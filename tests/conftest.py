@@ -1,0 +1,21 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+The image's sitecustomize pins the axon TPU platform programmatically, so an
+env var alone is not enough — jax.config.update must override it. XLA_FLAGS
+is still read lazily at CPU-backend init, so setting it here (before any
+jax.devices() call) is in time.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,73 @@
+"""Integration tier (envtest analog): real scheduler against the in-memory API
+server with fabricated nodes. Covers BASELINE eval config #1: a 1-pod
+google.com/tpu Filter pass on a CPU-only-emulated TPU node."""
+import time
+
+from tpusched.api.resources import CPU, TPU, TPU_MEMORY, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION
+from tpusched.testing import TestCluster, make_node, make_pod, make_tpu_node
+
+
+def test_single_tpu_pod_schedules():
+    with TestCluster() as c:
+        c.add_nodes([make_node("cpu-node"), make_tpu_node("tpu-node")])
+        pod = make_pod("jax-worker", limits={TPU: 4},
+                       requests=make_resources(cpu=8, memory="16Gi"))
+        c.create_pods([pod])
+        assert c.wait_for_pods_scheduled([pod.key])
+        bound = c.pod(pod.key)
+        assert bound.spec.node_name == "tpu-node"
+        assert bound.meta.annotations[CHIP_INDEX_ANNOTATION] == "0,1,2,3"
+
+
+def test_fractional_pods_pack_one_chip():
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("tpu-node")])
+        pods = [make_pod(f"frac-{i}", limits={TPU_MEMORY: 10 * 1024})
+                for i in range(3)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods])
+        indexes = {c.pod(p.key).meta.annotations[CHIP_INDEX_ANNOTATION]
+                   for p in pods}
+        assert indexes == {"0"}  # bin-pack keeps them on one chip
+
+
+def test_unschedulable_pod_stays_pending_then_fits_after_node_add():
+    with TestCluster() as c:
+        c.add_nodes([make_node("cpu-node")])
+        pod = make_pod("needs-tpu", limits={TPU: 1})
+        c.create_pods([pod])
+        assert c.wait_for_pods_unscheduled([pod.key], hold=0.4)
+        c.add_nodes([make_tpu_node("late-tpu")])
+        assert c.wait_for_pods_scheduled([pod.key], timeout=15)
+
+
+def test_chip_exhaustion_blocks_fifth_pod():
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("tpu-node", chips=4)])
+        pods = [make_pod(f"w{i}", limits={TPU: 1}) for i in range(4)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods])
+        # all four chips distinct
+        assert sorted(c.pod(p.key).meta.annotations[CHIP_INDEX_ANNOTATION]
+                      for p in pods) == ["0", "1", "2", "3"]
+        extra = make_pod("w4", limits={TPU: 1})
+        c.create_pods([extra])
+        assert c.wait_for_pods_unscheduled([extra.key], hold=0.4)
+        # deleting a bound pod frees its chip and unsticks the waiter
+        c.api.delete(srv.PODS, pods[0].key)
+        assert c.wait_for_pods_scheduled([extra.key], timeout=15)
+
+
+def test_priority_order_respected():
+    with TestCluster() as c:
+        # no nodes yet: both pods queue; high priority must bind first
+        lo = make_pod("lo", limits={TPU: 4}, priority=1)
+        hi = make_pod("hi", limits={TPU: 4}, priority=100)
+        c.create_pods([lo, hi])
+        time.sleep(0.3)
+        c.add_nodes([make_tpu_node("tpu-node", chips=4)])
+        assert c.wait_for_pods_scheduled([hi.key])
+        assert c.pod(hi.key).spec.node_name == "tpu-node"
+        assert not c.pod_scheduled(lo.key)

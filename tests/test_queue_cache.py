@@ -1,0 +1,88 @@
+"""Unit tests: scheduling queue ordering/backoff/moves and scheduler cache."""
+import time
+
+from tpusched.fwk.interfaces import (ClusterEvent, EVENT_ADD, RESOURCE_NODE,
+                                     RESOURCE_POD_GROUP)
+from tpusched.sched.cache import Cache
+from tpusched.sched.queue import QueuedPodInfo, SchedulingQueue
+from tpusched.testing import make_node, make_pod
+
+
+def prio_less(a, b):
+    if a.pod.priority != b.pod.priority:
+        return a.pod.priority > b.pod.priority
+    return a.timestamp < b.timestamp
+
+
+def test_queue_priority_order():
+    q = SchedulingQueue(prio_less)
+    q.add(make_pod("low", priority=1))
+    q.add(make_pod("high", priority=10))
+    q.add(make_pod("mid", priority=5))
+    assert q.pop().pod.name == "high"
+    assert q.pop().pod.name == "mid"
+    assert q.pop().pod.name == "low"
+
+
+def test_queue_fifo_within_priority():
+    q = SchedulingQueue(prio_less)
+    for i in range(5):
+        q.add(make_pod(f"p{i}"))
+    assert [q.pop().pod.name for _ in range(5)] == [f"p{i}" for i in range(5)]
+
+
+def test_unschedulable_requeue_on_matching_event():
+    event_map = {"PluginA": [ClusterEvent(RESOURCE_POD_GROUP, EVENT_ADD)]}
+    q = SchedulingQueue(prio_less, event_map)
+    info = QueuedPodInfo(make_pod("p"))
+    info.attempts = 1
+    info.unschedulable_plugins = {"PluginA"}
+    q.add_unschedulable_if_not_present(info)
+    assert q.pop(timeout=0.05) is None
+    # non-matching event: stays parked
+    q.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)
+    assert q.pop(timeout=0.05) is None
+    # matching event: requeued (after its backoff expires)
+    q.move_all_to_active_or_backoff(RESOURCE_POD_GROUP, EVENT_ADD)
+    got = q.pop(timeout=3.0)
+    assert got is not None and got.pod.name == "p"
+
+
+def test_activate_bypasses_unschedulable():
+    q = SchedulingQueue(prio_less)
+    pod = make_pod("gang-member")
+    info = QueuedPodInfo(pod)
+    info.unschedulable_plugins = {"Coscheduling"}
+    info.attempts = 3
+    q.add_unschedulable_if_not_present(info)
+    q.activate([pod])
+    got = q.pop(timeout=0.5)
+    assert got is not None and got.pod.name == "gang-member"
+
+
+def test_cache_assume_confirm_snapshot():
+    c = Cache()
+    c.add_node(make_node("n1"))
+    pod = make_pod("p1", requests={"cpu": 1000})
+    c.assume_pod(pod, "n1")
+    snap = c.snapshot()
+    assert len(snap.get("n1").pods) == 1
+    # confirmation replaces assumed
+    bound = pod.deepcopy()
+    bound.spec.node_name = "n1"
+    c.add_pod(bound)
+    assert not c.is_assumed(pod.key)
+    assert len(c.snapshot().get("n1").pods) == 1
+    c.remove_pod(bound)
+    assert len(c.snapshot().get("n1").pods) == 0
+
+
+def test_cache_assumed_expires_without_confirmation():
+    now = [100.0]
+    c = Cache(clock=lambda: now[0])
+    c.add_node(make_node("n1"))
+    pod = make_pod("p1")
+    c.assume_pod(pod, "n1")
+    c.finish_binding(pod)
+    now[0] += 31.0  # past ASSUME_EXPIRATION_S
+    assert len(c.snapshot().get("n1").pods) == 0

@@ -1,0 +1,61 @@
+"""Unit tests: resource quantities and pod request math
+(reference analog: pkg/util/resource_test.go)."""
+from tpusched.api.resources import (CPU, MEMORY, TPU, TPU_MEMORY,
+                                    add_resources, make_resources,
+                                    parse_quantity, resources_fit,
+                                    sub_resources)
+from tpusched.testing import make_pod
+from tpusched.util.podutil import pod_effective_request
+
+
+def test_parse_quantity_cpu():
+    assert parse_quantity("2", CPU) == 2000
+    assert parse_quantity("500m", CPU) == 500
+    assert parse_quantity(1.5, CPU) == 1500
+
+
+def test_parse_quantity_memory():
+    assert parse_quantity("1Gi", MEMORY) == 2**30
+    assert parse_quantity("512Mi", MEMORY) == 512 * 2**20
+    assert parse_quantity("1G", MEMORY) == 10**9
+
+
+def test_make_resources():
+    r = make_resources(cpu="2", memory="4Gi", tpu=4, tpu_memory=1024)
+    assert r[CPU] == 2000
+    assert r[MEMORY] == 4 * 2**30
+    assert r[TPU] == 4
+    assert r[TPU_MEMORY] == 1024
+
+
+def test_resource_arithmetic():
+    a = {CPU: 1000, TPU: 2}
+    b = {CPU: 500, MEMORY: 10}
+    assert add_resources(a, b) == {CPU: 1500, TPU: 2, MEMORY: 10}
+    assert sub_resources(a, b) == {CPU: 500, TPU: 2, MEMORY: -10}
+    assert resources_fit({CPU: 500}, {CPU: 500})
+    assert not resources_fit({CPU: 501}, {CPU: 500})
+    assert not resources_fit({TPU: 1}, {CPU: 500})
+
+
+def test_pod_effective_request_max_of_init_containers():
+    # max(Σ containers, max(initContainers)) per resource (resource.go:50-78)
+    pod = make_pod("p", requests={CPU: 1000})
+    from tpusched.api.core import Container
+    pod.spec.containers.append(Container(name="c2", requests={CPU: 500}))
+    pod.spec.init_containers.append(Container(name="init", requests={CPU: 2000}))
+    req = pod_effective_request(pod)
+    assert req[CPU] == 2000  # init dominates
+    pod.spec.init_containers[0].requests[CPU] = 1200
+    assert pod_effective_request(pod)[CPU] == 1500  # sum dominates
+
+
+def test_qos_classes():
+    from tpusched.api.core import QOS_BEST_EFFORT, QOS_BURSTABLE, QOS_GUARANTEED
+    best_effort = make_pod("be")
+    assert best_effort.qos_class() == QOS_BEST_EFFORT
+    burstable = make_pod("bu", requests={CPU: 100})
+    assert burstable.qos_class() == QOS_BURSTABLE
+    guaranteed = make_pod("gu", requests={CPU: 100, MEMORY: 100},
+                          limits={CPU: 100, MEMORY: 100})
+    assert guaranteed.qos_class() == QOS_GUARANTEED

@@ -1,0 +1,134 @@
+"""TpuSlice plugin unit tests (reference has no flexgpu unit tests — SURVEY §2
+row 1 notes 0 test LoC; this suite covers the fit/score/reserve semantics the
+reference only exercises manually, including its documented quirks we fixed)."""
+from tpusched.api.resources import TPU, TPU_MEMORY
+from tpusched.fwk import CycleState, PluginProfile
+from tpusched.fwk.nodeinfo import NodeInfo
+from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION, ChipNode, TpuSlice
+from tpusched.testing import make_pod, make_tpu_node, new_test_framework
+
+V5P_HBM = 95 * 1024
+
+
+def tpuslice_profile():
+    return PluginProfile(filter=["TpuSlice"], score=[("TpuSlice", 1)],
+                        reserve=["TpuSlice"], bind=["TpuSlice"])
+
+
+def node_info_with(pods=(), chips=4):
+    node = make_tpu_node("n1", chips=chips)
+    return NodeInfo(node, pods)
+
+
+def test_chipnode_from_empty_node():
+    cn = ChipNode.from_node_info(node_info_with())
+    assert len(cn.chips) == 4
+    assert all(c.hbm_mb == V5P_HBM for c in cn.chips)
+    assert cn.free_chip_indexes() == [0, 1, 2, 3]
+
+
+def test_chipnode_rebuilds_from_annotations():
+    mono = make_pod("mono", limits={TPU: 1},
+                    annotations={CHIP_INDEX_ANNOTATION: "2"}, node_name="n1")
+    frac = make_pod("frac", limits={TPU_MEMORY: 1000},
+                    annotations={CHIP_INDEX_ANNOTATION: "0"}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([mono, frac]))
+    assert cn.chips[2].monopoly
+    assert cn.chips[0].used_mb == 1000
+    assert cn.free_chip_indexes() == [1, 3]
+
+
+def test_chipnode_annotationless_pod_skipped():
+    # fixed quirk: annotation checked before parsing (gpu_node.go:91-96)
+    p = make_pod("no-ann", limits={TPU: 1}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([p]))
+    assert cn.free_chip_indexes() == [0, 1, 2, 3]
+
+
+def test_mem_fit_binpack_order():
+    # chip 1 has least remaining after fit → listed first (bin-pack)
+    a = make_pod("a", limits={TPU_MEMORY: 50 * 1024},
+                 annotations={CHIP_INDEX_ANNOTATION: "1"}, node_name="n1")
+    b = make_pod("b", limits={TPU_MEMORY: 10 * 1024},
+                 annotations={CHIP_INDEX_ANNOTATION: "3"}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([a, b]))
+    fits = cn.mem_fit_indexes(20 * 1024)
+    assert fits[0] == 1 and fits[1] == 3
+    assert set(fits) == {0, 1, 2, 3}
+
+
+def test_mem_fit_no_aliasing_corruption():
+    # fixed quirk: the reference's fit computation mutated chip state
+    # (gpu_node.go:134-144); repeated fits must be idempotent here.
+    cn = ChipNode.from_node_info(node_info_with())
+    before = [(c.used_mb, c.hbm_mb) for c in cn.chips]
+    for _ in range(5):
+        cn.mem_fit_indexes(1024)
+    assert [(c.used_mb, c.hbm_mb) for c in cn.chips] == before
+
+
+def test_filter_conflict_and_capacity():
+    fw, handle, _ = new_test_framework(tpuslice_profile(),
+                                       nodes=[make_tpu_node("n1")])
+    ni = handle.snapshot_shared_lister().get("n1")
+    plugin = fw.plugins["TpuSlice"]
+    # mixing whole-chip and fractional is UnschedulableAndUnresolvable
+    s = plugin.filter(CycleState(), make_pod("x", limits={TPU: 1, TPU_MEMORY: 5}), ni)
+    assert s.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE"
+    # 5 chips on a 4-chip node
+    s = plugin.filter(CycleState(), make_pod("y", limits={TPU: 5}), ni)
+    assert s.is_unschedulable()
+    # fits
+    s = plugin.filter(CycleState(), make_pod("z", limits={TPU: 4}), ni)
+    assert s.is_success()
+    # non-TPU pod passes trivially
+    s = plugin.filter(CycleState(), make_pod("w"), ni)
+    assert s.is_success()
+
+
+def test_filter_non_tpu_node_unresolvable():
+    from tpusched.testing import make_node
+    fw, handle, _ = new_test_framework(tpuslice_profile(),
+                                       nodes=[make_node("cpu-only")])
+    ni = handle.snapshot_shared_lister().get("cpu-only")
+    s = fw.plugins["TpuSlice"].filter(CycleState(), make_pod("p", limits={TPU: 1}), ni)
+    assert s.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE"
+
+
+def test_reserve_whole_chips_multi():
+    fw, handle, _ = new_test_framework(tpuslice_profile(),
+                                       nodes=[make_tpu_node("n1")])
+    pod = make_pod("p", limits={TPU: 4})
+    s = fw.run_reserve_plugins_reserve(CycleState(), pod, "n1")
+    assert s.is_success()
+    assert pod.meta.annotations[CHIP_INDEX_ANNOTATION] == "0,1,2,3"
+    fw.run_reserve_plugins_unreserve(CycleState(), pod, "n1")
+    assert CHIP_INDEX_ANNOTATION not in pod.meta.annotations
+
+
+def test_reserve_fractional_binpack():
+    occupied = make_pod("occ", limits={TPU_MEMORY: 90 * 1024},
+                        annotations={CHIP_INDEX_ANNOTATION: "2"}, node_name="n1")
+    node = make_tpu_node("n1")
+    fw, handle, _ = new_test_framework(tpuslice_profile(), nodes=[node],
+                                       pods=[occupied])
+    pod = make_pod("p", limits={TPU_MEMORY: 4 * 1024})
+    s = fw.run_reserve_plugins_reserve(CycleState(), pod, "n1")
+    assert s.is_success()
+    # chip 2 has least remaining (95-90-4=1GB) → bin-pack picks it
+    assert pod.meta.annotations[CHIP_INDEX_ANNOTATION] == "2"
+
+
+def test_score_binpack_normalize():
+    # fuller node must win under the reference's reverse normalize
+    n_empty = make_tpu_node("empty")
+    n_half = make_tpu_node("half")
+    used = make_pod("u", limits={TPU: 2},
+                    annotations={CHIP_INDEX_ANNOTATION: "0,1"}, node_name="half")
+    fw, handle, _ = new_test_framework(tpuslice_profile(),
+                                       nodes=[n_empty, n_half], pods=[used])
+    state = CycleState()
+    totals, s = fw.run_score_plugins(state, make_pod("p", limits={TPU: 1}),
+                                     [n_empty, n_half])
+    assert s.is_success()
+    assert totals["half"] > totals["empty"]

@@ -1,0 +1,39 @@
+"""tpusched — a TPU-native scheduling framework.
+
+A brand-new, from-scratch rebuild of the capabilities of
+WLBF/flex-gpu-scheduler (a kubernetes-sigs/scheduler-plugins fork, 100% Go;
+see SURVEY.md): a scheduling framework with QueueSort / PreFilter / Filter /
+PostFilter / Score / Reserve / Permit / Bind / PostBind extension points,
+hosting a TPU-native plugin suite:
+
+- ``plugins.tpuslice``        — fractional-TPU placement (``google.com/tpu`` chips,
+                                ``google.com/tpu-memory`` HBM MB); successor of
+                                the reference's pkg/flexgpu (flex_gpu.go).
+- ``plugins.coscheduling``    — PodGroup gang (all-or-nothing) admission;
+                                successor of pkg/coscheduling.
+- ``plugins.capacity``        — ElasticQuota min/max capacity sharing with
+                                quota-aware preemption; successor of
+                                pkg/capacityscheduling.
+- ``plugins.topologymatch``   — ICI-torus slice-shape fitting; TPU-native
+                                successor of pkg/noderesourcetopology (NUMA).
+- ``plugins.multislice``      — DCN-aware cross-slice scoring for multi-slice
+                                jobs (new; no reference analog).
+- ``plugins.trimaran``        — load-aware scoring (TargetLoadPacking,
+                                LoadVariationRiskBalancing); successor of
+                                pkg/trimaran.
+- ``plugins.allocatable``     — NodeResourcesAllocatable scoring.
+- ``plugins.preemptiontoleration``, ``plugins.podstate``, ``plugins.qossort``,
+  ``plugins.crossnodepreemption`` — the remaining reference plugin suite.
+
+The control plane is an in-memory API server (``tpusched.apiserver``) with
+watch/list/patch semantics standing in for the Kubernetes API server, so the
+whole framework runs hermetically (the reference's envtest analog) while
+keeping the same process-boundary discipline: plugins read through informer
+caches and write through a clientset.
+
+The workloads being placed are JAX/XLA jobs; ``tpusched.jaxbridge`` maps a
+gang's slice assignment onto a ``jax.sharding.Mesh`` so a scheduled PodGroup
+turns directly into a sharded pjit training step.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""API object model: core objects (Pod/Node/...), scheduling CRDs, topology CRs."""

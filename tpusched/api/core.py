@@ -1,0 +1,223 @@
+"""Core API objects: Pod, Node, PriorityClass, PodDisruptionBudget, Binding.
+
+The minimal slice of k8s core/v1 the scheduling framework needs, rebuilt as
+plain dataclasses. Semantics follow the reference's usage of client-go types
+(pods with resource requests, nodes with allocatable, binding subresource at
+/root/reference/pkg/flexgpu/flex_gpu.go:230-242).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .meta import ObjectMeta
+from .resources import CPU, MEMORY, ResourceList
+
+# -- Pod phases (v1.PodPhase) -------------------------------------------------
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+# -- QoS classes (k8s component-helpers qos, used by the qossort plugin,
+#    /root/reference/pkg/qos/queue_sort.go:42-59) -----------------------------
+QOS_GUARANTEED = "Guaranteed"
+QOS_BURSTABLE = "Burstable"
+QOS_BEST_EFFORT = "BestEffort"
+
+DEFAULT_SCHEDULER_NAME = "tpusched"
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    requests: ResourceList = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"   # Equal | Exists
+    value: str = ""
+    effect: str = ""          # "" matches all effects
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    priority: int = 0
+    priority_class_name: str = ""
+    tolerations: List[Toleration] = field(default_factory=list)
+    overhead: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    nominated_node_name: str = ""
+    conditions: List[PodCondition] = field(default_factory=list)
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def namespace(self) -> str:
+        return self.meta.namespace
+
+    @property
+    def priority(self) -> int:
+        return self.spec.priority
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+    def qos_class(self) -> str:
+        """QoS per k8s component-helpers (reference qossort dependency)."""
+        requests: ResourceList = {}
+        limits: ResourceList = {}
+        all_guaranteed = True
+        for c in self.spec.containers + self.spec.init_containers:
+            for k, v in c.requests.items():
+                if v > 0:
+                    requests[k] = requests.get(k, 0) + v
+            for k, v in c.limits.items():
+                if v > 0:
+                    limits[k] = limits.get(k, 0) + v
+            for res in (CPU, MEMORY):
+                if c.limits.get(res, 0) == 0 or c.requests.get(res, c.limits.get(res, 0)) != c.limits.get(res, 0):
+                    all_guaranteed = False
+        if not requests and not limits:
+            return QOS_BEST_EFFORT
+        if all_guaranteed and set(requests) == set(limits) and limits:
+            if all(requests.get(k, 0) == v for k, v in limits.items()):
+                return QOS_GUARANTEED
+        return QOS_BURSTABLE
+
+    def is_terminating(self) -> bool:
+        return self.meta.deletion_timestamp is not None
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    def __post_init__(self):
+        self.meta.namespace = ""  # nodes are cluster-scoped
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def deepcopy(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass; annotations drive preemption
+    toleration policy (/root/reference/pkg/preemptiontoleration/
+    preemption_toleration_policy.go:26-53)."""
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PDB — only what the preemption reprieve loop needs
+    (/root/reference/pkg/capacityscheduling/capacity_scheduling.go:857-902)."""
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)   # matchLabels only
+    disruptions_allowed: int = 0
+
+    def matches(self, pod: Pod) -> bool:
+        if not self.selector or pod.namespace != self.meta.namespace:
+            return False
+        return all(pod.meta.labels.get(k) == v for k, v in self.selector.items())
+
+
+@dataclass
+class Binding:
+    """The Bind subresource payload. The reference's custom FlexGPU Bind copies
+    pod annotations into the Binding object so the on-node device plugin can
+    read the chosen device index (/root/reference/pkg/flexgpu/flex_gpu.go:230-242);
+    we preserve that contract."""
+    pod_key: str = ""
+    node_name: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Event:
+    """A k8s Event record (controllers emit these,
+    /root/reference/pkg/controller/elasticquota.go:208)."""
+    object_key: str = ""
+    kind: str = ""
+    type: str = "Normal"
+    reason: str = ""
+    message: str = ""
+    timestamp: float = 0.0
+
+
+def tolerates(pod: Pod, taint: Taint) -> bool:
+    for t in pod.spec.tolerations:
+        if t.effect and t.effect != taint.effect:
+            continue
+        if t.operator == "Exists":
+            if not t.key or t.key == taint.key:
+                return True
+        elif t.key == taint.key and t.value == taint.value:
+            return True
+    return False

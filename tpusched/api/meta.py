@@ -1,0 +1,52 @@
+"""Object metadata — the apimachinery slice the framework needs.
+
+Replaces k8s.io/apimachinery ObjectMeta for the rebuilt control plane
+(reference uses metav1.ObjectMeta throughout, e.g.
+/root/reference/apis/scheduling/v1alpha1/types.go:30).
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def new_uid() -> str:
+    with _uid_lock:
+        return f"uid-{next(_uid_counter):08d}"
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        """namespace/name key, the canonical cache key (client-go MetaNamespaceKeyFunc)."""
+        return f"{self.namespace}/{self.name}"
+
+    def deepcopy(self) -> "ObjectMeta":
+        return copy.deepcopy(self)

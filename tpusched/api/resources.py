@@ -1,0 +1,91 @@
+"""Resource quantities and lists.
+
+A deliberately simple replacement for k8s resource.Quantity: quantities are
+plain integers in canonical units (cpu: millicores, memory: bytes, extended
+resources: integral counts). The reference's device model corrupted itself by
+aliasing Quantity pointers (/root/reference/pkg/flexgpu/gpu_node.go:134-144,
+:55,:73 — `assumed := u.usedMemory; assumed.Add(...)` mutates shared state);
+value-typed ints make that class of bug impossible here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+# Canonical resource names (k8s v1.ResourceName analogs).
+CPU = "cpu"                     # millicores
+MEMORY = "memory"               # bytes
+PODS = "pods"                   # count
+EPHEMERAL_STORAGE = "ephemeral-storage"  # bytes
+
+# TPU-native extended resources (north star: zero nvidia.com/* references;
+# successor of nvidia.flex.com/gpu + nvidia.flex.com/memory,
+# /root/reference/pkg/flexgpu/flex_gpu.go:31-34).
+TPU = "google.com/tpu"              # whole chips
+TPU_MEMORY = "google.com/tpu-memory"  # HBM megabytes, fractional-chip sharing
+
+_SUFFIXES = {
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+ResourceList = Dict[str, int]
+
+
+def parse_quantity(value, resource: str = "") -> int:
+    """Parse '2', '500m', '1Gi', 1.5 → canonical int units.
+
+    cpu values are returned in millicores; everything else in base units.
+    """
+    if isinstance(value, (int, float)):
+        if resource == CPU:
+            return int(round(float(value) * 1000))
+        return int(value)
+    s = str(value).strip()
+    if s.endswith("m"):
+        n = int(float(s[:-1]))
+        return n if resource == CPU else n  # milli only meaningful for cpu
+    for suf in sorted(_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suf):
+            base = float(s[: -len(suf)]) * _SUFFIXES[suf]
+            return int(round(base * 1000)) if resource == CPU else int(base)
+    if resource == CPU:
+        return int(round(float(s) * 1000))
+    return int(float(s))
+
+
+def make_resources(**kw) -> ResourceList:
+    """Builder: make_resources(cpu='2', memory='4Gi', tpu=4) → canonical ResourceList.
+
+    Mirrors the reference's test builder MakeResourceList().CPU().Mem().GPU()
+    (/root/reference/test/integration/utils.go:59-160).
+    """
+    out: ResourceList = {}
+    alias = {"cpu": CPU, "memory": MEMORY, "mem": MEMORY, "pods": PODS,
+             "tpu": TPU, "tpu_memory": TPU_MEMORY}
+    for k, v in kw.items():
+        name = alias.get(k, k)
+        out[name] = parse_quantity(v, name)
+    return out
+
+
+def add_resources(a: Mapping[str, int], b: Mapping[str, int]) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def sub_resources(a: Mapping[str, int], b: Mapping[str, int]) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) - v
+    return out
+
+
+def resources_fit(request: Mapping[str, int], free: Mapping[str, int]) -> bool:
+    """True if every requested resource fits into `free` (missing free ⇒ 0)."""
+    return all(v <= free.get(k, 0) for k, v in request.items() if v > 0)
+
+
+def any_resource_positive(r: Mapping[str, int]) -> bool:
+    return any(v > 0 for v in r.values())

@@ -1,0 +1,119 @@
+"""Scheduling CRDs: PodGroup and ElasticQuota.
+
+TPU-native rebuild of the reference's scheduling.sigs.k8s.io/v1alpha1 group
+(/root/reference/apis/scheduling/v1alpha1/types.go:30-180). Both types are
+kept accelerator-agnostic (north star in BASELINE.json): resource lists may
+name any resource including google.com/tpu.
+
+Group name: scheduling.tpu.dev. Gang membership label:
+``pod-group.scheduling.tpu.dev`` (analog of PodGroupLabel, types.go:113).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+from .resources import ResourceList
+
+GROUP_NAME = "scheduling.tpu.dev"
+POD_GROUP_LABEL = "pod-group." + GROUP_NAME
+
+# PodGroup phases (types.go:84-111). The lifecycle driven by the PodGroup
+# controller is "" → Pending → PreScheduling → Scheduling/Scheduled → Running
+# → Finished/Failed (/root/reference/pkg/controller/podgroup.go:185-273).
+PG_PENDING = "Pending"
+PG_PRE_SCHEDULING = "PreScheduling"
+PG_SCHEDULING = "Scheduling"
+PG_SCHEDULED = "Scheduled"
+PG_RUNNING = "Running"
+PG_UNKNOWN = "Unknown"
+PG_FINISHED = "Finished"
+PG_FAILED = "Failed"
+
+
+@dataclass
+class PodGroupSpec:
+    # Minimal number of members to run the gang; fewer ⇒ nobody starts.
+    min_member: int = 0
+    # Minimal aggregate resources for the gang; used by the coscheduling
+    # PreFilter cluster-capacity dry-run.
+    min_resources: Optional[ResourceList] = None
+    # Max seconds gang members wait in Permit before mass rejection.
+    schedule_timeout_seconds: Optional[int] = None
+    # --- TPU-native extensions (no reference analog; see SURVEY §7) ---
+    # Requested ICI slice shape, e.g. "4x4x4" on a v5p torus. Consumed by the
+    # topologymatch plugin for all-or-nothing slice placement.
+    tpu_slice_shape: str = ""
+    # Requested accelerator type, e.g. "tpu-v5p" / "tpu-v5e".
+    tpu_accelerator: str = ""
+    # For multi-slice jobs: name of the MultiSliceSet this gang belongs to and
+    # its slice ordinal; consumed by the multislice DCN-aware scorer.
+    multislice_set: str = ""
+    multislice_index: int = 0
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = ""
+    occupied_by: str = ""
+    scheduled: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    schedule_start_time: Optional[float] = None
+
+
+@dataclass
+class PodGroup:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+    def deepcopy(self) -> "PodGroup":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ElasticQuotaSpec:
+    # Min: guaranteed resources; Max: ceiling (types.go:30-63). used ≤ max
+    # always; used > min means this quota is borrowing from others.
+    min: ResourceList = field(default_factory=dict)
+    max: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuotaStatus:
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuota:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+    def deepcopy(self) -> "ElasticQuota":
+        return copy.deepcopy(self)
+
+
+def pod_group_label(pod) -> str:
+    """Gang name from the membership label (util/podgroup.go:53-60)."""
+    return pod.meta.labels.get(POD_GROUP_LABEL, "")
+
+
+def pod_group_full_name(pod) -> str:
+    """namespace/pgName, or "" for non-gang pods (util/podgroup.go:63-69)."""
+    name = pod_group_label(pod)
+    if not name:
+        return ""
+    return f"{pod.meta.namespace}/{name}"

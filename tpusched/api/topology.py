@@ -1,0 +1,96 @@
+"""TPU topology CRs — the TPU-native successor of the NodeResourceTopology CRD.
+
+The reference's NUMA plugin consumes an external NodeResourceTopology CRD
+listing per-NUMA-zone resources ("node-%d",
+/root/reference/pkg/noderesourcetopology/pluginhelpers.go:69-89) and fits pods
+with a 1-D bitmask (filter.go:84-150). The TPU generalization (SURVEY §5, §7.5):
+a node pool publishes a ``TpuTopology`` CR describing its ICI torus — axes,
+wraparound, host coordinates — and the topologymatch plugin fits *slice shapes*
+(2x2x1 … 4x4x8) as sub-blocks of the torus.
+
+Group: topology.tpu.dev.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+from .meta import ObjectMeta
+
+TOPOLOGY_GROUP = "topology.tpu.dev"
+
+# Node labels published by the (emulated) TPU device plugin / node pool.
+LABEL_POOL = "tpu.dev/pool"               # node-pool (slice) name
+LABEL_ACCELERATOR = "tpu.dev/accelerator"  # e.g. "tpu-v5p"
+LABEL_COORD = "tpu.dev/coord"              # host coordinate "x-y-z" in the pool torus
+LABEL_DCN_DOMAIN = "tpu.dev/dcn-domain"    # DCN proximity domain (multislice scoring)
+
+
+@dataclass(frozen=True)
+class TpuAccelerator:
+    """Static accelerator catalog entry (hardware model, not a CR)."""
+    name: str
+    ici_dims: int          # 2 for v5e (2-D torus/mesh), 3 for v5p (3-D torus)
+    chips_per_host: int
+    hbm_mb_per_chip: int
+    max_dims: Tuple[int, ...]   # largest supported slice per axis (chips)
+
+
+# Public v5e/v5p topology facts (cloud.google.com/tpu docs): v5e hosts carry
+# 1/4/8 chips (we model 4), 16 GB HBM, 2-D up to 16x16; v5p hosts carry 4
+# chips, 95 GB HBM, 3-D torus up to 16x20x28.
+V5E = TpuAccelerator("tpu-v5e", ici_dims=2, chips_per_host=4,
+                     hbm_mb_per_chip=16 * 1024, max_dims=(16, 16))
+V5P = TpuAccelerator("tpu-v5p", ici_dims=3, chips_per_host=4,
+                     hbm_mb_per_chip=95 * 1024, max_dims=(16, 20, 28))
+
+ACCELERATORS: Dict[str, TpuAccelerator] = {a.name: a for a in (V5E, V5P)}
+
+
+def parse_shape(s: str) -> Tuple[int, ...]:
+    """'4x4x4' → (4,4,4). Raises ValueError on malformed shapes."""
+    dims = tuple(int(p) for p in s.lower().split("x"))
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid slice shape {s!r}")
+    return dims
+
+
+def format_coord(c: Tuple[int, ...]) -> str:
+    return "-".join(str(x) for x in c)
+
+
+def parse_coord(s: str) -> Tuple[int, ...]:
+    return tuple(int(p) for p in s.split("-"))
+
+
+@dataclass
+class TpuTopologySpec:
+    pool: str = ""                       # node-pool name
+    accelerator: str = "tpu-v5p"
+    # Torus dims in CHIP units per axis, e.g. (8, 8, 4) for a v5p-256 pool.
+    dims: Tuple[int, ...] = ()
+    # Per-axis wraparound. Real slices get wraparound links only on full-size
+    # axes; emulated pools set this explicitly.
+    wrap: Tuple[bool, ...] = ()
+    # Host coordinates in CHIP units (hosts own `chips_per_host` chips laid
+    # out contiguously along the last axis): node name → base chip coordinate.
+    hosts: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    chips_per_host: int = 4
+    dcn_domain: str = ""
+
+
+@dataclass
+class TpuTopology:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TpuTopologySpec = field(default_factory=TpuTopologySpec)
+
+    def __post_init__(self):
+        self.meta.namespace = ""  # cluster-scoped, like NodeResourceTopology
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+    def deepcopy(self) -> "TpuTopology":
+        return copy.deepcopy(self)

@@ -1,0 +1,15 @@
+"""In-memory API server + client/informer layer.
+
+The reference's only process boundary is the Kubernetes API server: plugins
+read through informer caches and write via clientset (SURVEY §1, §5 —
+"the API server (etcd) *is* the checkpoint"). This package rebuilds that
+contract hermetically: a thread-safe object store with watch fan-out,
+merge-patch, and the Bind subresource, so the real scheduler + controllers run
+in-process against fabricated Nodes exactly like the reference's envtest
+integration tier (/root/reference/test/integration/main_test.go:31-46).
+"""
+from .server import APIServer, WatchEvent
+from .client import Clientset
+from .informers import Informer, InformerFactory
+
+__all__ = ["APIServer", "WatchEvent", "Clientset", "Informer", "InformerFactory"]

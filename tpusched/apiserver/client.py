@@ -1,0 +1,98 @@
+"""Typed clientset facade over the APIServer.
+
+Analog of the generated clientset in /root/reference/pkg/generated
+(versioned.NewForConfig) plus the core kube client: typed CRUD per kind, with
+the Bind subresource on pods. QPS/burst throttling is supported to mirror the
+controller's --qps/--burst API budget
+(/root/reference/cmd/controller/app/options.go:43-44).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..api.core import Binding
+from . import server as srv
+
+
+class _TokenBucket:
+    def __init__(self, qps: float, burst: int, clock=time.monotonic):
+        self.qps, self.burst, self._clock = qps, burst, clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def wait(self):
+        if self.qps <= 0:
+            return
+        while True:
+            with self._lock:
+                now = self._clock()
+                self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+                self._last = now
+                if self._tokens >= 1:
+                    self._tokens -= 1
+                    return
+                need = (1 - self._tokens) / self.qps
+            time.sleep(need)
+
+
+class _KindClient:
+    def __init__(self, api: srv.APIServer, kind: str, bucket: Optional[_TokenBucket]):
+        self._api, self._kind, self._bucket = api, kind, bucket
+
+    def _throttle(self):
+        if self._bucket:
+            self._bucket.wait()
+
+    def create(self, obj):
+        self._throttle()
+        return self._api.create(self._kind, obj)
+
+    def get(self, key: str):
+        self._throttle()
+        return self._api.get(self._kind, key)
+
+    def try_get(self, key: str):
+        self._throttle()
+        return self._api.try_get(self._kind, key)
+
+    def list(self, namespace=None, selector: Optional[Dict[str, str]] = None):
+        self._throttle()
+        return self._api.list(self._kind, namespace, selector)
+
+    def update(self, obj):
+        self._throttle()
+        return self._api.update(self._kind, obj)
+
+    def patch(self, key: str, mutate: Callable):
+        self._throttle()
+        return self._api.patch(self._kind, key, mutate)
+
+    def delete(self, key: str):
+        self._throttle()
+        return self._api.delete(self._kind, key)
+
+
+class _PodClient(_KindClient):
+    def bind(self, binding: Binding):
+        self._throttle()
+        return self._api.bind(binding)
+
+
+class Clientset:
+    def __init__(self, api: srv.APIServer, qps: float = 0.0, burst: int = 0):
+        bucket = _TokenBucket(qps, burst) if qps > 0 else None
+        self.api = api
+        self.pods = _PodClient(api, srv.PODS, bucket)
+        self.nodes = _KindClient(api, srv.NODES, bucket)
+        self.podgroups = _KindClient(api, srv.POD_GROUPS, bucket)
+        self.elasticquotas = _KindClient(api, srv.ELASTIC_QUOTAS, bucket)
+        self.priorityclasses = _KindClient(api, srv.PRIORITY_CLASSES, bucket)
+        self.pdbs = _KindClient(api, srv.PDBS, bucket)
+        self.tputopologies = _KindClient(api, srv.TPU_TOPOLOGIES, bucket)
+
+    def record_event(self, object_key: str, kind: str, etype: str, reason: str,
+                     message: str = "") -> None:
+        self.api.record_event(object_key, kind, etype, reason, message)

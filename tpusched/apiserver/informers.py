@@ -1,0 +1,109 @@
+"""Informer/lister layer over APIServer watches.
+
+Analog of client-go SharedInformerFactory + the generated factory in
+/root/reference/pkg/generated/informers. An Informer keeps its own local cache
+(synced by watch events) and fans out to registered event handlers in watch
+order; Listers read from that cache without touching the server.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from . import server as srv
+
+
+class Informer:
+    def __init__(self, api: srv.APIServer, kind: str):
+        self._api = api
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._cache: Dict[str, Any] = {}
+        self._on_add: List[Callable[[Any], None]] = []
+        self._on_update: List[Callable[[Any, Any], None]] = []
+        self._on_delete: List[Callable[[Any], None]] = []
+        api.add_watch(kind, self._handle, replay=True)
+
+    def _handle(self, ev: srv.WatchEvent) -> None:
+        key = ev.object.meta.key
+        with self._lock:
+            if ev.type == srv.DELETED:
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = ev.object
+        if ev.type == srv.ADDED:
+            for h in list(self._on_add):
+                h(ev.object)
+        elif ev.type == srv.MODIFIED:
+            for h in list(self._on_update):
+                h(ev.old_object, ev.object)
+        else:
+            for h in list(self._on_delete):
+                h(ev.object)
+
+    def add_event_handler(self, on_add=None, on_update=None, on_delete=None,
+                          replay: bool = True) -> None:
+        """client-go AddEventHandler: with replay, on_add fires for every
+        object already in the cache. Snapshot+append happen under the informer
+        lock so an object created in between is either in the replay set or
+        delivered live (at-least-once; handlers must tolerate duplicate adds,
+        as client-go's must)."""
+        with self._lock:
+            existing = ([copy.deepcopy(o) for o in self._cache.values()]
+                        if (replay and on_add) else [])
+            if on_add:
+                self._on_add.append(on_add)
+            if on_update:
+                self._on_update.append(on_update)
+            if on_delete:
+                self._on_delete.append(on_delete)
+        for o in existing:
+            on_add(o)
+
+    # -- lister ---------------------------------------------------------------
+
+    def get(self, key: str):
+        with self._lock:
+            obj = self._cache.get(key)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def items(self, namespace: Optional[str] = None,
+              selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        with self._lock:
+            objs = [copy.deepcopy(o) for o in self._cache.values()
+                    if namespace is None or o.meta.namespace == namespace]
+        if selector:
+            objs = [o for o in objs
+                    if all(o.meta.labels.get(k) == v for k, v in selector.items())]
+        return objs
+
+    def has_synced(self) -> bool:
+        return True  # in-memory watches are synchronous
+
+
+class InformerFactory:
+    """SharedInformerFactory analog: one shared Informer per kind."""
+
+    def __init__(self, api: srv.APIServer):
+        self._api = api
+        self._lock = threading.Lock()
+        self._informers: Dict[str, Informer] = {}
+
+    def informer(self, kind: str) -> Informer:
+        with self._lock:
+            if kind not in self._informers:
+                self._informers[kind] = Informer(self._api, kind)
+            return self._informers[kind]
+
+    # typed sugar
+    def pods(self) -> Informer: return self.informer(srv.PODS)
+    def nodes(self) -> Informer: return self.informer(srv.NODES)
+    def podgroups(self) -> Informer: return self.informer(srv.POD_GROUPS)
+    def elasticquotas(self) -> Informer: return self.informer(srv.ELASTIC_QUOTAS)
+    def priorityclasses(self) -> Informer: return self.informer(srv.PRIORITY_CLASSES)
+    def pdbs(self) -> Informer: return self.informer(srv.PDBS)
+    def tputopologies(self) -> Informer: return self.informer(srv.TPU_TOPOLOGIES)
+
+    def wait_for_cache_sync(self) -> None:
+        return  # synchronous watches: always synced

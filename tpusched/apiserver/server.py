@@ -1,0 +1,240 @@
+"""Thread-safe in-memory object store with watch fan-out.
+
+Semantics mirrored from the k8s API server as the reference uses it:
+- objects are stored by kind + namespace/name key; every write bumps
+  ``resource_version``;
+- reads return deep copies (informer-cache isolation — callers may never
+  mutate stored state in place, the discipline client-go enforces by
+  convention);
+- writers race via optimistic concurrency is *not* modeled; instead ``patch``
+  takes a mutator applied atomically under the store lock, which is the
+  behavioral equivalent of the reference's strategic-merge-patch loop
+  (/root/reference/pkg/util/podgroup.go:33-50 + controller patch sites);
+- the Bind subresource sets ``pod.spec.node_name`` and merges the Binding's
+  annotations into the pod (contract of the reference's custom FlexGPU Bind,
+  flex_gpu.go:230-242).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api.core import Binding, Event, Pod
+from ..util import klog
+
+# Canonical kind names.
+PODS = "pods"
+NODES = "nodes"
+POD_GROUPS = "podgroups"
+ELASTIC_QUOTAS = "elasticquotas"
+PRIORITY_CLASSES = "priorityclasses"
+PDBS = "poddisruptionbudgets"
+TPU_TOPOLOGIES = "tputopologies"
+LEASES = "leases"
+
+ALL_KINDS = (PODS, NODES, POD_GROUPS, ELASTIC_QUOTAS, PRIORITY_CLASSES, PDBS,
+             TPU_TOPOLOGIES, LEASES)
+
+ADDED = "Added"
+MODIFIED = "Modified"
+DELETED = "Deleted"
+
+
+@dataclass
+class WatchEvent:
+    type: str            # Added | Modified | Deleted
+    kind: str
+    object: Any          # deep copy of the object after (or before, if Deleted)
+    old_object: Any = None  # deep copy before the change (Modified only)
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+@dataclass
+class _Lease:
+    """Coordination lease for leader election (reference analog: Endpoints
+    lock "sched-plugins-controller" in kube-system,
+    /root/reference/cmd/controller/app/server.go:84-123)."""
+    meta: Any = None
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration: float = 15.0
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+
+class APIServer:
+    """The hermetic control plane. All access is via the public methods; the
+    lock is never held while user callbacks run."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._stores: Dict[str, Dict[str, Any]] = {k: {} for k in ALL_KINDS}
+        self._handlers: Dict[str, List[Callable[[WatchEvent], None]]] = {k: [] for k in ALL_KINDS}
+        self._events: List[Event] = []           # k8s Events (recorder sink)
+        self._stopped = False
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.meta.resource_version = self._rv
+
+    def _dispatch(self, ev: WatchEvent) -> None:
+        for h in list(self._handlers[ev.kind]):
+            try:
+                h(ev)
+            except Exception as e:  # handlers must not kill the server
+                klog.error_s(e, "watch handler panicked", kind=ev.kind)
+
+    def add_watch(self, kind: str, handler: Callable[[WatchEvent], None],
+                  replay: bool = True) -> None:
+        """Register a watch handler. With replay=True (client-go semantics),
+        the handler first receives synthetic Added events for every existing
+        object."""
+        with self._lock:
+            existing = [copy.deepcopy(o) for o in self._stores[kind].values()]
+            self._handlers[kind].append(handler)
+        if replay:
+            for o in existing:
+                handler(WatchEvent(ADDED, kind, o))
+
+    # -- CRUD -----------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> Any:
+        with self._lock:
+            key = obj.meta.key
+            if key in self._stores[kind]:
+                raise Conflict(f"{kind} {key} already exists")
+            stored = copy.deepcopy(obj)
+            if not stored.meta.creation_timestamp:
+                stored.meta.creation_timestamp = self._clock()
+            self._bump(stored)
+            self._stores[kind][key] = stored
+            out = copy.deepcopy(stored)
+        self._dispatch(WatchEvent(ADDED, kind, copy.deepcopy(out)))
+        return out
+
+    def get(self, kind: str, key: str):
+        with self._lock:
+            obj = self._stores[kind].get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, key: str):
+        try:
+            return self.get(kind, key)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        with self._lock:
+            objs = [copy.deepcopy(o) for o in self._stores[kind].values()
+                    if (namespace is None or o.meta.namespace == namespace)]
+        if selector:
+            objs = [o for o in objs
+                    if all(o.meta.labels.get(k) == v for k, v in selector.items())]
+        return objs
+
+    def update(self, kind: str, obj) -> Any:
+        with self._lock:
+            key = obj.meta.key
+            old = self._stores[kind].get(key)
+            if old is None:
+                raise NotFound(f"{kind} {key} not found")
+            stored = copy.deepcopy(obj)
+            stored.meta.creation_timestamp = old.meta.creation_timestamp
+            stored.meta.uid = old.meta.uid
+            self._bump(stored)
+            self._stores[kind][key] = stored
+            out = copy.deepcopy(stored)
+            old_copy = copy.deepcopy(old)
+        self._dispatch(WatchEvent(MODIFIED, kind, copy.deepcopy(out), old_copy))
+        return out
+
+    def patch(self, kind: str, key: str, mutate: Callable[[Any], None]) -> Any:
+        """Atomic read-modify-write (merge-patch analog). `mutate` runs under
+        the store lock against the live object; keep it pure and fast."""
+        with self._lock:
+            old = self._stores[kind].get(key)
+            if old is None:
+                raise NotFound(f"{kind} {key} not found")
+            old_copy = copy.deepcopy(old)
+            stored = copy.deepcopy(old)
+            mutate(stored)
+            self._bump(stored)
+            self._stores[kind][key] = stored
+            out = copy.deepcopy(stored)
+        self._dispatch(WatchEvent(MODIFIED, kind, copy.deepcopy(out), old_copy))
+        return out
+
+    def delete(self, kind: str, key: str) -> None:
+        with self._lock:
+            obj = self._stores[kind].pop(key, None)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            gone = copy.deepcopy(obj)
+        self._dispatch(WatchEvent(DELETED, kind, gone))
+
+    # -- subresources ---------------------------------------------------------
+
+    def bind(self, binding: Binding) -> None:
+        """POST pods/<p>/binding. Fails if the pod is already bound (the API
+        server's real behavior, which the scheduler cache relies on)."""
+        def mutate(pod: Pod):
+            if pod.spec.node_name:
+                raise Conflict(f"pod {binding.pod_key} already bound to {pod.spec.node_name}")
+            pod.spec.node_name = binding.node_name
+            pod.meta.annotations.update(binding.annotations)
+        self.patch(PODS, binding.pod_key, mutate)
+
+    def record_event(self, object_key: str, kind: str, etype: str, reason: str,
+                     message: str) -> None:
+        ev = Event(object_key=object_key, kind=kind, type=etype, reason=reason,
+                   message=message, timestamp=self._clock())
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)
+
+    # -- coordination (leases for leader election) ---------------------------
+
+    def acquire_or_renew_lease(self, name: str, holder: str,
+                               lease_duration: float = 15.0) -> bool:
+        """Atomically acquire/renew a named lease. Returns True if `holder`
+        is (now) the leader."""
+        now = self._clock()
+        with self._lock:
+            lease = self._stores[LEASES].get("/" + name)
+            if lease is None or lease.holder == holder or \
+                    now - lease.renew_time > lease.lease_duration:
+                from ..api.meta import ObjectMeta
+                new = _Lease(meta=ObjectMeta(name=name, namespace=""),
+                             holder=holder, renew_time=now,
+                             lease_duration=lease_duration)
+                self._rv += 1
+                new.meta.resource_version = self._rv
+                self._stores[LEASES]["/" + name] = new
+                return True
+            return False
+
+    def lease_holder(self, name: str) -> str:
+        with self._lock:
+            lease = self._stores[LEASES].get("/" + name)
+            return lease.holder if lease else ""

@@ -1,0 +1,16 @@
+"""Typed, versioned, defaulted plugin-args config API.
+
+Analog of /root/reference/apis/config (internal types + v1beta2/v1beta3
+versioned decode + defaults, registered into the scheduler scheme so YAML
+pluginConfig decodes to typed args — types.go:28-160, scheme/scheme.go:30-47).
+Here: dataclass args types, a name→type scheme, hand-written defaults
+(defaults.go analogs), and a YAML/dict decoder with strict unknown-field
+checking.
+"""
+from .types import (TpuSliceArgs, CoschedulingArgs, ElasticQuotaArgs,
+                    TopologyMatchArgs, MultiSliceArgs,
+                    NodeResourcesAllocatableArgs, TargetLoadPackingArgs,
+                    LoadVariationRiskBalancingArgs, PreemptionTolerationArgs)
+from .scheme import decode_plugin_args, decode_profile, ARGS_SCHEME
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,101 @@
+"""Scheme: plugin name → args type, plus strict profile decoding.
+
+Analog of apis/config/register.go + scheme/scheme.go (strict codecs: unknown
+fields are errors, scheme.go:35) and the profile wiring of
+KubeSchedulerConfiguration YAML (manifests/*/scheduler-config.yaml).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from ..fwk.runtime import PluginProfile
+from . import types as t
+
+# Plugin name → args dataclass ("<PluginName>Args" convention).
+ARGS_SCHEME: Dict[str, type] = {
+    "TpuSlice": t.TpuSliceArgs,
+    "Coscheduling": t.CoschedulingArgs,
+    "CapacityScheduling": t.ElasticQuotaArgs,
+    "TopologyMatch": t.TopologyMatchArgs,
+    "MultiSlice": t.MultiSliceArgs,
+    "NodeResourcesAllocatable": t.NodeResourcesAllocatableArgs,
+    "TargetLoadPacking": t.TargetLoadPackingArgs,
+    "LoadVariationRiskBalancing": t.LoadVariationRiskBalancingArgs,
+    "PreemptionToleration": t.PreemptionTolerationArgs,
+}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def decode_plugin_args(plugin_name: str, raw: Dict[str, Any]):
+    """Decode a raw dict into the plugin's typed args with defaulting; strict
+    on unknown fields (the reference uses strict codecs)."""
+    cls = ARGS_SCHEME.get(plugin_name)
+    if cls is None:
+        raise ConfigError(f"no args type registered for plugin {plugin_name!r}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in (raw or {}).items():
+        norm = _camel_to_snake(k)
+        if norm not in fields:
+            raise ConfigError(f"unknown field {k!r} in {plugin_name}Args")
+        kwargs[norm] = v
+    return cls(**kwargs)
+
+
+def _camel_to_snake(name: str) -> str:
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i > 0 and (not name[i - 1].isupper()):
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
+_EXTENSION_POINTS = ("preFilter", "filter", "postFilter", "preScore", "score",
+                     "reserve", "permit", "preBind", "bind", "postBind")
+_POINT_ATTR = {
+    "preFilter": "pre_filter", "filter": "filter", "postFilter": "post_filter",
+    "preScore": "pre_score", "reserve": "reserve", "permit": "permit",
+    "preBind": "pre_bind", "bind": "bind", "postBind": "post_bind",
+}
+
+
+def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
+    """Decode a profile dict (YAML-shaped, mirroring KubeSchedulerConfiguration):
+
+    schedulerName: tpusched
+    plugins:
+      queueSort: {enabled: [{name: Coscheduling}]}
+      filter: {enabled: [{name: TpuSlice}], disabled: [{name: "*"}]}
+      score: {enabled: [{name: TpuSlice, weight: 2}]}
+    pluginConfig:
+      - name: Coscheduling
+        args: {permitWaitingTimeSeconds: 10}
+    """
+    profile = PluginProfile(scheduler_name=raw.get("schedulerName", "tpusched"))
+    plugins = raw.get("plugins", {}) or {}
+
+    qs = plugins.get("queueSort", {}).get("enabled", [])
+    if qs:
+        profile.queue_sort = qs[0]["name"]
+
+    for point in _EXTENSION_POINTS:
+        spec = plugins.get(point, {}) or {}
+        enabled = spec.get("enabled", []) or []
+        if point == "score":
+            profile.score = [(e["name"], int(e.get("weight", 1))) for e in enabled]
+        else:
+            getattr(profile, _POINT_ATTR[point]).extend(e["name"] for e in enabled)
+
+    for pc in raw.get("pluginConfig", []) or []:
+        name = pc["name"]
+        profile.plugin_args[name] = decode_plugin_args(name, pc.get("args", {}))
+    # plugins without explicit config get defaulted args
+    for name in profile.all_plugin_names():
+        if name not in profile.plugin_args and name in ARGS_SCHEME:
+            profile.plugin_args[name] = ARGS_SCHEME[name]()
+    return profile

@@ -1,0 +1,99 @@
+"""Internal plugin-args types with their defaults.
+
+Mirrors /root/reference/apis/config/types.go:28-160 plus the hand-written
+defaults in apis/config/v1beta3/defaults.go:29-160. The ``<PluginName>Args``
+naming convention is load-bearing for YAML decoding (doc/develop.md:21 in the
+reference) — ``scheme.py`` maps plugin name → args type by it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+# Defaults (v1beta3/defaults.go:29-30,50; SURVEY §6 anchors).
+DEFAULT_PERMIT_WAITING_TIME_SECONDS = 60
+DEFAULT_DENIED_PG_EXPIRATION_TIME_SECONDS = 20
+DEFAULT_TARGET_UTILIZATION_PERCENT = 40
+DEFAULT_REQUESTS_MULTIPLIER = 1.5
+DEFAULT_SAFE_VARIANCE_MARGIN = 1.0
+DEFAULT_SAFE_VARIANCE_SENSITIVITY = 1.0
+DEFAULT_METRICS_REFRESH_INTERVAL_SECONDS = 30
+DEFAULT_METRICS_WINDOW_SECONDS = 60
+
+
+@dataclass
+class TpuSliceArgs:
+    """Args for the TpuSlice plugin (FlexGPU successor; the reference plugin
+    takes no args — these add deliberate knobs for the TPU resource model)."""
+    # binpack: fewer free chips score higher (the reference's reverse
+    # normalize, flex_gpu.go:172-176); spread: more free chips score higher.
+    score_mode: str = "binpack"
+
+
+@dataclass
+class CoschedulingArgs:
+    """types.go:28-39."""
+    permit_waiting_time_seconds: int = DEFAULT_PERMIT_WAITING_TIME_SECONDS
+    denied_pg_expiration_time_seconds: int = DEFAULT_DENIED_PG_EXPIRATION_TIME_SECONDS
+
+
+@dataclass
+class ElasticQuotaArgs:
+    """CapacityScheduling needs no args in the reference; placeholder."""
+    pass
+
+
+@dataclass
+class TopologyMatchArgs:
+    """types.go:144-152 (NodeResourceTopologyMatchArgs): scoring strategy for
+    the torus zones."""
+    scoring_strategy: str = "LeastAllocated"   # LeastAllocated|MostAllocated|BalancedAllocation
+    # resource weights for the strategy (cpu/mem weight 1 default in the
+    # reference; here chips).
+    resource_weights: dict = field(default_factory=lambda: {"google.com/tpu": 1})
+
+
+@dataclass
+class MultiSliceArgs:
+    """DCN-aware cross-slice scoring (new; no reference analog)."""
+    # score weight for sharing a DCN domain with already-placed sibling slices
+    same_domain_score: int = 100
+    adjacent_domain_score: int = 50
+
+
+@dataclass
+class NodeResourcesAllocatableArgs:
+    """types.go:50-60: weighted allocatable scoring, Least or Most mode.
+    Default weights: 1<<20 per cpu millicore ≈ 1 per memory byte
+    (resource_allocation.go:38)."""
+    mode: str = "Least"   # Least | Most
+    resources: List[dict] = field(default_factory=lambda: [
+        {"name": "cpu", "weight": 1 << 20},
+        {"name": "memory", "weight": 1},
+    ])
+
+
+@dataclass
+class TargetLoadPackingArgs:
+    """types.go:88-104."""
+    target_utilization: int = DEFAULT_TARGET_UTILIZATION_PERCENT
+    default_requests_cpu_millis: int = 1000      # 1-core default
+    default_requests_multiplier: float = DEFAULT_REQUESTS_MULTIPLIER
+    watcher_address: str = ""                    # empty ⇒ in-process provider
+    metrics_refresh_interval_seconds: int = DEFAULT_METRICS_REFRESH_INTERVAL_SECONDS
+
+
+@dataclass
+class LoadVariationRiskBalancingArgs:
+    """types.go:106-120."""
+    safe_variance_margin: float = DEFAULT_SAFE_VARIANCE_MARGIN
+    safe_variance_sensitivity: float = DEFAULT_SAFE_VARIANCE_SENSITIVITY
+    watcher_address: str = ""
+    metrics_refresh_interval_seconds: int = DEFAULT_METRICS_REFRESH_INTERVAL_SECONDS
+
+
+@dataclass
+class PreemptionTolerationArgs:
+    """types.go:154-160: same knobs as DefaultPreemption."""
+    min_candidate_nodes_percentage: int = 10
+    min_candidate_nodes_absolute: int = 100

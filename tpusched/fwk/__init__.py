@@ -1,0 +1,24 @@
+"""Scheduling framework: extension points, cycle state, node snapshots, runtime.
+
+Rebuild of the contract the reference plugs into (vendored
+k8s.io/kubernetes/pkg/scheduler/framework; SURVEY §1 "Hosting runtime"):
+QueueSort → PreFilter → Filter → PostFilter → PreScore → Score →
+Reserve → Permit → PreBind → Bind → PostBind, with CycleState carrying
+per-cycle plugin data and a waitingPods map as the in-process gang barrier.
+"""
+from .status import (Status, Code, SUCCESS, ERROR, UNSCHEDULABLE,
+                     UNSCHEDULABLE_AND_UNRESOLVABLE, WAIT, SKIP)
+from .cycle_state import CycleState
+from .nodeinfo import NodeInfo, Snapshot, MAX_NODE_SCORE, MIN_NODE_SCORE
+from .interfaces import (Plugin, QueueSortPlugin, PreFilterPlugin, FilterPlugin,
+                         PostFilterPlugin, PreScorePlugin, ScorePlugin,
+                         ReservePlugin, PermitPlugin, PreBindPlugin, BindPlugin,
+                         PostBindPlugin, PreFilterExtensions, EnqueueExtensions,
+                         ClusterEvent, PostFilterResult, NodeScore,
+                         EVENT_ADD, EVENT_UPDATE, EVENT_DELETE,
+                         RESOURCE_POD, RESOURCE_NODE, RESOURCE_POD_GROUP,
+                         RESOURCE_ELASTIC_QUOTA, RESOURCE_TPU_TOPOLOGY,
+                         WILDCARD_EVENT)
+from .runtime import Framework, Registry, Handle, PluginProfile, PODS_TO_ACTIVATE_KEY, PodsToActivate
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,51 @@
+"""CycleState: per-scheduling-cycle key/value store for plugin data.
+
+Plugins snapshot-clone state into CycleState at PreFilter and read/mutate it
+through the cycle — the race-freedom discipline the reference relies on
+(/root/reference/pkg/capacityscheduling/capacity_scheduling.go:83-93 clones
+the ElasticQuota snapshot per cycle)."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+
+class StateKeyNotFound(KeyError):
+    pass
+
+
+class CycleState:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Any] = {}
+        # Set by the scheduler when preemption might still make the pod
+        # schedulable (mirrors framework's recordPluginMetrics/skip flags).
+        self.skip_score_plugins: set = set()
+        self.skip_filter_plugins: set = set()
+
+    def write(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def read(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._data:
+                raise StateKeyNotFound(key)
+            return self._data[key]
+
+    def try_read(self, key: str) -> Any:
+        with self._lock:
+            return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        """Shallow clone; values implementing .clone() are cloned too
+        (StateData.Clone contract)."""
+        out = CycleState()
+        with self._lock:
+            for k, v in self._data.items():
+                out._data[k] = v.clone() if hasattr(v, "clone") else v
+        return out

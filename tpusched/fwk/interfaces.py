@@ -1,0 +1,148 @@
+"""Plugin interfaces — the framework's typed extension points.
+
+Each plugin implements the subset it needs and the framework dispatches by
+isinstance (analog of `var _ framework.FilterPlugin = &FlexGPU{}` assertions,
+/root/reference/pkg/flexgpu/flex_gpu.go:27-30).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..api.core import Node, Pod
+from .cycle_state import CycleState
+from .nodeinfo import NodeInfo
+from .status import Status
+
+# Cluster-event resources/actions for requeue hints (EnqueueExtensions,
+# /root/reference/pkg/coscheduling/coscheduling.go:93-101).
+RESOURCE_POD = "Pod"
+RESOURCE_NODE = "Node"
+RESOURCE_POD_GROUP = "PodGroup"
+RESOURCE_ELASTIC_QUOTA = "ElasticQuota"
+RESOURCE_TPU_TOPOLOGY = "TpuTopology"
+
+EVENT_ADD = 1
+EVENT_UPDATE = 2
+EVENT_DELETE = 4
+EVENT_ALL = EVENT_ADD | EVENT_UPDATE | EVENT_DELETE
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    resource: str
+    action_type: int
+
+    def matches(self, resource: str, action: int) -> bool:
+        return (self.resource in (resource, "*")) and bool(self.action_type & action)
+
+
+WILDCARD_EVENT = ClusterEvent("*", EVENT_ALL)
+
+
+@dataclass
+class NodeScore:
+    name: str
+    score: int
+
+
+@dataclass
+class PostFilterResult:
+    nominated_node_name: str = ""
+
+
+class Plugin:
+    NAME = "Plugin"
+
+    def name(self) -> str:
+        return self.NAME
+
+
+class QueueSortPlugin(Plugin):
+    def less(self, pod_info1, pod_info2) -> bool:
+        raise NotImplementedError
+
+
+class PreFilterExtensions:
+    """Keeps PreFilter-computed state consistent while preemption dry-runs
+    add/remove pods (capacity_scheduling.go:283-318)."""
+
+    def add_pod(self, state: CycleState, pod_to_schedule: Pod,
+                pod_to_add: Pod, node_info: NodeInfo) -> Status:
+        return Status.success()
+
+    def remove_pod(self, state: CycleState, pod_to_schedule: Pod,
+                   pod_to_remove: Pod, node_info: NodeInfo) -> Status:
+        return Status.success()
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        raise NotImplementedError
+
+    def pre_filter_extensions(self) -> Optional[PreFilterExtensions]:
+        return None
+
+
+class FilterPlugin(Plugin):
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        raise NotImplementedError
+
+
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        raise NotImplementedError
+
+
+class PreScorePlugin(Plugin):
+    def pre_score(self, state: CycleState, pod: Pod, nodes: List[Node]) -> Status:
+        raise NotImplementedError
+
+
+class ScorePlugin(Plugin):
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        raise NotImplementedError
+
+    def normalize_score(self, state: CycleState, pod: Pod,
+                        scores: List[NodeScore]) -> Optional[Status]:
+        return None  # None ⇒ no score extension
+
+
+class ReservePlugin(Plugin):
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        raise NotImplementedError
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pass
+
+
+class PermitPlugin(Plugin):
+    def permit(self, state: CycleState, pod: Pod,
+               node_name: str) -> Tuple[Status, float]:
+        """Returns (status, timeout_seconds). Wait status parks the pod in
+        waitingPods until Allow/Reject/timeout."""
+        raise NotImplementedError
+
+
+class PreBindPlugin(Plugin):
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        raise NotImplementedError
+
+
+class BindPlugin(Plugin):
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        raise NotImplementedError
+
+
+class PostBindPlugin(Plugin):
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pass
+
+
+class EnqueueExtensions:
+    """Optional mixin: plugins declare which cluster events can make pods they
+    rejected schedulable again."""
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [WILDCARD_EVENT]

@@ -1,0 +1,100 @@
+"""NodeInfo + cluster Snapshot (framework's SharedLister contract).
+
+A Snapshot is taken once per scheduling cycle and is the only cluster view
+plugins may use in Filter/Score (hot path; SURVEY §3.2). NodeInfo supports
+add_pod/remove_pod so preemption dry-runs can simulate victim removal
+(/root/reference/pkg/capacityscheduling/capacity_scheduling.go:489-506).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Iterable, List, Optional
+
+from ..api.core import Node, Pod
+from ..api.resources import ResourceList
+from ..util.podutil import pod_request_with_defaults
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+
+
+class NodeInfo:
+    __slots__ = ("node", "pods", "requested", "non_zero_requested", "generation")
+
+    def __init__(self, node: Optional[Node] = None, pods: Iterable[Pod] = ()):
+        self.node = node
+        self.pods: List[Pod] = []
+        self.requested: ResourceList = {}
+        self.non_zero_requested: ResourceList = {}
+        self.generation = 0
+        for p in pods:
+            self.add_pod(p)
+
+    @property
+    def allocatable(self) -> ResourceList:
+        return self.node.status.allocatable if self.node else {}
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+        for k, v in pod_request_with_defaults(pod).items():
+            self.requested[k] = self.requested.get(k, 0) + v
+        for k, v in pod_request_with_defaults(pod, non_zero=True).items():
+            self.non_zero_requested[k] = self.non_zero_requested.get(k, 0) + v
+        self.generation += 1
+
+    def remove_pod(self, pod: Pod) -> bool:
+        for i, p in enumerate(self.pods):
+            if p.meta.uid == pod.meta.uid or p.key == pod.key:
+                self.pods.pop(i)
+                for k, v in pod_request_with_defaults(p).items():
+                    self.requested[k] = self.requested.get(k, 0) - v
+                for k, v in pod_request_with_defaults(p, non_zero=True).items():
+                    self.non_zero_requested[k] = self.non_zero_requested.get(k, 0) - v
+                self.generation += 1
+                return True
+        return False
+
+    def free(self) -> ResourceList:
+        alloc = self.allocatable
+        return {k: alloc.get(k, 0) - self.requested.get(k, 0)
+                for k in set(alloc) | set(self.requested)}
+
+    def clone(self) -> "NodeInfo":
+        out = NodeInfo()
+        out.node = self.node  # nodes are treated as immutable snapshots
+        out.pods = list(self.pods)
+        out.requested = dict(self.requested)
+        out.non_zero_requested = dict(self.non_zero_requested)
+        out.generation = self.generation
+        return out
+
+
+class Snapshot:
+    """Immutable-by-convention per-cycle cluster view; also the fake shared
+    lister used by unit tests (/root/reference/test/util/fake.go:32-101)."""
+
+    def __init__(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()):
+        self._infos: Dict[str, NodeInfo] = {}
+        for n in nodes:
+            self._infos[n.name] = NodeInfo(n)
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name in self._infos:
+                self._infos[p.spec.node_name].add_pod(p)
+
+    # SharedLister / NodeInfoLister ------------------------------------------
+    def list(self) -> List[NodeInfo]:
+        return list(self._infos.values())
+
+    def get(self, node_name: str) -> Optional[NodeInfo]:
+        return self._infos.get(node_name)
+
+    def node_names(self) -> List[str]:
+        return list(self._infos)
+
+    def num_nodes(self) -> int:
+        return len(self._infos)
+
+    def clone(self) -> "Snapshot":
+        out = Snapshot()
+        out._infos = {name: info.clone() for name, info in self._infos.items()}
+        return out

@@ -1,0 +1,466 @@
+"""Framework runtime: plugin registry, profiles, extension-point dispatch,
+waitingPods barrier, pod nominator.
+
+Rebuild of framework.NewFramework + frameworkImpl (vendored upstream in the
+reference). The waitingPods map is the in-process gang barrier coscheduling
+relies on (SURVEY §5 "Distributed communication backend").
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..api.core import Node, Pod
+from ..util import klog
+from .cycle_state import CycleState
+from .interfaces import (BindPlugin, ClusterEvent, EnqueueExtensions,
+                         FilterPlugin, NodeScore, PermitPlugin, Plugin,
+                         PostBindPlugin, PostFilterPlugin, PostFilterResult,
+                         PreBindPlugin, PreFilterPlugin, PreScorePlugin,
+                         QueueSortPlugin, ReservePlugin, ScorePlugin,
+                         WILDCARD_EVENT)
+from .nodeinfo import MAX_NODE_SCORE, NodeInfo, Snapshot
+from .status import SKIP, Status, merge_statuses
+
+# CycleState key through which plugins ask the scheduler to move specific
+# pods back into the active queue (framework.PodsToActivateKey; used by gang
+# sibling activation, /root/reference/pkg/coscheduling/core/core.go:111-143).
+PODS_TO_ACTIVATE_KEY = "tpusched/pods-to-activate"
+
+
+class PodsToActivate:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.map: Dict[str, Pod] = {}
+
+    def clone(self):
+        return self  # shared across cloned cycle states on purpose
+
+
+@dataclass
+class PluginProfile:
+    """A scheduler profile: which plugins run at which extension points.
+
+    Analog of the KubeSchedulerConfiguration profile the reference wires via
+    YAML (manifests/*/scheduler-config.yaml; e.g. coscheduling enables
+    queueSort/preFilter/postFilter/permit/reserve/postBind,
+    manifests/coscheduling/scheduler-config.yaml:10-34)."""
+    scheduler_name: str = "tpusched"
+    queue_sort: str = "PrioritySort"
+    pre_filter: List[str] = field(default_factory=list)
+    filter: List[str] = field(default_factory=list)
+    post_filter: List[str] = field(default_factory=list)
+    pre_score: List[str] = field(default_factory=list)
+    score: List[Tuple[str, int]] = field(default_factory=list)  # (name, weight)
+    reserve: List[str] = field(default_factory=list)
+    permit: List[str] = field(default_factory=list)
+    pre_bind: List[str] = field(default_factory=list)
+    bind: List[str] = field(default_factory=list)  # first Success/non-Skip wins
+    post_bind: List[str] = field(default_factory=list)
+    plugin_args: Dict[str, Any] = field(default_factory=dict)
+
+    def all_plugin_names(self) -> List[str]:
+        names: List[str] = [self.queue_sort]
+        for lst in (self.pre_filter, self.filter, self.post_filter,
+                    self.pre_score, self.reserve, self.permit, self.pre_bind,
+                    self.bind, self.post_bind):
+            names.extend(lst)
+        names.extend(n for n, _ in self.score)
+        seen, out = set(), []
+        for n in names:
+            if n and n not in seen:
+                seen.add(n)
+                out.append(n)
+        return out
+
+
+class Registry(Dict[str, Callable[[Any, "Handle"], Plugin]]):
+    """name → factory(args, handle). Mirrors app.WithPlugin registration
+    (/root/reference/cmd/scheduler/main.go:34-47)."""
+
+    def register(self, name: str, factory) -> None:
+        if name in self:
+            raise ValueError(f"plugin {name} already registered")
+        self[name] = factory
+
+
+class _WaitingPod:
+    """A pod parked at Permit. Per-plugin deadlines; any rejection or any
+    plugin's timeout rejects the pod; all allowed ⇒ proceed to bind."""
+
+    def __init__(self, pod: Pod, plugin_timeouts: Dict[str, float]):
+        self.pod = pod
+        self._cond = threading.Condition()
+        now = time.monotonic()
+        self._pending: Dict[str, float] = {p: now + t for p, t in plugin_timeouts.items()}
+        self._status: Optional[Status] = None
+
+    def get_pending_plugins(self) -> List[str]:
+        with self._cond:
+            return list(self._pending)
+
+    def allow(self, plugin: str) -> None:
+        with self._cond:
+            self._pending.pop(plugin, None)
+            if not self._pending and self._status is None:
+                self._status = Status.success()
+            self._cond.notify_all()
+
+    def reject(self, plugin: str, msg: str) -> None:
+        with self._cond:
+            if self._status is None:
+                self._status = Status.unschedulable(msg).with_plugin(plugin)
+            self._cond.notify_all()
+
+    def wait(self) -> Status:
+        with self._cond:
+            while self._status is None:
+                if not self._pending:
+                    self._status = Status.success()
+                    break
+                deadline = min(self._pending.values())
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    plugin = min(self._pending, key=self._pending.get)
+                    self._status = Status.unschedulable(
+                        f"pod {self.pod.key} rejected: permit wait timeout").with_plugin(plugin)
+                    break
+                self._cond.wait(timeout=remaining)
+            return self._status
+
+
+class PodNominator:
+    """Tracks preemptor pods nominated to nodes (upstream PodNominator;
+    the reference's tests carry a copied one, test/util/fake.go:103-247)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._by_node: Dict[str, Dict[str, Pod]] = {}
+
+    def add_nominated_pod(self, pod: Pod, node_name: str) -> None:
+        node = node_name or pod.status.nominated_node_name
+        if not node:
+            return
+        with self._lock:
+            self.delete_nominated_pod_if_exists(pod)
+            self._by_node.setdefault(node, {})[pod.key] = pod
+
+    def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
+        with self._lock:
+            for node, pods in list(self._by_node.items()):
+                if pod.key in pods:
+                    del pods[pod.key]
+                    if not pods:
+                        del self._by_node[node]
+
+    def update_nominated_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            self.delete_nominated_pod_if_exists(old)
+            if new.status.nominated_node_name:
+                self.add_nominated_pod(new, new.status.nominated_node_name)
+
+    def nominated_pods_for_node(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return list(self._by_node.get(node_name, {}).values())
+
+
+class Handle:
+    """framework.Handle analog passed to plugin factories: cluster views,
+    clients, the waitingPods map, and helper runs (SURVEY §3.1 init
+    boundary)."""
+
+    def __init__(self, clientset, informer_factory, framework_getter,
+                 clock=time.time):
+        self.clientset = clientset
+        self.informer_factory = informer_factory
+        self._framework_getter = framework_getter
+        self.clock = clock
+        self.pod_nominator = PodNominator()
+        self._snapshot: Snapshot = Snapshot()
+
+    # Snapshot (updated by the scheduler at cycle start) ----------------------
+    def snapshot_shared_lister(self) -> Snapshot:
+        return self._snapshot
+
+    def set_snapshot(self, snap: Snapshot) -> None:
+        self._snapshot = snap
+
+    # Framework passthroughs --------------------------------------------------
+    @property
+    def framework(self) -> "Framework":
+        return self._framework_getter()
+
+    def iterate_over_waiting_pods(self, fn: Callable[[_WaitingPod], None]) -> None:
+        self.framework.iterate_over_waiting_pods(fn)
+
+    def get_waiting_pod(self, uid: str) -> Optional[_WaitingPod]:
+        return self.framework.get_waiting_pod(uid)
+
+    def reject_waiting_pod(self, uid: str, plugin: str = "", msg: str = "") -> bool:
+        return self.framework.reject_waiting_pod(uid, plugin, msg)
+
+    def run_filter_plugins_with_nominated_pods(self, state: CycleState, pod: Pod,
+                                               node_info: NodeInfo) -> Status:
+        return self.framework.run_filter_plugins_with_nominated_pods(state, pod, node_info)
+
+    def record_event(self, obj_key: str, kind: str, etype: str, reason: str,
+                     message: str = "") -> None:
+        self.clientset.record_event(obj_key, kind, etype, reason, message)
+
+
+class Framework:
+    """One profile's compiled plugin set."""
+
+    def __init__(self, registry: Registry, profile: PluginProfile, handle: Handle):
+        self.profile = profile
+        self.handle = handle
+        self._waiting: Dict[str, _WaitingPod] = {}
+        self._waiting_lock = threading.RLock()
+
+        plugins: Dict[str, Plugin] = {}
+        for name in profile.all_plugin_names():
+            if name not in registry:
+                raise ValueError(f"plugin {name!r} not in registry")
+            plugins[name] = registry[name](profile.plugin_args.get(name), handle)
+        self.plugins = plugins
+
+        def _bucket(names: Iterable[str], cls) -> List[Plugin]:
+            out = []
+            for n in names:
+                p = plugins[n]
+                if not isinstance(p, cls):
+                    raise TypeError(f"plugin {n} does not implement {cls.__name__}")
+                out.append(p)
+            return out
+
+        self.queue_sort_plugin: QueueSortPlugin = _bucket([profile.queue_sort], QueueSortPlugin)[0]
+        self.pre_filter_plugins = _bucket(profile.pre_filter, PreFilterPlugin)
+        self.filter_plugins = _bucket(profile.filter, FilterPlugin)
+        self.post_filter_plugins = _bucket(profile.post_filter, PostFilterPlugin)
+        self.pre_score_plugins = _bucket(profile.pre_score, PreScorePlugin)
+        self.score_plugins: List[Tuple[ScorePlugin, int]] = [
+            (p, w) for (p, w) in zip(_bucket([n for n, _ in profile.score], ScorePlugin),
+                                     [w for _, w in profile.score])]
+        self.reserve_plugins = _bucket(profile.reserve, ReservePlugin)
+        self.permit_plugins = _bucket(profile.permit, PermitPlugin)
+        self.pre_bind_plugins = _bucket(profile.pre_bind, PreBindPlugin)
+        self.bind_plugins = _bucket(profile.bind, BindPlugin)
+        self.post_bind_plugins = _bucket(profile.post_bind, PostBindPlugin)
+
+    # -- queue sort ----------------------------------------------------------
+    def less(self, pi1, pi2) -> bool:
+        return self.queue_sort_plugin.less(pi1, pi2)
+
+    # -- prefilter -----------------------------------------------------------
+    def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
+        for p in self.pre_filter_plugins:
+            s = p.pre_filter(state, pod)
+            if s.is_skip():
+                state.skip_filter_plugins.add(p.name())
+                continue
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_pre_filter_extension_add_pod(self, state: CycleState, pod: Pod,
+                                         pod_to_add: Pod, node_info: NodeInfo) -> Status:
+        for p in self.pre_filter_plugins:
+            ext = p.pre_filter_extensions()
+            if ext is None:
+                continue
+            s = ext.add_pod(state, pod, pod_to_add, node_info)
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_pre_filter_extension_remove_pod(self, state: CycleState, pod: Pod,
+                                            pod_to_remove: Pod, node_info: NodeInfo) -> Status:
+        for p in self.pre_filter_plugins:
+            ext = p.pre_filter_extensions()
+            if ext is None:
+                continue
+            s = ext.remove_pod(state, pod, pod_to_remove, node_info)
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    # -- filter --------------------------------------------------------------
+    def run_filter_plugins(self, state: CycleState, pod: Pod,
+                           node_info: NodeInfo) -> Status:
+        for p in self.filter_plugins:
+            if p.name() in state.skip_filter_plugins:
+                continue
+            s = p.filter(state, pod, node_info)
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_filter_plugins_with_nominated_pods(self, state: CycleState, pod: Pod,
+                                               node_info: NodeInfo) -> Status:
+        """Upstream semantics: evaluate twice when higher-priority nominated
+        pods exist on the node — once assuming they are running, once not."""
+        nominated = [p for p in self.handle.pod_nominator.nominated_pods_for_node(
+            node_info.node.name) if p.priority >= pod.priority and p.key != pod.key]
+        for add_nominated in ([True, False] if nominated else [False]):
+            state_to_use, info_to_use = state, node_info
+            if add_nominated:
+                state_to_use = state.clone()
+                info_to_use = node_info.clone()
+                for np in nominated:
+                    info_to_use.add_pod(np)
+                    s = self.run_pre_filter_extension_add_pod(state_to_use, pod, np, info_to_use)
+                    if not s.is_success():
+                        return s
+            s = self.run_filter_plugins(state_to_use, pod, info_to_use)
+            if not s.is_success():
+                return s
+        return Status.success()
+
+    # -- postfilter ----------------------------------------------------------
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod,
+                                filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        statuses: List[Status] = []
+        for p in self.post_filter_plugins:
+            result, s = p.post_filter(state, pod, filtered_node_status_map)
+            s.with_plugin(p.name())
+            if s.is_success():
+                return result, s
+            if not s.is_unschedulable():
+                return None, s
+            statuses.append(s)
+        return None, merge_statuses(statuses) if statuses else Status.unschedulable("no postfilter plugins")
+
+    # -- score ---------------------------------------------------------------
+    def run_pre_score_plugins(self, state: CycleState, pod: Pod,
+                              nodes: List[Node]) -> Status:
+        for p in self.pre_score_plugins:
+            s = p.pre_score(state, pod, nodes)
+            if s.is_skip():
+                state.skip_score_plugins.add(p.name())
+                continue
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_score_plugins(self, state: CycleState, pod: Pod,
+                          nodes: List[Node]) -> Tuple[Dict[str, int], Status]:
+        """Returns total weighted score per node name."""
+        totals: Dict[str, int] = {n.name: 0 for n in nodes}
+        for plugin, weight in self.score_plugins:
+            if plugin.name() in state.skip_score_plugins:
+                continue
+            scores: List[NodeScore] = []
+            for n in nodes:
+                val, s = plugin.score(state, pod, n.name)
+                if not s.is_success():
+                    return {}, s.with_plugin(plugin.name())
+                scores.append(NodeScore(n.name, val))
+            ns = plugin.normalize_score(state, pod, scores)
+            if ns is not None and not ns.is_success():
+                return {}, ns.with_plugin(plugin.name())
+            for sc in scores:
+                if not (0 <= sc.score <= MAX_NODE_SCORE):
+                    return {}, Status.error(
+                        f"plugin {plugin.name()} returned invalid score {sc.score} for node {sc.name}")
+                totals[sc.name] += sc.score * weight
+        return totals, Status.success()
+
+    # -- reserve -------------------------------------------------------------
+    def run_reserve_plugins_reserve(self, state: CycleState, pod: Pod,
+                                    node_name: str) -> Status:
+        for i, p in enumerate(self.reserve_plugins):
+            s = p.reserve(state, pod, node_name)
+            if not s.is_success():
+                for q in reversed(self.reserve_plugins[:i]):
+                    q.unreserve(state, pod, node_name)
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_reserve_plugins_unreserve(self, state: CycleState, pod: Pod,
+                                      node_name: str) -> None:
+        for p in reversed(self.reserve_plugins):
+            p.unreserve(state, pod, node_name)
+
+    # -- permit --------------------------------------------------------------
+    def run_permit_plugins(self, state: CycleState, pod: Pod,
+                           node_name: str) -> Status:
+        plugin_timeouts: Dict[str, float] = {}
+        status_code = Status.success()
+        for p in self.permit_plugins:
+            s, timeout = p.permit(state, pod, node_name)
+            if s.is_success():
+                continue
+            if s.is_wait():
+                plugin_timeouts[p.name()] = timeout
+                continue
+            s.with_plugin(p.name())
+            return s
+        if plugin_timeouts:
+            with self._waiting_lock:
+                self._waiting[pod.meta.uid] = _WaitingPod(pod, plugin_timeouts)
+            return Status.wait()
+        return status_code
+
+    def wait_on_permit(self, pod: Pod) -> Status:
+        with self._waiting_lock:
+            wp = self._waiting.get(pod.meta.uid)
+        if wp is None:
+            return Status.success()
+        try:
+            return wp.wait()
+        finally:
+            with self._waiting_lock:
+                self._waiting.pop(pod.meta.uid, None)
+
+    def iterate_over_waiting_pods(self, fn) -> None:
+        with self._waiting_lock:
+            pods = list(self._waiting.values())
+        for wp in pods:
+            fn(wp)
+
+    def get_waiting_pod(self, uid: str) -> Optional[_WaitingPod]:
+        with self._waiting_lock:
+            return self._waiting.get(uid)
+
+    def reject_waiting_pod(self, uid: str, plugin: str = "", msg: str = "") -> bool:
+        with self._waiting_lock:
+            wp = self._waiting.get(uid)
+        if wp is None:
+            return False
+        wp.reject(plugin, msg)
+        return True
+
+    # -- bind ----------------------------------------------------------------
+    def run_pre_bind_plugins(self, state: CycleState, pod: Pod,
+                             node_name: str) -> Status:
+        for p in self.pre_bind_plugins:
+            s = p.pre_bind(state, pod, node_name)
+            if not s.is_success():
+                return s.with_plugin(p.name())
+        return Status.success()
+
+    def run_bind_plugins(self, state: CycleState, pod: Pod,
+                         node_name: str) -> Status:
+        if not self.bind_plugins:
+            return Status.error("no bind plugin configured")
+        for p in self.bind_plugins:
+            s = p.bind(state, pod, node_name)
+            if s.is_skip():
+                continue
+            return s.with_plugin(p.name()) if not s.is_success() else s
+        return Status.error("all bind plugins skipped")
+
+    def run_post_bind_plugins(self, state: CycleState, pod: Pod,
+                              node_name: str) -> None:
+        for p in self.post_bind_plugins:
+            p.post_bind(state, pod, node_name)
+
+    # -- enqueue hints -------------------------------------------------------
+    def events_to_register(self) -> List[ClusterEvent]:
+        events: List[ClusterEvent] = []
+        for p in self.plugins.values():
+            if isinstance(p, EnqueueExtensions):
+                events.extend(p.events_to_register())
+        return events or [WILDCARD_EVENT]

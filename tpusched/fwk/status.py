@@ -1,0 +1,104 @@
+"""Status codes for plugin results (framework *Status semantics)."""
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import List, Optional
+
+
+class Code(IntEnum):
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    # Unresolvable: preemption will not help; skip PostFilter for this pod.
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4   # Permit only
+    SKIP = 5
+
+
+SUCCESS = Code.SUCCESS
+ERROR = Code.ERROR
+UNSCHEDULABLE = Code.UNSCHEDULABLE
+UNSCHEDULABLE_AND_UNRESOLVABLE = Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+WAIT = Code.WAIT
+SKIP = Code.SKIP
+
+
+class Status:
+    __slots__ = ("code", "reasons", "plugin")
+
+    def __init__(self, code: Code = SUCCESS, reasons: Optional[List[str]] = None,
+                 plugin: str = ""):
+        self.code = code
+        self.reasons = reasons or []
+        self.plugin = plugin
+
+    # Constructors -----------------------------------------------------------
+    @staticmethod
+    def success() -> "Status":
+        return Status(SUCCESS)
+
+    @staticmethod
+    def error(msg: str) -> "Status":
+        return Status(ERROR, [msg])
+
+    @staticmethod
+    def unschedulable(*reasons: str) -> "Status":
+        return Status(UNSCHEDULABLE, list(reasons))
+
+    @staticmethod
+    def unresolvable(*reasons: str) -> "Status":
+        return Status(UNSCHEDULABLE_AND_UNRESOLVABLE, list(reasons))
+
+    @staticmethod
+    def wait() -> "Status":
+        return Status(WAIT)
+
+    @staticmethod
+    def skip() -> "Status":
+        return Status(SKIP)
+
+    # Predicates -------------------------------------------------------------
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    def is_wait(self) -> bool:
+        return self.code == WAIT
+
+    def is_skip(self) -> bool:
+        return self.code == SKIP
+
+    def is_unschedulable(self) -> bool:
+        return self.code in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE)
+
+    def is_error(self) -> bool:
+        return self.code == ERROR
+
+    def message(self) -> str:
+        return "; ".join(self.reasons)
+
+    def with_plugin(self, name: str) -> "Status":
+        self.plugin = name
+        return self
+
+    def __repr__(self) -> str:
+        return f"Status({self.code.name}, {self.reasons!r}, plugin={self.plugin!r})"
+
+
+def merge_statuses(statuses: List[Status]) -> Status:
+    """PluginToStatus.Merge: error > unresolvable > unschedulable > success."""
+    if not statuses:
+        return Status.success()
+    final = Status.success()
+    reasons: List[str] = []
+    for s in statuses:
+        if s.is_success():
+            continue
+        reasons.extend(s.reasons)
+        if s.code == ERROR:
+            final = Status(ERROR, plugin=s.plugin)
+        elif s.code == UNSCHEDULABLE_AND_UNRESOLVABLE and final.code != ERROR:
+            final = Status(UNSCHEDULABLE_AND_UNRESOLVABLE, plugin=s.plugin)
+        elif s.code == UNSCHEDULABLE and final.code not in (ERROR, UNSCHEDULABLE_AND_UNRESOLVABLE):
+            final = Status(UNSCHEDULABLE, plugin=s.plugin)
+    final.reasons = reasons
+    return final

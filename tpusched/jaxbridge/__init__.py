@@ -1,0 +1,9 @@
+"""JAX bridge: the workloads tpusched places, and the slice→Mesh mapping.
+
+The reference schedules opaque "Spark/TF jobs" (kep/42 use cases); the TPU
+rebuild's workloads are JAX/XLA jobs (BASELINE.json configs). This package
+closes the loop: a PodGroup's slice assignment (chip coordinates reserved by
+the topologymatch plugin) maps onto a ``jax.sharding.Mesh``, and
+``workload.py`` provides the flagship Llama-style sharded train step used by
+``__graft_entry__.py`` and the benchmarks.
+"""

@@ -1,0 +1,45 @@
+"""Slice assignment → jax.sharding.Mesh.
+
+The scheduler reserves chip coordinates for a gang (topologymatch plugin
+annotations); this module turns that assignment into the device mesh a JAX
+job would build on those hosts. Off-cluster (tests, dry-runs) the same
+factorization runs over virtual CPU devices.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def factor_mesh(n_devices: int, max_tp: int = 4) -> Tuple[int, int]:
+    """(dp, tp) with tp the largest power-of-two divisor of n ≤ max_tp — tp
+    rides ICI within a host (4 chips/host on v5e/v5p), dp spans hosts.
+    Power-of-two keeps tp dividing the model dims (all sized in multiples
+    of 4)."""
+    tp = max_tp
+    while tp > 1 and (n_devices % tp or tp & (tp - 1)):
+        tp -= 1
+    return n_devices // tp, tp
+
+
+def build_mesh(n_devices: int, devices: Optional[Sequence] = None,
+               axis_names: Tuple[str, str] = ("dp", "tp")):
+    """A dp×tp Mesh over the first n devices (CPU-virtual or TPU)."""
+    import jax
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    dp, tp = factor_mesh(n_devices)
+    arr = np.array(devs[:n_devices]).reshape(dp, tp)
+    from jax.sharding import Mesh
+    return Mesh(arr, axis_names)
+
+
+def mesh_from_slice_shape(shape: Tuple[int, ...], devices: Optional[Sequence] = None):
+    """Mesh matching a scheduled ICI slice shape, e.g. (4,4,4) → 64 chips
+    arranged dp×tp with tp within hosts."""
+    n = 1
+    for d in shape:
+        n *= d
+    return build_mesh(n, devices)

@@ -1,0 +1,166 @@
+"""Flagship JAX workload: a Llama-style decoder LM train step, sharded dp×tp.
+
+This is the job the scheduler gang-places (north star: a 32-host JAX/XLA
+Llama-3-8B job on v5p-256, BASELINE.md). Model code is deliberately
+TPU-first: bf16-friendly matmuls sized for the MXU, static shapes, no
+data-dependent Python control flow, shardings expressed as NamedSharding so
+XLA GSPMD inserts the collectives (tp ⇒ all-reduce over ICI, dp ⇒ grad
+all-reduce).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 2048
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    seq: int = 128
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def tiny() -> "ModelConfig":
+        return ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=2,
+                           d_ff=128, seq=32)
+
+    @staticmethod
+    def llama_like(seq: int = 2048) -> "ModelConfig":
+        """Scaled-down Llama-3-ish proportions for single-chip benching."""
+        return ModelConfig(vocab=32000, d_model=1024, n_layers=8, n_heads=8,
+                           d_ff=2816, seq=seq, dtype=jnp.bfloat16)
+
+
+Params = Dict[str, Any]
+
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
+    k_embed, k_out, *k_layers = jax.random.split(key, 2 + cfg.n_layers)
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) / np.sqrt(shape[0])).astype(cfg.dtype)
+
+    layers: List[Dict[str, jax.Array]] = []
+    for kl in k_layers:
+        ks = jax.random.split(kl, 7)
+        layers.append({
+            "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d)),
+            "wv": dense(ks[2], (d, d)), "wo": dense(ks[3], (d, d)),
+            "w_gate": dense(ks[4], (d, f)), "w_up": dense(ks[5], (d, f)),
+            "w_down": dense(ks[6], (f, d)),
+            "ln_attn": jnp.ones((d,), cfg.dtype),
+            "ln_mlp": jnp.ones((d,), cfg.dtype),
+        })
+    return {
+        "embed": dense(k_embed, (v, d)),
+        "out": dense(k_out, (d, v)),
+        "ln_f": jnp.ones((d,), cfg.dtype),
+        "layers": layers,
+    }
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * w
+
+
+def _rotary(x: jax.Array) -> jax.Array:
+    """Rotary position embedding over the head dim (pairs)."""
+    b, s, h, hd = x.shape
+    half = hd // 2
+    pos = jnp.arange(s)[:, None]
+    inv_freq = 1.0 / (10000 ** (jnp.arange(half) / half))
+    ang = (pos * inv_freq)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    cos, sin = jnp.cos(ang).astype(x.dtype), jnp.sin(ang).astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _block(x: jax.Array, p: Dict[str, jax.Array], n_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    hd = d // n_heads
+    h = _rmsnorm(x, p["ln_attn"])
+    q = _rotary((h @ p["wq"]).reshape(b, s, n_heads, hd))
+    k = _rotary((h @ p["wk"]).reshape(b, s, n_heads, hd))
+    v = (h @ p["wv"]).reshape(b, s, n_heads, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(b, s, d) @ p["wo"]
+    x = x + o
+    h = _rmsnorm(x, p["ln_mlp"])
+    mlp = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
+    return x + mlp
+
+
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = _block(x, layer, cfg.n_heads)
+    x = _rmsnorm(x, params["ln_f"])
+    return x @ params["out"]
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def sgd_train_step(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                   lr: float = 1e-3) -> Tuple[Params, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
+                                        params, grads)
+    return new_params, loss
+
+
+# -- shardings ---------------------------------------------------------------
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """dp×tp sharding rules: column-parallel in (wq/wk/wv/w_gate/w_up, shard
+    output dim over tp), row-parallel out (wo/w_down, shard input dim over tp
+    ⇒ GSPMD inserts the tp all-reduce), embeddings sharded over d_model."""
+    layer = {
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "w_gate": P(None, "tp"), "w_up": P(None, "tp"), "w_down": P("tp", None),
+        "ln_attn": P(None), "ln_mlp": P(None),
+    }
+    return {
+        "embed": P(None, "tp"),
+        "out": P("tp", None),
+        "ln_f": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
+    """jit the train step over a (dp, tp) mesh with explicit shardings; batch
+    is dp-sharded, params tp-sharded."""
+    pspecs = param_specs(cfg)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    token_sharding = NamedSharding(mesh, P("dp", None))
+
+    step = jax.jit(
+        functools.partial(sgd_train_step, cfg=cfg),
+        in_shardings=(param_shardings, token_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,))
+    return step, param_shardings, token_sharding

@@ -1,0 +1,79 @@
+"""Plugin suite. ``default_registry()`` is the analog of the reference's
+``app.NewSchedulerCommand(app.WithPlugin(...))`` registration
+(/root/reference/cmd/scheduler/main.go:34-47) — every in-tree plugin is
+registered; profiles choose what is enabled."""
+from __future__ import annotations
+
+from ..fwk import Registry
+
+
+def default_registry() -> Registry:
+    # Imports are local so plugin modules can import this package's helpers.
+    from . import defaults
+    from .tpuslice import TpuSlice
+    r = Registry()
+    r.register(defaults.PrioritySort.NAME, lambda args, h: defaults.PrioritySort())
+    r.register(defaults.NodeResourcesFit.NAME, lambda args, h: defaults.NodeResourcesFit())
+    r.register(defaults.NodeUnschedulable.NAME, lambda args, h: defaults.NodeUnschedulable())
+    r.register(defaults.TaintToleration.NAME, lambda args, h: defaults.TaintToleration())
+    r.register(defaults.NodeName.NAME, lambda args, h: defaults.NodeName())
+    r.register(defaults.NodeSelector.NAME, lambda args, h: defaults.NodeSelector())
+    r.register(defaults.DefaultBinder.NAME, lambda args, h: defaults.DefaultBinder(h))
+    r.register(TpuSlice.NAME, TpuSlice.new)
+    _register_optional(r)
+    return r
+
+
+def _register_optional(r: Registry) -> None:
+    """Plugins added by later milestones register here as they land."""
+    try:
+        from .coscheduling import Coscheduling
+        r.register(Coscheduling.NAME, Coscheduling.new)
+    except ImportError:
+        pass
+    try:
+        from .qossort import QOSSort
+        r.register(QOSSort.NAME, lambda args, h: QOSSort())
+    except ImportError:
+        pass
+    try:
+        from .podstate import PodState
+        r.register(PodState.NAME, PodState.new)
+    except ImportError:
+        pass
+    try:
+        from .topologymatch import TopologyMatch
+        r.register(TopologyMatch.NAME, TopologyMatch.new)
+    except ImportError:
+        pass
+    try:
+        from .capacity import CapacityScheduling
+        r.register(CapacityScheduling.NAME, CapacityScheduling.new)
+    except ImportError:
+        pass
+    try:
+        from .multislice import MultiSlice
+        r.register(MultiSlice.NAME, MultiSlice.new)
+    except ImportError:
+        pass
+    try:
+        from .allocatable import NodeResourcesAllocatable
+        r.register(NodeResourcesAllocatable.NAME, NodeResourcesAllocatable.new)
+    except ImportError:
+        pass
+    try:
+        from .trimaran import TargetLoadPacking, LoadVariationRiskBalancing
+        r.register(TargetLoadPacking.NAME, TargetLoadPacking.new)
+        r.register(LoadVariationRiskBalancing.NAME, LoadVariationRiskBalancing.new)
+    except ImportError:
+        pass
+    try:
+        from .preemptiontoleration import PreemptionToleration
+        r.register(PreemptionToleration.NAME, PreemptionToleration.new)
+    except ImportError:
+        pass
+    try:
+        from .crossnodepreemption import CrossNodePreemption
+        r.register(CrossNodePreemption.NAME, CrossNodePreemption.new)
+    except ImportError:
+        pass

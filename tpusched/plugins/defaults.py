@@ -1,0 +1,109 @@
+"""Built-in default plugins.
+
+The reference leans on upstream in-tree plugins for basic feasibility (its
+fork disables most but the hosting framework still provides fit/priority/
+binder). These are the minimal equivalents: priority queue sort, resource
+fit, unschedulable/taints/selector filters, and the default binder.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..api.core import Binding, Node, Pod, tolerates
+from ..api.resources import resources_fit
+from ..fwk import (CycleState, Status)
+from ..fwk.interfaces import (BindPlugin, FilterPlugin, QueueSortPlugin)
+from ..fwk.nodeinfo import NodeInfo
+from ..util.podutil import pod_effective_request
+
+
+class PrioritySort(QueueSortPlugin):
+    """Upstream PrioritySort: priority desc, then queue arrival time."""
+    NAME = "PrioritySort"
+
+    def less(self, pi1, pi2) -> bool:
+        p1, p2 = pi1.pod.priority, pi2.pod.priority
+        if p1 != p2:
+            return p1 > p2
+        return pi1.timestamp < pi2.timestamp
+
+
+class NodeResourcesFit(FilterPlugin):
+    """cpu/memory/pods/extended-resource fit against allocatable − requested."""
+    NAME = "NodeResourcesFit"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if node_info.node is None:
+            return Status.error("node not found")
+        request = pod_effective_request(pod)
+        request["pods"] = 1
+        free = node_info.free()
+        insufficient = [k for k, v in request.items() if v > 0 and v > free.get(k, 0)]
+        if insufficient:
+            return Status.unschedulable(
+                *[f"Insufficient {k}" for k in insufficient])
+        return Status.success()
+
+
+class NodeUnschedulable(FilterPlugin):
+    NAME = "NodeUnschedulable"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if node_info.node.spec.unschedulable:
+            return Status.unresolvable("node(s) were unschedulable")
+        return Status.success()
+
+
+class TaintToleration(FilterPlugin):
+    NAME = "TaintToleration"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for taint in node_info.node.spec.taints:
+            if taint.effect in ("NoSchedule", "NoExecute") and not tolerates(pod, taint):
+                return Status.unresolvable(
+                    f"node(s) had untolerated taint {{{taint.key}: {taint.value}}}")
+        return Status.success()
+
+
+class NodeName(FilterPlugin):
+    NAME = "NodeName"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if pod.spec.node_name and pod.spec.node_name != node_info.node.name:
+            return Status.unresolvable("node didn't match requested node name")
+        return Status.success()
+
+
+class NodeSelector(FilterPlugin):
+    NAME = "NodeSelector"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        labels = node_info.node.meta.labels
+        for k, v in pod.spec.node_selector.items():
+            if labels.get(k) != v:
+                return Status.unresolvable("node(s) didn't match node selector")
+        return Status.success()
+
+
+def bind_with_annotations(handle, pod: Pod, node_name: str) -> Status:
+    """POST the Binding carrying the pod's current annotations, so
+    Reserve-time device/coord annotations survive to the API server — the
+    contract the reference's custom FlexGPU Bind establishes
+    (flex_gpu.go:230-242). Shared by DefaultBinder and TpuSlice.bind."""
+    try:
+        handle.clientset.pods.bind(Binding(
+            pod_key=pod.key, node_name=node_name,
+            annotations=dict(pod.meta.annotations)))
+    except Exception as e:
+        return Status.error(f"bind failed: {e}")
+    return Status.success()
+
+
+class DefaultBinder(BindPlugin):
+    NAME = "DefaultBinder"
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return bind_with_annotations(self.handle, pod, node_name)

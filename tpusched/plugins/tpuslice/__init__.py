@@ -1,0 +1,4 @@
+from .plugin import TpuSlice, CHIP_INDEX_ANNOTATION
+from .chip_node import ChipNode, Chip
+
+__all__ = ["TpuSlice", "ChipNode", "Chip", "CHIP_INDEX_ANNOTATION"]

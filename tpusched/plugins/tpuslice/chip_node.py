@@ -1,0 +1,134 @@
+"""Per-node TPU chip model, rebuilt from pod annotations each cycle.
+
+Successor of the reference's gpuNode (/root/reference/pkg/flexgpu/gpu_node.go).
+Deliberate fixes over the reference (SURVEY §2 quirks, resolved not inherited):
+
+- Value-typed integer accounting. The reference aliases resource.Quantity
+  pointers (`assumed := u.usedMemory; assumed.Add(...)` mutates the chip,
+  gpu_node.go:134-144; all devices share one memEachGPU pointer,
+  gpu_node.go:55,73) so fit computations corrupt the model mid-cycle. Ints
+  by value can't.
+- The index annotation is checked for presence *before* parsing
+  (the reference parses first, gpu_node.go:91-96, so annotation-less pods hit
+  the error path and the has-annotation branch below is dead code).
+- Whole-chip pods may request N>1 chips (a v5p host pod typically owns all 4);
+  the reference only warns when gpu limit != 1 and still assigns one index
+  (gpu_node.go:80-82, flex_gpu.go:198-206). Here the annotation carries a
+  comma-separated index list.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ...api.core import Pod
+from ...api.resources import TPU, TPU_MEMORY
+from ...api.topology import ACCELERATORS, LABEL_ACCELERATOR
+from ...fwk.nodeinfo import NodeInfo
+from ...util import klog
+
+CHIP_INDEX_ANNOTATION = "tpuslice.scheduling.tpu.dev/chip-index"
+
+
+def pod_tpu_limits(pod: Pod) -> Tuple[int, bool, int, bool]:
+    """Sum container limits for (chips, chips_set, hbm_mb, hbm_set).
+
+    The reference sums container *limits* (flex_gpu.go podResourceLimit:120-130);
+    extended resources require requests==limits in k8s, so falling back to
+    requests when limits are unset is behavior-preserving for well-formed pods.
+    """
+    chips = mem = 0
+    chips_set = mem_set = False
+    for c in pod.spec.containers:
+        src = c.limits if (TPU in c.limits or TPU_MEMORY in c.limits) else c.requests
+        if TPU in src:
+            chips_set = True
+            chips += src[TPU]
+        if TPU_MEMORY in src:
+            mem_set = True
+            mem += src[TPU_MEMORY]
+    return chips, chips_set, mem, mem_set
+
+
+def parse_chip_indexes(s: str) -> Optional[List[int]]:
+    try:
+        return [int(p) for p in s.split(",") if p != ""]
+    except ValueError:
+        return None
+
+
+@dataclass
+class Chip:
+    index: int
+    hbm_mb: int         # capacity of this chip
+    used_mb: int = 0    # fractional usage by tpu-memory pods
+    monopoly: bool = False  # owned wholly by a tpu-chips pod
+
+
+class ChipNode:
+    """Chip occupancy for one node, derived purely from the node's allocatable
+    and its pods' annotations — the restart-safe annotations-as-truth model
+    (SURVEY §5 checkpoint/resume)."""
+
+    def __init__(self, chips: List[Chip]):
+        self.chips = chips
+
+    @classmethod
+    def from_node_info(cls, node_info: NodeInfo) -> Optional["ChipNode"]:
+        node = node_info.node
+        alloc = node.status.allocatable
+        count = alloc.get(TPU, 0)
+        if count <= 0:
+            return None
+        mem_total = alloc.get(TPU_MEMORY, 0)
+        if mem_total <= 0:
+            acc = ACCELERATORS.get(node.meta.labels.get(LABEL_ACCELERATOR, ""))
+            mem_total = acc.hbm_mb_per_chip * count if acc else 0
+        hbm_each = mem_total // count if count else 0
+        chips = [Chip(i, hbm_each) for i in range(count)]
+
+        for pod in node_info.pods:
+            chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
+            if not chips_set and not mem_set:
+                continue
+            ann = pod.meta.annotations.get(CHIP_INDEX_ANNOTATION)
+            if ann is None:
+                klog.warning_s("TPU pod has no chip-index annotation", pod=pod.key)
+                continue
+            indexes = parse_chip_indexes(ann)
+            if indexes is None or any(i < 0 or i >= count for i in indexes):
+                klog.warning_s("invalid chip-index annotation", pod=pod.key, value=ann)
+                continue
+            if chips_set:
+                for i in indexes:
+                    chips[i].monopoly = True
+            if mem_set:
+                # fractional pods occupy exactly one chip
+                chips[indexes[0]].used_mb += mem_req
+        return cls(chips)
+
+    # -- fitting --------------------------------------------------------------
+
+    def mem_fit_indexes(self, mem_mb: int) -> List[int]:
+        """Chips that can host a fractional pod of mem_mb, sorted by least
+        remaining HBM after placement (bin-pack; gpu_node.go:122-161)."""
+        fits = []
+        for u in self.chips:
+            if u.monopoly and u.used_mb:
+                klog.warning_s("conflicting chip usage", index=u.index)
+            if not u.monopoly and u.used_mb + mem_mb <= u.hbm_mb:
+                fits.append((u.hbm_mb - u.used_mb - mem_mb, u.index))
+        fits.sort()
+        return [i for _, i in fits]
+
+    def free_chip_indexes(self) -> List[int]:
+        """Wholly-free chips, eligible for monopoly pods (gpu_node.go:163-177)."""
+        return [u.index for u in self.chips if not u.monopoly and u.used_mb == 0]
+
+    # -- scoring --------------------------------------------------------------
+
+    def chip_score(self) -> int:
+        return len(self.free_chip_indexes())
+
+    def mem_score(self) -> int:
+        return sum(u.hbm_mb - u.used_mb for u in self.chips)

@@ -1,0 +1,151 @@
+"""TpuSlice plugin: fractional-TPU placement.
+
+Successor of the reference fork's core feature, pkg/flexgpu
+(/root/reference/pkg/flexgpu/flex_gpu.go). Extended resources:
+
+- ``google.com/tpu``         — whole chips (monopoly), N ≥ 1 per pod;
+- ``google.com/tpu-memory``  — HBM megabytes on a single shared chip.
+
+Extension points mirror the reference exactly:
+Filter (node capacity + per-chip fit, mutual exclusion of the two resource
+kinds, flex_gpu.go:41-119) → Score (free chips / free HBM, :142-166) →
+NormalizeScore (reverse default-normalize ⇒ node-level bin-pack, :172-176) →
+Reserve (choose chip index(es), write annotation, :178-223) → Unreserve
+(delete it, :225-228) → Bind (Binding carries the annotations so the on-node
+device plugin reads the assignment, :230-242).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...api.core import Binding, Pod
+from ...api.resources import TPU, TPU_MEMORY
+from ...fwk import CycleState, Status
+from ...fwk.interfaces import (BindPlugin, FilterPlugin, NodeScore,
+                               ReservePlugin, ScorePlugin)
+from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
+from ...util import klog
+from ...config.types import TpuSliceArgs
+from .chip_node import (CHIP_INDEX_ANNOTATION, ChipNode, pod_tpu_limits)
+
+
+def default_normalize(scores: List[NodeScore], reverse: bool) -> None:
+    """Upstream helper.DefaultNormalizeScore: scale to [0,100]; reverse flips
+    (the reference passes reverse=true, flex_gpu.go:172-176, so fuller nodes
+    win — bin-pack across nodes)."""
+    max_score = max((s.score for s in scores), default=0)
+    for s in scores:
+        if max_score > 0:
+            s.score = s.score * MAX_NODE_SCORE // max_score
+        if reverse:
+            s.score = MAX_NODE_SCORE - s.score
+
+
+class TpuSlice(FilterPlugin, ScorePlugin, ReservePlugin, BindPlugin):
+    NAME = "TpuSlice"
+
+    def __init__(self, args: Optional[TpuSliceArgs], handle):
+        self.args = args or TpuSliceArgs()
+        self.handle = handle
+
+    @classmethod
+    def new(cls, args, handle) -> "TpuSlice":
+        return cls(args, handle)
+
+    # -- Filter ---------------------------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
+        if not chips_set and not mem_set:
+            return Status.success()
+        if chips_set and mem_set:
+            # a pod may not mix whole-chip and fractional requests
+            # (flex_gpu.go:58-61)
+            return Status.unresolvable("pod conflict resources")
+
+        alloc = node_info.node.status.allocatable
+        if alloc.get(TPU, 0) <= 0:
+            return Status.unresolvable(f"unknown resource type {TPU}")
+
+        # node-level capacity check over the *limit sums* of resident pods
+        # (flex_gpu.go:96-119)
+        used_chips = used_mem = 0
+        for p in node_info.pods:
+            c, _, m, _ = pod_tpu_limits(p)
+            used_chips += c
+            used_mem += m
+        cn = ChipNode.from_node_info(node_info)
+        if cn is None:
+            return Status.unresolvable(f"unknown resource type {TPU}")
+        mem_alloc = sum(ch.hbm_mb for ch in cn.chips)
+        if used_chips + chips_req > alloc.get(TPU, 0):
+            return Status.unschedulable(f"insufficient resource {TPU}")
+        if used_mem + mem_req > mem_alloc:
+            return Status.unschedulable(f"insufficient resource {TPU_MEMORY}")
+
+        if mem_set and not cn.mem_fit_indexes(mem_req):
+            return Status.unschedulable(f"no fit indexes resource {TPU_MEMORY}")
+        if chips_set and len(cn.free_chip_indexes()) < chips_req:
+            return Status.unschedulable(f"no fit indexes resource {TPU}")
+        return Status.success()
+
+    # -- Score ----------------------------------------------------------------
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        node_info = self.handle.snapshot_shared_lister().get(node_name)
+        if node_info is None:
+            return 0, Status.error(f"node {node_name} not in snapshot")
+        chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
+        if not chips_set and not mem_set:
+            return 0, Status.success()
+        cn = ChipNode.from_node_info(node_info)
+        if cn is None:
+            return 0, Status.success()
+        raw = cn.chip_score() if chips_set else cn.mem_score()
+        return raw, Status.success()
+
+    def normalize_score(self, state: CycleState, pod: Pod,
+                        scores: List[NodeScore]) -> Optional[Status]:
+        default_normalize(scores, reverse=(self.args.score_mode == "binpack"))
+        klog.V(6).info_s("normalized scores", pod=pod.key)
+        return Status.success()
+
+    # -- Reserve --------------------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        node_info = self.handle.snapshot_shared_lister().get(node_name)
+        if node_info is None:
+            return Status.error(f"node {node_name} not in snapshot")
+        chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
+        if not chips_set and not mem_set:
+            return Status.success()
+        if chips_set and mem_set:
+            return Status.unresolvable("pod conflict resources")
+        cn = ChipNode.from_node_info(node_info)
+        if cn is None:
+            return Status.unschedulable(f"no {TPU} on node {node_name}")
+        if chips_set:
+            fits = cn.free_chip_indexes()
+            if len(fits) < chips_req:
+                return Status.unschedulable(f"allocate index fail {TPU}")
+            chosen = fits[:chips_req]
+        else:
+            fits = cn.mem_fit_indexes(mem_req)
+            if not fits:
+                return Status.unschedulable(f"allocate index fail {TPU_MEMORY}")
+            chosen = [fits[0]]  # bin-pack: least remaining first
+        pod.meta.annotations[CHIP_INDEX_ANNOTATION] = ",".join(map(str, chosen))
+        klog.V(6).info_s("reserved chips", pod=pod.key, node=node_name,
+                         chips=pod.meta.annotations[CHIP_INDEX_ANNOTATION])
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pod.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
+
+    # -- Bind -----------------------------------------------------------------
+
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        klog.V(3).info_s("attempting to bind pod to node", pod=pod.key,
+                         node=node_name)
+        from ..defaults import bind_with_annotations
+        return bind_with_annotations(self.handle, pod, node_name)

@@ -1,0 +1,6 @@
+"""Scheduler: queue, cache, scheduleOne loop (the hot path of SURVEY §3.2)."""
+from .queue import QueuedPodInfo, SchedulingQueue
+from .cache import Cache
+from .scheduler import Scheduler
+
+__all__ = ["QueuedPodInfo", "SchedulingQueue", "Cache", "Scheduler"]

@@ -1,0 +1,110 @@
+"""Scheduler cache: authoritative in-process view of nodes + pods, with
+assume/confirm/expire semantics so concurrent cycles see in-flight decisions.
+
+Rebuild of upstream internal/cache as the reference's hot loop depends on it
+(snapshot at cycle start, SURVEY §3.2 "assume pod in cache"). Assumed pods
+expire if the bind is never confirmed by the API server (watch event), which
+keeps the scheduler restart-safe with annotations-as-truth (SURVEY §5
+checkpoint/resume).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..api.core import Node, Pod
+from ..fwk.nodeinfo import NodeInfo, Snapshot
+from ..util import klog
+
+ASSUME_EXPIRATION_S = 30.0
+
+
+class Cache:
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, Node] = {}
+        self._pods: Dict[str, Pod] = {}            # all known scheduled pods
+        self._assumed: Dict[str, float] = {}       # pod key → bind deadline
+
+    # -- nodes ----------------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+
+    def remove_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes.pop(node.name, None)
+
+    # -- pods -----------------------------------------------------------------
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        """Stores the caller's object by reference (upstream shares the pod
+        pointer too): Reserve plugins mutate the assumed pod's annotations
+        *after* assume, and snapshots must see those writes — the chip model
+        is rebuilt from annotations (tpuslice/chip_node.py)."""
+        with self._lock:
+            pod.spec.node_name = node_name
+            self._pods[pod.key] = pod
+            self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
+
+    def finish_binding(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.key in self._assumed:
+                self._assumed[pod.key] = self._clock() + ASSUME_EXPIRATION_S
+
+    def forget_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.key in self._assumed:
+                self._assumed.pop(pod.key, None)
+                self._pods.pop(pod.key, None)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Confirmed (bound) pod from the watch stream."""
+        with self._lock:
+            self._assumed.pop(pod.key, None)
+            self._pods[pod.key] = pod
+
+    def update_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._pods[pod.key] = pod
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._assumed.pop(pod.key, None)
+            self._pods.pop(pod.key, None)
+
+    def is_assumed(self, pod_key: str) -> bool:
+        with self._lock:
+            return pod_key in self._assumed
+
+    def _cleanup_expired(self) -> None:
+        now = self._clock()
+        for key, deadline in list(self._assumed.items()):
+            if deadline < now:
+                klog.warning_s("assumed pod expired without bind confirmation",
+                               pod=key)
+                self._assumed.pop(key, None)
+                self._pods.pop(key, None)
+
+    # -- snapshot -------------------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        with self._lock:
+            self._cleanup_expired()
+            snap = Snapshot(nodes=list(self._nodes.values()))
+            for pod in self._pods.values():
+                info = snap.get(pod.spec.node_name)
+                if info is not None:
+                    info.add_pod(pod)
+            return snap
+
+    def node_names(self):
+        with self._lock:
+            return list(self._nodes)

@@ -1,0 +1,261 @@
+"""Priority scheduling queue: activeQ (heap over the QueueSort plugin's Less),
+backoffQ (exponential per-pod backoff), and unschedulableQ with event-driven
+requeue.
+
+Rebuild of upstream SchedulingQueue as the reference uses it: QueueSort
+ordering (coscheduling.Less, /root/reference/pkg/coscheduling/coscheduling.go:112-124),
+PodsToActivate sibling activation (core.go:111-143), and cluster-event moves
+declared via EnqueueExtensions (coscheduling.go:93-101).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api.core import Pod
+from ..fwk.interfaces import ClusterEvent
+from ..util import klog
+
+INITIAL_BACKOFF_S = 1.0
+MAX_BACKOFF_S = 10.0
+UNSCHEDULABLE_Q_FLUSH_S = 30.0
+
+
+class QueuedPodInfo:
+    __slots__ = ("pod", "timestamp", "initial_attempt_timestamp", "attempts",
+                 "unschedulable_plugins")
+
+    def __init__(self, pod: Pod, clock=time.time):
+        self.pod = pod
+        self.timestamp = clock()              # last enqueue time
+        self.initial_attempt_timestamp = self.timestamp
+        self.attempts = 0
+        self.unschedulable_plugins: set = set()
+
+    def backoff_duration(self) -> float:
+        d = INITIAL_BACKOFF_S
+        for _ in range(self.attempts - 1):
+            d *= 2
+            if d >= MAX_BACKOFF_S:
+                return MAX_BACKOFF_S
+        return d
+
+
+class _Heap:
+    """Stable heap with a less(a, b) comparator and O(1) membership."""
+
+    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool]):
+        self._less = less
+        self._seq = itertools.count()
+        self._heap: List = []
+        self._entries: Dict[str, list] = {}   # key → entry; entry[2] None ⇒ removed
+
+    class _Item:
+        __slots__ = ("info", "less", "seq")
+
+        def __init__(self, info, less, seq):
+            self.info, self.less, self.seq = info, less, seq
+
+        def __lt__(self, other):
+            if self.less(self.info, other.info):
+                return True
+            if self.less(other.info, self.info):
+                return False
+            return self.seq < other.seq
+
+    def push(self, info: QueuedPodInfo) -> None:
+        key = info.pod.key
+        self.remove(key)
+        item = self._Item(info, self._less, next(self._seq))
+        entry = [item, key, info]
+        self._entries[key] = entry
+        heapq.heappush(self._heap, (item, entry))
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        while self._heap:
+            _, entry = heapq.heappop(self._heap)
+            if entry[2] is not None:
+                del self._entries[entry[1]]
+                return entry[2]
+        return None
+
+    def remove(self, key: str) -> Optional[QueuedPodInfo]:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return None
+        info = entry[2]
+        entry[2] = None
+        return info
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def items(self) -> List[QueuedPodInfo]:
+        return [e[2] for e in self._entries.values() if e[2] is not None]
+
+
+class SchedulingQueue:
+    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
+                 cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
+                 clock=time.time):
+        self._clock = clock
+        self._lock = threading.Condition()
+        self._active = _Heap(less)
+        self._backoff: List = []           # (expiry, seq, info)
+        self._backoff_seq = itertools.count()
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        # plugin name → events that plugin said can unstick its rejections
+        self._cluster_event_map = cluster_event_map or {}
+        self._closed = False
+
+    # -- producers ------------------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        with self._lock:
+            info = QueuedPodInfo(pod, self._clock)
+            self._active.push(info)
+            self._lock.notify_all()
+
+    def update(self, pod: Pod) -> None:
+        """Pod object changed while queued: refresh the copy wherever it is."""
+        key = pod.key
+        with self._lock:
+            info = self._active.remove(key)
+            if info is not None:
+                info.pod = pod
+                self._active.push(info)
+                self._lock.notify_all()
+                return
+            for i, (exp, seq, binfo) in enumerate(self._backoff):
+                if binfo is not None and binfo.pod.key == key:
+                    binfo.pod = pod
+                    return
+            if key in self._unschedulable:
+                self._unschedulable[key].pod = pod
+
+    def delete(self, pod: Pod) -> None:
+        key = pod.key
+        with self._lock:
+            self._active.remove(key)
+            self._unschedulable.pop(key, None)
+            self._backoff = [(e, s, i) for (e, s, i) in self._backoff
+                             if i is None or i.pod.key != key]
+            heapq.heapify(self._backoff)
+
+    def add_unschedulable_if_not_present(self, info: QueuedPodInfo) -> None:
+        with self._lock:
+            key = info.pod.key
+            if key in self._active or key in self._unschedulable:
+                return
+            info.timestamp = self._clock()
+            self._unschedulable[key] = info
+
+    def requeue_after_failure(self, info: QueuedPodInfo) -> None:
+        """After a failed attempt: park in unschedulableQ; cluster events (or
+        the periodic flush) move it back through backoff. `attempts` was
+        already incremented by pop()."""
+        self.add_unschedulable_if_not_present(info)
+
+    # -- activation / moves ---------------------------------------------------
+
+    def activate(self, pods: List[Pod]) -> None:
+        """PodsToActivate: force the listed pods into activeQ
+        (core.go:111-143 / upstream scheduler.go activate)."""
+        with self._lock:
+            moved = False
+            for pod in pods:
+                key = pod.key
+                info = self._unschedulable.pop(key, None)
+                if info is None:
+                    for i, (exp, seq, binfo) in enumerate(self._backoff):
+                        if binfo is not None and binfo.pod.key == key:
+                            self._backoff[i] = (exp, seq, None)
+                            info = binfo
+                            break
+                if info is not None:
+                    self._active.push(info)
+                    moved = True
+            if moved:
+                self._lock.notify_all()
+
+    def move_all_to_active_or_backoff(self, resource: str, action: int) -> None:
+        """Cluster event: requeue unschedulable pods whose rejector plugins
+        registered a matching event (or that have no recorded rejector)."""
+        with self._lock:
+            now = self._clock()
+            moved = []
+            for key, info in list(self._unschedulable.items()):
+                if self._event_unsticks(info, resource, action):
+                    del self._unschedulable[key]
+                    moved.append(info)
+            for info in moved:
+                expiry = info.timestamp + info.backoff_duration()
+                if expiry <= now:
+                    self._active.push(info)
+                else:
+                    heapq.heappush(self._backoff, (expiry, next(self._backoff_seq), info))
+            if moved:
+                self._lock.notify_all()
+
+    def _event_unsticks(self, info: QueuedPodInfo, resource: str, action: int) -> bool:
+        if not info.unschedulable_plugins:
+            return True
+        for plugin in info.unschedulable_plugins:
+            for ev in self._cluster_event_map.get(plugin, []):
+                if ev.matches(resource, action):
+                    return True
+        return False
+
+    # -- consumer -------------------------------------------------------------
+
+    def _flush_locked(self) -> None:
+        now = self._clock()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, info = heapq.heappop(self._backoff)
+            if info is not None:
+                self._active.push(info)
+        for key, info in list(self._unschedulable.items()):
+            if now - info.timestamp > UNSCHEDULABLE_Q_FLUSH_S:
+                del self._unschedulable[key]
+                self._active.push(info)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._closed:
+                    return None
+                self._flush_locked()
+                info = self._active.pop()
+                if info is not None:
+                    info.attempts += 1
+                    return info
+                wait = 0.2
+                if self._backoff:
+                    wait = min(wait, max(0.0, self._backoff[0][0] - self._clock()))
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    # -- introspection --------------------------------------------------------
+
+    def pending_pods(self) -> List[Pod]:
+        with self._lock:
+            out = [i.pod for i in self._active.items()]
+            out += [i.pod for (_, _, i) in self._backoff if i is not None]
+            out += [i.pod for i in self._unschedulable.values()]
+            return out

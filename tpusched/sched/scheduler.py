@@ -1,0 +1,332 @@
+"""The scheduler: scheduleOne loop + async binding cycles.
+
+Rebuild of the hosting loop the reference plugs into (SURVEY §3.2):
+pop from activeQ (QueueSort order) → snapshot → PreFilter → Filter (per node)
+→ [PostFilter on failure] → Score → select → assume → Reserve → Permit →
+async binding cycle (WaitOnPermit → PreBind → Bind → PostBind). Each binding
+cycle runs on its own thread, crossing the same "goroutine boundary" as
+upstream (vendored scheduler.go:425,557-604 in the reference tree).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.core import Binding, Node, Pod
+from ..apiserver import Clientset, InformerFactory
+from ..apiserver import server as srv
+from ..fwk import (CycleState, Framework, Handle, PluginProfile, Registry,
+                   Status, PODS_TO_ACTIVATE_KEY, PodsToActivate)
+from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
+                              RESOURCE_ELASTIC_QUOTA, RESOURCE_NODE,
+                              RESOURCE_POD, RESOURCE_POD_GROUP,
+                              RESOURCE_TPU_TOPOLOGY)
+from ..util import klog
+from ..util.metrics import (bind_total, e2e_scheduling_seconds,
+                            schedule_attempts)
+from ..util.podutil import assigned
+from .cache import Cache
+from .queue import QueuedPodInfo, SchedulingQueue
+
+_KIND_TO_RESOURCE = {
+    srv.PODS: RESOURCE_POD,
+    srv.NODES: RESOURCE_NODE,
+    srv.POD_GROUPS: RESOURCE_POD_GROUP,
+    srv.ELASTIC_QUOTAS: RESOURCE_ELASTIC_QUOTA,
+    srv.TPU_TOPOLOGIES: RESOURCE_TPU_TOPOLOGY,
+}
+
+
+class Scheduler:
+    def __init__(self, api: srv.APIServer, registry: Registry,
+                 profile: PluginProfile, clock=time.time):
+        self.api = api
+        self.clock = clock
+        self.clientset = Clientset(api)
+        self.informer_factory = InformerFactory(api)
+        self.cache = Cache(clock)
+        self.profile = profile
+
+        self._fw: Optional[Framework] = None
+        self.handle = Handle(self.clientset, self.informer_factory,
+                             lambda: self._fw, clock)
+        self._fw = Framework(registry, profile, self.handle)
+
+        # Plugins without EnqueueExtensions default to all-events (upstream
+        # semantics: only declared hints narrow the requeue set).
+        from ..fwk.interfaces import EnqueueExtensions, WILDCARD_EVENT
+        cluster_event_map = {}
+        for name, plugin in self._fw.plugins.items():
+            if isinstance(plugin, EnqueueExtensions):
+                cluster_event_map[name] = plugin.events_to_register()
+            else:
+                cluster_event_map[name] = [WILDCARD_EVENT]
+        self.queue = SchedulingQueue(self._fw.less, cluster_event_map, clock)
+
+        self._stop = threading.Event()
+        self._sched_thread: Optional[threading.Thread] = None
+        self._binding_threads: List[threading.Thread] = []
+        self._wire_informers()
+
+    @property
+    def framework(self) -> Framework:
+        return self._fw
+
+    # -- informer wiring ------------------------------------------------------
+
+    def _responsible(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name == self.profile.scheduler_name
+
+    def _wire_informers(self) -> None:
+        pods = self.informer_factory.pods()
+        pods.add_event_handler(
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete)
+        nodes = self.informer_factory.nodes()
+        nodes.add_event_handler(
+            on_add=lambda n: (self.cache.add_node(n),
+                              self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)),
+            on_update=lambda old, new: (self.cache.update_node(new),
+                                        self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_UPDATE)),
+            on_delete=lambda n: (self.cache.remove_node(n),
+                                 self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_DELETE)))
+        for kind in (srv.POD_GROUPS, srv.ELASTIC_QUOTAS, srv.TPU_TOPOLOGIES):
+            res = _KIND_TO_RESOURCE[kind]
+            self.informer_factory.informer(kind).add_event_handler(
+                on_add=lambda o, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_ADD),
+                on_update=lambda o, n, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_UPDATE),
+                on_delete=lambda o, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_DELETE),
+                replay=False)
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if assigned(pod):
+            self.cache.add_pod(pod)
+            self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_ADD)
+        elif self._responsible(pod):
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        self.handle.pod_nominator.update_nominated_pod(old, new)
+        if assigned(new):
+            if not assigned(old):
+                # our own bind confirmation (or an external bind)
+                self.cache.add_pod(new)
+                self.queue.delete(new)
+            else:
+                self.cache.update_pod(new)
+            self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_UPDATE)
+        elif self._responsible(new):
+            self.queue.update(new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
+        if assigned(pod):
+            self.cache.remove_pod(pod)
+            self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
+        else:
+            self.queue.delete(pod)
+        # a waiting gang member deleted mid-permit must be rejected
+        self._fw.reject_waiting_pod(pod.meta.uid, msg=f"pod {pod.key} deleted")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._sched_thread = threading.Thread(target=self._loop,
+                                              name="tpusched-scheduleOne",
+                                              daemon=True)
+        self._sched_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        # unblock waiting gang members
+        self._fw.iterate_over_waiting_pods(
+            lambda wp: wp.reject("", "scheduler shutting down"))
+        if self._sched_thread:
+            self._sched_thread.join(timeout=5)
+        for t in list(self._binding_threads):
+            t.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            info = self.queue.pop(timeout=0.5)
+            if info is None:
+                continue
+            try:
+                self.schedule_one(info)
+            except Exception as e:
+                klog.error_s(e, "scheduleOne panicked", pod=info.pod.key)
+                self._handle_failure(info, Status.error(str(e)))
+
+    # -- one scheduling cycle -------------------------------------------------
+
+    def schedule_one(self, info: QueuedPodInfo) -> None:
+        pod = info.pod
+        # skip pods deleted/bound while queued
+        live = self.api.try_get(srv.PODS, pod.key)
+        if live is None or assigned(live) or live.is_terminating():
+            return
+        pod = live
+        info.pod = live
+        schedule_attempts.inc()
+        start = self.clock()
+
+        state = CycleState()
+        pods_to_activate = PodsToActivate()
+        state.write(PODS_TO_ACTIVATE_KEY, pods_to_activate)
+
+        snapshot = self.cache.snapshot()
+        self.handle.set_snapshot(snapshot)
+
+        node_name, status = self._schedule_pod(state, pod, snapshot)
+        if not status.is_success():
+            self._run_post_filter(state, pod, status)
+            self._handle_failure(info, status)
+            self._activate_pods(pods_to_activate)
+            return
+
+        # clear any stale nomination; assume so parallel cycles see the pod
+        self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
+        assumed = pod.deepcopy()
+        self.cache.assume_pod(assumed, node_name)
+
+        s = self._fw.run_reserve_plugins_reserve(state, assumed, node_name)
+        if not s.is_success():
+            self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(info, s)
+            self._activate_pods(pods_to_activate)
+            return
+
+        s = self._fw.run_permit_plugins(state, assumed, node_name)
+        if not s.is_success() and not s.is_wait():
+            self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(info, s)
+            self._activate_pods(pods_to_activate)
+            return
+
+        # sibling activation happens at end of the scheduling cycle
+        self._activate_pods(pods_to_activate)
+
+        t = threading.Thread(target=self._binding_cycle,
+                             args=(state, info, assumed, node_name, start,
+                                   pods_to_activate),
+                             name=f"bind-{pod.name}", daemon=True)
+        self._binding_threads.append(t)
+        t.start()
+        self._gc_binding_threads()
+
+    def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
+        """genericScheduler.Schedule analog: prefilter → filter → score."""
+        num_nodes = snapshot.num_nodes()
+        if num_nodes == 0:
+            return "", Status.unschedulable("no nodes available")
+
+        s = self._fw.run_pre_filter_plugins(state, pod)
+        if not s.is_success():
+            if s.is_error():
+                return "", s
+            diagnosis = {n: s for n in snapshot.node_names()}
+            state.write("tpusched/diagnosis", diagnosis)
+            return "", s
+
+        feasible: List[Node] = []
+        diagnosis: Dict[str, Status] = {}
+        for node_info in snapshot.list():
+            fs = self._fw.run_filter_plugins_with_nominated_pods(state, pod, node_info)
+            if fs.is_success():
+                feasible.append(node_info.node)
+            elif fs.is_error():
+                return "", fs
+            else:
+                diagnosis[node_info.node.name] = fs
+        state.write("tpusched/diagnosis", diagnosis)
+
+        if not feasible:
+            return "", Status.unschedulable(
+                f"0/{num_nodes} nodes are available").with_plugin(
+                    next(iter(diagnosis.values())).plugin if diagnosis else "")
+        if len(feasible) == 1:
+            return feasible[0].name, Status.success()
+
+        s = self._fw.run_pre_score_plugins(state, pod, feasible)
+        if not s.is_success():
+            return "", s
+        totals, s = self._fw.run_score_plugins(state, pod, feasible)
+        if not s.is_success():
+            return "", s
+        best = max(feasible, key=lambda n: (totals.get(n.name, 0), n.name))
+        return best.name, Status.success()
+
+    def _run_post_filter(self, state: CycleState, pod: Pod, status: Status) -> None:
+        from ..fwk.status import UNSCHEDULABLE
+        if status.code != UNSCHEDULABLE or not self._fw.post_filter_plugins:
+            return
+        diagnosis = state.try_read("tpusched/diagnosis") or {}
+        result, pf_status = self._fw.run_post_filter_plugins(state, pod, diagnosis)
+        if pf_status.is_success() and result and result.nominated_node_name:
+            node = result.nominated_node_name
+            try:
+                self.api.patch(srv.PODS, pod.key,
+                               lambda p: setattr(p.status, "nominated_node_name", node))
+            except srv.NotFound:
+                return
+            pod.status.nominated_node_name = node
+            self.handle.pod_nominator.add_nominated_pod(pod, node)
+            klog.V(4).info_s("preemption nominated node", pod=pod.key, node=node)
+
+    def _binding_cycle(self, state: CycleState, info: QueuedPodInfo,
+                       assumed: Pod, node_name: str, cycle_start: float,
+                       pods_to_activate: PodsToActivate) -> None:
+        pod = assumed
+        s = self._fw.wait_on_permit(pod)
+        if not s.is_success():
+            self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
+            self.cache.forget_pod(pod)
+            self._handle_failure(info, s)
+            return
+        s = self._fw.run_pre_bind_plugins(state, pod, node_name)
+        if not s.is_success():
+            self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
+            self.cache.forget_pod(pod)
+            self._handle_failure(info, s)
+            return
+        s = self._fw.run_bind_plugins(state, pod, node_name)
+        if not s.is_success():
+            self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
+            self.cache.forget_pod(pod)
+            self._handle_failure(info, s)
+            return
+        self.cache.finish_binding(pod)
+        bind_total.inc()
+        e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        klog.V(4).info_s("bound", pod=pod.key, node=node_name)
+        self._fw.run_post_bind_plugins(state, pod, node_name)
+        self._activate_pods(pods_to_activate)
+
+    # -- failure path ---------------------------------------------------------
+
+    def _handle_failure(self, info: QueuedPodInfo, status: Status) -> None:
+        if status.plugin:
+            info.unschedulable_plugins.add(status.plugin)
+        pod = info.pod
+        live = self.api.try_get(srv.PODS, pod.key)
+        if live is None or assigned(live):
+            return
+        info.pod = live
+        self.queue.requeue_after_failure(info)
+        klog.V(5).info_s("pod unschedulable", pod=pod.key,
+                         reason=status.message(), plugin=status.plugin)
+
+    def _activate_pods(self, pods_to_activate: PodsToActivate) -> None:
+        with pods_to_activate.lock:
+            pods = list(pods_to_activate.map.values())
+            pods_to_activate.map.clear()
+        if pods:
+            self.queue.activate(pods)
+
+    def _gc_binding_threads(self) -> None:
+        self._binding_threads = [t for t in self._binding_threads if t.is_alive()]

@@ -1,0 +1,120 @@
+"""In-process integration cluster — the envtest analog.
+
+The reference's integration tier runs a real kube-apiserver+etcd with no
+kubelet and fabricates Nodes as pure API objects
+(/root/reference/test/integration/main_test.go:31-46, coscheduling_test.go:106-118).
+TestCluster does the same hermetically: real scheduler + real controllers
+against the in-memory API server; "multi-node" is simulated by creating Node
+objects with arbitrary capacities. A tiny kubelet simulator can flip bound
+pods to Running so controller phase machines progress.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, List, Optional
+
+from ..api.core import POD_RUNNING, Node, Pod
+from ..apiserver import APIServer, Clientset
+from ..apiserver import server as srv
+from ..fwk import PluginProfile, Registry
+from ..plugins import default_registry
+from ..sched import Scheduler
+from ..util.podutil import assigned
+
+
+class TestCluster:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, profile: Optional[PluginProfile] = None,
+                 registry: Optional[Registry] = None,
+                 start_controllers: bool = False):
+        self.api = APIServer()
+        self.client = Clientset(self.api)
+        self.profile = profile or default_profile()
+        self.scheduler = Scheduler(self.api, registry or default_registry(),
+                                   self.profile)
+        self._controllers = []
+        if start_controllers:
+            from ..controllers.podgroup import PodGroupController
+            from ..controllers.elasticquota import ElasticQuotaController
+            self._controllers = [PodGroupController(self.api),
+                                 ElasticQuotaController(self.api)]
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def __enter__(self) -> "TestCluster":
+        self.scheduler.run()
+        for c in self._controllers:
+            c.run()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        for c in self._controllers:
+            c.stop()
+
+    # -- fixtures -------------------------------------------------------------
+
+    def add_nodes(self, nodes: Iterable[Node]) -> None:
+        for n in nodes:
+            self.api.create(srv.NODES, n)
+
+    def create_pods(self, pods: Iterable[Pod]) -> None:
+        for p in pods:
+            self.api.create(srv.PODS, p)
+
+    # -- assertions -----------------------------------------------------------
+
+    def pod(self, key: str) -> Optional[Pod]:
+        return self.api.try_get(srv.PODS, key)
+
+    def pod_scheduled(self, key: str) -> bool:
+        p = self.pod(key)
+        return p is not None and assigned(p)
+
+    def wait_for_pods_scheduled(self, keys: List[str], timeout: float = 10.0,
+                                interval: float = 0.02) -> bool:
+        """Poll like the reference's podScheduled helper
+        (test/integration/utils.go:46-55)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(self.pod_scheduled(k) for k in keys):
+                return True
+            time.sleep(interval)
+        return False
+
+    def wait_for_pods_unscheduled(self, keys: List[str], hold: float = 0.5) -> bool:
+        """Assert pods stay unscheduled for `hold` seconds."""
+        deadline = time.monotonic() + hold
+        while time.monotonic() < deadline:
+            if any(self.pod_scheduled(k) for k in keys):
+                return False
+            time.sleep(0.02)
+        return True
+
+    # -- kubelet simulator ----------------------------------------------------
+
+    def mark_running(self, keys: Optional[List[str]] = None) -> None:
+        for p in self.api.list(srv.PODS):
+            if assigned(p) and (keys is None or p.key in keys):
+                def mutate(pod):
+                    pod.status.phase = POD_RUNNING
+                self.api.patch(srv.PODS, p.key, mutate)
+
+
+def default_profile() -> PluginProfile:
+    """The kitchen-sink test profile: defaults + TpuSlice wired the way the
+    reference's flexgpu Helm chart wires FlexGPU (DefaultBinder disabled,
+    TpuSlice at filter/score/reserve/bind —
+    /root/reference/manifests/flexgpu/templates/configmap.yaml:14-28)."""
+    return PluginProfile(
+        queue_sort="PrioritySort",
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit", "TpuSlice"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice"],
+        bind=["TpuSlice", "DefaultBinder"],
+    )

@@ -1,0 +1,45 @@
+"""Unit-test harness: a Framework over a fake snapshot + in-memory API server.
+
+Analog of the reference's NewFramework + fakeSharedLister pattern
+(/root/reference/test/util/framework.go:29-40, test/util/fake.go:32-101):
+build a framework with only the plugin(s) under test and an in-memory
+pods/nodes view, no scheduler loop.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from ..api.core import Node, Pod
+from ..apiserver import APIServer, Clientset, InformerFactory
+from ..fwk import Framework, Handle, PluginProfile, Registry, Snapshot
+from ..plugins import default_registry
+
+
+def new_test_framework(profile: PluginProfile,
+                       nodes: Iterable[Node] = (),
+                       pods: Iterable[Pod] = (),
+                       registry: Optional[Registry] = None,
+                       api: Optional[APIServer] = None,
+                       clock=None) -> Tuple[Framework, Handle, APIServer]:
+    """Returns (framework, handle, apiserver) with the snapshot pre-populated
+    from `nodes`/`pods` (which are also created in the API server so plugin
+    informers see them)."""
+    import time
+    api = api or APIServer()
+    clientset = Clientset(api)
+    informers = InformerFactory(api)
+    from ..apiserver import server as srv
+    for n in nodes:
+        if api.try_get(srv.NODES, n.meta.key) is None:
+            api.create(srv.NODES, n)
+    for p in pods:
+        if api.try_get(srv.PODS, p.meta.key) is None:
+            api.create(srv.PODS, p)
+
+    fw_holder: List[Framework] = []
+    handle = Handle(clientset, informers, lambda: fw_holder[0],
+                    clock or time.time)
+    fw = Framework(registry or default_registry(), profile, handle)
+    fw_holder.append(fw)
+    handle.set_snapshot(Snapshot(nodes=list(nodes), pods=list(pods)))
+    return fw, handle, api

@@ -1,0 +1,93 @@
+"""Object builders for tests — MakePod/MakeResourceList analogs
+(/root/reference/test/integration/utils.go:59-160)."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..api.core import (Container, Node, NodeSpec, NodeStatus, Pod, PodSpec,
+                        PodStatus, DEFAULT_SCHEDULER_NAME)
+from ..api.meta import ObjectMeta
+from ..api.resources import ResourceList, TPU, TPU_MEMORY, make_resources
+from ..api.scheduling import (ElasticQuota, ElasticQuotaSpec, POD_GROUP_LABEL,
+                              PodGroup, PodGroupSpec)
+from ..api.topology import (ACCELERATORS, LABEL_ACCELERATOR, LABEL_COORD,
+                            LABEL_DCN_DOMAIN, LABEL_POOL, format_coord)
+
+
+def make_node(name: str, capacity: Optional[ResourceList] = None,
+              labels: Optional[Dict[str, str]] = None,
+              unschedulable: bool = False) -> Node:
+    cap = dict(capacity or make_resources(cpu=32, memory="128Gi", pods=110))
+    cap.setdefault("pods", 110)
+    return Node(meta=ObjectMeta(name=name, namespace="", labels=labels or {}),
+                spec=NodeSpec(unschedulable=unschedulable),
+                status=NodeStatus(capacity=dict(cap), allocatable=dict(cap)))
+
+
+def make_tpu_node(name: str, accelerator: str = "tpu-v5p", chips: int = 4,
+                  pool: str = "", coord: Tuple[int, ...] = (),
+                  dcn_domain: str = "",
+                  extra: Optional[ResourceList] = None) -> Node:
+    """A node as the TPU device plugin would advertise it: google.com/tpu
+    chips + google.com/tpu-memory HBM, with pool/accelerator/coord labels."""
+    acc = ACCELERATORS[accelerator]
+    cap = make_resources(cpu=208, memory="384Gi", pods=110)
+    cap[TPU] = chips
+    cap[TPU_MEMORY] = chips * acc.hbm_mb_per_chip
+    if extra:
+        cap.update(extra)
+    labels = {LABEL_ACCELERATOR: accelerator}
+    if pool:
+        labels[LABEL_POOL] = pool
+    if coord:
+        labels[LABEL_COORD] = format_coord(coord)
+    if dcn_domain:
+        labels[LABEL_DCN_DOMAIN] = dcn_domain
+    return make_node(name, cap, labels)
+
+
+def make_pod(name: str, namespace: str = "default",
+             requests: Optional[ResourceList] = None,
+             limits: Optional[ResourceList] = None,
+             pod_group: str = "", priority: int = 0,
+             node_name: str = "",
+             labels: Optional[Dict[str, str]] = None,
+             annotations: Optional[Dict[str, str]] = None,
+             scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+             priority_class_name: str = "",
+             node_selector: Optional[Dict[str, str]] = None) -> Pod:
+    lbls = dict(labels or {})
+    if pod_group:
+        lbls[POD_GROUP_LABEL] = pod_group
+    c = Container(requests=dict(requests or {}), limits=dict(limits or {}))
+    return Pod(
+        meta=ObjectMeta(name=name, namespace=namespace, labels=lbls,
+                        annotations=dict(annotations or {})),
+        spec=PodSpec(containers=[c], node_name=node_name, priority=priority,
+                     scheduler_name=scheduler_name,
+                     priority_class_name=priority_class_name,
+                     node_selector=dict(node_selector or {})),
+        status=PodStatus())
+
+
+def make_pod_group(name: str, namespace: str = "default", min_member: int = 1,
+                   min_resources: Optional[ResourceList] = None,
+                   schedule_timeout_seconds: Optional[int] = None,
+                   tpu_slice_shape: str = "", tpu_accelerator: str = "",
+                   multislice_set: str = "", multislice_index: int = 0) -> PodGroup:
+    return PodGroup(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        spec=PodGroupSpec(min_member=min_member, min_resources=min_resources,
+                          schedule_timeout_seconds=schedule_timeout_seconds,
+                          tpu_slice_shape=tpu_slice_shape,
+                          tpu_accelerator=tpu_accelerator,
+                          multislice_set=multislice_set,
+                          multislice_index=multislice_index))
+
+
+def make_elastic_quota(name: str, namespace: str,
+                       min: Optional[ResourceList] = None,
+                       max: Optional[ResourceList] = None) -> ElasticQuota:
+    return ElasticQuota(meta=ObjectMeta(name=name, namespace=namespace),
+                        spec=ElasticQuotaSpec(min=dict(min or {}),
+                                              max=dict(max or {})))

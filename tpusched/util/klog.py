@@ -1,0 +1,71 @@
+"""Structured, leveled logging — klog.InfoS/ErrorS analog.
+
+The reference enforces structured logging repo-wide
+(/root/reference/hack/verify-structured-logging.sh:17-19) with verbosity
+conventions V(4)-V(6) for scheduling detail and V(10) for firehose
+(flex_gpu.go:42, trimaran/handler.go:93). Same conventions here:
+``V(4).info_s("msg", pod=..., node=...)``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+_logger = logging.getLogger("tpusched")
+if not _logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter("%(message)s"))
+    _logger.addHandler(h)
+    _logger.setLevel(logging.INFO)
+
+_verbosity = int(os.environ.get("TPUSCHED_V", "0"))
+_lock = threading.Lock()
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def verbosity() -> int:
+    return _verbosity
+
+
+def _fmt(msg: str, kv: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime())
+    parts = [f'{k}="{v}"' if isinstance(v, str) else f"{k}={v}" for k, v in kv.items()]
+    return f'{ts} "{msg}" ' + " ".join(parts) if parts else f'{ts} "{msg}"'
+
+
+class _Verbose:
+    def __init__(self, level: int):
+        self._enabled = level <= _verbosity
+
+    def info_s(self, msg: str, **kv) -> None:
+        if self._enabled:
+            with _lock:
+                _logger.info("I " + _fmt(msg, kv))
+
+
+def V(level: int) -> _Verbose:  # noqa: N802 — klog naming
+    return _Verbose(level)
+
+
+def info_s(msg: str, **kv) -> None:
+    with _lock:
+        _logger.info("I " + _fmt(msg, kv))
+
+
+def error_s(err, msg: str, **kv) -> None:
+    if err is not None:
+        kv = {"err": str(err), **kv}
+    with _lock:
+        _logger.error("E " + _fmt(msg, kv))
+
+
+def warning_s(msg: str, **kv) -> None:
+    with _lock:
+        _logger.warning("W " + _fmt(msg, kv))

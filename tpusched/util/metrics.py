@@ -1,0 +1,135 @@
+"""Minimal Prometheus-style metrics registry.
+
+The reference inherits kube-scheduler's registry and increments upstream
+counters (metrics.PreemptionAttempts.Inc(),
+/root/reference/pkg/capacityscheduling/capacity_scheduling.go:322); the
+controller is scraped via ServiceMonitor (config/prometheus/monitor.yaml).
+Here: counters + histograms with a text exposition dump, including the
+north-star PodGroup-to-Bound latency histogram (BASELINE.md).
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.0, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(Counter):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        # bounded sample window for exact quantiles in bench; buckets remain
+        # exact forever (an always-on control plane must not leak memory)
+        self._samples: "collections.deque[float]" = collections.deque(maxlen=100_000)
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._samples.append(v)
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            xs = sorted(self._samples)
+            idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+            return xs[idx]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._samples.clear()
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(name, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_make(self, name, ctor):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = ctor()
+            return self._metrics[name]
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, m in sorted(metrics.items()):
+            if isinstance(m, Histogram):
+                cum = 0
+                with m._lock:
+                    for b, c in zip(m.buckets, m._counts):
+                        cum += c
+                        lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+                    lines.append(f'{name}_bucket{{le="+Inf"}} {m._count}')
+                    lines.append(f"{name}_sum {m._sum}")
+                    lines.append(f"{name}_count {m._count}")
+            else:
+                lines.append(f"{name} {m.value()}")
+        return "\n".join(lines) + "\n"
+
+
+# Global scheduler registry + well-known metrics.
+REGISTRY = Registry()
+
+preemption_attempts = REGISTRY.counter(
+    "tpusched_preemption_attempts_total", "Preemption attempts (PostFilter).")
+e2e_scheduling_seconds = REGISTRY.histogram(
+    "tpusched_e2e_scheduling_duration_seconds", "Pop-to-bound per pod.")
+pod_group_to_bound_seconds = REGISTRY.histogram(
+    "tpusched_podgroup_to_bound_duration_seconds",
+    "First-member-seen to last-member-bound per PodGroup (north-star metric).")
+schedule_attempts = REGISTRY.counter(
+    "tpusched_schedule_attempts_total", "Scheduling cycles run.")
+bind_total = REGISTRY.counter("tpusched_bind_total", "Successful binds.")

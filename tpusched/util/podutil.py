@@ -1,0 +1,51 @@
+"""Pod resource helpers (reference analog: /root/reference/pkg/util/resource.go)."""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api.core import POD_FAILED, POD_SUCCEEDED, Pod
+from ..api.resources import CPU, MEMORY, PODS, ResourceList
+
+
+def pod_effective_request(pod: Pod) -> ResourceList:
+    """Effective request = max(Σ containers, max(initContainers)) per resource,
+    plus overhead (resource.go:50-78 / k8s resourcehelper semantics)."""
+    total: Dict[str, int] = {}
+    for c in pod.spec.containers:
+        for k, v in c.requests.items():
+            total[k] = total.get(k, 0) + v
+    for c in pod.spec.init_containers:
+        for k, v in c.requests.items():
+            if v > total.get(k, 0):
+                total[k] = v
+    for k, v in pod.spec.overhead.items():
+        total[k] = total.get(k, 0) + v
+    return total
+
+
+def pod_request_with_defaults(pod: Pod, non_zero: bool = False) -> ResourceList:
+    """Like pod_effective_request but with the scheduler's non-zero defaults
+    (100m cpu / 200Mi memory) applied when requested — the upstream
+    NonZeroRequest convention used by the scheduler cache."""
+    req = pod_effective_request(pod)
+    if non_zero:
+        req.setdefault(CPU, 0)
+        req.setdefault(MEMORY, 0)
+        if req[CPU] == 0:
+            req[CPU] = 100
+        if req[MEMORY] == 0:
+            req[MEMORY] = 200 * 1024 * 1024
+    req[PODS] = 1
+    return req
+
+
+def is_pod_terminated(pod: Pod) -> bool:
+    return pod.status.phase in (POD_SUCCEEDED, POD_FAILED)
+
+
+def is_pod_active(pod: Pod) -> bool:
+    return not is_pod_terminated(pod) and not pod.is_terminating()
+
+
+def assigned(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)

@@ -1,0 +1,47 @@
+"""TTL cache — replacement for the vendored patrickmn/go-cache the reference
+uses for denied/permitted PodGroup backoff caches
+(/root/reference/pkg/coscheduling/core/core.go:79-81,103-104)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class TTLCache:
+    def __init__(self, default_ttl: float, clock=time.monotonic):
+        self._ttl = default_ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._items: Dict[str, Tuple[Any, float]] = {}
+
+    def set(self, key: str, value: Any = True, ttl: Optional[float] = None) -> None:
+        exp = self._clock() + (self._ttl if ttl is None else ttl)
+        with self._lock:
+            self._items[key] = (value, exp)
+
+    def get(self, key: str):
+        """Returns (value, True) if present and fresh, else (None, False)."""
+        now = self._clock()
+        with self._lock:
+            item = self._items.get(key)
+            if item is None:
+                return None, False
+            value, exp = item
+            if exp < now:
+                del self._items[key]
+                return None, False
+            return value, True
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key)[1]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._items.pop(key, None)
+
+    def purge(self) -> None:
+        now = self._clock()
+        with self._lock:
+            for k in [k for k, (_, exp) in self._items.items() if exp < now]:
+                del self._items[k]
