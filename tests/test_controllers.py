@@ -1,0 +1,152 @@
+"""Controller tests (reference analog: pkg/controller/{podgroup,elasticquota}_test.go
+with fake clients; here against the in-memory API server)."""
+import time
+
+from tpusched.api.core import POD_RUNNING, POD_SUCCEEDED, POD_FAILED
+from tpusched.api.resources import CPU, TPU
+from tpusched.api.scheduling import (PG_FAILED, PG_FINISHED, PG_PENDING,
+                                     PG_PRE_SCHEDULING, PG_RUNNING,
+                                     PG_SCHEDULED, PG_SCHEDULING)
+from tpusched.apiserver import APIServer
+from tpusched.apiserver import server as srv
+from tpusched.controllers import (ControllerRunner, ElasticQuotaController,
+                                  PodGroupController, ServerRunOptions,
+                                  WorkQueue)
+from tpusched.testing import make_elastic_quota, make_pod, make_pod_group
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def pg_phase(api, key):
+    pg = api.try_get(srv.POD_GROUPS, key)
+    return pg.status.phase if pg else None
+
+
+def test_workqueue_dedup_and_done():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    assert len(q) == 1
+    item = q.get(timeout=1)
+    assert item == "a"
+    q.add("a")  # re-added while processing → dirty
+    assert q.get(timeout=0.05) is None
+    q.done("a")
+    assert q.get(timeout=1) == "a"
+
+
+def test_podgroup_phase_progression():
+    api = APIServer()
+    ctrl = PodGroupController(api)
+    ctrl.run()
+    try:
+        pg = make_pod_group("gang", min_member=2)
+        api.create(srv.POD_GROUPS, pg)
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_PENDING)
+
+        # two member pods exist → PreScheduling
+        pods = [make_pod(f"m{i}", pod_group="gang") for i in range(2)]
+        for p in pods:
+            api.create(srv.PODS, p)
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_PRE_SCHEDULING)
+
+        # scheduler-side PostBind would set Scheduling + scheduled count
+        def to_scheduling(o):
+            o.status.phase = PG_SCHEDULING
+            o.status.scheduled = 2
+        api.patch(srv.POD_GROUPS, pg.key, to_scheduling)
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_SCHEDULED)
+
+        # pods running → Running
+        for p in pods:
+            api.patch(srv.PODS, p.key,
+                      lambda o: setattr(o.status, "phase", POD_RUNNING))
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_RUNNING)
+
+        # pods succeed → Finished
+        for p in pods:
+            api.patch(srv.PODS, p.key,
+                      lambda o: setattr(o.status, "phase", POD_SUCCEEDED))
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_FINISHED)
+    finally:
+        ctrl.stop()
+
+
+def test_podgroup_failure_counted():
+    api = APIServer()
+    ctrl = PodGroupController(api)
+    ctrl.run()
+    try:
+        pg = make_pod_group("gang", min_member=2)
+        api.create(srv.POD_GROUPS, pg)
+        pods = [make_pod(f"m{i}", pod_group="gang") for i in range(2)]
+        for p in pods:
+            api.create(srv.PODS, p)
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_PRE_SCHEDULING)
+        api.patch(srv.POD_GROUPS, pg.key,
+                  lambda o: setattr(o.status, "phase", PG_SCHEDULING))
+        api.patch(srv.PODS, pods[0].key,
+                  lambda o: setattr(o.status, "phase", POD_FAILED))
+        api.patch(srv.PODS, pods[1].key,
+                  lambda o: setattr(o.status, "phase", POD_RUNNING))
+        assert wait_until(lambda: pg_phase(api, pg.key) == PG_FAILED)
+        pg_obj = api.get(srv.POD_GROUPS, pg.key)
+        assert pg_obj.status.failed == 1 and pg_obj.status.running == 1
+    finally:
+        ctrl.stop()
+
+
+def test_elasticquota_used_recompute():
+    api = APIServer()
+    ctrl = ElasticQuotaController(api)
+    ctrl.run()
+    try:
+        eq = make_elastic_quota("quota-a", "team-a",
+                                min={CPU: 4000, TPU: 8}, max={CPU: 8000, TPU: 16})
+        api.create(srv.ELASTIC_QUOTAS, eq)
+        # running pod counts; pending pod does not
+        running = make_pod("r", namespace="team-a", requests={CPU: 1000, TPU: 4})
+        pending = make_pod("p", namespace="team-a", requests={CPU: 500})
+        api.create(srv.PODS, running)
+        api.create(srv.PODS, pending)
+        api.patch(srv.PODS, running.key,
+                  lambda o: setattr(o.status, "phase", POD_RUNNING))
+
+        def used_ok():
+            got = api.get(srv.ELASTIC_QUOTAS, eq.key).status.used
+            return got.get(CPU) == 1000 and got.get(TPU) == 4
+        assert wait_until(used_ok)
+
+        # pod deletion zeroes usage (zero-valued entries kept for min/max keys)
+        api.delete(srv.PODS, running.key)
+        def used_zero():
+            got = api.get(srv.ELASTIC_QUOTAS, eq.key).status.used
+            return got.get(CPU) == 0 and got.get(TPU) == 0
+        assert wait_until(used_zero)
+        assert any(e.reason == "Synced" for e in api.events())
+    finally:
+        ctrl.stop()
+
+
+def test_leader_election_single_leader():
+    api = APIServer()
+    opts = ServerRunOptions(enable_leader_election=True, lease_duration_s=2.0,
+                            renew_interval_s=0.2)
+    r1 = ControllerRunner(api, opts)
+    r2 = ControllerRunner(api, opts)
+    r1.run()
+    assert wait_until(lambda: r1.is_leader.is_set())
+    r2.run()
+    time.sleep(0.5)
+    assert not r2.is_leader.is_set()   # lease held by r1
+    r1.stop()
+    # r2 takes over after the lease expires
+    assert wait_until(lambda: r2.is_leader.is_set(), timeout=5)
+    r2.stop()

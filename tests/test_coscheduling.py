@@ -1,0 +1,153 @@
+"""Coscheduling gang tests — integration tier over the in-process cluster
+(reference analog: test/integration/coscheduling_test.go) plus manager units
+(pkg/coscheduling/core/core_test.go). BASELINE eval config #2: 8-pod gang on
+an emulated v5e-8 pool."""
+import time
+
+from tpusched.api.resources import CPU, PODS, TPU
+from tpusched.api.scheduling import PG_SCHEDULED
+from tpusched.apiserver import server as srv
+from tpusched.config.types import CoschedulingArgs
+from tpusched.fwk import PluginProfile
+from tpusched.plugins.coscheduling.core import check_cluster_resource
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, make_tpu_node)
+
+
+def gang_profile(permit_wait_s=3, denied_s=1):
+    """Coscheduling wiring per the reference's scheduler-config
+    (manifests/coscheduling/scheduler-config.yaml:10-34) + TpuSlice."""
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeSelector", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["Coscheduling"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice", "Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["TpuSlice"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=permit_wait_s,
+            denied_pg_expiration_time_seconds=denied_s)},
+    )
+
+
+def v5e8_nodes():
+    # v5e-8 slice: 2 hosts × 4 chips
+    return [make_tpu_node(f"v5e-host-{i}", accelerator="tpu-v5e", chips=4,
+                          pool="v5e-8") for i in range(2)]
+
+
+def test_8_pod_gang_schedules_atomically():
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        c.api.create(srv.POD_GROUPS, make_pod_group("jax-job", min_member=8))
+        pods = [make_pod(f"w{i}", pod_group="jax-job", limits={TPU: 1})
+                for i in range(8)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=15)
+        pg = c.api.get(srv.POD_GROUPS, "default/jax-job")
+        assert pg.status.phase == PG_SCHEDULED
+        assert pg.status.scheduled == 8
+
+
+def test_gang_all_or_nothing_when_capacity_short():
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())  # 8 chips
+        c.api.create(srv.POD_GROUPS, make_pod_group("too-big", min_member=9))
+        pods = [make_pod(f"w{i}", pod_group="too-big", limits={TPU: 1})
+                for i in range(9)]
+        c.create_pods(pods)
+        # all-or-nothing: NOBODY binds even though 8 chips are free
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=2.0)
+
+
+def test_gang_waits_for_enough_siblings():
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        c.api.create(srv.POD_GROUPS, make_pod_group("gang", min_member=3))
+        first_two = [make_pod(f"w{i}", pod_group="gang", limits={TPU: 1})
+                     for i in range(2)]
+        c.create_pods(first_two)
+        # sibling count < minMember → PreFilter rejects
+        assert c.wait_for_pods_unscheduled([p.key for p in first_two], hold=0.6)
+        third = make_pod("w2", pod_group="gang", limits={TPU: 1})
+        c.create_pods([third])
+        keys = [p.key for p in first_two] + [third.key]
+        assert c.wait_for_pods_scheduled(keys, timeout=15)
+
+
+def test_min_resources_gate_then_capacity_arrives():
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes([make_node("small", capacity={CPU: 2000, "pods": 10})])
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "needs-tpus", min_member=2, min_resources={TPU: 8}))
+        pods = [make_pod(f"w{i}", pod_group="needs-tpus", limits={TPU: 4})
+                for i in range(2)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=1.0)
+        c.add_nodes(v5e8_nodes())
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=15)
+
+
+def test_quorum_gap_grace_lets_stragglers_catch_up():
+    """≤10% gap: 9/10 assigned must NOT be mass-rejected; when the blocker
+    frees a chip the straggler completes the gang (coscheduling.go:156-162)."""
+    with TestCluster(profile=gang_profile(permit_wait_s=20)) as c:
+        nodes = [make_tpu_node(f"h{i}", chips=4) for i in range(3)]  # 12 chips
+        c.add_nodes(nodes)
+        blockers = [make_pod(f"blocker-{i}", limits={TPU: 1}) for i in range(3)]
+        c.create_pods(blockers)
+        assert c.wait_for_pods_scheduled([b.key for b in blockers])
+        # 10-member gang needs 10 of the 9 remaining chips
+        c.api.create(srv.POD_GROUPS, make_pod_group("gang", min_member=10))
+        pods = [make_pod(f"w{i}", pod_group="gang", limits={TPU: 1})
+                for i in range(10)]
+        c.create_pods(pods)
+        time.sleep(1.0)
+        bound = [p for p in pods if c.pod_scheduled(p.key)]
+        assert len(bound) == 0  # waiting in Permit, not bound
+        # free one chip → straggler fits → quorum completes
+        c.api.delete(srv.PODS, blockers[0].key)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+
+
+def test_permit_timeout_rejects_gang():
+    with TestCluster(profile=gang_profile(permit_wait_s=1, denied_s=1)) as c:
+        c.add_nodes([make_tpu_node("h0", chips=4)])
+        c.api.create(srv.POD_GROUPS, make_pod_group("gang", min_member=5))
+        # 5 members but only 4 chips: 4 wait in Permit, the 5th can't fit
+        pods = [make_pod(f"w{i}", pod_group="gang", limits={TPU: 1})
+                for i in range(5)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=2.5)
+        # chips must all be free again after the gang rejection (no leak)
+        probe = make_pod("probe", limits={TPU: 4})
+        c.create_pods([probe])
+        assert c.wait_for_pods_scheduled([probe.key], timeout=15)
+
+
+# -- manager unit tests -------------------------------------------------------
+
+def test_check_cluster_resource_does_not_mutate_request():
+    from tpusched.fwk.nodeinfo import NodeInfo
+    n = make_tpu_node("n1", chips=4)
+    infos = [NodeInfo(n)]
+    request = {TPU: 2, PODS: 2}
+    snapshot = dict(request)
+    assert check_cluster_resource(infos, request, "default/pg") is None
+    assert request == snapshot  # fixed quirk: reference mutates its input
+    gap = check_cluster_resource(infos, {TPU: 99}, "default/pg")
+    assert gap is not None and "google.com/tpu" in gap
+
+
+def test_check_cluster_resource_ignores_own_gang_pods():
+    """A retrying gang must not be blocked by its own resident pods
+    (getNodeResource, core.go:349-382)."""
+    from tpusched.fwk.nodeinfo import NodeInfo
+    n = make_tpu_node("n1", chips=4)
+    own = make_pod("own", pod_group="pg", limits={TPU: 4}, node_name="n1")
+    infos = [NodeInfo(n, [own])]
+    assert check_cluster_resource(infos, {TPU: 4}, "default/pg") is None
+    assert check_cluster_resource(infos, {TPU: 4}, "default/other") is not None

@@ -62,16 +62,19 @@ class Informer:
             on_add(o)
 
     # -- lister ---------------------------------------------------------------
+    # Listers return SHARED references, exactly like client-go listers share
+    # pointers out of the informer cache: callers must treat results as
+    # read-only (deepcopy before mutating). This keeps the hot scheduling
+    # paths (queue-sort comparisons, sibling listing) allocation-free.
 
     def get(self, key: str):
         with self._lock:
-            obj = self._cache.get(key)
-            return copy.deepcopy(obj) if obj is not None else None
+            return self._cache.get(key)
 
     def items(self, namespace: Optional[str] = None,
               selector: Optional[Dict[str, str]] = None) -> List[Any]:
         with self._lock:
-            objs = [copy.deepcopy(o) for o in self._cache.values()
+            objs = [o for o in self._cache.values()
                     if namespace is None or o.meta.namespace == namespace]
         if selector:
             objs = [o for o in objs

@@ -1,0 +1,45 @@
+"""Canonical scheduler profiles — the analog of the reference's per-plugin
+deployment YAML (manifests/*/scheduler-config.yaml). These are the wirings a
+deployment would select; tests compose their own narrower ones."""
+from __future__ import annotations
+
+from ..fwk.runtime import PluginProfile
+from .types import CoschedulingArgs
+
+
+def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
+                     scheduler_name: str = "tpusched") -> PluginProfile:
+    """The flagship profile: gang admission + TPU chip placement.
+    Mirrors the coscheduling config (queueSort/preFilter/postFilter/permit/
+    reserve/postBind, manifests/coscheduling/scheduler-config.yaml:10-34)
+    combined with the flexgpu chart's custom-bind wiring
+    (manifests/flexgpu/templates/configmap.yaml:14-28)."""
+    return PluginProfile(
+        scheduler_name=scheduler_name,
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["Coscheduling"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice", "Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["TpuSlice"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=permit_wait_s,
+            denied_pg_expiration_time_seconds=denied_s)},
+    )
+
+
+def tpuslice_profile(scheduler_name: str = "tpusched") -> PluginProfile:
+    """TpuSlice-only wiring (the flexgpu Helm chart analog)."""
+    return PluginProfile(
+        scheduler_name=scheduler_name,
+        queue_sort="PrioritySort",
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit", "TpuSlice"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice"],
+        bind=["TpuSlice"],
+    )

@@ -1,0 +1,10 @@
+"""Async controllers (reference analog: /root/reference/pkg/controller +
+cmd/controller/app): PodGroup phase machine, ElasticQuota usage accounting,
+workqueue plumbing, and the runner with leader election."""
+from .workqueue import WorkQueue
+from .podgroup import PodGroupController
+from .elasticquota import ElasticQuotaController
+from .runner import ControllerRunner, ServerRunOptions
+
+__all__ = ["WorkQueue", "PodGroupController", "ElasticQuotaController",
+           "ControllerRunner", "ServerRunOptions"]

@@ -1,0 +1,162 @@
+"""PodGroup controller: drives PodGroup.Status.Phase.
+
+Rebuild of /root/reference/pkg/controller/podgroup.go: workqueue fed by PG and
+member-pod events (:112-155); syncHandler phase machine (:185-273):
+"" → Pending → PreScheduling (≥MinMember pods exist; fills OccupiedBy from
+owner refs :291-303) → Scheduling/Scheduled (set by the coscheduling plugin's
+PostBind) → Running → Finished/Failed by counting member pod phases;
+merge-patches status (:275-289); skips groups stuck >48h (:122-126).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..api.core import POD_FAILED, POD_RUNNING, POD_SUCCEEDED, Pod
+from ..api.scheduling import (PG_FAILED, PG_FINISHED, PG_PENDING,
+                              PG_PRE_SCHEDULING, PG_RUNNING, PG_SCHEDULED,
+                              PG_SCHEDULING, POD_GROUP_LABEL, PodGroup,
+                              pod_group_label)
+from ..apiserver import Clientset, InformerFactory
+from ..apiserver import server as srv
+from ..util import klog
+from .workqueue import WorkQueue
+
+STUCK_GROUP_MAX_AGE_S = 48 * 3600.0
+
+
+class PodGroupController:
+    def __init__(self, api: srv.APIServer, workers: int = 1, clock=time.time):
+        self.api = api
+        self.client = Clientset(api)
+        self.informers = InformerFactory(api)
+        self.queue = WorkQueue()
+        self.workers = workers
+        self.clock = clock
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+        self.pg_informer = self.informers.podgroups()
+        self.pod_informer = self.informers.pods()
+        self.pg_informer.add_event_handler(on_add=self._pg_added,
+                                           on_update=lambda old, new: self._pg_added(new))
+        self.pod_informer.add_event_handler(on_add=self._pod_added,
+                                            on_update=lambda old, new: self._pod_added(new))
+
+    # -- event handlers (podgroup.go:112-155) ---------------------------------
+
+    def _pg_added(self, pg: PodGroup) -> None:
+        if pg.status.phase in (PG_FINISHED, PG_FAILED):
+            return
+        # skip groups whose scheduling started >48h after creation (pods GCed)
+        if (pg.status.scheduled == pg.spec.min_member and pg.status.running == 0
+                and pg.status.schedule_start_time is not None
+                and pg.status.schedule_start_time - pg.meta.creation_timestamp
+                > STUCK_GROUP_MAX_AGE_S):
+            return
+        klog.V(5).info_s("enqueue podGroup", podGroup=pg.key)
+        self.queue.add(pg.key)
+
+    def _pod_added(self, pod: Pod) -> None:
+        pg_name = pod_group_label(pod)
+        if not pg_name:
+            return
+        pg = self.pg_informer.get(f"{pod.namespace}/{pg_name}")
+        if pg is None:
+            return
+        self._pg_added(pg)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"pg-controller-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                err = self.sync_handler(key)
+                if err is None:
+                    self.queue.forget(key)
+                else:
+                    klog.error_s(err, "error syncing pod group", podGroup=key)
+                    self.queue.add_rate_limited(key)
+            except Exception as e:
+                klog.error_s(e, "sync panicked", podGroup=key)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    # -- phase machine (podgroup.go:185-273) ----------------------------------
+
+    def sync_handler(self, key: str) -> Optional[Exception]:
+        pg = self.pg_informer.get(key)
+        if pg is None:
+            klog.V(5).info_s("pod group has been deleted", podGroup=key)
+            return None
+        pods = self.pod_informer.items(namespace=pg.meta.namespace,
+                                       selector={POD_GROUP_LABEL: pg.meta.name})
+
+        # The phase machine runs INSIDE the atomic patch, against the live
+        # object — never writing status.scheduled (owned by the scheduler's
+        # PostBind). The reference survives the equivalent race only because
+        # its merge patch sends changed fields; replacing the whole status
+        # from a stale read would clobber concurrent scheduled-count patches.
+        probe = pg.deepcopy()
+        self._apply_phase_machine(probe, pods)
+        if probe.status == pg.status:
+            return None  # avoid patch→event→resync loops
+        try:
+            self.client.podgroups.patch(
+                key, lambda live: self._apply_phase_machine(live, pods))
+        except srv.NotFound:
+            return None
+        except Exception as e:
+            return e
+        return None
+
+    def _apply_phase_machine(self, pg: PodGroup, pods: List[Pod]) -> None:
+        st = pg.status
+        if st.phase == "":
+            st.phase = PG_PENDING
+            return
+        if st.phase == PG_PENDING:
+            if len(pods) >= pg.spec.min_member:
+                st.phase = PG_PRE_SCHEDULING
+                self._fill_occupied(pg, pods[0])
+            return
+        st.running = sum(1 for p in pods if p.status.phase == POD_RUNNING)
+        st.succeeded = sum(1 for p in pods if p.status.phase == POD_SUCCEEDED)
+        st.failed = sum(1 for p in pods if p.status.phase == POD_FAILED)
+        if not pods:
+            st.phase = PG_PENDING
+            return
+        if st.scheduled >= pg.spec.min_member and st.phase == PG_SCHEDULING:
+            st.phase = PG_SCHEDULED
+        if (st.succeeded + st.running >= pg.spec.min_member
+                and st.phase == PG_SCHEDULED):
+            st.phase = PG_RUNNING
+        # terminal states
+        if st.failed and st.failed + st.running + st.succeeded >= pg.spec.min_member:
+            st.phase = PG_FAILED
+        if st.succeeded >= pg.spec.min_member:
+            st.phase = PG_FINISHED
+
+    def _fill_occupied(self, pg: PodGroup, pod: Pod) -> None:
+        refs = sorted(f"{pod.namespace}/{ref.name}"
+                      for ref in pod.meta.owner_references)
+        if refs:
+            pg.status.occupied_by = ";".join(refs)

@@ -1,0 +1,94 @@
+"""Controller runner: options + leader election + lifecycle.
+
+Rebuild of /root/reference/cmd/controller/app (options.go:23-52, server.go:55-129):
+builds clients/informers, optionally campaigns for a coordination lease named
+"sched-plugins-controller" and only runs controllers while leading; exits
+leadership cleanly on stop. QPS/burst mirror the controller API budget
+(defaults qps=5 burst=10 workers=1, options.go:43-45).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+
+from ..apiserver import server as srv
+from ..util import klog
+from .elasticquota import ElasticQuotaController
+from .podgroup import PodGroupController
+
+LEASE_NAME = "sched-plugins-controller"
+
+
+@dataclass
+class ServerRunOptions:
+    """options.go:39-47 (kubeconfig/in-cluster flags are meaningless against
+    the in-memory server and intentionally absent)."""
+    api_qps: float = 5.0
+    api_burst: int = 10
+    workers: int = 1
+    enable_leader_election: bool = False
+    lease_duration_s: float = 15.0
+    renew_interval_s: float = 5.0
+
+
+class ControllerRunner:
+    def __init__(self, api: srv.APIServer,
+                 options: ServerRunOptions = ServerRunOptions()):
+        self.api = api
+        self.options = options
+        self.identity = f"controller-{uuid.uuid4().hex[:8]}"
+        self._stop = threading.Event()
+        self._thread = None
+        self._controllers = []
+        self.is_leader = threading.Event()
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="controller-runner")
+        self._thread.start()
+
+    def _run(self) -> None:
+        if self.options.enable_leader_election:
+            # campaign; block until we acquire the lease (server.go:84-123)
+            while not self._stop.is_set():
+                if self.api.acquire_or_renew_lease(
+                        LEASE_NAME, self.identity, self.options.lease_duration_s):
+                    break
+                time.sleep(self.options.renew_interval_s / 5)
+            if self._stop.is_set():
+                return
+            klog.info_s("started leading", identity=self.identity)
+        self.is_leader.set()
+        self._start_controllers()
+        if self.options.enable_leader_election:
+            # renew loop; losing the lease means exit (exit-on-lost-lease)
+            while not self._stop.is_set():
+                if not self.api.acquire_or_renew_lease(
+                        LEASE_NAME, self.identity, self.options.lease_duration_s):
+                    klog.error_s(None, "leader election lost; stopping controllers",
+                                 identity=self.identity)
+                    break
+                time.sleep(self.options.renew_interval_s)
+            self._stop_controllers()
+            self.is_leader.clear()
+
+    def _start_controllers(self) -> None:
+        self._controllers = [
+            PodGroupController(self.api, workers=self.options.workers),
+            ElasticQuotaController(self.api, workers=self.options.workers),
+        ]
+        for c in self._controllers:
+            c.run()
+
+    def _stop_controllers(self) -> None:
+        for c in self._controllers:
+            c.stop()
+        self._controllers = []
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._stop_controllers()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,109 @@
+"""Rate-limited workqueue — client-go workqueue semantics:
+
+- an item present in the queue is deduplicated;
+- an item being processed is not redelivered until done() — if re-added
+  meanwhile it is requeued after done();
+- add_rate_limited applies per-item exponential backoff (5ms base, 16s cap,
+  client-go defaults); forget() resets the failure count.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, Optional, Set
+
+BASE_DELAY_S = 0.005
+MAX_DELAY_S = 16.0
+
+
+class WorkQueue:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: list = []          # FIFO of ready items
+        self._queued: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._dirty: Set[str] = set()   # re-added while processing
+        self._delayed: list = []        # (ready_time, seq, item)
+        self._seq = itertools.count()
+        self._failures: Dict[str, int] = {}
+        self._shutdown = False
+
+    def add(self, item: str) -> None:
+        with self._cond:
+            if item in self._queued:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: str, delay: float) -> None:
+        with self._cond:
+            heapq.heappush(self._delayed, (self._clock() + delay,
+                                           next(self._seq), item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: str) -> None:
+        n = self._failures.get(item, 0)
+        self._failures[item] = n + 1
+        self.add_after(item, min(MAX_DELAY_S, BASE_DELAY_S * (2 ** n)))
+
+    def forget(self, item: str) -> None:
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def _flush_delayed_locked(self) -> None:
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._queued and item not in self._processing:
+                self._queued.add(item)
+                self._queue.append(item)
+            elif item in self._processing:
+                self._dirty.add(item)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                self._flush_delayed_locked()
+                if self._shutdown:
+                    return None
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._queued.discard(item)
+                    self._processing.add(item)
+                    return item
+                wait = 0.1
+                if self._delayed:
+                    wait = min(wait, max(0.0, self._delayed[0][0] - self._clock()))
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: str) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

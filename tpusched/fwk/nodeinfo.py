@@ -75,11 +75,34 @@ class Snapshot:
 
     def __init__(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()):
         self._infos: Dict[str, NodeInfo] = {}
+        self._pg_assigned: Optional[Dict[str, int]] = None  # lazy gang index
         for n in nodes:
             self._infos[n.name] = NodeInfo(n)
         for p in pods:
             if p.spec.node_name and p.spec.node_name in self._infos:
                 self._infos[p.spec.node_name].add_pod(p)
+
+    @classmethod
+    def from_infos(cls, infos: Dict[str, "NodeInfo"]) -> "Snapshot":
+        out = cls()
+        out._infos = infos
+        return out
+
+    def assigned_count(self, pg_name: str, namespace: str) -> int:
+        """Members of a gang with a node assigned (assumed or bound) — the
+        quorum input (core.go:301-318). Indexed lazily once per snapshot so
+        per-Permit cost is O(1) instead of O(pods)."""
+        from ..api.scheduling import POD_GROUP_LABEL
+        if self._pg_assigned is None:
+            idx: Dict[str, int] = {}
+            for info in self._infos.values():
+                for p in info.pods:
+                    name = p.meta.labels.get(POD_GROUP_LABEL)
+                    if name and p.spec.node_name:
+                        key = f"{p.meta.namespace}/{name}"
+                        idx[key] = idx.get(key, 0) + 1
+            self._pg_assigned = idx
+        return self._pg_assigned.get(f"{namespace}/{pg_name}", 0)
 
     # SharedLister / NodeInfoLister ------------------------------------------
     def list(self) -> List[NodeInfo]:
@@ -95,6 +118,5 @@ class Snapshot:
         return len(self._infos)
 
     def clone(self) -> "Snapshot":
-        out = Snapshot()
-        out._infos = {name: info.clone() for name, info in self._infos.items()}
-        return out
+        return Snapshot.from_infos(
+            {name: info.clone() for name, info in self._infos.items()})

@@ -1,0 +1,4 @@
+from .plugin import Coscheduling
+from .core import PodGroupManager
+
+__all__ = ["Coscheduling", "PodGroupManager"]

@@ -1,0 +1,170 @@
+"""Coscheduling plugin: all-or-nothing PodGroup admission.
+
+Rebuild of /root/reference/pkg/coscheduling/coscheduling.go:
+QueueSort by priority → gang creation time → key (:112-124); PreFilter
+delegates to the manager and maps errors to UnschedulableAndUnresolvable so
+preemption is not attempted (:129-137); PostFilter optimistically rejects the
+whole waiting gang when one member fails, with a ≤10% quorum-gap grace
+(:140-176); Permit waits until assigned+1 ≥ MinMember then Allows all waiting
+siblings (:184-216); Unreserve rejects all siblings on timeout (:224-237);
+PostBind patches PG status (:240-243); cluster events registered for requeue
+(:93-101).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...api.core import Pod
+from ...api.scheduling import POD_GROUP_LABEL, pod_group_full_name, pod_group_label
+from ...config.types import CoschedulingArgs
+from ...fwk import CycleState, Status
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+                               EVENT_DELETE, EVENT_UPDATE, PermitPlugin,
+                               PostBindPlugin, PostFilterPlugin,
+                               PostFilterResult, PreFilterPlugin,
+                               QueueSortPlugin, ReservePlugin, RESOURCE_POD,
+                               RESOURCE_POD_GROUP)
+from ...util import klog
+from .core import (POD_GROUP_NOT_FOUND, POD_GROUP_NOT_SPECIFIED, SUCCESS, WAIT,
+                   PodGroupManager, get_wait_time_duration)
+
+
+class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
+                   PermitPlugin, ReservePlugin, PostBindPlugin,
+                   EnqueueExtensions):
+    NAME = "Coscheduling"
+
+    def __init__(self, args: Optional[CoschedulingArgs], handle):
+        self.args = args or CoschedulingArgs()
+        self.handle = handle
+        self.pg_mgr = PodGroupManager(
+            handle,
+            schedule_timeout_s=float(self.args.permit_waiting_time_seconds),
+            denied_pg_expiration_s=float(self.args.denied_pg_expiration_time_seconds))
+
+    @classmethod
+    def new(cls, args, handle) -> "Coscheduling":
+        return cls(args, handle)
+
+    # -- EnqueueExtensions (coscheduling.go:93-101) ---------------------------
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            # a new/deleted sibling can make a gang schedulable
+            ClusterEvent(RESOURCE_POD, EVENT_ADD | EVENT_DELETE),
+            # PG created/updated (e.g. minMember lowered)
+            ClusterEvent(RESOURCE_POD_GROUP, EVENT_ADD | EVENT_UPDATE),
+            # capacity appearing can satisfy MinResources
+            ClusterEvent("Node", EVENT_ADD | EVENT_UPDATE),
+        ]
+
+    # -- QueueSort ------------------------------------------------------------
+
+    def less(self, pi1, pi2) -> bool:
+        if pi1.pod.priority != pi2.pod.priority:
+            return pi1.pod.priority > pi2.pod.priority
+        t1 = self.pg_mgr.get_creation_timestamp(pi1.pod, pi1.initial_attempt_timestamp)
+        t2 = self.pg_mgr.get_creation_timestamp(pi2.pod, pi2.initial_attempt_timestamp)
+        if t1 != t2:
+            return t1 < t2
+        return pi1.pod.key < pi2.pod.key
+
+    # -- PreFilter ------------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        err = self.pg_mgr.pre_filter(pod)
+        if err is not None:
+            klog.V(4).info_s("PreFilter failed", pod=pod.key, reason=err)
+            return Status.unresolvable(err)
+        return Status.success()
+
+    # -- PostFilter -----------------------------------------------------------
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        full, pg = self.pg_mgr.get_pod_group(pod)
+        if pg is None:
+            klog.V(4).info_s("pod does not belong to any group", pod=pod.key)
+            return PostFilterResult(), Status.unschedulable("can not find pod group")
+
+        assigned = self.pg_mgr.calculate_assigned_pods(pg.meta.name, pod.namespace)
+        if assigned >= pg.spec.min_member:
+            # quorum already satisfied; no need to reject the gang
+            return PostFilterResult(), Status.unschedulable()
+
+        # ≤10% quorum gap: let subsequent members try before mass rejection
+        if pg.spec.min_member > 0:
+            not_assigned_pct = (pg.spec.min_member - assigned) / pg.spec.min_member
+            if not_assigned_pct <= 0.1:
+                klog.V(4).info_s("small quorum gap, not rejecting gang",
+                                 podGroup=full, gap=not_assigned_pct)
+                return PostFilterResult(), Status.unschedulable()
+
+        # one member failed ⇒ its siblings would very likely fail too
+        def reject(waiting_pod):
+            wp = waiting_pod.pod
+            if (wp.namespace == pod.namespace
+                    and wp.meta.labels.get(POD_GROUP_LABEL) == pg.meta.name):
+                klog.V(3).info_s("PostFilter rejects the pod", podGroup=full,
+                                 pod=wp.key)
+                waiting_pod.reject(self.NAME, "optimistic rejection in PostFilter")
+        self.handle.iterate_over_waiting_pods(reject)
+        self.pg_mgr.add_denied_pod_group(full)
+        self.pg_mgr.delete_permitted_pod_group(full)
+        return PostFilterResult(), Status.unschedulable(
+            f"PodGroup {full} gets rejected due to Pod {pod.name} is "
+            f"unschedulable even after PostFilter")
+
+    # -- Permit ---------------------------------------------------------------
+
+    def permit(self, state: CycleState, pod: Pod,
+               node_name: str) -> Tuple[Status, float]:
+        verdict = self.pg_mgr.permit(pod)
+        if verdict == POD_GROUP_NOT_SPECIFIED:
+            return Status.success(), 0.0
+        if verdict == POD_GROUP_NOT_FOUND:
+            return Status.unschedulable("PodGroup not found"), 0.0
+        if verdict == WAIT:
+            _, pg = self.pg_mgr.get_pod_group(pod)
+            wait_s = get_wait_time_duration(
+                pg, float(self.args.permit_waiting_time_seconds))
+            klog.V(3).info_s("pod is waiting to be scheduled", pod=pod.key,
+                             node=node_name, waitSeconds=wait_s)
+            # pull the siblings into activeQ so the quorum can form
+            self.pg_mgr.activate_siblings(pod, state)
+            return Status.wait(), wait_s
+        # SUCCESS: quorum reached — release every waiting sibling
+        full = pod_group_full_name(pod)
+
+        def allow(waiting_pod):
+            if pod_group_full_name(waiting_pod.pod) == full:
+                klog.V(3).info_s("Permit allows", pod=waiting_pod.pod.key)
+                waiting_pod.allow(self.NAME)
+        self.handle.iterate_over_waiting_pods(allow)
+        return Status.success(), 0.0
+
+    # -- Reserve/Unreserve ----------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        full, pg = self.pg_mgr.get_pod_group(pod)
+        if pg is None:
+            return
+
+        def reject(waiting_pod):
+            wp = waiting_pod.pod
+            if (wp.namespace == pod.namespace
+                    and wp.meta.labels.get(POD_GROUP_LABEL) == pg.meta.name):
+                klog.V(3).info_s("Unreserve rejects", pod=wp.key, podGroup=full)
+                waiting_pod.reject(self.NAME, "rejection in Unreserve")
+        self.handle.iterate_over_waiting_pods(reject)
+        self.pg_mgr.add_denied_pod_group(full)
+        self.pg_mgr.delete_permitted_pod_group(full)
+
+    # -- PostBind -------------------------------------------------------------
+
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        klog.V(5).info_s("PostBind", pod=pod.key)
+        self.pg_mgr.post_bind(pod, node_name)

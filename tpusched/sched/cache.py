@@ -2,16 +2,17 @@
 assume/confirm/expire semantics so concurrent cycles see in-flight decisions.
 
 Rebuild of upstream internal/cache as the reference's hot loop depends on it
-(snapshot at cycle start, SURVEY §3.2 "assume pod in cache"). Assumed pods
-expire if the bind is never confirmed by the API server (watch event), which
-keeps the scheduler restart-safe with annotations-as-truth (SURVEY §5
-checkpoint/resume).
+(snapshot at cycle start, SURVEY §3.2 "assume pod in cache"). NodeInfos are
+maintained incrementally on every event (upstream's design) so snapshot() is
+a cheap per-node clone, not a rebuild. Assumed pods expire if the bind is
+never confirmed by the API server (watch event), which keeps the scheduler
+restart-safe with annotations-as-truth (SURVEY §5 checkpoint/resume).
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..api.core import Node, Pod
 from ..fwk.nodeinfo import NodeInfo, Snapshot
@@ -24,25 +25,45 @@ class Cache:
     def __init__(self, clock=time.time):
         self._clock = clock
         self._lock = threading.RLock()
-        self._nodes: Dict[str, Node] = {}
-        self._pods: Dict[str, Pod] = {}            # all known scheduled pods
-        self._assumed: Dict[str, float] = {}       # pod key → bind deadline
+        self._infos: Dict[str, NodeInfo] = {}       # node name → live NodeInfo
+        self._pods: Dict[str, Pod] = {}             # all known scheduled pods
+        self._assumed: Dict[str, float] = {}        # pod key → bind deadline
 
     # -- nodes ----------------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
         with self._lock:
-            self._nodes[node.name] = node
+            info = NodeInfo(node)
+            self._infos[node.name] = info
+            # attach pods already known to live on this node
+            for p in self._pods.values():
+                if p.spec.node_name == node.name:
+                    info.add_pod(p)
 
     def update_node(self, node: Node) -> None:
         with self._lock:
-            self._nodes[node.name] = node
+            info = self._infos.get(node.name)
+            if info is None:
+                self.add_node(node)
+            else:
+                info.node = node
+                info.generation += 1
 
     def remove_node(self, node: Node) -> None:
         with self._lock:
-            self._nodes.pop(node.name, None)
+            self._infos.pop(node.name, None)
 
     # -- pods -----------------------------------------------------------------
+
+    def _attach(self, pod: Pod) -> None:
+        info = self._infos.get(pod.spec.node_name)
+        if info is not None:
+            info.add_pod(pod)
+
+    def _detach(self, pod: Pod) -> None:
+        info = self._infos.get(pod.spec.node_name)
+        if info is not None:
+            info.remove_pod(pod)
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
         """Stores the caller's object by reference (upstream shares the pod
@@ -52,6 +73,7 @@ class Cache:
         with self._lock:
             pod.spec.node_name = node_name
             self._pods[pod.key] = pod
+            self._attach(pod)
             self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
 
     def finish_binding(self, pod: Pod) -> None:
@@ -63,22 +85,29 @@ class Cache:
         with self._lock:
             if pod.key in self._assumed:
                 self._assumed.pop(pod.key, None)
-                self._pods.pop(pod.key, None)
+                old = self._pods.pop(pod.key, None)
+                if old is not None:
+                    self._detach(old)
 
     def add_pod(self, pod: Pod) -> None:
         """Confirmed (bound) pod from the watch stream."""
         with self._lock:
             self._assumed.pop(pod.key, None)
+            old = self._pods.get(pod.key)
+            if old is not None:
+                self._detach(old)
             self._pods[pod.key] = pod
+            self._attach(pod)
 
     def update_pod(self, pod: Pod) -> None:
-        with self._lock:
-            self._pods[pod.key] = pod
+        self.add_pod(pod)
 
     def remove_pod(self, pod: Pod) -> None:
         with self._lock:
             self._assumed.pop(pod.key, None)
-            self._pods.pop(pod.key, None)
+            old = self._pods.pop(pod.key, None)
+            if old is not None:
+                self._detach(old)
 
     def is_assumed(self, pod_key: str) -> bool:
         with self._lock:
@@ -91,20 +120,18 @@ class Cache:
                 klog.warning_s("assumed pod expired without bind confirmation",
                                pod=key)
                 self._assumed.pop(key, None)
-                self._pods.pop(key, None)
+                old = self._pods.pop(key, None)
+                if old is not None:
+                    self._detach(old)
 
     # -- snapshot -------------------------------------------------------------
 
     def snapshot(self) -> Snapshot:
         with self._lock:
             self._cleanup_expired()
-            snap = Snapshot(nodes=list(self._nodes.values()))
-            for pod in self._pods.values():
-                info = snap.get(pod.spec.node_name)
-                if info is not None:
-                    info.add_pod(pod)
-            return snap
+            return Snapshot.from_infos(
+                {name: info.clone() for name, info in self._infos.items()})
 
     def node_names(self):
         with self._lock:
-            return list(self._nodes)
+            return list(self._infos)

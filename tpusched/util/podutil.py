@@ -7,15 +7,25 @@ from ..api.core import POD_FAILED, POD_SUCCEEDED, Pod
 from ..api.resources import CPU, MEMORY, PODS, ResourceList
 
 
+def _container_requests(c) -> Dict[str, int]:
+    """Container requests with the API server's defaulting applied: a resource
+    set only in limits defaults requests to the limit (mandatory for extended
+    resources like google.com/tpu)."""
+    req = dict(c.requests)
+    for k, v in c.limits.items():
+        req.setdefault(k, v)
+    return req
+
+
 def pod_effective_request(pod: Pod) -> ResourceList:
     """Effective request = max(Σ containers, max(initContainers)) per resource,
     plus overhead (resource.go:50-78 / k8s resourcehelper semantics)."""
     total: Dict[str, int] = {}
     for c in pod.spec.containers:
-        for k, v in c.requests.items():
+        for k, v in _container_requests(c).items():
             total[k] = total.get(k, 0) + v
     for c in pod.spec.init_containers:
-        for k, v in c.requests.items():
+        for k, v in _container_requests(c).items():
             if v > total.get(k, 0):
                 total[k] = v
     for k, v in pod.spec.overhead.items():
@@ -26,7 +36,13 @@ def pod_effective_request(pod: Pod) -> ResourceList:
 def pod_request_with_defaults(pod: Pod, non_zero: bool = False) -> ResourceList:
     """Like pod_effective_request but with the scheduler's non-zero defaults
     (100m cpu / 200Mi memory) applied when requested — the upstream
-    NonZeroRequest convention used by the scheduler cache."""
+    NonZeroRequest convention used by the scheduler cache.
+
+    Memoized per pod object (hot path: every NodeInfo.add_pod); safe because
+    pod specs are replaced wholesale on update, never mutated in place."""
+    cache = getattr(pod, "_req_memo", None)
+    if cache is not None and non_zero in cache:
+        return cache[non_zero]
     req = pod_effective_request(pod)
     if non_zero:
         req.setdefault(CPU, 0)
@@ -36,6 +52,13 @@ def pod_request_with_defaults(pod: Pod, non_zero: bool = False) -> ResourceList:
         if req[MEMORY] == 0:
             req[MEMORY] = 200 * 1024 * 1024
     req[PODS] = 1
+    if cache is None:
+        cache = {}
+        try:
+            object.__setattr__(pod, "_req_memo", cache)
+        except AttributeError:
+            return req
+    cache[non_zero] = req
     return req
 
 
