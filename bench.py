@@ -21,15 +21,18 @@ def run_once() -> float:
     from tpusched.api.resources import TPU, make_resources
     from tpusched.apiserver import server as srv
     from tpusched.config.profiles import tpu_gang_profile
-    from tpusched.testing import TestCluster, make_pod, make_pod_group, make_tpu_node
+    from tpusched.testing import TestCluster, make_pod, make_pod_group, make_tpu_pool
 
-    # 64 hosts × 4 chips (v5p pool) so a 256-chip gang fits exactly.
-    nodes = [make_tpu_node(f"host-{i:03d}", pool="pool-a", chips=4)
-             for i in range(64)]
     with TestCluster(profile=tpu_gang_profile()) as c:
+        # v5p-256 pool: 8x8x4 chips = 64 hosts × 4 chips, published as a
+        # TpuTopology CR so the gang goes through full ICI slice fitting.
+        topo, nodes = make_tpu_pool("pool-a", dims=(8, 8, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
         c.add_nodes(nodes)
         c.api.create(srv.POD_GROUPS,
-                     make_pod_group("llama-gang", min_member=GANG_SIZE))
+                     make_pod_group("llama-gang", min_member=GANG_SIZE,
+                                    tpu_slice_shape="8x8x4",
+                                    tpu_accelerator="tpu-v5p"))
         pods = [make_pod(f"worker-{i:03d}", pod_group="llama-gang",
                          limits={TPU: 1},
                          requests=make_resources(cpu=4, memory="8Gi"))

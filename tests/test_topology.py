@@ -1,0 +1,198 @@
+"""Torus fitting engine + TopologyMatch plugin tests.
+
+Reference analog: the NRT filter table tests (pkg/noderesourcetopology/
+filter_test.go, the reference's biggest suite) — here covering the TPU
+generalization. BASELINE eval config #3: ICI-zone fit on a 4x4x4 v5p-64
+torus."""
+import time
+
+from tpusched.api.resources import TPU
+from tpusched.api.topology import V5P, parse_shape
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.plugins.topologymatch import (COORD_ANNOTATION, POOL_ANNOTATION,
+                                            TopologyMatch)
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+from tpusched.topology.torus import (HostGrid, enumerate_placements,
+                                     feasible_placements, host_block_shape,
+                                     validate_slice_shape)
+
+
+# -- engine unit tests --------------------------------------------------------
+
+def grid_4x4x8():
+    topo, _ = make_tpu_pool("p", dims=(4, 4, 8))
+    return HostGrid.from_spec(topo.spec)
+
+
+def test_host_block_shape():
+    assert host_block_shape((4, 4, 4), V5P) == (2, 2, 4)
+    assert host_block_shape((2, 2, 8), V5P) == (1, 1, 8)
+
+
+def test_validate_slice_shape():
+    assert validate_slice_shape((4, 4, 4), V5P, (4, 4, 8)) is None
+    # wrong rank
+    assert validate_slice_shape((4, 4), V5P, (4, 4, 8)) is not None
+    # not a multiple of host extent (2,2,1)
+    assert validate_slice_shape((3, 4, 4), V5P, (4, 4, 8)) is not None
+    # too big for the pool under any rotation
+    assert validate_slice_shape((4, 4, 16), V5P, (4, 4, 8)) is not None
+
+
+def test_enumerate_placements_counts():
+    grid = grid_4x4x8()          # host grid 2x2x8, no wrap
+    # full-pool cross-section blocks: 2x2x4 hosts can slide along z: 5 anchors
+    ps = enumerate_placements(grid, (2, 2, 4))
+    assert len(ps) == 5
+    assert all(len(p) == 16 for p in ps)
+    # 1x1x8 spans z fully; 2x2 anchor positions in x,y = 4; plus permutations
+    # placing the long axis along x/y are impossible (dims 2,2) → exactly 4
+    ps = enumerate_placements(grid, (1, 1, 8))
+    assert len(ps) == 4
+
+
+def test_enumerate_placements_wraparound():
+    topo, _ = make_tpu_pool("p", dims=(4, 4, 8), wrap=(False, False, True))
+    grid = HostGrid.from_spec(topo.spec)
+    # with z wraparound a 2x2x4 host block can anchor at any of 8 z positions
+    ps = enumerate_placements(grid, (2, 2, 4))
+    assert len(ps) == 8
+
+
+def test_feasible_placements_respects_assigned_and_free():
+    grid = grid_4x4x8()
+    ps = enumerate_placements(grid, (2, 2, 4))
+    all_hosts = frozenset(grid.node_of)
+    # a blocker at z=3 kills every window containing it
+    blocked = frozenset({(0, 0, 3)})
+    free = all_hosts - blocked
+    survivors = feasible_placements(ps, frozenset(), free)
+    assert len(survivors) == 1  # only window z∈[4,8)
+    # an assigned sibling at z=0 pins the window to z∈[0,4) — conflicts
+    survivors = feasible_placements(ps, frozenset({(0, 0, 0)}), free)
+    assert survivors == []
+
+
+# -- integration: BASELINE config #3 -----------------------------------------
+
+def add_pool(c, *args, **kw):
+    topo, nodes = make_tpu_pool(*args, **kw)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+    return topo, nodes
+
+
+def slice_gang(c, name, shape, members, accelerator="tpu-v5p", chips=4):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator=accelerator))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: chips})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def test_v5p64_full_slice_gang():
+    """4x4x4 slice on a v5p-64 pool: 16 hosts, the whole pool, with coord
+    annotations on every member."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "v5p-64", dims=(4, 4, 4))
+        pods = slice_gang(c, "llama", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+        coords = set()
+        for p in pods:
+            bound = c.pod(p.key)
+            assert bound.meta.annotations[POOL_ANNOTATION] == "v5p-64"
+            coords.add(bound.meta.annotations[COORD_ANNOTATION])
+        assert len(coords) == 16  # every host exactly once
+
+
+def test_contiguity_respected_with_blocker():
+    """A blocker host in the middle of the torus forces the slice into the
+    contiguous free window; a second identical slice cannot fit."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=3, denied_s=1)) as c:
+        topo, nodes = add_pool(c, "v5p-128", dims=(4, 4, 8))
+        # occupy host (0,0,3) with a pre-bound pod: its chips are gone, so
+        # every z-window containing z=3 is blocked
+        target = next(n for n in nodes if topo.spec.hosts[n.name] == (0, 0, 3))
+        c.create_pods([make_pod("pinned-blocker", limits={TPU: 4},
+                                node_name=target.name)])
+        gang = slice_gang(c, "sliceA", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in gang], timeout=20)
+        zs = set()
+        for p in gang:
+            coord = c.pod(p.key).meta.annotations[COORD_ANNOTATION]
+            zs.add(int(coord.split("-")[2]))
+        assert zs == {4, 5, 6, 7}  # pushed past the blocker at z=3
+        # no second 4x4x4 window remains
+        gang2 = slice_gang(c, "sliceB", "4x4x4", 16)
+        assert c.wait_for_pods_unscheduled([p.key for p in gang2], hold=2.0)
+
+
+def test_two_slices_pack_one_pool():
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "v5p-128", dims=(4, 4, 8))
+        a = slice_gang(c, "a", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in a], timeout=20)
+        b = slice_gang(c, "b", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in b], timeout=20)
+        # disjoint host sets
+        nodes_a = {c.pod(p.key).spec.node_name for p in a}
+        nodes_b = {c.pod(p.key).spec.node_name for p in b}
+        assert not (nodes_a & nodes_b)
+
+
+def test_wrong_accelerator_unresolvable():
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=2, denied_s=1)) as c:
+        add_pool(c, "v5e-16", accelerator="tpu-v5e", dims=(4, 4))
+        pods = slice_gang(c, "wants-v5p", "4x4x4", 16, accelerator="tpu-v5p")
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=1.0)
+
+
+def test_v5e_2d_slice():
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "v5e-16", accelerator="tpu-v5e", dims=(4, 4))
+        pods = slice_gang(c, "flash", "4x4", 4, accelerator="tpu-v5e")
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+
+
+def test_gang_never_splits_across_pools():
+    """Two identical pools: the gang must land entirely in one torus
+    (regression: cross-pool slice splitting)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "pool-a", dims=(4, 4, 4))
+        add_pool(c, "pool-b", dims=(4, 4, 4))
+        pods = slice_gang(c, "whole", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+        pools = {c.pod(p.key).meta.annotations[POOL_ANNOTATION] for p in pods}
+        assert len(pools) == 1, f"gang split across pools: {pools}"
+
+
+def test_foreign_chip_excludes_host_from_placement():
+    """One foreign 1-chip pod inside the only candidate window must make the
+    slice infeasible — a placement owns whole hosts (regression:
+    false-free partially-occupied hosts deadlocking the Permit barrier)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=2, denied_s=1)) as c:
+        topo, nodes = add_pool(c, "v5p-64", dims=(4, 4, 4))
+        # 1 foreign chip on one host: 255 of 256... here 63 of 64 chips free
+        c.create_pods([make_pod("foreign", limits={TPU: 1},
+                                node_name=nodes[0].name)])
+        pods = slice_gang(c, "full", "4x4x4", 16)
+        # PreFilter must reject outright (no feasible placement) — nobody
+        # assumes, nobody parks at Permit
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=2.0)
+
+
+def test_subhost_pods_pack_hosts_within_slice():
+    """4 one-chip pods per host: sibling-partial hosts stay eligible."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "v5p-16", dims=(2, 2, 4))  # 4 hosts x 4 chips
+        pods = slice_gang(c, "packed", "2x2x4", 16, chips=1)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+        per_host = {}
+        for p in pods:
+            n = c.pod(p.key).spec.node_name
+            per_host[n] = per_host.get(n, 0) + 1
+        assert sorted(per_host.values()) == [4, 4, 4, 4]

@@ -50,7 +50,7 @@ class Informer:
         delivered live (at-least-once; handlers must tolerate duplicate adds,
         as client-go's must)."""
         with self._lock:
-            existing = ([copy.deepcopy(o) for o in self._cache.values()]
+            existing = (list(self._cache.values())
                         if (replay and on_add) else [])
             if on_add:
                 self._on_add.append(on_add)

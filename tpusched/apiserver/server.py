@@ -105,13 +105,19 @@ class APIServer:
         the handler first receives synthetic Added events for every existing
         object."""
         with self._lock:
-            existing = [copy.deepcopy(o) for o in self._stores[kind].values()]
+            existing = list(self._stores[kind].values())  # shared, read-only
             self._handlers[kind].append(handler)
         if replay:
             for o in existing:
                 handler(WatchEvent(ADDED, kind, o))
 
     # -- CRUD -----------------------------------------------------------------
+
+    # Write-path sharing discipline: stored objects are never mutated in
+    # place after publication (every write replaces them wholesale), so watch
+    # events carry the stored object itself — exactly client-go's shared
+    # informer-cache contract. Consumers MUST treat watched/listed objects as
+    # read-only; get()/list() still return private deep copies.
 
     def create(self, kind: str, obj) -> Any:
         with self._lock:
@@ -123,9 +129,8 @@ class APIServer:
                 stored.meta.creation_timestamp = self._clock()
             self._bump(stored)
             self._stores[kind][key] = stored
-            out = copy.deepcopy(stored)
-        self._dispatch(WatchEvent(ADDED, kind, copy.deepcopy(out)))
-        return out
+        self._dispatch(WatchEvent(ADDED, kind, stored))
+        return copy.deepcopy(stored)  # callers own (and may mutate) returns
 
     def get(self, kind: str, key: str):
         with self._lock:
@@ -161,34 +166,38 @@ class APIServer:
             stored.meta.uid = old.meta.uid
             self._bump(stored)
             self._stores[kind][key] = stored
-            out = copy.deepcopy(stored)
-            old_copy = copy.deepcopy(old)
-        self._dispatch(WatchEvent(MODIFIED, kind, copy.deepcopy(out), old_copy))
-        return out
+        self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
+        return copy.deepcopy(stored)
 
     def patch(self, kind: str, key: str, mutate: Callable[[Any], None]) -> Any:
         """Atomic read-modify-write (merge-patch analog). `mutate` runs under
-        the store lock against the live object; keep it pure and fast."""
+        the store lock against a private copy of the live object; keep it
+        pure and fast."""
         with self._lock:
             old = self._stores[kind].get(key)
             if old is None:
                 raise NotFound(f"{kind} {key} not found")
-            old_copy = copy.deepcopy(old)
             stored = copy.deepcopy(old)
             mutate(stored)
             self._bump(stored)
             self._stores[kind][key] = stored
-            out = copy.deepcopy(stored)
-        self._dispatch(WatchEvent(MODIFIED, kind, copy.deepcopy(out), old_copy))
-        return out
+        self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
+        return copy.deepcopy(stored)
 
     def delete(self, kind: str, key: str) -> None:
         with self._lock:
             obj = self._stores[kind].pop(key, None)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
-            gone = copy.deepcopy(obj)
-        self._dispatch(WatchEvent(DELETED, kind, gone))
+        self._dispatch(WatchEvent(DELETED, kind, obj))
+
+    def peek(self, kind: str, key: str):
+        """Zero-copy read of the live stored object (or None). Callers MUST
+        treat the result as read-only — this is the hot-poll path (e.g. the
+        integration harness's podScheduled loop) where a full deepcopy per
+        probe would contend the store lock against binds."""
+        with self._lock:
+            return self._stores[kind].get(key)
 
     # -- subresources ---------------------------------------------------------
 

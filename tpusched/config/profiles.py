@@ -17,12 +17,13 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
     return PluginProfile(
         scheduler_name=scheduler_name,
         queue_sort="Coscheduling",
-        pre_filter=["Coscheduling"],
+        pre_filter=["Coscheduling", "TopologyMatch"],
         filter=["NodeUnschedulable", "NodeName", "NodeSelector",
-                "TaintToleration", "NodeResourcesFit", "TpuSlice"],
+                "TaintToleration", "NodeResourcesFit", "TpuSlice",
+                "TopologyMatch"],
         post_filter=["Coscheduling"],
-        score=[("TpuSlice", 1)],
-        reserve=["TpuSlice", "Coscheduling"],
+        score=[("TpuSlice", 1), ("TopologyMatch", 2)],
+        reserve=["TpuSlice", "TopologyMatch", "Coscheduling"],
         permit=["Coscheduling"],
         bind=["TpuSlice"],
         post_bind=["Coscheduling"],

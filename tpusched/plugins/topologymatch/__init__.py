@@ -1,0 +1,3 @@
+from .plugin import TopologyMatch, COORD_ANNOTATION, POOL_ANNOTATION
+
+__all__ = ["TopologyMatch", "COORD_ANNOTATION", "POOL_ANNOTATION"]

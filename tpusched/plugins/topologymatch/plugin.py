@@ -1,0 +1,316 @@
+"""TopologyMatch plugin: ICI-torus slice-shape fitting for gangs.
+
+TPU-native successor of the reference's NodeResourceTopologyMatch plugin
+(/root/reference/pkg/noderesourcetopology): where that plugin simulates the
+kubelet TopologyManager's single-NUMA-node admission with 1-D bitmasks
+(filter.go:84-150) fed by the NodeResourceTopology CRD, this plugin fits a
+PodGroup's requested chip shape (PodGroupSpec.tpu_slice_shape, e.g. "4x4x4")
+onto a contiguous free block of a pool's ICI torus published as a TpuTopology
+CR — axis permutations allowed, wraparound only on wrapped axes.
+
+Mechanics per scheduling cycle:
+- PreFilter: resolve the pod's gang slice request; enumerate feasible
+  placements on every matching pool given hosts already occupied and hosts
+  already ASSIGNED to gang siblings (the incremental all-or-nothing
+  constraint); stash per-node feasibility + scoring info in CycleState.
+  Non-slice pods return Skip (the filter is bypassed entirely, like the
+  reference skips BestEffort pods, filter.go:194-196).
+- Filter: membership test against the stash.
+- Score: corner-packing — prefer the node appearing in the FEWEST surviving
+  placements (most-constrained-first keeps the torus defragmented for future
+  gangs), with the configured strategy over pool utilization as a tiebreak.
+- Reserve/Unreserve: write/remove the pool + chip-coordinate annotations the
+  on-host runtime (and jaxbridge mesh builder) consumes.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ...api.core import Pod
+from ...api.resources import TPU
+from ...api.scheduling import POD_GROUP_LABEL, pod_group_label
+from ...api.topology import (ACCELERATORS, TOPOLOGY_GROUP, format_coord,
+                             parse_shape)
+from ...config.types import TopologyMatchArgs
+from ...fwk import CycleState, Status
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+                               EVENT_DELETE, EVENT_UPDATE, FilterPlugin,
+                               NodeScore, ReservePlugin, ScorePlugin,
+                               PreFilterPlugin, RESOURCE_NODE, RESOURCE_POD,
+                               RESOURCE_POD_GROUP, RESOURCE_TPU_TOPOLOGY)
+from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
+from ...topology.torus import (HostGrid, enumerate_placements,
+                               feasible_placements, host_block_shape,
+                               validate_slice_shape)
+from ...util import klog
+from ..tpuslice.chip_node import pod_tpu_limits
+
+COORD_ANNOTATION = TOPOLOGY_GROUP + "/coord"
+POOL_ANNOTATION = TOPOLOGY_GROUP + "/pool"
+
+_STATE_KEY = "TopologyMatch/state"
+
+
+class _CycleStash:
+    """Per-cycle feasibility: node → (pool, membership count, pool util)."""
+
+    def __init__(self):
+        self.allowed: Dict[str, Tuple[str, int, float]] = {}
+        self.max_membership = 1
+
+    def clone(self):
+        return self  # read-only after PreFilter
+
+
+class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
+                    EnqueueExtensions):
+    NAME = "TopologyMatch"
+
+    def __init__(self, args: Optional[TopologyMatchArgs], handle):
+        self.args = args or TopologyMatchArgs()
+        self.handle = handle
+        self.pg_informer = handle.informer_factory.podgroups()
+        self.topo_informer = handle.informer_factory.tputopologies()
+        # caches keyed by CR resource_version (grids) / + block (placements)
+        self._grid_cache: Dict[Tuple[str, int], HostGrid] = {}
+        self._placement_cache: Dict[Tuple[str, int, Tuple[int, ...]], list] = {}
+
+    @classmethod
+    def new(cls, args, handle) -> "TopologyMatch":
+        return cls(args, handle)
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(RESOURCE_POD, EVENT_ADD | EVENT_DELETE),
+            ClusterEvent(RESOURCE_NODE, EVENT_ADD | EVENT_UPDATE),
+            ClusterEvent(RESOURCE_TPU_TOPOLOGY, EVENT_ADD | EVENT_UPDATE),
+            ClusterEvent(RESOURCE_POD_GROUP, EVENT_ADD | EVENT_UPDATE),
+        ]
+
+    # -- gang slice request resolution ---------------------------------------
+
+    def _slice_request(self, pod: Pod):
+        """Returns (pg, chip_shape, accelerator_name) or None."""
+        name = pod_group_label(pod)
+        if not name:
+            return None
+        pg = self.pg_informer.get(f"{pod.namespace}/{name}")
+        if pg is None or not pg.spec.tpu_slice_shape:
+            return None
+        try:
+            shape = parse_shape(pg.spec.tpu_slice_shape)
+        except ValueError:
+            return "invalid"
+        return pg, shape, pg.spec.tpu_accelerator
+
+    # -- PreFilter ------------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        req = self._slice_request(pod)
+        if req is None:
+            return Status.skip()
+        if req == "invalid":
+            return Status.unresolvable("invalid tpu_slice_shape on PodGroup")
+        pg, shape, want_acc = req
+
+        chips_req, chips_set, _, _ = pod_tpu_limits(pod)
+        chips_needed = chips_req if chips_set else None
+        snapshot = self.handle.snapshot_shared_lister()
+        stash = _CycleStash()
+        validation_errors: List[str] = []
+        any_pool = False
+
+        candidates = []
+        for topo in self.topo_informer.items():
+            spec = topo.spec
+            if want_acc and spec.accelerator != want_acc:
+                continue
+            acc = ACCELERATORS.get(spec.accelerator)
+            if acc is None:
+                continue
+            any_pool = True
+            err = validate_slice_shape(shape, acc, tuple(spec.dims))
+            if err:
+                validation_errors.append(f"pool {spec.pool}: {err}")
+                continue
+            grid = self._grid(topo)
+            if grid is None:
+                continue
+            occ = self._occupancy(grid, snapshot, pg.meta.name, pod.namespace,
+                                  chips_needed if chips_needed is not None
+                                  else acc.chips_per_host)
+            candidates.append((topo, acc, grid, occ))
+
+        # A gang must live in ONE torus: once any sibling is assigned in a
+        # pool, every other pool is off the table (a "slice" spanning two
+        # disjoint ICI fabrics would be unusable).
+        pinned = [c for c in candidates if c[3][0]]
+        if pinned:
+            candidates = pinned
+
+        for topo, acc, grid, (assigned, free, eligible) in candidates:
+            block = host_block_shape(shape, acc)
+            placements = self._placements(topo, grid, block)
+            survivors = feasible_placements(placements, assigned, free)
+            if not survivors:
+                continue
+            pool_util = self._pool_utilization(grid, snapshot)
+            membership: Dict[str, int] = {}
+            for p in survivors:
+                for coord in p:
+                    node = grid.node_of.get(coord)
+                    if node is not None and coord in eligible:
+                        membership[node] = membership.get(node, 0) + 1
+            for node, count in membership.items():
+                prev = stash.allowed.get(node)
+                if prev is None or count < prev[1]:
+                    stash.allowed[node] = (grid.pool, count, pool_util)
+                stash.max_membership = max(stash.max_membership, count)
+
+        if not stash.allowed:
+            if not any_pool:
+                return Status.unresolvable(
+                    f"no TpuTopology pool matches accelerator "
+                    f"{want_acc or '(any)'}")
+            if validation_errors:
+                return Status.unresolvable("; ".join(validation_errors))
+            return Status.unschedulable(
+                f"no feasible {pg.spec.tpu_slice_shape} slice placement "
+                f"in any pool")
+        state.write(_STATE_KEY, stash)
+        return Status.success()
+
+    def _grid(self, topo) -> Optional[HostGrid]:
+        key = (topo.key, topo.meta.resource_version)
+        grid = self._grid_cache.get(key)
+        if grid is None:
+            grid = HostGrid.from_spec(topo.spec)
+            if grid is not None:
+                if len(self._grid_cache) > 16:
+                    self._grid_cache.clear()
+                self._grid_cache[key] = grid
+        return grid
+
+    def _placements(self, topo, grid: HostGrid, block) -> list:
+        key = (topo.key, topo.meta.resource_version, tuple(block))
+        got = self._placement_cache.get(key)
+        if got is None:
+            got = enumerate_placements(grid, block)
+            if len(self._placement_cache) > 64:
+                self._placement_cache.clear()
+            self._placement_cache[key] = got
+        return got
+
+    def _occupancy(self, grid: HostGrid, snapshot, pg_name: str,
+                   namespace: str, chips_needed: int):
+        """Returns (assigned, free, eligible) host-coord sets:
+
+        - assigned: hosts any gang sibling already occupies (assumed/bound);
+        - free: hosts a placement may CLAIM — no foreign TPU usage at all
+          (a placement owns the host's whole chip block; a single foreign
+          chip inside the slice breaks ICI exclusivity);
+        - eligible: hosts THIS pod may land on — no foreign usage and enough
+          chips left after siblings (covers sub-host pods packing a host)."""
+        assigned = set()
+        free = set()
+        eligible = set()
+        for node, coord in grid.coord_of.items():
+            info = snapshot.get(node)
+            if info is None:
+                continue
+            sibling_used = foreign_used = 0
+            has_sibling = False
+            for p in info.pods:
+                c, _, _, _ = pod_tpu_limits(p)
+                if (p.meta.labels.get(POD_GROUP_LABEL) == pg_name
+                        and p.meta.namespace == namespace):
+                    has_sibling = True
+                    sibling_used += c
+                else:
+                    foreign_used += c
+            if has_sibling:
+                assigned.add(coord)
+            if foreign_used:
+                continue
+            alloc = info.allocatable.get(TPU, 0)
+            if not has_sibling:
+                free.add(coord)
+            if alloc - sibling_used >= chips_needed:
+                eligible.add(coord)
+        return frozenset(assigned), frozenset(free), frozenset(eligible)
+
+    def _pool_utilization(self, grid: HostGrid, snapshot) -> float:
+        total = used = 0
+        for node in grid.coord_of:
+            info = snapshot.get(node)
+            if info is None:
+                continue
+            total += info.allocatable.get(TPU, 0)
+            used += sum(pod_tpu_limits(p)[0] for p in info.pods)
+        return used / total if total else 1.0
+
+    # -- Filter ---------------------------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        stash = state.try_read(_STATE_KEY)
+        if stash is None:
+            return Status.success()  # PreFilter skipped (non-slice pod)
+        if node_info.node.name not in stash.allowed:
+            return Status.unschedulable(
+                "node is not part of any feasible slice placement")
+        return Status.success()
+
+    # -- Score ----------------------------------------------------------------
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        stash = state.try_read(_STATE_KEY)
+        if stash is None:
+            return 0, Status.success()
+        entry = stash.allowed.get(node_name)
+        if entry is None:
+            return 0, Status.success()
+        _, membership, pool_util = entry
+        # corner-packing: fewest surviving placements wins
+        constraint = MAX_NODE_SCORE * (stash.max_membership - membership) \
+            // max(1, stash.max_membership)
+        strategy = self._strategy_score(pool_util)
+        return (constraint * 7 + strategy * 3) // 10, Status.success()
+
+    def _strategy_score(self, util: float) -> int:
+        """NRT scoring strategies over the pool 'zone'
+        (least_allocated.go:25-55, most_allocated.go:25-54,
+        balanced_allocation.go:28-55)."""
+        s = self.args.scoring_strategy
+        if s == "MostAllocated":
+            return int(util * MAX_NODE_SCORE)
+        if s == "BalancedAllocation":
+            return int((1.0 - abs(util - 0.5) * 2) * MAX_NODE_SCORE)
+        return int((1.0 - util) * MAX_NODE_SCORE)  # LeastAllocated default
+
+    # -- Reserve --------------------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        stash = state.try_read(_STATE_KEY)
+        if stash is None:
+            return Status.success()
+        entry = stash.allowed.get(node_name)
+        if entry is None:
+            return Status.unschedulable(
+                f"node {node_name} not in a feasible slice placement")
+        pool = entry[0]
+        topo = next((t for t in self.topo_informer.items()
+                     if t.spec.pool == pool), None)
+        if topo is None:
+            return Status.error(f"TpuTopology for pool {pool} vanished")
+        chip_coord = topo.spec.hosts.get(node_name)
+        if chip_coord is None:
+            return Status.error(f"node {node_name} missing from pool {pool}")
+        pod.meta.annotations[POOL_ANNOTATION] = pool
+        pod.meta.annotations[COORD_ANNOTATION] = format_coord(chip_coord)
+        klog.V(5).info_s("reserved slice coordinate", pod=pod.key,
+                         pool=pool, coord=pod.meta.annotations[COORD_ANNOTATION])
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pod.meta.annotations.pop(POOL_ANNOTATION, None)
+        pod.meta.annotations.pop(COORD_ANNOTATION, None)

@@ -1,10 +1,10 @@
 """Test scaffolding (reference analog: /root/reference/test/util +
 test/integration/utils.go builder wrappers)."""
 from .wrappers import (make_node, make_pod, make_pod_group, make_elastic_quota,
-                       make_tpu_node, make_resources)
+                       make_tpu_node, make_tpu_pool, make_resources)
 from .harness import new_test_framework
 from .cluster import TestCluster
 
 __all__ = ["make_node", "make_pod", "make_pod_group", "make_elastic_quota",
-           "make_tpu_node", "make_resources", "new_test_framework",
-           "TestCluster"]
+           "make_tpu_node", "make_tpu_pool", "make_resources",
+           "new_test_framework", "TestCluster"]

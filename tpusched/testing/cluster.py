@@ -69,10 +69,11 @@ class TestCluster:
     # -- assertions -----------------------------------------------------------
 
     def pod(self, key: str) -> Optional[Pod]:
-        return self.api.try_get(srv.PODS, key)
+        """Zero-copy read — treat the result as read-only."""
+        return self.api.peek(srv.PODS, key)
 
     def pod_scheduled(self, key: str) -> bool:
-        p = self.pod(key)
+        p = self.api.peek(srv.PODS, key)
         return p is not None and assigned(p)
 
     def wait_for_pods_scheduled(self, keys: List[str], timeout: float = 10.0,

@@ -46,6 +46,37 @@ def make_tpu_node(name: str, accelerator: str = "tpu-v5p", chips: int = 4,
     return make_node(name, cap, labels)
 
 
+def make_tpu_pool(pool: str, accelerator: str = "tpu-v5p",
+                  dims: Tuple[int, ...] = (4, 4, 4),
+                  wrap: Optional[Tuple[bool, ...]] = None,
+                  dcn_domain: str = ""):
+    """A whole node pool: the TpuTopology CR + one Node per host position.
+    dims are in CHIPS; hosts tile the torus at the accelerator's host extent
+    (2x2 on v5e, 2x2x1 on v5p)."""
+    import itertools
+    from ..api.topology import TpuTopology, TpuTopologySpec
+    from ..topology.torus import HOST_EXTENT
+    acc = ACCELERATORS[accelerator]
+    extent = HOST_EXTENT[accelerator]
+    hosts = {}
+    nodes = []
+    ranges = [range(0, d, e) for d, e in zip(dims, extent)]
+    for coord in itertools.product(*ranges):
+        name = f"{pool}-" + "-".join(str(c) for c in coord)
+        hosts[name] = tuple(coord)
+        nodes.append(make_tpu_node(name, accelerator, chips=acc.chips_per_host,
+                                   pool=pool, coord=tuple(coord),
+                                   dcn_domain=dcn_domain))
+    topo = TpuTopology(
+        meta=ObjectMeta(name=pool, namespace=""),
+        spec=TpuTopologySpec(pool=pool, accelerator=accelerator,
+                             dims=tuple(dims),
+                             wrap=tuple(wrap) if wrap else tuple(False for _ in dims),
+                             hosts=hosts, chips_per_host=acc.chips_per_host,
+                             dcn_domain=dcn_domain))
+    return topo, nodes
+
+
 def make_pod(name: str, namespace: str = "default",
              requests: Optional[ResourceList] = None,
              limits: Optional[ResourceList] = None,

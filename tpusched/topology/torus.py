@@ -1,0 +1,132 @@
+"""Slice-shape fitting on ICI tori.
+
+The reference's NUMA filter ANDs per-resource feasibility bitmasks over ≤8
+zones in one dimension (/root/reference/pkg/noderesourcetopology/filter.go:
+35-37,84-150). The TPU generalization (SURVEY §5, §7.5): a node pool is a 2-D
+(v5e) or 3-D (v5p) torus of chips; hosts own fixed sub-blocks (2x2 on v5e,
+2x2x1 on v5p — 4 chips); a job requests a chip-shape like 4x4x4 which must
+map onto a *contiguous free block* of the torus, modulo axis permutation,
+with wraparound only on axes the pool wraps.
+
+Everything here works in HOST units: chip shapes are converted via the
+accelerator's host extent, placements are host-coordinate sets.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ..api.topology import ACCELERATORS, TpuAccelerator, TpuTopologySpec
+
+# Host extents: how a host's chips are laid out in the torus.
+HOST_EXTENT = {
+    "tpu-v5e": (2, 2),      # 4 chips as a 2x2 tile of the 2-D torus
+    "tpu-v5p": (2, 2, 1),   # 4 chips as a 2x2x1 block of the 3-D torus
+}
+
+Coord = Tuple[int, ...]
+Placement = FrozenSet[Coord]   # set of host coords (host units)
+
+
+def validate_slice_shape(shape: Coord, acc: TpuAccelerator,
+                         pool_dims: Coord) -> Optional[str]:
+    """Returns an error string or None. Shape and pool dims are in chips."""
+    extent = HOST_EXTENT[acc.name]
+    if len(shape) != acc.ici_dims:
+        return (f"slice shape {shape} has {len(shape)} axes; "
+                f"{acc.name} torus has {acc.ici_dims}")
+    if len(pool_dims) != acc.ici_dims:
+        return f"pool dims {pool_dims} do not match {acc.name} torus rank"
+    for i, s in enumerate(shape):
+        if s <= 0 or s % extent[i]:
+            return (f"slice shape {shape} axis {i} must be a positive "
+                    f"multiple of the host extent {extent}")
+    if sorted_fit_impossible(shape, pool_dims):
+        return f"slice shape {shape} cannot fit pool dims {pool_dims} under any rotation"
+    return None
+
+
+def sorted_fit_impossible(shape: Coord, dims: Coord) -> bool:
+    return any(s > d for s, d in zip(sorted(shape), sorted(dims)))
+
+
+def host_block_shape(chip_shape: Coord, acc: TpuAccelerator) -> Coord:
+    """Chip shape → host-block shape, e.g. v5p 4x4x4 chips → 2x2x4 hosts."""
+    extent = HOST_EXTENT[acc.name]
+    return tuple(s // e for s, e in zip(chip_shape, extent))
+
+
+@dataclass
+class HostGrid:
+    """A pool's torus reduced to host units."""
+    pool: str
+    acc: TpuAccelerator
+    dims: Coord                       # host-unit dims per axis
+    wrap: Tuple[bool, ...]
+    node_of: Dict[Coord, str]         # host coord → node name
+    coord_of: Dict[str, Coord]        # node name → host coord
+
+    @classmethod
+    def from_spec(cls, spec: TpuTopologySpec) -> Optional["HostGrid"]:
+        acc = ACCELERATORS.get(spec.accelerator)
+        if acc is None or not spec.dims:
+            return None
+        extent = HOST_EXTENT[acc.name]
+        if len(spec.dims) != len(extent):
+            return None
+        dims = tuple(d // e for d, e in zip(spec.dims, extent))
+        wrap = tuple(spec.wrap) if spec.wrap else tuple(False for _ in dims)
+        node_of: Dict[Coord, str] = {}
+        coord_of: Dict[str, Coord] = {}
+        for node, chip_coord in spec.hosts.items():
+            hc = tuple(c // e for c, e in zip(chip_coord, extent))
+            node_of[hc] = node
+            coord_of[node] = hc
+        return cls(spec.pool, acc, dims, wrap, node_of, coord_of)
+
+
+def _distinct_permutations(shape: Coord) -> List[Coord]:
+    return list(dict.fromkeys(itertools.permutations(shape)))
+
+
+def enumerate_placements(grid: HostGrid, block: Coord) -> List[Placement]:
+    """All distinct host-sets where a block of host-shape `block` (any axis
+    permutation) can sit on the grid. Wraparound anchors are allowed only on
+    wrapped axes; a block spanning the full axis uses a single anchor."""
+    out: List[Placement] = []
+    seen = set()
+    rank = len(grid.dims)
+    for shape in _distinct_permutations(block):
+        if any(shape[i] > grid.dims[i] for i in range(rank)):
+            continue
+        anchor_ranges = []
+        for i in range(rank):
+            if shape[i] == grid.dims[i]:
+                anchor_ranges.append(range(1))
+            elif grid.wrap[i]:
+                anchor_ranges.append(range(grid.dims[i]))
+            else:
+                anchor_ranges.append(range(grid.dims[i] - shape[i] + 1))
+        offsets = list(itertools.product(*(range(s) for s in shape)))
+        for anchor in itertools.product(*anchor_ranges):
+            hosts = frozenset(
+                tuple((anchor[i] + off[i]) % grid.dims[i] for i in range(rank))
+                for off in offsets)
+            if hosts not in seen:
+                seen.add(hosts)
+                out.append(hosts)
+    return out
+
+
+def feasible_placements(placements: Sequence[Placement],
+                        assigned: FrozenSet[Coord],
+                        free: FrozenSet[Coord]) -> List[Placement]:
+    """Placements that contain every already-assigned gang host and whose
+    remaining hosts are all free — the incremental all-or-nothing constraint
+    each Filter call enforces."""
+    out = []
+    for p in placements:
+        if assigned <= p and (p - assigned) <= free:
+            out.append(p)
+    return out
