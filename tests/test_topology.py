@@ -35,35 +35,47 @@ def test_validate_slice_shape():
     assert validate_slice_shape((4, 4, 4), V5P, (4, 4, 8)) is None
     # wrong rank
     assert validate_slice_shape((4, 4), V5P, (4, 4, 8)) is not None
-    # not a multiple of host extent (2,2,1)
-    assert validate_slice_shape((3, 4, 4), V5P, (4, 4, 8)) is not None
+    # no rotation can divide the (2,2,1) host extent: two odd chip axes
+    assert validate_slice_shape((3, 3, 4), V5P, (4, 4, 8)) is not None
     # too big for the pool under any rotation
     assert validate_slice_shape((4, 4, 16), V5P, (4, 4, 8)) is not None
+    # fits ONLY under rotation (8 must land on the z axis)
+    assert validate_slice_shape((8, 4, 4), V5P, (4, 4, 8)) is None
 
 
 def test_enumerate_placements_counts():
     grid = grid_4x4x8()          # host grid 2x2x8, no wrap
-    # full-pool cross-section blocks: 2x2x4 hosts can slide along z: 5 anchors
-    ps = enumerate_placements(grid, (2, 2, 4))
+    # 4x4x4 chips → 2x2x4 hosts sliding along z: 5 anchors
+    ps = enumerate_placements(grid, (4, 4, 4))
     assert len(ps) == 5
     assert all(len(p) == 16 for p in ps)
-    # 1x1x8 spans z fully; 2x2 anchor positions in x,y = 4; plus permutations
-    # placing the long axis along x/y are impossible (dims 2,2) → exactly 4
-    ps = enumerate_placements(grid, (1, 1, 8))
+    # 2x2x8 chips → 1x1x8 hosts spanning z; 2x2 anchor positions in x,y = 4;
+    # rotations putting the long axis on x/y don't divide/fit → exactly 4
+    ps = enumerate_placements(grid, (2, 2, 8))
     assert len(ps) == 4
+
+
+def test_enumerate_placements_rotation_onto_anisotropic_extent():
+    """8x4x4 chips fits a 4x4x8 pool ONLY as the rotation 4x4x8 (the 8 must
+    land on the z axis whose host extent is 1) — regression for permuting
+    host blocks instead of chip shapes."""
+    grid = grid_4x4x8()
+    ps = enumerate_placements(grid, (8, 4, 4))
+    assert len(ps) == 1
+    assert len(ps[0]) == 32  # whole pool: 2x2x8 hosts
 
 
 def test_enumerate_placements_wraparound():
     topo, _ = make_tpu_pool("p", dims=(4, 4, 8), wrap=(False, False, True))
     grid = HostGrid.from_spec(topo.spec)
-    # with z wraparound a 2x2x4 host block can anchor at any of 8 z positions
-    ps = enumerate_placements(grid, (2, 2, 4))
+    # with z wraparound a 4x4x4-chip (2x2x4-host) block anchors at any z
+    ps = enumerate_placements(grid, (4, 4, 4))
     assert len(ps) == 8
 
 
 def test_feasible_placements_respects_assigned_and_free():
     grid = grid_4x4x8()
-    ps = enumerate_placements(grid, (2, 2, 4))
+    ps = enumerate_placements(grid, (4, 4, 4))
     all_hosts = frozenset(grid.node_of)
     # a blocker at z=3 kills every window containing it
     blocked = frozenset({(0, 0, 3)})

@@ -33,6 +33,23 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
     )
 
 
+def capacity_profile(scheduler_name: str = "tpusched") -> PluginProfile:
+    """ElasticQuota capacity sharing + quota-aware preemption over TPU
+    placement (mirrors manifests/capacityscheduling/scheduler-config wiring:
+    preFilter/postFilter/reserve)."""
+    return PluginProfile(
+        scheduler_name=scheduler_name,
+        queue_sort="PrioritySort",
+        pre_filter=["CapacityScheduling"],
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["CapacityScheduling"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice", "CapacityScheduling"],
+        bind=["TpuSlice"],
+    )
+
+
 def tpuslice_profile(scheduler_name: str = "tpusched") -> PluginProfile:
     """TpuSlice-only wiring (the flexgpu Helm chart analog)."""
     return PluginProfile(

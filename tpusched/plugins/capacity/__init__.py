@@ -1,0 +1,4 @@
+from .plugin import CapacityScheduling
+from .elasticquota_info import ElasticQuotaInfo, ElasticQuotaInfos
+
+__all__ = ["CapacityScheduling", "ElasticQuotaInfo", "ElasticQuotaInfos"]

@@ -1,0 +1,99 @@
+"""In-memory ElasticQuota accounting.
+
+Rebuild of /root/reference/pkg/capacityscheduling/elasticquota.go: per-
+namespace {Min, Max, Used, pods} (:55-61), reserve/unreserve (:74-88),
+bound comparisons via cmp2 (:90-100,165-181), aggregate borrow check
+(:40-51), idempotent add/delete by pod key (:127-159), deep clone (:102-125).
+
+Comparison semantics (cmp2): only resources *named by the bound* are
+compared — Max omitting a resource means unlimited, Min omitting one means
+no guarantee.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Set
+
+from ...api.core import Pod
+from ...api.resources import ResourceList, add_resources
+from ...util.podutil import pod_effective_request
+
+
+def _over(used: ResourceList, delta: Optional[ResourceList],
+          bound: ResourceList) -> bool:
+    """any resource named in `bound` with used+delta > bound."""
+    for k, b in bound.items():
+        v = used.get(k, 0) + (delta.get(k, 0) if delta else 0)
+        if v > b:
+            return True
+    return False
+
+
+class ElasticQuotaInfo:
+    __slots__ = ("namespace", "min", "max", "used", "pods")
+
+    def __init__(self, namespace: str, min: Optional[ResourceList] = None,
+                 max: Optional[ResourceList] = None,
+                 used: Optional[ResourceList] = None,
+                 pods: Optional[Set[str]] = None):
+        self.namespace = namespace
+        self.min: ResourceList = dict(min or {})
+        self.max: ResourceList = dict(max or {})
+        self.used: ResourceList = dict(used or {})
+        self.pods: Set[str] = set(pods or ())
+
+    # -- accounting -----------------------------------------------------------
+
+    def reserve_resource(self, req: ResourceList) -> None:
+        for k, v in req.items():
+            self.used[k] = self.used.get(k, 0) + v
+
+    def unreserve_resource(self, req: ResourceList) -> None:
+        for k, v in req.items():
+            self.used[k] = self.used.get(k, 0) - v
+
+    def add_pod_if_not_present(self, pod: Pod) -> None:
+        if pod.key in self.pods:
+            return
+        self.pods.add(pod.key)
+        self.reserve_resource(pod_effective_request(pod))
+
+    def delete_pod_if_present(self, pod: Pod) -> None:
+        if pod.key not in self.pods:
+            return
+        self.pods.discard(pod.key)
+        self.unreserve_resource(pod_effective_request(pod))
+
+    # -- comparisons ----------------------------------------------------------
+
+    def used_over_min_with(self, req: Optional[ResourceList] = None) -> bool:
+        return _over(self.used, req, self.min)
+
+    def used_over_max_with(self, req: Optional[ResourceList] = None) -> bool:
+        return _over(self.used, req, self.max)
+
+    def used_over_min(self) -> bool:
+        return self.used_over_min_with(None)
+
+    def clone(self) -> "ElasticQuotaInfo":
+        return ElasticQuotaInfo(self.namespace, self.min, self.max, self.used,
+                                self.pods)
+
+
+class ElasticQuotaInfos(dict):
+    """namespace → ElasticQuotaInfo (elasticquota.go:26)."""
+
+    def aggregated_used_over_min_with(self, req: ResourceList) -> bool:
+        """Σ used + req > Σ min for any resource named by some Min — the
+        global borrow gate (elasticquota.go:40-51)."""
+        total_used: ResourceList = {}
+        total_min: ResourceList = {}
+        for info in self.values():
+            total_used = add_resources(total_used, info.used)
+            total_min = add_resources(total_min, info.min)
+        return _over(total_used, req, total_min)
+
+    def clone(self) -> "ElasticQuotaInfos":
+        out = ElasticQuotaInfos()
+        for ns, info in self.items():
+            out[ns] = info.clone()
+        return out

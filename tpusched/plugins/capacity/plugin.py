@@ -1,0 +1,375 @@
+"""CapacityScheduling plugin: ElasticQuota min/max with borrowing and
+quota-aware preemption.
+
+Rebuild of /root/reference/pkg/capacityscheduling/capacity_scheduling.go:
+- PreFilter snapshots all quota state into CycleState and rejects if
+  used+pod > max, or the aggregate used would exceed Σmin, with
+  nominated-pod accounting (:201-275);
+- PreFilterExtensions Add/RemovePod keep the snapshot consistent during
+  preemption dry-runs (:283-318);
+- PostFilter runs the preemption Evaluator with quota-aware victim selection
+  (:320-338, :465-644): borrowing semantics — if the preemptor's quota would
+  stay within min, victims come from OTHER quotas that are over min
+  (borrowers); otherwise from the SAME quota at lower priority;
+- Reserve/Unreserve maintain live Used (:340-366);
+- informer handlers mirror EQ CRs and assigned pods into memory (:646-751).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ...api.core import Pod, PodDisruptionBudget
+from ...api.resources import ResourceList
+from ...api.scheduling import ElasticQuota
+from ...fwk import CycleState, Status
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+                               EVENT_DELETE, EVENT_UPDATE, PostFilterPlugin,
+                               PostFilterResult, PreFilterExtensions,
+                               PreFilterPlugin, ReservePlugin,
+                               RESOURCE_ELASTIC_QUOTA, RESOURCE_POD)
+from ...fwk.nodeinfo import NodeInfo
+from ...sched.preemption import (Evaluator, PreemptionInterface,
+                                 dry_run_add, dry_run_remove,
+                                 filter_pods_with_pdb_violation,
+                                 more_important_pod)
+from ...util import klog
+from ...util.podutil import assigned, is_pod_terminated, pod_effective_request
+from .elasticquota_info import ElasticQuotaInfo, ElasticQuotaInfos
+
+EQ_SNAPSHOT_KEY = "CapacityScheduling/elasticQuotaSnapshot"
+PRE_FILTER_STATE_KEY = "CapacityScheduling/preFilterState"
+
+
+class _EQSnapshot:
+    def __init__(self, infos: ElasticQuotaInfos):
+        self.infos = infos
+
+    def clone(self):
+        return _EQSnapshot(self.infos.clone())
+
+
+class _PreFilterState:
+    def __init__(self, pod_req: ResourceList,
+                 nominated_in_eq_with_req: ResourceList,
+                 nominated_with_req: ResourceList):
+        self.pod_req = pod_req
+        self.nominated_in_eq_with_req = nominated_in_eq_with_req
+        self.nominated_with_req = nominated_with_req
+
+    def clone(self):
+        return self
+
+
+class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
+                         EnqueueExtensions):
+    NAME = "CapacityScheduling"
+
+    def __init__(self, args, handle):
+        self.handle = handle
+        self._lock = threading.RLock()
+        self.eq_infos = ElasticQuotaInfos()
+        eq_informer = handle.informer_factory.elasticquotas()
+        pod_informer = handle.informer_factory.pods()
+        eq_informer.add_event_handler(on_add=self._eq_added,
+                                      on_update=self._eq_updated,
+                                      on_delete=self._eq_deleted)
+        pod_informer.add_event_handler(on_add=self._pod_added,
+                                       on_update=self._pod_updated,
+                                       on_delete=self._pod_deleted)
+
+    @classmethod
+    def new(cls, args, handle) -> "CapacityScheduling":
+        return cls(args, handle)
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(RESOURCE_POD, EVENT_DELETE),
+                ClusterEvent(RESOURCE_ELASTIC_QUOTA,
+                             EVENT_ADD | EVENT_UPDATE | EVENT_DELETE),
+                ClusterEvent("Node", EVENT_ADD | EVENT_UPDATE)]
+
+    # -- informer mirror (capacity_scheduling.go:646-751) ---------------------
+
+    def _eq_added(self, eq: ElasticQuota) -> None:
+        with self._lock:
+            info = self.eq_infos.get(eq.meta.namespace)
+            if info is None:
+                info = ElasticQuotaInfo(eq.meta.namespace)
+                self.eq_infos[eq.meta.namespace] = info
+            info.min = dict(eq.spec.min)
+            info.max = dict(eq.spec.max)
+
+    def _eq_updated(self, old: ElasticQuota, new: ElasticQuota) -> None:
+        self._eq_added(new)
+
+    def _eq_deleted(self, eq: ElasticQuota) -> None:
+        with self._lock:
+            self.eq_infos.pop(eq.meta.namespace, None)
+
+    def _pod_added(self, pod: Pod) -> None:
+        if not assigned(pod) or is_pod_terminated(pod):
+            return
+        with self._lock:
+            info = self.eq_infos.get(pod.namespace)
+            if info is not None:
+                info.add_pod_if_not_present(pod)
+
+    def _pod_updated(self, old: Pod, new: Pod) -> None:
+        if assigned(new) and not is_pod_terminated(new):
+            self._pod_added(new)
+        else:
+            self._pod_deleted(new)
+
+    def _pod_deleted(self, pod: Pod) -> None:
+        with self._lock:
+            info = self.eq_infos.get(pod.namespace)
+            if info is not None:
+                info.delete_pod_if_present(pod)
+
+    # -- PreFilter ------------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        with self._lock:
+            snapshot = _EQSnapshot(self.eq_infos.clone())
+        state.write(EQ_SNAPSHOT_KEY, snapshot)
+        pod_req = pod_effective_request(pod)
+
+        eq = snapshot.infos.get(pod.namespace)
+        if eq is None:
+            state.write(PRE_FILTER_STATE_KEY,
+                        _PreFilterState(pod_req, dict(pod_req), dict(pod_req)))
+            return Status.success()
+
+        # nominated-pod accounting (:218-257): reqs of nominated pods that
+        # would consume this quota (same ns, ≥ priority) or global min spare
+        # (other ns, quota not over min)
+        in_eq: ResourceList = dict(pod_req)
+        total: ResourceList = dict(pod_req)
+        for info in self.handle.snapshot_shared_lister().list():
+            for np in self.handle.pod_nominator.nominated_pods_for_node(
+                    info.node.name):
+                if np.meta.uid == pod.meta.uid:
+                    continue
+                np_info = snapshot.infos.get(np.namespace)
+                if np_info is None:
+                    continue
+                np_req = pod_effective_request(np)
+                if np.namespace == pod.namespace and np.priority >= pod.priority:
+                    for k, v in np_req.items():
+                        in_eq[k] = in_eq.get(k, 0) + v
+                        total[k] = total.get(k, 0) + v
+                elif np.namespace != pod.namespace and not np_info.used_over_min():
+                    for k, v in np_req.items():
+                        total[k] = total.get(k, 0) + v
+
+        state.write(PRE_FILTER_STATE_KEY, _PreFilterState(pod_req, in_eq, total))
+
+        if eq.used_over_max_with(in_eq):
+            return Status.unschedulable(
+                f"Pod {pod.key} is rejected in PreFilter because ElasticQuota "
+                f"{eq.namespace} is more than Max")
+        if snapshot.infos.aggregated_used_over_min_with(total):
+            return Status.unschedulable(
+                f"Pod {pod.key} is rejected in PreFilter because total "
+                f"ElasticQuota used is more than min")
+        return Status.success()
+
+    def pre_filter_extensions(self) -> Optional[PreFilterExtensions]:
+        return _Extensions()
+
+    # -- PostFilter (preemption) ----------------------------------------------
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        evaluator = Evaluator(self.NAME, self.handle, state,
+                              _Preemptor(self.handle, state))
+        result, status = evaluator.preempt(pod, filtered_node_status_map)
+        if result is None:
+            return None, status
+        return result, status
+
+    # -- Reserve --------------------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        with self._lock:
+            info = self.eq_infos.get(pod.namespace)
+            if info is not None:
+                info.add_pod_if_not_present(pod)
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        with self._lock:
+            info = self.eq_infos.get(pod.namespace)
+            if info is not None:
+                info.delete_pod_if_present(pod)
+
+
+class _Extensions(PreFilterExtensions):
+    """AddPod/RemovePod keep the per-cycle EQ snapshot consistent during
+    preemption dry-runs (:283-318)."""
+
+    def add_pod(self, state: CycleState, pod_to_schedule: Pod,
+                pod_to_add: Pod, node_info: NodeInfo) -> Status:
+        snap = state.try_read(EQ_SNAPSHOT_KEY)
+        if snap is not None:
+            info = snap.infos.get(pod_to_add.namespace)
+            if info is not None:
+                info.add_pod_if_not_present(pod_to_add)
+        return Status.success()
+
+    def remove_pod(self, state: CycleState, pod_to_schedule: Pod,
+                   pod_to_remove: Pod, node_info: NodeInfo) -> Status:
+        snap = state.try_read(EQ_SNAPSHOT_KEY)
+        if snap is not None:
+            info = snap.infos.get(pod_to_remove.namespace)
+            if info is not None:
+                info.delete_pod_if_present(pod_to_remove)
+        return Status.success()
+
+
+class _Preemptor(PreemptionInterface):
+    """Quota-aware victim selection (:391-644)."""
+
+    def __init__(self, handle, state: CycleState):
+        self.handle = handle
+        self.state = state
+
+    def pod_eligible_to_preempt_others(self, pod: Pod,
+                                       nominated_node_status: Optional[Status]) -> bool:
+        # PreemptNever pods never preempt (:392-396)
+        pc = None
+        if pod.spec.priority_class_name:
+            pc = self.handle.clientset.priorityclasses.try_get(
+                "/" + pod.spec.priority_class_name)
+        if pc is not None and pc.preemption_policy == "Never":
+            return False
+        nom = pod.status.nominated_node_name
+        if not nom:
+            return True
+        from ...fwk.status import UNSCHEDULABLE_AND_UNRESOLVABLE
+        if (nominated_node_status is not None
+                and nominated_node_status.code == UNSCHEDULABLE_AND_UNRESOLVABLE):
+            return True
+        # terminating-victim check (:427-460): if a terminating pod on the
+        # nominated node would release room the preemptor can claim, wait
+        info = self.handle.snapshot_shared_lister().get(nom)
+        if info is None:
+            return True
+        snap = self.state.try_read(EQ_SNAPSHOT_KEY)
+        pfs = self.state.try_read(PRE_FILTER_STATE_KEY)
+        eq = snap.infos.get(pod.namespace) if snap else None
+        if eq is not None and pfs is not None:
+            more_than_min = eq.used_over_min_with(pfs.nominated_in_eq_with_req)
+            for p in info.pods:
+                if not p.is_terminating():
+                    continue
+                p_eq = snap.infos.get(p.namespace) if snap else None
+                if p_eq is None:
+                    continue
+                if p.namespace == pod.namespace and p.priority < pod.priority:
+                    return False
+                if (p.namespace != pod.namespace and not more_than_min
+                        and p_eq.used_over_min()):
+                    return False
+        else:
+            for p in info.pods:
+                if snap and snap.infos.get(p.namespace) is not None:
+                    continue
+                if p.is_terminating() and p.priority < pod.priority:
+                    return False
+        return True
+
+    def select_victims_on_node(self, state: CycleState, pod: Pod,
+                               node_info: NodeInfo,
+                               pdbs: List[PodDisruptionBudget]
+                               ) -> Tuple[List[Pod], int, Status]:
+        snap = state.try_read(EQ_SNAPSHOT_KEY)
+        pfs = state.try_read(PRE_FILTER_STATE_KEY)
+        if snap is None or pfs is None:
+            return [], 0, Status.unschedulable("missing capacity cycle state")
+        infos = snap.infos
+        eq = infos.get(pod.namespace)
+
+        potential: List[Pod] = []
+
+        def remove(v: Pod) -> Optional[Status]:
+            return dry_run_remove(self.handle, state, pod, v, node_info)
+
+        if eq is not None:
+            more_than_min = eq.used_over_min_with(pfs.nominated_in_eq_with_req)
+            for p in list(node_info.pods):
+                p_eq = infos.get(p.namespace)
+                if p_eq is None:
+                    continue
+                if more_than_min:
+                    # preemptor exceeds its own min ⇒ reclaim only inside its
+                    # quota, from lower-priority pods (:526-538)
+                    if p.namespace == pod.namespace and p.priority < pod.priority:
+                        potential.append(p)
+                        err = remove(p)
+                        if err:
+                            return [], 0, err
+                else:
+                    # preemptor within min ⇒ its guarantee is borrowed; evict
+                    # borrowers: other quotas currently over min (:539-553)
+                    if p.namespace != pod.namespace and p_eq.used_over_min():
+                        potential.append(p)
+                        err = remove(p)
+                        if err:
+                            return [], 0, err
+        else:
+            for p in list(node_info.pods):
+                if infos.get(p.namespace) is not None:
+                    continue
+                if p.priority < pod.priority:
+                    potential.append(p)
+                    err = remove(p)
+                    if err:
+                        return [], 0, err
+
+        if not potential:
+            return [], 0, Status.unresolvable(
+                f"No victims found on node {node_info.node.name} "
+                f"for preemptor pod {pod.name}")
+
+        s = self.handle.run_filter_plugins_with_nominated_pods(state, pod, node_info)
+        if not s.is_success():
+            return [], 0, s
+
+        if eq is not None:
+            if (eq.used_over_max_with(pfs.pod_req)
+                    or infos.aggregated_used_over_min_with(pfs.pod_req)):
+                return [], 0, Status.unschedulable("global quota max exceeded")
+
+        victims: List[Pod] = []
+        num_violating = 0
+        potential.sort(key=lambda p: (-p.priority,
+                                      p.status.start_time or p.meta.creation_timestamp))
+        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+
+        def reprieve(p: Pod) -> bool:
+            err = dry_run_add(self.handle, state, pod, p, node_info)
+            if err:
+                raise RuntimeError(err.message())
+            fits = self.handle.run_filter_plugins_with_nominated_pods(
+                state, pod, node_info).is_success()
+            quota_broken = eq is not None and (
+                eq.used_over_max_with(pfs.nominated_in_eq_with_req)
+                or infos.aggregated_used_over_min_with(pfs.nominated_with_req))
+            if not fits or quota_broken:
+                err = dry_run_remove(self.handle, state, pod, p, node_info)
+                if err:
+                    raise RuntimeError(err.message())
+                victims.append(p)
+                return fits and not quota_broken
+            return True
+
+        try:
+            for p in violating:
+                if not reprieve(p):
+                    num_violating += 1
+            for p in non_violating:
+                reprieve(p)
+        except RuntimeError as e:
+            return [], 0, Status.error(str(e))
+        return victims, num_violating, Status.success()

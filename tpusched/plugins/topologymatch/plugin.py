@@ -40,8 +40,7 @@ from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
                                RESOURCE_POD_GROUP, RESOURCE_TPU_TOPOLOGY)
 from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
 from ...topology.torus import (HostGrid, enumerate_placements,
-                               feasible_placements, host_block_shape,
-                               validate_slice_shape)
+                               feasible_placements, validate_slice_shape)
 from ...util import klog
 from ..tpuslice.chip_node import pod_tpu_limits
 
@@ -121,6 +120,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         any_pool = False
 
         candidates = []
+        any_valid_pool = False
         for topo in self.topo_informer.items():
             spec = topo.spec
             if want_acc and spec.accelerator != want_acc:
@@ -136,6 +136,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
             grid = self._grid(topo)
             if grid is None:
                 continue
+            any_valid_pool = True
             occ = self._occupancy(grid, snapshot, pg.meta.name, pod.namespace,
                                   chips_needed if chips_needed is not None
                                   else acc.chips_per_host)
@@ -148,13 +149,11 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         if pinned:
             candidates = pinned
 
-        for topo, acc, grid, (assigned, free, eligible) in candidates:
-            block = host_block_shape(shape, acc)
-            placements = self._placements(topo, grid, block)
+        for topo, acc, grid, (assigned, free, eligible, pool_util) in candidates:
+            placements = self._placements(topo, grid, shape)
             survivors = feasible_placements(placements, assigned, free)
             if not survivors:
                 continue
-            pool_util = self._pool_utilization(grid, snapshot)
             membership: Dict[str, int] = {}
             for p in survivors:
                 for coord in p:
@@ -172,7 +171,9 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
                 return Status.unresolvable(
                     f"no TpuTopology pool matches accelerator "
                     f"{want_acc or '(any)'}")
-            if validation_errors:
+            # only permanent if EVERY matching pool failed validation; a
+            # transiently-full valid pool keeps the pod retriable
+            if validation_errors and not any_valid_pool:
                 return Status.unresolvable("; ".join(validation_errors))
             return Status.unschedulable(
                 f"no feasible {pg.spec.tpu_slice_shape} slice placement "
@@ -191,11 +192,11 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
                 self._grid_cache[key] = grid
         return grid
 
-    def _placements(self, topo, grid: HostGrid, block) -> list:
-        key = (topo.key, topo.meta.resource_version, tuple(block))
+    def _placements(self, topo, grid: HostGrid, chip_shape) -> list:
+        key = (topo.key, topo.meta.resource_version, tuple(chip_shape))
         got = self._placement_cache.get(key)
         if got is None:
-            got = enumerate_placements(grid, block)
+            got = enumerate_placements(grid, chip_shape)
             if len(self._placement_cache) > 64:
                 self._placement_cache.clear()
             self._placement_cache[key] = got
@@ -203,17 +204,20 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
 
     def _occupancy(self, grid: HostGrid, snapshot, pg_name: str,
                    namespace: str, chips_needed: int):
-        """Returns (assigned, free, eligible) host-coord sets:
+        """Returns (assigned, free, eligible, pool_utilization):
 
         - assigned: hosts any gang sibling already occupies (assumed/bound);
         - free: hosts a placement may CLAIM — no foreign TPU usage at all
           (a placement owns the host's whole chip block; a single foreign
           chip inside the slice breaks ICI exclusivity);
         - eligible: hosts THIS pod may land on — no foreign usage and enough
-          chips left after siblings (covers sub-host pods packing a host)."""
+          chips left after siblings (covers sub-host pods packing a host);
+        - pool_utilization: used/allocatable chips (for the score strategy),
+          computed in the same walk."""
         assigned = set()
         free = set()
         eligible = set()
+        total_alloc = total_used = 0
         for node, coord in grid.coord_of.items():
             info = snapshot.get(node)
             if info is None:
@@ -228,26 +232,19 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
                     sibling_used += c
                 else:
                     foreign_used += c
+            alloc = info.allocatable.get(TPU, 0)
+            total_alloc += alloc
+            total_used += sibling_used + foreign_used
             if has_sibling:
                 assigned.add(coord)
             if foreign_used:
                 continue
-            alloc = info.allocatable.get(TPU, 0)
             if not has_sibling:
                 free.add(coord)
             if alloc - sibling_used >= chips_needed:
                 eligible.add(coord)
-        return frozenset(assigned), frozenset(free), frozenset(eligible)
-
-    def _pool_utilization(self, grid: HostGrid, snapshot) -> float:
-        total = used = 0
-        for node in grid.coord_of:
-            info = snapshot.get(node)
-            if info is None:
-                continue
-            total += info.allocatable.get(TPU, 0)
-            used += sum(pod_tpu_limits(p)[0] for p in info.pods)
-        return used / total if total else 1.0
+        util = total_used / total_alloc if total_alloc else 1.0
+        return frozenset(assigned), frozenset(free), frozenset(eligible), util
 
     # -- Filter ---------------------------------------------------------------
 

@@ -1,0 +1,193 @@
+"""Preemption evaluator — rebuild of the vendored upstream
+k8s.io/kubernetes/pkg/scheduler/framework/preemption the reference's
+CapacityScheduling and PreemptionToleration plug into (SURVEY §3.3).
+
+Flow (preemption.Evaluator.Preempt):
+1. re-fetch the preemptor; plugin-specific PodEligibleToPreemptOthers;
+2. dry-run candidates on every node the filters called Unschedulable (not
+   Unresolvable): clone CycleState + NodeInfo, plugin SelectVictimsOnNode;
+3. pick the best candidate (fewest PDB violations → lowest max victim
+   priority → lowest priority sum → fewest victims → name);
+4. prepare: delete victims (rejecting waiting ones), clear lower-priority
+   nominations on the node;
+5. return the nominated node.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.core import Pod, PodDisruptionBudget
+from ..apiserver import server as srv
+from ..fwk import CycleState, Status
+from ..fwk.interfaces import PostFilterResult
+from ..fwk.nodeinfo import NodeInfo
+from ..fwk.status import UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE
+from ..util import klog
+from ..util.metrics import preemption_attempts
+
+
+def more_important_pod(p1: Pod, p2: Pod) -> bool:
+    """upstream schedutil.MoreImportantPod: higher priority, then earlier
+    start time."""
+    if p1.priority != p2.priority:
+        return p1.priority > p2.priority
+    t1 = p1.status.start_time or p1.meta.creation_timestamp
+    t2 = p2.status.start_time or p2.meta.creation_timestamp
+    return t1 < t2
+
+
+def filter_pods_with_pdb_violation(pods: List[Pod],
+                                   pdbs: List[PodDisruptionBudget]
+                                   ) -> Tuple[List[Pod], List[Pod]]:
+    """Split into (violating, non-violating). A pod violates if some matching
+    PDB has no disruptions left (capacity_scheduling.go:857-902)."""
+    violating, ok = [], []
+    disruptions = {pdb.meta.key: pdb.disruptions_allowed for pdb in pdbs}
+    for pod in pods:
+        hit = False
+        for pdb in pdbs:
+            if pdb.matches(pod):
+                if disruptions.get(pdb.meta.key, 0) <= 0:
+                    hit = True
+                else:
+                    disruptions[pdb.meta.key] -= 1
+        (violating if hit else ok).append(pod)
+    return violating, ok
+
+
+class Candidate:
+    __slots__ = ("node_name", "victims", "num_pdb_violations")
+
+    def __init__(self, node_name: str, victims: List[Pod], num_pdb_violations: int):
+        self.node_name = node_name
+        self.victims = victims
+        self.num_pdb_violations = num_pdb_violations
+
+
+class PreemptionInterface:
+    """The plugin-provided policy (upstream preemption.Interface)."""
+
+    def pod_eligible_to_preempt_others(self, pod: Pod,
+                                       nominated_node_status: Optional[Status]) -> bool:
+        return True
+
+    def select_victims_on_node(self, state: CycleState, pod: Pod,
+                               node_info: NodeInfo,
+                               pdbs: List[PodDisruptionBudget]
+                               ) -> Tuple[List[Pod], int, Status]:
+        raise NotImplementedError
+
+
+class Evaluator:
+    def __init__(self, plugin_name: str, handle, state: CycleState,
+                 interface: PreemptionInterface):
+        self.plugin_name = plugin_name
+        self.handle = handle
+        self.state = state
+        self.interface = interface
+
+    # -- main entry -----------------------------------------------------------
+
+    def preempt(self, pod: Pod, diagnosis: Dict[str, Status]
+                ) -> Tuple[Optional[PostFilterResult], Status]:
+        preemption_attempts.inc()
+        live = self.handle.clientset.pods.try_get(pod.key)
+        if live is None:
+            return None, Status.unschedulable(f"pod {pod.key} not found")
+        pod = live
+
+        nominated_status = diagnosis.get(pod.status.nominated_node_name)
+        if not self.interface.pod_eligible_to_preempt_others(pod, nominated_status):
+            return None, Status.unschedulable(
+                f"pod {pod.key} is not eligible for preemption")
+
+        candidates = self._find_candidates(pod, diagnosis)
+        if not candidates:
+            return None, Status.unschedulable(
+                "preemption: 0/%d nodes are available" % max(1, len(diagnosis)))
+
+        best = self._select_candidate(candidates)
+        status = self._prepare_candidate(best, pod)
+        if not status.is_success():
+            return None, status
+        return PostFilterResult(nominated_node_name=best.node_name), Status.success()
+
+    # -- candidate search -----------------------------------------------------
+
+    def _find_candidates(self, pod: Pod,
+                         diagnosis: Dict[str, Status]) -> List[Candidate]:
+        snapshot = self.handle.snapshot_shared_lister()
+        pdbs = self.handle.clientset.pdbs.list()
+        candidates: List[Candidate] = []
+        for node_name, st in diagnosis.items():
+            # preemption cannot resolve Unresolvable rejections
+            if st.code != UNSCHEDULABLE:
+                continue
+            info = snapshot.get(node_name)
+            if info is None or info.node is None:
+                continue
+            state_copy = self.state.clone()
+            info_copy = info.clone()
+            victims, violations, vs = self.interface.select_victims_on_node(
+                state_copy, pod, info_copy, pdbs)
+            if vs.is_success() and victims:
+                candidates.append(Candidate(node_name, victims, violations))
+        return candidates
+
+    def _select_candidate(self, candidates: List[Candidate]) -> Candidate:
+        """upstream pickOneNodeForPreemption ordering."""
+        def key(c: Candidate):
+            max_prio = max((v.priority for v in c.victims), default=0)
+            sum_prio = sum(v.priority for v in c.victims)
+            return (c.num_pdb_violations, max_prio, sum_prio,
+                    len(c.victims), c.node_name)
+        return min(candidates, key=key)
+
+    # -- execution ------------------------------------------------------------
+
+    def _prepare_candidate(self, candidate: Candidate, pod: Pod) -> Status:
+        cs = self.handle.clientset
+        for victim in candidate.victims:
+            # a waiting gang member is rejected in place; others are deleted
+            if self.handle.reject_waiting_pod(
+                    victim.meta.uid, self.plugin_name,
+                    f"preempted by {pod.key}"):
+                klog.V(3).info_s("rejected waiting victim", victim=victim.key)
+            else:
+                try:
+                    cs.pods.delete(victim.key)
+                except srv.NotFound:
+                    pass
+            cs.record_event(victim.key, "Pod", "Normal", "Preempted",
+                            f"Preempted by {pod.key} on node {candidate.node_name}")
+            klog.V(3).info_s("preempted victim", victim=victim.key,
+                             node=candidate.node_name, preemptor=pod.key)
+        # lower-priority nominated pods on this node lose their nomination
+        for np in self.handle.pod_nominator.nominated_pods_for_node(candidate.node_name):
+            if np.priority < pod.priority:
+                self.handle.pod_nominator.delete_nominated_pod_if_exists(np)
+                try:
+                    cs.pods.patch(np.key, lambda p: setattr(
+                        p.status, "nominated_node_name", ""))
+                except srv.NotFound:
+                    pass
+        return Status.success()
+
+
+# -- shared victim-selection helpers (used by plugin Interfaces) --------------
+
+def dry_run_remove(handle, state: CycleState, preemptor: Pod, victim: Pod,
+                   node_info: NodeInfo) -> Optional[Status]:
+    if not node_info.remove_pod(victim):
+        return Status.error(f"victim {victim.key} not on node")
+    s = handle.framework.run_pre_filter_extension_remove_pod(
+        state, preemptor, victim, node_info)
+    return None if s.is_success() else s
+
+
+def dry_run_add(handle, state: CycleState, preemptor: Pod, victim: Pod,
+                node_info: NodeInfo) -> Optional[Status]:
+    node_info.add_pod(victim)
+    s = handle.framework.run_pre_filter_extension_add_pod(
+        state, preemptor, victim, node_info)
+    return None if s.is_success() else s

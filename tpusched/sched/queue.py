@@ -156,10 +156,27 @@ class SchedulingQueue:
             info.timestamp = self._clock()
             self._unschedulable[key] = info
 
-    def requeue_after_failure(self, info: QueuedPodInfo) -> None:
+    def requeue_after_failure(self, info: QueuedPodInfo,
+                              to_backoff: bool = False) -> None:
         """After a failed attempt: park in unschedulableQ; cluster events (or
         the periodic flush) move it back through backoff. `attempts` was
-        already incremented by pop()."""
+        already incremented by pop().
+
+        to_backoff=True short-circuits straight to backoffQ — used for pods
+        that just won preemption (nominated node set): their victim-delete
+        events fired synchronously inside their own cycle, before parking, so
+        no later event would unstick them."""
+        if to_backoff:
+            with self._lock:
+                key = info.pod.key
+                if key in self._active or key in self._unschedulable:
+                    return
+                info.timestamp = self._clock()
+                expiry = info.timestamp + info.backoff_duration()
+                heapq.heappush(self._backoff,
+                               (expiry, next(self._backoff_seq), info))
+                self._lock.notify_all()
+            return
         self.add_unschedulable_if_not_present(info)
 
     # -- activation / moves ---------------------------------------------------

@@ -108,7 +108,12 @@ class Scheduler:
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
-        self.handle.pod_nominator.update_nominated_pod(old, new)
+        if assigned(new):
+            # a bound pod is no longer a nominated (in-flight preemptor) —
+            # leaving it nominated would double-count it against quotas
+            self.handle.pod_nominator.delete_nominated_pod_if_exists(new)
+        else:
+            self.handle.pod_nominator.update_nominated_pod(old, new)
         if assigned(new):
             if not assigned(old):
                 # our own bind confirmation (or an external bind)
@@ -317,7 +322,8 @@ class Scheduler:
         if live is None or assigned(live):
             return
         info.pod = live
-        self.queue.requeue_after_failure(info)
+        self.queue.requeue_after_failure(
+            info, to_backoff=bool(live.status.nominated_node_name))
         klog.V(5).info_s("pod unschedulable", pod=pod.key,
                          reason=status.message(), plugin=status.plugin)
 

@@ -1,7 +1,7 @@
 """ICI-torus topology engine (TPU-native successor of the reference's NUMA
 bitmask fitting, /root/reference/pkg/noderesourcetopology/filter.go:84-150)."""
-from .torus import (HostGrid, enumerate_placements, host_block_shape,
-                    validate_slice_shape)
+from .torus import (HostGrid, candidate_host_blocks, enumerate_placements,
+                    host_block_shape, validate_slice_shape)
 
-__all__ = ["HostGrid", "enumerate_placements", "host_block_shape",
-           "validate_slice_shape"]
+__all__ = ["HostGrid", "candidate_host_blocks", "enumerate_placements",
+           "host_block_shape", "validate_slice_shape"]

@@ -29,6 +29,24 @@ Coord = Tuple[int, ...]
 Placement = FrozenSet[Coord]   # set of host coords (host units)
 
 
+def candidate_host_blocks(chip_shape: Coord, acc: TpuAccelerator,
+                          host_dims: Coord) -> List[Coord]:
+    """All host-block shapes realizable by rotating `chip_shape` onto the
+    torus. Rotation happens on the CHIP shape FIRST; each rotated axis must
+    then divide the (anisotropic) host extent on the torus axis it lands on —
+    permuting after division is wrong on v5p's (2,2,1) extent (it both misses
+    feasible rotations and fabricates non-rotations)."""
+    extent = HOST_EXTENT[acc.name]
+    blocks: List[Coord] = []
+    for perm in dict.fromkeys(itertools.permutations(chip_shape)):
+        if any(perm[i] % extent[i] for i in range(len(extent))):
+            continue
+        hb = tuple(perm[i] // extent[i] for i in range(len(extent)))
+        if all(hb[i] <= host_dims[i] for i in range(len(hb))):
+            blocks.append(hb)
+    return list(dict.fromkeys(blocks))
+
+
 def validate_slice_shape(shape: Coord, acc: TpuAccelerator,
                          pool_dims: Coord) -> Optional[str]:
     """Returns an error string or None. Shape and pool dims are in chips."""
@@ -38,21 +56,19 @@ def validate_slice_shape(shape: Coord, acc: TpuAccelerator,
                 f"{acc.name} torus has {acc.ici_dims}")
     if len(pool_dims) != acc.ici_dims:
         return f"pool dims {pool_dims} do not match {acc.name} torus rank"
-    for i, s in enumerate(shape):
-        if s <= 0 or s % extent[i]:
-            return (f"slice shape {shape} axis {i} must be a positive "
-                    f"multiple of the host extent {extent}")
-    if sorted_fit_impossible(shape, pool_dims):
-        return f"slice shape {shape} cannot fit pool dims {pool_dims} under any rotation"
+    if any(s <= 0 for s in shape):
+        return f"slice shape {shape} axes must be positive"
+    host_dims = tuple(d // e for d, e in zip(pool_dims, extent))
+    if not candidate_host_blocks(shape, acc, host_dims):
+        return (f"slice shape {shape} cannot map onto pool dims {pool_dims} "
+                f"(host extent {extent}) under any rotation")
     return None
 
 
-def sorted_fit_impossible(shape: Coord, dims: Coord) -> bool:
-    return any(s > d for s, d in zip(sorted(shape), sorted(dims)))
-
-
 def host_block_shape(chip_shape: Coord, acc: TpuAccelerator) -> Coord:
-    """Chip shape → host-block shape, e.g. v5p 4x4x4 chips → 2x2x4 hosts."""
+    """Identity-orientation chip shape → host-block shape (v5p 4x4x4 chips →
+    2x2x4 hosts). Placement enumeration uses candidate_host_blocks, which
+    handles rotations."""
     extent = HOST_EXTENT[acc.name]
     return tuple(s // e for s, e in zip(chip_shape, extent))
 
@@ -86,20 +102,14 @@ class HostGrid:
         return cls(spec.pool, acc, dims, wrap, node_of, coord_of)
 
 
-def _distinct_permutations(shape: Coord) -> List[Coord]:
-    return list(dict.fromkeys(itertools.permutations(shape)))
-
-
-def enumerate_placements(grid: HostGrid, block: Coord) -> List[Placement]:
-    """All distinct host-sets where a block of host-shape `block` (any axis
-    permutation) can sit on the grid. Wraparound anchors are allowed only on
-    wrapped axes; a block spanning the full axis uses a single anchor."""
+def enumerate_placements(grid: HostGrid, chip_shape: Coord) -> List[Placement]:
+    """All distinct host-sets where `chip_shape` (chips; any rotation) can
+    sit on the grid. Wraparound anchors are allowed only on wrapped axes; a
+    block spanning the full axis uses a single anchor."""
     out: List[Placement] = []
     seen = set()
     rank = len(grid.dims)
-    for shape in _distinct_permutations(block):
-        if any(shape[i] > grid.dims[i] for i in range(rank)):
-            continue
+    for shape in candidate_host_blocks(chip_shape, grid.acc, grid.dims):
         anchor_ranges = []
         for i in range(rank):
             if shape[i] == grid.dims[i]:
