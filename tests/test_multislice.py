@@ -1,0 +1,76 @@
+"""MultiSlice DCN-aware scoring tests (BASELINE eval config #5: multi-slice
+job as N PodGroups sharing multislice_set, slices pulled toward nearby DCN
+domains)."""
+from tpusched.api.resources import TPU
+from tpusched.api.topology import LABEL_DCN_DOMAIN
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.plugins.topologymatch import POOL_ANNOTATION
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+
+
+def add_pool(c, name, dcn_domain, dims=(4, 4, 4)):
+    topo, nodes = make_tpu_pool(name, dims=dims, dcn_domain=dcn_domain)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+
+
+def slice_pg(c, set_name, index, members=16, shape="4x4x4"):
+    name = f"{set_name}-slice-{index}"
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator="tpu-v5p", multislice_set=set_name,
+        multislice_index=index))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def pool_of(c, pods):
+    pools = {c.pod(p.key).meta.annotations[POOL_ANNOTATION] for p in pods}
+    assert len(pools) == 1
+    return pools.pop()
+
+
+def test_second_slice_prefers_same_dcn_domain():
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        # pin slice-0 deterministically: only one pool exists when it lands
+        add_pool(c, "first", "zoneA/rack1")
+        s0 = slice_pg(c, "llama70b", 0)
+        assert c.wait_for_pods_scheduled([p.key for p in s0], timeout=20)
+        assert pool_of(c, s0) == "first"
+        add_pool(c, "near", "zoneA/rack1")     # same domain as slice-0
+        add_pool(c, "far", "zoneB/rack9")
+        s1 = slice_pg(c, "llama70b", 1)
+        assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=20)
+        # the second slice must pick the pool sharing the first's DCN domain
+        assert pool_of(c, s1) == "near"
+
+
+def test_adjacent_zone_beats_remote_zone():
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "a1", "zoneA/rack1")
+        s0 = slice_pg(c, "job", 0)
+        assert c.wait_for_pods_scheduled([p.key for p in s0], timeout=20)
+        assert pool_of(c, s0) == "a1"
+        add_pool(c, "a2", "zoneA/rack2")   # adjacent (same zone, other rack)
+        add_pool(c, "b1", "zoneB/rack1")   # remote
+        s1 = slice_pg(c, "job", 1)
+        assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=20)
+        assert pool_of(c, s1) == "a2", "slice-1 went to the remote zone"
+
+
+def test_four_slice_job_spreads_over_four_pools():
+    """4× v5p-64 multi-slice job: every slice whole-pool, all in one zone."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=10, denied_s=1)) as c:
+        for i in range(4):
+            add_pool(c, f"pool-{i}", f"zoneA/rack{i % 2}")
+        all_pods = {}
+        for idx in range(4):
+            pods = slice_pg(c, "big", idx)
+            assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+            all_pods[idx] = pods
+        pools = {idx: pool_of(c, pods) for idx, pods in all_pods.items()}
+        assert len(set(pools.values())) == 4  # one pool per slice

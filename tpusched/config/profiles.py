@@ -22,7 +22,8 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
                 "TaintToleration", "NodeResourcesFit", "TpuSlice",
                 "TopologyMatch"],
         post_filter=["Coscheduling"],
-        score=[("TpuSlice", 1), ("TopologyMatch", 2)],
+        pre_score=["MultiSlice"],
+        score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],
         reserve=["TpuSlice", "TopologyMatch", "Coscheduling"],
         permit=["Coscheduling"],
         bind=["TpuSlice"],

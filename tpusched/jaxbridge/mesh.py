@@ -26,14 +26,8 @@ def factor_mesh(n_devices: int, max_tp: int = 4) -> Tuple[int, int]:
 def build_mesh(n_devices: int, devices: Optional[Sequence] = None,
                axis_names: Tuple[str, str] = ("dp", "tp")):
     """A dp×tp Mesh over the first n devices (CPU-virtual or TPU)."""
-    import jax
-    devs = list(devices) if devices is not None else jax.devices()
-    if len(devs) < n_devices:
-        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
     dp, tp = factor_mesh(n_devices)
-    arr = np.array(devs[:n_devices]).reshape(dp, tp)
-    from jax.sharding import Mesh
-    return Mesh(arr, axis_names)
+    return build_named_mesh({axis_names[0]: dp, axis_names[1]: tp}, devices)
 
 
 def mesh_from_slice_shape(shape: Tuple[int, ...], devices: Optional[Sequence] = None):
@@ -43,3 +37,35 @@ def mesh_from_slice_shape(shape: Tuple[int, ...], devices: Optional[Sequence] = 
     for d in shape:
         n *= d
     return build_mesh(n, devices)
+
+
+def build_named_mesh(axis_sizes: "dict[str, int]",
+                     devices: Optional[Sequence] = None):
+    """Arbitrary named mesh, e.g. {"dp": 2, "sp": 2, "tp": 2} or a
+    multi-slice {"slice": 4, "dp": 4, "tp": 4} — `slice` rides DCN between
+    ICI tori, everything else rides ICI."""
+    import jax
+    from jax.sharding import Mesh
+    n = 1
+    for s in axis_sizes.values():
+        n *= s
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    arr = np.array(devs[:n]).reshape(tuple(axis_sizes.values()))
+    return Mesh(arr, tuple(axis_sizes))
+
+
+def slice_assignment(pods) -> "list[tuple[tuple[int, ...], str]]":
+    """Decode the scheduler's slice placement from bound gang pods: a sorted
+    list of (chip_coordinate, node_name) from the TopologyMatch coord
+    annotations — the on-host runtime's source of truth for building the
+    physical device mesh."""
+    from ..api.topology import parse_coord
+    from ..plugins.topologymatch import COORD_ANNOTATION
+    out = []
+    for p in pods:
+        ann = p.meta.annotations.get(COORD_ANNOTATION)
+        if ann and p.spec.node_name:
+            out.append((parse_coord(ann), p.spec.node_name))
+    return sorted(out)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -105,16 +105,24 @@ def _block(x: jax.Array, p: Dict[str, jax.Array], n_heads: int) -> jax.Array:
     return x + mlp
 
 
-def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            act_spec: Optional[Any] = None) -> jax.Array:
     x = params["embed"][tokens]
+    if act_spec is not None:
+        # sequence parallelism: constrain activations to the sp axis and let
+        # GSPMD insert the attention gathers/collectives
+        x = jax.lax.with_sharding_constraint(x, act_spec)
     for layer in params["layers"]:
         x = _block(x, layer, cfg.n_heads)
+        if act_spec is not None:
+            x = jax.lax.with_sharding_constraint(x, act_spec)
     x = _rmsnorm(x, params["ln_f"])
     return x @ params["out"]
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            act_spec: Optional[Any] = None) -> jax.Array:
+    logits = forward(params, tokens[:, :-1], cfg, act_spec).astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -122,44 +130,70 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def sgd_train_step(params: Params, tokens: jax.Array, cfg: ModelConfig,
-                   lr: float = 1e-3) -> Tuple[Params, jax.Array]:
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+                   lr: float = 1e-3,
+                   act_spec: Optional[Any] = None) -> Tuple[Params, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
+                                              act_spec=act_spec)
     new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                         params, grads)
     return new_params, loss
 
 
 # -- shardings ---------------------------------------------------------------
+#
+# Axis conventions (any subset may be present on the mesh):
+#   slice — data parallelism ACROSS ICI slices (gradient all-reduce over DCN;
+#           multi-slice jobs, BASELINE config #5)
+#   dp    — data parallelism across hosts within a slice
+#   fsdp  — fully-sharded params (ZeRO-3 style) over a second batch axis
+#   sp    — sequence parallelism: activations sharded along sequence, GSPMD
+#           inserts the attention collectives (long-context jobs)
+#   tp    — tensor parallelism inside a host (4 chips on ICI)
 
-def param_specs(cfg: ModelConfig) -> Params:
-    """dp×tp sharding rules: column-parallel in (wq/wk/wv/w_gate/w_up, shard
-    output dim over tp), row-parallel out (wo/w_down, shard input dim over tp
-    ⇒ GSPMD inserts the tp all-reduce), embeddings sharded over d_model."""
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("slice", "dp", "fsdp") if a in mesh.axis_names)
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Column-parallel in (wq/wk/wv/w_gate/w_up: shard output dim over tp),
+    row-parallel out (wo/w_down: shard input dim over tp ⇒ GSPMD inserts the
+    tp all-reduce). With an fsdp axis, the non-tp dim of every matrix is
+    additionally sharded fsdp (ZeRO-3)."""
+    tp = "tp" if "tp" in mesh.axis_names else None
+    fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    col = P(fsdp, tp)   # (in, out) sharded (fsdp, tp)
+    row = P(tp, fsdp)
+    vec = P(None)
     layer = {
-        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
-        "wo": P("tp", None),
-        "w_gate": P(None, "tp"), "w_up": P(None, "tp"), "w_down": P("tp", None),
-        "ln_attn": P(None), "ln_mlp": P(None),
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "w_gate": col, "w_up": col, "w_down": row,
+        "ln_attn": vec, "ln_mlp": vec,
     }
     return {
-        "embed": P(None, "tp"),
-        "out": P("tp", None),
-        "ln_f": P(None),
+        "embed": col,
+        "out": row,
+        "ln_f": vec,
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
-    """jit the train step over a (dp, tp) mesh with explicit shardings; batch
-    is dp-sharded, params tp-sharded."""
-    pspecs = param_specs(cfg)
+    """jit the train step over the mesh with explicit shardings; batch is
+    sharded over every batch axis present (slice/dp/fsdp), activations over
+    sp when present, params over fsdp×tp."""
+    pspecs = param_specs(cfg, mesh)
     param_shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), pspecs,
         is_leaf=lambda x: isinstance(x, P))
-    token_sharding = NamedSharding(mesh, P("dp", None))
+    b_axes = batch_axes(mesh)
+    batch_spec = b_axes if b_axes else None
+    token_sharding = NamedSharding(mesh, P(batch_spec, None))
+    act_spec = None
+    if "sp" in mesh.axis_names:
+        act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
 
     step = jax.jit(
-        functools.partial(sgd_train_step, cfg=cfg),
+        functools.partial(sgd_train_step, cfg=cfg, act_spec=act_spec),
         in_shardings=(param_shardings, token_sharding),
         out_shardings=(param_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,))

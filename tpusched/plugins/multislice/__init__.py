@@ -1,0 +1,3 @@
+from .plugin import MultiSlice
+
+__all__ = ["MultiSlice"]

@@ -1,0 +1,102 @@
+"""MultiSlice plugin: DCN-aware cross-slice scoring.
+
+New TPU-native capability with no reference analog (SURVEY §7.7, BASELINE
+eval config #5): a multi-slice job (e.g. Llama-3-70B on 4× v5p-64) is N
+PodGroups sharing ``PodGroupSpec.multislice_set``, one gang per slice. Each
+slice lands on one ICI torus (TopologyMatch guarantees that); the slices
+communicate gradients over DCN. This scorer pulls sibling slices toward the
+same DCN proximity domain so the cross-slice all-reduce rides the shortest
+data-center paths:
+
+- nodes in a pool whose ``dcn-domain`` equals a domain already hosting a
+  sibling slice score ``same_domain_score``;
+- nodes whose domain shares the same top-level zone (prefix before "/")
+  score ``adjacent_domain_score``;
+- everything else scores 0. Non-multislice pods skip.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...api.core import Pod
+from ...api.scheduling import POD_GROUP_LABEL, pod_group_label
+from ...api.topology import LABEL_DCN_DOMAIN
+from ...config.types import MultiSliceArgs
+from ...fwk import CycleState, Status
+from ...fwk.interfaces import NodeScore, PreScorePlugin, ScorePlugin
+from ...fwk.nodeinfo import MAX_NODE_SCORE
+
+_STATE_KEY = "MultiSlice/domains"
+
+
+class _Domains:
+    def __init__(self, domains: set):
+        self.domains = domains
+        self.zones = {d.split("/")[0] for d in domains}
+
+    def clone(self):
+        return self
+
+
+class MultiSlice(PreScorePlugin, ScorePlugin):
+    NAME = "MultiSlice"
+
+    def __init__(self, args: Optional[MultiSliceArgs], handle):
+        self.args = args or MultiSliceArgs()
+        self.handle = handle
+        self.pg_informer = handle.informer_factory.podgroups()
+        self.pod_informer = handle.informer_factory.pods()
+
+    @classmethod
+    def new(cls, args, handle) -> "MultiSlice":
+        return cls(args, handle)
+
+    # -- PreScore: collect DCN domains of already-placed sibling slices -------
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        name = pod_group_label(pod)
+        if not name:
+            return Status.skip()
+        pg = self.pg_informer.get(f"{pod.namespace}/{name}")
+        if pg is None or not pg.spec.multislice_set:
+            return Status.skip()
+        sibling_pgs = [
+            g for g in self.pg_informer.items(namespace=pod.namespace)
+            if g.spec.multislice_set == pg.spec.multislice_set
+            and g.meta.name != pg.meta.name]
+        domains = set()
+        snapshot = self.handle.snapshot_shared_lister()
+        for g in sibling_pgs:
+            for p in self.pod_informer.items(
+                    namespace=pod.namespace,
+                    selector={POD_GROUP_LABEL: g.meta.name}):
+                if not p.spec.node_name:
+                    continue
+                info = snapshot.get(p.spec.node_name)
+                if info is None:
+                    continue
+                d = info.node.meta.labels.get(LABEL_DCN_DOMAIN, "")
+                if d:
+                    domains.add(d)
+        if not domains:
+            return Status.skip()  # first slice of the set: nothing to pull toward
+        state.write(_STATE_KEY, _Domains(domains))
+        return Status.success()
+
+    # -- Score ----------------------------------------------------------------
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        doms = state.try_read(_STATE_KEY)
+        if doms is None:
+            return 0, Status.success()
+        info = self.handle.snapshot_shared_lister().get(node_name)
+        if info is None:
+            return 0, Status.success()
+        d = info.node.meta.labels.get(LABEL_DCN_DOMAIN, "")
+        if not d:
+            return 0, Status.success()
+        if d in doms.domains:
+            return min(MAX_NODE_SCORE, self.args.same_domain_score), Status.success()
+        if d.split("/")[0] in doms.zones:
+            return min(MAX_NODE_SCORE, self.args.adjacent_domain_score), Status.success()
+        return 0, Status.success()
