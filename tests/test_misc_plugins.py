@@ -1,0 +1,166 @@
+"""Tests for NodeResourcesAllocatable, PodState, QOSSort,
+PreemptionToleration, and CrossNodePreemption."""
+import time
+
+from tpusched.api.core import PriorityClass
+from tpusched.api.meta import ObjectMeta
+from tpusched.api.resources import CPU, TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.types import NodeResourcesAllocatableArgs
+from tpusched.fwk import CycleState, PluginProfile
+from tpusched.plugins.preemptiontoleration import (
+    ANNOTATION_MIN_PREEMPTABLE, ANNOTATION_TOLERATION_SECONDS,
+    exempted_from_preemption, parse_policy)
+from tpusched.sched.queue import QueuedPodInfo
+from tpusched.testing import (TestCluster, make_node, make_pod, make_tpu_node,
+                              new_test_framework)
+
+
+# -- NodeResourcesAllocatable -------------------------------------------------
+
+def test_allocatable_least_mode_prefers_small_nodes():
+    small = make_node("small", capacity=make_resources(cpu=8, memory="32Gi"))
+    big = make_node("big", capacity=make_resources(cpu=64, memory="256Gi"))
+    profile = PluginProfile(score=[("NodeResourcesAllocatable", 1)],
+                            bind=["DefaultBinder"])
+    fw, handle, _ = new_test_framework(profile, nodes=[small, big])
+    totals, s = fw.run_score_plugins(CycleState(), make_pod("p"), [small, big])
+    assert s.is_success()
+    assert totals["small"] == 100 and totals["big"] == 0
+
+
+def test_allocatable_most_mode_prefers_big_nodes():
+    small = make_node("small", capacity=make_resources(cpu=8, memory="32Gi"))
+    big = make_node("big", capacity=make_resources(cpu=64, memory="256Gi"))
+    profile = PluginProfile(score=[("NodeResourcesAllocatable", 1)],
+                            bind=["DefaultBinder"])
+    profile.plugin_args["NodeResourcesAllocatable"] = \
+        NodeResourcesAllocatableArgs(mode="Most")
+    fw, handle, _ = new_test_framework(profile, nodes=[small, big])
+    totals, s = fw.run_score_plugins(CycleState(), make_pod("p"), [small, big])
+    assert totals["big"] == 100 and totals["small"] == 0
+
+
+# -- PodState -----------------------------------------------------------------
+
+def test_podstate_prefers_terminating_capacity():
+    n1, n2 = make_node("n1"), make_node("n2")
+    terminating = make_pod("t", node_name="n1")
+    terminating.meta.deletion_timestamp = time.time()
+    profile = PluginProfile(score=[("PodState", 1)], bind=["DefaultBinder"])
+    fw, handle, _ = new_test_framework(profile, nodes=[n1, n2],
+                                       pods=[terminating])
+    totals, s = fw.run_score_plugins(CycleState(), make_pod("p"), [n1, n2])
+    assert s.is_success()
+    assert totals["n1"] > totals["n2"]
+
+
+# -- QOSSort ------------------------------------------------------------------
+
+def test_qossort_order():
+    from tpusched.plugins.qossort import QOSSort
+    sort = QOSSort()
+    guaranteed = QueuedPodInfo(make_pod("g", requests={CPU: 100, "memory": 100},
+                                        limits={CPU: 100, "memory": 100}))
+    burstable = QueuedPodInfo(make_pod("b", requests={CPU: 100}))
+    best_effort = QueuedPodInfo(make_pod("e"))
+    assert sort.less(guaranteed, burstable)
+    assert sort.less(burstable, best_effort)
+    assert not sort.less(best_effort, guaranteed)
+    high_priority_be = QueuedPodInfo(make_pod("hp", priority=10))
+    assert sort.less(high_priority_be, guaranteed)  # priority first
+
+
+# -- PreemptionToleration -----------------------------------------------------
+
+def make_pc(name, value, minimum=None, toleration=None):
+    ann = {}
+    if minimum is not None:
+        ann[ANNOTATION_MIN_PREEMPTABLE] = str(minimum)
+    if toleration is not None:
+        ann[ANNOTATION_TOLERATION_SECONDS] = str(toleration)
+    return PriorityClass(meta=ObjectMeta(name=name, namespace="",
+                                         annotations=ann), value=value)
+
+
+def test_parse_policy_defaults():
+    pc = make_pc("low", 100)
+    policy = parse_policy(pc)
+    assert policy.minimum_preemptable_priority == 101
+    assert policy.toleration_seconds == 0
+    assert parse_policy(make_pc("bad", 1, minimum="oops")) is None
+
+
+def test_exempted_from_preemption_window():
+    pc = make_pc("tolerant", 100, minimum=10000, toleration=3600)
+    getter = lambda name: pc
+    victim = make_pod("v", priority=100, priority_class_name="tolerant")
+    from tpusched.api.core import PodCondition
+    victim.status.conditions.append(PodCondition(
+        type="PodScheduled", status="True", last_transition_time=1000.0))
+    preemptor = make_pod("p", priority=500)
+    # within the toleration window → exempt
+    assert exempted_from_preemption(victim, preemptor, getter, now=2000.0)
+    # window expired → preemptable
+    assert not exempted_from_preemption(victim, preemptor, getter, now=1000.0 + 3601)
+    # preemptor above minimum-preemptable → never exempt
+    big = make_pod("big", priority=20000)
+    assert not exempted_from_preemption(victim, big, getter, now=2000.0)
+    # negative toleration → exempt forever
+    pc2 = make_pc("forever", 100, minimum=10000, toleration=-1)
+    assert exempted_from_preemption(victim, preemptor, lambda n: pc2, now=10**9)
+
+
+def pt_profile():
+    return PluginProfile(
+        queue_sort="PrioritySort",
+        filter=["NodeUnschedulable", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["PreemptionToleration"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice"],
+        bind=["TpuSlice"],
+    )
+
+
+def test_preemption_toleration_integration():
+    """Exempt victims survive; the non-exempt one is evicted."""
+    with TestCluster(profile=pt_profile()) as c:
+        c.api.create(srv.PRIORITY_CLASSES,
+                     make_pc("tolerant", 100, minimum=10000, toleration=-1))
+        c.add_nodes([make_tpu_node("h0", chips=4)])
+        protected = make_pod("protected", limits={TPU: 2}, priority=100,
+                             priority_class_name="tolerant")
+        plain = make_pod("plain", limits={TPU: 2}, priority=100)
+        c.create_pods([protected, plain])
+        assert c.wait_for_pods_scheduled([protected.key, plain.key])
+        preemptor = make_pod("preemptor", limits={TPU: 2}, priority=500)
+        c.create_pods([preemptor])
+        assert c.wait_for_pods_scheduled([preemptor.key], timeout=15)
+        assert c.pod(protected.key) is not None   # exempt → survived
+        assert c.pod(plain.key) is None           # evicted
+
+
+# -- CrossNodePreemption ------------------------------------------------------
+
+def cnp_profile():
+    return PluginProfile(
+        queue_sort="PrioritySort",
+        filter=["NodeUnschedulable", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["CrossNodePreemption"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice"],
+        bind=["TpuSlice"],
+    )
+
+
+def test_cross_node_preemption_frees_whole_node():
+    with TestCluster(profile=cnp_profile()) as c:
+        c.add_nodes([make_tpu_node("h0", chips=4)])
+        lows = [make_pod(f"low-{i}", limits={TPU: 1}, priority=1)
+                for i in range(4)]
+        c.create_pods(lows)
+        assert c.wait_for_pods_scheduled([p.key for p in lows])
+        high = make_pod("high", limits={TPU: 4}, priority=100)
+        c.create_pods([high])
+        assert c.wait_for_pods_scheduled([high.key], timeout=15)
+        assert all(c.pod(p.key) is None for p in lows)

@@ -71,6 +71,7 @@ class PodCondition:
     status: str = "True"
     reason: str = ""
     message: str = ""
+    last_transition_time: float = 0.0
 
 
 @dataclass

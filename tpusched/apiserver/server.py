@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..api.core import Binding, Event, Pod
+from ..api.core import Binding, Event, Pod, PodCondition
 from ..util import klog
 
 # Canonical kind names.
@@ -204,11 +204,15 @@ class APIServer:
     def bind(self, binding: Binding) -> None:
         """POST pods/<p>/binding. Fails if the pod is already bound (the API
         server's real behavior, which the scheduler cache relies on)."""
+        now = self._clock()
+
         def mutate(pod: Pod):
             if pod.spec.node_name:
                 raise Conflict(f"pod {binding.pod_key} already bound to {pod.spec.node_name}")
             pod.spec.node_name = binding.node_name
             pod.meta.annotations.update(binding.annotations)
+            pod.status.conditions.append(PodCondition(
+                type="PodScheduled", status="True", last_transition_time=now))
         self.patch(PODS, binding.pod_key, mutate)
 
     def record_event(self, object_key: str, kind: str, etype: str, reason: str,

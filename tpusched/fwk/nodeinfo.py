@@ -18,6 +18,18 @@ MAX_NODE_SCORE = 100
 MIN_NODE_SCORE = 0
 
 
+def minmax_normalize(raw: Dict[str, int], scores) -> None:
+    """Min-max normalize NodeScore list in place from a raw per-node dict
+    (the shared pattern of allocatable.go:141-166 / pod_state.go:72-95);
+    all-equal raw values map to MAX_NODE_SCORE."""
+    values = [raw.get(s.name, 0) for s in scores]
+    lo, hi = (min(values), max(values)) if values else (0, 0)
+    for s in scores:
+        v = raw.get(s.name, 0)
+        s.score = MAX_NODE_SCORE if hi == lo else \
+            int((v - lo) * MAX_NODE_SCORE // (hi - lo))
+
+
 class NodeInfo:
     __slots__ = ("node", "pods", "requested", "non_zero_requested", "generation")
 

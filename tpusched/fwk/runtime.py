@@ -457,6 +457,17 @@ class Framework:
         for p in self.post_bind_plugins:
             p.post_bind(state, pod, node_name)
 
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        """Release plugin background resources (collector threads etc.)."""
+        for p in self.plugins.values():
+            closer = getattr(p, "close", None)
+            if callable(closer):
+                try:
+                    closer()
+                except Exception as e:
+                    klog.error_s(e, "plugin close failed", plugin=p.name())
+
     # -- enqueue hints -------------------------------------------------------
     def events_to_register(self) -> List[ClusterEvent]:
         events: List[ClusterEvent] = []

@@ -1,0 +1,53 @@
+"""NodeResourcesAllocatable: score by weighted node allocatable.
+
+Rebuild of /root/reference/pkg/noderesources/allocatable.go: score = weighted
+sum of node ALLOCATABLE (not free) resources, Least mode negates so smaller
+nodes win (:119-138); default weights 1<<20 per cpu millicore ≈ 1 per memory
+byte (resource_allocation.go:38); min-max normalized to [0,100] (:141-166).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..api.core import Pod
+from ..config.types import NodeResourcesAllocatableArgs
+from ..fwk import CycleState, Status
+from ..fwk.interfaces import NodeScore, ScorePlugin
+from ..fwk.nodeinfo import minmax_normalize
+
+
+class NodeResourcesAllocatable(ScorePlugin):
+    NAME = "NodeResourcesAllocatable"
+
+    def __init__(self, args: Optional[NodeResourcesAllocatableArgs], handle):
+        self.args = args or NodeResourcesAllocatableArgs()
+        if self.args.mode not in ("Least", "Most"):
+            raise ValueError(f"invalid mode {self.args.mode!r}")
+        self.handle = handle
+
+    @classmethod
+    def new(cls, args, handle) -> "NodeResourcesAllocatable":
+        return cls(args, handle)
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        info = self.handle.snapshot_shared_lister().get(node_name)
+        if info is None:
+            return 0, Status.error(f"node {node_name} not in snapshot")
+        total = 0
+        for spec in self.args.resources:
+            total += info.allocatable.get(spec["name"], 0) * int(spec["weight"])
+        if self.args.mode == "Least":
+            total = -total
+        # raw scores are normalized below; stash per-node raw in state
+        raw = state.try_read("NodeResourcesAllocatable/raw")
+        if raw is None:
+            raw = {}
+            state.write("NodeResourcesAllocatable/raw", raw)
+        raw[node_name] = total
+        return 0, Status.success()   # real value applied in normalize
+
+    def normalize_score(self, state: CycleState, pod: Pod,
+                        scores: List[NodeScore]) -> Optional[Status]:
+        minmax_normalize(state.try_read("NodeResourcesAllocatable/raw") or {},
+                         scores)
+        return Status.success()

@@ -129,9 +129,15 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
     # -- PreFilter ------------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
-        with self._lock:
-            snapshot = _EQSnapshot(self.eq_infos.clone())
-        state.write(EQ_SNAPSHOT_KEY, snapshot)
+        # Reuse an existing snapshot when re-evaluated inside a preemption
+        # dry-run (cloned CycleState): the dry-run's Add/RemovePod extensions
+        # have adjusted it, and re-snapshotting the live infos would clobber
+        # those adjustments (CrossNodePreemption re-runs PreFilter this way).
+        snapshot = state.try_read(EQ_SNAPSHOT_KEY)
+        if snapshot is None:
+            with self._lock:
+                snapshot = _EQSnapshot(self.eq_infos.clone())
+            state.write(EQ_SNAPSHOT_KEY, snapshot)
         pod_req = pod_effective_request(pod)
 
         eq = snapshot.infos.get(pod.namespace)

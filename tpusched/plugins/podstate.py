@@ -1,0 +1,43 @@
+"""PodState sample plugin: prefer nodes releasing capacity.
+
+Rebuild of /root/reference/pkg/podstate/pod_state.go: score = count of
+terminating pods − count of nominated pods per node (:57-69), min-max
+normalized (:72-95).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..api.core import Pod
+from ..fwk import CycleState, Status
+from ..fwk.interfaces import NodeScore, ScorePlugin
+from ..fwk.nodeinfo import minmax_normalize
+
+
+class PodState(ScorePlugin):
+    NAME = "PodState"
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    @classmethod
+    def new(cls, args, handle) -> "PodState":
+        return cls(handle)
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        info = self.handle.snapshot_shared_lister().get(node_name)
+        if info is None:
+            return 0, Status.error(f"node {node_name} not in snapshot")
+        terminating = sum(1 for p in info.pods if p.is_terminating())
+        nominated = len(self.handle.pod_nominator.nominated_pods_for_node(node_name))
+        raw = state.try_read("PodState/raw")
+        if raw is None:
+            raw = {}
+            state.write("PodState/raw", raw)
+        raw[node_name] = terminating - nominated
+        return 0, Status.success()
+
+    def normalize_score(self, state: CycleState, pod: Pod,
+                        scores: List[NodeScore]) -> Optional[Status]:
+        minmax_normalize(state.try_read("PodState/raw") or {}, scores)
+        return Status.success()

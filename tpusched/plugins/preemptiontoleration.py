@@ -1,0 +1,180 @@
+"""PreemptionToleration: DefaultPreemption with exemptable victims.
+
+Rebuild of /root/reference/pkg/preemptiontoleration: a victim whose
+PriorityClass carries the annotations
+
+- ``preemption-toleration.scheduling.tpu.dev/minimum-preemptable-priority``
+  (default: pc.value + 1)
+- ``preemption-toleration.scheduling.tpu.dev/toleration-seconds``
+  (default 0 = no toleration; negative = tolerate forever)
+
+is exempt from preemption by preemptors below the minimum priority, within
+the toleration window measured from the victim's PodScheduled condition
+(preemption_toleration.go:125-175). Victim selection is otherwise the
+default-preemption algorithm (:182-283): all lower-priority pods minus
+exempted, remove-all feasibility check, PDB-aware reprieve from the highest
+priority down.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from ..api.core import Pod, PodDisruptionBudget, PriorityClass
+from ..config.types import PreemptionTolerationArgs
+from ..fwk import CycleState, Status
+from ..fwk.interfaces import (PostFilterPlugin, PostFilterResult)
+from ..fwk.nodeinfo import NodeInfo
+from ..sched.preemption import (Evaluator, PreemptionInterface, dry_run_add,
+                                dry_run_remove, filter_pods_with_pdb_violation)
+from ..util import klog
+
+ANNOTATION_PREFIX = "preemption-toleration.scheduling.tpu.dev/"
+ANNOTATION_MIN_PREEMPTABLE = ANNOTATION_PREFIX + "minimum-preemptable-priority"
+ANNOTATION_TOLERATION_SECONDS = ANNOTATION_PREFIX + "toleration-seconds"
+
+
+class Policy:
+    def __init__(self, minimum_preemptable_priority: int, toleration_seconds: int):
+        self.minimum_preemptable_priority = minimum_preemptable_priority
+        self.toleration_seconds = toleration_seconds
+
+
+def parse_policy(pc: PriorityClass) -> Optional[Policy]:
+    """Returns None on a malformed annotation (⇒ no toleration,
+    preemption_toleration_policy.go:56-84)."""
+    try:
+        min_str = pc.meta.annotations.get(ANNOTATION_MIN_PREEMPTABLE)
+        minimum = int(min_str) if min_str is not None else pc.value + 1
+        tol_str = pc.meta.annotations.get(ANNOTATION_TOLERATION_SECONDS)
+        toleration = int(tol_str) if tol_str is not None else 0
+        return Policy(minimum, toleration)
+    except ValueError:
+        return None
+
+
+def exempted_from_preemption(victim: Pod, preemptor: Pod, pc_getter,
+                             now: Optional[float] = None) -> bool:
+    """preemption_toleration.go:125-175 (public policy check)."""
+    if not victim.spec.priority_class_name:
+        return False
+    pc = pc_getter(victim.spec.priority_class_name)
+    if pc is None:
+        return False
+    policy = parse_policy(pc)
+    if policy is None:
+        return False
+    if preemptor.priority >= policy.minimum_preemptable_priority:
+        return False
+    if policy.toleration_seconds < 0:
+        return True
+    scheduled_at = None
+    for cond in victim.status.conditions:
+        if cond.type == "PodScheduled" and cond.status == "True":
+            scheduled_at = cond.last_transition_time
+    if scheduled_at is None:
+        return True  # not yet scheduled: tolerate (no effect on nominated pods)
+    now = time.time() if now is None else now
+    return scheduled_at + policy.toleration_seconds > now
+
+
+class PreemptionToleration(PostFilterPlugin):
+    NAME = "PreemptionToleration"
+
+    def __init__(self, args: Optional[PreemptionTolerationArgs], handle):
+        self.args = args or PreemptionTolerationArgs()
+        self.handle = handle
+
+    @classmethod
+    def new(cls, args, handle) -> "PreemptionToleration":
+        return cls(args, handle)
+
+    def _pc(self, name: str) -> Optional[PriorityClass]:
+        return self.handle.informer_factory.priorityclasses().get("/" + name)
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        evaluator = Evaluator(self.NAME, self.handle, state,
+                              _Interface(self.handle, self._pc))
+        return evaluator.preempt(pod, filtered_node_status_map)
+
+
+class _Interface(PreemptionInterface):
+    def __init__(self, handle, pc_getter):
+        self.handle = handle
+        self.pc_getter = pc_getter
+
+    def pod_eligible_to_preempt_others(self, pod: Pod,
+                                       nominated_node_status) -> bool:
+        pc = self.pc_getter(pod.spec.priority_class_name) \
+            if pod.spec.priority_class_name else None
+        if pc is not None and pc.preemption_policy == "Never":
+            return False
+        # default-preemption terminating-victim check on the nominated node
+        nom = pod.status.nominated_node_name
+        if nom:
+            from ..fwk.status import UNSCHEDULABLE_AND_UNRESOLVABLE
+            if (nominated_node_status is not None and
+                    nominated_node_status.code == UNSCHEDULABLE_AND_UNRESOLVABLE):
+                return True
+            info = self.handle.snapshot_shared_lister().get(nom)
+            if info is not None:
+                for p in info.pods:
+                    if p.is_terminating() and p.priority < pod.priority:
+                        return False
+        return True
+
+    def select_victims_on_node(self, state: CycleState, pod: Pod,
+                               node_info: NodeInfo,
+                               pdbs: List[PodDisruptionBudget]
+                               ) -> Tuple[List[Pod], int, Status]:
+        now = self.handle.clock()
+        potential: List[Pod] = []
+        for p in list(node_info.pods):
+            if p.priority >= pod.priority:
+                continue
+            # the exemption filter — the plugin's whole point
+            # (preemption_toleration.go:208-229)
+            if exempted_from_preemption(p, pod, self.pc_getter, now):
+                klog.V(5).info_s("victim candidate exempted", victim=p.key,
+                                 preemptor=pod.key)
+                continue
+            potential.append(p)
+            err = dry_run_remove(self.handle, state, pod, p, node_info)
+            if err:
+                return [], 0, err
+        if not potential:
+            return [], 0, Status.unresolvable(
+                f"No preemption victims found on node {node_info.node.name}")
+        s = self.handle.run_filter_plugins_with_nominated_pods(state, pod, node_info)
+        if not s.is_success():
+            return [], 0, s
+
+        victims: List[Pod] = []
+        num_violating = 0
+        potential.sort(key=lambda p: (-p.priority,
+                                      p.status.start_time or p.meta.creation_timestamp))
+        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+
+        def reprieve(p: Pod) -> bool:
+            err = dry_run_add(self.handle, state, pod, p, node_info)
+            if err:
+                raise RuntimeError(err.message())
+            fits = self.handle.run_filter_plugins_with_nominated_pods(
+                state, pod, node_info).is_success()
+            if not fits:
+                err = dry_run_remove(self.handle, state, pod, p, node_info)
+                if err:
+                    raise RuntimeError(err.message())
+                victims.append(p)
+            return fits
+
+        try:
+            for p in violating:
+                if not reprieve(p):
+                    num_violating += 1
+            for p in non_violating:
+                reprieve(p)
+        except RuntimeError as e:
+            return [], 0, Status.error(str(e))
+        return victims, num_violating, Status.success()

@@ -1,0 +1,25 @@
+"""QOSSort sample plugin: queue ordering by priority, then QoS class.
+
+Rebuild of /root/reference/pkg/qos/queue_sort.go:42-59: priority desc;
+tie-break Guaranteed > Burstable > BestEffort; final tie by queue time.
+"""
+from __future__ import annotations
+
+from ..api.core import QOS_BEST_EFFORT, QOS_BURSTABLE, QOS_GUARANTEED
+from ..fwk.interfaces import QueueSortPlugin
+
+_QOS_ORDER = {QOS_GUARANTEED: 0, QOS_BURSTABLE: 1, QOS_BEST_EFFORT: 2}
+
+
+class QOSSort(QueueSortPlugin):
+    NAME = "QOSSort"
+
+    def less(self, pi1, pi2) -> bool:
+        p1, p2 = pi1.pod.priority, pi2.pod.priority
+        if p1 != p2:
+            return p1 > p2
+        q1 = _QOS_ORDER[pi1.pod.qos_class()]
+        q2 = _QOS_ORDER[pi2.pod.qos_class()]
+        if q1 != q2:
+            return q1 < q2
+        return pi1.timestamp < pi2.timestamp

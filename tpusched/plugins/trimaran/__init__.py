@@ -1,0 +1,12 @@
+from .watcher import (Metric, NodeMetrics, WatcherMetrics, Window,
+                      LibraryClient, ServiceClient, Collector,
+                      CPU_TYPE, MEMORY_TYPE, TPU_TYPE, AVERAGE, STD, LATEST)
+from .handler import PodAssignEventHandler
+from .targetloadpacking import TargetLoadPacking
+from .loadvariationriskbalancing import LoadVariationRiskBalancing
+
+__all__ = ["Metric", "NodeMetrics", "WatcherMetrics", "Window",
+           "LibraryClient", "ServiceClient", "Collector",
+           "PodAssignEventHandler", "TargetLoadPacking",
+           "LoadVariationRiskBalancing",
+           "CPU_TYPE", "MEMORY_TYPE", "TPU_TYPE", "AVERAGE", "STD", "LATEST"]

@@ -1,0 +1,79 @@
+"""PodAssignEventHandler: recently-bound pods not yet visible in metrics.
+
+Rebuild of /root/reference/pkg/trimaran/handler.go: a node→[(timestamp, pod)]
+cache fed by pod informer Add/Update (:43-111), background cleanup every
+5 minutes dropping entries older than the metrics reporting window
+(:33-38,114-138). Bridges real metrics and just-scheduled pods.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ...api.core import Pod
+from ...util.podutil import assigned
+from .watcher import METRICS_AGENT_REPORTING_INTERVAL_S
+
+CLEANUP_INTERVAL_S = 300.0
+
+
+class PodAssignEventHandler:
+    def __init__(self, informer_factory, clock=time.time,
+                 auto_cleanup: bool = True):
+        self.clock = clock
+        self.lock = threading.RLock()
+        # node name → [(assign timestamp, pod)]
+        self.scheduled_pods_cache: Dict[str, List[Tuple[float, Pod]]] = {}
+        informer_factory.pods().add_event_handler(
+            on_add=self._on_add, on_update=self._on_update,
+            on_delete=self._on_delete)
+        self._stop = threading.Event()
+        if auto_cleanup:
+            t = threading.Thread(target=self._cleanup_loop, daemon=True,
+                                 name="trimaran-handler-gc")
+            t.start()
+
+    def _on_add(self, pod: Pod) -> None:
+        if assigned(pod):
+            self._record(pod)
+
+    def _on_update(self, old: Pod, new: Pod) -> None:
+        if not assigned(old) and assigned(new):
+            self._record(new)
+
+    def _on_delete(self, pod: Pod) -> None:
+        if not assigned(pod):
+            return
+        with self.lock:
+            entries = self.scheduled_pods_cache.get(pod.spec.node_name)
+            if entries:
+                self.scheduled_pods_cache[pod.spec.node_name] = [
+                    (t, p) for (t, p) in entries if p.key != pod.key]
+
+    def _record(self, pod: Pod) -> None:
+        with self.lock:
+            self.scheduled_pods_cache.setdefault(pod.spec.node_name, []).append(
+                (self.clock(), pod))
+
+    def recent_pods(self, node_name: str) -> List[Tuple[float, Pod]]:
+        with self.lock:
+            return list(self.scheduled_pods_cache.get(node_name, ()))
+
+    def _cleanup_loop(self) -> None:
+        while not self._stop.wait(CLEANUP_INTERVAL_S):
+            self.cleanup()
+
+    def cleanup(self) -> None:
+        horizon = self.clock() - METRICS_AGENT_REPORTING_INTERVAL_S
+        with self.lock:
+            for node in list(self.scheduled_pods_cache):
+                kept = [(t, p) for (t, p) in self.scheduled_pods_cache[node]
+                        if t > horizon]
+                if kept:
+                    self.scheduled_pods_cache[node] = kept
+                else:
+                    del self.scheduled_pods_cache[node]
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,130 @@
+"""LoadVariationRiskBalancing: score by mean+stddev load risk.
+
+Rebuild of /root/reference/pkg/trimaran/loadvariationriskbalancing:
+risk = (mu + margin·sigma^(1/sensitivity)) / 2 where mu = (avg+req)/capacity
+and sigma = stddev/capacity, score = (1 − risk)·100 (analysis.go:48-78);
+CPU and memory combined via min when both metrics are valid, else max
+(loadvariationriskbalancing.go:104-129). Owns its own Collector — the
+reference deliberately does not share it with TargetLoadPacking
+(collector.go:38-44).
+
+TPU-native extension: when a TPU duty-cycle metric is present, its score
+joins the min() — a TPU host hot on tensorcore gets deprioritized even when
+its CPU looks idle.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+from ...api.core import Pod
+from ...api.resources import CPU, MEMORY, TPU
+from ...config.types import LoadVariationRiskBalancingArgs
+from ...fwk import CycleState, Status
+from ...fwk.nodeinfo import MAX_NODE_SCORE, MIN_NODE_SCORE
+from ...fwk.interfaces import ScorePlugin
+from ...util import klog
+from ...util.podutil import pod_effective_request
+from .watcher import (CPU_TYPE, MEMORY_TYPE, Metric, TPU_TYPE,
+                      get_resource_data, make_collector)
+
+
+class ResourceStats:
+    """analysis.go resourceStats."""
+
+    __slots__ = ("used_avg", "used_stdev", "req", "capacity")
+
+    def __init__(self, used_avg: float, used_stdev: float, req: float,
+                 capacity: float):
+        self.used_avg = used_avg
+        self.used_stdev = used_stdev
+        self.req = req
+        self.capacity = capacity
+
+    def compute_score(self, margin: float, sensitivity: float) -> float:
+        if self.capacity <= 0:
+            klog.error_s(None, "invalid resource capacity", capacity=self.capacity)
+            return 0.0
+        req = max(self.req, 0.0)
+        used_avg = max(min(self.used_avg, self.capacity), 0.0)
+        used_stdev = max(min(self.used_stdev, self.capacity), 0.0)
+        mu = max(min((used_avg + req) / self.capacity, 1.0), 0.0)
+        sigma = max(min(used_stdev / self.capacity, 1.0), 0.0)
+        if sensitivity > 0:
+            sigma = math.pow(sigma, 1.0 / sensitivity)
+        elif sensitivity == 0:
+            # Go semantics: pow(sigma, +Inf) → 0 for sigma<1, 1 at sigma=1
+            sigma = 0.0 if sigma < 1.0 else 1.0
+        sigma = max(min(sigma * margin, 1.0), 0.0)
+        risk = (mu + sigma) / 2.0
+        return (1.0 - risk) * MAX_NODE_SCORE
+
+
+def create_resource_stats(metrics: List[Metric], node, pod_req,
+                          resource_name: str, watcher_type: str
+                          ) -> Tuple[Optional[ResourceStats], bool]:
+    avg, std, found = get_resource_data(metrics, watcher_type)
+    if not found:
+        return None, False
+    capacity = float(node.status.allocatable.get(resource_name, 0))
+    req = float(pod_req.get(resource_name, 0))
+    if resource_name == MEMORY:
+        mega = 1.0 / (1024.0 * 1024.0)
+        capacity *= mega
+        req *= mega
+    rs = ResourceStats(used_avg=avg * capacity / 100.0,
+                       used_stdev=std * capacity / 100.0,
+                       req=req, capacity=capacity)
+    return rs, True
+
+
+class LoadVariationRiskBalancing(ScorePlugin):
+    NAME = "LoadVariationRiskBalancing"
+
+    def __init__(self, args: Optional[LoadVariationRiskBalancingArgs], handle,
+                 provider=None):
+        self.args = args or LoadVariationRiskBalancingArgs()
+        self.handle = handle
+        self.collector = make_collector(self.args, provider)
+
+    @classmethod
+    def new(cls, args, handle) -> "LoadVariationRiskBalancing":
+        return cls(args, handle)
+
+    def close(self) -> None:
+        self.collector.stop()
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        node_info = self.handle.snapshot_shared_lister().get(node_name)
+        if node_info is None:
+            return MIN_NODE_SCORE, Status.error(f"node {node_name} not in snapshot")
+        metrics = self.collector.get_node_metrics(node_name)
+        if metrics is None:
+            klog.V(5).info_s("no metrics for node; min score", node=node_name)
+            return MIN_NODE_SCORE, Status.success()
+        pod_req = pod_effective_request(pod)
+        node = node_info.node
+        margin = self.args.safe_variance_margin
+        sens = self.args.safe_variance_sensitivity
+
+        scores = {}
+        cpu_stats, cpu_ok = create_resource_stats(metrics, node, pod_req, CPU, CPU_TYPE)
+        if cpu_ok:
+            scores["cpu"] = cpu_stats.compute_score(margin, sens)
+        mem_stats, mem_ok = create_resource_stats(metrics, node, pod_req, MEMORY, MEMORY_TYPE)
+        if mem_ok:
+            scores["memory"] = mem_stats.compute_score(margin, sens)
+        tpu_stats, tpu_ok = create_resource_stats(metrics, node, pod_req, TPU, TPU_TYPE)
+        if tpu_ok:
+            scores["tpu"] = tpu_stats.compute_score(margin, sens)
+
+        if not scores:
+            return MIN_NODE_SCORE, Status.success()
+        # two or more valid dimensions combine via min (the cautious bound —
+        # a node hot on ANY measured dimension is deprioritized); a single
+        # valid dimension stands alone
+        if len(scores) >= 2:
+            total = min(scores.values())
+        else:
+            total = next(iter(scores.values()))
+        return int(round(total)), Status.success()

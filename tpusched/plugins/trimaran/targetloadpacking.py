@@ -1,0 +1,104 @@
+"""TargetLoadPacking: best-fit bin-packing around a target CPU utilization.
+
+Rebuild of /root/reference/pkg/trimaran/targetloadpacking/targetloadpacking.go:
+Score = predicted node CPU% after placing this pod (measured average +
+this pod's predicted use + recently-bound-but-unmeasured pods from the
+assign handler), mapped to a score that rises linearly from `target` at 0%
+to 100 at the target utilization, then falls linearly to 0 at 100%
+(:253-269). Missing metrics ⇒ MinScore (:192-203). Pod prediction: limits,
+else requests × multiplier (1.5), else a 1-core default (:286-294).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ...api.core import Container, Pod
+from ...api.resources import CPU
+from ...config.types import TargetLoadPackingArgs
+from ...fwk import CycleState, Status
+from ...fwk.nodeinfo import MIN_NODE_SCORE
+from ...fwk.interfaces import ScorePlugin
+from ...util import klog
+from .handler import PodAssignEventHandler
+from .watcher import (AVERAGE, CPU_TYPE, LATEST,
+                      METRICS_AGENT_REPORTING_INTERVAL_S, make_collector)
+
+
+class TargetLoadPacking(ScorePlugin):
+    NAME = "TargetLoadPacking"
+
+    def __init__(self, args: Optional[TargetLoadPackingArgs], handle,
+                 provider=None):
+        self.args = args or TargetLoadPackingArgs()
+        self.handle = handle
+        self.collector = make_collector(self.args, provider)
+        self.event_handler = PodAssignEventHandler(handle.informer_factory,
+                                                   clock=handle.clock)
+
+    @classmethod
+    def new(cls, args, handle) -> "TargetLoadPacking":
+        return cls(args, handle)
+
+    def close(self) -> None:
+        self.collector.stop()
+        self.event_handler.stop()
+
+    # -- prediction (targetloadpacking.go:286-294) ----------------------------
+
+    def predict_utilisation(self, container: Container) -> float:
+        if CPU in container.limits:
+            return float(container.limits[CPU])
+        if CPU in container.requests:
+            return round(container.requests[CPU] * self.args.default_requests_multiplier)
+        return float(self.args.default_requests_cpu_millis)
+
+    def _pod_predicted_millis(self, pod: Pod) -> float:
+        total = sum(self.predict_utilisation(c) for c in pod.spec.containers)
+        total += pod.spec.overhead.get(CPU, 0)
+        return total
+
+    # -- Score ----------------------------------------------------------------
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        node_info = self.handle.snapshot_shared_lister().get(node_name)
+        if node_info is None:
+            return MIN_NODE_SCORE, Status.error(f"node {node_name} not in snapshot")
+        metrics = self.collector.get_all_metrics()
+        if metrics is None or not metrics.data:
+            klog.V(5).info_s("metrics not available, min score", node=node_name)
+            return MIN_NODE_SCORE, Status.success()
+        node_metrics = metrics.data.get(node_name)
+        if node_metrics is None:
+            return MIN_NODE_SCORE, Status.success()
+
+        cpu_util_percent = None
+        for m in node_metrics.metrics:
+            if m.type == CPU_TYPE and m.operator in (AVERAGE, LATEST):
+                cpu_util_percent = m.value
+        if cpu_util_percent is None:
+            klog.error_s(None, "cpu metric not found", node=node_name)
+            return MIN_NODE_SCORE, Status.success()
+
+        cap_millis = float(node_info.node.status.capacity.get(CPU, 0))
+        if cap_millis == 0:
+            return MIN_NODE_SCORE, Status.success()
+        util_millis = cpu_util_percent / 100.0 * cap_millis
+
+        # recently-assigned pods whose load the watcher can't see yet
+        # (:234-251)
+        missing_millis = 0.0
+        for ts, p in self.event_handler.recent_pods(node_name):
+            if ts > metrics.window.end or \
+                    (metrics.window.end - ts) < METRICS_AGENT_REPORTING_INTERVAL_S:
+                missing_millis += self._pod_predicted_millis(p)
+
+        predicted = 100.0 * (util_millis + self._pod_predicted_millis(pod)
+                             + missing_millis) / cap_millis
+        target = float(self.args.target_utilization)
+        if predicted > target:
+            if predicted > 100.0:
+                return MIN_NODE_SCORE, Status.success()
+            return int(round(target * (100.0 - predicted) / (100.0 - target))), \
+                Status.success()
+        return int(round((100.0 - target) * predicted / target + target)), \
+            Status.success()

@@ -1,0 +1,177 @@
+"""Load-watcher client model — replacement for the vendored
+github.com/paypal/load-watcher dependency (SURVEY §2 vendored deps).
+
+The reference consumes cluster load metrics either from a load-watcher HTTP
+service or an in-process library client
+(/root/reference/pkg/trimaran/targetloadpacking/targetloadpacking.go:82-96).
+Same here: ``ServiceClient`` GETs JSON from a local endpoint, ``LibraryClient``
+wraps a provider callable. ``Collector`` caches metrics behind a lock and
+refreshes every 30 s (collector.go:45-99).
+
+TPU-native extension: metric type "TPU" (tensorcore duty-cycle %) rides the
+same pipeline so load-aware scoring can see accelerator pressure, not just
+host CPU.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ...util import klog
+
+CPU_TYPE = "CPU"
+MEMORY_TYPE = "Memory"
+TPU_TYPE = "TPU"
+
+AVERAGE = "Average"
+STD = "Std"
+LATEST = "Latest"
+
+METRICS_AGENT_REPORTING_INTERVAL_S = 60   # handler.go:37
+
+
+@dataclass
+class Metric:
+    name: str = ""
+    type: str = CPU_TYPE
+    operator: str = AVERAGE
+    rollup: str = ""
+    value: float = 0.0   # percent of capacity
+
+
+@dataclass
+class NodeMetrics:
+    metrics: List[Metric] = field(default_factory=list)
+
+
+@dataclass
+class Window:
+    duration: str = "15m"
+    start: float = 0.0
+    end: float = 0.0
+
+
+@dataclass
+class WatcherMetrics:
+    timestamp: float = 0.0
+    window: Window = field(default_factory=Window)
+    data: Dict[str, NodeMetrics] = field(default_factory=dict)
+
+    @staticmethod
+    def from_json(doc: dict) -> "WatcherMetrics":
+        window = doc.get("window", {})
+        data = {}
+        for node, nm in (doc.get("data", {}).get("NodeMetricsMap", {})).items():
+            data[node] = NodeMetrics(metrics=[
+                Metric(name=m.get("name", ""), type=m.get("type", CPU_TYPE),
+                       operator=m.get("operator", ""), value=float(m.get("value", 0)))
+                for m in nm.get("metrics", [])])
+        return WatcherMetrics(
+            timestamp=float(doc.get("timestamp", 0)),
+            window=Window(duration=window.get("duration", ""),
+                          start=float(window.get("start", 0)),
+                          end=float(window.get("end", 0))),
+            data=data)
+
+
+class LibraryClient:
+    """In-process metrics provider (the reference's library-mode watcher)."""
+
+    def __init__(self, provider: Callable[[], Optional[WatcherMetrics]]):
+        self._provider = provider
+
+    def get_latest_watcher_metrics(self) -> Optional[WatcherMetrics]:
+        return self._provider()
+
+
+class ServiceClient:
+    """HTTP watcher client (GET <address>/watcher, JSON)."""
+
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+
+    def get_latest_watcher_metrics(self) -> Optional[WatcherMetrics]:
+        try:
+            with urllib.request.urlopen(self.address + "/watcher", timeout=5) as r:
+                return WatcherMetrics.from_json(json.loads(r.read()))
+        except Exception as e:
+            klog.error_s(e, "load-watcher fetch failed", address=self.address)
+            return None
+
+
+class Collector:
+    """Cached metrics + refresh loop (collector.go:45-99). Each plugin owns
+    its own Collector — deliberately not shared (collector.go:38-44)."""
+
+    def __init__(self, client, refresh_interval_s: float = 30.0,
+                 auto_refresh: bool = True):
+        self._client = client
+        self._interval = refresh_interval_s
+        self._lock = threading.RLock()
+        self._metrics: Optional[WatcherMetrics] = None
+        self._stop = threading.Event()
+        self.update_metrics()
+        if auto_refresh:
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="trimaran-collector")
+            t.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.update_metrics()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def update_metrics(self) -> None:
+        m = self._client.get_latest_watcher_metrics()
+        if m is not None:
+            with self._lock:
+                self._metrics = m
+
+    def get_all_metrics(self) -> Optional[WatcherMetrics]:
+        with self._lock:
+            return self._metrics
+
+    def get_node_metrics(self, node_name: str) -> Optional[List[Metric]]:
+        with self._lock:
+            if self._metrics is None:
+                return None
+            nm = self._metrics.data.get(node_name)
+            return nm.metrics if nm else None
+
+
+def make_collector(args, provider=None) -> Collector:
+    """Shared client-selection + Collector construction for the trimaran
+    plugins: explicit provider > watcher_address HTTP service > dead client."""
+    if provider is not None:
+        client = LibraryClient(provider)
+    elif getattr(args, "watcher_address", ""):
+        client = ServiceClient(args.watcher_address)
+    else:
+        client = LibraryClient(lambda: None)
+    return Collector(client,
+                     refresh_interval_s=args.metrics_refresh_interval_seconds)
+
+
+def get_resource_data(metrics: List[Metric], resource_type: str):
+    """(avg, stddev, found) — backward-compatible operator handling
+    (analysis.go getResourceData)."""
+    avg = std = 0.0
+    found = avg_found = False
+    for m in metrics:
+        if m.type != resource_type:
+            continue
+        if m.operator == AVERAGE:
+            avg = m.value
+            avg_found = True
+        elif m.operator == STD:
+            std = m.value
+        elif m.operator in ("", LATEST) and not avg_found:
+            avg = m.value
+        found = True
+    return avg, std, found

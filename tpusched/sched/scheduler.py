@@ -153,6 +153,7 @@ class Scheduler:
             self._sched_thread.join(timeout=5)
         for t in list(self._binding_threads):
             t.join(timeout=5)
+        self._fw.close()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
