@@ -164,3 +164,26 @@ def test_cross_node_preemption_frees_whole_node():
         c.create_pods([high])
         assert c.wait_for_pods_scheduled([high.key], timeout=15)
         assert all(c.pod(p.key) is None for p in lows)
+
+
+def test_cross_node_dry_run_has_no_prefilter_side_effects():
+    """The what-if dry-run must never re-run full PreFilter plugins — a
+    stateful gate (e.g. Coscheduling's denied-PG TTL cache) would be poisoned
+    by a hypothetical pass (upstream dryRunOnePass runs only RemovePod
+    extensions + Filter)."""
+    from tpusched.fwk import CycleState
+    from tpusched.plugins.crossnodepreemption import CrossNodePreemption
+
+    nodes = [make_tpu_node("h0", chips=4)]
+    victims = [make_pod(f"low-{i}", limits={TPU: 1}, priority=1,
+                        node_name="h0") for i in range(4)]
+    fw, handle, api = new_test_framework(cnp_profile(), nodes=nodes,
+                                         pods=victims)
+    calls = []
+    orig = fw.run_pre_filter_plugins
+    fw.run_pre_filter_plugins = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    plugin = CrossNodePreemption.new(None, handle)
+    high = make_pod("high", limits={TPU: 4}, priority=100)
+    node = plugin._dry_run(CycleState(), high, tuple(victims))
+    assert node == "h0"
+    assert calls == []

@@ -178,3 +178,17 @@ def test_assign_handler_cleanup():
     h.cleanup()
     pods = [p.name for _, p in h.recent_pods("n1")]
     assert pods == ["new"]
+
+
+def test_assign_handler_stop_detaches_from_informer():
+    """After stop(), informer events must no longer feed the cache (the
+    handler's registration is removed, not just its GC thread)."""
+    fw, handle, api = new_test_framework(minimal_profile())
+    h = PodAssignEventHandler(handle.informer_factory, auto_cleanup=False)
+    from tpusched.apiserver import server as srv
+    p1 = make_pod("p1", node_name="n1")
+    api.create(srv.PODS, p1)
+    assert [p.name for _, p in h.recent_pods("n1")] == ["p1"]
+    h.stop()
+    api.create(srv.PODS, make_pod("p2", node_name="n1"))
+    assert [p.name for _, p in h.recent_pods("n1")] == ["p1"]

@@ -43,12 +43,13 @@ class Informer:
                 h(ev.object)
 
     def add_event_handler(self, on_add=None, on_update=None, on_delete=None,
-                          replay: bool = True) -> None:
+                          replay: bool = True):
         """client-go AddEventHandler: with replay, on_add fires for every
         object already in the cache. Snapshot+append happen under the informer
         lock so an object created in between is either in the replay set or
         delivered live (at-least-once; handlers must tolerate duplicate adds,
-        as client-go's must)."""
+        as client-go's must). Returns a registration token for
+        remove_event_handler (client-go's ResourceEventHandlerRegistration)."""
         with self._lock:
             existing = (list(self._cache.values())
                         if (replay and on_add) else [])
@@ -60,6 +61,20 @@ class Informer:
                 self._on_delete.append(on_delete)
         for o in existing:
             on_add(o)
+        return (on_add, on_update, on_delete)
+
+    def remove_event_handler(self, registration) -> None:
+        """Detach a registration returned by add_event_handler so a stopped
+        component (e.g. the Trimaran assign handler) no longer receives
+        events."""
+        on_add, on_update, on_delete = registration
+        with self._lock:
+            if on_add in self._on_add:
+                self._on_add.remove(on_add)
+            if on_update in self._on_update:
+                self._on_update.remove(on_update)
+            if on_delete in self._on_delete:
+                self._on_delete.remove(on_delete)
 
     # -- lister ---------------------------------------------------------------
     # Listers return SHARED references, exactly like client-go listers share

@@ -49,8 +49,14 @@ def decode_plugin_args(plugin_name: str, raw: Dict[str, Any]):
 def _camel_to_snake(name: str) -> str:
     out = []
     for i, c in enumerate(name):
-        if c.isupper() and i > 0 and (not name[i - 1].isupper()):
-            out.append("_")
+        if c.isupper() and i > 0:
+            # boundary at lower→Upper and at the end of an acronym run
+            # (Upper followed by lower), so "deniedPGExpirationTimeSeconds"
+            # maps to denied_pg_expiration_time_seconds.
+            prev_upper = name[i - 1].isupper()
+            next_lower = i + 1 < len(name) and name[i + 1].islower()
+            if not prev_upper or next_lower:
+                out.append("_")
         out.append(c.lower())
     return "".join(out)
 

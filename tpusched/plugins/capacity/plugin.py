@@ -30,9 +30,8 @@ from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
                                RESOURCE_ELASTIC_QUOTA, RESOURCE_POD)
 from ...fwk.nodeinfo import NodeInfo
 from ...sched.preemption import (Evaluator, PreemptionInterface,
-                                 dry_run_add, dry_run_remove,
-                                 filter_pods_with_pdb_violation,
-                                 more_important_pod)
+                                 dry_run_remove, more_important_pod,
+                                 reprieve_victims)
 from ...util import klog
 from ...util.podutil import assigned, is_pod_terminated, pod_effective_request
 from .elasticquota_info import ElasticQuotaInfo, ElasticQuotaInfos
@@ -347,35 +346,10 @@ class _Preemptor(PreemptionInterface):
                     or infos.aggregated_used_over_min_with(pfs.pod_req)):
                 return [], 0, Status.unschedulable("global quota max exceeded")
 
-        victims: List[Pod] = []
-        num_violating = 0
-        potential.sort(key=lambda p: (-p.priority,
-                                      p.status.start_time or p.meta.creation_timestamp))
-        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
-
-        def reprieve(p: Pod) -> bool:
-            err = dry_run_add(self.handle, state, pod, p, node_info)
-            if err:
-                raise RuntimeError(err.message())
-            fits = self.handle.run_filter_plugins_with_nominated_pods(
-                state, pod, node_info).is_success()
-            quota_broken = eq is not None and (
+        def quota_broken() -> bool:
+            return eq is not None and (
                 eq.used_over_max_with(pfs.nominated_in_eq_with_req)
                 or infos.aggregated_used_over_min_with(pfs.nominated_with_req))
-            if not fits or quota_broken:
-                err = dry_run_remove(self.handle, state, pod, p, node_info)
-                if err:
-                    raise RuntimeError(err.message())
-                victims.append(p)
-                return fits and not quota_broken
-            return True
 
-        try:
-            for p in violating:
-                if not reprieve(p):
-                    num_violating += 1
-            for p in non_violating:
-                reprieve(p)
-        except RuntimeError as e:
-            return [], 0, Status.error(str(e))
-        return victims, num_violating, Status.success()
+        return reprieve_victims(self.handle, state, pod, node_info, potential,
+                                pdbs, extra_infeasible=quota_broken)

@@ -84,14 +84,12 @@ class CrossNodePreemption(PostFilterPlugin):
                     state_copy, pod, v, info)
                 if not s.is_success():
                     return None
-        # re-run PreFilter so cluster-wide gates see the removals. Plugins
-        # whose PreFilter reuses the dry-run-adjusted cycle state (e.g.
-        # CapacityScheduling's EQ snapshot) re-evaluate correctly; gates that
-        # read the live snapshot directly (coscheduling MinResources) remain
-        # approximate until the victims' deletions land.
-        s = self.handle.framework.run_pre_filter_plugins(state_copy, pod)
-        if not s.is_success():
-            return None
+        # Upstream's dryRunOnePass runs only the RemovePod PreFilter
+        # extensions (done above) plus Filter — never a full PreFilter
+        # re-run, which would leak side effects from stateful gates
+        # (e.g. Coscheduling's denied-PG TTL cache) into a what-if pass.
+        # Cluster-wide gates that read the live snapshot stay approximate
+        # until the victims' deletions land.
         for info in snapshot.list():
             info_to_use = infos.get(info.node.name, info)
             fs = self.handle.run_filter_plugins_with_nominated_pods(

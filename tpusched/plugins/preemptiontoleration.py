@@ -25,8 +25,8 @@ from ..config.types import PreemptionTolerationArgs
 from ..fwk import CycleState, Status
 from ..fwk.interfaces import (PostFilterPlugin, PostFilterResult)
 from ..fwk.nodeinfo import NodeInfo
-from ..sched.preemption import (Evaluator, PreemptionInterface, dry_run_add,
-                                dry_run_remove, filter_pods_with_pdb_violation)
+from ..sched.preemption import (Evaluator, PreemptionInterface,
+                                dry_run_remove, reprieve_victims)
 from ..util import klog
 
 ANNOTATION_PREFIX = "preemption-toleration.scheduling.tpu.dev/"
@@ -150,31 +150,5 @@ class _Interface(PreemptionInterface):
         if not s.is_success():
             return [], 0, s
 
-        victims: List[Pod] = []
-        num_violating = 0
-        potential.sort(key=lambda p: (-p.priority,
-                                      p.status.start_time or p.meta.creation_timestamp))
-        violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
-
-        def reprieve(p: Pod) -> bool:
-            err = dry_run_add(self.handle, state, pod, p, node_info)
-            if err:
-                raise RuntimeError(err.message())
-            fits = self.handle.run_filter_plugins_with_nominated_pods(
-                state, pod, node_info).is_success()
-            if not fits:
-                err = dry_run_remove(self.handle, state, pod, p, node_info)
-                if err:
-                    raise RuntimeError(err.message())
-                victims.append(p)
-            return fits
-
-        try:
-            for p in violating:
-                if not reprieve(p):
-                    num_violating += 1
-            for p in non_violating:
-                reprieve(p)
-        except RuntimeError as e:
-            return [], 0, Status.error(str(e))
-        return victims, num_violating, Status.success()
+        return reprieve_victims(self.handle, state, pod, node_info, potential,
+                                pdbs)

@@ -25,7 +25,8 @@ class PodAssignEventHandler:
         self.lock = threading.RLock()
         # node name → [(assign timestamp, pod)]
         self.scheduled_pods_cache: Dict[str, List[Tuple[float, Pod]]] = {}
-        informer_factory.pods().add_event_handler(
+        self._informer = informer_factory.pods()
+        self._registration = self._informer.add_event_handler(
             on_add=self._on_add, on_update=self._on_update,
             on_delete=self._on_delete)
         self._stop = threading.Event()
@@ -77,3 +78,4 @@ class PodAssignEventHandler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._informer.remove_event_handler(self._registration)

@@ -191,3 +191,50 @@ def dry_run_add(handle, state: CycleState, preemptor: Pod, victim: Pod,
     s = handle.framework.run_pre_filter_extension_add_pod(
         state, preemptor, victim, node_info)
     return None if s.is_success() else s
+
+
+def reprieve_victims(handle, state: CycleState, pod: Pod, node_info: NodeInfo,
+                     potential: List[Pod], pdbs: List[PodDisruptionBudget],
+                     extra_infeasible: Optional[Callable[[], bool]] = None,
+                     ) -> Tuple[List[Pod], int, Status]:
+    """The PDB-aware reprieve loop shared by quota preemption and preemption
+    toleration (the reference's defaultpreemption bottom half,
+    capacity_scheduling.go:597-642 / preemption_toleration.go:285-407):
+    add candidates back highest-priority-first; a candidate stays reprieved if
+    the preemptor still fits (and `extra_infeasible`, e.g. the quota-max
+    check, stays false); otherwise it becomes a victim. Returns
+    (victims, num_violating_pdb, status). `potential` must already be removed
+    from `node_info` via dry_run_remove."""
+    victims: List[Pod] = []
+    num_violating = 0
+    potential.sort(key=lambda p: (-p.priority,
+                                  p.status.start_time or p.meta.creation_timestamp))
+    violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+
+    def reprieve(p: Pod) -> bool:
+        err = dry_run_add(handle, state, pod, p, node_info)
+        if err:
+            raise _ReprieveError(err.message())
+        fits = handle.run_filter_plugins_with_nominated_pods(
+            state, pod, node_info).is_success()
+        ok = fits and not (extra_infeasible() if extra_infeasible else False)
+        if not ok:
+            err = dry_run_remove(handle, state, pod, p, node_info)
+            if err:
+                raise _ReprieveError(err.message())
+            victims.append(p)
+        return ok
+
+    try:
+        for p in violating:
+            if not reprieve(p):
+                num_violating += 1
+        for p in non_violating:
+            reprieve(p)
+    except _ReprieveError as e:
+        return [], 0, Status.error(str(e))
+    return victims, num_violating, Status.success()
+
+
+class _ReprieveError(RuntimeError):
+    pass
