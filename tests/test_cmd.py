@@ -1,0 +1,91 @@
+"""CLI binaries: config decoding → fully-wired scheduler, controller options.
+
+Analog of the reference's cmd tier test (cmd/scheduler/main_test.go:48
+TestSetup, 644 LoC): boot the real options stack and assert the
+fully-defaulted profile wiring for every plugin.
+"""
+import json
+import textwrap
+
+import pytest
+
+from tpusched.apiserver import APIServer
+from tpusched.cmd import controller as ctl_cmd
+from tpusched.cmd import scheduler as sched_cmd
+from tpusched.plugins import default_registry
+from tpusched.sched import Scheduler
+
+
+def test_every_canned_profile_wires_fully():
+    """Every canned profile must instantiate every plugin it names."""
+    for name, factory in sched_cmd.CANNED_PROFILES.items():
+        profile = factory()
+        s = Scheduler(APIServer(), default_registry(), profile)
+        for plugin_name in profile.all_plugin_names():
+            assert plugin_name in s.framework.plugins, (name, plugin_name)
+
+
+def test_validate_only_prints_resolved_profile(capsys, tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: gangsched
+          plugins:
+            queueSort:
+              enabled: [{name: Coscheduling}]
+              disabled: [{name: "*"}]
+            permit: {enabled: [{name: Coscheduling}]}
+            filter: {enabled: [{name: TpuSlice}]}
+            score: {enabled: [{name: TpuSlice, weight: 3}]}
+            bind:
+              disabled: [{name: DefaultBinder}]
+              enabled: [{name: TpuSlice}]
+          pluginConfig:
+          - name: Coscheduling
+            args: {permitWaitingTimeSeconds: 5}
+    """))
+    rc = sched_cmd.main(["--config", str(cfg), "--validate-only"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["schedulerName"] == "gangsched"
+    assert out["queueSort"] == "Coscheduling"
+    assert out["filter"][-1] == "TpuSlice"
+    assert out["score"] == [{"name": "TpuSlice", "weight": 3}]
+    assert out["bind"] == ["TpuSlice"]
+    # the framework actually instantiated the named plugins
+    assert "Coscheduling" in out["plugins"] and "TpuSlice" in out["plugins"]
+
+
+def test_validate_only_canned_default(capsys):
+    rc = sched_cmd.main(["--validate-only"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["queueSort"] == "Coscheduling"     # tpu-gang default
+    assert out["permit"] == ["Coscheduling"]
+    assert out["bind"] == ["TpuSlice"]
+
+
+def test_bad_config_is_an_error(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text("apiVersion: nope/v9\nkind: TpuSchedulerConfiguration\nprofiles: [{}]\n")
+    from tpusched.config.scheme import ConfigError
+    with pytest.raises(ConfigError):
+        sched_cmd.main(["--config", str(cfg), "--validate-only"])
+
+
+def test_controller_options_mirror_flags():
+    args = ctl_cmd.build_parser().parse_args(
+        ["--qps", "50", "--burst", "100", "--workers", "3",
+         "--enable-leader-election"])
+    opts = ctl_cmd.options_from_args(args)
+    assert opts.api_qps == 50 and opts.api_burst == 100
+    assert opts.workers == 3 and opts.enable_leader_election
+
+
+def test_controller_defaults_match_reference_budget():
+    """qps=5 burst=10 workers=1 (options.go:43-45)."""
+    opts = ctl_cmd.options_from_args(ctl_cmd.build_parser().parse_args([]))
+    assert (opts.api_qps, opts.api_burst, opts.workers) == (5.0, 10, 1)
+    assert not opts.enable_leader_election

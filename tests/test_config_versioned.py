@@ -1,0 +1,218 @@
+"""Versioned YAML config decoding: defaults merge, strict fields, conversion.
+
+Mirrors the reference's config round-trip/defaulting tier:
+apis/config/scheme/scheme_test.go (YAML through the real codec, strict) and
+apis/config/v1beta{2,3}/defaults_test.go.
+"""
+import textwrap
+
+import pytest
+
+from tpusched.config import types as t
+from tpusched.config import versioned as v
+from tpusched.config.scheme import ConfigError
+
+COSCHED_YAML = textwrap.dedent("""
+    apiVersion: tpusched.config.tpu.dev/v1beta1
+    kind: TpuSchedulerConfiguration
+    leaderElection:
+      leaderElect: false
+    clientConnection:
+      qps: 50
+      burst: 100
+    profiles:
+    - schedulerName: tpusched
+      plugins:
+        queueSort:
+          enabled:
+          - name: Coscheduling
+          disabled:
+          - name: "*"
+        preFilter:
+          enabled:
+          - name: Coscheduling
+        postFilter:
+          enabled:
+          - name: Coscheduling
+        permit:
+          enabled:
+          - name: Coscheduling
+        reserve:
+          enabled:
+          - name: Coscheduling
+        postBind:
+          enabled:
+          - name: Coscheduling
+      pluginConfig:
+      - name: Coscheduling
+        args:
+          permitWaitingTimeSeconds: 10
+          deniedPGExpirationTimeSeconds: 3
+""")
+
+
+def test_decode_coscheduling_profile():
+    cfg = v.loads(COSCHED_YAML)
+    p = cfg.profile("tpusched")
+    assert p.queue_sort == "Coscheduling"
+    assert p.pre_filter == ["Coscheduling"]
+    assert p.post_filter == ["Coscheduling"]
+    assert p.permit == ["Coscheduling"]
+    assert p.post_bind == ["Coscheduling"]
+    # default filter set survives untouched
+    assert p.filter == ["NodeUnschedulable", "NodeName", "NodeSelector",
+                        "TaintToleration", "NodeResourcesFit"]
+    assert p.bind == ["DefaultBinder"]
+    args = p.plugin_args["Coscheduling"]
+    assert args.permit_waiting_time_seconds == 10
+    assert args.denied_pg_expiration_time_seconds == 3
+    assert cfg.client_connection.qps == 50
+    assert cfg.client_connection.burst == 100
+
+
+def test_defaults_without_plugin_config():
+    cfg = v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+          plugins:
+            permit: {enabled: [{name: Coscheduling}]}
+          pluginConfig:
+          - name: Coscheduling
+            args: {}
+    """))
+    args = cfg.profile().plugin_args["Coscheduling"]
+    # v1beta3/defaults.go:29-30 in the reference
+    assert args.permit_waiting_time_seconds == t.DEFAULT_PERMIT_WAITING_TIME_SECONDS == 60
+    assert args.denied_pg_expiration_time_seconds == t.DEFAULT_DENIED_PG_EXPIRATION_TIME_SECONDS == 20
+
+
+def test_custom_bind_replaces_default_binder():
+    cfg = v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+          plugins:
+            bind:
+              disabled: [{name: DefaultBinder}]
+              enabled: [{name: TpuSlice}]
+            score:
+              enabled: [{name: TpuSlice, weight: 2}]
+    """))
+    p = cfg.profile()
+    assert p.bind == ["TpuSlice"]
+    assert p.score == [("TpuSlice", 2)]
+
+
+@pytest.mark.parametrize("mutation,msg", [
+    ({"apiVersion": "bogus/v1"}, "unsupported apiVersion"),
+    ({"kind": "KubeSchedulerConfiguration"}, "unsupported kind"),
+    ({"bogusField": 1}, "unknown field"),
+    ({"profiles": None}, "at least one profile"),
+])
+def test_strict_top_level(mutation, msg):
+    import yaml
+    raw = yaml.safe_load(COSCHED_YAML)
+    raw.update(mutation)
+    with pytest.raises(ConfigError, match=msg):
+        v.decode(raw)
+
+
+def test_strict_unknown_args_field():
+    bad = COSCHED_YAML.replace("permitWaitingTimeSeconds", "permitWaitingTimeSecs")
+    with pytest.raises(ConfigError, match="unknown field"):
+        v.loads(bad)
+
+
+def test_strict_unknown_extension_point():
+    with pytest.raises(ConfigError, match="unknown extension point"):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1beta1
+            kind: TpuSchedulerConfiguration
+            profiles:
+            - schedulerName: tpusched
+              plugins:
+                preemptAggressively: {enabled: [{name: X}]}
+        """))
+
+
+def test_double_enable_rejected():
+    with pytest.raises(ConfigError, match="enabled twice"):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1beta1
+            kind: TpuSchedulerConfiguration
+            profiles:
+            - schedulerName: tpusched
+              plugins:
+                permit:
+                  enabled: [{name: Coscheduling}, {name: Coscheduling}]
+        """))
+
+
+def test_multi_queue_sort_rejected():
+    with pytest.raises(ConfigError, match="exactly one queueSort"):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1beta1
+            kind: TpuSchedulerConfiguration
+            profiles:
+            - schedulerName: tpusched
+              plugins:
+                queueSort:
+                  enabled: [{name: Coscheduling}, {name: QOSSort}]
+        """))
+
+
+def test_v1alpha1_conversion_renames():
+    cfg = v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1alpha1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+          plugins:
+            permit: {enabled: [{name: Coscheduling}]}
+          pluginConfig:
+          - name: Coscheduling
+            args:
+              permitWaitingSeconds: 7
+              deniedPGExpirationSeconds: 2
+          - name: MultiSlice
+            args:
+              dcnDomainScore: 90
+    """))
+    args = cfg.profile().plugin_args["Coscheduling"]
+    assert args.permit_waiting_time_seconds == 7
+    assert args.denied_pg_expiration_time_seconds == 2
+    assert cfg.profile().plugin_args["MultiSlice"].same_domain_score == 90
+
+
+def test_v1alpha1_conflicting_legacy_and_current():
+    with pytest.raises(ConfigError, match="both legacy"):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1alpha1
+            kind: TpuSchedulerConfiguration
+            profiles:
+            - schedulerName: tpusched
+              pluginConfig:
+              - name: Coscheduling
+                args:
+                  permitWaitingSeconds: 7
+                  permitWaitingTimeSeconds: 9
+        """))
+
+
+def test_round_trip_encode_decode():
+    cfg = v.loads(COSCHED_YAML)
+    re = v.decode(v.encode(cfg))
+    assert re.profile("tpusched") == cfg.profile("tpusched")
+    assert re.client_connection == cfg.client_connection
+    assert re.leader_election == cfg.leader_election
+
+
+def test_duplicate_scheduler_names_rejected():
+    import yaml
+    raw = yaml.safe_load(COSCHED_YAML)
+    raw["profiles"] = raw["profiles"] * 2
+    with pytest.raises(ConfigError, match="duplicate schedulerName"):
+        v.decode(raw)

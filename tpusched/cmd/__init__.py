@@ -1,0 +1,9 @@
+"""CLI entry points — the analog of the reference's two binaries
+(/root/reference/cmd/scheduler/main.go:30-47, cmd/controller/controller.go:30):
+
+- ``python -m tpusched.cmd.scheduler`` — the scheduler binary: decodes a
+  versioned YAML config, registers every in-tree plugin, runs the scheduling
+  loop.
+- ``python -m tpusched.cmd.controller`` — the controller manager: PodGroup +
+  ElasticQuota reconcilers with optional leader election.
+"""

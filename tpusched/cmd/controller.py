@@ -1,0 +1,66 @@
+"""The controller-manager binary.
+
+Analog of /root/reference/cmd/controller (controller.go:30 → app/server.go:55):
+runs the PodGroup phase controller and the ElasticQuota usage controller with
+optional leader election. Flags mirror ServerRunOptions
+(cmd/controller/app/options.go:39-47): --qps --burst --workers
+--enable-leader-election (the kubeconfig/in-cluster pair is meaningless
+against the in-process server and intentionally absent).
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from ..apiserver import APIServer
+from ..controllers.runner import ControllerRunner, ServerRunOptions
+from ..util import klog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-controller",
+        description="PodGroup + ElasticQuota controller manager")
+    p.add_argument("--qps", type=float, default=5.0,
+                   help="API budget: queries per second (options.go:43)")
+    p.add_argument("--burst", type=int, default=10,
+                   help="API budget: burst (options.go:44)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="reconcile workers per controller (options.go:45)")
+    p.add_argument("--enable-leader-election", action="store_true",
+                   help="campaign for the sched-plugins-controller lease")
+    p.add_argument("-v", "--verbosity", type=int, default=2)
+    return p
+
+
+def options_from_args(args) -> ServerRunOptions:
+    return ServerRunOptions(api_qps=args.qps, api_burst=args.burst,
+                            workers=args.workers,
+                            enable_leader_election=args.enable_leader_election)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    klog.set_verbosity(args.verbosity)
+    api = APIServer()
+    runner = ControllerRunner(api, options_from_args(args))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    runner.run()
+    klog.info_s("controller manager running", workers=args.workers,
+                leaderElection=args.enable_leader_election)
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        runner.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

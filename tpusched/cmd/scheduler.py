@@ -1,0 +1,125 @@
+"""The scheduler binary.
+
+Analog of /root/reference/cmd/scheduler/main.go:30-47: build the scheduler
+command with every out-of-tree plugin registered (app.WithPlugin), decode the
+--config YAML into typed, defaulted profiles through the versioned scheme,
+and run the scheduling loop.
+
+Because the rebuild's API server is in-process (SURVEY §5 "Checkpoint /
+resume": etcd-as-truth), the binary hosts one and can emulate a TPU node pool
+behind it (``--emulate-pool``) so the whole stack is drivable end-to-end from
+the command line; ``--validate-only`` decodes + wires the config and prints
+the resolved profile without scheduling (the smoke path main_test.go's
+TestSetup exercises in the reference).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from ..apiserver import APIServer
+from ..apiserver import server as srv
+from ..config import profiles as canned
+from ..config import versioned
+from ..plugins import default_registry
+from ..sched import Scheduler
+from ..util import klog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-scheduler",
+        description="TPU-native scheduler (gang, quota, ICI-topology, load-aware)")
+    p.add_argument("--config", help="versioned TpuSchedulerConfiguration YAML")
+    p.add_argument("--profile", default="tpu-gang",
+                   choices=sorted(CANNED_PROFILES),
+                   help="canned profile when --config is not given")
+    p.add_argument("--scheduler-name", default=None,
+                   help="which profile (schedulerName) in --config to run")
+    p.add_argument("--emulate-pool", default=None, metavar="DIMS",
+                   help="emulate a v5p pool with these torus dims, e.g. 8x8x4")
+    p.add_argument("--validate-only", action="store_true",
+                   help="decode + wire the config, print the resolved profile, exit")
+    p.add_argument("-v", "--verbosity", type=int, default=2,
+                   help="klog verbosity")
+    return p
+
+
+CANNED_PROFILES = {
+    "tpu-gang": canned.tpu_gang_profile,
+    "capacity": canned.capacity_profile,
+    "tpuslice": canned.tpuslice_profile,
+}
+
+
+def resolve_profile(args) -> "versioned.PluginProfile":
+    if args.config:
+        cfg = versioned.load_file(args.config)
+        if args.scheduler_name:
+            return cfg.profile(args.scheduler_name)
+        return cfg.profiles[0]
+    return CANNED_PROFILES[args.profile]()
+
+
+def profile_summary(scheduler: Scheduler) -> dict:
+    """The resolved wiring, plugin instances included — what the reference's
+    TestSetup asserts on (cmd/scheduler/main_test.go:48)."""
+    prof = scheduler.profile
+    return {
+        "schedulerName": prof.scheduler_name,
+        "queueSort": prof.queue_sort,
+        "preFilter": prof.pre_filter,
+        "filter": prof.filter,
+        "postFilter": prof.post_filter,
+        "preScore": prof.pre_score,
+        "score": [{"name": n, "weight": w} for n, w in prof.score],
+        "reserve": prof.reserve,
+        "permit": prof.permit,
+        "preBind": prof.pre_bind,
+        "bind": prof.bind,
+        "postBind": prof.post_bind,
+        "plugins": sorted(scheduler.framework.plugins),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    klog.set_verbosity(args.verbosity)
+
+    api = APIServer()
+    profile = resolve_profile(args)
+    scheduler = Scheduler(api, default_registry(), profile)
+
+    if args.validate_only:
+        print(json.dumps(profile_summary(scheduler), indent=2))
+        return 0
+
+    if args.emulate_pool:
+        from ..testing.wrappers import make_tpu_pool
+        dims = tuple(int(d) for d in args.emulate_pool.split("x"))
+        topo, nodes = make_tpu_pool("pool-0", dims=dims)
+        api.create(srv.TPU_TOPOLOGIES, topo)
+        for n in nodes:
+            api.create(srv.NODES, n)
+        klog.info_s("emulated TPU pool", dims=args.emulate_pool,
+                    nodes=len(nodes))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    scheduler.run()
+    klog.info_s("scheduler running", schedulerName=profile.scheduler_name)
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        scheduler.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Versioned YAML configuration API for the scheduler binary.
+
+Analog of the reference's KubeSchedulerConfiguration machinery: typed,
+versioned, defaulted plugin args registered into the scheduler scheme so that
+YAML ``pluginConfig`` decodes to typed args structs
+(/root/reference/apis/config/register.go:26-45, apis/config/scheme/scheme.go:30-47),
+with two coexisting API versions and hand-maintained conversion between them
+(/root/reference/apis/config/v1beta2/zz_generated.conversion.go,
+v1beta3/...). Decoding is strict — unknown fields are errors — mirroring the
+reference's strict codecs (scheme.go:35).
+
+The YAML shape mirrors the reference's deployment profiles
+(manifests/*/scheduler-config.yaml): per-extension-point ``enabled`` /
+``disabled`` lists with a ``"*"`` wildcard merged over the default plugin set,
+plus a ``pluginConfig`` list of ``{name, args}`` decoded through the
+``<PluginName>Args`` scheme.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from ..fwk.runtime import PluginProfile
+from .scheme import ARGS_SCHEME, ConfigError, decode_plugin_args
+
+GROUP = "tpusched.config.tpu.dev"
+KIND = "TpuSchedulerConfiguration"
+V1BETA1 = f"{GROUP}/v1beta1"    # current version
+V1ALPHA1 = f"{GROUP}/v1alpha1"  # legacy version, converted on decode
+SUPPORTED_VERSIONS = (V1BETA1, V1ALPHA1)
+
+# The extension points a profile may wire (KubeSchedulerConfiguration's
+# `plugins` map keys; SURVEY §1 "QueueSort → ... → PostBind").
+EXTENSION_POINTS = ("queueSort", "preFilter", "filter", "postFilter",
+                    "preScore", "score", "reserve", "permit", "preBind",
+                    "bind", "postBind")
+
+# Default plugin wiring (the upstream default-plugins analog): what a profile
+# starts from before enabled/disabled merging. `disabled: [{name: "*"}]`
+# clears an extension point, exactly as the coscheduling manifest does for
+# queueSort (manifests/coscheduling/scheduler-config.yaml:12-14).
+DEFAULT_PLUGINS: Dict[str, List[str]] = {
+    "queueSort": ["PrioritySort"],
+    "preFilter": [],
+    "filter": ["NodeUnschedulable", "NodeName", "NodeSelector",
+               "TaintToleration", "NodeResourcesFit"],
+    "postFilter": [],
+    "preScore": [],
+    "score": [],
+    "reserve": [],
+    "permit": [],
+    "preBind": [],
+    "bind": ["DefaultBinder"],
+    "postBind": [],
+}
+
+# v1alpha1 → internal field renames, the hand-maintained conversion table
+# (the analog of zz_generated.conversion.go). Keyed by plugin name; values map
+# legacy camelCase field → current camelCase field.
+_V1ALPHA1_ARG_RENAMES: Dict[str, Dict[str, str]] = {
+    "Coscheduling": {"permitWaitingSeconds": "permitWaitingTimeSeconds",
+                     "deniedPGExpirationSeconds": "deniedPGExpirationTimeSeconds"},
+    "MultiSlice": {"dcnDomainScore": "sameDomainScore",
+                   "dcnAdjacentScore": "adjacentDomainScore"},
+}
+
+
+@dataclass
+class LeaderElectionConfig:
+    """`leaderElection:` block (manifests/coscheduling/scheduler-config.yaml:3-4)."""
+    leader_elect: bool = False
+    lease_duration_seconds: float = 15.0
+    renew_interval_seconds: float = 5.0
+
+
+@dataclass
+class ClientConnectionConfig:
+    """`clientConnection:` block; qps/burst mirror the controller API budget
+    defaults (cmd/controller/app/options.go:43-44)."""
+    qps: float = 5.0
+    burst: int = 10
+    kubeconfig: str = ""   # accepted for shape parity; in-memory server ignores it
+
+
+@dataclass
+class SchedulerConfiguration:
+    """The decoded, internal-version configuration."""
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    client_connection: ClientConnectionConfig = field(default_factory=ClientConnectionConfig)
+    profiles: List[PluginProfile] = field(default_factory=list)
+
+    def profile(self, scheduler_name: str = "tpusched") -> PluginProfile:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        raise ConfigError(f"no profile for scheduler {scheduler_name!r}")
+
+
+def load_file(path: str) -> SchedulerConfiguration:
+    with open(path) as f:
+        return loads(f.read())
+
+
+def loads(text: str) -> SchedulerConfiguration:
+    raw = yaml.safe_load(text)
+    if not isinstance(raw, dict):
+        raise ConfigError("config must be a YAML mapping")
+    return decode(raw)
+
+
+def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
+    version = raw.get("apiVersion")
+    if version not in SUPPORTED_VERSIONS:
+        raise ConfigError(
+            f"unsupported apiVersion {version!r} (supported: {SUPPORTED_VERSIONS})")
+    if raw.get("kind") != KIND:
+        raise ConfigError(f"unsupported kind {raw.get('kind')!r} (want {KIND})")
+
+    known_top = {"apiVersion", "kind", "leaderElection", "clientConnection",
+                 "profiles"}
+    for k in raw:
+        if k not in known_top:
+            raise ConfigError(f"unknown field {k!r} in {KIND}")
+
+    cfg = SchedulerConfiguration()
+    le = raw.get("leaderElection") or {}
+    _check_fields("leaderElection", le,
+                  {"leaderElect", "leaseDurationSeconds", "renewIntervalSeconds"})
+    cfg.leader_election = LeaderElectionConfig(
+        leader_elect=bool(le.get("leaderElect", False)),
+        lease_duration_seconds=float(le.get("leaseDurationSeconds", 15.0)),
+        renew_interval_seconds=float(le.get("renewIntervalSeconds", 5.0)))
+    cc = raw.get("clientConnection") or {}
+    _check_fields("clientConnection", cc, {"qps", "burst", "kubeconfig"})
+    cfg.client_connection = ClientConnectionConfig(
+        qps=float(cc.get("qps", 5.0)), burst=int(cc.get("burst", 10)),
+        kubeconfig=str(cc.get("kubeconfig", "")))
+
+    profiles = raw.get("profiles")
+    if not profiles:
+        raise ConfigError("config must declare at least one profile")
+    for p in profiles:
+        cfg.profiles.append(_decode_profile(p, version))
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(set(names)) != len(names):
+        raise ConfigError(f"duplicate schedulerName in profiles: {names}")
+    return cfg
+
+
+def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
+    _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig"})
+    name = raw.get("schedulerName") or "tpusched"
+    plugins = raw.get("plugins") or {}
+    for ep in plugins:
+        if ep not in EXTENSION_POINTS:
+            raise ConfigError(f"unknown extension point {ep!r}")
+
+    wiring: Dict[str, List[Tuple[str, int]]] = {}
+    for ep in EXTENSION_POINTS:
+        wiring[ep] = _merge_extension_point(ep, plugins.get(ep) or {})
+
+    qs = wiring["queueSort"]
+    if len(qs) != 1:
+        raise ConfigError(
+            f"profile {name!r}: exactly one queueSort plugin required, got "
+            f"{[n for n, _ in qs]}")
+
+    args: Dict[str, Any] = {}
+    for entry in raw.get("pluginConfig") or []:
+        _check_fields("pluginConfig entry", entry, {"name", "args"})
+        pname = entry.get("name")
+        if pname not in ARGS_SCHEME:
+            raise ConfigError(f"pluginConfig for unknown plugin {pname!r}")
+        raw_args = dict(entry.get("args") or {})
+        if version == V1ALPHA1:
+            raw_args = _convert_v1alpha1_args(pname, raw_args)
+        args[pname] = decode_plugin_args(pname, raw_args)
+
+    return PluginProfile(
+        scheduler_name=name,
+        queue_sort=qs[0][0],
+        pre_filter=[n for n, _ in wiring["preFilter"]],
+        filter=[n for n, _ in wiring["filter"]],
+        post_filter=[n for n, _ in wiring["postFilter"]],
+        pre_score=[n for n, _ in wiring["preScore"]],
+        score=list(wiring["score"]),
+        reserve=[n for n, _ in wiring["reserve"]],
+        permit=[n for n, _ in wiring["permit"]],
+        pre_bind=[n for n, _ in wiring["preBind"]],
+        bind=[n for n, _ in wiring["bind"]],
+        post_bind=[n for n, _ in wiring["postBind"]],
+        plugin_args=args,
+    )
+
+
+def _merge_extension_point(ep: str, spec: Dict[str, Any]) -> List[Tuple[str, int]]:
+    """Default plugins + disabled (with "*" wildcard) + enabled, in order."""
+    _check_fields(ep, spec, {"enabled", "disabled"})
+    current: List[Tuple[str, int]] = [(n, 1) for n in DEFAULT_PLUGINS[ep]]
+    for d in spec.get("disabled") or []:
+        _check_fields(f"{ep}.disabled entry", d, {"name"})
+        dname = d.get("name")
+        if dname == "*":
+            current = []
+        else:
+            current = [(n, w) for n, w in current if n != dname]
+    for e in spec.get("enabled") or []:
+        _check_fields(f"{ep}.enabled entry", e, {"name", "weight"})
+        ename = e.get("name")
+        if not ename:
+            raise ConfigError(f"{ep}.enabled entry missing name")
+        if any(n == ename for n, _ in current):
+            raise ConfigError(f"plugin {ename!r} enabled twice at {ep}")
+        current.append((ename, int(e.get("weight", 1))))
+    return current
+
+
+def _convert_v1alpha1_args(plugin: str, raw_args: Dict[str, Any]) -> Dict[str, Any]:
+    renames = _V1ALPHA1_ARG_RENAMES.get(plugin, {})
+    out = {}
+    for k, v in raw_args.items():
+        new = renames.get(k, k)
+        if new in out:
+            raise ConfigError(
+                f"{plugin}Args: both legacy {k!r} and current {new!r} set")
+        out[new] = v
+    return out
+
+
+def encode(cfg: SchedulerConfiguration) -> Dict[str, Any]:
+    """Internal → v1beta1 wire form (round-trip partner of decode; the
+    analog of the conversion machinery's internal→versioned half). Extension
+    points are emitted as explicit full wiring: defaults disabled with "*"
+    and the profile's plugins enabled in order."""
+    profiles = []
+    for p in cfg.profiles:
+        plugins: Dict[str, Any] = {}
+        wiring = {
+            "queueSort": [(p.queue_sort, 1)],
+            "preFilter": [(n, 1) for n in p.pre_filter],
+            "filter": [(n, 1) for n in p.filter],
+            "postFilter": [(n, 1) for n in p.post_filter],
+            "preScore": [(n, 1) for n in p.pre_score],
+            "score": list(p.score),
+            "reserve": [(n, 1) for n in p.reserve],
+            "permit": [(n, 1) for n in p.permit],
+            "preBind": [(n, 1) for n in p.pre_bind],
+            "bind": [(n, 1) for n in p.bind],
+            "postBind": [(n, 1) for n in p.post_bind],
+        }
+        for ep, entries in wiring.items():
+            spec: Dict[str, Any] = {}
+            if DEFAULT_PLUGINS[ep]:
+                spec["disabled"] = [{"name": "*"}]
+            if entries:
+                if ep == "score":
+                    spec["enabled"] = [{"name": n, "weight": w} for n, w in entries]
+                else:
+                    spec["enabled"] = [{"name": n} for n, _ in entries]
+            if spec:
+                plugins[ep] = spec
+        prof: Dict[str, Any] = {"schedulerName": p.scheduler_name}
+        if plugins:
+            prof["plugins"] = plugins
+        if p.plugin_args:
+            prof["pluginConfig"] = [
+                {"name": n, "args": _encode_args(a)}
+                for n, a in sorted(p.plugin_args.items())]
+        profiles.append(prof)
+    return {
+        "apiVersion": V1BETA1,
+        "kind": KIND,
+        "leaderElection": {
+            "leaderElect": cfg.leader_election.leader_elect,
+            "leaseDurationSeconds": cfg.leader_election.lease_duration_seconds,
+            "renewIntervalSeconds": cfg.leader_election.renew_interval_seconds,
+        },
+        "clientConnection": {
+            "qps": cfg.client_connection.qps,
+            "burst": cfg.client_connection.burst,
+            "kubeconfig": cfg.client_connection.kubeconfig,
+        },
+        "profiles": profiles,
+    }
+
+
+def _encode_args(args: Any) -> Dict[str, Any]:
+    import dataclasses
+    out = {}
+    for f in dataclasses.fields(args):
+        out[_snake_to_camel(f.name)] = getattr(args, f.name)
+    return out
+
+
+def _snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _check_fields(ctx: str, raw: Dict[str, Any], allowed: set) -> None:
+    if not isinstance(raw, dict):
+        raise ConfigError(f"{ctx} must be a mapping, got {type(raw).__name__}")
+    for k in raw:
+        if k not in allowed:
+            raise ConfigError(f"unknown field {k!r} in {ctx}")
