@@ -1,0 +1,43 @@
+# Build/test entry points — analog of /root/reference/Makefile:44,81,125
+# (build / unit-test / integration-test / verify).
+
+PY ?= python
+
+.PHONY: all
+all: verify unit-test
+
+.PHONY: unit-test
+unit-test:
+	hack/unit-test.sh
+
+.PHONY: integration-test
+integration-test:
+	hack/integration-test.sh
+
+.PHONY: bench
+bench:
+	$(PY) bench.py
+
+.PHONY: verify
+verify: verify-structured-logging verify-crdgen verify-manifests
+
+.PHONY: verify-structured-logging
+verify-structured-logging:
+	hack/verify-structured-logging.sh
+
+.PHONY: verify-crdgen
+verify-crdgen:
+	hack/verify-crdgen.sh
+
+.PHONY: verify-manifests
+verify-manifests:
+	$(PY) -m pytest tests/test_manifests.py tests/test_config_versioned.py -q
+
+.PHONY: local-image
+local-image:
+	docker build -f build/scheduler/Dockerfile -t tpusched/scheduler:latest .
+	docker build -f build/controller/Dockerfile -t tpusched/controller:latest .
+
+.PHONY: graft-check
+graft-check:
+	$(PY) __graft_entry__.py

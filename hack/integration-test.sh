@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Integration tier — analog of /root/reference/hack/integration-test.sh:35-37
+# (40-minute budget): the TestCluster-driven end-to-end suites (real
+# scheduler + controllers against the in-memory API server) plus the JAX
+# workload bridge.
+set -o errexit -o nounset -o pipefail
+cd "$(dirname "$0")/.."
+exec timeout 2400 python -m pytest -q \
+  tests/test_integration_basic.py tests/test_jaxbridge.py \
+  tests/test_coscheduling.py tests/test_capacity.py tests/test_topology.py \
+  tests/test_multislice.py tests/test_controllers.py \
+  "$@"

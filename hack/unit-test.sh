@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Unit tier — analog of /root/reference/hack/unit-test.sh:24-28 (go test over
+# cmd/pkg/apis): every suite except the slow end-to-end integration files.
+set -o errexit -o nounset -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q \
+  --ignore=tests/test_integration_basic.py \
+  --ignore=tests/test_jaxbridge.py \
+  "$@"

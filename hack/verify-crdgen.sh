@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# CRD drift check — analog of /root/reference/hack/verify-crdgen.sh: the
+# published CRD schemas in manifests/crds/ must cover every field of the API
+# dataclasses (tests/test_manifests.py::test_crd_spec_fields_cover_dataclasses).
+set -o errexit -o nounset -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/test_manifests.py -q "$@"
