@@ -151,3 +151,52 @@ def test_check_cluster_resource_ignores_own_gang_pods():
     infos = [NodeInfo(n, [own])]
     assert check_cluster_resource(infos, {TPU: 4}, "default/pg") is None
     assert check_cluster_resource(infos, {TPU: 4}, "default/other") is not None
+
+
+def test_lightweight_label_only_gang():
+    """KEP-2: CRD-less gang — quorum from the min-available label; no
+    PodGroup CR exists at any point."""
+    from tpusched.api.scheduling import MIN_AVAILABLE_LABEL
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        lbl = {MIN_AVAILABLE_LABEL: "3"}
+        first_two = [make_pod(f"lw{i}", pod_group="lwgang", limits={TPU: 1},
+                              labels=lbl) for i in range(2)]
+        c.create_pods(first_two)
+        assert c.wait_for_pods_unscheduled([p.key for p in first_two], hold=0.6)
+        c.create_pods([make_pod("lw2", pod_group="lwgang", limits={TPU: 1},
+                                labels=lbl)])
+        keys = [p.key for p in first_two] + ["default/lw2"]
+        assert c.wait_for_pods_scheduled(keys, timeout=15)
+        assert c.api.try_get(srv.POD_GROUPS, "default/lwgang") is None
+
+
+def test_label_without_min_available_stays_pending():
+    """A group label naming a CR that doesn't exist (and no min-available
+    label) is held at Permit — reference parity: PodGroupNotFound ⇒
+    Unschedulable (coscheduling.go:191-192)."""
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        p = make_pod("solo", pod_group="ghost-group", limits={TPU: 1})
+        c.create_pods([p])
+        assert c.wait_for_pods_unscheduled([p.key], hold=0.8)
+
+
+def test_lightweight_gang_shares_synthesized_group_and_records_status():
+    """KEP-2 follow-ups: all members share ONE synthesized PodGroup (same
+    QueueSort timestamp), and post_bind tracks status on it (the north-star
+    metric fires for CRD-less gangs too)."""
+    from tpusched.api.scheduling import MIN_AVAILABLE_LABEL
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        lbl = {MIN_AVAILABLE_LABEL: "3"}
+        pods = [make_pod(f"m{i}", pod_group="memo-gang", limits={TPU: 1},
+                         labels=lbl) for i in range(3)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=15)
+        mgr = c.scheduler.framework.plugins["Coscheduling"].pg_mgr
+        pg1 = mgr.get_pod_group(c.pod(pods[0].key))[1]
+        pg2 = mgr.get_pod_group(c.pod(pods[1].key))[1]
+        assert pg1 is pg2
+        assert pg1.status.scheduled == 3
+        assert pg1.status.phase == PG_SCHEDULED

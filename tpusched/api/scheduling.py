@@ -19,6 +19,9 @@ from .resources import ResourceList
 
 GROUP_NAME = "scheduling.tpu.dev"
 POD_GROUP_LABEL = "pod-group." + GROUP_NAME
+# Lightweight (CRD-less) gang admission, KEP-2: quorum declared on the pod
+# itself. Only consulted when no PodGroup CR exists for the labeled name.
+MIN_AVAILABLE_LABEL = POD_GROUP_LABEL + "/min-available"
 
 # PodGroup phases (types.go:84-111). The lifecycle driven by the PodGroup
 # controller is "" → Pending → PreScheduling → Scheduling/Scheduled → Running

@@ -17,13 +17,15 @@ Deliberate fixes over the reference (SURVEY §2 quirks):
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional, Tuple
 
 from ...api.core import Pod
 from ...api.resources import PODS, ResourceList
-from ...api.scheduling import (PG_SCHEDULED, PG_SCHEDULING, POD_GROUP_LABEL,
-                               PodGroup, pod_group_full_name, pod_group_label)
+from ...api.scheduling import (MIN_AVAILABLE_LABEL, PG_SCHEDULED,
+                               PG_SCHEDULING, POD_GROUP_LABEL, PodGroup,
+                               pod_group_full_name, pod_group_label)
 from ...apiserver import server as srv
 from ...fwk import CycleState
 from ...fwk.nodeinfo import NodeInfo
@@ -61,6 +63,14 @@ class PodGroupManager:
         self.pod_informer = handle.informer_factory.pods()
         self.last_denied_pg = TTLCache(denied_pg_expiration_s)
         self.permitted_pg = TTLCache(schedule_timeout_s)
+        # KEP-2 lightweight gangs: one synthesized PodGroup instance per
+        # "ns/name", created on first sight. Sharing the instance gives every
+        # member the same QueueSort timestamp (gangs drain contiguously),
+        # keeps the hot queue comparator allocation-free, and lets post_bind
+        # track status/metrics for groups that have no CR to patch. TTL'd so
+        # abandoned CRD-less gang names don't accumulate forever.
+        self._synthesized_pgs = TTLCache(max(3600.0, 60 * schedule_timeout_s))
+        self._synthesized_status_lock = threading.Lock()
 
     # -- lookups --------------------------------------------------------------
 
@@ -69,7 +79,34 @@ class PodGroupManager:
         if not name:
             return "", None
         full = f"{pod.namespace}/{name}"
-        return full, self.pg_informer.get(full)
+        pg = self.pg_informer.get(full)
+        if pg is None:
+            pg = self._synthesize_pod_group(pod, name)
+        return full, pg
+
+    def _synthesize_pod_group(self, pod: Pod, name: str) -> Optional[PodGroup]:
+        """Lightweight (CRD-less) gang admission, KEP-2: a pod labeled with a
+        group name plus MIN_AVAILABLE_LABEL gets an in-memory PodGroup with
+        that quorum. Without the min-available label this returns None and
+        the pod is held at Permit (reference parity: PodGroupNotFound ⇒
+        Unschedulable, coscheduling.go:191-192)."""
+        raw = pod.meta.labels.get(MIN_AVAILABLE_LABEL, "")
+        try:
+            min_available = int(raw)
+        except ValueError:
+            return None
+        if min_available <= 0:
+            return None
+        full = f"{pod.namespace}/{name}"
+        cached, ok = self._synthesized_pgs.get(full)
+        if ok:
+            return cached
+        from ...api.meta import ObjectMeta
+        pg = PodGroup(meta=ObjectMeta(name=name, namespace=pod.namespace,
+                                      creation_timestamp=pod.meta.creation_timestamp))
+        pg.spec.min_member = min_available
+        self._synthesized_pgs.set(full, pg)
+        return pg
 
     def siblings(self, pod: Pod) -> List[Pod]:
         name = pod_group_label(pod)
@@ -169,7 +206,15 @@ class PodGroupManager:
         try:
             self.handle.clientset.podgroups.patch(full, mutate)
         except srv.NotFound:
-            pass
+            # KEP-2 synthesized group: no CR to patch — track status on the
+            # memoized instance so quorum completion (and the north-star
+            # PodGroup-to-Bound observation inside mutate) still happens.
+            synthesized, ok = self._synthesized_pgs.get(full)
+            if ok:
+                # binding cycles run on their own threads; the CR path is
+                # serialized by the API server, this one needs its own lock
+                with self._synthesized_status_lock:
+                    mutate(synthesized)
         except Exception as e:
             klog.error_s(e, "failed to patch PodGroup", podGroup=full)
 
