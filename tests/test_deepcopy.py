@@ -1,0 +1,188 @@
+"""Hand-rolled API-object deepcopies: equal to the generic copy, and fully
+independent (mutating the copy never leaks into the original).
+
+The API-server store copies every object on read/write (server.py), so these
+fast copies are on the hot scheduling path; a missed nested container here
+would silently alias informer-cache state — exactly the Quantity-aliasing
+class of bug the reference has (SURVEY §2 quirks, gpu_node.go:134-144).
+"""
+from __future__ import annotations
+
+import copy
+
+from tpusched.api.core import (Container, Pod, PodCondition,
+                               PodDisruptionBudget, PriorityClass, Taint,
+                               Toleration)
+from tpusched.api.meta import ObjectMeta, OwnerReference
+from tpusched.api.scheduling import ElasticQuota, PodGroup
+from tpusched.api.topology import TpuTopology
+
+
+def make_pod() -> Pod:
+    p = Pod()
+    p.meta = ObjectMeta(name="p", namespace="ns",
+                        labels={"a": "1"}, annotations={"b": "2"},
+                        owner_references=[OwnerReference(kind="Job", name="j")])
+    p.spec.containers = [Container(requests={"cpu": 1000},
+                                   limits={"google.com/tpu": 4})]
+    p.spec.init_containers = [Container(name="init", requests={"cpu": 500})]
+    p.spec.node_selector = {"pool": "a"}
+    p.spec.tolerations = [Toleration(key="tpu", operator="Exists")]
+    p.spec.overhead = {"cpu": 10}
+    p.status.conditions = [PodCondition(type="PodScheduled")]
+    return p
+
+
+def assert_equal_and_independent(obj, mutators):
+    """copy == original (vs the generic deep copy), then each mutator applied
+    to the copy must leave the original untouched."""
+    reference = copy.deepcopy(obj)
+    got = obj.deepcopy()
+    assert got == reference
+    for mutate in mutators:
+        cp = obj.deepcopy()
+        mutate(cp)
+        assert obj == reference, f"mutation leaked into original via {mutate}"
+
+
+def test_pod_deepcopy():
+    assert_equal_and_independent(make_pod(), [
+        lambda p: p.meta.labels.update(x="y"),
+        lambda p: p.meta.annotations.clear(),
+        lambda p: setattr(p.meta.owner_references[0], "name", "changed"),
+        lambda p: p.spec.containers[0].requests.update(cpu=9),
+        lambda p: p.spec.containers[0].limits.clear(),
+        lambda p: p.spec.init_containers[0].requests.update(cpu=9),
+        lambda p: p.spec.node_selector.update(pool="b"),
+        lambda p: setattr(p.spec.tolerations[0], "key", "changed"),
+        lambda p: p.spec.overhead.update(cpu=99),
+        lambda p: setattr(p.status.conditions[0], "status", "False"),
+        lambda p: p.status.conditions.append(PodCondition(type="Ready")),
+    ])
+
+
+def test_node_deepcopy():
+    from tpusched.testing import make_node
+    n = make_node("n1", capacity={"cpu": 8000, "google.com/tpu": 4})
+    n.spec.taints = [Taint(key="tpu", effect="NoSchedule")]
+    n.meta.labels["tpu.dev/pool"] = "pool-a"
+    assert_equal_and_independent(n, [
+        lambda m: m.status.allocatable.update(cpu=1),
+        lambda m: m.status.capacity.clear(),
+        lambda m: setattr(m.spec.taints[0], "key", "changed"),
+        lambda m: m.meta.labels.clear(),
+    ])
+
+
+def test_pod_group_deepcopy():
+    pg = PodGroup()
+    pg.meta.name = "gang"
+    pg.spec.min_member = 8
+    pg.spec.min_resources = {"cpu": 1000}
+    pg.status.scheduled = 3
+    assert_equal_and_independent(pg, [
+        lambda g: g.spec.min_resources.update(cpu=9),
+        lambda g: setattr(g.status, "scheduled", 99),
+        lambda g: setattr(g.spec, "min_member", 1),
+    ])
+    # None min_resources stays None
+    pg2 = PodGroup()
+    assert pg2.deepcopy().spec.min_resources is None
+
+
+def test_elastic_quota_deepcopy():
+    eq = ElasticQuota()
+    eq.meta.name = "q"
+    eq.spec.min = {"cpu": 1}
+    eq.spec.max = {"cpu": 2}
+    eq.status.used = {"cpu": 1}
+    assert_equal_and_independent(eq, [
+        lambda q: q.spec.min.update(cpu=9),
+        lambda q: q.spec.max.clear(),
+        lambda q: q.status.used.update(cpu=9),
+    ])
+
+
+def test_tpu_topology_deepcopy():
+    t = TpuTopology()
+    t.meta.name = "pool-a"
+    t.spec.pool = "pool-a"
+    t.spec.dims = (8, 8, 4)
+    t.spec.hosts = {"n1": (0, 0, 0), "n2": (2, 0, 0)}
+    assert_equal_and_independent(t, [
+        lambda x: x.spec.hosts.update(n3=(4, 0, 0)),
+        lambda x: setattr(x.spec, "dims", (1,)),
+    ])
+
+
+def _sentinel(tp, counter):
+    """A non-default value of type `tp` (recursing into containers)."""
+    import dataclasses
+    import typing
+    counter[0] += 1
+    n = counter[0]
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[...]
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _sentinel(args[0], counter)
+    if origin is list:
+        (elem,) = typing.get_args(tp)
+        return [_sentinel(elem, counter)]
+    if origin is dict:
+        k, v = typing.get_args(tp)
+        return {_sentinel(k, counter): _sentinel(v, counter)}
+    if origin is tuple:
+        args = typing.get_args(tp)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return (_sentinel(args[0], counter), _sentinel(args[0], counter))
+        return tuple(_sentinel(a, counter) for a in args)
+    if dataclasses.is_dataclass(tp):
+        return _populated(tp, counter)
+    if tp is bool:
+        return True
+    if tp is int:
+        return n
+    if tp is float:
+        return n + 0.5
+    if tp is str:
+        return f"s{n}"
+    raise TypeError(f"no sentinel for {tp}")
+
+
+def _populated(cls, counter):
+    """Instance of `cls` with EVERY field set to a non-default sentinel."""
+    import dataclasses
+    import typing
+    obj = cls()
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        setattr(obj, f.name, _sentinel(hints[f.name], counter))
+    # re-apply constructor invariants (cluster-scoped kinds force
+    # meta.namespace="") — the hand-rolled copies go through __init__ and
+    # legitimately re-establish them
+    post = getattr(obj, "__post_init__", None)
+    if post is not None:
+        post()
+    return obj
+
+
+def test_deepcopy_covers_every_field():
+    """Drift guard: a field added to any API dataclass without updating its
+    hand-rolled deepcopy silently resets to default on every API-server
+    read/write. Populating every field programmatically makes that drift a
+    loud equality failure instead."""
+    from tpusched.api.core import Node
+    for cls in (ObjectMeta, Pod, Node, PodGroup, ElasticQuota, TpuTopology,
+                PriorityClass, PodDisruptionBudget):
+        obj = _populated(cls, [0])
+        assert obj.deepcopy() == copy.deepcopy(obj), \
+            f"{cls.__name__}.deepcopy dropped a field"
+
+
+def test_priority_class_and_pdb_deepcopy():
+    pc = PriorityClass(value=100)
+    pc.meta.name = "high"
+    assert_equal_and_independent(pc, [lambda c: setattr(c, "value", 0)])
+    pdb = PodDisruptionBudget(selector={"app": "x"}, disruptions_allowed=1)
+    pdb.meta.name = "pdb"
+    assert_equal_and_independent(pdb, [lambda b: b.selector.clear()])

@@ -7,8 +7,7 @@ plain dataclasses. Semantics follow the reference's usage of client-go types
 """
 from __future__ import annotations
 
-import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from .meta import ObjectMeta
@@ -105,7 +104,29 @@ class Pod:
         return self.spec.priority
 
     def deepcopy(self) -> "Pod":
-        return copy.deepcopy(self)
+        # Hand-rolled copy (see ObjectMeta.deepcopy): every leaf is a scalar.
+        spec = self.spec
+        status = self.status
+        return Pod(
+            meta=self.meta.deepcopy(),
+            spec=PodSpec(
+                containers=[Container(c.name, c.image, dict(c.requests),
+                                      dict(c.limits)) for c in spec.containers],
+                init_containers=[Container(c.name, c.image, dict(c.requests),
+                                           dict(c.limits))
+                                 for c in spec.init_containers],
+                node_name=spec.node_name,
+                node_selector=dict(spec.node_selector),
+                scheduler_name=spec.scheduler_name,
+                priority=spec.priority,
+                priority_class_name=spec.priority_class_name,
+                tolerations=[replace(t) for t in spec.tolerations],
+                overhead=dict(spec.overhead)),
+            status=PodStatus(
+                phase=status.phase,
+                nominated_node_name=status.nominated_node_name,
+                conditions=[replace(c) for c in status.conditions],
+                start_time=status.start_time))
 
     def qos_class(self) -> str:
         """QoS per k8s component-helpers (reference qossort dependency)."""
@@ -159,7 +180,12 @@ class Node:
         return self.meta.name
 
     def deepcopy(self) -> "Node":
-        return copy.deepcopy(self)
+        return Node(
+            meta=self.meta.deepcopy(),
+            spec=NodeSpec(unschedulable=self.spec.unschedulable,
+                          taints=[replace(t) for t in self.spec.taints]),
+            status=NodeStatus(capacity=dict(self.status.capacity),
+                              allocatable=dict(self.status.allocatable)))
 
 
 @dataclass
@@ -174,6 +200,10 @@ class PriorityClass:
     def __post_init__(self):
         self.meta.namespace = ""
 
+    def deepcopy(self) -> "PriorityClass":
+        return PriorityClass(meta=self.meta.deepcopy(), value=self.value,
+                             preemption_policy=self.preemption_policy)
+
 
 @dataclass
 class PodDisruptionBudget:
@@ -182,6 +212,11 @@ class PodDisruptionBudget:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Dict[str, str] = field(default_factory=dict)   # matchLabels only
     disruptions_allowed: int = 0
+
+    def deepcopy(self) -> "PodDisruptionBudget":
+        return PodDisruptionBudget(meta=self.meta.deepcopy(),
+                                   selector=dict(self.selector),
+                                   disruptions_allowed=self.disruptions_allowed)
 
     def matches(self, pod: Pod) -> bool:
         if not self.selector or pod.namespace != self.meta.namespace:

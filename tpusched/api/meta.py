@@ -6,11 +6,10 @@ Replaces k8s.io/apimachinery ObjectMeta for the rebuilt control plane
 """
 from __future__ import annotations
 
-import copy
 import itertools
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 _uid_counter = itertools.count(1)
@@ -49,4 +48,13 @@ class ObjectMeta:
         return f"{self.namespace}/{self.name}"
 
     def deepcopy(self) -> "ObjectMeta":
-        return copy.deepcopy(self)
+        # Hand-rolled: all leaves are scalars, so shallow container copies
+        # give full isolation at a fraction of copy.deepcopy's cost (the
+        # API-server store copies every object on read/write — hot path).
+        return ObjectMeta(
+            name=self.name, namespace=self.namespace, uid=self.uid,
+            labels=dict(self.labels), annotations=dict(self.annotations),
+            creation_timestamp=self.creation_timestamp,
+            deletion_timestamp=self.deletion_timestamp,
+            resource_version=self.resource_version,
+            owner_references=[replace(o) for o in self.owner_references])

@@ -10,8 +10,7 @@ Group name: scheduling.tpu.dev. Gang membership label:
 """
 from __future__ import annotations
 
-import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from .meta import ObjectMeta
@@ -79,7 +78,11 @@ class PodGroup:
         return self.meta.key
 
     def deepcopy(self) -> "PodGroup":
-        return copy.deepcopy(self)
+        spec = replace(self.spec)
+        if self.spec.min_resources is not None:
+            spec.min_resources = dict(self.spec.min_resources)
+        return PodGroup(meta=self.meta.deepcopy(), spec=spec,
+                        status=replace(self.status))
 
 
 @dataclass
@@ -106,7 +109,11 @@ class ElasticQuota:
         return self.meta.key
 
     def deepcopy(self) -> "ElasticQuota":
-        return copy.deepcopy(self)
+        return ElasticQuota(
+            meta=self.meta.deepcopy(),
+            spec=ElasticQuotaSpec(min=dict(self.spec.min),
+                                  max=dict(self.spec.max)),
+            status=ElasticQuotaStatus(used=dict(self.status.used)))
 
 
 def pod_group_label(pod) -> str:

@@ -12,8 +12,7 @@ Group: topology.tpu.dev.
 """
 from __future__ import annotations
 
-import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, Tuple
 
 from .meta import ObjectMeta
@@ -93,4 +92,6 @@ class TpuTopology:
         return self.meta.key
 
     def deepcopy(self) -> "TpuTopology":
-        return copy.deepcopy(self)
+        spec = replace(self.spec)
+        spec.hosts = dict(self.spec.hosts)  # coords are immutable tuples
+        return TpuTopology(meta=self.meta.deepcopy(), spec=spec)

@@ -7,7 +7,6 @@ order; Listers read from that cache without touching the server.
 """
 from __future__ import annotations
 
-import copy
 import threading
 from typing import Any, Callable, Dict, List, Optional
 

@@ -16,7 +16,6 @@ Semantics mirrored from the k8s API server as the reference uses it:
 """
 from __future__ import annotations
 
-import copy
 import threading
 import time
 from dataclasses import dataclass, field
@@ -70,7 +69,9 @@ class _Lease:
     lease_duration: float = 15.0
 
     def deepcopy(self):
-        return copy.deepcopy(self)
+        return _Lease(meta=self.meta.deepcopy() if self.meta else None,
+                      holder=self.holder, renew_time=self.renew_time,
+                      lease_duration=self.lease_duration)
 
 
 class APIServer:
@@ -124,20 +125,20 @@ class APIServer:
             key = obj.meta.key
             if key in self._stores[kind]:
                 raise Conflict(f"{kind} {key} already exists")
-            stored = copy.deepcopy(obj)
+            stored = obj.deepcopy()
             if not stored.meta.creation_timestamp:
                 stored.meta.creation_timestamp = self._clock()
             self._bump(stored)
             self._stores[kind][key] = stored
         self._dispatch(WatchEvent(ADDED, kind, stored))
-        return copy.deepcopy(stored)  # callers own (and may mutate) returns
+        return stored.deepcopy()  # callers own (and may mutate) returns
 
     def get(self, kind: str, key: str):
         with self._lock:
             obj = self._stores[kind].get(key)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
-            return copy.deepcopy(obj)
+            return obj.deepcopy()
 
     def try_get(self, kind: str, key: str):
         try:
@@ -148,11 +149,12 @@ class APIServer:
     def list(self, kind: str, namespace: Optional[str] = None,
              selector: Optional[Dict[str, str]] = None) -> List[Any]:
         with self._lock:
-            objs = [copy.deepcopy(o) for o in self._stores[kind].values()
-                    if (namespace is None or o.meta.namespace == namespace)]
-        if selector:
-            objs = [o for o in objs
-                    if all(o.meta.labels.get(k) == v for k, v in selector.items())]
+            # select before copying — only matches pay the per-object copy
+            objs = [o.deepcopy() for o in self._stores[kind].values()
+                    if (namespace is None or o.meta.namespace == namespace)
+                    and (not selector
+                         or all(o.meta.labels.get(k) == v
+                                for k, v in selector.items()))]
         return objs
 
     def update(self, kind: str, obj) -> Any:
@@ -161,13 +163,13 @@ class APIServer:
             old = self._stores[kind].get(key)
             if old is None:
                 raise NotFound(f"{kind} {key} not found")
-            stored = copy.deepcopy(obj)
+            stored = obj.deepcopy()
             stored.meta.creation_timestamp = old.meta.creation_timestamp
             stored.meta.uid = old.meta.uid
             self._bump(stored)
             self._stores[kind][key] = stored
         self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
-        return copy.deepcopy(stored)
+        return stored.deepcopy()
 
     def patch(self, kind: str, key: str, mutate: Callable[[Any], None]) -> Any:
         """Atomic read-modify-write (merge-patch analog). `mutate` runs under
@@ -177,12 +179,12 @@ class APIServer:
             old = self._stores[kind].get(key)
             if old is None:
                 raise NotFound(f"{kind} {key} not found")
-            stored = copy.deepcopy(old)
+            stored = old.deepcopy()
             mutate(stored)
             self._bump(stored)
             self._stores[kind][key] = stored
         self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
-        return copy.deepcopy(stored)
+        return stored.deepcopy()
 
     def delete(self, kind: str, key: str) -> None:
         with self._lock:

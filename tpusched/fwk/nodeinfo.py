@@ -7,7 +7,6 @@ add_pod/remove_pod so preemption dry-runs can simulate victim removal
 """
 from __future__ import annotations
 
-import copy
 from typing import Dict, Iterable, List, Optional
 
 from ..api.core import Node, Pod
