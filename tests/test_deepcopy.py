@@ -166,17 +166,53 @@ def _populated(cls, counter):
     return obj
 
 
+def _mutate_every_container(obj):
+    """Recursively mutate every dict/list (and dataclass scalar) reachable
+    from obj's fields, so any container aliased between a copy and its
+    original shows up as a change to the original."""
+    import dataclasses
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if dataclasses.is_dataclass(v):
+            _mutate_every_container(v)
+        elif isinstance(v, dict):
+            v["__mut__"] = "__mut__"
+        elif isinstance(v, list):
+            for e in v:
+                if dataclasses.is_dataclass(e):
+                    _mutate_every_container(e)
+            v.append("__mut__")
+        elif isinstance(v, bool):
+            setattr(obj, f.name, not v)
+        elif isinstance(v, (int, float)):
+            setattr(obj, f.name, v + 1)
+        elif isinstance(v, str):
+            setattr(obj, f.name, v + "__mut__")
+        # tuples/None are immutable — aliasing them is safe
+
+
 def test_deepcopy_covers_every_field():
-    """Drift guard: a field added to any API dataclass without updating its
-    hand-rolled deepcopy silently resets to default on every API-server
-    read/write. Populating every field programmatically makes that drift a
-    loud equality failure instead."""
+    """Drift guard, two halves:
+
+    1. Dropped fields: a field added to any API dataclass without updating
+       its hand-rolled deepcopy silently resets to default on every
+       API-server read/write. Populating every field with sentinels makes
+       that a loud equality failure.
+    2. Aliased containers: a future mutable field copied by a shallow
+       replace() would pass the equality check while sharing state with the
+       original (the reference's Quantity-aliasing bug class,
+       gpu_node.go:134-144). Mutating every container of the copy must
+       leave the original untouched."""
     from tpusched.api.core import Node
     for cls in (ObjectMeta, Pod, Node, PodGroup, ElasticQuota, TpuTopology,
                 PriorityClass, PodDisruptionBudget):
         obj = _populated(cls, [0])
-        assert obj.deepcopy() == copy.deepcopy(obj), \
-            f"{cls.__name__}.deepcopy dropped a field"
+        reference = copy.deepcopy(obj)
+        cp = obj.deepcopy()
+        assert cp == reference, f"{cls.__name__}.deepcopy dropped a field"
+        _mutate_every_container(cp)
+        assert obj == reference, \
+            f"{cls.__name__}.deepcopy aliased a container with the original"
 
 
 def test_priority_class_and_pdb_deepcopy():

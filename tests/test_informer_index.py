@@ -1,0 +1,51 @@
+"""Informer indexers (client-go cache.Indexers analog): bucket membership
+tracks adds/updates/deletes, including label changes that move an object
+between buckets."""
+from __future__ import annotations
+
+from tpusched.api.scheduling import (POD_GROUP_INDEX, POD_GROUP_LABEL,
+                                     pod_group_index_key)
+from tpusched.apiserver import InformerFactory
+from tpusched.apiserver import server as srv
+from tpusched.testing import make_pod
+
+
+def keys(informer, value):
+    return sorted(p.meta.key for p in informer.by_index(POD_GROUP_INDEX, value))
+
+
+def test_index_add_update_delete():
+    api = srv.APIServer()
+    # one pod exists BEFORE the index is registered: must be back-filled
+    api.create(srv.PODS, make_pod("pre", labels={POD_GROUP_LABEL: "g1"}))
+    informer = InformerFactory(api).pods()
+    informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+    informer.add_index(POD_GROUP_INDEX, pod_group_index_key)  # idempotent
+
+    api.create(srv.PODS, make_pod("a", labels={POD_GROUP_LABEL: "g1"}))
+    api.create(srv.PODS, make_pod("b", labels={POD_GROUP_LABEL: "g2"}))
+    api.create(srv.PODS, make_pod("plain"))  # unindexed (no gang label)
+    assert keys(informer, "default/g1") == ["default/a", "default/pre"]
+    assert keys(informer, "default/g2") == ["default/b"]
+
+    # relabel moves the pod between buckets
+    api.patch(srv.PODS, "default/a",
+              lambda p: p.meta.labels.update({POD_GROUP_LABEL: "g2"}))
+    assert keys(informer, "default/g1") == ["default/pre"]
+    assert keys(informer, "default/g2") == ["default/a", "default/b"]
+
+    # delete drops from the bucket; empty buckets vanish
+    api.delete(srv.PODS, "default/pre")
+    assert keys(informer, "default/g1") == []
+    assert keys(informer, "default/unknown") == []
+
+
+def test_index_scoped_by_namespace():
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+    api.create(srv.PODS, make_pod("a", labels={POD_GROUP_LABEL: "g"}))
+    api.create(srv.PODS, make_pod("a", namespace="other",
+                                  labels={POD_GROUP_LABEL: "g"}))
+    assert keys(informer, "default/g") == ["default/a"]
+    assert keys(informer, "other/g") == ["other/a"]

@@ -127,3 +127,14 @@ def pod_group_full_name(pod) -> str:
     if not name:
         return ""
     return f"{pod.meta.namespace}/{name}"
+
+
+# Pod-informer index on gang membership (client-go cache.Indexers analog),
+# keyed "namespace/pgName": sibling listing is O(gang), not O(all pods).
+# Registered by every consumer (coscheduling manager, multislice scorer,
+# PodGroup controller) — add_index is idempotent per name.
+POD_GROUP_INDEX = "tpusched/pod-group"
+
+
+def pod_group_index_key(pod) -> Optional[str]:
+    return pod_group_full_name(pod) or None

@@ -19,18 +19,44 @@ class Informer:
         self.kind = kind
         self._lock = threading.RLock()
         self._cache: Dict[str, Any] = {}
+        # client-go Indexers: index name → key_fn, and the materialized
+        # index name → index value → {object key → object}
+        self._index_fns: Dict[str, Callable[[Any], Optional[str]]] = {}
+        self._indexes: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self._on_add: List[Callable[[Any], None]] = []
         self._on_update: List[Callable[[Any, Any], None]] = []
         self._on_delete: List[Callable[[Any], None]] = []
         api.add_watch(kind, self._handle, replay=True)
 
+    def _index_insert(self, obj) -> None:
+        for name, fn in self._index_fns.items():
+            val = fn(obj)
+            if val is not None:
+                self._indexes[name].setdefault(val, {})[obj.meta.key] = obj
+
+    def _index_remove(self, obj) -> None:
+        for name, fn in self._index_fns.items():
+            val = fn(obj)
+            if val is not None:
+                bucket = self._indexes[name].get(val)
+                if bucket is not None:
+                    bucket.pop(obj.meta.key, None)
+                    if not bucket:
+                        del self._indexes[name][val]
+
     def _handle(self, ev: srv.WatchEvent) -> None:
         key = ev.object.meta.key
         with self._lock:
             if ev.type == srv.DELETED:
-                self._cache.pop(key, None)
+                old = self._cache.pop(key, None)
+                if old is not None:
+                    self._index_remove(old)
             else:
+                old = self._cache.get(key)
+                if old is not None:
+                    self._index_remove(old)
                 self._cache[key] = ev.object
+                self._index_insert(ev.object)
         if ev.type == srv.ADDED:
             for h in list(self._on_add):
                 h(ev.object)
@@ -80,6 +106,34 @@ class Informer:
     # pointers out of the informer cache: callers must treat results as
     # read-only (deepcopy before mutating). This keeps the hot scheduling
     # paths (queue-sort comparisons, sibling listing) allocation-free.
+
+    def add_index(self, name: str,
+                  key_fn: Callable[[Any], Optional[str]]) -> None:
+        """Register a named index (client-go cache.Indexers analog). key_fn
+        maps an object to its index value, or None to leave it unindexed.
+        Existing cache contents are indexed immediately; idempotent for the
+        same name (shared informers register once per consumer)."""
+        with self._lock:
+            existing = self._index_fns.get(name)
+            if existing is key_fn:
+                return
+            if existing is not None:
+                raise ValueError(
+                    f"index {name!r} already registered with a different "
+                    f"key function")
+            self._index_fns[name] = key_fn
+            self._indexes[name] = {}
+            for obj in self._cache.values():
+                val = key_fn(obj)
+                if val is not None:
+                    self._indexes[name].setdefault(val, {})[obj.meta.key] = obj
+
+    def by_index(self, name: str, value: str) -> List[Any]:
+        """All cached objects whose index `name` maps to `value` — O(bucket)
+        instead of an O(cache) items() scan. Shared references, read-only."""
+        with self._lock:
+            bucket = self._indexes[name].get(value)
+            return list(bucket.values()) if bucket else []
 
     def get(self, key: str):
         with self._lock:

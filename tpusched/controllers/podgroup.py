@@ -16,8 +16,8 @@ from typing import List, Optional
 from ..api.core import POD_FAILED, POD_RUNNING, POD_SUCCEEDED, Pod
 from ..api.scheduling import (PG_FAILED, PG_FINISHED, PG_PENDING,
                               PG_PRE_SCHEDULING, PG_RUNNING, PG_SCHEDULED,
-                              PG_SCHEDULING, POD_GROUP_LABEL, PodGroup,
-                              pod_group_label)
+                              PG_SCHEDULING, POD_GROUP_INDEX, PodGroup,
+                              pod_group_index_key, pod_group_label)
 from ..apiserver import Clientset, InformerFactory
 from ..apiserver import server as srv
 from ..util import klog
@@ -39,6 +39,7 @@ class PodGroupController:
 
         self.pg_informer = self.informers.podgroups()
         self.pod_informer = self.informers.pods()
+        self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
         self.pg_informer.add_event_handler(on_add=self._pg_added,
                                            on_update=lambda old, new: self._pg_added(new))
         self.pod_informer.add_event_handler(on_add=self._pod_added,
@@ -107,8 +108,7 @@ class PodGroupController:
         if pg is None:
             klog.V(5).info_s("pod group has been deleted", podGroup=key)
             return None
-        pods = self.pod_informer.items(namespace=pg.meta.namespace,
-                                       selector={POD_GROUP_LABEL: pg.meta.name})
+        pods = self.pod_informer.by_index(POD_GROUP_INDEX, key)
 
         # The phase machine runs INSIDE the atomic patch, against the live
         # object — never writing status.scheduled (owned by the scheduler's

@@ -24,8 +24,9 @@ from typing import List, Optional, Tuple
 from ...api.core import Pod
 from ...api.resources import PODS, ResourceList
 from ...api.scheduling import (MIN_AVAILABLE_LABEL, PG_SCHEDULED,
-                               PG_SCHEDULING, POD_GROUP_LABEL, PodGroup,
-                               pod_group_full_name, pod_group_label)
+                               PG_SCHEDULING, POD_GROUP_INDEX, PodGroup,
+                               pod_group_full_name, pod_group_index_key,
+                               pod_group_label)
 from ...apiserver import server as srv
 from ...fwk import CycleState
 from ...fwk.nodeinfo import NodeInfo
@@ -61,6 +62,7 @@ class PodGroupManager:
         self.schedule_timeout_s = schedule_timeout_s
         self.pg_informer = handle.informer_factory.podgroups()
         self.pod_informer = handle.informer_factory.pods()
+        self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
         self.last_denied_pg = TTLCache(denied_pg_expiration_s)
         self.permitted_pg = TTLCache(schedule_timeout_s)
         # KEP-2 lightweight gangs: one synthesized PodGroup instance per
@@ -110,8 +112,10 @@ class PodGroupManager:
 
     def siblings(self, pod: Pod) -> List[Pod]:
         name = pod_group_label(pod)
-        return self.pod_informer.items(namespace=pod.namespace,
-                                       selector={POD_GROUP_LABEL: name})
+        if not name:
+            return []
+        return self.pod_informer.by_index(POD_GROUP_INDEX,
+                                          f"{pod.namespace}/{name}")
 
     def get_creation_timestamp(self, pod: Pod, default_ts: float) -> float:
         _, pg = self.get_pod_group(pod)

@@ -19,7 +19,8 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from ...api.core import Pod
-from ...api.scheduling import POD_GROUP_LABEL, pod_group_label
+from ...api.scheduling import (POD_GROUP_INDEX, pod_group_index_key,
+                               pod_group_label)
 from ...api.topology import LABEL_DCN_DOMAIN
 from ...config.types import MultiSliceArgs
 from ...fwk import CycleState, Status
@@ -46,6 +47,7 @@ class MultiSlice(PreScorePlugin, ScorePlugin):
         self.handle = handle
         self.pg_informer = handle.informer_factory.podgroups()
         self.pod_informer = handle.informer_factory.pods()
+        self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
 
     @classmethod
     def new(cls, args, handle) -> "MultiSlice":
@@ -67,9 +69,8 @@ class MultiSlice(PreScorePlugin, ScorePlugin):
         domains = set()
         snapshot = self.handle.snapshot_shared_lister()
         for g in sibling_pgs:
-            for p in self.pod_informer.items(
-                    namespace=pod.namespace,
-                    selector={POD_GROUP_LABEL: g.meta.name}):
+            for p in self.pod_informer.by_index(
+                    POD_GROUP_INDEX, f"{pod.namespace}/{g.meta.name}"):
                 if not p.spec.node_name:
                     continue
                 info = snapshot.get(p.spec.node_name)
