@@ -18,6 +18,13 @@ integration-test:
 bench:
 	$(PY) bench.py
 
+# Native C++ engine (torus placement math). Also auto-built when the
+# TopologyMatch plugin constructs (native.load() warm-up); this target just
+# builds it eagerly / fails loudly in CI.
+.PHONY: native
+native:
+	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
+
 .PHONY: verify
 verify: verify-structured-logging verify-crdgen verify-manifests
 

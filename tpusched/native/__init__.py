@@ -1,0 +1,88 @@
+"""Native (C++) engine loader.
+
+The reference ships its whole runtime as a compiled binary (Go). The rebuild
+keeps Python as the control-plane glue but pushes the combinatorial
+scheduling math — torus placement enumeration and per-cycle feasibility /
+membership counting (tpusched/native/torus_engine.cc) — into a C++ shared
+library, consumed via ctypes.
+
+The library is built on demand from the in-tree source with g++ (cached next
+to the source; rebuilt when the source is newer). Every entry point degrades
+gracefully: if the toolchain or load fails, callers fall back to the pure-
+Python implementation in tpusched/topology/engine.py, which is differential-
+tested against the native one.
+
+Set TPUSCHED_NO_NATIVE=1 to force the Python path (used by the differential
+tests themselves).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from ..util import klog
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_attempted = False
+
+_CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC"]
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.tpusched_enumerate_placements.restype = ctypes.c_int64
+    lib.tpusched_enumerate_placements.argtypes = [
+        i64p, u8p, ctypes.c_int32, i64p, ctypes.c_int32, u64p, ctypes.c_int64]
+    lib.tpusched_feasible_membership.restype = ctypes.c_int64
+    lib.tpusched_feasible_membership.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, u64p, u64p, u64p, i64p, u8p]
+    return lib
+
+
+def _build(src: Path, so: Path) -> None:
+    tmp = so.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = ["g++", *_CXX_FLAGS, str(src), "-o", str(tmp)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)  # atomic: concurrent builders race benignly
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it first if needed; None when unavailable
+    (no toolchain, unwritable tree, TPUSCHED_NO_NATIVE=1)."""
+    global _lib, _attempted
+    if _attempted:
+        return _lib
+    with _lock:
+        if _attempted:
+            return _lib
+        if os.environ.get("TPUSCHED_NO_NATIVE"):
+            _attempted = True
+            return None
+        here = Path(__file__).resolve().parent
+        src = here / "torus_engine.cc"
+        so = here / "_torus_engine.so"
+        try:
+            if (not so.exists()
+                    or so.stat().st_mtime < src.stat().st_mtime):
+                _build(src, so)
+            _lib = _configure(ctypes.CDLL(str(so)))
+        except Exception as e:
+            klog.warning_s("native engine unavailable; using Python fallback",
+                           error=str(e))
+            _lib = None
+        _attempted = True
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,152 @@
+// Native torus placement engine.
+//
+// The scheduling-path successor of the reference's 1-D NUMA bitmask fit
+// (/root/reference/pkg/noderesourcetopology/filter.go:84-150), generalized to
+// ICI tori: placements of a host-block shape on an n-D (optionally wrapped)
+// grid are enumerated as bitmasks over host cells, and per-cycle feasibility
+// (assigned ⊆ placement, placement \ assigned ⊆ free) plus per-cell
+// membership counting run as pure word ops.
+//
+// Exposed as a C ABI consumed via ctypes (tpusched/native/__init__.py); the
+// pure-Python fallback with identical semantics lives in
+// tpusched/topology/engine.py and is differential-tested against this.
+//
+// Cells are row-major: cell(coord) = Σ coord[i] * stride[i],
+// stride[rank-1] = 1. Masks are little-endian uint64 words:
+// word w, bit b ⇔ cell w*64+b.
+
+#include <cstdint>
+#include <set>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxRank = 8;
+
+struct Walker {
+  int64_t dims[kMaxRank];
+  int64_t strides[kMaxRank];
+  int32_t rank;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Enumerates every distinct placement of each block shape on the grid.
+//   dims/wrap: per-axis grid extent (host units) and wraparound flag.
+//   blocks: n_blocks * rank shape entries (pre-rotated candidate shapes —
+//           the caller applies accelerator host-extent rules).
+//   out_masks: receives n * words uint64 words (words = ceil(ncells/64)).
+// Returns the number of placements written, or -1 if more than max_out
+// distinct placements exist (caller should grow the buffer and retry).
+int64_t tpusched_enumerate_placements(const int64_t* dims, const uint8_t* wrap,
+                                      int32_t rank, const int64_t* blocks,
+                                      int32_t n_blocks, uint64_t* out_masks,
+                                      int64_t max_out) {
+  if (rank <= 0 || rank > kMaxRank) return 0;
+  Walker g;
+  g.rank = rank;
+  int64_t ncells = 1;
+  for (int i = rank - 1; i >= 0; --i) {
+    g.dims[i] = dims[i];
+    g.strides[i] = ncells;
+    ncells *= dims[i];
+  }
+  const int64_t words = (ncells + 63) / 64;
+
+  std::set<std::vector<uint64_t>> seen;
+  int64_t written = 0;
+
+  std::vector<uint64_t> mask(words);
+  int64_t anchor[kMaxRank], offset[kMaxRank], anchor_count[kMaxRank];
+
+  for (int32_t b = 0; b < n_blocks; ++b) {
+    const int64_t* shape = blocks + static_cast<int64_t>(b) * rank;
+    bool fits = true;
+    for (int i = 0; i < rank; ++i) {
+      if (shape[i] <= 0 || shape[i] > g.dims[i]) fits = false;
+    }
+    if (!fits) continue;
+    for (int i = 0; i < rank; ++i) {
+      if (shape[i] == g.dims[i]) {
+        anchor_count[i] = 1;  // full axis: one anchor covers all rotations
+      } else if (wrap[i]) {
+        anchor_count[i] = g.dims[i];
+      } else {
+        anchor_count[i] = g.dims[i] - shape[i] + 1;
+      }
+      anchor[i] = 0;
+    }
+    while (true) {
+      // build the mask for this anchor
+      for (int64_t w = 0; w < words; ++w) mask[w] = 0;
+      for (int i = 0; i < rank; ++i) offset[i] = 0;
+      while (true) {
+        int64_t cell = 0;
+        for (int i = 0; i < rank; ++i) {
+          cell += ((anchor[i] + offset[i]) % g.dims[i]) * g.strides[i];
+        }
+        mask[cell >> 6] |= (uint64_t{1} << (cell & 63));
+        int i = rank - 1;
+        for (; i >= 0; --i) {
+          if (++offset[i] < shape[i]) break;
+          offset[i] = 0;
+        }
+        if (i < 0) break;
+      }
+      if (seen.insert(mask).second) {
+        if (written >= max_out) return -1;
+        for (int64_t w = 0; w < words; ++w) {
+          out_masks[written * words + w] = mask[w];
+        }
+        ++written;
+      }
+      int i = rank - 1;
+      for (; i >= 0; --i) {
+        if (++anchor[i] < anchor_count[i]) break;
+        anchor[i] = 0;
+      }
+      if (i < 0) break;
+    }
+  }
+  return written;
+}
+
+// Per-cycle feasibility + membership over a packed placement set.
+// A placement p survives iff assigned ⊆ p and (p \ assigned) ⊆ free.
+// For each surviving p, membership[cell]++ for every cell of p ∩ eligible.
+// survivors_out (optional, length n) records each placement's verdict.
+// Returns the number of survivors.
+int64_t tpusched_feasible_membership(const uint64_t* masks, int64_t n,
+                                     int32_t words, const uint64_t* assigned,
+                                     const uint64_t* free_mask,
+                                     const uint64_t* eligible,
+                                     int64_t* membership,
+                                     uint8_t* survivors_out) {
+  int64_t survivors = 0;
+  for (int64_t p = 0; p < n; ++p) {
+    const uint64_t* m = masks + p * words;
+    bool ok = true;
+    for (int32_t w = 0; w < words && ok; ++w) {
+      if (assigned[w] & ~m[w]) ok = false;                 // assigned ⊆ p
+      if ((m[w] & ~assigned[w]) & ~free_mask[w]) ok = false;  // rest ⊆ free
+    }
+    if (survivors_out) survivors_out[p] = ok ? 1 : 0;
+    if (!ok) continue;
+    ++survivors;
+    if (membership) {
+      for (int32_t w = 0; w < words; ++w) {
+        uint64_t bits = m[w] & eligible[w];
+        while (bits) {
+          const int b = __builtin_ctzll(bits);
+          ++membership[(static_cast<int64_t>(w) << 6) + b];
+          bits &= bits - 1;
+        }
+      }
+    }
+  }
+  return survivors;
+}
+
+}  // extern "C"

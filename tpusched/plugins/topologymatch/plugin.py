@@ -39,8 +39,11 @@ from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
                                PreFilterPlugin, RESOURCE_NODE, RESOURCE_POD,
                                RESOURCE_POD_GROUP, RESOURCE_TPU_TOPOLOGY)
 from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
-from ...topology.torus import (HostGrid, enumerate_placements,
-                               feasible_placements, validate_slice_shape)
+from ... import native
+from ...topology.engine import (MaskGrid, PlacementSet,
+                                enumerate_placement_masks,
+                                feasible_membership)
+from ...topology.torus import HostGrid, validate_slice_shape
 from ...util import klog
 from ..tpuslice.chip_node import pod_tpu_limits
 
@@ -71,8 +74,12 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         self.pg_informer = handle.informer_factory.podgroups()
         self.topo_informer = handle.informer_factory.tputopologies()
         # caches keyed by CR resource_version (grids) / + block (placements)
-        self._grid_cache: Dict[Tuple[str, int], HostGrid] = {}
-        self._placement_cache: Dict[Tuple[str, int, Tuple[int, ...]], list] = {}
+        self._grid_cache: Dict[Tuple[str, int], Tuple[HostGrid, MaskGrid]] = {}
+        self._placement_cache: Dict[Tuple[str, int, Tuple[int, ...]],
+                                    PlacementSet] = {}
+        # warm the native engine at construction — its first load may compile
+        # the C++ source, which must not stall a scheduling cycle
+        native.load()
 
     @classmethod
     def new(cls, args, handle) -> "TopologyMatch":
@@ -133,14 +140,15 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
             if err:
                 validation_errors.append(f"pool {spec.pool}: {err}")
                 continue
-            grid = self._grid(topo)
-            if grid is None:
+            grids = self._grid(topo)
+            if grids is None:
                 continue
             any_valid_pool = True
+            grid, _ = grids
             occ = self._occupancy(grid, snapshot, pg.meta.name, pod.namespace,
                                   chips_needed if chips_needed is not None
                                   else acc.chips_per_host)
-            candidates.append((topo, acc, grid, occ))
+            candidates.append((topo, acc, grids, occ))
 
         # A gang must live in ONE torus: once any sibling is assigned in a
         # pool, every other pool is off the table (a "slice" spanning two
@@ -149,17 +157,14 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         if pinned:
             candidates = pinned
 
-        for topo, acc, grid, (assigned, free, eligible, pool_util) in candidates:
-            placements = self._placements(topo, grid, shape)
-            survivors = feasible_placements(placements, assigned, free)
-            if not survivors:
+        for topo, acc, (grid, mgrid), (assigned, free, eligible,
+                                       pool_util) in candidates:
+            pset = self._placements(topo, mgrid, shape)
+            n_survivors, membership = feasible_membership(
+                pset, mgrid.mask_of(assigned), mgrid.mask_of(free),
+                mgrid.mask_of(eligible))
+            if not n_survivors:
                 continue
-            membership: Dict[str, int] = {}
-            for p in survivors:
-                for coord in p:
-                    node = grid.node_of.get(coord)
-                    if node is not None and coord in eligible:
-                        membership[node] = membership.get(node, 0) + 1
             for node, count in membership.items():
                 prev = stash.allowed.get(node)
                 if prev is None or count < prev[1]:
@@ -181,22 +186,24 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         state.write(_STATE_KEY, stash)
         return Status.success()
 
-    def _grid(self, topo) -> Optional[HostGrid]:
+    def _grid(self, topo) -> Optional[Tuple[HostGrid, MaskGrid]]:
         key = (topo.key, topo.meta.resource_version)
-        grid = self._grid_cache.get(key)
-        if grid is None:
+        grids = self._grid_cache.get(key)
+        if grids is None:
             grid = HostGrid.from_spec(topo.spec)
-            if grid is not None:
-                if len(self._grid_cache) > 16:
-                    self._grid_cache.clear()
-                self._grid_cache[key] = grid
-        return grid
+            if grid is None:
+                return None
+            grids = (grid, MaskGrid(grid))
+            if len(self._grid_cache) > 16:
+                self._grid_cache.clear()
+            self._grid_cache[key] = grids
+        return grids
 
-    def _placements(self, topo, grid: HostGrid, chip_shape) -> list:
+    def _placements(self, topo, mgrid: MaskGrid, chip_shape) -> PlacementSet:
         key = (topo.key, topo.meta.resource_version, tuple(chip_shape))
         got = self._placement_cache.get(key)
         if got is None:
-            got = enumerate_placements(grid, chip_shape)
+            got = enumerate_placement_masks(mgrid, chip_shape)
             if len(self._placement_cache) > 64:
                 self._placement_cache.clear()
             self._placement_cache[key] = got

@@ -1,0 +1,180 @@
+"""Mask-based placement engine over a HostGrid — native C++ fast path with a
+pure-Python fallback.
+
+Placements (torus.py represents them as frozensets of host coords) become
+bitmasks over row-major host cells. Enumeration and the per-cycle
+feasibility + membership pass run either in the native engine
+(tpusched/native/torus_engine.cc) or in the Python implementations here;
+both are differential-tested against torus.py's reference semantics
+(tests/test_native_engine.py).
+
+The per-cycle contract (matches torus.feasible_placements plus the
+membership counting the TopologyMatch PreFilter does on top):
+- a placement p survives iff assigned ⊆ p and (p \\ assigned) ⊆ free;
+- for each surviving p, every host of p ∩ eligible gets membership += 1
+  (the corner-packing score input: how many surviving slices a host sits in).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from .. import native
+from .torus import (Coord, HostGrid, candidate_host_blocks,
+                    enumerate_placements)
+
+
+class MaskGrid:
+    """Row-major cell indexing for a HostGrid (host units)."""
+
+    def __init__(self, grid: HostGrid):
+        self.grid = grid
+        self.rank = len(grid.dims)
+        self.dims = grid.dims
+        strides = [0] * self.rank
+        ncells = 1
+        for i in range(self.rank - 1, -1, -1):
+            strides[i] = ncells
+            ncells *= grid.dims[i]
+        self.strides = tuple(strides)
+        self.ncells = ncells
+        self.words = (ncells + 63) // 64
+        self.node_of_cell: List[Optional[str]] = [None] * ncells
+        for coord, node in grid.node_of.items():
+            self.node_of_cell[self.cell(coord)] = node
+
+    def cell(self, coord: Coord) -> int:
+        return sum(c * s for c, s in zip(coord, self.strides))
+
+    def mask_of(self, coords: Iterable[Coord]) -> int:
+        m = 0
+        for c in coords:
+            m |= 1 << self.cell(c)
+        return m
+
+    def coords_of(self, mask: int) -> FrozenSet[Coord]:
+        out = []
+        while mask:
+            low = mask & -mask
+            cell = low.bit_length() - 1
+            coord = []
+            for s in self.strides:
+                coord.append(cell // s)
+                cell %= s
+            out.append(tuple(coord))
+            mask ^= low
+        return frozenset(out)
+
+
+class PlacementSet:
+    """All distinct placements of one chip shape on one grid, as int masks;
+    the packed uint64 buffer for the native engine is built once and reused
+    every cycle."""
+
+    def __init__(self, mgrid: MaskGrid, masks: List[int]):
+        self.mgrid = mgrid
+        self.masks = masks
+        self._packed: Optional[ctypes.Array] = None
+
+    def __len__(self) -> int:
+        return len(self.masks)
+
+    def packed(self) -> ctypes.Array:
+        if self._packed is None:
+            words = self.mgrid.words
+            buf = (ctypes.c_uint64 * (len(self.masks) * words))()
+            for i, m in enumerate(self.masks):
+                for w in range(words):
+                    buf[i * words + w] = (m >> (64 * w)) & 0xFFFFFFFFFFFFFFFF
+            self._packed = buf
+        return self._packed
+
+
+def _to_words(mask: int, words: int) -> ctypes.Array:
+    buf = (ctypes.c_uint64 * words)()
+    for w in range(words):
+        buf[w] = (mask >> (64 * w)) & 0xFFFFFFFFFFFFFFFF
+    return buf
+
+
+def enumerate_placement_masks(mgrid: MaskGrid,
+                              chip_shape: Coord) -> PlacementSet:
+    """All distinct host-cell masks where chip_shape (any rotation) fits —
+    mask analog of torus.enumerate_placements."""
+    grid = mgrid.grid
+    blocks = candidate_host_blocks(chip_shape, grid.acc, grid.dims)
+    if not blocks:
+        return PlacementSet(mgrid, [])
+    lib = native.load()
+    if lib is not None:
+        rank = mgrid.rank
+        dims = (ctypes.c_int64 * rank)(*grid.dims)
+        wrap = (ctypes.c_uint8 * rank)(*(1 if w else 0 for w in grid.wrap))
+        flat = (ctypes.c_int64 * (len(blocks) * rank))(
+            *(x for b in blocks for x in b))
+        cap = 256
+        while True:
+            out = (ctypes.c_uint64 * (cap * mgrid.words))()
+            n = lib.tpusched_enumerate_placements(
+                dims, wrap, rank, flat, len(blocks), out, cap)
+            if n >= 0:
+                break
+            cap *= 4  # buffer too small; grow and retry
+        masks = []
+        words = mgrid.words
+        for i in range(n):
+            m = 0
+            for w in range(words):
+                m |= out[i * words + w] << (64 * w)
+            masks.append(m)
+        return PlacementSet(mgrid, masks)
+    # Fallback reuses the reference enumeration rather than duplicating the
+    # trickiest logic (full-axis single anchor, wrap-only anchors, rotation
+    # dedup); mask conversion is cheap next to the enumeration itself.
+    return PlacementSet(
+        mgrid, [mgrid.mask_of(p) for p in enumerate_placements(grid,
+                                                               chip_shape)])
+
+
+def feasible_membership(
+        pset: PlacementSet, assigned: int, free: int,
+        eligible: int) -> Tuple[int, Dict[str, int]]:
+    """One pass over the placement set: how many placements survive this
+    cycle's occupancy, and for each eligible host, in how many survivors it
+    appears. Returns (survivor count, node name → membership)."""
+    mgrid = pset.mgrid
+    lib = native.load()
+    if lib is not None and pset.masks:
+        words = mgrid.words
+        membership = (ctypes.c_int64 * mgrid.ncells)()
+        survivors = lib.tpusched_feasible_membership(
+            pset.packed(), len(pset.masks), words,
+            _to_words(assigned, words), _to_words(free, words),
+            _to_words(eligible, words), membership, None)
+        counts: Dict[str, int] = {}
+        for cell in range(mgrid.ncells):
+            if membership[cell]:
+                node = mgrid.node_of_cell[cell]
+                if node is not None:
+                    counts[node] = membership[cell]
+        return survivors, counts
+    survivors = 0
+    cell_counts: Dict[int, int] = {}
+    for m in pset.masks:
+        if assigned & ~m:
+            continue                      # assigned ⊄ placement
+        if (m & ~assigned) & ~free:
+            continue                      # claims a non-free host
+        survivors += 1
+        bits = m & eligible
+        while bits:
+            low = bits & -bits
+            cell = low.bit_length() - 1
+            cell_counts[cell] = cell_counts.get(cell, 0) + 1
+            bits ^= low
+    counts = {}
+    for cell, n in cell_counts.items():
+        node = mgrid.node_of_cell[cell]
+        if node is not None:
+            counts[node] = n
+    return survivors, counts

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from ..api.topology import ACCELERATORS, TpuAccelerator, TpuTopologySpec
+from ..util import klog
 
 # Host extents: how a host's chips are laid out in the torus.
 HOST_EXTENT = {
@@ -96,7 +97,17 @@ class HostGrid:
         node_of: Dict[Coord, str] = {}
         coord_of: Dict[str, Coord] = {}
         for node, chip_coord in spec.hosts.items():
+            if len(chip_coord) != len(dims):
+                klog.warning_s("host coord rank mismatch; dropping host",
+                               pool=spec.pool, node=node, coord=chip_coord)
+                continue
             hc = tuple(c // e for c, e in zip(chip_coord, extent))
+            if any(not (0 <= hc[i] < dims[i]) for i in range(len(dims))):
+                # out-of-torus coords from a malformed CR must not alias a
+                # real cell in the mask engine — drop the host instead
+                klog.warning_s("host coord outside pool torus; dropping host",
+                               pool=spec.pool, node=node, coord=chip_coord)
+                continue
             node_of[hc] = node
             coord_of[node] = hc
         return cls(spec.pool, acc, dims, wrap, node_of, coord_of)
