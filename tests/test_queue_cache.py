@@ -86,3 +86,31 @@ def test_cache_assumed_expires_without_confirmation():
     c.finish_binding(pod)
     now[0] += 31.0  # past ASSUME_EXPIRATION_S
     assert len(c.snapshot().get("n1").pods) == 0
+
+
+def test_cache_incremental_snapshot_reuse_and_invalidation():
+    """snapshot() reuses a node's clone while unchanged, re-clones on any
+    change — and a node deleted and re-added with identical pod count must
+    NOT serve the old clone (the per-instance-generation collision; upstream
+    uses a global monotonic generation for exactly this)."""
+    c = Cache()
+    c.add_node(make_node("n1", capacity={"cpu": 8000, "pods": 10}))
+    s1 = c.snapshot()
+    s2 = c.snapshot()
+    assert s2.get("n1") is s1.get("n1")  # unchanged → same clone object
+
+    pod = make_pod("p1", requests={"cpu": 1000})
+    c.assume_pod(pod, "n1")
+    s3 = c.snapshot()
+    assert s3.get("n1") is not s2.get("n1")
+    assert len(s3.get("n1").pods) == 1
+
+    # delete + re-add with smaller allocatable and the same pod re-attached:
+    # the fresh NodeInfo's snapshot must reflect the NEW node object
+    node_small = make_node("n1", capacity={"cpu": 1000, "pods": 10})
+    c.remove_node(node_small)
+    c.add_node(node_small)
+    s4 = c.snapshot()
+    assert s4.get("n1") is not s3.get("n1")
+    assert s4.get("n1").allocatable["cpu"] == 1000
+    assert len(s4.get("n1").pods) == 1  # known pod re-attached

@@ -7,6 +7,7 @@ add_pod/remove_pod so preemption dry-runs can simulate victim removal
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Iterable, List, Optional
 
 from ..api.core import Node, Pod
@@ -15,6 +16,16 @@ from ..util.podutil import pod_request_with_defaults
 
 MAX_NODE_SCORE = 100
 MIN_NODE_SCORE = 0
+
+# Process-global monotonic generation (upstream nodeinfo.nextGeneration):
+# every NodeInfo mutation takes a FRESH value, so a node deleted and re-added
+# can never collide with its predecessor's generation in the incremental
+# snapshot (sched/cache.py). CPython's count.__next__ is atomic.
+_generation = itertools.count(1)
+
+
+def next_generation() -> int:
+    return next(_generation)
 
 
 def minmax_normalize(raw: Dict[str, int], scores) -> None:
@@ -30,16 +41,31 @@ def minmax_normalize(raw: Dict[str, int], scores) -> None:
 
 
 class NodeInfo:
-    __slots__ = ("node", "pods", "requested", "non_zero_requested", "generation")
+    __slots__ = ("node", "pods", "requested", "non_zero_requested",
+                 "generation", "derived_cache")
 
     def __init__(self, node: Optional[Node] = None, pods: Iterable[Pod] = ()):
         self.node = node
         self.pods: List[Pod] = []
         self.requested: ResourceList = {}
         self.non_zero_requested: ResourceList = {}
-        self.generation = 0
+        self.generation = next_generation()
+        # (generation, value) memo for derived per-node models (e.g. the
+        # TpuSlice ChipNode); any add/remove/update invalidates by bumping
+        # the generation
+        self.derived_cache: Dict[str, tuple] = {}
         for p in pods:
             self.add_pod(p)
+
+    def derived(self, key: str, build):
+        """Generation-keyed memo: returns build(self), cached until this
+        NodeInfo changes. Only for values derived purely from (node, pods)."""
+        ent = self.derived_cache.get(key)
+        if ent is not None and ent[0] == self.generation:
+            return ent[1]
+        value = build(self)
+        self.derived_cache[key] = (self.generation, value)
+        return value
 
     @property
     def allocatable(self) -> ResourceList:
@@ -51,7 +77,7 @@ class NodeInfo:
             self.requested[k] = self.requested.get(k, 0) + v
         for k, v in pod_request_with_defaults(pod, non_zero=True).items():
             self.non_zero_requested[k] = self.non_zero_requested.get(k, 0) + v
-        self.generation += 1
+        self.generation = next_generation()
 
     def remove_pod(self, pod: Pod) -> bool:
         for i, p in enumerate(self.pods):
@@ -61,14 +87,9 @@ class NodeInfo:
                     self.requested[k] = self.requested.get(k, 0) - v
                 for k, v in pod_request_with_defaults(p, non_zero=True).items():
                     self.non_zero_requested[k] = self.non_zero_requested.get(k, 0) - v
-                self.generation += 1
+                self.generation = next_generation()
                 return True
         return False
-
-    def free(self) -> ResourceList:
-        alloc = self.allocatable
-        return {k: alloc.get(k, 0) - self.requested.get(k, 0)
-                for k in set(alloc) | set(self.requested)}
 
     def clone(self) -> "NodeInfo":
         out = NodeInfo()
@@ -77,6 +98,7 @@ class NodeInfo:
         out.requested = dict(self.requested)
         out.non_zero_requested = dict(self.non_zero_requested)
         out.generation = self.generation
+        out.derived_cache = dict(self.derived_cache)  # values are derived-pure
         return out
 
 

@@ -32,13 +32,23 @@ class NodeResourcesFit(FilterPlugin):
     """cpu/memory/pods/extended-resource fit against allocatable − requested."""
     NAME = "NodeResourcesFit"
 
+    _REQ_KEY = "NodeResourcesFit/pod-request"
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if node_info.node is None:
             return Status.error("node not found")
-        request = pod_effective_request(pod)
-        request["pods"] = 1
-        free = node_info.free()
-        insufficient = [k for k, v in request.items() if v > 0 and v > free.get(k, 0)]
+        # the pod's request is cycle-invariant: compute once per cycle
+        # (upstream stashes it in PreFilter; memoizing on first Filter call
+        # needs no profile wiring)
+        request = state.try_read(self._REQ_KEY)
+        if request is None:
+            request = pod_effective_request(pod)
+            request["pods"] = 1
+            state.write(self._REQ_KEY, request)
+        alloc = node_info.allocatable
+        requested = node_info.requested
+        insufficient = [k for k, v in request.items()
+                        if v > 0 and requested.get(k, 0) + v > alloc.get(k, 0)]
         if insufficient:
             return Status.unschedulable(
                 *[f"Insufficient {k}" for k in insufficient])

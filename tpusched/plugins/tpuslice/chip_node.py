@@ -72,6 +72,20 @@ class ChipNode:
 
     def __init__(self, chips: List[Chip]):
         self.chips = chips
+        self.hbm_total_mb = sum(c.hbm_mb for c in chips)
+        # node-level limit sums over ALL resident TPU pods (with or without
+        # index annotations) — the Filter's capacity check input
+        # (flex_gpu.go:96-119)
+        self.used_chips_limit = 0
+        self.used_mem_limit = 0
+
+    @classmethod
+    def cached(cls, node_info: NodeInfo) -> Optional["ChipNode"]:
+        """Generation-keyed memo on the NodeInfo: Filter/Score/Reserve in one
+        cycle (and later cycles, while the node is unchanged) share one
+        build. ChipNode is derived purely from (node, pods), the
+        derived-cache contract."""
+        return node_info.derived("TpuSlice/chip-node", cls.from_node_info)
 
     @classmethod
     def from_node_info(cls, node_info: NodeInfo) -> Optional["ChipNode"]:
@@ -85,12 +99,15 @@ class ChipNode:
             acc = ACCELERATORS.get(node.meta.labels.get(LABEL_ACCELERATOR, ""))
             mem_total = acc.hbm_mb_per_chip * count if acc else 0
         hbm_each = mem_total // count if count else 0
-        chips = [Chip(i, hbm_each) for i in range(count)]
+        out = cls([Chip(i, hbm_each) for i in range(count)])
+        chips = out.chips
 
         for pod in node_info.pods:
             chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
             if not chips_set and not mem_set:
                 continue
+            out.used_chips_limit += chips_req
+            out.used_mem_limit += mem_req
             ann = pod.meta.annotations.get(CHIP_INDEX_ANNOTATION)
             if ann is None:
                 klog.warning_s("TPU pod has no chip-index annotation", pod=pod.key)
@@ -105,7 +122,7 @@ class ChipNode:
             if mem_set:
                 # fractional pods occupy exactly one chip
                 chips[indexes[0]].used_mb += mem_req
-        return cls(chips)
+        return out
 
     # -- fitting --------------------------------------------------------------
 

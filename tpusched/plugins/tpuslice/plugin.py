@@ -68,19 +68,13 @@ class TpuSlice(FilterPlugin, ScorePlugin, ReservePlugin, BindPlugin):
             return Status.unresolvable(f"unknown resource type {TPU}")
 
         # node-level capacity check over the *limit sums* of resident pods
-        # (flex_gpu.go:96-119)
-        used_chips = used_mem = 0
-        for p in node_info.pods:
-            c, _, m, _ = pod_tpu_limits(p)
-            used_chips += c
-            used_mem += m
-        cn = ChipNode.from_node_info(node_info)
+        # (flex_gpu.go:96-119), precomputed at ChipNode build
+        cn = ChipNode.cached(node_info)
         if cn is None:
             return Status.unresolvable(f"unknown resource type {TPU}")
-        mem_alloc = sum(ch.hbm_mb for ch in cn.chips)
-        if used_chips + chips_req > alloc.get(TPU, 0):
+        if cn.used_chips_limit + chips_req > alloc.get(TPU, 0):
             return Status.unschedulable(f"insufficient resource {TPU}")
-        if used_mem + mem_req > mem_alloc:
+        if cn.used_mem_limit + mem_req > cn.hbm_total_mb:
             return Status.unschedulable(f"insufficient resource {TPU_MEMORY}")
 
         if mem_set and not cn.mem_fit_indexes(mem_req):
@@ -98,7 +92,7 @@ class TpuSlice(FilterPlugin, ScorePlugin, ReservePlugin, BindPlugin):
         chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
         if not chips_set and not mem_set:
             return 0, Status.success()
-        cn = ChipNode.from_node_info(node_info)
+        cn = ChipNode.cached(node_info)
         if cn is None:
             return 0, Status.success()
         raw = cn.chip_score() if chips_set else cn.mem_score()
@@ -121,7 +115,7 @@ class TpuSlice(FilterPlugin, ScorePlugin, ReservePlugin, BindPlugin):
             return Status.success()
         if chips_set and mem_set:
             return Status.unresolvable("pod conflict resources")
-        cn = ChipNode.from_node_info(node_info)
+        cn = ChipNode.cached(node_info)
         if cn is None:
             return Status.unschedulable(f"no {TPU} on node {node_name}")
         if chips_set:

@@ -16,6 +16,7 @@ from typing import Dict, Optional, Tuple
 
 from ..api.core import Node, Pod
 from ..fwk.nodeinfo import NodeInfo, Snapshot
+from ..fwk.nodeinfo import next_generation as nodeinfo_next_generation
 from ..util import klog
 
 ASSUME_EXPIRATION_S = 30.0
@@ -28,6 +29,9 @@ class Cache:
         self._infos: Dict[str, NodeInfo] = {}       # node name → live NodeInfo
         self._pods: Dict[str, Pod] = {}             # all known scheduled pods
         self._assumed: Dict[str, float] = {}        # pod key → bind deadline
+        # last snapshot's clones, keyed by (generation) — upstream's
+        # UpdateSnapshot design: only nodes that changed re-clone
+        self._snap_clones: Dict[str, Tuple[int, NodeInfo]] = {}
 
     # -- nodes ----------------------------------------------------------------
 
@@ -47,7 +51,7 @@ class Cache:
                 self.add_node(node)
             else:
                 info.node = node
-                info.generation += 1
+                info.generation = nodeinfo_next_generation()
 
     def remove_node(self, node: Node) -> None:
         with self._lock:
@@ -127,10 +131,24 @@ class Cache:
     # -- snapshot -------------------------------------------------------------
 
     def snapshot(self) -> Snapshot:
+        """Incremental (upstream cache.UpdateSnapshot): a node's clone from
+        the previous snapshot is reused while its generation is unchanged.
+        Safe because snapshot NodeInfos are read-only by contract — every
+        mutation path (preemption dry-runs, nominated-pod evaluation) clones
+        first (sched/preemption.py:129-130, fwk/runtime.py:309-312)."""
         with self._lock:
             self._cleanup_expired()
-            return Snapshot.from_infos(
-                {name: info.clone() for name, info in self._infos.items()})
+            prev = self._snap_clones
+            clones: Dict[str, Tuple[int, NodeInfo]] = {}
+            infos: Dict[str, NodeInfo] = {}
+            for name, info in self._infos.items():
+                ent = prev.get(name)
+                if ent is None or ent[0] != info.generation:
+                    ent = (info.generation, info.clone())
+                clones[name] = ent
+                infos[name] = ent[1]
+            self._snap_clones = clones
+            return Snapshot.from_infos(infos)
 
     def node_names(self):
         with self._lock:
