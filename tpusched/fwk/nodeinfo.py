@@ -71,6 +71,10 @@ class NodeInfo:
     def allocatable(self) -> ResourceList:
         return self.node.status.allocatable if self.node else {}
 
+    def set_node(self, node: Node) -> None:
+        self.node = node
+        self.generation = next_generation()
+
     def add_pod(self, pod: Pod) -> None:
         self.pods.append(pod)
         for k, v in pod_request_with_defaults(pod).items():

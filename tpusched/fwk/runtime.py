@@ -165,6 +165,11 @@ class PodNominator:
         with self._lock:
             return list(self._by_node.get(node_name, {}).values())
 
+    def empty(self) -> bool:
+        # Lock-free peek: callers use this only as a fast-path hint, and a
+        # stale False merely takes the slow path.
+        return not self._by_node
+
 
 class Handle:
     """framework.Handle analog passed to plugin factories: cluster views,
@@ -238,6 +243,10 @@ class Framework:
         self.queue_sort_plugin: QueueSortPlugin = _bucket([profile.queue_sort], QueueSortPlugin)[0]
         self.pre_filter_plugins = _bucket(profile.pre_filter, PreFilterPlugin)
         self.filter_plugins = _bucket(profile.filter, FilterPlugin)
+        # Hot-loop dispatch table: (name, bound filter method) resolved once —
+        # filter runs plugins×nodes times per cycle and name()/attr lookups
+        # dominate the Python-side overhead otherwise.
+        self._filter_dispatch = [(p.name(), p.filter) for p in self.filter_plugins]
         self.post_filter_plugins = _bucket(profile.post_filter, PostFilterPlugin)
         self.pre_score_plugins = _bucket(profile.pre_score, PreScorePlugin)
         self.score_plugins: List[Tuple[ScorePlugin, int]] = [
@@ -289,18 +298,21 @@ class Framework:
     # -- filter --------------------------------------------------------------
     def run_filter_plugins(self, state: CycleState, pod: Pod,
                            node_info: NodeInfo) -> Status:
-        for p in self.filter_plugins:
-            if p.name() in state.skip_filter_plugins:
+        skip = state.skip_filter_plugins
+        for name, filter_fn in self._filter_dispatch:
+            if name in skip:
                 continue
-            s = p.filter(state, pod, node_info)
+            s = filter_fn(state, pod, node_info)
             if not s.is_success():
-                return s.with_plugin(p.name())
+                return s.with_plugin(name)
         return Status.success()
 
     def run_filter_plugins_with_nominated_pods(self, state: CycleState, pod: Pod,
                                                node_info: NodeInfo) -> Status:
         """Upstream semantics: evaluate twice when higher-priority nominated
         pods exist on the node — once assuming they are running, once not."""
+        if self.handle.pod_nominator.empty():
+            return self.run_filter_plugins(state, pod, node_info)
         nominated = [p for p in self.handle.pod_nominator.nominated_pods_for_node(
             node_info.node.name) if p.priority >= pod.priority and p.key != pod.key]
         for add_nominated in ([True, False] if nominated else [False]):
@@ -324,7 +336,7 @@ class Framework:
         statuses: List[Status] = []
         for p in self.post_filter_plugins:
             result, s = p.post_filter(state, pod, filtered_node_status_map)
-            s.with_plugin(p.name())
+            s = s.with_plugin(p.name())
             if s.is_success():
                 return result, s
             if not s.is_unschedulable():

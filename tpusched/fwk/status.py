@@ -35,7 +35,10 @@ class Status:
     # Constructors -----------------------------------------------------------
     @staticmethod
     def success() -> "Status":
-        return Status(SUCCESS)
+        # Shared immutable instance: the success status is by far the hottest
+        # allocation (every plugin × every node per cycle); with_plugin()
+        # copies-on-write so the singleton can never be mutated.
+        return _SUCCESS
 
     @staticmethod
     def error(msg: str) -> "Status":
@@ -77,6 +80,8 @@ class Status:
         return "; ".join(self.reasons)
 
     def with_plugin(self, name: str) -> "Status":
+        if self is _SUCCESS:
+            return Status(SUCCESS, plugin=name)
         self.plugin = name
         return self
 
@@ -84,21 +89,23 @@ class Status:
         return f"Status({self.code.name}, {self.reasons!r}, plugin={self.plugin!r})"
 
 
+_SUCCESS = Status(SUCCESS)
+
+
 def merge_statuses(statuses: List[Status]) -> Status:
     """PluginToStatus.Merge: error > unresolvable > unschedulable > success."""
-    if not statuses:
-        return Status.success()
-    final = Status.success()
+    code, plugin = SUCCESS, ""
     reasons: List[str] = []
     for s in statuses:
         if s.is_success():
             continue
         reasons.extend(s.reasons)
         if s.code == ERROR:
-            final = Status(ERROR, plugin=s.plugin)
-        elif s.code == UNSCHEDULABLE_AND_UNRESOLVABLE and final.code != ERROR:
-            final = Status(UNSCHEDULABLE_AND_UNRESOLVABLE, plugin=s.plugin)
-        elif s.code == UNSCHEDULABLE and final.code not in (ERROR, UNSCHEDULABLE_AND_UNRESOLVABLE):
-            final = Status(UNSCHEDULABLE, plugin=s.plugin)
-    final.reasons = reasons
-    return final
+            code, plugin = ERROR, s.plugin
+        elif s.code == UNSCHEDULABLE_AND_UNRESOLVABLE and code != ERROR:
+            code, plugin = UNSCHEDULABLE_AND_UNRESOLVABLE, s.plugin
+        elif s.code == UNSCHEDULABLE and code not in (ERROR, UNSCHEDULABLE_AND_UNRESOLVABLE):
+            code, plugin = UNSCHEDULABLE, s.plugin
+    if code == SUCCESS:
+        return Status.success()
+    return Status(code, reasons, plugin)

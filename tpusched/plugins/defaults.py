@@ -42,13 +42,14 @@ class NodeResourcesFit(FilterPlugin):
         # needs no profile wiring)
         request = state.try_read(self._REQ_KEY)
         if request is None:
-            request = pod_effective_request(pod)
-            request["pods"] = 1
+            req = pod_effective_request(pod)
+            req["pods"] = 1
+            request = tuple((k, v) for k, v in req.items() if v > 0)
             state.write(self._REQ_KEY, request)
         alloc = node_info.allocatable
         requested = node_info.requested
-        insufficient = [k for k, v in request.items()
-                        if v > 0 and requested.get(k, 0) + v > alloc.get(k, 0)]
+        insufficient = [k for k, v in request
+                        if requested.get(k, 0) + v > alloc.get(k, 0)]
         if insufficient:
             return Status.unschedulable(
                 *[f"Insufficient {k}" for k in insufficient])
@@ -88,8 +89,11 @@ class NodeSelector(FilterPlugin):
     NAME = "NodeSelector"
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        selector = pod.spec.node_selector
+        if not selector:
+            return Status.success()
         labels = node_info.node.meta.labels
-        for k, v in pod.spec.node_selector.items():
+        for k, v in selector.items():
             if labels.get(k) != v:
                 return Status.unresolvable("node(s) didn't match node selector")
         return Status.success()

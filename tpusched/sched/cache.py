@@ -16,7 +16,6 @@ from typing import Dict, Optional, Tuple
 
 from ..api.core import Node, Pod
 from ..fwk.nodeinfo import NodeInfo, Snapshot
-from ..fwk.nodeinfo import next_generation as nodeinfo_next_generation
 from ..util import klog
 
 ASSUME_EXPIRATION_S = 30.0
@@ -50,8 +49,7 @@ class Cache:
             if info is None:
                 self.add_node(node)
             else:
-                info.node = node
-                info.generation = nodeinfo_next_generation()
+                info.set_node(node)
 
     def remove_node(self, node: Node) -> None:
         with self._lock:

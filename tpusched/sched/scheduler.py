@@ -66,7 +66,10 @@ class Scheduler:
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
-        self._binding_threads: List[threading.Thread] = []
+        # binding cycles deregister themselves on exit (O(1) vs scanning the
+        # whole list each schedule_one, which was O(gang²) on large gangs)
+        self._binding_lock = threading.Lock()
+        self._binding_threads: Dict[int, threading.Thread] = {}
         self._wire_informers()
 
     @property
@@ -151,7 +154,9 @@ class Scheduler:
             lambda wp: wp.reject("", "scheduler shutting down"))
         if self._sched_thread:
             self._sched_thread.join(timeout=5)
-        for t in list(self._binding_threads):
+        with self._binding_lock:
+            pending = list(self._binding_threads.values())
+        for t in pending:
             t.join(timeout=5)
         self._fw.close()
 
@@ -221,9 +226,9 @@ class Scheduler:
                              args=(state, info, assumed, node_name, start,
                                    pods_to_activate),
                              name=f"bind-{pod.name}", daemon=True)
-        self._binding_threads.append(t)
+        with self._binding_lock:
+            self._binding_threads[id(t)] = t
         t.start()
-        self._gc_binding_threads()
 
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
         """genericScheduler.Schedule analog: prefilter → filter → score."""
@@ -287,6 +292,16 @@ class Scheduler:
     def _binding_cycle(self, state: CycleState, info: QueuedPodInfo,
                        assumed: Pod, node_name: str, cycle_start: float,
                        pods_to_activate: PodsToActivate) -> None:
+        try:
+            self._run_binding_cycle(state, info, assumed, node_name,
+                                    cycle_start, pods_to_activate)
+        finally:
+            with self._binding_lock:
+                self._binding_threads.pop(id(threading.current_thread()), None)
+
+    def _run_binding_cycle(self, state: CycleState, info: QueuedPodInfo,
+                           assumed: Pod, node_name: str, cycle_start: float,
+                           pods_to_activate: PodsToActivate) -> None:
         pod = assumed
         s = self._fw.wait_on_permit(pod)
         if not s.is_success():
@@ -335,5 +350,3 @@ class Scheduler:
         if pods:
             self.queue.activate(pods)
 
-    def _gc_binding_threads(self) -> None:
-        self._binding_threads = [t for t in self._binding_threads if t.is_alive()]
