@@ -21,6 +21,23 @@ def new_uid() -> str:
         return f"uid-{next(_uid_counter):08d}"
 
 
+def bump_uid_counter(uids) -> None:
+    """Advance the process-local uid counter past every recovered uid so a
+    restarted process can never mint a colliding uid (recovery path,
+    apiserver.persistence.load_into)."""
+    global _uid_counter
+    highest = 0
+    for u in uids:
+        if isinstance(u, str) and u.startswith("uid-"):
+            try:
+                highest = max(highest, int(u[4:]))
+            except ValueError:
+                continue
+    with _uid_lock:
+        nxt = next(_uid_counter)
+        _uid_counter = itertools.count(max(nxt, highest + 1))
+
+
 @dataclass
 class OwnerReference:
     api_version: str = ""

@@ -1,0 +1,310 @@
+"""Durability for the in-memory API server: write-ahead journal + snapshot.
+
+The reference keeps **no local persistence**: etcd behind the kube-apiserver
+is the checkpoint, and every component rebuilds in-memory state from the API
+on restart (SURVEY §5; device occupancy from pod annotations,
+/root/reference/pkg/flexgpu/gpu_node.go:67-120; ElasticQuota ``used`` from
+pods, /root/reference/pkg/controller/elasticquota.go:212-224). Our control
+plane is hermetic, so this module supplies the etcd half of that contract:
+
+- a **write-ahead journal** (``wal.jsonl``): every store mutation is appended
+  *under the store lock, before its watch event fires* — the same
+  happens-before etcd gives watchers;
+- a **snapshot** (``snapshot.json``) written at compaction time; replay =
+  snapshot + WAL suffix, exactly etcd's snapshot+raft-log recovery;
+- a reflective dataclass codec (all API objects are plain nested dataclasses
+  with scalar leaves, so encoding is total and lossless).
+
+Leases are deliberately NOT persisted: leader-election state must die with
+the process (a restarted process re-campaigns; holding a stale lease across
+restart is the split-brain the reference's leaderelection exit-on-lost-lease
+guards against, /root/reference/cmd/controller/app/server.go:84-123).
+Events are best-effort observability, also skipped (k8s Events are TTL'd).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import meta as metalib
+from ..api.core import Node, Pod, PodDisruptionBudget, PriorityClass
+from ..api.scheduling import ElasticQuota, PodGroup
+from ..api.topology import TpuTopology
+from ..util import klog
+from . import server as srv
+
+# kind → dataclass; LEASES and Events intentionally absent (see module doc).
+KIND_CLASSES: Dict[str, type] = {
+    srv.PODS: Pod,
+    srv.NODES: Node,
+    srv.POD_GROUPS: PodGroup,
+    srv.ELASTIC_QUOTAS: ElasticQuota,
+    srv.PRIORITY_CLASSES: PriorityClass,
+    srv.PDBS: PodDisruptionBudget,
+    srv.TPU_TOPOLOGIES: TpuTopology,
+}
+
+SNAPSHOT_FILE = "snapshot.json"
+WAL_FILE = "wal.jsonl"
+
+
+# -- reflective codec ---------------------------------------------------------
+
+def encode_object(obj: Any) -> Any:
+    """Dataclass → JSON-able. Tuples become lists; the decoder restores them
+    from the field's type hint."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: encode_object(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: encode_object(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_object(v) for v in obj]
+    return obj
+
+
+_hints_cache: Dict[type, Dict[str, Any]] = {}
+
+
+def _type_hints(cls: type) -> Dict[str, Any]:
+    hints = _hints_cache.get(cls)
+    if hints is None:
+        hints = typing.get_type_hints(cls)
+        _hints_cache[cls] = hints
+    return hints
+
+
+def _decode_value(tp: Any, v: Any) -> Any:
+    if v is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[T] (and unions of scalars)
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _decode_value(args[0], v) if len(args) == 1 else v
+    if origin in (list, List):
+        (et,) = typing.get_args(tp) or (Any,)
+        return [_decode_value(et, x) for x in v]
+    if origin in (tuple, Tuple):
+        args = typing.get_args(tp)
+        et = args[0] if args else Any
+        return tuple(_decode_value(et, x) for x in v)
+    if origin in (dict, Dict):
+        args = typing.get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(vt, x) for k, x in v.items()}
+    if dataclasses.is_dataclass(tp):
+        return decode_object(tp, v)
+    return v
+
+
+def decode_object(cls: type, data: Dict[str, Any]) -> Any:
+    hints = _type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _decode_value(hints[f.name], data[f.name])
+    return cls(**kwargs)
+
+
+# -- journal ------------------------------------------------------------------
+
+class Journal:
+    """Appends every store mutation to the WAL; compacts into a snapshot when
+    the WAL grows past ``compact_every`` records.
+
+    The API server invokes the sink under its store lock — there the record
+    is only ENQUEUED (stored objects are never mutated after publication, so
+    encoding can safely happen later). A dedicated writer thread drains the
+    queue in order — WAL order == store mutation order — and does all disk
+    I/O, so the control plane's lock is never held across a syscall.
+    Compaction also runs on the writer thread; replay is idempotent
+    (put=upsert, delete=discard-missing), so a snapshot racing a queued
+    record is harmless."""
+
+    def __init__(self, api: srv.APIServer, directory: str,
+                 fsync: bool = False, compact_every: int = 50_000):
+        self.api = api
+        self.dir = directory
+        self.fsync = fsync
+        self.compact_every = compact_every
+        os.makedirs(directory, exist_ok=True)
+        self._file_lock = threading.Lock()      # guards WAL/snapshot files
+        self._wal_path = os.path.join(directory, WAL_FILE)
+        self._snap_path = os.path.join(directory, SNAPSHOT_FILE)
+        self._wal = open(self._wal_path, "a", encoding="utf-8")
+        self._wal_records = 0
+
+        self._cv = threading.Condition()
+        self._queue: "list[Tuple[str, str, Any]]" = []
+        self._enqueued = 0
+        self._written = 0
+        self._closed = False
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="tpusched-journal", daemon=True)
+        self._writer.start()
+
+    # sink signature: op in {"put", "delete"} — called under the store lock;
+    # must stay allocation-cheap and syscall-free.
+    def __call__(self, op: str, kind: str, obj: Any) -> None:
+        if kind not in KIND_CLASSES:
+            return
+        with self._cv:
+            if self._closed:
+                return
+            self._queue.append((op, kind, obj))
+            self._enqueued += 1
+            self._cv.notify()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.5)
+                batch, self._queue = self._queue, []
+                closing = self._closed
+            if batch:
+                try:
+                    self._write_batch(batch)
+                except Exception as e:  # durability is best-effort: never
+                    klog.error_s(e, "journal write failed")  # take down the plane
+                with self._cv:
+                    self._written += len(batch)
+                    self._cv.notify_all()
+            if closing and not batch:
+                return
+
+    def _write_batch(self, batch) -> None:
+        with self._file_lock:
+            for op, kind, obj in batch:
+                rec = {"op": op, "kind": kind, "obj": encode_object(obj)}
+                self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            self._wal_records += len(batch)
+            needs_compact = self._wal_records >= self.compact_every
+        if needs_compact:
+            self.compact()
+
+    def compact(self) -> None:
+        """Write a full snapshot and truncate the WAL (atomic via rename).
+        Runs on the writer thread (or at attach time); takes the store lock
+        only for the duration of dump_for_snapshot's dict copies."""
+        dump, rv = self.api.dump_for_snapshot(KIND_CLASSES.keys())
+        snap = {"rv": rv,
+                "kinds": {k: [encode_object(o) for o in objs]
+                          for k, objs in dump.items()}}
+        tmp = self._snap_path + ".tmp"
+        with self._file_lock:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path)
+            self._wal.close()
+            self._wal = open(self._wal_path, "w", encoding="utf-8")
+            self._wal_records = 0
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every record enqueued so far is on disk."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            target = self._enqueued
+            while self._written < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def close(self) -> None:
+        """Drain the queue, stop the writer, close the WAL."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._writer.join(timeout=10)
+        with self._file_lock:
+            self._wal.close()
+
+
+# -- recovery + attachment ----------------------------------------------------
+
+def load_into(api: srv.APIServer, directory: str) -> int:
+    """Replay snapshot + WAL from ``directory`` into ``api``. Returns the
+    number of live objects restored. Must run before any watchers register
+    (restore does not dispatch events — informers replay on add_watch)."""
+    by_kind: Dict[str, Dict[str, Any]] = {k: {} for k in KIND_CLASSES}
+    max_rv = 0
+
+    snap_path = os.path.join(directory, SNAPSHOT_FILE)
+    if os.path.exists(snap_path):
+        with open(snap_path, encoding="utf-8") as f:
+            snap = json.load(f)
+        max_rv = snap.get("rv", 0)
+        for kind, objs in snap.get("kinds", {}).items():
+            cls = KIND_CLASSES.get(kind)
+            if cls is None:
+                continue
+            for data in objs:
+                obj = decode_object(cls, data)
+                by_kind[kind][obj.meta.key] = obj
+
+    wal_path = os.path.join(directory, WAL_FILE)
+    if os.path.exists(wal_path):
+        with open(wal_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail write (crash mid-append): stop replay here,
+                    # everything before the tear is consistent
+                    klog.error_s(None, "journal tail truncated; stopping replay")
+                    break
+                kind, cls = rec.get("kind"), KIND_CLASSES.get(rec.get("kind"))
+                if cls is None:
+                    continue
+                obj = decode_object(cls, rec["obj"])
+                if rec["op"] == "delete":
+                    by_kind[kind].pop(obj.meta.key, None)
+                else:
+                    by_kind[kind][obj.meta.key] = obj
+
+    count = 0
+    uids: List[str] = []
+    for kind, objs in by_kind.items():
+        if objs:
+            api.restore(kind, objs.values())
+            count += len(objs)
+            for o in objs.values():
+                max_rv = max(max_rv, o.meta.resource_version)
+                uids.append(o.meta.uid)
+    api.restore_resource_version(max_rv)
+    metalib.bump_uid_counter(uids)
+    return count
+
+
+def attach(api: srv.APIServer, directory: str, fsync: bool = False,
+           compact_every: int = 50_000) -> Journal:
+    """Recover state from ``directory`` (if any) into ``api``, then install a
+    Journal as its persistence sink. Call before starting schedulers or
+    controllers."""
+    restored = load_into(api, directory)
+    if restored:
+        klog.info_s("recovered state from journal", directory=directory,
+                    objects=restored)
+    journal = Journal(api, directory, fsync=fsync, compact_every=compact_every)
+    # fold recovered state into a fresh snapshot so old WAL entries are
+    # dropped and recovery stays O(live objects), not O(history)
+    journal.compact()
+    api.set_persistence_sink(journal)
+    return journal

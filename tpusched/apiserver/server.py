@@ -86,12 +86,42 @@ class APIServer:
         self._handlers: Dict[str, List[Callable[[WatchEvent], None]]] = {k: [] for k in ALL_KINDS}
         self._events: List[Event] = []           # k8s Events (recorder sink)
         self._stopped = False
+        # Optional persistence sink (apiserver.persistence.Journal): called
+        # under the store lock, before the watch event fires — the etcd
+        # happens-before. Signature: sink(op: "put"|"delete", kind, stored).
+        self._persist: Optional[Callable[[str, str, Any], None]] = None
 
     # -- plumbing -------------------------------------------------------------
 
     def _bump(self, obj) -> None:
         self._rv += 1
         obj.meta.resource_version = self._rv
+
+    def set_persistence_sink(self, sink: Optional[Callable[[str, str, Any], None]]) -> None:
+        with self._lock:
+            self._persist = sink
+
+    def restore(self, kind: str, objects) -> None:
+        """Load recovered objects without dispatching watch events (informers
+        replay on add_watch). Only valid before watchers register."""
+        with self._lock:
+            for o in objects:
+                self._stores[kind][o.meta.key] = o
+                if o.meta.resource_version > self._rv:
+                    self._rv = o.meta.resource_version
+
+    def restore_resource_version(self, rv: int) -> None:
+        with self._lock:
+            if rv > self._rv:
+                self._rv = rv
+
+    def dump_for_snapshot(self, kinds) -> "tuple[Dict[str, List[Any]], int]":
+        """Consistent point-in-time view of the stores for compaction. The
+        returned objects are the live stored ones — callers must only read
+        (the persistence codec does)."""
+        with self._lock:
+            return ({k: list(self._stores[k].values()) for k in kinds},
+                    self._rv)
 
     def _dispatch(self, ev: WatchEvent) -> None:
         for h in list(self._handlers[ev.kind]):
@@ -130,6 +160,8 @@ class APIServer:
                 stored.meta.creation_timestamp = self._clock()
             self._bump(stored)
             self._stores[kind][key] = stored
+            if self._persist:
+                self._persist("put", kind, stored)
         self._dispatch(WatchEvent(ADDED, kind, stored))
         return stored.deepcopy()  # callers own (and may mutate) returns
 
@@ -168,6 +200,8 @@ class APIServer:
             stored.meta.uid = old.meta.uid
             self._bump(stored)
             self._stores[kind][key] = stored
+            if self._persist:
+                self._persist("put", kind, stored)
         self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
         return stored.deepcopy()
 
@@ -183,6 +217,8 @@ class APIServer:
             mutate(stored)
             self._bump(stored)
             self._stores[kind][key] = stored
+            if self._persist:
+                self._persist("put", kind, stored)
         self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
         return stored.deepcopy()
 
@@ -191,6 +227,8 @@ class APIServer:
             obj = self._stores[kind].pop(key, None)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
+            if self._persist:
+                self._persist("delete", kind, obj)
         self._dispatch(WatchEvent(DELETED, kind, obj))
 
     def peek(self, kind: str, key: str):

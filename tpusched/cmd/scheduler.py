@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emulate a v5p pool with these torus dims, e.g. 8x8x4")
     p.add_argument("--validate-only", action="store_true",
                    help="decode + wire the config, print the resolved profile, exit")
+    p.add_argument("--state-dir", default=None,
+                   help="persist control-plane state (WAL + snapshot) here and "
+                        "recover it on restart — the etcd durability analog")
     p.add_argument("-v", "--verbosity", type=int, default=2,
                    help="klog verbosity")
     return p
@@ -91,6 +94,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     klog.set_verbosity(args.verbosity)
 
     api = APIServer()
+    journal = None
+    if args.state_dir and not args.validate_only:
+        from ..apiserver import persistence
+        journal = persistence.attach(api, args.state_dir)
     profile = resolve_profile(args)
     scheduler = Scheduler(api, default_registry(), profile)
 
@@ -102,9 +109,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..testing.wrappers import make_tpu_pool
         dims = tuple(int(d) for d in args.emulate_pool.split("x"))
         topo, nodes = make_tpu_pool("pool-0", dims=dims)
-        api.create(srv.TPU_TOPOLOGIES, topo)
+        # a recovered state dir may already carry the pool: emulate is
+        # idempotent for identical dims, and refuses a silent reshape
+        existing = api.try_get(srv.TPU_TOPOLOGIES, topo.key)
+        if existing is not None and tuple(existing.spec.dims) != dims:
+            klog.error_s(None, "recovered pool dims conflict with --emulate-pool",
+                         recovered="x".join(map(str, existing.spec.dims)),
+                         requested=args.emulate_pool)
+            scheduler.stop()
+            if journal is not None:
+                journal.close()
+            return 1
+        if existing is None:
+            api.create(srv.TPU_TOPOLOGIES, topo)
         for n in nodes:
-            api.create(srv.NODES, n)
+            if api.try_get(srv.NODES, n.meta.key) is None:
+                api.create(srv.NODES, n)
         klog.info_s("emulated TPU pool", dims=args.emulate_pool,
                     nodes=len(nodes))
 
@@ -118,6 +138,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             stop.wait(1.0)
     finally:
         scheduler.stop()
+        if journal is not None:
+            journal.close()
     return 0
 
 

@@ -27,8 +27,12 @@ class TestCluster:
 
     def __init__(self, profile: Optional[PluginProfile] = None,
                  registry: Optional[Registry] = None,
-                 start_controllers: bool = False):
-        self.api = APIServer()
+                 start_controllers: bool = False,
+                 api: Optional[APIServer] = None):
+        # `api` lets a test restart the control plane against surviving state
+        # (e.g. one recovered by apiserver.persistence.attach) — the analog of
+        # rebooting the scheduler against a live etcd.
+        self.api = api if api is not None else APIServer()
         self.client = Clientset(self.api)
         self.profile = profile or default_profile()
         self.scheduler = Scheduler(self.api, registry or default_registry(),
