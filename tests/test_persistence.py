@@ -130,6 +130,35 @@ def test_torn_wal_tail_is_ignored(tmp_path):
     assert restored == 2
 
 
+def test_rv_monotonic_across_delete_and_restart(tmp_path):
+    """rv bumps consumed by objects deleted before the crash must still
+    advance the recovered rv floor."""
+    d = str(tmp_path / "state")
+    api = srv.APIServer()
+    journal = persistence.attach(api, d)
+    api.create(srv.PODS, make_pod("a"))
+    rv_b = api.create(srv.PODS, make_pod("b")).meta.resource_version
+    api.delete(srv.PODS, "default/b")
+    journal.close()
+
+    api2 = srv.APIServer()
+    persistence.attach(api2, d)
+    c = api2.create(srv.PODS, make_pod("c"))
+    assert c.meta.resource_version > rv_b
+
+
+def test_flush_reports_write_failure(tmp_path, monkeypatch):
+    d = str(tmp_path / "state")
+    api = srv.APIServer()
+    journal = persistence.attach(api, d)
+
+    def boom(batch):
+        raise OSError("disk full")
+    monkeypatch.setattr(journal, "_write_batch", boom)
+    api.create(srv.PODS, make_pod("a"))
+    assert journal.flush(timeout=5) is False
+
+
 # -- scheduler restart over recovered state -----------------------------------
 
 def test_scheduler_restart_rebuilds_chip_occupancy(tmp_path):

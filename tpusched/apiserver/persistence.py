@@ -142,7 +142,8 @@ class Journal:
         self._cv = threading.Condition()
         self._queue: "list[Tuple[str, str, Any]]" = []
         self._enqueued = 0
-        self._written = 0
+        self._processed = 0     # records drained (written or failed)
+        self._failed = 0        # records lost to write errors
         self._closed = False
         self._writer = threading.Thread(target=self._writer_loop,
                                         name="tpusched-journal", daemon=True)
@@ -168,12 +169,15 @@ class Journal:
                 batch, self._queue = self._queue, []
                 closing = self._closed
             if batch:
+                lost = 0
                 try:
                     self._write_batch(batch)
                 except Exception as e:  # durability is best-effort: never
                     klog.error_s(e, "journal write failed")  # take down the plane
+                    lost = len(batch)
                 with self._cv:
-                    self._written += len(batch)
+                    self._processed += len(batch)
+                    self._failed += lost
                     self._cv.notify_all()
             if closing and not batch:
                 return
@@ -211,16 +215,18 @@ class Journal:
             self._wal_records = 0
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Block until every record enqueued so far is on disk."""
+        """Block until every record enqueued so far has been processed.
+        Returns False on timeout OR if any record was lost to a write error —
+        callers must not treat state as durable then."""
         deadline = time.monotonic() + timeout
         with self._cv:
             target = self._enqueued
-            while self._written < target:
+            while self._processed < target:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cv.wait(remaining)
-        return True
+            return self._failed == 0
 
     def close(self) -> None:
         """Drain the queue, stop the writer, close the WAL."""
@@ -274,6 +280,11 @@ def load_into(api: srv.APIServer, directory: str) -> int:
                 if cls is None:
                     continue
                 obj = decode_object(cls, rec["obj"])
+                # every record — including deletes and superseded puts —
+                # advances the rv floor, so post-restart writes can never
+                # re-mint a resource_version watchers already observed
+                if obj.meta.resource_version > max_rv:
+                    max_rv = obj.meta.resource_version
                 if rec["op"] == "delete":
                     by_kind[kind].pop(obj.meta.key, None)
                 else:

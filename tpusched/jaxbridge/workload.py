@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import attention
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
@@ -28,6 +30,10 @@ class ModelConfig:
     d_ff: int = 512
     seq: int = 128
     dtype: Any = jnp.float32
+    # attention implementation: "naive" (materialized), "flash" (pallas
+    # online-softmax kernel), or "ring" (sp-axis sequence parallelism;
+    # requires an sp mesh axis — falls back to naive+GSPMD without one)
+    attn: str = "naive"
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -87,33 +93,43 @@ def _rotary(x: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _block(x: jax.Array, p: Dict[str, jax.Array], n_heads: int) -> jax.Array:
+def _block(x: jax.Array, p: Dict[str, jax.Array], n_heads: int,
+           attn_fn=None) -> jax.Array:
     b, s, d = x.shape
     hd = d // n_heads
     h = _rmsnorm(x, p["ln_attn"])
     q = _rotary((h @ p["wq"]).reshape(b, s, n_heads, hd))
     k = _rotary((h @ p["wk"]).reshape(b, s, n_heads, hd))
     v = (h @ p["wv"]).reshape(b, s, n_heads, hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(b, s, d) @ p["wo"]
+    if attn_fn is None:
+        attn_fn = attention.naive_attention
+    o = attn_fn(q, k, v).reshape(b, s, d) @ p["wo"]
     x = x + o
     h = _rmsnorm(x, p["ln_mlp"])
     mlp = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
     return x + mlp
 
 
+def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
+    if attn_fn is not None:
+        return attn_fn
+    if cfg.attn == "flash":
+        return attention.flash_attention
+    return attention.naive_attention
+
+
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            act_spec: Optional[Any] = None) -> jax.Array:
+            act_spec: Optional[Any] = None, attn_fn=None) -> jax.Array:
+    attn_fn = _resolve_attn_fn(cfg, attn_fn)
     x = params["embed"][tokens]
     if act_spec is not None:
         # sequence parallelism: constrain activations to the sp axis and let
-        # GSPMD insert the attention gathers/collectives
+        # GSPMD insert the attention gathers/collectives (cfg.attn == "ring"
+        # instead rotates K/V around the sp ring explicitly, see
+        # make_sharded_train_step)
         x = jax.lax.with_sharding_constraint(x, act_spec)
     for layer in params["layers"]:
-        x = _block(x, layer, cfg.n_heads)
+        x = _block(x, layer, cfg.n_heads, attn_fn)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
     x = _rmsnorm(x, params["ln_f"])
@@ -121,8 +137,12 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            act_spec: Optional[Any] = None) -> jax.Array:
-    logits = forward(params, tokens[:, :-1], cfg, act_spec).astype(jnp.float32)
+            act_spec: Optional[Any] = None, attn_fn=None) -> jax.Array:
+    # run the full sequence and slice logits afterward — identical for a
+    # causal model, and keeps the sequence dim evenly divisible for ring
+    # attention's manual sp sharding
+    logits = forward(params, tokens, cfg, act_spec,
+                     attn_fn)[:, :-1].astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -130,10 +150,11 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
 
 
 def sgd_train_step(params: Params, tokens: jax.Array, cfg: ModelConfig,
-                   lr: float = 1e-3,
-                   act_spec: Optional[Any] = None) -> Tuple[Params, jax.Array]:
+                   lr: float = 1e-3, act_spec: Optional[Any] = None,
+                   attn_fn=None) -> Tuple[Params, jax.Array]:
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
-                                              act_spec=act_spec)
+                                              act_spec=act_spec,
+                                              attn_fn=attn_fn)
     new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                         params, grads)
     return new_params, loss
@@ -189,11 +210,19 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
     batch_spec = b_axes if b_axes else None
     token_sharding = NamedSharding(mesh, P(batch_spec, None))
     act_spec = None
+    attn_fn = None
     if "sp" in mesh.axis_names:
         act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
+        if cfg.attn == "ring":
+            # explicit sequence parallelism: K/V ride the sp ring
+            # (ppermute over ICI) instead of GSPMD-inserted gathers
+            attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
+    if attn_fn is None:
+        attn_fn = _resolve_attn_fn(cfg)
 
     step = jax.jit(
-        functools.partial(sgd_train_step, cfg=cfg, act_spec=act_spec),
+        functools.partial(sgd_train_step, cfg=cfg, act_spec=act_spec,
+                          attn_fn=attn_fn),
         in_shardings=(param_shardings, token_sharding),
         out_shardings=(param_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,))
