@@ -85,10 +85,10 @@ def test_ring_gradients_match_naive():
 def test_ring_composes_with_full_mesh_train_step():
     """cfg.attn='ring' on a dp×sp×tp mesh: the full sharded train step runs
     and matches the GSPMD (naive) step loss."""
+    import dataclasses
     mesh = build_named_mesh({"dp": 2, "sp": 2, "tp": 2})
-    cfg_ring = workload.ModelConfig.tiny()
-    cfg_ring = type(cfg_ring)(**{**cfg_ring.__dict__, "attn": "ring"})
     cfg_naive = workload.ModelConfig.tiny()
+    cfg_ring = dataclasses.replace(cfg_naive, attn="ring")
 
     tokens = jax.random.randint(jax.random.PRNGKey(6), (4, cfg_ring.seq),
                                 0, cfg_ring.vocab)

@@ -154,9 +154,19 @@ def test_flush_reports_write_failure(tmp_path, monkeypatch):
 
     def boom(batch):
         raise OSError("disk full")
+    real_write = journal._write_batch
     monkeypatch.setattr(journal, "_write_batch", boom)
     api.create(srv.PODS, make_pod("a"))
     assert journal.flush(timeout=5) is False
+
+    # a successful compaction snapshots the full live store — the lost
+    # record is durable again and flush() recovers
+    monkeypatch.setattr(journal, "_write_batch", real_write)
+    journal.compact()
+    assert journal.flush(timeout=5) is True
+    api2 = srv.APIServer()
+    persistence.load_into(api2, d)
+    assert api2.try_get(srv.PODS, "default/a") is not None
 
 
 # -- scheduler restart over recovered state -----------------------------------

@@ -213,6 +213,11 @@ class Journal:
             self._wal.close()
             self._wal = open(self._wal_path, "w", encoding="utf-8")
             self._wal_records = 0
+        # a successful snapshot contains every live object, so records lost
+        # to earlier write errors are durable again — clear the failure flag
+        with self._cv:
+            self._failed = 0
+            self._cv.notify_all()
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until every record enqueued so far has been processed.

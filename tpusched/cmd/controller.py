@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reconcile workers per controller (options.go:45)")
     p.add_argument("--enable-leader-election", action="store_true",
                    help="campaign for the sched-plugins-controller lease")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve /metrics /healthz /readyz /debug/threads on "
+                        "127.0.0.1:PORT (0 picks a free port; off by default)")
     p.add_argument("-v", "--verbosity", type=int, default=2)
     return p
 
@@ -48,6 +51,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     api = APIServer()
     runner = ControllerRunner(api, options_from_args(args))
 
+    metrics_server = None
+    if args.metrics_port is not None:
+        from ..util.httpserve import MetricsServer
+        # ready once controllers run (post-leader-election when enabled)
+        metrics_server = MetricsServer(
+            args.metrics_port,
+            ready_probe=lambda: runner.is_leader.is_set()).start()
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -59,6 +70,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             stop.wait(1.0)
     finally:
         runner.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

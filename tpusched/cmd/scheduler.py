@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--state-dir", default=None,
                    help="persist control-plane state (WAL + snapshot) here and "
                         "recover it on restart — the etcd durability analog")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve /metrics /healthz /readyz /debug/threads on "
+                        "127.0.0.1:PORT (0 picks a free port; off by default)")
     p.add_argument("-v", "--verbosity", type=int, default=2,
                    help="klog verbosity")
     return p
@@ -128,6 +131,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         klog.info_s("emulated TPU pool", dims=args.emulate_pool,
                     nodes=len(nodes))
 
+    metrics_server = None
+    if args.metrics_port is not None:
+        from ..util.httpserve import MetricsServer
+        metrics_server = MetricsServer(
+            args.metrics_port, ready_probe=lambda: scheduler.running).start()
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -138,6 +147,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             stop.wait(1.0)
     finally:
         scheduler.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
         if journal is not None:
             journal.close()
     return 0

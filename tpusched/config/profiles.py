@@ -62,3 +62,24 @@ def tpuslice_profile(scheduler_name: str = "tpusched") -> PluginProfile:
         reserve=["TpuSlice"],
         bind=["TpuSlice"],
     )
+
+
+def load_aware_profile(watcher_address: str = "",
+                       target_utilization: int = None,
+                       scheduler_name: str = "tpusched") -> PluginProfile:
+    """Trimaran load-aware scoring (mirrors manifests/trimaran/
+    scheduler-config wiring: TargetLoadPacking as the sole scorer fed by a
+    load-watcher endpoint, targetloadpacking.go:82-96)."""
+    from .types import TargetLoadPackingArgs
+    args = TargetLoadPackingArgs(watcher_address=watcher_address)
+    if target_utilization is not None:
+        args.target_utilization = target_utilization
+    return PluginProfile(
+        scheduler_name=scheduler_name,
+        queue_sort="PrioritySort",
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit"],
+        score=[("TargetLoadPacking", 1)],
+        bind=["DefaultBinder"],
+        plugin_args={"TargetLoadPacking": args},
+    )

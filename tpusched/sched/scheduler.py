@@ -76,6 +76,13 @@ class Scheduler:
     def framework(self) -> Framework:
         return self._fw
 
+    @property
+    def running(self) -> bool:
+        """Readiness: the scheduleOne loop is up and not shutting down."""
+        return (self._sched_thread is not None
+                and self._sched_thread.is_alive()
+                and not self._stop.is_set())
+
     # -- informer wiring ------------------------------------------------------
 
     def _responsible(self, pod: Pod) -> bool:

@@ -1,0 +1,97 @@
+"""Observability HTTP endpoint: /metrics, /healthz, /debug/threads.
+
+The reference inherits the kube-scheduler's serving stack — Prometheus
+/metrics scraped via ServiceMonitor (/root/reference/config/prometheus/
+monitor.yaml:4-22) and component-base /debug/pprof (SURVEY §5). This is the
+rebuild's equivalent for its own binaries:
+
+- ``/metrics``   Prometheus text exposition of util.metrics.REGISTRY
+- ``/healthz``   liveness ("ok")
+- ``/readyz``    readiness (caller-supplied probe)
+- ``/debug/threads``  stack dump of every thread (the pprof-goroutine analog)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from . import klog
+from .metrics import REGISTRY
+
+
+def _thread_dump() -> str:
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        daemon = t.daemon if t else "?"
+        out.append(f"--- {name} (ident={ident} daemon={daemon}) ---")
+        out.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """Serves the registry on 127.0.0.1:<port>; port=0 picks a free one."""
+
+    def __init__(self, port: int = 0,
+                 ready_probe: Optional[Callable[[], bool]] = None):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, REGISTRY.expose(),
+                               "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    self._send(200, "ok\n")
+                elif self.path == "/readyz":
+                    ready = server.ready_probe() if server.ready_probe else True
+                    self._send(200 if ready else 503,
+                               "ok\n" if ready else "not ready\n")
+                elif self.path == "/debug/threads":
+                    self._send(200, _thread_dump())
+                elif self.path == "/debug/vars":
+                    self._send(200, json.dumps(
+                        {"threads": threading.active_count()}) + "\n",
+                        "application/json")
+                else:
+                    self._send(404, "not found\n")
+
+            def _send(self, code: int, body: str, ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):  # route through klog, V(6)
+                klog.V(6).info_s("http " + fmt % args)
+
+        self.ready_probe = ready_probe
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tpusched-metrics-http",
+                                        daemon=True)
+        self._thread.start()
+        klog.info_s("metrics endpoint up", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
