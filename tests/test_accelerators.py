@@ -1,0 +1,76 @@
+"""Accelerator-catalog coverage: gang + slice placement end-to-end on every
+supported TPU generation (v4/v5e/v5p/v6e), exercising both 2-D mesh and 3-D
+torus host extents and the 8-chips-per-host v6e layout."""
+from __future__ import annotations
+
+import pytest
+
+from tpusched.api.resources import TPU
+from tpusched.api.topology import ACCELERATORS
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.plugins.topologymatch import COORD_ANNOTATION
+from tpusched.testing import TestCluster, make_pod, make_pod_group, make_tpu_pool
+from tpusched.topology.torus import HOST_EXTENT
+
+
+def test_catalog_is_consistent():
+    for name, acc in ACCELERATORS.items():
+        extent = HOST_EXTENT[name]
+        assert len(extent) == acc.ici_dims == len(acc.max_dims)
+        chips_in_extent = 1
+        for e in extent:
+            chips_in_extent *= e
+        assert chips_in_extent == acc.chips_per_host
+        # every max dim is tileable by the host extent
+        assert all(d % e == 0 for d, e in zip(acc.max_dims, extent))
+
+
+@pytest.mark.parametrize("accelerator,pool_dims,slice_shape,gang", [
+    ("tpu-v4", (4, 4, 4), "2x2x4", 4),     # 3-D torus, 16 chips = 4 hosts
+    ("tpu-v5e", (8, 8), "4x4", 4),         # 2-D mesh, 16 chips = 4 hosts
+    ("tpu-v5p", (4, 4, 4), "4x4x1", 4),    # 3-D torus
+    ("tpu-v6e", (8, 8), "4x4", 2),         # 2-D, 8 chips/host ⇒ 2 hosts
+])
+def test_gang_slice_placement_per_generation(accelerator, pool_dims,
+                                             slice_shape, gang):
+    acc = ACCELERATORS[accelerator]
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=10)) as c:
+        topo, nodes = make_tpu_pool("pool", accelerator=accelerator,
+                                    dims=pool_dims)
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("g", min_member=gang,
+                                    tpu_slice_shape=slice_shape,
+                                    tpu_accelerator=accelerator))
+        pods = [make_pod(f"w{i}", pod_group="g",
+                         limits={TPU: acc.chips_per_host})
+                for i in range(gang)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods])
+        # every member landed on a distinct host with a torus coordinate
+        placed = {c.pod(p.key).spec.node_name for p in pods}
+        assert len(placed) == gang
+        coords = {c.pod(p.key).meta.annotations[COORD_ANNOTATION]
+                  for p in pods}
+        assert len(coords) == gang
+
+
+def test_v6e_eight_chip_host_packs_two_four_chip_pods():
+    """Sub-host pods pack a single 8-chip v6e host before spilling."""
+    with TestCluster() as c:
+        topo, nodes = make_tpu_pool("pool", accelerator="tpu-v6e", dims=(4, 2))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)  # one host, 8 chips
+        assert len(nodes) == 1
+        pods = [make_pod(f"w{i}", limits={TPU: 4}) for i in range(2)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods])
+        chips = set()
+        for p in pods:
+            ann = c.pod(p.key).meta.annotations["tpuslice.scheduling.tpu.dev/chip-index"]
+            chips.update(ann.split(","))
+        assert len(chips) == 8  # disjoint halves of the same host
+        c.create_pods([make_pod("overflow", limits={TPU: 1})])
+        assert c.wait_for_pods_unscheduled(["default/overflow"])

@@ -61,8 +61,16 @@ class ObjectMeta:
 
     @property
     def key(self) -> str:
-        """namespace/name key, the canonical cache key (client-go MetaNamespaceKeyFunc)."""
-        return f"{self.namespace}/{self.name}"
+        """namespace/name key, the canonical cache key (client-go
+        MetaNamespaceKeyFunc). Lazily cached: name/namespace are immutable
+        once an object is in play (k8s semantics; cluster-scoped kinds blank
+        the namespace in their own __post_init__, before any access). The
+        cache lives outside the dataclass fields so eq/repr/codec ignore it."""
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = f"{self.namespace}/{self.name}"
+            self.__dict__["_key"] = k
+        return k
 
     def deepcopy(self) -> "ObjectMeta":
         # Hand-rolled: all leaves are scalars, so shallow container copies

@@ -36,15 +36,23 @@ class TpuAccelerator:
     max_dims: Tuple[int, ...]   # largest supported slice per axis (chips)
 
 
-# Public v5e/v5p topology facts (cloud.google.com/tpu docs): v5e hosts carry
-# 1/4/8 chips (we model 4), 16 GB HBM, 2-D up to 16x16; v5p hosts carry 4
-# chips, 95 GB HBM, 3-D torus up to 16x20x28.
+# Public topology facts (cloud.google.com/tpu docs):
+# - v4: 3-D torus, 4 chips/host, 32 GB HBM, slices 2x2x1 … 16x16x16
+# - v5e: 2-D mesh, hosts carry 1/4/8 chips (we model 4), 16 GB HBM, up to 16x16
+# - v5p: 3-D torus, 4 chips/host, 95 GB HBM, up to 16x20x28
+# - v6e (Trillium): 2-D mesh, 8 chips/host (ct6e-standard-8t), 32 GB HBM,
+#   up to 16x16
+V4 = TpuAccelerator("tpu-v4", ici_dims=3, chips_per_host=4,
+                    hbm_mb_per_chip=32 * 1024, max_dims=(16, 16, 16))
 V5E = TpuAccelerator("tpu-v5e", ici_dims=2, chips_per_host=4,
                      hbm_mb_per_chip=16 * 1024, max_dims=(16, 16))
 V5P = TpuAccelerator("tpu-v5p", ici_dims=3, chips_per_host=4,
                      hbm_mb_per_chip=95 * 1024, max_dims=(16, 20, 28))
+V6E = TpuAccelerator("tpu-v6e", ici_dims=2, chips_per_host=8,
+                     hbm_mb_per_chip=32 * 1024, max_dims=(16, 16))
 
-ACCELERATORS: Dict[str, TpuAccelerator] = {a.name: a for a in (V5E, V5P)}
+ACCELERATORS: Dict[str, TpuAccelerator] = {a.name: a
+                                           for a in (V4, V5E, V5P, V6E)}
 
 
 def parse_shape(s: str) -> Tuple[int, ...]:

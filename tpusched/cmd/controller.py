@@ -33,8 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-leader-election", action="store_true",
                    help="campaign for the sched-plugins-controller lease")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
-                   help="serve /metrics /healthz /readyz /debug/threads on "
-                        "127.0.0.1:PORT (0 picks a free port; off by default)")
+                   help="serve /metrics /healthz /readyz /debug/threads "
+                        "(0 picks a free port; off by default)")
+    p.add_argument("--metrics-bind-address", default="127.0.0.1",
+                   help="bind address for --metrics-port; use 0.0.0.0 "
+                        "in-cluster so ServiceMonitor/kubelet can reach it")
     p.add_argument("-v", "--verbosity", type=int, default=2)
     return p
 
@@ -57,7 +60,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # ready once controllers run (post-leader-election when enabled)
         metrics_server = MetricsServer(
             args.metrics_port,
-            ready_probe=lambda: runner.is_leader.is_set()).start()
+            ready_probe=lambda: runner.is_leader.is_set(),
+            host=args.metrics_bind_address).start()
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

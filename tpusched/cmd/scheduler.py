@@ -48,8 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist control-plane state (WAL + snapshot) here and "
                         "recover it on restart — the etcd durability analog")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
-                   help="serve /metrics /healthz /readyz /debug/threads on "
-                        "127.0.0.1:PORT (0 picks a free port; off by default)")
+                   help="serve /metrics /healthz /readyz /debug/threads "
+                        "(0 picks a free port; off by default)")
+    p.add_argument("--metrics-bind-address", default="127.0.0.1",
+                   help="bind address for --metrics-port; use 0.0.0.0 "
+                        "in-cluster so ServiceMonitor/kubelet can reach it")
     p.add_argument("-v", "--verbosity", type=int, default=2,
                    help="klog verbosity")
     return p
@@ -59,6 +62,7 @@ CANNED_PROFILES = {
     "tpu-gang": canned.tpu_gang_profile,
     "capacity": canned.capacity_profile,
     "tpuslice": canned.tpuslice_profile,
+    "load-aware": canned.load_aware_profile,
 }
 
 
@@ -135,7 +139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.metrics_port is not None:
         from ..util.httpserve import MetricsServer
         metrics_server = MetricsServer(
-            args.metrics_port, ready_probe=lambda: scheduler.running).start()
+            args.metrics_port, ready_probe=lambda: scheduler.running,
+            host=args.metrics_bind_address).start()
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

@@ -65,7 +65,7 @@ def tpuslice_profile(scheduler_name: str = "tpusched") -> PluginProfile:
 
 
 def load_aware_profile(watcher_address: str = "",
-                       target_utilization: int = None,
+                       target_utilization: "int | None" = None,
                        scheduler_name: str = "tpusched") -> PluginProfile:
     """Trimaran load-aware scoring (mirrors manifests/trimaran/
     scheduler-config wiring: TargetLoadPacking as the sole scorer fed by a

@@ -22,8 +22,10 @@ from ..util import klog
 
 # Host extents: how a host's chips are laid out in the torus.
 HOST_EXTENT = {
+    "tpu-v4": (2, 2, 1),    # 4 chips as a 2x2x1 block of the 3-D torus
     "tpu-v5e": (2, 2),      # 4 chips as a 2x2 tile of the 2-D torus
     "tpu-v5p": (2, 2, 1),   # 4 chips as a 2x2x1 block of the 3-D torus
+    "tpu-v6e": (4, 2),      # 8 chips as a 4x2 tile of the 2-D mesh
 }
 
 Coord = Tuple[int, ...]

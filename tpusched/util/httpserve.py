@@ -37,10 +37,13 @@ def _thread_dump() -> str:
 
 
 class MetricsServer:
-    """Serves the registry on 127.0.0.1:<port>; port=0 picks a free one."""
+    """Serves the registry on <host>:<port>; port=0 picks a free one.
+    Default bind is loopback (safe for local runs); in-cluster deployments
+    scrape via ServiceMonitor and must bind 0.0.0.0 (--metrics-bind-address)."""
 
     def __init__(self, port: int = 0,
-                 ready_probe: Optional[Callable[[], bool]] = None):
+                 ready_probe: Optional[Callable[[], bool]] = None,
+                 host: str = "127.0.0.1"):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,7 +78,7 @@ class MetricsServer:
                 klog.V(6).info_s("http " + fmt % args)
 
         self.ready_probe = ready_probe
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
     @property
