@@ -216,3 +216,25 @@ def test_duplicate_scheduler_names_rejected():
     raw["profiles"] = raw["profiles"] * 2
     with pytest.raises(ConfigError, match="duplicate schedulerName"):
         v.decode(raw)
+
+
+def test_percentage_of_nodes_to_score_decodes():
+    cfg = v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+          percentageOfNodesToScore: 100
+    """))
+    assert cfg.profiles[0].percentage_of_nodes_to_score == 100
+
+
+def test_percentage_of_nodes_to_score_rejects_out_of_range():
+    with pytest.raises(ConfigError):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1beta1
+            kind: TpuSchedulerConfiguration
+            profiles:
+            - schedulerName: tpusched
+              percentageOfNodesToScore: 150
+        """))

@@ -1,0 +1,71 @@
+"""Adaptive node sampling (upstream numFeasibleNodesToFind semantics) and a
+mid-size gang stress run exercising it end to end."""
+from __future__ import annotations
+
+import time
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, make_tpu_pool)
+
+
+def test_num_feasible_nodes_formula():
+    with TestCluster() as c:
+        s = c.scheduler
+        # below the 100-node floor: scan everything
+        assert s._num_feasible_nodes_to_find(64) == 64
+        assert s._num_feasible_nodes_to_find(99) == 99
+        # adaptive: 50 - nodes//125 percent, but never below 100 nodes
+        assert s._num_feasible_nodes_to_find(256) == max(100, 256 * 48 // 100)
+        assert s._num_feasible_nodes_to_find(5000) == 5000 * 10 // 100
+        # explicit 100% pins a full scan
+        s.percentage_of_nodes_to_score = 100
+        assert s._num_feasible_nodes_to_find(256) == 256
+        s.percentage_of_nodes_to_score = 5
+        assert s._num_feasible_nodes_to_find(4000) == 200
+
+
+def test_round_robin_start_spreads_scans():
+    """With sampling active, successive cycles start at different nodes, so
+    placement spreads instead of hammering the scan prefix."""
+    with TestCluster() as c:
+        c.add_nodes([make_node(f"n{i:03d}",
+                               capacity=make_resources(cpu=64, memory="64Gi"))
+                     for i in range(120)])
+        pods = [make_pod(f"p{i}", requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(8)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods])
+        start = c.scheduler._next_start_node_index
+        assert start != 0  # the scan window moved
+
+
+def test_512_gang_on_128_hosts_schedules_fully():
+    """Stress: sampling must never starve a gang — all 512 members bind, 4
+    chips per host, and the slice stays exact."""
+    GANG = 512
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=120)) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(8, 8, 8))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        assert len(nodes) == 128
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("big", min_member=GANG,
+                                    tpu_slice_shape="8x8x8",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w{i:03d}", pod_group="big", limits={TPU: 1},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(GANG)]
+        t0 = time.perf_counter()
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=120)
+        elapsed = time.perf_counter() - t0
+        used = {}
+        for p in pods:
+            node = c.pod(p.key).spec.node_name
+            used[node] = used.get(node, 0) + 1
+        assert len(used) == 128 and set(used.values()) == {4}
+        # soft budget: scale roughly linearly with the bench (0.5s @ 256)
+        assert elapsed < 30, f"512-gang took {elapsed:.1f}s"

@@ -82,7 +82,10 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
       - name: Coscheduling
         args: {permitWaitingTimeSeconds: 10}
     """
-    profile = PluginProfile(scheduler_name=raw.get("schedulerName", "tpusched"))
+    profile = PluginProfile(
+        scheduler_name=raw.get("schedulerName", "tpusched"),
+        percentage_of_nodes_to_score=int(
+            raw.get("percentageOfNodesToScore", 0) or 0))
     plugins = raw.get("plugins", {}) or {}
 
     qs = plugins.get("queueSort", {}).get("enabled", [])

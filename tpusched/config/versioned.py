@@ -150,8 +150,13 @@ def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
 
 
 def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
-    _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig"})
+    _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig",
+                                   "percentageOfNodesToScore"})
     name = raw.get("schedulerName") or "tpusched"
+    pct = int(raw.get("percentageOfNodesToScore") or 0)
+    if not 0 <= pct <= 100:
+        raise ConfigError(
+            f"profile {name!r}: percentageOfNodesToScore must be 0-100, got {pct}")
     plugins = raw.get("plugins") or {}
     for ep in plugins:
         if ep not in EXTENSION_POINTS:
@@ -192,6 +197,7 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         bind=[n for n, _ in wiring["bind"]],
         post_bind=[n for n, _ in wiring["postBind"]],
         plugin_args=args,
+        percentage_of_nodes_to_score=pct,
     )
 
 

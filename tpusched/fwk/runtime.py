@@ -60,6 +60,9 @@ class PluginProfile:
     bind: List[str] = field(default_factory=list)  # first Success/non-Skip wins
     post_bind: List[str] = field(default_factory=list)
     plugin_args: Dict[str, Any] = field(default_factory=dict)
+    # upstream percentageOfNodesToScore: 0 = adaptive (50 - nodes/125,
+    # floor 5%, only above 100 nodes); 100 = always scan every node
+    percentage_of_nodes_to_score: int = 0
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]

@@ -64,6 +64,12 @@ class Scheduler:
                 cluster_event_map[name] = [WILDCARD_EVENT]
         self.queue = SchedulingQueue(self._fw.less, cluster_event_map, clock)
 
+        # adaptive node sampling (upstream percentageOfNodesToScore):
+        # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
+        # start index spreads scan load across cycles
+        self.percentage_of_nodes_to_score = profile.percentage_of_nodes_to_score
+        self._next_start_node_index = 0
+
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
         # binding cycles deregister themselves on exit (O(1) vs scanning the
@@ -253,14 +259,23 @@ class Scheduler:
 
         feasible: List[Node] = []
         diagnosis: Dict[str, Status] = {}
-        for node_info in snapshot.list():
+        infos = snapshot.list()
+        want = self._num_feasible_nodes_to_find(len(infos))
+        start = self._next_start_node_index % len(infos)
+        visited = 0
+        for idx in range(len(infos)):
+            node_info = infos[(start + idx) % len(infos)]
+            visited += 1
             fs = self._fw.run_filter_plugins_with_nominated_pods(state, pod, node_info)
             if fs.is_success():
                 feasible.append(node_info.node)
+                if len(feasible) >= want:
+                    break
             elif fs.is_error():
                 return "", fs
             else:
                 diagnosis[node_info.node.name] = fs
+        self._next_start_node_index = (start + visited) % len(infos)
         state.write("tpusched/diagnosis", diagnosis)
 
         if not feasible:
@@ -278,6 +293,20 @@ class Scheduler:
             return "", s
         best = max(feasible, key=lambda n: (totals.get(n.name, 0), n.name))
         return best.name, Status.success()
+
+    def _num_feasible_nodes_to_find(self, num_all: int) -> int:
+        """Upstream numFeasibleNodesToFind (generic_scheduler.go): scan every
+        node on small clusters; above minFeasibleNodesToFind=100, sample an
+        adaptive percentage (50 - nodes/125, floor 5%) of the cluster."""
+        MIN_FEASIBLE = 100
+        if num_all < MIN_FEASIBLE:
+            return num_all
+        pct = self.percentage_of_nodes_to_score
+        if pct <= 0:
+            pct = max(5, 50 - num_all // 125)
+        if pct >= 100:
+            return num_all
+        return max(MIN_FEASIBLE, num_all * pct // 100)
 
     def _run_post_filter(self, state: CycleState, pod: Pod, status: Status) -> None:
         from ..fwk.status import UNSCHEDULABLE
