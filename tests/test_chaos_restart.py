@@ -1,0 +1,105 @@
+"""Failure recovery: scheduler death mid-gang and controller fail-over.
+
+The reference's failure model (SURVEY §5): no local checkpoint — a restarted
+scheduler reconstructs everything from the API server; gang members parked at
+the Permit barrier are process state and die with it, but unassigned pods are
+still Pending in the API, so the next scheduler re-admits the whole gang.
+Leader election covers the controller side
+(/root/reference/cmd/controller/app/server.go:84-123)."""
+from __future__ import annotations
+
+import time
+
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.controllers.runner import (LEASE_NAME, ControllerRunner,
+                                         ServerRunOptions)
+from tpusched.testing import TestCluster, make_pod, make_pod_group
+
+
+def test_scheduler_death_at_permit_barrier_gang_recovers():
+    """A gang with capacity for only half its members parks the schedulable
+    half at the Permit barrier (quorum unreachable); the scheduler dies; a
+    fresh scheduler against the same API server — with capacity restored —
+    admits the full gang. Proves the barrier is process state and the API
+    server is the only checkpoint."""
+    from tpusched.testing import make_tpu_node
+    api = srv.APIServer()
+    gang = 16  # 1 stuck member = 6.25% gap, inside the ≤10% quorum-gap
+    #            grace — PostFilter does NOT mass-reject, so the other 15
+    #            stay parked at the barrier (coscheduling.go:140-176)
+
+    # set the cluster up BEFORE the scheduling loop starts so the queue pops
+    # in creation order: the 15 schedulable members assume + park first, then
+    # the stuck one fails inside the grace window
+    c = TestCluster(profile=tpu_gang_profile(permit_wait_s=60), api=api)
+    c.add_nodes([make_tpu_node(f"n{i}", chips=4) for i in range(gang)])
+    c.api.create(srv.POD_GROUPS, make_pod_group("g", min_member=gang))
+    pods = [make_pod(f"w{i:02d}", pod_group="g", limits={TPU: 4},
+                     node_selector=({"flavor": "special"}
+                                    if i == gang - 1 else None))
+            for i in range(gang)]
+    c.create_pods(pods)
+    c.scheduler.run()
+    try:
+        # w15 can't land anywhere (no node carries the label); the other 15
+        # park at the Permit barrier (waitingPods map — in-process state)
+        deadline = time.time() + 10
+        waiting = []
+        while time.time() < deadline:
+            waiting = []
+            c.scheduler.framework.iterate_over_waiting_pods(
+                lambda wp: waiting.append(wp))
+            if len(waiting) == gang - 1:
+                break
+            time.sleep(0.02)
+        assert len(waiting) == gang - 1
+        assert all(not c.pod_scheduled(p.key) for p in pods)
+    finally:
+        c.stop()
+
+    # process death rejected the waiting pods; nothing was bound
+    assert all(not p.spec.node_name for p in api.list(srv.PODS))
+
+    # fresh scheduler, same control plane (etcd-as-truth); the missing
+    # capacity appears and the whole gang admits
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=60), api=api) as c2:
+        c2.add_nodes([make_tpu_node("n-special", chips=4)])
+        c2.api.patch(srv.NODES, "/n-special",
+                     lambda n: n.meta.labels.update({"flavor": "special"}))
+        keys = [f"default/w{i:02d}" for i in range(gang)]
+        assert c2.wait_for_pods_scheduled(keys, timeout=30)
+        hosts = {c2.pod(k).spec.node_name for k in keys}
+        assert len(hosts) == gang  # one host each, nothing double-placed
+
+
+def test_controller_failover_via_leader_election():
+    """Two controller runners with leader election: killing the leader hands
+    the lease to the standby, which resumes reconciling PodGroup phases."""
+    api = srv.APIServer()
+    opts = ServerRunOptions(enable_leader_election=True,
+                            lease_duration_s=0.5, renew_interval_s=0.1)
+    a = ControllerRunner(api, opts)
+    b = ControllerRunner(api, opts)
+    a.run()
+    assert a.is_leader.wait(timeout=5)
+    b.run()
+    time.sleep(0.3)
+    assert not b.is_leader.is_set()  # standby while the lease is held
+
+    a.stop()  # leader dies; lease expires; standby must take over
+    assert b.is_leader.wait(timeout=10)
+    assert api.lease_holder(LEASE_NAME) == b.identity
+
+    # the new leader's controllers actually reconcile: a PodGroup gets phased
+    api.create(srv.POD_GROUPS, make_pod_group("g", min_member=1))
+    deadline = time.time() + 10
+    phase = ""
+    while time.time() < deadline:
+        phase = api.get(srv.POD_GROUPS, "default/g").status.phase
+        if phase:
+            break
+        time.sleep(0.05)
+    assert phase != ""
+    b.stop()

@@ -82,10 +82,13 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
       - name: Coscheduling
         args: {permitWaitingTimeSeconds: 10}
     """
+    pct = int(raw.get("percentageOfNodesToScore", 0) or 0)
+    if not 0 <= pct <= 100:
+        raise ConfigError(
+            f"percentageOfNodesToScore must be 0-100, got {pct}")
     profile = PluginProfile(
         scheduler_name=raw.get("schedulerName", "tpusched"),
-        percentage_of_nodes_to_score=int(
-            raw.get("percentageOfNodesToScore", 0) or 0))
+        percentage_of_nodes_to_score=pct)
     plugins = raw.get("plugins", {}) or {}
 
     qs = plugins.get("queueSort", {}).get("enabled", [])
