@@ -146,3 +146,87 @@ def test_pdb_protected_victims_reprieved_last():
         c.api.create(srv.PDBS, pdb)
         b_pods = team_pods(c, "team-b", 2)
         assert c.wait_for_pods_scheduled([p.key for p in b_pods], timeout=20)
+
+
+def test_nominated_preemptor_counts_against_quota():
+    """PreFilter's nominated-pod accounting (capacity_scheduling.go:232-268):
+    a nominated-but-unbound preemptor already consumes quota headroom, so a
+    second pod whose admission would exceed max with the nominated pod
+    counted is rejected at PreFilter — deterministically, with the nominated
+    state fabricated (the e2e transient is racy by construction)."""
+    from tpusched.fwk import CycleState
+    from tpusched.testing.harness import new_test_framework
+
+    profile = capacity_profile()
+    nodes = [make_tpu_node(f"h{i}", chips=4) for i in range(4)]
+    fw, handle, api = new_test_framework(profile, nodes=nodes)
+    api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+        "quota-a", "team-a", min={TPU: 8}, max={TPU: 8}))
+
+    # a preemptor nominated onto h0 but not yet bound: 4 of team-a's 8 max
+    pree = make_pod("pree", namespace="team-a", limits={TPU: 4}, priority=100)
+    pree.status.nominated_node_name = "h0"
+    handle.pod_nominator.add_nominated_pod(pree, "h0")
+
+    # 4 more chips still fit under max=8...
+    ok = fw.run_pre_filter_plugins(
+        CycleState(), make_pod("fits", namespace="team-a", limits={TPU: 4}))
+    assert ok.is_success()
+    # ...but 8 more would exceed max once the nominated pod is counted
+    rejected = fw.run_pre_filter_plugins(
+        CycleState(), make_pod("late", namespace="team-a", limits={TPU: 8}))
+    assert rejected.is_unschedulable()
+    assert rejected.plugin == "CapacityScheduling"
+
+    # drop the nomination: the same pod now fits under max
+    handle.pod_nominator.delete_nominated_pod_if_exists(pree)
+    ok2 = fw.run_pre_filter_plugins(
+        CycleState(), make_pod("late2", namespace="team-a", limits={TPU: 8}))
+    assert ok2.is_success()
+
+
+def test_three_team_aggregate_min_gate():
+    """Σmin borrowing across >2 quotas (capacity_scheduling.go:242-255).
+    Physical capacity (32 chips) exceeds Σmin (24), so the aggregate gate —
+    not free chips — is what decides admission:
+    - within-own-min pods reclaim from borrowers (preemption);
+    - an over-own-min pod whose admission would push aggregate past Σmin
+      stays pending even with free chips on the floor."""
+    c = TestCluster(profile=capacity_profile())
+    with c:
+        c.add_nodes([make_tpu_node(f"h{i}", chips=4) for i in range(8)])  # 32
+        for team in ("t-a", "t-b", "t-c"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"q-{team}", team, min={TPU: 8}, max={TPU: 24}))
+        # t-a borrows far beyond its min while b and c are idle: 20 of Σ24
+        team_pods(c, "t-a", 5, chips=4)
+        assert c.wait_for_pods_scheduled([f"t-a/t-a-{i}" for i in range(5)])
+        team_pods(c, "t-b", 3, chips=4)
+        # b-0 admits outright (aggregate 24 ≤ Σmin); b-1 is within t-b's own
+        # min, so it may reclaim from the borrower t-a via preemption
+        assert c.wait_for_pods_scheduled(["t-b/t-b-0", "t-b/t-b-1"],
+                                         timeout=20)
+        # b-2 would take t-b over its own min AND aggregate past Σmin:
+        # pending forever despite free physical chips (32 - 28 = 4 free)
+        assert c.wait_for_pods_unscheduled(["t-b/t-b-2"], hold=1.0)
+        surviving_a = 0
+        for i in range(5):
+            p = c.pod(f"t-a/t-a-{i}")  # evicted victims are deleted
+            if p is not None and p.spec.node_name and not p.is_terminating():
+                surviving_a += 1
+        assert surviving_a == 4  # exactly one borrower reclaimed
+
+
+def test_eq_shrink_blocks_new_pods_keeps_running():
+    """Shrinking max below current used must not evict running pods, but
+    new pods in the namespace are rejected until usage drains."""
+    with two_team_cluster() as c:
+        team_pods(c, "team-a", 3, chips=4)  # 12 used, max 16
+        assert c.wait_for_pods_scheduled([f"team-a/team-a-{i}" for i in range(3)])
+        c.api.patch(srv.ELASTIC_QUOTAS, "team-a/quota-a",
+                    lambda eq: eq.spec.max.update({TPU: 8}))
+        team_pods(c, "team-a", 1, chips=4, prefix="extra")
+        assert c.wait_for_pods_unscheduled(["team-a/extra-0"])
+        # running pods untouched
+        assert all(c.pod(f"team-a/team-a-{i}").spec.node_name
+                   for i in range(3))
