@@ -97,3 +97,24 @@ def test_load_aware_scheduling_over_live_watcher():
             assert placed == {"cold"}
     finally:
         watcher.shutdown()
+
+
+def test_scheduler_emits_scheduled_and_failed_events():
+    """Upstream-parity Events: Scheduled on bind, FailedScheduling on an
+    unschedulable cycle (the kube-scheduler event surface kubectl shows)."""
+    from tpusched.api.resources import TPU
+    from tpusched.testing import make_tpu_node
+
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        c.create_pods([make_pod("ok", limits={TPU: 4}),
+                       make_pod("nofit", limits={TPU: 8})])
+        assert c.wait_for_pods_scheduled(["default/ok"])
+        assert c.wait_for_pods_unscheduled(["default/nofit"])
+        events = c.api.events()
+        by = {(e.object_key, e.reason) for e in events}
+        assert ("default/ok", "Scheduled") in by
+        assert ("default/nofit", "FailedScheduling") in by
+        failed = [e for e in events if e.reason == "FailedScheduling"]
+        assert all(e.type == "Warning" for e in failed)
+        assert any("Insufficient" in e.message for e in failed)

@@ -16,6 +16,7 @@ Semantics mirrored from the k8s API server as the reference uses it:
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
@@ -84,7 +85,11 @@ class APIServer:
         self._rv = 0
         self._stores: Dict[str, Dict[str, Any]] = {k: {} for k in ALL_KINDS}
         self._handlers: Dict[str, List[Callable[[WatchEvent], None]]] = {k: [] for k in ALL_KINDS}
-        self._events: List[Event] = []           # k8s Events (recorder sink)
+        # k8s Events (recorder sink). Bounded ring: real Events are TTL'd in
+        # etcd (1h default); an always-on control plane must not grow
+        # per-retry FailedScheduling records without bound.
+        self._events: "collections.deque[Event]" = collections.deque(
+            maxlen=10_000)
         self._stopped = False
         # Optional persistence sink (apiserver.persistence.Journal): called
         # under the store lock, before the watch event fires — the etcd

@@ -5,11 +5,12 @@ Three interchangeable implementations of causal multi-head attention over
 
 - :func:`naive_attention` — reference O(s²)-materialized einsum version;
   ground truth for the others and the fallback on odd shapes.
-- :func:`flash_attention` — a Pallas TPU kernel (online-softmax tiling, the
-  standard FlashAttention recurrence): never materializes the (s, s) score
-  matrix in HBM, streams K/V blocks through VMEM, accumulates in f32 scratch.
-  Backward pass is recompute-based (custom_vjp over the reference impl) — the
-  classic remat trade: burn FLOPs to avoid storing O(s²) activations.
+- :func:`flash_attention` — Pallas TPU kernels (online-softmax tiling, the
+  FlashAttention-2 recurrence): never materializes the (s, s) score matrix
+  in HBM, streams K/V blocks through VMEM, accumulates in f32 scratch. The
+  backward is also blockwise kernels (dK/dV sweep + dQ sweep) recomputing P
+  from q, k and the saved per-row logsumexp — O(s) residual memory in both
+  directions.
 - :func:`ring_attention` — sequence parallelism for long context: K/V chunks
   rotate around the ``sp`` mesh axis via ``lax.ppermute`` while each device
   keeps its Q chunk resident, with online-softmax accumulation across steps
@@ -59,8 +60,16 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, block_q: int, block_k: int, causal: bool,
+def _causal_mask(s, i, j, block_q, block_k):
+    q_idx = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_idx = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_idx >= k_idx, s, -jnp.inf)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float, block_q: int, block_k: int, causal: bool,
                   nk: int):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -83,11 +92,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_idx = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+            s = _causal_mask(s, i, j, block_q, block_k)
         m_prev = m_scr[:]
         l_prev = l_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -105,39 +110,55 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(j == nk - 1)
     def _final():
         l = l_scr[:]
-        o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp per row, the only forward residual the backward needs
+        lse_ref[0] = m_scr[:] + jnp.log(safe_l)
+
+
+def _flash_blocks(s: int, block_q: int, block_k: int):
+    return min(block_q, s), min(block_k, s)
+
+
+def _to_bh(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                   block_q: int, block_k: int,
-                   interpret: Optional[bool]) -> jax.Array:
+                   block_q: int, block_k: int, interpret: Optional[bool]):
+    """Returns (out 4-D, lse (b·h, s) f32). Caller guarantees divisibility."""
     b, s, h, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        return naive_attention(q, k, v, causal)
+    block_q, block_k = _flash_blocks(s, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     nq, nk = s // block_q, s // block_k
     scale = 1.0 / np.sqrt(d)
 
     # (b, s, h, d) → (b·h, s, d): one grid axis walks batch×heads
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
 
     kernel = functools.partial(_flash_kernel, scale=scale, block_q=block_q,
                                block_k=block_k, causal=causal, nk=nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
@@ -145,7 +166,147 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return _from_bh(out, b, h), lse
+
+
+# -- flash backward (FlashAttention-2): p recomputed from q,k + lse; O(s)
+#    residual memory instead of the O(s²) score matrix ------------------------
+
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                           block_q: int, block_k: int, causal: bool, nq: int):
+    j = pl.program_id(1)   # k-block (held fixed while i sweeps)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    reachable = (j * block_k < (i + 1) * block_q) if causal else True
+
+    @pl.when(reachable)
+    def _update():
+        q = q_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])                   # masked cells → 0
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[0]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                         dq_ref, dq_scr, *, scale: float, block_q: int,
+                         block_k: int, causal: bool, nk: int):
+    i = pl.program_id(1)   # q-block (held fixed while j sweeps)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    reachable = (j * block_k < (i + 1) * block_q) if causal else True
+
+    @pl.when(reachable)
+    def _update():
+        q = q_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[0]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    b, s, h, d = q.shape
+    block_q, block_k = _flash_blocks(s, block_q, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    nq, nk = s // block_q, s // block_k
+    scale = 1.0 / np.sqrt(d)
+
+    qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
+    dof = _to_bh(g)
+    outf = _to_bh(out)
+    # D_i = Σ_d dO ∘ O — cheap elementwise reduce, XLA fuses it
+    dd = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                 axis=-1, keepdims=True)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, a, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, a, b_: (bh, a, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, a, b_: (bh, b_, 0))
+    # dkdv sweeps q-blocks innermost: swap which grid axis feeds each spec
+    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, b_, 0))
+    row_spec_kv = pl.BlockSpec((1, block_q, 1), lambda bh, a, b_: (bh, b_, 0))
+    kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda bh, a, b_: (bh, a, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, scale=scale,
+                          block_q=block_q, block_k=block_k, causal=causal,
+                          nq=nq),
+        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec_kv, q_spec_kv, row_spec_kv, row_spec_kv,
+                  kv_spec_kv, kv_spec_kv],
+        out_specs=(kv_spec_kv, kv_spec_kv),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, dof, lse, dd, kf, vf)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, q_spec, row_spec, row_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, dof, lse, dd, kf, vf)
+
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+
+
+def _flash_supported(s: int, block_q: int, block_k: int) -> bool:
+    bq, bk = _flash_blocks(s, block_q, block_k)
+    return _HAVE_PALLAS and s % bq == 0 and s % bk == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -153,22 +314,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """FlashAttention forward on the MXU; O(s) HBM traffic for activations.
-    Backward recomputes through the reference implementation (remat)."""
-    if not _HAVE_PALLAS:
+    """FlashAttention on the MXU: O(s) HBM traffic for activations in both
+    directions — the backward recomputes P blockwise from q, k and the saved
+    logsumexp (FlashAttention-2) instead of materializing the score matrix."""
+    if not _flash_supported(q.shape[1], block_q, block_k):
         return naive_attention(q, k, v, causal)
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    if not _flash_supported(q.shape[1], block_q, block_k):
+        return naive_attention(q, k, v, causal), (q, k, v, None, None)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: naive_attention(q_, k_, v_, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:  # unsupported shape: recompute through the reference
+        _, vjp = jax.vjp(lambda q_, k_, v_: naive_attention(q_, k_, v_, causal),
+                         q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -9,6 +9,7 @@ upstream (vendored scheduler.go:425,557-604 in the reference tree).
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, List, Optional
@@ -279,9 +280,16 @@ class Scheduler:
         state.write("tpusched/diagnosis", diagnosis)
 
         if not feasible:
-            return "", Status.unschedulable(
-                f"0/{num_nodes} nodes are available").with_plugin(
-                    next(iter(diagnosis.values())).plugin if diagnosis else "")
+            # upstream-style aggregation: "0/N nodes are available:
+            # 3 Insufficient google.com/tpu, 1 node(s) had untolerated taint"
+            counts = collections.Counter(
+                r for st in diagnosis.values()
+                for r in (st.reasons or ["unknown"]))
+            detail = ", ".join(f"{n} {r}" for r, n in counts.most_common())
+            msg = (f"0/{num_nodes} nodes are available: {detail}"
+                   if detail else f"0/{num_nodes} nodes are available")
+            return "", Status.unschedulable(msg).with_plugin(
+                next(iter(diagnosis.values())).plugin if diagnosis else "")
         if len(feasible) == 1:
             return feasible[0].name, Status.success()
 
@@ -360,6 +368,9 @@ class Scheduler:
         self.cache.finish_binding(pod)
         bind_total.inc()
         e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        self.clientset.record_event(
+            pod.key, "Pod", "Normal", "Scheduled",
+            f"Successfully assigned {pod.key} to {node_name}")
         klog.V(4).info_s("bound", pod=pod.key, node=node_name)
         self._fw.run_post_bind_plugins(state, pod, node_name)
         self._activate_pods(pods_to_activate)
@@ -376,6 +387,9 @@ class Scheduler:
         info.pod = live
         self.queue.requeue_after_failure(
             info, to_backoff=bool(live.status.nominated_node_name))
+        self.clientset.record_event(
+            pod.key, "Pod", "Warning", "FailedScheduling",
+            status.message() or "unschedulable")
         klog.V(5).info_s("pod unschedulable", pod=pod.key,
                          reason=status.message(), plugin=status.plugin)
 
