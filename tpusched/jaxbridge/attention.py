@@ -343,6 +343,16 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """GQA → MHA expansion: (b, s, n_kv, d) → (b, s, n_kv·n_rep, d). Each KV
+    head serves n_rep query heads (Llama-3 style grouped-query attention)."""
+    if n_rep == 1:
+        return x
+    b, s, n_kv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :],
+                            (b, s, n_kv, n_rep, d)).reshape(b, s, n_kv * n_rep, d)
+
+
 # -- ring attention (sequence parallelism over the sp mesh axis) --------------
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,

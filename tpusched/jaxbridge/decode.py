@@ -1,0 +1,109 @@
+"""KV-cache inference for the flagship workload: prefill + single-token decode.
+
+The serving-side counterpart of ``workload.forward``: static-shape caches
+(one (b, max_seq, kv_heads, head_dim) K and V per layer — GQA-sized, the
+point of grouped-query attention is exactly this cache being
+n_heads/kv_heads× smaller), `lax.dynamic_update_slice` writes, and
+position-masked attention so the whole decode step jits with no
+data-dependent shapes. The reference schedules such serving pods but carries
+no model code; this is the TPU-native workload the scheduler places.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import attention
+from .workload import ModelConfig, Params, _qkv, _rmsnorm
+
+KVCache = List[Dict[str, jax.Array]]
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch, max_seq, cfg.kv_heads, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _cached_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                      pos, n_rep: int) -> jax.Array:
+    """q (b, s_q, h, hd) against the GQA cache up to ``pos + s_q - 1``;
+    positions beyond are masked, keeping shapes static under jit. The group
+    axis is folded into the einsum — the kv_heads-sized cache is never
+    expanded to n_heads, which is the GQA bandwidth win."""
+    b, s_q, h, hd = q.shape
+    kv = ck.shape[2]
+    qg = q.reshape(b, s_q, kv, n_rep, hd)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck) / np.sqrt(hd)
+    max_seq = ck.shape[1]
+    q_pos = pos + jnp.arange(s_q)[:, None]           # absolute query positions
+    k_pos = jnp.arange(max_seq)[None, :]
+    logits = jnp.where((k_pos <= q_pos)[None, None, None], logits,
+                       attention.NEG_INF)
+    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bgrqk,bkgd->bqgrd", attn, cv).reshape(b, s_q, h, hd)
+
+
+def _layer_step(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
+                cfg: ModelConfig):
+    """One decoder layer over ``x`` (b, s_q, d) with cache write at ``pos``."""
+    b, s_q, d = x.shape
+    h = _rmsnorm(x, layer["ln_attn"])
+    q, k, v = _qkv(h, layer, cfg, pos_offset=pos)
+    ck = jax.lax.dynamic_update_slice(c["k"], k, (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(c["v"], v, (0, pos, 0, 0))
+    n_rep = cfg.n_heads // cfg.kv_heads
+    o = _cached_attention(q, ck, cv, pos, n_rep).reshape(b, s_q, d)
+    x = x + o @ layer["wo"]
+    h = _rmsnorm(x, layer["ln_mlp"])
+    mlp = (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer["w_down"]
+    return x + mlp, {"k": ck, "v": cv}
+
+
+def prefill(params: Params, cache: KVCache, tokens: jax.Array,
+            cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt through the model, filling the cache from position 0.
+    Returns (logits (b, s, vocab), cache)."""
+    x = params["embed"][tokens]
+    new_cache: KVCache = []
+    for layer, c in zip(params["layers"], cache):
+        x, c2 = _layer_step(x, layer, c, 0, cfg)
+        new_cache.append(c2)
+    x = _rmsnorm(x, params["ln_f"])
+    return x @ params["out"], new_cache
+
+
+def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
+                cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
+    """One token per sequence: tokens_t (b,) at absolute position ``pos``
+    (scalar, traceable). Returns (logits (b, vocab), updated cache)."""
+    x = params["embed"][tokens_t][:, None, :]
+    new_cache: KVCache = []
+    for layer, c in zip(params["layers"], cache):
+        x, c2 = _layer_step(x, layer, c, pos, cfg)
+        new_cache.append(c2)
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["out"])[:, 0], new_cache
+
+
+def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
+             steps: int) -> jax.Array:
+    """Greedy generation: prefill the prompt, then ``steps`` decode steps via
+    lax.scan (static trip count; the cache threads through the scan carry)."""
+    b, s0 = prompt.shape
+    cache = init_kv_cache(cfg, b, s0 + steps)
+    logits, cache = prefill(params, cache, prompt, cfg)
+    first = jnp.argmax(logits[:, s0 - 1], axis=-1)
+
+    def step(carry, t):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, s0 + t, cfg)
+        nxt = jnp.argmax(logits, axis=-1)
+        return (nxt, cache), tok
+
+    (last, _), toks = jax.lax.scan(step, (first, cache), jnp.arange(steps))
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)  # (b, steps+1)

@@ -34,6 +34,22 @@ class ModelConfig:
     # online-softmax kernel), or "ring" (sp-axis sequence parallelism;
     # requires an sp mesh axis — falls back to naive+GSPMD without one)
     attn: str = "naive"
+    # grouped-query attention: number of KV heads (0 ⇒ n_heads, plain MHA).
+    # Llama-3 style: each KV head serves n_heads/n_kv_heads query heads.
+    n_kv_heads: int = 0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    def __post_init__(self):
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_kv_heads ({self.kv_heads}) must divide n_heads "
+                f"({self.n_heads}) — each KV head serves an equal group")
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must divide d_model ({self.d_model})")
 
     @staticmethod
     def tiny() -> "ModelConfig":
@@ -42,9 +58,11 @@ class ModelConfig:
 
     @staticmethod
     def llama_like(seq: int = 2048) -> "ModelConfig":
-        """Scaled-down Llama-3-ish proportions for single-chip benching."""
+        """Scaled-down Llama-3-ish proportions for single-chip benching
+        (incl. 4:1 grouped-query attention)."""
         return ModelConfig(vocab=32000, d_model=1024, n_layers=8, n_heads=8,
-                           d_ff=2816, seq=seq, dtype=jnp.bfloat16)
+                           d_ff=2816, seq=seq, dtype=jnp.bfloat16,
+                           n_kv_heads=2)
 
 
 Params = Dict[str, Any]
@@ -53,6 +71,7 @@ Params = Dict[str, Any]
 def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
     k_embed, k_out, *k_layers = jax.random.split(key, 2 + cfg.n_layers)
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
+    d_kv = (d // cfg.n_heads) * cfg.kv_heads   # GQA: fewer KV projections
 
     def dense(k, shape):
         return (jax.random.normal(k, shape) / np.sqrt(shape[0])).astype(cfg.dtype)
@@ -61,8 +80,8 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
     for kl in k_layers:
         ks = jax.random.split(kl, 7)
         layers.append({
-            "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d)),
-            "wv": dense(ks[2], (d, d)), "wo": dense(ks[3], (d, d)),
+            "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d_kv)),
+            "wv": dense(ks[2], (d, d_kv)), "wo": dense(ks[3], (d, d)),
             "w_gate": dense(ks[4], (d, f)), "w_up": dense(ks[5], (d, f)),
             "w_down": dense(ks[6], (f, d)),
             "ln_attn": jnp.ones((d,), cfg.dtype),
@@ -81,11 +100,12 @@ def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * w
 
 
-def _rotary(x: jax.Array) -> jax.Array:
-    """Rotary position embedding over the head dim (pairs)."""
+def _rotary(x: jax.Array, pos_offset=0) -> jax.Array:
+    """Rotary position embedding over the head dim (pairs). ``pos_offset``
+    shifts absolute positions (KV-cache decode at position t)."""
     b, s, h, hd = x.shape
     half = hd // 2
-    pos = jnp.arange(s)[:, None]
+    pos = pos_offset + jnp.arange(s)[:, None]
     inv_freq = 1.0 / (10000 ** (jnp.arange(half) / half))
     ang = (pos * inv_freq)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -93,14 +113,25 @@ def _rotary(x: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _block(x: jax.Array, p: Dict[str, jax.Array], n_heads: int,
+def _qkv(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
+         pos_offset: int = 0):
+    """Projections + rotary. K/V carry cfg.kv_heads heads (GQA)."""
+    b, s, _ = h.shape
+    hd = cfg.d_model // cfg.n_heads
+    q = _rotary((h @ p["wq"]).reshape(b, s, cfg.n_heads, hd), pos_offset)
+    k = _rotary((h @ p["wk"]).reshape(b, s, cfg.kv_heads, hd), pos_offset)
+    v = (h @ p["wv"]).reshape(b, s, cfg.kv_heads, hd)
+    return q, k, v
+
+
+def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
            attn_fn=None) -> jax.Array:
     b, s, d = x.shape
-    hd = d // n_heads
     h = _rmsnorm(x, p["ln_attn"])
-    q = _rotary((h @ p["wq"]).reshape(b, s, n_heads, hd))
-    k = _rotary((h @ p["wk"]).reshape(b, s, n_heads, hd))
-    v = (h @ p["wv"]).reshape(b, s, n_heads, hd)
+    q, k, v = _qkv(h, p, cfg)
+    n_rep = cfg.n_heads // cfg.kv_heads
+    k = attention.repeat_kv(k, n_rep)
+    v = attention.repeat_kv(v, n_rep)
     if attn_fn is None:
         attn_fn = attention.naive_attention
     o = attn_fn(q, k, v).reshape(b, s, d) @ p["wo"]
@@ -129,7 +160,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         # make_sharded_train_step)
         x = jax.lax.with_sharding_constraint(x, act_spec)
     for layer in params["layers"]:
-        x = _block(x, layer, cfg.n_heads, attn_fn)
+        x = _block(x, layer, cfg, attn_fn)
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
     x = _rmsnorm(x, params["ln_f"])
