@@ -131,3 +131,55 @@ def test_flash_gradients_noncausal_and_rect_blocks(causal):
     gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gn):
         np.testing.assert_allclose(a, b, atol=3e-4, rtol=3e-4)
+
+
+def test_gqa_naive_matches_repeat_kv():
+    q, _, _ = _qkv(jax.random.PRNGKey(10), h=4)
+    kq = jax.random.PRNGKey(11)
+    k = jax.random.normal(kq, (2, 256, 2, 64))
+    v = jax.random.normal(jax.random.PRNGKey(12), (2, 256, 2, 64))
+    grouped = attention.naive_attention(q, k, v, True)
+    expanded = attention.naive_attention(q, attention.repeat_kv(k, 2),
+                                         attention.repeat_kv(v, 2), True)
+    np.testing.assert_allclose(grouped, expanded, atol=1e-6, rtol=1e-6)
+
+
+def test_gqa_ring_matches_naive_without_expansion():
+    """Ring attention with kv_heads < n_heads: the ring carries the small
+    tensors; result matches the grouped reference."""
+    mesh = build_named_mesh({"sp": 4})
+    q, _, _ = _qkv(jax.random.PRNGKey(13), s=64, h=4)
+    k = jax.random.normal(jax.random.PRNGKey(14), (2, 64, 2, 64))
+    v = jax.random.normal(jax.random.PRNGKey(15), (2, 64, 2, 64))
+    ring = jax.jit(attention.make_ring_attention(mesh))
+    np.testing.assert_allclose(ring(q, k, v),
+                               attention.naive_attention(q, k, v, True),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_flash_gradients_reduce_over_group():
+    q, _, _ = _qkv(jax.random.PRNGKey(16), s=128, h=4)
+    k = jax.random.normal(jax.random.PRNGKey(17), (2, 128, 2, 64))
+    v = jax.random.normal(jax.random.PRNGKey(18), (2, 128, 2, 64))
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        attention.flash_attention_gqa(q, k, v, True, 64, 64) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(lambda q, k, v: jnp.sum(
+        attention.naive_attention(q, k, v, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gn):
+        assert a.shape == b.shape  # dk/dv keep the kv_heads shape
+        np.testing.assert_allclose(a, b, atol=3e-4, rtol=3e-4)
+
+
+def test_flash_head_mismatch_fails_loudly():
+    q, _, _ = _qkv(jax.random.PRNGKey(19), s=128, h=4)
+    k = jax.random.normal(jax.random.PRNGKey(20), (2, 128, 3, 64))
+    v = jax.random.normal(jax.random.PRNGKey(21), (2, 128, 3, 64))
+    with pytest.raises(ValueError, match="divide"):
+        attention.flash_attention_gqa(q, k, v)
+    k2 = jax.random.normal(jax.random.PRNGKey(22), (2, 128, 2, 64))
+    v2 = jax.random.normal(jax.random.PRNGKey(23), (2, 128, 2, 64))
+    with pytest.raises(ValueError, match="equal head counts"):
+        attention.flash_attention(q, k2, v2)

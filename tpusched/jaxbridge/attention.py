@@ -39,14 +39,22 @@ NEG_INF = float(np.finfo(np.float32).min)
 
 def naive_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True) -> jax.Array:
-    """Materialized softmax(QKᵀ/√d)V. Shapes: (b, s, h, d) → (b, s, h, d)."""
-    d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    """Materialized softmax(QKᵀ/√d)V. Shapes: (b, s, h, d) → (b, s, h, d).
+    GQA-aware: k/v may carry h/n_rep heads — the group axis is folded into
+    the einsum, never materialized to h heads."""
+    b, s_q, h, d = q.shape
+    kv = k.shape[2]
+    if kv != h:
+        qg = q.reshape(b, s_q, kv, h // kv, d)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k) / np.sqrt(d)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
     if causal:
-        s_q, s_k = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        mask = jnp.tril(jnp.ones((s_q, k.shape[1]), bool))
         logits = jnp.where(mask, logits, NEG_INF)
     attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if kv != h:
+        return jnp.einsum("bgrqk,bkgd->bqgrd", attn, v).reshape(b, s_q, h, d)
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
 
@@ -134,6 +142,13 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    block_q: int, block_k: int, interpret: Optional[bool]):
     """Returns (out 4-D, lse (b·h, s) f32). Caller guarantees divisibility."""
     b, s, h, d = q.shape
+    if k.shape[2] != h or v.shape[2] != h:
+        # the kernels are MHA: a head-count mismatch here would launch a
+        # q-sized grid over smaller K/V buffers and clamp out of range —
+        # silently wrong output. GQA callers go through flash_attention_gqa.
+        raise ValueError(
+            f"flash kernels need equal head counts (q {h}, k {k.shape[2]}, "
+            f"v {v.shape[2]}); use flash_attention_gqa for grouped KV")
     block_q, block_k = _flash_blocks(s, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -343,6 +358,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_attention_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """GQA front-end for the flash kernels: expands K/V to n_heads OUTSIDE
+    the custom_vjp (so dK/dV reduce back over the group via the broadcast's
+    transpose). The kernels themselves stay MHA; a grouped kernel that skips
+    the expansion is a further HBM optimization."""
+    h, kv = q.shape[2], k.shape[2]
+    if h % kv:
+        raise ValueError(
+            f"kv heads ({kv}) must divide q heads ({h}) for GQA")
+    n_rep = h // kv
+    return flash_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                           causal, block_q, block_k, interpret)
+
+
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """GQA → MHA expansion: (b, s, n_kv, d) → (b, s, n_kv·n_rep, d). Each KV
     head serves n_rep query heads (Llama-3 style grouped-query attention)."""
@@ -366,10 +398,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     chunk currently held, then forwards K/V to the next ring neighbor
     (``ppermute`` → one ICI hop). Online-softmax accumulation makes the
     result exact; causality masks whole future chunks to zero contribution.
+
+    GQA-aware: k/v may carry h/n_rep heads. The group axis is folded into
+    the einsums, so the tensors riding the ring stay kv_heads-sized — each
+    ICI hop moves n_rep× fewer bytes than expanding first would.
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
+    kv = k.shape[2]
+    n_rep = h // kv
     scale = 1.0 / np.sqrt(d)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -377,39 +415,40 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # through the scan never sees inf-inf NaNs. Step t=0 attends the resident
     # (diagonal) chunk, where each row has ≥1 unmasked entry — the running
     # max is finite from the first step on.
-    q32 = q.astype(jnp.float32)
+    q32 = q.astype(jnp.float32).reshape(b, s_loc, kv, n_rep, d)
     # fresh accumulators are device-invariant constants; mark them varying
     # over the manual sp axis so the scan carry types line up (JAX VMA rules)
     def vary(x):
         return jax.lax.pcast(x, (axis_name,), to="varying")
-    m0 = vary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32))
-    l0 = vary(jnp.zeros((b, h, s_loc, 1), jnp.float32))
-    acc0 = vary(jnp.zeros((b, s_loc, h, d), jnp.float32))
+    m0 = vary(jnp.full((b, kv, n_rep, s_loc, 1), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((b, kv, n_rep, s_loc, 1), jnp.float32))
+    acc0 = vary(jnp.zeros((b, s_loc, kv, n_rep, d), jnp.float32))
 
     def step(carry, t):
         m_prev, l_prev, acc, k_cur, v_cur = carry
         src = (my - t) % n                     # global chunk we now hold
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32,
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", q32,
                        k_cur.astype(jnp.float32)) * scale
         if causal:
             q_pos = my * s_loc + jnp.arange(s_loc)[:, None]
             k_pos = src * s_loc + jnp.arange(s_loc)[None, :]
-            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+            s = jnp.where((q_pos >= k_pos)[None, None, None], s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                 # masked: exp(NEG_INF-m) == 0
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv
-        # one ICI hop: hand K/V to the next device, receive from previous
+        pv = jnp.einsum("bgrqk,bkgd->bqgrd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * alpha.transpose(0, 3, 1, 2, 4) + pv
+        # one ICI hop: hand K/V to the next device, receive from previous —
+        # kv_heads-sized, never group-expanded
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return (m_new, l_new, acc_new, k_next, v_next), ()
 
     (m, l, acc, _, _), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(n))
-    l_t = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)
-    return (acc / l_t).astype(q.dtype)
+    l_t = jnp.where(l == 0.0, 1.0, l).transpose(0, 3, 1, 2, 4)
+    return (acc / l_t).reshape(b, s_loc, h, d).astype(q.dtype)
 
 
 def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,

@@ -1,0 +1,74 @@
+"""Workload checkpoint/resume: orbax-backed sharded train-state snapshots.
+
+Two halves of "checkpoint/resume" exist in this framework:
+- the scheduler side (apiserver/persistence.py, kep/300): the control plane
+  journals itself, and schedulers rebuild occupancy from annotations;
+- this module, the WORKLOAD side: the gang-placed JAX job periodically
+  saves its sharded train state (params + step) with orbax and, after a
+  reschedule — possibly onto a different slice with a different mesh —
+  restores it with each shard loaded directly to its new device placement
+  (no host-gather of the full state).
+
+The reference has no workload state at all (it schedules opaque pods); this
+is the TPU-native capability its users need when a gang is preempted and
+re-placed (ElasticQuota reclaim, kep/9) or a slice fails.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from .workload import Params
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save(directory: str, params: Params, step: int) -> None:
+    """Blocking save of the sharded train state. ``directory`` must not
+    already contain a checkpoint for this step."""
+    import orbax.checkpoint as ocp
+    path = os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(path, {"params": params, "step": step})
+
+
+def latest_step(directory: str) -> Optional[int]:
+    try:
+        steps = [int(n[len("step_"):]) for n in os.listdir(directory)
+                 if n.startswith("step_")]
+    except FileNotFoundError:
+        return None
+    return max(steps) if steps else None
+
+
+def restore(directory: str, abstract_params: Params,
+            step: Optional[int] = None) -> Tuple[Params, int]:
+    """Restore (params, step), each leaf materialized with the sharding given
+    by ``abstract_params`` (a pytree of jax.ShapeDtypeStruct carrying
+    NamedSharding) — shards land directly on their devices, so a state saved
+    on one slice restores onto a different mesh without a host round-trip."""
+    import orbax.checkpoint as ocp
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(
+            path, args=ocp.args.StandardRestore(
+                {"params": abstract_params, "step": step}))
+    return restored["params"], restored["step"]
+
+
+def abstract_state(params: Params, shardings) -> Params:
+    """Shape/dtype/sharding skeleton for restore(): the concrete params'
+    structure with each leaf replaced by a ShapeDtypeStruct carrying the
+    TARGET sharding (usually from make_sharded_train_step on the new mesh)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params, shardings)

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import attention
-from .workload import ModelConfig, Params, _qkv, _rmsnorm
+from .workload import (ModelConfig, Params, _finish_block, _qkv, _rmsnorm,
+                       _resolve_attn_fn)
 
 KVCache = List[Dict[str, jax.Array]]
 
@@ -48,20 +49,29 @@ def _cached_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
     return jnp.einsum("bgrqk,bkgd->bqgrd", attn, cv).reshape(b, s_q, h, hd)
 
 
-def _layer_step(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
-                cfg: ModelConfig):
-    """One decoder layer over ``x`` (b, s_q, d) with cache write at ``pos``."""
-    b, s_q, d = x.shape
+def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
+                  cfg: ModelConfig):
+    """One decoder layer over ``x`` (b, s_q, d) attending the cache, with the
+    cache write at ``pos``. The block tail is workload._finish_block — shared
+    with the training forward so the two can never desynchronize."""
     h = _rmsnorm(x, layer["ln_attn"])
     q, k, v = _qkv(h, layer, cfg, pos_offset=pos)
     ck = jax.lax.dynamic_update_slice(c["k"], k, (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(c["v"], v, (0, pos, 0, 0))
-    n_rep = cfg.n_heads // cfg.kv_heads
-    o = _cached_attention(q, ck, cv, pos, n_rep).reshape(b, s_q, d)
-    x = x + o @ layer["wo"]
-    h = _rmsnorm(x, layer["ln_mlp"])
-    mlp = (jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])) @ layer["w_down"]
-    return x + mlp, {"k": ck, "v": cv}
+    o = _cached_attention(q, ck, cv, pos, cfg.n_heads // cfg.kv_heads)
+    return _finish_block(x, layer, o), {"k": ck, "v": cv}
+
+
+def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
+                   cfg: ModelConfig, attn_fn):
+    """Prefill layer: attention over the prompt runs through the CONFIGURED
+    impl (flash when cfg.attn == 'flash' — O(s) HBM, not the materialized
+    cache matrix) while K/V are recorded into the cache at position 0."""
+    h = _rmsnorm(x, layer["ln_attn"])
+    q, k, v = _qkv(h, layer, cfg)
+    ck = jax.lax.dynamic_update_slice(c["k"], k, (0, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(c["v"], v, (0, 0, 0, 0))
+    return _finish_block(x, layer, attn_fn(q, k, v)), {"k": ck, "v": cv}
 
 
 def prefill(params: Params, cache: KVCache, tokens: jax.Array,
@@ -69,9 +79,10 @@ def prefill(params: Params, cache: KVCache, tokens: jax.Array,
     """Run the prompt through the model, filling the cache from position 0.
     Returns (logits (b, s, vocab), cache)."""
     x = params["embed"][tokens]
+    attn_fn = _resolve_attn_fn(cfg)
     new_cache: KVCache = []
     for layer, c in zip(params["layers"], cache):
-        x, c2 = _layer_step(x, layer, c, 0, cfg)
+        x, c2 = _layer_prefill(x, layer, c, cfg, attn_fn)
         new_cache.append(c2)
     x = _rmsnorm(x, params["ln_f"])
     return x @ params["out"], new_cache
@@ -84,7 +95,7 @@ def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
     x = params["embed"][tokens_t][:, None, :]
     new_cache: KVCache = []
     for layer, c in zip(params["layers"], cache):
-        x, c2 = _layer_step(x, layer, c, pos, cfg)
+        x, c2 = _layer_decode(x, layer, c, pos, cfg)
         new_cache.append(c2)
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["out"])[:, 0], new_cache

@@ -124,28 +124,34 @@ def _qkv(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     return q, k, v
 
 
-def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
-           attn_fn=None) -> jax.Array:
+def _finish_block(x: jax.Array, p: Dict[str, jax.Array],
+                  o: jax.Array) -> jax.Array:
+    """Residual + SwiGLU MLP tail shared by the training forward and the
+    KV-cache decode path (jaxbridge/decode.py) — one definition so the two
+    can never desynchronize."""
     b, s, d = x.shape
-    h = _rmsnorm(x, p["ln_attn"])
-    q, k, v = _qkv(h, p, cfg)
-    n_rep = cfg.n_heads // cfg.kv_heads
-    k = attention.repeat_kv(k, n_rep)
-    v = attention.repeat_kv(v, n_rep)
-    if attn_fn is None:
-        attn_fn = attention.naive_attention
-    o = attn_fn(q, k, v).reshape(b, s, d) @ p["wo"]
-    x = x + o
+    x = x + o.reshape(b, s, d) @ p["wo"]
     h = _rmsnorm(x, p["ln_mlp"])
     mlp = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
     return x + mlp
+
+
+def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
+           attn_fn=None) -> jax.Array:
+    h = _rmsnorm(x, p["ln_attn"])
+    # k/v stay kv_heads-sized: every impl folds the GQA group axis itself
+    # (flash expands at its custom_vjp boundary, see flash_attention_gqa)
+    q, k, v = _qkv(h, p, cfg)
+    if attn_fn is None:
+        attn_fn = attention.naive_attention
+    return _finish_block(x, p, attn_fn(q, k, v))
 
 
 def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
     if attn_fn is not None:
         return attn_fn
     if cfg.attn == "flash":
-        return attention.flash_attention
+        return attention.flash_attention_gqa
     return attention.naive_attention
 
 
