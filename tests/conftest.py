@@ -19,3 +19,14 @@ except ImportError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _close_harness_frameworks():
+    """Release background plugin resources (collector refresh threads etc.)
+    of frameworks built via tpusched.testing.harness after every test."""
+    yield
+    from tpusched.testing import harness
+    harness.close_all()

@@ -129,3 +129,41 @@ def test_empty_and_infeasible():
     pset = engine.enumerate_placement_masks(mgrid, (8, 8, 8))
     assert len(pset) == 0
     assert engine.feasible_membership(pset, 0, 0, 0) == (0, {})
+
+
+def test_fuzz_native_vs_python_vs_reference():
+    """Seeded fuzz over random generations, grid dims, wrap patterns, slice
+    shapes, and occupancies: all three implementations must agree exactly."""
+    if not native.available():
+        pytest.skip("native engine unavailable (no toolchain)")
+    from tpusched.api.topology import V4, V6E
+    rng = random.Random(0xC0FFEE)
+    accs = [V4, V5E, V5P, V6E]
+    for trial in range(40):
+        acc = rng.choice(accs)
+        ext = HOST_EXTENT[acc.name]
+        dims = tuple(e * rng.randint(1, 3) for e in ext)
+        wrap = tuple(rng.random() < 0.5 for _ in ext)
+        # shape: random per-axis chip extents (may be rotated/infeasible)
+        shape = tuple(rng.choice([1, 2, 4, e, d])
+                      for e, d in zip(ext, dims))
+        grid = make_grid(acc, dims, wrap)
+        ref = enumerate_placements(grid, shape)
+
+        mgrid = engine.MaskGrid(grid)
+        pset_native = engine.enumerate_placement_masks(mgrid, shape)
+        assert {mgrid.coords_of(m) for m in pset_native.masks} == set(ref), \
+            (acc.name, dims, wrap, shape)
+
+        hosts = list(grid.node_of)
+        for _ in range(5):
+            assigned = frozenset(
+                rng.sample(hosts, rng.randint(0, min(2, len(hosts)))))
+            free = frozenset(h for h in hosts
+                             if h not in assigned and rng.random() < 0.7)
+            eligible = assigned | free
+            want = reference_membership(ref, grid, assigned, free, eligible)
+            got = engine.feasible_membership(
+                pset_native, mgrid.mask_of(assigned), mgrid.mask_of(free),
+                mgrid.mask_of(eligible))
+            assert got == want, (acc.name, dims, wrap, shape)

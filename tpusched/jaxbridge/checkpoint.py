@@ -28,13 +28,19 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save(directory: str, params: Params, step: int) -> None:
-    """Blocking save of the sharded train state. ``directory`` must not
-    already contain a checkpoint for this step."""
+def save(directory: str, params: Params, step: int,
+         extra: Any = None) -> None:
+    """Blocking save of the sharded train state. ``extra`` carries any
+    additional sharded pytree — typically the optax optimizer state, whose
+    moments are as large as the params and just as sharded. ``directory``
+    must not already contain a checkpoint for this step."""
     import orbax.checkpoint as ocp
     path = os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+    state: Dict[str, Any] = {"params": params, "step": step}
+    if extra is not None:
+        state["extra"] = extra
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        ckptr.save(path, {"params": params, "step": step})
+        ckptr.save(path, state)
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -47,21 +53,29 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def restore(directory: str, abstract_params: Params,
-            step: Optional[int] = None) -> Tuple[Params, int]:
-    """Restore (params, step), each leaf materialized with the sharding given
-    by ``abstract_params`` (a pytree of jax.ShapeDtypeStruct carrying
+            step: Optional[int] = None,
+            abstract_extra: Any = None):
+    """Restore the train state, each leaf materialized with the sharding
+    given by the abstract pytrees (jax.ShapeDtypeStruct carrying
     NamedSharding) — shards land directly on their devices, so a state saved
-    on one slice restores onto a different mesh without a host round-trip."""
+    on one slice restores onto a different mesh without a host round-trip.
+
+    Returns (params, step) — or (params, step, extra) when
+    ``abstract_extra`` is given (e.g. the optimizer-state skeleton from
+    ``abstract_state(init_opt(params), ...)``)."""
     import orbax.checkpoint as ocp
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
     path = os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+    target: Dict[str, Any] = {"params": abstract_params, "step": step}
+    if abstract_extra is not None:
+        target["extra"] = abstract_extra
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        restored = ckptr.restore(
-            path, args=ocp.args.StandardRestore(
-                {"params": abstract_params, "step": step}))
+        restored = ckptr.restore(path, args=ocp.args.StandardRestore(target))
+    if abstract_extra is not None:
+        return restored["params"], restored["step"], restored["extra"]
     return restored["params"], restored["step"]
 
 
@@ -72,3 +86,13 @@ def abstract_state(params: Params, shardings) -> Params:
     return jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         params, shardings)
+
+
+def abstract_like(tree: Any) -> Any:
+    """Skeleton of an already-sharded concrete pytree: each leaf becomes a
+    ShapeDtypeStruct carrying that leaf's OWN sharding. Use for optimizer
+    state: init it on the new mesh (shardings inherited from params), then
+    restore the saved moments into that skeleton."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        tree)

@@ -264,3 +264,84 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
         out_shardings=(param_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,))
     return step, param_shardings, token_sharding
+
+
+def make_optax_train_step(mesh: Mesh, cfg: ModelConfig, tx):
+    """Sharded train step for an arbitrary optax transform (e.g. adamw).
+
+    Optimizer-state sharding is derived, ZeRO-style: per-parameter moments
+    (adam mu/nu) mirror the params subtree, so their shardings are resolved
+    by matching each opt-state leaf's tree path suffix against the param
+    tree (wq's mu shards exactly like wq, fsdp×tp); leaves with no param
+    counterpart (step counts) replicate. ``tx.init``'s zeros don't depend on
+    input values, so sharding must be pinned via out_shardings — inference
+    alone would leave them on one device.
+
+    Returns (step, init_opt, param_shardings, token_sharding) where
+    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+    """
+    import optax
+
+    pspecs = param_specs(cfg, mesh)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    b_axes = batch_axes(mesh)
+    batch_spec = b_axes if b_axes else None
+    token_sharding = NamedSharding(mesh, P(batch_spec, None))
+    act_spec = None
+    attn_fn = None
+    if "sp" in mesh.axis_names:
+        act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
+        if cfg.attn == "ring":
+            attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
+    if attn_fn is None:
+        attn_fn = _resolve_attn_fn(cfg)
+
+    def _step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, act_spec=act_spec, attn_fn=attn_fn)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    opt_shardings = _opt_state_shardings(mesh, cfg, tx, param_shardings)
+    step = jax.jit(
+        _step,
+        in_shardings=(param_shardings, opt_shardings, token_sharding),
+        out_shardings=(param_shardings, opt_shardings,
+                       NamedSharding(mesh, P())),
+        donate_argnums=(0, 1))
+    init_opt = jax.jit(tx.init, in_shardings=(param_shardings,),
+                       out_shardings=opt_shardings)
+    return step, init_opt, param_shardings, token_sharding
+
+
+def _opt_state_shardings(mesh: Mesh, cfg: ModelConfig, tx, param_shardings):
+    """Sharding tree for tx.init's state: each leaf whose tree-path suffix
+    matches a parameter path inherits that parameter's sharding; the rest
+    (scalar counts) replicate."""
+    from jax.tree_util import (tree_flatten_with_path, tree_map_with_path)
+
+    def key_str(k) -> str:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                return str(getattr(k, attr))
+        return str(k)
+
+    flat, _ = tree_flatten_with_path(param_shardings)
+    by_path = {tuple(key_str(k) for k in path): shard for path, shard in flat}
+
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    opt_shape = jax.eval_shape(tx.init, abstract_params)
+
+    def spec_for(path, leaf):
+        t = tuple(key_str(k) for k in path)
+        for i in range(len(t)):
+            got = by_path.get(t[i:])
+            if got is not None:
+                return got
+        return NamedSharding(mesh, P())
+
+    return tree_map_with_path(spec_for, opt_shape)

@@ -42,4 +42,17 @@ def new_test_framework(profile: PluginProfile,
     fw = Framework(registry or default_registry(), profile, handle)
     fw_holder.append(fw)
     handle.set_snapshot(Snapshot(nodes=list(nodes), pods=list(pods)))
+    _open_frameworks.append(fw)
     return fw, handle, api
+
+
+# Frameworks built by the harness own background plugin resources (trimaran
+# collector threads etc.); tests close them via close_all() (wired as an
+# autouse fixture in tests/conftest.py) so a plugin's refresh loop can't
+# outlive its test and poll a torn-down fake endpoint.
+_open_frameworks: List[Framework] = []
+
+
+def close_all() -> None:
+    while _open_frameworks:
+        _open_frameworks.pop().close()
