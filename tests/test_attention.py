@@ -179,7 +179,16 @@ def test_flash_head_mismatch_fails_loudly():
     v = jax.random.normal(jax.random.PRNGKey(21), (2, 128, 3, 64))
     with pytest.raises(ValueError, match="divide"):
         attention.flash_attention_gqa(q, k, v)
-    k2 = jax.random.normal(jax.random.PRNGKey(22), (2, 128, 2, 64))
-    v2 = jax.random.normal(jax.random.PRNGKey(23), (2, 128, 2, 64))
-    with pytest.raises(ValueError, match="equal head counts"):
-        attention.flash_attention(q, k2, v2)
+    with pytest.raises(ValueError, match="divide"):
+        attention.flash_attention(q, k, v)
+
+
+def test_flash_attention_is_gqa_native():
+    """flash_attention takes kv_heads-sized K/V directly — the grouped
+    kernels resolve the group via index maps; output matches naive GQA."""
+    q, _, _ = _qkv(jax.random.PRNGKey(19), s=128, h=4)
+    k = jax.random.normal(jax.random.PRNGKey(22), (2, 128, 2, 64))
+    v = jax.random.normal(jax.random.PRNGKey(23), (2, 128, 2, 64))
+    out = attention.flash_attention(q, k, v, True, 64, 64)
+    ref = attention.naive_attention(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -7,9 +7,14 @@ on restart (SURVEY §5; device occupancy from pod annotations,
 pods, /root/reference/pkg/controller/elasticquota.go:212-224). Our control
 plane is hermetic, so this module supplies the etcd half of that contract:
 
-- a **write-ahead journal** (``wal.jsonl``): every store mutation is appended
-  *under the store lock, before its watch event fires* — the same
-  happens-before etcd gives watchers;
+- a **write-ahead journal** (``wal.jsonl``): every store mutation is
+  *enqueued under the store lock, before its watch event fires*, so WAL
+  order always equals store-mutation order; the disk append itself is
+  asynchronous (a dedicated writer thread), and fsync is off by default —
+  an acknowledged mutation enqueued but not yet flushed can be lost on a
+  hard crash. ``Journal.flush()`` gives a durability barrier, and
+  ``fsync=True`` (``--state-fsync`` on the CLIs) makes every batch durable
+  before the writer proceeds;
 - a **snapshot** (``snapshot.json``) written at compaction time; replay =
   snapshot + WAL suffix, exactly etcd's snapshot+raft-log recovery;
 - a reflective dataclass codec (all API objects are plain nested dataclasses
@@ -184,16 +189,48 @@ class Journal:
 
     def _write_batch(self, batch) -> None:
         with self._file_lock:
-            for op, kind, obj in batch:
-                rec = {"op": op, "kind": kind, "obj": encode_object(obj)}
-                self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
-            self._wal.flush()
+            # a mid-batch write failure (disk full) can leave a torn partial
+            # line; replay stops at the first undecodable line, so appending
+            # after a tear would silently shadow every later record. On
+            # failure, discard the Python-level buffer and truncate the file
+            # back to the last known-good on-disk offset. The buffer is
+            # always clean at entry (every exit path flushes or reopens), so
+            # fstat's size IS the logical append position.
+            good = os.fstat(self._wal.fileno()).st_size
+            try:
+                for op, kind, obj in batch:
+                    rec = {"op": op, "kind": kind, "obj": encode_object(obj)}
+                    self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                self._wal.flush()
+            except Exception:
+                self._reopen_discarding_buffer(good)
+                raise
             if self.fsync:
                 os.fsync(self._wal.fileno())
             self._wal_records += len(batch)
             needs_compact = self._wal_records >= self.compact_every
         if needs_compact:
             self.compact()
+
+    def _reopen_discarding_buffer(self, good: int) -> None:
+        """Recover from a torn batch: drop any bytes stuck in the text
+        wrapper's buffer (close may fail re-flushing them — the fd closes
+        regardless) and os.ftruncate the WAL back to ``good``. Called under
+        ``_file_lock``."""
+        try:
+            self._wal.close()
+        except OSError:
+            pass
+        try:
+            fd = os.open(self._wal_path, os.O_RDWR)
+            try:
+                os.ftruncate(fd, good)
+            finally:
+                os.close(fd)
+        except OSError as e:
+            klog.error_s(e, "journal truncate after torn write failed",
+                         offset=good)
+        self._wal = open(self._wal_path, "a", encoding="utf-8")
 
     def compact(self) -> None:
         """Write a full snapshot and truncate the WAL (atomic via rename).

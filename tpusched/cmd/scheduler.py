@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--state-dir", default=None,
                    help="persist control-plane state (WAL + snapshot) here and "
                         "recover it on restart — the etcd durability analog")
+    p.add_argument("--state-fsync", action="store_true",
+                   help="fsync every WAL batch before acknowledging it "
+                        "(durable across power loss, at a latency cost; "
+                        "without it the WAL is flushed but not synced)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "(0 picks a free port; off by default)")
@@ -104,7 +108,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     journal = None
     if args.state_dir and not args.validate_only:
         from ..apiserver import persistence
-        journal = persistence.attach(api, args.state_dir)
+        journal = persistence.attach(api, args.state_dir,
+                                     fsync=args.state_fsync)
     profile = resolve_profile(args)
     scheduler = Scheduler(api, default_registry(), profile)
 

@@ -410,8 +410,7 @@ class Framework:
             if s.is_wait():
                 plugin_timeouts[p.name()] = timeout
                 continue
-            s.with_plugin(p.name())
-            return s
+            return s.with_plugin(p.name())
         if plugin_timeouts:
             with self._waiting_lock:
                 self._waiting[pod.meta.uid] = _WaitingPod(pod, plugin_timeouts)

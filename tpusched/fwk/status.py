@@ -80,10 +80,13 @@ class Status:
         return "; ".join(self.reasons)
 
     def with_plugin(self, name: str) -> "Status":
-        if self is _SUCCESS:
-            return Status(SUCCESS, plugin=name)
-        self.plugin = name
-        return self
+        # uniformly copy-on-write: plugins may return shared/cached Status
+        # instances (the success singleton is one), and run_filter_plugins
+        # calls this per node — in-place mutation would corrupt them across
+        # nodes. Use the result, not the receiver.
+        if self.plugin == name:
+            return self
+        return Status(self.code, list(self.reasons), name)
 
     def __repr__(self) -> str:
         return f"Status({self.code.name}, {self.reasons!r}, plugin={self.plugin!r})"

@@ -140,22 +140,24 @@ def _from_bh(x, b, h):
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    block_q: int, block_k: int, interpret: Optional[bool]):
-    """Returns (out 4-D, lse (b·h, s) f32). Caller guarantees divisibility."""
+    """Returns (out 4-D, lse (b·h, s) f32). Caller guarantees divisibility.
+
+    GQA-native: k/v may carry h/n_rep heads. The grid walks b·h query heads
+    while the K/V BlockSpec index maps divide by n_rep — flattened query
+    index ``bh = batch·h + head`` lands on KV buffer row
+    ``bh // n_rep == batch·kv + head//n_rep`` exactly (h = kv·n_rep), so the
+    kernel streams kv_heads-sized blocks straight from HBM and the expanded
+    (b, s, h, d) K/V tensors never exist anywhere."""
     b, s, h, d = q.shape
-    if k.shape[2] != h or v.shape[2] != h:
-        # the kernels are MHA: a head-count mismatch here would launch a
-        # q-sized grid over smaller K/V buffers and clamp out of range —
-        # silently wrong output. GQA callers go through flash_attention_gqa.
-        raise ValueError(
-            f"flash kernels need equal head counts (q {h}, k {k.shape[2]}, "
-            f"v {v.shape[2]}); use flash_attention_gqa for grouped KV")
+    kv = k.shape[2]
+    n_rep = h // kv
     block_q, block_k = _flash_blocks(s, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     nq, nk = s // block_q, s // block_k
     scale = 1.0 / np.sqrt(d)
 
-    # (b, s, h, d) → (b·h, s, d): one grid axis walks batch×heads
+    # q: (b, s, h, d) → (b·h, s, d); k/v: (b, s, kv, d) → (b·kv, s, d)
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
 
     kernel = functools.partial(_flash_kernel, scale=scale, block_q=block_q,
@@ -167,8 +169,10 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh // n_rep, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh // n_rep, j, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -189,11 +193,17 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
 
 def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
                            dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                           block_q: int, block_k: int, causal: bool, nq: int):
-    j = pl.program_id(1)   # k-block (held fixed while i sweeps)
-    i = pl.program_id(2)
+                           block_q: int, block_k: int, causal: bool, nq: int,
+                           n_rep: int):
+    """dK/dV for one KV head: the innermost grid axis sweeps all n_rep·nq
+    (query-head-in-group, q-block) pairs, so the scratch accumulators reduce
+    over the whole GQA group in VMEM — the group-summed dK/dV leave the
+    kernel already reduced, with no (b, s, h, d)-sized intermediate."""
+    j = pl.program_id(1)   # k-block (held fixed while c sweeps)
+    c = pl.program_id(2)   # c = r·nq + i: query head r of the group, q-block i
+    i = c % nq
 
-    @pl.when(i == 0)
+    @pl.when(c == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -222,7 +232,7 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == nq - 1)
+    @pl.when(c == n_rep * nq - 1)
     def _final():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -269,6 +279,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
                     interpret):
     b, s, h, d = q.shape
+    kv = k.shape[2]
+    n_rep = h // kv
     block_q, block_k = _flash_blocks(s, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -282,21 +294,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     dd = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                  axis=-1, keepdims=True)
 
-    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, a, 0))
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, a, b_: (bh, a, 0))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, a, b_: (bh, b_, 0))
-    # dkdv sweeps q-blocks innermost: swap which grid axis feeds each spec
-    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, b_, 0))
-    row_spec_kv = pl.BlockSpec((1, block_q, 1), lambda bh, a, b_: (bh, b_, 0))
-    kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda bh, a, b_: (bh, a, 0))
+    # dK/dV: grid walks b·kv KV heads; the innermost axis c enumerates all
+    # n_rep·nq (group query head r, q-block i) pairs. KV buffer row bkv holds
+    # query rows bkv·n_rep … bkv·n_rep+n_rep−1 (same h = kv·n_rep identity as
+    # the forward), so q-side blocks live at row bkv·n_rep + c//nq.
+    q_spec_kv = pl.BlockSpec(
+        (1, block_q, d), lambda bkv, a, c: (bkv * n_rep + c // nq, c % nq, 0))
+    row_spec_kv = pl.BlockSpec(
+        (1, block_q, 1), lambda bkv, a, c: (bkv * n_rep + c // nq, c % nq, 0))
+    kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda bkv, a, c: (bkv, a, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, scale=scale,
                           block_q=block_q, block_k=block_k, causal=causal,
-                          nq=nq),
-        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)),
-        grid=(b * h, nk, nq),
+                          nq=nq, n_rep=n_rep),
+        out_shape=(jax.ShapeDtypeStruct((b * kv, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * kv, s, d), v.dtype)),
+        grid=(b * kv, nk, n_rep * nq),
         in_specs=[q_spec_kv, q_spec_kv, row_spec_kv, row_spec_kv,
                   kv_spec_kv, kv_spec_kv],
         out_specs=(kv_spec_kv, kv_spec_kv),
@@ -304,6 +318,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qf, dof, lse, dd, kf, vf)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, a, b_: (bh, a, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, a, b_: (bh, a, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d),
+                           lambda bh, a, b_: (bh // n_rep, b_, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, block_q=block_q,
@@ -316,10 +335,18 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(qf, dof, lse, dd, kf, vf)
 
-    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+    return (_from_bh(dq, b, h), _from_bh(dk, b, kv), _from_bh(dv, b, kv))
 
 
-def _flash_supported(s: int, block_q: int, block_k: int) -> bool:
+def _flash_supported(q: jax.Array, k: jax.Array, v: jax.Array,
+                     block_q: int, block_k: int) -> bool:
+    s, h, kv = q.shape[1], q.shape[2], k.shape[2]
+    if h % kv or v.shape[2] != kv:
+        # not a silent fallback: an invalid group can't run anywhere, and a
+        # k/v head mismatch would make the v index map read the wrong rows
+        raise ValueError(
+            f"kv heads must divide q heads and match between k/v for GQA "
+            f"(q {h}, k {kv}, v {v.shape[2]})")
     bq, bk = _flash_blocks(s, block_q, block_k)
     return _HAVE_PALLAS and s % bq == 0 and s % bk == 0
 
@@ -331,15 +358,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: Optional[bool] = None) -> jax.Array:
     """FlashAttention on the MXU: O(s) HBM traffic for activations in both
     directions — the backward recomputes P blockwise from q, k and the saved
-    logsumexp (FlashAttention-2) instead of materializing the score matrix."""
-    if not _flash_supported(q.shape[1], block_q, block_k):
+    logsumexp (FlashAttention-2) instead of materializing the score matrix.
+
+    GQA-native: k/v may carry h/n_rep heads (Llama-3 grouped-query). The
+    kernels stream kv_heads-sized K/V blocks and resolve the group in their
+    BlockSpec index maps; dK/dV are reduced over the group inside the
+    backward kernel. Nothing n_heads-sized is ever materialized for K/V —
+    the n_rep× HBM saving is the point of GQA on TPU."""
+    if not _flash_supported(q, k, v, block_q, block_k):
         return naive_attention(q, k, v, causal)
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    if not _flash_supported(q.shape[1], block_q, block_k):
+    if not _flash_supported(q, k, v, block_q, block_k):
         return naive_attention(q, k, v, causal), (q, k, v, None, None)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
@@ -362,17 +395,11 @@ def flash_attention_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True, block_q: int = 128,
                         block_k: int = 128,
                         interpret: Optional[bool] = None) -> jax.Array:
-    """GQA front-end for the flash kernels: expands K/V to n_heads OUTSIDE
-    the custom_vjp (so dK/dV reduce back over the group via the broadcast's
-    transpose). The kernels themselves stay MHA; a grouped kernel that skips
-    the expansion is a further HBM optimization."""
-    h, kv = q.shape[2], k.shape[2]
-    if h % kv:
-        raise ValueError(
-            f"kv heads ({kv}) must divide q heads ({h}) for GQA")
-    n_rep = h // kv
-    return flash_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
-                           causal, block_q, block_k, interpret)
+    """Alias kept for callers predating grouped kernels: flash_attention is
+    GQA-native (K/V stay kv_heads-sized end to end; the group is resolved by
+    the kernels' index maps, never by expansion in HBM). Validation lives in
+    _flash_supported."""
+    return flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

@@ -24,8 +24,11 @@ from .workload import Params
 
 
 def _checkpointer():
+    """One construction point for the orbax checkpointer used by save() and
+    restore() — StandardCheckpointHandler handles pytrees-of-arrays with
+    shardings, which is exactly the train-state shape."""
     import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
 
 
 def save(directory: str, params: Params, step: int,
@@ -34,12 +37,11 @@ def save(directory: str, params: Params, step: int,
     additional sharded pytree — typically the optax optimizer state, whose
     moments are as large as the params and just as sharded. ``directory``
     must not already contain a checkpoint for this step."""
-    import orbax.checkpoint as ocp
     path = os.path.join(os.path.abspath(directory), f"step_{step:08d}")
     state: Dict[str, Any] = {"params": params, "step": step}
     if extra is not None:
         state["extra"] = extra
-    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+    with _checkpointer() as ckptr:
         ckptr.save(path, state)
 
 
@@ -72,7 +74,7 @@ def restore(directory: str, abstract_params: Params,
     target: Dict[str, Any] = {"params": abstract_params, "step": step}
     if abstract_extra is not None:
         target["extra"] = abstract_extra
-    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+    with _checkpointer() as ckptr:
         restored = ckptr.restore(path, args=ocp.args.StandardRestore(target))
     if abstract_extra is not None:
         return restored["params"], restored["step"], restored["extra"]

@@ -35,7 +35,9 @@ class TokenBatcher:
         self.start_step = start_step
 
     def _host_batch(self, step: int) -> np.ndarray:
-        rng = np.random.default_rng((self.seed << 20) | step)
+        # SeedSequence keeps (seed, step) pairs collision-free for any step —
+        # bit-packing would bleed step bits into the seed past 2**20 steps
+        rng = np.random.default_rng(np.random.SeedSequence((self.seed, step)))
         return rng.integers(0, self.cfg.vocab,
                             size=(self.batch, self.cfg.seq), dtype=np.int32)
 

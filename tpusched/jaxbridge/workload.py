@@ -140,7 +140,7 @@ def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
            attn_fn=None) -> jax.Array:
     h = _rmsnorm(x, p["ln_attn"])
     # k/v stay kv_heads-sized: every impl folds the GQA group axis itself
-    # (flash expands at its custom_vjp boundary, see flash_attention_gqa)
+    # (flash resolves it in its kernels' index maps; naive/ring in einsums)
     q, k, v = _qkv(h, p, cfg)
     if attn_fn is None:
         attn_fn = attention.naive_attention
