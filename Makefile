@@ -14,6 +14,12 @@ unit-test:
 integration-test:
 	hack/integration-test.sh
 
+# Opt-in real-TPU tier: pallas kernel parity + e2e train step on hardware.
+# Skips cleanly when no TPU backend is present.
+.PHONY: tpu-test
+tpu-test:
+	hack/tpu-test.sh
+
 .PHONY: bench
 bench:
 	$(PY) bench.py

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Real-TPU test tier: pallas kernel parity (fwd+bwd, MHA/GQA/MQA), a jitted
+# end-to-end train step, and the KV-cache decode path — all on the actual
+# chip, so the Mosaic lowering is never hardware-untested in-repo.
+#
+# Opt-in (round-1 verdict item 2): the CI tiers (hack/unit-test.sh,
+# hack/integration-test.sh) force a virtual CPU mesh; this one needs a TPU
+# and SKIPS (exit 0) cleanly when none is present.
+set -o errexit -o nounset -o pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+
+exec python -m pytest tests_tpu/ -q "$@"

@@ -187,3 +187,56 @@ def test_cross_node_dry_run_has_no_prefilter_side_effects():
     node = plugin._dry_run(CycleState(), high, tuple(victims))
     assert node == "h0"
     assert calls == []
+
+
+# -- NodeResourceLimits (KEP-217 analog) --------------------------------------
+
+def test_node_resource_limits_spreads_away_from_oversubscribed():
+    """The node whose resident LIMITS are oversubscribed scores lower even
+    though its requests look idle (the KEP-217 use case)."""
+    hot = make_node("hot", capacity=make_resources(cpu=8000, memory="32Gi"))
+    cold = make_node("cold", capacity=make_resources(cpu=8000, memory="32Gi"))
+    # resident burstable pod: request 1 cpu, limit 16 (2x allocatable)
+    resident = make_pod("burst", node_name="hot",
+                        requests=make_resources(cpu=1000),
+                        limits=make_resources(cpu=16000))
+    profile = PluginProfile(score=[("NodeResourceLimits", 1)],
+                            bind=["DefaultBinder"])
+    fw, handle, _ = new_test_framework(profile, nodes=[hot, cold],
+                                       pods=[resident])
+    pod = make_pod("p", limits=make_resources(cpu=2000))
+    totals, s = fw.run_score_plugins(CycleState(), pod, [hot, cold])
+    assert s.is_success()
+    assert totals["cold"] > totals["hot"]
+    assert totals["hot"] == 0            # >= 2x oversubscribed floors at 0
+
+
+def test_node_resource_limits_counts_hbm():
+    """tpu-memory limits join the ratio: a host whose HBM is limit-packed by
+    serving pods scores below an empty one."""
+    from tpusched.api.resources import TPU_MEMORY
+    a = make_tpu_node("hbm-full", chips=4)
+    b = make_tpu_node("hbm-free", chips=4)
+    hbm = a.status.allocatable[TPU_MEMORY]
+    resident = make_pod("serve", node_name="hbm-full",
+                        limits={TPU_MEMORY: hbm})
+    profile = PluginProfile(score=[("NodeResourceLimits", 1)],
+                            bind=["DefaultBinder"])
+    fw, handle, _ = new_test_framework(profile, nodes=[a, b], pods=[resident])
+    pod = make_pod("p", limits={TPU_MEMORY: hbm // 4})
+    totals, s = fw.run_score_plugins(CycleState(), pod, [a, b])
+    assert s.is_success()
+    assert totals["hbm-free"] > totals["hbm-full"]
+
+
+def test_node_resource_limits_neutral_for_limitless_pods():
+    """BestEffort pods on empty nodes: every node scores MAX (no limit
+    pressure anywhere)."""
+    n1 = make_node("n1")
+    n2 = make_node("n2")
+    profile = PluginProfile(score=[("NodeResourceLimits", 1)],
+                            bind=["DefaultBinder"])
+    fw, handle, _ = new_test_framework(profile, nodes=[n1, n2])
+    totals, s = fw.run_score_plugins(CycleState(), make_pod("p"), [n1, n2])
+    assert s.is_success()
+    assert totals["n1"] == totals["n2"] == 100

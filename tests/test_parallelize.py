@@ -1,0 +1,141 @@
+"""Parallel Filter/Score machinery: Parallelizer semantics, the vectorized
+NodeResourcesFit batch path's parity with its per-node path, and the
+CycleState atomic memo used by parallel score plugins."""
+import threading
+
+import pytest
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.fwk import CycleState, PluginProfile
+from tpusched.fwk.nodeinfo import NodeInfo
+from tpusched.plugins.defaults import NodeResourcesFit
+from tpusched.testing import make_node, make_pod, make_tpu_node
+from tpusched.util.parallelize import Parallelizer
+
+
+def test_until_runs_every_item():
+    par = Parallelizer(4)
+    hit = [0] * 100
+
+    def work(i):
+        hit[i] += 1
+
+    par.until(100, work)
+    par.close()
+    assert hit == [1] * 100
+
+
+def test_until_early_stop_bounded():
+    par = Parallelizer(4)
+    lock = threading.Lock()
+    done = []
+
+    def work(i):
+        with lock:
+            done.append(i)
+
+    par.until(1000, work, stop=lambda: len(done) >= 10)
+    par.close()
+    # stop is checked between items: bounded overshoot, not a full sweep
+    assert 10 <= len(done) < 1000
+
+
+def test_until_propagates_errors():
+    par = Parallelizer(4)
+
+    def work(i):
+        if i == 37:
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        par.until(64, work)
+    par.close()
+
+
+def test_serial_mode_is_inline_and_ordered():
+    par = Parallelizer(1)
+    seen = []
+    par.until(10, seen.append, stop=lambda: len(seen) >= 5)
+    assert seen == [0, 1, 2, 3, 4]   # deterministic serial early stop
+    assert par.map(lambda i: i * i, 5) == [0, 1, 4, 9, 16]
+
+
+def test_map_ordered_under_parallelism():
+    par = Parallelizer(8)
+    assert par.map(lambda i: i * 2, 500) == [i * 2 for i in range(500)]
+    par.close()
+
+
+# -- batch filter parity ------------------------------------------------------
+
+def _infos():
+    nodes = [make_node(f"n{i}", capacity=make_resources(
+        cpu=(i % 5) * 1000, memory=f"{(i % 7) + 1}Gi", pods=3))
+        for i in range(40)]
+    for i, n in enumerate(nodes):
+        if i % 3 == 0:
+            n.status.allocatable[TPU] = 4
+    return [NodeInfo(n) for n in nodes]
+
+
+@pytest.mark.parametrize("limits", [
+    {},                                  # cpu/pods-only request
+    {TPU: 2},                            # extended resource
+])
+def test_filter_batch_matches_per_node(limits):
+    plugin = NodeResourcesFit()
+    pod = make_pod("p", requests=make_resources(cpu=2000, memory="4Gi"),
+                   limits=limits)
+    infos = _infos()
+    batch = plugin.filter_batch(CycleState(), pod, infos)
+    for info, got in zip(infos, batch):
+        want = plugin.filter(CycleState(), pod, info)
+        if want.is_success():
+            assert got is None, info.node.name
+        else:
+            assert got is not None, info.node.name
+            assert sorted(got.reasons) == sorted(want.reasons), info.node.name
+
+
+def test_read_or_init_single_container_across_threads():
+    state = CycleState()
+    containers = []
+    barrier = threading.Barrier(8)
+
+    def work():
+        barrier.wait()
+        containers.append(id(state.read_or_init("k", dict)))
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(containers)) == 1
+
+
+def test_scheduler_parallel_profile_schedules_gang():
+    """End-to-end: a gang schedules identically under forced parallelism."""
+    from tpusched.apiserver import server as srv
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.testing import (TestCluster, make_pod_group, make_tpu_pool)
+
+    profile = tpu_gang_profile(permit_wait_s=10, denied_s=1)
+    profile.parallelism = 8
+    with TestCluster(profile=profile) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("gang", min_member=16,
+                                    tpu_slice_shape="4x4x4",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w-{i}", pod_group="gang", limits={TPU: 4})
+                for i in range(16)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+        used = {}
+        for p in pods:
+            used[c.pod(p.key).spec.node_name] = used.get(
+                c.pod(p.key).spec.node_name, 0) + 1
+        assert len(used) == 16 and all(v == 1 for v in used.values())

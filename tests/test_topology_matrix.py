@@ -1,0 +1,270 @@
+"""Table-driven topology matrix (round-2 depth pass).
+
+The reference's NUMA plugin carries a 989-LoC table suite tracked against
+its TESTS.md (/root/reference/pkg/noderesourcetopology/filter_test.go); this
+file is the equivalent sweep for the ICI-torus engine, closing the gaps the
+round-1 TESTS.md tracked:
+
+- placement enumeration differentially tested against an independent
+  brute-force enumerator across every accelerator x wrap pattern x rotation
+  (including rotation-on-wrapped-axis interactions);
+- malformed/duplicate/degenerate TpuTopology CRs;
+- placement-cache invalidation when a CR's resource_version changes;
+- fragmentation, then defrag after gang deletion, at the scheduler level.
+"""
+import itertools
+
+import pytest
+
+from tpusched.api.resources import TPU
+from tpusched.api.topology import ACCELERATORS, TpuTopology, TpuTopologySpec
+from tpusched.api.meta import ObjectMeta
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+from tpusched.topology.torus import (HOST_EXTENT, HostGrid,
+                                     candidate_host_blocks,
+                                     enumerate_placements,
+                                     validate_slice_shape)
+
+
+# -- independent brute-force reference ---------------------------------------
+
+def brute_force_placements(chip_shape, acc_name, dims, wrap):
+    """Every distinct host-coordinate set reachable by (rotation, anchor):
+    written independently of torus.py (no shared helpers) so the two can
+    only agree by both being right."""
+    extent = HOST_EXTENT[acc_name]
+    host_dims = tuple(d // e for d, e in zip(dims, extent))
+    rank = len(host_dims)
+    results = set()
+    for perm in set(itertools.permutations(chip_shape)):
+        if any(perm[i] % extent[i] for i in range(rank)):
+            continue
+        hb = tuple(perm[i] // extent[i] for i in range(rank))
+        if any(hb[i] > host_dims[i] for i in range(rank)):
+            continue
+        axis_anchors = []
+        for i in range(rank):
+            if hb[i] == host_dims[i]:
+                axis_anchors.append([0])          # full axis: one anchor
+            elif wrap[i]:
+                axis_anchors.append(list(range(host_dims[i])))
+            else:
+                axis_anchors.append(list(range(host_dims[i] - hb[i] + 1)))
+        for anchor in itertools.product(*axis_anchors):
+            hosts = frozenset(
+                tuple((anchor[i] + o[i]) % host_dims[i] for i in range(rank))
+                for o in itertools.product(*(range(b) for b in hb)))
+            results.add(hosts)
+    return results
+
+
+def grid_for(acc_name, dims, wrap):
+    extent = HOST_EXTENT[acc_name]
+    hosts = {}
+    ranges = [range(0, d, e) for d, e in zip(dims, extent)]
+    for c in itertools.product(*ranges):
+        hosts["h" + "-".join(map(str, c))] = c
+    spec = TpuTopologySpec(pool="p", accelerator=acc_name, dims=tuple(dims),
+                           wrap=tuple(wrap), hosts=hosts,
+                           chips_per_host=ACCELERATORS[acc_name].chips_per_host)
+    g = HostGrid.from_spec(spec)
+    assert g is not None
+    return g
+
+
+# the full sweep: accelerator x pool dims x wrap pattern x chip shape.
+# Shapes are chosen to exercise: exact tile, rotation-required, wraparound-
+# required, full-axis, too-big, and non-tiling (expected 0 placements).
+_SWEEP = []
+for _acc, _dims, _shapes in [
+    ("tpu-v5p", (8, 4, 4), [(4, 4, 4), (8, 4, 2), (2, 2, 4), (4, 2, 2),
+                            (8, 4, 4), (2, 2, 3), (16, 4, 4), (4, 4, 2)]),
+    ("tpu-v4", (4, 4, 4), [(2, 2, 4), (4, 4, 4), (2, 2, 1), (3, 2, 2)]),
+    ("tpu-v5e", (8, 8), [(4, 4), (8, 2), (2, 8), (8, 8), (6, 4), (2, 2)]),
+    ("tpu-v6e", (8, 4), [(4, 4), (8, 2), (4, 2), (8, 4), (2, 4)]),
+]:
+    _rank = len(_dims)
+    for _wrap in itertools.product([False, True], repeat=_rank):
+        for _shape in _shapes:
+            _SWEEP.append((_acc, _dims, _wrap, _shape))
+
+
+@pytest.mark.parametrize("acc,dims,wrap,shape", _SWEEP)
+def test_enumeration_matches_brute_force(acc, dims, wrap, shape):
+    g = grid_for(acc, dims, wrap)
+    got = set(enumerate_placements(g, shape))
+    want = brute_force_placements(shape, acc, dims, wrap)
+    assert got == want
+    err = validate_slice_shape(shape, ACCELERATORS[acc], dims)
+    # validation must agree with enumeration about impossibility — except
+    # for wraparound-only feasibility, which validation (host-count check)
+    # cannot rule out; it may only be MORE permissive, never less
+    if err is not None:
+        assert got == set()
+
+
+def test_rotation_onto_wrapped_axis_only():
+    """Rows 2x3 interaction from TESTS.md known gaps: a v5p pool wrapped on
+    axis 0 only; a 2x2x6-chip slice on an 8x4x4... use dims where the shape's
+    long axis exceeds every unwrapped axis span but rides the wrapped one
+    split across the seam."""
+    # host dims (4,2,4) from chip dims (8,4,4); block (1,1,3) in hosts fits
+    # axis 2 (span 4) without wrap; rotate so the 3 lands on axis 0 -> needs
+    # anchors 2,3 to wrap. Wrapping axis 0 must strictly add placements.
+    unwrapped = grid_for("tpu-v5p", (8, 4, 4), (False, False, False))
+    wrapped = grid_for("tpu-v5p", (8, 4, 4), (True, False, False))
+    shape = (6, 2, 2)   # hosts (3,1,2) identity; rotations put 3 on any axis
+    n_unwrapped = len(enumerate_placements(unwrapped, shape))
+    n_wrapped = len(enumerate_placements(wrapped, shape))
+    assert n_wrapped > n_unwrapped
+    # and both agree with brute force (also covered by the sweep)
+    assert n_wrapped == len(
+        brute_force_placements(shape, "tpu-v5p", (8, 4, 4),
+                               (True, False, False)))
+
+
+# -- malformed CRs ------------------------------------------------------------
+
+def _spec(**kw):
+    base = dict(pool="p", accelerator="tpu-v5p", dims=(4, 4, 4),
+                wrap=(False, False, False),
+                hosts={"h0": (0, 0, 0), "h1": (2, 0, 0)}, chips_per_host=4)
+    base.update(kw)
+    return TpuTopologySpec(**base)
+
+
+def test_malformed_cr_unknown_accelerator():
+    assert HostGrid.from_spec(_spec(accelerator="tpu-v9")) is None
+
+
+def test_malformed_cr_rank_mismatch_dims():
+    assert HostGrid.from_spec(_spec(dims=(4, 4))) is None
+
+
+def test_malformed_cr_host_coord_rank_mismatch_drops_host():
+    g = HostGrid.from_spec(_spec(hosts={"bad": (0, 0), "ok": (0, 0, 0)}))
+    assert g is not None
+    assert "bad" not in g.coord_of and "ok" in g.coord_of
+
+
+def test_malformed_cr_out_of_torus_coord_drops_host():
+    g = HostGrid.from_spec(_spec(hosts={"out": (8, 0, 0), "ok": (2, 0, 0)}))
+    assert g is not None
+    assert "out" not in g.coord_of and "ok" in g.coord_of
+
+
+def test_malformed_cr_duplicate_host_coords_last_wins_consistently():
+    """Two nodes claiming one torus cell: the grid must stay internally
+    consistent (node_of and coord_of agree on a single winner), never map
+    one cell to two nodes."""
+    g = HostGrid.from_spec(_spec(hosts={"a": (0, 0, 0), "b": (0, 0, 0)}))
+    assert g is not None
+    winner = g.node_of[(0, 0, 0)]
+    assert winner in ("a", "b")
+    assert g.coord_of[winner] == (0, 0, 0)
+    assert len([n for n, c in g.coord_of.items() if c == (0, 0, 0)]) >= 1
+    assert list(g.node_of.values()).count(winner) == 1
+
+
+@pytest.mark.parametrize("shape,msg", [
+    ((4, 4), "axes"),                 # rank mismatch
+    ((0, 4, 4), "positive"),          # degenerate axis
+    ((-2, 4, 4), "positive"),
+    ((3, 3, 3), "rotation"),          # never tiles the 2x2x1 extent
+    ((16, 4, 4), "rotation"),         # exceeds the pool on every rotation
+])
+def test_validate_slice_shape_rejections(shape, msg):
+    err = validate_slice_shape(shape, ACCELERATORS["tpu-v5p"], (4, 4, 4))
+    assert err is not None and msg in err
+
+
+# -- scheduler-level: cache invalidation + defrag -----------------------------
+
+def _gang(c, name, members, shape="4x4x2", chips=4):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator="tpu-v5p"))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: chips})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def test_topology_cache_invalidated_on_cr_update():
+    """A gang needing wraparound stays Pending on an unwrapped pool; patching
+    the SAME CR to wrap (bumping resource_version) must flow through the
+    placement cache and admit it."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(8, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        # occupy the middle of axis 0 so a 4x4x4 block fits only wrapped
+        # around the seam: blockers on host-axis-0 rows 1 and 2
+        blockers = []
+        for node, hc in topo.spec.hosts.items():
+            if hc[0] in (2, 4):   # chip rows 2,4 -> host rows 1,2
+                blockers.append(make_pod(f"blk-{node}", limits={TPU: 4},
+                                         node_name=node))
+        for b in blockers:
+            c.api.create(srv.PODS, b)
+        gang = _gang(c, "ring", members=16, shape="4x4x4")
+        assert c.wait_for_pods_unscheduled([p.key for p in gang], hold=1.5)
+        c.api.patch(srv.TPU_TOPOLOGIES, topo.key,
+                    lambda t: setattr(t.spec, "wrap", (True, False, False)))
+        assert c.wait_for_pods_scheduled([p.key for p in gang], timeout=30)
+        rows = {topo.spec.hosts[c.pod(p.key).spec.node_name][0]
+                for p in gang}
+        assert rows == {0, 6}   # the wrapped block across the seam
+
+
+def test_defrag_after_gang_deletion():
+    """Fill the torus with two gangs, delete one, and a third gang must land
+    exactly in the freed contiguous block (fragmentation bookkeeping)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        g1 = _gang(c, "left", members=8)    # 4x4x2 = half the pool
+        assert c.wait_for_pods_scheduled([p.key for p in g1], timeout=30)
+        g2 = _gang(c, "right", members=8)
+        assert c.wait_for_pods_scheduled([p.key for p in g2], timeout=30)
+        g3 = _gang(c, "wait", members=8)
+        assert c.wait_for_pods_unscheduled([p.key for p in g3], hold=1.5)
+        g1_hosts = {c.pod(p.key).spec.node_name for p in g1}
+        for p in g1:
+            c.api.delete(srv.PODS, p.key)
+        assert c.wait_for_pods_scheduled([p.key for p in g3], timeout=30)
+        assert {c.pod(p.key).spec.node_name for p in g3} == g1_hosts
+
+
+def test_full_pool_fragmented_gang_blocked_until_contiguous():
+    """Foreign single-host pods scattered so no contiguous half-pool block
+    survives: the gang must stay Pending even though enough TOTAL chips are
+    free (contiguity, not capacity, is the constraint)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        # host grid is (2,2,4); a 4x4x2-chip gang needs a (2,2,2) host block
+        # (or a (2,1,4)/(1,2,4) rotation). Blockers at host coords (0,0,1)
+        # and (1,1,2) — chip coords (0,0,1), (2,2,2) — intersect every
+        # placement of every rotation while freeing 14 of 16 hosts.
+        blocked_chip_coords = {(0, 0, 1), (2, 2, 2)}
+        blockers = [node for node, hc in topo.spec.hosts.items()
+                    if tuple(hc) in blocked_chip_coords]
+        assert len(blockers) == 2
+        for i, node in enumerate(blockers):
+            c.api.create(srv.PODS, make_pod(f"blk-{i}", limits={TPU: 4},
+                                            node_name=node))
+        gang = _gang(c, "frag", members=8)
+        assert c.wait_for_pods_unscheduled([p.key for p in gang], hold=1.5)
+        # free every blocker: the gang must now bind
+        for i in range(len(blockers)):
+            c.api.delete(srv.PODS, f"default/blk-{i}")
+        assert c.wait_for_pods_scheduled([p.key for p in gang], timeout=30)

@@ -1,0 +1,30 @@
+"""Real-TPU test tier (opt-in; hack/tpu-test.sh).
+
+Unlike tests/conftest.py, this tier does NOT pin JAX to CPU: the whole point
+is exercising the real Mosaic lowering of the pallas kernels and a jitted
+end-to-end train step on hardware — a kernel that passes under interpret
+mode can still fail or mis-tile on the chip. Every test skips cleanly when
+no TPU backend is available, so the tier is safe to run anywhere.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def _tpu_available() -> bool:
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@pytest.fixture(scope="session")
+def tpu():
+    if not _tpu_available():
+        pytest.skip("no TPU backend available")
+    import jax
+    return jax.devices()[0]

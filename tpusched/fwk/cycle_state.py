@@ -41,6 +41,18 @@ class CycleState:
         with self._lock:
             self._data.pop(key, None)
 
+    def read_or_init(self, key: str, factory) -> Any:
+        """Atomic get-or-create: under parallel Filter/Score, the lazy
+        'try_read → write on miss' memo pattern loses entries (two threads
+        both miss and install DIFFERENT containers); this makes the install
+        atomic so every thread shares one."""
+        with self._lock:
+            v = self._data.get(key)
+            if v is None:
+                v = factory()
+                self._data[key] = v
+            return v
+
     def clone(self) -> "CycleState":
         """Shallow clone; values implementing .clone() are cloned too
         (StateData.Clone contract)."""

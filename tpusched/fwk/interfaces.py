@@ -89,6 +89,25 @@ class FilterPlugin(Plugin):
         raise NotImplementedError
 
 
+class BatchFilterPlugin(FilterPlugin):
+    """Optional vectorized fast path over the whole candidate node list.
+
+    ``filter_batch`` must be semantically identical to calling ``filter``
+    per node on the SAME node_infos: entry i is None when node i passes,
+    else the failure Status. The scheduler uses it as a pre-pass when no
+    nominated pods are in play (a nominated-pod dry-run mutates per-node
+    state the batch pass cannot see, so those nodes take the per-node
+    path). Upstream has no analog — its per-node parallelism is goroutines
+    (generic_scheduler.go:266); here the TPU-first equivalent is
+    vectorizing the fleet-wide checks with numpy, which also sidesteps the
+    GIL entirely for the heavy part.
+    """
+
+    def filter_batch(self, state: CycleState, pod: Pod,
+                     node_infos) -> List[Optional[Status]]:
+        raise NotImplementedError
+
+
 class PostFilterPlugin(Plugin):
     def post_filter(self, state: CycleState, pod: Pod,
                     filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:

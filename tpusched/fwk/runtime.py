@@ -15,12 +15,12 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from ..api.core import Node, Pod
 from ..util import klog
 from .cycle_state import CycleState
-from .interfaces import (BindPlugin, ClusterEvent, EnqueueExtensions,
-                         FilterPlugin, NodeScore, PermitPlugin, Plugin,
-                         PostBindPlugin, PostFilterPlugin, PostFilterResult,
-                         PreBindPlugin, PreFilterPlugin, PreScorePlugin,
-                         QueueSortPlugin, ReservePlugin, ScorePlugin,
-                         WILDCARD_EVENT)
+from .interfaces import (BatchFilterPlugin, BindPlugin, ClusterEvent,
+                         EnqueueExtensions, FilterPlugin, NodeScore,
+                         PermitPlugin, Plugin, PostBindPlugin,
+                         PostFilterPlugin, PostFilterResult, PreBindPlugin,
+                         PreFilterPlugin, PreScorePlugin, QueueSortPlugin,
+                         ReservePlugin, ScorePlugin, WILDCARD_EVENT)
 from .nodeinfo import MAX_NODE_SCORE, NodeInfo, Snapshot
 from .status import SKIP, Status, merge_statuses
 
@@ -63,6 +63,10 @@ class PluginProfile:
     # upstream percentageOfNodesToScore: 0 = adaptive (50 - nodes/125,
     # floor 5%, only above 100 nodes); 100 = always scan every node
     percentage_of_nodes_to_score: int = 0
+    # upstream KubeSchedulerConfiguration.parallelism (default 16): worker
+    # threads for the per-node Filter/Score sweeps; 0 = min(16, cpu count),
+    # 1 = fully serial (deterministic single-threaded scan)
+    parallelism: int = 0
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]
@@ -250,6 +254,14 @@ class Framework:
         # filter runs plugins×nodes times per cycle and name()/attr lookups
         # dominate the Python-side overhead otherwise.
         self._filter_dispatch = [(p.name(), p.filter) for p in self.filter_plugins]
+        # Plugins with a vectorized whole-fleet path (BatchFilterPlugin): the
+        # scheduler runs these once over all candidate nodes, then excludes
+        # them from the per-node sweep (sched/scheduler.py).
+        self.batch_filter_plugins = [
+            p for p in self.filter_plugins if isinstance(p, BatchFilterPlugin)]
+        # Optional per-node parallelism for score (scheduler injects the
+        # shared pool; None = serial, the default for bare Frameworks/tests)
+        self.parallelizer = None
         self.post_filter_plugins = _bucket(profile.post_filter, PostFilterPlugin)
         self.pre_score_plugins = _bucket(profile.pre_score, PreScorePlugin)
         self.score_plugins: List[Tuple[ScorePlugin, int]] = [
@@ -300,10 +312,13 @@ class Framework:
 
     # -- filter --------------------------------------------------------------
     def run_filter_plugins(self, state: CycleState, pod: Pod,
-                           node_info: NodeInfo) -> Status:
+                           node_info: NodeInfo,
+                           exclude: frozenset = frozenset()) -> Status:
+        """``exclude`` skips plugins the caller already evaluated for this
+        node via their batch path (scheduler's vectorized pre-pass)."""
         skip = state.skip_filter_plugins
         for name, filter_fn in self._filter_dispatch:
-            if name in skip:
+            if name in skip or name in exclude:
                 continue
             s = filter_fn(state, pod, node_info)
             if not s.is_success():
@@ -311,11 +326,14 @@ class Framework:
         return Status.success()
 
     def run_filter_plugins_with_nominated_pods(self, state: CycleState, pod: Pod,
-                                               node_info: NodeInfo) -> Status:
+                                               node_info: NodeInfo,
+                                               exclude: frozenset = frozenset()) -> Status:
         """Upstream semantics: evaluate twice when higher-priority nominated
-        pods exist on the node — once assuming they are running, once not."""
+        pods exist on the node — once assuming they are running, once not.
+        ``exclude`` only applies on the no-nominated-pods fast path: a
+        nominated dry-run mutates node state, so every plugin must re-run."""
         if self.handle.pod_nominator.empty():
-            return self.run_filter_plugins(state, pod, node_info)
+            return self.run_filter_plugins(state, pod, node_info, exclude)
         nominated = [p for p in self.handle.pod_nominator.nominated_pods_for_node(
             node_info.node.name) if p.priority >= pod.priority and p.key != pod.key]
         for add_nominated in ([True, False] if nominated else [False]):
@@ -363,15 +381,30 @@ class Framework:
                           nodes: List[Node]) -> Tuple[Dict[str, int], Status]:
         """Returns total weighted score per node name."""
         totals: Dict[str, int] = {n.name: 0 for n in nodes}
+        par = self.parallelizer
         for plugin, weight in self.score_plugins:
             if plugin.name() in state.skip_score_plugins:
                 continue
-            scores: List[NodeScore] = []
-            for n in nodes:
-                val, s = plugin.score(state, pod, n.name)
-                if not s.is_success():
-                    return {}, s.with_plugin(plugin.name())
-                scores.append(NodeScore(n.name, val))
+            if par is not None and len(nodes) >= 64:
+                # upstream prioritizeNodes parallelism
+                # (generic_scheduler.go:426): score nodes concurrently; a
+                # score() must already be safe under the parallel Filter
+                # contract (read-only on shared state / idempotent memos)
+                results = par.map(
+                    lambda i: plugin.score(state, pod, nodes[i].name),
+                    len(nodes))
+                scores = []
+                for n, (val, s) in zip(nodes, results):
+                    if not s.is_success():
+                        return {}, s.with_plugin(plugin.name())
+                    scores.append(NodeScore(n.name, val))
+            else:
+                scores = []
+                for n in nodes:
+                    val, s = plugin.score(state, pod, n.name)
+                    if not s.is_success():
+                        return {}, s.with_plugin(plugin.name())
+                    scores.append(NodeScore(n.name, val))
             ns = plugin.normalize_score(state, pod, scores)
             if ns is not None and not ns.is_success():
                 return {}, ns.with_plugin(plugin.name())

@@ -125,7 +125,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_blocks(s: int, block_q: int, block_k: int):
-    return min(block_q, s), min(block_k, s)
+    """Clamp requested block sizes to ones that divide the sequence: short
+    sequences collapse to one block; otherwise halve (512→256→128) until a
+    divisor is found. Returning a non-divisor (odd s) makes _flash_supported
+    fall back to naive — it must never silently change the math, and a
+    too-big default must never disable the kernel for s % 512 != 0 lengths
+    like 640/1280 that a smaller block handles fine."""
+    def fit(b: int) -> int:
+        if s <= b:
+            return s
+        while b >= 128 and s % b:
+            b //= 2
+        return b
+    return fit(block_q), fit(block_k)
 
 
 def _to_bh(x):
@@ -348,13 +360,13 @@ def _flash_supported(q: jax.Array, k: jax.Array, v: jax.Array,
             f"kv heads must divide q heads and match between k/v for GQA "
             f"(q {h}, k {kv}, v {v.shape[2]})")
     bq, bk = _flash_blocks(s, block_q, block_k)
-    return _HAVE_PALLAS and s % bq == 0 and s % bk == 0
+    return _HAVE_PALLAS and bq > 0 and bk > 0 and s % bq == 0 and s % bk == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 128,
-                    block_k: int = 128,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """FlashAttention on the MXU: O(s) HBM traffic for activations in both
     directions — the backward recomputes P blockwise from q, k and the saved
@@ -392,8 +404,8 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True, block_q: int = 128,
-                        block_k: int = 128,
+                        causal: bool = True, block_q: int = 512,
+                        block_k: int = 1024,
                         interpret: Optional[bool] = None) -> jax.Array:
     """Alias kept for callers predating grouped kernels: flash_attention is
     GQA-native (K/V stay kv_heads-sized end to end; the group is resolved by

@@ -62,6 +62,11 @@ def _register_optional(r: Registry) -> None:
     except ImportError:
         pass
     try:
+        from .resourcelimits import NodeResourceLimits
+        r.register(NodeResourceLimits.NAME, NodeResourceLimits.new)
+    except ImportError:
+        pass
+    try:
         from .trimaran import TargetLoadPacking, LoadVariationRiskBalancing
         r.register(TargetLoadPacking.NAME, TargetLoadPacking.new)
         r.register(LoadVariationRiskBalancing.NAME, LoadVariationRiskBalancing.new)

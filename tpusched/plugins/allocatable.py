@@ -39,10 +39,8 @@ class NodeResourcesAllocatable(ScorePlugin):
         if self.args.mode == "Least":
             total = -total
         # raw scores are normalized below; stash per-node raw in state
-        raw = state.try_read("NodeResourcesAllocatable/raw")
-        if raw is None:
-            raw = {}
-            state.write("NodeResourcesAllocatable/raw", raw)
+        # (read_or_init: score runs across nodes in parallel)
+        raw = state.read_or_init("NodeResourcesAllocatable/raw", dict)
         raw[node_name] = total
         return 0, Status.success()   # real value applied in normalize
 

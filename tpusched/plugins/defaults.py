@@ -9,10 +9,15 @@ from __future__ import annotations
 
 from typing import List, Tuple
 
+from typing import Optional
+
+import numpy as np
+
 from ..api.core import Binding, Node, Pod, tolerates
 from ..api.resources import resources_fit
 from ..fwk import (CycleState, Status)
-from ..fwk.interfaces import (BindPlugin, FilterPlugin, QueueSortPlugin)
+from ..fwk.interfaces import (BatchFilterPlugin, BindPlugin, FilterPlugin,
+                              QueueSortPlugin)
 from ..fwk.nodeinfo import NodeInfo
 from ..util.podutil import pod_effective_request
 
@@ -28,24 +33,31 @@ class PrioritySort(QueueSortPlugin):
         return pi1.timestamp < pi2.timestamp
 
 
-class NodeResourcesFit(FilterPlugin):
-    """cpu/memory/pods/extended-resource fit against allocatable − requested."""
+class NodeResourcesFit(BatchFilterPlugin):
+    """cpu/memory/pods/extended-resource fit against allocatable − requested.
+
+    Implements the vectorized fleet-wide path (filter_batch): the per-node
+    check is three dict lookups per resource, which at 1000+ hosts is pure
+    Python dispatch overhead — one numpy comparison over (nodes × resources)
+    matrices does the same work GIL-free."""
     NAME = "NodeResourcesFit"
 
     _REQ_KEY = "NodeResourcesFit/pod-request"
 
-    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
-        if node_info.node is None:
-            return Status.error("node not found")
+    def _pod_request(self, state: CycleState, pod: Pod):
         # the pod's request is cycle-invariant: compute once per cycle
         # (upstream stashes it in PreFilter; memoizing on first Filter call
         # needs no profile wiring)
-        request = state.try_read(self._REQ_KEY)
-        if request is None:
+        def build():
             req = pod_effective_request(pod)
             req["pods"] = 1
-            request = tuple((k, v) for k, v in req.items() if v > 0)
-            state.write(self._REQ_KEY, request)
+            return tuple((k, v) for k, v in req.items() if v > 0)
+        return state.read_or_init(self._REQ_KEY, build)
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if node_info.node is None:
+            return Status.error("node not found")
+        request = self._pod_request(state, pod)
         alloc = node_info.allocatable
         requested = node_info.requested
         insufficient = [k for k, v in request
@@ -54,6 +66,30 @@ class NodeResourcesFit(FilterPlugin):
             return Status.unschedulable(
                 *[f"Insufficient {k}" for k in insufficient])
         return Status.success()
+
+    def filter_batch(self, state: CycleState, pod: Pod,
+                     node_infos) -> List[Optional[Status]]:
+        request = self._pod_request(state, pod)
+        n = len(node_infos)
+        out: List[Optional[Status]] = [None] * n
+        # (resources × nodes) headroom matrix; one vectorized compare per
+        # resource replaces n per-node Python filter calls
+        fail = np.zeros(n, dtype=bool)
+        fail_by_res = []
+        for k, v in request:
+            alloc = np.fromiter(
+                (inf.allocatable.get(k, 0) for inf in node_infos),
+                dtype=np.float64, count=n)
+            used = np.fromiter(
+                (inf.requested.get(k, 0) for inf in node_infos),
+                dtype=np.float64, count=n)
+            res_fail = used + v > alloc
+            fail_by_res.append((k, res_fail))
+            fail |= res_fail
+        for i in np.flatnonzero(fail):
+            out[i] = Status.unschedulable(
+                *[f"Insufficient {k}" for k, rf in fail_by_res if rf[i]])
+        return out
 
 
 class NodeUnschedulable(FilterPlugin):

@@ -30,10 +30,8 @@ class PodState(ScorePlugin):
             return 0, Status.error(f"node {node_name} not in snapshot")
         terminating = sum(1 for p in info.pods if p.is_terminating())
         nominated = len(self.handle.pod_nominator.nominated_pods_for_node(node_name))
-        raw = state.try_read("PodState/raw")
-        if raw is None:
-            raw = {}
-            state.write("PodState/raw", raw)
+        # read_or_init: score runs across nodes in parallel
+        raw = state.read_or_init("PodState/raw", dict)
         raw[node_name] = terminating - nominated
         return 0, Status.success()
 

@@ -1,0 +1,92 @@
+"""NodeResourceLimits: limit-aware spreading (KEP-217 analog, implemented).
+
+The reference ships KEP-217 as design only — no code exists in its tree
+(/root/reference/kep/217-resource-limit-aware-scoring/README.md:1). This
+implements the proposal: burstable pods can carry limits far above requests,
+so request-based scoring happily over-subscribes a node's LIMITS (the KEP's
+production observation: limit/allocatable from 0.1 to 6). Score spreads by
+the post-placement limit-to-allocatable ratio — the node whose limits are
+least oversubscribed wins.
+
+TPU-native twist: ``tpu-memory`` (fractional HBM serving pods, KEP-1) joins
+cpu/memory in the ratio — HBM over-subscription is exactly the burstable
+failure mode on an accelerator host, and the chip model already tracks
+resident limit sums.
+
+score(node) = MAX_NODE_SCORE · (1 − min(r, CAP)/CAP), where r is the max
+over resources of (Σ resident pod limits + this pod's limit)/allocatable and
+CAP=2.0 bounds the useful range (a node past 2× oversubscription scores 0 —
+beyond that, degree no longer matters).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..api.core import Pod
+from ..api.resources import TPU_MEMORY
+from ..fwk import CycleState, Status
+from ..fwk.interfaces import ScorePlugin
+from ..fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
+
+_RATIO_CAP = 2.0
+_RESOURCES = ("cpu", "memory")
+
+
+def _pod_limits(pod: Pod) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for c in pod.spec.containers:
+        for k, v in c.limits.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _node_limit_sums(info: NodeInfo) -> Dict[str, int]:
+    sums: Dict[str, int] = {}
+    for p in info.pods:
+        for k, v in _pod_limits(p).items():
+            sums[k] = sums.get(k, 0) + v
+    return sums
+
+
+class NodeResourceLimits(ScorePlugin):
+    NAME = "NodeResourceLimits"
+
+    _LIMITS_KEY = "NodeResourceLimits/pod-limits"
+
+    def __init__(self, handle):
+        self.handle = handle
+        # bound once: score() is the per-node hot loop (the deferred import
+        # exists only to avoid a plugins-package import cycle)
+        from .tpuslice.chip_node import ChipNode
+        self._chip_node = ChipNode
+
+    @classmethod
+    def new(cls, args, handle) -> "NodeResourceLimits":
+        return cls(handle)
+
+    def score(self, state: CycleState, pod: Pod,
+              node_name: str) -> Tuple[int, Status]:
+        info = self.handle.snapshot_shared_lister().get(node_name)
+        if info is None:
+            return 0, Status.error(f"node {node_name} not in snapshot")
+        pod_limits = state.read_or_init(self._LIMITS_KEY,
+                                        lambda: _pod_limits(pod))
+        # resident limit sums are derived purely from (node, pods): memoized
+        # on the NodeInfo generation so repeat scoring cycles stay O(1)
+        sums = info.derived("NodeResourceLimits/sums", _node_limit_sums)
+        ratio = 0.0
+        for res in (*_RESOURCES, TPU_MEMORY):
+            limit = pod_limits.get(res, 0) + sums.get(res, 0)
+            if limit <= 0:
+                continue
+            alloc = info.allocatable.get(res, 0)
+            if res == TPU_MEMORY:
+                # HBM allocatable is published via the chip model, not the
+                # node resource list
+                cn = self._chip_node.cached(info)
+                alloc = cn.hbm_total_mb if cn is not None else 0
+            if alloc <= 0:
+                continue
+            ratio = max(ratio, limit / alloc)
+        capped = min(ratio, _RATIO_CAP) / _RATIO_CAP
+        return int(MAX_NODE_SCORE * (1.0 - capped)), Status.success()

@@ -114,18 +114,29 @@ class Collector:
         self._lock = threading.RLock()
         self._metrics: Optional[WatcherMetrics] = None
         self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
         self.update_metrics()
         if auto_refresh:
-            t = threading.Thread(target=self._loop, daemon=True,
-                                 name="trimaran-collector")
-            t.start()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="trimaran-collector")
+            self._thread.start()
 
     def _loop(self) -> None:
         while not self._stop.wait(self._interval):
+            if self._stop.is_set():
+                return
             self.update_metrics()
 
     def stop(self) -> None:
+        """Signal and JOIN the refresh thread: an in-flight fetch logging
+        after the caller tears down (pytest closing capture streams) shows
+        up as spurious '--- Logging error ---' noise that masks real
+        failures."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=6.0)  # fetch timeout is 5s; outlast it
+            self._thread = None
 
     def update_metrics(self) -> None:
         m = self._client.get_latest_watcher_metrics()

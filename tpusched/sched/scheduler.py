@@ -71,6 +71,12 @@ class Scheduler:
         self.percentage_of_nodes_to_score = profile.percentage_of_nodes_to_score
         self._next_start_node_index = 0
 
+        # per-node Filter/Score parallelism (upstream parallelism=16); the
+        # pool is shared by the filter sweep and the score pass
+        from ..util.parallelize import Parallelizer
+        self._par = Parallelizer(profile.parallelism)
+        self._fw.parallelizer = self._par
+
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
         # binding cycles deregister themselves on exit (O(1) vs scanning the
@@ -172,6 +178,7 @@ class Scheduler:
             pending = list(self._binding_threads.values())
         for t in pending:
             t.join(timeout=5)
+        self._par.close()
         self._fw.close()
 
     def _loop(self) -> None:
@@ -258,25 +265,11 @@ class Scheduler:
             state.write("tpusched/diagnosis", diagnosis)
             return "", s
 
-        feasible: List[Node] = []
-        diagnosis: Dict[str, Status] = {}
         infos = snapshot.list()
         want = self._num_feasible_nodes_to_find(len(infos))
-        start = self._next_start_node_index % len(infos)
-        visited = 0
-        for idx in range(len(infos)):
-            node_info = infos[(start + idx) % len(infos)]
-            visited += 1
-            fs = self._fw.run_filter_plugins_with_nominated_pods(state, pod, node_info)
-            if fs.is_success():
-                feasible.append(node_info.node)
-                if len(feasible) >= want:
-                    break
-            elif fs.is_error():
-                return "", fs
-            else:
-                diagnosis[node_info.node.name] = fs
-        self._next_start_node_index = (start + visited) % len(infos)
+        feasible, diagnosis, error = self._find_feasible(state, pod, infos, want)
+        if error is not None:
+            return "", error
         state.write("tpusched/diagnosis", diagnosis)
 
         if not feasible:
@@ -301,6 +294,74 @@ class Scheduler:
             return "", s
         best = max(feasible, key=lambda n: (totals.get(n.name, 0), n.name))
         return best.name, Status.success()
+
+    def _find_feasible(self, state: CycleState, pod: Pod, infos,
+                       want: int):
+        """findNodesThatPassFilters analog (generic_scheduler.go:266), in two
+        stages tuned for Python-on-TPU-control-plane economics:
+
+        1. a vectorized batch pre-pass: every BatchFilterPlugin evaluates the
+           WHOLE candidate list in one numpy-backed call (no per-node Python
+           dispatch, no GIL contention);
+        2. a chunked thread-pool sweep running the remaining per-node plugins
+           in round-robin order from the rotating start index, stopping once
+           ``want`` feasible nodes are found (upstream ParallelizeUntil).
+
+        The batch results are only consumed while no nominated pods exist —
+        a preemption dry-run adds nominated pods to per-node state the batch
+        pass never saw, so those cycles take the full per-node path.
+        Returns (feasible_nodes, diagnosis, error_status_or_None).
+        """
+        n = len(infos)
+        start = self._next_start_node_index % n
+        fw = self._fw
+        nominator_empty = self.handle.pod_nominator.empty()
+
+        batch_fail: List[Optional[Status]] = [None] * n
+        exclude: frozenset = frozenset()
+        if nominator_empty and fw.batch_filter_plugins:
+            names = []
+            for p in fw.batch_filter_plugins:
+                if p.name() in state.skip_filter_plugins:
+                    continue
+                names.append(p.name())
+                res = p.filter_batch(state, pod, infos)
+                for i, st in enumerate(res):
+                    if st is not None and batch_fail[i] is None:
+                        batch_fail[i] = st.with_plugin(p.name())
+            exclude = frozenset(names)
+
+        feasible: List[Node] = []
+        diagnosis: Dict[str, Status] = {}
+        errors: List[Status] = []
+        lock = threading.Lock()
+        visited = [0]
+
+        def work(idx: int) -> None:
+            oi = (start + idx) % n
+            node_info = infos[oi]
+            fs = batch_fail[oi]
+            if fs is None:
+                fs = fw.run_filter_plugins_with_nominated_pods(
+                    state, pod, node_info, exclude)
+                if fs.is_success():
+                    with lock:
+                        visited[0] += 1
+                        feasible.append(node_info.node)
+                    return
+            with lock:
+                visited[0] += 1
+                if fs.is_error():
+                    errors.append(fs)
+                else:
+                    diagnosis[node_info.node.name] = fs
+
+        self._par.until(
+            n, work, stop=lambda: len(feasible) >= want or bool(errors))
+        self._next_start_node_index = (start + max(visited[0], 1)) % n
+        if errors:
+            return [], {}, errors[0]
+        return feasible, diagnosis, None
 
     def _num_feasible_nodes_to_find(self, num_all: int) -> int:
         """Upstream numFeasibleNodesToFind (generic_scheduler.go): scan every

@@ -1,0 +1,99 @@
+"""Chunked thread-pool parallelism for the scheduling hot loop.
+
+Rebuild of the upstream hosting loop's per-node parallelism
+(/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/core/generic_scheduler.go:266,426
+runs Filter and Score across nodes on 16 workers via
+``workqueue.ParallelizeUntil``). Python's GIL changes the economics: pure-
+Python plugin bodies serialize, but the native torus engine is called
+through ctypes (which releases the GIL for the call) and numpy releases it
+for vectorized work — so the pool buys real concurrency exactly where the
+per-node cost is concentrated, and bounded overhead elsewhere. Chunking
+keeps GIL handoffs amortized: each worker takes a contiguous chunk of the
+index space, checking the early-stop predicate between items.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+DEFAULT_PARALLELISM = 16  # upstream KubeSchedulerConfiguration default
+
+
+class Parallelizer:
+    """A persistent worker pool with ParallelizeUntil semantics.
+
+    ``until(n, work, stop)`` invokes ``work(i)`` for i in [0, n) across the
+    pool, skipping remaining items once ``stop()`` turns true (checked
+    between items, so a bounded overshoot of in-flight items can still
+    complete — same contract as upstream's context cancellation). Exceptions
+    propagate to the caller after all workers settle.
+
+    With ``workers <= 1`` everything runs inline on the caller thread —
+    zero-overhead fallback for tiny clusters and deterministic tests.
+    """
+
+    def __init__(self, workers: int = 0):
+        if workers <= 0:
+            workers = min(DEFAULT_PARALLELISM, (os.cpu_count() or 4))
+        self.workers = workers
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="tpusched-par")
+            return self._pool
+
+    def until(self, n: int, work: Callable[[int], None],
+              stop: Optional[Callable[[], bool]] = None) -> None:
+        if n <= 0:
+            return
+        if self.workers <= 1 or n == 1:
+            for i in range(n):
+                if stop is not None and stop():
+                    return
+                work(i)
+            return
+        pool = self._ensure_pool()
+        # upstream chunk sizing: ceil(n / (workers*4)), floor 1 — small
+        # enough to balance, large enough to amortize task dispatch
+        chunk = max(1, n // (self.workers * 4))
+        starts = range(0, n, chunk)
+
+        def run_chunk(lo: int) -> None:
+            for i in range(lo, min(lo + chunk, n)):
+                if stop is not None and stop():
+                    return
+                work(i)
+
+        futures = [pool.submit(run_chunk, lo) for lo in starts]
+        err = None
+        for f in futures:
+            try:
+                f.result()
+            except BaseException as e:  # keep draining so the pool settles
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
+    def map(self, fn: Callable[[int], object], n: int) -> list:
+        """Parallel [fn(0), …, fn(n−1)] with ordered results."""
+        out = [None] * n
+
+        def work(i: int) -> None:
+            out[i] = fn(i)
+
+        self.until(n, work)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
