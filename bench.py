@@ -197,9 +197,29 @@ def bench_scale() -> None:
 
 # -- TPU workload side --------------------------------------------------------
 
+def _tpu_alive(timeout_s: float = 240.0) -> bool:
+    """Probe the TPU in a SUBPROCESS with a hard timeout: a wedged axon
+    tunnel (e.g. a killed client whose device claim hasn't expired) hangs
+    jax backend init indefinitely — that must never take the headline gang
+    metric down with it."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return "tpu" in r.stdout
+    except Exception:
+        return False
+
+
 def bench_tpu_workload() -> None:
     import dataclasses
 
+    if not _tpu_alive():
+        emit("train-step MFU skipped: no TPU backend reachable "
+             "(subprocess probe timed out or reported non-tpu)",
+             None, "", None)
+        return
     import jax
 
     if jax.default_backend() not in ("tpu",):

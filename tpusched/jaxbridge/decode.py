@@ -59,7 +59,8 @@ def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
     ck = jax.lax.dynamic_update_slice(c["k"], k, (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(c["v"], v, (0, pos, 0, 0))
     o = _cached_attention(q, ck, cv, pos, cfg.n_heads // cfg.kv_heads)
-    return _finish_block(x, layer, o), {"k": ck, "v": cv}
+    out, _ = _finish_block(x, layer, o, cfg)   # aux loss is a train concern
+    return out, {"k": ck, "v": cv}
 
 
 def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
@@ -71,7 +72,8 @@ def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
     q, k, v = _qkv(h, layer, cfg)
     ck = jax.lax.dynamic_update_slice(c["k"], k, (0, 0, 0, 0))
     cv = jax.lax.dynamic_update_slice(c["v"], v, (0, 0, 0, 0))
-    return _finish_block(x, layer, attn_fn(q, k, v)), {"k": ck, "v": cv}
+    out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
+    return out, {"k": ck, "v": cv}
 
 
 def prefill(params: Params, cache: KVCache, tokens: jax.Array,

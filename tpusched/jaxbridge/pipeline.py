@@ -1,0 +1,161 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+The reference has no model-parallel dimension at all (SURVEY §2: "no ML
+parallelism strategies… in the reference"); this completes the framework's
+parallelism matrix (dp / fsdp / sp / tp / ep / slice / **pp**) the TPU-first
+way:
+
+- layers are STACKED per stage (one (L, …) leaf per layer param) and the
+  leading axis is sharded over ``pp`` — each device owns n_layers/pp layers;
+- the schedule is a ``lax.scan`` over n_micro + pp − 1 ticks inside a
+  ``shard_map`` manual only over ``pp``: at tick t, stage s runs microbatch
+  t−s through its local layers (a second, inner ``lax.scan`` over the stacked
+  leaf) and hands activations to stage s+1 via ``lax.ppermute`` — one ICI
+  hop, the same neighbor-ring pattern ring attention uses;
+- bubbles are masked with ``jnp.where`` (no data-dependent control flow; the
+  whole schedule is one compiled XLA program);
+- reverse-mode AD through the scan+ppermute IS the backward pipeline
+  schedule (ppermute transposes to the reverse permutation), so
+  ``jax.value_and_grad`` of this loss needs no hand-written backward pass.
+
+Other mesh axes (dp for batch, tp inside a stage) stay automatic: GSPMD
+shards the per-microbatch tensors over them as usual.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import attention
+from .workload import (ModelConfig, Params, _block, _resolve_attn_fn,
+                       _rmsnorm, init_params, param_specs)
+
+
+def stack_layers(params: Params) -> Dict[str, Any]:
+    """List-of-layer-dicts → one dict of (L, …) stacked leaves (the pytree
+    shape lax.scan and pp sharding want)."""
+    layers = params["layers"]
+    return {k: jnp.stack([lyr[k] for lyr in layers]) for k in layers[0]}
+
+
+def pipeline_param_shardings(cfg: ModelConfig, mesh: Mesh):
+    """Shardings for (stacked_layers, embed, out, ln_f): stacked leaves get
+    P('pp', *per-layer spec); embeddings/norms replicate over pp (tp/fsdp
+    still apply via param_specs)."""
+    specs = param_specs(cfg, mesh)
+    layer_spec = specs["layers"][0]
+    stacked = {k: NamedSharding(mesh, P("pp", *spec))
+               for k, spec in layer_spec.items()}
+    return (stacked,
+            NamedSharding(mesh, specs["embed"]),
+            NamedSharding(mesh, specs["out"]),
+            NamedSharding(mesh, specs["ln_f"]))
+
+
+def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig, n_micro: int,
+                             lr: float = 1e-3):
+    """Returns (step, shardings, token_sharding) where
+    ``step((stacked, embed, out, ln_f), tokens) -> (new_params, loss)``.
+    Requires a ``pp`` mesh axis with cfg.n_layers % pp == 0 and batch %
+    n_micro == 0."""
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide into "
+                         f"pp={pp} stages")
+    attn_fn = _resolve_attn_fn(cfg)
+    b_axes = tuple(a for a in ("dp",) if a in mesh.axis_names)
+    batch_spec = b_axes if b_axes else None
+    token_sharding = NamedSharding(mesh, P(batch_spec, None))
+    shardings = pipeline_param_shardings(cfg, mesh)
+
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def pipe_loss(stacked_local, embed, out_w, ln_f, tokens):
+        """Runs INSIDE shard_map (manual over pp): stacked_local carries
+        this stage's (L/pp, …) layers; everything else is pp-replicated."""
+        s_idx = jax.lax.axis_index("pp")
+        bsz, seq = tokens.shape
+        mb = bsz // n_micro
+        micro = tokens.reshape(n_micro, mb, seq)
+
+        def run_stage(x):
+            def body(h, layer):
+                h, aux = _block(h, layer, cfg, attn_fn)
+                return h, aux
+            x, auxs = jax.lax.scan(body, x, stacked_local)
+            return x, jnp.sum(auxs)
+
+        def vary(x):
+            return jax.lax.pcast(x, ("pp",), to="varying")
+
+        d = embed.shape[1]
+        ticks = n_micro + pp - 1
+        recv0 = vary(jnp.zeros((mb, seq, d), cfg.dtype))
+        outs0 = vary(jnp.zeros((n_micro, mb, seq, d), cfg.dtype))
+        aux0 = vary(jnp.float32(0.0))
+
+        def tick(carry, t):
+            recv, outs, aux_tot = carry
+            mb_idx = t - s_idx
+            active = (mb_idx >= 0) & (mb_idx < n_micro)
+            # stage 0 feeds itself from the embedded microbatch stream
+            feed = embed[micro[jnp.clip(t, 0, n_micro - 1)]]
+            x = jnp.where(s_idx == 0, feed, recv)
+            y, aux = run_stage(x)
+            aux_tot = aux_tot + jnp.where(active, aux, 0.0)
+            # the LAST stage records its finished microbatch
+            write = (s_idx == pp - 1) & active
+            slot = jnp.clip(mb_idx, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, y, cur), slot, axis=0)
+            # hand activations to the next stage — one ICI hop
+            recv = jax.lax.ppermute(y, "pp", perm)
+            return (recv, outs, aux_tot), None
+
+        (recv, outs, aux_tot), _ = jax.lax.scan(
+            tick, (recv0, outs0, aux0), jnp.arange(ticks))
+
+        # only the last stage's outputs are real; compute loss there and
+        # psum the masked value so every stage returns the same scalar
+        x = _rmsnorm(outs.reshape(bsz, seq, d), ln_f)
+        logits = (x @ out_w)[:, :-1].astype(jnp.float32)
+        targets = tokens.reshape(n_micro, mb, seq).reshape(bsz, seq)[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        local = jnp.where(s_idx == pp - 1,
+                          jnp.mean(nll) + cfg.moe_aux_weight * aux_tot, 0.0)
+        return jax.lax.psum(local, "pp")
+
+    sharded_loss = jax.shard_map(
+        pipe_loss, mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P(), P()),
+        out_specs=P(),
+        axis_names={"pp"})
+
+    def step(params, tokens):
+        stacked, embed, out_w, ln_f = params
+        loss, grads = jax.value_and_grad(
+            lambda st, e, o, l: sharded_loss(st, e, o, l, tokens),
+            argnums=(0, 1, 2, 3))(stacked, embed, out_w, ln_f)
+        new = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, tuple(grads))
+        return new, loss
+
+    jit_step = jax.jit(
+        step,
+        in_shardings=(shardings, token_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,))
+    return jit_step, shardings, token_sharding
+
+
+def init_pipeline_params(key: jax.Array, cfg: ModelConfig):
+    """(stacked_layers, embed, out, ln_f) tuple from the standard init."""
+    params = init_params(key, cfg)
+    return (stack_layers(params), params["embed"], params["out"],
+            params["ln_f"])

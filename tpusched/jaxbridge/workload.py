@@ -37,6 +37,14 @@ class ModelConfig:
     # grouped-query attention: number of KV heads (0 ⇒ n_heads, plain MHA).
     # Llama-3 style: each KV head serves n_heads/n_kv_heads query heads.
     n_kv_heads: int = 0
+    # mixture-of-experts (0 ⇒ dense SwiGLU MLP). Mixtral-style: every layer's
+    # MLP becomes n_experts stacked SwiGLU experts behind a top-k router with
+    # GShard capacity-based dispatch (static shapes; the dispatch/combine
+    # einsums are what all_to_all rides when experts shard over the ep axis).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01   # load-balance loss weight (switch-style)
 
     @property
     def kv_heads(self) -> int:
@@ -64,6 +72,14 @@ class ModelConfig:
                            d_ff=2816, seq=seq, dtype=jnp.bfloat16,
                            n_kv_heads=2)
 
+    @staticmethod
+    def mixtral_like(seq: int = 2048, n_experts: int = 8) -> "ModelConfig":
+        """Scaled-down Mixtral-ish MoE: 8 SwiGLU experts, top-2 routing,
+        GQA attention — the second flagship model family."""
+        return ModelConfig(vocab=32000, d_model=1024, n_layers=8, n_heads=8,
+                           d_ff=2816, seq=seq, dtype=jnp.bfloat16,
+                           n_kv_heads=2, n_experts=n_experts, moe_top_k=2)
+
 
 Params = Dict[str, Any]
 
@@ -78,15 +94,33 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
 
     layers: List[Dict[str, jax.Array]] = []
     for kl in k_layers:
-        ks = jax.random.split(kl, 7)
-        layers.append({
+        ks = jax.random.split(kl, 8)
+        layer = {
             "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d_kv)),
             "wv": dense(ks[2], (d, d_kv)), "wo": dense(ks[3], (d, d)),
-            "w_gate": dense(ks[4], (d, f)), "w_up": dense(ks[5], (d, f)),
-            "w_down": dense(ks[6], (f, d)),
             "ln_attn": jnp.ones((d,), cfg.dtype),
             "ln_mlp": jnp.ones((d,), cfg.dtype),
-        })
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+
+            def expert(k, shape, fan_in):
+                # fan-in scaled per expert matrix (dense() scales by
+                # shape[0], which would be E here)
+                x = jax.random.normal(k, shape) / np.sqrt(fan_in)
+                return x.astype(cfg.dtype)
+
+            # stacked experts: the leading E axis is what ep shards
+            layer["router"] = (jax.random.normal(ks[7], (d, e))
+                               / np.sqrt(d)).astype(jnp.float32)
+            layer["w_gate"] = expert(ks[4], (e, d, f), d)
+            layer["w_up"] = expert(ks[5], (e, d, f), d)
+            layer["w_down"] = expert(ks[6], (e, f, d), f)
+        else:
+            layer["w_gate"] = dense(ks[4], (d, f))
+            layer["w_up"] = dense(ks[5], (d, f))
+            layer["w_down"] = dense(ks[6], (f, d))
+        layers.append(layer)
     return {
         "embed": dense(k_embed, (v, d)),
         "out": dense(k_out, (d, v)),
@@ -124,27 +158,102 @@ def _qkv(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     return q, k, v
 
 
-def _finish_block(x: jax.Array, p: Dict[str, jax.Array],
-                  o: jax.Array) -> jax.Array:
-    """Residual + SwiGLU MLP tail shared by the training forward and the
-    KV-cache decode path (jaxbridge/decode.py) — one definition so the two
-    can never desynchronize."""
+def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
+             ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+    """GShard/Mixtral-style top-k MoE with capacity-based dispatch, fully
+    static shapes (jit-stable): router → top-k → position-in-expert via
+    cumsum → dispatch/combine one-hot einsums. Returns (out, aux_loss).
+
+    TPU-first sharding story: expert weights carry a leading E axis sharded
+    over the ``ep`` mesh axis (param_specs); ``ep_spec`` pins the (E, C, d)
+    expert input buffer to the same axis, so GSPMD lowers the dispatch
+    einsum to exactly the token→expert all_to_all the reference world would
+    hand-write against NCCL (SURVEY §5: no comm backend exists there; here
+    the collective is compiler-inserted and rides ICI).
+
+    Top-1 slots get capacity priority over top-2 slots (k-major cumsum), the
+    standard GShard ordering. Dropped tokens (capacity overflow) pass through
+    the residual only. Aux loss is the switch-transformer load-balance term
+    E·Σ_e f_e·P_e.
+    """
+    b, s, d = h.shape
+    e, k = cfg.n_experts, cfg.moe_top_k
+    n = b * s
+    x = h.reshape(n, d)
+    # capacity: tokens each expert may accept, padded to a lane-friendly 4
+    cap = max(4, int(cfg.moe_capacity_factor * k * n / e) + 3 & ~3)
+
+    logits = x.astype(jnp.float32) @ p["router"]           # (n, E) f32
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = jax.lax.top_k(probs, k)                    # (n, k)
+    gate = gate / jnp.sum(gate, axis=-1, keepdims=True)    # renormalize
+
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # (n, k, E)
+    # k-major flatten: all top-1 slots claim capacity before any top-2 slot
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)     # (k·n, E)
+    pos = jnp.cumsum(flat, axis=0) - 1.0                   # position in expert
+    slot_pos = jnp.sum(pos * flat, axis=-1)                # (k·n,)
+    keep = (slot_pos < cap) & (jnp.sum(flat, axis=-1) > 0)
+    gate_flat = gate.transpose(1, 0).reshape(k * n) * keep
+
+    # dispatch (k·n, E, C) — one-hot in both expert and capacity slot
+    cap_onehot = jax.nn.one_hot(slot_pos.astype(jnp.int32), cap,
+                                dtype=jnp.float32)
+    dispatch = (flat * keep[:, None])[:, :, None] * cap_onehot[:, None, :]
+    x_rep = jnp.tile(x, (k, 1))                            # k-major token copy
+    expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                           x_rep.astype(jnp.float32)).astype(cfg.dtype)
+    if ep_spec is not None:
+        expert_in = jax.lax.with_sharding_constraint(expert_in, ep_spec)
+
+    # per-expert SwiGLU on the MXU: batched (E, C, d) x (E, d, f)
+    g = jnp.einsum("ecd,edf->ecf", expert_in, p["w_gate"])
+    u = jnp.einsum("ecd,edf->ecf", expert_in, p["w_up"])
+    out_e = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, p["w_down"])
+    if ep_spec is not None:
+        out_e = jax.lax.with_sharding_constraint(out_e, ep_spec)
+
+    combine = dispatch * gate_flat[:, None, None]          # weights folded in
+    out = jnp.einsum("ecd,tec->td", out_e.astype(jnp.float32), combine)
+    out = out.reshape(k, n, d).sum(0).reshape(b, s, d).astype(h.dtype)
+
+    # load balance: fraction of top-1 assignments vs mean router prob
+    f_e = jnp.mean(jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32), axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f_e * p_e)
+    return out, aux
+
+
+def _mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
+         ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+    """SwiGLU MLP — dense or MoE by config. Returns (out, aux_loss)."""
+    if cfg.n_experts:
+        return _moe_mlp(h, p, cfg, ep_spec)
+    out = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
+    return out, jnp.float32(0.0)
+
+
+def _finish_block(x: jax.Array, p: Dict[str, jax.Array], o: jax.Array,
+                  cfg: ModelConfig, ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+    """Residual + MLP tail shared by the training forward and the KV-cache
+    decode path (jaxbridge/decode.py) — one definition so the two can never
+    desynchronize. Returns (x, moe_aux_loss)."""
     b, s, d = x.shape
     x = x + o.reshape(b, s, d) @ p["wo"]
     h = _rmsnorm(x, p["ln_mlp"])
-    mlp = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
-    return x + mlp
+    mlp, aux = _mlp(h, p, cfg, ep_spec)
+    return x + mlp, aux
 
 
 def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
-           attn_fn=None) -> jax.Array:
+           attn_fn=None, ep_spec=None) -> Tuple[jax.Array, jax.Array]:
     h = _rmsnorm(x, p["ln_attn"])
     # k/v stay kv_heads-sized: every impl folds the GQA group axis itself
     # (flash resolves it in its kernels' index maps; naive/ring in einsums)
     q, k, v = _qkv(h, p, cfg)
     if attn_fn is None:
         attn_fn = attention.naive_attention
-    return _finish_block(x, p, attn_fn(q, k, v))
+    return _finish_block(x, p, attn_fn(q, k, v), cfg, ep_spec)
 
 
 def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
@@ -156,7 +265,9 @@ def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
 
 
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            act_spec: Optional[Any] = None, attn_fn=None) -> jax.Array:
+            act_spec: Optional[Any] = None, attn_fn=None,
+            ep_spec: Optional[Any] = None,
+            with_aux: bool = False):
     attn_fn = _resolve_attn_fn(cfg, attn_fn)
     x = params["embed"][tokens]
     if act_spec is not None:
@@ -165,33 +276,40 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         # instead rotates K/V around the sp ring explicitly, see
         # make_sharded_train_step)
         x = jax.lax.with_sharding_constraint(x, act_spec)
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = _block(x, layer, cfg, attn_fn)
+        x, aux = _block(x, layer, cfg, attn_fn, ep_spec)
+        aux_total = aux_total + aux
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
     x = _rmsnorm(x, params["ln_f"])
-    return x @ params["out"]
+    logits = x @ params["out"]
+    return (logits, aux_total) if with_aux else logits
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            act_spec: Optional[Any] = None, attn_fn=None) -> jax.Array:
+            act_spec: Optional[Any] = None, attn_fn=None,
+            ep_spec: Optional[Any] = None) -> jax.Array:
     # run the full sequence and slice logits afterward — identical for a
     # causal model, and keeps the sequence dim evenly divisible for ring
     # attention's manual sp sharding
-    logits = forward(params, tokens, cfg, act_spec,
-                     attn_fn)[:, :-1].astype(jnp.float32)
+    logits, aux = forward(params, tokens, cfg, act_spec, attn_fn, ep_spec,
+                          with_aux=True)
+    logits = logits[:, :-1].astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
 def sgd_train_step(params: Params, tokens: jax.Array, cfg: ModelConfig,
                    lr: float = 1e-3, act_spec: Optional[Any] = None,
-                   attn_fn=None) -> Tuple[Params, jax.Array]:
+                   attn_fn=None, ep_spec: Optional[Any] = None
+                   ) -> Tuple[Params, jax.Array]:
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
                                               act_spec=act_spec,
-                                              attn_fn=attn_fn)
+                                              attn_fn=attn_fn,
+                                              ep_spec=ep_spec)
     new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                         params, grads)
     return new_params, loss
@@ -216,23 +334,42 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     """Column-parallel in (wq/wk/wv/w_gate/w_up: shard output dim over tp),
     row-parallel out (wo/w_down: shard input dim over tp ⇒ GSPMD inserts the
     tp all-reduce). With an fsdp axis, the non-tp dim of every matrix is
-    additionally sharded fsdp (ZeRO-3)."""
+    additionally sharded fsdp (ZeRO-3). MoE expert stacks shard their
+    leading E axis over ep (expert parallelism; the dispatch einsum's
+    resharding is the all_to_all)."""
     tp = "tp" if "tp" in mesh.axis_names else None
     fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    ep = "ep" if "ep" in mesh.axis_names else None
     col = P(fsdp, tp)   # (in, out) sharded (fsdp, tp)
     row = P(tp, fsdp)
     vec = P(None)
     layer = {
         "wq": col, "wk": col, "wv": col, "wo": row,
-        "w_gate": col, "w_up": col, "w_down": row,
         "ln_attn": vec, "ln_mlp": vec,
     }
+    if cfg.n_experts:
+        layer["router"] = P(None, None)
+        layer["w_gate"] = P(ep, fsdp, tp)
+        layer["w_up"] = P(ep, fsdp, tp)
+        layer["w_down"] = P(ep, tp, fsdp)
+    else:
+        layer["w_gate"] = col
+        layer["w_up"] = col
+        layer["w_down"] = row
     return {
         "embed": col,
         "out": row,
         "ln_f": vec,
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
+
+
+def moe_act_spec(cfg: ModelConfig, mesh: Mesh):
+    """NamedSharding for the (E, C, d) expert buffers — E over ep — or None
+    when the model is dense / the mesh has no ep axis."""
+    if cfg.n_experts and "ep" in mesh.axis_names:
+        return NamedSharding(mesh, P("ep", None, None))
+    return None
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
@@ -259,7 +396,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
 
     step = jax.jit(
         functools.partial(sgd_train_step, cfg=cfg, act_spec=act_spec,
-                          attn_fn=attn_fn),
+                          attn_fn=attn_fn, ep_spec=moe_act_spec(cfg, mesh)),
         in_shardings=(param_shardings, token_sharding),
         out_shardings=(param_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,))
@@ -297,10 +434,12 @@ def make_optax_train_step(mesh: Mesh, cfg: ModelConfig, tx):
             attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
     if attn_fn is None:
         attn_fn = _resolve_attn_fn(cfg)
+    ep_spec = moe_act_spec(cfg, mesh)
 
     def _step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, act_spec=act_spec, attn_fn=attn_fn)
+            params, tokens, cfg, act_spec=act_spec, attn_fn=attn_fn,
+            ep_spec=ep_spec)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

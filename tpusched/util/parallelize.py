@@ -49,20 +49,25 @@ class Parallelizer:
                     thread_name_prefix="tpusched-par")
             return self._pool
 
+    # below this many items the GIL makes pool dispatch pure overhead for
+    # Python-level work; run inline (native/numpy-heavy callers still win
+    # above it)
+    INLINE_THRESHOLD = 128
+
     def until(self, n: int, work: Callable[[int], None],
               stop: Optional[Callable[[], bool]] = None) -> None:
         if n <= 0:
             return
-        if self.workers <= 1 or n == 1:
+        if self.workers <= 1 or n < self.INLINE_THRESHOLD:
             for i in range(n):
                 if stop is not None and stop():
                     return
                 work(i)
             return
         pool = self._ensure_pool()
-        # upstream chunk sizing: ceil(n / (workers*4)), floor 1 — small
-        # enough to balance, large enough to amortize task dispatch
-        chunk = max(1, n // (self.workers * 4))
+        # upstream chunk sizing: n / (workers*4) — small enough to balance;
+        # floor 8 so task dispatch stays amortized under the GIL
+        chunk = max(8, n // (self.workers * 4))
         starts = range(0, n, chunk)
 
         def run_chunk(lo: int) -> None:
