@@ -1,0 +1,284 @@
+"""Direct unit tables for quota-aware victim selection and preemptor
+eligibility — the reference's TestSelectVictimsOnNode /
+TestPodEligibleToPreemptOthers style suites
+(/root/reference/pkg/capacityscheduling/capacity_scheduling_test.go),
+driving _Preemptor against fabricated snapshot + cycle state rather than
+the full scheduler loop (tests/test_capacity.py covers the e2e paths)."""
+import time
+
+from tpusched.api.core import PodDisruptionBudget, PriorityClass
+from tpusched.api.meta import ObjectMeta
+from tpusched.api.resources import TPU
+from tpusched.apiserver import APIServer
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import capacity_profile
+from tpusched.fwk import CycleState
+from tpusched.fwk.status import UNSCHEDULABLE_AND_UNRESOLVABLE
+from tpusched.plugins.capacity.plugin import _Preemptor
+from tpusched.testing import make_elastic_quota, make_pod, make_tpu_node
+from tpusched.testing.harness import new_test_framework
+
+
+def build(quotas, running, preemptor, chips=8, priority_classes=()):
+    """Framework + populated cycle state for one 8-chip node. EQs are created
+    before pods so informer replay accounts existing usage (the same create
+    order the controllers guarantee in production)."""
+    api = APIServer()
+    for eq in quotas:
+        api.create(srv.ELASTIC_QUOTAS, eq)
+    for pc in priority_classes:
+        api.create(srv.PRIORITY_CLASSES, pc)
+    for p in running:
+        p.spec.node_name = "h0"
+    node = make_tpu_node("h0", chips=chips)
+    fw, handle, _ = new_test_framework(capacity_profile(), nodes=[node],
+                                       pods=running, api=api)
+    state = CycleState()
+    fw.run_pre_filter_plugins(state, preemptor)  # snapshot written either way
+    return fw, handle, state
+
+
+def select_victims(quotas, running, preemptor, chips=8, pdbs=()):
+    fw, handle, state = build(quotas, running, preemptor, chips)
+    ni = handle.snapshot_shared_lister().get("h0").clone()
+    return _Preemptor(handle, state).select_victims_on_node(
+        state, preemptor, ni, list(pdbs))
+
+
+def names(pods):
+    return sorted(p.name for p in pods)
+
+
+# -- select_victims_on_node ---------------------------------------------------
+
+def test_over_min_evicts_lowest_priority_same_quota_only():
+    """Preemptor beyond its own min reclaims inside its quota, lowest
+    priority first, and the reprieve loop keeps the minimal victim set
+    (capacity_scheduling.go:526-538 + reprieve :597-642)."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8})]
+    running = [make_pod("low", "team-a", limits={TPU: 4}, priority=1),
+               make_pod("mid", "team-a", limits={TPU: 4}, priority=5)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=10)
+    victims, n_pdb, status = select_victims(quotas, running, preemptor)
+    assert status.is_success()
+    # min=8: after evicting only `low`, used(4)+req(4) == Σmin → `mid` is
+    # reprieved; exactly the lowest-priority pod pays
+    assert names(victims) == ["low"]
+    assert n_pdb == 0
+
+
+def test_over_min_never_touches_other_quotas():
+    """Same-quota reclaim must not consider another team's pods even when
+    they are the only occupants."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 2}),
+              make_elastic_quota("qb", "team-b", min={TPU: 2})]
+    running = [make_pod("b0", "team-b", limits={TPU: 4}, priority=0),
+               make_pod("b1", "team-b", limits={TPU: 4}, priority=0)]
+    # a already over min via the preemptor's own request
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=10)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert victims == []
+    assert status.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+    assert "No victims" in status.message()
+
+
+def test_within_min_evicts_borrowers_cross_quota():
+    """Preemptor within its guarantee evicts borrowers — other quotas over
+    min — regardless of victim priority (capacity_scheduling.go:539-553);
+    the reprieve pass then restores the most important candidates first."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8}),
+              make_elastic_quota("qb", "team-b", min={TPU: 0})]
+    running = [make_pod("b-hi", "team-b", limits={TPU: 4}, priority=100),
+               make_pod("b-lo", "team-b", limits={TPU: 4}, priority=1)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=0)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert status.is_success()
+    # min=0 keeps team-b over min throughout collection, so BOTH borrowers
+    # are candidates; reprieve keeps the higher-priority one
+    assert names(victims) == ["b-lo"]
+
+
+def test_borrower_collection_stops_at_min():
+    """Candidate collection mutates the quota snapshot as it removes pods
+    (the Add/RemovePod extensions), so once evictions bring a quota down to
+    its min, its remaining pods are spared — candidate choice follows pod
+    order on the node, not priority. Faithful to the reference's sequential
+    dry-run (capacity_scheduling.go:539-553 + :283-318)."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8}),
+              make_elastic_quota("qb", "team-b", min={TPU: 4})]
+    running = [make_pod("b-first", "team-b", limits={TPU: 4}, priority=100),
+               make_pod("b-second", "team-b", limits={TPU: 4}, priority=1)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=0)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert status.is_success()
+    # removing b-first drops team-b to min=4 ⇒ b-second never becomes a
+    # candidate, and b-first cannot be reprieved (b-second still holds chips)
+    assert names(victims) == ["b-first"]
+
+
+def test_within_min_spares_quotas_at_or_under_min():
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8}),
+              make_elastic_quota("qb", "team-b", min={TPU: 16})]
+    running = [make_pod("b0", "team-b", limits={TPU: 4}, priority=0),
+               make_pod("b1", "team-b", limits={TPU: 4}, priority=0)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert victims == []
+    assert status.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+def test_no_quota_namespace_ignores_quota_pods():
+    """A preemptor outside any ElasticQuota falls back to plain priority
+    preemption, but only over pods that are also outside every quota
+    (capacity_scheduling.go:555-575)."""
+    quotas = [make_elastic_quota("qb", "team-b", min={TPU: 1})]
+    running = [make_pod("free-lo", "wild", limits={TPU: 4}, priority=1),
+               make_pod("b0", "team-b", limits={TPU: 4}, priority=0)]
+    preemptor = make_pod("pree", "wild2", limits={TPU: 4}, priority=10)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert status.is_success()
+    assert names(victims) == ["free-lo"]  # team-b pod untouchable here
+
+
+def test_no_quota_namespace_requires_lower_priority():
+    quotas = []
+    running = [make_pod("peer", "wild", limits={TPU: 4}, priority=10)]
+    preemptor = make_pod("pree", "wild2", limits={TPU: 4}, priority=10)
+    victims, _, status = select_victims(quotas, running, preemptor, chips=4)
+    assert victims == []
+    assert status.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+def test_preemptor_over_quota_max_rejected_despite_victims():
+    """Even with a feasible victim set, admission that would break the
+    preemptor's own Max is refused (capacity_scheduling.go:577-594)."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 2}, max={TPU: 6})]
+    running = [make_pod("low", "team-a", limits={TPU: 4}, priority=1)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 8}, priority=10)
+    victims, _, status = select_victims(quotas, running, preemptor)
+    assert victims == []
+    assert status.is_unschedulable()
+    assert "max" in status.message().lower()
+
+
+def test_pdb_violations_counted_and_minimized():
+    """PDB-covered candidates are tried first so reprieve minimizes
+    violations; survivors of a zero-budget PDB still count when evicted
+    (filterPodsWithPDBViolation, capacity_scheduling.go:857-902)."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8}),
+              make_elastic_quota("qb", "team-b", min={TPU: 0})]
+    running = [make_pod("b-hi", "team-b", limits={TPU: 4}, priority=100,
+                        labels={"app": "b"}),
+               make_pod("b-lo", "team-b", limits={TPU: 4}, priority=1,
+                        labels={"app": "b"})]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=0)
+    pdb = PodDisruptionBudget(
+        meta=ObjectMeta(name="protect-b", namespace="team-b"),
+        selector={"app": "b"}, disruptions_allowed=0)
+    victims, n_pdb, status = select_victims(quotas, running, preemptor,
+                                            pdbs=[pdb])
+    assert status.is_success()
+    assert names(victims) == ["b-lo"]
+    assert n_pdb == 1
+
+
+def test_aggregate_min_gate_limits_reprieve():
+    """quota_broken: a reprieve that would push aggregate used past Σmin is
+    rolled back even when chips physically fit."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 4})]
+    running = [make_pod("low", "team-a", limits={TPU: 4}, priority=1),
+               make_pod("mid", "team-a", limits={TPU: 4}, priority=5)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=10)
+    # Σmin = 4: with the preemptor admitted (4), NO running pod can stay
+    # under the aggregate gate although the node has 8 chips
+    victims, _, status = select_victims(quotas, running, preemptor, chips=16)
+    assert status.is_success()
+    assert names(victims) == ["low", "mid"]
+
+
+def test_victims_must_leave_room_for_fit():
+    """Candidate set feasible quota-wise but the node still can't fit the
+    preemptor after all evictions → filter failure surfaces as the status."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 1})]
+    running = [make_pod("low", "team-a", limits={TPU: 2}, priority=1)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 16}, priority=10)
+    victims, _, status = select_victims(quotas, running, preemptor, chips=8)
+    assert victims == []
+    assert not status.is_success()
+
+
+# -- pod_eligible_to_preempt_others ------------------------------------------
+
+def eligible(quotas, running, preemptor, priority_classes=(),
+             nominated_status=None):
+    fw, handle, state = build(quotas, running, preemptor,
+                              priority_classes=priority_classes)
+    return _Preemptor(handle, state).pod_eligible_to_preempt_others(
+        preemptor, nominated_status)
+
+
+def terminating(pod):
+    pod.meta.deletion_timestamp = time.time()
+    return pod
+
+
+def test_preempt_never_policy_blocks_preemption():
+    pc = PriorityClass(meta=ObjectMeta(name="no-preempt"), value=100,
+                       preemption_policy="Never")
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100,
+                         priority_class_name="no-preempt")
+    assert not eligible([], [], preemptor, priority_classes=[pc])
+
+
+def test_eligible_without_nomination():
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    assert eligible([], [], preemptor)
+
+
+def test_eligible_when_nominated_node_became_unresolvable():
+    from tpusched.fwk import Status
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    preemptor.status.nominated_node_name = "h0"
+    assert eligible([], [], preemptor,
+                    nominated_status=Status.unresolvable("gone"))
+
+
+def test_waits_for_terminating_same_quota_victim():
+    """A lower-priority same-quota pod already terminating on the nominated
+    node is about to release quota — don't preempt again, wait
+    (capacity_scheduling.go:427-460)."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8})]
+    running = [terminating(make_pod("dying", "team-a", limits={TPU: 4},
+                                    priority=1))]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    preemptor.status.nominated_node_name = "h0"
+    assert not eligible(quotas, running, preemptor)
+
+
+def test_waits_for_terminating_borrower():
+    """Preemptor within min + terminating pod of an over-min quota on the
+    nominated node: the borrower's exit will satisfy the guarantee."""
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8}),
+              make_elastic_quota("qb", "team-b", min={TPU: 1})]
+    running = [terminating(make_pod("borrower", "team-b", limits={TPU: 4},
+                                    priority=200))]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=0)
+    preemptor.status.nominated_node_name = "h0"
+    assert not eligible(quotas, running, preemptor)
+
+
+def test_eligible_when_terminating_pod_is_higher_priority_same_quota():
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8})]
+    running = [terminating(make_pod("dying", "team-a", limits={TPU: 4},
+                                    priority=200))]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    preemptor.status.nominated_node_name = "h0"
+    assert eligible(quotas, running, preemptor)
+
+
+def test_eligible_no_terminating_pods_on_nominated_node():
+    quotas = [make_elastic_quota("qa", "team-a", min={TPU: 8})]
+    running = [make_pod("healthy", "team-a", limits={TPU: 4}, priority=1)]
+    preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
+    preemptor.status.nominated_node_name = "h0"
+    assert eligible(quotas, running, preemptor)

@@ -238,3 +238,80 @@ def test_percentage_of_nodes_to_score_rejects_out_of_range():
             - schedulerName: tpusched
               percentageOfNodesToScore: 150
         """))
+
+
+# -- per-plugin args decode + defaults tables ---------------------------------
+# (the reference's defaults_test.go sweep, v1beta3/defaults.go:29-160)
+
+def _decode_args(plugin, args_yaml=""):
+    cfg = v.loads(textwrap.dedent(f"""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+          pluginConfig:
+          - name: {plugin}
+            args: {{{args_yaml}}}
+    """))
+    return cfg.profile().plugin_args[plugin]
+
+
+@pytest.mark.parametrize("plugin,expected_defaults", [
+    ("TpuSlice", {"score_mode": "binpack"}),
+    ("Coscheduling", {"permit_waiting_time_seconds": 60,
+                      "denied_pg_expiration_time_seconds": 20}),
+    ("TopologyMatch", {"scoring_strategy": "LeastAllocated",
+                       "resource_weights": {"google.com/tpu": 1}}),
+    ("MultiSlice", {"same_domain_score": 100, "adjacent_domain_score": 50}),
+    ("NodeResourcesAllocatable", {"mode": "Least",
+                                  "resources": [{"name": "cpu", "weight": 1 << 20},
+                                                {"name": "memory", "weight": 1}]}),
+    ("TargetLoadPacking", {"target_utilization": 40,
+                           "default_requests_cpu_millis": 1000,
+                           "default_requests_multiplier": 1.5,
+                           "watcher_address": "",
+                           "metrics_refresh_interval_seconds": 30}),
+    ("LoadVariationRiskBalancing", {"safe_variance_margin": 1.0,
+                                    "safe_variance_sensitivity": 1.0,
+                                    "watcher_address": "",
+                                    "metrics_refresh_interval_seconds": 30}),
+    ("PreemptionToleration", {"min_candidate_nodes_percentage": 10,
+                              "min_candidate_nodes_absolute": 100}),
+    ("CapacityScheduling", {}),
+])
+def test_empty_args_yield_reference_defaults(plugin, expected_defaults):
+    args = _decode_args(plugin)
+    for field_name, want in expected_defaults.items():
+        assert getattr(args, field_name) == want, (plugin, field_name)
+
+
+@pytest.mark.parametrize("plugin,args_yaml,field_name,want", [
+    ("TpuSlice", "scoreMode: spread", "score_mode", "spread"),
+    ("TopologyMatch", "scoringStrategy: BalancedAllocation",
+     "scoring_strategy", "BalancedAllocation"),
+    ("MultiSlice", "sameDomainScore: 7", "same_domain_score", 7),
+    ("NodeResourcesAllocatable", "mode: Most", "mode", "Most"),
+    ("TargetLoadPacking", "targetUtilization: 70", "target_utilization", 70),
+    ("TargetLoadPacking", "defaultRequestsMultiplier: 2.0",
+     "default_requests_multiplier", 2.0),
+    ("LoadVariationRiskBalancing", "safeVarianceSensitivity: 2.5",
+     "safe_variance_sensitivity", 2.5),
+    ("PreemptionToleration", "minCandidateNodesAbsolute: 5",
+     "min_candidate_nodes_absolute", 5),
+])
+def test_camel_case_field_decode_table(plugin, args_yaml, field_name, want):
+    assert getattr(_decode_args(plugin, args_yaml), field_name) == want
+
+
+@pytest.mark.parametrize("plugin", sorted(
+    __import__("tpusched.config.scheme", fromlist=["ARGS_SCHEME"]).ARGS_SCHEME))
+def test_unknown_field_rejected_for_every_plugin(plugin):
+    with pytest.raises(ConfigError, match="unknown field"):
+        _decode_args(plugin, "bogusKnob: 1")
+
+
+def test_partial_args_keep_other_defaults():
+    args = _decode_args("TargetLoadPacking", "targetUtilization: 55")
+    assert args.target_utilization == 55
+    assert args.default_requests_multiplier == 1.5     # untouched default
+    assert args.metrics_refresh_interval_seconds == 30

@@ -1,0 +1,234 @@
+"""Direct unit tables for the Coscheduling plugin and PodGroupManager —
+queue-sort ordering, PreFilter gating, Permit verdicts, wait-time
+precedence. The reference's table style in
+/root/reference/pkg/coscheduling/coscheduling_test.go (TestLess,
+TestPermit, TestPostFilter) and pkg/coscheduling/core/core_test.go
+(TestPreFilter); e2e gang behavior lives in tests/test_coscheduling.py."""
+import time
+
+from tpusched.api.resources import CPU, TPU
+from tpusched.api.scheduling import MIN_AVAILABLE_LABEL
+from tpusched.apiserver import APIServer
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.fwk import CycleState, PODS_TO_ACTIVATE_KEY, PodsToActivate
+from tpusched.plugins.coscheduling.core import (POD_GROUP_NOT_FOUND,
+                                                POD_GROUP_NOT_SPECIFIED,
+                                                SUCCESS, WAIT,
+                                                get_wait_time_duration)
+from tpusched.sched.queue import QueuedPodInfo
+from tpusched.testing import make_pod, make_pod_group, make_tpu_node
+from tpusched.testing.harness import new_test_framework
+
+
+def gang_framework(pod_groups=(), pods=(), nodes=(), permit_wait_s=60,
+                   denied_s=20):
+    api = APIServer()
+    for pg in pod_groups:
+        api.create(srv.POD_GROUPS, pg)
+    fw, handle, api = new_test_framework(
+        tpu_gang_profile(permit_wait_s=permit_wait_s, denied_s=denied_s),
+        nodes=nodes, pods=pods, api=api)
+    return fw, fw.plugins["Coscheduling"], handle, api
+
+
+def qpi(pod, ts):
+    info = QueuedPodInfo(pod, clock=lambda: ts)
+    return info
+
+
+# -- QueueSort Less (coscheduling.go:112-124) --------------------------------
+
+def test_less_priority_wins_over_everything():
+    fw, cs, _, _ = gang_framework()
+    hi = qpi(make_pod("hi", priority=10), ts=200.0)
+    lo = qpi(make_pod("lo", priority=1), ts=100.0)  # older, still loses
+    assert cs.less(hi, lo)
+    assert not cs.less(lo, hi)
+
+
+def test_less_group_creation_time_breaks_priority_tie():
+    old_pg = make_pod_group("old-gang", min_member=2)
+    old_pg.meta.creation_timestamp = 100.0
+    new_pg = make_pod_group("new-gang", min_member=2)
+    new_pg.meta.creation_timestamp = 200.0
+    fw, cs, _, _ = gang_framework(pod_groups=[old_pg, new_pg])
+    # pod of the OLDER group sorts first even if the pod itself enqueued later
+    a = qpi(make_pod("a", pod_group="old-gang"), ts=500.0)
+    b = qpi(make_pod("b", pod_group="new-gang"), ts=50.0)
+    assert cs.less(a, b)
+    assert not cs.less(b, a)
+
+
+def test_less_groupless_pod_uses_initial_attempt_time():
+    fw, cs, _, _ = gang_framework()
+    early = qpi(make_pod("early"), ts=10.0)
+    late = qpi(make_pod("late"), ts=20.0)
+    assert cs.less(early, late)
+    assert not cs.less(late, early)
+
+
+def test_less_same_group_members_tie_break_by_key():
+    pg = make_pod_group("gang", min_member=2)
+    pg.meta.creation_timestamp = 100.0
+    fw, cs, _, _ = gang_framework(pod_groups=[pg])
+    # same group ⇒ same timestamp ⇒ name decides: gang drains contiguously
+    a = qpi(make_pod("a", pod_group="gang"), ts=500.0)
+    b = qpi(make_pod("b", pod_group="gang"), ts=50.0)
+    assert cs.less(a, b)
+    assert not cs.less(b, a)
+
+
+def test_less_mixed_gang_vs_groupless_compares_timestamps():
+    pg = make_pod_group("gang", min_member=2)
+    pg.meta.creation_timestamp = 100.0
+    fw, cs, _, _ = gang_framework(pod_groups=[pg])
+    member = qpi(make_pod("m", pod_group="gang"), ts=999.0)  # PG ts 100 rules
+    loner_older = qpi(make_pod("loner-old"), ts=50.0)
+    loner_newer = qpi(make_pod("loner-new"), ts=150.0)
+    assert cs.less(loner_older, member)
+    assert cs.less(member, loner_newer)
+
+
+# -- PreFilter gating (core.go:149-196) --------------------------------------
+
+def test_pre_filter_groupless_pod_passes():
+    fw, cs, _, _ = gang_framework()
+    assert cs.pre_filter(CycleState(), make_pod("solo")).is_success()
+
+
+def test_pre_filter_rejects_below_min_member():
+    pg = make_pod_group("gang", min_member=3)
+    fw, cs, _, api = gang_framework(pod_groups=[pg])
+    members = [make_pod(f"m{i}", pod_group="gang") for i in range(2)]
+    for m in members:
+        api.create(srv.PODS, m)
+    st = cs.pre_filter(CycleState(), members[0])
+    assert st.is_unschedulable()
+    assert "cannot find enough sibling pods" in st.message()
+
+
+def test_pre_filter_denied_group_fast_fails_until_ttl():
+    pg = make_pod_group("gang", min_member=1)
+    fw, cs, _, api = gang_framework(pod_groups=[pg], denied_s=1)
+    pod = make_pod("m0", pod_group="gang")
+    api.create(srv.PODS, pod)
+    assert cs.pre_filter(CycleState(), pod).is_success()
+    cs.pg_mgr.add_denied_pod_group("default/gang")
+    st = cs.pre_filter(CycleState(), pod)
+    assert st.is_unschedulable()
+    assert "denied-PodGroup expiration window" in st.message()
+    time.sleep(1.1)  # TTL expiry reopens the gate
+    assert cs.pre_filter(CycleState(), pod).is_success()
+
+
+def test_pre_filter_min_resources_cluster_dry_run():
+    """MinResources gate subtracts other pods' usage but ignores the group's
+    own members (getNodeResource, core.go:349-382)."""
+    pg = make_pod_group("gang", min_member=2, min_resources={TPU: 8})
+    nodes = [make_tpu_node("h0", chips=4), make_tpu_node("h1", chips=4)]
+    fw, cs, _, api = gang_framework(pod_groups=[pg], nodes=nodes)
+    members = [make_pod(f"m{i}", pod_group="gang", limits={TPU: 4})
+               for i in range(2)]
+    for m in members:
+        api.create(srv.PODS, m)
+    assert cs.pre_filter(CycleState(), members[0]).is_success()
+
+
+def test_pre_filter_min_resources_shortfall_denies_group():
+    pg = make_pod_group("gang", min_member=2, min_resources={TPU: 16})
+    nodes = [make_tpu_node("h0", chips=4), make_tpu_node("h1", chips=4)]
+    fw, cs, _, api = gang_framework(pod_groups=[pg], nodes=nodes)
+    members = [make_pod(f"m{i}", pod_group="gang", limits={TPU: 8})
+               for i in range(2)]
+    for m in members:
+        api.create(srv.PODS, m)
+    st = cs.pre_filter(CycleState(), members[0])
+    assert st.is_unschedulable()
+    # shortfall also primes the denied cache: the sibling fast-fails
+    st2 = cs.pre_filter(CycleState(), members[1])
+    assert "denied-PodGroup expiration window" in st2.message()
+
+
+def test_pre_filter_permitted_group_memoizes_dry_run():
+    """Once the capacity dry-run passes, the group is 'permitted' for the
+    schedule timeout and the dry-run is skipped — capacity consumed by the
+    gang's own landing members must not flip the gate mid-admission
+    (core.go:168-170)."""
+    pg = make_pod_group("gang", min_member=2, min_resources={TPU: 8})
+    nodes = [make_tpu_node("h0", chips=4), make_tpu_node("h1", chips=4)]
+    fw, cs, handle, api = gang_framework(pod_groups=[pg], nodes=nodes)
+    members = [make_pod(f"m{i}", pod_group="gang", limits={TPU: 4})
+               for i in range(2)]
+    for m in members:
+        api.create(srv.PODS, m)
+    assert cs.pre_filter(CycleState(), members[0]).is_success()
+    # an unrelated pod eats the whole cluster in the snapshot
+    hog = make_pod("hog", namespace="other", limits={TPU: 8}, node_name="h0")
+    from tpusched.fwk import Snapshot
+    handle.set_snapshot(Snapshot(nodes=nodes, pods=[hog]))
+    # memoized: sibling still passes without re-running the dry-run
+    assert cs.pre_filter(CycleState(), members[1]).is_success()
+    cs.pg_mgr.delete_permitted_pod_group("default/gang")
+    assert cs.pre_filter(CycleState(), members[1]).is_unschedulable()
+
+
+# -- Permit verdicts (core.go:199-216) ---------------------------------------
+
+def test_permit_verdict_table():
+    pg = make_pod_group("gang", min_member=2)
+    node = make_tpu_node("h0", chips=8)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg], nodes=[node])
+    mgr = cs.pg_mgr
+
+    assert mgr.permit(make_pod("solo")) == POD_GROUP_NOT_SPECIFIED
+    # label names a group with no CR and no min-available ⇒ not found
+    orphan = make_pod("orphan", pod_group="ghost")
+    assert mgr.permit(orphan) == POD_GROUP_NOT_FOUND
+
+    member = make_pod("m0", pod_group="gang")
+    assert mgr.permit(member) == WAIT  # 0 assigned + 1 < 2
+
+    # one sibling assumed onto a node ⇒ assigned(1) + 1 ≥ 2
+    from tpusched.fwk import Snapshot
+    bound = make_pod("m1", pod_group="gang", node_name="h0")
+    handle.set_snapshot(Snapshot(nodes=[node], pods=[bound]))
+    assert mgr.permit(member) == SUCCESS
+
+
+def test_permit_synthesized_group_reaches_quorum():
+    """KEP-2 lightweight gang: min-available label alone drives the quorum."""
+    node = make_tpu_node("h0", chips=8)
+    fw, cs, handle, api = gang_framework(nodes=[node])
+    labels = {MIN_AVAILABLE_LABEL: "2"}
+    member = make_pod("m0", pod_group="lite", labels=labels)
+    assert cs.pg_mgr.permit(member) == WAIT
+    from tpusched.fwk import Snapshot
+    bound = make_pod("m1", pod_group="lite", labels=labels, node_name="h0")
+    handle.set_snapshot(Snapshot(nodes=[node], pods=[bound]))
+    assert cs.pg_mgr.permit(member) == SUCCESS
+
+
+def test_activate_siblings_stashes_other_members():
+    pg = make_pod_group("gang", min_member=3)
+    fw, cs, _, api = gang_framework(pod_groups=[pg])
+    members = [make_pod(f"m{i}", pod_group="gang") for i in range(3)]
+    for m in members:
+        api.create(srv.PODS, m)
+    state = CycleState()
+    stash = PodsToActivate()
+    state.write(PODS_TO_ACTIVATE_KEY, stash)
+    cs.pg_mgr.activate_siblings(members[0], state)
+    assert sorted(stash.map) == ["default/m1", "default/m2"]
+
+
+# -- wait-time precedence (util/podgroup.go:53-76) ----------------------------
+
+def test_wait_time_precedence():
+    pg = make_pod_group("g", schedule_timeout_seconds=10)
+    assert get_wait_time_duration(pg, 40.0) == 10.0       # PG.spec first
+    pg_unset = make_pod_group("g2")
+    assert get_wait_time_duration(pg_unset, 40.0) == 40.0  # then plugin arg
+    assert get_wait_time_duration(None, 40.0) == 40.0
+    assert get_wait_time_duration(pg_unset, 0.0) == 60.0   # then 60s default
+    assert get_wait_time_duration(None, 0.0) == 60.0

@@ -140,6 +140,106 @@ def test_preemption_toleration_integration():
         assert c.pod(plain.key) is None           # evicted
 
 
+def test_parse_policy_edge_cases():
+    # malformed toleration string invalidates the whole policy
+    assert parse_policy(make_pc("bad-tol", 1, minimum=10, toleration="soon")) is None
+    # explicit minimum respected verbatim, even below pc.value
+    p = parse_policy(make_pc("low-min", 1000, minimum=5))
+    assert p.minimum_preemptable_priority == 5
+    # toleration alone keeps the minimum default of value+1
+    p2 = parse_policy(make_pc("tol-only", 7, toleration=60))
+    assert (p2.minimum_preemptable_priority, p2.toleration_seconds) == (8, 60)
+
+
+def test_exempted_without_priority_class_or_schedule_condition():
+    pc = make_pc("tolerant", 100, minimum=10000, toleration=3600)
+    preemptor = make_pod("p", priority=500)
+    # no priority class on the victim → never exempt
+    bare = make_pod("bare", priority=100)
+    assert not exempted_from_preemption(bare, preemptor, lambda n: pc)
+    # priority class that the getter can't resolve → not exempt
+    ghost = make_pod("ghost", priority=100, priority_class_name="gone")
+    assert not exempted_from_preemption(ghost, preemptor, lambda n: None)
+    # victim not yet scheduled (no PodScheduled condition) → tolerate
+    pending = make_pod("pending", priority=100, priority_class_name="tolerant")
+    assert exempted_from_preemption(pending, preemptor, lambda n: pc,
+                                    now=10**9)
+
+
+def select_pt_victims(priority_classes, running, preemptor, chips=4):
+    """Drive PreemptionToleration._Interface.select_victims_on_node directly
+    (preemption_toleration.go:182-283 table style)."""
+    from tpusched.fwk.status import UNSCHEDULABLE_AND_UNRESOLVABLE  # noqa: F401
+    from tpusched.plugins.preemptiontoleration import _Interface
+    from tpusched.apiserver import APIServer
+    api = APIServer()
+    for pc in priority_classes:
+        api.create(srv.PRIORITY_CLASSES, pc)
+    for p in running:
+        p.spec.node_name = "h0"
+    node = make_tpu_node("h0", chips=chips)
+    fw, handle, _ = new_test_framework(pt_profile(), nodes=[node],
+                                       pods=running, api=api)
+    iface = _Interface(handle, lambda name: handle.informer_factory
+                       .priorityclasses().get("/" + name))
+    ni = handle.snapshot_shared_lister().get("h0").clone()
+    return iface.select_victims_on_node(CycleState(), preemptor, ni, [])
+
+
+def test_pt_select_victims_exemption_filter():
+    """The exemption filter removes tolerated pods from candidacy entirely;
+    remaining lower-priority pods are selected and reprieved minimally."""
+    pcs = [make_pc("tolerant", 100, minimum=10000, toleration=-1)]
+    running = [
+        make_pod("protected", limits={TPU: 2}, priority=100,
+                 priority_class_name="tolerant"),
+        make_pod("plain-lo", limits={TPU: 1}, priority=1),
+        make_pod("plain-mid", limits={TPU: 1}, priority=50),
+    ]
+    preemptor = make_pod("pree", limits={TPU: 1}, priority=500)
+    victims, n_pdb, status = select_pt_victims(pcs, running, preemptor)
+    assert status.is_success()
+    # one chip suffices: reprieve keeps plain-mid, evicts only plain-lo
+    assert [v.name for v in victims] == ["plain-lo"]
+    assert n_pdb == 0
+
+
+def test_pt_select_victims_all_exempt_unresolvable():
+    pcs = [make_pc("tolerant", 100, minimum=10000, toleration=-1)]
+    running = [make_pod(f"prot-{i}", limits={TPU: 2}, priority=100,
+                        priority_class_name="tolerant") for i in range(2)]
+    preemptor = make_pod("pree", limits={TPU: 2}, priority=500)
+    victims, _, status = select_pt_victims(pcs, running, preemptor)
+    assert victims == []
+    from tpusched.fwk.status import UNSCHEDULABLE_AND_UNRESOLVABLE
+    assert status.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+def test_pt_select_victims_expired_window_preemptable():
+    """Once the toleration window lapses, the same pod becomes a victim."""
+    from tpusched.api.core import PodCondition
+    pcs = [make_pc("brief", 100, minimum=10000, toleration=1)]
+    victim = make_pod("was-protected", limits={TPU: 2}, priority=100,
+                      priority_class_name="brief")
+    victim.status.conditions.append(PodCondition(
+        type="PodScheduled", status="True",
+        last_transition_time=time.time() - 3600))
+    preemptor = make_pod("pree", limits={TPU: 4}, priority=500)
+    victims, _, status = select_pt_victims(pcs, [victim], preemptor)
+    assert status.is_success()
+    assert [v.name for v in victims] == ["was-protected"]
+
+
+def test_pt_select_victims_preemptor_above_minimum_ignores_exemption():
+    pcs = [make_pc("tolerant", 100, minimum=400, toleration=-1)]
+    running = [make_pod("protected", limits={TPU: 4}, priority=100,
+                        priority_class_name="tolerant")]
+    preemptor = make_pod("pree", limits={TPU: 4}, priority=500)  # ≥ minimum
+    victims, _, status = select_pt_victims(pcs, running, preemptor)
+    assert status.is_success()
+    assert [v.name for v in victims] == ["protected"]
+
+
 # -- CrossNodePreemption ------------------------------------------------------
 
 def cnp_profile():

@@ -1,0 +1,258 @@
+"""Table-driven Trimaran scoring math — the reference's analysis_test.go
+(322 LoC) + targetloadpacking_test.go score tables at full depth. Basic
+curve/e2e coverage lives in tests/test_trimaran.py."""
+import pytest
+
+from tpusched.api.core import Container
+from tpusched.api.resources import CPU, MEMORY, TPU, make_resources
+from tpusched.config.types import (LoadVariationRiskBalancingArgs,
+                                   TargetLoadPackingArgs)
+from tpusched.fwk import CycleState, PluginProfile
+from tpusched.plugins.trimaran import (AVERAGE, CPU_TYPE, LATEST, MEMORY_TYPE,
+                                       Metric, STD, LoadVariationRiskBalancing,
+                                       TargetLoadPacking)
+from tpusched.plugins.trimaran.loadvariationriskbalancing import (
+    ResourceStats, create_resource_stats)
+from tpusched.plugins.trimaran.watcher import TPU_TYPE, get_resource_data
+from tpusched.testing import make_node, make_pod, make_tpu_node, new_test_framework
+from tests.test_trimaran import make_handle, metrics_for
+
+
+# -- ResourceStats.compute_score (analysis.go:48-78) --------------------------
+
+@pytest.mark.parametrize(
+    "used_avg,used_stdev,req,capacity,margin,sensitivity,expected",
+    [
+        # id: nominal — risk = (0.5 + 0.1)/2 = 0.3
+        (50.0, 10.0, 0.0, 100.0, 1.0, 1.0, 70),
+        # idle node, no variance → perfect score
+        (0.0, 0.0, 0.0, 100.0, 1.0, 1.0, 100),
+        # fully loaded, fully variable → worst score
+        (100.0, 100.0, 0.0, 100.0, 1.0, 1.0, 0),
+        # invalid capacity → score 0 (guard, analysis.go:49-52)
+        (50.0, 10.0, 0.0, 0.0, 1.0, 1.0, 0),
+        (50.0, 10.0, 0.0, -5.0, 1.0, 1.0, 0),
+        # request pushes mu past 1 → clamped: (1 + 0)/2 = 0.5
+        (80.0, 0.0, 50.0, 100.0, 1.0, 1.0, 50),
+        # negative request treated as 0
+        (50.0, 0.0, -10.0, 100.0, 1.0, 1.0, 75),
+        # measured average above capacity clamps to capacity
+        (150.0, 0.0, 0.0, 100.0, 1.0, 1.0, 50),
+        # stdev above capacity clamps to capacity → sigma 1
+        (0.0, 150.0, 0.0, 100.0, 1.0, 1.0, 50),
+        # margin scales sigma: risk = (0.4 + 2*0.2)/2 = 0.4
+        (40.0, 20.0, 0.0, 100.0, 2.0, 1.0, 60),
+        # margin product clamps at 1: (0 + min(2*0.8,1))/2 = 0.5
+        (0.0, 80.0, 0.0, 100.0, 2.0, 1.0, 50),
+        # sensitivity 2 → sigma^(1/2): (0 + sqrt(0.25))/2 = 0.25
+        (0.0, 25.0, 0.0, 100.0, 1.0, 2.0, 75),
+        # sensitivity 0.5 → sigma^2 amplifies: (0 + 0.25)/2
+        (0.0, 50.0, 0.0, 100.0, 1.0, 0.5, round((1 - 0.125) * 100)),
+        # Go pow(+Inf) edge at sensitivity 0: sigma<1 → 0 (analysis.go quirk)
+        (40.0, 20.0, 0.0, 100.0, 1.0, 0.0, 80),
+        # ...but sigma == 1 stays 1
+        (0.0, 100.0, 0.0, 100.0, 1.0, 0.0, 50),
+    ])
+def test_lvrb_compute_score_table(used_avg, used_stdev, req, capacity,
+                                  margin, sensitivity, expected):
+    rs = ResourceStats(used_avg=used_avg, used_stdev=used_stdev, req=req,
+                       capacity=capacity)
+    assert round(rs.compute_score(margin, sensitivity)) == expected
+
+
+def test_create_resource_stats_memory_converts_to_mb():
+    """Memory stats operate in MB (analysis.go:81-131): a 1Gi node at 50%
+    average yields used_avg 512 MB against a 1024 MB capacity."""
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="1Gi"))
+    metrics = [Metric(type=MEMORY_TYPE, operator=AVERAGE, value=50.0),
+               Metric(type=MEMORY_TYPE, operator=STD, value=10.0)]
+    rs, ok = create_resource_stats(metrics, node, {MEMORY: 256 * 1024 * 1024},
+                                   MEMORY, MEMORY_TYPE)
+    assert ok
+    assert rs.capacity == 1024.0
+    assert rs.used_avg == 512.0
+    assert rs.used_stdev == pytest.approx(102.4)
+    assert rs.req == 256.0
+
+
+def test_create_resource_stats_absent_type_not_found():
+    node = make_node("n1")
+    metrics = [Metric(type=CPU_TYPE, operator=AVERAGE, value=50.0)]
+    rs, ok = create_resource_stats(metrics, node, {}, MEMORY, MEMORY_TYPE)
+    assert not ok and rs is None
+
+
+@pytest.mark.parametrize("metrics,want_avg,want_std,want_found", [
+    # Average + Std, plus noise of another type
+    ([Metric(type=CPU_TYPE, operator=AVERAGE, value=40.0),
+      Metric(type=CPU_TYPE, operator=STD, value=10.0),
+      Metric(type=MEMORY_TYPE, operator=AVERAGE, value=99.0)], 40.0, 10.0, True),
+    # Latest stands in for Average when no Average present
+    ([Metric(type=CPU_TYPE, operator=LATEST, value=30.0)], 30.0, 0.0, True),
+    # ...but a real Average wins over Latest regardless of order
+    ([Metric(type=CPU_TYPE, operator=LATEST, value=30.0),
+      Metric(type=CPU_TYPE, operator=AVERAGE, value=40.0)], 40.0, 0.0, True),
+    ([Metric(type=CPU_TYPE, operator=AVERAGE, value=40.0),
+      Metric(type=CPU_TYPE, operator=LATEST, value=30.0)], 40.0, 0.0, True),
+    # empty-string operator behaves like Latest (backward compat)
+    ([Metric(type=CPU_TYPE, operator="", value=25.0)], 25.0, 0.0, True),
+    # nothing of the requested type
+    ([Metric(type=MEMORY_TYPE, operator=AVERAGE, value=40.0)], 0.0, 0.0, False),
+    ([], 0.0, 0.0, False),
+])
+def test_get_resource_data_table(metrics, want_avg, want_std, want_found):
+    avg, std, found = get_resource_data(metrics, CPU_TYPE)
+    assert (avg, std, found) == (want_avg, want_std, want_found)
+
+
+def test_lvrb_tpu_duty_cycle_joins_min():
+    """TPU-native extension: a host hot on tensorcore duty cycle loses the
+    min() even when CPU looks idle."""
+    node = make_tpu_node("n1", chips=4)
+    handle = make_handle([node])
+    plugin = LoadVariationRiskBalancing(
+        LoadVariationRiskBalancingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=CPU_TYPE, operator=AVERAGE, value=0.0),
+            Metric(type=CPU_TYPE, operator=STD, value=0.0),
+            Metric(type=TPU_TYPE, operator=AVERAGE, value=90.0),
+            Metric(type=TPU_TYPE, operator=STD, value=10.0),
+        ]}))
+    plugin.collector.update_metrics()
+    s, _ = plugin.score(CycleState(), make_pod("p"), "n1")
+    # cpu score 100; tpu risk = (0.9 + 0.1)/2 = 0.5 → 50; min wins
+    assert s == 50
+
+
+def test_lvrb_single_dimension_stands_alone():
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="1Gi"))
+    handle = make_handle([node])
+    plugin = LoadVariationRiskBalancing(
+        LoadVariationRiskBalancingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=MEMORY_TYPE, operator=AVERAGE, value=40.0)]}))
+    plugin.collector.update_metrics()
+    s, _ = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 80  # memory-only: risk 0.2, no min() partner
+
+
+def test_lvrb_no_valid_dimensions_min_score():
+    node = make_node("n1")
+    handle = make_handle([node])
+    plugin = LoadVariationRiskBalancing(
+        LoadVariationRiskBalancingArgs(), handle,
+        provider=lambda: metrics_for({"n1": []}))
+    plugin.collector.update_metrics()
+    s, status = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 0 and status.is_success()
+
+
+# -- TargetLoadPacking score curve (targetloadpacking.go:253-269) -------------
+
+@pytest.mark.parametrize("measured_pct,expected", [
+    # cap 10 cores; pod defaults to 1000m = +10%. target 40.
+    # rising edge: (100-40)*predicted/40 + 40
+    (0.0, 55),    # predicted 10
+    (10.0, 70),   # predicted 20
+    (20.0, 85),   # predicted 30
+    (30.0, 100),  # predicted exactly at target
+    # falling edge: 40*(100-predicted)/60
+    (35.0, 37),   # predicted 45 → 36.67
+    (50.0, 27),   # predicted 60 → 26.67
+    (60.0, 20),   # predicted 70
+    (80.0, 7),    # predicted 90 → 6.67
+    (90.0, 0),    # predicted exactly 100 → 0 (not the >100 branch)
+    (95.0, 0),    # predicted 105 → MinScore branch
+])
+def test_tlp_score_curve_table(measured_pct, expected):
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="64Gi"))
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=CPU_TYPE, operator=AVERAGE, value=measured_pct)]}))
+    plugin.collector.update_metrics()
+    s, status = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert status.is_success()
+    assert s == expected
+
+
+@pytest.mark.parametrize("measured_pct,expected", [
+    # custom target 60: peak moves right
+    (0.0, 67),    # (100-60)*10/60 + 60 = 66.67
+    (50.0, 100),  # predicted 60 = target
+    (70.0, 30),   # 60*(100-80)/40
+])
+def test_tlp_custom_target_table(measured_pct, expected):
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="64Gi"))
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(target_utilization=60), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=CPU_TYPE, operator=AVERAGE, value=measured_pct)]}))
+    plugin.collector.update_metrics()
+    s, _ = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == expected
+
+
+def test_tlp_prediction_sums_containers_and_overhead():
+    """predictUtilisation per container + pod overhead
+    (targetloadpacking.go:286-294, :229-232)."""
+    handle = make_handle([make_node("n1")])
+    plugin = TargetLoadPacking(TargetLoadPackingArgs(), handle,
+                               provider=lambda: None)
+    pod = make_pod("p")
+    pod.spec.containers = [Container(limits={CPU: 2000}),
+                           Container(requests={CPU: 1000}),
+                           Container()]
+    pod.spec.overhead = {CPU: 250}
+    # 2000 (limit) + 1500 (request×1.5) + 1000 (default) + 250 overhead
+    assert plugin._pod_predicted_millis(pod) == 4750
+
+
+def test_tlp_latest_operator_accepted():
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="64Gi"))
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=CPU_TYPE, operator=LATEST, value=30.0)]}))
+    plugin.collector.update_metrics()
+    s, _ = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 100
+
+
+def test_tlp_node_without_metrics_entry_min_score():
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="64Gi"))
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(), handle,
+        provider=lambda: metrics_for({"other-node": [
+            Metric(type=CPU_TYPE, operator=AVERAGE, value=10.0)]}))
+    plugin.collector.update_metrics()
+    s, status = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 0 and status.is_success()
+
+
+def test_tlp_zero_cpu_capacity_min_score():
+    node = make_node("n1", capacity={CPU: 0, MEMORY: 1024, "pods": 10})
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=CPU_TYPE, operator=AVERAGE, value=10.0)]}))
+    plugin.collector.update_metrics()
+    s, status = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 0 and status.is_success()
+
+
+def test_tlp_cpu_metric_missing_from_node_entry():
+    node = make_node("n1", capacity=make_resources(cpu=10, memory="64Gi"))
+    handle = make_handle([node])
+    plugin = TargetLoadPacking(
+        TargetLoadPackingArgs(), handle,
+        provider=lambda: metrics_for({"n1": [
+            Metric(type=MEMORY_TYPE, operator=AVERAGE, value=10.0)]}))
+    plugin.collector.update_metrics()
+    s, status = plugin.score(CycleState(), make_pod("p"), "n1")
+    assert s == 0 and status.is_success()
