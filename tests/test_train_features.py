@@ -1,0 +1,253 @@
+"""Training-side features on the virtual 8-device CPU mesh: gradient
+accumulation, vocab-parallel (tensor-parallel) cross-entropy, and the
+mixed-precision (f32 master / bf16 compute) policy."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusched.jaxbridge import mesh as meshlib
+from tpusched.jaxbridge import workload as wl
+
+
+def need_devices(n=8):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+def tokens_for(cfg, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab, (batch, cfg.seq)), jnp.int32)
+
+
+# -- vocab-parallel cross-entropy --------------------------------------------
+
+def test_cross_entropy_sharded_form_matches_gather_form():
+    """The logsumexp/iota form must agree with take_along_axis log_softmax
+    bit-for-bit-ish on identical logits."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 64)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    ref = wl._cross_entropy(logits, targets, vocab_spec=None)
+    # vocab_spec path without a mesh: pass a no-op constraint via identity
+    # by faking the constraint — use jax.sharding only under a mesh; here
+    # exercise the math by calling the sharded branch pieces directly
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[..., 0]
+    ids = jax.lax.broadcasted_iota(targets.dtype, logits.shape, 2)
+    tl = jnp.sum(jnp.where(ids == targets[..., None], logits, 0.0), axis=-1)
+    got = jnp.mean(lse - tl)
+    assert jnp.allclose(ref, got, atol=1e-6)
+
+
+def test_vocab_parallel_loss_matches_replicated():
+    """Same params, same tokens: the vocab-parallel step must produce the
+    same loss as the replicated-logits step (GSPMD semantics preserved)."""
+    need_devices()
+    cfg = wl.ModelConfig.tiny()
+    cfg_vp = dataclasses.replace(cfg, vocab_parallel_loss=True)
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 4})
+
+    losses = {}
+    for name, c in (("repl", cfg), ("vp", cfg_vp)):
+        step, pshard, tshard = wl.make_sharded_train_step(mesh, c)
+        params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), c),
+                                pshard)
+        toks = jax.device_put(tokens_for(c, 4), tshard)
+        _, loss = step(params, toks)
+        losses[name] = float(loss)
+    assert losses["vp"] == pytest.approx(losses["repl"], rel=1e-4)
+
+
+def test_vocab_parallel_out_matrix_sharded_over_vocab():
+    need_devices()
+    cfg = dataclasses.replace(wl.ModelConfig.tiny(), vocab_parallel_loss=True)
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 4})
+    step, pshard, tshard = wl.make_sharded_train_step(mesh, cfg)
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    out = params["out"]  # (d, vocab): vocab dim sharded 4-way over tp
+    assert out.addressable_shards[0].data.shape[1] == cfg.vocab // 4
+
+
+# -- gradient accumulation ----------------------------------------------------
+
+def test_accum_step_matches_large_batch():
+    """accum_steps×B microbatches must land within numerical noise of one
+    (accum_steps·B)-batch step: same mean-of-token-means loss (equal-sized
+    microbatches), near-identical SGD update."""
+    need_devices()
+    import optax
+    cfg = wl.ModelConfig.tiny()
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 2})
+    tx = optax.sgd(1e-2)
+
+    toks = tokens_for(cfg, 8, seed=3)
+
+    step, init_opt, pshard, tshard = wl.make_optax_train_step(mesh, cfg, tx)
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    opt = init_opt(params)
+    big_params, _, big_loss = step(params, opt, jax.device_put(toks, tshard))
+
+    astep, ainit, apshard, stack_shard = wl.make_accum_train_step(
+        mesh, cfg, tx, accum_steps=4)
+    params2 = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg),
+                             apshard)
+    opt2 = ainit(params2)
+    stack = jax.device_put(toks.reshape(4, 2, cfg.seq), stack_shard)
+    acc_params, _, acc_loss = astep(params2, opt2, stack)
+
+    assert float(acc_loss) == pytest.approx(float(big_loss), rel=1e-5)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                           - b.astype(jnp.float32)))),
+        acc_params, big_params)
+    assert max(jax.tree_util.tree_leaves(diffs)) < 1e-5
+
+
+def test_accum_step_runs_with_adamw_and_moe():
+    need_devices()
+    import optax
+    cfg = dataclasses.replace(wl.ModelConfig.tiny(), n_experts=4)
+    mesh = meshlib.build_named_mesh({"dp": 2, "ep": 2, "tp": 2})
+    step, init_opt, pshard, stack_shard = wl.make_accum_train_step(
+        mesh, cfg, optax.adamw(1e-3), accum_steps=2)
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    opt = init_opt(params)
+    stack = jax.device_put(
+        tokens_for(cfg, 4, seed=1).reshape(2, 2, cfg.seq), stack_shard)
+    params, opt, loss = step(params, opt, stack)
+    assert jnp.isfinite(loss)
+
+
+# -- mixed precision ----------------------------------------------------------
+
+def mp_config(**kw):
+    return dataclasses.replace(wl.ModelConfig.tiny(), dtype=jnp.bfloat16,
+                               param_dtype=jnp.float32, **kw)
+
+
+def test_mixed_precision_masters_stay_f32():
+    need_devices()
+    import optax
+    cfg = mp_config()
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 2})
+    step, init_opt, pshard, tshard = wl.make_optax_train_step(
+        mesh, cfg, optax.adamw(1e-3))
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    assert params["embed"].dtype == jnp.float32          # master weights f32
+    opt = init_opt(params)
+    toks = jax.device_put(tokens_for(cfg, 4), tshard)
+    params, opt, loss = step(params, opt, toks)
+    assert jnp.isfinite(loss)
+    assert params["embed"].dtype == jnp.float32          # stays f32
+    # adam moments in master precision too
+    mus = [l for l in jax.tree_util.tree_leaves(opt)
+           if hasattr(l, "dtype") and l.ndim >= 2]
+    assert all(m.dtype == jnp.float32 for m in mus)
+
+
+def test_cast_params_for_compute_policy():
+    cfg = mp_config(n_experts=2)
+    params = wl.init_params(jax.random.PRNGKey(0), cfg)
+    cast = wl.cast_params_for_compute(params, cfg)
+    assert cast["embed"].dtype == jnp.bfloat16
+    assert cast["layers"][0]["w_gate"].dtype == jnp.bfloat16
+    # the MoE router deliberately stays f32 (f32 softmax logits)
+    assert cast["layers"][0]["router"].dtype == jnp.float32
+    # no-op policy returns the same tree untouched
+    plain = wl.ModelConfig.tiny()
+    p2 = wl.init_params(jax.random.PRNGKey(0), plain)
+    assert wl.cast_params_for_compute(p2, plain) is p2
+
+
+def test_vocab_parallel_with_sequence_parallel_mesh():
+    """vocab_spec must keep the seq dim on sp — regression for the spec that
+    pinned it None and all-gathered the f32 logits along seq."""
+    need_devices()
+    cfg = dataclasses.replace(wl.ModelConfig.tiny(), vocab_parallel_loss=True)
+    mesh = meshlib.build_named_mesh({"dp": 2, "sp": 2, "tp": 2})
+    ts = wl.TrainShardings(mesh, cfg)
+    assert ts.vocab_spec.spec == jax.sharding.PartitionSpec(
+        ("dp",), "sp", "tp")
+    step, pshard, tshard = wl.make_sharded_train_step(mesh, cfg)
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    _, loss = step(params, jax.device_put(tokens_for(cfg, 4), tshard))
+    assert jnp.isfinite(loss)
+
+
+def test_accum_short_final_stack_averages_correctly():
+    """A stack shorter than the constructor's accum_steps must still divide
+    by the actual microbatch count — regression for silent grad scaling."""
+    need_devices()
+    import optax
+    cfg = wl.ModelConfig.tiny()
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 2})
+    tx = optax.sgd(1e-2)
+    toks = tokens_for(cfg, 4, seed=7)
+
+    astep, ainit, pshard, sshard = wl.make_accum_train_step(
+        mesh, cfg, tx, accum_steps=4)
+    params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg), pshard)
+    opt = ainit(params)
+    short = jax.device_put(toks.reshape(2, 2, cfg.seq), sshard)
+    acc_params, _, acc_loss = astep(params, opt, short)
+
+    step, init_opt, pshard2, tshard = wl.make_optax_train_step(mesh, cfg, tx)
+    params2 = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg),
+                             pshard2)
+    ref_params, _, ref_loss = step(params2, init_opt(params2),
+                                   jax.device_put(toks, tshard))
+    assert float(acc_loss) == pytest.approx(float(ref_loss), rel=1e-5)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), acc_params, ref_params)
+    assert max(jax.tree_util.tree_leaves(diffs)) < 1e-5
+
+
+def test_mixed_precision_decode_path():
+    """Serving a mixed-precision-trained model: prefill/generate must cast
+    the f32 masters to the bf16 compute/cache dtype (regression: dtype
+    mismatch crash in dynamic_update_slice)."""
+    from tpusched.jaxbridge import decode
+    cfg = mp_config()
+    params = wl.init_params(jax.random.PRNGKey(0), cfg)
+    out = decode.generate(params, tokens_for(cfg, 2)[:, :8], cfg, steps=4)
+    assert out.shape == (2, 5)
+    # greedy decode agrees with a pure-bf16 copy of the same weights
+    cfg_bf16 = dataclasses.replace(cfg, param_dtype=None)
+    cast = wl.cast_params_for_compute(params, cfg)
+    out2 = decode.generate(cast, tokens_for(cfg, 2)[:, :8], cfg_bf16, steps=4)
+    assert (out == out2).all()
+
+
+def test_mixed_precision_pipeline_path():
+    """Pipeline-parallel training under the f32-master policy (regression:
+    bf16 buffers vs f32 activations crash at trace time)."""
+    need_devices()
+    from tpusched.jaxbridge import pipeline
+    cfg = mp_config()
+    mesh = meshlib.build_named_mesh({"pp": 2, "dp": 4})
+    step, shardings, tshard = pipeline.make_pipeline_train_step(
+        mesh, cfg, n_micro=2)
+    params = jax.device_put(
+        pipeline.init_pipeline_params(jax.random.PRNGKey(0), cfg), shardings)
+    new_params, loss = step(params, jax.device_put(tokens_for(cfg, 4), tshard))
+    assert jnp.isfinite(loss)
+    assert new_params[1].dtype == jnp.float32   # embed master stays f32
+
+
+def test_mixed_precision_tracks_pure_f32_early():
+    """One step from identical inits: bf16-compute loss should be close to
+    the f32 loss (sanity that the cast sits only on the compute path)."""
+    need_devices()
+    mesh = meshlib.build_named_mesh({"dp": 2, "tp": 2})
+    losses = {}
+    for name, cfg in (("f32", wl.ModelConfig.tiny()), ("mp", mp_config())):
+        step, pshard, tshard = wl.make_sharded_train_step(mesh, cfg)
+        params = jax.device_put(wl.init_params(jax.random.PRNGKey(0), cfg),
+                                pshard)
+        toks = jax.device_put(tokens_for(cfg, 4), tshard)
+        _, loss = step(params, toks)
+        losses[name] = float(loss)
+    assert losses["mp"] == pytest.approx(losses["f32"], rel=0.05)

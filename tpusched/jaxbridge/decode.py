@@ -18,7 +18,7 @@ import numpy as np
 
 from . import attention
 from .workload import (ModelConfig, Params, _finish_block, _qkv, _rmsnorm,
-                       _resolve_attn_fn)
+                       _resolve_attn_fn, cast_params_for_compute)
 
 KVCache = List[Dict[str, jax.Array]]
 
@@ -80,6 +80,7 @@ def prefill(params: Params, cache: KVCache, tokens: jax.Array,
             cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling the cache from position 0.
     Returns (logits (b, s, vocab), cache)."""
+    params = cast_params_for_compute(params, cfg)  # f32 masters → bf16 serve
     x = params["embed"][tokens]
     attn_fn = _resolve_attn_fn(cfg)
     new_cache: KVCache = []
@@ -94,6 +95,7 @@ def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
                 cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
     """One token per sequence: tokens_t (b,) at absolute position ``pos``
     (scalar, traceable). Returns (logits (b, vocab), updated cache)."""
+    params = cast_params_for_compute(params, cfg)
     x = params["embed"][tokens_t][:, None, :]
     new_cache: KVCache = []
     for layer, c in zip(params["layers"], cache):
@@ -107,6 +109,9 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
              steps: int) -> jax.Array:
     """Greedy generation: prefill the prompt, then ``steps`` decode steps via
     lax.scan (static trip count; the cache threads through the scan carry)."""
+    # cast once up front: the per-call casts inside prefill/decode_step then
+    # trace to no-op converts instead of re-converting the tree every token
+    params = cast_params_for_compute(params, cfg)
     b, s0 = prompt.shape
     cache = init_kv_cache(cfg, b, s0 + steps)
     logits, cache = prefill(params, cache, prompt, cfg)

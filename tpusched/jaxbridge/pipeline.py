@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import attention
 from .workload import (ModelConfig, Params, _block, _resolve_attn_fn,
-                       _rmsnorm, init_params, param_specs)
+                       _rmsnorm, cast_params_for_compute, init_params,
+                       param_specs)
 
 
 def stack_layers(params: Params) -> Dict[str, Any]:
@@ -74,9 +75,20 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig, n_micro: int,
 
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def pipe_loss(stacked_local, embed, out_w, ln_f, tokens):
+    def pipe_loss(stacked_local, embed_t, out_t, ln_t, tokens):
         """Runs INSIDE shard_map (manual over pp): stacked_local carries
-        this stage's (L/pp, …) layers; everything else is pp-replicated."""
+        this stage's (L/pp, …) layers. embed/out/ln_f arrive TILED along a
+        leading pp axis (one (1, …) slice per stage) rather than replicated:
+        physically the same one-copy-per-device layout, but their gradients
+        come back per-stage and are summed by the broadcast transpose at the
+        jit level — XLA-CPU's copy-insertion pass CHECK-fails on the
+        replicated-input gradient psum that shard_map's transpose would
+        otherwise emit when the body computes in bf16."""
+        embed, out_w, ln_f = embed_t[0], out_t[0], ln_t[0]
+        # mixed precision: f32 masters compute in cfg.dtype; grads flow
+        # through the cast back to the masters (workload.loss_fn parity)
+        stacked_local, embed, out_w, ln_f = cast_params_for_compute(
+            (stacked_local, embed, out_w, ln_f), cfg)
         s_idx = jax.lax.axis_index("pp")
         bsz, seq = tokens.shape
         mb = bsz // n_micro
@@ -133,15 +145,22 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig, n_micro: int,
 
     sharded_loss = jax.shard_map(
         pipe_loss, mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P(), P()),
+        in_specs=(P("pp"), P("pp"), P("pp"), P("pp"), P()),
         out_specs=P(),
         axis_names={"pp"})
 
     def step(params, tokens):
         stacked, embed, out_w, ln_f = params
+
+        def lossf(st, e, o, l):
+            # tile the stage-shared tensors along pp (see pipe_loss docstring)
+            et = jnp.broadcast_to(e[None], (pp, *e.shape))
+            ot = jnp.broadcast_to(o[None], (pp, *o.shape))
+            lt = jnp.broadcast_to(l[None], (pp, *l.shape))
+            return sharded_loss(st, et, ot, lt, tokens)
+
         loss, grads = jax.value_and_grad(
-            lambda st, e, o, l: sharded_loss(st, e, o, l, tokens),
-            argnums=(0, 1, 2, 3))(stacked, embed, out_w, ln_f)
+            lossf, argnums=(0, 1, 2, 3))(stacked, embed, out_w, ln_f)
         new = jax.tree_util.tree_map(
             lambda p, g: p - lr * g.astype(p.dtype), params, tuple(grads))
         return new, loss

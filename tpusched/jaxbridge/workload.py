@@ -45,10 +45,23 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01   # load-balance loss weight (switch-style)
+    # mixed precision: master/optimizer dtype when it differs from the
+    # compute dtype (`dtype`). None ⇒ params stored in `dtype` (pure-bf16
+    # training). jnp.float32 + dtype=bf16 is the classic policy: f32 master
+    # weights, bf16 matmuls on the MXU, f32 grads/updates.
+    param_dtype: Any = None
+    # tensor-parallel cross-entropy: shard the unembedding's vocab dim over
+    # tp and compute the loss in logsumexp form so the (b, s, V) logits are
+    # never replicated — the HBM win that makes large-vocab models fit.
+    vocab_parallel_loss: bool = False
 
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+    @property
+    def master_dtype(self) -> Any:
+        return self.param_dtype if self.param_dtype is not None else self.dtype
 
     def __post_init__(self):
         if self.n_heads % self.kv_heads:
@@ -90,7 +103,8 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
     d_kv = (d // cfg.n_heads) * cfg.kv_heads   # GQA: fewer KV projections
 
     def dense(k, shape):
-        return (jax.random.normal(k, shape) / np.sqrt(shape[0])).astype(cfg.dtype)
+        return (jax.random.normal(k, shape)
+                / np.sqrt(shape[0])).astype(cfg.master_dtype)
 
     layers: List[Dict[str, jax.Array]] = []
     for kl in k_layers:
@@ -98,8 +112,8 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
         layer = {
             "wq": dense(ks[0], (d, d)), "wk": dense(ks[1], (d, d_kv)),
             "wv": dense(ks[2], (d, d_kv)), "wo": dense(ks[3], (d, d)),
-            "ln_attn": jnp.ones((d,), cfg.dtype),
-            "ln_mlp": jnp.ones((d,), cfg.dtype),
+            "ln_attn": jnp.ones((d,), cfg.master_dtype),
+            "ln_mlp": jnp.ones((d,), cfg.master_dtype),
         }
         if cfg.n_experts:
             e = cfg.n_experts
@@ -108,7 +122,7 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
                 # fan-in scaled per expert matrix (dense() scales by
                 # shape[0], which would be E here)
                 x = jax.random.normal(k, shape) / np.sqrt(fan_in)
-                return x.astype(cfg.dtype)
+                return x.astype(cfg.master_dtype)
 
             # stacked experts: the leading E axis is what ep shards
             layer["router"] = (jax.random.normal(ks[7], (d, e))
@@ -124,7 +138,7 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
     return {
         "embed": dense(k_embed, (v, d)),
         "out": dense(k_out, (d, v)),
-        "ln_f": jnp.ones((d,), cfg.dtype),
+        "ln_f": jnp.ones((d,), cfg.master_dtype),
         "layers": layers,
     }
 
@@ -287,29 +301,70 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     return (logits, aux_total) if with_aux else logits
 
 
+def cast_params_for_compute(params: Params, cfg: ModelConfig) -> Params:
+    """Mixed-precision entry: cast master-dtype weights to the compute dtype.
+    Gradients flow through the cast, so `jax.grad` of a loss over the master
+    tree yields master-dtype gradients (the classic f32-master/bf16-compute
+    policy). Leaves deliberately stored in f32 regardless of policy (the MoE
+    router, which needs f32 softmax logits) are left untouched."""
+    if cfg.master_dtype == cfg.dtype:
+        return params
+
+    def cast(path, leaf):
+        if any(getattr(k, "key", None) == "router" for k in path):
+            return leaf
+        return leaf.astype(cfg.dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def _cross_entropy(logits: jax.Array, targets: jax.Array,
+                   vocab_spec: Optional[Any] = None) -> jax.Array:
+    """Token-mean NLL. With ``vocab_spec`` (vocab dim sharded over tp) the
+    loss is computed in logsumexp form with the target logit extracted by a
+    fused iota-compare-reduce instead of a gather — both reductions run over
+    the sharded vocab dim, so GSPMD inserts tp all-reduces of (b, s)-sized
+    partials and the full logits are never replicated or gathered."""
+    logits = logits.astype(jnp.float32)
+    if vocab_spec is None:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+    logits = jax.lax.with_sharding_constraint(logits, vocab_spec)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    vocab_ids = jax.lax.broadcasted_iota(targets.dtype, logits.shape,
+                                         logits.ndim - 1)
+    target_logit = jnp.sum(
+        jnp.where(vocab_ids == targets[..., None], logits, 0.0), axis=-1)
+    return jnp.mean(lse - target_logit)
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
             act_spec: Optional[Any] = None, attn_fn=None,
-            ep_spec: Optional[Any] = None) -> jax.Array:
+            ep_spec: Optional[Any] = None,
+            vocab_spec: Optional[Any] = None) -> jax.Array:
     # run the full sequence and slice logits afterward — identical for a
     # causal model, and keeps the sequence dim evenly divisible for ring
     # attention's manual sp sharding
+    params = cast_params_for_compute(params, cfg)
     logits, aux = forward(params, tokens, cfg, act_spec, attn_fn, ep_spec,
                           with_aux=True)
-    logits = logits[:, :-1].astype(jnp.float32)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    nll = _cross_entropy(logits[:, :-1], tokens[:, 1:], vocab_spec)
+    return nll + cfg.moe_aux_weight * aux
 
 
 def sgd_train_step(params: Params, tokens: jax.Array, cfg: ModelConfig,
                    lr: float = 1e-3, act_spec: Optional[Any] = None,
-                   attn_fn=None, ep_spec: Optional[Any] = None
+                   attn_fn=None, ep_spec: Optional[Any] = None,
+                   vocab_spec: Optional[Any] = None
                    ) -> Tuple[Params, jax.Array]:
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
                                               act_spec=act_spec,
                                               attn_fn=attn_fn,
-                                              ep_spec=ep_spec)
+                                              ep_spec=ep_spec,
+                                              vocab_spec=vocab_spec)
     new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                         params, grads)
     return new_params, loss
@@ -358,7 +413,10 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
         layer["w_down"] = row
     return {
         "embed": col,
-        "out": row,
+        # vocab-parallel loss: unembedding goes column-parallel (vocab over
+        # tp) so logits materialize vocab-sharded; default is row-parallel
+        # (d_model over tp ⇒ tp all-reduce produces replicated logits)
+        "out": col if cfg.vocab_parallel_loss else row,
         "ln_f": vec,
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
@@ -372,35 +430,59 @@ def moe_act_spec(cfg: ModelConfig, mesh: Mesh):
     return None
 
 
+class TrainShardings:
+    """Everything a sharded step needs, derived once from (mesh, cfg):
+    param/token NamedShardings, the sp activation constraint, the resolved
+    attention fn (ring rides the sp axis explicitly), the ep expert-buffer
+    spec, and the vocab-parallel logits spec."""
+
+    __slots__ = ("params", "tokens", "act_spec", "attn_fn", "ep_spec",
+                 "vocab_spec")
+
+    def __init__(self, mesh: Mesh, cfg: ModelConfig):
+        pspecs = param_specs(cfg, mesh)
+        self.params = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        b_axes = batch_axes(mesh)
+        batch_spec = b_axes if b_axes else None
+        self.tokens = NamedSharding(mesh, P(batch_spec, None))
+        self.act_spec = None
+        self.attn_fn = None
+        if "sp" in mesh.axis_names:
+            self.act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
+            if cfg.attn == "ring":
+                # explicit sequence parallelism: K/V ride the sp ring
+                # (ppermute over ICI) instead of GSPMD-inserted gathers
+                self.attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
+        if self.attn_fn is None:
+            self.attn_fn = _resolve_attn_fn(cfg)
+        self.ep_spec = moe_act_spec(cfg, mesh)
+        self.vocab_spec = None
+        if cfg.vocab_parallel_loss and "tp" in mesh.axis_names:
+            # keep the sequence dim sp-sharded: pinning it to None would
+            # all-gather the f32 logits along seq — the exact materialization
+            # the vocab-parallel loss exists to avoid
+            seq_axis = "sp" if "sp" in mesh.axis_names else None
+            self.vocab_spec = NamedSharding(mesh,
+                                            P(batch_spec, seq_axis, "tp"))
+
+    def loss_kwargs(self) -> Dict[str, Any]:
+        return dict(act_spec=self.act_spec, attn_fn=self.attn_fn,
+                    ep_spec=self.ep_spec, vocab_spec=self.vocab_spec)
+
+
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig):
     """jit the train step over the mesh with explicit shardings; batch is
     sharded over every batch axis present (slice/dp/fsdp), activations over
     sp when present, params over fsdp×tp."""
-    pspecs = param_specs(cfg, mesh)
-    param_shardings = jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), pspecs,
-        is_leaf=lambda x: isinstance(x, P))
-    b_axes = batch_axes(mesh)
-    batch_spec = b_axes if b_axes else None
-    token_sharding = NamedSharding(mesh, P(batch_spec, None))
-    act_spec = None
-    attn_fn = None
-    if "sp" in mesh.axis_names:
-        act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
-        if cfg.attn == "ring":
-            # explicit sequence parallelism: K/V ride the sp ring
-            # (ppermute over ICI) instead of GSPMD-inserted gathers
-            attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
-    if attn_fn is None:
-        attn_fn = _resolve_attn_fn(cfg)
-
+    ts = TrainShardings(mesh, cfg)
     step = jax.jit(
-        functools.partial(sgd_train_step, cfg=cfg, act_spec=act_spec,
-                          attn_fn=attn_fn, ep_spec=moe_act_spec(cfg, mesh)),
-        in_shardings=(param_shardings, token_sharding),
-        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+        functools.partial(sgd_train_step, cfg=cfg, **ts.loss_kwargs()),
+        in_shardings=(ts.params, ts.tokens),
+        out_shardings=(ts.params, NamedSharding(mesh, P())),
         donate_argnums=(0,))
-    return step, param_shardings, token_sharding
+    return step, ts.params, ts.tokens
 
 
 def make_optax_train_step(mesh: Mesh, cfg: ModelConfig, tx):
@@ -419,41 +501,82 @@ def make_optax_train_step(mesh: Mesh, cfg: ModelConfig, tx):
     """
     import optax
 
-    pspecs = param_specs(cfg, mesh)
-    param_shardings = jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), pspecs,
-        is_leaf=lambda x: isinstance(x, P))
-    b_axes = batch_axes(mesh)
-    batch_spec = b_axes if b_axes else None
-    token_sharding = NamedSharding(mesh, P(batch_spec, None))
-    act_spec = None
-    attn_fn = None
-    if "sp" in mesh.axis_names:
-        act_spec = NamedSharding(mesh, P(batch_spec, "sp", None))
-        if cfg.attn == "ring":
-            attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
-    if attn_fn is None:
-        attn_fn = _resolve_attn_fn(cfg)
-    ep_spec = moe_act_spec(cfg, mesh)
+    ts = TrainShardings(mesh, cfg)
+    loss_kwargs = ts.loss_kwargs()
 
     def _step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, act_spec=act_spec, attn_fn=attn_fn,
-            ep_spec=ep_spec)
+            params, tokens, cfg, **loss_kwargs)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    opt_shardings = _opt_state_shardings(mesh, cfg, tx, param_shardings)
+    opt_shardings = _opt_state_shardings(mesh, cfg, tx, ts.params)
     step = jax.jit(
         _step,
-        in_shardings=(param_shardings, opt_shardings, token_sharding),
-        out_shardings=(param_shardings, opt_shardings,
+        in_shardings=(ts.params, opt_shardings, ts.tokens),
+        out_shardings=(ts.params, opt_shardings,
                        NamedSharding(mesh, P())),
         donate_argnums=(0, 1))
-    init_opt = jax.jit(tx.init, in_shardings=(param_shardings,),
+    init_opt = jax.jit(tx.init, in_shardings=(ts.params,),
                        out_shardings=opt_shardings)
-    return step, init_opt, param_shardings, token_sharding
+    return step, init_opt, ts.params, ts.tokens
+
+
+def make_accum_train_step(mesh: Mesh, cfg: ModelConfig, tx,
+                          accum_steps: int):
+    """Gradient accumulation: one optimizer update per ``accum_steps``
+    microbatches, scanned inside a single jit. Tokens arrive as
+    (accum_steps, B, S); `lax.scan` keeps the trace size constant at any
+    accumulation depth (no unrolled Python loop) and the f32 accumulator
+    tree makes microbatch summation precision-safe under a bf16 compute
+    policy. Effective batch = accum_steps × B without the activation memory
+    of a accum_steps×B batch — the standard trade when HBM, not FLOPs, binds.
+
+    Returns (step, init_opt, param_shardings, token_sharding) where
+    ``step(params, opt_state, tokens) -> (params, opt_state, mean_loss)``
+    and token_sharding covers the (accum, B, S) stack (batch axes shard B;
+    the accum axis stays unsharded — it is time, not data).
+    """
+    import optax
+
+    ts = TrainShardings(mesh, cfg)
+    loss_kwargs = ts.loss_kwargs()
+    b_axes = batch_axes(mesh)
+    stack_sharding = NamedSharding(
+        mesh, P(None, b_axes if b_axes else None, None))
+
+    def _step(params, opt_state, token_stack):
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def micro(acc, tokens):
+            loss, grads = grad_fn(params, tokens, cfg, **loss_kwargs)
+            acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return acc, loss
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        acc, losses = jax.lax.scan(micro, zeros, token_stack)
+        # divisor from the stack's static leading dim, not the constructor
+        # arg — a shorter final stack then still averages correctly instead
+        # of silently under-scaling every gradient
+        n_micro = token_stack.shape[0]
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / n_micro).astype(p.dtype), acc, params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jnp.mean(losses)
+
+    opt_shardings = _opt_state_shardings(mesh, cfg, tx, ts.params)
+    step = jax.jit(
+        _step,
+        in_shardings=(ts.params, opt_shardings, stack_sharding),
+        out_shardings=(ts.params, opt_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1))
+    init_opt = jax.jit(tx.init, in_shardings=(ts.params,),
+                       out_shardings=opt_shardings)
+    return step, init_opt, ts.params, stack_sharding
 
 
 def _opt_state_shardings(mesh: Mesh, cfg: ModelConfig, tx, param_shardings):
