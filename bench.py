@@ -259,6 +259,32 @@ def bench_tpu_workload() -> None:
          round(n_mfu, 4) if n_mfu else round(n_tf, 1),
          "MFU" if n_mfu else "TFLOP/s", None)
 
+    # long-context: the flash kernels' O(s) residual memory is what makes
+    # this length practical — on 16 GB-class chips (v5e) the naive path's
+    # materialized fwd+bwd score matrices exhaust HBM at seq 8192, so the
+    # naive/flash ratio is reported from seq 4096 where both compile.
+    # Isolated so a long-context failure can't take the decode metric down.
+    try:
+        long_flash = dataclasses.replace(ModelConfig.llama_like(seq=8192),
+                                         attn="flash")
+        l_per, l_tf, l_mfu = measure_train_step(long_flash, batch=2)
+        f4_per, _, _ = measure_train_step(
+            dataclasses.replace(ModelConfig.llama_like(seq=4096),
+                                attn="flash"), batch=4)
+        n4_per, _, _ = measure_train_step(ModelConfig.llama_like(seq=4096),
+                                          batch=4)
+        emit("train-step MFU, long-context seq 8192 b2, flash attention "
+             f"(step {l_per * 1e3:.1f} ms on "
+             f"{jax.devices()[0].device_kind}; vs_baseline = naive/flash "
+             "step-time ratio at seq 4096: "
+             f"{n4_per * 1e3:.1f}/{f4_per * 1e3:.1f} ms)",
+             round(l_mfu, 4) if l_mfu else round(l_tf, 1),
+             "MFU" if l_mfu else "TFLOP/s",
+             round(n4_per / f4_per, 2))
+    except Exception as e:  # noqa: BLE001 — keep later metrics alive
+        emit(f"long-context train-step FAILED: {type(e).__name__}", None, "",
+             None)
+
     tok_s = measure_decode(dataclasses.replace(cfg, seq=512), batch=8)
     emit("KV-cache greedy decode throughput, llama-like 155M bf16, b8, "
          "prompt 128 (single v5e chip)",
