@@ -268,22 +268,28 @@ def bench_tpu_workload() -> None:
         long_flash = dataclasses.replace(ModelConfig.llama_like(seq=8192),
                                          attn="flash")
         l_per, l_tf, l_mfu = measure_train_step(long_flash, batch=2)
-        f4_per, _, _ = measure_train_step(
-            dataclasses.replace(ModelConfig.llama_like(seq=4096),
-                                attn="flash"), batch=4)
-        n4_per, _, _ = measure_train_step(ModelConfig.llama_like(seq=4096),
-                                          batch=4)
+        # the naive/flash ratio at seq 4096 is best-effort garnish: its
+        # failure must not discard the already-measured 8192 headline
+        ratio = ratio_note = None
+        try:
+            f4_per, _, _ = measure_train_step(
+                dataclasses.replace(ModelConfig.llama_like(seq=4096),
+                                    attn="flash"), batch=4)
+            n4_per, _, _ = measure_train_step(
+                ModelConfig.llama_like(seq=4096), batch=4)
+            ratio = round(n4_per / f4_per, 2)
+            ratio_note = (f"{n4_per * 1e3:.1f}/{f4_per * 1e3:.1f} ms")
+        except Exception as e:  # noqa: BLE001
+            ratio_note = f"unavailable: {type(e).__name__}: {e}"
         emit("train-step MFU, long-context seq 8192 b2, flash attention "
              f"(step {l_per * 1e3:.1f} ms on "
              f"{jax.devices()[0].device_kind}; vs_baseline = naive/flash "
-             "step-time ratio at seq 4096: "
-             f"{n4_per * 1e3:.1f}/{f4_per * 1e3:.1f} ms)",
+             f"step-time ratio at seq 4096: {ratio_note})",
              round(l_mfu, 4) if l_mfu else round(l_tf, 1),
-             "MFU" if l_mfu else "TFLOP/s",
-             round(n4_per / f4_per, 2))
+             "MFU" if l_mfu else "TFLOP/s", ratio)
     except Exception as e:  # noqa: BLE001 — keep later metrics alive
-        emit(f"long-context train-step FAILED: {type(e).__name__}", None, "",
-             None)
+        emit(f"long-context train-step FAILED: {type(e).__name__}: {e}",
+             None, "", None)
 
     tok_s = measure_decode(dataclasses.replace(cfg, seq=512), batch=8)
     emit("KV-cache greedy decode throughput, llama-like 155M bf16, b8, "

@@ -118,3 +118,40 @@ def test_scheduler_emits_scheduled_and_failed_events():
         failed = [e for e in events if e.reason == "FailedScheduling"]
         assert all(e.type == "Warning" for e in failed)
         assert any("Insufficient" in e.message for e in failed)
+
+
+def test_extension_point_duration_metrics():
+    """framework_extension_point_duration_seconds{extension_point=...}
+    (upstream parity): one observation per cycle for every point the cycle
+    traverses, exposed with labels in the Prometheus text format."""
+    from tpusched.api.resources import TPU
+    from tpusched.testing import TestCluster, make_pod, make_tpu_node
+    from tpusched.util.metrics import extension_point_seconds
+
+    before = {k: h.count()
+              for k, h in extension_point_seconds.children().items()}
+    with TestCluster() as c:
+        # two nodes: a single feasible node short-circuits before Score
+        c.add_nodes([make_tpu_node("n1", chips=4), make_tpu_node("n2", chips=4)])
+        c.create_pods([make_pod("p", limits={TPU: 2})])
+        assert c.wait_for_pods_scheduled(["default/p"])
+    for point in ("PreFilter", "Filter", "Score", "Reserve", "Bind",
+                  "PostBind"):
+        h = extension_point_seconds.with_labels(point)
+        assert h.count() > before.get((point,), 0), point
+
+    text = REGISTRY.expose()
+    assert ('tpusched_framework_extension_point_duration_seconds_bucket'
+            '{extension_point="Filter",le="+Inf"}') in text
+    assert ('tpusched_framework_extension_point_duration_seconds_count'
+            '{extension_point="Bind"}') in text
+
+
+def test_histogram_vec_label_arity_checked():
+    import pytest
+    from tpusched.util.metrics import HistogramVec
+    vec = HistogramVec("x_seconds", ("a", "b"))
+    with pytest.raises(ValueError):
+        vec.with_labels("only-one")
+    vec.with_labels("1", "2").observe(0.5)
+    assert vec.children()[("1", "2")].count() == 1

@@ -25,7 +25,7 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_TPU_TOPOLOGY)
 from ..util import klog
 from ..util.metrics import (bind_total, e2e_scheduling_seconds,
-                            schedule_attempts)
+                            extension_point_seconds, schedule_attempts)
 from ..util.podutil import assigned
 from .cache import Cache
 from .queue import QueuedPodInfo, SchedulingQueue
@@ -224,7 +224,8 @@ class Scheduler:
         assumed = pod.deepcopy()
         self.cache.assume_pod(assumed, node_name)
 
-        s = self._fw.run_reserve_plugins_reserve(state, assumed, node_name)
+        s = self._timed_point("Reserve", self._fw.run_reserve_plugins_reserve,
+                              state, assumed, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
             self.cache.forget_pod(assumed)
@@ -232,7 +233,8 @@ class Scheduler:
             self._activate_pods(pods_to_activate)
             return
 
-        s = self._fw.run_permit_plugins(state, assumed, node_name)
+        s = self._timed_point("Permit", self._fw.run_permit_plugins,
+                              state, assumed, node_name)
         if not s.is_success() and not s.is_wait():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
             self.cache.forget_pod(assumed)
@@ -251,13 +253,24 @@ class Scheduler:
             self._binding_threads[id(t)] = t
         t.start()
 
+    def _timed_point(self, point: str, fn, *args):
+        """framework_extension_point_duration_seconds recorder (upstream
+        parity; see the metric's divergence note in util/metrics.py)."""
+        t0 = time.perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            extension_point_seconds.with_labels(point).observe(
+                time.perf_counter() - t0)
+
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
         """genericScheduler.Schedule analog: prefilter → filter → score."""
         num_nodes = snapshot.num_nodes()
         if num_nodes == 0:
             return "", Status.unschedulable("no nodes available")
 
-        s = self._fw.run_pre_filter_plugins(state, pod)
+        s = self._timed_point("PreFilter", self._fw.run_pre_filter_plugins,
+                              state, pod)
         if not s.is_success():
             if s.is_error():
                 return "", s
@@ -267,7 +280,8 @@ class Scheduler:
 
         infos = snapshot.list()
         want = self._num_feasible_nodes_to_find(len(infos))
-        feasible, diagnosis, error = self._find_feasible(state, pod, infos, want)
+        feasible, diagnosis, error = self._timed_point(
+            "Filter", self._find_feasible, state, pod, infos, want)
         if error is not None:
             return "", error
         state.write("tpusched/diagnosis", diagnosis)
@@ -286,10 +300,12 @@ class Scheduler:
         if len(feasible) == 1:
             return feasible[0].name, Status.success()
 
-        s = self._fw.run_pre_score_plugins(state, pod, feasible)
+        s = self._timed_point("PreScore", self._fw.run_pre_score_plugins,
+                              state, pod, feasible)
         if not s.is_success():
             return "", s
-        totals, s = self._fw.run_score_plugins(state, pod, feasible)
+        totals, s = self._timed_point("Score", self._fw.run_score_plugins,
+                                      state, pod, feasible)
         if not s.is_success():
             return "", s
         best = max(feasible, key=lambda n: (totals.get(n.name, 0), n.name))
@@ -382,7 +398,9 @@ class Scheduler:
         if status.code != UNSCHEDULABLE or not self._fw.post_filter_plugins:
             return
         diagnosis = state.try_read("tpusched/diagnosis") or {}
-        result, pf_status = self._fw.run_post_filter_plugins(state, pod, diagnosis)
+        result, pf_status = self._timed_point(
+            "PostFilter", self._fw.run_post_filter_plugins, state, pod,
+            diagnosis)
         if pf_status.is_success() and result and result.nominated_node_name:
             node = result.nominated_node_name
             try:
@@ -414,13 +432,15 @@ class Scheduler:
             self.cache.forget_pod(pod)
             self._handle_failure(info, s)
             return
-        s = self._fw.run_pre_bind_plugins(state, pod, node_name)
+        s = self._timed_point("PreBind", self._fw.run_pre_bind_plugins,
+                              state, pod, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self.cache.forget_pod(pod)
             self._handle_failure(info, s)
             return
-        s = self._fw.run_bind_plugins(state, pod, node_name)
+        s = self._timed_point("Bind", self._fw.run_bind_plugins,
+                              state, pod, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self.cache.forget_pod(pod)
@@ -433,7 +453,8 @@ class Scheduler:
             pod.key, "Pod", "Normal", "Scheduled",
             f"Successfully assigned {pod.key} to {node_name}")
         klog.V(4).info_s("bound", pod=pod.key, node=node_name)
-        self._fw.run_post_bind_plugins(state, pod, node_name)
+        self._timed_point("PostBind", self._fw.run_post_bind_plugins,
+                          state, pod, node_name)
         self._activate_pods(pods_to_activate)
 
     # -- failure path ---------------------------------------------------------
