@@ -289,7 +289,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
-                    interpret):
+                    interpret, dd=None):
     b, s, h, d = q.shape
     kv = k.shape[2]
     n_rep = h // kv
@@ -301,10 +301,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
 
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
     dof = _to_bh(g)
-    outf = _to_bh(out)
-    # D_i = Σ_d dO ∘ O — cheap elementwise reduce, XLA fuses it
-    dd = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
-                 axis=-1, keepdims=True)
+    if dd is None:
+        outf = _to_bh(out)
+        # D_i = Σ_d dO ∘ O — cheap elementwise reduce, XLA fuses it
+        dd = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                     axis=-1, keepdims=True)
 
     # dK/dV: grid walks b·kv KV heads; the innermost axis c enumerates all
     # n_rep·nq (group query head r, q-block i) pairs. KV buffer row bkv holds
@@ -498,3 +499,178 @@ def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis_name})
+
+
+# -- ring-flash attention: the pallas kernels INSIDE the sp ring --------------
+#
+# ring_attention above materializes the (s_loc × s_loc) score tensor of each
+# ring step in f32 — fine for modest chunks, but it forfeits exactly what the
+# flash kernels buy on long context. Here every ring step runs the
+# FlashAttention-2 kernels on the (resident Q, visiting K/V) chunk pair and
+# the per-pair partials are combined online via their logsumexps, so per-step
+# HBM stays O(s_loc) while K/V ride the ICI ring kv_heads-sized. The backward
+# is a second ring pass: each visiting chunk's dK/dV accumulate in a buffer
+# that travels WITH the chunk (arriving home after n hops), dQ accumulates
+# in place; every per-pair gradient comes from the flash backward kernels
+# fed the GLOBAL out/lse, which decomposes the FA2 backward exactly.
+
+def _combine_partials(o_acc, lse_acc, o_t, lse_t):
+    """Merge two normalized attention partials by their logsumexps.
+    o in (b·h, s, d) f32; lse in (b·h, s, 1) f32. An excluded partial
+    (lse_t == NEG_INF) contributes exp(NEG_INF − lse_new) == 0."""
+    lse_new = jnp.logaddexp(lse_acc, lse_t)
+    return (o_acc * jnp.exp(lse_acc - lse_new)
+            + o_t * jnp.exp(lse_t - lse_new)), lse_new
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
+                         interpret):
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm_fn = lambda m: [(i, (i + 1) % m) for i in range(m)]
+
+    # t = 0: the resident (diagonal) chunk pair — the only causal one
+    out0, lse0 = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    o_acc = _to_bh(out0).astype(jnp.float32)
+    l_acc = lse0
+
+    def compute(ks, vs):
+        o_t, l_t = _flash_forward(q, ks, vs, False, block_q, block_k,
+                                  interpret)
+        return _to_bh(o_t).astype(jnp.float32), l_t
+
+    def skip(ks, vs):
+        # excluded (future) chunk: zero weight in the combine, and the
+        # kernels never run — half the causal ring's FLOPs skipped
+        return (jnp.zeros((b * h, s_loc, d), jnp.float32),
+                jnp.full((b * h, s_loc, 1), NEG_INF, jnp.float32))
+
+    def step(carry, t):
+        o_acc, l_acc, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm_fn(n))
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm_fn(n))
+        src = (my - t) % n                    # global chunk now visiting
+        if causal:
+            o_t, l_t = jax.lax.cond(src < my, compute, skip, k_cur, v_cur)
+        else:
+            o_t, l_t = compute(k_cur, v_cur)
+        o_acc, l_acc = _combine_partials(o_acc, l_acc, o_t, l_t)
+        return (o_acc, l_acc, k_cur, v_cur), ()
+
+    (o_acc, l_acc, _, _), _ = jax.lax.scan(
+        step, (o_acc, l_acc, k, v), jnp.arange(1, n))
+    out = _from_bh(o_acc.astype(q.dtype), b, h)
+    return out, l_acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal, block_q,
+                                  block_k, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, block_q, block_k,
+                        interpret):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal, block_q,
+                                    block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, interpret,
+                        res, g):
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    kv_heads = k.shape[2]
+
+    # D = Σ_d dO ∘ O depends only on loop-invariant (g, out): hoisted out of
+    # the ring instead of being re-derived by every per-pair backward
+    dd = jnp.sum(_to_bh(g).astype(jnp.float32)
+                 * _to_bh(out).astype(jnp.float32), axis=-1, keepdims=True)
+
+    # resident pair first (the causal one); accumulators in f32 — they sum
+    # n per-pair contributions before the final cast
+    dq0, dk0, dv0 = _flash_backward(q, k, v, out, lse, g, causal, block_q,
+                                    block_k, interpret, dd=dd)
+    dq_acc = dq0.astype(jnp.float32)
+    dk_cur = dk0.astype(jnp.float32)   # travels WITH the resident chunk
+    dv_cur = dv0.astype(jnp.float32)
+
+    def compute(ks, vs):
+        dq_c, dk_c, dv_c = _flash_backward(q, ks, vs, out, lse, g, False,
+                                           block_q, block_k, interpret,
+                                           dd=dd)
+        return (dq_c.astype(jnp.float32), dk_c.astype(jnp.float32),
+                dv_c.astype(jnp.float32))
+
+    def skip(ks, vs):
+        # excluded pair: the kernels never run — a masked-region outlier
+        # logit (s > global lse) would otherwise overflow p = exp(s − lse)
+        # to inf inside the kernel and 0·inf-poison the accumulators
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros((*q.shape[:2], kv_heads, q.shape[3]), jnp.float32),
+                jnp.zeros((*q.shape[:2], kv_heads, q.shape[3]), jnp.float32))
+
+    def step(carry, t):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        # rotate the chunk and its gradient accumulator together
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        src = (my - t) % n
+        if causal:
+            dq_c, dk_c, dv_c = jax.lax.cond(src < my, compute, skip,
+                                            k_cur, v_cur)
+        else:
+            dq_c, dk_c, dv_c = compute(k_cur, v_cur)
+        dq_acc = dq_acc + dq_c
+        dk_cur = dk_cur + dk_c
+        dv_cur = dv_cur + dv_c
+        return (dq_acc, k_cur, v_cur, dk_cur, dv_cur), ()
+
+    (dq_acc, k_cur, v_cur, dk_cur, dv_cur), _ = jax.lax.scan(
+        step, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(1, n))
+    # one final hop brings every chunk (and its accumulated gradient) home
+    dk_home = jax.lax.ppermute(dk_cur, axis_name, perm)
+    dv_home = jax.lax.ppermute(dv_cur, axis_name, perm)
+    return (dq_acc.astype(q.dtype), dk_home.astype(k.dtype),
+            dv_home.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = "sp", causal: bool = True,
+                         block_q: int = 512, block_k: int = 1024,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention whose per-step compute is the flash kernel pair.
+    Falls back to the blockwise-naive ring when the local chunk can't run
+    the kernels (shape indivisibility / pallas unavailable)."""
+    if not _flash_supported(q, k, v, block_q, block_k):
+        return ring_attention(q, k, v, axis_name, causal)
+    return _ring_flash(q, k, v, axis_name, causal, block_q, block_k,
+                       interpret)
+
+
+def make_ring_flash_attention(mesh, axis_name: str = "sp",
+                              causal: bool = True, batch_spec=None,
+                              block_q: int = 512, block_k: int = 1024,
+                              interpret: Optional[bool] = None):
+    """shard_map-wrapped ring-flash attention (cfg.attn == 'ringflash').
+
+    check_vma=False: pallas_call's out_shapes carry no varying-mesh-axes
+    annotation, so the VMA checker rejects any kernel launched inside a
+    manual axis; correctness of the ring collectives is pinned by the
+    parity suite instead (tests/test_attention.py ring-flash cases)."""
+    spec = P(batch_spec, axis_name, None, None)
+    fn = functools.partial(ring_flash_attention, axis_name=axis_name,
+                           causal=causal, block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis_name},
+                         check_vma=False)

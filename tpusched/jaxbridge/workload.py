@@ -31,8 +31,9 @@ class ModelConfig:
     seq: int = 128
     dtype: Any = jnp.float32
     # attention implementation: "naive" (materialized), "flash" (pallas
-    # online-softmax kernel), or "ring" (sp-axis sequence parallelism;
-    # requires an sp mesh axis — falls back to naive+GSPMD without one)
+    # online-softmax kernel), "ring" (sp-axis sequence parallelism;
+    # requires an sp mesh axis — falls back to naive+GSPMD without one), or
+    # "ringflash" (ring with the flash kernels running each chunk pair)
     attn: str = "naive"
     # grouped-query attention: number of KV heads (0 ⇒ n_heads, plain MHA).
     # Llama-3 style: each KV head serves n_heads/n_kv_heads query heads.
@@ -455,6 +456,11 @@ class TrainShardings:
                 # explicit sequence parallelism: K/V ride the sp ring
                 # (ppermute over ICI) instead of GSPMD-inserted gathers
                 self.attn_fn = attention.make_ring_attention(mesh, axis_name="sp")
+            elif cfg.attn == "ringflash":
+                # same ring, but each step runs the pallas flash kernels on
+                # the visiting chunk pair — the long-context production path
+                self.attn_fn = attention.make_ring_flash_attention(
+                    mesh, axis_name="sp")
         if self.attn_fn is None:
             self.attn_fn = _resolve_attn_fn(cfg)
         self.ep_spec = moe_act_spec(cfg, mesh)
