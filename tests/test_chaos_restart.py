@@ -103,3 +103,67 @@ def test_controller_failover_via_leader_election():
         time.sleep(0.05)
     assert phase != ""
     b.stop()
+
+
+def test_permit_barrier_resolves_on_framework_close():
+    """Shutdown straggler: a pod that reaches the permit barrier during
+    teardown must still get its resolution callback (the failure path that
+    unreserves + forgets it). Framework.close() rejects remaining waiters
+    before killing the deadline sweeper; after close, new permit waits are
+    refused outright."""
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.fwk import CycleState
+    from tpusched.testing import make_pod, make_pod_group, make_tpu_node
+    from tpusched.testing.harness import new_test_framework
+
+    pg = make_pod_group("gang", min_member=2)
+    fw, handle, api = new_test_framework(
+        tpu_gang_profile(permit_wait_s=3600), nodes=[make_tpu_node("h0")])
+    api.create(srv.POD_GROUPS, pg)
+    member = make_pod("m0", pod_group="gang")
+    api.create(srv.PODS, member)
+
+    s = fw.run_permit_plugins(CycleState(), member, "h0")
+    assert s.is_wait()
+    resolved = []
+    fw.notify_on_permit(member, resolved.append)
+    assert resolved == []          # barrier still open
+
+    fw.close()
+    assert len(resolved) == 1
+    assert resolved[0].is_unschedulable()
+    assert "closing" in resolved[0].message()
+
+    # post-close registration is refused, not leaked
+    late = make_pod("m1", pod_group="gang")
+    api.create(srv.PODS, late)
+    s2 = fw.run_permit_plugins(CycleState(), late, "h0")
+    assert s2.is_unschedulable()
+    assert "closing" in s2.message()
+
+
+def test_permit_timeout_fires_via_sweeper_callback():
+    """Event-driven deadline: with nobody blocked in wait(), the framework's
+    sweeper must expire the barrier and fire the callback."""
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.fwk import CycleState
+    from tpusched.testing import make_pod, make_pod_group, make_tpu_node
+    from tpusched.testing.harness import new_test_framework
+
+    pg = make_pod_group("gang", min_member=2, schedule_timeout_seconds=1)
+    fw, handle, api = new_test_framework(
+        tpu_gang_profile(permit_wait_s=1), nodes=[make_tpu_node("h0")])
+    api.create(srv.POD_GROUPS, pg)
+    member = make_pod("m0", pod_group="gang")
+    api.create(srv.PODS, member)
+
+    s = fw.run_permit_plugins(CycleState(), member, "h0")
+    assert s.is_wait()
+    resolved = []
+    fw.notify_on_permit(member, resolved.append)
+    deadline = time.time() + 5
+    while not resolved and time.time() < deadline:
+        time.sleep(0.05)
+    assert resolved and resolved[0].is_unschedulable()
+    assert "timeout" in resolved[0].message()
+    assert fw.get_waiting_pod(member.meta.uid) is None  # entry removed

@@ -103,23 +103,71 @@ class _WaitingPod:
         now = time.monotonic()
         self._pending: Dict[str, float] = {p: now + t for p, t in plugin_timeouts.items()}
         self._status: Optional[Status] = None
+        self._callbacks: List = []
 
     def get_pending_plugins(self) -> List[str]:
         with self._cond:
             return list(self._pending)
 
+    def _take_callbacks_locked(self) -> List:
+        cbs, self._callbacks = self._callbacks, []
+        return cbs
+
+    @staticmethod
+    def _fire(cbs: List, status: Status) -> None:
+        for cb in cbs:
+            cb(status)
+
+    def add_done_callback(self, fn) -> None:
+        """fn(status) exactly once when the barrier resolves (allow-all,
+        rejection, or deadline) — immediately if it already has. The
+        callback runs on whichever thread resolves the pod; keep it cheap
+        (the scheduler's hands the bind off to its worker pool)."""
+        with self._cond:
+            if self._status is None:
+                self._callbacks.append(fn)
+                return
+            status = self._status
+        fn(status)
+
     def allow(self, plugin: str) -> None:
+        fire: List = []
         with self._cond:
             self._pending.pop(plugin, None)
             if not self._pending and self._status is None:
                 self._status = Status.success()
+            if self._status is not None:
+                fire = self._take_callbacks_locked()
             self._cond.notify_all()
+        self._fire(fire, self._status)
 
     def reject(self, plugin: str, msg: str) -> None:
         with self._cond:
             if self._status is None:
                 self._status = Status.unschedulable(msg).with_plugin(plugin)
+            fire = self._take_callbacks_locked()
             self._cond.notify_all()
+        self._fire(fire, self._status)
+
+    def deadline(self) -> Optional[float]:
+        """Earliest permit deadline (monotonic), None once resolved."""
+        with self._cond:
+            if self._status is not None or not self._pending:
+                return None
+            return min(self._pending.values())
+
+    def expire_if_due(self, now: float) -> None:
+        fire: List = []
+        with self._cond:
+            if self._status is None and self._pending \
+                    and min(self._pending.values()) <= now:
+                plugin = min(self._pending, key=self._pending.get)
+                self._status = Status.unschedulable(
+                    f"pod {self.pod.key} rejected: permit wait timeout"
+                ).with_plugin(plugin)
+                fire = self._take_callbacks_locked()
+                self._cond.notify_all()
+        self._fire(fire, self._status)
 
     def wait(self) -> Status:
         with self._cond:
@@ -230,6 +278,11 @@ class Framework:
         self.handle = handle
         self._waiting: Dict[str, _WaitingPod] = {}
         self._waiting_lock = threading.RLock()
+        # deadline sweeper for the event-driven permit barrier: started
+        # lazily on the first waiting pod; woken on registration and close
+        self._waiting_cv = threading.Condition(self._waiting_lock)
+        self._sweeper: Optional[threading.Thread] = None
+        self._closed = False
 
         plugins: Dict[str, Plugin] = {}
         for name in profile.all_plugin_names():
@@ -445,12 +498,28 @@ class Framework:
                 continue
             return s.with_plugin(p.name())
         if plugin_timeouts:
-            with self._waiting_lock:
+            with self._waiting_cv:
+                if self._closed:
+                    # closing framework: nothing will ever resolve or expire
+                    # this barrier — fail the pod now instead of leaking its
+                    # reserved state
+                    return Status.unschedulable(
+                        f"pod {pod.key} rejected: framework is closing")
                 self._waiting[pod.meta.uid] = _WaitingPod(pod, plugin_timeouts)
+                if self._sweeper is None:
+                    self._sweeper = threading.Thread(
+                        target=self._sweep_permit_deadlines,
+                        name="tpusched-permit-sweeper", daemon=True)
+                    self._sweeper.start()
+                self._waiting_cv.notify_all()
             return Status.wait()
         return status_code
 
     def wait_on_permit(self, pod: Pod) -> Status:
+        """Blocking WaitOnPermit (upstream scheduler.go:557 shape). The
+        scheduler's binding path uses notify_on_permit instead — one parked
+        OS thread per gang member doesn't survive contact with 256-pod
+        gangs; this stays for API parity and direct framework users."""
         with self._waiting_lock:
             wp = self._waiting.get(pod.meta.uid)
         if wp is None:
@@ -460,6 +529,47 @@ class Framework:
         finally:
             with self._waiting_lock:
                 self._waiting.pop(pod.meta.uid, None)
+
+    def notify_on_permit(self, pod: Pod, fn) -> None:
+        """Event-driven WaitOnPermit: fn(status) fires exactly once when the
+        pod's permit barrier resolves (immediately if the pod is not
+        waiting). The waitingPods entry is removed before fn runs."""
+        with self._waiting_lock:
+            wp = self._waiting.get(pod.meta.uid)
+        if wp is None:
+            fn(Status.success())
+            return
+
+        def done(status: Status) -> None:
+            with self._waiting_lock:
+                self._waiting.pop(pod.meta.uid, None)
+            fn(status)
+
+        wp.add_done_callback(done)
+
+    def _sweep_permit_deadlines(self) -> None:
+        """Enforce permit timeouts for callback-mode waiters: sleeps until
+        the earliest outstanding deadline, then expires due pods. wait()
+        callers enforce their own deadline; expire_if_due is a no-op on
+        already-resolved pods, so the two paths compose."""
+        while True:
+            with self._waiting_cv:
+                if self._closed:
+                    return
+                nxt = None
+                for wp in self._waiting.values():
+                    d = wp.deadline()
+                    if d is not None and (nxt is None or d < nxt):
+                        nxt = d
+                timeout = None if nxt is None \
+                    else max(0.01, nxt - time.monotonic())
+                self._waiting_cv.wait(timeout=timeout)
+                if self._closed:
+                    return
+                due = list(self._waiting.values())
+            now = time.monotonic()
+            for wp in due:  # fires callbacks — never under the lock
+                wp.expire_if_due(now)
 
     def iterate_over_waiting_pods(self, fn) -> None:
         with self._waiting_lock:
@@ -506,7 +616,19 @@ class Framework:
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
-        """Release plugin background resources (collector threads etc.)."""
+        """Release plugin background resources (collector threads etc.).
+        Any pod still at the permit barrier is rejected first — once the
+        sweeper dies nothing would ever resolve it, and its callback is what
+        runs the unreserve/forget failure path."""
+        with self._waiting_cv:
+            self._closed = True
+            stragglers = list(self._waiting.values())
+            self._waiting_cv.notify_all()
+        for wp in stragglers:
+            wp.reject("", "framework closing")
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5)
+            self._sweeper = None
         for p in self.plugins.values():
             closer = getattr(p, "close", None)
             if callable(closer):

@@ -10,6 +10,8 @@ upstream (vendored scheduler.go:425,557-604 in the reference tree).
 from __future__ import annotations
 
 import collections
+import os
+import queue
 import threading
 import time
 from typing import Dict, List, Optional
@@ -37,6 +39,65 @@ _KIND_TO_RESOURCE = {
     srv.ELASTIC_QUOTAS: RESOURCE_ELASTIC_QUOTA,
     srv.TPU_TOPOLOGIES: RESOURCE_TPU_TOPOLOGY,
 }
+
+
+class _BindingPool:
+    """Bounded DAEMON-thread task pool for post-permit binding work.
+
+    Not concurrent.futures: its workers are non-daemon and joined by an
+    atexit hook, so one wedged Bind API call would block both stop() and
+    interpreter exit forever. Daemon workers + a bounded-join drain keep the
+    old thread-per-bind shutdown contract — a stuck bind delays stop() by at
+    most the drain timeout and can never pin the process."""
+
+    def __init__(self, workers: int):
+        self._q: "queue.Queue" = queue.Queue()
+        self._open = True
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"tpusched-bind-{i}")
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, *args) -> None:
+        if not self._open:
+            raise RuntimeError("binding pool is shut down")
+        self._q.put((fn, args))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception as e:  # a binding task must never kill a worker
+                klog.error_s(e, "binding task panicked")
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Queued tasks drain first (FIFO before the sentinels); workers are
+        then joined with a shared bounded deadline. Tasks racing past the
+        open-check are drained inline afterwards so no pod's failure path is
+        silently dropped."""
+        self._open = False
+        for _ in self._threads:
+            self._q.put(None)
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                fn, args = item
+                try:
+                    fn(*args)
+                except Exception as e:
+                    klog.error_s(e, "binding task panicked during drain")
 
 
 class Scheduler:
@@ -79,10 +140,12 @@ class Scheduler:
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
-        # binding cycles deregister themselves on exit (O(1) vs scanning the
-        # whole list each schedule_one, which was O(gang²) on large gangs)
-        self._binding_lock = threading.Lock()
-        self._binding_threads: Dict[int, threading.Thread] = {}
+        # Binding cycles run on a bounded pool, dispatched only when the
+        # permit barrier RESOLVES (Framework.notify_on_permit) — not one
+        # parked thread per member. A 256-pod gang therefore costs zero
+        # binding threads while waiting and at most pool-width while
+        # draining, instead of 256 spawns + 256 blocked stacks per gang.
+        self._bind_pool = _BindingPool(max(4, min(16, os.cpu_count() or 4)))
         self._wire_informers()
 
     @property
@@ -169,15 +232,13 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
-        # unblock waiting gang members
+        # unblock waiting gang members; their resolution callbacks enqueue
+        # the (failing) binding tasks, which the pool drains before exit
         self._fw.iterate_over_waiting_pods(
             lambda wp: wp.reject("", "scheduler shutting down"))
         if self._sched_thread:
             self._sched_thread.join(timeout=5)
-        with self._binding_lock:
-            pending = list(self._binding_threads.values())
-        for t in pending:
-            t.join(timeout=5)
+        self._bind_pool.shutdown(timeout=5.0)
         self._par.close()
         self._fw.close()
 
@@ -245,13 +306,18 @@ class Scheduler:
         # sibling activation happens at end of the scheduling cycle
         self._activate_pods(pods_to_activate)
 
-        t = threading.Thread(target=self._binding_cycle,
-                             args=(state, info, assumed, node_name, start,
-                                   pods_to_activate),
-                             name=f"bind-{pod.name}", daemon=True)
-        with self._binding_lock:
-            self._binding_threads[id(t)] = t
-        t.start()
+        def on_permit_resolved(permit_status: Status,
+                               args=(state, info, assumed, node_name, start,
+                                     pods_to_activate)) -> None:
+            try:
+                self._bind_pool.submit(self._finish_binding, permit_status,
+                                       *args)
+            except RuntimeError:
+                # pool already shut down (scheduler stopping): run the
+                # failure path inline so the pod is not silently leaked
+                self._finish_binding(permit_status, *args)
+
+        self._fw.notify_on_permit(assumed, on_permit_resolved)
 
     def _timed_point(self, point: str, fn, *args):
         """framework_extension_point_duration_seconds recorder (upstream
@@ -412,21 +478,14 @@ class Scheduler:
             self.handle.pod_nominator.add_nominated_pod(pod, node)
             klog.V(4).info_s("preemption nominated node", pod=pod.key, node=node)
 
-    def _binding_cycle(self, state: CycleState, info: QueuedPodInfo,
-                       assumed: Pod, node_name: str, cycle_start: float,
-                       pods_to_activate: PodsToActivate) -> None:
-        try:
-            self._run_binding_cycle(state, info, assumed, node_name,
-                                    cycle_start, pods_to_activate)
-        finally:
-            with self._binding_lock:
-                self._binding_threads.pop(id(threading.current_thread()), None)
-
-    def _run_binding_cycle(self, state: CycleState, info: QueuedPodInfo,
-                           assumed: Pod, node_name: str, cycle_start: float,
-                           pods_to_activate: PodsToActivate) -> None:
+    def _finish_binding(self, permit_status: Status, state: CycleState,
+                        info: QueuedPodInfo, assumed: Pod, node_name: str,
+                        cycle_start: float,
+                        pods_to_activate: PodsToActivate) -> None:
+        """Post-permit half of the binding cycle, dispatched by
+        notify_on_permit once the barrier resolves."""
         pod = assumed
-        s = self._fw.wait_on_permit(pod)
+        s = permit_status
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self.cache.forget_pod(pod)
