@@ -80,3 +80,68 @@ def test_generate_greedy_is_deterministic():
 def test_invalid_gqa_config_fails_fast():
     with pytest.raises(ValueError, match="n_kv_heads"):
         dataclasses.replace(MHA, n_kv_heads=3)  # 2 heads % 3 != 0
+
+
+# -- sampling -----------------------------------------------------------------
+
+def test_sample_temperature_zero_is_greedy():
+    cfg = workload.ModelConfig.tiny()
+    params = workload.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    greedy = decode.generate(params, prompt, cfg, steps=6)
+    sampled = decode.sample(params, prompt, cfg, steps=6,
+                            key=jax.random.PRNGKey(9), temperature=0.0)
+    assert (greedy == sampled).all()
+
+
+def test_sample_top_k_one_is_greedy():
+    cfg = workload.ModelConfig.tiny()
+    params = workload.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    greedy = decode.generate(params, prompt, cfg, steps=6)
+    sampled = decode.sample(params, prompt, cfg, steps=6,
+                            key=jax.random.PRNGKey(9), temperature=1.0,
+                            top_k=1)
+    assert (greedy == sampled).all()
+
+
+def test_sample_token_distribution_matches_softmax():
+    """Statistical: categorical draws over a tiny vocab track the softmax."""
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 4000)
+    draws = jax.vmap(lambda k: decode.sample_token(logits, k))(keys)
+    counts = jnp.bincount(draws.reshape(-1), length=4) / 4000.0
+    np.testing.assert_allclose(counts, [0.5, 0.3, 0.15, 0.05], atol=0.04)
+
+
+def test_sample_token_top_k_masks_tail():
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(1), 500)
+    draws = jax.vmap(lambda k: decode.sample_token(logits, k, top_k=2))(keys)
+    assert set(np.unique(draws)) <= {0, 1}
+    # renormalized over the kept pair: 4:3 ratio
+    counts = jnp.bincount(draws.reshape(-1), length=4) / 500.0
+    np.testing.assert_allclose(counts[:2], [4 / 7, 3 / 7], atol=0.06)
+
+
+def test_sample_token_top_p_nucleus():
+    # token 0 alone carries 0.6 ≥ p → nucleus of exactly one token
+    logits = jnp.log(jnp.asarray([[0.6, 0.2, 0.15, 0.05]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(2), 200)
+    draws = jax.vmap(lambda k: decode.sample_token(logits, k, top_p=0.5))(keys)
+    assert set(np.unique(draws)) == {0}
+    # p=0.85: nucleus {0, 1, 2} (cum 0.6, 0.8, 0.95: third still needed)
+    draws2 = jax.vmap(lambda k: decode.sample_token(logits, k, top_p=0.85))(keys)
+    assert set(np.unique(draws2)) <= {0, 1, 2}
+    assert 2 in np.unique(draws2)
+
+
+def test_sample_temperature_sharpens():
+    """Low temperature concentrates mass on the argmax token."""
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(3), 500)
+    cold = jax.vmap(lambda k: decode.sample_token(logits, k,
+                                                  temperature=0.1))(keys)
+    # T=0.1 ⇒ p ∝ p_orig^10: token 0 holds ~0.945 of the mass
+    frac0 = float(jnp.mean((cold == 0).astype(jnp.float32)))
+    assert frac0 > 0.9

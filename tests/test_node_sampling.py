@@ -69,3 +69,50 @@ def test_512_gang_on_128_hosts_schedules_fully():
         assert len(used) == 128 and set(used.values()) == {4}
         # soft budget: scale roughly linearly with the bench (0.5s @ 256)
         assert elapsed < 30, f"512-gang took {elapsed:.1f}s"
+
+
+def test_1024_gang_permit_barrier_thread_economy():
+    """The event-driven barrier must hold a 1024-member gang with ZERO
+    parked binding threads while waiting and bind it fully once quorum
+    lands. Pre-redesign this would spawn 1024 OS threads blocked at
+    wait_on_permit."""
+    GANG = 1024
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=240)) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(8, 16, 8))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        assert len(nodes) == 256
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("huge", min_member=GANG,
+                                    tpu_slice_shape="8x16x8",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w{i:04d}", pod_group="huge", limits={TPU: 1},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(GANG)]
+        t0 = time.perf_counter()
+        c.create_pods(pods)
+
+        # while the quorum forms, binding threads stay bounded: only the
+        # pool's fixed workers exist, no thread-per-waiting-pod
+        import threading as _th
+        deadline = time.time() + 240
+        max_bind_threads = 0
+        while time.time() < deadline:
+            names = [t.name for t in _th.enumerate()]
+            max_bind_threads = max(
+                max_bind_threads,
+                sum(1 for n in names if n.startswith("tpusched-bind")))
+            assert not any(n.startswith("bind-") for n in names)
+            if c.pod_scheduled(pods[-1].key) and all(
+                    c.pod_scheduled(p.key) for p in pods[::101]):
+                break
+            time.sleep(0.25)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=60)
+        elapsed = time.perf_counter() - t0
+        assert max_bind_threads <= 16
+        used = {}
+        for p in pods:
+            node = c.pod(p.key).spec.node_name
+            used[node] = used.get(node, 0) + 1
+        assert len(used) == 256 and set(used.values()) == {4}
+        assert elapsed < 90, f"1024-gang took {elapsed:.1f}s"

@@ -105,23 +105,73 @@ def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
     return (x @ params["out"])[:, 0], new_cache
 
 
-def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
-             steps: int) -> jax.Array:
-    """Greedy generation: prefill the prompt, then ``steps`` decode steps via
-    lax.scan (static trip count; the cache threads through the scan carry)."""
-    # cast once up front: the per-call casts inside prefill/decode_step then
-    # trace to no-op converts instead of re-converting the tree every token
+def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """One sampling decision per row of ``logits`` (b, vocab) — temperature,
+    top-k, and nucleus (top-p) filtering composed in the usual order, all
+    static-shape so the decode loop jits. temperature == 0 is argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    vocab = logits.shape[-1]
+    use_top_k = 0 < top_k < vocab
+    if use_top_k or top_p < 1.0:
+        # one descending sort serves both filters — this runs inside every
+        # decode step, so a second O(V log V) pass matters
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if use_top_k:
+            kth = sorted_desc[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, attention.NEG_INF, logits)
+        if top_p < 1.0:
+            # nucleus over the (possibly top-k-masked) distribution: mask
+            # the sorted tail in sorted space rather than re-sorting
+            s_masked = sorted_desc
+            if use_top_k:
+                ranks = jnp.arange(vocab)[None, :]
+                s_masked = jnp.where(ranks < top_k, sorted_desc,
+                                     attention.NEG_INF)
+            probs = jax.nn.softmax(s_masked, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix with mass ≥ top_p (the first token
+            # always survives); entries whose PRECEDING mass already reached
+            # top_p drop. threshold = the smallest KEPT logit
+            dropped = (cum - probs) >= top_p
+            threshold = jnp.min(
+                jnp.where(dropped, jnp.inf, s_masked), axis=-1,
+                keepdims=True)
+            logits = jnp.where(logits >= threshold, logits,
+                               attention.NEG_INF)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
+           key: jax.Array, temperature: float = 1.0, top_k: int = 0,
+           top_p: float = 1.0) -> jax.Array:
+    """Stochastic generation: prefill then ``steps`` sampled decode steps
+    (PRNG key split per step inside the scan). temperature=0 reduces to
+    greedy `generate`."""
     params = cast_params_for_compute(params, cfg)
     b, s0 = prompt.shape
     cache = init_kv_cache(cfg, b, s0 + steps)
     logits, cache = prefill(params, cache, prompt, cfg)
-    first = jnp.argmax(logits[:, s0 - 1], axis=-1)
+    key, sub = jax.random.split(key)
+    first = sample_token(logits[:, s0 - 1], sub, temperature, top_k, top_p)
 
     def step(carry, t):
-        tok, cache = carry
+        tok, cache, key = carry
         logits, cache = decode_step(params, cache, tok, s0 + t, cfg)
-        nxt = jnp.argmax(logits, axis=-1)
-        return (nxt, cache), tok
+        key, sub = jax.random.split(key)
+        nxt = sample_token(logits, sub, temperature, top_k, top_p)
+        return (nxt, cache, key), tok
 
-    (last, _), toks = jax.lax.scan(step, (first, cache), jnp.arange(steps))
-    return jnp.concatenate([toks.T, last[:, None]], axis=1)  # (b, steps+1)
+    (last, _, _), toks = jax.lax.scan(step, (first, cache, key),
+                                      jnp.arange(steps))
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
+             steps: int) -> jax.Array:
+    """Greedy generation — `sample` at temperature 0 (argmax; the PRNG key
+    is never consumed). One prefill/scan loop definition serves both."""
+    return sample(params, prompt, cfg, steps, key=jax.random.PRNGKey(0),
+                  temperature=0.0)
