@@ -145,3 +145,12 @@ def test_sample_temperature_sharpens():
     # T=0.1 ⇒ p ∝ p_orig^10: token 0 holds ~0.945 of the mass
     frac0 = float(jnp.mean((cold == 0).astype(jnp.float32)))
     assert frac0 > 0.9
+
+
+def test_sample_token_top_p_zero_is_near_greedy():
+    """top_p=0.0 keeps exactly the rank-0 token — the most restrictive
+    nucleus, never mask-everything-and-go-uniform."""
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(4), 100)
+    draws = jax.vmap(lambda k: decode.sample_token(logits, k, top_p=0.0))(keys)
+    assert set(np.unique(draws)) == {0}

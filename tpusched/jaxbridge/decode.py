@@ -114,33 +114,24 @@ def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
     vocab = logits.shape[-1]
-    use_top_k = 0 < top_k < vocab
-    if use_top_k or top_p < 1.0:
-        # one descending sort serves both filters — this runs inside every
-        # decode step, so a second O(V log V) pass matters
+    if 0 < top_k < vocab:
+        # O(V log k): only the kth-largest value is needed as the threshold
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, attention.NEG_INF, logits)
+    if top_p < 1.0:
+        # nucleus over the (possibly top-k-masked) distribution — the one
+        # place a full sort is required; masked entries sort to the tail
+        # with ~zero mass and never enter the kept prefix
         sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-        if use_top_k:
-            kth = sorted_desc[:, top_k - 1][:, None]
-            logits = jnp.where(logits < kth, attention.NEG_INF, logits)
-        if top_p < 1.0:
-            # nucleus over the (possibly top-k-masked) distribution: mask
-            # the sorted tail in sorted space rather than re-sorting
-            s_masked = sorted_desc
-            if use_top_k:
-                ranks = jnp.arange(vocab)[None, :]
-                s_masked = jnp.where(ranks < top_k, sorted_desc,
-                                     attention.NEG_INF)
-            probs = jax.nn.softmax(s_masked, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # keep the smallest prefix with mass ≥ top_p (the first token
-            # always survives); entries whose PRECEDING mass already reached
-            # top_p drop. threshold = the smallest KEPT logit
-            dropped = (cum - probs) >= top_p
-            threshold = jnp.min(
-                jnp.where(dropped, jnp.inf, s_masked), axis=-1,
-                keepdims=True)
-            logits = jnp.where(logits >= threshold, logits,
-                               attention.NEG_INF)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass ≥ top_p; rank 0 ALWAYS
+        # survives (top_p == 0.0 must mean near-greedy, not mask-everything)
+        ranks = jnp.arange(vocab)[None, :]
+        dropped = ((cum - probs) >= top_p) & (ranks > 0)
+        threshold = jnp.min(
+            jnp.where(dropped, jnp.inf, sorted_desc), axis=-1, keepdims=True)
+        logits = jnp.where(logits >= threshold, logits, attention.NEG_INF)
     return jax.random.categorical(key, logits, axis=-1)
 
 
