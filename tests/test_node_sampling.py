@@ -77,6 +77,11 @@ def test_1024_gang_permit_barrier_thread_economy():
     lands. Pre-redesign this would spawn 1024 OS threads blocked at
     wait_on_permit."""
     GANG = 1024
+    import threading as _th
+    # other tests' pools may linger inside their 5s shutdown-join window on
+    # a loaded machine; assert the DELTA this cluster adds, not the global
+    baseline = sum(1 for t in _th.enumerate()
+                   if t.name.startswith("tpusched-bind"))
     with TestCluster(profile=tpu_gang_profile(permit_wait_s=240)) as c:
         topo, nodes = make_tpu_pool("pool-a", dims=(8, 16, 8))
         c.api.create(srv.TPU_TOPOLOGIES, topo)
@@ -94,7 +99,6 @@ def test_1024_gang_permit_barrier_thread_economy():
 
         # while the quorum forms, binding threads stay bounded: only the
         # pool's fixed workers exist, no thread-per-waiting-pod
-        import threading as _th
         deadline = time.time() + 240
         max_bind_threads = 0
         while time.time() < deadline:
@@ -109,7 +113,7 @@ def test_1024_gang_permit_barrier_thread_economy():
             time.sleep(0.25)
         assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=60)
         elapsed = time.perf_counter() - t0
-        assert max_bind_threads <= 16
+        assert max_bind_threads - baseline <= 16
         used = {}
         for p in pods:
             node = c.pod(p.key).spec.node_name

@@ -155,3 +155,28 @@ def test_histogram_vec_label_arity_checked():
         vec.with_labels("only-one")
     vec.with_labels("1", "2").observe(0.5)
     assert vec.children()[("1", "2")].count() == 1
+
+
+def test_plugin_execution_duration_metrics():
+    """plugin_execution_duration_seconds{plugin,extension_point} (upstream
+    parity): recorded at the cold points, never for the per-node sweeps."""
+    from tpusched.api.resources import TPU
+    from tpusched.testing import TestCluster, make_pod, make_tpu_node
+    from tpusched.util.metrics import plugin_execution_seconds
+
+    before = {k: h.count()
+              for k, h in plugin_execution_seconds.children().items()}
+
+    def grew(plugin, point):
+        h = plugin_execution_seconds.with_labels(plugin, point)
+        return h.count() > before.get((plugin, point), 0)
+
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        c.create_pods([make_pod("p", limits={TPU: 2})])
+        assert c.wait_for_pods_scheduled(["default/p"])
+    assert grew("TpuSlice", "Reserve")
+    assert grew("TpuSlice", "Bind")
+    # the hot per-node sweep is deliberately not per-plugin-instrumented
+    assert not any(point == "Filter"
+                   for (_, point) in plugin_execution_seconds.children())

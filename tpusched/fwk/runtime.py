@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.core import Node, Pod
 from ..util import klog
+from ..util.metrics import plugin_execution_seconds
 from .cycle_state import CycleState
 from .interfaces import (BatchFilterPlugin, BindPlugin, ClusterEvent,
                          EnqueueExtensions, FilterPlugin, NodeScore,
@@ -270,6 +271,22 @@ class Handle:
         self.clientset.record_event(obj_key, kind, etype, reason, message)
 
 
+
+def _timed_plugin(point: str, plugin_name: str, fn, *args):
+    """plugin_execution_duration_seconds{plugin,extension_point} recorder
+    (upstream parity). Wired only at the once-per-cycle extension points —
+    the per-node Filter/Score sweeps stay unrecorded per plugin on purpose
+    (an observation per plugin per node per pod would cost more than the
+    plugin bodies; the whole-sweep number lives in
+    framework_extension_point_duration_seconds instead)."""
+    t0 = time.perf_counter()
+    try:
+        return fn(*args)
+    finally:
+        plugin_execution_seconds.with_labels(plugin_name, point).observe(
+            time.perf_counter() - t0)
+
+
 class Framework:
     """One profile's compiled plugin set."""
 
@@ -333,7 +350,7 @@ class Framework:
     # -- prefilter -----------------------------------------------------------
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
         for p in self.pre_filter_plugins:
-            s = p.pre_filter(state, pod)
+            s = _timed_plugin("PreFilter", p.name(), p.pre_filter, state, pod)
             if s.is_skip():
                 state.skip_filter_plugins.add(p.name())
                 continue
@@ -409,7 +426,8 @@ class Framework:
                                 filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
         statuses: List[Status] = []
         for p in self.post_filter_plugins:
-            result, s = p.post_filter(state, pod, filtered_node_status_map)
+            result, s = _timed_plugin("PostFilter", p.name(), p.post_filter,
+                                      state, pod, filtered_node_status_map)
             s = s.with_plugin(p.name())
             if s.is_success():
                 return result, s
@@ -422,7 +440,8 @@ class Framework:
     def run_pre_score_plugins(self, state: CycleState, pod: Pod,
                               nodes: List[Node]) -> Status:
         for p in self.pre_score_plugins:
-            s = p.pre_score(state, pod, nodes)
+            s = _timed_plugin("PreScore", p.name(), p.pre_score, state, pod,
+                              nodes)
             if s.is_skip():
                 state.skip_score_plugins.add(p.name())
                 continue
@@ -472,17 +491,20 @@ class Framework:
     def run_reserve_plugins_reserve(self, state: CycleState, pod: Pod,
                                     node_name: str) -> Status:
         for i, p in enumerate(self.reserve_plugins):
-            s = p.reserve(state, pod, node_name)
+            s = _timed_plugin("Reserve", p.name(), p.reserve, state, pod,
+                              node_name)
             if not s.is_success():
                 for q in reversed(self.reserve_plugins[:i]):
-                    q.unreserve(state, pod, node_name)
+                    _timed_plugin("Unreserve", q.name(), q.unreserve, state,
+                                  pod, node_name)
                 return s.with_plugin(p.name())
         return Status.success()
 
     def run_reserve_plugins_unreserve(self, state: CycleState, pod: Pod,
                                       node_name: str) -> None:
         for p in reversed(self.reserve_plugins):
-            p.unreserve(state, pod, node_name)
+            _timed_plugin("Unreserve", p.name(), p.unreserve, state, pod,
+                          node_name)
 
     # -- permit --------------------------------------------------------------
     def run_permit_plugins(self, state: CycleState, pod: Pod,
@@ -490,7 +512,8 @@ class Framework:
         plugin_timeouts: Dict[str, float] = {}
         status_code = Status.success()
         for p in self.permit_plugins:
-            s, timeout = p.permit(state, pod, node_name)
+            s, timeout = _timed_plugin("Permit", p.name(), p.permit, state,
+                                       pod, node_name)
             if s.is_success():
                 continue
             if s.is_wait():
@@ -593,7 +616,8 @@ class Framework:
     def run_pre_bind_plugins(self, state: CycleState, pod: Pod,
                              node_name: str) -> Status:
         for p in self.pre_bind_plugins:
-            s = p.pre_bind(state, pod, node_name)
+            s = _timed_plugin("PreBind", p.name(), p.pre_bind, state, pod,
+                              node_name)
             if not s.is_success():
                 return s.with_plugin(p.name())
         return Status.success()
@@ -603,7 +627,7 @@ class Framework:
         if not self.bind_plugins:
             return Status.error("no bind plugin configured")
         for p in self.bind_plugins:
-            s = p.bind(state, pod, node_name)
+            s = _timed_plugin("Bind", p.name(), p.bind, state, pod, node_name)
             if s.is_skip():
                 continue
             return s.with_plugin(p.name()) if not s.is_success() else s
@@ -612,7 +636,8 @@ class Framework:
     def run_post_bind_plugins(self, state: CycleState, pod: Pod,
                               node_name: str) -> None:
         for p in self.post_bind_plugins:
-            p.post_bind(state, pod, node_name)
+            _timed_plugin("PostBind", p.name(), p.post_bind, state, pod,
+                          node_name)
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

@@ -189,3 +189,10 @@ extension_point_seconds = REGISTRY.histogram_vec(
     "tpusched_framework_extension_point_duration_seconds",
     ("extension_point",),
     "Per-cycle latency of each framework extension point.")
+# Per-plugin companion (upstream plugin_execution_duration_seconds): wired
+# only at the once-per-cycle points — never inside the per-node Filter/Score
+# sweeps (see fwk/runtime._timed_plugin).
+plugin_execution_seconds = REGISTRY.histogram_vec(
+    "tpusched_plugin_execution_duration_seconds",
+    ("plugin", "extension_point"),
+    "Per-invocation plugin latency at the cold extension points.")
