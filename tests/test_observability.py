@@ -180,3 +180,28 @@ def test_plugin_execution_duration_metrics():
     # the hot per-node sweep is deliberately not per-plugin-instrumented
     assert not any(point == "Filter"
                    for (_, point) in plugin_execution_seconds.children())
+
+
+def test_pending_pods_gauges():
+    """pending_pods{queue=...} (upstream parity), computed at scrape time:
+    an unschedulable pod shows up in the unschedulable gauge and the
+    exposition carries the queue label."""
+    from tpusched.api.resources import TPU
+    from tpusched.testing import TestCluster, make_pod, make_tpu_node
+
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        c.create_pods([make_pod("nofit", limits={TPU: 64})])
+        assert c.wait_for_pods_unscheduled(["default/nofit"])
+
+        def unsched_count():
+            return c.scheduler.queue.pending_counts()["unschedulable"]
+        deadline = threading.Event()
+        for _ in range(100):
+            if unsched_count() == 1:
+                break
+            deadline.wait(0.05)
+        assert unsched_count() == 1
+        text = REGISTRY.expose()
+        assert 'tpusched_pending_pods{queue="unschedulable"} 1' in text
+        assert 'tpusched_pending_pods{queue="active"} 0' in text

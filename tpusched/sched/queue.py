@@ -114,6 +114,15 @@ class SchedulingQueue:
         self._cluster_event_map = cluster_event_map or {}
         self._closed = False
 
+    def pending_counts(self) -> Dict[str, int]:
+        """Queue depths for the pending_pods{queue=...} gauges (upstream
+        kube-scheduler metric). (pending_pods() below returns the pod
+        objects themselves — the introspection API.)"""
+        with self._lock:
+            return {"active": len(self._active),
+                    "backoff": len(self._backoff),
+                    "unschedulable": len(self._unschedulable)}
+
     # -- producers ------------------------------------------------------------
 
     def add(self, pod: Pod) -> None:

@@ -125,6 +125,15 @@ class Scheduler:
             else:
                 cluster_event_map[name] = [WILDCARD_EVENT]
         self.queue = SchedulingQueue(self._fw.less, cluster_event_map, clock)
+        # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
+        # computed at scrape time from the live queue
+        from ..util.metrics import REGISTRY
+        for q in ("active", "backoff", "unschedulable"):
+            REGISTRY.gauge_func(
+                "tpusched_pending_pods",
+                lambda q=q: self.queue.pending_counts()[q],
+                "Pods pending per scheduling sub-queue.",
+                labels=f'queue="{q}"')
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
         # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin

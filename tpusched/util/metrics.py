@@ -39,6 +39,26 @@ class Gauge(Counter):
             self._value = v
 
 
+class GaugeFunc:
+    """Scrape-time gauge: value() calls a provider. Re-registering replaces
+    the provider, so a restarted component (new scheduler in-process, as the
+    test harness does constantly) takes over its metric instead of leaving a
+    stale closure over dead state."""
+
+    def __init__(self, name: str, fn, help_: str = "", labels: str = ""):
+        self.name, self.help, self.labels = name, help_, labels
+        self._fn = fn
+
+    def set_fn(self, fn) -> None:
+        self._fn = fn
+
+    def value(self) -> float:
+        try:
+            return float(self._fn())
+        except Exception:
+            return 0.0
+
+
 class Histogram:
     def __init__(self, name: str, help_: str = "",
                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
@@ -129,6 +149,13 @@ class Registry:
                       buckets=_DEFAULT_BUCKETS) -> HistogramVec:
         return self._get_or_make(
             name, lambda: HistogramVec(name, label_names, help_, buckets))
+
+    def gauge_func(self, name: str, fn, help_: str = "",
+                   labels: str = "") -> GaugeFunc:
+        key = f"{name}{{{labels}}}" if labels else name
+        g = self._get_or_make(key, lambda: GaugeFunc(name, fn, help_, labels))
+        g.set_fn(fn)
+        return g
 
     def _get_or_make(self, name, ctor):
         with self._lock:
