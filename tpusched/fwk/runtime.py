@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.core import Node, Pod
-from ..util import klog
+from ..util import klog, metrics
 from ..util.metrics import plugin_execution_seconds
 from .cycle_state import CycleState
 from .interfaces import (BatchFilterPlugin, BindPlugin, ClusterEvent,
@@ -279,12 +279,8 @@ def _timed_plugin(point: str, plugin_name: str, fn, *args):
     (an observation per plugin per node per pod would cost more than the
     plugin bodies; the whole-sweep number lives in
     framework_extension_point_duration_seconds instead)."""
-    t0 = time.perf_counter()
-    try:
-        return fn(*args)
-    finally:
-        plugin_execution_seconds.with_labels(plugin_name, point).observe(
-            time.perf_counter() - t0)
+    return metrics.timed_call(
+        plugin_execution_seconds.with_labels(plugin_name, point), fn, *args)
 
 
 class Framework:

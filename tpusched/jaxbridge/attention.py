@@ -528,7 +528,7 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    perm_fn = lambda m: [(i, (i + 1) % m) for i in range(m)]
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
     # t = 0: the resident (diagonal) chunk pair — the only causal one
     out0, lse0 = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
@@ -548,8 +548,8 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, block_q, block_k,
 
     def step(carry, t):
         o_acc, l_acc, k_cur, v_cur = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm_fn(n))
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm_fn(n))
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         src = (my - t) % n                    # global chunk now visiting
         if causal:
             o_t, l_t = jax.lax.cond(src < my, compute, skip, k_cur, v_cur)

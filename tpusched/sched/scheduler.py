@@ -126,14 +126,19 @@ class Scheduler:
                 cluster_event_map[name] = [WILDCARD_EVENT]
         self.queue = SchedulingQueue(self._fw.less, cluster_event_map, clock)
         # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
-        # computed at scrape time from the live queue
+        # computed at scrape time from the live queue. weakref: the global
+        # registry must not keep a stopped scheduler (and everything it
+        # holds) alive through the provider closure
+        import weakref
         from ..util.metrics import REGISTRY
+        queue_ref = weakref.ref(self.queue)
         for q in ("active", "backoff", "unschedulable"):
-            REGISTRY.gauge_func(
-                "tpusched_pending_pods",
-                lambda q=q: self.queue.pending_counts()[q],
-                "Pods pending per scheduling sub-queue.",
-                labels=f'queue="{q}"')
+            def depth(q=q, ref=queue_ref):
+                live = ref()
+                return live.pending_counts()[q] if live is not None else 0
+            REGISTRY.gauge_func("tpusched_pending_pods", depth,
+                                "Pods pending per scheduling sub-queue.",
+                                labels=f'queue="{q}"')
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
         # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
@@ -331,12 +336,9 @@ class Scheduler:
     def _timed_point(self, point: str, fn, *args):
         """framework_extension_point_duration_seconds recorder (upstream
         parity; see the metric's divergence note in util/metrics.py)."""
-        t0 = time.perf_counter()
-        try:
-            return fn(*args)
-        finally:
-            extension_point_seconds.with_labels(point).observe(
-                time.perf_counter() - t0)
+        from ..util.metrics import timed_call
+        return timed_call(extension_point_seconds.with_labels(point),
+                          fn, *args)
 
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
         """genericScheduler.Schedule analog: prefilter → filter → score."""

@@ -208,6 +208,18 @@ pod_group_to_bound_seconds = REGISTRY.histogram(
 schedule_attempts = REGISTRY.counter(
     "tpusched_schedule_attempts_total", "Scheduling cycles run.")
 bind_total = REGISTRY.counter("tpusched_bind_total", "Successful binds.")
+def timed_call(hist: Histogram, fn, *args):
+    """Run fn(*args), observing its wall time into ``hist`` (including on
+    exception). The shared body of the extension-point and per-plugin
+    duration recorders."""
+    import time as _time
+    t0 = _time.perf_counter()
+    try:
+        return fn(*args)
+    finally:
+        hist.observe(_time.perf_counter() - t0)
+
+
 # Upstream framework_extension_point_duration_seconds analog. Deliberate
 # divergence: the per-node Filter/Score sweeps are recorded once per CYCLE
 # (the whole sweep), not once per node — at 1024-host scale a per-node
