@@ -154,3 +154,48 @@ def test_sample_token_top_p_zero_is_near_greedy():
     keys = jax.random.split(jax.random.PRNGKey(4), 100)
     draws = jax.vmap(lambda k: decode.sample_token(logits, k, top_p=0.0))(keys)
     assert set(np.unique(draws)) == {0}
+
+
+def test_continuous_batch_per_sequence_positions():
+    """decode_step with a (b,) position array: two sequences at DIFFERENT
+    decode positions in one batch must produce exactly the logits each
+    yields when decoded alone — the continuous-batching contract."""
+    cfg = MHA
+    params = workload.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(7), (2, 12), 0, cfg.vocab)
+    starts = (8, 5)
+    max_seq = 16
+
+    # independent single-sequence references, two steps each
+    solo_logits = []
+    solo_caches = []
+    for r, s0 in enumerate(starts):
+        cache = decode.init_kv_cache(cfg, 1, max_seq)
+        _, cache = decode.prefill(params, cache, toks[r:r + 1, :s0], cfg)
+        l1, cache = decode.decode_step(params, cache, toks[r:r + 1, s0],
+                                       s0, cfg)
+        l2, cache = decode.decode_step(params, cache, toks[r:r + 1, s0 + 1],
+                                       s0 + 1, cfg)
+        solo_logits.append((l1, l2))
+        solo_caches.append(cache)
+
+    # batched with per-row positions: prefill each row into a shared
+    # batched cache (what a serving loop does when a request joins)
+    cache = decode.init_kv_cache(cfg, 2, max_seq)
+    for r, s0 in enumerate(starts):
+        row = decode.init_kv_cache(cfg, 1, max_seq)
+        _, row = decode.prefill(params, row, toks[r:r + 1, :s0], cfg)
+        for i in range(cfg.n_layers):
+            cache[i]["k"] = cache[i]["k"].at[r].set(row[i]["k"][0])
+            cache[i]["v"] = cache[i]["v"].at[r].set(row[i]["v"][0])
+
+    pos = jnp.asarray(starts)
+    l1, cache = decode.decode_step(
+        params, cache, jnp.stack([toks[0, 8], toks[1, 5]]), pos, cfg)
+    l2, cache = decode.decode_step(
+        params, cache, jnp.stack([toks[0, 9], toks[1, 6]]), pos + 1, cfg)
+    for r in range(2):
+        np.testing.assert_allclose(l1[r], solo_logits[r][0][0],
+                                   atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(l2[r], solo_logits[r][1][0],
+                                   atol=3e-5, rtol=3e-5)

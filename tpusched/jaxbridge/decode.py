@@ -17,8 +17,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import attention
-from .workload import (ModelConfig, Params, _finish_block, _qkv, _rmsnorm,
-                       _resolve_attn_fn, cast_params_for_compute)
+from .workload import (ModelConfig, Params, _as_pos_vec, _finish_block,
+                       _qkv, _rmsnorm, _resolve_attn_fn,
+                       cast_params_for_compute)
 
 KVCache = List[Dict[str, jax.Array]]
 
@@ -33,20 +34,36 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
 def _cached_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
                       pos, n_rep: int) -> jax.Array:
     """q (b, s_q, h, hd) against the GQA cache up to ``pos + s_q - 1``;
-    positions beyond are masked, keeping shapes static under jit. The group
-    axis is folded into the einsum — the kv_heads-sized cache is never
-    expanded to n_heads, which is the GQA bandwidth win."""
+    positions beyond are masked, keeping shapes static under jit. ``pos``
+    is a scalar or a (b,) array (continuous batching: per-sequence decode
+    positions). The group axis is folded into the einsum — the
+    kv_heads-sized cache is never expanded to n_heads, which is the GQA
+    bandwidth win."""
     b, s_q, h, hd = q.shape
     kv = ck.shape[2]
     qg = q.reshape(b, s_q, kv, n_rep, hd)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck) / np.sqrt(hd)
     max_seq = ck.shape[1]
-    q_pos = pos + jnp.arange(s_q)[:, None]           # absolute query positions
-    k_pos = jnp.arange(max_seq)[None, :]
-    logits = jnp.where((k_pos <= q_pos)[None, None, None], logits,
-                       attention.NEG_INF)
+    off = _as_pos_vec(pos)
+    # (b|1, s_q) absolute query positions vs (max_seq,) key positions
+    q_pos = off[:, None] + jnp.arange(s_q)[None, :]
+    mask = q_pos[:, None, None, :, None] >= jnp.arange(max_seq)
+    logits = jnp.where(mask, logits, attention.NEG_INF)
     attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bgrqk,bkgd->bqgrd", attn, cv).reshape(b, s_q, h, hd)
+
+
+def _cache_write(cache: jax.Array, new: jax.Array, pos) -> jax.Array:
+    """Write ``new`` (b, s_q, kv, hd) into the cache at sequence offset
+    ``pos`` — scalar (whole batch aligned) or (b,) per-sequence positions
+    (continuous batching: each row writes at its own offset)."""
+    off = jnp.asarray(pos)
+    if off.ndim == 0:
+        return jax.lax.dynamic_update_slice(cache, new, (0, off, 0, 0))
+    # (b,) per-row offsets: one dynamic_update_slice per row
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+    )(cache, new, off)
 
 
 def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
@@ -56,8 +73,8 @@ def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
     with the training forward so the two can never desynchronize."""
     h = _rmsnorm(x, layer["ln_attn"])
     q, k, v = _qkv(h, layer, cfg, pos_offset=pos)
-    ck = jax.lax.dynamic_update_slice(c["k"], k, (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(c["v"], v, (0, pos, 0, 0))
+    ck = _cache_write(c["k"], k, pos)
+    cv = _cache_write(c["v"], v, pos)
     o = _cached_attention(q, ck, cv, pos, cfg.n_heads // cfg.kv_heads)
     out, _ = _finish_block(x, layer, o, cfg)   # aux loss is a train concern
     return out, {"k": ck, "v": cv}
@@ -70,8 +87,8 @@ def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
     cache matrix) while K/V are recorded into the cache at position 0."""
     h = _rmsnorm(x, layer["ln_attn"])
     q, k, v = _qkv(h, layer, cfg)
-    ck = jax.lax.dynamic_update_slice(c["k"], k, (0, 0, 0, 0))
-    cv = jax.lax.dynamic_update_slice(c["v"], v, (0, 0, 0, 0))
+    ck = _cache_write(c["k"], k, 0)
+    cv = _cache_write(c["v"], v, 0)
     out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
     return out, {"k": ck, "v": cv}
 
@@ -93,8 +110,10 @@ def prefill(params: Params, cache: KVCache, tokens: jax.Array,
 
 def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
                 cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
-    """One token per sequence: tokens_t (b,) at absolute position ``pos``
-    (scalar, traceable). Returns (logits (b, vocab), updated cache)."""
+    """One token per sequence: tokens_t (b,) at absolute position ``pos`` —
+    a traceable scalar, or a (b,) array for continuous batching where every
+    sequence sits at its own position (requests join/leave the batch
+    mid-flight). Returns (logits (b, vocab), updated cache)."""
     params = cast_params_for_compute(params, cfg)
     x = params["embed"][tokens_t][:, None, :]
     new_cache: KVCache = []

@@ -149,22 +149,33 @@ def _rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * w
 
 
+def _as_pos_vec(pos) -> jax.Array:
+    """Position normalization shared by rotary, the decode cache write, and
+    the cached-attention mask: a scalar (training / uniform decode) or a
+    (b,) array (continuous batching, every sequence at its own position)
+    becomes a rank-1 array that broadcasts over batch."""
+    off = jnp.asarray(pos)
+    return off[None] if off.ndim == 0 else off
+
+
 def _rotary(x: jax.Array, pos_offset=0) -> jax.Array:
     """Rotary position embedding over the head dim (pairs). ``pos_offset``
-    shifts absolute positions (KV-cache decode at position t)."""
+    shifts absolute positions: a scalar or a (b,) array (see _as_pos_vec)."""
     b, s, h, hd = x.shape
     half = hd // 2
-    pos = pos_offset + jnp.arange(s)[:, None]
+    off = _as_pos_vec(pos_offset)
+    pos = off[:, None] + jnp.arange(s)[None, :]      # (b or 1, s)
     inv_freq = 1.0 / (10000 ** (jnp.arange(half) / half))
-    ang = (pos * inv_freq)[None, :, None, :]
+    ang = pos[:, :, None, None] * inv_freq           # (b or 1, s, 1, half)
     x1, x2 = x[..., :half], x[..., half:]
     cos, sin = jnp.cos(ang).astype(x.dtype), jnp.sin(ang).astype(x.dtype)
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
 def _qkv(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
-         pos_offset: int = 0):
-    """Projections + rotary. K/V carry cfg.kv_heads heads (GQA)."""
+         pos_offset=0):
+    """Projections + rotary. K/V carry cfg.kv_heads heads (GQA).
+    ``pos_offset``: scalar or (b,) per-sequence positions (_as_pos_vec)."""
     b, s, _ = h.shape
     hd = cfg.d_model // cfg.n_heads
     q = _rotary((h @ p["wq"]).reshape(b, s, cfg.n_heads, hd), pos_offset)
