@@ -92,3 +92,24 @@ def test_token_batcher_deterministic_and_sharded():
     # different seed, different stream
     d = next(iter(TokenBatcher(cfg, 8, tshard, seed=8)))
     assert not np.array_equal(d, a[0])
+
+
+def test_pack_documents():
+    from tpusched.jaxbridge.data import pack_documents
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12]]
+    rows = pack_documents(docs, seq=8, eos=99, pad=0)
+    flat = [t for r in rows for t in r]
+    # every token survives in order; exactly one eos per document
+    content = [t for t in flat if t not in (0,)]
+    assert content == [1, 2, 3, 99, 4, 5, 99, 6, 7, 8, 9, 10, 11, 12, 99]
+    assert flat.count(99) == len(docs)
+    assert rows.shape[1] == 8
+    # a document longer than a whole row splits without a phantom eos
+    long = pack_documents([list(range(1, 20))], seq=8, eos=99)
+    lflat = [t for r in long for t in r]
+    assert lflat.count(99) == 1
+    assert lflat[19] == 99
+    # full utilization: only the final row may carry padding
+    for r in rows[:-1]:
+        assert 0 not in r
+    assert pack_documents([], seq=8, eos=99).shape == (0, 8)

@@ -17,6 +17,33 @@ import numpy as np
 from .workload import ModelConfig
 
 
+def pack_documents(docs, seq: int, eos: int, pad: int = 0) -> np.ndarray:
+    """Greedy sequence packing: variable-length token documents become
+    fixed (rows, seq) int32 rows, each document terminated by ``eos``,
+    rows padded with ``pad``. Standard TPU-efficiency transform — fixed
+    shapes keep the train step compiled once, and packing recovers the
+    compute that padding short documents to ``seq`` would burn (the MXU
+    runs the same FLOPs either way; packed rows make them useful).
+
+    Documents longer than a row split across rows; ``eos`` appears exactly
+    once per document, at its true end. Attention is allowed to flow across
+    document boundaries within a row (the simple packing regime) —
+    segment-masked variants belong in the attention impls, not the packer.
+    """
+    rows = []
+    buf: list = []
+    for doc in docs:
+        buf.extend(doc)
+        buf.append(eos)
+        while len(buf) >= seq:
+            rows.append(buf[:seq])
+            buf = buf[seq:]
+    if buf:
+        rows.append(buf + [pad] * (seq - len(buf)))
+    return np.asarray(rows, dtype=np.int32) if rows else \
+        np.zeros((0, seq), dtype=np.int32)
+
+
 class TokenBatcher:
     """Deterministic synthetic LM corpus (seeded PRNG over the vocab),
     yielding (batch, seq) int32 arrays placed with ``sharding``.

@@ -201,6 +201,12 @@ def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     standard GShard ordering. Dropped tokens (capacity overflow) pass through
     the residual only. Aux loss is the switch-transformer load-balance term
     E·Σ_e f_e·P_e.
+
+    Scale note: the one-hot dispatch/combine tensors are O(k·n·E·C) — sized
+    for the ep-SHARDED regime, where n is the per-device token count. On a
+    single device with a large global batch they dominate memory and compile
+    time; a ragged/sort-based dispatch (Megablocks-style) is the upgrade
+    path if that regime ever matters here.
     """
     b, s, d = h.shape
     e, k = cfg.n_experts, cfg.moe_top_k
