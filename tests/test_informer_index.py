@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from tpusched.api.scheduling import (POD_GROUP_INDEX, POD_GROUP_LABEL,
                                      pod_group_index_key)
-from tpusched.apiserver import InformerFactory
+from tpusched.apiserver import APIServer, InformerFactory
 from tpusched.apiserver import server as srv
 from tpusched.testing import make_pod
 
@@ -49,3 +49,48 @@ def test_index_scoped_by_namespace():
                                   labels={POD_GROUP_LABEL: "g"}))
     assert keys(informer, "default/g") == ["default/a"]
     assert keys(informer, "other/g") == ["other/a"]
+
+
+def test_handler_exceptions_are_isolated():
+    """One raising handler must not starve later handlers of the event nor
+    propagate into the mutating API call (delivery is synchronous under the
+    write here — client-go's per-listener processors give the analogous
+    isolation)."""
+    api = APIServer()
+    informers = InformerFactory(api)
+    pods = informers.pods()
+    seen = []
+
+    def bad(obj):
+        raise RuntimeError("buggy plugin handler")
+
+    pods.add_event_handler(on_add=bad, on_delete=bad)
+    pods.add_event_handler(on_add=lambda o: seen.append(("add", o.meta.name)),
+                           on_delete=lambda o: seen.append(("del", o.meta.name)))
+
+    p = make_pod("p1")
+    api.create(srv.PODS, p)        # must not raise despite `bad`
+    api.delete(srv.PODS, p.key)
+    assert seen == [("add", "p1"), ("del", "p1")]
+    # cache stayed consistent through the bad handler
+    assert pods.get("default/p1") is None
+
+
+def test_handler_exceptions_isolated_during_replay():
+    """Registration with a pre-populated cache: a raising on_add must not
+    abort the replay of remaining cached objects nor escape the registering
+    constructor."""
+    api = APIServer()
+    for i in range(3):
+        api.create(srv.PODS, make_pod(f"p{i}"))
+    informers = InformerFactory(api)
+    pods = informers.pods()
+    seen = []
+
+    def bad_then_record(obj):
+        if obj.meta.name == "p0":
+            raise RuntimeError("boom on first replayed object")
+        seen.append(obj.meta.name)
+
+    pods.add_event_handler(on_add=bad_then_record)   # must not raise
+    assert sorted(seen) == ["p1", "p2"]

@@ -57,15 +57,28 @@ class Informer:
                     self._index_remove(old)
                 self._cache[key] = ev.object
                 self._index_insert(ev.object)
+        # per-handler isolation (client-go's processor gives each listener
+        # its own delivery): one handler raising must not starve the other
+        # handlers of the event, nor propagate into the watch source —
+        # handlers run synchronously under the mutating API call here, so an
+        # unisolated raise would surface as a failure of an unrelated write
         if ev.type == srv.ADDED:
             for h in list(self._on_add):
-                h(ev.object)
+                self._dispatch(h, ev.object)
         elif ev.type == srv.MODIFIED:
             for h in list(self._on_update):
-                h(ev.old_object, ev.object)
+                self._dispatch(h, ev.old_object, ev.object)
         else:
             for h in list(self._on_delete):
-                h(ev.object)
+                self._dispatch(h, ev.object)
+
+    def _dispatch(self, handler, *args) -> None:
+        try:
+            handler(*args)
+        except Exception as e:
+            from ..util import klog
+            klog.error_s(e, "informer event handler panicked",
+                         kind=self.kind)
 
     def add_event_handler(self, on_add=None, on_update=None, on_delete=None,
                           replay: bool = True):
@@ -85,7 +98,7 @@ class Informer:
             if on_delete:
                 self._on_delete.append(on_delete)
         for o in existing:
-            on_add(o)
+            self._dispatch(on_add, o)   # same isolation as live delivery
         return (on_add, on_update, on_delete)
 
     def remove_event_handler(self, registration) -> None:

@@ -29,19 +29,17 @@ def pack_documents(docs, seq: int, eos: int, pad: int = 0) -> np.ndarray:
     once per document, at its true end. Attention is allowed to flow across
     document boundaries within a row (the simple packing regime) —
     segment-masked variants belong in the attention impls, not the packer.
+
+    Greedy packing with no bin choice is just flatten-then-reshape: O(n).
     """
-    rows = []
-    buf: list = []
+    flat: list = []
     for doc in docs:
-        buf.extend(doc)
-        buf.append(eos)
-        while len(buf) >= seq:
-            rows.append(buf[:seq])
-            buf = buf[seq:]
-    if buf:
-        rows.append(buf + [pad] * (seq - len(buf)))
-    return np.asarray(rows, dtype=np.int32) if rows else \
-        np.zeros((0, seq), dtype=np.int32)
+        flat.extend(doc)
+        flat.append(eos)
+    if not flat:
+        return np.zeros((0, seq), dtype=np.int32)
+    flat.extend([pad] * (-len(flat) % seq))
+    return np.asarray(flat, dtype=np.int32).reshape(-1, seq)
 
 
 class TokenBatcher:
