@@ -1,0 +1,150 @@
+"""API-server semantics tables: create/update/patch/delete contracts,
+resource versions, bind conflicts, watch delivery and replay — the
+storage-layer behavior every informer, controller, and scheduler path sits
+on (the reference delegates all of this to a real kube-apiserver; here it
+must be pinned by its own tests)."""
+import pytest
+
+from tpusched.api.core import Binding
+from tpusched.apiserver import server as srv
+from tpusched.testing import make_pod
+
+
+def test_create_conflict_and_get_notfound():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    with pytest.raises(srv.Conflict):
+        api.create(srv.PODS, make_pod("p"))
+    with pytest.raises(srv.NotFound):
+        api.get(srv.PODS, "default/ghost")
+    assert api.try_get(srv.PODS, "default/ghost") is None
+
+
+def test_resource_version_bumps_on_every_mutation():
+    api = srv.APIServer()
+    created = api.create(srv.PODS, make_pod("p"))
+    rv0 = created.meta.resource_version
+    patched = api.patch(srv.PODS, "default/p",
+                        lambda p: p.meta.labels.update({"a": "1"}))
+    assert patched.meta.resource_version > rv0
+    other = api.create(srv.PODS, make_pod("q"))
+    # one global monotonic sequence across objects (etcd-style)
+    assert other.meta.resource_version > patched.meta.resource_version
+
+
+def test_update_requires_existing_object():
+    api = srv.APIServer()
+    with pytest.raises(srv.NotFound):
+        api.update(srv.PODS, make_pod("nope"))
+
+
+def test_patch_mutation_is_atomic_against_reads():
+    """patch() applies the mutation to the live object under the store lock;
+    a mutation that raises must leave the object unchanged."""
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    before = api.get(srv.PODS, "default/p")
+
+    def bad(p):
+        p.meta.labels["half"] = "written"
+        raise RuntimeError("mutation failed mid-way")
+
+    with pytest.raises(RuntimeError):
+        api.patch(srv.PODS, "default/p", bad)
+    after = api.get(srv.PODS, "default/p")
+    assert after.meta.resource_version == before.meta.resource_version
+    assert "half" not in after.meta.labels
+
+
+def test_reads_return_copies_not_store_references():
+    """get() hands out copies: caller-side mutation must not write through
+    to the store (the scheduler deepcopies before assuming for this
+    contract)."""
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    got = api.get(srv.PODS, "default/p")
+    got.meta.labels["rogue"] = "edit"
+    assert "rogue" not in api.get(srv.PODS, "default/p").meta.labels
+
+
+def test_list_namespace_filter():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("a", namespace="team-a"))
+    api.create(srv.PODS, make_pod("b", namespace="team-b"))
+    assert [p.meta.name for p in api.list(srv.PODS, namespace="team-a")] == ["a"]
+    assert len(api.list(srv.PODS)) == 2
+
+
+def test_bind_sets_node_and_conflicts_when_rebinding():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    api.bind(Binding(pod_key="default/p", node_name="n1",
+                     annotations={"chip": "0"}))
+    bound = api.get(srv.PODS, "default/p")
+    assert bound.spec.node_name == "n1"
+    assert bound.meta.annotations["chip"] == "0"   # annotations ride the bind
+    with pytest.raises(srv.Conflict):
+        api.bind(Binding(pod_key="default/p", node_name="n2"))
+
+
+def test_watch_delivery_order_and_types():
+    api = srv.APIServer()
+    seen = []
+    api.add_watch(srv.PODS, lambda ev: seen.append(
+        (ev.type, ev.object.meta.name)))
+    api.create(srv.PODS, make_pod("p"))
+    api.patch(srv.PODS, "default/p", lambda p: None or
+              p.meta.labels.update({"x": "1"}))
+    api.delete(srv.PODS, "default/p")
+    assert seen == [(srv.ADDED, "p"), (srv.MODIFIED, "p"), (srv.DELETED, "p")]
+
+
+def test_watch_replay_delivers_existing_objects_as_adds():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("old1"))
+    api.create(srv.PODS, make_pod("old2"))
+    seen = []
+    api.add_watch(srv.PODS, lambda ev: seen.append((ev.type,
+                                                    ev.object.meta.name)),
+                  replay=True)
+    assert sorted(seen) == [(srv.ADDED, "old1"), (srv.ADDED, "old2")]
+    api.create(srv.PODS, make_pod("new"))
+    assert seen[-1] == (srv.ADDED, "new")
+
+
+def test_modified_events_carry_old_object():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    olds = []
+    api.add_watch(srv.PODS, lambda ev: olds.append(ev.old_object)
+                  if ev.type == srv.MODIFIED else None)
+    api.patch(srv.PODS, "default/p",
+              lambda p: p.meta.labels.update({"gen": "2"}))
+    assert len(olds) == 1
+    assert "gen" not in olds[0].meta.labels   # the pre-mutation snapshot
+
+
+def test_events_ring_records_most_recent():
+    api = srv.APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    api.record_event("default/p", "Pod", "Warning", "FailedScheduling", "no")
+    api.record_event("default/p", "Pod", "Normal", "Scheduled", "ok")
+    evs = api.events()
+    assert [e.reason for e in evs[-2:]] == ["FailedScheduling", "Scheduled"]
+
+
+def test_lease_acquire_renew_and_steal_after_expiry():
+    now = [1000.0]
+    api = srv.APIServer(clock=lambda: now[0])
+    assert api.acquire_or_renew_lease("lock", "a", lease_duration=10)
+    assert not api.acquire_or_renew_lease("lock", "b", lease_duration=10)
+    assert api.lease_holder("lock") == "a"
+    # holder renews within the window
+    now[0] += 8
+    assert api.acquire_or_renew_lease("lock", "a", lease_duration=10)
+    # non-holder acquires only after expiry
+    now[0] += 9
+    assert not api.acquire_or_renew_lease("lock", "b", lease_duration=10)
+    now[0] += 2
+    assert api.acquire_or_renew_lease("lock", "b", lease_duration=10)
+    assert api.lease_holder("lock") == "b"

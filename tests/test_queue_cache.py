@@ -114,3 +114,110 @@ def test_cache_incremental_snapshot_reuse_and_invalidation():
     assert s4.get("n1") is not s3.get("n1")
     assert s4.get("n1").allocatable["cpu"] == 1000
     assert len(s4.get("n1").pods) == 1  # known pod re-attached
+
+
+# -- event-gating tables (the EnqueueExtensions contract end to end) ----------
+
+def park(q, name, plugins, attempts=1, clock=None):
+    info = QueuedPodInfo(make_pod(name), clock or time.time)
+    info.attempts = attempts
+    info.unschedulable_plugins = set(plugins)
+    q.add_unschedulable_if_not_present(info)
+    return info
+
+
+def test_event_gating_table():
+    from tpusched.fwk.interfaces import (EVENT_DELETE, EVENT_UPDATE,
+                                         RESOURCE_POD, WILDCARD_EVENT)
+    event_map = {
+        "PodDel": [ClusterEvent(RESOURCE_POD, EVENT_DELETE)],
+        "NodeAny": [ClusterEvent(RESOURCE_NODE, EVENT_ADD | EVENT_UPDATE)],
+        "Wild": [WILDCARD_EVENT],
+    }
+    now = [1000.0]
+    q = SchedulingQueue(prio_less, event_map, clock=lambda: now[0])
+
+    cases = [
+        # (rejector plugins, event, should_unstick)
+        ({"PodDel"}, (RESOURCE_POD, EVENT_DELETE), True),
+        ({"PodDel"}, (RESOURCE_POD, EVENT_ADD), False),
+        ({"PodDel"}, (RESOURCE_NODE, EVENT_DELETE), False),
+        ({"NodeAny"}, (RESOURCE_NODE, EVENT_UPDATE), True),
+        ({"NodeAny"}, (RESOURCE_NODE, EVENT_ADD), True),
+        ({"NodeAny"}, (RESOURCE_POD, EVENT_ADD), False),
+        ({"Wild"}, ("anything", EVENT_UPDATE), True),
+        # any-of semantics across multiple rejectors
+        ({"PodDel", "NodeAny"}, (RESOURCE_NODE, EVENT_ADD), True),
+        ({"PodDel", "NodeAny"}, (RESOURCE_POD, EVENT_UPDATE), False),
+        # no recorded rejector ⇒ every event unsticks
+        (set(), (RESOURCE_POD, EVENT_UPDATE), True),
+        # unknown plugin (no map entry) ⇒ nothing unsticks it
+        ({"Ghost"}, (RESOURCE_POD, EVENT_DELETE), False),
+    ]
+    for i, (plugins, (res, act), want) in enumerate(cases):
+        park(q, f"c{i}", plugins, clock=lambda: now[0])
+        q.move_all_to_active_or_backoff(res, act)
+        # clear the ≤10s per-pod backoff but stay inside the 30s
+        # unschedulable-leftover flush window (which would unstick anything)
+        now[0] += 15
+        got = q.pop(timeout=0.1)
+        assert (got is not None) == want, (i, plugins, res, act)
+        if got is not None:
+            q.delete(got.pod)
+        else:
+            # clean up the parked pod for the next row
+            q.activate([make_pod(f"c{i}")])
+            left = q.pop(timeout=0.5)
+            assert left is not None
+            q.delete(left.pod)
+
+
+def test_unstuck_pod_respects_remaining_backoff():
+    """A matching event moves the pod to backoffQ, not straight to activeQ,
+    while its per-pod backoff window is still open (fake clock)."""
+    now = [1000.0]
+    q = SchedulingQueue(prio_less, {"P": [ClusterEvent(RESOURCE_NODE,
+                                                       EVENT_ADD)]},
+                        clock=lambda: now[0])
+    park(q, "p", {"P"}, attempts=3, clock=lambda: now[0])  # backoff 4s
+    q.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)
+    assert q.pop(timeout=0.05) is None       # still backing off
+    now[0] += 4.1
+    got = q.pop(timeout=0.5)
+    assert got is not None and got.pod.name == "p"
+
+
+def test_backoff_duration_exponential_with_cap():
+    info = QueuedPodInfo(make_pod("p"))
+    expect = {1: 1.0, 2: 2.0, 3: 4.0, 4: 8.0, 5: 10.0, 10: 10.0}
+    for attempts, want in expect.items():
+        info.attempts = attempts
+        assert info.backoff_duration() == want, attempts
+
+
+def test_delete_removes_from_every_queue():
+    now = [1000.0]
+    q = SchedulingQueue(prio_less, clock=lambda: now[0])
+    # active
+    q.add(make_pod("a"))
+    # backoff (via requeue with nomination short-circuit)
+    info_b = QueuedPodInfo(make_pod("b"), clock=lambda: now[0])
+    info_b.attempts = 2
+    q.requeue_after_failure(info_b, to_backoff=True)
+    # unschedulable
+    park(q, "c", {"X"}, clock=lambda: now[0])
+    for name in ("a", "b", "c"):
+        q.delete(make_pod(name))
+    now[0] += 60
+    assert q.pop(timeout=0.1) is None
+    assert q.pending_counts() == {"active": 0, "backoff": 0,
+                                  "unschedulable": 0}
+
+
+def test_update_refreshes_pod_in_place():
+    q = SchedulingQueue(prio_less)
+    q.add(make_pod("p"))
+    updated = make_pod("p", labels={"v": "2"})
+    q.update(updated)
+    got = q.pop(timeout=0.5)
+    assert got.pod.meta.labels.get("v") == "2"
