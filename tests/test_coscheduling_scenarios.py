@@ -1,0 +1,154 @@
+"""Gang-contention scenario table — the reference's integration matrix
+(/root/reference/test/integration/coscheduling_test.go:47,126-353: nine cases
+of gangs + regular pods contending for one node's memory) rebuilt over the
+in-process cluster.
+
+Determinism: every scenario creates ALL its objects before the scheduler
+loop starts, so the first pop order is exactly the Coscheduling queue-sort
+order (priority desc → PG creation time → key) with no informer-timing
+races — the property the reference approximates by creating pods quickly
+and polling.
+"""
+import pytest
+
+from tpusched.api.resources import MEMORY, PODS
+from tpusched.apiserver import server as srv
+from tpusched.config.types import CoschedulingArgs
+from tpusched.fwk import PluginProfile
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, make_resources)
+
+MID, HIGH = 100, 1000
+
+
+def contention_profile(permit_wait_s=3, denied_s=1):
+    """Coscheduling over the default fit filter — the reference's default
+    profile + coscheduling extension points
+    (test/integration/coscheduling_test.go:73-90)."""
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeSelector", "NodeResourcesFit"],
+        post_filter=["Coscheduling"],
+        reserve=["Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["DefaultBinder"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=permit_wait_s,
+            denied_pg_expiration_time_seconds=denied_s)},
+    )
+
+
+def mem_node(name="fake-node", memory=300):
+    # the reference's fake-node: 32 pods, 300 memory units
+    return make_node(name, capacity={MEMORY: memory, PODS: 32, "cpu": 320000})
+
+
+def gang_pod(name, group, mem, priority=MID):
+    return make_pod(name, pod_group=group, priority=priority,
+                    requests=make_resources(memory=mem))
+
+
+def regular_pod(name, mem, priority=MID):
+    return make_pod(name, priority=priority,
+                    requests=make_resources(memory=mem))
+
+
+# Each row: (name, pods, pod_groups, expected scheduled pod names).
+# pods = list of (name, group-or-None, mem, priority) in creation order;
+# pod_groups = list of (name, min_member, min_resources-or-None).
+SCENARIOS = [
+    ("equal priority, sequentially pg1 meets min and pg2 does not",
+     [(f"t1-p1-{i}", "pg1-1", 50, MID) for i in range(1, 4)]
+     + [(f"t1-p2-{i}", "pg1-2", 100, MID) for i in range(1, 5)],
+     [("pg1-1", 3, None), ("pg1-2", 4, None)],
+     ["t1-p1-1", "t1-p1-2", "t1-p1-3"]),
+
+    ("equal priority, interleaved pg1 meets min and pg2 does not",
+     [("t2-p1-1", "pg2-1", 50, MID), ("t2-p2-1", "pg2-2", 100, MID),
+      ("t2-p1-2", "pg2-1", 50, MID), ("t2-p2-2", "pg2-2", 100, MID),
+      ("t2-p1-3", "pg2-1", 50, MID), ("t2-p2-3", "pg2-2", 100, MID),
+      ("t2-p2-4", "pg2-2", 100, MID)],
+     [("pg2-1", 3, None), ("pg2-2", 4, None)],
+     ["t2-p1-1", "t2-p1-2", "t2-p1-3"]),
+
+    ("pg1 below min alongside regular pods: only regulars bind",
+     [("t3-p1-1", "pg3-1", 50, MID), ("t3-p2", None, 100, MID),
+      ("t3-p1-2", "pg3-1", 50, MID), ("t3-p3", None, 100, MID),
+      ("t3-p1-3", "pg3-1", 50, MID)],
+     [("pg3-1", 4, None)],  # only 3 members exist
+     ["t3-p2", "t3-p3"]),
+
+    ("different priority, sequential: only the high-priority gang fits",
+     [(f"t4-p1-{i}", "pg4-1", 100, MID) for i in range(1, 4)]
+     + [(f"t4-p2-{i}", "pg4-2", 50, HIGH) for i in range(1, 4)],
+     [("pg4-1", 3, None), ("pg4-2", 3, None)],
+     ["t4-p2-1", "t4-p2-2", "t4-p2-3"]),
+
+    ("different priority, interleaved: only the high-priority gang fits",
+     [("t5-p1-1", "pg5-1", 100, MID), ("t5-p2-1", "pg5-2", 50, HIGH),
+      ("t5-p1-2", "pg5-1", 100, MID), ("t5-p2-2", "pg5-2", 50, HIGH),
+      ("t5-p1-3", "pg5-1", 100, MID), ("t5-p2-3", "pg5-2", 50, HIGH)],
+     [("pg5-1", 3, None), ("pg5-2", 3, None)],
+     ["t5-p2-1", "t5-p2-2", "t5-p2-3"]),
+
+    ("high-priority regulars starve a mid-priority gang",
+     [("t6-p1-1", "pg6-1", 50, MID), ("t6-p2", None, 100, HIGH),
+      ("t6-p1-2", "pg6-1", 50, MID), ("t6-p3", None, 100, HIGH),
+      ("t6-p1-3", "pg6-1", 50, MID), ("t6-p4", None, 100, HIGH)],
+     [("pg6-1", 3, None)],
+     ["t6-p2", "t6-p3", "t6-p4"]),
+
+    ("three gangs, one fits: pg1 meets min, pg2/pg3 cannot",
+     [("t7-p1-1", "pg7-1", 50, MID), ("t7-p2-1", "pg7-2", 100, MID),
+      ("t7-p3-1", "pg7-3", 100, MID), ("t7-p1-2", "pg7-1", 50, MID),
+      ("t7-p2-2", "pg7-2", 100, MID), ("t7-p3-2", "pg7-3", 100, MID),
+      ("t7-p1-3", "pg7-1", 50, MID), ("t7-p2-3", "pg7-2", 100, MID),
+      ("t7-p3-3", "pg7-3", 100, MID), ("t7-p2-4", "pg7-2", 100, MID),
+      ("t7-p3-4", "pg7-3", 100, MID)],
+     [("pg7-1", 3, None), ("pg7-2", 4, None), ("pg7-3", 4, None)],
+     ["t7-p1-1", "t7-p1-2", "t7-p1-3"]),
+
+    ("three gangs with minResources: the 400-unit gangs are gated early",
+     [("t8-p1-1", "pg8-1", 50, MID), ("t8-p2-1", "pg8-2", 100, MID),
+      ("t8-p3-1", "pg8-3", 100, MID), ("t8-p1-2", "pg8-1", 50, MID),
+      ("t8-p2-2", "pg8-2", 100, MID), ("t8-p3-2", "pg8-3", 100, MID),
+      ("t8-p1-3", "pg8-1", 50, MID), ("t8-p2-3", "pg8-2", 100, MID),
+      ("t8-p3-3", "pg8-3", 100, MID), ("t8-p2-4", "pg8-2", 100, MID),
+      ("t8-p3-4", "pg8-3", 100, MID)],
+     [("pg8-1", 3, {MEMORY: 150}), ("pg8-2", 4, {MEMORY: 400}),
+      ("pg8-3", 4, {MEMORY: 400})],
+     ["t8-p1-1", "t8-p1-2", "t8-p1-3"]),
+
+    ("two gangs with minResources: pg1 meets min, pg2's 400 > capacity",
+     [("t9-p1-1", "pg9-1", 50, MID), ("t9-p2-1", "pg9-2", 100, MID),
+      ("t9-p1-2", "pg9-1", 50, MID), ("t9-p2-2", "pg9-2", 100, MID),
+      ("t9-p1-3", "pg9-1", 50, MID), ("t9-p2-3", "pg9-2", 100, MID),
+      ("t9-p2-4", "pg9-2", 100, MID)],
+     [("pg9-1", 3, {MEMORY: 150}), ("pg9-2", 4, {MEMORY: 400})],
+     ["t9-p1-1", "t9-p1-2", "t9-p1-3"]),
+]
+
+
+@pytest.mark.parametrize("name,pods,pod_groups,expected",
+                         SCENARIOS, ids=[s[0] for s in SCENARIOS])
+def test_gang_contention(name, pods, pod_groups, expected):
+    c = TestCluster(profile=contention_profile())
+    c.add_nodes([mem_node()])
+    for pg_name, min_member, min_res in pod_groups:
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            pg_name, min_member=min_member, min_resources=min_res))
+    objs = []
+    for pname, group, mem, prio in pods:
+        p = (gang_pod(pname, group, mem, prio) if group
+             else regular_pod(pname, mem, prio))
+        objs.append(p)
+    c.create_pods(objs)
+    with c:
+        want = [f"default/{n}" for n in expected]
+        assert c.wait_for_pods_scheduled(want, timeout=20), \
+            f"{name}: expected {expected} to schedule"
+        others = [p.key for p in objs if p.key not in want]
+        assert c.wait_for_pods_unscheduled(others, hold=1.0), \
+            f"{name}: expected {others} to stay pending"

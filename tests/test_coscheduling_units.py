@@ -222,6 +222,140 @@ def test_activate_siblings_stashes_other_members():
     assert sorted(stash.map) == ["default/m1", "default/m2"]
 
 
+# -- PostFilter mass rejection (coscheduling.go:140-176, TestPostFilter) ------
+
+def park_in_permit(fw, pods, node="h0"):
+    """Drive each pod through run_permit_plugins so it parks as a waitingPod
+    (the state PostFilter's mass-reject iterates over)."""
+    for p in pods:
+        st = fw.run_permit_plugins(CycleState(), p, node)
+        assert st.is_wait(), f"{p.key} did not park: {st.message()}"
+
+
+def permit_rejected(fw, pod):
+    """True iff the parked pod's permit barrier has resolved (rejection sets
+    the status; the entry leaves the map only when a binding-cycle waiter
+    collects it — deadline() is None exactly once resolved)."""
+    wp = fw.get_waiting_pod(pod.meta.uid)
+    assert wp is not None, f"{pod.key} never parked at Permit"
+    return wp.deadline() is None
+
+
+def test_post_filter_pod_without_group_is_noop():
+    fw, cs, _, _ = gang_framework()
+    _, st = cs.post_filter(CycleState(), make_pod("solo"), {})
+    assert st.is_unschedulable()
+    assert "can not find pod group" in st.message()
+
+
+def test_post_filter_enough_assigned_does_not_reject():
+    """assigned ≥ minMember ⇒ the quorum is already satisfied; waiting
+    members must be left alone (coscheduling_test.go:385 'enough pods
+    assigned, do not reject all')."""
+    from tpusched.fwk import Snapshot
+    pg = make_pod_group("gang", min_member=3)
+    node = make_tpu_node("h0", chips=8)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg], nodes=[node])
+    waiter = make_pod("w", pod_group="gang")
+    park_in_permit(fw, [waiter])  # 0 assigned + 1 < 3 ⇒ parks
+    # three siblings land between the park and the straggler's failure
+    bound = [make_pod(f"b{i}", pod_group="gang", node_name="h0")
+             for i in range(3)]
+    handle.set_snapshot(Snapshot(nodes=[node], pods=bound))
+    straggler = make_pod("s", pod_group="gang")
+    _, st = cs.post_filter(CycleState(), straggler, {})
+    assert st.is_unschedulable()
+    assert not permit_rejected(fw, waiter)  # still parked, unresolved
+    assert "default/gang" not in cs.pg_mgr.last_denied_pg
+
+
+def test_post_filter_small_quorum_gap_spares_gang():
+    """9/10 assigned (10% gap) ⇒ grace: no mass rejection."""
+    from tpusched.fwk import Snapshot
+    pg = make_pod_group("gang", min_member=10)
+    node = make_tpu_node("h0", chips=16)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg], nodes=[node])
+    waiter = make_pod("w", pod_group="gang")
+    park_in_permit(fw, [waiter])  # 0 + 1 < 10 ⇒ parks
+    bound = [make_pod(f"b{i}", pod_group="gang", node_name="h0")
+             for i in range(9)]
+    handle.set_snapshot(Snapshot(nodes=[node], pods=bound))
+    _, st = cs.post_filter(CycleState(), make_pod("s", pod_group="gang"), {})
+    assert st.is_unschedulable()
+    assert not permit_rejected(fw, waiter)
+    assert "default/gang" not in cs.pg_mgr.last_denied_pg
+
+
+def test_post_filter_mass_rejects_waiting_siblings_and_denies_group():
+    """Filter failure with a real quorum gap ⇒ every waiting sibling is
+    rejected, the group enters the denied cache, and its permitted
+    memoization is dropped (coscheduling_test.go:391 'reject all pods')."""
+    pg = make_pod_group("gang", min_member=4)
+    node = make_tpu_node("h0", chips=8)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg], nodes=[node])
+    waiters = [make_pod(f"w{i}", pod_group="gang") for i in range(2)]
+    park_in_permit(fw, waiters)
+    outsider = make_pod("other", pod_group="other-gang")
+    api.create(srv.POD_GROUPS, make_pod_group("other-gang", min_member=2))
+    park_in_permit(fw, [outsider])
+
+    cs.pg_mgr.permitted_pg.set("default/gang")
+    _, st = cs.post_filter(CycleState(), make_pod("s", pod_group="gang"), {})
+    assert st.is_unschedulable()
+    assert "gets rejected due to Pod" in st.message()
+    for w in waiters:
+        assert permit_rejected(fw, w)
+        assert fw.get_waiting_pod(w.meta.uid).wait().is_unschedulable()
+    # other groups' waiting pods are untouched
+    assert not permit_rejected(fw, outsider)
+    assert "default/gang" in cs.pg_mgr.last_denied_pg
+    assert "default/gang" not in cs.pg_mgr.permitted_pg  # memoization dropped
+
+
+def test_post_filter_rejection_scoped_to_namespace():
+    """Same group name in another namespace must not be collateral damage."""
+    pg = make_pod_group("gang", min_member=4)
+    pg_other = make_pod_group("gang", namespace="team-b", min_member=4)
+    node = make_tpu_node("h0", chips=8)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg, pg_other],
+                                         nodes=[node])
+    ours = make_pod("w0", pod_group="gang")
+    theirs = make_pod("w1", namespace="team-b", pod_group="gang")
+    park_in_permit(fw, [ours, theirs])
+    _, st = cs.post_filter(CycleState(), make_pod("s", pod_group="gang"), {})
+    assert st.is_unschedulable()
+    assert permit_rejected(fw, ours)
+    assert fw.get_waiting_pod(ours.meta.uid).wait().is_unschedulable()
+    assert not permit_rejected(fw, theirs)
+    assert "default/gang" in cs.pg_mgr.last_denied_pg
+    assert "team-b/gang" not in cs.pg_mgr.last_denied_pg
+
+
+# -- PostBind phase machine (core.go:220-252, TestPostBind) -------------------
+
+def test_post_bind_tracks_scheduling_then_scheduled():
+    from tpusched.api.scheduling import PG_SCHEDULED, PG_SCHEDULING
+    pg = make_pod_group("gang", min_member=2)
+    fw, cs, _, api = gang_framework(pod_groups=[pg])
+    members = [make_pod(f"m{i}", pod_group="gang") for i in range(2)]
+    for m in members:
+        api.create(srv.PODS, m)
+    cs.post_bind(CycleState(), members[0], "h0")
+    got = api.get(srv.POD_GROUPS, "default/gang")
+    assert got.status.scheduled == 1
+    assert got.status.phase == PG_SCHEDULING
+    assert got.status.schedule_start_time is not None
+    cs.post_bind(CycleState(), members[1], "h0")
+    got = api.get(srv.POD_GROUPS, "default/gang")
+    assert got.status.scheduled == 2
+    assert got.status.phase == PG_SCHEDULED
+
+
+def test_post_bind_groupless_pod_is_noop():
+    fw, cs, _, api = gang_framework()
+    cs.post_bind(CycleState(), make_pod("solo"), "h0")  # must not raise
+
+
 # -- wait-time precedence (util/podgroup.go:53-76) ----------------------------
 
 def test_wait_time_precedence():
