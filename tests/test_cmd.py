@@ -48,7 +48,7 @@ def test_validate_only_prints_resolved_profile(capsys, tmp_path):
     """))
     rc = sched_cmd.main(["--config", str(cfg), "--validate-only"])
     assert rc == 0
-    out = json.loads(capsys.readouterr().out)
+    out = json.loads(capsys.readouterr().out)[0]
     assert out["schedulerName"] == "gangsched"
     assert out["queueSort"] == "Coscheduling"
     assert out["filter"][-1] == "TpuSlice"
@@ -61,7 +61,7 @@ def test_validate_only_prints_resolved_profile(capsys, tmp_path):
 def test_validate_only_canned_default(capsys):
     rc = sched_cmd.main(["--validate-only"])
     assert rc == 0
-    out = json.loads(capsys.readouterr().out)
+    out = json.loads(capsys.readouterr().out)[0]
     assert out["queueSort"] == "Coscheduling"     # tpu-gang default
     assert out["permit"] == ["Coscheduling"]
     assert out["bind"] == ["TpuSlice"]
@@ -73,6 +73,61 @@ def test_bad_config_is_an_error(tmp_path):
     from tpusched.config.scheme import ConfigError
     with pytest.raises(ConfigError):
         sched_cmd.main(["--config", str(cfg), "--validate-only"])
+
+
+def test_multi_profile_config_hosts_every_profile(tmp_path, capsys):
+    """Upstream hosts all of a config's profiles in one process; pods choose
+    by spec.schedulerName. --validate-only reports them all, and two live
+    schedulers over one API server each bind their own pods."""
+    cfg = tmp_path / "multi.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: sched-a
+        - schedulerName: sched-b
+          plugins:
+            queueSort:
+              enabled: [{name: QOSSort}]
+              disabled: [{name: "*"}]
+    """))
+    rc = sched_cmd.main(["--config", str(cfg), "--validate-only"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [p["schedulerName"] for p in out] == ["sched-a", "sched-b"]
+    assert out[1]["queueSort"] == "QOSSort"
+
+    # live: both profiles schedule their own pods against one API server
+    from tpusched.apiserver import server as srv
+    from tpusched.cmd.scheduler import resolve_profiles
+    from tpusched.testing import make_node, make_pod
+
+    args = sched_cmd.build_parser().parse_args(["--config", str(cfg)])
+    api = APIServer()
+    scheds = [Scheduler(api, default_registry(), p)
+              for p in resolve_profiles(args)]
+    api.create(srv.NODES, make_node("n1"))
+    try:
+        for s in scheds:
+            s.run()
+        pa = make_pod("pa", scheduler_name="sched-a", requests={"cpu": 100})
+        pb = make_pod("pb", scheduler_name="sched-b", requests={"cpu": 100})
+        px = make_pod("px", scheduler_name="nobody", requests={"cpu": 100})
+        for p in (pa, pb, px):
+            api.create(srv.PODS, p)
+        import time
+        deadline = time.monotonic() + 10
+        def bound(k):
+            pod = api.peek(srv.PODS, k)
+            return pod is not None and pod.spec.node_name
+        while time.monotonic() < deadline and not (
+                bound("default/pa") and bound("default/pb")):
+            time.sleep(0.02)
+        assert bound("default/pa") and bound("default/pb")
+        assert not bound("default/px")  # no profile claims it
+    finally:
+        for s in scheds:
+            s.stop()
 
 
 def test_controller_options_mirror_flags():

@@ -1,0 +1,135 @@
+"""TestSetup analog — the reference boots the real options stack and asserts
+the fully-defaulted per-plugin wiring for every shipped scheduler config
+(/root/reference/cmd/scheduler/main_test.go:48 `TestSetup`: a wantPlugins
+table per plugin configuration). Here: every manifests/*/scheduler-config.yaml
+is (a) accepted end-to-end by the real CLI (`--validate-only`), and (b)
+resolved to EXACTLY the expected extension-point wiring and defaulted args —
+any drift in defaults, decode, or manifest content fails the table.
+"""
+import dataclasses
+import glob
+import json
+import os
+
+import pytest
+
+from tpusched.cmd import scheduler as sched_cmd
+from tpusched.config import versioned as v
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_FILTERS = ["NodeUnschedulable", "NodeName", "NodeSelector",
+                   "TaintToleration", "NodeResourcesFit"]
+
+# (manifest, scheduler_name) -> want wiring. Unlisted points default to
+# expectations of the defaults profile: PrioritySort + default filters +
+# DefaultBinder, everything else empty.
+WANT = {
+    ("coscheduling", "tpusched"): dict(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"], post_filter=["Coscheduling"],
+        reserve=["Coscheduling"], permit=["Coscheduling"],
+        post_bind=["Coscheduling"],
+        args={"Coscheduling": {"permit_waiting_time_seconds": 60,
+                               "denied_pg_expiration_time_seconds": 20}}),
+    ("capacityscheduling", "tpusched"): dict(
+        pre_filter=["CapacityScheduling"], post_filter=["CapacityScheduling"],
+        reserve=["CapacityScheduling"]),
+    ("multislice", "tpusched"): dict(
+        pre_score=["MultiSlice"], score=[("MultiSlice", 3)],
+        args={"MultiSlice": {"same_domain_score": 100,
+                             "adjacent_domain_score": 50}}),
+    ("noderesources", "tpusched"): dict(
+        score=[("NodeResourcesAllocatable", 1)],
+        args={"NodeResourcesAllocatable": {
+            "mode": "Least",
+            "resources": [{"name": "cpu", "weight": 1 << 20},
+                          {"name": "memory", "weight": 1}]}}),
+    ("podstate", "tpusched"): dict(score=[("PodState", 1)]),
+    ("preemptiontoleration", "tpusched"): dict(
+        post_filter=["PreemptionToleration"],
+        args={"PreemptionToleration": {"min_candidate_nodes_percentage": 10,
+                                       "min_candidate_nodes_absolute": 100}}),
+    ("qos", "tpusched"): dict(queue_sort="QOSSort"),
+    ("topologymatch", "tpusched"): dict(
+        pre_filter=["TopologyMatch"],
+        filter=DEFAULT_FILTERS + ["TopologyMatch"],
+        score=[("TopologyMatch", 2)], reserve=["TopologyMatch"],
+        args={"TopologyMatch": {"scoring_strategy": "LeastAllocated",
+                                "resource_weights": {"google.com/tpu": 1}}}),
+    ("trimaran", "tpusched"): dict(
+        score=[("TargetLoadPacking", 1)],
+        args={"TargetLoadPacking": {
+            "target_utilization": 40,          # defaults.go:50
+            "default_requests_cpu_millis": 1000,
+            "default_requests_multiplier": 1.5,  # defaults preserved
+            "watcher_address": "http://127.0.0.1:2020",
+            "metrics_refresh_interval_seconds": 30}}),
+    ("trimaran", "tpusched-risk"): dict(
+        score=[("LoadVariationRiskBalancing", 1)],
+        args={"LoadVariationRiskBalancing": {
+            "safe_variance_margin": 1,         # defaults.go SafeVarianceMargin
+            "safe_variance_sensitivity": 1,
+            "watcher_address": "http://127.0.0.1:2020",
+            "metrics_refresh_interval_seconds": 30}}),
+}
+
+
+def resolved_profiles():
+    out = {}
+    for path in sorted(glob.glob(os.path.join(
+            REPO, "manifests", "*", "scheduler-config.yaml"))):
+        manifest = os.path.basename(os.path.dirname(path))
+        for p in v.load_file(path).profiles:
+            out[(manifest, p.scheduler_name)] = (path, p)
+    return out
+
+
+PROFILES = resolved_profiles()
+
+
+def test_table_covers_every_manifest_profile():
+    """New manifests must be added to the WANT table — drift is an error in
+    both directions."""
+    assert sorted(PROFILES) == sorted(WANT)
+
+
+@pytest.mark.parametrize("key", sorted(WANT), ids=["/".join(k) for k in WANT])
+def test_manifest_resolves_to_expected_wiring(key):
+    path, profile = PROFILES[key]
+    want = WANT[key]
+    assert profile.queue_sort == want.get("queue_sort", "PrioritySort")
+    assert profile.pre_filter == want.get("pre_filter", [])
+    assert profile.filter == want.get("filter", DEFAULT_FILTERS)
+    assert profile.post_filter == want.get("post_filter", [])
+    assert profile.pre_score == want.get("pre_score", [])
+    assert [tuple(s) for s in profile.score] == want.get("score", [])
+    assert profile.reserve == want.get("reserve", [])
+    assert profile.permit == want.get("permit", [])
+    assert profile.pre_bind == want.get("pre_bind", [])
+    assert profile.bind == want.get("bind", ["DefaultBinder"])
+    assert profile.post_bind == want.get("post_bind", [])
+    got_args = {name: dataclasses.asdict(a)
+                for name, a in profile.plugin_args.items()}
+    assert got_args == want.get("args", {})
+
+
+@pytest.mark.parametrize("key", sorted(WANT), ids=["/".join(k) for k in WANT])
+def test_cli_accepts_manifest(key, capsys):
+    """The real binary path: decode → instantiate every plugin → report.
+    --scheduler-name selects the profile, as a deployment would."""
+    path, _ = PROFILES[key]
+    rc = sched_cmd.main(["--config", path, "--validate-only",
+                         "--scheduler-name", key[1]])
+    assert rc == 0
+    [out] = json.loads(capsys.readouterr().out)
+    assert out["schedulerName"] == key[1]
+    # every plugin the profile names was actually constructed
+    for point in ("queueSort", "preFilter", "filter", "postFilter",
+                  "permit", "reserve", "bind", "postBind", "score"):
+        val = out.get(point)
+        names = ([val] if isinstance(val, str) else
+                 [e["name"] if isinstance(e, dict) else e
+                  for e in (val or [])])
+        for n in names:
+            assert n in out["plugins"], (path, point, n)

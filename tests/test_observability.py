@@ -203,5 +203,7 @@ def test_pending_pods_gauges():
             deadline.wait(0.05)
         assert unsched_count() == 1
         text = REGISTRY.expose()
-        assert 'tpusched_pending_pods{queue="unschedulable"} 1' in text
-        assert 'tpusched_pending_pods{queue="active"} 0' in text
+        assert ('tpusched_pending_pods{scheduler="tpusched",'
+                'queue="unschedulable"} 1') in text
+        assert ('tpusched_pending_pods{scheduler="tpusched",'
+                'queue="active"} 0') in text

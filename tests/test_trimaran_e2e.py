@@ -1,0 +1,190 @@
+"""Trimaran end-to-end placement — the reference's integration tier
+(/root/reference/test/integration/targetloadpacking_test.go:56-95 and
+loadVariationRiskBalancing_test.go: real scheduler + watcher faked at the
+HTTP layer) over the in-process cluster: a local HTTP server serves
+load-watcher JSON, the scheduler profile wires the plugin by args, and the
+assertion is WHERE pods land.
+"""
+import http.server
+import json
+import threading
+
+import pytest
+
+from tpusched.api.resources import CPU, make_resources
+from tpusched.config.types import (LoadVariationRiskBalancingArgs,
+                                   TargetLoadPackingArgs)
+from tpusched.fwk import PluginProfile
+from tpusched.testing import TestCluster, make_node, make_pod
+
+
+class FakeWatcher:
+    """Serves the load-watcher wire format; per-test mutable node loads."""
+
+    def __init__(self):
+        self.node_metrics = {}   # name -> list of metric dicts
+        self.fail = False
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if outer.fail:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                # window ends "now": pods bound after it are unmeasured and
+                # must be bridged by the assign handler
+                import time as _t
+                doc = {"timestamp": 1,
+                       "window": {"start": 0, "end": _t.time()},
+                       "data": {"NodeMetricsMap": {
+                           n: {"metrics": ms}
+                           for n, ms in outer.node_metrics.items()}}}
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        self.address = f"http://127.0.0.1:{self._server.server_port}"
+
+    def set_cpu(self, **loads):
+        self.node_metrics = {
+            n: [{"type": "CPU", "operator": "Average", "value": v}]
+            for n, v in loads.items()}
+
+    def close(self):
+        self._server.shutdown()
+
+
+@pytest.fixture
+def watcher():
+    w = FakeWatcher()
+    yield w
+    w.close()
+
+
+def cpu_node(name, cores=10):
+    return make_node(name, capacity=make_resources(
+        cpu=cores, memory="64Gi", pods=110))
+
+
+def tlp_profile(watcher, target=40):
+    return PluginProfile(
+        filter=["NodeUnschedulable", "NodeResourcesFit"],
+        score=[("TargetLoadPacking", 1)],
+        bind=["DefaultBinder"],
+        plugin_args={"TargetLoadPacking": TargetLoadPackingArgs(
+            target_utilization=target, watcher_address=watcher.address)},
+    )
+
+
+def lvrb_profile(watcher):
+    return PluginProfile(
+        filter=["NodeUnschedulable", "NodeResourcesFit"],
+        score=[("LoadVariationRiskBalancing", 1)],
+        bind=["DefaultBinder"],
+        plugin_args={"LoadVariationRiskBalancing":
+                     LoadVariationRiskBalancingArgs(
+                         watcher_address=watcher.address)},
+    )
+
+
+def landed_on(c, key):
+    return c.pod(key).spec.node_name
+
+
+def test_tlp_packs_toward_target_not_emptiest(watcher):
+    """Best-fit packing: the node already near (but under) target wins over
+    the idle one — the defining difference from spread-style scorers."""
+    watcher.set_cpu(busy=30.0, idle=0.0)
+    with TestCluster(profile=tlp_profile(watcher)) as c:
+        c.add_nodes([cpu_node("busy"), cpu_node("idle")])
+        p = make_pod("p", requests={CPU: 666})  # predicted ~1000m = 10%
+        c.create_pods([p])
+        assert c.wait_for_pods_scheduled([p.key], timeout=10)
+        # busy: predicted 40% → 100; idle: predicted 10% → 55
+        assert landed_on(c, p.key) == "busy"
+
+
+def test_tlp_penalizes_overshoot(watcher):
+    """A node that the pod would push past the target scores below one it
+    leaves under target."""
+    watcher.set_cpu(hot=80.0, warm=20.0)
+    with TestCluster(profile=tlp_profile(watcher)) as c:
+        c.add_nodes([cpu_node("hot"), cpu_node("warm")])
+        p = make_pod("p", requests={CPU: 666})
+        c.create_pods([p])
+        assert c.wait_for_pods_scheduled([p.key], timeout=10)
+        # hot: predicted 90% → 40*(100-90)/60 ≈ 7; warm: 30% → 85
+        assert landed_on(c, p.key) == "warm"
+
+
+def test_tlp_recently_bound_pods_shift_subsequent_placements(watcher):
+    """The PodAssignEventHandler bridge: pods bound inside the metrics
+    window count at requests x 1.5 even though the watcher still reports
+    the stale pre-bind load (targetloadpacking.go:234-251)."""
+    watcher.set_cpu(a=30.0, b=28.0)
+    with TestCluster(profile=tlp_profile(watcher)) as c:
+        c.add_nodes([cpu_node("a"), cpu_node("b")])
+        first = make_pod("first", requests={CPU: 666})
+        c.create_pods([first])
+        assert c.wait_for_pods_scheduled([first.key], timeout=10)
+        assert landed_on(c, first.key) == "a"  # 30+10=40 exactly at target
+        # watcher unchanged; 'a' must now be seen as 40% + first's 10%
+        second = make_pod("second", requests={CPU: 666})
+        c.create_pods([second])
+        assert c.wait_for_pods_scheduled([second.key], timeout=10)
+        # a: predicted 50% → penalized ≈ 33; b: 28+10=38% → 97
+        assert landed_on(c, second.key) == "b"
+
+
+def test_tlp_watcher_down_still_schedules(watcher):
+    """Missing metrics ⇒ MinScore everywhere, but pods must still bind —
+    load-awareness degrades, admission does not fail."""
+    watcher.fail = True
+    with TestCluster(profile=tlp_profile(watcher)) as c:
+        c.add_nodes([cpu_node("n1")])
+        p = make_pod("p", requests={CPU: 500})
+        c.create_pods([p])
+        assert c.wait_for_pods_scheduled([p.key], timeout=10)
+
+
+def test_lvrb_prefers_low_risk_node(watcher):
+    """Same mean, different variance: the steadier node wins
+    (analysis.go:48-78 risk = (mu + margin*sigma)/2)."""
+    watcher.node_metrics = {
+        "steady": [{"type": "CPU", "operator": "Average", "value": 40.0},
+                   {"type": "CPU", "operator": "Std", "value": 5.0}],
+        "spiky": [{"type": "CPU", "operator": "Average", "value": 40.0},
+                  {"type": "CPU", "operator": "Std", "value": 40.0}],
+    }
+    with TestCluster(profile=lvrb_profile(watcher)) as c:
+        c.add_nodes([cpu_node("steady"), cpu_node("spiky")])
+        p = make_pod("p", requests={CPU: 100})
+        c.create_pods([p])
+        assert c.wait_for_pods_scheduled([p.key], timeout=10)
+        assert landed_on(c, p.key) == "steady"
+
+
+def test_lvrb_memory_pressure_caps_cpu_score(watcher):
+    """cpu and memory scores combine via min(): a memory-hot node loses even
+    with an idle CPU (loadvariationriskbalancing.go:104-129)."""
+    watcher.node_metrics = {
+        "mem-hot": [{"type": "CPU", "operator": "Average", "value": 0.0},
+                    {"type": "Memory", "operator": "Average", "value": 95.0}],
+        "balanced": [{"type": "CPU", "operator": "Average", "value": 30.0},
+                     {"type": "Memory", "operator": "Average", "value": 30.0}],
+    }
+    with TestCluster(profile=lvrb_profile(watcher)) as c:
+        c.add_nodes([cpu_node("mem-hot"), cpu_node("balanced")])
+        p = make_pod("p", requests={CPU: 100})
+        c.create_pods([p])
+        assert c.wait_for_pods_scheduled([p.key], timeout=10)
+        assert landed_on(c, p.key) == "balanced"

@@ -70,13 +70,16 @@ CANNED_PROFILES = {
 }
 
 
-def resolve_profile(args) -> "versioned.PluginProfile":
+def resolve_profiles(args) -> List["versioned.PluginProfile"]:
+    """All profiles the binary will host. Upstream runs every profile of the
+    config in one process and pods pick one via spec.schedulerName
+    (vendor/.../scheduler.go profiles map); --scheduler-name narrows to one."""
     if args.config:
         cfg = versioned.load_file(args.config)
         if args.scheduler_name:
-            return cfg.profile(args.scheduler_name)
-        return cfg.profiles[0]
-    return CANNED_PROFILES[args.profile]()
+            return [cfg.profile(args.scheduler_name)]
+        return list(cfg.profiles)
+    return [CANNED_PROFILES[args.profile]()]
 
 
 def profile_summary(scheduler: Scheduler) -> dict:
@@ -110,11 +113,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..apiserver import persistence
         journal = persistence.attach(api, args.state_dir,
                                      fsync=args.state_fsync)
-    profile = resolve_profile(args)
-    scheduler = Scheduler(api, default_registry(), profile)
+    profiles = resolve_profiles(args)
+    schedulers = [Scheduler(api, default_registry(), p) for p in profiles]
 
     if args.validate_only:
-        print(json.dumps(profile_summary(scheduler), indent=2))
+        # stable contract: always a JSON array, one entry per hosted profile
+        summaries = [profile_summary(s) for s in schedulers]
+        for s in schedulers:   # release binding pools / informer handlers
+            s.stop()
+        print(json.dumps(summaries, indent=2))
         return 0
 
     if args.emulate_pool:
@@ -128,7 +135,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             klog.error_s(None, "recovered pool dims conflict with --emulate-pool",
                          recovered="x".join(map(str, existing.spec.dims)),
                          requested=args.emulate_pool)
-            scheduler.stop()
+            for sch in schedulers:
+                sch.stop()
             if journal is not None:
                 journal.close()
             return 1
@@ -144,19 +152,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.metrics_port is not None:
         from ..util.httpserve import MetricsServer
         metrics_server = MetricsServer(
-            args.metrics_port, ready_probe=lambda: scheduler.running,
+            args.metrics_port,
+            ready_probe=lambda: all(s.running for s in schedulers),
             host=args.metrics_bind_address).start()
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    scheduler.run()
-    klog.info_s("scheduler running", schedulerName=profile.scheduler_name)
+    for s in schedulers:
+        s.run()
+        klog.info_s("scheduler running",
+                    schedulerName=s.profile.scheduler_name)
     try:
         while not stop.is_set():
             stop.wait(1.0)
     finally:
-        scheduler.stop()
+        for s in schedulers:
+            s.stop()
         if metrics_server is not None:
             metrics_server.stop()
         if journal is not None:

@@ -132,13 +132,18 @@ class Scheduler:
         import weakref
         from ..util.metrics import REGISTRY
         queue_ref = weakref.ref(self.queue)
+        # scheduler label: one process can host several profiles (upstream
+        # shares ONE queue across profiles; here each profile owns a queue,
+        # so the label keeps N schedulers from clobbering each other's gauge)
+        sched_label = f'scheduler="{profile.scheduler_name}",' \
+            if profile.scheduler_name else ""
         for q in ("active", "backoff", "unschedulable"):
             def depth(q=q, ref=queue_ref):
                 live = ref()
                 return live.pending_counts()[q] if live is not None else 0
             REGISTRY.gauge_func("tpusched_pending_pods", depth,
                                 "Pods pending per scheduling sub-queue.",
-                                labels=f'queue="{q}"')
+                                labels=f'{sched_label}queue="{q}"')
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
         # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
