@@ -56,7 +56,8 @@ WANT = {
         filter=DEFAULT_FILTERS + ["TopologyMatch"],
         score=[("TopologyMatch", 2)], reserve=["TopologyMatch"],
         args={"TopologyMatch": {"scoring_strategy": "LeastAllocated",
-                                "resource_weights": {"google.com/tpu": 1}}}),
+                                "resource_weights": {"google.com/tpu": 1},
+                                "packing_weight": 0.7}}),
     ("trimaran", "tpusched"): dict(
         score=[("TargetLoadPacking", 1)],
         args={"TargetLoadPacking": {

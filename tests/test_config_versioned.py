@@ -315,3 +315,16 @@ def test_partial_args_keep_other_defaults():
     assert args.target_utilization == 55
     assert args.default_requests_multiplier == 1.5     # untouched default
     assert args.metrics_refresh_interval_seconds == 30
+
+
+def test_plugin_args_validate_hook_rejects_out_of_range():
+    """Args types may define validate(); decode surfaces it as ConfigError so
+    --validate-only catches range errors (no silent clamping at score time)."""
+    import pytest
+    from tpusched.config.scheme import ConfigError, decode_plugin_args
+    with pytest.raises(ConfigError, match="packingWeight"):
+        decode_plugin_args("TopologyMatch", {"packingWeight": 7})
+    with pytest.raises(ConfigError, match="scoringStrategy"):
+        decode_plugin_args("TopologyMatch", {"scoringStrategy": "Best"})
+    args = decode_plugin_args("TopologyMatch", {"packingWeight": 0.0})
+    assert args.packing_weight == 0.0

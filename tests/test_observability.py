@@ -7,8 +7,6 @@ tier's httptest-faked load-watcher
 with a REAL scheduler making placement decisions off the live HTTP metrics."""
 from __future__ import annotations
 
-import http.server
-import json
 import threading
 import urllib.error
 import urllib.request
@@ -62,29 +60,11 @@ def test_readyz_probe():
 def test_load_aware_scheduling_over_live_watcher():
     """A real scheduler steers pods toward the under-target node reported by
     a live load-watcher HTTP endpoint."""
-    doc = {"timestamp": 1, "window": {"start": 0, "end": 100},
-           "data": {"NodeMetricsMap": {
-               "cold": {"metrics": [{"type": "CPU", "operator": "Average",
-                                     "value": 5.0}]},
-               "hot": {"metrics": [{"type": "CPU", "operator": "Average",
-                                    "value": 95.0}]}}}}
-
-    class Handler(http.server.BaseHTTPRequestHandler):
-        def do_GET(self):
-            body = json.dumps(doc).encode()
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *a):
-            pass
-
-    watcher = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=watcher.serve_forever, daemon=True).start()
+    from tpusched.testing import FakeWatcher
+    watcher = FakeWatcher(window_end=100)
+    watcher.set_cpu(cold=5.0, hot=95.0)
     try:
-        profile = load_aware_profile(
-            watcher_address=f"http://127.0.0.1:{watcher.server_port}")
+        profile = load_aware_profile(watcher_address=watcher.address)
         with TestCluster(profile=profile) as c:
             caps = make_resources(cpu=8, memory="16Gi")
             c.add_nodes([make_node("hot", capacity=caps),
@@ -96,7 +76,7 @@ def test_load_aware_scheduling_over_live_watcher():
             placed = {c.pod(p.key).spec.node_name for p in pods}
             assert placed == {"cold"}
     finally:
-        watcher.shutdown()
+        watcher.close()
 
 
 def test_scheduler_emits_scheduled_and_failed_events():

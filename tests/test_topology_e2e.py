@@ -1,0 +1,159 @@
+"""TopologyMatch live-cluster scenarios — CR lifecycle, scoring strategies,
+racing gangs, and every accelerator generation end-to-end. The reference's
+NRT integration tier (/root/reference/test/integration/
+noderesourcetopology_test.go, its biggest integration file) creates NRT CRs
+through the real API server and asserts placement; the torus analog here
+drives TpuTopology CRs against the live scheduler.
+"""
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.config.types import TopologyMatchArgs
+from tpusched.plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+
+
+def add_pool(c, pool, accelerator="tpu-v5p", dims=(4, 4, 4), dcn_domain=""):
+    topo, nodes = make_tpu_pool(pool, accelerator=accelerator, dims=dims,
+                                dcn_domain=dcn_domain)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+    return topo, nodes
+
+
+def slice_gang(c, name, shape, members, accelerator="tpu-v5p", chips=4):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator=accelerator))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: chips})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def strategy_profile(strategy, packing_weight=0.0):
+    """packing_weight=0: pure NRT-style strategy scoring over pool zones,
+    so the strategy alone decides pool choice (the default 0.7 blend keeps
+    anti-fragmentation packing dominant — covered by the corner-packing
+    tests in test_topology.py)."""
+    prof = tpu_gang_profile(permit_wait_s=5, denied_s=1)
+    prof.plugin_args["TopologyMatch"] = TopologyMatchArgs(
+        scoring_strategy=strategy, packing_weight=packing_weight)
+    return prof
+
+
+# -- CR lifecycle -------------------------------------------------------------
+
+def test_gang_pending_until_topology_cr_arrives():
+    """Slice-shaped gang with nodes but NO TpuTopology CR: PreFilter cannot
+    resolve a pool; creating the CR later must requeue and admit the gang
+    (cluster-event registration on the CR kind)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        topo, nodes = make_tpu_pool("late-pool", dims=(4, 4, 4))
+        c.add_nodes(nodes)  # nodes first, CR withheld
+        pods = slice_gang(c, "early", "4x4x4", 16)
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=1.5)
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+
+
+def test_topology_cr_deleted_blocks_new_slices_only():
+    """Deleting the CR strands new slice gangs but must not disturb pods
+    already bound (annotations-as-truth survives the CR)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=2, denied_s=1)) as c:
+        topo, _ = add_pool(c, "doomed", dims=(4, 4, 4))
+        first = slice_gang(c, "resident", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in first], timeout=20)
+        c.api.delete(srv.TPU_TOPOLOGIES, topo.key)
+        second = slice_gang(c, "stranded", "2x2x4", 4)
+        assert c.wait_for_pods_unscheduled([p.key for p in second], hold=1.5)
+        # residents untouched
+        for p in first:
+            assert c.pod(p.key).spec.node_name
+
+
+# -- scoring strategies over pool zones ---------------------------------------
+
+def test_most_allocated_packs_the_busy_pool():
+    """MostAllocated: a new slice consolidates onto the fuller pool, keeping
+    the empty pool free for large jobs (most_allocated.go:25-54 semantics
+    over torus zones)."""
+    with TestCluster(profile=strategy_profile("MostAllocated")) as c:
+        add_pool(c, "busy", dims=(4, 4, 4))
+        add_pool(c, "empty", dims=(4, 4, 4))
+        seed = slice_gang(c, "seed", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in seed], timeout=20)
+        seed_pool = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                     for p in seed}
+        nxt = slice_gang(c, "next", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in nxt], timeout=20)
+        nxt_pool = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                    for p in nxt}
+        assert nxt_pool == seed_pool  # consolidated
+
+
+def test_least_allocated_spreads_to_the_idle_pool():
+    with TestCluster(profile=strategy_profile("LeastAllocated")) as c:
+        add_pool(c, "busy", dims=(4, 4, 4))
+        add_pool(c, "empty", dims=(4, 4, 4))
+        seed = slice_gang(c, "seed", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in seed], timeout=20)
+        seed_pool = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                     for p in seed}
+        nxt = slice_gang(c, "next", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in nxt], timeout=20)
+        nxt_pool = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                    for p in nxt}
+        assert nxt_pool != seed_pool  # spread
+
+
+# -- racing gangs -------------------------------------------------------------
+
+def test_two_gangs_race_for_last_window_exactly_one_wins():
+    """One 4x4x4 window left; two identical gangs submitted together. The
+    Permit barrier + placement reservation must admit exactly one whole gang
+    (no interleaved half-gangs deadlocking the window)."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=3, denied_s=1)) as c:
+        add_pool(c, "arena", dims=(4, 4, 4))
+        a = slice_gang(c, "gang-a", "4x4x4", 16)
+        b = slice_gang(c, "gang-b", "4x4x4", 16)
+        import time
+        deadline = time.monotonic() + 25
+        def done(pods):
+            return all(c.pod_scheduled(p.key) for p in pods)
+        while time.monotonic() < deadline and not (done(a) or done(b)):
+            time.sleep(0.05)
+        assert done(a) or done(b)
+        winner, loser = (a, b) if done(a) else (b, a)
+        # the loser must remain fully unbound (all-or-nothing held)
+        assert c.wait_for_pods_unscheduled([p.key for p in loser], hold=1.5)
+        hosts = {c.pod(p.key).spec.node_name for p in winner}
+        assert len(hosts) == 16
+
+
+# -- accelerator generations --------------------------------------------------
+
+def test_every_generation_places_a_slice_e2e():
+    """v4 / v5e / v5p / v6e each schedule a full-pool slice with the right
+    host-block geometry (accelerator catalog, api/topology.py)."""
+    cases = [
+        ("tpu-v4", (4, 4, 4), "4x4x4", 16),    # 2x2x1 hosts → 16 hosts
+        ("tpu-v5e", (4, 4), "4x4", 4),         # 2x2 hosts → 4 hosts
+        ("tpu-v5p", (2, 2, 4), "2x2x4", 4),    # 4 hosts
+        ("tpu-v6e", (8, 4), "8x4", 4),         # 4x2 hosts → 4 hosts
+    ]
+    import math
+    from tpusched.topology.torus import HOST_EXTENT
+    for acc, dims, shape, members in cases:
+        with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                                  denied_s=1)) as c:
+            chips_per_host = math.prod(HOST_EXTENT[acc])
+            add_pool(c, f"pool-{acc}", accelerator=acc, dims=dims)
+            pods = slice_gang(c, f"job-{acc}", shape, members,
+                              accelerator=acc, chips=chips_per_host)
+            assert c.wait_for_pods_scheduled([p.key for p in pods],
+                                             timeout=20), acc
+            coords = {c.pod(p.key).meta.annotations[COORD_ANNOTATION]
+                      for p in pods}
+            assert len(coords) == members, (acc, coords)

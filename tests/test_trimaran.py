@@ -1,9 +1,6 @@
 """Trimaran load-aware scoring tests — mirrors the reference's scoring math
 suites (targetloadpacking_test.go, loadvariationriskbalancing_test.go,
 analysis_test.go; SURVEY §4 'biggest suites')."""
-import http.server
-import json
-import threading
 import time
 
 from tpusched.api.resources import CPU, make_resources
@@ -138,33 +135,16 @@ def test_lvrb_combines_cpu_memory_min():
 
 def test_service_client_http_roundtrip():
     """The reference integration tier fakes the watcher at the HTTP layer
-    (targetloadpacking_test.go:56-95); same here with a real local server."""
-    doc = {"timestamp": 1, "window": {"start": 0, "end": 100},
-           "data": {"NodeMetricsMap": {
-               "n1": {"metrics": [{"type": "CPU", "operator": "Average",
-                                   "value": 42.5}]}}}}
-
-    class Handler(http.server.BaseHTTPRequestHandler):
-        def do_GET(self):
-            body = json.dumps(doc).encode()
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *a):
-            pass
-
-    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    try:
-        client = ServiceClient(f"http://127.0.0.1:{server.server_port}")
+    (targetloadpacking_test.go:56-95); same here with the shared double."""
+    from tpusched.testing import FakeWatcher
+    with FakeWatcher(window_end=100) as w:
+        w.node_metrics = {"n1": [{"type": "CPU", "operator": "Average",
+                                  "value": 42.5}]}
+        client = ServiceClient(w.address)
         m = client.get_latest_watcher_metrics()
         assert m is not None
         assert m.data["n1"].metrics[0].value == 42.5
         assert m.window.end == 100
-    finally:
-        server.shutdown()
 
 
 def test_assign_handler_cleanup():

@@ -5,62 +5,13 @@ HTTP layer) over the in-process cluster: a local HTTP server serves
 load-watcher JSON, the scheduler profile wires the plugin by args, and the
 assertion is WHERE pods land.
 """
-import http.server
-import json
-import threading
-
 import pytest
 
 from tpusched.api.resources import CPU, make_resources
 from tpusched.config.types import (LoadVariationRiskBalancingArgs,
                                    TargetLoadPackingArgs)
 from tpusched.fwk import PluginProfile
-from tpusched.testing import TestCluster, make_node, make_pod
-
-
-class FakeWatcher:
-    """Serves the load-watcher wire format; per-test mutable node loads."""
-
-    def __init__(self):
-        self.node_metrics = {}   # name -> list of metric dicts
-        self.fail = False
-        outer = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):
-                if outer.fail:
-                    self.send_response(500)
-                    self.end_headers()
-                    return
-                # window ends "now": pods bound after it are unmeasured and
-                # must be bridged by the assign handler
-                import time as _t
-                doc = {"timestamp": 1,
-                       "window": {"start": 0, "end": _t.time()},
-                       "data": {"NodeMetricsMap": {
-                           n: {"metrics": ms}
-                           for n, ms in outer.node_metrics.items()}}}
-                body = json.dumps(doc).encode()
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):
-                pass
-
-        self._server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
-        self.address = f"http://127.0.0.1:{self._server.server_port}"
-
-    def set_cpu(self, **loads):
-        self.node_metrics = {
-            n: [{"type": "CPU", "operator": "Average", "value": v}]
-            for n, v in loads.items()}
-
-    def close(self):
-        self._server.shutdown()
+from tpusched.testing import FakeWatcher, TestCluster, make_node, make_pod
 
 
 @pytest.fixture

@@ -9,7 +9,8 @@ Because the rebuild's API server is in-process (SURVEY §5 "Checkpoint /
 resume": etcd-as-truth), the binary hosts one and can emulate a TPU node pool
 behind it (``--emulate-pool``) so the whole stack is drivable end-to-end from
 the command line; ``--validate-only`` decodes + wires the config and prints
-the resolved profile without scheduling (the smoke path main_test.go's
+the resolved profiles (a JSON array, one entry per hosted profile) without
+scheduling (the smoke path main_test.go's
 TestSetup exercises in the reference).
 """
 from __future__ import annotations

@@ -43,7 +43,16 @@ def decode_plugin_args(plugin_name: str, raw: Dict[str, Any]):
         if norm not in fields:
             raise ConfigError(f"unknown field {k!r} in {plugin_name}Args")
         kwargs[norm] = v
-    return cls(**kwargs)
+    args = cls(**kwargs)
+    # args types may define validate() raising ValueError — surfaced here so
+    # --validate-only catches range errors, not a silent clamp at score time
+    validate = getattr(args, "validate", None)
+    if validate is not None:
+        try:
+            validate()
+        except ValueError as e:
+            raise ConfigError(f"{plugin_name}Args: {e}") from e
+    return args
 
 
 def _camel_to_snake(name: str) -> str:

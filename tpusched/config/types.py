@@ -51,6 +51,20 @@ class TopologyMatchArgs:
     # resource weights for the strategy (cpu/mem weight 1 default in the
     # reference; here chips).
     resource_weights: dict = field(default_factory=lambda: {"google.com/tpu": 1})
+    # blend between the TPU-first corner-packing constraint score (fewest
+    # surviving placements wins — anti-fragmentation) and the NRT-style
+    # strategy score over the pool zone. 0.7 keeps packing dominant; 0.0
+    # reproduces the reference's pure-strategy zone scoring.
+    packing_weight: float = 0.7
+
+    def validate(self) -> None:
+        if not 0.0 <= self.packing_weight <= 1.0:
+            raise ValueError(
+                f"packingWeight must be in [0, 1], got {self.packing_weight}")
+        if self.scoring_strategy not in ("LeastAllocated", "MostAllocated",
+                                         "BalancedAllocation"):
+            raise ValueError(
+                f"unknown scoringStrategy {self.scoring_strategy!r}")
 
 
 @dataclass

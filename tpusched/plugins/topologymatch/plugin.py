@@ -278,7 +278,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         constraint = MAX_NODE_SCORE * (stash.max_membership - membership) \
             // max(1, stash.max_membership)
         strategy = self._strategy_score(pool_util)
-        return (constraint * 7 + strategy * 3) // 10, Status.success()
+        w = self.args.packing_weight  # range-checked at config decode
+        return int(constraint * w + strategy * (1.0 - w)), Status.success()
 
     def _strategy_score(self, util: float) -> int:
         """NRT scoring strategies over the pool 'zone'

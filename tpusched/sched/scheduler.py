@@ -135,8 +135,11 @@ class Scheduler:
         # scheduler label: one process can host several profiles (upstream
         # shares ONE queue across profiles; here each profile owns a queue,
         # so the label keeps N schedulers from clobbering each other's gauge)
-        sched_label = f'scheduler="{profile.scheduler_name}",' \
-            if profile.scheduler_name else ""
+        # escape per the Prometheus text format: the name is the one
+        # user-controlled string that reaches a label value
+        esc = (profile.scheduler_name.replace("\\", r"\\")
+               .replace('"', r'\"').replace("\n", r"\n"))
+        sched_label = f'scheduler="{esc}",' if profile.scheduler_name else ""
         for q in ("active", "backoff", "unschedulable"):
             def depth(q=q, ref=queue_ref):
                 live = ref()

@@ -1,0 +1,72 @@
+"""A local HTTP load-watcher double — the reference integration tier fakes
+the watcher at the HTTP layer (httptest.NewServer serving canned
+watcher.WatcherMetrics JSON, /root/reference/pkg/trimaran/targetloadpacking/
+targetloadpacking_test.go:56-95). One shared implementation so the wire
+format lives in a single place across suites.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class FakeWatcher:
+    """Serves the load-watcher wire format on an ephemeral local port.
+
+    - ``node_metrics``: node name → list of raw metric dicts
+      (``{"type": "CPU", "operator": "Average", "value": 40.0}``).
+    - ``fail=True`` → every GET returns 500 (watcher-outage path).
+    - ``window_end``: fixed metrics-window end; ``None`` (default) serves
+      end=now so pods bound after the scrape read as unmeasured and must be
+      bridged by the PodAssignEventHandler.
+    """
+
+    def __init__(self, window_end: Optional[float] = None):
+        self.node_metrics: Dict[str, List[dict]] = {}
+        self.fail = False
+        self.window_end = window_end
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if outer.fail:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                end = outer.window_end
+                doc = {"timestamp": 1,
+                       "window": {"start": 0,
+                                  "end": time.time() if end is None else end},
+                       "data": {"NodeMetricsMap": {
+                           n: {"metrics": ms}
+                           for n, ms in outer.node_metrics.items()}}}
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        self.address = f"http://127.0.0.1:{self._server.server_port}"
+
+    def set_cpu(self, **loads: float) -> None:
+        self.node_metrics = {
+            n: [{"type": "CPU", "operator": "Average", "value": v}]
+            for n, v in loads.items()}
+
+    def close(self) -> None:
+        self._server.shutdown()
+
+    def __enter__(self) -> "FakeWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
