@@ -295,6 +295,12 @@ class Framework:
         # lazily on the first waiting pod; woken on registration and close
         self._waiting_cv = threading.Condition(self._waiting_lock)
         self._sweeper: Optional[threading.Thread] = None
+        # earliest outstanding permit deadline the sweeper is sleeping
+        # toward (monotonic); None = no horizon. Inserters notify ONLY when
+        # they shrink it, so a gang of same-timeout waiters (deadlines
+        # strictly increasing) wakes the sweeper exactly once — without
+        # this, every arrival woke an O(n) rescan: O(n^2) per gang.
+        self._permit_horizon: Optional[float] = None
         self._closed = False
 
         plugins: Dict[str, Plugin] = {}
@@ -524,13 +530,18 @@ class Framework:
                     # reserved state
                     return Status.unschedulable(
                         f"pod {pod.key} rejected: framework is closing")
-                self._waiting[pod.meta.uid] = _WaitingPod(pod, plugin_timeouts)
+                wp = _WaitingPod(pod, plugin_timeouts)
+                self._waiting[pod.meta.uid] = wp
                 if self._sweeper is None:
                     self._sweeper = threading.Thread(
                         target=self._sweep_permit_deadlines,
                         name="tpusched-permit-sweeper", daemon=True)
                     self._sweeper.start()
-                self._waiting_cv.notify_all()
+                d = wp.deadline()
+                if d is not None and (self._permit_horizon is None
+                                      or d < self._permit_horizon):
+                    self._permit_horizon = d
+                    self._waiting_cv.notify_all()
             return Status.wait()
         return status_code
 
@@ -580,13 +591,21 @@ class Framework:
                     d = wp.deadline()
                     if d is not None and (nxt is None or d < nxt):
                         nxt = d
+                self._permit_horizon = nxt
                 timeout = None if nxt is None \
                     else max(0.01, nxt - time.monotonic())
                 self._waiting_cv.wait(timeout=timeout)
                 if self._closed:
                     return
-                due = list(self._waiting.values())
-            now = time.monotonic()
+                # a wake before the horizon means an inserter SHRANK it
+                # (inserters only notify then): nothing can be due yet,
+                # recompute the horizon without sweeping the waiters
+                now = time.monotonic()
+                horizon = self._permit_horizon
+                if horizon is None or now < horizon:
+                    continue
+                due = [wp for wp in self._waiting.values()
+                       if (d := wp.deadline()) is not None and d <= now]
             for wp in due:  # fires callbacks — never under the lock
                 wp.expire_if_due(now)
 
