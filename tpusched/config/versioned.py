@@ -69,7 +69,16 @@ _V1ALPHA1_ARG_RENAMES: Dict[str, Dict[str, str]] = {
 
 @dataclass
 class LeaderElectionConfig:
-    """`leaderElection:` block (manifests/coscheduling/scheduler-config.yaml:3-4)."""
+    """`leaderElection:` block (manifests/coscheduling/scheduler-config.yaml:3-4).
+
+    Decoded for schema parity with KubeSchedulerConfiguration, but the
+    SCHEDULER binary deliberately does not act on it: its API server is
+    in-process, so two scheduler processes can never share the state a
+    lease would arbitrate (a --state-dir WAL is single-writer). HA lives
+    where state is shared — the controller runner's Lease-based election
+    (controllers/runner.py, `--enable-leader-election`), matching the
+    reference's split: kube-scheduler HA is the hosting cluster's concern,
+    controller HA is in-repo (cmd/controller/app/server.go:84-123)."""
     leader_elect: bool = False
     lease_duration_seconds: float = 15.0
     renew_interval_seconds: float = 5.0
