@@ -49,7 +49,10 @@ def test_second_slice_prefers_same_dcn_domain():
         assert pool_of(c, s1) == "near"
 
 
-def test_adjacent_zone_beats_remote_zone():
+def test_adjacent_zone_beats_remote_zone_then_degrades_to_remote():
+    """DCN proximity prefers the anchor rack, degrades to the adjacent rack
+    when it is full, and still admits in the remote zone when the whole
+    anchor zone is full — a preference, never a gate."""
     with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
         add_pool(c, "a1", "zoneA/rack1")
         s0 = slice_pg(c, "job", 0)
@@ -60,6 +63,10 @@ def test_adjacent_zone_beats_remote_zone():
         s1 = slice_pg(c, "job", 1)
         assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=20)
         assert pool_of(c, s1) == "a2", "slice-1 went to the remote zone"
+        # the whole anchor zone is now full: the remote zone still admits
+        s2 = slice_pg(c, "job", 2)
+        assert c.wait_for_pods_scheduled([p.key for p in s2], timeout=20)
+        assert pool_of(c, s2) == "b1"
 
 
 def test_four_slice_job_spreads_over_four_pools():
@@ -74,3 +81,4 @@ def test_four_slice_job_spreads_over_four_pools():
             all_pods[idx] = pods
         pools = {idx: pool_of(c, pods) for idx, pods in all_pods.items()}
         assert len(set(pools.values())) == 4  # one pool per slice
+

@@ -1,0 +1,158 @@
+"""MultiSlice PreScore/Score unit tables — the plugin is a TPU-native
+addition with no reference analog (SURVEY §7.7), so its contract is pinned
+here at the same table depth the ported plugins get: domain collection from
+placed siblings, the same/adjacent/remote score ladder, skip paths, and
+isolation between sets and namespaces.
+"""
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.types import MultiSliceArgs
+from tpusched.fwk import CycleState, PluginProfile
+from tpusched.testing import (make_pod, make_pod_group, make_tpu_node,
+                              new_test_framework)
+
+SET = "llama70b"
+
+
+def ms_framework(args=None, pod_groups=(), pods=(), nodes=()):
+    profile = PluginProfile(
+        pre_score=["MultiSlice"], score=[("MultiSlice", 1)],
+        bind=["DefaultBinder"],
+        plugin_args={"MultiSlice": args} if args else {})
+    fw, handle, api = new_test_framework(profile, nodes=nodes, pods=pods)
+    for pg in pod_groups:
+        api.create(srv.POD_GROUPS, pg)
+    return fw, fw.plugins["MultiSlice"], handle, api
+
+
+def domain_node(name, domain):
+    return make_tpu_node(name, chips=4, dcn_domain=domain)
+
+
+def slice_pg(index, namespace="default"):
+    return make_pod_group(f"{SET}-slice-{index}", namespace=namespace,
+                          min_member=1, multislice_set=SET,
+                          multislice_index=index)
+
+
+def placed_sibling(name, pg, node, namespace="default"):
+    return make_pod(name, namespace=namespace, pod_group=pg,
+                    limits={TPU: 4}, node_name=node)
+
+
+def run_pre_score(ms, pod):
+    state = CycleState()
+    st = ms.pre_score(state, pod, [])
+    return state, st
+
+
+def test_pre_score_skips_non_multislice_pods():
+    pg = make_pod_group("plain-gang", min_member=1)
+    fw, ms, _, api = ms_framework(pod_groups=[pg])
+    _, st = run_pre_score(ms, make_pod("solo"))
+    assert st.is_skip()
+    _, st = run_pre_score(ms, make_pod("m", pod_group="plain-gang"))
+    assert st.is_skip()
+
+
+def test_pre_score_skips_first_slice_of_set():
+    """No placed sibling ⇒ nothing to pull toward: Score must not run."""
+    fw, ms, _, api = ms_framework(pod_groups=[slice_pg(0)])
+    _, st = run_pre_score(ms, make_pod("p", pod_group=f"{SET}-slice-0"))
+    assert st.is_skip()
+
+
+def scored_framework(extra_pgs=(), sibling_domains=("zoneA/rack1",)):
+    """slice-1 scoring while slice-0 members sit in sibling_domains."""
+    nodes = [domain_node(f"placed-{i}", d)
+             for i, d in enumerate(sibling_domains)]
+    nodes += [domain_node("same", "zoneA/rack1"),
+              domain_node("adjacent", "zoneA/rack2"),
+              domain_node("remote", "zoneB/rack1"),
+              make_tpu_node("unlabeled", chips=4)]
+    placed = [placed_sibling(f"s0-{i}", f"{SET}-slice-0", f"placed-{i}")
+              for i in range(len(sibling_domains))]
+    fw, ms, handle, api = ms_framework(
+        pod_groups=[slice_pg(0), slice_pg(1), *extra_pgs],
+        pods=placed, nodes=nodes)
+    return fw, ms, handle, api
+
+
+def test_score_ladder_same_adjacent_remote_unlabeled():
+    fw, ms, handle, api = scored_framework()
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    state, st = run_pre_score(ms, pod)
+    assert st.is_success()
+    assert ms.score(state, pod, "same")[0] == 100
+    assert ms.score(state, pod, "adjacent")[0] == 50
+    assert ms.score(state, pod, "remote")[0] == 0
+    assert ms.score(state, pod, "unlabeled")[0] == 0
+
+
+def test_score_custom_args_and_cap():
+    args = MultiSliceArgs(same_domain_score=500, adjacent_domain_score=80)
+    nodes = [domain_node("placed-0", "zoneA/rack1"),
+             domain_node("same", "zoneA/rack1"),
+             domain_node("adjacent", "zoneA/rack2")]
+    fw, ms, handle, api = ms_framework(
+        args=args, pod_groups=[slice_pg(0), slice_pg(1)],
+        pods=[placed_sibling("s0-0", f"{SET}-slice-0", "placed-0")],
+        nodes=nodes)
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    state, st = run_pre_score(ms, pod)
+    assert st.is_success()
+    assert ms.score(state, pod, "same")[0] == 100   # capped at MaxNodeScore
+    assert ms.score(state, pod, "adjacent")[0] == 80
+
+
+def test_siblings_spanning_domains_all_attract():
+    """A set already spread over two domains: BOTH count as same-domain."""
+    fw, ms, handle, api = scored_framework(
+        sibling_domains=("zoneA/rack1", "zoneB/rack1"))
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    state, _ = run_pre_score(ms, pod)
+    assert ms.score(state, pod, "same")[0] == 100      # zoneA/rack1
+    assert ms.score(state, pod, "remote")[0] == 100    # zoneB/rack1 now sibling
+
+
+def test_other_set_does_not_attract():
+    """Placed pods of a DIFFERENT multislice set must not pull this one."""
+    other_pg = make_pod_group("other-slice-0", min_member=1,
+                              multislice_set="other", multislice_index=0)
+    nodes = [domain_node("placed-0", "zoneA/rack1"),
+             domain_node("same", "zoneA/rack1")]
+    fw, ms, handle, api = ms_framework(
+        pod_groups=[other_pg, slice_pg(0), slice_pg(1)],
+        pods=[placed_sibling("o-0", "other-slice-0", "placed-0")],
+        nodes=nodes)
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    _, st = run_pre_score(ms, pod)
+    assert st.is_skip()   # no OWN siblings placed anywhere
+
+
+def test_same_set_other_namespace_does_not_attract():
+    """multislice_set matching is namespace-scoped."""
+    fw, ms, handle, api = ms_framework(
+        pod_groups=[slice_pg(0, namespace="team-b"), slice_pg(0),
+                    slice_pg(1)],
+        pods=[placed_sibling("b-0", f"{SET}-slice-0", "placed-0",
+                             namespace="team-b")],
+        nodes=[domain_node("placed-0", "zoneA/rack1"),
+               domain_node("same", "zoneA/rack1")])
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    _, st = run_pre_score(ms, pod)
+    assert st.is_skip()
+
+
+def test_unassigned_siblings_do_not_attract():
+    """Only pods with a node (assumed/bound) contribute domains — a pending
+    sibling slice must not anchor the set to nowhere."""
+    pending = make_pod("s0-pending", pod_group=f"{SET}-slice-0",
+                       limits={TPU: 4})  # no node_name
+    fw, ms, handle, api = ms_framework(
+        pod_groups=[slice_pg(0), slice_pg(1)],
+        pods=[pending],
+        nodes=[domain_node("same", "zoneA/rack1")])
+    pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
+    _, st = run_pre_score(ms, pod)
+    assert st.is_skip()
