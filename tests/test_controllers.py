@@ -12,16 +12,7 @@ from tpusched.apiserver import server as srv
 from tpusched.controllers import (ControllerRunner, ElasticQuotaController,
                                   PodGroupController, ServerRunOptions,
                                   WorkQueue)
-from tpusched.testing import make_elastic_quota, make_pod, make_pod_group
-
-
-def wait_until(fn, timeout=5.0, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
+from tpusched.testing import wait_until, make_elastic_quota, make_pod, make_pod_group
 
 
 def pg_phase(api, key):

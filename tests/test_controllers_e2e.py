@@ -6,8 +6,6 @@ scheduler AND both controllers; the kubelet simulator flips bound pods to
 Running; assertions are on CR *status* written by the controllers while
 scheduling happens around them.
 """
-import time
-
 from tpusched.api.core import POD_FAILED, POD_SUCCEEDED
 from tpusched.api.resources import TPU
 from tpusched.api.scheduling import (PG_FAILED, PG_FINISHED, PG_RUNNING,
@@ -15,16 +13,7 @@ from tpusched.api.scheduling import (PG_FAILED, PG_FINISHED, PG_RUNNING,
 from tpusched.apiserver import server as srv
 from tpusched.config.profiles import capacity_profile, tpu_gang_profile
 from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
-                              make_pod_group, make_tpu_node)
-
-
-def wait_for(fn, timeout=10.0, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(interval)
-    return fn()
+                              make_pod_group, make_tpu_node, wait_until)
 
 
 def set_pod_phase(c, key, phase):
@@ -48,16 +37,16 @@ def test_podgroup_walks_scheduled_running_finished_live():
 
         def phase():
             return c.api.get(srv.POD_GROUPS, "default/job").status.phase
-        assert wait_for(lambda: phase() == PG_SCHEDULED)
+        assert wait_until(lambda: phase() == PG_SCHEDULED)
 
         c.mark_running()
-        assert wait_for(lambda: phase() == PG_RUNNING)
+        assert wait_until(lambda: phase() == PG_RUNNING)
         pg = c.api.get(srv.POD_GROUPS, "default/job")
         assert pg.status.running == 8
 
         for p in pods:
             set_pod_phase(c, p.key, POD_SUCCEEDED)
-        assert wait_for(lambda: phase() == PG_FINISHED)
+        assert wait_until(lambda: phase() == PG_FINISHED)
         pg = c.api.get(srv.POD_GROUPS, "default/job")
         assert pg.status.succeeded == 8 and pg.status.running == 0
 
@@ -76,7 +65,7 @@ def test_podgroup_member_failure_is_terminal_live():
 
         def phase():
             return c.api.get(srv.POD_GROUPS, "default/job").status.phase
-        assert wait_for(lambda: phase() == PG_FAILED)
+        assert wait_until(lambda: phase() == PG_FAILED)
         assert c.api.get(srv.POD_GROUPS, "default/job").status.failed == 1
 
 
@@ -97,12 +86,14 @@ def test_elasticquota_status_tracks_running_pods_live():
         def used():
             return c.api.get(srv.ELASTIC_QUOTAS,
                              "default/quota").status.used.get(TPU, 0)
-        # bound but not Running: used stays 0 (reference counts Running only)
+        # bound but not Running: used stays 0 (reference counts Running only,
+        # controller/elasticquota.go:212-224)
+        assert not wait_until(lambda: used() > 0, timeout=0.7)
         c.mark_running()
-        assert wait_for(lambda: used() == 3)
+        assert wait_until(lambda: used() == 3)
 
         c.api.delete(srv.PODS, pods[0].key)
-        assert wait_for(lambda: used() == 2)
+        assert wait_until(lambda: used() == 2)
         events = [e for e in c.api.events()
                   if e.reason == "Synced" and "quota" in e.object_key]
         assert events, "EQ controller emitted no Synced event"
@@ -127,5 +118,5 @@ def test_occupied_by_filled_live():
 
         def occupied():
             return c.api.get(srv.POD_GROUPS, "default/job").status.occupied_by
-        assert wait_for(lambda: bool(occupied()))
+        assert wait_until(lambda: bool(occupied()))
         assert "train-job" in occupied()

@@ -3,9 +3,9 @@ test/integration/utils.go builder wrappers)."""
 from .wrappers import (make_node, make_pod, make_pod_group, make_elastic_quota,
                        make_tpu_node, make_tpu_pool, make_resources)
 from .harness import new_test_framework
-from .cluster import TestCluster
+from .cluster import TestCluster, wait_until
 from .fakewatcher import FakeWatcher
 
 __all__ = ["make_node", "make_pod", "make_pod_group", "make_elastic_quota",
            "make_tpu_node", "make_tpu_pool", "make_resources",
-           "new_test_framework", "TestCluster", "FakeWatcher"]
+           "new_test_framework", "TestCluster", "FakeWatcher", "wait_until"]

@@ -110,6 +110,17 @@ class TestCluster:
                 self.api.patch(srv.PODS, p.key, mutate)
 
 
+def wait_until(fn, timeout: float = 5.0, interval: float = 0.02) -> bool:
+    """Poll fn() until truthy or timeout — the podScheduled-style helper for
+    arbitrary conditions (test/integration/utils.go:46-55)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
 def default_profile() -> PluginProfile:
     """The kitchen-sink test profile: defaults + TpuSlice wired the way the
     reference's flexgpu Helm chart wires FlexGPU (DefaultBinder disabled,
