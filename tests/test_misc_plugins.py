@@ -340,3 +340,34 @@ def test_node_resource_limits_neutral_for_limitless_pods():
     totals, s = fw.run_score_plugins(CycleState(), make_pod("p"), [n1, n2])
     assert s.is_success()
     assert totals["n1"] == totals["n2"] == 100
+
+
+def test_preemption_toleration_window_expires_live():
+    """Timed toleration e2e (preemption_toleration.go:125-175): the victim is
+    exempt while its toleration window runs, and the SAME pending preemptor
+    succeeds once the window expires — no operator action in between."""
+    import time as _time
+    from tpusched.testing import wait_until
+    with TestCluster(profile=pt_profile()) as c:
+        c.api.create(srv.PRIORITY_CLASSES,
+                     make_pc("short-fuse", 100, minimum=10000, toleration=2))
+        node = make_tpu_node("h0", chips=4)
+        c.add_nodes([node])
+        victim = make_pod("victim", limits={TPU: 4}, priority=100,
+                          priority_class_name="short-fuse")
+        c.create_pods([victim])
+        assert c.wait_for_pods_scheduled([victim.key])
+        bound_at = _time.time()
+        preemptor = make_pod("preemptor", limits={TPU: 4}, priority=500)
+        c.create_pods([preemptor])
+        # inside the window: the preemptor must NOT displace the victim
+        assert c.wait_for_pods_unscheduled([preemptor.key], hold=1.0)
+        assert c.pod(victim.key) is not None
+        # after expiry, a cluster event requeues the pending preemptor (the
+        # unschedulable-queue periodic flush is 30s; real clusters see a
+        # constant event stream — emulate one poke)
+        while _time.time() < bound_at + 2.2:
+            _time.sleep(0.05)
+        c.api.patch(srv.NODES, node.meta.key, lambda n: None)  # update event
+        assert c.wait_for_pods_scheduled([preemptor.key], timeout=15)
+        assert wait_until(lambda: c.pod(victim.key) is None, timeout=5)
