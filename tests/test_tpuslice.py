@@ -132,3 +132,113 @@ def test_score_binpack_normalize():
                                      [n_empty, n_half])
     assert s.is_success()
     assert totals["half"] > totals["empty"]
+
+
+def test_chipnode_invalid_annotations_counted_but_unplaced():
+    """Garbage / out-of-range chip indexes: the pod still counts against the
+    node-level limit sums (capacity check input) but places nothing."""
+    bad1 = make_pod("bad1", limits={TPU: 1},
+                    annotations={CHIP_INDEX_ANNOTATION: "nope"}, node_name="n1")
+    bad2 = make_pod("bad2", limits={TPU: 1},
+                    annotations={CHIP_INDEX_ANNOTATION: "7"}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([bad1, bad2]))
+    assert cn.free_chip_indexes() == [0, 1, 2, 3]  # nothing placed
+    assert cn.used_chips_limit == 2                # but capacity-counted
+
+
+def test_chipnode_hbm_from_accelerator_catalog():
+    """A node advertising chips but no google.com/tpu-memory falls back to
+    the accelerator catalog's per-chip HBM (api/topology.py)."""
+    from tpusched.api.resources import make_resources
+    from tpusched.api.topology import ACCELERATORS, LABEL_ACCELERATOR
+    from tpusched.testing import make_node
+    cap = make_resources(cpu=8, memory="16Gi", pods=110)
+    cap[TPU] = 4
+    node = make_node("bare", capacity=cap,
+                     labels={LABEL_ACCELERATOR: "tpu-v5e"})
+    cn = ChipNode.from_node_info(NodeInfo(node, []))
+    assert cn.chips[0].hbm_mb == ACCELERATORS["tpu-v5e"].hbm_mb_per_chip
+
+
+def test_pod_tpu_limits_multi_container_and_requests_fallback():
+    from tpusched.api.core import Container
+    from tpusched.plugins.tpuslice.chip_node import pod_tpu_limits
+    p = make_pod("multi")
+    p.spec.containers = [Container(limits={TPU: 2}),
+                         Container(limits={TPU: 1})]
+    assert pod_tpu_limits(p) == (3, True, 0, False)
+    # requests-only containers fall back (extended resources force
+    # requests==limits in k8s, so this is behavior-preserving)
+    p.spec.containers = [Container(requests={TPU_MEMORY: 512})]
+    assert pod_tpu_limits(p) == (0, False, 512, True)
+
+
+def test_fractional_pod_occupies_first_index_only():
+    frac = make_pod("f", limits={TPU_MEMORY: 1000},
+                    annotations={CHIP_INDEX_ANNOTATION: "1,2"}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([frac]))
+    assert cn.chips[1].used_mb == 1000
+    assert cn.chips[2].used_mb == 0
+
+
+def test_mem_fit_skips_monopoly_chips():
+    mono = make_pod("m", limits={TPU: 2},
+                    annotations={CHIP_INDEX_ANNOTATION: "0,1"}, node_name="n1")
+    cn = ChipNode.from_node_info(node_info_with([mono]))
+    assert cn.mem_fit_indexes(1024) == [2, 3]
+
+
+def test_fractional_tenants_pack_then_overflow_e2e():
+    """Live cluster: three 40GB fractional pods — the first two pack one
+    chip (bin-pack by least remaining), the third overflows to a new chip;
+    a whole-chip pod then takes a free chip, never the fractional ones."""
+    from tpusched.testing import TestCluster
+    profile = PluginProfile(filter=["NodeResourcesFit", "TpuSlice"],
+                            score=[("TpuSlice", 1)],
+                            reserve=["TpuSlice"], bind=["TpuSlice"])
+    with TestCluster(profile=profile) as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        gb40 = 40 * 1024
+        fr = [make_pod(f"fr{i}", limits={TPU_MEMORY: gb40}) for i in range(3)]
+        c.create_pods(fr)
+        assert c.wait_for_pods_scheduled([p.key for p in fr])
+        idx = [c.pod(p.key).meta.annotations[CHIP_INDEX_ANNOTATION]
+               for p in fr]
+        assert idx[0] == idx[1] != idx[2]  # two pack, third overflows
+        whole = make_pod("whole", limits={TPU: 2})
+        c.create_pods([whole])
+        assert c.wait_for_pods_scheduled([whole.key])
+        whole_idx = set(c.pod(whole.key).meta.annotations[
+            CHIP_INDEX_ANNOTATION].split(","))
+        assert not (whole_idx & set(idx))  # disjoint from fractional chips
+
+
+def test_annotations_as_truth_restart_e2e():
+    """A second scheduler attached to the same API state rebuilds chip
+    occupancy purely from bound pods' annotations (SURVEY §5: the API server
+    is the checkpoint) — it must refuse a 4th whole chip but admit a 1-chip
+    pod on the remaining free chip."""
+    from tpusched.apiserver import server as srv
+    from tpusched.testing import TestCluster
+    profile = PluginProfile(filter=["NodeResourcesFit", "TpuSlice"],
+                            score=[("TpuSlice", 1)],
+                            reserve=["TpuSlice"], bind=["TpuSlice"])
+    with TestCluster(profile=profile) as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        first = [make_pod(f"a{i}", limits={TPU: 1}) for i in range(3)]
+        c.create_pods(first)
+        assert c.wait_for_pods_scheduled([p.key for p in first])
+        api = c.api
+    # control plane survives; a fresh scheduler process attaches
+    with TestCluster(profile=profile, api=api) as c2:
+        late_big = make_pod("late-big", limits={TPU: 2})
+        late_fit = make_pod("late-fit", limits={TPU: 1})
+        c2.create_pods([late_big, late_fit])
+        assert c2.wait_for_pods_scheduled([late_fit.key])
+        assert c2.wait_for_pods_unscheduled([late_big.key], hold=1.0)
+        used = set()
+        for p in first:
+            used |= set(c2.pod(p.key).meta.annotations[
+                CHIP_INDEX_ANNOTATION].split(","))
+        fit_idx = c2.pod(late_fit.key).meta.annotations[CHIP_INDEX_ANNOTATION]
+        assert fit_idx not in used and len(used) == 3
