@@ -148,3 +148,46 @@ def test_lease_acquire_renew_and_steal_after_expiry():
     now[0] += 2
     assert api.acquire_or_renew_lease("lock", "b", lease_duration=10)
     assert api.lease_holder("lock") == "b"
+
+
+def test_concurrent_writers_lose_no_events():
+    """8 writer threads over disjoint keys with a live watcher: every
+    mutation's event arrives, per-key streams are ordered (single writer per
+    key ⇒ create < patches < delete), and nothing deadlocks. Pins the
+    write-path sharing discipline under real concurrency."""
+    import threading
+    from collections import defaultdict
+
+    api = srv.APIServer()
+    per_key = defaultdict(list)
+    log_lock = threading.Lock()
+
+    def handler(ev):
+        with log_lock:
+            per_key[ev.object.meta.key].append(ev.type)
+
+    api.add_watch(srv.PODS, handler)
+    PATCHES = 20
+
+    def writer(t):
+        for i in range(5):
+            p = make_pod(f"w{t}-p{i}")
+            api.create(srv.PODS, p)
+            for _ in range(PATCHES):
+                api.patch(srv.PODS, p.key,
+                          lambda live: live.meta.labels.__setitem__("x", "y"))
+            api.delete(srv.PODS, p.key)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "writer deadlocked"
+
+    assert len(per_key) == 40
+    for key, evs in per_key.items():
+        assert len(evs) == 2 + PATCHES, (key, len(evs))
+        assert evs[0] == srv.ADDED and evs[-1] == srv.DELETED, (key, evs[:3])
+        assert all(e == srv.MODIFIED for e in evs[1:-1]), key
+    assert api.list(srv.PODS) == []
