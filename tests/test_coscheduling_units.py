@@ -366,3 +366,19 @@ def test_wait_time_precedence():
     assert get_wait_time_duration(None, 40.0) == 40.0
     assert get_wait_time_duration(pg_unset, 0.0) == 60.0   # then 60s default
     assert get_wait_time_duration(None, 0.0) == 60.0
+
+
+def test_denied_window_not_extended_by_repeat_denials():
+    """go-cache Add semantics (core.go:268-270): the denial window runs from
+    the FIRST denial; re-denials during event-driven retries must not extend
+    it, or a retry storm pins the gang denied forever."""
+    from tpusched.util.ttlcache import TTLCache
+    now = [0.0]
+    cache = TTLCache(1.0, clock=lambda: now[0])
+    assert cache.add("pg")
+    now[0] = 0.9
+    assert not cache.add("pg")      # still fresh: not refreshed
+    assert "pg" in cache
+    now[0] = 1.1                    # original expiry passed despite re-add
+    assert "pg" not in cache
+    assert cache.add("pg")          # expired ⇒ add succeeds again

@@ -225,7 +225,10 @@ class PodGroupManager:
     # -- deny/permit caches ---------------------------------------------------
 
     def add_denied_pod_group(self, full: str) -> None:
-        self.last_denied_pg.set(full)
+        # add-if-absent (go-cache Add, core.go:268-270): the denial window
+        # runs from the FIRST denial; repeat denials during retries must not
+        # extend it, or event-driven retries re-deny the gang indefinitely
+        self.last_denied_pg.add(full)
 
     def delete_permitted_pod_group(self, full: str) -> None:
         self.permitted_pg.delete(full)

@@ -20,6 +20,23 @@ class TTLCache:
         with self._lock:
             self._items[key] = (value, exp)
 
+    def add(self, key: str, value: Any = True,
+            ttl: Optional[float] = None) -> bool:
+        """Set ONLY if absent (or expired); returns whether it was added —
+        go-cache Add semantics (cache.go:92-100). The distinction is
+        load-bearing for the denied-PodGroup cache: repeat denials must NOT
+        extend the window, or an event-driven retry storm pins a gang in the
+        denied state forever (each retry would refresh the TTL it is itself
+        rejected by)."""
+        now = self._clock()
+        exp = now + (self._ttl if ttl is None else ttl)
+        with self._lock:
+            item = self._items.get(key)
+            if item is not None and item[1] >= now:
+                return False
+            self._items[key] = (value, exp)
+            return True
+
     def get(self, key: str):
         """Returns (value, True) if present and fresh, else (None, False)."""
         now = self._clock()
