@@ -21,8 +21,11 @@ def test_every_canned_profile_wires_fully():
     for name, factory in sched_cmd.CANNED_PROFILES.items():
         profile = factory()
         s = Scheduler(APIServer(), default_registry(), profile)
-        for plugin_name in profile.all_plugin_names():
-            assert plugin_name in s.framework.plugins, (name, plugin_name)
+        try:
+            for plugin_name in profile.all_plugin_names():
+                assert plugin_name in s.framework.plugins, (name, plugin_name)
+        finally:
+            s.stop()
 
 
 def test_validate_only_prints_resolved_profile(capsys, tmp_path):

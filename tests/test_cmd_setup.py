@@ -35,6 +35,24 @@ WANT = {
     ("capacityscheduling", "tpusched"): dict(
         pre_filter=["CapacityScheduling"], post_filter=["CapacityScheduling"],
         reserve=["CapacityScheduling"]),
+    ("full", "tpusched"): dict(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling", "TopologyMatch", "CapacityScheduling"],
+        filter=DEFAULT_FILTERS + ["TpuSlice", "TopologyMatch"],
+        post_filter=["TopologyMatch", "Coscheduling", "CapacityScheduling"],
+        pre_score=["MultiSlice"],
+        score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],
+        reserve=["TpuSlice", "TopologyMatch", "Coscheduling",
+                 "CapacityScheduling"],
+        permit=["Coscheduling"], bind=["TpuSlice"],
+        post_bind=["Coscheduling"],
+        args={"Coscheduling": {"permit_waiting_time_seconds": 60,
+                               "denied_pg_expiration_time_seconds": 20},
+              "TopologyMatch": {"scoring_strategy": "LeastAllocated",
+                                "resource_weights": {"google.com/tpu": 1},
+                                "packing_weight": 0.7,
+                                "enable_slice_preemption": True,
+                                "slice_preemption_drain_seconds": 60.0}}),
     ("multislice", "tpusched"): dict(
         pre_score=["MultiSlice"], score=[("MultiSlice", 3)],
         args={"MultiSlice": {"same_domain_score": 100,
@@ -57,7 +75,9 @@ WANT = {
         score=[("TopologyMatch", 2)], reserve=["TopologyMatch"],
         args={"TopologyMatch": {"scoring_strategy": "LeastAllocated",
                                 "resource_weights": {"google.com/tpu": 1},
-                                "packing_weight": 0.7}}),
+                                "packing_weight": 0.7,
+                                "enable_slice_preemption": False,
+                                "slice_preemption_drain_seconds": 60.0}}),
     ("trimaran", "tpusched"): dict(
         score=[("TargetLoadPacking", 1)],
         args={"TargetLoadPacking": {

@@ -104,3 +104,47 @@ def test_gang_within_min_unaffected_by_other_teams_gangs():
         keys = [p.key for p in a + b]
         assert c.wait_for_pods_scheduled(keys, timeout=20)
         assert all(c.pod(k) is not None for k in keys)
+
+
+def test_full_stack_slice_gang_under_quota_with_topology():
+    """The full-stack profile end to end: a slice-shaped gang under a team
+    quota lands as one contiguous torus block with chip annotations; a
+    second team's slice gang reclaims its min by preempting the first
+    team's borrowed SECOND slice — torus fitting, gang atomicity, and
+    quota-aware preemption composed in one scheduler."""
+    from tpusched.config.profiles import full_stack_profile
+    from tpusched.plugins.topologymatch import COORD_ANNOTATION
+    from tpusched.testing import make_tpu_pool
+
+    prof = full_stack_profile(permit_wait_s=20, denied_s=1)
+    with TestCluster(profile=prof) as c:
+        topo, nodes = make_tpu_pool("pool", dims=(4, 4, 8))  # 128 chips
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        team_quota(c, "team-a", min_chips=64, max_chips=128)
+        team_quota(c, "team-b", min_chips=64, max_chips=128)
+
+        def slice_gang(team, name):
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, namespace=team, min_member=16,
+                tpu_slice_shape="4x4x4", tpu_accelerator="tpu-v5p"))
+            ps = [make_pod(f"{name}-{i}", namespace=team, pod_group=name,
+                           limits={TPU: 4}) for i in range(16)]
+            c.create_pods(ps)
+            return ps
+
+        a1 = slice_gang("team-a", "a-first")   # within min
+        assert c.wait_for_pods_scheduled([p.key for p in a1], timeout=30)
+        a2 = slice_gang("team-a", "a-borrow")  # borrowed: 128 used vs min 64
+        assert c.wait_for_pods_scheduled([p.key for p in a2], timeout=30)
+        # every member carries torus coords; each gang is 16 distinct hosts
+        for gang_pods in (a1, a2):
+            coords = {c.pod(p.key).meta.annotations[COORD_ANNOTATION]
+                      for p in gang_pods}
+            assert len(coords) == 16
+
+        b1 = slice_gang("team-b", "b-reclaim")  # b's min: must evict a2
+        assert c.wait_for_pods_scheduled([p.key for p in b1], timeout=40)
+        # team-a keeps its guaranteed first slice, loses the borrowed one
+        assert all(c.pod(p.key) is not None for p in a1)
+        assert all(c.pod(p.key) is None for p in a2)

@@ -31,9 +31,12 @@ def test_every_manifest_decodes_and_wires():
         for profile in cfg.profiles:
             # every named plugin resolves and instantiates
             s = Scheduler(APIServer(), default_registry(), profile)
-            for name in profile.all_plugin_names():
-                assert name in registry, (path, name)
-                assert name in s.framework.plugins, (path, name)
+            try:
+                for name in profile.all_plugin_names():
+                    assert name in registry, (path, name)
+                    assert name in s.framework.plugins, (path, name)
+            finally:
+                s.stop()   # leaked collector threads log after teardown
 
 
 def test_all_in_one_embedded_config_decodes():

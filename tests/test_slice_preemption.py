@@ -1,0 +1,173 @@
+"""Slice preemption (TopologyMatch PostFilter) — window-wise eviction for
+slice-shaped gangs. No reference analog (the reference ships cross-node
+preemption disabled and its NRT plugin never preempts); the contract pinned
+here: a whole placement window's victims are evicted together, and every
+victim must be eligible (priority rule OR quota-borrowing rule, minus
+PreemptionToleration exemptions).
+"""
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import full_stack_profile
+from tpusched.config.types import TopologyMatchArgs
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool, wait_until)
+
+
+def cluster(enable=True, permit_wait_s=15):
+    prof = full_stack_profile(permit_wait_s=permit_wait_s, denied_s=1)
+    prof.plugin_args["TopologyMatch"] = TopologyMatchArgs(
+        enable_slice_preemption=enable)
+    return TestCluster(profile=prof)
+
+
+def add_pool(c, dims=(4, 4, 4)):
+    topo, nodes = make_tpu_pool("pool", dims=dims)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+
+
+def slice_gang(c, name, members=16, shape="4x4x4", namespace="default",
+               priority=0):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, namespace=namespace, min_member=members,
+        tpu_slice_shape=shape, tpu_accelerator="tpu-v5p"))
+    pods = [make_pod(f"{name}-{i}", namespace=namespace, pod_group=name,
+                     limits={TPU: 4}, priority=priority)
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def test_high_priority_slice_gang_evicts_low_priority_slice():
+    """Priority rule, no quotas involved: the resident low-priority slice is
+    evicted window-wise and the high-priority gang takes the pool."""
+    with cluster() as c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        high = slice_gang(c, "high", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in high], timeout=30)
+        assert all(c.pod(p.key) is None for p in low)
+        evicted = [e for e in c.api.events() if e.reason == "Preempted"
+                   and "Slice-preempted" in e.message]
+        assert len(evicted) == 16
+
+
+def test_equal_priority_no_quota_never_evicts():
+    """Without a priority edge or a quota-borrowing edge there is no right
+    to the window: the second gang stays pending."""
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        first = slice_gang(c, "first", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in first], timeout=30)
+        second = slice_gang(c, "second", priority=10)
+        assert c.wait_for_pods_unscheduled([p.key for p in second], hold=3.0)
+        assert all(c.pod(p.key) is not None for p in first)
+
+
+def test_toleration_exempt_victims_block_the_window():
+    """A resident slice whose PriorityClass grants unlimited toleration must
+    not be slice-preempted even by a higher-priority gang (composition with
+    PreemptionToleration's policy annotations)."""
+    from tests.test_misc_plugins import make_pc
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        c.api.create(srv.PRIORITY_CLASSES,
+                     make_pc("tolerant", 10, minimum=100000, toleration=-1))
+        low = slice_gang(c, "protected", priority=10)
+        for p in low:
+            c.api.patch(srv.PODS, p.key, lambda live: setattr(
+                live.spec, "priority_class_name", "tolerant"))
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        high = slice_gang(c, "impatient", priority=1000)
+        assert c.wait_for_pods_unscheduled([p.key for p in high], hold=3.0)
+        assert all(c.pod(p.key) is not None for p in low)
+
+
+def test_disabled_flag_never_evicts():
+    with cluster(enable=False, permit_wait_s=3) as c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        high = slice_gang(c, "high", priority=1000)
+        assert c.wait_for_pods_unscheduled([p.key for p in high], hold=2.5)
+        assert all(c.pod(p.key) is not None for p in low)
+
+
+def test_cheapest_victim_window_chosen():
+    """A full pool holds two resident slices at different priorities; the
+    incoming top-priority gang must evict the LOWER-total-priority window
+    and leave the other resident running (window ranking: PDB violations →
+    victim count → total priority)."""
+    with cluster() as c:
+        add_pool(c, dims=(4, 4, 8))  # exactly two disjoint 4x4x4 windows
+        cheap = slice_gang(c, "cheap", members=16, shape="4x4x4", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in cheap], timeout=30)
+        dear = slice_gang(c, "dear", members=16, shape="4x4x4", priority=500)
+        assert c.wait_for_pods_scheduled([p.key for p in dear], timeout=30)
+        big = slice_gang(c, "big", members=16, shape="4x4x4", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in big], timeout=30)
+        assert all(c.pod(p.key) is None for p in cheap)      # evicted window
+        assert all(c.pod(p.key) is not None for p in dear)   # spared
+
+
+def test_priority_never_breaks_foreign_team_min():
+    """A quota-governed team running INSIDE its min is untouchable even by a
+    much higher-priority foreign gang — priority does not bypass another
+    team's guarantee (upstream CapacityScheduling only ever evicts over-min
+    borrowers cross-namespace)."""
+    from tpusched.testing import make_elastic_quota
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "a-quota", "team-a", min={TPU: 64}, max={TPU: 64}))
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "b-quota", "team-b", min={TPU: 64}, max={TPU: 64}))
+        resident = slice_gang(c, "guarded", namespace="team-a", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in resident],
+                                         timeout=30)
+        intruder = slice_gang(c, "vip", namespace="team-b", priority=10000)
+        assert c.wait_for_pods_unscheduled([p.key for p in intruder],
+                                           hold=3.0)
+        assert all(c.pod(p.key) is not None for p in resident)
+
+
+def test_borrow_eviction_capped_at_overage():
+    """The window's foreign victims may only consume the victim team's
+    overage (usage - min): a window whose eviction would push the team
+    below min is ineligible."""
+    from tpusched.testing import make_elastic_quota
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c, dims=(4, 4, 8))   # 128 chips, two 4x4x4 windows
+        # team-a min 96: two 64-chip slices = 128 used, overage only 32 —
+        # NO 64-chip window is evictable without breaking a's min
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "a-quota", "team-a", min={TPU: 96}, max={TPU: 128}))
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "b-quota", "team-b", min={TPU: 64}, max={TPU: 128}))
+        s1 = slice_gang(c, "a-one", namespace="team-a", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=30)
+        s2 = slice_gang(c, "a-two", namespace="team-a", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in s2], timeout=30)
+        b = slice_gang(c, "b-wants", namespace="team-b", priority=10)
+        assert c.wait_for_pods_unscheduled([p.key for p in b], hold=3.0)
+        assert all(c.pod(p.key) is not None for p in s1 + s2)
+
+
+def test_cordoned_window_host_vetoes_eviction():
+    """If a window host would still fail other filters after eviction (here:
+    cordoned), the window must not be evicted — destroying a resident
+    workload cannot help the gang (post-eviction dry-run, the analog of
+    upstream preemption's filter re-check)."""
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        node_name = c.pod(low[0].key).spec.node_name
+        node = next(n for n in c.api.list(srv.NODES)
+                    if n.meta.name == node_name)
+        c.api.patch(srv.NODES, node.meta.key,
+                    lambda n: setattr(n.spec, "unschedulable", True))
+        high = slice_gang(c, "high", priority=1000)
+        assert c.wait_for_pods_unscheduled([p.key for p in high], hold=3.0)
+        assert all(c.pod(p.key) is not None for p in low)  # untouched

@@ -65,6 +65,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 CANNED_PROFILES = {
     "tpu-gang": canned.tpu_gang_profile,
+    "full": canned.full_stack_profile,
     "capacity": canned.capacity_profile,
     "tpuslice": canned.tpuslice_profile,
     "load-aware": canned.load_aware_profile,

@@ -34,6 +34,28 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
     )
 
 
+def full_stack_profile(permit_wait_s: int = 60, denied_s: int = 20,
+                       scheduler_name: str = "tpusched") -> PluginProfile:
+    """Everything composed: gang admission under team ElasticQuotas with
+    quota-aware preemption, ICI-torus slice fitting, chip placement, and
+    DCN-aware multi-slice scoring — the production wiring a multi-team TPU
+    fleet runs (the reference composes its plugins the same way: all are
+    framework plugins in one scheduler, SURVEY §1)."""
+    from .types import TopologyMatchArgs
+    prof = tpu_gang_profile(permit_wait_s=permit_wait_s, denied_s=denied_s,
+                            scheduler_name=scheduler_name)
+    prof.pre_filter = prof.pre_filter + ["CapacityScheduling"]
+    # TopologyMatch's slice preemption first: window-wise eviction for
+    # slice-shaped gangs (single-node preemption cannot free a torus block);
+    # CapacityScheduling's evaluator handles the non-slice pods after it
+    prof.post_filter = (["TopologyMatch"] + prof.post_filter
+                        + ["CapacityScheduling"])
+    prof.reserve = prof.reserve + ["CapacityScheduling"]
+    prof.plugin_args["TopologyMatch"] = TopologyMatchArgs(
+        enable_slice_preemption=True)
+    return prof
+
+
 def capacity_profile(scheduler_name: str = "tpusched") -> PluginProfile:
     """ElasticQuota capacity sharing + quota-aware preemption over TPU
     placement (mirrors manifests/capacityscheduling/scheduler-config wiring:

@@ -56,6 +56,15 @@ class TopologyMatchArgs:
     # strategy score over the pool zone. 0.7 keeps packing dominant; 0.0
     # reproduces the reference's pure-strategy zone scoring.
     packing_weight: float = 0.7
+    # slice preemption (PostFilter): when a slice-shaped gang has no feasible
+    # placement, evict the cheapest eligible victim WINDOW (whole torus
+    # block) — single-node preemption can never free a contiguous slice.
+    # Off by default; the full-stack profile enables it.
+    enable_slice_preemption: bool = False
+    # one eviction burst per gang within this window — must outlast victim
+    # graceful termination (k8s default 30s) or a sibling's failure mid-drain
+    # evicts a second window
+    slice_preemption_drain_seconds: float = 60.0
 
     def validate(self) -> None:
         if not 0.0 <= self.packing_weight <= 1.0:
@@ -65,6 +74,8 @@ class TopologyMatchArgs:
                                          "BalancedAllocation"):
             raise ValueError(
                 f"unknown scoringStrategy {self.scoring_strategy!r}")
+        if self.slice_preemption_drain_seconds <= 0:
+            raise ValueError("slicePreemptionDrainSeconds must be positive")
 
 
 @dataclass

@@ -35,7 +35,8 @@ from ...config.types import TopologyMatchArgs
 from ...fwk import CycleState, Status
 from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
                                EVENT_DELETE, EVENT_UPDATE, FilterPlugin,
-                               NodeScore, ReservePlugin, ScorePlugin,
+                               NodeScore, PostFilterPlugin, PostFilterResult,
+                               ReservePlugin, ScorePlugin,
                                PreFilterPlugin, RESOURCE_NODE, RESOURCE_POD,
                                RESOURCE_POD_GROUP, RESOURCE_TPU_TOPOLOGY)
 from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
@@ -44,6 +45,7 @@ from ...topology.engine import (MaskGrid, PlacementSet,
                                 enumerate_placement_masks,
                                 feasible_membership)
 from ...topology.torus import HostGrid, validate_slice_shape
+from ...sched.preemption import filter_pods_with_pdb_violation
 from ...util import klog
 from ..tpuslice.chip_node import pod_tpu_limits
 
@@ -64,8 +66,8 @@ class _CycleStash:
         return self  # read-only after PreFilter
 
 
-class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
-                    EnqueueExtensions):
+class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
+                    ScorePlugin, ReservePlugin, EnqueueExtensions):
     NAME = "TopologyMatch"
 
     def __init__(self, args: Optional[TopologyMatchArgs], handle):
@@ -77,6 +79,10 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
         self._grid_cache: Dict[Tuple[str, int], Tuple[HostGrid, MaskGrid]] = {}
         self._placement_cache: Dict[Tuple[str, int, Tuple[int, ...]],
                                     PlacementSet] = {}
+        # one eviction burst per gang while victims drain (add-if-absent:
+        # sibling failures during the drain must not evict a second window)
+        from ...util.ttlcache import TTLCache
+        self._recent_evictions = TTLCache(5.0)
         # warm the native engine at construction — its first load may compile
         # the C++ source, which must not stall a scheduling cycle
         native.load()
@@ -263,6 +269,265 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin,
             return Status.unschedulable(
                 "node is not part of any feasible slice placement")
         return Status.success()
+
+    # -- PostFilter: slice preemption -----------------------------------------
+    #
+    # Single-node preemption (the upstream Evaluator the capacity plugin
+    # drives) can never help a slice-shaped gang: freeing ONE node does not
+    # free a contiguous torus window. This preempts window-wise — pick the
+    # cheapest placement whose resident foreign pods are ALL eligible
+    # victims, evict them, and let the gang's retry (pod-delete events
+    # requeue it) find the freed window. No reference analog: the reference
+    # ships cross-node preemption disabled and its NRT plugin has no
+    # preemption at all; this is the TPU-native composition of the two.
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> Tuple[Optional[PostFilterResult], Status]:
+        if not self.args.enable_slice_preemption:
+            return None, Status.unschedulable("slice preemption disabled")
+        req = self._slice_request(pod)
+        if req is None or req == "invalid":
+            return None, Status.unschedulable("not a slice-shaped pod")
+        pg, shape, want_acc = req
+        full = f"{pod.namespace}/{pg.meta.name}"
+        if full in self._recent_evictions:
+            # drain window: report progress (PostFilter success semantics)
+            # so Coscheduling's mass-reject doesn't deny the gang while the
+            # victims it is waiting for terminate
+            return PostFilterResult(), Status.success()
+
+        snapshot = self.handle.snapshot_shared_lister()
+        cs = self.handle.clientset
+        pdbs = cs.pdbs.list()
+        pcs = {pc.meta.name: pc for pc in cs.priorityclasses.list()}
+        usage, quotas = self._namespace_tpu_usage(snapshot)
+        gang_chips = 1
+        for d in shape:
+            gang_chips *= d
+        # preemptor-side quota gate, invariant across windows: cross-quota
+        # eviction is allowed only while the gang reclaims its own
+        # guaranteed min (assumed siblings already inside the usage sum)
+        peq = quotas.get(pod.namespace)
+        if peq is None:
+            preemptor_within_min = True  # no quota governs the preemptor
+        else:
+            after = (usage.get(pod.namespace, 0)
+                     - self._assumed_gang_chips(pod, snapshot) + gang_chips)
+            preemptor_within_min = after <= peq.spec.min.get(TPU, 0)
+
+        # candidate pools with the SAME one-torus pinning rule as PreFilter:
+        # once a sibling is assigned in a pool, windows elsewhere are useless
+        candidates = []
+        for topo in self.topo_informer.items():
+            spec = topo.spec
+            if want_acc and spec.accelerator != want_acc:
+                continue
+            acc = ACCELERATORS.get(spec.accelerator)
+            if acc is None or validate_slice_shape(shape, acc,
+                                                   tuple(spec.dims)):
+                continue
+            grids = self._grid(topo)
+            if grids is None:
+                continue
+            assigned, _, _, _ = self._occupancy(
+                grids[0], snapshot, pg.meta.name, pod.namespace,
+                acc.chips_per_host)
+            candidates.append((topo, grids, assigned))
+        pinned = [c for c in candidates if c[2]]
+        if pinned:
+            candidates = pinned
+
+        best = None  # (rank key, victims)
+        for topo, (grid, mgrid), assigned in candidates:
+            assigned_mask = mgrid.mask_of(assigned)
+            for mask in self._placements(topo, mgrid, shape).masks:
+                if assigned_mask and (mask & assigned_mask) != assigned_mask:
+                    continue  # must contain already-placed siblings
+                victims = self._window_victims(grid, mgrid, mask, snapshot,
+                                               pg.meta.name, pod.namespace)
+                if not victims:
+                    # victimless window: TopologyMatch found it feasible, so
+                    # this pod's failure came from ANOTHER plugin (cordon,
+                    # cpu pressure) — evicting elsewhere would not help it,
+                    # but other windows may still be worth ranking
+                    continue
+                if not self._window_viable_after_eviction(
+                        pod, grid, mgrid, mask, snapshot, victims):
+                    continue  # eviction would not make the hosts usable
+                partial_gangs = self._window_eligible(
+                    victims, pod, pcs, usage, quotas, preemptor_within_min,
+                    snapshot)
+                if partial_gangs is None:
+                    continue
+                violating, _ = filter_pods_with_pdb_violation(victims, pdbs)
+                # rank: fewest PDB violations → fewest gangs split by the
+                # window → fewest victims → lowest total priority → NEWEST
+                # victims (upstream MoreImportantPod: earlier start = more
+                # important) → mask for full determinism
+                key = (len(violating), partial_gangs, len(victims),
+                       sum(v.priority for v in victims),
+                       -sum(v.meta.creation_timestamp for v in victims),
+                       mask)
+                if best is None or key < best[0]:
+                    best = (key, victims)
+
+        if best is None:
+            return None, Status.unschedulable(
+                "no slice window has an evictable victim set")
+        (violations, _, n, _, _, _), victims = best
+        if violations:
+            klog.warning_s("slice preemption violates PDBs",
+                           pod=pod.key, violations=violations)
+        self._recent_evictions.add(
+            full, ttl=self.args.slice_preemption_drain_seconds)
+        for v in victims:
+            if not self.handle.reject_waiting_pod(
+                    v.meta.uid, self.NAME, f"slice-preempted by {full}"):
+                cs.pods.delete(v.key)
+            cs.record_event(v.key, "Pod", "Normal", "Preempted",
+                            f"Slice-preempted by gang {full}")
+        klog.V(2).info_s("slice preemption evicted a window",
+                         podGroup=full, victims=n)
+        # success (upstream PostFilter contract: preemption made progress,
+        # no nominated node — a gang has no single node): stops the chain,
+        # so the gang is NOT mass-denied; victim deletions requeue it
+        return PostFilterResult(), Status.success()
+
+    def _window_viable_after_eviction(self, pod: Pod, grid, mgrid, mask,
+                                      snapshot, victims) -> bool:
+        """Dry-run the stateless node filters (cordon, taints, resource fit)
+        on every window host with the victims removed — upstream preemption
+        re-runs filters over the post-eviction state the same way
+        (capacity_scheduling.go:581); evicting a window whose hosts still
+        fail other plugins would destroy workloads for zero progress."""
+        from ..defaults import (NodeResourcesFit, NodeUnschedulable,
+                                TaintToleration)
+        gone = {id(v) for v in victims}
+        checks = (NodeUnschedulable(), TaintToleration(), NodeResourcesFit())
+        state = CycleState()
+        for coord in mgrid.coords_of(mask):
+            info = snapshot.get(grid.node_of.get(coord))
+            if info is None:
+                return False
+            stripped = NodeInfo(info.node,
+                                [p for p in info.pods if id(p) not in gone])
+            for chk in checks:
+                if not chk.filter(state, pod, stripped).is_success():
+                    return False
+        return True
+
+    def _namespace_tpu_usage(self, snapshot):
+        """(namespace → whole chips used, namespace → ElasticQuota) — the
+        borrowing-rule inputs (capacity_scheduling.go:526-553 semantics,
+        window-wise). Counts whole-chip pods only: fractional tpu-memory
+        pods are governed by the priority rule, not chip borrowing (their
+        occupancy is sub-chip and quota min/max here are chip counts)."""
+        usage: Dict[str, int] = {}
+        for info in snapshot.list():
+            for p in info.pods:
+                chips, chips_set, _, _ = pod_tpu_limits(p)
+                if chips_set:
+                    usage[p.meta.namespace] = \
+                        usage.get(p.meta.namespace, 0) + chips
+        quotas = {eq.meta.namespace: eq
+                  for eq in self.handle.clientset.elasticquotas.list()}
+        return usage, quotas
+
+    def _window_victims(self, grid, mgrid, mask, snapshot, pg_name,
+                        namespace):
+        """Foreign TPU pods resident on the window's hosts, or None when a
+        host is missing from the snapshot (stale CR)."""
+        victims: List[Pod] = []
+        for coord in mgrid.coords_of(mask):
+            node = grid.node_of.get(coord)
+            info = snapshot.get(node) if node else None
+            if info is None:
+                return None
+            for p in info.pods:
+                chips, chips_set, mem, mem_set = pod_tpu_limits(p)
+                if not chips_set and not mem_set:
+                    continue  # non-TPU pods don't block chips
+                if (p.meta.labels.get(POD_GROUP_LABEL) == pg_name
+                        and p.meta.namespace == namespace):
+                    continue  # own sibling
+                victims.append(p)
+        return victims
+
+    def _window_eligible(self, victims, preemptor: Pod, pcs, usage, quotas,
+                         preemptor_within_min: bool,
+                         snapshot) -> Optional[int]:
+        """Window-wise eligibility — returns the number of running gangs the
+        window would SPLIT (a ranking penalty), or None if any victim is
+        ineligible. The composition contract with CapacityScheduling's
+        borrowing rules (capacity_scheduling.go:526-553) and
+        PreemptionToleration's policy annotations:
+
+        - same-namespace victims: priority rule (victim < preemptor);
+        - foreign victims under NO quota: priority rule;
+        - foreign victims under a quota: evictable only while the preemptor
+          reclaims its own guaranteed min (within-min after accounting for
+          its already-assumed siblings), and only up to the victim team's
+          overage (usage - min): another team's min is never broken, not
+          even by priority;
+        - toleration-exempt victims veto the window outright.
+        """
+        from ..preemptiontoleration import exempted_from_preemption
+        pns = preemptor.namespace
+        foreign_chips: Dict[str, int] = {}
+        for v in victims:
+            if exempted_from_preemption(v, preemptor,
+                                        lambda name: pcs.get(name)):
+                return None
+            chips, chips_set, _, _ = pod_tpu_limits(v)
+            if v.meta.namespace == pns or quotas.get(v.meta.namespace) is None:
+                if not v.priority < preemptor.priority:
+                    return None
+                continue
+            # foreign, quota-governed
+            if not preemptor_within_min:
+                return None
+            if not chips_set:
+                # fractional pod: chip borrowing doesn't govern it
+                if not v.priority < preemptor.priority:
+                    return None
+                continue
+            foreign_chips[v.meta.namespace] = \
+                foreign_chips.get(v.meta.namespace, 0) + chips
+        for ns, evicted in foreign_chips.items():
+            overage = usage.get(ns, 0) - quotas[ns].spec.min.get(TPU, 0)
+            if evicted > overage:
+                return None  # would break the team's guaranteed min
+
+        # ranking penalty: gangs only partially contained in the window
+        # (evicting half a gang leaves it running below min_member)
+        by_gang: Dict[Tuple[str, str], int] = {}
+        for v in victims:
+            g = v.meta.labels.get(POD_GROUP_LABEL)
+            if g:
+                k = (v.meta.namespace, g)
+                by_gang[k] = by_gang.get(k, 0) + 1
+        partial = 0
+        for (ns, g), n in by_gang.items():
+            if n < snapshot.assigned_count(g, ns):
+                partial += 1
+        return partial
+
+    def _assumed_gang_chips(self, pod: Pod, snapshot) -> int:
+        """Whole chips already held by this gang's assumed/bound siblings —
+        they are inside the namespace usage sum and must not be counted a
+        second time through gang_chips."""
+        name = pod_group_label(pod)
+        if not name:
+            return 0
+        total = 0
+        for info in snapshot.list():
+            for p in info.pods:
+                if (p.meta.namespace == pod.namespace
+                        and p.meta.labels.get(POD_GROUP_LABEL) == name):
+                    chips, chips_set, _, _ = pod_tpu_limits(p)
+                    if chips_set:
+                        total += chips
+        return total
 
     # -- Score ----------------------------------------------------------------
 
