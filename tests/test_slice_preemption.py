@@ -171,3 +171,30 @@ def test_cordoned_window_host_vetoes_eviction():
         high = slice_gang(c, "high", priority=1000)
         assert c.wait_for_pods_unscheduled([p.key for p in high], hold=3.0)
         assert all(c.pod(p.key) is not None for p in low)  # untouched
+
+
+def test_fractional_serving_victims_fall_under_priority_rule():
+    """Mixed fleet: low-priority fractional (tpu-memory) serving pods inside
+    the only window are evicted by a higher-priority training slice via the
+    priority rule (chip borrowing never governs sub-chip pods); raise their
+    priority and the window is vetoed."""
+    from tpusched.api.resources import TPU_MEMORY
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        serving = [make_pod(f"serve-{i}", limits={TPU_MEMORY: 1024},
+                            priority=10) for i in range(4)]
+        c.create_pods(serving)
+        assert c.wait_for_pods_scheduled([p.key for p in serving], timeout=20)
+        train = slice_gang(c, "train", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in train], timeout=30)
+        assert all(c.pod(p.key) is None for p in serving)  # evicted
+
+    with cluster(permit_wait_s=3) as c2:
+        add_pool(c2)
+        vip = [make_pod(f"vip-{i}", limits={TPU_MEMORY: 1024},
+                        priority=5000) for i in range(4)]
+        c2.create_pods(vip)
+        assert c2.wait_for_pods_scheduled([p.key for p in vip], timeout=20)
+        train = slice_gang(c2, "train", priority=1000)
+        assert c2.wait_for_pods_unscheduled([p.key for p in train], hold=3.0)
+        assert all(c2.pod(p.key) is not None for p in vip)
