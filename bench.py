@@ -126,6 +126,54 @@ def bench_quota() -> None:
          round(v, 4), "s", round(NORTH_STAR_S / v, 2))
 
 
+def run_slice_reclaim_once() -> float:
+    """Slice preemption (KEP-119 addendum): team-b's slice gang reclaims its
+    quota min by evicting team-a's borrowed slice WINDOW — submit-to-bound
+    including window selection, eviction, drain, and re-admission."""
+    from tpusched.api.resources import TPU
+    from tpusched.apiserver import server as srv
+    from tpusched.config.profiles import full_stack_profile
+    from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                                  make_pod_group, make_tpu_pool)
+
+    with TestCluster(profile=full_stack_profile(permit_wait_s=20,
+                                                denied_s=1)) as c:
+        topo, nodes = make_tpu_pool("pool", dims=(4, 4, 8))  # 128 chips
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        for team in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 64}, max={TPU: 128}))
+
+        def slice_gang(team, name):
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, namespace=team, min_member=16,
+                tpu_slice_shape="4x4x4", tpu_accelerator="tpu-v5p"))
+            ps = [make_pod(f"{name}-{i}", namespace=team, pod_group=name,
+                           limits={TPU: 4}) for i in range(16)]
+            c.create_pods(ps)
+            return ps
+
+        for name in ("a-first", "a-borrow"):
+            ps = slice_gang("team-a", name)
+            if not c.wait_for_pods_scheduled([p.key for p in ps], timeout=30):
+                raise RuntimeError(f"fill gang {name} did not schedule")
+        b = slice_gang("team-b", "b-reclaim")
+        start = time.perf_counter()
+        if not c.wait_for_pods_scheduled([p.key for p in b], timeout=60):
+            raise RuntimeError("slice reclaim did not complete")
+        return time.perf_counter() - start
+
+
+def bench_slice_reclaim() -> None:
+    run_slice_reclaim_once()
+    times = [run_slice_reclaim_once() for _ in range(5)]
+    v = p99(times)
+    emit("slice-preemption reclaim p99: 64-chip slice gang evicts a borrowed "
+         "4x4x4 window and binds (full-stack profile, v5p-128, n=5)",
+         round(v, 4), "s", round(NORTH_STAR_S / v, 2))
+
+
 def run_multislice_once() -> float:
     """BASELINE eval #5: 4 x v5p-64 slices of one multislice set over DCN."""
     from tpusched.api.resources import TPU
@@ -306,8 +354,8 @@ def bench_tpu_workload() -> None:
 
 
 def main() -> None:
-    for bench in (bench_quota, bench_multislice, bench_scale,
-                  bench_tpu_workload):
+    for bench in (bench_quota, bench_slice_reclaim, bench_multislice,
+                  bench_scale, bench_tpu_workload):
         try:
             bench()
         except Exception as e:  # keep the headline line alive no matter what
