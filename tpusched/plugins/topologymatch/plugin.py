@@ -24,6 +24,8 @@ Mechanics per scheduling cycle:
 """
 from __future__ import annotations
 
+import math
+
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from ...api.core import Pod
@@ -47,12 +49,20 @@ from ...topology.engine import (MaskGrid, PlacementSet,
 from ...topology.torus import HostGrid, validate_slice_shape
 from ...sched.preemption import filter_pods_with_pdb_violation
 from ...util import klog
+from ...util.ttlcache import TTLCache
+from ..defaults import (NodeResourcesFit, NodeUnschedulable,
+                        TaintToleration)
+from ..preemptiontoleration import exempted_from_preemption
 from ..tpuslice.chip_node import pod_tpu_limits
 
 COORD_ANNOTATION = TOPOLOGY_GROUP + "/coord"
 POOL_ANNOTATION = TOPOLOGY_GROUP + "/pool"
 
 _STATE_KEY = "TopologyMatch/state"
+
+# stateless node filters used by the slice-preemption dry-run
+_VIABILITY_CHECKS = (NodeUnschedulable(), TaintToleration(),
+                     NodeResourcesFit())
 
 
 class _CycleStash:
@@ -81,8 +91,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                                     PlacementSet] = {}
         # one eviction burst per gang while victims drain (add-if-absent:
         # sibling failures during the drain must not evict a second window)
-        from ...util.ttlcache import TTLCache
-        self._recent_evictions = TTLCache(5.0)
+        self._recent_evictions = TTLCache(
+            self.args.slice_preemption_drain_seconds)
         # warm the native engine at construction — its first load may compile
         # the C++ source, which must not stall a scheduling cycle
         native.load()
@@ -115,6 +125,26 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             return "invalid"
         return pg, shape, pg.spec.tpu_accelerator
 
+    def _matching_pools(self, shape, want_acc):
+        """Pools whose accelerator matches and whose torus could hold the
+        shape: yields (topo, acc, grids, validation_error) — error is a
+        string when the shape can never fit that pool, None otherwise."""
+        for topo in self.topo_informer.items():
+            spec = topo.spec
+            if want_acc and spec.accelerator != want_acc:
+                continue
+            acc = ACCELERATORS.get(spec.accelerator)
+            if acc is None:
+                continue
+            err = validate_slice_shape(shape, acc, tuple(spec.dims))
+            if err:
+                yield topo, acc, None, err
+                continue
+            grids = self._grid(topo)
+            if grids is None:
+                continue
+            yield topo, acc, grids, None
+
     # -- PreFilter ------------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
@@ -134,24 +164,14 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
 
         candidates = []
         any_valid_pool = False
-        for topo in self.topo_informer.items():
-            spec = topo.spec
-            if want_acc and spec.accelerator != want_acc:
-                continue
-            acc = ACCELERATORS.get(spec.accelerator)
-            if acc is None:
-                continue
+        for topo, acc, grids, err in self._matching_pools(shape, want_acc):
             any_pool = True
-            err = validate_slice_shape(shape, acc, tuple(spec.dims))
             if err:
-                validation_errors.append(f"pool {spec.pool}: {err}")
-                continue
-            grids = self._grid(topo)
-            if grids is None:
+                validation_errors.append(f"pool {topo.spec.pool}: {err}")
                 continue
             any_valid_pool = True
-            grid, _ = grids
-            occ = self._occupancy(grid, snapshot, pg.meta.name, pod.namespace,
+            occ = self._occupancy(grids[0], snapshot, pg.meta.name,
+                                  pod.namespace,
                                   chips_needed if chips_needed is not None
                                   else acc.chips_per_host)
             candidates.append((topo, acc, grids, occ))
@@ -301,9 +321,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         pdbs = cs.pdbs.list()
         pcs = {pc.meta.name: pc for pc in cs.priorityclasses.list()}
         usage, quotas = self._namespace_tpu_usage(snapshot)
-        gang_chips = 1
-        for d in shape:
-            gang_chips *= d
+        gang_chips = math.prod(shape)
         # preemptor-side quota gate, invariant across windows: cross-quota
         # eviction is allowed only while the gang reclaims its own
         # guaranteed min (assumed siblings already inside the usage sum)
@@ -318,16 +336,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # candidate pools with the SAME one-torus pinning rule as PreFilter:
         # once a sibling is assigned in a pool, windows elsewhere are useless
         candidates = []
-        for topo in self.topo_informer.items():
-            spec = topo.spec
-            if want_acc and spec.accelerator != want_acc:
-                continue
-            acc = ACCELERATORS.get(spec.accelerator)
-            if acc is None or validate_slice_shape(shape, acc,
-                                                   tuple(spec.dims)):
-                continue
-            grids = self._grid(topo)
-            if grids is None:
+        for topo, acc, grids, err in self._matching_pools(shape, want_acc):
+            if err:
                 continue
             assigned, _, _, _ = self._occupancy(
                 grids[0], snapshot, pg.meta.name, pod.namespace,
@@ -378,8 +388,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if violations:
             klog.warning_s("slice preemption violates PDBs",
                            pod=pod.key, violations=violations)
-        self._recent_evictions.add(
-            full, ttl=self.args.slice_preemption_drain_seconds)
+        self._recent_evictions.add(full)
         for v in victims:
             if not self.handle.reject_waiting_pod(
                     v.meta.uid, self.NAME, f"slice-preempted by {full}"):
@@ -400,10 +409,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         re-runs filters over the post-eviction state the same way
         (capacity_scheduling.go:581); evicting a window whose hosts still
         fail other plugins would destroy workloads for zero progress."""
-        from ..defaults import (NodeResourcesFit, NodeUnschedulable,
-                                TaintToleration)
         gone = {id(v) for v in victims}
-        checks = (NodeUnschedulable(), TaintToleration(), NodeResourcesFit())
         state = CycleState()
         for coord in mgrid.coords_of(mask):
             info = snapshot.get(grid.node_of.get(coord))
@@ -411,7 +417,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 return False
             stripped = NodeInfo(info.node,
                                 [p for p in info.pods if id(p) not in gone])
-            for chk in checks:
+            for chk in _VIABILITY_CHECKS:
                 if not chk.filter(state, pod, stripped).is_success():
                     return False
         return True
@@ -471,7 +477,6 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
           even by priority;
         - toleration-exempt victims veto the window outright.
         """
-        from ..preemptiontoleration import exempted_from_preemption
         pns = preemptor.namespace
         foreign_chips: Dict[str, int] = {}
         for v in victims:
@@ -515,7 +520,10 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
     def _assumed_gang_chips(self, pod: Pod, snapshot) -> int:
         """Whole chips already held by this gang's assumed/bound siblings —
         they are inside the namespace usage sum and must not be counted a
-        second time through gang_chips."""
+        second time through gang_chips. Walks the SNAPSHOT (not the
+        informer): siblings parked at Permit are assumed — node-assigned in
+        the scheduler cache only, invisible as bound in the API. Runs once
+        per post_filter call (cold failure path)."""
         name = pod_group_label(pod)
         if not name:
             return 0
