@@ -5,7 +5,8 @@ from tpusched.fwk.interfaces import (ClusterEvent, EVENT_ADD, RESOURCE_NODE,
                                      RESOURCE_POD_GROUP)
 from tpusched.sched.cache import Cache
 from tpusched.sched.queue import QueuedPodInfo, SchedulingQueue
-from tpusched.testing import make_node, make_pod
+from tpusched.api.resources import TPU
+from tpusched.testing import make_node, make_pod, make_tpu_node
 
 
 def prio_less(a, b):
@@ -221,3 +222,126 @@ def test_update_refreshes_pod_in_place():
     q.update(updated)
     got = q.pop(timeout=0.5)
     assert got.pod.meta.labels.get("v") == "2"
+
+
+def make_queue():
+    """(queue, mutable clock) with a controllable time source."""
+    clock = [1000.0]
+    q = SchedulingQueue(prio_less, clock=lambda: clock[0])
+    return q, clock
+
+
+def test_update_refreshes_pod_in_backoff_and_unschedulable():
+    """update() must refresh the stored copy wherever the pod sits —
+    backoffQ entries and unschedulableQ entries included."""
+    q, clock = make_queue()
+    p = make_pod("p")
+    info = QueuedPodInfo(p, clock=lambda: clock[0])
+    info.attempts = 1
+    q.requeue_after_failure(info, to_backoff=True)   # parked in backoff
+    p2 = make_pod("p", labels={"v": "2"})
+    q.update(p2)
+    clock[0] += 60                                   # backoff expired
+    got = q.pop(timeout=0.2)
+    assert got is not None and got.pod.meta.labels.get("v") == "2"
+
+    info2 = QueuedPodInfo(make_pod("u"), clock=lambda: clock[0])
+    q.requeue_after_failure(info2)                   # unschedulable
+    u2 = make_pod("u", labels={"v": "3"})
+    q.update(u2)
+    q.activate([u2])
+    got = q.pop(timeout=0.2)
+    assert got is not None and got.pod.meta.labels.get("v") == "3"
+
+
+def test_preemptor_requeues_straight_to_backoff():
+    """to_backoff=True (a pod that just won preemption): no cluster event is
+    coming — it must resurface from backoffQ by itself."""
+    q, clock = make_queue()
+    info = QueuedPodInfo(make_pod("winner"), clock=lambda: clock[0])
+    info.attempts = 1
+    q.requeue_after_failure(info, to_backoff=True)
+    assert q.pending_counts()["backoff"] == 1
+    assert q.pop(timeout=0.05) is None               # still backing off
+    clock[0] += 60
+    got = q.pop(timeout=0.2)
+    assert got is not None and got.pod.name == "winner"
+
+
+def test_close_unblocks_poppers():
+    import threading
+    q, clock = make_queue()
+    results = []
+    t = threading.Thread(target=lambda: results.append(q.pop(timeout=5)))
+    t.start()
+    q.close()
+    t.join(timeout=2)
+    assert not t.is_alive() and results == [None]
+
+
+def test_add_unschedulable_if_not_present_is_idempotent():
+    q, clock = make_queue()
+    p = make_pod("p")
+    q.add(p)  # active
+    info = QueuedPodInfo(p, clock=lambda: clock[0])
+    q.add_unschedulable_if_not_present(info)  # already active: no-op
+    assert q.pending_counts() == {"active": 1, "backoff": 0,
+                                  "unschedulable": 0}
+
+
+def test_cache_bind_confirmation_replaces_assumed():
+    """Watch-stream bound pod replaces the assumed copy (no double count)."""
+    from tpusched.sched.cache import Cache
+    cache = Cache()
+    cache.add_node(make_tpu_node("n1", chips=4))
+    p = make_pod("p", limits={TPU: 2})
+    cache.assume_pod(p, "n1")
+    assert cache.is_assumed("default/p")
+    bound = make_pod("p", limits={TPU: 2}, node_name="n1")
+    cache.add_pod(bound)                     # bind confirmation
+    assert not cache.is_assumed("default/p")
+    snap = cache.snapshot()
+    assert len(snap.get("n1").pods) == 1     # replaced, not duplicated
+    assert snap.get("n1").requested.get(TPU, 0) == 2
+
+
+def test_cache_forget_releases_assumed_resources():
+    from tpusched.sched.cache import Cache
+    cache = Cache()
+    cache.add_node(make_tpu_node("n1", chips=4))
+    p = make_pod("p", limits={TPU: 4})
+    cache.assume_pod(p, "n1")
+    assert cache.snapshot().get("n1").requested.get(TPU, 0) == 4
+    cache.forget_pod(p)
+    assert not cache.is_assumed("default/p")
+    assert cache.snapshot().get("n1").requested.get(TPU, 0) == 0
+
+
+def test_cache_assumed_never_expires_before_binding_finishes():
+    """The assume TTL arms only at finish_binding: a pod parked at a long
+    Permit barrier must not be expired out of the cache mid-wait."""
+    from tpusched.sched import cache as cache_mod
+    clock = [1000.0]
+    c = cache_mod.Cache(clock=lambda: clock[0])
+    c.add_node(make_tpu_node("n1", chips=4))
+    p = make_pod("p", limits={TPU: 1})
+    c.assume_pod(p, "n1")
+    clock[0] += 10 * cache_mod.ASSUME_EXPIRATION_S   # far past any TTL
+    assert c.is_assumed("default/p")
+    assert len(c.snapshot().get("n1").pods) == 1     # still held
+    c.finish_binding(p)
+    clock[0] += cache_mod.ASSUME_EXPIRATION_S + 1
+    c.snapshot()                                     # triggers cleanup
+    assert not c.is_assumed("default/p")
+
+
+def test_cache_remove_node_keeps_pod_accounting_consistent():
+    from tpusched.sched.cache import Cache
+    cache = Cache()
+    cache.add_node(make_tpu_node("n1", chips=4))
+    bound = make_pod("p", limits={TPU: 2}, node_name="n1")
+    cache.add_pod(bound)
+    cache.remove_node(make_tpu_node("n1", chips=4))
+    assert cache.snapshot().get("n1") is None
+    # pod deletion after its node vanished must not raise
+    cache.remove_pod(bound)
