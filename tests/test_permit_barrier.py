@@ -1,0 +1,136 @@
+"""Permit-barrier unit tables — the waitingPods map is the in-process gang
+barrier (SURVEY §5 'distributed comm backend': the framework's waitingPods
+map IS the barrier; upstream scheduler.go:524,557). This framework is our
+own code (the reference vendors upstream's), so its resolution semantics
+get direct tables: allow-all, first-rejection-wins, deadline expiry,
+exactly-once callbacks, and resolution races.
+"""
+import threading
+import time
+
+from tpusched.fwk import CycleState, PluginProfile, Status
+from tpusched.fwk.interfaces import PermitPlugin
+from tpusched.testing import make_pod, new_test_framework
+
+
+class FakePermit(PermitPlugin):
+    """Permit plugin returning a configurable wait per pod."""
+    NAME = "FakePermit"
+    timeout_s = 5.0
+
+    def __init__(self, args, handle):
+        pass
+
+    @classmethod
+    def new(cls, args, handle):
+        return cls(args, handle)
+
+    def permit(self, state, pod, node_name):
+        return Status.wait(), self.timeout_s
+
+
+def barrier_framework(timeout_s=5.0):
+    from tpusched.plugins import default_registry
+    FakePermit.timeout_s = timeout_s
+    registry = default_registry()
+    registry.register(FakePermit.NAME, FakePermit.new)
+    profile = PluginProfile(permit=[FakePermit.NAME],
+                            bind=["DefaultBinder"])
+    fw, handle, api = new_test_framework(profile, registry=registry)
+    return fw
+
+
+def park(fw, name):
+    pod = make_pod(name)
+    st = fw.run_permit_plugins(CycleState(), pod, "n1")
+    assert st.is_wait()
+    return pod
+
+
+def test_allow_from_every_plugin_resolves_success():
+    fw = barrier_framework()
+    pod = park(fw, "p")
+    wp = fw.get_waiting_pod(pod.meta.uid)
+    assert wp.get_pending_plugins() == [FakePermit.NAME]
+    wp.allow(FakePermit.NAME)
+    assert wp.wait().is_success()
+
+
+def test_first_rejection_wins_even_after_allow_race():
+    fw = barrier_framework()
+    pod = park(fw, "p")
+    wp = fw.get_waiting_pod(pod.meta.uid)
+    wp.reject(FakePermit.NAME, "lost the race")
+    wp.allow(FakePermit.NAME)  # late allow must not flip the verdict
+    st = wp.wait()
+    assert st.is_unschedulable() and "lost the race" in st.message()
+
+
+def test_deadline_expiry_rejects_with_timeout_message():
+    fw = barrier_framework(timeout_s=0.1)
+    pod = park(fw, "p")
+    wp = fw.get_waiting_pod(pod.meta.uid)
+    st = wp.wait()  # blocks until the 0.1s deadline
+    assert st.is_unschedulable() and "timeout" in st.message()
+
+
+def test_callbacks_fire_exactly_once_each():
+    fw = barrier_framework()
+    pod = park(fw, "p")
+    wp = fw.get_waiting_pod(pod.meta.uid)
+    hits = []
+    wp.add_done_callback(lambda st: hits.append(("a", st.is_success())))
+    wp.add_done_callback(lambda st: hits.append(("b", st.is_success())))
+    wp.allow(FakePermit.NAME)
+    wp.allow(FakePermit.NAME)   # idempotent: no second firing
+    assert hits == [("a", True), ("b", True)]
+    # post-resolution registration fires immediately, once
+    wp.add_done_callback(lambda st: hits.append(("late", st.is_success())))
+    assert hits[-1] == ("late", True)
+
+
+def test_notify_on_permit_removes_entry_before_callback():
+    fw = barrier_framework()
+    pod = park(fw, "p")
+    seen = []
+
+    def cb(st):
+        # by callback time the pod has left the waiting map — a retry of the
+        # same pod must be able to park again without colliding
+        seen.append((st.is_success(), fw.get_waiting_pod(pod.meta.uid)))
+    fw.notify_on_permit(pod, cb)
+    fw.get_waiting_pod(pod.meta.uid).allow(FakePermit.NAME)
+    deadline = time.monotonic() + 2
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen == [(True, None)]
+
+
+def test_iterate_over_waiting_pods_sees_all_parked():
+    fw = barrier_framework()
+    pods = [park(fw, f"p{i}") for i in range(5)]
+    names = []
+    fw.iterate_over_waiting_pods(lambda wp: names.append(wp.pod.name))
+    assert sorted(names) == [f"p{i}" for i in range(5)]
+    # reject them all (the PostFilter mass-reject path)
+    fw.iterate_over_waiting_pods(lambda wp: wp.reject("t", "mass"))
+    for p in pods:
+        assert fw.get_waiting_pod(p.meta.uid).wait().is_unschedulable()
+
+
+def test_concurrent_allow_and_expiry_single_resolution():
+    """A deadline racing an allow must produce exactly one verdict and one
+    callback firing (no double resolution)."""
+    for _ in range(20):
+        fw = barrier_framework(timeout_s=0.02)
+        pod = park(fw, "p")
+        wp = fw.get_waiting_pod(pod.meta.uid)
+        hits = []
+        wp.add_done_callback(lambda st: hits.append(st.is_success()))
+        t = threading.Thread(target=lambda: wp.allow(FakePermit.NAME))
+        time.sleep(0.015)   # land near the deadline
+        t.start()
+        t.join()
+        wp.wait()
+        time.sleep(0.03)    # let a late sweeper expiry (if any) fire
+        assert len(hits) == 1, hits
