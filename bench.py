@@ -122,7 +122,9 @@ def bench_quota() -> None:
     times = [run_quota_once() for _ in range(5)]
     v = p99(times)
     emit("ElasticQuota reclaim-by-preemption p99, 16 pods/64 chips reclaimed "
-         "on contended v5p-128 (BASELINE eval #4, n=5)",
+         "on contended v5p-128 (BASELINE eval #4, n=5; floor is the "
+         "upstream-parity 1s post-preemption backoff, scheduler.go "
+         "podInitialBackoffSeconds default)",
          round(v, 4), "s", round(NORTH_STAR_S / v, 2))
 
 
