@@ -198,3 +198,17 @@ def test_fractional_serving_victims_fall_under_priority_rule():
         train = slice_gang(c2, "train", priority=1000)
         assert c2.wait_for_pods_unscheduled([p.key for p in train], hold=3.0)
         assert all(c2.pod(p.key) is not None for p in vip)
+
+
+def test_metrics_count_attempts_and_victims():
+    from tpusched.util.metrics import (preemption_attempts,
+                                       slice_preemption_victims)
+    a0, v0 = preemption_attempts.value(), slice_preemption_victims.value()
+    with cluster() as c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        high = slice_gang(c, "high", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in high], timeout=30)
+    assert preemption_attempts.value() == a0 + 1
+    assert slice_preemption_victims.value() == v0 + 16

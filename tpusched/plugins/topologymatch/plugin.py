@@ -49,6 +49,7 @@ from ...topology.engine import (MaskGrid, PlacementSet,
 from ...topology.torus import HostGrid, validate_slice_shape
 from ...sched.preemption import filter_pods_with_pdb_violation
 from ...util import klog
+from ...util.metrics import preemption_attempts, slice_preemption_victims
 from ...util.ttlcache import TTLCache
 from ..defaults import (NodeResourcesFit, NodeUnschedulable,
                         TaintToleration)
@@ -306,6 +307,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if not self.args.enable_slice_preemption:
             return None, Status.unschedulable("slice preemption disabled")
         req = self._slice_request(pod)
+
         if req is None or req == "invalid":
             return None, Status.unschedulable("not a slice-shaped pod")
         pg, shape, want_acc = req
@@ -395,6 +397,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 cs.pods.delete(v.key)
             cs.record_event(v.key, "Pod", "Normal", "Preempted",
                             f"Slice-preempted by gang {full}")
+        preemption_attempts.inc()
+        slice_preemption_victims.inc(n)
         klog.V(2).info_s("slice preemption evicted a window",
                          podGroup=full, victims=n)
         # success (upstream PostFilter contract: preemption made progress,

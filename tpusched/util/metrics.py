@@ -200,6 +200,9 @@ REGISTRY = Registry()
 
 preemption_attempts = REGISTRY.counter(
     "tpusched_preemption_attempts_total", "Preemption attempts (PostFilter).")
+slice_preemption_victims = REGISTRY.counter(
+    "tpusched_slice_preemption_victims_total",
+    "Pods evicted by slice (window-wise) preemption.")
 e2e_scheduling_seconds = REGISTRY.histogram(
     "tpusched_e2e_scheduling_duration_seconds", "Pop-to-bound per pod.")
 pod_group_to_bound_seconds = REGISTRY.histogram(
