@@ -212,3 +212,46 @@ def test_metrics_count_attempts_and_victims():
         assert c.wait_for_pods_scheduled([p.key for p in high], timeout=30)
     assert preemption_attempts.value() == a0 + 1
     assert slice_preemption_victims.value() == v0 + 16
+
+
+def test_pdb_protected_window_is_last_resort():
+    """PDBs are soft, upstream-parity: a window whose victims violate a PDB
+    ranks behind a violation-free window, but IS evicted (with the warning)
+    when it is the only option."""
+    from tpusched.api.core import PodDisruptionBudget
+    from tpusched.api.meta import ObjectMeta
+    # two windows: 'guarded' (PDB, no disruptions left) and 'plain'
+    with cluster() as c:
+        add_pool(c, dims=(4, 4, 8))
+        c.api.create(srv.PDBS, PodDisruptionBudget(
+            meta=ObjectMeta(name="guard", namespace="default"),
+            selector={"app": "guarded"}, disruptions_allowed=0))
+        guarded = slice_gang(c, "guarded", priority=10)
+        for p in guarded:
+            c.api.patch(srv.PODS, p.key,
+                        lambda live: live.meta.labels.__setitem__(
+                            "app", "guarded"))
+        assert c.wait_for_pods_scheduled([p.key for p in guarded], timeout=30)
+        plain = slice_gang(c, "plain", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in plain], timeout=30)
+        big = slice_gang(c, "big", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in big], timeout=30)
+        # the violation-free window was chosen
+        assert all(c.pod(p.key) is None for p in plain)
+        assert all(c.pod(p.key) is not None for p in guarded)
+
+    # only option: the PDB-protected window is still evicted (soft PDBs)
+    with cluster() as c2:
+        add_pool(c2)
+        c2.api.create(srv.PDBS, PodDisruptionBudget(
+            meta=ObjectMeta(name="guard", namespace="default"),
+            selector={"app": "guarded"}, disruptions_allowed=0))
+        only = slice_gang(c2, "only", priority=10)
+        for p in only:
+            c2.api.patch(srv.PODS, p.key,
+                         lambda live: live.meta.labels.__setitem__(
+                             "app", "guarded"))
+        assert c2.wait_for_pods_scheduled([p.key for p in only], timeout=30)
+        big = slice_gang(c2, "big", priority=1000)
+        assert c2.wait_for_pods_scheduled([p.key for p in big], timeout=30)
+        assert all(c2.pod(p.key) is None for p in only)
