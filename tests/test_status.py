@@ -1,0 +1,56 @@
+"""Status semantics tables — the framework's per-node verdict type is shared
+and cached (success singleton, plugins may memoize failures), so its
+copy-on-write and merge rules are load-bearing (fwk/status.py; upstream
+framework.Status / PluginToStatus.Merge analogs).
+"""
+from tpusched.fwk.status import (ERROR, SUCCESS, UNSCHEDULABLE,
+                                 UNSCHEDULABLE_AND_UNRESOLVABLE, Status,
+                                 merge_statuses)
+
+
+def test_with_plugin_is_uniformly_copy_on_write():
+    """A shared/cached Status instance must never be mutated by attribution:
+    run_filter_plugins calls with_plugin per node (advisor round-1 finding:
+    only the success singleton was copy-on-write)."""
+    shared = Status.unschedulable("cached failure")
+    a = shared.with_plugin("PluginA")
+    b = shared.with_plugin("PluginB")
+    assert shared.plugin == ""          # untouched
+    assert (a.plugin, b.plugin) == ("PluginA", "PluginB")
+    assert a is not shared and b is not shared
+    # same-name attribution short-circuits without a copy
+    assert a.with_plugin("PluginA") is a
+
+
+def test_success_singleton_shared_and_safe():
+    s1, s2 = Status.success(), Status.success()
+    assert s1 is s2                      # the singleton
+    named = s1.with_plugin("X")
+    assert named is not s1 and Status.success().plugin == ""
+
+
+def test_merge_severity_order():
+    """error > unresolvable > unschedulable > success, reasons concatenated
+    (PluginToStatus.Merge)."""
+    merged = merge_statuses([
+        Status.unschedulable("u1").with_plugin("A"),
+        Status.unresolvable("hard").with_plugin("B"),
+        Status.unschedulable("u2").with_plugin("C"),
+    ])
+    assert merged.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+    assert merged.plugin == "B"
+    assert "u1" in merged.message() and "hard" in merged.message()
+
+    err = merge_statuses([Status.unresolvable("x"),
+                          Status.error("boom").with_plugin("E")])
+    assert err.code == ERROR and err.plugin == "E"
+
+    assert merge_statuses([Status.success(), Status.success()]).is_success()
+    assert merge_statuses([]).is_success()
+
+
+def test_merge_does_not_mutate_inputs():
+    u = Status.unschedulable("why")
+    before = list(u.reasons)
+    merge_statuses([u, Status.unschedulable("other")])
+    assert u.reasons == before
