@@ -255,3 +255,62 @@ def test_pdb_protected_window_is_last_resort():
         big = slice_gang(c2, "big", priority=1000)
         assert c2.wait_for_pods_scheduled([p.key for p in big], timeout=30)
         assert all(c2.pod(p.key) is None for p in only)
+
+
+def test_window_claims_guard_the_freed_window():
+    """The freed window is CLAIMED by the evictor (the nominatedNodeName
+    analog for gangs): another slice gang's PreFilter must not see the
+    claimed hosts as free, and plain TPU pods — whole-chip AND fractional —
+    are rejected there THROUGH THE FRAMEWORK DISPATCH (PreFilter Skip would
+    suppress our Filter; live claims must disable the skip), while the
+    claimant itself still places and non-TPU pods are untouched."""
+    from tpusched.api.resources import TPU, TPU_MEMORY
+    from tpusched.fwk import CycleState
+    from tpusched.testing import (make_pod, make_pod_group, make_tpu_pool,
+                                  new_test_framework)
+    from tpusched.config.profiles import full_stack_profile
+
+    topo, nodes = make_tpu_pool("pool", dims=(4, 4, 4))  # ONE 4x4x4 window
+    fw, handle, api = new_test_framework(full_stack_profile(), nodes=nodes)
+    api.create(srv.TPU_TOPOLOGIES, topo)
+    for name in ("claimant", "rival"):
+        api.create(srv.POD_GROUPS, make_pod_group(
+            name, min_member=16, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+    tm = fw.plugins["TopologyMatch"]
+
+    # simulate the eviction's claim: every host of the pool for 'claimant'
+    tm._window_claims.set("default/claimant",
+                          (topo.key, frozenset(n.meta.name for n in nodes)))
+
+    rival_pod = make_pod("r0", pod_group="rival", limits={TPU: 4})
+    st = tm.pre_filter(CycleState(), rival_pod)
+    assert st.is_unschedulable()          # claimed hosts are not free
+
+    mine = make_pod("c0", pod_group="claimant", limits={TPU: 4})
+    assert tm.pre_filter(CycleState(), mine).is_success()  # claimant exempt
+
+    ni = handle.snapshot_shared_lister().get(nodes[0].name)
+
+    def framework_filter_verdict(pod):
+        """The REAL dispatch: PreFilter (with skip bookkeeping) then Filter."""
+        state = CycleState()
+        st = fw.run_pre_filter_plugins(state, pod)
+        if not st.is_success():
+            return st
+        return fw.run_filter_plugins(state, pod, ni)
+
+    # whole-chip and fractional TPU pods are both rejected on claimed hosts
+    st = framework_filter_verdict(make_pod("plain", limits={TPU: 1}))
+    assert st.is_unschedulable() and "claimed" in st.message()
+    st = framework_filter_verdict(make_pod("frac",
+                                           limits={TPU_MEMORY: 1024}))
+    assert st.is_unschedulable() and "claimed" in st.message()
+    # non-TPU pod unaffected
+    assert framework_filter_verdict(make_pod("cpu-only")).is_success()
+
+    # claim expiry frees everything
+    tm._window_claims.delete("default/claimant")
+    assert tm.pre_filter(CycleState(), rival_pod).is_success()
+    assert framework_filter_verdict(make_pod("plain2",
+                                             limits={TPU: 1})).is_success()

@@ -94,6 +94,13 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # sibling failures during the drain must not evict a second window)
         self._recent_evictions = TTLCache(
             self.args.slice_preemption_drain_seconds)
+        # freed-window claims: gang full-name → (topo key, host mask). While
+        # a claim is live, OTHER gangs' PreFilter treats the window's hosts
+        # as unavailable — the nominatedNodeName analog for gangs (without
+        # it, the victim-delete events requeue every pending gang and an
+        # older equal-priority rival pops first and steals the window)
+        self._window_claims = TTLCache(
+            self.args.slice_preemption_drain_seconds)
         # warm the native engine at construction — its first load may compile
         # the C++ source, which must not stall a scheduling cycle
         native.load()
@@ -151,6 +158,13 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
         req = self._slice_request(pod)
         if req is None:
+            # Skip suppresses our Filter entirely (state.skip_filter_plugins)
+            # — but while freed-window claims are live, TPU-consuming pods
+            # must still pass through filter()'s claim guard, or a plain pod
+            # lands on a claimed host and re-breaks the claimant's window
+            chips, chips_set, mem, mem_set = pod_tpu_limits(pod)
+            if (chips_set or mem_set) and self._window_claims.items():
+                return Status.success()   # no stash: filter() guards claims only
             return Status.skip()
         if req == "invalid":
             return Status.unresolvable("invalid tpu_slice_shape on PodGroup")
@@ -184,12 +198,15 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if pinned:
             candidates = pinned
 
+        full = f"{pod.namespace}/{pg.meta.name}"
         for topo, acc, (grid, mgrid), (assigned, free, eligible,
                                        pool_util) in candidates:
             pset = self._placements(topo, mgrid, shape)
+            claimed = self._claimed_mask(mgrid, grid, topo.key, exclude=full)
             n_survivors, membership = feasible_membership(
-                pset, mgrid.mask_of(assigned), mgrid.mask_of(free),
-                mgrid.mask_of(eligible))
+                pset, mgrid.mask_of(assigned),
+                mgrid.mask_of(free) & ~claimed,
+                mgrid.mask_of(eligible) & ~claimed)
             if not n_survivors:
                 continue
             for node, count in membership.items():
@@ -285,11 +302,28 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         stash = state.try_read(_STATE_KEY)
         if stash is None:
-            return Status.success()  # PreFilter skipped (non-slice pod)
+            # PreFilter skipped (non-slice pod) — but a freed-window claim
+            # still guards its hosts: a plain TPU pod grabbing one host of
+            # a just-evicted window would re-break the claimant's placement
+            claims = self._window_claims.items()
+            _, chips_set, _, mem_set = pod_tpu_limits(pod)
+            if (claims and (chips_set or mem_set)
+                    and self._node_claimed(pod, node_info.node, claims)):
+                return Status.unschedulable(
+                    "host is claimed by an in-flight slice preemption")
+            return Status.success()
         if node_info.node.name not in stash.allowed:
             return Status.unschedulable(
                 "node is not part of any feasible slice placement")
         return Status.success()
+
+    def _node_claimed(self, pod: Pod, node, claims) -> bool:
+        """Is this node inside a live freed-window claim of any gang the pod
+        does not belong to? Claims hold node names — no grid needed."""
+        mine = pod_group_label(pod)
+        mine_full = f"{pod.namespace}/{mine}" if mine else None
+        return any(full != mine_full and node.name in names
+                   for full, (_, names) in claims)
 
     # -- PostFilter: slice preemption -----------------------------------------
     #
@@ -381,12 +415,15 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                        -sum(v.meta.creation_timestamp for v in victims),
                        mask)
                 if best is None or key < best[0]:
-                    best = (key, victims)
+                    window_nodes = frozenset(
+                        grid.node_of[c] for c in mgrid.coords_of(mask)
+                        if c in grid.node_of)
+                    best = (key, victims, topo.key, window_nodes)
 
         if best is None:
             return None, Status.unschedulable(
                 "no slice window has an evictable victim set")
-        (violations, _, n, _, _, _), victims = best
+        (violations, _, n, _, _, _), victims, best_topo_key, best_nodes = best
         if violations:
             klog.warning_s("slice preemption violates PDBs",
                            pod=pod.key, violations=violations)
@@ -397,6 +434,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 cs.pods.delete(v.key)
             cs.record_event(v.key, "Pod", "Normal", "Preempted",
                             f"Slice-preempted by gang {full}")
+        self._window_claims.set(full, (best_topo_key, best_nodes))
         preemption_attempts.inc()
         slice_preemption_victims.inc(n)
         klog.V(2).info_s("slice preemption evicted a window",
@@ -425,6 +463,20 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 if not chk.filter(state, pod, stripped).is_success():
                     return False
         return True
+
+    def _claimed_mask(self, mgrid, grid, topo_key: str, exclude: str) -> int:
+        """Mask of live window claims on this pool from OTHER gangs. Claims
+        store node NAMES: grid-independent, so a TpuTopology update during
+        the drain (new strides/dims) cannot misdirect the guard."""
+        m = 0
+        for full, (tk, names) in self._window_claims.items():
+            if full == exclude or tk != topo_key:
+                continue
+            for n in names:
+                coord = grid.coord_of.get(n)
+                if coord is not None:
+                    m |= 1 << mgrid.cell(coord)
+        return m
 
     def _namespace_tpu_usage(self, snapshot):
         """(namespace → whole chips used, namespace → ElasticQuota) — the

@@ -57,6 +57,13 @@ class TTLCache:
         with self._lock:
             self._items.pop(key, None)
 
+    def items(self):
+        """(key, value) pairs still fresh at call time."""
+        now = self._clock()
+        with self._lock:
+            return [(k, v) for k, (v, exp) in self._items.items()
+                    if exp >= now]
+
     def purge(self) -> None:
         now = self._clock()
         with self._lock:
