@@ -314,3 +314,35 @@ def test_window_claims_guard_the_freed_window():
     assert tm.pre_filter(CycleState(), rival_pod).is_success()
     assert framework_filter_verdict(make_pod("plain2",
                                              limits={TPU: 1})).is_success()
+
+
+def test_scheduler_restart_mid_drain_recovers_without_second_eviction():
+    """Claims are in-memory and die with the scheduler — by design (KEP-119):
+    after a restart the victims are already gone, so the claimant finds the
+    free window directly and no second eviction fires. Chaos shape: kill the
+    scheduler immediately after the eviction, restart on the surviving API
+    state."""
+    from tpusched.testing import wait_until
+    prof = full_stack_profile(permit_wait_s=15, denied_s=1)
+    c = TestCluster(profile=prof)
+    with c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        high = slice_gang(c, "high", priority=1000)
+        # wait for the eviction (victims deleted), then kill the scheduler
+        assert wait_until(
+            lambda: all(c.pod(p.key) is None for p in low), timeout=20)
+        api = c.api
+    # scheduler died mid-drain; control plane survived. Fresh scheduler:
+    evictions_before = len([e for e in api.events()
+                            if e.reason == "Preempted"])
+    with TestCluster(profile=full_stack_profile(permit_wait_s=15,
+                                                denied_s=1), api=api) as c2:
+        high_keys = [f"default/high-{i}" for i in range(16)]
+        assert c2.wait_for_pods_scheduled(high_keys, timeout=30)
+        hosts = {c2.pod(k).spec.node_name for k in high_keys}
+        assert len(hosts) == 16
+    evictions_after = len([e for e in api.events()
+                           if e.reason == "Preempted"])
+    assert evictions_after == evictions_before  # no second eviction
