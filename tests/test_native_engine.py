@@ -167,3 +167,22 @@ def test_fuzz_native_vs_python_vs_reference():
                 pset_native, mgrid.mask_of(assigned), mgrid.mask_of(free),
                 mgrid.mask_of(eligible))
             assert got == want, (acc.name, dims, wrap, shape)
+
+
+def test_enumeration_fleet_scale_budget():
+    """Placement enumeration at v5p-4096 scale (1024 hosts) stays far inside
+    the per-cycle Filter budget (SURVEY §7 hard part (c)); it is also cached
+    per CR resource_version, so this cost is paid once per topology change."""
+    import time
+    from tpusched.testing import make_tpu_pool
+    from tpusched.topology.torus import HostGrid
+    from tpusched.topology.engine import MaskGrid, enumerate_placement_masks
+
+    topo, nodes = make_tpu_pool("big", dims=(16, 16, 16))
+    assert len(nodes) == 1024
+    mgrid = MaskGrid(HostGrid.from_spec(topo.spec))
+    t0 = time.perf_counter()
+    ps = enumerate_placement_masks(mgrid, (4, 4, 4))
+    elapsed = time.perf_counter() - t0
+    assert len(ps) == 637           # pinned: count is geometry, not timing
+    assert elapsed < 0.25, f"enumeration took {elapsed:.3f}s at 1024 hosts"
