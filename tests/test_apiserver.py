@@ -191,3 +191,32 @@ def test_concurrent_writers_lose_no_events():
         assert evs[0] == srv.ADDED and evs[-1] == srv.DELETED, (key, evs[:3])
         assert all(e == srv.MODIFIED for e in evs[1:-1]), key
     assert api.list(srv.PODS) == []
+
+
+def test_clientset_token_bucket_budget():
+    """--qps/--burst budget (options.go:43-44 analog): burst drains free,
+    then calls pace at ~1/qps; qps=0 means unthrottled."""
+    import time as _t
+    from tpusched.apiserver.client import Clientset, _TokenBucket
+
+    b = _TokenBucket(qps=50.0, burst=5)
+    t0 = _t.perf_counter()
+    for _ in range(5):
+        b.wait()                       # burst: free
+    burst_t = _t.perf_counter() - t0
+    assert burst_t < 0.05
+    t0 = _t.perf_counter()
+    for _ in range(5):
+        b.wait()                       # paced at 50qps ⇒ ~100ms for 5
+    paced_t = _t.perf_counter() - t0
+    assert 0.05 <= paced_t < 1.0
+
+    # unthrottled clientset round-trip incl. the Bind subresource
+    api = srv.APIServer()
+    cs = Clientset(api)
+    from tpusched.testing import make_node
+    api.create(srv.NODES, make_node("n1"))
+    cs.pods.create(make_pod("p"))
+    from tpusched.api.core import Binding
+    cs.pods.bind(Binding(pod_key="default/p", node_name="n1"))
+    assert cs.pods.get("default/p").spec.node_name == "n1"
