@@ -200,3 +200,21 @@ def test_lightweight_gang_shares_synthesized_group_and_records_status():
         assert pg1 is pg2
         assert pg1.status.scheduled == 3
         assert pg1.status.phase == PG_SCHEDULED
+
+
+def test_gang_admitted_after_min_member_lowered():
+    """A pending 3-member gang with minMember=4 becomes schedulable when the
+    PodGroup is resized down — the PG UPDATE cluster event must requeue the
+    members (events_to_register: PodGroup add|update)."""
+    with TestCluster(profile=gang_profile()) as c:
+        c.add_nodes(v5e8_nodes())
+        c.api.create(srv.POD_GROUPS, make_pod_group("resizable", min_member=4))
+        pods = [make_pod(f"w{i}", pod_group="resizable", limits={TPU: 1})
+                for i in range(3)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_unscheduled([p.key for p in pods], hold=1.2)
+        c.api.patch(srv.POD_GROUPS, "default/resizable",
+                    lambda pg: setattr(pg.spec, "min_member", 3))
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=15)
+        got = c.api.get(srv.POD_GROUPS, "default/resizable")
+        assert got.status.scheduled == 3
