@@ -218,3 +218,32 @@ def test_gang_admitted_after_min_member_lowered():
         assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=15)
         got = c.api.get(srv.POD_GROUPS, "default/resizable")
         assert got.status.scheduled == 3
+
+
+def test_cordon_mid_admission_releases_chips_after_drain():
+    """Members park at Permit, the pool is cordoned mid-admission, the gang
+    is rejected and then deleted (operator drain): every assumed chip must
+    be back — no leaked cache reservations from the interrupted admission.
+    (While an under-capacity gang LIVES it keeps retrying and transiently
+    re-assuming chips — upstream-parity optimism — so the deterministic
+    no-leak probe requires the drain.)"""
+    with TestCluster(profile=gang_profile(permit_wait_s=2, denied_s=1)) as c:
+        nodes = v5e8_nodes()
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS, make_pod_group("doomed", min_member=3))
+        pods = [make_pod(f"w{i}", pod_group="doomed", limits={TPU: 4})
+                for i in range(3)]   # 12 chips > 8 available: 3rd can't fit
+        c.create_pods(pods)
+        time.sleep(0.8)              # two members parked at Permit
+        for n in nodes:
+            c.api.patch(srv.NODES, n.meta.key,
+                        lambda live: setattr(live.spec, "unschedulable", True))
+        time.sleep(2.5)              # permit deadline passes under cordon
+        for p in pods:               # operator drains the doomed gang
+            c.api.delete(srv.PODS, p.key)
+        for n in nodes:
+            c.api.patch(srv.NODES, n.meta.key,
+                        lambda live: setattr(live.spec, "unschedulable", False))
+        probes = [make_pod(f"probe{i}", limits={TPU: 4}) for i in range(2)]
+        c.create_pods(probes)        # needs ALL 8 chips: any leak blocks it
+        assert c.wait_for_pods_scheduled([p.key for p in probes], timeout=15)
