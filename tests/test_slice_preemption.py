@@ -346,3 +346,27 @@ def test_scheduler_restart_mid_drain_recovers_without_second_eviction():
     evictions_after = len([e for e in api.events()
                            if e.reason == "Preempted"])
     assert evictions_after == evictions_before  # no second eviction
+
+
+def test_parked_permit_victims_rejected_in_place():
+    """Victims that are PARKED at Permit (assumed, not bound) are evicted via
+    the waiting-pod rejection path, not API deletion — their pods survive as
+    pending objects and their chips free immediately."""
+    with cluster(permit_wait_s=30) as c:
+        add_pool(c)
+        # under-capacity resident gang: 17 members wanted, 16 park forever
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "stuck", min_member=17, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+        stuck = [make_pod(f"stuck-{i}", pod_group="stuck", limits={TPU: 4},
+                          priority=10) for i in range(16)]
+        c.create_pods(stuck)
+        import time
+        time.sleep(1.5)   # members parked at Permit, chips assumed
+        assert all(not c.pod_scheduled(p.key) for p in stuck)
+        high = slice_gang(c, "vip", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in high], timeout=30)
+        # parked victims were rejected in place: pods still exist, unbound
+        for p in stuck:
+            live = c.pod(p.key)
+            assert live is not None and not live.spec.node_name
