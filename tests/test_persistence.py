@@ -281,3 +281,48 @@ def test_wal_fuzz_random_mutations_with_torn_tails(tmp_path):
         got_pgs = {g.meta.key for g in api_torn.list(srv.POD_GROUPS)}
         assert got_pods == expect_pods, f"cut={cut} intact={intact}"
         assert got_pgs == expect_pgs, f"cut={cut} intact={intact}"
+
+
+def test_slice_gang_recovery_through_wal(tmp_path):
+    """Full control-plane durability for the slice path: topology CR, gang
+    PodGroup, and bound members all ride the WAL; the recovered scheduler
+    sees the torus as occupied (a second slice stays Pending) and defrag
+    works after the recovered gang is deleted."""
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.testing import make_pod_group, make_tpu_pool
+
+    d = str(tmp_path / "state")
+    api = srv.APIServer()
+    journal = persistence.attach(api, d)
+
+    def slice_gang(c, name):
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            name, min_member=16, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+        ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+              for i in range(16)]
+        c.create_pods(ps)
+        return ps
+
+    prof = tpu_gang_profile(permit_wait_s=5, denied_s=1)
+    with TestCluster(profile=prof, api=api) as c:
+        topo, nodes = make_tpu_pool("pool", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        first = slice_gang(c, "resident")
+        assert c.wait_for_pods_scheduled([p.key for p in first], timeout=30)
+    journal.close()
+
+    api2 = srv.APIServer()
+    persistence.attach(api2, d)
+    prof2 = tpu_gang_profile(permit_wait_s=2, denied_s=1)
+    with TestCluster(profile=prof2, api=api2) as c2:
+        # recovered occupancy: the pool is full, a second slice pends
+        second = slice_gang(c2, "newcomer")
+        assert c2.wait_for_pods_unscheduled([p.key for p in second], hold=1.5)
+        # defrag: delete the recovered gang; the newcomer takes the window
+        for i in range(16):
+            api2.delete(srv.PODS, f"default/resident-{i}")
+        assert c2.wait_for_pods_scheduled([p.key for p in second], timeout=20)
+        hosts = {c2.pod(p.key).spec.node_name for p in second}
+        assert len(hosts) == 16
