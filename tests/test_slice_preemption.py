@@ -370,3 +370,63 @@ def test_parked_permit_victims_rejected_in_place():
         for p in stuck:
             live = c.pod(p.key)
             assert live is not None and not live.spec.node_name
+
+
+def test_node_selector_mismatch_vetoes_eviction():
+    """A gang whose nodeSelector matches none of the pool's hosts must not
+    evict anything — the viability dry-run includes NodeSelector/NodeName,
+    or preemption destroys a window the gang can never use (and repeats
+    every drain TTL)."""
+    with cluster(permit_wait_s=3) as c:
+        add_pool(c)
+        low = slice_gang(c, "low", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in low], timeout=30)
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "picky", min_member=16, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+        picky = [make_pod(f"picky-{i}", pod_group="picky", limits={TPU: 4},
+                          priority=1000,
+                          node_selector={"zone": "nowhere"})
+                 for i in range(16)]
+        c.create_pods(picky)
+        assert c.wait_for_pods_unscheduled([p.key for p in picky], hold=3.0)
+        assert all(c.pod(p.key) is not None for p in low)  # untouched
+
+
+def test_claim_released_when_pg_deleted():
+    """Deleting the claimant PodGroup releases its freed-window claim at
+    once — the evicted capacity must not idle out the drain TTL."""
+    with cluster(permit_wait_s=15) as c:
+        add_pool(c)
+        tm = c.scheduler.framework.plugins["TopologyMatch"]
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "ghost", min_member=16, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+        tm._window_claims.set("default/ghost", ("pool-key", frozenset({"h"})))
+        c.api.delete(srv.POD_GROUPS, "default/ghost")
+        from tpusched.testing import wait_until
+        assert wait_until(
+            lambda: "default/ghost" not in tm._window_claims, timeout=5)
+
+
+def test_claim_released_when_gang_lands_elsewhere():
+    """If another window frees first and the claimant binds there, its claim
+    on the evicted window is dropped at Reserve time — rivals regain the
+    hosts immediately."""
+    with cluster(permit_wait_s=15) as c:
+        add_pool(c, dims=(4, 4, 8))  # two disjoint 4x4x4 windows
+        tm = c.scheduler.framework.plugins["TopologyMatch"]
+        # resident occupies window A; claimant holds a (stale) claim on A's
+        # hosts but window B is free — the gang binds in B and must release
+        resident = slice_gang(c, "resident", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in resident],
+                                         timeout=30)
+        occupied = {c.pod(p.key).spec.node_name for p in resident}
+        topo = c.api.list(srv.TPU_TOPOLOGIES)[0]
+        tm._window_claims.set("default/mover", (topo.key,
+                                                frozenset(occupied)))
+        mover = slice_gang(c, "mover", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in mover], timeout=30)
+        hosts = {c.pod(p.key).spec.node_name for p in mover}
+        assert hosts.isdisjoint(occupied)
+        assert "default/mover" not in tm._window_claims  # released

@@ -51,8 +51,8 @@ from ...sched.preemption import filter_pods_with_pdb_violation
 from ...util import klog
 from ...util.metrics import preemption_attempts, slice_preemption_victims
 from ...util.ttlcache import TTLCache
-from ..defaults import (NodeResourcesFit, NodeUnschedulable,
-                        TaintToleration)
+from ..defaults import (NodeName, NodeResourcesFit, NodeSelector,
+                        NodeUnschedulable, TaintToleration)
 from ..preemptiontoleration import exempted_from_preemption
 from ..tpuslice.chip_node import pod_tpu_limits
 
@@ -60,10 +60,13 @@ COORD_ANNOTATION = TOPOLOGY_GROUP + "/coord"
 POOL_ANNOTATION = TOPOLOGY_GROUP + "/pool"
 
 _STATE_KEY = "TopologyMatch/state"
+_CLAIMS_KEY = "TopologyMatch/claimed-hosts"
 
-# stateless node filters used by the slice-preemption dry-run
-_VIABILITY_CHECKS = (NodeUnschedulable(), TaintToleration(),
-                     NodeResourcesFit())
+# stateless node filters used by the slice-preemption dry-run — every
+# node-scoped filter of the full-stack profile, or the dry-run evicts a
+# window the preemptor's own selector/name constraints can never use
+_VIABILITY_CHECKS = (NodeUnschedulable(), NodeName(), NodeSelector(),
+                     TaintToleration(), NodeResourcesFit())
 
 
 class _CycleStash:
@@ -85,6 +88,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         self.args = args or TopologyMatchArgs()
         self.handle = handle
         self.pg_informer = handle.informer_factory.podgroups()
+        self.pg_informer.add_event_handler(
+            on_delete=self._pg_deleted, replay=False)
         self.topo_informer = handle.informer_factory.tputopologies()
         # caches keyed by CR resource_version (grids) / + block (placements)
         self._grid_cache: Dict[Tuple[str, int], Tuple[HostGrid, MaskGrid]] = {}
@@ -108,6 +113,11 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
     @classmethod
     def new(cls, args, handle) -> "TopologyMatch":
         return cls(args, handle)
+
+    def _pg_deleted(self, pg) -> None:
+        # a deleted claimant releases its freed-window claim immediately —
+        # without this the evicted capacity idles until the drain TTL
+        self._window_claims.delete(pg.meta.key)
 
     def events_to_register(self) -> List[ClusterEvent]:
         return [
@@ -161,10 +171,21 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             # Skip suppresses our Filter entirely (state.skip_filter_plugins)
             # — but while freed-window claims are live, TPU-consuming pods
             # must still pass through filter()'s claim guard, or a plain pod
-            # lands on a claimed host and re-breaks the claimant's window
+            # lands on a claimed host and re-breaks the claimant's window.
+            # The guarded-host set is computed ONCE here (the per-node
+            # filter sweep must stay a set lookup, not a cache scan).
             chips, chips_set, mem, mem_set = pod_tpu_limits(pod)
-            if (chips_set or mem_set) and self._window_claims.items():
-                return Status.success()   # no stash: filter() guards claims only
+            if chips_set or mem_set:
+                claims = self._window_claims.items()
+                if claims:
+                    mine = pod_group_label(pod)
+                    mine_full = f"{pod.namespace}/{mine}" if mine else None
+                    guarded = frozenset().union(*(
+                        names for full, (_, names) in claims
+                        if full != mine_full)) if claims else frozenset()
+                    if guarded:
+                        state.write(_CLAIMS_KEY, guarded)
+                        return Status.success()
             return Status.skip()
         if req == "invalid":
             return Status.unresolvable("invalid tpu_slice_shape on PodGroup")
@@ -305,10 +326,9 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             # PreFilter skipped (non-slice pod) — but a freed-window claim
             # still guards its hosts: a plain TPU pod grabbing one host of
             # a just-evicted window would re-break the claimant's placement
-            claims = self._window_claims.items()
-            _, chips_set, _, mem_set = pod_tpu_limits(pod)
-            if (claims and (chips_set or mem_set)
-                    and self._node_claimed(pod, node_info.node, claims)):
+            # (guarded set precomputed once per cycle in pre_filter)
+            guarded = state.try_read(_CLAIMS_KEY)
+            if guarded and node_info.node.name in guarded:
                 return Status.unschedulable(
                     "host is claimed by an in-flight slice preemption")
             return Status.success()
@@ -317,13 +337,6 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 "node is not part of any feasible slice placement")
         return Status.success()
 
-    def _node_claimed(self, pod: Pod, node, claims) -> bool:
-        """Is this node inside a live freed-window claim of any gang the pod
-        does not belong to? Claims hold node names — no grid needed."""
-        mine = pod_group_label(pod)
-        mine_full = f"{pod.namespace}/{mine}" if mine else None
-        return any(full != mine_full and node.name in names
-                   for full, (_, names) in claims)
 
     # -- PostFilter: slice preemption -----------------------------------------
     #
@@ -431,7 +444,10 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         for v in victims:
             if not self.handle.reject_waiting_pod(
                     v.meta.uid, self.NAME, f"slice-preempted by {full}"):
-                cs.pods.delete(v.key)
+                try:
+                    cs.pods.delete(v.key)
+                except srv.NotFound:   # raced an external delete: fine
+                    pass
             cs.record_event(v.key, "Pod", "Normal", "Preempted",
                             f"Slice-preempted by gang {full}")
         self._window_claims.set(full, (best_topo_key, best_nodes))
@@ -641,6 +657,17 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             return Status.error(f"node {node_name} missing from pool {pool}")
         pod.meta.annotations[POOL_ANNOTATION] = pool
         pod.meta.annotations[COORD_ANNOTATION] = format_coord(chip_coord)
+        # gang landed OUTSIDE its claimed window (another window freed
+        # first): release the claim so the evicted capacity reopens now
+        # instead of at the drain TTL
+        name = pod_group_label(pod)
+        if name:
+            full = f"{pod.namespace}/{name}"
+            claim, ok = self._window_claims.get(full)
+            if ok and node_name not in claim[1]:
+                self._window_claims.delete(full)
+                klog.V(3).info_s("released freed-window claim: gang landed "
+                                 "elsewhere", podGroup=full)
         klog.V(5).info_s("reserved slice coordinate", pod=pod.key,
                          pool=pool, coord=pod.meta.annotations[COORD_ANNOTATION])
         return Status.success()
