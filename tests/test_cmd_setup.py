@@ -38,7 +38,7 @@ WANT = {
     ("full", "tpusched"): dict(
         queue_sort="Coscheduling",
         pre_filter=["Coscheduling", "TopologyMatch", "CapacityScheduling"],
-        filter=DEFAULT_FILTERS + ["TpuSlice", "TopologyMatch"],
+        filter=["TopologyMatch"] + DEFAULT_FILTERS + ["TpuSlice"],
         post_filter=["TopologyMatch", "Coscheduling", "CapacityScheduling"],
         pre_score=["MultiSlice"],
         score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],
@@ -71,7 +71,7 @@ WANT = {
     ("qos", "tpusched"): dict(queue_sort="QOSSort"),
     ("topologymatch", "tpusched"): dict(
         pre_filter=["TopologyMatch"],
-        filter=DEFAULT_FILTERS + ["TopologyMatch"],
+        filter=["TopologyMatch"] + DEFAULT_FILTERS,
         score=[("TopologyMatch", 2)], reserve=["TopologyMatch"],
         args={"TopologyMatch": {"scoring_strategy": "LeastAllocated",
                                 "resource_weights": {"google.com/tpu": 1},

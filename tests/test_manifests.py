@@ -51,9 +51,12 @@ def test_all_in_one_embedded_config_decodes():
     assert p.bind == ["TpuSlice"]
     assert ("MultiSlice", 3) in p.score
     # the embedded profile matches the canned flagship profile's wiring
+    # (incl. the TopologyMatch-first filter order — the fleet-scale perf
+    # contract the canned profile documents)
     from tpusched.config.profiles import tpu_gang_profile
     canned = tpu_gang_profile()
-    assert p.filter[-2:] == canned.filter[-2:] == ["TpuSlice", "TopologyMatch"]
+    assert p.filter == canned.filter
+    assert p.filter[0] == "TopologyMatch" and p.filter[-1] == "TpuSlice"
     assert p.permit == canned.permit
     assert sorted(p.score) == sorted(canned.score)
 
@@ -98,3 +101,103 @@ def test_crd_spec_fields_cover_dataclasses():
         published = props(path, cls)
         for f in dataclasses.fields(cls):
             assert _snake_to_camel(f.name) in published, (path, f.name)
+
+
+# -- tpuslice Helm chart ------------------------------------------------------
+
+CHART = os.path.join(REPO, "manifests", "tpuslice")
+
+
+def _render_chart_template(path: str) -> str:
+    """Minimal helm-render for the constructs THIS chart uses, with its
+    default values.yaml (no helm binary in the image): include helpers
+    resolve to their default-value expansions, {{ .Values.* }} substitutes,
+    nindent emits an indented block. A construct outside this subset fails
+    the test loudly rather than silently passing."""
+    import re
+    values = yaml.safe_load(open(os.path.join(CHART, "values.yaml")))
+    # default-values expansion of the _helpers.tpl defines
+    helpers = {
+        "tpuslice.name": "tpuslice-scheduler",
+        "tpuslice.fullname": values["fullnameOverride"],
+        "tpuslice.chart": "tpuslice-scheduler-0.1.0",
+        "tpuslice.serviceAccountName": values["serviceAccount"]["name"],
+        "tpuslice.selectorLabels": (
+            "app.kubernetes.io/name: tpuslice-scheduler\n"
+            "app.kubernetes.io/instance: RELEASE"),
+        "tpuslice.labels": (
+            "helm.sh/chart: tpuslice-scheduler-0.1.0\n"
+            "app.kubernetes.io/name: tpuslice-scheduler\n"
+            "app.kubernetes.io/instance: RELEASE\n"
+            'app.kubernetes.io/version: "0.1.0"\n'
+            "app.kubernetes.io/managed-by: Helm"),
+    }
+    text = open(path).read()
+
+    def sub(m: "re.Match") -> str:
+        expr = m.group(1).strip().strip("-").strip()
+        nindent = re.search(r"\|\s*nindent\s+(\d+)$", expr)
+        if nindent:
+            expr = expr[:nindent.start()].strip()
+        inc = re.fullmatch(r'include "([^"]+)" \.', expr)
+        if inc:
+            out = helpers[inc.group(1)]
+        elif expr.startswith(".Values."):
+            cur = values
+            for part in expr[len(".Values."):].split("."):
+                cur = cur[part]
+            out = str(cur)
+        else:
+            raise AssertionError(f"{path}: unsupported construct {expr!r}")
+        if nindent:
+            pad = " " * int(nindent.group(1))
+            out = "\n" + "\n".join(pad + line for line in out.splitlines())
+        return out
+
+    return re.sub(r"\{\{(.*?)\}\}", sub, text)
+
+
+def test_chart_has_full_template_set():
+    """Chart parity with the reference's flexgpu chart
+    (/root/reference/manifests/flexgpu/templates): helpers, rbac, configmap,
+    deployment, values."""
+    for f in ("_helpers.tpl", "rbac.yaml", "configmap.yaml",
+              "deployment.yaml"):
+        assert os.path.exists(os.path.join(CHART, "templates", f)), f
+    helpers = open(os.path.join(CHART, "templates", "_helpers.tpl")).read()
+    for name in ("tpuslice.name", "tpuslice.fullname", "tpuslice.labels",
+                 "tpuslice.selectorLabels", "tpuslice.serviceAccountName"):
+        assert f'define "{name}"' in helpers, name
+
+
+def test_chart_rbac_renders_complete_install():
+    docs = list(yaml.safe_load_all(_render_chart_template(
+        os.path.join(CHART, "templates", "rbac.yaml"))))
+    kinds = [d["kind"] for d in docs if d]
+    assert kinds == ["ServiceAccount", "ClusterRole", "ClusterRoleBinding"]
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    granted = {(g, r) for rule in role["rules"]
+               for g in rule["apiGroups"] for r in rule["resources"]}
+    # the scheduler's working set: core pods/binding/nodes, the tpusched
+    # CRD groups, and leases for leader election
+    for need in (("", "pods"), ("", "pods/binding"), ("", "nodes"),
+                 ("scheduling.tpu.dev", "podgroups"),
+                 ("scheduling.tpu.dev", "elasticquotas"),
+                 ("topology.tpu.dev", "tputopologies"),
+                 ("coordination.k8s.io", "leases")):
+        assert need in granted, need
+    binding = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+    sa = next(d for d in docs if d["kind"] == "ServiceAccount")
+    assert binding["subjects"][0]["name"] == sa["metadata"]["name"]
+    assert binding["roleRef"]["name"] == role["metadata"]["name"]
+
+
+def test_chart_deployment_and_configmap_render():
+    for f in ("deployment.yaml", "configmap.yaml"):
+        docs = list(yaml.safe_load_all(_render_chart_template(
+            os.path.join(CHART, "templates", f))))
+        assert docs and all(d for d in docs), f
+    cm = list(yaml.safe_load_all(_render_chart_template(
+        os.path.join(CHART, "templates", "configmap.yaml"))))[0]
+    cfg = v.loads(cm["data"]["scheduler-config.yaml"])
+    assert cfg.profiles[0].bind == ["TpuSlice"]

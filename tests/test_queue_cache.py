@@ -345,3 +345,81 @@ def test_cache_remove_node_keeps_pod_accounting_consistent():
     assert cache.snapshot().get("n1") is None
     # pod deletion after its node vanished must not raise
     cache.remove_pod(bound)
+
+
+# -- configurable pod backoff (upstream podInitialBackoffSeconds) -------------
+
+def test_queue_initial_backoff_configurable():
+    """podInitialBackoffSeconds analog: a requeued-to-backoff pod serves the
+    configured initial backoff, not the 1 s upstream default."""
+    now = [100.0]
+    q = SchedulingQueue(prio_less, clock=lambda: now[0],
+                        initial_backoff_s=0.25)
+    info = QueuedPodInfo(make_pod("p"), clock=lambda: now[0])
+    info.attempts = 1
+    q.requeue_after_failure(info, to_backoff=True)
+    assert q.pop(timeout=0.01) is None          # still backing off
+    now[0] += 0.3                               # past 0.25s, well before 1s
+    got = q.pop(timeout=0.5)
+    assert got is not None and got.pod.name == "p"
+
+
+def test_queue_explicit_zero_backoff_is_immediate():
+    """Explicit 0 means retry immediately (upstream allows 0); it must not
+    be conflated with 'unset'."""
+    q = SchedulingQueue(prio_less, initial_backoff_s=0.0, max_backoff_s=0.0)
+    info = QueuedPodInfo(make_pod("p"))
+    info.attempts = 3
+    q.requeue_after_failure(info, to_backoff=True)
+    got = q.pop(timeout=0.5)
+    assert got is not None and got.pod.name == "p"
+
+
+def test_queue_max_backoff_caps_growth():
+    now = [100.0]
+    q = SchedulingQueue(prio_less, clock=lambda: now[0],
+                        initial_backoff_s=0.5, max_backoff_s=1.0)
+    info = QueuedPodInfo(make_pod("p"), clock=lambda: now[0])
+    info.attempts = 10                          # exponential would be huge
+    q.requeue_after_failure(info, to_backoff=True)
+    now[0] += 1.1                               # just past the 1 s cap
+    got = q.pop(timeout=0.5)
+    assert got is not None
+
+
+def test_activate_noop_when_nothing_parked():
+    """The O(1) early exit: activating pods that are all in-flight (neither
+    unschedulable nor in backoff) moves nothing and breaks nothing."""
+    q = SchedulingQueue(prio_less)
+    q.add(make_pod("active-one"))
+    q.activate([make_pod(f"sib-{i}") for i in range(50)])
+    got = q.pop(timeout=0.2)
+    assert got is not None and got.pod.name == "active-one"
+    assert q.pop(timeout=0.05) is None          # siblings were not conjured
+
+
+# -- incremental gang-assigned index (Permit quorum input) --------------------
+
+def test_snapshot_assigned_count_incremental():
+    """The cache maintains gang→assigned counts at attach/detach; the
+    snapshot answers assigned_count without walking nodes."""
+    c = Cache()
+    for i in range(3):
+        c.add_node(make_tpu_node(f"n{i}", chips=4))
+    pods = [make_pod(f"g-{i}", pod_group="gang") for i in range(3)]
+    for i, p in enumerate(pods):
+        c.assume_pod(p, f"n{i}")
+    assert c.snapshot().assigned_count("gang", "default") == 3
+    # forget one assumed pod: count drops
+    c.forget_pod(pods[0])
+    assert c.snapshot().assigned_count("gang", "default") == 2
+    # confirmation (add_pod) replaces assumed without double counting
+    bound = pods[1].deepcopy()
+    c.add_pod(bound)
+    assert c.snapshot().assigned_count("gang", "default") == 2
+    # node removal sheds its resident members
+    c.remove_node(make_tpu_node("n2", chips=4))
+    assert c.snapshot().assigned_count("gang", "default") == 1
+    # node re-add re-attaches the still-known bound pod
+    c.add_node(make_tpu_node("n2", chips=4))
+    assert c.snapshot().assigned_count("gang", "default") == 2

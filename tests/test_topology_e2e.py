@@ -157,3 +157,38 @@ def test_every_generation_places_a_slice_e2e():
             coords = {c.pod(p.key).meta.annotations[COORD_ANNOTATION]
                       for p in pods}
             assert len(coords) == members, (acc, coords)
+
+
+# -- gang→pool pin (Reserve-time sweep shortcut) ------------------------------
+
+def test_gang_pool_pin_set_and_released():
+    """The fleet-scale shortcut: once a sibling reserves, the gang is pinned
+    to its pool (later siblings sweep 1 pool, not N); deleting the PodGroup
+    releases the pin."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        for i in range(4):
+            add_pool(c, f"pin-pool-{i}", dims=(4, 4, 4))
+        pods = slice_gang(c, "pinned", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+        tm = c.scheduler._fw.plugins["TopologyMatch"]
+        landed = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                  for p in pods}
+        assert len(landed) == 1
+        assert tm._gang_pool.get("default/pinned") == landed.pop()
+        c.api.delete(srv.POD_GROUPS, "default/pinned")
+        from tpusched.testing import wait_until
+        assert wait_until(lambda: "default/pinned" not in tm._gang_pool,
+                          timeout=5)
+
+
+def test_stale_gang_pool_pin_falls_back_to_full_sweep():
+    """A pin pointing at a vanished/full pool must not wedge the gang: the
+    sweep falls back to all matching pools and re-derives the pin."""
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5, denied_s=1)) as c:
+        add_pool(c, "real-pool", dims=(4, 4, 4))
+        tm = c.scheduler._fw.plugins["TopologyMatch"]
+        # poison the pin before the gang arrives
+        tm._gang_pool["default/resilient"] = "no-such-pool"
+        pods = slice_gang(c, "resilient", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+        assert tm._gang_pool.get("default/resilient") == "real-pool"

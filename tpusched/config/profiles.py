@@ -18,9 +18,14 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
         scheduler_name=scheduler_name,
         queue_sort="Coscheduling",
         pre_filter=["Coscheduling", "TopologyMatch"],
-        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
-                "TaintToleration", "NodeResourcesFit", "TpuSlice",
-                "TopologyMatch"],
+        # TopologyMatch first: its per-node check is one set lookup against
+        # the PreFilter stash and it is the most selective filter for slice
+        # gangs (a 16-pool fleet rejects ~15/16 of hosts here) — running it
+        # early skips the rest of the chain for every rejected host.
+        # Filters are conjunctive, so order changes cost, not outcome.
+        filter=["TopologyMatch", "NodeUnschedulable", "NodeName",
+                "NodeSelector", "TaintToleration", "NodeResourcesFit",
+                "TpuSlice"],
         post_filter=["Coscheduling"],
         pre_score=["MultiSlice"],
         score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],

@@ -120,24 +120,40 @@ class Snapshot:
                 self._infos[p.spec.node_name].add_pod(p)
 
     @classmethod
-    def from_infos(cls, infos: Dict[str, "NodeInfo"]) -> "Snapshot":
+    def from_infos(cls, infos: Dict[str, "NodeInfo"],
+                   pg_assigned: Optional[Dict[str, int]] = None) -> "Snapshot":
+        """pg_assigned: a precomputed gang→assigned-members index (the
+        scheduler cache maintains one incrementally); when absent the index
+        is derived lazily from the infos on first assigned_count query."""
         out = cls()
         out._infos = infos
+        out._pg_assigned = pg_assigned
         return out
+
+    @staticmethod
+    def _node_pg_counts(info: "NodeInfo") -> Dict[str, int]:
+        from ..api.scheduling import POD_GROUP_LABEL
+        counts: Dict[str, int] = {}
+        for p in info.pods:
+            name = p.meta.labels.get(POD_GROUP_LABEL)
+            if name and p.spec.node_name:
+                key = f"{p.meta.namespace}/{name}"
+                counts[key] = counts.get(key, 0) + 1
+        return counts
 
     def assigned_count(self, pg_name: str, namespace: str) -> int:
         """Members of a gang with a node assigned (assumed or bound) — the
         quorum input (core.go:301-318). Indexed lazily once per snapshot so
-        per-Permit cost is O(1) instead of O(pods)."""
-        from ..api.scheduling import POD_GROUP_LABEL
+        per-Permit cost is O(1) instead of O(pods); the per-node counts are
+        generation-memoized (derived()), so the snapshot index rebuild is
+        O(nodes) — only nodes that changed since the last cycle re-walk
+        their pods."""
         if self._pg_assigned is None:
             idx: Dict[str, int] = {}
             for info in self._infos.values():
-                for p in info.pods:
-                    name = p.meta.labels.get(POD_GROUP_LABEL)
-                    if name and p.spec.node_name:
-                        key = f"{p.meta.namespace}/{name}"
-                        idx[key] = idx.get(key, 0) + 1
+                for key, c in info.derived(
+                        "Snapshot/pg-assigned", self._node_pg_counts).items():
+                    idx[key] = idx.get(key, 0) + c
             self._pg_assigned = idx
         return self._pg_assigned.get(f"{namespace}/{pg_name}", 0)
 

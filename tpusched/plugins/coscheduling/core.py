@@ -169,7 +169,12 @@ class PodGroupManager:
         name = pod_group_label(pod)
         if not name:
             return
-        pods = [p for p in self.siblings(pod) if p.meta.uid != pod.meta.uid]
+        # Assigned siblings (assumed or bound) have nothing left to schedule —
+        # re-activating them is wasted queue work that grows O(n²) over a
+        # gang's bind burst (upstream stashes all siblings; the queue's
+        # absent-key probe makes the difference invisible there, costly here).
+        pods = [p for p in self.siblings(pod)
+                if p.meta.uid != pod.meta.uid and not p.spec.node_name]
         if not pods:
             return
         stash = state.try_read(PODS_TO_ACTIVATE_KEY)
@@ -189,18 +194,21 @@ class PodGroupManager:
         if not full or pg is None:
             return
         now = time.time()
-        # north-star interval start: first member SEEN (earliest sibling
-        # creation), not first member bound — the Permit barrier releases all
-        # binds at once, so first-bind→last-bind would only measure the burst
-        first_seen = min((p.meta.creation_timestamp for p in self.siblings(pod)),
-                         default=pg.meta.creation_timestamp)
 
         def mutate(g: PodGroup):
             g.status.scheduled += 1
             if g.status.scheduled >= g.spec.min_member:
                 if g.status.phase != PG_SCHEDULED:
                     # quorum complete: record the north-star latency
-                    # (BASELINE.md PodGroup-to-Bound)
+                    # (BASELINE.md PodGroup-to-Bound). Interval start: first
+                    # member SEEN (earliest sibling creation), not first
+                    # bound — the Permit barrier releases all binds at once,
+                    # so first-bind→last-bind would only measure the burst.
+                    # Computed here, once per gang: an O(members) sweep on
+                    # every bind is O(n²) over the release burst.
+                    first_seen = min(
+                        (p.meta.creation_timestamp for p in self.siblings(pod)),
+                        default=pg.meta.creation_timestamp)
                     pod_group_to_bound_seconds.observe(max(0.0, now - first_seen))
                 g.status.phase = PG_SCHEDULED
             else:

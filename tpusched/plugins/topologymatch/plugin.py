@@ -106,6 +106,9 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # older equal-priority rival pops first and steals the window)
         self._window_claims = TTLCache(
             self.args.slice_preemption_drain_seconds)
+        # gang full-name → pool name, set at Reserve: once any sibling is
+        # placed, later siblings' PreFilter sweeps only that pool
+        self._gang_pool: Dict[str, str] = {}
         # warm the native engine at construction — its first load may compile
         # the C++ source, which must not stall a scheduling cycle
         native.load()
@@ -118,6 +121,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # a deleted claimant releases its freed-window claim immediately —
         # without this the evicted capacity idles until the drain TTL
         self._window_claims.delete(pg.meta.key)
+        self._gang_pool.pop(pg.meta.key, None)
 
     def events_to_register(self) -> List[ClusterEvent]:
         return [
@@ -194,47 +198,65 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         chips_req, chips_set, _, _ = pod_tpu_limits(pod)
         chips_needed = chips_req if chips_set else None
         snapshot = self.handle.snapshot_shared_lister()
-        stash = _CycleStash()
+        full = f"{pod.namespace}/{pg.meta.name}"
         validation_errors: List[str] = []
         any_pool = False
-
-        candidates = []
         any_valid_pool = False
+
+        matching = []
         for topo, acc, grids, err in self._matching_pools(shape, want_acc):
             any_pool = True
             if err:
                 validation_errors.append(f"pool {topo.spec.pool}: {err}")
                 continue
             any_valid_pool = True
-            occ = self._occupancy(grids[0], snapshot, pg.meta.name,
-                                  pod.namespace,
-                                  chips_needed if chips_needed is not None
-                                  else acc.chips_per_host)
-            candidates.append((topo, acc, grids, occ))
+            matching.append((topo, acc, grids))
 
-        # A gang must live in ONE torus: once any sibling is assigned in a
-        # pool, every other pool is off the table (a "slice" spanning two
-        # disjoint ICI fabrics would be unusable).
-        pinned = [c for c in candidates if c[3][0]]
-        if pinned:
-            candidates = pinned
+        def sweep(pools) -> _CycleStash:
+            stash = _CycleStash()
+            candidates = []
+            for topo, acc, grids in pools:
+                occ = self._occupancy(grids[0], snapshot, pg.meta.name,
+                                      pod.namespace,
+                                      chips_needed if chips_needed is not None
+                                      else acc.chips_per_host)
+                candidates.append((topo, acc, grids, occ))
+            # A gang must live in ONE torus: once any sibling is assigned in
+            # a pool, every other pool is off the table (a "slice" spanning
+            # two disjoint ICI fabrics would be unusable).
+            pinned = [c for c in candidates if c[3][0]]
+            if pinned:
+                candidates = pinned
+            for topo, acc, (grid, mgrid), (assigned, free, eligible,
+                                           pool_util) in candidates:
+                pset = self._placements(topo, mgrid, shape)
+                claimed = self._claimed_mask(mgrid, grid, topo.key,
+                                             exclude=full)
+                n_survivors, membership = feasible_membership(
+                    pset, mgrid.mask_of(assigned),
+                    mgrid.mask_of(free) & ~claimed,
+                    mgrid.mask_of(eligible) & ~claimed)
+                if not n_survivors:
+                    continue
+                for node, count in membership.items():
+                    prev = stash.allowed.get(node)
+                    if prev is None or count < prev[1]:
+                        stash.allowed[node] = (grid.pool, count, pool_util)
+                    stash.max_membership = max(stash.max_membership, count)
+            return stash
 
-        full = f"{pod.namespace}/{pg.meta.name}"
-        for topo, acc, (grid, mgrid), (assigned, free, eligible,
-                                       pool_util) in candidates:
-            pset = self._placements(topo, mgrid, shape)
-            claimed = self._claimed_mask(mgrid, grid, topo.key, exclude=full)
-            n_survivors, membership = feasible_membership(
-                pset, mgrid.mask_of(assigned),
-                mgrid.mask_of(free) & ~claimed,
-                mgrid.mask_of(eligible) & ~claimed)
-            if not n_survivors:
-                continue
-            for node, count in membership.items():
-                prev = stash.allowed.get(node)
-                if prev is None or count < prev[1]:
-                    stash.allowed[node] = (grid.pool, count, pool_util)
-                stash.max_membership = max(stash.max_membership, count)
+        # pool pin (set at the first sibling's Reserve): sweep only the
+        # gang's pool; a stale/failed pin falls back to the full sweep
+        pin = self._gang_pool.get(full)
+        stash = _CycleStash()
+        if pin is not None:
+            pool_match = [m for m in matching if m[0].spec.pool == pin]
+            if pool_match:
+                stash = sweep(pool_match)
+            if not stash.allowed:
+                self._gang_pool.pop(full, None)
+        if not stash.allowed:
+            stash = sweep(matching)
 
         if not stash.allowed:
             if not any_pool:
@@ -274,6 +296,23 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             self._placement_cache[key] = got
         return got
 
+    @staticmethod
+    def _node_pg_usage(info: NodeInfo):
+        """Per-node TPU usage grouped by owning gang: {(ns, pg_label): chips}
+        plus the node's total TPU chips in use. Memoized on the NodeInfo via
+        its generation (fwk/nodeinfo.py derived()): during a 256-member gang
+        burst only the node that just took a sibling changes, so the other
+        63+ hosts answer every later cycle's occupancy query without
+        re-walking their pods."""
+        usage: Dict[Tuple[str, Optional[str]], int] = {}
+        total = 0
+        for p in info.pods:
+            c, _, _, _ = pod_tpu_limits(p)
+            k = (p.meta.namespace, p.meta.labels.get(POD_GROUP_LABEL))
+            usage[k] = usage.get(k, 0) + c
+            total += c
+        return usage, total
+
     def _occupancy(self, grid: HostGrid, snapshot, pg_name: str,
                    namespace: str, chips_needed: int):
         """Returns (assigned, free, eligible, pool_utilization):
@@ -290,23 +329,20 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         free = set()
         eligible = set()
         total_alloc = total_used = 0
+        me = (namespace, pg_name)
         for node, coord in grid.coord_of.items():
             info = snapshot.get(node)
             if info is None:
                 continue
-            sibling_used = foreign_used = 0
-            has_sibling = False
-            for p in info.pods:
-                c, _, _, _ = pod_tpu_limits(p)
-                if (p.meta.labels.get(POD_GROUP_LABEL) == pg_name
-                        and p.meta.namespace == namespace):
-                    has_sibling = True
-                    sibling_used += c
-                else:
-                    foreign_used += c
+            usage, node_used = info.derived("TopologyMatch/pg-usage",
+                                            self._node_pg_usage)
+            ent = usage.get(me)
+            has_sibling = ent is not None
+            sibling_used = ent or 0
+            foreign_used = node_used - sibling_used
             alloc = info.allocatable.get(TPU, 0)
             total_alloc += alloc
-            total_used += sibling_used + foreign_used
+            total_used += node_used
             if has_sibling:
                 assigned.add(coord)
             if foreign_used:
@@ -657,12 +693,18 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             return Status.error(f"node {node_name} missing from pool {pool}")
         pod.meta.annotations[POOL_ANNOTATION] = pool
         pod.meta.annotations[COORD_ANNOTATION] = format_coord(chip_coord)
-        # gang landed OUTSIDE its claimed window (another window freed
-        # first): release the claim so the evicted capacity reopens now
-        # instead of at the drain TTL
         name = pod_group_label(pod)
         if name:
             full = f"{pod.namespace}/{name}"
+            # pin the gang to this pool: siblings' PreFilter needs only this
+            # pool's occupancy from now on (a gang lives in ONE torus
+            # anyway — at fleet scale this is the difference between
+            # sweeping 16 pools per sibling and sweeping 1). Dropped on
+            # unreserve/PG delete; a stale pin costs one fall-back sweep.
+            self._gang_pool[full] = pool
+            # gang landed OUTSIDE its claimed window (another window freed
+            # first): release the claim so the evicted capacity reopens now
+            # instead of at the drain TTL
             claim, ok = self._window_claims.get(full)
             if ok and node_name not in claim[1]:
                 self._window_claims.delete(full)
@@ -675,3 +717,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
         pod.meta.annotations.pop(POOL_ANNOTATION, None)
         pod.meta.annotations.pop(COORD_ANNOTATION, None)
+        # drop the pool pin: the gang's placement is in doubt (denied quorum,
+        # failed bind) — the next cycle re-derives it from a full sweep
+        name = pod_group_label(pod)
+        if name:
+            self._gang_pool.pop(f"{pod.namespace}/{name}", None)

@@ -15,6 +15,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ..api.core import Node, Pod
+from ..api.scheduling import POD_GROUP_LABEL
 from ..fwk.nodeinfo import NodeInfo, Snapshot
 from ..util import klog
 
@@ -31,17 +32,37 @@ class Cache:
         # last snapshot's clones, keyed by (generation) — upstream's
         # UpdateSnapshot design: only nodes that changed re-clone
         self._snap_clones: Dict[str, Tuple[int, NodeInfo]] = {}
+        # gang full-name → members attached to a cached node (the Permit
+        # quorum input), maintained incrementally at attach/detach so
+        # assigned_count never walks the fleet (O(1) per cycle at any scale)
+        self._pg_assigned: Dict[str, int] = {}
+
+    def _pg_adjust(self, pod: Pod, delta: int) -> None:
+        name = pod.meta.labels.get(POD_GROUP_LABEL)
+        if not name or not pod.spec.node_name:
+            return
+        key = f"{pod.meta.namespace}/{name}"
+        n = self._pg_assigned.get(key, 0) + delta
+        if n <= 0:
+            self._pg_assigned.pop(key, None)
+        else:
+            self._pg_assigned[key] = n
 
     # -- nodes ----------------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
         with self._lock:
+            old = self._infos.get(node.name)
+            if old is not None:
+                for p in old.pods:
+                    self._pg_adjust(p, -1)
             info = NodeInfo(node)
             self._infos[node.name] = info
             # attach pods already known to live on this node
             for p in self._pods.values():
                 if p.spec.node_name == node.name:
                     info.add_pod(p)
+                    self._pg_adjust(p, +1)
 
     def update_node(self, node: Node) -> None:
         with self._lock:
@@ -53,7 +74,10 @@ class Cache:
 
     def remove_node(self, node: Node) -> None:
         with self._lock:
-            self._infos.pop(node.name, None)
+            info = self._infos.pop(node.name, None)
+            if info is not None:
+                for p in info.pods:
+                    self._pg_adjust(p, -1)
 
     # -- pods -----------------------------------------------------------------
 
@@ -61,11 +85,12 @@ class Cache:
         info = self._infos.get(pod.spec.node_name)
         if info is not None:
             info.add_pod(pod)
+            self._pg_adjust(pod, +1)
 
     def _detach(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
-        if info is not None:
-            info.remove_pod(pod)
+        if info is not None and info.remove_pod(pod):
+            self._pg_adjust(pod, -1)
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
         """Stores the caller's object by reference (upstream shares the pod
@@ -146,7 +171,7 @@ class Cache:
                 clones[name] = ent
                 infos[name] = ent[1]
             self._snap_clones = clones
-            return Snapshot.from_infos(infos)
+            return Snapshot.from_infos(infos, dict(self._pg_assigned))
 
     def node_names(self):
         with self._lock:

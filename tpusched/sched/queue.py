@@ -35,12 +35,13 @@ class QueuedPodInfo:
         self.attempts = 0
         self.unschedulable_plugins: set = set()
 
-    def backoff_duration(self) -> float:
-        d = INITIAL_BACKOFF_S
+    def backoff_duration(self, initial: float = INITIAL_BACKOFF_S,
+                         maximum: float = MAX_BACKOFF_S) -> float:
+        d = initial
         for _ in range(self.attempts - 1):
             d *= 2
-            if d >= MAX_BACKOFF_S:
-                return MAX_BACKOFF_S
+            if d >= maximum:
+                return maximum
         return d
 
 
@@ -103,16 +104,38 @@ class _Heap:
 class SchedulingQueue:
     def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
                  cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
-                 clock=time.time):
+                 clock=time.time,
+                 initial_backoff_s: Optional[float] = None,
+                 max_backoff_s: Optional[float] = None):
         self._clock = clock
+        # upstream podInitialBackoffSeconds / podMaxBackoffSeconds;
+        # None = default, explicit 0 = retry immediately
+        self._initial_backoff_s = (INITIAL_BACKOFF_S if initial_backoff_s
+                                   is None else initial_backoff_s)
+        self._max_backoff_s = (MAX_BACKOFF_S if max_backoff_s is None
+                               else max_backoff_s)
         self._lock = threading.Condition()
         self._active = _Heap(less)
         self._backoff: List = []           # (expiry, seq, info)
         self._backoff_seq = itertools.count()
+        # live (non-tombstoned) keys in _backoff, with multiplicity — lets
+        # activate()/update() skip the O(backoff) scan for absent keys, which
+        # matters because PodsToActivate probes every gang sibling each cycle
+        self._backoff_keys: Dict[str, int] = {}
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         # plugin name → events that plugin said can unstick its rejections
         self._cluster_event_map = cluster_event_map or {}
         self._closed = False
+
+    def _bk_add(self, key: str) -> None:
+        self._backoff_keys[key] = self._backoff_keys.get(key, 0) + 1
+
+    def _bk_del(self, key: str) -> None:
+        n = self._backoff_keys.get(key, 0) - 1
+        if n <= 0:
+            self._backoff_keys.pop(key, None)
+        else:
+            self._backoff_keys[key] = n
 
     def pending_counts(self) -> Dict[str, int]:
         """Queue depths for the pending_pods{queue=...} gauges (upstream
@@ -141,10 +164,11 @@ class SchedulingQueue:
                 self._active.push(info)
                 self._lock.notify_all()
                 return
-            for i, (exp, seq, binfo) in enumerate(self._backoff):
-                if binfo is not None and binfo.pod.key == key:
-                    binfo.pod = pod
-                    return
+            if key in self._backoff_keys:
+                for i, (exp, seq, binfo) in enumerate(self._backoff):
+                    if binfo is not None and binfo.pod.key == key:
+                        binfo.pod = pod
+                        return
             if key in self._unschedulable:
                 self._unschedulable[key].pod = pod
 
@@ -153,9 +177,13 @@ class SchedulingQueue:
         with self._lock:
             self._active.remove(key)
             self._unschedulable.pop(key, None)
-            self._backoff = [(e, s, i) for (e, s, i) in self._backoff
-                             if i is None or i.pod.key != key]
-            heapq.heapify(self._backoff)
+            if key in self._backoff_keys:
+                before = len(self._backoff)
+                self._backoff = [(e, s, i) for (e, s, i) in self._backoff
+                                 if i is None or i.pod.key != key]
+                heapq.heapify(self._backoff)
+                for _ in range(before - len(self._backoff)):
+                    self._bk_del(key)
 
     def add_unschedulable_if_not_present(self, info: QueuedPodInfo) -> None:
         with self._lock:
@@ -181,9 +209,11 @@ class SchedulingQueue:
                 if key in self._active or key in self._unschedulable:
                     return
                 info.timestamp = self._clock()
-                expiry = info.timestamp + info.backoff_duration()
+                expiry = info.timestamp + info.backoff_duration(
+                    self._initial_backoff_s, self._max_backoff_s)
                 heapq.heappush(self._backoff,
                                (expiry, next(self._backoff_seq), info))
+                self._bk_add(key)
                 self._lock.notify_all()
             return
         self.add_unschedulable_if_not_present(info)
@@ -194,14 +224,21 @@ class SchedulingQueue:
         """PodsToActivate: force the listed pods into activeQ
         (core.go:111-143 / upstream scheduler.go activate)."""
         with self._lock:
+            # Nothing parked means nothing to move: during a healthy gang
+            # burst every sibling is active or in-flight, and PodsToActivate
+            # probes all of them every cycle — this O(1) exit is what keeps
+            # that probe from being O(members²) per gang.
+            if not self._unschedulable and not self._backoff_keys:
+                return
             moved = False
             for pod in pods:
                 key = pod.key
                 info = self._unschedulable.pop(key, None)
-                if info is None:
+                if info is None and key in self._backoff_keys:
                     for i, (exp, seq, binfo) in enumerate(self._backoff):
                         if binfo is not None and binfo.pod.key == key:
                             self._backoff[i] = (exp, seq, None)
+                            self._bk_del(key)
                             info = binfo
                             break
                 if info is not None:
@@ -221,11 +258,13 @@ class SchedulingQueue:
                     del self._unschedulable[key]
                     moved.append(info)
             for info in moved:
-                expiry = info.timestamp + info.backoff_duration()
+                expiry = info.timestamp + info.backoff_duration(
+                    self._initial_backoff_s, self._max_backoff_s)
                 if expiry <= now:
                     self._active.push(info)
                 else:
                     heapq.heappush(self._backoff, (expiry, next(self._backoff_seq), info))
+                    self._bk_add(info.pod.key)
             if moved:
                 self._lock.notify_all()
 
@@ -245,6 +284,7 @@ class SchedulingQueue:
         while self._backoff and self._backoff[0][0] <= now:
             _, _, info = heapq.heappop(self._backoff)
             if info is not None:
+                self._bk_del(info.pod.key)
                 self._active.push(info)
         for key, info in list(self._unschedulable.items()):
             if now - info.timestamp > UNSCHEDULABLE_Q_FLUSH_S:

@@ -124,7 +124,10 @@ class Scheduler:
                 cluster_event_map[name] = plugin.events_to_register()
             else:
                 cluster_event_map[name] = [WILDCARD_EVENT]
-        self.queue = SchedulingQueue(self._fw.less, cluster_event_map, clock)
+        self.queue = SchedulingQueue(
+            self._fw.less, cluster_event_map, clock,
+            initial_backoff_s=profile.pod_initial_backoff_s,
+            max_backoff_s=profile.pod_max_backoff_s)
         # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
         # computed at scrape time from the live queue. weakref: the global
         # registry must not keep a stopped scheduler (and everything it

@@ -12,6 +12,7 @@ from __future__ import annotations
 import bisect
 import collections
 import threading
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -215,7 +216,6 @@ def timed_call(hist: Histogram, fn, *args):
     """Run fn(*args), observing its wall time into ``hist`` (including on
     exception). The shared body of the extension-point and per-plugin
     duration recorders."""
-    import time as _time
     t0 = _time.perf_counter()
     try:
         return fn(*args)
