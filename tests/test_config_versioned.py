@@ -328,3 +328,79 @@ def test_plugin_args_validate_hook_rejects_out_of_range():
         decode_plugin_args("TopologyMatch", {"scoringStrategy": "Best"})
     args = decode_plugin_args("TopologyMatch", {"packingWeight": 0.0})
     assert args.packing_weight == 0.0
+
+
+# -- podInitialBackoffSeconds / podMaxBackoffSeconds --------------------------
+
+BACKOFF_YAML = textwrap.dedent("""
+    apiVersion: tpusched.config.tpu.dev/v1beta1
+    kind: TpuSchedulerConfiguration
+    podInitialBackoffSeconds: {init}
+    podMaxBackoffSeconds: {max}
+    profiles:
+    - schedulerName: tpusched
+""")
+
+
+def test_backoff_seconds_decoded_onto_profiles():
+    cfg = v.loads(BACKOFF_YAML.format(init=0.25, max=5))
+    assert cfg.profiles[0].pod_initial_backoff_s == 0.25
+    assert cfg.profiles[0].pod_max_backoff_s == 5.0
+
+
+def test_backoff_absent_means_none_not_zero():
+    cfg = v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+    """))
+    assert cfg.profiles[0].pod_initial_backoff_s is None
+    assert cfg.profiles[0].pod_max_backoff_s is None
+
+
+def test_backoff_explicit_zero_preserved():
+    """0 = retry immediately (upstream allows it); must survive decode."""
+    cfg = v.loads(BACKOFF_YAML.format(init=0, max=0))
+    assert cfg.profiles[0].pod_initial_backoff_s == 0.0
+    assert cfg.profiles[0].pod_max_backoff_s == 0.0
+
+
+def test_backoff_max_below_default_initial_rejected():
+    """podMaxBackoffSeconds below the EFFECTIVE initial (1 s default when
+    unset) must fail validation, not be silently exceeded at runtime."""
+    with pytest.raises(ConfigError):
+        v.loads(textwrap.dedent("""
+            apiVersion: tpusched.config.tpu.dev/v1beta1
+            kind: TpuSchedulerConfiguration
+            podMaxBackoffSeconds: 0.5
+            profiles:
+            - schedulerName: tpusched
+        """))
+
+
+def test_backoff_negative_rejected():
+    with pytest.raises(ConfigError):
+        v.loads(BACKOFF_YAML.format(init=-1, max=10))
+
+
+def test_backoff_max_less_than_initial_rejected():
+    with pytest.raises(ConfigError):
+        v.loads(BACKOFF_YAML.format(init=4, max=2))
+
+
+def test_backoff_round_trips_through_encode():
+    cfg = v.loads(BACKOFF_YAML.format(init=0.25, max=5))
+    wire = v.encode(cfg)
+    assert wire["podInitialBackoffSeconds"] == 0.25
+    assert wire["podMaxBackoffSeconds"] == 5.0
+    again = v.decode(wire)
+    assert again.profiles[0].pod_initial_backoff_s == 0.25
+    # unset stays absent on the wire
+    wire2 = v.encode(v.loads(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: tpusched
+    """)))
+    assert "podInitialBackoffSeconds" not in wire2

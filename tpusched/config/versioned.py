@@ -128,7 +128,7 @@ def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
         raise ConfigError(f"unsupported kind {raw.get('kind')!r} (want {KIND})")
 
     known_top = {"apiVersion", "kind", "leaderElection", "clientConnection",
-                 "profiles"}
+                 "profiles", "podInitialBackoffSeconds", "podMaxBackoffSeconds"}
     for k in raw:
         if k not in known_top:
             raise ConfigError(f"unknown field {k!r} in {KIND}")
@@ -147,11 +147,34 @@ def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
         qps=float(cc.get("qps", 5.0)), burst=int(cc.get("burst", 10)),
         kubeconfig=str(cc.get("kubeconfig", "")))
 
+    # upstream podInitialBackoffSeconds / podMaxBackoffSeconds (component
+    # config level, scheduler defaults 1/10). Upstream shares one queue
+    # across profiles; here each profile owns a queue, so the config-level
+    # value is stamped onto every decoded profile. None = absent (use
+    # defaults); explicit 0 is honored (retry immediately). Validation is
+    # against the EFFECTIVE values, so a configured max below the 1 s
+    # default initial is rejected, not silently exceeded.
+    raw_init = raw.get("podInitialBackoffSeconds")
+    raw_max = raw.get("podMaxBackoffSeconds")
+    init_backoff = None if raw_init is None else float(raw_init)
+    max_backoff = None if raw_max is None else float(raw_max)
+    eff_init = 1.0 if init_backoff is None else init_backoff
+    eff_max = 10.0 if max_backoff is None else max_backoff
+    if eff_init < 0 or eff_max < 0:
+        raise ConfigError("pod backoff seconds must be >= 0")
+    if eff_max < eff_init:
+        raise ConfigError(
+            f"podMaxBackoffSeconds ({eff_max}) must be >= "
+            f"podInitialBackoffSeconds ({eff_init})")
+
     profiles = raw.get("profiles")
     if not profiles:
         raise ConfigError("config must declare at least one profile")
     for p in profiles:
-        cfg.profiles.append(_decode_profile(p, version))
+        prof = _decode_profile(p, version)
+        prof.pod_initial_backoff_s = init_backoff
+        prof.pod_max_backoff_s = max_backoff
+        cfg.profiles.append(prof)
     names = [p.scheduler_name for p in cfg.profiles]
     if len(set(names)) != len(names):
         raise ConfigError(f"duplicate schedulerName in profiles: {names}")
@@ -284,7 +307,7 @@ def encode(cfg: SchedulerConfiguration) -> Dict[str, Any]:
                 {"name": n, "args": _encode_args(a)}
                 for n, a in sorted(p.plugin_args.items())]
         profiles.append(prof)
-    return {
+    out: Dict[str, Any] = {
         "apiVersion": V1BETA1,
         "kind": KIND,
         "leaderElection": {
@@ -299,6 +322,15 @@ def encode(cfg: SchedulerConfiguration) -> Dict[str, Any]:
         },
         "profiles": profiles,
     }
+    # config-level backoff (stamped identically on every profile at decode;
+    # emit from the first — None = unset stays absent, explicit 0 survives)
+    if cfg.profiles:
+        first = cfg.profiles[0]
+        if first.pod_initial_backoff_s is not None:
+            out["podInitialBackoffSeconds"] = first.pod_initial_backoff_s
+        if first.pod_max_backoff_s is not None:
+            out["podMaxBackoffSeconds"] = first.pod_max_backoff_s
+    return out
 
 
 def _encode_args(args: Any) -> Dict[str, Any]:

@@ -68,6 +68,13 @@ class PluginProfile:
     # threads for the per-node Filter/Score sweeps; 0 = min(16, cpu count),
     # 1 = fully serial (deterministic single-threaded scan)
     parallelism: int = 0
+    # upstream podInitialBackoffSeconds / podMaxBackoffSeconds (scheduler
+    # defaults 1s / 10s): the retry backoff a failed pod serves before it
+    # may be popped again. None = use the defaults; an explicit 0 means
+    # retry immediately (upstream allows it, so it must not be conflated
+    # with "unset")
+    pod_initial_backoff_s: Optional[float] = None
+    pod_max_backoff_s: Optional[float] = None
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]
