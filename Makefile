@@ -22,7 +22,7 @@ tpu-test:
 
 .PHONY: bench
 bench:
-	$(PY) bench.py
+	$(PY) bench.py --gate
 
 # Native C++ engine (torus placement math). Also auto-built when the
 # TopologyMatch plugin constructs (native.load() warm-up); this target just
