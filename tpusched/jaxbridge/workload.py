@@ -55,6 +55,11 @@ class ModelConfig:
     # tp and compute the loss in logsumexp form so the (b, s, V) logits are
     # never replicated — the HBM win that makes large-vocab models fit.
     vocab_parallel_loss: bool = False
+    # gradient checkpointing: wrap each decoder block in jax.checkpoint so
+    # the backward pass recomputes block activations instead of storing
+    # them — O(layers) residuals instead of O(layers × block internals),
+    # the HBM trade that fits ~1B-param AdamW training on a 16 GB chip
+    remat: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -85,6 +90,22 @@ class ModelConfig:
         return ModelConfig(vocab=32000, d_model=1024, n_layers=8, n_heads=8,
                            d_ff=2816, seq=seq, dtype=jnp.bfloat16,
                            n_kv_heads=2)
+
+    @staticmethod
+    def llama_like_big(seq: int = 4096) -> "ModelConfig":
+        """The representative single-chip config: ~0.67B params (embed+out
+        131M, 12 layers × 45.1M — wq/wo 4.19M each, GQA wk/wv 1.05M each,
+        SwiGLU 34.6M), Llama-3 proportions with 4:1 GQA. Sized so AdamW
+        training fits a 16 GB v5e WITH optimizer state AND the slope-timing
+        harness's loop-carry double buffering: bf16 params 1.35 GB + f32 mu
+        2.7 GB + bf16 nu 1.35 GB ≈ 5.4 GB of state — ~2× that across a
+        fori_loop carry boundary, plus bf16 grads 1.35 GB and remat'd
+        activations at seq 4096, stays under 16 GB (a 16-layer/0.85B
+        variant ResourceExhausts exactly there)."""
+        return ModelConfig(vocab=32000, d_model=2048, n_layers=12,
+                           n_heads=16, d_ff=5632, seq=seq,
+                           dtype=jnp.bfloat16, n_kv_heads=4,
+                           attn="flash", remat=True)
 
     @staticmethod
     def mixtral_like(seq: int = 2048, n_experts: int = 8) -> "ModelConfig":
@@ -308,9 +329,14 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         # instead rotates K/V around the sp ring explicitly, see
         # make_sharded_train_step)
         x = jax.lax.with_sharding_constraint(x, act_spec)
+    blk = functools.partial(_block, cfg=cfg, attn_fn=attn_fn, ep_spec=ep_spec)
+    if cfg.remat:
+        # rematerialize each block in backward: cfg/attn_fn/ep_spec bound
+        # in the closure, (x, layer) trace as the checkpointed args
+        blk = jax.checkpoint(blk)
     aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x, aux = _block(x, layer, cfg, attn_fn, ep_spec)
+        x, aux = blk(x, layer)
         aux_total = aux_total + aux
         if act_spec is not None:
             x = jax.lax.with_sharding_constraint(x, act_spec)
