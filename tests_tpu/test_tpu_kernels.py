@@ -134,3 +134,125 @@ def test_ring_flash_lowers_on_chip(tpu):
         ring(q, k, v).astype(jnp.float32) ** 2), argnums=(0, 1, 2)))(q, k, v)
     for a in g:
         assert bool(jnp.isfinite(a.astype(jnp.float32)).all())
+
+
+def test_moe_block_parity_on_chip(tpu):
+    """MoE (GShard dispatch) fwd + bwd on hardware vs the SAME computation
+    on CPU: top-k routing, capacity cumsum, and the dispatch/combine
+    einsums must survive the real lowering with matching math (f32 routing
+    makes device-vs-host drift small)."""
+    import dataclasses
+    from tpusched.jaxbridge.workload import init_params, loss_fn
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), n_experts=4, moe_top_k=2)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (4, cfg.seq),
+                                0, cfg.vocab, dtype=jnp.int32)
+    loss_tpu, grads_tpu = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        loss_cpu, grads_cpu = jax.jit(
+            jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
+    assert abs(float(loss_tpu) - float(loss_cpu)) < 5e-3
+    flat_t = jax.tree_util.tree_leaves(grads_tpu)
+    flat_c = jax.tree_util.tree_leaves(grads_cpu)
+    for a, b in zip(flat_t, flat_c):
+        assert _rel_err(a, b) < 5e-2
+
+
+def test_seq8192_flash_backward_on_chip(tpu):
+    """Long-context backward at seq 8192 on hardware: the naive path cannot
+    materialize the 8192² score matrices here, so parity is kernel-vs-
+    kernel across block tilings (a mis-tiled bwd kernel disagrees with
+    itself under a different block split) plus finiteness."""
+    q, k, v = _qkv(jax.random.PRNGKey(7), b=1, s=8192, h=4, kv=1)
+
+    def loss(bq, bk):
+        return lambda q, k, v: jnp.sum(
+            attention.flash_attention(q, k, v, True, bq, bk)
+            .astype(jnp.float32) ** 2)
+
+    g1 = jax.jit(jax.grad(loss(512, 1024), argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(loss(256, 512), argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), g1, g2):
+        assert bool(jnp.isfinite(a.astype(jnp.float32)).all()), name
+        assert _rel_err(a, b) < 3e-2, name
+
+
+def test_adamw_step_on_chip(tpu):
+    """Full AdamW (optax, f32 mu over bf16 params) training on hardware:
+    the measure_adamw_train_step body — loss must be finite and decrease."""
+    import dataclasses
+    import functools
+    import optax
+    from tpusched.jaxbridge.workload import init_params, loss_fn
+
+    cfg = dataclasses.replace(
+        ModelConfig(vocab=1024, d_model=256, n_layers=2, n_heads=4,
+                    d_ff=512, seq=512, dtype=jnp.bfloat16, n_kv_heads=2),
+        attn="flash", remat=True)
+    tx = optax.adamw(1e-3, mu_dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(8), cfg)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (4, cfg.seq),
+                                0, cfg.vocab, dtype=jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, t):
+        loss, g = jax.value_and_grad(loss_fn)(p, t, cfg)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_remat_parity_on_chip(tpu):
+    """jax.checkpoint'ed blocks on hardware: same loss and gradients as the
+    stored-activation path (remat must change memory, never math)."""
+    import dataclasses
+    from tpusched.jaxbridge.workload import init_params, loss_fn
+
+    base = dataclasses.replace(
+        ModelConfig(vocab=1024, d_model=256, n_layers=2, n_heads=4,
+                    d_ff=512, seq=512, dtype=jnp.bfloat16, n_kv_heads=2),
+        attn="flash")
+    cfg_r = dataclasses.replace(base, remat=True)
+    params = init_params(jax.random.PRNGKey(10), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (2, base.seq),
+                                0, base.vocab, dtype=jnp.int32)
+    l0, g0 = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, tokens, base)))(params)
+    l1, g1 = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg_r)))(params)
+    assert abs(float(l0) - float(l1)) < 1e-3
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        assert _rel_err(a, b) < 1e-2
+
+
+def test_vocab_parallel_loss_on_chip(tpu):
+    """Tensor-parallel cross-entropy (logsumexp form, vocab sharded over
+    tp) on a 1-device tp mesh equals the plain gather-based loss — the
+    HBM-saving loss path must not change the number it computes."""
+    import dataclasses
+    from jax.sharding import Mesh
+    from tpusched.jaxbridge.workload import (init_params,
+                                             make_sharded_train_step)
+    from tpusched.jaxbridge.workload import loss_fn
+
+    base = dataclasses.replace(ModelConfig.tiny(), dtype=jnp.bfloat16)
+    cfg_vp = dataclasses.replace(base, vocab_parallel_loss=True)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    params = init_params(jax.random.PRNGKey(12), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(13), (2, base.seq),
+                                0, base.vocab, dtype=jnp.int32)
+    want = float(loss_fn(params, tokens, base))
+    step, pshard, tshard = make_sharded_train_step(mesh, cfg_vp)
+    _, got = step(jax.device_put(params, pshard),
+                  jax.device_put(tokens, tshard))
+    assert abs(float(got) - want) < 5e-3
