@@ -195,11 +195,23 @@ class APIServer:
         return objs
 
     def update(self, kind: str, obj) -> Any:
+        """PUT. Optimistic concurrency (the kube-apiserver contract the
+        reference's controllers retry against): a non-zero
+        ``metadata.resourceVersion`` that does not match the stored object
+        is rejected with Conflict — the caller's copy is stale and must be
+        re-read. Divergence, documented in doc/develop.md: RV 0 (an object
+        never read from this store) is accepted as "no precondition",
+        where the real apiserver rejects empty-RV PUTs for built-ins."""
         with self._lock:
             key = obj.meta.key
             old = self._stores[kind].get(key)
             if old is None:
                 raise NotFound(f"{kind} {key} not found")
+            if (obj.meta.resource_version
+                    and obj.meta.resource_version != old.meta.resource_version):
+                raise Conflict(
+                    f"{kind} {key}: stale resourceVersion "
+                    f"{obj.meta.resource_version} != {old.meta.resource_version}")
             stored = obj.deepcopy()
             stored.meta.creation_timestamp = old.meta.creation_timestamp
             stored.meta.uid = old.meta.uid
