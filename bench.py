@@ -421,16 +421,20 @@ def bench_scale() -> None:
         times, "scale_per_pod_p99")
 
 
-def run_fleet_gang_once() -> float:
+def fleet_gang_times(repeats: int) -> list:
     """The composed fleet case: a 256-pod slice gang selects among 16 pools /
     1024 hosts, with partially-occupied pools, topology CRs, and a LIVE
-    freed-window claim held by a rival gang (its hosts must be avoided)."""
+    freed-window claim held by a rival gang (its hosts must be avoided).
+    The fleet (12 bound fill gangs, 3072 pods) is built ONCE; each repeat
+    schedules a fresh measured gang and deletes it afterwards — the steady
+    state an always-on scheduler actually runs in."""
     from tpusched.api.resources import TPU, make_resources
     from tpusched.apiserver import server as srv
     from tpusched.config.profiles import tpu_gang_profile
     from tpusched.testing import (TestCluster, make_pod, make_pod_group,
-                                  make_tpu_pool)
+                                  make_tpu_pool, wait_until)
 
+    times = []
     with TestCluster(profile=tpu_gang_profile()) as c:
         pools = []
         for i in range(16):
@@ -455,46 +459,71 @@ def run_fleet_gang_once() -> float:
             fill_keys.extend(p.key for p in ps)
         if not c.wait_for_pods_scheduled(fill_keys, timeout=240):
             raise RuntimeError("fleet fill gangs did not schedule")
-        # a live freed-window claim from a rival gang over one free pool:
-        # the measured gang must route around those hosts
         tm = c.scheduler._fw.plugins.get("TopologyMatch")
-        claim_topo, claim_nodes = pools[12]
-        tm._window_claims.set(
-            "default/rival-gang",
-            (claim_topo.key, frozenset(n.name for n in claim_nodes)),
-            ttl=120)
-
-        c.api.create(srv.POD_GROUPS, make_pod_group(
-            "fleet-gang", min_member=256, tpu_slice_shape="8x8x4",
-            tpu_accelerator="tpu-v5p"))
-        pods = [make_pod(f"fleet-{i:03d}", pod_group="fleet-gang",
-                         limits={TPU: 1},
-                         requests=make_resources(cpu=4, memory="8Gi"))
-                for i in range(256)]
-        start = time.perf_counter()
-        c.create_pods(pods)
-        if not c.wait_for_pods_scheduled([p.key for p in pods], timeout=120):
-            raise RuntimeError("fleet gang did not schedule")
-        elapsed = time.perf_counter() - start
-        # the gang must have landed on ONE pool, and not the claimed one
+        # claim a pool the fill left FREE (the scheduler's tie-break decides
+        # which 12 pools filled): a claim on an occupied pool could never
+        # influence placement and the route-around scenario would be vacuous
+        filled = {"-".join(c.pod(k).spec.node_name.split("-")[:2])
+                  for k in fill_keys}
+        free_pools = [(t, ns) for t, ns in pools if t.spec.pool not in filled]
+        if len(free_pools) != 4:
+            raise RuntimeError(f"expected 4 free pools, got "
+                               f"{[t.spec.pool for t, _ in free_pools]}")
+        claim_topo, claim_nodes = free_pools[0]
         claimed = {n.name for n in claim_nodes}
-        used_pools = set()
-        for p in pods:
-            node = c.pod(p.key).spec.node_name
-            if node in claimed:
-                raise RuntimeError("gang violated a live freed-window claim")
-            used_pools.add("-".join(node.split("-")[:2]))  # "pool-NN-x-y-z"
-        if len(used_pools) != 1:
-            raise RuntimeError(f"gang spanned pools: {used_pools}")
-        return elapsed
+
+        for rep in range(repeats + 1):           # +1 warmup
+            # (re)assert the rival's freed-window claim over one free pool:
+            # the measured gang must route around those hosts
+            tm._window_claims.set(
+                "default/rival-gang",
+                (claim_topo.key, frozenset(claimed)), ttl=120)
+            name = f"fleet-{rep:02d}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=256, tpu_slice_shape="8x8x4",
+                tpu_accelerator="tpu-v5p"))
+            pods = [make_pod(f"{name}-{i:03d}", pod_group=name,
+                             limits={TPU: 1},
+                             requests=make_resources(cpu=4, memory="8Gi"))
+                    for i in range(256)]
+            start = time.perf_counter()
+            c.create_pods(pods)
+            if not c.wait_for_pods_scheduled([p.key for p in pods],
+                                             timeout=120):
+                raise RuntimeError("fleet gang did not schedule")
+            elapsed = time.perf_counter() - start
+            # the gang must land on ONE pool, and not the claimed one
+            used_pools = set()
+            for p in pods:
+                node = c.pod(p.key).spec.node_name
+                if node in claimed:
+                    raise RuntimeError(
+                        "gang violated a live freed-window claim")
+                used_pools.add("-".join(node.split("-")[:2]))
+            if len(used_pools) != 1:
+                raise RuntimeError(f"gang spanned pools: {used_pools}")
+            if rep > 0:
+                times.append(elapsed)
+            # tear down the measured gang; wait until its hosts free up
+            for p in pods:
+                c.api.delete(srv.PODS, p.key)
+            c.api.delete(srv.POD_GROUPS, f"default/{name}")
+            if not wait_until(
+                    lambda: not any(inf.pods for inf in
+                                    c.scheduler.cache.snapshot().list()
+                                    if inf.node.name.startswith(
+                                        tuple(used_pools))),
+                    timeout=30):
+                raise RuntimeError("measured gang did not tear down")
+    return times
 
 
 def bench_fleet_gang() -> None:
-    times = _repeat(run_fleet_gang_once, SUPP_REPEATS)
+    times = fleet_gang_times(SUPP_REPEATS)
     emit_latency(
         "256-pod gang PodGroup-to-Bound p99 at FLEET scale: 16 pools / 1024 "
         "hosts, 12 pools occupied (3072 resident pods), live freed-window "
-        "claim to route around",
+        "claim to route around (one fleet, fresh gang per sample)",
         times, "fleet_gang_p99")
 
 

@@ -423,3 +423,18 @@ def test_snapshot_assigned_count_incremental():
     # node re-add re-attaches the still-known bound pod
     c.add_node(make_tpu_node("n2", chips=4))
     assert c.snapshot().assigned_count("gang", "default") == 2
+
+
+# -- PreFilterResult.NodeNames analog (CycleState.restrict_nodes) -------------
+
+def test_restrict_nodes_intersects_and_clones():
+    from tpusched.fwk import CycleState
+    s = CycleState()
+    assert s.restricted_node_names is None
+    s.restrict_nodes(["a", "b", "c"])
+    s.restrict_nodes({"b", "c", "d"})
+    assert s.restricted_node_names == {"b", "c"}
+    c = s.clone()
+    c.restrict_nodes({"b"})
+    assert s.restricted_node_names == {"b", "c"}   # clone is isolated
+    assert c.restricted_node_names == {"b"}

@@ -271,6 +271,11 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 f"no feasible {pg.spec.tpu_slice_shape} slice placement "
                 f"in any pool")
         state.write(_STATE_KEY, stash)
+        # PreFilterResult.NodeNames analog: only hosts inside a surviving
+        # placement can take this pod — hand the scheduler the exact
+        # candidate set so the per-node sweep never visits the rest of the
+        # fleet (the Filter membership check stays as the correctness net)
+        state.restrict_nodes(stash.allowed.keys())
         return Status.success()
 
     def _grid(self, topo) -> Optional[Tuple[HostGrid, MaskGrid]]:

@@ -367,6 +367,15 @@ class Scheduler:
             return "", s
 
         infos = snapshot.list()
+        # PreFilterResult.NodeNames (upstream findNodesThatPassFilters):
+        # a PreFilter that resolved the only viable hosts narrows the sweep
+        rset = state.restricted_node_names
+        if rset is not None:
+            infos = [i for i in infos if i.node.name in rset]
+            if not infos:
+                return "", Status.unschedulable(
+                    f"0/{num_nodes} nodes are available: none match the "
+                    "PreFilter node set")
         want = self._num_feasible_nodes_to_find(len(infos))
         feasible, diagnosis, error = self._timed_point(
             "Filter", self._find_feasible, state, pod, infos, want)
