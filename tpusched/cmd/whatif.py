@@ -1,0 +1,65 @@
+"""The what-if binary: capacity simulation against saved control-plane state.
+
+No reference analog (the reference has no way to ask "would this gang fit"
+without submitting it); composes the durability layer with the simulator:
+point it at a scheduler's ``--state-dir`` and it answers from the exact
+state the fleet last persisted, without touching it.
+
+    python -m tpusched.cmd.whatif --state-dir /var/lib/tpusched \\
+        --slice-shape 4x4x4 --members 16 --chips 4 --namespace team-b \\
+        --allow-preemption
+
+Prints ONE JSON report: feasible, per-pod placements + chip coordinates,
+the pool chosen, and — with --allow-preemption — the exact pods slice
+preemption would evict.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-whatif",
+        description="dry-run gang admission against saved cluster state")
+    p.add_argument("--state-dir", required=True,
+                   help="scheduler --state-dir to load the shadow state from")
+    p.add_argument("--members", type=int, required=True,
+                   help="gang size (PodGroup minMember)")
+    p.add_argument("--slice-shape", default="",
+                   help="ICI slice shape, e.g. 4x4x4 (empty: no slice fitting)")
+    p.add_argument("--accelerator", default="",
+                   help="required accelerator, e.g. tpu-v5p (empty: any)")
+    p.add_argument("--chips", type=int, default=1,
+                   help="google.com/tpu chips per pod")
+    p.add_argument("--cpu", type=int, default=4, help="CPUs per pod")
+    p.add_argument("--memory", default="8Gi", help="memory per pod")
+    p.add_argument("--namespace", default="default",
+                   help="namespace (quota team) the gang belongs to")
+    p.add_argument("--priority", type=int, default=0, help="pod priority")
+    p.add_argument("--allow-preemption", action="store_true",
+                   help="run the full-stack profile: report which pods "
+                        "slice/quota preemption would evict to fit the gang")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="seconds to wait before declaring infeasible")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..sim import simulate_gang
+    report = simulate_gang(
+        state_dir=args.state_dir, members=args.members,
+        slice_shape=args.slice_shape, accelerator=args.accelerator,
+        chips_per_pod=args.chips, cpu_per_pod=args.cpu,
+        memory_per_pod=args.memory, namespace=args.namespace,
+        priority=args.priority, allow_preemption=args.allow_preemption,
+        timeout_s=args.timeout)
+    print(json.dumps(report.to_dict()))
+    return 0 if report.feasible else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from .whatif import WhatIfReport, simulate_gang  # noqa: F401
