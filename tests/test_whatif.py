@@ -106,3 +106,115 @@ def test_whatif_cli_runs_from_state_dir(tmp_path):
     assert out.returncode == 0, out.stderr
     report = json.loads(out.stdout.strip().splitlines()[-1])
     assert report["feasible"] and len(report["placements"]) == 16
+
+
+def test_whatif_plan_sequential_capacity():
+    """simulate_plan: jobs share one shadow. On a 128-chip pool: gang 1
+    (64 chips) fits; gang 2 wants the WHOLE pool (4x4x8) and must report
+    infeasible; gang 3 (64 chips) still fits in the remainder — proving
+    the failed job was withdrawn and did not poison the plan; gang 4
+    finds the pool full."""
+    from tpusched.sim import simulate_plan
+    with TestCluster() as c:
+        _cluster_with_pool(c, dims=(4, 4, 8))      # 128 chips
+        gang = dict(members=16, slice_shape="4x4x4",
+                    accelerator="tpu-v5p", chips_per_pod=4)
+        whole_pool = dict(members=32, slice_shape="4x4x8",
+                          accelerator="tpu-v5p", chips_per_pod=4)
+        reports = simulate_plan(
+            source_api=c.api,
+            jobs=[dict(gang), dict(whole_pool), dict(gang), dict(gang)],
+            timeout_s=6)
+        assert [r.feasible for r in reports] == [True, False, True, False]
+        # the two admitted slice gangs landed on disjoint host sets
+        h0 = set(reports[0].placements.values())
+        h2 = set(reports[2].placements.values())
+        assert h0 and h2 and not (h0 & h2)
+        assert reports[1].reason and reports[3].reason  # diagnoses surfaced
+        # source untouched throughout
+        assert c.api.list(srv.PODS) == []
+
+
+def test_whatif_plan_cli(tmp_path):
+    from tpusched.apiserver import APIServer
+    from tpusched.apiserver.persistence import attach
+
+    api = APIServer()
+    journal = attach(api, str(tmp_path / "state"))
+    try:
+        with TestCluster(api=api) as c:
+            _cluster_with_pool(c, dims=(4, 4, 8))
+        assert journal.flush(timeout=10)
+    finally:
+        journal.close()
+    plan = tmp_path / "plan.json"
+    gang = {"members": 16, "slice_shape": "4x4x4",
+            "accelerator": "tpu-v5p", "chips_per_pod": 4}
+    plan.write_text(json.dumps([gang, gang, gang]))
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path / "state"), "--plan", str(plan),
+         "--timeout", "6"],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 1                      # third job does not fit
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert [r["feasible"] for r in lines] == [True, True, False]
+
+
+def test_whatif_plan_validates_up_front():
+    from tpusched.sim import simulate_plan
+    import pytest as _pytest
+    with TestCluster() as c:
+        _cluster_with_pool(c)
+        with _pytest.raises(ValueError, match="unknown keys"):
+            simulate_plan(source_api=c.api,
+                          jobs=[{"members": 4, "chips": 4}])   # CLI-flag typo
+        with _pytest.raises(ValueError, match="members"):
+            simulate_plan(source_api=c.api, jobs=[{"slice_shape": "2x2x1"}])
+        with _pytest.raises(ValueError, match="duplicate"):
+            simulate_plan(source_api=c.api,
+                          jobs=[{"members": 4, "name": "j"},
+                                {"members": 4, "name": "j"}])
+
+
+def test_whatif_plan_failed_preemption_attempt_is_unwound():
+    """An infeasible preempting job must not leave phantom free capacity:
+    any pods its attempt evicted are restored, and the next job's report
+    shows the true (preemption-requiring) cost."""
+    from tpusched.sim import simulate_plan
+    with TestCluster(profile=full_stack_profile(permit_wait_s=20,
+                                                denied_s=1)) as c:
+        _cluster_with_pool(c, dims=(4, 4, 8))      # 128 chips
+        for team in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 64}, max={TPU: 128}))
+        for g in ("a-first", "a-borrow"):
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                g, namespace="team-a", min_member=16,
+                tpu_slice_shape="4x4x4", tpu_accelerator="tpu-v5p"))
+            ps = [make_pod(f"{g}-{i}", namespace="team-a", pod_group=g,
+                           limits={TPU: 4}) for i in range(16)]
+            c.create_pods(ps)
+            assert c.wait_for_pods_scheduled([p.key for p in ps], timeout=30)
+
+        # job 0: team-b wants the WHOLE pool — preemption can evict team-a's
+        # borrowed window but can never break team-a's min, so it fails
+        # (after evicting a window it must restore); job 1: a one-window
+        # team-b gang — feasible, and its report must name 16 victims
+        # (proof the failed attempt's evictions were restored: without the
+        # restore, job 1 would find a free window and report victims=[])
+        reports = simulate_plan(
+            source_api=c.api, allow_preemption=True, timeout_s=8,
+            jobs=[dict(members=32, slice_shape="4x4x8",
+                       accelerator="tpu-v5p", chips_per_pod=4,
+                       namespace="team-b"),
+                  dict(members=16, slice_shape="4x4x4",
+                       accelerator="tpu-v5p", chips_per_pod=4,
+                       namespace="team-b")])
+        assert [r.feasible for r in reports] == [False, True]
+        assert reports[0].victims == []             # unwound
+        assert len(reports[1].victims) == 16        # true admission cost
+        assert reports[1].displaced_plan_pods == []
+        # source untouched
+        assert len([p for p in c.api.list(srv.PODS)
+                    if p.spec.node_name]) == 32

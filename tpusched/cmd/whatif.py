@@ -26,8 +26,14 @@ def build_parser() -> argparse.ArgumentParser:
         description="dry-run gang admission against saved cluster state")
     p.add_argument("--state-dir", required=True,
                    help="scheduler --state-dir to load the shadow state from")
-    p.add_argument("--members", type=int, required=True,
-                   help="gang size (PodGroup minMember)")
+    p.add_argument("--plan", metavar="JOBS_JSON",
+                   help="plan a QUEUE instead of one gang: path to a JSON "
+                        "array of job objects (simulate_gang gang kwargs); "
+                        "jobs share one shadow, so each sees the capacity "
+                        "earlier jobs consumed. Prints one report per job; "
+                        "exit 0 iff every job fits")
+    p.add_argument("--members", type=int,
+                   help="gang size (PodGroup minMember); required without --plan")
     p.add_argument("--slice-shape", default="",
                    help="ICI slice shape, e.g. 4x4x4 (empty: no slice fitting)")
     p.add_argument("--accelerator", default="",
@@ -49,7 +55,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from ..sim import simulate_gang
+    from ..sim import simulate_gang, simulate_plan
+    if args.plan:
+        with open(args.plan, encoding="utf-8") as f:
+            jobs = json.load(f)
+        reports = simulate_plan(state_dir=args.state_dir, jobs=jobs,
+                                allow_preemption=args.allow_preemption,
+                                timeout_s=args.timeout)
+        for r in reports:
+            print(json.dumps(r.to_dict()))
+        return 0 if all(r.feasible for r in reports) else 1
+    if args.members is None:
+        build_parser().error("--members is required without --plan")
     report = simulate_gang(
         state_dir=args.state_dir, members=args.members,
         slice_shape=args.slice_shape, accelerator=args.accelerator,

@@ -1,1 +1,1 @@
-from .whatif import WhatIfReport, simulate_gang  # noqa: F401
+from .whatif import WhatIfReport, simulate_gang, simulate_plan  # noqa: F401

@@ -48,9 +48,13 @@ class WhatIfReport:
     placements: Dict[str, str]          # pod key → node name
     pool: str                           # pool the gang landed in ("" if none)
     coords: Dict[str, str]              # pod key → chip coordinate annotation
-    victims: List[str]                  # pre-existing pods evicted to fit
+    victims: List[str]                  # REAL pre-existing pods evicted
     elapsed_s: float
     reason: str                         # FailedScheduling detail if infeasible
+    # plan mode only: pods of EARLIER hypothetical plan jobs this job
+    # displaced (simulation artifacts, never real workloads — kept separate
+    # from victims so a script acting on evictions cannot confuse them)
+    displaced_plan_pods: List[str] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -72,6 +76,79 @@ def _shadow_of(source_api: Optional[APIServer],
     return shadow
 
 
+def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
+             slice_shape: str, accelerator: str, chips_per_pod: int,
+             cpu_per_pod: int, memory_per_pod: str, priority: int,
+             timeout_s: float,
+             hypothetical: frozenset = frozenset()
+             ) -> "tuple[WhatIfReport, List[str]]":
+    """Inject one hypothetical gang into a live shadow. Returns the report
+    plus the exact pod keys created (for plan-mode withdrawal).
+    ``hypothetical``: pod keys belonging to earlier plan jobs — evictions
+    of those are reported as displaced_plan_pods, not victims."""
+    pre_existing = {p.meta.key for p in shadow.list(srv.PODS)}
+    shadow.create(srv.POD_GROUPS, PodGroup(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        spec=PodGroupSpec(min_member=members,
+                          tpu_slice_shape=slice_shape,
+                          tpu_accelerator=accelerator)))
+    pods: List[Pod] = []
+    from ..testing.wrappers import make_pod
+    for i in range(members):
+        pods.append(make_pod(
+            f"{name}-{i:03d}", namespace=namespace, pod_group=name,
+            limits={TPU: chips_per_pod},
+            requests=make_resources(cpu=cpu_per_pod,
+                                    memory=memory_per_pod),
+            priority=priority))
+    start = time.perf_counter()
+    for p in pods:
+        shadow.create(srv.PODS, p)
+
+    keys = [p.key for p in pods]
+    deadline = time.monotonic() + timeout_s
+    feasible = False
+    while time.monotonic() < deadline:
+        live = [shadow.peek(srv.PODS, k) for k in keys]
+        if all(p is not None and assigned(p) for p in live):
+            feasible = True
+            break
+        time.sleep(0.02)
+    elapsed = time.perf_counter() - start
+
+    placements: Dict[str, str] = {}
+    coords: Dict[str, str] = {}
+    pool = ""
+    if feasible:
+        for k in keys:
+            p = shadow.peek(srv.PODS, k)
+            placements[k] = p.spec.node_name
+            coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
+            pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
+    gone = pre_existing - {p.meta.key for p in shadow.list(srv.PODS)}
+    victims = sorted(gone - hypothetical)
+    displaced = sorted(gone & hypothetical)
+    reason = ""
+    if not feasible:
+        # the scheduler's own diagnosis, newest first
+        for ev in reversed(shadow.events()):
+            if ev.reason == "FailedScheduling" and ev.object_key in keys:
+                reason = ev.message
+                break
+    return WhatIfReport(feasible=feasible, placements=placements,
+                        pool=pool, coords=coords, victims=victims,
+                        elapsed_s=round(elapsed, 4), reason=reason,
+                        displaced_plan_pods=displaced), keys
+
+
+def _make_profile(allow_preemption: bool, timeout_s: float):
+    return (canned.full_stack_profile(permit_wait_s=int(timeout_s),
+                                      denied_s=1)
+            if allow_preemption else
+            canned.tpu_gang_profile(permit_wait_s=int(timeout_s),
+                                    denied_s=1))
+
+
 def simulate_gang(source_api: Optional[APIServer] = None,
                   state_dir: Optional[str] = None, *,
                   name: str = "whatif-gang",
@@ -91,65 +168,110 @@ def simulate_gang(source_api: Optional[APIServer] = None,
     ``timeout_s`` elapses (feasible=False, with the scheduler's own
     FailedScheduling diagnosis as ``reason``)."""
     shadow = _shadow_of(source_api, state_dir)
-    pre_existing = {p.meta.key for p in shadow.list(srv.PODS)}
-
-    profile = (canned.full_stack_profile(permit_wait_s=int(timeout_s),
-                                         denied_s=1)
-               if allow_preemption else
-               canned.tpu_gang_profile(permit_wait_s=int(timeout_s),
-                                       denied_s=1))
-    sched = Scheduler(shadow, default_registry(), profile)
+    sched = Scheduler(shadow, default_registry(),
+                      _make_profile(allow_preemption, timeout_s))
     sched.run()
     try:
-        shadow.create(srv.POD_GROUPS, PodGroup(
-            meta=ObjectMeta(name=name, namespace=namespace),
-            spec=PodGroupSpec(min_member=members,
-                              tpu_slice_shape=slice_shape,
-                              tpu_accelerator=accelerator)))
-        pods: List[Pod] = []
-        from ..testing.wrappers import make_pod
-        for i in range(members):
-            pods.append(make_pod(
-                f"{name}-{i:03d}", namespace=namespace, pod_group=name,
-                limits={TPU: chips_per_pod},
-                requests=make_resources(cpu=cpu_per_pod,
-                                        memory=memory_per_pod),
-                priority=priority))
-        start = time.perf_counter()
-        for p in pods:
-            shadow.create(srv.PODS, p)
+        report, _ = _run_one(shadow, name=name, namespace=namespace,
+                             members=members, slice_shape=slice_shape,
+                             accelerator=accelerator,
+                             chips_per_pod=chips_per_pod,
+                             cpu_per_pod=cpu_per_pod,
+                             memory_per_pod=memory_per_pod,
+                             priority=priority, timeout_s=timeout_s)
+        return report
+    finally:
+        sched.stop()
 
-        keys = [p.key for p in pods]
-        deadline = time.monotonic() + timeout_s
-        feasible = False
-        while time.monotonic() < deadline:
-            live = [shadow.peek(srv.PODS, k) for k in keys]
-            if all(p is not None and assigned(p) for p in live):
-                feasible = True
-                break
-            time.sleep(0.02)
-        elapsed = time.perf_counter() - start
 
-        placements: Dict[str, str] = {}
-        coords: Dict[str, str] = {}
-        pool = ""
-        if feasible:
-            for k in keys:
-                p = shadow.peek(srv.PODS, k)
-                placements[k] = p.spec.node_name
-                coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
-                pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
-        victims = sorted(pre_existing
-                         - {p.meta.key for p in shadow.list(srv.PODS)})
-        reason = ""
-        if not feasible:
-            # the scheduler's own diagnosis, newest first
-            for ev in reversed(shadow.events()):
-                if ev.reason == "FailedScheduling" and ev.object_key in keys:
-                    reason = ev.message
-                    break
-        return WhatIfReport(feasible=feasible, placements=placements,
-                            pool=pool, coords=coords, victims=victims,
-                            elapsed_s=round(elapsed, 4), reason=reason)
+def simulate_plan(source_api: Optional[APIServer] = None,
+                  state_dir: Optional[str] = None, *,
+                  jobs: List[dict],
+                  allow_preemption: bool = False,
+                  timeout_s: float = 30.0) -> List[WhatIfReport]:
+    """Plan a QUEUE of gangs on ONE shared shadow: job N is admitted into
+    the capacity jobs 0..N-1 already consumed — the "will my whole batch
+    land, and in what order does it stop fitting" question. Each ``jobs``
+    entry is a dict of gang kwargs (members required; name, namespace,
+    slice_shape, accelerator, chips_per_pod, cpu_per_pod, memory_per_pod,
+    priority optional); an unnamed job gets ``plan-<index>``. The whole
+    plan is validated before anything runs (unknown keys, duplicate or
+    colliding names, missing members fail fast with a ValueError naming
+    the job). An infeasible job is withdrawn — its own pods/PodGroup
+    deleted by exact key AND any pre-existing pods its preemption attempt
+    evicted restored — so one oversized job does not poison the rest of
+    the plan. A feasible job's pods later displaced by a preempting job
+    show up in that job's ``displaced_plan_pods`` (never ``victims``)."""
+    gang_keys = {"name", "namespace", "members", "slice_shape",
+                 "accelerator", "chips_per_pod", "cpu_per_pod",
+                 "memory_per_pod", "priority"}
+    shadow = _shadow_of(source_api, state_dir)
+    seen_names = set()
+    normalized: List[dict] = []
+    for i, job in enumerate(jobs):
+        bad = set(job) - gang_keys
+        if bad:
+            raise ValueError(f"plan job {i}: unknown keys {sorted(bad)} "
+                             f"(allowed: {sorted(gang_keys)})")
+        if "members" not in job:
+            raise ValueError(f"plan job {i}: 'members' is required")
+        kw = dict(name=f"plan-{i:02d}", namespace="default",
+                  slice_shape="", accelerator="", chips_per_pod=1,
+                  cpu_per_pod=4, memory_per_pod="8Gi", priority=0)
+        kw.update(job)
+        full = f"{kw['namespace']}/{kw['name']}"
+        if full in seen_names:
+            raise ValueError(f"plan job {i}: duplicate name {full!r}")
+        if shadow.try_get(srv.POD_GROUPS, full) is not None:
+            raise ValueError(f"plan job {i}: name {full!r} collides with an "
+                             "existing PodGroup in the source state")
+        seen_names.add(full)
+        normalized.append(kw)
+
+    sched = Scheduler(shadow, default_registry(),
+                      _make_profile(allow_preemption, timeout_s))
+    sched.run()
+    reports: List[WhatIfReport] = []
+    plan_pods: set = set()
+    try:
+        for kw in normalized:
+            before = {p.meta.key: p for p in shadow.list(srv.PODS)}
+            r, keys = _run_one(shadow, timeout_s=timeout_s,
+                               hypothetical=frozenset(plan_pods), **kw)
+            reports.append(r)
+            if r.feasible:
+                plan_pods.update(keys)
+                plan_pods -= set(r.displaced_plan_pods)
+            else:
+                # withdraw the failed gang by EXACT key...
+                for k in keys:
+                    try:
+                        shadow.delete(srv.PODS, k)
+                    except srv.NotFound:
+                        pass
+                try:
+                    shadow.delete(
+                        srv.POD_GROUPS, f"{kw['namespace']}/{kw['name']}")
+                except srv.NotFound:
+                    pass
+                # ...and restore anything its preemption attempt evicted,
+                # or later jobs would plan against phantom free capacity
+                live = {p.meta.key for p in shadow.list(srv.PODS)}
+                own = set(keys)
+                restored = 0
+                for k, obj in before.items():
+                    if k not in live and k not in own:
+                        obj.meta.resource_version = 0   # fresh write
+                        shadow.create(srv.PODS, obj)
+                        restored += 1
+                # the report describes the PLANNED state: nothing this
+                # failed attempt evicted stays evicted, so nothing may be
+                # listed as a victim (the count survives in the reason)
+                if restored:
+                    r.reason = (f"{r.reason} [attempt evicted {restored} "
+                                "pods; all restored]").strip()
+                r.victims = []
+                r.displaced_plan_pods = []
+        return reports
     finally:
         sched.stop()
