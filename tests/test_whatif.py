@@ -218,3 +218,22 @@ def test_whatif_plan_failed_preemption_attempt_is_unwound():
         # source untouched
         assert len([p for p in c.api.list(srv.PODS)
                     if p.spec.node_name]) == 32
+
+
+def test_whatif_cli_rejects_plan_flag_mix(tmp_path):
+    plan = tmp_path / "p.json"
+    plan.write_text(json.dumps([{"members": 4}]))
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path), "--plan", str(plan),
+         "--members", "16"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2
+    assert "--members" in out.stderr and "--plan" in out.stderr
+    # non-array plan file fails fast too
+    plan.write_text(json.dumps({"members": 4}))
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path), "--plan", str(plan)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2 and "JSON array" in out.stderr

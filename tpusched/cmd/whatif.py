@@ -57,8 +57,24 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ..sim import simulate_gang, simulate_plan
     if args.plan:
+        # single-gang flags don't apply to a plan (each job carries its own
+        # kwargs); silently ignoring them would simulate the wrong question
+        parser = build_parser()
+        defaults = {a.dest: a.default for a in parser._actions}
+        conflicting = [f"--{d.replace('_', '-')}"
+                       for d in ("members", "slice_shape", "accelerator",
+                                 "chips", "cpu", "memory", "namespace",
+                                 "priority")
+                       if getattr(args, d) != defaults.get(d)]
+        if conflicting:
+            parser.error(
+                f"{', '.join(conflicting)} cannot be combined with --plan; "
+                "set them per job in the plan file")
         with open(args.plan, encoding="utf-8") as f:
             jobs = json.load(f)
+        if not isinstance(jobs, list) or not all(
+                isinstance(j, dict) for j in jobs):
+            parser.error(f"{args.plan}: must be a JSON array of job objects")
         reports = simulate_plan(state_dir=args.state_dir, jobs=jobs,
                                 allow_preemption=args.allow_preemption,
                                 timeout_s=args.timeout)

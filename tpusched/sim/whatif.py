@@ -195,20 +195,27 @@ def simulate_plan(source_api: Optional[APIServer] = None,
     entry is a dict of gang kwargs (members required; name, namespace,
     slice_shape, accelerator, chips_per_pod, cpu_per_pod, memory_per_pod,
     priority optional); an unnamed job gets ``plan-<index>``. The whole
-    plan is validated before anything runs (unknown keys, duplicate or
-    colliding names, missing members fail fast with a ValueError naming
-    the job). An infeasible job is withdrawn — its own pods/PodGroup
-    deleted by exact key AND any pre-existing pods its preemption attempt
-    evicted restored — so one oversized job does not poison the rest of
-    the plan. A feasible job's pods later displaced by a preempting job
-    show up in that job's ``displaced_plan_pods`` (never ``victims``)."""
+    plan is validated before anything runs (non-dict entries, unknown keys,
+    duplicate or colliding names/pod keys, missing members fail fast with
+    a ValueError naming the job). An infeasible job is withdrawn — its own
+    pods/PodGroup deleted by exact key AND any pre-existing pods its
+    preemption attempt evicted restored behind a scheduler-stop barrier —
+    so one oversized job does not poison the rest of the plan. A feasible
+    job's pods later displaced by a preempting job show up in that job's
+    ``displaced_plan_pods`` (never ``victims``)."""
     gang_keys = {"name", "namespace", "members", "slice_shape",
                  "accelerator", "chips_per_pod", "cpu_per_pod",
                  "memory_per_pod", "priority"}
+    if not isinstance(jobs, list):
+        raise ValueError(f"jobs must be a list of job objects, "
+                         f"got {type(jobs).__name__}")
     shadow = _shadow_of(source_api, state_dir)
     seen_names = set()
     normalized: List[dict] = []
     for i, job in enumerate(jobs):
+        if not isinstance(job, dict):
+            raise ValueError(f"plan job {i}: expected an object of gang "
+                             f"kwargs, got {type(job).__name__}")
         bad = set(job) - gang_keys
         if bad:
             raise ValueError(f"plan job {i}: unknown keys {sorted(bad)} "
@@ -225,37 +232,52 @@ def simulate_plan(source_api: Optional[APIServer] = None,
         if shadow.try_get(srv.POD_GROUPS, full) is not None:
             raise ValueError(f"plan job {i}: name {full!r} collides with an "
                              "existing PodGroup in the source state")
+        for j in range(int(kw["members"])):
+            pk = f"{kw['namespace']}/{kw['name']}-{j:03d}"
+            if shadow.peek(srv.PODS, pk) is not None:
+                raise ValueError(f"plan job {i}: pod key {pk!r} collides "
+                                 "with an existing pod in the source state")
         seen_names.add(full)
         normalized.append(kw)
 
-    sched = Scheduler(shadow, default_registry(),
-                      _make_profile(allow_preemption, timeout_s))
+    profile = _make_profile(allow_preemption, timeout_s)
+    sched = Scheduler(shadow, default_registry(), profile)
     sched.run()
     reports: List[WhatIfReport] = []
     plan_pods: set = set()
     try:
         for kw in normalized:
-            before = {p.meta.key: p for p in shadow.list(srv.PODS)}
+            # `before` is only needed to undo a failed PREEMPTING job's
+            # evictions; without preemption nothing can be evicted, so the
+            # O(pods) deepcopy per iteration is skipped
+            before = ({p.meta.key: p for p in shadow.list(srv.PODS)}
+                      if allow_preemption else {})
             r, keys = _run_one(shadow, timeout_s=timeout_s,
                                hypothetical=frozenset(plan_pods), **kw)
             reports.append(r)
             if r.feasible:
                 plan_pods.update(keys)
                 plan_pods -= set(r.displaced_plan_pods)
-            else:
-                # withdraw the failed gang by EXACT key...
-                for k in keys:
-                    try:
-                        shadow.delete(srv.PODS, k)
-                    except srv.NotFound:
-                        pass
+                continue
+            if allow_preemption:
+                # hard quiescence barrier: an in-flight retry cycle could
+                # otherwise evict victims AFTER the restore below, leaving
+                # phantom free capacity for later jobs
+                sched.stop()
+            # withdraw the failed gang by EXACT key...
+            for k in keys:
                 try:
-                    shadow.delete(
-                        srv.POD_GROUPS, f"{kw['namespace']}/{kw['name']}")
+                    shadow.delete(srv.PODS, k)
                 except srv.NotFound:
                     pass
-                # ...and restore anything its preemption attempt evicted,
-                # or later jobs would plan against phantom free capacity
+            try:
+                shadow.delete(
+                    srv.POD_GROUPS, f"{kw['namespace']}/{kw['name']}")
+            except srv.NotFound:
+                pass
+            if allow_preemption:
+                # ...restore anything its preemption attempt evicted, then
+                # bring a fresh scheduler up over the repaired state
                 live = {p.meta.key for p in shadow.list(srv.PODS)}
                 own = set(keys)
                 restored = 0
@@ -272,6 +294,8 @@ def simulate_plan(source_api: Optional[APIServer] = None,
                                 "pods; all restored]").strip()
                 r.victims = []
                 r.displaced_plan_pods = []
+                sched = Scheduler(shadow, default_registry(), profile)
+                sched.run()
         return reports
     finally:
         sched.stop()
