@@ -22,6 +22,7 @@ from tpusched.apiserver import server as srv
 from tpusched.config.profiles import full_stack_profile
 from tpusched.api.scheduling import POD_GROUP_LABEL
 from tpusched.plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
+from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION
 from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
                               make_pod_group, make_tpu_pool, wait_until)
 
@@ -50,8 +51,7 @@ def _check_invariants(c, gangs):
             f"I1 violated on {node}: {used} chips (seed {SEED})"
         indexes = []
         for pp in pods:
-            ann = pp.meta.annotations.get(
-                "tpuslice.scheduling.tpu.dev/chip-index", "")
+            ann = pp.meta.annotations.get(CHIP_INDEX_ANNOTATION, "")
             indexes.extend(i for i in ann.split(",") if i)
         assert len(indexes) == len(set(indexes)), \
             f"I2 violated on {node}: {indexes} (seed {SEED})"

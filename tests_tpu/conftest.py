@@ -14,10 +14,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
-def _tpu_available() -> bool:
+def _tpu_available(timeout_s: float = 240.0) -> bool:
+    """Probe in a SUBPROCESS with a hard timeout: a wedged axon tunnel (a
+    killed client whose device claim hasn't expired) hangs jax backend init
+    indefinitely — probing in-process would hang the whole tier instead of
+    skipping it (same pattern as bench.py's _tpu_alive)."""
+    import subprocess
     try:
-        import jax
-        return jax.default_backend() == "tpu"
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            timeout=timeout_s, capture_output=True, text=True)
+        lines = r.stdout.strip().splitlines()
+        return bool(lines) and lines[-1] == "tpu"   # exact backend match
     except Exception:
         return False
 

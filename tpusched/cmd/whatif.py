@@ -54,18 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     from ..sim import simulate_gang, simulate_plan
     if args.plan:
         # single-gang flags don't apply to a plan (each job carries its own
         # kwargs); silently ignoring them would simulate the wrong question
-        parser = build_parser()
-        defaults = {a.dest: a.default for a in parser._actions}
         conflicting = [f"--{d.replace('_', '-')}"
                        for d in ("members", "slice_shape", "accelerator",
                                  "chips", "cpu", "memory", "namespace",
                                  "priority")
-                       if getattr(args, d) != defaults.get(d)]
+                       if getattr(args, d) != parser.get_default(d)]
         if conflicting:
             parser.error(
                 f"{', '.join(conflicting)} cannot be combined with --plan; "
@@ -82,7 +81,7 @@ def main(argv=None) -> int:
             print(json.dumps(r.to_dict()))
         return 0 if all(r.feasible for r in reports) else 1
     if args.members is None:
-        build_parser().error("--members is required without --plan")
+        parser.error("--members is required without --plan")
     report = simulate_gang(
         state_dir=args.state_dir, members=args.members,
         slice_shape=args.slice_shape, accelerator=args.accelerator,

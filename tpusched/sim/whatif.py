@@ -220,8 +220,9 @@ def simulate_plan(source_api: Optional[APIServer] = None,
         if bad:
             raise ValueError(f"plan job {i}: unknown keys {sorted(bad)} "
                              f"(allowed: {sorted(gang_keys)})")
-        if "members" not in job:
-            raise ValueError(f"plan job {i}: 'members' is required")
+        if not isinstance(job.get("members"), int) or job["members"] < 1:
+            raise ValueError(f"plan job {i}: 'members' must be a positive "
+                             f"integer, got {job.get('members')!r}")
         kw = dict(name=f"plan-{i:02d}", namespace="default",
                   slice_shape="", accelerator="", chips_per_pod=1,
                   cpu_per_pod=4, memory_per_pod="8Gi", priority=0)
@@ -232,7 +233,7 @@ def simulate_plan(source_api: Optional[APIServer] = None,
         if shadow.try_get(srv.POD_GROUPS, full) is not None:
             raise ValueError(f"plan job {i}: name {full!r} collides with an "
                              "existing PodGroup in the source state")
-        for j in range(int(kw["members"])):
+        for j in range(kw["members"]):
             pk = f"{kw['namespace']}/{kw['name']}-{j:03d}"
             if shadow.peek(srv.PODS, pk) is not None:
                 raise ValueError(f"plan job {i}: pod key {pk!r} collides "
