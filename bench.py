@@ -153,6 +153,12 @@ def run_gang_once(state_dir: str | None = None, fsync: bool = False) -> float:
 
 def bench_gang() -> None:
     times = _repeat(run_gang_once, GANG_REPEATS)
+    # BASELINE metric "TPU chip bin-pack %": run_gang_once RAISES unless the
+    # gang lands on exactly 64 hosts x 4 chips, so surviving n runs proves
+    # zero chip stranding on every one of them
+    emit("TPU chip bin-pack at the headline gang: 256 chips on exactly 64 "
+         f"hosts, 4/4 chips per host, asserted on all {len(times)} runs",
+         1.0, "fraction", 1.0)
     emit_latency(
         "256-pod gang PodGroup-to-Bound p99 "
         "(Coscheduling+TpuSlice, emulated v5p pool, 64 hosts)",

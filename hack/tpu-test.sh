@@ -9,4 +9,6 @@
 set -o errexit -o nounset -o pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
-exec python -m pytest tests_tpu/ -q "$@"
+# per-test timeout guard is in tests_tpu/conftest.py (subprocess probe);
+# the outer timeout bounds a wedged-tunnel hang of the whole tier
+exec timeout --signal=INT --kill-after=60 3600 python -m pytest tests_tpu/ -q "$@"
