@@ -558,8 +558,7 @@ def bench_tpu_workload() -> None:
              "probe timed out or reported non-tpu — a wedged axon tunnel "
              "device claim hangs backend init indefinitely). Last measured "
              "values with the same methodology are recorded in "
-             "doc/performance.md: 155M flash 0.65 MFU, seq-8192 0.65, "
-             "0.67B AdamW+remat 0.55 MFU, decode 18-20k tok/s",
+             "doc/performance.md (TPU-side table)",
              None, "", None)
         return
     import jax

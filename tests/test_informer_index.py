@@ -94,3 +94,61 @@ def test_handler_exceptions_isolated_during_replay():
 
     pods.add_event_handler(on_add=bad_then_record)   # must not raise
     assert sorted(seen) == ["p1", "p2"]
+
+
+def test_informer_close_detaches_from_watch_fanout():
+    """client-go watch-Stop analog: after close(), the informer's cache is
+    frozen and its handlers receive nothing; a fresh informer on the same
+    server still sees the full state (replay)."""
+    from tpusched.apiserver import APIServer
+    from tpusched.apiserver import server as srv
+    from tpusched.apiserver.informers import InformerFactory
+    from tpusched.testing import make_pod
+
+    api = APIServer()
+    api.create(srv.PODS, make_pod("before"))
+    f1 = InformerFactory(api)
+    inf1 = f1.pods()
+    seen = []
+    inf1.add_event_handler(on_add=lambda p: seen.append(p.meta.name))
+    assert seen == ["before"]
+    f1.close()
+    api.create(srv.PODS, make_pod("after"))
+    assert seen == ["before"]                  # no post-close delivery
+    assert inf1.get("default/after") is None   # cache frozen
+    # a new factory on the same server replays everything
+    f2 = InformerFactory(api)
+    assert {p.meta.name for p in f2.pods().items()} == {"before", "after"}
+    f2.close()
+
+
+def test_stopped_scheduler_stops_consuming_events():
+    """A stopped scheduler's informers detach: subsequent writes reach only
+    the live scheduler (the HA fail-over / what-if restart hygiene)."""
+    from tpusched.apiserver import APIServer
+    from tpusched.apiserver import server as srv
+    from tpusched.api.resources import TPU
+    from tpusched.plugins import default_registry
+    from tpusched.sched import Scheduler
+    from tpusched.testing import make_pod, make_tpu_node, wait_until
+    from tpusched.testing.cluster import default_profile
+
+    api = APIServer()
+    s1 = Scheduler(api, default_registry(), default_profile())
+    s1.run()
+    live = len(api._handlers[srv.PODS])
+    assert live >= 1
+    s1.stop()
+    assert len(api._handlers[srv.PODS]) == 0   # fully detached
+    s2 = Scheduler(api, default_registry(), default_profile())
+    s2.run()
+    try:
+        api.create(srv.NODES, make_tpu_node("n1", chips=4))
+        api.create(srv.PODS, make_pod("p", limits={TPU: 1}))
+        assert wait_until(
+            lambda: (api.peek(srv.PODS, "default/p") or make_pod("x")
+                     ).spec.node_name, timeout=10)
+        # s1's informers are detached; only s2's (same count) remain
+        assert len(api._handlers[srv.PODS]) == live
+    finally:
+        s2.stop()

@@ -438,3 +438,18 @@ def test_restrict_nodes_intersects_and_clones():
     c.restrict_nodes({"b"})
     assert s.restricted_node_names == {"b", "c"}   # clone is isolated
     assert c.restricted_node_names == {"b"}
+
+
+def test_pending_counts_exclude_backoff_tombstones():
+    """The pending_pods{queue=backoff} gauge counts live entries only —
+    activate() tombstones a backoff entry in place, and the tombstone must
+    not show as a pending pod until the heap happens to drain."""
+    q = SchedulingQueue(prio_less)
+    pod = make_pod("p")
+    info = QueuedPodInfo(pod)
+    info.attempts = 5                    # long backoff so it stays parked
+    q.requeue_after_failure(info, to_backoff=True)
+    assert q.pending_counts()["backoff"] == 1
+    q.activate([pod])                    # tombstones the heap entry
+    assert q.pop(timeout=0.5) is not None
+    assert q.pending_counts()["backoff"] == 0

@@ -165,6 +165,15 @@ class Informer:
     def has_synced(self) -> bool:
         return True  # in-memory watches are synchronous
 
+    def close(self) -> None:
+        """Detach from the API server's watch fan-out and drop handlers —
+        after this the informer's cache is frozen and it receives nothing."""
+        self._api.remove_watch(self.kind, self._handle)
+        with self._lock:
+            self._on_add.clear()
+            self._on_update.clear()
+            self._on_delete.clear()
+
 
 class InformerFactory:
     """SharedInformerFactory analog: one shared Informer per kind."""
@@ -173,9 +182,15 @@ class InformerFactory:
         self._api = api
         self._lock = threading.Lock()
         self._informers: Dict[str, Informer] = {}
+        self._closed = False
 
     def informer(self, kind: str) -> Informer:
         with self._lock:
+            if self._closed:
+                # a lazily-created informer on a closed factory would
+                # re-register a watch handler nobody will ever remove
+                raise RuntimeError(
+                    "InformerFactory is closed (owner stopped)")
             if kind not in self._informers:
                 self._informers[kind] = Informer(self._api, kind)
             return self._informers[kind]
@@ -191,3 +206,12 @@ class InformerFactory:
 
     def wait_for_cache_sync(self) -> None:
         return  # synchronous watches: always synced
+
+    def close(self) -> None:
+        """Close every shared informer and refuse new ones (factory
+        Shutdown analog). Idempotent."""
+        with self._lock:
+            self._closed = True
+            informers, self._informers = list(self._informers.values()), {}
+        for inf in informers:
+            inf.close()

@@ -147,6 +147,18 @@ class APIServer:
             for o in existing:
                 handler(WatchEvent(ADDED, kind, o))
 
+    def remove_watch(self, kind: str, handler: Callable[[WatchEvent], None]) -> None:
+        """Deregister a watch handler (client-go watch Stop analog): a
+        stopped component must not keep receiving events — without this a
+        long-lived process restarting schedulers (HA fail-over, the what-if
+        planner's stop/restore/restart barrier) accumulates dead handlers
+        that are invoked on every write forever."""
+        with self._lock:
+            try:
+                self._handlers[kind].remove(handler)
+            except ValueError:
+                pass
+
     # -- CRUD -----------------------------------------------------------------
 
     # Write-path sharing discipline: stored objects are never mutated in

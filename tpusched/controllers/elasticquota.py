@@ -63,6 +63,10 @@ class ElasticQuotaController:
         self.queue.shut_down()
         for t in self._threads:
             t.join(timeout=5)
+        # detach from the watch fan-out: a stopped controller (e.g. after
+        # losing the leader lease) must not keep enqueueing into a queue
+        # no worker drains
+        self.informers.close()
 
     def _worker(self) -> None:
         while not self._stop.is_set():

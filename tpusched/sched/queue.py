@@ -142,8 +142,10 @@ class SchedulingQueue:
         kube-scheduler metric). (pending_pods() below returns the pod
         objects themselves — the introspection API.)"""
         with self._lock:
+            # _backoff_keys counts LIVE entries; len(_backoff) would also
+            # count tombstones left by activate() until the heap drains
             return {"active": len(self._active),
-                    "backoff": len(self._backoff),
+                    "backoff": sum(self._backoff_keys.values()),
                     "unschedulable": len(self._unschedulable)}
 
     # -- producers ------------------------------------------------------------

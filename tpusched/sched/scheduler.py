@@ -266,6 +266,11 @@ class Scheduler:
         self._bind_pool.shutdown(timeout=5.0)
         self._par.close()
         self._fw.close()
+        # detach this scheduler's informers from the API server's watch
+        # fan-out: a stopped scheduler must not keep consuming every write
+        # (HA fail-over and the what-if planner restart schedulers against
+        # a live server)
+        self.informer_factory.close()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
