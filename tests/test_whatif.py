@@ -237,3 +237,76 @@ def test_whatif_cli_rejects_plan_flag_mix(tmp_path):
          "--state-dir", str(tmp_path), "--plan", str(plan)],
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 2 and "JSON array" in out.stderr
+
+
+def test_whatif_with_production_config_profile():
+    """--config: the shadow runs the EXACT decoded production wiring (the
+    shipped full-stack manifest), not a canned profile."""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = os.path.join(repo, "manifests", "full", "scheduler-config.yaml")
+    with TestCluster() as c:
+        _cluster_with_pool(c)
+        r = simulate_gang(source_api=c.api, members=16,
+                          slice_shape="4x4x4", accelerator="tpu-v5p",
+                          chips_per_pod=4, timeout_s=25,
+                          config_path=cfg)
+        assert r.feasible and len(r.placements) == 16
+
+
+def test_whatif_config_with_custom_scheduler_name():
+    """A --config profile with a non-default schedulerName must still
+    simulate: hypothetical pods are stamped with the profile's name (a
+    mismatch would make every simulation falsely infeasible)."""
+    import textwrap
+    cfg_yaml = textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        profiles:
+        - schedulerName: prod-sched
+          plugins:
+            queueSort:
+              enabled: [{name: Coscheduling}]
+              disabled: [{name: "*"}]
+            preFilter:
+              enabled: [{name: Coscheduling}, {name: TopologyMatch}]
+            filter:
+              enabled: [{name: TopologyMatch}, {name: TpuSlice}]
+            postFilter:
+              enabled: [{name: Coscheduling}]
+            score:
+              enabled: [{name: TpuSlice, weight: 1}]
+            reserve:
+              enabled: [{name: TpuSlice}, {name: TopologyMatch},
+                        {name: Coscheduling}]
+            permit:
+              enabled: [{name: Coscheduling}]
+            bind:
+              enabled: [{name: TpuSlice}]
+              disabled: [{name: DefaultBinder}]
+    """)
+    import tempfile, os
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(cfg_yaml)
+        path = f.name
+    try:
+        with TestCluster() as c:
+            _cluster_with_pool(c)
+            r = simulate_gang(source_api=c.api, members=16,
+                              slice_shape="4x4x4", accelerator="tpu-v5p",
+                              chips_per_pod=4, timeout_s=25,
+                              config_path=path,
+                              scheduler_name="prod-sched")
+            assert r.feasible and len(r.placements) == 16
+    finally:
+        os.unlink(path)
+
+
+def test_whatif_cli_scheduler_name_requires_config(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path), "--members", "4",
+         "--scheduler-name", "prod"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2 and "--config" in out.stderr

@@ -50,12 +50,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "slice/quota preemption would evict to fit the gang")
     p.add_argument("--timeout", type=float, default=30.0,
                    help="seconds to wait before declaring infeasible")
+    p.add_argument("--config", default=None,
+                   help="TpuSchedulerConfiguration YAML: simulate with the "
+                        "EXACT profile production runs instead of a canned "
+                        "one (--allow-preemption is then ignored)")
+    p.add_argument("--scheduler-name", default=None,
+                   help="which profile in --config to simulate with")
     return p
 
 
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.scheduler_name and not args.config:
+        parser.error("--scheduler-name requires --config")
     from ..sim import simulate_gang, simulate_plan
     if args.plan:
         # single-gang flags don't apply to a plan (each job carries its own
@@ -76,7 +84,9 @@ def main(argv=None) -> int:
             parser.error(f"{args.plan}: must be a JSON array of job objects")
         reports = simulate_plan(state_dir=args.state_dir, jobs=jobs,
                                 allow_preemption=args.allow_preemption,
-                                timeout_s=args.timeout)
+                                timeout_s=args.timeout,
+                                config_path=args.config,
+                                scheduler_name=args.scheduler_name)
         for r in reports:
             print(json.dumps(r.to_dict()))
         return 0 if all(r.feasible for r in reports) else 1
@@ -88,7 +98,8 @@ def main(argv=None) -> int:
         chips_per_pod=args.chips, cpu_per_pod=args.cpu,
         memory_per_pod=args.memory, namespace=args.namespace,
         priority=args.priority, allow_preemption=args.allow_preemption,
-        timeout_s=args.timeout)
+        timeout_s=args.timeout, config_path=args.config,
+        scheduler_name=args.scheduler_name)
     print(json.dumps(report.to_dict()))
     return 0 if report.feasible else 1
 

@@ -79,7 +79,7 @@ def _shadow_of(source_api: Optional[APIServer],
 def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
              slice_shape: str, accelerator: str, chips_per_pod: int,
              cpu_per_pod: int, memory_per_pod: str, priority: int,
-             timeout_s: float,
+             timeout_s: float, scheduler_name: str,
              hypothetical: frozenset = frozenset()
              ) -> "tuple[WhatIfReport, List[str]]":
     """Inject one hypothetical gang into a live shadow. Returns the report
@@ -100,7 +100,9 @@ def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
             limits={TPU: chips_per_pod},
             requests=make_resources(cpu=cpu_per_pod,
                                     memory=memory_per_pod),
-            priority=priority))
+            priority=priority,
+            # must match the shadow profile or it ignores every pod
+            scheduler_name=scheduler_name))
     start = time.perf_counter()
     for p in pods:
         shadow.create(srv.PODS, p)
@@ -141,7 +143,34 @@ def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
                         displaced_plan_pods=displaced), keys
 
 
-def _make_profile(allow_preemption: bool, timeout_s: float):
+def _profile_may_evict(profile) -> bool:
+    """Whether this profile's PostFilter chain can EVICT pods. Coscheduling's
+    PostFilter only denies gangs; every other shipped PostFilter
+    (CapacityScheduling, TopologyMatch slice preemption,
+    PreemptionToleration, CrossNodePreemption) drives an evictor — the
+    plan-mode restore/barrier machinery must key off THIS, not off the CLI
+    flag, or a --config profile with preemption enabled silently skips the
+    unwind."""
+    return any(name != "Coscheduling" for name in profile.post_filter)
+
+
+def _make_profile(allow_preemption: bool, timeout_s: float,
+                  config_path: Optional[str] = None,
+                  scheduler_name: Optional[str] = None):
+    """The shadow's profile: a canned one by default, or — so the simulator
+    answers with EXACTLY the wiring production runs — the profile decoded
+    from a TpuSchedulerConfiguration YAML (``config_path``; with several
+    profiles, ``scheduler_name`` picks one)."""
+    if config_path is not None:
+        from ..config import versioned
+        cfg = versioned.load_file(config_path)
+        if scheduler_name:
+            return cfg.profile(scheduler_name)  # raises ConfigError if absent
+        if len(cfg.profiles) > 1:
+            raise ValueError(
+                f"{config_path} declares {len(cfg.profiles)} profiles; "
+                "pass scheduler_name to pick one")
+        return cfg.profiles[0]
     return (canned.full_stack_profile(permit_wait_s=int(timeout_s),
                                       denied_s=1)
             if allow_preemption else
@@ -161,15 +190,20 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                   memory_per_pod: str = "8Gi",
                   priority: int = 0,
                   allow_preemption: bool = False,
-                  timeout_s: float = 30.0) -> WhatIfReport:
+                  timeout_s: float = 30.0,
+                  config_path: Optional[str] = None,
+                  scheduler_name: Optional[str] = None) -> WhatIfReport:
     """Dry-run one hypothetical gang against a shadow of the given state.
 
+    ``config_path``/``scheduler_name`` run the shadow with a production
+    TpuSchedulerConfiguration profile instead of the canned one.
     Returns once the gang is fully bound in the shadow (feasible=True) or
     ``timeout_s`` elapses (feasible=False, with the scheduler's own
     FailedScheduling diagnosis as ``reason``)."""
     shadow = _shadow_of(source_api, state_dir)
-    sched = Scheduler(shadow, default_registry(),
-                      _make_profile(allow_preemption, timeout_s))
+    profile = _make_profile(allow_preemption, timeout_s,
+                            config_path, scheduler_name)
+    sched = Scheduler(shadow, default_registry(), profile)
     sched.run()
     try:
         report, _ = _run_one(shadow, name=name, namespace=namespace,
@@ -178,7 +212,8 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                              chips_per_pod=chips_per_pod,
                              cpu_per_pod=cpu_per_pod,
                              memory_per_pod=memory_per_pod,
-                             priority=priority, timeout_s=timeout_s)
+                             priority=priority, timeout_s=timeout_s,
+                             scheduler_name=profile.scheduler_name)
         return report
     finally:
         sched.stop()
@@ -188,7 +223,9 @@ def simulate_plan(source_api: Optional[APIServer] = None,
                   state_dir: Optional[str] = None, *,
                   jobs: List[dict],
                   allow_preemption: bool = False,
-                  timeout_s: float = 30.0) -> List[WhatIfReport]:
+                  timeout_s: float = 30.0,
+                  config_path: Optional[str] = None,
+                  scheduler_name: Optional[str] = None) -> List[WhatIfReport]:
     """Plan a QUEUE of gangs on ONE shared shadow: job N is admitted into
     the capacity jobs 0..N-1 already consumed — the "will my whole batch
     land, and in what order does it stop fitting" question. Each ``jobs``
@@ -241,7 +278,11 @@ def simulate_plan(source_api: Optional[APIServer] = None,
         seen_names.add(full)
         normalized.append(kw)
 
-    profile = _make_profile(allow_preemption, timeout_s)
+    profile = _make_profile(allow_preemption, timeout_s,
+                            config_path, scheduler_name)
+    # the restore/barrier machinery keys off what the RESOLVED profile can
+    # do — a --config profile may enable preemption without the flag
+    may_evict = allow_preemption or _profile_may_evict(profile)
     sched = Scheduler(shadow, default_registry(), profile)
     sched.run()
     reports: List[WhatIfReport] = []
@@ -252,15 +293,16 @@ def simulate_plan(source_api: Optional[APIServer] = None,
             # evictions; without preemption nothing can be evicted, so the
             # O(pods) deepcopy per iteration is skipped
             before = ({p.meta.key: p for p in shadow.list(srv.PODS)}
-                      if allow_preemption else {})
+                      if may_evict else {})
             r, keys = _run_one(shadow, timeout_s=timeout_s,
+                               scheduler_name=profile.scheduler_name,
                                hypothetical=frozenset(plan_pods), **kw)
             reports.append(r)
             if r.feasible:
                 plan_pods.update(keys)
                 plan_pods -= set(r.displaced_plan_pods)
                 continue
-            if allow_preemption:
+            if may_evict:
                 # hard quiescence barrier: an in-flight retry cycle could
                 # otherwise evict victims AFTER the restore below, leaving
                 # phantom free capacity for later jobs
@@ -276,7 +318,7 @@ def simulate_plan(source_api: Optional[APIServer] = None,
                     srv.POD_GROUPS, f"{kw['namespace']}/{kw['name']}")
             except srv.NotFound:
                 pass
-            if allow_preemption:
+            if may_evict:
                 # ...restore anything its preemption attempt evicted, then
                 # bring a fresh scheduler up over the repaired state
                 live = {p.meta.key for p in shadow.list(srv.PODS)}
