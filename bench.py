@@ -511,16 +511,24 @@ def fleet_gang_times(repeats: int) -> list:
             if rep > 0:
                 times.append(elapsed)
             # tear down the measured gang; wait until its hosts free up
+            # (generous timeout: a cache ghost — assume racing a delete —
+            # self-expires at the 30 s assume TTL, and ambient load can
+            # stretch event processing; name the stragglers on failure)
             for p in pods:
                 c.api.delete(srv.PODS, p.key)
             c.api.delete(srv.POD_GROUPS, f"default/{name}")
-            if not wait_until(
-                    lambda: not any(inf.pods for inf in
-                                    c.scheduler.cache.snapshot().list()
-                                    if inf.node.name.startswith(
-                                        tuple(used_pools))),
-                    timeout=30):
-                raise RuntimeError("measured gang did not tear down")
+
+            last = []
+
+            def _drained():
+                last[:] = [p.key
+                           for inf in c.scheduler.cache.snapshot().list()
+                           if inf.node.name.startswith(tuple(used_pools))
+                           for p in inf.pods]
+                return not last
+            if not wait_until(_drained, timeout=90):
+                raise RuntimeError(
+                    f"measured gang did not tear down; lingering: {last[:8]}")
     return times
 
 

@@ -310,3 +310,14 @@ def test_whatif_cli_scheduler_name_requires_config(tmp_path):
          "--scheduler-name", "prod"],
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 2 and "--config" in out.stderr
+
+
+def test_whatif_cli_bad_config_exits_2_not_1(tmp_path):
+    """Operational errors (bad --config) must exit 2, never the exit 1 an
+    admission-control script reads as 'infeasible'."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path), "--members", "4",
+         "--config", str(tmp_path / "missing.yaml")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2, (out.returncode, out.stderr[-200:])

@@ -64,6 +64,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.scheduler_name and not args.config:
         parser.error("--scheduler-name requires --config")
+    from ..config.scheme import ConfigError
     from ..sim import simulate_gang, simulate_plan
     if args.plan:
         # single-gang flags don't apply to a plan (each job carries its own
@@ -82,24 +83,31 @@ def main(argv=None) -> int:
         if not isinstance(jobs, list) or not all(
                 isinstance(j, dict) for j in jobs):
             parser.error(f"{args.plan}: must be a JSON array of job objects")
-        reports = simulate_plan(state_dir=args.state_dir, jobs=jobs,
-                                allow_preemption=args.allow_preemption,
-                                timeout_s=args.timeout,
-                                config_path=args.config,
-                                scheduler_name=args.scheduler_name)
+        try:
+            reports = simulate_plan(state_dir=args.state_dir, jobs=jobs,
+                                    allow_preemption=args.allow_preemption,
+                                    timeout_s=args.timeout,
+                                    config_path=args.config,
+                                    scheduler_name=args.scheduler_name)
+        except (OSError, ValueError, ConfigError) as e:
+            # exit 2 = operational error; 1 is reserved for "infeasible"
+            parser.error(str(e))
         for r in reports:
             print(json.dumps(r.to_dict()))
         return 0 if all(r.feasible for r in reports) else 1
     if args.members is None:
         parser.error("--members is required without --plan")
-    report = simulate_gang(
-        state_dir=args.state_dir, members=args.members,
-        slice_shape=args.slice_shape, accelerator=args.accelerator,
-        chips_per_pod=args.chips, cpu_per_pod=args.cpu,
-        memory_per_pod=args.memory, namespace=args.namespace,
-        priority=args.priority, allow_preemption=args.allow_preemption,
-        timeout_s=args.timeout, config_path=args.config,
-        scheduler_name=args.scheduler_name)
+    try:
+        report = simulate_gang(
+            state_dir=args.state_dir, members=args.members,
+            slice_shape=args.slice_shape, accelerator=args.accelerator,
+            chips_per_pod=args.chips, cpu_per_pod=args.cpu,
+            memory_per_pod=args.memory, namespace=args.namespace,
+            priority=args.priority, allow_preemption=args.allow_preemption,
+            timeout_s=args.timeout, config_path=args.config,
+            scheduler_name=args.scheduler_name)
+    except (OSError, ValueError, ConfigError) as e:
+        parser.error(str(e))    # exit 2, not the "infeasible" exit 1
     print(json.dumps(report.to_dict()))
     return 0 if report.feasible else 1
 
