@@ -140,7 +140,33 @@ def test_moe_block_parity_on_chip(tpu):
     """MoE (GShard dispatch) fwd + bwd on hardware vs the SAME computation
     on CPU: top-k routing, capacity cumsum, and the dispatch/combine
     einsums must survive the real lowering with matching math (f32 routing
-    makes device-vs-host drift small)."""
+    makes device-vs-host drift small). The CPU reference runs in a
+    SUBPROCESS: under the pinned axon platform no in-process CPU backend
+    exists (JAX_PLATFORMS=axon), so cross-backend comparison goes through
+    scalars — loss plus per-leaf grad-norm fingerprints."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    prog = """
+import json, dataclasses, jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_platforms", "cpu")
+from tpusched.jaxbridge.workload import ModelConfig, init_params, loss_fn
+cfg = dataclasses.replace(ModelConfig.tiny(), n_experts=4, moe_top_k=2)
+params = init_params(jax.random.PRNGKey(5), cfg)
+tokens = jax.random.randint(jax.random.PRNGKey(6), (4, cfg.seq),
+                            0, cfg.vocab, dtype=jnp.int32)
+loss, grads = jax.jit(
+    jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
+norms = [float(jnp.linalg.norm(g.astype(jnp.float32)))
+         for g in jax.tree_util.tree_leaves(grads)]
+print(json.dumps({"loss": float(loss), "norms": norms}))
+"""
+    r = subprocess.run([_sys.executable, "-c", prog], capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-500:]
+    ref = json.loads(r.stdout.strip().splitlines()[-1])
+
     import dataclasses
     from tpusched.jaxbridge.workload import init_params, loss_fn
 
@@ -150,14 +176,12 @@ def test_moe_block_parity_on_chip(tpu):
                                 0, cfg.vocab, dtype=jnp.int32)
     loss_tpu, grads_tpu = jax.jit(
         jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
-    with jax.default_device(jax.local_devices(backend="cpu")[0]):
-        loss_cpu, grads_cpu = jax.jit(
-            jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
-    assert abs(float(loss_tpu) - float(loss_cpu)) < 5e-3
-    flat_t = jax.tree_util.tree_leaves(grads_tpu)
-    flat_c = jax.tree_util.tree_leaves(grads_cpu)
-    for a, b in zip(flat_t, flat_c):
-        assert _rel_err(a, b) < 5e-2
+    assert abs(float(loss_tpu) - ref["loss"]) < 5e-3
+    norms_tpu = [float(jnp.linalg.norm(g.astype(jnp.float32)))
+                 for g in jax.tree_util.tree_leaves(grads_tpu)]
+    assert len(norms_tpu) == len(ref["norms"])
+    for a, b in zip(norms_tpu, ref["norms"]):
+        assert abs(a - b) <= 5e-2 * max(abs(b), 1e-6)
 
 
 def test_seq8192_flash_backward_on_chip(tpu):
