@@ -1,0 +1,134 @@
+"""Defragmentation advisor: single-move migration plans on shadow state.
+
+The scenario the advisor exists for: total free chips suffice but no
+contiguous window does, and the advisor must find the one migration that
+(a) admits the blocked job AND (b) re-places the migrated gang — never a
+plan that orphans it."""
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.sim import suggest_migrations
+from tpusched.testing import TestCluster, make_pod, make_pod_group, make_tpu_pool
+
+import pytest
+
+
+def _pool(c, name="pool", dims=(4, 4, 4)):
+    topo, nodes = make_tpu_pool(name, dims=dims)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+
+
+def _gang(c, name, shape, members, namespace="default"):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, namespace=namespace, min_member=members,
+        tpu_slice_shape=shape, tpu_accelerator="tpu-v5p"))
+    ps = [make_pod(f"{name}-{i}", namespace=namespace, pod_group=name,
+                   limits={TPU: 4}) for i in range(members)]
+    c.create_pods(ps)
+    assert c.wait_for_pods_scheduled([p.key for p in ps], timeout=30)
+    return ps
+
+
+def test_advisor_finds_the_unfragmenting_move():
+    """Two pools. pool-a holds a small gang; pool-b is full. A pool-sized
+    target (4x4x4 on the 64-chip pool-a) is blocked ONLY by the small
+    gang — the advisor must name it, place the target on pool-a, and
+    re-home the small gang into pool-b's remaining space."""
+    with TestCluster() as c:
+        # deterministic fragmentation: pool-a exists ALONE when the small
+        # gang arrives, so it must land there and fragment it; the exactly-
+        # gang-sized re-home pool appears only afterwards
+        _pool(c, "pool-a", dims=(4, 4, 4))          # 64 chips
+        _gang(c, "small", "2x2x4", 4)               # 16 chips, in pool-a
+        _pool(c, "rehome", dims=(2, 2, 4))          # 16 chips, empty
+        # a contiguous 4x4x4 (the whole of pool-a) fits nowhere now
+        target = dict(members=16, slice_shape="4x4x4",
+                      accelerator="tpu-v5p", chips_per_pod=4)
+        from tpusched.sim import simulate_gang
+        blocked = simulate_gang(source_api=c.api, timeout_s=4, **target)
+        assert not blocked.feasible, "scenario must start blocked"
+        plans = suggest_migrations(source_api=c.api, job=target,
+                                   timeout_s=15)
+        assert len(plans) == 1
+        plan = plans[0]
+        assert plan.migrate == "default/small"
+        assert plan.migrate_chips == 16
+        assert plan.target.feasible and len(plan.target.placements) == 16
+        assert plan.target.pool == "pool-a"
+        assert plan.resubmitted.feasible
+        assert len(plan.resubmitted.placements) == 4
+        assert plan.resubmitted.pool == "rehome"
+        # the SOURCE cluster was never touched
+        assert len([p for p in c.api.list(srv.PODS)
+                    if p.spec.node_name]) == 4
+
+
+def test_advisor_returns_empty_when_no_single_move_helps():
+    """One full pool, target needs the whole pool: migrating any single
+    resident gang cannot re-home it anywhere (no second pool), so the
+    advisor must return no plan rather than an orphaning one."""
+    with TestCluster() as c:
+        _pool(c, "only", dims=(4, 4, 4))            # 64 chips
+        _gang(c, "a", "4x4x2", 8)                   # 32
+        _gang(c, "b", "4x4x2", 8)                   # 32 — pool full
+        target = dict(members=16, slice_shape="4x4x4",
+                      accelerator="tpu-v5p", chips_per_pod=4)
+        plans = suggest_migrations(source_api=c.api, job=target,
+                                   timeout_s=6)
+        assert plans == []
+
+
+def test_advisor_respects_candidate_restriction():
+    """Restricting candidates to a gang whose migration cannot help (or to
+    an unknown gang) yields no plan / a clear error — never a fallback to
+    gangs the caller excluded."""
+    with TestCluster() as c:
+        _pool(c, "pool-a", dims=(4, 4, 4))
+        _gang(c, "small", "2x2x4", 4)               # fragments pool-a
+        _pool(c, "rehome", dims=(2, 2, 4))
+        _gang(c, "other", "2x2x4", 4)               # fills the rehome pool
+        target = dict(members=16, slice_shape="4x4x4",
+                      accelerator="tpu-v5p", chips_per_pod=4)
+        # migrating `other` frees the rehome pool but pool-a stays
+        # fragmented by `small` — no plan from this candidate set
+        plans = suggest_migrations(source_api=c.api, job=target,
+                                   candidates=["default/other"],
+                                   timeout_s=6)
+        assert plans == []
+        with pytest.raises(ValueError, match="unknown candidate"):
+            suggest_migrations(source_api=c.api, job=target,
+                               candidates=["default/nope"], timeout_s=4)
+
+
+def test_advisor_cli(tmp_path):
+    """End-to-end: persisted fragmented state; the CLI reports infeasible
+    + a migration plan and exits 0."""
+    import json
+    import subprocess
+    import sys
+    from tpusched.apiserver import APIServer
+    from tpusched.apiserver.persistence import attach
+
+    api = APIServer()
+    journal = attach(api, str(tmp_path))
+    try:
+        with TestCluster(api=api) as c:
+            _pool(c, "pool-a", dims=(4, 4, 4))
+            _gang(c, "small", "2x2x4", 4)
+            _pool(c, "rehome", dims=(2, 2, 4))
+        assert journal.flush(timeout=10)
+    finally:
+        journal.close()
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.whatif",
+         "--state-dir", str(tmp_path), "--members", "16",
+         "--slice-shape", "4x4x4", "--accelerator", "tpu-v5p",
+         "--chips", "4", "--timeout", "10", "--suggest-migrations", "1"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-300:]
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert lines[0]["feasible"] is False
+    plan = lines[1]["migration_plan"]
+    assert plan["migrate"] == "default/small"
+    assert plan["target"]["feasible"] and plan["resubmitted"]["feasible"]

@@ -158,9 +158,14 @@ tokens = jax.random.randint(jax.random.PRNGKey(6), (4, cfg.seq),
                             0, cfg.vocab, dtype=jnp.int32)
 loss, grads = jax.jit(
     jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
-norms = [float(jnp.linalg.norm(g.astype(jnp.float32)))
-         for g in jax.tree_util.tree_leaves(grads)]
-print(json.dumps({"loss": float(loss), "norms": norms}))
+# norm + random-projection per leaf: the projection (fixed PRNG key) is
+# direction-sensitive, so permuted/sign-flipped gradients cannot alias
+fps = []
+for g in jax.tree_util.tree_leaves(grads):
+    g32 = g.astype(jnp.float32)
+    r = jax.random.normal(jax.random.PRNGKey(7), g.shape, jnp.float32)
+    fps.append([float(jnp.linalg.norm(g32)), float(jnp.vdot(g32, r))])
+print(json.dumps({"loss": float(loss), "fps": fps}))
 """
     r = subprocess.run([_sys.executable, "-c", prog], capture_output=True,
                        text=True, timeout=600)
@@ -177,11 +182,16 @@ print(json.dumps({"loss": float(loss), "norms": norms}))
     loss_tpu, grads_tpu = jax.jit(
         jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))(params)
     assert abs(float(loss_tpu) - ref["loss"]) < 5e-3
-    norms_tpu = [float(jnp.linalg.norm(g.astype(jnp.float32)))
-                 for g in jax.tree_util.tree_leaves(grads_tpu)]
-    assert len(norms_tpu) == len(ref["norms"])
-    for a, b in zip(norms_tpu, ref["norms"]):
-        assert abs(a - b) <= 5e-2 * max(abs(b), 1e-6)
+    fps_tpu = []
+    for g in jax.tree_util.tree_leaves(grads_tpu):
+        g32 = g.astype(jnp.float32)
+        r = jax.random.normal(jax.random.PRNGKey(7), g.shape, jnp.float32)
+        fps_tpu.append([float(jnp.linalg.norm(g32)),
+                        float(jnp.vdot(g32, r))])
+    assert len(fps_tpu) == len(ref["fps"])
+    for (na, pa), (nb, pb) in zip(fps_tpu, ref["fps"]):
+        assert abs(na - nb) <= 5e-2 * max(abs(nb), 1e-6)       # magnitude
+        assert abs(pa - pb) <= 5e-2 * max(abs(nb), abs(pb), 1e-6)  # direction
 
 
 def test_seq8192_flash_backward_on_chip(tpu):

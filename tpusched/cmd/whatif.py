@@ -56,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "one (--allow-preemption is then ignored)")
     p.add_argument("--scheduler-name", default=None,
                    help="which profile in --config to simulate with")
+    p.add_argument("--suggest-migrations", type=int, default=0,
+                   metavar="N",
+                   help="when the gang is infeasible, search for up to N "
+                        "single-gang migration plans that would admit it "
+                        "(defrag advisor, kep/302): each plan re-places the "
+                        "migrated gang too — exit 0 iff the gang fits or a "
+                        "plan exists")
     return p
 
 
@@ -72,7 +79,7 @@ def main(argv=None) -> int:
         conflicting = [f"--{d.replace('_', '-')}"
                        for d in ("members", "slice_shape", "accelerator",
                                  "chips", "cpu", "memory", "namespace",
-                                 "priority")
+                                 "priority", "suggest_migrations")
                        if getattr(args, d) != parser.get_default(d)]
         if conflicting:
             parser.error(
@@ -109,7 +116,29 @@ def main(argv=None) -> int:
     except (OSError, ValueError, ConfigError) as e:
         parser.error(str(e))    # exit 2, not the "infeasible" exit 1
     print(json.dumps(report.to_dict()))
-    return 0 if report.feasible else 1
+    if report.feasible:
+        return 0
+    if args.suggest_migrations > 0:
+        from ..sim import suggest_migrations
+        try:
+            plans = suggest_migrations(
+                state_dir=args.state_dir,
+                job=dict(members=args.members,
+                         slice_shape=args.slice_shape,
+                         accelerator=args.accelerator,
+                         chips_per_pod=args.chips, cpu_per_pod=args.cpu,
+                         memory_per_pod=args.memory,
+                         namespace=args.namespace,
+                         priority=args.priority),
+                max_suggestions=args.suggest_migrations,
+                timeout_s=args.timeout, config_path=args.config,
+                scheduler_name=args.scheduler_name)
+        except (OSError, ValueError, ConfigError) as e:
+            parser.error(str(e))
+        for plan in plans:
+            print(json.dumps({"migration_plan": plan.to_dict()}))
+        return 0 if plans else 1
+    return 1
 
 
 if __name__ == "__main__":

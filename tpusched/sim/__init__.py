@@ -1,1 +1,2 @@
 from .whatif import WhatIfReport, simulate_gang, simulate_plan  # noqa: F401
+from .defrag import MigrationSuggestion, suggest_migrations  # noqa: F401

@@ -1,0 +1,203 @@
+"""Defragmentation advisor: which gang migration would admit a blocked job.
+
+A torus fleet fragments: enough free chips exist in total, but no
+CONTIGUOUS window fits the next slice gang, and quota/priority rules make
+preemption unavailable (the victims are entitled to their capacity). The
+operator's question becomes: *which running gang should I migrate (delete
+and resubmit) so the blocked job fits — without losing the migrated gang?*
+
+The reference world has no answer short of trial-and-error on production.
+Here the advisor reuses the shadow machinery (KEP-302): for each candidate
+resident gang (smallest chip footprint first — cheapest migration first),
+fork a fresh shadow, remove the candidate, schedule the TARGET job first,
+then resubmit the candidate. A suggestion is only returned when BOTH land —
+a migration that admits the target by orphaning the migrated gang is not a
+plan, it's an outage. Every placement decision is the real scheduler's.
+
+This is deliberately an ADVISOR, not an actuator: it prints the plan (who
+moves, where everyone ends up); executing the migration stays a human/
+higher-level-controller decision, exactly like the reference ecosystem
+splits descheduling from scheduling.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..api.scheduling import POD_GROUP_LABEL
+from ..apiserver import APIServer
+from ..apiserver import server as srv
+from ..plugins import default_registry
+from ..plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
+from ..plugins.tpuslice import CHIP_INDEX_ANNOTATION
+from ..sched import Scheduler
+from ..api.core import Pod
+from .whatif import WhatIfReport, _make_profile, _run_one, _shadow_of
+
+# sentinel for peek() misses in the post-resubmission check: a vanished
+# target pod must read as "not bound"
+_GONE = Pod()
+
+
+@dataclasses.dataclass
+class MigrationSuggestion:
+    """One workable plan: migrate ``migrate`` and the target fits."""
+    migrate: str                        # gang full name to migrate
+    migrate_chips: int                  # its chip footprint (migration cost)
+    target: WhatIfReport                # where the target job lands
+    resubmitted: WhatIfReport           # where the migrated gang re-lands
+
+    def to_dict(self) -> dict:
+        return {"migrate": self.migrate,
+                "migrate_chips": self.migrate_chips,
+                "target": self.target.to_dict(),
+                "resubmitted": self.resubmitted.to_dict()}
+
+
+def _resident_gangs(api: APIServer) -> List[Tuple[str, int, int]]:
+    """(full name, member count, chip footprint) of every FULLY-bound gang,
+    smallest footprint first. Partially-bound gangs (members still pending)
+    are excluded: they are in flux, and a migration-cost number that counts
+    only the bound half would mis-rank candidates while the plan would
+    actually move every member."""
+    from ..plugins.tpuslice.chip_node import pod_tpu_limits
+    members: Dict[str, int] = {}
+    bound: Dict[str, int] = {}
+    chips: Dict[str, int] = {}
+    for p in api.list(srv.PODS):
+        name = p.meta.labels.get(POD_GROUP_LABEL)
+        if not name:
+            continue
+        full = f"{p.meta.namespace}/{name}"
+        members[full] = members.get(full, 0) + 1
+        c, _, _, _ = pod_tpu_limits(p)
+        chips[full] = chips.get(full, 0) + c
+        if p.spec.node_name:
+            bound[full] = bound.get(full, 0) + 1
+    out = [(full, members[full], chips[full]) for full in members
+           if bound.get(full, 0) == members[full]]
+    out.sort(key=lambda t: (t[2], t[0]))
+    return out
+
+
+def suggest_migrations(source_api: Optional[APIServer] = None,
+                       state_dir: Optional[str] = None, *,
+                       job: dict,
+                       max_suggestions: int = 1,
+                       candidates: Optional[List[str]] = None,
+                       timeout_s: float = 20.0,
+                       config_path: Optional[str] = None,
+                       scheduler_name: Optional[str] = None
+                       ) -> List[MigrationSuggestion]:
+    """Single-move migration plans that admit ``job`` (simulate_gang gang
+    kwargs; ``members`` required). Candidates default to every fully-bound
+    gang, tried smallest-chip-footprint first; pass ``candidates`` (gang
+    full names) to restrict — e.g. to gangs a team is willing to move.
+    Returns up to ``max_suggestions`` plans; empty list = no single
+    migration helps (the job needs >1 move, preemption, or more capacity).
+    """
+    if not isinstance(job, dict) or not isinstance(job.get("members"), int):
+        raise ValueError("job must be a dict with integer 'members'")
+    base = _shadow_of(source_api, state_dir)
+    profile = _make_profile(False, timeout_s, config_path, scheduler_name)
+    gangs = _resident_gangs(base)
+    if candidates is not None:
+        want = set(candidates)
+        unknown = want - {full for full, _, _ in gangs}
+        if unknown:
+            raise ValueError(f"unknown candidate gangs: {sorted(unknown)}")
+        gangs = [g for g in gangs if g[0] in want]
+
+    job_kw = dict(name="defrag-target", namespace="default", slice_shape="",
+                  accelerator="", chips_per_pod=1, cpu_per_pod=4,
+                  memory_per_pod="8Gi", priority=0)
+    job_kw.update(job)
+    target_full = f"{job_kw['namespace']}/{job_kw['name']}"
+    if base.try_get(srv.POD_GROUPS, target_full) is not None:
+        raise ValueError(f"target name {target_full!r} collides with an "
+                         "existing PodGroup; pass job['name']")
+    for j in range(job_kw["members"]):
+        pk = f"{job_kw['namespace']}/{job_kw['name']}-{j:03d}"
+        if base.peek(srv.PODS, pk) is not None:
+            raise ValueError(f"target pod key {pk!r} collides with an "
+                             "existing pod; pass job['name']")
+
+    suggestions: List[MigrationSuggestion] = []
+    for full, n_members, n_chips in gangs:
+        if len(suggestions) >= max_suggestions:
+            break
+        ns, gname = full.split("/", 1)
+        fork = _shadow_of(base, None)
+        # capture the candidate's pods (for resubmission), then remove them
+        moved_pods = [p for p in fork.list(srv.PODS, ns)
+                      if p.meta.labels.get(POD_GROUP_LABEL) == gname]
+        moved_pg = fork.try_get(srv.POD_GROUPS, full)
+        for p in moved_pods:
+            fork.delete(srv.PODS, p.meta.key)
+        if moved_pg is not None:
+            fork.delete(srv.POD_GROUPS, full)
+
+        sched = Scheduler(fork, default_registry(), profile)
+        sched.run()
+        try:
+            pre_resident = {p.meta.key for p in fork.list(srv.PODS)}
+            target, target_keys = _run_one(
+                fork, timeout_s=timeout_s,
+                scheduler_name=profile.scheduler_name, **job_kw)
+            if not target.feasible:
+                continue
+            # resubmit the migrated gang: its PodGroup, then unbound copies
+            # of its pods — the real scheduler re-places it
+            if moved_pg is not None:
+                moved_pg.meta.resource_version = 0
+                fork.create(srv.POD_GROUPS, moved_pg)
+            keys = []
+            for p in moved_pods:
+                q = p.deepcopy()
+                q.meta.resource_version = 0
+                q.spec.node_name = ""
+                q.meta.annotations.pop(COORD_ANNOTATION, None)
+                q.meta.annotations.pop(POOL_ANNOTATION, None)
+                q.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
+                q.status.conditions = []
+                fork.create(srv.PODS, q)
+                keys.append(q.meta.key)
+            import time as _time
+            deadline = _time.monotonic() + timeout_s
+            ok = False
+            while _time.monotonic() < deadline:
+                live = [fork.peek(srv.PODS, k) for k in keys]
+                if all(x is not None and x.spec.node_name for x in live):
+                    ok = True
+                    break
+                _time.sleep(0.02)
+            if not ok:
+                continue   # target fits but the migrated gang is homeless
+            # the resubmission must not have undone the plan: with an
+            # evicting profile it could have preempted the target's own
+            # pods or uninvolved residents to bind — either invalidates
+            # the "everyone lands, nobody else pays" contract
+            target_still = all(
+                (fork.peek(srv.PODS, k) or _GONE).spec.node_name
+                for k in target_keys)
+            after = {p.meta.key for p in fork.list(srv.PODS)}
+            third_party_evicted = (pre_resident - after)
+            if not target_still or third_party_evicted:
+                continue
+            placements = {}
+            coords = {}
+            pool = ""
+            for k in keys:
+                p = fork.peek(srv.PODS, k)
+                placements[k] = p.spec.node_name
+                coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
+                pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
+            resub = WhatIfReport(feasible=True, placements=placements,
+                                 pool=pool, coords=coords, victims=[],
+                                 elapsed_s=0.0, reason="")
+            suggestions.append(MigrationSuggestion(
+                migrate=full, migrate_chips=n_chips, target=target,
+                resubmitted=resub))
+        finally:
+            sched.stop()
+    return suggestions
