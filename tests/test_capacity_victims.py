@@ -282,3 +282,47 @@ def test_eligible_no_terminating_pods_on_nominated_node():
     preemptor = make_pod("pree", "team-a", limits={TPU: 4}, priority=100)
     preemptor.status.nominated_node_name = "h0"
     assert eligible(quotas, running, preemptor)
+
+
+def test_single_node_reclaim_respects_gang_min_member_floor():
+    """GangDisruptionFloor in the capacity evaluator: quota reclaim may not
+    evict one member of a running gang (leaving it below minMember) even
+    when every borrowing rule would otherwise allow it; a gang-free borrower
+    on another node IS evicted instead."""
+    from tpusched.api.resources import TPU
+    from tpusched.apiserver import server as srv
+    from tpusched.config.profiles import capacity_profile
+    from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                                  make_pod_group, make_tpu_node, wait_until)
+
+    with TestCluster(profile=capacity_profile()) as c:
+        c.add_nodes([make_tpu_node(f"h{i}", chips=4) for i in range(3)])
+        # aggregate min must cover all 12 chips or the borrow gate
+        # (aggregated-used-over-min) blocks the third pod outright
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "qa", "team-a", min={TPU: 8}, max={TPU: 12}))
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "qb", "team-b", min={TPU: 4}, max={TPU: 12}))
+        # team-b borrows: a 2-member gang (8 chips, over its 4 min) +
+        # one plain borrower pod (4 chips)
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "duo", namespace="team-b", min_member=2))
+        gang = [make_pod(f"duo-{i}", namespace="team-b", pod_group="duo",
+                         limits={TPU: 4}) for i in range(2)]
+        plain = make_pod("plain", namespace="team-b", limits={TPU: 4})
+        c.create_pods(gang + [plain])
+        assert c.wait_for_pods_scheduled(
+            [p.key for p in gang] + [plain.key], timeout=30)
+        # team-a reclaims its min: one 4-chip pod. Victim must be `plain`
+        # (gang-free), never a duo member (2-member gang, floor == 2).
+        a = make_pod("a-0", namespace="team-a", limits={TPU: 4})
+        c.create_pods([a])
+        assert c.wait_for_pods_scheduled([a.key], timeout=30)
+        assert wait_until(
+            lambda: c.api.try_get(srv.PODS, "team-b/plain") is None,
+            timeout=10)
+        duo_bound = [p for p in c.api.list(srv.PODS, "team-b")
+                     if p.meta.labels.get(
+                         "pod-group.scheduling.tpu.dev") == "duo"
+                     and p.spec.node_name]
+        assert len(duo_bound) == 2            # the gang never degraded

@@ -430,3 +430,45 @@ def test_claim_released_when_gang_lands_elsewhere():
         hosts = {c.pod(p.key).spec.node_name for p in mover}
         assert hosts.isdisjoint(occupied)
         assert "default/mover" not in tm._window_claims  # released
+
+
+def test_window_eviction_vetoed_when_it_would_strand_a_gang():
+    """Gang minMember disruption floor (the soak-caught bug): a 1-host
+    window whose only victims are 1 of a running 16-member gang must be
+    VETOED — evicting it would leave 15/16 running below quorum. The
+    blocked gang stays pending; the big gang stays whole."""
+    from tpusched.api.resources import TPU
+    from tpusched.apiserver import server as srv
+    from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                                  make_pod_group, make_tpu_pool)
+
+    prof = full_stack_profile(permit_wait_s=5, denied_s=1)
+    with TestCluster(profile=prof) as c:
+        topo, nodes = make_tpu_pool("pool", dims=(4, 4, 4))   # 64 chips
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        for team in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 32}, max={TPU: 128}))
+        # team-b's 16-member gang fills the whole pool (borrowing 32 chips)
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "big", namespace="team-b", min_member=16,
+            tpu_slice_shape="4x4x4", tpu_accelerator="tpu-v5p"))
+        big = [make_pod(f"big-{i}", namespace="team-b", pod_group="big",
+                        limits={TPU: 4}) for i in range(16)]
+        c.create_pods(big)
+        assert c.wait_for_pods_scheduled([p.key for p in big], timeout=30)
+        # team-a's tiny gang (one host) is within ITS min and team-b is over
+        # min by 32 chips — every borrow-rule gate passes; ONLY the gang
+        # floor stands between the window and a stranded 15/16
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "tiny", namespace="team-a", min_member=1,
+            tpu_slice_shape="2x2x1", tpu_accelerator="tpu-v5p"))
+        tiny = make_pod("tiny-0", namespace="team-a", pod_group="tiny",
+                        limits={TPU: 4})
+        c.create_pods([tiny])
+        assert c.wait_for_pods_unscheduled([tiny.key], hold=3.0)
+        # the big gang is untouched: 16/16 still bound
+        bound = [p for p in c.api.list(srv.PODS, "team-b")
+                 if p.spec.node_name]
+        assert len(bound) == 16

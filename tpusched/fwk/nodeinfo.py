@@ -113,6 +113,7 @@ class Snapshot:
     def __init__(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()):
         self._infos: Dict[str, NodeInfo] = {}
         self._pg_assigned: Optional[Dict[str, int]] = None  # lazy gang index
+        self._pg_live: Optional[Dict[str, int]] = None      # sans terminating
         for n in nodes:
             self._infos[n.name] = NodeInfo(n)
         for p in pods:
@@ -140,6 +141,35 @@ class Snapshot:
                 key = f"{p.meta.namespace}/{name}"
                 counts[key] = counts.get(key, 0) + 1
         return counts
+
+    @staticmethod
+    def _node_pg_live_counts(info: "NodeInfo") -> Dict[str, int]:
+        from ..api.scheduling import POD_GROUP_LABEL
+        counts: Dict[str, int] = {}
+        for p in info.pods:
+            name = p.meta.labels.get(POD_GROUP_LABEL)
+            if (name and p.spec.node_name
+                    and p.meta.deletion_timestamp is None):
+                key = f"{p.meta.namespace}/{name}"
+                counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def assigned_live_count(self, pg_name: str, namespace: str) -> int:
+        """Like assigned_count but excluding TERMINATING members (deletion
+        timestamp set): the disruption-floor input. A member evicted by an
+        earlier cycle that is still draining must not count as a quorum
+        survivor, or back-to-back preemptions on different hosts would
+        each think the gang can spare one more. Lazy per-snapshot index
+        (cold preemption path only), per-node generation-memoized."""
+        if self._pg_live is None:
+            idx: Dict[str, int] = {}
+            for info in self._infos.values():
+                for key, c in info.derived(
+                        "Snapshot/pg-live",
+                        self._node_pg_live_counts).items():
+                    idx[key] = idx.get(key, 0) + c
+            self._pg_live = idx
+        return self._pg_live.get(f"{namespace}/{pg_name}", 0)
 
     def assigned_count(self, pg_name: str, namespace: str) -> int:
         """Members of a gang with a node assigned (assumed or bound) — the

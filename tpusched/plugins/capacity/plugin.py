@@ -29,9 +29,9 @@ from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
                                PreFilterPlugin, ReservePlugin,
                                RESOURCE_ELASTIC_QUOTA, RESOURCE_POD)
 from ...fwk.nodeinfo import NodeInfo
-from ...sched.preemption import (Evaluator, PreemptionInterface,
-                                 dry_run_remove, more_important_pod,
-                                 reprieve_victims)
+from ...sched.preemption import (Evaluator, GangDisruptionFloor,
+                                 PreemptionInterface, dry_run_remove,
+                                 more_important_pod, reprieve_victims)
 from ...util import klog
 from ...util.podutil import assigned, is_pod_terminated, pod_effective_request
 from .elasticquota_info import ElasticQuotaInfo, ElasticQuotaInfos
@@ -296,6 +296,7 @@ class _Preemptor(PreemptionInterface):
         eq = infos.get(pod.namespace)
 
         potential: List[Pod] = []
+        floor = GangDisruptionFloor(self.handle)
 
         def remove(v: Pod) -> Optional[Status]:
             return dry_run_remove(self.handle, state, pod, v, node_info)
@@ -309,7 +310,9 @@ class _Preemptor(PreemptionInterface):
                 if more_than_min:
                     # preemptor exceeds its own min ⇒ reclaim only inside its
                     # quota, from lower-priority pods (:526-538)
-                    if p.namespace == pod.namespace and p.priority < pod.priority:
+                    if (p.namespace == pod.namespace
+                            and p.priority < pod.priority
+                            and floor.may_evict(p)):
                         potential.append(p)
                         err = remove(p)
                         if err:
@@ -317,7 +320,8 @@ class _Preemptor(PreemptionInterface):
                 else:
                     # preemptor within min ⇒ its guarantee is borrowed; evict
                     # borrowers: other quotas currently over min (:539-553)
-                    if p.namespace != pod.namespace and p_eq.used_over_min():
+                    if (p.namespace != pod.namespace and p_eq.used_over_min()
+                            and floor.may_evict(p)):
                         potential.append(p)
                         err = remove(p)
                         if err:
@@ -326,7 +330,7 @@ class _Preemptor(PreemptionInterface):
             for p in list(node_info.pods):
                 if infos.get(p.namespace) is not None:
                     continue
-                if p.priority < pod.priority:
+                if p.priority < pod.priority and floor.may_evict(p):
                     potential.append(p)
                     err = remove(p)
                     if err:

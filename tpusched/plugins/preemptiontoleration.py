@@ -25,8 +25,9 @@ from ..config.types import PreemptionTolerationArgs
 from ..fwk import CycleState, Status
 from ..fwk.interfaces import (PostFilterPlugin, PostFilterResult)
 from ..fwk.nodeinfo import NodeInfo
-from ..sched.preemption import (Evaluator, PreemptionInterface,
-                                dry_run_remove, reprieve_victims)
+from ..sched.preemption import (Evaluator, GangDisruptionFloor,
+                                PreemptionInterface, dry_run_remove,
+                                reprieve_victims)
 from ..util import klog
 
 ANNOTATION_PREFIX = "preemption-toleration.scheduling.tpu.dev/"
@@ -130,14 +131,22 @@ class _Interface(PreemptionInterface):
                                ) -> Tuple[List[Pod], int, Status]:
         now = self.handle.clock()
         potential: List[Pod] = []
+        floor = GangDisruptionFloor(self.handle)
         for p in list(node_info.pods):
             if p.priority >= pod.priority:
                 continue
             # the exemption filter — the plugin's whole point
-            # (preemption_toleration.go:208-229)
+            # (preemption_toleration.go:208-229). Checked BEFORE the gang
+            # floor: an exempted pod can never be evicted, so it must not
+            # consume the gang's disruption budget (that would wrongly
+            # veto legal victims behind it)
             if exempted_from_preemption(p, pod, self.pc_getter, now):
                 klog.V(5).info_s("victim candidate exempted", victim=p.key,
                                  preemptor=pod.key)
+                continue
+            if not floor.may_evict(p):
+                klog.V(5).info_s("victim candidate protected by gang "
+                                 "minMember floor", victim=p.key)
                 continue
             potential.append(p)
             err = dry_run_remove(self.handle, state, pod, p, node_info)

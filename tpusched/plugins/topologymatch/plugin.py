@@ -47,7 +47,8 @@ from ...topology.engine import (MaskGrid, PlacementSet,
                                 enumerate_placement_masks,
                                 feasible_membership)
 from ...topology.torus import HostGrid, validate_slice_shape
-from ...sched.preemption import filter_pods_with_pdb_violation
+from ...sched.preemption import (filter_pods_with_pdb_violation,
+                                 gang_min_member)
 from ...util import klog
 from ...util.metrics import preemption_attempts, slice_preemption_victims
 from ...util.ttlcache import TTLCache
@@ -616,17 +617,32 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             if evicted > overage:
                 return None  # would break the team's guaranteed min
 
-        # ranking penalty: gangs only partially contained in the window
-        # (evicting half a gang leaves it running below min_member)
-        by_gang: Dict[Tuple[str, str], int] = {}
+        # gang minMember disruption floor (shared contract with the
+        # single-node evaluators, sched/preemption.GangDisruptionFloor):
+        # a window whose eviction leaves any victim gang strictly between
+        # zero and minMember bound members is VETOED — the survivors would
+        # burn their chips below quorum (the stranded-gang state the
+        # randomized soak caught: a 1-host window evicting 1 of 16).
+        # Gangs still above min after the eviction, or taken to exactly
+        # zero, remain eligible; the partial count stays a ranking penalty
+        # among the survivors.
+        by_gang: Dict[Tuple[str, str], Tuple[int, Pod]] = {}
         for v in victims:
             g = v.meta.labels.get(POD_GROUP_LABEL)
             if g:
                 k = (v.meta.namespace, g)
-                by_gang[k] = by_gang.get(k, 0) + 1
+                n, _ = by_gang.get(k, (0, v))
+                by_gang[k] = (n + 1, v)
         partial = 0
-        for (ns, g), n in by_gang.items():
-            if n < snapshot.assigned_count(g, ns):
+        for (ns, g), (n, rep) in by_gang.items():
+            live = snapshot.assigned_live_count(g, ns)
+            min_member = gang_min_member(self.handle, rep, f"{ns}/{g}")
+            if live < min_member:
+                continue            # already sub-quorum: nothing to protect
+            remaining = live - n
+            if remaining > 0:
+                if remaining < min_member:
+                    return None     # would strand a live gang below quorum
                 partial += 1
         return partial
 

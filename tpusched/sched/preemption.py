@@ -36,6 +36,70 @@ def more_important_pod(p1: Pod, p2: Pod) -> bool:
     return t1 < t2
 
 
+class GangDisruptionFloor:
+    """PodGroup.minMember as a hard disruption floor for SINGLE-NODE victim
+    selection: evicting one member of a running gang leaves the survivors
+    burning their chips below quorum — the stranded-gang failure the
+    randomized soak caught (I3: a 16-member slice gang degraded to 15/16 by
+    a quota preemption of one pod). The rule: a victim may be evicted only
+    if its gang stays ≥ minMember afterwards, or drops to exactly ZERO
+    bound members (all-or-nothing both ways). Whole-gang eviction remains
+    the WINDOW path's job (TopologyMatch slice preemption, which takes a
+    gang's entire torus block coherently); the single-node evaluators must
+    not produce the in-between states.
+
+    Instantiate per select_victims_on_node call: the running count makes
+    multiple same-gang victims on one node compose correctly, and the
+    reprieve loop can only REDUCE evictions, so the floor holds through it.
+    No reference analog — upstream's evaluator is gang-blind (its
+    coscheduling KEP lists exactly this as an open problem)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self._remaining: dict = {}      # gang full name → assigned still left
+
+    def may_evict(self, victim: Pod) -> bool:
+        from ..api.scheduling import POD_GROUP_LABEL
+        name = victim.meta.labels.get(POD_GROUP_LABEL)
+        if not name:
+            return True
+        full = f"{victim.meta.namespace}/{name}"
+        min_member = gang_min_member(self.handle, victim, full)
+        remaining = self._remaining.get(full)
+        if remaining is None:
+            # LIVE members only: a member evicted by an earlier cycle but
+            # still draining is not a quorum survivor — counting it would
+            # let back-to-back preemptions on different hosts each think
+            # the gang can spare one more
+            remaining = self.handle.snapshot_shared_lister() \
+                .assigned_live_count(name, victim.meta.namespace)
+        if remaining < min_member:
+            # already below quorum: the gang provides nothing to protect,
+            # and an unpreemptable sub-quorum gang would pin its chips
+            # forever — freely evictable
+            self._remaining[full] = remaining - 1
+            return True
+        if remaining - 1 >= min_member or remaining <= 1:
+            self._remaining[full] = remaining - 1
+            return True
+        return False
+
+
+def gang_min_member(handle, member: Pod, full: str) -> int:
+    """A gang's quorum: the PodGroup CR's minMember, or — for KEP-2
+    label-only synthesized gangs (no CR) — the member's min-available
+    label. Shared by the single-node floor and the window veto so the two
+    can never diverge on which gangs are protected."""
+    from ..api.scheduling import MIN_AVAILABLE_LABEL
+    pg = handle.informer_factory.podgroups().get(full)
+    if pg is not None:
+        return pg.spec.min_member
+    try:
+        return int(member.meta.labels.get(MIN_AVAILABLE_LABEL, "0"))
+    except ValueError:
+        return 0
+
+
 def filter_pods_with_pdb_violation(pods: List[Pod],
                                    pdbs: List[PodDisruptionBudget]
                                    ) -> Tuple[List[Pod], List[Pod]]:
