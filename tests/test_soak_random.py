@@ -26,7 +26,7 @@ from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION
 from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
                               make_pod_group, make_tpu_pool, wait_until)
 
-SEED = 20260730
+SEED = 20260730          # default; the test is parametrized over several
 ROUNDS = 6
 SHAPES = ["2x2x1", "2x2x2", "4x4x4"]          # 4 / 8 / 64 chips
 MEMBERS = {"2x2x1": 1, "2x2x2": 2, "4x4x4": 16}
@@ -70,8 +70,17 @@ def _check_invariants(c, gangs):
                        for p in bound), f"I4 coords missing (seed {SEED})"
 
 
-def test_randomized_soak_invariants():
-    rng = random.Random(SEED)
+import pytest
+
+
+@pytest.mark.parametrize("seed", [20260730, 42, 999])
+def test_randomized_soak_invariants(seed):
+    """seed 42 is the one that caught the stranded-gang bug (a slice-
+    preemption window evicting 1 of 16 — now vetoed by the minMember
+    disruption floor); it stays pinned here as a regression."""
+    global SEED
+    SEED = seed
+    rng = random.Random(seed)
     with TestCluster(profile=full_stack_profile(permit_wait_s=6,
                                                 denied_s=1)) as c:
         for i in range(2):
