@@ -472,3 +472,38 @@ def test_window_eviction_vetoed_when_it_would_strand_a_gang():
         bound = [p for p in c.api.list(srv.PODS, "team-b")
                  if p.spec.node_name]
         assert len(bound) == 16
+
+
+def test_window_veto_protects_label_only_gangs():
+    """The floor's KEP-2 fallback: a gang admitted via labels alone (no
+    PodGroup CR, minMember from the min-available label) is protected from
+    partial window eviction exactly like a CR-backed gang."""
+    from tpusched.api.resources import TPU
+    from tpusched.api.scheduling import MIN_AVAILABLE_LABEL
+    from tpusched.apiserver import server as srv
+    from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                                  make_pod_group, make_tpu_pool)
+
+    prof = full_stack_profile(permit_wait_s=5, denied_s=1)
+    with TestCluster(profile=prof) as c:
+        topo, nodes = make_tpu_pool("pool", dims=(4, 4, 4))   # 64 chips
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        for team in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 32}, max={TPU: 128}))
+        # label-only 16-member gang fills the pool (no CR anywhere)
+        big = [make_pod(f"lbig-{i}", namespace="team-b", pod_group="lbig",
+                        labels={MIN_AVAILABLE_LABEL: "16"},
+                        limits={TPU: 4}) for i in range(16)]
+        c.create_pods(big)
+        assert c.wait_for_pods_scheduled([p.key for p in big], timeout=30)
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "tiny", namespace="team-a", min_member=1,
+            tpu_slice_shape="2x2x1", tpu_accelerator="tpu-v5p"))
+        tiny = make_pod("tiny-0", namespace="team-a", pod_group="tiny",
+                        limits={TPU: 4})
+        c.create_pods([tiny])
+        assert c.wait_for_pods_unscheduled([tiny.key], hold=3.0)
+        assert len([p for p in c.api.list(srv.PODS, "team-b")
+                    if p.spec.node_name]) == 16
