@@ -66,7 +66,7 @@ def test_validate_only_canned_default(capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out)[0]
     assert out["queueSort"] == "Coscheduling"     # tpu-gang default
-    assert out["permit"] == ["Coscheduling"]
+    assert out["permit"] == ["Coscheduling", "MultiSlice"]
     assert out["bind"] == ["TpuSlice"]
 
 

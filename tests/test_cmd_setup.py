@@ -37,14 +37,16 @@ WANT = {
         reserve=["CapacityScheduling"]),
     ("full", "tpusched"): dict(
         queue_sort="Coscheduling",
-        pre_filter=["Coscheduling", "TopologyMatch", "CapacityScheduling"],
-        filter=["TopologyMatch"] + DEFAULT_FILTERS + ["TpuSlice"],
-        post_filter=["TopologyMatch", "Coscheduling", "CapacityScheduling"],
+        pre_filter=["Coscheduling", "TopologyMatch", "MultiSlice",
+                    "CapacityScheduling"],
+        filter=["TopologyMatch", "MultiSlice"] + DEFAULT_FILTERS + ["TpuSlice"],
+        post_filter=["TopologyMatch", "Coscheduling", "MultiSlice",
+                     "CapacityScheduling"],
         pre_score=["MultiSlice"],
         score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],
-        reserve=["TpuSlice", "TopologyMatch", "Coscheduling",
+        reserve=["TpuSlice", "TopologyMatch", "Coscheduling", "MultiSlice",
                  "CapacityScheduling"],
-        permit=["Coscheduling"], bind=["TpuSlice"],
+        permit=["Coscheduling", "MultiSlice"], bind=["TpuSlice"],
         post_bind=["Coscheduling"],
         args={"Coscheduling": {"permit_waiting_time_seconds": 60,
                                "denied_pg_expiration_time_seconds": 20},
@@ -56,7 +58,10 @@ WANT = {
     ("multislice", "tpusched"): dict(
         pre_score=["MultiSlice"], score=[("MultiSlice", 3)],
         args={"MultiSlice": {"same_domain_score": 100,
-                             "adjacent_domain_score": 50}}),
+                             "adjacent_domain_score": 50,
+                             "set_schedule_timeout_seconds": 120,
+                             "denied_set_expiration_time_seconds": 20,
+                             "hard_domain_policy": ""}}),
     ("noderesources", "tpusched"): dict(
         score=[("NodeResourcesAllocatable", 1)],
         args={"NodeResourcesAllocatable": {

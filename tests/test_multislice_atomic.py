@@ -1,0 +1,172 @@
+"""MultiSlice set-level atomic admission (VERDICT r3 #2): a multi-slice job
+is N gangs sharing ``multislice_set``; with ``multislice_set_size`` declared,
+admission is all-or-nothing across the set — no slice binds until every
+member gang has quorum, and an infeasible member releases every sibling
+slice's reservations instead of stranding chips."""
+import time
+
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.config.types import MultiSliceArgs
+from tpusched.plugins.topologymatch import POOL_ANNOTATION
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool, wait_until)
+
+
+def atomic_profile(permit_wait_s=10, denied_s=1, set_wait_s=6,
+                   denied_set_s=30, hard=""):
+    prof = tpu_gang_profile(permit_wait_s=permit_wait_s, denied_s=denied_s)
+    prof.plugin_args["MultiSlice"] = MultiSliceArgs(
+        set_schedule_timeout_seconds=set_wait_s,
+        denied_set_expiration_time_seconds=denied_set_s,
+        hard_domain_policy=hard)
+    return prof
+
+
+def add_pool(c, name, dcn_domain, dims=(4, 4, 4)):
+    topo, nodes = make_tpu_pool(name, dims=dims, dcn_domain=dcn_domain)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+
+
+def slice_pg(c, set_name, index, set_size, members=16, shape="4x4x4",
+             min_resources=None):
+    name = f"{set_name}-slice-{index}"
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator="tpu-v5p", multislice_set=set_name,
+        multislice_index=index, multislice_set_size=set_size,
+        min_resources=min_resources))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def pool_of(c, pods):
+    pools = {c.pod(p.key).meta.annotations[POOL_ANNOTATION] for p in pods}
+    assert len(pools) == 1
+    return pools.pop()
+
+
+def test_complete_set_admits_all_slices():
+    """Happy path: both slices of a size-2 set land, on distinct pools."""
+    with TestCluster(profile=atomic_profile()) as c:
+        add_pool(c, "p0", "zoneA/rack0")
+        add_pool(c, "p1", "zoneA/rack1")
+        s0 = slice_pg(c, "job", 0, set_size=2)
+        s1 = slice_pg(c, "job", 1, set_size=2)
+        keys = [p.key for p in s0 + s1]
+        assert c.wait_for_pods_scheduled(keys, timeout=30)
+        assert pool_of(c, s0) != pool_of(c, s1)
+
+
+def test_incomplete_set_binds_nothing():
+    """With only 1 of 2 slices submitted, no pod may bind — the set barrier
+    holds the first slice at Permit even though its own gang has quorum."""
+    with TestCluster(profile=atomic_profile(set_wait_s=30)) as c:
+        add_pool(c, "p0", "zoneA/rack0")
+        add_pool(c, "p1", "zoneA/rack1")
+        s0 = slice_pg(c, "solo", 0, set_size=2)
+        assert c.wait_for_pods_unscheduled([p.key for p in s0], hold=2.0)
+
+
+def test_infeasible_member_releases_sibling_reservations():
+    """The flagship stranding case: a 4-slice set on a 3-pool fleet. Slice 3
+    can never fit; slices 0-2 must release their reserved pools (PostFilter
+    set teardown) so an unrelated gang can use the chips."""
+    with TestCluster(profile=atomic_profile(set_wait_s=20,
+                                            denied_set_s=60)) as c:
+        for i in range(3):
+            add_pool(c, f"pool-{i}", f"zoneA/rack{i}")
+        all_pods = []
+        for idx in range(4):
+            all_pods += slice_pg(c, "big", idx, set_size=4)
+        # teardown is event-driven (slice-3 failure), well before the 20s
+        # set timeout: every reservation must be gone again
+        assert wait_until(
+            lambda: all(not c.pod(p.key).spec.node_name for p in all_pods),
+            timeout=15), "set members still hold assignments"
+        # the freed chips are genuinely usable: an unrelated whole-pool gang
+        # binds while the torn-down set sits in its denied window
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "winner", min_member=16, tpu_slice_shape="4x4x4",
+            tpu_accelerator="tpu-v5p"))
+        w = [make_pod(f"winner-{i}", pod_group="winner", limits={TPU: 4})
+             for i in range(16)]
+        c.create_pods(w)
+        assert c.wait_for_pods_scheduled([p.key for p in w], timeout=30)
+
+
+def test_set_capacity_dryrun_denies_before_reserving():
+    """When every member declares minResources, the summed-set dry-run
+    rejects the whole set at PreFilter — no chips are ever reserved."""
+    with TestCluster(profile=atomic_profile(denied_set_s=60)) as c:
+        for i in range(2):
+            add_pool(c, f"pool-{i}", f"zoneA/rack{i}")
+        # 3 slices × 64 chips on a 128-chip fleet: impossible, knowable
+        # from the specs alone
+        all_pods = []
+        for idx in range(3):
+            all_pods += slice_pg(c, "toobig", idx, set_size=3,
+                                 min_resources={TPU: 64})
+        assert c.wait_for_pods_unscheduled([p.key for p in all_pods],
+                                           hold=1.0)
+        assert all(POOL_ANNOTATION not in c.pod(p.key).meta.annotations
+                   for p in all_pods)
+
+
+def test_torn_down_set_recovers_when_capacity_appears():
+    """After a teardown, the denied-set window expires and the set admits
+    once a 4th pool exists (Node add events requeue the members)."""
+    with TestCluster(profile=atomic_profile(permit_wait_s=20, set_wait_s=20,
+                                            denied_set_s=2)) as c:
+        for i in range(3):
+            add_pool(c, f"pool-{i}", f"zoneA/rack{i}")
+        all_pods = []
+        for idx in range(4):
+            all_pods += slice_pg(c, "grow", idx, set_size=4)
+        keys = [p.key for p in all_pods]
+        # stranding released first
+        assert wait_until(
+            lambda: all(not c.pod(k).spec.node_name for k in keys),
+            timeout=15)
+        add_pool(c, "pool-3", "zoneA/rack3")
+        assert c.wait_for_pods_scheduled(keys, timeout=60)
+        pools = set()
+        for idx in range(4):
+            pools.add(pool_of(c, all_pods[idx * 16:(idx + 1) * 16]))
+        assert len(pools) == 4
+
+
+def test_hard_same_zone_gates_rather_than_prefers():
+    """hard_domain_policy=same-zone: once slice 0 lands in zoneA, a zoneB
+    pool is Unschedulable for slice 1 (soft mode would degrade to it)."""
+    with TestCluster(profile=atomic_profile(hard="same-zone",
+                                            set_wait_s=30)) as c:
+        add_pool(c, "a0", "zoneA/rack0")
+        s0 = slice_pg(c, "pinned", 0, set_size=1)  # size-1: no barrier
+        assert c.wait_for_pods_scheduled([p.key for p in s0], timeout=20)
+        assert pool_of(c, s0) == "a0"
+        add_pool(c, "b0", "zoneB/rack0")
+        s1 = slice_pg(c, "pinned", 1, set_size=1)
+        assert c.wait_for_pods_unscheduled([p.key for p in s1], hold=2.0)
+        # a same-zone pool appears: slice 1 lands there and only there
+        add_pool(c, "a1", "zoneA/rack1")
+        assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=30)
+        assert pool_of(c, s1) == "a1"
+
+
+def test_hard_same_domain_allows_same_domain():
+    """Positive control for same-domain mode: a second pool in the anchor
+    domain admits the second slice."""
+    with TestCluster(profile=atomic_profile(hard="same-domain")) as c:
+        add_pool(c, "a0", "zoneA/rack0")
+        s0 = slice_pg(c, "dom", 0, set_size=1)
+        assert c.wait_for_pods_scheduled([p.key for p in s0], timeout=20)
+        add_pool(c, "a1", "zoneA/rack0")   # same domain, different pool
+        add_pool(c, "b0", "zoneA/rack9")   # same zone, wrong domain
+        s1 = slice_pg(c, "dom", 1, set_size=1)
+        assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=20)
+        assert pool_of(c, s1) == "a1"

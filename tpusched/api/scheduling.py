@@ -54,6 +54,12 @@ class PodGroupSpec:
     # its slice ordinal; consumed by the multislice DCN-aware scorer.
     multislice_set: str = ""
     multislice_index: int = 0
+    # Declared number of slices in the set (minMember one level up). When
+    # > 1, the MultiSlice plugin holds every member gang at the permit
+    # barrier until ALL member gangs have quorum — set-level all-or-nothing
+    # admission. 0 (default) keeps the pre-existing behavior: slices admit
+    # independently, DCN proximity is a scoring preference only.
+    multislice_set_size: int = 0
 
 
 @dataclass

@@ -17,20 +17,25 @@ def tpu_gang_profile(permit_wait_s: int = 60, denied_s: int = 20,
     return PluginProfile(
         scheduler_name=scheduler_name,
         queue_sort="Coscheduling",
-        pre_filter=["Coscheduling", "TopologyMatch"],
+        pre_filter=["Coscheduling", "TopologyMatch", "MultiSlice"],
         # TopologyMatch first: its per-node check is one set lookup against
         # the PreFilter stash and it is the most selective filter for slice
         # gangs (a 16-pool fleet rejects ~15/16 of hosts here) — running it
         # early skips the rest of the chain for every rejected host.
         # Filters are conjunctive, so order changes cost, not outcome.
-        filter=["TopologyMatch", "NodeUnschedulable", "NodeName",
+        filter=["TopologyMatch", "MultiSlice", "NodeUnschedulable", "NodeName",
                 "NodeSelector", "TaintToleration", "NodeResourcesFit",
                 "TpuSlice"],
-        post_filter=["Coscheduling"],
+        # MultiSlice after Coscheduling: its set teardown relies on
+        # Coscheduling having already judged (and possibly graced) the
+        # failing member gang
+        post_filter=["Coscheduling", "MultiSlice"],
         pre_score=["MultiSlice"],
         score=[("TpuSlice", 1), ("TopologyMatch", 2), ("MultiSlice", 3)],
-        reserve=["TpuSlice", "TopologyMatch", "Coscheduling"],
-        permit=["Coscheduling"],
+        reserve=["TpuSlice", "TopologyMatch", "Coscheduling", "MultiSlice"],
+        # Coscheduling first: a pod clears its gang quorum check before the
+        # set barrier decides whether the whole set may proceed
+        permit=["Coscheduling", "MultiSlice"],
         bind=["TpuSlice"],
         post_bind=["Coscheduling"],
         plugin_args={"Coscheduling": CoschedulingArgs(

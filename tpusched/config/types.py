@@ -80,10 +80,23 @@ class TopologyMatchArgs:
 
 @dataclass
 class MultiSliceArgs:
-    """DCN-aware cross-slice scoring (new; no reference analog)."""
+    """DCN-aware cross-slice scoring and set-level atomic admission (new; no
+    reference analog)."""
     # score weight for sharing a DCN domain with already-placed sibling slices
     same_domain_score: int = 100
     adjacent_domain_score: int = 50
+    # Max seconds a member gang waits at the permit barrier for the REST of
+    # its set (gangs wait for their own quorum under the Coscheduling
+    # timeout; this one is the budget for sibling slices to land). Applies
+    # only to PodGroups declaring multislice_set_size > 1.
+    set_schedule_timeout_seconds: int = 120
+    # How long a torn-down set stays denied (fast PreFilter rejection)
+    # before members may retry. Window runs from the first denial.
+    denied_set_expiration_time_seconds: int = 20
+    # "" (default) = DCN proximity is a preference only. "same-domain" /
+    # "same-zone" = hard Filter constraint: once any sibling slice is
+    # placed, later slices may only land inside its DCN domain / zone.
+    hard_domain_policy: str = ""
 
 
 @dataclass

@@ -24,13 +24,20 @@ SKIP = Code.SKIP
 
 
 class Status:
-    __slots__ = ("code", "reasons", "plugin")
+    __slots__ = ("code", "reasons", "plugin", "retry_after_s")
 
     def __init__(self, code: Code = SUCCESS, reasons: Optional[List[str]] = None,
                  plugin: str = ""):
         self.code = code
         self.reasons = reasons or []
         self.plugin = plugin
+        # Time-bounded rejection hint: the pod was rejected by a denial
+        # WINDOW (denied-PG / denied-multislice-set TTL), so retrying is
+        # pointless before — and correct after — this many seconds. The
+        # scheduler parks such pods in backoffQ with this expiry instead of
+        # unschedulableQ: no cluster event will ever fire when a TTL lapses,
+        # so event-driven requeue would leave them to the periodic flush.
+        self.retry_after_s: Optional[float] = None
 
     # Constructors -----------------------------------------------------------
     @staticmethod
@@ -86,7 +93,16 @@ class Status:
         # nodes. Use the result, not the receiver.
         if self.plugin == name:
             return self
-        return Status(self.code, list(self.reasons), name)
+        out = Status(self.code, list(self.reasons), name)
+        out.retry_after_s = self.retry_after_s
+        return out
+
+    def with_retry_after(self, seconds: float) -> "Status":
+        """Attach the time-bounded-rejection hint (see retry_after_s).
+        Mutates in place — callers construct a fresh Status for rejection
+        paths; never call on the success singleton."""
+        self.retry_after_s = seconds
+        return self
 
     def __repr__(self) -> str:
         return f"Status({self.code.name}, {self.reasons!r}, plugin={self.plugin!r})"

@@ -232,6 +232,11 @@ class PodGroupManager:
 
     # -- deny/permit caches ---------------------------------------------------
 
+    def denied_remaining(self, pod: Pod) -> float:
+        """Seconds left on the pod's gang denial window (0 if not denied)."""
+        full = pod_group_full_name(pod)
+        return self.last_denied_pg.remaining(full) if full else 0.0
+
     def add_denied_pod_group(self, full: str) -> None:
         # add-if-absent (go-cache Add, core.go:268-270): the denial window
         # runs from the FIRST denial; repeat denials during retries must not
@@ -244,18 +249,23 @@ class PodGroupManager:
 
 def check_cluster_resource(node_list: List[NodeInfo],
                            resource_request: ResourceList,
-                           desired_pg_full_name: str) -> Optional[str]:
+                           desired_pg_full_names) -> Optional[str]:
     """Can the cluster's aggregate free capacity hold `resource_request`?
 
     Walks nodes subtracting each node's free resources (with the group's own
     pods removed first, so a retrying gang doesn't double-count itself —
     getNodeResource, core.go:349-382). Returns a gap description or None.
-    Operates on a private copy (reference mutates the caller's map)."""
+    Operates on a private copy (reference mutates the caller's map).
+
+    ``desired_pg_full_names``: one gang full-name, or a set of them (the
+    MultiSlice set-level dry-run excludes every member gang's pods)."""
+    if isinstance(desired_pg_full_names, str):
+        desired_pg_full_names = frozenset((desired_pg_full_names,))
     remaining = {k: v for k, v in resource_request.items() if v > 0}
     for info in node_list:
         if info is None or info.node is None:
             continue
-        left = _node_left_resource(info, desired_pg_full_name)
+        left = _node_left_resource(info, desired_pg_full_names)
         for name in list(remaining):
             remaining[name] -= left.get(name, 0)
             if remaining[name] <= 0:
@@ -265,12 +275,13 @@ def check_cluster_resource(node_list: List[NodeInfo],
     return f"resource gap: {remaining}"
 
 
-def _node_left_resource(info: NodeInfo, desired_pg_full_name: str) -> ResourceList:
+def _node_left_resource(info: NodeInfo,
+                        desired_pg_full_names: frozenset) -> ResourceList:
     alloc = dict(info.allocatable)
     requested: ResourceList = {}
     own_pods = 0
     for p in info.pods:
-        if pod_group_full_name(p) == desired_pg_full_name:
+        if pod_group_full_name(p) in desired_pg_full_names:
             own_pods += 1
             continue
         for k, v in pod_effective_request(p).items():

@@ -75,7 +75,15 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
         err = self.pg_mgr.pre_filter(pod)
         if err is not None:
             klog.V(4).info_s("PreFilter failed", pod=pod.key, reason=err)
-            return Status.unresolvable(err)
+            status = Status.unresolvable(err)
+            # denial-window rejections are time-bounded: tell the queue when
+            # a retry can actually succeed (nothing emits an event when a
+            # TTL lapses, so event-driven requeue alone strands the gang
+            # until the periodic flush)
+            remaining = self.pg_mgr.denied_remaining(pod)
+            if remaining > 0:
+                status.with_retry_after(remaining + 0.05)
+            return status
         return Status.success()
 
     # -- PostFilter -----------------------------------------------------------

@@ -1,37 +1,84 @@
-"""MultiSlice plugin: DCN-aware cross-slice scoring.
+"""MultiSlice plugin: DCN-aware cross-slice scoring and set-level atomic
+admission.
 
 New TPU-native capability with no reference analog (SURVEY §7.7, BASELINE
 eval config #5): a multi-slice job (e.g. Llama-3-70B on 4× v5p-64) is N
 PodGroups sharing ``PodGroupSpec.multislice_set``, one gang per slice. Each
 slice lands on one ICI torus (TopologyMatch guarantees that); the slices
-communicate gradients over DCN. This scorer pulls sibling slices toward the
-same DCN proximity domain so the cross-slice all-reduce rides the shortest
-data-center paths:
+communicate gradients over DCN.
 
-- nodes in a pool whose ``dcn-domain`` equals a domain already hosting a
-  sibling slice score ``same_domain_score``;
-- nodes whose domain shares the same top-level zone (prefix before "/")
-  score ``adjacent_domain_score``;
-- everything else scores 0. Non-multislice pods skip.
+Two cooperating capabilities:
+
+**Scoring (always on).** Pull sibling slices toward the same DCN proximity
+domain so the cross-slice all-reduce rides the shortest data-center paths:
+nodes in a pool whose ``dcn-domain`` equals a domain already hosting a
+sibling slice score ``same_domain_score``; nodes whose domain shares the
+same top-level zone (prefix before "/") score ``adjacent_domain_score``;
+everything else 0. Non-multislice pods skip. Sibling placements are read
+from the cycle snapshot, so slices held at the permit barrier (assumed but
+not bound) already exert pull.
+
+**Set-level atomic admission (opt-in via
+``PodGroupSpec.multislice_set_size > 1``).** The gang barrier one level up:
+the Coscheduling quorum machinery
+(/root/reference/pkg/coscheduling/coscheduling.go:184-216) guarantees
+all-or-nothing *within* a gang, but a 4-slice set admitting slice by slice
+can strand 3 bound slices forever when the 4th can never fit — exactly the
+resource stranding the pod-level barrier exists to prevent. With a declared
+set size:
+
+- *Permit*: every member pod waits until ALL ``multislice_set_size`` member
+  gangs have quorum (own-gang in-flight pod counted +1, same snapshot
+  convention as core.go:209-215). No slice binds before the whole set is
+  placed, so unwinding never has to touch bound pods.
+- *PreFilter*: a set-level cluster-capacity dry-run (the per-gang
+  CheckClusterResource lifted to the summed set request) fails the whole
+  set fast — before any chip is reserved — when the fleet can never hold
+  it; a denied-set TTL makes retries cheap.
+- *PostFilter*: when one member gang is rejected (Coscheduling has already
+  swept its own waiters by the time we run — profile order), the remaining
+  member gangs' waiting pods are rejected too, releasing their
+  reservations immediately instead of waiting out the set timeout.
+- *Unreserve*: any member pod's failure past Reserve tears down the whole
+  set's waiters (cascade-guarded by the denied-set cache).
+
+**Hard DCN constraint (``hard_domain_policy`` arg).** ``same-domain`` /
+``same-zone`` turn the scoring preference into a Filter-level gate: once
+any sibling slice is placed (assumed or bound), nodes outside its DCN
+domain/zone are Unschedulable for later slices. The first slice is
+unconstrained — operators pairing this with atomic admission should size
+domains so a whole set fits one domain, or the set will burn a timeout
+discovering it cannot.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import FrozenSet, List, Optional, Set, Tuple
 
 from ...api.core import Pod
-from ...api.scheduling import (POD_GROUP_INDEX, pod_group_index_key,
-                               pod_group_label)
+from ...api.resources import PODS
+from ...api.scheduling import (POD_GROUP_INDEX, PodGroup,
+                               pod_group_index_key, pod_group_label)
 from ...api.topology import LABEL_DCN_DOMAIN
 from ...config.types import MultiSliceArgs
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import NodeScore, PreScorePlugin, ScorePlugin
-from ...fwk.nodeinfo import MAX_NODE_SCORE
+from ...fwk.interfaces import (FilterPlugin, NodeScore, PermitPlugin,
+                               PostFilterPlugin, PostFilterResult,
+                               PreFilterPlugin, PreScorePlugin, ReservePlugin,
+                               ScorePlugin)
+from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
+from ...util import klog
+from ...util.ttlcache import TTLCache
+from ..coscheduling.core import check_cluster_resource
 
-_STATE_KEY = "MultiSlice/domains"
+_SCORE_KEY = "MultiSlice/domains"
+_FILTER_KEY = "MultiSlice/hard-domains"
+
+HARD_SAME_DOMAIN = "same-domain"
+HARD_SAME_ZONE = "same-zone"
 
 
 class _Domains:
-    def __init__(self, domains: set):
+    def __init__(self, domains: Set[str]):
         self.domains = domains
         self.zones = {d.split("/")[0] for d in domains}
 
@@ -39,7 +86,19 @@ class _Domains:
         return self
 
 
-class MultiSlice(PreScorePlugin, ScorePlugin):
+def _node_pg_keys(info: NodeInfo) -> FrozenSet[str]:
+    """Gang full-names with a pod assigned on this node (derived-pure:
+    recomputed only when the node's generation moves)."""
+    out = set()
+    for p in info.pods:
+        name = pod_group_label(p)
+        if name and p.spec.node_name:
+            out.add(f"{p.meta.namespace}/{name}")
+    return frozenset(out)
+
+
+class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
+                 PreScorePlugin, ScorePlugin, ReservePlugin, PermitPlugin):
     NAME = "MultiSlice"
 
     def __init__(self, args: Optional[MultiSliceArgs], handle):
@@ -48,46 +107,193 @@ class MultiSlice(PreScorePlugin, ScorePlugin):
         self.pg_informer = handle.informer_factory.podgroups()
         self.pod_informer = handle.informer_factory.pods()
         self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+        # Denied sets: like the coscheduling denied-PG cache, the window runs
+        # from the FIRST denial (TTLCache.add is add-if-absent) so cascading
+        # unreserves and event-driven retries cannot extend it.
+        self._denied_sets = TTLCache(
+            float(self.args.denied_set_expiration_time_seconds))
+        # Memoized set-level capacity dry-runs (coscheduling permitted_pg
+        # analog): one dry-run per set per permit window, not per cycle.
+        self._permitted_sets = TTLCache(
+            float(self.args.set_schedule_timeout_seconds))
 
     @classmethod
     def new(cls, args, handle) -> "MultiSlice":
         return cls(args, handle)
 
-    # -- PreScore: collect DCN domains of already-placed sibling slices -------
+    # -- set lookups ----------------------------------------------------------
 
-    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def _pod_set_pg(self, pod: Pod) -> Optional[PodGroup]:
         name = pod_group_label(pod)
         if not name:
-            return Status.skip()
+            return None
         pg = self.pg_informer.get(f"{pod.namespace}/{name}")
         if pg is None or not pg.spec.multislice_set:
-            return Status.skip()
-        sibling_pgs = [
-            g for g in self.pg_informer.items(namespace=pod.namespace)
-            if g.spec.multislice_set == pg.spec.multislice_set
-            and g.meta.name != pg.meta.name]
-        domains = set()
-        snapshot = self.handle.snapshot_shared_lister()
-        for g in sibling_pgs:
-            for p in self.pod_informer.by_index(
-                    POD_GROUP_INDEX, f"{pod.namespace}/{g.meta.name}"):
-                if not p.spec.node_name:
-                    continue
-                info = snapshot.get(p.spec.node_name)
-                if info is None:
-                    continue
+            return None
+        return pg
+
+    def _member_pgs(self, namespace: str, set_name: str) -> List[PodGroup]:
+        return [g for g in self.pg_informer.items(namespace=namespace)
+                if g.spec.multislice_set == set_name]
+
+    @staticmethod
+    def _set_key(namespace: str, set_name: str) -> str:
+        return f"{namespace}/{set_name}"
+
+    @staticmethod
+    def _barrier_enabled(pg: PodGroup) -> bool:
+        return bool(pg.spec.multislice_set) and pg.spec.multislice_set_size > 1
+
+    def _sibling_domains(self, namespace: str, set_name: str,
+                         own_pg_name: str) -> Set[str]:
+        """DCN domains hosting a sibling slice (assumed OR bound — the cycle
+        snapshot contains pods the cache has assumed, which is what makes
+        the pull/gate work while siblings are parked at the permit
+        barrier). O(nodes) per cycle: the per-node gang sweep is
+        generation-memoized."""
+        member_keys = {f"{namespace}/{g.meta.name}"
+                       for g in self._member_pgs(namespace, set_name)
+                       if g.meta.name != own_pg_name}
+        if not member_keys:
+            return set()
+        domains: Set[str] = set()
+        for info in self.handle.snapshot_shared_lister().list():
+            if info.node is None:
+                continue
+            keys = info.derived("MultiSlice/pg-keys", _node_pg_keys)
+            if keys and not member_keys.isdisjoint(keys):
                 d = info.node.meta.labels.get(LABEL_DCN_DOMAIN, "")
                 if d:
                     domains.add(d)
+        return domains
+
+    # -- PreFilter: denied-set gate + set capacity dry-run + hard-mode state --
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        pg = self._pod_set_pg(pod)
+        if pg is None:
+            return Status.skip()
+        set_name = pg.spec.multislice_set
+        set_key = self._set_key(pod.namespace, set_name)
+        if self._barrier_enabled(pg):
+            if set_key in self._denied_sets:
+                return Status.unresolvable(
+                    f"multislice set {set_key} was denied within the "
+                    f"denied-set expiration window").with_retry_after(
+                        self._denied_sets.remaining(set_key) + 0.05)
+            status = self._check_set_capacity(pod.namespace, set_name,
+                                              set_key, pg)
+            if status is not None:
+                return status
+        if self.args.hard_domain_policy not in (HARD_SAME_DOMAIN,
+                                                HARD_SAME_ZONE):
+            return Status.skip()
+        domains = self._sibling_domains(pod.namespace, set_name, pg.meta.name)
         if not domains:
-            return Status.skip()  # first slice of the set: nothing to pull toward
-        state.write(_STATE_KEY, _Domains(domains))
+            return Status.skip()   # first slice of the set: unconstrained
+        state.write(_FILTER_KEY, _Domains(domains))
         return Status.success()
 
-    # -- Score ----------------------------------------------------------------
+    def _check_set_capacity(self, namespace: str, set_name: str, set_key: str,
+                            pg: PodGroup) -> Optional[Status]:
+        """Summed-set CheckClusterResource (core.go:322-342 one level up).
+        Runs only once every member PG exists and every member declares
+        min_resources; memoized for the permit window. Returns a failure
+        Status, or None to proceed."""
+        if set_key in self._permitted_sets:
+            return None
+        members = self._member_pgs(namespace, set_name)
+        if len(members) < pg.spec.multislice_set_size:
+            return None    # set not fully submitted yet: nothing to sum
+        if not all(g.spec.min_resources for g in members):
+            return None
+        total: dict = {}
+        for g in members:
+            for k, v in g.spec.min_resources.items():
+                total[k] = total.get(k, 0) + v
+            total[PODS] = total.get(PODS, 0) + g.spec.min_member
+        nodes = self.handle.snapshot_shared_lister().list()
+        member_keys = frozenset(f"{namespace}/{g.meta.name}" for g in members)
+        err = check_cluster_resource(nodes, total, member_keys)
+        if err:
+            self._deny_set(set_key, namespace, set_name,
+                           f"set capacity dry-run failed: {err}")
+            return Status.unresolvable(
+                f"multislice set {set_key} cannot fit the fleet: {err}"
+            ).with_retry_after(self._denied_sets.remaining(set_key) + 0.05)
+        self._permitted_sets.set(set_key)
+        return None
 
-    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
-        doms = state.try_read(_STATE_KEY)
+    # -- Filter: hard DCN constraint ------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        doms = state.try_read(_FILTER_KEY)
+        if doms is None:
+            return Status.success()
+        d = node_info.node.meta.labels.get(LABEL_DCN_DOMAIN, "")
+        if self.args.hard_domain_policy == HARD_SAME_DOMAIN:
+            if d in doms.domains:
+                return Status.success()
+            return Status.unschedulable(
+                "node outside the set's DCN domain (hard same-domain policy)")
+        if d.split("/")[0] in doms.zones:
+            return Status.success()
+        return Status.unschedulable(
+            "node outside the set's DCN zone (hard same-zone policy)")
+
+    # -- PostFilter: proactive whole-set teardown -----------------------------
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map
+                    ) -> Tuple[Optional[PostFilterResult], Status]:
+        """Runs after Coscheduling's PostFilter (profile order), which has
+        already swept this pod's OWN gang and denied it unless the quorum
+        gap was small. Mirror that judgement one level up: if this member
+        gang is genuinely stuck, the sibling slices' reservations are doing
+        nothing but stranding chips — release them now rather than when the
+        set timeout expires."""
+        pg = self._pod_set_pg(pod)
+        if pg is None or not self._barrier_enabled(pg):
+            return PostFilterResult(), Status.unschedulable()
+        assigned = self.handle.snapshot_shared_lister().assigned_count(
+            pg.meta.name, pod.namespace)
+        if pg.spec.min_member > 0:
+            gap = (pg.spec.min_member - assigned) / pg.spec.min_member
+            if gap <= 0.1:
+                # same ≤10% grace as Coscheduling: the gang is nearly there,
+                # let its remaining members try before nuking the whole set
+                return PostFilterResult(), Status.unschedulable()
+        set_key = self._set_key(pod.namespace, pg.spec.multislice_set)
+        self._deny_set(set_key, pod.namespace, pg.spec.multislice_set,
+                       f"member gang {pg.meta.name} unschedulable "
+                       f"(pod {pod.name})")
+        return PostFilterResult(), Status.unschedulable(
+            f"multislice set {set_key} torn down: member gang "
+            f"{pg.meta.name} is unschedulable")
+
+    # -- PreScore / Score: DCN proximity preference ---------------------------
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        pg = self._pod_set_pg(pod)
+        if pg is None:
+            return Status.skip()
+        # hard mode already swept the snapshot for this cycle in pre_filter;
+        # reuse its stash instead of a second O(nodes) walk
+        stashed = state.try_read(_FILTER_KEY)
+        if stashed is not None:
+            state.write(_SCORE_KEY, stashed)
+            return Status.success()
+        domains = self._sibling_domains(pod.namespace, pg.spec.multislice_set,
+                                        pg.meta.name)
+        if not domains:
+            return Status.skip()  # first slice of the set: nothing to pull toward
+        state.write(_SCORE_KEY, _Domains(domains))
+        return Status.success()
+
+    def score(self, state: CycleState, pod: Pod,
+              node_name: str) -> Tuple[int, Status]:
+        doms = state.try_read(_SCORE_KEY)
         if doms is None:
             return 0, Status.success()
         info = self.handle.snapshot_shared_lister().get(node_name)
@@ -101,3 +307,86 @@ class MultiSlice(PreScorePlugin, ScorePlugin):
         if d.split("/")[0] in doms.zones:
             return min(MAX_NODE_SCORE, self.args.adjacent_domain_score), Status.success()
         return 0, Status.success()
+
+    # -- Permit: the set barrier ----------------------------------------------
+
+    def permit(self, state: CycleState, pod: Pod,
+               node_name: str) -> Tuple[Status, float]:
+        pg = self._pod_set_pg(pod)
+        if pg is None or not self._barrier_enabled(pg):
+            return Status.success(), 0.0
+        if self._set_complete(pod, pg):
+            self._allow_set_waiters(pod.namespace, pg.spec.multislice_set)
+            return Status.success(), 0.0
+        klog.V(3).info_s("pod waiting for its multislice set", pod=pod.key,
+                         set=pg.spec.multislice_set,
+                         setSize=pg.spec.multislice_set_size)
+        return Status.wait(), float(self.args.set_schedule_timeout_seconds)
+
+    def _set_complete(self, pod: Pod, pg: PodGroup) -> bool:
+        """Every member gang of the set has quorum. The in-flight pod is not
+        in the cycle snapshot, so its own gang counts +1 (the coscheduling
+        convention, core.go:209-215); sibling gangs' members are all either
+        bound or assumed-at-the-barrier, so the snapshot sees them."""
+        members = self._member_pgs(pod.namespace, pg.spec.multislice_set)
+        if len(members) < pg.spec.multislice_set_size:
+            return False
+        snapshot = self.handle.snapshot_shared_lister()
+        for g in members:
+            assigned = snapshot.assigned_count(g.meta.name, pod.namespace)
+            if g.meta.name == pg.meta.name:
+                assigned += 1
+            if assigned < g.spec.min_member:
+                return False
+        return True
+
+    def _allow_set_waiters(self, namespace: str, set_name: str) -> None:
+        member_names = {g.meta.name
+                        for g in self._member_pgs(namespace, set_name)}
+
+        def allow(waiting_pod):
+            wp = waiting_pod.pod
+            if (wp.namespace == namespace
+                    and pod_group_label(wp) in member_names):
+                klog.V(3).info_s("multislice set complete, allowing",
+                                 pod=wp.key, set=set_name)
+                waiting_pod.allow(self.NAME)
+        self.handle.iterate_over_waiting_pods(allow)
+
+    # -- Reserve / Unreserve: whole-set unwind --------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pg = self._pod_set_pg(pod)
+        if pg is None or not self._barrier_enabled(pg):
+            return
+        set_key = self._set_key(pod.namespace, pg.spec.multislice_set)
+        if set_key in self._denied_sets:
+            return   # cascade guard: a sweep already ran for this denial
+        self._deny_set(set_key, pod.namespace, pg.spec.multislice_set,
+                       f"member pod {pod.key} unreserved")
+
+    def _deny_set(self, set_key: str, namespace: str, set_name: str,
+                  reason: str) -> None:
+        """Deny the set (TTL from first denial) and reject every member
+        gang's waiting pods. Each rejection resolves that pod's permit
+        barrier; the scheduler's resolution callback runs the pod's
+        unreserve chain on the bind pool, which re-enters unreserve() above
+        and stops at the cascade guard."""
+        self._denied_sets.add(set_key)
+        self._permitted_sets.delete(set_key)
+        klog.V(3).info_s("multislice set denied", set=set_key, reason=reason)
+        member_names = {g.meta.name
+                        for g in self._member_pgs(namespace, set_name)}
+
+        def reject(waiting_pod):
+            wp = waiting_pod.pod
+            if (wp.namespace == namespace
+                    and pod_group_label(wp) in member_names):
+                klog.V(3).info_s("rejecting multislice set member",
+                                 pod=wp.key, set=set_key)
+                waiting_pod.reject(self.NAME,
+                                   f"multislice set {set_key} denied: {reason}")
+        self.handle.iterate_over_waiting_pods(reject)

@@ -196,7 +196,8 @@ class SchedulingQueue:
             self._unschedulable[key] = info
 
     def requeue_after_failure(self, info: QueuedPodInfo,
-                              to_backoff: bool = False) -> None:
+                              to_backoff: bool = False,
+                              delay_s: Optional[float] = None) -> None:
         """After a failed attempt: park in unschedulableQ; cluster events (or
         the periodic flush) move it back through backoff. `attempts` was
         already incremented by pop().
@@ -204,17 +205,24 @@ class SchedulingQueue:
         to_backoff=True short-circuits straight to backoffQ — used for pods
         that just won preemption (nominated node set): their victim-delete
         events fired synchronously inside their own cycle, before parking, so
-        no later event would unstick them."""
-        if to_backoff:
+        no later event would unstick them.
+
+        delay_s (implies to_backoff) overrides the exponential backoff with
+        an exact delay — used for time-bounded rejections (denial windows,
+        Status.retry_after_s): the pod becomes schedulable when the WINDOW
+        lapses, which no cluster event announces."""
+        if to_backoff or delay_s is not None:
             with self._lock:
                 key = info.pod.key
                 if key in self._active or key in self._unschedulable:
                     return
                 info.timestamp = self._clock()
-                expiry = info.timestamp + info.backoff_duration(
-                    self._initial_backoff_s, self._max_backoff_s)
+                delay = delay_s if delay_s is not None else \
+                    info.backoff_duration(self._initial_backoff_s,
+                                          self._max_backoff_s)
                 heapq.heappush(self._backoff,
-                               (expiry, next(self._backoff_seq), info))
+                               (info.timestamp + delay,
+                                next(self._backoff_seq), info))
                 self._bk_add(key)
                 self._lock.notify_all()
             return

@@ -319,7 +319,7 @@ class Scheduler:
                               state, assumed, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
-            self.cache.forget_pod(assumed)
+            self._forget_and_signal(assumed)
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
@@ -328,7 +328,7 @@ class Scheduler:
                               state, assumed, node_name)
         if not s.is_success() and not s.is_wait():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
-            self.cache.forget_pod(assumed)
+            self._forget_and_signal(assumed)
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
@@ -524,21 +524,21 @@ class Scheduler:
         s = permit_status
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self.cache.forget_pod(pod)
+            self._forget_and_signal(pod)
             self._handle_failure(info, s)
             return
         s = self._timed_point("PreBind", self._fw.run_pre_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self.cache.forget_pod(pod)
+            self._forget_and_signal(pod)
             self._handle_failure(info, s)
             return
         s = self._timed_point("Bind", self._fw.run_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self.cache.forget_pod(pod)
+            self._forget_and_signal(pod)
             self._handle_failure(info, s)
             return
         self.cache.finish_binding(pod)
@@ -552,6 +552,16 @@ class Scheduler:
                           state, pod, node_name)
         self._activate_pods(pods_to_activate)
 
+    def _forget_and_signal(self, assumed: Pod) -> None:
+        """Forget an assumed pod AND wake unschedulable pods that a pod
+        deletion would wake. Releasing a reservation frees the same
+        resources a deletion frees, but comes from inside the scheduler, so
+        no informer event fires for it — without this, a gang whose rivals
+        released an entire slice (permit timeout, multislice set teardown,
+        failed bind) sits in unschedulableQ until the periodic flush."""
+        self.cache.forget_pod(assumed)
+        self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
+
     # -- failure path ---------------------------------------------------------
 
     def _handle_failure(self, info: QueuedPodInfo, status: Status) -> None:
@@ -563,7 +573,8 @@ class Scheduler:
             return
         info.pod = live
         self.queue.requeue_after_failure(
-            info, to_backoff=bool(live.status.nominated_node_name))
+            info, to_backoff=bool(live.status.nominated_node_name),
+            delay_s=status.retry_after_s)
         self.clientset.record_event(
             pod.key, "Pod", "Warning", "FailedScheduling",
             status.message() or "unschedulable")

@@ -105,7 +105,8 @@ def make_pod_group(name: str, namespace: str = "default", min_member: int = 1,
                    min_resources: Optional[ResourceList] = None,
                    schedule_timeout_seconds: Optional[int] = None,
                    tpu_slice_shape: str = "", tpu_accelerator: str = "",
-                   multislice_set: str = "", multislice_index: int = 0) -> PodGroup:
+                   multislice_set: str = "", multislice_index: int = 0,
+                   multislice_set_size: int = 0) -> PodGroup:
     return PodGroup(
         meta=ObjectMeta(name=name, namespace=namespace),
         spec=PodGroupSpec(min_member=min_member, min_resources=min_resources,
@@ -113,7 +114,8 @@ def make_pod_group(name: str, namespace: str = "default", min_member: int = 1,
                           tpu_slice_shape=tpu_slice_shape,
                           tpu_accelerator=tpu_accelerator,
                           multislice_set=multislice_set,
-                          multislice_index=multislice_index))
+                          multislice_index=multislice_index,
+                          multislice_set_size=multislice_set_size))
 
 
 def make_elastic_quota(name: str, namespace: str,

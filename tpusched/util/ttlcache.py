@@ -37,6 +37,17 @@ class TTLCache:
             self._items[key] = (value, exp)
             return True
 
+    def remaining(self, key: str) -> float:
+        """Seconds until `key` expires; 0.0 if absent or already expired.
+        Lets rejection paths tell the scheduler exactly when a retry can
+        succeed (Status.with_retry_after)."""
+        now = self._clock()
+        with self._lock:
+            item = self._items.get(key)
+            if item is None or item[1] < now:
+                return 0.0
+            return item[1] - now
+
     def get(self, key: str):
         """Returns (value, True) if present and fresh, else (None, False)."""
         now = self._clock()
