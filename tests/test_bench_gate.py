@@ -1,0 +1,82 @@
+"""Perf-gate logic (VERDICT r3 #5): the gate must fail a synthetic +0.15s
+hot-path regression while passing ambient-noise inflation of the tail.
+Exercises bench.py's _check_gate directly with synthetic sample arrays —
+the statistic design is what's under test, not the scheduler."""
+import importlib
+import json
+
+import numpy as np
+import pytest
+
+bench = importlib.import_module("bench")
+
+# a quiet-machine headline distribution: min 0.26, p50 ~0.29, p99 ~0.34
+QUIET = [0.26, 0.27, 0.27, 0.28, 0.28, 0.29, 0.29, 0.30, 0.30, 0.31,
+         0.31, 0.32, 0.26, 0.27, 0.28, 0.29, 0.30, 0.31, 0.32, 0.33,
+         0.28, 0.29, 0.30, 0.34]
+
+
+@pytest.fixture
+def gate(monkeypatch, tmp_path):
+    """Arm the gate against a budget dict; returns a runner that yields the
+    failure list for a given sample set."""
+    def run(budget: dict, times):
+        monkeypatch.setattr(bench, "_GATE", True)
+        monkeypatch.setattr(bench, "_budgets_cache", None)
+        monkeypatch.setattr(bench, "_gate_failures", [])
+        path = tmp_path / "budget.json"
+        path.write_text(json.dumps(budget))
+        monkeypatch.setattr(bench, "_BUDGETS_PATH", str(path))
+        bench._check_gate("gang_p99", times)
+        return list(bench._gate_failures)
+    return run
+
+
+GANG_BUDGET = {"gang_p99": {"min": 0.38, "p99": 0.9}}
+
+
+def test_quiet_machine_passes(gate):
+    assert gate(GANG_BUDGET, QUIET) == []
+
+
+def test_ambient_noise_passes(gate):
+    """Ambient load: tail inflated by up to +0.2s on half the samples (the
+    observed same-code spread) — the min is untouched, so no failure.
+    This is the regime that forced the round-3 budget to 0.65."""
+    noisy = [t + 0.2 * (i % 2) for i, t in enumerate(QUIET)]
+    assert float(np.percentile(noisy, 99)) > 0.5   # old-style gate territory
+    assert gate(GANG_BUDGET, noisy) == []
+
+
+def test_hot_path_regression_fails(gate):
+    """+0.15s on EVERY sample (a real hot-path cost): min moves with it."""
+    regressed = [t + 0.15 for t in QUIET]
+    failures = gate(GANG_BUDGET, regressed)
+    assert failures and "min" in failures[0]
+
+
+def test_round2_regression_would_fail(gate):
+    """Round 2's 0.577s p99 regression (quiet min ~0.5) must not pass —
+    the precise failure mode the round-3 0.65 p99-only budget had."""
+    r2_like = [0.50 + 0.005 * i for i in range(24)]
+    assert gate(GANG_BUDGET, r2_like)
+
+
+def test_catastrophic_tail_fails(gate):
+    """p99 backstop: a livelock-ish tail fails even with a healthy min."""
+    tail = QUIET[:-1] + [1.4]
+    failures = gate(GANG_BUDGET, tail)
+    assert failures and "p99" in failures[0]
+
+
+def test_legacy_number_budget_still_gates_p99(gate):
+    assert gate({"gang_p99": 0.65}, QUIET) == []
+    assert gate({"gang_p99": 0.65}, [t + 0.4 for t in QUIET])
+
+
+def test_malformed_budget_reports(gate):
+    assert gate({"gang_p99": "fast"}, QUIET)
+
+
+def test_missing_key_passes(gate):
+    assert gate({"other": 1.0}, QUIET) == []
