@@ -59,10 +59,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--suggest-migrations", type=int, default=0,
                    metavar="N",
                    help="when the gang is infeasible, search for up to N "
-                        "single-gang migration plans that would admit it "
-                        "(defrag advisor, kep/302): each plan re-places the "
-                        "migrated gang too — exit 0 iff the gang fits or a "
-                        "plan exists")
+                        "migration plans that would admit it (defrag "
+                        "advisor, kep/302): each plan re-places the "
+                        "migrated gang(s) too — exit 0 iff the gang fits or "
+                        "a plan exists")
+    p.add_argument("--max-moves", type=int, default=1, choices=(1, 2),
+                   help="migration plan depth: 1 = single-gang plans only "
+                        "(default), 2 = fall through to a bounded "
+                        "pair search when no single move admits the gang")
     return p
 
 
@@ -79,7 +83,8 @@ def main(argv=None) -> int:
         conflicting = [f"--{d.replace('_', '-')}"
                        for d in ("members", "slice_shape", "accelerator",
                                  "chips", "cpu", "memory", "namespace",
-                                 "priority", "suggest_migrations")
+                                 "priority", "suggest_migrations",
+                                 "max_moves")
                        if getattr(args, d) != parser.get_default(d)]
         if conflicting:
             parser.error(
@@ -131,6 +136,7 @@ def main(argv=None) -> int:
                          namespace=args.namespace,
                          priority=args.priority),
                 max_suggestions=args.suggest_migrations,
+                max_moves=args.max_moves,
                 timeout_s=args.timeout, config_path=args.config,
                 scheduler_name=args.scheduler_name)
         except (OSError, ValueError, ConfigError) as e:

@@ -1,18 +1,25 @@
-"""Defragmentation advisor: which gang migration would admit a blocked job.
+"""Defragmentation advisor: which gang migration(s) would admit a blocked job.
 
 A torus fleet fragments: enough free chips exist in total, but no
 CONTIGUOUS window fits the next slice gang, and quota/priority rules make
 preemption unavailable (the victims are entitled to their capacity). The
-operator's question becomes: *which running gang should I migrate (delete
-and resubmit) so the blocked job fits — without losing the migrated gang?*
+operator's question becomes: *which running gang(s) should I migrate
+(delete and resubmit) so the blocked job fits — without losing the
+migrated gangs?*
 
 The reference world has no answer short of trial-and-error on production.
-Here the advisor reuses the shadow machinery (KEP-302): for each candidate
-resident gang (smallest chip footprint first — cheapest migration first),
-fork a fresh shadow, remove the candidate, schedule the TARGET job first,
-then resubmit the candidate. A suggestion is only returned when BOTH land —
-a migration that admits the target by orphaning the migrated gang is not a
-plan, it's an outage. Every placement decision is the real scheduler's.
+Here the advisor reuses the shadow machinery (KEP-302): fork a fresh
+shadow, remove the candidate gang(s), schedule the TARGET job first, then
+resubmit the candidates (largest footprint first — the safest re-packing
+order). A suggestion is only returned when EVERYONE lands — a migration
+that admits the target by orphaning a migrated gang is not a plan, it's an
+outage. Every placement decision is the real scheduler's.
+
+Search is cheapest-first and bounded: all single moves (smallest chip
+footprint first), then — when ``max_moves >= 2`` — pairs ordered by
+combined footprint, capped at ``max_pair_trials`` shadow runs (a fleet
+fragmented enough to need 2-step plans has O(gangs²) pairs; the cap keeps
+the advisor interactive).
 
 This is deliberately an ADVISOR, not an actuator: it prints the plan (who
 moves, where everyone ends up); executing the migration stays a human/
@@ -22,6 +29,8 @@ splits descheduling from scheduling.
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 from ..api.scheduling import POD_GROUP_LABEL
@@ -40,18 +49,50 @@ _GONE = Pod()
 
 
 @dataclasses.dataclass
-class MigrationSuggestion:
-    """One workable plan: migrate ``migrate`` and the target fits."""
-    migrate: str                        # gang full name to migrate
-    migrate_chips: int                  # its chip footprint (migration cost)
-    target: WhatIfReport                # where the target job lands
-    resubmitted: WhatIfReport           # where the migrated gang re-lands
+class MigrationMove:
+    """One migrated gang within a plan and where it re-lands."""
+    gang: str                           # gang full name
+    chips: int                          # its chip footprint (migration cost)
+    resubmitted: WhatIfReport           # where it re-lands
 
     def to_dict(self) -> dict:
-        return {"migrate": self.migrate,
-                "migrate_chips": self.migrate_chips,
-                "target": self.target.to_dict(),
+        return {"gang": self.gang, "chips": self.chips,
                 "resubmitted": self.resubmitted.to_dict()}
+
+
+@dataclasses.dataclass
+class MigrationSuggestion:
+    """One workable plan: migrate every gang in ``moves`` (in order) and the
+    target fits. Single-move plans keep the legacy accessors
+    (``migrate``/``migrate_chips``/``resubmitted``)."""
+    moves: List[MigrationMove]
+    target: WhatIfReport                # where the target job lands
+
+    @property
+    def migrate(self) -> str:
+        return "+".join(m.gang for m in self.moves)
+
+    @property
+    def migrate_chips(self) -> int:
+        return sum(m.chips for m in self.moves)
+
+    @property
+    def resubmitted(self) -> WhatIfReport:
+        if len(self.moves) != 1:
+            # silently returning one gang's report would hand a runbook
+            # half a plan; multi-move callers must read .moves
+            raise ValueError(
+                f"plan migrates {len(self.moves)} gangs; read .moves")
+        return self.moves[0].resubmitted
+
+    def to_dict(self) -> dict:
+        out = {"migrate": self.migrate,
+               "migrate_chips": self.migrate_chips,
+               "target": self.target.to_dict(),
+               "moves": [m.to_dict() for m in self.moves]}
+        if len(self.moves) == 1:   # legacy single-move shape, kept stable
+            out["resubmitted"] = self.moves[0].resubmitted.to_dict()
+        return out
 
 
 def _resident_gangs(api: APIServer) -> List[Tuple[str, int, int]]:
@@ -80,24 +121,121 @@ def _resident_gangs(api: APIServer) -> List[Tuple[str, int, int]]:
     return out
 
 
+def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
+               job_kw: dict, timeout_s: float
+               ) -> Optional[Tuple[WhatIfReport, List[MigrationMove]]]:
+    """One shadow trial: remove every gang in ``moves``, schedule the
+    target, resubmit the gangs largest-footprint-first. Returns the plan's
+    reports, or None when anyone ends up homeless or a third party pays."""
+    fork = _shadow_of(base, None)
+    captured = []   # (full, chips, moved_pg, moved_pods)
+    for full, _, n_chips in moves:
+        ns, gname = full.split("/", 1)
+        moved_pods = [p for p in fork.list(srv.PODS, ns)
+                      if p.meta.labels.get(POD_GROUP_LABEL) == gname]
+        moved_pg = fork.try_get(srv.POD_GROUPS, full)
+        for p in moved_pods:
+            fork.delete(srv.PODS, p.meta.key)
+        if moved_pg is not None:
+            fork.delete(srv.POD_GROUPS, full)
+        captured.append((full, n_chips, moved_pg, moved_pods))
+    # big gangs are the hardest to re-home: place them first
+    captured.sort(key=lambda t: (-t[1], t[0]))
+
+    sched = Scheduler(fork, default_registry(), profile)
+    sched.run()
+    try:
+        pre_resident = {p.meta.key for p in fork.list(srv.PODS)}
+        target, target_keys = _run_one(
+            fork, timeout_s=timeout_s,
+            scheduler_name=profile.scheduler_name, **job_kw)
+        if not target.feasible:
+            return None
+        plan_moves: List[MigrationMove] = []
+        for full, n_chips, moved_pg, moved_pods in captured:
+            # resubmit the migrated gang: its PodGroup, then unbound copies
+            # of its pods — the real scheduler re-places it
+            if moved_pg is not None:
+                moved_pg.meta.resource_version = 0
+                fork.create(srv.POD_GROUPS, moved_pg)
+            keys = []
+            for p in moved_pods:
+                q = p.deepcopy()
+                q.meta.resource_version = 0
+                q.spec.node_name = ""
+                q.meta.annotations.pop(COORD_ANNOTATION, None)
+                q.meta.annotations.pop(POOL_ANNOTATION, None)
+                q.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
+                q.status.conditions = []
+                fork.create(srv.PODS, q)
+                keys.append(q.meta.key)
+            deadline = _time.monotonic() + timeout_s
+            ok = False
+            while _time.monotonic() < deadline:
+                live = [fork.peek(srv.PODS, k) for k in keys]
+                if all(x is not None and x.spec.node_name for x in live):
+                    ok = True
+                    break
+                _time.sleep(0.02)
+            if not ok:
+                return None   # target fits but this migrated gang is homeless
+            placements = {}
+            coords = {}
+            pool = ""
+            for k in keys:
+                p = fork.peek(srv.PODS, k)
+                placements[k] = p.spec.node_name
+                coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
+                pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
+            plan_moves.append(MigrationMove(
+                gang=full, chips=n_chips,
+                resubmitted=WhatIfReport(
+                    feasible=True, placements=placements, pool=pool,
+                    coords=coords, victims=[], elapsed_s=0.0, reason="")))
+        # the resubmissions must not have undone the plan: with an evicting
+        # profile they could have preempted the target's own pods or
+        # uninvolved residents to bind — either invalidates the "everyone
+        # lands, nobody else pays" contract
+        target_still = all(
+            (fork.peek(srv.PODS, k) or _GONE).spec.node_name
+            for k in target_keys)
+        after = {p.meta.key for p in fork.list(srv.PODS)}
+        if not target_still or (pre_resident - after):
+            return None
+        return target, plan_moves
+    finally:
+        sched.stop()
+
+
 def suggest_migrations(source_api: Optional[APIServer] = None,
                        state_dir: Optional[str] = None, *,
                        job: dict,
                        max_suggestions: int = 1,
+                       max_moves: int = 1,
+                       max_pair_trials: int = 24,
                        candidates: Optional[List[str]] = None,
                        timeout_s: float = 20.0,
                        config_path: Optional[str] = None,
                        scheduler_name: Optional[str] = None
                        ) -> List[MigrationSuggestion]:
-    """Single-move migration plans that admit ``job`` (simulate_gang gang
-    kwargs; ``members`` required). Candidates default to every fully-bound
-    gang, tried smallest-chip-footprint first; pass ``candidates`` (gang
-    full names) to restrict — e.g. to gangs a team is willing to move.
-    Returns up to ``max_suggestions`` plans; empty list = no single
-    migration helps (the job needs >1 move, preemption, or more capacity).
-    """
+    """Migration plans that admit ``job`` (simulate_gang gang kwargs;
+    ``members`` required). Candidates default to every fully-bound gang,
+    tried smallest-chip-footprint first; pass ``candidates`` (gang full
+    names) to restrict — e.g. to gangs a team is willing to move.
+
+    ``max_moves=1`` (default) searches single migrations only.
+    ``max_moves=2`` falls through to a bounded pair search (combined
+    footprint ascending, at most ``max_pair_trials`` shadow runs) when the
+    quota of single-move plans isn't met — the fleet regime where no one
+    migration opens a window but two do.
+
+    Returns up to ``max_suggestions`` plans, cheapest-first; empty list =
+    no plan within the search bounds (the job needs more moves, preemption,
+    or more capacity)."""
     if not isinstance(job, dict) or not isinstance(job.get("members"), int):
         raise ValueError("job must be a dict with integer 'members'")
+    if max_moves not in (1, 2):
+        raise ValueError("max_moves must be 1 or 2")
     base = _shadow_of(source_api, state_dir)
     profile = _make_profile(False, timeout_s, config_path, scheduler_name)
     gangs = _resident_gangs(base)
@@ -123,81 +261,24 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
                              "existing pod; pass job['name']")
 
     suggestions: List[MigrationSuggestion] = []
-    for full, n_members, n_chips in gangs:
+    for g in gangs:
         if len(suggestions) >= max_suggestions:
+            return suggestions
+        result = _try_moves(base, profile, [g], job_kw, timeout_s)
+        if result is not None:
+            suggestions.append(MigrationSuggestion(moves=result[1],
+                                                   target=result[0]))
+    if max_moves < 2:
+        return suggestions
+    pairs = sorted(itertools.combinations(gangs, 2),
+                   key=lambda pr: (pr[0][2] + pr[1][2], pr[0][0], pr[1][0]))
+    trials = 0
+    for pair in pairs:
+        if len(suggestions) >= max_suggestions or trials >= max_pair_trials:
             break
-        ns, gname = full.split("/", 1)
-        fork = _shadow_of(base, None)
-        # capture the candidate's pods (for resubmission), then remove them
-        moved_pods = [p for p in fork.list(srv.PODS, ns)
-                      if p.meta.labels.get(POD_GROUP_LABEL) == gname]
-        moved_pg = fork.try_get(srv.POD_GROUPS, full)
-        for p in moved_pods:
-            fork.delete(srv.PODS, p.meta.key)
-        if moved_pg is not None:
-            fork.delete(srv.POD_GROUPS, full)
-
-        sched = Scheduler(fork, default_registry(), profile)
-        sched.run()
-        try:
-            pre_resident = {p.meta.key for p in fork.list(srv.PODS)}
-            target, target_keys = _run_one(
-                fork, timeout_s=timeout_s,
-                scheduler_name=profile.scheduler_name, **job_kw)
-            if not target.feasible:
-                continue
-            # resubmit the migrated gang: its PodGroup, then unbound copies
-            # of its pods — the real scheduler re-places it
-            if moved_pg is not None:
-                moved_pg.meta.resource_version = 0
-                fork.create(srv.POD_GROUPS, moved_pg)
-            keys = []
-            for p in moved_pods:
-                q = p.deepcopy()
-                q.meta.resource_version = 0
-                q.spec.node_name = ""
-                q.meta.annotations.pop(COORD_ANNOTATION, None)
-                q.meta.annotations.pop(POOL_ANNOTATION, None)
-                q.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
-                q.status.conditions = []
-                fork.create(srv.PODS, q)
-                keys.append(q.meta.key)
-            import time as _time
-            deadline = _time.monotonic() + timeout_s
-            ok = False
-            while _time.monotonic() < deadline:
-                live = [fork.peek(srv.PODS, k) for k in keys]
-                if all(x is not None and x.spec.node_name for x in live):
-                    ok = True
-                    break
-                _time.sleep(0.02)
-            if not ok:
-                continue   # target fits but the migrated gang is homeless
-            # the resubmission must not have undone the plan: with an
-            # evicting profile it could have preempted the target's own
-            # pods or uninvolved residents to bind — either invalidates
-            # the "everyone lands, nobody else pays" contract
-            target_still = all(
-                (fork.peek(srv.PODS, k) or _GONE).spec.node_name
-                for k in target_keys)
-            after = {p.meta.key for p in fork.list(srv.PODS)}
-            third_party_evicted = (pre_resident - after)
-            if not target_still or third_party_evicted:
-                continue
-            placements = {}
-            coords = {}
-            pool = ""
-            for k in keys:
-                p = fork.peek(srv.PODS, k)
-                placements[k] = p.spec.node_name
-                coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
-                pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
-            resub = WhatIfReport(feasible=True, placements=placements,
-                                 pool=pool, coords=coords, victims=[],
-                                 elapsed_s=0.0, reason="")
-            suggestions.append(MigrationSuggestion(
-                migrate=full, migrate_chips=n_chips, target=target,
-                resubmitted=resub))
-        finally:
-            sched.stop()
+        trials += 1
+        result = _try_moves(base, profile, list(pair), job_kw, timeout_s)
+        if result is not None:
+            suggestions.append(MigrationSuggestion(moves=result[1],
+                                                   target=result[0]))
     return suggestions
