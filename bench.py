@@ -397,8 +397,11 @@ def bench_slice_reclaim() -> None:
         times, "slice_reclaim_p99")
 
 
-def run_multislice_once() -> float:
-    """BASELINE eval #5: 4 x v5p-64 slices of one multislice set over DCN."""
+def run_multislice_once(set_size: int = 0) -> float:
+    """BASELINE eval #5: 4 x v5p-64 slices of one multislice set over DCN.
+    ``set_size=4`` measures the set-level barrier path (VERDICT r3 #2): no
+    slice binds until every member gang has quorum, so the interval adds
+    the barrier's release sweep on top of DCN scoring."""
     from tpusched.api.resources import TPU
     from tpusched.apiserver import server as srv
     from tpusched.config.profiles import tpu_gang_profile
@@ -419,7 +422,7 @@ def run_multislice_once() -> float:
             c.api.create(srv.POD_GROUPS, make_pod_group(
                 name, min_member=16, tpu_slice_shape="4x4x4",
                 tpu_accelerator="tpu-v5p", multislice_set="llama",
-                multislice_index=s))
+                multislice_index=s, multislice_set_size=set_size))
             ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
                   for i in range(16)]
             c.create_pods(ps)
@@ -435,6 +438,87 @@ def bench_multislice() -> None:
         "multislice 4x v5p-64 set-to-Bound p99, DCN-aware scoring "
         "(BASELINE eval #5)",
         times, "multislice_p99")
+    times = _repeat(run_multislice_once, SUPP_REPEATS, 4)
+    emit_latency(
+        "multislice ATOMIC 4x v5p-64 set-to-Bound p99 "
+        "(set-level all-or-nothing barrier, multislice_set_size=4)",
+        times, "multislice_atomic_p99")
+
+
+def run_ha_takeover_once() -> float:
+    """Active-standby takeover (VERDICT r3 #3): active binds a resident
+    256-pod gang, a second 256-pod gang arrives, the active dies with
+    SIGKILL semantics (lease NOT released, journal fenced). Measures
+    death → the standby has lease-acquired (waiting out the 1s lease),
+    replayed the WAL (~520 objects) and completed the in-flight gang."""
+    import shutil
+    from tpusched.api.resources import TPU, make_resources
+    from tpusched.apiserver import server as srv
+    from tpusched.sched.ha import HAScheduler
+    from tpusched.testing import make_pod, make_pod_group, make_tpu_pool
+
+    d = tempfile.mkdtemp(prefix="tpusched-bench-ha-")
+    a = HAScheduler(d, identity="bench-a", lease_duration_s=1.0,
+                    renew_interval_s=0.25)
+    b = HAScheduler(d, identity="bench-b", lease_duration_s=1.0,
+                    renew_interval_s=0.25)
+    try:
+        a.run()
+        if not a.is_active.wait(10):
+            raise RuntimeError("active never started leading")
+        b.run()
+        for name in ("pool-a", "pool-b"):
+            topo, nodes = make_tpu_pool(name, dims=(8, 8, 4))
+            a.api.create(srv.TPU_TOPOLOGIES, topo)
+            for n in nodes:
+                a.api.create(srv.NODES, n)
+
+        def gang(name):
+            a.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=256, tpu_slice_shape="8x8x4",
+                tpu_accelerator="tpu-v5p"))
+            ps = [make_pod(f"{name}-{i:03d}", pod_group=name,
+                           limits={TPU: 1},
+                           requests=make_resources(cpu=1, memory="1Gi"))
+                  for i in range(256)]
+            for p in ps:
+                a.api.create(srv.PODS, p)
+            return [p.key for p in ps]
+
+        def bound(api, keys):
+            return sum(1 for k in keys
+                       if (p := api.try_get(srv.PODS, k)) is not None
+                       and p.spec.node_name)
+
+        g1 = gang("resident")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and bound(a.api, g1) < 256:
+            time.sleep(0.01)
+        if bound(a.api, g1) < 256:
+            raise RuntimeError("resident gang did not bind")
+        g2 = gang("inflight")
+        start = time.perf_counter()
+        a.crash()
+        if not b.is_active.wait(30):
+            raise RuntimeError("standby never took over")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and bound(b.api, g2) < 256:
+            time.sleep(0.01)
+        if bound(b.api, g2) < 256:
+            raise RuntimeError("standby did not complete the in-flight gang")
+        return time.perf_counter() - start
+    finally:
+        a.crash()
+        b.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def bench_ha_takeover() -> None:
+    times = _repeat(run_ha_takeover_once, 8)
+    emit_latency(
+        "HA takeover p99: active SIGKILL mid-256-pod-gang -> standby lease "
+        "acquire (1s lease) + WAL replay (~520 objects) + gang completion",
+        times, "ha_takeover_p99")
 
 
 def run_scale_once(hosts: int = 1024, pods: int = 64) -> float:
@@ -740,7 +824,8 @@ def main() -> int:
         return smoke_gate()
     for bench in (bench_quota, bench_slice_reclaim, bench_multislice,
                   bench_scale, bench_fleet_gang, bench_gang_wal,
-                  bench_wal_recovery, bench_tpu_workload):
+                  bench_wal_recovery, bench_ha_takeover,
+                  bench_tpu_workload):
         try:
             bench()
         except Exception as e:  # keep the headline line alive no matter what

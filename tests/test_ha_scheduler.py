@@ -1,0 +1,316 @@
+"""Active-standby scheduler HA (VERDICT r3 #3): two replicas over a shared
+--state-dir, file-lease leader election, WAL replay on takeover, and the
+chaos case — the active dies mid-256-pod-gang and the standby completes it
+against the surviving binds."""
+import json
+import os
+import time
+
+import pytest
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import APIServer
+from tpusched.apiserver import server as srv
+from tpusched.apiserver.persistence import attach, load_into
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.sched.ha import FileLease, HAScheduler
+from tpusched.testing import make_pod, make_pod_group, make_tpu_pool
+from tpusched.testing.cluster import wait_until
+
+
+# -- FileLease unit behavior --------------------------------------------------
+
+def test_file_lease_mutual_exclusion_and_expiry(tmp_path):
+    now = [100.0]
+    lease = FileLease(str(tmp_path), clock=lambda: now[0])
+    assert lease.acquire_or_renew("a", 5.0)
+    assert not lease.acquire_or_renew("b", 5.0)   # live, someone else's
+    assert lease.acquire_or_renew("a", 5.0)       # renew own
+    assert lease.holder() == "a"
+    now[0] += 6.0                                 # expire
+    assert lease.holder() == ""
+    assert lease.acquire_or_renew("b", 5.0)       # steal after expiry
+    assert not lease.acquire_or_renew("a", 5.0)
+    lease.release("a")                            # not the holder: no-op
+    assert lease.holder() == "b"
+    lease.release("b")
+    assert lease.holder() == ""
+    assert lease.acquire_or_renew("a", 5.0)       # immediate after release
+
+
+def test_file_lease_survives_torn_file(tmp_path):
+    lease = FileLease(str(tmp_path))
+    (tmp_path / "scheduler.lease").write_text("{not json")
+    assert lease.holder() == ""
+    assert lease.acquire_or_renew("a", 5.0)
+
+
+# -- WAL fencing --------------------------------------------------------------
+
+def test_takeover_fences_deposed_journal_writes(tmp_path):
+    """attach() rotates the WAL inode: a deposed active still appending
+    through its old journal writes into an orphaned file, not the new
+    active's WAL."""
+    d = str(tmp_path)
+    api1 = APIServer()
+    j1 = attach(api1, d)
+    api1.create(srv.POD_GROUPS, make_pod_group("before", min_member=1))
+    assert j1.flush(timeout=10)
+
+    api2 = APIServer()          # the new active takes over the directory
+    j2 = attach(api2, d)
+    assert api2.try_get(srv.POD_GROUPS, "default/before") is not None
+
+    # the deposed active keeps writing through its orphaned fd
+    api1.create(srv.POD_GROUPS, make_pod_group("zombie", min_member=1))
+    j1.flush(timeout=10)
+    api2.create(srv.POD_GROUPS, make_pod_group("after", min_member=1))
+    assert j2.flush(timeout=10)
+    j1.close()
+    j2.close()
+
+    fresh = APIServer()
+    load_into(fresh, d)
+    assert fresh.try_get(srv.POD_GROUPS, "default/after") is not None
+    assert fresh.try_get(srv.POD_GROUPS, "default/zombie") is None
+
+
+# -- failover e2e -------------------------------------------------------------
+
+def _fleet(api, pools=("pool-a", "pool-b")):
+    for name in pools:
+        topo, nodes = make_tpu_pool(name, dims=(8, 8, 4))   # 256 chips each
+        api.create(srv.TPU_TOPOLOGIES, topo)
+        for n in nodes:
+            api.create(srv.NODES, n)
+
+
+def _gang(api, name, members=256):
+    api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape="8x8x4",
+        tpu_accelerator="tpu-v5p"))
+    pods = [make_pod(f"{name}-{i:03d}", pod_group=name, limits={TPU: 1},
+                     requests=make_resources(cpu=1, memory="1Gi"))
+            for i in range(members)]
+    for p in pods:
+        api.create(srv.PODS, p)
+    return [p.key for p in pods]
+
+
+def _bound_count(api, keys):
+    n = 0
+    for k in keys:
+        p = api.try_get(srv.PODS, k)
+        if p is not None and p.spec.node_name:
+            n += 1
+    return n
+
+
+def _assert_binpack(api, keys):
+    from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION
+    used = {}
+    for k in keys:
+        p = api.try_get(srv.PODS, k)
+        used[p.spec.node_name] = used.get(p.spec.node_name, 0) + 1
+        # a bound pod without its chip assignment would mean the WAL
+        # persisted the bind but lost the Reserve-time annotation patch —
+        # the crash-consistency hole TpuSlice accounting cannot survive
+        assert CHIP_INDEX_ANNOTATION in p.meta.annotations, k
+    assert len(used) == 64 and all(v == 4 for v in used.values()), used
+
+
+def test_standby_completes_gang_after_active_crash(tmp_path):
+    """The headline chaos case. The active binds gang-1 fully, then dies
+    (SIGKILL semantics: lease kept, cleanup writes fenced) in the middle of
+    admitting 256-pod gang-2. The standby waits out the lease, replays the
+    WAL, preserves every surviving bind, and completes gang-2."""
+    state = str(tmp_path)
+    a = HAScheduler(state, identity="rep-a", lease_duration_s=1.5,
+                    renew_interval_s=0.3)
+    b = HAScheduler(state, identity="rep-b", lease_duration_s=1.5,
+                    renew_interval_s=0.3)
+    a.run()
+    assert a.is_active.wait(10)
+    b.run()                       # campaigns, must stay standby
+    try:
+        _fleet(a.api)
+        g1 = _gang(a.api, "g1")
+        assert wait_until(lambda: _bound_count(a.api, g1) == 256, timeout=60)
+        g1_before = {k: a.api.try_get(srv.PODS, k).spec.node_name for k in g1}
+        assert not b.is_active.is_set()
+
+        g2 = _gang(a.api, "g2")
+        # die mid-admission: as soon as any slice reservation work started
+        # (deterministically before the full gang is bound: the permit
+        # barrier releases binds only at quorum, and crash() fences the
+        # journal before stopping the binder threads)
+        died_at = time.monotonic()
+        a.crash()
+        pre = _bound_count(a.api, g2)
+        assert pre < 256, "crash landed after the whole gang bound"
+
+        assert b.is_active.wait(30), "standby never took over"
+        takeover_s = time.monotonic() - died_at
+        # the lease was never released: takeover must have waited it out
+        assert takeover_s >= 1.0, f"standby took over at {takeover_s:.2f}s " \
+                                  "without waiting out the crashed lease"
+        # gang-1's binds survived the replay byte-for-byte
+        for k, node in g1_before.items():
+            assert b.api.try_get(srv.PODS, k).spec.node_name == node
+        # and the standby completes gang-2
+        assert wait_until(lambda: _bound_count(b.api, g2) == 256, timeout=90)
+        _assert_binpack(b.api, g1)
+        _assert_binpack(b.api, g2)
+    finally:
+        a.crash()
+        b.stop()
+
+
+def test_clean_shutdown_hands_over_without_waiting_out_lease(tmp_path):
+    """stop() releases the lease: the standby activates promptly instead of
+    sleeping through the remaining duration."""
+    state = str(tmp_path)
+    a = HAScheduler(state, identity="rep-a", lease_duration_s=10.0,
+                    renew_interval_s=0.5)
+    b = HAScheduler(state, identity="rep-b", lease_duration_s=10.0,
+                    renew_interval_s=0.5)
+    a.run()
+    assert a.is_active.wait(10)
+    b.run()
+    try:
+        _fleet(a.api, pools=("pool-a",))
+        g1 = _gang(a.api, "g1")
+        assert wait_until(lambda: _bound_count(a.api, g1) == 256, timeout=60)
+        t0 = time.monotonic()
+        a.stop()                      # releases the lease
+        assert b.is_active.wait(8), "standby did not take over after release"
+        assert time.monotonic() - t0 < 8.0
+        assert _bound_count(b.api, g1) == 256
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_deposed_active_demotes_on_lost_lease(tmp_path):
+    """A replica that sleeps through its lease (wedged process) must demote
+    when it wakes and finds the lease stolen — exit-on-lost-lease."""
+    state = str(tmp_path)
+    now = [0.0]
+    a = HAScheduler(state, identity="rep-a", lease_duration_s=1.0,
+                    renew_interval_s=0.2)
+    a.run()
+    assert a.is_active.wait(10)
+    try:
+        # steal the lease out from under it (simulates: a froze > duration,
+        # b acquired); a's next renew must fail and demote it
+        lease = FileLease(state)
+        deadline = time.monotonic() + 10
+        stolen = False
+        while time.monotonic() < deadline and not stolen:
+            with lease._locked():
+                cur = lease._read()
+                if cur and cur.get("holder") == "rep-a":
+                    cur["holder"] = "rep-b"
+                    cur["renewed_at"] = time.time() + 3600
+                    tmp = lease.path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(cur, f)
+                    os.replace(tmp, lease.path)
+                    stolen = True
+        assert stolen
+        assert a.demoted.wait(10), "replica kept leading on a stolen lease"
+        assert wait_until(lambda: not a.is_active.is_set(), timeout=5)
+    finally:
+        a.stop()
+
+
+def test_cmd_level_ha_failover(tmp_path):
+    """Binary-level e2e: two `tpusched.cmd.scheduler` processes with
+    leaderElection in the config YAML and a shared --state-dir. SIGKILL the
+    active; the standby must start leading within the lease duration."""
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apiVersion: tpusched.config.tpu.dev/v1beta1
+        kind: TpuSchedulerConfiguration
+        leaderElection:
+          leaderElect: true
+          leaseDurationSeconds: 1.5
+          renewIntervalSeconds: 0.3
+        profiles:
+        - schedulerName: tpusched
+    """))
+    state = tmp_path / "state"
+
+    def spawn(log_name):
+        log = open(tmp_path / log_name, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpusched.cmd.scheduler",
+             "--config", str(cfg), "--state-dir", str(state), "-v", "2"],
+            stdout=log, stderr=subprocess.STDOUT)
+        return proc, tmp_path / log_name
+
+    def leading(logpath):
+        try:
+            return "started leading" in logpath.read_text()
+        except OSError:
+            return False
+
+    a, a_log = spawn("a.log")
+    b = b_log = None
+    try:
+        assert wait_until(lambda: leading(a_log), timeout=20), \
+            a_log.read_text()[-500:]
+        b, b_log = spawn("b.log")
+        assert wait_until(lambda: "campaigning" in b_log.read_text(),
+                          timeout=20)
+        time.sleep(0.5)
+        assert not leading(b_log), "standby led while the active was alive"
+        a.send_signal(signal.SIGKILL)
+        a.wait(timeout=10)
+        assert wait_until(lambda: leading(b_log), timeout=15), \
+            b_log.read_text()[-500:]
+        b.terminate()                  # clean SIGTERM: releases the lease
+        assert b.wait(timeout=15) == 0
+        b = None
+    finally:
+        for proc in (a, b):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+
+
+def test_deposed_journal_cannot_clobber_by_path(tmp_path):
+    """Inode fencing (not just fd fencing): a deposed journal that later
+    runs compact() — or the torn-write truncation path — must leave the new
+    active's snapshot and WAL untouched."""
+    d = str(tmp_path)
+    api1 = APIServer()
+    j1 = attach(api1, d)
+    api1.create(srv.POD_GROUPS, make_pod_group("old", min_member=1))
+    assert j1.flush(timeout=10)
+
+    api2 = APIServer()
+    j2 = attach(api2, d)          # takeover: rotates the WAL inode
+    api2.create(srv.POD_GROUPS, make_pod_group("new", min_member=1))
+    assert j2.flush(timeout=10)
+
+    # the zombie journal compacts: would overwrite snapshot + swap the WAL
+    # by path if not fenced
+    j1.compact()
+    api1.create(srv.POD_GROUPS, make_pod_group("zombie2", min_member=1))
+    j1.flush(timeout=10)
+    j1.close()
+
+    api2.create(srv.POD_GROUPS, make_pod_group("new2", min_member=1))
+    assert j2.flush(timeout=10)
+    j2.close()
+
+    fresh = APIServer()
+    load_into(fresh, d)
+    assert fresh.try_get(srv.POD_GROUPS, "default/new") is not None
+    assert fresh.try_get(srv.POD_GROUPS, "default/new2") is not None
+    assert fresh.try_get(srv.POD_GROUPS, "default/zombie2") is None

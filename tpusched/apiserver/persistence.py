@@ -142,6 +142,14 @@ class Journal:
         self._wal_path = os.path.join(directory, WAL_FILE)
         self._snap_path = os.path.join(directory, SNAPSHOT_FILE)
         self._wal = open(self._wal_path, "a", encoding="utf-8")
+        # ownership token for HA fencing: the WAL inode this journal opened.
+        # A takeover rotates the WAL through a new inode (compact below), so
+        # "path's inode != mine" means this journal is DEPOSED — every
+        # by-path file operation (compact's snapshot/WAL swaps, torn-write
+        # truncation) must check this first or it would clobber the new
+        # active's files.
+        self._wal_inode = os.fstat(self._wal.fileno()).st_ino
+        self._fenced = False
         self._wal_records = 0
 
         self._cv = threading.Condition()
@@ -187,8 +195,27 @@ class Journal:
             if closing and not batch:
                 return
 
+    def _is_deposed_locked(self) -> bool:
+        """True when another journal has taken over the directory (the WAL
+        path no longer points at our inode). Called under ``_file_lock``."""
+        if self._fenced:
+            return True
+        try:
+            if os.stat(self._wal_path).st_ino != self._wal_inode:
+                self._fenced = True
+        except OSError:
+            self._fenced = True   # WAL gone: someone else owns the dir
+        if self._fenced:
+            klog.error_s(None, "journal fenced: state dir taken over; "
+                         "dropping all further writes")
+        return self._fenced
+
     def _write_batch(self, batch) -> None:
         with self._file_lock:
+            if self._is_deposed_locked():
+                # deliberate data drop: a deposed active's writes must die,
+                # not interleave with the new active's WAL
+                raise RuntimeError("journal fenced (state dir taken over)")
             # a mid-batch write failure (disk full) can leave a torn partial
             # line; replay stops at the first undecodable line, so appending
             # after a tear would silently shadow every later record. On
@@ -216,11 +243,19 @@ class Journal:
         """Recover from a torn batch: drop any bytes stuck in the text
         wrapper's buffer (close may fail re-flushing them — the fd closes
         regardless) and os.ftruncate the WAL back to ``good``. Called under
-        ``_file_lock``."""
+        ``_file_lock``.
+
+        Fencing: the truncate-and-reopen is BY PATH, so if the directory
+        was taken over between our last write and this failure, doing it
+        would corrupt the new active's WAL (truncating to OUR old offset
+        can NUL-pad or discard THEIR records). A deposed journal just
+        closes and stays fenced."""
         try:
             self._wal.close()
         except OSError:
             pass
+        if self._is_deposed_locked():
+            return
         try:
             fd = os.open(self._wal_path, os.O_RDWR)
             try:
@@ -231,6 +266,7 @@ class Journal:
             klog.error_s(e, "journal truncate after torn write failed",
                          offset=good)
         self._wal = open(self._wal_path, "a", encoding="utf-8")
+        self._wal_inode = os.fstat(self._wal.fileno()).st_ino
 
     def compact(self) -> None:
         """Write a full snapshot and truncate the WAL (atomic via rename).
@@ -242,13 +278,26 @@ class Journal:
                           for k, objs in dump.items()}}
         tmp = self._snap_path + ".tmp"
         with self._file_lock:
+            if self._is_deposed_locked():
+                # by-path snapshot/WAL swaps from a deposed journal would
+                # overwrite the new active's files with stale state
+                return
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(snap, f, separators=(",", ":"))
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._snap_path)
             self._wal.close()
-            self._wal = open(self._wal_path, "w", encoding="utf-8")
+            # rotate the WAL through a NEW inode (empty tmp + rename), not
+            # an in-place truncate: attach() compacts at startup, so an HA
+            # takeover re-inodes the WAL here — a deposed active that still
+            # holds the old fd keeps appending to the orphaned inode, where
+            # its un-fenced writes vanish instead of interleaving with ours
+            wal_tmp = self._wal_path + ".tmp"
+            open(wal_tmp, "w", encoding="utf-8").close()
+            os.replace(wal_tmp, self._wal_path)
+            self._wal = open(self._wal_path, "a", encoding="utf-8")
+            self._wal_inode = os.fstat(self._wal.fileno()).st_ino
             self._wal_records = 0
         # a successful snapshot contains every live object, so records lost
         # to earlier write errors are durable again — clear the failure flag

@@ -72,12 +72,15 @@ CANNED_PROFILES = {
 }
 
 
-def resolve_profiles(args) -> List["versioned.PluginProfile"]:
+def resolve_profiles(args, cfg=None) -> List["versioned.PluginProfile"]:
     """All profiles the binary will host. Upstream runs every profile of the
     config in one process and pods pick one via spec.schedulerName
-    (vendor/.../scheduler.go profiles map); --scheduler-name narrows to one."""
+    (vendor/.../scheduler.go profiles map); --scheduler-name narrows to one.
+    ``cfg``: an already-decoded configuration (main decodes once and shares
+    it with the leader-election setup)."""
     if args.config:
-        cfg = versioned.load_file(args.config)
+        if cfg is None:
+            cfg = versioned.load_file(args.config)
         if args.scheduler_name:
             return [cfg.profile(args.scheduler_name)]
         return list(cfg.profiles)
@@ -109,13 +112,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     klog.set_verbosity(args.verbosity)
 
+    # handlers must be live BEFORE the (possibly long) leader-election
+    # campaign: a SIGTERM while campaigning — or in the window between
+    # winning and the run loop — must stop cleanly, not kill the process
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    # leaderElection: from the decoded config (scheduler-config.yaml:3-4 in
+    # the reference manifests). Meaningful only with shared state: the lease
+    # lives in --state-dir next to the WAL it arbitrates (sched/ha.py).
+    le = None
+    cfg = versioned.load_file(args.config) if args.config else None
+    if cfg is not None:
+        le_cfg = cfg.leader_election
+        if le_cfg.leader_elect and not args.validate_only:
+            if not args.state_dir:
+                klog.error_s(None, "leaderElection.leaderElect requires "
+                             "--state-dir (the lease arbitrates the WAL)")
+                return 1
+            import uuid as _uuid
+            from ..sched.ha import FileLease
+            identity = f"scheduler-{_uuid.uuid4().hex[:8]}"
+            le = (FileLease(args.state_dir), identity,
+                  le_cfg.lease_duration_seconds,
+                  le_cfg.renew_interval_seconds)
+            lease, ident, dur, _renew = le
+            klog.info_s("campaigning for scheduler lease",
+                        identity=ident, stateDir=args.state_dir)
+            while not lease.acquire_or_renew(ident, dur):
+                if stop.wait(max(0.05, dur / 5)):
+                    return 0
+            klog.info_s("started leading", identity=ident)
+
     api = APIServer()
     journal = None
     if args.state_dir and not args.validate_only:
         from ..apiserver import persistence
         journal = persistence.attach(api, args.state_dir,
                                      fsync=args.state_fsync)
-    profiles = resolve_profiles(args)
+    profiles = resolve_profiles(args, cfg)
     schedulers = [Scheduler(api, default_registry(), p) for p in profiles]
 
     if args.validate_only:
@@ -158,16 +194,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             ready_probe=lambda: all(s.running for s in schedulers),
             host=args.metrics_bind_address).start()
 
-    stop = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    lost_lease = False
+    if le is not None:
+        # re-assert leadership after the (possibly long) startup — WAL
+        # replay, compaction, pool emulation. If the lease expired under
+        # us, a standby may already own the directory: scheduling against
+        # our now-fenced state would be split-brain.
+        lease, ident, dur, _renew = le
+        if not lease.acquire_or_renew(ident, dur):
+            klog.error_s(None, "lease expired during startup; exiting",
+                         identity=ident)
+            for s in schedulers:
+                s.stop()
+            if metrics_server is not None:
+                metrics_server.stop()
+            if journal is not None:
+                journal.close()
+            return 1
     for s in schedulers:
         s.run()
         klog.info_s("scheduler running",
                     schedulerName=s.profile.scheduler_name)
     try:
         while not stop.is_set():
-            stop.wait(1.0)
+            if le is not None:
+                lease, ident, dur, renew = le
+                stop.wait(renew)
+                if stop.is_set():
+                    break
+                if not lease.acquire_or_renew(ident, dur):
+                    # exit-on-lost-lease: the new active's WAL rotation has
+                    # fenced our journal; stop scheduling and let the
+                    # supervisor restart us as a standby
+                    klog.error_s(None, "scheduler lease lost; exiting",
+                                 identity=ident)
+                    lost_lease = True
+                    break
+            else:
+                stop.wait(1.0)
     finally:
         for s in schedulers:
             s.stop()
@@ -175,7 +239,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             metrics_server.stop()
         if journal is not None:
             journal.close()
-    return 0
+        if le is not None and not lost_lease:
+            le[0].release(le[1])
+    return 1 if lost_lease else 0
 
 
 if __name__ == "__main__":

@@ -71,14 +71,17 @@ _V1ALPHA1_ARG_RENAMES: Dict[str, Dict[str, str]] = {
 class LeaderElectionConfig:
     """`leaderElection:` block (manifests/coscheduling/scheduler-config.yaml:3-4).
 
-    Decoded for schema parity with KubeSchedulerConfiguration, but the
-    SCHEDULER binary deliberately does not act on it: its API server is
-    in-process, so two scheduler processes can never share the state a
-    lease would arbitrate (a --state-dir WAL is single-writer). HA lives
-    where state is shared — the controller runner's Lease-based election
-    (controllers/runner.py, `--enable-leader-election`), matching the
-    reference's split: kube-scheduler HA is the hosting cluster's concern,
-    controller HA is in-repo (cmd/controller/app/server.go:84-123)."""
+    The scheduler binary acts on it when — and only when — there is shared
+    state to arbitrate: with ``--state-dir``, ``leaderElect: true`` runs
+    active-standby election on a file lease living NEXT TO the WAL it
+    guards (sched/ha.py: campaign before scheduling, renew on
+    ``renewIntervalSeconds``, exit-on-lost-lease; takeover replays the WAL
+    and the attach-time compaction rotates the WAL inode to fence a
+    deposed writer). Without ``--state-dir`` the stanza is decoded but
+    inert — two stateless in-process API servers share nothing a lease
+    could arbitrate. The controller runner keeps its own Lease-object
+    election (controllers/runner.py), matching the reference's split
+    (cmd/controller/app/server.go:84-123)."""
     leader_elect: bool = False
     lease_duration_seconds: float = 15.0
     renew_interval_seconds: float = 5.0

@@ -1,0 +1,240 @@
+"""Active-standby scheduler HA over a shared state directory.
+
+The reference scheduler inherits HA from upstream kube-scheduler leader
+election, configured in the very YAML this repo decodes
+(/root/reference/manifests/coscheduling/scheduler-config.yaml:3-4); the
+controller analog is cmd/controller/app/server.go:84-123. In this rebuild
+the API server is in-process, so the shared state two replicas arbitrate is
+the ``--state-dir`` WAL — and the lease must live where the state lives:
+a FILE lease in the state directory, not a Lease object inside the active's
+own (dying) API server.
+
+Model (mirrors upstream leader election semantics):
+
+- N replicas campaign on ``<state-dir>/scheduler.lease``; acquisition is
+  serialized by an ``fcntl`` lock so check-then-write is atomic across
+  processes.
+- The winner recovers the WAL into a fresh APIServer (``persistence.attach``
+  — whose startup compaction also ROTATES the WAL inode, fencing a deposed
+  active's buffered writes into an orphaned file), starts the scheduler, and
+  renews the lease every ``renew_interval_s``.
+- A replica that fails to renew (lease stolen after an expiry it slept
+  through) stops its schedulers and journal immediately — exit-on-lost-lease,
+  the same policy as the controller runner.
+- A standby that wins takeover resumes the fleet mid-flight: bound pods are
+  in the WAL (chip annotations included), members parked at the dead
+  active's permit barrier were process state and come back Pending, so the
+  gang re-admits against the surviving binds.
+
+Takeover latency = remaining lease time + WAL replay (measured at 0.3 s for
+2k objects, BENCH r3) + first scheduling cycle; bench.py's ha_takeover line
+measures the whole pipeline.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, List, Optional
+
+from ..apiserver import APIServer
+from ..apiserver import persistence
+from ..fwk import PluginProfile
+from ..plugins import default_registry
+from ..util import klog
+
+LEASE_FILE = "scheduler.lease"
+LOCK_FILE = "scheduler.lease.lock"
+
+
+class FileLease:
+    """A kube Lease analog as a file: JSON {holder, renewed_at, duration}.
+    Wall-clock based (cross-process, same machine or shared filesystem);
+    every transition runs under an fcntl lock, so acquire is atomic."""
+
+    def __init__(self, directory: str, clock: Callable[[], float] = time.time):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, LEASE_FILE)
+        self._lock_path = os.path.join(directory, LOCK_FILE)
+        self._clock = clock
+
+    def _locked(self):
+        f = open(self._lock_path, "a+")
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        return f
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                return None
+            return data
+        except (OSError, ValueError):
+            return None   # absent or torn write: treat as no lease
+
+    def acquire_or_renew(self, holder: str, duration_s: float) -> bool:
+        """True iff ``holder`` is (now) the leader — acquires a free/expired
+        lease, renews an owned one, refuses someone else's live lease."""
+        with self._locked():
+            cur = self._read()
+            now = self._clock()
+            if cur is not None and cur.get("holder") != holder and \
+                    now - float(cur.get("renewed_at", 0)) <= \
+                    float(cur.get("duration", 0)):
+                return False
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"holder": holder, "renewed_at": now,
+                           "duration": duration_s}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            return True
+
+    def release(self, holder: str) -> None:
+        """Drop the lease iff still held by ``holder`` (clean shutdown lets
+        the standby take over without waiting out the duration)."""
+        with self._locked():
+            cur = self._read()
+            if cur is not None and cur.get("holder") == holder:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+
+    def holder(self) -> str:
+        cur = self._read()
+        if cur is None:
+            return ""
+        if self._clock() - float(cur.get("renewed_at", 0)) > \
+                float(cur.get("duration", 0)):
+            return ""
+        return str(cur.get("holder", ""))
+
+
+class HAScheduler:
+    """One scheduler replica: campaigns, and while leading runs the full
+    stack (recovered APIServer + journal + Scheduler per profile)."""
+
+    def __init__(self, state_dir: str,
+                 profiles: Optional[List[PluginProfile]] = None,
+                 registry=None, identity: Optional[str] = None,
+                 lease_duration_s: float = 5.0,
+                 renew_interval_s: float = 1.0,
+                 fsync: bool = False,
+                 clock: Callable[[], float] = time.time):
+        from ..config.profiles import tpu_gang_profile
+        self.state_dir = state_dir
+        self.profiles = profiles or [tpu_gang_profile()]
+        self.registry = registry or default_registry()
+        self.identity = identity or f"scheduler-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self.fsync = fsync
+        self.lease = FileLease(state_dir, clock=clock)
+        self.is_active = threading.Event()   # leading AND schedulers running
+        self.demoted = threading.Event()     # lost a lease it once held
+        self.api: Optional[APIServer] = None
+        self.schedulers: list = []
+        self._journal = None
+        self._stop = threading.Event()
+        self._crashed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"tpusched-ha-{self.identity}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        # campaign: poll well inside the lease duration so an expiry is
+        # noticed promptly (upstream retryPeriod ~ duration/7.5)
+        poll = max(0.02, min(self.renew_interval_s,
+                             self.lease_duration_s / 5))
+        while not self._stop.is_set():
+            if self.lease.acquire_or_renew(self.identity,
+                                           self.lease_duration_s):
+                break
+            self._stop.wait(poll)
+        if self._stop.is_set():
+            return
+        klog.info_s("scheduler replica started leading",
+                    identity=self.identity, stateDir=self.state_dir)
+        self._activate()
+        try:
+            # renew-then-sleep (not sleep-then-renew): the first check runs
+            # right after activation, so a lease that expired during a slow
+            # WAL replay is caught before a full renew interval of
+            # split-brain scheduling
+            while not self._stop.is_set():
+                if not self.lease.acquire_or_renew(self.identity,
+                                                   self.lease_duration_s):
+                    # exit-on-lost-lease: our writes are already fenced off
+                    # by the new active's WAL rotation; stop doing work NOW
+                    klog.error_s(None, "scheduler lease lost; demoting",
+                                 identity=self.identity)
+                    self.demoted.set()
+                    break
+                self._stop.wait(self.renew_interval_s)
+        finally:
+            if not self._crashed.is_set():
+                self._deactivate()
+
+    def _activate(self) -> None:
+        self.api = APIServer()
+        self._journal = persistence.attach(self.api, self.state_dir,
+                                           fsync=self.fsync)
+        from .scheduler import Scheduler
+        self.schedulers = [Scheduler(self.api, self.registry, p)
+                           for p in self.profiles]
+        for s in self.schedulers:
+            s.run()
+        self.is_active.set()
+
+    def _deactivate(self) -> None:
+        self.is_active.clear()
+        for s in self.schedulers:
+            s.stop()
+        self.schedulers = []
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    def stop(self, release_lease: bool = True) -> None:
+        """Clean shutdown. ``release_lease=False`` keeps the lease on disk —
+        test/bench hook simulating a crash (a SIGKILLed active releases
+        nothing; the standby must wait out the lease duration)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if release_lease:
+            self.lease.release(self.identity)
+
+    def crash(self) -> None:
+        """Die like SIGKILL, as far as the shared state can tell: the lease
+        is NOT released (the standby must wait out the duration), and the
+        clean-shutdown writes (permit-barrier rejections → unreserve →
+        annotation patches) are disconnected from the journal FIRST, so
+        nothing the dying replica does after "death" reaches the WAL. Only
+        records accepted before the crash drain to disk."""
+        self._crashed.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.is_active.clear()
+        if self.api is not None:
+            self.api.set_persistence_sink(None)
+        for s in self.schedulers:
+            s.stop()
+        self.schedulers = []
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
