@@ -654,6 +654,109 @@ def fleet_gang_times(repeats: int) -> list:
     return times
 
 
+def run_contention_once() -> tuple:
+    """Concurrent-arrival contention (VERDICT r3 #4): 8 slice gangs of mixed
+    shapes under 2 quota teams all submitted in ONE burst against 4 pools
+    whose capacity (1024 chips) barely exceeds the demand (928 chips).
+    This is the regime where queue ordering, backoff, denied-PG TTLs and
+    freed-window claims interact — every other gang line schedules one
+    fresh gang against a quiesced fleet.
+
+    Returns (makespan_s, [per-gang submit-to-Bound seconds]). Raises on
+    livelock (not everyone admitted) and on any quiesce-invariant breach
+    (host chip oversubscription, a slice gang spanning pools)."""
+    from tpusched.api.resources import TPU
+    from tpusched.apiserver import server as srv
+    from tpusched.config.profiles import full_stack_profile
+    from tpusched.plugins.topologymatch import POOL_ANNOTATION
+    from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                                  make_pod_group, make_tpu_pool)
+
+    # (shape, members, chips-per-pod): 928 chips total over 1024
+    GANGS = [("8x8x4", 256, 1), ("8x8x4", 256, 1),
+             ("4x4x8", 32, 4), ("4x4x8", 32, 4),
+             ("4x4x4", 16, 4), ("4x4x4", 16, 4),
+             ("2x2x4", 4, 4), ("2x2x4", 4, 4)]
+
+    with TestCluster(profile=full_stack_profile(permit_wait_s=30,
+                                                denied_s=1)) as c:
+        for i in range(4):
+            topo, nodes = make_tpu_pool(f"pool-{i}", dims=(8, 8, 4),
+                                        dcn_domain=f"zoneA/rack{i // 2}")
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        for team in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 464}, max={TPU: 1024}))
+
+        by_gang = {}
+        submitted_at = {}
+        start = time.perf_counter()
+        for gi, (shape, members, chips) in enumerate(GANGS):
+            team = f"team-{'ab'[gi % 2]}"
+            name = f"job-{gi}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, namespace=team, min_member=members,
+                tpu_slice_shape=shape, tpu_accelerator="tpu-v5p"))
+            ps = [make_pod(f"{name}-{j:03d}", namespace=team, pod_group=name,
+                           limits={TPU: chips}) for j in range(members)]
+            c.create_pods(ps)
+            by_gang[name] = [p.key for p in ps]
+            # per-gang clock starts when ITS pods exist: a late gang must
+            # not be charged for the creation of the earlier ones
+            submitted_at[name] = time.perf_counter()
+
+        # poll until quiesce, recording each gang's completion time
+        done_at = {}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(done_at) < len(by_gang):
+            for name, keys in by_gang.items():
+                if name in done_at:
+                    continue
+                if all(c.pod(k).spec.node_name for k in keys):
+                    done_at[name] = time.perf_counter() - submitted_at[name]
+                    quiesce_at = time.perf_counter()
+            time.sleep(0.005)
+        if len(done_at) < len(by_gang):
+            missing = sorted(set(by_gang) - set(done_at))
+            raise RuntimeError(f"contention livelock: {missing} never "
+                               f"fully admitted within 120s")
+        makespan = quiesce_at - start   # burst start -> last gang bound
+
+        # quiesce invariants (the soak suite's, applied at bench scale):
+        # no host over its 4 chips; every slice gang on exactly one pool
+        host_chips = {}
+        for gi, (shape, members, chips) in enumerate(GANGS):
+            name = f"job-{gi}"
+            pools = set()
+            for k in by_gang[name]:
+                p = c.pod(k)
+                host_chips[p.spec.node_name] = \
+                    host_chips.get(p.spec.node_name, 0) + chips
+                pools.add(p.meta.annotations.get(POOL_ANNOTATION, ""))
+            if len(pools) != 1:
+                raise RuntimeError(f"{name} spans pools {pools}")
+        over = {h: n for h, n in host_chips.items() if n > 4}
+        if over:
+            raise RuntimeError(f"host chip oversubscription: {over}")
+        return makespan, sorted(done_at.values())
+
+
+def bench_contention() -> None:
+    results = _repeat(run_contention_once, 10)
+    makespans = [m for m, _ in results]
+    per_gang = [t for _, ts in results for t in ts]
+    emit_latency(
+        "contention makespan p99: 8 mixed-shape slice gangs (928 chips) + "
+        "2 quota teams in one burst over 4x v5p-256 pools, submit-to-"
+        "fleet-quiesce, invariants asserted",
+        makespans, "contention_makespan_p99")
+    emit_latency(
+        "contention per-gang submit-to-Bound p99 (same burst, 80 gang "
+        "admissions)",
+        per_gang, "contention_gang_p99")
+
+
 def bench_fleet_gang() -> None:
     times = fleet_gang_times(SUPP_REPEATS)
     emit_latency(
@@ -779,13 +882,26 @@ def bench_tpu_workload() -> None:
         emit(f"AdamW big-model train-step FAILED: {type(e).__name__}: {e}",
              None, "", None)
 
-    # NOT benched: the Mixtral-style MoE family. Its GShard one-hot
-    # dispatch/combine tensors are O(tokens·E·capacity) — designed for
-    # ep-sharded runs where `tokens` is per-device — and at single-chip
-    # bench scale (8k tokens) the gradient program's remote compile alone
-    # exceeds the whole bench budget. Correctness is pinned by
-    # tests/test_moe.py + the driver's moe dryrun; a single-chip MoE perf
-    # number would measure the wrong regime anyway.
+    # Mixtral-style MoE train step (VERDICT r3 #7). Measured at the
+    # ep-sharded PER-DEVICE regime (seq 1024, b1 — the token count one ep
+    # shard of a multi-chip run sees), because the GShard one-hot
+    # dispatch/combine tensors are O(tokens²): at global-batch single-chip
+    # scale they dominate compute AND compile time and the number would
+    # measure the wrong regime. FLOP accounting includes the dispatch
+    # einsums explicitly; the note carries their share of the budget.
+    try:
+        from tpusched.jaxbridge.measure import moe_flops_note
+        moe = ModelConfig.mixtral_like(seq=1024)
+        m_per, m_tf, m_mfu = measure_train_step(moe, batch=1)
+        emit("train-step MFU, mixtral-like MoE bf16 (8 experts top-2, GQA), "
+             f"seq 1024, b1, per-device-regime tokens "
+             f"({moe_flops_note(moe, 1)}; step {m_per * 1e3:.1f} ms, "
+             "single v5e chip)",
+             round(m_mfu, 4) if m_mfu else round(m_tf, 1),
+             "MFU" if m_mfu else "TFLOP/s", None)
+    except Exception as e:  # noqa: BLE001
+        emit(f"MoE train-step FAILED: {type(e).__name__}: {e}",
+             None, "", None)
 
     tok_s = measure_decode(dataclasses.replace(cfg, seq=512), batch=8)
     emit("KV-cache greedy decode throughput, llama-like 155M bf16, b8, "
@@ -823,8 +939,8 @@ def main() -> int:
     if "--smoke" in sys.argv:
         return smoke_gate()
     for bench in (bench_quota, bench_slice_reclaim, bench_multislice,
-                  bench_scale, bench_fleet_gang, bench_gang_wal,
-                  bench_wal_recovery, bench_ha_takeover,
+                  bench_scale, bench_fleet_gang, bench_contention,
+                  bench_gang_wal, bench_wal_recovery, bench_ha_takeover,
                   bench_tpu_workload):
         try:
             bench()

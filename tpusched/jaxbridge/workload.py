@@ -205,6 +205,15 @@ def _qkv(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     return q, k, v
 
 
+def moe_capacity(cfg: ModelConfig, tokens: int) -> int:
+    """Tokens each expert may accept, padded to a lane-friendly 4 — the ONE
+    definition of the capacity/padding policy. measure.train_step_flops
+    charges FLOPs from this same function, so the budget tracks what
+    _moe_mlp actually executes."""
+    return max(4, int(cfg.moe_capacity_factor * cfg.moe_top_k * tokens
+                      / cfg.n_experts) + 3 & ~3)
+
+
 def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
              ep_spec=None) -> Tuple[jax.Array, jax.Array]:
     """GShard/Mixtral-style top-k MoE with capacity-based dispatch, fully
@@ -233,8 +242,7 @@ def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     e, k = cfg.n_experts, cfg.moe_top_k
     n = b * s
     x = h.reshape(n, d)
-    # capacity: tokens each expert may accept, padded to a lane-friendly 4
-    cap = max(4, int(cfg.moe_capacity_factor * k * n / e) + 3 & ~3)
+    cap = moe_capacity(cfg, n)
 
     logits = x.astype(jnp.float32) @ p["router"]           # (n, E) f32
     probs = jax.nn.softmax(logits, axis=-1)
