@@ -507,3 +507,107 @@ def test_window_veto_protects_label_only_gangs():
         assert c.wait_for_pods_unscheduled([tiny.key], hold=3.0)
         assert len([p for p in c.api.list(srv.PODS, "team-b")
                     if p.spec.node_name]) == 16
+
+
+def test_atomic_set_member_not_evicted_while_siblings_bound():
+    """SET disruption floor (soak seed 7): a bound member gang of an atomic
+    2-slice set is not a valid victim window while its sibling slice is
+    bound elsewhere — evicting it would strand the survivor forever (the
+    set barrier never re-admits piecemeal). The high-priority rival must
+    stay pending rather than half-kill the set."""
+    from tpusched.testing import make_tpu_pool as _mk
+    with cluster() as c:
+        # two pools; the atomic set takes both
+        for pool in ("pool-a", "pool-b"):
+            topo, nodes = _mk(pool, dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        set_pods = []
+        for idx in range(2):
+            name = f"atom-s{idx}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=16, tpu_slice_shape="4x4x4",
+                tpu_accelerator="tpu-v5p", multislice_set="atom",
+                multislice_index=idx, multislice_set_size=2))
+            set_pods += [make_pod(f"{name}-{i}", pod_group=name,
+                                  limits={TPU: 4}, priority=10)
+                         for i in range(16)]
+        c.create_pods(set_pods)
+        keys = [p.key for p in set_pods]
+        assert c.wait_for_pods_scheduled(keys, timeout=30)
+
+        rival = slice_gang(c, "rival", priority=1000)
+        # the rival outranks the set but may not break it: nothing evicted
+        assert c.wait_for_pods_unscheduled([p.key for p in rival], hold=3.0)
+        assert all(c.pod(k) is not None and c.pod(k).spec.node_name
+                   for k in keys), "set member was evicted"
+
+
+def test_plain_gang_still_evictable_next_to_protected_set():
+    """The set floor must not over-protect: with a plain low-priority gang
+    on one pool and an atomic set pool-less, the rival evicts the plain
+    gang's window, never the set's."""
+    from tpusched.testing import make_tpu_pool as _mk
+    with cluster() as c:
+        for pool in ("pool-a", "pool-b", "pool-c"):
+            topo, nodes = _mk(pool, dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        set_pods = []
+        for idx in range(2):
+            name = f"atom-s{idx}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=16, tpu_slice_shape="4x4x4",
+                tpu_accelerator="tpu-v5p", multislice_set="atom",
+                multislice_index=idx, multislice_set_size=2))
+            set_pods += [make_pod(f"{name}-{i}", pod_group=name,
+                                  limits={TPU: 4}, priority=10)
+                         for i in range(16)]
+        c.create_pods(set_pods)
+        assert c.wait_for_pods_scheduled([p.key for p in set_pods],
+                                         timeout=30)
+        plain = slice_gang(c, "plain", priority=10)
+        assert c.wait_for_pods_scheduled([p.key for p in plain], timeout=30)
+
+        rival = slice_gang(c, "rival", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in rival], timeout=30)
+        # the plain gang paid; the set is intact
+        assert all(c.pod(p.key) is None for p in plain)
+        assert all(c.pod(p.key).spec.node_name for p in set_pods)
+
+
+def test_half_dead_set_stays_evictable():
+    """The set floor must not pin a broken set's chips: once one member
+    gang of an atomic set has degraded below its own quorum, the set
+    provides nothing to protect — a high-priority rival may take the
+    surviving slice's window (whole-gang-to-zero, per the gang floor)."""
+    from tpusched.testing import make_tpu_pool as _mk
+    with cluster() as c:
+        for pool in ("pool-a", "pool-b"):
+            topo, nodes = _mk(pool, dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        set_pods = {0: [], 1: []}
+        for idx in range(2):
+            name = f"atom-s{idx}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=16, tpu_slice_shape="4x4x4",
+                tpu_accelerator="tpu-v5p", multislice_set="atom",
+                multislice_index=idx, multislice_set_size=2))
+            set_pods[idx] = [make_pod(f"{name}-{i}", pod_group=name,
+                                      limits={TPU: 4}, priority=10)
+                             for i in range(16)]
+            c.create_pods(set_pods[idx])
+        all_keys = [p.key for pods in set_pods.values() for p in pods]
+        assert c.wait_for_pods_scheduled(all_keys, timeout=30)
+
+        # degrade slice 0 below quorum: 4 members die and are not replaced
+        for p in set_pods[0][:4]:
+            c.api.delete(srv.PODS, p.key)
+
+        rival = slice_gang(c, "rival", priority=1000)
+        assert c.wait_for_pods_scheduled([p.key for p in rival], timeout=30)
+        # one of the broken set's slices paid for it
+        survivors = [k for k in all_keys if c.pod(k) is not None
+                     and c.pod(k).spec.node_name]
+        assert len(survivors) < 28

@@ -52,6 +52,7 @@ discovering it cannot.
 """
 from __future__ import annotations
 
+import threading
 from typing import FrozenSet, List, Optional, Set, Tuple
 
 from ...api.core import Pod
@@ -61,10 +62,13 @@ from ...api.scheduling import (POD_GROUP_INDEX, PodGroup,
 from ...api.topology import LABEL_DCN_DOMAIN
 from ...config.types import MultiSliceArgs
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import (FilterPlugin, NodeScore, PermitPlugin,
-                               PostFilterPlugin, PostFilterResult,
-                               PreFilterPlugin, PreScorePlugin, ReservePlugin,
-                               ScorePlugin)
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+                               EVENT_DELETE, EVENT_UPDATE, FilterPlugin,
+                               NodeScore, PermitPlugin, PostFilterPlugin,
+                               PostFilterResult, PreFilterPlugin,
+                               PreScorePlugin, ReservePlugin, ScorePlugin,
+                               RESOURCE_NODE, RESOURCE_POD,
+                               RESOURCE_POD_GROUP)
 from ...fwk.nodeinfo import MAX_NODE_SCORE, NodeInfo
 from ...util import klog
 from ...util.ttlcache import TTLCache
@@ -98,8 +102,20 @@ def _node_pg_keys(info: NodeInfo) -> FrozenSet[str]:
 
 
 class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
-                 PreScorePlugin, ScorePlugin, ReservePlugin, PermitPlugin):
+                 PreScorePlugin, ScorePlugin, ReservePlugin, PermitPlugin,
+                 EnqueueExtensions):
     NAME = "MultiSlice"
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        """Events that can unstick a pod THIS plugin rejected: a sibling
+        slice's PodGroup appearing completes an incomplete set; pod churn
+        or new capacity can clear a failed set dry-run or hard-domain
+        filter."""
+        return [
+            ClusterEvent(RESOURCE_POD_GROUP, EVENT_ADD | EVENT_UPDATE),
+            ClusterEvent(RESOURCE_POD, EVENT_ADD | EVENT_DELETE),
+            ClusterEvent(RESOURCE_NODE, EVENT_ADD | EVENT_UPDATE),
+        ]
 
     def __init__(self, args: Optional[MultiSliceArgs], handle):
         self.args = args or MultiSliceArgs()
@@ -116,6 +132,14 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # analog): one dry-run per set per permit window, not per cycle.
         self._permitted_sets = TTLCache(
             float(self.args.set_schedule_timeout_seconds))
+        # serializes the allow sweep against the deny sweep: without it, a
+        # set completing on a scheduling thread can race a member's permit
+        # timeout (sweeper thread) and release half the set after the
+        # other half was torn down. The residual per-pod window (a pod
+        # resolving between our denied-check and its allow) mirrors the
+        # upstream coscheduling permit race and heals the same way — the
+        # rejected member's freed reservation re-admits it.
+        self._set_sweep_lock = threading.Lock()
 
     @classmethod
     def new(cls, args, handle) -> "MultiSlice":
@@ -181,8 +205,20 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                     f"multislice set {set_key} was denied within the "
                     f"denied-set expiration window").with_retry_after(
                         self._denied_sets.remaining(set_key) + 0.05)
+            members = self._member_pgs(pod.namespace, set_name)
+            if len(members) < pg.spec.multislice_set_size:
+                # the barrier can engage only once every member PG exists;
+                # WAITING at Permit here would reserve this slice's chips
+                # and hold them for a full set timeout per retry, forever,
+                # for a set that may never be fully submitted (or whose
+                # sibling was deleted mid-flight). Park reservation-free;
+                # a PodGroup add/update event requeues us.
+                return Status.unresolvable(
+                    f"multislice set {set_key} incomplete: "
+                    f"{len(members)}/{pg.spec.multislice_set_size} member "
+                    f"PodGroups exist")
             status = self._check_set_capacity(pod.namespace, set_name,
-                                              set_key, pg)
+                                              set_key, members)
             if status is not None:
                 return status
         if self.args.hard_domain_policy not in (HARD_SAME_DOMAIN,
@@ -194,17 +230,15 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         state.write(_FILTER_KEY, _Domains(domains))
         return Status.success()
 
-    def _check_set_capacity(self, namespace: str, set_name: str, set_key: str,
-                            pg: PodGroup) -> Optional[Status]:
+    def _check_set_capacity(self, namespace: str, set_name: str,
+                            set_key: str,
+                            members: List[PodGroup]) -> Optional[Status]:
         """Summed-set CheckClusterResource (core.go:322-342 one level up).
-        Runs only once every member PG exists and every member declares
-        min_resources; memoized for the permit window. Returns a failure
-        Status, or None to proceed."""
+        Caller guarantees every member PG exists; runs only when every
+        member declares min_resources; memoized for the permit window.
+        Returns a failure Status, or None to proceed."""
         if set_key in self._permitted_sets:
             return None
-        members = self._member_pgs(namespace, set_name)
-        if len(members) < pg.spec.multislice_set_size:
-            return None    # set not fully submitted yet: nothing to sum
         if not all(g.spec.min_resources for g in members):
             return None
         total: dict = {}
@@ -316,8 +350,22 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if pg is None or not self._barrier_enabled(pg):
             return Status.success(), 0.0
         if self._set_complete(pod, pg):
-            self._allow_set_waiters(pod.namespace, pg.spec.multislice_set)
-            return Status.success(), 0.0
+            set_key = self._set_key(pod.namespace, pg.spec.multislice_set)
+            with self._set_sweep_lock:
+                if set_key not in self._denied_sets:
+                    self._allow_set_waiters(pod.namespace,
+                                            pg.spec.multislice_set)
+                    return Status.success(), 0.0
+            # completed and denied simultaneously (a member timed out as
+            # the last quorum formed). WAITing would hold this pod's
+            # reservation for the whole set timeout — the deny sweep ran
+            # before the framework parks us, so nothing would reject us
+            # sooner. Fail the cycle NOW (reservation released on the
+            # permit failure path) and retry when the window lapses.
+            return Status.unschedulable(
+                f"multislice set {set_key} denied as its last quorum "
+                f"formed").with_retry_after(
+                    self._denied_sets.remaining(set_key) + 0.05), 0.0
         klog.V(3).info_s("pod waiting for its multislice set", pod=pod.key,
                          set=pg.spec.multislice_set,
                          setSize=pg.spec.multislice_set_size)
@@ -375,18 +423,21 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         barrier; the scheduler's resolution callback runs the pod's
         unreserve chain on the bind pool, which re-enters unreserve() above
         and stops at the cascade guard."""
-        self._denied_sets.add(set_key)
-        self._permitted_sets.delete(set_key)
-        klog.V(3).info_s("multislice set denied", set=set_key, reason=reason)
-        member_names = {g.meta.name
-                        for g in self._member_pgs(namespace, set_name)}
+        with self._set_sweep_lock:
+            self._denied_sets.add(set_key)
+            self._permitted_sets.delete(set_key)
+            klog.V(3).info_s("multislice set denied", set=set_key,
+                             reason=reason)
+            member_names = {g.meta.name
+                            for g in self._member_pgs(namespace, set_name)}
 
-        def reject(waiting_pod):
-            wp = waiting_pod.pod
-            if (wp.namespace == namespace
-                    and pod_group_label(wp) in member_names):
-                klog.V(3).info_s("rejecting multislice set member",
-                                 pod=wp.key, set=set_key)
-                waiting_pod.reject(self.NAME,
-                                   f"multislice set {set_key} denied: {reason}")
-        self.handle.iterate_over_waiting_pods(reject)
+            def reject(waiting_pod):
+                wp = waiting_pod.pod
+                if (wp.namespace == namespace
+                        and pod_group_label(wp) in member_names):
+                    klog.V(3).info_s("rejecting multislice set member",
+                                     pod=wp.key, set=set_key)
+                    waiting_pod.reject(
+                        self.NAME,
+                        f"multislice set {set_key} denied: {reason}")
+            self.handle.iterate_over_waiting_pods(reject)

@@ -47,7 +47,8 @@ from ...topology.engine import (MaskGrid, PlacementSet,
                                 enumerate_placement_masks,
                                 feasible_membership)
 from ...topology.torus import HostGrid, validate_slice_shape
-from ...sched.preemption import (filter_pods_with_pdb_violation,
+from ...sched.preemption import (atomic_set_eviction_vetoed,
+                                 filter_pods_with_pdb_violation,
                                  gang_min_member)
 from ...util import klog
 from ...util.metrics import preemption_attempts, slice_preemption_victims
@@ -644,6 +645,14 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 if remaining < min_member:
                     return None     # would strand a live gang below quorum
                 partial += 1
+        # SET disruption floor (atomic multislice): a window taking one
+        # slice of a bound set to zero strands its sibling slices on other
+        # pools — all-or-nothing in admission must be all-or-nothing in
+        # disruption (soak seed 7)
+        if atomic_set_eviction_vetoed(
+                self.handle, snapshot,
+                {k: n for k, (n, _) in by_gang.items()}):
+            return None
         return partial
 
     def _assumed_gang_chips(self, pod: Pod, snapshot) -> int:

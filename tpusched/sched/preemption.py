@@ -57,6 +57,7 @@ class GangDisruptionFloor:
     def __init__(self, handle):
         self.handle = handle
         self._remaining: dict = {}      # gang full name → assigned still left
+        self._set_veto: dict = {}       # gang full name → memoized set veto
 
     def may_evict(self, victim: Pod) -> bool:
         from ..api.scheduling import POD_GROUP_LABEL
@@ -64,6 +65,17 @@ class GangDisruptionFloor:
         if not name:
             return True
         full = f"{victim.meta.namespace}/{name}"
+        # memoized per gang per plan: the set membership sweep is
+        # O(namespace PodGroups) and a 16-member victim gang would
+        # otherwise pay it 16 times per candidate node
+        vetoed = self._set_veto.get(full)
+        if vetoed is None:
+            vetoed = atomic_set_eviction_vetoed(
+                self.handle, self.handle.snapshot_shared_lister(),
+                {(victim.meta.namespace, name): 1})
+            self._set_veto[full] = vetoed
+        if vetoed:
+            return False
         min_member = gang_min_member(self.handle, victim, full)
         remaining = self._remaining.get(full)
         if remaining is None:
@@ -83,6 +95,51 @@ class GangDisruptionFloor:
             self._remaining[full] = remaining - 1
             return True
         return False
+
+
+def atomic_set_eviction_vetoed(handle, snapshot, victim_counts) -> bool:
+    """The SET-level disruption floor (the gang floor one level up): a gang
+    belonging to an atomic multislice set (multislice_set_size > 1) may
+    only lose members if every OTHER member gang of its set is also going
+    to zero — otherwise the surviving slices burn their chips waiting for
+    a sibling that admission's all-or-nothing barrier will never replace
+    piecemeal. Caught by the randomized soak (seed 7: window preemption
+    evicted one slice of a bound 2-slice set; the survivor strands
+    forever — I5).
+
+    ``victim_counts``: {(namespace, gang_name): members evicted by this
+    plan}. Returns True when the plan must be vetoed.
+
+    Only an INTACT set is protected — every member gang at or above its
+    own quorum. A set with any member already sub-quorum (node crash, job
+    that never recreated its pods) provides nothing to protect, and
+    vetoing there would pin the survivors' chips below every priority
+    forever — the exact pinned-sub-quorum state the gang floor's
+    freely-evictable rule exists to prevent, one level up. Its members
+    fall through to the plain gang-floor rules (whole-gang-to-zero
+    eviction stays possible, so cleanup of a half-dead set works)."""
+    if not victim_counts:
+        return False
+    pgs = handle.informer_factory.podgroups()
+    for (ns, g), _n in victim_counts.items():
+        pg = pgs.get(f"{ns}/{g}")
+        if pg is None or not pg.spec.multislice_set \
+                or pg.spec.multislice_set_size <= 1:
+            continue
+        members = [sib for sib in pgs.items(namespace=ns)
+                   if sib.spec.multislice_set == pg.spec.multislice_set]
+        intact = len(members) >= pg.spec.multislice_set_size and all(
+            snapshot.assigned_live_count(sib.meta.name, ns)
+            >= sib.spec.min_member for sib in members)
+        if not intact:
+            continue
+        for sib in members:
+            if sib.meta.name == g:
+                continue
+            evicted = victim_counts.get((ns, sib.meta.name), 0)
+            if snapshot.assigned_live_count(sib.meta.name, ns) - evicted > 0:
+                return True
+    return False
 
 
 def gang_min_member(handle, member: Pod, full: str) -> int:
