@@ -321,3 +321,46 @@ def test_whatif_cli_bad_config_exits_2_not_1(tmp_path):
          "--config", str(tmp_path / "missing.yaml")],
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 2, (out.returncode, out.stderr[-200:])
+
+
+def test_whatif_atomic_set_feasible_and_infeasible():
+    """slices=N simulates the whole atomic set through the real barrier:
+    feasible iff every member slice lands. Two pools hold a 2-slice set;
+    the same ask with 3 slices must come back infeasible — and leave the
+    source untouched either way."""
+    with TestCluster() as c:
+        for name in ("pool-a", "pool-b"):
+            topo, nodes = make_tpu_pool(name, dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        r = simulate_gang(source_api=c.api, members=16, slices=2,
+                          slice_shape="4x4x4", accelerator="tpu-v5p",
+                          chips_per_pod=4, timeout_s=25)
+        assert r.feasible
+        assert len(r.placements) == 32             # both slices placed
+        pools = {r.placements[k].split("-")[0] for k in r.placements}
+        r3 = simulate_gang(source_api=c.api, members=16, slices=3,
+                           slice_shape="4x4x4", accelerator="tpu-v5p",
+                           chips_per_pod=4, timeout_s=6)
+        assert not r3.feasible
+        assert c.api.list(srv.PODS) == []
+        assert len(c.api.list(srv.POD_GROUPS)) == 0
+
+
+def test_whatif_plan_supports_set_jobs():
+    """A plan job may declare slices: the set admits (or is withdrawn)
+    as one unit, and later jobs see the capacity it consumed."""
+    from tpusched.sim import simulate_plan
+    with TestCluster() as c:
+        for name in ("pool-a", "pool-b"):
+            topo, nodes = make_tpu_pool(name, dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        reports = simulate_plan(source_api=c.api, jobs=[
+            {"members": 16, "slices": 2, "slice_shape": "4x4x4",
+             "accelerator": "tpu-v5p", "chips_per_pod": 4},
+            {"members": 16, "slice_shape": "4x4x4",
+             "accelerator": "tpu-v5p", "chips_per_pod": 4},
+        ], timeout_s=25)
+        assert reports[0].feasible and len(reports[0].placements) == 32
+        assert not reports[1].feasible     # the set took both pools

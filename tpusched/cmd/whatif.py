@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default="default",
                    help="namespace (quota team) the gang belongs to")
     p.add_argument("--priority", type=int, default=0, help="pod priority")
+    p.add_argument("--slices", type=int, default=1,
+                   help="simulate an ATOMIC multislice set of N slice gangs "
+                        "(each of --members pods) instead of one gang: "
+                        "feasible iff the WHOLE set lands (set barrier, "
+                        "all-or-nothing)")
     p.add_argument("--allow-preemption", action="store_true",
                    help="run the full-stack profile: report which pods "
                         "slice/quota preemption would evict to fit the gang")
@@ -84,7 +89,7 @@ def main(argv=None) -> int:
                        for d in ("members", "slice_shape", "accelerator",
                                  "chips", "cpu", "memory", "namespace",
                                  "priority", "suggest_migrations",
-                                 "max_moves")
+                                 "max_moves", "slices")
                        if getattr(args, d) != parser.get_default(d)]
         if conflicting:
             parser.error(
@@ -112,6 +117,7 @@ def main(argv=None) -> int:
     try:
         report = simulate_gang(
             state_dir=args.state_dir, members=args.members,
+            slices=args.slices,
             slice_shape=args.slice_shape, accelerator=args.accelerator,
             chips_per_pod=args.chips, cpu_per_pod=args.cpu,
             memory_per_pod=args.memory, namespace=args.namespace,
@@ -129,6 +135,7 @@ def main(argv=None) -> int:
             plans = suggest_migrations(
                 state_dir=args.state_dir,
                 job=dict(members=args.members,
+                         slices=args.slices,
                          slice_shape=args.slice_shape,
                          accelerator=args.accelerator,
                          chips_per_pod=args.chips, cpu_per_pod=args.cpu,

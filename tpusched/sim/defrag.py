@@ -41,7 +41,8 @@ from ..plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
 from ..plugins.tpuslice import CHIP_INDEX_ANNOTATION
 from ..sched import Scheduler
 from ..api.core import Pod
-from .whatif import WhatIfReport, _make_profile, _run_one, _shadow_of
+from .whatif import (WhatIfReport, _make_profile, _run_one,
+                     _set_gang_names, _shadow_of)
 
 # sentinel for peek() misses in the post-resubmission check: a vanished
 # target pod must read as "not bound"
@@ -248,17 +249,21 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
 
     job_kw = dict(name="defrag-target", namespace="default", slice_shape="",
                   accelerator="", chips_per_pod=1, cpu_per_pod=4,
-                  memory_per_pod="8Gi", priority=0)
+                  memory_per_pod="8Gi", priority=0, slices=1)
     job_kw.update(job)
-    target_full = f"{job_kw['namespace']}/{job_kw['name']}"
-    if base.try_get(srv.POD_GROUPS, target_full) is not None:
-        raise ValueError(f"target name {target_full!r} collides with an "
-                         "existing PodGroup; pass job['name']")
-    for j in range(job_kw["members"]):
-        pk = f"{job_kw['namespace']}/{job_kw['name']}-{j:03d}"
-        if base.peek(srv.PODS, pk) is not None:
-            raise ValueError(f"target pod key {pk!r} collides with an "
-                             "existing pod; pass job['name']")
+    # collision checks over the DERIVED gang names (a slices>1 target
+    # creates name-s0..; checking only the base name would let the shadow
+    # die on an apiserver Conflict mid-search)
+    for gname in _set_gang_names(job_kw["name"], job_kw["slices"]):
+        gfull = f"{job_kw['namespace']}/{gname}"
+        if base.try_get(srv.POD_GROUPS, gfull) is not None:
+            raise ValueError(f"target name {gfull!r} collides with an "
+                             "existing PodGroup; pass job['name']")
+        for j in range(job_kw["members"]):
+            pk = f"{gfull}-{j:03d}"
+            if base.peek(srv.PODS, pk) is not None:
+                raise ValueError(f"target pod key {pk!r} collides with an "
+                                 "existing pod; pass job['name']")
 
     suggestions: List[MigrationSuggestion] = []
     for g in gangs:

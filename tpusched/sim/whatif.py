@@ -76,33 +76,56 @@ def _shadow_of(source_api: Optional[APIServer],
     return shadow
 
 
+def _set_gang_names(name: str, slices: int) -> List[str]:
+    """THE derived-name scheme for a set job's member gangs — shared by
+    plan validation, creation, withdrawal, and the defrag advisor's
+    collision checks, so they can never desynchronize."""
+    if slices <= 1:
+        return [name]
+    return [f"{name}-s{idx}" for idx in range(slices)]
+
+
 def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
              slice_shape: str, accelerator: str, chips_per_pod: int,
              cpu_per_pod: int, memory_per_pod: str, priority: int,
              timeout_s: float, scheduler_name: str,
+             slices: int = 1,
              hypothetical: frozenset = frozenset()
              ) -> "tuple[WhatIfReport, List[str]]":
     """Inject one hypothetical gang into a live shadow. Returns the report
     plus the exact pod keys created (for plan-mode withdrawal).
     ``hypothetical``: pod keys belonging to earlier plan jobs — evictions
-    of those are reported as displaced_plan_pods, not victims."""
+    of those are reported as displaced_plan_pods, not victims.
+
+    ``slices > 1`` simulates an ATOMIC multislice set: N member gangs of
+    ``members`` pods each sharing ``multislice_set=name`` with the
+    declared set size, so the shadow exercises the real set barrier —
+    feasible means the WHOLE set binds. The set must be one job (its
+    slices barrier on each other; split across plan jobs, the first would
+    wait forever for siblings the plan hasn't submitted yet)."""
     pre_existing = {p.meta.key for p in shadow.list(srv.PODS)}
-    shadow.create(srv.POD_GROUPS, PodGroup(
-        meta=ObjectMeta(name=name, namespace=namespace),
-        spec=PodGroupSpec(min_member=members,
-                          tpu_slice_shape=slice_shape,
-                          tpu_accelerator=accelerator)))
     pods: List[Pod] = []
     from ..testing.wrappers import make_pod
-    for i in range(members):
-        pods.append(make_pod(
-            f"{name}-{i:03d}", namespace=namespace, pod_group=name,
-            limits={TPU: chips_per_pod},
-            requests=make_resources(cpu=cpu_per_pod,
-                                    memory=memory_per_pod),
-            priority=priority,
-            # must match the shadow profile or it ignores every pod
-            scheduler_name=scheduler_name))
+    gang_names = _set_gang_names(name, slices)
+    for idx, gname in enumerate(gang_names):
+        shadow.create(srv.POD_GROUPS, PodGroup(
+            meta=ObjectMeta(name=gname, namespace=namespace),
+            spec=PodGroupSpec(min_member=members,
+                              tpu_slice_shape=slice_shape,
+                              tpu_accelerator=accelerator,
+                              multislice_set=name if slices > 1 else "",
+                              multislice_index=idx,
+                              multislice_set_size=slices if slices > 1
+                              else 0)))
+        for i in range(members):
+            pods.append(make_pod(
+                f"{gname}-{i:03d}", namespace=namespace, pod_group=gname,
+                limits={TPU: chips_per_pod},
+                requests=make_resources(cpu=cpu_per_pod,
+                                        memory=memory_per_pod),
+                priority=priority,
+                # must match the shadow profile or it ignores every pod
+                scheduler_name=scheduler_name))
     start = time.perf_counter()
     for p in pods:
         shadow.create(srv.PODS, p)
@@ -120,13 +143,19 @@ def _run_one(shadow: APIServer, *, name: str, namespace: str, members: int,
 
     placements: Dict[str, str] = {}
     coords: Dict[str, str] = {}
-    pool = ""
+    pools = set()
     if feasible:
         for k in keys:
             p = shadow.peek(srv.PODS, k)
             placements[k] = p.spec.node_name
             coords[k] = p.meta.annotations.get(COORD_ANNOTATION, "")
-            pool = p.meta.annotations.get(POOL_ANNOTATION, pool)
+            pl = p.meta.annotations.get(POOL_ANNOTATION, "")
+            if pl:
+                pools.add(pl)
+    # one gang lands in one pool; a multislice set deliberately spans
+    # pools — report every pool it touched, sorted and comma-joined, so
+    # "pool" never names just whichever pod iterated last
+    pool = ",".join(sorted(pools))
     gone = pre_existing - {p.meta.key for p in shadow.list(srv.PODS)}
     victims = sorted(gone - hypothetical)
     displaced = sorted(gone & hypothetical)
@@ -189,11 +218,16 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                   cpu_per_pod: int = 4,
                   memory_per_pod: str = "8Gi",
                   priority: int = 0,
+                  slices: int = 1,
                   allow_preemption: bool = False,
                   timeout_s: float = 30.0,
                   config_path: Optional[str] = None,
                   scheduler_name: Optional[str] = None) -> WhatIfReport:
     """Dry-run one hypothetical gang against a shadow of the given state.
+
+    ``slices > 1`` asks the set question instead: would this ATOMIC
+    multislice set (N slice gangs of ``members`` pods each, all-or-nothing
+    barrier) fully land?
 
     ``config_path``/``scheduler_name`` run the shadow with a production
     TpuSchedulerConfiguration profile instead of the canned one.
@@ -212,7 +246,8 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                              chips_per_pod=chips_per_pod,
                              cpu_per_pod=cpu_per_pod,
                              memory_per_pod=memory_per_pod,
-                             priority=priority, timeout_s=timeout_s,
+                             priority=priority, slices=slices,
+                             timeout_s=timeout_s,
                              scheduler_name=profile.scheduler_name)
         return report
     finally:
@@ -242,7 +277,7 @@ def simulate_plan(source_api: Optional[APIServer] = None,
     ``displaced_plan_pods`` (never ``victims``)."""
     gang_keys = {"name", "namespace", "members", "slice_shape",
                  "accelerator", "chips_per_pod", "cpu_per_pod",
-                 "memory_per_pod", "priority"}
+                 "memory_per_pod", "priority", "slices"}
     if not isinstance(jobs, list):
         raise ValueError(f"jobs must be a list of job objects, "
                          f"got {type(jobs).__name__}")
@@ -260,21 +295,38 @@ def simulate_plan(source_api: Optional[APIServer] = None,
         if not isinstance(job.get("members"), int) or job["members"] < 1:
             raise ValueError(f"plan job {i}: 'members' must be a positive "
                              f"integer, got {job.get('members')!r}")
+        slices = job.get("slices", 1)
+        if not isinstance(slices, int) or slices < 1:
+            raise ValueError(f"plan job {i}: 'slices' must be a positive "
+                             f"integer, got {slices!r}")
         kw = dict(name=f"plan-{i:02d}", namespace="default",
                   slice_shape="", accelerator="", chips_per_pod=1,
-                  cpu_per_pod=4, memory_per_pod="8Gi", priority=0)
+                  cpu_per_pod=4, memory_per_pod="8Gi", priority=0,
+                  slices=1)
         kw.update(job)
         full = f"{kw['namespace']}/{kw['name']}"
         if full in seen_names:
             raise ValueError(f"plan job {i}: duplicate name {full!r}")
-        if shadow.try_get(srv.POD_GROUPS, full) is not None:
-            raise ValueError(f"plan job {i}: name {full!r} collides with an "
-                             "existing PodGroup in the source state")
-        for j in range(kw["members"]):
-            pk = f"{kw['namespace']}/{kw['name']}-{j:03d}"
-            if shadow.peek(srv.PODS, pk) is not None:
-                raise ValueError(f"plan job {i}: pod key {pk!r} collides "
-                                 "with an existing pod in the source state")
+        for gname in _set_gang_names(kw["name"], kw["slices"]):
+            gfull = f"{kw['namespace']}/{gname}"
+            # cross-job check covers DERIVED names too: job "a" with
+            # slices=2 creates gangs a-s0/a-s1 — a later job literally
+            # named "a-s0" must fail fast here, not as a mid-plan
+            # apiserver Conflict
+            if gfull in seen_names:
+                raise ValueError(f"plan job {i}: gang name {gfull!r} "
+                                 "collides with an earlier plan job")
+            if shadow.try_get(srv.POD_GROUPS, gfull) is not None:
+                raise ValueError(f"plan job {i}: name {gfull!r} collides "
+                                 "with an existing PodGroup in the source "
+                                 "state")
+            seen_names.add(gfull)
+            for j in range(kw["members"]):
+                pk = f"{kw['namespace']}/{gname}-{j:03d}"
+                if shadow.peek(srv.PODS, pk) is not None:
+                    raise ValueError(f"plan job {i}: pod key {pk!r} "
+                                     "collides with an existing pod in the "
+                                     "source state")
         seen_names.add(full)
         normalized.append(kw)
 
@@ -313,11 +365,12 @@ def simulate_plan(source_api: Optional[APIServer] = None,
                     shadow.delete(srv.PODS, k)
                 except srv.NotFound:
                     pass
-            try:
-                shadow.delete(
-                    srv.POD_GROUPS, f"{kw['namespace']}/{kw['name']}")
-            except srv.NotFound:
-                pass
+            for gname in _set_gang_names(kw["name"], kw["slices"]):
+                try:
+                    shadow.delete(srv.POD_GROUPS,
+                                  f"{kw['namespace']}/{gname}")
+                except srv.NotFound:
+                    pass
             if may_evict:
                 # ...restore anything its preemption attempt evicted, then
                 # bring a fresh scheduler up over the repaired state
