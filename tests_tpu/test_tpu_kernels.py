@@ -290,3 +290,28 @@ def test_vocab_parallel_loss_on_chip(tpu):
     _, got = step(jax.device_put(params, pshard),
                   jax.device_put(tokens, tshard))
     assert abs(float(got) - want) < 5e-3
+
+
+def test_moe_train_step_measures_on_chip(tpu):
+    """The bench's mixtral-like MFU line end-to-end on hardware (VERDICT r3
+    #7): slope-timed MoE train step at the ep-shard per-device token
+    regime, with the dispatch-inclusive FLOP accounting. Asserts the
+    measurement completes and lands in a sane MFU band — the exact value
+    is the bench's to record."""
+    import dataclasses
+    from tpusched.jaxbridge.measure import (measure_train_step,
+                                            moe_flops_note)
+    from tpusched.jaxbridge.workload import ModelConfig
+
+    moe = dataclasses.replace(ModelConfig.mixtral_like(seq=1024))
+    per, tflops, mfu = measure_train_step(moe, batch=1, k1=1, k2=4,
+                                          repeats=2)
+    note = moe_flops_note(moe, 1)
+    print(f"moe step {per * 1e3:.1f} ms, {tflops:.1f} TFLOP/s, "
+          f"mfu={mfu}, {note}")
+    assert per > 0 and tflops > 0
+    if mfu is not None:
+        # dispatch einsums cap what an MoE step can utilize; anything in
+        # (0.05, 1.0) is plausible on a v5e — the gate is "really ran on
+        # the MXU", not a perf bar
+        assert 0.05 < mfu < 1.0
