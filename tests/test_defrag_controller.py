@@ -1,0 +1,139 @@
+"""Defrag controller (controllers/defrag.py): opt-in, consent-gated
+actuation of shadow-verified migration plans. The contract under test:
+nothing moves without the consent annotation, plans are verified on a
+shadow with the blocked gang's OWN pods, and after actuation everyone —
+target and migrant — ends up bound by the real scheduler."""
+import time
+
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.controllers.defrag import (ALLOW_MIGRATION_ANNOTATION,
+                                         DefragController)
+from tpusched.plugins.topologymatch import POOL_ANNOTATION
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool, wait_until)
+
+
+def _cluster():
+    return TestCluster(profile=tpu_gang_profile(permit_wait_s=10, denied_s=1))
+
+
+def _pool(c, name, dims=(4, 4, 4)):
+    topo, nodes = make_tpu_pool(name, dims=dims)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+
+
+def _gang(c, name, shape, members, consent=False, wait=True):
+    pg = make_pod_group(name, min_member=members, tpu_slice_shape=shape,
+                        tpu_accelerator="tpu-v5p")
+    if consent:
+        pg.meta.annotations[ALLOW_MIGRATION_ANNOTATION] = "true"
+    c.api.create(srv.POD_GROUPS, pg)
+    ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+          for i in range(members)]
+    c.create_pods(ps)
+    if wait:
+        assert c.wait_for_pods_scheduled([p.key for p in ps], timeout=30)
+    return ps
+
+
+def _fragmented_cluster(c, consent=True):
+    """pool-a fragmented by a small consenting gang; rehome pool sized for
+    it; a whole-pool target gang blocked."""
+    _pool(c, "pool-a")                              # 64 chips
+    small = _gang(c, "small", "2x2x4", 4, consent=consent)
+    _pool(c, "rehome", dims=(2, 2, 4))              # fits `small` exactly
+    target = _gang(c, "target", "4x4x4", 16, wait=False)   # needs all of pool-a
+    assert c.wait_for_pods_unscheduled([p.key for p in target], hold=0.5)
+    return small, target
+
+
+def _controller(c, **kw):
+    kw.setdefault("blocked_after_s", 0.5)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("shadow_timeout_s", 15.0)
+    return DefragController(c.api, **kw)
+
+
+def test_controller_migrates_consenting_gang_and_admits_blocked():
+    with _cluster() as c:
+        small, target = _fragmented_cluster(c)
+        ctl = _controller(c)
+        time.sleep(0.6)                     # cross blocked_after
+        plan = ctl.reconcile_once()
+        assert plan is not None
+        assert plan["migrate"] == "default/small"
+        assert plan["blocked"] == "default/target"
+        assert ctl.migrations == 1
+        # everyone lands: target takes pool-a, small re-homes
+        assert c.wait_for_pods_scheduled([p.key for p in target], timeout=30)
+        small_keys = [p.key for p in small]
+        assert c.wait_for_pods_scheduled(small_keys, timeout=30)
+        pools = {c.pod(k).meta.annotations[POOL_ANNOTATION]
+                 for k in small_keys}
+        assert pools == {"rehome"}
+        evs = [e for e in c.api.events() if e.reason == "DefragMigrated"]
+        assert len(evs) == 4
+
+
+def test_no_consent_no_migration():
+    with _cluster() as c:
+        small, target = _fragmented_cluster(c, consent=False)
+        ctl = _controller(c)
+        time.sleep(0.6)
+        assert ctl.reconcile_once() is None
+        assert ctl.migrations == 0
+        # nothing was evicted
+        assert all(c.pod(p.key).spec.node_name for p in small)
+
+
+def test_dry_run_plans_without_evicting():
+    with _cluster() as c:
+        small, target = _fragmented_cluster(c)
+        ctl = _controller(c, dry_run=True)
+        time.sleep(0.6)
+        plan = ctl.reconcile_once()
+        assert plan is not None and plan["migrate"] == "default/small"
+        assert ctl.migrations == 0
+        assert all(c.pod(p.key).spec.node_name for p in small)
+        assert all(not c.pod(p.key).spec.node_name for p in target)
+
+
+def test_no_plan_when_migration_would_orphan():
+    """No rehome pool: migrating `small` would leave it homeless — the
+    shadow trial must reject the plan and nothing is evicted."""
+    with _cluster() as c:
+        _pool(c, "pool-a")
+        small = _gang(c, "small", "2x2x4", 4, consent=True)
+        target = _gang(c, "target", "4x4x4", 16, wait=False)
+        assert c.wait_for_pods_unscheduled([p.key for p in target], hold=0.5)
+        ctl = _controller(c, shadow_timeout_s=4.0)
+        time.sleep(0.6)
+        assert ctl.reconcile_once() is None
+        assert all(c.pod(p.key).spec.node_name for p in small)
+
+
+def test_cooldown_limits_actuations():
+    with _cluster() as c:
+        small, target = _fragmented_cluster(c)
+        ctl = _controller(c, cooldown_s=3600.0)
+        ctl._last_actuation = ctl.clock()   # as if one just happened
+        time.sleep(0.6)
+        assert ctl.reconcile_once() is None
+        assert ctl.migrations == 0
+
+
+def test_runner_wires_defrag_controller():
+    from tpusched.controllers.runner import ControllerRunner, ServerRunOptions
+    api = srv.APIServer()
+    r = ControllerRunner(api, ServerRunOptions(enable_defrag=True,
+                                               defrag_dry_run=True))
+    r.run()
+    try:
+        assert wait_until(lambda: any(
+            type(ctl).__name__ == "DefragController"
+            for ctl in r._controllers), timeout=5)
+    finally:
+        r.stop()

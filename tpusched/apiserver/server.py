@@ -120,6 +120,12 @@ class APIServer:
             if rv > self._rv:
                 self._rv = rv
 
+    def current_resource_version(self) -> int:
+        """The store's latest resourceVersion — a cheap change cursor for
+        callers memoizing work against cluster state (defrag trial cache)."""
+        with self._lock:
+            return self._rv
+
     def dump_for_snapshot(self, kinds) -> "tuple[Dict[str, List[Any]], int]":
         """Consistent point-in-time view of the stores for compaction. The
         returned objects are the live stored ones — callers must only read

@@ -32,6 +32,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reconcile workers per controller (options.go:45)")
     p.add_argument("--enable-leader-election", action="store_true",
                    help="campaign for the sched-plugins-controller lease")
+    p.add_argument("--enable-defrag", action="store_true",
+                   help="run the defrag controller: shadow-verified, "
+                        "consent-gated migration of bound gangs to admit "
+                        "fragmentation-blocked slice gangs")
+    p.add_argument("--defrag-dry-run", action="store_true",
+                   help="defrag controller logs plans without evicting")
+    p.add_argument("--defrag-blocked-after", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="how long a slice gang must be fully Pending before "
+                        "the defrag controller considers it blocked")
+    p.add_argument("--defrag-cooldown", type=float, default=120.0,
+                   metavar="SECONDS",
+                   help="minimum seconds between defrag actuations")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "(0 picks a free port; off by default)")
@@ -45,7 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
 def options_from_args(args) -> ServerRunOptions:
     return ServerRunOptions(api_qps=args.qps, api_burst=args.burst,
                             workers=args.workers,
-                            enable_leader_election=args.enable_leader_election)
+                            enable_leader_election=args.enable_leader_election,
+                            enable_defrag=args.enable_defrag,
+                            defrag_dry_run=args.defrag_dry_run,
+                            defrag_blocked_after_s=args.defrag_blocked_after,
+                            defrag_cooldown_s=args.defrag_cooldown)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

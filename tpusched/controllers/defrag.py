@@ -1,0 +1,268 @@
+"""Defrag controller: opt-in, consent-gated actuation of migration plans.
+
+The advisor (sim/defrag.py, KEP-302) answers *which gang migration would
+admit a fragmentation-blocked job*; this controller closes the loop. The
+reference ecosystem splits this role into a separate descheduler project
+that evicts by policy and hopes the scheduler does better next time; here
+the plan is verified on a shadow (real scheduler, zero mutation) BEFORE
+anything is evicted, and nothing moves without consent:
+
+- a gang is BLOCKED when it declares a slice shape and its members have
+  been Pending for longer than ``blocked_after_s``;
+- migration candidates are restricted to fully-bound gangs whose PodGroup
+  carries ``defrag.tpu.dev/allow-migration: "true"`` — no workload moves
+  because a controller thought it best;
+- the plan trial forks a shadow, removes the candidate, and waits for the
+  BLOCKED gang's own pending pods to bind there (no synthetic probe gang —
+  a probe would race the real pending pods for the freed window), then
+  re-places the migrant; only a plan where everyone lands is actuated;
+- actuation = evict the migrant's pods and resubmit unbound copies; the
+  real scheduler re-places the migrant while the freed window admits the
+  blocked gang (reservation-release wakeups handle the requeue);
+- rate-limited to one migration per ``cooldown_s``; ``dry_run`` logs the
+  plan without acting.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api.scheduling import (PG_FINISHED, PG_FAILED, POD_GROUP_INDEX,
+                              POD_GROUP_LABEL, pod_group_index_key)
+from ..apiserver import Clientset, InformerFactory
+from ..apiserver import server as srv
+from ..plugins import default_registry
+from ..sched import Scheduler
+from ..sim.defrag import sanitize_for_resubmit
+from ..sim.whatif import _make_profile, _shadow_of
+from ..util import klog
+from ..util.metrics import REGISTRY
+
+ALLOW_MIGRATION_ANNOTATION = "defrag.tpu.dev/allow-migration"
+
+defrag_migrations_total = REGISTRY.counter(
+    "tpusched_defrag_migrations_total",
+    "Gangs migrated by the defrag controller.")
+
+
+class DefragController:
+    def __init__(self, api: srv.APIServer, *,
+                 blocked_after_s: float = 60.0,
+                 scan_interval_s: float = 15.0,
+                 cooldown_s: float = 120.0,
+                 shadow_timeout_s: float = 20.0,
+                 dry_run: bool = False,
+                 clock=time.time):
+        self.api = api
+        self.client = Clientset(api)
+        self.informers = InformerFactory(api)
+        self.blocked_after_s = blocked_after_s
+        self.scan_interval_s = scan_interval_s
+        self.cooldown_s = cooldown_s
+        self.shadow_timeout_s = shadow_timeout_s
+        self.dry_run = dry_run
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_actuation = 0.0
+        self.migrations = 0            # actuations performed (tests/metrics)
+        self.last_plan: Optional[dict] = None
+        # negative trial cache: (blocked, candidate) → store rv at failure.
+        # A failed shadow trial is deterministic for unchanged state, and a
+        # trial costs a full shadow scheduler for up to shadow_timeout_s —
+        # without this, one permanently-blocked gang re-burns every
+        # candidate every scan forever
+        self._failed_trials: Dict[Tuple[str, str], int] = {}
+
+        self.pg_informer = self.informers.podgroups()
+        self.pod_informer = self.informers.pods()
+        self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpusched-defrag")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # detach informers: the runner rebuilds controllers on every
+        # leadership cycle; leaked watch handlers would process every
+        # event forever (same discipline as PodGroupController.stop)
+        self.informers.close()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.scan_interval_s):
+            try:
+                self.reconcile_once()
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                klog.error_s(e, "defrag reconcile failed")
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile_once(self) -> Optional[dict]:
+        """One scan: find the oldest blocked slice gang, plan, (maybe) act.
+        Returns the actuated (or dry-run) plan dict, None when idle."""
+        if self.clock() - self._last_actuation < self.cooldown_s:
+            return None
+        blocked = self._blocked_gangs()
+        if not blocked:
+            return None
+        candidates = self._consenting_bound_gangs()
+        if not candidates:
+            return None
+        for full, _age in blocked:
+            plan = self._plan_for(full, candidates)
+            if plan is None:
+                continue
+            self.last_plan = plan
+            if self.dry_run:
+                klog.info_s("defrag plan (dry-run)", blocked=full,
+                            migrate=plan["migrate"])
+                # rate-limit REPLANNING too: the plan is in last_plan, and
+                # recomputing it every scan costs a shadow run
+                self._last_actuation = self.clock()
+                return plan
+            self._actuate(plan)
+            self._last_actuation = self.clock()
+            return plan
+        return None
+
+    def _blocked_gangs(self) -> List[Tuple[str, float]]:
+        """Slice gangs whose members are all still Pending past the
+        threshold, oldest first."""
+        now = self.clock()
+        out = []
+        for pg in self.pg_informer.items():
+            if not pg.spec.tpu_slice_shape:
+                continue
+            if pg.status.phase in (PG_FINISHED, PG_FAILED):
+                continue
+            members = self.pod_informer.by_index(POD_GROUP_INDEX, pg.key)
+            if not members or len(members) < pg.spec.min_member:
+                continue               # not fully submitted: not our case
+            if any(p.spec.node_name for p in members):
+                continue               # partially bound: scheduler's business
+            # age of the NEWEST member: the gang is blocked only since its
+            # last pod arrived (gang admission can't start before that)
+            age = now - max(p.meta.creation_timestamp for p in members)
+            if age >= self.blocked_after_s:
+                out.append((pg.key, age))
+        out.sort(key=lambda t: -t[1])
+        return out
+
+    def _consenting_bound_gangs(self) -> List[Tuple[str, int]]:
+        """(gang full name, chip footprint) of fully-bound gangs that opted
+        in to migration, smallest footprint first (the advisor's resident
+        scan filtered by consent)."""
+        from ..sim.defrag import _resident_gangs
+        consent = {pg.key for pg in self.pg_informer.items()
+                   if pg.meta.annotations.get(
+                       ALLOW_MIGRATION_ANNOTATION, "") == "true"}
+        if not consent:
+            return []
+        return [(full, chips) for full, _members, chips
+                in _resident_gangs(self.api) if full in consent]
+
+    # -- planning -------------------------------------------------------------
+
+    def _plan_for(self, blocked_full: str,
+                  candidates: List[Tuple[str, int]]) -> Optional[dict]:
+        """Shadow-trial each candidate (cheapest first): remove it, wait for
+        the blocked gang's OWN pending pods to bind, re-place the migrant.
+        Returns {blocked, migrate, chips} or None."""
+        blocked_keys = [p.meta.key for p in self.pod_informer.by_index(
+            POD_GROUP_INDEX, blocked_full)]
+        profile = _make_profile(False, self.shadow_timeout_s)
+        rv = self.api.current_resource_version()
+        for cand_full, cand_chips in candidates:
+            if cand_full == blocked_full:
+                continue
+            if self._failed_trials.get((blocked_full, cand_full)) == rv:
+                continue   # state unchanged since this trial failed
+            fork = _shadow_of(self.api, None)
+            cns, cname = cand_full.split("/", 1)
+            moved_pods = [p for p in fork.list(srv.PODS, cns)
+                          if p.meta.labels.get(POD_GROUP_LABEL) == cname]
+            moved_pg = fork.try_get(srv.POD_GROUPS, cand_full)
+            for p in moved_pods:
+                fork.delete(srv.PODS, p.meta.key)
+            if moved_pg is not None:
+                fork.delete(srv.POD_GROUPS, cand_full)
+            sched = Scheduler(fork, default_registry(), profile)
+            sched.run()
+            try:
+                if not self._wait_bound(fork, blocked_keys):
+                    self._failed_trials[(blocked_full, cand_full)] = rv
+                    continue
+                # re-place the migrant in what capacity remains
+                if moved_pg is not None:
+                    moved_pg.meta.resource_version = 0
+                    fork.create(srv.POD_GROUPS, moved_pg)
+                keys = []
+                for p in moved_pods:
+                    q = sanitize_for_resubmit(p)
+                    fork.create(srv.PODS, q)
+                    keys.append(q.meta.key)
+                if not self._wait_bound(fork, keys):
+                    # migrant would be homeless: not a plan
+                    self._failed_trials[(blocked_full, cand_full)] = rv
+                    continue
+                return {"blocked": blocked_full, "migrate": cand_full,
+                        "chips": cand_chips}
+            finally:
+                sched.stop()
+        return None
+
+    def _wait_bound(self, fork, keys: List[str]) -> bool:
+        deadline = time.monotonic() + self.shadow_timeout_s
+        while time.monotonic() < deadline:
+            if self._stop.is_set():
+                return False
+            live = [fork.peek(srv.PODS, k) for k in keys]
+            if all(p is not None and p.spec.node_name for p in live):
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- actuation ------------------------------------------------------------
+
+    def _actuate(self, plan: dict) -> None:
+        """Evict the migrant, wait for the blocked gang to take the freed
+        window, THEN resubmit the migrant — the same sequencing the shadow
+        trial verified. Resubmitting immediately would race the blocked
+        gang for the window it just vacated (the migrant is smaller and
+        off backoff, so it tends to win and re-fragment the pool). The
+        migrant is resubmitted even if the blocked gang misses its wait —
+        losing a consenting workload is never acceptable."""
+        cand_full = plan["migrate"]
+        cns, cname = cand_full.split("/", 1)
+        moved = [p for p in self.api.list(srv.PODS, cns)
+                 if p.meta.labels.get(POD_GROUP_LABEL) == cname]
+        klog.info_s("defrag actuation: migrating gang", gang=cand_full,
+                    members=len(moved), toAdmit=plan["blocked"])
+        resubmit = []
+        for p in moved:
+            resubmit.append(sanitize_for_resubmit(p))
+            try:
+                self.api.delete(srv.PODS, p.meta.key)
+            except srv.NotFound:
+                pass
+            self.client.record_event(
+                p.meta.key, "Pod", "Normal", "DefragMigrated",
+                f"migrated to admit blocked gang {plan['blocked']}")
+        blocked_keys = [p.meta.key for p in self.pod_informer.by_index(
+            POD_GROUP_INDEX, plan["blocked"])]
+        if not self._wait_bound(self.api, blocked_keys):
+            klog.error_s(None, "blocked gang missed the freed window; "
+                         "resubmitting the migrant anyway",
+                         blocked=plan["blocked"], migrated=cand_full)
+        for q in resubmit:
+            self.api.create(srv.PODS, q)
+        self.migrations += 1
+        defrag_migrations_total.inc()

@@ -31,6 +31,13 @@ class ServerRunOptions:
     enable_leader_election: bool = False
     lease_duration_s: float = 15.0
     renew_interval_s: float = 5.0
+    # defrag actuation (controllers/defrag.py) is opt-in twice over: the
+    # flag enables the controller, and each migrated gang must carry the
+    # consent annotation. dry-run plans without evicting.
+    enable_defrag: bool = False
+    defrag_dry_run: bool = False
+    defrag_blocked_after_s: float = 60.0
+    defrag_cooldown_s: float = 120.0
 
 
 class ControllerRunner:
@@ -79,6 +86,12 @@ class ControllerRunner:
             PodGroupController(self.api, workers=self.options.workers),
             ElasticQuotaController(self.api, workers=self.options.workers),
         ]
+        if self.options.enable_defrag:
+            from .defrag import DefragController
+            self._controllers.append(DefragController(
+                self.api, dry_run=self.options.defrag_dry_run,
+                blocked_after_s=self.options.defrag_blocked_after_s,
+                cooldown_s=self.options.defrag_cooldown_s))
         for c in self._controllers:
             c.run()
 

@@ -96,6 +96,25 @@ class MigrationSuggestion:
         return out
 
 
+def sanitize_for_resubmit(p: Pod) -> Pod:
+    """An unbound copy of a (possibly bound) pod, stripped of every
+    placement artifact — THE one definition, shared by the advisor's
+    shadow resubmission and the defrag controller's plan trial and
+    actuation, so shadow verification can never diverge from what
+    actuation actually submits."""
+    q = p.deepcopy()
+    q.meta.resource_version = 0
+    q.meta.creation_timestamp = 0   # re-stamped on create: a migrant must
+    #                                 not inherit its old age (it would
+    #                                 instantly read as "long-blocked")
+    q.spec.node_name = ""
+    q.meta.annotations.pop(COORD_ANNOTATION, None)
+    q.meta.annotations.pop(POOL_ANNOTATION, None)
+    q.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
+    q.status.conditions = []
+    return q
+
+
 def _resident_gangs(api: APIServer) -> List[Tuple[str, int, int]]:
     """(full name, member count, chip footprint) of every FULLY-bound gang,
     smallest footprint first. Partially-bound gangs (members still pending)
@@ -161,13 +180,7 @@ def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
                 fork.create(srv.POD_GROUPS, moved_pg)
             keys = []
             for p in moved_pods:
-                q = p.deepcopy()
-                q.meta.resource_version = 0
-                q.spec.node_name = ""
-                q.meta.annotations.pop(COORD_ANNOTATION, None)
-                q.meta.annotations.pop(POOL_ANNOTATION, None)
-                q.meta.annotations.pop(CHIP_INDEX_ANNOTATION, None)
-                q.status.conditions = []
+                q = sanitize_for_resubmit(p)
                 fork.create(srv.PODS, q)
                 keys.append(q.meta.key)
             deadline = _time.monotonic() + timeout_s
