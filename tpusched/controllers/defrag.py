@@ -110,6 +110,12 @@ class DefragController:
         Returns the actuated (or dry-run) plan dict, None when idle."""
         if self.clock() - self._last_actuation < self.cooldown_s:
             return None
+        # prune the negative trial cache: entries recorded against an older
+        # store rv can never match again (the guard compares equality), and
+        # keeping them would leak an entry per gang pair forever
+        rv = self.api.current_resource_version()
+        self._failed_trials = {k: v for k, v in self._failed_trials.items()
+                               if v == rv}
         blocked = self._blocked_gangs()
         if not blocked:
             return None
@@ -263,6 +269,13 @@ class DefragController:
                          "resubmitting the migrant anyway",
                          blocked=plan["blocked"], migrated=cand_full)
         for q in resubmit:
-            self.api.create(srv.PODS, q)
+            # fault-tolerant per pod: eviction already happened — one
+            # failed create (a Conflict from an external recreate during
+            # the wait window) must not strand the REST of the gang
+            try:
+                self.api.create(srv.PODS, q)
+            except Exception as e:  # noqa: BLE001
+                klog.error_s(e, "defrag resubmit failed for pod",
+                             pod=q.meta.key, gang=cand_full)
         self.migrations += 1
         defrag_migrations_total.inc()

@@ -36,6 +36,14 @@ class Cache:
         # quorum input), maintained incrementally at attach/detach so
         # assigned_count never walks the fleet (O(1) per cycle at any scale)
         self._pg_assigned: Dict[str, int] = {}
+        # global change cursor: bumped by every structural mutation so an
+        # unchanged cache returns the PREVIOUS Snapshot object outright —
+        # back-to-back cycles over a quiet fleet otherwise rebuild two
+        # O(nodes) dicts each (in-place pod mutations after assume stay
+        # visible without a bump: snapshots share the pod objects)
+        self._mutation = 0
+        self._snap_mutation = -1
+        self._last_snapshot: "Snapshot | None" = None
 
     def _pg_adjust(self, pod: Pod, delta: int) -> None:
         name = pod.meta.labels.get(POD_GROUP_LABEL)
@@ -52,6 +60,7 @@ class Cache:
 
     def add_node(self, node: Node) -> None:
         with self._lock:
+            self._mutation += 1
             old = self._infos.get(node.name)
             if old is not None:
                 for p in old.pods:
@@ -70,10 +79,12 @@ class Cache:
             if info is None:
                 self.add_node(node)
             else:
+                self._mutation += 1
                 info.set_node(node)
 
     def remove_node(self, node: Node) -> None:
         with self._lock:
+            self._mutation += 1
             info = self._infos.pop(node.name, None)
             if info is not None:
                 for p in info.pods:
@@ -84,12 +95,14 @@ class Cache:
     def _attach(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None:
+            self._mutation += 1
             info.add_pod(pod)
             self._pg_adjust(pod, +1)
 
     def _detach(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None and info.remove_pod(pod):
+            self._mutation += 1
             self._pg_adjust(pod, -1)
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
@@ -161,6 +174,9 @@ class Cache:
         first (sched/preemption.py:129-130, fwk/runtime.py:309-312)."""
         with self._lock:
             self._cleanup_expired()
+            if (self._mutation == self._snap_mutation
+                    and self._last_snapshot is not None):
+                return self._last_snapshot
             prev = self._snap_clones
             clones: Dict[str, Tuple[int, NodeInfo]] = {}
             infos: Dict[str, NodeInfo] = {}
@@ -171,7 +187,10 @@ class Cache:
                 clones[name] = ent
                 infos[name] = ent[1]
             self._snap_clones = clones
-            return Snapshot.from_infos(infos, dict(self._pg_assigned))
+            snap = Snapshot.from_infos(infos, dict(self._pg_assigned))
+            self._snap_mutation = self._mutation
+            self._last_snapshot = snap
+            return snap
 
     def node_names(self):
         with self._lock:

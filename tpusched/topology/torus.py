@@ -32,13 +32,30 @@ Coord = Tuple[int, ...]
 Placement = FrozenSet[Coord]   # set of host coords (host units)
 
 
+# Memo caches for the two pure shape functions below. Every PreFilter of a
+# slice pod evaluates them once per pool (a 1024-host/16-pool fleet pays
+# ~32 calls per pod per cycle); the result depends only on (shape,
+# accelerator, dims) — a handful of distinct keys fleet-wide. Bounded:
+# cleared wholesale if an adversarial stream of unique shapes ever grows
+# them past the cap (correct, just cold again).
+_CACHE_CAP = 4096
+_blocks_cache: dict = {}
+_validate_cache: dict = {}
+_MISS = object()
+
+
 def candidate_host_blocks(chip_shape: Coord, acc: TpuAccelerator,
-                          host_dims: Coord) -> List[Coord]:
+                          host_dims: Coord) -> "Sequence[Coord]":
     """All host-block shapes realizable by rotating `chip_shape` onto the
-    torus. Rotation happens on the CHIP shape FIRST; each rotated axis must
-    then divide the (anisotropic) host extent on the torus axis it lands on —
-    permuting after division is wrong on v5p's (2,2,1) extent (it both misses
-    feasible rotations and fabricates non-rotations)."""
+    torus (an immutable, memoized sequence). Rotation happens on the CHIP
+    shape FIRST; each rotated axis must then divide the (anisotropic) host
+    extent on the torus axis it lands on — permuting after division is
+    wrong on v5p's (2,2,1) extent (it both misses feasible rotations and
+    fabricates non-rotations)."""
+    key = (chip_shape, acc.name, host_dims)
+    hit = _blocks_cache.get(key, _MISS)
+    if hit is not _MISS:
+        return hit
     extent = HOST_EXTENT[acc.name]
     blocks: List[Coord] = []
     for perm in dict.fromkeys(itertools.permutations(chip_shape)):
@@ -47,25 +64,42 @@ def candidate_host_blocks(chip_shape: Coord, acc: TpuAccelerator,
         hb = tuple(perm[i] // extent[i] for i in range(len(extent)))
         if all(hb[i] <= host_dims[i] for i in range(len(hb))):
             blocks.append(hb)
-    return list(dict.fromkeys(blocks))
+    # cache a TUPLE: the memo hands the same object to every caller, and
+    # a mutable cached list would let one caller's sort/append poison
+    # feasibility answers fleet-wide
+    out = tuple(dict.fromkeys(blocks))
+    if len(_blocks_cache) >= _CACHE_CAP:
+        _blocks_cache.clear()
+    _blocks_cache[key] = out
+    return out
 
 
 def validate_slice_shape(shape: Coord, acc: TpuAccelerator,
                          pool_dims: Coord) -> Optional[str]:
     """Returns an error string or None. Shape and pool dims are in chips."""
+    key = (shape, acc.name, pool_dims)
+    hit = _validate_cache.get(key, _MISS)
+    if hit is not _MISS:
+        return hit
     extent = HOST_EXTENT[acc.name]
     if len(shape) != acc.ici_dims:
-        return (f"slice shape {shape} has {len(shape)} axes; "
-                f"{acc.name} torus has {acc.ici_dims}")
-    if len(pool_dims) != acc.ici_dims:
-        return f"pool dims {pool_dims} do not match {acc.name} torus rank"
-    if any(s <= 0 for s in shape):
-        return f"slice shape {shape} axes must be positive"
-    host_dims = tuple(d // e for d, e in zip(pool_dims, extent))
-    if not candidate_host_blocks(shape, acc, host_dims):
-        return (f"slice shape {shape} cannot map onto pool dims {pool_dims} "
-                f"(host extent {extent}) under any rotation")
-    return None
+        err = (f"slice shape {shape} has {len(shape)} axes; "
+               f"{acc.name} torus has {acc.ici_dims}")
+    elif len(pool_dims) != acc.ici_dims:
+        err = f"pool dims {pool_dims} do not match {acc.name} torus rank"
+    elif any(s <= 0 for s in shape):
+        err = f"slice shape {shape} axes must be positive"
+    else:
+        host_dims = tuple(d // e for d, e in zip(pool_dims, extent))
+        if not candidate_host_blocks(shape, acc, host_dims):
+            err = (f"slice shape {shape} cannot map onto pool dims "
+                   f"{pool_dims} (host extent {extent}) under any rotation")
+        else:
+            err = None
+    if len(_validate_cache) >= _CACHE_CAP:
+        _validate_cache.clear()
+    _validate_cache[key] = err
+    return err
 
 
 def host_block_shape(chip_shape: Coord, acc: TpuAccelerator) -> Coord:
