@@ -252,3 +252,64 @@ def test_delete_missing_and_get_missing_raise_not_found():
         api.get(srv.PODS, "default/nope")
     with pytest.raises(srv.NotFound):
         api.delete(srv.PODS, "default/nope")
+
+
+def test_create_restamps_falsy_creation_timestamp():
+    """Upstream: the apiserver sets metadata.creationTimestamp at admission
+    when absent. Round-4 reliance: sanitize_for_resubmit zeroes the
+    timestamp so a migrated pod's age restarts — if create() ever stopped
+    re-stamping, the defrag controller would instantly classify freshly
+    resubmitted migrants as long-blocked."""
+    api = APIServer()
+    p = make_pod("fresh")
+    p.meta.creation_timestamp = 0
+    stored = api.create(srv.PODS, p)
+    assert stored.meta.creation_timestamp > 0
+    # a non-zero timestamp is preserved (recovery/restore path relies on it)
+    q = make_pod("old")
+    q.meta.creation_timestamp = 123.0
+    assert api.create(srv.PODS, q).meta.creation_timestamp == 123.0
+
+
+def test_create_conflict_on_existing_key():
+    """Upstream: 409 AlreadyExists. Round-4 reliance: simulate_plan's
+    fail-fast validation exists precisely because mid-plan creates raise
+    this — the contract must hold for derived set gang names too."""
+    api = APIServer()
+    api.create(srv.PODS, make_pod("dup"))
+    with pytest.raises(srv.Conflict):
+        api.create(srv.PODS, make_pod("dup"))
+
+
+def test_current_resource_version_tracks_every_write():
+    """Round-4 reliance: the defrag controller's negative trial cache keys
+    on this cursor — it must move on EVERY mutation (any kind), and only
+    then."""
+    api = APIServer()
+    rv0 = api.current_resource_version()
+    assert api.current_resource_version() == rv0   # reads don't bump
+    api.create(srv.PODS, make_pod("a"))
+    rv1 = api.current_resource_version()
+    assert rv1 > rv0
+    api.patch(srv.PODS, "default/a", lambda p: None)
+    rv2 = api.current_resource_version()
+    assert rv2 > rv1
+    api.delete(srv.PODS, "default/a")
+    assert api.current_resource_version() > rv2
+
+
+def test_peek_is_zero_copy_and_live():
+    """peek() hands back the STORED object (hot-poll path): it must reflect
+    later writes through the same reference... but callers must never
+    mutate it. The contract pinned: peek sees the post-patch object
+    identity change (stored objects are replaced wholesale, never mutated
+    in place — the shared-informer-cache discipline)."""
+    api = APIServer()
+    api.create(srv.PODS, make_pod("p"))
+    first = api.peek(srv.PODS, "default/p")
+    api.patch(srv.PODS, "default/p",
+              lambda p: p.meta.labels.update({"x": "1"}))
+    second = api.peek(srv.PODS, "default/p")
+    assert second is not first          # wholesale replacement, no in-place
+    assert second.meta.labels.get("x") == "1"
+    assert first.meta.labels.get("x") is None   # old snapshot untouched

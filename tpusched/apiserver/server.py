@@ -262,6 +262,10 @@ class APIServer:
             obj = self._stores[kind].pop(key, None)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
+            # a delete IS a write: etcd bumps its revision for deletions
+            # too, and current_resource_version() consumers (the defrag
+            # negative-trial cache) must see freed capacity as a change
+            self._rv += 1
             if self._persist:
                 self._persist("delete", kind, obj)
         self._dispatch(WatchEvent(DELETED, kind, obj))
