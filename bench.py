@@ -908,6 +908,34 @@ def bench_tpu_workload() -> None:
          "prompt 128 (single v5e chip)",
          round(tok_s, 1), "tokens/s", 1.0)
 
+    # continuous-batching serving engine (jaxbridge/serve.py): mixed
+    # prompt/generation lengths through an 8-slot arena — the regime where
+    # static batching burns idle lanes waiting for the longest generation.
+    # occupancy is the reclaimed fraction; result-parity with solo decode
+    # is pinned CPU-side by tests/test_serve.py.
+    try:
+        import numpy as _np
+        from tpusched.jaxbridge.serve import Request, measure_serving
+        from tpusched.jaxbridge.workload import init_params as _init
+        scfg = dataclasses.replace(cfg, seq=512)
+        sparams = _init(jax.random.PRNGKey(0), scfg)
+        rng = _np.random.default_rng(0)
+        reqs = [Request(rid=i,
+                        prompt=rng.integers(0, scfg.vocab,
+                                            size=int(rng.integers(32, 128)),
+                                            dtype=_np.int32),
+                        max_new_tokens=int(rng.integers(16, 128)))
+                for i in range(32)]
+        out = measure_serving(scfg, sparams, reqs, slots=8, max_seq=512,
+                              prompt_bucket=128)   # engine warms itself
+        emit("continuous-batching serve throughput, llama-like 155M bf16, "
+             "8 slots, 32 mixed requests (prompts 32-128, gens 16-128), "
+             f"occupancy {out['occupancy']:.2f} (single v5e chip)",
+             round(out["tokens_per_s"], 1), "tokens/s", 1.0)
+    except Exception as e:  # noqa: BLE001
+        emit(f"serving bench FAILED: {type(e).__name__}: {e}",
+             None, "", None)
+
 
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): 5 headline gang runs, gate on the
