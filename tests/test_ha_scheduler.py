@@ -314,3 +314,62 @@ def test_deposed_journal_cannot_clobber_by_path(tmp_path):
     assert fresh.try_get(srv.POD_GROUPS, "default/new") is not None
     assert fresh.try_get(srv.POD_GROUPS, "default/new2") is not None
     assert fresh.try_get(srv.POD_GROUPS, "default/zombie2") is None
+
+
+def test_repeated_takeover_churn_preserves_state(tmp_path):
+    """Five successive crash-and-take-over generations: every takeover must
+    replay the WHOLE surviving state, and the binds accumulated across
+    generations survive byte-for-byte. Compaction runs on every attach, so
+    this churns the snapshot/WAL rotation path five times over one
+    directory."""
+    state = str(tmp_path)
+    expected = {}                     # pod key -> node, across generations
+    rep = None
+    try:
+        for gen in range(5):
+            rep = HAScheduler(state, identity=f"rep-{gen}",
+                              lease_duration_s=0.8, renew_interval_s=0.2)
+            rep.run()
+            assert rep.is_active.wait(20), f"generation {gen} never led"
+            # previous generation's binds all survived the replay
+            for k, node in expected.items():
+                p = rep.api.try_get(srv.PODS, k)
+                assert p is not None and p.spec.node_name == node, \
+                    f"gen {gen}: lost bind {k}"
+            if gen == 0:
+                topo, nodes = make_tpu_pool("pool", dims=(8, 8, 4))
+                rep.api.create(srv.TPU_TOPOLOGIES, topo)
+                for n in nodes:
+                    rep.api.create(srv.NODES, n)
+            # one fresh 16-chip slice gang per generation (5 gens fill 80
+            # of the pool's 256 chips)
+            name = f"gen-{gen}"
+            rep.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=4, tpu_slice_shape="2x2x4",
+                tpu_accelerator="tpu-v5p"))
+            keys = []
+            for i in range(4):
+                p_ = make_pod(f"{name}-{i}", pod_group=name,
+                              limits={TPU: 4})
+                rep.api.create(srv.PODS, p_)
+                keys.append(p_.key)
+            assert wait_until(lambda: _bound_count(rep.api, keys) == 4,
+                              timeout=30), f"gen {gen} gang did not bind"
+            for k in keys:
+                expected[k] = rep.api.try_get(srv.PODS, k).spec.node_name
+            rep.crash()               # SIGKILL semantics, lease kept
+    finally:
+        if rep is not None:
+            rep.crash()               # idempotent; frees a mid-loop leak
+    # final generation: clean recovery of all five gangs
+    final = HAScheduler(state, identity="rep-final",
+                        lease_duration_s=0.8, renew_interval_s=0.2)
+    final.run()
+    try:
+        assert final.is_active.wait(20)
+        assert len(expected) == 20
+        for k, node in expected.items():
+            p = final.api.try_get(srv.PODS, k)
+            assert p is not None and p.spec.node_name == node
+    finally:
+        final.stop()
