@@ -547,6 +547,11 @@ def bench_scale() -> None:
         "per-pod schedule latency at 1024 emulated TPU hosts "
         "(vectorized batch filter + parallel sweep, 64 pods)",
         times, "scale_per_pod_p99")
+    times = _repeat(run_scale_once, 8, 4096)
+    emit_latency(
+        "per-pod schedule latency at 4096 emulated TPU hosts "
+        "(4x fleet: sublinear via adaptive node sampling, 64 pods)",
+        times, "scale4k_per_pod_p99")
 
 
 def fleet_gang_times(repeats: int) -> list:

@@ -1,0 +1,125 @@
+"""Property-based torus-engine invariants (hypothesis). The example-based
+suites pin known shapes; these pin the LAWS that must hold for every
+shape/pool combination the fuzzer can draw — the combinatorial core where
+a subtle rotation or wraparound bug would otherwise only surface on an
+operator's exotic pool.
+
+Invariants:
+  P1  every candidate host block divides out the accelerator's host extent
+      exactly and fits the pool (and the memoized result is stable);
+  P2  every enumerated placement has exactly prod(block) distinct in-bounds
+      hosts, and without wrap no placement crosses an axis boundary;
+  P3  placements are pairwise distinct as sets;
+  P4  validate_slice_shape is consistent with enumeration: a shape that
+      validates on a fully-populated pool enumerates >= 1 placement, and a
+      shape that fails validation enumerates none;
+  P5  feasible_placements never returns a placement missing an assigned
+      host or touching a non-free host.
+"""
+import math
+
+from hypothesis import given, settings, strategies as st
+
+from tpusched.api.topology import ACCELERATORS
+from tpusched.topology.torus import (HostGrid, HOST_EXTENT,
+                                     candidate_host_blocks,
+                                     enumerate_placements,
+                                     feasible_placements,
+                                     validate_slice_shape)
+
+ACC_3D = ACCELERATORS["tpu-v5p"]          # host extent (2, 2, 1)
+ACC_2D = ACCELERATORS["tpu-v5e"]          # host extent (2, 2)
+
+
+def _grid(acc, chip_dims, wrap):
+    extent = HOST_EXTENT[acc.name]
+    host_dims = tuple(d // e for d, e in zip(chip_dims, extent))
+    node_of = {}
+    coords = [()]
+    for d in host_dims:
+        coords = [c + (i,) for c in coords for i in range(d)]
+    for hc in coords:
+        node_of[hc] = "n" + "-".join(map(str, hc))
+    return HostGrid(pool="p", acc=acc, dims=host_dims, wrap=wrap,
+                    node_of=node_of,
+                    coord_of={v: k for k, v in node_of.items()})
+
+
+dims3 = st.tuples(st.sampled_from([2, 4, 6, 8]), st.sampled_from([2, 4, 6]),
+                  st.sampled_from([1, 2, 4]))
+shape3 = st.tuples(st.integers(1, 8), st.integers(1, 8), st.integers(1, 4))
+wrap3 = st.tuples(st.booleans(), st.booleans(), st.booleans())
+
+dims2 = st.tuples(st.sampled_from([2, 4, 8, 16]), st.sampled_from([2, 4, 8]))
+shape2 = st.tuples(st.integers(1, 16), st.integers(1, 8))
+wrap2 = st.tuples(st.booleans(), st.booleans())
+
+
+@settings(max_examples=200, deadline=None)
+@given(shape=shape3, dims=dims3)
+def test_p1_candidate_blocks_divide_extent_and_fit(shape, dims):
+    extent = HOST_EXTENT[ACC_3D.name]
+    host_dims = tuple(d // e for d, e in zip(dims, extent))
+    blocks = candidate_host_blocks(shape, ACC_3D, host_dims)
+    again = candidate_host_blocks(shape, ACC_3D, host_dims)
+    assert tuple(blocks) == tuple(again)          # memo stability
+    for hb in blocks:
+        assert all(0 < hb[i] <= host_dims[i] for i in range(3))
+        # some permutation of the chip shape reproduces hb * extent
+        assert any(tuple(p[i] // extent[i] for i in range(3)) == hb
+                   and all(p[i] % extent[i] == 0 for i in range(3))
+                   for p in set(__import__("itertools").permutations(shape)))
+
+
+@settings(max_examples=120, deadline=None)
+@given(shape=shape3, dims=dims3, wrap=wrap3)
+def test_p2_p3_placements_sized_in_bounds_distinct(shape, dims, wrap):
+    grid = _grid(ACC_3D, dims, wrap)
+    placements = enumerate_placements(grid, shape)
+    extent = HOST_EXTENT[ACC_3D.name]
+    sizes = {tuple(p[i] // extent[i] for i in range(3))
+             for p in __import__("itertools").permutations(shape)
+             if all(p[i] % extent[i] == 0 for i in range(3))}
+    valid_sizes = {math.prod(hb) for hb in sizes
+                   if all(hb[i] <= grid.dims[i] for i in range(3))}
+    seen = set()
+    for pl in placements:
+        assert pl not in seen                    # P3
+        seen.add(pl)
+        assert len(pl) in valid_sizes            # P2: cardinality
+        for hc in pl:
+            assert all(0 <= hc[i] < grid.dims[i] for i in range(3))
+        if not any(wrap):
+            # without wrap the placement is a contiguous axis-aligned box
+            for i in range(3):
+                axis = sorted({hc[i] for hc in pl})
+                assert axis == list(range(axis[0], axis[-1] + 1))
+
+
+@settings(max_examples=120, deadline=None)
+@given(shape=shape2, dims=dims2, wrap=wrap2)
+def test_p4_validate_consistent_with_enumeration_2d(shape, dims, wrap):
+    err = validate_slice_shape(shape, ACC_2D, dims)
+    grid = _grid(ACC_2D, dims, wrap)
+    placements = enumerate_placements(grid, shape)
+    if err is None:
+        assert placements, (shape, dims, wrap)
+    else:
+        assert not placements, (shape, dims, wrap, err)
+
+
+@settings(max_examples=80, deadline=None)
+@given(shape=shape3, dims=dims3, wrap=wrap3, data=st.data())
+def test_p5_feasible_respects_assigned_and_free(shape, dims, wrap, data):
+    grid = _grid(ACC_3D, dims, wrap)
+    placements = enumerate_placements(grid, shape)
+    hosts = sorted(grid.node_of)
+    free = frozenset(data.draw(st.sets(st.sampled_from(hosts))) if hosts
+                     else set())
+    assigned_pool = sorted(free) or hosts
+    assigned = frozenset(data.draw(
+        st.sets(st.sampled_from(assigned_pool), max_size=3))) if hosts \
+        else frozenset()
+    for pl in feasible_placements(placements, assigned, free):
+        assert assigned <= pl
+        assert all(hc in free or hc in assigned for hc in pl)
