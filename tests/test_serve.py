@@ -108,3 +108,27 @@ def test_measure_serving_reports_occupancy(model):
     assert out["tokens"] == sum(r.max_new_tokens for r in reqs)
     assert 0 < out["occupancy"] <= 1.0
     assert out["tokens_per_s"] > 0
+
+
+def test_tp_sharded_engine_matches_unsharded(model):
+    """Tensor-parallel serving on a tp=2 mesh (virtual CPU devices): the
+    sharded engine's greedy completions must equal the unsharded solo
+    outputs — GSPMD's inserted collectives may not change the math."""
+    from jax.sharding import Mesh
+    cfg, params = model
+    devices = np.array(jax.devices()[:2])
+    mesh = Mesh(devices, ("tp",))
+    rng = np.random.default_rng(9)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 4, 12, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 6)))
+            for i in range(4)]
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      mesh=mesh)
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    for c in done:
+        req = next(r for r in reqs if r.rid == c.rid)
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)

@@ -44,11 +44,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .decode import (KVCache, decode_step, init_kv_cache,
                      sample_token)
 from .workload import (ModelConfig, Params, _finish_block, _qkv,
-                       _resolve_attn_fn, _rmsnorm, cast_params_for_compute)
+                       _resolve_attn_fn, _rmsnorm, cast_params_for_compute,
+                       param_specs)
 
 
 @dataclasses.dataclass
@@ -124,7 +126,8 @@ class ServeEngine:
                  slots: int = 8, max_seq: int = 1024,
                  prompt_bucket: int = 128,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: int = 0):
+                 top_p: float = 1.0, seed: int = 0,
+                 mesh: Optional[Mesh] = None):
         if prompt_bucket > max_seq:
             raise ValueError("prompt_bucket must fit in max_seq")
         self.params = params
@@ -136,7 +139,36 @@ class ServeEngine:
         self.top_k = top_k
         self.top_p = top_p
         self._key = jax.random.PRNGKey(seed)
-        self.cache = init_kv_cache(cfg, slots, max_seq)
+        if mesh is None:
+            self.cache = init_kv_cache(cfg, slots, max_seq)
+        else:
+            # tensor-parallel serving: params tp-sharded exactly like
+            # training (param_specs: column-parallel in, row-parallel out —
+            # GSPMD inserts the per-layer tp all-reduce), the KV arena
+            # sharded over its kv_heads axis. Everything downstream is the
+            # SAME jitted program; shardings propagate through it.
+            tp_axis = "tp" if "tp" in mesh.axis_names else None
+            tp = mesh.shape.get("tp", 1)
+            if cfg.kv_heads % tp:
+                raise ValueError(
+                    f"kv_heads {cfg.kv_heads} not divisible by tp {tp}")
+            if cfg.vocab_parallel_loss:
+                raise ValueError("serving samples over full logits; use a "
+                                 "cfg with vocab_parallel_loss=False")
+            pshard = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec),
+                param_specs(cfg, mesh),
+                is_leaf=lambda x: isinstance(x, P))
+            self.params = jax.device_put(params, pshard)
+            # allocate the arena DIRECTLY sharded: materializing the full
+            # (slots, max_seq) zeros replicated first would transiently
+            # commit the whole arena to one chip (an OOM at production
+            # sizes even when every shard fits)
+            kv_sh = NamedSharding(mesh, P(None, None, tp_axis, None))
+            self.cache = jax.jit(
+                lambda: init_kv_cache(cfg, slots, max_seq),
+                out_shardings=[{"k": kv_sh, "v": kv_sh}
+                               for _ in range(cfg.n_layers)])()
         self._prefill = _build_prefill_slot(cfg, prompt_bucket)
         self._tick = _build_decode_tick(cfg)
         # host-side slot state (numpy: the scheduler of this tiny world)
