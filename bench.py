@@ -908,9 +908,15 @@ def bench_tpu_workload() -> None:
         emit(f"MoE train-step FAILED: {type(e).__name__}: {e}",
              None, "", None)
 
-    tok_s = measure_decode(dataclasses.replace(cfg, seq=512), batch=8)
+    tok_s, mean_ctx = measure_decode(dataclasses.replace(cfg, seq=512),
+                                     batch=8)
+    from tpusched.jaxbridge.measure import decode_bandwidth_utilization
+    bw = decode_bandwidth_utilization(dataclasses.replace(cfg, seq=512),
+                                      batch=8, mean_ctx=mean_ctx,
+                                      tokens_per_s=tok_s)
+    bw_note = f", {bw:.0%} of peak HBM BW" if bw is not None else ""
     emit("KV-cache greedy decode throughput, llama-like 155M bf16, b8, "
-         "prompt 128 (single v5e chip)",
+         f"prompt 128 (single v5e chip; decode is bandwidth-bound{bw_note})",
          round(tok_s, 1), "tokens/s", 1.0)
 
     # continuous-batching serving engine (jaxbridge/serve.py): mixed

@@ -161,3 +161,32 @@ def test_moe_train_step_flops_accounting():
     assert gap2 > 2.5 * gap1
     note = moe_flops_note(moe, 1)
     assert "dispatch" in note and "E=8" in note
+
+
+def test_decode_bandwidth_accounting():
+    """Decode roofline numerator: weight streaming dominates at b1, the KV
+    term grows linearly with batch and context."""
+    from tpusched.jaxbridge.measure import (decode_bytes_per_token,
+                                            decode_bandwidth_utilization)
+    from tpusched.jaxbridge.workload import ModelConfig
+
+    cfg = ModelConfig.llama_like(seq=512)
+    b1 = decode_bytes_per_token(cfg, 1, 128)
+    b8 = decode_bytes_per_token(cfg, 8, 128)
+    long = decode_bytes_per_token(cfg, 8, 512)
+    assert b8 > b1                      # KV term scales with batch
+    assert long > b8                    # and with live context
+    kv1 = b8 - b1                       # 7 extra sequences' KV at ctx 128
+    assert abs((long - b8) - kv1 * (8 / 7) * 3) / (long - b8) < 0.01
+    # MoE configs must refuse rather than publish a dense-MLP number
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="n_experts"):
+        decode_bytes_per_token(ModelConfig.mixtral_like(), 1, 128)
+    # off-TPU the peak is unknown: utilization must decline to answer;
+    # on a recognized chip it must answer with a positive fraction
+    from tpusched.jaxbridge.measure import device_peak_hbm_gbps
+    util = decode_bandwidth_utilization(cfg, 8, 128, 1000.0)
+    if device_peak_hbm_gbps() is None:
+        assert util is None
+    else:
+        assert util is not None and util > 0

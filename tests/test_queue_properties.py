@@ -1,0 +1,113 @@
+"""Property-based SchedulingQueue conservation laws (hypothesis stateful).
+
+The queue juggles four structures (activeQ heap, backoff heap with
+tombstones, unschedulable map, live-key index) across adds, pops, failure
+requeues, deletes, event moves, and activations. The conservation law a
+scheduler cannot live without: **every added, undeleted, unpopped pod is
+pending in exactly one place — never lost, never duplicated** — under ANY
+interleaving. A lost pod is a silently stranded workload; a duplicated one
+double-schedules.
+
+A deterministic fake clock drives backoff expiry so the machine can
+explore "time passed" transitions without sleeping.
+"""
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import (Bundle, RuleBasedStateMachine, invariant,
+                                 rule)
+
+from tpusched.fwk.interfaces import EVENT_DELETE, RESOURCE_POD
+from tpusched.sched.queue import SchedulingQueue
+from tpusched.testing import make_pod
+
+
+class QueueMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        self.now = [1000.0]
+        self.q = SchedulingQueue(
+            less=lambda a, b: a.pod.key < b.pod.key,
+            clock=lambda: self.now[0])
+        self.counter = 0
+        self.pending = {}              # key -> Pod (added, not popped/deleted)
+        self.popped = {}               # key -> QueuedPodInfo (in a "cycle")
+
+    pods = Bundle("pods")
+
+    @rule(target=pods)
+    def add_pod(self):
+        self.counter += 1
+        p = make_pod(f"p{self.counter}")
+        self.q.add(p)
+        self.pending[p.key] = p
+        return p
+
+    @rule()
+    def pop_one(self):
+        info = self.q.pop(timeout=0)
+        if info is not None:
+            key = info.pod.key
+            assert key in self.pending, f"popped unknown/duplicate {key}"
+            assert key not in self.popped, f"double-pop {key}"
+            self.popped[key] = info
+            del self.pending[key]
+
+    @rule(to_backoff=st.booleans())
+    def fail_popped(self, to_backoff):
+        if not self.popped:
+            return
+        key = next(iter(self.popped))
+        info = self.popped.pop(key)
+        self.q.requeue_after_failure(info, to_backoff=to_backoff)
+        self.pending[key] = info.pod
+
+    @rule(delay=st.floats(0.1, 5.0))
+    def fail_popped_with_delay(self, delay):
+        if not self.popped:
+            return
+        key = next(iter(self.popped))
+        info = self.popped.pop(key)
+        self.q.requeue_after_failure(info, delay_s=delay)
+        self.pending[key] = info.pod
+
+    @rule(pod=pods)
+    def delete_pod(self, pod):
+        if pod.key in self.pending:
+            self.q.delete(pod)
+            del self.pending[pod.key]
+        elif pod.key in self.popped:
+            # a pod deleted mid-cycle: the scheduler's failure path checks
+            # liveness before requeueing; model that by dropping it
+            self.q.delete(pod)
+            del self.popped[pod.key]
+
+    @rule()
+    def event_move(self):
+        self.q.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
+
+    @rule()
+    def activate_all_pending(self):
+        self.q.activate(list(self.pending.values()))
+
+    @rule(dt=st.floats(0.1, 40.0))
+    def advance_time(self, dt):
+        self.now[0] += dt
+
+    @invariant()
+    def conservation(self):
+        counts = self.q.pending_counts()
+        total = counts["active"] + counts["backoff"] + counts["unschedulable"]
+        assert total == len(self.pending), \
+            f"{counts} vs model {sorted(self.pending)}"
+
+    @invariant()
+    def no_phantom_pods(self):
+        queued = [p.key for p in self.q.pending_pods()]
+        assert sorted(queued) == sorted(self.pending), \
+            f"queue={sorted(queued)} model={sorted(self.pending)}"
+
+
+QueueMachine.TestCase.settings = settings(max_examples=60,
+                                          stateful_step_count=60,
+                                          deadline=None)
+TestQueueConservation = QueueMachine.TestCase

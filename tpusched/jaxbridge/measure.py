@@ -50,6 +50,69 @@ _PEAK_TFLOPS = (
 )
 
 
+# HBM bandwidth peaks (GB/s) — the decode roofline. Single-token decode is
+# bandwidth-bound: every step streams the full parameter set plus the live
+# KV prefix; tokens/s alone says nothing without the fraction of peak BW it
+# achieves.
+_PEAK_HBM_GBPS = (
+    ("TPU v6 lite", 1640.0),
+    ("TPU v6", 1640.0),
+    ("TPU v5 lite", 819.0),
+    ("TPU v5p", 2765.0),
+    ("TPU v5", 2765.0),
+    ("TPU v4 lite", 614.0),   # before "TPU v4": prefix-shadowing
+    ("TPU v4", 1228.0),
+    ("TPU v3", 900.0),
+)
+
+
+def device_peak_hbm_gbps(device=None) -> Optional[float]:
+    """HBM bandwidth peak for ``device``, or None when unknown."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in _PEAK_HBM_GBPS:
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def decode_bytes_per_token(cfg: ModelConfig, batch: int,
+                           mean_ctx: int) -> int:
+    """HBM bytes one decode STEP must stream (the bandwidth roofline's
+    numerator): the full parameter set once per step (amortized over the
+    whole batch — that is batching's entire win) plus each sequence's live
+    KV prefix (batch × mean_ctx × layers × 2 × kv_heads × head_dim).
+    Weight streaming dominates at small batch; KV at long context."""
+    if cfg.n_experts:
+        # the MoE decode path streams top-k-gathered expert stacks; until a
+        # measured MoE decode exists, a dense-MLP count here would publish
+        # a confidently wrong utilization
+        raise ValueError("decode bandwidth accounting models dense MLPs "
+                         "only (n_experts > 0 unsupported)")
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
+    d_kv = (d // cfg.n_heads) * cfg.kv_heads
+    per_layer = d * d + d * d_kv * 2 + d * d + 3 * d * f  # wq wk wv wo mlp
+    n_params = v * d * 2 + cfg.n_layers * per_layer       # embed + out
+    kv = batch * mean_ctx * cfg.n_layers * 2 * d_kv
+    return (n_params + kv) * itemsize
+
+
+def decode_bandwidth_utilization(cfg: ModelConfig, batch: int,
+                                 mean_ctx: int,
+                                 tokens_per_s: float) -> Optional[float]:
+    """Achieved HBM bandwidth fraction of the decode loop: steps/s ×
+    bytes/step vs the chip's peak. The MFU analog for the regime where
+    the MXU is idle and the memory system is the machine."""
+    peak = device_peak_hbm_gbps()
+    if peak is None:
+        return None
+    steps_per_s = tokens_per_s / batch
+    achieved = steps_per_s * decode_bytes_per_token(cfg, batch, mean_ctx)
+    return achieved / (peak * 1e9)
+
+
 def device_peak_tflops(device=None) -> Optional[float]:
     """bf16 peak for ``device`` (default: first jax device), or None when
     unknown (CPU, new chip) — callers must then skip MFU claims."""
@@ -283,10 +346,13 @@ def measure_adamw_train_step(cfg: ModelConfig, batch: int, k1: int = 1,
 
 def measure_decode(cfg: ModelConfig, batch: int, prompt_len: int = 128,
                    k1: int = 64, k2: int = 256,
-                   repeats: int = 3) -> float:
+                   repeats: int = 3) -> "Tuple[float, int]":
     """Decode throughput (tokens/s across the batch) of the KV-cache path:
     greedy generate() with k decode steps, slope-timed so prefill and the
-    tunnel round-trip cancel out."""
+    tunnel round-trip cancel out. Returns (tokens_per_s, mean_ctx) where
+    mean_ctx is the mean live context over the slope window — derived
+    from the SAME prompt_len/k1/k2, so bandwidth accounting can never
+    desynchronize from what was measured."""
     from .decode import generate
     params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
@@ -300,4 +366,4 @@ def measure_decode(cfg: ModelConfig, batch: int, prompt_len: int = 128,
         _timed(run, params, prompt, k)
     per_token = time_chained(lambda k: _timed(run, params, prompt, k),
                              k1, k2, repeats)
-    return batch / per_token
+    return batch / per_token, prompt_len + (k1 + k2) // 2
