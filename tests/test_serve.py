@@ -132,3 +132,25 @@ def test_tp_sharded_engine_matches_unsharded(model):
         solo = np.asarray(generate(params, req.prompt[None, :], cfg,
                                    steps=req.max_new_tokens - 1))[0]
         np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_prompt_buckets_pick_smallest_fit(model):
+    """Multi-bucket prefill: a short prompt compiles/uses the small bucket,
+    a long one the big bucket — and parity still holds for both."""
+    cfg, params = model
+    rng = np.random.default_rng(13)
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64,
+                      prompt_bucket=(8, 24))
+    short = Request(rid=0, prompt=_prompt(rng, 3, 8, cfg.vocab),
+                    max_new_tokens=3)
+    long_ = Request(rid=1, prompt=_prompt(rng, 12, 24, cfg.vocab),
+                    max_new_tokens=3)
+    eng.submit(short)
+    eng.submit(long_)
+    done = eng.run_until_drained()
+    assert set(eng._prefill_by_bucket) == {8, 24}
+    for c in done:
+        req = short if c.rid == 0 else long_
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)

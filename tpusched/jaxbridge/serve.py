@@ -124,17 +124,25 @@ class ServeEngine:
 
     def __init__(self, params: Params, cfg: ModelConfig, *,
                  slots: int = 8, max_seq: int = 1024,
-                 prompt_bucket: int = 128,
+                 prompt_bucket: "int | Tuple[int, ...]" = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
                  mesh: Optional[Mesh] = None):
-        if prompt_bucket > max_seq:
-            raise ValueError("prompt_bucket must fit in max_seq")
+        # one or several prompt buckets (ascending): each admission pads to
+        # the SMALLEST bucket that fits, so short prompts stop paying the
+        # longest prompt's prefill FLOPs. One compiled prefill per bucket,
+        # built lazily on first use.
+        buckets = ((prompt_bucket,) if isinstance(prompt_bucket, int)
+                   else tuple(sorted(set(prompt_bucket))))
+        if not buckets or buckets[-1] >= max_seq:
+            raise ValueError("prompt buckets must be non-empty and leave "
+                             "generation room under max_seq")
         self.params = params
         self.cfg = cfg
         self.slots = slots
         self.max_seq = max_seq
-        self.prompt_bucket = prompt_bucket
+        self.prompt_buckets = buckets
+        self.prompt_bucket = buckets[-1]   # largest (admission bound)
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
@@ -169,7 +177,7 @@ class ServeEngine:
                 lambda: init_kv_cache(cfg, slots, max_seq),
                 out_shardings=[{"k": kv_sh, "v": kv_sh}
                                for _ in range(cfg.n_layers)])()
-        self._prefill = _build_prefill_slot(cfg, prompt_bucket)
+        self._prefill_by_bucket: Dict[int, Callable] = {}
         self._tick = _build_decode_tick(cfg)
         # host-side slot state (numpy: the scheduler of this tiny world)
         self.pos = np.zeros(slots, dtype=np.int32)       # next write position
@@ -200,11 +208,16 @@ class ServeEngine:
         path) and reset the metrics counters — measurement must time
         decode work, not XLA compilation. The jit caches live on THIS
         engine's closures, so a different engine cannot warm them."""
-        self.submit(Request(rid=-1,
-                            prompt=np.zeros(min(4, self.prompt_bucket),
-                                            dtype=np.int32),
-                            max_new_tokens=2))
-        self.run_until_drained()
+        for i, bucket in enumerate(self.prompt_buckets):
+            # a FULL-length prompt selects exactly this bucket (a short one
+            # would fall into the smallest bucket and warm only that); the
+            # first warmup generates 2 tokens so the DECODE tick compiles
+            # too (a 1-token request finishes inside admission)
+            self.submit(Request(rid=-1,
+                                prompt=np.zeros(bucket, dtype=np.int32),
+                                max_new_tokens=min(2, self.max_seq - bucket)
+                                if i == 0 else 1))
+            self.run_until_drained()
         self.completions.clear()
         self.tick_count = 0
         self.decode_tokens = 0
@@ -217,9 +230,14 @@ class ServeEngine:
                 continue
             req = self.queue.pop(0)
             true_len = len(req.prompt)
-            padded = np.zeros(self.prompt_bucket, dtype=np.int32)
+            bucket = next(b for b in self.prompt_buckets if b >= true_len)
+            prefill = self._prefill_by_bucket.get(bucket)
+            if prefill is None:
+                prefill = _build_prefill_slot(self.cfg, bucket)
+                self._prefill_by_bucket[bucket] = prefill
+            padded = np.zeros(bucket, dtype=np.int32)
             padded[:true_len] = req.prompt
-            self.cache, first_logits = self._prefill(
+            self.cache, first_logits = prefill(
                 self.params, self.cache, jnp.asarray(padded),
                 jnp.int32(slot), jnp.int32(true_len))
             tok = self._sample(first_logits[None, :])[0]
@@ -286,7 +304,7 @@ class ServeEngine:
 
 def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
                     *, slots: int = 8, max_seq: int = 1024,
-                    prompt_bucket: int = 128,
+                    prompt_bucket: "int | Tuple[int, ...]" = 128,
                     time_fn: Callable[[], float] = None) -> Dict[str, float]:
     """Throughput of the continuous engine vs the static-batch floor on the
     SAME request set. Static batching pads every generation to the
