@@ -183,3 +183,42 @@ def test_pair_search_is_bounded():
                       accelerator="tpu-v5p", chips_per_pod=4)
         assert suggest_migrations(source_api=c.api, job=target, max_moves=2,
                                   max_pair_trials=0, timeout_s=8) == []
+
+
+def test_advisor_treats_atomic_set_as_one_unit():
+    """Suggesting half an atomic multislice set is suggesting an outage:
+    the advisor must return the WHOLE set as one plan (both member gangs
+    in .moves), never a single-slice migration."""
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.config.types import MultiSliceArgs
+    prof = tpu_gang_profile(permit_wait_s=10, denied_s=1)
+    prof.plugin_args["MultiSlice"] = MultiSliceArgs(
+        set_schedule_timeout_seconds=8, denied_set_expiration_time_seconds=1)
+    with TestCluster(profile=prof) as c:
+        _pool(c, "pool-a", dims=(4, 4, 4))
+        set_keys = []
+        for idx in range(2):
+            name = f"ms-s{idx}"
+            c.api.create(srv.POD_GROUPS, make_pod_group(
+                name, min_member=4, tpu_slice_shape="2x2x4",
+                tpu_accelerator="tpu-v5p", multislice_set="ms",
+                multislice_index=idx, multislice_set_size=2))
+            ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+                  for i in range(4)]
+            c.create_pods(ps)
+            set_keys += [p.key for p in ps]
+        from tpusched.testing import wait_until
+        assert c.wait_for_pods_scheduled(set_keys, timeout=30)
+        _pool(c, "rehome", dims=(4, 4, 2))
+        target = dict(members=16, slice_shape="4x4x4",
+                      accelerator="tpu-v5p", chips_per_pod=4)
+        plans = suggest_migrations(source_api=c.api, job=target,
+                                   timeout_s=15)
+        assert len(plans) == 1
+        assert sorted(m.gang for m in plans[0].moves) == \
+            ["default/ms-s0", "default/ms-s1"]
+        assert plans[0].migrate_chips == 32
+        # naming only one slice as a candidate must NOT move the set
+        assert suggest_migrations(source_api=c.api, job=target,
+                                  candidates=["default/ms-s0"],
+                                  timeout_s=6) == []

@@ -64,7 +64,7 @@ def test_controller_migrates_consenting_gang_and_admits_blocked():
         time.sleep(0.6)                     # cross blocked_after
         plan = ctl.reconcile_once()
         assert plan is not None
-        assert plan["migrate"] == "default/small"
+        assert plan["migrate"] == ["default/small"]
         assert plan["blocked"] == "default/target"
         assert ctl.migrations == 1
         # everyone lands: target takes pool-a, small re-homes
@@ -95,7 +95,7 @@ def test_dry_run_plans_without_evicting():
         ctl = _controller(c, dry_run=True)
         time.sleep(0.6)
         plan = ctl.reconcile_once()
-        assert plan is not None and plan["migrate"] == "default/small"
+        assert plan is not None and plan["migrate"] == ["default/small"]
         assert ctl.migrations == 0
         assert all(c.pod(p.key).spec.node_name for p in small)
         assert all(not c.pod(p.key).spec.node_name for p in target)
@@ -137,3 +137,77 @@ def test_runner_wires_defrag_controller():
             for ctl in r._controllers), timeout=5)
     finally:
         r.stop()
+
+
+def test_atomic_set_migrates_as_one_unit():
+    """An atomic multislice set is one migration unit: the controller must
+    move BOTH member gangs together (half-migrating a bound set would
+    strand the survivor) and the set must re-admit whole through its own
+    barrier on the re-home pool."""
+    from tpusched.config.types import MultiSliceArgs
+    prof = tpu_gang_profile(permit_wait_s=10, denied_s=1)
+    prof.plugin_args["MultiSlice"] = MultiSliceArgs(
+        set_schedule_timeout_seconds=8, denied_set_expiration_time_seconds=1)
+    with TestCluster(profile=prof) as c:
+        _pool(c, "pool-a")                          # 64 chips
+        # the atomic set fragments pool-a (2 x 16 chips)
+        set_keys = []
+        for idx in range(2):
+            name = f"ms-s{idx}"
+            pg = make_pod_group(name, min_member=4, tpu_slice_shape="2x2x4",
+                                tpu_accelerator="tpu-v5p",
+                                multislice_set="ms", multislice_index=idx,
+                                multislice_set_size=2)
+            pg.meta.annotations[ALLOW_MIGRATION_ANNOTATION] = "true"
+            c.api.create(srv.POD_GROUPS, pg)
+            ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+                  for i in range(4)]
+            c.create_pods(ps)
+            set_keys += [p.key for p in ps]
+        assert c.wait_for_pods_scheduled(set_keys, timeout=30)
+        _pool(c, "rehome", dims=(4, 4, 2))          # fits the whole set
+        target = _gang(c, "target", "4x4x4", 16, wait=False)
+        assert c.wait_for_pods_unscheduled([p.key for p in target], hold=0.5)
+
+        ctl = _controller(c)
+        time.sleep(0.6)
+        plan = ctl.reconcile_once()
+        assert plan is not None
+        assert sorted(plan["migrate"]) == ["default/ms-s0", "default/ms-s1"]
+        assert c.wait_for_pods_scheduled([p.key for p in target], timeout=30)
+        assert c.wait_for_pods_scheduled(set_keys, timeout=30)
+        pools = {c.pod(k).meta.annotations[POOL_ANNOTATION]
+                 for k in set_keys}
+        assert pools == {"rehome"}
+
+
+def test_half_consented_set_is_not_a_candidate():
+    """Consent on ONE slice of an atomic set does not make the set movable."""
+    from tpusched.config.types import MultiSliceArgs
+    prof = tpu_gang_profile(permit_wait_s=10, denied_s=1)
+    prof.plugin_args["MultiSlice"] = MultiSliceArgs(
+        set_schedule_timeout_seconds=8, denied_set_expiration_time_seconds=1)
+    with TestCluster(profile=prof) as c:
+        _pool(c, "pool-a")
+        set_keys = []
+        for idx in range(2):
+            name = f"ms-s{idx}"
+            pg = make_pod_group(name, min_member=4, tpu_slice_shape="2x2x4",
+                                tpu_accelerator="tpu-v5p",
+                                multislice_set="ms", multislice_index=idx,
+                                multislice_set_size=2)
+            if idx == 0:
+                pg.meta.annotations[ALLOW_MIGRATION_ANNOTATION] = "true"
+            c.api.create(srv.POD_GROUPS, pg)
+            ps = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+                  for i in range(4)]
+            c.create_pods(ps)
+            set_keys += [p.key for p in ps]
+        assert c.wait_for_pods_scheduled(set_keys, timeout=30)
+        _pool(c, "rehome", dims=(4, 4, 2))
+        target = _gang(c, "target", "4x4x4", 16, wait=False)
+        assert c.wait_for_pods_unscheduled([p.key for p in target], hold=0.5)
+        ctl = _controller(c)
+        time.sleep(0.6)
+        assert ctl.reconcile_once() is None
+        assert all(c.pod(k).spec.node_name for k in set_keys)

@@ -1,0 +1,13 @@
+"""The capability-tour demo must stay green — it is the first thing a new
+user runs, and it exercises gang admission, the atomic set barrier,
+what-if, set-unit defrag (advisor + controller), and HA takeover against
+the real stack in one process."""
+import subprocess
+import sys
+
+
+def test_demo_runs_green():
+    r = subprocess.run([sys.executable, "-m", "tpusched.cmd.demo"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-1000:]
+    assert "demo complete — all steps green" in r.stdout

@@ -68,12 +68,12 @@ class DefragController:
         self._last_actuation = 0.0
         self.migrations = 0            # actuations performed (tests/metrics)
         self.last_plan: Optional[dict] = None
-        # negative trial cache: (blocked, candidate) → store rv at failure.
+        # negative trial cache: (blocked, candidate-unit) → rv at failure.
         # A failed shadow trial is deterministic for unchanged state, and a
         # trial costs a full shadow scheduler for up to shadow_timeout_s —
         # without this, one permanently-blocked gang re-burns every
         # candidate every scan forever
-        self._failed_trials: Dict[Tuple[str, str], int] = {}
+        self._failed_trials: Dict[Tuple[str, Tuple[str, ...]], int] = {}
 
         self.pg_informer = self.informers.podgroups()
         self.pod_informer = self.informers.pods()
@@ -162,65 +162,92 @@ class DefragController:
         out.sort(key=lambda t: -t[1])
         return out
 
-    def _consenting_bound_gangs(self) -> List[Tuple[str, int]]:
-        """(gang full name, chip footprint) of fully-bound gangs that opted
-        in to migration, smallest footprint first (the advisor's resident
-        scan filtered by consent)."""
+    def _consenting_bound_gangs(self) -> List[Tuple[Tuple[str, ...], int]]:
+        """Migration UNITS: (gang full names, combined chip footprint),
+        smallest first. A plain gang is a unit of one; an atomic multislice
+        set is ONE unit containing every member gang — half-migrating a
+        bound set would strand the surviving slices (the same law the set
+        disruption floor enforces for preemption), so a set is a candidate
+        only when EVERY member gang is bound and consented."""
         from ..sim.defrag import _resident_gangs
         consent = {pg.key for pg in self.pg_informer.items()
                    if pg.meta.annotations.get(
                        ALLOW_MIGRATION_ANNOTATION, "") == "true"}
         if not consent:
             return []
-        return [(full, chips) for full, _members, chips
-                in _resident_gangs(self.api) if full in consent]
+        resident = {full: chips for full, _m, chips
+                    in _resident_gangs(self.api)}
+        units: Dict[Tuple[str, ...], int] = {}
+        for full, chips in resident.items():
+            pg = self.pg_informer.get(full)
+            if pg is None:
+                continue
+            if pg.spec.multislice_set and pg.spec.multislice_set_size > 1:
+                ns = pg.meta.namespace
+                members = tuple(sorted(
+                    g.key for g in self.pg_informer.items(namespace=ns)
+                    if g.spec.multislice_set == pg.spec.multislice_set))
+                if any(m not in consent or m not in resident
+                       for m in members):
+                    continue     # whole set must be bound AND consented
+                units[members] = sum(resident[m] for m in members)
+            elif full in consent:
+                units[(full,)] = chips
+        out = sorted(units.items(), key=lambda t: (t[1], t[0]))
+        return out
 
     # -- planning -------------------------------------------------------------
 
     def _plan_for(self, blocked_full: str,
-                  candidates: List[Tuple[str, int]]) -> Optional[dict]:
-        """Shadow-trial each candidate (cheapest first): remove it, wait for
-        the blocked gang's OWN pending pods to bind, re-place the migrant.
-        Returns {blocked, migrate, chips} or None."""
+                  candidates: List[Tuple[Tuple[str, ...], int]]
+                  ) -> Optional[dict]:
+        """Shadow-trial each candidate UNIT (cheapest first): remove every
+        gang in the unit, wait for the blocked gang's OWN pending pods to
+        bind, re-place the migrants (atomic sets re-admit through their own
+        barrier in the shadow, so a unit whose set cannot re-land whole is
+        rejected). Returns {blocked, migrate: [fulls...], chips} or None."""
         blocked_keys = [p.meta.key for p in self.pod_informer.by_index(
             POD_GROUP_INDEX, blocked_full)]
         profile = _make_profile(False, self.shadow_timeout_s)
         rv = self.api.current_resource_version()
-        for cand_full, cand_chips in candidates:
-            if cand_full == blocked_full:
+        for unit, unit_chips in candidates:
+            if blocked_full in unit:
                 continue
-            if self._failed_trials.get((blocked_full, cand_full)) == rv:
+            if self._failed_trials.get((blocked_full, unit)) == rv:
                 continue   # state unchanged since this trial failed
             fork = _shadow_of(self.api, None)
-            cns, cname = cand_full.split("/", 1)
-            moved_pods = [p for p in fork.list(srv.PODS, cns)
-                          if p.meta.labels.get(POD_GROUP_LABEL) == cname]
-            moved_pg = fork.try_get(srv.POD_GROUPS, cand_full)
-            for p in moved_pods:
-                fork.delete(srv.PODS, p.meta.key)
-            if moved_pg is not None:
-                fork.delete(srv.POD_GROUPS, cand_full)
+            moved = []     # (full, pg, pods) per gang in the unit
+            for cand_full in unit:
+                cns, cname = cand_full.split("/", 1)
+                pods = [p for p in fork.list(srv.PODS, cns)
+                        if p.meta.labels.get(POD_GROUP_LABEL) == cname]
+                pg = fork.try_get(srv.POD_GROUPS, cand_full)
+                for p in pods:
+                    fork.delete(srv.PODS, p.meta.key)
+                if pg is not None:
+                    fork.delete(srv.POD_GROUPS, cand_full)
+                moved.append((cand_full, pg, pods))
             sched = Scheduler(fork, default_registry(), profile)
             sched.run()
             try:
                 if not self._wait_bound(fork, blocked_keys):
-                    self._failed_trials[(blocked_full, cand_full)] = rv
+                    self._failed_trials[(blocked_full, unit)] = rv
                     continue
-                # re-place the migrant in what capacity remains
-                if moved_pg is not None:
-                    moved_pg.meta.resource_version = 0
-                    fork.create(srv.POD_GROUPS, moved_pg)
                 keys = []
-                for p in moved_pods:
-                    q = sanitize_for_resubmit(p)
-                    fork.create(srv.PODS, q)
-                    keys.append(q.meta.key)
+                for _full, pg, pods in moved:
+                    if pg is not None:
+                        pg.meta.resource_version = 0
+                        fork.create(srv.POD_GROUPS, pg)
+                    for p in pods:
+                        q = sanitize_for_resubmit(p)
+                        fork.create(srv.PODS, q)
+                        keys.append(q.meta.key)
                 if not self._wait_bound(fork, keys):
-                    # migrant would be homeless: not a plan
-                    self._failed_trials[(blocked_full, cand_full)] = rv
+                    # a migrant would be homeless: not a plan
+                    self._failed_trials[(blocked_full, unit)] = rv
                     continue
-                return {"blocked": blocked_full, "migrate": cand_full,
-                        "chips": cand_chips}
+                return {"blocked": blocked_full, "migrate": list(unit),
+                        "chips": unit_chips}
             finally:
                 sched.stop()
         return None
@@ -246,11 +273,13 @@ class DefragController:
         off backoff, so it tends to win and re-fragment the pool). The
         migrant is resubmitted even if the blocked gang misses its wait —
         losing a consenting workload is never acceptable."""
-        cand_full = plan["migrate"]
-        cns, cname = cand_full.split("/", 1)
-        moved = [p for p in self.api.list(srv.PODS, cns)
-                 if p.meta.labels.get(POD_GROUP_LABEL) == cname]
-        klog.info_s("defrag actuation: migrating gang", gang=cand_full,
+        unit = plan["migrate"]
+        moved = []
+        for cand_full in unit:
+            cns, cname = cand_full.split("/", 1)
+            moved += [p for p in self.api.list(srv.PODS, cns)
+                      if p.meta.labels.get(POD_GROUP_LABEL) == cname]
+        klog.info_s("defrag actuation: migrating unit", gangs=unit,
                     members=len(moved), toAdmit=plan["blocked"])
         resubmit = []
         for p in moved:
@@ -266,8 +295,8 @@ class DefragController:
             POD_GROUP_INDEX, plan["blocked"])]
         if not self._wait_bound(self.api, blocked_keys):
             klog.error_s(None, "blocked gang missed the freed window; "
-                         "resubmitting the migrant anyway",
-                         blocked=plan["blocked"], migrated=cand_full)
+                         "resubmitting the migrants anyway",
+                         blocked=plan["blocked"], migrated=unit)
         for q in resubmit:
             # fault-tolerant per pod: eviction already happened — one
             # failed create (a Conflict from an external recreate during
@@ -275,7 +304,9 @@ class DefragController:
             try:
                 self.api.create(srv.PODS, q)
             except Exception as e:  # noqa: BLE001
+                pg_name = q.meta.labels.get(POD_GROUP_LABEL, "")
                 klog.error_s(e, "defrag resubmit failed for pod",
-                             pod=q.meta.key, gang=cand_full)
+                             pod=q.meta.key,
+                             gang=f"{q.meta.namespace}/{pg_name}")
         self.migrations += 1
         defrag_migrations_total.inc()

@@ -115,6 +115,32 @@ def sanitize_for_resubmit(p: Pod) -> Pod:
     return q
 
 
+def _resident_units(api: APIServer) -> List[Tuple[Tuple[str, int, int], ...]]:
+    """Migration UNITS over the resident gangs, smallest combined footprint
+    first: a plain gang is a unit of one; an ATOMIC multislice set
+    (multislice_set_size > 1) is one unit containing every member gang —
+    suggesting half a set would be suggesting an outage (the surviving
+    slices strand; disruption must be all-or-nothing like admission). A
+    set whose members are not all fully bound yields no unit."""
+    resident = {full: (members, chips)
+                for full, members, chips in _resident_gangs(api)}
+    units: Dict[Tuple[str, ...], Tuple[Tuple[str, int, int], ...]] = {}
+    for full, (members, chips) in resident.items():
+        ns = full.split("/", 1)[0]
+        pg = api.try_get(srv.POD_GROUPS, full)
+        if pg is not None and pg.spec.multislice_set                 and pg.spec.multislice_set_size > 1:
+            names = tuple(sorted(
+                g.key for g in api.list(srv.POD_GROUPS, ns)
+                if g.spec.multislice_set == pg.spec.multislice_set))
+            if any(m not in resident for m in names):
+                continue
+            units[names] = tuple((m, *resident[m]) for m in names)
+        else:
+            units[(full,)] = ((full, members, chips),)
+    return sorted(units.values(),
+                  key=lambda u: (sum(g[2] for g in u), u[0][0]))
+
+
 def _resident_gangs(api: APIServer) -> List[Tuple[str, int, int]]:
     """(full name, member count, chip footprint) of every FULLY-bound gang,
     smallest footprint first. Partially-bound gangs (members still pending)
@@ -171,10 +197,13 @@ def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
             scheduler_name=profile.scheduler_name, **job_kw)
         if not target.feasible:
             return None
-        plan_moves: List[MigrationMove] = []
+        # resubmit EVERY migrated gang (largest-footprint creation order
+        # biases packing), then wait for all of them together: member
+        # gangs of an atomic multislice set barrier on EACH OTHER — a
+        # per-gang wait would deadlock on the first slice waiting for a
+        # sibling the loop had not resubmitted yet
+        keys_by_gang: List[Tuple[str, int, List[str]]] = []
         for full, n_chips, moved_pg, moved_pods in captured:
-            # resubmit the migrated gang: its PodGroup, then unbound copies
-            # of its pods — the real scheduler re-places it
             if moved_pg is not None:
                 moved_pg.meta.resource_version = 0
                 fork.create(srv.POD_GROUPS, moved_pg)
@@ -183,16 +212,20 @@ def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
                 q = sanitize_for_resubmit(p)
                 fork.create(srv.PODS, q)
                 keys.append(q.meta.key)
-            deadline = _time.monotonic() + timeout_s
-            ok = False
-            while _time.monotonic() < deadline:
-                live = [fork.peek(srv.PODS, k) for k in keys]
-                if all(x is not None and x.spec.node_name for x in live):
-                    ok = True
-                    break
-                _time.sleep(0.02)
-            if not ok:
-                return None   # target fits but this migrated gang is homeless
+            keys_by_gang.append((full, n_chips, keys))
+        all_keys = [k for _, _, ks in keys_by_gang for k in ks]
+        deadline = _time.monotonic() + timeout_s
+        ok = False
+        while _time.monotonic() < deadline:
+            live = [fork.peek(srv.PODS, k) for k in all_keys]
+            if all(x is not None and x.spec.node_name for x in live):
+                ok = True
+                break
+            _time.sleep(0.02)
+        if not ok:
+            return None   # target fits but a migrated gang is homeless
+        plan_moves: List[MigrationMove] = []
+        for full, n_chips, keys in keys_by_gang:
             placements = {}
             coords = {}
             pool = ""
@@ -237,11 +270,13 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
     tried smallest-chip-footprint first; pass ``candidates`` (gang full
     names) to restrict — e.g. to gangs a team is willing to move.
 
-    ``max_moves=1`` (default) searches single migrations only.
-    ``max_moves=2`` falls through to a bounded pair search (combined
-    footprint ascending, at most ``max_pair_trials`` shadow runs) when the
-    quota of single-move plans isn't met — the fleet regime where no one
-    migration opens a window but two do.
+    Candidates are migration UNITS: a plain gang, or an ATOMIC multislice
+    set as one unit (half a set is never suggested — the survivors would
+    strand). ``max_moves=1`` (default) searches single units only;
+    ``max_moves=2`` falls through to a bounded pair-of-units search
+    (combined footprint ascending, at most ``max_pair_trials`` shadow
+    runs) when the quota of single-unit plans isn't met — the fleet
+    regime where no one migration opens a window but two do.
 
     Returns up to ``max_suggestions`` plans, cheapest-first; empty list =
     no plan within the search bounds (the job needs more moves, preemption,
@@ -252,13 +287,16 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
         raise ValueError("max_moves must be 1 or 2")
     base = _shadow_of(source_api, state_dir)
     profile = _make_profile(False, timeout_s, config_path, scheduler_name)
-    gangs = _resident_gangs(base)
+    units = _resident_units(base)
     if candidates is not None:
         want = set(candidates)
-        unknown = want - {full for full, _, _ in gangs}
+        known = {full for full, _, _ in _resident_gangs(base)}
+        unknown = want - known
         if unknown:
             raise ValueError(f"unknown candidate gangs: {sorted(unknown)}")
-        gangs = [g for g in gangs if g[0] in want]
+        # a unit is eligible only when EVERY member gang was named: naming
+        # one slice of an atomic set does not consent the whole set
+        units = [u for u in units if all(g[0] in want for g in u)]
 
     job_kw = dict(name="defrag-target", namespace="default", slice_shape="",
                   accelerator="", chips_per_pod=1, cpu_per_pod=4,
@@ -279,23 +317,26 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
                                  "existing pod; pass job['name']")
 
     suggestions: List[MigrationSuggestion] = []
-    for g in gangs:
+    for unit in units:
         if len(suggestions) >= max_suggestions:
             return suggestions
-        result = _try_moves(base, profile, [g], job_kw, timeout_s)
+        result = _try_moves(base, profile, list(unit), job_kw, timeout_s)
         if result is not None:
             suggestions.append(MigrationSuggestion(moves=result[1],
                                                    target=result[0]))
     if max_moves < 2:
         return suggestions
-    pairs = sorted(itertools.combinations(gangs, 2),
-                   key=lambda pr: (pr[0][2] + pr[1][2], pr[0][0], pr[1][0]))
+    pairs = sorted(
+        itertools.combinations(units, 2),
+        key=lambda pr: (sum(g[2] for g in pr[0]) + sum(g[2] for g in pr[1]),
+                        pr[0][0][0], pr[1][0][0]))
     trials = 0
     for pair in pairs:
         if len(suggestions) >= max_suggestions or trials >= max_pair_trials:
             break
         trials += 1
-        result = _try_moves(base, profile, list(pair), job_kw, timeout_s)
+        result = _try_moves(base, profile, list(pair[0]) + list(pair[1]),
+                            job_kw, timeout_s)
         if result is not None:
             suggestions.append(MigrationSuggestion(moves=result[1],
                                                    target=result[0]))
