@@ -1,0 +1,88 @@
+"""Property-based permit-barrier laws (hypothesis stateful).
+
+The _WaitingPod barrier is the synchronization point every gang (and
+multislice set) admission rides: per-plugin pending entries, allow/reject
+from arbitrary threads, a deadline sweeper, and exactly-once callbacks.
+The laws pinned for ANY interleaving of allows/rejects/expiries:
+
+  L1  a pod resolves at most once, and its callback fires exactly once
+      with the SAME status wait() observers see;
+  L2  allowing every pending plugin ⇒ Success; any reject ⇒ Unschedulable
+      (first resolution wins; later verbs are no-ops);
+  L3  an expiry resolves the pod only when some plugin's deadline truly
+      passed (fake clock), and late allows/rejects cannot overwrite it;
+  L4  get_pending_plugins never grows and only shrinks by allowed names.
+"""
+import time
+
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import RuleBasedStateMachine, invariant, rule
+
+from tpusched.fwk.runtime import _WaitingPod
+from tpusched.testing import make_pod
+
+PLUGINS = ("A", "B", "C")
+
+
+class BarrierMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        # control monotonic time via a patched deadline table: timeouts
+        # below are huge so only explicit expire calls can trip them
+        self.wp = _WaitingPod(make_pod("p"), {p: 10_000.0 for p in PLUGINS})
+        self.allowed = set()
+        self.resolved_status = None     # model: first resolution
+        self.callback_fires = []
+        self.wp.add_done_callback(self.callback_fires.append)
+
+    @rule(plugin=st.sampled_from(PLUGINS))
+    def allow(self, plugin):
+        self.wp.allow(plugin)
+        if self.resolved_status is None:
+            self.allowed.add(plugin)
+            if self.allowed == set(PLUGINS):
+                self.resolved_status = "success"
+
+    @rule(plugin=st.sampled_from(PLUGINS))
+    def reject(self, plugin):
+        self.wp.reject(plugin, "nope")
+        if self.resolved_status is None:
+            self.resolved_status = "unschedulable"
+
+    @rule()
+    def expire_not_due(self):
+        # now is far before every deadline: must be a no-op
+        self.wp.expire_if_due(time.monotonic())
+
+    @rule()
+    def expire_due(self):
+        # now is past every deadline: resolves (timeout) unless already done
+        self.wp.expire_if_due(time.monotonic() + 20_000.0)
+        if self.resolved_status is None:
+            self.resolved_status = "unschedulable"
+
+    @invariant()
+    def exactly_once_and_consistent(self):
+        # L1: never more than one callback fire
+        assert len(self.callback_fires) <= 1
+        if self.resolved_status is None:
+            assert not self.callback_fires
+            # L4: pending is exactly the never-allowed set
+            assert set(self.wp.get_pending_plugins()) == \
+                set(PLUGINS) - self.allowed
+        else:
+            # L1/L2/L3: resolution matches the model, callback fired once
+            assert len(self.callback_fires) == 1
+            status = self.callback_fires[0]
+            assert self.wp.wait() is status     # wait() sees the same object
+            if self.resolved_status == "success":
+                assert status.is_success()
+            else:
+                assert status.is_unschedulable()
+
+
+BarrierMachine.TestCase.settings = settings(max_examples=80,
+                                            stateful_step_count=40,
+                                            deadline=None)
+TestPermitBarrier = BarrierMachine.TestCase
