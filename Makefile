@@ -58,6 +58,10 @@ local-image:
 	docker build -f build/scheduler/Dockerfile -t tpusched/scheduler:latest .
 	docker build -f build/controller/Dockerfile -t tpusched/controller:latest .
 
+.PHONY: demo
+demo:   ## 30s end-to-end capability tour on an emulated fleet
+	$(PY) -m tpusched.cmd.demo
+
 .PHONY: graft-check
 graft-check:
 	$(PY) __graft_entry__.py
