@@ -211,3 +211,42 @@ def test_half_consented_set_is_not_a_candidate():
         time.sleep(0.6)
         assert ctl.reconcile_once() is None
         assert all(c.pod(k).spec.node_name for k in set_keys)
+
+
+def test_cross_namespace_blocked_and_migrant():
+    """The blocked gang and the consenting migrant live in different
+    namespaces: planning, eviction, and re-homing must all be
+    namespace-correct."""
+    with _cluster() as c:
+        _pool(c, "pool-a")
+        pg = make_pod_group("small", namespace="team-a", min_member=4,
+                            tpu_slice_shape="2x2x4",
+                            tpu_accelerator="tpu-v5p")
+        pg.meta.annotations[ALLOW_MIGRATION_ANNOTATION] = "true"
+        c.api.create(srv.POD_GROUPS, pg)
+        small = [make_pod(f"small-{i}", namespace="team-a",
+                          pod_group="small", limits={TPU: 4})
+                 for i in range(4)]
+        c.create_pods(small)
+        assert c.wait_for_pods_scheduled([p.key for p in small], timeout=30)
+        _pool(c, "rehome", dims=(2, 2, 4))
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "target", namespace="team-b", min_member=16,
+            tpu_slice_shape="4x4x4", tpu_accelerator="tpu-v5p"))
+        target = [make_pod(f"target-{i}", namespace="team-b",
+                           pod_group="target", limits={TPU: 4})
+                  for i in range(16)]
+        c.create_pods(target)
+        assert c.wait_for_pods_unscheduled([p.key for p in target], hold=0.5)
+
+        ctl = _controller(c)
+        time.sleep(0.6)
+        plan = ctl.reconcile_once()
+        assert plan is not None
+        assert plan["migrate"] == ["team-a/small"]
+        assert plan["blocked"] == "team-b/target"
+        assert c.wait_for_pods_scheduled([p.key for p in target], timeout=30)
+        assert c.wait_for_pods_scheduled([p.key for p in small], timeout=30)
+        pools = {c.pod(p.key).meta.annotations[POOL_ANNOTATION]
+                 for p in small}
+        assert pools == {"rehome"}

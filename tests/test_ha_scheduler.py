@@ -373,3 +373,28 @@ def test_repeated_takeover_churn_preserves_state(tmp_path):
             assert p is not None and p.spec.node_name == node
     finally:
         final.stop()
+
+
+def test_three_replicas_exactly_one_leads(tmp_path):
+    """Three replicas campaign simultaneously: exactly one activates; the
+    others stay standby; killing the winner promotes exactly one more."""
+    state = str(tmp_path)
+    reps = [HAScheduler(state, identity=f"r{i}", lease_duration_s=1.0,
+                        renew_interval_s=0.25) for i in range(3)]
+    for r in reps:
+        r.run()
+    try:
+        assert wait_until(
+            lambda: sum(r.is_active.is_set() for r in reps) == 1, timeout=15)
+        time.sleep(1.0)     # several renew cycles: still exactly one
+        actives = [r for r in reps if r.is_active.is_set()]
+        assert len(actives) == 1
+        actives[0].crash()
+        rest = [r for r in reps if r is not actives[0]]
+        assert wait_until(
+            lambda: sum(r.is_active.is_set() for r in rest) == 1, timeout=15)
+        time.sleep(1.0)
+        assert sum(r.is_active.is_set() for r in rest) == 1
+    finally:
+        for r in reps:
+            r.crash()
