@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 TPU-evidence watcher: probe the axon tunnel every 4 min (bounded
+# subprocess — a wedged claim hangs backend init indefinitely); the moment
+# it recovers, capture on-chip evidence serially: tests_tpu tier first,
+# then the full bench. ONE chip client at a time — two clients racing for
+# the single-chip claim is what orphaned it this morning.
+LOG=/root/repo/hack/tpu-probe-r4.log
+TIER=/root/repo/hack/probes/tpu_tier_r4.log
+BENCHLOG=/root/repo/hack/probes/bench_r4_onchip.log
+cd /root/repo || exit 1
+for i in $(seq 1 200); do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 120 python -c "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
+  if [ "$out" = "tpu" ]; then
+    echo "$ts probe $i: LIVE - starting capture (critical tier, rest of tier, bench)" >> "$LOG"
+    # critical subset FIRST (the tests that have never executed on
+    # hardware + this round's additions): if the tunnel wedges mid-tier,
+    # the marginal evidence is already on disk. -u + -v: every test
+    # result line flushes to the log as it happens.
+    CRIT="moe or seq8192 or adamw or remat or vocab or serve"
+    echo "=== tests_tpu CRITICAL subset started $(date -u +%FT%TZ) ===" >> "$TIER"
+    timeout --signal=INT --kill-after=60 3600 python -u -m pytest tests_tpu/ -v -k "$CRIT" >> "$TIER" 2>&1
+    echo "critical rc=$? finished $(date -u +%FT%TZ)" >> "$TIER"
+    echo "=== tests_tpu remainder started $(date -u +%FT%TZ) ===" >> "$TIER"
+    timeout --signal=INT --kill-after=60 3600 python -u -m pytest tests_tpu/ -v -k "not ($CRIT)" >> "$TIER" 2>&1
+    echo "remainder rc=$? finished $(date -u +%FT%TZ)" >> "$TIER"
+    echo "=== bench started $(date -u +%FT%TZ) ===" >> "$BENCHLOG"
+    timeout --signal=INT --kill-after=60 5400 python -u bench.py >> "$BENCHLOG" 2>&1
+    echo "bench rc=$? finished $(date -u +%FT%TZ)" >> "$BENCHLOG"
+    echo "$(date -u +%H:%M:%S) capture complete" >> "$LOG"
+    exit 0
+  else
+    echo "$ts probe $i: wedged (timeout/non-tpu)" >> "$LOG"
+  fi
+  sleep 240
+done
+exit 1

@@ -313,3 +313,53 @@ def test_peek_is_zero_copy_and_live():
     assert second is not first          # wholesale replacement, no in-place
     assert second.meta.labels.get("x") == "1"
     assert first.meta.labels.get("x") is None   # old snapshot untouched
+
+
+def test_patch_missing_raises_not_found():
+    """Upstream: PATCH on a missing object is 404 (no upsert). The defrag
+    actuator and controllers retry-or-skip on this; a silent create here
+    would resurrect deleted pods."""
+    api = APIServer()
+    with pytest.raises(srv.NotFound):
+        api.patch(srv.PODS, "default/ghost", lambda p: None)
+
+
+def test_delete_then_recreate_same_key():
+    """Upstream: deleting a key and POSTing a new object under the same
+    name yields a NEW object: its resourceVersion is strictly newer than
+    anything the old incarnation had, and watchers see Deleted then Added
+    (never Modified). Defrag actuation (delete gang → resubmit sanitized
+    copies) and the fleet bench's create/delete steady-state depend on the
+    two incarnations never being conflated."""
+    api = APIServer()
+    events = []
+    api.add_watch(srv.PODS, lambda ev: events.append(ev))
+    first = api.create(srv.PODS, make_pod("p"))
+    api.patch(srv.PODS, "default/p",
+              lambda p: p.meta.labels.update({"gen": "1"}))
+    last_rv = api.get(srv.PODS, "default/p").meta.resource_version
+    api.delete(srv.PODS, "default/p")
+    second = api.create(srv.PODS, make_pod("p"))
+    assert second.meta.resource_version > last_rv > first.meta.resource_version
+    assert [e.type for e in events] == [srv.ADDED, srv.MODIFIED, srv.DELETED,
+                                        srv.ADDED]
+    assert "gen" not in events[-1].object.meta.labels   # new incarnation
+    assert api.get(srv.PODS, "default/p").meta.labels == {}
+
+
+def test_deleted_event_carries_final_state():
+    """Upstream: a DELETED watch event carries the object's last-stored
+    state. The scheduler cache detaches a deleted pod from the node named
+    by the EVENT object's spec.nodeName — an empty or stale object here
+    would leak phantom occupancy on the node."""
+    api = APIServer()
+    api.create(srv.NODES, make_node("n1"))
+    api.create(srv.PODS, make_pod("p"))
+    api.bind(Binding(pod_key="default/p", node_name="n1"))
+    deleted = []
+    api.add_watch(srv.PODS,
+                  lambda ev: deleted.append(ev.object)
+                  if ev.type == srv.DELETED else None)
+    api.delete(srv.PODS, "default/p")
+    assert len(deleted) == 1
+    assert deleted[0].spec.node_name == "n1"     # final (bound) state

@@ -178,6 +178,14 @@ def test_decode_bandwidth_accounting():
     assert long > b8                    # and with live context
     kv1 = b8 - b1                       # 7 extra sequences' KV at ctx 128
     assert abs((long - b8) - kv1 * (8 / 7) * 3) / (long - b8) < 0.01
+    # the embedding TABLE is gathered (batch rows), not streamed: doubling
+    # the vocab must grow bytes/step by exactly one v*d matrix (the
+    # out-projection) — charging embed+out would grow it by two
+    import dataclasses as _dc
+    cfg2v = _dc.replace(cfg, vocab=2 * cfg.vocab)
+    itemsize = 2  # bf16
+    assert (decode_bytes_per_token(cfg2v, 1, 128) - b1
+            == cfg.vocab * cfg.d_model * itemsize)
     # MoE configs must refuse rather than publish a dense-MLP number
     import pytest as _pytest
     with _pytest.raises(ValueError, match="n_experts"):

@@ -170,3 +170,51 @@ def test_hard_same_domain_allows_same_domain():
         s1 = slice_pg(c, "dom", 1, set_size=1)
         assert c.wait_for_pods_scheduled([p.key for p in s1], timeout=20)
         assert pool_of(c, s1) == "a1"
+
+
+def test_permit_fails_fast_when_set_denied_mid_cycle():
+    """A pod whose cycle was already past PreFilter when its set was denied
+    is invisible to the denial's reject sweep (it is not parked yet). Its
+    Permit must fail the cycle — releasing the reservation now — rather
+    than park at the barrier for the full set timeout with nothing left to
+    reject it."""
+    from tpusched.fwk import CycleState
+
+    with TestCluster(profile=atomic_profile(set_wait_s=60)) as c:
+        add_pool(c, "p0", "zoneA/rack0")
+        add_pool(c, "p1", "zoneA/rack1")
+        s0 = slice_pg(c, "job", 0, set_size=2)   # incomplete set: no barrier
+        assert wait_until(lambda: c.pod(s0[0].key) is not None, timeout=10)
+        ms = c.scheduler._fw.plugins["MultiSlice"]
+        ms._deny_set("default/job", "default", "job",
+                     "test: simulated denial while a cycle was in flight")
+        status, timeout_s = ms.permit(CycleState(), c.pod(s0[0].key),
+                                      "p0-000000")
+        assert not status.is_wait(), (
+            "permit parked a pod of a denied set — the reject sweep "
+            "already ran and would never resolve it")
+        assert status.is_unschedulable()
+        assert status.retry_after_s is not None
+
+
+def test_on_pod_waiting_rejects_when_denial_raced_the_park():
+    """The other half of the park-after-sweep race: the denial lands AFTER
+    permit()'s denied-check but before (or while) the framework registers
+    the pod. The post-registration hook must resolve the parked pod
+    immediately instead of leaving it at the barrier for the set
+    timeout."""
+    from tpusched.fwk.runtime import _WaitingPod
+
+    with TestCluster(profile=atomic_profile(set_wait_s=60)) as c:
+        add_pool(c, "p0", "zoneA/rack0")
+        s0 = slice_pg(c, "job", 0, set_size=2)
+        assert wait_until(lambda: c.pod(s0[0].key) is not None, timeout=10)
+        ms = c.scheduler._fw.plugins["MultiSlice"]
+        wp = _WaitingPod(c.pod(s0[0].key), {ms.NAME: 60.0})
+        # denial arrives while the pod is being parked (post permit-check)
+        ms._deny_set("default/job", "default", "job",
+                     "test: denial racing the park")
+        ms.on_pod_waiting(wp)
+        st = wp.wait()        # resolved by the hook, not the 60s deadline
+        assert st is not None and st.is_unschedulable()
+        assert "parked at the barrier" in st.message()

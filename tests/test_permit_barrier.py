@@ -134,3 +134,39 @@ def test_concurrent_allow_and_expiry_single_resolution():
         wp.wait()
         time.sleep(0.03)    # let a late sweeper expiry (if any) fire
         assert len(hits) == 1, hits
+
+
+def test_on_pod_waiting_fires_after_registration():
+    """The post-registration hook contract: a plugin that asked to Wait is
+    called back AFTER its pod is visible to iterate_over_waiting_pods, so
+    a mass-rejection that raced the park can be re-checked (and the pod
+    resolved) instead of stranding until the permit deadline."""
+    seen = []
+
+    class HookedPermit(FakePermit):
+        NAME = "HookedPermit"
+
+        def on_pod_waiting(self, waiting_pod):
+            # the pod must already be registered: reject() from here must
+            # resolve the real barrier entry, not a pre-registration ghost
+            parked = []
+            fw.iterate_over_waiting_pods(
+                lambda wp: parked.append(wp.pod.meta.uid))
+            seen.append((waiting_pod.pod.meta.name,
+                         waiting_pod.pod.meta.uid in parked))
+            waiting_pod.reject(self.NAME, "re-checked and denied")
+
+    from tpusched.plugins import default_registry
+    registry = default_registry()
+    registry.register(HookedPermit.NAME, HookedPermit.new)
+    profile = PluginProfile(permit=[HookedPermit.NAME],
+                            bind=["DefaultBinder"])
+    from tpusched.testing import new_test_framework
+    fw, handle, api = new_test_framework(profile, registry=registry)
+    pod = make_pod("racer")
+    st = fw.run_permit_plugins(CycleState(), pod, "n1")
+    assert st.is_wait()                      # the cycle still parked it...
+    assert seen == [("racer", True)]         # ...hook ran post-registration
+    got = fw.wait_on_permit(pod)             # ...but it is already resolved
+    assert got.is_unschedulable()
+    assert "re-checked and denied" in got.message()

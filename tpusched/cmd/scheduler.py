@@ -132,17 +132,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "--state-dir (the lease arbitrates the WAL)")
                 return 1
             import uuid as _uuid
-            from ..sched.ha import FileLease
+            from ..sched import ha
             identity = f"scheduler-{_uuid.uuid4().hex[:8]}"
-            le = (FileLease(args.state_dir), identity,
+            le = (ha.FileLease(args.state_dir), identity,
                   le_cfg.lease_duration_seconds,
                   le_cfg.renew_interval_seconds)
             lease, ident, dur, _renew = le
             klog.info_s("campaigning for scheduler lease",
                         identity=ident, stateDir=args.state_dir)
-            while not lease.acquire_or_renew(ident, dur):
-                if stop.wait(max(0.05, dur / 5)):
-                    return 0
+            if not ha.campaign(lease, ident, dur, stop):
+                return 0   # SIGTERM while campaigning
             klog.info_s("started leading", identity=ident)
 
     api = APIServer()
@@ -216,21 +215,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         klog.info_s("scheduler running",
                     schedulerName=s.profile.scheduler_name)
     try:
-        while not stop.is_set():
-            if le is not None:
-                lease, ident, dur, renew = le
-                stop.wait(renew)
-                if stop.is_set():
-                    break
-                if not lease.acquire_or_renew(ident, dur):
-                    # exit-on-lost-lease: the new active's WAL rotation has
-                    # fenced our journal; stop scheduling and let the
-                    # supervisor restart us as a standby
-                    klog.error_s(None, "scheduler lease lost; exiting",
-                                 identity=ident)
-                    lost_lease = True
-                    break
-            else:
+        if le is not None:
+            from ..sched import ha
+            lease, ident, dur, renew = le
+            if not ha.hold(lease, ident, dur, renew, stop):
+                # exit-on-lost-lease: the new active's WAL rotation has
+                # fenced our journal; stop scheduling and let the
+                # supervisor restart us as a standby
+                klog.error_s(None, "scheduler lease lost; exiting",
+                             identity=ident)
+                lost_lease = True
+        else:
+            while not stop.is_set():
                 stop.wait(1.0)
     finally:
         for s in schedulers:

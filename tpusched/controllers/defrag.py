@@ -164,36 +164,23 @@ class DefragController:
 
     def _consenting_bound_gangs(self) -> List[Tuple[Tuple[str, ...], int]]:
         """Migration UNITS: (gang full names, combined chip footprint),
-        smallest first. A plain gang is a unit of one; an atomic multislice
-        set is ONE unit containing every member gang — half-migrating a
-        bound set would strand the surviving slices (the same law the set
-        disruption floor enforces for preemption), so a set is a candidate
-        only when EVERY member gang is bound and consented."""
-        from ..sim.defrag import _resident_gangs
+        smallest first. The unit grouping — a plain gang is a unit of one,
+        an atomic multislice set is ONE unit or none (half-migrating a
+        bound set would strand the surviving slices, the same law the set
+        disruption floor enforces for preemption) — is the advisor's
+        ``_resident_units``; this controller only adds the consent filter,
+        so the two can never drift on what counts as migratable."""
+        from ..sim.defrag import _resident_units
         consent = {pg.key for pg in self.pg_informer.items()
                    if pg.meta.annotations.get(
                        ALLOW_MIGRATION_ANNOTATION, "") == "true"}
         if not consent:
             return []
-        resident = {full: chips for full, _m, chips
-                    in _resident_gangs(self.api)}
-        units: Dict[Tuple[str, ...], int] = {}
-        for full, chips in resident.items():
-            pg = self.pg_informer.get(full)
-            if pg is None:
-                continue
-            if pg.spec.multislice_set and pg.spec.multislice_set_size > 1:
-                ns = pg.meta.namespace
-                members = tuple(sorted(
-                    g.key for g in self.pg_informer.items(namespace=ns)
-                    if g.spec.multislice_set == pg.spec.multislice_set))
-                if any(m not in consent or m not in resident
-                       for m in members):
-                    continue     # whole set must be bound AND consented
-                units[members] = sum(resident[m] for m in members)
-            elif full in consent:
-                units[(full,)] = chips
-        out = sorted(units.items(), key=lambda t: (t[1], t[0]))
+        out = []
+        for unit in _resident_units(self.api):
+            names = tuple(g[0] for g in unit)
+            if all(n in consent for n in names):
+                out.append((names, sum(g[2] for g in unit)))
         return out
 
     # -- planning -------------------------------------------------------------

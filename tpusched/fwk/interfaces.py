@@ -143,6 +143,15 @@ class PermitPlugin(Plugin):
         waitingPods until Allow/Reject/timeout."""
         raise NotImplementedError
 
+    def on_pod_waiting(self, waiting_pod) -> None:
+        """Called once, without framework locks held, right AFTER a pod this
+        plugin asked to Wait was registered in the waitingPods map. A mass
+        rejection that ran between permit() returning Wait and the
+        registration iterates a map the pod was not yet in — this hook is
+        where a plugin re-checks such a condition and resolves the pod
+        (``waiting_pod.reject`` is idempotent) instead of stranding it at
+        the barrier until its timeout. Default: nothing."""
+
 
 class PreBindPlugin(Plugin):
     def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:

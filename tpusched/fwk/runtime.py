@@ -549,6 +549,23 @@ class Framework:
                                       or d < self._permit_horizon):
                     self._permit_horizon = d
                     self._waiting_cv.notify_all()
+            # post-registration hooks, OUTSIDE the waiting lock (a hook's
+            # own serialization may be held by a thread that is sweeping
+            # the waiting map — calling under the lock would invert the
+            # order and deadlock): each wait-requesting plugin gets one
+            # chance to re-check conditions a sweep could have changed
+            # while this pod was between permit() and registration.
+            # Guarded per plugin: the pod is already parked (committed) —
+            # a raising hook must degrade to "hook never ran" (the barrier
+            # timeout still bounds the pod), not abort a cycle whose
+            # waiting-map entry would then leak unresolved forever.
+            for p in self.permit_plugins:
+                if p.name() in plugin_timeouts:
+                    try:
+                        p.on_pod_waiting(wp)
+                    except Exception as e:  # noqa: BLE001
+                        klog.error_s(e, "on_pod_waiting hook failed",
+                                     plugin=p.name(), pod=pod.key)
             return Status.wait()
         return status_code
 

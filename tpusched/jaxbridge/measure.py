@@ -80,9 +80,13 @@ def device_peak_hbm_gbps(device=None) -> Optional[float]:
 def decode_bytes_per_token(cfg: ModelConfig, batch: int,
                            mean_ctx: int) -> int:
     """HBM bytes one decode STEP must stream (the bandwidth roofline's
-    numerator): the full parameter set once per step (amortized over the
+    numerator): every MATMUL weight once per step (amortized over the
     whole batch — that is batching's entire win) plus each sequence's live
     KV prefix (batch × mean_ctx × layers × 2 × kv_heads × head_dim).
+    The embedding TABLE is not matmul'd at decode — ``embed[token]`` is a
+    gather that touches ``batch`` rows, not v×d bytes — so only the
+    out-projection charges the full vocab matrix; counting the table too
+    would overstate utilization ~20% on a 155M-class model.
     Weight streaming dominates at small batch; KV at long context."""
     if cfg.n_experts:
         # the MoE decode path streams top-k-gathered expert stacks; until a
@@ -94,9 +98,9 @@ def decode_bytes_per_token(cfg: ModelConfig, batch: int,
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
     d_kv = (d // cfg.n_heads) * cfg.kv_heads
     per_layer = d * d + d * d_kv * 2 + d * d + 3 * d * f  # wq wk wv wo mlp
-    n_params = v * d * 2 + cfg.n_layers * per_layer       # embed + out
+    streamed = v * d + cfg.n_layers * per_layer + batch * d  # out + embed rows
     kv = batch * mean_ctx * cfg.n_layers * 2 * d_kv
-    return (n_params + kv) * itemsize
+    return (streamed + kv) * itemsize
 
 
 def decode_bandwidth_utilization(cfg: ModelConfig, batch: int,

@@ -366,10 +366,46 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 f"multislice set {set_key} denied as its last quorum "
                 f"formed").with_retry_after(
                     self._denied_sets.remaining(set_key) + 0.05), 0.0
-        klog.V(3).info_s("pod waiting for its multislice set", pod=pod.key,
-                         set=pg.spec.multislice_set,
-                         setSize=pg.spec.multislice_set_size)
-        return Status.wait(), float(self.args.set_schedule_timeout_seconds)
+        set_key = self._set_key(pod.namespace, pg.spec.multislice_set)
+        with self._set_sweep_lock:
+            if set_key in self._denied_sets:
+                # the set was denied after this pod's pre_filter (its cycle
+                # was in Score/Reserve when the reject sweep ran, so the
+                # sweep could not see it). WAITing would strand this pod's
+                # reservation for the full set timeout. Fail the cycle now,
+                # same as the complete-and-denied branch above. (Cheap early
+                # exit; a denial landing after this check is caught by
+                # on_pod_waiting below — between them every ordering is
+                # covered.)
+                return Status.unschedulable(
+                    f"multislice set {set_key} denied while this pod's "
+                    f"cycle was in flight").with_retry_after(
+                        self._denied_sets.remaining(set_key) + 0.05), 0.0
+            klog.V(3).info_s("pod waiting for its multislice set",
+                             pod=pod.key, set=pg.spec.multislice_set,
+                             setSize=pg.spec.multislice_set_size)
+            return Status.wait(), float(self.args.set_schedule_timeout_seconds)
+
+    def on_pod_waiting(self, waiting_pod) -> None:
+        """Closes the park-after-sweep race: permit() returned Wait, the
+        framework registered the pod, and only now do we learn whether a
+        denial slipped into that window. The denial flag is written and
+        read under _set_sweep_lock, so exactly one of {the deny sweep saw
+        the registered pod, this hook sees the denial} holds — either way
+        the pod resolves instead of stranding its reservation for the set
+        timeout."""
+        pg = self._pod_set_pg(waiting_pod.pod)
+        if pg is None or not self._barrier_enabled(pg):
+            return
+        set_key = self._set_key(waiting_pod.pod.namespace,
+                                pg.spec.multislice_set)
+        with self._set_sweep_lock:
+            if set_key not in self._denied_sets:
+                return
+        waiting_pod.reject(
+            self.NAME,
+            f"multislice set {set_key} denied while this pod was being "
+            f"parked at the barrier")
 
     def _set_complete(self, pod: Pod, pg: PodGroup) -> bool:
         """Every member gang of the set has quorum. The in-flight pod is not

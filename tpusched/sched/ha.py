@@ -116,6 +116,37 @@ class FileLease:
         return str(cur.get("holder", ""))
 
 
+def campaign(lease: FileLease, holder: str, duration_s: float,
+             stop: threading.Event,
+             poll_s: Optional[float] = None) -> bool:
+    """Block until ``holder`` acquires the lease or ``stop`` is set.
+    Returns True iff leading. THE campaign policy — both the HAScheduler
+    replica and the scheduler binary call this, so the poll cadence
+    (well inside the lease duration, upstream retryPeriod ~ duration/7.5)
+    has exactly one definition."""
+    poll = poll_s if poll_s is not None else max(0.02, duration_s / 5)
+    while not stop.is_set():
+        if lease.acquire_or_renew(holder, duration_s):
+            return True
+        stop.wait(poll)
+    return False
+
+
+def hold(lease: FileLease, holder: str, duration_s: float,
+         renew_interval_s: float, stop: threading.Event) -> bool:
+    """Renew until ``stop`` is set or the lease is lost. Renew-then-sleep:
+    the first check runs immediately, so a lease that expired during a
+    slow activation (WAL replay) is caught before a full renew interval
+    of split-brain scheduling. Returns True on clean stop, False on a
+    lost lease (caller must stop doing work NOW — its writes are fenced
+    by the new active's WAL rotation)."""
+    while not stop.is_set():
+        if not lease.acquire_or_renew(holder, duration_s):
+            return False
+        stop.wait(renew_interval_s)
+    return True
+
+
 class HAScheduler:
     """One scheduler replica: campaigns, and while leading runs the full
     stack (recovered APIServer + journal + Scheduler per profile)."""
@@ -153,35 +184,20 @@ class HAScheduler:
         self._thread.start()
 
     def _run(self) -> None:
-        # campaign: poll well inside the lease duration so an expiry is
-        # noticed promptly (upstream retryPeriod ~ duration/7.5)
-        poll = max(0.02, min(self.renew_interval_s,
-                             self.lease_duration_s / 5))
-        while not self._stop.is_set():
-            if self.lease.acquire_or_renew(self.identity,
-                                           self.lease_duration_s):
-                break
-            self._stop.wait(poll)
-        if self._stop.is_set():
+        if not campaign(self.lease, self.identity, self.lease_duration_s,
+                        self._stop,
+                        poll_s=max(0.02, min(self.renew_interval_s,
+                                             self.lease_duration_s / 5))):
             return
         klog.info_s("scheduler replica started leading",
                     identity=self.identity, stateDir=self.state_dir)
         self._activate()
         try:
-            # renew-then-sleep (not sleep-then-renew): the first check runs
-            # right after activation, so a lease that expired during a slow
-            # WAL replay is caught before a full renew interval of
-            # split-brain scheduling
-            while not self._stop.is_set():
-                if not self.lease.acquire_or_renew(self.identity,
-                                                   self.lease_duration_s):
-                    # exit-on-lost-lease: our writes are already fenced off
-                    # by the new active's WAL rotation; stop doing work NOW
-                    klog.error_s(None, "scheduler lease lost; demoting",
-                                 identity=self.identity)
-                    self.demoted.set()
-                    break
-                self._stop.wait(self.renew_interval_s)
+            if not hold(self.lease, self.identity, self.lease_duration_s,
+                        self.renew_interval_s, self._stop):
+                klog.error_s(None, "scheduler lease lost; demoting",
+                             identity=self.identity)
+                self.demoted.set()
         finally:
             if not self._crashed.is_set():
                 self._deactivate()
