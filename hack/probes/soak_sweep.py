@@ -1,0 +1,24 @@
+"""Extended soak sweep (ad hoc, not CI): run the randomized soak at many
+fresh set-enabled seeds to shake rare interleavings (e.g. the permit-hook
+path). Each seed is a full soak round with invariant checks at quiesce.
+Usage: python hack/probes/soak_sweep.py <lo> <hi>
+"""
+import sys
+
+sys.path.insert(0, "tests")
+sys.path.insert(0, ".")
+from conftest import *  # noqa: F401,F403 — pins JAX to CPU like the suite
+import test_soak_random as soak
+
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+failed = []
+for seed in range(lo, hi):
+    for with_sets in (True,):
+        try:
+            soak.test_randomized_soak_invariants(seed, with_sets)
+            print(f"seed {seed} sets={with_sets}: ok", flush=True)
+        except Exception as e:  # noqa: BLE001
+            failed.append(seed)
+            print(f"seed {seed} sets={with_sets}: FAILED {e}", flush=True)
+print("failed seeds:", failed)
+sys.exit(1 if failed else 0)
