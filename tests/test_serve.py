@@ -154,3 +154,79 @@ def test_prompt_buckets_pick_smallest_fit(model):
         solo = np.asarray(generate(params, req.prompt[None, :], cfg,
                                    steps=req.max_new_tokens - 1))[0]
         np.testing.assert_array_equal(c.tokens, solo)
+
+
+@pytest.mark.parametrize("chunk", [4, 7, 16])
+def test_chunked_prefill_matches_solo_generation(model, chunk):
+    """chunk_prefill streams the prompt in through the decode-shaped chunk
+    program instead of one monolithic insert; the result contract is
+    unchanged — every completion equals generate() run alone. Chunk sizes
+    straddle the prompt lengths: single-chunk, ragged-final-chunk, and
+    exact-multiple cases all occur across the draw."""
+    cfg, params = model
+    rng = np.random.default_rng(11)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 17, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 9)))
+            for i in range(8)]
+    eng = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=24,
+                      chunk_prefill=chunk)
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    assert sorted(c.rid for c in done) == list(range(8))
+    for c in done:
+        req = next(r for r in reqs if r.rid == c.rid)
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_chunked_prefill_interleaves_with_resident_decode(model):
+    """The point of chunking: a resident sequence keeps producing tokens
+    on every tick WHILE a long prompt streams in — a monolithic prefill
+    would stall it for the whole insert."""
+    cfg, params = model
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=32,
+                      chunk_prefill=4)
+    rng = np.random.default_rng(3)
+    resident = Request(rid=0,
+                       prompt=rng.integers(0, cfg.vocab, 4, dtype=np.int32),
+                       max_new_tokens=40)
+    eng.submit(resident)
+    for _ in range(4):          # resident admitted and decoding
+        eng.tick()
+    assert eng.req[0] is not None and eng.prefill_off[0] is None
+    long_req = Request(rid=1,
+                       prompt=rng.integers(0, cfg.vocab, 32, dtype=np.int32),
+                       max_new_tokens=2)
+    eng.submit(long_req)
+    before = len(eng.generated[0])
+    eng.tick()                  # admits the long prompt + first chunk
+    prefill_ticks = 1
+    while any(off is not None for off in eng.prefill_off):
+        eng.tick()
+        prefill_ticks += 1
+    assert prefill_ticks >= 32 // 4 - 1       # genuinely streamed in chunks
+    # the resident decoded on EVERY prefill tick — zero head-of-line stall
+    assert len(eng.generated[0]) - before >= prefill_ticks
+    done = eng.run_until_drained()
+    for c in done:
+        req = resident if c.rid == 0 else long_req
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_chunk_prefill_rejects_arena_overrun(model):
+    """A final chunk whose full-extent write would cross max_seq is a
+    construction-time error: dynamic_update_slice CLAMPS the start index,
+    which would silently overwrite earlier prompt rows with K/V encoded
+    for later positions — corruption, never an exception, so the engine
+    must refuse the geometry up front."""
+    cfg, params = model
+    with pytest.raises(ValueError, match="chunk-aligned"):
+        ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=60,
+                    chunk_prefill=48)   # ceil(60/48)*48 = 96 > 64
+    # the same chunk size with room to spare is fine
+    ServeEngine(params, cfg, slots=2, max_seq=128, prompt_bucket=60,
+                chunk_prefill=48)

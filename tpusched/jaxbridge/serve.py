@@ -20,7 +20,11 @@ GPU-side dynamic batcher):
   prompt K/V are computed with the configured attention (flash for long
   prompts) on a rank-1 batch and written into the slot's rows with
   ``dynamic_update_slice`` — resident slots' caches are untouched, so
-  admission never perturbs in-flight sequences.
+  admission never perturbs in-flight sequences. With ``chunk_prefill=C``
+  the insert is streamed C positions per tick through a decode-shaped
+  chunk program (one compile for every offset), so a long prompt costs
+  resident sequences at most one chunk of head-of-line latency per tick
+  instead of a whole-prompt stall.
 - **Pad pollution is provably harmless**: pad keys land at positions ≥ the
   prompt's true length; the causal mask (key_pos ≤ query_pos) hides them
   until the decode cursor reaches those positions — and the cursor
@@ -46,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .decode import (KVCache, decode_step, init_kv_cache,
+from .decode import (KVCache, _cached_attention, decode_step, init_kv_cache,
                      sample_token)
 from .workload import (ModelConfig, Params, _finish_block, _qkv,
                        _resolve_attn_fn, _rmsnorm, cast_params_for_compute,
@@ -100,6 +104,45 @@ def _build_prefill_slot(cfg: ModelConfig, prompt_bucket: int):
     return jax.jit(run, donate_argnums=(1,))
 
 
+def _build_prefill_chunk(cfg: ModelConfig, chunk: int):
+    """jitted (params, cache, chunk_tokens (chunk,), slot, off, last_row) →
+    (cache', next_logits): advance one slot's prefill by ``chunk`` prompt
+    positions starting at absolute offset ``off``.
+
+    This is the decode step's shape family, not the bucket-prefill's: the
+    chunk's K/V are written into the slot's arena rows [off, off+chunk) and
+    its queries attend the slot's WHOLE row-space through the same
+    position-masked ``_cached_attention`` the decode tick uses — earlier
+    chunks' rows are live keys, later rows are masked garbage. Offset and
+    slot are traced scalars, so ONE compiled program serves every chunk of
+    every prompt length (a per-offset specialization would compile
+    bucket/chunk programs for zero win — the mask already encodes the
+    offset). ``next_logits`` is row ``last_row`` of the chunk's logits —
+    meaningful only on a prompt's final chunk (true_len-1-off), where it
+    seeds the first sampled token."""
+    def run(params: Params, cache: KVCache, chunk_tokens: jax.Array,
+            slot: jax.Array, off: jax.Array, last_row: jax.Array):
+        params = cast_params_for_compute(params, cfg)
+        x = params["embed"][chunk_tokens][None, :, :]    # (1, chunk, d)
+        n_rep = cfg.n_heads // cfg.kv_heads
+        new_cache: KVCache = []
+        for layer, c in zip(params["layers"], cache):
+            h = _rmsnorm(x, layer["ln_attn"])
+            q, k, v = _qkv(h, layer, cfg, pos_offset=off)
+            ck = jax.lax.dynamic_update_slice(c["k"], k, (slot, off, 0, 0))
+            cv = jax.lax.dynamic_update_slice(c["v"], v, (slot, off, 0, 0))
+            ks = jax.lax.dynamic_slice_in_dim(ck, slot, 1, axis=0)
+            vs = jax.lax.dynamic_slice_in_dim(cv, slot, 1, axis=0)
+            o = _cached_attention(q, ks, vs, off, n_rep)
+            x, _ = _finish_block(x, layer, o, cfg)
+            new_cache.append({"k": ck, "v": cv})
+        x = _rmsnorm(x, params["ln_f"])
+        logits = x[0] @ params["out"]                    # (chunk, vocab)
+        return new_cache, logits[jnp.clip(last_row, 0, chunk - 1)]
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
 def _build_decode_tick(cfg: ModelConfig):
     """jitted (params, cache, tokens (slots,), pos (slots,)) →
     (cache', logits (slots, vocab)): one lock-step decode over the arena —
@@ -127,7 +170,8 @@ class ServeEngine:
                  prompt_bucket: "int | Tuple[int, ...]" = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 chunk_prefill: Optional[int] = None):
         # one or several prompt buckets (ascending): each admission pads to
         # the SMALLEST bucket that fits, so short prompts stop paying the
         # longest prompt's prefill FLOPs. One compiled prefill per bucket,
@@ -179,10 +223,33 @@ class ServeEngine:
                                for _ in range(cfg.n_layers)])()
         self._prefill_by_bucket: Dict[int, Callable] = {}
         self._tick = _build_decode_tick(cfg)
+        # chunked prefill (opt-in): admission writes the prompt into the
+        # slot one fixed-size chunk per engine tick instead of all at
+        # once, so resident sequences keep decoding while a long prompt
+        # streams in — the head-of-line latency a monolithic prefill
+        # inflicts on every active slot is bounded by one chunk's compute.
+        if chunk_prefill is not None:
+            if chunk_prefill < 1:
+                raise ValueError("chunk_prefill must be >= 1")
+            # every chunk writes a full chunk_prefill-row extent; the final
+            # chunk of the longest admissible prompt must still fit the
+            # arena, or dynamic_update_slice CLAMPS the start index and
+            # silently overwrites earlier prompt rows with K/V encoded for
+            # later positions — corruption, not an error
+            worst = -(-buckets[-1] // chunk_prefill) * chunk_prefill
+            if worst > max_seq:
+                raise ValueError(
+                    f"chunk_prefill={chunk_prefill}: a {buckets[-1]}-token "
+                    f"prompt's chunk-aligned writes span {worst} rows > "
+                    f"max_seq {max_seq}")
+            self._chunk_fn = _build_prefill_chunk(cfg, chunk_prefill)
+        self.chunk_prefill = chunk_prefill
         # host-side slot state (numpy: the scheduler of this tiny world)
         self.pos = np.zeros(slots, dtype=np.int32)       # next write position
         self.next_tok = np.zeros(slots, dtype=np.int32)  # last sampled token
         self.req: List[Optional[Request]] = [None] * slots
+        # per-slot prompt offset while chunk-prefilling; None = not prefilling
+        self.prefill_off: List[Optional[int]] = [None] * slots
         self.generated: List[List[int]] = [[] for _ in range(slots)]
         self.admitted_at = np.zeros(slots, dtype=np.int64)
         self.queue: List[Request] = []
@@ -208,16 +275,27 @@ class ServeEngine:
         path) and reset the metrics counters — measurement must time
         decode work, not XLA compilation. The jit caches live on THIS
         engine's closures, so a different engine cannot warm them."""
-        for i, bucket in enumerate(self.prompt_buckets):
-            # a FULL-length prompt selects exactly this bucket (a short one
-            # would fall into the smallest bucket and warm only that); the
-            # first warmup generates 2 tokens so the DECODE tick compiles
-            # too (a 1-token request finishes inside admission)
-            self.submit(Request(rid=-1,
-                                prompt=np.zeros(bucket, dtype=np.int32),
-                                max_new_tokens=min(2, self.max_seq - bucket)
-                                if i == 0 else 1))
+        if self.chunk_prefill is not None:
+            # one full-bucket request compiles BOTH programs: the chunk
+            # prefill is offset-dynamic (a single compile serves every
+            # bucket and chunk index), and 2 generated tokens force the
+            # decode tick through XLA too
+            self.submit(Request(
+                rid=-1, prompt=np.zeros(self.prompt_bucket, dtype=np.int32),
+                max_new_tokens=min(2, self.max_seq - self.prompt_bucket)))
             self.run_until_drained()
+        else:
+            for i, bucket in enumerate(self.prompt_buckets):
+                # a FULL-length prompt selects exactly this bucket (a short
+                # one would fall into the smallest bucket and warm only
+                # that); the first warmup generates 2 tokens so the DECODE
+                # tick compiles too (a 1-token request finishes inside
+                # admission)
+                self.submit(Request(rid=-1,
+                                    prompt=np.zeros(bucket, dtype=np.int32),
+                                    max_new_tokens=min(2, self.max_seq - bucket)
+                                    if i == 0 else 1))
+                self.run_until_drained()
         self.completions.clear()
         self.tick_count = 0
         self.decode_tokens = 0
@@ -229,6 +307,19 @@ class ServeEngine:
             if self.req[slot] is not None or not self.queue:
                 continue
             req = self.queue.pop(0)
+            if self.chunk_prefill is not None:
+                # chunked admission: claim the slot, stream the prompt in
+                # from tick(); no device work here. Park the decode cursor
+                # at true_len: the fused decode tick still runs this slot
+                # while it prefills, and its garbage K/V write must land on
+                # the ONE row every chunk's causal mask hides (key_pos ==
+                # true_len > any prompt query) and that the first real
+                # decode step overwrites before attending.
+                self.req[slot] = req
+                self.prefill_off[slot] = 0
+                self.pos[slot] = len(req.prompt)
+                self.admitted_at[slot] = self.tick_count
+                continue
             true_len = len(req.prompt)
             bucket = next(b for b in self.prompt_buckets if b >= true_len)
             prefill = self._prefill_by_bucket.get(bucket)
@@ -246,6 +337,35 @@ class ServeEngine:
             self.next_tok[slot] = tok
             self.generated[slot] = [int(tok)]
             self.admitted_at[slot] = self.tick_count
+            self._maybe_finish(slot)
+
+    def _advance_prefills(self) -> None:
+        """One chunk of device work per PREFILLING slot per tick. The final
+        chunk's last-real-row logits seed the first sampled token and flip
+        the slot to decoding."""
+        C = self.chunk_prefill
+        for slot in range(self.slots):
+            off = self.prefill_off[slot]
+            if off is None:
+                continue
+            req = self.req[slot]
+            true_len = len(req.prompt)
+            chunk = np.zeros(C, dtype=np.int32)
+            n = min(C, true_len - off)
+            chunk[:n] = req.prompt[off:off + n]
+            self.cache, next_logits = self._chunk_fn(
+                self.params, self.cache, jnp.asarray(chunk),
+                jnp.int32(slot), jnp.int32(off),
+                jnp.int32(true_len - 1 - off))
+            off += n
+            if off < true_len:
+                self.prefill_off[slot] = off
+                continue
+            self.prefill_off[slot] = None          # prompt fully resident
+            tok = self._sample(next_logits[None, :])[0]
+            self.pos[slot] = true_len
+            self.next_tok[slot] = tok
+            self.generated[slot] = [int(tok)]
             self._maybe_finish(slot)
 
     def _sample(self, logits: jax.Array) -> np.ndarray:
@@ -272,10 +392,14 @@ class ServeEngine:
 
     def tick(self) -> int:
         """One engine iteration: admit waiting requests into free slots,
-        then one fused decode step over the arena. Returns the number of
-        ACTIVE slots this tick (0 = fully idle)."""
+        advance chunked prefills by one chunk each, then one fused decode
+        step over the arena. Returns the number of ACTIVE (decoding) slots
+        this tick (0 = fully idle)."""
         self._admit()
-        active = [s for s in range(self.slots) if self.req[s] is not None]
+        if self.chunk_prefill is not None:
+            self._advance_prefills()
+        active = [s for s in range(self.slots)
+                  if self.req[s] is not None and self.prefill_off[s] is None]
         if not active:
             self.tick_count += 1
             return 0
@@ -292,11 +416,17 @@ class ServeEngine:
             self._maybe_finish(s)
         return len(active)
 
-    def run_until_drained(self, max_ticks: int = 100_000) -> List[Completion]:
+    def run_until_drained(self, max_ticks: int = 100_000,
+                          on_tick: Optional[Callable[[], None]] = None
+                          ) -> List[Completion]:
         """Tick until every submitted request completed (or the safety cap
-        trips). Returns completions in finish order."""
+        trips). Returns completions in finish order. ``on_tick`` runs after
+        every tick — the instrumentation hook (measure_serving times tick
+        gaps through it), so there is exactly one drain loop."""
         while (self.queue or any(r is not None for r in self.req)):
             self.tick()
+            if on_tick is not None:
+                on_tick()
             if self.tick_count >= max_ticks:
                 raise RuntimeError("serve engine did not drain (cap hit)")
         return self.completions
@@ -305,6 +435,7 @@ class ServeEngine:
 def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
                     *, slots: int = 8, max_seq: int = 1024,
                     prompt_bucket: "int | Tuple[int, ...]" = 128,
+                    chunk_prefill: Optional[int] = None,
                     time_fn: Callable[[], float] = None) -> Dict[str, float]:
     """Throughput of the continuous engine vs the static-batch floor on the
     SAME request set. Static batching pads every generation to the
@@ -314,13 +445,26 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     import time as _time
     time_fn = time_fn or _time.perf_counter
     eng = ServeEngine(params, cfg, slots=slots, max_seq=max_seq,
-                      prompt_bucket=prompt_bucket)
+                      prompt_bucket=prompt_bucket,
+                      chunk_prefill=chunk_prefill)
     eng.warmup()              # compile outside the clock
     for r in requests:
         eng.submit(r)
+    # time every tick: every slot's decode stalls for a whole tick, so the
+    # max inter-tick gap IS the head-of-line latency an admission inflicts
+    # on residents (monolithic prefill spikes it by a full prompt's
+    # compute; chunked bounds it near one chunk + decode)
     t0 = time_fn()
-    completions = eng.run_until_drained()
+    state = {"last": t0, "max_gap": 0.0}
+
+    def stamp():
+        now = time_fn()
+        state["max_gap"] = max(state["max_gap"], now - state["last"])
+        state["last"] = now
+
+    completions = eng.run_until_drained(on_tick=stamp)
     elapsed = time_fn() - t0
+    max_gap = state["max_gap"]
     total_tokens = sum(len(c.tokens) for c in completions)
     decode_ticks = max(1, eng.tick_count)
     return {
@@ -329,4 +473,5 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
         "tokens_per_s": total_tokens / max(elapsed, 1e-9),
         "occupancy": eng.decode_tokens / (decode_ticks * slots),
         "ticks": float(decode_ticks),
+        "max_tick_gap_s": max_gap,
     }
