@@ -230,3 +230,27 @@ def test_chunk_prefill_rejects_arena_overrun(model):
     # the same chunk size with room to spare is fine
     ServeEngine(params, cfg, slots=2, max_seq=128, prompt_bucket=60,
                 chunk_prefill=48)
+
+
+def test_chunked_prefill_on_tp_mesh_matches_solo(model):
+    """chunk_prefill composed with tensor-parallel serving: the chunk
+    program's dynamic_update_slice/dynamic_slice on the kv-sharded arena
+    must preserve shardings (GSPMD) and greedy parity simultaneously."""
+    from jax.sharding import Mesh
+    cfg, params = model
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    rng = np.random.default_rng(17)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 6, 16, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 6)))
+            for i in range(4)]
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      mesh=mesh, chunk_prefill=6)
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    assert sorted(c.rid for c in done) == list(range(4))
+    for c in done:
+        req = next(r for r in reqs if r.rid == c.rid)
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)
