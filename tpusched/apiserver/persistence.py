@@ -18,7 +18,11 @@ plane is hermetic, so this module supplies the etcd half of that contract:
 - a **snapshot** (``snapshot.json``) written at compaction time; replay =
   snapshot + WAL suffix, exactly etcd's snapshot+raft-log recovery;
 - a reflective dataclass codec (all API objects are plain nested dataclasses
-  with scalar leaves, so encoding is total and lossless).
+  with scalar leaves, so encoding is total and lossless). Replay is
+  schema-drift tolerant by construction — unknown record kinds are skipped,
+  unknown object fields dropped, absent fields take dataclass defaults — so
+  a --state-dir written by an adjacent version replays cleanly (pinned by
+  tests/test_persistence.py::test_replay_tolerates_schema_drift).
 
 Leases are deliberately NOT persisted: leader-election state must die with
 the process (a restarted process re-campaigns; holding a stale lease across
