@@ -254,3 +254,126 @@ def test_chunked_prefill_on_tp_mesh_matches_solo(model):
         solo = np.asarray(generate(params, req.prompt[None, :], cfg,
                                    steps=req.max_new_tokens - 1))[0]
         np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_prefix_caching_matches_solo_on_full_prompt(model):
+    """Prefix caching: requests sharing a registered prefix copy its K/V
+    device-side and prefill only their suffix — greedy output must equal
+    generate() on the CONCATENATED prompt, interleaved with non-prefix
+    tenants reusing the same slots (stale slot_prefix must never leak)."""
+    cfg, params = model
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, cfg.vocab, 12, dtype=np.int32)
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      chunk_prefill=5)
+    eng.register_prefix("sys", prefix)
+    reqs = []
+    for i in range(6):
+        if i % 2 == 0:
+            reqs.append(Request(rid=i,
+                                prompt=_prompt(rng, 4, 10, cfg.vocab),
+                                max_new_tokens=int(rng.integers(2, 6)),
+                                prefix_id="sys"))
+        else:       # plain tenant between prefix tenants, same slots
+            reqs.append(Request(rid=i,
+                                prompt=_prompt(rng, 4, 10, cfg.vocab),
+                                max_new_tokens=int(rng.integers(2, 6))))
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    assert sorted(c.rid for c in done) == list(range(6))
+    for c in done:
+        req = next(r for r in reqs if r.rid == c.rid)
+        full = (np.concatenate([prefix, req.prompt])
+                if req.prefix_id else req.prompt)
+        assert c.prompt_len == len(full)
+        solo = np.asarray(generate(params, full[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_prefix_caching_validation(model):
+    cfg, params = model
+    rng = np.random.default_rng(23)
+    prefix = rng.integers(0, cfg.vocab, 8, dtype=np.int32)
+    mono = ServeEngine(params, cfg, slots=1, max_seq=64, prompt_bucket=16)
+    with pytest.raises(ValueError, match="chunk"):
+        mono.register_prefix("sys", prefix)
+    eng = ServeEngine(params, cfg, slots=1, max_seq=32, prompt_bucket=8,
+                      chunk_prefill=4)
+    eng.register_prefix("sys", prefix)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit(Request(rid=0, prompt=np.zeros(4, np.int32),
+                           max_new_tokens=1, prefix_id="nope"))
+    with pytest.raises(ValueError, match="non-empty suffix"):
+        eng.submit(Request(rid=0, prompt=np.zeros(0, np.int32),
+                           max_new_tokens=1, prefix_id="sys"))
+    with pytest.raises(ValueError, match="max_seq"):
+        # prefix 8 + suffix 8 + 17 generated > 32
+        eng.submit(Request(rid=0, prompt=np.zeros(8, np.int32),
+                           max_new_tokens=17, prefix_id="sys"))
+
+
+def test_prefix_caching_on_tp_mesh_matches_solo(model):
+    """Prefix caching composed with tensor-parallel serving: the prefix
+    K/V computed from tp-sharded params and memcpy'd into the kv-sharded
+    arena must preserve shardings (GSPMD) and greedy parity."""
+    from jax.sharding import Mesh
+    cfg, params = model
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    rng = np.random.default_rng(29)
+    prefix = rng.integers(0, cfg.vocab, 9, dtype=np.int32)
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      mesh=mesh, chunk_prefill=6)
+    eng.register_prefix("sys", prefix)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 4, 10, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 5)),
+                    prefix_id="sys")
+            for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    for c in done:
+        req = next(r for r in reqs if r.rid == c.rid)
+        full = np.concatenate([prefix, req.prompt])
+        solo = np.asarray(generate(params, full[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)
+
+
+def test_prefix_reregistration_does_not_affect_queued_requests(model):
+    """The resolved prefix entry is pinned at submit: re-registering the
+    same prefix_id (even with a different length) before admission must
+    not retroactively change — or un-validate — an already-queued
+    request. The completion reflects the prefix that was registered when
+    the request was submitted."""
+    cfg, params = model
+    rng = np.random.default_rng(31)
+    old = rng.integers(0, cfg.vocab, 8, dtype=np.int32)
+    eng = ServeEngine(params, cfg, slots=1, max_seq=64, prompt_bucket=16,
+                      chunk_prefill=4)
+    eng.register_prefix("sys", old)
+    suffix = _prompt(rng, 4, 8, cfg.vocab)
+    eng.submit(Request(rid=0, prompt=suffix, max_new_tokens=4,
+                       prefix_id="sys"))
+    # a longer prefix takes the id BEFORE the queued request admits; a
+    # re-resolve at admission would shift every offset and corrupt rows
+    eng.register_prefix("sys", rng.integers(0, cfg.vocab, 20,
+                                            dtype=np.int32))
+    done = eng.run_until_drained()
+    full = np.concatenate([old, suffix])
+    assert done[0].prompt_len == len(full)
+    solo = np.asarray(generate(params, full[None, :], cfg, steps=3))[0]
+    np.testing.assert_array_equal(done[0].tokens, solo)
+
+
+def test_register_prefix_rejects_unusable_length(model):
+    """A prefix so long that no chunk-aligned suffix + generation fits
+    max_seq must fail AT REGISTRATION (before paying KV compute), not on
+    every later submit."""
+    cfg, params = model
+    eng = ServeEngine(params, cfg, slots=1, max_seq=32, prompt_bucket=8,
+                      chunk_prefill=8)
+    with pytest.raises(ValueError, match="room"):
+        eng.register_prefix("big", np.zeros(26, np.int32))  # 26+8 > 32
+    eng.register_prefix("ok", np.zeros(24, np.int32))       # 24+8 == 32

@@ -60,11 +60,15 @@ from .workload import (ModelConfig, Params, _finish_block, _qkv,
 @dataclasses.dataclass
 class Request:
     """One generation request. ``max_new_tokens`` bounds the generation;
-    ``eos_token`` (optional) ends it early."""
+    ``eos_token`` (optional) ends it early. With ``prefix_id`` set (chunked
+    engines only), ``prompt`` is the SUFFIX after a prefix registered via
+    ``ServeEngine.register_prefix`` — admission copies the prefix's cached
+    K/V into the slot device-side and prefills only the suffix."""
     rid: int
     prompt: np.ndarray                  # (true_len,) int32
     max_new_tokens: int
     eos_token: Optional[int] = None
+    prefix_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -141,6 +145,45 @@ def _build_prefill_chunk(cfg: ModelConfig, chunk: int):
         return new_cache, logits[jnp.clip(last_row, 0, chunk - 1)]
 
     return jax.jit(run, donate_argnums=(1,))
+
+
+def _build_prefix_kv(cfg: ModelConfig):
+    """jitted (params, tokens (prefix_len,)) → per-layer [{k, v}] with
+    shapes (1, len, kv_heads, head_dim): the prefix's K/V computed
+    ONCE at registration with the configured attention (flash for long
+    prefixes). Rotary positions are absolute 0..prefix_len-1 — a prefix
+    always occupies a slot's leading rows, so the cached values are
+    position-correct for every future insertion."""
+    attn_fn = _resolve_attn_fn(cfg)
+
+    def run(params: Params, tokens: jax.Array):
+        params = cast_params_for_compute(params, cfg)
+        x = params["embed"][tokens][None, :, :]
+        kv = []
+        for layer in params["layers"]:
+            h = _rmsnorm(x, layer["ln_attn"])
+            q, k, v = _qkv(h, layer, cfg)
+            x, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
+            kv.append({"k": k, "v": v})
+        return kv
+
+    return jax.jit(run)
+
+
+def _build_prefix_insert(cfg: ModelConfig):
+    """jitted (cache, kv, slot) → cache': copy a registered prefix's K/V
+    into the slot's leading rows — a device-side memcpy per layer, zero
+    recompute. The whole point of prefix caching: N requests sharing a
+    system prompt pay its prefill once."""
+    def run(cache: KVCache, kv, slot: jax.Array):
+        out: KVCache = []
+        for c, x in zip(cache, kv):
+            ck = jax.lax.dynamic_update_slice(c["k"], x["k"], (slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(c["v"], x["v"], (slot, 0, 0, 0))
+            out.append({"k": ck, "v": cv})
+        return out
+
+    return jax.jit(run, donate_argnums=(0,))
 
 
 def _build_decode_tick(cfg: ModelConfig):
@@ -244,20 +287,62 @@ class ServeEngine:
                     f"max_seq {max_seq}")
             self._chunk_fn = _build_prefill_chunk(cfg, chunk_prefill)
         self.chunk_prefill = chunk_prefill
+        # registered shared prefixes: id → {"len", "kv"} (+ per-length
+        # compiled insert programs); chunked engines only
+        self._prefixes: Dict[str, dict] = {}
+        self._prefix_kv_fn: Optional[Callable] = None
+        self._prefix_insert_fn: Optional[Callable] = None
+        self._warmed_prefix_lens: set = set()
         # host-side slot state (numpy: the scheduler of this tiny world)
         self.pos = np.zeros(slots, dtype=np.int32)       # next write position
         self.next_tok = np.zeros(slots, dtype=np.int32)  # last sampled token
         self.req: List[Optional[Request]] = [None] * slots
         # per-slot prompt offset while chunk-prefilling; None = not prefilling
         self.prefill_off: List[Optional[int]] = [None] * slots
+        self.slot_prefix = np.zeros(slots, dtype=np.int32)  # tenant prefix len
         self.generated: List[List[int]] = [[] for _ in range(slots)]
         self.admitted_at = np.zeros(slots, dtype=np.int64)
-        self.queue: List[Request] = []
+        self.queue: List[Tuple[Request, Optional[dict]]] = []
         self.completions: List[Completion] = []
         self.tick_count = 0
         self.decode_tokens = 0          # real (non-idle) tokens decoded
 
     # -- submission -----------------------------------------------------------
+
+    def register_prefix(self, prefix_id: str, tokens: np.ndarray) -> None:
+        """Compute and cache a shared prefix's K/V once (system-prompt
+        reuse): every request submitted with this ``prefix_id`` copies the
+        cached rows into its slot device-side and prefills only its
+        suffix. Chunked engines only — the suffix streams in through the
+        offset-dynamic chunk program starting at the prefix boundary.
+        Registration compiles per distinct prefix LENGTH (registrations
+        are rare; admissions are not) and AOT-warms the insert program."""
+        if self.chunk_prefill is None:
+            raise ValueError("prefix caching requires chunk_prefill")
+        p = int(len(tokens))
+        # the minimal admissible request is a 1-token suffix (one chunk
+        # extent past the prefix boundary) generating 1 token — a prefix
+        # that cannot host even that would make every submit() fail after
+        # registration paid KV compute and two compiles
+        if p < 1 or p + max(self.chunk_prefill, 2) > self.max_seq:
+            raise ValueError("prefix must leave room for a chunk-aligned "
+                             "suffix and generation under max_seq")
+        if self._prefix_kv_fn is None:
+            self._prefix_kv_fn = _build_prefix_kv(self.cfg)
+            self._prefix_insert_fn = _build_prefix_insert(self.cfg)
+        kv = self._prefix_kv_fn(
+            self.params, jnp.asarray(np.asarray(tokens, dtype=np.int32)))
+        if p not in self._warmed_prefix_lens:
+            # AOT-compile against abstract cache/kv so the first admission
+            # does not pay XLA inside the serving loop (running it for
+            # real here would need a scratch slot the arena may not have);
+            # jit's own cache keys on shape, so one warm per prefix LENGTH
+            abstract = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+            self._prefix_insert_fn.lower(abstract(self.cache), abstract(kv),
+                                         jnp.int32(0)).compile()
+            self._warmed_prefix_lens.add(p)
+        self._prefixes[prefix_id] = {"len": p, "kv": kv}
 
     def submit(self, req: Request) -> None:
         if req.max_new_tokens < 1:
@@ -266,9 +351,33 @@ class ServeEngine:
         if len(req.prompt) > self.prompt_bucket:
             raise ValueError(
                 f"prompt len {len(req.prompt)} > bucket {self.prompt_bucket}")
-        if len(req.prompt) + req.max_new_tokens > self.max_seq:
+        prefix_len, entry = 0, None
+        if req.prefix_id is not None:
+            if self.chunk_prefill is None:
+                raise ValueError("prefix_id requires a chunked engine")
+            entry = self._prefixes.get(req.prefix_id)
+            if entry is None:
+                raise ValueError(f"unknown prefix_id {req.prefix_id!r}")
+            if len(req.prompt) < 1:
+                raise ValueError("prefix requests need a non-empty suffix "
+                                 "(first-token logits come from its last "
+                                 "real row)")
+            prefix_len = entry["len"]
+        if prefix_len + len(req.prompt) + req.max_new_tokens > self.max_seq:
             raise ValueError("prompt + max_new_tokens exceeds max_seq")
-        self.queue.append(req)
+        if self.chunk_prefill is not None:
+            # the suffix's final chunk writes a full chunk extent; it must
+            # not cross the arena edge (dynamic_update_slice clamps)
+            C = self.chunk_prefill
+            span = prefix_len + -(-len(req.prompt) // C) * C
+            if span > self.max_seq:
+                raise ValueError(
+                    f"chunk-aligned prompt span {span} exceeds max_seq "
+                    f"{self.max_seq}")
+        # the RESOLVED prefix entry rides with the request: re-registering
+        # the id later must not retroactively change (and un-validate) an
+        # already-queued request
+        self.queue.append((req, entry))
 
     def warmup(self) -> None:
         """Compile both programs (one throwaway request through the real
@@ -306,18 +415,26 @@ class ServeEngine:
         for slot in range(self.slots):
             if self.req[slot] is not None or not self.queue:
                 continue
-            req = self.queue.pop(0)
+            req, prefix_entry = self.queue.pop(0)
             if self.chunk_prefill is not None:
                 # chunked admission: claim the slot, stream the prompt in
-                # from tick(); no device work here. Park the decode cursor
-                # at true_len: the fused decode tick still runs this slot
-                # while it prefills, and its garbage K/V write must land on
-                # the ONE row every chunk's causal mask hides (key_pos ==
-                # true_len > any prompt query) and that the first real
-                # decode step overwrites before attending.
+                # from tick(). Park the decode cursor at true_len: the
+                # fused decode tick still runs this slot while it
+                # prefills, and its garbage K/V write must land on the ONE
+                # row every chunk's causal mask hides (key_pos == true_len
+                # > any prompt query) and that the first real decode step
+                # overwrites before attending.
+                p = 0
+                if prefix_entry is not None:
+                    p = prefix_entry["len"]
+                    # device-side memcpy of the cached prefix rows; the
+                    # suffix then streams in from offset p
+                    self.cache = self._prefix_insert_fn(
+                        self.cache, prefix_entry["kv"], jnp.int32(slot))
                 self.req[slot] = req
-                self.prefill_off[slot] = 0
-                self.pos[slot] = len(req.prompt)
+                self.slot_prefix[slot] = p
+                self.prefill_off[slot] = p
+                self.pos[slot] = p + len(req.prompt)
                 self.admitted_at[slot] = self.tick_count
                 continue
             true_len = len(req.prompt)
@@ -333,6 +450,7 @@ class ServeEngine:
                 jnp.int32(slot), jnp.int32(true_len))
             tok = self._sample(first_logits[None, :])[0]
             self.req[slot] = req
+            self.slot_prefix[slot] = 0
             self.pos[slot] = true_len
             self.next_tok[slot] = tok
             self.generated[slot] = [int(tok)]
@@ -349,10 +467,11 @@ class ServeEngine:
             if off is None:
                 continue
             req = self.req[slot]
-            true_len = len(req.prompt)
+            p = int(self.slot_prefix[slot])      # suffix starts at row p
+            true_len = p + len(req.prompt)
             chunk = np.zeros(C, dtype=np.int32)
             n = min(C, true_len - off)
-            chunk[:n] = req.prompt[off:off + n]
+            chunk[:n] = req.prompt[off - p:off - p + n]
             self.cache, next_logits = self._chunk_fn(
                 self.params, self.cache, jnp.asarray(chunk),
                 jnp.int32(slot), jnp.int32(off),
@@ -382,7 +501,7 @@ class ServeEngine:
             return
         self.completions.append(Completion(
             rid=req.rid, tokens=np.asarray(gen, dtype=np.int32),
-            prompt_len=len(req.prompt),
+            prompt_len=int(self.slot_prefix[slot]) + len(req.prompt),
             admitted_tick=int(self.admitted_at[slot]),
             finished_tick=self.tick_count))
         self.req[slot] = None
