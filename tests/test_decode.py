@@ -199,3 +199,50 @@ def test_continuous_batch_per_sequence_positions():
                                    atol=3e-5, rtol=3e-5)
         np.testing.assert_allclose(l2[r], solo_logits[r][1][0],
                                    atol=3e-5, rtol=3e-5)
+
+
+def test_moe_decode_matches_dropless_forward():
+    """MoE inference is DROPLESS end-to-end: a token's expert output is a
+    pure function of the token, so KV-cache decode continues exactly the
+    function prefill computed. (Capacity-based routing cannot have this
+    property — see the companion test.)"""
+    from tpusched.jaxbridge.workload import forward, init_params
+
+    cfg = dataclasses.replace(workload.ModelConfig.tiny(), n_experts=4,
+                              moe_top_k=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    steps = 6
+    got = np.asarray(decode.generate(params, prompt, cfg, steps))
+    seq = np.asarray(prompt)
+    for _ in range(steps + 1):
+        logits = forward(params, jnp.asarray(seq), cfg, dropless=True)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, seq[:, 8:8 + steps + 1])
+
+
+def test_moe_capacity_routing_is_batch_dependent():
+    """Why inference must be dropless: under capacity routing a token's
+    output depends on which OTHER tokens won capacity slots, so the same
+    prefix through different batch shapes yields different logits — the
+    training path trades exactness for the hardware-efficient dispatch
+    (and the load-balance aux), which is fine for training and wrong for
+    decode."""
+    from tpusched.jaxbridge.workload import forward, init_params
+
+    cfg = dataclasses.replace(workload.ModelConfig.tiny(), n_experts=4,
+                              moe_top_k=2, moe_capacity_factor=1.0)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tok8 = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    tok16 = jnp.concatenate([tok8, tok8 + 1], axis=1)   # same first 8
+    short = np.asarray(forward(params, tok8, cfg))[0, :8]
+    long = np.asarray(forward(params, tok16, cfg))[0, :8]
+    # capacity contention from the extra tokens moves the shared prefix's
+    # logits; dropless leaves them untouched
+    assert not np.allclose(short, long, atol=1e-5)
+    short_d = np.asarray(forward(params, tok8, cfg, dropless=True))[0, :8]
+    long_d = np.asarray(forward(params, tok16, cfg, dropless=True))[0, :8]
+    np.testing.assert_allclose(short_d, long_d, atol=1e-5)

@@ -377,3 +377,29 @@ def test_register_prefix_rejects_unusable_length(model):
     with pytest.raises(ValueError, match="room"):
         eng.register_prefix("big", np.zeros(26, np.int32))  # 26+8 > 32
     eng.register_prefix("ok", np.zeros(24, np.int32))       # 24+8 == 32
+
+
+def test_moe_engine_matches_solo_generation(model):
+    """The serving engine over an MoE config: continuous batching, chunked
+    prefill, and the lock-step decode tick must all route through the
+    DROPLESS MoE path, keeping completions solo-identical (the capacity
+    path would make a slot's tokens depend on its neighbors' routing)."""
+    import dataclasses
+    cfg = dataclasses.replace(ModelConfig.tiny(), n_experts=4, moe_top_k=2)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(37)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 14, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 7)))
+            for i in range(6)]
+    for chunk in (None, 5):
+        eng = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=16,
+                          chunk_prefill=chunk)
+        for r in reqs:
+            eng.submit(r)
+        done = eng.run_until_drained()
+        assert sorted(c.rid for c in done) == list(range(6))
+        for c in done:
+            req = next(r for r in reqs if r.rid == c.rid)
+            solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                       steps=req.max_new_tokens - 1))[0]
+            np.testing.assert_array_equal(c.tokens, solo)

@@ -76,7 +76,9 @@ def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
     ck = _cache_write(c["k"], k, pos)
     cv = _cache_write(c["v"], v, pos)
     o = _cached_attention(q, ck, cv, pos, cfg.n_heads // cfg.kv_heads)
-    out, _ = _finish_block(x, layer, o, cfg)   # aux loss is a train concern
+    # dropless: a decode token's MoE output must be a pure function of the
+    # token (capacity contention would make it depend on batch composition)
+    out, _ = _finish_block(x, layer, o, cfg, dropless=True)
     return out, {"k": ck, "v": cv}
 
 
@@ -89,7 +91,9 @@ def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
     q, k, v = _qkv(h, layer, cfg)
     ck = _cache_write(c["k"], k, 0)
     cv = _cache_write(c["v"], v, 0)
-    out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
+    # inference is dropless end-to-end: decode continues exactly the
+    # function prefill computed (see _moe_mlp_dropless)
+    out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg, dropless=True)
     return out, {"k": ck, "v": cv}
 
 

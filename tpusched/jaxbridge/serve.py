@@ -97,7 +97,8 @@ def _build_prefill_slot(cfg: ModelConfig, prompt_bucket: int):
             # insert the row's K/V into ITS slot only
             ck = jax.lax.dynamic_update_slice(c["k"], k, (slot, 0, 0, 0))
             cv = jax.lax.dynamic_update_slice(c["v"], v, (slot, 0, 0, 0))
-            out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
+            out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg,
+                                   dropless=True)
             x = out
             new_cache.append({"k": ck, "v": cv})
         x = _rmsnorm(x, params["ln_f"])
@@ -138,7 +139,7 @@ def _build_prefill_chunk(cfg: ModelConfig, chunk: int):
             ks = jax.lax.dynamic_slice_in_dim(ck, slot, 1, axis=0)
             vs = jax.lax.dynamic_slice_in_dim(cv, slot, 1, axis=0)
             o = _cached_attention(q, ks, vs, off, n_rep)
-            x, _ = _finish_block(x, layer, o, cfg)
+            x, _ = _finish_block(x, layer, o, cfg, dropless=True)
             new_cache.append({"k": ck, "v": cv})
         x = _rmsnorm(x, params["ln_f"])
         logits = x[0] @ params["out"]                    # (chunk, vocab)
@@ -163,7 +164,8 @@ def _build_prefix_kv(cfg: ModelConfig):
         for layer in params["layers"]:
             h = _rmsnorm(x, layer["ln_attn"])
             q, k, v = _qkv(h, layer, cfg)
-            x, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg)
+            x, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg,
+                                 dropless=True)
             kv.append({"k": k, "v": v})
         return kv
 

@@ -214,6 +214,20 @@ def moe_capacity(cfg: ModelConfig, tokens: int) -> int:
                       / cfg.n_experts) + 3 & ~3)
 
 
+def _router_gates(x: jax.Array, p: Dict[str, jax.Array],
+                  cfg: ModelConfig):
+    """THE routing decision — f32 router logits, softmax, top-k,
+    renormalized gates — shared by the capacity (training) and dropless
+    (inference) paths so a routing change can never desynchronize the
+    experts a model trains with from the experts it serves with.
+    Returns (probs (n,E) f32, gate (n,k), idx (n,k))."""
+    logits = x.astype(jnp.float32) @ p["router"]           # (n, E) f32
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = jax.lax.top_k(probs, cfg.moe_top_k)        # (n, k)
+    gate = gate / jnp.sum(gate, axis=-1, keepdims=True)    # renormalize
+    return probs, gate, idx
+
+
 def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
              ep_spec=None) -> Tuple[jax.Array, jax.Array]:
     """GShard/Mixtral-style top-k MoE with capacity-based dispatch, fully
@@ -244,10 +258,7 @@ def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     x = h.reshape(n, d)
     cap = moe_capacity(cfg, n)
 
-    logits = x.astype(jnp.float32) @ p["router"]           # (n, E) f32
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate, idx = jax.lax.top_k(probs, k)                    # (n, k)
-    gate = gate / jnp.sum(gate, axis=-1, keepdims=True)    # renormalize
+    probs, gate, idx = _router_gates(x, p, cfg)
 
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # (n, k, E)
     # k-major flatten: all top-1 slots claim capacity before any top-2 slot
@@ -285,36 +296,83 @@ def _moe_mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
     return out, aux
 
 
+def _moe_mlp_dropless(h: jax.Array, p: Dict[str, jax.Array],
+                      cfg: ModelConfig,
+                      ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+    """Inference-exact MoE: every token runs its top-k experts with NO
+    capacity contention, so a token's output is a pure function of that
+    token alone — the property KV-cache decode requires (capacity-based
+    dispatch makes a token's output depend on which OTHER tokens won
+    capacity slots, so a decode step processing n=batch tokens can never
+    reproduce a prefill that processed n=seq tokens; tested and real).
+    Training keeps the capacity path (_moe_mlp: hardware-efficient,
+    carries the load-balance aux) — dropped-token training + dropless
+    inference is the standard MoE serving arrangement.
+
+    Computes ALL experts per token and combines with zero-padded top-k
+    gates: E/k× the routed FLOPs, the right trade at decode/serving token
+    counts (n = slots or one chunk). A sort-based ragged dispatch is the
+    upgrade path if dropless prefill at large n ever matters."""
+    b, s, d = h.shape
+    n = b * s
+    x = h.reshape(n, d)
+    _, gate, idx = _router_gates(x, p, cfg)
+    w = jnp.sum(gate[..., None]
+                * jax.nn.one_hot(idx, cfg.n_experts, dtype=jnp.float32),
+                axis=1)                                    # (n, E)
+    xc = x.astype(cfg.dtype)
+    g = jnp.einsum("nd,edf->enf", xc, p["w_gate"])
+    u = jnp.einsum("nd,edf->enf", xc, p["w_up"])
+    if ep_spec is not None:
+        # same leading-E sharding as the capacity path's expert buffers:
+        # without it GSPMD may replicate the all-expert activations
+        # across the ep axis (OOM at real expert counts)
+        g = jax.lax.with_sharding_constraint(g, ep_spec)
+        u = jax.lax.with_sharding_constraint(u, ep_spec)
+    oe = jnp.einsum("enf,efd->end", jax.nn.silu(g) * u, p["w_down"])
+    if ep_spec is not None:
+        oe = jax.lax.with_sharding_constraint(oe, ep_spec)
+    out = jnp.einsum("end,ne->nd", oe.astype(jnp.float32), w)
+    return out.reshape(b, s, d).astype(h.dtype), jnp.float32(0.0)
+
+
 def _mlp(h: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
-         ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+         ep_spec=None, dropless: bool = False) -> Tuple[jax.Array, jax.Array]:
     """SwiGLU MLP — dense or MoE by config. Returns (out, aux_loss)."""
     if cfg.n_experts:
+        if dropless:
+            return _moe_mlp_dropless(h, p, cfg, ep_spec)
         return _moe_mlp(h, p, cfg, ep_spec)
     out = (jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])) @ p["w_down"]
     return out, jnp.float32(0.0)
 
 
 def _finish_block(x: jax.Array, p: Dict[str, jax.Array], o: jax.Array,
-                  cfg: ModelConfig, ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+                  cfg: ModelConfig, ep_spec=None,
+                  dropless: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Residual + MLP tail shared by the training forward and the KV-cache
     decode path (jaxbridge/decode.py) — one definition so the two can never
-    desynchronize. Returns (x, moe_aux_loss)."""
+    desynchronize. ``dropless`` selects the inference-exact MoE routing
+    (decode/serving); training uses the capacity path. Returns
+    (x, moe_aux_loss)."""
     b, s, d = x.shape
     x = x + o.reshape(b, s, d) @ p["wo"]
     h = _rmsnorm(x, p["ln_mlp"])
-    mlp, aux = _mlp(h, p, cfg, ep_spec)
+    mlp, aux = _mlp(h, p, cfg, ep_spec, dropless=dropless)
     return x + mlp, aux
 
 
 def _block(x: jax.Array, p: Dict[str, jax.Array], cfg: ModelConfig,
-           attn_fn=None, ep_spec=None) -> Tuple[jax.Array, jax.Array]:
+           attn_fn=None, ep_spec=None,
+           dropless: bool = False) -> Tuple[jax.Array, jax.Array]:
     h = _rmsnorm(x, p["ln_attn"])
     # k/v stay kv_heads-sized: every impl folds the GQA group axis itself
     # (flash resolves it in its kernels' index maps; naive/ring in einsums)
     q, k, v = _qkv(h, p, cfg)
     if attn_fn is None:
         attn_fn = attention.naive_attention
-    return _finish_block(x, p, attn_fn(q, k, v), cfg, ep_spec)
+    return _finish_block(x, p, attn_fn(q, k, v), cfg, ep_spec,
+                         dropless=dropless)
 
 
 def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
@@ -328,7 +386,7 @@ def _resolve_attn_fn(cfg: ModelConfig, attn_fn=None):
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             act_spec: Optional[Any] = None, attn_fn=None,
             ep_spec: Optional[Any] = None,
-            with_aux: bool = False):
+            with_aux: bool = False, dropless: bool = False):
     attn_fn = _resolve_attn_fn(cfg, attn_fn)
     x = params["embed"][tokens]
     if act_spec is not None:
@@ -337,7 +395,8 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
         # instead rotates K/V around the sp ring explicitly, see
         # make_sharded_train_step)
         x = jax.lax.with_sharding_constraint(x, act_spec)
-    blk = functools.partial(_block, cfg=cfg, attn_fn=attn_fn, ep_spec=ep_spec)
+    blk = functools.partial(_block, cfg=cfg, attn_fn=attn_fn, ep_spec=ep_spec,
+                            dropless=dropless)
     if cfg.remat:
         # rematerialize each block in backward: cfg/attn_fn/ep_spec bound
         # in the closure, (x, layer) trace as the checkpointed args
