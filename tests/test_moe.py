@@ -186,10 +186,15 @@ def test_decode_bandwidth_accounting():
     itemsize = 2  # bf16
     assert (decode_bytes_per_token(cfg2v, 1, 128) - b1
             == cfg.vocab * cfg.d_model * itemsize)
-    # MoE configs must refuse rather than publish a dense-MLP number
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="n_experts"):
-        decode_bytes_per_token(ModelConfig.mixtral_like(), 1, 128)
+    # MoE: dropless decode streams ALL E expert stacks + the f32 router;
+    # vs the dense config of the same proportions, the delta per layer is
+    # (E-1) extra SwiGLU stacks (bf16) + the router (f32)
+    moe = ModelConfig.mixtral_like(seq=512)
+    dense_twin = _dc.replace(moe, n_experts=0)
+    delta = (decode_bytes_per_token(moe, 1, 128)
+             - decode_bytes_per_token(dense_twin, 1, 128))
+    L, d, f, E = moe.n_layers, moe.d_model, moe.d_ff, moe.n_experts
+    assert delta == L * ((E - 1) * 3 * d * f * 2 + d * E * 4)
     # off-TPU the peak is unknown: utilization must decline to answer;
     # on a recognized chip it must answer with a positive fraction
     from tpusched.jaxbridge.measure import device_peak_hbm_gbps

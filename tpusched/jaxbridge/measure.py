@@ -88,19 +88,26 @@ def decode_bytes_per_token(cfg: ModelConfig, batch: int,
     out-projection charges the full vocab matrix; counting the table too
     would overstate utilization ~20% on a 155M-class model.
     Weight streaming dominates at small batch; KV at long context."""
-    if cfg.n_experts:
-        # the MoE decode path streams top-k-gathered expert stacks; until a
-        # measured MoE decode exists, a dense-MLP count here would publish
-        # a confidently wrong utilization
-        raise ValueError("decode bandwidth accounting models dense MLPs "
-                         "only (n_experts > 0 unsupported)")
     itemsize = jnp.dtype(cfg.dtype).itemsize
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
     d_kv = (d // cfg.n_heads) * cfg.kv_heads
-    per_layer = d * d + d * d_kv * 2 + d * d + 3 * d * f  # wq wk wv wo mlp
+    attn_w = d * d + d * d_kv * 2 + d * d                 # wq wk wv wo
+    if cfg.n_experts:
+        # dropless decode (workload._moe_mlp_dropless) streams ALL E
+        # expert stacks plus the f32 router per layer. That is the honest
+        # count for this implementation — and near-optimal anyway once
+        # batch*top_k >= E, where a gathered top-k path would touch every
+        # expert too.
+        mlp_w = 3 * d * f * cfg.n_experts
+        router_f32 = d * cfg.n_experts * 4                # f32, not itemsize
+        per_layer = attn_w + mlp_w
+        extra = cfg.n_layers * router_f32
+    else:
+        per_layer = attn_w + 3 * d * f
+        extra = 0
     streamed = v * d + cfg.n_layers * per_layer + batch * d  # out + embed rows
     kv = batch * mean_ctx * cfg.n_layers * 2 * d_kv
-    return (streamed + kv) * itemsize
+    return (streamed + kv) * itemsize + extra
 
 
 def decode_bandwidth_utilization(cfg: ModelConfig, batch: int,
