@@ -1,0 +1,110 @@
+"""Speculative decoding (jaxbridge/spec_decode.py). The load-bearing
+contract: greedy speculation is EXACT — whatever the draft proposes, the
+emitted tokens equal the target model's own greedy decode. A bad draft can
+only cost speed, never correctness; a good draft shrinks the number of
+target weight streams toward steps/(k+1)."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpusched.jaxbridge.decode import generate  # noqa: E402
+from tpusched.jaxbridge.spec_decode import (score_span,  # noqa: E402
+                                            speculative_generate)
+from tpusched.jaxbridge.workload import ModelConfig, init_params  # noqa: E402
+
+TARGET = ModelConfig.tiny()
+DRAFT = dataclasses.replace(TARGET, n_layers=1, d_model=32, n_heads=2,
+                            d_ff=64)
+
+
+def _models(seed_t=0, seed_d=100):
+    tp = init_params(jax.random.PRNGKey(seed_t), TARGET)
+    dp = init_params(jax.random.PRNGKey(seed_d), DRAFT)
+    return tp, dp
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+@pytest.mark.parametrize("steps", [1, 7, 12])
+def test_speculative_matches_target_greedy(k, steps):
+    """Exactness across k and generation lengths, with an UNRELATED random
+    draft (worst case: most proposals rejected — every acceptance path,
+    including n_ok=0 corrections, gets exercised)."""
+    tp, dp = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 9), 0,
+                                TARGET.vocab, dtype=jnp.int32)
+    ref = np.asarray(generate(tp, prompt, TARGET, steps))
+    got, stats = speculative_generate(tp, TARGET, dp, DRAFT, prompt,
+                                      steps, k=k)
+    np.testing.assert_array_equal(got, ref)
+    assert stats["plain_calls"] == steps + 1
+    # every round emits at least one token, plus the prefill
+    assert stats["target_calls"] <= steps + 2
+
+
+def test_perfect_draft_maximizes_acceptance():
+    """Draft == target: every proposal matches, so each round accepts k
+    and emits k+1 (bonus included) — target weight streams collapse to
+    ceil(total/(k+1)) + prefill, the speculation bound."""
+    tp, _ = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0,
+                                TARGET.vocab, dtype=jnp.int32)
+    steps, k = 11, 3
+    ref = np.asarray(generate(tp, prompt, TARGET, steps))
+    got, stats = speculative_generate(tp, TARGET, tp, TARGET, prompt,
+                                      steps, k=k)
+    np.testing.assert_array_equal(got, ref)
+    assert stats["accept_rate"] == 1.0
+    total = steps + 1
+    rounds = -(-(total - 1) // (k + 1))     # prefill emits the first token
+    assert stats["target_calls"] == 1 + rounds
+    assert stats["target_calls"] < stats["plain_calls"]
+
+
+def test_score_span_k1_equals_decode_step():
+    """score_span with a length-1 span IS the decode step — one definition
+    of the decode math (the file's own claim)."""
+    from tpusched.jaxbridge.decode import decode_step, init_kv_cache, prefill
+    tp, _ = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0,
+                                TARGET.vocab, dtype=jnp.int32)
+    cache = init_kv_cache(TARGET, 1, 32)
+    _, cache = prefill(tp, cache, prompt, TARGET)
+    tok = jnp.asarray([7], dtype=jnp.int32)
+    span_logits, _ = score_span(tp, cache, tok[None, :], jnp.int32(5), TARGET)
+    step_logits, _ = decode_step(tp, cache, tok, jnp.int32(5), TARGET)
+    np.testing.assert_allclose(np.asarray(span_logits[0, 0]),
+                               np.asarray(step_logits[0]), atol=1e-6)
+
+
+def test_validation():
+    tp, dp = _models()
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="single-sequence"):
+        speculative_generate(tp, TARGET, dp, DRAFT, prompt, 2)
+    bad_draft = dataclasses.replace(DRAFT, vocab=TARGET.vocab * 2)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(tp, TARGET, init_params(jax.random.PRNGKey(4),
+                                                     bad_draft),
+                             bad_draft, jnp.zeros((1, 4), jnp.int32), 2)
+    with pytest.raises(ValueError, match="k must"):
+        speculative_generate(tp, TARGET, dp, DRAFT,
+                             jnp.zeros((1, 4), jnp.int32), 2, k=0)
+
+
+def test_speculative_with_moe_target():
+    """MoE target: speculation rides the dropless decode path, keeping
+    exactness (a capacity-routed target would break the span==step
+    equivalence the acceptance rule relies on)."""
+    moe_cfg = dataclasses.replace(TARGET, n_experts=4, moe_top_k=2)
+    tp = init_params(jax.random.PRNGKey(5), moe_cfg)
+    dp = init_params(jax.random.PRNGKey(6), DRAFT)
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (1, 8), 0,
+                                moe_cfg.vocab, dtype=jnp.int32)
+    steps = 6
+    ref = np.asarray(generate(tp, prompt, moe_cfg, steps))
+    got, _ = speculative_generate(tp, moe_cfg, dp, DRAFT, prompt, steps, k=3)
+    np.testing.assert_array_equal(got, ref)

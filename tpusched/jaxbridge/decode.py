@@ -112,20 +112,32 @@ def prefill(params: Params, cache: KVCache, tokens: jax.Array,
     return x @ params["out"], new_cache
 
 
+def score_span(params: Params, cache: KVCache, tokens: jax.Array, pos,
+               cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
+    """Teacher-force ``tokens`` (b, n) at absolute positions pos..pos+n-1
+    (``pos`` scalar or (b,)): returns (logits (b, n, vocab), cache'). Row
+    i's argmax is the greedy token for position pos+i+1. One weight stream
+    scores n positions — what speculative verification rides
+    (jaxbridge/spec_decode.py); n == 1 IS the decode step (decode_step is
+    a view over this function, so the two cannot desynchronize)."""
+    params = cast_params_for_compute(params, cfg)
+    x = params["embed"][tokens]
+    new_cache: KVCache = []
+    for layer, c in zip(params["layers"], cache):
+        x, c2 = _layer_decode(x, layer, c, pos, cfg)
+        new_cache.append(c2)
+    x = _rmsnorm(x, params["ln_f"])
+    return x @ params["out"], new_cache
+
+
 def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
                 cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
     """One token per sequence: tokens_t (b,) at absolute position ``pos`` —
     a traceable scalar, or a (b,) array for continuous batching where every
     sequence sits at its own position (requests join/leave the batch
     mid-flight). Returns (logits (b, vocab), updated cache)."""
-    params = cast_params_for_compute(params, cfg)
-    x = params["embed"][tokens_t][:, None, :]
-    new_cache: KVCache = []
-    for layer, c in zip(params["layers"], cache):
-        x, c2 = _layer_decode(x, layer, c, pos, cfg)
-        new_cache.append(c2)
-    x = _rmsnorm(x, params["ln_f"])
-    return (x @ params["out"])[:, 0], new_cache
+    logits, new_cache = score_span(params, cache, tokens_t[:, None], pos, cfg)
+    return logits[:, 0], new_cache
 
 
 def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,

@@ -1,0 +1,145 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Single-token decode is HBM-bandwidth-bound (measure.decode_bytes_per_token):
+every step streams the full parameter set to produce ONE token per sequence.
+Speculative decoding converts one target weight stream into up to k+1
+accepted tokens — the draft (a much smaller model) decodes k tokens
+autoregressively, then the target scores them all in ONE span forward whose
+weight streaming costs the same as a single decode step. Greedy acceptance
+makes the output EXACTLY the target's greedy decode (accept draft token i
+iff it equals the target's argmax at that position; on the first mismatch
+emit the target's token; on full acceptance emit the target's bonus k+1th
+token) — pinned against ``decode.generate`` by tests/test_spec_decode.py,
+the same parity bar every other inference path here meets.
+
+TPU-first shape discipline (why this composes out of existing pieces):
+
+- **Span scoring is the decode step's shape family**, not a fresh path:
+  ``score_span`` runs ``decode._layer_decode`` with s_q = span length — the
+  SAME position-masked cached attention and in-layer write-then-attend
+  ordering the single-token step uses (s_q = 1 IS ``decode_step``).
+- **Static shapes**: the target always scores k+1 rows; the draft feeds
+  spans of length 1 or 2 (2 = the full-acceptance catch-up merged into the
+  next round's first feed). jit caches one program per span length —
+  three compiled shapes total, independent of acceptance behavior, which
+  lives on the host as a tiny logits fetch per round.
+- **Rejected rows need no rollback.** A rejected draft token leaves stale
+  K/V above the accepted position; every later query's causal mask hides
+  rows above its own position, and each row is rewritten by a
+  write-then-attend pass before any query can attend it — the serving
+  arena's pad-pollution argument, carried over verbatim (cursors only move
+  forward through accepted positions).
+
+The reference schedules serving pods; this is the latency optimization the
+pods it places actually run.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import init_kv_cache, prefill, score_span
+from .workload import ModelConfig, Params
+
+# module-level jitted wrappers with cfg STATIC: jit's cache keys on the
+# function identity + static args, so repeated speculative_generate calls
+# (or several engines over the same configs) reuse the compiled programs
+# instead of paying XLA again — the whole module exists to cut decode
+# latency (ModelConfig is a frozen dataclass, hence hashable)
+_span = jax.jit(score_span, static_argnames="cfg", donate_argnums=(1,))
+_prefill = jax.jit(prefill, static_argnames="cfg", donate_argnums=(1,))
+
+
+def speculative_generate(target_params: Params, target_cfg: ModelConfig,
+                         draft_params: Params, draft_cfg: ModelConfig,
+                         prompt: jax.Array, steps: int,
+                         k: int = 4) -> Tuple[np.ndarray, dict]:
+    """Greedy speculative decoding for one sequence (prompt (1, s0)):
+    generates ``steps + 1`` tokens (``decode.generate``'s contract) that
+    are EXACTLY the target model's greedy continuation. Returns
+    (tokens (1, steps+1), stats); stats carries the acceptance telemetry
+    that decides whether the draft pays for itself — ``target_calls``
+    (each streams the target weights once; plain decode makes
+    ``plain_calls`` of them) and ``accept_rate``.
+
+    Both models must share a vocabulary; the draft is typically much
+    smaller (same tokenizer, fewer layers/width)."""
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative_generate is single-sequence (b=1); "
+                         "batched speculation belongs in the serve engine")
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    total = int(steps) + 1
+    s0 = prompt.shape[1]
+    max_seq = s0 + total + k + 2          # headroom for the last overshoot
+    t_cache = init_kv_cache(target_cfg, 1, max_seq)
+    d_cache = init_kv_cache(draft_cfg, 1, max_seq)
+
+    t_logits, t_cache = _prefill(target_params, t_cache, prompt,
+                                 cfg=target_cfg)
+    _, d_cache = _prefill(draft_params, d_cache, prompt, cfg=draft_cfg)
+    out = [int(jnp.argmax(t_logits[0, s0 - 1]))]
+
+    # cursors: next write row of each cache. Invariant at every round
+    # start: rows [0, t_pos) of the target cache and [0, d_pos) of the
+    # draft cache hold the ACCEPTED stream (prompt + out, minus its last
+    # t_pos-or-d_pos-relative suffix); out's last (t_pos - d_pos + 1)
+    # tokens are exactly what the draft has not ingested yet.
+    t_pos = d_pos = s0
+    target_calls = 1                      # the prefill produced out[0]
+    drafted = accepted = 0
+    while len(out) < total:
+        # 1) draft phase: ingest the catch-up suffix (ends with the last
+        #    emitted token), then propose k tokens autoregressively. The
+        #    local cursor walks every fed row; d_pos itself advances only
+        #    through rows that turn out VALID (catch-up + accepted
+        #    proposals) — rejected rows are re-written next round.
+        feed = out[len(out) - (t_pos - d_pos) - 1:]
+        catch_up = len(feed)
+        span = []
+        cur = d_pos
+        for _ in range(k):
+            logits, d_cache = _span(draft_params, d_cache,
+                                    jnp.asarray([feed], dtype=jnp.int32),
+                                    jnp.int32(cur), cfg=draft_cfg)
+            cur += len(feed)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            span.append(nxt)
+            feed = [nxt]
+        drafted += k
+        # 2) ONE target stream scores [last_emitted] + span (k+1 rows) at
+        #    positions t_pos..t_pos+k; row i's argmax answers position
+        #    t_pos+i+1 — compare row i to span[i], row k is the bonus
+        scored = jnp.asarray([[out[-1]] + span], dtype=jnp.int32)
+        t_logits, t_cache = _span(target_params, t_cache, scored,
+                                  jnp.int32(t_pos), cfg=target_cfg)
+        target_calls += 1
+        t_arg = np.asarray(jnp.argmax(t_logits[0], axis=-1))   # (k+1,)
+        n_ok = 0
+        while n_ok < k and span[n_ok] == int(t_arg[n_ok]):
+            n_ok += 1
+        accepted += n_ok
+        if n_ok == k:
+            out.extend(span)
+            out.append(int(t_arg[k]))     # bonus: target's own next token
+        else:
+            out.extend(span[:n_ok])
+            out.append(int(t_arg[n_ok]))  # the target's correction
+        # accepted rows now reach t_pos + n_ok (inputs [out[-...], span[:n_ok]]
+        # were all fed); the newly emitted token sits one past them, unfed
+        t_pos += n_ok + 1
+        # draft's valid rows: the catch-up plus accepted proposals it fed
+        # (it never fed span[k-1], hence the min with k-1)
+        d_pos += catch_up + min(n_ok, k - 1)
+    tokens = np.asarray([out[:total]], dtype=np.int32)
+    stats = {"target_calls": target_calls,
+             "plain_calls": total,
+             "drafted": drafted,
+             "accepted": accepted,
+             "accept_rate": accepted / max(drafted, 1)}
+    return tokens, stats
