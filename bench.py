@@ -978,6 +978,54 @@ def bench_tpu_workload() -> None:
         emit(f"chunked serve bench FAILED: {type(e).__name__}: {e}",
              None, "", None)
 
+    # speculative decoding at the acceptance CEILING (the model drafts for
+    # itself, so every proposal is accepted): measures the span-scoring +
+    # host-acceptance machinery's real overhead against plain decode. A
+    # production draft lands between the two; random weights would sit
+    # below plain and measure nothing but draft quality. vs_baseline =
+    # plain/spec wall-time ratio (>1: the machinery's win is real).
+    try:
+        import jax.numpy as jnp
+        from tpusched.jaxbridge.decode import generate as _gen
+        from tpusched.jaxbridge.spec_decode import speculative_generate
+        from tpusched.jaxbridge.workload import init_params as _init
+        sp_cfg = dataclasses.replace(cfg, seq=512)
+        sp_params = _init(jax.random.PRNGKey(1), sp_cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 64), 0,
+                                    sp_cfg.vocab, dtype=jnp.int32)
+        steps, k = 127, 4
+        _ = _gen(sp_params, prompt, sp_cfg, steps)          # warm both paths
+        _ = speculative_generate(sp_params, sp_cfg, sp_params, sp_cfg,
+                                 prompt, steps, k=k)
+        t0 = time.perf_counter()
+        ref = _gen(sp_params, prompt, sp_cfg, steps).block_until_ready()
+        plain_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got, stats = speculative_generate(sp_params, sp_cfg, sp_params,
+                                          sp_cfg, prompt, steps, k=k)
+        spec_s = time.perf_counter() - t0
+        if not np.array_equal(got, np.asarray(ref)):
+            # plausible on-hardware near-tie: the s_q=1 scan program and
+            # the s_q=k+1 span program may tile bf16 reductions
+            # differently, flipping an argmax the two top logits tie on.
+            # That breaks the exact-greedy claim for THIS run — report it
+            # as data, do not take the bench down.
+            div = int(np.argmax(got[0] != np.asarray(ref)[0]))
+            emit("speculative decode DIVERGED from plain greedy at token "
+                 f"{div} of {steps + 1} (near-tie argmax across program "
+                 "shapes?) — exactness holds on CPU; timing suppressed",
+                 None, "", None)
+            return
+        emit("speculative decode ceiling (self-draft, k=4, 128 tokens, "
+             f"155M bf16): {stats['target_calls']} target streams vs "
+             f"{stats['plain_calls']} plain; exact-output asserted "
+             "(single v5e chip; vs_baseline = plain/spec wall ratio)",
+             round((steps + 1) / spec_s, 1), "tokens/s",
+             round(plain_s / spec_s, 2))
+    except Exception as e:  # noqa: BLE001
+        emit(f"speculative decode bench FAILED: {type(e).__name__}: {e}",
+             None, "", None)
+
 
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): 5 headline gang runs, gate on the

@@ -53,6 +53,32 @@ _span = jax.jit(score_span, static_argnames="cfg", donate_argnums=(1,))
 _prefill = jax.jit(prefill, static_argnames="cfg", donate_argnums=(1,))
 
 
+def _draft_propose(params: Params, cache: KVCache, feed: jax.Array, pos,
+                   cfg: ModelConfig, k: int) -> Tuple[jax.Array, KVCache]:
+    """The whole draft phase as ONE device program: ingest ``feed``
+    (1, p) at ``pos``, then scan k-1 further single-token steps — the k
+    proposals come back in a single host transfer instead of k blocking
+    argmax round-trips (a per-token sync costs the same order as a small
+    draft's forward; paying it k times per round would erode the very
+    latency the module exists to cut)."""
+    logits, cache = score_span(params, cache, feed, pos, cfg)
+    tok0 = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, cache, p = carry
+        logits, cache = score_span(params, cache, tok[None, None], p, cfg)
+        nxt = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+        return (nxt, cache, p + 1), tok
+
+    (last, cache, _), toks = jax.lax.scan(
+        step, (tok0, cache, pos + feed.shape[1]), None, length=k - 1)
+    return jnp.concatenate([toks, last[None]]), cache
+
+
+_draft = jax.jit(_draft_propose, static_argnames=("cfg", "k"),
+                 donate_argnums=(1,))
+
+
 def speculative_generate(target_params: Params, target_cfg: ModelConfig,
                          draft_params: Params, draft_cfg: ModelConfig,
                          prompt: jax.Array, steps: int,
@@ -101,16 +127,10 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
         #    proposals) — rejected rows are re-written next round.
         feed = out[len(out) - (t_pos - d_pos) - 1:]
         catch_up = len(feed)
-        span = []
-        cur = d_pos
-        for _ in range(k):
-            logits, d_cache = _span(draft_params, d_cache,
-                                    jnp.asarray([feed], dtype=jnp.int32),
-                                    jnp.int32(cur), cfg=draft_cfg)
-            cur += len(feed)
-            nxt = int(jnp.argmax(logits[0, -1]))
-            span.append(nxt)
-            feed = [nxt]
+        span_dev, d_cache = _draft(draft_params, d_cache,
+                                   jnp.asarray([feed], dtype=jnp.int32),
+                                   jnp.int32(d_pos), cfg=draft_cfg, k=k)
+        span = [int(t) for t in np.asarray(span_dev)]   # ONE host transfer
         drafted += k
         # 2) ONE target stream scores [last_emitted] + span (k+1 rows) at
         #    positions t_pos..t_pos+k; row i's argmax answers position
