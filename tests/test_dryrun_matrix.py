@@ -31,8 +31,10 @@ def test_dryrun_multichip(n):
         f"dryrun_multichip({n}) failed\n--- stdout ---\n{r.stdout}"
         f"\n--- stderr ---\n{r.stderr}")
     # the asserted-parity markers must have printed (moe/pipeline run on
-    # multiples of 8 only — the ep/pp splits need those factors)
-    families = (("dense", "moe", "pipeline") if n % 8 == 0 else ("dense",))
+    # multiples of 8 only — the ep/pp splits need those factors; tp-serve
+    # needs just 2 devices, so every CI size must show it)
+    families = (("dense", "moe", "pipeline", "tp-serve") if n % 8 == 0
+                else ("dense", "tp-serve"))
     for family in families:
         assert f"{family} mesh=" in r.stdout, (
             f"{family} family missing from dryrun_multichip({n}) output:\n"
