@@ -27,8 +27,56 @@ KVCache = List[Dict[str, jax.Array]]
 def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
     hd = cfg.d_model // cfg.n_heads
     shape = (batch, max_seq, cfg.kv_heads, hd)
+    if cfg.kv_cache_dtype == "int8":
+        # quantized cache: int8 values + one f32 scale per (seq row, kv
+        # head) — decode streams HALF the KV bytes, the term that
+        # dominates the bandwidth roofline at long context. Opt-in and
+        # decode-path-only (the serving arena's insert programs write
+        # rows directly and guard against it).
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros(shape[:3], jnp.float32),
+                 "vs": jnp.zeros(shape[:3], jnp.float32)}
+                for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-(row, kv-head) int8 quantization over head_dim:
+    (b, s, kv, hd) -> (int8 q, f32 scale (b, s, kv)). One scale per head
+    per position keeps the dequant a fused broadcast-multiply inside the
+    attention einsum's operand read."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def cache_update(c: Dict[str, jax.Array], k: jax.Array, v: jax.Array,
+                 pos) -> Dict[str, jax.Array]:
+    """Write fresh K/V rows into the (possibly quantized) cache entry at
+    ``pos``. THE single write path for decode/prefill/span scoring."""
+    if "ks" in c:
+        qk, ks = _quantize_kv(k)
+        qv, vs = _quantize_kv(v)
+        return {"k": _cache_write(c["k"], qk, pos),
+                "v": _cache_write(c["v"], qv, pos),
+                "ks": _cache_write(c["ks"], ks, pos),
+                "vs": _cache_write(c["vs"], vs, pos)}
+    return {"k": _cache_write(c["k"], k, pos),
+            "v": _cache_write(c["v"], v, pos)}
+
+
+def cache_kv(c: Dict[str, jax.Array], dtype) -> Tuple[jax.Array, jax.Array]:
+    """The cache entry's K/V as compute-dtype arrays. For an int8 cache the
+    dequant (int8 * scale) stays elementwise so XLA fuses it into the
+    attention contraction — HBM reads the int8 bytes, the MXU sees
+    dequantized values."""
+    if "ks" in c:
+        return (c["k"].astype(dtype) * c["ks"].astype(dtype)[..., None],
+                c["v"].astype(dtype) * c["vs"].astype(dtype)[..., None])
+    return c["k"], c["v"]
 
 
 def _cached_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
@@ -54,15 +102,18 @@ def _cached_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
 
 
 def _cache_write(cache: jax.Array, new: jax.Array, pos) -> jax.Array:
-    """Write ``new`` (b, s_q, kv, hd) into the cache at sequence offset
+    """Write ``new`` (b, s_q, ...) into the cache at sequence offset
     ``pos`` — scalar (whole batch aligned) or (b,) per-sequence positions
-    (continuous batching: each row writes at its own offset)."""
+    (continuous batching: each row writes at its own offset). Rank-agnostic
+    past the (batch, seq) prefix so int8 scale planes (b, s, kv) write
+    through the same helper as value tensors (b, s, kv, hd)."""
     off = jnp.asarray(pos)
+    tail = (0,) * (cache.ndim - 2)
     if off.ndim == 0:
-        return jax.lax.dynamic_update_slice(cache, new, (0, off, 0, 0))
+        return jax.lax.dynamic_update_slice(cache, new, (0, off) + tail)
     # (b,) per-row offsets: one dynamic_update_slice per row
     return jax.vmap(
-        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p,) + tail)
     )(cache, new, off)
 
 
@@ -73,13 +124,13 @@ def _layer_decode(x: jax.Array, layer: Dict[str, jax.Array], c, pos,
     with the training forward so the two can never desynchronize."""
     h = _rmsnorm(x, layer["ln_attn"])
     q, k, v = _qkv(h, layer, cfg, pos_offset=pos)
-    ck = _cache_write(c["k"], k, pos)
-    cv = _cache_write(c["v"], v, pos)
+    c2 = cache_update(c, k, v, pos)
+    ck, cv = cache_kv(c2, q.dtype)
     o = _cached_attention(q, ck, cv, pos, cfg.n_heads // cfg.kv_heads)
     # dropless: a decode token's MoE output must be a pure function of the
     # token (capacity contention would make it depend on batch composition)
     out, _ = _finish_block(x, layer, o, cfg, dropless=True)
-    return out, {"k": ck, "v": cv}
+    return out, c2
 
 
 def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
@@ -89,12 +140,13 @@ def _layer_prefill(x: jax.Array, layer: Dict[str, jax.Array], c,
     cache matrix) while K/V are recorded into the cache at position 0."""
     h = _rmsnorm(x, layer["ln_attn"])
     q, k, v = _qkv(h, layer, cfg)
-    ck = _cache_write(c["k"], k, 0)
-    cv = _cache_write(c["v"], v, 0)
+    c2 = cache_update(c, k, v, 0)
     # inference is dropless end-to-end: decode continues exactly the
-    # function prefill computed (see _moe_mlp_dropless)
+    # function prefill computed (see _moe_mlp_dropless). Prefill attention
+    # uses the FRESH (unquantized) k/v — quantization error enters only
+    # where it buys bandwidth: the cached reads of later steps.
     out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg, dropless=True)
-    return out, {"k": ck, "v": cv}
+    return out, c2
 
 
 def prefill(params: Params, cache: KVCache, tokens: jax.Array,

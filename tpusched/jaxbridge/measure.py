@@ -106,8 +106,15 @@ def decode_bytes_per_token(cfg: ModelConfig, batch: int,
         per_layer = attn_w + 3 * d * f
         extra = 0
     streamed = v * d + cfg.n_layers * per_layer + batch * d  # out + embed rows
-    kv = batch * mean_ctx * cfg.n_layers * 2 * d_kv
-    return (streamed + kv) * itemsize + extra
+    kv_elems = batch * mean_ctx * cfg.n_layers * 2 * d_kv
+    if cfg.kv_cache_dtype == "int8":
+        # 1 byte per element + one f32 scale per (row, kv-head) — the
+        # per-element amortization is 4/head_dim
+        hd = d // cfg.n_heads
+        kv_bytes = kv_elems + (kv_elems // hd) * 4
+    else:
+        kv_bytes = kv_elems * itemsize
+    return streamed * itemsize + kv_bytes + extra
 
 
 def decode_bandwidth_utilization(cfg: ModelConfig, batch: int,

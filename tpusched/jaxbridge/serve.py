@@ -226,6 +226,14 @@ class ServeEngine:
         if not buckets or buckets[-1] >= max_seq:
             raise ValueError("prompt buckets must be non-empty and leave "
                              "generation room under max_seq")
+        if cfg.kv_cache_dtype is not None:
+            # the engine's prefill/chunk/prefix programs dynamic_update_slice
+            # raw K/V rows into the arena; a quantized cache would need the
+            # scale planes threaded through every one of them — reject
+            # loudly rather than corrupt silently
+            raise ValueError("ServeEngine requires the exact KV cache "
+                             "(cfg.kv_cache_dtype=None); int8 KV is a "
+                             "decode-path option")
         self.params = params
         self.cfg = cfg
         self.slots = slots

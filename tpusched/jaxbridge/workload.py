@@ -60,6 +60,12 @@ class ModelConfig:
     # them — O(layers) residuals instead of O(layers × block internals),
     # the HBM trade that fits ~1B-param AdamW training on a 16 GB chip
     remat: bool = False
+    # KV-cache storage dtype for the DECODE path: None ⇒ `dtype` (exact),
+    # "int8" ⇒ symmetric per-(row, kv-head) quantization — halves the KV
+    # bytes each decode step streams, the dominant roofline term at long
+    # context. Approximate (bounded by the per-head scale), decode-only;
+    # the serving arena rejects it (its insert programs write raw rows).
+    kv_cache_dtype: Any = None
 
     @property
     def kv_heads(self) -> int:
@@ -77,6 +83,13 @@ class ModelConfig:
         if self.d_model % self.n_heads:
             raise ValueError(
                 f"n_heads ({self.n_heads}) must divide d_model ({self.d_model})")
+        if self.kv_cache_dtype not in (None, "int8"):
+            # the natural mistake is jnp.int8 (the adjacent dtype fields
+            # take jnp dtypes) — which would silently select the EXACT
+            # cache while the user believes quantization is on
+            raise ValueError(
+                f"kv_cache_dtype must be None or the string 'int8', got "
+                f"{self.kv_cache_dtype!r}")
 
     @staticmethod
     def tiny() -> "ModelConfig":
