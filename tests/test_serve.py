@@ -403,3 +403,49 @@ def test_moe_engine_matches_solo_generation(model):
             solo = np.asarray(generate(params, req.prompt[None, :], cfg,
                                        steps=req.max_new_tokens - 1))[0]
             np.testing.assert_array_equal(c.tokens, solo)
+
+
+@pytest.mark.parametrize("seed", [51, 77, 1234])
+def test_serving_soak_composed_features(model, seed):
+    """Randomized composition torture: chunked prefill + prefix caching +
+    EOS early-stop + mixed lengths + slot churn in ONE engine run, every
+    completion checked against solo generation on its full prompt. The
+    serving analog of the scheduler's randomized soak — features that are
+    each correct alone can still interact (slot reuse between prefix and
+    plain tenants, chunk streams racing admissions, EOS mid-prefill)."""
+    cfg, params = model
+    rng = np.random.default_rng(seed)
+    eng = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=20,
+                      chunk_prefill=int(rng.integers(3, 8)))
+    prefix = rng.integers(0, cfg.vocab, int(rng.integers(6, 12)),
+                          dtype=np.int32)
+    eng.register_prefix("sys", prefix)
+    reqs, fulls = [], {}
+    for i in range(10):
+        use_prefix = bool(rng.integers(0, 2))
+        prompt = _prompt(rng, 3, 14, cfg.vocab)
+        gen = int(rng.integers(2, 9))
+        full = np.concatenate([prefix, prompt]) if use_prefix else prompt
+        solo = np.asarray(generate(params, full[None, :], cfg,
+                                   steps=gen - 1))[0]
+        eos = None
+        if rng.integers(0, 3) == 0 and gen >= 3:
+            # pick a token greedy WILL emit mid-generation: the engine
+            # must stop there, shortening the completion
+            eos = int(solo[1])
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=gen,
+                            eos_token=eos,
+                            prefix_id="sys" if use_prefix else None))
+        fulls[i] = (full, solo, eos)
+    for r in reqs:
+        eng.submit(r)
+    done = eng.run_until_drained()
+    assert sorted(c.rid for c in done) == list(range(10))
+    for c in done:
+        full, solo, eos = fulls[c.rid]
+        assert c.prompt_len == len(full)
+        if eos is not None and eos in list(solo):
+            stop = list(solo).index(eos)
+            np.testing.assert_array_equal(c.tokens, solo[:stop + 1])
+        else:
+            np.testing.assert_array_equal(c.tokens, solo)
