@@ -449,3 +449,112 @@ def test_serving_soak_composed_features(model, seed):
             np.testing.assert_array_equal(c.tokens, solo[:stop + 1])
         else:
             np.testing.assert_array_equal(c.tokens, solo)
+
+
+@pytest.mark.parametrize("seed", [61, 88])
+def test_speculative_engine_matches_plain_engine(model, seed):
+    """Batched speculation in the engine: per-slot draft proposals + one
+    arena-wide verify stream must produce completions IDENTICAL to the
+    plain engine on the same request set (which itself is solo-exact) —
+    across mixed lengths, EOS early-stops, and slot churn. An unrelated
+    random draft exercises heavy rejection; stats must account every
+    round."""
+    import dataclasses
+    cfg, params = model
+    draft_cfg = dataclasses.replace(cfg, n_layers=1, d_model=32, n_heads=2,
+                                    d_ff=64)
+    draft_params = init_params(jax.random.PRNGKey(500 + seed), draft_cfg)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(8):
+        prompt = _prompt(rng, 3, 15, cfg.vocab)
+        gen = int(rng.integers(2, 10))
+        eos = None
+        if rng.integers(0, 3) == 0 and gen >= 4:
+            solo = np.asarray(generate(params, prompt[None, :], cfg,
+                                       steps=gen - 1))[0]
+            eos = int(solo[1])
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=gen,
+                            eos_token=eos))
+    plain = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=16)
+    spec = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=16,
+                       draft_params=draft_params, draft_cfg=draft_cfg,
+                       spec_k=3)
+    for eng in (plain, spec):
+        for r in reqs:
+            eng.submit(r)
+    done_p = {c.rid: c for c in plain.run_until_drained()}
+    done_s = {c.rid: c for c in spec.run_until_drained()}
+    assert set(done_s) == set(range(8))
+    for rid in done_s:
+        np.testing.assert_array_equal(done_s[rid].tokens,
+                                      done_p[rid].tokens)
+    assert spec.spec_stats["rounds"] > 0
+    assert spec.spec_stats["drafted"] >= spec.spec_stats["accepted"]
+
+
+def test_speculative_engine_perfect_draft_compresses_rounds(model):
+    """Draft == target: every proposal accepted, so each slot emits
+    spec_k+1 tokens per round — total rounds collapse well below the
+    token count (the batched analog of the perfect-draft bound)."""
+    cfg, params = model
+    rng = np.random.default_rng(3)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 4, 10, cfg.vocab),
+                    max_new_tokens=12) for i in range(4)]
+    spec = ServeEngine(params, cfg, slots=4, max_seq=64, prompt_bucket=16,
+                       draft_params=params, draft_cfg=cfg, spec_k=3)
+    plain = ServeEngine(params, cfg, slots=4, max_seq=64, prompt_bucket=16)
+    for eng in (spec, plain):
+        for r in reqs:
+            eng.submit(r)
+    done_s = {c.rid: c for c in spec.run_until_drained()}
+    done_p = {c.rid: c for c in plain.run_until_drained()}
+    for rid in done_s:
+        np.testing.assert_array_equal(done_s[rid].tokens,
+                                      done_p[rid].tokens)
+    assert spec.spec_stats["accepted"] == spec.spec_stats["drafted"]
+    # 12 tokens per slot, 4 per round after the admission token:
+    # ceil(11/4) = 3 rounds per slot, all slots in parallel
+    assert spec.spec_stats["rounds"] <= 4
+    assert plain.tick_count > spec.tick_count
+
+
+def test_speculative_engine_validation(model):
+    import dataclasses
+    cfg, params = model
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(1), dcfg)
+    with pytest.raises(ValueError, match="draft_cfg"):
+        ServeEngine(params, cfg, draft_params=dp, max_seq=64,
+                    prompt_bucket=16)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
+                    temperature=0.5, max_seq=64, prompt_bucket=16)
+    with pytest.raises(ValueError, match="monolithic"):
+        ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
+                    chunk_prefill=4, max_seq=64, prompt_bucket=16)
+    eng = ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
+                      slots=1, max_seq=64, prompt_bucket=16)
+    with pytest.raises(ValueError, match="non-empty prompt"):
+        eng.submit(Request(rid=0, prompt=np.zeros(0, np.int32),
+                           max_new_tokens=2))
+
+
+def test_speculative_engine_rejects_arena_overrun(model):
+    """The last round's verify span can overshoot the final accepted
+    position by spec_k+1 rows; a budget without that headroom would be
+    silently clamp-corrupted — must refuse at submit."""
+    import dataclasses
+    cfg, params = model
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(2), dcfg)
+    eng = ServeEngine(params, cfg, slots=1, max_seq=64, prompt_bucket=16,
+                      draft_params=dp, draft_cfg=dcfg, spec_k=4)
+    with pytest.raises(ValueError, match="overshoot"):
+        eng.submit(Request(rid=0, prompt=np.zeros(16, np.int32),
+                           max_new_tokens=44))   # 16+44+5 > 64
+    eng.submit(Request(rid=0, prompt=np.zeros(16, np.int32),
+                       max_new_tokens=43))       # 16+43+5 == 64: fits
+    with pytest.raises(ValueError, match="draft_cfg without"):
+        ServeEngine(params, cfg, draft_cfg=dcfg, max_seq=64,
+                    prompt_bucket=16)

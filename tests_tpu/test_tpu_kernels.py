@@ -475,3 +475,37 @@ def test_speculative_decode_on_chip(tpu):
     np.testing.assert_array_equal(got2, ref)
     assert stats2["accept_rate"] == 1.0
     assert stats2["target_calls"] < stats2["plain_calls"]
+
+
+def test_speculative_serving_on_chip(tpu):
+    """Batched speculative serving on hardware: the per-slot draft scan
+    and arena-wide verify span must lower and emit completions identical
+    to the plain engine."""
+    import dataclasses
+    import numpy as np
+    from tpusched.jaxbridge.serve import Request, ServeEngine
+    from tpusched.jaxbridge.workload import ModelConfig, init_params
+
+    cfg = ModelConfig.tiny()
+    draft_cfg = dataclasses.replace(cfg, n_layers=1, d_model=32, n_heads=2,
+                                    d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dp = init_params(jax.random.PRNGKey(50), draft_cfg)
+    rng = np.random.default_rng(9)
+    reqs = [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab,
+                                        size=int(rng.integers(4, 12)),
+                                        dtype=np.int32),
+                    max_new_tokens=int(rng.integers(3, 7)))
+            for i in range(4)]
+    plain = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16)
+    spec = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                       draft_params=dp, draft_cfg=draft_cfg, spec_k=3)
+    for eng in (plain, spec):
+        for r in reqs:
+            eng.submit(r)
+    done_p = {c.rid: c for c in plain.run_until_drained()}
+    done_s = {c.rid: c for c in spec.run_until_drained()}
+    for rid in done_s:
+        np.testing.assert_array_equal(done_s[rid].tokens,
+                                      done_p[rid].tokens)

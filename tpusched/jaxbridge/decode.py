@@ -192,6 +192,29 @@ def decode_step(params: Params, cache: KVCache, tokens_t: jax.Array, pos,
     return logits[:, 0], new_cache
 
 
+def draft_rollout(params: Params, cache: KVCache, feed: jax.Array, pos,
+                  cfg: ModelConfig, k: int) -> Tuple[jax.Array, KVCache]:
+    """Greedy draft rollout: ingest ``feed`` (b, p) at positions
+    pos..pos+p-1, then propose k tokens autoregressively via lax.scan —
+    ONE device program, one host transfer for all proposals. THE single
+    definition of the speculative draft phase: the single-stream
+    speculative_generate and the serving engine's batched draft tick are
+    both thin wrappers (``pos`` scalar or (b,) per-slot cursors).
+    Returns (proposals (b, k), cache')."""
+    logits, cache = score_span(params, cache, feed, pos, cfg)
+    tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, cache, p = carry
+        logits, cache = score_span(params, cache, tok[:, None], p, cfg)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, cache, p + 1), tok
+
+    (last, cache, _), toks = jax.lax.scan(
+        step, (tok0, cache, pos + feed.shape[1]), None, length=k - 1)
+    return jnp.concatenate([toks, last[None]], axis=0).T, cache
+
+
 def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0) -> jax.Array:
     """One sampling decision per row of ``logits`` (b, vocab) — temperature,

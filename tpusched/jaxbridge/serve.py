@@ -204,11 +204,47 @@ def _build_decode_tick(cfg: ModelConfig):
     return jax.jit(run, donate_argnums=(1,))
 
 
+def _build_draft_tick(cfg: ModelConfig, k: int):
+    """jitted (draft_params, draft_cache, feed2 (slots, 2), pos (slots,)) →
+    (proposals (slots, k), cache'): decode.draft_rollout (the single
+    definition of the draft phase) over the arena. feed2 holds each
+    slot's tokens at rows (pos-1, pos) — a UNIFORM 2-row catch-up:
+    re-feeding an already-ingested token at its own position rewrites
+    identical K/V (idempotent), which is what lets per-slot variable
+    acceptance avoid ragged feeds entirely."""
+    from .decode import draft_rollout
+
+    def run(params: Params, cache: KVCache, feed2: jax.Array,
+            pos: jax.Array):
+        return draft_rollout(params, cache, feed2, pos - 1, cfg, k)
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def _build_verify_span(cfg: ModelConfig):
+    """jitted (params, cache, scored (slots, k+1), pos (slots,)) →
+    (argmax (slots, k+1), cache'): ONE target weight stream scores every
+    slot's k proposals plus its bonus position — decode.score_span over
+    the arena with per-slot cursors."""
+    from .decode import score_span
+
+    def run(params: Params, cache: KVCache, scored: jax.Array,
+            pos: jax.Array):
+        logits, cache = score_span(params, cache, scored, pos, cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
 class ServeEngine:
     """Continuous-batching engine: submit() requests, tick() until done.
 
     Greedy by default (temperature 0); pass temperature/top_k/top_p for
-    sampled generation (one PRNG stream per engine)."""
+    sampled generation (one PRNG stream per engine). With
+    ``draft_params``/``draft_cfg`` the engine runs BATCHED speculative
+    decoding: every tick, a draft arena proposes ``spec_k`` tokens per
+    slot and the target verifies all slots in one span stream — per-slot
+    greedy acceptance, outputs identical to the plain engine."""
 
     def __init__(self, params: Params, cfg: ModelConfig, *,
                  slots: int = 8, max_seq: int = 1024,
@@ -216,7 +252,10 @@ class ServeEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
                  mesh: Optional[Mesh] = None,
-                 chunk_prefill: Optional[int] = None):
+                 chunk_prefill: Optional[int] = None,
+                 draft_params: Optional[Params] = None,
+                 draft_cfg: Optional[ModelConfig] = None,
+                 spec_k: int = 4):
         # one or several prompt buckets (ascending): each admission pads to
         # the SMALLEST bucket that fits, so short prompts stop paying the
         # longest prompt's prefill FLOPs. One compiled prefill per bucket,
@@ -274,6 +313,36 @@ class ServeEngine:
                 lambda: init_kv_cache(cfg, slots, max_seq),
                 out_shardings=[{"k": kv_sh, "v": kv_sh}
                                for _ in range(cfg.n_layers)])()
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+        if draft_params is None and draft_cfg is not None:
+            raise ValueError("draft_cfg without draft_params: the engine "
+                             "would silently run plain, not speculative")
+        if draft_params is not None:
+            # v1 scope: greedy, monolithic admission, single-device — each
+            # relaxation is its own correctness argument; refuse combos
+            # this version has not earned
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocabulary")
+            if temperature != 0.0:
+                raise ValueError("speculative serving is greedy-only "
+                                 "(temperature must be 0)")
+            if chunk_prefill is not None or mesh is not None:
+                raise ValueError("speculative serving composes with "
+                                 "monolithic single-device admission only "
+                                 "(no chunk_prefill/mesh) in this version")
+            if spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if draft_cfg.kv_cache_dtype is not None:
+                raise ValueError("draft cache must be exact")
+            self.draft_cache = init_kv_cache(draft_cfg, slots, max_seq)
+            self._draft_prefill_by_bucket: Dict[int, Callable] = {}
+            self._draft_tick = _build_draft_tick(draft_cfg, spec_k)
+            self._verify = _build_verify_span(cfg)
         self._prefill_by_bucket: Dict[int, Callable] = {}
         self._tick = _build_decode_tick(cfg)
         # chunked prefill (opt-in): admission writes the prompt into the
@@ -306,6 +375,7 @@ class ServeEngine:
         # host-side slot state (numpy: the scheduler of this tiny world)
         self.pos = np.zeros(slots, dtype=np.int32)       # next write position
         self.next_tok = np.zeros(slots, dtype=np.int32)  # last sampled token
+        self.prev_tok = np.zeros(slots, dtype=np.int32)  # token at pos-1 (fed)
         self.req: List[Optional[Request]] = [None] * slots
         # per-slot prompt offset while chunk-prefilling; None = not prefilling
         self.prefill_off: List[Optional[int]] = [None] * slots
@@ -361,6 +431,21 @@ class ServeEngine:
         if len(req.prompt) > self.prompt_bucket:
             raise ValueError(
                 f"prompt len {len(req.prompt)} > bucket {self.prompt_bucket}")
+        if self.draft_params is not None:
+            if len(req.prompt) < 1:
+                raise ValueError("speculative serving needs a non-empty "
+                                 "prompt (the catch-up feed anchors on its "
+                                 "last token)")
+            if (len(req.prompt) + req.max_new_tokens + self.spec_k + 1
+                    > self.max_seq):
+                # the last round's verify span writes up to spec_k+1 rows
+                # past the final accepted position; without this headroom
+                # dynamic_update_slice CLAMPS the write and silently
+                # corrupts accepted rows (same guard speculative_generate
+                # sizes its cache with)
+                raise ValueError(
+                    "prompt + max_new_tokens + spec_k + 1 exceeds max_seq "
+                    "(speculative rounds overshoot by up to spec_k+1 rows)")
         prefix_len, entry = 0, None
         if req.prefix_id is not None:
             if self.chunk_prefill is None:
@@ -462,10 +547,25 @@ class ServeEngine:
             self.req[slot] = req
             self.slot_prefix[slot] = 0
             self.pos[slot] = true_len
+            self.prev_tok[slot] = int(req.prompt[-1]) if true_len else 0
             self.next_tok[slot] = tok
             self.generated[slot] = [int(tok)]
             self.admitted_at[slot] = self.tick_count
             self._maybe_finish(slot)
+            if self.draft_params is not None and self.req[slot] is not None:
+                # mirror the admission into the draft arena — AFTER the
+                # finish check: a request that completed at admission
+                # (max_new=1 / instant EOS) never reaches a speculative
+                # round, so its draft prefill would be pure waste (the
+                # next tenant's prefill overwrites the rows regardless)
+                dpre = self._draft_prefill_by_bucket.get(bucket)
+                if dpre is None:
+                    dpre = _build_prefill_slot(self.draft_cfg, bucket)
+                    self._draft_prefill_by_bucket[bucket] = dpre
+                self.draft_cache, _ = dpre(
+                    self.draft_params, self.draft_cache,
+                    jnp.asarray(padded), jnp.int32(slot),
+                    jnp.int32(true_len))
 
     def _advance_prefills(self) -> None:
         """One chunk of device work per PREFILLING slot per tick. The final
@@ -519,11 +619,69 @@ class ServeEngine:
         # the slot's cache rows stay as garbage — the next tenant's prefill
         # overwrites [0, prompt) and the causal cursor masks the rest
 
+    def _tick_speculative(self) -> int:
+        """One speculative round over the whole arena: the draft proposes
+        spec_k tokens per slot (one fused program), the target verifies
+        every slot in ONE span stream, acceptance is per-slot greedy on
+        the host. Emits between 1 and spec_k+1 tokens per active slot per
+        round — the plain tick's token stream, exactly, at a fraction of
+        the target weight streams."""
+        self._admit()
+        active = [s for s in range(self.slots) if self.req[s] is not None]
+        if not active:
+            self.tick_count += 1
+            return 0
+        k = self.spec_k
+        feed2 = np.stack([self.prev_tok, self.next_tok], axis=1)
+        pos = jnp.asarray(self.pos)
+        proposals, self.draft_cache = self._draft_tick(
+            self.draft_params, self.draft_cache, jnp.asarray(feed2), pos)
+        proposals = np.asarray(proposals)                 # (slots, k)
+        scored = np.concatenate([self.next_tok[:, None], proposals], axis=1)
+        t_arg, self.cache = self._verify(self.params, self.cache,
+                                         jnp.asarray(scored), pos)
+        t_arg = np.asarray(t_arg)                         # (slots, k+1)
+        self.tick_count += 1
+        self.spec_stats["rounds"] += 1
+        for s in active:
+            span = proposals[s]
+            n_ok = 0
+            while n_ok < k and int(span[n_ok]) == int(t_arg[s, n_ok]):
+                n_ok += 1
+            self.spec_stats["drafted"] += k
+            self.spec_stats["accepted"] += n_ok
+            emitted = [int(t) for t in span[:n_ok]] + [int(t_arg[s, n_ok])]
+            req = self.req[s]
+            finished = False
+            for tok in emitted:
+                self.generated[s].append(tok)
+                self.decode_tokens += 1
+                if (len(self.generated[s]) >= req.max_new_tokens
+                        or (req.eos_token is not None
+                            and tok == req.eos_token)):
+                    finished = True
+                    break
+            if finished:
+                # leftover span rows are garbage the next tenant's prefill
+                # and cursor overwrite before attending — the arena's
+                # standing invariant
+                self._maybe_finish(s)
+                continue
+            # cursors advance through ACCEPTED rows only; the newly
+            # emitted token (correction or bonus) is the next unfed token
+            self.prev_tok[s] = (int(span[n_ok - 1]) if n_ok >= 1
+                                else int(self.next_tok[s]))
+            self.next_tok[s] = emitted[-1]
+            self.pos[s] += n_ok + 1
+        return len(active)
+
     def tick(self) -> int:
         """One engine iteration: admit waiting requests into free slots,
         advance chunked prefills by one chunk each, then one fused decode
         step over the arena. Returns the number of ACTIVE (decoding) slots
         this tick (0 = fully idle)."""
+        if self.draft_params is not None:
+            return self._tick_speculative()
         self._admit()
         if self.chunk_prefill is not None:
             self._advance_prefills()

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import init_kv_cache, prefill, score_span
+from .decode import draft_rollout, init_kv_cache, prefill, score_span
 from .workload import ModelConfig, Params
 
 # module-level jitted wrappers with cfg STATIC: jit's cache keys on the
@@ -53,29 +53,10 @@ _span = jax.jit(score_span, static_argnames="cfg", donate_argnums=(1,))
 _prefill = jax.jit(prefill, static_argnames="cfg", donate_argnums=(1,))
 
 
-def _draft_propose(params: Params, cache: KVCache, feed: jax.Array, pos,
-                   cfg: ModelConfig, k: int) -> Tuple[jax.Array, KVCache]:
-    """The whole draft phase as ONE device program: ingest ``feed``
-    (1, p) at ``pos``, then scan k-1 further single-token steps — the k
-    proposals come back in a single host transfer instead of k blocking
-    argmax round-trips (a per-token sync costs the same order as a small
-    draft's forward; paying it k times per round would erode the very
-    latency the module exists to cut)."""
-    logits, cache = score_span(params, cache, feed, pos, cfg)
-    tok0 = jnp.argmax(logits[0, -1]).astype(jnp.int32)
-
-    def step(carry, _):
-        tok, cache, p = carry
-        logits, cache = score_span(params, cache, tok[None, None], p, cfg)
-        nxt = jnp.argmax(logits[0, -1]).astype(jnp.int32)
-        return (nxt, cache, p + 1), tok
-
-    (last, cache, _), toks = jax.lax.scan(
-        step, (tok0, cache, pos + feed.shape[1]), None, length=k - 1)
-    return jnp.concatenate([toks, last[None]]), cache
-
-
-_draft = jax.jit(_draft_propose, static_argnames=("cfg", "k"),
+# decode.draft_rollout is the single definition of the draft phase (one
+# ingest + lax.scan rollout, one host transfer); jitted here with cfg/k
+# static so repeated calls reuse the compiled program
+_draft = jax.jit(draft_rollout, static_argnames=("cfg", "k"),
                  donate_argnums=(1,))
 
 
@@ -130,7 +111,7 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
         span_dev, d_cache = _draft(draft_params, d_cache,
                                    jnp.asarray([feed], dtype=jnp.int32),
                                    jnp.int32(d_pos), cfg=draft_cfg, k=k)
-        span = [int(t) for t in np.asarray(span_dev)]   # ONE host transfer
+        span = [int(t) for t in np.asarray(span_dev)[0]]  # ONE host transfer
         drafted += k
         # 2) ONE target stream scores [last_emitted] + span (k+1 rows) at
         #    positions t_pos..t_pos+k; row i's argmax answers position
