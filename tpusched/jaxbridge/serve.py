@@ -503,6 +503,7 @@ class ServeEngine:
         self.completions.clear()
         self.tick_count = 0
         self.decode_tokens = 0
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
 
     # -- engine loop ----------------------------------------------------------
 
@@ -723,6 +724,9 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
                     *, slots: int = 8, max_seq: int = 1024,
                     prompt_bucket: "int | Tuple[int, ...]" = 128,
                     chunk_prefill: Optional[int] = None,
+                    draft_params: Optional[Params] = None,
+                    draft_cfg: Optional[ModelConfig] = None,
+                    spec_k: int = 4,
                     time_fn: Callable[[], float] = None) -> Dict[str, float]:
     """Throughput of the continuous engine vs the static-batch floor on the
     SAME request set. Static batching pads every generation to the
@@ -733,7 +737,9 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     time_fn = time_fn or _time.perf_counter
     eng = ServeEngine(params, cfg, slots=slots, max_seq=max_seq,
                       prompt_bucket=prompt_bucket,
-                      chunk_prefill=chunk_prefill)
+                      chunk_prefill=chunk_prefill,
+                      draft_params=draft_params, draft_cfg=draft_cfg,
+                      spec_k=spec_k)
     eng.warmup()              # compile outside the clock
     for r in requests:
         eng.submit(r)
@@ -754,7 +760,7 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     max_gap = state["max_gap"]
     total_tokens = sum(len(c.tokens) for c in completions)
     decode_ticks = max(1, eng.tick_count)
-    return {
+    out = {
         "tokens": float(total_tokens),
         "elapsed_s": elapsed,
         "tokens_per_s": total_tokens / max(elapsed, 1e-9),
@@ -762,3 +768,7 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
         "ticks": float(decode_ticks),
         "max_tick_gap_s": max_gap,
     }
+    if draft_params is not None:
+        out.update({f"spec_{k_}": float(v)
+                    for k_, v in eng.spec_stats.items()})
+    return out
