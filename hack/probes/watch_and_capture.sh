@@ -1,12 +1,12 @@
 #!/bin/bash
-# Round-4 TPU-evidence watcher: probe the axon tunnel every 4 min (bounded
+# Round-5 TPU-evidence watcher: probe the axon tunnel every 4 min (bounded
 # subprocess — a wedged claim hangs backend init indefinitely); the moment
 # it recovers, capture on-chip evidence serially: tests_tpu tier first,
 # then the full bench. ONE chip client at a time — two clients racing for
-# the single-chip claim is what orphaned it this morning.
-LOG=/root/repo/hack/tpu-probe-r4.log
-TIER=/root/repo/hack/probes/tpu_tier_r4.log
-BENCHLOG=/root/repo/hack/probes/bench_r4_onchip.log
+# the single-chip claim is what orphaned it in round 4.
+LOG=/root/repo/hack/tpu-probe-r5.log
+TIER=/root/repo/hack/probes/tpu_tier_r5.log
+BENCHLOG=/root/repo/hack/probes/bench_r5_onchip.log
 cd /root/repo || exit 1
 for i in $(seq 1 200); do
   ts=$(date -u +%H:%M:%S)
@@ -17,7 +17,7 @@ for i in $(seq 1 200); do
     # hardware + this round's additions): if the tunnel wedges mid-tier,
     # the marginal evidence is already on disk. -u + -v: every test
     # result line flushes to the log as it happens.
-    CRIT="moe or seq8192 or adamw or remat or vocab or serve or speculative"
+    CRIT="moe or seq8192 or adamw or remat or vocab or serve or speculative or decode or budget"
     echo "=== tests_tpu CRITICAL subset started $(date -u +%FT%TZ) ===" >> "$TIER"
     timeout --signal=INT --kill-after=60 3600 python -u -m pytest tests_tpu/ -v -k "$CRIT" >> "$TIER" 2>&1
     echo "critical rc=$? finished $(date -u +%FT%TZ)" >> "$TIER"

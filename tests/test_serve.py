@@ -560,6 +560,52 @@ def test_speculative_engine_rejects_arena_overrun(model):
                     prompt_bucket=16)
 
 
+def test_speculative_engine_rejects_impossible_warmup_geometry(model):
+    """A geometry the constructor accepts must be one warmup()/full-bucket
+    submits can use: bucket + spec_k + 3 > max_seq means no full-bucket
+    request could ever be admitted — refuse at construction, not at
+    warmup-time deep inside first use."""
+    import dataclasses
+    cfg, params = model
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(3), dcfg)
+    with pytest.raises(ValueError, match="speculative geometry"):
+        ServeEngine(params, cfg, slots=1, max_seq=24, prompt_bucket=16,
+                    draft_params=dp, draft_cfg=dcfg, spec_k=6)  # 16+6+3>24
+    # single-bucket boundary (16+6+3 == 25) compiles and warms up
+    eng = ServeEngine(params, cfg, slots=1, max_seq=25, prompt_bucket=16,
+                      draft_params=dp, draft_cfg=dcfg, spec_k=6)
+    eng.warmup()
+    # multi-bucket boundary: only the SMALLEST bucket warms with 2 new
+    # tokens, so the largest needs just spec_k+2 headroom — (8,16) at
+    # max_seq 24 is valid (8+6+3=17, 16+6+2=24) and must not be rejected
+    eng = ServeEngine(params, cfg, slots=1, max_seq=24, prompt_bucket=(8, 16),
+                      draft_params=dp, draft_cfg=dcfg, spec_k=6)
+    eng.warmup()
+
+
+def test_speculative_idle_slots_stay_finite(model):
+    """With fewer requests than slots, the never-used slots sit at pos=0;
+    the fused draft/verify programs must not compute a query row at
+    position -1 (all-masked softmax => NaN). Run with debug_nans armed so
+    any NaN in ANY batch row — active or idle — fails loudly."""
+    import dataclasses
+    cfg, params = model
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(4), dcfg)
+    eng = ServeEngine(params, cfg, slots=3, max_seq=64, prompt_bucket=16,
+                      draft_params=dp, draft_cfg=dcfg, spec_k=3)
+    rng = np.random.default_rng(5)
+    eng.submit(Request(rid=0, prompt=_prompt(rng, 4, 10, cfg.vocab),
+                       max_new_tokens=6))       # 1 request, 3 slots
+    jax.config.update("jax_debug_nans", True)
+    try:
+        done = eng.run_until_drained()
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    assert len(done) == 1 and len(done[0].tokens) == 6
+
+
 def test_sampled_engine_is_deterministic_and_bounded(model):
     """Non-greedy serving (temperature/top-k/top-p): no solo-parity
     contract exists (RNG consumption differs by construction), but the

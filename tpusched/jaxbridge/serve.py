@@ -337,6 +337,21 @@ class ServeEngine:
                                  "(no chunk_prefill/mesh) in this version")
             if spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
+            # speculative admission needs prompt + max_new + spec_k + 1
+            # <= max_seq (verify overshoots by up to spec_k+1 rows), and
+            # warmup() submits every bucket full-length — the smallest
+            # with 2 new tokens, the rest with 1. Surface an impossible
+            # geometry here with the knobs named, not as a
+            # warmup()/submit()-time failure deep inside first use.
+            if (buckets[0] + spec_k + 3 > max_seq
+                    or buckets[-1] + spec_k + 2 > max_seq):
+                raise ValueError(
+                    f"speculative geometry: prompt buckets {buckets} with "
+                    f"spec_k {spec_k} leave no room under max_seq "
+                    f"{max_seq} (need smallest bucket + spec_k + 3 and "
+                    f"largest bucket + spec_k + 2 within the arena); "
+                    f"warmup and full-bucket requests could never be "
+                    f"admitted")
             if draft_cfg.kv_cache_dtype is not None:
                 raise ValueError("draft cache must be exact")
             self.draft_cache = init_kv_cache(draft_cfg, slots, max_seq)
@@ -634,7 +649,14 @@ class ServeEngine:
             return 0
         k = self.spec_k
         feed2 = np.stack([self.prev_tok, self.next_tok], axis=1)
-        pos = jnp.asarray(self.pos)
+        # never-used slots sit at pos=0; feeding them through the fused
+        # draft/verify programs would place a query row at position -1 —
+        # fully causally masked, softmax over all NEG_INF, NaN (poison
+        # under jax_debug_nans) plus a clamped negative-index cache write.
+        # Clamp the DEVICE-side positions to 1 so idle rows compute
+        # harmless garbage at rows 0/1; active slots always have pos >= 1
+        # so their math is untouched, and self.pos itself is not altered.
+        pos = jnp.asarray(np.maximum(self.pos, 1))
         proposals, self.draft_cache = self._draft_tick(
             self.draft_params, self.draft_cache, jnp.asarray(feed2), pos)
         proposals = np.asarray(proposals)                 # (slots, k)
@@ -751,6 +773,13 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     state = {"last": t0, "max_gap": 0.0}
 
     def stamp():
+        # a tick in which EVERY slot is chunk-prefilling dispatches the
+        # chunk program asynchronously and returns with no host sync, so
+        # its device time would be charged to the next tick that samples;
+        # block on the cache so each tick pays for its own dispatch. After
+        # a decode tick the program already completed (sampling synced),
+        # so this is free outside the all-prefilling regime.
+        jax.block_until_ready(eng.cache)
         now = time_fn()
         state["max_gap"] = max(state["max_gap"], now - state["last"])
         state["last"] = now
