@@ -117,6 +117,30 @@ def test_set_capacity_dryrun_denies_before_reserving():
                    for p in all_pods)
 
 
+def test_hard_domain_oversized_set_denied_in_one_cycle():
+    """The module-doc footgun, mitigated: under hard same-domain policy a
+    set whose summed request exceeds every single DCN domain (though not
+    the fleet: 4x64 chips requested, 4 domains of 64 each) is denied at
+    PreFilter in one cycle — no reservations, and nowhere near the 60 s
+    set timeout."""
+    with TestCluster(profile=atomic_profile(hard="same-domain",
+                                            set_wait_s=60,
+                                            denied_set_s=60)) as c:
+        for i in range(4):
+            add_pool(c, f"pool-{i}", f"zoneA/rack{i}")
+        all_pods = []
+        for idx in range(4):
+            all_pods += slice_pg(c, "wide", idx, set_size=4,
+                                 min_resources={TPU: 64})
+        ms = c.scheduler._fw.plugins["MultiSlice"]
+        assert wait_until(lambda: "default/wide" in ms._denied_sets,
+                          timeout=10), "set not denied by the dry-run"
+        assert c.wait_for_pods_unscheduled([p.key for p in all_pods],
+                                           hold=1.0)
+        assert all(POOL_ANNOTATION not in c.pod(p.key).meta.annotations
+                   for p in all_pods)
+
+
 def test_torn_down_set_recovers_when_capacity_appears():
     """After a teardown, the denied-set window expires and the set admits
     once a 4th pool exists (Node add events requeue the members)."""

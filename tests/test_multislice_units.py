@@ -156,3 +156,33 @@ def test_unassigned_siblings_do_not_attract():
     pod = make_pod("p", pod_group=f"{SET}-slice-1", limits={TPU: 4})
     _, st = run_pre_score(ms, pod)
     assert st.is_skip()
+
+
+def test_set_capacity_gap_is_domain_wise_under_hard_policy():
+    """Hard same-domain turns the set dry-run domain-wise: a request that
+    fits the FLEET but no single domain is a gap (the module-doc footgun —
+    without this the set burns its full timeout); unlabeled nodes count
+    with every candidate domain since the hard Filter never excludes
+    them."""
+    nodes = ([domain_node(f"a{i}", "zoneA/rack0") for i in range(2)]
+             + [domain_node(f"b{i}", "zoneA/rack1") for i in range(2)])
+    fw, ms, handle, api = ms_framework(
+        args=MultiSliceArgs(hard_domain_policy="same-domain"), nodes=nodes)
+    infos = handle.snapshot_shared_lister().list()
+    assert ms._set_capacity_gap(infos, {TPU: 8}, frozenset()) is None
+    gap = ms._set_capacity_gap(infos, {TPU: 12}, frozenset())
+    assert gap and "no single DCN domain" in gap
+    # soft mode keeps the fleet-wide semantics
+    fw2, ms2, _, _ = ms_framework(nodes=nodes)
+    assert ms2._set_capacity_gap(infos, {TPU: 12}, frozenset()) is None
+    # same-zone groups merge the two racks: 16 chips in one zone
+    fw3, ms3, _, _ = ms_framework(
+        args=MultiSliceArgs(hard_domain_policy="same-zone"), nodes=nodes)
+    assert ms3._set_capacity_gap(infos, {TPU: 12}, frozenset()) is None
+    # unlabeled spill is usable alongside any single domain
+    nodes4 = nodes + [make_tpu_node("u0", chips=4)]
+    fw4, ms4, handle4, _ = ms_framework(
+        args=MultiSliceArgs(hard_domain_policy="same-domain"), nodes=nodes4)
+    infos4 = handle4.snapshot_shared_lister().list()
+    assert ms4._set_capacity_gap(infos4, {TPU: 12}, frozenset()) is None
+    assert ms4._set_capacity_gap(infos4, {TPU: 14}, frozenset()) is not None

@@ -46,9 +46,10 @@ set size:
 ``same-zone`` turn the scoring preference into a Filter-level gate: once
 any sibling slice is placed (assumed or bound), nodes outside its DCN
 domain/zone are Unschedulable for later slices. The first slice is
-unconstrained — operators pairing this with atomic admission should size
-domains so a whole set fits one domain, or the set will burn a timeout
-discovering it cannot.
+unconstrained. When paired with set-level atomic admission, the capacity
+dry-run becomes domain-wise: a set that no single DCN domain/zone (plus
+unlabeled nodes) can hold is denied in ONE cycle — it does not burn the
+set timeout discovering the fleet-wide headroom cannot be used together.
 """
 from __future__ import annotations
 
@@ -248,7 +249,7 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             total[PODS] = total.get(PODS, 0) + g.spec.min_member
         nodes = self.handle.snapshot_shared_lister().list()
         member_keys = frozenset(f"{namespace}/{g.meta.name}" for g in members)
-        err = check_cluster_resource(nodes, total, member_keys)
+        err = self._set_capacity_gap(nodes, total, member_keys)
         if err:
             self._deny_set(set_key, namespace, set_name,
                            f"set capacity dry-run failed: {err}")
@@ -257,6 +258,43 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             ).with_retry_after(self._denied_sets.remaining(set_key) + 0.05)
         self._permitted_sets.set(set_key)
         return None
+
+    def _set_capacity_gap(self, nodes, total, member_keys) -> Optional[str]:
+        """Fleet-wide aggregate dry-run — or, under a hard DCN policy, the
+        stricter per-domain form: the whole set must fit inside ONE
+        domain (same-domain) / zone (same-zone). Unlabeled nodes count
+        with every candidate — the hard Filter never excludes them, since
+        only labeled sibling hosts ever constrain a later slice — so a
+        set spanning one domain plus unlabeled spill is still admitted.
+        Without this, a set larger than every domain passes the fleet-wide
+        dry-run and burns a full set timeout discovering the headroom
+        cannot be used together."""
+        policy = self.args.hard_domain_policy
+        if policy not in (HARD_SAME_DOMAIN, HARD_SAME_ZONE):
+            return check_cluster_resource(nodes, total, member_keys)
+
+        def group_of(info) -> str:
+            d = info.node.meta.labels.get(LABEL_DCN_DOMAIN, "")
+            return d if policy == HARD_SAME_DOMAIN else d.split("/")[0]
+
+        labeled: dict = {}
+        unlabeled = []
+        for info in nodes:
+            if info is None or info.node is None:
+                continue
+            k = group_of(info)
+            (labeled.setdefault(k, []) if k else unlabeled).append(info)
+        if not labeled:
+            return check_cluster_resource(unlabeled, total, member_keys)
+        gaps = []
+        for k in sorted(labeled):
+            err = check_cluster_resource(labeled[k] + unlabeled, total,
+                                         member_keys)
+            if err is None:
+                return None
+            gaps.append(f"{k}: {err}")
+        kind = "domain" if policy == HARD_SAME_DOMAIN else "zone"
+        return f"no single DCN {kind} can hold the set ({'; '.join(gaps)})"
 
     # -- Filter: hard DCN constraint ------------------------------------------
 
