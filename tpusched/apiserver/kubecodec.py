@@ -1,0 +1,504 @@
+"""Kubernetes JSON ↔ tpusched dataclass codec.
+
+The hermetic control plane stores plain dataclasses; a real kube-apiserver
+speaks the wire shapes published in ``manifests/crds/`` (camelCase fields,
+quantity strings, RFC3339 timestamps). This module is the total mapping
+between the two for every kind the framework consumes — the hand-written
+equivalent of the reference's generated deepcopy/conversion functions
+(/root/reference/apis/scheduling/v1alpha1/zz_generated.deepcopy.go) plus
+client-go's serializers.
+
+Lossiness discipline: decoding a real cluster's Pod drops fields this
+framework does not model (volumes, env, probes...). Writers must therefore
+never PUT a re-encoded Pod wholesale — ``kube.KubeAPIServer`` turns every
+update into an RFC 7386 merge-patch computed between two *encoded* forms,
+so untouched (including unmodeled) fields are never sent. ``merge_patch``
+below is that diff.
+
+resourceVersion: kube's is an opaque string; ours is an int. etcd mints
+decimal uint64 strings, so ``int(rv)`` is faithful against any real
+apiserver; a non-numeric RV (some aggregated API) decodes as 0 and relies
+on server-side conflict checks alone.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..api.core import (Binding, Container, Node, NodeSpec, NodeStatus, Pod,
+                        PodCondition, PodDisruptionBudget, PodSpec, PodStatus,
+                        PriorityClass, Taint, Toleration)
+from ..api.meta import ObjectMeta, OwnerReference
+from ..api.resources import CPU, ResourceList, parse_quantity
+from ..api.scheduling import (ElasticQuota, ElasticQuotaSpec,
+                              ElasticQuotaStatus, PodGroup, PodGroupSpec,
+                              PodGroupStatus)
+from ..api.topology import TpuTopology, TpuTopologySpec
+from . import server as srv
+
+# -- quantities ---------------------------------------------------------------
+
+
+def format_quantity(resource: str, value: int) -> str:
+    """Canonical int units → kube quantity string (cpu millicores → '250m',
+    everything else plain base-unit integers — valid quantities kube
+    normalizes server-side)."""
+    if resource == CPU:
+        return f"{int(value)}m"
+    return str(int(value))
+
+
+def encode_resources(r: Optional[ResourceList]) -> Optional[Dict[str, str]]:
+    if r is None:
+        return None
+    return {k: format_quantity(k, v) for k, v in r.items()}
+
+
+def decode_resources(r: Optional[Dict[str, Any]]) -> ResourceList:
+    if not r:
+        return {}
+    return {k: parse_quantity(v, k) for k, v in r.items()}
+
+
+# -- timestamps ---------------------------------------------------------------
+
+def encode_time(t: Optional[float], micro: bool = False) -> Optional[str]:
+    if t is None or not t:
+        return None
+    dt = _dt.datetime.fromtimestamp(float(t), _dt.timezone.utc)
+    if micro:
+        return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def decode_time(s: Optional[str]) -> Optional[float]:
+    if not s:
+        return None
+    txt = s.rstrip("Z")
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S"):
+        try:
+            return _dt.datetime.strptime(txt, fmt).replace(
+                tzinfo=_dt.timezone.utc).timestamp()
+        except ValueError:
+            continue
+    return None
+
+
+def decode_rv(rv: Any) -> int:
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return 0
+
+
+# -- metadata -----------------------------------------------------------------
+
+def encode_meta(meta: ObjectMeta, namespaced: bool) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": meta.name}
+    if namespaced:
+        out["namespace"] = meta.namespace
+    if meta.labels:
+        out["labels"] = dict(meta.labels)
+    if meta.annotations:
+        out["annotations"] = dict(meta.annotations)
+    if meta.resource_version:
+        out["resourceVersion"] = str(meta.resource_version)
+    ct = encode_time(meta.creation_timestamp)
+    if ct:
+        out["creationTimestamp"] = ct
+    dt = encode_time(meta.deletion_timestamp)
+    if dt:
+        out["deletionTimestamp"] = dt
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {"apiVersion": o.api_version, "kind": o.kind, "name": o.name,
+             "uid": o.uid, "controller": o.controller}
+            for o in meta.owner_references]
+    return out
+
+
+def decode_meta(m: Dict[str, Any], namespaced: bool) -> ObjectMeta:
+    meta = ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", "default") if namespaced else "",
+        labels=dict(m.get("labels") or {}),
+        annotations=dict(m.get("annotations") or {}),
+        resource_version=decode_rv(m.get("resourceVersion")),
+        creation_timestamp=decode_time(m.get("creationTimestamp")) or 0.0,
+        deletion_timestamp=decode_time(m.get("deletionTimestamp")),
+        owner_references=[OwnerReference(
+            api_version=o.get("apiVersion", ""), kind=o.get("kind", ""),
+            name=o.get("name", ""), uid=str(o.get("uid", "")),
+            controller=bool(o.get("controller", False)))
+            for o in m.get("ownerReferences") or []])
+    uid = m.get("uid")
+    if uid:
+        meta.uid = str(uid)
+    return meta
+
+
+# -- Pod ----------------------------------------------------------------------
+
+def _encode_container(c: Container) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": c.name}
+    if c.image:
+        out["image"] = c.image
+    res: Dict[str, Any] = {}
+    if c.requests:
+        res["requests"] = encode_resources(c.requests)
+    if c.limits:
+        res["limits"] = encode_resources(c.limits)
+    if res:
+        out["resources"] = res
+    return out
+
+
+def _decode_container(c: Dict[str, Any]) -> Container:
+    res = c.get("resources") or {}
+    return Container(name=c.get("name", "main"), image=c.get("image", ""),
+                     requests=decode_resources(res.get("requests")),
+                     limits=decode_resources(res.get("limits")))
+
+
+def encode_pod(p: Pod) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "containers": [_encode_container(c) for c in p.spec.containers],
+        "schedulerName": p.spec.scheduler_name,
+    }
+    if p.spec.init_containers:
+        spec["initContainers"] = [_encode_container(c)
+                                  for c in p.spec.init_containers]
+    if p.spec.node_name:
+        spec["nodeName"] = p.spec.node_name
+    if p.spec.node_selector:
+        spec["nodeSelector"] = dict(p.spec.node_selector)
+    if p.spec.priority:
+        spec["priority"] = p.spec.priority
+    if p.spec.priority_class_name:
+        spec["priorityClassName"] = p.spec.priority_class_name
+    if p.spec.tolerations:
+        spec["tolerations"] = [
+            {k: v for k, v in (("key", t.key), ("operator", t.operator),
+                               ("value", t.value), ("effect", t.effect)) if v}
+            for t in p.spec.tolerations]
+    if p.spec.overhead:
+        spec["overhead"] = encode_resources(p.spec.overhead)
+    status: Dict[str, Any] = {"phase": p.status.phase}
+    if p.status.nominated_node_name:
+        status["nominatedNodeName"] = p.status.nominated_node_name
+    if p.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status, "reason": c.reason,
+             "message": c.message,
+             "lastTransitionTime": encode_time(c.last_transition_time)}
+            for c in p.status.conditions]
+    if p.status.start_time is not None:
+        status["startTime"] = encode_time(p.status.start_time)
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": encode_meta(p.meta, True),
+            "spec": spec, "status": status}
+
+
+def decode_pod(d: Dict[str, Any]) -> Pod:
+    s = d.get("spec") or {}
+    st = d.get("status") or {}
+    return Pod(
+        meta=decode_meta(d.get("metadata") or {}, True),
+        spec=PodSpec(
+            containers=[_decode_container(c)
+                        for c in s.get("containers") or []],
+            init_containers=[_decode_container(c)
+                             for c in s.get("initContainers") or []],
+            node_name=s.get("nodeName", ""),
+            node_selector=dict(s.get("nodeSelector") or {}),
+            scheduler_name=s.get("schedulerName", "default-scheduler"),
+            priority=int(s.get("priority") or 0),
+            priority_class_name=s.get("priorityClassName", ""),
+            tolerations=[Toleration(key=t.get("key", ""),
+                                    operator=t.get("operator", "Equal"),
+                                    value=t.get("value", ""),
+                                    effect=t.get("effect", ""))
+                         for t in s.get("tolerations") or []],
+            overhead=decode_resources(s.get("overhead"))),
+        status=PodStatus(
+            phase=st.get("phase", "Pending"),
+            nominated_node_name=st.get("nominatedNodeName", ""),
+            conditions=[PodCondition(
+                type=c.get("type", ""), status=c.get("status", "True"),
+                reason=c.get("reason", ""), message=c.get("message", ""),
+                last_transition_time=decode_time(
+                    c.get("lastTransitionTime")) or 0.0)
+                for c in st.get("conditions") or []],
+            start_time=decode_time(st.get("startTime"))))
+
+
+# -- Node ---------------------------------------------------------------------
+
+def encode_node(n: Node) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if n.spec.unschedulable:
+        spec["unschedulable"] = True
+    if n.spec.taints:
+        spec["taints"] = [{"key": t.key, "value": t.value, "effect": t.effect}
+                          for t in n.spec.taints]
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": encode_meta(n.meta, False),
+            "spec": spec,
+            "status": {"capacity": encode_resources(n.status.capacity) or {},
+                       "allocatable":
+                           encode_resources(n.status.allocatable) or {}}}
+
+
+def decode_node(d: Dict[str, Any]) -> Node:
+    s = d.get("spec") or {}
+    st = d.get("status") or {}
+    return Node(
+        meta=decode_meta(d.get("metadata") or {}, False),
+        spec=NodeSpec(
+            unschedulable=bool(s.get("unschedulable", False)),
+            taints=[Taint(key=t.get("key", ""), value=t.get("value", ""),
+                          effect=t.get("effect", "NoSchedule"))
+                    for t in s.get("taints") or []]),
+        status=NodeStatus(capacity=decode_resources(st.get("capacity")),
+                          allocatable=decode_resources(st.get("allocatable"))))
+
+
+# -- PodGroup -----------------------------------------------------------------
+
+def encode_podgroup(pg: PodGroup) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"minMember": pg.spec.min_member}
+    if pg.spec.min_resources is not None:
+        spec["minResources"] = encode_resources(pg.spec.min_resources)
+    if pg.spec.schedule_timeout_seconds is not None:
+        spec["scheduleTimeoutSeconds"] = pg.spec.schedule_timeout_seconds
+    if pg.spec.tpu_slice_shape:
+        spec["tpuSliceShape"] = pg.spec.tpu_slice_shape
+    if pg.spec.tpu_accelerator:
+        spec["tpuAccelerator"] = pg.spec.tpu_accelerator
+    if pg.spec.multislice_set:
+        spec["multisliceSet"] = pg.spec.multislice_set
+        spec["multisliceIndex"] = pg.spec.multislice_index
+    if pg.spec.multislice_set_size:
+        spec["multisliceSetSize"] = pg.spec.multislice_set_size
+    status: Dict[str, Any] = {
+        "phase": pg.status.phase, "occupiedBy": pg.status.occupied_by,
+        "scheduled": pg.status.scheduled, "running": pg.status.running,
+        "succeeded": pg.status.succeeded, "failed": pg.status.failed}
+    sst = encode_time(pg.status.schedule_start_time)
+    if sst:
+        status["scheduleStartTime"] = sst
+    return {"apiVersion": "scheduling.tpu.dev/v1alpha1", "kind": "PodGroup",
+            "metadata": encode_meta(pg.meta, True),
+            "spec": spec, "status": status}
+
+
+def decode_podgroup(d: Dict[str, Any]) -> PodGroup:
+    s = d.get("spec") or {}
+    st = d.get("status") or {}
+    min_res = s.get("minResources")
+    return PodGroup(
+        meta=decode_meta(d.get("metadata") or {}, True),
+        spec=PodGroupSpec(
+            min_member=int(s.get("minMember") or 0),
+            min_resources=(decode_resources(min_res)
+                           if min_res is not None else None),
+            schedule_timeout_seconds=s.get("scheduleTimeoutSeconds"),
+            tpu_slice_shape=s.get("tpuSliceShape", ""),
+            tpu_accelerator=s.get("tpuAccelerator", ""),
+            multislice_set=s.get("multisliceSet", ""),
+            multislice_index=int(s.get("multisliceIndex") or 0),
+            multislice_set_size=int(s.get("multisliceSetSize") or 0)),
+        status=PodGroupStatus(
+            phase=st.get("phase", ""),
+            occupied_by=st.get("occupiedBy", ""),
+            scheduled=int(st.get("scheduled") or 0),
+            running=int(st.get("running") or 0),
+            succeeded=int(st.get("succeeded") or 0),
+            failed=int(st.get("failed") or 0),
+            schedule_start_time=decode_time(st.get("scheduleStartTime"))))
+
+
+# -- ElasticQuota -------------------------------------------------------------
+
+def encode_elasticquota(eq: ElasticQuota) -> Dict[str, Any]:
+    return {"apiVersion": "scheduling.tpu.dev/v1alpha1",
+            "kind": "ElasticQuota",
+            "metadata": encode_meta(eq.meta, True),
+            "spec": {"min": encode_resources(eq.spec.min) or {},
+                     "max": encode_resources(eq.spec.max) or {}},
+            "status": {"used": encode_resources(eq.status.used) or {}}}
+
+
+def decode_elasticquota(d: Dict[str, Any]) -> ElasticQuota:
+    s = d.get("spec") or {}
+    st = d.get("status") or {}
+    return ElasticQuota(
+        meta=decode_meta(d.get("metadata") or {}, True),
+        spec=ElasticQuotaSpec(min=decode_resources(s.get("min")),
+                              max=decode_resources(s.get("max"))),
+        status=ElasticQuotaStatus(used=decode_resources(st.get("used"))))
+
+
+# -- PriorityClass ------------------------------------------------------------
+
+def encode_priorityclass(pc: PriorityClass) -> Dict[str, Any]:
+    return {"apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+            "metadata": encode_meta(pc.meta, False),
+            "value": pc.value, "preemptionPolicy": pc.preemption_policy}
+
+
+def decode_priorityclass(d: Dict[str, Any]) -> PriorityClass:
+    return PriorityClass(
+        meta=decode_meta(d.get("metadata") or {}, False),
+        value=int(d.get("value") or 0),
+        preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"))
+
+
+# -- PodDisruptionBudget ------------------------------------------------------
+
+def encode_pdb(pdb: PodDisruptionBudget) -> Dict[str, Any]:
+    return {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": encode_meta(pdb.meta, True),
+            "spec": {"selector": {"matchLabels": dict(pdb.selector)}},
+            "status": {"disruptionsAllowed": pdb.disruptions_allowed}}
+
+
+def decode_pdb(d: Dict[str, Any]) -> PodDisruptionBudget:
+    sel = ((d.get("spec") or {}).get("selector") or {})
+    return PodDisruptionBudget(
+        meta=decode_meta(d.get("metadata") or {}, True),
+        selector=dict(sel.get("matchLabels") or {}),
+        disruptions_allowed=int(
+            (d.get("status") or {}).get("disruptionsAllowed") or 0))
+
+
+# -- TpuTopology --------------------------------------------------------------
+
+def encode_tputopology(t: TpuTopology) -> Dict[str, Any]:
+    return {"apiVersion": "topology.tpu.dev/v1alpha1", "kind": "TpuTopology",
+            "metadata": encode_meta(t.meta, False),
+            "spec": {"pool": t.spec.pool,
+                     "accelerator": t.spec.accelerator,
+                     "dims": list(t.spec.dims),
+                     "wrap": list(t.spec.wrap),
+                     "hosts": {h: list(c) for h, c in t.spec.hosts.items()},
+                     "chipsPerHost": t.spec.chips_per_host,
+                     "dcnDomain": t.spec.dcn_domain}}
+
+
+def decode_tputopology(d: Dict[str, Any]) -> TpuTopology:
+    s = d.get("spec") or {}
+    return TpuTopology(
+        meta=decode_meta(d.get("metadata") or {}, False),
+        spec=TpuTopologySpec(
+            pool=s.get("pool", ""),
+            accelerator=s.get("accelerator", "tpu-v5p"),
+            dims=tuple(int(x) for x in s.get("dims") or ()),
+            wrap=tuple(bool(x) for x in s.get("wrap") or ()),
+            hosts={h: tuple(int(x) for x in c)
+                   for h, c in (s.get("hosts") or {}).items()},
+            chips_per_host=int(s.get("chipsPerHost") or 4),
+            dcn_domain=s.get("dcnDomain", "")))
+
+
+# -- Binding / Event payloads (request bodies, not stored kinds) --------------
+
+def encode_binding(b: Binding) -> Dict[str, Any]:
+    """The pods/binding POST body. Annotations ride the Binding's metadata —
+    the apiserver merges them into the pod on bind, the contract the
+    reference's FlexGPU Bind relies on
+    (/root/reference/pkg/flexgpu/flex_gpu.go:230-242)."""
+    ns, name = b.pod_key.split("/", 1)
+    meta: Dict[str, Any] = {"name": name, "namespace": ns}
+    if b.annotations:
+        meta["annotations"] = dict(b.annotations)
+    return {"apiVersion": "v1", "kind": "Binding", "metadata": meta,
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": b.node_name}}
+
+
+# -- kind registry ------------------------------------------------------------
+
+class KindInfo:
+    def __init__(self, kind: str, api_version: str, k8s_kind: str,
+                 plural: str, namespaced: bool,
+                 encode: Callable[[Any], Dict[str, Any]],
+                 decode: Callable[[Dict[str, Any]], Any]):
+        self.kind = kind
+        self.api_version = api_version
+        self.k8s_kind = k8s_kind
+        self.plural = plural
+        self.namespaced = namespaced
+        self.encode = encode
+        self.decode = decode
+
+    def collection_path(self, namespace: Optional[str] = None) -> str:
+        base = ("/api/v1" if self.api_version == "v1"
+                else f"/apis/{self.api_version}")
+        if self.namespaced and namespace is not None:
+            return f"{base}/namespaces/{namespace}/{self.plural}"
+        return f"{base}/{self.plural}"
+
+    def object_path(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        if self.namespaced:
+            return f"{self.collection_path(ns or 'default')}/{name}"
+        return f"{self.collection_path()}/{name or ns}"
+
+
+KINDS: Dict[str, KindInfo] = {k.kind: k for k in (
+    KindInfo(srv.PODS, "v1", "Pod", "pods", True, encode_pod, decode_pod),
+    KindInfo(srv.NODES, "v1", "Node", "nodes", False,
+             encode_node, decode_node),
+    KindInfo(srv.POD_GROUPS, "scheduling.tpu.dev/v1alpha1", "PodGroup",
+             "podgroups", True, encode_podgroup, decode_podgroup),
+    KindInfo(srv.ELASTIC_QUOTAS, "scheduling.tpu.dev/v1alpha1",
+             "ElasticQuota", "elasticquotas", True,
+             encode_elasticquota, decode_elasticquota),
+    KindInfo(srv.PRIORITY_CLASSES, "scheduling.k8s.io/v1", "PriorityClass",
+             "priorityclasses", False,
+             encode_priorityclass, decode_priorityclass),
+    KindInfo(srv.PDBS, "policy/v1", "PodDisruptionBudget",
+             "poddisruptionbudgets", True, encode_pdb, decode_pdb),
+    KindInfo(srv.TPU_TOPOLOGIES, "topology.tpu.dev/v1alpha1", "TpuTopology",
+             "tputopologies", False,
+             encode_tputopology, decode_tputopology),
+)}
+
+
+# -- merge patch --------------------------------------------------------------
+
+def merge_patch(old: Dict[str, Any], new: Dict[str, Any]) -> Dict[str, Any]:
+    """RFC 7386 merge patch turning ``old`` into ``new`` (both JSON
+    objects). Empty result = nothing changed. Lists are replaced wholesale
+    — merge-patch semantics, which matches how this framework writes
+    list-valued fields (conditions, tolerations: full-value updates)."""
+    patch: Dict[str, Any] = {}
+    for k, v in new.items():
+        if k not in old:
+            patch[k] = v
+        elif isinstance(old[k], dict) and isinstance(v, dict):
+            sub = merge_patch(old[k], v)
+            if sub:
+                patch[k] = sub
+        elif old[k] != v:
+            patch[k] = v
+    for k in old:
+        if k not in new:
+            patch[k] = None
+    return patch
+
+
+def apply_merge_patch(doc: Any, patch: Any) -> Any:
+    """RFC 7386 apply (the server half; the fake apiserver uses it)."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(doc, dict):
+        doc = {}
+    out = dict(doc)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = apply_merge_patch(out.get(k), v)
+    return out

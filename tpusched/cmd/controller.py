@@ -4,8 +4,9 @@ Analog of /root/reference/cmd/controller (controller.go:30 → app/server.go:55)
 runs the PodGroup phase controller and the ElasticQuota usage controller with
 optional leader election. Flags mirror ServerRunOptions
 (cmd/controller/app/options.go:39-47): --qps --burst --workers
---enable-leader-election (the kubeconfig/in-cluster pair is meaningless
-against the in-process server and intentionally absent).
+--enable-leader-election, plus the reference's kubeconfig pair
+(options.go:41-42): ``--kubeconfig PATH|in-cluster`` reconciles against a
+real Kubernetes API server instead of the in-process one.
 """
 from __future__ import annotations
 
@@ -24,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpusched-controller",
         description="PodGroup + ElasticQuota controller manager")
+    p.add_argument("--kubeconfig", default=None, metavar="PATH|in-cluster",
+                   help="reconcile against a real Kubernetes API server "
+                        "(options.go:41-42): a kubeconfig path, or "
+                        "'in-cluster' for the service-account mount")
     p.add_argument("--qps", type=float, default=5.0,
                    help="API budget: queries per second (options.go:43)")
     p.add_argument("--burst", type=int, default=10,
@@ -68,7 +73,14 @@ def options_from_args(args) -> ServerRunOptions:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     klog.set_verbosity(args.verbosity)
-    api = APIServer()
+    kube_api = None
+    if args.kubeconfig:
+        from ..apiserver import kube
+        klog.info_s("connecting to external apiserver",
+                    kubeconfig=args.kubeconfig)
+        kube_api = kube.KubeAPIServer(
+            kube.load_connection(args.kubeconfig)).start()
+    api = kube_api if kube_api is not None else APIServer()
     runner = ControllerRunner(api, options_from_args(args))
 
     metrics_server = None
@@ -93,6 +105,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         runner.stop()
         if metrics_server is not None:
             metrics_server.stop()
+        if kube_api is not None:
+            kube_api.stop()
     return 0
 
 

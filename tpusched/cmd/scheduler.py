@@ -36,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpusched-scheduler",
         description="TPU-native scheduler (gang, quota, ICI-topology, load-aware)")
     p.add_argument("--config", help="versioned TpuSchedulerConfiguration YAML")
+    p.add_argument("--kubeconfig", default=None, metavar="PATH|in-cluster",
+                   help="run against a real Kubernetes API server (the "
+                        "reference's deployment contract): a kubeconfig "
+                        "path, or 'in-cluster' for the service-account "
+                        "mount. Mutually exclusive with --state-dir and "
+                        "--emulate-pool — etcd owns durability and nodes "
+                        "come from the cluster")
     p.add_argument("--profile", default="tpu-gang",
                    choices=sorted(CANNED_PROFILES),
                    help="canned profile when --config is not given")
@@ -119,32 +126,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
 
-    # leaderElection: from the decoded config (scheduler-config.yaml:3-4 in
-    # the reference manifests). Meaningful only with shared state: the lease
-    # lives in --state-dir next to the WAL it arbitrates (sched/ha.py).
-    le = None
     cfg = versioned.load_file(args.config) if args.config else None
+
+    # external apiserver mode: same plugin suite, transport swapped — the
+    # reference's deployment contract (main.go:34-47 hosts the plugins in
+    # the real kube-scheduler against a real apiserver)
+    kube_api = None
+    if args.kubeconfig and not args.validate_only:
+        if args.state_dir or args.state_fsync:
+            klog.error_s(None, "--kubeconfig and --state-dir are mutually "
+                         "exclusive: etcd owns durability in kube mode")
+            return 1
+        if args.emulate_pool:
+            klog.error_s(None, "--kubeconfig and --emulate-pool are "
+                         "mutually exclusive: nodes come from the cluster")
+            return 1
+        from ..apiserver import kube
+        klog.info_s("connecting to external apiserver",
+                    kubeconfig=args.kubeconfig)
+        kube_api = kube.KubeAPIServer(
+            kube.load_connection(args.kubeconfig)).start()
+
+    # leaderElection: from the decoded config (scheduler-config.yaml:3-4 in
+    # the reference manifests). Hermetic mode arbitrates the WAL via a file
+    # lease in --state-dir (sched/ha.py); kube mode uses a
+    # coordination.k8s.io Lease — the reference's resourcelock.
+    le = None
     if cfg is not None:
         le_cfg = cfg.leader_election
         if le_cfg.leader_elect and not args.validate_only:
-            if not args.state_dir:
-                klog.error_s(None, "leaderElection.leaderElect requires "
-                             "--state-dir (the lease arbitrates the WAL)")
-                return 1
             import uuid as _uuid
             from ..sched import ha
             identity = f"scheduler-{_uuid.uuid4().hex[:8]}"
-            le = (ha.FileLease(args.state_dir), identity,
+            if kube_api is not None:
+                from ..apiserver import kube
+                lease_obj = kube.KubeLease(kube_api)
+            elif args.state_dir:
+                lease_obj = ha.FileLease(args.state_dir)
+            else:
+                klog.error_s(None, "leaderElection.leaderElect requires "
+                             "--state-dir (the lease arbitrates the WAL) "
+                             "or --kubeconfig (a coordination Lease)")
+                return 1
+            le = (lease_obj, identity,
                   le_cfg.lease_duration_seconds,
                   le_cfg.renew_interval_seconds)
             lease, ident, dur, _renew = le
             klog.info_s("campaigning for scheduler lease",
                         identity=ident, stateDir=args.state_dir)
             if not ha.campaign(lease, ident, dur, stop):
+                if kube_api is not None:
+                    kube_api.stop()
                 return 0   # SIGTERM while campaigning
             klog.info_s("started leading", identity=ident)
 
-    api = APIServer()
+    api = kube_api if kube_api is not None else APIServer()
     journal = None
     if args.state_dir and not args.validate_only:
         from ..apiserver import persistence
@@ -237,6 +273,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             journal.close()
         if le is not None and not lost_lease:
             le[0].release(le[1])
+        if kube_api is not None:
+            kube_api.stop()
     return 1 if lost_lease else 0
 
 

@@ -1,0 +1,386 @@
+"""In-process fake Kubernetes API server speaking real HTTP.
+
+The reference proves its scheduler boot against a genuine apiserver+etcd
+(/root/reference/test/integration/main_test.go:31-46); this is the rebuild's
+equivalent test double for the ``apiserver.kube`` client mode: a
+ThreadingHTTPServer that stores raw JSON objects and implements the slice of
+the Kubernetes REST contract the framework exercises —
+
+- GET/LIST/DELETE per resource, POST create (409 on exists, uid+rv+
+  creationTimestamp minted server-side), PUT with resourceVersion
+  optimistic-concurrency, PATCH as RFC 7386 merge-patch (rv precondition
+  honored when the patch body carries ``metadata.resourceVersion``);
+- WATCH: ``?watch=true&resourceVersion=N`` returns a chunked stream of
+  line-delimited ``{"type","object"}`` events, replaying everything after
+  rv N first (events since server start are retained — test scale);
+- the pods/binding subresource: sets ``spec.nodeName`` (409 if bound),
+  merges the Binding's metadata annotations into the pod, and appends a
+  ``PodScheduled`` condition — the real apiserver's assignPod contract that
+  the reference's FlexGPU Bind relies on
+  (/root/reference/pkg/flexgpu/flex_gpu.go:230-242);
+- coordination.k8s.io Leases and core Events via the generic machinery.
+
+Paths cover core (``/api/v1``) and group (``/apis/{group}/{version}``)
+resources, namespaced and cluster-scoped, plus all-namespace collection
+LIST/WATCH (``/api/v1/pods``). No auth is enforced.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ..apiserver.kubecodec import apply_merge_patch
+
+NAMESPACED = {"pods", "podgroups", "elasticquotas", "poddisruptionbudgets",
+              "leases", "events"}
+CLUSTER = {"nodes", "priorityclasses", "tputopologies"}
+
+
+class _Store:
+    """kind-agnostic object store + watch event log."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rv = 0
+        self.objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self.log: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        self.watchers: List[Tuple[str, "queue.Queue"]] = []
+        self.uid = 0
+
+    def bump(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def emit(self, plural: str, etype: str, obj: Dict[str, Any]) -> None:
+        rv = int(obj["metadata"]["resourceVersion"])
+        self.log.append((rv, plural, etype, obj))
+        for plural_w, q in list(self.watchers):
+            if plural_w == plural:
+                q.put((etype, obj))
+
+
+class FakeKube:
+    """Owns the HTTP server; ``url`` is the base endpoint for
+    ``kube.ConnectionInfo``."""
+
+    def __init__(self):
+        store = self.store = _Store()
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            srv_store = store
+
+            def log_message(self, *a):   # silence per-request stderr noise
+                pass
+
+            # -- plumbing --------------------------------------------------
+
+            def _json(self, code: int, body: Dict[str, Any]) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _status(self, code: int, reason: str) -> None:
+                self._json(code, {"kind": "Status", "code": code,
+                                  "message": reason})
+
+            def _read_body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                return json.loads(self.rfile.read(n))
+
+            def _route(self):
+                """→ (plural, namespace|None, name|None, subresource|None)
+                or None for unroutable paths."""
+                u = urlsplit(self.path)
+                segs = [s for s in u.path.split("/") if s]
+                if len(segs) >= 2 and segs[0] == "api" and segs[1] == "v1":
+                    rest = segs[2:]
+                elif len(segs) >= 3 and segs[0] == "apis":
+                    rest = segs[3:]
+                else:
+                    return None
+                if not rest:
+                    return None
+                if rest[0] == "namespaces" and len(rest) >= 3:
+                    ns, plural = rest[1], rest[2]
+                    name = rest[3] if len(rest) > 3 else None
+                    sub = rest[4] if len(rest) > 4 else None
+                    return plural, ns, name, sub
+                plural = rest[0]
+                name = rest[1] if len(rest) > 1 else None
+                sub = rest[2] if len(rest) > 2 else None
+                ns = None
+                return plural, ns, name, sub
+
+            def _query(self) -> Dict[str, str]:
+                q = parse_qs(urlsplit(self.path).query)
+                return {k: v[0] for k, v in q.items()}
+
+            @staticmethod
+            def _key(plural, ns, name):
+                return (plural, ns or "", name)
+
+            # -- verbs -----------------------------------------------------
+
+            def do_GET(self):
+                r = self._route()
+                if r is None:
+                    return self._status(404, "unroutable")
+                plural, ns, name, _sub = r
+                st = self.srv_store
+                if name is None:
+                    q = self._query()
+                    if q.get("watch") in ("true", "1"):
+                        return self._serve_watch(plural, ns, q)
+                    with st.lock:
+                        items = [o for (p, ons, _n), o in st.objects.items()
+                                 if p == plural
+                                 and (ns is None or ons == ns)]
+                        rv = st.rv
+                    return self._json(200, {
+                        "kind": "List", "apiVersion": "v1",
+                        "metadata": {"resourceVersion": str(rv)},
+                        "items": items})
+                with st.lock:
+                    obj = st.objects.get(self._key(plural, ns, name))
+                if obj is None:
+                    return self._status(404, f"{plural} {name} not found")
+                return self._json(200, obj)
+
+            def do_POST(self):
+                r = self._route()
+                if r is None:
+                    return self._status(404, "unroutable")
+                plural, ns, name, sub = r
+                st = self.srv_store
+                body = self._read_body()
+                if plural == "pods" and sub == "binding":
+                    return self._bind(ns, name, body)
+                meta = body.setdefault("metadata", {})
+                oname = meta.get("name")
+                if not oname:
+                    return self._status(422, "metadata.name required")
+                if ns is not None:
+                    meta["namespace"] = ns
+                key = self._key(plural, meta.get("namespace")
+                                if plural in NAMESPACED else None, oname)
+                with st.lock:
+                    if key in st.objects:
+                        return self._status(
+                            409, f"{plural} {oname} already exists")
+                    st.uid += 1
+                    meta["uid"] = f"fake-{st.uid:08d}"
+                    meta.setdefault(
+                        "creationTimestamp",
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+                    meta["resourceVersion"] = str(st.bump())
+                    st.objects[key] = body
+                    st.emit(plural, "ADDED", body)
+                return self._json(201, body)
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None:
+                    return self._status(404, "unroutable")
+                plural, ns, name, _sub = r
+                st = self.srv_store
+                body = self._read_body()
+                key = self._key(plural, ns, name)
+                with st.lock:
+                    cur = st.objects.get(key)
+                    if cur is None:
+                        return self._status(404, f"{plural} {name} not found")
+                    sent_rv = (body.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if sent_rv and str(sent_rv) != \
+                            cur["metadata"]["resourceVersion"]:
+                        return self._status(409, "resourceVersion conflict")
+                    meta = body.setdefault("metadata", {})
+                    meta["uid"] = cur["metadata"]["uid"]
+                    meta["creationTimestamp"] = \
+                        cur["metadata"].get("creationTimestamp")
+                    meta["name"], meta["namespace"] = name, ns
+                    if plural not in NAMESPACED:
+                        meta.pop("namespace", None)
+                    meta["resourceVersion"] = str(st.bump())
+                    st.objects[key] = body
+                    st.emit(plural, "MODIFIED", body)
+                return self._json(200, body)
+
+            def do_PATCH(self):
+                r = self._route()
+                if r is None:
+                    return self._status(404, "unroutable")
+                plural, ns, name, _sub = r
+                st = self.srv_store
+                patch = self._read_body()
+                key = self._key(plural, ns, name)
+                with st.lock:
+                    cur = st.objects.get(key)
+                    if cur is None:
+                        return self._status(404, f"{plural} {name} not found")
+                    sent_rv = (patch.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if sent_rv and str(sent_rv) != \
+                            cur["metadata"]["resourceVersion"]:
+                        return self._status(409, "resourceVersion conflict")
+                    if isinstance(patch.get("metadata"), dict):
+                        patch["metadata"].pop("resourceVersion", None)
+                    merged = apply_merge_patch(cur, patch)
+                    merged["metadata"]["uid"] = cur["metadata"]["uid"]
+                    merged["metadata"]["resourceVersion"] = str(st.bump())
+                    st.objects[key] = merged
+                    st.emit(plural, "MODIFIED", merged)
+                return self._json(200, merged)
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None:
+                    return self._status(404, "unroutable")
+                plural, ns, name, _sub = r
+                st = self.srv_store
+                key = self._key(plural, ns, name)
+                with st.lock:
+                    obj = st.objects.pop(key, None)
+                    if obj is None:
+                        return self._status(404, f"{plural} {name} not found")
+                    obj = dict(obj)
+                    obj["metadata"] = dict(obj["metadata"])
+                    obj["metadata"]["resourceVersion"] = str(st.bump())
+                    st.emit(plural, "DELETED", obj)
+                return self._json(200, {"kind": "Status", "status": "Success"})
+
+            # -- subresources ---------------------------------------------
+
+            def _bind(self, ns, name, body):
+                st = self.srv_store
+                key = self._key("pods", ns, name)
+                with st.lock:
+                    pod = st.objects.get(key)
+                    if pod is None:
+                        return self._status(404, f"pod {name} not found")
+                    if (pod.get("spec") or {}).get("nodeName"):
+                        return self._status(
+                            409, f"pod {name} is already assigned to node "
+                                 f"{pod['spec']['nodeName']}")
+                    pod = json.loads(json.dumps(pod))   # deep copy: the
+                    # watch log aliases stored objects; mutate a fresh one
+                    pod.setdefault("spec", {})["nodeName"] = \
+                        ((body.get("target") or {}).get("name", ""))
+                    ann = (body.get("metadata") or {}).get("annotations")
+                    if ann:
+                        pod.setdefault("metadata", {}).setdefault(
+                            "annotations", {}).update(ann)
+                    conds = pod.setdefault("status", {}).setdefault(
+                        "conditions", [])
+                    conds.append({
+                        "type": "PodScheduled", "status": "True",
+                        "lastTransitionTime": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+                    pod["metadata"]["resourceVersion"] = str(st.bump())
+                    st.objects[key] = pod
+                    st.emit("pods", "MODIFIED", pod)
+                return self._json(201, {"kind": "Status",
+                                        "status": "Success"})
+
+            # -- watch -----------------------------------------------------
+
+            def _serve_watch(self, plural, ns, q):
+                st = self.srv_store
+                since = int(q.get("resourceVersion") or 0)
+                deadline = None
+                if q.get("timeoutSeconds"):
+                    deadline = time.monotonic() + float(q["timeoutSeconds"])
+                events: "queue.Queue" = queue.Queue()
+                with st.lock:
+                    backlog = [(etype, obj)
+                               for rv, p, etype, obj in st.log
+                               if p == plural and rv > since]
+                    st.watchers.append((plural, events))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def send(etype, obj):
+                    if ns is not None and (obj.get("metadata") or {}).get(
+                            "namespace") != ns:
+                        return
+                    data = json.dumps(
+                        {"type": etype, "object": obj}).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for etype, obj in backlog:
+                        send(etype, obj)
+                    while True:
+                        if deadline and time.monotonic() > deadline:
+                            break
+                        try:
+                            etype, obj = events.get(timeout=0.25)
+                        except queue.Empty:
+                            continue
+                        send(etype, obj)
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    with st.lock:
+                        try:
+                            st.watchers.remove((plural, events))
+                        except ValueError:
+                            pass
+                    self.close_connection = True
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fake-kube", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def object(self, plural: str, namespace: str, name: str
+               ) -> Optional[Dict[str, Any]]:
+        with self.store.lock:
+            key = (plural, namespace if plural in NAMESPACED else "", name)
+            obj = self.store.objects.get(key)
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def put_object(self, plural: str, obj: Dict[str, Any]) -> None:
+        """Seed state directly (test setup), emitting a watch event."""
+        meta = obj.setdefault("metadata", {})
+        ns = meta.get("namespace", "") if plural in NAMESPACED else ""
+        with self.store.lock:
+            self.store.uid += 1
+            meta.setdefault("uid", f"fake-{self.store.uid:08d}")
+            meta["resourceVersion"] = str(self.store.bump())
+            key = (plural, ns, meta["name"])
+            etype = "MODIFIED" if key in self.store.objects else "ADDED"
+            self.store.objects[key] = obj
+            self.store.emit(plural, etype, obj)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeKube":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.close()
