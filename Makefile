@@ -39,7 +39,11 @@ native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
 
 .PHONY: verify
-verify: verify-structured-logging verify-crdgen verify-manifests
+verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize
+
+.PHONY: verify-kustomize
+verify-kustomize:
+	hack/verify-kustomize.sh
 
 .PHONY: verify-structured-logging
 verify-structured-logging:

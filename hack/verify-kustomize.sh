@@ -1,0 +1,14 @@
+#!/bin/bash
+# config/crd/bases must mirror manifests/crds byte-for-byte (kustomize's
+# load restrictor forces the copies; this keeps them honest).
+set -e
+cd "$(dirname "$0")/.."
+rc=0
+for f in manifests/crds/*.yaml; do
+  b="config/crd/bases/$(basename "$f")"
+  if ! diff -q "$f" "$b" >/dev/null 2>&1; then
+    echo "DRIFT: $b != $f (run: cp $f $b)"
+    rc=1
+  fi
+done
+exit $rc
