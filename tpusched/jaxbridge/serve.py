@@ -283,6 +283,8 @@ class ServeEngine:
         self.top_k = top_k
         self.top_p = top_p
         self._key = jax.random.PRNGKey(seed)
+        self._mesh = mesh
+        self._kv_shard = None
         if mesh is None:
             self.cache = init_kv_cache(cfg, slots, max_seq)
         else:
@@ -309,6 +311,7 @@ class ServeEngine:
             # commit the whole arena to one chip (an OOM at production
             # sizes even when every shard fits)
             kv_sh = NamedSharding(mesh, P(None, None, tp_axis, None))
+            self._kv_shard = kv_sh
             self.cache = jax.jit(
                 lambda: init_kv_cache(cfg, slots, max_seq),
                 out_shardings=[{"k": kv_sh, "v": kv_sh}
@@ -321,9 +324,10 @@ class ServeEngine:
             raise ValueError("draft_cfg without draft_params: the engine "
                              "would silently run plain, not speculative")
         if draft_params is not None:
-            # v1 scope: greedy, monolithic admission, single-device — each
-            # relaxation is its own correctness argument; refuse combos
-            # this version has not earned
+            # scope: greedy, monolithic admission; single-device or a
+            # tensor-parallel mesh (draft + target arenas both tp-sharded).
+            # Each further relaxation is its own correctness argument;
+            # refuse combos this version has not earned
             if draft_cfg is None:
                 raise ValueError("draft_params requires draft_cfg")
             if draft_cfg.vocab != cfg.vocab:
@@ -331,10 +335,11 @@ class ServeEngine:
             if temperature != 0.0:
                 raise ValueError("speculative serving is greedy-only "
                                  "(temperature must be 0)")
-            if chunk_prefill is not None or mesh is not None:
+            if chunk_prefill is not None:
                 raise ValueError("speculative serving composes with "
-                                 "monolithic single-device admission only "
-                                 "(no chunk_prefill/mesh) in this version")
+                                 "monolithic admission only (no "
+                                 "chunk_prefill) in this version; a "
+                                 "tensor-parallel mesh is supported")
             if spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
             # speculative admission needs prompt + max_new + spec_k + 1
@@ -354,7 +359,28 @@ class ServeEngine:
                     f"admitted")
             if draft_cfg.kv_cache_dtype is not None:
                 raise ValueError("draft cache must be exact")
-            self.draft_cache = init_kv_cache(draft_cfg, slots, max_seq)
+            if mesh is None:
+                self.draft_cache = init_kv_cache(draft_cfg, slots, max_seq)
+            else:
+                # the draft rides the SAME tp mesh: its params shard via its
+                # own param_specs, its arena over kv_heads — the draft and
+                # verify programs are the standard jitted paths, so the
+                # shardings propagate exactly as they do for the target
+                tp = mesh.shape.get("tp", 1)
+                if draft_cfg.kv_heads % tp:
+                    raise ValueError(
+                        f"draft kv_heads {draft_cfg.kv_heads} not "
+                        f"divisible by tp {tp}")
+                dshard = jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(mesh, spec),
+                    param_specs(draft_cfg, mesh),
+                    is_leaf=lambda x: isinstance(x, P))
+                self.draft_params = jax.device_put(draft_params, dshard)
+                self.draft_cache = jax.jit(
+                    lambda: init_kv_cache(draft_cfg, slots, max_seq),
+                    out_shardings=[{"k": self._kv_shard,
+                                    "v": self._kv_shard}
+                                   for _ in range(draft_cfg.n_layers)])()
             self._draft_prefill_by_bucket: Dict[int, Callable] = {}
             self._draft_tick = _build_draft_tick(draft_cfg, spec_k)
             self._verify = _build_verify_span(cfg)
