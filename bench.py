@@ -1056,6 +1056,124 @@ def bench_tpu_workload() -> None:
         emit(f"batched speculative serving bench FAILED: "
              f"{type(e).__name__}: {e}", None, "", None)
 
+    # serving SLO, wall-clock, ON CHIP: the seconds the tick-gated CPU
+    # lines (bench_serving_slo) stand in for. Same harness, production-ish
+    # arrival pressure, 155M model.
+    try:
+        from tpusched.jaxbridge.serve import measure_serving_slo
+        rng = _np.random.default_rng(42)
+        n = 24
+        prompts = [rng.integers(0, scfg.vocab,
+                                int(rng.integers(48, 192)),
+                                dtype=_np.int32) for _ in range(n)]
+        slo_reqs = [Request(rid=i, prompt=p,
+                            max_new_tokens=int(rng.integers(16, 96)))
+                    for i, p in enumerate(prompts)]
+        arrivals = _np.cumsum(rng.poisson(4.0, size=n)).tolist()
+        for label, ckw in (("monolithic", {}),
+                           ("chunked cp=64", {"chunk_prefill": 64})):
+            m = measure_serving_slo(scfg, sparams, slo_reqs, arrivals,
+                                    slots=8, max_seq=512,
+                                    prompt_bucket=192,
+                                    ttft_slo_ticks=32, **ckw)
+            emit(f"on-chip serving SLO [{label}]: 155M bf16, 8 slots, "
+                 f"24 Poisson arrivals — TTFT p50/p99 "
+                 f"{m['ttft_s_p50'] * 1e3:.1f}/"
+                 f"{m['ttft_s_p99'] * 1e3:.1f} ms, per-token "
+                 f"{m['per_token_s'] * 1e3:.2f} ms, goodput "
+                 f"{m['goodput_tokens_per_s']:.0f} tok/s at a 32-tick "
+                 f"TTFT SLO, attainment {m['slo_attainment']:.2f} "
+                 "(single v5e chip)",
+                 round(m["ttft_s_p99"] * 1e3, 2), "ms",
+                 round(m["slo_attainment"], 3))
+    except Exception as e:  # noqa: BLE001
+        emit(f"on-chip serving SLO bench FAILED: {type(e).__name__}: {e}",
+             None, "", None)
+
+
+def _serving_slo_child() -> None:
+    """Subprocess body for bench_serving_slo: CPU-pinned (the parent may
+    hold — or be unable to reach — the TPU chip; tick metrics are
+    platform-independent anyway). Prints ONE tagged JSON dict."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    import numpy as _np
+    from tpusched.jaxbridge.serve import Request, measure_serving_slo
+    from tpusched.jaxbridge.workload import ModelConfig, init_params
+    cfg = ModelConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = _np.random.default_rng(42)
+    n = 24
+    suffixes = [rng.integers(0, cfg.vocab, int(rng.integers(8, 56)),
+                             dtype=_np.int32) for _ in range(n)]
+    gens = [int(rng.integers(8, 48)) for _ in range(n)]
+    arrivals = _np.cumsum(rng.poisson(3.0, size=n)).tolist()
+    shared = (_np.arange(64, dtype=_np.int32) * 7) % cfg.vocab
+    full = [_np.concatenate([shared, s]) for s in suffixes]
+
+    def mk(prompts):
+        return [Request(rid=i, prompt=p, max_new_tokens=gens[i])
+                for i, p in enumerate(prompts)]
+
+    kw = dict(slots=8, max_seq=256, prompt_bucket=128, ttft_slo_ticks=24)
+    out = {
+        "mono": measure_serving_slo(cfg, params, mk(full), arrivals, **kw),
+        "chunked": measure_serving_slo(cfg, params, mk(full), arrivals,
+                                       chunk_prefill=32, **kw),
+        # prefix-cache-on: the SAME total context, but the shared 64-token
+        # head is registered once and device-copied at admission — only
+        # the suffix prefills
+        "prefix": measure_serving_slo(cfg, params, mk(suffixes), arrivals,
+                                      chunk_prefill=32,
+                                      prefix_tokens=shared, **kw),
+    }
+    print("SLO_JSON:" + json.dumps(out), flush=True)
+
+
+def bench_serving_slo() -> None:
+    """Serving SLO lines (VERDICT r4 #3): TTFT p50/p99, per-token latency,
+    goodput for an 8-slot mixed workload under seeded Poisson arrivals —
+    monolithic vs chunked prefill vs chunked+prefix-cache. Tick-denominated
+    metrics are DETERMINISTIC for the fixed seed (no-EOS trajectories
+    depend only on geometry), so they gate in bench_budget.json exactly
+    like the scheduler lines; wall-clock numbers are informational here and
+    become the TPU-table values when the on-chip tier runs."""
+    import subprocess
+    res = subprocess.run(
+        [sys.executable, "-c", "import bench; bench._serving_slo_child()"],
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    line = next((ln for ln in res.stdout.splitlines()
+                 if ln.startswith("SLO_JSON:")), None)
+    if line is None:
+        emit(f"serving SLO bench FAILED: rc={res.returncode} "
+             f"{res.stderr[-300:]}", None, "", None)
+        return
+    out = json.loads(line[len("SLO_JSON:"):])
+    labels = (("mono", "monolithic prefill"),
+              ("chunked", "chunked prefill cp=32"),
+              ("prefix", "chunked + 64-token shared prefix cache"))
+    for name, label in labels:
+        m = out[name]
+        emit(f"serving SLO [{label}]: 8 slots, 24 Poisson arrivals — "
+             f"TTFT p50/p99 {m['ttft_ticks_p50']:.0f}/"
+             f"{m['ttft_ticks_p99']:.0f} ticks "
+             f"({m['ttft_s_p50'] * 1e3:.1f}/{m['ttft_s_p99'] * 1e3:.1f} ms "
+             f"host), per-token {m['per_token_s'] * 1e3:.2f} ms, goodput "
+             f"{m['goodput_tokens_per_tick']:.2f} tok/tick at a 24-tick "
+             f"TTFT SLO, attainment {m['slo_attainment']:.2f} "
+             "(tick metrics deterministic + gated; seconds informational "
+             "off-chip)",
+             round(m["ttft_ticks_p99"], 1), "ticks",
+             round(m["slo_attainment"], 3))
+        _check_gate(f"serve_slo_{name}_ttft_ticks_p99",
+                    [m["ttft_ticks_p99"]])
+        _check_gate(f"serve_slo_{name}_drain_ticks", [m["ticks"]])
+
 
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): 5 headline gang runs, gate on the
@@ -1089,7 +1207,7 @@ def main() -> int:
     for bench in (bench_quota, bench_slice_reclaim, bench_multislice,
                   bench_scale, bench_fleet_gang, bench_contention,
                   bench_gang_wal, bench_wal_recovery, bench_ha_takeover,
-                  bench_tpu_workload):
+                  bench_serving_slo, bench_tpu_workload):
         try:
             bench()
         except Exception as e:  # keep the headline line alive no matter what

@@ -1,0 +1,104 @@
+"""Serving SLO harness (jaxbridge.serve.measure_serving_slo): the bench's
+regression gates rest on its tick metrics being deterministic and meaning
+what they claim — pin both, plus the prefix-cache TTFT win the bench line
+advertises."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpusched.jaxbridge.serve import Request, measure_serving_slo
+from tpusched.jaxbridge.workload import ModelConfig, init_params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny()
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _workload(cfg, seed=9, n=12):
+    rng = np.random.default_rng(seed)
+    suffixes = [rng.integers(0, cfg.vocab, int(rng.integers(6, 40)),
+                             dtype=np.int32) for _ in range(n)]
+    gens = [int(rng.integers(4, 24)) for _ in range(n)]
+    arrivals = np.cumsum(rng.poisson(2.0, size=n)).tolist()
+    return suffixes, gens, arrivals
+
+
+def _mk(prompts, gens):
+    return [Request(rid=i, prompt=p, max_new_tokens=gens[i])
+            for i, p in enumerate(prompts)]
+
+
+TICK_KEYS = ("ttft_ticks_p50", "ttft_ticks_p99", "tokens", "ticks",
+             "tokens_per_tick", "slo_attainment",
+             "goodput_tokens_per_tick")
+
+
+def test_tick_metrics_are_deterministic(model):
+    """The gate contract: tick-denominated metrics must be identical run
+    to run (they depend only on geometry — no EOS, no weights, no
+    clock)."""
+    cfg, params = model
+    sfx, gens, arr = _workload(cfg)
+    a = measure_serving_slo(cfg, params, _mk(sfx, gens), arr, slots=4,
+                            max_seq=128, prompt_bucket=64,
+                            ttft_slo_ticks=16)
+    b = measure_serving_slo(cfg, params, _mk(sfx, gens), arr, slots=4,
+                            max_seq=128, prompt_bucket=64,
+                            ttft_slo_ticks=16)
+    assert {k: a[k] for k in TICK_KEYS} == {k: b[k] for k in TICK_KEYS}
+    assert a["tokens"] == float(sum(gens))   # all requests completed
+    assert a["ttft_ticks_p50"] <= a["ttft_ticks_p99"]
+
+
+def test_arrivals_are_honored(model):
+    """A request must not be admitted before its arrival tick: with one
+    request arriving at tick 20 into an idle engine, TTFT counts from
+    arrival, not from t=0, and drain takes arrival + generation ticks."""
+    cfg, params = model
+    req = [Request(rid=0, prompt=np.arange(5, dtype=np.int32),
+                   max_new_tokens=6)]
+    m = measure_serving_slo(cfg, params, req, [20], slots=2, max_seq=64,
+                            prompt_bucket=16)
+    assert m["ticks"] >= 20 + 5          # idle ticks + decode ticks
+    assert m["ttft_ticks_p99"] <= 2      # admitted+prefilled promptly
+
+
+def test_goodput_counts_only_slo_meeting_requests(model):
+    """With an SLO of 0 ticks only instant-TTFT requests count; with a
+    huge SLO everything counts — goodput and attainment must track."""
+    cfg, params = model
+    sfx, gens, arr = _workload(cfg)
+    tight = measure_serving_slo(cfg, params, _mk(sfx, gens), arr, slots=2,
+                                max_seq=128, prompt_bucket=64,
+                                ttft_slo_ticks=0)
+    loose = measure_serving_slo(cfg, params, _mk(sfx, gens), arr, slots=2,
+                                max_seq=128, prompt_bucket=64,
+                                ttft_slo_ticks=10_000)
+    assert loose["slo_attainment"] == 1.0
+    assert loose["goodput_tokens_per_tick"] == loose["tokens_per_tick"]
+    assert tight["slo_attainment"] < 1.0   # 2 slots, 12 requests: queueing
+    assert (tight["goodput_tokens_per_tick"]
+            < tight["tokens_per_tick"])
+
+
+def test_prefix_cache_beats_full_prefill(model):
+    """The bench's prefix line: same total context, but the shared head
+    registered once — TTFT p50 and drain ticks must both improve vs
+    chunk-prefilling the full prompts."""
+    cfg, params = model
+    sfx, gens, arr = _workload(cfg, seed=3)
+    shared = (np.arange(48, dtype=np.int32) * 5) % cfg.vocab
+    full = [np.concatenate([shared, s]) for s in sfx]
+    base = measure_serving_slo(cfg, params, _mk(full, gens), arr, slots=4,
+                               max_seq=192, prompt_bucket=96,
+                               chunk_prefill=16, ttft_slo_ticks=32)
+    pfx = measure_serving_slo(cfg, params, _mk(sfx, gens), arr, slots=4,
+                              max_seq=192, prompt_bucket=96,
+                              chunk_prefill=16, prefix_tokens=shared,
+                              ttft_slo_ticks=32)
+    assert pfx["ttft_ticks_p50"] < base["ttft_ticks_p50"]
+    assert pfx["ticks"] < base["ticks"]
+    assert pfx["tokens"] == base["tokens"]

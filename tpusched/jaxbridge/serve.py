@@ -42,6 +42,7 @@ is the workload its TpuSlice placements actually run.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -826,4 +827,109 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     if draft_params is not None:
         out.update({f"spec_{k_}": float(v)
                     for k_, v in eng.spec_stats.items()})
+    return out
+
+
+def _pctl(xs: List[float], q: float) -> float:
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(round(q * (len(ys) - 1))))] if ys else 0.0
+
+
+def measure_serving_slo(cfg: ModelConfig, params: Params,
+                        requests: List[Request],
+                        arrival_ticks: List[int], *,
+                        slots: int = 8, max_seq: int = 1024,
+                        prompt_bucket: "int | Tuple[int, ...]" = 128,
+                        chunk_prefill: Optional[int] = None,
+                        prefix_tokens: "Optional[np.ndarray]" = None,
+                        ttft_slo_ticks: Optional[int] = None,
+                        time_fn: Callable[[], float] = None
+                        ) -> Dict[str, float]:
+    """Serving SLO statistics under seeded stochastic arrivals: requests
+    enter the engine at their ``arrival_ticks`` (not all upfront), and the
+    harness stamps each request's submit→first-token interval.
+
+    Two denominations, one run:
+    - **ticks** — deterministic for a fixed request/arrival draw: with no
+      EOS token the trajectory depends only on geometry (prompt lengths,
+      max_new, slots, chunking, arrivals), never on weights or wall time.
+      These are the CPU-side regression gates (`bench_budget.json`): a
+      scheduling/admission regression moves them exactly, ambient machine
+      load cannot.
+    - **seconds** — the on-chip numbers (TTFT p50/p99, per-token latency,
+      goodput) for `doc/performance.md`'s TPU table.
+
+    ``prefix_tokens`` registers a shared prefix (chunked engines only) and
+    every request is submitted against it — the prefix-cache-on
+    configuration. ``ttft_slo_ticks`` defines goodput: the fraction of
+    requests whose tick-TTFT meets the bound (and their token share).
+    """
+    import time as _time
+    time_fn = time_fn or _time.perf_counter
+    eng = ServeEngine(params, cfg, slots=slots, max_seq=max_seq,
+                      prompt_bucket=prompt_bucket,
+                      chunk_prefill=chunk_prefill)
+    eng.warmup()
+    prefix_id = None
+    if prefix_tokens is not None:
+        prefix_id = "slo-shared-prefix"
+        eng.register_prefix(prefix_id, prefix_tokens)
+    order = sorted(zip(arrival_ticks, range(len(requests))))
+    pending = collections.deque(
+        (t, requests[i]) for t, i in order)
+    submit_tick: Dict[int, int] = {}
+    submit_wall: Dict[int, float] = {}
+    first_tick: Dict[int, int] = {}
+    first_wall: Dict[int, float] = {}
+    t0 = time_fn()
+    while pending or eng.queue or any(r is not None for r in eng.req):
+        while pending and pending[0][0] <= eng.tick_count:
+            _, req = pending.popleft()
+            if prefix_id is not None:
+                req = dataclasses.replace(req, prefix_id=prefix_id)
+            eng.submit(req)
+            submit_tick[req.rid] = eng.tick_count
+            submit_wall[req.rid] = time_fn()
+        eng.tick()
+        jax.block_until_ready(eng.cache)   # charge each tick its own work
+        now = time_fn()
+        for s in range(eng.slots):
+            req = eng.req[s]
+            if (req is not None and req.rid not in first_tick
+                    and eng.generated[s]):
+                first_tick[req.rid] = eng.tick_count
+                first_wall[req.rid] = now
+        for c in eng.completions:
+            # a request finishing in its admission tick frees the slot
+            # before the scan above sees it
+            if c.rid not in first_tick:
+                first_tick[c.rid] = eng.tick_count
+                first_wall[c.rid] = now
+        if eng.tick_count > 100_000:
+            raise RuntimeError("serving SLO harness did not drain")
+    elapsed = time_fn() - t0
+    completions = eng.completions
+    total_tokens = sum(len(c.tokens) for c in completions)
+    ttft_ticks = [first_tick[r.rid] - submit_tick[r.rid] for r in requests]
+    ttft_s = [first_wall[r.rid] - submit_wall[r.rid] for r in requests]
+    out = {
+        "ttft_ticks_p50": _pctl(ttft_ticks, 0.50),
+        "ttft_ticks_p99": _pctl(ttft_ticks, 0.99),
+        "ttft_s_p50": _pctl(ttft_s, 0.50),
+        "ttft_s_p99": _pctl(ttft_s, 0.99),
+        "per_token_s": elapsed / max(total_tokens, 1),
+        "tokens_per_s": total_tokens / max(elapsed, 1e-9),
+        "tokens": float(total_tokens),
+        "ticks": float(eng.tick_count),
+        "tokens_per_tick": total_tokens / max(eng.tick_count, 1),
+        "elapsed_s": elapsed,
+    }
+    if ttft_slo_ticks is not None:
+        ok = [r.rid for r, t in zip(requests, ttft_ticks)
+              if t <= ttft_slo_ticks]
+        ok_tokens = sum(len(c.tokens) for c in completions
+                        if c.rid in set(ok))
+        out["slo_attainment"] = len(ok) / max(len(requests), 1)
+        out["goodput_tokens_per_s"] = ok_tokens / max(elapsed, 1e-9)
+        out["goodput_tokens_per_tick"] = ok_tokens / max(eng.tick_count, 1)
     return out
