@@ -1,0 +1,262 @@
+"""Composed chaos soak (VERDICT r4 #8): ONE seeded stream interleaving
+every disruptive subsystem — priority bursts that drive window slice
+preemption and quota reclaim, consent-gated defrag actuation, and active
+SIGKILL → standby HA takeover over a shared WAL — with the safety
+invariants asserted continuously across ≥1000 scheduling cycles:
+
+  S1  no host oversubscribed, chip-index annotations disjoint (always);
+  S2  no double-bind: a pod (by uid) never changes hosts — across defrag
+      (which must delete+resubmit, never rebind), preemption, and WAL
+      replay on takeover;
+  S3  no stranded sub-quorum gang at quiesce (all-or-nothing, healing
+      window allowed — the upstream per-pod permit race);
+  S4  bin-pack: every bound slice gang sits in exactly one pool with
+      coordinates;
+  S5  WAL replay converges: a cold replay of the final state dir
+      reproduces the live assignments exactly.
+
+Individually these are pinned by test_soak_random / test_chaos_restart /
+test_defrag_controller; this soak is the cross-product — the regressions
+that only appear when a takeover lands mid-preemption or defrag races a
+burst. Failures reproduce from the printed seed."""
+from __future__ import annotations
+
+import random
+import shutil
+import tempfile
+import time
+
+import pytest
+
+from tpusched.api.resources import TPU
+from tpusched.api.scheduling import POD_GROUP_LABEL
+from tpusched.apiserver import persistence
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import full_stack_profile
+from tpusched.controllers.defrag import (ALLOW_MIGRATION_ANNOTATION,
+                                         DefragController)
+from tpusched.plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
+from tpusched.plugins.tpuslice import CHIP_INDEX_ANNOTATION
+from tpusched.sched.ha import HAScheduler
+from tpusched.testing import (make_elastic_quota, make_pod, make_pod_group,
+                              make_tpu_pool, wait_until)
+from tpusched.util.metrics import schedule_attempts
+
+SEED = 20260731
+ROUNDS = 10
+MIN_CYCLES = 1000
+CHIPS_PER_HOST = 4
+
+
+def _active_of(replicas, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for r in replicas:
+            if r.is_active.is_set():
+                return r
+        time.sleep(0.02)
+    raise AssertionError(f"no replica became active (seed {SEED})")
+
+
+def _bound_pods(api):
+    return [p for p in api.list(srv.PODS) if p.spec.node_name]
+
+
+def _check_hard(api, assignments):
+    """S1 + S2 + S4 — must hold at every instant."""
+    by_node = {}
+    for p in _bound_pods(api):
+        by_node.setdefault(p.spec.node_name, []).append(p)
+        prev = assignments.get(p.meta.uid)
+        assert prev is None or prev == p.spec.node_name, (
+            f"S2: pod {p.meta.key} (uid {p.meta.uid}) moved "
+            f"{prev} -> {p.spec.node_name} (seed {SEED})")
+        assignments[p.meta.uid] = p.spec.node_name
+    for node, pods in by_node.items():
+        used = sum(int(pp.spec.containers[0].limits.get(TPU, 0))
+                   for pp in pods)
+        assert used <= CHIPS_PER_HOST, (
+            f"S1: {node} oversubscribed: {used} chips (seed {SEED})")
+        idx = []
+        for pp in pods:
+            ann = pp.meta.annotations.get(CHIP_INDEX_ANNOTATION, "")
+            idx.extend(i for i in ann.split(",") if i)
+        assert len(idx) == len(set(idx)), (
+            f"S1: {node} chip indexes collide: {idx} (seed {SEED})")
+
+
+def _gang_violation(api, gangs):
+    """S3 + S4 (eventual: healing window applies)."""
+    for full, (members, shape) in gangs.items():
+        ns, name = full.split("/")
+        bound = [p for p in api.list(srv.PODS, ns)
+                 if p.meta.labels.get(POD_GROUP_LABEL) == name
+                 and p.spec.node_name]
+        if not (len(bound) == 0 or len(bound) >= members):
+            return f"S3: {full}: {len(bound)}/{members} bound"
+        if shape and bound:
+            pools = {p.meta.annotations.get(POOL_ANNOTATION) for p in bound}
+            if len(pools) > 1:
+                return f"S4: {full}: split across pools {pools}"
+            if not all(p.meta.annotations.get(COORD_ANNOTATION)
+                       for p in bound):
+                return f"S4: {full}: coordinates missing"
+    return None
+
+
+def test_composed_chaos_soak():
+    rng = random.Random(SEED)
+    state_dir = tempfile.mkdtemp(prefix="tpusched-soak-composed-")
+    profile = full_stack_profile(permit_wait_s=4, denied_s=1)
+    mk = lambda ident: HAScheduler(state_dir, profiles=[profile],
+                                   identity=ident, lease_duration_s=1.0,
+                                   renew_interval_s=0.25)
+    replicas = [mk("soak-a"), mk("soak-b"), mk("soak-c")]
+    crash_rounds = {ROUNDS // 3, (2 * ROUNDS) // 3}
+    attempts_start = schedule_attempts.value()
+    defrag = None
+    gangs = {}                 # full → (min_member, shape)
+    assignments = {}           # uid → node (S2 ledger)
+    counter = 0
+    try:
+        replicas[0].run()
+        active = _active_of(replicas)
+        for r in replicas[1:]:
+            r.run()
+        for i in range(2):
+            topo, nodes = make_tpu_pool(f"pool-{i}", dims=(4, 4, 4))
+            active.api.create(srv.TPU_TOPOLOGIES, topo)
+            for n in nodes:
+                active.api.create(srv.NODES, n)
+        for team in ("team-a", "team-b"):
+            active.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 32}, max={TPU: 128}))
+
+        def fresh_defrag(api):
+            nonlocal defrag
+            if defrag is not None:
+                defrag.stop()
+            defrag = DefragController(api, blocked_after_s=0.5,
+                                      cooldown_s=0.0, shadow_timeout_s=10.0,
+                                      dry_run=False)
+            return defrag
+
+        fresh_defrag(active.api)
+
+        def submit_gang(kind):
+            nonlocal counter
+            team = rng.choice(("team-a", "team-b"))
+            name = f"{kind}{counter}"
+            counter += 1
+            members, shape, prio = {
+                "filler": (1, "2x2x1", 0),
+                "mid": (2, "2x2x2", 0),
+                "burst": (16, "4x4x4", 100),
+            }[kind]
+            pg = make_pod_group(name, namespace=team, min_member=members,
+                                tpu_slice_shape=shape,
+                                tpu_accelerator="tpu-v5p")
+            if kind != "burst":    # small gangs consent to defrag moves
+                pg.meta.annotations[ALLOW_MIGRATION_ANNOTATION] = "true"
+            active.api.create(srv.POD_GROUPS, pg)
+            for j in range(members):
+                active.api.create(srv.PODS, make_pod(
+                    f"{name}-{j}", namespace=team, pod_group=name,
+                    limits={TPU: 4}, priority=prio))
+            gangs[f"{team}/{name}"] = (members, shape)
+
+        def delete_gang():
+            full = rng.choice(sorted(gangs))
+            ns, name = full.split("/")
+            for p in list(active.api.list(srv.PODS, ns)):
+                if p.meta.labels.get(POD_GROUP_LABEL) == name:
+                    try:
+                        active.api.delete(srv.PODS, p.meta.key)
+                    except srv.NotFound:
+                        pass
+                    assignments.pop(p.meta.uid, None)
+            try:
+                active.api.delete(srv.POD_GROUPS, full)
+            except srv.NotFound:
+                pass
+            del gangs[full]
+
+        def quiesced():
+            return (active.is_active.is_set() and active.schedulers
+                    and active.schedulers[0].queue.pending_counts()
+                    ["active"] == 0)
+
+        for rnd in range(ROUNDS):
+            for _ in range(rng.randint(2, 4)):
+                op = rng.random()
+                if op < 0.35 or not gangs:
+                    submit_gang(rng.choice(("filler", "filler", "mid")))
+                elif op < 0.55:
+                    submit_gang("burst")
+                elif op < 0.75 and gangs:
+                    delete_gang()
+                else:
+                    # defrag scan+actuation against the LIVE control plane
+                    defrag.reconcile_once()
+            if rnd in crash_rounds:
+                # SIGKILL semantics: lease unreleased, journal fenced by
+                # the successor's WAL rotation. Preemptions/permits
+                # in-flight die with the process; the WAL + API are the
+                # only checkpoint.
+                dead = active
+                dead.crash()
+                replicas.remove(dead)
+                active = _active_of(replicas, timeout=45)
+                fresh_defrag(active.api)
+                # S2 across replay: every surviving bound pod kept its host
+                _check_hard(active.api, assignments)
+            assert wait_until(quiesced, timeout=40), (
+                f"round {rnd} did not quiesce (seed {SEED})")
+
+            def stable_clean():
+                _check_hard(active.api, assignments)
+                if not quiesced() or _gang_violation(active.api, gangs):
+                    return False
+                time.sleep(0.3)
+                return (quiesced()
+                        and _gang_violation(active.api, gangs) is None)
+
+            if not wait_until(stable_clean, timeout=40, interval=0.2):
+                raise AssertionError(
+                    f"round {rnd}: invariants never stabilized (seed "
+                    f"{SEED}): {_gang_violation(active.api, gangs)}")
+
+        # keep the stream going until the cycle floor is met: churn small
+        # gangs (every admission, retry, and denial is a cycle)
+        deadline = time.monotonic() + 120
+        while (schedule_attempts.value() - attempts_start < MIN_CYCLES
+               and time.monotonic() < deadline):
+            submit_gang("filler")
+            if len(gangs) > 40:
+                delete_gang()
+            time.sleep(0.02)
+        cycles = schedule_attempts.value() - attempts_start
+        assert cycles >= MIN_CYCLES, (
+            f"only {cycles:.0f} scheduling cycles exercised (seed {SEED})")
+        assert len(replicas) == 1, "both scheduled takeovers must have run"
+        assert wait_until(quiesced, timeout=40)
+        _check_hard(active.api, assignments)
+
+        # S5: WAL replay convergence — drain the journal, then a cold
+        # replay of the state dir must reproduce the live assignments
+        assert active._journal.flush(timeout=30)
+        live = {p.meta.uid: p.spec.node_name
+                for p in _bound_pods(active.api)}
+        cold = srv.APIServer()
+        persistence.load_into(cold, state_dir)
+        replayed = {p.meta.uid: p.spec.node_name
+                    for p in _bound_pods(cold)}
+        assert replayed == live, (
+            f"S5: cold replay diverged (seed {SEED}): "
+            f"{len(replayed)} vs {len(live)} bound")
+    finally:
+        if defrag is not None:
+            defrag.stop()
+        for r in replicas:
+            r.crash()
+        shutil.rmtree(state_dir, ignore_errors=True)
