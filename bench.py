@@ -887,6 +887,30 @@ def bench_tpu_workload() -> None:
         emit(f"AdamW big-model train-step FAILED: {type(e).__name__}: {e}",
              None, "", None)
 
+    # the SCALED flagship line (VERDICT r4 #4): ~1.55B params — the
+    # largest config the HBM budget calculator (jaxbridge/budget.py)
+    # approves for a 16 GiB v5e under the pure-bf16-AdamW-state policy
+    # (params+mu+nu+grads+remat activations+f32 logits ≈ 87% of HBM).
+    # The budget figures ride the metric text so the arithmetic and the
+    # measurement land in the same artifact.
+    try:
+        import jax.numpy as _jnp
+        from tpusched.jaxbridge import budget as budget_mod
+        xl = ModelConfig.llama_like_xl(seq=4096)
+        bd = budget_mod.train_hbm_breakdown(xl, 1, mu_dtype="bf16",
+                                            accelerator="tpu-v5e")
+        x_per, x_tf, x_mfu, xnote = measure_adamw_train_step(
+            xl, batch=1, mu_dtype=_jnp.bfloat16)
+        emit("train-step MFU, llama-like ~1.55B bf16 AdamW(optax) "
+             "pure-bf16 state + remat, seq 4096, b1, flash attention "
+             f"(budget {bd.total_gib:.1f}/{bd.hbm_gib:.0f} GiB; {xnote}; "
+             f"step {x_per * 1e3:.1f} ms, single v5e chip)",
+             round(x_mfu, 4) if x_mfu else round(x_tf, 1),
+             "MFU" if x_mfu else "TFLOP/s", None)
+    except Exception as e:  # noqa: BLE001
+        emit(f"AdamW 1.55B train-step FAILED: {type(e).__name__}: {e}",
+             None, "", None)
+
     # Mixtral-style MoE train step (VERDICT r3 #7). Measured at the
     # ep-sharded PER-DEVICE regime (seq 1024, b1 — the token count one ep
     # shard of a multi-chip run sees), because the GShard one-hot

@@ -509,3 +509,39 @@ def test_speculative_serving_on_chip(tpu):
     for rid in done_s:
         np.testing.assert_array_equal(done_s[rid].tokens,
                                       done_p[rid].tokens)
+
+
+def test_xl_flagship_fits_and_trains_on_chip(tpu):
+    """The budget-sized flagship (VERDICT r4 #4): llama_like_xl (~1.55B,
+    pure-bf16 AdamW state, remat) was sized arithmetically to 87% of a
+    16 GiB v5e by jaxbridge.budget — prove the arithmetic on hardware:
+    init + two donated train steps must fit (no ResourceExhausted) with a
+    finite, decreasing loss. The MFU >= 0.5 evidence is bench.py's 1.55B
+    line (slope-timed); this gate is the fit + trainability proof."""
+    import functools
+    import optax
+    from tpusched.jaxbridge import budget as budget_mod
+    from tpusched.jaxbridge.workload import init_params, loss_fn
+
+    cfg = ModelConfig.llama_like_xl(seq=4096)
+    bd = budget_mod.train_hbm_breakdown(cfg, 1, mu_dtype="bf16",
+                                        accelerator="tpu-v5e")
+    assert bd.fits, f"budget says it no longer fits: {bd.to_dict()}"
+    tx = optax.adamw(1e-4, mu_dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(12), cfg)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(13), (1, cfg.seq),
+                                0, cfg.vocab, dtype=jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, t):
+        loss, g = jax.value_and_grad(loss_fn)(p, t, cfg)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

@@ -24,7 +24,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpusched-whatif",
         description="dry-run gang admission against saved cluster state")
-    p.add_argument("--state-dir", required=True,
+    p.add_argument("--train-plan", metavar="PLAN_JSON", default=None,
+                   help="HBM-budget check of a training plan (model + mesh "
+                        "+ accelerator JSON, jaxbridge.budget.validate_plan "
+                        "schema) — pure arithmetic, no cluster state. "
+                        "Exit 0 = fits per chip, 1 = does not")
+    p.add_argument("--state-dir", default=None,
                    help="scheduler --state-dir to load the shadow state from")
     p.add_argument("--plan", metavar="JOBS_JSON",
                    help="plan a QUEUE instead of one gang: path to a JSON "
@@ -80,6 +85,22 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.scheduler_name and not args.config:
         parser.error("--scheduler-name requires --config")
+    if args.train_plan:
+        # capacity arithmetic is a host computation: pin jax to CPU so the
+        # planner never waits on (or claims) an accelerator
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from ..jaxbridge.budget import validate_plan
+        with open(args.train_plan, encoding="utf-8") as f:
+            plan = json.load(f)
+        try:
+            out = validate_plan(plan)
+        except (KeyError, TypeError, ValueError) as e:
+            parser.error(f"{args.train_plan}: {e}")
+        print(json.dumps(out))
+        return 0 if out["fits"] else 1
+    if not args.state_dir:
+        parser.error("--state-dir is required (except with --train-plan)")
     from ..config.scheme import ConfigError
     from ..sim import simulate_gang, simulate_plan
     if args.plan:

@@ -1,0 +1,219 @@
+"""HBM budget calculator: will this training/serving job fit its chips?
+
+The scheduler half of this repo places gangs by chip count and
+``google.com/tpu-memory`` megabytes (`plugins/tpuslice/chip_node.py`); the
+workload half knows what a training step actually keeps resident. This
+module connects them: an analytic, sharding-aware memory model for the
+flagship families (dense + MoE Llama-likes), derived from the same
+parameter tree `workload.init_params` builds — so a capacity plan (e.g.
+"Llama-3-8B AdamW on a v5p-256, dp8×fsdp8×tp4") can be validated
+ARITHMETICALLY before any gang is submitted, from the what-if CLI
+(`cmd/whatif.py --train-plan`) or a test.
+
+The reference has no analog (it schedules by resource ints it never
+derives); the numbers here follow the standard accounting (e.g. the public
+"How to Scale Your Model" treatment of params/optimizer/activations):
+
+- master params, optimizer moments (AdamW mu/nu in configurable dtypes),
+  a compute-dtype cast when ``param_dtype`` differs, and gradients —
+  all divided by the param-sharding factor (fsdp × tp);
+- activations under remat: per-layer residuals + ONE block's recompute
+  workspace; without remat: every block's internals. Flash attention
+  drops the s² score tensor; naive keeps it. Divided by dp × sp (batch
+  and sequence sharding);
+- the (b, s, vocab) f32 logits for the loss — the silent peak at large
+  vocab — divided by tp when ``vocab_parallel_loss`` is on;
+- serving: params + the (slots, max_seq) GQA KV arena (int8 cache halves
+  the bytes, + scale planes).
+
+Everything returns GiB (floats) plus a ``fits`` verdict against the
+accelerator catalog (`api.topology.ACCELERATORS`), with a configurable
+safety margin for XLA workspace/fragmentation the model cannot see.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ..api.topology import ACCELERATORS
+
+GiB = 1024 ** 3
+
+
+def dtype_bytes(dt: Any) -> int:
+    """Width of a jnp/np dtype (or the strings 'bf16'/'f32'/'int8')."""
+    if dt is None:
+        return 4
+    if isinstance(dt, str):
+        return {"bf16": 2, "bfloat16": 2, "f32": 4, "float32": 4,
+                "f16": 2, "int8": 1}[dt]
+    import numpy as np
+    try:
+        return np.dtype(dt).itemsize
+    except TypeError:
+        # jnp dtype objects (e.g. jnp.bfloat16) expose .dtype.itemsize
+        return np.dtype(getattr(dt, "dtype", dt)).itemsize
+
+
+def count_params(cfg) -> int:
+    """Analytic leaf count of workload.init_params' tree (pinned against a
+    real init by tests/test_budget.py)."""
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
+    d_kv = (d // cfg.n_heads) * cfg.kv_heads
+    per_layer = d * d * 2 + d * d_kv * 2 + 2 * d          # attn + 2 LN
+    if cfg.n_experts:
+        per_layer += cfg.n_experts * 3 * d * f + d * cfg.n_experts
+    else:
+        per_layer += 3 * d * f
+    return v * d * 2 + d + cfg.n_layers * per_layer       # embed+out+ln_f
+
+
+@dataclasses.dataclass
+class TrainBreakdown:
+    params_gib: float
+    optimizer_gib: float
+    grads_gib: float
+    activations_gib: float
+    logits_gib: float
+    total_gib: float          # sum × safety margin
+    n_params: int
+    hbm_gib: Optional[float]  # per chip, None if accelerator unknown
+    fits: Optional[bool]
+    utilization: Optional[float]
+    note: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def train_hbm_breakdown(cfg, batch: int, *,
+                        mu_dtype: Any = "f32", nu_dtype: Any = None,
+                        fsdp: int = 1, tp: int = 1, dp: int = 1,
+                        sp: int = 1,
+                        accelerator: str = "",
+                        safety: float = 1.10) -> TrainBreakdown:
+    """Per-chip resident GiB for one AdamW train step of ``cfg`` at
+    per-replica ``batch``. Sharding factors follow the mesh semantics of
+    `workload.make_sharded_train_step`: params/optimizer/grads shard over
+    fsdp×tp; activations over dp×sp (``batch`` is the PER-dp-REPLICA
+    batch); the loss logits additionally over tp when
+    ``vocab_parallel_loss``."""
+    n = count_params(cfg)
+    master_b = dtype_bytes(cfg.master_dtype)
+    compute_b = dtype_bytes(cfg.dtype)
+    pshard = max(1, fsdp) * max(1, tp)
+    ashard = max(1, sp)
+    params_gib = n * master_b / pshard / GiB
+    if cfg.param_dtype is not None:
+        params_gib += n * compute_b / pshard / GiB   # the compute cast
+    opt_gib = n * (dtype_bytes(mu_dtype)
+                   + dtype_bytes(nu_dtype if nu_dtype is not None
+                                 else mu_dtype)) / pshard / GiB
+    grads_gib = n * master_b / pshard / GiB
+    d, f, s = cfg.d_model, cfg.d_ff, cfg.seq
+    ff_width = 3 * f * (cfg.moe_top_k if cfg.n_experts else 1)
+    block_internals = batch * s * (4 * d + ff_width) * compute_b
+    if cfg.attn == "naive":
+        block_internals += batch * cfg.n_heads * s * s * compute_b
+    residuals = cfg.n_layers * batch * s * d * compute_b
+    if cfg.remat:
+        acts = residuals + block_internals            # one block recomputes
+    else:
+        acts = residuals + cfg.n_layers * block_internals
+    acts_gib = acts / ashard / GiB
+    logits_gib = (batch * s * cfg.vocab * 4
+                  / (tp if cfg.vocab_parallel_loss else 1) / ashard / GiB)
+    total = (params_gib + opt_gib + grads_gib + acts_gib
+             + logits_gib) * safety
+    hbm = fits = util = None
+    note = (f"{n / 1e9:.2f}B params, shard fsdp{fsdp}×tp{tp}, "
+            f"acts ÷ sp{sp}, batch/replica {batch}, safety ×{safety}")
+    if accelerator:
+        acc = ACCELERATORS[accelerator]
+        hbm = acc.hbm_mb_per_chip / 1024
+        fits = total <= hbm
+        util = total / hbm
+    return TrainBreakdown(params_gib, opt_gib, grads_gib, acts_gib,
+                          logits_gib, total, n, hbm, fits, util, note)
+
+
+@dataclasses.dataclass
+class ServeBreakdown:
+    params_gib: float
+    kv_arena_gib: float
+    total_gib: float
+    hbm_gib: Optional[float]
+    fits: Optional[bool]
+    utilization: Optional[float]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def serve_hbm_breakdown(cfg, slots: int, max_seq: int, *, tp: int = 1,
+                        accelerator: str = "",
+                        safety: float = 1.10) -> ServeBreakdown:
+    """Per-chip resident GiB for the continuous-batching arena: tp-sharded
+    params + the (slots, max_seq, kv_heads, head_dim) K/V cache pair
+    (int8 cache: 1-byte values + f32 per-(row, head) scales)."""
+    n = count_params(cfg)
+    params_gib = n * dtype_bytes(cfg.dtype) / max(1, tp) / GiB
+    hd = cfg.d_model // cfg.n_heads
+    rows = slots * max_seq * cfg.kv_heads
+    if cfg.kv_cache_dtype == "int8":
+        per_layer = 2 * (rows * hd + rows * 4)
+    else:
+        per_layer = 2 * rows * hd * dtype_bytes(cfg.dtype)
+    kv_gib = cfg.n_layers * per_layer / max(1, tp) / GiB
+    total = (params_gib + kv_gib) * safety
+    hbm = fits = util = None
+    if accelerator:
+        acc = ACCELERATORS[accelerator]
+        hbm = acc.hbm_mb_per_chip / 1024
+        fits = total <= hbm
+        util = total / hbm
+    return ServeBreakdown(params_gib, kv_gib, total, hbm, fits, util)
+
+
+def tpu_memory_request_mb(breakdown) -> int:
+    """The breakdown as a ``google.com/tpu-memory`` request (MB) — the unit
+    `chip_node` fits fractional-chip placements in, so a what-if plan can
+    carry an arithmetically derived memory ask instead of a guess."""
+    return int(breakdown.total_gib * 1024 + 0.5)
+
+
+def validate_plan(plan: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-in/JSON-out plan check for the what-if CLI.
+
+    ``plan``: {"model": {d_model, n_layers, n_heads, d_ff, vocab, seq,
+    n_kv_heads?, n_experts?, moe_top_k?, dtype?: "bf16"|"f32",
+    param_dtype?, attn?, remat?, vocab_parallel_loss?},
+    "batch_per_replica": int, "mesh": {dp?, fsdp?, sp?, tp?},
+    "accelerator": "tpu-v5p", "mu_dtype"?: "bf16"|"f32", "safety"?}.
+
+    Returns the per-chip breakdown + chips implied by the mesh + verdict.
+    """
+    from .workload import ModelConfig
+    import jax.numpy as jnp
+    m = dict(plan["model"])
+    dt = {"bf16": jnp.bfloat16, "f32": jnp.float32}
+    m["dtype"] = dt[m.get("dtype", "bf16")]
+    if m.get("param_dtype"):
+        m["param_dtype"] = dt[m["param_dtype"]]
+    cfg = ModelConfig(**m)
+    mesh = {k: int(v) for k, v in (plan.get("mesh") or {}).items()}
+    bd = train_hbm_breakdown(
+        cfg, int(plan.get("batch_per_replica", 1)),
+        mu_dtype=plan.get("mu_dtype", "f32"),
+        nu_dtype=plan.get("nu_dtype"),
+        dp=mesh.get("dp", 1), fsdp=mesh.get("fsdp", 1),
+        sp=mesh.get("sp", 1), tp=mesh.get("tp", 1),
+        accelerator=plan.get("accelerator", ""),
+        safety=float(plan.get("safety", 1.10)))
+    chips = 1
+    for v in mesh.values():
+        chips *= max(1, v)
+    out = {"breakdown": bd.to_dict(), "chips": chips,
+           "tpu_memory_request_mb": tpu_memory_request_mb(bd),
+           "fits": bd.fits}
+    return out

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -288,7 +288,7 @@ def measure_train_step(cfg: ModelConfig, batch: int, k1: int = 2,
 
 def measure_adamw_train_step(cfg: ModelConfig, batch: int, k1: int = 1,
                              k2: int = 4, repeats: int = 3,
-                             lr: float = 1e-4
+                             lr: float = 1e-4, mu_dtype: Any = None
                              ) -> Tuple[float, float, Optional[float], str]:
     """Per-step seconds / TFLOP/s / MFU for AdamW training with full
     optimizer state — the representative-model line (VERDICT r2 #2).
@@ -307,13 +307,17 @@ def measure_adamw_train_step(cfg: ModelConfig, batch: int, k1: int = 1,
     (hundreds of ms) step time. mu is kept f32 (mu_dtype) over bf16
     params — the policy whose HBM cost llama_like_big's docstring accounts.
     MFU uses the standard 6N model-FLOPs convention, so remat's recompute
-    overhead shows up as lost MFU, not hidden FLOPs.
+    overhead shows up as lost MFU, not hidden FLOPs. ``mu_dtype`` defaults
+    to f32 (the classic policy llama_like_big accounts); pass
+    ``jnp.bfloat16`` for the pure-bf16-state policy that fits
+    llama_like_xl on a 16 GiB chip (nu follows the param dtype in optax).
 
     Returns (per_step_s, tflops, mfu, accounting_note).
     """
     import optax
 
-    tx = optax.adamw(lr, mu_dtype=jnp.float32)
+    tx = optax.adamw(lr, mu_dtype=mu_dtype if mu_dtype is not None
+                     else jnp.float32)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq),
                                 0, cfg.vocab, dtype=jnp.int32)
 
