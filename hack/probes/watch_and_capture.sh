@@ -17,7 +17,7 @@ for i in $(seq 1 200); do
     # hardware + this round's additions): if the tunnel wedges mid-tier,
     # the marginal evidence is already on disk. -u + -v: every test
     # result line flushes to the log as it happens.
-    CRIT="moe or seq8192 or adamw or remat or vocab or serve or speculative or decode or budget"
+    CRIT="moe or seq8192 or adamw or remat or vocab or serve or speculative or decode or budget or xl or flagship"
     echo "=== tests_tpu CRITICAL subset started $(date -u +%FT%TZ) ===" >> "$TIER"
     timeout --signal=INT --kill-after=60 3600 python -u -m pytest tests_tpu/ -v -k "$CRIT" >> "$TIER" 2>&1
     echo "critical rc=$? finished $(date -u +%FT%TZ)" >> "$TIER"
