@@ -229,7 +229,13 @@ def test_lease_election_over_http(api):
     assert not api.acquire_or_renew_lease("ctl", "bob", lease_duration=1)
     assert api.lease_holder("ctl") == "alice"
     assert api.acquire_or_renew_lease("ctl", "alice", lease_duration=1)
-    time.sleep(1.1)   # expiry: bob may steal
+    time.sleep(1.1)
+    # client-go expiry discipline: a challenger must OBSERVE the record
+    # unchanged for a full duration of ITS OWN clock before stealing —
+    # never by comparing its clock to the holder's renewTime stamp. The
+    # first post-expiry attempt only records the observation.
+    assert not api.acquire_or_renew_lease("ctl", "bob", lease_duration=30)
+    time.sleep(1.1)
     assert api.acquire_or_renew_lease("ctl", "bob", lease_duration=30)
     assert api.lease_holder("ctl") == "bob"
     lease = kube.KubeLease(api, "ctl")

@@ -66,6 +66,11 @@ class KubeError(RuntimeError):
         self.status = status
 
 
+class _HistoryGone(RuntimeError):
+    """Watch resume point compacted away (410 / ERROR event) — the one
+    disconnect that REQUIRES a relist."""
+
+
 # -- connection config --------------------------------------------------------
 
 class ConnectionInfo:
@@ -119,19 +124,28 @@ class ConnectionInfo:
                                    user.get("client-key"))
             cert_data, key_data = (user.get("client-certificate-data"),
                                    user.get("client-key-data"))
+            tmp_pems = []
             if cert_data and key_data:
                 # load_cert_chain is file-path only; materialize the PEMs
-                cf = tempfile.NamedTemporaryFile("w", suffix=".pem",
-                                                 delete=False)
-                cf.write(base64.b64decode(cert_data).decode())
-                cf.close()
-                kf = tempfile.NamedTemporaryFile("w", suffix=".pem",
-                                                 delete=False)
-                kf.write(base64.b64decode(key_data).decode())
-                kf.close()
-                cert_file, key_file = cf.name, kf.name
-            if cert_file and key_file:
-                sslctx.load_cert_chain(cert_file, key_file)
+                # briefly and unlink the moment the context has read them
+                # (leaking a private key into /tmp for the process's — or
+                # filesystem's — lifetime is not acceptable)
+                for blob in (cert_data, key_data):
+                    f = tempfile.NamedTemporaryFile("w", suffix=".pem",
+                                                    delete=False)
+                    f.write(base64.b64decode(blob).decode())
+                    f.close()
+                    tmp_pems.append(f.name)
+                cert_file, key_file = tmp_pems
+            try:
+                if cert_file and key_file:
+                    sslctx.load_cert_chain(cert_file, key_file)
+            finally:
+                for pth in tmp_pems:
+                    try:
+                        os.unlink(pth)
+                    except OSError:
+                        pass
         token = user.get("token", "")
         return cls(server, token=token, ssl_context=sslctx)
 
@@ -187,18 +201,31 @@ class _Transport:
     def request(self, method: str, path: str,
                 body: Optional[Dict[str, Any]] = None,
                 content_type: str = "application/json") -> Dict[str, Any]:
+        """One JSON request. Retry discipline: a SEND-phase failure (the
+        pooled keep-alive connection went stale) is retried once on a
+        fresh connection for every verb — a request that never finished
+        transmitting was not processed (Content-Length framing). A
+        RESPONSE-phase failure is retried only for idempotent verbs: the
+        server may have committed a write whose acknowledgment we lost,
+        and blindly re-POSTing e.g. pods/binding would turn a SUCCESSFUL
+        bind into a spurious Conflict. Non-idempotent verbs surface
+        KubeError(0, outcome-unknown) instead — the caller's failure path
+        (unreserve/retry) is the conservative recovery."""
         payload = (json.dumps(body).encode() if body is not None else None)
+        idempotent = method in ("GET", "HEAD")
         last_err: Optional[Exception] = None
         for attempt in (0, 1):   # one reconnect on a stale pooled connection
             conn = getattr(self._local, "conn", None)
             if conn is None:
                 conn = self._connect()
                 self._local.conn = conn
+            sent = False
             try:
                 conn.request(method, path, body=payload,
                              headers=self._headers(
                                  content_type if payload is not None
                                  else None))
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
                 break
@@ -209,6 +236,11 @@ class _Transport:
                     pass
                 self._local.conn = None
                 last_err = e
+                if sent and not idempotent:
+                    raise KubeError(
+                        0, f"{method} {path}: response lost after send — "
+                           f"outcome unknown, not retrying a "
+                           f"non-idempotent request: {e}")
         else:
             raise KubeError(0, f"connection failed: {last_err}")
         if resp.status == 404:
@@ -295,6 +327,10 @@ class KubeAPIServer:
         self._streams: List[Any] = []
         self._synced = threading.Event()
         self.field_manager = field_manager
+        # leader-election observations: lease name → ((holder, renewTime,
+        # rv), local monotonic time first seen) — expiry is judged against
+        # local observation age, never by comparing clocks across nodes
+        self._lease_obs: Dict[str, Tuple[Tuple[str, str, str], float]] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -350,13 +386,36 @@ class KubeAPIServer:
 
     def _watch_loop(self, kind: str) -> None:
         info = codec.KINDS[kind]
+        need_relist = False
         while not self._stop.is_set():
+            if need_relist:
+                # history gap (410 Gone / ERROR event): the RV we hold is
+                # compacted away — relist and diff. NOT done on routine
+                # disconnects: a full LIST per kind per 5-minute watch
+                # expiry would be sustained apiserver load that grows with
+                # cluster size; a clean re-watch from the last RV is the
+                # client-go reflector contract.
+                try:
+                    self._initial_list(kind)
+                    need_relist = False
+                except (KubeError, NotFound, OSError) as e:
+                    klog.V(2).info_s("relist failed; backing off",
+                                     kind=kind, error=str(e))
+                    self._stop.wait(1.0)
+                    continue
             path = (info.collection_path() + "?" + urlencode(
                 {"watch": "true", "resourceVersion": str(self._rv[kind]),
                  "allowWatchBookmarks": "true", "timeoutSeconds": "300"}))
             try:
                 conn, resp = self._tx.open_stream(path)
-            except (KubeError, OSError) as e:
+            except KubeError as e:
+                need_relist = need_relist or e.status == 410
+                if not self._stop.is_set():
+                    klog.V(2).info_s("watch connect failed; backing off",
+                                     kind=kind, error=str(e))
+                    self._stop.wait(1.0)
+                continue
+            except OSError as e:
                 if not self._stop.is_set():
                     klog.V(2).info_s("watch connect failed; backing off",
                                      kind=kind, error=str(e))
@@ -366,6 +425,8 @@ class KubeAPIServer:
                 self._streams.append(conn)
             try:
                 self._consume_stream(kind, info, resp)
+            except _HistoryGone:
+                need_relist = True
             except Exception:
                 # disconnect → re-watch from last rv. Broad on purpose:
                 # http.client can surface ValueError/AttributeError when a
@@ -380,15 +441,6 @@ class KubeAPIServer:
                     conn.close()
                 except OSError:
                     pass
-            if self._stop.is_set():
-                return
-            # 410-Gone or plain disconnect: relist (cheap no-op if current)
-            try:
-                self._initial_list(kind)
-            except (KubeError, NotFound, OSError) as e:
-                klog.V(2).info_s("relist failed; backing off", kind=kind,
-                                 error=str(e))
-                self._stop.wait(1.0)
 
     def _consume_stream(self, kind: str, info: codec.KindInfo, resp) -> None:
         while not self._stop.is_set():
@@ -408,7 +460,7 @@ class KubeAPIServer:
                 continue
             if etype == "ERROR":
                 # typically 410 Gone: force the relist path
-                raise ValueError(f"watch error event: {ev.get('object')}")
+                raise _HistoryGone(f"watch error event: {ev.get('object')}")
             obj = info.decode(ev.get("object") or {})
             key = obj.meta.key
             with self._lock:
@@ -567,9 +619,19 @@ class KubeAPIServer:
         raise Conflict(f"{kind} {key}: patch kept conflicting: {last}")
 
     def delete(self, kind: str, key: str) -> None:
+        """DELETE with the in-memory server's semantics: pods go with
+        gracePeriodSeconds=0 (a real apiserver's default 30 s grace would
+        leave the pod Terminating, and this stack's delete-then-recreate
+        flows — defrag migration, soak churn — would 409 on the recreate),
+        and the cache entry is evicted immediately for read-your-writes
+        symmetry with ``_observe_write`` (idempotent against the DELETED
+        watch event that follows)."""
         info = codec.KINDS[kind]
-        self._tx.request("DELETE", info.object_path(key))
-        # the DELETED watch event evicts the cache entry; no local mutation
+        body = ({"kind": "DeleteOptions", "apiVersion": "v1",
+                 "gracePeriodSeconds": 0} if kind == srv.PODS else None)
+        self._tx.request("DELETE", info.object_path(key), body)
+        with self._lock:
+            self._cache[kind].pop(key, None)
 
     def _observe_write(self, kind: str, obj) -> None:
         """Fold a write's response into the cache immediately (bounded
@@ -648,10 +710,25 @@ class KubeAPIServer:
                 return False   # lost the creation race
         spec = cur.get("spec") or {}
         cur_holder = spec.get("holderIdentity", "")
-        renew = codec.decode_time(spec.get("renewTime")) or 0.0
         duration = float(spec.get("leaseDurationSeconds") or 15.0)
-        if cur_holder and cur_holder != holder and now - renew <= duration:
-            return False
+        if cur_holder and cur_holder != holder:
+            # Expiry is judged on OUR clock against OUR observations — the
+            # client-go leaderelection discipline. Comparing now() to the
+            # holder's self-stamped renewTime would let a campaigner whose
+            # clock runs > duration ahead steal the lease from a live
+            # leader (split-brain); instead, the record must be OBSERVED
+            # UNCHANGED for a full duration of local monotonic time before
+            # it counts as expired.
+            record = (cur_holder, spec.get("renewTime", ""),
+                      str((cur.get("metadata") or {}).get(
+                          "resourceVersion", "")))
+            seen = self._lease_obs.get(name)
+            mono = time.monotonic()
+            if seen is None or seen[0] != record:
+                self._lease_obs[name] = (record, mono)
+                return False
+            if mono - seen[1] <= duration:
+                return False
         body["metadata"]["resourceVersion"] = str(
             (cur.get("metadata") or {}).get("resourceVersion", ""))
         try:

@@ -244,6 +244,8 @@ class FakeKube:
                 return self._json(200, merged)
 
             def do_DELETE(self):
+                self._read_body()   # DeleteOptions: drain it off the
+                # keep-alive socket (unread bytes corrupt the next request)
                 r = self._route()
                 if r is None:
                     return self._status(404, "unroutable")
