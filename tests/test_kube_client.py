@@ -188,6 +188,42 @@ def test_patch_preserves_unmodeled_fields(api, fake):
     assert raw["spec"]["containers"][0]["volumeMounts"][0]["name"] == "v"
 
 
+def test_status_writes_ride_the_status_subresource(api, fake):
+    """The CRDs declare `subresources: status`, so a real apiserver
+    silently DROPS status fields patched to the main resource (the fake
+    enforces that). A mutate touching spec AND status must land both —
+    proving the client splits the patch across the two endpoints."""
+    api.create(srv.POD_GROUPS, make_pod_group("st", min_member=2))
+
+    def mutate(pg):
+        pg.spec.min_member = 5
+        pg.status.phase = "Scheduling"
+        pg.status.scheduled = 2
+
+    got = api.patch(srv.POD_GROUPS, "default/st", mutate)
+    assert got.spec.min_member == 5
+    assert got.status.phase == "Scheduling"
+    raw = fake.object("podgroups", "default", "st")
+    assert raw["spec"]["minMember"] == 5
+    assert raw["status"]["phase"] == "Scheduling"
+    assert raw["status"]["scheduled"] == 2
+    # status-only mutate: exactly one write, to /status
+    got = api.patch(srv.POD_GROUPS, "default/st",
+                    lambda pg: setattr(pg.status, "phase", "Scheduled"))
+    assert got.status.phase == "Scheduled"
+    raw = fake.object("podgroups", "default", "st")
+    assert raw["status"]["phase"] == "Scheduled"
+    assert raw["spec"]["minMember"] == 5
+    # control: the fake really does drop main-resource status writes
+    from tpusched.apiserver.kubecodec import KINDS
+    info = KINDS[srv.POD_GROUPS]
+    api._tx.request("PATCH", info.object_path("default/st"),
+                    {"status": {"phase": "Bogus"}},
+                    content_type="application/merge-patch+json")
+    raw = fake.object("podgroups", "default", "st")
+    assert raw["status"]["phase"] == "Scheduled"   # unchanged
+
+
 def test_bind_subresource_contract(api, fake):
     """Bind = POST pods/binding: nodeName set, Binding annotations merged
     into the pod (the device-index contract, flex_gpu.go:230-242),

@@ -580,14 +580,39 @@ class KubeAPIServer:
         patch = codec.merge_patch(info.encode(live), info.encode(obj))
         if not _scrub_patch_meta(patch):
             return live
-        patch.setdefault("metadata", {})["resourceVersion"] = str(
-            live.meta.resource_version)
-        doc = self._tx.request("PATCH", info.object_path(obj.meta.key),
-                               patch,
-                               content_type="application/merge-patch+json")
+        doc = self._send_patch(info, obj.meta.key, patch,
+                               live.meta.resource_version)
         updated = info.decode(doc)
         self._observe_write(kind, updated)
         return updated
+
+    def _send_patch(self, info: codec.KindInfo, key: str,
+                    patch: Dict[str, Any], rv: int) -> Dict[str, Any]:
+        """Transmit a computed merge patch, honoring the kind's /status
+        subresource: a real apiserver IGNORES status fields written to the
+        main resource, so status changes ship as a second PATCH to
+        ``{path}/status`` (chained on the first PATCH's resourceVersion).
+        ``mutate`` callbacks are pure, so a Conflict between the two legs
+        retries cleanly from the caller's loop."""
+        status_part = (patch.pop("status", None)
+                       if info.status_sub else None)
+        doc: Optional[Dict[str, Any]] = None
+        if _scrub_patch_meta(patch):
+            patch.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            doc = self._tx.request(
+                "PATCH", info.object_path(key), patch,
+                content_type="application/merge-patch+json")
+            rv_str = (doc.get("metadata") or {}).get("resourceVersion")
+        else:
+            rv_str = str(rv)
+        if status_part is not None:
+            doc = self._tx.request(
+                "PATCH", info.object_path(key) + "/status",
+                {"metadata": {"resourceVersion": rv_str},
+                 "status": status_part},
+                content_type="application/merge-patch+json")
+        assert doc is not None   # caller guarantees a non-empty patch
+        return doc
 
     def patch(self, kind: str, key: str,
               mutate: Callable[[Any], None]) -> Any:
@@ -604,12 +629,9 @@ class KubeAPIServer:
             patch = codec.merge_patch(before, info.encode(live))
             if not _scrub_patch_meta(patch):
                 return live
-            patch.setdefault("metadata", {})["resourceVersion"] = str(
-                live.meta.resource_version)
             try:
-                doc = self._tx.request(
-                    "PATCH", info.object_path(key), patch,
-                    content_type="application/merge-patch+json")
+                doc = self._send_patch(info, key, patch,
+                                       live.meta.resource_version)
             except Conflict as e:
                 last = e
                 continue

@@ -423,7 +423,8 @@ class KindInfo:
     def __init__(self, kind: str, api_version: str, k8s_kind: str,
                  plural: str, namespaced: bool,
                  encode: Callable[[Any], Dict[str, Any]],
-                 decode: Callable[[Dict[str, Any]], Any]):
+                 decode: Callable[[Dict[str, Any]], Any],
+                 status_sub: bool = False):
         self.kind = kind
         self.api_version = api_version
         self.k8s_kind = k8s_kind
@@ -431,6 +432,11 @@ class KindInfo:
         self.namespaced = namespaced
         self.encode = encode
         self.decode = decode
+        # the kind serves a /status subresource: a real apiserver IGNORES
+        # status fields written to the main resource, so the client must
+        # split writes (manifests/crds declare `subresources: status` for
+        # the CRDs; pods/nodes/PDBs have it built in)
+        self.status_sub = status_sub
 
     def collection_path(self, namespace: Optional[str] = None) -> str:
         base = ("/api/v1" if self.api_version == "v1"
@@ -447,19 +453,22 @@ class KindInfo:
 
 
 KINDS: Dict[str, KindInfo] = {k.kind: k for k in (
-    KindInfo(srv.PODS, "v1", "Pod", "pods", True, encode_pod, decode_pod),
+    KindInfo(srv.PODS, "v1", "Pod", "pods", True, encode_pod, decode_pod,
+             status_sub=True),
     KindInfo(srv.NODES, "v1", "Node", "nodes", False,
-             encode_node, decode_node),
+             encode_node, decode_node, status_sub=True),
     KindInfo(srv.POD_GROUPS, "scheduling.tpu.dev/v1alpha1", "PodGroup",
-             "podgroups", True, encode_podgroup, decode_podgroup),
+             "podgroups", True, encode_podgroup, decode_podgroup,
+             status_sub=True),
     KindInfo(srv.ELASTIC_QUOTAS, "scheduling.tpu.dev/v1alpha1",
              "ElasticQuota", "elasticquotas", True,
-             encode_elasticquota, decode_elasticquota),
+             encode_elasticquota, decode_elasticquota, status_sub=True),
     KindInfo(srv.PRIORITY_CLASSES, "scheduling.k8s.io/v1", "PriorityClass",
              "priorityclasses", False,
              encode_priorityclass, decode_priorityclass),
     KindInfo(srv.PDBS, "policy/v1", "PodDisruptionBudget",
-             "poddisruptionbudgets", True, encode_pdb, decode_pdb),
+             "poddisruptionbudgets", True, encode_pdb, decode_pdb,
+             status_sub=True),
     KindInfo(srv.TPU_TOPOLOGIES, "topology.tpu.dev/v1alpha1", "TpuTopology",
              "tputopologies", False,
              encode_tputopology, decode_tputopology),

@@ -39,6 +39,12 @@ from ..apiserver.kubecodec import apply_merge_patch
 NAMESPACED = {"pods", "podgroups", "elasticquotas", "poddisruptionbudgets",
               "leases", "events"}
 CLUSTER = {"nodes", "priorityclasses", "tputopologies"}
+# kinds serving a /status subresource (the CRDs declare it; pods/nodes/PDBs
+# have it built in): writes to the MAIN resource must ignore status, and
+# writes to /status must apply ONLY status — the real apiserver contract
+# that forces clients to split their patches.
+STATUS_SUB = {"pods", "nodes", "podgroups", "elasticquotas",
+              "poddisruptionbudgets"}
 
 
 class _Store:
@@ -192,7 +198,7 @@ class FakeKube:
                 r = self._route()
                 if r is None:
                     return self._status(404, "unroutable")
-                plural, ns, name, _sub = r
+                plural, ns, name, sub = r
                 st = self.srv_store
                 body = self._read_body()
                 key = self._key(plural, ns, name)
@@ -205,6 +211,15 @@ class FakeKube:
                     if sent_rv and str(sent_rv) != \
                             cur["metadata"]["resourceVersion"]:
                         return self._status(409, "resourceVersion conflict")
+                    if sub == "status":
+                        # /status PUT: only the status field applies (deep
+                        # copy — stored objects are aliased by the log)
+                        body = json.loads(json.dumps(
+                            {**cur, "status": body.get("status")}))
+                    elif plural in STATUS_SUB:
+                        body["status"] = json.loads(json.dumps(
+                            cur.get("status"))) if cur.get("status") \
+                            is not None else None
                     meta = body.setdefault("metadata", {})
                     meta["uid"] = cur["metadata"]["uid"]
                     meta["creationTimestamp"] = \
@@ -221,7 +236,7 @@ class FakeKube:
                 r = self._route()
                 if r is None:
                     return self._status(404, "unroutable")
-                plural, ns, name, _sub = r
+                plural, ns, name, sub = r
                 st = self.srv_store
                 patch = self._read_body()
                 key = self._key(plural, ns, name)
@@ -236,6 +251,14 @@ class FakeKube:
                         return self._status(409, "resourceVersion conflict")
                     if isinstance(patch.get("metadata"), dict):
                         patch["metadata"].pop("resourceVersion", None)
+                    if sub == "status":
+                        patch = ({"status": patch["status"]}
+                                 if "status" in patch else {})
+                    elif plural in STATUS_SUB:
+                        # the real apiserver contract: the main resource
+                        # silently drops status writes for subresourced
+                        # kinds — clients MUST use /status
+                        patch.pop("status", None)
                     merged = apply_merge_patch(cur, patch)
                     merged["metadata"]["uid"] = cur["metadata"]["uid"]
                     merged["metadata"]["resourceVersion"] = str(st.bump())
