@@ -654,8 +654,15 @@ def fleet_gang_times(repeats: int) -> list:
                            for p in inf.pods]
                 return not last
             if not wait_until(_drained, timeout=90):
+                # diagnosable failure: for each straggler, is it still in
+                # the API (delete lost?) or cache-only (assume ghost /
+                # missed DELETE event)?
+                detail = [(k, c.pod(k) is not None,
+                           c.scheduler.cache.is_assumed(k))
+                          for k in last[:8]]
                 raise RuntimeError(
-                    f"measured gang did not tear down; lingering: {last[:8]}")
+                    "measured gang did not tear down; lingering "
+                    f"(key, in_api, assumed): {detail}")
     return times
 
 
@@ -1237,6 +1244,12 @@ def main() -> int:
         except Exception as e:  # keep the headline line alive no matter what
             emit(f"{bench.__name__} FAILED: {type(e).__name__}: {e}",
                  None, "", None)
+            if _GATE and bench is not bench_tpu_workload:
+                # a scenario that CRASHES must not bypass its own gate (its
+                # latency line was never emitted, so no budget would fire).
+                # The TPU tier is exempt: its absence is the hardware's.
+                _gate_failures.append(
+                    f"{bench.__name__} crashed: {type(e).__name__}: {e}")
     bench_gang()
     if _gate_failures:
         for f in _gate_failures:
