@@ -280,15 +280,21 @@ def test_int8_kv_cache_decode_tracks_exact():
     assert agreement >= 0.8, f"int8 KV diverged too much: {agreement:.2f}"
 
 
-def test_int8_kv_cache_rejected_by_serve_engine():
-    """The serving arena's insert programs write raw rows; a quantized
-    cache there would corrupt silently — must refuse at construction."""
+def test_int8_kv_arena_scope():
+    """The int8 arena composes with monolithic admission (round 5 — the
+    insert programs quantize through decode's write discipline); chunked
+    prefill is refused: its queries would attend DEQUANTIZED history
+    where monolithic attends fresh values, silently breaking the
+    chunk-size-invariance contract."""
     from tpusched.jaxbridge.serve import ServeEngine
     cfg8 = dataclasses.replace(workload.ModelConfig.tiny(),
                                kv_cache_dtype="int8")
     params = workload.init_params(jax.random.PRNGKey(0), cfg8)
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        ServeEngine(params, cfg8, slots=2, max_seq=64, prompt_bucket=16)
+    eng = ServeEngine(params, cfg8, slots=2, max_seq=64, prompt_bucket=16)
+    assert eng.cache[0]["k"].dtype == jnp.int8 and "ks" in eng.cache[0]
+    with pytest.raises(ValueError, match="monolithic admission"):
+        ServeEngine(params, cfg8, slots=2, max_seq=64, prompt_bucket=16,
+                    chunk_prefill=4)
     # the natural misconfiguration fails loudly at config construction
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         dataclasses.replace(workload.ModelConfig.tiny(),

@@ -632,6 +632,77 @@ def test_speculative_engine_on_tp_mesh_matches_plain(model):
     assert acc > 0.5   # self-draft: near-total acceptance
 
 
+def test_int8_kv_arena_matches_solo_int8(model):
+    """int8 KV arena (round 5): the engine's monolithic admission
+    quantizes slot inserts exactly like solo prefill (fresh-KV prefill
+    attention, per-(row, head) quant at write, fused dequant at cached
+    reads), so continuous batching over the QUANTIZED arena is
+    result-identical to solo int8 generate — the same parity contract the
+    exact arena carries, at half the KV bytes."""
+    import dataclasses
+    cfg, params = model
+    i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    eng = ServeEngine(params, i8, slots=3, max_seq=64, prompt_bucket=16)
+    rng = np.random.default_rng(29)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 15, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 9)))
+            for i in range(8)]
+    for r in reqs:
+        eng.submit(r)
+    for c in eng.run_until_drained():
+        req = next(r for r in reqs if r.rid == c.rid)
+        solo = np.asarray(generate(params, req.prompt[None, :], i8,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo,
+                                      err_msg=f"request {c.rid}")
+
+
+def test_int8_speculative_matches_plain_int8(model):
+    """Speculative decoding over an int8 TARGET arena (draft stays exact,
+    enforced): the verify span writes/reads the same quantized rows
+    sequential decode would, so emitted streams equal the plain int8
+    engine token-for-token."""
+    import dataclasses
+    cfg, params = model
+    i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    spec = ServeEngine(params, i8, slots=2, max_seq=64, prompt_bucket=16,
+                       draft_params=params, draft_cfg=cfg, spec_k=3)
+    plain = ServeEngine(params, i8, slots=2, max_seq=64, prompt_bucket=16)
+    rng = np.random.default_rng(31)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 14, cfg.vocab),
+                    max_new_tokens=int(rng.integers(3, 8)))
+            for i in range(5)]
+    for e in (spec, plain):
+        for r in reqs:
+            e.submit(r)
+    got = {c.rid: list(c.tokens) for c in spec.run_until_drained()}
+    want = {c.rid: list(c.tokens) for c in plain.run_until_drained()}
+    assert got == want
+
+
+def test_int8_arena_on_tp_mesh(model):
+    """int8 arena + tensor-parallel mesh: values AND scale planes shard
+    over kv_heads; parity against single-device int8 engine holds."""
+    import dataclasses
+    from jax.sharding import Mesh
+    cfg, params = model
+    i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    sharded = ServeEngine(params, i8, slots=2, max_seq=64,
+                          prompt_bucket=16, mesh=mesh)
+    solo = ServeEngine(params, i8, slots=2, max_seq=64, prompt_bucket=16)
+    assert "ks" in sharded.cache[0]
+    rng = np.random.default_rng(37)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 4, 12, cfg.vocab),
+                    max_new_tokens=5) for i in range(4)]
+    for e in (sharded, solo):
+        for r in reqs:
+            e.submit(r)
+    got = {c.rid: list(c.tokens) for c in sharded.run_until_drained()}
+    want = {c.rid: list(c.tokens) for c in solo.run_until_drained()}
+    assert got == want
+
+
 def test_sampled_engine_is_deterministic_and_bounded(model):
     """Non-greedy serving (temperature/top-k/top-p): no solo-parity
     contract exists (RNG consumption differs by construction), but the

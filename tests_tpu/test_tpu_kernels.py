@@ -545,3 +545,33 @@ def test_xl_flagship_fits_and_trains_on_chip(tpu):
         losses.append(float(loss))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_int8_kv_arena_serving_on_chip(tpu):
+    """int8 serving arena under the real Mosaic lowering: quantized slot
+    inserts + fused dequant at cached reads must emit exactly the solo
+    int8 stream (CPU pins the math; this pins the lowering)."""
+    import dataclasses
+    import numpy as np
+    from tpusched.jaxbridge.decode import generate
+    from tpusched.jaxbridge.serve import Request, ServeEngine
+    from tpusched.jaxbridge.workload import ModelConfig, init_params
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), kv_cache_dtype="int8")
+    params = init_params(jax.random.PRNGKey(21), cfg)
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16)
+    assert eng.cache[0]["k"].dtype == jnp.int8
+    rng = np.random.default_rng(22)
+    reqs = [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab,
+                                        size=int(rng.integers(4, 12)),
+                                        dtype=np.int32),
+                    max_new_tokens=int(rng.integers(3, 7)))
+            for i in range(4)]
+    for r in reqs:
+        eng.submit(r)
+    for c in eng.run_until_drained():
+        req = next(r for r in reqs if r.rid == c.rid)
+        solo = np.asarray(generate(params, req.prompt[None, :], cfg,
+                                   steps=req.max_new_tokens - 1))[0]
+        np.testing.assert_array_equal(c.tokens, solo)

@@ -30,9 +30,10 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
     if cfg.kv_cache_dtype == "int8":
         # quantized cache: int8 values + one f32 scale per (seq row, kv
         # head) — decode streams HALF the KV bytes, the term that
-        # dominates the bandwidth roofline at long context. Opt-in and
-        # decode-path-only (the serving arena's insert programs write
-        # rows directly and guard against it).
+        # dominates the bandwidth roofline at long context. Opt-in; the
+        # serving arena supports it under monolithic admission
+        # (serve._arena_write quantizes slot inserts; chunked prefill is
+        # excluded — see the engine's constructor).
         return [{"k": jnp.zeros(shape, jnp.int8),
                  "v": jnp.zeros(shape, jnp.int8),
                  "ks": jnp.zeros(shape[:3], jnp.float32),

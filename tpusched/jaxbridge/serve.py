@@ -51,8 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .decode import (KVCache, _cached_attention, decode_step, init_kv_cache,
-                     sample_token)
+from .decode import (KVCache, _cached_attention, _quantize_kv, decode_step,
+                     init_kv_cache, sample_token)
 from .workload import (ModelConfig, Params, _finish_block, _qkv,
                        _resolve_attn_fn, _rmsnorm, cast_params_for_compute,
                        param_specs)
@@ -81,10 +81,32 @@ class Completion:
     finished_tick: int
 
 
+def _arena_write(c: Dict[str, jax.Array], k: jax.Array, v: jax.Array,
+                 slot, off) -> Dict[str, jax.Array]:
+    """Insert freshly-computed K/V rows (1, n, kv, hd) into ONE slot's
+    arena rows [off, off+n) — the engine-side counterpart of
+    decode.cache_update (which writes batch-aligned rows). Quantizes on
+    the way in when the arena is int8, scale planes included, so every
+    slot-targeted insert shares one write discipline."""
+    if "ks" in c:
+        qk, ks = _quantize_kv(k)
+        qv, vs = _quantize_kv(v)
+        return {
+            "k": jax.lax.dynamic_update_slice(c["k"], qk, (slot, off, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(c["v"], qv, (slot, off, 0, 0)),
+            "ks": jax.lax.dynamic_update_slice(c["ks"], ks, (slot, off, 0)),
+            "vs": jax.lax.dynamic_update_slice(c["vs"], vs, (slot, off, 0)),
+        }
+    return {"k": jax.lax.dynamic_update_slice(c["k"], k, (slot, off, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(c["v"], v, (slot, off, 0, 0))}
+
+
 def _build_prefill_slot(cfg: ModelConfig, prompt_bucket: int):
     """jitted (params, cache, padded_prompt, slot, true_len) →
     (cache', first_logits): compute the single row's prompt K/V with the
-    configured attention and insert them into the slot's arena rows."""
+    configured attention and insert them into the slot's arena rows.
+    Prefill attention uses the FRESH K/V (decode.py's convention:
+    quantization error enters only at cached reads)."""
     attn_fn = _resolve_attn_fn(cfg)
 
     def run(params: Params, cache: KVCache, prompt: jax.Array,
@@ -96,12 +118,11 @@ def _build_prefill_slot(cfg: ModelConfig, prompt_bucket: int):
             h = _rmsnorm(x, layer["ln_attn"])
             q, k, v = _qkv(h, layer, cfg)
             # insert the row's K/V into ITS slot only
-            ck = jax.lax.dynamic_update_slice(c["k"], k, (slot, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(c["v"], v, (slot, 0, 0, 0))
+            c2 = _arena_write(c, k, v, slot, 0)
             out, _ = _finish_block(x, layer, attn_fn(q, k, v), cfg,
                                    dropless=True)
             x = out
-            new_cache.append({"k": ck, "v": cv})
+            new_cache.append(c2)
         x = _rmsnorm(x, params["ln_f"])
         logits = x[0] @ params["out"]                    # (bucket, vocab)
         # the next-token logits live at the LAST REAL prompt position
@@ -181,9 +202,7 @@ def _build_prefix_insert(cfg: ModelConfig):
     def run(cache: KVCache, kv, slot: jax.Array):
         out: KVCache = []
         for c, x in zip(cache, kv):
-            ck = jax.lax.dynamic_update_slice(c["k"], x["k"], (slot, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(c["v"], x["v"], (slot, 0, 0, 0))
-            out.append({"k": ck, "v": cv})
+            out.append(_arena_write(c, x["k"], x["v"], slot, 0))
         return out
 
     return jax.jit(run, donate_argnums=(0,))
@@ -266,14 +285,20 @@ class ServeEngine:
         if not buckets or buckets[-1] >= max_seq:
             raise ValueError("prompt buckets must be non-empty and leave "
                              "generation room under max_seq")
-        if cfg.kv_cache_dtype is not None:
-            # the engine's prefill/chunk/prefix programs dynamic_update_slice
-            # raw K/V rows into the arena; a quantized cache would need the
-            # scale planes threaded through every one of them — reject
-            # loudly rather than corrupt silently
-            raise ValueError("ServeEngine requires the exact KV cache "
-                             "(cfg.kv_cache_dtype=None); int8 KV is a "
-                             "decode-path option")
+        if cfg.kv_cache_dtype is not None and chunk_prefill is not None:
+            # int8 + chunked admission is a PARITY trap, not a plumbing
+            # gap: a chunk's queries attend earlier chunks through the
+            # DEQUANTIZED cache, while monolithic prefill (and solo
+            # decode.generate) attend the fresh values — the outputs
+            # would legitimately differ and the engine's result-identical
+            # contract (chunk-size-invariance) could not hold. Monolithic
+            # int8 admission quantizes exactly like solo prefill, so
+            # engine-vs-solo parity stays EXACT.
+            raise ValueError(
+                "int8 KV arena composes with monolithic admission only: "
+                "chunked prefill would attend dequantized history where "
+                "monolithic attends fresh values, breaking result parity "
+                "(kv_cache_dtype=None for chunk_prefill/prefix caching)")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -313,9 +338,15 @@ class ServeEngine:
             # sizes even when every shard fits)
             kv_sh = NamedSharding(mesh, P(None, None, tp_axis, None))
             self._kv_shard = kv_sh
+            entry_sh: Dict[str, NamedSharding] = {"k": kv_sh, "v": kv_sh}
+            if cfg.kv_cache_dtype == "int8":
+                # scale planes (slots, max_seq, kv_heads) shard over the
+                # same kv_heads axis as their values
+                scale_sh = NamedSharding(mesh, P(None, None, tp_axis))
+                entry_sh.update({"ks": scale_sh, "vs": scale_sh})
             self.cache = jax.jit(
                 lambda: init_kv_cache(cfg, slots, max_seq),
-                out_shardings=[{"k": kv_sh, "v": kv_sh}
+                out_shardings=[dict(entry_sh)
                                for _ in range(cfg.n_layers)])()
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg

@@ -60,11 +60,13 @@ class ModelConfig:
     # them — O(layers) residuals instead of O(layers × block internals),
     # the HBM trade that fits ~1B-param AdamW training on a 16 GB chip
     remat: bool = False
-    # KV-cache storage dtype for the DECODE path: None ⇒ `dtype` (exact),
-    # "int8" ⇒ symmetric per-(row, kv-head) quantization — halves the KV
-    # bytes each decode step streams, the dominant roofline term at long
-    # context. Approximate (bounded by the per-head scale), decode-only;
-    # the serving arena rejects it (its insert programs write raw rows).
+    # KV-cache storage dtype for the inference paths: None ⇒ `dtype`
+    # (exact), "int8" ⇒ symmetric per-(row, kv-head) quantization — halves
+    # the KV bytes each decode step streams, the dominant roofline term at
+    # long context. Approximate (bounded by the per-head scale). The
+    # serving arena supports it under monolithic admission (engine ==
+    # solo-int8 exactly); chunked prefill refuses it (dequantized-history
+    # asymmetry would break chunk-size invariance).
     kv_cache_dtype: Any = None
 
     @property
