@@ -1087,6 +1087,42 @@ def bench_tpu_workload() -> None:
         emit(f"batched speculative serving bench FAILED: "
              f"{type(e).__name__}: {e}", None, "", None)
 
+    # int8 KV ARENA serving (round 5): same long-context request set
+    # through the exact and the quantized arena — the KV stream is the
+    # dominant roofline term at long context, so the int8 engine's
+    # tokens/s should pull ahead exactly where the budget says the bytes
+    # halve. vs_baseline = int8/exact tokens/s ratio.
+    try:
+        from tpusched.jaxbridge import budget as _bm
+        l_cfg = dataclasses.replace(cfg, seq=2048)
+        l_params = _init(jax.random.PRNGKey(4), l_cfg)
+        rng = _np.random.default_rng(5)
+        lreqs = [Request(rid=i,
+                         prompt=rng.integers(0, l_cfg.vocab,
+                                             size=int(rng.integers(
+                                                 512, 1024)),
+                                             dtype=_np.int32),
+                         max_new_tokens=int(rng.integers(32, 96)))
+                 for i in range(12)]
+        exact = measure_serving(l_cfg, l_params, lreqs, slots=8,
+                                max_seq=2048, prompt_bucket=1024)
+        i8_cfg = dataclasses.replace(l_cfg, kv_cache_dtype="int8")
+        quant = measure_serving(i8_cfg, l_params, lreqs, slots=8,
+                                max_seq=2048, prompt_bucket=1024)
+        exact_gib = _bm.serve_hbm_breakdown(l_cfg, 8, 2048).kv_arena_gib
+        int8_gib = _bm.serve_hbm_breakdown(i8_cfg, 8, 2048).kv_arena_gib
+        emit("int8 KV arena serving, long prompts 512-1024, 8 slots x "
+             f"2048 rows: {quant['tokens_per_s']:.0f} vs exact "
+             f"{exact['tokens_per_s']:.0f} tok/s; arena "
+             f"{int8_gib:.2f} vs {exact_gib:.2f} GiB "
+             "(single v5e chip; vs_baseline = int8/exact tok/s)",
+             round(quant["tokens_per_s"], 1), "tokens/s",
+             round(quant["tokens_per_s"]
+                   / max(exact["tokens_per_s"], 1e-9), 2))
+    except Exception as e:  # noqa: BLE001
+        emit(f"int8 arena serving bench FAILED: {type(e).__name__}: {e}",
+             None, "", None)
+
     # serving SLO, wall-clock, ON CHIP: the seconds the tick-gated CPU
     # lines (bench_serving_slo) stand in for. Same harness, production-ish
     # arrival pressure, 155M model.
