@@ -216,13 +216,16 @@ def draft_rollout(params: Params, cache: KVCache, feed: jax.Array, pos,
     return jnp.concatenate([toks, last[None]], axis=0).T, cache
 
 
-def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0) -> jax.Array:
-    """One sampling decision per row of ``logits`` (b, vocab) — temperature,
-    top-k, and nucleus (top-p) filtering composed in the usual order, all
-    static-shape so the decode loop jits. temperature == 0 is argmax."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+def adjusted_logits(logits: jax.Array, temperature: float = 1.0,
+                    top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """THE sampling-distribution definition: temperature, top-k, and
+    nucleus (top-p) filtering composed in the usual order over rows of
+    ``logits`` (b, vocab), returning masked/scaled f32 logits whose
+    softmax IS the distribution sampling draws from. Factored out of
+    sample_token so speculative SAMPLING (spec_decode.speculative_sample)
+    computes its acceptance ratios against the exact distributions the
+    samplers use — two definitions would drift. temperature must be > 0
+    (0 is the greedy paths' short-circuit)."""
     logits = logits.astype(jnp.float32) / temperature
     vocab = logits.shape[-1]
     if 0 < top_k < vocab:
@@ -243,7 +246,17 @@ def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
         threshold = jnp.min(
             jnp.where(dropped, jnp.inf, sorted_desc), axis=-1, keepdims=True)
         logits = jnp.where(logits >= threshold, logits, attention.NEG_INF)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
+
+
+def sample_token(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """One sampling decision per row of ``logits`` (b, vocab) — categorical
+    over ``adjusted_logits``; temperature == 0 is argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        key, adjusted_logits(logits, temperature, top_k, top_p), axis=-1)
 
 
 def sample(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
@@ -277,3 +290,70 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
     is never consumed). One prefill/scan loop definition serves both."""
     return sample(params, prompt, cfg, steps, key=jax.random.PRNGKey(0),
                   temperature=0.0)
+
+
+def sample_position_keyed(params: Params, prompt: jax.Array,
+                          cfg: ModelConfig, steps: int, key: jax.Array,
+                          temperature: float = 1.0, top_k: int = 0,
+                          top_p: float = 1.0) -> jax.Array:
+    """``sample`` with THE speculative-sampling key convention: the token
+    that will occupy absolute position ``p`` is drawn with
+    ``fold_in(key, p)`` instead of a split chain. This is what makes the
+    randomness position-stable: speculative sampling re-proposes the same
+    position across rounds without double-spending its key, and a perfect
+    draft reproduces this sampler's stream EXACTLY (the self-draft
+    contract tests/test_spec_decode.py pins)."""
+    params = cast_params_for_compute(params, cfg)
+    b, s0 = prompt.shape
+    cache = init_kv_cache(cfg, b, s0 + steps)
+    logits, cache = prefill(params, cache, prompt, cfg)
+    first = sample_token(logits[:, s0 - 1], jax.random.fold_in(key, s0),
+                         temperature, top_k, top_p)
+
+    def step(carry, t):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, s0 + t, cfg)
+        nxt = sample_token(logits, jax.random.fold_in(key, s0 + t + 1),
+                           temperature, top_k, top_p)
+        return (nxt, cache), tok
+
+    (last, _), toks = jax.lax.scan(step, (first, cache),
+                                   jnp.arange(steps))
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def sampling_draft_rollout(params: Params, cache: KVCache, feed: jax.Array,
+                           pos, cfg: ModelConfig, k: int, key: jax.Array,
+                           temperature: float = 1.0, top_k: int = 0,
+                           top_p: float = 1.0
+                           ) -> Tuple[jax.Array, jax.Array, KVCache]:
+    """``draft_rollout``'s SAMPLING sibling: ingest ``feed`` (b, p) at
+    positions pos..pos+p-1, then propose k tokens by sampling from the
+    adjusted distribution, each with the position-keyed fold_in
+    (the token occupying row ``q`` draws ``fold_in(key, q)``). Returns
+    (proposals (b, k), proposal_probs (b, k, vocab) — the full ADJUSTED
+    distribution each proposal was drawn from, which the verifier's
+    acceptance ratio divides by — and the cache)."""
+    logits, cache = score_span(params, cache, feed, pos, cfg)
+
+    def pick(row_logits: jax.Array, position):
+        adj = adjusted_logits(row_logits, temperature, top_k, top_p)
+        probs = jax.nn.softmax(adj, axis=-1)
+        tok = jax.random.categorical(jax.random.fold_in(key, position),
+                                     adj, axis=-1).astype(jnp.int32)
+        return tok, probs
+
+    p0 = pos + feed.shape[1]              # row the first proposal occupies
+    tok0, prob0 = pick(logits[:, -1], p0)
+
+    def step(carry, _):
+        tok, prob, cache, p = carry
+        logits, cache = score_span(params, cache, tok[:, None], p, cfg)
+        nxt, nprob = pick(logits[:, 0], p + 1)
+        return (nxt, nprob, cache, p + 1), (tok, prob)
+
+    (ltok, lprob, cache, _), (toks, probs) = jax.lax.scan(
+        step, (tok0, prob0, cache, p0), None, length=k - 1)
+    proposals = jnp.concatenate([toks, ltok[None]], axis=0).T     # (b, k)
+    prob_stack = jnp.concatenate([probs, lprob[None]], axis=0)    # (k,b,V)
+    return proposals, jnp.swapaxes(prob_stack, 0, 1), cache

@@ -35,13 +35,15 @@ pods it places actually run.
 """
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import draft_rollout, init_kv_cache, prefill, score_span
+from .decode import (adjusted_logits, draft_rollout, init_kv_cache, prefill,
+                     sampling_draft_rollout, score_span)
 from .workload import ModelConfig, Params
 
 # module-level jitted wrappers with cfg STATIC: jit's cache keys on the
@@ -136,6 +138,160 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
         t_pos += n_ok + 1
         # draft's valid rows: the catch-up plus accepted proposals it fed
         # (it never fed span[k-1], hence the min with k-1)
+        d_pos += catch_up + min(n_ok, k - 1)
+    tokens = np.asarray([out[:total]], dtype=np.int32)
+    stats = {"target_calls": target_calls,
+             "plain_calls": total,
+             "drafted": drafted,
+             "accepted": accepted,
+             "accept_rate": accepted / max(drafted, 1)}
+    return tokens, stats
+
+
+# -- distribution-preserving speculative SAMPLING -----------------------------
+
+# Key-stream salts: a position's proposal draw, its acceptance uniform, and
+# its residual draw must be three independent streams (the acceptance test
+# may not reuse the randomness that generated the proposal). Positions are
+# < 2^29 in any realistic context, so the salted ranges cannot collide.
+_ACCEPT_SALT = 1 << 30
+_RESIDUAL_SALT = 3 << 29
+
+
+def residual_distribution(p: np.ndarray, q: np.ndarray) -> np.ndarray:
+    """The rejection-path distribution norm(max(q - p, 0)): what makes
+    speculative sampling EXACTLY distribution-preserving —
+    P(emit y) = p(y)·min(1, q(y)/p(y)) + P(reject)·residual(y) = q(y)
+    (tests/test_spec_decode.py verifies that identity numerically against
+    THIS function). Degenerate guard: when q ≤ p everywhere (q == p),
+    rejection is impossible, but a caller that lands here anyway gets q."""
+    r = np.maximum(np.asarray(q, np.float64) - np.asarray(p, np.float64), 0)
+    s = float(r.sum())
+    if s <= 1e-12:
+        qq = np.asarray(q, np.float64)
+        return qq / max(float(qq.sum()), 1e-30)
+    return r / s
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "temperature", "top_k", "top_p"))
+def _span_adjusted(params, cache, scored, pos, cfg, temperature, top_k,
+                   top_p):
+    """Verify phase for sampling: ONE target stream over the k+1 span rows,
+    returning the ADJUSTED logits (the acceptance distributions) and the
+    cache."""
+    logits, cache = score_span(params, cache, scored, pos, cfg)
+    adj = adjusted_logits(logits[0], temperature, top_k, top_p)
+    return adj, cache
+
+
+_sampling_draft = jax.jit(
+    sampling_draft_rollout,
+    static_argnames=("cfg", "k", "temperature", "top_k", "top_p"),
+    donate_argnums=(1,))
+
+
+def speculative_sample(target_params: Params, target_cfg: ModelConfig,
+                       draft_params: Params, draft_cfg: ModelConfig,
+                       prompt: jax.Array, steps: int, key: jax.Array,
+                       k: int = 4, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 1.0
+                       ) -> Tuple[np.ndarray, dict]:
+    """Distribution-preserving speculative SAMPLING (the standard
+    accept-with-min(1, q/p), resample-from-residual algorithm): generates
+    ``steps + 1`` tokens whose distribution is EXACTLY the target's
+    adjusted sampling distribution — the draft can only change speed,
+    never statistics. Greedy speculation (`speculative_generate`) is the
+    temperature→0 special case and stays its own path (argmax comparison,
+    no keys).
+
+    Randomness is position-keyed (`decode.sample_position_keyed` is the
+    canonical definition): the token occupying absolute row ``p`` draws
+    ``fold_in(key, p)``; acceptance uniforms and residual draws use salted
+    streams of the same position. Consequences worth the discipline:
+    a re-proposed position after a rejection re-draws the SAME key (no
+    key double-spend skew), and a perfect draft (draft == target) accepts
+    everything and reproduces ``sample_position_keyed``'s stream
+    token-for-token — the deterministic contract the tests pin, standing
+    in for a statistical test of the acceptance math (which is verified
+    as an exact numpy identity separately).
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative_sample is single-sequence (b=1)")
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    if temperature <= 0.0:
+        raise ValueError("temperature must be > 0 (use "
+                         "speculative_generate for greedy)")
+    total = int(steps) + 1
+    s0 = prompt.shape[1]
+    max_seq = s0 + total + k + 2
+    t_cache = init_kv_cache(target_cfg, 1, max_seq)
+    d_cache = init_kv_cache(draft_cfg, 1, max_seq)
+
+    t_logits, t_cache = _prefill(target_params, t_cache, prompt,
+                                 cfg=target_cfg)
+    _, d_cache = _prefill(draft_params, d_cache, prompt, cfg=draft_cfg)
+    first_adj = adjusted_logits(t_logits[:, s0 - 1], temperature, top_k,
+                                top_p)
+    out = [int(jax.random.categorical(jax.random.fold_in(key, s0),
+                                      first_adj, axis=-1)[0])]
+
+    t_pos = d_pos = s0
+    target_calls = 1
+    drafted = accepted = 0
+    while len(out) < total:
+        feed = out[len(out) - (t_pos - d_pos) - 1:]
+        catch_up = len(feed)
+        span_dev, probs_dev, d_cache = _sampling_draft(
+            draft_params, d_cache, jnp.asarray([feed], dtype=jnp.int32),
+            jnp.int32(d_pos), cfg=draft_cfg, k=k, key=key,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+        span = [int(t) for t in np.asarray(span_dev)[0]]
+        p_mat = np.asarray(probs_dev[0], np.float64)        # (k, vocab)
+        drafted += k
+        scored = jnp.asarray([[out[-1]] + span], dtype=jnp.int32)
+        adj_dev, t_cache = _span_adjusted(
+            target_params, t_cache, scored, jnp.int32(t_pos),
+            cfg=target_cfg, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+        target_calls += 1
+        adj = np.asarray(adj_dev, np.float64)               # (k+1, vocab)
+        q_mat = np.exp(adj - adj.max(axis=-1, keepdims=True))
+        q_mat /= q_mat.sum(axis=-1, keepdims=True)
+        n_ok = 0
+        emitted_rejection = None
+        while n_ok < k:
+            x = span[n_ok]
+            tok_pos = t_pos + n_ok + 1       # row the proposal occupies
+            u = float(jax.random.uniform(
+                jax.random.fold_in(key, _ACCEPT_SALT + tok_pos)))
+            ratio = q_mat[n_ok, x] / max(p_mat[n_ok, x], 1e-30)
+            if u < min(1.0, ratio):
+                n_ok += 1
+                continue
+            res = residual_distribution(p_mat[n_ok], q_mat[n_ok])
+            r = float(jax.random.uniform(
+                jax.random.fold_in(key, _RESIDUAL_SALT + tok_pos)))
+            emitted_rejection = int(np.searchsorted(
+                np.cumsum(res), r, side="right").clip(0, len(res) - 1))
+            break
+        accepted += n_ok
+        if emitted_rejection is None:
+            # full acceptance: the bonus token at row t_pos+k+1 draws its
+            # own position key from the target's adjusted distribution —
+            # exactly what sample_position_keyed would do there
+            bonus = int(jax.random.categorical(
+                jax.random.fold_in(key, t_pos + k + 1),
+                jnp.asarray(adj[k])[None, :], axis=-1)[0])
+            out.extend(span)
+            out.append(bonus)
+        else:
+            out.extend(span[:n_ok])
+            out.append(emitted_rejection)
+        t_pos += n_ok + 1
         d_pos += catch_up + min(n_ok, k - 1)
     tokens = np.asarray([out[:total]], dtype=np.int32)
     stats = {"target_calls": target_calls,
