@@ -174,15 +174,31 @@ def residual_distribution(p: np.ndarray, q: np.ndarray) -> np.ndarray:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "temperature", "top_k", "top_p"))
+                   static_argnames=("cfg", "temperature", "top_k", "top_p"),
+                   donate_argnums=(1,))
 def _span_adjusted(params, cache, scored, pos, cfg, temperature, top_k,
                    top_p):
     """Verify phase for sampling: ONE target stream over the k+1 span rows,
     returning the ADJUSTED logits (the acceptance distributions) and the
-    cache."""
+    cache (donated, like every sibling wrapper — the arena updates in
+    place instead of copying a full-context cache per round)."""
     logits, cache = score_span(params, cache, scored, pos, cfg)
     adj = adjusted_logits(logits[0], temperature, top_k, top_p)
     return adj, cache
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _round_uniforms(key, t_pos, k):
+    """All of one round's acceptance + residual uniforms in ONE dispatch
+    (per-token scalar fetches would add up to 2k host syncs to the
+    latency-critical loop). Value-identical to drawing
+    uniform(fold_in(key, SALT + position)) one at a time."""
+    pos = t_pos + 1 + jnp.arange(k)
+    au = jax.vmap(lambda p: jax.random.uniform(
+        jax.random.fold_in(key, _ACCEPT_SALT + p)))(pos)
+    ru = jax.vmap(lambda p: jax.random.uniform(
+        jax.random.fold_in(key, _RESIDUAL_SALT + p)))(pos)
+    return au, ru
 
 
 _sampling_draft = jax.jit(
@@ -261,22 +277,20 @@ def speculative_sample(target_params: Params, target_cfg: ModelConfig,
         adj = np.asarray(adj_dev, np.float64)               # (k+1, vocab)
         q_mat = np.exp(adj - adj.max(axis=-1, keepdims=True))
         q_mat /= q_mat.sum(axis=-1, keepdims=True)
+        acc_u, res_u = (np.asarray(a) for a in _round_uniforms(
+            key, jnp.int32(t_pos), k))
         n_ok = 0
         emitted_rejection = None
         while n_ok < k:
             x = span[n_ok]
-            tok_pos = t_pos + n_ok + 1       # row the proposal occupies
-            u = float(jax.random.uniform(
-                jax.random.fold_in(key, _ACCEPT_SALT + tok_pos)))
             ratio = q_mat[n_ok, x] / max(p_mat[n_ok, x], 1e-30)
-            if u < min(1.0, ratio):
+            if float(acc_u[n_ok]) < min(1.0, ratio):
                 n_ok += 1
                 continue
             res = residual_distribution(p_mat[n_ok], q_mat[n_ok])
-            r = float(jax.random.uniform(
-                jax.random.fold_in(key, _RESIDUAL_SALT + tok_pos)))
             emitted_rejection = int(np.searchsorted(
-                np.cumsum(res), r, side="right").clip(0, len(res) - 1))
+                np.cumsum(res), float(res_u[n_ok]),
+                side="right").clip(0, len(res) - 1))
             break
         accepted += n_ok
         if emitted_rejection is None:
