@@ -260,6 +260,19 @@ def test_watch_survives_reconnect(api, fake):
     assert wait_until(lambda: "r2" in seen, timeout=10)
 
 
+def test_idle_watch_rv_advances_via_bookmarks(api, fake):
+    """An idle kind's watch must keep its resume point fresh through
+    BOOKMARK events (the fake sends them on idle, like a real apiserver
+    with allowWatchBookmarks): after heavy traffic on ANOTHER kind, the
+    idle kind's reflector RV catches up, so its next reconnect resumes
+    near head instead of replaying foreign history."""
+    for i in range(10):
+        api.create(srv.NODES, make_tpu_node(f"bk{i}"))
+    head = api._rv[srv.NODES]
+    assert wait_until(lambda: api._rv[srv.PODS] >= head, timeout=10), (
+        f"pods watch rv stuck at {api._rv[srv.PODS]} < {head}")
+
+
 def test_lease_election_over_http(api):
     assert api.acquire_or_renew_lease("ctl", "alice", lease_duration=1)
     assert not api.acquire_or_renew_lease("ctl", "bob", lease_duration=1)

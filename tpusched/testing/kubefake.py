@@ -347,6 +347,8 @@ class FakeKube:
                         f"{len(data):X}\r\n".encode() + data + b"\r\n")
                     self.wfile.flush()
 
+                bookmarks = q.get("allowWatchBookmarks") in ("true", "1")
+                idle_since = time.monotonic()
                 try:
                     for etype, obj in backlog:
                         send(etype, obj)
@@ -356,8 +358,28 @@ class FakeKube:
                         try:
                             etype, obj = events.get(timeout=0.25)
                         except queue.Empty:
+                            if bookmarks and \
+                                    time.monotonic() - idle_since > 1.0:
+                                # periodic BOOKMARK on idle streams (the
+                                # real apiserver's freshness contract): the
+                                # client's resume point advances without
+                                # object traffic, so a reconnect never
+                                # replays history another kind produced
+                                with st.lock:
+                                    rv = str(st.rv)
+                                data = json.dumps(
+                                    {"type": "BOOKMARK",
+                                     "object": {"metadata":
+                                                {"resourceVersion": rv}}}
+                                ).encode() + b"\n"
+                                self.wfile.write(
+                                    f"{len(data):X}\r\n".encode()
+                                    + data + b"\r\n")
+                                self.wfile.flush()
+                                idle_since = time.monotonic()
                             continue
                         send(etype, obj)
+                        idle_since = time.monotonic()
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
