@@ -703,6 +703,49 @@ def test_int8_arena_on_tp_mesh(model):
     assert got == want
 
 
+def test_request_keyed_sampling_is_batching_invariant_and_solo_exact(model):
+    """Request-keyed sampled serving (round 5): every token draws
+    fold_in(fold_in(engine_key, rid), absolute_row), so a request's
+    sampled stream is a pure function of (key, rid, rows) — IDENTICAL
+    across slot counts, submission orders, and neighbors, and equal to
+    decode.sample_position_keyed run solo. Sampled serving gets the same
+    batching-invariance law greedy serving always had."""
+    from tpusched.jaxbridge.decode import sample_position_keyed
+    cfg, params = model
+    rng = np.random.default_rng(43)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 14, cfg.vocab),
+                    max_new_tokens=int(rng.integers(3, 9)))
+            for i in range(6)]
+
+    def run(slots, order):
+        eng = ServeEngine(params, cfg, slots=slots, max_seq=64,
+                          prompt_bucket=16, temperature=0.8, top_k=24,
+                          seed=5, request_keyed=True)
+        for i in order:
+            eng.submit(reqs[i])
+        return {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+
+    a = run(2, range(6))
+    b = run(4, list(reversed(range(6))))
+    assert a == b                      # batching/order invariance
+    chunked = ServeEngine(params, cfg, slots=3, max_seq=64,
+                          prompt_bucket=16, temperature=0.8, top_k=24,
+                          seed=5, request_keyed=True, chunk_prefill=5)
+    for r in reqs:
+        chunked.submit(r)
+    c = {cm.rid: list(cm.tokens) for cm in chunked.run_until_drained()}
+    assert c == a                      # chunk-size invariance composes
+    for r in reqs:
+        key_r = jax.random.fold_in(jax.random.PRNGKey(5), r.rid)
+        solo = np.asarray(sample_position_keyed(
+            params, r.prompt[None, :], cfg, r.max_new_tokens - 1, key_r,
+            temperature=0.8, top_k=24))[0]
+        assert a[r.rid] == list(solo), f"request {r.rid}"
+    with pytest.raises(ValueError, match="request_keyed"):
+        ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                    request_keyed=True)   # greedy consumes no randomness
+
+
 def test_sampled_engine_is_deterministic_and_bounded(model):
     """Non-greedy serving (temperature/top-k/top-p): no solo-parity
     contract exists (RNG consumption differs by construction), but the

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -51,8 +52,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .decode import (KVCache, _cached_attention, _quantize_kv, decode_step,
-                     init_kv_cache, sample_token)
+from .decode import (KVCache, _cached_attention, _quantize_kv,
+                     adjusted_logits, decode_step, init_kv_cache,
+                     sample_token)
 from .workload import (ModelConfig, Params, _finish_block, _qkv,
                        _resolve_attn_fn, _rmsnorm, cast_params_for_compute,
                        param_specs)
@@ -208,6 +210,26 @@ def _build_prefix_insert(cfg: ModelConfig):
     return jax.jit(run, donate_argnums=(0,))
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def _keyed_sample(logits: jax.Array, keys: jax.Array, rows: jax.Array,
+                  temperature: float, top_k: int, top_p: float
+                  ) -> jax.Array:
+    """Request-keyed sampling: row i of ``logits`` draws
+    categorical(fold_in(keys[i], rows[i])) over its adjusted distribution —
+    decode.sample_position_keyed's convention, vectorized per slot. What
+    makes sampled serving BATCHING-INVARIANT: a token's randomness depends
+    only on its request's key and its absolute row, never on which slots
+    its neighbors occupy or when they joined."""
+    adj = adjusted_logits(logits, temperature, top_k, top_p)
+
+    def one(row_logits, k, r):
+        return jax.random.categorical(jax.random.fold_in(k, r),
+                                      row_logits, axis=-1)
+
+    return jax.vmap(one)(adj, keys, rows).astype(jnp.int32)
+
+
 def _build_decode_tick(cfg: ModelConfig):
     """jitted (params, cache, tokens (slots,), pos (slots,)) →
     (cache', logits (slots, vocab)): one lock-step decode over the arena —
@@ -271,6 +293,7 @@ class ServeEngine:
                  prompt_bucket: "int | Tuple[int, ...]" = 128,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
+                 request_keyed: bool = False,
                  mesh: Optional[Mesh] = None,
                  chunk_prefill: Optional[int] = None,
                  draft_params: Optional[Params] = None,
@@ -309,6 +332,22 @@ class ServeEngine:
         self.top_k = top_k
         self.top_p = top_p
         self._key = jax.random.PRNGKey(seed)
+        # request-keyed sampling (opt-in): every token draws
+        # fold_in(fold_in(engine_key, rid), absolute_row) instead of the
+        # engine's shared split chain — sampled outputs become a pure
+        # function of (request, its rows), INVARIANT to batching, slot
+        # assignment, and neighbors. Parity law: each request's stream
+        # equals decode.sample_position_keyed run solo with
+        # fold_in(engine_key, rid). Requires distinct rids.
+        self.request_keyed = bool(request_keyed)
+        if self.request_keyed and temperature == 0.0:
+            raise ValueError("request_keyed sampling needs temperature > 0 "
+                             "(greedy consumes no randomness)")
+        # per-slot current tenant's request key; idle placeholders are
+        # harmless (their samples are discarded)
+        self.slot_key: List[jax.Array] = [
+            jax.random.fold_in(self._key, (1 << 31) + s)
+            for s in range(slots)]
         self._mesh = mesh
         self._kv_shard = None
         if mesh is None:
@@ -617,7 +656,7 @@ class ServeEngine:
             self.cache, first_logits = prefill(
                 self.params, self.cache, jnp.asarray(padded),
                 jnp.int32(slot), jnp.int32(true_len))
-            tok = self._sample(first_logits[None, :])[0]
+            tok = self._first_token(req.rid, first_logits, true_len, slot)
             self.req[slot] = req
             self.slot_prefix[slot] = 0
             self.pos[slot] = true_len
@@ -665,7 +704,7 @@ class ServeEngine:
                 self.prefill_off[slot] = off
                 continue
             self.prefill_off[slot] = None          # prompt fully resident
-            tok = self._sample(next_logits[None, :])[0]
+            tok = self._first_token(req.rid, next_logits, true_len, slot)
             self.pos[slot] = true_len
             self.next_tok[slot] = tok
             self.generated[slot] = [int(tok)]
@@ -675,6 +714,19 @@ class ServeEngine:
         self._key, sub = jax.random.split(self._key)
         return np.asarray(sample_token(logits, sub, self.temperature,
                                        self.top_k, self.top_p))
+
+    def _first_token(self, rid: int, logits_row: jax.Array, row: int,
+                     slot: int) -> int:
+        """A slot's first generated token (occupying absolute ``row``):
+        request-keyed draws bind the tenant's key to the slot here; the
+        shared-stream path is the legacy engine behavior."""
+        if self.request_keyed:
+            self.slot_key[slot] = jax.random.fold_in(self._key, rid)
+            return int(np.asarray(_keyed_sample(
+                logits_row[None, :], self.slot_key[slot][None, ...],
+                jnp.asarray([row], dtype=jnp.int32),
+                self.temperature, self.top_k, self.top_p))[0])
+        return int(self._sample(logits_row[None, :])[0])
 
     def _maybe_finish(self, slot: int) -> None:
         req = self.req[slot]
@@ -774,7 +826,15 @@ class ServeEngine:
         self.cache, logits = self._tick(
             self.params, self.cache, jnp.asarray(self.next_tok),
             jnp.asarray(self.pos))
-        toks = self._sample(logits)
+        if self.request_keyed:
+            # the token sampled from this tick occupies row pos+1 in its
+            # slot — the same row the solo position-keyed sampler keys
+            toks = np.asarray(_keyed_sample(
+                logits, jnp.stack(self.slot_key),
+                jnp.asarray(self.pos + 1, dtype=jnp.int32),
+                self.temperature, self.top_k, self.top_p))
+        else:
+            toks = self._sample(logits)
         self.tick_count += 1
         for s in active:
             self.pos[s] += 1
