@@ -527,9 +527,13 @@ def test_speculative_engine_validation(model):
     with pytest.raises(ValueError, match="draft_cfg"):
         ServeEngine(params, cfg, draft_params=dp, max_seq=64,
                     prompt_bucket=16)
-    with pytest.raises(ValueError, match="greedy-only"):
+    with pytest.raises(ValueError, match="request_keyed"):
+        # sampled speculation needs position-stable randomness
         ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
                     temperature=0.5, max_seq=64, prompt_bucket=16)
+    ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
+                temperature=0.5, request_keyed=True, max_seq=64,
+                prompt_bucket=16)   # ...and composes with it
     with pytest.raises(ValueError, match="monolithic"):
         ServeEngine(params, cfg, draft_params=dp, draft_cfg=dcfg,
                     chunk_prefill=4, max_seq=64, prompt_bucket=16)
@@ -744,6 +748,66 @@ def test_request_keyed_sampling_is_batching_invariant_and_solo_exact(model):
     with pytest.raises(ValueError, match="request_keyed"):
         ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
                     request_keyed=True)   # greedy consumes no randomness
+
+
+def test_sampled_speculative_serving_matches_solo(model):
+    """Sampled speculative SERVING (request-keyed): per-request outputs
+    must equal solo spec_decode.speculative_sample with
+    fold_in(engine_key, rid) — same proposal, acceptance, residual, and
+    bonus streams at the same absolute rows — for a WEAK draft (real
+    rejections exercised)."""
+    import dataclasses
+    from tpusched.jaxbridge.spec_decode import speculative_sample
+    cfg, params = model
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(9), dcfg)
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      temperature=0.8, top_k=24, seed=5,
+                      request_keyed=True, draft_params=dp, draft_cfg=dcfg,
+                      spec_k=3)
+    rng = np.random.default_rng(47)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 12, cfg.vocab),
+                    max_new_tokens=int(rng.integers(3, 8)))
+            for i in range(4)]
+    for r in reqs:
+        eng.submit(r)
+    got = {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+    assert eng.spec_stats["accepted"] < eng.spec_stats["drafted"], (
+        "weak draft should see rejections — the residual path never ran")
+    for r in reqs:
+        key_r = jax.random.fold_in(jax.random.PRNGKey(5), r.rid)
+        solo, _ = speculative_sample(params, cfg, dp, dcfg,
+                                     r.prompt[None, :],
+                                     r.max_new_tokens - 1, key_r, k=3,
+                                     temperature=0.8, top_k=24)
+        assert got[r.rid] == list(solo[0]), f"request {r.rid}"
+
+
+def test_sampled_speculative_self_draft_is_position_keyed(model):
+    """Self-draft sampled speculation through the ENGINE collapses to the
+    canonical position-keyed sampler — the full chain: batched sampled
+    speculative serving == solo speculative_sample == solo
+    sample_position_keyed."""
+    from tpusched.jaxbridge.decode import sample_position_keyed
+    cfg, params = model
+    eng = ServeEngine(params, cfg, slots=2, max_seq=64, prompt_bucket=16,
+                      temperature=0.8, top_k=24, seed=5,
+                      request_keyed=True, draft_params=params,
+                      draft_cfg=cfg, spec_k=3)
+    rng = np.random.default_rng(53)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 12, cfg.vocab),
+                    max_new_tokens=6) for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    got = {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+    acc = eng.spec_stats["accepted"] / max(1, eng.spec_stats["drafted"])
+    assert acc == 1.0
+    for r in reqs:
+        key_r = jax.random.fold_in(jax.random.PRNGKey(5), r.rid)
+        solo = np.asarray(sample_position_keyed(
+            params, r.prompt[None, :], cfg, r.max_new_tokens - 1, key_r,
+            temperature=0.8, top_k=24))[0]
+        assert got[r.rid] == list(solo), f"request {r.rid}"
 
 
 def test_sampled_engine_is_deterministic_and_bounded(model):

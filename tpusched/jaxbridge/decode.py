@@ -292,6 +292,15 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig,
                   temperature=0.0)
 
 
+# Speculative-sampling key-stream salts (the position-keyed convention's
+# other two streams): a position's PROPOSAL draw uses fold_in(key, row);
+# its acceptance uniform and residual draw use the salted row. Defined
+# here with sample_position_keyed so solo speculation (spec_decode) and
+# batched sampled serving (serve) share one convention.
+ACCEPT_SALT = 1 << 30
+RESIDUAL_SALT = 3 << 29
+
+
 def sample_position_keyed(params: Params, prompt: jax.Array,
                           cfg: ModelConfig, steps: int, key: jax.Array,
                           temperature: float = 1.0, top_k: int = 0,

@@ -278,6 +278,87 @@ def _build_verify_span(cfg: ModelConfig):
     return jax.jit(run, donate_argnums=(1,))
 
 
+def _build_sampling_draft_tick(cfg: ModelConfig, k: int, temperature: float,
+                               top_k: int, top_p: float):
+    """The draft tick's SAMPLING sibling for request-keyed speculation:
+    (params, cache, feed2 (slots, 2), pos (slots,), keys (slots, key)) →
+    (proposals (slots, k), proposal_probs (slots, k, vocab), cache').
+    Each slot's proposal occupying row r draws fold_in(keys[slot], r) —
+    exactly solo speculative_sample's draft stream at the same absolute
+    rows."""
+    from .decode import score_span
+
+    def pick(row_logits, key, row):
+        adj = adjusted_logits(row_logits[None, :], temperature, top_k,
+                              top_p)[0]
+        tok = jax.random.categorical(jax.random.fold_in(key, row), adj)
+        return tok.astype(jnp.int32), jax.nn.softmax(adj, axis=-1)
+
+    def run(params: Params, cache: KVCache, feed2: jax.Array,
+            pos: jax.Array, keys: jax.Array):
+        logits, cache = score_span(params, cache, feed2, pos - 1, cfg)
+        tok0, prob0 = jax.vmap(pick)(logits[:, -1], keys, pos + 1)
+
+        def step(carry, _):
+            tok, prob, cache, p = carry
+            logits, cache = score_span(params, cache, tok[:, None], p, cfg)
+            nxt, nprob = jax.vmap(pick)(logits[:, 0], keys, p + 1)
+            return (nxt, nprob, cache, p + 1), (tok, prob)
+
+        (lt, lp, cache, _), (toks, probs) = jax.lax.scan(
+            step, (tok0, prob0, cache, pos + 1), None, length=k - 1)
+        proposals = jnp.concatenate([toks, lt[None]], axis=0)   # (k, slots)
+        prob_stack = jnp.concatenate([probs, lp[None]], axis=0)
+        return (proposals.T, jnp.swapaxes(prob_stack, 0, 1), cache)
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def _build_verify_sampled(cfg: ModelConfig, temperature: float, top_k: int,
+                          top_p: float):
+    """Sampled verification: ONE target stream over every slot's span,
+    returning the adjusted target distributions (slots, k+1, vocab) — the
+    acceptance ratios' numerators — plus each slot's BONUS candidate
+    (row k), drawn device-side with its position key so full acceptance
+    emits exactly what solo speculative_sample would."""
+    from .decode import score_span
+
+    def run(params: Params, cache: KVCache, scored: jax.Array,
+            pos: jax.Array, keys: jax.Array):
+        logits, cache = score_span(params, cache, scored, pos, cfg)
+        s, span, v = logits.shape
+        adj = adjusted_logits(logits.reshape(s * span, v), temperature,
+                              top_k, top_p).reshape(s, span, v)
+        q = jax.nn.softmax(adj, axis=-1)
+
+        def bonus_one(adj_row, key, p):
+            return jax.random.categorical(
+                jax.random.fold_in(key, p + span), adj_row)
+
+        bonus = jax.vmap(bonus_one)(adj[:, -1], keys, pos).astype(jnp.int32)
+        return q, bonus, cache
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _spec_round_uniforms(keys: jax.Array, pos: jax.Array, k: int):
+    """All slots' acceptance + residual uniforms for one speculative round
+    in one dispatch — per (slot, proposal-row) streams
+    fold_in(key, SALT + row), identical to solo speculative_sample's."""
+    from .decode import ACCEPT_SALT, RESIDUAL_SALT
+
+    def per_slot(key, p):
+        rows = p + 1 + jnp.arange(k)
+        au = jax.vmap(lambda r: jax.random.uniform(
+            jax.random.fold_in(key, ACCEPT_SALT + r)))(rows)
+        ru = jax.vmap(lambda r: jax.random.uniform(
+            jax.random.fold_in(key, RESIDUAL_SALT + r)))(rows)
+        return au, ru
+
+    return jax.vmap(per_slot)(keys, pos)
+
+
 class ServeEngine:
     """Continuous-batching engine: submit() requests, tick() until done.
 
@@ -403,9 +484,13 @@ class ServeEngine:
                 raise ValueError("draft_params requires draft_cfg")
             if draft_cfg.vocab != cfg.vocab:
                 raise ValueError("draft and target must share a vocabulary")
-            if temperature != 0.0:
-                raise ValueError("speculative serving is greedy-only "
-                                 "(temperature must be 0)")
+            if temperature != 0.0 and not self.request_keyed:
+                raise ValueError(
+                    "sampled speculative serving requires "
+                    "request_keyed=True: the accept/residual randomness "
+                    "must be position-stable per request or the "
+                    "distribution-preservation law cannot hold "
+                    "(temperature=0 runs greedy verification)")
             if chunk_prefill is not None:
                 raise ValueError("speculative serving composes with "
                                  "monolithic admission only (no "
@@ -453,8 +538,14 @@ class ServeEngine:
                                     "v": self._kv_shard}
                                    for _ in range(draft_cfg.n_layers)])()
             self._draft_prefill_by_bucket: Dict[int, Callable] = {}
-            self._draft_tick = _build_draft_tick(draft_cfg, spec_k)
-            self._verify = _build_verify_span(cfg)
+            if temperature == 0.0:
+                self._draft_tick = _build_draft_tick(draft_cfg, spec_k)
+                self._verify = _build_verify_span(cfg)
+            else:
+                self._sampling_draft_tick = _build_sampling_draft_tick(
+                    draft_cfg, spec_k, temperature, top_k, top_p)
+                self._verify_sampled = _build_verify_sampled(
+                    cfg, temperature, top_k, top_p)
         self._prefill_by_bucket: Dict[int, Callable] = {}
         self._tick = _build_decode_tick(cfg)
         # chunked prefill (opt-in): admission writes the prompt into the
@@ -808,12 +899,87 @@ class ServeEngine:
             self.pos[s] += n_ok + 1
         return len(active)
 
+    def _tick_speculative_sampled(self) -> int:
+        """The sampled sibling of _tick_speculative (request-keyed only):
+        per-slot draft SAMPLING with position keys, one verify stream
+        returning the adjusted target distributions + device-drawn bonus
+        candidates, host acceptance with min(1, q/p) and residual
+        resampling per slot. Per-request outputs equal solo
+        spec_decode.speculative_sample with fold_in(engine_key, rid) —
+        same proposals, same accept/residual streams, same rows."""
+        self._admit()
+        active = [s for s in range(self.slots) if self.req[s] is not None]
+        if not active:
+            self.tick_count += 1
+            return 0
+        k = self.spec_k
+        feed2 = np.stack([self.prev_tok, self.next_tok], axis=1)
+        pos = jnp.asarray(np.maximum(self.pos, 1))   # idle rows: see greedy
+        keys = jnp.stack(self.slot_key)
+        proposals, p_probs, self.draft_cache = self._sampling_draft_tick(
+            self.draft_params, self.draft_cache, jnp.asarray(feed2), pos,
+            keys)
+        proposals = np.asarray(proposals)                  # (slots, k)
+        p_mat = np.asarray(p_probs, np.float64)            # (slots, k, V)
+        scored = np.concatenate([self.next_tok[:, None], proposals], axis=1)
+        q_dev, bonus_dev, self.cache = self._verify_sampled(
+            self.params, self.cache, jnp.asarray(scored), pos, keys)
+        q_mat = np.asarray(q_dev, np.float64)              # (slots, k+1, V)
+        bonus = np.asarray(bonus_dev)                      # (slots,)
+        acc_u, res_u = (np.asarray(a) for a in _spec_round_uniforms(
+            keys, pos, k))
+        self.tick_count += 1
+        self.spec_stats["rounds"] += 1
+        from .spec_decode import residual_distribution
+        for s in active:
+            span = proposals[s]
+            n_ok = 0
+            rejection_tok = None
+            while n_ok < k:
+                x = int(span[n_ok])
+                ratio = (q_mat[s, n_ok, x]
+                         / max(p_mat[s, n_ok, x], 1e-30))
+                if float(acc_u[s, n_ok]) < min(1.0, ratio):
+                    n_ok += 1
+                    continue
+                res = residual_distribution(p_mat[s, n_ok], q_mat[s, n_ok])
+                rejection_tok = int(np.searchsorted(
+                    np.cumsum(res), float(res_u[s, n_ok]),
+                    side="right").clip(0, len(res) - 1))
+                break
+            self.spec_stats["drafted"] += k
+            self.spec_stats["accepted"] += n_ok
+            if rejection_tok is None:
+                emitted = [int(t) for t in span] + [int(bonus[s])]
+            else:
+                emitted = [int(t) for t in span[:n_ok]] + [rejection_tok]
+            req = self.req[s]
+            finished = False
+            for tok in emitted:
+                self.generated[s].append(tok)
+                self.decode_tokens += 1
+                if (len(self.generated[s]) >= req.max_new_tokens
+                        or (req.eos_token is not None
+                            and tok == req.eos_token)):
+                    finished = True
+                    break
+            if finished:
+                self._maybe_finish(s)
+                continue
+            self.prev_tok[s] = (int(span[n_ok - 1]) if n_ok >= 1
+                                else int(self.next_tok[s]))
+            self.next_tok[s] = emitted[-1]
+            self.pos[s] += n_ok + 1
+        return len(active)
+
     def tick(self) -> int:
         """One engine iteration: admit waiting requests into free slots,
         advance chunked prefills by one chunk each, then one fused decode
         step over the arena. Returns the number of ACTIVE (decoding) slots
         this tick (0 = fully idle)."""
         if self.draft_params is not None:
+            if self.temperature != 0.0:
+                return self._tick_speculative_sampled()
             return self._tick_speculative()
         self._admit()
         if self.chunk_prefill is not None:

@@ -154,8 +154,10 @@ def speculative_generate(target_params: Params, target_cfg: ModelConfig,
 # its residual draw must be three independent streams (the acceptance test
 # may not reuse the randomness that generated the proposal). Positions are
 # < 2^29 in any realistic context, so the salted ranges cannot collide.
-_ACCEPT_SALT = 1 << 30
-_RESIDUAL_SALT = 3 << 29
+# canonical definition lives with sample_position_keyed (decode.py); the
+# serve engine's batched sampled speculation shares the same streams
+from .decode import ACCEPT_SALT as _ACCEPT_SALT          # noqa: E402
+from .decode import RESIDUAL_SALT as _RESIDUAL_SALT      # noqa: E402
 
 
 def residual_distribution(p: np.ndarray, q: np.ndarray) -> np.ndarray:
