@@ -750,6 +750,31 @@ def test_request_keyed_sampling_is_batching_invariant_and_solo_exact(model):
                     request_keyed=True)   # greedy consumes no randomness
 
 
+def test_request_keyed_composes_with_int8_arena(model):
+    """Orthogonal features compose: the quantized arena under
+    request-keyed sampling still equals the solo position-keyed sampler
+    run with the same int8 cfg (monolithic admission on both sides)."""
+    import dataclasses
+    from tpusched.jaxbridge.decode import sample_position_keyed
+    cfg, params = model
+    i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    eng = ServeEngine(params, i8, slots=2, max_seq=64, prompt_bucket=16,
+                      temperature=0.8, top_k=24, seed=5,
+                      request_keyed=True)
+    rng = np.random.default_rng(59)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 12, cfg.vocab),
+                    max_new_tokens=5) for i in range(4)]
+    for r in reqs:
+        eng.submit(r)
+    got = {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+    for r in reqs:
+        key_r = jax.random.fold_in(jax.random.PRNGKey(5), r.rid)
+        solo = np.asarray(sample_position_keyed(
+            params, r.prompt[None, :], i8, r.max_new_tokens - 1, key_r,
+            temperature=0.8, top_k=24))[0]
+        assert got[r.rid] == list(solo), f"request {r.rid}"
+
+
 def test_sampled_speculative_serving_matches_solo(model):
     """Sampled speculative SERVING (request-keyed): per-request outputs
     must equal solo spec_decode.speculative_sample with
