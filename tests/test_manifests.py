@@ -201,3 +201,22 @@ def test_chart_deployment_and_configmap_render():
         os.path.join(CHART, "templates", "configmap.yaml"))))[0]
     cfg = v.loads(cm["data"]["scheduler-config.yaml"])
     assert cfg.profiles[0].bind == ["TpuSlice"]
+
+
+def test_chart_deployment_identity_is_rbac_bound():
+    """The pod's serviceAccountName must be the SA the chart creates AND
+    the one its ClusterRoleBinding grants — a mismatch means the default
+    --kubeconfig=in-cluster transport has no working identity (403s or an
+    unmountable token)."""
+    dep = yaml.safe_load(_render_chart_template(
+        os.path.join(CHART, "templates", "deployment.yaml")))
+    rbac_docs = [d for d in yaml.safe_load_all(_render_chart_template(
+        os.path.join(CHART, "templates", "rbac.yaml"))) if d]
+    created = {d["metadata"]["name"] for d in rbac_docs
+               if d["kind"] == "ServiceAccount"}
+    bound = {s["name"] for d in rbac_docs
+             if d["kind"] == "ClusterRoleBinding"
+             for s in d.get("subjects", [])}
+    pod_sa = dep["spec"]["template"]["spec"]["serviceAccountName"]
+    assert pod_sa in created, (pod_sa, created)
+    assert pod_sa in bound, (pod_sa, bound)

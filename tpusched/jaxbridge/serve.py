@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .decode import (KVCache, _cached_attention, _quantize_kv,
                      adjusted_logits, decode_step, init_kv_cache,
                      sample_token)
+from .spec_decode import accept_span, probs_from_adjusted
 from .workload import (ModelConfig, Params, _finish_block, _qkv,
                        _resolve_attn_fn, _rmsnorm, cast_params_for_compute,
                        param_specs)
@@ -317,10 +318,12 @@ def _build_sampling_draft_tick(cfg: ModelConfig, k: int, temperature: float,
 def _build_verify_sampled(cfg: ModelConfig, temperature: float, top_k: int,
                           top_p: float):
     """Sampled verification: ONE target stream over every slot's span,
-    returning the adjusted target distributions (slots, k+1, vocab) — the
-    acceptance ratios' numerators — plus each slot's BONUS candidate
-    (row k), drawn device-side with its position key so full acceptance
-    emits exactly what solo speculative_sample would."""
+    returning the ADJUSTED target logits (slots, k+1, vocab) — the host
+    computes float64 distributions from them, exactly like solo
+    speculative_sample (a device f32 softmax would shift min(1, q/p)
+    enough to flip tokens) — plus each slot's BONUS candidate (row k),
+    drawn device-side with its position key so full acceptance emits
+    exactly what solo would."""
     from .decode import score_span
 
     def run(params: Params, cache: KVCache, scored: jax.Array,
@@ -329,14 +332,13 @@ def _build_verify_sampled(cfg: ModelConfig, temperature: float, top_k: int,
         s, span, v = logits.shape
         adj = adjusted_logits(logits.reshape(s * span, v), temperature,
                               top_k, top_p).reshape(s, span, v)
-        q = jax.nn.softmax(adj, axis=-1)
 
         def bonus_one(adj_row, key, p):
             return jax.random.categorical(
                 jax.random.fold_in(key, p + span), adj_row)
 
         bonus = jax.vmap(bonus_one)(adj[:, -1], keys, pos).astype(jnp.int32)
-        return q, bonus, cache
+        return adj, bonus, cache
 
     return jax.jit(run, donate_argnums=(1,))
 
@@ -631,6 +633,17 @@ class ServeEngine:
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (prefill always "
                              "samples the first token)")
+        if self.request_keyed:
+            # the rid IS the request's randomness identity: a duplicate
+            # among in-flight requests would silently emit fully
+            # correlated streams
+            in_flight = ({r.rid for r, _ in self.queue}
+                         | {r.rid for r in self.req if r is not None})
+            if req.rid in in_flight:
+                raise ValueError(
+                    f"request_keyed sampling requires distinct rids; "
+                    f"{req.rid} is already in flight (its stream would be "
+                    f"identical)")
         if len(req.prompt) > self.prompt_bucket:
             raise ValueError(
                 f"prompt len {len(req.prompt)} > bucket {self.prompt_bucket}")
@@ -922,31 +935,18 @@ class ServeEngine:
         proposals = np.asarray(proposals)                  # (slots, k)
         p_mat = np.asarray(p_probs, np.float64)            # (slots, k, V)
         scored = np.concatenate([self.next_tok[:, None], proposals], axis=1)
-        q_dev, bonus_dev, self.cache = self._verify_sampled(
+        adj_dev, bonus_dev, self.cache = self._verify_sampled(
             self.params, self.cache, jnp.asarray(scored), pos, keys)
-        q_mat = np.asarray(q_dev, np.float64)              # (slots, k+1, V)
+        q_mat = probs_from_adjusted(np.asarray(adj_dev))   # (slots, k+1, V)
         bonus = np.asarray(bonus_dev)                      # (slots,)
         acc_u, res_u = (np.asarray(a) for a in _spec_round_uniforms(
             keys, pos, k))
         self.tick_count += 1
         self.spec_stats["rounds"] += 1
-        from .spec_decode import residual_distribution
         for s in active:
             span = proposals[s]
-            n_ok = 0
-            rejection_tok = None
-            while n_ok < k:
-                x = int(span[n_ok])
-                ratio = (q_mat[s, n_ok, x]
-                         / max(p_mat[s, n_ok, x], 1e-30))
-                if float(acc_u[s, n_ok]) < min(1.0, ratio):
-                    n_ok += 1
-                    continue
-                res = residual_distribution(p_mat[s, n_ok], q_mat[s, n_ok])
-                rejection_tok = int(np.searchsorted(
-                    np.cumsum(res), float(res_u[s, n_ok]),
-                    side="right").clip(0, len(res) - 1))
-                break
+            n_ok, rejection_tok = accept_span(
+                span, p_mat[s], q_mat[s, :k], acc_u[s], res_u[s])
             self.spec_stats["drafted"] += k
             self.spec_stats["accepted"] += n_ok
             if rejection_tok is None:

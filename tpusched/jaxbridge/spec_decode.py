@@ -36,7 +36,7 @@ pods it places actually run.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -175,6 +175,41 @@ def residual_distribution(p: np.ndarray, q: np.ndarray) -> np.ndarray:
     return r / s
 
 
+def accept_span(span, p_mat: np.ndarray, q_mat: np.ndarray,
+                acc_u: np.ndarray, res_u: np.ndarray
+                ) -> "Tuple[int, Optional[int]]":
+    """THE acceptance/residual decision over one proposal span: proposals
+    ``span`` (k,), draft distributions ``p_mat`` (k, V), target
+    distributions ``q_mat`` (k, V) — float64, computed host-side from the
+    adjusted logits — and the round's accept/residual uniforms.
+    Returns (n_accepted, rejection_token-or-None). ONE definition shared
+    by solo ``speculative_sample`` and the engine's batched sampled tick:
+    the engine-vs-solo parity law depends on this math never drifting."""
+    k = len(span)
+    n_ok = 0
+    while n_ok < k:
+        x = int(span[n_ok])
+        ratio = q_mat[n_ok, x] / max(p_mat[n_ok, x], 1e-30)
+        if float(acc_u[n_ok]) < min(1.0, ratio):
+            n_ok += 1
+            continue
+        res = residual_distribution(p_mat[n_ok], q_mat[n_ok])
+        return n_ok, int(np.searchsorted(
+            np.cumsum(res), float(res_u[n_ok]),
+            side="right").clip(0, len(res) - 1))
+    return k, None
+
+
+def probs_from_adjusted(adj: np.ndarray) -> np.ndarray:
+    """Adjusted logits (…, V) → float64 distributions, the EXACT host
+    softmax both speculation paths divide in (a float32 device softmax
+    would shift min(1, q/p) by ~1e-7 — enough to flip a token on an
+    unlucky uniform and break engine-vs-solo parity)."""
+    a = np.asarray(adj, np.float64)
+    q = np.exp(a - a.max(axis=-1, keepdims=True))
+    return q / q.sum(axis=-1, keepdims=True)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "temperature", "top_k", "top_p"),
                    donate_argnums=(1,))
@@ -277,23 +312,11 @@ def speculative_sample(target_params: Params, target_cfg: ModelConfig,
             top_p=top_p)
         target_calls += 1
         adj = np.asarray(adj_dev, np.float64)               # (k+1, vocab)
-        q_mat = np.exp(adj - adj.max(axis=-1, keepdims=True))
-        q_mat /= q_mat.sum(axis=-1, keepdims=True)
+        q_mat = probs_from_adjusted(adj)
         acc_u, res_u = (np.asarray(a) for a in _round_uniforms(
             key, jnp.int32(t_pos), k))
-        n_ok = 0
-        emitted_rejection = None
-        while n_ok < k:
-            x = span[n_ok]
-            ratio = q_mat[n_ok, x] / max(p_mat[n_ok, x], 1e-30)
-            if float(acc_u[n_ok]) < min(1.0, ratio):
-                n_ok += 1
-                continue
-            res = residual_distribution(p_mat[n_ok], q_mat[n_ok])
-            emitted_rejection = int(np.searchsorted(
-                np.cumsum(res), float(res_u[n_ok]),
-                side="right").clip(0, len(res) - 1))
-            break
+        n_ok, emitted_rejection = accept_span(span, p_mat, q_mat[:k],
+                                              acc_u, res_u)
         accepted += n_ok
         if emitted_rejection is None:
             # full acceptance: the bonus token at row t_pos+k+1 draws its

@@ -364,8 +364,15 @@ class FakeKube:
                                 # real apiserver's freshness contract): the
                                 # client's resume point advances without
                                 # object traffic, so a reconnect never
-                                # replays history another kind produced
+                                # replays history another kind produced.
+                                # Read rv AND confirm the queue is drained
+                                # under ONE lock: a bookmark advertising a
+                                # resume point past a queued-but-unsent
+                                # event would lose that event across a
+                                # reconnect
                                 with st.lock:
+                                    if not events.empty():
+                                        continue
                                     rv = str(st.rv)
                                 data = json.dumps(
                                     {"type": "BOOKMARK",
