@@ -808,6 +808,36 @@ def test_sampled_speculative_serving_matches_solo(model):
         assert got[r.rid] == list(solo[0]), f"request {r.rid}"
 
 
+def test_sampled_speculative_composes_with_int8_arena(model):
+    """KEP-303's composition matrix row: sampled speculation over an int8
+    TARGET arena still equals solo speculative_sample with the same int8
+    cfg (quantized rows are identical on both sides; the acceptance math
+    divides the same adjusted distributions)."""
+    import dataclasses
+    from tpusched.jaxbridge.spec_decode import speculative_sample
+    cfg, params = model
+    i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    dp = init_params(jax.random.PRNGKey(9), dcfg)
+    eng = ServeEngine(params, i8, slots=2, max_seq=64, prompt_bucket=16,
+                      temperature=0.8, top_k=24, seed=5,
+                      request_keyed=True, draft_params=dp, draft_cfg=dcfg,
+                      spec_k=3)
+    rng = np.random.default_rng(61)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 12, cfg.vocab),
+                    max_new_tokens=5) for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    got = {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+    for r in reqs:
+        key_r = jax.random.fold_in(jax.random.PRNGKey(5), r.rid)
+        solo, _ = speculative_sample(params, i8, dp, dcfg,
+                                     r.prompt[None, :],
+                                     r.max_new_tokens - 1, key_r, k=3,
+                                     temperature=0.8, top_k=24)
+        assert got[r.rid] == list(solo[0]), f"request {r.rid}"
+
+
 def test_sampled_speculative_self_draft_is_position_keyed(model):
     """Self-draft sampled speculation through the ENGINE collapses to the
     canonical position-keyed sampler — the full chain: batched sampled
