@@ -750,6 +750,28 @@ def test_request_keyed_sampling_is_batching_invariant_and_solo_exact(model):
                     request_keyed=True)   # greedy consumes no randomness
 
 
+def test_request_keyed_composes_with_tp_mesh(model):
+    """Request-keyed sampling on a tensor-parallel mesh: the vmapped
+    per-slot fold_in/categorical runs under GSPMD over sharded logits and
+    must emit exactly the single-device request-keyed streams."""
+    from jax.sharding import Mesh
+    cfg, params = model
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    rng = np.random.default_rng(67)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 12, cfg.vocab),
+                    max_new_tokens=5) for i in range(4)]
+
+    def run(**kw):
+        eng = ServeEngine(params, cfg, slots=2, max_seq=64,
+                          prompt_bucket=16, temperature=0.8, top_k=24,
+                          seed=5, request_keyed=True, **kw)
+        for r in reqs:
+            eng.submit(r)
+        return {c.rid: list(c.tokens) for c in eng.run_until_drained()}
+
+    assert run(mesh=mesh) == run()
+
+
 def test_request_keyed_composes_with_int8_arena(model):
     """Orthogonal features compose: the quantized arena under
     request-keyed sampling still equals the solo position-keyed sampler
