@@ -67,6 +67,12 @@ class DefragController:
         self._thread: Optional[threading.Thread] = None
         self._last_actuation = 0.0
         self.migrations = 0            # actuations performed (tests/metrics)
+        # actuations whose blocked gang did NOT take the freed window in
+        # time (it was deep in gang-denial TTL / backoff): the migrant was
+        # resubmitted, nothing was lost, but the actuation bought nothing.
+        # Repeated misses for one gang under a small cooldown look like
+        # eviction churn — watch this counter before lowering cooldown_s.
+        self.window_misses = 0
         self.last_plan: Optional[dict] = None
         # negative trial cache: (blocked, candidate-unit) → rv at failure.
         # A failed shadow trial is deterministic for unchanged state, and a
@@ -281,9 +287,11 @@ class DefragController:
         blocked_keys = [p.meta.key for p in self.pod_informer.by_index(
             POD_GROUP_INDEX, plan["blocked"])]
         if not self._wait_bound(self.api, blocked_keys):
+            self.window_misses += 1
             klog.error_s(None, "blocked gang missed the freed window; "
                          "resubmitting the migrants anyway",
-                         blocked=plan["blocked"], migrated=unit)
+                         blocked=plan["blocked"], migrated=unit,
+                         windowMisses=self.window_misses)
         for q in resubmit:
             # fault-tolerant per pod: eviction already happened — one
             # failed create (a Conflict from an external recreate during
