@@ -42,7 +42,7 @@ from tpusched.testing import (make_elastic_quota, make_pod, make_pod_group,
                               make_tpu_pool, wait_until)
 from tpusched.util.metrics import schedule_attempts
 
-SEED = 20260731
+SEED = 20260731          # module default; the test parametrizes over two
 ROUNDS = 10
 MIN_CYCLES = 1000
 CHIPS_PER_HOST = 4
@@ -104,7 +104,10 @@ def _gang_violation(api, gangs):
     return None
 
 
-def test_composed_chaos_soak():
+@pytest.mark.parametrize("seed", [20260731, 7])
+def test_composed_chaos_soak(seed):
+    global SEED
+    SEED = seed
     rng = random.Random(SEED)
     state_dir = tempfile.mkdtemp(prefix="tpusched-soak-composed-")
     profile = full_stack_profile(permit_wait_s=4, denied_s=1)
