@@ -24,12 +24,22 @@ tpu-test:
 bench:
 	$(PY) bench.py --gate
 
-# CI perf gate: min-of-5 headline gang runs under 2x the checked-in budget
-# (min is the noise-robust statistic for shared CI runners; quiet-hardware
-# enforcement of the full matrix is `make bench`).
+# CI perf gate: min-of-3 headline gang runs under the smoke budget (min is
+# the noise-robust statistic for shared CI runners; quiet-hardware
+# enforcement of the full matrix is `make bench`). Fast enough to run
+# pre-push alongside `make tier1`.
 .PHONY: bench-smoke
 bench-smoke:
 	$(PY) bench.py --smoke
+
+# The ROADMAP tier-1 suite (the merge gate): full tests/ minus slow marks,
+# CPU-only JAX, collection errors tolerated but counted. Mirrors the
+# "Tier-1 verify" command in ROADMAP.md.
+.PHONY: tier1
+tier1:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
 
 # Native C++ engine (torus placement math). Also auto-built when the
 # TopologyMatch plugin constructs (native.load() warm-up); this target just

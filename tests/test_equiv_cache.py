@@ -1,0 +1,293 @@
+"""Equivalence-class scheduling cache: invalidation edges.
+
+The fast path (sched/equivcache.py) may only serve a gang sibling while the
+validity triple holds — mutation cursor, nominator generation, per-plugin
+fingerprints. These tests pin the edges where serving a STALE entry would be
+a correctness bug: a node update between siblings, a foreign assume/forget,
+and a nominated preemptor in play (mandatory full-path bypass). Each edge is
+driven synchronously — Scheduler constructed but never run(); the test pops
+and calls schedule_one itself — so the interleaving is exact, not a race.
+"""
+from __future__ import annotations
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.fwk import PluginProfile
+from tpusched.plugins import default_registry
+from tpusched.sched import Scheduler
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, make_tpu_pool)
+from tpusched.util.equivalence import equivalence_key
+from tpusched.util.metrics import (equiv_cache_bypasses,
+                                   equiv_cache_differential_mismatches,
+                                   equiv_cache_hits,
+                                   equiv_cache_invalidations)
+
+
+def gang_profile(min_member_permit: bool = True) -> PluginProfile:
+    """Minimal gang wiring: Coscheduling quorum + the default node filters.
+    Permit keeps members WAITING until quorum, so no bind lands (and no
+    informer event fires) mid-burst — the interleaving stays synchronous."""
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeName", "NodeSelector",
+                "TaintToleration", "NodeResourcesFit"],
+        permit=["Coscheduling"] if min_member_permit else [],
+        bind=["DefaultBinder"],
+        parallelism=1,
+    )
+
+
+class Counters:
+    """Before/after deltas of the global equiv-cache counters."""
+
+    def __init__(self):
+        self._at = {}
+        for name, c in (("hits", equiv_cache_hits),
+                        ("invalidations", equiv_cache_invalidations),
+                        ("bypasses", equiv_cache_bypasses),
+                        ("mismatches", equiv_cache_differential_mismatches)):
+            self._at[name] = c.value()
+
+    def delta(self, name: str) -> float:
+        cur = {"hits": equiv_cache_hits,
+               "invalidations": equiv_cache_invalidations,
+               "bypasses": equiv_cache_bypasses,
+               "mismatches": equiv_cache_differential_mismatches}[name].value()
+        return cur - self._at[name]
+
+
+def build(n_nodes: int = 4, gang: int = 8, min_member: int = 8):
+    """A never-run scheduler + one gang parked in its queue. min_member
+    defaults to the full gang so permit holds every member waiting (no
+    async binds mutate the cache under the test's feet)."""
+    api = srv.APIServer()
+    s = Scheduler(api, default_registry(), gang_profile())
+    for i in range(n_nodes):
+        api.create(srv.NODES, make_node(
+            f"n{i}", capacity=make_resources(cpu=8, memory="16Gi")))
+    api.create(srv.POD_GROUPS, make_pod_group("g", min_member=min_member))
+    pods = [make_pod(f"w{i}", pod_group="g",
+                     requests=make_resources(cpu=1, memory="1Gi"))
+            for i in range(gang)]
+    for p in pods:
+        api.create(srv.PODS, p)
+    return api, s, pods
+
+
+def step(s: Scheduler) -> None:
+    info = s.queue.pop(timeout=1.0)
+    assert info is not None, "queue unexpectedly empty"
+    s.schedule_one(info)
+
+
+def assumed_node(s: Scheduler, key: str) -> str:
+    info = s.cache.snapshot()
+    for ni in info.list():
+        for p in ni.pods:
+            if p.key == key:
+                return ni.node.name
+    return ""
+
+
+def test_gang_siblings_hit_back_to_back():
+    api, s, pods = build()
+    try:
+        c = Counters()
+        for _ in range(len(pods)):
+            step(s)
+        # first member is the miss that builds the entry; every sibling
+        # after it rides the fast path
+        assert c.delta("hits") == len(pods) - 1
+        assert c.delta("invalidations") == 0
+        # every member actually got a host
+        for p in pods:
+            assert assumed_node(s, p.key)
+    finally:
+        s.stop()
+
+
+def test_equiv_key_separates_gangs_and_shapes():
+    a = make_pod("a", pod_group="g1", requests=make_resources(cpu=1))
+    b = make_pod("b", pod_group="g1", requests=make_resources(cpu=1))
+    other_gang = make_pod("c", pod_group="g2", requests=make_resources(cpu=1))
+    other_shape = make_pod("d", pod_group="g1", requests=make_resources(cpu=2))
+    assert equivalence_key(a) == equivalence_key(b)
+    assert equivalence_key(a) != equivalence_key(other_gang)
+    assert equivalence_key(a) != equivalence_key(other_shape)
+
+
+def test_node_update_invalidates_entry():
+    """A node update between two siblings moves the mutation cursor: the
+    second sibling must NOT be served from the stale entry (the update may
+    have been a cordon, a relabel, a capacity change)."""
+    api, s, pods = build()
+    try:
+        step(s)                      # member 0: full path, entry armed
+        c = Counters()
+        api.patch(srv.NODES, "/n0",
+                  lambda n: n.meta.labels.update({"churned": "yes"}))
+        step(s)                      # member 1: entry stale -> full path
+        assert c.delta("hits") == 0
+        assert c.delta("invalidations") == 1
+        assert assumed_node(s, pods[1].key)
+        # the full path re-armed a fresh entry: member 2 hits again
+        step(s)
+        assert c.delta("hits") == 1
+    finally:
+        s.stop()
+
+
+def test_foreign_assume_and_forget_invalidate():
+    """assume_pod/forget_pod from anywhere but this class's own chain break
+    the cursor chain — a sibling must re-derive feasibility (the foreign
+    pod consumed capacity the entry never saw)."""
+    api, s, pods = build()
+    try:
+        step(s)
+        c = Counters()
+        foreign = make_pod("foreign", requests=make_resources(cpu=1))
+        s.cache.assume_pod(foreign.deepcopy(), "n1")
+        step(s)
+        assert c.delta("hits") == 0
+        assert c.delta("invalidations") == 1
+
+        # the full path above re-armed; a forget breaks the chain again
+        c2 = Counters()
+        s.cache.forget_pod(foreign)
+        step(s)
+        assert c2.delta("hits") == 0
+        assert c2.delta("invalidations") == 1
+    finally:
+        s.stop()
+
+
+def test_nominated_preemptor_bypasses_cache():
+    """Nominated pods change per-node filter semantics (preemption dry-run):
+    the cache must not even be consulted, in either direction — no lookup,
+    no entry creation. And once the nomination clears, the GENERATION (not
+    emptiness) is what gates reuse: a nominate->un-nominate round trip ran
+    preemption machinery the armed entry never saw."""
+    api, s, pods = build()
+    try:
+        step(s)                      # arm an entry
+        c = Counters()
+        preemptor = make_pod("preemptor", priority=100,
+                             requests=make_resources(cpu=1))
+        s.handle.pod_nominator.add_nominated_pod(preemptor, "n0")
+        step(s)                      # sibling: mandatory full path
+        assert c.delta("hits") == 0
+        assert c.delta("bypasses") == 1
+
+        s.handle.pod_nominator.delete_nominated_pod_if_exists(preemptor)
+        c2 = Counters()
+        step(s)
+        # nominator empty again, but its generation moved past every entry
+        # armed before/during the nomination: no stale hit
+        assert c2.delta("hits") == 0
+        assert c2.delta("bypasses") == 0
+    finally:
+        s.stop()
+
+
+def test_podgroup_spec_change_invalidates_fingerprint():
+    """minMember lives OUTSIDE the scheduler cache (no node/pod mutation):
+    only the Coscheduling fingerprint can catch it changing between
+    siblings."""
+    api, s, pods = build()
+    try:
+        step(s)
+        c = Counters()
+        api.patch(srv.POD_GROUPS, "default/g",
+                  lambda pg: setattr(pg.spec, "min_member", 4))
+        step(s)
+        assert c.delta("hits") == 0
+        assert c.delta("invalidations") == 1
+    finally:
+        s.stop()
+
+
+def test_differential_mode_end_to_end_slice_gang():
+    """The oracle run: a real v5p slice gang scheduled end to end with
+    equiv_cache_differential=True — every cache hit re-runs the FULL path
+    and asserts the identical placement. Zero mismatches tolerated."""
+    GANG = 64
+    profile = tpu_gang_profile(permit_wait_s=120)
+    profile.equiv_cache_differential = True
+    c = Counters()
+    with TestCluster(profile=profile) as tc:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        tc.api.create(srv.TPU_TOPOLOGIES, topo)
+        tc.add_nodes(nodes)
+        tc.api.create(srv.POD_GROUPS,
+                      make_pod_group("gang", min_member=GANG,
+                                     tpu_slice_shape="4x4x4",
+                                     tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w{i:02d}", pod_group="gang", limits={TPU: 1},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(GANG)]
+        tc.create_pods(pods)
+        assert tc.wait_for_pods_scheduled([p.key for p in pods], timeout=60)
+        used = {}
+        for p in pods:
+            used.setdefault(tc.pod(p.key).spec.node_name, 0)
+            used[tc.pod(p.key).spec.node_name] += 1
+        assert len(used) == 16 and all(v == 4 for v in used.values())
+    assert c.delta("mismatches") == 0
+    assert c.delta("hits") > 0
+
+
+def test_cache_disabled_profile_still_schedules():
+    """equiv_cache=False wiring: the fast path never engages but the gang
+    still schedules identically (the knob is a pure perf toggle)."""
+    api = srv.APIServer()
+    prof = gang_profile()
+    prof.equiv_cache = False
+    s = Scheduler(api, default_registry(), prof)
+    try:
+        for i in range(2):
+            api.create(srv.NODES, make_node(
+                f"n{i}", capacity=make_resources(cpu=8, memory="16Gi")))
+        api.create(srv.POD_GROUPS, make_pod_group("g", min_member=4))
+        pods = [make_pod(f"w{i}", pod_group="g",
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(4)]
+        for p in pods:
+            api.create(srv.PODS, p)
+        c = Counters()
+        for _ in range(4):
+            step(s)
+        assert c.delta("hits") == 0
+        for p in pods:
+            assert assumed_node(s, p.key)
+    finally:
+        s.stop()
+
+
+def test_queue_prefers_gang_siblings():
+    """SchedulingQueue.pop drains same-priority siblings of the last-popped
+    gang back-to-back even when QueueSort interleaves another gang at equal
+    priority — the cursor chain (and so the cache) depends on it."""
+    from tpusched.api.scheduling import POD_GROUP_LABEL
+    from tpusched.sched.queue import SchedulingQueue
+
+    q = SchedulingQueue(
+        lambda a, b: (a.pod.priority, -a.timestamp) > (b.pod.priority, -b.timestamp))
+    # interleave two gangs' arrivals at equal priority
+    for i in range(3):
+        q.add(make_pod(f"a{i}", pod_group="ga"))
+        q.add(make_pod(f"b{i}", pod_group="gb"))
+    order = [q.pop(timeout=0.1).pod.meta.labels[POD_GROUP_LABEL]
+             for _ in range(6)]
+    # whatever gang pops first is fully drained before the other starts
+    assert order == sorted(order) or order == sorted(order, reverse=True)
+    assert order.count(order[0]) == 3 and order[0] == order[1] == order[2]
+
+    # a HIGHER-priority arrival must still preempt the preference
+    for i in range(2):
+        q.add(make_pod(f"c{i}", pod_group="gc"))
+    q.add(make_pod("urgent", priority=10))
+    first = q.pop(timeout=0.1)
+    assert first.pod.meta.name == "urgent"

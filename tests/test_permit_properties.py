@@ -15,6 +15,10 @@ The laws pinned for ANY interleaving of allows/rejects/expiries:
 """
 import time
 
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import settings
 from hypothesis import strategies as st
 from hypothesis.stateful import RuleBasedStateMachine, invariant, rule

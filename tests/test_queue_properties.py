@@ -11,6 +11,10 @@ double-schedules.
 A deterministic fake clock drives backoff expiry so the machine can
 explore "time passed" transitions without sleeping.
 """
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import settings
 from hypothesis import strategies as st
 from hypothesis.stateful import (Bundle, RuleBasedStateMachine, invariant,

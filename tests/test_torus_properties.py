@@ -18,6 +18,10 @@ Invariants:
 """
 import math
 
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from tpusched.api.topology import ACCELERATORS

@@ -67,6 +67,29 @@ class CycleState:
                 self._data[key] = v
             return v
 
+    def export(self, exclude=frozenset()) -> Dict[str, Any]:
+        """Snapshot the data map for the equivalence cache (minus per-cycle
+        scheduler keys). Values are shared by reference — install() applies
+        the StateData.Clone discipline when they re-enter a cycle."""
+        with self._lock:
+            return {k: v for k, v in self._data.items() if k not in exclude}
+
+    def install(self, data: Dict[str, Any]) -> None:
+        """Replay an exported data map into this cycle, cloning values that
+        implement .clone() (same contract as clone()) so a plugin mutating
+        its cycle state cannot corrupt the cached original."""
+        with self._lock:
+            for k, v in data.items():
+                self._data[k] = v.clone() if hasattr(v, "clone") else v
+
+    def adopt(self, other: "CycleState") -> None:
+        """Merge ``other``'s data map by REFERENCE — no re-clone. Only for
+        a throwaway donor that is discarded right after the call (the
+        equivalence-cache hit path committing its scratch state): cloning
+        again here would clone values install() already cloned."""
+        with self._lock:
+            self._data.update(other._data)
+
     def clone(self) -> "CycleState":
         """Shallow clone; values implementing .clone() are cloned too
         (StateData.Clone contract)."""

@@ -84,7 +84,48 @@ class PreFilterPlugin(Plugin):
         return None
 
 
+class EquivalenceAware:
+    """Optional mixin for PreFilter/Filter plugins whose verdicts read state
+    the equivalence cache's mutation cursor cannot see (PodGroup/topology CR
+    specs, TTL'd denial windows, freed-window claims, sibling counts,
+    quota mirrors).
+
+    ``equiv_fingerprint`` returns hashable key material covering exactly
+    those inputs; the scheduler stores it at entry creation and recomputes
+    it at every lookup — any difference invalidates the entry. Returning
+    ``None`` VETOES the fast path for this pod (the plugin cannot prove its
+    PreFilter output is reusable, e.g. TopologyMatch with multiple surviving
+    placement windows, CapacityScheduling while quotas exist).
+
+    ``state`` is the just-completed cycle's CycleState at entry creation and
+    ``None`` at lookup revalidation. The two computations are compared for
+    equality, so by default the returned material must NOT depend on
+    ``state`` — consult it only for the veto decision. The one sanctioned
+    exception is *predicting the post-Reserve value* of a field this
+    cycle's own Reserve is about to write: TopologyMatch normalizes its
+    pool pin this way (an unpinned arming cycle with exactly one surviving
+    window fingerprints the pool Reserve will pin, so the next sibling's
+    pinned lookup still matches). Use that pattern only when the creation
+    cycle can prove what the lookup-time value will be — and note a failed
+    Reserve that never writes the field just costs a safe miss."""
+
+    def equiv_fingerprint(self, pod: Pod, state: Optional[CycleState]):
+        return None
+
+
 class FilterPlugin(Plugin):
+    # Equivalence-cache classification (sched/equivcache.py). True (the
+    # conservative default) means this plugin's verdict can change between
+    # two cycles of EQUIVALENT pods even while the cache mutation cursor
+    # only advanced by the scheduler's own same-class assumes — i.e. it
+    # reads consumable capacity (resource fit, chip fit) — so the cached
+    # fast path must re-run it over the cached feasible set. False is a
+    # plugin's promise that its verdict depends only on (node object,
+    # pod-equivalence fields, PreFilter-cached cycle state): those inputs
+    # are byte-identical while an entry is armed (any node/pod change
+    # invalidates), so re-running it would be pure waste.
+    EQUIV_DYNAMIC = True
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         raise NotImplementedError
 

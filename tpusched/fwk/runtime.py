@@ -75,6 +75,14 @@ class PluginProfile:
     # with "unset")
     pod_initial_backoff_s: Optional[float] = None
     pod_max_backoff_s: Optional[float] = None
+    # gang-aware equivalence-class scheduling cache (sched/equivcache.py):
+    # memoized PreFilter/Filter outcomes reused across equivalent pods
+    # (gang siblings). equiv_cache_differential additionally re-runs the
+    # FULL path on every cache hit and asserts the identical placement —
+    # the oracle check bench scenarios and tests run with; never enable it
+    # in production wiring (it spends the cycle the cache saved).
+    equiv_cache: bool = True
+    equiv_cache_differential: bool = False
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]
@@ -201,6 +209,16 @@ class PodNominator:
     def __init__(self):
         self._lock = threading.RLock()
         self._by_node: Dict[str, Dict[str, Pod]] = {}
+        # bumped on every effective add/remove/update — the equivalence
+        # cache's witness that NO nomination changed between a cached
+        # entry's arming and its reuse (an empty map at both ends is not
+        # enough: a nominate→un-nominate round trip in between ran
+        # preemption machinery the entry never saw)
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        return self._generation
 
     def add_nominated_pod(self, pod: Pod, node_name: str) -> None:
         node = node_name or pod.status.nominated_node_name
@@ -209,6 +227,7 @@ class PodNominator:
         with self._lock:
             self.delete_nominated_pod_if_exists(pod)
             self._by_node.setdefault(node, {})[pod.key] = pod
+            self._generation += 1
 
     def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
         with self._lock:
@@ -217,6 +236,7 @@ class PodNominator:
                     del pods[pod.key]
                     if not pods:
                         del self._by_node[node]
+                    self._generation += 1
 
     def update_nominated_pod(self, old: Pod, new: Pod) -> None:
         with self._lock:
@@ -338,6 +358,29 @@ class Framework:
         # them from the per-node sweep (sched/scheduler.py).
         self.batch_filter_plugins = [
             p for p in self.filter_plugins if isinstance(p, BatchFilterPlugin)]
+        # Equivalence-cache fast path (sched/equivcache.py): the subset of
+        # filters whose verdict can change between cycles of equivalent pods
+        # while only same-class assumes moved the mutation cursor (resource/
+        # chip fit). A cache hit re-runs ONLY these over the cached feasible
+        # set; EQUIV_DYNAMIC=False plugins were already decided by the entry.
+        # Batch-capable dynamics keep their vectorized path on hits too
+        # (the scheduler runs filter_batch over the cached set first).
+        self.dynamic_batch_filter_plugins = [
+            p for p in self.batch_filter_plugins
+            if getattr(type(p), "EQUIV_DYNAMIC", True)]
+        batch_names = {p.name() for p in self.dynamic_batch_filter_plugins}
+        self._dynamic_filter_dispatch = [
+            (p.name(), p.filter) for p in self.filter_plugins
+            if getattr(type(p), "EQUIV_DYNAMIC", True)
+            and p.name() not in batch_names]
+        # PreFilter/Filter plugins carrying cache-invisible state: their
+        # fingerprints gate entry creation and revalidate every lookup.
+        from .interfaces import EquivalenceAware
+        seen_eq: Dict[str, Plugin] = {}
+        for p in list(self.pre_filter_plugins) + list(self.filter_plugins):
+            if isinstance(p, EquivalenceAware) and p.name() not in seen_eq:
+                seen_eq[p.name()] = p
+        self.equiv_aware_plugins = list(seen_eq.values())
         # Optional per-node parallelism for score (scheduler injects the
         # shared pool; None = serial, the default for bare Frameworks/tests)
         self.parallelizer = None
@@ -398,6 +441,23 @@ class Framework:
         skip = state.skip_filter_plugins
         for name, filter_fn in self._filter_dispatch:
             if name in skip or name in exclude:
+                continue
+            s = filter_fn(state, pod, node_info)
+            if not s.is_success():
+                return s.with_plugin(name)
+        return Status.success()
+
+    def run_dynamic_filter_plugins(self, state: CycleState, pod: Pod,
+                                   node_info: NodeInfo) -> Status:
+        """Equivalence-cache hit path: only the capacity-consuming filters
+        re-run over a cached feasible node (static verdicts are byte-stable
+        while the entry is armed — see FilterPlugin.EQUIV_DYNAMIC), and
+        batch-capable dynamics are excluded here (the scheduler already ran
+        their filter_batch over the whole cached set). The caller guarantees
+        no nominated pods exist (hits are impossible otherwise)."""
+        skip = state.skip_filter_plugins
+        for name, filter_fn in self._dynamic_filter_dispatch:
+            if name in skip:
                 continue
             s = filter_fn(state, pod, node_info)
             if not s.is_success():

@@ -23,7 +23,8 @@ from ...api.core import Pod, PodDisruptionBudget
 from ...api.resources import ResourceList
 from ...api.scheduling import ElasticQuota
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
+                               EquivalenceAware, EVENT_ADD,
                                EVENT_DELETE, EVENT_UPDATE, PostFilterPlugin,
                                PostFilterResult, PreFilterExtensions,
                                PreFilterPlugin, ReservePlugin,
@@ -61,8 +62,18 @@ class _PreFilterState:
 
 
 class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
-                         EnqueueExtensions):
+                         EnqueueExtensions, EquivalenceAware):
     NAME = "CapacityScheduling"
+
+    def equiv_fingerprint(self, pod, state):
+        """Veto while ANY ElasticQuota exists: the per-cycle quota snapshot
+        moves with every Reserve — including the same-class sibling assumes
+        the cache's cursor chain sanctions — so a memoized snapshot could
+        admit a pod the live quota arithmetic would reject. With no quotas
+        registered, PreFilter degenerates to an empty snapshot plus a pure
+        function of the pod: trivially reusable."""
+        with self._lock:
+            return None if self.eq_infos else ()
 
     def __init__(self, args, handle):
         self.handle = handle

@@ -18,8 +18,9 @@ from ...api.core import Pod
 from ...api.scheduling import POD_GROUP_LABEL, pod_group_full_name, pod_group_label
 from ...config.types import CoschedulingArgs
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
-                               EVENT_DELETE, EVENT_UPDATE, PermitPlugin,
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
+                               EquivalenceAware, EVENT_ADD, EVENT_DELETE,
+                               EVENT_UPDATE, PermitPlugin,
                                PostBindPlugin, PostFilterPlugin,
                                PostFilterResult, PreFilterPlugin,
                                QueueSortPlugin, ReservePlugin, RESOURCE_POD,
@@ -31,7 +32,7 @@ from .core import (POD_GROUP_NOT_FOUND, POD_GROUP_NOT_SPECIFIED, SUCCESS, WAIT,
 
 class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
                    PermitPlugin, ReservePlugin, PostBindPlugin,
-                   EnqueueExtensions):
+                   EnqueueExtensions, EquivalenceAware):
     NAME = "Coscheduling"
 
     def __init__(self, args: Optional[CoschedulingArgs], handle):
@@ -85,6 +86,28 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
                 status.with_retry_after(remaining + 0.05)
             return status
         return Status.success()
+
+    # -- equivalence cache (sched/equivcache.py) ------------------------------
+
+    def equiv_fingerprint(self, pod: Pod, state):
+        """PreFilter inputs invisible to the mutation cursor: the PodGroup
+        spec (minMember / minResources can change without any node or pod
+        mutation), the live sibling COUNT (unassigned pod churn never
+        touches the scheduler cache), and the TTL'd denial/permit windows
+        (which lapse on the clock, announced by no event). Recomputing this
+        at every lookup means a lapsed denial window or a deleted sibling
+        invalidates the entry exactly when the full path's verdict would
+        change."""
+        full, pg = self.pg_mgr.get_pod_group(pod)
+        if pg is None:
+            return ("", full)
+        mgr = self.pg_mgr
+        min_resources = pg.spec.min_resources or {}
+        return (full, pg.meta.resource_version, pg.spec.min_member,
+                tuple(sorted(min_resources.items())),
+                len(mgr.siblings(pod)),
+                full in mgr.last_denied_pg,
+                full in mgr.permitted_pg if min_resources else None)
 
     # -- PostFilter -----------------------------------------------------------
 

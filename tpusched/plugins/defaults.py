@@ -106,6 +106,9 @@ class NodeResourcesFit(BatchFilterPlugin):
 
 class NodeUnschedulable(FilterPlugin):
     NAME = "NodeUnschedulable"
+    # reads only node.spec: byte-identical while an equivalence entry is
+    # armed (any node update bumps the mutation cursor)
+    EQUIV_DYNAMIC = False
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if node_info.node.spec.unschedulable:
@@ -115,6 +118,8 @@ class NodeUnschedulable(FilterPlugin):
 
 class TaintToleration(FilterPlugin):
     NAME = "TaintToleration"
+    # node taints + pod tolerations only: both pinned by cursor/equiv key
+    EQUIV_DYNAMIC = False
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         for taint in node_info.node.spec.taints:
@@ -126,6 +131,8 @@ class TaintToleration(FilterPlugin):
 
 class NodeName(FilterPlugin):
     NAME = "NodeName"
+    # pod.spec.node_name vs node name only
+    EQUIV_DYNAMIC = False
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if pod.spec.node_name and pod.spec.node_name != node_info.node.name:
@@ -135,6 +142,8 @@ class NodeName(FilterPlugin):
 
 class NodeSelector(FilterPlugin):
     NAME = "NodeSelector"
+    # node labels + pod selector only
+    EQUIV_DYNAMIC = False
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         selector = pod.spec.node_selector

@@ -63,7 +63,8 @@ from ...api.scheduling import (POD_GROUP_INDEX, PodGroup,
 from ...api.topology import LABEL_DCN_DOMAIN
 from ...config.types import MultiSliceArgs
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
+                               EquivalenceAware, EVENT_ADD,
                                EVENT_DELETE, EVENT_UPDATE, FilterPlugin,
                                NodeScore, PermitPlugin, PostFilterPlugin,
                                PostFilterResult, PreFilterPlugin,
@@ -104,8 +105,20 @@ def _node_pg_keys(info: NodeInfo) -> FrozenSet[str]:
 
 class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                  PreScorePlugin, ScorePlugin, ReservePlugin, PermitPlugin,
-                 EnqueueExtensions):
+                 EnqueueExtensions, EquivalenceAware):
     NAME = "MultiSlice"
+    # filter() reads only the PreFilter-stashed sibling-domain set; entries
+    # exist only for non-set pods (see equiv_fingerprint), whose stash is
+    # absent and whose filter is a constant pass.
+    EQUIV_DYNAMIC = False
+
+    def equiv_fingerprint(self, pod, state):
+        """Veto for multislice-set members: the set barrier reads sibling
+        PG existence, TTL'd denied/permitted-set windows, and cross-gang
+        DCN domains — none of which the mutation cursor tracks. Pods
+        outside any set never enter this plugin's logic (PreFilter skips),
+        so their fingerprint is the empty constant."""
+        return None if self._pod_set_pg(pod) is not None else ()
 
     def events_to_register(self) -> List[ClusterEvent]:
         """Events that can unstick a pod THIS plugin rejected: a sibling

@@ -35,9 +35,10 @@ from ...api.topology import (ACCELERATORS, TOPOLOGY_GROUP, format_coord,
                              parse_shape)
 from ...config.types import TopologyMatchArgs
 from ...fwk import CycleState, Status
-from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions, EVENT_ADD,
-                               EVENT_DELETE, EVENT_UPDATE, FilterPlugin,
-                               NodeScore, PostFilterPlugin, PostFilterResult,
+from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
+                               EquivalenceAware, EVENT_ADD, EVENT_DELETE,
+                               EVENT_UPDATE, FilterPlugin, NodeScore,
+                               PostFilterPlugin, PostFilterResult,
                                ReservePlugin, ScorePlugin,
                                PreFilterPlugin, RESOURCE_NODE, RESOURCE_POD,
                                RESOURCE_POD_GROUP, RESOURCE_TPU_TOPOLOGY)
@@ -77,14 +78,23 @@ class _CycleStash:
     def __init__(self):
         self.allowed: Dict[str, Tuple[str, int, float]] = {}
         self.max_membership = 1
+        # total surviving placements across every swept pool — the
+        # equivalence cache's participation gate (see equiv_fingerprint)
+        self.survivors = 0
 
     def clone(self):
         return self  # read-only after PreFilter
 
 
 class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
-                    ScorePlugin, ReservePlugin, EnqueueExtensions):
+                    ScorePlugin, ReservePlugin, EnqueueExtensions,
+                    EquivalenceAware):
     NAME = "TopologyMatch"
+    # filter() is a membership probe against the PreFilter stash — on a
+    # cache hit the stash IS the memoized artifact, so re-running the probe
+    # over the cached feasible set (feasible ⊆ allowed by construction)
+    # would be a no-op. Stash validity is the fingerprint's job.
+    EQUIV_DYNAMIC = False
 
     def __init__(self, args: Optional[TopologyMatchArgs], handle):
         self.args = args or TopologyMatchArgs()
@@ -240,6 +250,7 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                     mgrid.mask_of(eligible) & ~claimed)
                 if not n_survivors:
                     continue
+                stash.survivors += n_survivors
                 for node, count in membership.items():
                     prev = stash.allowed.get(node)
                     if prev is None or count < prev[1]:
@@ -379,6 +390,57 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             return Status.unschedulable(
                 "node is not part of any feasible slice placement")
         return Status.success()
+
+    # -- equivalence cache (sched/equivcache.py) ------------------------------
+
+    def equiv_fingerprint(self, pod: Pod, state):
+        """Key material for the inputs the mutation cursor cannot see:
+        TpuTopology CR versions (grid/placement geometry), live freed-window
+        claims (TTL'd), and the gang's pool pin. Occupancy itself is
+        cursor-guarded.
+
+        Participation gate (creation only): a slice pod's cycle must have
+        ended with EXACTLY ONE surviving placement. That is the regime where
+        the stash is provably stable under same-class sibling assumes —
+        assigned grows inside the unique window (it keeps surviving:
+        assigned ⊆ mask, and a host moving free→assigned stays covered),
+        hosts that fill up are re-rejected by the dynamic chip/resource
+        filters exactly as the full path's eligibility test would, and the
+        Score inputs (membership ≡ 1, one shared pool util) shift uniformly
+        across the window so the argmax cannot move. With ≥ 2 surviving
+        windows a sibling could land outside the window the first member
+        chose — the multi-window cycle takes the full path (in practice the
+        pool pin set at first Reserve collapses the next cycle to one
+        window, and THAT cycle's entry serves the rest of the gang)."""
+        claims = tuple(sorted(
+            (full, tk, tuple(sorted(names)))
+            for full, (tk, names) in self._window_claims.items()))
+        req = self._slice_request(pod)
+        if req is None:
+            return ("nonslice", claims)
+        if req == "invalid":
+            return None
+        pg, shape, want_acc = req
+        full = f"{pod.namespace}/{pg.meta.name}"
+        pin = self._gang_pool.get(full)
+        if state is not None:
+            stash = state.try_read(_STATE_KEY)
+            if stash is None or stash.survivors != 1:
+                return None
+            if pin is None and stash.allowed:
+                # normalize the pin across the arming boundary: this cycle's
+                # Reserve is about to pin the gang to the single surviving
+                # window's pool, so fingerprint the pool the NEXT sibling's
+                # lookup will see — without this the first entry of every
+                # gang dies at its first lookup (pin None → pin set) and the
+                # second member pays a wasted full sweep. A pinned sweep of
+                # that one pool produces the identical stash, so the two
+                # states are genuinely equivalent.
+                pin = next(iter(stash.allowed.values()))[0]
+        topos = tuple(sorted((t.key, t.meta.resource_version)
+                             for t in self.topo_informer.items()))
+        return ("slice", full, pg.meta.resource_version, tuple(shape),
+                want_acc, pin, claims, topos)
 
 
     # -- PostFilter: slice preemption -----------------------------------------

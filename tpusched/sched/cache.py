@@ -195,3 +195,19 @@ class Cache:
     def node_names(self):
         with self._lock:
             return list(self._infos)
+
+    # -- mutation cursor (equivalence-cache validity witness) -----------------
+
+    def mutation_cursor(self) -> int:
+        """Current value of the global change cursor. Every structural
+        mutation (node add/update/remove, pod attach/detach, assume/forget)
+        advances it; the equivalence cache keys entry validity on it."""
+        with self._lock:
+            return self._mutation
+
+    def snapshot_cursor(self) -> int:
+        """Cursor value the LAST snapshot() was built at — i.e. the state
+        this cycle's filters actually read. Differs from mutation_cursor()
+        only when an informer event raced in after snapshot()."""
+        with self._lock:
+            return self._snap_mutation

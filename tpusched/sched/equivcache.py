@@ -1,0 +1,105 @@
+"""Equivalence-class scheduling cache: memoized PreFilter/Filter outcomes
+for gang siblings (and identical singletons).
+
+A 256-member slice gang is 256 equivalent pods popped back-to-back; without
+this cache every member pays an identical PreFilter sweep (topology
+occupancy + placement membership + gang bookkeeping) and a full per-node
+Filter pass. An entry memoizes, for one equivalence class
+(util/equivalence.equivalence_key):
+
+- the PreFilter-written CycleState data (the TopologyMatch stash, claims
+  guard set, quota snapshots, ...),
+- the PreFilter-restricted candidate node set,
+- the skip-Filter plugin set, and
+- the node names that passed the full Filter sweep (the feasible set).
+
+Validity is the strict triple the cache is keyed on:
+
+- ``armed_mutation`` — the scheduler cache's mutation cursor. ANY node or
+  pod mutation invalidates; the one sanctioned exception is the chain of
+  the scheduler's own assumes for this same class: after a cycle assumes
+  its pod, the scheduler re-arms the entry iff the cursor advanced by
+  EXACTLY one (its own attach) — a concurrent foreign mutation breaks the
+  chain and the entry dies at the next lookup.
+- ``nominator_gen`` — the PodNominator generation. Nominated preemptors
+  change per-node filter semantics (the dry-run path), so the fast path
+  additionally requires an EMPTY nominator; the generation catches
+  nominate→un-nominate races between cycles.
+- ``fingerprints`` — per-plugin key material (EquivalenceAware) covering
+  inputs the mutation cursor cannot see: PodGroup/topology CR resource
+  versions, denial windows, freed-window claims, sibling counts.
+
+Exactness contract (why a hit cannot drift from the full path): between
+arming and lookup the only cluster change is assumes of pods from the SAME
+class. Those only consume resources, so per-node Filter failures are
+monotone — a node outside the feasible set stays outside. Nodes inside it
+are re-checked by the still-running *dynamic* filters (resource/chip fit);
+*static* filters (selector, taints, name, cordon, cached-stash membership)
+re-run would read byte-identical inputs, so they are skipped. Score always
+runs fresh on the live snapshot. Plugins whose PreFilter output is not
+provably reusable veto entry creation via their fingerprint (e.g.
+TopologyMatch vetoes multi-window placements, CapacityScheduling vetoes
+when quotas exist). The full path stays the oracle: nominated pods bypass
+the cache entirely, and the scheduler's differential mode re-runs the full
+path on every hit and asserts the identical placement.
+
+Single-threaded by design: only the scheduleOne loop touches it.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, FrozenSet, Hashable, Optional, Tuple
+
+# Entries are per equivalence class; a handful of gangs plus singleton
+# templates are live at once, so a small LRU bound is plenty.
+DEFAULT_CAPACITY = 256
+
+
+class EquivEntry:
+    __slots__ = ("key", "armed_mutation", "nominator_gen", "fingerprints",
+                 "prefilter_data", "skip_filter", "restricted", "feasible")
+
+    def __init__(self, key: Hashable, fingerprints: Tuple,
+                 nominator_gen: int, prefilter_data: Dict,
+                 skip_filter: FrozenSet[str],
+                 restricted: Optional[FrozenSet[str]],
+                 feasible: Tuple[str, ...]):
+        self.key = key
+        self.armed_mutation = -1          # set by arm(); -1 never matches
+        self.nominator_gen = nominator_gen
+        self.fingerprints = fingerprints
+        self.prefilter_data = prefilter_data
+        self.skip_filter = skip_filter
+        self.restricted = restricted
+        self.feasible = feasible
+
+
+class EquivalenceCache:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._capacity = capacity
+        self._entries: "OrderedDict[Hashable, EquivEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: Hashable) -> Optional[EquivEntry]:
+        ent = self._entries.get(key)
+        if ent is not None:
+            self._entries.move_to_end(key)
+        return ent
+
+    def drop(self, key: Hashable) -> None:
+        self._entries.pop(key, None)
+
+    def arm(self, entry: EquivEntry, mutation_cursor: int) -> None:
+        """(Re)arm ``entry`` as valid exactly at ``mutation_cursor`` and
+        (re)insert it. The caller has verified the cursor advanced by
+        exactly its own assume since the state the entry describes."""
+        entry.armed_mutation = mutation_cursor
+        self._entries[entry.key] = entry
+        self._entries.move_to_end(entry.key)
+        while len(self._entries) > self._capacity:
+            self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        self._entries.clear()

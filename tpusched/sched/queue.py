@@ -16,12 +16,20 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..api.core import Pod
+from ..api.scheduling import POD_GROUP_LABEL
 from ..fwk.interfaces import ClusterEvent
 from ..util import klog
 
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 10.0
 UNSCHEDULABLE_Q_FLUSH_S = 30.0
+
+
+def _gang_of(info: "QueuedPodInfo"):
+    """(namespace, gang) of a queued pod, or None for singletons."""
+    pod = info.pod
+    name = pod.meta.labels.get(POD_GROUP_LABEL)
+    return (pod.meta.namespace, name) if name else None
 
 
 class QueuedPodInfo:
@@ -53,6 +61,10 @@ class _Heap:
         self._seq = itertools.count()
         self._heap: List = []
         self._entries: Dict[str, list] = {}   # key → entry; entry[2] None ⇒ removed
+        # (ns, gang) → live member keys: lets pop() drain a gang's siblings
+        # back-to-back (the equivalence cache only hits while the cursor
+        # chain is unbroken by foreign assumes)
+        self._gangs: Dict[tuple, set] = {}
 
     class _Item:
         __slots__ = ("info", "less", "seq")
@@ -74,14 +86,45 @@ class _Heap:
         entry = [item, key, info]
         self._entries[key] = entry
         heapq.heappush(self._heap, (item, entry))
+        gang = _gang_of(info)
+        if gang is not None:
+            self._gangs.setdefault(gang, set()).add(key)
+
+    def _gang_discard(self, key: str, info: QueuedPodInfo) -> None:
+        gang = _gang_of(info)
+        if gang is None:
+            return
+        members = self._gangs.get(gang)
+        if members is not None:
+            members.discard(key)
+            if not members:
+                del self._gangs[gang]
 
     def pop(self) -> Optional[QueuedPodInfo]:
         while self._heap:
             _, entry = heapq.heappop(self._heap)
             if entry[2] is not None:
                 del self._entries[entry[1]]
+                self._gang_discard(entry[1], entry[2])
                 return entry[2]
         return None
+
+    def peek(self) -> Optional[QueuedPodInfo]:
+        while self._heap:
+            _, entry = self._heap[0]
+            if entry[2] is not None:
+                return entry[2]
+            heapq.heappop(self._heap)
+        return None
+
+    def get(self, key: str) -> Optional[QueuedPodInfo]:
+        entry = self._entries.get(key)
+        return entry[2] if entry is not None else None
+
+    def gang_member(self, gang: tuple) -> Optional[str]:
+        """Deterministic (smallest-key) live member of ``gang``, if any."""
+        members = self._gangs.get(gang)
+        return min(members) if members else None
 
     def remove(self, key: str) -> Optional[QueuedPodInfo]:
         entry = self._entries.pop(key, None)
@@ -89,6 +132,7 @@ class _Heap:
             return None
         info = entry[2]
         entry[2] = None
+        self._gang_discard(key, info)
         return info
 
     def __contains__(self, key: str) -> bool:
@@ -125,6 +169,14 @@ class SchedulingQueue:
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         # plugin name → events that plugin said can unstick its rejections
         self._cluster_event_map = cluster_event_map or {}
+        # coalesced cluster-event moves: resource → OR'd action mask. A
+        # 256-member gang's informer storm is 256 identical scans over the
+        # parked pods if applied per event; buffering them here and draining
+        # once per pop cycle (or observer read) makes the storm one scan.
+        self._pending_moves: Dict[str, int] = {}
+        # gang of the most recently popped pod: pop() prefers its remaining
+        # same-priority siblings so the equivalence cache actually hits
+        self._last_gang: Optional[tuple] = None
         self._closed = False
 
     def _bk_add(self, key: str) -> None:
@@ -142,6 +194,7 @@ class SchedulingQueue:
         kube-scheduler metric). (pending_pods() below returns the pod
         objects themselves — the introspection API.)"""
         with self._lock:
+            self._apply_pending_moves_locked()
             # _backoff_keys counts LIVE entries; len(_backoff) would also
             # count tombstones left by activate() until the heap drains
             return {"active": len(self._active),
@@ -234,6 +287,7 @@ class SchedulingQueue:
         """PodsToActivate: force the listed pods into activeQ
         (core.go:111-143 / upstream scheduler.go activate)."""
         with self._lock:
+            self._apply_pending_moves_locked()
             # Nothing parked means nothing to move: during a healthy gang
             # burst every sibling is active or in-flight, and PodsToActivate
             # probes all of them every cycle — this O(1) exit is what keeps
@@ -259,24 +313,41 @@ class SchedulingQueue:
 
     def move_all_to_active_or_backoff(self, resource: str, action: int) -> None:
         """Cluster event: requeue unschedulable pods whose rejector plugins
-        registered a matching event (or that have no recorded rejector)."""
+        registered a matching event (or that have no recorded rejector).
+
+        Coalesced: the event is buffered (actions OR'd per resource) and the
+        parked-pod scan runs once when the buffer drains — at the consumer's
+        next pop cycle or any observer read — so a gang-sized informer storm
+        costs one scan instead of one per member. Merging actions is exact:
+        ClusterEvent.matches tests bitmask overlap, i.e. "some buffered
+        event would have unstuck this pod"."""
         with self._lock:
-            now = self._clock()
-            moved = []
-            for key, info in list(self._unschedulable.items()):
-                if self._event_unsticks(info, resource, action):
-                    del self._unschedulable[key]
-                    moved.append(info)
-            for info in moved:
-                expiry = info.timestamp + info.backoff_duration(
-                    self._initial_backoff_s, self._max_backoff_s)
-                if expiry <= now:
-                    self._active.push(info)
-                else:
-                    heapq.heappush(self._backoff, (expiry, next(self._backoff_seq), info))
-                    self._bk_add(info.pod.key)
-            if moved:
-                self._lock.notify_all()
+            self._pending_moves[resource] = \
+                self._pending_moves.get(resource, 0) | action
+            self._lock.notify_all()
+
+    def _apply_pending_moves_locked(self) -> None:
+        if not self._pending_moves:
+            return
+        pending, self._pending_moves = self._pending_moves, {}
+        now = self._clock()
+        moved = []
+        for key, info in list(self._unschedulable.items()):
+            if any(self._event_unsticks(info, resource, mask)
+                   for resource, mask in pending.items()):
+                del self._unschedulable[key]
+                moved.append(info)
+        for info in moved:
+            expiry = info.timestamp + info.backoff_duration(
+                self._initial_backoff_s, self._max_backoff_s)
+            if expiry <= now:
+                self._active.push(info)
+            else:
+                heapq.heappush(self._backoff,
+                               (expiry, next(self._backoff_seq), info))
+                self._bk_add(info.pod.key)
+        if moved:
+            self._lock.notify_all()
 
     def _event_unsticks(self, info: QueuedPodInfo, resource: str, action: int) -> bool:
         if not info.unschedulable_plugins:
@@ -290,6 +361,7 @@ class SchedulingQueue:
     # -- consumer -------------------------------------------------------------
 
     def _flush_locked(self) -> None:
+        self._apply_pending_moves_locked()
         now = self._clock()
         while self._backoff and self._backoff[0][0] <= now:
             _, _, info = heapq.heappop(self._backoff)
@@ -301,6 +373,32 @@ class SchedulingQueue:
                 del self._unschedulable[key]
                 self._active.push(info)
 
+    def _pop_preferred_locked(self) -> Optional[QueuedPodInfo]:
+        """Pop the next pod, preferring a remaining sibling of the gang the
+        LAST pop served (so the equivalence cache's cursor chain stays
+        unbroken across the gang's burst). The preference never jumps the
+        priority order: a sibling is taken over the heap top only when both
+        have the same priority — within one priority band QueueSort order is
+        a throughput policy, not a correctness contract."""
+        top = self._active.peek()
+        if top is None:
+            return None
+        last = self._last_gang
+        info = None
+        if last is not None and _gang_of(top) != last:
+            key = self._active.gang_member(last)
+            if key is None:
+                self._last_gang = None
+            else:
+                sibling = self._active.get(key)
+                if (sibling is not None
+                        and sibling.pod.priority == top.pod.priority):
+                    info = self._active.remove(key)
+        if info is None:
+            info = self._active.pop()
+        self._last_gang = _gang_of(info) if info is not None else None
+        return info
+
     def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
@@ -308,7 +406,7 @@ class SchedulingQueue:
                 if self._closed:
                     return None
                 self._flush_locked()
-                info = self._active.pop()
+                info = self._pop_preferred_locked()
                 if info is not None:
                     info.attempts += 1
                     return info
@@ -331,6 +429,7 @@ class SchedulingQueue:
 
     def pending_pods(self) -> List[Pod]:
         with self._lock:
+            self._apply_pending_moves_locked()
             out = [i.pod for i in self._active.items()]
             out += [i.pod for (_, _, i) in self._backoff if i is not None]
             out += [i.pod for i in self._unschedulable.values()]

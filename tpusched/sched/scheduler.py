@@ -26,11 +26,22 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_POD, RESOURCE_POD_GROUP,
                               RESOURCE_TPU_TOPOLOGY)
 from ..util import klog
+from ..util.equivalence import equivalence_key
 from ..util.metrics import (bind_total, e2e_scheduling_seconds,
-                            extension_point_seconds, schedule_attempts)
+                            equiv_cache_bypasses,
+                            equiv_cache_differential_mismatches,
+                            equiv_cache_fallbacks, equiv_cache_hits,
+                            equiv_cache_invalidations, equiv_cache_misses,
+                            equiv_cache_vetoes, extension_point_seconds,
+                            schedule_attempts)
 from ..util.podutil import assigned
 from .cache import Cache
+from .equivcache import EquivalenceCache, EquivEntry
 from .queue import QueuedPodInfo, SchedulingQueue
+
+# CycleState keys the equivalence cache must NOT memoize: per-cycle
+# scheduler plumbing, re-created fresh by every cycle.
+_EQUIV_EXCLUDE_KEYS = frozenset((PODS_TO_ACTIVATE_KEY, "tpusched/diagnosis"))
 
 _KIND_TO_RESOURCE = {
     srv.PODS: RESOURCE_POD,
@@ -146,7 +157,11 @@ class Scheduler:
         for q in ("active", "backoff", "unschedulable"):
             def depth(q=q, ref=queue_ref):
                 live = ref()
-                return live.pending_counts()[q] if live is not None else 0
+                # None = dead provider: the registry prunes this series at
+                # the next scrape instead of emitting stale zeros forever
+                # (HA failover / what-if restarts construct schedulers
+                # under fresh label sets constantly)
+                return live.pending_counts()[q] if live is not None else None
             REGISTRY.gauge_func("tpusched_pending_pods", depth,
                                 "Pods pending per scheduling sub-queue.",
                                 labels=f'{sched_label}queue="{q}"')
@@ -162,6 +177,18 @@ class Scheduler:
         from ..util.parallelize import Parallelizer
         self._par = Parallelizer(profile.parallelism)
         self._fw.parallelizer = self._par
+
+        # Equivalence-class scheduling cache (sched/equivcache.py): gang
+        # siblings popped back-to-back skip straight to Score over the
+        # memoized feasible set. Touched only by the scheduleOne thread.
+        self._equiv_cache: Optional[EquivalenceCache] = (
+            EquivalenceCache() if profile.equiv_cache else None)
+        self._equiv_differential = profile.equiv_cache_differential
+        # (entry, cycle cursor) awaiting arming: set by the cycle that built
+        # or reused the entry, consumed right after assume_pod — the only
+        # point where "the cursor advanced by EXACTLY my own attach" can be
+        # verified.
+        self._equiv_pending: Optional[tuple] = None
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
@@ -314,6 +341,9 @@ class Scheduler:
         self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
         assumed = pod.deepcopy()
         self.cache.assume_pod(assumed, node_name)
+        # the sanctioned cursor advance: (re)arm the cycle's equivalence
+        # entry iff the assume was the ONLY mutation since the snapshot
+        self._equiv_after_assume()
 
         s = self._timed_point("Reserve", self._fw.run_reserve_plugins_reserve,
                               state, assumed, node_name)
@@ -357,11 +387,30 @@ class Scheduler:
                           fn, *args)
 
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
-        """genericScheduler.Schedule analog: prefilter → filter → score."""
+        """genericScheduler.Schedule analog: prefilter → filter → score —
+        with the equivalence-class fast path in front: a gang sibling whose
+        class has a valid cache entry skips PreFilter and the static
+        filters entirely and goes straight to a dynamic re-filter + Score
+        over the memoized feasible set."""
+        self._equiv_pending = None
         num_nodes = snapshot.num_nodes()
         if num_nodes == 0:
             return "", Status.unschedulable("no nodes available")
+        entry = self._equiv_lookup(pod)
+        if entry is not None:
+            result = self._schedule_from_cache(state, pod, snapshot, entry)
+            if result is not None:
+                return result
+            # cached feasible set drained (or differential mismatch): the
+            # entry is dropped and the full path runs as the oracle
+        return self._schedule_full(state, pod, snapshot, record=True)
 
+    def _schedule_full(self, state: CycleState, pod: Pod, snapshot,
+                       record: bool = False):
+        """The full per-node path — always the oracle. ``record``: offer the
+        completed cycle to the equivalence cache (False for differential
+        re-runs, which must be side-effect-free on the cache)."""
+        num_nodes = snapshot.num_nodes()
         s = self._timed_point("PreFilter", self._fw.run_pre_filter_plugins,
                               state, pod)
         if not s.is_success():
@@ -399,9 +448,29 @@ class Scheduler:
                    if detail else f"0/{num_nodes} nodes are available")
             return "", Status.unschedulable(msg).with_plugin(
                 next(iter(diagnosis.values())).plugin if diagnosis else "")
+        # snapshot the data map BEFORE Score: an entry memoizes PreFilter/
+        # Filter state only. Score-phase writes (per-node raw-score dicts
+        # etc.) are per-cycle and often plain dicts with no .clone() —
+        # letting them into an entry would share them by reference with
+        # every hit cycle's Score, mutating the cached original in place.
+        prefilter_export = None
+        if record and self._equiv_cache is not None:
+            prefilter_export = state.export(exclude=_EQUIV_EXCLUDE_KEYS)
+        node_name, status = self._select_host(state, pod, feasible)
+        if record and status.is_success():
+            # a sampled sweep (want < candidates) is a partial feasible set:
+            # memoizing it would pin siblings to the sample
+            self._equiv_offer(pod, state, feasible,
+                              swept_all=want >= len(infos),
+                              prefilter_data=prefilter_export)
+        return node_name, status
+
+    def _select_host(self, state: CycleState, pod: Pod, feasible):
+        """PreScore → Score → deterministic argmax. Shared verbatim by the
+        full path and the cache-hit path so the two cannot diverge in
+        selection semantics."""
         if len(feasible) == 1:
             return feasible[0].name, Status.success()
-
         s = self._timed_point("PreScore", self._fw.run_pre_score_plugins,
                               state, pod, feasible)
         if not s.is_success():
@@ -412,6 +481,189 @@ class Scheduler:
             return "", s
         best = max(feasible, key=lambda n: (totals.get(n.name, 0), n.name))
         return best.name, Status.success()
+
+    # -- equivalence-class fast path (sched/equivcache.py) --------------------
+
+    def _equiv_lookup(self, pod: Pod) -> Optional[EquivEntry]:
+        """Return a VALID entry for the pod's class or None. Validity is the
+        strict triple: mutation cursor at the snapshot this cycle's filters
+        read, nominator generation, and every EquivalenceAware plugin's
+        recomputed fingerprint."""
+        if self._equiv_cache is None:
+            return None
+        nominator = self.handle.pod_nominator
+        if not nominator.empty():
+            # nominated preemptors change per-node filter semantics (the
+            # dry-run path): the full path is mandatory
+            equiv_cache_bypasses.inc()
+            return None
+        key = equivalence_key(pod)
+        entry = self._equiv_cache.get(key)
+        if entry is None:
+            equiv_cache_misses.inc()
+            return None
+        if (entry.armed_mutation != self.cache.snapshot_cursor()
+                or entry.nominator_gen != nominator.generation
+                or entry.fingerprints != self._equiv_fingerprints(pod, None)):
+            self._equiv_cache.drop(key)
+            equiv_cache_invalidations.inc()
+            return None
+        return entry
+
+    def _equiv_fingerprints(self, pod: Pod, state: Optional[CycleState]):
+        """Tuple of (plugin, fingerprint) over the EquivalenceAware plugins,
+        or None if any plugin vetoes."""
+        fps = []
+        for p in self._fw.equiv_aware_plugins:
+            fp = p.equiv_fingerprint(pod, state)
+            if fp is None:
+                return None
+            fps.append((p.name(), fp))
+        return tuple(fps)
+
+    def _schedule_from_cache(self, state: CycleState, pod: Pod, snapshot,
+                             entry: EquivEntry):
+        """The hit path: dynamic re-filter over the cached feasible set,
+        then the shared Score tail. Returns (node, status) or None to fall
+        back to the full path (entry already dropped)."""
+        fw = self._fw
+        # work on a throwaway state first: a fallback must leave the real
+        # cycle state untouched (CapacityScheduling reuses a pre-existing
+        # EQ snapshot key if one is present)
+        cstate = CycleState()
+        cstate.install(entry.prefilter_data)
+        cstate.skip_filter_plugins |= set(entry.skip_filter)
+        if entry.restricted is not None:
+            cstate.restricted_node_names = set(entry.restricted)
+        infos = []
+        for name in entry.feasible:
+            node_info = snapshot.get(name)
+            if node_info is None:
+                # a vanished node always bumps the cursor, so this is
+                # unreachable in practice — belt and braces
+                self._equiv_cache.drop(entry.key)
+                equiv_cache_invalidations.inc()
+                return None
+            infos.append(node_info)
+        # batch-capable dynamics keep their vectorized path on hits: one
+        # fused resource-fit pass over the cached set, exactly as the full
+        # path's pre-pass (the hit path guarantees an empty nominator, the
+        # same condition the full path gates its batch pass on)
+        batch_fail, _ = self._run_batch_filters(
+            fw.dynamic_batch_filter_plugins, cstate, pod, infos)
+        feasible = []
+        diagnosis: Dict[str, Status] = {}
+        for i, node_info in enumerate(infos):
+            fs = batch_fail[i]
+            if fs is None:
+                fs = fw.run_dynamic_filter_plugins(cstate, pod, node_info)
+            if fs.is_success():
+                feasible.append(node_info.node)
+            elif fs.is_error():
+                self._equiv_cache.drop(entry.key)
+                equiv_cache_fallbacks.inc()
+                return None
+            else:
+                diagnosis[node_info.node.name] = fs
+        if not feasible:
+            # the gang burst consumed every cached host: the full path
+            # re-derives feasibility (and owns the unschedulable messaging)
+            self._equiv_cache.drop(entry.key)
+            equiv_cache_fallbacks.inc()
+            return None
+        node_name, status = self._select_host(cstate, pod, feasible)
+        if not status.is_success():
+            self._equiv_cache.drop(entry.key)
+            equiv_cache_fallbacks.inc()
+            return None
+        if self._equiv_differential:
+            full_node = self._differential_check(pod, snapshot, node_name)
+            if full_node != node_name:
+                self._equiv_cache.drop(entry.key)
+                equiv_cache_fallbacks.inc()
+                return None
+        equiv_cache_hits.inc()
+        # commit the throwaway state into the cycle: Reserve/Permit plugins
+        # read the PreFilter stashes from it (e.g. TopologyMatch's
+        # coordinate assignment). By-reference adopt — cstate dies here.
+        state.adopt(cstate)
+        state.skip_filter_plugins |= cstate.skip_filter_plugins
+        state.restricted_node_names = cstate.restricted_node_names
+        state.write("tpusched/diagnosis", diagnosis)
+        self._equiv_pending = (entry, self.cache.snapshot_cursor())
+        return node_name, status
+
+    def _differential_check(self, pod: Pod, snapshot, cached_node: str):
+        """Oracle assertion (equiv_cache_differential profiles only): re-run
+        the FULL path on a fresh state and compare placements. Returns the
+        full path's chosen node ('' on failure)."""
+        full_state = CycleState()
+        full_state.write(PODS_TO_ACTIVATE_KEY, PodsToActivate())
+        full_node, full_status = self._schedule_full(full_state, pod,
+                                                     snapshot, record=False)
+        if full_node != cached_node or not full_status.is_success():
+            equiv_cache_differential_mismatches.inc()
+            klog.error_s(
+                RuntimeError("equivalence-cache placement drift"),
+                "cached placement differs from full path", pod=pod.key,
+                cached=cached_node, full=full_node,
+                full_status=full_status.message())
+        return full_node
+
+    def _equiv_offer(self, pod: Pod, state: CycleState, feasible,
+                     swept_all: bool, prefilter_data: Dict) -> None:
+        """Offer a completed full cycle as a cache entry (pending until the
+        assume verifies the cursor chain). ``prefilter_data`` is the data
+        map exported BEFORE Score ran — the only state an entry may hold."""
+        if self._equiv_cache is None or not swept_all:
+            return
+        nominator = self.handle.pod_nominator
+        if not nominator.empty():
+            return
+        key = equivalence_key(pod)
+        fps = self._equiv_fingerprints(pod, state)
+        if fps is None:
+            equiv_cache_vetoes.inc()
+            return
+        entry = EquivEntry(
+            key, fps, nominator.generation,
+            prefilter_data,
+            frozenset(state.skip_filter_plugins),
+            (frozenset(state.restricted_node_names)
+             if state.restricted_node_names is not None else None),
+            tuple(sorted(n.name for n in feasible)))
+        self._equiv_pending = (entry, self.cache.snapshot_cursor())
+
+    def _equiv_after_assume(self) -> None:
+        """Arm the pending entry iff the cursor advanced by EXACTLY the
+        cycle's own assume; any concurrent foreign mutation breaks the
+        chain and the entry is discarded."""
+        pending, self._equiv_pending = self._equiv_pending, None
+        if pending is None or self._equiv_cache is None:
+            return
+        entry, cycle_cursor = pending
+        if self.cache.mutation_cursor() == cycle_cursor + 1:
+            self._equiv_cache.arm(entry, cycle_cursor + 1)
+        else:
+            self._equiv_cache.drop(entry.key)
+
+    @staticmethod
+    def _run_batch_filters(plugins, state: CycleState, pod: Pod, infos):
+        """First-failure-wins batch pre-pass, shared by _find_feasible and
+        the equivalence-cache hit path so their batch semantics cannot
+        drift. Returns (per-node failure list aligned with ``infos``,
+        frozenset of plugin names that ran)."""
+        batch_fail: List[Optional[Status]] = [None] * len(infos)
+        names = []
+        for p in plugins:
+            if p.name() in state.skip_filter_plugins:
+                continue
+            names.append(p.name())
+            res = p.filter_batch(state, pod, infos)
+            for i, st in enumerate(res):
+                if st is not None and batch_fail[i] is None:
+                    batch_fail[i] = st.with_plugin(p.name())
+        return batch_fail, frozenset(names)
 
     def _find_feasible(self, state: CycleState, pod: Pod, infos,
                        want: int):
@@ -438,16 +690,8 @@ class Scheduler:
         batch_fail: List[Optional[Status]] = [None] * n
         exclude: frozenset = frozenset()
         if nominator_empty and fw.batch_filter_plugins:
-            names = []
-            for p in fw.batch_filter_plugins:
-                if p.name() in state.skip_filter_plugins:
-                    continue
-                names.append(p.name())
-                res = p.filter_batch(state, pod, infos)
-                for i, st in enumerate(res):
-                    if st is not None and batch_fail[i] is None:
-                        batch_fail[i] = st.with_plugin(p.name())
-            exclude = frozenset(names)
+            batch_fail, exclude = self._run_batch_filters(
+                fw.batch_filter_plugins, state, pod, infos)
 
         feasible: List[Node] = []
         diagnosis: Dict[str, Status] = {}

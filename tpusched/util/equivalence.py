@@ -1,0 +1,61 @@
+"""Pod equivalence keys for the scheduling equivalence-class cache.
+
+Two pods are *equivalent* when every Filter-relevant input the scheduler
+reads off the pod itself is identical: same namespace, labels (which carry
+gang membership), scheduling constraints (selector/name/tolerations/
+priority) and per-container resource shape. Gang members stamped from one
+template are the motivating class — a 256-pod slice gang is 256 equivalent
+pods — but any identical singletons form one too.
+
+The key deliberately covers MORE than the in-tree plugins read today
+(e.g. init containers, overhead): an over-precise key only costs cache
+misses, an under-precise one would alias pods with different feasibility.
+Plugin state that lives OUTSIDE the pod (PodGroup specs, topology CRs,
+denial windows, claims) is covered separately by per-plugin fingerprints
+(fwk.interfaces.EquivalenceAware), and cluster state by the scheduler
+cache's mutation cursor — the key only has to pin the pod's own half.
+"""
+from __future__ import annotations
+
+from typing import Hashable
+
+
+def _container_fp(containers) -> tuple:
+    return tuple((tuple(sorted(c.requests.items())),
+                  tuple(sorted(c.limits.items())))
+                 for c in containers)
+
+
+def equivalence_key(pod) -> Hashable:
+    """Hashable equivalence-class key for ``pod``. Total: every pod has a
+    key (per-plugin fingerprints, not this key, carry the veto power).
+
+    Memoized per pod object (same discipline as podutil's request memo:
+    pod specs are replaced wholesale on update, never mutated in place).
+    Annotations are excluded on purpose: no Filter/PreFilter plugin reads
+    them, and Reserve writes device annotations onto the assumed DEEPCOPY,
+    not the queued object.
+    """
+    cached = getattr(pod, "_equiv_key_memo", None)
+    if cached is not None:
+        return cached
+    spec = pod.spec
+    key = (
+        pod.meta.namespace,
+        tuple(sorted(pod.meta.labels.items())),
+        spec.scheduler_name,
+        spec.priority,
+        spec.priority_class_name,
+        spec.node_name,
+        tuple(sorted(spec.node_selector.items())),
+        tuple((t.key, t.operator, t.value, t.effect)
+              for t in spec.tolerations),
+        _container_fp(spec.containers),
+        _container_fp(spec.init_containers),
+        tuple(sorted(spec.overhead.items())),
+    )
+    try:
+        object.__setattr__(pod, "_equiv_key_memo", key)
+    except AttributeError:
+        pass
+    return key

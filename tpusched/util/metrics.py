@@ -44,18 +44,31 @@ class GaugeFunc:
     """Scrape-time gauge: value() calls a provider. Re-registering replaces
     the provider, so a restarted component (new scheduler in-process, as the
     test harness does constantly) takes over its metric instead of leaving a
-    stale closure over dead state."""
+    stale closure over dead state.
+
+    A provider returning ``None`` declares itself DEAD (its weakref target
+    is gone — e.g. a stopped scheduler's queue): the registry prunes the
+    entry at the next expose() instead of emitting a stale zero-valued
+    series forever. HA failover and the what-if planner construct schedulers
+    under fresh label sets constantly; without pruning every one of them
+    leaks a gauge_func entry for the life of the process."""
 
     def __init__(self, name: str, fn, help_: str = "", labels: str = ""):
         self.name, self.help, self.labels = name, help_, labels
         self._fn = fn
+        self.dead = False
 
     def set_fn(self, fn) -> None:
         self._fn = fn
+        self.dead = False
 
     def value(self) -> float:
         try:
-            return float(self._fn())
+            v = self._fn()
+            if v is None:
+                self.dead = True
+                return 0.0
+            return float(v)
         except Exception:
             return 0.0
 
@@ -165,8 +178,11 @@ class Registry:
             return self._metrics[name]
 
     def expose(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. GaugeFunc entries whose
+        provider reports a dead target are pruned here rather than emitted
+        as stale zeros (see GaugeFunc)."""
         lines: List[str] = []
+        dead: List[str] = []
         with self._lock:
             metrics = dict(self._metrics)
         for name, m in sorted(metrics.items()):
@@ -178,7 +194,18 @@ class Registry:
             elif isinstance(m, Histogram):
                 self._expose_histogram(lines, name, m, "")
             else:
-                lines.append(f"{name} {m.value()}")
+                v = m.value()
+                if isinstance(m, GaugeFunc) and m.dead:
+                    dead.append(name)
+                    continue
+                lines.append(f"{name} {v}")
+        if dead:
+            with self._lock:
+                for name in dead:
+                    m = self._metrics.get(name)
+                    # re-registration may have revived the slot since
+                    if isinstance(m, GaugeFunc) and m.dead:
+                        del self._metrics[name]
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -212,6 +239,42 @@ pod_group_to_bound_seconds = REGISTRY.histogram(
 schedule_attempts = REGISTRY.counter(
     "tpusched_schedule_attempts_total", "Scheduling cycles run.")
 bind_total = REGISTRY.counter("tpusched_bind_total", "Successful binds.")
+
+# Equivalence-class scheduling cache (sched/equivcache.py). A lookup lands in
+# exactly one of: hit, miss (no entry for the class), invalidation (an entry
+# existed but its validity triple failed — mutation cursor, nominator
+# generation, or a plugin fingerprint moved — or a cached node vanished),
+# fallback (a valid entry was found but the hit path aborted to the full
+# path: cached feasible set drained under the dynamic re-filter, a filter
+# errored, host selection failed, or the differential oracle disagreed), or
+# bypass (nominated pods in play: the cache is not consulted at all) — so
+# hits + misses + invalidations + fallbacks + bypasses == cycles that
+# reached the lookup. Creation-side: veto counts cycles where an
+# EquivalenceAware plugin refused to certify its PreFilter output as
+# reusable. differential_mismatches MUST stay 0: it counts cache-hit
+# placements that differed from the full path under differential mode.
+equiv_cache_hits = REGISTRY.counter(
+    "tpusched_equiv_cache_hits_total",
+    "Scheduling cycles served from the equivalence cache.")
+equiv_cache_misses = REGISTRY.counter(
+    "tpusched_equiv_cache_misses_total",
+    "Cycles with no cache entry for the pod's equivalence class.")
+equiv_cache_invalidations = REGISTRY.counter(
+    "tpusched_equiv_cache_invalidations_total",
+    "Cache entries dropped because cursor/nominator/fingerprint moved.")
+equiv_cache_bypasses = REGISTRY.counter(
+    "tpusched_equiv_cache_bypasses_total",
+    "Cycles that skipped the cache because nominated pods exist.")
+equiv_cache_vetoes = REGISTRY.counter(
+    "tpusched_equiv_cache_vetoes_total",
+    "Entry creations vetoed by an EquivalenceAware plugin.")
+equiv_cache_fallbacks = REGISTRY.counter(
+    "tpusched_equiv_cache_fallbacks_total",
+    "Valid-entry cycles that aborted to the full path (set drained, "
+    "filter error, selection failure, or differential disagreement).")
+equiv_cache_differential_mismatches = REGISTRY.counter(
+    "tpusched_equiv_cache_differential_mismatches_total",
+    "Differential-mode hits whose placement differed from the full path.")
 def timed_call(hist: Histogram, fn, *args):
     """Run fn(*args), observing its wall time into ``hist`` (including on
     exception). The shared body of the extension-point and per-plugin
