@@ -32,11 +32,19 @@ bench:
 bench-smoke:
 	$(PY) bench.py --smoke
 
+# Flight-recorder smoke (the tracing-subsystem gate, part of the tier1
+# flow): headline gang with tracing on vs off, interleaved; fails if
+# overhead > 3% on the min statistic or any cycle produced a malformed
+# span tree / invalid Perfetto export.
+.PHONY: trace-smoke
+trace-smoke:
+	$(PY) bench.py --trace-smoke
+
 # The ROADMAP tier-1 suite (the merge gate): full tests/ minus slow marks,
 # CPU-only JAX, collection errors tolerated but counted. Mirrors the
-# "Tier-1 verify" command in ROADMAP.md.
+# "Tier-1 verify" command in ROADMAP.md, plus the trace-smoke gate.
 .PHONY: tier1
-tier1:
+tier1: trace-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -1,0 +1,393 @@
+"""Flight recorder unit tier: span trees, ring/byte budgets, anomaly
+pinning, /debug endpoints (empty + under concurrent writes), Perfetto
+export validation, and trace-id correlation (klog + Events +
+/debug/threads)."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpusched import trace
+from tpusched.trace.span import CycleTrace, build_span_tree
+from tpusched.util import tracectx
+from tpusched.util.httpserve import MetricsServer
+
+
+class _Meta:
+    def __init__(self, i, gang=None):
+        from tpusched.api.scheduling import POD_GROUP_LABEL
+        self.labels = {POD_GROUP_LABEL: gang} if gang else {}
+        self.namespace = "default"
+        self.uid = f"uid-{i}"
+
+
+class _Pod:
+    def __init__(self, i, gang=None):
+        self.meta = _Meta(i, gang)
+        self.key = f"default/p-{i}"
+
+
+class _Info:
+    attempts = 1
+    timestamp = 0.0
+    initial_attempt_timestamp = 0.0
+
+
+def _mk_trace(rec, i, gang=None, n_events=6, outcome="bound",
+              anomaly=None):
+    tr = rec.begin_cycle(_Pod(i, gang), _Info(), time.time())
+    for j in range(n_events):
+        t0 = time.perf_counter()
+        tr.add_event(f"Point{j}", t0, 0.0001)
+    if anomaly:
+        tr.add_anomaly(anomaly, detail="x")
+    tr.finish(outcome, node="n1" if outcome == "bound" else "")
+    return tr
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- span tree ----------------------------------------------------------------
+
+def test_span_tree_reconstruction_nesting():
+    """End-ordered complete events rebuild the parent/child structure:
+    children started at-or-after the parent and ended before it."""
+    events = [
+        ("child-a", 0.001, 0.002, None),      # inside parent
+        ("child-b", 0.004, 0.001, None),      # inside parent
+        ("parent", 0.001, 0.005, None),
+        ("root2", 0.010, 0.002, {"k": "v"}),
+    ]
+    roots = build_span_tree(events)
+    assert [r.name for r in roots] == ["parent", "root2"]
+    assert [c.name for c in roots[0].children] == ["child-a", "child-b"]
+    assert roots[1].attrs == {"k": "v"}
+    assert roots[1].children is None
+
+
+def test_cycle_trace_to_dict_and_extension_points():
+    rec = trace.FlightRecorder()
+    tr = rec.begin_cycle(_Pod(1, gang="g"), _Info(), time.time())
+    t0 = time.perf_counter()
+    tr.add_event("TpuSlice", t0, 0.001)       # child of Filter
+    tr.add_event("Filter", t0, 0.003)
+    tr.add_event("Score", time.perf_counter(), 0.002)
+    tr.finish("bound", node="n1")
+    d = tr.to_dict()
+    assert d["outcome"] == "bound" and d["node"] == "n1"
+    assert d["gang"] == "default/g"
+    assert [s["name"] for s in d["spans"]] == ["Filter", "Score"]
+    assert d["spans"][0]["children"][0]["name"] == "TpuSlice"
+    pts = tr.extension_point_s()
+    assert pytest.approx(pts["Filter"], abs=1e-9) == 0.003
+    assert pytest.approx(pts["Score"], abs=1e-9) == 0.002
+    assert "TpuSlice" not in pts              # child, not a root
+
+
+def test_trace_truncation_bound():
+    rec = trace.FlightRecorder()
+    tr = rec.begin_cycle(_Pod(1), _Info(), time.time())
+    for i in range(trace.MAX_SPANS_PER_TRACE + 50):
+        tr.add_event("e", time.perf_counter(), 0.0)
+    assert len(tr._events) == trace.MAX_SPANS_PER_TRACE
+    assert tr.truncated == 50
+    assert tr.to_dict()["truncated_spans"] == 50
+
+
+# -- ring / byte budgets ------------------------------------------------------
+
+def test_ring_bounds_hold_under_10k_cycle_soak():
+    """The flight-recorder acceptance soak: 10k committed cycles, the ring
+    never exceeds its entry or byte budget (checked continuously), eviction
+    is counted, and the gang book stays within its LRU cap."""
+    rec = trace.FlightRecorder(max_entries=128, max_bytes=256 * 1024,
+                               max_pinned=16)
+    for i in range(10_000):
+        tr = _mk_trace(rec, i, gang=f"gang-{i % 100}",
+                       outcome="bound" if i % 3 else "unschedulable")
+        rec.commit(tr, final=True)
+        if i % 997 == 0 or i > 9_900:
+            s = rec.stats()
+            assert s["entries"] <= 128, s
+            assert s["approx_bytes"] <= 256 * 1024, s
+    s = rec.stats()
+    assert s["committed_total"] == 10_000
+    assert s["evicted_total"] >= 10_000 - 128
+    assert s["gangs"] <= 64                   # GangBook LRU cap
+    # the cycles view serves only retained traces
+    assert len(rec.cycles()) == s["entries"]
+
+
+def test_byte_budget_evicts_before_entry_budget():
+    """A few fat traces must trip the byte budget even when far below the
+    entry budget."""
+    rec = trace.FlightRecorder(max_entries=10_000, max_bytes=64 * 1024)
+    for i in range(200):
+        tr = _mk_trace(rec, i, n_events=200)  # ~72B/event estimate
+        rec.commit(tr, final=True)
+    s = rec.stats()
+    assert s["approx_bytes"] <= 64 * 1024
+    assert s["entries"] < 200
+
+
+def test_finalize_recharges_bytes_for_late_spans():
+    rec = trace.FlightRecorder()
+    tr = _mk_trace(rec, 1, n_events=2, outcome="waiting-permit")
+    rec.commit(tr)
+    before = rec.stats()["approx_bytes"]
+    for _ in range(40):                       # binding-side growth
+        tr.add_event("Bind", time.perf_counter(), 0.001)
+    tr.finish("bound", node="n1")
+    rec.finalize(tr)
+    assert rec.stats()["approx_bytes"] > before
+
+
+def test_anomaly_pinning_bounded_and_fifo():
+    rec = trace.FlightRecorder(max_entries=8, max_pinned=4)
+    pinned_ids = []
+    for i in range(10):
+        tr = _mk_trace(rec, i, outcome="unschedulable",
+                       anomaly="gang_denied")
+        rec.commit(tr, final=True)            # fused path pins anomalies
+        pinned_ids.append(tr.trace_id)
+    pins = rec.pinned_dump()
+    assert len(pins) == 4                     # bounded
+    assert [p["trace_id"] for p in pins] == pinned_ids[-4:]  # FIFO evict
+    assert all(p["anomalies"][0]["kind"] == "gang_denied" for p in pins)
+    # pinning the same trace twice must not duplicate it
+    tr = _mk_trace(rec, 99, anomaly="bind_failed")
+    rec.pin(tr)
+    rec.pin(tr)
+    assert sum(1 for p in rec.pinned_dump()
+               if p["trace_id"] == tr.trace_id) == 1
+
+
+# -- /debug endpoints ---------------------------------------------------------
+
+def test_debug_endpoints_valid_json_on_empty_recorder():
+    rec = trace.FlightRecorder()
+    server = MetricsServer(port=0, recorder=rec).start()
+    try:
+        for path in ("/debug/trace", "/debug/gangs", "/debug/flightrecorder",
+                     "/debug/trace?format=perfetto"):
+            status, body = _get(server.port, path)
+            assert status == 200, path
+            doc = json.loads(body)            # valid JSON even when empty
+            assert isinstance(doc, dict)
+        status, body = _get(server.port, "/debug/flightrecorder")
+        doc = json.loads(body)
+        assert doc["stats"]["entries"] == 0
+        assert doc["cycles"] == [] and doc["pinned"] == []
+        assert doc["gangs"] == []
+    finally:
+        server.stop()
+
+
+def test_debug_threads_route_dumps_all_threads():
+    """Satellite: util.httpserve._thread_dump is reachable at
+    /debug/threads so a hung Permit barrier is diagnosable in place."""
+    hang = threading.Event()
+    t = threading.Thread(target=hang.wait, name="fake-permit-barrier",
+                         daemon=True)
+    t.start()
+    server = MetricsServer(port=0).start()
+    try:
+        status, body = _get(server.port, "/debug/threads")
+        assert status == 200
+        assert "MainThread" in body
+        assert "fake-permit-barrier" in body  # the wedged thread is visible
+        assert "daemon=" in body
+    finally:
+        hang.set()
+        server.stop()
+
+
+def test_debug_endpoints_under_concurrent_writes():
+    """Readers must see valid JSON while cycles are being committed,
+    finalized and pinned from multiple writer threads."""
+    rec = trace.FlightRecorder(max_entries=64, max_bytes=128 * 1024)
+    server = MetricsServer(port=0, recorder=rec).start()
+    stop = threading.Event()
+    errors = []
+
+    def writer(widx):
+        i = 0
+        while not stop.is_set():
+            tr = _mk_trace(rec, f"{widx}-{i}", gang=f"g{widx}",
+                           outcome="bound" if i % 2 else "unschedulable",
+                           anomaly="bind_failed" if i % 7 == 0 else None)
+            rec.commit(tr, final=True)
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        reads = 0
+        while time.monotonic() < deadline:
+            for path in ("/debug/trace?n=10", "/debug/gangs",
+                         "/debug/flightrecorder",
+                         "/debug/trace?format=perfetto"):
+                status, body = _get(server.port, path)
+                if status != 200:
+                    errors.append((path, status))
+                    continue
+                try:
+                    json.loads(body)
+                except ValueError as e:
+                    errors.append((path, str(e)))
+                reads += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        server.stop()
+    assert not errors
+    assert reads > 8
+    s = rec.stats()
+    assert s["entries"] <= 64 and s["approx_bytes"] <= 128 * 1024
+
+
+def test_debug_trace_filters():
+    rec = trace.FlightRecorder()
+    for i in range(20):
+        rec.commit(_mk_trace(rec, i), final=True)
+    server = MetricsServer(port=0, recorder=rec).start()
+    try:
+        _, body = _get(server.port, "/debug/trace?n=5")
+        assert len(json.loads(body)["cycles"]) == 5
+        _, body = _get(server.port, "/debug/trace?pod=p-7")
+        cycles = json.loads(body)["cycles"]
+        assert len(cycles) == 1 and cycles[0]["pod"] == "default/p-7"
+        _, body = _get(server.port, "/debug/trace?n=0")
+        assert json.loads(body)["cycles"] == []
+        # the perfetto form honors the same filters
+        _, body = _get(server.port, "/debug/trace?pod=p-7&format=perfetto")
+        doc = json.loads(body)
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M"}
+        assert lanes == {"default/p-7"}
+    finally:
+        server.stop()
+
+
+# -- Perfetto export ----------------------------------------------------------
+
+def test_perfetto_export_validates_and_carries_lanes():
+    rec = trace.FlightRecorder()
+    for i in range(3):
+        rec.commit(_mk_trace(rec, i, gang="g"), final=True)
+    doc = trace.export.to_perfetto(rec.traces(), rec.pinned_traces())
+    assert trace.export.validate_trace_events(doc) == []
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert names == {f"default/p-{i}" for i in range(3)}
+    cycles = [e for e in doc["traceEvents"]
+              if e["ph"] == "X" and e["name"].startswith("cycle:")]
+    assert len(cycles) == 3
+    assert json.loads(json.dumps(doc))        # serializable
+
+
+def test_perfetto_validator_rejects_malformed():
+    bad = {"traceEvents": [
+        {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": -5},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1},
+        {"name": "y", "ph": "??", "pid": 1, "tid": 1},
+    ]}
+    problems = trace.export.validate_trace_events(bad)
+    assert len(problems) == 3
+    assert trace.export.validate_trace_events([]) \
+        == ["document is not a JSON object"]
+
+
+def test_span_tree_validator_flags_disorder():
+    rec = trace.FlightRecorder()
+    tr = _mk_trace(rec, 1)
+    assert trace.export.validate_span_tree(tr) == []
+    # hand-corrupt the event log: an event ending before its predecessor
+    tr._events.append(("late", -1.0, 0.0, None))
+    assert any("not end-ordered" in p
+               for p in trace.export.validate_span_tree(tr))
+    # a trace with no outcome is malformed
+    tr2 = rec.begin_cycle(_Pod(2), _Info(), time.time())
+    assert any("no outcome" in p
+               for p in trace.export.validate_span_tree(tr2))
+
+
+# -- correlation (klog + Events) ----------------------------------------------
+
+def test_klog_lines_carry_active_trace_id(caplog):
+    import logging
+
+    from tpusched.util import klog
+    rec = trace.FlightRecorder()
+    tr = rec.begin_cycle(_Pod(1), _Info(), time.time())
+    with caplog.at_level(logging.INFO, logger="tpusched"):
+        token = trace.activate(tr)
+        try:
+            klog.info_s("inside cycle", pod="default/p-1")
+        finally:
+            trace.deactivate(token)
+        klog.info_s("outside cycle")
+    lines = [r.getMessage() for r in caplog.records]
+    inside = [l for l in lines if '"inside cycle"' in l]
+    assert inside and f'trace="{tr.trace_id}"' in inside[0]
+    outside = [l for l in lines if '"outside cycle"' in l]
+    assert outside and "trace=" not in outside[0]
+
+
+def test_record_event_carries_active_trace_id():
+    from tpusched.apiserver import APIServer, Clientset
+    api = APIServer()
+    cs = Clientset(api)
+    rec = trace.FlightRecorder()
+    tr = rec.begin_cycle(_Pod(1), _Info(), time.time())
+    token = trace.activate(tr)
+    try:
+        cs.record_event("default/p-1", "Pod", "Warning",
+                        "FailedScheduling", "0/3 nodes are available")
+    finally:
+        trace.deactivate(token)
+    cs.record_event("default/p-1", "Pod", "Normal", "Scheduled", "plain")
+    evs = api.events()
+    assert f"[trace={tr.trace_id}]" in evs[0].message
+    assert "0/3 nodes are available" in evs[0].message
+    assert "trace=" not in evs[1].message
+
+
+def test_activate_nests_and_restores():
+    rec = trace.FlightRecorder()
+    t1 = rec.begin_cycle(_Pod(1), _Info(), time.time())
+    t2 = rec.begin_cycle(_Pod(2), _Info(), time.time())
+    assert trace.current() is None
+    tok1 = trace.activate(t1)
+    assert trace.current() is t1 and tracectx.get() == t1.trace_id
+    tok2 = trace.activate(t2)
+    assert trace.current() is t2 and tracectx.get() == t2.trace_id
+    trace.deactivate(tok2)
+    assert trace.current() is t1 and tracectx.get() == t1.trace_id
+    trace.deactivate(tok1)
+    assert trace.current() is None and tracectx.get() == ""
+
+
+def test_helpers_are_noops_without_active_trace():
+    # must not raise, must not create state
+    trace.annotate("k", "v")
+    trace.record_rejection("P", "why", detail=1)
+    trace.record_anomaly("kind")
+    with trace.span("nothing"):
+        pass

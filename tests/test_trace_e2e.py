@@ -1,0 +1,264 @@
+"""Flight recorder end-to-end tier.
+
+The acceptance scenario: a deliberately wedged gang (Permit barrier never
+satisfied) must be fully explainable from the /debug/flightrecorder output
+alone — the dump names the blocking plugin, the unschedulable reason per
+member, and queue-wait vs extension-point time. Plus: gang critical-path
+stitching against the measured PodGroup-to-Bound wall time, structured
+plugin rejections, and anomaly pinning through the real scheduler."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpusched import trace
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.config.types import CoschedulingArgs
+from tpusched.fwk import PluginProfile
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_node, make_tpu_pool, wait_until)
+from tpusched.util.httpserve import MetricsServer
+
+
+@pytest.fixture()
+def fresh_recorder():
+    """Isolate each test's traces in a private global recorder (schedulers
+    capture the global at construction)."""
+    old = trace.default_recorder()
+    rec = trace.install_recorder(trace.FlightRecorder())
+    yield rec
+    trace.install_recorder(old)
+
+
+def _gang_profile(permit_wait_s=120):
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeResourcesFit", "TpuSlice"],
+        post_filter=["Coscheduling"],
+        score=[("TpuSlice", 1)],
+        reserve=["TpuSlice", "Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["TpuSlice"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=permit_wait_s,
+            denied_pg_expiration_time_seconds=20)},
+    )
+
+
+def test_wedged_gang_explainable_from_flightrecorder_alone(fresh_recorder):
+    """10-member gang, capacity for 9: nine members park at the Permit
+    barrier (quorum 10 never forms — Coscheduling's ≤10% grace keeps the
+    gang from being mass-rejected), the tenth retries unschedulable. The
+    /debug/flightrecorder JSON alone must explain the wedge."""
+    rec = fresh_recorder
+    with TestCluster(profile=_gang_profile()) as c:
+        c.add_nodes([make_tpu_node("n1", chips=4),
+                     make_tpu_node("n2", chips=4),
+                     make_tpu_node("n3", chips=1)])   # 9 chips total
+        c.api.create(srv.POD_GROUPS, make_pod_group("wedge", min_member=10))
+        pods = [make_pod(f"m-{i}", pod_group="wedge", limits={TPU: 1})
+                for i in range(10)]
+        c.create_pods(pods)
+
+        def waiting_count():
+            n = [0]
+            c.scheduler.framework.iterate_over_waiting_pods(
+                lambda wp: n.__setitem__(0, n[0] + 1))
+            return n[0]
+        assert wait_until(lambda: waiting_count() == 9, timeout=15)
+        # let the straggler's retry cycles land in the recorder
+        assert wait_until(
+            lambda: any(cy["outcome"] == "unschedulable"
+                        for cy in rec.cycles()), timeout=10)
+
+        server = MetricsServer(port=0, recorder=rec).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/flightrecorder",
+                    timeout=5) as r:
+                dump = json.loads(r.read().decode())
+        finally:
+            server.stop()
+
+    # ---- everything below reads ONLY the dump ----
+    gangs = [g for g in dump["gangs"] if g["pod_group"] == "default/wedge"]
+    assert len(gangs) == 1
+    g = gangs[0]
+    assert g["waiting_at_permit"] == 9
+    assert g["bound"] == 0
+
+    # the dump names the blocking plugin
+    barrier = g["permit_barrier"]
+    assert barrier["resolved"] is False
+    assert barrier["blocking_plugins"] == ["Coscheduling"]
+    assert len(barrier["waiting_members"]) == 9
+
+    members = g["members"]
+    assert len(members) == 10
+    waiting = {k: m for k, m in members.items()
+               if m["outcome"] == "waiting-permit"}
+    stuck = {k: m for k, m in members.items()
+             if m["outcome"] == "unschedulable"}
+    assert len(waiting) == 9 and len(stuck) == 1
+    # per-member blocking-plugin + unschedulable-reason attribution
+    assert all(m["plugin"] == "Coscheduling" for m in waiting.values())
+    (stuck_key, stuck_m), = stuck.items()
+    assert stuck_m["plugin"] in ("NodeResourcesFit", "TpuSlice")
+    assert "Insufficient" in stuck_m["reason"] \
+        or "insufficient" in stuck_m["reason"]
+    # queue-wait vs extension-point decomposition, per member
+    for m in members.values():
+        assert m["queue_wait_s"] >= 0.0
+        assert m["sched_s"] > 0.0
+
+    # the stuck member's full cycle trace is in the ring with the per-node
+    # diagnosis summary and the quorum annotation on the waiting members
+    stuck_cycles = [cy for cy in dump["cycles"]
+                    if cy["pod"] == stuck_key
+                    and cy["outcome"] == "unschedulable"]
+    assert stuck_cycles
+    cy = stuck_cycles[-1]
+    assert cy["plugin"] in ("NodeResourcesFit", "TpuSlice")
+    assert cy["diagnosis"]
+    assert sum(row["nodes"] for row in cy["diagnosis"]) == 3
+    assert any(s["name"] == "Filter" for s in cy["spans"])
+    waiting_cycles = [cy for cy in dump["cycles"]
+                      if cy["outcome"] == "waiting-permit"]
+    assert waiting_cycles
+    assert waiting_cycles[-1]["blocked_on"] == ["Coscheduling"]
+    assert waiting_cycles[-1]["annotations"]["coscheduling_quorum"] \
+        .endswith("/10")
+
+
+def test_gang_critical_path_matches_measured_wall(fresh_recorder):
+    """Gang stitching: the PodGroup-to-Bound critical path reconstructed
+    from member cycle traces matches the externally measured wall time."""
+    rec = fresh_recorder
+    with TestCluster(profile=tpu_gang_profile()) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(2, 2, 2))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("g", min_member=8,
+                                    tpu_slice_shape="2x2x2",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w-{i}", pod_group="g", limits={TPU: 1},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(8)]
+        start = time.perf_counter()
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+        wall = time.perf_counter() - start
+        assert wait_until(
+            lambda: (rec.gangs.get("default/g") is not None
+                     and rec.gangs.get("default/g").to_dict()["bound"] == 8),
+            timeout=5)
+
+    g = rec.gangs.get("default/g").to_dict()
+    cp = g["critical_path"]
+    # the measured wall brackets the critical path (creation before first
+    # enqueue, poll tick after last bind)
+    assert 0 < cp["total_s"] <= wall + 0.05
+    assert wall - cp["total_s"] <= max(0.3, 0.5 * wall)
+    assert cp["queue_wait_s"] >= 0
+    assert g["permit_barrier"]["resolved"] is True
+    assert g["permit_barrier"]["max_wait_s"] > 0
+    assert len(g["stragglers"]) == 5
+    # every member bound, with spans decomposing the cycle
+    assert all(m["outcome"] == "bound" and m["node"]
+               for m in g["members"].values())
+    pts = g["extension_point_s"]
+    for point in ("Reserve", "Permit", "Bind"):
+        assert pts.get(point, 0) > 0, (point, pts)
+    assert "PermitWait" not in pts            # idle time is not work
+
+    # the exported Perfetto document validates and reconstructs the gang
+    doc = trace.export.to_perfetto(rec.traces(), rec.pinned_traces())
+    assert trace.export.validate_trace_events(doc) == []
+    for t in rec.traces():
+        assert trace.export.validate_span_tree(t) == []
+
+
+def test_gang_denial_pins_anomaly_with_structured_reason(fresh_recorder):
+    """A gang too large for the fleet (quorum gap > 10%) is mass-rejected
+    by Coscheduling's PostFilter: the denial is pinned as an anomaly and
+    later retries carry the structured denied-window rejection."""
+    rec = fresh_recorder
+    with TestCluster(profile=_gang_profile(permit_wait_s=30)) as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])   # room for 4 of 8
+        c.api.create(srv.POD_GROUPS, make_pod_group("big", min_member=8))
+        pods = [make_pod(f"b-{i}", pod_group="big", limits={TPU: 1})
+                for i in range(8)]
+        c.create_pods(pods)
+        assert wait_until(
+            lambda: any(p["anomalies"][0]["kind"] == "gang_denied"
+                        for p in rec.pinned_dump() if p.get("anomalies")),
+            timeout=15)
+        assert wait_until(
+            lambda: any(
+                any(rj["reason"] == "gang inside denied-PodGroup window"
+                    for rj in cy.get("rejections", []))
+                for cy in rec.cycles()), timeout=15)
+
+    pinned = [p for p in rec.pinned_dump()
+              if p.get("anomalies")
+              and p["anomalies"][0]["kind"] == "gang_denied"]
+    anom = pinned[0]["anomalies"][0]
+    assert anom["pod_group"] == "default/big"
+    assert anom["min_member"] == 8
+    denied = [rj for cy in rec.cycles()
+              for rj in cy.get("rejections", [])
+              if rj["plugin"] == "Coscheduling"
+              and rj["reason"] == "gang inside denied-PodGroup window"]
+    assert denied and denied[0]["pod_group"] == "default/big"
+    assert "denied_remaining_s" in denied[0]
+
+
+def test_scheduler_events_correlate_to_ring_traces(fresh_recorder):
+    """FailedScheduling / Scheduled events carry [trace=<id>] suffixes
+    that resolve to entries in the flight recorder."""
+    rec = fresh_recorder
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        c.create_pods([make_pod("ok", limits={TPU: 4}),
+                       make_pod("nofit", limits={TPU: 8})])
+        assert c.wait_for_pods_scheduled(["default/ok"])
+        assert c.wait_for_pods_unscheduled(["default/nofit"])
+        events = c.api.events()
+    ids = {cy["trace_id"] for cy in rec.cycles()}
+    tagged = [e for e in events if "[trace=" in e.message]
+    assert tagged
+    for e in tagged:
+        tid = e.message.rsplit("[trace=", 1)[1].rstrip("]")
+        assert tid in ids, (e.reason, e.message)
+    # both outcomes are represented in the ring
+    outcomes = {cy["outcome"] for cy in rec.cycles()}
+    assert "bound" in outcomes and "unschedulable" in outcomes
+
+
+def test_equivcache_annotations_in_traces(fresh_recorder):
+    """Gang sibling cycles annotate their equivalence-cache disposition."""
+    rec = fresh_recorder
+    with TestCluster(profile=tpu_gang_profile()) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(2, 2, 2))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("g", min_member=8,
+                                    tpu_slice_shape="2x2x2",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w-{i}", pod_group="g", limits={TPU: 1})
+                for i in range(8)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+    dispositions = [cy.get("annotations", {}).get("equiv_cache")
+                    for cy in rec.cycles()]
+    assert "hit" in dispositions              # siblings hit the cache
+    assert any(d in ("miss", "invalidated") for d in dispositions)

@@ -13,6 +13,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..api.core import Binding
+from ..util import tracectx
 from . import server as srv
 
 
@@ -95,4 +96,11 @@ class Clientset:
 
     def record_event(self, object_key: str, kind: str, etype: str, reason: str,
                      message: str = "") -> None:
+        # flight-recorder correlation: an Event recorded inside a traced
+        # cycle carries the cycle's trace id, so an operator can jump from
+        # `kubectl describe`-style output to /debug/flightrecorder
+        tid = tracectx.get()
+        if tid:
+            message = f"{message} [trace={tid}]" if message \
+                else f"[trace={tid}]"
         self.api.record_event(object_key, kind, etype, reason, message)

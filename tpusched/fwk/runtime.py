@@ -13,7 +13,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.core import Node, Pod
-from ..util import klog, metrics
+from .. import trace
+from ..util import klog
 from ..util.metrics import plugin_execution_seconds
 from .cycle_state import CycleState
 from .interfaces import (BatchFilterPlugin, BindPlugin, ClusterEvent,
@@ -301,13 +302,30 @@ class Handle:
 
 def _timed_plugin(point: str, plugin_name: str, fn, *args):
     """plugin_execution_duration_seconds{plugin,extension_point} recorder
-    (upstream parity). Wired only at the once-per-cycle extension points —
-    the per-node Filter/Score sweeps stay unrecorded per plugin on purpose
-    (an observation per plugin per node per pod would cost more than the
-    plugin bodies; the whole-sweep number lives in
-    framework_extension_point_duration_seconds instead)."""
-    return metrics.timed_call(
-        plugin_execution_seconds.with_labels(plugin_name, point), fn, *args)
+    (upstream parity) + the per-plugin child span of the active cycle trace
+    (it nests under the extension-point span the scheduler opened, and
+    reuses the metric's perf_counter reads — tracing adds one tuple append,
+    no attrs dict: the parent span IS the extension point). Wired only at
+    the once-per-cycle extension points — the per-node Filter/Score sweeps
+    stay unrecorded per plugin on purpose (an observation per plugin per
+    node per pod would cost more than the plugin bodies; the whole-sweep
+    number lives in framework_extension_point_duration_seconds instead)."""
+    hist = plugin_execution_seconds.with_labels(plugin_name, point)
+    t0 = time.perf_counter()
+    try:
+        return fn(*args)
+    finally:
+        dur = time.perf_counter() - t0
+        hist.observe(dur)
+        tr = trace.current()
+        if tr is not None:
+            # inlined CycleTrace.add_event — this is the hottest trace
+            # write and the method-call overhead is measurable here
+            ev = tr._events
+            if len(ev) < trace.MAX_SPANS_PER_TRACE:
+                ev.append((plugin_name, t0 - tr.perf_start, dur, None))
+            else:
+                tr.truncated += 1
 
 
 class Framework:

@@ -181,10 +181,24 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
         state.write(PRE_FILTER_STATE_KEY, _PreFilterState(pod_req, in_eq, total))
 
         if eq.used_over_max_with(in_eq):
+            from ... import trace
+            if trace.current() is not None:   # kwargs stringify quota dicts
+                trace.record_rejection(
+                    self.NAME, "quota used would exceed Max",
+                    quota_namespace=eq.namespace,
+                    used=str(dict(eq.used)), max=str(dict(eq.max)),
+                    request=str(dict(pod_req)))
             return Status.unschedulable(
                 f"Pod {pod.key} is rejected in PreFilter because ElasticQuota "
                 f"{eq.namespace} is more than Max")
         if snapshot.infos.aggregated_used_over_min_with(total):
+            from ... import trace
+            if trace.current() is not None:
+                trace.record_rejection(
+                    self.NAME, "aggregate used would exceed sum of quota "
+                    "mins (no spare capacity to borrow)",
+                    quota_namespace=eq.namespace,
+                    request=str(dict(pod_req)))
             return Status.unschedulable(
                 f"Pod {pod.key} is rejected in PreFilter because total "
                 f"ElasticQuota used is more than min")

@@ -124,15 +124,27 @@ class PodGroupManager:
     # -- extension-point logic ------------------------------------------------
 
     def pre_filter(self, pod: Pod) -> Optional[str]:
-        """Returns an error string (⇒ UnschedulableAndUnresolvable) or None."""
+        """Returns an error string (⇒ UnschedulableAndUnresolvable) or None.
+        Each failure site also records its structured WHY (gang identity +
+        the arithmetic behind the message) on the active cycle trace."""
+        from ... import trace
         full, pg = self.get_pod_group(pod)
         if pg is None:
             return None
         if full in self.last_denied_pg:
+            trace.record_rejection(
+                "Coscheduling", "gang inside denied-PodGroup window",
+                pod_group=full,
+                denied_remaining_s=round(
+                    self.last_denied_pg.remaining(full), 3))
             return (f"pod with pgName {full} last failed within "
                     f"the denied-PodGroup expiration window, deny")
         pods = self.siblings(pod)
         if len(pods) < pg.spec.min_member:
+            trace.record_rejection(
+                "Coscheduling", "not enough sibling pods exist",
+                pod_group=full, siblings=len(pods),
+                min_member=pg.spec.min_member)
             return (f"pre-filter pod {pod.name} cannot find enough sibling pods, "
                     f"current pods number: {len(pods)}, minMember of group: "
                     f"{pg.spec.min_member}")
@@ -147,6 +159,10 @@ class PodGroupManager:
         err = check_cluster_resource(nodes, min_resources, full)
         if err:
             self.add_denied_pod_group(full)
+            trace.record_rejection(
+                "Coscheduling", "cluster-capacity dry-run failed",
+                pod_group=full, gap=err,
+                min_member=pg.spec.min_member)
             return err
         self.permitted_pg.set(full, ttl=self.schedule_timeout_s)
         return None

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from ... import trace
 from ...api.core import Pod
 from ...api.scheduling import POD_GROUP_LABEL, pod_group_full_name, pod_group_label
 from ...config.types import CoschedulingArgs
@@ -73,6 +74,10 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
     # -- PreFilter ------------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        # structured rejection detail is recorded by the manager at the
+        # exact failure site (core.pre_filter), where the quorum arithmetic
+        # is already in hand — re-deriving it here would re-walk the
+        # sibling index on every denied retry
         err = self.pg_mgr.pre_filter(pod)
         if err is not None:
             klog.V(4).info_s("PreFilter failed", pod=pod.key, reason=err)
@@ -142,6 +147,11 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
         self.handle.iterate_over_waiting_pods(reject)
         self.pg_mgr.add_denied_pod_group(full)
         self.pg_mgr.delete_permitted_pod_group(full)
+        # gang denial is a flight-recorder anomaly: the member that
+        # triggered the optimistic whole-gang rejection pins its trace
+        trace.record_anomaly("gang_denied", pod_group=full,
+                             trigger_pod=pod.key, assigned=assigned,
+                             min_member=pg.spec.min_member)
         return PostFilterResult(), Status.unschedulable(
             f"PodGroup {full} gets rejected due to Pod {pod.name} is "
             f"unschedulable even after PostFilter")
@@ -161,6 +171,15 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
                 pg, float(self.args.permit_waiting_time_seconds))
             klog.V(3).info_s("pod is waiting to be scheduled", pod=pod.key,
                              node=node_name, waitSeconds=wait_s)
+            # quorum progress into the cycle trace: assigned+1 (this pod is
+            # not in its own snapshot) of min_member, so a wedged barrier's
+            # dump shows exactly how far the gang got (guarded: the count
+            # lookup + format is only worth paying when a trace is live)
+            if trace.current() is not None:
+                assigned = self.pg_mgr.calculate_assigned_pods(
+                    pg.meta.name, pod.namespace)
+                trace.annotate("coscheduling_quorum",
+                               f"{assigned + 1}/{pg.spec.min_member}")
             # pull the siblings into activeQ so the quorum can form
             self.pg_mgr.activate_siblings(pod, state)
             return Status.wait(), wait_s

@@ -215,6 +215,12 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         set_key = self._set_key(pod.namespace, set_name)
         if self._barrier_enabled(pg):
             if set_key in self._denied_sets:
+                from ... import trace
+                trace.record_rejection(
+                    self.NAME, "multislice set inside denied window",
+                    multislice_set=set_key,
+                    denied_remaining_s=round(
+                        self._denied_sets.remaining(set_key), 3))
                 return Status.unresolvable(
                     f"multislice set {set_key} was denied within the "
                     f"denied-set expiration window").with_retry_after(
@@ -227,6 +233,11 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 # for a set that may never be fully submitted (or whose
                 # sibling was deleted mid-flight). Park reservation-free;
                 # a PodGroup add/update event requeues us.
+                from ... import trace
+                trace.record_rejection(
+                    self.NAME, "multislice set incomplete",
+                    multislice_set=set_key, members_present=len(members),
+                    set_size=pg.spec.multislice_set_size)
                 return Status.unresolvable(
                     f"multislice set {set_key} incomplete: "
                     f"{len(members)}/{pg.spec.multislice_set_size} member "
@@ -266,6 +277,9 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if err:
             self._deny_set(set_key, namespace, set_name,
                            f"set capacity dry-run failed: {err}")
+            from ... import trace
+            trace.record_anomaly("multislice_set_denied",
+                                 multislice_set=set_key, gap=err)
             return Status.unresolvable(
                 f"multislice set {set_key} cannot fit the fleet: {err}"
             ).with_retry_after(self._denied_sets.remaining(set_key) + 0.05)
@@ -353,6 +367,12 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         self._deny_set(set_key, pod.namespace, pg.spec.multislice_set,
                        f"member gang {pg.meta.name} unschedulable "
                        f"(pod {pod.name})")
+        from ... import trace
+        trace.record_anomaly("multislice_set_torn_down",
+                             multislice_set=set_key,
+                             member_gang=pg.meta.name, trigger_pod=pod.key,
+                             assigned=assigned,
+                             min_member=pg.spec.min_member)
         return PostFilterResult(), Status.unschedulable(
             f"multislice set {set_key} torn down: member gang "
             f"{pg.meta.name} is unschedulable")

@@ -272,6 +272,13 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             stash = sweep(matching)
 
         if not stash.allowed:
+            from ... import trace
+            trace.record_rejection(
+                self.NAME, "no feasible slice placement",
+                pod_group=full, shape=pg.spec.tpu_slice_shape,
+                accelerator=want_acc or "(any)",
+                matching_pools=len(matching), pool_pin=pin or "",
+                validation_errors="; ".join(validation_errors))
             if not any_pool:
                 return Status.unresolvable(
                     f"no TpuTopology pool matches accelerator "
@@ -284,6 +291,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 f"no feasible {pg.spec.tpu_slice_shape} slice placement "
                 f"in any pool")
         state.write(_STATE_KEY, stash)
+        from ... import trace
+        trace.annotate("topology_surviving_placements", stash.survivors)
         # PreFilterResult.NodeNames analog: only hosts inside a surviving
         # placement can take this pod — hand the scheduler the exact
         # candidate set so the per-node sweep never visits the rest of the

@@ -25,6 +25,7 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_ELASTIC_QUOTA, RESOURCE_NODE,
                               RESOURCE_POD, RESOURCE_POD_GROUP,
                               RESOURCE_TPU_TOPOLOGY)
+from .. import trace
 from ..util import klog
 from ..util.equivalence import equivalence_key
 from ..util.metrics import (bind_total, e2e_scheduling_seconds,
@@ -33,7 +34,7 @@ from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_fallbacks, equiv_cache_hits,
                             equiv_cache_invalidations, equiv_cache_misses,
                             equiv_cache_vetoes, extension_point_seconds,
-                            schedule_attempts)
+                            queue_wait_seconds, schedule_attempts)
 from ..util.podutil import assigned
 from .cache import Cache
 from .equivcache import EquivalenceCache, EquivEntry
@@ -113,9 +114,15 @@ class _BindingPool:
 
 class Scheduler:
     def __init__(self, api: srv.APIServer, registry: Registry,
-                 profile: PluginProfile, clock=time.time):
+                 profile: PluginProfile, clock=time.time,
+                 recorder: Optional["trace.FlightRecorder"] = None):
         self.api = api
         self.clock = clock
+        # Scheduling flight recorder (tpusched/trace): every cycle emits a
+        # span tree into the process-global ring unless a private recorder
+        # is injected (bench/test isolation).
+        self.recorder = recorder if recorder is not None \
+            else trace.default_recorder()
         self.clientset = Clientset(api)
         self.informer_factory = InformerFactory(api)
         self.cache = Cache(clock)
@@ -323,6 +330,36 @@ class Scheduler:
         schedule_attempts.inc()
         start = self.clock()
 
+        # flight recorder: one cycle trace per attempt, active on this
+        # thread (klog/Events correlate via the id) until the cycle either
+        # resolves or parks at the permit barrier; committed to the ring
+        # unconditionally so even a still-waiting cycle is inspectable
+        queue_wait_seconds.observe(max(0.0, start - info.timestamp))
+        tr = None
+        if trace.enabled():
+            tr = self.recorder.begin_cycle(
+                pod, info, start, scheduler=self.profile.scheduler_name)
+        token = trace.activate(tr)
+        try:
+            self._schedule_cycle(info, pod, tr, start)
+        except Exception as e:
+            if tr is not None:
+                tr.add_anomaly("cycle_panic", error=str(e))
+                tr.finish("error")
+            raise
+        finally:
+            if tr is not None:
+                # cycles that resolved inside the scheduling half take the
+                # fused commit+finalize (the permit-wait path finalizes
+                # from the binding thread instead)
+                self.recorder.commit(
+                    tr, final=tr.outcome not in ("scheduling",
+                                                 "waiting-permit", "bound"),
+                    now=self.clock())
+            trace.deactivate(token)
+
+    def _schedule_cycle(self, info: QueuedPodInfo, pod: Pod,
+                        tr, start: float) -> None:
         state = CycleState()
         pods_to_activate = PodsToActivate()
         state.write(PODS_TO_ACTIVATE_KEY, pods_to_activate)
@@ -333,6 +370,10 @@ class Scheduler:
         node_name, status = self._schedule_pod(state, pod, snapshot)
         if not status.is_success():
             self._run_post_filter(state, pod, status)
+            if tr is not None:
+                tr.finish("error" if status.is_error() else "unschedulable",
+                          status=status,
+                          diagnosis=state.try_read("tpusched/diagnosis"))
             self._handle_failure(info, status)
             self._activate_pods(pods_to_activate)
             return
@@ -350,6 +391,8 @@ class Scheduler:
         if not s.is_success():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
             self._forget_and_signal(assumed)
+            if tr is not None:
+                tr.finish("reserve-failed", status=s, node=node_name)
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
@@ -359,16 +402,25 @@ class Scheduler:
         if not s.is_success() and not s.is_wait():
             self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
             self._forget_and_signal(assumed)
+            if tr is not None:
+                tr.finish("permit-rejected", status=s, node=node_name)
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
+
+        if tr is not None and s.is_wait():
+            # parked at the permit barrier: record which plugins hold it so
+            # a wedged gang is explainable from the dump before any timeout
+            wp = self._fw.get_waiting_pod(assumed.meta.uid)
+            tr.mark_waiting(wp.get_pending_plugins() if wp else [])
+            tr.node = node_name
 
         # sibling activation happens at end of the scheduling cycle
         self._activate_pods(pods_to_activate)
 
         def on_permit_resolved(permit_status: Status,
                                args=(state, info, assumed, node_name, start,
-                                     pods_to_activate)) -> None:
+                                     pods_to_activate, tr)) -> None:
             try:
                 self._bind_pool.submit(self._finish_binding, permit_status,
                                        *args)
@@ -381,10 +433,26 @@ class Scheduler:
 
     def _timed_point(self, point: str, fn, *args):
         """framework_extension_point_duration_seconds recorder (upstream
-        parity; see the metric's divergence note in util/metrics.py)."""
-        from ..util.metrics import timed_call
-        return timed_call(extension_point_seconds.with_labels(point),
-                          fn, *args)
+        parity; see the metric's divergence note in util/metrics.py) — and
+        the extension-point span of the active cycle trace (per-plugin
+        child spans attach underneath via fwk.runtime._timed_plugin). The
+        span reuses the metric's perf_counter reads: tracing adds one tuple
+        append to the serial scheduleOne thread, nothing more."""
+        hist = extension_point_seconds.with_labels(point)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            dur = time.perf_counter() - t0
+            hist.observe(dur)
+            tr = trace.current()
+            if tr is not None:
+                # inlined CycleTrace.add_event (hot write path)
+                ev = tr._events
+                if len(ev) < trace.MAX_SPANS_PER_TRACE:
+                    ev.append((point, t0 - tr.perf_start, dur, None))
+                else:
+                    tr.truncated += 1
 
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
         """genericScheduler.Schedule analog: prefilter → filter → score —
@@ -403,6 +471,7 @@ class Scheduler:
                 return result
             # cached feasible set drained (or differential mismatch): the
             # entry is dropped and the full path runs as the oracle
+            trace.annotate("equiv_cache", "fallback")
         return self._schedule_full(state, pod, snapshot, record=True)
 
     def _schedule_full(self, state: CycleState, pod: Pod, snapshot,
@@ -496,17 +565,20 @@ class Scheduler:
             # nominated preemptors change per-node filter semantics (the
             # dry-run path): the full path is mandatory
             equiv_cache_bypasses.inc()
+            trace.annotate("equiv_cache", "bypass")
             return None
         key = equivalence_key(pod)
         entry = self._equiv_cache.get(key)
         if entry is None:
             equiv_cache_misses.inc()
+            trace.annotate("equiv_cache", "miss")
             return None
         if (entry.armed_mutation != self.cache.snapshot_cursor()
                 or entry.nominator_gen != nominator.generation
                 or entry.fingerprints != self._equiv_fingerprints(pod, None)):
             self._equiv_cache.drop(key)
             equiv_cache_invalidations.inc()
+            trace.annotate("equiv_cache", "invalidated")
             return None
         return entry
 
@@ -549,6 +621,20 @@ class Scheduler:
         # fused resource-fit pass over the cached set, exactly as the full
         # path's pre-pass (the hit path guarantees an empty nominator, the
         # same condition the full path gates its batch pass on)
+        tr = trace.current()
+        # any fallback truncates the event log back to here: an abandoned
+        # hit attempt must not leave its Filter/PreScore/Score spans next
+        # to the full path's own set (double-counted roots in the dump)
+        mark = len(tr._events) if tr is not None else 0
+        t0 = time.perf_counter()
+
+        def fallback():
+            self._equiv_cache.drop(entry.key)
+            equiv_cache_fallbacks.inc()
+            if tr is not None:
+                del tr._events[mark:]
+            return None
+
         batch_fail, _ = self._run_batch_filters(
             fw.dynamic_batch_filter_plugins, cstate, pod, infos)
         feasible = []
@@ -560,29 +646,25 @@ class Scheduler:
             if fs.is_success():
                 feasible.append(node_info.node)
             elif fs.is_error():
-                self._equiv_cache.drop(entry.key)
-                equiv_cache_fallbacks.inc()
-                return None
+                return fallback()
             else:
                 diagnosis[node_info.node.name] = fs
         if not feasible:
             # the gang burst consumed every cached host: the full path
             # re-derives feasibility (and owns the unschedulable messaging)
-            self._equiv_cache.drop(entry.key)
-            equiv_cache_fallbacks.inc()
-            return None
+            return fallback()
+        if tr is not None:
+            tr.add_event("Filter", t0, time.perf_counter() - t0,
+                         {"equiv_cache": "hit"})
         node_name, status = self._select_host(cstate, pod, feasible)
         if not status.is_success():
-            self._equiv_cache.drop(entry.key)
-            equiv_cache_fallbacks.inc()
-            return None
+            return fallback()
         if self._equiv_differential:
             full_node = self._differential_check(pod, snapshot, node_name)
             if full_node != node_name:
-                self._equiv_cache.drop(entry.key)
-                equiv_cache_fallbacks.inc()
-                return None
+                return fallback()
         equiv_cache_hits.inc()
+        trace.annotate("equiv_cache", "hit")
         # commit the throwaway state into the cycle: Reserve/Permit plugins
         # read the PreFilter stashes from it (e.g. TopologyMatch's
         # coordinate assignment). By-reference adopt — cstate dies here.
@@ -596,11 +678,17 @@ class Scheduler:
     def _differential_check(self, pod: Pod, snapshot, cached_node: str):
         """Oracle assertion (equiv_cache_differential profiles only): re-run
         the FULL path on a fresh state and compare placements. Returns the
-        full path's chosen node ('' on failure)."""
-        full_state = CycleState()
-        full_state.write(PODS_TO_ACTIVATE_KEY, PodsToActivate())
-        full_node, full_status = self._schedule_full(full_state, pod,
-                                                     snapshot, record=False)
+        full path's chosen node ('' on failure). Runs UNTRACED: the oracle's
+        extension-point spans would double-count into the live cycle's
+        flight-recorder entry."""
+        token = trace.activate(None)
+        try:
+            full_state = CycleState()
+            full_state.write(PODS_TO_ACTIVATE_KEY, PodsToActivate())
+            full_node, full_status = self._schedule_full(
+                full_state, pod, snapshot, record=False)
+        finally:
+            trace.deactivate(token)
         if full_node != cached_node or not full_status.is_success():
             equiv_cache_differential_mismatches.inc()
             klog.error_s(
@@ -756,34 +844,62 @@ class Scheduler:
                 return
             pod.status.nominated_node_name = node
             self.handle.pod_nominator.add_nominated_pod(pod, node)
+            trace.record_anomaly("preemption_nominated", node=node,
+                                 plugin=pf_status.plugin)
             klog.V(4).info_s("preemption nominated node", pod=pod.key, node=node)
 
     def _finish_binding(self, permit_status: Status, state: CycleState,
                         info: QueuedPodInfo, assumed: Pod, node_name: str,
                         cycle_start: float,
-                        pods_to_activate: PodsToActivate) -> None:
+                        pods_to_activate: PodsToActivate, tr=None) -> None:
         """Post-permit half of the binding cycle, dispatched by
-        notify_on_permit once the barrier resolves."""
+        notify_on_permit once the barrier resolves. Re-activates the cycle
+        trace on this pool thread so the permit-wait span, the binding
+        spans, and the outcome all land in the same flight-recorder entry
+        (and klog/Events here keep the correlation id)."""
+        token = trace.activate(tr)
+        try:
+            self._finish_binding_traced(permit_status, state, info, assumed,
+                                        node_name, cycle_start,
+                                        pods_to_activate, tr)
+        finally:
+            trace.deactivate(token)
+
+    def _finish_binding_traced(self, permit_status: Status,
+                               state: CycleState, info: QueuedPodInfo,
+                               assumed: Pod, node_name: str,
+                               cycle_start: float,
+                               pods_to_activate: PodsToActivate,
+                               tr) -> None:
         pod = assumed
         s = permit_status
-        if not s.is_success():
+        if tr is not None:
+            tr.mark_permit_resolved()
+
+        def fail(outcome: str, status: Status, anomaly: str) -> None:
+            if tr is not None:
+                tr.add_anomaly(anomaly, plugin=status.plugin,
+                               message=status.message(), node=node_name)
+                tr.finish(outcome, status=status, node=node_name)
+                self.recorder.finalize(tr, now=self.clock())
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self._forget_and_signal(pod)
-            self._handle_failure(info, s)
+            self._handle_failure(info, status)
+
+        if not s.is_success():
+            kind = ("permit_timeout" if "timeout" in s.message()
+                    else "permit_rejected")
+            fail("permit-rejected", s, kind)
             return
         s = self._timed_point("PreBind", self._fw.run_pre_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
-            self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self._forget_and_signal(pod)
-            self._handle_failure(info, s)
+            fail("bind-failed", s, "prebind_failed")
             return
         s = self._timed_point("Bind", self._fw.run_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
-            self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self._forget_and_signal(pod)
-            self._handle_failure(info, s)
+            fail("bind-failed", s, "bind_failed")
             return
         self.cache.finish_binding(pod)
         bind_total.inc()
@@ -794,6 +910,9 @@ class Scheduler:
         klog.V(4).info_s("bound", pod=pod.key, node=node_name)
         self._timed_point("PostBind", self._fw.run_post_bind_plugins,
                           state, pod, node_name)
+        if tr is not None:
+            tr.finish("bound", node=node_name)
+            self.recorder.finalize(tr, now=self.clock())
         self._activate_pods(pods_to_activate)
 
     def _forget_and_signal(self, assumed: Pod) -> None:

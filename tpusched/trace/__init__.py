@@ -1,0 +1,151 @@
+"""tpusched.trace — the scheduling flight recorder.
+
+Always-on, bounded-overhead cycle tracing:
+
+- ``span.CycleTrace`` / ``span.Span``: the per-cycle structured span tree
+  (queue-wait, extension points, per-plugin child spans, equivcache
+  annotations, outcome + unschedulable-reason attribution);
+- ``recorder.FlightRecorder``: a lock-cheap ring of the last N cycle traces
+  plus pinned anomaly traces, with per-PodGroup gang stitching
+  (``gang.GangBook``) exposing the PodGroup-to-Bound critical path;
+- ``export``: Chrome/Perfetto trace-event JSON for offline viewing;
+- this module: the thread-local *trace context* the scheduler activates for
+  the duration of a cycle. Instrumentation sites (``fwk/runtime``,
+  ``sched/scheduler``, plugins) call the module-level helpers below, which
+  are near-free no-ops when no trace is active, so plugin code never needs
+  a recorder handle threaded through it.
+
+The id of the active trace is mirrored into ``util.tracectx`` so klog lines
+and API-server Events emitted inside the cycle correlate back to the
+flight-recorder entry.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from typing import Any, Optional
+
+from ..util import tracectx
+from .gang import GangBook, GangTrace
+from .recorder import FlightRecorder
+from .span import (CycleTrace, MAX_SPANS_PER_TRACE, Span,
+                   summarize_diagnosis)
+from . import export  # noqa: F401  (re-export)
+
+__all__ = [
+    "FlightRecorder", "GangBook", "GangTrace", "CycleTrace", "Span",
+    "MAX_SPANS_PER_TRACE", "summarize_diagnosis", "export",
+    "default_recorder", "install_recorder", "enabled", "set_enabled",
+    "current", "activate", "deactivate", "span", "annotate",
+    "record_rejection", "record_anomaly",
+]
+
+_tls = threading.local()
+_enabled = os.environ.get("TPUSCHED_TRACE", "1") not in ("0", "false", "off")
+_default = FlightRecorder()
+
+
+# -- recorder registry --------------------------------------------------------
+
+def default_recorder() -> FlightRecorder:
+    return _default
+
+
+def install_recorder(rec: FlightRecorder) -> FlightRecorder:
+    """Swap the process-global recorder (bench/test isolation). Components
+    that captured the old one keep feeding it; the /debug endpoints resolve
+    the global at request time."""
+    global _default
+    _default = rec
+    return rec
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(v: bool) -> None:
+    """Kill switch (and the tracing-off arm of the trace-smoke A/B). Takes
+    effect at the next cycle; in-flight traces complete normally."""
+    global _enabled
+    _enabled = bool(v)
+
+
+# -- trace context ------------------------------------------------------------
+
+def current() -> Optional[CycleTrace]:
+    return getattr(_tls, "trace", None)
+
+
+def activate(tr: Optional[CycleTrace]):
+    """Install ``tr`` as this thread's active trace; returns a token for
+    deactivate(). Accepts None (no-op trace context)."""
+    prev = (getattr(_tls, "trace", None), tracectx.get())
+    _tls.trace = tr
+    tracectx.set(tr.trace_id if tr is not None else "")
+    return prev
+
+
+def deactivate(token) -> None:
+    prev_trace, prev_id = token
+    _tls.trace = prev_trace
+    tracectx.set(prev_id)
+
+
+class _SpanCM:
+    """Context manager recording one complete span on the active trace
+    (no-op when tracing is off / no trace is active). The instrumentation
+    hot path (extension points + cold plugin calls) does NOT use this — it
+    fuses the span into the perf_counter reads the duration metrics already
+    make (see sched.scheduler._timed_point / fwk.runtime._timed_plugin);
+    this CM serves the colder block-structured sites."""
+
+    __slots__ = ("_name", "_attrs", "_tr", "_t0")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> "_SpanCM":
+        tr = current()
+        self._tr = tr
+        if tr is not None:
+            self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        tr = self._tr
+        if tr is not None:
+            t0 = self._t0
+            tr.add_event(self._name, t0, _time.perf_counter() - t0,
+                         self._attrs)
+
+
+def span(name: str, **attrs: Any) -> _SpanCM:
+    return _SpanCM(name, attrs or None)
+
+
+def annotate(key: str, value: Any) -> None:
+    tr = current()
+    if tr is not None:
+        tr.annotate(key, value)
+
+
+def record_rejection(plugin: str, reason: str, **detail: Any) -> None:
+    """Structured rejection attribution: plugins call this next to returning
+    an unschedulable Status so the flight recorder carries machine-readable
+    WHY (quorum counts, quota arithmetic, surviving-window counts) instead
+    of only the human message."""
+    tr = current()
+    if tr is not None:
+        tr.add_rejection(plugin, reason, **detail)
+
+
+def record_anomaly(kind: str, **detail: Any) -> None:
+    """Mark the active cycle anomalous (gang denial, preemption, permit
+    timeout, bind failure); the recorder pins such traces beyond ring
+    eviction when the cycle finalizes."""
+    tr = current()
+    if tr is not None:
+        tr.add_anomaly(kind, **detail)

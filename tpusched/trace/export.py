@@ -1,0 +1,163 @@
+"""Chrome/Perfetto trace-event export of cycle traces.
+
+Emits the JSON object form of the Trace Event Format ("X" complete events +
+"M" metadata), loadable in ui.perfetto.dev or chrome://tracing for offline
+inspection of where a gang's PodGroup-to-Bound interval went.
+
+Lane model: pid 1 = the scheduler; each pod gets a tid (stable per pod key
+within one export) named by an "M" thread_name record, so a gang renders as
+a stacked set of member lanes with their extension-point spans aligned on
+one wall-clock axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .span import CycleTrace, Span, build_span_tree
+
+PID = 1
+
+
+def _emit_span(events: List[dict], sp: Span, tid: int, epoch_off_us: float,
+               cat: str) -> None:
+    if sp.dur_s is None:
+        return
+    events.append({
+        "name": sp.name,
+        "cat": cat,
+        "ph": "X",
+        "ts": round(epoch_off_us + sp.t0_off * 1e6, 3),
+        "dur": round(sp.dur_s * 1e6, 3),
+        "pid": PID,
+        "tid": tid,
+        "args": dict(sp.attrs) if sp.attrs else {},
+    })
+    for c in sp.children or ():
+        _emit_span(events, c, tid, epoch_off_us, cat)
+
+
+def to_perfetto(traces: List[CycleTrace],
+                pinned: Optional[List[CycleTrace]] = None) -> Dict[str, Any]:
+    """Serialize cycle traces to a trace-event JSON object. The export
+    epoch is the earliest first-enqueue so queue-wait renders as real dead
+    time before the first span."""
+    all_traces = list(traces) + [t for t in (pinned or [])
+                                 if t not in traces]
+    events: List[dict] = []
+    if not all_traces:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    epoch = min(min(t.first_enqueue, t.wall_start) for t in all_traces)
+    tids: Dict[str, int] = {}
+    for tr in all_traces:
+        tid = tids.get(tr.pod_key)
+        if tid is None:
+            tid = tids[tr.pod_key] = len(tids) + 1
+            events.append({"name": "thread_name", "ph": "M", "pid": PID,
+                           "tid": tid, "args": {"name": tr.pod_key}})
+        d = tr.to_dict()
+        cycle_off_us = (tr.wall_start - epoch) * 1e6
+        # one enclosing cycle span carrying the outcome + attribution
+        total = d.get("total_s")
+        if total is None:
+            # still open (e.g. parked at Permit): span up to the last event
+            total = max([t0 + (dur or 0.0)
+                         for _, t0, dur, _ in tr._events] or [0.0])
+        events.append({
+            "name": f"cycle:{d['outcome']}",
+            "cat": "cycle",
+            "ph": "X",
+            "ts": round(cycle_off_us, 3),
+            "dur": round(total * 1e6, 3),
+            "pid": PID,
+            "tid": tid,
+            "args": {k: d[k] for k in ("trace_id", "gang", "attempt",
+                                       "outcome", "node", "plugin",
+                                       "queue_wait_s") if d.get(k)},
+        })
+        if tr.queue_wait_s > 0:
+            events.append({
+                "name": "queue-wait", "cat": "queue", "ph": "X",
+                "ts": round(cycle_off_us - tr.queue_wait_s * 1e6, 3),
+                "dur": round(tr.queue_wait_s * 1e6, 3),
+                "pid": PID, "tid": tid, "args": {},
+            })
+        for sp in tr.root_spans():
+            _emit_span(events, sp, tid, cycle_off_us, "extension_point")
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_trace_events(doc: Any) -> List[str]:
+    """Validate a document against the trace-event schema subset this
+    exporter emits. Returns a list of problems (empty = valid) — the
+    trace-smoke gate and the bench --trace-out assertion both run this."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "B", "E", "i", "I"):
+            problems.append(f"{where}: bad ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing name")
+        for k in ("pid", "tid"):
+            if not isinstance(ev.get(k), int):
+                problems.append(f"{where}: missing int {k}")
+        if ph == "X":
+            for k in ("ts", "dur"):
+                if not isinstance(ev.get(k), (int, float)):
+                    problems.append(f"{where}: missing number {k}")
+            if isinstance(ev.get("dur"), (int, float)) and ev["dur"] < 0:
+                problems.append(f"{where}: negative dur")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"{where}: args not an object")
+        if len(problems) >= 20:
+            problems.append("... (truncated)")
+            break
+    return problems
+
+
+def validate_span_tree(tr: CycleTrace) -> List[str]:
+    """Structural well-formedness of one cycle trace's reconstructed span
+    tree — every span has a non-negative duration, children fit inside
+    their parent (small epsilon for clock reads straddling the close), the
+    event log is end-ordered (the reconstruction invariant), and the trace
+    carries an outcome. Used by the trace-smoke gate."""
+    problems: List[str] = []
+    eps = 5e-4
+
+    def walk(sp: Span, path: str, lo: float, hi: float) -> None:
+        p = f"{path}/{sp.name}"
+        if sp.t0_off < lo - eps:
+            problems.append(f"{p}: starts before parent")
+        if sp.dur_s is None:
+            problems.append(f"{p}: no duration recorded")
+        else:
+            if sp.dur_s < 0:
+                problems.append(f"{p}: negative duration")
+            if sp.t0_off + sp.dur_s > hi + eps:
+                problems.append(f"{p}: ends after parent")
+        for c in sp.children or ():
+            walk(c, p, sp.t0_off,
+                 sp.t0_off + sp.dur_s if sp.dur_s is not None else hi)
+
+    events = list(tr._events)
+    last_end = -eps
+    for name, t0, dur, _ in events:
+        end = t0 + (dur or 0.0)
+        if end < last_end - eps:
+            problems.append(
+                f"{tr.trace_id}/{name}: event log not end-ordered")
+        last_end = max(last_end, end)
+    for sp in build_span_tree(events):
+        walk(sp, tr.trace_id, 0.0, float("inf"))
+    if tr.outcome == "scheduling":
+        problems.append(f"{tr.trace_id}: no outcome recorded")
+    return problems

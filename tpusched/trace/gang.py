@@ -1,0 +1,243 @@
+"""Gang stitching: per-PodGroup aggregation of member cycle traces.
+
+The flight recorder feeds every committed/finalized cycle trace of a
+gang-labeled pod into a ``GangBook``; the book maintains one bounded
+``GangTrace`` per PodGroup exposing the PodGroup-to-Bound critical path
+(first-enqueue → last-bind), the permit-barrier wait, per-member outcome
+attribution and the straggler set — the "where did the 0.46 s go / which
+plugin parked us" view the /debug/gangs endpoint serves.
+
+Write-path discipline: ``on_cycle``/``on_final`` run on the serial
+scheduleOne thread (via recorder.commit) and the binding pool — they store
+a REFERENCE to the member's latest cycle trace plus two scalars, nothing
+more; all extraction (outcome, extension-point decomposition, critical
+path) happens lazily at dump time. Memory stays bounded: an LRU of gangs,
+a per-gang member cap, one trace reference per member (the trace itself is
+already retained by the recorder's ring or about to be garbage — holding
+the ref extends the last cycle's life per member, which is exactly the
+"explain the stuck gang" retention we want).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional
+
+MAX_GANGS = 64
+MAX_MEMBERS = 4096
+# global cap on member records across ALL retained gangs: each member
+# holds one trace reference (~1 KB typical), so this bounds the book to
+# ~10 MB worst case no matter how many huge gangs churn through
+MAX_TOTAL_MEMBERS = 8192
+STRAGGLER_K = 5
+
+
+class _Member:
+    __slots__ = ("tr", "bound_at", "first_enqueue")
+
+    def __init__(self) -> None:
+        self.tr = None                        # latest CycleTrace
+        self.bound_at: Optional[float] = None
+        self.first_enqueue: Optional[float] = None
+
+
+class GangTrace:
+    __slots__ = ("pod_group", "members", "first_cycle_start", "lock")
+
+    def __init__(self, pod_group: str):
+        self.pod_group = pod_group
+        self.members: Dict[str, _Member] = {}
+        self.first_cycle_start: Optional[float] = None
+        self.lock = threading.Lock()
+
+    def _member(self, key: str) -> Optional[_Member]:
+        m = self.members.get(key)
+        if m is None:
+            if len(self.members) >= MAX_MEMBERS:
+                return None
+            m = self.members[key] = _Member()
+        return m
+
+    # -- feed (hot path: reference + two scalars, no extraction) --------------
+
+    def on_cycle(self, tr, final_now: Optional[float] = None) -> None:
+        """A member's scheduling cycle completed (any outcome, including
+        waiting-permit); with ``final_now`` set, the cycle also RESOLVED in
+        the same breath (the scheduler fuses commit+finalize for cycles
+        that fail before the permit barrier). ``tr`` is a span.CycleTrace."""
+        with self.lock:
+            m = self._member(tr.pod_key)
+            if m is None:
+                return
+            m.tr = tr
+            if m.first_enqueue is None or tr.first_enqueue < m.first_enqueue:
+                m.first_enqueue = tr.first_enqueue
+            if (self.first_cycle_start is None
+                    or tr.wall_start < self.first_cycle_start):
+                self.first_cycle_start = tr.wall_start
+            if final_now is not None:
+                m.bound_at = final_now if tr.outcome == "bound" else None
+
+    def on_final(self, tr, now: float) -> None:
+        """A member's binding cycle resolved (bound / permit-rejected /
+        bind-failed / unschedulable)."""
+        with self.lock:
+            m = self._member(tr.pod_key)
+            if m is None:
+                return
+            m.tr = tr
+            m.bound_at = now if tr.outcome == "bound" else None
+
+    # -- view (all extraction happens here) -----------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self.lock:
+            snapshot = [(k, m.tr, m.bound_at, m.first_enqueue)
+                        for k, m in self.members.items() if m.tr is not None]
+            first_cycle_start = self.first_cycle_start
+
+        rows: Dict[str, Dict[str, Any]] = {}
+        points: Dict[str, float] = {}
+        permit_waits: List[float] = []
+        waiting: List[tuple] = []
+        bound: List[tuple] = []
+        unschedulable = 0
+        first_enq = None
+        for key, tr, bound_at, fe in snapshot:
+            if fe is not None and (first_enq is None or fe < first_enq):
+                first_enq = fe
+            mpoints = tr.extension_point_s()
+            # the permit-barrier wait is idle time, not scheduling work —
+            # it's surfaced via permit_barrier/critical_path instead
+            mpoints.pop("PermitWait", None)
+            for k, v in mpoints.items():
+                points[k] = points.get(k, 0.0) + v
+            if tr.permit_wait_s is not None:
+                permit_waits.append(tr.permit_wait_s)
+            outcome = tr.outcome
+            if outcome == "waiting-permit":
+                wait_start = tr.wall_start + (tr.permit_wait_off or 0.0)
+                waiting.append((key, tr, wait_start))
+            elif outcome in ("unschedulable", "error"):
+                unschedulable += 1
+            if bound_at is not None:
+                bound.append((key, tr, bound_at, fe))
+            # the member's last verdict — the per-member attribution the
+            # wedged-gang dump is read for (bounded: scalars only)
+            rows[key] = {
+                "outcome": outcome,
+                "plugin": tr.plugin or "/".join(tr.blocked_on),
+                "reason": tr.reasons[0] if tr.reasons else "",
+                "attempts": tr.attempt,
+                "queue_wait_s": round(tr.queue_wait_s, 6),
+                "sched_s": round(sum(mpoints.values()), 6),
+                "node": tr.node,
+                "trace_id": tr.trace_id,
+            }
+
+        d: Dict[str, Any] = {
+            "pod_group": self.pod_group,
+            "members_seen": len(snapshot),
+            "bound": len(bound),
+            "waiting_at_permit": len(waiting),
+            "unschedulable": unschedulable,
+            "first_enqueue": first_enq,
+            "extension_point_s": {k: round(v, 6)
+                                  for k, v in sorted(points.items())},
+            "members": dict(sorted(rows.items())),
+        }
+        if waiting:
+            d["permit_barrier"] = {
+                "first_wait_start": min(w[2] for w in waiting),
+                "resolved": False,
+                "waiting_members": sorted(w[0] for w in waiting)[:16],
+                "blocking_plugins": sorted(
+                    {p for w in waiting for p in w[1].blocked_on}),
+            }
+        elif permit_waits:
+            d["permit_barrier"] = {
+                "first_wait_start": None,
+                "resolved": True,
+                "max_wait_s": round(max(permit_waits), 6),
+            }
+        if first_enq is not None and bound:
+            last_bind = max(b[2] for b in bound)
+            first_bind = min(b[2] for b in bound)
+            cp: Dict[str, Any] = {
+                "total_s": round(last_bind - first_enq, 6),
+                "first_enqueue": first_enq,
+                "last_bind": last_bind,
+            }
+            if first_cycle_start is not None:
+                cp["queue_wait_s"] = round(
+                    max(0.0, first_cycle_start - first_enq), 6)
+            if permit_waits:
+                cp["permit_barrier_s"] = round(max(permit_waits), 6)
+            if len(bound) > 1:
+                cp["bind_burst_s"] = round(last_bind - first_bind, 6)
+            d["critical_path"] = cp
+            if len(bound) > 1:
+                worst = sorted(bound, key=lambda b: -b[2])
+                d["stragglers"] = [
+                    {"pod": k,
+                     "enqueue_to_bound_s": round(
+                         at - (fe if fe is not None else first_enq), 6),
+                     "node": tr.node}
+                    for k, tr, at, fe in worst[:STRAGGLER_K]]
+        return d
+
+
+class GangBook:
+    """LRU of per-gang stitched traces."""
+
+    def __init__(self, max_gangs: int = MAX_GANGS):
+        self._lock = threading.Lock()
+        self._gangs: "collections.OrderedDict[str, GangTrace]" = \
+            collections.OrderedDict()
+        self._max = max_gangs
+
+    def _get(self, full: str) -> GangTrace:
+        # lock-free fast path (GIL-atomic dict read): the per-cycle feed
+        # must not pay a lock + LRU shuffle for an existing gang. Recency is
+        # tracked at creation and dump time only — eviction of a gang that
+        # is actively scheduling is still effectively impossible (creation
+        # order tracks activity at MAX_GANGS=64 concurrent gangs).
+        g = self._gangs.get(full)
+        if g is not None:
+            return g
+        with self._lock:
+            g = self._gangs.get(full)
+            if g is None:
+                g = self._gangs[full] = GangTrace(full)
+                while len(self._gangs) > self._max:
+                    self._gangs.popitem(last=False)
+                # gang creation is the (rare) point where total member
+                # retention is re-bounded: evict oldest gangs until the
+                # book-wide member count fits the global cap
+                while (len(self._gangs) > 1
+                       and sum(len(x.members)
+                               for x in self._gangs.values())
+                       > MAX_TOTAL_MEMBERS):
+                    self._gangs.popitem(last=False)
+            return g
+
+    def on_cycle(self, tr, final_now: Optional[float] = None) -> None:
+        if tr.gang:
+            self._get(tr.gang).on_cycle(tr, final_now)
+
+    def on_final(self, tr, now: float) -> None:
+        if tr.gang:
+            self._get(tr.gang).on_final(tr, now)
+
+    def get(self, full: str) -> Optional[GangTrace]:
+        with self._lock:
+            return self._gangs.get(full)
+
+    def dump(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            gangs = list(self._gangs.values())
+        return [g.to_dict() for g in gangs]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._gangs)

@@ -1,0 +1,200 @@
+"""Flight recorder: a lock-cheap bounded ring of the last N cycle traces,
+plus pinned anomaly traces (permit timeout, bind failure, gang denial,
+preemption) that survive ring eviction.
+
+Budgets are enforced on BOTH axes (entry count and approximate bytes) at
+every commit/finalize — an always-on control plane must hold its memory
+ceiling through any workload. Byte accounting uses each trace's cheap
+estimate (span.CycleTrace.estimate_bytes); a trace that grows after commit
+(permit-wait + binding spans land later) has its delta re-charged at
+finalize and the ring re-trimmed.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..api.scheduling import POD_GROUP_LABEL
+from ..util.metrics import flight_recorder_anomalies
+from .gang import GangBook
+from .span import CycleTrace
+
+DEFAULT_MAX_ENTRIES = 256
+DEFAULT_MAX_BYTES = 4 << 20          # ~4 MiB of trace estimate in the ring
+DEFAULT_MAX_PINNED = 64
+DEFAULT_MAX_PINNED_BYTES = 1 << 20
+
+
+class FlightRecorder:
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 max_pinned: int = DEFAULT_MAX_PINNED,
+                 max_pinned_bytes: int = DEFAULT_MAX_PINNED_BYTES):
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self.max_pinned = max_pinned
+        self.max_pinned_bytes = max_pinned_bytes
+        self._lock = threading.Lock()
+        # ring entries: [trace, cached_byte_estimate]
+        self._ring: "collections.deque[list]" = collections.deque()
+        self._ring_bytes = 0
+        self._pinned: "collections.deque[list]" = collections.deque()
+        self._pinned_bytes = 0
+        self._seq = itertools.count(1)
+        self._committed = 0
+        self._evicted = 0
+        self.gangs = GangBook()
+
+    # -- trace lifecycle ------------------------------------------------------
+
+    def begin_cycle(self, pod, info, wall_start: float,
+                    scheduler: str = "") -> CycleTrace:
+        """Create the cycle trace for a popped pod. ``info`` is the queue's
+        QueuedPodInfo (duck-typed: timestamp / initial_attempt_timestamp /
+        attempts)."""
+        gang_name = pod.meta.labels.get(POD_GROUP_LABEL)
+        gang = f"{pod.meta.namespace}/{gang_name}" if gang_name else None
+        tr = CycleTrace(
+            trace_id=f"c{next(self._seq):08x}",
+            pod_key=pod.key,
+            pod_uid=pod.meta.uid,
+            gang=gang,
+            attempt=getattr(info, "attempts", 0),
+            scheduler=scheduler,
+            wall_start=wall_start,
+            first_enqueue=getattr(info, "initial_attempt_timestamp",
+                                  wall_start),
+            queue_wait_s=max(0.0, wall_start
+                             - getattr(info, "timestamp", wall_start)))
+        return tr
+
+    def commit(self, tr: CycleTrace, final: bool = False,
+               now: Optional[float] = None) -> None:
+        """End of the scheduling half of a cycle: the trace enters the ring
+        (it may still gain permit/binding spans — finalize re-charges).
+        ``final=True`` fuses finalize in (for cycles that resolved before
+        the permit barrier — the common failure/retry shape — one ring pass
+        and one gang feed instead of two). ``now``: the caller's clock (the
+        scheduler passes its injected clock so gang timestamps share one
+        domain with first_enqueue; wall clock otherwise)."""
+        est = tr.estimate_bytes()
+        entry = [tr, est, True]      # [trace, charged bytes, still in ring]
+        tr._ring_entry = entry
+        with self._lock:
+            self._ring.append(entry)
+            self._ring_bytes += est
+            self._committed += 1
+            self._trim_locked()
+        if final:
+            self.gangs.on_cycle(tr, final_now=(time.time() if now is None
+                                               else now))
+            if tr.anomalies:
+                self.pin(tr)
+        else:
+            self.gangs.on_cycle(tr)
+
+    def finalize(self, tr: CycleTrace, now: Optional[float] = None) -> None:
+        """The cycle's final resolution (bound / failed). Re-charges the
+        trace's byte estimate and pins it if it carries anomalies."""
+        est = tr.estimate_bytes()
+        with self._lock:
+            entry = tr._ring_entry
+            if entry is not None and entry[2]:
+                self._ring_bytes += est - entry[1]
+                entry[1] = est
+                self._trim_locked()
+        self.gangs.on_final(tr, time.time() if now is None else now)
+        if tr.anomalies:
+            self.pin(tr)
+
+    def pin(self, tr: CycleTrace) -> None:
+        """Retain an anomaly trace beyond ring eviction (bounded FIFO).
+
+        Coalesced per (gang-or-pod, anomaly kind): a 256-member gang denial
+        resolves every sibling's permit barrier with a rejection — pinning
+        each one would flush the FIFO of the distinct root-cause traces
+        (the triggering member's gang_denied, earlier bind failures) that
+        pinning exists to retain. The FIRST instance per key is kept (it is
+        closest to the root cause); repeats only bump its counter."""
+        kinds = tuple(sorted({a.get("kind", "") for a in tr.anomalies})) \
+            if tr.anomalies else ()
+        key = (tr.gang or tr.pod_key, kinds)
+        est = tr.estimate_bytes()
+        with self._lock:
+            for entry in self._pinned:
+                if entry[0] is tr:
+                    self._pinned_bytes += est - entry[1]
+                    entry[1] = est
+                    return
+            for entry in self._pinned:
+                if entry[2] == key:
+                    prev = (entry[0].annotations or {}).get(
+                        "anomaly_repeats", 1)
+                    entry[0].annotate("anomaly_repeats", prev + 1)
+                    return
+            self._pinned.append([tr, est, key])
+            self._pinned_bytes += est
+            flight_recorder_anomalies.inc()
+            while self._pinned and (len(self._pinned) > self.max_pinned
+                                    or self._pinned_bytes
+                                    > self.max_pinned_bytes):
+                entry = self._pinned.popleft()
+                self._pinned_bytes -= entry[1]
+
+    def _trim_locked(self) -> None:
+        while self._ring and (len(self._ring) > self.max_entries
+                              or self._ring_bytes > self.max_bytes):
+            entry = self._ring.popleft()
+            entry[2] = False         # a late finalize must not re-charge
+            self._ring_bytes -= entry[1]
+            self._evicted += 1
+
+    # -- views (the /debug surface) ------------------------------------------
+
+    def traces(self) -> List[CycleTrace]:
+        with self._lock:
+            return [e[0] for e in self._ring]
+
+    def pinned_traces(self) -> List[CycleTrace]:
+        with self._lock:
+            return [e[0] for e in self._pinned]
+
+    def cycles(self, n: Optional[int] = None,
+               pod: Optional[str] = None) -> List[Dict[str, Any]]:
+        out = self.traces()
+        if pod:
+            out = [t for t in out if pod in t.pod_key]
+        if n is not None:
+            out = out[-n:] if n > 0 else []
+        return [t.to_dict() for t in out]
+
+    def pinned_dump(self) -> List[Dict[str, Any]]:
+        return [t.to_dict() for t in self.pinned_traces()]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._ring),
+                "approx_bytes": self._ring_bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "pinned": len(self._pinned),
+                "pinned_approx_bytes": self._pinned_bytes,
+                "max_pinned": self.max_pinned,
+                "committed_total": self._committed,
+                "evicted_total": self._evicted,
+                "gangs": len(self.gangs),
+            }
+
+    def dump(self) -> Dict[str, Any]:
+        """The full /debug/flightrecorder payload: a wedged gang must be
+        explainable from this one document."""
+        return {
+            "stats": self.stats(),
+            "cycles": self.cycles(),
+            "pinned": self.pinned_dump(),
+            "gangs": self.gangs.dump(),
+        }

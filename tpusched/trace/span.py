@@ -1,0 +1,301 @@
+"""Cycle spans: the structured span tree one scheduling cycle emits.
+
+A ``CycleTrace`` is born when the scheduler pops a pod, collects timing
+events as the cycle crosses extension points (and each point's per-plugin
+child calls), survives the permit barrier onto whichever binding thread
+resolves it, and is finalized with an outcome + structured rejection
+attribution.
+
+Bounded-overhead discipline (this is ALWAYS ON in the hot scheduling loop):
+
+- the write path records **complete events** — ``(name, t0_off, dur)``
+  tuples appended to a flat list — not span objects. The instrumentation
+  sites already read ``perf_counter`` twice for the duration metrics, so a
+  span costs one subtraction, one tuple and one list append on top of work
+  the metrics layer was doing anyway. Nothing here is per-node (the
+  per-node Filter/Score sweeps stay untraced, exactly like the metrics
+  layer).
+- the span TREE is reconstructed lazily at read time (``/debug`` endpoints,
+  export): events are appended in end-time order and properly nested, so a
+  single O(n) stack pass rebuilds parent/child structure.
+- no per-trace lock: a trace is only ever mutated by one thread at a time
+  (the scheduleOne thread until Permit resolves, then exactly one
+  binding-pool thread), every mutation is a GIL-atomic list/dict operation,
+  and the concurrent /debug readers copy before iterating — they may
+  observe a cycle mid-flight, never a torn structure.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Event-list size guard: a runaway plugin cannot balloon a trace past the
+# flight recorder's byte budget (excess activity is dropped and counted).
+MAX_SPANS_PER_TRACE = 256
+MAX_ATTR_STR = 200
+_EVENT_EST_BYTES = 72            # flat per-event contribution to estimates
+
+
+def _clip(v: Any) -> Any:
+    if isinstance(v, str) and len(v) > MAX_ATTR_STR:
+        return v[:MAX_ATTR_STR] + "…"
+    return v
+
+
+class Span:
+    """Read-side span node (built lazily from the event list)."""
+
+    __slots__ = ("name", "t0_off", "dur_s", "attrs", "children")
+
+    def __init__(self, name: str, t0_off: float, dur_s: Optional[float],
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t0_off = t0_off          # seconds since the trace epoch
+        self.dur_s = dur_s
+        self.attrs = attrs
+        self.children: Optional[List["Span"]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name,
+                             "t0_off_s": round(self.t0_off, 6),
+                             "dur_s": (round(self.dur_s, 6)
+                                       if self.dur_s is not None else None)}
+        if self.attrs:
+            d["attrs"] = {k: _clip(v) for k, v in self.attrs.items()}
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+def build_span_tree(events: List[tuple]) -> List[Span]:
+    """Reconstruct the span forest from end-ordered complete events.
+
+    Properly nested intervals appended in END order mean: walking the list,
+    any already-seen span that STARTED at-or-after my start is my
+    descendant (it also ended before me, or it would appear later). One
+    stack pass, O(n)."""
+    stack: List[Span] = []
+    for name, t0, dur, attrs in events:
+        sp = Span(name, t0, dur, attrs)
+        children: List[Span] = []
+        while stack and stack[-1].t0_off >= t0:
+            children.append(stack.pop())
+        if children:
+            children.reverse()
+            sp.children = children
+        stack.append(sp)
+    return stack
+
+
+class CycleTrace:
+    """One scheduling cycle's event log + outcome attribution."""
+
+    __slots__ = ("trace_id", "pod_key", "pod_uid", "gang", "attempt",
+                 "scheduler", "wall_start", "perf_start", "first_enqueue",
+                 "queue_wait_s", "outcome", "node", "plugin",
+                 "reasons", "rejections", "annotations", "anomalies",
+                 "diagnosis", "blocked_on", "permit_wait_off",
+                 "permit_wait_s", "end_off", "truncated", "_events",
+                 "_extra_bytes", "_ring_entry")
+
+    def __init__(self, trace_id: str, pod_key: str, pod_uid: str,
+                 gang: Optional[str], attempt: int, scheduler: str,
+                 wall_start: float, first_enqueue: float,
+                 queue_wait_s: float):
+        self.trace_id = trace_id
+        self.pod_key = pod_key
+        self.pod_uid = pod_uid
+        self.gang = gang                      # "ns/name" or None
+        self.attempt = attempt
+        self.scheduler = scheduler
+        self.wall_start = wall_start          # epoch seconds at cycle start
+        self.perf_start = time.perf_counter()
+        self.first_enqueue = first_enqueue    # epoch seconds, first add
+        self.queue_wait_s = queue_wait_s      # since LAST enqueue
+        self.outcome = "scheduling"
+        self.node = ""
+        self.plugin = ""
+        # attribution containers are LAZY (most cycles bind cleanly and
+        # carry none of these; six empty-container allocations per cycle
+        # were measurable on the serial scheduleOne thread)
+        self.reasons: tuple = ()
+        self.rejections: Optional[List[Dict[str, Any]]] = None
+        self.annotations: Optional[Dict[str, Any]] = None
+        self.anomalies: Optional[List[Dict[str, Any]]] = None
+        self.diagnosis: tuple = ()
+        self.blocked_on: tuple = ()           # permit plugins still pending
+        self.permit_wait_off: Optional[float] = None
+        self.permit_wait_s: Optional[float] = None
+        self.end_off: Optional[float] = None
+        self.truncated = 0
+        # flat (name, t0_off, dur_s, attrs) complete events, end-ordered
+        self._events: List[tuple] = []
+        self._extra_bytes = 0
+        self._ring_entry = None      # recorder bookkeeping (O(1) finalize)
+
+    # -- event log (the hot write path) ---------------------------------------
+
+    def _off(self) -> float:
+        return time.perf_counter() - self.perf_start
+
+    def add_event(self, name: str, t0_abs: float, dur_s: float,
+                  attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Record one completed span. ``t0_abs`` is the raw perf_counter
+        value the caller already read for its duration metric."""
+        if len(self._events) >= MAX_SPANS_PER_TRACE:
+            self.truncated += 1
+            return
+        self._events.append((name, t0_abs - self.perf_start, dur_s, attrs))
+
+    # -- attribution ----------------------------------------------------------
+
+    def annotate(self, key: str, value: Any) -> None:
+        if self.annotations is None:
+            self.annotations = {}
+        self.annotations[key] = _clip(value)
+        self._extra_bytes += 32
+
+    def add_rejection(self, plugin: str, reason: str, **detail: Any) -> None:
+        if self.rejections is None:
+            self.rejections = []
+        if len(self.rejections) < 16:
+            self.rejections.append(
+                {"plugin": plugin, "reason": _clip(reason),
+                 **{k: _clip(v) for k, v in detail.items()}})
+            self._extra_bytes += 96 + len(reason)
+
+    def add_anomaly(self, kind: str, **detail: Any) -> None:
+        if self.anomalies is None:
+            self.anomalies = []
+        if len(self.anomalies) < 8:
+            self.anomalies.append(
+                {"kind": kind,
+                 **{k: _clip(v) for k, v in detail.items()}})
+            self._extra_bytes += 96
+
+    def mark_waiting(self, pending_plugins: List[str]) -> None:
+        self.blocked_on = list(pending_plugins)
+        self.permit_wait_off = self._off()
+        self.outcome = "waiting-permit"
+
+    def mark_permit_resolved(self) -> None:
+        """Record the permit-barrier wait as a first-class span (called by
+        the binding thread the resolution dispatched)."""
+        off = self.permit_wait_off
+        if off is None:
+            return
+        self.permit_wait_off = None
+        dur = self._off() - off
+        self.permit_wait_s = dur
+        if len(self._events) < MAX_SPANS_PER_TRACE:
+            self._events.append(("PermitWait", off, dur, None))
+
+    def finish(self, outcome: str, status=None, node: str = "",
+               diagnosis=None) -> None:
+        """Set the final outcome. ``status`` is duck-typed (fwk.Status):
+        only ``.plugin`` and ``.reasons`` are read. ``diagnosis`` is the
+        per-node Status map from the Filter sweep — summarized (bounded),
+        never stored per node."""
+        self.node = node
+        self.blocked_on = ()
+        self.end_off = self._off()
+        if status is not None:
+            self.plugin = getattr(status, "plugin", "") or ""
+            self.reasons = tuple(
+                _clip(r) for r in (getattr(status, "reasons", None)
+                                   or ())[:8])
+            self._extra_bytes += sum(len(r) for r in self.reasons)
+        if diagnosis:
+            self.diagnosis = summarize_diagnosis(diagnosis)
+            self._extra_bytes += 96 * len(self.diagnosis)
+        self.outcome = outcome
+
+    # -- views ----------------------------------------------------------------
+
+    def root_spans(self) -> List[Span]:
+        return build_span_tree(list(self._events))
+
+    def extension_point_s(self) -> Dict[str, float]:
+        """Root-span durations by name — the queue-wait vs extension-point
+        decomposition the gang stitcher and the endpoints expose. Computed
+        by a reversed scan over the flat events (a root is any event not
+        inside the most recent root seen so far) — no tree allocation, the
+        commit path calls this per cycle."""
+        out: Dict[str, float] = {}
+        root_t0 = float("inf")
+        for name, t0, dur, _ in reversed(self._events):
+            if t0 < root_t0:
+                root_t0 = t0
+                if dur is not None:
+                    out[name] = out.get(name, 0.0) + dur
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "pod": self.pod_key,
+            "gang": self.gang,
+            "attempt": self.attempt,
+            "scheduler": self.scheduler,
+            "wall_start": self.wall_start,
+            "first_enqueue": self.first_enqueue,
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "outcome": self.outcome,
+            "spans": [sp.to_dict() for sp in self.root_spans()],
+        }
+        if self.node:
+            d["node"] = self.node
+        if self.plugin:
+            d["plugin"] = self.plugin
+        if self.reasons:
+            d["reasons"] = list(self.reasons)
+        if self.rejections:
+            d["rejections"] = list(self.rejections)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.anomalies:
+            d["anomalies"] = list(self.anomalies)
+        if self.diagnosis:
+            d["diagnosis"] = list(self.diagnosis)
+        if self.blocked_on:
+            d["blocked_on"] = list(self.blocked_on)
+        if self.permit_wait_s is not None:
+            d["permit_wait_s"] = round(self.permit_wait_s, 6)
+        if self.end_off is not None:
+            d["total_s"] = round(self.end_off, 6)
+        if self.truncated:
+            d["truncated_spans"] = self.truncated
+        return d
+
+    def estimate_bytes(self) -> int:
+        """O(1) size estimate for the recorder's byte budget (event count ×
+        flat cost + the attribution extras tracked at write time)."""
+        return (200 + len(self.pod_key)
+                + _EVENT_EST_BYTES * len(self._events)
+                + self._extra_bytes)
+
+
+def summarize_diagnosis(diagnosis) -> List[Dict[str, Any]]:
+    """Aggregate a {node: Status} Filter diagnosis into bounded
+    (plugin, reason) → node-count rows. At fleet scale the raw map is 1024
+    entries; the dump needs the shape, not the roster. Statuses are
+    deduplicated by identity first — a PreFilter rejection shares ONE
+    Status across every node, so the common worst case collapses to a
+    single attribute read instead of an O(nodes) getattr storm."""
+    counts: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+    by_id: Dict[int, Tuple[str, Tuple[str, ...]]] = {}
+    for st in diagnosis.values():
+        k = by_id.get(id(st))
+        if k is None:
+            plugin = getattr(st, "plugin", "") or ""
+            reasons = tuple(getattr(st, "reasons", None) or ("unknown",))
+            k = by_id[id(st)] = (plugin, reasons)
+        counts[k] = counts.get(k, 0) + 1
+    flat: Dict[Tuple[str, str], int] = {}
+    for (plugin, reasons), n in counts.items():
+        for r in reasons:
+            kr = (plugin, r)
+            flat[kr] = flat.get(kr, 0) + n
+    top = sorted(flat.items(), key=lambda kv: -kv[1])[:8]
+    return [{"plugin": p, "reason": _clip(r), "nodes": n}
+            for (p, r), n in top]

@@ -1,4 +1,4 @@
-"""Observability HTTP endpoint: /metrics, /healthz, /debug/threads.
+"""Observability HTTP endpoint: /metrics, /healthz, /debug/*.
 
 The reference inherits the kube-scheduler's serving stack — Prometheus
 /metrics scraped via ServiceMonitor (/root/reference/config/prometheus/
@@ -8,7 +8,15 @@ rebuild's equivalent for its own binaries:
 - ``/metrics``   Prometheus text exposition of util.metrics.REGISTRY
 - ``/healthz``   liveness ("ok")
 - ``/readyz``    readiness (caller-supplied probe)
-- ``/debug/threads``  stack dump of every thread (the pprof-goroutine analog)
+- ``/debug/threads``  stack dump of every thread (the pprof-goroutine analog;
+  the first place to look when a Permit barrier hangs)
+- ``/debug/trace``  last N cycle traces from the flight recorder
+  (``?n=``, ``?pod=`` substring filter, ``?format=perfetto`` for a
+  Chrome/Perfetto trace-event document)
+- ``/debug/gangs``  per-PodGroup stitched gang traces (critical path,
+  permit barrier, stragglers, per-member attribution)
+- ``/debug/flightrecorder``  the full dump: stats + ring + pinned anomaly
+  traces + gangs — a wedged gang is explainable from this one document
 """
 from __future__ import annotations
 
@@ -16,6 +24,7 @@ import json
 import sys
 import threading
 import traceback
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -39,32 +48,68 @@ def _thread_dump() -> str:
 class MetricsServer:
     """Serves the registry on <host>:<port>; port=0 picks a free one.
     Default bind is loopback (safe for local runs); in-cluster deployments
-    scrape via ServiceMonitor and must bind 0.0.0.0 (--metrics-bind-address)."""
+    scrape via ServiceMonitor and must bind 0.0.0.0 (--metrics-bind-address).
+
+    ``recorder``: the flight recorder backing the /debug/trace,
+    /debug/gangs and /debug/flightrecorder routes; None = resolve the
+    process-global recorder at request time (so a bench/test that installs
+    a fresh recorder is picked up without rebuilding the server)."""
 
     def __init__(self, port: int = 0,
                  ready_probe: Optional[Callable[[], bool]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", recorder=None):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path == "/metrics":
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
                     self._send(200, REGISTRY.expose(),
                                "text/plain; version=0.0.4")
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     self._send(200, "ok\n")
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ready = server.ready_probe() if server.ready_probe else True
                     self._send(200 if ready else 503,
                                "ok\n" if ready else "not ready\n")
-                elif self.path == "/debug/threads":
+                elif path == "/debug/threads":
                     self._send(200, _thread_dump())
-                elif self.path == "/debug/vars":
+                elif path == "/debug/trace":
+                    self._send_json(self._trace_payload(query))
+                elif path == "/debug/gangs":
+                    self._send_json({"gangs": server.recorder().gangs.dump()})
+                elif path == "/debug/flightrecorder":
+                    self._send_json(server.recorder().dump())
+                elif path == "/debug/vars":
                     self._send(200, json.dumps(
                         {"threads": threading.active_count()}) + "\n",
                         "application/json")
                 else:
                     self._send(404, "not found\n")
+
+            def _trace_payload(self, query: str):
+                qs = urllib.parse.parse_qs(query)
+                rec = server.recorder()
+                try:
+                    n = int(qs["n"][0]) if "n" in qs else None
+                except ValueError:
+                    n = None
+                pod = qs.get("pod", [None])[0]
+                if qs.get("format", [""])[0] == "perfetto":
+                    from ..trace import export
+                    traces = rec.traces()
+                    pinned = rec.pinned_traces()
+                    if pod:               # same filters as the JSON form
+                        traces = [t for t in traces if pod in t.pod_key]
+                        pinned = [t for t in pinned if pod in t.pod_key]
+                    if n is not None:
+                        traces = traces[-n:] if n > 0 else []
+                    return export.to_perfetto(traces, pinned)
+                return {"stats": rec.stats(), "cycles": rec.cycles(n, pod)}
+
+            def _send_json(self, payload) -> None:
+                self._send(200, json.dumps(payload) + "\n",
+                           "application/json")
 
             def _send(self, code: int, body: str, ctype: str = "text/plain"):
                 data = body.encode()
@@ -78,8 +123,17 @@ class MetricsServer:
                 klog.V(6).info_s("http " + fmt % args)
 
         self.ready_probe = ready_probe
+        self._recorder = recorder
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    def recorder(self):
+        """The flight recorder serving /debug/* (late-bound global unless
+        one was injected)."""
+        if self._recorder is not None:
+            return self._recorder
+        from .. import trace
+        return trace.default_recorder()
 
     @property
     def port(self) -> int:

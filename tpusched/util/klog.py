@@ -14,6 +14,8 @@ import sys
 import threading
 import time
 
+from . import tracectx
+
 _logger = logging.getLogger("tpusched")
 if not _logger.handlers:
     h = logging.StreamHandler(sys.stderr)
@@ -35,6 +37,12 @@ def verbosity() -> int:
 
 
 def _fmt(msg: str, kv: dict) -> str:
+    # flight-recorder correlation: a log line emitted inside a traced
+    # scheduling/binding cycle carries that cycle's trace id, so operators
+    # can jump from any line to the matching /debug/flightrecorder entry
+    tid = tracectx.get()
+    if tid and "trace" not in kv:
+        kv = {**kv, "trace": tid}
     ts = time.strftime("%H:%M:%S", time.localtime())
     parts = [f'{k}="{v}"' if isinstance(v, str) else f"{k}={v}" for k, v in kv.items()]
     return f'{ts} "{msg}" ' + " ".join(parts) if parts else f'{ts} "{msg}"'

@@ -275,17 +275,17 @@ equiv_cache_fallbacks = REGISTRY.counter(
 equiv_cache_differential_mismatches = REGISTRY.counter(
     "tpusched_equiv_cache_differential_mismatches_total",
     "Differential-mode hits whose placement differed from the full path.")
-def timed_call(hist: Histogram, fn, *args):
-    """Run fn(*args), observing its wall time into ``hist`` (including on
-    exception). The shared body of the extension-point and per-plugin
-    duration recorders."""
-    t0 = _time.perf_counter()
-    try:
-        return fn(*args)
-    finally:
-        hist.observe(_time.perf_counter() - t0)
 
-
+# Flight recorder (tpusched/trace): queue-wait is the span the cycle trace
+# decomposes out of e2e latency (pop time - last enqueue time), and every
+# pinned anomaly trace (permit timeout, bind failure, gang denial,
+# preemption) counts here so dashboards can alert before anyone reads dumps.
+queue_wait_seconds = REGISTRY.histogram(
+    "tpusched_scheduling_queue_wait_duration_seconds",
+    "Last-enqueue to pop per scheduling cycle (the trace's queue-wait span).")
+flight_recorder_anomalies = REGISTRY.counter(
+    "tpusched_flight_recorder_anomalies_total",
+    "Cycle traces pinned by the flight recorder as anomalies.")
 # Upstream framework_extension_point_duration_seconds analog. Deliberate
 # divergence: the per-node Filter/Score sweeps are recorded once per CYCLE
 # (the whole sweep), not once per node — at 1024-host scale a per-node

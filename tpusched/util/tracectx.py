@@ -1,0 +1,29 @@
+"""Thread-local trace-id correlation context.
+
+The flight recorder (tpusched/trace) activates a cycle trace id here for the
+duration of a scheduling/binding cycle; klog lines and API-server Events
+emitted inside the cycle pick it up so an operator can jump from a
+``FailedScheduling`` event or a log line straight to the matching
+``/debug/flightrecorder`` entry.
+
+Deliberately dependency-free (stdlib only): both ``util.klog`` and
+``tpusched.trace`` import it, so it must sit below both.
+"""
+from __future__ import annotations
+
+import threading
+
+_tls = threading.local()
+
+
+def set(trace_id: str) -> str:  # noqa: A001 — klog-style tiny API
+    """Install ``trace_id`` as the current thread's correlation id and
+    return the previous one (restore it when the cycle leaves the thread)."""
+    prev = getattr(_tls, "id", "")
+    _tls.id = trace_id
+    return prev
+
+
+def get() -> str:
+    """Current thread's trace id, or '' outside any traced cycle."""
+    return getattr(_tls, "id", "")
