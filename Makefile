@@ -40,11 +40,23 @@ bench-smoke:
 trace-smoke:
 	$(PY) bench.py --trace-smoke
 
+# Chaos-smoke (the resilience gate, part of the tier1 flow): ≥5k seeded
+# scheduling cycles under injected API faults — conflicts, transients,
+# lost-response binds, a forced terminal mid-gang bind failure and a total
+# outage — asserting the C1–C5 invariants (no pod lost, no double-bind,
+# gangs all-or-nothing at quiescence, differential oracle exact, degraded
+# mode trips + recovers). See tpusched/testing/chaos.py.
+.PHONY: chaos-smoke
+chaos-smoke:
+	env JAX_PLATFORMS=cpu CHAOS_SOAK_CYCLES=5000 $(PY) -m pytest \
+		tests/test_chaos_soak.py -q -p no:cacheprovider
+
 # The ROADMAP tier-1 suite (the merge gate): full tests/ minus slow marks,
 # CPU-only JAX, collection errors tolerated but counted. Mirrors the
-# "Tier-1 verify" command in ROADMAP.md, plus the trace-smoke gate.
+# "Tier-1 verify" command in ROADMAP.md, plus the trace-smoke and
+# chaos-smoke gates.
 .PHONY: tier1
-tier1: trace-smoke
+tier1: chaos-smoke trace-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
@@ -57,7 +69,11 @@ native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
 
 .PHONY: verify
-verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize
+verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls
+
+.PHONY: verify-naked-api-calls
+verify-naked-api-calls:
+	hack/verify-naked-api-calls.sh
 
 .PHONY: verify-kustomize
 verify-kustomize:

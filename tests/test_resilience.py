@@ -1,0 +1,460 @@
+"""API-failure resilience units: the client retry layer, token-bucket
+deadlines, best-effort events, binding-pool shutdown semantics, degraded
+mode, and the gang-atomic bind rollback — each against the seeded fault
+injector (apiserver/faults.py). The multi-thousand-cycle composition of all
+of these is tests/test_chaos_soak.py.
+"""
+import threading
+import time
+
+import pytest
+
+from tpusched import trace
+from tpusched.api.core import Binding
+from tpusched.api.resources import make_resources
+from tpusched.apiserver import (APIServer, Clientset, Conflict, FaultInjector,
+                                FaultRule, NotFound, RetryPolicy, Throttled,
+                                Unavailable)
+from tpusched.apiserver import server as srv
+from tpusched.apiserver.client import _TokenBucket
+from tpusched.apiserver.errors import is_retriable
+from tpusched.config.types import CoschedulingArgs
+from tpusched.fwk import PluginProfile
+from tpusched.sched.scheduler import _BindingPool, _DegradedMode
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, wait_until)
+from tpusched.util.metrics import (api_retries, api_retry_exhausted,
+                                   events_dropped, gang_bind_rollbacks)
+
+FAST_RETRY = RetryPolicy(max_attempts=3, initial_backoff_s=0.005,
+                         max_backoff_s=0.02, deadline_s=2.0)
+
+
+# -- taxonomy -----------------------------------------------------------------
+
+def test_taxonomy_classification():
+    assert is_retriable("get", Unavailable("x"))
+    assert is_retriable("bind", Unavailable("x"))
+    assert not is_retriable("get", Throttled("x"))
+    assert not is_retriable("get", NotFound("x"))
+    # Conflict: only the server-side-RMW patch retries; a bind Conflict is
+    # terminal (the lost-response case is resolved by the heal hook BEFORE
+    # classification — see _PodClient.bind)
+    assert is_retriable("patch", Conflict("x"))
+    assert not is_retriable("bind", Conflict("x"))
+    assert not is_retriable("update", Conflict("x"))
+    assert not is_retriable("create", Conflict("x"))
+
+
+# -- retry layer --------------------------------------------------------------
+
+def test_transient_fault_is_retried_to_success():
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    cs = Clientset(inj, retry=FAST_RETRY)
+    inj.add_rule(FaultRule(verbs=("create",), error="unavailable",
+                           max_injections=2))
+    before = api_retries.value()
+    out = cs.pods.create(make_pod("r1"))
+    assert out.meta.name == "r1"
+    assert api_retries.value() - before == 2
+    assert api.get(srv.PODS, "default/r1") is not None
+
+
+def test_retry_exhaustion_is_terminal_and_counted():
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    exhausted = []
+    cs = Clientset(inj, retry=FAST_RETRY,
+                   on_retry_exhausted=lambda v, k, e: exhausted.append((v, k)))
+    inj.add_rule(FaultRule(verbs=("get",), error="unavailable"))
+    before = api_retry_exhausted.value()
+    with pytest.raises(Unavailable):
+        cs.pods.get("default/nope")
+    assert api_retry_exhausted.value() - before == 1
+    assert exhausted == [("get", srv.PODS)]
+
+
+def test_terminal_errors_do_not_burn_retries():
+    api = APIServer()
+    cs = Clientset(api, retry=FAST_RETRY)
+    before = api_retries.value()
+    with pytest.raises(NotFound):
+        cs.pods.get("default/absent")
+    with pytest.raises(Conflict):
+        api.create(srv.PODS, make_pod("dup"))
+        cs.pods.create(make_pod("dup"))
+    assert api_retries.value() == before
+
+
+def test_patch_conflict_is_retried_via_server_side_reread():
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    cs = Clientset(inj, retry=FAST_RETRY)
+    api.create(srv.PODS, make_pod("p1"))
+    inj.add_rule(FaultRule(verbs=("patch",), error="conflict",
+                           max_injections=2))
+    cs.pods.patch("default/p1",
+                  lambda p: p.meta.labels.__setitem__("touched", "yes"))
+    assert api.get(srv.PODS, "default/p1").meta.labels["touched"] == "yes"
+
+
+def test_bind_lost_response_heals_on_retry():
+    """The bind applied but the response was lost: the retry Conflicts and
+    the client heals by re-reading — bound to OUR node is success."""
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    cs = Clientset(inj, retry=FAST_RETRY)
+    api.create(srv.PODS, make_pod("b1"))
+    inj.add_rule(FaultRule(verbs=("bind",), error="unavailable", after=True,
+                           max_injections=1))
+    cs.pods.bind(Binding(pod_key="default/b1", node_name="n1", annotations={}))
+    assert api.get(srv.PODS, "default/b1").spec.node_name == "n1"
+
+
+def test_bind_genuine_conflict_stays_terminal():
+    """A real double-bind fails FAST: no retry sleeps burned, and no
+    spurious retry-exhaustion fed into the degraded-mode trip counter (a
+    semantic conflict is not an apiserver outage)."""
+    api = APIServer()
+    cs = Clientset(api, retry=FAST_RETRY)
+    api.create(srv.PODS, make_pod("b2"))
+    api.bind(Binding(pod_key="default/b2", node_name="other", annotations={}))
+    retries_before = api_retries.value()
+    exhausted_before = api_retry_exhausted.value()
+    with pytest.raises(Conflict):
+        cs.pods.bind(Binding(pod_key="default/b2", node_name="mine",
+                             annotations={}))
+    assert api.get(srv.PODS, "default/b2").spec.node_name == "other"
+    assert api_retries.value() == retries_before
+    assert api_retry_exhausted.value() == exhausted_before
+
+
+def test_retries_annotate_active_trace():
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    cs = Clientset(inj, retry=FAST_RETRY)
+    inj.add_rule(FaultRule(verbs=("create",), error="unavailable",
+                           max_injections=1))
+    tr = trace.CycleTrace("t1", "default/tp", "u1", None, 1, "s", 0.0, 0.0,
+                          0.0)
+    token = trace.activate(tr)
+    try:
+        cs.pods.create(make_pod("tp"))
+    finally:
+        trace.deactivate(token)
+    names = [e[0] for e in tr._events]
+    assert "api-retry" in names
+
+
+# -- token bucket deadlines (satellite: no unbounded sleep) -------------------
+
+def test_token_bucket_deadline_raises_throttled():
+    b = _TokenBucket(qps=0.5, burst=1)
+    b.wait()                                 # burns the burst token
+    t0 = time.monotonic()
+    with pytest.raises(Throttled):
+        b.wait(deadline=time.monotonic() + 0.05)
+    assert time.monotonic() - t0 < 0.5       # no 2s sleep toward the token
+
+
+def test_token_bucket_no_deadline_still_waits():
+    b = _TokenBucket(qps=100.0, burst=1)
+    b.wait()
+    t0 = time.monotonic()
+    b.wait()                                 # ~10ms mint time
+    assert 0.003 <= time.monotonic() - t0 < 1.0
+
+
+def test_clientset_surfaces_throttled_terminally():
+    api = APIServer()
+    cs = Clientset(api, qps=0.2, burst=1,
+                   retry=RetryPolicy(max_attempts=3, initial_backoff_s=0.005,
+                                     max_backoff_s=0.01, deadline_s=0.1))
+    api.create(srv.PODS, make_pod("q1"))     # raw create: no throttle burn
+    cs.pods.get("default/q1")                # burns the burst token
+    before = api_retries.value()
+    t0 = time.monotonic()
+    with pytest.raises(Throttled):
+        cs.pods.get("default/q1")
+    assert time.monotonic() - t0 < 1.0
+    assert api_retries.value() == before     # Throttled is never retried
+
+
+# (token-bucket hypothesis property tests live in
+# tests/test_token_bucket_properties.py — a module-level importorskip must
+# not skip THIS module's deterministic coverage when hypothesis is absent)
+
+
+# -- best-effort events (satellite) -------------------------------------------
+
+def test_record_event_never_raises_and_counts_drops():
+    api = APIServer()
+    inj = FaultInjector(api, seed=3)
+    cs = Clientset(inj, retry=FAST_RETRY)
+    inj.add_rule(FaultRule(verbs=("record_event",), error="unavailable"))
+    before = events_dropped.value()
+    cs.record_event("default/x", "Pod", "Warning", "FailedScheduling", "m")
+    assert events_dropped.value() - before == 1
+    assert api.events() == []
+    inj.clear()
+    cs.record_event("default/x", "Pod", "Normal", "Scheduled", "ok")
+    assert len(api.events()) == 1
+
+
+# -- binding pool shutdown (satellite) ----------------------------------------
+
+def test_binding_pool_shutdown_aborts_queued_tasks_with_wedged_worker():
+    """One wedged task must not extend shutdown past its timeout, queued
+    tasks must drain through their ABORT path (reservations released), and
+    no queued task's full body may run after shutdown returns."""
+    pool = _BindingPool(workers=1)
+    wedge = threading.Event()
+    started = threading.Event()
+    ran, aborted = [], []
+
+    pool.submit(lambda: (started.set(), wedge.wait(10)), lambda: None)
+    assert started.wait(2.0)
+    for i in range(3):
+        pool.submit(lambda i=i: ran.append(i), lambda i=i: aborted.append(i))
+
+    t0 = time.monotonic()
+    pool.shutdown(timeout=0.3)
+    assert time.monotonic() - t0 < 2.0       # bounded by drain timeout
+    assert sorted(aborted) == [0, 1, 2]
+    assert ran == []
+    with pytest.raises(RuntimeError):
+        pool.submit(lambda: None, None)
+    wedge.set()                              # release the wedged daemon
+    time.sleep(0.1)
+    assert ran == []                         # drained queue: nothing to run
+
+
+def test_binding_pool_abort_fallback_used_after_shutdown_on_permit_resolve():
+    """Satellite: a permit resolving AFTER the bind pool shut down must run
+    the cheap abort path (unreserve + forget) on the signaling thread —
+    never a full bind cycle. Observable as: the pod's reservation is gone,
+    no FailedScheduling event was recorded, and its trace finalized as
+    bind-aborted."""
+    prev = trace.default_recorder()
+    rec = trace.install_recorder(trace.FlightRecorder())
+    profile = PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeResourcesFit"],
+        permit=["Coscheduling"],
+        bind=["DefaultBinder"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=30)},
+    )
+    c = TestCluster(profile=profile)
+    try:
+        c.scheduler.run()
+        c.api.create(srv.NODES, make_node("n1"))
+        # m0 parks at the permit barrier: its sibling exists (PreFilter's
+        # sibling count passes) but can never fit, so quorum never forms
+        # (no PostFilter in this profile ⇒ no optimistic gang rejection)
+        c.api.create(srv.POD_GROUPS, make_pod_group("half", min_member=2))
+        pod = make_pod("half-m0", requests=make_resources(cpu=1),
+                       pod_group="half")
+        c.api.create(srv.PODS, pod)
+        c.api.create(srv.PODS, make_pod(
+            "half-m1", requests=make_resources(cpu=10_000),
+            pod_group="half"))
+        sched = c.scheduler
+        assert wait_until(
+            lambda: sched._fw.get_waiting_pod(pod.meta.uid) is not None,
+            timeout=5.0)
+        assert sched.cache.is_assumed(pod.key)
+        # the pool dies first (the stop() race this satellite hardens)
+        sched._bind_pool.shutdown(timeout=1.0)
+        events_before = len(c.api.events())
+        sched._fw.reject_waiting_pod(pod.meta.uid, "Test", "forced rejection")
+        assert wait_until(lambda: not sched.cache.is_assumed(pod.key),
+                          timeout=2.0)
+        outcomes = {t.outcome for t in rec.traces() if t.pod_key == pod.key}
+        assert "bind-aborted" in outcomes
+        # no failure-path side effects ran inline (no requeue event)
+        assert len(c.api.events()) == events_before
+    finally:
+        c.stop()
+        trace.install_recorder(prev)
+
+
+# -- degraded mode ------------------------------------------------------------
+
+def test_degraded_mode_trips_recovers_and_publishes():
+    published = []
+    dm = _DegradedMode(threshold=2, initial_pause_s=0.1, max_pause_s=0.4,
+                       publish=lambda comp, st: published.append((comp, st)))
+    dm.on_retry_exhausted("bind", "pods", Unavailable("x"))
+    assert not dm.active()
+    dm.on_retry_exhausted("bind", "pods", Unavailable("x"))
+    assert dm.active()
+    assert published and published[-1][0] == "degraded_mode"
+    assert published[-1][1]["active"] is True
+    dm.on_success()
+    assert not dm.active()
+    assert published[-1][1]["active"] is False
+    # a fresh episode starts from the initial pause again
+    dm.on_retry_exhausted("get", "pods", Unavailable("x"))
+    dm.on_retry_exhausted("get", "pods", Unavailable("x"))
+    assert 0 < dm.pause_remaining() <= 0.1 + 1e-3
+
+
+def test_degraded_mode_pause_grows_without_recovery():
+    dm = _DegradedMode(threshold=1, initial_pause_s=0.02, max_pause_s=0.1)
+    dm.on_retry_exhausted("get", "pods", Unavailable("x"))
+    first = dm.pause_remaining()
+    assert wait_until(lambda: not dm.active(), timeout=1.0)
+    dm.on_retry_exhausted("get", "pods", Unavailable("x"))
+    assert dm.pause_remaining() > first      # doubled window
+    assert dm.snapshot()["entries_total"] == 2
+
+
+def test_degraded_mode_recovery_publishes_after_window_lapse():
+    """A success arriving AFTER the pause window lapsed must still publish
+    the recovery — otherwise /debug/flightrecorder's health section claims
+    degraded forever while the gauge reads 0."""
+    published = []
+    dm = _DegradedMode(threshold=1, initial_pause_s=0.02, max_pause_s=0.05,
+                       publish=lambda comp, st: published.append(st))
+    dm.on_retry_exhausted("bind", "pods", Unavailable("x"))
+    assert published[-1]["active"] is True
+    assert wait_until(lambda: not dm.active(), timeout=1.0)  # window lapses
+    dm.on_success()
+    assert published[-1]["active"] is False
+
+
+def test_degraded_mode_half_open_probing_keeps_escalated_pause():
+    """Window lapse with NO success moves to half-open: health stops
+    claiming an active pause (probing published), but the pause ladder is
+    NOT reset — a still-down apiserver re-trips into a longer window;
+    only a real success resets it."""
+    published = []
+    dm = _DegradedMode(threshold=1, initial_pause_s=0.02, max_pause_s=0.2,
+                       publish=lambda comp, st: published.append(st))
+    dm.on_retry_exhausted("bind", "pods", Unavailable("x"))
+    assert wait_until(lambda: not dm.active(), timeout=1.0)
+    dm.maybe_expire()
+    assert published[-1]["active"] is False
+    assert published[-1]["probing"] is True
+    # re-trip while half-open: the window is the ESCALATED one
+    dm.on_retry_exhausted("bind", "pods", Unavailable("x"))
+    assert dm.pause_remaining() > 0.02
+    assert published[-1]["active"] is True
+    # a success anywhere ends the episode and resets the ladder
+    dm.on_success()
+    assert published[-1]["active"] is False
+    assert published[-1]["probing"] is False
+
+
+def test_degraded_mode_disabled_with_zero_threshold():
+    dm = _DegradedMode(threshold=0, initial_pause_s=0.1, max_pause_s=0.1)
+    for _ in range(10):
+        dm.on_retry_exhausted("get", "pods", Unavailable("x"))
+    assert not dm.active()
+
+
+# -- gang-atomic bind rollback (tentpole acceptance) --------------------------
+
+def _gang_profile():
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeResourcesFit"],
+        post_filter=["Coscheduling"],
+        reserve=["Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["DefaultBinder"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=3,
+            # deliberately HUGE: a rollback-driven Unreserve must NOT put
+            # the gang in the denial window (it failed on an API outage,
+            # not on fit) — if it did, recovery would stall far past this
+            # test's wait and fail it
+            denied_pg_expiration_time_seconds=120)},
+        pod_initial_backoff_s=0.02, pod_max_backoff_s=0.2,
+    )
+
+
+def test_terminal_midgang_bind_failure_rolls_back_and_recovers():
+    """Acceptance: a terminal mid-gang bind failure is fully explainable
+    from /debug/flightrecorder ALONE (pinned rollback anomaly with
+    per-member attribution), no partially-bound gang wedges, and the gang
+    binds once the faults clear."""
+    from tpusched.util.httpserve import MetricsServer
+    import json
+    import urllib.request
+
+    prev = trace.default_recorder()
+    trace.install_recorder(trace.FlightRecorder())
+    api = APIServer()
+    inj = FaultInjector(api, seed=11)
+    c = TestCluster(profile=_gang_profile(), api=inj)
+    server = MetricsServer(port=0).start()
+    rollbacks_before = gang_bind_rollbacks.value()
+    try:
+        c.scheduler.run()
+        for i in range(3):
+            api.create(srv.NODES, make_node(f"n{i}"))
+        # member m0's binds fail until the outage budget is spent: two full
+        # retry-exhausted bind calls (2 × max 4 attempts), then success
+        inj.add_rule(FaultRule(name="m0-outage", verbs=("bind",),
+                               error="unavailable", key_substr="roll-m0",
+                               max_injections=8))
+        api.create(srv.POD_GROUPS, make_pod_group("roll", min_member=3))
+        keys = []
+        for m in range(3):
+            p = make_pod(f"roll-m{m}", requests=make_resources(cpu=1),
+                         pod_group="roll")
+            api.create(srv.PODS, p)
+            keys.append(p.key)
+        # faults clear by exhaustion; the gang must fully bind
+        assert c.wait_for_pods_scheduled(keys, timeout=30.0), \
+            [k for k in keys if not c.pod_scheduled(k)]
+        assert gang_bind_rollbacks.value() - rollbacks_before >= 1
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/flightrecorder") as r:
+            dump = json.loads(r.read())
+        pinned = dump["pinned"]
+        rollback_anomalies = [
+            a for t in pinned for a in t.get("anomalies", [])
+            if a["kind"] == "gang_bind_rollback"]
+        assert rollback_anomalies, "rollback anomaly not pinned"
+        trigger = [a for a in rollback_anomalies if a.get("role") == "trigger"]
+        assert trigger and trigger[0]["gang"] == "default/roll"
+        assert trigger[0]["trigger_pod"] == "default/roll-m0"
+        # per-member attribution: the triggering trace names the pod, node
+        # and the terminal bind error
+        assert "injected unavailable" in trigger[0]["message"]
+        # no partially-bound gang at quiescence (all three are bound)
+        bound = [p for p in api.list(srv.PODS) if p.spec.node_name]
+        assert len(bound) == 3
+    finally:
+        server.stop()
+        c.stop()
+        inj.clear()
+        trace.install_recorder(prev)
+
+
+def test_gang_rollback_skipped_for_singletons():
+    """A singleton's terminal bind failure requeues only itself — no
+    rollback bookkeeping, no metric bump."""
+    api = APIServer()
+    inj = FaultInjector(api, seed=5)
+    c = TestCluster(api=inj)
+    before = gang_bind_rollbacks.value()
+    try:
+        c.scheduler.run()
+        api.create(srv.NODES, make_node("n0"))
+        inj.add_rule(FaultRule(verbs=("bind",), error="unavailable",
+                               max_injections=8))
+        p = make_pod("solo", requests=make_resources(cpu=1))
+        api.create(srv.PODS, p)
+        assert c.wait_for_pods_scheduled([p.key], timeout=20.0)
+        assert gang_bind_rollbacks.value() == before
+    finally:
+        c.stop()

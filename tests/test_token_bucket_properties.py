@@ -1,0 +1,83 @@
+"""Property-based token-bucket laws (hypothesis): the client-side QPS
+throttle must (1) bound the admission rate, (2) never deadlock concurrent
+waiters, and (3) resolve every deadline-carrying waiter — a token or a
+terminal ``Throttled``, never an unbounded sleep (the satellite fix in
+apiserver/client.py:_TokenBucket.wait).
+
+Deterministic companions: tests/test_resilience.py's token-bucket section.
+"""
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+from tpusched.apiserver.client import _TokenBucket  # noqa: E402
+from tpusched.apiserver.errors import Throttled  # noqa: E402
+
+
+@settings(max_examples=10, deadline=None)
+@given(qps=st.integers(min_value=100, max_value=400),
+       burst=st.integers(min_value=1, max_value=4),
+       extra=st.integers(min_value=1, max_value=8),
+       workers=st.integers(min_value=1, max_value=4))
+def test_rate_bound_and_liveness(qps, burst, extra, workers):
+    """Concurrent waiters never exceed the configured rate (elapsed ≥
+    tokens-minted/qps, with scheduling slack) and never deadlock (every
+    waiter returns)."""
+    b = _TokenBucket(qps=float(qps), burst=burst)
+    n = burst + extra
+    taken = []
+    lock = threading.Lock()
+
+    def puller():
+        while True:
+            with lock:
+                if len(taken) >= n:
+                    return
+                taken.append(1)
+            b.wait(deadline=time.monotonic() + 10.0)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=puller) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.monotonic() - t0
+    assert not any(t.is_alive() for t in threads), "token bucket deadlocked"
+    assert elapsed >= (n - burst) / qps * 0.5
+
+
+@settings(max_examples=10, deadline=None)
+@given(deadline_ms=st.integers(min_value=5, max_value=50),
+       waiters=st.integers(min_value=2, max_value=6))
+def test_deadline_liveness_under_contention(deadline_ms, waiters):
+    """Starved waiters with deadlines all resolve (token or Throttled) —
+    nobody sleeps unboundedly toward a token that cannot arrive in time."""
+    b = _TokenBucket(qps=0.2, burst=1)
+    b.wait()                                 # starve the bucket
+    outcomes = []
+    lock = threading.Lock()
+
+    def waiter():
+        try:
+            b.wait(deadline=time.monotonic() + deadline_ms / 1000.0)
+            out = "token"
+        except Throttled:
+            out = "throttled"
+        with lock:
+            outcomes.append(out)
+
+    threads = [threading.Thread(target=waiter) for _ in range(waiters)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in threads)
+    assert len(outcomes) == waiters
+    assert outcomes.count("throttled") >= waiters - 1

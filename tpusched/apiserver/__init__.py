@@ -9,7 +9,11 @@ in-process against fabricated Nodes exactly like the reference's envtest
 integration tier (/root/reference/test/integration/main_test.go:31-46).
 """
 from .server import APIServer, WatchEvent
-from .client import Clientset
+from .client import Clientset, RetryPolicy
+from .errors import Conflict, NotFound, Throttled, Unavailable
+from .faults import FaultInjector, FaultRule
 from .informers import Informer, InformerFactory
 
-__all__ = ["APIServer", "WatchEvent", "Clientset", "Informer", "InformerFactory"]
+__all__ = ["APIServer", "WatchEvent", "Clientset", "RetryPolicy",
+           "Conflict", "NotFound", "Throttled", "Unavailable",
+           "FaultInjector", "FaultRule", "Informer", "InformerFactory"]

@@ -5,16 +5,62 @@ Analog of the generated clientset in /root/reference/pkg/generated
 the Bind subresource on pods. QPS/burst throttling is supported to mirror the
 controller's --qps/--burst API budget
 (/root/reference/cmd/controller/app/options.go:43-44).
+
+Resilience layer (the retry contract every consumer gets for free):
+
+- every verb classifies failures through ``errors.is_retriable`` and retries
+  transient ones under capped exponential backoff with jitter, bounded by
+  BOTH an attempt budget and a per-call wall deadline — the client-go
+  rate-limited-workqueue + RetryOnConflict discipline, collapsed to the one
+  place all API traffic passes through;
+- ``patch`` retries Conflict: the server re-reads the live object under its
+  lock on every attempt, so the retry IS the conflict-aware
+  re-read-and-retry loop;
+- ``bind`` heals the lost-response case: a retried bind that Conflicts
+  re-reads the pod, and "already bound to MY node" is success (the first
+  attempt's write landed; failing the cycle would roll back a healthy gang);
+- retries annotate the active flight-recorder trace (an ``api-retry`` span
+  per sleep) and bump ``tpusched_api_retries_total`` /
+  ``tpusched_api_retry_exhausted_total``; exhaustions also feed the
+  caller's ``on_retry_exhausted`` hook (the scheduler's degraded-mode trip
+  counter), successes feed ``on_success`` (its reset).
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from ..api.core import Binding
-from ..util import tracectx
+from ..util import klog, tracectx
+from ..util.metrics import api_retries, api_retry_exhausted, events_dropped
 from . import server as srv
+from .errors import Conflict, Throttled, is_retriable
+
+
+@dataclass
+class RetryPolicy:
+    """Capped exponential backoff with jitter + a per-call wall deadline.
+
+    Defaults are tuned for a control loop: fail a single call within
+    ~5 s worst-case so the scheduler's own failure path (requeue with pod
+    backoff, degraded mode) takes over instead of one cycle hanging."""
+    max_attempts: int = 4           # total tries, including the first
+    initial_backoff_s: float = 0.02
+    max_backoff_s: float = 0.5
+    jitter: float = 0.25            # ± fraction of the backoff
+    deadline_s: float = 5.0         # wall budget incl. throttle wait + sleeps
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+# Private jitter source: retry sleeps must not consume (or be perturbed by)
+# the GLOBAL random stream — seeded tests and the chaos soak's injector own
+# their own deterministic streams, and client jitter drawing from the
+# shared module RNG would silently desynchronize them.
+_RNG = random.Random()
 
 
 class _TokenBucket:
@@ -24,7 +70,11 @@ class _TokenBucket:
         self._last = clock()
         self._lock = threading.Lock()
 
-    def wait(self):
+    def wait(self, deadline: Optional[float] = None) -> None:
+        """Block until a token is available. ``deadline`` (in this bucket's
+        clock domain) bounds the wait: a token that cannot be minted in time
+        raises ``Throttled`` — terminal, never an unbounded sleep — so a
+        tiny qps cannot wedge a binding thread forever."""
         if self.qps <= 0:
             return
         while True:
@@ -36,66 +86,182 @@ class _TokenBucket:
                     self._tokens -= 1
                     return
                 need = (1 - self._tokens) / self.qps
+            if deadline is not None and now + need > deadline:
+                raise Throttled(
+                    f"qps budget exhausted: next token in {need:.3f}s, "
+                    f"deadline in {max(0.0, deadline - now):.3f}s")
             time.sleep(need)
 
 
 class _KindClient:
-    def __init__(self, api: srv.APIServer, kind: str, bucket: Optional[_TokenBucket]):
+    def __init__(self, api: srv.APIServer, kind: str,
+                 bucket: Optional[_TokenBucket],
+                 policy: Optional[RetryPolicy] = DEFAULT_RETRY_POLICY,
+                 hooks: Optional["_Hooks"] = None):
         self._api, self._kind, self._bucket = api, kind, bucket
+        self._policy = policy
+        self._hooks = hooks or _NO_HOOKS
 
-    def _throttle(self):
-        if self._bucket:
-            self._bucket.wait()
+    def _invoke(self, verb: str, key: str, fn, heal=None):
+        """The retry core every verb funnels through. ``heal(exc, attempt)``
+        optionally resolves a retriable error without another server round
+        trip (returns a 1-tuple result to adopt, or None to keep going)."""
+        pol = self._policy
+        if pol is None:                       # retries disabled (tests)
+            if self._bucket:
+                self._bucket.wait()
+            return fn()
+        deadline = time.monotonic() + pol.deadline_s
+        backoff = pol.initial_backoff_s
+        attempt = 1
+        while True:
+            try:
+                if self._bucket:
+                    self._bucket.wait(deadline)
+                out = fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                # heal first: it can resolve errors the taxonomy calls
+                # terminal (a retried bind Conflicting against its own
+                # landed write), so a genuine failure that heal declines
+                # raises immediately — no wasted sleeps, no spurious
+                # retry-exhausted feed into degraded mode
+                healed = heal(e, attempt) if heal is not None else None
+                if healed is not None:
+                    self._hooks.on_success()
+                    return healed[0]
+                if not is_retriable(verb, e):
+                    raise
+                delay = backoff * (1 + pol.jitter * (2 * _RNG.random() - 1))
+                if (attempt >= pol.max_attempts
+                        or time.monotonic() + delay > deadline):
+                    api_retry_exhausted.inc()
+                    self._hooks.on_retry_exhausted(verb, self._kind, e)
+                    klog.V(3).info_s("api retry budget exhausted",
+                                     verb=verb, kind=self._kind, key=key,
+                                     attempts=attempt, err=str(e))
+                    raise
+                api_retries.inc()
+                self._annotate_retry(verb, key, attempt, delay, e)
+                time.sleep(delay)
+                backoff = min(backoff * 2, pol.max_backoff_s)
+                attempt += 1
+                continue
+            self._hooks.on_success()
+            return out
+
+    @staticmethod
+    def _annotate_retry(verb: str, key: str, attempt: int, delay: float,
+                        exc: Exception) -> None:
+        # an api-retry is invisible latency inside whatever extension point
+        # is running: put a span on the active cycle trace so a slow cycle
+        # under apiserver degradation is attributable from the dump alone
+        from .. import trace
+        tr = trace.current()
+        if tr is not None:
+            tr.add_event("api-retry", time.perf_counter(), delay,
+                         {"verb": verb, "key": key, "attempt": attempt,
+                          "err": str(exc)[:120]})
 
     def create(self, obj):
-        self._throttle()
-        return self._api.create(self._kind, obj)
+        return self._invoke("create", obj.meta.key,
+                            lambda: self._api.create(self._kind, obj))
 
     def get(self, key: str):
-        self._throttle()
-        return self._api.get(self._kind, key)
+        return self._invoke("get", key, lambda: self._api.get(self._kind, key))
 
     def try_get(self, key: str):
-        self._throttle()
-        return self._api.try_get(self._kind, key)
+        return self._invoke("try_get", key,
+                            lambda: self._api.try_get(self._kind, key))
 
     def list(self, namespace=None, selector: Optional[Dict[str, str]] = None):
-        self._throttle()
-        return self._api.list(self._kind, namespace, selector)
+        return self._invoke("list", "",
+                            lambda: self._api.list(self._kind, namespace,
+                                                   selector))
 
     def update(self, obj):
-        self._throttle()
-        return self._api.update(self._kind, obj)
+        return self._invoke("update", obj.meta.key,
+                            lambda: self._api.update(self._kind, obj))
 
     def patch(self, key: str, mutate: Callable):
-        self._throttle()
-        return self._api.patch(self._kind, key, mutate)
+        return self._invoke("patch", key,
+                            lambda: self._api.patch(self._kind, key, mutate))
 
     def delete(self, key: str):
-        self._throttle()
-        return self._api.delete(self._kind, key)
+        return self._invoke("delete", key,
+                            lambda: self._api.delete(self._kind, key))
 
 
 class _PodClient(_KindClient):
     def bind(self, binding: Binding):
-        self._throttle()
-        return self._api.bind(binding)
+        def heal(exc: Exception, attempt: int):
+            """Lost-response bind healing: a Conflict on a RETRIED bind
+            means either a genuine double-bind or our own first attempt
+            landing without its response. Re-read and compare: bound to
+            our node ⇒ the write was ours, the call succeeded.
+            First-attempt Conflicts stay terminal (a real already-bound
+            pod must fail the cycle)."""
+            if attempt < 2 or not isinstance(exc, Conflict):
+                return None
+            # bounded re-read retry: a single transient blip here must not
+            # convert an actually-successful bind into a terminal Conflict
+            # (and, for gangs, a spurious whole-gang rollback). Raw store
+            # read on purpose — a throttle/deadline wait inside heal would
+            # charge the verification read against the budget the bind
+            # already spent.
+            pod = None
+            for i in range(3):
+                try:
+                    pod = self._api.try_get(self._kind, binding.pod_key)
+                    break
+                except Exception:  # noqa: BLE001 — healing is best-effort
+                    if i < 2:
+                        time.sleep(0.01)
+            if pod is not None and pod.spec.node_name == binding.node_name:
+                klog.V(3).info_s("bind healed after lost response",
+                                 pod=binding.pod_key, node=binding.node_name)
+                return (None,)
+            return None
+        return self._invoke("bind", binding.pod_key,
+                            lambda: self._api.bind(binding), heal=heal)
+
+
+class _Hooks:
+    """Caller-observable retry outcomes (degraded-mode feed). on_success is
+    called on EVERY successful API call — keep implementations O(1)."""
+
+    def __init__(self, on_retry_exhausted=None, on_success=None):
+        self.on_retry_exhausted = on_retry_exhausted or (lambda *a: None)
+        self.on_success = on_success or (lambda: None)
+
+
+_NO_HOOKS = _Hooks()
 
 
 class Clientset:
-    def __init__(self, api: srv.APIServer, qps: float = 0.0, burst: int = 0):
+    def __init__(self, api: srv.APIServer, qps: float = 0.0, burst: int = 0,
+                 retry: Optional[RetryPolicy] = DEFAULT_RETRY_POLICY,
+                 on_retry_exhausted=None, on_success=None):
         bucket = _TokenBucket(qps, burst) if qps > 0 else None
+        hooks = (_Hooks(on_retry_exhausted, on_success)
+                 if (on_retry_exhausted or on_success) else _NO_HOOKS)
         self.api = api
-        self.pods = _PodClient(api, srv.PODS, bucket)
-        self.nodes = _KindClient(api, srv.NODES, bucket)
-        self.podgroups = _KindClient(api, srv.POD_GROUPS, bucket)
-        self.elasticquotas = _KindClient(api, srv.ELASTIC_QUOTAS, bucket)
-        self.priorityclasses = _KindClient(api, srv.PRIORITY_CLASSES, bucket)
-        self.pdbs = _KindClient(api, srv.PDBS, bucket)
-        self.tputopologies = _KindClient(api, srv.TPU_TOPOLOGIES, bucket)
+        self.pods = _PodClient(api, srv.PODS, bucket, retry, hooks)
+        self.nodes = _KindClient(api, srv.NODES, bucket, retry, hooks)
+        self.podgroups = _KindClient(api, srv.POD_GROUPS, bucket, retry, hooks)
+        self.elasticquotas = _KindClient(api, srv.ELASTIC_QUOTAS, bucket,
+                                         retry, hooks)
+        self.priorityclasses = _KindClient(api, srv.PRIORITY_CLASSES, bucket,
+                                           retry, hooks)
+        self.pdbs = _KindClient(api, srv.PDBS, bucket, retry, hooks)
+        self.tputopologies = _KindClient(api, srv.TPU_TOPOLOGIES, bucket,
+                                         retry, hooks)
 
     def record_event(self, object_key: str, kind: str, etype: str, reason: str,
                      message: str = "") -> None:
+        """Best-effort by contract: an Event is advisory telemetry and must
+        NEVER raise into a scheduling/binding cycle — a failed emission is
+        swallowed and counted (tpusched_events_dropped_total), not retried
+        (retrying advisory writes under an outage amplifies the outage)."""
         # flight-recorder correlation: an Event recorded inside a traced
         # cycle carries the cycle's trace id, so an operator can jump from
         # `kubectl describe`-style output to /debug/flightrecorder
@@ -103,4 +269,9 @@ class Clientset:
         if tid:
             message = f"{message} [trace={tid}]" if message \
                 else f"[trace={tid}]"
-        self.api.record_event(object_key, kind, etype, reason, message)
+        try:
+            self.api.record_event(object_key, kind, etype, reason, message)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            events_dropped.inc()
+            klog.V(4).info_s("event emission dropped", object=object_key,
+                             reason=reason, err=str(e))

@@ -1,0 +1,66 @@
+"""API error taxonomy: retriable vs terminal, shared by every API surface.
+
+The reference rides kube-apiserver error semantics — controllers wrap writes
+in `retry.RetryOnConflict` and client-go rate limiters surface 429s — so
+"which failures are worth retrying" is a first-class contract, not an
+accident of each call site. This module centralizes that contract for all
+three API surfaces the repo has (the hermetic in-memory ``APIServer``, the
+kube-mode ``KubeAPIServer``, and the fault injector wrapping either):
+
+- ``NotFound`` / ``Conflict``: the store's own semantic errors (defined in
+  ``server.py``, re-exported here). Terminal by default; Conflict is
+  retriable ONLY for ``patch`` (the server re-reads the live object under
+  its lock on every attempt, so a retry IS the conflict-aware
+  re-read-and-retry loop). A bind Conflict is terminal HERE — the
+  lost-response case (our own first attempt landed, the retry Conflicts
+  against it) is resolved by the client's heal hook, which re-reads the
+  pod BEFORE this classification runs (client._PodClient.bind), so a
+  genuine already-bound pod fails fast without burning retries.
+- ``Unavailable``: a transient infrastructure failure (apiserver blip,
+  injected fault, connection reset). Always retriable.
+- ``Throttled``: the client-side QPS budget could not admit the call within
+  its deadline. Terminal — retrying against an exhausted budget only digs
+  the hole deeper; callers back off through the scheduler's failure path.
+- kube-mode ``KubeError``: retriable when the HTTP status says the server
+  (not the request) was at fault — 429/5xx — and only for idempotent verbs;
+  status 0 ("outcome unknown": the response was lost) is never retried
+  blindly for non-idempotent verbs, the caller's failure path re-reads.
+"""
+from __future__ import annotations
+
+from .server import Conflict, NotFound
+
+__all__ = ["Conflict", "NotFound", "Unavailable", "Throttled",
+           "is_retriable", "IDEMPOTENT_VERBS"]
+
+
+class Unavailable(RuntimeError):
+    """Transient API failure — the request may succeed if simply retried."""
+
+
+class Throttled(RuntimeError):
+    """Client-side QPS budget exhausted within the call's deadline."""
+
+
+# Verbs whose blind retry cannot double-apply: reads, and the atomic
+# read-modify-write patch (the mutator runs against the live object each
+# attempt). create/update/delete/bind replays can double-apply or mask
+# real conflicts and are retried only on errors proven pre-application.
+IDEMPOTENT_VERBS = frozenset(("get", "try_get", "list", "patch"))
+
+
+def is_retriable(verb: str, exc: BaseException) -> bool:
+    """Is this (verb, error) pair worth another attempt?"""
+    if isinstance(exc, Unavailable):
+        return True
+    if isinstance(exc, Throttled) or isinstance(exc, NotFound):
+        return False
+    if isinstance(exc, Conflict):
+        # patch only: server-side RMW makes the retry the re-read loop.
+        # bind Conflicts are either healed (lost response, resolved before
+        # this runs) or genuine double-binds — terminal either way.
+        return verb == "patch"
+    status = getattr(exc, "status", None)   # kube.KubeError
+    if isinstance(status, int):
+        return (status == 429 or status >= 500) and verb in IDEMPOTENT_VERBS
+    return False

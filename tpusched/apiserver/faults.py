@@ -1,0 +1,205 @@
+"""Deterministic, seedable fault injector wrapping an APIServer.
+
+The reference's resilience story is exercised by the real world (flaky
+apiservers, conflict storms under HA controllers); the hermetic rebuild
+needs the adversary built in. ``FaultInjector`` interposes on the API
+surface the clientset calls (the watch fan-out and informer paths pass
+through untouched — faults model the REQUEST path, not the store), so the
+same injector drives unit tests, the chaos soak (tests/test_chaos_soak.py,
+``make chaos-smoke``) and ad-hoc debugging.
+
+Determinism: every probabilistic decision draws from one ``random.Random``
+seeded at construction, and rule evaluation order is the registration
+order — a failing soak reproduces from its printed seed.
+
+Fault shapes (``FaultRule``):
+
+- ``error="unavailable"``: transient ``errors.Unavailable`` (the retriable
+  blip). With ``after=True`` the operation APPLIES first and the error is
+  raised afterwards — the lost-response case (e.g. a bind timeout whose
+  write landed), which is what makes conflict-healing paths testable.
+- ``error="conflict"`` / ``"not_found"``: semantic errors injected without
+  touching the store (optimistic-concurrency races, informer-lag races).
+- ``latency_s``: a deterministic stall before the verdict (slow apiserver);
+  composable with any error or with ``error="none"`` for pure latency.
+- ``max_injections`` bounds a rule (an outage of exactly N failures);
+  ``probability`` makes it intermittent; ``key_substr`` scopes it to
+  matching object keys (fail ONE gang member's bind, not the burst).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import server as srv
+from .errors import Conflict, NotFound, Unavailable
+
+ALL = "*"
+
+_ERRORS = {
+    "unavailable": lambda msg: Unavailable(msg),
+    "conflict": lambda msg: Conflict(msg),
+    "not_found": lambda msg: NotFound(msg),
+}
+
+
+@dataclass
+class FaultRule:
+    """One injection rule. Matches (verb, kind, key); fires with
+    ``probability`` until ``max_injections`` is spent."""
+    verbs: tuple = (ALL,)
+    kinds: tuple = (ALL,)
+    error: str = "unavailable"      # unavailable | conflict | not_found | none
+    probability: float = 1.0
+    latency_s: float = 0.0
+    after: bool = False             # apply the op, then fail (lost response)
+    max_injections: Optional[int] = None
+    key_substr: str = ""
+    name: str = ""
+    injected: int = field(default=0, compare=False)
+
+    def matches(self, verb: str, kind: str, key: str) -> bool:
+        if self.max_injections is not None and self.injected >= self.max_injections:
+            return False
+        if ALL not in self.verbs and verb not in self.verbs:
+            return False
+        if ALL not in self.kinds and kind not in self.kinds:
+            return False
+        if self.key_substr and self.key_substr not in (key or ""):
+            return False
+        return True
+
+
+class FaultInjector:
+    """APIServer-shaped wrapper injecting faults on the request path.
+
+    Drop-in anywhere an ``APIServer`` is accepted (Scheduler, Clientset,
+    TestCluster(api=...)): the CRUD/bind/record_event surface is
+    intercepted; everything else (watches, peek, leases, persistence,
+    restore) delegates to the wrapped server so informers and HA machinery
+    see the store exactly as-is.
+    """
+
+    def __init__(self, api: srv.APIServer, seed: int = 0):
+        self._api = api
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self._enabled = True
+        self._injections_total = 0
+
+    # -- rule management ------------------------------------------------------
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def set_rules(self, rules: List[FaultRule]) -> None:
+        with self._lock:
+            self._rules = list(rules)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+
+    def set_enabled(self, v: bool) -> None:
+        with self._lock:
+            self._enabled = bool(v)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "injections_total": self._injections_total,
+                "rules": [{"name": r.name or f"rule{i}", "error": r.error,
+                           "verbs": list(r.verbs), "kinds": list(r.kinds),
+                           "injected": r.injected}
+                          for i, r in enumerate(self._rules)],
+            }
+
+    # -- the interposition core ----------------------------------------------
+
+    def _decide(self, verb: str, kind: str, key: str) -> Optional[FaultRule]:
+        """Pick the first matching rule that fires (one RNG draw per
+        matching probabilistic rule, in registration order)."""
+        with self._lock:
+            if not self._enabled:
+                return None
+            for r in self._rules:
+                if not r.matches(verb, kind, key):
+                    continue
+                if r.probability < 1.0 and self._rng.random() >= r.probability:
+                    continue
+                r.injected += 1
+                self._injections_total += 1
+                return r
+        return None
+
+    def _call(self, verb: str, kind: str, key: str, fn):
+        rule = self._decide(verb, kind, key)
+        if rule is None:
+            return fn()
+        if rule.latency_s > 0:
+            time.sleep(rule.latency_s)
+        make = _ERRORS.get(rule.error)
+        if make is None:            # pure latency / "none"
+            return fn()
+        msg = (f"injected {rule.error} [{rule.name or 'fault'}] "
+               f"on {verb} {kind} {key}")
+        if rule.after:
+            fn()                    # the write LANDED; the response is lost
+        raise make(msg)
+
+    # -- intercepted surface --------------------------------------------------
+
+    def create(self, kind: str, obj):
+        return self._call("create", kind, obj.meta.key,
+                          lambda: self._api.create(kind, obj))
+
+    def get(self, kind: str, key: str):
+        return self._call("get", kind, key, lambda: self._api.get(kind, key))
+
+    def try_get(self, kind: str, key: str):
+        # a not_found injection here models the informer-lag race (object
+        # exists, the read misses it): surface None exactly like a miss
+        try:
+            return self._call("try_get", kind, key,
+                              lambda: self._api.try_get(kind, key))
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace=None, selector=None):
+        return self._call("list", kind, "",
+                          lambda: self._api.list(kind, namespace, selector))
+
+    def update(self, kind: str, obj):
+        return self._call("update", kind, obj.meta.key,
+                          lambda: self._api.update(kind, obj))
+
+    def patch(self, kind: str, key: str, mutate):
+        return self._call("patch", kind, key,
+                          lambda: self._api.patch(kind, key, mutate))
+
+    def delete(self, kind: str, key: str) -> None:
+        return self._call("delete", kind, key,
+                          lambda: self._api.delete(kind, key))
+
+    def bind(self, binding) -> None:
+        return self._call("bind", srv.PODS, binding.pod_key,
+                          lambda: self._api.bind(binding))
+
+    def record_event(self, object_key: str, kind: str, etype: str,
+                     reason: str, message: str) -> None:
+        return self._call("record_event", kind, object_key,
+                          lambda: self._api.record_event(
+                              object_key, kind, etype, reason, message))
+
+    # -- transparent delegation ----------------------------------------------
+
+    def __getattr__(self, name: str):
+        # watches, peek, events, leases, persistence, restore, cursors —
+        # the store side of the contract is never faulted
+        return getattr(self._api, name)

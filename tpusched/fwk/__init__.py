@@ -19,6 +19,8 @@ from .interfaces import (Plugin, QueueSortPlugin, PreFilterPlugin, FilterPlugin,
                          RESOURCE_POD, RESOURCE_NODE, RESOURCE_POD_GROUP,
                          RESOURCE_ELASTIC_QUOTA, RESOURCE_TPU_TOPOLOGY,
                          WILDCARD_EVENT)
-from .runtime import Framework, Registry, Handle, PluginProfile, PODS_TO_ACTIVATE_KEY, PodsToActivate
+from .runtime import (Framework, Registry, Handle, PluginProfile,
+                      PODS_TO_ACTIVATE_KEY, GANG_ROLLBACK_STATE_KEY,
+                      PodsToActivate)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -31,6 +31,14 @@ from .status import SKIP, Status, merge_statuses
 # sibling activation, /root/reference/pkg/coscheduling/core/core.go:111-143).
 PODS_TO_ACTIVATE_KEY = "tpusched/pods-to-activate"
 
+# CycleState key the scheduler sets before running Unreserve on a
+# gang-bind-rollback failure path (sched/scheduler): the cycle failed
+# because of an API-side bind outage, NOT because the gang cannot fit.
+# Coscheduling's Unreserve reads it to skip the denied-PodGroup window —
+# the rollback's whole point is re-admitting the gang through pod backoff
+# as soon as the faults clear, and a denial TTL on top would stall that.
+GANG_ROLLBACK_STATE_KEY = "tpusched/gang-bind-rollback"
+
 
 class PodsToActivate:
     def __init__(self):
@@ -84,6 +92,15 @@ class PluginProfile:
     # in production wiring (it spends the cycle the cache saved).
     equiv_cache: bool = True
     equiv_cache_differential: bool = False
+    # API-degradation circuit breaker (sched/scheduler._DegradedMode):
+    # after `degraded_threshold` CONSECUTIVE retry-exhausted API calls the
+    # scheduler pauses pop-dispatch for an exponentially growing window
+    # (initial→max) instead of hot-looping failures against a dead
+    # apiserver; any successful API call resets the trip counter and ends
+    # the episode at the next window lapse. 0 threshold disables.
+    degraded_threshold: int = 3
+    degraded_initial_pause_s: float = 1.0
+    degraded_max_pause_s: float = 30.0
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]

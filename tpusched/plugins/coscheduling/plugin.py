@@ -18,7 +18,7 @@ from ... import trace
 from ...api.core import Pod
 from ...api.scheduling import POD_GROUP_LABEL, pod_group_full_name, pod_group_label
 from ...config.types import CoschedulingArgs
-from ...fwk import CycleState, Status
+from ...fwk import CycleState, GANG_ROLLBACK_STATE_KEY, Status
 from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
                                EquivalenceAware, EVENT_ADD, EVENT_DELETE,
                                EVENT_UPDATE, PermitPlugin,
@@ -210,7 +210,12 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
                 klog.V(3).info_s("Unreserve rejects", pod=wp.key, podGroup=full)
                 waiting_pod.reject(self.NAME, "rejection in Unreserve")
         self.handle.iterate_over_waiting_pods(reject)
-        self.pg_mgr.add_denied_pod_group(full)
+        # gang-bind-rollback cycles (scheduler-marked) failed on an API
+        # outage, not on schedulability: the denial window would only stall
+        # the gang's re-admission after the faults clear — skip it and let
+        # pod backoff pace the retry
+        if not state.try_read(GANG_ROLLBACK_STATE_KEY):
+            self.pg_mgr.add_denied_pod_group(full)
         self.pg_mgr.delete_permitted_pod_group(full)
 
     # -- PostBind -------------------------------------------------------------

@@ -17,10 +17,12 @@ import time
 from typing import Dict, List, Optional
 
 from ..api.core import Binding, Node, Pod
+from ..api.scheduling import pod_group_full_name
 from ..apiserver import Clientset, InformerFactory
 from ..apiserver import server as srv
 from ..fwk import (CycleState, Framework, Handle, PluginProfile, Registry,
-                   Status, PODS_TO_ACTIVATE_KEY, PodsToActivate)
+                   Status, GANG_ROLLBACK_STATE_KEY, PODS_TO_ACTIVATE_KEY,
+                   PodsToActivate)
 from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_ELASTIC_QUOTA, RESOURCE_NODE,
                               RESOURCE_POD, RESOURCE_POD_GROUP,
@@ -34,7 +36,8 @@ from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_fallbacks, equiv_cache_hits,
                             equiv_cache_invalidations, equiv_cache_misses,
                             equiv_cache_vetoes, extension_point_seconds,
-                            queue_wait_seconds, schedule_attempts)
+                            gang_bind_rollbacks, queue_wait_seconds,
+                            schedule_attempts)
 from ..util.podutil import assigned
 from .cache import Cache
 from .equivcache import EquivalenceCache, EquivEntry
@@ -51,6 +54,130 @@ _KIND_TO_RESOURCE = {
     srv.ELASTIC_QUOTAS: RESOURCE_ELASTIC_QUOTA,
     srv.TPU_TOPOLOGIES: RESOURCE_TPU_TOPOLOGY,
 }
+
+# Attribution plugin name for gang-atomic bind rollback rejections (not a
+# real plugin: no cluster event will ever announce "the apiserver healed",
+# so _handle_failure routes these through backoffQ, never unschedulableQ).
+GANG_ROLLBACK_PLUGIN = "GangBindRollback"
+
+# A gang-rollback entry older than this cannot match any in-flight binding
+# task (permit dispatch → Bind is bounded by the bind pool's own drain
+# timeout); lazily pruned on the next rollback.
+_GANG_ABORT_TTL_S = 60.0
+
+
+class _DegradedMode:
+    """API-degradation circuit breaker.
+
+    Consecutive retry-exhausted API calls (the client burned its whole
+    backoff budget and still failed) flip the scheduler into a degraded
+    state: pop-dispatch pauses for an exponentially growing window instead
+    of hot-looping doomed cycles against a dead apiserver. ANY successful
+    API call recovers immediately (binding threads and sibling components
+    keep probing, so recovery needs no dedicated prober). Transitions are
+    published to the flight recorder's health section and the
+    ``tpusched_degraded_mode`` gauge."""
+
+    def __init__(self, threshold: int, initial_pause_s: float,
+                 max_pause_s: float, publish=None, clock=time.monotonic):
+        self._threshold = threshold
+        self._initial = initial_pause_s
+        self._max = max_pause_s
+        self._publish = publish or (lambda component, state: None)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._pause = initial_pause_s
+        self._until = 0.0
+        self._entries = 0
+        self._last_error = ""
+        # half-open: an armed window lapsed with no API success yet — the
+        # loop probes again, the escalated pause is kept until a success
+        self._probing = False
+
+    # Publishes happen UNDER self._lock (recorder.set_health only takes the
+    # recorder's own lock, no back-edge here): an enter publish delayed past
+    # a concurrent recovery publish would otherwise leave the health dict
+    # claiming degraded while the breaker is closed.
+
+    def on_retry_exhausted(self, verb: str, kind: str, exc: Exception) -> None:
+        if self._threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive += 1
+            self._last_error = f"{verb} {kind}: {exc}"
+            if self._consecutive < self._threshold:
+                return
+            now = self._clock()
+            if now < self._until:       # already paused: let the window run
+                return
+            pause = self._pause
+            self._until = now + pause
+            self._pause = min(self._pause * 2, self._max)
+            self._entries += 1
+            self._probing = False
+            state = self._snapshot_locked()
+            klog.warning_s("entering degraded mode: pausing pop-dispatch",
+                           pause_s=pause,
+                           consecutive_failures=state["consecutive_failures"],
+                           last_error=state["last_error"])
+            self._publish("degraded_mode", state)
+
+    def on_success(self) -> None:
+        # hot path: every successful API call lands here — exit without
+        # the lock while healthy
+        if self._consecutive == 0 and self._until == 0.0 \
+                and not self._probing:
+            return
+        with self._lock:
+            # an episode existed if a window was armed (still running,
+            # lapsed, or half-open/probing) — publish the recovery even
+            # when the success arrives AFTER the window lapsed, or the
+            # health section would claim degraded forever
+            had_episode = self._until != 0.0 or self._probing
+            self._consecutive = 0
+            self._pause = self._initial
+            self._until = 0.0
+            self._probing = False
+            if had_episode:
+                klog.info_s("leaving degraded mode: API call succeeded")
+                self._publish("degraded_mode", self._snapshot_locked())
+
+    def maybe_expire(self) -> None:
+        """Scheduler-loop tick: an armed window that lapsed WITHOUT any API
+        success moves to half-open — pop-dispatch resumes (probing), the
+        health section stops claiming an expired pause, but the escalated
+        pause is kept so a still-down apiserver re-trips into a longer
+        window instead of restarting the ladder. Only a real success
+        (on_success) resets the ladder."""
+        if self._until == 0.0:          # lock-free fast path (healthy)
+            return
+        with self._lock:
+            if self._until == 0.0 or self._clock() < self._until:
+                return
+            self._until = 0.0
+            self._probing = True
+            self._publish("degraded_mode", self._snapshot_locked())
+
+    def pause_remaining(self) -> float:
+        with self._lock:
+            return max(0.0, self._until - self._clock())
+
+    def active(self) -> bool:
+        return self.pause_remaining() > 0
+
+    def _snapshot_locked(self) -> Dict[str, object]:
+        now = self._clock()
+        return {"active": now < self._until,
+                "probing": self._probing,
+                "pause_remaining_s": round(max(0.0, self._until - now), 3),
+                "entries_total": self._entries,
+                "consecutive_failures": self._consecutive,
+                "last_error": self._last_error[:200]}
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return self._snapshot_locked()
 
 
 class _BindingPool:
@@ -72,27 +199,32 @@ class _BindingPool:
         for t in self._threads:
             t.start()
 
-    def submit(self, fn, *args) -> None:
+    def submit(self, fn, abort, *args) -> None:
+        """Queue a binding task. ``abort(*args)`` is the task's cheap
+        failure path (unreserve + forget, no API calls): shutdown drains
+        still-queued tasks through it instead of executing full bind
+        cycles on the stopping thread."""
         if not self._open:
             raise RuntimeError("binding pool is shut down")
-        self._q.put((fn, args))
+        self._q.put((fn, abort, args))
 
     def _run(self) -> None:
         while True:
             item = self._q.get()
             if item is None:
                 return
-            fn, args = item
+            fn, _, args = item
             try:
                 fn(*args)
             except Exception as e:  # a binding task must never kill a worker
                 klog.error_s(e, "binding task panicked")
 
     def shutdown(self, timeout: float = 5.0) -> None:
-        """Queued tasks drain first (FIFO before the sentinels); workers are
-        then joined with a shared bounded deadline. Tasks racing past the
-        open-check are drained inline afterwards so no pod's failure path is
-        silently dropped."""
+        """Workers are joined with a shared bounded deadline (a wedged Bind
+        API call delays stop() by at most ``timeout``). Tasks still queued
+        after the join — including ones racing past the open-check — are
+        ABORTED inline (reservations released, pods not leaked), never run
+        as full bind cycles on the stopping thread."""
         self._open = False
         for _ in self._threads:
             self._q.put(None)
@@ -105,11 +237,11 @@ class _BindingPool:
             except queue.Empty:
                 return
             if item is not None:
-                fn, args = item
+                fn, abort, args = item
                 try:
-                    fn(*args)
+                    (abort or fn)(*args)
                 except Exception as e:
-                    klog.error_s(e, "binding task panicked during drain")
+                    klog.error_s(e, "binding task abort panicked during drain")
 
 
 class Scheduler:
@@ -123,7 +255,16 @@ class Scheduler:
         # is injected (bench/test isolation).
         self.recorder = recorder if recorder is not None \
             else trace.default_recorder()
-        self.clientset = Clientset(api)
+        # degraded-mode circuit breaker, fed by the clientset's retry layer:
+        # consecutive retry-exhausted calls pause pop-dispatch (see
+        # _DegradedMode); any successful call recovers it
+        self._degraded = _DegradedMode(
+            profile.degraded_threshold, profile.degraded_initial_pause_s,
+            profile.degraded_max_pause_s,
+            publish=lambda comp, state: self.recorder.set_health(comp, state))
+        self.clientset = Clientset(
+            api, on_retry_exhausted=self._degraded.on_retry_exhausted,
+            on_success=self._degraded.on_success)
         self.informer_factory = InformerFactory(api)
         self.cache = Cache(clock)
         self.profile = profile
@@ -172,6 +313,17 @@ class Scheduler:
             REGISTRY.gauge_func("tpusched_pending_pods", depth,
                                 "Pods pending per scheduling sub-queue.",
                                 labels=f'{sched_label}queue="{q}"')
+        # degraded-mode visibility: 1 while pop-dispatch is paused (same
+        # weakref/prune discipline as the queue gauges above)
+        degraded_ref = weakref.ref(self._degraded)
+
+        def degraded_val(ref=degraded_ref):
+            live = ref()
+            return None if live is None else (1.0 if live.active() else 0.0)
+        REGISTRY.gauge_func(
+            "tpusched_degraded_mode", degraded_val,
+            "1 while the scheduler pauses pop-dispatch after consecutive "
+            "API retry exhaustions.", labels=sched_label.rstrip(","))
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
         # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
@@ -205,6 +357,14 @@ class Scheduler:
         # binding threads while waiting and at most pool-width while
         # draining, instead of 256 spawns + 256 blocked stacks per gang.
         self._bind_pool = _BindingPool(max(4, min(16, os.cpu_count() or 4)))
+        # gang-atomic bind rollback registry: gang full-name →
+        # (abort monotonic ts, triggering pod key, reason). A binding task
+        # dispatched BEFORE the abort must not commit its Bind; tasks from
+        # later cycles (dispatched after) proceed. Entries are pruned
+        # lazily (_GANG_ABORT_TTL_S) — the dict only ever holds gangs that
+        # failed a bind in the last minute.
+        self._gang_aborts: Dict[str, tuple] = {}
+        self._gang_aborts_lock = threading.Lock()
         self._wire_informers()
 
     @property
@@ -308,6 +468,13 @@ class Scheduler:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # degraded mode: pausing the pop IS the backoff — failed cycles
+            # against a dead apiserver would only re-queue themselves
+            pause = self._degraded.pause_remaining()
+            if pause > 0:
+                self._stop.wait(min(pause, 0.5))
+                continue
+            self._degraded.maybe_expire()
             info = self.queue.pop(timeout=0.5)
             if info is None:
                 continue
@@ -315,14 +482,32 @@ class Scheduler:
                 self.schedule_one(info)
             except Exception as e:
                 klog.error_s(e, "scheduleOne panicked", pod=info.pod.key)
-                self._handle_failure(info, Status.error(str(e)))
+                try:
+                    self._handle_failure(info, Status.error(str(e)))
+                except Exception as e2:  # the loop thread must survive ANY
+                    # failure-path failure (e.g. apiserver down): requeue on
+                    # backoff so the pod is never lost
+                    klog.error_s(e2, "failure path panicked; requeueing",
+                                 pod=info.pod.key)
+                    self.queue.requeue_after_failure(info, to_backoff=True)
 
     # -- one scheduling cycle -------------------------------------------------
+
+    def _live_pod(self, key: str) -> Optional[Pod]:
+        """Pre-read through the shared pod informer cache (upstream
+        semantics: the scheduling loop READS via informers; only writes hit
+        the API). Immune to the two failure shapes a live API read has —
+        transient unavailability burning a scheduling attempt, and the
+        stale-NotFound race that would make the scheduler silently DROP a
+        pod that still exists (the chaos soak's C1). Returns an owned
+        deepcopy (callers mutate status fields) or None."""
+        live = self.informer_factory.pods().get(key)
+        return live.deepcopy() if live is not None else None
 
     def schedule_one(self, info: QueuedPodInfo) -> None:
         pod = info.pod
         # skip pods deleted/bound while queued
-        live = self.api.try_get(srv.PODS, pod.key)
+        live = self._live_pod(pod.key)
         if live is None or assigned(live) or live.is_terminating():
             return
         pod = live
@@ -421,13 +606,19 @@ class Scheduler:
         def on_permit_resolved(permit_status: Status,
                                args=(state, info, assumed, node_name, start,
                                      pods_to_activate, tr)) -> None:
+            # dispatch timestamp: the gang-rollback registry compares it
+            # against abort times so only tasks of the aborted burst (not
+            # later retry cycles) are rolled back
+            dispatch_ts = time.monotonic()
             try:
-                self._bind_pool.submit(self._finish_binding, permit_status,
-                                       *args)
+                self._bind_pool.submit(self._finish_binding,
+                                       self._abort_binding, permit_status,
+                                       dispatch_ts, *args)
             except RuntimeError:
-                # pool already shut down (scheduler stopping): run the
-                # failure path inline so the pod is not silently leaked
-                self._finish_binding(permit_status, *args)
+                # pool already shut down (scheduler stopping): release the
+                # pod's reserved state only — NEVER run a full bind cycle
+                # inline on the signaling (informer/sweeper) thread
+                self._abort_binding(permit_status, dispatch_ts, *args)
 
         self._fw.notify_on_permit(assumed, on_permit_resolved)
 
@@ -838,9 +1029,15 @@ class Scheduler:
         if pf_status.is_success() and result and result.nominated_node_name:
             node = result.nominated_node_name
             try:
-                self.api.patch(srv.PODS, pod.key,
-                               lambda p: setattr(p.status, "nominated_node_name", node))
+                self.clientset.pods.patch(
+                    pod.key,
+                    lambda p: setattr(p.status, "nominated_node_name", node))
             except srv.NotFound:
+                return
+            except Exception as e:  # noqa: BLE001 — nomination is advisory:
+                # losing it costs a preemption round trip, not correctness
+                klog.V(3).info_s("nomination patch failed; skipping",
+                                 pod=pod.key, err=str(e))
                 return
             pod.status.nominated_node_name = node
             self.handle.pod_nominator.add_nominated_pod(pod, node)
@@ -848,9 +1045,31 @@ class Scheduler:
                                  plugin=pf_status.plugin)
             klog.V(4).info_s("preemption nominated node", pod=pod.key, node=node)
 
-    def _finish_binding(self, permit_status: Status, state: CycleState,
-                        info: QueuedPodInfo, assumed: Pod, node_name: str,
-                        cycle_start: float,
+    def _abort_binding(self, permit_status: Status, dispatch_ts: float,
+                       state: CycleState, info: QueuedPodInfo, assumed: Pod,
+                       node_name: str, cycle_start: float,
+                       pods_to_activate: PodsToActivate, tr=None) -> None:
+        """Shutdown-path resolution of a dispatched binding task: release
+        the pod's reserved state (unreserve + forget) and finalize its
+        trace — no API calls, no requeue, cheap enough for the signaling
+        thread or the pool's shutdown drain. The pod comes back Pending at
+        the next scheduler start (annotations-as-truth restart contract)."""
+        token = trace.activate(tr)
+        try:
+            self._fw.run_reserve_plugins_unreserve(state, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            if tr is not None:
+                tr.add_anomaly("binding_aborted",
+                               reason="scheduler shutting down",
+                               node=node_name)
+                tr.finish("bind-aborted", node=node_name)
+                self.recorder.finalize(tr, now=self.clock())
+        finally:
+            trace.deactivate(token)
+
+    def _finish_binding(self, permit_status: Status, dispatch_ts: float,
+                        state: CycleState, info: QueuedPodInfo, assumed: Pod,
+                        node_name: str, cycle_start: float,
                         pods_to_activate: PodsToActivate, tr=None) -> None:
         """Post-permit half of the binding cycle, dispatched by
         notify_on_permit once the barrier resolves. Re-activates the cycle
@@ -859,47 +1078,92 @@ class Scheduler:
         (and klog/Events here keep the correlation id)."""
         token = trace.activate(tr)
         try:
-            self._finish_binding_traced(permit_status, state, info, assumed,
-                                        node_name, cycle_start,
+            self._finish_binding_traced(permit_status, dispatch_ts, state,
+                                        info, assumed, node_name, cycle_start,
                                         pods_to_activate, tr)
         finally:
             trace.deactivate(token)
 
     def _finish_binding_traced(self, permit_status: Status,
-                               state: CycleState, info: QueuedPodInfo,
+                               dispatch_ts: float, state: CycleState,
+                               info: QueuedPodInfo,
                                assumed: Pod, node_name: str,
                                cycle_start: float,
                                pods_to_activate: PodsToActivate,
                                tr) -> None:
         pod = assumed
         s = permit_status
+        gang = pod_group_full_name(pod) or None
         if tr is not None:
             tr.mark_permit_resolved()
 
-        def fail(outcome: str, status: Status, anomaly: str) -> None:
+        def fail(outcome: str, status: Status, anomaly: str,
+                 to_backoff: bool = False, rollback: bool = False,
+                 **detail) -> None:
+            if rollback:
+                # tell gang-aware Unreserve plugins this failure is an API
+                # outage, not unschedulability: no denial window, the gang
+                # re-admits through pod backoff (GANG_ROLLBACK_STATE_KEY)
+                state.write(GANG_ROLLBACK_STATE_KEY, True)
             if tr is not None:
                 tr.add_anomaly(anomaly, plugin=status.plugin,
-                               message=status.message(), node=node_name)
+                               message=status.message(), node=node_name,
+                               **detail)
                 tr.finish(outcome, status=status, node=node_name)
                 self.recorder.finalize(tr, now=self.clock())
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self._forget_and_signal(pod)
-            self._handle_failure(info, status)
+            self._handle_failure(info, status, to_backoff=to_backoff)
 
         if not s.is_success():
+            if s.plugin == GANG_ROLLBACK_PLUGIN:
+                # a sibling's terminal bind failure rejected this member's
+                # barrier: per-member attribution + straight to backoffQ
+                fail("permit-rejected", s, "gang_bind_rollback",
+                     to_backoff=True, rollback=True, gang=gang,
+                     role="waiting-member")
+                return
             kind = ("permit_timeout" if "timeout" in s.message()
                     else "permit_rejected")
             fail("permit-rejected", s, kind)
             return
+
+        rolled = self._gang_rollback_entry(gang, dispatch_ts)
+        if rolled is not None:
+            fail("bind-failed", self._rollback_status(rolled),
+                 "gang_bind_rollback", to_backoff=True, rollback=True,
+                 gang=gang, trigger_pod=rolled[1], role="sibling")
+            return
         s = self._timed_point("PreBind", self._fw.run_pre_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
-            fail("bind-failed", s, "prebind_failed")
+            fail("bind-failed", s, "prebind_failed", to_backoff=True)
+            return
+        # last look before the commit point: a sibling may have failed
+        # terminally while PreBind ran — binding now would re-open the
+        # partially-bound-gang window the rollback just closed
+        rolled = self._gang_rollback_entry(gang, dispatch_ts)
+        if rolled is not None:
+            fail("bind-failed", self._rollback_status(rolled),
+                 "gang_bind_rollback", to_backoff=True, rollback=True,
+                 gang=gang, trigger_pod=rolled[1], role="sibling")
             return
         s = self._timed_point("Bind", self._fw.run_bind_plugins,
                               state, pod, node_name)
         if not s.is_success():
-            fail("bind-failed", s, "bind_failed")
+            # terminal mid-gang bind failure (the client already burned its
+            # retry budget): roll the WHOLE gang back coherently before
+            # requeueing this member. Guard: a bind that failed because the
+            # pod itself is GONE (deleted mid-flight — the informer no
+            # longer holds it) tears down nothing; its gang needs no
+            # rollback
+            rollback = (gang is not None
+                        and self.informer_factory.pods().get(pod.key)
+                        is not None)
+            if rollback:
+                self._trigger_gang_rollback(gang, pod, node_name, s)
+            fail("bind-failed", s, "bind_failed", to_backoff=True,
+                 rollback=rollback)
             return
         self.cache.finish_binding(pod)
         bind_total.inc()
@@ -915,6 +1179,69 @@ class Scheduler:
             self.recorder.finalize(tr, now=self.clock())
         self._activate_pods(pods_to_activate)
 
+    # -- gang-atomic bind rollback -------------------------------------------
+
+    @staticmethod
+    def _rollback_status(entry: tuple) -> Status:
+        return Status.unschedulable(
+            f"gang bind rollback: member {entry[1]} failed to bind "
+            f"({entry[2]})").with_plugin(GANG_ROLLBACK_PLUGIN)
+
+    def _gang_rollback_entry(self, gang: Optional[str],
+                             dispatch_ts: float) -> Optional[tuple]:
+        """The gang's active rollback entry, if it applies to a binding
+        task dispatched at ``dispatch_ts`` (aborts only reach BACKWARD:
+        tasks of later retry cycles were dispatched after the abort and
+        must proceed)."""
+        if gang is None:
+            return None
+        with self._gang_aborts_lock:
+            entry = self._gang_aborts.get(gang)
+            if entry is not None \
+                    and time.monotonic() - entry[0] > _GANG_ABORT_TTL_S:
+                # expired entries are pruned HERE too (not only when the
+                # next rollback fires), so the registry really does hold
+                # only gangs that failed a bind within the TTL
+                del self._gang_aborts[gang]
+                entry = None
+        if entry is None or entry[0] < dispatch_ts:
+            return None
+        return entry
+
+    def _trigger_gang_rollback(self, gang: str, pod: Pod, node_name: str,
+                               status: Status) -> None:
+        """A member's bind failed terminally: make the whole gang's failure
+        coherent. (1) arm the rollback registry so every sibling task of
+        this burst that has not passed its Bind commit point unreserves +
+        forgets instead of binding; (2) reject siblings still parked at the
+        permit barrier with a structured reason; (3) pin a
+        ``gang_bind_rollback`` anomaly on the triggering cycle's trace.
+        Members already bound stay bound — they count toward quorum when
+        the rolled-back members retry through backoff, so the gang
+        completes once the faults clear instead of wedging half-bound."""
+        now = time.monotonic()
+        with self._gang_aborts_lock:
+            for g, ent in list(self._gang_aborts.items()):
+                if now - ent[0] > _GANG_ABORT_TTL_S:
+                    del self._gang_aborts[g]
+            self._gang_aborts[gang] = (now, pod.key, status.message()[:200])
+        gang_bind_rollbacks.inc()
+        trace.record_anomaly("gang_bind_rollback", gang=gang,
+                             trigger_pod=pod.key, node=node_name,
+                             plugin=status.plugin, role="trigger",
+                             message=status.message())
+        def reject(waiting_pod):
+            # membership via the same derivation coscheduling uses — one
+            # source of truth for "which pods are this gang"
+            if pod_group_full_name(waiting_pod.pod) == gang:
+                waiting_pod.reject(
+                    GANG_ROLLBACK_PLUGIN,
+                    f"gang bind rollback: member {pod.key} failed to bind "
+                    f"({status.message()})")
+        self._fw.iterate_over_waiting_pods(reject)
+        klog.warning_s("gang bind rollback", gang=gang, trigger=pod.key,
+                       node=node_name, reason=status.message())
+
     def _forget_and_signal(self, assumed: Pod) -> None:
         """Forget an assumed pod AND wake unschedulable pods that a pod
         deletion would wake. Releasing a reservation frees the same
@@ -927,16 +1254,24 @@ class Scheduler:
 
     # -- failure path ---------------------------------------------------------
 
-    def _handle_failure(self, info: QueuedPodInfo, status: Status) -> None:
+    def _handle_failure(self, info: QueuedPodInfo, status: Status,
+                        to_backoff: bool = False) -> None:
+        """``to_backoff`` forces backoffQ over unschedulableQ — the bind/
+        rollback failure paths use it because no cluster event ever fires
+        when an apiserver outage clears, so event-driven requeue would
+        strand those pods until the periodic flush."""
         if status.plugin:
             info.unschedulable_plugins.add(status.plugin)
         pod = info.pod
-        live = self.api.try_get(srv.PODS, pod.key)
+        # informer-cache re-read (see _live_pod): the failure path must
+        # never itself fail in a way that loses the pod
+        live = self._live_pod(pod.key)
         if live is None or assigned(live):
             return
         info.pod = live
         self.queue.requeue_after_failure(
-            info, to_backoff=bool(live.status.nominated_node_name),
+            info,
+            to_backoff=to_backoff or bool(live.status.nominated_node_name),
             delay_s=status.retry_after_s)
         self.clientset.record_event(
             pod.key, "Pod", "Warning", "FailedScheduling",

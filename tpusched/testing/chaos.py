@@ -1,0 +1,314 @@
+"""Chaos soak harness: seeded fault injection over a live scheduler with
+invariant checks at every quiesce point.
+
+The complement of tests/test_soak_random.py (adversarial WORKLOAD
+interleavings): here the workload is regular and the ADVERSARY is the API
+server — conflicts, transient unavailability, latency spikes, lost-response
+binds, Event failures and full outages, injected deterministically through
+``apiserver.faults.FaultInjector``. The invariants that must survive any
+fault schedule:
+
+  C1  no pod is ever lost: every created pod still exists and, once the
+      fault phase clears, binds;
+  C2  no pod is ever double-bound (bound → bound-elsewhere transition) or
+      silently unbound (bound → unbound without a delete);
+  C3  gangs stay all-or-nothing at quiescence: after faults clear, every
+      gang is FULLY bound — a terminal mid-gang bind failure rolls the gang
+      back instead of wedging it partially bound;
+  C4  the equivalence-cache differential oracle stays exact throughout
+      (zero placement mismatches while the chaos churns the cursor chain);
+  C5  a total outage trips degraded mode (pop-dispatch pauses) and the
+      scheduler recovers on its own once the API heals.
+
+Shared by tests/test_chaos_soak.py and ``make chaos-smoke`` (which raises
+the cycle floor via CHAOS_SOAK_CYCLES). Failures reproduce from the
+printed seed.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.resources import make_resources
+from ..apiserver import APIServer, FaultInjector, FaultRule
+from ..apiserver import server as srv
+from ..config.types import CoschedulingArgs
+from ..fwk import PluginProfile
+from ..util.metrics import (api_retries, api_retry_exhausted, bind_total,
+                            equiv_cache_differential_mismatches,
+                            gang_bind_rollbacks, schedule_attempts)
+from .cluster import TestCluster, wait_until
+from .wrappers import make_node, make_pod, make_pod_group
+
+
+def chaos_profile(permit_wait_s: float = 3.0,
+                  denied_s: float = 0.3) -> PluginProfile:
+    """Gang profile tuned for fast convergence under injected faults: tiny
+    pod backoffs (retries are the point), the differential oracle ON (every
+    equivalence-cache hit is re-derived and compared, C4), and a quick
+    degraded-mode trip/recovery so C5 is observable in seconds."""
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling"],
+        filter=["NodeUnschedulable", "NodeResourcesFit"],
+        post_filter=["Coscheduling"],
+        reserve=["Coscheduling"],
+        permit=["Coscheduling"],
+        bind=["DefaultBinder"],
+        post_bind=["Coscheduling"],
+        plugin_args={"Coscheduling": CoschedulingArgs(
+            permit_waiting_time_seconds=permit_wait_s,
+            denied_pg_expiration_time_seconds=denied_s)},
+        pod_initial_backoff_s=0.02,
+        pod_max_backoff_s=0.2,
+        equiv_cache_differential=True,
+        degraded_threshold=3,
+        degraded_initial_pause_s=0.05,
+        degraded_max_pause_s=0.5,
+    )
+
+
+class BindTransitionMonitor:
+    """Watches pod MODIFIED events for the C2 transitions no fault schedule
+    may produce: bound → bound-elsewhere (double bind) and bound → unbound
+    (silent unbind). Registered on the REAL store, under the injector."""
+
+    def __init__(self, api: APIServer):
+        self.violations: List[str] = []
+        self._api = api
+        api.add_watch(srv.PODS, self._on_event, replay=False)
+
+    def _on_event(self, ev: srv.WatchEvent) -> None:
+        if ev.type != srv.MODIFIED or ev.old_object is None:
+            return
+        old_node = ev.old_object.spec.node_name
+        new_node = ev.object.spec.node_name
+        if old_node and new_node and old_node != new_node:
+            self.violations.append(
+                f"C2 double-bind: {ev.object.meta.key} "
+                f"{old_node} -> {new_node}")
+        elif old_node and not new_node:
+            self.violations.append(
+                f"C2 silent unbind: {ev.object.meta.key} was on {old_node}")
+
+    def close(self) -> None:
+        self._api.remove_watch(srv.PODS, self._on_event)
+
+
+# Fault phases, rotated per round. Each phase is bounded (probability < 1
+# or max_injections) so the system always converges; the dedicated outage
+# and rollback phases are driven explicitly by run_chaos_soak.
+def _phase_rules(phase: int) -> Tuple[str, List[FaultRule]]:
+    if phase == 0:
+        return "transient-unavailability", [
+            FaultRule(name="blip", verbs=("get", "try_get", "list", "patch",
+                                          "bind", "create"),
+                      error="unavailable", probability=0.12)]
+    if phase == 1:
+        return "conflict-storm", [
+            FaultRule(name="patch-conflict", verbs=("patch",),
+                      error="conflict", probability=0.25),
+            FaultRule(name="slow-bind", verbs=("bind",), error="none",
+                      probability=0.3, latency_s=0.002)]
+    if phase == 2:
+        return "lost-response-binds", [
+            FaultRule(name="bind-timeout", verbs=("bind",),
+                      error="unavailable", after=True, probability=0.3)]
+    if phase == 3:
+        return "notfound-races+event-faults", [
+            FaultRule(name="stale-read", verbs=("try_get",),
+                      error="not_found", probability=0.03),
+            FaultRule(name="event-drop", verbs=("record_event",),
+                      error="unavailable", probability=0.5)]
+    return "healthy", []
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    cycles: int = 0
+    rounds: int = 0
+    binds: int = 0
+    retries: int = 0
+    exhausted: int = 0
+    injections: int = 0
+    rollbacks: int = 0
+    degraded_tripped: bool = False
+    violations: List[str] = field(default_factory=list)
+    phases: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        return (f"seed={self.seed} cycles={self.cycles} rounds={self.rounds} "
+                f"binds={self.binds} retries={self.retries} "
+                f"exhausted={self.exhausted} injections={self.injections} "
+                f"rollbacks={self.rollbacks} "
+                f"degraded={self.degraded_tripped} "
+                f"violations={len(self.violations)}")
+
+
+def run_chaos_soak(seed: int = 20260802, min_cycles: int = 5000,
+                   gangs_per_round: int = 4, members: int = 4,
+                   nodes: int = 8, round_timeout_s: float = 30.0,
+                   max_rounds: int = 1000) -> ChaosReport:
+    """Drive gang workloads through a live scheduler under rotating fault
+    phases until at least ``min_cycles`` scheduling cycles ran, then a
+    forced-rollback round and a total-outage (degraded mode) round; check
+    C1–C5 at every quiesce. Returns the report (violations listed)."""
+    from .. import trace
+
+    report = ChaosReport(seed=seed)
+    api = APIServer()
+    injector = FaultInjector(api, seed=seed)
+    prev_recorder = trace.default_recorder()
+    recorder = trace.install_recorder(trace.FlightRecorder())
+    monitor = BindTransitionMonitor(api)
+    cycles0 = schedule_attempts.value()
+    binds0 = bind_total.value()
+    retries0 = api_retries.value()
+    exhausted0 = api_retry_exhausted.value()
+    mismatch0 = equiv_cache_differential_mismatches.value()
+    rollbacks0 = gang_bind_rollbacks.value()
+
+    cluster = TestCluster(profile=chaos_profile(), api=injector)
+    # fixture writes go to the REAL store: the adversary attacks the
+    # scheduler's traffic, not the test's own arrangement
+    for i in range(nodes):
+        api.create(srv.NODES, make_node(f"chaos-n{i}"))
+    try:
+        cluster.scheduler.run()
+        gen = 0
+        while (schedule_attempts.value() - cycles0 < min_cycles
+               and report.rounds < max_rounds):
+            phase_name, rules = _phase_rules(report.rounds % 5)
+            report.phases.append(phase_name)
+            injector.set_rules(rules)
+            _run_round(api, injector, cluster, report, monitor,
+                       gangs_per_round, members, gen, round_timeout_s)
+            gen += 1
+            report.rounds += 1
+
+        # forced gang rollback: one member's bind fails terminally (outage
+        # outlasting the retry budget), the gang must roll back coherently
+        # and complete once the rule expires (C3 + the rollback anomaly)
+        injector.set_rules([FaultRule(
+            name="terminal-bind", verbs=("bind",), error="unavailable",
+            key_substr=f"g{gen}-0-m0", max_injections=12)])
+        report.phases.append("forced-rollback")
+        _run_round(api, injector, cluster, report, monitor, 1, members,
+                   gen, round_timeout_s)
+        gen += 1
+        report.rounds += 1
+        if gang_bind_rollbacks.value() - rollbacks0 < 1:
+            report.violations.append(
+                "C3: forced terminal bind failure produced no gang rollback")
+
+        # total outage: degraded mode must trip, then self-recover (C5)
+        outage = FaultRule(name="outage", error="unavailable")
+        injector.set_rules([outage])
+        pods = _make_gang(api, f"g{gen}-0", members)
+        if not wait_until(lambda: cluster.scheduler._degraded.active(),
+                          timeout=15.0):
+            report.violations.append("C5: total outage never tripped "
+                                     "degraded mode")
+        else:
+            report.degraded_tripped = True
+        injector.clear()
+        if not wait_until(
+                lambda: not cluster.scheduler._degraded.active(), timeout=10.0):
+            report.violations.append("C5: degraded mode did not recover "
+                                     "after the outage cleared")
+        if not cluster.wait_for_pods_scheduled(pods, timeout=round_timeout_s):
+            report.violations.append(
+                "C5: outage-phase gang did not bind after recovery")
+        _check_gangs_quiesced(api, report)
+        report.rounds += 1
+
+        report.cycles = int(schedule_attempts.value() - cycles0)
+        report.retries = int(api_retries.value() - retries0)
+        report.exhausted = int(api_retry_exhausted.value() - exhausted0)
+        report.rollbacks = int(gang_bind_rollbacks.value() - rollbacks0)
+        report.injections = injector.stats()["injections_total"]
+        report.binds = int(bind_total.value() - binds0)
+        mismatches = equiv_cache_differential_mismatches.value() - mismatch0
+        if mismatches:
+            report.violations.append(
+                f"C4: {int(mismatches)} equivalence-cache differential "
+                "mismatches under chaos")
+        report.violations.extend(monitor.violations)
+    finally:
+        injector.clear()
+        monitor.close()
+        cluster.stop()
+        trace.install_recorder(prev_recorder)
+    return report
+
+
+def _make_gang(api: APIServer, name: str, members: int,
+               cpu: int = 4) -> List[str]:
+    api.create(srv.POD_GROUPS, make_pod_group(name, min_member=members))
+    keys = []
+    for m in range(members):
+        pod = make_pod(f"{name}-m{m}", requests=make_resources(cpu=cpu),
+                       pod_group=name)
+        api.create(srv.PODS, pod)
+        keys.append(pod.key)
+    return keys
+
+
+def _run_round(api: APIServer, injector: FaultInjector,
+               cluster: TestCluster, report: ChaosReport,
+               monitor: BindTransitionMonitor, gangs: int, members: int,
+               gen: int, timeout_s: float) -> None:
+    created: Dict[str, List[str]] = {}
+    for g in range(gangs):
+        name = f"g{gen}-{g}"
+        created[name] = _make_gang(api, name, members)
+    all_keys = [k for keys in created.values() for k in keys]
+    # churn under faults; convergence is NOT required while rules are live
+    cluster.wait_for_pods_scheduled(all_keys, timeout=timeout_s / 2)
+    # faults clear: now every gang MUST complete (C1 + C3)
+    injector.clear()
+    if not cluster.wait_for_pods_scheduled(all_keys, timeout=timeout_s):
+        unbound = [k for k in all_keys if not cluster.pod_scheduled(k)]
+        report.violations.append(
+            f"C1/C3: round gen={gen}: {len(unbound)}/{len(all_keys)} pods "
+            f"never bound after faults cleared: {unbound[:8]}")
+    for key in all_keys:
+        if api.try_get(srv.PODS, key) is None:
+            report.violations.append(f"C1: pod {key} lost from the store")
+    _check_gangs_quiesced(api, report)
+    # cleanup through the raw store (the adversary never attacks fixtures)
+    for name, keys in created.items():
+        for k in keys:
+            try:
+                api.delete(srv.PODS, k)
+            except srv.NotFound:
+                pass
+        try:
+            api.delete(srv.POD_GROUPS, f"default/{name}")
+        except srv.NotFound:
+            pass
+    # let deletion churn settle so the next round starts from empty nodes
+    wait_until(lambda: not api.list(srv.PODS), timeout=5.0)
+
+
+def _check_gangs_quiesced(api: APIServer, report: ChaosReport) -> None:
+    """C3 at quiescence: every PodGroup present in the store is
+    all-or-nothing — fully bound or fully unbound."""
+    from ..api.scheduling import POD_GROUP_LABEL
+    groups: Dict[str, List] = {}
+    for p in api.list(srv.PODS):
+        gang = p.meta.labels.get(POD_GROUP_LABEL)
+        if gang:
+            groups.setdefault(f"{p.meta.namespace}/{gang}", []).append(p)
+    for full, pods in groups.items():
+        bound = sum(1 for p in pods if p.spec.node_name)
+        if 0 < bound < len(pods):
+            report.violations.append(
+                f"C3: gang {full} partially bound at quiescence: "
+                f"{bound}/{len(pods)}")

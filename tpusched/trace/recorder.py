@@ -47,6 +47,11 @@ class FlightRecorder:
         self._committed = 0
         self._evicted = 0
         self.gangs = GangBook()
+        # operator-facing health facts (degraded-mode state, fault-injector
+        # stats in chaos runs): tiny dicts keyed by component, replaced
+        # wholesale on every transition so /debug/flightrecorder always
+        # shows current state even when no cycle is running
+        self._health: Dict[str, Dict[str, Any]] = {}
 
     # -- trace lifecycle ------------------------------------------------------
 
@@ -152,6 +157,17 @@ class FlightRecorder:
             self._ring_bytes -= entry[1]
             self._evicted += 1
 
+    def set_health(self, component: str, state: Optional[Dict[str, Any]]) -> None:
+        """Publish (or clear, with None) a component's health facts into
+        the /debug/flightrecorder dump — the scheduler's degraded mode
+        reports its transitions here so an operator sees WHY pop-dispatch
+        paused without correlating metrics first."""
+        with self._lock:
+            if state is None:
+                self._health.pop(component, None)
+            else:
+                self._health[component] = dict(state)
+
     # -- views (the /debug surface) ------------------------------------------
 
     def traces(self) -> List[CycleTrace]:
@@ -192,8 +208,11 @@ class FlightRecorder:
     def dump(self) -> Dict[str, Any]:
         """The full /debug/flightrecorder payload: a wedged gang must be
         explainable from this one document."""
+        with self._lock:
+            health = {k: dict(v) for k, v in self._health.items()}
         return {
             "stats": self.stats(),
+            "health": health,
             "cycles": self.cycles(),
             "pinned": self.pinned_dump(),
             "gangs": self.gangs.dump(),

@@ -286,6 +286,30 @@ queue_wait_seconds = REGISTRY.histogram(
 flight_recorder_anomalies = REGISTRY.counter(
     "tpusched_flight_recorder_anomalies_total",
     "Cycle traces pinned by the flight recorder as anomalies.")
+# API-failure resilience (apiserver/client.py retry layer + the scheduler's
+# degraded mode). retries counts every re-attempt the client made after a
+# retriable failure; retry_exhausted counts calls that failed terminally
+# AFTER burning their retry budget (each of these also feeds the scheduler's
+# degraded-mode trip counter). events_dropped counts Event emissions
+# swallowed by the best-effort recorder path — an Event must never fail a
+# scheduling/binding cycle. gang_bind_rollbacks counts whole-PodGroup
+# rollbacks triggered by a terminal mid-gang bind failure (each one also
+# pins a gang_bind_rollback anomaly trace in the flight recorder).
+# tpusched_degraded_mode itself is a per-scheduler gauge_func registered by
+# the Scheduler (0 = normal, 1 = pop-dispatch paused).
+api_retries = REGISTRY.counter(
+    "tpusched_api_retries_total",
+    "API calls re-attempted after a retriable failure.")
+api_retry_exhausted = REGISTRY.counter(
+    "tpusched_api_retry_exhausted_total",
+    "API calls that failed terminally after exhausting their retry budget.")
+events_dropped = REGISTRY.counter(
+    "tpusched_events_dropped_total",
+    "Best-effort Event emissions swallowed instead of raised into a cycle.")
+gang_bind_rollbacks = REGISTRY.counter(
+    "tpusched_gang_bind_rollbacks_total",
+    "Whole-gang rollbacks after a terminal mid-gang bind failure.")
+
 # Upstream framework_extension_point_duration_seconds analog. Deliberate
 # divergence: the per-node Filter/Score sweeps are recorded once per CYCLE
 # (the whole sweep), not once per node — at 1024-host scale a per-node
