@@ -45,10 +45,15 @@ trace-smoke:
 # lost-response binds, a forced terminal mid-gang bind failure and a total
 # outage — asserting the C1–C5 invariants (no pod lost, no double-bind,
 # gangs all-or-nothing at quiescence, differential oracle exact, degraded
-# mode trips + recovers). See tpusched/testing/chaos.py.
+# mode trips + recovers) — PLUS a ≥5k-cycle seeded node-churn soak where
+# the HARDWARE misbehaves (heartbeat loss, node kills with bound gang
+# members, cordon storms, flapping Ready) asserting C6: no gang ever
+# wedges — every gang losing a node re-reaches Bound on healthy hardware.
+# See tpusched/testing/chaos.py.
 .PHONY: chaos-smoke
 chaos-smoke:
-	env JAX_PLATFORMS=cpu CHAOS_SOAK_CYCLES=5000 $(PY) -m pytest \
+	env JAX_PLATFORMS=cpu CHAOS_SOAK_CYCLES=5000 \
+		CHAOS_NODE_CHURN_CYCLES=5000 $(PY) -m pytest \
 		tests/test_chaos_soak.py -q -p no:cacheprovider
 
 # The ROADMAP tier-1 suite (the merge gate): full tests/ minus slow marks,
@@ -69,11 +74,17 @@ native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
 
 .PHONY: verify
-verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls
+verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls verify-node-health-filters
 
 .PHONY: verify-naked-api-calls
 verify-naked-api-calls:
 	hack/verify-naked-api-calls.sh
+
+# Every placement-producing Filter must consult node readiness
+# (api.core.node_health_error): no plugin may admit a NotReady node.
+.PHONY: verify-node-health-filters
+verify-node-health-filters:
+	hack/verify-node-health-filters.sh
 
 .PHONY: verify-kustomize
 verify-kustomize:

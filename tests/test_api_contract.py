@@ -212,6 +212,8 @@ def test_bind_subresource_rejects_double_bind():
     """Upstream: pods/binding on an already-bound pod fails (the scheduler
     cache's assume/confirm machinery relies on exactly this)."""
     api = APIServer()
+    api.create(srv.NODES, make_node("n1"))
+    api.create(srv.NODES, make_node("n2"))
     api.create(srv.PODS, make_pod("p"))
     api.bind(Binding(pod_key="default/p", node_name="n1"))
     with pytest.raises(srv.Conflict):

@@ -7,7 +7,7 @@ import pytest
 
 from tpusched.api.core import Binding
 from tpusched.apiserver import server as srv
-from tpusched.testing import make_pod
+from tpusched.testing import make_node, make_pod
 
 
 def test_create_conflict_and_get_notfound():
@@ -77,6 +77,8 @@ def test_list_namespace_filter():
 
 def test_bind_sets_node_and_conflicts_when_rebinding():
     api = srv.APIServer()
+    api.create(srv.NODES, make_node("n1"))
+    api.create(srv.NODES, make_node("n2"))
     api.create(srv.PODS, make_pod("p"))
     api.bind(Binding(pod_key="default/p", node_name="n1",
                      annotations={"chip": "0"}))

@@ -47,3 +47,34 @@ def test_chaos_soak_alternate_seed_quick():
     report = run_chaos_soak(seed=7, min_cycles=400, gangs_per_round=3,
                             members=3, nodes=6)
     assert report.ok, "\n".join([report.summary()] + report.violations)
+
+
+# In-suite floor for the node-churn soak (hardware-as-adversary): every
+# node fault phase — heartbeat loss, node kill with bound gang members,
+# cordon storm, flapping Ready, API blips — at least once, without paying
+# the full 5k soak twice per `make tier1` (chaos-smoke runs it at
+# CHAOS_NODE_CHURN_CYCLES=5000).
+DEFAULT_CHURN_CYCLES = 150
+
+
+def test_node_churn_soak_no_wedged_gangs():
+    """C6: under node churn every gang that loses hardware re-reaches
+    fully-Bound on existing, Ready nodes (or a clean terminal phase) —
+    never a permanent wedge — while C1/C2/C3 keep holding."""
+    from tpusched.testing import run_node_churn_soak
+
+    min_cycles = int(os.environ.get("CHAOS_NODE_CHURN_CYCLES",
+                                    DEFAULT_CHURN_CYCLES))
+    report = run_node_churn_soak(seed=SEED, min_cycles=min_cycles)
+    print(report.summary())          # -s / failure output: the repro line
+    assert report.cycles >= min_cycles, report.summary()
+    # the adversary showed up: nodes died, pods were evicted, gangs were
+    # actually repaired — not a quiet run that proved nothing
+    assert report.node_kills >= 1, report.summary()
+    assert report.not_ready_transitions >= 1, report.summary()
+    assert report.evictions >= 1, report.summary()
+    assert report.repairs >= 1, report.summary()
+    # every phase ran at least once (5-round floor), incl. api-blips
+    assert report.rounds >= 5, report.summary()
+    assert report.injections >= 1, report.summary()
+    assert report.ok, "\n".join([report.summary()] + report.violations)

@@ -1,6 +1,7 @@
 """Informer indexers (client-go cache.Indexers analog): bucket membership
 tracks adds/updates/deletes, including label changes that move an object
-between buckets."""
+between buckets — plus the delete-race tolerance and relist/resync
+contracts the node-failure pipeline leans on."""
 from __future__ import annotations
 
 from tpusched.api.scheduling import (POD_GROUP_INDEX, POD_GROUP_LABEL,
@@ -152,3 +153,68 @@ def test_stopped_scheduler_stops_consuming_events():
         assert len(api._handlers[srv.PODS]) == live
     finally:
         s2.stop()
+
+
+# -- delete-race tolerance + relist/resync ------------------------------------
+
+def test_deleted_event_for_unknown_key_is_tolerated():
+    """A DELETED for a key the informer never cached (replay race: the
+    object was created+deleted around add_watch's snapshot) must not
+    throw, must not corrupt indexes, and must still fan out to delete
+    handlers (client-go DeletedFinalStateUnknown analog)."""
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+    deletes = []
+    informer.add_event_handler(on_delete=deletes.append)
+
+    ghost = make_pod("ghost", labels={POD_GROUP_LABEL: "g1"})
+    informer._handle(srv.WatchEvent(srv.DELETED, srv.PODS, ghost))
+    assert [p.meta.key for p in deletes] == ["default/ghost"]
+    assert informer.get("default/ghost") is None
+    assert keys(informer, "default/g1") == []
+
+    # the informer keeps working normally afterwards, indexes consistent
+    api.create(srv.PODS, make_pod("real", labels={POD_GROUP_LABEL: "g1"}))
+    assert keys(informer, "default/g1") == ["default/real"]
+    api.delete(srv.PODS, "default/real")
+    assert keys(informer, "default/g1") == []
+
+
+def test_resync_reconciles_missed_events():
+    """Relist/resync (reconnect-after-missed-events): an informer whose
+    cache drifted from the store — missed add, missed update, missed
+    delete — converges on resync(), with handler deliveries and index
+    maintenance exactly as a live watch would have produced."""
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+    api.create(srv.PODS, make_pod("keep", labels={POD_GROUP_LABEL: "g1"}))
+    api.create(srv.PODS, make_pod("stale", labels={POD_GROUP_LABEL: "g1"}))
+    api.create(srv.PODS, make_pod("doomed", labels={POD_GROUP_LABEL: "g2"}))
+
+    # simulate a disconnected window: mutate the store behind the
+    # informer's back by detaching its watch first
+    api.remove_watch(srv.PODS, informer._handle)
+    api.delete(srv.PODS, "default/doomed")
+    api.patch(srv.PODS, "default/stale",
+              lambda p: p.meta.labels.update({POD_GROUP_LABEL: "g2"}))
+    api.create(srv.PODS, make_pod("born", labels={POD_GROUP_LABEL: "g2"}))
+
+    # drifted: the informer still sees the old world
+    assert informer.get("default/doomed") is not None
+    assert informer.get("default/born") is None
+
+    adds, updates, deletes = [], [], []
+    informer.add_event_handler(on_add=adds.append, replay=False,
+                               on_update=lambda o, n: updates.append((o, n)),
+                               on_delete=deletes.append)
+    informer.resync()
+
+    assert [p.meta.key for p in adds] == ["default/born"]
+    assert [(o.meta.key, n.meta.labels[POD_GROUP_LABEL])
+            for o, n in updates] == [("default/stale", "g2")]
+    assert [p.meta.key for p in deletes] == ["default/doomed"]
+    assert informer.get("default/doomed") is None
+    assert keys(informer, "default/g1") == ["default/keep"]
+    assert keys(informer, "default/g2") == ["default/born", "default/stale"]

@@ -105,6 +105,7 @@ def test_bind_lost_response_heals_on_retry():
     api = APIServer()
     inj = FaultInjector(api, seed=3)
     cs = Clientset(inj, retry=FAST_RETRY)
+    api.create(srv.NODES, make_node("n1"))
     api.create(srv.PODS, make_pod("b1"))
     inj.add_rule(FaultRule(verbs=("bind",), error="unavailable", after=True,
                            max_injections=1))
@@ -118,6 +119,8 @@ def test_bind_genuine_conflict_stays_terminal():
     semantic conflict is not an apiserver outage)."""
     api = APIServer()
     cs = Clientset(api, retry=FAST_RETRY)
+    api.create(srv.NODES, make_node("other"))
+    api.create(srv.NODES, make_node("mine"))
     api.create(srv.PODS, make_pod("b2"))
     api.bind(Binding(pod_key="default/b2", node_name="other", annotations={}))
     retries_before = api_retries.value()
@@ -457,4 +460,199 @@ def test_gang_rollback_skipped_for_singletons():
         assert c.wait_for_pods_scheduled([p.key], timeout=20.0)
         assert gang_bind_rollbacks.value() == before
     finally:
+        c.stop()
+
+
+# -- node-death windows (node dies before permit / pre-bind / post-bind) ------
+
+def _set_barrier_profile():
+    """Gang + multislice-set profile: the set barrier is the only permit
+    state that parks indefinitely with every member pod present — the
+    stable "before permit resolves" window a node death can race."""
+    from tpusched.config.types import MultiSliceArgs
+    return PluginProfile(
+        queue_sort="Coscheduling",
+        pre_filter=["Coscheduling", "MultiSlice"],
+        filter=["NodeUnschedulable", "NodeResourcesFit", "MultiSlice"],
+        post_filter=["Coscheduling", "MultiSlice"],
+        reserve=["Coscheduling", "MultiSlice"],
+        permit=["Coscheduling", "MultiSlice"],
+        bind=["DefaultBinder"],
+        post_bind=["Coscheduling"],
+        plugin_args={
+            "Coscheduling": CoschedulingArgs(
+                permit_waiting_time_seconds=20,
+                denied_pg_expiration_time_seconds=0.1),
+            "MultiSlice": MultiSliceArgs(
+                set_schedule_timeout_seconds=20,
+                denied_set_expiration_time_seconds=0.2)},
+        pod_initial_backoff_s=0.02, pod_max_backoff_s=0.2,
+        stuck_gang_after_s=2.0, stuck_gang_sweep_interval_s=0.2,
+    )
+
+
+def test_node_dies_before_permit_resolves():
+    """Window (a): the node dies while gang members sit at the permit
+    barrier. Gang-atomic outcome: the parked members' reservations are
+    released (none may proceed to bind on the vanished node) and the whole
+    set later binds on healthy hardware only."""
+    from tpusched.testing.chaos import BindTransitionMonitor
+
+    api = APIServer()
+    monitor = BindTransitionMonitor(api)
+    c = TestCluster(profile=_set_barrier_profile(), api=api)
+    try:
+        c.scheduler.run()
+        api.create(srv.NODES, make_node("doomed"))
+        for idx in range(2):
+            api.create(srv.POD_GROUPS, make_pod_group(
+                f"w-{idx}", min_member=2, multislice_set="w",
+                multislice_index=idx, multislice_set_size=2))
+        for m in range(2):
+            api.create(srv.PODS, make_pod(
+                f"w-0-m{m}", pod_group="w-0",
+                requests=make_resources(cpu=2)))
+        # slice w-1 unfittable for now: w-0 parks at the set barrier
+        for m in range(2):
+            api.create(srv.PODS, make_pod(
+                f"w-1-m{m}", pod_group="w-1",
+                requests=make_resources(cpu=900)))
+        assert wait_until(
+            lambda: c.scheduler.cache.snapshot().assigned_count(
+                "w-0", "default") == 2, timeout=10.0)
+
+        api.delete(srv.NODES, "/doomed")        # the window slams shut
+        assert wait_until(
+            lambda: c.scheduler.cache.snapshot().assigned_count(
+                "w-0", "default") == 0, timeout=10.0)
+        # none of the parked members ever bound anywhere (gang-atomic)
+        assert all(not (api.peek(srv.PODS, f"default/w-0-m{m}") or
+                        make_pod("x")).spec.node_name for m in range(2))
+
+        api.create(srv.NODES, make_node("healthy"))
+        for m in range(2):
+            api.delete(srv.PODS, f"default/w-1-m{m}")
+            api.create(srv.PODS, make_pod(
+                f"w-1r-m{m}", pod_group="w-1",
+                requests=make_resources(cpu=2)))
+        keys = [f"default/w-0-m{m}" for m in range(2)] + \
+               [f"default/w-1r-m{m}" for m in range(2)]
+        assert c.wait_for_pods_scheduled(keys, timeout=20.0), \
+            [k for k in keys if not c.pod_scheduled(k)]
+        assert all(c.pod(k).spec.node_name == "healthy" for k in keys)
+        assert not monitor.violations, monitor.violations
+    finally:
+        monitor.close()
+        c.stop()
+
+
+def test_node_dies_between_permit_and_bind():
+    """Window (b): permit resolved, binds in flight, node deleted. The
+    bind's terminal NotFound (node gone) triggers PR 3's gang-atomic
+    rollback registry; the whole gang re-admits and binds on the healthy
+    node."""
+    from tpusched.testing.chaos import BindTransitionMonitor
+
+    api = APIServer()
+    inj = FaultInjector(api, seed=13)
+    monitor = BindTransitionMonitor(api)
+    c = TestCluster(profile=_gang_profile(), api=inj)
+    rollbacks0 = gang_bind_rollbacks.value()
+    try:
+        c.scheduler.run()
+        # name order makes z-doomed the argmax host while it exists
+        api.create(srv.NODES, make_node("a-fresh"))
+        api.create(srv.NODES, make_node("z-doomed"))
+        # every bind fails retriably: the gang parks IN the permit→bind
+        # window (permit resolved, bind not committed)
+        inj.add_rule(FaultRule(name="bind-wedge", verbs=("bind",),
+                               error="unavailable"))
+        api.create(srv.POD_GROUPS, make_pod_group("wb", min_member=3))
+        keys = []
+        for m in range(3):
+            p = make_pod(f"wb-m{m}", requests=make_resources(cpu=2),
+                         pod_group="wb")
+            api.create(srv.PODS, p)
+            keys.append(p.key)
+        assert wait_until(
+            lambda: inj.stats()["injections_total"] >= 2, timeout=10.0)
+        api.delete(srv.NODES, "/z-doomed")      # inside the window
+        inj.clear()
+        # terminal NotFound (vanished node) → whole-gang rollback → the
+        # gang re-admits and completes on the healthy node
+        assert c.wait_for_pods_scheduled(keys, timeout=30.0), \
+            [k for k in keys if not c.pod_scheduled(k)]
+        assert all(c.pod(k).spec.node_name == "a-fresh" for k in keys)
+        assert gang_bind_rollbacks.value() - rollbacks0 >= 1
+        assert not monitor.violations, monitor.violations
+    finally:
+        monitor.close()
+        c.stop()
+        inj.clear()
+
+
+def test_node_dies_after_partial_bind():
+    """Window (c): part of the gang is already bound when the node dies.
+    The lifecycle controller orphan-GCs the dead node's members, the gang
+    repair controller evicts the survivor and recreates the gang
+    (restart-gang), and the gang re-reaches fully-Bound on healthy nodes."""
+    from tpusched.controllers import (GangRepairController,
+                                      NodeLifecycleController)
+    from tpusched.testing.chaos import BindTransitionMonitor
+    from tpusched.util.metrics import gang_repairs
+
+    api = APIServer()
+    monitor = BindTransitionMonitor(api)
+    c = TestCluster(profile=_gang_profile(), api=api)
+    lifecycle = NodeLifecycleController(api, heartbeat_grace_s=5.0,
+                                        pod_eviction_grace_s=5.0,
+                                        sweep_interval_s=0.05)
+    repair = GangRepairController(api, cooldown_s=0.05)
+    repairs0 = gang_repairs.value()
+    try:
+        c.scheduler.run()
+        lifecycle.run()
+        repair.run()
+        # z-big fits two members, a-small one: deterministic 2+1 split
+        api.create(srv.NODES, make_node(
+            "z-big", capacity=make_resources(cpu=17, pods=10)))
+        api.create(srv.NODES, make_node(
+            "a-small", capacity=make_resources(cpu=9, pods=10)))
+        api.create(srv.POD_GROUPS, make_pod_group("wc", min_member=3))
+        keys = []
+        for m in range(3):
+            p = make_pod(f"wc-m{m}", requests=make_resources(cpu=8),
+                         pod_group="wc")
+            api.create(srv.PODS, p)
+            keys.append(p.key)
+        assert c.wait_for_pods_scheduled(keys, timeout=20.0)
+        split = {c.pod(k).spec.node_name for k in keys}
+        assert split == {"z-big", "a-small"}
+
+        # replacement capacity, then the kill: two bound members orphaned
+        api.create(srv.NODES, make_node(
+            "m-replacement", capacity=make_resources(cpu=17, pods=10)))
+        api.delete(srv.NODES, "/z-big")
+
+        # orphan GC + restart-gang repair: every member re-reaches Bound on
+        # nodes that exist (gang-atomic — the survivor restarted too)
+        def settled():
+            for k in keys:
+                p = api.peek(srv.PODS, k)
+                if p is None or not p.spec.node_name:
+                    return False
+                if api.peek(srv.NODES, "/" + p.spec.node_name) is None:
+                    return False
+            return True
+        assert wait_until(settled, timeout=30.0), \
+            {k: getattr(api.peek(srv.PODS, k), "spec", None) and
+             api.peek(srv.PODS, k).spec.node_name for k in keys}
+        assert gang_repairs.value() - repairs0 >= 1
+        assert all(c.pod(k).spec.node_name in ("a-small", "m-replacement")
+                   for k in keys)
+        assert not monitor.violations, monitor.violations
+    finally:
+        monitor.close()
+        for ctrl in (lifecycle, repair):
+            ctrl.stop()
         c.stop()

@@ -154,10 +154,35 @@ class Pod:
         return self.meta.deletion_timestamp is not None
 
 
+# -- Node conditions (v1.NodeCondition, the slice node-health needs) ----------
+NODE_READY = "Ready"
+
+# Taint the node lifecycle controller places on NotReady nodes (analog of
+# k8s node.kubernetes.io/not-ready). Placement-producing Filters also consult
+# the Ready condition directly (node_health_error), so the taint is the
+# operator-visible artifact, not the only line of defense.
+TAINT_NODE_NOT_READY = "node.tpu.dev/not-ready"
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
 @dataclass
 class NodeStatus:
     capacity: ResourceList = field(default_factory=dict)
     allocatable: ResourceList = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    # Last kubelet heartbeat (epoch seconds). None = the node is not
+    # heartbeat-managed (fixture/legacy nodes): the lifecycle controller
+    # never marks such nodes NotReady, which keeps every pre-existing test
+    # fleet implicitly healthy.
+    last_heartbeat_time: Optional[float] = None
 
 
 @dataclass
@@ -184,8 +209,57 @@ class Node:
             meta=self.meta.deepcopy(),
             spec=NodeSpec(unschedulable=self.spec.unschedulable,
                           taints=[replace(t) for t in self.spec.taints]),
-            status=NodeStatus(capacity=dict(self.status.capacity),
-                              allocatable=dict(self.status.allocatable)))
+            status=NodeStatus(
+                capacity=dict(self.status.capacity),
+                allocatable=dict(self.status.allocatable),
+                conditions=[replace(c) for c in self.status.conditions],
+                last_heartbeat_time=self.status.last_heartbeat_time))
+
+    def ready_condition(self) -> Optional[NodeCondition]:
+        for c in self.status.conditions:
+            if c.type == NODE_READY:
+                return c
+        return None
+
+    def set_condition(self, ctype: str, status: str, reason: str = "",
+                      message: str = "", now: float = 0.0) -> bool:
+        """Upsert a condition; last_transition_time moves only on a status
+        flip (k8s semantics). Returns True if the status actually changed."""
+        for c in self.status.conditions:
+            if c.type == ctype:
+                changed = c.status != status
+                if changed:
+                    c.last_transition_time = now
+                c.status, c.reason, c.message = status, reason, message
+                return changed
+        self.status.conditions.append(NodeCondition(
+            type=ctype, status=status, reason=reason, message=message,
+            last_transition_time=now))
+        return True
+
+
+def node_ready(node: Node) -> bool:
+    """Ready unless an explicit Ready=False condition says otherwise — an
+    absent condition means a legacy/fixture node that predates the health
+    model, and those must keep scheduling."""
+    c = node.ready_condition()
+    return c is None or c.status == "True"
+
+
+def node_health_error(node: Node) -> Optional[str]:
+    """Why this node must not receive NEW placements, or None if healthy.
+    The single helper every placement-producing Filter consults
+    (hack/verify-node-health-filters.sh lints for it): unschedulable spec,
+    a NotReady condition, or the lifecycle controller's not-ready taint."""
+    if node.spec.unschedulable:
+        return "node(s) were unschedulable"
+    if not node_ready(node):
+        return "node(s) were NotReady"
+    for t in node.spec.taints:
+        if t.key == TAINT_NODE_NOT_READY and t.effect in ("NoSchedule",
+                                                          "NoExecute"):
+            return "node(s) had the not-ready taint"
+    return None
 
 
 @dataclass

@@ -186,9 +186,13 @@ class _KindClient:
         return self._invoke("patch", key,
                             lambda: self._api.patch(self._kind, key, mutate))
 
-    def delete(self, key: str):
+    def delete(self, key: str, uid: Optional[str] = None):
+        """``uid``: precondition the delete on the observed object instance
+        (DeleteOptions.Preconditions.UID) — a stale sweep must not kill a
+        same-name replacement. Conflict on mismatch, terminal by taxonomy."""
         return self._invoke("delete", key,
-                            lambda: self._api.delete(self._kind, key))
+                            lambda: self._api.delete(self._kind, key,
+                                                     uid=uid))
 
 
 class _PodClient(_KindClient):
@@ -225,6 +229,21 @@ class _PodClient(_KindClient):
                             lambda: self._api.bind(binding), heal=heal)
 
 
+class _NodeClient(_KindClient):
+    def heartbeat(self, name: str, now: Optional[float] = None):
+        """The kubelet heartbeat (Lease-renewal analog): stamp
+        ``status.last_heartbeat_time``. Goes through the normal retry
+        layer — a node agent keeps heartbeating through transient apiserver
+        blips; the lifecycle controller's grace period absorbs the rest.
+        Both Ready transitions (condition + taint) stay with the lifecycle
+        controller, so exactly one component owns the node-health edges."""
+        ts = time.time() if now is None else now
+
+        def mutate(node):
+            node.status.last_heartbeat_time = ts
+        return self.patch(f"/{name}" if "/" not in name else name, mutate)
+
+
 class _Hooks:
     """Caller-observable retry outcomes (degraded-mode feed). on_success is
     called on EVERY successful API call — keep implementations O(1)."""
@@ -246,7 +265,7 @@ class Clientset:
                  if (on_retry_exhausted or on_success) else _NO_HOOKS)
         self.api = api
         self.pods = _PodClient(api, srv.PODS, bucket, retry, hooks)
-        self.nodes = _KindClient(api, srv.NODES, bucket, retry, hooks)
+        self.nodes = _NodeClient(api, srv.NODES, bucket, retry, hooks)
         self.podgroups = _KindClient(api, srv.POD_GROUPS, bucket, retry, hooks)
         self.elasticquotas = _KindClient(api, srv.ELASTIC_QUOTAS, bucket,
                                          retry, hooks)

@@ -183,9 +183,9 @@ class FaultInjector:
         return self._call("patch", kind, key,
                           lambda: self._api.patch(kind, key, mutate))
 
-    def delete(self, kind: str, key: str) -> None:
+    def delete(self, kind: str, key: str, uid=None) -> None:
         return self._call("delete", kind, key,
-                          lambda: self._api.delete(kind, key))
+                          lambda: self._api.delete(kind, key, uid=uid))
 
     def bind(self, binding) -> None:
         return self._call("bind", srv.PODS, binding.pod_key,

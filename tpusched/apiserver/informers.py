@@ -48,6 +48,13 @@ class Informer:
         key = ev.object.meta.key
         with self._lock:
             if ev.type == srv.DELETED:
+                # A DELETED for a key this informer never saw (replay race:
+                # the object was created and deleted around add_watch's
+                # replay snapshot, or a resync dropped it first) is
+                # TOLERATED: indexes are keyed off the cached object, so an
+                # absent entry means nothing to unindex — the event still
+                # fans out to handlers (client-go's DeletedFinalStateUnknown
+                # analog; handlers must be delete-idempotent).
                 old = self._cache.pop(key, None)
                 if old is not None:
                     self._index_remove(old)
@@ -148,6 +155,13 @@ class Informer:
             bucket = self._indexes[name].get(value)
             return list(bucket.values()) if bucket else []
 
+    def index_values(self, name: str) -> List[str]:
+        """The distinct values of index `name` currently holding objects —
+        O(buckets). Lets a sweep visit only populated groups (e.g. the
+        node-lifecycle orphan GC walks bound-to node names, not all pods)."""
+        with self._lock:
+            return list(self._indexes[name])
+
     def get(self, key: str):
         with self._lock:
             return self._cache.get(key)
@@ -164,6 +178,57 @@ class Informer:
 
     def has_synced(self) -> bool:
         return True  # in-memory watches are synchronous
+
+    def resync(self) -> None:
+        """Relist-and-diff (client-go's reconnect/resync after missed watch
+        events): pull the authoritative list from the API server, reconcile
+        the local cache + indexes, and synthesize the handler deliveries a
+        live watch would have made — Added for objects the cache never saw,
+        Modified for resourceVersion drift, Deleted for objects the server
+        no longer has. Handlers observe at-least-once semantics exactly as
+        with live events. The in-memory watch fan-out cannot actually drop
+        events, but HA fail-over and kube-backed deployments re-attach
+        informers to servers whose history they missed — this is their
+        catch-up path.
+
+        The list AND the reconcile run under the informer lock: a live
+        watch delivery racing the relist would otherwise be overwritten by
+        the (already stale) listed copy, or a just-created object evicted
+        with a spurious Deleted. A concurrent _handle blocks until the
+        reconcile commits, then applies on top — its object is never older
+        than the list (the server dispatches synchronously after commit).
+        Lock order informer→store matches _handle's (the store lock is
+        released before watch dispatch). Handler fan-out happens after the
+        lock drops, exactly as _handle does."""
+        added, updated, deleted = [], [], []
+        with self._lock:
+            live = {o.meta.key: o for o in self._api.list(self.kind)}
+            for key, obj in live.items():
+                old = self._cache.get(key)
+                if old is None:
+                    added.append(obj)
+                elif old.meta.resource_version != obj.meta.resource_version:
+                    updated.append((old, obj))
+            for key, old in list(self._cache.items()):
+                if key not in live:
+                    deleted.append(old)
+            for old, obj in updated:
+                self._index_remove(old)
+            for old in deleted:
+                self._index_remove(old)
+                del self._cache[old.meta.key]
+            for obj in added + [o for _, o in updated]:
+                self._cache[obj.meta.key] = obj
+                self._index_insert(obj)
+        for obj in added:
+            for h in list(self._on_add):
+                self._dispatch(h, obj)
+        for old, obj in updated:
+            for h in list(self._on_update):
+                self._dispatch(h, old, obj)
+        for old in deleted:
+            for h in list(self._on_delete):
+                self._dispatch(h, old)
 
     def close(self) -> None:
         """Detach from the API server's watch fan-out and drop handlers —

@@ -640,17 +640,21 @@ class KubeAPIServer:
             return updated
         raise Conflict(f"{kind} {key}: patch kept conflicting: {last}")
 
-    def delete(self, kind: str, key: str) -> None:
+    def delete(self, kind: str, key: str, uid=None) -> None:
         """DELETE with the in-memory server's semantics: pods go with
         gracePeriodSeconds=0 (a real apiserver's default 30 s grace would
         leave the pod Terminating, and this stack's delete-then-recreate
         flows — defrag migration, soak churn — would 409 on the recreate),
         and the cache entry is evicted immediately for read-your-writes
         symmetry with ``_observe_write`` (idempotent against the DELETED
-        watch event that follows)."""
+        watch event that follows). ``uid`` maps onto
+        deleteOptions.preconditions.uid (the real apiserver enforces it)."""
         info = codec.KINDS[kind]
         body = ({"kind": "DeleteOptions", "apiVersion": "v1",
                  "gracePeriodSeconds": 0} if kind == srv.PODS else None)
+        if uid is not None:
+            body = dict(body or {"kind": "DeleteOptions", "apiVersion": "v1"})
+            body["preconditions"] = {"uid": uid}
         self._tx.request("DELETE", info.object_path(key), body)
         with self._lock:
             self._cache[kind].pop(key, None)

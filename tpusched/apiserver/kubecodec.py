@@ -25,8 +25,9 @@ from __future__ import annotations
 import datetime as _dt
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..api.core import (Binding, Container, Node, NodeSpec, NodeStatus, Pod,
-                        PodCondition, PodDisruptionBudget, PodSpec, PodStatus,
+from ..api.core import (Binding, Container, NODE_READY, Node, NodeCondition,
+                        NodeSpec, NodeStatus, Pod, PodCondition,
+                        PodDisruptionBudget, PodSpec, PodStatus,
                         PriorityClass, Taint, Toleration)
 from ..api.meta import ObjectMeta, OwnerReference
 from ..api.resources import CPU, ResourceList, parse_quantity
@@ -241,17 +242,56 @@ def encode_node(n: Node) -> Dict[str, Any]:
     if n.spec.taints:
         spec["taints"] = [{"key": t.key, "value": t.value, "effect": t.effect}
                           for t in n.spec.taints]
+    status: Dict[str, Any] = {
+        "capacity": encode_resources(n.status.capacity) or {},
+        "allocatable": encode_resources(n.status.allocatable) or {}}
+    # node health model: conditions round-trip as v1.NodeCondition; the
+    # node-level heartbeat stamp rides the Ready condition's
+    # lastHeartbeatTime (where the real kubelet keeps it)
+    conditions: List[Dict[str, Any]] = []
+    hb = encode_time(n.status.last_heartbeat_time, micro=True)
+    for c in n.status.conditions:
+        cd: Dict[str, Any] = {"type": c.type, "status": c.status}
+        if c.reason:
+            cd["reason"] = c.reason
+        if c.message:
+            cd["message"] = c.message
+        lt = encode_time(c.last_transition_time, micro=True)
+        if lt:
+            cd["lastTransitionTime"] = lt
+        if c.type == NODE_READY and hb:
+            cd["lastHeartbeatTime"] = hb
+        conditions.append(cd)
+    if hb and not any(c.type == NODE_READY for c in n.status.conditions):
+        # heartbeat-managed node with no Ready condition written yet:
+        # synthesize the carrier so the stamp survives (decode treats a
+        # Ready=True condition identically to an absent one)
+        conditions.append({"type": NODE_READY, "status": "True",
+                           "lastHeartbeatTime": hb})
+    if conditions:
+        status["conditions"] = conditions
     return {"apiVersion": "v1", "kind": "Node",
             "metadata": encode_meta(n.meta, False),
             "spec": spec,
-            "status": {"capacity": encode_resources(n.status.capacity) or {},
-                       "allocatable":
-                           encode_resources(n.status.allocatable) or {}}}
+            "status": status}
 
 
 def decode_node(d: Dict[str, Any]) -> Node:
     s = d.get("spec") or {}
     st = d.get("status") or {}
+    conditions: List[NodeCondition] = []
+    hb: Optional[float] = None
+    for cd in st.get("conditions") or []:
+        conditions.append(NodeCondition(
+            type=cd.get("type", ""),
+            status=cd.get("status", "True"),
+            reason=cd.get("reason", ""),
+            message=cd.get("message", ""),
+            last_transition_time=decode_time(
+                cd.get("lastTransitionTime")) or 0.0))
+        t = decode_time(cd.get("lastHeartbeatTime"))
+        if t is not None and (hb is None or t > hb):
+            hb = t
     return Node(
         meta=decode_meta(d.get("metadata") or {}, False),
         spec=NodeSpec(
@@ -260,7 +300,9 @@ def decode_node(d: Dict[str, Any]) -> Node:
                           effect=t.get("effect", "NoSchedule"))
                     for t in s.get("taints") or []]),
         status=NodeStatus(capacity=decode_resources(st.get("capacity")),
-                          allocatable=decode_resources(st.get("allocatable"))))
+                          allocatable=decode_resources(st.get("allocatable")),
+                          conditions=conditions,
+                          last_heartbeat_time=hb))
 
 
 # -- PodGroup -----------------------------------------------------------------

@@ -257,11 +257,22 @@ class APIServer:
         self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
         return stored.deepcopy()
 
-    def delete(self, kind: str, key: str) -> None:
+    def delete(self, kind: str, key: str, uid: Optional[str] = None) -> None:
+        """``uid`` is the DeleteOptions.Preconditions.UID analog: the delete
+        applies only to the exact object instance the caller observed. A
+        controller deleting from a point-in-time sweep (node lifecycle
+        orphan GC) MUST pass it — without the precondition, a stale delete
+        races the gang repair controller's recreation of the same pod name
+        and silently kills the replacement."""
         with self._lock:
-            obj = self._stores[kind].pop(key, None)
+            obj = self._stores[kind].get(key)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
+            if uid is not None and obj.meta.uid != uid:
+                raise Conflict(
+                    f"{kind} {key}: uid precondition failed "
+                    f"({uid} != live {obj.meta.uid})")
+            self._stores[kind].pop(key, None)
             # a delete IS a write: etcd bumps its revision for deletions
             # too, and current_resource_version() consumers (the defrag
             # negative-trial cache) must see freed capacity as a change
@@ -282,12 +293,29 @@ class APIServer:
 
     def bind(self, binding: Binding) -> None:
         """POST pods/<p>/binding. Fails if the pod is already bound (the API
-        server's real behavior, which the scheduler cache relies on)."""
+        server's real behavior, which the scheduler cache relies on) or if
+        the target node no longer exists. The node check is a DELIBERATE
+        divergence from the real apiserver (which admits binds to any node
+        name and lets the kubelet reject the pod): this hermetic control
+        plane has no kubelet, so the terminal NotFound is what lets a bind
+        racing a node deletion trigger the gang-atomic rollback instead of
+        silently parking pods on vanished hardware. Kube-backed deployments
+        take the slower path for this window — the bind lands, and the node
+        lifecycle controller's orphan GC + gang repair recover the gang."""
         now = self._clock()
 
         def mutate(pod: Pod):
+            # already-bound check FIRST: a lost-response bind retried after
+            # the target node died must surface the Conflict the client's
+            # heal path recognizes ("bound to my node" ⇒ success), not a
+            # terminal NotFound that would roll back a gang whose bind
+            # actually committed
             if pod.spec.node_name:
                 raise Conflict(f"pod {binding.pod_key} already bound to {pod.spec.node_name}")
+            # inside patch's store lock: atomic with the commit, so a node
+            # deletion can never interleave between the check and the write
+            if "/" + binding.node_name not in self._stores[NODES]:
+                raise NotFound(f"node {binding.node_name} not found")
             pod.spec.node_name = binding.node_name
             pod.meta.annotations.update(binding.annotations)
             pod.status.conditions.append(PodCondition(

@@ -1,10 +1,16 @@
 """Async controllers (reference analog: /root/reference/pkg/controller +
 cmd/controller/app): PodGroup phase machine, ElasticQuota usage accounting,
-workqueue plumbing, and the runner with leader election."""
+node lifecycle (heartbeat health + eviction), gang repair after hardware
+loss, workqueue plumbing, and the runner with leader election."""
 from .workqueue import WorkQueue
 from .podgroup import PodGroupController
 from .elasticquota import ElasticQuotaController
+from .nodelifecycle import NodeLifecycleController
+from .gangrepair import (GangRepairController, REPAIR_BACKFILL,
+                         REPAIR_POLICY_ANNOTATION, REPAIR_RESTART_GANG)
 from .runner import ControllerRunner, ServerRunOptions
 
 __all__ = ["WorkQueue", "PodGroupController", "ElasticQuotaController",
-           "ControllerRunner", "ServerRunOptions"]
+           "NodeLifecycleController", "GangRepairController",
+           "REPAIR_POLICY_ANNOTATION", "REPAIR_RESTART_GANG",
+           "REPAIR_BACKFILL", "ControllerRunner", "ServerRunOptions"]

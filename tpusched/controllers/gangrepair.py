@@ -1,0 +1,337 @@
+"""Gang repair controller: gang-granular reaction to hardware loss.
+
+The "repair" stage of the node-failure pipeline (nodelifecycle.py detects,
+this controller repairs, the scheduler reschedules). When a PodGroup loses
+bound members to a dead/NotReady node (the member pods were evicted by the
+lifecycle controller, or orphan-GC'd after a node kill), the gang is not
+left to wedge half-alive: honoring a per-PG repair policy the controller
+re-establishes the gang's desired member set and lets the coscheduling
+barrier re-admit it atomically on healthy hardware.
+
+Policies (annotation ``repair-policy.scheduling.tpu.dev`` on the PodGroup):
+
+- ``restart-gang`` (default): evict the surviving BOUND members too, then
+  recreate every lost/evicted member fresh — mirroring the all-or-nothing
+  semantics of coscheduling/multislice (and JobSet's RecreateAll failure
+  policy): a TPU training gang that lost a slice host restarts from its
+  checkpoint anyway, and survivors squatting their chips only strand
+  capacity the retry needs.
+- ``backfill``: keep bound survivors, recreate only the lost members; the
+  permit barrier counts survivors toward quorum so only the replacements
+  re-schedule (a serving gang whose members are independent prefers this).
+
+Member specs are captured from the pods themselves when first seen (the
+workload controller's desired-state analog — there is no Job template in
+this control plane). Only LOSSES ATTRIBUTED TO HARDWARE trigger repair: a
+pod deleted while its node was healthy is user intent, and its template is
+dropped instead of resurrected.
+
+PG phase is reset through the normal status patch path so the PodGroup
+controller's phase machine stays the single owner of forward transitions.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import trace
+from ..api.core import Pod, node_health_error
+from ..api.meta import ObjectMeta
+from ..api.scheduling import (PG_FAILED, PG_FINISHED, PG_PENDING,
+                              PG_SCHEDULING, POD_GROUP_INDEX, PodGroup,
+                              pod_group_full_name, pod_group_index_key)
+from ..apiserver import Clientset, InformerFactory
+from ..apiserver import server as srv
+from ..util import klog
+from ..util.metrics import gang_repairs
+from ..util.podutil import assigned
+from .workqueue import WorkQueue
+
+REPAIR_POLICY_ANNOTATION = "repair-policy.scheduling.tpu.dev"
+REPAIR_RESTART_GANG = "restart-gang"
+REPAIR_BACKFILL = "backfill"
+
+# Annotations the scheduler writes at Reserve time — a recreated member must
+# shed them or the chip/coordinate model would read stale placement facts.
+_SCHEDULER_ANNOTATIONS = (
+    "tpuslice.scheduling.tpu.dev/chip-index",
+    "topology.tpu.dev/coord",
+    "topology.tpu.dev/pool",
+)
+
+
+def _sanitize_template(pod: Pod) -> Pod:
+    """A clean, unbound copy of a member pod suitable for recreation."""
+    t = pod.deepcopy()
+    t.spec.node_name = ""
+    t.status = type(t.status)()
+    for k in _SCHEDULER_ANNOTATIONS:
+        t.meta.annotations.pop(k, None)
+    return t
+
+
+def _fresh_member(template: Pod) -> Pod:
+    """A recreate-able pod: template spec under a brand-new ObjectMeta
+    (fresh uid/resourceVersion — the old identity died with the node)."""
+    t = _sanitize_template(template)
+    t.meta = ObjectMeta(name=t.meta.name, namespace=t.meta.namespace,
+                        labels=dict(t.meta.labels),
+                        annotations=dict(t.meta.annotations),
+                        owner_references=list(t.meta.owner_references))
+    return t
+
+
+class GangRepairController:
+    def __init__(self, api: srv.APIServer, workers: int = 1,
+                 cooldown_s: float = 1.0, clock=time.time):
+        self.api = api
+        self.client = Clientset(api)
+        self.informers = InformerFactory(api)
+        self.queue = WorkQueue()
+        self.workers = workers
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        # pg_key → {member name: clean template}
+        self._templates: Dict[str, Dict[str, Pod]] = {}
+        # pg_key → {member names lost to dead hardware, pending repair}
+        self._lost: Dict[str, set] = {}
+        self._last_repair: Dict[str, float] = {}
+        # pod keys the repair itself is deleting: their DELETE events must
+        # not be read as user intent (which would drop the template the
+        # recreate right behind the eviction needs)
+        self._evicting: set = set()
+
+        self.pg_informer = self.informers.podgroups()
+        self.node_informer = self.informers.nodes()
+        self.pod_informer = self.informers.pods()
+        self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
+        self.pod_informer.add_event_handler(on_add=self._pod_added,
+                                            on_delete=self._pod_deleted)
+        self.pg_informer.add_event_handler(
+            on_delete=lambda pg: self._forget(pg.key), replay=False)
+
+    # -- event handlers -------------------------------------------------------
+
+    def _pod_added(self, pod: Pod) -> None:
+        pg_key = pod_group_full_name(pod)
+        if not pg_key:
+            return
+        with self._lock:
+            members = self._templates.setdefault(pg_key, {})
+            if pod.name not in members or not assigned(pod):
+                # prefer the unbound shape; a bound-first sighting (controller
+                # started late) is sanitized on capture
+                members[pod.name] = _sanitize_template(pod)
+            # a member re-appearing (repair's own recreate, or user resubmit)
+            # is no longer lost
+            lost = self._lost.get(pg_key)
+            if lost:
+                lost.discard(pod.name)
+
+    def _pod_deleted(self, pod: Pod) -> None:
+        pg_key = pod_group_full_name(pod)
+        if not pg_key:
+            return
+        with self._lock:
+            if pod.key in self._evicting:
+                self._evicting.discard(pod.key)
+                return
+        if not assigned(pod) or pod.status.phase in ("Succeeded", "Failed"):
+            # unbound deletion or a finished member: user/workload intent —
+            # never resurrect it
+            with self._lock:
+                members = self._templates.get(pg_key)
+                if members:
+                    members.pop(pod.name, None)
+            return
+        node = self.node_informer.get(f"/{pod.spec.node_name}")
+        hardware_loss = node is None or node_health_error(node) is not None
+        if not hardware_loss:
+            with self._lock:
+                members = self._templates.get(pg_key)
+                if members:
+                    members.pop(pod.name, None)
+            return
+        with self._lock:
+            self._lost.setdefault(pg_key, set()).add(pod.name)
+        klog.warning_s("gang member lost to dead hardware", pod=pod.key,
+                       node=pod.spec.node_name, gang=pg_key)
+        self.queue.add(pg_key)
+
+    def _forget(self, pg_key: str) -> None:
+        with self._lock:
+            self._templates.pop(pg_key, None)
+            self._lost.pop(pg_key, None)
+            self._last_repair.pop(pg_key, None)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"gang-repair-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.informers.close()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                err = self.sync(key)
+                if err is None:
+                    self.queue.forget(key)
+                else:
+                    klog.error_s(err, "gang repair sync failed", podGroup=key)
+                    self.queue.add_rate_limited(key)
+            except Exception as e:
+                klog.error_s(e, "gang repair sync panicked", podGroup=key)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    # -- repair ---------------------------------------------------------------
+
+    def sync(self, pg_key: str) -> Optional[Exception]:
+        pg = self.pg_informer.get(pg_key)
+        if pg is None:
+            self._forget(pg_key)
+            return None
+        if pg.status.phase in (PG_FINISHED, PG_FAILED):
+            self._forget(pg_key)
+            return None
+        with self._lock:
+            lost = set(self._lost.get(pg_key) or ())
+            templates = dict(self._templates.get(pg_key) or {})
+        if not lost:
+            return None
+        now = self.clock()
+        with self._lock:
+            last = self._last_repair.get(pg_key, 0.0)
+        remaining = self.cooldown_s - (now - last)
+        if remaining > 0:
+            # a burst of eviction events for one failure = one repair: one
+            # delayed requeue at cooldown lapse, not a rate-limited
+            # busy-poll through the whole window
+            self.queue.add_after(pg_key, remaining + 0.01)
+            return None
+
+        live = {p.name: p for p in
+                self.pod_informer.by_index(POD_GROUP_INDEX, pg_key)}
+        missing = [n for n in lost if n not in live and n in templates]
+        unknown = [n for n in lost if n not in live and n not in templates]
+        if unknown:
+            klog.error_s(None, "lost gang members have no captured template",
+                         podGroup=pg_key, members=len(unknown))
+        if not missing:
+            with self._lock:
+                if self._lost.get(pg_key) is not None:
+                    self._lost[pg_key] -= lost
+            return None
+
+        policy = pg.meta.annotations.get(REPAIR_POLICY_ANNOTATION,
+                                         REPAIR_RESTART_GANG)
+        if policy not in (REPAIR_RESTART_GANG, REPAIR_BACKFILL):
+            policy = REPAIR_RESTART_GANG
+
+        evicted: List[str] = []
+        recreate = list(missing)
+        if policy == REPAIR_RESTART_GANG:
+            # all-or-nothing: bound survivors restart with the gang
+            for name, p in live.items():
+                if assigned(p):
+                    with self._lock:
+                        self._evicting.add(p.key)
+                    try:
+                        # uid precondition: never kill a same-name pod that
+                        # replaced the survivor we observed
+                        self.client.pods.delete(p.key, uid=p.meta.uid)
+                    except (srv.NotFound, srv.Conflict):
+                        with self._lock:
+                            self._evicting.discard(p.key)
+                    except Exception as e:  # noqa: BLE001
+                        with self._lock:
+                            self._evicting.discard(p.key)
+                        return e
+                    evicted.append(name)
+                    if name in templates:
+                        recreate.append(name)
+                        # the evicted survivor is now a loss too: if this
+                        # sync fails before its recreate lands, the retry
+                        # must still recreate it (the successful create's
+                        # ADD event discards it from _lost again)
+                        with self._lock:
+                            self._lost.setdefault(pg_key, set()).add(name)
+
+        err = self._reset_pg_status(pg_key, policy)
+        if err is not None:
+            return err
+
+        for name in recreate:
+            fresh = _fresh_member(templates[name])
+            try:
+                self.client.pods.create(fresh)
+            except srv.Conflict:
+                pass        # already recreated (competing worker / resubmit)
+            except Exception as e:  # noqa: BLE001
+                return e
+
+        with self._lock:
+            if pg_key in self._lost:
+                self._lost[pg_key] -= lost
+            # under the same lock as _forget: a PG deleted mid-sync must
+            # not have its just-popped entry resurrected (and then leaked)
+            if (pg_key in self._templates or pg_key in self._lost
+                    or self.pg_informer.get(pg_key) is not None):
+                self._last_repair[pg_key] = now
+        gang_repairs.inc()
+        trace.pin_event("gang_repair", subject=pg_key, gang_name=pg_key,
+                        policy=policy, lost=len(missing),
+                        evicted_survivors=len(evicted),
+                        recreated=len(recreate))
+        self.client.record_event(
+            pg_key, "PodGroup", "Warning", "GangRepair",
+            f"policy={policy} lost={sorted(missing)} "
+            f"evicted={sorted(evicted)} recreated={len(recreate)}")
+        klog.warning_s("gang repaired after hardware loss", gang=pg_key,
+                       policy=policy, lost=len(missing),
+                       evicted_survivors=len(evicted))
+        return None
+
+    def _reset_pg_status(self, pg_key: str, policy: str) -> Optional[Exception]:
+        """Rewind the PG phase machine so the gang re-admits: restart-gang
+        goes back to Pending with a zeroed scheduled count; backfill stays
+        Scheduling with scheduled reflecting the bound survivors (the
+        scheduler's PostBind re-increments as replacements bind)."""
+        # counted BEFORE the patch: mutate runs under the store lock and
+        # must stay pure (no informer reads inside it)
+        bound = sum(1 for p in self.pod_informer.by_index(
+            POD_GROUP_INDEX, pg_key) if assigned(p))
+
+        def mutate(g: PodGroup):
+            if policy == REPAIR_RESTART_GANG:
+                g.status.phase = PG_PENDING
+                g.status.scheduled = 0
+            else:
+                g.status.phase = PG_SCHEDULING
+                g.status.scheduled = bound
+            g.status.schedule_start_time = None
+        try:
+            self.client.podgroups.patch(pg_key, mutate)
+        except srv.NotFound:
+            return None
+        except Exception as e:  # noqa: BLE001
+            return e
+        return None

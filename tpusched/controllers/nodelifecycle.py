@@ -1,0 +1,208 @@
+"""Node lifecycle controller: heartbeat-driven node health + pod eviction.
+
+The slice of kube-controller-manager's node lifecycle controller the
+scheduler's failure-resilience loop needs, TPU-flavored. Nodes that opt into
+health management (``status.last_heartbeat_time`` set — TestCluster fixture
+nodes without it are implicitly healthy forever) are swept on a short
+period:
+
+- heartbeat missed for ``heartbeat_grace_s``  ⇒ Ready=False condition +
+  the ``node.tpu.dev/not-ready`` NoSchedule taint (placement-producing
+  Filters also consult the condition directly via
+  ``api.core.node_health_error``);
+- heartbeat resumes                            ⇒ Ready=True, taint removed;
+- NotReady persists for ``pod_eviction_grace_s`` ⇒ the node's bound pods are
+  deleted (the k8s NoExecute eviction analog), which is what lets the gang
+  repair controller re-place the gang on healthy hardware;
+- a pod bound to a node that no longer EXISTS is deleted immediately
+  (pod-GC orphan semantics): a killed node must not strand its gang.
+
+Fleet papers (PAPERS.md, "Training Supercomputers…") make slice
+failure-and-repair the dominant availability cost — this controller is the
+"detect" stage of the detect→repair→reschedule pipeline; gangrepair.py is
+the "repair" stage.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import trace
+from ..api.core import (NODE_READY, Node, Pod, TAINT_NODE_NOT_READY, Taint,
+                        node_ready)
+from ..apiserver import Clientset, InformerFactory
+from ..apiserver import server as srv
+from ..util import klog
+from ..util.metrics import (node_not_ready_transitions, node_pod_evictions,
+                            nodes_not_ready)
+
+# Pod-informer index on the bound-to node name: the eviction and orphan-GC
+# sweeps visit O(affected) pods per tick instead of scanning the fleet.
+POD_NODE_INDEX = "tpusched/pod-node"
+
+
+def pod_node_index_key(pod) -> Optional[str]:
+    return pod.spec.node_name or None
+
+
+class NodeLifecycleController:
+    def __init__(self, api: srv.APIServer, heartbeat_grace_s: float = 10.0,
+                 pod_eviction_grace_s: float = 30.0,
+                 sweep_interval_s: float = 1.0, clock=time.time):
+        self.api = api
+        self.client = Clientset(api)
+        self.informers = InformerFactory(api)
+        self.node_informer = self.informers.nodes()
+        self.pod_informer = self.informers.pods()
+        self.pod_informer.add_index(POD_NODE_INDEX, pod_node_index_key)
+        self.heartbeat_grace_s = heartbeat_grace_s
+        self.pod_eviction_grace_s = pod_eviction_grace_s
+        self.sweep_interval_s = sweep_interval_s
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # NotReady-since per node (monotonic-free: the injected clock), kept
+        # controller-local so a restart re-grants the eviction grace instead
+        # of mass-evicting on the first sweep after recovery
+        self._not_ready_since: Dict[str, float] = {}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-lifecycle")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.informers.close()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.sweep_interval_s):
+            try:
+                self.sweep_once()
+            except Exception as e:  # the monitor must survive anything
+                klog.error_s(e, "node lifecycle sweep panicked")
+
+    # -- the sweep ------------------------------------------------------------
+
+    def sweep_once(self) -> None:
+        now = self.clock()
+        not_ready = 0
+        node_names = set()
+        for node in self.node_informer.items():
+            node_names.add(node.name)
+            hb = node.status.last_heartbeat_time
+            if hb is None:
+                continue            # not heartbeat-managed
+            missed = now - hb > self.heartbeat_grace_s
+            if missed and node_ready(node):
+                self._mark_not_ready(node, now)
+            elif not missed and not node_ready(node):
+                self._mark_ready(node, now)
+            if not node_ready(self.node_informer.get(node.meta.key) or node):
+                not_ready += 1
+                since = self._not_ready_since.setdefault(node.name, now)
+                if now - since > self.pod_eviction_grace_s:
+                    self._evict_pods(node.name, "node NotReady past the "
+                                                "eviction grace period")
+            else:
+                self._not_ready_since.pop(node.name, None)
+        nodes_not_ready.set(not_ready)
+        self._not_ready_since = {n: t for n, t in
+                                 self._not_ready_since.items()
+                                 if n in node_names}
+        # orphan GC: pods bound to a node object that no longer exists can
+        # never run — delete them now so the gang repair controller can act.
+        # O(bound-to nodes) via the pod-node index, and the node lookup is
+        # LIVE (informer get at delete time), not the sweep-start snapshot:
+        # a replacement node created mid-sweep with a repaired gang freshly
+        # bound to it must not have those pods GC'd by a stale membership
+        # set (the uid precondition would not save them — they are the very
+        # instances we would be deleting).
+        for node_name in self.pod_informer.index_values(POD_NODE_INDEX):
+            if self.node_informer.get(f"/{node_name}") is not None:
+                continue
+            for pod in self.pod_informer.by_index(POD_NODE_INDEX, node_name):
+                if not pod.is_terminating() \
+                        and self.node_informer.get(
+                            f"/{pod.spec.node_name}") is None:
+                    self._delete_pod(
+                        pod, f"node {pod.spec.node_name} is gone "
+                             f"(orphaned pod GC)")
+
+    # -- transitions ----------------------------------------------------------
+
+    def _mark_not_ready(self, node: Node, now: float) -> None:
+        def mutate(live: Node):
+            live.set_condition(NODE_READY, "False", reason="HeartbeatMissed",
+                               message="kubelet stopped posting heartbeats",
+                               now=now)
+            if not any(t.key == TAINT_NODE_NOT_READY
+                       for t in live.spec.taints):
+                live.spec.taints.append(Taint(key=TAINT_NODE_NOT_READY,
+                                              effect="NoSchedule"))
+        try:
+            self.client.nodes.patch(node.meta.key, mutate)
+        except srv.NotFound:
+            return
+        except Exception as e:  # noqa: BLE001 — retried next sweep
+            klog.error_s(e, "NotReady patch failed", node=node.name)
+            return
+        self._not_ready_since.setdefault(node.name, now)
+        node_not_ready_transitions.inc()
+        trace.pin_event("node_not_ready", subject=f"node/{node.name}",
+                        node=node.name,
+                        heartbeat_age_s=round(
+                            now - (node.status.last_heartbeat_time or now), 2))
+        self.client.record_event(node.meta.key, "Node", "Warning",
+                                 "NodeNotReady",
+                                 "heartbeat missed beyond grace period")
+        klog.warning_s("node marked NotReady", node=node.name)
+
+    def _mark_ready(self, node: Node, now: float) -> None:
+        def mutate(live: Node):
+            live.set_condition(NODE_READY, "True", reason="HeartbeatResumed",
+                               now=now)
+            live.spec.taints = [t for t in live.spec.taints
+                                if t.key != TAINT_NODE_NOT_READY]
+        try:
+            self.client.nodes.patch(node.meta.key, mutate)
+        except srv.NotFound:
+            return
+        except Exception as e:  # noqa: BLE001 — retried next sweep
+            klog.error_s(e, "Ready patch failed", node=node.name)
+            return
+        self._not_ready_since.pop(node.name, None)
+        self.client.record_event(node.meta.key, "Node", "Normal",
+                                 "NodeReady", "heartbeat resumed")
+        klog.info_s("node recovered to Ready", node=node.name)
+
+    # -- eviction -------------------------------------------------------------
+
+    def _bound_pods(self, node_name: str) -> List[Pod]:
+        return self.pod_informer.by_index(POD_NODE_INDEX, node_name)
+
+    def _evict_pods(self, node_name: str, reason: str) -> None:
+        for pod in self._bound_pods(node_name):
+            self._delete_pod(pod, reason)
+
+    def _delete_pod(self, pod: Pod, reason: str) -> None:
+        try:
+            # uid precondition: the sweep works off a point-in-time list,
+            # and the gang repair controller recreates lost members under
+            # the SAME name — a stale eviction must fail (Conflict) rather
+            # than kill the replacement
+            self.client.pods.delete(pod.key, uid=pod.meta.uid)
+        except (srv.NotFound, srv.Conflict):
+            return
+        except Exception as e:  # noqa: BLE001 — retried next sweep
+            klog.error_s(e, "pod eviction failed", pod=pod.key)
+            return
+        node_pod_evictions.inc()
+        self.client.record_event(pod.key, "Pod", "Warning", "Evicted", reason)
+        klog.warning_s("evicted pod off failed node", pod=pod.key,
+                       node=pod.spec.node_name, reason=reason)

@@ -38,6 +38,15 @@ class ServerRunOptions:
     defrag_dry_run: bool = False
     defrag_blocked_after_s: float = 60.0
     defrag_cooldown_s: float = 120.0
+    # node & slice failure resilience: heartbeat-driven node health +
+    # eviction (nodelifecycle.py) and gang-granular repair after hardware
+    # loss (gangrepair.py). On by default — a fleet without them assumes
+    # immortal hardware.
+    enable_node_lifecycle: bool = True
+    node_heartbeat_grace_s: float = 10.0
+    node_pod_eviction_grace_s: float = 30.0
+    enable_gang_repair: bool = True
+    gang_repair_cooldown_s: float = 1.0
 
 
 class ControllerRunner:
@@ -92,6 +101,17 @@ class ControllerRunner:
                 self.api, dry_run=self.options.defrag_dry_run,
                 blocked_after_s=self.options.defrag_blocked_after_s,
                 cooldown_s=self.options.defrag_cooldown_s))
+        if self.options.enable_node_lifecycle:
+            from .nodelifecycle import NodeLifecycleController
+            self._controllers.append(NodeLifecycleController(
+                self.api,
+                heartbeat_grace_s=self.options.node_heartbeat_grace_s,
+                pod_eviction_grace_s=self.options.node_pod_eviction_grace_s))
+        if self.options.enable_gang_repair:
+            from .gangrepair import GangRepairController
+            self._controllers.append(GangRepairController(
+                self.api, workers=self.options.workers,
+                cooldown_s=self.options.gang_repair_cooldown_s))
         for c in self._controllers:
             c.run()
 

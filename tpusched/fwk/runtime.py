@@ -101,6 +101,17 @@ class PluginProfile:
     degraded_threshold: int = 3
     degraded_initial_pause_s: float = 1.0
     degraded_max_pause_s: float = 30.0
+    # Stuck-gang watchdog (sched/scheduler._StuckGangWatchdog): a gang with
+    # pending/waiting members whose progress signature (bound+assumed count,
+    # pending count, barrier population) has not moved for
+    # `stuck_gang_after_s` is declared stuck — pinned `gang_stuck` anomaly,
+    # `tpusched_gang_stuck_total`, a /debug/flightrecorder health entry, and
+    # a forced reactivation of its parked members. The watchdog also
+    # enforces permit-barrier deadlines missed by the event sweeper
+    # (belt-and-braces: a wedged sweeper must not wedge gangs with it).
+    # 0 disables.
+    stuck_gang_after_s: float = 30.0
+    stuck_gang_sweep_interval_s: float = 1.0
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]

@@ -11,7 +11,7 @@ from typing import List, Tuple
 
 from typing import Optional
 
-from ..api.core import Binding, Node, Pod, tolerates
+from ..api.core import Binding, Node, Pod, node_health_error, tolerates
 from ..api.resources import resources_fit
 from ..fwk import (CycleState, Status, UNSCHEDULABLE)
 from ..fwk.interfaces import (BatchFilterPlugin, BindPlugin, FilterPlugin,
@@ -105,14 +105,20 @@ class NodeResourcesFit(BatchFilterPlugin):
 
 
 class NodeUnschedulable(FilterPlugin):
+    """Cordon + node-health gate: spec.unschedulable, a NotReady Ready
+    condition, or the lifecycle controller's not-ready taint all reject the
+    node (api.core.node_health_error is the one shared judgement — the
+    verify-node-health-filters lint holds every placement-producing Filter
+    to it)."""
     NAME = "NodeUnschedulable"
-    # reads only node.spec: byte-identical while an equivalence entry is
-    # armed (any node update bumps the mutation cursor)
+    # reads only node.spec + node.status.conditions: byte-identical while an
+    # equivalence entry is armed (any node update bumps the mutation cursor)
     EQUIV_DYNAMIC = False
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
-        if node_info.node.spec.unschedulable:
-            return Status.unresolvable("node(s) were unschedulable")
+        err = node_health_error(node_info.node)
+        if err is not None:
+            return Status.unresolvable(err)
         return Status.success()
 
 

@@ -56,7 +56,7 @@ from __future__ import annotations
 import threading
 from typing import FrozenSet, List, Optional, Set, Tuple
 
-from ...api.core import Pod
+from ...api.core import Pod, node_health_error
 from ...api.resources import PODS
 from ...api.scheduling import (POD_GROUP_INDEX, PodGroup,
                                pod_group_index_key, pod_group_label)
@@ -327,6 +327,18 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
 
     def filter(self, state: CycleState, pod: Pod,
                node_info: NodeInfo) -> Status:
+        # degraded/NotReady hardware is rejected before any DCN-domain
+        # arithmetic: a retrying slice must land on healthy hosts. Cheap by
+        # construction — this Filter only runs for multislice-set pods
+        # (pre_filter Skips everyone else into skip_filter_plugins), and
+        # set members are always equivalence-cache vetoed (equiv_fingerprint
+        # returns None), so no armed entry can outlive a readiness flip.
+        health = node_health_error(node_info.node)
+        if health is not None:
+            # unresolvable, matching NodeUnschedulable/TpuSlice: preemption
+            # cannot make dead hardware Ready, so PostFilter must not keep
+            # this node in its victim dry-run candidate set
+            return Status.unresolvable(health)
         doms = state.try_read(_FILTER_KEY)
         if doms is None:
             return Status.success()

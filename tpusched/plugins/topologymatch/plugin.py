@@ -28,7 +28,7 @@ import math
 
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
-from ...api.core import Pod
+from ...api.core import Pod, node_health_error
 from ...api.resources import TPU
 from ...api.scheduling import POD_GROUP_LABEL, pod_group_label
 from ...api.topology import (ACCELERATORS, TOPOLOGY_GROUP, format_coord,
@@ -374,6 +374,13 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 assigned.add(coord)
             if foreign_used:
                 continue
+            # a NotReady/cordoned host must not anchor a NEW window: a
+            # window containing it would pass enumeration, fail the
+            # per-node health filter, and wedge the gang on a placement
+            # that can never complete (sibling-occupied hosts stay counted
+            # as assigned above — API truth until eviction/repair acts)
+            if node_health_error(info.node) is not None:
+                continue
             if not has_sibling:
                 free.add(coord)
             if alloc - sibling_used >= chips_needed:
@@ -385,6 +392,16 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         stash = state.try_read(_STATE_KEY)
+        if stash is not None:
+            # belt-and-braces behind the _occupancy exclusion: a readiness
+            # flip between PreFilter's window sweep and this node's visit
+            # must still reject (the cursor bump invalidates any armed
+            # equivalence entry, so the two layers cannot disagree)
+            health = node_health_error(node_info.node)
+            if health is not None:
+                # unresolvable, same severity as NodeUnschedulable/TpuSlice:
+                # no preemption can revive dead hardware
+                return Status.unresolvable(health)
         if stash is None:
             # PreFilter skipped (non-slice pod) — but a freed-window claim
             # still guards its hosts: a plain TPU pod grabbing one host of

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ...api.core import Binding, Pod
+from ...api.core import Binding, Pod, node_health_error
 from ...api.resources import TPU, TPU_MEMORY
 from ...fwk import CycleState, Status
 from ...fwk.interfaces import (BindPlugin, FilterPlugin, NodeScore,
@@ -58,6 +58,13 @@ class TpuSlice(FilterPlugin, ScorePlugin, ReservePlugin, BindPlugin):
         chips_req, chips_set, mem_req, mem_set = pod_tpu_limits(pod)
         if not chips_set and not mem_set:
             return Status.success()
+        # NotReady/cordoned hardware never takes a NEW chip placement, even
+        # in profiles that do not wire NodeUnschedulable — the post-failure
+        # retry must land on healthy silicon (node updates bump the cache's
+        # mutation cursor, so equivalence entries stay exact)
+        health = node_health_error(node_info.node)
+        if health is not None:
+            return Status.unresolvable(health)
         if chips_set and mem_set:
             # a pod may not mix whole-chip and fractional requests
             # (flex_gpu.go:58-61)

@@ -82,13 +82,38 @@ class Cache:
                 self._mutation += 1
                 info.set_node(node)
 
-    def remove_node(self, node: Node) -> None:
+    def remove_node(self, node: Node) -> list:
+        """Drop a node AND reconcile the pod state attached to it — node
+        removal with bound/assumed pods is a first-class event, not a blind
+        pop.
+
+        - pods stay in ``_pods`` (upstream RemoveNode semantics: the API
+          server still holds bound pods, and a node-object replacement —
+          remove+add of the same name — must re-attach them); quorum
+          accounting is decremented with the NodeInfo;
+        - assumed pods with a still-∞ deadline get their expiry TTL armed
+          NOW: their bind targets hardware that no longer exists, and
+          without this a bind whose confirmation can never arrive would
+          leak the assume-table entry (and its quorum count on re-add)
+          forever. The scheduler's ``_on_node_delete`` additionally rejects
+          barrier-parked members on the vanished node, whose failure path
+          forgets them promptly — the TTL is the backstop.
+
+        Returns the pods that were attached so the caller can reject
+        barrier-parked members and requeue the affected gangs."""
         with self._lock:
             self._mutation += 1
             info = self._infos.pop(node.name, None)
-            if info is not None:
-                for p in info.pods:
-                    self._pg_adjust(p, -1)
+            if info is None:
+                return []
+            affected = list(info.pods)
+            deadline = self._clock() + ASSUME_EXPIRATION_S
+            for p in affected:
+                self._pg_adjust(p, -1)
+                if self._assumed.get(p.key) == float("inf"):
+                    self._assumed[p.key] = deadline
+            return affected
+
 
     # -- pods -----------------------------------------------------------------
 

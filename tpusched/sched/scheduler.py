@@ -36,8 +36,8 @@ from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_fallbacks, equiv_cache_hits,
                             equiv_cache_invalidations, equiv_cache_misses,
                             equiv_cache_vetoes, extension_point_seconds,
-                            gang_bind_rollbacks, queue_wait_seconds,
-                            schedule_attempts)
+                            gang_bind_rollbacks, gang_stuck_total,
+                            queue_wait_seconds, schedule_attempts)
 from ..util.podutil import assigned
 from .cache import Cache
 from .equivcache import EquivalenceCache, EquivEntry
@@ -178,6 +178,111 @@ class _DegradedMode:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             return self._snapshot_locked()
+
+
+class _StuckGangWatchdog:
+    """No-progress detector for gangs, swept from the scheduleOne loop.
+
+    Tracing (PR 2) made a wedged gang *explainable*; the watchdog makes the
+    scheduler *act*. Per gang with pending or barrier-parked members it
+    tracks a progress signature — (assigned members, pending members,
+    waiting-at-permit members) — and when the signature has not moved for
+    ``stuck_after_s`` it: pins a ``gang_stuck`` anomaly, bumps
+    ``tpusched_gang_stuck_total``, publishes the stuck set into the
+    flight recorder's health section (/debug/flightrecorder), and force-
+    reactivates the gang's parked members so a lost wakeup (the classic
+    wedge) cannot strand the gang until the periodic flush. It also
+    enforces permit-barrier deadlines missed by the event sweeper
+    (``expire_if_due`` is idempotent), so a wedged sweeper thread cannot
+    wedge gangs with it. Runs on the scheduling thread between cycles —
+    snapshot access needs no extra locking."""
+
+    def __init__(self, scheduler: "Scheduler", stuck_after_s: float,
+                 sweep_interval_s: float, clock=time.monotonic):
+        self._sched = scheduler
+        self._after = stuck_after_s
+        self._interval = max(0.05, sweep_interval_s)
+        self._clock = clock
+        self._last_sweep = 0.0
+        # gang → [signature, since, last_fired, last_seen]
+        self._state: Dict[str, list] = {}
+        self._published: Dict[str, Dict[str, object]] = {}
+
+    def sweep(self) -> None:
+        if self._after <= 0:
+            return
+        now = self._clock()
+        if now - self._last_sweep < self._interval:
+            return
+        self._last_sweep = now
+        sched = self._sched
+
+        waiting: Dict[str, int] = {}
+
+        def visit(wp):
+            wp.expire_if_due(now)   # belt-and-braces deadline enforcement
+            gang = pod_group_full_name(wp.pod)
+            if gang:
+                waiting[gang] = waiting.get(gang, 0) + 1
+        sched._fw.iterate_over_waiting_pods(visit)
+
+        pending: Dict[str, List[Pod]] = {}
+        for pod in sched.queue.pending_pods():
+            gang = pod_group_full_name(pod)
+            if gang:
+                pending.setdefault(gang, []).append(pod)
+
+        snapshot = sched.cache.snapshot()
+        live = set(pending) | set(waiting)
+        for gang in live:
+            ns, _, name = gang.partition("/")
+            sig = (snapshot.assigned_count(name, ns),
+                   len(pending.get(gang, ())), waiting.get(gang, 0))
+            ent = self._state.get(gang)
+            if ent is None or ent[0] != sig:
+                self._state[gang] = [sig, now, 0.0, now]
+                continue
+            ent[3] = now
+            stalled_s = now - ent[1]
+            if stalled_s < self._after:
+                continue
+            if now - ent[2] < self._after:
+                continue            # fired for this epoch already
+            ent[2] = now
+            detail = {"assigned": sig[0], "pending": sig[1],
+                      "waiting": sig[2], "stalled_s": round(stalled_s, 2)}
+            gang_stuck_total.inc()
+            trace.pin_event("gang_stuck", subject=gang,
+                            recorder=sched.recorder, gang_name=gang, **detail)
+            klog.warning_s("gang made no scheduling progress", gang=gang,
+                           **detail)
+            if pending.get(gang):
+                sched.queue.activate(pending[gang])
+        # absence grace: a gang whose only pending member is POPPED (mid
+        # scheduling cycle) at sweep time vanishes from the queue view for
+        # a beat — resetting its stall clock (or flickering the health
+        # entry) on that would make the watchdog blind to exactly the
+        # gangs it exists for. State drops only after a sustained absence
+        # (a few sweeps), so a genuinely resolved gang leaves the stuck
+        # report within ~3 sweep intervals.
+        grace = 3 * self._interval
+        stuck_now: Dict[str, Dict[str, object]] = {}
+        for gang in list(self._state):
+            sig, since, _, last_seen = self._state[gang]
+            if now - last_seen > grace:
+                del self._state[gang]
+                continue
+            stalled_s = now - since
+            if stalled_s >= self._after:
+                stuck_now[gang] = {
+                    "assigned": sig[0], "pending": sig[1], "waiting": sig[2],
+                    "stalled_s": round(stalled_s, 2)}
+        if stuck_now != self._published:
+            self._published = stuck_now
+            sched.recorder.set_health(
+                "stuck_gangs",
+                {"count": len(stuck_now), "gangs": dict(stuck_now)}
+                if stuck_now else None)
 
 
 class _BindingPool:
@@ -365,6 +470,11 @@ class Scheduler:
         # failed a bind in the last minute.
         self._gang_aborts: Dict[str, tuple] = {}
         self._gang_aborts_lock = threading.Lock()
+        # stuck-gang watchdog: no-progress detection + permit-deadline
+        # belt-and-braces, swept between cycles on the scheduling thread
+        self._watchdog = _StuckGangWatchdog(
+            self, profile.stuck_gang_after_s,
+            profile.stuck_gang_sweep_interval_s)
         self._wire_informers()
 
     @property
@@ -393,10 +503,8 @@ class Scheduler:
         nodes.add_event_handler(
             on_add=lambda n: (self.cache.add_node(n),
                               self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)),
-            on_update=lambda old, new: (self.cache.update_node(new),
-                                        self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_UPDATE)),
-            on_delete=lambda n: (self.cache.remove_node(n),
-                                 self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_DELETE)))
+            on_update=self._on_node_update,
+            on_delete=self._on_node_delete)
         for kind in (srv.POD_GROUPS, srv.ELASTIC_QUOTAS, srv.TPU_TOPOLOGIES):
             res = _KIND_TO_RESOURCE[kind]
             self.informer_factory.informer(kind).add_event_handler(
@@ -429,6 +537,60 @@ class Scheduler:
             self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_UPDATE)
         elif self._responsible(new):
             self.queue.update(new)
+
+    @staticmethod
+    def _heartbeat_only_update(old: Node, new: Node) -> bool:
+        """True when the ONLY delta is the kubelet heartbeat stamp. Nothing
+        the scheduler evaluates reads it, so treating these as real updates
+        would bump the cache mutation cursor (disarming every equivalence
+        entry — PR 1's cache could never stay warm on a heartbeat-managed
+        fleet) and re-activate all parked pods once per node per heartbeat
+        period. The same reason Kubernetes moved heartbeats off the Node
+        object onto Leases."""
+        return (old.status.last_heartbeat_time
+                != new.status.last_heartbeat_time
+                and old.spec == new.spec
+                and old.meta.labels == new.meta.labels
+                and old.status.capacity == new.status.capacity
+                and old.status.allocatable == new.status.allocatable
+                and old.status.conditions == new.status.conditions)
+
+    def _on_node_update(self, old: Node, new: Node) -> None:
+        if self._heartbeat_only_update(old, new):
+            return
+        self.cache.update_node(new)
+        self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_UPDATE)
+
+    def _on_node_delete(self, node: Node) -> None:
+        """Node removal with bound/assumed pods is a FIRST-CLASS failure
+        event, not a blind cache pop: assume-state is reconciled
+        (cache.remove_node), members parked at the permit barrier on the
+        vanished node are rejected before they can dispatch a doomed bind,
+        affected gangs' parked siblings are woken, and the event is pinned
+        in the flight recorder so an operator sees which gangs lost
+        hardware without correlating logs."""
+        affected = self.cache.remove_node(node)
+        self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_DELETE)
+        if not affected:
+            return
+        gangs = sorted({pod_group_full_name(p) for p in affected
+                        if pod_group_full_name(p)})
+        trace.pin_event("node_removed_with_pods", subject=f"node/{node.name}",
+                        recorder=self.recorder, node=node.name,
+                        pods=len(affected), gangs=",".join(gangs[:8]))
+        klog.warning_s("node removed with pods attached", node=node.name,
+                       pods=len(affected), gangs=len(gangs))
+
+        def reject(waiting_pod):
+            if waiting_pod.pod.spec.node_name == node.name:
+                waiting_pod.reject(
+                    "", f"node {node.name} deleted while pod waited at the "
+                        f"permit barrier")
+        self._fw.iterate_over_waiting_pods(reject)
+        # released reservations / vanished members free the same resources a
+        # pod deletion frees, and no pod event fires for them — wake parked
+        # siblings the same way _forget_and_signal does
+        self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
 
     def _on_pod_delete(self, pod: Pod) -> None:
         self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
@@ -468,6 +630,12 @@ class Scheduler:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # the watchdog sweeps BEFORE the degraded-mode gate: during an
+            # apiserver outage stuck gangs must stay visible (health entry,
+            # pinned anomalies) and their stall clocks must keep running —
+            # the sweep touches only local state (cache snapshot, queue,
+            # waiting pods), never the API
+            self._watchdog.sweep()
             # degraded mode: pausing the pop IS the backoff — failed cycles
             # against a dead apiserver would only re-queue themselves
             pause = self._degraded.pause_remaining()

@@ -5,9 +5,11 @@ from .wrappers import (make_node, make_pod, make_pod_group, make_elastic_quota,
 from .harness import new_test_framework
 from .cluster import TestCluster, wait_until
 from .fakewatcher import FakeWatcher
-from .chaos import ChaosReport, chaos_profile, run_chaos_soak
+from .chaos import (ChaosReport, NodeHeartbeater, chaos_profile,
+                    node_churn_profile, run_chaos_soak, run_node_churn_soak)
 
 __all__ = ["make_node", "make_pod", "make_pod_group", "make_elastic_quota",
            "make_tpu_node", "make_tpu_pool", "make_resources",
            "new_test_framework", "TestCluster", "FakeWatcher", "wait_until",
-           "ChaosReport", "chaos_profile", "run_chaos_soak"]
+           "ChaosReport", "NodeHeartbeater", "chaos_profile",
+           "node_churn_profile", "run_chaos_soak", "run_node_churn_soak"]

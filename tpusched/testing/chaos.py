@@ -26,6 +26,7 @@ printed seed.
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -137,6 +138,12 @@ class ChaosReport:
     degraded_tripped: bool = False
     violations: List[str] = field(default_factory=list)
     phases: List[str] = field(default_factory=list)
+    # node-churn soak extras (run_node_churn_soak)
+    node_kills: int = 0
+    not_ready_transitions: int = 0
+    evictions: int = 0
+    repairs: int = 0
+    stuck_findings: int = 0
 
     @property
     def ok(self) -> bool:
@@ -148,6 +155,10 @@ class ChaosReport:
                 f"exhausted={self.exhausted} injections={self.injections} "
                 f"rollbacks={self.rollbacks} "
                 f"degraded={self.degraded_tripped} "
+                f"node_kills={self.node_kills} "
+                f"not_ready={self.not_ready_transitions} "
+                f"evictions={self.evictions} repairs={self.repairs} "
+                f"stuck={self.stuck_findings} "
                 f"violations={len(self.violations)}")
 
 
@@ -295,6 +306,329 @@ def _run_round(api: APIServer, injector: FaultInjector,
             pass
     # let deletion churn settle so the next round starts from empty nodes
     wait_until(lambda: not api.list(srv.PODS), timeout=5.0)
+
+
+# =============================================================================
+# Node-churn soak: the hardware is the adversary (C6).
+#
+# The API-fault soak above assumes immortal nodes; this soak kills them.
+# Rotating node-level fault phases — heartbeat loss, node kill with bound
+# gang members, cordon storms, flapping Ready — run against a live
+# scheduler PLUS the node lifecycle, gang repair and PodGroup controllers
+# (all through the fault injector, so API blips compound with hardware
+# loss). The invariant on top of C1/C2/C3:
+#
+#   C6  no permanent wedge: every gang that loses a node re-reaches
+#       fully-Bound on nodes that exist and are Ready, or a clean terminal
+#       phase — at every quiesce point, with no pod lost and no
+#       double-bind.
+# =============================================================================
+
+
+class NodeHeartbeater:
+    """The kubelet-simulator half of node health: stamps
+    ``status.last_heartbeat_time`` for every node on a short period,
+    except the names currently silenced (the heartbeat-loss fault).
+    Writes go to the REAL store — the heartbeat is the fixture; the
+    lifecycle controller under test reads it through the injector."""
+
+    def __init__(self, api: APIServer, period_s: float = 0.08):
+        self._api = api
+        self._period = period_s
+        self._lock = threading.Lock()
+        self._silenced: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-heartbeater")
+
+    def start(self) -> "NodeHeartbeater":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def silence(self, *names: str) -> None:
+        with self._lock:
+            self._silenced.update(names)
+
+    def restore(self, *names: str) -> None:
+        with self._lock:
+            if names:
+                self._silenced.difference_update(names)
+            else:
+                self._silenced.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period):
+            now = time.time()
+            with self._lock:
+                silenced = set(self._silenced)
+            for node in self._api.list(srv.NODES):
+                if node.name in silenced:
+                    continue
+                try:
+                    self._api.patch(
+                        srv.NODES, node.meta.key,
+                        lambda n, ts=now: setattr(n.status,
+                                                  "last_heartbeat_time", ts))
+                except srv.NotFound:
+                    continue
+
+
+def node_churn_profile() -> PluginProfile:
+    """chaos_profile + a fast stuck-gang watchdog: under node churn the
+    watchdog is part of the system under test (a gang wedged by a lost
+    wakeup must be detected and reactivated, not carried by the test's
+    patience)."""
+    p = chaos_profile()
+    p.stuck_gang_after_s = 2.0
+    p.stuck_gang_sweep_interval_s = 0.2
+    return p
+
+
+def _make_hb_node(api: APIServer, name: str):
+    node = make_node(name)
+    node.status.last_heartbeat_time = time.time()
+    api.create(srv.NODES, node)
+
+
+def _healthy_node_names(api: APIServer) -> List[str]:
+    from ..api.core import node_health_error
+    return [n.name for n in api.list(srv.NODES)
+            if node_health_error(n) is None]
+
+
+def _check_no_wedge(api: APIServer, keys: List[str],
+                    report: ChaosReport, ctx: str,
+                    timeout_s: float) -> None:
+    """C6 at quiesce: every created pod exists, is bound, and its node
+    exists and is healthy; every gang all-or-nothing (C3)."""
+    from ..api.core import node_health_error
+
+    def settled() -> bool:
+        for k in keys:
+            p = api.peek(srv.PODS, k)
+            if p is None or not p.spec.node_name:
+                return False
+            node = api.peek(srv.NODES, "/" + p.spec.node_name)
+            if node is None or node_health_error(node) is not None:
+                return False
+        return True
+    if not wait_until(settled, timeout=timeout_s):
+        for k in keys:
+            p = api.peek(srv.PODS, k)
+            if p is None:
+                report.violations.append(f"C1 [{ctx}]: pod {k} lost")
+            elif not p.spec.node_name:
+                report.violations.append(
+                    f"C6 [{ctx}]: pod {k} permanently unbound (wedged)")
+            else:
+                node = api.peek(srv.NODES, "/" + p.spec.node_name)
+                if node is None:
+                    report.violations.append(
+                        f"C6 [{ctx}]: pod {k} bound to vanished node "
+                        f"{p.spec.node_name}")
+                elif node_health_error(node) is not None:
+                    report.violations.append(
+                        f"C6 [{ctx}]: pod {k} bound to unhealthy node "
+                        f"{p.spec.node_name}")
+    _check_gangs_quiesced(api, report)
+
+
+def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
+                        gangs_per_round: int = 2, members: int = 3,
+                        nodes: int = 6, round_timeout_s: float = 30.0,
+                        max_rounds: int = 2000,
+                        pressure: int = 8) -> ChaosReport:
+    """Drive gang workloads while the HARDWARE misbehaves: rotating node
+    fault phases until ``min_cycles`` scheduling cycles ran, asserting
+    C1/C2/C3/C6 at every quiesce. Returns the report.
+
+    ``pressure``: permanently-unschedulable singletons kept pending for the
+    soak's whole life. Every heartbeat/cordon/kill event requeues them, so
+    each one continuously re-runs the full PreFilter/Filter path against
+    the churning fleet — exactly the traffic that would catch a Filter
+    admitting a NotReady node — and the cycle floor is reached in smoke
+    time instead of node-fault wall-clock time."""
+    import random
+
+    from .. import trace
+    from ..controllers.gangrepair import GangRepairController
+    from ..controllers.nodelifecycle import NodeLifecycleController
+    from ..controllers.podgroup import PodGroupController
+    from ..util.metrics import (gang_repairs, gang_stuck_total,
+                                node_not_ready_transitions,
+                                node_pod_evictions)
+
+    rng = random.Random(seed)
+    report = ChaosReport(seed=seed)
+    api = APIServer()
+    injector = FaultInjector(api, seed=seed)
+    prev_recorder = trace.default_recorder()
+    trace.install_recorder(trace.FlightRecorder())
+    monitor = BindTransitionMonitor(api)
+    cycles0 = schedule_attempts.value()
+    binds0 = bind_total.value()
+    retries0 = api_retries.value()
+    mismatch0 = equiv_cache_differential_mismatches.value()
+    nr0 = node_not_ready_transitions.value()
+    ev0 = node_pod_evictions.value()
+    rep0 = gang_repairs.value()
+    stuck0 = gang_stuck_total.value()
+
+    cluster = TestCluster(profile=node_churn_profile(), api=injector)
+    # grace periods sized to the heartbeat period: trip fast, but never
+    # from scheduler latency alone
+    lifecycle = NodeLifecycleController(injector, heartbeat_grace_s=0.5,
+                                        pod_eviction_grace_s=0.4,
+                                        sweep_interval_s=0.1)
+    repair = GangRepairController(injector, cooldown_s=0.2)
+    pg_ctrl = PodGroupController(injector)
+    heartbeater = NodeHeartbeater(api).start()
+    for i in range(nodes):
+        _make_hb_node(api, f"churn-n{i}")
+    spare = nodes          # replacement-node name counter
+    try:
+        cluster.scheduler.run()
+        for i in range(pressure):
+            # no gang label on purpose: the watchdog tracks gangs, and a
+            # by-design-unschedulable singleton must not read as a wedge
+            api.create(srv.PODS, make_pod(
+                f"pressure-{i}", requests=make_resources(cpu=10_000)))
+        lifecycle.run()
+        repair.run()
+        pg_ctrl.run()
+        gen = 0
+        # phase-coverage floor: even a tiny cycle budget runs every node
+        # fault phase at least once (the in-suite floor leans on this)
+        while ((schedule_attempts.value() - cycles0 < min_cycles
+                or report.rounds < 5)
+               and report.rounds < max_rounds):
+            phase = report.rounds % 5
+            created: Dict[str, List[str]] = {}
+            for g in range(gangs_per_round):
+                name = f"ng{gen}-{g}"
+                created[name] = _make_gang(api, name, members)
+            all_keys = [k for keys in created.values() for k in keys]
+            # let the gangs reach (or approach) Bound before the fault
+            cluster.wait_for_pods_scheduled(all_keys, timeout=5.0)
+
+            if phase == 0:
+                report.phases.append("heartbeat-loss")
+                victim = rng.choice(_healthy_node_names(api) or ["churn-n0"])
+                heartbeater.silence(victim)
+                # long enough for NotReady + eviction-grace lapse
+                time.sleep(1.2)
+                heartbeater.restore(victim)
+            elif phase == 1:
+                report.phases.append("node-kill")
+                bound_nodes = sorted({p.spec.node_name
+                                      for k in all_keys
+                                      for p in [api.peek(srv.PODS, k)]
+                                      if p is not None and p.spec.node_name})
+                victim = (rng.choice(bound_nodes) if bound_nodes
+                          else f"churn-n{rng.randrange(nodes)}")
+                try:
+                    api.delete(srv.NODES, "/" + victim)
+                    report.node_kills += 1
+                except srv.NotFound:
+                    pass
+                _make_hb_node(api, f"churn-r{spare}")   # replacement
+                spare += 1
+            elif phase == 2:
+                report.phases.append("cordon-storm")
+                names = _healthy_node_names(api)
+                rng.shuffle(names)
+                storm = names[: max(1, len(names) // 2)]
+                for n in storm:
+                    api.patch(srv.NODES, "/" + n,
+                              lambda x: setattr(x.spec, "unschedulable",
+                                                True))
+                time.sleep(0.4)
+                for n in storm:
+                    try:
+                        api.patch(srv.NODES, "/" + n,
+                                  lambda x: setattr(x.spec, "unschedulable",
+                                                    False))
+                    except srv.NotFound:
+                        pass
+            elif phase == 3:
+                report.phases.append("flapping-ready")
+                victim = rng.choice(_healthy_node_names(api) or ["churn-n0"])
+                for _ in range(3):
+                    heartbeater.silence(victim)
+                    time.sleep(0.7)     # > heartbeat grace: Ready flips
+                    heartbeater.restore(victim)
+                    time.sleep(0.3)
+            else:
+                report.phases.append("healthy+api-blips")
+                # arm the rules, THEN submit another gang: its whole
+                # schedule-and-bind flow (and the controllers' sweeps) runs
+                # under API blips compounding with the node-health machinery
+                injector.set_rules([FaultRule(
+                    name="blip", verbs=("get", "try_get", "list", "patch",
+                                        "bind", "create", "delete"),
+                    error="unavailable", probability=0.3,
+                    max_injections=40)])
+                name = f"ng{gen}-b"
+                created[name] = _make_gang(api, name, members)
+                all_keys += created[name]
+                cluster.wait_for_pods_scheduled(created[name], timeout=5.0)
+                injector.clear()
+
+            # the fault is over: every gang must converge onto healthy
+            # hardware — this wait IS the C6 assertion
+            _check_no_wedge(api, all_keys, report,
+                            ctx=f"round{report.rounds}:{report.phases[-1]}",
+                            timeout_s=round_timeout_s)
+
+            # cleanup (PG first so the repair controller forgets the gang
+            # before its pods' deletions could look like losses)
+            for name, keys in created.items():
+                try:
+                    api.delete(srv.POD_GROUPS, f"default/{name}")
+                except srv.NotFound:
+                    pass
+                for k in keys:
+                    try:
+                        api.delete(srv.PODS, k)
+                    except srv.NotFound:
+                        pass
+            all_keys_snapshot = list(all_keys)
+            wait_until(lambda: all(api.peek(srv.PODS, k) is None
+                                   for k in all_keys_snapshot), timeout=5.0)
+            gen += 1
+            report.rounds += 1
+
+        report.cycles = int(schedule_attempts.value() - cycles0)
+        report.binds = int(bind_total.value() - binds0)
+        report.retries = int(api_retries.value() - retries0)
+        report.injections = injector.stats()["injections_total"]
+        report.not_ready_transitions = int(
+            node_not_ready_transitions.value() - nr0)
+        report.evictions = int(node_pod_evictions.value() - ev0)
+        report.repairs = int(gang_repairs.value() - rep0)
+        report.stuck_findings = int(gang_stuck_total.value() - stuck0)
+        mismatches = equiv_cache_differential_mismatches.value() - mismatch0
+        if mismatches:
+            report.violations.append(
+                f"C4: {int(mismatches)} equivalence-cache differential "
+                "mismatches under node churn")
+        report.violations.extend(monitor.violations)
+    finally:
+        injector.clear()
+        heartbeater.stop()
+        monitor.close()
+        for c in (lifecycle, repair, pg_ctrl):
+            try:
+                c.stop()
+            except Exception:   # noqa: BLE001 — teardown is best-effort
+                pass
+        cluster.stop()
+        trace.install_recorder(prev_recorder)
+    return report
 
 
 def _check_gangs_quiesced(api: APIServer, report: ChaosReport) -> None:

@@ -21,6 +21,7 @@ flight-recorder entry.
 """
 from __future__ import annotations
 
+import itertools as _itertools
 import os
 import threading
 import time as _time
@@ -38,7 +39,7 @@ __all__ = [
     "MAX_SPANS_PER_TRACE", "summarize_diagnosis", "export",
     "default_recorder", "install_recorder", "enabled", "set_enabled",
     "current", "activate", "deactivate", "span", "annotate",
-    "record_rejection", "record_anomaly",
+    "record_rejection", "record_anomaly", "pin_event",
 ]
 
 _tls = threading.local()
@@ -149,3 +150,28 @@ def record_anomaly(kind: str, **detail: Any) -> None:
     tr = current()
     if tr is not None:
         tr.add_anomaly(kind, **detail)
+
+
+_event_seq = _itertools.count(1)
+
+
+def pin_event(kind: str, subject: str = "",
+              recorder: Optional[FlightRecorder] = None,
+              **detail: Any) -> None:
+    """Pin an OUT-OF-CYCLE anomaly: controller/watchdog events with no
+    scheduling cycle to attach to (node NotReady transitions, gang repair,
+    stuck-gang findings, node removal with bound pods). Builds a minimal
+    trace shell whose only content is the anomaly and commits it final —
+    it shows up in /debug/flightrecorder's pinned set and counts into
+    ``tpusched_flight_recorder_anomalies_total`` exactly like an in-cycle
+    anomaly. No-op while tracing is disabled."""
+    if not _enabled:
+        return
+    rec = recorder if recorder is not None else _default
+    now = _time.time()
+    tr = CycleTrace(trace_id=f"e{next(_event_seq):08x}", pod_key=subject,
+                    pod_uid="", gang=None, attempt=0, scheduler="",
+                    wall_start=now, first_enqueue=now, queue_wait_s=0.0)
+    tr.add_anomaly(kind, **detail)
+    tr.finish(kind)
+    rec.commit(tr, final=True, now=now)

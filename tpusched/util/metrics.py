@@ -310,6 +310,31 @@ gang_bind_rollbacks = REGISTRY.counter(
     "tpusched_gang_bind_rollbacks_total",
     "Whole-gang rollbacks after a terminal mid-gang bind failure.")
 
+# Node & slice failure resilience (controllers/nodelifecycle.py,
+# controllers/gangrepair.py, the scheduler's stuck-gang watchdog).
+# nodes_not_ready is the CURRENT count of heartbeat-managed nodes holding a
+# Ready=False condition (set by the lifecycle sweep); transitions counts
+# every Ready→NotReady edge. node_pod_evictions counts pods deleted off
+# dead/NotReady nodes (grace-lapsed eviction + orphan GC). gang_repairs
+# counts whole-gang repair actions (restart-gang or backfill) after member
+# loss to dead hardware; gang_stuck counts watchdog no-progress findings
+# (each also pins a gang_stuck anomaly trace).
+nodes_not_ready = REGISTRY.gauge(
+    "tpusched_nodes_not_ready",
+    "Heartbeat-managed nodes currently holding a Ready=False condition.")
+node_not_ready_transitions = REGISTRY.counter(
+    "tpusched_node_not_ready_transitions_total",
+    "Ready→NotReady transitions marked by the node lifecycle controller.")
+node_pod_evictions = REGISTRY.counter(
+    "tpusched_node_pod_evictions_total",
+    "Pods evicted off dead/NotReady nodes by the lifecycle controller.")
+gang_repairs = REGISTRY.counter(
+    "tpusched_gang_repairs_total",
+    "Whole-gang repair actions after member loss to dead hardware.")
+gang_stuck_total = REGISTRY.counter(
+    "tpusched_gang_stuck_total",
+    "Stuck-gang watchdog findings (no scheduling progress past deadline).")
+
 # Upstream framework_extension_point_duration_seconds analog. Deliberate
 # divergence: the per-node Filter/Score sweeps are recorded once per CYCLE
 # (the whole sweep), not once per node — at 1024-host scale a per-node
