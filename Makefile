@@ -60,8 +60,17 @@ chaos-smoke:
 # CPU-only JAX, collection errors tolerated but counted. Mirrors the
 # "Tier-1 verify" command in ROADMAP.md, plus the trace-smoke and
 # chaos-smoke gates.
+# Observability smoke (the why-pending/metrics gate, part of the tier1
+# flow): /debug/explain + explain CLI against real wedged gangs
+# (quota-blocked, fragmentation-blocked, unhealthy-node) and Prometheus
+# text-exposition validation via a parser-based round trip.
+.PHONY: obs-smoke
+obs-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs_explain.py \
+		tests/test_metrics_conformance.py -q -p no:cacheprovider
+
 .PHONY: tier1
-tier1: chaos-smoke trace-smoke
+tier1: chaos-smoke trace-smoke obs-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
@@ -74,7 +83,13 @@ native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
 
 .PHONY: verify
-verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls verify-node-health-filters
+verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls verify-node-health-filters verify-metrics-names
+
+# Prometheus naming contract: tpusched_ prefix, _total/_seconds suffix
+# conventions, no duplicate registrations.
+.PHONY: verify-metrics-names
+verify-metrics-names:
+	hack/verify-metrics-names.sh
 
 .PHONY: verify-naked-api-calls
 verify-naked-api-calls:

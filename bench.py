@@ -201,7 +201,19 @@ def run_gang_once(state_dir: str | None = None, fsync: bool = False) -> float:
 
 
 def bench_gang() -> None:
-    times = _repeat(run_gang_once, GANG_REPEATS)
+    from tpusched import obs
+    run_gang_once()   # warmup: imports + first-touch caches uncounted
+    # fresh SLO tracker installed AFTER the warmup: the summary below then
+    # describes exactly the counted runs (pod-e2e fed per bind by the
+    # scheduler, gang-bound fed by Coscheduling's quorum clock) — the
+    # warmup's cold-cache binds must not burn the reported window any
+    # more than they count into the latency stats
+    # window sized for every counted event (24 runs x 256 pods), so the
+    # reported p50/p99 and the breach counts describe the SAME window
+    obs.install_slo(obs.SLOTracker(pod_e2e_s=NORTH_STAR_S,
+                                   gang_bound_s=NORTH_STAR_S,
+                                   window=GANG_REPEATS * 256 + 64))
+    times = [run_gang_once() for _ in range(GANG_REPEATS)]
     # BASELINE metric "TPU chip bin-pack %": run_gang_once RAISES unless the
     # gang lands on exactly 64 hosts x 4 chips, so surviving n runs proves
     # zero chip stranding on every one of them
@@ -212,6 +224,19 @@ def bench_gang() -> None:
         "256-pod gang PodGroup-to-Bound p99 "
         "(Coscheduling+TpuSlice, emulated v5p pool, 64 hosts)",
         times, "gang_p99")
+    # scheduling SLO summary (ISSUE 5): p50/p99 vs the objective + burn
+    # counts, one BENCH line per objective — the perf-trajectory signal
+    # beyond raw latency (a future PR that keeps p99 flat but doubles the
+    # breach tail moves these numbers)
+    for name, s in sorted(obs.default_slo().summary().items()):
+        emit(f"scheduling SLO [{name}] over the headline-gang window: "
+             f"objective {s['objective_s']}s, p50 {s['p50_s']}s / "
+             f"p99 {s['p99_s']}s, {s['breaches']}/{s['events']} breaches, "
+             f"burn rate {s['burn_rate']}",
+             s["attainment"], "fraction", None,
+             objective_s=s["objective_s"], p50_s=s["p50_s"],
+             p99_s=s["p99_s"], breaches=s["breaches"], events=s["events"],
+             burn_rate=s["burn_rate"])
 
 
 def _wal_dir_run(fsync: bool) -> float:

@@ -1,0 +1,233 @@
+"""Prometheus text-exposition conformance + the labeled metric families.
+
+A parser-based round trip: everything ``Registry.expose()`` emits must
+parse back under the exposition-format grammar — sample names, escaped
+label values (backslash/newline/quote), escaped HELP text, exactly one
+``# TYPE`` per family emitted before its first sample, cumulative
+histogram buckets.  Plus units for the PR 5 satellite work:
+CounterVec/GaugeVec semantics and scrape-time collectors.
+"""
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from tpusched.util.metrics import (REGISTRY, CounterVec, GaugeVec, Registry,
+                                   escape_label_value, format_labels)
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[0-9eE+.naif-]+)$")
+
+
+def parse_label_pairs(raw: str):
+    """Parse `k="v",...` honoring \\\\ \\" \\n escapes; raises on garbage."""
+    out = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.index("=", i)
+        key = raw[i:eq]
+        assert _NAME.match(key), f"bad label name {key!r}"
+        assert raw[eq + 1] == '"', raw
+        j = eq + 2
+        val = []
+        while raw[j] != '"':
+            if raw[j] == "\\":
+                nxt = raw[j + 1]
+                assert nxt in ('\\', '"', 'n'), f"bad escape \\{nxt}"
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                j += 2
+            else:
+                val.append(raw[j])
+                j += 1
+        out[key] = "".join(val)
+        i = j + 1
+        if i < len(raw):
+            assert raw[i] == ",", raw
+            i += 1
+    return out
+
+
+def parse_exposition(text: str):
+    """Validating parser: returns (types, helps, samples).  Asserts the
+    grammar invariants a real Prometheus scraper enforces."""
+    types, helps = {}, {}
+    samples = []
+    current_family = None
+    sampled_families = set()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert _NAME.match(name), name
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps[name] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            assert _NAME.match(name), name
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), mtype
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert name not in sampled_families, \
+                f"TYPE for {name} after its samples"
+            types[name] = mtype
+            current_family = name
+            continue
+        assert not line.startswith("#"), f"unknown comment {line!r}"
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        family = base if base in types else name
+        assert family == current_family, \
+            f"sample {name} outside its family block ({current_family})"
+        sampled_families.add(family)
+        labels = parse_label_pairs(m.group("labels")) \
+            if m.group("labels") else {}
+        samples.append((name, labels, float(m.group("value"))))
+    return types, helps, samples
+
+
+def test_registry_exposition_round_trips():
+    """The full global registry (every metric the scheduler ever
+    registered in this process) parses clean."""
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert "tpusched_podgroup_to_bound_duration_seconds" in types
+    assert types["tpusched_podgroup_to_bound_duration_seconds"] == "histogram"
+    assert types["tpusched_bind_total"] == "counter"
+    assert samples
+
+
+def test_label_value_escaping_round_trips():
+    reg = Registry()
+    hostile = 'a"b\\c\nd'
+    vec = reg.gauge_vec("tpusched_esc_test_info", ("who",), "esc \\ test\n2")
+    vec.with_labels(hostile).set(7)
+    types, helps, samples = parse_exposition(reg.expose())
+    assert helps["tpusched_esc_test_info"] == "esc \\\\ test\\n2"
+    (name, labels, value), = samples
+    assert name == "tpusched_esc_test_info"
+    assert labels == {"who": hostile}          # the round trip
+    assert value == 7.0
+
+
+def test_histogram_vec_label_escaping_and_bucket_monotonicity():
+    reg = Registry()
+    vec = reg.histogram_vec("tpusched_h_test_seconds", ("op",), "h")
+    vec.with_labels('x"y').observe(0.003)
+    vec.with_labels('x"y').observe(2.0)
+    types, _, samples = parse_exposition(reg.expose())
+    assert types["tpusched_h_test_seconds"] == "histogram"
+    buckets = [(labels, v) for name, labels, v in samples
+               if name.endswith("_bucket")]
+    assert all(labels["op"] == 'x"y' for labels, _ in buckets)
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)            # cumulative
+    count = [v for name, labels, v in samples if name.endswith("_count")]
+    assert count == [2.0]
+    # +Inf bucket equals _count
+    inf = [v for labels, v in buckets if labels["le"] == "+Inf"]
+    assert inf == [2.0]
+
+
+def test_counter_vec_children_and_total():
+    reg = Registry()
+    vec = reg.counter_vec("tpusched_cv_test_total", ("verb",), "cv")
+    assert isinstance(vec, CounterVec)
+    vec.with_labels("bind").inc()
+    vec.with_labels("bind").inc()
+    vec.with_labels("patch").inc(3)
+    assert vec.value() == 5.0                  # family total
+    assert vec.children()[("bind",)].value() == 2.0
+    with pytest.raises(ValueError):
+        vec.with_labels("a", "b")
+    _, _, samples = parse_exposition(reg.expose())
+    assert (("tpusched_cv_test_total", {"verb": "patch"}, 3.0)) in samples
+    # stable child ordering: bind before patch
+    verbs = [labels["verb"] for _, labels, _ in samples]
+    assert verbs == sorted(verbs)
+
+
+def test_gauge_vec_remove_and_clear():
+    reg = Registry()
+    vec = reg.gauge_vec("tpusched_gv_test_chips", ("pool",), "gv")
+    assert isinstance(vec, GaugeVec)
+    vec.with_labels("a").set(1)
+    vec.with_labels("b").set(2)
+    vec.remove("a")
+    assert set(vec.children()) == {("b",)}
+    vec.clear()
+    assert vec.children() == {}
+    # an empty family emits no orphan HELP/TYPE header
+    assert "tpusched_gv_test_chips" not in reg.expose()
+
+
+def test_collectors_run_at_scrape_and_never_break_expose():
+    reg = Registry()
+    vec = reg.gauge_vec("tpusched_coll_test_chips", ("pool",), "c")
+    calls = [0]
+
+    def collect():
+        calls[0] += 1
+        vec.with_labels("p0").set(calls[0])
+
+    def broken():
+        raise RuntimeError("collector bug")
+    reg.register_collector(collect)
+    reg.register_collector(broken)
+    _, _, samples = parse_exposition(reg.expose())
+    assert (("tpusched_coll_test_chips", {"pool": "p0"}, 1.0)) in samples
+    reg.expose()
+    assert calls[0] == 2
+    reg.unregister_collector(collect)
+    reg.expose()
+    assert calls[0] == 2
+
+
+def test_gauge_func_series_share_one_family_header():
+    reg = Registry()
+    reg.gauge_func("tpusched_gf_test_depth", lambda: 1, "gf",
+                   labels='queue="active"')
+    reg.gauge_func("tpusched_gf_test_depth", lambda: 2, "gf",
+                   labels='queue="backoff"')
+    types, _, samples = parse_exposition(reg.expose())
+    assert types["tpusched_gf_test_depth"] == "gauge"
+    assert len([s for s in samples
+                if s[0] == "tpusched_gf_test_depth"]) == 2
+
+
+def test_migrated_counters_carry_labels():
+    """The PR 5 migration: api retries by verb, flight-recorder anomalies
+    by kind — labeled children, with the family total still readable via
+    .value() (the pre-migration call-site contract)."""
+    from tpusched import trace
+    from tpusched.util.metrics import (api_retries,
+                                       flight_recorder_anomalies)
+    assert isinstance(api_retries, CounterVec)
+    before_total = flight_recorder_anomalies.value()
+    before_kind = flight_recorder_anomalies.with_labels(
+        "conformance_test_kind").value()
+    rec = trace.FlightRecorder()
+    tr = trace.CycleTrace("t1", "default/p", "u1", None, 0, "s",
+                          0.0, 0.0, 0.0)
+    tr.add_anomaly("conformance_test_kind", detail="x")
+    rec.pin(tr)
+    assert flight_recorder_anomalies.with_labels(
+        "conformance_test_kind").value() == before_kind + 1
+    assert flight_recorder_anomalies.value() == before_total + 1
+    text = REGISTRY.expose()
+    assert ('tpusched_flight_recorder_anomalies_total'
+            '{kind="conformance_test_kind"}') in text
+
+
+def test_escape_helpers():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert format_labels(("k",), ('v"',)) == 'k="v\\""'

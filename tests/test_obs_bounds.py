@@ -1,0 +1,138 @@
+"""Diagnosis-engine memory bounds: the 10k-cycle soak.
+
+Mirror of the PR 2 flight-recorder ring soak: an always-on control plane
+must hold its memory ceiling through ANY workload — thousands of distinct
+pods churning through failure/resolution, per-pod reason-row growth, gang
+index growth.  Asserts the entry and byte budgets hold at every step (not
+just at the end), that resolved pods are evicted immediately, and that
+the LRU keeps the MOST RECENT pods when over budget.
+"""
+from __future__ import annotations
+
+import threading
+
+from tpusched.obs import DiagnosisEngine
+from tpusched.obs.diagnosis import MAX_ROWS_PER_POD
+
+
+def test_diagnosis_engine_10k_cycle_soak_stays_bounded():
+    eng = DiagnosisEngine(max_pods=256, max_bytes=128 * 1024)
+    peak_pods = peak_bytes = 0
+    for i in range(10_000):
+        pod = f"default/p-{i % 3000:04d}"
+        gang = f"default/g-{i % 211:03d}" if i % 3 else None
+        eng.on_attempt(
+            pod, gang, "unschedulable", "TpuSlice",
+            f"0/{64 + i % 5} nodes are available: insufficient resource "
+            f"google.com/tpu",
+            [{"plugin": "TpuSlice",
+              "reason": "insufficient resource google.com/tpu",
+              "nodes": 1 + i % 64},
+             {"plugin": "NodeResourcesFit",
+              "reason": f"Insufficient cpu ({i % 9} tried)",
+              "nodes": i % 8}],
+            attempt=i % 7)
+        if i % 5 == 0:
+            eng.on_resolved(f"default/p-{(i * 7) % 3000:04d}")
+        if i % 97 == 0:
+            s = eng.stats()
+            peak_pods = max(peak_pods, s["pods"])
+            peak_bytes = max(peak_bytes, s["approx_bytes"])
+            assert s["pods"] <= 256, i
+            assert s["approx_bytes"] <= 128 * 1024, i
+    s = eng.stats()
+    assert s["pods"] <= 256 and s["approx_bytes"] <= 128 * 1024
+    assert s["fed_total"] == 10_000
+    assert s["evicted_total"] > 0              # the soak DID hit the cap
+    # the table actually filled toward its budgets (the byte cap is the
+    # binding constraint for this workload's row sizes)
+    assert peak_pods >= 200 and peak_bytes >= 100 * 1024
+    # internal consistency after the churn: blocker counts sum to pods
+    assert sum(b["pods"] for b in eng.top_blockers(100)) == s["pods"]
+    # LRU: the very last pod fed (i=9999 → p-0999) must have survived
+    assert eng.explain_pod("default/p-0999") is not None
+
+
+def test_resolved_pods_evict_immediately_and_gang_index_follows():
+    eng = DiagnosisEngine()
+    for i in range(4):
+        eng.on_attempt(f"default/m-{i}", "default/g", "unschedulable",
+                       "Coscheduling", "not enough siblings", None)
+    assert eng.explain_gang("default/g")["members_pending"] == 4
+    for i in range(4):
+        eng.on_resolved(f"default/m-{i}")
+    assert eng.explain_pod("default/m-0") is None
+    assert eng.explain_gang("default/g") is None      # index cleaned up
+    assert eng.stats()["gangs"] == 0
+    assert eng.top_blockers() == []                   # rollup decremented
+
+
+def test_per_pod_reason_rows_bounded():
+    eng = DiagnosisEngine()
+    for i in range(100):
+        eng.on_attempt("default/noisy", None, "unschedulable",
+                       f"Plugin{i}", f"distinct reason {i} with text", None)
+    out = eng.explain_pod("default/noisy")
+    assert len(out["reasons"]) <= MAX_ROWS_PER_POD
+    # the headline verdict keeps updating even when rows are saturated
+    assert out["blocking_plugin"] == "Plugin99"
+    assert out["attempts"] == 100
+
+
+def test_repeat_attempts_aggregate_not_duplicate():
+    eng = DiagnosisEngine()
+    for attempt in range(5):
+        eng.on_attempt(
+            "default/p", "default/g", "unschedulable", "CapacityScheduling",
+            f"Pod default/p is rejected in PreFilter because ElasticQuota "
+            f"research is more than Max (attempt {attempt})",
+            [{"plugin": "CapacityScheduling",
+              "reason": "quota used would exceed Max", "nodes": 48}])
+    out = eng.explain_pod("default/p")
+    # per-attempt variance (the attempt counter) collapsed to ONE row
+    quota_rows = [r for r in out["reasons"]
+                  if r["plugin"] == "CapacityScheduling"]
+    assert len(quota_rows) == 2                # headline + diagnosis row
+    assert all(r["cycles"] == 5 for r in quota_rows)
+    assert any(r["nodes"] == 48 for r in quota_rows)
+    assert "quota" in out["suggestion"]
+
+
+def test_concurrent_feed_and_read():
+    """Binding-pool threads feed failures while /debug/explain reads —
+    no torn state, bounds hold."""
+    eng = DiagnosisEngine(max_pods=64, max_bytes=64 * 1024)
+    stop = threading.Event()
+    errors = []
+
+    def feeder(tid: int):
+        try:
+            for i in range(2000):
+                eng.on_attempt(f"default/t{tid}-{i % 100}",
+                               f"default/g{tid}", "unschedulable",
+                               "TpuSlice", "insufficient resource", None)
+                if i % 3 == 0:
+                    eng.on_resolved(f"default/t{tid}-{(i + 1) % 100}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                eng.top_blockers()
+                eng.explain_gang("default/g0")
+                eng.dump()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+    threads = [threading.Thread(target=feeder, args=(t,)) for t in range(3)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    assert not errors
+    s = eng.stats()
+    assert s["pods"] <= 64 and s["approx_bytes"] <= 64 * 1024

@@ -1,0 +1,435 @@
+"""Why-pending diagnosis end-to-end (the ISSUE 5 acceptance tier).
+
+A wedged gang — quota-blocked, fragmentation-blocked, and unhealthy-node —
+must be fully diagnosable from ``/debug/explain`` / the explain CLI ALONE:
+blocking plugin, top rejection reasons with node counts, and the
+suggested unblock signal.  Plus the capacity/fragmentation gauges, the
+SLO layer, and the config-surface decode for the objectives.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpusched import obs
+from tpusched.api.core import TAINT_NODE_NOT_READY, Taint
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import full_stack_profile, tpu_gang_profile
+from tpusched.testing import (TestCluster, make_elastic_quota, make_pod,
+                              make_pod_group, make_tpu_node, make_tpu_pool,
+                              wait_until)
+from tpusched.util.httpserve import MetricsServer
+from tpusched.util.metrics import REGISTRY
+
+
+@pytest.fixture()
+def fresh_obs():
+    """Isolate each test's diagnosis/SLO state in fresh global instances
+    (schedulers capture the globals at construction)."""
+    old_engine, old_slo = obs.default_engine(), obs.default_slo()
+    engine = obs.install_engine(obs.DiagnosisEngine())
+    slo = obs.install_slo(obs.SLOTracker())
+    yield engine, slo
+    obs.install_engine(old_engine)
+    obs.install_slo(old_slo)
+
+
+def _get_json(port: int, path: str):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_quota_blocked_gang_diagnosable_from_explain_alone(fresh_obs):
+    """10-member gang under an ElasticQuota that fits 9: nine park at the
+    permit barrier, the tenth bounces off CapacityScheduling forever.  The
+    /debug/explain JSON alone names the blocking plugin, the quota reason,
+    and the quota unblock signal."""
+    with TestCluster(profile=full_stack_profile(permit_wait_s=120)) as c:
+        c.add_nodes([make_tpu_node("n1", chips=8),
+                     make_tpu_node("n2", chips=8)])
+        c.api.create(srv.ELASTIC_QUOTAS,
+                     make_elastic_quota("research", "research",
+                                        min={TPU: 9}, max={TPU: 9}))
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("train", namespace="research",
+                                    min_member=10))
+        pods = [make_pod(f"m-{i}", namespace="research", pod_group="train",
+                         limits={TPU: 1}) for i in range(10)]
+        c.create_pods(pods)
+
+        def waiting_count():
+            n = [0]
+            c.scheduler.framework.iterate_over_waiting_pods(
+                lambda wp: n.__setitem__(0, n[0] + 1))
+            return n[0]
+        assert wait_until(lambda: waiting_count() == 9, timeout=15)
+        engine, _ = fresh_obs
+        assert wait_until(
+            lambda: (engine.explain_gang("research/train") or {})
+            .get("outcomes", {}).get("unschedulable", 0) >= 1, timeout=10)
+
+        server = MetricsServer(port=0).start()
+        try:
+            status, out = _get_json(server.port,
+                                    "/debug/explain?gang=research/train")
+            _, metrics_text = _fetch_text(server.port, "/metrics")
+        finally:
+            server.stop()
+
+    # ---- everything below reads ONLY the endpoint payloads ----
+    assert status == 200
+    assert out["gang"] == "research/train"
+    assert out["members_pending"] == 10
+    assert out["outcomes"]["waiting-permit"] == 9
+    assert out["outcomes"]["unschedulable"] == 1
+    # the permit barrier (stitched from the tracer) names its holder
+    assert out["permit_barrier"]["resolved"] is False
+    assert out["permit_barrier"]["blocking_plugins"] == ["Coscheduling"]
+    reasons = {(r["plugin"], r["reason"]): r for r in out["top_reasons"]}
+    quota_rows = [r for (p, _), r in reasons.items()
+                  if p == "CapacityScheduling"]
+    assert quota_rows, out["top_reasons"]
+    assert any("more than Max" in r["reason"] for r in quota_rows)
+    # node counts ride along (the PreFilter rejection covers every node)
+    assert any(r["nodes"] == 2 for r in quota_rows)
+    # the suggested unblock signal is the QUOTA, not the barrier echo
+    assert "quota" in out["suggestion"].lower()
+    # and the quota gauges confirm the story: 9 chips used of min 9
+    assert 'tpusched_quota_used_chips{namespace="research"} 9' \
+        in metrics_text
+    assert 'tpusched_quota_utilization{namespace="research"} 1.0' \
+        in metrics_text
+
+
+def _fetch_text(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_fragmentation_blocked_gang_and_pool_gauges(fresh_obs):
+    """A 4x4x4 slice gang blocked because a resident 2x2x2 gang fragments
+    the pool: TopologyMatch attribution + the defrag unblock signal from
+    /debug/explain, and the pool gauges quantify it (free chips >>
+    largest placeable window)."""
+    engine, _ = fresh_obs
+    with TestCluster(profile=tpu_gang_profile()) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("small", min_member=8,
+                                    tpu_slice_shape="2x2x2",
+                                    tpu_accelerator="tpu-v5p"))
+        small = [make_pod(f"s-{i}", pod_group="small", limits={TPU: 1},
+                          requests=make_resources(cpu=1, memory="1Gi"))
+                 for i in range(8)]
+        c.create_pods(small)
+        assert c.wait_for_pods_scheduled([p.key for p in small], timeout=30)
+
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("big", min_member=16,
+                                    tpu_slice_shape="4x4x4",
+                                    tpu_accelerator="tpu-v5p"))
+        big = [make_pod(f"b-{i}", pod_group="big", limits={TPU: 4})
+               for i in range(16)]
+        c.create_pods(big)
+        assert wait_until(
+            lambda: (engine.explain_gang("default/big") or {})
+            .get("members_pending", 0) == 16, timeout=15)
+
+        server = MetricsServer(port=0).start()
+        try:
+            status, out = _get_json(server.port,
+                                    "/debug/explain?gang=default/big")
+            _, metrics_text = _fetch_text(server.port, "/metrics")
+        finally:
+            server.stop()
+
+    assert status == 200
+    topo_rows = [r for r in out["top_reasons"]
+                 if r["plugin"] == "TopologyMatch"]
+    assert topo_rows and "no feasible" in topo_rows[0]["reason"]
+    # node counts: the rejection covered the whole 16-host pool
+    assert topo_rows[0]["nodes"] == 16
+    # the unblock signal points at fragmentation/defrag tooling
+    assert "defrag" in out["suggestion"]
+    # gauges: 56 chips free but only a 32-chip window placeable — the
+    # free-vs-largest gap IS the fragmentation diagnosis
+    assert 'tpusched_pool_capacity_chips{pool="pool-a"} 64' in metrics_text
+    assert 'tpusched_pool_free_chips{pool="pool-a"} 56' in metrics_text
+    assert ('tpusched_pool_largest_placeable_chips{pool="pool-a"} 32'
+            in metrics_text)
+    frag = [ln for ln in metrics_text.splitlines()
+            if ln.startswith('tpusched_pool_fragmentation_ratio')]
+    assert frag and 0.0 < float(frag[0].split()[-1]) < 1.0
+
+
+def test_unhealthy_node_gang_diagnosable(fresh_obs):
+    """Every candidate node carries the lifecycle controller's not-ready
+    taint: the diagnosis names the health reason and the repair runbook
+    suggestion."""
+    engine, _ = fresh_obs
+    with TestCluster() as c:
+        nodes = [make_tpu_node(f"n{i}", chips=4) for i in range(3)]
+        for n in nodes:
+            n.spec.taints.append(Taint(key=TAINT_NODE_NOT_READY,
+                                       effect="NoSchedule"))
+        c.add_nodes(nodes)
+        c.create_pods([make_pod("sick", limits={TPU: 1})])
+        assert wait_until(
+            lambda: engine.explain_pod("default/sick") is not None,
+            timeout=10)
+        server = MetricsServer(port=0).start()
+        try:
+            status, out = _get_json(server.port,
+                                    "/debug/explain?pod=default/sick")
+        finally:
+            server.stop()
+    assert status == 200
+    assert out["last_outcome"] == "unschedulable"
+    rows = {r["reason"]: r for r in out["reasons"]}
+    taint_rows = [r for r in rows.values() if "not-ready" in r["reason"]]
+    assert taint_rows, rows
+    assert any(r["nodes"] == 3 for r in taint_rows)   # all 3 nodes counted
+    assert "repair" in out["suggestion"] or "unhealthy" in out["suggestion"]
+
+
+def test_explain_endpoint_rollup_and_404(fresh_obs):
+    engine, slo = fresh_obs
+    engine.on_attempt("default/p1", None, "unschedulable", "TpuSlice",
+                      "insufficient resource google.com/tpu", None)
+    slo.observe(obs.POD_E2E, 0.5)
+    slo.observe(obs.POD_E2E, 9.0)              # breach
+    server = MetricsServer(port=0).start()
+    try:
+        status, out = _get_json(server.port, "/debug/explain")
+        assert status == 200
+        assert out["stats"]["pods"] == 1
+        assert out["top_blockers"][0]["plugin"] == "TpuSlice"
+        assert "suggestion" in out["top_blockers"][0]
+        s = out["slo"]["pod_e2e"]
+        assert s["events"] == 2 and s["breaches"] == 1
+        assert s["objective_s"] == obs.DEFAULT_POD_E2E_S
+        status, err = _get_json(server.port, "/debug/explain?pod=nope")
+        assert status == 404 and "error" in err
+        status, err = _get_json(server.port, "/debug/explain?gang=nope")
+        assert status == 404 and "error" in err
+    finally:
+        server.stop()
+
+
+def test_explain_cli_renders_and_exit_codes(fresh_obs, capsys):
+    from tpusched.cmd import explain
+    engine, _ = fresh_obs
+    engine.on_attempt("default/w-1", "default/g", "unschedulable",
+                      "CapacityScheduling",
+                      "Pod default/w-1 is rejected in PreFilter because "
+                      "ElasticQuota research is more than Max",
+                      [{"plugin": "CapacityScheduling",
+                        "reason": "quota used would exceed Max",
+                        "nodes": 48}])
+    server = MetricsServer(port=0).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        assert explain.main(["--url", url, "--pod", "w-1"]) == 0
+        out = capsys.readouterr().out
+        assert "CapacityScheduling" in out
+        assert "48 node(s)" in out
+        assert "unblock:" in out and "quota" in out
+        assert explain.main(["--url", url, "--gang", "default/g"]) == 0
+        out = capsys.readouterr().out
+        assert "1 member(s) still pending" in out
+        assert explain.main(["--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "top blockers" in out and "SLO" in out
+        # --json is machine-parseable
+        assert explain.main(["--url", url, "--pod", "w-1", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["pod"] == "default/w-1"
+        # not-found → exit 1
+        assert explain.main(["--url", url, "--pod", "ghost"]) == 1
+    finally:
+        server.stop()
+    # unreachable server → exit 2
+    assert explain.main(["--url", "http://127.0.0.1:1", "--pod", "x",
+                         "--timeout", "0.2"]) == 2
+
+
+def test_bound_pods_leave_the_diagnosis_and_feed_pod_e2e_slo(fresh_obs):
+    """The happy path: a pod that binds is evicted from the why-pending
+    table and its first-enqueue→bound latency lands in the pod_e2e SLO."""
+    engine, slo = fresh_obs
+    with TestCluster() as c:
+        c.add_nodes([make_tpu_node("n1", chips=4)])
+        c.create_pods([make_pod("ok", limits={TPU: 2})])
+        assert c.wait_for_pods_scheduled(["default/ok"])
+        assert wait_until(
+            lambda: slo.summary()["pod_e2e"]["events"] >= 1, timeout=5)
+    assert engine.explain_pod("default/ok") is None
+    s = slo.summary()["pod_e2e"]
+    assert s["events"] >= 1
+    assert s["p99_s"] < obs.DEFAULT_POD_E2E_S   # a 1-pod bind is fast
+    assert s["breaches"] == 0 and s["burn_rate"] == 0.0
+
+
+def test_gang_bound_slo_fed_by_quorum_completion(fresh_obs):
+    _, slo = fresh_obs
+    with TestCluster(profile=tpu_gang_profile()) as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(2, 2, 2))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.POD_GROUPS,
+                     make_pod_group("g", min_member=8,
+                                    tpu_slice_shape="2x2x2",
+                                    tpu_accelerator="tpu-v5p"))
+        pods = [make_pod(f"w-{i}", pod_group="g", limits={TPU: 1},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(8)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=30)
+        assert wait_until(
+            lambda: slo.summary()["gang_bound"]["events"] >= 1, timeout=5)
+    s = slo.summary()["gang_bound"]
+    assert s["events"] >= 1 and s["p50_s"] > 0.0
+    # the tpusched_slo_* families are on /metrics
+    text = REGISTRY.expose()
+    assert 'tpusched_slo_events_total{objective="gang_bound"}' in text
+    assert 'tpusched_slo_burn_rate{objective="gang_bound"}' in text
+    assert 'tpusched_slo_objective_seconds{objective="gang_bound"} 2.0' \
+        in text
+
+
+def test_slo_objectives_decode_from_config():
+    from tpusched.config.scheme import ConfigError, decode_profile
+    p = decode_profile({"schedulerName": "x",
+                        "slo": {"podE2ESeconds": 1.5,
+                                "gangBoundSeconds": 30}})
+    assert p.slo_pod_e2e_s == 1.5 and p.slo_gang_bound_s == 30.0
+    assert decode_profile({}).slo_pod_e2e_s == 2.0      # defaults hold
+    with pytest.raises(ConfigError):
+        decode_profile({"slo": {"podE2ESeconds": "fast"}})
+    with pytest.raises(ConfigError):
+        decode_profile({"slo": {"gangBoundSeconds": -1}})
+    with pytest.raises(ConfigError):
+        decode_profile({"slo": {"ttftSeconds": 1}})
+
+
+def test_shadow_scheduler_does_not_touch_global_observability(fresh_obs):
+    """What-if/defrag trials schedule forked state holding the SAME pod
+    keys as the live fleet: a shadow (telemetry=False) bind must not evict
+    the real pod's why-pending diagnosis, publish capacity gauges, or
+    burn the SLO."""
+    from tpusched.apiserver import APIServer
+    from tpusched.plugins import default_registry
+    from tpusched.sched import Scheduler
+    from tpusched.testing.cluster import default_profile
+    engine, slo = fresh_obs
+    # the "real" fleet state: a pod pending with a diagnosis
+    engine.on_attempt("default/p", None, "unschedulable", "TpuSlice",
+                      "insufficient resource google.com/tpu", None)
+    events_before = slo.summary()["pod_e2e"]["events"]
+    api = APIServer()
+    api.create(srv.NODES, make_tpu_node("n1", chips=4))
+    sched = Scheduler(api, default_registry(), default_profile(),
+                      telemetry=False)
+    sched.run()
+    try:
+        api.create(srv.PODS, make_pod("p", limits={TPU: 2}))
+        assert wait_until(
+            lambda: (api.peek(srv.PODS, "default/p") or make_pod("x"))
+            .spec.node_name, timeout=10)
+    finally:
+        sched.stop()
+    # the trial bound default/p — the REAL diagnosis entry must survive
+    assert engine.explain_pod("default/p") is not None
+    # no SLO burn from the trial bind
+    assert slo.summary()["pod_e2e"]["events"] == events_before
+    # no capacity collector registered for the shadow
+    assert sched._capacity is None
+    # and the trial's cycle traces went to a PRIVATE ring, not the global
+    # recorder the /debug/explain gang stitch reads
+    from tpusched import trace
+    assert sched.recorder is not trace.default_recorder()
+
+
+def test_largest_window_floor_never_false_zero():
+    """A pool whose free hosts are scattered single cells must report one
+    host block (the extent shape always fits a free host), never 0."""
+    from tpusched.obs import largest_window_chips
+    from tpusched.topology.torus import HostGrid
+    topo, _ = make_tpu_pool("p", dims=(8, 8, 4))
+    grid = HostGrid.from_spec(topo.spec)
+    # free = two isolated, non-adjacent host cells
+    free = frozenset({(0, 0, 0), (2, 2, 2)})
+    chips = largest_window_chips(grid, free)
+    assert chips == 4                     # one v5p host block (2x2x1)
+    assert largest_window_chips(grid, frozenset()) == 0
+    # a fully free pool places the whole torus
+    assert largest_window_chips(
+        grid, frozenset(grid.coord_of.values())) == 256
+
+
+def test_burn_window_rolls_over_consistently(fresh_obs):
+    """The O(1) rolling burn counter must agree with a recount after the
+    window wraps (breaches falling off the back are un-counted)."""
+    from tpusched.obs.slo import _WINDOW
+    t = obs.SLOTracker(pod_e2e_s=1.0, gang_bound_s=0)
+    for _ in range(_WINDOW):
+        t.observe(obs.POD_E2E, 2.0)            # all breaches
+    assert t.summary()["pod_e2e"]["burn_rate"] == 1.0
+    for _ in range(_WINDOW // 2):
+        t.observe(obs.POD_E2E, 0.1)            # half the window heals
+    s = t.summary()["pod_e2e"]
+    assert s["burn_rate"] == 0.5
+    for _ in range(_WINDOW):
+        t.observe(obs.POD_E2E, 0.1)            # fully healed
+    assert t.summary()["pod_e2e"]["burn_rate"] == 0.0
+    assert t.summary()["pod_e2e"]["breaches"] == _WINDOW  # cumulative kept
+
+
+def test_pool_occupancy_ignores_chipless_healthy_hosts():
+    """A healthy empty host advertising 0 allocatable chips (device plugin
+    not up yet) must not count as window-eligible — largest_placeable
+    would float above free_chips."""
+    from tpusched.obs import pool_occupancy
+    from tpusched.topology.torus import HostGrid
+    from tpusched.fwk.nodeinfo import NodeInfo, Snapshot
+    topo, nodes = make_tpu_pool("p", dims=(4, 4, 4))
+    for n in nodes:
+        n.status.allocatable[TPU] = 0          # chips not advertised
+        n.status.capacity[TPU] = 0
+    grid = HostGrid.from_spec(topo.spec)
+    snap = Snapshot(nodes=nodes)
+    free, free_chips, capacity = pool_occupancy(grid, snap)
+    assert free == frozenset() and free_chips == 0 and capacity == 0
+
+
+def test_install_slo_prunes_retired_objective_gauges(fresh_obs):
+    from tpusched.obs.slo import slo_objective_seconds
+    # current tracker exposes both objectives; the new one disables gangs
+    assert ("gang_bound",) in slo_objective_seconds.children()
+    obs.install_slo(obs.SLOTracker(pod_e2e_s=1.0, gang_bound_s=0))
+    assert ("gang_bound",) not in slo_objective_seconds.children()
+    assert ("pod_e2e",) in slo_objective_seconds.children()
+
+
+def test_scheduler_installs_profile_slo_targets(fresh_obs):
+    """A profile with non-default objectives re-installs the global
+    tracker; a same-target scheduler does not reset it."""
+    prof = tpu_gang_profile()
+    prof.slo_pod_e2e_s = 0.25
+    prof.slo_gang_bound_s = 7.5
+    with TestCluster(profile=prof):
+        assert obs.default_slo().targets == (0.25, 7.5)
+        t = obs.default_slo()
+    with TestCluster(profile=prof):
+        assert obs.default_slo() is t          # same targets: kept

@@ -134,13 +134,13 @@ class _KindClient:
                 delay = backoff * (1 + pol.jitter * (2 * _RNG.random() - 1))
                 if (attempt >= pol.max_attempts
                         or time.monotonic() + delay > deadline):
-                    api_retry_exhausted.inc()
+                    api_retry_exhausted.with_labels(verb).inc()
                     self._hooks.on_retry_exhausted(verb, self._kind, e)
                     klog.V(3).info_s("api retry budget exhausted",
                                      verb=verb, kind=self._kind, key=key,
                                      attempts=attempt, err=str(e))
                     raise
-                api_retries.inc()
+                api_retries.with_labels(verb).inc()
                 self._annotate_retry(verb, key, attempt, delay, e)
                 time.sleep(delay)
                 backoff = min(backoff * 2, pol.max_backoff_s)

@@ -6,4 +6,7 @@
   loop.
 - ``python -m tpusched.cmd.controller`` — the controller manager: PodGroup +
   ElasticQuota reconcilers with optional leader election.
+- ``python -m tpusched.cmd.explain`` — why-pending diagnosis client: asks a
+  running scheduler's ``/debug/explain`` endpoint why a pod or gang is
+  still pending and what would unblock it.
 """

@@ -1,0 +1,145 @@
+"""The explain binary: "why is my pod/gang still pending?" from a shell.
+
+Queries a running scheduler's ``/debug/explain`` endpoint (the why-pending
+diagnosis engine, ``tpusched/obs``) and renders the answer for a human:
+blocking plugin, top rejection reasons with node counts, attempts, and the
+suggested unblock signal.
+
+    python -m tpusched.cmd.explain --url http://localhost:8080 \\
+        --pod default/worker-003
+    python -m tpusched.cmd.explain --gang default/llama-gang
+    python -m tpusched.cmd.explain            # cluster top-blockers + SLO
+
+Exit codes: 0 = diagnosis found (or rollup printed), 1 = pod/gang not
+pending (bound, deleted, or never seen), 2 = usage/connection error.
+``--json`` prints the raw endpoint payload for scripting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-explain",
+        description="why-pending diagnosis for a pod or gang")
+    p.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="scheduler debug endpoint base URL "
+                        "(--metrics-port server)")
+    who = p.add_mutually_exclusive_group()
+    who.add_argument("--pod", help="pod key (ns/name) or unique substring")
+    who.add_argument("--gang", help="PodGroup full name (ns/name) or "
+                                    "unique substring")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON payload instead of prose")
+    p.add_argument("--timeout", type=float, default=5.0)
+    return p
+
+
+def _fetch(url: str, timeout: float):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {"error": f"HTTP {e.code}"}
+
+
+def _print_reasons(rows, count_key: str) -> None:
+    for row in rows:
+        nodes = f", {row['nodes']} node(s) at last attempt" \
+            if row.get("nodes") else ""
+        count = row.get(count_key, 0)
+        print(f"  - [{row['plugin'] or '(scheduler)'}] {row['reason']} "
+              f"({count_key} {count}{nodes})")
+        if row.get("example") and row["example"] != row["reason"]:
+            print(f"      e.g. {row['example']}")
+
+
+def _render_pod(out) -> None:
+    print(f"pod {out['pod']}"
+          + (f" (gang {out['gang']})" if out.get("gang") else ""))
+    print(f"  pending for {out['pending_for_s']:.1f}s over "
+          f"{out['attempts']} attempt(s); last outcome: "
+          f"{out['last_outcome']}")
+    print(f"  blocking plugin: {out['blocking_plugin'] or '(none)'}")
+    if out.get("reasons"):
+        print("  rejection reasons (aggregated across attempts):")
+        _print_reasons(out["reasons"], "cycles")
+    print(f"  unblock: {out['suggestion']}")
+
+
+def _render_gang(out) -> None:
+    print(f"gang {out['gang']}: {out['members_pending']} member(s) still "
+          f"pending for {out['pending_for_s']:.1f}s "
+          f"(outcomes {out['outcomes']})")
+    print(f"  blocking plugin: {out['blocking_plugin'] or '(none)'}")
+    barrier = out.get("permit_barrier")
+    if barrier:
+        if barrier.get("resolved") is False:
+            print(f"  permit barrier: UNRESOLVED, held by "
+                  f"{'/'.join(barrier.get('blocking_plugins', []))}, "
+                  f"{len(barrier.get('waiting_members', []))}+ member(s) "
+                  "parked")
+        else:
+            print(f"  permit barrier: resolved "
+                  f"(max wait {barrier.get('max_wait_s', 0)}s)")
+    if out.get("top_reasons"):
+        print("  top rejection reasons across members:")
+        _print_reasons(out["top_reasons"], "members")
+    print(f"  unblock: {out['suggestion']}")
+
+
+def _render_top(out) -> None:
+    stats = out["stats"]
+    print(f"why-pending rollup: {stats['pods']} pending pod(s), "
+          f"{stats['gangs']} gang(s) tracked")
+    if out.get("top_blockers"):
+        print("top blockers (pods currently blocked per reason):")
+        _print_reasons(out["top_blockers"], "pods")
+        print(f"  unblock (top): {out['top_blockers'][0]['suggestion']}")
+    for name, s in sorted((out.get("slo") or {}).items()):
+        print(f"SLO {name}: objective {s['objective_s']}s, "
+              f"p50 {s['p50_s']}s / p99 {s['p99_s']}s, "
+              f"{s['breaches']}/{s['events']} breach(es), "
+              f"burn rate {s['burn_rate']}")
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    query = ""
+    if args.pod:
+        query = "?pod=" + urllib.parse.quote(args.pod)
+    elif args.gang:
+        query = "?gang=" + urllib.parse.quote(args.gang)
+    url = args.url.rstrip("/") + "/debug/explain" + query
+    try:
+        status, payload = _fetch(url, args.timeout)
+    except (OSError, ValueError) as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(payload))
+        return 0 if status == 200 else 1
+    if status != 200:
+        print(payload.get("error", f"HTTP {status}"), file=sys.stderr)
+        return 1
+    if args.pod:
+        _render_pod(payload)
+    elif args.gang:
+        _render_gang(payload)
+    else:
+        _render_top(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

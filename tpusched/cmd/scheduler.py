@@ -62,7 +62,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "/debug/trace /debug/gangs /debug/flightrecorder "
-                        "(0 picks a free port; off by default)")
+                        "/debug/explain (0 picks a free port; off by "
+                        "default)")
     p.add_argument("--metrics-bind-address", default="127.0.0.1",
                    help="bind address for --metrics-port; use 0.0.0.0 "
                         "in-cluster so ServiceMonitor/kubelet can reach it")

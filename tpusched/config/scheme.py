@@ -98,6 +98,24 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
     profile = PluginProfile(
         scheduler_name=raw.get("schedulerName", "tpusched"),
         percentage_of_nodes_to_score=pct)
+    slo = raw.get("slo", {}) or {}
+    if not isinstance(slo, dict):
+        raise ConfigError(f"slo must be a mapping, got {type(slo).__name__}")
+    for yaml_key, attr in (("podE2ESeconds", "slo_pod_e2e_s"),
+                           ("gangBoundSeconds", "slo_gang_bound_s")):
+        if yaml_key in slo:
+            try:
+                v = float(slo[yaml_key])
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"slo.{yaml_key} must be a number, got "
+                    f"{slo[yaml_key]!r}")
+            if v < 0:
+                raise ConfigError(f"slo.{yaml_key} must be >= 0, got {v}")
+            setattr(profile, attr, v)
+    unknown = set(slo) - {"podE2ESeconds", "gangBoundSeconds"}
+    if unknown:
+        raise ConfigError(f"unknown slo fields: {sorted(unknown)}")
     plugins = raw.get("plugins", {}) or {}
 
     qs = plugins.get("queueSort", {}).get("enabled", [])

@@ -220,7 +220,8 @@ class DefragController:
                 if pg is not None:
                     fork.delete(srv.POD_GROUPS, cand_full)
                 moved.append((cand_full, pg, pods))
-            sched = Scheduler(fork, default_registry(), profile)
+            sched = Scheduler(fork, default_registry(), profile,
+                              telemetry=False)
             sched.run()
             try:
                 if not self._wait_bound(fork, blocked_keys):

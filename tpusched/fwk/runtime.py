@@ -112,6 +112,12 @@ class PluginProfile:
     # 0 disables.
     stuck_gang_after_s: float = 30.0
     stuck_gang_sweep_interval_s: float = 1.0
+    # Scheduling SLO objectives (tpusched/obs/slo.py): latency targets for
+    # pod first-enqueue→bound and PodGroup-to-Bound.  Breaches feed the
+    # tpusched_slo_* burn metrics and the bench SLO summary; 0 disables an
+    # objective.  Config YAML: `slo: {podE2ESeconds, gangBoundSeconds}`.
+    slo_pod_e2e_s: float = 2.0
+    slo_gang_bound_s: float = 2.0
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]

@@ -1,0 +1,77 @@
+"""tpusched.obs — operator-grade observability on top of trace/metrics.
+
+Three pillars (ISSUE 5):
+
+- ``diagnosis.DiagnosisEngine`` — the why-pending engine: bounded rolling
+  per-pod / per-gang rejection aggregation + a cluster top-blockers table,
+  served at ``/debug/explain`` and by ``python -m tpusched.cmd.explain``;
+- ``capacity.CapacityTelemetry`` — per-pool free/placeable chip gauges
+  (torus fragmentation index), ElasticQuota utilization, queue depth;
+- ``slo.SLOTracker`` — pod-e2e and PodGroup-to-Bound latency objectives
+  with burn-rate accounting (``tpusched_slo_*``).
+
+Like the flight recorder, the engine and the SLO tracker have process-
+global defaults: the scheduler feeds whichever instances it was built
+with (default: the globals), and the /debug HTTP surface resolves the
+globals at request time — so a bench/test that installs fresh instances
+is picked up without rebuilding servers, and plugin code (Coscheduling's
+gang-bound clock) can feed the SLO layer without a handle threaded
+through the framework.
+"""
+from __future__ import annotations
+
+from .diagnosis import DiagnosisEngine
+from .slo import (GANG_BOUND, POD_E2E, SLOTracker, DEFAULT_GANG_BOUND_S,
+                  DEFAULT_POD_E2E_S)
+from .capacity import (CapacityTelemetry, largest_placeable_chips,
+                       largest_window_chips, pool_occupancy)
+from . import reasons  # noqa: F401  (re-export)
+
+__all__ = [
+    "DiagnosisEngine", "SLOTracker", "CapacityTelemetry",
+    "largest_placeable_chips", "largest_window_chips", "pool_occupancy",
+    "POD_E2E", "GANG_BOUND",
+    "DEFAULT_POD_E2E_S", "DEFAULT_GANG_BOUND_S", "reasons",
+    "default_engine", "install_engine", "default_slo", "install_slo",
+    "observe_gang_bound",
+]
+
+_engine = DiagnosisEngine()
+_slo = SLOTracker()
+
+
+def default_engine() -> DiagnosisEngine:
+    return _engine
+
+
+def install_engine(engine: DiagnosisEngine) -> DiagnosisEngine:
+    """Swap the process-global diagnosis engine (bench/test isolation).
+    Schedulers built earlier keep feeding the instance they captured; the
+    /debug/explain route resolves the global at request time."""
+    global _engine
+    _engine = engine
+    return engine
+
+
+def default_slo() -> SLOTracker:
+    return _slo
+
+
+def install_slo(tracker: SLOTracker) -> SLOTracker:
+    """Swap the process-global SLO tracker.  Objectives the NEW tracker
+    does not carry (disabled via a 0 target) have their objective/burn
+    gauge children removed — a retired objective must stop being exposed,
+    not freeze at its last value."""
+    global _slo
+    from .slo import slo_burn_rate, slo_objective_seconds
+    for name in set(_slo.objective_names()) - set(tracker.objective_names()):
+        slo_objective_seconds.remove(name)
+        slo_burn_rate.remove(name)
+    _slo = tracker
+    return tracker
+
+
+def observe_gang_bound(seconds: float) -> None:
+    """Feed the gang-bound objective from wherever the PodGroup-to-Bound
+    clock is read (Coscheduling's post_bind quorum completion)."""
+    _slo.observe(GANG_BOUND, seconds)

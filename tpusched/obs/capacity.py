@@ -1,0 +1,322 @@
+"""Capacity & fragmentation telemetry.
+
+Fleet-level gauges computed at scrape time (a registry collector — no
+background thread) from the scheduler's own informers and cache snapshot:
+
+- per-pool chip capacity/free gauges;
+- a torus FRAGMENTATION index per pool: the largest slice (in chips) that
+  is placeable RIGHT NOW as one contiguous window, against the pool's free
+  chips.  ``free=512, largest_placeable=64`` is the number that explains a
+  "no feasible slice placement" rejection — plenty of chips, no window.
+  Placement semantics mirror the scheduler exactly (same HostGrid, same
+  rotation/wraparound rules via topology.torus, same health gating);
+- ElasticQuota utilization per queue (namespace), in whole TPU chips —
+  the fleet currency quota min/max are written in;
+- queue-depth gauges already exist (``tpusched_pending_pods{queue=}``);
+  this adds ``tpusched_pending_gangs`` (distinct gangs with pending
+  members) so "how many JOBS are waiting" needs no label math.
+
+Cost discipline: the fragmentation search is memoized on (cache mutation
+cursor, TpuTopology resourceVersions) and rate-limited; an idle fleet
+re-serves the cached answer for free.  The search itself prunes shapes
+larger than the free host count and is capped, so a scrape can never walk
+an unbounded placement space.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import time
+import weakref
+from typing import Dict, FrozenSet, Optional, Tuple
+
+from ..api.core import node_health_error
+from ..api.resources import TPU
+from ..api.scheduling import POD_GROUP_LABEL
+from ..plugins.tpuslice.chip_node import pod_tpu_limits
+from ..topology.torus import HOST_EXTENT, HostGrid, iter_placements
+from ..util.metrics import REGISTRY
+
+pool_capacity_chips = REGISTRY.gauge_vec(
+    "tpusched_pool_capacity_chips", ("pool",),
+    "Allocatable TPU chips per topology pool.")
+pool_free_chips = REGISTRY.gauge_vec(
+    "tpusched_pool_free_chips", ("pool",),
+    "TPU chips not claimed by any pod, per pool.")
+pool_largest_placeable_chips = REGISTRY.gauge_vec(
+    "tpusched_pool_largest_placeable_chips", ("pool",),
+    "Largest slice (chips) placeable right now as one contiguous torus "
+    "window on healthy free hosts.")
+pool_fragmentation_ratio = REGISTRY.gauge_vec(
+    "tpusched_pool_fragmentation_ratio", ("pool",),
+    "1 - largest_placeable/free chips per pool (0 = every free chip is "
+    "reachable by one window; 1 = free capacity unusable for slices).")
+quota_min_chips = REGISTRY.gauge_vec(
+    "tpusched_quota_min_chips", ("namespace",),
+    "ElasticQuota guaranteed min, in whole TPU chips, per queue.")
+quota_used_chips = REGISTRY.gauge_vec(
+    "tpusched_quota_used_chips", ("namespace",),
+    "Whole TPU chips in use (bound + assumed pods), per quota queue.")
+quota_utilization = REGISTRY.gauge_vec(
+    "tpusched_quota_utilization", ("namespace",),
+    "used/min chip ratio per quota queue (>1 = borrowing beyond min).")
+# scheduler-labeled like the pending_pods gauges beside it: one process
+# can host several profiles/replicas, and an unlabeled gauge would flap
+# between their queues (and freeze at a stopped scheduler's last value)
+pending_gangs = REGISTRY.gauge_vec(
+    "tpusched_pending_gangs", ("scheduler",),
+    "Distinct gangs (PodGroups) with pending members, per scheduler queue.")
+
+_MAX_SHAPES_TRIED = 128
+
+
+def _node_chip_usage(info) -> Tuple[int, bool]:
+    """(whole chips requested, any TPU usage at all) for one node.
+    Computed directly, NOT via NodeInfo.derived(): this runs on the
+    /metrics scrape thread against snapshot NodeInfos the scheduling loop
+    shares across incremental snapshots, and a foreign-thread write into
+    ``derived_cache`` could race the loop's ``clone()`` dict copy.
+    Reading ``info.pods`` is safe (snapshot infos are read-only by
+    contract); only the memo write would be the hazard."""
+    chips = 0
+    any_usage = False
+    for p in info.pods:
+        c, chips_set, _, mem_set = pod_tpu_limits(p)
+        chips += c
+        if chips_set or mem_set:
+            any_usage = True
+    return chips, any_usage
+
+
+def _any_placement_fits(grid: HostGrid, chip_shape: Tuple[int, ...],
+                        free: FrozenSet) -> bool:
+    """Streaming existence check: does ANY placement of ``chip_shape``
+    land entirely on ``free`` hosts?  Iterates the scheduler's own lazy
+    placement generator (torus.iter_placements — ONE implementation of
+    the rotation/wraparound rules) and returns on the first fit instead
+    of materializing the full placement list."""
+    return any(p <= free for p in iter_placements(grid, chip_shape))
+
+
+def pool_occupancy(grid: HostGrid, snapshot) -> Tuple[FrozenSet, int, int]:
+    """(window-eligible free host coords, free chips, capacity chips).
+
+    A host is window-eligible when it is healthy and carries zero TPU
+    usage — the same definition TopologyMatch's occupancy sweep uses for
+    ``free``, so these gauges and the scheduler can never disagree about
+    what is placeable."""
+    free_coords: set = set()
+    free_chips = 0
+    capacity = 0
+    for node, coord in grid.coord_of.items():
+        info = snapshot.get(node)
+        if info is None:
+            continue
+        alloc = info.allocatable.get(TPU, 0)
+        capacity += alloc
+        used, any_usage = _node_chip_usage(info)
+        free_chips += max(0, alloc - used)
+        # window-eligible requires chips to actually exist on the host: a
+        # healthy empty node whose device plugin has not advertised chips
+        # yet (alloc 0, post-repair churn) must not count as placeable —
+        # it would float largest_placeable above free_chips
+        if alloc > 0 and not any_usage \
+                and node_health_error(info.node) is None:
+            free_coords.add(coord)
+    return frozenset(free_coords), free_chips, capacity
+
+
+def largest_window_chips(grid: HostGrid, free: FrozenSet) -> int:
+    """Largest slice (chips) placeable as one contiguous window on the
+    given free hosts.  Bounded (_MAX_SHAPES_TRIED) but can never
+    under-report below one host block: a single free healthy host always
+    places the extent shape."""
+    if not free:
+        return 0
+    extent = HOST_EXTENT[grid.acc.name]
+    # candidate chip shapes: host-block multiples of the accelerator's
+    # host extent, deduplicated up to rotation (the fit check tries
+    # rotations itself), largest chip count first.  Floor: one host block
+    # (the extent shape) trivially fits any free host, so the bounded
+    # search can only ever refine the answer UP from there.
+    best = math.prod(extent)
+    axes = [[e * h for h in range(1, hd + 1)]
+            for e, hd in zip(extent, grid.dims)]
+    shapes: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
+    for s in itertools.product(*axes):
+        shapes.setdefault(tuple(sorted(s)), s)
+    ordered = sorted(shapes.values(), key=lambda s: -math.prod(s))
+    tried = 0
+    for shape in ordered:
+        chips = math.prod(shape)
+        if chips <= best:
+            break                         # descending order: done
+        hosts_needed = math.prod(s // e for s, e in zip(shape, extent))
+        if hosts_needed > len(free):
+            continue
+        tried += 1
+        if tried > _MAX_SHAPES_TRIED:
+            break                         # bounded: report the floor/best
+        if _any_placement_fits(grid, shape, free):
+            best = chips
+            break                         # nothing bigger left to try
+    return best
+
+
+def largest_placeable_chips(grid: HostGrid, snapshot) -> Tuple[int, int, int]:
+    """(largest placeable chips, free chips, capacity chips) for a pool —
+    the one-call convenience over pool_occupancy + largest_window_chips."""
+    free, free_chips, capacity = pool_occupancy(grid, snapshot)
+    return largest_window_chips(grid, free), free_chips, capacity
+
+
+class CapacityTelemetry:
+    """Scrape-time collector bound to one scheduler (weakly: a stopped
+    scheduler's collector removes its own series and unregisters)."""
+
+    def __init__(self, scheduler, min_refresh_s: float = 1.0,
+                 frag_refresh_s: float = 15.0, clock=time.monotonic):
+        self._ref = weakref.ref(scheduler)
+        # kept by value: close() may run after the scheduler is garbage
+        self._scheduler_name = scheduler.profile.scheduler_name
+        self._min_refresh_s = min_refresh_s
+        # the fragmentation search is the one non-O(nodes) computation
+        # here: on an ACTIVE cluster the mutation cursor moves between
+        # every pair of scrapes, so cursor-memoization alone would re-run
+        # it per scrape — this interval additionally rate-limits it (the
+        # gauge may lag reality by up to frag_refresh_s; capacity trend
+        # data, not a scheduling input)
+        self._frag_refresh_s = frag_refresh_s
+        self._clock = clock
+        self._last_refresh = -1e9
+        # fragmentation memo: {pool: [cursor, topo_rv, computed_at, result]}
+        self._frag_memo: Dict[str, list] = {}
+        self._grid_cache: Dict[Tuple[str, int], Optional[HostGrid]] = {}
+        self._pool_labels: set = set()
+        self._ns_labels: set = set()
+        REGISTRY.register_collector(self.collect)
+
+    def close(self) -> None:
+        REGISTRY.unregister_collector(self.collect)
+        pending_gangs.remove(self._scheduler_name)
+        for pool in self._pool_labels:
+            for vec in (pool_capacity_chips, pool_free_chips,
+                        pool_largest_placeable_chips,
+                        pool_fragmentation_ratio):
+                vec.remove(pool)
+        for ns in self._ns_labels:
+            for vec in (quota_min_chips, quota_used_chips,
+                        quota_utilization):
+                vec.remove(ns)
+        self._pool_labels.clear()
+        self._ns_labels.clear()
+
+    # -- the collector --------------------------------------------------------
+
+    def collect(self) -> None:
+        sched = self._ref()
+        if sched is None:
+            self.close()
+            return
+        now = self._clock()
+        if now - self._last_refresh < self._min_refresh_s:
+            return
+        self._last_refresh = now
+        # READ-ONLY snapshot access: this runs on the /metrics scrape
+        # thread, and cache.snapshot() from here would advance the
+        # snapshot cursor mid-cycle — laundering a concurrent foreign
+        # mutation past the equivalence cache's arming guard.  The last
+        # loop-built snapshot is at most one scheduling cycle stale.
+        snapshot = sched.cache.peek_snapshot()
+        self._refresh_queue(sched)
+        if snapshot is None:
+            return                        # no cycle has run yet
+        cursor = sched.cache.snapshot_cursor()
+        self._refresh_pools(sched, snapshot, cursor)
+        self._refresh_quotas(sched, snapshot)
+
+    def _grid(self, topo) -> Optional[HostGrid]:
+        key = (topo.key, topo.meta.resource_version)
+        if key not in self._grid_cache:
+            if len(self._grid_cache) > 16:
+                self._grid_cache.clear()
+            self._grid_cache[key] = HostGrid.from_spec(topo.spec)
+        return self._grid_cache[key]
+
+    def _refresh_pools(self, sched, snapshot, cursor: int) -> None:
+        seen = set()
+        for topo in sched.informer_factory.tputopologies().items():
+            grid = self._grid(topo)
+            if grid is None:
+                continue
+            pool = topo.spec.pool
+            seen.add(pool)
+            # free/capacity: cheap O(pool hosts) walk, always fresh
+            free_set, free, capacity = pool_occupancy(grid, snapshot)
+            # largest-window search: memoized on (cursor, topo rv) AND
+            # rate-limited — an active cluster moves the cursor between
+            # every pair of scrapes, so the memo alone would re-run the
+            # search per scrape
+            memo = self._frag_memo.get(pool)
+            rv = topo.meta.resource_version
+            now = self._clock()
+            fresh = memo is not None and (
+                (memo[0] == cursor and memo[1] == rv)
+                or now - memo[2] < self._frag_refresh_s)
+            if fresh:
+                largest = memo[3]
+            else:
+                largest = largest_window_chips(grid, free_set)
+                self._frag_memo[pool] = [cursor, rv, now, largest]
+            pool_capacity_chips.with_labels(pool).set(capacity)
+            pool_free_chips.with_labels(pool).set(free)
+            pool_largest_placeable_chips.with_labels(pool).set(largest)
+            # clamped: a one-cycle-stale snapshot or sub-host free chips
+            # can put largest marginally above free; the ratio is defined
+            # on [0, 1]
+            pool_fragmentation_ratio.with_labels(pool).set(
+                max(0.0, round(1.0 - (largest / free), 4)) if free else 0.0)
+        for stale in self._pool_labels - seen:
+            self._frag_memo.pop(stale, None)
+            for vec in (pool_capacity_chips, pool_free_chips,
+                        pool_largest_placeable_chips,
+                        pool_fragmentation_ratio):
+                vec.remove(stale)
+        self._pool_labels = seen
+
+    def _refresh_quotas(self, sched, snapshot) -> None:
+        quotas = list(sched.informer_factory.elasticquotas().items())
+        if not quotas and not self._ns_labels:
+            return
+        used: Dict[str, int] = {}
+        for info in snapshot.list():
+            for p in info.pods:
+                chips, chips_set, _, _ = pod_tpu_limits(p)
+                if chips_set:
+                    used[p.meta.namespace] = \
+                        used.get(p.meta.namespace, 0) + chips
+        seen = set()
+        for eq in quotas:
+            ns = eq.meta.namespace
+            seen.add(ns)
+            mn = eq.spec.min.get(TPU, 0)
+            u = used.get(ns, 0)
+            quota_min_chips.with_labels(ns).set(mn)
+            quota_used_chips.with_labels(ns).set(u)
+            quota_utilization.with_labels(ns).set(
+                round(u / mn, 4) if mn else 0.0)
+        for stale in self._ns_labels - seen:
+            for vec in (quota_min_chips, quota_used_chips,
+                        quota_utilization):
+                vec.remove(stale)
+        self._ns_labels = seen
+
+    @staticmethod
+    def _refresh_queue(sched) -> None:
+        gangs = set()
+        for p in sched.queue.pending_pods():
+            name = p.meta.labels.get(POD_GROUP_LABEL)
+            if name:
+                gangs.add(f"{p.meta.namespace}/{name}")
+        pending_gangs.with_labels(
+            sched.profile.scheduler_name).set(len(gangs))

@@ -1,0 +1,331 @@
+"""Why-pending diagnosis engine.
+
+The flight recorder (PR 2) answers "what happened in cycle X"; this engine
+answers the operator's actual question — "why is my pod/gang STILL pending,
+and what would unblock it" — by aggregating each pod's structured rejection
+attribution ACROSS attempts into a bounded rolling diagnosis:
+
+    per pod   last outcome + blocking plugin + (plugin, reason) rows with
+              node counts ("178/256 nodes: TpuSlice shape-mismatch") and
+              how many attempts each reason has blocked;
+    per gang  the same rolled up across members (how many members each
+              reason blocks, barrier population, blocking plugins);
+    cluster   a top-blockers table: which (plugin, reason) keys block the
+              most pods right now.
+
+Fed by the scheduler at cycle resolution (works with tracing DISABLED —
+the inputs are the Status + Filter diagnosis the cycle produced anyway,
+not the trace ring).  Served at ``/debug/explain`` and by
+``python -m tpusched.cmd.explain``.
+
+Bounded like the flight recorder: entry cap + approximate byte cap on BOTH
+the pod table and each pod's reason rows, LRU eviction, and immediate
+eviction of RESOLVED pods (bound or deleted) so a healthy fleet holds a
+near-empty table.  Write path is O(rows) per FAILED cycle under one lock —
+the happy path (bound) pays one dict pop.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import reasons as _reasons
+
+DEFAULT_MAX_PODS = 1024
+DEFAULT_MAX_BYTES = 1 << 20          # ~1 MiB of diagnosis state
+MAX_ROWS_PER_POD = 12
+_POD_BASE_BYTES = 160
+_ROW_BASE_BYTES = 96
+
+
+class _Row:
+    """One (plugin, normalized reason) aggregate for a pod."""
+
+    __slots__ = ("plugin", "reason", "nodes", "cycles", "example")
+
+    def __init__(self, plugin: str, reason: str):
+        self.plugin = plugin
+        self.reason = reason
+        self.nodes = 0        # node count at the LAST attempt that saw it
+        self.cycles = 0       # attempts in which this reason appeared
+        self.example = ""     # one raw (un-normalized) instance, clipped
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"plugin": self.plugin, "reason": self.reason,
+             "nodes": self.nodes, "cycles": self.cycles}
+        if self.example and self.example != self.reason:
+            d["example"] = self.example
+        return d
+
+
+class _PodDiag:
+    __slots__ = ("gang", "first_seen", "last_seen", "attempts",
+                 "last_outcome", "last_plugin", "last_reason", "rows",
+                 "bytes")
+
+    def __init__(self, gang: Optional[str], now: float):
+        self.gang = gang
+        self.first_seen = now
+        self.last_seen = now
+        self.attempts = 0
+        self.last_outcome = ""
+        self.last_plugin = ""
+        self.last_reason = ""
+        self.rows: "collections.OrderedDict[Tuple[str, str], _Row]" = \
+            collections.OrderedDict()
+        self.bytes = _POD_BASE_BYTES
+
+    def blocking_key(self) -> Optional[Tuple[str, str]]:
+        if not self.last_plugin and not self.last_reason:
+            return None
+        return (self.last_plugin, self.last_reason)
+
+
+class DiagnosisEngine:
+    def __init__(self, max_pods: int = DEFAULT_MAX_PODS,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 max_rows_per_pod: int = MAX_ROWS_PER_POD,
+                 clock=time.time):
+        self.max_pods = max_pods
+        self.max_bytes = max_bytes
+        self.max_rows_per_pod = max_rows_per_pod
+        self._clock = clock
+        self._lock = threading.Lock()
+        # pod key → diag, LRU order (OrderedDict, most-recent last)
+        self._pods: "collections.OrderedDict[str, _PodDiag]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        # gang full-name → set of member pod keys currently tracked
+        self._gangs: Dict[str, set] = {}
+        # cluster rollup: (plugin, norm reason) → pods currently blocked
+        self._blockers: Dict[Tuple[str, str], int] = {}
+        self._fed = 0
+        self._resolved = 0
+        self._evicted = 0
+
+    # -- write path (scheduler feed) -----------------------------------------
+
+    def on_attempt(self, pod_key: str, gang: Optional[str], outcome: str,
+                   plugin: str, reason: str,
+                   diagnosis_rows: Optional[List[Dict[str, Any]]] = None,
+                   attempt: int = 0) -> None:
+        """One resolved-unsuccessfully scheduling cycle.  ``diagnosis_rows``
+        is the bounded (plugin, reason) → node-count summary of the Filter
+        sweep (trace.summarize_diagnosis shape); ``plugin``/``reason`` are
+        the merged Status attribution (the cycle's headline verdict)."""
+        now = self._clock()
+        norm_headline = _reasons.normalize(reason)
+        with self._lock:
+            self._fed += 1
+            d = self._pods.get(pod_key)
+            if d is None:
+                d = _PodDiag(gang, now)
+                self._pods[pod_key] = d
+                if gang:
+                    self._gangs.setdefault(gang, set()).add(pod_key)
+                self._bytes += d.bytes
+            else:
+                self._pods.move_to_end(pod_key)
+            old_key = d.blocking_key()
+            d.last_seen = now
+            d.attempts = max(d.attempts + 1, attempt)
+            d.last_outcome = outcome
+            d.last_plugin = plugin
+            d.last_reason = norm_headline
+            seen_this_attempt = set()
+            merged: List[Tuple[str, str, int, str]] = []
+            if plugin or reason:
+                merged.append((plugin, norm_headline, 0, reason))
+            for row in diagnosis_rows or ():
+                merged.append((row.get("plugin", ""),
+                               _reasons.normalize(row.get("reason", "")),
+                               int(row.get("nodes", 0)),
+                               row.get("reason", "")))
+            for rplugin, rreason, nodes, raw in merged:
+                key = (rplugin, rreason)
+                row = d.rows.get(key)
+                if row is None:
+                    if len(d.rows) >= self.max_rows_per_pod:
+                        continue           # bounded: keep the earliest keys
+                    row = d.rows[key] = _Row(rplugin, rreason)
+                    cost = (_ROW_BASE_BYTES + len(rreason)
+                            + len(rplugin))
+                    d.bytes += cost
+                    self._bytes += cost
+                if key not in seen_this_attempt:
+                    row.cycles += 1
+                    seen_this_attempt.add(key)
+                if nodes:
+                    row.nodes = nodes      # last attempt's count wins
+                if not row.example:
+                    row.example = raw[:160]
+            self._reblock(old_key, d.blocking_key())
+            self._trim_locked()
+
+    def on_resolved(self, pod_key: str, outcome: str = "bound") -> None:
+        """The pod stopped being pending (bound, or deleted): its diagnosis
+        is no longer a question anyone needs answered — evict."""
+        with self._lock:
+            d = self._pods.pop(pod_key, None)
+            if d is None:
+                return
+            self._resolved += 1
+            self._drop_locked(pod_key, d)
+
+    # -- internals ------------------------------------------------------------
+
+    def _drop_locked(self, pod_key: str, d: _PodDiag) -> None:
+        self._bytes -= d.bytes
+        self._reblock(d.blocking_key(), None)
+        if d.gang:
+            members = self._gangs.get(d.gang)
+            if members is not None:
+                members.discard(pod_key)
+                if not members:
+                    del self._gangs[d.gang]
+
+    def _reblock(self, old: Optional[Tuple[str, str]],
+                 new: Optional[Tuple[str, str]]) -> None:
+        if old == new:
+            return
+        if old is not None:
+            n = self._blockers.get(old, 0) - 1
+            if n <= 0:
+                self._blockers.pop(old, None)
+            else:
+                self._blockers[old] = n
+        if new is not None:
+            self._blockers[new] = self._blockers.get(new, 0) + 1
+
+    def _trim_locked(self) -> None:
+        while self._pods and (len(self._pods) > self.max_pods
+                              or self._bytes > self.max_bytes):
+            key, d = self._pods.popitem(last=False)   # LRU victim
+            self._evicted += 1
+            self._drop_locked(key, d)
+
+    # -- read path (/debug/explain, the explain CLI) --------------------------
+
+    def _find_pod_locked(self, query: str) -> Optional[str]:
+        if query in self._pods:
+            return query
+        # substring convenience: `?pod=w-003` finds `default/w-003`
+        hits = [k for k in self._pods if query in k]
+        return hits[0] if len(hits) == 1 else None
+
+    def explain_pod(self, query: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            key = self._find_pod_locked(query)
+            if key is None:
+                return None
+            d = self._pods[key]
+            rows = sorted(d.rows.values(),
+                          key=lambda r: (-r.nodes, -r.cycles, r.plugin))
+            out = {
+                "pod": key,
+                "gang": d.gang,
+                "pending_for_s": round(self._clock() - d.first_seen, 3),
+                "attempts": d.attempts,
+                "last_outcome": d.last_outcome,
+                "blocking_plugin": d.last_plugin,
+                "blocking_reason": d.last_reason,
+                "reasons": [r.to_dict() for r in rows],
+            }
+        out["suggestion"] = _reasons.suggest(out["blocking_plugin"],
+                                             out["blocking_reason"])
+        return out
+
+    def explain_gang(self, query: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            full = query if query in self._gangs else None
+            if full is None:
+                hits = [g for g in self._gangs if query in g]
+                full = hits[0] if len(hits) == 1 else None
+            if full is None:
+                return None
+            members = sorted(self._gangs[full])
+            outcomes: Dict[str, int] = {}
+            plugins: Dict[str, int] = {}
+            agg: Dict[Tuple[str, str], List[int]] = {}  # → [members, nodes]
+            oldest = None
+            attempts = 0
+            for key in members:
+                d = self._pods.get(key)
+                if d is None:
+                    continue
+                outcomes[d.last_outcome] = outcomes.get(d.last_outcome, 0) + 1
+                if d.last_plugin:
+                    plugins[d.last_plugin] = plugins.get(d.last_plugin, 0) + 1
+                attempts = max(attempts, d.attempts)
+                if oldest is None or d.first_seen < oldest:
+                    oldest = d.first_seen
+                for (rplugin, rreason), row in d.rows.items():
+                    ent = agg.setdefault((rplugin, rreason), [0, 0])
+                    ent[0] += 1
+                    ent[1] = max(ent[1], row.nodes)
+            top = sorted(agg.items(), key=lambda kv: (-kv[1][0], -kv[1][1]))
+            blocking = max(plugins.items(), key=lambda kv: kv[1])[0] \
+                if plugins else ""
+            out = {
+                "gang": full,
+                "members_pending": len(members),
+                "outcomes": dict(sorted(outcomes.items())),
+                "blocking_plugin": blocking,
+                "max_attempts": attempts,
+                "pending_for_s": (round(self._clock() - oldest, 3)
+                                  if oldest is not None else 0.0),
+                "top_reasons": [
+                    {"plugin": p, "reason": r, "members": m, "nodes": n}
+                    for (p, r), (m, n) in top[:10]],
+            }
+        # suggestion: prefer a ROOT-CAUSE reason over derivative ones —
+        # members parked at the permit barrier are waiting FOR the blocked
+        # members, and siblings bouncing off a denied-PG/denied-set window
+        # echo one member's sweep failure; both dominate the member count
+        # while explaining nothing the operator can act on directly
+        lead = None
+        for r in out["top_reasons"]:
+            low = r["reason"].lower()
+            if "denied" in low or "window" in low or "permit barrier" in low:
+                continue
+            lead = r
+            break
+        if lead is None and out["top_reasons"]:
+            lead = out["top_reasons"][0]
+        out["suggestion"] = _reasons.suggest(
+            lead["plugin"] if lead else out["blocking_plugin"],
+            lead["reason"] if lead else "")
+        return out
+
+    def top_blockers(self, n: int = 10) -> List[Dict[str, Any]]:
+        with self._lock:
+            top = sorted(self._blockers.items(),
+                         key=lambda kv: (-kv[1], kv[0]))[:n]
+        return [{"plugin": p, "reason": r, "pods": c,
+                 "suggestion": _reasons.suggest(p, r)}
+                for (p, r), c in top]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "pods": len(self._pods),
+                "gangs": len(self._gangs),
+                "approx_bytes": self._bytes,
+                "max_pods": self.max_pods,
+                "max_bytes": self.max_bytes,
+                "fed_total": self._fed,
+                "resolved_total": self._resolved,
+                "evicted_total": self._evicted,
+            }
+
+    def dump(self) -> Dict[str, Any]:
+        """The no-argument /debug/explain payload: cluster-wide rollup."""
+        with self._lock:
+            gangs = sorted(self._gangs)
+        return {
+            "stats": self.stats(),
+            "top_blockers": self.top_blockers(),
+            "pending_gangs": gangs[:64],
+        }

@@ -1,0 +1,125 @@
+"""Rejection-reason normalization + unblock-signal hints.
+
+Plugins attach human-oriented reason strings to their unschedulable
+Statuses ("0/64 nodes are available: 48 insufficient resource
+google.com/tpu", "Pod default/w-003 is rejected in PreFilter because
+ElasticQuota research is more than Max").  The diagnosis engine aggregates
+rejections ACROSS attempts and ACROSS gang members, so per-attempt
+variance — node counts, pod keys, remaining-TTL seconds — must collapse to
+one stable key or every retry mints a "new" reason and the bounded
+per-pod table fills with noise.
+
+``normalize()`` is that collapse: conservative, regex-based, and loses no
+plugin identity (the engine keys on ``(plugin, normalized_reason)``).
+``suggest()`` maps a blocking (plugin, reason) to the operator's next
+action — the "what would unblock it" half of the why-pending contract.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+# Standalone integers/decimals (counts, quorums, TTLs) → N.  \b-delimited
+# so resource/accelerator tokens survive: "tpu-v5p" and "4x4x4" contain no
+# word-boundary-delimited number and normalize to themselves.
+_NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
+# Object keys after the vocabulary the plugins actually use.  A blanket
+# "ns/name" pattern would also eat resource names (google.com/tpu), so the
+# preceding keyword anchors it.
+_KEYED = re.compile(r"\b(Pod|pod|pgName|member|set)\s+\S+")
+_WS = re.compile(r"\s+")
+
+
+def normalize(reason: str) -> str:
+    """Stable aggregation key for a rejection reason string."""
+    if not reason:
+        return "unknown"
+    out = _KEYED.sub(lambda m: f"{m.group(1)} *", reason)
+    out = _NUM.sub("N", out)
+    return _WS.sub(" ", out).strip()[:160]
+
+
+# (plugin-or-None, reason-substring) → hint, first match wins.  Substrings
+# are matched against the NORMALIZED reason (lower-cased).  None plugin =
+# any plugin.
+_HINTS: Tuple[Tuple[Optional[str], str, str], ...] = (
+    ("CapacityScheduling", "more than max",
+     "queue quota exhausted: the namespace's ElasticQuota max is fully "
+     "used — raise the quota, or wait for the team's running pods to "
+     "finish (tpusched_quota_utilization{namespace=...})"),
+    ("CapacityScheduling", "more than min",
+     "no spare fleet capacity to borrow: every team is at or above its "
+     "guaranteed min — add capacity or rebalance ElasticQuota mins"),
+    ("TopologyMatch", "no feasible",
+     "no contiguous torus window fits the slice shape: likely "
+     "fragmentation — compare tpusched_pool_largest_placeable_chips "
+     "against tpusched_pool_free_chips, then run the defrag advisor "
+     "(python -m tpusched.cmd.whatif --suggest-migrations)"),
+    ("TopologyMatch", "cannot map onto pool",
+     "the requested slice shape can never fit this pool's torus "
+     "geometry: fix tpu_slice_shape or target a different pool"),
+    ("TopologyMatch", "no tputopology pool",
+     "no TpuTopology CR matches the requested accelerator: publish the "
+     "pool CR or fix tpu_accelerator on the PodGroup"),
+    ("Coscheduling", "cannot find enough sibling",
+     "fewer member pods exist than the PodGroup's minMember: create the "
+     "missing gang members"),
+    ("Coscheduling", "denied-podgroup expiration window",
+     "the gang was recently mass-denied and is inside its backoff "
+     "window: it retries automatically when the window lapses"),
+    ("Coscheduling", "cluster-capacity dry-run",
+     "the whole gang cannot fit the cluster's free capacity: add nodes "
+     "or free capacity before the gang can admit"),
+    ("MultiSlice", "incomplete",
+     "the atomic multislice set is missing member PodGroups: submit the "
+     "remaining slices (all-or-nothing admission)"),
+    ("MultiSlice", "denied",
+     "the multislice set was recently torn down and is inside its "
+     "denied window: it retries automatically"),
+    ("GangBindRollback", "",
+     "a sibling's bind failed terminally and the gang rolled back "
+     "coherently: check apiserver health "
+     "(tpusched_api_retry_exhausted_total) — the gang requeues on its "
+     "own once writes succeed"),
+    (None, "notready",
+     "unhealthy hardware: nodes are NotReady — repair or replace them "
+     "(tpusched_nodes_not_ready; doc/ops.md 'Node and slice failures')"),
+    (None, "not-ready taint",
+     "unhealthy hardware: nodes carry the node.tpu.dev/not-ready taint — "
+     "repair or replace them (doc/ops.md 'Node and slice failures')"),
+    (None, "unschedulable",
+     "nodes are cordoned (spec.unschedulable): uncordon them or add "
+     "capacity"),
+    (None, "untolerated taint",
+     "nodes carry taints the pod does not tolerate: add tolerations or "
+     "untaint the intended nodes"),
+    (None, "insufficient",
+     "insufficient free resources on every candidate node: add capacity, "
+     "free pods, or (for slice gangs) run the defrag advisor"),
+    (None, "no fit indexes",
+     "chip-level fit failed on every candidate node (free chips exist "
+     "but not in a usable arrangement): free whole chips or add hosts"),
+    (None, "claimed by an in-flight slice preemption",
+     "the hosts are reserved for a gang whose preemption is draining: "
+     "wait for the drain window or target other hosts"),
+    (None, "permit barrier",
+     "gang quorum has not formed: the remaining members are blocked or "
+     "missing — inspect the member rows (or /debug/gangs) for the "
+     "member that is NOT waiting"),
+)
+
+
+def suggest(plugin: str, reason: str) -> str:
+    """The operator's next action for a blocking (plugin, reason)."""
+    low = (reason or "").lower()
+    for want_plugin, needle, hint in _HINTS:
+        if want_plugin is not None and want_plugin != plugin:
+            continue
+        if needle and needle not in low:
+            continue
+        return hint
+    if plugin:
+        return (f"blocked by plugin {plugin}: inspect the pod's cycle "
+                "trace (/debug/trace?pod=...) for the full diagnosis")
+    return ("no scheduling attempt recorded yet, or the reason is "
+            "uncategorized: check /debug/flightrecorder")

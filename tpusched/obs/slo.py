@@ -1,0 +1,154 @@
+"""Scheduling SLO layer: latency objectives + burn accounting.
+
+Two built-in objectives, fed from clocks the scheduler already keeps:
+
+- ``pod_e2e``     first-enqueue → bound per pod (the user-perceived
+                  latency; fed by the binding thread at bind commit);
+- ``gang_bound``  PodGroup-to-Bound per gang (the north-star interval the
+                  gang stitcher and Coscheduling's post_bind already
+                  compute: first member SEEN → quorum complete).
+
+Per objective the tracker keeps cumulative event/breach counters
+(``tpusched_slo_events_total`` / ``tpusched_slo_breaches_total``, labeled
+``objective`` — PromQL burn rate is rate(breaches)/rate(events)), a
+rolling-window burn-rate gauge (``tpusched_slo_burn_rate``), the objective
+itself as a gauge (``tpusched_slo_objective_seconds`` — dashboards draw
+the target line without config access), and a bounded sample window for
+exact p50/p99 in ``summary()`` (the BENCH-json SLO block).
+
+Objectives come from the scheduler profile (``slo_pod_e2e_s`` /
+``slo_gang_bound_s``; 0 disables an objective).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional
+
+from ..util.metrics import REGISTRY
+
+POD_E2E = "pod_e2e"
+GANG_BOUND = "gang_bound"
+
+DEFAULT_POD_E2E_S = 2.0       # matches the 2 s north-star budget
+DEFAULT_GANG_BOUND_S = 2.0    # (BASELINE.md PodGroup-to-Bound)
+_WINDOW = 1024                # rolling burn-rate / quantile window
+
+slo_events = REGISTRY.counter_vec(
+    "tpusched_slo_events_total", ("objective",),
+    "SLO-governed completions observed, by objective.")
+slo_breaches = REGISTRY.counter_vec(
+    "tpusched_slo_breaches_total", ("objective",),
+    "Completions that exceeded their latency objective.")
+slo_burn_rate = REGISTRY.gauge_vec(
+    "tpusched_slo_burn_rate", ("objective",),
+    "Breach fraction over the rolling window (0 = within SLO).")
+slo_objective_seconds = REGISTRY.gauge_vec(
+    "tpusched_slo_objective_seconds", ("objective",),
+    "The configured latency objective, as data.")
+
+
+class _Objective:
+    __slots__ = ("name", "target_s", "count", "breaches", "window",
+                 "window_breaches", "samples")
+
+    def __init__(self, name: str, target_s: float, window: int = _WINDOW):
+        self.name = name
+        self.target_s = target_s
+        self.count = 0
+        self.breaches = 0
+        # rolling breach window (booleans, with a running count so the
+        # per-bind burn computation is O(1), not an O(window) sum) +
+        # bounded sample window for exact quantiles — an always-on
+        # control plane must not grow
+        self.window: "collections.deque[bool]" = collections.deque(
+            maxlen=window)
+        self.window_breaches = 0
+        self.samples: "collections.deque[float]" = collections.deque(
+            maxlen=window)
+
+    def push(self, breached: bool, seconds: float) -> float:
+        """Record one completion into the rolling windows; returns the
+        current burn fraction."""
+        if len(self.window) == self.window.maxlen and self.window[0]:
+            self.window_breaches -= 1     # the value about to fall off
+        self.window.append(breached)
+        if breached:
+            self.window_breaches += 1
+        self.samples.append(seconds)
+        return self.window_breaches / len(self.window)
+
+
+class SLOTracker:
+    def __init__(self, pod_e2e_s: float = DEFAULT_POD_E2E_S,
+                 gang_bound_s: float = DEFAULT_GANG_BOUND_S,
+                 publish: bool = True, window: int = _WINDOW):
+        """``publish=False`` builds a PRIVATE tracker (shadow schedulers:
+        what-if planner, defrag trials): observations accumulate in the
+        internal windows for summary() but never touch the process-global
+        ``tpusched_slo_*`` metric families — a trial bind's latency must
+        not count into the production burn rate.  ``window`` sizes the
+        rolling burn/quantile deques: bench installs one large enough to
+        hold EVERY counted run's events so its summary quantiles and
+        breach counts describe the same window."""
+        self._lock = threading.Lock()
+        self._publish = publish
+        # introspectable config (the scheduler re-installs the global
+        # tracker only when its profile asks for DIFFERENT targets)
+        self.targets = (pod_e2e_s, gang_bound_s)
+        self._objectives: Dict[str, _Objective] = {}
+        for name, target in ((POD_E2E, pod_e2e_s),
+                             (GANG_BOUND, gang_bound_s)):
+            if target and target > 0:
+                self._objectives[name] = _Objective(name, target, window)
+                if publish:
+                    slo_objective_seconds.with_labels(name).set(target)
+
+    def objective_names(self):
+        return tuple(self._objectives)
+
+    def observe(self, objective: str, seconds: float) -> Optional[bool]:
+        """Record one completion; returns whether it breached (None when
+        the objective is disabled/unknown)."""
+        with self._lock:
+            obj = self._objectives.get(objective)
+            if obj is None:
+                return None
+            breached = seconds > obj.target_s
+            obj.count += 1
+            if breached:
+                obj.breaches += 1
+            burn = obj.push(breached, seconds)
+        if self._publish:
+            slo_events.with_labels(objective).inc()
+            if breached:
+                slo_breaches.with_labels(objective).inc()
+            slo_burn_rate.with_labels(objective).set(round(burn, 4))
+        return breached
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-objective digest (the BENCH-json SLO block and the
+        /debug/explain footer): target vs observed p50/p99, totals, burn."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, obj in self._objectives.items():
+                xs = sorted(obj.samples)
+
+                def q(p):
+                    if not xs:
+                        return 0.0
+                    return xs[min(len(xs) - 1,
+                                  max(0, int(round(p * (len(xs) - 1)))))]
+                out[name] = {
+                    "objective_s": obj.target_s,
+                    "events": obj.count,
+                    "breaches": obj.breaches,
+                    "attainment": round(1.0 - (obj.breaches / obj.count), 4)
+                    if obj.count else 1.0,
+                    "burn_rate": round(
+                        (obj.window_breaches / len(obj.window))
+                        if obj.window else 0.0, 4),
+                    "p50_s": round(q(0.50), 4),
+                    "p99_s": round(q(0.99), 4),
+                }
+        return out

@@ -225,7 +225,16 @@ class PodGroupManager:
                     first_seen = min(
                         (p.meta.creation_timestamp for p in self.siblings(pod)),
                         default=pg.meta.creation_timestamp)
-                    pod_group_to_bound_seconds.observe(max(0.0, now - first_seen))
+                    bound_s = max(0.0, now - first_seen)
+                    # the north-star histogram and the gang-bound SLO
+                    # objective share this one clock read — and both are
+                    # LIVE-fleet data: a SHADOW scheduler's (what-if/
+                    # defrag trial) simulated binds must neither skew the
+                    # PodGroup-to-Bound distribution nor burn the SLO
+                    if getattr(self.handle, "telemetry", True):
+                        pod_group_to_bound_seconds.observe(bound_s)
+                        from ... import obs
+                        obs.observe_gang_bound(bound_s)
                 g.status.phase = PG_SCHEDULED
             else:
                 g.status.phase = PG_SCHEDULING

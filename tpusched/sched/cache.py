@@ -217,6 +217,19 @@ class Cache:
             self._last_snapshot = snap
             return snap
 
+    def peek_snapshot(self) -> "Snapshot | None":
+        """Read-only view of the LAST snapshot the scheduling loop built —
+        never rebuilds.  Foreign threads (the /metrics capacity collector)
+        must use this instead of snapshot(): a rebuild from outside the
+        loop advances ``_snap_mutation`` mid-cycle, which would launder a
+        concurrent foreign mutation past the equivalence cache's
+        "cursor advanced by exactly my own assume" arming guard
+        (scheduler._equiv_offer / _equiv_after_assume) and arm an entry
+        whose feasible set was computed against older state.  Telemetry
+        readers tolerate the staleness (at most one scheduling cycle)."""
+        with self._lock:
+            return self._last_snapshot
+
     def node_names(self):
         with self._lock:
             return list(self._infos)

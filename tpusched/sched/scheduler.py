@@ -27,6 +27,7 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_ELASTIC_QUOTA, RESOURCE_NODE,
                               RESOURCE_POD, RESOURCE_POD_GROUP,
                               RESOURCE_TPU_TOPOLOGY)
+from .. import obs as obs_mod
 from .. import trace
 from ..util import klog
 from ..util.equivalence import equivalence_key
@@ -352,14 +353,61 @@ class _BindingPool:
 class Scheduler:
     def __init__(self, api: srv.APIServer, registry: Registry,
                  profile: PluginProfile, clock=time.time,
-                 recorder: Optional["trace.FlightRecorder"] = None):
+                 recorder: Optional["trace.FlightRecorder"] = None,
+                 obs_engine: Optional["obs_mod.DiagnosisEngine"] = None,
+                 telemetry: bool = True):
+        """``telemetry=False`` marks a SHADOW scheduler (what-if planner,
+        defrag trials): it schedules forked state holding the SAME pod
+        keys as the live fleet, so it must never touch the process-global
+        observability surfaces — a trial bind would evict the real pod's
+        why-pending diagnosis, a trial's capacity collector would publish
+        hypothetical pool gauges as real, and its SLO observations would
+        dilute the production burn rate.  Shadows get private throwaway
+        instances instead."""
         self.api = api
         self.clock = clock
         # Scheduling flight recorder (tpusched/trace): every cycle emits a
         # span tree into the process-global ring unless a private recorder
-        # is injected (bench/test isolation).
-        self.recorder = recorder if recorder is not None \
-            else trace.default_recorder()
+        # is injected (bench/test isolation).  Shadows get a private ring:
+        # trial cycles over forked state (same gang keys as the live
+        # fleet!) must not overwrite the live gang's stitched trace in
+        # /debug/gangs//debug/explain or pin trial denials as real
+        # anomalies.
+        if recorder is not None:
+            self.recorder = recorder
+        elif telemetry:
+            self.recorder = trace.default_recorder()
+        else:
+            self.recorder = trace.FlightRecorder()
+        # Why-pending diagnosis engine (tpusched/obs): failed cycles feed
+        # their structured attribution here regardless of whether tracing
+        # is enabled — /debug/explain must answer during a trace outage too
+        if obs_engine is not None:
+            self.obs_engine = obs_engine
+        elif telemetry:
+            self.obs_engine = obs_mod.default_engine()
+        else:
+            self.obs_engine = obs_mod.DiagnosisEngine()
+        # SLO layer: re-install the global tracker only when this profile
+        # asks for DIFFERENT targets (HA standbys re-running the same
+        # profile must not reset the rolling windows); shadows observe
+        # into a private tracker that dies with them
+        if not telemetry:
+            self._slo = obs_mod.SLOTracker(profile.slo_pod_e2e_s,
+                                           profile.slo_gang_bound_s,
+                                           publish=False)
+        else:
+            if obs_mod.default_slo().targets != (profile.slo_pod_e2e_s,
+                                                 profile.slo_gang_bound_s):
+                obs_mod.install_slo(obs_mod.SLOTracker(
+                    profile.slo_pod_e2e_s, profile.slo_gang_bound_s))
+            # None = resolve the GLOBAL tracker at observe time: if a
+            # later scheduler retargets/reinstalls it, earlier live
+            # schedulers must follow instead of publishing from a
+            # replaced tracker (two publishers would fight over the
+            # shared burn-rate gauge children)
+            self._slo = None
+        self._telemetry = telemetry
         # degraded-mode circuit breaker, fed by the clientset's retry layer:
         # consecutive retry-exhausted calls pause pop-dispatch (see
         # _DegradedMode); any successful call recovers it
@@ -377,6 +425,10 @@ class Scheduler:
         self._fw: Optional[Framework] = None
         self.handle = Handle(self.clientset, self.informer_factory,
                              lambda: self._fw, clock)
+        # shadow marker for plugins that feed process-global telemetry
+        # (Coscheduling's gang-bound SLO clock checks it): a trial bind's
+        # latency must not count into the production burn rate
+        self.handle.telemetry = telemetry
         self._fw = Framework(registry, profile, self.handle)
 
         # Plugins without EnqueueExtensions default to all-events (upstream
@@ -404,31 +456,39 @@ class Scheduler:
         # so the label keeps N schedulers from clobbering each other's gauge)
         # escape per the Prometheus text format: the name is the one
         # user-controlled string that reaches a label value
-        esc = (profile.scheduler_name.replace("\\", r"\\")
-               .replace('"', r'\"').replace("\n", r"\n"))
+        from ..util.metrics import escape_label_value
+        esc = escape_label_value(profile.scheduler_name)
         sched_label = f'scheduler="{esc}",' if profile.scheduler_name else ""
-        for q in ("active", "backoff", "unschedulable"):
-            def depth(q=q, ref=queue_ref):
-                live = ref()
-                # None = dead provider: the registry prunes this series at
-                # the next scrape instead of emitting stale zeros forever
-                # (HA failover / what-if restarts construct schedulers
-                # under fresh label sets constantly)
-                return live.pending_counts()[q] if live is not None else None
-            REGISTRY.gauge_func("tpusched_pending_pods", depth,
-                                "Pods pending per scheduling sub-queue.",
-                                labels=f'{sched_label}queue="{q}"')
-        # degraded-mode visibility: 1 while pop-dispatch is paused (same
-        # weakref/prune discipline as the queue gauges above)
-        degraded_ref = weakref.ref(self._degraded)
+        # Shadows register NO gauge providers: a trial scheduler usually
+        # runs under the SAME scheduler_name as the live one, so
+        # gauge_func's re-register-replaces semantics would hijack the
+        # live series with trial queue depths — and kill it outright when
+        # the trial is garbage-collected (dead-provider pruning).
+        if telemetry:
+            for q in ("active", "backoff", "unschedulable"):
+                def depth(q=q, ref=queue_ref):
+                    live = ref()
+                    # None = dead provider: the registry prunes this series
+                    # at the next scrape instead of emitting stale zeros
+                    # forever (HA failover / what-if restarts construct
+                    # schedulers under fresh label sets constantly)
+                    return live.pending_counts()[q] if live is not None \
+                        else None
+                REGISTRY.gauge_func("tpusched_pending_pods", depth,
+                                    "Pods pending per scheduling sub-queue.",
+                                    labels=f'{sched_label}queue="{q}"')
+            # degraded-mode visibility: 1 while pop-dispatch is paused (same
+            # weakref/prune discipline as the queue gauges above)
+            degraded_ref = weakref.ref(self._degraded)
 
-        def degraded_val(ref=degraded_ref):
-            live = ref()
-            return None if live is None else (1.0 if live.active() else 0.0)
-        REGISTRY.gauge_func(
-            "tpusched_degraded_mode", degraded_val,
-            "1 while the scheduler pauses pop-dispatch after consecutive "
-            "API retry exhaustions.", labels=sched_label.rstrip(","))
+            def degraded_val(ref=degraded_ref):
+                live = ref()
+                return None if live is None else \
+                    (1.0 if live.active() else 0.0)
+            REGISTRY.gauge_func(
+                "tpusched_degraded_mode", degraded_val,
+                "1 while the scheduler pauses pop-dispatch after consecutive "
+                "API retry exhaustions.", labels=sched_label.rstrip(","))
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
         # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
@@ -475,6 +535,12 @@ class Scheduler:
         self._watchdog = _StuckGangWatchdog(
             self, profile.stuck_gang_after_s,
             profile.stuck_gang_sweep_interval_s)
+        # capacity & fragmentation telemetry: a scrape-time collector over
+        # this scheduler's informers + cache (unregistered at stop()).
+        # Shadows register none — a trial's fork must not publish
+        # hypothetical pool/quota gauges as real fleet state
+        self._capacity = obs_mod.CapacityTelemetry(self) if telemetry \
+            else None
         self._wire_informers()
 
     @property
@@ -593,6 +659,9 @@ class Scheduler:
         self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        # a deleted pod is no longer pending-with-a-question: evict its
+        # rolling diagnosis so the bounded table tracks live pods only
+        self.obs_engine.on_resolved(pod.key, "deleted")
         self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
         if assigned(pod):
             self.cache.remove_pod(pod)
@@ -612,6 +681,8 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._capacity is not None:
+            self._capacity.close()
         self.queue.close()
         # unblock waiting gang members; their resolution callbacks enqueue
         # the (failing) binding tasks, which the pool drains before exit
@@ -677,17 +748,21 @@ class Scheduler:
         # skip pods deleted/bound while queued
         live = self._live_pod(pod.key)
         if live is None or assigned(live) or live.is_terminating():
+            # no longer pending: its why-pending entry is answered
+            self.obs_engine.on_resolved(pod.key)
             return
         pod = live
         info.pod = live
-        schedule_attempts.inc()
         start = self.clock()
-
+        # global counters are live-fleet data: shadow trials (what-if,
+        # defrag) must not inflate them with simulated cycles
+        if self._telemetry:
+            schedule_attempts.inc()
+            queue_wait_seconds.observe(max(0.0, start - info.timestamp))
         # flight recorder: one cycle trace per attempt, active on this
         # thread (klog/Events correlate via the id) until the cycle either
         # resolves or parks at the permit barrier; committed to the ring
         # unconditionally so even a still-waiting cycle is inspectable
-        queue_wait_seconds.observe(max(0.0, start - info.timestamp))
         tr = None
         if trace.enabled():
             tr = self.recorder.begin_cycle(
@@ -723,10 +798,11 @@ class Scheduler:
         node_name, status = self._schedule_pod(state, pod, snapshot)
         if not status.is_success():
             self._run_post_filter(state, pod, status)
+            diagnosis = state.try_read("tpusched/diagnosis")
             if tr is not None:
                 tr.finish("error" if status.is_error() else "unschedulable",
-                          status=status,
-                          diagnosis=state.try_read("tpusched/diagnosis"))
+                          status=status, diagnosis=diagnosis)
+            self._obs_failure(info, pod, status, diagnosis=diagnosis)
             self._handle_failure(info, status)
             self._activate_pods(pods_to_activate)
             return
@@ -746,6 +822,7 @@ class Scheduler:
             self._forget_and_signal(assumed)
             if tr is not None:
                 tr.finish("reserve-failed", status=s, node=node_name)
+            self._obs_failure(info, pod, s, outcome="reserve-failed")
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
@@ -757,16 +834,25 @@ class Scheduler:
             self._forget_and_signal(assumed)
             if tr is not None:
                 tr.finish("permit-rejected", status=s, node=node_name)
+            self._obs_failure(info, pod, s, outcome="permit-rejected")
             self._handle_failure(info, s)
             self._activate_pods(pods_to_activate)
             return
 
-        if tr is not None and s.is_wait():
+        if s.is_wait():
             # parked at the permit barrier: record which plugins hold it so
-            # a wedged gang is explainable from the dump before any timeout
+            # a wedged gang is explainable (trace dump AND /debug/explain)
+            # before any timeout fires
             wp = self._fw.get_waiting_pod(assumed.meta.uid)
-            tr.mark_waiting(wp.get_pending_plugins() if wp else [])
-            tr.node = node_name
+            pending = wp.get_pending_plugins() if wp else []
+            if tr is not None:
+                tr.mark_waiting(pending)
+                tr.node = node_name
+            self.obs_engine.on_attempt(
+                pod.key, pod_group_full_name(pod) or None, "waiting-permit",
+                "/".join(pending) or "Permit",
+                "waiting at the permit barrier", None,
+                getattr(info, "attempts", 0))
 
         # sibling activation happens at end of the scheduling cycle
         self._activate_pods(pods_to_activate)
@@ -1279,6 +1365,7 @@ class Scheduler:
                                **detail)
                 tr.finish(outcome, status=status, node=node_name)
                 self.recorder.finalize(tr, now=self.clock())
+            self._obs_failure(info, pod, status, outcome=outcome)
             self._fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self._forget_and_signal(pod)
             self._handle_failure(info, status, to_backoff=to_backoff)
@@ -1334,8 +1421,21 @@ class Scheduler:
                  rollback=rollback)
             return
         self.cache.finish_binding(pod)
-        bind_total.inc()
-        e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        if self._telemetry:
+            # live-fleet counters only: a shadow trial's simulated
+            # (in-memory, near-zero-latency) binds would inflate
+            # bind_total and pollute the e2e latency histogram
+            bind_total.inc()
+            e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        # bound: the why-pending question is answered; feed the pod-e2e SLO
+        # with the user-perceived interval (first enqueue → bind commit)
+        self.obs_engine.on_resolved(pod.key)
+        slo = self._slo if self._slo is not None else obs_mod.default_slo()
+        slo.observe(
+            obs_mod.POD_E2E,
+            max(0.0, self.clock() - getattr(info,
+                                            "initial_attempt_timestamp",
+                                            cycle_start)))
         self.clientset.record_event(
             pod.key, "Pod", "Normal", "Scheduled",
             f"Successfully assigned {pod.key} to {node_name}")
@@ -1421,6 +1521,21 @@ class Scheduler:
         self.queue.move_all_to_active_or_backoff(RESOURCE_POD, EVENT_DELETE)
 
     # -- failure path ---------------------------------------------------------
+
+    def _obs_failure(self, info: QueuedPodInfo, pod: Pod, status: Status,
+                     diagnosis: Optional[Dict[str, Status]] = None,
+                     outcome: Optional[str] = None) -> None:
+        """Feed the why-pending diagnosis engine one failed cycle.  Works
+        with tracing disabled: the inputs are the merged Status and the
+        Filter sweep's per-node diagnosis the cycle produced anyway.  The
+        per-node map is summarized through the same bounded aggregator the
+        flight recorder uses, so the two surfaces cannot disagree."""
+        rows = trace.summarize_diagnosis(diagnosis) if diagnosis else None
+        self.obs_engine.on_attempt(
+            pod.key, pod_group_full_name(pod) or None,
+            outcome or ("error" if status.is_error() else "unschedulable"),
+            status.plugin, status.message(), rows,
+            getattr(info, "attempts", 0))
 
     def _handle_failure(self, info: QueuedPodInfo, status: Status,
                         to_backoff: bool = False) -> None:

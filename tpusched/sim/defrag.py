@@ -188,7 +188,7 @@ def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
     # big gangs are the hardest to re-home: place them first
     captured.sort(key=lambda t: (-t[1], t[0]))
 
-    sched = Scheduler(fork, default_registry(), profile)
+    sched = Scheduler(fork, default_registry(), profile, telemetry=False)
     sched.run()
     try:
         pre_resident = {p.meta.key for p in fork.list(srv.PODS)}

@@ -237,7 +237,8 @@ def simulate_gang(source_api: Optional[APIServer] = None,
     shadow = _shadow_of(source_api, state_dir)
     profile = _make_profile(allow_preemption, timeout_s,
                             config_path, scheduler_name)
-    sched = Scheduler(shadow, default_registry(), profile)
+    sched = Scheduler(shadow, default_registry(), profile,
+                      telemetry=False)
     sched.run()
     try:
         report, _ = _run_one(shadow, name=name, namespace=namespace,
@@ -335,7 +336,8 @@ def simulate_plan(source_api: Optional[APIServer] = None,
     # the restore/barrier machinery keys off what the RESOLVED profile can
     # do — a --config profile may enable preemption without the flag
     may_evict = allow_preemption or _profile_may_evict(profile)
-    sched = Scheduler(shadow, default_registry(), profile)
+    sched = Scheduler(shadow, default_registry(), profile,
+                      telemetry=False)
     sched.run()
     reports: List[WhatIfReport] = []
     plan_pods: set = set()
@@ -390,7 +392,8 @@ def simulate_plan(source_api: Optional[APIServer] = None,
                                 "pods; all restored]").strip()
                 r.victims = []
                 r.displaced_plan_pods = []
-                sched = Scheduler(shadow, default_registry(), profile)
+                sched = Scheduler(shadow, default_registry(), profile,
+                                  telemetry=False)
                 sched.run()
         return reports
     finally:

@@ -149,12 +149,15 @@ class HostGrid:
         return cls(spec.pool, acc, dims, wrap, node_of, coord_of)
 
 
-def enumerate_placements(grid: HostGrid, chip_shape: Coord) -> List[Placement]:
-    """All distinct host-sets where `chip_shape` (chips; any rotation) can
-    sit on the grid. Wraparound anchors are allowed only on wrapped axes; a
-    block spanning the full axis uses a single anchor."""
-    out: List[Placement] = []
-    seen = set()
+def iter_placements(grid: HostGrid, chip_shape: Coord):
+    """Lazily yield every host-set where `chip_shape` (chips; any
+    rotation) can sit on the grid — wraparound anchors only on wrapped
+    axes; a block spanning the full axis uses a single anchor.  May yield
+    the same set more than once across rotations (enumerate_placements
+    dedups); the generator form exists so existence probes (the
+    fragmentation gauge's largest-window search) can stop at the first
+    fit without materializing the full placement list — and so the gauge
+    and the scheduler share ONE implementation of the placement rules."""
     rank = len(grid.dims)
     for shape in candidate_host_blocks(chip_shape, grid.acc, grid.dims):
         anchor_ranges = []
@@ -167,12 +170,20 @@ def enumerate_placements(grid: HostGrid, chip_shape: Coord) -> List[Placement]:
                 anchor_ranges.append(range(grid.dims[i] - shape[i] + 1))
         offsets = list(itertools.product(*(range(s) for s in shape)))
         for anchor in itertools.product(*anchor_ranges):
-            hosts = frozenset(
-                tuple((anchor[i] + off[i]) % grid.dims[i] for i in range(rank))
+            yield frozenset(
+                tuple((anchor[i] + off[i]) % grid.dims[i]
+                      for i in range(rank))
                 for off in offsets)
-            if hosts not in seen:
-                seen.add(hosts)
-                out.append(hosts)
+
+
+def enumerate_placements(grid: HostGrid, chip_shape: Coord) -> List[Placement]:
+    """All DISTINCT host-sets where `chip_shape` can sit on the grid."""
+    out: List[Placement] = []
+    seen = set()
+    for hosts in iter_placements(grid, chip_shape):
+        if hosts not in seen:
+            seen.add(hosts)
+            out.append(hosts)
     return out
 
 

@@ -142,7 +142,11 @@ class FlightRecorder:
                     return
             self._pinned.append([tr, est, key])
             self._pinned_bytes += est
-            flight_recorder_anomalies.inc()
+            # one inc per distinct anomaly kind on the trace (almost always
+            # exactly one): the family total stays ~= pinned traces while
+            # dashboards can alert per failure mode
+            for k in (kinds or ("unknown",)):
+                flight_recorder_anomalies.with_labels(k or "unknown").inc()
             while self._pinned and (len(self._pinned) > self.max_pinned
                                     or self._pinned_bytes
                                     > self.max_pinned_bytes):

@@ -17,6 +17,10 @@ rebuild's equivalent for its own binaries:
   permit barrier, stragglers, per-member attribution)
 - ``/debug/flightrecorder``  the full dump: stats + ring + pinned anomaly
   traces + gangs — a wedged gang is explainable from this one document
+- ``/debug/explain``  the why-pending diagnosis engine (tpusched/obs):
+  ``?pod=`` / ``?gang=`` → rolling rejection aggregate + blocking plugin
+  + suggested unblock signal; no argument → cluster top-blockers + SLO
+  summary (also served by ``python -m tpusched.cmd.explain``)
 """
 from __future__ import annotations
 
@@ -80,12 +84,49 @@ class MetricsServer:
                     self._send_json({"gangs": server.recorder().gangs.dump()})
                 elif path == "/debug/flightrecorder":
                     self._send_json(server.recorder().dump())
+                elif path == "/debug/explain":
+                    code, payload = self._explain_payload(query)
+                    self._send(code, json.dumps(payload) + "\n",
+                               "application/json")
                 elif path == "/debug/vars":
                     self._send(200, json.dumps(
                         {"threads": threading.active_count()}) + "\n",
                         "application/json")
                 else:
                     self._send(404, "not found\n")
+
+            def _explain_payload(self, query: str):
+                """/debug/explain: the why-pending diagnosis surface.
+                Late-bound process-global engine/SLO tracker (tpusched.obs)
+                — same contract as the flight-recorder routes."""
+                from .. import obs
+                qs = urllib.parse.parse_qs(query)
+                engine = obs.default_engine()
+                pod = qs.get("pod", [None])[0]
+                gang = qs.get("gang", [None])[0]
+                if pod is not None:
+                    out = engine.explain_pod(pod)
+                    if out is None:
+                        return 404, {"error": f"no pending diagnosis for "
+                                              f"pod {pod!r} (bound, "
+                                              "deleted, or never seen)"}
+                    return 200, out
+                if gang is not None:
+                    out = engine.explain_gang(gang)
+                    if out is None:
+                        return 404, {"error": f"no pending diagnosis for "
+                                              f"gang {gang!r}"}
+                    # stitch in the permit-barrier view when the flight
+                    # recorder holds one (tracing may be off: optional)
+                    gt = server.recorder().gangs.get(out["gang"])
+                    if gt is not None:
+                        gd = gt.to_dict()
+                        out["permit_barrier"] = gd.get("permit_barrier")
+                        out["members_seen_by_tracer"] = gd["members_seen"]
+                    return 200, out
+                dump = engine.dump()
+                dump["slo"] = obs.default_slo().summary()
+                return 200, dump
 
             def _trace_payload(self, query: str):
                 qs = urllib.parse.parse_qs(query)

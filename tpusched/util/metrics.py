@@ -13,10 +13,31 @@ import bisect
 import collections
 import threading
 import time as _time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.0, 5.0, 10.0, 30.0, 60.0)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (exposition format spec):
+    backslash, double-quote and newline must be escaped or one hostile
+    value (a pod name, an error string) corrupts every later sample line."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(h: str) -> str:
+    """# HELP escaping: backslash and newline only (quotes are legal)."""
+    return h.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def format_labels(label_names: Tuple[str, ...],
+                  label_values: Tuple[str, ...]) -> str:
+    """``k1="v1",k2="v2"`` with proper value escaping — the one formatter
+    every labeled family goes through."""
+    return ",".join(f'{k}="{escape_label_value(v)}"'
+                    for k, v in zip(label_names, label_values))
 
 
 class Counter:
@@ -144,16 +165,101 @@ class HistogramVec:
             return dict(self._children)
 
 
+class _ScalarVec:
+    """Labeled scalar family (counter/gauge children created on first use).
+
+    ``value()`` returns the SUM over children so a family can stand in for
+    the unlabeled counter it replaced — call sites that watched the total
+    (tests, the chaos soak's invariants) keep working across the
+    name-mangled → labeled-children migration."""
+
+    _child_type = Counter
+
+    def __init__(self, name: str, label_names: Tuple[str, ...],
+                 help_: str = ""):
+        self.name, self.help = name, help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Counter] = {}
+
+    def with_labels(self, *label_values) -> Counter:
+        key = tuple(str(v) for v in label_values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: want labels {self.label_names}, got {key}")
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child_type(self.name, self.help)
+                self._children[key] = child
+            return child
+
+    def children(self) -> Dict[Tuple[str, ...], Counter]:
+        with self._lock:
+            return dict(self._children)
+
+    def value(self) -> float:
+        return sum(c.value() for c in self.children().values())
+
+    def clear(self) -> None:
+        """Drop every child (collectors that rebuild the family per refresh
+        use this so vanished label sets — a deleted pool, a removed quota —
+        do not linger as stale series)."""
+        with self._lock:
+            self._children.clear()
+
+    def remove(self, *label_values) -> None:
+        """Drop one child: a vanished label set (deleted pool, removed
+        quota) must stop being exposed, not freeze at its last value."""
+        with self._lock:
+            self._children.pop(tuple(str(v) for v in label_values), None)
+
+
+class CounterVec(_ScalarVec):
+    _child_type = Counter
+
+
+class GaugeVec(_ScalarVec):
+    _child_type = Gauge
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        # scrape-time collectors (capacity/fragmentation telemetry): called
+        # before each expose() so gauge families with DYNAMIC label sets
+        # (per pool, per quota namespace) refresh without a background
+        # thread. A collector raising is dropped from that scrape only —
+        # telemetry must never take /metrics down with it.
+        self._collectors: List[Callable[[], None]] = []
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get_or_make(name, lambda: Counter(name, help_))
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_make(name, lambda: Gauge(name, help_))
+
+    def counter_vec(self, name: str, label_names: Tuple[str, ...],
+                    help_: str = "") -> CounterVec:
+        return self._get_or_make(
+            name, lambda: CounterVec(name, label_names, help_))
+
+    def gauge_vec(self, name: str, label_names: Tuple[str, ...],
+                  help_: str = "") -> GaugeVec:
+        return self._get_or_make(
+            name, lambda: GaugeVec(name, label_names, help_))
+
+    def register_collector(self, fn: Callable[[], None]) -> Callable[[], None]:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def unregister_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
 
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_make(name, lambda: Histogram(name, help_, buckets))
@@ -177,35 +283,86 @@ class Registry:
                 self._metrics[name] = ctor()
             return self._metrics[name]
 
+    @staticmethod
+    def _metric_type(m) -> str:
+        # order matters: Gauge subclasses Counter
+        if isinstance(m, (Gauge, GaugeFunc, GaugeVec)):
+            return "gauge"
+        if isinstance(m, (Counter, CounterVec)):
+            return "counter"
+        if isinstance(m, (Histogram, HistogramVec)):
+            return "histogram"
+        return "untyped"
+
     def expose(self) -> str:
-        """Prometheus text exposition format. GaugeFunc entries whose
-        provider reports a dead target are pruned here rather than emitted
-        as stale zeros (see GaugeFunc)."""
+        """Prometheus text exposition format, conformant per the format
+        spec: one ``# HELP``/``# TYPE`` header per metric FAMILY (emitted
+        before its first sample, never repeated — gauge_func series of one
+        name share a single header), escaped HELP text and label values,
+        and deterministic ordering (families by name, children by label
+        tuple). GaugeFunc entries whose provider reports a dead target are
+        pruned here rather than emitted as stale zeros (see GaugeFunc)."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — telemetry refresh is
+                pass           # best-effort; /metrics must stay up
         lines: List[str] = []
         dead: List[str] = []
         with self._lock:
             metrics = dict(self._metrics)
-        for name, m in sorted(metrics.items()):
-            if isinstance(m, HistogramVec):
-                for values, child in sorted(m.children().items()):
-                    labels = ",".join(f'{k}="{v}"'
-                                      for k, v in zip(m.label_names, values))
-                    self._expose_histogram(lines, name, child, labels)
-            elif isinstance(m, Histogram):
-                self._expose_histogram(lines, name, m, "")
-            else:
-                v = m.value()
-                if isinstance(m, GaugeFunc) and m.dead:
-                    dead.append(name)
-                    continue
-                lines.append(f"{name} {v}")
+        # group registry keys by metric FAMILY name: gauge_func series are
+        # keyed 'name{labels}' and must share one HELP/TYPE header
+        families: Dict[str, List[Tuple[str, object]]] = {}
+        for key, m in metrics.items():
+            families.setdefault(getattr(m, "name", key), []).append((key, m))
+        for name in sorted(families):
+            entries = sorted(families[name], key=lambda kv: kv[0])
+            m0 = entries[0][1]
+            help_ = getattr(m0, "help", "")
+            if help_:
+                lines.append(f"# HELP {name} {_escape_help(help_)}")
+            lines.append(f"# TYPE {name} {self._metric_type(m0)}")
+            emitted = 0
+            for key, m in entries:
+                if isinstance(m, HistogramVec):
+                    for values, child in sorted(m.children().items()):
+                        self._expose_histogram(
+                            lines, name, child,
+                            format_labels(m.label_names, values))
+                        emitted += 1
+                elif isinstance(m, Histogram):
+                    self._expose_histogram(lines, name, m, "")
+                    emitted += 1
+                elif isinstance(m, (CounterVec, GaugeVec)):
+                    for values, child in sorted(m.children().items()):
+                        labels = format_labels(m.label_names, values)
+                        lines.append(f"{name}{{{labels}}} {child.value()}")
+                        emitted += 1
+                else:
+                    v = m.value()
+                    if isinstance(m, GaugeFunc) and m.dead:
+                        dead.append(key)
+                        continue
+                    labels = getattr(m, "labels", "")
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(f"{name}{suffix} {v}")
+                    emitted += 1
+            if emitted == 0:
+                # every series of the family was pruned (dead gauge_funcs)
+                # or the vec has no children yet: drop the orphan header
+                del lines[-1]
+                if help_:
+                    del lines[-1]
         if dead:
             with self._lock:
-                for name in dead:
-                    m = self._metrics.get(name)
+                for key in dead:
+                    m = self._metrics.get(key)
                     # re-registration may have revived the slot since
                     if isinstance(m, GaugeFunc) and m.dead:
-                        del self._metrics[name]
+                        del self._metrics[key]
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -283,9 +440,12 @@ equiv_cache_differential_mismatches = REGISTRY.counter(
 queue_wait_seconds = REGISTRY.histogram(
     "tpusched_scheduling_queue_wait_duration_seconds",
     "Last-enqueue to pop per scheduling cycle (the trace's queue-wait span).")
-flight_recorder_anomalies = REGISTRY.counter(
-    "tpusched_flight_recorder_anomalies_total",
-    "Cycle traces pinned by the flight recorder as anomalies.")
+# Labeled by anomaly kind (permit_timeout, bind_failed, gang_denied,
+# gang_stuck, ...) so dashboards can alert on ONE failure mode without
+# name-mangled per-kind metrics; .value() is the family total.
+flight_recorder_anomalies = REGISTRY.counter_vec(
+    "tpusched_flight_recorder_anomalies_total", ("kind",),
+    "Cycle traces pinned by the flight recorder as anomalies, by kind.")
 # API-failure resilience (apiserver/client.py retry layer + the scheduler's
 # degraded mode). retries counts every re-attempt the client made after a
 # retriable failure; retry_exhausted counts calls that failed terminally
@@ -297,12 +457,13 @@ flight_recorder_anomalies = REGISTRY.counter(
 # pins a gang_bind_rollback anomaly trace in the flight recorder).
 # tpusched_degraded_mode itself is a per-scheduler gauge_func registered by
 # the Scheduler (0 = normal, 1 = pop-dispatch paused).
-api_retries = REGISTRY.counter(
-    "tpusched_api_retries_total",
-    "API calls re-attempted after a retriable failure.")
-api_retry_exhausted = REGISTRY.counter(
-    "tpusched_api_retry_exhausted_total",
-    "API calls that failed terminally after exhausting their retry budget.")
+api_retries = REGISTRY.counter_vec(
+    "tpusched_api_retries_total", ("verb",),
+    "API calls re-attempted after a retriable failure, by verb.")
+api_retry_exhausted = REGISTRY.counter_vec(
+    "tpusched_api_retry_exhausted_total", ("verb",),
+    "API calls that failed terminally after exhausting their retry "
+    "budget, by verb.")
 events_dropped = REGISTRY.counter(
     "tpusched_events_dropped_total",
     "Best-effort Event emissions swallowed instead of raised into a cycle.")
