@@ -70,10 +70,23 @@ obs-smoke:
 		tests/test_metrics_conformance.py -q -p no:cacheprovider
 
 .PHONY: tier1
-tier1: chaos-smoke trace-smoke obs-smoke
+tier1: lint chaos-smoke trace-smoke obs-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
+
+# tpulint: the AST-based invariant suite (tpusched/analysis) — ports of the
+# four historical grep lints plus exception-taxonomy, shadow-isolation,
+# monotonic-clock, thread-hygiene, lock-discipline and suppression-hygiene.
+# One interpreter pass over the tree, < 15 s by contract (the lint
+# self-test enforces it). `make lint-changed` is the fast pre-commit loop.
+.PHONY: lint
+lint:
+	$(PY) -m tpusched.cmd.lint
+
+.PHONY: lint-changed
+lint-changed:
+	$(PY) -m tpusched.cmd.lint --changed-only
 
 # Native C++ engine (torus placement math). Also auto-built when the
 # TopologyMatch plugin constructs (native.load() warm-up); this target just
@@ -82,8 +95,12 @@ tier1: chaos-smoke trace-smoke obs-smoke
 native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
 
+# All four historical grep lints are tpulint rules now; `make verify` runs
+# the FULL rule suite in one interpreter pass (via `lint`) instead of four
+# separate greps. The per-lint targets below still work (CI muscle memory)
+# as thin wrappers over single-rule tpulint runs.
 .PHONY: verify
-verify: verify-structured-logging verify-crdgen verify-manifests verify-kustomize verify-naked-api-calls verify-node-health-filters verify-metrics-names
+verify: lint verify-crdgen verify-manifests verify-kustomize
 
 # Prometheus naming contract: tpusched_ prefix, _total/_seconds suffix
 # conventions, no duplicate registrations.

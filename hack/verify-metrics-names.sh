@@ -1,54 +1,9 @@
 #!/usr/bin/env bash
-# Metric-name lint: every metric registered in tpusched/ must follow the
-# Prometheus naming contract this repo standardizes on —
-#
-#   1. `tpusched_` prefix (one namespace for the whole control plane);
-#   2. counters end `_total`, histograms end `_seconds` (the unit suffix —
-#      every histogram here is a duration), gauges never end `_total`;
-#   3. no duplicate registrations of one name from multiple sites
-#      (gauge_func is exempt: per-scheduler re-registration under fresh
-#      label sets is its designed lifecycle).
-#
-# A name that breaks the convention ships a dashboard/alert footgun that
-# can never be renamed cheaply once scraped — fail the build instead.
+# Thin wrapper: the Prometheus naming lint is now a tpulint AST rule
+# (tpusched/analysis/rules/metrics_names.py) — tpusched_ prefix, _total/
+# _seconds suffix conventions, no duplicate registrations.  This script
+# keeps the historical Makefile target; `make verify` runs the whole rule
+# suite in one interpreter pass via `make lint`.
 set -o errexit -o nounset -o pipefail
 cd "$(dirname "$0")/.."
-
-python - <<'EOF'
-import pathlib
-import re
-import sys
-
-pat = re.compile(
-    r'REGISTRY\.(counter_vec|gauge_vec|histogram_vec|counter|gauge_func'
-    r'|gauge|histogram)\(\s*\n?\s*"([^"]+)"')
-seen = {}
-bad = []
-for path in sorted(pathlib.Path("tpusched").rglob("*.py")):
-    text = path.read_text(encoding="utf-8")
-    for m in pat.finditer(text):
-        kind, name = m.group(1), m.group(2)
-        site = f"{path}:{text[:m.start()].count(chr(10)) + 1}"
-        if not name.startswith("tpusched_"):
-            bad.append(f"{site}: {name}: missing tpusched_ prefix")
-        if kind in ("counter", "counter_vec") \
-                and not name.endswith("_total"):
-            bad.append(f"{site}: {name}: counters must end _total")
-        if kind in ("histogram", "histogram_vec") \
-                and not name.endswith("_seconds"):
-            bad.append(f"{site}: {name}: histograms must end _seconds")
-        if kind in ("gauge", "gauge_vec", "gauge_func") \
-                and name.endswith("_total"):
-            bad.append(f"{site}: {name}: gauges must not end _total")
-        prev = seen.get(name)
-        if prev is not None and kind != "gauge_func":
-            bad.append(f"{site}: {name}: duplicate registration "
-                       f"(also at {prev})")
-        seen.setdefault(name, site)
-if bad:
-    print("ERROR: metric naming violations:", file=sys.stderr)
-    for b in bad:
-        print(f"  {b}", file=sys.stderr)
-    sys.exit(1)
-print(f"metrics-names verify OK ({len(seen)} metric names)")
-EOF
+exec python -m tpusched.cmd.lint --rules metrics-names

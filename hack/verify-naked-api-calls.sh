@@ -1,39 +1,10 @@
 #!/usr/bin/env bash
-# Naked-API-call lint: all scheduler-side API traffic must flow through the
-# retrying Clientset (tpusched/apiserver/client.py) — its error taxonomy,
-# capped-backoff retries, per-call deadlines and degraded-mode hooks are the
-# resilience contract, and a direct store call silently opts out of all of
-# it. Two patterns fail the build:
-#
-#   1. `self._api.` anywhere outside tpusched/apiserver/ — the raw store
-#      handle is an apiserver-package implementation detail;
-#   2. direct CRUD/bind/record_event on a bare `self.api` inside the
-#      scheduling core (sched/, fwk/, plugins/) — the scheduler owns a
-#      clientset precisely so its read/write/failure paths keep the retry
-#      layer (reads go through informer caches, writes through the client).
-#
-# Informer wiring (add_watch/peek/current_resource_version) and the
-# controllers' store bootstrap are intentionally out of scope.
+# Thin wrapper: the naked-API-call lint is now a tpulint AST rule
+# (tpusched/analysis/rules/api_calls.py) — raw `self._api.` access outside
+# tpusched/apiserver/ and direct CRUD/bind/record_event verbs on `self.api`
+# inside the scheduling core bypass the Clientset retry layer.  This script
+# keeps the historical Makefile target; `make verify` runs the whole rule
+# suite in one interpreter pass via `make lint`.
 set -o errexit -o nounset -o pipefail
 cd "$(dirname "$0")/.."
-
-# testing/ is exempt (harness plumbing talks to the raw store on purpose:
-# fixtures and watch monitors must not be attacked by the fault injector)
-bad_raw=$(grep -rn --include='*.py' 'self\._api\.' tpusched/ \
-  | grep -v '^tpusched/apiserver/' \
-  | grep -v '^tpusched/testing/' \
-  || true)
-
-bad_core=$(grep -rnE --include='*.py' \
-  'self\.api\.(create|get|try_get|list|update|patch|delete|bind|record_event)\(' \
-  tpusched/sched/ tpusched/fwk/ tpusched/plugins/ \
-  || true)
-
-if [[ -n "$bad_raw$bad_core" ]]; then
-  echo "ERROR: direct API-server calls bypassing the retry layer" >&2
-  echo "(use the Clientset — see tpusched/apiserver/client.py):" >&2
-  [[ -n "$bad_raw" ]] && echo "$bad_raw" >&2
-  [[ -n "$bad_core" ]] && echo "$bad_core" >&2
-  exit 1
-fi
-echo "naked-api-call verify OK"
+exec python -m tpusched.cmd.lint --rules naked-api-calls

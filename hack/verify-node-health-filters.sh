@@ -1,40 +1,10 @@
 #!/usr/bin/env bash
-# Node-health filter lint: every placement-producing plugin path must
-# consult node readiness. `api.core.node_health_error` is the single shared
-# judgement (unschedulable spec, Ready=False condition, not-ready taint) —
-# a Filter that skips it can admit a NotReady node, and a gang retrying
-# after a node failure would land right back on the dead hardware the
-# lifecycle controller just drained.
-#
-# Rule: every file under tpusched/plugins/ that defines a `def filter(self`
-# extension point must reference node_health_error (directly, or via a
-# helper defined in the same file). Candidate-set builders that pre-select
-# hosts for slice windows (TopologyMatch._occupancy) are covered by the
-# same file-level check.
+# Thin wrapper: the node-health filter lint is now a tpulint AST rule
+# (tpusched/analysis/rules/node_health.py) — every plugin file defining a
+# Filter must consult api.core.node_health_error, and the helper itself
+# must keep covering all three health facts.  This script keeps the
+# historical Makefile target; `make verify` runs the whole rule suite in
+# one interpreter pass via `make lint`.
 set -o errexit -o nounset -o pipefail
 cd "$(dirname "$0")/.."
-
-fail=0
-while IFS= read -r f; do
-  if ! grep -q 'node_health_error' "$f"; then
-    echo "ERROR: $f defines a Filter but never consults node_health_error" >&2
-    echo "       (import it from tpusched.api.core and reject unhealthy" >&2
-    echo "       nodes before any placement arithmetic)" >&2
-    fail=1
-  fi
-done < <(grep -rl --include='*.py' 'def filter(self' tpusched/plugins/)
-
-# the helper itself must keep covering all three health facts — a refactor
-# that drops one silently weakens every filter at once
-for fact in 'spec.unschedulable' 'node_ready' 'TAINT_NODE_NOT_READY'; do
-  if ! grep -A 20 'def node_health_error' tpusched/api/core.py \
-      | grep -q "$fact"; then
-    echo "ERROR: api/core.py node_health_error no longer checks $fact" >&2
-    fail=1
-  fi
-done
-
-if [[ "$fail" -ne 0 ]]; then
-  exit 1
-fi
-echo "node-health filter verify OK"
+exec python -m tpusched.cmd.lint --rules node-health-filters

@@ -1,21 +1,9 @@
 #!/usr/bin/env bash
-# Structured-logging regression check — analog of
-# /root/reference/hack/verify-structured-logging.sh:17-19 (which greps for
-# non-structured klog calls). Here: library code must log through
-# tpusched.util.klog (info_s/error_s/warning_s with key=value pairs), never
-# bare print(). The cmd/ binaries are exempt (they print JSON to stdout by
-# contract), as is testing/ (harness output).
+# Thin wrapper: the structured-logging lint is now a tpulint AST rule
+# (tpusched/analysis/rules/logging_discipline.py) — no bare print() in
+# library code; log through tpusched.util.klog.  This script keeps the
+# historical Makefile target; `make verify` runs the whole rule suite in
+# one interpreter pass via `make lint`.
 set -o errexit -o nounset -o pipefail
 cd "$(dirname "$0")/.."
-
-bad=$(grep -rn --include='*.py' '\bprint(' tpusched/ \
-  | grep -v '^tpusched/cmd/' \
-  | grep -v '^tpusched/testing/' \
-  || true)
-
-if [[ -n "$bad" ]]; then
-  echo "ERROR: bare print() in library code — use tpusched.util.klog:" >&2
-  echo "$bad" >&2
-  exit 1
-fi
-echo "structured-logging verify OK"
+exec python -m tpusched.cmd.lint --rules structured-logging

@@ -1,0 +1,537 @@
+"""tpulint (tpusched/analysis): positive + negative fixtures for every
+rule, suppression handling, JSON output schema, the CLI, and the meta-test
+that the LIVE tree is lint-clean inside the latency budget that lets the
+lint gate tier1 (< 15 s full-tree).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpusched.analysis import Runner, rule_names
+from tpusched.analysis.core import SUPPRESSION_HYGIENE
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_snippet(tmp_path, relpath, source, rules=None, extra=()):
+    """Write dedented ``source`` at ``relpath`` under a scratch repo root
+    and lint it (plus ``extra`` (relpath, source) files) with ``rules``."""
+    paths = []
+    for rp, src in [(relpath, source)] + list(extra):
+        f = tmp_path / rp
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src))
+        paths.append(f)
+    return Runner(tmp_path, rules).run(paths)
+
+
+def names(report):
+    return [(f.rule, f.line) for f in report.findings]
+
+
+# -- naked-api-calls -----------------------------------------------------------
+
+
+def test_naked_api_calls(tmp_path):
+    bad = """
+        class S:
+            def work(self):
+                return self._api.try_get("pods", "k")
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/foo.py", bad,
+                    ["naked-api-calls"])
+    assert [f.rule for f in r.findings] == ["naked-api-calls"]
+    # same file under apiserver/ is the implementation package — exempt
+    r = run_snippet(tmp_path, "tpusched/apiserver/foo.py", bad,
+                    ["naked-api-calls"])
+    assert r.findings == []
+    # direct store verbs on self.api in the scheduling core
+    core_bad = """
+        class P:
+            def bind_it(self, b):
+                return self.api.bind(b)
+    """
+    r = run_snippet(tmp_path, "tpusched/plugins/p.py", core_bad,
+                    ["naked-api-calls"])
+    assert len(r.findings) == 1 and "retry layer" in r.findings[0].message
+    # non-verb attribute access on self.api is informer wiring — fine
+    ok = """
+        class P:
+            def wire(self):
+                self.api.add_watch("pods", self.cb)
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/q.py", ok,
+                    ["naked-api-calls"])
+    assert r.findings == []
+
+
+# -- node-health-filters -------------------------------------------------------
+
+
+def test_node_health_filter_missing_reference(tmp_path):
+    bad = """
+        class F:
+            def filter(self, state, pod, node_info):
+                return None
+    """
+    r = run_snippet(tmp_path, "tpusched/plugins/myplug.py", bad,
+                    ["node-health-filters"])
+    assert [f.rule for f in r.findings] == ["node-health-filters"]
+    ok = """
+        from ..api.core import node_health_error
+
+        class F:
+            def filter(self, state, pod, node_info):
+                if node_health_error(node_info.node()):
+                    return "unhealthy"
+    """
+    r = run_snippet(tmp_path, "tpusched/plugins/myplug2.py", ok,
+                    ["node-health-filters"])
+    assert r.findings == []
+
+
+def test_node_health_helper_fact_check(tmp_path):
+    weakened = """
+        def node_health_error(node):
+            if node.spec.unschedulable:
+                return "cordoned"
+            return None
+    """
+    r = run_snippet(tmp_path, "tpusched/api/core.py", weakened,
+                    ["node-health-filters"])
+    msgs = [f.message for f in r.findings]
+    assert any("node_ready" in m for m in msgs)
+    assert any("TAINT_NODE_NOT_READY" in m for m in msgs)
+
+
+# -- metrics-names -------------------------------------------------------------
+
+
+def test_metrics_naming_contract(tmp_path):
+    bad = """
+        from ..util.metrics import REGISTRY
+        a = REGISTRY.counter("foo_total", "no prefix")
+        b = REGISTRY.counter("tpusched_things", "no _total")
+        c = REGISTRY.histogram("tpusched_lat_ms", "wrong unit")
+        d = REGISTRY.gauge("tpusched_depth_total", "gauge as counter")
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/m.py", bad, ["metrics-names"])
+    msgs = " ".join(f.message for f in r.findings)
+    assert "missing tpusched_ prefix" in msgs
+    assert "counters must end _total" in msgs
+    assert "histograms must end _seconds" in msgs
+    assert "gauges must not end _total" in msgs
+
+
+def test_metrics_duplicate_across_files(tmp_path):
+    one = 'x = REGISTRY.counter("tpusched_x_total", "a")\n'
+    two = 'y = REGISTRY.counter("tpusched_x_total", "b")\n'
+    r = run_snippet(tmp_path, "tpusched/a.py", one, ["metrics-names"],
+                    extra=[("tpusched/b.py", two)])
+    assert any("duplicate registration" in f.message for f in r.findings)
+    # gauge_func re-registration is its designed lifecycle
+    gf = 'g = REGISTRY.gauge_func("tpusched_g", lambda: 1)\n'
+    r = run_snippet(tmp_path, "tpusched/c.py", gf, ["metrics-names"],
+                    extra=[("tpusched/d.py", gf)])
+    assert r.findings == []
+    # ...but ONLY gauge_func-vs-gauge_func: a counter colliding with a
+    # gauge_func name ships two registrations of one series, either order
+    ctr = 'c = REGISTRY.gauge("tpusched_g", "collides")\n'
+    r = run_snippet(tmp_path, "tpusched/e.py", gf, ["metrics-names"],
+                    extra=[("tpusched/f.py", ctr)])
+    assert any("duplicate registration" in f.message for f in r.findings)
+
+
+# -- structured-logging --------------------------------------------------------
+
+
+def test_print_flagged_outside_cmd(tmp_path):
+    src = 'print("hello")\n'
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["structured-logging"])
+    assert [f.rule for f in r.findings] == ["structured-logging"]
+    for exempt in ("tpusched/cmd/x.py", "tpusched/testing/x.py"):
+        r = run_snippet(tmp_path, exempt, src, ["structured-logging"])
+        assert r.findings == []
+
+
+# -- exception-taxonomy --------------------------------------------------------
+
+
+def test_exception_taxonomy(tmp_path):
+    bare = """
+        try:
+            x = 1
+        except:
+            pass
+    """
+    r = run_snippet(tmp_path, "tpusched/a.py", bare,
+                    ["exception-taxonomy"])
+    assert "bare except" in r.findings[0].message
+    swallow = """
+        try:
+            x = 1
+        except Exception:
+            x = 2
+    """
+    r = run_snippet(tmp_path, "tpusched/b.py", swallow,
+                    ["exception-taxonomy"])
+    assert len(r.findings) == 1
+    # binding + referencing the exception preserves the taxonomy
+    logged = """
+        try:
+            x = 1
+        except Exception as e:
+            log(e)
+    """
+    r = run_snippet(tmp_path, "tpusched/c.py", logged,
+                    ["exception-taxonomy"])
+    assert r.findings == []
+    reraised = """
+        try:
+            x = 1
+        except BaseException:
+            raise
+    """
+    r = run_snippet(tmp_path, "tpusched/d.py", reraised,
+                    ["exception-taxonomy"])
+    assert r.findings == []
+    narrow = """
+        try:
+            x = 1
+        except ValueError:
+            pass
+    """
+    r = run_snippet(tmp_path, "tpusched/e.py", narrow,
+                    ["exception-taxonomy"])
+    assert r.findings == []
+
+
+# -- shadow-isolation ----------------------------------------------------------
+
+
+def test_shadow_module_must_not_touch_globals(tmp_path):
+    bad = """
+        from ..util.metrics import REGISTRY
+
+        def plan(api, registry, profile):
+            s = Scheduler(api, registry, profile)
+            return s
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/planner.py", bad,
+                    ["shadow-isolation"])
+    msgs = " ".join(f.message for f in r.findings)
+    assert "REGISTRY" in msgs
+    assert "telemetry=False" in msgs
+    ok = """
+        def plan(api, registry, profile):
+            return Scheduler(api, registry, profile, telemetry=False)
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/planner2.py", ok,
+                    ["shadow-isolation"])
+    assert r.findings == []
+
+
+def test_accessor_needs_guard_outside_sim(tmp_path):
+    bad = """
+        from .. import trace
+
+        def wire(self):
+            self.rec = trace.default_recorder()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/s.py", bad,
+                    ["shadow-isolation"])
+    assert len(r.findings) == 1
+    guarded = """
+        from .. import trace
+
+        def wire(self, telemetry):
+            if telemetry:
+                self.rec = trace.default_recorder()
+            else:
+                self.rec = trace.FlightRecorder()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/s2.py", guarded,
+                    ["shadow-isolation"])
+    assert r.findings == []
+    module_level = """
+        from .. import trace
+        REC = trace.default_recorder()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/s3.py", module_level,
+                    ["shadow-isolation"])
+    assert "module level" in r.findings[0].message
+
+
+# -- monotonic-clock -----------------------------------------------------------
+
+
+def test_monotonic_clock_flags_calls_not_references(tmp_path):
+    src = """
+        import time
+
+        def f():
+            return time.time()
+
+        def g(clock=time.time):
+            return clock()
+    """
+    r = run_snippet(tmp_path, "tpusched/a.py", src, ["monotonic-clock"])
+    assert len(r.findings) == 1       # the call, not the default parameter
+
+
+def test_monotonic_clock_sees_through_aliases(tmp_path):
+    src = """
+        import time as _t
+        from time import time as wall
+
+        def f():
+            return _t.time() + wall()
+    """
+    r = run_snippet(tmp_path, "tpusched/b.py", src, ["monotonic-clock"])
+    assert len(r.findings) == 2
+
+
+# -- thread-hygiene ------------------------------------------------------------
+
+
+def test_thread_hygiene(tmp_path):
+    src = """
+        import threading
+
+        def a():
+            threading.Thread(target=a).start()
+
+        def b():
+            threading.Thread(target=b, daemon=True).start()
+
+        def c():
+            threading.Thread(target=c, name="tpusched-c",
+                             daemon=True).start()
+    """
+    r = run_snippet(tmp_path, "tpusched/t.py", src, ["thread-hygiene"])
+    assert len(r.findings) == 2
+    assert "name/daemon" in r.findings[0].message
+    assert "name" in r.findings[1].message
+
+
+# -- lock-discipline -----------------------------------------------------------
+
+_GUARDED_CLASS = """
+    from tpusched.util.locking import GuardedLock, guarded_by
+
+    @guarded_by("_lock", "_d", "_n")
+    class Box:
+        def __init__(self):
+            self._lock = GuardedLock("Box")
+            self._d = {}
+            self._n = 0
+
+        def good(self):
+            with self._lock:
+                self._d["a"] = 1
+                self._n += 1
+
+        def helper_locked(self):
+            self._d.pop("a", None)
+
+        def bad(self):
+            self._d["b"] = 2
+
+        def bad_mutator(self):
+            self._d.update(x=1)
+
+        def bad_rebind(self):
+            self._n = 7
+"""
+
+
+def test_lock_discipline_rule(tmp_path):
+    r = run_snippet(tmp_path, "tpusched/sched/box.py", _GUARDED_CLASS,
+                    ["lock-discipline"])
+    got = sorted((f.message.split(":")[0], f.line) for f in r.findings)
+    # exactly the three bad methods; good/__init__/_locked are clean
+    assert len(got) == 3
+    msgs = " ".join(f.message for f in r.findings)
+    assert "Box.bad:" in msgs
+    assert "Box.bad_mutator:" in msgs
+    assert "Box.bad_rebind:" in msgs
+
+
+def test_lock_discipline_ignores_undeclared_classes(tmp_path):
+    src = """
+        class Plain:
+            def poke(self):
+                self._d["a"] = 1
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/plain.py", src,
+                    ["lock-discipline"])
+    assert r.findings == []
+
+
+# -- suppressions --------------------------------------------------------------
+
+
+def test_sameline_suppression(tmp_path):
+    src = ('import time\n'
+           'x = time.time()  '
+           '# tpulint: disable=monotonic-clock — fixture wall time\n')
+    r = run_snippet(tmp_path, "tpusched/a.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    assert r.findings == []
+    assert len(r.suppressed) == 1
+    assert r.suppressed[0][1].reason == "fixture wall time"
+
+
+def test_standalone_suppression_spans_wrapped_comment(tmp_path):
+    src = ('import time\n'
+           '# tpulint: disable=monotonic-clock — a justification that\n'
+           '# wraps over two comment lines\n'
+           'x = time.time()\n')
+    r = run_snippet(tmp_path, "tpusched/b.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    assert r.findings == []
+    assert len(r.suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = ('import time\n'
+           'x = time.time()  # tpulint: disable=monotonic-clock —\n')
+    r = run_snippet(tmp_path, "tpusched/c.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    rules = {f.rule for f in r.findings}
+    assert SUPPRESSION_HYGIENE in rules
+    assert any("no justification" in f.message for f in r.findings)
+
+
+def test_suppression_without_separator_still_parsed_and_flagged(tmp_path):
+    """The most natural malformed directive — no separator, no reason —
+    must not be silently ignored: it suppresses nothing AND hygiene tells
+    the author why."""
+    src = ('import time\n'
+           'x = time.time()  # tpulint: disable=monotonic-clock\n')
+    r = run_snippet(tmp_path, "tpusched/c2.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    assert any("no justification" in f.message for f in r.findings)
+
+
+def test_unknown_rule_in_suppression_is_a_finding(tmp_path):
+    src = 'x = 1  # tpulint: disable=no-such-rule — because\n'
+    r = run_snippet(tmp_path, "tpusched/d.py", src,
+                    [SUPPRESSION_HYGIENE])
+    assert any("unknown rule" in f.message for f in r.findings)
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    src = 'x = 1  # tpulint: disable=monotonic-clock — nothing here\n'
+    r = run_snippet(tmp_path, "tpusched/e.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    assert any("matched no finding" in f.message for f in r.findings)
+
+
+def test_unused_check_skipped_for_inactive_rules(tmp_path):
+    """A single-rule wrapper run must not flag other rules' suppressions
+    as stale — only `make verify`'s full pass judges usedness."""
+    src = 'x = 1  # tpulint: disable=monotonic-clock — wall by design\n'
+    r = run_snippet(tmp_path, "tpusched/f.py", src,
+                    ["thread-hygiene", SUPPRESSION_HYGIENE])
+    assert r.findings == []
+
+
+# -- output + CLI --------------------------------------------------------------
+
+
+def test_json_schema(tmp_path):
+    src = ('import time\n'
+           'x = time.time()\n'
+           'y = time.time()  # tpulint: disable=monotonic-clock — fixture\n')
+    r = run_snippet(tmp_path, "tpusched/j.py", src,
+                    ["monotonic-clock", SUPPRESSION_HYGIENE])
+    doc = json.loads(r.to_json())
+    assert doc["version"] == 1
+    assert doc["files"] == 1
+    assert set(doc) == {"version", "files", "rules", "findings",
+                        "suppressed", "errors", "duration_s"}
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "path", "line", "col", "message"}
+    assert f["rule"] == "monotonic-clock" and f["line"] == 2
+    (s,) = doc["suppressed"]
+    assert s["reason"] == "fixture" and s["suppressed_at"] == 3
+
+
+def test_syntax_error_is_an_error_not_a_crash(tmp_path):
+    r = run_snippet(tmp_path, "tpusched/broken.py", "def f(:\n")
+    assert r.findings == []
+    assert len(r.errors) == 1 and "syntax error" in r.errors[0]
+    assert not r.clean
+
+
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.lint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "tpusched" / "x.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\nx = time.time()\n")
+    p = _cli("--root", str(tmp_path), "--json")
+    assert p.returncode == 1
+    doc = json.loads(p.stdout)
+    assert doc["findings"][0]["rule"] == "monotonic-clock"
+    bad.write_text("x = 1\n")
+    p = _cli("--root", str(tmp_path))
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = _cli("--rules", "no-such-rule")
+    assert p.returncode == 2
+    assert "unknown rule" in p.stderr
+
+
+def test_cli_list_rules():
+    p = _cli("--list-rules")
+    assert p.returncode == 0
+    for name in rule_names():
+        assert name in p.stdout
+
+
+def test_cli_changed_only_smoke():
+    p = _cli("--changed-only", "--json")
+    # clean or findings, but never a usage/internal error — and the
+    # output must parse
+    assert p.returncode in (0, 1), p.stderr
+    json.loads(p.stdout)
+
+
+# -- the meta-tests: the live tree, and the latency budget ---------------------
+
+
+def test_live_tree_is_lint_clean_and_fast():
+    """The acceptance criteria in one test: tpulint reports zero
+    unsuppressed findings on the REAL tree (including its own package —
+    the self-check), every suppression carries a reason (hygiene is part
+    of the run), and the full pass fits the < 15 s budget that lets it
+    gate tier1."""
+    runner = Runner(REPO_ROOT)
+    report = runner.run([REPO_ROOT / "tpusched"])
+    assert report.errors == [], report.errors
+    assert report.findings == [], "\n" + report.render_text()
+    assert report.files > 100            # the whole tree, not a subset
+    assert all(s.reason for _, s in report.suppressed)
+    assert report.duration_s < 15.0, (
+        f"tpulint full-tree pass took {report.duration_s:.1f}s — "
+        f"too slow to stay a tier1 prerequisite")
+
+
+def test_all_advertised_rules_are_registered():
+    expected = {"naked-api-calls", "node-health-filters", "metrics-names",
+                "structured-logging", "exception-taxonomy",
+                "shadow-isolation", "monotonic-clock", "thread-hygiene",
+                "lock-discipline", SUPPRESSION_HYGIENE}
+    assert expected == set(rule_names())

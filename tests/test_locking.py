@@ -1,0 +1,346 @@
+"""util/locking.py: the runtime half of lock discipline.
+
+Covers the ISSUE-6 acceptance points: lock-order cycle detection (a new
+edge closing a cycle in the acquisition-order graph is a potential
+deadlock), guarded-by runtime assertions (mutating declared state without
+the declared lock is recorded at the mutation site), thread confinement,
+Condition integration (wait/notify keeps the recorder's per-thread stack
+exact), and ZERO overhead when debug mode is off (structural: off-mode
+objects are the plain stdlib types — there is no wrapper to pay for).
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tpusched.util import locking
+
+
+@pytest.fixture(autouse=True)
+def _reset_locking():
+    prev = locking.set_debug(False)
+    locking.recorder().reset()
+    yield
+    locking.set_debug(prev)
+    locking.recorder().reset()
+
+
+def _run_in_thread(fn, name="t2"):
+    t = threading.Thread(target=fn, name=name, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+# -- zero overhead off ---------------------------------------------------------
+
+
+def test_guarded_lock_off_mode_is_plain_stdlib_lock():
+    lk = locking.GuardedLock("x")
+    assert type(lk).__name__ == "RLock"           # threading.RLock factory
+    nk = locking.GuardedLock("y", reentrant=False)
+    assert type(nk) is type(threading.Lock())
+
+
+def test_guarded_by_off_mode_leaves_instances_untouched():
+    @locking.guarded_by("_lock", "_d")
+    class Foo:
+        def __init__(self):
+            self._lock = locking.GuardedLock("Foo")
+            self._d = {}
+
+        def bad(self):
+            self._d["k"] = 1          # unguarded — but debug is off
+
+    f = Foo()
+    assert type(f) is Foo                        # no class swap
+    assert type(f._d) is dict                    # no container proxy
+    f.bad()
+    assert locking.recorder().violations() == []
+    # declaration metadata is still present for the static rule
+    assert Foo.__tpulint_guarded__ == {"_lock": ("_d",)}
+
+
+def test_annotated_production_classes_are_plain_when_off():
+    from tpusched.sched.cache import Cache
+    c = Cache()
+    assert type(c) is Cache
+    assert type(c._pods) is dict
+    assert type(c._lock).__name__ == "RLock"
+
+
+# -- lock-order recorder --------------------------------------------------------
+
+
+def test_cycle_detected_across_threads():
+    locking.set_debug(True)
+    a, b = locking.GuardedLock("A"), locking.GuardedLock("B")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+    _run_in_thread(inverted)
+    cycles = locking.recorder().cycles()
+    assert len(cycles) == 1
+    assert "B -> A -> B" in cycles[0] or "A -> B -> A" in cycles[0]
+
+
+def test_consistent_order_is_not_a_cycle():
+    locking.set_debug(True)
+    a, b = locking.GuardedLock("A"), locking.GuardedLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+
+    def same_order():
+        with a:
+            with b:
+                pass
+    _run_in_thread(same_order)
+    assert locking.recorder().cycles() == []
+    assert locking.recorder().report()["edges"] == ["A -> B"]
+
+
+def test_three_way_cycle_detected():
+    locking.set_debug(True)
+    a, b, c = (locking.GuardedLock(n) for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    cycles = locking.recorder().cycles()
+    assert len(cycles) == 1 and "C -> A" in cycles[0]
+
+
+def test_reentrant_reacquisition_is_not_an_edge():
+    locking.set_debug(True)
+    a = locking.GuardedLock("A")
+    with a:
+        with a:                      # same instance: reentrancy, not order
+            pass
+    assert locking.recorder().report()["edges"] == []
+    assert locking.recorder().cycles() == []
+
+
+def test_distinct_instances_of_one_name_are_an_ordering_fact():
+    locking.set_debug(True)
+    a1, a2 = locking.GuardedLock("sib"), locking.GuardedLock("sib")
+    with a1:
+        with a2:                     # AB/BA risk between siblings
+            pass
+    assert "sib -> sib" in locking.recorder().report()["edges"]
+    assert locking.recorder().cycles()     # self-edge = cycle
+
+
+def test_strict_mode_raises_on_cycle():
+    locking.set_debug(True)
+    rec = locking.recorder()
+    rec.strict = True
+    try:
+        a, b = locking.GuardedLock("A"), locking.GuardedLock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(locking.LockOrderError):
+                a.acquire()
+    finally:
+        rec.strict = False
+        # unwind whatever strict left half-acquired
+        locking.recorder().reset()
+
+
+def test_release_by_non_owner_recorded():
+    locking.set_debug(True)
+    a = locking.GuardedLock("A", reentrant=False)
+    a.acquire()
+
+    def release_foreign():
+        a.release()
+    _run_in_thread(release_foreign)
+    assert any("released by non-owner" in v
+               for v in locking.recorder().violations())
+
+
+def test_liveness_witness_counts_acquires():
+    locking.set_debug(True)
+    a = locking.GuardedLock("A")
+    with a:
+        pass
+    assert locking.recorder().report()["acquires"] >= 1
+
+
+# -- guarded-by runtime assertions ---------------------------------------------
+
+
+def _make_guarded():
+    @locking.guarded_by("_lock", "_d", "_items", "_tags", "_n")
+    class Box:
+        def __init__(self):
+            self._lock = locking.GuardedLock("Box")
+            self._d = {}
+            self._items = []
+            self._tags = set()
+            self._n = 0
+
+        def good(self):
+            with self._lock:
+                self._d["a"] = 1
+                self._items.append(2)
+                self._tags.add(3)
+                self._n = 4
+
+        def bad_item(self):
+            self._d["x"] = 1
+
+        def bad_rebind(self):
+            self._n = 9
+
+        def bad_swap(self):
+            self._d = {}
+
+    return Box
+
+
+def test_guarded_mutations_under_lock_are_clean():
+    locking.set_debug(True)
+    box = _make_guarded()()
+    box.good()
+    assert locking.recorder().violations() == []
+
+
+def test_unguarded_container_mutation_recorded():
+    locking.set_debug(True)
+    box = _make_guarded()()
+    box.bad_item()
+    v = locking.recorder().violations()
+    assert len(v) == 1 and "Box._d.__setitem__ without _lock" in v[0]
+
+
+def test_unguarded_scalar_rebind_recorded():
+    locking.set_debug(True)
+    box = _make_guarded()()
+    box.bad_rebind()
+    assert any("Box._n.rebind without _lock" in v
+               for v in locking.recorder().violations())
+
+
+def test_container_swap_is_checked_and_rewrapped():
+    locking.set_debug(True)
+    box = _make_guarded()()
+    box.bad_swap()                      # unguarded rebind of _d
+    assert any("_d.rebind" in v for v in locking.recorder().violations())
+    locking.recorder().reset()
+    box.bad_item()                      # the REPLACEMENT dict is guarded too
+    assert any("_d.__setitem__" in v
+               for v in locking.recorder().violations())
+
+
+def test_condition_guard_integration():
+    locking.set_debug(True)
+
+    @locking.guarded_by("_cv", "_q")
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition(locking.GuardedLock("Q"))
+            self._q = []
+
+        def put(self, x):
+            with self._cv:
+                self._q.append(x)
+                self._cv.notify_all()
+
+        def take(self):
+            with self._cv:
+                while not self._q:
+                    self._cv.wait(0.05)
+                return self._q.pop()
+
+    q = Q()
+    got = []
+
+    def consumer():
+        got.append(q.take())
+    t = threading.Thread(target=consumer, name="consumer", daemon=True)
+    t.start()
+    q.put(42)
+    t.join(timeout=10)
+    assert got == [42]
+    assert locking.recorder().violations() == []
+
+
+def test_production_cache_clean_under_debug():
+    """The annotated Cache, exercised through its public API in debug mode,
+    produces zero violations — the annotations match reality."""
+    locking.set_debug(True)
+    from tpusched.sched.cache import Cache
+    from tpusched.testing.wrappers import make_node, make_pod
+    c = Cache()
+    assert type(c._pods).__name__ == "_GuardedDict"
+    c.add_node(make_node("n1"))
+    p = make_pod("p1")
+    c.assume_pod(p, "n1")
+    c.snapshot()
+    c.finish_binding(p)
+    c.add_pod(p)
+    c.remove_pod(p)
+    c.remove_node(make_node("n1"))
+    assert locking.recorder().violations() == []
+
+
+# -- thread confinement ----------------------------------------------------------
+
+
+def test_thread_confined_flags_cross_thread_use():
+    locking.set_debug(True)
+
+    @locking.thread_confined
+    class Conf:
+        def __init__(self):
+            self.x = 0
+
+        def touch(self):
+            self.x += 1
+
+    c = Conf()
+    c.touch()
+    assert locking.recorder().violations() == []
+    _run_in_thread(c.touch, name="intruder")
+    v = locking.recorder().violations()
+    assert len(v) == 1 and "confined to its first caller" in v[0]
+
+
+def test_thread_confined_off_mode_untouched():
+    @locking.thread_confined
+    class Conf:
+        def __init__(self):
+            self.x = 0
+
+        def touch(self):
+            self.x += 1
+
+    c = Conf()
+    assert type(c) is Conf
+    _run_in_thread(c.touch, name="intruder")
+    assert locking.recorder().violations() == []
+
+
+def test_equivcache_is_confined_in_debug_mode():
+    locking.set_debug(True)
+    from tpusched.sched.equivcache import EquivalenceCache
+    ec = EquivalenceCache()
+    ec.get("k")                          # claims the owner thread
+    _run_in_thread(lambda: ec.get("k"), name="foreign-loop")
+    assert any("EquivalenceCache" in v
+               for v in locking.recorder().violations())

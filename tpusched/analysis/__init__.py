@@ -1,0 +1,33 @@
+"""tpulint: AST-based invariant analysis for the tpusched tree.
+
+The repo's correctness conventions — all API traffic through the retrying
+clientset, every Filter consults node health, Prometheus naming, structured
+logging, the retriable-vs-terminal exception taxonomy, shadow-scheduler
+telemetry isolation, monotonic clocks in duration math, thread and lock
+discipline — started life as grep lints and review habit.  This package
+turns them into real AST passes with one shared framework:
+
+- a rule registry (``analysis.core.RULES``; add a rule by subclassing
+  ``Rule`` and decorating with ``@register``),
+- per-line suppressions that MUST carry a written justification
+  (``# tpulint: disable=RULE — reason``), verified non-empty and actually
+  used by the ``suppression-hygiene`` meta-rule,
+- text and JSON output, stable exit codes (0 clean / 1 findings /
+  2 usage-or-internal error),
+- one interpreter pass over the tree: every rule shares each file's parsed
+  AST, so ``make verify`` costs one parse per file, not one grep per rule.
+
+Run it: ``python -m tpusched.cmd.lint`` (see that module for flags, incl.
+``--changed-only`` for the pre-commit loop).  The runtime complement —
+debug-mode instrumented locks that build the acquisition-order graph and
+assert guarded-state mutations hold their declared lock — lives in
+``tpusched/util/locking.py`` and is exercised by the chaos soaks.
+"""
+from __future__ import annotations
+
+from .core import (Finding, Report, Rule, Runner, RULES, register,
+                   rule_names)
+from . import rules as _rules  # noqa: F401 — importing registers the rules
+
+__all__ = ["Finding", "Report", "Rule", "Runner", "RULES", "register",
+           "rule_names"]

@@ -1,0 +1,420 @@
+"""tpulint framework: file contexts, the rule registry, suppressions,
+the runner, and output rendering.
+
+Design notes:
+
+- One ``FileContext`` per file, shared by every rule: the AST is parsed
+  once, suppression comments are extracted once, and rules are pure
+  functions of the context — this is what keeps the full-tree run inside
+  the tier-1 latency budget (< 15 s, enforced by tests/test_analysis.py).
+- Suppressions are per-line and per-rule, and the justification is part of
+  the syntax: ``# tpulint: disable=RULE[,RULE2] — reason``.  A suppression
+  with no reason, an unknown rule name, or one that never matches a finding
+  is itself a finding (``suppression-hygiene``) — the suppression table
+  must stay an honest ledger of known, justified exceptions.
+- Rules see every file; scoping (``plugins/`` only, ``testing/`` exempt,
+  ...) lives INSIDE each rule next to the invariant it checks, so reading
+  one rule file tells the whole story.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+# -- findings -----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    col: int = 0
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+# -- suppressions -------------------------------------------------------------
+
+# Directive shape: "tpulint: disable=<rule>[,<rule2>] <sep> <justification>"
+# in a comment.  The reason separator accepts an em dash, a double hyphen,
+# or a colon; the reason itself is mandatory (suppression-hygiene flags
+# empty ones).
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*(?:—|--|:)\s*(.*))?$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int                  # line the comment sits on
+    rules: Tuple[str, ...]
+    reason: str
+    target: int                # line the suppression applies to: its own
+    #                            for trailing comments, the next
+    #                            non-comment line for standalone ones (a
+    #                            justification may wrap over several
+    #                            comment lines)
+    used: bool = False
+
+    def matches(self, finding: Finding) -> bool:
+        return finding.rule in self.rules and finding.line == self.target
+
+
+class FileContext:
+    """Everything a rule needs about one file: source, AST, suppressions.
+
+    The AST is walked ONCE here into ``nodes`` (+ a parent map); rules
+    iterate that flat list instead of re-walking the tree — this is the
+    difference between the full-tree pass taking seconds and taking ten.
+    """
+
+    def __init__(self, root: Path, path: Path):
+        self.root = root
+        self.path = path
+        self.relpath = path.relative_to(root).as_posix()
+        self.source = path.read_text(encoding="utf-8")
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.source, filename=str(path))
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.nodes: List[ast.AST] = []
+        self._parent: Dict[int, ast.AST] = {}
+        if self.tree is not None:
+            stack = [self.tree]
+            while stack:
+                n = stack.pop()
+                self.nodes.append(n)
+                for c in ast.iter_child_nodes(n):
+                    self._parent[id(c)] = n
+                    stack.append(c)
+        self.suppressions: List[Suppression] = []
+        # lines strictly inside a multi-line string literal (docstrings):
+        # a '# tpulint:' there is documentation, not a directive
+        in_string: set = set()
+        for n in self.nodes:
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and getattr(n, "end_lineno", n.lineno) > n.lineno:
+                in_string.update(range(n.lineno + 1, n.end_lineno))
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m or i in in_string:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = (m.group(2) or "").strip()
+            target = i
+            if line.lstrip().startswith("#"):
+                # standalone comment: applies to the next non-comment
+                # line, so a long justification can wrap
+                target = i + 1
+                while target <= len(self.lines) \
+                        and self.lines[target - 1].lstrip().startswith("#"):
+                    target += 1
+            self.suppressions.append(Suppression(
+                line=i, rules=rules, reason=reason,
+                target=target))
+
+    # convenience for rules ---------------------------------------------------
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of a node (best effort)."""
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except (ValueError, TypeError, IndexError):
+            return ""
+
+    def in_dir(self, *prefixes: str) -> bool:
+        return any(self.relpath.startswith(p) for p in prefixes)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(id(node))
+
+    def enclosing_function(self, node: ast.AST
+                           ) -> Optional[ast.FunctionDef]:
+        """Innermost function/method containing ``node`` (None at module
+        level) — O(depth) via the parent map."""
+        cur = self._parent.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self._parent.get(id(cur))
+        return None
+
+    def has_identifier(self, idents: Sequence[str]) -> bool:
+        """Does the FILE mention any of these identifiers?"""
+        wanted = set(idents)
+        for n in self.nodes:
+            if isinstance(n, ast.Name) and n.id in wanted:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in wanted:
+                return True
+        return False
+
+    def import_aliases(self, module: str, attr: str) -> List[str]:
+        """Every dotted spelling under which ``module.attr`` is reachable
+        in this file: 'time.time' itself, 'alias.time' for
+        ``import time as alias``, and bare names for
+        ``from time import time [as t]``."""
+        out = [f"{module}.{attr}"]
+        for n in self.nodes:
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.name == module and a.asname:
+                        out.append(f"{a.asname}.{attr}")
+            elif isinstance(n, ast.ImportFrom) and n.module == module:
+                for a in n.names:
+                    if a.name == attr:
+                        out.append(a.asname or a.name)
+        return out
+
+
+# -- rule registry ------------------------------------------------------------
+
+
+class Rule:
+    """Base class: one invariant.  ``check`` runs per file; ``finish`` runs
+    once after every file (cross-file state like duplicate detection)."""
+
+    name = ""
+    summary = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        return ()
+
+    # helper used by most rules
+    def finding(self, ctx: FileContext, node: ast.AST, message: str
+                ) -> Finding:
+        return Finding(rule=self.name, path=ctx.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0), message=message)
+
+
+RULES: Dict[str, Type[Rule]] = {}
+
+SUPPRESSION_HYGIENE = "suppression-hygiene"
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.name, "rule classes must set a name"
+    assert cls.name not in RULES, f"duplicate rule {cls.name}"
+    RULES[cls.name] = cls
+    return cls
+
+
+def rule_names() -> List[str]:
+    return sorted(RULES) + [SUPPRESSION_HYGIENE]
+
+
+# -- AST helpers shared by rules ---------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def references_identifier(node: ast.AST, idents: Sequence[str]) -> bool:
+    """Does the subtree mention any of these identifiers (as a Name or an
+    attribute component)?"""
+    wanted = set(idents)
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in wanted:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in wanted:
+            return True
+    return False
+
+
+# -- runner -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, Suppression]]
+    files: int
+    rules: List[str]
+    duration_s: float
+    errors: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.findings]
+        out += [f"ERROR: {e}" for e in self.errors]
+        verdict = "clean" if self.clean else \
+            f"{len(self.findings)} finding(s)"
+        out.append(f"tpulint: {self.files} file(s), {len(self.rules)} "
+                   f"rule(s), {len(self.suppressed)} suppressed, "
+                   f"{self.duration_s:.2f}s — {verdict}")
+        return "\n".join(out)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 1,
+            "files": self.files,
+            "rules": self.rules,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "reason": s.reason,
+                 "suppressed_at": s.line}
+                for f, s in self.suppressed],
+            "errors": self.errors,
+            "duration_s": round(self.duration_s, 3),
+        }, indent=None, sort_keys=True)
+
+
+class Runner:
+    def __init__(self, root: Path, rule_names_filter:
+                 Optional[Sequence[str]] = None):
+        self.root = Path(root)
+        all_names = rule_names()
+        if rule_names_filter:
+            unknown = sorted(set(rule_names_filter) - set(all_names))
+            if unknown:
+                raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
+                                 f"(known: {', '.join(all_names)})")
+            self.active = list(dict.fromkeys(rule_names_filter))
+        else:
+            self.active = all_names
+        self._rules: List[Rule] = [RULES[n]() for n in self.active
+                                   if n in RULES]
+        self._hygiene = SUPPRESSION_HYGIENE in self.active
+
+    def run(self, paths: Sequence[Path]) -> Report:
+        t0 = time.monotonic()
+        files = self._collect(paths)
+        errors: List[str] = []
+        raw: List[Finding] = []
+        contexts: List[FileContext] = []
+        for path in files:
+            try:
+                ctx = FileContext(self.root, path)
+            except OSError as e:
+                errors.append(f"{path}: unreadable: {e}")
+                continue
+            if ctx.parse_error is not None:
+                errors.append(f"{ctx.relpath}: syntax error: "
+                              f"{ctx.parse_error}")
+                continue
+            contexts.append(ctx)
+            for rule in self._rules:
+                try:
+                    raw.extend(rule.check(ctx))
+                except Exception as e:
+                    errors.append(f"{ctx.relpath}: rule {rule.name} "
+                                  f"crashed: {e!r}")
+        for rule in self._rules:
+            try:
+                raw.extend(rule.finish())
+            except Exception as e:
+                errors.append(f"rule {rule.name} finish crashed: {e!r}")
+
+        findings, suppressed = self._apply_suppressions(contexts, raw)
+        if self._hygiene:
+            findings.extend(self._hygiene_findings(contexts))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return Report(findings=findings, suppressed=suppressed,
+                      files=len(contexts), rules=self.active,
+                      duration_s=time.monotonic() - t0, errors=errors)
+
+    # -- internals ------------------------------------------------------------
+
+    def _collect(self, paths: Sequence[Path]) -> List[Path]:
+        out: List[Path] = []
+        for p in paths:
+            p = Path(p)
+            if not p.is_absolute():
+                p = self.root / p
+            if p.is_dir():
+                out.extend(sorted(f for f in p.rglob("*.py")
+                                  if "__pycache__" not in f.parts))
+            elif p.suffix == ".py" and p.exists():
+                out.append(p)
+        # stable + deduped
+        seen, uniq = set(), []
+        for f in out:
+            if f not in seen:
+                seen.add(f)
+                uniq.append(f)
+        return uniq
+
+    def _apply_suppressions(self, contexts: List[FileContext],
+                            raw: List[Finding]):
+        by_path = {c.relpath: c for c in contexts}
+        findings: List[Finding] = []
+        suppressed: List[Tuple[Finding, Suppression]] = []
+        for f in raw:
+            ctx = by_path.get(f.path)
+            hit = None
+            if ctx is not None:
+                for s in ctx.suppressions:
+                    if s.matches(f):
+                        hit = s
+                        break
+            if hit is not None:
+                hit.used = True
+                suppressed.append((f, hit))
+            else:
+                findings.append(f)
+        return findings, suppressed
+
+    def _hygiene_findings(self, contexts: List[FileContext]
+                          ) -> List[Finding]:
+        known = set(rule_names())
+        active = set(self.active)
+        out: List[Finding] = []
+        for ctx in contexts:
+            for s in ctx.suppressions:
+                mk = lambda msg, s=s, ctx=ctx: Finding(  # noqa: E731
+                    rule=SUPPRESSION_HYGIENE, path=ctx.relpath,
+                    line=s.line, message=msg)
+                if not s.reason:
+                    out.append(mk(
+                        "suppression carries no justification — write "
+                        "tpulint: disable=<rule> — <why this is safe>"))
+                bad = sorted(set(s.rules) - known)
+                if bad:
+                    out.append(mk(f"suppression names unknown rule(s): "
+                                  f"{', '.join(bad)}"))
+                # 'unused' is only decidable for rules that actually ran
+                # this pass (the per-rule hack/ wrappers run subsets)
+                if (not s.used and s.reason
+                        and not bad and set(s.rules) <= active):
+                    out.append(mk(
+                        f"suppression for {','.join(s.rules)} matched no "
+                        f"finding — stale; delete it"))
+        return out

@@ -1,0 +1,14 @@
+"""tpulint rules.  Importing this package registers every rule with
+``analysis.core.RULES``; each module holds one invariant family so the
+scoping and the rationale live next to the check."""
+from __future__ import annotations
+
+from . import api_calls        # noqa: F401
+from . import clocks           # noqa: F401
+from . import exceptions       # noqa: F401
+from . import locks            # noqa: F401
+from . import logging_discipline  # noqa: F401
+from . import metrics_names    # noqa: F401
+from . import node_health      # noqa: F401
+from . import shadow           # noqa: F401
+from . import threads          # noqa: F401

@@ -1,0 +1,68 @@
+"""naked-api-calls: all scheduler-side API traffic must flow through the
+retrying Clientset (tpusched/apiserver/client.py).
+
+Its error taxonomy, capped-backoff retries, per-call deadlines and
+degraded-mode hooks are the resilience contract (PR 3); a direct store call
+silently opts out of all of it.  Two patterns fail:
+
+1. ``self._api.<anything>`` outside ``tpusched/apiserver/`` — the raw store
+   handle is an apiserver-package implementation detail;
+2. direct CRUD/bind/record_event on a bare ``self.api`` inside the
+   scheduling core (``sched/``, ``fwk/``, ``plugins/``) — the scheduler
+   owns a clientset precisely so its read/write/failure paths keep the
+   retry layer (reads go through informer caches, writes through the
+   client).
+
+``testing/`` is exempt: harness plumbing talks to the raw store on purpose
+(fixtures and watch monitors must not be attacked by the fault injector).
+Informer wiring (add_watch/peek/current_resource_version) and controller
+store bootstrap are out of scope — pattern 2 only names the mutating verbs.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, register
+
+_CORE_DIRS = ("tpusched/sched/", "tpusched/fwk/", "tpusched/plugins/")
+_VERBS = frozenset(("create", "get", "try_get", "list", "update", "patch",
+                    "delete", "bind", "record_event"))
+
+
+@register
+class NakedApiCalls(Rule):
+    name = "naked-api-calls"
+    summary = ("API calls must go through the retrying Clientset, not the "
+               "raw store handle")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        exempt_raw = ctx.in_dir("tpusched/apiserver/", "tpusched/testing/")
+        in_core = ctx.in_dir(*_CORE_DIRS)
+        if exempt_raw and not in_core:
+            return
+        call_funcs = {id(n.func) for n in ctx.nodes
+                      if isinstance(n, ast.Call)}
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = node.value
+            if not (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                continue
+            if base.attr == "_api" and not exempt_raw:
+                yield self.finding(
+                    ctx, node,
+                    f"self._api.{node.attr}: raw store access outside "
+                    f"tpusched/apiserver/ — route through the Clientset "
+                    f"(apiserver/client.py) or an informer lister")
+            elif (base.attr == "api" and in_core and node.attr in _VERBS
+                    and id(node) in call_funcs):
+                yield self.finding(
+                    ctx, node,
+                    f"self.api.{node.attr}(...): direct store verb in the "
+                    f"scheduling core bypasses the retry layer — use "
+                    f"self.clientset / handle.client_set()")

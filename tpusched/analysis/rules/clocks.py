@@ -1,0 +1,49 @@
+"""monotonic-clock: no ``time.time()`` in library code.
+
+Wall clocks jump — NTP slew, VM suspend, leap smearing — and a latency or
+duration computed from two ``time.time()`` reads can come out negative or
+wildly large, which then feeds SLO burn rates, backoff deadlines and trace
+spans.  The discipline:
+
+- durations/deadlines come from ``time.monotonic()``;
+- schedulable timestamps come from the component's injected ``clock=``
+  (every long-lived object here takes one — that is also what makes the
+  soaks and unit tests deterministic);
+- the few wall-time-by-design sites (heartbeat stamps compared against
+  other wall stamps, log line prefixes) carry a
+  ``# tpulint: disable=monotonic-clock — reason`` suppression, which is
+  exactly the documentation a reviewer needs.
+
+The rule flags ``time.time()`` CALLS only.  ``clock=time.time`` default
+parameters and ``default_factory=time.time`` are references, not calls —
+the injected-clock idiom stays free.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, dotted_name, register
+
+
+@register
+class MonotonicClock(Rule):
+    name = "monotonic-clock"
+    summary = ("no time.time() calls — monotonic for durations, injected "
+               "clock= for timestamps")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        # resolve `import time as _time` / `from time import time` so an
+        # alias cannot smuggle a wall-clock read past the rule
+        spellings = set(ctx.import_aliases("time", "time"))
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in spellings:
+                yield self.finding(
+                    ctx, node,
+                    "time.time() call: use time.monotonic() for "
+                    "durations/deadlines, the injected clock= for "
+                    "timestamps; wall-time-by-design sites must be "
+                    "suppressed with a justification")

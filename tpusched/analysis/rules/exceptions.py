@@ -1,0 +1,89 @@
+"""exception-taxonomy: no bare/overbroad ``except`` that swallows the
+retriable-vs-terminal distinction.
+
+``apiserver/errors.py`` is the repo's failure contract: ``Unavailable`` is
+worth retrying, ``Throttled``/``NotFound``/non-patch ``Conflict`` are
+terminal, and every resilience path (retry loops, gang rollback, degraded
+mode) branches on that distinction.  A ``except:`` or an
+``except Exception: pass`` upstream of those branches erases it — a
+terminal error silently becomes "nothing happened" and the failure paths
+PRs 3–4 built never fire.
+
+The rule:
+
+- bare ``except:`` is always a finding (it also catches KeyboardInterrupt/
+  SystemExit);
+- ``except Exception`` / ``except BaseException`` (alone or in a tuple) is
+  a finding UNLESS the handler visibly deals with what it caught: it binds
+  the exception and references it (logs/wraps/classifies it), or it
+  re-raises.  A broad catch that inspects or re-raises preserves the
+  taxonomy; one that silently drops the error does not.
+
+Deliberate best-effort swallows (telemetry refresh, teardown) must carry a
+``# tpulint: disable=exception-taxonomy — reason`` suppression; the reason
+is the documentation reviewers get.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, register
+
+_BROAD = frozenset(("Exception", "BaseException"))
+
+
+def _broad_names(type_node: ast.AST):
+    """The broad names matched by an except clause's type expression."""
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            out.append(n.id)
+    return out
+
+
+@register
+class ExceptionTaxonomy(Rule):
+    name = "exception-taxonomy"
+    summary = ("no bare/overbroad except that silently swallows the "
+               "retriable-vs-terminal error taxonomy")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare except: swallows every error (incl. "
+                    "KeyboardInterrupt) and the retriable-vs-terminal "
+                    "taxonomy with it — catch the specific "
+                    "apiserver.errors classes, or Exception with "
+                    "handling")
+                continue
+            broad = _broad_names(node.type)
+            if not broad:
+                continue
+            if self._handles(node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"except {broad[0]} silently drops the error — bind it "
+                f"and log/classify it (klog.error_s, "
+                f"apiserver.errors.is_retriable), re-raise, or suppress "
+                f"with a written justification")
+
+    @staticmethod
+    def _handles(handler: ast.ExceptHandler) -> bool:
+        for n in handler.body:
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if handler.name and isinstance(sub, ast.Name) \
+                        and sub.id == handler.name:
+                    return True
+        return False

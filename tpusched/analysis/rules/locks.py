@@ -1,0 +1,183 @@
+"""lock-discipline: guarded fields are only mutated with the declared lock
+held.
+
+``@util.locking.guarded_by("_lock", "_pods", ...)`` declares which lock
+guards which fields (sched/cache.py, sched/queue.py, trace/recorder.py,
+obs/diagnosis.py, apiserver/informers.py carry the annotations).  This
+rule reads the declaration and verifies, lexically, that every mutation of
+a guarded field happens either
+
+- inside a ``with self.<lock>:`` block (any enclosing depth within the
+  method), or
+- in a method whose name ends ``_locked`` — the repo's long-standing
+  caller-holds-the-lock convention (``_flush_locked``,
+  ``_trim_locked``, ...), or
+- in ``__init__`` (construction happens-before publication).
+
+Mutations recognized: attribute (re)binds and aug-assigns, subscript
+stores/deletes (``self._pods[k] = v``), and calls of known mutator methods
+on the field (``self._ring.append(...)``).  Reads are not checked — the
+runtime half (debug-mode ``GuardedLock`` + the chaos soaks) covers what
+lexical analysis cannot see, e.g. a ``*_locked`` helper actually called
+without the lock.
+
+The rule is lexical by design: it cannot prove a ``_locked`` method's
+callers hold the lock, and a mutation threaded through an alias
+(``d = self._pods; d[k] = v``) escapes it.  Those are exactly the cases
+the runtime recorder catches; the two halves are one check.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, FileContext, Rule, dotted_name, register
+
+_MUTATORS = frozenset((
+    "append", "appendleft", "add", "insert", "extend", "extendleft",
+    "pop", "popitem", "popleft", "remove", "discard", "clear", "update",
+    "setdefault", "move_to_end", "rotate", "sort", "reverse",
+    "difference_update", "intersection_update",
+    "symmetric_difference_update", "push", "set_fn"))
+
+
+def _guarded_decl(cls: ast.ClassDef) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """(lock_attr, fields) from a @guarded_by('...', ...) decorator, if
+    present with constant-string args."""
+    for dec in cls.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        if dotted_name(dec.func).rsplit(".", 1)[-1] != "guarded_by":
+            continue
+        consts = [a.value for a in dec.args
+                  if isinstance(a, ast.Constant)
+                  and isinstance(a.value, str)]
+        if len(consts) >= 2:
+            return consts[0], tuple(consts[1:])
+    return None
+
+
+def _is_self_attr(node: ast.AST, attr: str) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == attr
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _self_field(node: ast.AST, fields: Set[str]) -> Optional[str]:
+    """The guarded field name if ``node`` is ``self.<field>``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr in fields):
+        return node.attr
+    return None
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method tracking whether the current position is inside a
+    ``with self.<lock>`` block; records unguarded mutations."""
+
+    def __init__(self, lock_attr: str, fields: Set[str]):
+        self.lock_attr = lock_attr
+        self.fields = fields
+        self.depth = 0
+        self.hits: List[Tuple[ast.AST, str, str]] = []  # node, field, op
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_is_self_attr(item.context_expr, self.lock_attr)
+                     for item in node.items)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    # nested defs keep the lexical context: a closure defined inside
+    # `with self._lock:` does NOT inherit the guard at call time, but
+    # flagging it would false-positive the common "build callback under
+    # lock" idiom; the runtime recorder owns that case.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.generic_visit(node)
+
+    def _record(self, node: ast.AST, field: str, op: str) -> None:
+        if self.depth == 0:
+            self.hits.append((node, field, op))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._check_target(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._check_target(tgt)
+        self.generic_visit(node)
+
+    def _check_target(self, tgt: ast.AST) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._check_target(elt)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._check_target(tgt.value)
+            return
+        field = _self_field(tgt, self.fields)
+        if field is not None:
+            self._record(tgt, field, "rebind")
+            return
+        if isinstance(tgt, ast.Subscript):
+            field = _self_field(tgt.value, self.fields)
+            if field is not None:
+                self._record(tgt, field, "item-write")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            field = _self_field(node.func.value, self.fields)
+            if field is not None:
+                self._record(node, field, node.func.attr)
+        self.generic_visit(node)
+
+
+@register
+class LockDiscipline(Rule):
+    name = "lock-discipline"
+    summary = ("@guarded_by fields are mutated only under their declared "
+               "lock (or in *_locked methods)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        for cls in ctx.nodes:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            decl = _guarded_decl(cls)
+            if decl is None:
+                continue
+            lock_attr, fields = decl
+            fieldset = set(fields)
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__" \
+                        or method.name.endswith("_locked"):
+                    continue
+                chk = _MethodChecker(lock_attr, fieldset)
+                chk.visit(method)
+                for node, field, op in chk.hits:
+                    yield self.finding(
+                        ctx, node,
+                        f"{cls.name}.{method.name}: mutates guarded "
+                        f"field self.{field} ({op}) outside 'with "
+                        f"self.{lock_attr}:' — hold the declared lock, "
+                        f"or rename the method *_locked if the caller "
+                        f"holds it")

@@ -1,0 +1,36 @@
+"""structured-logging: library code logs through ``tpusched.util.klog``
+(``info_s``/``error_s``/``warning_s`` with key=value pairs), never bare
+``print()``.
+
+Exemptions mirror the original grep lint: ``tpusched/cmd/`` binaries print
+JSON/prose to stdout by contract, and ``tpusched/testing/`` is harness
+output.  Everything else that prints is invisible to the trace-id
+correlation klog provides (util/tracectx.py) and unparseable for fleet log
+pipelines.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, register
+
+
+@register
+class StructuredLogging(Rule):
+    name = "structured-logging"
+    summary = "no bare print() in library code — use tpusched.util.klog"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/") \
+                or ctx.in_dir("tpusched/cmd/", "tpusched/testing/"):
+            return
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                yield self.finding(
+                    ctx, node,
+                    "bare print() in library code — use tpusched.util.klog "
+                    "(info_s/warning_s/error_s) so the line carries the "
+                    "cycle trace id and stays machine-parseable")

@@ -1,0 +1,80 @@
+"""metrics-names: the Prometheus naming contract.
+
+Every metric registered in ``tpusched/`` must follow the conventions this
+repo standardizes on — a name that breaks them ships a dashboard/alert
+footgun that can never be renamed cheaply once scraped:
+
+1. ``tpusched_`` prefix (one namespace for the whole control plane);
+2. counters end ``_total``; histograms end ``_seconds`` (the unit suffix —
+   every histogram here is a duration); gauges never end ``_total``;
+3. no duplicate registrations of one name from multiple sites
+   (``gauge_func`` is exempt: per-scheduler re-registration under fresh
+   label sets is its designed lifecycle).
+
+Duplicate detection is cross-file state, so it reports from ``finish()``
+— which means a ``--changed-only`` run only sees duplicates within the
+changed subset; the full ``make verify`` pass is authoritative.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from ..core import Finding, FileContext, Rule, dotted_name, register
+
+_KINDS = frozenset(("counter", "counter_vec", "gauge", "gauge_vec",
+                    "gauge_func", "histogram", "histogram_vec"))
+
+
+@register
+class MetricsNames(Rule):
+    name = "metrics-names"
+    summary = "Prometheus naming contract for REGISTRY registrations"
+
+    def __init__(self):
+        self._seen: Dict[str, Tuple[str, str]] = {}   # name → (site, kind)
+        self._dups: List[Finding] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            kind = node.func.attr
+            if kind not in _KINDS \
+                    or not dotted_name(node.func).endswith("REGISTRY."
+                                                           + kind):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue     # dynamic names are the registry's problem
+            name = node.args[0].value
+            site = f"{ctx.relpath}:{node.lineno}"
+            if not name.startswith("tpusched_"):
+                yield self.finding(ctx, node,
+                                   f"{name}: missing tpusched_ prefix")
+            if kind in ("counter", "counter_vec") \
+                    and not name.endswith("_total"):
+                yield self.finding(ctx, node,
+                                   f"{name}: counters must end _total")
+            if kind in ("histogram", "histogram_vec") \
+                    and not name.endswith("_seconds"):
+                yield self.finding(ctx, node,
+                                   f"{name}: histograms must end _seconds "
+                                   f"(every histogram here is a duration)")
+            if kind in ("gauge", "gauge_vec", "gauge_func") \
+                    and name.endswith("_total"):
+                yield self.finding(ctx, node,
+                                   f"{name}: gauges must not end _total")
+            prev = self._seen.get(name)
+            if prev is not None and not (kind == "gauge_func"
+                                         and prev[1] == "gauge_func"):
+                self._dups.append(self.finding(
+                    ctx, node, f"{name}: duplicate registration "
+                               f"(also at {prev[0]})"))
+            self._seen.setdefault(name, (site, kind))
+
+    def finish(self) -> Iterable[Finding]:
+        return self._dups

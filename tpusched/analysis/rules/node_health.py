@@ -1,0 +1,74 @@
+"""node-health-filters: every placement-producing plugin path must consult
+node readiness.
+
+``api.core.node_health_error`` is the single shared judgement (unschedulable
+spec, Ready=False condition, not-ready taint) — a Filter that skips it can
+admit a NotReady node, and a gang retrying after a node failure would land
+right back on the dead hardware the lifecycle controller just drained
+(PR 4).  Two checks:
+
+1. every file under ``tpusched/plugins/`` that defines a ``filter(self, ...)``
+   extension point must reference ``node_health_error`` somewhere in the
+   file (directly or via a helper defined there — candidate-set builders
+   like TopologyMatch._occupancy are covered by the file-level check);
+2. the helper itself (``tpusched/api/core.py``) must keep covering all
+   three health facts — a refactor that drops one silently weakens every
+   filter at once.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, register
+
+_FACTS = ("spec.unschedulable", "node_ready", "TAINT_NODE_NOT_READY")
+
+
+@register
+class NodeHealthFilters(Rule):
+    name = "node-health-filters"
+    summary = ("every plugin Filter must consult api.core.node_health_error")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath == "tpusched/api/core.py":
+            yield from self._check_helper(ctx)
+            return
+        if not ctx.in_dir("tpusched/plugins/"):
+            return
+        filters = [
+            n for n in ctx.nodes
+            if isinstance(n, ast.FunctionDef) and n.name == "filter"
+            and n.args.args and n.args.args[0].arg == "self"]
+        if not filters:
+            return
+        if ctx.has_identifier(("node_health_error",)):
+            return
+        for fn in filters:
+            yield self.finding(
+                ctx, fn,
+                "defines a Filter but the file never consults "
+                "node_health_error — import it from tpusched.api.core and "
+                "reject unhealthy nodes before any placement arithmetic")
+
+    def _check_helper(self, ctx: FileContext) -> Iterable[Finding]:
+        helper = None
+        for n in ctx.nodes:
+            if isinstance(n, ast.FunctionDef) \
+                    and n.name == "node_health_error":
+                helper = n
+                break
+        if helper is None:
+            yield Finding(rule=self.name, path=ctx.relpath, line=1,
+                          message="api/core.py no longer defines "
+                                  "node_health_error — every Filter "
+                                  "depends on it")
+            return
+        body = ctx.segment(helper)
+        for fact in _FACTS:
+            if fact not in body:
+                yield self.finding(
+                    ctx, helper,
+                    f"node_health_error no longer checks {fact} — a "
+                    f"refactor that drops one health fact silently weakens "
+                    f"every filter at once")

@@ -1,0 +1,134 @@
+"""shadow-isolation: ``telemetry=False`` code paths must not reach the
+process-global observability surfaces.
+
+Shadow schedulers (what-if planner, defrag trials — ``tpusched/sim/``)
+schedule FORKED state holding the SAME pod/gang keys as the live fleet.
+A trial that touches a global surface corrupts production telemetry: a
+trial bind evicts the real pod's why-pending diagnosis, a trial's capacity
+collector publishes hypothetical pool gauges as real, its SLO observations
+dilute the production burn rate, and its cycle traces overwrite the live
+gang's stitched trace (ROADMAP PR 5 closed exactly these leaks).  The
+global surfaces are reached through a small, known accessor set, which is
+what makes the invariant statically checkable:
+
+    trace.default_recorder / install_recorder
+    obs.default_engine / install_engine / default_slo / install_slo
+    REGISTRY.gauge_func / REGISTRY.register_collector
+
+Checks:
+
+1. ``tpusched/sim/`` may not reference any accessor (or ``REGISTRY`` at
+   all), and every ``Scheduler(...)`` it constructs must pass
+   ``telemetry=False`` explicitly;
+2. everywhere else, a function that calls an accessor must visibly branch
+   on the shadow marker — reference ``telemetry``/``_telemetry`` (the
+   Scheduler flag) or ``publish``/``_publish`` (the SLO tracker's) in the
+   same function — and module-level accessor calls are findings outright.
+
+Exempt: the modules that DEFINE the accessors (``trace/__init__.py``,
+``obs/__init__.py``), ``cmd/`` (process entry points wire the live
+surfaces by contract), and ``testing/`` (harnesses swap recorders on
+purpose, restoring them in ``finally``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..core import (Finding, FileContext, Rule, dotted_name,
+                    references_identifier, register)
+
+_ACCESSORS = frozenset((
+    "default_recorder", "install_recorder", "default_engine",
+    "install_engine", "default_slo", "install_slo"))
+_REGISTRY_METHODS = frozenset(("gauge_func", "register_collector"))
+_GUARDS = ("telemetry", "_telemetry", "publish", "_publish")
+_DEFINING = frozenset(("tpusched/trace/__init__.py",
+                       "tpusched/obs/__init__.py"))
+
+
+def _accessor_call(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _ACCESSORS:
+        return name
+    if leaf in _REGISTRY_METHODS and "REGISTRY" in name.split("."):
+        return name
+    return None
+
+
+@register
+class ShadowIsolation(Rule):
+    name = "shadow-isolation"
+    summary = ("telemetry=False paths must not reach global metric "
+               "registries, the live flight recorder, or SLO trackers")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        if ctx.in_dir("tpusched/sim/"):
+            yield from self._check_shadow_module(ctx)
+            return
+        if ctx.relpath in _DEFINING \
+                or ctx.in_dir("tpusched/cmd/", "tpusched/testing/"):
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            name = _accessor_call(node)
+            if name is None:
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() at module level reaches the process-global "
+                    f"telemetry surface unconditionally — shadow "
+                    f"schedulers import this module too")
+            elif not references_identifier(fn, _GUARDS):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() without a telemetry/publish guard in "
+                    f"{fn.name}(): a telemetry=False shadow reaching this "
+                    f"path would corrupt live telemetry — branch on the "
+                    f"shadow marker or suppress with justification")
+
+    def _check_shadow_module(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.nodes:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    leaf = alias.name.rsplit(".", 1)[-1]
+                    if leaf in _ACCESSORS or leaf == "REGISTRY":
+                        yield self.finding(
+                            ctx, node,
+                            f"shadow module imports global telemetry "
+                            f"surface {leaf!r} — shadows get private "
+                            f"instances (Scheduler(telemetry=False) "
+                            f"builds them)")
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                ident = node.attr if isinstance(node, ast.Attribute) \
+                    else node.id
+                if ident in _ACCESSORS or ident == "REGISTRY":
+                    yield self.finding(
+                        ctx, node,
+                        f"shadow module references global telemetry "
+                        f"surface {ident!r} — shadows get private "
+                        f"instances (Scheduler(telemetry=False) builds "
+                        f"them)")
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee.rsplit(".", 1)[-1] == "Scheduler":
+                    tkw = [k for k in node.keywords
+                           if k.arg == "telemetry"]
+                    if not tkw or not (
+                            isinstance(tkw[0].value, ast.Constant)
+                            and tkw[0].value.value is False):
+                        yield self.finding(
+                            ctx, node,
+                            "Scheduler constructed in a shadow module "
+                            "must pass telemetry=False explicitly — the "
+                            "default wires the live flight recorder, "
+                            "diagnosis engine and SLO tracker")
+        return

@@ -1,0 +1,42 @@
+"""thread-hygiene: every ``threading.Thread`` is named and daemon-explicit.
+
+An unnamed thread is anonymous in stack dumps, ``/debug/threads``, the
+lock-order recorder's witness lines and py-spy profiles — exactly the
+places you look when a fleet wedges.  An implicit ``daemon`` flag is a
+shutdown-semantics decision made by omission: non-daemon threads pin the
+interpreter on exit (the _BindingPool docstring documents a real instance
+of that bite).  Both are one keyword each at construction time; the rule
+makes them mandatory.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, FileContext, Rule, dotted_name, register
+
+
+@register
+class ThreadHygiene(Rule):
+    name = "thread-hygiene"
+    summary = "threading.Thread(...) must pass name= and daemon= explicitly"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in ("threading.Thread", "Thread"):
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            missing = [k for k in ("name", "daemon") if k not in kwargs]
+            if missing:
+                yield self.finding(
+                    ctx, node,
+                    f"threading.Thread without explicit "
+                    f"{'/'.join(missing)}= — unnamed threads are "
+                    f"anonymous in stack dumps and lock-order reports, "
+                    f"and implicit daemon-ness decides shutdown "
+                    f"semantics by omission")

@@ -217,7 +217,11 @@ class _PodClient(_KindClient):
                 try:
                     pod = self._api.try_get(self._kind, binding.pod_key)
                     break
-                except Exception:  # noqa: BLE001 — healing is best-effort
+                except Exception as e:  # noqa: BLE001 — best-effort,
+                    # but the swallowed read failure must stay diagnosable
+                    klog.V(3).info_s("bind heal verification read failed",
+                                     pod=binding.pod_key, attempt=i,
+                                     error=str(e))
                     if i < 2:
                         time.sleep(0.01)
             if pod is not None and pod.spec.node_name == binding.node_name:
@@ -237,6 +241,9 @@ class _NodeClient(_KindClient):
         blips; the lifecycle controller's grace period absorbs the rest.
         Both Ready transitions (condition + taint) stay with the lifecycle
         controller, so exactly one component owns the node-health edges."""
+        # tpulint: disable=monotonic-clock — heartbeat stamps are
+        # wall-clock by contract: the lifecycle controller compares
+        # them against its own injected wall clock; tests pass now=
         ts = time.time() if now is None else now
 
         def mutate(node):

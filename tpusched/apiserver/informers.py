@@ -7,17 +7,19 @@ order; Listers read from that cache without touching the server.
 """
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from . import server as srv
+from ..util.locking import GuardedLock, guarded_by
 
 
+@guarded_by("_lock", "_cache", "_index_fns", "_indexes",
+            "_on_add", "_on_update", "_on_delete")
 class Informer:
     def __init__(self, api: srv.APIServer, kind: str):
         self._api = api
         self.kind = kind
-        self._lock = threading.RLock()
+        self._lock = GuardedLock("apiserver.Informer")
         self._cache: Dict[str, Any] = {}
         # client-go Indexers: index name → key_fn, and the materialized
         # index name → index value → {object key → object}
@@ -28,13 +30,13 @@ class Informer:
         self._on_delete: List[Callable[[Any], None]] = []
         api.add_watch(kind, self._handle, replay=True)
 
-    def _index_insert(self, obj) -> None:
+    def _index_insert_locked(self, obj) -> None:
         for name, fn in self._index_fns.items():
             val = fn(obj)
             if val is not None:
                 self._indexes[name].setdefault(val, {})[obj.meta.key] = obj
 
-    def _index_remove(self, obj) -> None:
+    def _index_remove_locked(self, obj) -> None:
         for name, fn in self._index_fns.items():
             val = fn(obj)
             if val is not None:
@@ -57,13 +59,13 @@ class Informer:
                 # analog; handlers must be delete-idempotent).
                 old = self._cache.pop(key, None)
                 if old is not None:
-                    self._index_remove(old)
+                    self._index_remove_locked(old)
             else:
                 old = self._cache.get(key)
                 if old is not None:
-                    self._index_remove(old)
+                    self._index_remove_locked(old)
                 self._cache[key] = ev.object
-                self._index_insert(ev.object)
+                self._index_insert_locked(ev.object)
         # per-handler isolation (client-go's processor gives each listener
         # its own delivery): one handler raising must not starve the other
         # handlers of the event, nor propagate into the watch source —
@@ -213,13 +215,13 @@ class Informer:
                 if key not in live:
                     deleted.append(old)
             for old, obj in updated:
-                self._index_remove(old)
+                self._index_remove_locked(old)
             for old in deleted:
-                self._index_remove(old)
+                self._index_remove_locked(old)
                 del self._cache[old.meta.key]
             for obj in added + [o for _, o in updated]:
                 self._cache[obj.meta.key] = obj
-                self._index_insert(obj)
+                self._index_insert_locked(obj)
         for obj in added:
             for h in list(self._on_add):
                 self._dispatch(h, obj)
@@ -240,12 +242,14 @@ class Informer:
             self._on_delete.clear()
 
 
+@guarded_by("_lock", "_informers", "_closed")
 class InformerFactory:
     """SharedInformerFactory analog: one shared Informer per kind."""
 
     def __init__(self, api: srv.APIServer):
         self._api = api
-        self._lock = threading.Lock()
+        self._lock = GuardedLock("apiserver.InformerFactory",
+                                 reentrant=False)
         self._informers: Dict[str, Informer] = {}
         self._closed = False
 

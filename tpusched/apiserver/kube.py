@@ -427,12 +427,13 @@ class KubeAPIServer:
                 self._consume_stream(kind, info, resp)
             except _HistoryGone:
                 need_relist = True
-            except Exception:
+            except Exception as e:  # noqa: BLE001
                 # disconnect → re-watch from last rv. Broad on purpose:
                 # http.client can surface ValueError/AttributeError when a
                 # socket dies mid-chunk, and the reflector must outlive any
-                # transport hiccup
-                pass
+                # transport hiccup — but the hiccup itself stays visible
+                klog.V(2).info_s("watch stream broke; re-watching",
+                                 kind=kind, error=str(e))
             finally:
                 with self._lock:
                     if conn in self._streams:

@@ -9,4 +9,7 @@
 - ``python -m tpusched.cmd.explain`` — why-pending diagnosis client: asks a
   running scheduler's ``/debug/explain`` endpoint why a pod or gang is
   still pending and what would unblock it.
+- ``python -m tpusched.cmd.lint`` — tpulint: the AST-based invariant
+  analysis suite (``tpusched/analysis``); gates ``make tier1`` and runs
+  inside ``make verify``.
 """

@@ -1,0 +1,131 @@
+"""tpulint CLI: AST-based invariant analysis over the tree.
+
+    python -m tpusched.cmd.lint                      # full tree (tpusched/)
+    python -m tpusched.cmd.lint tpusched/sched/      # a subtree
+    python -m tpusched.cmd.lint --rules metrics-names,thread-hygiene
+    python -m tpusched.cmd.lint --changed-only       # git-diff-driven
+    python -m tpusched.cmd.lint --json               # machine-readable
+    python -m tpusched.cmd.lint --list-rules
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage/internal error.  The
+``hack/verify-*.sh`` wrappers call this with ``--rules`` for the legacy
+per-lint Makefile targets; ``make verify`` runs the full suite in one
+interpreter pass; ``--changed-only`` keeps the pre-commit loop fast
+(note: cross-file checks like duplicate metric names only see the changed
+subset there — full runs are authoritative).
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+from ..analysis import RULES, Runner, rule_names
+from ..analysis.core import SUPPRESSION_HYGIENE
+
+DEFAULT_TARGET = "tpusched"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="AST-based invariant analysis for the tpusched tree")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/directories to lint (default: "
+                        f"{DEFAULT_TARGET}/)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: autodetected from this "
+                        "package's location)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (schema version 1)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only .py files changed vs git HEAD "
+                        "(staged, unstaged and untracked)")
+    return p
+
+
+def _detect_root() -> Path:
+    # tpusched/cmd/lint.py → repo root is two parents above the package
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def _changed_files(root: Path) -> list:
+    """Changed .py files vs HEAD: staged + unstaged + untracked."""
+    out = subprocess.run(
+        ["git", "-C", str(root), "status", "--porcelain"],
+        capture_output=True, text=True, check=True).stdout
+    files = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].split(" -> ")[-1].strip()
+        if path.startswith('"') and path.endswith('"'):
+            # git C-quotes paths with special/non-ASCII chars; undo the
+            # backslash escapes or the file silently escapes the lint
+            path = (path[1:-1].encode("latin-1", "backslashreplace")
+                    .decode("unicode_escape")
+                    .encode("latin-1").decode("utf-8", "replace"))
+        if path.endswith(".py"):
+            files.append(root / path)
+    return files
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for name in rule_names():
+            if name == SUPPRESSION_HYGIENE:
+                summary = ("suppressions must be justified, known and "
+                           "actually used")
+            else:
+                summary = RULES[name].summary
+            print(f"{name:22s} {summary}")
+        return 0
+    root = Path(args.root).resolve() if args.root else _detect_root()
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        runner = Runner(root, rules)
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    if args.changed_only:
+        try:
+            targets = _changed_files(root)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"tpulint: --changed-only needs git: {e}",
+                  file=sys.stderr)
+            return 2
+        scope = [Path(p) if Path(p).is_absolute() else root / p
+                 for p in (args.paths or [DEFAULT_TARGET])]
+        targets = [f for f in targets
+                   if any(str(f).startswith(str(s)) for s in scope)]
+        if not targets:
+            if not args.json:
+                print("tpulint: no changed .py files in scope — clean")
+            else:
+                print('{"version": 1, "files": 0, "findings": [], '
+                      '"errors": [], "rules": [], "suppressed": [], '
+                      '"duration_s": 0.0}')
+            return 0
+    else:
+        targets = args.paths or [DEFAULT_TARGET]
+    report = runner.run([Path(t) for t in targets])
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text())
+    if report.errors:
+        return 2
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

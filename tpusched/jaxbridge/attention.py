@@ -64,8 +64,18 @@ try:  # pallas import is deferred-safe: CPU-only environments still get ring/nai
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAVE_PALLAS = True
-except Exception:  # pragma: no cover
+    _PALLAS_IMPORT_ERROR = ""
+except Exception as e:  # pragma: no cover — any import failure means
+    # "no pallas here"; keep the reason so a missing kernel is diagnosable
+    # (pallas_unavailable_reason() below)
     _HAVE_PALLAS = False
+    _PALLAS_IMPORT_ERROR = str(e)
+
+
+def pallas_unavailable_reason() -> str:
+    """Why the flash kernel is unavailable ('' when it is) — surfaced so
+    a silently-slow deployment is diagnosable from a REPL or a probe."""
+    return _PALLAS_IMPORT_ERROR
 
 
 def _causal_mask(s, i, j, block_q, block_k):

@@ -194,6 +194,10 @@ class CapacityTelemetry:
         self._grid_cache: Dict[Tuple[str, int], Optional[HostGrid]] = {}
         self._pool_labels: set = set()
         self._ns_labels: set = set()
+        # tpulint: disable=shadow-isolation — CapacityTelemetry is
+        # only constructed for telemetry=True schedulers (the guard
+        # is the `if telemetry` at the single construction site in
+        # sched/scheduler.py); shadows never instantiate it
         REGISTRY.register_collector(self.collect)
 
     def close(self) -> None:

@@ -27,11 +27,11 @@ the happy path (bound) pays one dict pop.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import reasons as _reasons
+from ..util.locking import GuardedLock, guarded_by
 
 DEFAULT_MAX_PODS = 1024
 DEFAULT_MAX_BYTES = 1 << 20          # ~1 MiB of diagnosis state
@@ -83,6 +83,8 @@ class _PodDiag:
         return (self.last_plugin, self.last_reason)
 
 
+@guarded_by("_lock", "_pods", "_bytes", "_gangs", "_blockers",
+            "_fed", "_resolved", "_evicted")
 class DiagnosisEngine:
     def __init__(self, max_pods: int = DEFAULT_MAX_PODS,
                  max_bytes: int = DEFAULT_MAX_BYTES,
@@ -92,7 +94,8 @@ class DiagnosisEngine:
         self.max_bytes = max_bytes
         self.max_rows_per_pod = max_rows_per_pod
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = GuardedLock("obs.DiagnosisEngine",
+                                 reentrant=False)
         # pod key → diag, LRU order (OrderedDict, most-recent last)
         self._pods: "collections.OrderedDict[str, _PodDiag]" = \
             collections.OrderedDict()
@@ -161,7 +164,7 @@ class DiagnosisEngine:
                     row.nodes = nodes      # last attempt's count wins
                 if not row.example:
                     row.example = raw[:160]
-            self._reblock(old_key, d.blocking_key())
+            self._reblock_locked(old_key, d.blocking_key())
             self._trim_locked()
 
     def on_resolved(self, pod_key: str, outcome: str = "bound") -> None:
@@ -178,7 +181,7 @@ class DiagnosisEngine:
 
     def _drop_locked(self, pod_key: str, d: _PodDiag) -> None:
         self._bytes -= d.bytes
-        self._reblock(d.blocking_key(), None)
+        self._reblock_locked(d.blocking_key(), None)
         if d.gang:
             members = self._gangs.get(d.gang)
             if members is not None:
@@ -186,7 +189,7 @@ class DiagnosisEngine:
                 if not members:
                     del self._gangs[d.gang]
 
-    def _reblock(self, old: Optional[Tuple[str, str]],
+    def _reblock_locked(self, old: Optional[Tuple[str, str]],
                  new: Optional[Tuple[str, str]]) -> None:
         if old == new:
             return

@@ -18,7 +18,6 @@ Deliberate fixes over the reference (SURVEY §2 quirks):
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional, Tuple
 
 from ...api.core import Pod
@@ -209,7 +208,7 @@ class PodGroupManager:
         full, pg = self.get_pod_group(pod)
         if not full or pg is None:
             return
-        now = time.time()
+        now = self.handle.clock()
 
         def mutate(g: PodGroup):
             g.status.scheduled += 1

@@ -75,6 +75,10 @@ def exempted_from_preemption(victim: Pod, preemptor: Pod, pc_getter,
             scheduled_at = cond.last_transition_time
     if scheduled_at is None:
         return True  # not yet scheduled: tolerate (no effect on nominated pods)
+    # tpulint: disable=monotonic-clock — fallback for direct helper
+    # calls in tests; both production call sites pass the plugin
+    # handle's injected clock, and the compared field
+    # (PodCondition.last_transition_time) is wall-clock API data
     now = time.time() if now is None else now
     return scheduled_at + policy.toleration_seconds > now
 

@@ -684,7 +684,8 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         foreign_chips: Dict[str, int] = {}
         for v in victims:
             if exempted_from_preemption(v, preemptor,
-                                        lambda name: pcs.get(name)):
+                                        lambda name: pcs.get(name),
+                                        now=self.handle.clock()):
                 return None
             chips, chips_set, _, _ = pod_tpu_limits(v)
             if v.meta.namespace == pns or quotas.get(v.meta.namespace) is None:

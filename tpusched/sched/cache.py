@@ -10,7 +10,6 @@ restart-safe with annotations-as-truth (SURVEY §5 checkpoint/resume).
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -18,14 +17,17 @@ from ..api.core import Node, Pod
 from ..api.scheduling import POD_GROUP_LABEL
 from ..fwk.nodeinfo import NodeInfo, Snapshot
 from ..util import klog
+from ..util.locking import GuardedLock, guarded_by
 
 ASSUME_EXPIRATION_S = 30.0
 
 
+@guarded_by("_lock", "_infos", "_pods", "_assumed", "_snap_clones",
+            "_pg_assigned", "_mutation", "_snap_mutation", "_last_snapshot")
 class Cache:
     def __init__(self, clock=time.time):
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = GuardedLock("sched.Cache")
         self._infos: Dict[str, NodeInfo] = {}       # node name → live NodeInfo
         self._pods: Dict[str, Pod] = {}             # all known scheduled pods
         self._assumed: Dict[str, float] = {}        # pod key → bind deadline
@@ -45,7 +47,7 @@ class Cache:
         self._snap_mutation = -1
         self._last_snapshot: "Snapshot | None" = None
 
-    def _pg_adjust(self, pod: Pod, delta: int) -> None:
+    def _pg_adjust_locked(self, pod: Pod, delta: int) -> None:
         name = pod.meta.labels.get(POD_GROUP_LABEL)
         if not name or not pod.spec.node_name:
             return
@@ -64,14 +66,14 @@ class Cache:
             old = self._infos.get(node.name)
             if old is not None:
                 for p in old.pods:
-                    self._pg_adjust(p, -1)
+                    self._pg_adjust_locked(p, -1)
             info = NodeInfo(node)
             self._infos[node.name] = info
             # attach pods already known to live on this node
             for p in self._pods.values():
                 if p.spec.node_name == node.name:
                     info.add_pod(p)
-                    self._pg_adjust(p, +1)
+                    self._pg_adjust_locked(p, +1)
 
     def update_node(self, node: Node) -> None:
         with self._lock:
@@ -109,7 +111,7 @@ class Cache:
             affected = list(info.pods)
             deadline = self._clock() + ASSUME_EXPIRATION_S
             for p in affected:
-                self._pg_adjust(p, -1)
+                self._pg_adjust_locked(p, -1)
                 if self._assumed.get(p.key) == float("inf"):
                     self._assumed[p.key] = deadline
             return affected
@@ -117,18 +119,18 @@ class Cache:
 
     # -- pods -----------------------------------------------------------------
 
-    def _attach(self, pod: Pod) -> None:
+    def _attach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None:
             self._mutation += 1
             info.add_pod(pod)
-            self._pg_adjust(pod, +1)
+            self._pg_adjust_locked(pod, +1)
 
-    def _detach(self, pod: Pod) -> None:
+    def _detach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None and info.remove_pod(pod):
             self._mutation += 1
-            self._pg_adjust(pod, -1)
+            self._pg_adjust_locked(pod, -1)
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
         """Stores the caller's object by reference (upstream shares the pod
@@ -138,7 +140,7 @@ class Cache:
         with self._lock:
             pod.spec.node_name = node_name
             self._pods[pod.key] = pod
-            self._attach(pod)
+            self._attach_locked(pod)
             self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
 
     def finish_binding(self, pod: Pod) -> None:
@@ -152,7 +154,7 @@ class Cache:
                 self._assumed.pop(pod.key, None)
                 old = self._pods.pop(pod.key, None)
                 if old is not None:
-                    self._detach(old)
+                    self._detach_locked(old)
 
     def add_pod(self, pod: Pod) -> None:
         """Confirmed (bound) pod from the watch stream."""
@@ -160,9 +162,9 @@ class Cache:
             self._assumed.pop(pod.key, None)
             old = self._pods.get(pod.key)
             if old is not None:
-                self._detach(old)
+                self._detach_locked(old)
             self._pods[pod.key] = pod
-            self._attach(pod)
+            self._attach_locked(pod)
 
     def update_pod(self, pod: Pod) -> None:
         self.add_pod(pod)
@@ -172,13 +174,13 @@ class Cache:
             self._assumed.pop(pod.key, None)
             old = self._pods.pop(pod.key, None)
             if old is not None:
-                self._detach(old)
+                self._detach_locked(old)
 
     def is_assumed(self, pod_key: str) -> bool:
         with self._lock:
             return pod_key in self._assumed
 
-    def _cleanup_expired(self) -> None:
+    def _cleanup_expired_locked(self) -> None:
         now = self._clock()
         for key, deadline in list(self._assumed.items()):
             if deadline < now:
@@ -187,7 +189,7 @@ class Cache:
                 self._assumed.pop(key, None)
                 old = self._pods.pop(key, None)
                 if old is not None:
-                    self._detach(old)
+                    self._detach_locked(old)
 
     # -- snapshot -------------------------------------------------------------
 
@@ -198,7 +200,7 @@ class Cache:
         mutation path (preemption dry-runs, nominated-pod evaluation) clones
         first (sched/preemption.py:129-130, fwk/runtime.py:309-312)."""
         with self._lock:
-            self._cleanup_expired()
+            self._cleanup_expired_locked()
             if (self._mutation == self._snap_mutation
                     and self._last_snapshot is not None):
                 return self._last_snapshot

@@ -43,12 +43,16 @@ when quotas exist). The full path stays the oracle: nominated pods bypass
 the cache entirely, and the scheduler's differential mode re-runs the full
 path on every hit and asserts the identical placement.
 
-Single-threaded by design: only the scheduleOne loop touches it.
+Single-threaded by design: only the scheduleOne loop touches it —
+declared via @util.locking.thread_confined, asserted in debug mode
+(the chaos soaks run with it on).
 """
 from __future__ import annotations
 
 from collections import OrderedDict
 from typing import Dict, FrozenSet, Hashable, Optional, Tuple
+
+from ..util.locking import thread_confined
 
 # Entries are per equivalence class; a handful of gangs plus singleton
 # templates are live at once, so a small LRU bound is plenty.
@@ -74,6 +78,7 @@ class EquivEntry:
         self.feasible = feasible
 
 
+@thread_confined
 class EquivalenceCache:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._capacity = capacity

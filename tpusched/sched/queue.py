@@ -19,6 +19,7 @@ from ..api.core import Pod
 from ..api.scheduling import POD_GROUP_LABEL
 from ..fwk.interfaces import ClusterEvent
 from ..util import klog
+from ..util.locking import GuardedLock, guarded_by
 
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 10.0
@@ -145,6 +146,8 @@ class _Heap:
         return [e[2] for e in self._entries.values() if e[2] is not None]
 
 
+@guarded_by("_lock", "_active", "_backoff", "_backoff_keys",
+            "_unschedulable", "_pending_moves", "_last_gang", "_closed")
 class SchedulingQueue:
     def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
                  cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
@@ -158,7 +161,10 @@ class SchedulingQueue:
                                    is None else initial_backoff_s)
         self._max_backoff_s = (MAX_BACKOFF_S if max_backoff_s is None
                                else max_backoff_s)
-        self._lock = threading.Condition()
+        # the Condition's underlying lock is the named guard — debug
+        # mode instruments it, off mode is a plain RLock inside
+        self._lock = threading.Condition(
+            GuardedLock("sched.SchedulingQueue"))
         self._active = _Heap(less)
         self._backoff: List = []           # (expiry, seq, info)
         self._backoff_seq = itertools.count()
@@ -179,10 +185,10 @@ class SchedulingQueue:
         self._last_gang: Optional[tuple] = None
         self._closed = False
 
-    def _bk_add(self, key: str) -> None:
+    def _bk_add_locked(self, key: str) -> None:
         self._backoff_keys[key] = self._backoff_keys.get(key, 0) + 1
 
-    def _bk_del(self, key: str) -> None:
+    def _bk_del_locked(self, key: str) -> None:
         n = self._backoff_keys.get(key, 0) - 1
         if n <= 0:
             self._backoff_keys.pop(key, None)
@@ -238,7 +244,7 @@ class SchedulingQueue:
                                  if i is None or i.pod.key != key]
                 heapq.heapify(self._backoff)
                 for _ in range(before - len(self._backoff)):
-                    self._bk_del(key)
+                    self._bk_del_locked(key)
 
     def add_unschedulable_if_not_present(self, info: QueuedPodInfo) -> None:
         with self._lock:
@@ -276,7 +282,7 @@ class SchedulingQueue:
                 heapq.heappush(self._backoff,
                                (info.timestamp + delay,
                                 next(self._backoff_seq), info))
-                self._bk_add(key)
+                self._bk_add_locked(key)
                 self._lock.notify_all()
             return
         self.add_unschedulable_if_not_present(info)
@@ -302,7 +308,7 @@ class SchedulingQueue:
                     for i, (exp, seq, binfo) in enumerate(self._backoff):
                         if binfo is not None and binfo.pod.key == key:
                             self._backoff[i] = (exp, seq, None)
-                            self._bk_del(key)
+                            self._bk_del_locked(key)
                             info = binfo
                             break
                 if info is not None:
@@ -345,7 +351,7 @@ class SchedulingQueue:
             else:
                 heapq.heappush(self._backoff,
                                (expiry, next(self._backoff_seq), info))
-                self._bk_add(info.pod.key)
+                self._bk_add_locked(info.pod.key)
         if moved:
             self._lock.notify_all()
 
@@ -366,7 +372,7 @@ class SchedulingQueue:
         while self._backoff and self._backoff[0][0] <= now:
             _, _, info = heapq.heappop(self._backoff)
             if info is not None:
-                self._bk_del(info.pod.key)
+                self._bk_del_locked(info.pod.key)
                 self._active.push(info)
         for key, info in list(self._unschedulable.items()):
             if now - info.timestamp > UNSCHEDULABLE_Q_FLUSH_S:

@@ -18,7 +18,12 @@ fault schedule:
   C4  the equivalence-cache differential oracle stays exact throughout
       (zero placement mismatches while the chaos churns the cursor chain);
   C5  a total outage trips degraded mode (pop-dispatch pauses) and the
-      scheduler recovers on its own once the API heals.
+      scheduler recovers on its own once the API heals;
+  C7  lock discipline holds under chaos: both soaks run with the
+      debug-mode lock-order recorder on (util/locking.py) — zero
+      acquisition-order cycles (= no potential deadlock in any schedule
+      explored) and zero mutations of @guarded_by state without the
+      declared lock held, across cache/queue/recorder/diagnosis/informers.
 
 Shared by tests/test_chaos_soak.py and ``make chaos-smoke`` (which raises
 the cycle floor via CHAOS_SOAK_CYCLES). Failures reproduce from the
@@ -36,6 +41,8 @@ from ..apiserver import APIServer, FaultInjector, FaultRule
 from ..apiserver import server as srv
 from ..config.types import CoschedulingArgs
 from ..fwk import PluginProfile
+from ..util import klog
+from ..util import locking
 from ..util.metrics import (api_retries, api_retry_exhausted, bind_total,
                             equiv_cache_differential_mismatches,
                             gang_bind_rollbacks, schedule_attempts)
@@ -144,6 +151,11 @@ class ChaosReport:
     evictions: int = 0
     repairs: int = 0
     stuck_findings: int = 0
+    # C7: distinct lock-order edges the debug recorder observed (cycles or
+    # unguarded mutations land in `violations`); acquires is the liveness
+    # witness that instrumentation was actually on
+    lock_edges: int = 0
+    lock_acquires: int = 0
 
     @property
     def ok(self) -> bool:
@@ -159,6 +171,7 @@ class ChaosReport:
                 f"not_ready={self.not_ready_transitions} "
                 f"evictions={self.evictions} repairs={self.repairs} "
                 f"stuck={self.stuck_findings} "
+                f"lock_edges={self.lock_edges} "
                 f"violations={len(self.violations)}")
 
 
@@ -173,6 +186,10 @@ def run_chaos_soak(seed: int = 20260802, min_cycles: int = 5000,
     from .. import trace
 
     report = ChaosReport(seed=seed)
+    # C7: the runtime lock-order recorder watches the whole soak — every
+    # lock/guarded container constructed from here on is instrumented
+    lock_debug_prev = locking.set_debug(True)
+    locking.recorder().reset()
     api = APIServer()
     injector = FaultInjector(api, seed=seed)
     prev_recorder = trace.default_recorder()
@@ -251,12 +268,34 @@ def run_chaos_soak(seed: int = 20260802, min_cycles: int = 5000,
                 f"C4: {int(mismatches)} equivalence-cache differential "
                 "mismatches under chaos")
         report.violations.extend(monitor.violations)
+        _collect_lock_discipline(report)
     finally:
         injector.clear()
         monitor.close()
         cluster.stop()
         trace.install_recorder(prev_recorder)
+        locking.set_debug(lock_debug_prev)
     return report
+
+
+def _collect_lock_discipline(report: "ChaosReport") -> None:
+    """C7 at soak end: the debug-mode lock recorder observed the whole run
+    — zero acquisition-order cycles (= no potential deadlock anywhere in
+    the schedule the soak explored) and zero mutations of @guarded_by
+    state without the declared lock held."""
+    rep = locking.recorder().report()
+    for msg in rep["cycles"]:
+        report.violations.append(f"C7 potential deadlock: {msg}")
+    for msg in rep["guard_violations"]:
+        report.violations.append(f"C7 unguarded mutation: {msg}")
+    for msg in rep["order_violations"]:
+        report.violations.append(f"C7 lock misuse: {msg}")
+    report.lock_edges = len(rep["edges"])
+    report.lock_acquires = rep["acquires"]
+    if not rep["acquires"]:
+        report.violations.append(
+            "C7 vacuous: lock instrumentation observed zero acquires "
+            "— debug mode was not live for the soak")
 
 
 def _make_gang(api: APIServer, name: str, members: int,
@@ -362,6 +401,9 @@ class NodeHeartbeater:
 
     def _run(self) -> None:
         while not self._stop.wait(self._period):
+            # tpulint: disable=monotonic-clock — heartbeat stamps are
+            # wall-clock by contract (the lifecycle controller under
+            # test compares them against its own wall clock)
             now = time.time()
             with self._lock:
                 silenced = set(self._silenced)
@@ -390,6 +432,8 @@ def node_churn_profile() -> PluginProfile:
 
 def _make_hb_node(api: APIServer, name: str):
     node = make_node(name)
+    # tpulint: disable=monotonic-clock — wall stamp, same heartbeat
+    # contract as NodeHeartbeater._run above
     node.status.last_heartbeat_time = time.time()
     api.create(srv.NODES, node)
 
@@ -464,6 +508,8 @@ def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
 
     rng = random.Random(seed)
     report = ChaosReport(seed=seed)
+    lock_debug_prev = locking.set_debug(True)    # C7, as in run_chaos_soak
+    locking.recorder().reset()
     api = APIServer()
     injector = FaultInjector(api, seed=seed)
     prev_recorder = trace.default_recorder()
@@ -617,6 +663,7 @@ def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
                 f"C4: {int(mismatches)} equivalence-cache differential "
                 "mismatches under node churn")
         report.violations.extend(monitor.violations)
+        _collect_lock_discipline(report)
     finally:
         injector.clear()
         heartbeater.stop()
@@ -624,10 +671,13 @@ def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
         for c in (lifecycle, repair, pg_ctrl):
             try:
                 c.stop()
-            except Exception:   # noqa: BLE001 — teardown is best-effort
-                pass
+            except Exception as e:   # noqa: BLE001 — teardown is
+                # best-effort, but a hung stop() should still be visible
+                klog.warning_s("controller stop failed during chaos "
+                               "teardown", error=str(e))
         cluster.stop()
         trace.install_recorder(prev_recorder)
+        locking.set_debug(lock_debug_prev)
     return report
 
 

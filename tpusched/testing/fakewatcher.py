@@ -39,6 +39,9 @@ class FakeWatcher:
                 end = outer.window_end
                 doc = {"timestamp": 1,
                        "window": {"start": 0,
+                                  # tpulint: disable=monotonic-clock — the
+                                  # load-watcher API schema carries wall
+                                  # timestamps
                                   "end": time.time() if end is None else end},
                        "data": {"NodeMetricsMap": {
                            n: {"metrics": ms}
@@ -54,7 +57,7 @@ class FakeWatcher:
 
         self._server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
         threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
+                         name="fake-load-watcher", daemon=True).start()
         self.address = f"http://127.0.0.1:{self._server.server_port}"
 
     def set_cpu(self, **loads: float) -> None:

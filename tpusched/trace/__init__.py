@@ -168,6 +168,9 @@ def pin_event(kind: str, subject: str = "",
     if not _enabled:
         return
     rec = recorder if recorder is not None else _default
+    # tpulint: disable=monotonic-clock — anomaly timestamps share the
+    # wall-clock domain of first_enqueue/creation timestamps; this is
+    # an event time, never a duration operand on its own
     now = _time.time()
     tr = CycleTrace(trace_id=f"e{next(_event_seq):08x}", pod_key=subject,
                     pod_uid="", gang=None, attempt=0, scheduler="",

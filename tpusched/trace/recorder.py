@@ -13,11 +13,11 @@ from __future__ import annotations
 
 import collections
 import itertools
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from ..api.scheduling import POD_GROUP_LABEL
+from ..util.locking import GuardedLock, guarded_by
 from ..util.metrics import flight_recorder_anomalies
 from .gang import GangBook
 from .span import CycleTrace
@@ -28,6 +28,8 @@ DEFAULT_MAX_PINNED = 64
 DEFAULT_MAX_PINNED_BYTES = 1 << 20
 
 
+@guarded_by("_lock", "_ring", "_ring_bytes", "_pinned", "_pinned_bytes",
+            "_committed", "_evicted", "_health")
 class FlightRecorder:
     def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES,
                  max_bytes: int = DEFAULT_MAX_BYTES,
@@ -37,7 +39,8 @@ class FlightRecorder:
         self.max_bytes = max_bytes
         self.max_pinned = max_pinned
         self.max_pinned_bytes = max_pinned_bytes
-        self._lock = threading.Lock()
+        self._lock = GuardedLock("trace.FlightRecorder",
+                                 reentrant=False)
         # ring entries: [trace, cached_byte_estimate]
         self._ring: "collections.deque[list]" = collections.deque()
         self._ring_bytes = 0
@@ -94,6 +97,9 @@ class FlightRecorder:
             self._committed += 1
             self._trim_locked()
         if final:
+            # tpulint: disable=monotonic-clock — fallback only: the
+            # scheduler passes its injected clock; the gang book's
+            # timestamps share the queue's wall-clock domain
             self.gangs.on_cycle(tr, final_now=(time.time() if now is None
                                                else now))
             if tr.anomalies:
@@ -111,6 +117,8 @@ class FlightRecorder:
                 self._ring_bytes += est - entry[1]
                 entry[1] = est
                 self._trim_locked()
+        # tpulint: disable=monotonic-clock — same wall-domain fallback
+        # as commit(): callers on latency paths pass now= explicitly
         self.gangs.on_final(tr, time.time() if now is None else now)
         if tr.anomalies:
             self.pin(tr)

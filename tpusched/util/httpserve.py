@@ -101,6 +101,9 @@ class MetricsServer:
                 — same contract as the flight-recorder routes."""
                 from .. import obs
                 qs = urllib.parse.parse_qs(query)
+                # tpulint: disable=shadow-isolation — the debug server
+                # serves the LIVE process surfaces by contract; shadow
+                # schedulers never mount an HTTP server
                 engine = obs.default_engine()
                 pod = qs.get("pod", [None])[0]
                 gang = qs.get("gang", [None])[0]
@@ -125,6 +128,8 @@ class MetricsServer:
                         out["members_seen_by_tracer"] = gd["members_seen"]
                     return 200, out
                 dump = engine.dump()
+                # tpulint: disable=shadow-isolation — live surface,
+                # same contract as default_engine above
                 dump["slo"] = obs.default_slo().summary()
                 return 200, dump
 
@@ -174,6 +179,8 @@ class MetricsServer:
         if self._recorder is not None:
             return self._recorder
         from .. import trace
+        # tpulint: disable=shadow-isolation — live debug surface;
+        # shadows get private recorders injected at construction
         return trace.default_recorder()
 
     @property

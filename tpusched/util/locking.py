@@ -1,0 +1,509 @@
+"""Lock discipline: declared guards + a debug-mode lock-order recorder.
+
+The sharded scheduler core (ROADMAP item 1) moves the hot path from
+one-cycle-at-a-time to concurrent per-pool dispatch over shared
+cache/queue/telemetry state.  That regime needs the locking conventions this
+repo has kept by habit — "mutate ``_pods`` only under ``_lock``", "never
+acquire the queue lock while holding the cache lock" — turned into declared,
+machine-checked invariants.  Two halves:
+
+static
+    ``@guarded_by("_lock", "_pods", ...)`` declares which lock guards which
+    fields.  tpulint's ``lock-discipline`` rule (tpusched/analysis) reads the
+    declaration and verifies every mutation of a guarded field happens inside
+    ``with self._lock:`` or in a ``*_locked``-suffixed method (the repo's
+    caller-holds-the-lock convention).
+
+runtime (debug mode only)
+    ``GuardedLock`` returns an *instrumented* lock that feeds a global
+    acquisition-order recorder: a per-thread stack of held locks builds the
+    order graph (edges by lock NAME, so every Cache instance contributes to
+    one "sched.Cache" node), and a new edge that closes a cycle — a potential
+    deadlock — is recorded (and optionally raised) the moment it is first
+    observed, long before any schedule actually interleaves into the hang.
+    ``@guarded_by`` additionally wraps the declared container fields in
+    mutation-asserting proxies and installs a ``__setattr__`` checker, so an
+    unguarded mutation of declared state is caught at the mutation site.
+    The chaos soaks (testing/chaos.py) run with this enabled and assert zero
+    cycles and zero unguarded mutations across their 5k-cycle runs.
+
+Zero overhead when debug mode is off: ``GuardedLock(...)`` returns a plain
+``threading.RLock``/``Lock`` and ``@guarded_by`` only records metadata on the
+class — no wrapper, no per-operation check, no ``__setattr__`` override
+(instances get their class swapped to an instrumented subclass only when
+constructed in debug mode).  Enable with ``set_debug(True)`` (or
+``TPUSCHED_LOCK_DEBUG=1``) *before* constructing the objects to observe:
+instrumentation is decided at construction time, which is what keeps the
+off path free.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = ["GuardedLock", "guarded_by", "thread_confined", "set_debug",
+           "debug_enabled", "recorder", "LockOrderError",
+           "GuardedStateError", "LockOrderRecorder"]
+
+_DEBUG = os.environ.get("TPUSCHED_LOCK_DEBUG", "") not in ("", "0", "false")
+_MAX_VIOLATIONS = 256          # bounded: a hot unguarded site must not OOM
+
+
+def set_debug(on: bool) -> bool:
+    """Toggle debug-mode instrumentation for locks/classes constructed
+    AFTER this call.  Returns the previous value (restore in finally)."""
+    global _DEBUG
+    prev, _DEBUG = _DEBUG, bool(on)
+    return prev
+
+
+def debug_enabled() -> bool:
+    return _DEBUG
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition closed a cycle in the acquisition-order graph."""
+
+
+class GuardedStateError(RuntimeError):
+    """Guarded state was mutated without its declared lock held."""
+
+
+class LockOrderRecorder:
+    """Global acquisition-order graph + guarded-mutation violation log.
+
+    Nodes are lock NAMES (``sched.Cache``), not instances: the invariant
+    worth enforcing is the class-level order policy — if thread A ever
+    acquires Cache→Queue and thread B Queue→Cache, the pair can deadlock no
+    matter which instances are involved.  Reentrant reacquisition of the
+    SAME instance is not an edge; nesting two *distinct* instances of one
+    name is a real self-edge (classic AB/BA risk between siblings) and is
+    reported as a cycle.
+
+    Its own synchronization uses a raw ``threading.Lock`` on purpose — the
+    recorder must never feed itself.
+    """
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # approximate (unsynchronized increment — a liveness witness for
+        # "instrumentation was actually on", not an exact statistic)
+        self.acquires = 0
+        # name -> set of names acquired while holding it, with the first
+        # witness (thread, holder name chain) kept for the report
+        self._edges: Dict[str, Set[str]] = {}
+        self._edge_witness: Dict[Tuple[str, str], str] = {}
+        self._cycles: List[str] = []
+        self._guard_violations: List[str] = []
+        self._order_violations: List[str] = []
+
+    # -- per-thread held stack -----------------------------------------------
+
+    def _stack(self) -> List[Tuple[str, int]]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquire(self, name: str, ident: int) -> None:
+        self.acquires += 1
+        stack = self._stack()
+        if stack:
+            top_name, top_ident = stack[-1]
+            if top_ident != ident:      # reentrancy on the same instance is
+                self._add_edge(top_name, name)   # not an ordering fact
+        stack.append((name, ident))
+
+    def on_release(self, name: str, ident: int) -> None:
+        stack = self._stack()
+        # released out of LIFO order is legal (lock handoff patterns);
+        # remove by identity, newest first
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == ident:
+                del stack[i]
+                return
+
+    # -- graph ---------------------------------------------------------------
+
+    def _add_edge(self, frm: str, to: str) -> None:
+        with self._mu:
+            outs = self._edges.setdefault(frm, set())
+            if to in outs:
+                return                 # known edge: nothing new to check
+            outs.add(to)
+            t = threading.current_thread().name
+            self._edge_witness[(frm, to)] = t
+            path = self._find_path(to, frm)
+            if path is None:
+                return
+            cyc = " -> ".join([frm] + path)
+            msg = (f"lock-order cycle: {cyc} (closing edge {frm} -> {to} "
+                   f"first seen on thread {t!r})")
+            if len(self._cycles) < _MAX_VIOLATIONS:
+                self._cycles.append(msg)
+        if self.strict:
+            raise LockOrderError(msg)
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS src ↝ dst over the edge set; caller holds ``_mu``."""
+        seen = {src}
+        stack: List[Tuple[str, List[str]]] = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- guarded-state assertions ---------------------------------------------
+
+    def guard_violation(self, msg: str) -> None:
+        with self._mu:
+            if len(self._guard_violations) < _MAX_VIOLATIONS:
+                self._guard_violations.append(msg)
+        if self.strict:
+            raise GuardedStateError(msg)
+
+    def order_violation(self, msg: str) -> None:
+        with self._mu:
+            if len(self._order_violations) < _MAX_VIOLATIONS:
+                self._order_violations.append(msg)
+        if self.strict:
+            raise GuardedStateError(msg)
+
+    # -- report ---------------------------------------------------------------
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def cycles(self) -> List[str]:
+        with self._mu:
+            return list(self._cycles)
+
+    def violations(self) -> List[str]:
+        """All recorded discipline violations (cycles + unguarded
+        mutations + thread-confinement breaks)."""
+        with self._mu:
+            return (list(self._cycles) + list(self._guard_violations)
+                    + list(self._order_violations))
+
+    def reset(self) -> None:
+        self.acquires = 0
+        with self._mu:
+            self._edges.clear()
+            self._edge_witness.clear()
+            self._cycles.clear()
+            self._guard_violations.clear()
+            self._order_violations.clear()
+
+    def report(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "acquires": self.acquires,
+                "edges": sorted(f"{a} -> {b}"
+                                for a, outs in self._edges.items()
+                                for b in outs),
+                "cycles": list(self._cycles),
+                "guard_violations": list(self._guard_violations),
+                "order_violations": list(self._order_violations),
+            }
+
+
+_RECORDER = LockOrderRecorder()
+
+
+def recorder() -> LockOrderRecorder:
+    return _RECORDER
+
+
+class _InstrumentedLock:
+    """Debug-mode lock: a (R)Lock that reports to the order recorder and
+    knows its owner, so guarded-state proxies can ask ``is_held()``.
+    Implements the private Condition protocol (``_is_owned`` /
+    ``_release_save`` / ``_acquire_restore``) so
+    ``threading.Condition(GuardedLock(...))`` keeps the recorder's
+    per-thread stack exact across ``wait()``."""
+
+    __slots__ = ("name", "_inner", "_reentrant", "_owner", "_count", "_rec")
+
+    def __init__(self, name: str, reentrant: bool,
+                 rec: Optional[LockOrderRecorder] = None):
+        self.name = name
+        self._reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._owner: Optional[int] = None
+        self._count = 0
+        self._rec = rec if rec is not None else _RECORDER
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            self._inner.acquire()
+            self._count += 1
+            return True                 # reentrant: no recorder event
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._count = 1
+            self._rec.on_acquire(self.name, id(self))
+        return got
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            self._rec.order_violation(
+                f"{self.name}: released by non-owner thread "
+                f"{threading.current_thread().name!r}")
+        self._count -= 1
+        if self._count <= 0:
+            self._owner = None
+            self._rec.on_release(self.name, id(self))
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def is_held(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # Condition protocol ------------------------------------------------------
+
+    def _is_owned(self) -> bool:
+        return self.is_held()
+
+    def _release_save(self):
+        """Full release for Condition.wait: unwind reentrancy in one step."""
+        count, self._count = self._count, 0
+        self._owner = None
+        self._rec.on_release(self.name, id(self))
+        for _ in range(count - 1):
+            self._inner.release()
+        self._inner.release()
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        for _ in range(count):
+            self._inner.acquire()
+        self._owner = threading.get_ident()
+        self._count = count
+        self._rec.on_acquire(self.name, id(self))
+
+
+def GuardedLock(name: str, reentrant: bool = True):  # noqa: N802 — ctor-like
+    """A named lock participating in lock discipline.  Debug mode off (the
+    default): a plain ``threading.RLock``/``Lock`` — zero overhead, byte-
+    identical hot path.  Debug mode on: an instrumented lock feeding the
+    acquisition-order recorder and answering ownership queries for the
+    guarded-state proxies."""
+    if _DEBUG:
+        return _InstrumentedLock(name, reentrant)
+    return threading.RLock() if reentrant else threading.Lock()
+
+
+# =============================================================================
+# Guarded-state runtime assertions (@guarded_by debug half)
+# =============================================================================
+
+
+def _lock_is_held(lock) -> bool:
+    """Best-effort 'does the CURRENT thread hold this?' across the lock
+    flavors a guard can name: instrumented, RLock, Condition (recurse on
+    its inner lock), plain Lock (ownerless — ``locked()`` is the best
+    available witness)."""
+    inner = getattr(lock, "_lock", lock)     # Condition → its lock
+    held = getattr(inner, "is_held", None)
+    if held is not None:
+        return held()
+    owned = getattr(inner, "_is_owned", None)
+    if owned is not None:
+        return owned()
+    return inner.locked()
+
+
+def _check(owner_ref, field: str, op: str) -> None:
+    owner, lock_attr = owner_ref
+    lock = getattr(owner, lock_attr, None)
+    if lock is None or _lock_is_held(lock):
+        return
+    _RECORDER.guard_violation(
+        f"{type(owner).__name__}.{field}.{op} without {lock_attr} held "
+        f"(thread {threading.current_thread().name!r})")
+
+
+def _make_guarded_container(value, owner_ref, field: str):
+    """Wrap a container value in a subclass that asserts the guard on every
+    mutator.  Unknown types pass through unwrapped (scalar rebinds are
+    caught by the instrumented ``__setattr__`` instead).
+
+    Known limit: wrapping COPIES the container (``cls(value)``), so code
+    that keeps an alias to the object it assigned
+    (``d = {}; self._pods = d; d[k] = v``) mutates the orphaned original
+    — unobserved by the proxy AND invisible to the instance.  None of the
+    annotated classes alias their guarded fields (the static
+    lock-discipline rule has no alias escape in-tree either); if sharded
+    dispatch ever introduces the pattern, mutate through ``self.<field>``
+    or the guard is fiction."""
+    import collections
+
+    def mutators(base, names):
+        ns = {}
+        for n in names:
+            orig = getattr(base, n, None)
+            if orig is None:
+                continue
+
+            def wrapped(self, *a, __orig=orig, __n=n, **kw):
+                _check(owner_ref, field, __n)
+                return __orig(self, *a, **kw)
+            ns[n] = wrapped
+        return ns
+
+    if isinstance(value, collections.OrderedDict):
+        cls = type("_GuardedODict", (collections.OrderedDict,), mutators(
+            collections.OrderedDict,
+            ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+             "update", "setdefault", "move_to_end")))
+        return cls(value)
+    if isinstance(value, dict):
+        cls = type("_GuardedDict", (dict,), mutators(
+            dict, ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+                   "update", "setdefault")))
+        return cls(value)
+    if isinstance(value, collections.deque):
+        cls = type("_GuardedDeque", (collections.deque,), mutators(
+            collections.deque,
+            ("append", "appendleft", "pop", "popleft", "extend",
+             "extendleft", "clear", "remove", "rotate", "insert")))
+        out = cls(value, value.maxlen)
+        return out
+    if isinstance(value, set):
+        cls = type("_GuardedSet", (set,), mutators(
+            set, ("add", "discard", "remove", "pop", "clear", "update",
+                  "difference_update", "intersection_update",
+                  "symmetric_difference_update")))
+        return cls(value)
+    if isinstance(value, list):
+        cls = type("_GuardedList", (list,), mutators(
+            list, ("append", "extend", "insert", "pop", "remove", "clear",
+                   "sort", "reverse", "__setitem__", "__delitem__")))
+        return cls(value)
+    return value
+
+
+def guarded_by(lock_attr: str, *fields: str):
+    """Class decorator declaring that ``lock_attr`` guards ``fields``.
+
+    Always: records the declaration as ``cls.__tpulint_guarded__`` — the
+    static ``lock-discipline`` rule reads it, and so can humans.
+
+    Debug mode (and only then — decided per INSTANCE at construction):
+    after ``__init__`` returns, the instance's declared container fields
+    are wrapped in mutation-asserting proxies and its class is swapped to
+    a subclass whose ``__setattr__`` asserts the guard on rebinds of the
+    declared fields (re-wrapping new container values so the check
+    survives ``self._pending_moves = {}``-style swaps)."""
+    fields_t = tuple(fields)
+
+    def deco(cls):
+        declared = dict(getattr(cls, "__tpulint_guarded__", ()) or {})
+        declared[lock_attr] = tuple(declared.get(lock_attr, ())) + fields_t
+        cls.__tpulint_guarded__ = declared
+        orig_init = cls.__init__
+
+        def init(self, *a, **kw):
+            orig_init(self, *a, **kw)
+            # exact-type only: a subclass's __init__ may still be running
+            # after this super() call returns, and its construction-time
+            # writes must not be judged (construction happens-before
+            # publication) — subclasses opt in with their own decorator
+            if not _DEBUG or type(self) is not cls:
+                return
+            _instrument_instance(self, cls)
+
+        init.__wrapped__ = orig_init
+        init.__name__ = "__init__"
+        cls.__init__ = init
+        return cls
+    return deco
+
+
+def _instrument_instance(self, cls) -> None:
+    declared = cls.__tpulint_guarded__
+    for lock_attr, fs in declared.items():
+        ref = (self, lock_attr)
+        for f in fs:
+            if f in self.__dict__:
+                object.__setattr__(
+                    self, f,
+                    _make_guarded_container(self.__dict__[f], ref, f))
+    field_to_lock = {f: la for la, fs in declared.items() for f in fs}
+
+    def setattr_(obj, name, value, __map=field_to_lock):
+        la = __map.get(name)
+        if la is not None:
+            _check((obj, la), name, "rebind")
+            value = _make_guarded_container(value, (obj, la), name)
+        object.__setattr__(obj, name, value)
+
+    dbg = type(cls.__name__, (type(self),),
+               {"__setattr__": setattr_, "__tpulint_debug__": True,
+                "__module__": cls.__module__})
+    object.__setattr__(self, "__class__", dbg)
+
+
+def thread_confined(cls):
+    """Class decorator for single-threaded-by-contract state (the
+    equivalence cache: only the scheduleOne loop may touch it).  Debug
+    mode (decided per instance at construction, like ``guarded_by``) swaps
+    the instance's class for a subclass whose public methods record the
+    first calling thread and flag any call from another; off: instances
+    are untouched — zero overhead."""
+    cls.__tpulint_confined__ = True
+    orig_init = cls.__init__
+
+    def confine(name, fn):
+        def wrapped(self, *a, **kw):
+            me = threading.get_ident()
+            owner = self.__dict__.get("_tpulint_owner_thread")
+            if owner is None:
+                object.__setattr__(self, "_tpulint_owner_thread", me)
+            elif owner != me:
+                _RECORDER.guard_violation(
+                    f"{cls.__name__}.{name} called from thread "
+                    f"{threading.current_thread().name!r} but the instance "
+                    f"is confined to its first caller")
+            return fn(self, *a, **kw)
+        wrapped.__name__ = name
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        if not _DEBUG or type(self) is not cls:   # exact-type only, as in
+            return                                # guarded_by
+        object.__setattr__(self, "_tpulint_owner_thread", None)
+        ns: Dict[str, Any] = {"__tpulint_debug__": True,
+                              "__module__": cls.__module__}
+        for name, attr in vars(cls).items():
+            if not name.startswith("_") and callable(attr):
+                ns[name] = confine(name, attr)
+        object.__setattr__(self, "__class__",
+                           type(cls.__name__, (type(self),), ns))
+
+    init.__wrapped__ = orig_init
+    init.__name__ = "__init__"
+    cls.__init__ = init
+    return cls

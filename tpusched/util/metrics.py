@@ -15,6 +15,8 @@ import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import klog
+
 _DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.0, 5.0, 10.0, 30.0, 60.0)
 
@@ -78,10 +80,12 @@ class GaugeFunc:
         self.name, self.help, self.labels = name, help_, labels
         self._fn = fn
         self.dead = False
+        self.error = ""          # last provider failure ('' = healthy)
 
     def set_fn(self, fn) -> None:
         self._fn = fn
         self.dead = False
+        self.error = ""          # new provider: the old failure is history
 
     def value(self) -> float:
         try:
@@ -90,7 +94,10 @@ class GaugeFunc:
                 self.dead = True
                 return 0.0
             return float(v)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — a raising provider is
+            # treated like a dead one: pruned at scrape, reason retained
+            self.dead = True
+            self.error = str(e)
             return 0.0
 
 
@@ -307,8 +314,10 @@ class Registry:
         for fn in collectors:
             try:
                 fn()
-            except Exception:  # noqa: BLE001 — telemetry refresh is
-                pass           # best-effort; /metrics must stay up
+            except Exception as e:  # noqa: BLE001 — telemetry refresh
+                # is best-effort (/metrics must stay up), but the broken
+                # collector must be visible to operators
+                klog.error_s(e, "metrics collector failed during scrape")
         lines: List[str] = []
         dead: List[str] = []
         with self._lock:
