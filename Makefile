@@ -40,6 +40,22 @@ bench-smoke:
 trace-smoke:
 	$(PY) bench.py --trace-smoke
 
+# Profiler smoke (the hot-path-profiler gate, part of the tier1 flow):
+# headline gang with the sampling profiler on vs off, interleaved; fails
+# if overhead > 3% on the min statistic (direct-attribution fallback when
+# the box cannot resolve 3% — see doc/performance.md), if the sampler took
+# zero samples, or if the collapsed-stack output is malformed.
+.PHONY: prof-smoke
+prof-smoke:
+	$(PY) bench.py --prof-smoke
+
+# Sustained arrival-storm throughput baseline (pre-sharding, ROADMAP item
+# 1): mixed gangs + singletons arriving continuously, binds/sec + p99
+# pod-e2e, writes the schema-validated BENCH_RESULTS.json artifact.
+.PHONY: bench-storm
+bench-storm:
+	$(PY) bench.py --storm
+
 # Chaos-smoke (the resilience gate, part of the tier1 flow): ≥5k seeded
 # scheduling cycles under injected API faults — conflicts, transients,
 # lost-response binds, a forced terminal mid-gang bind failure and a total
@@ -70,7 +86,7 @@ obs-smoke:
 		tests/test_metrics_conformance.py -q -p no:cacheprovider
 
 .PHONY: tier1
-tier1: lint chaos-smoke trace-smoke obs-smoke
+tier1: lint chaos-smoke trace-smoke obs-smoke prof-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -42,7 +42,17 @@ trace-event JSON (flight recorder, tpusched/trace) to PATH, and assert the
 gang critical path reconstructed from the trace matches the measured
 PodGroup-to-Bound wall time. ``--trace-smoke`` (make trace-smoke): tracing
 on/off A-B on the headline gang — fails above 3% overhead (min statistic)
-or on any malformed span tree.
+or on any malformed span tree. ``--prof-smoke`` (make prof-smoke): the same
+A-B for the hot-path sampling profiler (tpusched/obs/profiler).
+
+``--storm``: the sustained arrival-storm throughput scenario only (mixed
+gangs + singletons arriving continuously across 32 pools / 2048 hosts,
+capacity recycling) — binds/sec + p99 pod first-enqueue→bound, the
+pre-sharding baseline for ROADMAP item 1. Every full/--storm run also
+writes a schema-validated machine-readable results artifact
+(BENCH_RESULTS.json, ``--results-out PATH``) with per-scenario
+p50/p99/min/binds-per-sec and an environment stamp, so the perf
+trajectory is tracked across PRs as data.
 """
 from __future__ import annotations
 
@@ -130,19 +140,126 @@ def _check_gate(budget_key: str, times) -> None:
 
 def emit_latency(metric: str, times, budget_key: str,
                  budget_s: float = NORTH_STAR_S) -> None:
-    """One latency line: value = p99, with p50/min and n alongside."""
+    """One latency line: value = p99, with p50/min and n alongside. Also
+    recorded into the machine-readable results artifact under the budget
+    key (the stable per-scenario identifier budgets already use)."""
     arr = np.asarray(times, dtype=np.float64)
     p99v = float(np.percentile(arr, 99))
     p50v = float(np.percentile(arr, 50))
     emit(f"{metric} (n={len(times)})", round(p99v, 4), "s",
          round(budget_s / p99v, 2), p50=round(p50v, 4),
          min=round(float(arr.min()), 4), n=len(times))
+    _record_scenario(budget_key, "latency", p50_s=round(p50v, 4),
+                     p99_s=round(p99v, 4),
+                     min_s=round(float(arr.min()), 4), n=len(times),
+                     description=metric)
     _check_gate(budget_key, times)
 
 
 def _repeat(fn, n: int, *args, **kwargs):
     fn(*args, **kwargs)  # warmup: imports + first-touch caches uncounted
     return [fn(*args, **kwargs) for _ in range(n)]
+
+
+# -- machine-readable results artifact ----------------------------------------
+#
+# Every latency/throughput line also lands in a schema-validated JSON
+# artifact (default BENCH_RESULTS.json, --results-out PATH) so the perf
+# trajectory is tracked across PRs as DATA instead of living only in commit
+# messages. The schema is hand-rolled (no jsonschema dependency in the
+# image) and enforced both at write time here and by the storm smoke test.
+
+RESULTS_SCHEMA_VERSION = 1
+_RESULTS_PATH = "BENCH_RESULTS.json"
+_results_scenarios: dict = {}
+
+
+def _record_scenario(key: str, kind: str, **fields) -> None:
+    rec = {"kind": kind}
+    rec.update(fields)
+    _results_scenarios[key] = rec
+
+
+def results_environment() -> dict:
+    """The environment stamp: enough to tell two artifacts' boxes apart
+    without leaking anything sensitive."""
+    import platform
+    commit = ""
+    try:
+        import subprocess
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+    except Exception:
+        pass
+    return {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count() or 0,
+        "commit": commit,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def build_results_artifact() -> dict:
+    return {"schema_version": RESULTS_SCHEMA_VERSION,
+            "environment": results_environment(),
+            "scenarios": dict(_results_scenarios)}
+
+
+def validate_results_artifact(doc) -> list:
+    """Schema check for the results artifact; returns problem strings
+    (empty = valid). Hand-rolled so the validation itself has no deps and
+    the schema lives next to the writer it constrains."""
+    probs: list = []
+    if not isinstance(doc, dict):
+        return ["artifact is not an object"]
+    if doc.get("schema_version") != RESULTS_SCHEMA_VERSION:
+        probs.append(f"schema_version != {RESULTS_SCHEMA_VERSION}")
+    env = doc.get("environment")
+    if not isinstance(env, dict):
+        probs.append("environment missing")
+    else:
+        for k in ("python", "platform", "cpu_count", "timestamp"):
+            if k not in env:
+                probs.append(f"environment.{k} missing")
+    scen = doc.get("scenarios")
+    if not isinstance(scen, dict) or not scen:
+        probs.append("scenarios missing/empty")
+        return probs
+    num = (int, float)
+    for key, rec in scen.items():
+        if not isinstance(rec, dict):
+            probs.append(f"{key}: not an object")
+            continue
+        kind = rec.get("kind")
+        if kind == "latency":
+            want = ("p50_s", "p99_s", "min_s", "n")
+        elif kind == "throughput":
+            want = ("binds_per_sec", "pod_e2e_p50_s", "pod_e2e_p99_s",
+                    "runs")
+        else:
+            probs.append(f"{key}: unknown kind {kind!r}")
+            continue
+        for f in want:
+            v = rec.get(f)
+            if not isinstance(v, num) or isinstance(v, bool):
+                probs.append(f"{key}.{f}: missing or non-numeric ({v!r})")
+    return probs
+
+
+def write_results_artifact(path: str) -> None:
+    doc = build_results_artifact()
+    probs = validate_results_artifact(doc)
+    if probs:
+        # an invalid artifact is a bench bug: fail the gate, not the write
+        _gate_failures.extend(f"results artifact: {p}" for p in probs)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote results artifact ({len(doc['scenarios'])} scenarios) "
+          f"to {path}", flush=True)
 
 
 # -- scheduler-side -----------------------------------------------------------
@@ -932,6 +1049,211 @@ def bench_fleet_gang() -> None:
         times, "fleet_gang_p99")
 
 
+# -- sustained arrival storm (the pre-sharding throughput baseline) -----------
+
+# (kind, slice shape, members, chips per pod, weight): a mixed stream the
+# one-pool-at-a-time benches never produce — singleton chips, small and
+# medium slice gangs, with an occasional half-pool gang, arriving
+# CONTINUOUSLY until the clock runs out. Weights sum to 1.
+STORM_MIX = (
+    ("singleton", None, 1, 1, 0.50),
+    ("gang-2x2x4", "2x2x4", 4, 4, 0.30),
+    ("gang-4x4x4", "4x4x4", 16, 4, 0.15),
+    ("gang-4x4x8", "4x4x8", 32, 4, 0.05),
+)
+
+
+def run_storm_once(pools: int = 32, duration_s: float = 10.0,
+                   max_pending_pods: int = 1200, seed: int = 0,
+                   drain_timeout_s: float = 120.0) -> dict:
+    """ONE sustained arrival storm: a mixed gang+singleton stream arrives
+    continuously across ``pools`` v5p-256 pools (64 hosts each) for
+    ``duration_s``, with completed workloads torn down as they bind so
+    capacity recycles — the steady state a production fleet actually runs,
+    where every bench so far measured one quiesced gang at a time.
+
+    Throughput accounting: binds/sec = bind commits during the submission
+    window / window length (the drain after the window completes the tail
+    but does not count into the rate — a rate padded by a drain with no
+    arrivals would overstate sustained capacity). Latency accounting:
+    pod-e2e (first-enqueue → bound) via the SLO tracker the scheduler
+    already feeds at bind commit, over EVERY pod of the run including the
+    drain. Backpressure: submission pauses while ``max_pending_pods`` pods
+    are in flight — admission control, so the queue depth (and therefore
+    queue-wait) is bounded by policy rather than by how fast this loop can
+    create API objects.
+
+    Raises if the drain leaves any pod unbound (a storm must never wedge a
+    gang — the chaos soaks' C6 applied at throughput scale)."""
+    import random
+
+    from tpusched import obs
+    from tpusched.api.resources import TPU, make_resources
+    from tpusched.apiserver import server as srv
+    from tpusched.config.profiles import tpu_gang_profile
+    from tpusched.testing import TestCluster, make_pod, make_pod_group, \
+        make_tpu_pool
+    from tpusched.util.metrics import binds_total, scheduling_cycles_total
+
+    rng = random.Random(seed)
+    weights = [w for *_, w in STORM_MIX]
+    slo = obs.install_slo(obs.SLOTracker(pod_e2e_s=NORTH_STAR_S,
+                                         gang_bound_s=NORTH_STAR_S,
+                                         window=65536))
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
+                                              denied_s=1)) as c:
+        for i in range(pools):
+            topo, nodes = make_tpu_pool(f"pool-{i:02d}", dims=(8, 8, 4),
+                                        dcn_domain=f"zoneA/rack{i // 4}")
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+
+        binds0 = binds_total.value()
+        cycles0 = scheduling_cycles_total.value()
+        live: list = []          # (unit name or None, [pod keys])
+        unit_seq = 0
+        submitted_pods = 0
+        reaped_pods = 0
+        pending_peak = 0
+
+        def submit_unit() -> int:
+            nonlocal unit_seq
+            kind, shape, members, chips, _ = rng.choices(
+                STORM_MIX, weights=weights)[0]
+            name = f"storm-{unit_seq:05d}"
+            unit_seq += 1
+            if shape is None:
+                pods = [make_pod(f"{name}-0", limits={TPU: chips},
+                                 requests=make_resources(cpu=1,
+                                                         memory="1Gi"))]
+                pg = None
+            else:
+                c.api.create(srv.POD_GROUPS, make_pod_group(
+                    name, min_member=members, tpu_slice_shape=shape,
+                    tpu_accelerator="tpu-v5p"))
+                pg = f"default/{name}"
+                pods = [make_pod(f"{name}-{j:03d}", pod_group=name,
+                                 limits={TPU: chips},
+                                 requests=make_resources(cpu=1,
+                                                         memory="1Gi"))
+                        for j in range(members)]
+            c.create_pods(pods)
+            live.append((pg, [p.key for p in pods]))
+            return len(pods)
+
+        def reap() -> int:
+            """Tear down fully-bound units so their chips recycle."""
+            done = 0
+            kept = []
+            for pg, keys in live:
+                pods = [c.pod(k) for k in keys]
+                if all(p is not None and p.spec.node_name for p in pods):
+                    for k in keys:
+                        c.api.delete(srv.PODS, k)
+                    if pg is not None:
+                        c.api.delete(srv.POD_GROUPS, pg)
+                    done += len(keys)
+                else:
+                    kept.append((pg, keys))
+            live[:] = kept
+            return done
+
+        start = time.perf_counter()
+        deadline = start + duration_s
+        last_reap = start
+        while time.perf_counter() < deadline:
+            in_flight = submitted_pods - reaped_pods
+            pending_peak = max(pending_peak, in_flight)
+            if in_flight < max_pending_pods:
+                submitted_pods += submit_unit()
+            else:
+                time.sleep(0.002)        # backpressured: let the fleet bind
+            # reap on a coarse tick: the O(in-flight) bound-check sweep is
+            # bench bookkeeping, and running it every iteration would
+            # throttle the arrival stream it exists to sustain
+            now = time.perf_counter()
+            if now - last_reap >= 0.05:
+                last_reap = now
+                reaped_pods += reap()
+        window_s = time.perf_counter() - start
+        window_binds = binds_total.value() - binds0
+
+        # drain: every submitted pod must still reach Bound (no storm may
+        # wedge a gang); the tail's latencies count into p99 pod-e2e
+        drain_start = time.perf_counter()
+        drain_deadline = drain_start + drain_timeout_s
+        while live and time.perf_counter() < drain_deadline:
+            reaped_pods += reap()
+            time.sleep(0.01)
+        if live:
+            stuck = [(pg, [k for k in keys if not (
+                c.pod(k) and c.pod(k).spec.node_name)])
+                for pg, keys in live[:5]]
+            raise RuntimeError(
+                f"storm wedged: {len(live)} units unbound after "
+                f"{drain_timeout_s:.0f}s drain; first: {stuck}")
+        drain_s = time.perf_counter() - drain_start
+        total_binds = binds_total.value() - binds0
+        cycles = scheduling_cycles_total.value() - cycles0
+
+    e2e = slo.summary().get(obs.POD_E2E, {})
+    return {
+        "pools": pools, "hosts": pools * 64,
+        "duration_s": round(window_s, 3),
+        "binds": int(window_binds),
+        "binds_per_sec": round(window_binds / window_s, 2),
+        "total_binds": int(total_binds),
+        "cycles": int(cycles),
+        "cycles_per_bind": round(cycles / max(total_binds, 1), 3),
+        "submitted_pods": submitted_pods,
+        "pending_peak": pending_peak,
+        "drain_s": round(drain_s, 3),
+        "pod_e2e_p50_s": e2e.get("p50_s", 0.0),
+        "pod_e2e_p99_s": e2e.get("p99_s", 0.0),
+        "pod_e2e_events": e2e.get("events", 0),
+    }
+
+
+def bench_storm(runs: int = 3, pools: int = 32,
+                duration_s: float = 10.0) -> None:
+    """The sustained arrival-storm baseline (pre-sharding, ROADMAP item 1).
+    min-of-N methodology (doc/performance.md): this box cannot resolve
+    small wall deltas by A/B, so the HEADLINE numbers are the best run's —
+    max binds/sec and min p99 — the run least taxed by ambient load; every
+    run's numbers are kept in the artifact."""
+    run_storm_once(pools=4, duration_s=2.0, seed=99)   # warmup, small
+    results = [run_storm_once(pools=pools, duration_s=duration_s, seed=i)
+               for i in range(runs)]
+    best_rate = max(r["binds_per_sec"] for r in results)
+    best_p99 = min(r["pod_e2e_p99_s"] for r in results)
+    best_p50 = min(r["pod_e2e_p50_s"] for r in results)
+    hosts = results[0]["hosts"]
+    emit(f"arrival-storm sustained throughput: mixed gangs+singletons over "
+         f"{pools} pools / {hosts} hosts, {duration_s:.0f}s continuous "
+         f"arrivals, capacity recycling (best of {runs} runs; per-run "
+         f"rates {[r['binds_per_sec'] for r in results]})",
+         best_rate, "binds/s", None,
+         pod_e2e_p99_s=best_p99, pod_e2e_p50_s=best_p50,
+         cycles_per_bind=results[0]["cycles_per_bind"],
+         pending_peak=max(r["pending_peak"] for r in results))
+    emit(f"arrival-storm pod first-enqueue->bound p99 under sustained "
+         f"load (min over {runs} runs; submission window + drain)",
+         best_p99, "s", round(NORTH_STAR_S / best_p99, 2)
+         if best_p99 else None)
+    _record_scenario(
+        "arrival_storm", "throughput",
+        binds_per_sec=best_rate, pod_e2e_p50_s=best_p50,
+        pod_e2e_p99_s=best_p99, runs=len(results),
+        pools=pools, hosts=hosts, duration_s=duration_s,
+        per_run=[{k: r[k] for k in ("binds_per_sec", "pod_e2e_p99_s",
+                                    "binds", "pending_peak",
+                                    "cycles_per_bind", "drain_s")}
+                 for r in results],
+        description="sustained mixed arrival storm, pre-sharding baseline")
+    _check_gate("storm_pod_e2e_p99",
+                [r["pod_e2e_p99_s"] for r in results])
+
+
 # -- TPU workload side --------------------------------------------------------
 
 def _tpu_alive(timeout_s: float = 240.0) -> bool:
@@ -1623,6 +1945,129 @@ def trace_smoke() -> int:
     return 0
 
 
+def _prof_direct_cost() -> tuple:
+    """Direct attribution for the profiler: one profiled gang run where
+    the cost charged to profiling is (a) the sampler thread's self-timed
+    sweep cost (the profiler accounts its own work) plus (b) the hot-path
+    attribution stores, charged at a locally calibrated per-store rate ×
+    the exact number of _timed_point/_timed_plugin invocations the run
+    made (two stores each — set + restore). Returns (prof_seconds, wall,
+    samples)."""
+    from tpusched import obs
+    from tpusched.util import tracectx
+    from tpusched.util.metrics import (extension_point_seconds,
+                                       plugin_execution_seconds)
+
+    def _family_count(vec) -> int:
+        return sum(h.count() for h in vec.children().values())
+
+    # calibrate one attribution store (thread-local getattr + list store)
+    t0 = time.perf_counter()
+    for _ in range(20000):
+        tracectx.set_point("CalibratePoint")
+    per_store = (time.perf_counter() - t0) / 20000
+    tracectx.set_point("")
+
+    obs.set_profiling_enabled(True)
+    prof = obs.install_profiler(obs.HotPathProfiler())
+    prof.ensure_started()
+    ep0 = _family_count(extension_point_seconds)
+    pl0 = _family_count(plugin_execution_seconds)
+    try:
+        wall = run_gang_once()
+    finally:
+        prof.stop()
+    calls = (_family_count(extension_point_seconds) - ep0) \
+        + (_family_count(plugin_execution_seconds) - pl0)
+    stats = prof.stats()
+    direct = stats["self_seconds"] + 2 * calls * per_store
+    return direct, wall, stats["samples"]
+
+
+def prof_smoke() -> int:
+    """``--prof-smoke`` (make prof-smoke, wired into the tier1 flow): the
+    headline gang with the sampling profiler ON and OFF interleaved; fails
+    above 3% overhead on the min statistic, with the trace-smoke
+    direct-attribution fallback for when this box provably cannot resolve
+    3% (off-arm spread > 3x the budget). Also sanity-checks the ON arms:
+    the sampler must actually have sampled and produced parseable
+    collapsed-stack output — a gate that passes because the profiler
+    silently never ran would be a disabled gate wearing a green check."""
+    import gc
+
+    from tpusched import obs
+
+    RUNS = 8
+    run_gang_once()                      # shared warmup
+    on_times, off_times = [], []
+    problems: list = []
+    total_samples = 0
+    try:
+        for i in range(RUNS):
+            for arm in (("on", "off") if i % 2 == 0 else ("off", "on")):
+                gc.collect()             # level GC debt across the arms
+                if arm == "on":
+                    obs.set_profiling_enabled(True)
+                    prof = obs.install_profiler(obs.HotPathProfiler())
+                    prof.ensure_started()
+                    on_times.append(run_gang_once())
+                    prof.stop()
+                    st = prof.stats()
+                    total_samples += st["samples"]
+                    for line in prof.collapsed().splitlines():
+                        stack, _, n = line.rpartition(" ")
+                        if not stack or not n.isdigit():
+                            problems.append(f"malformed collapsed line: "
+                                            f"{line!r}")
+                else:
+                    obs.set_profiling_enabled(False)
+                    obs.install_profiler(obs.HotPathProfiler())
+                    off_times.append(run_gang_once())
+    finally:
+        obs.set_profiling_enabled(True)
+        obs.install_profiler(obs.HotPathProfiler())
+
+    on_min, off_min = min(on_times), min(off_times)
+    overhead = (on_min - off_min) / off_min
+    off_spread = (max(off_times) - off_min) / off_min
+    print(f"prof-smoke: profiler-on min {on_min:.3f}s vs off min "
+          f"{off_min:.3f}s over {RUNS} interleaved runs each "
+          f"(overhead {overhead * 100:+.2f}%, off-arm spread "
+          f"{off_spread * 100:.0f}%, budget 3%, "
+          f"{total_samples} samples total)")
+    if total_samples == 0:
+        print("PROF-SMOKE FAILED: sampler took zero samples across all "
+              "ON arms", file=sys.stderr)
+        return 1
+    if problems:
+        print(f"PROF-SMOKE FAILED: {len(problems)} output problems, "
+              f"first: {problems[:3]}", file=sys.stderr)
+        return 1
+    if overhead <= 0.03:
+        return 0
+    if off_spread <= 0.09:
+        # the box CAN resolve 3%: the A/B verdict stands
+        print(f"PROF-SMOKE FAILED: profiler overhead {overhead * 100:.2f}%"
+              f" > 3% (on min {on_min:.3f}s, off min {off_min:.3f}s)",
+              file=sys.stderr)
+        return 1
+    # same-load-regime rule as trace-smoke: best of two direct runs, each
+    # self-ratioed against its own wall
+    cost, wall, samples = min((_prof_direct_cost() for _ in range(2)),
+                              key=lambda r: r[1])
+    direct = cost / wall
+    print(f"prof-smoke: A/B inconclusive on this box (off-arm spread "
+          f"{off_spread * 100:.0f}%); direct attribution: "
+          f"{cost * 1e3:.1f} ms of sampler+attribution work "
+          f"({samples} samples) = {direct * 100:.2f}% of that run's "
+          f"{wall:.3f}s wall (budget 3%)")
+    if direct > 0.03:
+        print(f"PROF-SMOKE FAILED: direct profiling cost "
+              f"{direct * 100:.2f}% > 3%", file=sys.stderr)
+        return 1
+    return 0
+
+
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): only the headline gang scenario at
     n=3 (pre-push fast path; the full matrix is `make bench`), gated on the
@@ -1650,6 +2095,17 @@ def smoke_gate() -> int:
     return 0
 
 
+def _results_path() -> str:
+    if "--results-out" in sys.argv:
+        try:
+            return sys.argv[sys.argv.index("--results-out") + 1]
+        except IndexError:
+            print("usage: bench.py --results-out PATH", file=sys.stderr)
+            sys.exit(2)
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        _RESULTS_PATH)
+
+
 def main() -> int:
     if "--trace-out" in sys.argv:
         try:
@@ -1660,11 +2116,23 @@ def main() -> int:
         return trace_out(path)
     if "--trace-smoke" in sys.argv:
         return trace_smoke()
+    if "--prof-smoke" in sys.argv:
+        return prof_smoke()
     if "--smoke" in sys.argv:
         return smoke_gate()
+    if "--storm" in sys.argv:
+        # storm-only run (the pre-sharding baseline recorder): emits the
+        # throughput lines and writes the schema-validated artifact
+        bench_storm()
+        write_results_artifact(_results_path())
+        if _gate_failures:
+            for f in _gate_failures:
+                print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)
+            return 1
+        return 0
     for bench in (bench_quota, bench_slice_reclaim, bench_multislice,
                   bench_scale, bench_equiv_churn, bench_fleet_gang,
-                  bench_contention,
+                  bench_contention, bench_storm,
                   bench_gang_wal, bench_wal_recovery, bench_ha_takeover,
                   bench_serving_slo, bench_tpu_workload):
         try:
@@ -1679,6 +2147,7 @@ def main() -> int:
                 _gate_failures.append(
                     f"{bench.__name__} crashed: {type(e).__name__}: {e}")
     bench_gang()
+    write_results_artifact(_results_path())
     if _gate_failures:
         for f in _gate_failures:
             print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)

@@ -269,6 +269,42 @@ def test_accessor_needs_guard_outside_sim(tmp_path):
     assert "module level" in r.findings[0].message
 
 
+def test_profiler_accessors_are_shadow_guarded(tmp_path):
+    """ISSUE 7: the profiler joined the global-surface accessor set — an
+    unguarded ensure_profiler()/default_profiler() outside sim/ is a
+    finding, and a shadow module may not reference them at all (a trial
+    run must never publish live hot-path samples)."""
+    bad = """
+        from .. import obs
+
+        def wire(self):
+            obs.ensure_profiler()
+            self.prof = obs.default_profiler()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/s.py", bad,
+                    ["shadow-isolation"])
+    assert len(r.findings) == 2
+    guarded = """
+        from .. import obs
+
+        def wire(self, telemetry):
+            if telemetry:
+                obs.ensure_profiler()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/s2.py", guarded,
+                    ["shadow-isolation"])
+    assert r.findings == []
+    shadow = """
+        from .. import obs
+
+        def trial(self):
+            obs.install_profiler(obs.HotPathProfiler())
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/trial.py", shadow,
+                    ["shadow-isolation"])
+    assert any("install_profiler" in f.message for f in r.findings)
+
+
 # -- monotonic-clock -----------------------------------------------------------
 
 

@@ -11,6 +11,7 @@ objects are the plain stdlib types — there is no wrapper to pay for).
 from __future__ import annotations
 
 import threading
+import time
 
 import pytest
 
@@ -20,9 +21,11 @@ from tpusched.util import locking
 @pytest.fixture(autouse=True)
 def _reset_locking():
     prev = locking.set_debug(False)
+    prev_tel = locking.set_telemetry(False)
     locking.recorder().reset()
     yield
     locking.set_debug(prev)
+    locking.set_telemetry(prev_tel)
     locking.recorder().reset()
 
 
@@ -344,3 +347,141 @@ def test_equivcache_is_confined_in_debug_mode():
     _run_in_thread(lambda: ec.get("k"), name="foreign-loop")
     assert any("EquivalenceCache" in v
                for v in locking.recorder().violations())
+
+
+# -- contention telemetry mode (ISSUE 7) ---------------------------------------
+
+
+def test_telemetry_off_mode_is_plain_stdlib_lock():
+    """The structural zero-overhead pin for TELEMETRY mode, same contract
+    as debug mode: both modes off ⇒ the factory returns the plain stdlib
+    lock — there is no wrapper to pay for."""
+    lk = locking.GuardedLock("tel-off")
+    assert type(lk).__name__ == "RLock"
+    nk = locking.GuardedLock("tel-off-n", reentrant=False)
+    assert type(nk) is type(threading.Lock())
+    locking.set_telemetry(True)
+    tk = locking.GuardedLock("tel-on")
+    assert type(tk) is locking._TelemetryLock
+    locking.set_telemetry(False)
+    lk2 = locking.GuardedLock("tel-off-again")
+    assert type(lk2).__name__ == "RLock"
+
+
+def test_debug_wins_when_both_modes_requested():
+    locking.set_debug(True)
+    locking.set_telemetry(True)
+    lk = locking.GuardedLock("both-modes")
+    assert type(lk) is locking._InstrumentedLock
+
+
+def test_contention_histograms_record_wait_and_hold():
+    """Forced contention: one thread holds for ~20 ms while another blocks
+    acquiring. The wait histogram must record exactly the contended
+    acquire (uncontended ones never observe) and the hold histogram the
+    long hold (the contender's own µs-hold stays below the threshold)."""
+    import time as _t
+
+    from tpusched.util.metrics import lock_hold_seconds, lock_wait_seconds
+
+    locking.set_telemetry(True)
+    lk = locking.GuardedLock("test.Contended")
+    wait_h = lock_wait_seconds.with_labels("test.Contended")
+    hold_h = lock_hold_seconds.with_labels("test.Contended")
+    wait0, hold0 = wait_h.count(), hold_h.count()
+
+    # uncontended acquire/release: nothing observed anywhere
+    with lk:
+        pass
+    assert wait_h.count() == wait0
+    assert hold_h.count() == hold0
+
+    t2_done = threading.Event()
+
+    def contender():
+        with lk:
+            pass
+        t2_done.set()
+
+    with lk:
+        t = threading.Thread(target=contender, name="tel-contender",
+                             daemon=True)
+        t.start()
+        _t.sleep(0.02)                 # contender blocks against this hold
+    assert t2_done.wait(5)
+    t.join(timeout=5)
+    assert wait_h.count() == wait0 + 1          # exactly the contended one
+    assert wait_h.quantile(0.5) >= 0.005        # it really waited ~20 ms
+    assert hold_h.count() == hold0 + 1          # only the long hold
+    assert hold_h.quantile(0.5) >= 0.01
+
+
+def test_reentrant_telemetry_hold_spans_outermost_acquire():
+    from tpusched.util.metrics import lock_hold_seconds
+
+    locking.set_telemetry(True)
+    lk = locking.GuardedLock("test.Reentrant")
+    h = lock_hold_seconds.with_labels("test.Reentrant")
+    before = h.count()
+    import time as _t
+    with lk:
+        with lk:                       # reentrant: no inner hold segment
+            _t.sleep(0.003)
+    assert h.count() == before + 1     # one hold, outer-acquire to final
+    assert h.quantile(0.5) >= 0.002    # release, covering the sleep
+
+
+def test_condition_wait_is_not_charged_as_hold():
+    """queue.pop()'s Condition wait is idle time, not a hold: a telemetry
+    lock under threading.Condition must end the hold at wait() and start a
+    fresh one at wakeup — a consumer blocking 50 ms on an empty queue must
+    not read as a 50 ms lock hold."""
+    from tpusched.util.metrics import lock_hold_seconds
+
+    locking.set_telemetry(True)
+    lk = locking.GuardedLock("test.CondTel")
+    cv = threading.Condition(lk)
+    h = lock_hold_seconds.with_labels("test.CondTel")
+    before = h.count()
+    with cv:
+        cv.wait(0.05)                  # both hold segments are ~µs
+    assert h.count() == before
+    assert not lk.locked()
+
+
+def test_contended_acquire_publishes_lock_attribution():
+    """While blocked on a contended acquire the waiter publishes
+    'blocked on <lock>' into the profiler's attribution context — the
+    sampler attributes those samples to the lock, which is exactly the
+    'Filter spends N% under the cache lock' signal."""
+    from tpusched.util import tracectx
+
+    locking.set_telemetry(True)
+    lk = locking.GuardedLock("test.AttrLock")
+    ident = {}
+    started = threading.Event()
+    t2_done = threading.Event()
+
+    def contender():
+        ident["v"] = threading.get_ident()
+        started.set()
+        with lk:
+            pass
+        t2_done.set()
+
+    with lk:
+        t = threading.Thread(target=contender, name="tel-attr-contender",
+                             daemon=True)
+        t.start()
+        assert started.wait(5)
+        deadline = time.monotonic() + 5
+        seen = ""
+        while time.monotonic() < deadline:
+            seen = tracectx.attribution(ident["v"])[2]
+            if seen == "test.AttrLock":
+                break
+            time.sleep(0.001)
+        assert seen == "test.AttrLock"
+    assert t2_done.wait(5)
+    t.join(timeout=5)
+    assert tracectx.attribution(ident["v"])[2] == ""   # restored

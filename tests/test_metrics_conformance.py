@@ -231,3 +231,35 @@ def test_escape_helpers():
     assert escape_label_value("a\\b") == "a\\\\b"
     assert escape_label_value("a\nb") == "a\\nb"
     assert format_labels(("k",), ('v"',)) == 'k="v\\""'
+
+
+def test_issue7_families_round_trip_exposition():
+    """The ISSUE 7 families (lock contention histograms, throughput
+    counters, profiler sample counter, arrival/backlog gauges) parse clean
+    through the same validating round trip, with the naming conventions
+    the metrics-names lint rule pins."""
+    from tpusched.util.metrics import (binds_total, lock_hold_seconds,
+                                       lock_wait_seconds,
+                                       profiler_samples_total,
+                                       scheduling_cycles_total)
+    lock_wait_seconds.with_labels("conformance.Lock").observe(0.0004)
+    lock_hold_seconds.with_labels("conformance.Lock").observe(0.002)
+    binds_total.with_labels("conformance-sched").inc()
+    scheduling_cycles_total.with_labels("conformance-sched").inc(2)
+    profiler_samples_total.inc(0)
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert types["tpusched_lock_wait_seconds"] == "histogram"
+    assert types["tpusched_lock_hold_seconds"] == "histogram"
+    assert types["tpusched_binds_total"] == "counter"
+    assert types["tpusched_scheduling_cycles_total"] == "counter"
+    assert types["tpusched_profiler_samples_total"] == "counter"
+    # the µs-scale buckets actually resolve a 0.4 ms wait: some bucket
+    # below the default 1 ms floor already counts it
+    sub_ms = [v for name, labels, v in samples
+              if name == "tpusched_lock_wait_seconds_bucket"
+              and labels.get("lock") == "conformance.Lock"
+              and labels["le"] not in ("+Inf",)
+              and float(labels["le"]) < 0.001]
+    assert sub_ms and max(sub_ms) >= 1.0
+    assert (("tpusched_binds_total", {"scheduler": "conformance-sched"},
+             1.0)) in samples

@@ -1,0 +1,88 @@
+"""Arrival-storm bench smoke (ISSUE 7): the sustained-throughput scenario
+runs to completion at CI scale, reports binds/sec + p99 pod-e2e, and its
+machine-readable results artifact round-trips the schema validator.  The
+validator itself gets negative tables — a schema check that accepts
+garbage is a disabled gate wearing a green checkmark.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+
+import pytest
+
+bench = importlib.import_module("bench")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_results(monkeypatch):
+    monkeypatch.setattr(bench, "_results_scenarios", {})
+    monkeypatch.setattr(bench, "_gate_failures", [])
+
+
+def test_storm_smoke_runs_and_reports(tmp_path):
+    """Scaled-down storm (2 pools / 128 hosts, ~2s of continuous mixed
+    arrivals): it must sustain throughput, drain without wedging a gang,
+    and produce a schema-valid artifact."""
+    r = bench.run_storm_once(pools=2, duration_s=2.0, max_pending_pods=300,
+                             seed=11, drain_timeout_s=90)
+    assert r["binds"] > 0
+    assert r["binds_per_sec"] > 0
+    assert r["total_binds"] == r["submitted_pods"]   # drained, no wedge
+    assert r["pod_e2e_events"] == r["submitted_pods"]
+    assert r["pod_e2e_p99_s"] >= r["pod_e2e_p50_s"] > 0
+    assert r["hosts"] == 128
+    assert r["cycles"] >= r["total_binds"]
+
+    bench._record_scenario(
+        "arrival_storm", "throughput",
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1)
+    out = tmp_path / "results.json"
+    bench.write_results_artifact(str(out))
+    assert bench._gate_failures == []
+    doc = json.loads(out.read_text())
+    assert bench.validate_results_artifact(doc) == []
+    assert doc["scenarios"]["arrival_storm"]["binds_per_sec"] > 0
+    for k in ("python", "platform", "cpu_count", "timestamp"):
+        assert k in doc["environment"]
+
+
+def test_latency_lines_record_into_artifact():
+    bench.emit_latency("synthetic scenario", [0.1, 0.2, 0.3], "synth_p99")
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    rec = doc["scenarios"]["synth_p99"]
+    assert rec["kind"] == "latency"
+    assert rec["min_s"] == 0.1 and rec["n"] == 3
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda d: d.pop("environment"), "environment missing"),
+    (lambda d: d.update(schema_version=99), "schema_version"),
+    (lambda d: d["scenarios"].update(bad={"kind": "nonsense"}),
+     "unknown kind"),
+    (lambda d: d["scenarios"]["x"].pop("p99_s"), "x.p99_s"),
+    (lambda d: d["scenarios"]["x"].update(p99_s="fast"), "x.p99_s"),
+    (lambda d: d.update(scenarios={}), "scenarios missing/empty"),
+])
+def test_validator_rejects_malformed_artifacts(mutate, expect):
+    bench.emit_latency("x scenario", [0.1, 0.2], "x")
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    mutate(doc)
+    probs = bench.validate_results_artifact(doc)
+    assert probs and any(expect in p for p in probs), probs
+
+
+def test_throughput_scenario_schema_requirements():
+    bench._record_scenario("arrival_storm", "throughput",
+                           binds_per_sec=100.0, pod_e2e_p50_s=0.5,
+                           pod_e2e_p99_s=1.5, runs=3)
+    assert bench.validate_results_artifact(
+        bench.build_results_artifact()) == []
+    bench._record_scenario("arrival_storm", "throughput",
+                           binds_per_sec=True, pod_e2e_p50_s=0.5,
+                           pod_e2e_p99_s=1.5, runs=3)
+    probs = bench.validate_results_artifact(bench.build_results_artifact())
+    assert any("binds_per_sec" in p for p in probs)

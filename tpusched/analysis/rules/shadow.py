@@ -13,7 +13,13 @@ what makes the invariant statically checkable:
 
     trace.default_recorder / install_recorder
     obs.default_engine / install_engine / default_slo / install_slo
+    obs.default_profiler / install_profiler / ensure_profiler
     REGISTRY.gauge_func / REGISTRY.register_collector
+
+The profiler/throughput additions (ISSUE 7) extend the same contract: a
+shadow scheduler gets a private (or nil) profiler and an inert
+``ThroughputTelemetry(publish=False)`` — a trial run must never publish
+live hot-path samples or binds/sec.
 
 Checks:
 
@@ -40,7 +46,8 @@ from ..core import (Finding, FileContext, Rule, dotted_name,
 
 _ACCESSORS = frozenset((
     "default_recorder", "install_recorder", "default_engine",
-    "install_engine", "default_slo", "install_slo"))
+    "install_engine", "default_slo", "install_slo",
+    "default_profiler", "install_profiler", "ensure_profiler"))
 _REGISTRY_METHODS = frozenset(("gauge_func", "register_collector"))
 _GUARDS = ("telemetry", "_telemetry", "publish", "_publish")
 _DEFINING = frozenset(("tpusched/trace/__init__.py",

@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.core import Node, Pod
 from .. import trace
-from ..util import klog
+from ..util import klog, tracectx
 from ..util.metrics import plugin_execution_seconds
 from .cycle_state import CycleState
 from .interfaces import (BatchFilterPlugin, BindPlugin, ClusterEvent,
@@ -345,11 +345,16 @@ def _timed_plugin(point: str, plugin_name: str, fn, *args):
     node per pod would cost more than the plugin bodies; the whole-sweep
     number lives in framework_extension_point_duration_seconds instead)."""
     hist = plugin_execution_seconds.with_labels(plugin_name, point)
+    # profiler attribution (obs/profiler): one thread-local list store each
+    # way — the sampler reads it cross-thread, so a sample taken inside the
+    # plugin body lands as "point/plugin", not just a Python frame
+    prev_plugin = tracectx.set_plugin(plugin_name)
     t0 = time.perf_counter()
     try:
         return fn(*args)
     finally:
         dur = time.perf_counter() - t0
+        tracectx.set_plugin(prev_plugin)
         hist.observe(dur)
         tr = trace.current()
         if tr is not None:

@@ -10,13 +10,24 @@ Three pillars (ISSUE 5):
 - ``slo.SLOTracker`` — pod-e2e and PodGroup-to-Bound latency objectives
   with burn-rate accounting (``tpusched_slo_*``).
 
+Plus the performance pillar (ISSUE 7):
+
+- ``profiler.HotPathProfiler`` — the always-on sampling profiler:
+  collapsed stacks at ``/debug/profile``, extension-point/plugin/lock
+  attribution in ``/debug/flightrecorder``'s health section;
+- ``throughput.ThroughputTelemetry`` — binds/sec, cycles/sec, arrival
+  rate and bind-pool backlog, per scheduler profile.
+
 Like the flight recorder, the engine and the SLO tracker have process-
 global defaults: the scheduler feeds whichever instances it was built
 with (default: the globals), and the /debug HTTP surface resolves the
 globals at request time — so a bench/test that installs fresh instances
 is picked up without rebuilding servers, and plugin code (Coscheduling's
 gang-bound clock) can feed the SLO layer without a handle threaded
-through the framework.
+through the framework.  The profiler follows the same pattern
+(``default_profiler``/``install_profiler``); live schedulers start it via
+``ensure_profiler`` and SHADOW schedulers never touch it — the
+shadow-isolation lint rule pins the whole accessor set.
 """
 from __future__ import annotations
 
@@ -25,19 +36,26 @@ from .slo import (GANG_BOUND, POD_E2E, SLOTracker, DEFAULT_GANG_BOUND_S,
                   DEFAULT_POD_E2E_S)
 from .capacity import (CapacityTelemetry, largest_placeable_chips,
                        largest_window_chips, pool_occupancy)
+from .profiler import (HotPathProfiler, profiling_enabled,
+                       set_profiling_enabled)
+from .throughput import ThroughputTelemetry
 from . import reasons  # noqa: F401  (re-export)
 
 __all__ = [
     "DiagnosisEngine", "SLOTracker", "CapacityTelemetry",
+    "HotPathProfiler", "ThroughputTelemetry",
+    "profiling_enabled", "set_profiling_enabled",
     "largest_placeable_chips", "largest_window_chips", "pool_occupancy",
     "POD_E2E", "GANG_BOUND",
     "DEFAULT_POD_E2E_S", "DEFAULT_GANG_BOUND_S", "reasons",
     "default_engine", "install_engine", "default_slo", "install_slo",
+    "default_profiler", "install_profiler", "ensure_profiler",
     "observe_gang_bound",
 ]
 
 _engine = DiagnosisEngine()
 _slo = SLOTracker()
+_profiler = HotPathProfiler()
 
 
 def default_engine() -> DiagnosisEngine:
@@ -75,3 +93,26 @@ def observe_gang_bound(seconds: float) -> None:
     """Feed the gang-bound objective from wherever the PodGroup-to-Bound
     clock is read (Coscheduling's post_bind quorum completion)."""
     _slo.observe(GANG_BOUND, seconds)
+
+
+def default_profiler() -> HotPathProfiler:
+    return _profiler
+
+
+def install_profiler(profiler: HotPathProfiler) -> HotPathProfiler:
+    """Swap the process-global profiler (bench/test isolation — prof-smoke
+    runs each arm against a fresh instance).  The replaced sampler is
+    stopped: two samplers would double every attribution share."""
+    global _profiler
+    if _profiler is not profiler:
+        _profiler.stop()
+    _profiler = profiler
+    return profiler
+
+
+def ensure_profiler() -> HotPathProfiler:
+    """Start the process-global profiler if enabled and not yet running
+    (idempotent — live schedulers call this at construction; shadows must
+    not)."""
+    _profiler.ensure_started()
+    return _profiler

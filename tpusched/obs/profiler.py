@@ -1,0 +1,354 @@
+"""Hot-path sampling profiler: always-on, low-overhead, attribution-aware.
+
+Every bench so far clocks one gang at a time; the sharded-core work
+(ROADMAP item 1) needs to know where a *sustained* cycle's wall time goes —
+"Filter spends 41% of the cycle under the cache lock" — without attaching
+an external profiler to a production scheduler.  This module is that
+substrate:
+
+- a named daemon sampler thread periodically snapshots the stacks of all
+  scheduler-owned threads (``sys._current_frames()`` — no stop-the-world,
+  no tracing hooks on the hot path);
+- each sample is attributed through the cross-thread context the hot path
+  already publishes into ``util/tracectx`` (active extension point, plugin
+  body, contended lock — the latter fed by ``GuardedLock`` telemetry mode),
+  so a stack is not just frames but "PreFilter / TpuSlice / blocked on
+  sched.Cache";
+- samples aggregate into a BOUNDED hot-path table (entry + byte budgets —
+  an always-on control plane must hold its memory ceiling through any
+  workload; overflow stacks are counted, never stored);
+- the aggregate serves collapsed-stack (flamegraph-collapsed, one
+  ``frame;frame;frame count`` line per distinct stack) output at
+  ``/debug/profile`` — ``?seconds=N`` collects a fresh bounded window so an
+  operator can profile "now", no argument returns the rolling aggregate —
+  and a top-N attribution table into ``/debug/flightrecorder``'s health
+  section.
+
+The sampler accounts for its own cost (``self_seconds`` in stats): the
+prof-smoke gate's direct-attribution fallback divides that by the run's
+wall time when the A/B cannot resolve its 3% budget on a noisy box.
+
+Overhead design: the HOT PATH pays only the tracectx attribution stores
+(one thread-local getattr + a list store per extension point / cold plugin
+call — sites that already pay two perf_counter reads for the duration
+metrics); everything else runs on the sampler thread at ``interval_s``
+resolution.  At the default 100 Hz with a dozen scheduler threads a sweep
+is ~100 µs of work — well under the 3% budget ``make prof-smoke`` pins.
+
+Known sampling bias (inherent to a pure-Python sampler): the sampler can
+only preempt a CPU-bound pure-Python burst through the forced GIL handoff,
+which fires after ``sys.getswitchinterval()`` (5 ms default) — a busy
+burst SHORTER than that is sampled only at its voluntary GIL releases, so
+sub-switch-interval bursts are attributed to the wait states around them.
+Durations at that scale belong to the duration histograms
+(``tpusched_framework_extension_point_duration_seconds`` and friends);
+the profiler's regime is the aggregate shape of where whole cycles go.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..util import klog, tracectx
+from ..util.metrics import profiler_samples_total
+
+__all__ = ["HotPathProfiler", "profiling_enabled", "set_profiling_enabled",
+           "DEFAULT_INTERVAL_S"]
+
+DEFAULT_INTERVAL_S = 0.01          # 100 Hz: resolves ms-scale cycle phases
+DEFAULT_MAX_STACKS = 512
+DEFAULT_MAX_BYTES = 1 << 20        # ~1 MiB of collapsed-stack keys
+DEFAULT_MAX_FRAMES = 48            # innermost frames kept per stack
+_MAX_ATTR_ROWS = 256
+_MAX_CAPTURES = 4                  # concurrent ?seconds=N windows
+_THREAD_PREFIX = "tpusched-"
+_NUM_SUFFIX = re.compile(r"-\d+$")
+
+_enabled = os.environ.get("TPUSCHED_PROFILE", "1") not in ("0", "false",
+                                                           "off")
+
+
+def profiling_enabled() -> bool:
+    return _enabled
+
+
+def set_profiling_enabled(v: bool) -> bool:
+    """Kill switch (and the profiler-off arm of the prof-smoke A/B):
+    ``ensure_started`` becomes a no-op and a running sampler parks at the
+    next tick.  Returns the previous value (restore in finally)."""
+    global _enabled
+    prev, _enabled = _enabled, bool(v)
+    return prev
+
+
+class _Aggregate:
+    """One bounded collapsed-stack table + attribution table.  Not
+    self-locking: the owning profiler serializes access (sampler feeds and
+    scrapers read under the profiler's lock)."""
+
+    __slots__ = ("max_stacks", "max_bytes", "stacks", "attrs", "bytes",
+                 "samples", "dropped", "dropped_attrs", "started_at")
+
+    def __init__(self, max_stacks: int, max_bytes: int):
+        self.max_stacks = max_stacks
+        self.max_bytes = max_bytes
+        # (thread_label, (point, plugin, lock), frames) → sample count
+        self.stacks: Dict[Tuple[str, Tuple[str, str, str],
+                                Tuple[str, ...]], int] = {}
+        # (thread_label, point, plugin, lock) → sample count
+        self.attrs: Dict[Tuple[str, str, str, str], int] = {}
+        self.bytes = 0
+        self.samples = 0
+        self.dropped = 0
+        self.dropped_attrs = 0
+        self.started_at = time.monotonic()
+
+    def feed(self, label: str, attr: Tuple[str, str, str],
+             frames: Tuple[str, ...]) -> None:
+        self.samples += 1
+        akey = (label,) + attr
+        if akey in self.attrs or len(self.attrs) < _MAX_ATTR_ROWS:
+            self.attrs[akey] = self.attrs.get(akey, 0) + 1
+        else:
+            self.dropped_attrs += 1    # same contract as stacks: overflow
+        skey = (label, attr, frames)   # is counted, never silent
+        n = self.stacks.get(skey)
+        if n is not None:
+            self.stacks[skey] = n + 1
+            return
+        est = len(label) + sum(len(f) + 1 for f in frames) + 24
+        if len(self.stacks) >= self.max_stacks \
+                or self.bytes + est > self.max_bytes:
+            self.dropped += 1          # counted, never stored: the budget
+            return                     # holds through any stack diversity
+        self.stacks[skey] = 1
+        self.bytes += est
+
+    # -- views ---------------------------------------------------------------
+
+    def collapsed(self) -> str:
+        """Flamegraph-collapsed text: ``thread;point:X;plugin:Y;lock:Z;
+        frame;...;frame N`` per distinct stack, hottest first.  Attribution
+        segments are emitted only when present, as synthetic frames — a
+        flamegraph then groups the scheduler's time by extension point and
+        plugin before any Python frame."""
+        lines = []
+        for (label, attr, frames), n in sorted(
+                self.stacks.items(), key=lambda kv: -kv[1]):
+            point, plugin, lock = attr
+            segs = [label]
+            if point:
+                segs.append(f"point:{point}")
+            if plugin:
+                segs.append(f"plugin:{plugin}")
+            if lock:
+                segs.append(f"lock:{lock}")
+            segs.extend(frames)
+            lines.append(f"{';'.join(segs)} {n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def top_attribution(self, n: int = 10) -> List[Dict[str, Any]]:
+        total = self.samples or 1
+        rows = sorted(self.attrs.items(), key=lambda kv: -kv[1])[:n]
+        return [{"thread": k[0], "extension_point": k[1], "plugin": k[2],
+                 "lock": k[3], "samples": v,
+                 "share": round(v / total, 4)}
+                for k, v in rows]
+
+    def stats(self) -> Dict[str, Any]:
+        return {"samples": self.samples, "stacks": len(self.stacks),
+                "approx_bytes": self.bytes, "dropped_stacks": self.dropped,
+                "dropped_attr_rows": self.dropped_attrs,
+                "max_stacks": self.max_stacks, "max_bytes": self.max_bytes,
+                "window_s": round(time.monotonic() - self.started_at, 3)}
+
+
+class HotPathProfiler:
+    """The always-on sampler.  One instance per process is the intended
+    shape (``obs.default_profiler()``); shadow schedulers get none — a
+    what-if trial must never publish live hot-path samples
+    (tpulint's shadow-isolation rule pins the accessor set)."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 max_stacks: int = DEFAULT_MAX_STACKS,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 max_frames: int = DEFAULT_MAX_FRAMES,
+                 thread_prefix: str = _THREAD_PREFIX):
+        self.interval_s = max(0.001, interval_s)
+        self.max_frames = max_frames
+        self.thread_prefix = thread_prefix
+        # raw Lock on purpose: the profiler must never feed itself (a
+        # GuardedLock in telemetry mode would observe its own contention
+        # from inside the sampler loop)
+        self._mu = threading.Lock()
+        self._agg = _Aggregate(max_stacks, max_bytes)
+        self._captures: List[_Aggregate] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sweeps = 0
+        self._sweep_errors = 0
+        self._self_s = 0.0             # sampler's own cost (direct
+        self._prune_countdown = 0      # attribution for prof-smoke)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive() \
+            and not self._stop.is_set()
+
+    def ensure_started(self) -> bool:
+        """Idempotent start (the scheduler calls this once per live
+        construction).  Respects the module kill switch."""
+        if not _enabled:
+            return False
+        with self._mu:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="tpusched-profiler-sampler",
+                daemon=True)
+            self._thread.start()
+            return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+
+    # -- sampling ------------------------------------------------------------
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval_s):
+            if not _enabled:
+                continue               # parked by the kill switch
+            t0 = time.perf_counter()
+            try:
+                self._sweep(me)
+            except Exception as e:  # noqa: BLE001 — an always-on sampler
+                # must survive one bad sweep (exotic frame, racing capture
+                # state): losing the thread would silently end profiling
+                # for the life of the process
+                self._sweep_errors += 1
+                if self._sweep_errors <= 3:
+                    klog.error_s(e, "profiler sweep failed")
+            self._self_s += time.perf_counter() - t0
+
+    def _sweep(self, self_ident: int) -> None:
+        frames = sys._current_frames()
+        try:
+            names = {t.ident: t.name for t in threading.enumerate()}
+            fed = 0
+            with self._mu:
+                captures = list(self._captures)
+                for ident, frame in frames.items():
+                    if ident == self_ident:
+                        continue
+                    name = names.get(ident, "")
+                    if not name.startswith(self.thread_prefix):
+                        continue
+                    label = _NUM_SUFFIX.sub("", name)
+                    attr = tracectx.attribution(ident)
+                    stack = self._extract(frame)
+                    self._agg.feed(label, attr, stack)
+                    for cap in captures:
+                        cap.feed(label, attr, stack)
+                    fed += 1
+            if fed:
+                profiler_samples_total.inc(fed)
+            self._sweeps += 1
+            # housekeeping every ~256 sweeps: drop attribution slots of
+            # dead threads (bind-pool workers are long-lived, but tests
+            # construct and stop schedulers constantly)
+            self._prune_countdown -= 1
+            if self._prune_countdown <= 0:
+                self._prune_countdown = 256
+                tracectx.prune_attributions(set(frames))
+        finally:
+            del frames                 # break frame → local ref cycles
+
+    def _extract(self, frame) -> Tuple[str, ...]:
+        out: List[str] = []
+        f = frame
+        while f is not None and len(out) < self.max_frames:
+            code = f.f_code
+            out.append(f"{f.f_globals.get('__name__', '?')}."
+                       f"{code.co_name}")
+            f = f.f_back
+        out.reverse()                  # root first, leaf last (collapsed
+        return tuple(out)              # stack convention)
+
+    # -- views ---------------------------------------------------------------
+
+    def collapsed(self) -> str:
+        with self._mu:
+            return self._agg.collapsed()
+
+    def top_attribution(self, n: int = 10) -> List[Dict[str, Any]]:
+        with self._mu:
+            return self._agg.top_attribution(n)
+
+    def capture(self, seconds: float) -> Optional[_Aggregate]:
+        """Collect a FRESH bounded window for ``seconds`` (the
+        ``/debug/profile?seconds=N`` path) and return its aggregate.
+        Blocking — intended for request-handler threads.  Concurrent
+        captures are capped to bound sampler work; past the cap this
+        returns None and the caller must say so (a silent fall-back to
+        the since-start rolling aggregate LOOKS like a fresh window but
+        may be dominated by hours of idle frames)."""
+        cap = _Aggregate(self._agg.max_stacks, self._agg.max_bytes)
+        with self._mu:
+            if len(self._captures) >= _MAX_CAPTURES:
+                return None
+            self._captures.append(cap)
+        try:
+            self._stop.wait(max(0.05, seconds))
+        finally:
+            with self._mu:
+                if cap in self._captures:
+                    self._captures.remove(cap)
+        return cap
+
+    def _snapshot_agg(self) -> _Aggregate:
+        snap = _Aggregate(self._agg.max_stacks, self._agg.max_bytes)
+        with self._mu:
+            snap.stacks = dict(self._agg.stacks)
+            snap.attrs = dict(self._agg.attrs)
+            snap.bytes = self._agg.bytes
+            snap.samples = self._agg.samples
+            snap.dropped = self._agg.dropped
+            snap.started_at = self._agg.started_at
+        return snap
+
+    def reset(self) -> None:
+        """Drop the rolling aggregate (bench isolation between arms)."""
+        with self._mu:
+            self._agg = _Aggregate(self._agg.max_stacks,
+                                   self._agg.max_bytes)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            st = self._agg.stats()
+        st.update({"running": self.running, "interval_s": self.interval_s,
+                   "sweeps": self._sweeps,
+                   "sweep_errors": self._sweep_errors,
+                   "self_seconds": round(self._self_s, 6),
+                   "active_captures": len(self._captures)})
+        return st
+
+    def health(self, n: int = 10) -> Dict[str, Any]:
+        """The /debug/flightrecorder health-section payload: top-N
+        attribution rows + the sampler's own vitals."""
+        with self._mu:
+            top = self._agg.top_attribution(n)
+            samples = self._agg.samples
+        return {"running": self.running, "interval_s": self.interval_s,
+                "samples": samples, "self_seconds": round(self._self_s, 6),
+                "top": top}

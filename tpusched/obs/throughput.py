@@ -1,0 +1,115 @@
+"""Fleet throughput telemetry: the sustained-rate counters and gauges the
+arrival-storm bench (bench.py --storm) and the sharded scheduler core
+(ROADMAP item 1) are judged against.
+
+One ``ThroughputTelemetry`` per scheduler, fed from the three points that
+define throughput:
+
+- ``on_arrival``   a pending pod entered the scheduling queue
+  (``sched/queue.SchedulingQueue.add``) — the arrival-rate gauge's source;
+- ``on_cycle``     a scheduling cycle started (``scheduleOne``) —
+  ``tpusched_scheduling_cycles_total``;
+- ``on_bind``      a bind committed — ``tpusched_binds_total``.
+
+Plus two scrape-time gauges registered per scheduler:
+``tpusched_pod_arrivals_per_second`` (rolling-window arrival rate) and
+``tpusched_bind_pool_backlog`` (binding tasks queued behind the
+``_BindingPool`` workers — the first queue to grow when bind throughput,
+not scheduling throughput, is the bottleneck).  Queue depths themselves
+are already exposed as ``tpusched_pending_pods{queue=...}``.
+
+Shadow isolation: a ``publish=False`` instance (what-if planner, defrag
+trials) is an inert shell — every feed method is a no-op and no gauge is
+registered, so a trial run can never publish hypothetical binds/sec as
+fleet throughput.  The hot-path cost of a publishing instance is one
+counter increment (arrivals also append one float to a bounded deque).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Optional
+
+from ..util.metrics import (REGISTRY, binds_total, escape_label_value,
+                            scheduling_cycles_total)
+
+__all__ = ["ThroughputTelemetry", "ARRIVAL_WINDOW_S"]
+
+ARRIVAL_WINDOW_S = 60.0
+_ARRIVAL_CAP = 65536        # bounded memory even under a 1k+/s storm
+
+
+class ThroughputTelemetry:
+    def __init__(self, scheduler_name: str = "", publish: bool = True,
+                 clock=time.monotonic,
+                 window_s: float = ARRIVAL_WINDOW_S):
+        self.publish = publish
+        self._clock = clock
+        self._window_s = window_s
+        # deque.append is atomic under the GIL; the rate reader copies.
+        self._arrivals: "collections.deque[float]" = collections.deque(
+            maxlen=_ARRIVAL_CAP)
+        if not publish:
+            # inert shell: no counter children, no gauges — the feed
+            # methods check self.publish and return
+            self._cycles = None
+            self._binds = None
+            return
+        self._cycles = scheduling_cycles_total.with_labels(scheduler_name)
+        self._binds = binds_total.with_labels(scheduler_name)
+        esc = escape_label_value(scheduler_name)
+        self._labels = f'scheduler="{esc}"' if scheduler_name else ""
+        ref = weakref.ref(self)
+
+        def arrival_rate(ref=ref):
+            live = ref()
+            # None = dead provider: pruned at the next scrape instead of
+            # a stale zero series (same discipline as the queue gauges)
+            return live.arrival_rate() if live is not None else None
+        REGISTRY.gauge_func(
+            "tpusched_pod_arrivals_per_second", arrival_rate,
+            "Pending-pod arrival rate over the rolling window, by "
+            "scheduler profile.", labels=self._labels)
+
+    def register_bind_backlog(self, backlog_fn) -> None:
+        """Expose the binding pool's queued-task depth as
+        ``tpusched_bind_pool_backlog``.  ``backlog_fn`` must already be
+        weakref-safe (return None when its target died)."""
+        if not self.publish:
+            return
+        REGISTRY.gauge_func(
+            "tpusched_bind_pool_backlog", backlog_fn,
+            "Binding tasks queued behind the bind-pool workers.",
+            labels=self._labels)
+
+    # -- feed points (hot path) ----------------------------------------------
+
+    def on_arrival(self) -> None:
+        if self.publish:
+            self._arrivals.append(self._clock())
+
+    def on_cycle(self) -> None:
+        if self.publish:
+            self._cycles.inc()
+
+    def on_bind(self) -> None:
+        if self.publish:
+            self._binds.inc()
+
+    # -- derived -------------------------------------------------------------
+
+    def arrival_rate(self) -> float:
+        """Arrivals per second over the rolling window.  For a window not
+        yet ``window_s`` old the divisor is the observed span (a storm's
+        first seconds read as their true rate, not diluted by the empty
+        prefix)."""
+        now = self._clock()
+        horizon = now - self._window_s
+        arrivals = list(self._arrivals)
+        recent = [t for t in arrivals if t >= horizon]
+        if not recent:
+            return 0.0
+        span = min(self._window_s, max(now - recent[0], 1e-3))
+        return len(recent) / span

@@ -153,8 +153,13 @@ class SchedulingQueue:
                  cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
                  clock=time.time,
                  initial_backoff_s: Optional[float] = None,
-                 max_backoff_s: Optional[float] = None):
+                 max_backoff_s: Optional[float] = None,
+                 arrival_cb: Optional[Callable[[], None]] = None):
         self._clock = clock
+        # throughput telemetry hook (obs/throughput.ThroughputTelemetry
+        # .on_arrival): fired once per NEW pending pod entering the queue —
+        # requeues/updates/activations are not arrivals
+        self._arrival_cb = arrival_cb or (lambda: None)
         # upstream podInitialBackoffSeconds / podMaxBackoffSeconds;
         # None = default, explicit 0 = retry immediately
         self._initial_backoff_s = (INITIAL_BACKOFF_S if initial_backoff_s
@@ -214,6 +219,7 @@ class SchedulingQueue:
             info = QueuedPodInfo(pod, self._clock)
             self._active.push(info)
             self._lock.notify_all()
+        self._arrival_cb()   # outside the lock: telemetry never extends it
 
     def update(self, pod: Pod) -> None:
         """Pod object changed while queued: refresh the copy wherever it is."""

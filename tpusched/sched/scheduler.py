@@ -29,7 +29,7 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_TPU_TOPOLOGY)
 from .. import obs as obs_mod
 from .. import trace
-from ..util import klog
+from ..util import klog, tracectx
 from ..util.equivalence import equivalence_key
 from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_bypasses,
@@ -305,6 +305,12 @@ class _BindingPool:
         for t in self._threads:
             t.start()
 
+    def backlog(self) -> int:
+        """Binding tasks queued but not yet picked up by a worker — the
+        first number to grow when Bind (API) throughput, not scheduling
+        throughput, is the bottleneck (tpusched_bind_pool_backlog)."""
+        return self._q.qsize()
+
     def submit(self, fn, abort, *args) -> None:
         """Queue a binding task. ``abort(*args)`` is the task's cheap
         failure path (unreserve + forget, no API calls): shutdown drains
@@ -440,10 +446,23 @@ class Scheduler:
                 cluster_event_map[name] = plugin.events_to_register()
             else:
                 cluster_event_map[name] = [WILDCARD_EVENT]
+        # Fleet throughput telemetry (tpusched/obs/throughput.py):
+        # binds/cycles counters + arrival-rate gauge, labeled by scheduler
+        # profile. Shadows get an inert publish=False shell — a what-if
+        # trial's simulated binds must never count into fleet binds/sec.
+        self._throughput = obs_mod.ThroughputTelemetry(
+            profile.scheduler_name, publish=telemetry)
+        # Hot-path sampling profiler: live schedulers make sure the
+        # process-global sampler is running (idempotent); shadows must not
+        # touch it — trial cycles publishing hot-path samples would read
+        # as live scheduler load in /debug/profile.
+        if telemetry:
+            obs_mod.ensure_profiler()
         self.queue = SchedulingQueue(
             self._fw.less, cluster_event_map, clock,
             initial_backoff_s=profile.pod_initial_backoff_s,
-            max_backoff_s=profile.pod_max_backoff_s)
+            max_backoff_s=profile.pod_max_backoff_s,
+            arrival_cb=self._throughput.on_arrival)
         # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
         # computed at scrape time from the live queue. weakref: the global
         # registry must not keep a stopped scheduler (and everything it
@@ -522,6 +541,14 @@ class Scheduler:
         # binding threads while waiting and at most pool-width while
         # draining, instead of 256 spawns + 256 blocked stacks per gang.
         self._bind_pool = _BindingPool(max(4, min(16, os.cpu_count() or 4)))
+        # bind-pool backlog gauge (weakref: the registry must not keep a
+        # stopped scheduler's pool alive; a dead ref prunes the series)
+        pool_ref = weakref.ref(self._bind_pool)
+
+        def bind_backlog(ref=pool_ref):
+            pool = ref()
+            return pool.backlog() if pool is not None else None
+        self._throughput.register_bind_backlog(bind_backlog)
         # gang-atomic bind rollback registry: gang full-name →
         # (abort monotonic ts, triggering pod key, reason). A binding task
         # dispatched BEFORE the abort must not commit its Bind; tasks from
@@ -758,6 +785,7 @@ class Scheduler:
         # defrag) must not inflate them with simulated cycles
         if self._telemetry:
             schedule_attempts.inc()
+            self._throughput.on_cycle()
             queue_wait_seconds.observe(max(0.0, start - info.timestamp))
         # flight recorder: one cycle trace per attempt, active on this
         # thread (klog/Events correlate via the id) until the cycle either
@@ -884,11 +912,16 @@ class Scheduler:
         span reuses the metric's perf_counter reads: tracing adds one tuple
         append to the serial scheduleOne thread, nothing more."""
         hist = extension_point_seconds.with_labels(point)
+        # profiler attribution: publish the active extension point for the
+        # sampling profiler (one thread-local list store each way — the
+        # same budget class as the perf_counter reads below)
+        prev_point = tracectx.set_point(point)
         t0 = time.perf_counter()
         try:
             return fn(*args)
         finally:
             dur = time.perf_counter() - t0
+            tracectx.set_point(prev_point)
             hist.observe(dur)
             tr = trace.current()
             if tr is not None:
@@ -1426,6 +1459,7 @@ class Scheduler:
             # (in-memory, near-zero-latency) binds would inflate
             # bind_total and pollute the e2e latency histogram
             bind_total.inc()
+            self._throughput.on_bind()
             e2e_scheduling_seconds.observe(self.clock() - cycle_start)
         # bound: the why-pending question is answered; feed the pod-e2e SLO
         # with the user-perceived interval (first enqueue → bind commit)

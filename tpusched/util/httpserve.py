@@ -21,6 +21,12 @@ rebuild's equivalent for its own binaries:
   ``?pod=`` / ``?gang=`` → rolling rejection aggregate + blocking plugin
   + suggested unblock signal; no argument → cluster top-blockers + SLO
   summary (also served by ``python -m tpusched.cmd.explain``)
+- ``/debug/profile``  the hot-path sampling profiler (tpusched/obs/
+  profiler): collapsed-stack (flamegraph-compatible) text of the rolling
+  aggregate; ``?seconds=N`` collects a fresh window first (blocking, capped
+  at 60 s); ``?format=json`` adds the top-N attribution table + sampler
+  stats.  The same top-N table rides along in ``/debug/flightrecorder``'s
+  health section.
 """
 from __future__ import annotations
 
@@ -83,7 +89,21 @@ class MetricsServer:
                 elif path == "/debug/gangs":
                     self._send_json({"gangs": server.recorder().gangs.dump()})
                 elif path == "/debug/flightrecorder":
-                    self._send_json(server.recorder().dump())
+                    dump = server.recorder().dump()
+                    # hot-path attribution rides along in the health
+                    # section: a wedged-or-slow scheduler is explainable
+                    # (and its cycle budget attributable) from ONE document
+                    from .. import obs
+                    # tpulint: disable=shadow-isolation — live debug
+                    # surface; shadow schedulers never mount a server
+                    prof = obs.default_profiler()
+                    if prof.running:
+                        dump.setdefault("health", {})["profiler"] = \
+                            prof.health()
+                    self._send_json(dump)
+                elif path == "/debug/profile":
+                    code, body, ctype = self._profile_payload(query)
+                    self._send(code, body, ctype)
                 elif path == "/debug/explain":
                     code, payload = self._explain_payload(query)
                     self._send(code, json.dumps(payload) + "\n",
@@ -94,6 +114,47 @@ class MetricsServer:
                         "application/json")
                 else:
                     self._send(404, "not found\n")
+
+            def _profile_payload(self, query: str):
+                """/debug/profile: collapsed stacks from the hot-path
+                sampling profiler.  ``?seconds=N`` collects a fresh
+                bounded window (blocking this handler thread — the server
+                is threading, so /metrics stays live); default serves the
+                rolling aggregate.  ``?format=json`` wraps collapsed text
+                with the top-N attribution table + sampler stats."""
+                from .. import obs
+                qs = urllib.parse.parse_qs(query)
+                # tpulint: disable=shadow-isolation — live debug surface,
+                # same contract as default_engine in _explain_payload
+                prof = obs.default_profiler()
+                if not prof.running:
+                    return (503, "profiler not running (TPUSCHED_PROFILE=0 "
+                                 "or no live scheduler constructed yet)\n",
+                            "text/plain")
+                try:
+                    seconds = float(qs["seconds"][0]) if "seconds" in qs \
+                        else 0.0
+                except ValueError:
+                    seconds = 0.0
+                if seconds > 0:
+                    agg = prof.capture(min(seconds, 60.0))
+                    if agg is None:
+                        return (429, "too many concurrent capture windows; "
+                                     "retry shortly or read the rolling "
+                                     "aggregate (no ?seconds=)\n",
+                                "text/plain")
+                    collapsed = agg.collapsed()
+                    top = agg.top_attribution(10)
+                    stats = agg.stats()
+                else:
+                    collapsed = prof.collapsed()
+                    top = prof.top_attribution(10)
+                    stats = prof.stats()
+                if qs.get("format", [""])[0] == "json":
+                    return (200, json.dumps(
+                        {"collapsed": collapsed, "top": top,
+                         "stats": stats}) + "\n", "application/json")
+                return 200, collapsed, "text/plain"
 
             def _explain_payload(self, query: str):
                 """/debug/explain: the why-pending diagnosis surface.

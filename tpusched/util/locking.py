@@ -35,19 +35,45 @@ constructed in debug mode).  Enable with ``set_debug(True)`` (or
 ``TPUSCHED_LOCK_DEBUG=1``) *before* constructing the objects to observe:
 instrumentation is decided at construction time, which is what keeps the
 off path free.
+
+A third, independent mode: CONTENTION TELEMETRY (``set_telemetry(True)`` /
+``TPUSCHED_LOCK_TELEMETRY=1``).  Distinct from debug mode — debug answers
+"is the lock *discipline* sound" in tests/soaks and may be arbitrarily
+strict; telemetry answers "where does wall time go under locks" in a
+running scheduler and must be cheap enough to leave on while profiling.
+In telemetry mode ``GuardedLock`` returns a ``_TelemetryLock`` that
+records contended-acquire waits and long holds into the
+``tpusched_lock_wait_seconds`` / ``tpusched_lock_hold_seconds`` histogram
+families (labeled by lock name) and publishes "blocked on <lock>" into the
+profiler's attribution context (util/tracectx) for the duration of a
+contended acquire.  Like debug mode, the choice is made at construction
+time, and with BOTH modes off the factory returns the plain stdlib lock —
+the structural zero-overhead contract is pinned in tests/test_locking.py.
+Debug wins when both are requested (the order recorder subsumes the
+telemetry use case in soaks).
 """
 from __future__ import annotations
 
 import os
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import tracectx
+
 __all__ = ["GuardedLock", "guarded_by", "thread_confined", "set_debug",
-           "debug_enabled", "recorder", "LockOrderError",
+           "debug_enabled", "set_telemetry", "telemetry_enabled",
+           "recorder", "LockOrderError",
            "GuardedStateError", "LockOrderRecorder"]
 
 _DEBUG = os.environ.get("TPUSCHED_LOCK_DEBUG", "") not in ("", "0", "false")
+_TELEMETRY = os.environ.get("TPUSCHED_LOCK_TELEMETRY", "") \
+    not in ("", "0", "false")
 _MAX_VIOLATIONS = 256          # bounded: a hot unguarded site must not OOM
+# holds shorter than this are not observed (a healthy hot path holds the
+# cache lock for ~µs thousands of times per second — recording every one
+# would cost more than the holds themselves and bury the pathological tail)
+LONG_HOLD_THRESHOLD_S = 0.001
 
 
 def set_debug(on: bool) -> bool:
@@ -60,6 +86,19 @@ def set_debug(on: bool) -> bool:
 
 def debug_enabled() -> bool:
     return _DEBUG
+
+
+def set_telemetry(on: bool) -> bool:
+    """Toggle contention-telemetry mode for locks constructed AFTER this
+    call (construction-time decision, same contract as ``set_debug``).
+    Returns the previous value (restore in finally)."""
+    global _TELEMETRY
+    prev, _TELEMETRY = _TELEMETRY, bool(on)
+    return prev
+
+
+def telemetry_enabled() -> bool:
+    return _TELEMETRY
 
 
 class LockOrderError(RuntimeError):
@@ -302,14 +341,129 @@ class _InstrumentedLock:
         self._rec.on_acquire(self.name, id(self))
 
 
+class _TelemetryLock:
+    """Contention-telemetry lock (telemetry mode): a (R)Lock that records
+    contended-acquire waits and long holds into the ``tpusched_lock_*``
+    histogram families, and publishes "blocked on <name>" into the
+    profiler's attribution context while it waits.
+
+    Cost model: the uncontended path pays one extra non-blocking
+    ``acquire(False)`` try plus a ``perf_counter`` read — the slow
+    (contended) path is the only one that touches a histogram, so a
+    healthy lock costs nanoseconds and a fought-over one tells on itself.
+    Implements the private Condition protocol so
+    ``threading.Condition(GuardedLock(...))`` keeps hold accounting exact
+    across ``wait()`` (the wait itself is NOT a hold)."""
+
+    __slots__ = ("name", "_inner", "_reentrant", "_owner", "_count",
+                 "_hold_t0", "_wait_hist", "_hold_hist")
+
+    def __init__(self, name: str, reentrant: bool):
+        from .metrics import lock_hold_seconds, lock_wait_seconds
+        self.name = name
+        self._reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._owner: Optional[int] = None
+        self._count = 0
+        self._hold_t0 = 0.0
+        self._wait_hist = lock_wait_seconds.with_labels(name)
+        self._hold_hist = lock_hold_seconds.with_labels(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            self._inner.acquire()
+            self._count += 1
+            return True
+        if self._inner.acquire(False):          # uncontended fast path
+            got = True
+        elif not blocking:
+            return False
+        else:
+            t0 = _time.perf_counter()
+            prev = tracectx.set_lock(self.name)
+            try:
+                got = self._inner.acquire(True, timeout)
+            finally:
+                tracectx.set_lock(prev)
+            if got:
+                self._wait_hist.observe(_time.perf_counter() - t0)
+        if got:
+            self._owner = me
+            self._count = 1
+            self._hold_t0 = _time.perf_counter()
+        return got
+
+    def release(self) -> None:
+        if self._count <= 1:
+            held = _time.perf_counter() - self._hold_t0
+            self._owner = None
+            self._count = 0
+            if held >= LONG_HOLD_THRESHOLD_S:
+                self._hold_hist.observe(held)
+        else:
+            self._count -= 1
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def is_held(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # Condition protocol ------------------------------------------------------
+
+    def _is_owned(self) -> bool:
+        return self.is_held()
+
+    def _release_save(self):
+        """Full release for Condition.wait: the hold ends here (the wait
+        is queue idle time, not a hold — charging it would make every
+        consumer pop() look like a pathological holder)."""
+        held = _time.perf_counter() - self._hold_t0
+        count, self._count = self._count, 0
+        self._owner = None
+        if held >= LONG_HOLD_THRESHOLD_S:
+            self._hold_hist.observe(held)
+        for _ in range(count - 1):
+            self._inner.release()
+        self._inner.release()
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        if not self._inner.acquire(False):      # contended reacquire after
+            t0 = _time.perf_counter()           # notify: a real wait
+            prev = tracectx.set_lock(self.name)
+            try:
+                self._inner.acquire()
+            finally:
+                tracectx.set_lock(prev)
+            self._wait_hist.observe(_time.perf_counter() - t0)
+        for _ in range(count - 1):
+            self._inner.acquire()
+        self._owner = threading.get_ident()
+        self._count = count
+        self._hold_t0 = _time.perf_counter()
+
+
 def GuardedLock(name: str, reentrant: bool = True):  # noqa: N802 — ctor-like
-    """A named lock participating in lock discipline.  Debug mode off (the
-    default): a plain ``threading.RLock``/``Lock`` — zero overhead, byte-
-    identical hot path.  Debug mode on: an instrumented lock feeding the
-    acquisition-order recorder and answering ownership queries for the
-    guarded-state proxies."""
+    """A named lock participating in lock discipline.  Debug and telemetry
+    modes off (the default): a plain ``threading.RLock``/``Lock`` — zero
+    overhead, byte-identical hot path.  Debug mode on: an instrumented lock
+    feeding the acquisition-order recorder and answering ownership queries
+    for the guarded-state proxies.  Telemetry mode on (and debug off): a
+    contention-telemetry lock feeding the ``tpusched_lock_*`` histograms."""
     if _DEBUG:
         return _InstrumentedLock(name, reentrant)
+    if _TELEMETRY:
+        return _TelemetryLock(name, reentrant)
     return threading.RLock() if reentrant else threading.Lock()
 
 

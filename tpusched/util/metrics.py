@@ -520,3 +520,45 @@ plugin_execution_seconds = REGISTRY.histogram_vec(
     "tpusched_plugin_execution_duration_seconds",
     ("plugin", "extension_point"),
     "Per-invocation plugin latency at the cold extension points.")
+
+# Lock-contention telemetry (util/locking.py telemetry mode — opt-in,
+# distinct from debug mode, which stays zero-overhead when off). Buckets
+# start in the microseconds: the locks worth watching (cache, queue,
+# recorder) are held for µs–ms, and the default duration buckets would
+# collapse every observation into the first bucket. wait counts CONTENDED
+# acquires only (the uncontended fast path never observes — its count would
+# drown the signal); hold counts holds longer than the long-hold threshold.
+_LOCK_BUCKETS = (0.000001, 0.000005, 0.00001, 0.00005, 0.0001, 0.0005,
+                 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+lock_wait_seconds = REGISTRY.histogram_vec(
+    "tpusched_lock_wait_seconds", ("lock",),
+    "Contended-acquire wait per named lock (telemetry mode only).",
+    buckets=_LOCK_BUCKETS)
+lock_hold_seconds = REGISTRY.histogram_vec(
+    "tpusched_lock_hold_seconds", ("lock",),
+    "Long lock holds per named lock (telemetry mode only; holds above "
+    "the long-hold threshold).", buckets=_LOCK_BUCKETS)
+
+# Fleet throughput telemetry (tpusched/obs/throughput.py, fed by the
+# scheduler and _BindingPool). These are the SUSTAINED-throughput counters
+# the arrival-storm bench and the sharded-core work (ROADMAP item 1) rate
+# against: rate(tpusched_binds_total[1m]) is the fleet's binds/sec.
+# Labeled by scheduler profile so one process hosting several profiles
+# (HA, what-if planners run under fresh names) attributes throughput
+# correctly; .value() is the process total. They deliberately coexist
+# with the older unlabeled tpusched_bind_total/tpusched_schedule_attempts_
+# total (dashboards already scrape those; renaming a scraped family is a
+# breaking change this repo does not make).
+binds_total = REGISTRY.counter_vec(
+    "tpusched_binds_total", ("scheduler",),
+    "Successful bind commits, by scheduler profile.")
+scheduling_cycles_total = REGISTRY.counter_vec(
+    "tpusched_scheduling_cycles_total", ("scheduler",),
+    "Scheduling cycles started, by scheduler profile.")
+
+# Sampling profiler self-accounting (tpusched/obs/profiler.py): the
+# sampler's own sample count — the denominator for every attribution
+# share, and the prof-smoke gate's liveness witness.
+profiler_samples_total = REGISTRY.counter(
+    "tpusched_profiler_samples_total",
+    "Stack samples taken by the hot-path sampling profiler.")

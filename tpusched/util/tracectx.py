@@ -1,4 +1,4 @@
-"""Thread-local trace-id correlation context.
+"""Thread-local trace-id correlation + cross-thread attribution context.
 
 The flight recorder (tpusched/trace) activates a cycle trace id here for the
 duration of a scheduling/binding cycle; klog lines and API-server Events
@@ -6,14 +6,35 @@ emitted inside the cycle pick it up so an operator can jump from a
 ``FailedScheduling`` event or a log line straight to the matching
 ``/debug/flightrecorder`` entry.
 
-Deliberately dependency-free (stdlib only): both ``util.klog`` and
-``tpusched.trace`` import it, so it must sit below both.
+The second half is the *attribution context* the sampling profiler
+(tpusched/obs/profiler.py) reads: each scheduler-owned thread publishes
+"what am I doing right now" — the active framework extension point, the
+plugin whose body is running, and the lock it is blocked acquiring — into a
+slot the sampler thread can read WITHOUT stopping the world.  The write
+path is deliberately the cheapest thing Python can do (one thread-local
+getattr plus a list-item store, both atomic under the GIL); the sampler
+pays the synchronization cost by copying, so the hot scheduling path never
+takes a lock to stay attributable.
+
+Deliberately dependency-free (stdlib only): ``util.klog``,
+``util.locking`` and ``tpusched.trace`` import it, so it must sit below
+all three.
 """
 from __future__ import annotations
 
 import threading
+from typing import Dict, Tuple
 
 _tls = threading.local()
+
+# thread ident → [extension_point, plugin, lock] — the per-thread slot is a
+# mutable list so the hot path stores into an already-published object and
+# the sampler reads whatever triple is current.  Keys are pruned by the
+# profiler against the live sys._current_frames() set (ident reuse after a
+# thread dies merely re-purposes a slot, which is fine for sampling).
+_attrs: Dict[int, list] = {}
+
+_POINT, _PLUGIN, _LOCK = 0, 1, 2
 
 
 def set(trace_id: str) -> str:  # noqa: A001 — klog-style tiny API
@@ -27,3 +48,64 @@ def set(trace_id: str) -> str:  # noqa: A001 — klog-style tiny API
 def get() -> str:
     """Current thread's trace id, or '' outside any traced cycle."""
     return getattr(_tls, "id", "")
+
+
+# -- attribution context (read by the sampling profiler) ----------------------
+
+def _slot() -> list:
+    s = getattr(_tls, "attr", None)
+    if s is None:
+        s = _tls.attr = ["", "", ""]
+    # re-assert registration on EVERY call (one GIL-atomic dict store of an
+    # existing key): the profiler's prune races threads that started after
+    # its frames snapshot — a pruned-but-live thread must re-register at
+    # its next write, or its samples stay unattributed for its lifetime
+    _attrs[threading.get_ident()] = s
+    return s
+
+
+def set_point(point: str) -> str:
+    """Publish the framework extension point this thread is executing
+    (``''`` outside any point).  Returns the previous value so nested /
+    re-entrant sites restore instead of clearing."""
+    s = _slot()
+    prev = s[_POINT]
+    s[_POINT] = point
+    return prev
+
+
+def set_plugin(plugin: str) -> str:
+    """Publish the plugin whose body this thread is executing."""
+    s = _slot()
+    prev = s[_PLUGIN]
+    s[_PLUGIN] = plugin
+    return prev
+
+
+def set_lock(name: str) -> str:
+    """Publish the lock this thread is currently BLOCKED acquiring
+    (GuardedLock telemetry mode sets it around the contended-acquire slow
+    path only — an uncontended acquire never writes here)."""
+    s = _slot()
+    prev = s[_LOCK]
+    s[_LOCK] = name
+    return prev
+
+
+def attribution(ident: int) -> Tuple[str, str, str]:
+    """(extension_point, plugin, lock) last published by thread ``ident``,
+    or empty strings.  Sampler-side: tolerates the slot mutating while read
+    (each element is an atomic load; a torn triple is one misattributed
+    sample, not an error)."""
+    s = _attrs.get(ident)
+    if s is None:
+        return ("", "", "")
+    return (s[_POINT], s[_PLUGIN], s[_LOCK])
+
+
+def prune_attributions(live_idents) -> None:
+    """Drop slots for threads no longer alive (profiler housekeeping —
+    called with the ident set of sys._current_frames())."""
+    for ident in list(_attrs):
+        if ident not in live_idents:
+            _attrs.pop(ident, None)
