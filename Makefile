@@ -85,8 +85,23 @@ obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs_explain.py \
 		tests/test_metrics_conformance.py -q -p no:cacheprovider
 
+# Race-smoke (the systematic-concurrency gate, part of the tier1 flow):
+# the tpuverify interleaving explorer runs its bounded schedule budget
+# (deterministic seeds, < 60 s) over the critical-section pairs the
+# sharded core will stress — equivcache arming guard vs. foreign
+# mutations, cache assume/confirm/expire, queue.pop vs. informer moves,
+# informer delete vs. resync, binding-pool shutdown vs. late permits,
+# Condition hand-off — asserting scenario invariants + zero lock-
+# discipline violations (C7) on every explored schedule, plus the
+# seeded-bug meta-test (the explorer must FIND a deliberate atomicity
+# bug and its artifact must replay deterministically via cmd.replay).
+.PHONY: race-smoke
+race-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_verify_scenarios.py \
+		-q -p no:cacheprovider
+
 .PHONY: tier1
-tier1: lint chaos-smoke trace-smoke obs-smoke prof-smoke
+tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

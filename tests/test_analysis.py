@@ -569,5 +569,7 @@ def test_all_advertised_rules_are_registered():
     expected = {"naked-api-calls", "node-health-filters", "metrics-names",
                 "structured-logging", "exception-taxonomy",
                 "shadow-isolation", "monotonic-clock", "thread-hygiene",
-                "lock-discipline", SUPPRESSION_HYGIENE}
+                "lock-discipline", "atomicity-violation",
+                "snapshot-discipline", "locked-callgraph",
+                SUPPRESSION_HYGIENE}
     assert expected == set(rule_names())

@@ -1,0 +1,427 @@
+"""tpulint flow-sensitive rules (atomicity-violation, snapshot-discipline)
++ the interprocedural locked-callgraph rule over the lazy per-module call
+graph + SARIF output round-trip."""
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from tpusched.analysis import Runner
+from tpusched.analysis.core import FileContext
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_snippet(tmp_path, relpath, source, rules=None):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return Runner(tmp_path, rules).run([f])
+
+
+def rules_found(report):
+    return [f.rule for f in report.findings]
+
+
+# -- atomicity-violation -------------------------------------------------------
+
+ATOMICITY_BAD = """
+    from tpusched.util.locking import GuardedLock, guarded_by
+
+    @guarded_by("_lock", "_count")
+    class C:
+        def bump(self):
+            with self._lock:
+                v = self._count
+            with self._lock:
+                self._count = v + 1
+"""
+
+ATOMICITY_GOOD_ONE_REGION = """
+    from tpusched.util.locking import GuardedLock, guarded_by
+
+    @guarded_by("_lock", "_count")
+    class C:
+        def bump(self):
+            with self._lock:
+                v = self._count
+                self._count = v + 1
+"""
+
+ATOMICITY_GOOD_REBOUND = """
+    from tpusched.util.locking import GuardedLock, guarded_by
+
+    @guarded_by("_lock", "_count")
+    class C:
+        def bump(self):
+            with self._lock:
+                v = self._count
+            v = 0
+            with self._lock:
+                self._count = v + 1
+"""
+
+
+def test_atomicity_read_write_across_release_flagged(tmp_path):
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", ATOMICITY_BAD,
+                    ["atomicity-violation"])
+    assert rules_found(r) == ["atomicity-violation"]
+    assert "check-then-act" in r.findings[0].message
+
+
+def test_atomicity_single_region_clean(tmp_path):
+    r = run_snippet(tmp_path, "tpusched/sched/x.py",
+                    ATOMICITY_GOOD_ONE_REGION, ["atomicity-violation"])
+    assert r.findings == []
+
+
+def test_atomicity_rebound_local_clean(tmp_path):
+    """A local overwritten from a non-guarded source between the regions
+    no longer carries stale guarded state."""
+    r = run_snippet(tmp_path, "tpusched/sched/x.py",
+                    ATOMICITY_GOOD_REBOUND, ["atomicity-violation"])
+    assert r.findings == []
+
+
+def test_atomicity_mutator_call_with_stale_operand_flagged(tmp_path):
+    src = """
+        from tpusched.util.locking import guarded_by
+
+        @guarded_by("_lock", "_pods", "_keys")
+        class C:
+            def move(self):
+                with self._lock:
+                    k, v = self._pods.popitem()
+                with self._lock:
+                    self._keys.append(k)
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["atomicity-violation"])
+    assert rules_found(r) == ["atomicity-violation"]
+
+
+def test_atomicity_annotated_assignments_seen(tmp_path):
+    """Type-annotating the local (or the write) must not bypass the rule."""
+    src = """
+        from tpusched.util.locking import guarded_by
+
+        @guarded_by("_lock", "_count")
+        class C:
+            def bump(self):
+                with self._lock:
+                    v: int = self._count
+                with self._lock:
+                    self._count = v + 1
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["atomicity-violation"])
+    assert rules_found(r) == ["atomicity-violation"]
+
+
+def test_atomicity_locked_methods_exempt(tmp_path):
+    src = """
+        from tpusched.util.locking import guarded_by
+
+        @guarded_by("_lock", "_count")
+        class C:
+            def _bump_locked(self):
+                v = self._count
+                self._count = v + 1
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["atomicity-violation"])
+    assert r.findings == []
+
+
+# -- snapshot-discipline -------------------------------------------------------
+
+
+def test_snapshot_call_outside_dispatch_flagged(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                return sched.cache.snapshot()
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+    assert "peek_snapshot" in r.findings[0].message
+    # the same call in dispatch-owned code is the sanctioned path
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["snapshot-discipline"])
+    assert r.findings == []
+
+
+def test_non_cache_snapshot_not_flagged(tmp_path):
+    src = """
+        class H:
+            def health(self):
+                return self._degraded.snapshot()
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert r.findings == []
+
+
+def test_peek_snapshot_mutation_flagged(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                snap = sched.cache.peek_snapshot()
+                snap.clear()
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+    assert "read-only" in r.findings[0].message
+
+
+def test_peek_snapshot_escape_to_self_flagged(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                snap = sched.cache.peek_snapshot()
+                self._snap = snap
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+    assert "epoch pin" in r.findings[0].message
+
+
+def test_peek_snapshot_annotated_binding_tracked(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                snap: object = sched.cache.peek_snapshot()
+                snap.clear()
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+
+
+def test_peek_snapshot_return_escape_flagged(tmp_path):
+    src = """
+        class Collector:
+            def grab(self, sched):
+                snap = sched.cache.peek_snapshot()
+                return snap
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+    assert "escapes the function" in r.findings[0].message
+
+
+def test_peek_snapshot_tracking_is_order_and_rebind_sensitive(tmp_path):
+    """A name mutated BEFORE it ever holds a snapshot, or AFTER being
+    re-bound to something else, is not a snapshot — no bogus
+    suppressions required."""
+    src = """
+        class Collector:
+            def collect(self, sched):
+                out = []
+                out.append(1)                    # plain list: fine
+                out = sched.cache.peek_snapshot()
+                out = transform(out)             # re-bound: snapshot gone
+                return out
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert r.findings == []
+
+
+def test_peek_snapshot_container_escapes_flagged(tmp_path):
+    """Escape through a container on self — subscript store or mutator
+    call — is the same epoch-laundering as a direct attribute store."""
+    sub = """
+        class Collector:
+            def collect(self, sched, k):
+                snap = sched.cache.peek_snapshot()
+                self._saved[k] = snap
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", sub,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+    app = """
+        class Collector:
+            def collect(self, sched):
+                snap = sched.cache.peek_snapshot()
+                self._history.append(snap)
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", app,
+                    ["snapshot-discipline"])
+    assert rules_found(r) == ["snapshot-discipline"]
+
+
+def test_peek_snapshot_tuple_rebind_untracks(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                snap = sched.cache.peek_snapshot()
+                snap, extra = [], 0
+                snap.append(1)
+                return snap
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert r.findings == []
+
+
+def test_peek_snapshot_read_only_use_clean(tmp_path):
+    src = """
+        class Collector:
+            def collect(self, sched):
+                snap = sched.cache.peek_snapshot()
+                if snap is None:
+                    return 0
+                return sum(1 for info in snap.list() for p in info.pods)
+    """
+    r = run_snippet(tmp_path, "tpusched/obs/x.py", src,
+                    ["snapshot-discipline"])
+    assert r.findings == []
+
+
+# -- locked-callgraph ----------------------------------------------------------
+
+CALLGRAPH_SRC = """
+    from tpusched.util.locking import guarded_by
+
+    @guarded_by("_lock", "_pods")
+    class C:
+        def _drop_locked(self, k):
+            self._pods.pop(k, None)
+
+        def good_with(self, k):
+            with self._lock:
+                self._drop_locked(k)
+
+        def _also_locked(self, k):
+            self._drop_locked(k)
+
+        def bad_unguarded(self, k):
+            self._drop_locked(k)
+
+        def good_cv(self, k):
+            with self._cond:
+                self._drop_locked(k)
+
+        def good_acquiring_helper(self, k):
+            with self._locked():
+                self._read(k)
+"""
+
+
+def test_locked_callgraph(tmp_path):
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", CALLGRAPH_SRC,
+                    ["locked-callgraph"])
+    assert [(f.rule, "bad_unguarded" in f.message) for f in r.findings] \
+        == [("locked-callgraph", True)]
+
+
+def test_locked_callgraph_scoped_to_tpusched(tmp_path):
+    r = run_snippet(tmp_path, "hack/x.py", CALLGRAPH_SRC,
+                    ["locked-callgraph"])
+    assert r.findings == []
+
+
+def test_call_graph_is_lazy(tmp_path):
+    """--changed-only latency contract: building a FileContext never pays
+    for the call graph; only a rule that asks for it does."""
+    f = tmp_path / "m.py"
+    f.write_text("class C:\n    def a(self):\n        self.b()\n")
+    ctx = FileContext(tmp_path, f)
+    assert ctx._self_call_graph is None
+    sites = ctx.self_call_graph
+    assert [(s.caller, s.callee) for s in sites] == [("a", "b")]
+    assert ctx._self_call_graph is not None      # cached after first use
+
+
+# -- SARIF ---------------------------------------------------------------------
+
+
+def _validate_sarif(doc):
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    assert len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tpulint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    for r in driver["rules"]:
+        assert isinstance(r["shortDescription"]["text"], str)
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] == "error"
+        assert isinstance(res["message"]["text"], str) \
+            and res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+    assert isinstance(run["invocations"][0]["executionSuccessful"], bool)
+    return run
+
+
+def test_sarif_round_trip(tmp_path):
+    src = """
+        from tpusched.util.locking import guarded_by
+
+        @guarded_by("_lock", "_count")
+        class C:
+            def bump(self):
+                with self._lock:
+                    v = self._count
+                with self._lock:
+                    self._count = v + 1
+
+            def ok(self):
+                # tpulint: disable=atomicity-violation — test fixture reason
+                with self._lock:
+                    w = self._count
+                return w
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["atomicity-violation"])
+    doc = json.loads(r.to_sarif())
+    run = _validate_sarif(doc)
+    unsuppressed = [x for x in run["results"] if "suppressions" not in x]
+    assert len(unsuppressed) == 1
+    assert unsuppressed[0]["ruleId"] == "atomicity-violation"
+
+
+def test_sarif_suppressions_carry_justifications(tmp_path):
+    src = """
+        import time
+
+        def f():
+            return time.time()  # tpulint: disable=monotonic-clock — fixture
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/x.py", src,
+                    ["monotonic-clock"])
+    assert r.findings == []
+    doc = json.loads(r.to_sarif())
+    run = _validate_sarif(doc)
+    sup = [x for x in run["results"] if "suppressions" in x]
+    assert len(sup) == 1
+    assert sup[0]["suppressions"][0]["justification"] == "fixture"
+    assert sup[0]["suppressions"][0]["kind"] == "inSource"
+
+
+def test_sarif_cli(tmp_path):
+    import subprocess
+    import sys
+    p = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.lint", "--format=sarif",
+         "tpusched/analysis/"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert p.returncode in (0, 1), p.stderr
+    _validate_sarif(json.loads(p.stdout))
+    # --json and --format=sarif together is a usage error
+    p = subprocess.run(
+        [sys.executable, "-m", "tpusched.cmd.lint", "--json",
+         "--format=sarif"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert p.returncode == 2

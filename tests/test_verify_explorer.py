@@ -1,0 +1,349 @@
+"""tpuverify kernel + explorer unit tests: cooperative determinism,
+strategies, trace canonicalization (DPOR-style pruning), schedule
+artifacts, deterministic replay, divergence detection, and the
+Condition-wait hand-off accounting (the C7-stays-exact satellite)."""
+from __future__ import annotations
+
+import json
+import random
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tpusched import verify
+from tpusched.util import locking
+from tpusched.verify.explorer import canonical_trace_key
+from tpusched.verify.scenarios import Scenario
+
+
+@pytest.fixture(autouse=True)
+def _clean_lock_state():
+    """The explorer saves/restores debug mode and the hook itself, but a
+    crashed assertion must not leak state into unrelated tests."""
+    yield
+    locking.set_verify_hook(None)
+    locking.set_debug(False)
+    locking.recorder().reset()
+
+
+EX = verify.Explorer()
+
+
+# -- soundness + non-vacuity on the selfchecks --------------------------------
+
+
+def test_atomic_selfcheck_never_fails():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-atomic-update"],
+                     seed=11, schedules=40)
+    assert rep.failures == 0, rep.first_failure
+
+
+def test_lost_update_found_and_artifact_captured():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                     seed=11, schedules=40)
+    assert rep.failures == 1
+    art = rep.first_failure
+    assert art is not None
+    verify.validate_artifact(art)
+    assert "lost update" in art["failure"]
+    assert art["decisions"]                      # a real decision list
+
+
+def test_broken_arming_guard_found():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-broken-arming"],
+                     seed=5, schedules=80)
+    assert rep.failures == 1, "explorer missed the seeded arming-guard bug"
+    assert "arming guard" in rep.first_failure["failure"]
+
+
+def test_explored_schedules_are_instrumented():
+    """Non-vacuity: the recorder actually observed acquisitions — the
+    yield points were live, not silently skipped."""
+    res = EX.run_schedule(verify.SCENARIOS["selfcheck-atomic-update"](),
+                          verify.RandomWalk(random.Random(1)))
+    assert res.ok and res.acquires > 0
+
+
+# -- determinism + replay ------------------------------------------------------
+
+
+def test_same_seed_same_schedules():
+    a = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                   seed=23, schedules=12, stop_on_failure=False)
+    b = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                   seed=23, schedules=12, stop_on_failure=False)
+    assert a.failures == b.failures
+    assert a.distinct_traces == b.distinct_traces
+    assert (a.first_failure is None) == (b.first_failure is None)
+    if a.first_failure:
+        assert a.first_failure["decisions"] == b.first_failure["decisions"]
+
+
+def test_replay_reproduces_failure_twice():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                     seed=3, schedules=40)
+    art = rep.first_failure
+    assert art is not None
+    for _ in range(2):                       # determinism, not luck
+        res = verify.replay_artifact(art)
+        assert not res.ok
+        assert res.failure == art["failure"]
+
+
+def test_replay_of_clean_schedule_stays_clean():
+    res = EX.run_schedule(verify.SCENARIOS["selfcheck-atomic-update"](),
+                          verify.RandomWalk(random.Random(9)))
+    assert res.ok
+    art = verify.make_artifact("selfcheck-atomic-update", seed="9",
+                               strategy="random-walk",
+                               decisions=res.decisions, failure=None,
+                               steps=res.steps)
+    rep = verify.replay_artifact(art)
+    assert rep.ok and rep.failure is None
+
+
+def test_replay_divergence_detected():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                     seed=3, schedules=40)
+    art = dict(rep.first_failure)
+    art["decisions"] = art["decisions"][:1]     # truncated: must diverge
+    res = verify.replay_artifact(art)
+    assert not res.ok
+    assert "ReplayDivergence" in res.failure
+
+
+def test_timeout_wake_decision_recorded_and_replayed():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-timeout-wake"],
+                     seed=1, schedules=4, stop_on_failure=False)
+    assert rep.failures == 0
+    res = EX.run_schedule(verify.SCENARIOS["selfcheck-timeout-wake"](),
+                          verify.RandomWalk(random.Random(1)))
+    assert res.ok
+    assert any(d.startswith("~") for d in res.decisions), (
+        "a timed wait with no notifier must be woken by an explicit "
+        "timeout-fire decision")
+    art = verify.make_artifact("selfcheck-timeout-wake", seed="1",
+                               strategy="random-walk",
+                               decisions=res.decisions, failure=None,
+                               steps=res.steps)
+    assert verify.replay_artifact(art).ok
+
+
+# -- artifact schema -----------------------------------------------------------
+
+
+def test_artifact_round_trip(tmp_path):
+    art = verify.make_artifact("s", seed="0:1", strategy="pct",
+                               decisions=["T0", "~T1"], failure="boom",
+                               steps=7)
+    path = tmp_path / "a.json"
+    verify.dump_artifact(art, str(path))
+    loaded = verify.load_artifact(str(path))
+    assert loaded == art
+    json.loads(path.read_text())                 # plain JSON on disk
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda a: a.update(version=99),
+    lambda a: a.pop("scenario"),
+    lambda a: a.update(decisions="T0"),
+    lambda a: a.update(decisions=[1, 2]),
+    lambda a: a.update(failure=17),
+    lambda a: a.update(steps="many"),
+])
+def test_artifact_validation_rejects(mutate):
+    art = verify.make_artifact("s", seed="0", strategy="pct",
+                               decisions=["T0"], failure=None, steps=1)
+    mutate(art)
+    with pytest.raises(ValueError):
+        verify.validate_artifact(art)
+
+
+# -- trace canonicalization ----------------------------------------------------
+
+
+def test_independent_ops_commute_dependent_do_not():
+    a = [("T0", "acquire", "L1"), ("T1", "acquire", "L2")]
+    swapped = list(reversed(a))
+    assert canonical_trace_key(a) == canonical_trace_key(swapped)
+    dep = [("T0", "acquire", "L1"), ("T1", "acquire", "L1")]
+    dep_swapped = list(reversed(dep))
+    assert canonical_trace_key(dep) != canonical_trace_key(dep_swapped)
+
+
+def test_program_order_is_dependence():
+    t = [("T0", "acquire", "L1"), ("T0", "release", "L1")]
+    assert canonical_trace_key(t) != canonical_trace_key(list(reversed(t)))
+
+
+def test_exploration_prunes_equivalent_schedules():
+    rep = EX.explore(verify.SCENARIOS["selfcheck-atomic-update"],
+                     seed=2, schedules=30, stop_on_failure=False)
+    assert rep.schedules == 30
+    assert rep.distinct_traces < rep.schedules   # dedupe really happened
+    assert rep.pruned == rep.schedules - rep.distinct_traces
+
+
+def test_pct_change_points_fire_within_horizon():
+    """PCT's whole value over a random walk is the mid-schedule
+    preemption; with change points sampled inside the schedule horizon
+    the favored worker must actually lose the CPU at least once."""
+    strat = verify.PCT(random.Random(4), depth=3, horizon=10)
+    picks = [strat.choose(["T0", "T1"]) for _ in range(12)]
+    assert len(set(picks)) == 2, (
+        f"no preemption in 12 steps with horizon=10: {picks} — PCT "
+        f"degenerated to fixed priorities")
+
+
+# -- modeled deadlock detection ------------------------------------------------
+
+
+class _ABBADeadlock(Scenario):
+    name = "test-abba"
+
+    def setup(self):
+        ctx = SimpleNamespace()
+        ctx.a = locking.GuardedLock("verify.test.A")
+        ctx.b = locking.GuardedLock("verify.test.B")
+        return ctx
+
+    def threads(self, ctx):
+        def ab():
+            with ctx.a:
+                with ctx.b:
+                    pass
+
+        def ba():
+            with ctx.b:
+                with ctx.a:
+                    pass
+
+        return [ab, ba]
+
+
+class _NonReentrantSelfDeadlock(Scenario):
+    name = "test-self-deadlock"
+
+    def setup(self):
+        ctx = SimpleNamespace()
+        ctx.lock = locking.GuardedLock("verify.test.NR", reentrant=False)
+        return ctx
+
+    def threads(self, ctx):
+        def nest():
+            with ctx.lock:
+                with ctx.lock:
+                    pass
+
+        return [nest]
+
+    def check(self, ctx):
+        raise AssertionError("schedule completed despite a self-deadlock")
+
+
+def test_nonreentrant_self_reacquire_reported_not_hung():
+    """Re-acquiring a non-reentrant lock the worker already holds must be
+    reported as a modeled self-deadlock immediately — not granted by the
+    model only to hang on the real lock for the whole hang timeout."""
+    t0 = time.monotonic()
+    res = EX.run_schedule(_NonReentrantSelfDeadlock(),
+                          verify.RandomWalk(random.Random(0)))
+    assert not res.ok
+    assert "self-deadlock" in res.failure, res.failure
+    assert time.monotonic() - t0 < 5.0, "burned the hang timeout"
+
+
+def test_abba_reported_as_deadlock_or_cycle():
+    """Every schedule either interleaves into the modeled deadlock or
+    records both edges — the lock-order cycle.  Nothing hangs."""
+    failures = []
+    for i in range(20):
+        res = EX.run_schedule(_ABBADeadlock(),
+                              verify.RandomWalk(random.Random(i)))
+        if not res.ok:
+            failures.append(res.failure)
+    assert failures, "AB/BA never detected across 20 schedules"
+    assert all("deadlock" in f or "cycle" in f for f in failures), failures
+
+
+# -- Condition hand-off accounting (the C7 satellite) -------------------------
+
+
+def _flatten(trace_key):
+    return [op for layer in trace_key for op in layer]
+
+
+def test_cond_handoff_accounting_survives_forced_interleavings():
+    """A notify delivered while the waiter sits between release and
+    re-acquire must not corrupt the per-thread lock-stack accounting:
+    every schedule stays violation-free AND at least one explored
+    schedule actually witnesses the wait → notify hand-off (the C7
+    witness is non-vacuous)."""
+    witnessed = 0
+    for i in range(24):
+        res = EX.run_schedule(verify.SCENARIOS["cond-handoff"](),
+                              verify.RandomWalk(random.Random(i)))
+        assert res.ok, res.failure
+        assert res.acquires > 0
+        ops = _flatten(res.trace_key)
+        if any(k == "wait" for _, k, _ in ops) \
+                and any(k == "notify" for _, k, _ in ops):
+            witnessed += 1
+    assert witnessed > 0, (
+        "no explored schedule exercised the wait/notify hand-off — "
+        "the regression this test pins would go untested")
+
+
+class _SingleNotify(Scenario):
+    """Two timed waiters, ONE notify(1): the model must wake at most one
+    waiter by notify (FIFO, like the stdlib waiter list) — modeling
+    notify(1) as notify-all would wake both."""
+
+    name = "test-single-notify"
+
+    def setup(self):
+        ctx = SimpleNamespace(wakes=[])
+        ctx.lock = locking.GuardedLock("verify.test.n1")
+        ctx.cond = locking.GuardedCondition(ctx.lock)
+        return ctx
+
+    def threads(self, ctx):
+        def waiter():
+            with ctx.cond:
+                ctx.wakes.append(bool(ctx.cond.wait(0.01)))
+
+        def notifier():
+            with ctx.cond:
+                ctx.cond.notify(1)
+
+        return [waiter, waiter, notifier]
+
+    def check(self, ctx):
+        assert len(ctx.wakes) == 2
+        assert ctx.wakes.count(True) <= 1, (
+            f"notify(1) woke {ctx.wakes.count(True)} waiters — modeled "
+            f"as notify_all")
+
+
+def test_notify_one_wakes_at_most_one_waiter():
+    single_wake_witnessed = 0
+    for i in range(24):
+        res = EX.run_schedule(_SingleNotify(),
+                              verify.RandomWalk(random.Random(i)))
+        assert res.ok, res.failure
+        ops = _flatten(res.trace_key)
+        if sum(1 for _, k, _ in ops if k == "wait") == 2 \
+                and any(k == "notify" for _, k, _ in ops):
+            single_wake_witnessed += 1
+    assert single_wake_witnessed > 0, (
+        "no schedule had both waiters parked when notify(1) fired — "
+        "the single-wake path went untested")
+
+
+def test_guarded_condition_plain_without_explorer():
+    """Off the explorer, GuardedCondition is a stdlib Condition: wait
+    times out for real, notify wakes a real waiter."""
+    cond = locking.GuardedCondition(locking.GuardedLock("verify.test.cv"))
+    with cond:
+        assert cond.wait(0.01) is False

@@ -1,0 +1,108 @@
+"""race-smoke: the tpuverify gate `make tier1` runs.
+
+Three halves, all on deterministic seeds and a bounded schedule budget
+(<60 s total by contract — the budget meta-test enforces it):
+
+1. the LIVE-TREE scenarios (the critical-section pairs ROADMAP item 1's
+   sharded dispatch will stress) must survive their full schedule budget
+   with zero invariant violations and zero lock-discipline (C7)
+   violations;
+2. NON-VACUITY: the explorer must FIND the deliberately seeded bugs
+   (lost-update, broken arming guard) within budget — a gate that cannot
+   fail cannot gate;
+3. REPLAY: a failure artifact must reproduce deterministically through
+   ``python -m tpusched.cmd.replay`` from the artifact alone.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpusched import verify
+from tpusched.cmd import replay as replay_cmd
+from tpusched.util import locking
+
+SEED = 20260803            # deterministic: today's gate is tomorrow's too
+BUDGET = 48                # schedules per scenario
+
+
+@pytest.fixture(autouse=True)
+def _clean_lock_state():
+    yield
+    locking.set_verify_hook(None)
+    locking.set_debug(False)
+    locking.recorder().reset()
+
+
+EX = verify.Explorer()
+
+
+@pytest.mark.parametrize("name", sorted(verify.LIVE_SCENARIOS))
+def test_live_scenario_survives_schedule_budget(name):
+    rep = EX.explore(verify.SCENARIOS[name], seed=SEED, schedules=BUDGET,
+                     stop_on_failure=True)
+    assert rep.failures == 0, (
+        f"{name}: {rep.first_failure['failure']}\n"
+        f"replay with: python -m tpusched.cmd.replay <artifact> after "
+        f"saving {rep.first_failure}")
+    assert rep.schedules == BUDGET
+    assert rep.distinct_traces >= 2, (
+        f"{name}: only {rep.distinct_traces} distinct interleaving(s) "
+        f"explored — the scenario's yield points have gone dark")
+
+
+@pytest.mark.parametrize("name", sorted(verify.SELFCHECK_BUGGY))
+def test_seeded_bug_is_found(name):
+    rep = EX.explore(verify.SCENARIOS[name], seed=SEED, schedules=120)
+    assert rep.failures == 1, (
+        f"{name}: the explorer missed a DELIBERATE bug in {rep.schedules} "
+        f"schedules — the race-smoke gate is vacuous")
+    verify.validate_artifact(rep.first_failure)
+
+
+def test_seeded_bug_replays_from_artifact_alone(tmp_path):
+    """The acceptance criterion verbatim: an injected failure reproduces
+    deterministically via cmd.replay from its schedule artifact alone."""
+    rep = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                     seed=SEED, schedules=120)
+    assert rep.first_failure is not None
+    path = tmp_path / "failure.json"
+    verify.dump_artifact(rep.first_failure, str(path))
+    # fresh process-level entry point, artifact file only
+    assert replay_cmd.main([str(path)]) == 0
+    assert replay_cmd.main([str(path), "--json"]) == 0
+
+
+def test_replay_cli_divergence_is_a_mismatch(tmp_path):
+    """A stale artifact (the code moved, the recorded schedule no longer
+    exists) must exit 1, not claim REPRODUCED: the replayed failure is a
+    ReplayDivergence, not the recorded one."""
+    rep = EX.explore(verify.SCENARIOS["selfcheck-lost-update"],
+                     seed=SEED, schedules=120)
+    art = dict(rep.first_failure)
+    art["decisions"] = art["decisions"][:1]
+    path = tmp_path / "stale.json"
+    verify.dump_artifact(art, str(path))
+    assert replay_cmd.main([str(path)]) == 1
+
+
+def test_replay_cli_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"version\": 1}")
+    assert replay_cmd.main([str(bad)]) == 2
+    missing = tmp_path / "nope.json"
+    assert replay_cmd.main([str(missing)]) == 2
+
+
+def test_race_smoke_fits_its_budget():
+    """One representative scenario timed: the whole gate (7 live + 2
+    seeded + replay) must stay under 60 s; a single scenario budget has
+    to clear its share with a wide margin."""
+    t0 = time.monotonic()
+    EX.explore(verify.SCENARIOS["informer-delete-resync"], seed=SEED,
+               schedules=BUDGET, stop_on_failure=True)
+    dt = time.monotonic() - t0
+    assert dt < 8.0, (
+        f"one scenario budget took {dt:.1f}s — race-smoke would blow "
+        f"its 60 s tier1 budget")

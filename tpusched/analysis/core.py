@@ -72,12 +72,85 @@ class Suppression:
         return finding.rule in self.rules and finding.line == self.target
 
 
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One ``self.<callee>(...)`` call inside a method, with the lexical
+    lock context the interprocedural rules need: every ``with self.<g>:``
+    / ``with self.<g>():`` guard name active at the site."""
+    cls: str
+    caller: str
+    callee: str
+    node: ast.Call
+    guards: Tuple[str, ...]
+    is_with_context: bool      # the call IS a with-statement's context expr
+
+
+class _SelfCallCollector(ast.NodeVisitor):
+    """Collects every ``self.<m>(...)`` site in one method, tracking the
+    lexical ``with self.<g>[()]:`` guard stack.  Nested defs are traversed
+    transparently (a closure built under the lock keeps the lexical
+    context — same policy as the lock-discipline rule; the runtime
+    recorder owns call-time truth)."""
+
+    def __init__(self, cls_name: str, method_name: str):
+        self.cls = cls_name
+        self.caller = method_name
+        self.guards: List[str] = []
+        self.sites: List[CallSite] = []
+        self._with_ctx: set = set()      # id() of Calls used as with items
+
+    @staticmethod
+    def _guard_name(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and isinstance(expr.func.value, ast.Name) \
+                and expr.func.value.id == "self":
+            return expr.func.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        added = 0
+        for item in node.items:
+            g = self._guard_name(item.context_expr)
+            if g is not None:
+                self.guards.append(g)
+                added += 1
+            if isinstance(item.context_expr, ast.Call):
+                self._with_ctx.add(id(item.context_expr))
+        self.generic_visit(node)
+        for _ in range(added):
+            self.guards.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            self.sites.append(CallSite(
+                cls=self.cls, caller=self.caller, callee=f.attr, node=node,
+                guards=tuple(self.guards),
+                is_with_context=id(node) in self._with_ctx))
+        self.generic_visit(node)
+
+
 class FileContext:
     """Everything a rule needs about one file: source, AST, suppressions.
 
     The AST is walked ONCE here into ``nodes`` (+ a parent map); rules
     iterate that flat list instead of re-walking the tree — this is the
     difference between the full-tree pass taking seconds and taking ten.
+
+    ``self_call_graph`` (lazy) adds the one-pass per-module call graph the
+    interprocedural rules (locked-callgraph) consume: every
+    ``self.<m>(...)`` call site per (class, method), annotated with its
+    lexical lock context.  Built on first access only — ``--changed-only``
+    runs never pay for call-graph construction on modules no rule asks
+    about, and unchanged modules are never parsed at all.
     """
 
     def __init__(self, root: Path, path: Path):
@@ -102,6 +175,7 @@ class FileContext:
                 for c in ast.iter_child_nodes(n):
                     self._parent[id(c)] = n
                     stack.append(c)
+        self._self_call_graph: Optional[List["CallSite"]] = None
         self.suppressions: List[Suppression] = []
         # lines strictly inside a multi-line string literal (docstrings):
         # a '# tpulint:' there is documentation, not a directive
@@ -164,6 +238,28 @@ class FileContext:
             if isinstance(n, ast.Attribute) and n.attr in wanted:
                 return True
         return False
+
+    @property
+    def self_call_graph(self) -> List["CallSite"]:
+        """Per-module call graph of ``self.<m>(...)`` sites, one pass over
+        each class body, built lazily and cached.  ``guards`` carries the
+        attribute names of every enclosing ``with self.<g>:`` /
+        ``with self.<g>():`` item, which is how callers prove "the lock is
+        lexically held here" to the locked-callgraph rule."""
+        if self._self_call_graph is None:
+            sites: List[CallSite] = []
+            if self.tree is not None:
+                for cls in ast.walk(self.tree):
+                    if isinstance(cls, ast.ClassDef):
+                        for m in cls.body:
+                            if isinstance(m, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+                                v = _SelfCallCollector(cls.name, m.name)
+                                for stmt in m.body:
+                                    v.visit(stmt)
+                                sites.extend(v.sites)
+            self._self_call_graph = sites
+        return self._self_call_graph
 
     def import_aliases(self, module: str, attr: str) -> List[str]:
         """Every dotted spelling under which ``module.attr`` is reachable
@@ -294,6 +390,59 @@ class Report:
                 for f, s in self.suppressed],
             "errors": self.errors,
             "duration_s": round(self.duration_s, 3),
+        }, indent=None, sort_keys=True)
+
+    def to_sarif(self) -> str:
+        """SARIF 2.1.0 — the interchange format CI annotators consume, so
+        findings land as inline review comments instead of a log to grep.
+        One run, one result per finding; suppressed findings are emitted
+        with a suppression record (SARIF's own model for them); tool
+        errors become toolExecutionNotifications."""
+        def rule_meta(name: str) -> Dict:
+            if name == SUPPRESSION_HYGIENE:
+                desc = ("suppressions must be justified, known and "
+                        "actually used")
+            else:
+                cls = RULES.get(name)
+                desc = cls.summary if cls is not None else ""
+            return {"id": name, "shortDescription": {"text": desc}}
+
+        def location(f: Finding) -> Dict:
+            return {"physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": max(1, f.col + 1)}}}
+
+        def result(f: Finding, suppression: Optional[Suppression] = None
+                   ) -> Dict:
+            out = {"ruleId": f.rule, "level": "error",
+                   "message": {"text": f.message},
+                   "locations": [location(f)]}
+            if suppression is not None:
+                out["suppressions"] = [{
+                    "kind": "inSource",
+                    "justification": suppression.reason}]
+            return out
+
+        run = {
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri":
+                    "https://github.com/tpusched/tpusched",
+                "rules": [rule_meta(n) for n in self.rules]}},
+            "results": [result(f) for f in self.findings]
+            + [result(f, s) for f, s in self.suppressed],
+            "invocations": [{
+                "executionSuccessful": not self.errors,
+                "toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in self.errors]}],
+        }
+        return json.dumps({
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [run],
         }, indent=None, sort_keys=True)
 
 

@@ -4,8 +4,10 @@ scoping and the rationale live next to the check."""
 from __future__ import annotations
 
 from . import api_calls        # noqa: F401
+from . import callgraph        # noqa: F401
 from . import clocks           # noqa: F401
 from . import exceptions       # noqa: F401
+from . import flow             # noqa: F401
 from . import locks            # noqa: F401
 from . import logging_discipline  # noqa: F401
 from . import metrics_names    # noqa: F401

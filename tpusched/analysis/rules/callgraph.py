@@ -1,0 +1,77 @@
+"""locked-callgraph: a ``*_locked`` method may only be invoked with its
+lock lexically held, or from a caller that is itself ``*_locked``.
+
+The ``*_locked`` suffix is the repo's caller-holds-the-lock contract
+(``_flush_locked``, ``_pg_adjust_locked``, ...).  The lock-discipline rule
+verifies such methods may MUTATE guarded state; this rule closes the other
+half interprocedurally: nobody may CALL one without the lock.  It consumes
+the one-pass per-module call graph ``FileContext.self_call_graph`` builds
+lazily (so ``--changed-only`` runs never construct graphs for unchanged
+modules).
+
+A call site is judged guarded when any lexically enclosing
+``with self.<g>[()]:`` names
+
+- the class's declared ``@guarded_by`` lock, or
+- a lock-shaped attribute (contains "lock", or the conventional ``_mu`` /
+  ``_cond`` / ``_cv`` condition-variable names — a Condition over a
+  GuardedLock IS the guard, as in sched/queue.py), or
+- a ``*_locked()`` acquiring helper (``with self._locked():`` in
+  sched/ha.py's file lease).
+
+Exemptions: callers named ``*_locked`` (the contract propagates), the
+call being itself a with-statement's context expression (that IS the
+acquire), and ``__init__`` (construction happens-before publication).
+
+Lexical by design, like lock-discipline: a caller that truly holds the
+lock non-lexically should be renamed ``*_locked``; a wrong rename is
+exactly what the runtime recorder and the interleaving explorer
+(tpusched/verify) exist to catch.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable
+
+from ..core import FileContext, Finding, Rule, register
+from .locks import _guarded_decl
+
+_CV_NAMES = frozenset(("_mu", "_cond", "_cv", "mu", "cond", "cv"))
+
+
+def _lockish(guard: str) -> bool:
+    return "lock" in guard or guard in _CV_NAMES
+
+
+@register
+class LockedCallgraph(Rule):
+    name = "locked-callgraph"
+    summary = ("*_locked methods are only called under their lock or from "
+               "*_locked callers")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        declared: Dict[str, str] = {}
+        for cls in ctx.nodes:
+            if isinstance(cls, ast.ClassDef):
+                decl = _guarded_decl(cls)
+                if decl is not None:
+                    declared[cls.name] = decl[0]
+        for site in ctx.self_call_graph:
+            if not site.callee.endswith("_locked"):
+                continue
+            if site.is_with_context:
+                continue              # `with self._locked():` — the acquire
+            if site.caller.endswith("_locked") or site.caller == "__init__":
+                continue
+            decl_lock = declared.get(site.cls)
+            if any(g == decl_lock or _lockish(g) for g in site.guards):
+                continue
+            yield self.finding(
+                ctx, site.node,
+                f"{site.cls}.{site.caller}: calls self.{site.callee}() "
+                f"without the lock lexically held — *_locked means the "
+                f"CALLER holds the lock; wrap the call in 'with "
+                f"self.{decl_lock or '_lock'}:' or rename the caller "
+                f"*_locked")

@@ -1,0 +1,322 @@
+"""Flow-sensitive concurrency rules: atomicity-violation and
+snapshot-discipline.
+
+These see what tpulint's per-statement rules cannot: a read-modify-write
+whose read and write each sit under the lock but with a RELEASE in
+between (the check-then-act window a concurrent writer slips through),
+and snapshot objects escaping the read-only, function-local contract that
+keeps the capacity collector honest against the equivalence cache's
+arming guard (sched/cache.peek_snapshot's docstring is the spec).  They
+are the static companions of the interleaving explorer (tpusched/verify):
+the lint pins the pattern, the explorer pins the schedules.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, Rule, dotted_name, register
+from .locks import _guarded_decl, _is_self_attr, _self_field, _MUTATORS
+
+
+class _AtomicityChecker(ast.NodeVisitor):
+    """Walks one method tracking lock REGIONS (maximal ``with self.<lock>``
+    spans): records locals bound from guarded-field reads inside region R,
+    and flags guarded-field writes in a later region R' != R whose
+    statement references such a local — the value crossed a lock release.
+
+    Locals re-bound from anything that is not a guarded read drop out of
+    the tracking (the stale value is gone).  Nested defs are transparent,
+    same policy as lock-discipline."""
+
+    def __init__(self, lock_attr: str, fields: Set[str]):
+        self.lock_attr = lock_attr
+        self.fields = fields
+        self.region: Optional[int] = None
+        self._next_region = 0
+        # local name → (region, guarded field it was read from, lineno)
+        self.reads: Dict[str, Tuple[int, str, int]] = {}
+        # (node, local, read_field, read_line, written_field)
+        self.hits: List[Tuple[ast.AST, str, str, int, str]] = []
+
+    # -- regions ---------------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_is_self_attr(item.context_expr, self.lock_attr)
+                     for item in node.items)
+        if locked and self.region is None:
+            self._next_region += 1
+            self.region = self._next_region
+            self.generic_visit(node)
+            self.region = None
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    # -- guarded reads ---------------------------------------------------------
+
+    def _guarded_read_fields(self, expr: ast.AST) -> List[str]:
+        out = []
+        for n in ast.walk(expr):
+            f = _self_field(n, self.fields)
+            if f is not None:
+                out.append(f)
+        return out
+
+    def _visit_binding(self, targets, value: Optional[ast.AST],
+                       node: ast.AST) -> None:
+        self._check_write_targets(targets, node)
+        read_fields = (self._guarded_read_fields(value)
+                       if self.region is not None and value is not None
+                       else [])
+        for tgt in targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for n in elts:
+                if isinstance(n, ast.Name):
+                    if read_fields:
+                        self.reads[n.id] = (self.region, read_fields[0],
+                                            node.lineno)
+                    else:
+                        # re-bound from something else: stale value gone
+                        self.reads.pop(n.id, None)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._visit_binding(node.targets, node.value, node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:       # a bare annotation binds nothing
+            self._visit_binding([node.target], node.value, node)
+        else:
+            self.generic_visit(node)
+
+    # -- guarded writes --------------------------------------------------------
+
+    def _written_field(self, tgt: ast.AST) -> Optional[str]:
+        f = _self_field(tgt, self.fields)
+        if f is not None:
+            return f
+        if isinstance(tgt, ast.Subscript):
+            return _self_field(tgt.value, self.fields)
+        return None
+
+    def _check_write_targets(self, targets, stmt: ast.AST) -> None:
+        if self.region is None:
+            return                    # unlocked writes are lock-discipline's
+        for tgt in targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for t in elts:
+                f = self._written_field(t)
+                if f is not None:
+                    self._flag_stale_operands(stmt, f)
+
+    def _flag_stale_operands(self, stmt: ast.AST, written: str) -> None:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and n.id in self.reads:
+                r, read_field, line = self.reads[n.id]
+                if r != self.region:
+                    self.hits.append((stmt, n.id, read_field, line, written))
+                    return
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_write_targets([node.target], node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.region is not None \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            f = _self_field(node.func.value, self.fields)
+            if f is not None:
+                self._flag_stale_operands(node, f)
+        self.generic_visit(node)
+
+
+@register
+class AtomicityViolation(Rule):
+    name = "atomicity-violation"
+    summary = ("a guarded read must not flow into a guarded write across "
+               "a lock release (check-then-act)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        for cls in ctx.nodes:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            decl = _guarded_decl(cls)
+            if decl is None:
+                continue
+            lock_attr, fields = decl
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name.endswith("_locked"):
+                    continue          # one region by contract
+                chk = _AtomicityChecker(lock_attr, set(fields))
+                chk.visit(method)
+                for node, local, read_field, line, written in chk.hits:
+                    yield self.finding(
+                        ctx, node,
+                        f"{cls.name}.{method.name}: writes guarded "
+                        f"self.{written} using {local!r} read from "
+                        f"guarded self.{read_field} at line {line} in an "
+                        f"EARLIER critical section — the lock was "
+                        f"released in between, so the value may be stale "
+                        f"(check-then-act); merge the critical sections "
+                        f"or re-read under the lock")
+
+
+_SNAPSHOT_ALLOWED = ("tpusched/sched/", "tpusched/verify/")
+_SNAP_ESCAPE_MUTATORS = _MUTATORS
+
+
+@register
+class SnapshotDiscipline(Rule):
+    name = "snapshot-discipline"
+    summary = ("peek_snapshot() results stay read-only and function-"
+               "local; cache.snapshot() only from dispatch-owned code")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith("tpusched/"):
+            return
+        yield from self._check_snapshot_callers(ctx)
+        yield from self._check_peek_usage(ctx)
+
+    # -- snapshot(): dispatch-owned only --------------------------------------
+
+    def _check_snapshot_callers(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.in_dir(*_SNAPSHOT_ALLOWED):
+            return
+        for n in ctx.nodes:
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "snapshot"):
+                continue
+            recv = dotted_name(n.func.value)
+            last = recv.rsplit(".", 1)[-1].lower()
+            if "cache" not in last:
+                continue              # some other object's snapshot()
+            yield self.finding(
+                ctx, n,
+                f"cache.snapshot() called from {ctx.relpath} — a rebuild "
+                f"from outside the scheduling loop advances the snapshot "
+                f"cursor mid-cycle and launders foreign mutations past "
+                f"the equivalence cache's arming guard; foreign threads "
+                f"read cache.peek_snapshot() instead (see "
+                f"sched/cache.py)")
+
+    # -- peek_snapshot(): read-only, function-local ---------------------------
+
+    @staticmethod
+    def _binding_targets(stmt) -> List[ast.AST]:
+        if isinstance(stmt, ast.Assign):
+            return list(stmt.targets)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            return [stmt.target]
+        return []
+
+    def _check_peek_usage(self, ctx: FileContext) -> Iterable[Finding]:
+        """Sweep each function in source order, tracking which locals
+        CURRENTLY hold a peek_snapshot() result: a name bound from
+        peek_snapshot() enters the set, a later re-bind from anything
+        else leaves it (the stale value is gone — without this, a plain
+        list mutated before the name is reused for a snapshot would be
+        flagged).  Lexical order stands in for execution order, same
+        posture as the rest of the suite."""
+        for fn in ctx.nodes:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_peek = any(isinstance(n, ast.Attribute)
+                           and n.attr == "peek_snapshot"
+                           for n in ast.walk(fn))
+            if not has_peek:
+                continue
+            nodes = sorted(
+                (n for n in ast.walk(fn) if hasattr(n, "lineno")),
+                key=lambda n: (n.lineno, n.col_offset))
+            snaps: Set[str] = set()
+            for n in nodes:
+                finding = self._peek_violation(ctx, n, snaps)
+                if finding is not None:
+                    yield finding
+                for tgt in self._binding_targets(n):
+                    elts = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                        ast.List)) \
+                        else [tgt]
+                    v = n.value
+                    from_peek = (isinstance(v, ast.Call)
+                                 and isinstance(v.func, ast.Attribute)
+                                 and v.func.attr == "peek_snapshot"
+                                 and len(elts) == 1)
+                    for name_tgt in elts:
+                        if not isinstance(name_tgt, ast.Name):
+                            continue
+                        if from_peek:
+                            snaps.add(name_tgt.id)
+                        else:
+                            snaps.discard(name_tgt.id)
+
+    def _peek_violation(self, ctx: FileContext, n: ast.AST,
+                        snaps: Set[str]) -> Optional[Finding]:
+        def is_snap(x) -> bool:
+            return isinstance(x, ast.Name) and x.id in snaps
+
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _SNAP_ESCAPE_MUTATORS:
+            if is_snap(n.func.value):
+                return self.finding(
+                    ctx, n, f"mutates a peek_snapshot() result "
+                            f"(.{n.func.attr}()) — snapshots are shared "
+                            f"read-only state; clone before mutating")
+            if isinstance(n.func.value, ast.Attribute) \
+                    and _is_self_attr(n.func.value, n.func.value.attr) \
+                    and any(is_snap(a) for a in n.args):
+                return self.finding(
+                    ctx, n, f"stores a peek_snapshot() result into "
+                            f"self.{n.func.value.attr} "
+                            f"(.{n.func.attr}()) — a snapshot must not "
+                            f"outlive the function without an epoch pin")
+        if isinstance(n, ast.Return) and n.value is not None \
+                and is_snap(n.value):
+            return self.finding(
+                ctx, n, "returns a peek_snapshot() result — the snapshot "
+                        "escapes the function and can outlive its epoch "
+                        "in the caller's hands; read what you need here "
+                        "and return that (or the cursor)")
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and is_snap(tgt.value):
+                    return self.finding(
+                        ctx, n, "writes an attribute on a peek_snapshot() "
+                                "result — snapshots are read-only")
+                if isinstance(tgt, ast.Subscript) and is_snap(tgt.value):
+                    return self.finding(
+                        ctx, n, "item-writes into a peek_snapshot() "
+                                "result — snapshots are read-only")
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Attribute) \
+                        and _is_self_attr(tgt.value, tgt.value.attr) \
+                        and n.value is not None \
+                        and any(is_snap(v) for v in ast.walk(n.value)):
+                    return self.finding(
+                        ctx, n, "stores a peek_snapshot() result into a "
+                                "container on self — a snapshot must not "
+                                "outlive the function without an epoch "
+                                "pin")
+                if isinstance(tgt, ast.Attribute) \
+                        and _is_self_attr(tgt, tgt.attr) \
+                        and n.value is not None \
+                        and any(is_snap(v) for v in ast.walk(n.value)):
+                    return self.finding(
+                        ctx, n, "stores a peek_snapshot() result on self — "
+                                "a snapshot must not outlive the function "
+                                "without an epoch pin; keep the cursor "
+                                "(cache.snapshot_cursor()), not the object")
+        return None

@@ -234,7 +234,7 @@ class Journal:
                     self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
                 self._wal.flush()
             except Exception:
-                self._reopen_discarding_buffer(good)
+                self._reopen_discarding_buffer_locked(good)
                 raise
             if self.fsync:
                 os.fsync(self._wal.fileno())
@@ -243,11 +243,11 @@ class Journal:
         if needs_compact:
             self.compact()
 
-    def _reopen_discarding_buffer(self, good: int) -> None:
+    def _reopen_discarding_buffer_locked(self, good: int) -> None:
         """Recover from a torn batch: drop any bytes stuck in the text
         wrapper's buffer (close may fail re-flushing them — the fd closes
-        regardless) and os.ftruncate the WAL back to ``good``. Called under
-        ``_file_lock``.
+        regardless) and os.ftruncate the WAL back to ``good``. The caller
+        holds ``_file_lock`` (the *_locked contract).
 
         Fencing: the truncate-and-reopen is BY PATH, so if the directory
         was taken over between our last write and this failure, doing it

@@ -12,4 +12,7 @@
 - ``python -m tpusched.cmd.lint`` — tpulint: the AST-based invariant
   analysis suite (``tpusched/analysis``); gates ``make tier1`` and runs
   inside ``make verify``.
+- ``python -m tpusched.cmd.replay`` — tpuverify replay client:
+  re-executes a race-smoke schedule artifact deterministically
+  (``tpusched/verify``; see doc/ops.md).
 """

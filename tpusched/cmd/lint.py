@@ -4,7 +4,8 @@
     python -m tpusched.cmd.lint tpusched/sched/      # a subtree
     python -m tpusched.cmd.lint --rules metrics-names,thread-hygiene
     python -m tpusched.cmd.lint --changed-only       # git-diff-driven
-    python -m tpusched.cmd.lint --json               # machine-readable
+    python -m tpusched.cmd.lint --format=json        # machine-readable
+    python -m tpusched.cmd.lint --format=sarif       # CI inline annotations
     python -m tpusched.cmd.lint --list-rules
 
 Exit codes: 0 = clean, 1 = findings, 2 = usage/internal error.  The
@@ -21,7 +22,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from ..analysis import RULES, Runner, rule_names
+from ..analysis import RULES, Report, Runner, rule_names
 from ..analysis.core import SUPPRESSION_HYGIENE
 
 DEFAULT_TARGET = "tpusched"
@@ -41,8 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule subset (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the registered rules and exit")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default=None,
+                   help="output format (default text); sarif is the "
+                        "2.1.0 interchange format CI annotators consume")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable output (schema version 1)")
+                   help="alias for --format=json (schema version 1)")
     p.add_argument("--changed-only", action="store_true",
                    help="lint only .py files changed vs git HEAD "
                         "(staged, unstaged and untracked)")
@@ -75,9 +80,22 @@ def _changed_files(root: Path) -> list:
     return files
 
 
+def _render(report, fmt: str) -> str:
+    if fmt == "json":
+        return report.to_json()
+    if fmt == "sarif":
+        return report.to_sarif()
+    return report.render_text()
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.format is not None and args.json and args.format != "json":
+        print("tpulint: --json conflicts with "
+              f"--format={args.format}", file=sys.stderr)
+        return 2
+    fmt = args.format or ("json" if args.json else "text")
     if args.list_rules:
         for name in rule_names():
             if name == SUPPRESSION_HYGIENE:
@@ -108,20 +126,17 @@ def main(argv=None) -> int:
         targets = [f for f in targets
                    if any(str(f).startswith(str(s)) for s in scope)]
         if not targets:
-            if not args.json:
+            if fmt == "text":
                 print("tpulint: no changed .py files in scope — clean")
             else:
-                print('{"version": 1, "files": 0, "findings": [], '
-                      '"errors": [], "rules": [], "suppressed": [], '
-                      '"duration_s": 0.0}')
+                empty = Report(findings=[], suppressed=[], files=0,
+                               rules=[], duration_s=0.0, errors=[])
+                print(_render(empty, fmt))
             return 0
     else:
         targets = args.paths or [DEFAULT_TARGET]
     report = runner.run([Path(t) for t in targets])
-    if args.json:
-        print(report.to_json())
-    else:
-        print(report.render_text())
+    print(_render(report, fmt))
     if report.errors:
         return 2
     return 0 if report.clean else 1

@@ -1,0 +1,78 @@
+"""Deterministic replay of a tpuverify schedule artifact.
+
+    python -m tpusched.cmd.replay artifact.json
+    python -m tpusched.cmd.replay artifact.json --json
+
+An artifact (written by the explorer when a schedule fails, or saved from
+a race-smoke run) pins a scenario name plus the exact decision list the
+scheduler took; replay re-executes that schedule and nothing else — same
+interleaving, same failure, every time.  See doc/ops.md "Reproducing a
+race-smoke failure from its schedule artifact".
+
+Exit codes: 0 = replay matched the artifact (recorded failure reproduced,
+or recorded-clean schedule still clean), 1 = mismatch (failure did not
+reproduce, a clean schedule now fails, or the execution diverged from the
+decision list), 2 = usage/artifact error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .. import verify
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuverify-replay",
+        description="re-execute a schedule artifact deterministically")
+    p.add_argument("artifact", help="path to the schedule artifact JSON")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable result")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        artifact = verify.load_artifact(args.artifact)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"replay: cannot load artifact: {e}", file=sys.stderr)
+        return 2
+    if artifact["scenario"] not in verify.SCENARIOS:
+        print(f"replay: unknown scenario {artifact['scenario']!r} "
+              f"(known: {', '.join(sorted(verify.SCENARIOS))})",
+              file=sys.stderr)
+        return 2
+    result = verify.replay_artifact(artifact)
+    expected = artifact.get("failure")
+    # deterministic replay means the SAME failure, byte for byte — a
+    # different failure (in particular a ReplayDivergence from a stale
+    # artifact after the code moved) is a mismatch, not a reproduction
+    reproduced = result.failure == expected
+    out = {
+        "scenario": artifact["scenario"],
+        "expected_failure": expected,
+        "replayed_failure": result.failure,
+        "steps": result.steps,
+        "decisions": len(artifact["decisions"]),
+        "reproduced": reproduced,
+    }
+    if args.json:
+        print(json.dumps(out, indent=None, sort_keys=True))
+    else:
+        print(f"scenario:  {out['scenario']}")
+        print(f"decisions: {out['decisions']} (steps executed: "
+              f"{out['steps']})")
+        print(f"expected:  {expected or '(clean schedule)'}")
+        print(f"replayed:  {result.failure or '(clean schedule)'}")
+        print("verdict:   " + ("REPRODUCED — deterministic replay matches "
+                               "the artifact" if reproduced else
+                               "MISMATCH — the execution no longer matches "
+                               "the recorded schedule"))
+    return 0 if reproduced else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -19,7 +18,7 @@ from ..api.core import Pod
 from ..api.scheduling import POD_GROUP_LABEL
 from ..fwk.interfaces import ClusterEvent
 from ..util import klog
-from ..util.locking import GuardedLock, guarded_by
+from ..util.locking import GuardedCondition, GuardedLock, guarded_by
 
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 10.0
@@ -167,8 +166,10 @@ class SchedulingQueue:
         self._max_backoff_s = (MAX_BACKOFF_S if max_backoff_s is None
                                else max_backoff_s)
         # the Condition's underlying lock is the named guard — debug
-        # mode instruments it, off mode is a plain RLock inside
-        self._lock = threading.Condition(
+        # mode instruments it, off mode is a plain RLock inside; the
+        # GuardedCondition flavor lets the interleaving explorer
+        # (tpusched/verify) model wait/notify hand-offs deterministically
+        self._lock = GuardedCondition(
             GuardedLock("sched.SchedulingQueue"))
         self._active = _Heap(less)
         self._backoff: List = []           # (expiry, seq, info)

@@ -29,7 +29,7 @@ from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_TPU_TOPOLOGY)
 from .. import obs as obs_mod
 from .. import trace
-from ..util import klog, tracectx
+from ..util import klog, locking, tracectx
 from ..util.equivalence import equivalence_key
 from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_bypasses,
@@ -315,10 +315,22 @@ class _BindingPool:
         """Queue a binding task. ``abort(*args)`` is the task's cheap
         failure path (unreserve + forget, no API calls): shutdown drains
         still-queued tasks through it instead of executing full bind
-        cycles on the stopping thread."""
+        cycles on the stopping thread.
+
+        The post-put re-check closes the shutdown race the interleaving
+        explorer (tpusched/verify, ``bindpool-shutdown-drain``) pins: a
+        submit that passes the open-check, loses the CPU, and lands its
+        task AFTER shutdown's drain finished would otherwise leave the
+        task queued forever — its reservation leaked with nobody left to
+        run OR abort it. Re-draining after the put guarantees a
+        post-shutdown task is aborted by somebody: either shutdown's
+        drain got it, or we do."""
         if not self._open:
             raise RuntimeError("binding pool is shut down")
+        locking.verify_point("bindpool.submit")
         self._q.put((fn, abort, args))
+        if not self._open:
+            self._abort_queued()
 
     def _run(self) -> None:
         while True:
@@ -331,6 +343,28 @@ class _BindingPool:
             except Exception as e:  # a binding task must never kill a worker
                 klog.error_s(e, "binding task panicked")
 
+    def _abort_queued(self) -> None:
+        """Drain every queued task through its abort path. Worker-wakeup
+        sentinels pulled out along the way are re-queued after the drain so
+        a still-parked worker cannot be stranded in ``get()``."""
+        sentinels = 0
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                sentinels += 1
+                continue
+            locking.verify_point("bindpool.drain-abort")
+            fn, abort, args = item
+            try:
+                (abort or fn)(*args)
+            except Exception as e:
+                klog.error_s(e, "binding task abort panicked during drain")
+        for _ in range(sentinels):
+            self._q.put(None)
+
     def shutdown(self, timeout: float = 5.0) -> None:
         """Workers are joined with a shared bounded deadline (a wedged Bind
         API call delays stop() by at most ``timeout``). Tasks still queued
@@ -338,22 +372,13 @@ class _BindingPool:
         ABORTED inline (reservations released, pods not leaked), never run
         as full bind cycles on the stopping thread."""
         self._open = False
+        locking.verify_point("bindpool.shutdown")
         for _ in self._threads:
             self._q.put(None)
         deadline = time.monotonic() + timeout
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                return
-            if item is not None:
-                fn, abort, args = item
-                try:
-                    (abort or fn)(*args)
-                except Exception as e:
-                    klog.error_s(e, "binding task abort panicked during drain")
+        self._abort_queued()
 
 
 class Scheduler:

@@ -36,6 +36,19 @@ constructed in debug mode).  Enable with ``set_debug(True)`` (or
 instrumentation is decided at construction time, which is what keeps the
 off path free.
 
+A fourth hook, layered ON TOP of debug mode: the INTERLEAVING EXPLORER
+(tpusched/verify).  ``set_verify_hook(runtime)`` installs a process-global
+observer that debug-mode locks consult at every acquisition boundary —
+before a non-reentrant acquire, after a full release, across a Condition
+``wait()`` hand-off, and at every guarded-container mutation.  The explorer
+uses those callbacks to take cooperative control of scheduler-owned
+threads and drive them through chosen interleavings deterministically;
+with no hook installed (the default, including all of debug mode's normal
+uses) the cost is one module-global ``is None`` test per boundary.
+``GuardedCondition`` is the Condition flavor whose wait/notify the
+explorer can model — off the explorer it behaves exactly like
+``threading.Condition`` over the same lock.
+
 A third, independent mode: CONTENTION TELEMETRY (``set_telemetry(True)`` /
 ``TPUSCHED_LOCK_TELEMETRY=1``).  Distinct from debug mode — debug answers
 "is the lock *discipline* sound" in tests/soaks and may be arbitrarily
@@ -61,10 +74,12 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import tracectx
 
-__all__ = ["GuardedLock", "guarded_by", "thread_confined", "set_debug",
+__all__ = ["GuardedLock", "GuardedCondition", "guarded_by",
+           "thread_confined", "set_debug",
            "debug_enabled", "set_telemetry", "telemetry_enabled",
            "recorder", "LockOrderError",
-           "GuardedStateError", "LockOrderRecorder"]
+           "GuardedStateError", "LockOrderRecorder",
+           "set_verify_hook", "verify_hook", "verify_point"]
 
 _DEBUG = os.environ.get("TPUSCHED_LOCK_DEBUG", "") not in ("", "0", "false")
 _TELEMETRY = os.environ.get("TPUSCHED_LOCK_TELEMETRY", "") \
@@ -99,6 +114,50 @@ def set_telemetry(on: bool) -> bool:
 
 def telemetry_enabled() -> bool:
     return _TELEMETRY
+
+
+# -- interleaving-explorer hook (tpusched/verify) ------------------------------
+#
+# The explorer registers a runtime object here; debug-mode locks report
+# their acquisition boundaries to it so it can suspend/resume scheduler-
+# owned threads at exactly the points where interleavings differ.  The
+# protocol (all methods must tolerate calls from threads the explorer does
+# not manage, and return immediately for them):
+#
+#   on_acquire(name, ident, blocking) -> bool   before a non-reentrant
+#       acquire; False means "would block and blocking=False" — the caller
+#       returns False without touching the real lock.
+#   on_release(name, ident)                     after a FULL real release.
+#   on_cond_wait(cond, timeout) -> bool | None  a GuardedCondition wait;
+#       None means "not handled — do a real wait".
+#   on_cond_notify(cond, n)                     before a real notify; n is
+#       the wake count (None = notify_all).
+#   on_point(label)                             explicit yield point
+#       (guarded-container mutations, _BindingPool boundaries, ...).
+
+_VERIFY_HOOK = None
+
+
+def set_verify_hook(hook):
+    """Install (or with None, remove) the interleaving-explorer hook for
+    ALL debug-mode locks in the process.  Returns the previous hook
+    (restore in finally).  Only the explorer should call this."""
+    global _VERIFY_HOOK
+    prev, _VERIFY_HOOK = _VERIFY_HOOK, hook
+    return prev
+
+
+def verify_hook():
+    return _VERIFY_HOOK
+
+
+def verify_point(label: str) -> None:
+    """Explicit explorer yield point for boundaries no GuardedLock marks
+    (e.g. the binding pool's plain ``queue.Queue`` hand-off).  One global
+    read + ``is None`` test when no explorer is active."""
+    h = _VERIFY_HOOK
+    if h is not None:
+        h.on_point(label)
 
 
 class LockOrderError(RuntimeError):
@@ -287,6 +346,9 @@ class _InstrumentedLock:
             self._inner.acquire()
             self._count += 1
             return True                 # reentrant: no recorder event
+        h = _VERIFY_HOOK
+        if h is not None and not h.on_acquire(self.name, id(self), blocking):
+            return False                # explorer: modeled try-acquire miss
         got = self._inner.acquire(blocking, timeout)
         if got:
             self._owner = me
@@ -300,10 +362,15 @@ class _InstrumentedLock:
                 f"{self.name}: released by non-owner thread "
                 f"{threading.current_thread().name!r}")
         self._count -= 1
-        if self._count <= 0:
+        full = self._count <= 0
+        if full:
             self._owner = None
             self._rec.on_release(self.name, id(self))
         self._inner.release()
+        if full:
+            h = _VERIFY_HOOK
+            if h is not None:
+                h.on_release(self.name, id(self))
 
     def __enter__(self):
         self.acquire()
@@ -331,9 +398,15 @@ class _InstrumentedLock:
         for _ in range(count - 1):
             self._inner.release()
         self._inner.release()
+        h = _VERIFY_HOOK
+        if h is not None:
+            h.on_release(self.name, id(self))
         return count
 
     def _acquire_restore(self, count) -> None:
+        h = _VERIFY_HOOK
+        if h is not None:
+            h.on_acquire(self.name, id(self), True)
         for _ in range(count):
             self._inner.acquire()
         self._owner = threading.get_ident()
@@ -467,6 +540,43 @@ def GuardedLock(name: str, reentrant: bool = True):  # noqa: N802 — ctor-like
     return threading.RLock() if reentrant else threading.Lock()
 
 
+class GuardedCondition(threading.Condition):
+    """``threading.Condition`` whose wait/notify the interleaving explorer
+    (tpusched/verify) can take over.  With no explorer hook installed —
+    production, debug soaks, telemetry — every method defers straight to
+    the stdlib implementation over the same (possibly instrumented) lock;
+    the only added cost is one module-global ``is None`` test.
+
+    Under the explorer, ``wait()`` becomes a MODELED wait: the waiter is
+    registered in the explorer's wakeup model *before* the lock is
+    released (the same atomicity the real Condition provides, so a modeled
+    notify cannot be lost), the thread parks at a scheduling decision
+    point instead of a real waiter lock, and the re-acquire goes back
+    through the instrumented lock's ``_acquire_restore`` — which is
+    exactly what keeps the recorder's per-thread lock-stack accounting
+    intact across the release → notify → re-acquire hand-off."""
+
+    def wait(self, timeout: Optional[float] = None):
+        h = _VERIFY_HOOK
+        if h is not None:
+            handled = h.on_cond_wait(self, timeout)
+            if handled is not None:
+                return handled
+        return super().wait(timeout)
+
+    def notify(self, n: int = 1) -> None:
+        h = _VERIFY_HOOK
+        if h is not None:
+            h.on_cond_notify(self, n)
+        super().notify(n)
+
+    def notify_all(self) -> None:
+        h = _VERIFY_HOOK
+        if h is not None:
+            h.on_cond_notify(self, None)
+        super().notify_all()
+
+
 # =============================================================================
 # Guarded-state runtime assertions (@guarded_by debug half)
 # =============================================================================
@@ -489,6 +599,12 @@ def _lock_is_held(lock) -> bool:
 
 def _check(owner_ref, field: str, op: str) -> None:
     owner, lock_attr = owner_ref
+    h = _VERIFY_HOOK
+    if h is not None:
+        # every guarded-container mutation is an explorer yield point —
+        # the label keys dependence, so two threads mutating the same
+        # declared field are ordered facts in the explored schedule
+        h.on_point(f"guarded:{type(owner).__name__}.{field}")
     lock = getattr(owner, lock_attr, None)
     if lock is None or _lock_is_held(lock):
         return

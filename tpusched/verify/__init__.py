@@ -1,0 +1,29 @@
+"""tpuverify: systematic interleaving exploration with deterministic
+replay.
+
+The correctness scaffolding ROADMAP item 1's sharded dispatch lands on:
+a cooperative deterministic scheduler (runtime.CoopRuntime) takes control
+of scheduler-owned threads at the yield points the debug-mode locks
+already mark, an explorer (explorer.Explorer) drives seeded random-walk
+and PCT schedules over targeted critical-section scenarios
+(scenarios.SCENARIOS), and any failure emits a replayable schedule
+artifact that ``python -m tpusched.cmd.replay`` re-executes
+deterministically.  ``make race-smoke`` runs the bounded budget as a
+tier-1 gate.
+"""
+from .explorer import (ARTIFACT_VERSION, Explorer, ExploreReport, PCT,
+                       RandomWalk, Replay, ReplayDivergence, ScheduleResult,
+                       canonical_trace_key, dump_artifact, load_artifact,
+                       make_artifact, replay_artifact, validate_artifact)
+from .runtime import CoopRuntime, HarnessHang, KilledWorker, atomic_region
+from .scenarios import (LIVE_SCENARIOS, SCENARIOS, SELFCHECK_BUGGY,
+                        Scenario)
+
+__all__ = [
+    "ARTIFACT_VERSION", "CoopRuntime", "Explorer", "ExploreReport",
+    "HarnessHang", "KilledWorker", "LIVE_SCENARIOS", "PCT", "RandomWalk",
+    "Replay", "ReplayDivergence", "SCENARIOS", "SELFCHECK_BUGGY",
+    "Scenario", "ScheduleResult", "atomic_region", "canonical_trace_key",
+    "dump_artifact", "load_artifact", "make_artifact", "replay_artifact",
+    "validate_artifact",
+]

@@ -1,0 +1,381 @@
+"""Systematic interleaving exploration with deterministic replay.
+
+The explorer drives one SCENARIO (a small set of threads over real
+scheduler objects, see scenarios.py) through many cooperative schedules
+(runtime.CoopRuntime), checking the scenario's invariants plus the lock
+discipline recorder (the C7 half of the chaos soaks) after every one.
+
+Scheduling strategies:
+
+``RandomWalk``
+    seeded uniform choice among runnable workers at every decision point —
+    the classic random stress, but over MODELED yield points, so one
+    schedule covers an interleaving the OS might produce once a year.
+``PCT``
+    priority-based with ``depth`` change points (Burckhardt et al.'s
+    probabilistic concurrency testing): workers get random priorities, the
+    highest-priority runnable worker always runs, and at d random steps
+    the running worker's priority drops below everyone — which provably
+    finds any bug of "depth" d with useful probability, and in practice
+    digs out the one-preemption-in-the-wrong-place bugs a uniform walk
+    dilutes away.
+``Replay``
+    consumes a recorded decision list verbatim and diverges loudly if the
+    execution does not offer the recorded choice — the deterministic
+    replay contract behind the schedule artifact.
+
+Pruning: after every schedule the executed trace (sequence of effectful
+ops: acquire/release/wait/notify/point, labeled by lock/condition NAME so
+keys are stable across runs) is reduced to its Foata normal form — the
+canonical representative of its Mazurkiewicz equivalence class under the
+independence relation "different workers AND different objects commute".
+Schedules whose canonical forms collide explored the same
+happens-before partial order; the report counts them as pruned, which is
+the bounded DPOR-style measure of how much of the budget bought genuinely
+new orderings.
+
+A failing schedule yields a SCHEDULE ARTIFACT — scenario name, seed,
+strategy, the decision list, and the failure — serializable to JSON.
+``python -m tpusched.cmd.replay artifact.json`` re-executes it
+deterministically; see doc/ops.md "Reproducing a race-smoke failure".
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..util import locking
+from .runtime import CoopRuntime, HarnessHang, Worker
+
+ARTIFACT_VERSION = 1
+DEFAULT_MAX_STEPS = 5000
+DEFAULT_SCHEDULES = 64
+# PCT change points are sampled inside the EXPECTED schedule length, not
+# the step budget — a change point past the schedule's end never fires
+# and PCT degenerates to fixed priorities.  explore() adapts the horizon
+# to each scenario from the steps its schedules actually take.
+DEFAULT_PCT_HORIZON = 48
+
+
+class ReplayDivergence(RuntimeError):
+    """A replayed decision list did not match the execution — the artifact
+    and the code under test have drifted apart."""
+
+
+# -- strategies ----------------------------------------------------------------
+
+
+class RandomWalk:
+    label = "random-walk"
+
+    def __init__(self, rng: random.Random):
+        self._rng = rng
+
+    def choose(self, runnable: Sequence[str], fire: bool = False) -> str:
+        return runnable[self._rng.randrange(len(runnable))]
+
+
+class PCT:
+    label = "pct"
+
+    def __init__(self, rng: random.Random, depth: int = 3,
+                 horizon: int = DEFAULT_PCT_HORIZON):
+        self._rng = rng
+        self._prio: Dict[str, float] = {}
+        self._step = 0
+        self._change_at = sorted(rng.randrange(1, max(2, horizon))
+                                 for _ in range(depth))
+
+    def choose(self, runnable: Sequence[str], fire: bool = False) -> str:
+        for name in runnable:
+            if name not in self._prio:
+                self._prio[name] = self._rng.random()
+        self._step += 1
+        pick = max(runnable, key=lambda n: self._prio[n])
+        if self._change_at and self._step >= self._change_at[0]:
+            self._change_at.pop(0)
+            self._prio[pick] = min(self._prio.values()) - 1.0
+        return pick
+
+
+class Replay:
+    label = "replay"
+
+    def __init__(self, decisions: Sequence[str]):
+        self._decisions = list(decisions)
+        self._pos = 0
+
+    def choose(self, runnable: Sequence[str], fire: bool = False) -> str:
+        if self._pos >= len(self._decisions):
+            raise ReplayDivergence(
+                f"decision list exhausted at step {self._pos} but workers "
+                f"still need scheduling ({', '.join(runnable)}) — the "
+                f"execution diverged from the recorded schedule")
+        d = self._decisions[self._pos]
+        self._pos += 1
+        if d.startswith("~") != fire:
+            raise ReplayDivergence(
+                f"step {self._pos - 1}: recorded decision {d!r} is a "
+                f"{'timeout-fire' if d.startswith('~') else 'grant'} but "
+                f"the execution needs a {'timeout-fire' if fire else 'grant'}")
+        name = d[1:] if d.startswith("~") else d
+        if name not in runnable:
+            raise ReplayDivergence(
+                f"step {self._pos - 1}: recorded choice {d!r} is not "
+                f"schedulable (candidates: {', '.join(runnable)})")
+        return name
+
+
+# -- results -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScheduleResult:
+    ok: bool
+    failure: Optional[str]
+    decisions: List[str]
+    steps: int
+    trace_key: tuple
+    acquires: int          # C7 non-vacuity witness: instrumentation was on
+
+
+@dataclasses.dataclass
+class ExploreReport:
+    scenario: str
+    seed: int
+    schedules: int
+    failures: int
+    distinct_traces: int
+    pruned: int            # schedules that re-explored a known trace class
+    first_failure: Optional[dict]   # schedule artifact, replayable
+
+    @property
+    def ok(self) -> bool:
+        return self.failures == 0
+
+
+# -- trace canonicalization (DPOR-style pruning measure) -----------------------
+
+
+def canonical_trace_key(trace: Sequence[Tuple[str, str, str]]) -> tuple:
+    """Foata normal form of the trace: layers of pairwise-independent ops,
+    each op placed one past the deepest layer holding a dependent
+    predecessor.  Unique per Mazurkiewicz equivalence class, so two
+    schedules with equal keys explored the same happens-before partial
+    order.  O(n): dependence is exactly "same worker or same object", and
+    layers grow monotonically along each worker's program order and each
+    object's conflict order, so the deepest dependent predecessor is
+    always the LAST op of the same worker or the same object."""
+    layers: List[List[Tuple[str, str, str]]] = []
+    by_worker: Dict[str, int] = {}
+    by_obj: Dict[str, int] = {}
+    for op in trace:
+        worker, _, obj = op
+        li = max(by_worker.get(worker, -1), by_obj.get(obj, -1)) + 1
+        while len(layers) <= li:
+            layers.append([])
+        layers[li].append(op)
+        by_worker[worker] = li
+        by_obj[obj] = li
+    return tuple(tuple(sorted(layer)) for layer in layers)
+
+
+# -- the explorer --------------------------------------------------------------
+
+
+class Explorer:
+    """Runs scenarios under cooperative schedules.  Stateless between
+    calls except for configuration; every schedule constructs a FRESH
+    scenario instance (fresh locks, fresh recorder state) so schedules
+    cannot contaminate each other."""
+
+    def __init__(self, max_steps: int = DEFAULT_MAX_STEPS,
+                 hang_timeout_s: float = 20.0):
+        self.max_steps = max_steps
+        self.hang_timeout_s = hang_timeout_s
+
+    def run_schedule(self, scenario, strategy) -> ScheduleResult:
+        """One schedule: set up the scenario under lock debug mode, drive
+        its threads per ``strategy``, then check invariants.  Restores all
+        global state (debug flag, verify hook, recorder) before returning."""
+        prev_debug = locking.set_debug(True)
+        rec = locking.recorder()
+        rec.reset()
+        rt = CoopRuntime(hang_timeout_s=self.hang_timeout_s)
+        prev_hook = locking.set_verify_hook(None)  # setup runs unexplored
+        decisions: List[str] = []
+        failure: Optional[str] = None
+        try:
+            ctx = scenario.setup()
+            by_name: Dict[str, Worker] = {}
+            for i, fn in enumerate(scenario.threads(ctx)):
+                w = rt.add_worker(f"T{i}", fn)
+                by_name[w.name] = w
+            locking.set_verify_hook(rt)
+            rt.start()
+            try:
+                failure = self._drive(rt, strategy, by_name, decisions)
+            except (HarnessHang, ReplayDivergence) as e:
+                failure = f"{type(e).__name__}: {e}"
+            finally:
+                locking.set_verify_hook(None)
+                if not rt.all_done():
+                    leaked = rt.kill_all()
+                    if leaked:
+                        failure = (
+                            f"{failure or 'schedule aborted'}; workers "
+                            f"{', '.join(leaked)} did not unwind — they "
+                            f"are blocked outside the model and may "
+                            f"pollute the recorder in later schedules "
+                            f"(treat this whole run as suspect)")
+            if failure is None:
+                for w in rt.workers:
+                    if w.error is not None:
+                        failure = (f"worker {w.name} raised: "
+                                   f"{type(w.error).__name__}: {w.error}")
+                        break
+            if failure is None:
+                viol = rec.violations()
+                if viol:
+                    failure = f"lock discipline violated: {viol[0]}"
+            if failure is None and rt.atomicity_violations:
+                failure = rt.atomicity_violations[0]
+            if failure is None:
+                try:
+                    scenario.check(ctx)
+                except AssertionError as e:
+                    failure = f"invariant violated: {e}"
+            return ScheduleResult(ok=failure is None, failure=failure,
+                                  decisions=decisions, steps=rt.steps,
+                                  trace_key=canonical_trace_key(rt.trace),
+                                  acquires=rec.acquires)
+        finally:
+            locking.set_verify_hook(prev_hook)
+            rec.reset()
+            locking.set_debug(prev_debug)
+
+    def _drive(self, rt: CoopRuntime, strategy,
+               by_name: Dict[str, Worker],
+               decisions: List[str]) -> Optional[str]:
+        """The scheduling loop: grant turns until every worker finishes or
+        the schedule fails.  Returns a failure description or None."""
+        while not rt.all_done():
+            if rt.steps > self.max_steps:
+                return (f"step budget exceeded ({self.max_steps}) — "
+                        f"modeled livelock? ({rt.describe_states()})")
+            runnable = rt.runnable_workers()
+            if runnable:
+                names = [w.name for w in runnable]
+                pick = strategy.choose(names, fire=False)
+                decisions.append(pick)
+                rt.grant(by_name[pick])
+                continue
+            timed = rt.timed_waiters()
+            if timed:
+                # nothing can run: some timed wait must fire.  Which one is
+                # a scheduling decision like any other (recorded as ~name).
+                names = [w.name for w in timed]
+                pick = strategy.choose(names, fire=True)
+                decisions.append("~" + pick)
+                rt.grant(by_name[pick], fire_timeout=True)
+                continue
+            return ("modeled deadlock: no runnable worker and no timed "
+                    f"wait to fire ({rt.describe_states()})")
+        return None
+
+    def explore(self, scenario_factory, seed: int = 0,
+                schedules: int = DEFAULT_SCHEDULES,
+                stop_on_failure: bool = True) -> ExploreReport:
+        """Seeded exploration: alternate RandomWalk and PCT schedules,
+        dedupe by canonical trace, capture the first failure as a
+        replayable artifact."""
+        name = scenario_factory.name
+        seen: set = set()
+        failures = 0
+        pruned = 0
+        first_failure: Optional[dict] = None
+        ran = 0
+        horizon = DEFAULT_PCT_HORIZON
+        for i in range(schedules):
+            rng = random.Random(f"{seed}:{i}")
+            strategy = PCT(rng, depth=3, horizon=horizon) \
+                if i % 2 else RandomWalk(rng)
+            res = self.run_schedule(scenario_factory(), strategy)
+            ran += 1
+            # adapt the change-point horizon to what this scenario's
+            # schedules actually take (deterministic: derived from prior
+            # results only), so PCT preemptions land inside the schedule
+            horizon = max(8, res.steps)
+            if res.trace_key in seen:
+                pruned += 1
+            else:
+                seen.add(res.trace_key)
+            if not res.ok:
+                failures += 1
+                if first_failure is None:
+                    first_failure = make_artifact(
+                        name, seed=f"{seed}:{i}", strategy=strategy.label,
+                        decisions=res.decisions, failure=res.failure,
+                        steps=res.steps)
+                if stop_on_failure:
+                    break
+        return ExploreReport(scenario=name, seed=seed, schedules=ran,
+                             failures=failures, distinct_traces=len(seen),
+                             pruned=pruned, first_failure=first_failure)
+
+
+# -- schedule artifacts --------------------------------------------------------
+
+
+def make_artifact(scenario: str, seed: str, strategy: str,
+                  decisions: List[str], failure: Optional[str],
+                  steps: int) -> dict:
+    return {"version": ARTIFACT_VERSION, "scenario": scenario,
+            "seed": seed, "strategy": strategy, "decisions": list(decisions),
+            "failure": failure, "steps": steps}
+
+
+def validate_artifact(data: dict) -> dict:
+    """Schema check for a loaded artifact; raises ValueError with the
+    first problem found."""
+    if not isinstance(data, dict):
+        raise ValueError("artifact must be a JSON object")
+    if data.get("version") != ARTIFACT_VERSION:
+        raise ValueError(f"unsupported artifact version {data.get('version')!r}"
+                         f" (want {ARTIFACT_VERSION})")
+    for field, typ in (("scenario", str), ("seed", str), ("strategy", str),
+                       ("decisions", list), ("steps", int)):
+        if not isinstance(data.get(field), typ):
+            raise ValueError(f"artifact field {field!r} must be "
+                             f"{typ.__name__}")
+    if not all(isinstance(d, str) for d in data["decisions"]):
+        raise ValueError("artifact decisions must all be strings")
+    if data.get("failure") is not None \
+            and not isinstance(data["failure"], str):
+        raise ValueError("artifact field 'failure' must be null or string")
+    return data
+
+
+def load_artifact(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return validate_artifact(json.load(f))
+
+
+def dump_artifact(artifact: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def replay_artifact(artifact: dict, max_steps: int = DEFAULT_MAX_STEPS
+                    ) -> ScheduleResult:
+    """Re-execute a schedule artifact deterministically: same scenario,
+    same decisions, nothing random.  Raises KeyError for an unknown
+    scenario and ReplayDivergence (inside the result's failure) if the
+    execution no longer matches the recorded decisions."""
+    from .scenarios import SCENARIOS
+    factory = SCENARIOS[artifact["scenario"]]
+    explorer = Explorer(max_steps=max_steps)
+    return explorer.run_schedule(factory(), Replay(artifact["decisions"]))

@@ -1,0 +1,346 @@
+"""Cooperative-scheduling kernel for the interleaving explorer.
+
+This is the half of tpuverify that touches threads.  A ``CoopRuntime``
+owns a set of WORKER threads (the scenario's actors) and a single TURN
+token: exactly one worker runs at any moment, everything else is parked.
+Workers hand the turn back at YIELD POINTS — the acquisition boundaries
+the debug-mode locks already mark (util/locking installs this object as
+its ``_VERIFY_HOOK``): before a ``GuardedLock`` acquire, after a full
+release, across a ``GuardedCondition`` wait/notify, at every
+``@guarded_by`` container mutation, and at the explicit
+``locking.verify_point`` markers (the binding pool's plain-Queue
+boundaries).  Between two yield points a worker runs REAL production code;
+because nothing else runs concurrently, that stretch is atomic by
+construction and the schedule is fully determined by the sequence of
+grant decisions — which is what makes a recorded decision list a
+deterministic replay artifact.
+
+The runtime keeps a MODEL of lock ownership and condition waiters,
+updated at the hooks while the mutating worker holds the turn (so the
+model needs no synchronization of its own).  The scheduler (the explorer,
+on the calling thread) only grants the turn to workers the model says can
+make progress; a worker that would block on a modeled lock is parked
+until the holder releases, so the real locks never block a running
+worker.  Condition waits are modeled the same way: the waiter registers
+in the model BEFORE the lock is released (the atomicity the real
+Condition provides), parks, and is woken by a modeled notify — or, for
+timed waits, by an explicit timeout-fire decision.  A state where no
+worker is runnable and no timed wait can fire is a MODELED DEADLOCK and
+is reported as a finding, long before any wall-clock hang.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..util import locking
+
+# A worker that executes real code for this long without reaching a yield
+# point (or finishing) has escaped the model — a real block on something
+# the runtime cannot see.  Abort the schedule instead of hanging the run.
+HANG_TIMEOUT_S = 20.0
+
+
+class KilledWorker(BaseException):
+    """Raised inside a worker to unwind it when the run aborts.  A
+    BaseException on purpose: production code's broad ``except Exception``
+    isolation (informer dispatch, binding workers) must not swallow the
+    teardown."""
+
+
+class HarnessHang(RuntimeError):
+    """A worker ran past HANG_TIMEOUT_S without yielding — it is blocked on
+    something outside the model (a real lock the hooks do not cover)."""
+
+
+class Worker:
+    __slots__ = ("name", "fn", "evt", "thread", "done", "error",
+                 "blocked_on", "waiting_on", "wait_timed", "wait_seq",
+                 "wake_pending", "wake_notified", "suppress_yield")
+
+    def __init__(self, name: str, fn: Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.evt = threading.Event()           # turn grant
+        self.thread: Optional[threading.Thread] = None
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.blocked_on: Optional[Tuple[str, int]] = None   # modeled lock
+        self.waiting_on: Optional[int] = None  # id(condition) while waiting
+        self.wait_timed = False
+        self.wait_seq = 0                      # FIFO order among waiters
+        self.wake_pending = False              # notify/timeout delivered
+        self.wake_notified = False             # wake reason (True = notify)
+        self.suppress_yield = False            # release inside a cond wait
+
+
+class CoopRuntime:
+    """One schedule's worth of cooperative execution state.  Construct,
+    ``add_worker`` the scenario's actors, install via
+    ``locking.set_verify_hook``, ``start()``, then drive with
+    ``grant()``/``runnable_workers()`` from the scheduling loop."""
+
+    def __init__(self, hang_timeout_s: float = HANG_TIMEOUT_S):
+        self.workers: List[Worker] = []
+        self._by_ident: Dict[int, Worker] = {}
+        self._sched_evt = threading.Event()
+        # modeled lock table: (name, id) → [holder, reentry count]
+        self._locks: Dict[Tuple[str, int], list] = {}
+        # execution trace of effectful ops: (worker, kind, object-label).
+        # Object labels are run-stable (lock NAMES, not ids) so canonical
+        # trace keys compare across schedules.
+        self.trace: List[Tuple[str, str, str]] = []
+        # atomicity assertions (atomic_region) that observed a foreign
+        # dependent op inside their span — checked after every schedule
+        self.atomicity_violations: List[str] = []
+        self.steps = 0
+        self.aborted = False
+        self.hang_timeout_s = hang_timeout_s
+        self._wait_seq = 0           # stamps cond waiters in arrival order
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def add_worker(self, name: str, fn: Callable[[], None]) -> Worker:
+        w = Worker(name, fn)
+        self.workers.append(w)
+        return w
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.thread = threading.Thread(target=self._main, args=(w,),
+                                        name=f"tpuverify-{w.name}",
+                                        daemon=True)
+            w.thread.start()
+
+    def _main(self, w: Worker) -> None:
+        self._by_ident[threading.get_ident()] = w
+        w.evt.wait()                    # start gate: the first grant
+        try:
+            if not self.aborted:
+                w.fn()
+        except KilledWorker:
+            pass
+        except Exception as e:          # scenario assertion / real bug
+            w.error = e
+        finally:
+            w.done = True
+            self._sched_evt.set()
+
+    def kill_all(self) -> List[str]:
+        """Abort the schedule: every parked worker raises KilledWorker at
+        its yield point and unwinds.  Model state is garbage afterwards —
+        collect results BEFORE calling this.  Returns the names of
+        workers that did NOT unwind within the join timeout (blocked on
+        something outside the model): such a thread can wake later and
+        feed the process-global recorder mid-unrelated-schedule, so the
+        caller must mark the whole run suspect, not just this schedule."""
+        self.aborted = True
+        for w in self.workers:
+            w.evt.set()
+        leaked = []
+        for w in self.workers:
+            if w.thread is not None:
+                w.thread.join(timeout=2.0)
+                if w.thread.is_alive():
+                    leaked.append(w.name)
+        return leaked
+
+    # -- scheduler side --------------------------------------------------------
+
+    def all_done(self) -> bool:
+        return all(w.done for w in self.workers)
+
+    def runnable_workers(self) -> List[Worker]:
+        return [w for w in self.workers
+                if not w.done and w.blocked_on is None
+                and (w.waiting_on is None or w.wake_pending)]
+
+    def timed_waiters(self) -> List[Worker]:
+        return [w for w in self.workers
+                if not w.done and w.waiting_on is not None
+                and not w.wake_pending and w.wait_timed]
+
+    def grant(self, w: Worker, fire_timeout: bool = False) -> None:
+        """Hand the turn to ``w``; returns when it yields again, finishes,
+        or overruns the hang timeout.  ``fire_timeout`` wakes a timed
+        condition waiter as if its wait timed out."""
+        if fire_timeout:
+            w.wake_pending = True
+            w.wake_notified = False
+        self._sched_evt.clear()
+        w.evt.set()
+        if not self._sched_evt.wait(self.hang_timeout_s):
+            raise HarnessHang(
+                f"worker {w.name} did not reach a yield point within "
+                f"{self.hang_timeout_s:.0f}s — blocked outside the model?")
+
+    # -- worker side -----------------------------------------------------------
+
+    def _me(self) -> Optional[Worker]:
+        return self._by_ident.get(threading.get_ident())
+
+    def _pause(self, w: Worker) -> None:
+        """Hand the turn back and park until granted again."""
+        self.steps += 1
+        w.evt.clear()
+        self._sched_evt.set()
+        w.evt.wait()
+        if self.aborted:
+            raise KilledWorker()
+
+    # -- locking._VERIFY_HOOK protocol ----------------------------------------
+
+    def on_acquire(self, name: str, ident: int, blocking: bool = True) -> bool:
+        w = self._me()
+        if w is None or self.aborted:
+            return True
+        key = (name, ident)
+        self._pause(w)                  # decision point before the acquire
+        while True:
+            ent = self._locks.get(key)
+            if ent is None:
+                self._locks[key] = [w, 1]
+            elif ent[0] is w:
+                # Only reachable by re-acquiring a NON-reentrant lock the
+                # worker already holds: a reentrant lock's re-acquire
+                # short-circuits before this hook fires, and
+                # _acquire_restore only runs after a full release.  The
+                # real acquire would block forever — report it instead of
+                # letting the schedule burn the hang timeout.
+                if not blocking:
+                    self.trace.append((w.name, "tryfail", name))
+                    return False
+                raise RuntimeError(
+                    f"modeled self-deadlock: {w.name} re-acquires "
+                    f"non-reentrant lock {name} it already holds")
+            elif not blocking:
+                self.trace.append((w.name, "tryfail", name))
+                return False
+            else:
+                w.blocked_on = key      # granted again only after release
+                self._pause(w)
+                continue
+            self.trace.append((w.name, "acquire", name))
+            return True
+
+    def on_release(self, name: str, ident: int) -> None:
+        w = self._me()
+        if w is None or self.aborted:
+            return
+        key = (name, ident)
+        ent = self._locks.get(key)
+        if ent is not None and ent[0] is w:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del self._locks[key]
+                for b in self.workers:
+                    if b.blocked_on == key:
+                        b.blocked_on = None
+        self.trace.append((w.name, "release", name))
+        if w.suppress_yield:            # release inside a modeled cond wait:
+            w.suppress_yield = False    # the wait itself is the decision point
+            return
+        self._pause(w)                  # decision point after the release
+
+    def on_cond_wait(self, cond, timeout) -> Optional[bool]:
+        w = self._me()
+        if w is None or self.aborted:
+            return None                 # unmanaged thread: real wait
+        lock = getattr(cond, "_lock", None)
+        if not hasattr(lock, "_release_save") or not hasattr(lock, "name"):
+            return None                 # not an instrumented GuardedLock
+        if not lock._is_owned():
+            raise RuntimeError("cannot wait() on an un-acquired condition")
+        # Register as a waiter BEFORE releasing the lock — a notify issued
+        # by the next lock holder must find us (no lost wakeups), exactly
+        # as the real Condition's waiter list guarantees.
+        w.waiting_on = id(cond)
+        w.wait_timed = timeout is not None
+        self._wait_seq += 1
+        w.wait_seq = self._wait_seq
+        w.wake_pending = False
+        w.wake_notified = False
+        w.suppress_yield = True
+        state = lock._release_save()    # on_release updates the model, no yield
+        self.trace.append((w.name, "wait", f"cond:{lock.name}"))
+        self._pause(w)                  # parked until notify / timeout-fire
+        w.waiting_on = None
+        w.wake_pending = False
+        lock._acquire_restore(state)    # on_acquire: contends like anyone else
+        return w.wake_notified
+
+    def on_cond_notify(self, cond, n: Optional[int] = None) -> None:
+        """``n`` is the wake count (None = notify_all).  Waiters wake in
+        arrival order, matching the stdlib Condition's FIFO waiter list —
+        modeling notify(1) as notify-all would explore wakeups production
+        cannot execute and hide lost-single-wake bugs."""
+        w = self._me()
+        if w is None or self.aborted:
+            return
+        waiters = sorted((b for b in self.workers
+                          if b.waiting_on == id(cond)
+                          and not b.wake_pending),
+                         key=lambda b: b.wait_seq)
+        if n is not None:
+            waiters = waiters[:n]
+        for b in waiters:
+            b.wake_pending = True
+            b.wake_notified = True
+        lock = getattr(cond, "_lock", None)
+        label = f"cond:{getattr(lock, 'name', 'condition')}"
+        self.trace.append((w.name, "notify", label))
+        self._pause(w)                  # decision point after the notify
+
+    def on_point(self, label: str) -> None:
+        w = self._me()
+        if w is None or self.aborted:
+            return
+        self.trace.append((w.name, "point", label))
+        self._pause(w)
+
+    # -- reporting -------------------------------------------------------------
+
+    def describe_states(self) -> str:
+        parts = []
+        for w in self.workers:
+            if w.done:
+                st = "done"
+            elif w.blocked_on is not None:
+                st = f"blocked on {w.blocked_on[0]}"
+            elif w.waiting_on is not None:
+                st = "in cond.wait" + (" (timed)" if w.wait_timed else "")
+            else:
+                st = "runnable"
+            parts.append(f"{w.name}: {st}")
+        return ", ".join(parts)
+
+
+def install(rt: CoopRuntime):
+    """Install ``rt`` as the process-global explorer hook.  Returns the
+    previous hook for restoration."""
+    return locking.set_verify_hook(rt)
+
+
+@contextlib.contextmanager
+def atomic_region(label: str, objects: Tuple[str, ...]):
+    """Declare that the wrapped span must be atomic with respect to the
+    named objects: if any OTHER worker's effectful op whose trace label
+    contains one of the ``objects`` tokens lands inside the span, the
+    schedule fails with an atomicity violation.  A no-op outside the
+    explorer, so production code paths may carry the declaration."""
+    h = locking.verify_hook()
+    if not isinstance(h, CoopRuntime):
+        yield
+        return
+    me = h._me()
+    start = len(h.trace)
+    yield
+    if me is None:
+        return
+    for wname, kind, obj in h.trace[start:]:
+        if wname != me.name and any(tok in obj for tok in objects):
+            h.atomicity_violations.append(
+                f"atomic region {label!r} ({me.name}) interleaved with "
+                f"{wname}'s {kind} on {obj}")
+

@@ -1,0 +1,488 @@
+"""Interleaving scenarios: the critical-section pairs the sharded core
+(ROADMAP item 1) will stress, each run under the explorer's full schedule
+budget by ``make race-smoke`` (tests/test_verify_scenarios.py).
+
+A Scenario is deliberately tiny: ``setup()`` builds REAL scheduler objects
+(Cache, SchedulingQueue, Informer, _BindingPool — under lock debug mode,
+so every acquisition boundary is an explorer yield point), ``threads()``
+returns the actors (each a plain callable; everything an actor does
+between two yield points is atomic by construction), and ``check()``
+asserts the quiescence invariants after all actors finish.  The explorer
+additionally asserts, on every schedule, that the lock-discipline
+recorder saw zero violations (the chaos soaks' C7) and that any declared
+``atomic_region`` really ran interleaving-free.
+
+Scenarios must be DETERMINISTIC: injected counter clocks, no wall-time
+branching, no unmanaged threads (the binding pool is constructed with
+zero workers — its shutdown/submit hand-off is the race under test, not
+its workers).  ``selfcheck-*`` scenarios carry deliberately seeded bugs;
+the race-smoke meta-test proves the explorer finds them (non-vacuity)
+and that their artifacts replay deterministically.
+"""
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Callable, Dict, List, Type
+
+from ..apiserver import server as srv
+from ..apiserver.informers import Informer
+from ..fwk.interfaces import EVENT_ADD, RESOURCE_NODE
+from ..sched.cache import Cache
+from ..sched.equivcache import EquivEntry, EquivalenceCache
+from ..sched.queue import SchedulingQueue
+from ..testing import make_node, make_pod
+from ..util import locking
+from .runtime import atomic_region
+
+
+class Scenario:
+    """One interleaving scenario.  Subclasses set ``name`` and implement
+    the three hooks; a fresh instance runs per schedule."""
+
+    name = ""
+
+    def setup(self):
+        """Build the objects under test (runs unexplored, on the driving
+        thread, with lock debug mode already on).  Returns the ctx handed
+        to threads() and check()."""
+        raise NotImplementedError
+
+    def threads(self, ctx) -> List[Callable[[], None]]:
+        raise NotImplementedError
+
+    def check(self, ctx) -> None:
+        """Quiescence invariants; raise AssertionError on violation."""
+
+
+SCENARIOS: Dict[str, Type[Scenario]] = {}
+
+
+def register(cls: Type[Scenario]) -> Type[Scenario]:
+    assert cls.name and cls.name not in SCENARIOS
+    SCENARIOS[cls.name] = cls
+    return cls
+
+
+def _counter_clock(ctx):
+    """Deterministic injectable clock: reads ``ctx.now``."""
+    return lambda: ctx.now
+
+
+# -- the live-tree pairs -------------------------------------------------------
+
+
+@register
+class EquivcacheArming(Scenario):
+    """Equivalence-cache arming guard vs. a foreign cache mutation.
+
+    The dispatch actor replays the scheduler's exact arming protocol
+    (scheduler._equiv_offer / _equiv_after_assume): snapshot, remember the
+    snapshot cursor, assume its own pod, then arm the entry iff the
+    mutation cursor advanced by EXACTLY its own assume.  The foreign actor
+    is a watch-confirmed pod landing via the informer path.  Invariant: an
+    ARMED entry implies the foreign mutation did not land inside the
+    (snapshot, arm] window — the guard's whole job."""
+
+    name = "equivcache-arming"
+
+    # guard tweak point so the seeded-bug variant can break exactly one
+    # comparison (see SelfcheckBrokenArming)
+    def _guard(self, cur: int, cyc: int) -> bool:
+        return cur == cyc + 1
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, events=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(make_node("n1"))
+        ctx.cache.add_node(make_node("n2"))
+        ctx.ec = EquivalenceCache()
+        return ctx
+
+    def threads(self, ctx):
+        def dispatch():
+            ctx.cache.snapshot()
+            cyc = ctx.cache.snapshot_cursor()
+            entry = EquivEntry("class-a", (), 0, {}, frozenset(), None,
+                               ("n1",))
+            ctx.cache.assume_pod(make_pod("own"), "n1")
+            cur = ctx.cache.mutation_cursor()
+            if self._guard(cur, cyc):
+                # between the cursor read and the arm nothing foreign may
+                # touch the cache — the guard's verdict is already cast
+                with atomic_region("equiv-arm", ("sched.Cache",)):
+                    ctx.ec.arm(entry, cyc + 1)
+                ctx.events.append(("armed", cyc, cur))
+            else:
+                ctx.ec.drop(entry.key)
+                ctx.events.append(("dropped", cyc, cur))
+
+        def foreign():
+            confirmed = make_pod("foreign", node_name="n2")
+            # the add and its cursor read share one critical section (the
+            # outer acquire makes the inner ones reentrant), so the
+            # recorded cursor is EXACTLY the foreign mutation's — read
+            # outside, the cursor could lag past dispatch's own assume
+            # and indict an innocent interleaving
+            with ctx.cache._lock:
+                ctx.cache.add_pod(confirmed)
+                ctx.events.append(("foreign", ctx.cache.mutation_cursor()))
+
+        return [dispatch, foreign]
+
+    def check(self, ctx):
+        armed = [e for e in ctx.events if e[0] == "armed"]
+        if not armed:
+            return
+        _, cyc, cur = armed[0]
+        for e in ctx.events:
+            if e[0] == "foreign":
+                fcur = e[1]
+                assert not (cyc < fcur <= cur), (
+                    f"entry armed at cursor {cur} although a foreign "
+                    f"mutation landed at cursor {fcur} inside the "
+                    f"(snapshot={cyc}, arm] window — the arming guard "
+                    f"let a concurrent mutation be laundered into a "
+                    f"'valid' cache entry")
+
+
+@register
+class CacheAssumeConfirm(Scenario):
+    """assume → {bind-commit finish_binding | watch-confirm add_pod |
+    TTL-expiry sweep} in every order.
+
+    setup() performs the assume (it happens-before both the bind commit
+    and the watch confirm in the live system); the actors are the three
+    threads that then race: the binding worker arming the TTL, the
+    informer delivering the confirmed pod, and a scheduling cycle whose
+    snapshot() runs the expiry sweep after the TTL would have lapsed.
+    Invariant: exactly one attached copy of the pod, assume table empty,
+    nothing leaked or double-attached."""
+
+    name = "cache-assume-confirm"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0)
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(make_node("n1"))
+        ctx.pod = make_pod("p")
+        ctx.confirmed = make_pod("p", node_name="n1")
+        ctx.cache.assume_pod(ctx.pod, "n1")
+        return ctx
+
+    def threads(self, ctx):
+        def bind_commit():
+            ctx.cache.finish_binding(ctx.pod)
+
+        def watch_confirm():
+            ctx.cache.add_pod(ctx.confirmed)
+
+        def expiry_sweep():
+            ctx.now = 100.0          # beyond ASSUME_EXPIRATION_S
+            ctx.cache.snapshot()     # runs _cleanup_expired_locked
+
+        return [bind_commit, watch_confirm, expiry_sweep]
+
+    def check(self, ctx):
+        key = ctx.pod.key
+        assert not ctx.cache.is_assumed(key), (
+            "assume-table entry survived bind-commit + watch-confirm — "
+            "the entry would leak its quorum count forever")
+        snap = ctx.cache.snapshot()
+        attached = [p for info in snap.list() for p in info.pods
+                    if p.key == key]
+        assert len(attached) == 1, (
+            f"{len(attached)} attached copies of {key} after confirm "
+            f"(want exactly 1): assume/confirm/expire interleaving "
+            f"double-attached or lost the pod")
+
+
+@register
+class QueuePopVsMove(Scenario):
+    """queue.pop() (including its Condition wait) vs. a coalesced
+    move_all_to_active_or_backoff storm.  Invariant: the parked pod is
+    delivered exactly once — either returned by pop or still pending —
+    and never both or neither (the lost-wakeup / lost-pod wedge)."""
+
+    name = "queue-pop-vs-move"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, popped=[])
+
+        def less(a, b):
+            if a.pod.priority != b.pod.priority:
+                return a.pod.priority > b.pod.priority
+            return a.timestamp < b.timestamp
+
+        # backoff 0: the pod is schedulable the moment the event moves it,
+        # so modeled time never has to advance past a real backoff window
+        ctx.q = SchedulingQueue(less, clock=_counter_clock(ctx),
+                                initial_backoff_s=0, max_backoff_s=0)
+        ctx.pod = make_pod("a")
+        ctx.q.add(ctx.pod)
+        info = ctx.q.pop(timeout=0)
+        assert info is not None
+        ctx.q.requeue_after_failure(info)    # parks in unschedulableQ
+        return ctx
+
+    def threads(self, ctx):
+        def consumer():
+            ctx.popped.append(ctx.q.pop(timeout=5.0))
+
+        def informer_storm():
+            ctx.q.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)
+
+        return [consumer, informer_storm]
+
+    def check(self, ctx):
+        got = [i for i in ctx.popped if i is not None]
+        pending = [p for p in ctx.q.pending_pods()
+                   if p.key == ctx.pod.key]
+        assert len(got) + len(pending) == 1, (
+            f"pod delivered {len(got)} time(s) and pending "
+            f"{len(pending)} time(s) — a queued pod must be in exactly "
+            f"one place after a pop/move race")
+        assert len(got) == 1, (
+            "pop returned None although the move event made the pod "
+            "schedulable and notified — lost wakeup")
+
+
+@register
+class InformerDeleteRace(Scenario):
+    """Informer live DELETED delivery vs. resync() relist-and-diff vs. a
+    dispatch-side reader, all feeding the scheduler cache.  Invariant: at
+    quiescence the pod is gone from the informer cache AND the scheduler
+    cache, with delete handlers tolerating the duplicate delivery the
+    at-least-once contract allows."""
+
+    name = "informer-delete-resync"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, deletes=[])
+        ctx.api = srv.APIServer()
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(make_node("n1"))
+        ctx.pod = make_pod("doomed", node_name="n1")
+        ctx.api.create(srv.PODS, ctx.pod)
+        ctx.inf = Informer(ctx.api, srv.PODS)
+
+        def on_add(obj):
+            if obj.spec.node_name:
+                ctx.cache.add_pod(obj)
+
+        def on_delete(obj):
+            ctx.deletes.append(obj.meta.key)
+            ctx.cache.remove_pod(obj)
+
+        ctx.inf.add_event_handler(on_add=on_add, on_delete=on_delete)
+        return ctx
+
+    def threads(self, ctx):
+        def deleter():
+            ctx.api.delete(srv.PODS, ctx.pod.meta.key)
+
+        def resyncer():
+            ctx.inf.resync()
+
+        def dispatch_reader():
+            ctx.inf.items()
+            ctx.cache.snapshot()
+
+        return [deleter, resyncer, dispatch_reader]
+
+    def check(self, ctx):
+        assert ctx.inf.get(ctx.pod.meta.key) is None, (
+            "informer cache still holds the deleted pod — a resync "
+            "racing the live DELETED resurrected it")
+        snap = ctx.cache.snapshot()
+        left = [p for info in snap.list() for p in info.pods]
+        assert not left, (
+            f"scheduler cache still attaches {[p.key for p in left]} "
+            f"after the delete — dispatch kept a pod the API server "
+            f"no longer has")
+        assert len(ctx.deletes) >= 1, (
+            "delete handler never fired — the event was lost between "
+            "the live watch and the resync diff")
+
+
+@register
+class BindpoolShutdownDrain(Scenario):
+    """_BindingPool shutdown-drain vs. a late permit resolution
+    submitting its binding task.  Invariant: the task is executed XOR
+    aborted, exactly once — a task that is neither would hold its pod's
+    reservation forever (the leak the post-put re-check in submit()
+    closes).  Zero workers keeps the schedule fully modeled; with no
+    worker the task can never execute, so exactly one abort must happen."""
+
+    name = "bindpool-shutdown-drain"
+
+    def setup(self):
+        from ..sched.scheduler import _BindingPool
+        ctx = SimpleNamespace(executed=[], aborted=[])
+        ctx.pool = _BindingPool(0)
+        return ctx
+
+    def threads(self, ctx):
+        def late_permit():
+            def run(task):
+                ctx.executed.append(task)
+
+            def abort(task):
+                ctx.aborted.append(task)
+
+            try:
+                ctx.pool.submit(run, abort, "bind-task")
+            except RuntimeError:
+                # scheduler.on_permit_resolved's contract: the submitter
+                # aborts inline when the pool already refused
+                abort("bind-task")
+
+        def stopper():
+            ctx.pool.shutdown(timeout=0.1)
+
+        return [late_permit, stopper]
+
+    def check(self, ctx):
+        total = len(ctx.executed) + len(ctx.aborted)
+        assert total == 1, (
+            f"binding task finished {len(ctx.executed)}x and aborted "
+            f"{len(ctx.aborted)}x (want exactly one outcome) — a task "
+            f"with no outcome leaks its pod's reservation; two outcomes "
+            f"double-release it")
+
+
+@register
+class CondHandoff(Scenario):
+    """GuardedCondition wait() hand-off: a notify delivered between the
+    waiter's release and re-acquire must neither be lost nor corrupt the
+    recorder's per-thread lock-stack accounting (C7 stays exact across
+    _release_save/_acquire_restore).  The explorer's recorder check plus
+    the post-wait re-acquire below are the witness."""
+
+    name = "cond-handoff"
+
+    def setup(self):
+        ctx = SimpleNamespace(flag=False, wakes=[])
+        ctx.lock = locking.GuardedLock("verify.handoff")
+        ctx.cond = locking.GuardedCondition(ctx.lock)
+        return ctx
+
+    def threads(self, ctx):
+        def waiter():
+            with ctx.cond:
+                while not ctx.flag:
+                    ctx.wakes.append(bool(ctx.cond.wait(1.0)))
+            # accounting witness: if the hand-off lost the per-thread
+            # stack, this re-acquire/release pair records a violation
+            with ctx.lock:
+                pass
+
+        def notifier():
+            with ctx.cond:
+                ctx.flag = True
+                ctx.cond.notify_all()
+
+        return [waiter, notifier]
+
+    def check(self, ctx):
+        assert ctx.flag, "notifier never ran"
+
+
+# -- seeded-bug self-checks (non-vacuity) --------------------------------------
+
+
+@register
+class SelfcheckLostUpdate(Scenario):
+    """DELIBERATE BUG: a read-modify-write whose read and write sit in
+    two separate critical sections — the textbook atomicity violation the
+    flow-sensitive lint rule also catches statically.  The explorer must
+    find a schedule where an increment is lost."""
+
+    name = "selfcheck-lost-update"
+
+    def setup(self):
+        ctx = SimpleNamespace(val=0)
+        ctx.lock = locking.GuardedLock("verify.selfcheck")
+        return ctx
+
+    def threads(self, ctx):
+        def bump():
+            with ctx.lock:
+                v = ctx.val
+            # lock released: the other actor's write can land here
+            with ctx.lock:
+                ctx.val = v + 1
+
+        return [bump, bump]
+
+    def check(self, ctx):
+        assert ctx.val == 2, (
+            f"lost update: val={ctx.val} after two increments")
+
+
+@register
+class SelfcheckAtomicUpdate(Scenario):
+    """Soundness control for the self-check: the same increment with the
+    read and write under ONE critical section.  No schedule may fail."""
+
+    name = "selfcheck-atomic-update"
+
+    def setup(self):
+        ctx = SimpleNamespace(val=0)
+        ctx.lock = locking.GuardedLock("verify.selfcheck")
+        return ctx
+
+    def threads(self, ctx):
+        def bump():
+            with ctx.lock:
+                ctx.val = ctx.val + 1
+
+        return [bump, bump]
+
+    def check(self, ctx):
+        assert ctx.val == 2, f"val={ctx.val} after two atomic increments"
+
+
+@register
+class SelfcheckBrokenArming(EquivcacheArming):
+    """DELIBERATE BUG: the arming guard accepts ANY cursor advance
+    (``>=`` instead of ``== +1``) — exactly the laundering the real guard
+    exists to stop.  The explorer must find the schedule where the
+    foreign mutation lands inside the window and the entry arms anyway."""
+
+    name = "selfcheck-broken-arming"
+
+    def _guard(self, cur: int, cyc: int) -> bool:
+        return cur >= cyc + 1
+    # check() is inherited: the parent invariant fires exactly when the
+    # broken guard arms across an in-window foreign mutation
+
+
+@register
+class SelfcheckTimeoutWake(Scenario):
+    """A timed wait with no notifier: the only way forward is the
+    explorer's timeout-fire decision — pins that ~decisions are taken,
+    recorded, and replayed."""
+
+    name = "selfcheck-timeout-wake"
+
+    def setup(self):
+        ctx = SimpleNamespace(wakes=[])
+        ctx.lock = locking.GuardedLock("verify.timeout")
+        ctx.cond = locking.GuardedCondition(ctx.lock)
+        return ctx
+
+    def threads(self, ctx):
+        def waiter():
+            with ctx.cond:
+                ctx.wakes.append(bool(ctx.cond.wait(0.01)))
+
+        return [waiter]
+
+    def check(self, ctx):
+        assert ctx.wakes == [False], (
+            f"timed wait with no notifier woke as {ctx.wakes} "
+            f"(want one timeout wake)")
+
+
+LIVE_SCENARIOS = tuple(n for n in SCENARIOS if not n.startswith("selfcheck-"))
+SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming")
