@@ -100,8 +100,22 @@ race-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_verify_scenarios.py \
 		-q -p no:cacheprovider
 
+# Replay-smoke (the fleet-trace/determinism gate, part of the tier1 flow):
+# record a tiny storm trace through the fleet trace capture, replay it
+# TWICE into identical configs and assert zero placement diff + identical
+# bind counts (the cmd.trace diff contract); a deliberately perturbed
+# scoring policy must produce a nonzero, attributed diff (non-vacuity);
+# capture overhead is gated ≤3% by the min-of-N / direct-attribution
+# methodology (trace/prof-smoke precedent); crash recovery (torn tail
+# segment tolerated, capture resumes into a fresh segment) and
+# capture-under-concurrent-scrape bounds ride in the same suite.
+.PHONY: replay-smoke
+replay-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_replay_smoke.py \
+		-q -p no:cacheprovider
+
 .PHONY: tier1
-tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke
+tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -51,8 +51,16 @@ capacity recycling) — binds/sec + p99 pod first-enqueue→bound, the
 pre-sharding baseline for ROADMAP item 1. Every full/--storm run also
 writes a schema-validated machine-readable results artifact
 (BENCH_RESULTS.json, ``--results-out PATH``) with per-scenario
-p50/p99/min/binds-per-sec and an environment stamp, so the perf
-trajectory is tracked across PRs as data.
+p50/p99/min/binds-per-sec and an environment stamp — including a workload
+block (storm seeds + arrival-stream hash, or the trace path under
+``--replay``) tying the numbers to a reproducible problem.
+
+``--replay TRACE_DIR``: storm bench over a RECORDED fleet trace
+(tpusched/obs/fleetrace.py): replays the captured arrival stream at
+recorded timescale into a fresh scheduler — the noise-robust A/B mode on
+boxes that cannot resolve small wall deltas (both arms run the
+byte-identical workload; see doc/performance.md "Deterministic replay
+methodology").
 """
 from __future__ import annotations
 
@@ -172,6 +180,12 @@ def _repeat(fn, n: int, *args, **kwargs):
 RESULTS_SCHEMA_VERSION = 1
 _RESULTS_PATH = "BENCH_RESULTS.json"
 _results_scenarios: dict = {}
+# workload identity for the environment stamp: which storm seeds /
+# recorded trace produced the numbers, and a hash of the arrival stream
+# itself — so a BENCH_RESULTS.json is tied to a REPRODUCIBLE workload,
+# not just a box (ISSUE 9: replay-based A/B is only meaningful when both
+# arms provably ran the same problem)
+_results_workload: dict = {}
 
 
 def _record_scenario(key: str, kind: str, **fields) -> None:
@@ -180,9 +194,15 @@ def _record_scenario(key: str, kind: str, **fields) -> None:
     _results_scenarios[key] = rec
 
 
+def _record_workload(**fields) -> None:
+    _results_workload.update(fields)
+
+
 def results_environment() -> dict:
     """The environment stamp: enough to tell two artifacts' boxes apart
-    without leaking anything sensitive."""
+    without leaking anything sensitive — plus the workload identity block
+    (storm seeds + stream hash, and the trace path under --replay) so the
+    artifact names the exact problem the numbers were measured on."""
     import platform
     commit = ""
     try:
@@ -193,13 +213,16 @@ def results_environment() -> dict:
             cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
     except Exception:
         pass
-    return {
+    env = {
         "python": platform.python_version(),
         "platform": platform.platform(),
         "cpu_count": os.cpu_count() or 0,
         "commit": commit,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if _results_workload:
+        env["workload"] = dict(_results_workload)
+    return env
 
 
 def build_results_artifact() -> dict:
@@ -224,6 +247,34 @@ def validate_results_artifact(doc) -> list:
         for k in ("python", "platform", "cpu_count", "timestamp"):
             if k not in env:
                 probs.append(f"environment.{k} missing")
+        wl = env.get("workload")
+        if wl is not None:
+            # optional block, but when present it must actually identify a
+            # workload — a half-stamped artifact claims reproducibility it
+            # does not have
+            if not isinstance(wl, dict):
+                probs.append("environment.workload: not an object")
+            else:
+                h = wl.get("workload_hash")
+                if not isinstance(h, str) or not h:
+                    probs.append("environment.workload.workload_hash: "
+                                 "missing or empty")
+                seeds = wl.get("storm_seeds")
+                if seeds is not None and (
+                        not isinstance(seeds, list)
+                        or not seeds           # [] names no workload at all
+                        or not all(isinstance(s, int)
+                                   and not isinstance(s, bool)
+                                   for s in seeds)):
+                    probs.append("environment.workload.storm_seeds: not a "
+                                 "non-empty list of ints")
+                tr = wl.get("replay_trace")
+                if tr is not None and (not isinstance(tr, str) or not tr):
+                    probs.append("environment.workload.replay_trace: not a "
+                                 "non-empty string")
+                if seeds is None and tr is None:
+                    probs.append("environment.workload: neither storm_seeds "
+                                 "nor replay_trace present")
     scen = doc.get("scenarios")
     if not isinstance(scen, dict) or not scen:
         probs.append("scenarios missing/empty")
@@ -1085,6 +1136,7 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
 
     Raises if the drain leaves any pod unbound (a storm must never wedge a
     gang — the chaos soaks' C6 applied at throughput scale)."""
+    import hashlib
     import random
 
     from tpusched import obs
@@ -1097,6 +1149,10 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
 
     rng = random.Random(seed)
     weights = [w for *_, w in STORM_MIX]
+    # workload identity: a running hash of the exact arrival stream this
+    # seed produced, stamped into the results artifact's environment
+    # block so the measured numbers are tied to a reproducible problem
+    stream_hash = hashlib.sha256()
     slo = obs.install_slo(obs.SLOTracker(pod_e2e_s=NORTH_STAR_S,
                                          gang_bound_s=NORTH_STAR_S,
                                          window=65536))
@@ -1122,6 +1178,8 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                 STORM_MIX, weights=weights)[0]
             name = f"storm-{unit_seq:05d}"
             unit_seq += 1
+            stream_hash.update(
+                f"{name}|{kind}|{shape}|{members}|{chips}".encode())
             if shape is None:
                 pods = [make_pod(f"{name}-0", limits={TPU: chips},
                                  requests=make_resources(cpu=1,
@@ -1198,6 +1256,8 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
 
     e2e = slo.summary().get(obs.POD_E2E, {})
     return {
+        "seed": seed,
+        "workload_hash": stream_hash.hexdigest()[:16],
         "pools": pools, "hosts": pools * 64,
         "duration_s": round(window_s, 3),
         "binds": int(window_binds),
@@ -1224,6 +1284,14 @@ def bench_storm(runs: int = 3, pools: int = 32,
     run_storm_once(pools=4, duration_s=2.0, seed=99)   # warmup, small
     results = [run_storm_once(pools=pools, duration_s=duration_s, seed=i)
                for i in range(runs)]
+    # per-run streams are seed-deterministic prefixes whose LENGTH depends
+    # on backpressure, so the stamp records both: the seeds (regenerate the
+    # stream) and the hash of what each run actually submitted
+    import hashlib
+    combined = hashlib.sha256(
+        "|".join(r["workload_hash"] for r in results).encode())
+    _record_workload(storm_seeds=[r["seed"] for r in results],
+                     workload_hash=combined.hexdigest()[:16])
     best_rate = max(r["binds_per_sec"] for r in results)
     best_p99 = min(r["pod_e2e_p99_s"] for r in results)
     best_p50 = min(r["pod_e2e_p50_s"] for r in results)
@@ -1252,6 +1320,54 @@ def bench_storm(runs: int = 3, pools: int = 32,
         description="sustained mixed arrival storm, pre-sharding baseline")
     _check_gate("storm_pod_e2e_p99",
                 [r["pod_e2e_p99_s"] for r in results])
+
+
+def bench_replay(trace_path: str, runs: int = 2) -> None:
+    """Storm bench over a RECORDED workload (``--replay <trace>``): replay
+    a fleet trace (tpusched/obs/fleetrace.py) at recorded timescale into a
+    fresh scheduler and report binds/sec + pod-e2e — the noise-robust A/B
+    mode: both arms of a comparison replay the byte-identical arrival
+    stream, so a binds/sec delta is the scheduler's, not the workload
+    generator's.  min-of-N like the storm (doc/performance.md)."""
+    from tpusched.obs.fleetrace import load_trace
+    from tpusched.sim.replay import run_replay
+
+    trace = load_trace(trace_path)
+    summary = trace.summary()
+    emit(f"replay workload: {summary['arrivals']} arrivals / "
+         f"{summary['binds']} recorded binds over {summary['window_s']}s, "
+         f"fingerprint {summary['workload_fingerprint']}",
+         summary["events"], "events", None)
+    reports = [run_replay(trace_path, trace=trace, deterministic=False,
+                          pace="timed", speedup=1.0)
+               for _ in range(runs)]
+    # denominator = elapsed (feed + drain-to-stable), not the feed window:
+    # when the scheduler lags the recorded arrival rate, binds land during
+    # the drain — dividing them by the feed window alone would report a
+    # rate the scheduler never sustained
+    rates = [r.binds / max(r.elapsed_s, 1e-6) for r in reports]
+    best = max(range(runs), key=lambda i: rates[i])
+    rep = reports[best]
+    emit(f"replay sustained throughput (best of {runs} runs; per-run "
+         f"rates {[round(x, 2) for x in rates]})",
+         round(rates[best], 2), "binds/s", None,
+         pod_e2e_p50_s=rep.pod_e2e["p50_s"],
+         pod_e2e_p99_s=rep.pod_e2e["p99_s"],
+         unbound=len(rep.unbound))
+    _record_workload(replay_trace=os.path.abspath(trace_path),
+                     workload_hash=rep.workload_fingerprint)
+    _record_scenario(
+        "replay_storm", "throughput",
+        binds_per_sec=round(rates[best], 2),
+        pod_e2e_p50_s=rep.pod_e2e["p50_s"],
+        pod_e2e_p99_s=rep.pod_e2e["p99_s"],
+        runs=runs,
+        per_run=[{"binds_per_sec": round(x, 2), "binds": r.binds,
+                  "unbound": len(r.unbound),
+                  "feed_window_s": r.feed_window_s,
+                  "elapsed_s": r.elapsed_s}
+                 for x, r in zip(rates, reports)],
+        description="storm bench over a recorded fleet trace (--replay)")
 
 
 # -- TPU workload side --------------------------------------------------------
@@ -2107,6 +2223,11 @@ def _results_path() -> str:
 
 
 def main() -> int:
+    # bench fabricates fleets: an exported TPUSCHED_FLEETRACE_DIR (live
+    # capture arming) would make every emulated scheduler env-arm the
+    # global fleet recorder and journal synthetic storms into the real
+    # trace directory.  Neutralize it for this process.
+    os.environ.pop("TPUSCHED_FLEETRACE_DIR", None)
     if "--trace-out" in sys.argv:
         try:
             path = sys.argv[sys.argv.index("--trace-out") + 1]
@@ -2124,6 +2245,21 @@ def main() -> int:
         # storm-only run (the pre-sharding baseline recorder): emits the
         # throughput lines and writes the schema-validated artifact
         bench_storm()
+        write_results_artifact(_results_path())
+        if _gate_failures:
+            for f in _gate_failures:
+                print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)
+            return 1
+        return 0
+    if "--replay" in sys.argv:
+        # storm-bench over a recorded fleet trace: the noise-robust A/B
+        # mode (identical workload both arms, see doc/performance.md)
+        try:
+            path = sys.argv[sys.argv.index("--replay") + 1]
+        except IndexError:
+            print("usage: bench.py --replay TRACE_DIR", file=sys.stderr)
+            return 2
+        bench_replay(path)
         write_results_artifact(_results_path())
         if _gate_failures:
             for f in _gate_failures:

@@ -305,6 +305,61 @@ def test_profiler_accessors_are_shadow_guarded(tmp_path):
     assert any("install_profiler" in f.message for f in r.findings)
 
 
+def test_fleetrace_accessors_are_shadow_guarded(tmp_path):
+    """ISSUE 9: the fleet trace recorder joined the global-surface
+    accessor set — a replay driver (sim/) reaching default_fleetrecorder
+    or ensure_fleetrace would journal simulated binds as fleet reality,
+    and a replay-driven shadow Scheduler constructed without
+    telemetry=False wires every live surface."""
+    replay_driver = """
+        from .. import obs
+
+        def run_replay(api, registry, profile):
+            rec = obs.default_fleetrecorder()
+            sched = Scheduler(api, registry, profile)
+            return rec, sched
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/replaybad.py", replay_driver,
+                    ["shadow-isolation"])
+    msgs = " ".join(f.message for f in r.findings)
+    assert "default_fleetrecorder" in msgs
+    assert "telemetry=False" in msgs
+
+    registry_reach = """
+        from ..util.metrics import REGISTRY
+
+        def publish(report):
+            REGISTRY.gauge_func("x", lambda: 1.0, "")
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/replaybad2.py", registry_reach,
+                    ["shadow-isolation"])
+    assert any("REGISTRY" in f.message for f in r.findings)
+
+    guarded = """
+        from .. import obs
+
+        def wire(self, api, telemetry):
+            if telemetry:
+                self._fleet = obs.ensure_fleetrace(api)
+            else:
+                self._fleet = obs.FleetTraceRecorder()
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/wiring.py", guarded,
+                    ["shadow-isolation"])
+    assert r.findings == []
+
+    unguarded = """
+        from .. import obs
+
+        def wire(self, api):
+            self._fleet = obs.ensure_fleetrace(api)
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/wiring2.py", unguarded,
+                    ["shadow-isolation"])
+    assert len(r.findings) == 1
+    assert "ensure_fleetrace" in r.findings[0].message
+
+
 # -- monotonic-clock -----------------------------------------------------------
 
 

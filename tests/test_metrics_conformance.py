@@ -233,6 +233,30 @@ def test_escape_helpers():
     assert format_labels(("k",), ('v"',)) == 'k="v\\""'
 
 
+def test_issue9_fleetrace_families_round_trip_exposition():
+    """The ISSUE 9 families (fleet-trace capture counters) parse clean
+    through the validating round trip: events by kind, the drop counter
+    and the byte counter, under the naming conventions the metrics-names
+    rule pins."""
+    from tpusched.util.metrics import (fleetrace_bytes_total,
+                                       fleetrace_dropped_total,
+                                       fleetrace_events_total)
+    fleetrace_events_total.with_labels("pod-arrival").inc()
+    fleetrace_events_total.with_labels("bind-commit").inc(2)
+    fleetrace_dropped_total.inc(0)
+    fleetrace_bytes_total.inc(128)
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert types["tpusched_fleetrace_events_total"] == "counter"
+    assert types["tpusched_fleetrace_dropped_total"] == "counter"
+    assert types["tpusched_fleetrace_bytes_written_total"] == "counter"
+    kinds = {labels.get("kind"): v for name, labels, v in samples
+             if name == "tpusched_fleetrace_events_total"}
+    assert kinds["pod-arrival"] >= 1
+    assert kinds["bind-commit"] >= 2
+    assert any(name == "tpusched_fleetrace_bytes_written_total" and v >= 128
+               for name, labels, v in samples)
+
+
 def test_issue7_families_round_trip_exposition():
     """The ISSUE 7 families (lock contention histograms, throughput
     counters, profiler sample counter, arrival/backlog gauges) parse clean

@@ -142,9 +142,15 @@ def test_fragmentation_blocked_gang_and_pool_gauges(fresh_obs):
         big = [make_pod(f"b-{i}", pod_group="big", limits={TPU: 4})
                for i in range(16)]
         c.create_pods(big)
-        assert wait_until(
-            lambda: (engine.explain_gang("default/big") or {})
-            .get("members_pending", 0) == 16, timeout=15)
+        # Wait for the diagnosis to stabilize on the fragmentation verdict,
+        # not just full membership: the engine re-derives per cycle and a
+        # loaded box can briefly regress to "fewer member pods than
+        # minMember" between the membership wait and the HTTP query.
+        def _fragmentation_diagnosed():
+            out = engine.explain_gang("default/big") or {}
+            return (out.get("members_pending", 0) == 16
+                    and "defrag" in out.get("suggestion", ""))
+        assert wait_until(_fragmentation_diagnosed, timeout=15)
 
         server = MetricsServer(port=0).start()
         try:

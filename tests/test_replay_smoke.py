@@ -1,0 +1,338 @@
+"""Replay-smoke (ISSUE 9, `make replay-smoke`, a tier1 prerequisite):
+record a tiny storm through the fleet trace capture, replay it twice into
+identical configs, and gate on the determinism contract:
+
+- zero placement diff + identical bind counts between the two replays
+  (byte-identical placement sequences — `cmd.trace diff` exits 0);
+- a deliberately perturbed scoring policy produces a NONZERO, attributed
+  diff (non-vacuity: the gate can actually fail);
+- capture overhead ≤3%, min-of-N A/B with the direct-attribution
+  fallback this box's noise floor requires (doc/performance.md — the
+  trace/prof-smoke precedent).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import pytest
+
+from tpusched import obs
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.obs.fleetrace import load_trace
+from tpusched.sim.replay import diff_placements, recorded_reality, run_replay
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+
+# the smoke workload: feasibly provisioned (demand comfortably under
+# capacity at every instant) so every unit binds promptly — determinism
+# is exact in this regime; saturated workloads additionally race the
+# wall-clock policy windows (permit timeouts, denial cascades) that
+# lockstep cannot virtualize (see doc/performance.md)
+UNITS = 36
+IN_FLIGHT_CAP = 40          # pods
+
+
+def record_smoke_storm(out_dir: str, seed: int = 7,
+                       capture: bool = True) -> dict:
+    """Record (or, capture=False, just run — the overhead-gate A/B arm)
+    a tiny mixed storm with capacity recycling and a full drain.  Returns
+    run stats including the wall time of the submission+drain window."""
+    import random
+    rng = random.Random(seed)
+    rec = obs.default_fleetrecorder()
+    stats = {"submitted": 0}
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
+                                              denied_s=1)) as c:
+        for i in range(2):
+            topo, nodes = make_tpu_pool(f"pool-{i}", dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        if capture:
+            rec.attach(c.api, out_dir)
+        try:
+            t0 = time.perf_counter()
+            live, seq, in_flight = [], 0, 0
+
+            def reap() -> int:
+                done, kept = 0, []
+                for pg, keys in live:
+                    pods = [c.pod(k) for k in keys]
+                    if all(p is not None and p.spec.node_name
+                           for p in pods):
+                        for k in keys:
+                            c.api.delete(srv.PODS, k)
+                        if pg is not None:
+                            c.api.delete(srv.POD_GROUPS, pg)
+                        done += len(keys)
+                    else:
+                        kept.append((pg, keys))
+                live[:] = kept
+                return done
+
+            while seq < UNITS:
+                if in_flight >= IN_FLIGHT_CAP:
+                    in_flight -= reap()
+                    time.sleep(0.005)
+                    continue
+                gang = rng.random() < 0.4
+                name = f"smoke-{seq:03d}"
+                seq += 1
+                if gang:
+                    c.api.create(srv.POD_GROUPS, make_pod_group(
+                        name, min_member=4, tpu_slice_shape="2x2x4",
+                        tpu_accelerator="tpu-v5p"))
+                    pods = [make_pod(f"{name}-{j}", pod_group=name,
+                                     limits={TPU: 4},
+                                     requests=make_resources(
+                                         cpu=1, memory="1Gi"))
+                            for j in range(4)]
+                    live.append((f"default/{name}", [p.key for p in pods]))
+                else:
+                    pods = [make_pod(f"{name}-0", limits={TPU: 1},
+                                     requests=make_resources(
+                                         cpu=1, memory="1Gi"))]
+                    live.append((None, [p.key for p in pods]))
+                c.create_pods(pods)
+                in_flight += len(pods)
+                stats["submitted"] += len(pods)
+                # pace arrivals: an unpaced submit loop makes the run a
+                # pure enqueue microbenchmark over a ~0.1 s wall, and the
+                # overhead gate's percent-of-wall attribution turns
+                # degenerate (3% of nothing).  8 ms/unit keeps the window
+                # arrival-shaped (~0.4 s) like the storms it stands in for.
+                time.sleep(0.008)
+            deadline = time.monotonic() + 60
+            while live and time.monotonic() < deadline:
+                reap()
+                time.sleep(0.005)
+            assert not live, f"smoke storm wedged: {live}"
+            stats["wall_s"] = time.perf_counter() - t0
+        finally:
+            if capture:
+                rec.flush()
+                stats["capture"] = rec.status()   # before detach: the
+                rec.detach()                      # writer stats live there
+    return stats
+
+
+@pytest.fixture(scope="module")
+def smoke_trace(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleettrace"))
+    record_smoke_storm(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def two_replays(smoke_trace):
+    r1 = run_replay(smoke_trace)
+    r2 = run_replay(smoke_trace)
+    return r1, r2
+
+
+# -- the determinism gate -----------------------------------------------------
+
+
+def test_replaying_twice_yields_byte_identical_placements(two_replays,
+                                                          smoke_trace):
+    r1, r2 = two_replays
+    assert r1.binds > 0
+    assert r1.unbound == [] and r2.unbound == []
+    # byte-identical placement SEQUENCES, not just equal sets
+    assert json.dumps(r1.placements) == json.dumps(r2.placements)
+    assert r1.binds == r2.binds
+    diff = diff_placements(r1.to_dict(), r2.to_dict())
+    assert diff["identical"] is True
+    assert diff["moved"] == 0 and not diff["only_in_a"] \
+        and not diff["only_in_b"]
+    # the replay covered the recorded workload: every recorded arrival
+    # bound in the replay too (feasible regime)
+    trace = load_trace(smoke_trace)
+    assert r1.binds == len({p for p, _ in trace.recorded_binds()})
+    assert r1.workload_fingerprint == \
+        trace.summary()["workload_fingerprint"]
+
+
+def test_perturbed_scoring_policy_produces_attributed_diff(two_replays,
+                                                           smoke_trace):
+    """Non-vacuity: the zero-diff gate must be able to fail.  Replaying
+    under a profile with different Score weights must move placements,
+    and the diff must attribute each move (pod → node A vs node B)."""
+    r1, _ = two_replays
+    prof = tpu_gang_profile(permit_wait_s=30, denied_s=1)
+    prof = dataclasses.replace(prof, score=[("TpuSlice", 1)])
+    r3 = run_replay(smoke_trace, profile=prof)
+    diff = diff_placements(r1.to_dict(), r3.to_dict())
+    assert not diff["identical"]
+    assert diff["moved"] > 0
+    for row in diff["placement_diff"]:
+        assert row["pod"] and row["a"] != row["b"]
+
+
+def test_diff_vs_recorded_reality_is_structured(two_replays, smoke_trace):
+    r1, _ = two_replays
+    real = recorded_reality(load_trace(smoke_trace))
+    assert real["binds"] == r1.binds
+    diff = diff_placements(r1.to_dict(), real)
+    # same pods placed on both sides (nodes may differ: the replay runs
+    # serial determinism overrides, reality ran parallel sweeps)
+    assert not diff["only_in_a"] and not diff["only_in_b"]
+    assert diff["binds_a"] == diff["binds_b"]
+
+
+def test_replay_report_carries_differential_surfaces(two_replays):
+    r1, _ = two_replays
+    # per-pool utilization curve sampled over the stream
+    assert r1.pool_utilization
+    assert all(set(s) == {"event", "pools"} for s in r1.pool_utilization)
+    final = r1.pool_utilization[-1]["pools"]
+    assert all(isinstance(v, int) for v in final.values())
+    # SLO attainment vs the profile objective
+    assert r1.pod_e2e["events"] == r1.binds
+    assert 0.0 <= r1.pod_e2e["attainment"] <= 1.0
+    assert r1.pod_e2e["objective_s"] > 0
+
+
+def test_compacted_trace_counts_snapshot_seeded_pods_on_both_sides(tmp_path):
+    """Compaction discards a pod's arrival event but keeps it (pending) in
+    the seeding snapshot, while its bind-commit stays in the stream.  The
+    replay schedules those pods too, and BOTH report shapes must count
+    them — otherwise every compacted trace diffs as only-in-recorded."""
+    from tpusched.apiserver import APIServer
+    from tpusched.obs.fleetrace import FleetTraceRecorder
+    from tpusched.testing import make_tpu_node
+
+    api = APIServer()
+    for i in range(3):
+        api.create(srv.NODES, make_tpu_node(f"n{i}", chips=4))
+    # two pods arrive BEFORE capture: the attach snapshot is the only
+    # record of them — exactly what WAL compaction leaves behind
+    pre = [make_pod(f"pre-{i}", limits={TPU: 4}) for i in range(2)]
+    for p in pre:
+        api.create(srv.PODS, p)
+    rec = FleetTraceRecorder()
+    rec.attach(api, str(tmp_path))
+    rec.flush()        # snapshot lands on the writer thread: barrier it
+    # BEFORE the binds below, so it carries the pods pending — the
+    # compaction shape under test
+    post = make_pod("post-0", limits={TPU: 4})
+    api.create(srv.PODS, post)
+    # the recorded scheduler binds all three (post first: the stream need
+    # not match the replay's own arrival ordering)
+    for key, node in ((post.key, "n2"), (pre[0].key, "n0"),
+                      (pre[1].key, "n1")):
+        pod = api.get(srv.PODS, key)
+        pod.spec.node_name = node
+        api.update(srv.PODS, pod)
+    rec.flush()
+    rec.detach()
+
+    trace = load_trace(str(tmp_path))
+    assert len(trace.arrivals()) == 1           # pre-* arrivals compacted
+    assert len(trace.recorded_binds()) == 3
+
+    real = recorded_reality(trace)
+    assert real["binds"] == 3 and real["unbound"] == []
+    rep = run_replay(str(tmp_path))
+    assert rep.binds == 3 and rep.unbound == []
+    diff = diff_placements(rep.to_dict(), real)
+    assert not diff["only_in_a"] and not diff["only_in_b"]
+    assert diff["binds_a"] == diff["binds_b"] == 3
+
+
+# -- the CLI contract ---------------------------------------------------------
+
+
+def test_cmd_trace_inspect_replay_diff_round_trip(two_replays, smoke_trace,
+                                                  tmp_path, capsys):
+    from tpusched.cmd import trace as trace_cmd
+    r1, r2 = two_replays
+    f1, f2 = str(tmp_path / "r1.json"), str(tmp_path / "r2.json")
+    for path, rep in ((f1, r1), (f2, r2)):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(rep.to_dict(), f)
+
+    assert trace_cmd.main(["inspect", smoke_trace, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["binds"] > 0 and summary["arrivals"] > 0
+
+    # identical replays: diff exits 0
+    assert trace_cmd.main(["diff", f1, f2, "--json"]) == 0
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["identical"] is True
+
+    # CLI replay produces a report usable by diff, and --fail-on-diff
+    # agrees with the recorded reality check
+    f3 = str(tmp_path / "r3.json")
+    rc = trace_cmd.main(["replay", smoke_trace, "--report", f3])
+    assert rc == 0
+    capsys.readouterr()
+    assert trace_cmd.main(["diff", f1, f3]) == 0    # deterministic again
+    capsys.readouterr()
+
+    # a perturbed report: diff exits 1 (the gate can fail)
+    perturbed = r1.to_dict()
+    perturbed["placements"] = [[p, n + "-moved"]
+                               for p, n in perturbed["placements"]]
+    f4 = str(tmp_path / "r4.json")
+    with open(f4, "w", encoding="utf-8") as f:
+        json.dump(perturbed, f)
+    assert trace_cmd.main(["diff", f1, f4]) == 1
+    capsys.readouterr()
+
+    assert trace_cmd.main(["inspect", str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+# -- the overhead gate --------------------------------------------------------
+
+
+def test_capture_overhead_gated_at_3_percent(tmp_path):
+    """Capture-on vs capture-off on the smoke storm, min-of-N; when the
+    box cannot resolve 3% by A/B (the usual case here — see
+    doc/performance.md), fall back to DIRECT ATTRIBUTION: calibrated
+    per-event enqueue cost × events actually captured, over the captured
+    run's wall time.  The enqueue is the only work capture adds to the
+    watch fan-out — encoding and disk I/O ride the dedicated writer
+    thread."""
+    on_walls, off_walls, captures = [], [], []
+    for i in range(2):
+        off_walls.append(record_smoke_storm("", seed=11 + i,
+                                            capture=False)["wall_s"])
+        s = record_smoke_storm(str(tmp_path / f"t{i}"), seed=11 + i)
+        on_walls.append(s["wall_s"])
+        captures.append(s["capture"])
+    ab = min(on_walls) / min(off_walls)
+    if ab <= 1.03:
+        return                      # A/B resolved it: within budget
+    # direct attribution: calibrate the per-event hot-path cost on an
+    # armed recorder, charge it to every event the noisier run captured
+    from tpusched.apiserver import APIServer
+    from tpusched.obs.fleetrace import FleetTraceRecorder
+    api = APIServer()
+    rec = FleetTraceRecorder()
+    rec.attach(api, str(tmp_path / "calib"))
+    pod = make_pod("calib-0", limits={TPU: 1})
+    # min over batches: one ambient-load spike during a single long
+    # calibration loop would inflate per_event and fail the gate for
+    # reasons that have nothing to do with the capture (doc/performance.md
+    # noise methodology)
+    n = 7_000
+    batch_costs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rec._enqueue("pod-arrival", obj=pod, objkind=srv.PODS,
+                         payload={"pod": pod.key, "gang": ""})
+        batch_costs.append((time.perf_counter() - t0) / n)
+    per_event = min(batch_costs)
+    rec.detach()
+    events = max(c["events_written"] + c["dropped"] for c in captures)
+    attributed = events * per_event / min(on_walls)
+    assert attributed <= 0.03, (
+        f"capture overhead: A/B ratio {ab:.3f} and direct attribution "
+        f"{attributed:.4f} ({events} events × {per_event * 1e6:.1f}µs "
+        f"over {min(on_walls):.2f}s) both above the 3% budget")

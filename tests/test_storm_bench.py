@@ -18,6 +18,7 @@ bench = importlib.import_module("bench")
 def _fresh_results(monkeypatch):
     monkeypatch.setattr(bench, "_results_scenarios", {})
     monkeypatch.setattr(bench, "_gate_failures", [])
+    monkeypatch.setattr(bench, "_results_workload", {})
 
 
 def test_storm_smoke_runs_and_reports(tmp_path):
@@ -67,6 +68,64 @@ def test_latency_lines_record_into_artifact():
     (lambda d: d.update(scenarios={}), "scenarios missing/empty"),
 ])
 def test_validator_rejects_malformed_artifacts(mutate, expect):
+    bench.emit_latency("x scenario", [0.1, 0.2], "x")
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    mutate(doc)
+    probs = bench.validate_results_artifact(doc)
+    assert probs and any(expect in p for p in probs), probs
+
+
+def test_workload_stamp_rides_in_environment():
+    """ISSUE 9: the environment block carries the workload identity —
+    storm seeds + arrival-stream hash (or the trace path under --replay)
+    — so a BENCH_RESULTS.json names the exact problem it measured."""
+    bench.emit_latency("x scenario", [0.1, 0.2], "x")
+    bench._record_workload(storm_seeds=[0, 1, 2],
+                           workload_hash="ab12cd34ef56ab78")
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    wl = doc["environment"]["workload"]
+    assert wl["storm_seeds"] == [0, 1, 2]
+    assert wl["workload_hash"] == "ab12cd34ef56ab78"
+
+    bench._record_workload(replay_trace="/some/trace")
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    assert doc["environment"]["workload"]["replay_trace"] == "/some/trace"
+
+
+def test_storm_run_reports_workload_hash():
+    """run_storm_once stamps its own seed + stream hash, and the same seed
+    reproduces the same stream prefix (hash equality holds when the run
+    submitted the same units)."""
+    r = bench.run_storm_once(pools=1, duration_s=0.5, max_pending_pods=60,
+                             seed=41, drain_timeout_s=60)
+    assert r["seed"] == 41
+    assert isinstance(r["workload_hash"], str) and len(r["workload_hash"]) == 16
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda d: d["environment"].update(workload="not-a-dict"),
+     "workload: not an object"),
+    (lambda d: d["environment"].update(workload={"storm_seeds": [1]}),
+     "workload_hash"),
+    (lambda d: d["environment"].update(
+        workload={"workload_hash": "abc", "storm_seeds": ["x"]}),
+     "storm_seeds"),
+    # an empty seed list satisfies a vacuous all() but names no
+    # reproducible workload — the half-stamped artifact the validator
+    # exists to reject
+    (lambda d: d["environment"].update(
+        workload={"workload_hash": "abc", "storm_seeds": []}),
+     "storm_seeds"),
+    (lambda d: d["environment"].update(
+        workload={"workload_hash": "abc", "replay_trace": ""}),
+     "replay_trace"),
+    (lambda d: d["environment"].update(workload={"workload_hash": "abc"}),
+     "neither storm_seeds nor replay_trace"),
+])
+def test_validator_rejects_malformed_workload_stamps(mutate, expect):
     bench.emit_latency("x scenario", [0.1, 0.2], "x")
     doc = bench.build_results_artifact()
     assert bench.validate_results_artifact(doc) == []

@@ -19,7 +19,11 @@ what makes the invariant statically checkable:
 The profiler/throughput additions (ISSUE 7) extend the same contract: a
 shadow scheduler gets a private (or nil) profiler and an inert
 ``ThroughputTelemetry(publish=False)`` — a trial run must never publish
-live hot-path samples or binds/sec.
+live hot-path samples or binds/sec.  The fleet-trace additions (ISSUE 9)
+extend it again: a replay driver or shadow scheduler must never reach the
+process-global fleet recorder (``default_fleetrecorder``/
+``ensure_fleetrace``) — a replay's simulated binds journaled into the
+live trace directory would forge fleet history.
 
 Checks:
 
@@ -47,7 +51,8 @@ from ..core import (Finding, FileContext, Rule, dotted_name,
 _ACCESSORS = frozenset((
     "default_recorder", "install_recorder", "default_engine",
     "install_engine", "default_slo", "install_slo",
-    "default_profiler", "install_profiler", "ensure_profiler"))
+    "default_profiler", "install_profiler", "ensure_profiler",
+    "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace"))
 _REGISTRY_METHODS = frozenset(("gauge_func", "register_collector"))
 _GUARDS = ("telemetry", "_telemetry", "publish", "_publish")
 _DEFINING = frozenset(("tpusched/trace/__init__.py",

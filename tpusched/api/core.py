@@ -262,6 +262,22 @@ def node_health_error(node: Node) -> Optional[str]:
     return None
 
 
+def heartbeat_only_update(old: Node, new: Node) -> bool:
+    """True when the ONLY delta between two Node versions is the kubelet
+    heartbeat stamp.  Nothing scheduling-relevant reads it, so both the
+    scheduler's informer path (cache mutation cursor, parked-pod wakeups)
+    and the fleet trace capture (event volume) drop such updates — the
+    same reason Kubernetes moved heartbeats off the Node object onto
+    Leases.  The one shared predicate keeps the two paths agreeing on
+    what counts as a real node change."""
+    return (old.status.last_heartbeat_time != new.status.last_heartbeat_time
+            and old.spec == new.spec
+            and old.meta.labels == new.meta.labels
+            and old.status.capacity == new.status.capacity
+            and old.status.allocatable == new.status.allocatable
+            and old.status.conditions == new.status.conditions)
+
+
 @dataclass
 class PriorityClass:
     """scheduling.k8s.io/v1 PriorityClass; annotations drive preemption

@@ -59,11 +59,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fsync every WAL batch before acknowledging it "
                         "(durable across power loss, at a latency cost; "
                         "without it the WAL is flushed but not synced)")
+    p.add_argument("--fleetrace-dir", default=None, metavar="DIR",
+                   help="capture the fleet trace (cluster-level event "
+                        "journal: arrivals, binds with attribution, node "
+                        "health, quota/gang changes) into rotating JSONL "
+                        "segments here — replayable via `python -m "
+                        "tpusched.cmd.trace replay`. Equivalent to "
+                        "TPUSCHED_FLEETRACE_DIR")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "/debug/trace /debug/gangs /debug/flightrecorder "
-                        "/debug/explain (0 picks a free port; off by "
-                        "default)")
+                        "/debug/explain /debug/fleetrace (0 picks a free "
+                        "port; off by default)")
     p.add_argument("--metrics-bind-address", default="127.0.0.1",
                    help="bind address for --metrics-port; use 0.0.0.0 "
                         "in-cluster so ServiceMonitor/kubelet can reach it")
@@ -120,6 +127,11 @@ def profile_summary(scheduler: Scheduler) -> dict:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     klog.set_verbosity(args.verbosity)
+    if args.fleetrace_dir:
+        # the flag is sugar for the env var: live Scheduler construction
+        # arms the process-global recorder via obs.ensure_fleetrace
+        import os
+        os.environ["TPUSCHED_FLEETRACE_DIR"] = args.fleetrace_dir
 
     # handlers must be live BEFORE the (possibly long) leader-election
     # campaign: a SIGTERM while campaigning — or in the window between

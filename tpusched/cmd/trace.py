@@ -1,0 +1,330 @@
+"""The fleet-trace binary: capture, inspect, replay and diff cluster
+workload traces (tpusched/obs/fleetrace.py + tpusched/sim/replay.py).
+
+    # record a synthetic mixed arrival storm on an emulated fleet
+    python -m tpusched.cmd.trace capture --out /tmp/trace \\
+        --pools 4 --duration 5 --seed 7
+
+    # what's in a trace
+    python -m tpusched.cmd.trace inspect /tmp/trace
+
+    # replay it into a shadow scheduler (deterministic lockstep) and
+    # report the differential vs the recorded reality
+    python -m tpusched.cmd.trace replay /tmp/trace --report /tmp/r1.json
+
+    # diff two replay reports (or a report vs a trace's recorded reality)
+    python -m tpusched.cmd.trace diff /tmp/r1.json /tmp/r2.json
+    python -m tpusched.cmd.trace diff /tmp/r1.json /tmp/trace
+
+Exit codes: ``diff`` (and ``replay`` with ``--fail-on-diff``) exit 0 when
+placements are identical, 1 when they differ, 2 on usage errors — so CI
+can gate on "replaying the same trace twice changes nothing"
+(``make replay-smoke``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-trace",
+        description="capture / inspect / replay / diff fleet traces")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    cap = sub.add_parser("capture",
+                         help="record a synthetic arrival storm into a "
+                              "trace directory")
+    cap.add_argument("--out", required=True, help="trace directory")
+    cap.add_argument("--pools", type=int, default=4)
+    cap.add_argument("--duration", type=float, default=5.0,
+                     help="seconds of continuous arrivals")
+    cap.add_argument("--seed", type=int, default=0)
+    cap.add_argument("--utilization", type=float, default=0.6,
+                     help="backpressure: cap in-flight chip demand at this "
+                          "fraction of fleet capacity. ≤0.7 keeps the "
+                          "trace in the feasible regime where lockstep "
+                          "replay is byte-deterministic; push it to 1.5+ "
+                          "for a deliberately saturated trace "
+                          "(replayable, but approximately — see "
+                          "doc/performance.md)")
+
+    ins = sub.add_parser("inspect", help="summarize a trace directory")
+    ins.add_argument("trace", help="trace directory")
+    ins.add_argument("--json", action="store_true")
+
+    rep = sub.add_parser("replay",
+                         help="replay a trace into a fresh shadow "
+                              "scheduler and report the differential")
+    rep.add_argument("trace", help="trace directory")
+    rep.add_argument("--config", help="TpuSchedulerConfiguration YAML for "
+                                      "the replay profile")
+    rep.add_argument("--scheduler-name",
+                     help="profile to pick from --config")
+    rep.add_argument("--allow-preemption", action="store_true")
+    rep.add_argument("--pace", choices=("lockstep", "timed"),
+                     default="lockstep")
+    rep.add_argument("--speedup", type=float, default=1.0,
+                     help="timed pace: divide recorded gaps by this")
+    rep.add_argument("--production-fidelity", action="store_true",
+                     help="keep the profile's parallelism / node sampling "
+                          "instead of the deterministic overrides")
+    rep.add_argument("--report", help="write the replay report JSON here")
+    rep.add_argument("--fail-on-diff", action="store_true",
+                     help="exit 1 if placements differ from the recorded "
+                          "reality")
+    rep.add_argument("--json", action="store_true")
+
+    dif = sub.add_parser("diff",
+                         help="diff two replay reports, or a report vs a "
+                              "trace's recorded reality")
+    dif.add_argument("a", help="replay report JSON")
+    dif.add_argument("b", help="replay report JSON or trace directory")
+    dif.add_argument("--json", action="store_true")
+    return p
+
+
+def _load_report(path: str) -> dict:
+    """A report JSON file, or a trace directory rendered as the recorded
+    reality."""
+    from ..obs.fleetrace import load_trace
+    from ..sim.replay import recorded_reality
+    if os.path.isdir(path):
+        return recorded_reality(load_trace(path))
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _cmd_capture(args) -> int:
+    """A self-contained recorded storm: emulated v5p pools, a seeded mixed
+    gang+singleton arrival stream with capacity recycling, captured with
+    full bind-decision attribution."""
+    import random
+
+    # this process fabricates a fleet: if the operator's shell exports
+    # TPUSCHED_FLEETRACE_DIR (live capture arming), the TestCluster's
+    # scheduler would env-arm the global recorder and journal the
+    # SYNTHETIC pools into the real trace directory before we attach to
+    # --out — forged fleet history.  Neutralize it for this process.
+    from ..obs.fleetrace import ENV_DIR
+    os.environ.pop(ENV_DIR, None)
+
+    from .. import obs
+    from ..api.resources import TPU, make_resources
+    from ..apiserver import server as srv
+    from ..config.profiles import tpu_gang_profile
+    from ..obs.fleetrace import trace_summary
+    from ..testing import (TestCluster, make_pod, make_pod_group,
+                           make_tpu_pool)
+
+    mix = (("singleton", None, 1, 1, 0.55),
+           ("gang-2x2x4", "2x2x4", 4, 4, 0.35),
+           ("gang-4x4x4", "4x4x4", 16, 4, 0.10))
+    weights = [w for *_, w in mix]
+    rng = random.Random(args.seed)
+    # the PROCESS-GLOBAL recorder: the cluster's live scheduler holds this
+    # instance, so bind-decision attribution lands in the trace (a private
+    # recorder would capture the watch stream but miss the decisions)
+    recorder = obs.default_fleetrecorder()
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
+                                              denied_s=1)) as c:
+        for i in range(args.pools):
+            topo, nodes = make_tpu_pool(f"pool-{i:02d}", dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        # arm AFTER fleet setup: the snapshot carries the fleet, the event
+        # stream carries the workload
+        recorder.attach(c.api, args.out)
+        # chip-based backpressure: demand bounded relative to CAPACITY.
+        # A pod-count cap at small fleet sizes oversubscribes the fleet
+        # several times over, which pushes the trace into the saturated
+        # regime where lockstep replay is only approximate.
+        chip_cap = max(16, int(args.pools * 64 * args.utilization))
+        live: list = []          # (pg key or None, [pod keys], chips)
+        seq = 0
+        in_flight_chips = 0
+
+        def reap_bound() -> None:
+            """Tear down every fully-bound unit, recycling its capacity."""
+            nonlocal in_flight_chips
+            kept = []
+            for pg, keys, unit in live:
+                pods = [c.pod(k) for k in keys]
+                if all(p is not None and p.spec.node_name for p in pods):
+                    for k in keys:
+                        c.api.delete(srv.PODS, k)
+                    if pg is not None:
+                        c.api.delete(srv.POD_GROUPS, pg)
+                    in_flight_chips -= unit
+                else:
+                    kept.append((pg, keys, unit))
+            live[:] = kept
+
+        deadline = time.monotonic() + args.duration
+        last_reap = 0.0
+        while time.monotonic() < deadline:
+            kind, shape, members, chips, _ = rng.choices(
+                mix, weights=weights)[0]
+            unit_chips = members * chips
+            if in_flight_chips + unit_chips <= chip_cap:
+                name = f"storm-{seq:05d}"
+                seq += 1
+                if shape is None:
+                    pods = [make_pod(f"{name}-0", limits={TPU: chips},
+                                     requests=make_resources(
+                                         cpu=1, memory="1Gi"))]
+                    pg = None
+                else:
+                    c.api.create(srv.POD_GROUPS, make_pod_group(
+                        name, min_member=members, tpu_slice_shape=shape,
+                        tpu_accelerator="tpu-v5p"))
+                    pg = f"default/{name}"
+                    pods = [make_pod(f"{name}-{j:03d}", pod_group=name,
+                                     limits={TPU: chips},
+                                     requests=make_resources(
+                                         cpu=1, memory="1Gi"))
+                            for j in range(members)]
+                c.create_pods(pods)
+                live.append((pg, [p.key for p in pods], unit_chips))
+                in_flight_chips += unit_chips
+            else:
+                time.sleep(0.002)
+            now = time.monotonic()
+            if now - last_reap >= 0.05:
+                last_reap = now
+                reap_bound()
+        # drain WITH capacity recycling (keep reaping bound units, like
+        # bench.py's storm drain): a large gang pending at window end
+        # still needs earlier units torn down to fit, and the trace must
+        # end at true quiescence — every recorded arrival's bind and
+        # teardown in the stream
+        drain_deadline = time.monotonic() + 60.0
+        while live and time.monotonic() < drain_deadline:
+            reap_bound()
+            time.sleep(0.02)
+        if live:
+            print(f"warning: {len(live)} unit(s) never bound within the "
+                  "drain window; the trace records them as pending",
+                  file=sys.stderr)
+        recorder.flush()
+        recorder.detach()
+    summary = trace_summary(args.out)
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    return 0
+
+
+def _cmd_inspect(args) -> int:
+    from ..obs.fleetrace import trace_summary
+    try:
+        summary = trace_summary(args.trace)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary))
+        return 0
+    print(f"fleet trace {summary['directory']} "
+          f"(schema v{summary['schema_version']}, "
+          f"{summary['segments']} segment(s)"
+          + (", TORN tail tolerated" if summary["torn"] else "") + ")")
+    print(f"  window: {summary['window_s']}s, workload fingerprint "
+          f"{summary['workload_fingerprint']}")
+    snap = summary["snapshot_objects"]
+    if snap:
+        print("  snapshot: " + ", ".join(f"{v} {k}"
+                                         for k, v in sorted(snap.items())))
+    print(f"  events: {summary['events']} "
+          f"({summary['arrivals']} arrivals, {summary['binds']} binds, "
+          f"{summary['gangs']} gang(s))")
+    for kind, n in sorted(summary["events_by_kind"].items()):
+        print(f"    {kind:18s} {n}")
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    from ..obs.fleetrace import load_trace
+    from ..sim.replay import diff_placements, recorded_reality, run_replay
+    try:
+        trace = load_trace(args.trace)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    report = run_replay(
+        args.trace, trace=trace, config_path=args.config,
+        scheduler_name=args.scheduler_name,
+        allow_preemption=args.allow_preemption,
+        deterministic=not args.production_fidelity,
+        pace=args.pace, speedup=args.speedup).to_dict()
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    diff = diff_placements(report, recorded_reality(trace))
+    if args.json:
+        print(json.dumps({"report": report, "vs_recorded": diff}))
+    else:
+        print(f"replayed {report['events_applied']} event(s) "
+              f"({report['pace']}, "
+              f"{'deterministic' if report['deterministic'] else 'production'}"
+              f"): {report['binds']} bind(s), "
+              f"{len(report['unbound'])} unbound, "
+              f"feed window {report['feed_window_s']}s")
+        e2e = report["pod_e2e"]
+        print(f"  replay pod-e2e p50 {e2e['p50_s']}s / p99 {e2e['p99_s']}s "
+              f"({e2e['events']} events, attainment {e2e['attainment']})")
+        print(f"  vs recorded reality: {diff['moved']} moved, "
+              f"{len(diff['only_in_a'])} only-replay, "
+              f"{len(diff['only_in_b'])} only-recorded "
+              f"(binds {diff['binds_a']} vs {diff['binds_b']})")
+        if args.report:
+            print(f"  report written to {args.report}")
+    return 1 if args.fail_on_diff and not diff["identical"] else 0
+
+
+def _cmd_diff(args) -> int:
+    from ..sim.replay import diff_placements
+    try:
+        a, b = _load_report(args.a), _load_report(args.b)
+    except (OSError, ValueError, FileNotFoundError) as e:
+        print(f"cannot load report: {e}", file=sys.stderr)
+        return 2
+    diff = diff_placements(a, b)
+    if args.json:
+        print(json.dumps(diff))
+    else:
+        verdict = "IDENTICAL" if diff["identical"] else "DIFFERENT"
+        print(f"{verdict}: binds {diff['binds_a']} vs {diff['binds_b']}, "
+              f"{diff['moved']} moved, {len(diff['only_in_a'])} only-in-a, "
+              f"{len(diff['only_in_b'])} only-in-b")
+        for row in diff["placement_diff"][:20]:
+            print(f"  {row['pod']}: {row['a']} -> {row['b']}")
+        if diff["moved"] > 20:
+            print(f"  ... {diff['moved'] - 20} more")
+    return 0 if diff["identical"] else 1
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "capture":
+            return _cmd_capture(args)
+        if args.cmd == "inspect":
+            return _cmd_inspect(args)
+        if args.cmd == "replay":
+            return _cmd_replay(args)
+        return _cmd_diff(args)
+    except BrokenPipeError:
+        # `trace diff ... | head` closing the pipe is not an error; keep
+        # the exit code meaningful for the part that was consumed
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,23 +39,26 @@ from .capacity import (CapacityTelemetry, largest_placeable_chips,
 from .profiler import (HotPathProfiler, profiling_enabled,
                        set_profiling_enabled)
 from .throughput import ThroughputTelemetry
+from .fleetrace import FleetTraceRecorder
 from . import reasons  # noqa: F401  (re-export)
 
 __all__ = [
     "DiagnosisEngine", "SLOTracker", "CapacityTelemetry",
-    "HotPathProfiler", "ThroughputTelemetry",
+    "HotPathProfiler", "ThroughputTelemetry", "FleetTraceRecorder",
     "profiling_enabled", "set_profiling_enabled",
     "largest_placeable_chips", "largest_window_chips", "pool_occupancy",
     "POD_E2E", "GANG_BOUND",
     "DEFAULT_POD_E2E_S", "DEFAULT_GANG_BOUND_S", "reasons",
     "default_engine", "install_engine", "default_slo", "install_slo",
     "default_profiler", "install_profiler", "ensure_profiler",
+    "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace",
     "observe_gang_bound",
 ]
 
 _engine = DiagnosisEngine()
 _slo = SLOTracker()
 _profiler = HotPathProfiler()
+_fleet = FleetTraceRecorder()
 
 
 def default_engine() -> DiagnosisEngine:
@@ -116,3 +119,37 @@ def ensure_profiler() -> HotPathProfiler:
     not)."""
     _profiler.ensure_started()
     return _profiler
+
+
+def default_fleetrecorder() -> FleetTraceRecorder:
+    return _fleet
+
+
+def install_fleetrecorder(rec: FleetTraceRecorder) -> FleetTraceRecorder:
+    """Swap the process-global fleet trace recorder (bench/test isolation).
+    The replaced recorder is detached: two armed recorders on one API
+    server would double every captured event."""
+    global _fleet
+    if _fleet is not rec:
+        _fleet.detach()
+    _fleet = rec
+    return rec
+
+
+def ensure_fleetrace(api) -> FleetTraceRecorder:
+    """Arm the process-global fleet trace capture from the environment
+    (``TPUSCHED_FLEETRACE_DIR``), idempotently — live schedulers call this
+    at construction; shadows get a private disarmed recorder instead and
+    must never reach this accessor (shadow-isolation lint rule)."""
+    import os as _os
+    from .fleetrace import ENV_DIR
+    directory = _os.environ.get(ENV_DIR, "")
+    if directory and not _fleet.enabled:
+        try:
+            _fleet.attach(api, directory)
+        except Exception as e:  # noqa: BLE001 — capture is observability:
+            # an unwritable trace dir must not keep the scheduler down
+            from ..util import klog
+            klog.error_s(e, "fleet trace capture arm failed",
+                         directory=directory)
+    return _fleet

@@ -1,0 +1,842 @@
+"""Fleet trace capture: a durable, bounded, schema-versioned journal of
+CLUSTER-level events — the workload record the live fleet actually saw.
+
+PRs 2/5/7 made the running scheduler legible (flight recorder, why-pending,
+profiler) but everything they hold dies with the process, and none of it
+records the *workload*: which pods arrived when, with what specs and gang
+membership, which nodes flapped, which quotas moved, and where each bind
+landed.  ROADMAP items 3 (Gavel-style policy evaluation) and 4 (defrag
+what-if) both need recorded fleet traces to replay, and on a box that
+cannot resolve small wall-clock deltas by A/B (doc/performance.md) a
+*deterministic* recorded workload is what turns perf comparisons into
+cycle counts instead of noise.
+
+Capture sits at the two boundaries that define cluster reality:
+
+- the **watch boundary** (``APIServer.add_watch``): pod arrivals (full
+  spec + gang membership), pod/node/PodGroup/ElasticQuota/TpuTopology
+  adds/updates/deletes, node health transitions, PodGroup phase moves,
+  and the authoritative bind commit (the ""→node transition);
+- the **scheduler bind path**: ``record_bind_decision`` attaches decision
+  attribution (profile, gang, scheduling e2e, attempt count) to each
+  commit from ``Scheduler._finish_binding_traced``.
+
+Every event is dual-stamped (``mono`` monotonic + ``wall`` epoch) and
+spilled to crash-safe rotating JSONL segments under the journal
+discipline of ``apiserver/persistence.Journal``: records are ENQUEUED
+cheaply on the event thread (stored API objects are immutable after
+publication, so encoding happens later), one named daemon writer thread
+does all disk I/O, the queue has a hard budget (over it, events are
+DROPPED and counted — capture sheds load, it never blocks the informer
+boundary), and a torn tail line from a crash is tolerated on read while
+a re-attached capture always resumes into a FRESH segment.  When the
+segment count exceeds its budget the writer compacts exactly like the
+WAL: the new segment opens with a fresh state snapshot and older
+segments are deleted, so the directory stays bounded AND replayable from
+its oldest retained byte.
+
+Consumers: ``tpusched/sim/replay.py`` (deterministic replay +
+differential placement/SLO reports), ``python -m tpusched.cmd.trace``
+(capture/inspect/replay/diff), ``bench.py --replay`` (storm bench over a
+recorded workload), ``/debug/fleetrace`` (live capture status).
+
+Shadow isolation: live schedulers arm the process-global recorder via
+``obs.ensure_fleetrace`` (environment-gated, ``TPUSCHED_FLEETRACE_DIR``);
+shadow schedulers get a private DISARMED instance — a what-if trial's
+simulated binds must never be recorded as fleet reality.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..apiserver import server as srv
+from ..apiserver.persistence import KIND_CLASSES, decode_object, encode_object
+from ..util import klog
+from ..util.metrics import (fleetrace_bytes_total, fleetrace_dropped_total,
+                            fleetrace_events_total)
+
+__all__ = [
+    "SCHEMA_VERSION", "ENV_DIR", "FleetTraceRecorder", "FleetTrace",
+    "load_trace", "read_records", "trace_summary", "workload_fingerprint",
+    "WORKLOAD_EVENT_KINDS",
+]
+
+SCHEMA_VERSION = 1
+ENV_DIR = "TPUSCHED_FLEETRACE_DIR"
+
+SEGMENT_PREFIX = "fleet-"
+SEGMENT_SUFFIX = ".jsonl"
+
+DEFAULT_SEGMENT_BYTES = 8 * 1024 * 1024
+DEFAULT_MAX_SEGMENTS = 8
+DEFAULT_QUEUE_BUDGET = 8192
+
+# Object kinds captured at the watch boundary and included in snapshots —
+# everything a replayed scheduler consumes (the what-if shadow's kind set).
+SNAPSHOT_KINDS = (srv.NODES, srv.PODS, srv.POD_GROUPS, srv.ELASTIC_QUOTAS,
+                  srv.PRIORITY_CLASSES, srv.PDBS, srv.TPU_TOPOLOGIES)
+_WATCH_KINDS = (srv.PODS, srv.NODES, srv.POD_GROUPS, srv.ELASTIC_QUOTAS,
+                srv.TPU_TOPOLOGIES)
+
+# Event kinds that ARE the workload (what a replay re-feeds). bind-commit /
+# bind-decision are recorded REALITY — a replay makes its own decisions and
+# diffs against them instead of re-applying them.
+WORKLOAD_EVENT_KINDS = frozenset((
+    "pod-arrival", "pod-update", "pod-delete",
+    "node-add", "node-update", "node-health", "node-delete",
+    "podgroup-add", "podgroup-update", "podgroup-phase", "podgroup-delete",
+    "quota-add", "quota-update", "quota-delete",
+    "topology-add", "topology-update", "topology-delete",
+))
+
+# sentinel payload: the writer thread expands it into snapshot records by
+# calling the recorder's snapshot function — a 50k-pod fleet snapshot must
+# not transit (and blow) the bounded event queue, and must not run its
+# O(objects) encode on the watch thread
+_SNAPSHOT_SENTINEL = "__snapshot__"
+
+
+def _stamps() -> Tuple[float, float]:
+    """(mono, wall) — every fleet-trace record is DUAL-stamped by design:
+    mono orders and paces replay within one capture session, wall anchors
+    the trace to fleet history across processes."""
+    # tpulint: disable=monotonic-clock — the wall stamp is the point here:
+    # post-hoc reconstruction needs epoch time next to the monotonic one
+    return time.monotonic(), time.time()
+
+
+def _segment_name(index: int) -> str:
+    return f"{SEGMENT_PREFIX}{index:08d}{SEGMENT_SUFFIX}"
+
+
+def _segment_paths(directory: str) -> List[Tuple[int, str]]:
+    """(index, path) for every segment file in the directory, ascending."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(SEGMENT_PREFIX)
+                and name.endswith(SEGMENT_SUFFIX)):
+            continue
+        stem = name[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]
+        try:
+            out.append((int(stem), os.path.join(directory, name)))
+        except ValueError:
+            continue
+    out.sort()
+    return out
+
+
+class _SegmentWriter:
+    """Rotating JSONL segment writer on one named daemon thread.
+
+    The journal discipline (apiserver/persistence.Journal): ``append`` only
+    enqueues under a condition variable — stored API objects are never
+    mutated after publication, so JSON encoding safely happens later on the
+    writer thread, which does ALL disk I/O.  A full queue drops (and
+    counts) instead of blocking: capture is observability, and the watch
+    fan-out it rides must never stall on a slow disk."""
+
+    def __init__(self, directory: str, segment_bytes: int, max_segments: int,
+                 queue_budget: int,
+                 snapshot_fn: Optional[Callable[[], Dict[str, list]]] = None):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self._segment_bytes = max(64 * 1024, segment_bytes)
+        self._max_segments = max(2, max_segments)
+        self._budget = max(16, queue_budget)
+        self._snapshot_fn = snapshot_fn
+
+        existing = _segment_paths(directory)
+        # crash/restart contract: NEVER append to an existing segment — a
+        # torn tail stays isolated in its own file and capture resumes
+        # into a fresh one
+        self._next_index = existing[-1][0] + 1 if existing else 1
+        self._on_disk = [i for i, _ in existing]
+        # a big snapshot can itself span several segments (each rotation
+        # re-enters _ensure_segment): re-compacting before max_segments
+        # FRESH segments accumulated would write snapshots back to back,
+        # and re-compacting while a snapshot is being WRITTEN would recurse
+        self._last_compact = 0
+        self._in_compact = False
+
+        self._cv = threading.Condition()
+        self._queue: List[tuple] = []
+        self._enqueued = 0
+        self._processed = 0
+        self._closed = False
+
+        self._file = None
+        self._file_bytes = 0
+        self._stats_lock = threading.Lock()
+        self._bytes_written = 0
+        self._events_written = 0
+        self._dropped = 0
+        self._write_errors = 0
+
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpusched-fleetrace")
+        self._thread.start()
+
+    # -- producer side (watch/bind threads) -----------------------------------
+
+    def append(self, kind: str, mono: float, wall: float,
+               payload: Optional[dict], obj: Any,
+               objkind: Optional[str]) -> Optional[bool]:
+        """Enqueue one record.  True = accepted, False = dropped at the
+        queue budget, None = writer already closed (not an event loss:
+        capture was detached)."""
+        with self._cv:
+            if self._closed:
+                return None
+            if len(self._queue) >= self._budget:
+                self._dropped += 1
+                fleetrace_dropped_total.inc()
+                return False
+            self._queue.append((kind, mono, wall, payload, obj, objkind))
+            self._enqueued += 1
+            self._cv.notify()
+        return True
+
+    # -- writer thread ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.5)
+                batch, self._queue = self._queue, []
+                closing = self._closed
+            if batch:
+                try:
+                    self._write_batch(batch)
+                except Exception as e:  # capture is best-effort: a disk
+                    # failure must never take the control plane's watch
+                    # fan-out down with it
+                    klog.error_s(e, "fleetrace segment write failed",
+                                 directory=self.dir)
+                    with self._stats_lock:
+                        self._write_errors += 1
+                with self._cv:
+                    self._processed += len(batch)
+                    self._cv.notify_all()
+            if closing and not batch:
+                self._close_file()
+                return
+
+    def _write_batch(self, batch) -> None:
+        for kind, mono, wall, payload, obj, objkind in batch:
+            if kind == _SNAPSHOT_SENTINEL:
+                self._ensure_segment()
+                self._write_snapshot(mono, wall)
+                continue
+            rec: Dict[str, Any] = {"kind": kind, "mono": mono, "wall": wall}
+            if payload:
+                rec.update(payload)
+            if obj is not None:
+                rec["objkind"] = objkind
+                rec["object"] = encode_object(obj)
+            self._ensure_segment()
+            self._write_record(rec)
+        # per-batch flush (persistence.Journal discipline): a process that
+        # exits without detach() loses at most the in-flight batch, not the
+        # whole Python-buffered tail of the open segment
+        if self._file is not None:
+            self._file.flush()
+
+    def _write_record(self, rec: dict) -> None:
+        if self._file is None:
+            # a rotation mid-batch (or mid-snapshot) closed the segment:
+            # open the next one. _ensure_segment sets _file BEFORE writing
+            # its header record, so the reentry terminates.
+            self._ensure_segment()
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._file.write(line)
+        n = len(line.encode("utf-8"))
+        self._file_bytes += n
+        with self._stats_lock:
+            self._bytes_written += n
+            self._events_written += 1
+        fleetrace_bytes_total.inc(n)
+        if self._file_bytes >= self._segment_bytes:
+            self._close_file()          # next record opens a fresh segment
+
+    def _ensure_segment(self) -> None:
+        if self._file is not None:
+            return
+        index = self._next_index
+        self._next_index += 1
+        path = os.path.join(self.dir, _segment_name(index))
+        self._file = open(path, "w", encoding="utf-8")
+        self._file_bytes = 0
+        self._on_disk.append(index)
+        now_m, now_w = _stamps()
+        self._write_record({"kind": "segment-header",
+                            "schema_version": SCHEMA_VERSION,
+                            "segment": index, "mono": now_m, "wall": now_w})
+        if len(self._on_disk) > self._max_segments \
+                and index - self._last_compact > self._max_segments \
+                and not self._in_compact:
+            self._last_compact = index
+            self._in_compact = True
+            try:
+                self._compact(index, now_m, now_w)
+            finally:
+                self._in_compact = False
+
+    def _compact(self, keep_from: int, mono: float, wall: float) -> None:
+        """WAL-style compaction: the freshly opened segment gets a full
+        state snapshot, then every OLDER segment is deleted — the directory
+        stays bounded and remains replayable from its oldest retained
+        byte (readers start at the last snapshot)."""
+        if self._snapshot_fn is not None:
+            self._write_snapshot(mono, wall)
+        kept = []
+        for idx in self._on_disk:
+            if idx >= keep_from:
+                kept.append(idx)
+                continue
+            try:
+                os.unlink(os.path.join(self.dir, _segment_name(idx)))
+            except OSError as e:
+                klog.error_s(e, "fleetrace segment delete failed",
+                             segment=idx)
+                kept.append(idx)
+        self._on_disk = kept
+
+    def _write_snapshot(self, mono: float, wall: float) -> None:
+        if self._snapshot_fn is None:
+            return
+        dump = self._snapshot_fn()
+        counts = {k: len(v) for k, v in dump.items() if v}
+        self._write_record({"kind": "snapshot-start", "mono": mono,
+                            "wall": wall, "counts": counts})
+        for objkind, objs in dump.items():
+            for obj in objs:
+                self._write_record({"kind": "snapshot-object",
+                                    "mono": mono, "wall": wall,
+                                    "objkind": objkind,
+                                    "object": encode_object(obj)})
+        self._write_record({"kind": "snapshot-end", "mono": mono,
+                            "wall": wall})
+
+    def _close_file(self) -> None:
+        if self._file is None:
+            return
+        try:
+            self._file.flush()
+            self._file.close()
+        except OSError as e:
+            klog.error_s(e, "fleetrace segment close failed")
+        self._file = None
+        self._file_bytes = 0
+
+    # -- control ---------------------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every record enqueued so far hit the writer (written
+        or counted as a write error)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            target = self._enqueued
+            while self._processed < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            out = {"bytes_written": self._bytes_written,
+                   "events_written": self._events_written,
+                   "dropped": self._dropped,
+                   "write_errors": self._write_errors}
+        with self._cv:
+            out["queue_depth"] = len(self._queue)
+        out["segments"] = len(self._on_disk)
+        return out
+
+
+class FleetTraceRecorder:
+    """The capture front-end: watch-boundary hooks + the scheduler's
+    bind-decision feed, multiplexed into one ``_SegmentWriter``.
+
+    Disarmed (the default, and always for shadow schedulers) every feed
+    method is a nearly-free no-op; ``attach`` arms it against ONE
+    APIServer.  All feed paths are thread-safe: the writer reference is
+    swapped atomically and a closed writer refuses appends."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._writer: Optional[_SegmentWriter] = None
+        self._api: Optional[srv.APIServer] = None
+        self._handlers: List[Tuple[str, Callable]] = []
+        self._events_by_kind: Dict[str, int] = {}
+        self._started_wall = 0.0
+        self._started_mono = 0.0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._writer is not None
+
+    def attach(self, api: srv.APIServer, directory: str, *,
+               segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+               max_segments: int = DEFAULT_MAX_SEGMENTS,
+               queue_budget: int = DEFAULT_QUEUE_BUDGET) -> None:
+        """Arm capture against ``api``, spilling into ``directory``.  The
+        first records are a ``capture-start`` marker and a full state
+        snapshot, so the trace is replayable without any external state.
+        Idempotent against the same directory; re-attaching elsewhere
+        detaches first."""
+        old = None
+        with self._lock:
+            if self._writer is not None:
+                if self._api is api and self._writer.dir == directory:
+                    return
+                # the old writer's drain (flush + thread join, seconds)
+                # happens AFTER the lock is released: _enqueue's
+                # bookkeeping takes this lock on the watch fan-out path,
+                # and APIServer dispatch is synchronous — holding it
+                # across the drain would stall every store write behind
+                # a re-arm
+                old = self._swap_out_locked()
+
+            def snapshot() -> Dict[str, list]:
+                dump, _rv = api.dump_for_snapshot(SNAPSHOT_KINDS)
+                return dump
+
+            writer = _SegmentWriter(directory, segment_bytes, max_segments,
+                                    queue_budget, snapshot_fn=snapshot)
+            self._writer = writer
+            self._api = api
+            mono, wall = _stamps()
+            self._started_wall = wall
+            self._started_mono = mono
+            self._events_by_kind = {}
+            # direct appends (not _enqueue): attach holds self._lock and
+            # _enqueue's bookkeeping takes it too
+            writer.append("capture-start", mono, wall,
+                          {"schema_version": SCHEMA_VERSION}, None, None)
+            # watch hooks BEFORE the snapshot sentinel: the writer thread
+            # dumps the store when it dequeues the sentinel, so an object
+            # written after the dump but before a later registration would
+            # be in neither the snapshot nor the stream. Registered first,
+            # a pre-sentinel event is merely discarded by load_trace (its
+            # effect is already in the store the dump will read) and a
+            # snapshot-ahead duplicate is upserted by replay's apply_event.
+            handlers = []
+            for kind in _WATCH_KINDS:
+                def handler(ev, kind=kind):
+                    self._on_watch_event(kind, ev)
+                api.add_watch(kind, handler, replay=False)
+                handlers.append((kind, handler))
+            self._handlers = handlers
+            writer.append(_SNAPSHOT_SENTINEL, mono, wall, None, None, None)
+        self._drain_writer(old)
+        klog.info_s("fleet trace capture armed", directory=directory)
+
+    def detach(self, flush_timeout: float = 5.0) -> None:
+        with self._lock:
+            writer = self._swap_out_locked()
+        self._drain_writer(writer, flush_timeout)
+
+    def _swap_out_locked(self):
+        """Under self._lock: deregister the watch hooks and surrender the
+        writer.  The blocking drain is the CALLER's job, outside the lock."""
+        writer, self._writer = self._writer, None
+        if writer is None:
+            return None
+        for kind, handler in self._handlers:
+            # tpulint: disable=naked-api-calls — the capture IS a watch-
+            # boundary component (informer-sibling): it registers raw
+            # watch handlers and must deregister the same way
+            self._api.remove_watch(kind, handler)
+        self._handlers = []
+        self._api = None
+        return writer
+
+    @staticmethod
+    def _drain_writer(writer, flush_timeout: float = 5.0) -> None:
+        """Stamp capture-stop, drain the queue, stop the writer thread.
+        Blocks up to flush_timeout + the thread join: never call under
+        self._lock (watch fan-out takes it per event)."""
+        if writer is None:
+            return
+        writer.append("capture-stop", *_stamps(), None, None, None)
+        writer.flush(flush_timeout)
+        writer.close()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        writer = self._writer
+        return writer.flush(timeout) if writer is not None else True
+
+    # -- feed points -----------------------------------------------------------
+
+    def _enqueue(self, kind: str, obj=None, objkind: Optional[str] = None,
+                 payload: Optional[dict] = None) -> None:
+        writer = self._writer
+        if writer is None:
+            return
+        mono, wall = _stamps()
+        ok = writer.append(kind, mono, wall, payload, obj, objkind)
+        if ok:
+            fleetrace_events_total.with_labels(kind).inc()
+            with self._lock:
+                self._events_by_kind[kind] = \
+                    self._events_by_kind.get(kind, 0) + 1
+        # ok is False → dropped (counted by the writer); None → detached
+        # mid-flight (not a loss)
+
+    def record_bind_decision(self, pod_key: str, node: str, *,
+                             scheduler: str = "", gang: Optional[str] = None,
+                             e2e_s: float = 0.0, attempts: int = 0) -> None:
+        """Decision attribution for a bind commit, fed from the scheduler's
+        bind path right after ``cache.finish_binding``.  The watch-derived
+        ``bind-commit`` event is the authoritative placement record (it
+        fires inside the API commit); this adds WHO decided and at what
+        cost.  No-op while disarmed — shadow schedulers hold a private
+        disarmed recorder, so trial binds can never masquerade as fleet
+        reality."""
+        if self._writer is None:
+            return
+        self._enqueue("bind-decision",
+                      payload={"pod": pod_key, "node": node,
+                               "scheduler": scheduler, "gang": gang or "",
+                               "e2e_s": round(e2e_s, 6),
+                               "attempts": attempts})
+
+    # -- watch boundary --------------------------------------------------------
+
+    def _on_watch_event(self, kind: str, ev: srv.WatchEvent) -> None:
+        try:
+            if kind == srv.PODS:
+                self._on_pod(ev)
+            elif kind == srv.NODES:
+                self._on_node(ev)
+            elif kind == srv.POD_GROUPS:
+                self._on_podgroup(ev)
+            elif kind == srv.ELASTIC_QUOTAS:
+                self._on_simple(ev, srv.ELASTIC_QUOTAS, "quota")
+            elif kind == srv.TPU_TOPOLOGIES:
+                self._on_simple(ev, srv.TPU_TOPOLOGIES, "topology")
+        except Exception as e:  # the capture must never break watch fan-out
+            klog.error_s(e, "fleetrace watch hook panicked", kind=kind)
+
+    def _on_pod(self, ev: srv.WatchEvent) -> None:
+        pod = ev.object
+        if ev.type == srv.ADDED:
+            self._enqueue("pod-arrival", obj=pod, objkind=srv.PODS,
+                          payload={"pod": pod.meta.key,
+                                   "gang": _gang_of(pod)})
+        elif ev.type == srv.MODIFIED:
+            old = ev.old_object
+            was = bool(old is not None and old.spec.node_name)
+            now = bool(pod.spec.node_name)
+            if now and not was:
+                # the authoritative commit: fires inside the API server's
+                # bind patch, so commit order here IS store-mutation order
+                self._enqueue("bind-commit",
+                              payload={"pod": pod.meta.key,
+                                       "node": pod.spec.node_name,
+                                       "gang": _gang_of(pod)})
+            elif not now:
+                self._enqueue("pod-update", obj=pod, objkind=srv.PODS,
+                              payload={"pod": pod.meta.key})
+            # bound-pod status churn (phase flips, conditions) carries no
+            # scheduling signal — deliberately not recorded
+        elif ev.type == srv.DELETED:
+            self._enqueue("pod-delete",
+                          payload={"pod": pod.meta.key,
+                                   "node": pod.spec.node_name,
+                                   "gang": _gang_of(pod)})
+
+    def _on_node(self, ev: srv.WatchEvent) -> None:
+        from ..api.core import heartbeat_only_update, node_health_error
+        node = ev.object
+        if ev.type == srv.ADDED:
+            self._enqueue("node-add", obj=node, objkind=srv.NODES,
+                          payload={"node": node.meta.name})
+        elif ev.type == srv.MODIFIED:
+            old = ev.old_object
+            # heartbeat-only stamps would be the dominant event kind while
+            # carrying zero scheduling information — same predicate the
+            # scheduler's informer path drops them by
+            if old is not None and heartbeat_only_update(old, node):
+                return
+            err_old = node_health_error(old) if old is not None else None
+            err_new = node_health_error(node)
+            if err_old != err_new:
+                self._enqueue("node-health", obj=node, objkind=srv.NODES,
+                              payload={"node": node.meta.name,
+                                       "health_from": err_old or "",
+                                       "health_to": err_new or ""})
+            else:
+                self._enqueue("node-update", obj=node, objkind=srv.NODES,
+                              payload={"node": node.meta.name})
+        elif ev.type == srv.DELETED:
+            self._enqueue("node-delete", payload={"node": node.meta.name})
+
+    def _on_podgroup(self, ev: srv.WatchEvent) -> None:
+        pg = ev.object
+        if ev.type == srv.ADDED:
+            self._enqueue("podgroup-add", obj=pg, objkind=srv.POD_GROUPS,
+                          payload={"gang": pg.meta.key})
+        elif ev.type == srv.MODIFIED:
+            old = ev.old_object
+            from_phase = old.status.phase if old is not None else ""
+            if pg.status.phase != from_phase:
+                self._enqueue("podgroup-phase", obj=pg,
+                              objkind=srv.POD_GROUPS,
+                              payload={"gang": pg.meta.key,
+                                       "from": from_phase,
+                                       "to": pg.status.phase})
+            else:
+                self._enqueue("podgroup-update", obj=pg,
+                              objkind=srv.POD_GROUPS,
+                              payload={"gang": pg.meta.key})
+        elif ev.type == srv.DELETED:
+            self._enqueue("podgroup-delete", payload={"gang": pg.meta.key})
+
+    def _on_simple(self, ev: srv.WatchEvent, kind: str, stem: str) -> None:
+        obj = ev.object
+        if ev.type == srv.ADDED:
+            self._enqueue(f"{stem}-add", obj=obj, objkind=kind,
+                          payload={"name": obj.meta.key})
+        elif ev.type == srv.MODIFIED:
+            self._enqueue(f"{stem}-update", obj=obj, objkind=kind,
+                          payload={"name": obj.meta.key})
+        elif ev.type == srv.DELETED:
+            self._enqueue(f"{stem}-delete", payload={"name": obj.meta.key})
+
+    # -- introspection ---------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The /debug/fleetrace payload."""
+        writer = self._writer
+        out: Dict[str, Any] = {"enabled": writer is not None,
+                               "schema_version": SCHEMA_VERSION}
+        if writer is None:
+            return out
+        out["directory"] = writer.dir
+        out.update(writer.stats())
+        with self._lock:
+            out["events_by_kind"] = dict(self._events_by_kind)
+            out["started_wall"] = self._started_wall
+            out["attached_for_s"] = round(
+                time.monotonic() - self._started_mono, 3)
+        return out
+
+
+# -- reading ------------------------------------------------------------------
+
+def read_records(directory: str) -> Iterator[dict]:
+    """Every decodable record in the directory, segment order.  A torn tail
+    (crash mid-append) ends THAT segment's stream — everything before the
+    tear is yielded, and later segments (a resumed capture) still read."""
+    records, _torn = read_all(directory)
+    return iter(records)
+
+
+def read_all(directory: str) -> Tuple[List[dict], int]:
+    """(records, torn_segment_count) — the tear-aware bulk reader behind
+    ``read_records``/``load_trace``."""
+    records: List[dict] = []
+    torn = 0
+    for index, path in _segment_paths(directory):
+        try:
+            f = open(path, encoding="utf-8")
+        except OSError as e:
+            klog.error_s(e, "fleetrace segment unreadable", segment=index)
+            torn += 1
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    klog.warning_s("fleetrace segment tail truncated; "
+                                   "stopping at the tear", segment=index)
+                    torn += 1
+                    break
+    return records, torn
+
+
+@dataclasses.dataclass
+class FleetTrace:
+    """A loaded trace: initial state (from the LAST snapshot — compaction
+    may have rolled earlier ones away) + every event after it, in capture
+    order."""
+    directory: str
+    schema_version: int
+    objects: Dict[str, List[Any]]       # objkind → decoded API objects
+    events: List[dict]
+    segments: int
+    torn: bool                          # any segment ended at a tear
+
+    def recorded_binds(self) -> List[Tuple[str, str]]:
+        """(pod key, node) per bind-commit, in store-mutation order — the
+        recorded reality replays diff against."""
+        return [(e["pod"], e["node"]) for e in self.events
+                if e.get("kind") == "bind-commit"]
+
+    def bind_decisions(self) -> Dict[str, dict]:
+        return {e["pod"]: e for e in self.events
+                if e.get("kind") == "bind-decision"}
+
+    def arrivals(self) -> List[dict]:
+        return [e for e in self.events if e.get("kind") == "pod-arrival"]
+
+    def events_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            k = e.get("kind", "?")
+            out[k] = out.get(k, 0) + 1
+        return out
+
+    def window_s(self) -> float:
+        monos = [e["mono"] for e in self.events if "mono" in e]
+        return max(monos) - min(monos) if len(monos) > 1 else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        by_kind = self.events_by_kind()
+        return {
+            "directory": self.directory,
+            "schema_version": self.schema_version,
+            "segments": self.segments,
+            "torn": self.torn,
+            "snapshot_objects": {k: len(v) for k, v in self.objects.items()
+                                 if v},
+            "events": sum(by_kind.values()),
+            "events_by_kind": by_kind,
+            "arrivals": by_kind.get("pod-arrival", 0),
+            "binds": by_kind.get("bind-commit", 0),
+            "gangs": len({e.get("gang") for e in self.events
+                          if e.get("kind") == "pod-arrival"
+                          and e.get("gang")}),
+            "window_s": round(self.window_s(), 3),
+            "workload_fingerprint": workload_fingerprint(self.events),
+        }
+
+
+def load_trace(directory: str) -> FleetTrace:
+    """Parse a trace directory into initial state + post-snapshot events.
+    Restart from the LAST complete-or-running snapshot: a re-attached
+    capture (or a compaction) always writes a fresh one, so the newest
+    snapshot governs everything after it."""
+    segments = len(_segment_paths(directory))
+    if segments == 0:
+        raise FileNotFoundError(f"no fleet-trace segments under {directory}")
+    records, torn_count = read_all(directory)
+    schema = SCHEMA_VERSION
+    for rec in records:
+        if rec.get("kind") == "segment-header":
+            schema = rec.get("schema_version", SCHEMA_VERSION)
+    last_snap = -1
+    for i, rec in enumerate(records):
+        if rec.get("kind") == "snapshot-start":
+            last_snap = i
+    objects: Dict[str, List[Any]] = {k: [] for k in SNAPSHOT_KINDS}
+    events: List[dict] = []
+    in_snapshot = False
+    for i, rec in enumerate(records):
+        kind = rec.get("kind")
+        if i == last_snap:
+            in_snapshot = True
+            continue
+        if in_snapshot:
+            if kind == "snapshot-object":
+                cls = KIND_CLASSES.get(rec.get("objkind"))
+                if cls is not None:
+                    objects.setdefault(rec["objkind"], []).append(
+                        decode_object(cls, rec["object"]))
+            elif kind == "snapshot-end":
+                in_snapshot = False
+            # a torn snapshot (no snapshot-end) swallows the segment tail,
+            # which read_records already ended at the tear
+            continue
+        if i < last_snap:
+            continue                    # pre-snapshot history: compacted away
+        if kind in ("segment-header", "capture-start", "capture-stop",
+                    "snapshot-start", "snapshot-object", "snapshot-end"):
+            continue
+        events.append(rec)
+    return FleetTrace(directory=directory, schema_version=schema,
+                      objects=objects, events=events, segments=segments,
+                      torn=bool(torn_count))
+
+
+def trace_summary(directory: str) -> Dict[str, Any]:
+    return load_trace(directory).summary()
+
+
+# record fields that are capture framing / stamps, not workload identity
+_FP_SKIP_FIELDS = frozenset(("kind", "mono", "wall", "objkind", "object"))
+
+
+def workload_fingerprint(events: List[dict]) -> str:
+    """Stable hash of the WORKLOAD an event stream carries (arrivals with
+    their specs, deletes, node/quota/gang changes — not the recorded
+    placements): two traces with the same fingerprint pose the scheduler
+    the same problem, so their replay reports are comparable."""
+    h = hashlib.sha256()
+    for e in events:
+        kind = e.get("kind")
+        if kind not in WORKLOAD_EVENT_KINDS:
+            continue
+        h.update(kind.encode())
+        # every payload field is workload (health_from/health_to on a
+        # node-health transition is as much the problem statement as the
+        # node's name), EXCEPT stamps/framing and a pod event's node —
+        # that is where the RECORDED scheduler put the pod (bind-commit
+        # reality leaking through pod-delete), and hashing it would give
+        # the same workload captured under two scoring policies different
+        # fingerprints
+        for field in sorted(e):
+            if field in _FP_SKIP_FIELDS:
+                continue
+            if field == "node" and kind.startswith("pod-"):
+                continue
+            v = e.get(field)
+            if v:
+                h.update(field.encode() + b"=" + str(v).encode())
+        obj = e.get("object")
+        if obj is not None:
+            h.update(json.dumps(obj.get("spec", obj), sort_keys=True,
+                                separators=(",", ":")).encode())
+            # node size is workload even though it lives in status;
+            # heartbeat times and conditions are capture noise and stay out
+            status = obj.get("status") or {}
+            sizing = {k: status[k] for k in ("capacity", "allocatable")
+                      if status.get(k)}
+            if sizing:
+                h.update(json.dumps(sizing, sort_keys=True,
+                                    separators=(",", ":")).encode())
+        h.update(b"|")
+    return h.hexdigest()[:16]
+
+
+def _gang_of(pod) -> str:
+    from ..api.scheduling import pod_group_full_name
+    return pod_group_full_name(pod) or ""

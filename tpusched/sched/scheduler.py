@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..api.core import Binding, Node, Pod
+from ..api.core import Binding, Node, Pod, heartbeat_only_update
 from ..api.scheduling import pod_group_full_name
 from ..apiserver import Clientset, InformerFactory
 from ..apiserver import server as srv
@@ -481,8 +481,16 @@ class Scheduler:
         # process-global sampler is running (idempotent); shadows must not
         # touch it — trial cycles publishing hot-path samples would read
         # as live scheduler load in /debug/profile.
+        # Fleet trace capture (tpusched/obs/fleetrace.py): live schedulers
+        # arm the process-global recorder from TPUSCHED_FLEETRACE_DIR
+        # (idempotent, disarmed when unset); shadows hold a private
+        # DISARMED recorder — a what-if trial's simulated binds must never
+        # be journaled as fleet reality.
         if telemetry:
             obs_mod.ensure_profiler()
+            self._fleet = obs_mod.ensure_fleetrace(api)
+        else:
+            self._fleet = obs_mod.FleetTraceRecorder()
         self.queue = SchedulingQueue(
             self._fw.less, cluster_event_map, clock,
             initial_backoff_s=profile.pod_initial_backoff_s,
@@ -656,22 +664,13 @@ class Scheduler:
         elif self._responsible(new):
             self.queue.update(new)
 
-    @staticmethod
-    def _heartbeat_only_update(old: Node, new: Node) -> bool:
-        """True when the ONLY delta is the kubelet heartbeat stamp. Nothing
-        the scheduler evaluates reads it, so treating these as real updates
-        would bump the cache mutation cursor (disarming every equivalence
-        entry — PR 1's cache could never stay warm on a heartbeat-managed
-        fleet) and re-activate all parked pods once per node per heartbeat
-        period. The same reason Kubernetes moved heartbeats off the Node
-        object onto Leases."""
-        return (old.status.last_heartbeat_time
-                != new.status.last_heartbeat_time
-                and old.spec == new.spec
-                and old.meta.labels == new.meta.labels
-                and old.status.capacity == new.status.capacity
-                and old.status.allocatable == new.status.allocatable
-                and old.status.conditions == new.status.conditions)
+    # heartbeat-only updates are dropped: treating them as real updates
+    # would bump the cache mutation cursor (disarming every equivalence
+    # entry — PR 1's cache could never stay warm on a heartbeat-managed
+    # fleet) and re-activate all parked pods once per node per heartbeat
+    # period.  Shared predicate: the fleet trace capture must agree with
+    # the informer path on what counts as a real node change.
+    _heartbeat_only_update = staticmethod(heartbeat_only_update)
 
     def _on_node_update(self, old: Node, new: Node) -> None:
         if self._heartbeat_only_update(old, new):
@@ -1486,6 +1485,14 @@ class Scheduler:
             bind_total.inc()
             self._throughput.on_bind()
             e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        # decision attribution for the fleet trace: the watch-derived
+        # bind-commit (fired inside the API patch above) is the placement
+        # record; this names WHO decided and at what cost. No-op unless
+        # capture is armed — and shadows hold a disarmed private recorder.
+        self._fleet.record_bind_decision(
+            pod.key, node_name, scheduler=self.profile.scheduler_name,
+            gang=gang, e2e_s=max(0.0, self.clock() - cycle_start),
+            attempts=getattr(info, "attempts", 0))
         # bound: the why-pending question is answered; feed the pod-e2e SLO
         # with the user-perceived interval (first enqueue → bind commit)
         self.obs_engine.on_resolved(pod.key)

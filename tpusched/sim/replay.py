@@ -1,0 +1,568 @@
+"""Deterministic fleet-trace replay: re-feed a recorded workload into a
+fresh scheduler and report what it did differently.
+
+The problem this solves (doc/performance.md): this class of box cannot
+resolve small wall-clock deltas by A/B because the *workload generator*
+and the ambient load are part of every measurement.  A recorded fleet
+trace (tpusched/obs/fleetrace.py) removes the first variable entirely —
+two replays of the same trace pose the scheduler the byte-identical
+problem, so comparisons become placement diffs, bind counts, cycle
+counts and profiler attribution instead of noisy seconds.  The same
+machinery answers the policy questions ROADMAP items 3 and 4 ask:
+replay yesterday's arrivals under a DIFFERENT profile (score weights,
+preemption policy, defrag strategy) and diff the outcome against the
+recorded reality.
+
+Mechanics: the trace's snapshot seeds a fresh in-memory APIServer, a
+SHADOW scheduler (``telemetry=False`` — trial binds must never pollute
+live telemetry, and the replay driver must never reach the process-global
+surfaces) schedules over it, and the feeder applies the recorded workload
+events in capture order:
+
+- ``lockstep`` pace (default): apply one event, wait for the scheduler to
+  quiesce (store cursor stable + active queue empty), apply the next.
+  Wall time disappears from the equation — with the determinism profile
+  overrides (``parallelism=1``, full node sweeps) two replays of the same
+  trace into the same config yield byte-identical placement sequences;
+- ``timed`` pace: sleep the recorded inter-event gaps (divided by
+  ``speedup``) — the realistic-rate mode ``bench.py --replay`` measures
+  sustained throughput with.
+
+What is and is not re-applied: workload events (arrivals, deletes, node
+add/health/delete, quota and PodGroup changes) are re-fed; recorded
+``bind-commit``/``bind-decision`` events are NOT — they are the recorded
+reality the replay's own decisions are diffed against.  Scheduler-owned
+derived state is stripped before injection (a recorded preemption
+nomination or PodGroup phase forced into the replay would smuggle the
+recorded scheduler's decisions into the new one's inputs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.resources import TPU
+from ..api.topology import LABEL_POOL
+from ..apiserver import APIServer
+from ..apiserver import server as srv
+from ..apiserver.persistence import KIND_CLASSES, decode_object
+from ..obs.fleetrace import FleetTrace, load_trace
+from ..plugins import default_registry
+from ..sched import Scheduler
+from ..util.podutil import pod_effective_request
+from .whatif import _make_profile
+
+__all__ = ["ReplayReport", "run_replay", "apply_event", "diff_placements",
+           "recorded_reality"]
+
+# event kinds the feeder applies; everything else (bind-commit,
+# bind-decision, capture/segment/snapshot markers) is recorded reality or
+# framing, never re-fed
+_APPLIED_KINDS = frozenset((
+    "pod-arrival", "pod-update", "pod-delete",
+    "node-add", "node-update", "node-health", "node-delete",
+    "podgroup-add", "podgroup-update", "podgroup-phase", "podgroup-delete",
+    "quota-add", "quota-update", "quota-delete",
+    "topology-add", "topology-update", "topology-delete",
+))
+
+_KIND_BY_STEM = {
+    "pod": srv.PODS, "node": srv.NODES, "podgroup": srv.POD_GROUPS,
+    "quota": srv.ELASTIC_QUOTAS, "topology": srv.TPU_TOPOLOGIES,
+}
+
+# lockstep pays its settle wait only after events that change what the
+# scheduler can DO.  podgroup-update IS such an event — apply_event
+# carries its SPEC changes (a lowered min_member unblocks a parked gang)
+# even though the derived status is stripped.  podgroup-phase events are
+# pure status mirrors (phase is re-derived by the replay's own
+# Coscheduling), cannot unblock or re-block a pod, and a storm trace
+# carries hundreds of them — they alone skip the barrier.
+_QUIESCE_KINDS = frozenset((
+    "pod-arrival", "pod-update", "pod-delete",
+    "node-add", "node-update", "node-health", "node-delete",
+    "podgroup-add", "podgroup-update", "podgroup-delete",
+    "quota-add", "quota-update", "quota-delete",
+    "topology-add", "topology-update", "topology-delete",
+))
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    """One replay's outcome, structured for diffing and for the
+    differential report ``cmd.trace replay``/``diff`` render."""
+    trace_dir: str
+    scheduler_name: str
+    pace: str
+    deterministic: bool
+    workload_fingerprint: str
+    events_applied: int
+    events_skipped: int
+    # [pod key, node] ordered by the pod's ARRIVAL sequence — bind-commit
+    # order races across bind-pool threads, arrival order does not, so
+    # this is the canonical (byte-comparable) placement sequence
+    placements: List[List[str]]
+    binds: int
+    unbound: List[str]
+    pod_e2e: Dict[str, float]           # replay-clock p50/p99/attainment
+    pool_utilization: List[dict]        # [{"event": i, "pools": {p: chips}}]
+    feed_window_s: float
+    elapsed_s: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _upsert(api: APIServer, kind: str, obj: Any) -> None:
+    """Idempotent apply (the journal-replay put=upsert discipline): a
+    compaction snapshot can run slightly ahead of the event stream, so an
+    event may re-describe an object the snapshot already carried."""
+    obj.meta.resource_version = 0       # fresh write, no precondition
+    try:
+        api.create(kind, obj)
+    except srv.Conflict:
+        api.update(kind, obj)
+
+
+def _delete(api: APIServer, kind: str, key: str) -> None:
+    try:
+        api.delete(kind, key)
+    except srv.NotFound:
+        pass
+
+
+def _decode(ev: dict):
+    cls = KIND_CLASSES.get(ev.get("objkind", ""))
+    if cls is None or "object" not in ev:
+        return None
+    return decode_object(cls, ev["object"])
+
+
+def apply_event(api: APIServer, ev: dict, *,
+                rename_scheduler: str = "") -> bool:
+    """Apply one recorded workload event to ``api``.  Returns False for
+    event kinds that are never re-fed (recorded reality / framing).
+
+    ``rename_scheduler``: rewrite arriving pods' ``spec.scheduler_name``
+    so a workload recorded under one profile name replays into a config
+    that names its profile differently (policy evaluation)."""
+    kind = ev.get("kind", "")
+    if kind not in _APPLIED_KINDS:
+        return False
+    stem = kind.split("-", 1)[0]
+    store_kind = _KIND_BY_STEM[stem]
+
+    if kind == "pod-delete":
+        _delete(api, store_kind, ev["pod"])
+        return True
+    if kind in ("node-delete", "podgroup-delete"):
+        _delete(api, store_kind, ev.get("node") or ev.get("gang"))
+        return True
+    if kind in ("quota-delete", "topology-delete"):
+        _delete(api, store_kind, ev["name"])
+        return True
+
+    obj = _decode(ev)
+    if obj is None:
+        return False
+    if store_kind == srv.PODS:
+        # scheduler-owned derived state must not leak into the replay's
+        # inputs: a recorded preemption nomination is the RECORDED
+        # scheduler's decision, not part of the workload
+        obj.status.nominated_node_name = ""
+        if rename_scheduler and not obj.spec.node_name:
+            obj.spec.scheduler_name = rename_scheduler
+        while True:
+            live = api.peek(srv.PODS, obj.meta.key)
+            if live is not None and live.spec.node_name \
+                    and not obj.spec.node_name:
+                # the capture snapshot runs on the writer thread and can
+                # land slightly AHEAD of the event stream: this arrival/
+                # update re-describes a pod the snapshot already carried —
+                # possibly bound by the replay scheduler by now.  Upserting
+                # the stale pending view would UN-bind it, a transition the
+                # scheduler cache has no path for (phantom chip occupancy
+                # forever).  The bound view is newer; the event is old news.
+                return True
+            # conditional write on the rv the guard judged: the scheduler's
+            # bind thread can commit between peek and PUT, and an
+            # unconditional upsert would un-bind the pod it just placed —
+            # a Conflict re-runs the guard instead
+            obj.meta.resource_version = \
+                0 if live is None else live.meta.resource_version
+            try:
+                if live is None:
+                    api.create(srv.PODS, obj)
+                else:
+                    api.update(srv.PODS, obj)
+                return True
+            except srv.Conflict:
+                continue
+    if store_kind == srv.POD_GROUPS and kind != "podgroup-add":
+        # same discipline for gangs: spec changes replay, but phase/counts
+        # are derived by the replay's own scheduler and controllers
+        live = api.try_get(store_kind, obj.meta.key)
+        if live is not None:
+            live.spec = obj.spec
+            _upsert(api, store_kind, live)
+        else:
+            _upsert(api, store_kind, obj)
+        return True
+    _upsert(api, store_kind, obj)
+    return True
+
+
+def _quiesce(api: APIServer, sched: Scheduler, settle_s: float,
+             timeout_s: float) -> bool:
+    """Lockstep barrier: the store cursor has not moved and the active
+    queue is empty for a settle window.  Pods parked at a permit barrier
+    (gang waiting for siblings) or in unschedulableQ are quiescent by
+    design — the next recorded event is what un-sticks them."""
+    deadline = time.monotonic() + timeout_s
+    last_rv = -1
+    stable_since: Optional[float] = None
+    while time.monotonic() < deadline:
+        rv = api.current_resource_version()
+        active = sched.queue.pending_counts().get("active", 0)
+        now = time.monotonic()
+        if rv == last_rv and active == 0:
+            if stable_since is None:
+                stable_since = now
+            elif now - stable_since >= settle_s:
+                return True
+        else:
+            last_rv = rv
+            stable_since = None
+        time.sleep(0.002)
+    return False
+
+
+def _percentiles(values: List[float]) -> Tuple[float, float]:
+    if not values:
+        return 0.0, 0.0
+    s = sorted(values)
+    p50 = s[min(len(s) - 1, int(0.50 * (len(s) - 1) + 0.5))]
+    p99 = s[min(len(s) - 1, int(0.99 * (len(s) - 1) + 0.5))]
+    return p50, p99
+
+
+def run_replay(trace_dir: str, *,
+               trace: Optional[FleetTrace] = None,
+               config_path: Optional[str] = None,
+               scheduler_name: Optional[str] = None,
+               allow_preemption: bool = False,
+               profile=None,
+               deterministic: bool = True,
+               pace: str = "lockstep",
+               speedup: float = 1.0,
+               settle_s: float = 0.02,
+               event_timeout_s: float = 15.0,
+               drain_timeout_s: float = 120.0,
+               util_sample_every: int = 50) -> ReplayReport:
+    """Replay a recorded trace into a fresh shadow scheduler.
+
+    ``deterministic`` (default) overrides the profile to ``parallelism=1``
+    and full node sweeps: the threaded Filter sweep's rotating start index
+    advances by a thread-timing-dependent visited count, which on sampled
+    sweeps (>100 hosts) makes feasible sets run-dependent — exactly the
+    nondeterminism a replay exists to remove.  Pass
+    ``deterministic=False`` to measure with production parallelism
+    (timed-pace throughput runs).
+
+    ``pace``: ``lockstep`` (apply → quiesce → apply; the diffable mode) or
+    ``timed`` (recorded inter-event gaps divided by ``speedup``)."""
+    if trace is None:
+        trace = load_trace(trace_dir)
+    prof = profile if profile is not None else _make_profile(
+        allow_preemption, 30.0, config_path, scheduler_name)
+    if deterministic:
+        # parallelism=1 + full sweeps: thread-timing-dependent visited
+        # counts and sampled feasible sets out.  The WALL-clock retry
+        # gates are ZEROED, not merely shortened: lockstep packs recorded
+        # seconds into milliseconds, so any nonzero pod backoff or
+        # Coscheduling denied-gang window turns into a race between the
+        # window's wall expiry and the event pacing — whether a woken pod
+        # retries now or next event would vary run to run, and one
+        # divergent cycle cascades into a different placement sequence.
+        # Zero means purely event-driven retries (both knobs document 0
+        # as a supported value), which is exactly deterministic.
+        plugin_args = dict(prof.plugin_args)
+        cos = plugin_args.get("Coscheduling")
+        if cos is not None:
+            plugin_args["Coscheduling"] = dataclasses.replace(
+                cos, denied_pg_expiration_time_seconds=0)
+        prof = dataclasses.replace(prof, parallelism=1,
+                                   percentage_of_nodes_to_score=100,
+                                   pod_initial_backoff_s=0.0,
+                                   pod_max_backoff_s=0.0,
+                                   plugin_args=plugin_args)
+
+    api = APIServer()
+    for kind, objs in trace.objects.items():
+        if not objs:
+            continue
+        seeded = [o.deepcopy() for o in objs]
+        # the compaction snapshot carries the RECORDED scheduler's derived
+        # state: the same discipline apply_event enforces on streamed
+        # events applies here, or a compacted trace replays differently
+        # from the identical uncompacted one (nominations/phases inherited
+        # as if they were the replay's own decisions)
+        if kind == srv.PODS:
+            for o in seeded:
+                o.status.nominated_node_name = ""
+                if not o.spec.node_name:
+                    o.spec.scheduler_name = prof.scheduler_name
+        elif kind == srv.POD_GROUPS:
+            for o in seeded:
+                o.status = type(o.status)()
+        # restore() advances the store's resource_version to the max
+        # restored rv itself
+        api.restore(kind, seeded)
+
+    # placement observer: arrival sequence assigned at injection, bind
+    # transitions observed at the watch boundary (the same boundary the
+    # capture recorded reality at)
+    arrival_seq: Dict[str, int] = {}
+    # pods PENDING in the seeding snapshot are workload too — compaction
+    # discarded their pod-arrival events, but the replay schedules them
+    # and the recorded stream carries their post-snapshot bind-commits;
+    # leaving them out of the sequence would make every compacted trace
+    # diff as only-in-recorded.  Snapshot order is the capture's write
+    # order, so it is stable across replays.
+    for pod in trace.objects.get(srv.PODS, ()):
+        if not pod.spec.node_name:
+            arrival_seq.setdefault(pod.meta.key, len(arrival_seq))
+    seq_lock = threading.Lock()
+    bound: Dict[str, Tuple[str, float]] = {}      # pod → (node, mono)
+    inject_ts: Dict[str, float] = {}
+
+    def on_pod_event(ev: srv.WatchEvent) -> None:
+        if ev.type != srv.MODIFIED:
+            return
+        old, new = ev.old_object, ev.object
+        if new.spec.node_name and (old is None or not old.spec.node_name):
+            with seq_lock:
+                bound[new.meta.key] = (new.spec.node_name, time.monotonic())
+    api.add_watch(srv.PODS, on_pod_event, replay=False)
+
+    # node → pool map for the utilization curve (snapshot + node-add feed)
+    pool_of: Dict[str, str] = {}
+    chips_of: Dict[str, int] = {}
+
+    def note_pod(ev: dict) -> None:
+        obj = _decode(ev)
+        if obj is not None:
+            chips_of[ev["pod"]] = int(
+                pod_effective_request(obj).get(TPU, 0))
+
+    for node in trace.objects.get(srv.NODES, ()):
+        pool_of[node.meta.name] = node.meta.labels.get(LABEL_POOL, "")
+
+    # teardown coupling (lockstep): a recorded pod-delete happened AFTER
+    # that pod finished running — its timing depends on the recorded
+    # run's bind times.  The replay makes its own placements, so applying
+    # teardowns at raw stream position lets them overtake the replay's
+    # in-flight work and starve it of the recycled capacity the recorded
+    # run had.  Gate each recorded-bound pod's delete on the replay
+    # having bound it too (or the system being provably stable — a pod
+    # the replay cannot place must not stall the stream forever).
+    ever_bound = {p for p, _ in trace.recorded_binds()}
+
+    sched = Scheduler(api, default_registry(), prof, telemetry=False)
+    sched.run()
+    start = time.monotonic()
+    applied = skipped = 0
+    samples: List[dict] = []
+    prev_mono: Optional[float] = None
+
+    def await_bound(key: str) -> None:
+        """Progress-gated wait: keep holding the teardown while the fleet
+        is still binding SOMETHING (the target may be next); a no-binds
+        window means the replay cannot place it with current capacity —
+        recorded reality's teardown schedule resumes.  Cheap for the
+        common cases: an already-bound target returns immediately, a
+        stuck one costs a fraction of a second."""
+        deadline = time.monotonic() + event_timeout_s
+        last_binds = len(bound)
+        last_progress = time.monotonic()
+        while time.monotonic() < deadline:
+            live = api.peek(srv.PODS, key)
+            if live is None or live.spec.node_name:
+                return
+            now = time.monotonic()
+            if len(bound) != last_binds:
+                last_binds = len(bound)
+                last_progress = now
+            elif now - last_progress > max(0.15, settle_s * 3):
+                return
+            time.sleep(0.005)
+    try:
+        for i, ev in enumerate(trace.events):
+            kind = ev.get("kind", "")
+            if pace == "lockstep" and kind == "pod-delete" \
+                    and ev.get("pod") in ever_bound:
+                await_bound(ev["pod"])
+            if pace == "timed" and prev_mono is not None and "mono" in ev:
+                gap = (ev["mono"] - prev_mono) / max(speedup, 1e-6)
+                if gap > 0:
+                    time.sleep(min(gap, 10.0))
+            prev_mono = ev.get("mono", prev_mono)
+            if kind == "node-add":
+                obj = _decode(ev)
+                if obj is not None:
+                    pool_of[obj.meta.name] = obj.meta.labels.get(
+                        LABEL_POOL, "")
+            if not apply_event(api, ev,
+                               rename_scheduler=prof.scheduler_name):
+                skipped += 1
+                continue
+            applied += 1
+            if kind == "pod-arrival":
+                with seq_lock:
+                    arrival_seq.setdefault(ev["pod"], len(arrival_seq))
+                inject_ts[ev["pod"]] = time.monotonic()
+                note_pod(ev)
+            if pace == "lockstep" and kind in _QUIESCE_KINDS:
+                _quiesce(api, sched, settle_s, event_timeout_s)
+            if util_sample_every > 0 and applied % util_sample_every == 0 \
+                    and len(samples) < 200:
+                samples.append({"event": i,
+                                "pools": _pool_usage(api, pool_of,
+                                                     chips_of)})
+        feed_window = time.monotonic() - start
+
+        # drain: give in-flight gangs a bounded chance to finish binding
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            with seq_lock:
+                outstanding = [k for k in arrival_seq
+                               if k not in bound
+                               and api.peek(srv.PODS, k) is not None]
+            if not outstanding:
+                break
+            if _quiesce(api, sched, settle_s * 4, 1.0) \
+                    and not sched.queue.pending_counts().get("backoff", 0):
+                # stable store, empty active/backoff queues, outstanding
+                # pods: genuinely unplaceable without further events — stop
+                break
+            time.sleep(0.01)
+        samples.append({"event": len(trace.events),
+                        "pools": _pool_usage(api, pool_of, chips_of)})
+    finally:
+        sched.stop()
+    elapsed = time.monotonic() - start
+
+    with seq_lock:
+        placed = sorted(
+            ((arrival_seq[k], k, node) for k, (node, _) in bound.items()
+             if k in arrival_seq), key=lambda t: t[0])
+        unbound = sorted(
+            (k for k in arrival_seq
+             if k not in bound and api.peek(srv.PODS, k) is not None),
+            key=lambda k: arrival_seq[k])
+        e2e = [bound[k][1] - inject_ts[k] for k in bound
+               if k in inject_ts]
+    p50, p99 = _percentiles(e2e)
+    objective = getattr(prof, "slo_pod_e2e_s", 0.0) or 0.0
+    attainment = (sum(1 for v in e2e if v <= objective) / len(e2e)
+                  if e2e and objective else 1.0 if e2e else 0.0)
+    from ..obs.fleetrace import workload_fingerprint
+    return ReplayReport(
+        trace_dir=trace_dir,
+        scheduler_name=prof.scheduler_name,
+        pace=pace,
+        deterministic=deterministic,
+        workload_fingerprint=workload_fingerprint(trace.events),
+        events_applied=applied,
+        events_skipped=skipped,
+        placements=[[k, node] for _, k, node in placed],
+        binds=len(placed),
+        unbound=unbound,
+        pod_e2e={"p50_s": round(p50, 4), "p99_s": round(p99, 4),
+                 "events": len(e2e), "objective_s": objective,
+                 "attainment": round(attainment, 4)},
+        pool_utilization=samples,
+        feed_window_s=round(feed_window, 3),
+        elapsed_s=round(elapsed, 3))
+
+
+def _pool_usage(api: APIServer, pool_of: Dict[str, str],
+                chips_of: Dict[str, int]) -> Dict[str, int]:
+    usage: Dict[str, int] = {}
+    for pod in api.list(srv.PODS):
+        if not pod.spec.node_name:
+            continue
+        pool = pool_of.get(pod.spec.node_name, "")
+        usage[pool] = usage.get(pool, 0) + chips_of.get(pod.meta.key, 0)
+    return {p: c for p, c in sorted(usage.items())}
+
+
+def recorded_reality(trace: FleetTrace) -> dict:
+    """The recorded run rendered in report shape, so ``diff_placements``
+    can compare a replay against what the live fleet actually did.  The
+    recorded pod-e2e is arrival-wall → bind-commit-wall per pod."""
+    arrivals: Dict[str, float] = {}
+    order: Dict[str, int] = {}
+    binds: List[Tuple[str, str]] = []
+    e2e: List[float] = []
+    decisions = trace.bind_decisions()
+    # mirror run_replay's sequence seeding: pods pending in the snapshot
+    # precede every streamed arrival (their own arrivals were compacted
+    # away), so both report shapes order and count the same pod set
+    for pod in trace.objects.get(srv.PODS, ()):
+        if not pod.spec.node_name:
+            order.setdefault(pod.meta.key, len(order))
+    for ev in trace.events:
+        kind = ev.get("kind")
+        if kind == "pod-arrival":
+            order.setdefault(ev["pod"], len(order))
+            arrivals[ev["pod"]] = ev.get("wall", 0.0)
+        elif kind == "bind-commit":
+            binds.append((ev["pod"], ev["node"]))
+            if ev["pod"] in arrivals:
+                e2e.append(max(0.0, ev.get("wall", 0.0)
+                               - arrivals[ev["pod"]]))
+    placed = sorted(((order.get(p, 1 << 30), p, n) for p, n in binds),
+                    key=lambda t: t[0])
+    bound_keys = {p for p, _ in binds}
+    p50, p99 = _percentiles(e2e)
+    return {
+        "trace_dir": trace.directory,
+        "scheduler_name": next(
+            (d.get("scheduler", "") for d in decisions.values()), ""),
+        "pace": "recorded",
+        "placements": [[p, n] for _, p, n in placed],
+        "binds": len(binds),
+        "unbound": sorted(p for p in order if p not in bound_keys),
+        "pod_e2e": {"p50_s": round(p50, 4), "p99_s": round(p99, 4),
+                    "events": len(e2e)},
+    }
+
+
+def diff_placements(a: dict, b: dict, *,
+                    gang_of: Optional[Dict[str, str]] = None) -> dict:
+    """Differential placement report between two replay reports (or a
+    report and ``recorded_reality``): per-pod node differences with
+    attribution, pods placed in only one run, and bind-count deltas.
+    ``identical`` is the replay-smoke gate's predicate."""
+    pa = {p: n for p, n in a.get("placements", [])}
+    pb = {p: n for p, n in b.get("placements", [])}
+    moved = [{"pod": p, "a": pa[p], "b": pb[p],
+              **({"gang": gang_of[p]} if gang_of and p in gang_of else {})}
+             for p in sorted(set(pa) & set(pb)) if pa[p] != pb[p]]
+    only_a = sorted(set(pa) - set(pb))
+    only_b = sorted(set(pb) - set(pa))
+    return {
+        "identical": not moved and not only_a and not only_b
+                     and a.get("binds") == b.get("binds"),
+        "binds_a": a.get("binds", len(pa)),
+        "binds_b": b.get("binds", len(pb)),
+        "placement_diff": moved,
+        "moved": len(moved),
+        "only_in_a": only_a,
+        "only_in_b": only_b,
+        "pod_e2e_a": a.get("pod_e2e"),
+        "pod_e2e_b": b.get("pod_e2e"),
+    }

@@ -27,6 +27,9 @@ rebuild's equivalent for its own binaries:
   at 60 s); ``?format=json`` adds the top-N attribution table + sampler
   stats.  The same top-N table rides along in ``/debug/flightrecorder``'s
   health section.
+- ``/debug/fleetrace``  fleet trace capture status (tpusched/obs/
+  fleetrace): armed/disarmed, trace directory, segments, bytes written,
+  events by kind, queue depth and drop count.
 """
 from __future__ import annotations
 
@@ -108,6 +111,11 @@ class MetricsServer:
                     code, payload = self._explain_payload(query)
                     self._send(code, json.dumps(payload) + "\n",
                                "application/json")
+                elif path == "/debug/fleetrace":
+                    from .. import obs
+                    # tpulint: disable=shadow-isolation — live debug
+                    # surface; shadow schedulers never mount a server
+                    self._send_json(obs.default_fleetrecorder().status())
                 elif path == "/debug/vars":
                     self._send(200, json.dumps(
                         {"threads": threading.active_count()}) + "\n",

@@ -562,3 +562,19 @@ scheduling_cycles_total = REGISTRY.counter_vec(
 profiler_samples_total = REGISTRY.counter(
     "tpusched_profiler_samples_total",
     "Stack samples taken by the hot-path sampling profiler.")
+
+# Fleet trace capture (tpusched/obs/fleetrace.py): the durable cluster-
+# event journal replay/policy-evaluation work consumes. events counts
+# records ACCEPTED into the writer queue by kind; dropped counts records
+# refused at the queue budget (capture is bounded — it sheds load, it
+# never blocks the informer boundary); bytes is the on-disk append volume
+# after JSON encoding (rotation/compaction deletions do not subtract).
+fleetrace_events_total = REGISTRY.counter_vec(
+    "tpusched_fleetrace_events_total", ("kind",),
+    "Fleet-trace events captured, by event kind.")
+fleetrace_dropped_total = REGISTRY.counter(
+    "tpusched_fleetrace_dropped_total",
+    "Fleet-trace events dropped at the capture queue budget.")
+fleetrace_bytes_total = REGISTRY.counter(
+    "tpusched_fleetrace_bytes_written_total",
+    "Bytes appended to fleet-trace segment files.")
