@@ -114,8 +114,24 @@ replay-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_replay_smoke.py \
 		-q -p no:cacheprovider
 
+# Goodput-smoke (the gang-runtime-telemetry gate, part of the tier1
+# flow): the arrival storm with in-band member goodput reports on vs off,
+# interleaved min-of-N on binds/sec — fails above 3% ingest+aggregation
+# overhead (direct-attribution fallback: measured per-report ingest cost
+# × report count vs the run's wall, when the box can't resolve 3%) or if
+# no report/matrix-cell ever flowed (vacuity). The straggler-detection
+# e2e (injected slow member fully attributable from /debug/goodput +
+# /debug/explain, hysteresis clear on teardown), the matrix
+# snapshot/reload round trip, and the 10k-report shed soak under
+# concurrent scrapes ride in the accompanying pytest suite.
+.PHONY: goodput-smoke
+goodput-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --goodput-smoke
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_goodput.py \
+		tests/test_goodput_e2e.py -q -p no:cacheprovider
+
 .PHONY: tier1
-tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke
+tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -177,7 +177,12 @@ def _repeat(fn, n: int, *args, **kwargs):
 # messages. The schema is hand-rolled (no jsonschema dependency in the
 # image) and enforced both at write time here and by the storm smoke test.
 
-RESULTS_SCHEMA_VERSION = 1
+# v2 (ISSUE 10): throughput scenarios may carry an aggregate
+# ``fleet_goodput`` stamp (in-band member-report accounting + measured
+# goodput-per-chip, ROADMAP item 3's baseline column); when present it
+# must be fully populated — a half-stamped block claims a measurement
+# that never ran.
+RESULTS_SCHEMA_VERSION = 2
 _RESULTS_PATH = "BENCH_RESULTS.json"
 _results_scenarios: dict = {}
 # workload identity for the environment stamp: which storm seeds /
@@ -297,6 +302,21 @@ def validate_results_artifact(doc) -> list:
             v = rec.get(f)
             if not isinstance(v, num) or isinstance(v, bool):
                 probs.append(f"{key}.{f}: missing or non-numeric ({v!r})")
+        fg = rec.get("fleet_goodput")
+        if fg is not None:
+            if kind != "throughput":
+                probs.append(f"{key}.fleet_goodput: only throughput "
+                             "scenarios carry the goodput stamp")
+            elif not isinstance(fg, dict):
+                probs.append(f"{key}.fleet_goodput: not an object")
+            else:
+                for f in ("reports", "shed", "straggler_edges",
+                          "matrix_cells", "goodput_per_chip_mean",
+                          "reporting_members"):
+                    v = fg.get(f)
+                    if not isinstance(v, num) or isinstance(v, bool):
+                        probs.append(f"{key}.fleet_goodput.{f}: missing "
+                                     f"or non-numeric ({v!r})")
     return probs
 
 
@@ -1116,7 +1136,8 @@ STORM_MIX = (
 
 def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                    max_pending_pods: int = 1200, seed: int = 0,
-                   drain_timeout_s: float = 120.0) -> dict:
+                   drain_timeout_s: float = 120.0,
+                   goodput_reports: bool = True) -> dict:
     """ONE sustained arrival storm: a mixed gang+singleton stream arrives
     continuously across ``pools`` v5p-256 pools (64 hosts each) for
     ``duration_s``, with completed workloads torn down as they bind so
@@ -1135,11 +1156,20 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     create API objects.
 
     Raises if the drain leaves any pod unbound (a storm must never wedge a
-    gang — the chaos soaks' C6 applied at throughput scale)."""
+    gang — the chaos soaks' C6 applied at throughput scale).
+
+    ``goodput_reports``: every fully-bound unit emits one in-band
+    ``GangMemberStatus`` report per member just before teardown (the
+    synthetic stand-in for a real member's jaxbridge reporter flush), so
+    the run exercises the goodput ingest path under storm load and the
+    result carries the aggregate fleet-goodput stamp (ROADMAP item 3's
+    baseline column). ``False`` is the A/B control arm for
+    ``--goodput-smoke``."""
     import hashlib
     import random
 
     from tpusched import obs
+    from tpusched.api.core import GangMemberStatus
     from tpusched.api.resources import TPU, make_resources
     from tpusched.apiserver import server as srv
     from tpusched.config.profiles import tpu_gang_profile
@@ -1156,6 +1186,11 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     slo = obs.install_slo(obs.SLOTracker(pod_e2e_s=NORTH_STAR_S,
                                          gang_bound_s=NORTH_STAR_S,
                                          window=65536))
+    # fresh per-run aggregator: the TestCluster's live scheduler attaches
+    # it (ensure_goodput) so bind→running registration names each
+    # member's generation/chips and the synthetic reports fold into the
+    # workload×generation matrix
+    goodput = obs.install_goodput(obs.GoodputAggregator())
     with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
                                               denied_s=1)) as c:
         for i in range(pools):
@@ -1166,7 +1201,7 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
 
         binds0 = binds_total.value()
         cycles0 = scheduling_cycles_total.value()
-        live: list = []          # (unit name or None, [pod keys])
+        live: list = []          # (pg full name or None, [pod keys], chips)
         unit_seq = 0
         submitted_pods = 0
         reaped_pods = 0
@@ -1196,23 +1231,31 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                                                          memory="1Gi"))
                         for j in range(members)]
             c.create_pods(pods)
-            live.append((pg, [p.key for p in pods]))
+            live.append((pg, [p.key for p in pods], chips))
             return len(pods)
 
         def reap() -> int:
-            """Tear down fully-bound units so their chips recycle."""
+            """Tear down fully-bound units so their chips recycle — each
+            member flushing one in-band goodput report first (what a real
+            member's jaxbridge reporter would have been emitting all
+            along), so ingest cost rides the measured storm path."""
             done = 0
             kept = []
-            for pg, keys in live:
+            for pg, keys, chips in live:
                 pods = [c.pod(k) for k in keys]
                 if all(p is not None and p.spec.node_name for p in pods):
+                    if goodput_reports:
+                        c.client.report_status([GangMemberStatus(
+                            pod_key=k, gang=pg or "", step=1,
+                            step_time_s=0.05,
+                            throughput=1000.0 * chips) for k in keys])
                     for k in keys:
                         c.api.delete(srv.PODS, k)
                     if pg is not None:
                         c.api.delete(srv.POD_GROUPS, pg)
                     done += len(keys)
                 else:
-                    kept.append((pg, keys))
+                    kept.append((pg, keys, chips))
             live[:] = kept
             return done
 
@@ -1246,7 +1289,7 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
         if live:
             stuck = [(pg, [k for k in keys if not (
                 c.pod(k) and c.pod(k).spec.node_name)])
-                for pg, keys in live[:5]]
+                for pg, keys, _chips in live[:5]]
             raise RuntimeError(
                 f"storm wedged: {len(live)} units unbound after "
                 f"{drain_timeout_s:.0f}s drain; first: {stuck}")
@@ -1255,9 +1298,26 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
         cycles = scheduling_cycles_total.value() - cycles0
 
     e2e = slo.summary().get(obs.POD_E2E, {})
+    stats = goodput.stats()
+    matrix = goodput.matrix_snapshot()
+    cells = [c.goodput_per_chip for row in matrix.cells.values()
+             for c in row.values()]
+    fleet_goodput = {
+        # everything cumulative over the whole run (ingest accounting +
+        # the matrix): a window-edge "live members" sample would measure
+        # reap/watch delete-lag races, not the reporting fleet
+        "reports": stats["accepted_total"],
+        "shed": stats["shed_total"],
+        "straggler_edges": stats["straggler_edges_total"],
+        "matrix_cells": len(cells),
+        "goodput_per_chip_mean": round(sum(cells) / len(cells), 4)
+        if cells else 0.0,
+        "reporting_members": stats["reporters_total"],
+    }
     return {
         "seed": seed,
         "workload_hash": stream_hash.hexdigest()[:16],
+        "fleet_goodput": fleet_goodput,
         "pools": pools, "hosts": pools * 64,
         "duration_s": round(window_s, 3),
         "binds": int(window_binds),
@@ -1296,6 +1356,10 @@ def bench_storm(runs: int = 3, pools: int = 32,
     best_p99 = min(r["pod_e2e_p99_s"] for r in results)
     best_p50 = min(r["pod_e2e_p50_s"] for r in results)
     hosts = results[0]["hosts"]
+    # the aggregate fleet-goodput stamp rides with the HEADLINE run (the
+    # best-rate one — same run the throughput numbers quote)
+    best_run = max(results, key=lambda r: r["binds_per_sec"])
+    fleet_goodput = best_run["fleet_goodput"]
     emit(f"arrival-storm sustained throughput: mixed gangs+singletons over "
          f"{pools} pools / {hosts} hosts, {duration_s:.0f}s continuous "
          f"arrivals, capacity recycling (best of {runs} runs; per-run "
@@ -1308,11 +1372,18 @@ def bench_storm(runs: int = 3, pools: int = 32,
          f"load (min over {runs} runs; submission window + drain)",
          best_p99, "s", round(NORTH_STAR_S / best_p99, 2)
          if best_p99 else None)
+    emit(f"arrival-storm aggregate fleet goodput (in-band member reports "
+         f"under storm load, best run: {fleet_goodput['reports']} reports "
+         f"/ {fleet_goodput['shed']} shed, {fleet_goodput['matrix_cells']} "
+         f"matrix cell(s), {fleet_goodput['reporting_members']} distinct "
+         f"reporting member(s) — ROADMAP item 3 baseline)",
+         fleet_goodput["goodput_per_chip_mean"], "unit/s/chip", None)
     _record_scenario(
         "arrival_storm", "throughput",
         binds_per_sec=best_rate, pod_e2e_p50_s=best_p50,
         pod_e2e_p99_s=best_p99, runs=len(results),
         pools=pools, hosts=hosts, duration_s=duration_s,
+        fleet_goodput=fleet_goodput,
         per_run=[{k: r[k] for k in ("binds_per_sec", "pod_e2e_p99_s",
                                     "binds", "pending_peak",
                                     "cycles_per_bind", "drain_s")}
@@ -2184,6 +2255,107 @@ def prof_smoke() -> int:
     return 0
 
 
+def _goodput_direct_cost() -> float:
+    """Measured per-report ingest cost on a live-shaped aggregator (the
+    direct-attribution probe): registered members with generation+chips,
+    so every ingest pays the full fold + matrix + straggler-reevaluation
+    path the storm pays."""
+    from tpusched import obs
+    from tpusched.api.core import GangMemberStatus
+    agg = obs.GoodputAggregator()
+    keys = []
+    for g in range(32):
+        for m in range(4):
+            key = f"smoke/g{g:02d}-{m}"
+            agg.register_member(key, f"smoke/g{g:02d}", f"n{m}",
+                                workload="w", generation="tpu-v5p", chips=4)
+            keys.append(key)
+    batch = [GangMemberStatus(pod_key=f"smoke/g{g:02d}-{m}",
+                              gang=f"smoke/g{g:02d}", step=1,
+                              step_time_s=0.05, throughput=4000.0)
+             for g in range(32) for m in range(4)]
+    rounds = 40                        # 40 × 128 = 5120 report ingests
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for r in batch:
+            r.timestamp = 0.0          # server re-stamps; keep folds equal
+        agg.ingest(batch)
+    per_report = (time.perf_counter() - t0) / (rounds * len(batch))
+    for k in keys:                     # drop the gauge children it published
+        agg.on_pod_delete(k)
+    return per_report
+
+
+def goodput_smoke() -> int:
+    """``--goodput-smoke`` (make goodput-smoke, wired into the tier1
+    flow): the arrival storm with in-band goodput reports ON vs OFF,
+    interleaved min-of-N on binds/sec; fails above 3% throughput overhead,
+    with the trace/prof-smoke direct-attribution fallback for when this
+    box provably cannot resolve 3% (off-arm spread > 3x the budget).
+    Non-vacuity: every ON arm must actually have ingested reports and
+    folded workload×generation matrix cells — a gate green because no
+    report ever flowed would be a disabled gate wearing a green check."""
+    import gc
+
+    RUNS = 3
+    POOLS = 8
+    DUR = 2.0
+    run_storm_once(pools=4, duration_s=1.0, seed=99)       # shared warmup
+    on_runs, off_runs = [], []
+    for i in range(RUNS):
+        for arm in (("on", "off") if i % 2 == 0 else ("off", "on")):
+            gc.collect()               # level GC debt across the arms
+            r = run_storm_once(pools=POOLS, duration_s=DUR, seed=i,
+                               goodput_reports=(arm == "on"))
+            (on_runs if arm == "on" else off_runs).append(r)
+
+    for r in on_runs:
+        fg = r["fleet_goodput"]
+        if fg["reports"] == 0 or fg["matrix_cells"] == 0:
+            print(f"GOODPUT-SMOKE FAILED: ON arm ingested "
+                  f"{fg['reports']} reports / {fg['matrix_cells']} matrix "
+                  "cells — the reporting path never ran", file=sys.stderr)
+            return 1
+    on_best = max(r["binds_per_sec"] for r in on_runs)
+    off_best = max(r["binds_per_sec"] for r in off_runs)
+    off_rates = [r["binds_per_sec"] for r in off_runs]
+    overhead = (off_best - on_best) / off_best
+    off_spread = (off_best - min(off_rates)) / off_best
+    reports = max(r["fleet_goodput"]["reports"] for r in on_runs)
+    print(f"goodput-smoke: reports-on best {on_best:.1f} binds/s vs off "
+          f"best {off_best:.1f} over {RUNS} interleaved runs each "
+          f"(overhead {overhead * 100:+.2f}%, off-arm spread "
+          f"{off_spread * 100:.0f}%, budget 3%, {reports} reports in the "
+          f"best ON arm)")
+    if overhead <= 0.03:
+        return 0
+    if off_spread <= 0.09:
+        # the box CAN resolve 3%: the A/B verdict stands
+        print(f"GOODPUT-SMOKE FAILED: report ingest overhead "
+              f"{overhead * 100:.2f}% > 3% (on best {on_best:.1f}, off "
+              f"best {off_best:.1f} binds/s)", file=sys.stderr)
+        return 1
+    # same-load-regime rule as trace/prof-smoke: measured per-report cost
+    # × the busiest ON arm's report count, self-ratioed against that
+    # arm's own wall (submission window + drain)
+    per_report = min(_goodput_direct_cost() for _ in range(2))
+    busiest = max(on_runs, key=lambda r: r["fleet_goodput"]["reports"])
+    wall = busiest["duration_s"] + busiest["drain_s"]
+    cost = per_report * busiest["fleet_goodput"]["reports"]
+    direct = cost / wall
+    print(f"goodput-smoke: A/B inconclusive on this box (off-arm spread "
+          f"{off_spread * 100:.0f}%); direct attribution: "
+          f"{per_report * 1e6:.1f} µs/report × "
+          f"{busiest['fleet_goodput']['reports']} reports = "
+          f"{cost * 1e3:.1f} ms = {direct * 100:.2f}% of that run's "
+          f"{wall:.2f}s wall (budget 3%)")
+    if direct > 0.03:
+        print(f"GOODPUT-SMOKE FAILED: direct ingest cost "
+              f"{direct * 100:.2f}% > 3%", file=sys.stderr)
+        return 1
+    return 0
+
+
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): only the headline gang scenario at
     n=3 (pre-push fast path; the full matrix is `make bench`), gated on the
@@ -2239,6 +2411,8 @@ def main() -> int:
         return trace_smoke()
     if "--prof-smoke" in sys.argv:
         return prof_smoke()
+    if "--goodput-smoke" in sys.argv:
+        return goodput_smoke()
     if "--smoke" in sys.argv:
         return smoke_gate()
     if "--storm" in sys.argv:

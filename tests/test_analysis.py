@@ -360,6 +360,68 @@ def test_fleetrace_accessors_are_shadow_guarded(tmp_path):
     assert "ensure_fleetrace" in r.findings[0].message
 
 
+def test_goodput_accessors_are_shadow_guarded(tmp_path):
+    """ISSUE 10: the goodput aggregator joined the global-surface
+    accessor set — a shadow scheduler publishing synthetic member
+    reports would fabricate fleet goodput, straggler anomalies and
+    throughput-matrix cells.  A sim/ module may not reference the
+    accessors at all; elsewhere they need the telemetry guard; the pure
+    matrix types stay importable by sim/ (matrices are consumed by
+    value)."""
+    shadow = """
+        from .. import obs
+
+        def trial(api):
+            return obs.ensure_goodput(api)
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/goodbad.py", shadow,
+                    ["shadow-isolation"])
+    assert any("ensure_goodput" in f.message for f in r.findings)
+
+    shadow_install = """
+        from ..obs import install_goodput
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/goodbad2.py", shadow_install,
+                    ["shadow-isolation"])
+    assert any("install_goodput" in f.message for f in r.findings)
+
+    unguarded = """
+        from .. import obs
+
+        def wire(self, api):
+            self._goodput = obs.ensure_goodput(api)
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/gwire.py", unguarded,
+                    ["shadow-isolation"])
+    assert len(r.findings) == 1
+    assert "ensure_goodput" in r.findings[0].message
+
+    guarded = """
+        from .. import obs
+
+        def wire(self, api, telemetry):
+            if telemetry:
+                self._goodput = obs.ensure_goodput(api)
+            else:
+                self._goodput = obs.GoodputAggregator(publish=False)
+    """
+    r = run_snippet(tmp_path, "tpusched/sched/gwire2.py", guarded,
+                    ["shadow-isolation"])
+    assert r.findings == []
+
+    # the pure data types are NOT accessors: the what-if planner consumes
+    # a measured matrix by value, and that must stay lint-clean
+    consumer = """
+        from ..obs.goodput import GoodputMatrix, workload_fingerprint_of
+
+        def annotate(report, matrix):
+            return matrix.peek(report.workload, report.generation)
+    """
+    r = run_snippet(tmp_path, "tpusched/sim/matrixok.py", consumer,
+                    ["shadow-isolation"])
+    assert r.findings == []
+
+
 # -- monotonic-clock -----------------------------------------------------------
 
 

@@ -1,0 +1,316 @@
+"""E2E tier for the gang runtime goodput plane (ISSUE 10):
+
+- a synthetic gang with one injected slow member is detected, pinned as a
+  ``gang_straggler`` flight-recorder anomaly, and fully attributable
+  (gang, member, skew magnitude) from ``/debug/goodput`` +
+  ``/debug/explain`` output ALONE; tearing the straggler down clears the
+  detection (the hysteresis exit);
+- the workload×generation throughput matrix built from injected
+  step-times orders generations per the injection, survives a
+  snapshot/reload round trip, and is consumable by ``sim/whatif.py``;
+- fleetrace captures goodput reports as ``goodput-report`` events and
+  ``matrix_from_trace`` rebuilds the matrix offline from the trace alone;
+- the ``/debug/`` index enumerates every mounted debug endpoint;
+- ``cmd.explain`` renders the RUNNING-phase gang view.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tpusched import obs, trace
+from tpusched.api.resources import TPU, make_resources
+from tpusched.apiserver import server as srv
+from tpusched.testing.cluster import TestCluster, wait_until
+from tpusched.testing.wrappers import make_pod, make_pod_group, make_tpu_pool
+from tpusched.util.httpserve import DEBUG_ENDPOINTS, MetricsServer
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _get_json(port: int, path: str):
+    status, body = _get(port, path)
+    return status, json.loads(body)
+
+
+@pytest.fixture
+def fresh_obs():
+    """Fresh process-global goodput aggregator + flight recorder, restored
+    afterwards so neighboring tests see their own surfaces."""
+    prev_rec = trace.default_recorder()
+    trace.install_recorder(trace.FlightRecorder())
+    agg = obs.install_goodput(obs.GoodputAggregator())
+    yield agg
+    obs.install_goodput(obs.GoodputAggregator())
+    trace.install_recorder(prev_rec)
+
+
+def _bind_gang(c: TestCluster, name: str, members: int = 4,
+               shape: str = "2x2x4", chips: int = 4):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator="tpu-v5p"))
+    pods = [make_pod(f"{name}-{i:03d}", pod_group=name, limits={TPU: chips},
+                     requests=make_resources(cpu=1, memory="1Gi"))
+            for i in range(members)]
+    c.create_pods(pods)
+    keys = [p.key for p in pods]
+    assert c.wait_for_pods_scheduled(keys, timeout=20), "gang did not bind"
+    return keys
+
+
+def test_straggler_fully_attributable_from_debug_alone(fresh_obs):
+    """The acceptance e2e: slow member → detected + pinned + attributable
+    from /debug/goodput + /debug/explain alone; teardown clears it."""
+    agg = fresh_obs
+    with TestCluster() as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        keys = _bind_gang(c, "slowgang")
+        gang = "default/slowgang"
+        slow = keys[0]
+        # one member runs 5x slow — six synthetic step reports per member
+        # (what the jaxbridge reporters would emit from real hardware)
+        c.pump_gang_progress(gang,
+                             {k: (0.5 if k == slow else 0.1) for k in keys},
+                             steps=6, tokens_per_step=100.0)
+        server = MetricsServer(port=0).start()
+        try:
+            # -- /debug/goodput: the full dump names gang, member, skew
+            status, dump = _get_json(server.port, "/debug/goodput")
+            assert status == 200
+            assert dump["stats"]["attached"] is True
+            [g] = [g for g in dump["gangs"] if g["gang"] == gang]
+            [s] = g["stragglers"]
+            assert s["pod"] == slow
+            assert s["skew"] >= 4.0            # injected 5x, rolling p99
+            assert s["node"]                   # placed node named
+            assert g["step_skew"] >= 4.0
+            member_rows = {m["pod"]: m for m in g["members"]}
+            assert member_rows[slow]["straggler"] is True
+            assert member_rows[keys[1]]["straggler"] is False
+            # -- ?gang= narrows to one document
+            status, one = _get_json(server.port,
+                                    f"/debug/goodput?gang={gang}")
+            assert status == 200 and one["gang"] == gang
+            # -- /debug/explain: the RUNNING-phase answer (no pending
+            # diagnosis exists — the gang is bound)
+            status, ex = _get_json(server.port,
+                                   f"/debug/explain?gang={gang}")
+            assert status == 200
+            assert ex["phase"] == "Running"
+            assert [x["pod"] for x in ex["stragglers"]] == [slow]
+            # -- pinned as a flight-recorder anomaly, fully attributed
+            pinned = [a for t in trace.default_recorder().pinned_traces()
+                      for a in (t.anomalies or [])
+                      if a["kind"] == "gang_straggler"]
+            assert pinned, "gang_straggler anomaly not pinned"
+            assert pinned[0]["gang"] == gang
+            assert pinned[0]["member"] == slow
+            assert float(pinned[0]["skew"]) >= 1.5
+            # -- hysteresis exit: tearing the straggler down clears the
+            # detection (the informer delete evicts the member)
+            c.api.delete(srv.PODS, slow)
+            assert wait_until(
+                lambda: (agg.gang_health(gang) or {}).get("stragglers")
+                == [], timeout=5), "teardown did not clear the verdict"
+            status, ex2 = _get_json(server.port,
+                                    f"/debug/explain?gang={gang}")
+            assert status == 200 and ex2["stragglers"] == []
+        finally:
+            server.stop()
+
+
+def test_unknown_gang_404_names_goodput_surface(fresh_obs):
+    server = MetricsServer(port=0).start()
+    try:
+        status, body = _get_json(server.port,
+                                 "/debug/explain?gang=default/nope")
+        assert status == 404
+        assert "goodput" in body["error"]
+        status, body = _get_json(server.port,
+                                 "/debug/goodput?gang=default/nope")
+        assert status == 404
+    finally:
+        server.stop()
+
+
+def test_debug_index_enumerates_every_mounted_endpoint(fresh_obs):
+    """/debug/ lists every debug route with a description, and the listing
+    cannot go stale: every listed path answers (non-404), and every
+    ``/debug/...`` literal dispatched in httpserve's handler is listed."""
+    import re
+
+    import tpusched.util.httpserve as hs
+    server = MetricsServer(port=0).start()
+    try:
+        status, idx = _get_json(server.port, "/debug/")
+        assert status == 200
+        assert idx["endpoints"] == DEBUG_ENDPOINTS
+        # trailing-slash-less spelling serves the same index
+        status2, idx2 = _get_json(server.port, "/debug")
+        assert status2 == 200 and idx2 == idx
+        for path, desc in idx["endpoints"].items():
+            assert desc.strip(), f"{path}: empty description"
+            status, _body = _get(server.port, path)
+            assert status != 404, f"listed endpoint {path} is unmounted"
+        # source pin: every mounted /debug route appears in the index
+        with open(hs.__file__, encoding="utf-8") as f:
+            src = f.read()
+        mounted = set(re.findall(r'path == "(/debug/[^"]+)"', src))
+        assert mounted <= set(DEBUG_ENDPOINTS), \
+            f"unlisted debug endpoints: {mounted - set(DEBUG_ENDPOINTS)}"
+    finally:
+        server.stop()
+
+
+def test_cmd_explain_renders_running_gang(fresh_obs, capsys):
+    """cmd.explain covers the RUNNING phase: a bound-but-degraded gang
+    renders goodput/straggler attribution instead of a dead end."""
+    from tpusched.api.core import GangMemberStatus
+    from tpusched.cmd import explain
+    agg = fresh_obs
+    gang = "default/rgang"
+    for m in range(3):
+        agg.register_member(f"default/rgang-{m}", gang, f"node-{m}",
+                            workload="llama", generation="tpu-v5p", chips=4)
+    for step in range(1, 7):
+        for m in range(3):
+            st = 0.4 if m == 0 else 0.1
+            agg.ingest([GangMemberStatus(
+                pod_key=f"default/rgang-{m}", gang=gang, step=step,
+                step_time_s=st, throughput=100.0 / st, timestamp=1.0)])
+    server = MetricsServer(port=0).start()
+    try:
+        rc = explain.main(["--url", f"http://127.0.0.1:{server.port}",
+                           "--gang", gang])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "RUNNING" in out
+        assert "STRAGGLERS (1)" in out
+        assert "default/rgang-0 on node-0" in out
+        assert "Why is my gang slow?" in out
+        # --json yields the raw payload for scripting
+        rc = explain.main(["--url", f"http://127.0.0.1:{server.port}",
+                           "--gang", gang, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0 and payload["phase"] == "Running"
+    finally:
+        server.stop()
+        for m in range(3):
+            agg.on_pod_delete(f"default/rgang-{m}")
+
+
+def test_matrix_ordering_round_trip_and_whatif_consumption(fresh_obs,
+                                                           tmp_path):
+    """The throughput-matrix acceptance: two workloads × two generations
+    with different injected step-times order per the injection, survive
+    snapshot/reload, and feed the what-if planner's goodput annotation."""
+    from tpusched.api.core import GangMemberStatus
+    from tpusched.sim.whatif import simulate_gang
+    agg = fresh_obs
+    # injected device rates (tokens/s/chip over 4 chips):
+    #   llama: v6e 2x faster than v5p;  moe: v5p faster than v6e
+    inject = {("llama", "tpu-v5p"): 0.4, ("llama", "tpu-v6e"): 0.2,
+              ("moe", "tpu-v5p"): 0.5, ("moe", "tpu-v6e"): 1.0}
+    i = 0
+    for (workload, gen), step_time in inject.items():
+        gang = f"default/{workload}-{gen}"
+        pod = f"{gang}-0"
+        agg.register_member(pod, gang, f"n{i}", workload=workload,
+                            generation=gen, chips=4)
+        i += 1
+        for step in range(1, 5):
+            agg.ingest([GangMemberStatus(
+                pod_key=pod, gang=gang, step=step, step_time_s=step_time,
+                throughput=400.0 / step_time, timestamp=1.0)])
+    matrix = agg.matrix_snapshot()
+    # ordering matches the injected step times, per workload — and the
+    # two workloads prefer OPPOSITE generations (the Gavel point)
+    assert matrix.peek("llama", "tpu-v6e") > matrix.peek("llama", "tpu-v5p")
+    assert matrix.peek("moe", "tpu-v5p") > matrix.peek("moe", "tpu-v6e")
+    assert matrix.best_generation("llama") == "tpu-v6e"
+    assert matrix.best_generation("moe") == "tpu-v5p"
+    # snapshot → disk → reload round trip
+    path = str(tmp_path / "matrix.json")
+    agg.save_matrix(path)
+    back = obs.load_matrix(path)
+    assert back.to_dict() == matrix.to_dict()
+    # consumable by the what-if planner: a hypothetical llama gang landing
+    # on a v5e-free fleet of v6e reports the measured cell AND that the
+    # matrix would prefer v5p for this workload
+    api = srv.APIServer()
+    topo, nodes = make_tpu_pool("pool-v6e", accelerator="tpu-v6e",
+                                dims=(8, 8))      # v6e torus is 2-D
+    api.create(srv.TPU_TOPOLOGIES, topo)
+    for n in nodes:
+        api.create(srv.NODES, n)
+    report = simulate_gang(api, name="trial", members=4,
+                           slice_shape="4x4", accelerator="tpu-v6e",
+                           chips_per_pod=4, timeout_s=20.0,
+                           goodput_matrix=back)
+    assert report.feasible
+    assert report.generation == "tpu-v6e"
+    assert report.workload  # shape-derived fingerprint ("4x4/4chip")
+    # the trial workload has no measured cell (fingerprints differ from
+    # the labeled "llama"): None, never fabricated zero
+    assert report.goodput_per_chip is None
+    # a matrix measured under the SAME fingerprint annotates fully
+    fp = report.workload
+    for gen, per_chip in (("tpu-v5p", 900.0), ("tpu-v6e", 450.0)):
+        back.fold(fp, gen, per_chip, "tokens", 2.0)
+    report2 = simulate_gang(api, name="trial2", members=4,
+                            slice_shape="4x4", accelerator="tpu-v6e",
+                            chips_per_pod=4, timeout_s=20.0,
+                            goodput_matrix=back)
+    assert report2.feasible
+    assert report2.goodput_per_chip == pytest.approx(450.0)
+    assert report2.best_generation == "tpu-v5p"   # fits, but on the slow gen
+
+
+def test_fleetrace_captures_reports_and_matrix_from_trace(fresh_obs,
+                                                          tmp_path):
+    """Recorded traces carry the matrix: goodput reports are captured as
+    ``goodput-report`` events, and ``matrix_from_trace`` rebuilds the
+    workload×generation matrix from the trace alone — no live aggregator
+    state."""
+    from tpusched.obs.fleetrace import FleetTraceRecorder, load_trace
+    from tpusched.obs.goodput import matrix_from_trace
+    rec = FleetTraceRecorder()
+    with TestCluster() as c:
+        topo, nodes = make_tpu_pool("pool-a", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        rec.attach(c.api, str(tmp_path / "trace"))
+        try:
+            keys = _bind_gang(c, "tracegang")
+            c.pump_gang_progress("default/tracegang",
+                                 {k: 0.1 for k in keys}, steps=4,
+                                 tokens_per_step=400.0)
+        finally:
+            rec.detach()
+    tr = load_trace(str(tmp_path / "trace"))
+    by_kind = tr.events_by_kind()
+    assert by_kind.get("goodput-report", 0) == 16      # 4 members × 4 steps
+    [ev] = [e for e in tr.events if e.get("kind") == "goodput-report"
+            and e.get("pod") == keys[0] and e.get("step") == 4]
+    assert ev["throughput"] == pytest.approx(4000.0)
+    assert ev["unit"] == "tokens"
+    # offline reconstruction: 4 chips/member ⇒ 1000 tokens/s/chip on the
+    # pool's generation, keyed by the shape-derived fingerprint
+    m = matrix_from_trace(tr)
+    assert m.peek("2x2x4/4chip", "tpu-v5p") == pytest.approx(1000.0)
+    # and the replay driver ignores the new kind (recorded telemetry is
+    # not workload): apply_event refuses to re-feed it
+    from tpusched.sim.replay import apply_event
+    assert apply_event(srv.APIServer(), ev) is False

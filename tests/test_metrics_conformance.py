@@ -287,3 +287,59 @@ def test_issue7_families_round_trip_exposition():
     assert sub_ms and max(sub_ms) >= 1.0
     assert (("tpusched_binds_total", {"scheduler": "conformance-sched"},
              1.0)) in samples
+
+
+def test_issue10_goodput_families_round_trip_exposition():
+    """The ISSUE 10 families (gang runtime goodput gauges, straggler
+    counter/gauges, the workload×generation matrix gauge and the report
+    accounting counters) parse clean through the validating round trip,
+    and children removed on gang teardown vanish from the exposition —
+    cardinality must track LIVE gangs only."""
+    from tpusched.util.metrics import (gang_goodput_per_chip,
+                                       gang_goodput_units, gang_step_skew,
+                                       gang_straggler_events,
+                                       gang_stragglers,
+                                       goodput_reports_dropped,
+                                       goodput_reports_shed,
+                                       goodput_reports_total,
+                                       workload_goodput_per_chip)
+    gang = 'conformance/gang-"q"'       # exercises label escaping too
+    gang_goodput_units.with_labels(gang, "tokens").set(4000.0)
+    gang_goodput_per_chip.with_labels(gang, "tokens").set(250.0)
+    gang_step_skew.with_labels(gang).set(1.5)
+    gang_stragglers.with_labels(gang).set(1)
+    gang_straggler_events.with_labels(gang).inc()
+    workload_goodput_per_chip.with_labels("2x2x4/4chip", "tpu-v5p").set(250.0)
+    workload_goodput_per_chip.with_labels("2x2x4/4chip", "tpu-v6e").set(510.0)
+    goodput_reports_total.inc(3)
+    goodput_reports_shed.inc(0)
+    goodput_reports_dropped.inc(0)
+    try:
+        types, helps, samples = parse_exposition(REGISTRY.expose())
+        assert types["tpusched_gang_goodput_units_per_second"] == "gauge"
+        assert types["tpusched_gang_goodput_per_chip"] == "gauge"
+        assert types["tpusched_gang_goodput_step_skew"] == "gauge"
+        assert types["tpusched_gang_stragglers"] == "gauge"
+        assert types["tpusched_gang_straggler_events_total"] == "counter"
+        assert types["tpusched_workload_goodput_per_chip"] == "gauge"
+        assert types["tpusched_goodput_reports_total"] == "counter"
+        assert types["tpusched_goodput_reports_shed_total"] == "counter"
+        assert types["tpusched_goodput_reports_dropped_total"] == "counter"
+        assert ("tpusched_gang_goodput_units_per_second",
+                {"gang": gang, "unit": "tokens"}, 4000.0) in samples
+        cells = {labels["generation"]: v for name, labels, v in samples
+                 if name == "tpusched_workload_goodput_per_chip"
+                 and labels.get("workload") == "2x2x4/4chip"}
+        assert cells == {"tpu-v5p": 250.0, "tpu-v6e": 510.0}
+        # teardown removes the gang's children from the exposition
+        gang_goodput_units.remove(gang, "tokens")
+        gang_goodput_per_chip.remove(gang, "tokens")
+        gang_step_skew.remove(gang)
+        gang_stragglers.remove(gang)
+        gang_straggler_events.remove(gang)
+        _, _, samples2 = parse_exposition(REGISTRY.expose())
+        assert not any(labels.get("gang") == gang
+                       for _, labels, _ in samples2)
+    finally:
+        workload_goodput_per_chip.remove("2x2x4/4chip", "tpu-v5p")
+        workload_goodput_per_chip.remove("2x2x4/4chip", "tpu-v6e")

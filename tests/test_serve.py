@@ -110,6 +110,31 @@ def test_measure_serving_reports_occupancy(model):
     assert out["tokens_per_s"] > 0
 
 
+def test_measure_serving_reporter_reports_true_rate(model):
+    # the in-band report's throughput must equal the measured tokens/s —
+    # not the per-tick rate inflated by the tick count
+    from tpusched.jaxbridge.measure import GoodputReporter
+    cfg, params = model
+    rng = np.random.default_rng(5)
+    reqs = [Request(rid=i, prompt=_prompt(rng, 3, 9, cfg.vocab),
+                    max_new_tokens=int(rng.integers(2, 7)))
+            for i in range(6)]
+    batches = []
+
+    class _CS:
+        def report_status(self, reports):
+            batches.append(list(reports))
+
+    rep = GoodputReporter(_CS(), "default/srv-0", gang="default/srv",
+                          min_interval_s=0.0)
+    out = measure_serving(cfg, params, reqs, slots=2, max_seq=48,
+                          prompt_bucket=16, reporter=rep)
+    [batch] = batches
+    [r] = batch
+    assert r.throughput == pytest.approx(out["tokens_per_s"], rel=1e-6)
+    assert r.step == out["ticks"]
+
+
 def test_tp_sharded_engine_matches_unsharded(model):
     """Tensor-parallel serving on a tp=2 mesh (virtual CPU devices): the
     sharded engine's greedy completions must equal the unsharded solo

@@ -145,3 +145,59 @@ def test_throughput_scenario_schema_requirements():
                            pod_e2e_p99_s=1.5, runs=3)
     probs = bench.validate_results_artifact(bench.build_results_artifact())
     assert any("binds_per_sec" in p for p in probs)
+
+
+def test_storm_run_carries_fleet_goodput_stamp():
+    """ISSUE 10: every storm run ingests in-band member goodput reports
+    and stamps the aggregate — reports accepted, nothing silently shed,
+    measured matrix cells (ROADMAP item 3's baseline column) — and the
+    stamped scenario round-trips the v2 validator."""
+    r = bench.run_storm_once(pools=1, duration_s=0.5, max_pending_pods=60,
+                             seed=7, drain_timeout_s=60)
+    fg = r["fleet_goodput"]
+    assert fg["reports"] == r["submitted_pods"]    # one flush per member
+    assert fg["reporting_members"] == r["submitted_pods"]  # cumulative,
+    # not a racy window-edge census of not-yet-reaped members
+    assert fg["shed"] == 0
+    assert fg["matrix_cells"] >= 1                 # v5p cells measured
+    assert fg["goodput_per_chip_mean"] > 0
+    bench._record_scenario(
+        "arrival_storm", "throughput",
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1, fleet_goodput=fg)
+    assert bench.validate_results_artifact(
+        bench.build_results_artifact()) == []
+    # the control arm (reports off) stamps explicit zeros, still valid
+    r0 = bench.run_storm_once(pools=1, duration_s=0.5,
+                              max_pending_pods=60, seed=8,
+                              drain_timeout_s=60, goodput_reports=False)
+    assert r0["fleet_goodput"]["reports"] == 0
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda d: d["scenarios"]["arrival_storm"].update(
+        fleet_goodput="not-a-dict"), "fleet_goodput: not an object"),
+    (lambda d: d["scenarios"]["arrival_storm"]["fleet_goodput"].pop(
+        "reports"), "fleet_goodput.reports"),
+    (lambda d: d["scenarios"]["arrival_storm"]["fleet_goodput"].update(
+        goodput_per_chip_mean="fast"), "goodput_per_chip_mean"),
+    (lambda d: d["scenarios"]["arrival_storm"]["fleet_goodput"].update(
+        reporting_members=True), "fleet_goodput.reporting_members"),
+    # the stamp belongs to throughput scenarios only
+    (lambda d: d["scenarios"].update(lat={"kind": "latency", "p50_s": 1.0,
+                                          "p99_s": 2.0, "min_s": 0.5,
+                                          "n": 3, "fleet_goodput": {}}),
+     "only throughput scenarios"),
+])
+def test_validator_rejects_malformed_fleet_goodput(mutate, expect):
+    bench._record_scenario(
+        "arrival_storm", "throughput",
+        binds_per_sec=100.0, pod_e2e_p50_s=0.5, pod_e2e_p99_s=1.5, runs=3,
+        fleet_goodput={"reports": 100, "shed": 0, "straggler_edges": 0,
+                       "matrix_cells": 2, "goodput_per_chip_mean": 250.0,
+                       "reporting_members": 12})
+    doc = bench.build_results_artifact()
+    assert bench.validate_results_artifact(doc) == []
+    mutate(doc)
+    probs = bench.validate_results_artifact(doc)
+    assert probs and any(expect in p for p in probs), probs

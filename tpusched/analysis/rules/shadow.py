@@ -23,7 +23,14 @@ live hot-path samples or binds/sec.  The fleet-trace additions (ISSUE 9)
 extend it again: a replay driver or shadow scheduler must never reach the
 process-global fleet recorder (``default_fleetrecorder``/
 ``ensure_fleetrace``) — a replay's simulated binds journaled into the
-live trace directory would forge fleet history.
+live trace directory would forge fleet history.  The goodput additions
+(ISSUE 10) extend it once more: the runtime-telemetry aggregator
+(``default_goodput``/``install_goodput``/``ensure_goodput``) is a live
+surface — a shadow publishing synthetic member reports would fabricate
+fleet goodput, straggler anomalies and throughput-matrix cells; shadows
+hold a private ``GoodputAggregator(publish=False)`` instead.  (The pure
+data types — ``GoodputMatrix``, ``workload_fingerprint_of`` — are NOT
+accessors: sim/ consumes matrices by value on purpose.)
 
 Checks:
 
@@ -52,7 +59,8 @@ _ACCESSORS = frozenset((
     "default_recorder", "install_recorder", "default_engine",
     "install_engine", "default_slo", "install_slo",
     "default_profiler", "install_profiler", "ensure_profiler",
-    "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace"))
+    "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace",
+    "default_goodput", "install_goodput", "ensure_goodput"))
 _REGISTRY_METHODS = frozenset(("gauge_func", "register_collector"))
 _GUARDS = ("telemetry", "_telemetry", "publish", "_publish")
 _DEFINING = frozenset(("tpusched/trace/__init__.py",

@@ -337,6 +337,34 @@ class Event:
     timestamp: float = 0.0
 
 
+@dataclass
+class GangMemberStatus:
+    """One in-band runtime progress report from a RUNNING gang member — the
+    payload that rides the node heartbeat (``clientset.nodes.heartbeat(...,
+    reports=[...])``) so runtime goodput telemetry costs zero extra API
+    round trips. Advisory by contract, like Events: the apiserver fans
+    reports out to registered status sinks (the goodput aggregator, the
+    fleet trace capture) best-effort, and every sink is bounded and sheds —
+    a report is never load-bearing for scheduling correctness.
+
+    ``throughput`` is items of ``unit`` per second ACROSS this member
+    (tokens for training/serving, examples for input-bound pipelines,
+    requests for serving frontends). ``step`` is the member's step index —
+    the per-member step SKEW within a gang is the straggler signal.
+    ``ttft_s`` carries the serving time-to-first-token over the member's
+    reporting window (0 = not a serving member); ``stall_s`` accumulates
+    checkpoint/restore stall seconds inside the window."""
+    pod_key: str = ""
+    gang: str = ""              # PodGroup full name ("" = solo workload)
+    step: int = 0               # step index / serving tick at report time
+    step_time_s: float = 0.0    # seconds per step over the window
+    throughput: float = 0.0     # unit/s across the member
+    unit: str = "tokens"        # tokens | examples | requests
+    ttft_s: float = 0.0         # serving TTFT over the window (0 = n/a)
+    stall_s: float = 0.0        # checkpoint/restore stall in the window
+    timestamp: float = 0.0      # wall clock; 0 = stamped by the server
+
+
 def tolerates(pod: Pod, taint: Taint) -> bool:
     for t in pod.spec.tolerations:
         if t.effect and t.effect != taint.effect:

@@ -35,7 +35,8 @@ from typing import Callable, Dict, Optional
 
 from ..api.core import Binding
 from ..util import klog, tracectx
-from ..util.metrics import api_retries, api_retry_exhausted, events_dropped
+from ..util.metrics import (api_retries, api_retry_exhausted, events_dropped,
+                            goodput_reports_dropped)
 from . import server as srv
 from .errors import Conflict, Throttled, is_retriable
 
@@ -234,13 +235,21 @@ class _PodClient(_KindClient):
 
 
 class _NodeClient(_KindClient):
-    def heartbeat(self, name: str, now: Optional[float] = None):
+    def heartbeat(self, name: str, now: Optional[float] = None,
+                  reports: Optional[list] = None):
         """The kubelet heartbeat (Lease-renewal analog): stamp
         ``status.last_heartbeat_time``. Goes through the normal retry
         layer — a node agent keeps heartbeating through transient apiserver
         blips; the lifecycle controller's grace period absorbs the rest.
         Both Ready transitions (condition + taint) stay with the lifecycle
-        controller, so exactly one component owns the node-health edges."""
+        controller, so exactly one component owns the node-health edges.
+
+        ``reports``: in-band ``GangMemberStatus`` progress reports from the
+        gang members running on this node, piggybacked so runtime goodput
+        telemetry costs zero extra API calls. Delivery is best-effort AFTER
+        the heartbeat lands (the liveness signal is the load-bearing half):
+        a failed fan-out is swallowed and counted, never retried — the next
+        heartbeat carries fresher numbers anyway."""
         # tpulint: disable=monotonic-clock — heartbeat stamps are
         # wall-clock by contract: the lifecycle controller compares
         # them against its own injected wall clock; tests pass now=
@@ -248,7 +257,22 @@ class _NodeClient(_KindClient):
 
         def mutate(node):
             node.status.last_heartbeat_time = ts
-        return self.patch(f"/{name}" if "/" not in name else name, mutate)
+        out = self.patch(f"/{name}" if "/" not in name else name, mutate)
+        if reports:
+            _fan_out_reports(self._api, reports, node=name)
+        return out
+
+
+def _fan_out_reports(api, reports: list, **ctx) -> None:
+    """In-band status-report fan-out, advisory by contract: a failure is
+    swallowed and counted, never retried — the next batch carries fresher
+    numbers anyway."""
+    try:
+        api.report_status(reports)
+    except Exception as e:  # noqa: BLE001 — advisory by contract
+        goodput_reports_dropped.inc(len(reports))
+        klog.V(4).info_s("goodput report fan-out dropped",
+                         reports=len(reports), err=str(e), **ctx)
 
 
 class _Hooks:
@@ -281,6 +305,13 @@ class Clientset:
         self.pdbs = _KindClient(api, srv.PDBS, bucket, retry, hooks)
         self.tputopologies = _KindClient(api, srv.TPU_TOPOLOGIES, bucket,
                                          retry, hooks)
+
+    def report_status(self, reports: list) -> None:
+        """Direct (non-heartbeat) in-band status report path for emitters
+        without a node identity (a serving frontend, a test pump). Same
+        best-effort contract as ``record_event``: advisory telemetry must
+        never raise into the caller, and is never retried."""
+        _fan_out_reports(self.api, reports)
 
     def record_event(self, object_key: str, kind: str, etype: str, reason: str,
                      message: str = "") -> None:

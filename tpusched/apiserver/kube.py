@@ -46,7 +46,7 @@ from http import client as httplib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
-from ..api.core import Binding, Event
+from ..api.core import Binding, Event, GangMemberStatus
 from ..util import klog
 from . import kubecodec as codec
 from . import server as srv
@@ -331,6 +331,11 @@ class KubeAPIServer:
         # rv), local monotonic time first seen) — expiry is judged against
         # local observation age, never by comparing clocks across nodes
         self._lease_obs: Dict[str, Tuple[Tuple[str, str, str], float]] = {}
+        # in-band gang runtime status reports: kube mode has no server-side
+        # fan-out object, so reports from in-process emitters (the
+        # clientset heartbeat piggyback) fan out locally — same surface
+        # and sink contract as the in-memory APIServer
+        self._status_sinks: List[Callable[[List[GangMemberStatus]], Any]] = []
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -789,6 +794,42 @@ class KubeAPIServer:
     def events(self) -> List[Event]:
         with self._lock:
             return list(self._events)
+
+    # -- gang runtime status reports (heartbeat-piggybacked) -------------------
+
+    def add_status_sink(self, sink: Callable[[List[GangMemberStatus]], Any]
+                        ) -> None:
+        """Same contract as ``APIServer.add_status_sink``: idempotent per
+        sink object, so a re-armed consumer never double-delivers."""
+        with self._lock:
+            if sink not in self._status_sinks:
+                self._status_sinks.append(sink)
+
+    def remove_status_sink(self, sink) -> None:
+        with self._lock:
+            try:
+                self._status_sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def report_status(self, reports: List[GangMemberStatus]) -> None:
+        """In-band gang progress reports. Kube mode keeps these process-
+        local (no kube resource models them): stamp unstamped reports and
+        fan out outside the lock, containing sink panics — identical
+        semantics to the in-memory server."""
+        if not reports:
+            return
+        now = self._clock()
+        for r in reports:
+            if not r.timestamp:
+                r.timestamp = now
+        with self._lock:
+            sinks = list(self._status_sinks)
+        for sink in sinks:
+            try:
+                sink(reports)
+            except Exception as e:  # sinks must not kill the server
+                klog.error_s(e, "status sink panicked")
 
 
 class KubeLease:

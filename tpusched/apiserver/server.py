@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..api.core import Binding, Event, Pod, PodCondition
+from ..api.core import Binding, Event, GangMemberStatus, Pod, PodCondition
 from ..util import klog
 
 # Canonical kind names.
@@ -95,6 +95,11 @@ class APIServer:
         # under the store lock, before the watch event fires — the etcd
         # happens-before. Signature: sink(op: "put"|"delete", kind, stored).
         self._persist: Optional[Callable[[str, str, Any], None]] = None
+        # In-band gang runtime status sinks (goodput aggregator, fleet
+        # trace capture).  Reports are ADVISORY: sinks run outside the
+        # store lock, must be bounded/shedding, and a panicking sink is
+        # swallowed — runtime telemetry never breaks the control plane.
+        self._status_sinks: List[Callable[[List[GangMemberStatus]], Any]] = []
 
     # -- plumbing -------------------------------------------------------------
 
@@ -332,6 +337,43 @@ class APIServer:
     def events(self) -> List[Event]:
         with self._lock:
             return list(self._events)
+
+    # -- gang runtime status reports (heartbeat-piggybacked) -------------------
+
+    def add_status_sink(self, sink: Callable[[List[GangMemberStatus]], Any]
+                        ) -> None:
+        """Register a runtime-status consumer. Idempotent per sink object —
+        a re-armed capture must not double-deliver every report."""
+        with self._lock:
+            if sink not in self._status_sinks:
+                self._status_sinks.append(sink)
+
+    def remove_status_sink(self, sink) -> None:
+        with self._lock:
+            try:
+                self._status_sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def report_status(self, reports: List[GangMemberStatus]) -> None:
+        """In-band gang member progress reports, normally piggybacked on
+        the node heartbeat (``clientset.nodes.heartbeat``). Stamps unstamped
+        reports and fans them out to every registered sink OUTSIDE the
+        store lock — sinks own their bounding/shedding; a panicking sink is
+        contained like a watch handler."""
+        if not reports:
+            return
+        now = self._clock()
+        for r in reports:
+            if not r.timestamp:
+                r.timestamp = now
+        with self._lock:
+            sinks = list(self._status_sinks)
+        for sink in sinks:
+            try:
+                sink(reports)
+            except Exception as e:  # sinks must not kill the server
+                klog.error_s(e, "status sink panicked")
 
     # -- coordination (leases for leader election) ---------------------------
 

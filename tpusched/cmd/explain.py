@@ -1,9 +1,12 @@
-"""The explain binary: "why is my pod/gang still pending?" from a shell.
+"""The explain binary: "why is my pod/gang still pending — or slow?"
 
 Queries a running scheduler's ``/debug/explain`` endpoint (the why-pending
 diagnosis engine, ``tpusched/obs``) and renders the answer for a human:
 blocking plugin, top rejection reasons with node counts, attempts, and the
-suggested unblock signal.
+suggested unblock signal.  A gang with NO pending diagnosis may simply be
+bound and RUNNING: the endpoint then answers with its runtime goodput
+health (rolling goodput, step skew, straggler attribution — fed by the
+heartbeat-piggybacked member reports) and this binary renders that view.
 
     python -m tpusched.cmd.explain --url http://localhost:8080 \\
         --pod default/worker-003
@@ -76,7 +79,40 @@ def _render_pod(out) -> None:
     print(f"  unblock: {out['suggestion']}")
 
 
+def _render_running_gang(out) -> None:
+    """The RUNNING-phase gang view: no pending diagnosis exists because
+    the gang is bound — render its runtime goodput health (fed by the
+    heartbeat-piggybacked member reports, /debug/goodput) instead of the
+    historical 'no pending diagnosis' dead end."""
+    goodput = ", ".join(f"{v:g} {u}/s" for u, v in
+                        sorted((out.get("goodput") or {}).items()))
+    per_chip = ", ".join(f"{v:g} {u}/s/chip" for u, v in
+                         sorted((out.get("goodput_per_chip") or {}).items()))
+    print(f"gang {out['gang']}: RUNNING, {out['members_reporting']} "
+          f"member(s) reporting over {out['chips']} chip(s)")
+    if out.get("workload"):
+        print(f"  workload: {out['workload']}")
+    print(f"  goodput: {goodput or '(no throughput reported)'}"
+          + (f" ({per_chip})" if per_chip else ""))
+    print(f"  step time p50: {out['step_time_p50_s']}s, step skew "
+          f"{out['step_skew']}x (slowest member p99 over gang median)")
+    stragglers = out.get("stragglers") or []
+    if stragglers:
+        print(f"  STRAGGLERS ({len(stragglers)}):")
+        for s in stragglers:
+            print(f"  - {s['pod']} on {s['node']}: p99 step "
+                  f"{s['step_time_p99_s']}s = {s['skew']}x the gang "
+                  f"median {s['gang_step_time_p50_s']}s")
+        print("  unblock: drain/replace the straggler's node (teardown "
+              "clears the verdict); see doc/ops.md 'Why is my gang slow?'")
+    else:
+        print("  no stragglers flagged")
+
+
 def _render_gang(out) -> None:
+    if out.get("phase") == "Running":
+        _render_running_gang(out)
+        return
     print(f"gang {out['gang']}: {out['members_pending']} member(s) still "
           f"pending for {out['pending_for_s']:.1f}s "
           f"(outcomes {out['outcomes']})")

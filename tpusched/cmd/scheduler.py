@@ -66,11 +66,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "segments here — replayable via `python -m "
                         "tpusched.cmd.trace replay`. Equivalent to "
                         "TPUSCHED_FLEETRACE_DIR")
+    p.add_argument("--goodput-matrix-out", default=None, metavar="PATH",
+                   help="export the measured workload×generation goodput "
+                        "matrix (the Gavel throughput matrix, fed by "
+                        "in-band gang member reports) as a schema-"
+                        "versioned JSON artifact on shutdown — loadable "
+                        "by obs.load_matrix / `cmd.whatif` for goodput-"
+                        "aware planning")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "/debug/trace /debug/gangs /debug/flightrecorder "
-                        "/debug/explain /debug/fleetrace (0 picks a free "
-                        "port; off by default)")
+                        "/debug/explain /debug/fleetrace /debug/goodput "
+                        "/debug/ (0 picks a free port; off by default)")
     p.add_argument("--metrics-bind-address", default="127.0.0.1",
                    help="bind address for --metrics-port; use 0.0.0.0 "
                         "in-cluster so ServiceMonitor/kubelet can reach it")
@@ -281,6 +288,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         for s in schedulers:
             s.stop()
+        if args.goodput_matrix_out:
+            # the measured workload×generation matrix outlives the
+            # process as a schema-versioned artifact (cmd/ wires the
+            # live surfaces by contract — the shadow-isolation exemption)
+            from .. import obs
+            try:
+                obs.default_goodput().save_matrix(args.goodput_matrix_out)
+                klog.info_s("goodput matrix exported",
+                            path=args.goodput_matrix_out)
+            except OSError as e:
+                klog.error_s(e, "goodput matrix export failed",
+                             path=args.goodput_matrix_out)
         if metrics_server is not None:
             metrics_server.stop()
         if journal is not None:

@@ -35,6 +35,98 @@ import numpy as np
 
 from .workload import ModelConfig, init_params, loss_fn, sgd_train_step
 
+
+class GoodputReporter:
+    """The in-band goodput emitter (contract: doc/jaxbridge.md).
+
+    A training/serving loop folds observations in locally —
+    ``observe_step`` per step (or per serving tick), ``observe_ttft`` /
+    ``observe_stall`` as they happen — and the reporter flushes at most
+    one bounded ``GangMemberStatus`` per ``min_interval_s`` through
+    ``clientset.report_status`` (on a TPU host the node agent piggybacks
+    the same payload on its heartbeat: ``clientset.nodes.heartbeat(...,
+    reports=[...])``).  Emission is ADVISORY by the apiserver contract:
+    it never raises into the loop, is never retried, and a dropped
+    report is simply superseded by the next window's fresher numbers.
+
+    Throughput is Σitems / Σstep-time over the window — the DEVICE rate;
+    checkpoint/restore stalls ride separately in ``stall_s`` so the
+    aggregator (and an operator) can tell "slow chip" from "stalled
+    job".  All internal clocks are monotonic (injectable for tests); the
+    wall timestamp is stamped server-side on ingest."""
+
+    def __init__(self, clientset, pod_key: str, gang: str = "",
+                 unit: str = "tokens", min_interval_s: float = 5.0,
+                 clock=time.monotonic):
+        self._client = clientset
+        self.pod_key = pod_key
+        self.gang = gang
+        self.unit = unit
+        self.min_interval_s = min_interval_s
+        self._clock = clock
+        self._last_flush = -1.0          # <0 = never flushed
+        self._step = 0
+        self._step_time_sum = 0.0
+        self._steps_observed = 0
+        self._items = 0.0
+        self._ttft_s = 0.0
+        self._stall_s = 0.0
+        self.sent = 0
+
+    def observe_step(self, step: int, step_time_s: float,
+                     items: float = 0.0) -> None:
+        """One completed step (training) or tick (serving): its index,
+        its device seconds, and the items (tokens/examples/requests) it
+        produced."""
+        self._step = max(self._step, int(step))
+        if step_time_s > 0:
+            self._step_time_sum += step_time_s
+            self._steps_observed += 1
+        self._items += max(0.0, items)
+
+    def observe_ttft(self, ttft_s: float) -> None:
+        """Serving time-to-first-token over the current window (latest
+        wins — the freshest window is the autoscaling signal)."""
+        if ttft_s > 0:
+            self._ttft_s = ttft_s
+
+    def observe_stall(self, seconds: float) -> None:
+        """Checkpoint/restore (or other non-productive) stall seconds."""
+        self._stall_s += max(0.0, seconds)
+
+    def maybe_flush(self) -> bool:
+        """Interval-gated flush — call freely from the loop."""
+        now = self._clock()
+        if 0 <= self._last_flush and now - self._last_flush \
+                < self.min_interval_s:
+            return False
+        return self.flush()
+
+    def flush(self) -> bool:
+        """Send the window now (empty windows are skipped).  Resets the
+        window on success or failure alike: report_status is best-effort
+        and stale numbers must not snowball into the next window."""
+        if self._steps_observed == 0 and self._items == 0 \
+                and self._ttft_s == 0 and self._stall_s == 0:
+            return False
+        from ..api.core import GangMemberStatus
+        report = GangMemberStatus(
+            pod_key=self.pod_key, gang=self.gang, step=self._step,
+            step_time_s=(self._step_time_sum / self._steps_observed
+                         if self._steps_observed else 0.0),
+            throughput=(self._items / self._step_time_sum
+                        if self._step_time_sum > 0 else 0.0),
+            unit=self.unit, ttft_s=self._ttft_s, stall_s=self._stall_s)
+        self._last_flush = self._clock()
+        self._step_time_sum = 0.0
+        self._steps_observed = 0
+        self._items = 0.0
+        self._ttft_s = 0.0
+        self._stall_s = 0.0
+        self._client.report_status([report])
+        self.sent += 1
+        return True
+
 # bf16 peak TFLOP/s per chip, by device_kind prefix (public spec sheets).
 # v5 lite == v5e; "TPU v4" reports its two cores as one device under PJRT.
 _PEAK_TFLOPS = (
@@ -256,11 +348,17 @@ def moe_flops_note(cfg: ModelConfig, batch: int) -> str:
 
 def measure_train_step(cfg: ModelConfig, batch: int, k1: int = 2,
                        k2: int = 8, repeats: int = 3,
-                       lr: float = 1e-4) -> Tuple[float, float, Optional[float]]:
+                       lr: float = 1e-4,
+                       reporter: Optional[GoodputReporter] = None
+                       ) -> Tuple[float, float, Optional[float]]:
     """Median per-step seconds, achieved TFLOP/s, and MFU (None off-TPU /
     unknown chip) for the flagship train step on the default backend.
     The K-chained loop threads params through fori_loop, so every step
-    depends on the previous — no overlap can hide a step."""
+    depends on the previous — no overlap can hide a step.
+
+    ``reporter``: an optional in-band goodput emitter — the measured
+    per-step time and tokens/s flow to the scheduler's runtime-telemetry
+    plane as one ``GangMemberStatus`` report (doc/jaxbridge.md)."""
     params = init_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq),
                                 0, cfg.vocab, dtype=jnp.int32)
@@ -283,6 +381,9 @@ def measure_train_step(cfg: ModelConfig, batch: int, k1: int = 2,
     tflops = train_step_flops(cfg, batch) / per_step / 1e12
     peak = device_peak_tflops()
     mfu = tflops / peak if peak else None
+    if reporter is not None:
+        reporter.observe_step(k2, per_step, items=batch * cfg.seq)
+        reporter.flush()
     return per_step, tflops, mfu
 
 

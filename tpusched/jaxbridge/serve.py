@@ -1033,12 +1033,17 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
                     draft_params: Optional[Params] = None,
                     draft_cfg: Optional[ModelConfig] = None,
                     spec_k: int = 4,
-                    time_fn: Callable[[], float] = None) -> Dict[str, float]:
+                    time_fn: Callable[[], float] = None,
+                    reporter=None) -> Dict[str, float]:
     """Throughput of the continuous engine vs the static-batch floor on the
     SAME request set. Static batching pads every generation to the
     longest in its batch-of-``slots`` — the idle-lane tokens it burns are
     exactly what continuous admission reclaims. Returns tokens/s plus the
-    occupancy ratio (real tokens / slot-ticks)."""
+    occupancy ratio (real tokens / slot-ticks).
+
+    ``reporter``: optional in-band goodput emitter
+    (``measure.GoodputReporter``) — the measured tick time and tokens/s
+    flow to the scheduler's runtime-telemetry plane (doc/jaxbridge.md)."""
     import time as _time
     time_fn = time_fn or _time.perf_counter
     eng = ServeEngine(params, cfg, slots=slots, max_seq=max_seq,
@@ -1084,6 +1089,14 @@ def measure_serving(cfg: ModelConfig, params: Params, requests: List[Request],
     if draft_params is not None:
         out.update({f"spec_{k_}": float(v)
                     for k_, v in eng.spec_stats.items()})
+    if reporter is not None:
+        # one observation per measured window, folded at per-tick scale:
+        # step_time and items are both /ticks so flush()'s Σitems/Σtime
+        # yields the true tokens/s (whole-window items against one tick's
+        # time would inflate the rate ×ticks)
+        reporter.observe_step(decode_ticks, elapsed / decode_ticks,
+                              items=float(total_tokens) / decode_ticks)
+        reporter.flush()
     return out
 
 
@@ -1100,8 +1113,8 @@ def measure_serving_slo(cfg: ModelConfig, params: Params,
                         chunk_prefill: Optional[int] = None,
                         prefix_tokens: "Optional[np.ndarray]" = None,
                         ttft_slo_ticks: Optional[int] = None,
-                        time_fn: Callable[[], float] = None
-                        ) -> Dict[str, float]:
+                        time_fn: Callable[[], float] = None,
+                        reporter=None) -> Dict[str, float]:
     """Serving SLO statistics under seeded stochastic arrivals: requests
     enter the engine at their ``arrival_ticks`` (not all upfront), and the
     harness stamps each request's submit→first-token interval.
@@ -1120,6 +1133,11 @@ def measure_serving_slo(cfg: ModelConfig, params: Params,
     every request is submitted against it — the prefix-cache-on
     configuration. ``ttft_slo_ticks`` defines goodput: the fraction of
     requests whose tick-TTFT meets the bound (and their token share).
+
+    ``reporter``: optional in-band goodput emitter
+    (``measure.GoodputReporter``) — measured tokens/s and the window's
+    p50 TTFT flow to the scheduler's runtime-telemetry plane, the live
+    signal ROADMAP item 5's elastic serving gangs autoscale against.
     """
     import time as _time
     time_fn = time_fn or _time.perf_counter
@@ -1189,4 +1207,11 @@ def measure_serving_slo(cfg: ModelConfig, params: Params,
         out["slo_attainment"] = len(ok) / max(len(requests), 1)
         out["goodput_tokens_per_s"] = ok_tokens / max(elapsed, 1e-9)
         out["goodput_tokens_per_tick"] = ok_tokens / max(eng.tick_count, 1)
+    if reporter is not None:
+        # per-tick scale for the same reason as measure_serving above
+        ticks = max(eng.tick_count, 1)
+        reporter.observe_step(int(eng.tick_count), elapsed / ticks,
+                              items=float(total_tokens) / ticks)
+        reporter.observe_ttft(out["ttft_s_p50"])
+        reporter.flush()
     return out

@@ -40,6 +40,8 @@ from .profiler import (HotPathProfiler, profiling_enabled,
                        set_profiling_enabled)
 from .throughput import ThroughputTelemetry
 from .fleetrace import FleetTraceRecorder
+from .goodput import (GoodputAggregator, GoodputMatrix, load_matrix,
+                      matrix_from_trace, workload_fingerprint_of)
 from . import reasons  # noqa: F401  (re-export)
 
 __all__ = [
@@ -53,12 +55,16 @@ __all__ = [
     "default_profiler", "install_profiler", "ensure_profiler",
     "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace",
     "observe_gang_bound",
+    "GoodputAggregator", "GoodputMatrix", "load_matrix", "matrix_from_trace",
+    "workload_fingerprint_of",
+    "default_goodput", "install_goodput", "ensure_goodput",
 ]
 
 _engine = DiagnosisEngine()
 _slo = SLOTracker()
 _profiler = HotPathProfiler()
 _fleet = FleetTraceRecorder()
+_goodput = GoodputAggregator()
 
 
 def default_engine() -> DiagnosisEngine:
@@ -134,6 +140,35 @@ def install_fleetrecorder(rec: FleetTraceRecorder) -> FleetTraceRecorder:
         _fleet.detach()
     _fleet = rec
     return rec
+
+
+def default_goodput() -> GoodputAggregator:
+    return _goodput
+
+
+def install_goodput(agg: GoodputAggregator) -> GoodputAggregator:
+    """Swap the process-global goodput aggregator (bench/test isolation).
+    The replaced aggregator is detached from its API server's status
+    fan-out: two attached aggregators would double-count every report,
+    and the stale one's per-gang gauge children would fight the fresh
+    one's over the shared metric families."""
+    global _goodput
+    if _goodput is not agg:
+        _goodput.detach()
+    _goodput = agg
+    return agg
+
+
+def ensure_goodput(api) -> GoodputAggregator:
+    """Arm the process-global goodput aggregator against ``api``'s
+    in-band status-report fan-out, idempotently — live schedulers call
+    this at construction so heartbeat-piggybacked ``GangMemberStatus``
+    reports flow the moment the first gang binds.  Shadow schedulers hold
+    a private ``GoodputAggregator(publish=False)`` and must never reach
+    this accessor (shadow-isolation lint rule): a what-if trial's
+    synthetic members must not publish as fleet runtime telemetry."""
+    _goodput.attach(api)
+    return _goodput
 
 
 def ensure_fleetrace(api) -> FleetTraceRecorder:

@@ -388,6 +388,7 @@ class FleetTraceRecorder:
         self._writer: Optional[_SegmentWriter] = None
         self._api: Optional[srv.APIServer] = None
         self._handlers: List[Tuple[str, Callable]] = []
+        self._status_sink: Optional[Callable] = None
         self._events_by_kind: Dict[str, int] = {}
         self._started_wall = 0.0
         self._started_mono = 0.0
@@ -450,6 +451,13 @@ class FleetTraceRecorder:
                 api.add_watch(kind, handler, replay=False)
                 handlers.append((kind, handler))
             self._handlers = handlers
+            # in-band gang runtime status reports (ISSUE 10): captured as
+            # goodput-report events so a recorded trace carries the
+            # workload×generation throughput matrix (goodput.
+            # matrix_from_trace rebuilds it offline) for replay/policy
+            # evaluation — same sink fan-out the goodput aggregator rides
+            self._status_sink = self._on_status_reports
+            api.add_status_sink(self._status_sink)
             writer.append(_SNAPSHOT_SENTINEL, mono, wall, None, None, None)
         self._drain_writer(old)
         klog.info_s("fleet trace capture armed", directory=directory)
@@ -471,6 +479,11 @@ class FleetTraceRecorder:
             # watch handlers and must deregister the same way
             self._api.remove_watch(kind, handler)
         self._handlers = []
+        if self._status_sink is not None:
+            # tpulint: disable=naked-api-calls — sink deregistration is
+            # the same watch-boundary contract as remove_watch above
+            self._api.remove_status_sink(self._status_sink)
+            self._status_sink = None
         self._api = None
         return writer
 
@@ -523,6 +536,25 @@ class FleetTraceRecorder:
                                "scheduler": scheduler, "gang": gang or "",
                                "e2e_s": round(e2e_s, 6),
                                "attempts": attempts})
+
+    def _on_status_reports(self, reports) -> None:
+        """In-band ``GangMemberStatus`` fan-out (``APIServer.
+        report_status``): one ``goodput-report`` event per report.  The
+        report's own wall timestamp rides in the payload (the emitter's
+        window end); the record's ``wall``/``mono`` stamps are capture
+        time, like every other event."""
+        for r in reports:
+            try:
+                self._enqueue("goodput-report", payload={
+                    "pod": r.pod_key, "gang": r.gang, "step": r.step,
+                    "step_time_s": round(r.step_time_s, 6),
+                    "throughput": round(r.throughput, 3), "unit": r.unit,
+                    "ttft_s": round(r.ttft_s, 6),
+                    "stall_s": round(r.stall_s, 6),
+                    "reported_wall": r.timestamp})
+            except Exception as e:  # a malformed report must not kill the
+                # heartbeat path that carried it
+                klog.error_s(e, "fleetrace goodput-report capture failed")
 
     # -- watch boundary --------------------------------------------------------
 

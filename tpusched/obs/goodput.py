@@ -1,0 +1,872 @@
+"""Gang runtime goodput telemetry: the observability plane for gangs AFTER
+they bind.
+
+Every layer so far (flight recorder, why-pending, profiler, fleet trace)
+watches the *scheduler*; the moment a gang binds the system goes blind —
+yet realized JCT on a TPU fleet is dominated by what happens next:
+stragglers, slice-generation throughput spread, checkpoint/restore stalls
+(the TPU-fleet retrospective's core lesson, PAPERS.md #2).  This module
+aggregates the in-band ``GangMemberStatus`` reports gang members piggyback
+on the node heartbeat (``api/core.GangMemberStatus``,
+``APIServer.report_status``) into:
+
+- **per-gang runtime health** — rolling goodput (unit/s and per-chip),
+  per-member step skew, and straggler detection (member p99 step-time vs
+  the gang's median-of-medians, with hysteresis so a single slow step
+  cannot flap the verdict).  Detections are pinned as ``gang_straggler``
+  flight-recorder anomalies and served through ``/debug/goodput`` and the
+  ``/debug/explain`` gang view, so "my gang is slow" is as diagnosable as
+  "my pod is pending";
+- **the workload × slice-type throughput matrix** — EWMA goodput-per-chip
+  keyed by workload fingerprint × pool generation (the measured matrix
+  ROADMAP item 3's Gavel-style Score plugin and ``sim/whatif.py``
+  consume), exportable as a schema-versioned JSON artifact with a ``peek``
+  API and reconstructible offline from a recorded fleet trace
+  (``matrix_from_trace`` — fleetrace captures every report as a
+  ``goodput-report`` event).
+
+Bounded like every other obs surface: entry + byte budgets on gangs,
+members and matrix cells; over budget the aggregator SHEDS (counted,
+``tpusched_goodput_reports_shed_total``) instead of growing; resolved
+(deleted) members are evicted immediately.  Ingest is O(members of the
+reporting gang) under one lock; the happy path for a solo report is a few
+dict operations.
+
+Shadow isolation: live schedulers attach the process-global aggregator to
+their API server via ``obs.ensure_goodput``; shadow schedulers construct a
+private ``GoodputAggregator(publish=False)`` — inert metrics, no anomaly
+pinning — so a what-if trial can never publish hypothetical runtime
+telemetry (the shadow-isolation lint rule pins the accessor set).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.resources import TPU
+from ..util import klog
+from ..util.locking import GuardedLock, guarded_by
+from ..util.metrics import (gang_goodput_per_chip, gang_goodput_units,
+                            gang_step_skew, gang_straggler_events,
+                            gang_stragglers, goodput_reports_shed,
+                            goodput_reports_total, workload_goodput_per_chip)
+
+__all__ = [
+    "MATRIX_SCHEMA_VERSION", "LABEL_WORKLOAD", "GoodputAggregator",
+    "GoodputMatrix", "load_matrix", "matrix_from_trace",
+    "workload_fingerprint_of",
+]
+
+MATRIX_SCHEMA_VERSION = 1
+
+# Pod/PodGroup label naming the workload class for the throughput matrix.
+# Absent the label, the fingerprint is derived from the gang's shape — two
+# jobs asking the same slice geometry are the same scheduling problem, and
+# a coarse fingerprint that groups them beats an unbounded per-job key.
+LABEL_WORKLOAD = "tpu.dev/workload"
+
+DEFAULT_MAX_GANGS = 256
+DEFAULT_MAX_MEMBERS = 4096
+DEFAULT_MAX_BYTES = 1 << 20          # ~1 MiB of runtime-health state
+DEFAULT_MAX_MATRIX_CELLS = 512
+MEMBER_WINDOW = 32                   # rolling step-time samples per member
+EWMA_ALPHA = 0.25                    # matrix cell smoothing
+
+# Straggler hysteresis: ENTER when the member's rolling p99 step time
+# exceeds enter_ratio × the gang's median-of-member-medians; CLEAR only
+# when it falls back under clear_ratio × the median (or the member is torn
+# down). The gap between the two ratios is what keeps one noisy step from
+# flapping the verdict.
+STRAGGLER_ENTER_RATIO = 1.5
+STRAGGLER_CLEAR_RATIO = 1.2
+STRAGGLER_MIN_REPORTS = 4            # per member, before it can be judged
+STRAGGLER_MIN_MEMBERS = 2            # a gang of one has no skew
+
+_MEMBER_BASE_BYTES = 160 + 8 * MEMBER_WINDOW
+_GANG_BASE_BYTES = 128
+
+
+def _median(xs: List[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _p99(sorted_xs: List[float]) -> float:
+    if not sorted_xs:
+        return 0.0
+    return sorted_xs[min(len(sorted_xs) - 1,
+                         max(0, round(0.99 * (len(sorted_xs) - 1))))]
+
+
+def workload_fingerprint_of(pod, pg=None) -> str:
+    """The matrix's workload key for a pod: the ``tpu.dev/workload`` label
+    when the job names itself (pod label wins, then its PodGroup's), else
+    a shape-derived class — gangs asking the same slice geometry pose the
+    same throughput question, and a bounded fingerprint space is what
+    keeps the matrix a matrix instead of a per-job log."""
+    name = pod.meta.labels.get(LABEL_WORKLOAD, "")
+    if not name and pg is not None:
+        name = pg.meta.labels.get(LABEL_WORKLOAD, "")
+    if name:
+        return name
+    shape = ""
+    if pg is not None and getattr(pg.spec, "tpu_slice_shape", ""):
+        shape = pg.spec.tpu_slice_shape
+    return f"{shape or 'any'}/{pod_chips(pod)}chip"
+
+
+# -- the persistent matrix artifact -------------------------------------------
+
+@dataclasses.dataclass
+class _MatrixCell:
+    goodput_per_chip: float = 0.0    # EWMA, unit/s/chip
+    unit: str = "tokens"
+    reports: int = 0
+    updated_wall: float = 0.0
+
+    def fold(self, per_chip: float, unit: str, wall: float,
+             alpha: float = EWMA_ALPHA) -> None:
+        if self.reports == 0:
+            self.goodput_per_chip = per_chip
+        else:
+            self.goodput_per_chip = (alpha * per_chip
+                                     + (1 - alpha) * self.goodput_per_chip)
+        self.unit = unit
+        self.reports += 1
+        self.updated_wall = wall
+
+
+@dataclasses.dataclass
+class GoodputMatrix:
+    """The workload × pool-generation throughput matrix: measured EWMA
+    goodput-per-chip per (workload fingerprint, generation) cell.  This is
+    the persistent artifact ROADMAP item 3's goodput-aware Score plugin
+    and ``sim/whatif.py`` consume — schema-versioned JSON so a snapshot
+    survives process restarts and rides in recorded fleet traces."""
+    schema_version: int = MATRIX_SCHEMA_VERSION
+    generated_wall: float = 0.0
+    # workload → generation → cell
+    cells: Dict[str, Dict[str, _MatrixCell]] = dataclasses.field(
+        default_factory=dict)
+
+    def peek(self, workload: str, generation: str) -> Optional[float]:
+        """Measured goodput-per-chip for a cell, or None when unmeasured —
+        callers (the what-if planner, a Score plugin) must treat None as
+        "no data", never as zero throughput."""
+        cell = self.cells.get(workload, {}).get(generation)
+        return cell.goodput_per_chip if cell is not None else None
+
+    def cell(self, workload: str, generation: str) -> Optional[_MatrixCell]:
+        return self.cells.get(workload, {}).get(generation)
+
+    def fold(self, workload: str, generation: str, per_chip: float,
+             unit: str, wall: float) -> None:
+        row = self.cells.setdefault(workload, {})
+        cell = row.get(generation)
+        if cell is None:
+            cell = row[generation] = _MatrixCell()
+        cell.fold(per_chip, unit, wall)
+
+    def size(self) -> int:
+        return sum(len(row) for row in self.cells.values())
+
+    def best_generation(self, workload: str) -> Optional[str]:
+        """The generation with the highest measured goodput-per-chip for a
+        workload (the Gavel placement question), or None when unmeasured."""
+        row = self.cells.get(workload)
+        if not row:
+            return None
+        return max(row, key=lambda g: row[g].goodput_per_chip)
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": self.schema_version,
+            "generated_wall": self.generated_wall,
+            "cells": {w: {g: dataclasses.asdict(c) for g, c in row.items()}
+                      for w, row in self.cells.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "GoodputMatrix":
+        version = doc.get("schema_version")
+        if version != MATRIX_SCHEMA_VERSION:
+            raise ValueError(
+                f"goodput matrix schema_version {version!r} unsupported "
+                f"(want {MATRIX_SCHEMA_VERSION})")
+        cells_in = doc.get("cells")
+        if not isinstance(cells_in, dict):
+            raise ValueError("goodput matrix: 'cells' missing or not an "
+                             "object")
+        cells: Dict[str, Dict[str, _MatrixCell]] = {}
+        for w, row in cells_in.items():
+            if not isinstance(row, dict):
+                raise ValueError(f"goodput matrix: workload {w!r} row is "
+                                 "not an object")
+            out_row: Dict[str, _MatrixCell] = {}
+            for g, c in row.items():
+                try:
+                    out_row[g] = _MatrixCell(
+                        goodput_per_chip=float(c["goodput_per_chip"]),
+                        unit=str(c.get("unit", "tokens")),
+                        reports=int(c.get("reports", 0)),
+                        updated_wall=float(c.get("updated_wall", 0.0)))
+                except (KeyError, TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"goodput matrix: malformed cell {w!r}×{g!r}: {e}")
+            cells[w] = out_row
+        return cls(schema_version=version,
+                   generated_wall=float(doc.get("generated_wall", 0.0)),
+                   cells=cells)
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def summary(self) -> dict:
+        return {
+            "schema_version": self.schema_version,
+            "workloads": len(self.cells),
+            "cells": self.size(),
+            "rows": {w: {g: {"goodput_per_chip":
+                             round(c.goodput_per_chip, 4),
+                             "unit": c.unit, "reports": c.reports}
+                         for g, c in row.items()}
+                     for w, row in self.cells.items()},
+        }
+
+
+def load_matrix(path: str) -> GoodputMatrix:
+    with open(path, encoding="utf-8") as f:
+        return GoodputMatrix.from_dict(json.load(f))
+
+
+# -- aggregator state ----------------------------------------------------------
+
+class _Member:
+    __slots__ = ("node", "workload", "generation", "chips", "unit",
+                 "steps", "last_step", "throughput", "ttft_s", "stall_s",
+                 "reports", "median", "p99", "straggler", "last_wall")
+
+    def __init__(self, node: str, workload: str, generation: str,
+                 chips: int):
+        self.node = node
+        self.workload = workload
+        self.generation = generation
+        self.chips = chips
+        self.unit = "tokens"
+        self.steps: "collections.deque[float]" = collections.deque(
+            maxlen=MEMBER_WINDOW)
+        self.last_step = 0
+        self.throughput = 0.0
+        self.ttft_s = 0.0
+        self.stall_s = 0.0
+        self.reports = 0
+        self.median = 0.0        # rolling median step time (cached)
+        self.p99 = 0.0           # rolling p99 step time (cached)
+        self.straggler = False
+        self.last_wall = 0.0
+
+    def fold(self, r) -> None:
+        if r.step_time_s > 0:
+            self.steps.append(r.step_time_s)
+            xs = sorted(self.steps)
+            self.median = _median(xs)
+            self.p99 = _p99(xs)
+        self.last_step = max(self.last_step, r.step)
+        self.throughput = r.throughput
+        self.unit = r.unit or self.unit
+        if r.ttft_s > 0:
+            self.ttft_s = r.ttft_s
+        self.stall_s += max(0.0, r.stall_s)
+        self.reports += 1
+        self.last_wall = r.timestamp
+
+    def to_dict(self, pod_key: str) -> dict:
+        return {
+            "pod": pod_key, "node": self.node,
+            "generation": self.generation, "chips": self.chips,
+            "step": self.last_step,
+            "step_time_p50_s": round(self.median, 4),
+            "step_time_p99_s": round(self.p99, 4),
+            "throughput": round(self.throughput, 3),
+            "unit": self.unit,
+            "ttft_s": round(self.ttft_s, 4),
+            "stall_s": round(self.stall_s, 3),
+            "reports": self.reports,
+            "straggler": self.straggler,
+        }
+
+
+class _Gang:
+    __slots__ = ("members", "workload", "units", "stragglers", "skew",
+                 "last_wall", "bytes")
+
+    def __init__(self, workload: str):
+        self.members: Dict[str, _Member] = {}
+        self.workload = workload
+        self.units: set = set()          # metric children to remove on drop
+        self.stragglers = 0
+        self.skew = 1.0
+        self.last_wall = 0.0
+        self.bytes = _GANG_BASE_BYTES
+
+
+@guarded_by("_lock", "_gangs", "_solo", "_pod_to_gang", "_members",
+            "_bytes", "_matrix", "_accepted", "_shed", "_straggler_edges",
+            "_evictions", "_reporters")
+class GoodputAggregator:
+    """The runtime-telemetry back end: member registration from the
+    scheduler's bind path, report ingest from the apiserver's status
+    fan-out, straggler diagnosis + matrix folding on the way through."""
+
+    def __init__(self, max_gangs: int = DEFAULT_MAX_GANGS,
+                 max_members: int = DEFAULT_MAX_MEMBERS,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 max_matrix_cells: int = DEFAULT_MAX_MATRIX_CELLS,
+                 enter_ratio: float = STRAGGLER_ENTER_RATIO,
+                 clear_ratio: float = STRAGGLER_CLEAR_RATIO,
+                 min_reports: int = STRAGGLER_MIN_REPORTS,
+                 publish: bool = True, clock=time.time):
+        """``publish=False`` builds the SHADOW shell: observations
+        accumulate for ``dump()`` but no process-global metric family is
+        touched and no anomaly is pinned — a what-if trial's synthetic
+        members must never read as fleet runtime telemetry."""
+        self.max_gangs = max_gangs
+        self.max_members = max_members
+        self.max_bytes = max_bytes
+        self.max_matrix_cells = max_matrix_cells
+        self.enter_ratio = enter_ratio
+        self.clear_ratio = clear_ratio
+        self.min_reports = min_reports
+        self._publish = publish
+        self._clock = clock
+        self._lock = GuardedLock("obs.GoodputAggregator", reentrant=False)
+        # gang full-name → _Gang, LRU order (most-recent report last)
+        self._gangs: "collections.OrderedDict[str, _Gang]" = \
+            collections.OrderedDict()
+        self._solo = _Gang("")           # gangless members, never evicted
+        self._pod_to_gang: Dict[str, str] = {}
+        self._members = 0
+        self._bytes = 0
+        self._matrix = GoodputMatrix()
+        self._accepted = 0
+        self._shed = 0
+        self._evictions = 0
+        self._reporters = 0      # distinct members ever heard from
+        self._straggler_edges = 0
+        self._api = None
+
+    # -- lifecycle (apiserver attachment) -------------------------------------
+
+    def attach(self, api) -> None:
+        """Arm ingest against ``api``'s status-report fan-out. Idempotent;
+        re-attaching elsewhere detaches first."""
+        if self._api is api:
+            return
+        self.detach()
+        api.add_status_sink(self.ingest)
+        self._api = api
+
+    def detach(self) -> None:
+        if self._api is not None:
+            # tpulint: disable=naked-api-calls — the aggregator IS a
+            # status-fan-out component (informer-sibling): it registers a
+            # raw report sink and must deregister the same way
+            self._api.remove_status_sink(self.ingest)
+            self._api = None
+
+    @property
+    def attached(self) -> bool:
+        return self._api is not None
+
+    # -- registration (scheduler bind path) -----------------------------------
+
+    def register_member(self, pod_key: str, gang: Optional[str], node: str,
+                        workload: str = "", generation: str = "",
+                        chips: int = 0) -> None:
+        """Bind→running registration, fed from the scheduler's bind commit:
+        names the member's node, pool generation and chip count so later
+        reports can be folded into the per-chip matrix without another
+        lookup.  Sheds (counted) at the member/byte budgets; at the gang
+        budget the LRU gang is evicted (counted) instead."""
+        with self._lock:
+            # member budget FIRST when the gang doesn't exist yet: a
+            # registration that would be shed anyway must not create an
+            # empty gang shell (or LRU-evict a live gang to make room)
+            g = self._gang_locked(gang, workload, create=False)
+            if g is None and self._members >= self.max_members:
+                self._shed += 1
+                if self._publish:
+                    goodput_reports_shed.inc()
+                return
+            if g is None:
+                g = self._gang_locked(gang, workload, create=True)
+            if pod_key not in g.members:
+                if self._members >= self.max_members:
+                    self._shed += 1
+                    if self._publish:
+                        goodput_reports_shed.inc()
+                    return
+                g.members[pod_key] = _Member(node, workload or g.workload,
+                                             generation, max(0, chips))
+                g.bytes += _MEMBER_BASE_BYTES
+                self._bytes += _MEMBER_BASE_BYTES
+                self._members += 1
+                self._pod_to_gang[pod_key] = gang or ""
+            else:
+                m = g.members[pod_key]
+                m.node, m.generation = node, generation or m.generation
+                if chips:
+                    m.chips = chips
+                if workload:
+                    m.workload = workload
+            if workload and not g.workload:
+                g.workload = workload
+            self._trim_locked()
+
+    def on_pod_delete(self, pod_key: str) -> None:
+        """Teardown clears the member — including any standing straggler
+        verdict (the hysteresis exit every straggler eventually takes:
+        slow hardware gets drained, not argued with)."""
+        edges: List[Tuple[str, float]] = []
+        with self._lock:
+            gang_name = self._pod_to_gang.pop(pod_key, None)
+            if gang_name is None:
+                return
+            g = self._solo if not gang_name else self._gangs.get(gang_name)
+            if g is None:
+                return
+            m = g.members.pop(pod_key, None)
+            if m is None:
+                return
+            self._members -= 1
+            g.bytes -= _MEMBER_BASE_BYTES
+            self._bytes -= _MEMBER_BASE_BYTES
+            if m.straggler:
+                g.stragglers -= 1
+            if not g.members and gang_name:
+                self._drop_gang_locked(gang_name, g)
+            elif gang_name:
+                # a deletion can shift the gang median enough to cross a
+                # survivor over the enter threshold — those ENTER edges
+                # pin anomalies exactly like ingest-triggered ones
+                edges = self._reevaluate_locked(gang_name, g)
+        if self._publish:
+            for surviving_pod, skew in edges:
+                self._pin_straggler(gang_name, surviving_pod, skew)
+
+    # -- ingest (apiserver status fan-out) ------------------------------------
+
+    def ingest(self, reports) -> None:
+        """Fold a batch of ``GangMemberStatus`` reports. Reports for
+        unregistered members are REGISTERED on the fly (synthetic emitters
+        and out-of-order heartbeats must not be lost) with unknown
+        node/generation until the scheduler's registration fills them in;
+        budgets shed as usual.
+
+        Batched on purpose: ONE lock round trip, and straggler
+        re-evaluation + gauge publication run once per TOUCHED GANG per
+        batch instead of once per report — a 32-member gang's heartbeat
+        batch costs one re-evaluation, not 32 (this is the storm-bench
+        ingest overhead budget, ``make goodput-smoke``)."""
+        accepted = 0
+        shed = 0
+        edge_pins: List[Tuple[str, str, float]] = []
+        with self._lock:
+            touched: Dict[str, _Gang] = {}
+            for r in reports:
+                gang_name = r.gang or ""
+                # as in register_member: don't create (or evict for) a
+                # gang whose only member would be shed at the budget
+                g = self._gang_locked(r.gang, "", create=False)
+                if g is None and self._members >= self.max_members:
+                    shed += 1
+                    continue
+                if g is None:
+                    g = self._gang_locked(r.gang, "", create=True)
+                m = g.members.get(r.pod_key)
+                if m is None:
+                    if self._members >= self.max_members:
+                        shed += 1
+                        continue
+                    m = g.members[r.pod_key] = _Member("", g.workload, "", 0)
+                    g.bytes += _MEMBER_BASE_BYTES
+                    self._bytes += _MEMBER_BASE_BYTES
+                    self._members += 1
+                    self._pod_to_gang[r.pod_key] = gang_name
+                if m.reports == 0:
+                    self._reporters += 1
+                m.fold(r)
+                g.last_wall = r.timestamp
+                accepted += 1
+                if gang_name:
+                    self._gangs.move_to_end(gang_name)
+                    touched[gang_name] = g
+                self._fold_matrix_locked(m, r)
+            for gang_name, g in touched.items():
+                # skip gangs LRU-evicted later in this same batch:
+                # re-evaluating would re-create their gauge children
+                # with nothing left to remove them
+                if self._gangs.get(gang_name) is not g:
+                    continue
+                for pod_key, skew in self._reevaluate_locked(gang_name, g):
+                    edge_pins.append((gang_name, pod_key, skew))
+            self._accepted += accepted
+            self._shed += shed
+            self._trim_locked()
+        if self._publish:
+            if accepted:
+                goodput_reports_total.inc(accepted)
+            if shed:
+                goodput_reports_shed.inc(shed)
+            for gang_name, pod_key, skew in edge_pins:
+                self._pin_straggler(gang_name, pod_key, skew)
+
+    # -- internals -------------------------------------------------------------
+
+    def _gang_locked(self, gang: Optional[str], workload: str,
+                     create: bool) -> Optional[_Gang]:
+        if not gang:
+            return self._solo
+        g = self._gangs.get(gang)
+        if g is None and create:
+            if len(self._gangs) >= self.max_gangs:
+                # evict the LRU gang to admit the new one — the newest
+                # reporter is the one an operator is likely debugging
+                old_name, old = self._gangs.popitem(last=False)
+                self._drop_gang_locked(old_name, old, popped=True)
+            g = self._gangs[gang] = _Gang(workload)
+            self._bytes += g.bytes
+        return g
+
+    def _drop_gang_locked(self, name: str, g: _Gang,
+                          popped: bool = False) -> None:
+        if not popped:
+            self._gangs.pop(name, None)
+        else:
+            self._evictions += 1          # budget eviction, not teardown
+        for pod_key in g.members:
+            self._pod_to_gang.pop(pod_key, None)
+        self._members -= len(g.members)
+        self._bytes -= g.bytes
+        if self._publish:
+            # a torn-down/evicted gang must stop being exposed, not freeze
+            # at its last values — same discipline as install_slo
+            for unit in g.units:
+                gang_goodput_units.remove(name, unit)
+                gang_goodput_per_chip.remove(name, unit)
+            gang_step_skew.remove(name)
+            gang_stragglers.remove(name)
+            gang_straggler_events.remove(name)
+
+    def _trim_locked(self) -> None:
+        while len(self._gangs) > self.max_gangs:
+            name, g = self._gangs.popitem(last=False)
+            self._drop_gang_locked(name, g, popped=True)
+        # byte budget: evict from whichever side holds the bulk — a flood
+        # of gangless reporters must not permanently evict every gang
+        # (the gang plane is the point), nor vice versa
+        while self._bytes > self.max_bytes and (self._gangs
+                                                or self._solo.members):
+            if self._solo.members and (not self._gangs
+                                       or self._solo.bytes
+                                       > self.max_bytes // 2):
+                pod_key = next(iter(self._solo.members))  # oldest first
+                del self._solo.members[pod_key]
+                self._pod_to_gang.pop(pod_key, None)
+                self._members -= 1
+                self._solo.bytes -= _MEMBER_BASE_BYTES
+                self._bytes -= _MEMBER_BASE_BYTES
+                self._evictions += 1
+            else:
+                name, g = self._gangs.popitem(last=False)
+                self._drop_gang_locked(name, g, popped=True)
+
+    def _fold_matrix_locked(self, m: _Member, r) -> None:
+        if r.throughput <= 0 or m.chips <= 0 or not m.generation:
+            return     # unattributable: no chips or unknown generation
+        workload = m.workload or "unlabeled"
+        if (self._matrix.cell(workload, m.generation) is None
+                and self._matrix.size() >= self.max_matrix_cells):
+            return     # bounded: new cells shed once the matrix is full
+            # (cell-exists first: the common case skips the row scan)
+        per_chip = r.throughput / m.chips
+        self._matrix.fold(workload, m.generation, per_chip, m.unit,
+                          r.timestamp)
+        self._matrix.generated_wall = r.timestamp
+        if self._publish:
+            cell = self._matrix.cell(workload, m.generation)
+            workload_goodput_per_chip.with_labels(
+                workload, m.generation).set(round(cell.goodput_per_chip, 4))
+
+    def _reevaluate_locked(self, gang_name: str, g: _Gang
+                           ) -> List[Tuple[str, float]]:
+        """Recompute gang skew + straggler verdicts after a report. Returns
+        the ENTER edges (pod, skew) so the caller can pin anomalies outside
+        the lock."""
+        judged = {k: m for k, m in g.members.items()
+                  if m.reports >= self.min_reports and m.median > 0}
+        edges: List[Tuple[str, float]] = []
+        gang_median = (_median([m.median for m in judged.values()])
+                       if len(judged) >= STRAGGLER_MIN_MEMBERS else 0.0)
+        stragglers = 0
+        if gang_median <= 0:
+            # too few judgeable members: a gang of one has no skew — and
+            # no standing verdicts either (a straggler whose last peer
+            # left must clear, not freeze), so fall through and republish
+            g.skew = 1.0
+            for m in g.members.values():
+                m.straggler = False
+        else:
+            worst = max(m.p99 for m in judged.values())
+            g.skew = worst / gang_median
+            for pod_key, m in judged.items():
+                ratio = m.p99 / gang_median
+                if not m.straggler and ratio > self.enter_ratio:
+                    m.straggler = True
+                    self._straggler_edges += 1
+                    edges.append((pod_key, ratio))
+                elif m.straggler and ratio < self.clear_ratio:
+                    m.straggler = False
+                if m.straggler:
+                    stragglers += 1
+        g.stragglers = stragglers
+        if self._publish:
+            throughput: Dict[str, float] = {}
+            per_chip_num: Dict[str, float] = {}
+            chips = 0
+            for m in g.members.values():
+                throughput[m.unit] = throughput.get(m.unit, 0.0) \
+                    + m.throughput
+                chips += m.chips
+            g.units |= set(throughput)
+            for unit, total in throughput.items():
+                gang_goodput_units.with_labels(gang_name, unit).set(
+                    round(total, 3))
+                if chips > 0:
+                    gang_goodput_per_chip.with_labels(gang_name, unit).set(
+                        round(total / chips, 4))
+            gang_step_skew.with_labels(gang_name).set(round(g.skew, 4))
+            gang_stragglers.with_labels(gang_name).set(stragglers)
+            for _ in edges:
+                gang_straggler_events.with_labels(gang_name).inc()
+        return edges
+
+    def _pin_straggler(self, gang_name: str, pod_key: str,
+                       skew: float) -> None:
+        """ENTER edge: pin the detection as a flight-recorder anomaly so
+        the standard anomaly surfaces (/debug/flightrecorder, the anomaly
+        counter) carry it — fully attributed: gang, member, skew."""
+        from .. import trace
+        m = None
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            if g is not None:
+                m = g.members.get(pod_key)
+        trace.pin_event("gang_straggler", subject=pod_key, gang=gang_name,
+                        member=pod_key, node=m.node if m else "",
+                        skew=round(skew, 3),
+                        step_time_p99_s=round(m.p99, 4) if m else 0.0)
+        klog.warning_s("gang straggler detected", gang=gang_name,
+                       member=pod_key, skew=round(skew, 3))
+
+    # -- read path (/debug/goodput, /debug/explain, whatif, bench) ------------
+
+    def gang_health(self, query: str) -> Optional[Dict[str, Any]]:
+        """Runtime health for one gang (full name or unique substring), or
+        None when the gang has never reported — the RUNNING-phase answer
+        the explain surface falls back to when no pending diagnosis
+        exists."""
+        with self._lock:
+            full = query if query in self._gangs else None
+            if full is None:
+                hits = [gname for gname in self._gangs if query in gname]
+                full = hits[0] if len(hits) == 1 else None
+            if full is None:
+                return None
+            return self._gang_health_locked(full, self._gangs[full])
+
+    def _gang_health_locked(self, name: str, g: _Gang) -> Dict[str, Any]:
+        members = [m.to_dict(k) for k, m in sorted(g.members.items())]
+        throughput: Dict[str, float] = {}
+        chips = 0
+        for m in g.members.values():
+            throughput[m.unit] = throughput.get(m.unit, 0.0) + m.throughput
+            chips += m.chips
+        medians = [m.median for m in g.members.values() if m.median > 0]
+        gang_median = _median(medians)
+        stragglers = [
+            {"pod": k, "node": m.node,
+             "skew": round(m.p99 / gang_median, 3) if gang_median else 0.0,
+             "step_time_p99_s": round(m.p99, 4),
+             "gang_step_time_p50_s": round(gang_median, 4)}
+            for k, m in sorted(g.members.items()) if m.straggler]
+        return {
+            "gang": name,
+            "phase": "Running",
+            "workload": g.workload,
+            "members": members,
+            "members_reporting": len(g.members),
+            "chips": chips,
+            "goodput": {u: round(v, 3) for u, v in throughput.items()},
+            "goodput_per_chip": {u: round(v / chips, 4)
+                                 for u, v in throughput.items()
+                                 if chips > 0},
+            "step_time_p50_s": round(gang_median, 4),
+            "step_skew": round(g.skew, 4),
+            "stragglers": stragglers,
+            "last_report_wall": g.last_wall,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "gangs": len(self._gangs),
+                "members": self._members,
+                "solo_members": len(self._solo.members),
+                "approx_bytes": self._bytes,
+                "max_gangs": self.max_gangs,
+                "max_members": self.max_members,
+                "max_bytes": self.max_bytes,
+                "accepted_total": self._accepted,
+                "shed_total": self._shed,
+                "gang_evictions_total": self._evictions,
+                "reporters_total": self._reporters,
+                "straggler_edges_total": self._straggler_edges,
+                "matrix_cells": self._matrix.size(),
+                "attached": self._api is not None,
+            }
+
+    def dump(self) -> Dict[str, Any]:
+        """The /debug/goodput payload: stats + the live fleet census +
+        per-gang runtime health + the matrix summary, one document."""
+        with self._lock:
+            gangs = [self._gang_health_locked(name, g)
+                     for name, g in list(self._gangs.items())[-64:]]
+            matrix = self._matrix.summary()
+        return {"stats": self.stats(), "fleet": self.fleet_summary(),
+                "gangs": gangs, "matrix": matrix}
+
+    def fleet_summary(self) -> Dict[str, Any]:
+        """The LIVE fleet census (rides in ``dump()``/``/debug/goodput``):
+        total reported throughput by unit, mean per-chip goodput and the
+        straggler count over currently-live members.  A census of what is
+        reporting right now — for cumulative whole-run accounting (the
+        bench stamp) use ``stats()``, whose counters survive teardown."""
+        with self._lock:
+            throughput: Dict[str, float] = {}
+            per_chip: List[float] = []
+            stragglers = 0
+            all_gangs = list(self._gangs.values()) + [self._solo]
+            for g in all_gangs:
+                stragglers += g.stragglers
+                for m in g.members.values():
+                    throughput[m.unit] = throughput.get(m.unit, 0.0) \
+                        + m.throughput
+                    if m.chips > 0 and m.throughput > 0:
+                        per_chip.append(m.throughput / m.chips)
+            return {
+                "units_per_s": {u: round(v, 3)
+                                for u, v in throughput.items()},
+                "goodput_per_chip_mean": round(
+                    sum(per_chip) / len(per_chip), 4) if per_chip else 0.0,
+                "reporting_members": len(per_chip),
+                "stragglers": stragglers,
+                "reports": self._accepted,
+                "shed": self._shed,
+            }
+
+    def matrix_snapshot(self) -> GoodputMatrix:
+        """A deep snapshot of the current matrix (safe to mutate/save)."""
+        with self._lock:
+            return GoodputMatrix.from_dict(self._matrix.to_dict())
+
+    def peek(self, workload: str, generation: str) -> Optional[float]:
+        with self._lock:
+            return self._matrix.peek(workload, generation)
+
+    def save_matrix(self, path: str) -> None:
+        self.matrix_snapshot().save(path)
+
+
+# -- offline reconstruction from a recorded fleet trace ------------------------
+
+def matrix_from_trace(trace) -> GoodputMatrix:
+    """Rebuild the throughput matrix from a recorded fleet trace
+    (``obs.fleetrace.FleetTrace``): join each ``goodput-report`` event with
+    the trace's own record of where that pod ran (bind-commits), what
+    hardware that was (node objects → generation label), and what the pod
+    asked for (arrival specs → chips + workload fingerprint).  This is what
+    makes recorded traces carry the matrix for replay/policy evaluation —
+    no live aggregator state needed."""
+    from ..api.scheduling import pod_group_full_name
+    from ..api.topology import LABEL_ACCELERATOR
+    from ..apiserver import server as srv
+    from ..apiserver.persistence import KIND_CLASSES, decode_object
+
+    node_gen: Dict[str, str] = {}
+    for node in trace.objects.get(srv.NODES, ()):
+        node_gen[node.meta.name] = node.meta.labels.get(LABEL_ACCELERATOR,
+                                                        "")
+    pods: Dict[str, Any] = {}                   # key → decoded Pod
+    for pod in trace.objects.get(srv.PODS, ()):
+        pods[pod.meta.key] = pod
+    groups: Dict[str, Any] = {}                 # full name → PodGroup
+    for pg in trace.objects.get(srv.POD_GROUPS, ()):
+        groups[pg.meta.key] = pg
+    pod_node: Dict[str, str] = {
+        pod.meta.key: pod.spec.node_name
+        for pod in trace.objects.get(srv.PODS, ())
+        if pod.spec.node_name}
+
+    matrix = GoodputMatrix()
+    for e in trace.events:
+        kind = e.get("kind")
+        if kind in ("node-add", "node-update", "node-health") \
+                and e.get("object") is not None:
+            node = decode_object(KIND_CLASSES[srv.NODES], e["object"])
+            node_gen[node.meta.name] = node.meta.labels.get(
+                LABEL_ACCELERATOR, "")
+        elif kind == "pod-arrival" and e.get("object") is not None:
+            pod = decode_object(KIND_CLASSES[srv.PODS], e["object"])
+            pods[pod.meta.key] = pod
+        elif kind in ("podgroup-add", "podgroup-update") \
+                and e.get("object") is not None:
+            pg = decode_object(KIND_CLASSES[srv.POD_GROUPS], e["object"])
+            groups[pg.meta.key] = pg
+        elif kind == "bind-commit":
+            pod_node[e.get("pod", "")] = e.get("node", "")
+        elif kind == "goodput-report":
+            pod = pods.get(e.get("pod", ""))
+            throughput = float(e.get("throughput", 0.0))
+            chips = pod_chips(pod) if pod is not None else 0
+            generation = node_gen.get(pod_node.get(e.get("pod", ""), ""),
+                                      "")
+            if pod is None or throughput <= 0 or chips <= 0 \
+                    or not generation:
+                continue
+            # the same fingerprint the LIVE path computes: the pod joined
+            # with its PodGroup (slice shape), so offline and online
+            # matrices key identically
+            pg = groups.get(pod_group_full_name(pod) or "")
+            workload = workload_fingerprint_of(pod, pg)
+            matrix.fold(workload or "unlabeled", generation,
+                        throughput / chips, e.get("unit", "tokens"),
+                        e.get("wall", 0.0))
+            matrix.generated_wall = e.get("wall", 0.0)
+    return matrix
+
+
+def pod_chips(pod) -> int:
+    """TPU chips a pod asks for — the one chip-counting rule shared by
+    the scheduler's bind-time registration and the matrix fingerprint."""
+    return sum(int(c.limits.get(TPU, 0)) for c in pod.spec.containers)

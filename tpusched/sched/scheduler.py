@@ -486,11 +486,19 @@ class Scheduler:
         # (idempotent, disarmed when unset); shadows hold a private
         # DISARMED recorder — a what-if trial's simulated binds must never
         # be journaled as fleet reality.
+        # Gang runtime goodput telemetry (tpusched/obs/goodput.py): live
+        # schedulers arm the process-global aggregator against this API
+        # server's in-band status-report fan-out and register members at
+        # bind commit; shadows hold a private inert (publish=False,
+        # unattached) aggregator — a what-if trial's members must never
+        # publish as fleet runtime telemetry.
         if telemetry:
             obs_mod.ensure_profiler()
             self._fleet = obs_mod.ensure_fleetrace(api)
+            self._goodput = obs_mod.ensure_goodput(api)
         else:
             self._fleet = obs_mod.FleetTraceRecorder()
+            self._goodput = obs_mod.GoodputAggregator(publish=False)
         self.queue = SchedulingQueue(
             self._fw.less, cluster_event_map, clock,
             initial_backoff_s=profile.pod_initial_backoff_s,
@@ -713,6 +721,9 @@ class Scheduler:
         # a deleted pod is no longer pending-with-a-question: evict its
         # rolling diagnosis so the bounded table tracks live pods only
         self.obs_engine.on_resolved(pod.key, "deleted")
+        # ...and no longer running-with-a-step-clock: evict its runtime
+        # health entry, clearing any standing straggler verdict with it
+        self._goodput.on_pod_delete(pod.key)
         self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
         if assigned(pod):
             self.cache.remove_pod(pod)
@@ -721,6 +732,30 @@ class Scheduler:
             self.queue.delete(pod)
         # a waiting gang member deleted mid-permit must be rejected
         self._fw.reject_waiting_pod(pod.meta.uid, msg=f"pod {pod.key} deleted")
+
+    def _register_goodput_member(self, pod: Pod, gang: Optional[str],
+                                 node_name: str) -> None:
+        """Register a just-bound member with the goodput aggregator:
+        node, pool generation (the node's accelerator label) and chip
+        count, so heartbeat-piggybacked reports fold into the per-chip
+        workload×generation matrix.  Best-effort by contract — runtime
+        telemetry must never fail a bind commit."""
+        try:
+            from ..api.topology import LABEL_ACCELERATOR
+            from ..obs.goodput import pod_chips
+            # cluster-scoped key: a Node's informer key is "/<name>"
+            node = self.informer_factory.nodes().get(f"/{node_name}")
+            generation = node.meta.labels.get(LABEL_ACCELERATOR, "") \
+                if node is not None else ""
+            pg = self.informer_factory.informer(srv.POD_GROUPS).get(gang) \
+                if gang else None
+            self._goodput.register_member(
+                pod.key, gang, node_name,
+                workload=obs_mod.workload_fingerprint_of(pod, pg),
+                generation=generation, chips=pod_chips(pod))
+        except Exception as e:  # noqa: BLE001 — advisory by contract
+            klog.V(4).info_s("goodput member registration failed",
+                             pod=pod.key, err=str(e))
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -1493,6 +1528,11 @@ class Scheduler:
             pod.key, node_name, scheduler=self.profile.scheduler_name,
             gang=gang, e2e_s=max(0.0, self.clock() - cycle_start),
             attempts=getattr(info, "attempts", 0))
+        # bind→running registration for the goodput plane: name the
+        # member's node, pool generation and chip count NOW so later
+        # heartbeat-piggybacked reports fold straight into the per-chip
+        # workload×generation matrix without another lookup
+        self._register_goodput_member(pod, gang, node_name)
         # bound: the why-pending question is answered; feed the pod-e2e SLO
         # with the user-perceived interval (first enqueue → bind commit)
         self.obs_engine.on_resolved(pod.key)

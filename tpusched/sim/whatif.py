@@ -28,9 +28,11 @@ from ..api.resources import TPU, make_resources
 from ..api.scheduling import PodGroup, PodGroupSpec
 from ..api.meta import ObjectMeta
 from ..api.core import Pod
+from ..api.topology import LABEL_ACCELERATOR
 from ..apiserver import APIServer
 from ..apiserver import server as srv
 from ..config import profiles as canned
+from ..obs.goodput import GoodputMatrix, workload_fingerprint_of
 from ..plugins import default_registry
 from ..plugins.topologymatch import COORD_ANNOTATION, POOL_ANNOTATION
 from ..sched import Scheduler
@@ -55,6 +57,17 @@ class WhatIfReport:
     # displaced (simulation artifacts, never real workloads — kept separate
     # from victims so a script acting on evictions cannot confuse them)
     displaced_plan_pods: List[str] = dataclasses.field(default_factory=list)
+    # goodput annotation (set when simulate_gang is given a measured
+    # GoodputMatrix, ISSUE 10 / ROADMAP item 3): the gang's workload
+    # fingerprint, the generation(s) of the hardware it landed on, the
+    # matrix's measured goodput-per-chip for that cell (None =
+    # unmeasured — never "zero throughput"), and the generation the
+    # matrix would PREFER for this workload (the Gavel question; may
+    # differ from where topology-only scoring put it)
+    workload: str = ""
+    generation: str = ""
+    goodput_per_chip: Optional[float] = None
+    best_generation: Optional[str] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -207,6 +220,40 @@ def _make_profile(allow_preemption: bool, timeout_s: float,
                                     denied_s=1))
 
 
+def annotate_with_goodput(report: WhatIfReport, shadow: APIServer,
+                          matrix: GoodputMatrix) -> WhatIfReport:
+    """Fold the measured workload×generation throughput matrix (ISSUE
+    10's goodput plane, ``obs.goodput``) into a feasibility report: what
+    goodput-per-chip has this workload MEASURED on the hardware the
+    shadow placed it on, and which generation would the matrix prefer?
+    This is the consumption path ROADMAP item 3's Gavel-style Score
+    plugin will productionize; here it lets an operator see "fits, but
+    on the slow generation for this workload" before submitting."""
+    if not report.placements:
+        return report
+    first_key = sorted(report.placements)[0]
+    pod = shadow.peek(srv.PODS, first_key)
+    if pod is None:
+        return report
+    from ..api.scheduling import pod_group_full_name
+    pg_name = pod_group_full_name(pod)
+    pg = shadow.try_get(srv.POD_GROUPS, pg_name) if pg_name else None
+    report.workload = workload_fingerprint_of(pod, pg)
+    generations = set()
+    node_gen = {n.meta.name: n.meta.labels.get(LABEL_ACCELERATOR, "")
+                for n in shadow.list(srv.NODES)}
+    for node_name in report.placements.values():
+        gen = node_gen.get(node_name, "")
+        if gen:
+            generations.add(gen)
+    report.generation = ",".join(sorted(generations))
+    if len(generations) == 1:
+        report.goodput_per_chip = matrix.peek(report.workload,
+                                              next(iter(generations)))
+    report.best_generation = matrix.best_generation(report.workload)
+    return report
+
+
 def simulate_gang(source_api: Optional[APIServer] = None,
                   state_dir: Optional[str] = None, *,
                   name: str = "whatif-gang",
@@ -222,7 +269,9 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                   allow_preemption: bool = False,
                   timeout_s: float = 30.0,
                   config_path: Optional[str] = None,
-                  scheduler_name: Optional[str] = None) -> WhatIfReport:
+                  scheduler_name: Optional[str] = None,
+                  goodput_matrix: Optional[GoodputMatrix] = None
+                  ) -> WhatIfReport:
     """Dry-run one hypothetical gang against a shadow of the given state.
 
     ``slices > 1`` asks the set question instead: would this ATOMIC
@@ -231,6 +280,14 @@ def simulate_gang(source_api: Optional[APIServer] = None,
 
     ``config_path``/``scheduler_name`` run the shadow with a production
     TpuSchedulerConfiguration profile instead of the canned one.
+
+    ``goodput_matrix``: a measured workload×generation throughput matrix
+    (``obs.GoodputAggregator.matrix_snapshot()``, ``obs.load_matrix`` on
+    an exported artifact, or ``obs.matrix_from_trace`` on a recorded
+    fleet trace) — the report is then annotated with the measured
+    goodput-per-chip of the placement and the matrix-preferred
+    generation (``annotate_with_goodput``).
+
     Returns once the gang is fully bound in the shadow (feasible=True) or
     ``timeout_s`` elapses (feasible=False, with the scheduler's own
     FailedScheduling diagnosis as ``reason``)."""
@@ -250,6 +307,8 @@ def simulate_gang(source_api: Optional[APIServer] = None,
                              priority=priority, slices=slices,
                              timeout_s=timeout_s,
                              scheduler_name=profile.scheduler_name)
+        if goodput_matrix is not None:
+            annotate_with_goodput(report, shadow, goodput_matrix)
         return report
     finally:
         sched.stop()

@@ -419,6 +419,63 @@ class NodeHeartbeater:
                     continue
 
 
+class GoodputPump:
+    """Synthetic in-band goodput emitter for the soaks (ISSUE 10): every
+    period, each BOUND pod reports one step of progress — with one
+    member per gang running deliberately slow, so the straggler detector
+    has signal to chew on while nodes churn underneath it.  Reports ride
+    ``APIServer.report_status`` exactly like a real member's
+    ``jaxbridge.measure.GoodputReporter`` flush; members vanishing
+    mid-report (the node-kill phases) exercise the aggregator's
+    register-on-the-fly and teardown-eviction paths under fire."""
+
+    def __init__(self, api: APIServer, period_s: float = 0.05,
+                 slow_ratio: float = 3.0):
+        self._api = api
+        self._period = period_s
+        self._slow_ratio = slow_ratio
+        self._step = 0
+        self._stop = threading.Event()
+        self.sent = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-goodput-pump")
+
+    def start(self) -> "GoodputPump":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        from ..api.core import GangMemberStatus
+        from ..api.scheduling import pod_group_full_name
+        while not self._stop.wait(self._period):
+            self._step += 1
+            slow_of: dict = {}        # gang → its designated slow member
+            batch = []
+            for pod in self._api.list(srv.PODS):
+                if not pod.spec.node_name:
+                    continue
+                gang = pod_group_full_name(pod) or ""
+                if gang:
+                    slow_of.setdefault(gang, pod.meta.key)
+                step_time = (0.1 * self._slow_ratio
+                             if slow_of.get(gang) == pod.meta.key and gang
+                             else 0.1)
+                batch.append(GangMemberStatus(
+                    pod_key=pod.meta.key, gang=gang, step=self._step,
+                    step_time_s=step_time, throughput=100.0 / step_time))
+            if batch:
+                try:
+                    self._api.report_status(batch)
+                    self.sent += len(batch)
+                except Exception as e:  # the pump is a fixture: a
+                    # mid-teardown blip must not kill the soak thread
+                    klog.V(4).info_s("goodput pump blip", err=str(e))
+
+
 def node_churn_profile() -> PluginProfile:
     """chaos_profile + a fast stuck-gang watchdog: under node churn the
     watchdog is part of the system under test (a gang wedged by a lost
@@ -533,6 +590,11 @@ def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
     repair = GangRepairController(injector, cooldown_s=0.2)
     pg_ctrl = PodGroupController(injector)
     heartbeater = NodeHeartbeater(api).start()
+    # synthetic goodput reports flow for every bound member throughout —
+    # the runtime-telemetry plane (register-on-bind, ingest, straggler
+    # re-evaluation, teardown eviction) soaks under the same node churn
+    # the scheduler does
+    goodput_pump = GoodputPump(api).start()
     for i in range(nodes):
         _make_hb_node(api, f"churn-n{i}")
     spare = nodes          # replacement-node name counter
@@ -667,6 +729,7 @@ def run_node_churn_soak(seed: int = 20260803, min_cycles: int = 5000,
     finally:
         injector.clear()
         heartbeater.stop()
+        goodput_pump.stop()
         monitor.close()
         for c in (lifecycle, repair, pg_ctrl):
             try:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import time
 from typing import Iterable, List, Optional
 
-from ..api.core import POD_RUNNING, Node, Pod
+from ..api.core import POD_RUNNING, GangMemberStatus, Node, Pod
 from ..apiserver import APIServer, Clientset
 from ..apiserver import server as srv
 from ..fwk import PluginProfile, Registry
@@ -99,6 +99,43 @@ class TestCluster:
                 return False
             time.sleep(0.02)
         return True
+
+    # -- synthetic goodput emitters (ISSUE 10) --------------------------------
+
+    def report_progress(self, pod_key: str, *, gang: str = "",
+                        step: int = 0, step_time_s: float = 0.0,
+                        throughput: float = 0.0, unit: str = "tokens",
+                        ttft_s: float = 0.0, stall_s: float = 0.0) -> None:
+        """One synthetic in-band ``GangMemberStatus`` report — what a real
+        member's ``jaxbridge.measure.GoodputReporter`` would emit, minus
+        the hardware. Best-effort by the report_status contract."""
+        self.client.report_status([GangMemberStatus(
+            pod_key=pod_key, gang=gang, step=step,
+            step_time_s=step_time_s, throughput=throughput, unit=unit,
+            ttft_s=ttft_s, stall_s=stall_s)])
+
+    def pump_gang_progress(self, gang: str, step_times: dict, *,
+                           steps: int = 6, tokens_per_step: float = 0.0,
+                           unit: str = "tokens") -> int:
+        """Drive a RUNNING gang's step clocks synthetically: each member
+        in ``step_times`` (pod key → per-step seconds) reports ``steps``
+        progressive step reports. An injected slow member (a larger
+        step-time) is exactly the straggler-detection fixture the e2e
+        tests and ``make goodput-smoke`` use. Returns reports sent."""
+        sent = 0
+        for s in range(1, steps + 1):
+            batch = []
+            for pod_key, step_time_s in sorted(step_times.items()):
+                throughput = (tokens_per_step / step_time_s
+                              if tokens_per_step and step_time_s > 0
+                              else 0.0)
+                batch.append(GangMemberStatus(
+                    pod_key=pod_key, gang=gang, step=s,
+                    step_time_s=step_time_s, throughput=throughput,
+                    unit=unit))
+            self.client.report_status(batch)
+            sent += len(batch)
+        return sent
 
     # -- kubelet simulator ----------------------------------------------------
 

@@ -30,6 +30,13 @@ rebuild's equivalent for its own binaries:
 - ``/debug/fleetrace``  fleet trace capture status (tpusched/obs/
   fleetrace): armed/disarmed, trace directory, segments, bytes written,
   events by kind, queue depth and drop count.
+- ``/debug/goodput``  gang runtime goodput telemetry (tpusched/obs/
+  goodput): per-gang runtime health (rolling goodput, step skew,
+  straggler attribution), aggregator stats, and the workload×generation
+  throughput matrix; ``?gang=`` narrows to one gang's health document.
+- ``/debug/``  the index: every registered debug endpoint with a
+  one-line description (there are enough now that nothing short of this
+  page enumerates them).
 """
 from __future__ import annotations
 
@@ -43,6 +50,31 @@ from typing import Callable, Optional
 
 from . import klog
 from .metrics import REGISTRY
+
+
+# The /debug/ index: one line per registered debug route.  Every route
+# mounted in Handler.do_GET must appear here — the index test pins the
+# two against each other so a new endpoint cannot ship unlisted.
+DEBUG_ENDPOINTS = {
+    "/debug/threads": "stack dump of every thread (the pprof-goroutine "
+                      "analog; first stop for a hung permit barrier)",
+    "/debug/trace": "last N flight-recorder cycle traces (?n=, ?pod= "
+                    "substring, ?format=perfetto)",
+    "/debug/gangs": "per-PodGroup stitched gang traces: critical path, "
+                    "permit barrier, per-member attribution",
+    "/debug/flightrecorder": "full flight-recorder dump: stats + ring + "
+                             "pinned anomaly traces + health section",
+    "/debug/explain": "why-pending / why-slow diagnosis (?pod=, ?gang=; "
+                      "no argument = cluster top blockers + SLO summary)",
+    "/debug/profile": "hot-path sampling profiler, flamegraph-collapsed "
+                      "stacks (?seconds=N fresh window, ?format=json)",
+    "/debug/fleetrace": "fleet trace capture status: armed, directory, "
+                        "segments, events by kind, queue depth, drops",
+    "/debug/goodput": "gang runtime goodput: per-gang health, straggler "
+                      "attribution, workload×generation throughput "
+                      "matrix (?gang= for one gang)",
+    "/debug/vars": "process variables (thread count)",
+}
 
 
 def _thread_dump() -> str:
@@ -116,6 +148,12 @@ class MetricsServer:
                     # tpulint: disable=shadow-isolation — live debug
                     # surface; shadow schedulers never mount a server
                     self._send_json(obs.default_fleetrecorder().status())
+                elif path == "/debug/goodput":
+                    code, payload = self._goodput_payload(query)
+                    self._send(code, json.dumps(payload) + "\n",
+                               "application/json")
+                elif path in ("/debug", "/debug/"):
+                    self._send_json({"endpoints": DEBUG_ENDPOINTS})
                 elif path == "/debug/vars":
                     self._send(200, json.dumps(
                         {"threads": threading.active_count()}) + "\n",
@@ -164,6 +202,27 @@ class MetricsServer:
                          "stats": stats}) + "\n", "application/json")
                 return 200, collapsed, "text/plain"
 
+            def _goodput_payload(self, query: str):
+                """/debug/goodput: the gang-runtime-health surface.
+                Late-bound process-global aggregator (tpusched.obs) —
+                same contract as the flight-recorder routes."""
+                from .. import obs
+                qs = urllib.parse.parse_qs(query)
+                # tpulint: disable=shadow-isolation — the debug server
+                # serves the LIVE process surfaces by contract; shadow
+                # schedulers never mount an HTTP server
+                agg = obs.default_goodput()
+                gang = qs.get("gang", [None])[0]
+                if gang is not None:
+                    out = agg.gang_health(gang)
+                    if out is None:
+                        return 404, {"error": f"gang {gang!r} has no "
+                                              "runtime reports (not "
+                                              "running, torn down, or "
+                                              "members never reported)"}
+                    return 200, out
+                return 200, agg.dump()
+
             def _explain_payload(self, query: str):
                 """/debug/explain: the why-pending diagnosis surface.
                 Late-bound process-global engine/SLO tracker (tpusched.obs)
@@ -186,8 +245,19 @@ class MetricsServer:
                 if gang is not None:
                     out = engine.explain_gang(gang)
                     if out is None:
+                        # no pending diagnosis — the gang may be bound
+                        # and RUNNING: answer with its runtime goodput
+                        # health (straggler attribution) instead of the
+                        # historical "no pending diagnosis" dead end
+                        # tpulint: disable=shadow-isolation — live
+                        # surface, same contract as default_engine above
+                        run = obs.default_goodput().gang_health(gang)
+                        if run is not None:
+                            return 200, run
                         return 404, {"error": f"no pending diagnosis for "
-                                              f"gang {gang!r}"}
+                                              f"gang {gang!r}, and no "
+                                              "runtime goodput reports "
+                                              "(see /debug/goodput)"}
                     # stitch in the permit-barrier view when the flight
                     # recorder holds one (tracing may be off: optional)
                     gt = server.recorder().gangs.get(out["gang"])

@@ -578,3 +578,41 @@ fleetrace_dropped_total = REGISTRY.counter(
 fleetrace_bytes_total = REGISTRY.counter(
     "tpusched_fleetrace_bytes_written_total",
     "Bytes appended to fleet-trace segment files.")
+
+# Gang runtime goodput telemetry (tpusched/obs/goodput.py, fed by the
+# heartbeat-piggybacked GangMemberStatus reports). reports counts reports
+# ACCEPTED into the aggregator; shed counts reports refused at its
+# entry/byte budgets (ingest is bounded — runtime telemetry sheds, it
+# never grows without bound); dropped counts reports lost before the
+# apiserver fan-out (client-side best-effort path). The per-gang gauge
+# families below are registered by the aggregator and REMOVED when a gang
+# is evicted/torn down, so cardinality tracks live gangs only.
+goodput_reports_total = REGISTRY.counter(
+    "tpusched_goodput_reports_total",
+    "Gang member runtime status reports accepted by the aggregator.")
+goodput_reports_shed = REGISTRY.counter(
+    "tpusched_goodput_reports_shed_total",
+    "Runtime status reports shed at the aggregator's entry/byte budgets.")
+goodput_reports_dropped = REGISTRY.counter(
+    "tpusched_goodput_reports_dropped_total",
+    "Runtime status reports lost in the best-effort client fan-out.")
+gang_goodput_units = REGISTRY.gauge_vec(
+    "tpusched_gang_goodput_units_per_second", ("gang", "unit"),
+    "Aggregate member-reported throughput per RUNNING gang (unit/s).")
+gang_goodput_per_chip = REGISTRY.gauge_vec(
+    "tpusched_gang_goodput_per_chip", ("gang", "unit"),
+    "Member-reported throughput per TPU chip for a RUNNING gang.")
+gang_step_skew = REGISTRY.gauge_vec(
+    "tpusched_gang_goodput_step_skew", ("gang",),
+    "Slowest member's rolling step time over the gang median (1.0 = "
+    "perfectly even; the straggler detector's input signal).")
+gang_stragglers = REGISTRY.gauge_vec(
+    "tpusched_gang_stragglers", ("gang",),
+    "Members currently flagged as stragglers per RUNNING gang.")
+gang_straggler_events = REGISTRY.counter_vec(
+    "tpusched_gang_straggler_events_total", ("gang",),
+    "Straggler detections (hysteresis entry edges), by gang.")
+workload_goodput_per_chip = REGISTRY.gauge_vec(
+    "tpusched_workload_goodput_per_chip", ("workload", "generation"),
+    "EWMA goodput-per-chip by workload fingerprint and pool generation "
+    "(the Gavel throughput-matrix cell, ROADMAP item 3).")
