@@ -49,12 +49,18 @@ trace-smoke:
 prof-smoke:
 	$(PY) bench.py --prof-smoke
 
-# Sustained arrival-storm throughput baseline (pre-sharding, ROADMAP item
-# 1): mixed gangs + singletons arriving continuously, binds/sec + p99
-# pod-e2e, writes the schema-validated BENCH_RESULTS.json artifact.
+# Sustained arrival-storm throughput (ROADMAP item 1): mixed gangs +
+# singletons arriving continuously, binds/sec + p99 pod-e2e, writes the
+# schema-validated BENCH_RESULTS.json artifact. bench-storm-sharded runs
+# the sharded dispatch core (sched/shards.py) on the same workload and
+# records it as arrival_storm_sharded.
 .PHONY: bench-storm
 bench-storm:
 	$(PY) bench.py --storm
+
+.PHONY: bench-storm-sharded
+bench-storm-sharded:
+	$(PY) bench.py --storm --shards 8
 
 # Chaos-smoke (the resilience gate, part of the tier1 flow): ≥5k seeded
 # scheduling cycles under injected API faults — conflicts, transients,
@@ -88,13 +94,17 @@ obs-smoke:
 # Race-smoke (the systematic-concurrency gate, part of the tier1 flow):
 # the tpuverify interleaving explorer runs its bounded schedule budget
 # (deterministic seeds, < 60 s) over the critical-section pairs the
-# sharded core will stress — equivcache arming guard vs. foreign
+# sharded core stresses — equivcache arming guard vs. foreign
 # mutations, cache assume/confirm/expire, queue.pop vs. informer moves,
-# informer delete vs. resync, binding-pool shutdown vs. late permits,
-# Condition hand-off — asserting scenario invariants + zero lock-
-# discipline violations (C7) on every explored schedule, plus the
-# seeded-bug meta-test (the explorer must FIND a deliberate atomicity
-# bug and its artifact must replay deterministically via cmd.replay).
+# informer delete vs. resync, binding-pool shutdown vs. late permits
+# (incl. MULTIPLE submitting shards), Condition hand-off, and the ISSUE
+# 11 sharded-dispatch races: concurrent shard commits on one pool's
+# cursor (lost-update control + seeded unguarded-commit bug),
+# shard-vs-informer snapshot epoch swap, cross-shard gang permit quorum
+# — asserting scenario invariants + zero lock-discipline violations
+# (C7) on every explored schedule, plus the seeded-bug meta-test (the
+# explorer must FIND each deliberate bug and its artifact must replay
+# deterministically via cmd.replay).
 .PHONY: race-smoke
 race-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_verify_scenarios.py \
@@ -103,7 +113,12 @@ race-smoke:
 # Replay-smoke (the fleet-trace/determinism gate, part of the tier1 flow):
 # record a tiny storm trace through the fleet trace capture, replay it
 # TWICE into identical configs and assert zero placement diff + identical
-# bind counts (the cmd.trace diff contract); a deliberately perturbed
+# bind counts (the cmd.trace diff contract); replay it through the
+# SHARDED dispatch core (shards=1 vs shards=4, lockstep) and assert the
+# same pod set binds with zero UNATTRIBUTED placement differences (every
+# move explained by the pool partition or a recorded escalation —
+# sched.shards.attribute_placement_diff) and that the sharded replay is
+# itself deterministic; a deliberately perturbed
 # scoring policy must produce a nonzero, attributed diff (non-vacuity);
 # capture overhead is gated ≤3% by the min-of-N / direct-attribution
 # methodology (trace/prof-smoke precedent); crash recovery (torn tail

@@ -302,6 +302,12 @@ def validate_results_artifact(doc) -> list:
             v = rec.get(f)
             if not isinstance(v, num) or isinstance(v, bool):
                 probs.append(f"{key}.{f}: missing or non-numeric ({v!r})")
+        if key == "arrival_storm_sharded":
+            v = rec.get("shards")
+            if not isinstance(v, num) or isinstance(v, bool) or v < 2:
+                probs.append(f"{key}.shards: missing or < 2 ({v!r}) — the "
+                             "sharded storm record must name its lane "
+                             "count")
         fg = rec.get("fleet_goodput")
         if fg is not None:
             if kind != "throughput":
@@ -1137,7 +1143,8 @@ STORM_MIX = (
 def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                    max_pending_pods: int = 1200, seed: int = 0,
                    drain_timeout_s: float = 120.0,
-                   goodput_reports: bool = True) -> dict:
+                   goodput_reports: bool = True,
+                   shards: int = 1) -> dict:
     """ONE sustained arrival storm: a mixed gang+singleton stream arrives
     continuously across ``pools`` v5p-256 pools (64 hosts each) for
     ``duration_s``, with completed workloads torn down as they bind so
@@ -1191,8 +1198,11 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     # member's generation/chips and the synthetic reports fold into the
     # workload×generation matrix
     goodput = obs.install_goodput(obs.GoodputAggregator())
-    with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
-                                              denied_s=1)) as c:
+    profile = tpu_gang_profile(permit_wait_s=30, denied_s=1)
+    # sharded dispatch core (ROADMAP item 1): N per-pool lanes + global
+    # lane; shards=1 keeps the classic single loop (the r6 baseline shape)
+    profile.dispatch_shards = shards
+    with TestCluster(profile=profile) as c:
         for i in range(pools):
             topo, nodes = make_tpu_pool(f"pool-{i:02d}", dims=(8, 8, 4),
                                         dcn_domain=f"zoneA/rack{i // 4}")
@@ -1335,14 +1345,20 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
 
 
 def bench_storm(runs: int = 3, pools: int = 32,
-                duration_s: float = 10.0) -> None:
-    """The sustained arrival-storm baseline (pre-sharding, ROADMAP item 1).
-    min-of-N methodology (doc/performance.md): this box cannot resolve
-    small wall deltas by A/B, so the HEADLINE numbers are the best run's —
-    max binds/sec and min p99 — the run least taxed by ambient load; every
-    run's numbers are kept in the artifact."""
-    run_storm_once(pools=4, duration_s=2.0, seed=99)   # warmup, small
-    results = [run_storm_once(pools=pools, duration_s=duration_s, seed=i)
+                duration_s: float = 10.0, shards: int = 1) -> None:
+    """The sustained arrival-storm scenario (ROADMAP item 1).  min-of-N
+    methodology (doc/performance.md): this box cannot resolve small wall
+    deltas by A/B, so the HEADLINE numbers are the best run's — max
+    binds/sec and min p99 — the run least taxed by ambient load; every
+    run's numbers are kept in the artifact.
+
+    ``shards`` > 1 runs the sharded dispatch core (sched/shards.py) and
+    records the result as the ``arrival_storm_sharded`` scenario, next to
+    the pre-sharding ``arrival_storm`` baseline."""
+    run_storm_once(pools=4, duration_s=2.0, seed=99,
+                   shards=shards)                      # warmup, small
+    results = [run_storm_once(pools=pools, duration_s=duration_s, seed=i,
+                              shards=shards)
                for i in range(runs)]
     # per-run streams are seed-deterministic prefixes whose LENGTH depends
     # on backpressure, so the stamp records both: the seeds (regenerate the
@@ -1360,7 +1376,10 @@ def bench_storm(runs: int = 3, pools: int = 32,
     # best-rate one — same run the throughput numbers quote)
     best_run = max(results, key=lambda r: r["binds_per_sec"])
     fleet_goodput = best_run["fleet_goodput"]
-    emit(f"arrival-storm sustained throughput: mixed gangs+singletons over "
+    label = (f"arrival-storm sustained throughput (SHARDED dispatch, "
+             f"shards={shards})" if shards > 1
+             else "arrival-storm sustained throughput")
+    emit(f"{label}: mixed gangs+singletons over "
          f"{pools} pools / {hosts} hosts, {duration_s:.0f}s continuous "
          f"arrivals, capacity recycling (best of {runs} runs; per-run "
          f"rates {[r['binds_per_sec'] for r in results]})",
@@ -1379,7 +1398,8 @@ def bench_storm(runs: int = 3, pools: int = 32,
          f"reporting member(s) — ROADMAP item 3 baseline)",
          fleet_goodput["goodput_per_chip_mean"], "unit/s/chip", None)
     _record_scenario(
-        "arrival_storm", "throughput",
+        "arrival_storm_sharded" if shards > 1 else "arrival_storm",
+        "throughput",
         binds_per_sec=best_rate, pod_e2e_p50_s=best_p50,
         pod_e2e_p99_s=best_p99, runs=len(results),
         pools=pools, hosts=hosts, duration_s=duration_s,
@@ -1388,7 +1408,12 @@ def bench_storm(runs: int = 3, pools: int = 32,
                                     "binds", "pending_peak",
                                     "cycles_per_bind", "drain_s")}
                  for r in results],
-        description="sustained mixed arrival storm, pre-sharding baseline")
+        **({"shards": shards,
+            "description": "sustained mixed arrival storm, sharded "
+                           "dispatch core (sched/shards.py)"}
+           if shards > 1 else
+           {"description": "sustained mixed arrival storm, single "
+                           "dispatch loop baseline"}))
     _check_gate("storm_pod_e2e_p99",
                 [r["pod_e2e_p99_s"] for r in results])
 
@@ -2416,9 +2441,19 @@ def main() -> int:
     if "--smoke" in sys.argv:
         return smoke_gate()
     if "--storm" in sys.argv:
-        # storm-only run (the pre-sharding baseline recorder): emits the
-        # throughput lines and writes the schema-validated artifact
-        bench_storm()
+        # storm-only run: emits the throughput lines and writes the
+        # schema-validated artifact.  --shards N runs the sharded
+        # dispatch core (recorded as arrival_storm_sharded, next to the
+        # single-loop arrival_storm baseline).
+        shards = 1
+        if "--shards" in sys.argv:
+            try:
+                shards = int(sys.argv[sys.argv.index("--shards") + 1])
+            except (IndexError, ValueError):
+                print("usage: bench.py --storm [--shards N]",
+                      file=sys.stderr)
+                return 2
+        bench_storm(shards=shards)
         write_results_artifact(_results_path())
         if _gate_failures:
             for f in _gate_failures:

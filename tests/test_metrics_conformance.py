@@ -268,8 +268,8 @@ def test_issue7_families_round_trip_exposition():
                                        scheduling_cycles_total)
     lock_wait_seconds.with_labels("conformance.Lock").observe(0.0004)
     lock_hold_seconds.with_labels("conformance.Lock").observe(0.002)
-    binds_total.with_labels("conformance-sched").inc()
-    scheduling_cycles_total.with_labels("conformance-sched").inc(2)
+    binds_total.with_labels("conformance-sched", "").inc()
+    scheduling_cycles_total.with_labels("conformance-sched", "").inc(2)
     profiler_samples_total.inc(0)
     types, helps, samples = parse_exposition(REGISTRY.expose())
     assert types["tpusched_lock_wait_seconds"] == "histogram"
@@ -285,8 +285,49 @@ def test_issue7_families_round_trip_exposition():
               and labels["le"] not in ("+Inf",)
               and float(labels["le"]) < 0.001]
     assert sub_ms and max(sub_ms) >= 1.0
-    assert (("tpusched_binds_total", {"scheduler": "conformance-sched"},
+    assert (("tpusched_binds_total",
+             {"scheduler": "conformance-sched", "shard": ""},
              1.0)) in samples
+
+
+def test_issue11_shard_families_round_trip_exposition():
+    """The ISSUE 11 sharded-dispatch families: throughput counters carry
+    the new ``shard`` label ('' on the single loop, s<N>/global per
+    lane), queue-wait is a shard-labeled histogram family, and the
+    conflict/escalation counters expose per-lane children — all through
+    the validating exposition round trip."""
+    from tpusched.util.metrics import (binds_total, queue_wait_seconds,
+                                       scheduling_cycles_total,
+                                       shard_conflicts_total,
+                                       shard_escalations_total)
+    binds_total.with_labels("conformance-shard", "s0").inc(3)
+    binds_total.with_labels("conformance-shard", "global").inc()
+    scheduling_cycles_total.with_labels("conformance-shard", "s0").inc(4)
+    queue_wait_seconds.with_labels("s0").observe(0.01)
+    queue_wait_seconds.with_labels("global").observe(0.02)
+    shard_conflicts_total.with_labels("s0").inc()
+    shard_escalations_total.with_labels("s0").inc(2)
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert types["tpusched_shard_conflicts_total"] == "counter"
+    assert types["tpusched_shard_escalations_total"] == "counter"
+    assert types["tpusched_scheduling_queue_wait_duration_seconds"] \
+        == "histogram"
+    assert (("tpusched_binds_total",
+             {"scheduler": "conformance-shard", "shard": "s0"}, 3.0)
+            in samples)
+    assert (("tpusched_binds_total",
+             {"scheduler": "conformance-shard", "shard": "global"}, 1.0)
+            in samples)
+    assert (("tpusched_shard_escalations_total", {"shard": "s0"}, 2.0)
+            in samples)
+    # per-shard queue-wait children expose their own bucket series
+    shard_buckets = {labels.get("shard")
+                     for name, labels, v in samples
+                     if name == "tpusched_scheduling_queue_wait_"
+                                "duration_seconds_bucket"}
+    assert {"s0", "global"} <= shard_buckets
+    # family totals still stand in for the pre-sharding unlabeled counters
+    assert binds_total.value() >= 4.0
 
 
 def test_issue10_goodput_families_round_trip_exposition():

@@ -172,6 +172,63 @@ def test_perturbed_scoring_policy_produces_attributed_diff(two_replays,
         assert row["pod"] and row["a"] != row["b"]
 
 
+def test_sharded_lockstep_replay_matches_single_lane(two_replays,
+                                                     smoke_trace):
+    """ISSUE 11 (`make replay-smoke` sharding gate): replay the recorded
+    storm through the SHARDED dispatch core in lockstep and diff against
+    the shards=1 replay.  The contract: the same pod set binds, bind
+    counts match, the sharded replay is itself deterministic, and every
+    placement move is ATTRIBUTED to the sharding policy — the pod landed
+    inside its routed shard's pool partition (partition argmax ≠ fleet
+    argmax, by design) or its unit was escalated to the global lane.
+    Zero unattributed differences: anything the partitioning rule cannot
+    explain is a real divergence (lost update, stale epoch) and fails."""
+    from tpusched.api.topology import LABEL_POOL
+    from tpusched.sched.shards import attribute_placement_diff
+    from tpusched.sim.replay import _decode
+
+    r1, _ = two_replays
+    rs = run_replay(smoke_trace, dispatch_shards=4)
+    assert rs.dispatch_shards == 4
+    assert rs.unbound == [], "sharded replay left pods unbound"
+    assert rs.binds == r1.binds
+
+    # sharded lockstep replay is deterministic in its own right
+    rs2 = run_replay(smoke_trace, dispatch_shards=4)
+    assert json.dumps(rs.placements) == json.dumps(rs2.placements)
+
+    trace = load_trace(smoke_trace)
+    pool_of = {n.meta.name: n.meta.labels.get(LABEL_POOL, "")
+               for n in trace.objects.get(srv.NODES, ())}
+    gang_of = {}
+    pinned_of = {}
+    from tpusched.api.scheduling import pod_group_full_name
+    for ev in trace.events:
+        if ev.get("kind") == "pod-arrival":
+            obj = _decode(ev)
+            if obj is not None:
+                gang_of[obj.meta.key] = pod_group_full_name(obj) or None
+                pinned_of[obj.meta.key] = \
+                    (obj.spec.node_selector or {}).get(LABEL_POOL)
+    assert rs.escalations_truncated is False
+    diff = diff_placements(r1.to_dict(), rs.to_dict())
+    attributed = attribute_placement_diff(
+        diff, shards=4,
+        pool_of_node=lambda n: pool_of.get(n, ""),
+        gang_of=lambda p: gang_of.get(p),
+        escalated_units=rs.escalated_units,
+        pinned_pool_of=lambda p: pinned_of.get(p),
+        escalated_truncated=rs.escalations_truncated)
+    assert attributed["unattributed_count"] == 0, (
+        f"unattributed placement differences: "
+        f"{attributed['unattributed']} / only_in: "
+        f"{attributed['only_in_a']} {attributed['only_in_b']}")
+    # every move carries its attribution verdict for the diff report
+    for row in attributed["placement_diff"]:
+        assert row["attributed"] in ("shard-partition", "escalated-global")
+        assert row["routed_shard"].startswith("s")
+
+
 def test_diff_vs_recorded_reality_is_structured(two_replays, smoke_trace):
     r1, _ = two_replays
     real = recorded_reality(load_trace(smoke_trace))

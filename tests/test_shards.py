@@ -1,0 +1,553 @@
+"""Sharded dispatch core (ISSUE 11, sched/shards.py): router determinism
+and fallback rules, the cache's per-pool cursor / epoch-view / guarded-
+assume protocol, the per-lane queue facade, the bind-pool sizing knob, and
+the end-to-end sharded scheduler — binds land, per-shard telemetry and
+health surfaces populate, escalation rescues pods whose routed shard
+cannot host them.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.api.topology import LABEL_POOL
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.fwk import PluginProfile
+from tpusched.sched.cache import Cache, pool_of_node
+from tpusched.sched.queue import SchedulingQueue, ShardedQueues
+from tpusched.sched.shards import (GLOBAL_LANE, ShardRouter,
+                                   attribute_placement_diff, pool_shard,
+                                   shard_lane, unit_key_of)
+from tpusched.testing import (TestCluster, make_node, make_pod,
+                              make_pod_group, make_tpu_pool)
+
+
+def pool_node(name: str, pool: str):
+    n = make_node(name, capacity=make_resources(cpu=8, memory="16Gi"))
+    n.meta.labels[LABEL_POOL] = pool
+    return n
+
+
+# -- router ───────────────────────────────────────────────────────────────────
+
+
+def test_pool_shard_is_stable_and_total():
+    for shards in (2, 4, 8):
+        for pool in ("pool-00", "pool-31", "", "zoneA/p1"):
+            a = pool_shard(pool, shards)
+            assert a == pool_shard(pool, shards)      # deterministic
+            assert 0 <= a < shards
+
+
+def test_router_keeps_gang_units_in_one_lane():
+    r = ShardRouter(4)
+    members = [make_pod(f"m-{i}", pod_group="g1") for i in range(6)]
+    lanes = {r.lane_for(p) for p in members}
+    assert len(lanes) == 1
+    assert lanes.pop() == shard_lane(pool_shard("default/g1", 4))
+    # singletons route by their own key
+    solo = make_pod("solo-1")
+    assert r.lane_for(solo) == shard_lane(pool_shard(solo.key, 4))
+    assert unit_key_of(solo) == solo.key
+    assert unit_key_of(members[0]) == "default/g1"
+
+
+def test_router_global_fallbacks():
+    pgs = {}
+    r = ShardRouter(4, pg_lookup=pgs.get)
+    # nominated preemptors serialize on the global lane
+    pod = make_pod("nom")
+    pod.status.nominated_node_name = "n1"
+    assert r.lane_for(pod) == GLOBAL_LANE
+    # multislice member gangs span pools: global
+    ms = make_pod_group("ms1", min_member=2)
+    ms.spec.multislice_set = "setA"
+    pgs["default/ms1"] = ms
+    assert r.lane_for(make_pod("m", pod_group="ms1")) == GLOBAL_LANE
+    # quota mode serializes EVERYTHING
+    r.set_quota_mode(True)
+    assert r.lane_for(make_pod("plain")) == GLOBAL_LANE
+    r.set_quota_mode(False)
+    # an explicit pool selector pins a SINGLETON to that pool's shard
+    pinned = make_pod("pin")
+    pinned.spec.node_selector = {LABEL_POOL: "pool-07"}
+    assert r.lane_for(pinned) == shard_lane(pool_shard("pool-07", 4))
+    # ...but never splits a gang: a pinned MEMBER still routes by unit
+    # (one unit = one lane; an out-of-partition pin escalates the unit)
+    member = make_pod("m-pin", pod_group="gp")
+    member.spec.node_selector = {LABEL_POOL: "pool-07"}
+    assert r.lane_for(member) == shard_lane(pool_shard("default/gp", 4))
+    # shards=1 is always the (single) global lane
+    assert ShardRouter(1).lane_for(make_pod("x")) == GLOBAL_LANE
+
+
+def test_router_escalation_ttl_and_registry():
+    now = [0.0]
+    r = ShardRouter(4, clock=lambda: now[0], escalation_ttl_s=10.0)
+    member = make_pod("m-0", pod_group="g2")
+    home = r.lane_for(member)
+    assert home != GLOBAL_LANE
+    unit = r.escalate(member)
+    assert unit == "default/g2"
+    # the WHOLE unit routes global, not just the escalated pod
+    assert r.lane_for(make_pod("m-1", pod_group="g2")) == GLOBAL_LANE
+    assert r.is_escalated(unit)
+    assert unit in r.escalated_units()
+    assert r.escalations() == 1
+    # TTL lapse returns the unit to its home shard
+    now[0] = 11.0
+    assert not r.is_escalated(unit)
+    assert r.lane_for(member) == home
+    # the cumulative set survives expiry (replay-diff attribution input)
+    assert unit in r.escalated_units()
+
+
+def test_router_partition_covers_every_pool_exactly_once():
+    r = ShardRouter(4)
+    pools = [f"pool-{i:02d}" for i in range(16)]
+    parts = [r.partition(pools, shard_lane(i)) for i in range(4)]
+    flat = [p for part in parts for p in part]
+    assert sorted(flat) == sorted(pools)          # a partition, exactly
+    assert r.partition(pools, GLOBAL_LANE) == pools
+
+
+# -- cache: pool cursors, epoch views, guarded assume ─────────────────────────
+
+
+def test_pool_cursors_attribute_mutations_to_the_touched_pool():
+    c = Cache()
+    c.add_node(pool_node("a1", "pool-a"))
+    c.add_node(pool_node("b1", "pool-b"))
+    a0, b0 = c.pool_cursor("pool-a"), c.pool_cursor("pool-b")
+    c.add_pod(make_pod("p", node_name="a1"))
+    assert c.pool_cursor("pool-a") == a0 + 1
+    assert c.pool_cursor("pool-b") == b0          # untouched pool untouched
+    g0 = c.mutation_cursor()
+    c.remove_pod(make_pod("p", node_name="a1"))
+    assert c.mutation_cursor() == g0 + 1
+    assert c.pool_cursor("pool-b") == b0
+
+
+def test_snapshot_view_partition_is_restricted_and_cached():
+    c = Cache()
+    c.add_node(pool_node("a1", "pool-a"))
+    c.add_node(pool_node("b1", "pool-b"))
+    v1 = c.snapshot_view(["pool-a"])
+    assert v1.snapshot.node_names() == ["a1"]     # partition-restricted
+    assert set(v1.pool_cursors) == {"pool-a"}
+    # a foreign-pool mutation must NOT rebuild this partition's snapshot
+    c.add_pod(make_pod("pb", node_name="b1"))
+    v2 = c.snapshot_view(["pool-a"])
+    assert v2.snapshot is v1.snapshot
+    # a mutation in MY pool does
+    c.add_pod(make_pod("pa", node_name="a1"))
+    v3 = c.snapshot_view(["pool-a"])
+    assert v3.snapshot is not v1.snapshot
+    assert [p.key for i in v3.snapshot.list() for p in i.pods] \
+        == ["default/pa"]
+
+
+def test_assume_pod_guarded_commits_and_refuses():
+    c = Cache()
+    c.add_node(pool_node("a1", "pool-a"))
+    c.add_node(pool_node("a2", "pool-a"))
+    view = c.snapshot_view(["pool-a"])
+    cur = view.pool_cursors["pool-a"]
+    # clean commit: returns the post-assume cursor tuple for the pools
+    out = c.assume_pod_guarded(make_pod("p1"), "a1", cur, pools=["pool-a"])
+    assert out == (("pool-a", cur + 1),)
+    assert c.is_assumed("default/p1")
+    # stale epoch: refused, nothing assumed
+    assert c.assume_pod_guarded(make_pod("p2"), "a2", cur) is None
+    assert not c.is_assumed("default/p2")
+    # vanished node: refused
+    assert c.assume_pod_guarded(make_pod("p3"), "gone", 0) is None
+
+
+def test_guarded_assume_ignores_foreign_pool_traffic():
+    c = Cache()
+    c.add_node(pool_node("a1", "pool-a"))
+    c.add_node(pool_node("b1", "pool-b"))
+    view = c.snapshot_view(["pool-a"])
+    # heavy foreign-pool churn between capture and commit
+    for i in range(5):
+        c.add_pod(make_pod(f"fb{i}", node_name="b1"))
+    out = c.assume_pod_guarded(make_pod("p"), "a1",
+                               view.pool_cursors["pool-a"])
+    assert out is not None, \
+        "cross-pool traffic must never refuse a shard's commit"
+
+
+def test_assume_replaces_instead_of_stacking_quorum():
+    """The cross-shard-gang-quorum race scenario's fix: an assume over an
+    already-cached copy (a raced watch confirm) replaces it — the permit
+    quorum index must count the member once."""
+    c = Cache()
+    c.add_node(pool_node("a1", "pool-a"))
+    c.add_pod(make_pod("m", pod_group="g", node_name="a1"))   # confirm first
+    c.assume_pod(make_pod("m", pod_group="g"), "a1")          # raced assume
+    assert c.snapshot().assigned_count("g", "default") == 1
+
+
+def test_pool_of_node_and_pools_accounting():
+    c = Cache()
+    n = pool_node("x1", "pool-x")
+    assert pool_of_node(n) == "pool-x"
+    v0 = c.pools_version
+    c.add_node(n)
+    assert c.pools() == ["pool-x"]
+    assert c.pools_version == v0 + 1
+    c.add_node(pool_node("x2", "pool-x"))
+    assert c.pools_version == v0 + 1      # pool SET unchanged
+    c.remove_node(n)
+    assert c.pools() == ["pool-x"]
+    c.remove_node(pool_node("x2", "pool-x"))
+    assert c.pools() == []
+    assert c.pools_version == v0 + 2
+
+
+# -- sharded queue facade ─────────────────────────────────────────────────────
+
+
+def _less(a, b):
+    if a.pod.priority != b.pod.priority:
+        return a.pod.priority > b.pod.priority
+    return a.timestamp < b.timestamp
+
+
+def make_lane_queues(route):
+    lanes = [shard_lane(i) for i in range(2)] + [GLOBAL_LANE]
+    return ShardedQueues(
+        lanes, lambda: SchedulingQueue(_less, initial_backoff_s=0,
+                                       max_backoff_s=0), route)
+
+
+def test_sharded_queues_route_pop_and_single_lane_delete():
+    routed = {}
+
+    def route(pod):
+        return routed.get(pod.key, "s0")
+
+    q = make_lane_queues(route)
+    a, b = make_pod("a"), make_pod("b")
+    routed[b.key] = "s1"
+    q.add(a)
+    q.add(b)
+    by_lane = q.pending_counts_by_lane()
+    assert by_lane["s0"]["active"] == 1 and by_lane["s1"]["active"] == 1
+    assert q.pending_counts()["active"] == 2
+    # lane-scoped pop serves only its own lane
+    assert q.pop(timeout=0, lane="s1").pod.key == b.key
+    assert q.pop(timeout=0, lane="s1") is None
+    # delete goes through the location map (single-lane)
+    q.delete(a)
+    assert q.pending_counts()["active"] == 0
+    assert not q.pending_pods()
+
+
+def test_sharded_queues_pop_none_blocks_like_the_single_queue():
+    """Facade contract parity: pop(timeout=None) blocks until a pod
+    arrives, and returns None once the queues close — exactly the
+    wrapped SchedulingQueue's behavior for by-hand drivers."""
+    import threading
+    q = make_lane_queues(lambda pod: "s0")
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.pop()),
+                         daemon=True, name="popper-1")
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive(), "pop(None) returned instead of blocking"
+    q.add(make_pod("blocker"))
+    t.join(2)
+    assert not t.is_alive() and got[0].pod.key == "default/blocker"
+    t2 = threading.Thread(target=lambda: got.append(q.pop()),
+                          daemon=True, name="popper-2")
+    t2.start()
+    time.sleep(0.05)
+    q.close()
+    t2.join(2)
+    assert not t2.is_alive() and got[1] is None
+
+
+def test_sharded_queues_reroute_and_requeue_follow_the_router():
+    lane = {"v": "s0"}
+
+    def route(pod):
+        return lane["v"]
+
+    q = make_lane_queues(route)
+    q.add(make_pod("p"))
+    info = q.pop(timeout=0, lane="s0")
+    assert info is not None
+    # escalation hop: push straight into the global lane's activeQ
+    q.push_active(info, GLOBAL_LANE)
+    info = q.pop(timeout=0, lane=GLOBAL_LANE)
+    assert info is not None
+    # requeue re-routes by the router's CURRENT verdict
+    lane["v"] = "s1"
+    q.requeue_after_failure(info, to_backoff=True)
+    assert q.pending_counts_by_lane()["s1"]["backoff"] == 1
+
+
+# -- attribution of sharded placement diffs ───────────────────────────────────
+
+
+def test_attribute_placement_diff_classifies_moves():
+    shards = 4
+    unit = "default/gX"
+    lane_idx = pool_shard(unit, shards)
+    in_part = next(f"pool-{i:02d}" for i in range(64)
+                   if pool_shard(f"pool-{i:02d}", shards) == lane_idx)
+    out_part = next(f"pool-{i:02d}" for i in range(64)
+                    if pool_shard(f"pool-{i:02d}", shards) != lane_idx)
+    diff = {"binds_a": 3, "binds_b": 3, "only_in_a": [], "only_in_b": [],
+            "placement_diff": [
+                {"pod": "default/gX-0", "a": "na", "b": f"{in_part}-n"},
+                {"pod": "default/gX-1", "a": "na", "b": f"{out_part}-n"},
+                {"pod": "default/gX-2", "a": "na", "b": f"{out_part}-m"}]}
+    pool_of = lambda node: node.rsplit("-", 1)[0]   # noqa: E731
+    out = attribute_placement_diff(
+        diff, shards=shards, pool_of_node=pool_of,
+        gang_of=lambda p: unit,
+        escalated_units=[])
+    kinds = [r["attributed"] for r in out["placement_diff"]]
+    assert kinds[0] == "shard-partition"
+    assert kinds[1] == "" and kinds[2] == ""
+    assert out["unattributed_count"] == 2
+    # the same moves become attributed when the unit escalated
+    out2 = attribute_placement_diff(
+        diff, shards=shards, pool_of_node=pool_of,
+        gang_of=lambda p: unit, escalated_units=[unit])
+    assert out2["unattributed_count"] == 0
+    assert all(r["attributed"] for r in out2["placement_diff"])
+    # a bind-count delta is always unattributed
+    out3 = attribute_placement_diff(
+        dict(diff, binds_b=2), shards=shards, pool_of_node=pool_of,
+        gang_of=lambda p: unit, escalated_units=[unit])
+    assert out3["unattributed_count"] == 1
+    # a pinned SINGLETON attributes against its pinned pool's shard,
+    # mirroring the router's selector rule
+    solo_diff = {"binds_a": 1, "binds_b": 1, "only_in_a": [],
+                 "only_in_b": [],
+                 "placement_diff": [
+                     {"pod": "default/solo", "a": "na",
+                      "b": f"{out_part}-n"}]}
+    out4 = attribute_placement_diff(
+        solo_diff, shards=shards, pool_of_node=pool_of,
+        gang_of=lambda p: None, escalated_units=[],
+        pinned_pool_of=lambda p: out_part)
+    assert out4["unattributed_count"] == 0
+    assert out4["placement_diff"][0]["attributed"] == "shard-partition"
+    # a truncated escalated set is itself an unattributed condition
+    out5 = attribute_placement_diff(
+        diff, shards=shards, pool_of_node=pool_of,
+        gang_of=lambda p: unit, escalated_units=[unit],
+        escalated_truncated=True)
+    assert out5["escalated_set_truncated"] is True
+    assert out5["unattributed_count"] == 1
+
+
+def test_profiler_thread_labels_keep_the_shard_id():
+    """/debug/profile attribution rows are keyed by thread label; the
+    sampler folds only PLAIN numeric worker suffixes ("tpusched-bind-3" →
+    "tpusched-bind") — a dispatch lane's "-s<N>"/"-global" suffix must
+    survive so per-shard samples stay attributable."""
+    from tpusched.obs.profiler import _NUM_SUFFIX
+    fold = lambda n: _NUM_SUFFIX.sub("", n)   # noqa: E731
+    assert fold("tpusched-bind-3") == "tpusched-bind"
+    assert fold("tpusched-dispatch-s0") == "tpusched-dispatch-s0"
+    assert fold("tpusched-dispatch-s12") == "tpusched-dispatch-s12"
+    assert fold("tpusched-dispatch-global") == "tpusched-dispatch-global"
+
+
+# -- profile knobs ────────────────────────────────────────────────────────────
+
+
+def test_bind_pool_sizing_follows_profile_and_shards():
+    api = srv.APIServer()
+    from tpusched.plugins import default_registry
+    prof = tpu_gang_profile()
+    prof.bind_pool_workers = 3
+    from tpusched.sched import Scheduler
+    s = Scheduler(api, default_registry(), prof)
+    try:
+        assert len(s._bind_pool._threads) == 3
+    finally:
+        s.stop()
+    # auto sizing scales with the lane count (2 per lane, floor 4, cap 32)
+    prof2 = tpu_gang_profile(scheduler_name="auto-sized")
+    prof2.dispatch_shards = 12
+    s2 = Scheduler(srv.APIServer(), default_registry(), prof2)
+    try:
+        assert len(s2._bind_pool._threads) == 24
+        assert s2.dispatch_shards == 12
+    finally:
+        s2.stop()
+
+
+def test_profile_yaml_decodes_dispatch_shards():
+    from tpusched.config import versioned
+    cfg = versioned.loads("""
+apiVersion: tpusched.config.tpu.dev/v1beta1
+kind: TpuSchedulerConfiguration
+profiles:
+  - schedulerName: sharded
+    dispatchShards: 4
+    bindPoolWorkers: 8
+""")
+    prof = cfg.profile("sharded")
+    assert prof.dispatch_shards == 4
+    assert prof.bind_pool_workers == 8
+    with pytest.raises(versioned.ConfigError):
+        versioned.loads("""
+apiVersion: tpusched.config.tpu.dev/v1beta1
+kind: TpuSchedulerConfiguration
+profiles:
+  - schedulerName: bad
+    dispatchShards: -1
+""")
+
+
+# -- end to end ───────────────────────────────────────────────────────────────
+
+
+def _drain(c, pods, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        live = [c.pod(p.key) for p in pods]
+        if all(p is not None and p.spec.node_name for p in live):
+            return []
+        time.sleep(0.1)
+    return [p.key for q, p in
+            zip(pods, (c.pod(p.key) for p in pods))
+            if p is None or not p.spec.node_name]
+
+
+def test_sharded_scheduler_binds_mixed_workload_with_shard_telemetry():
+    from tpusched.util.metrics import binds_total, scheduling_cycles_total
+    prof = tpu_gang_profile(permit_wait_s=30, denied_s=1,
+                            scheduler_name="shard-e2e")
+    prof.dispatch_shards = 4
+    with TestCluster(profile=prof) as c:
+        for i in range(8):
+            topo, nodes = make_tpu_pool(f"pool-{i:02d}", dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        pods = [make_pod(f"solo-{j}", limits={TPU: 1},
+                         scheduler_name="shard-e2e",
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for j in range(12)]
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "g1", min_member=4, tpu_slice_shape="2x2x4",
+            tpu_accelerator="tpu-v5p"))
+        pods += [make_pod(f"g1-{j}", pod_group="g1", limits={TPU: 4},
+                          scheduler_name="shard-e2e",
+                          requests=make_resources(cpu=1, memory="1Gi"))
+                 for j in range(4)]
+        c.create_pods(pods)
+        assert _drain(c, pods) == []
+
+        s = c.scheduler
+        assert s.dispatch_shards == 4
+        assert sorted(s.queue.lanes()) == sorted(
+            [f"s{i}" for i in range(4)] + [GLOBAL_LANE])
+        # per-shard throughput children exist and account for every bind
+        kids = binds_total.children()
+        lane_binds = {k[1]: v.value() for k, v in kids.items()
+                      if k[0] == "shard-e2e"}
+        assert sum(lane_binds.values()) == len(pods)
+        assert any(l.startswith("s") for l, v in lane_binds.items() if v)
+        cyc = {k[1]: v.value() for k, v in
+               scheduling_cycles_total.children().items()
+               if k[0] == "shard-e2e"}
+        assert sum(cyc.values()) >= len(pods)
+
+        # health.shards published into the flight recorder
+        # (/debug/flightrecorder renders recorder.dump()["health"])
+        s._publish_shard_health()
+        health = s.recorder.dump()["health"]["shards"]
+        assert health["shard_count"] == 5
+        assert set(health["lanes"]) == set(s.queue.lanes())
+        for lane, row in health["lanes"].items():
+            assert {"cycles", "binds", "conflicts",
+                    "escalations"} <= set(row)
+        # cycle traces carry the lane id (the ring is process-global:
+        # filter to THIS scheduler's cycles)
+        shards_seen = {t.shard for t in s.recorder.traces()
+                       if t.scheduler == "shard-e2e"}
+        assert shards_seen and all(sh in set(s.queue.lanes())
+                                   for sh in shards_seen)
+
+
+def test_partition_capacity_shortfall_does_not_poison_denied_window():
+    """A gang whose min_resources exceed its home shard's partition but
+    fit the fleet must bind promptly: the shard-lane Coscheduling
+    capacity dry-run failure must NOT write the process-global
+    denied-PodGroup window (the escalated global-lane retry would bounce
+    off it for the whole denial TTL)."""
+    prof = tpu_gang_profile(permit_wait_s=30, denied_s=30,
+                            scheduler_name="shard-deny")
+    prof.dispatch_shards = 2
+    # two pools on DIFFERENT shards (so each partition holds one pool)
+    pools = []
+    i = 0
+    while len({pool_shard(p, 2) for p in pools}) < 2:
+        name = f"pool-{i:02d}"
+        i += 1
+        if name not in pools:
+            pools = ([p for p in pools
+                      if pool_shard(p, 2) != pool_shard(name, 2)]
+                     + [name]) if pools else [name]
+    with TestCluster(profile=prof) as c:
+        for p in pools:
+            topo, nodes = make_tpu_pool(p, dims=(4, 4, 4))  # 64 chips each
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        pg = make_pod_group("bigmin", min_member=4,
+                            tpu_slice_shape="2x2x4",
+                            tpu_accelerator="tpu-v5p")
+        # dry-run demand: > one pool (64), <= fleet (128)
+        pg.spec.min_resources = {TPU: 100}
+        c.api.create(srv.POD_GROUPS, pg)
+        pods = [make_pod(f"bigmin-{j}", pod_group="bigmin",
+                         limits={TPU: 4}, scheduler_name="shard-deny",
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for j in range(4)]
+        c.create_pods(pods)
+        # well under the 30s denial TTL: a poisoned window would wedge it
+        assert _drain(c, pods, timeout=15.0) == [], (
+            "gang stalled: the shard-lane capacity shortfall poisoned "
+            "the global denied-PodGroup window")
+
+
+def test_escalation_rescues_units_hashed_to_poolless_shards():
+    """A unit hashed to a shard that owns no pools must still bind: the
+    empty-partition cycle escalates it to the global lane."""
+    prof = tpu_gang_profile(permit_wait_s=30, denied_s=1,
+                            scheduler_name="shard-esc")
+    prof.dispatch_shards = 4
+    with TestCluster(profile=prof) as c:
+        # ONE pool: three of the four shards own nothing
+        topo, nodes = make_tpu_pool("pool-00", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        pool_lane = pool_shard("pool-00", 4)
+        # find pod names hashed to a POOLLESS shard
+        pods = []
+        i = 0
+        while len(pods) < 3:
+            name = f"esc-{i}"
+            i += 1
+            key = f"default/{name}"
+            if pool_shard(key, 4) != pool_lane:
+                pods.append(make_pod(name, limits={TPU: 1},
+                                     scheduler_name="shard-esc",
+                                     requests=make_resources(
+                                         cpu=1, memory="1Gi")))
+        c.create_pods(pods)
+        assert _drain(c, pods) == []
+        assert c.scheduler.shard_router().escalations() >= len(pods)
+        assert c.scheduler.shard_router().escalated_units()

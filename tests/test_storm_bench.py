@@ -49,6 +49,35 @@ def test_storm_smoke_runs_and_reports(tmp_path):
         assert k in doc["environment"]
 
 
+def test_sharded_storm_smoke_runs_and_reports(tmp_path):
+    """ISSUE 11 CI smoke: the sharded dispatch core (shards=4) sustains
+    the scaled-down storm, drains without wedging a gang, and its record
+    lands as the ``arrival_storm_sharded`` scenario — schema-valid, with
+    the lane count stamped (the validator rejects a sharded record that
+    does not name its shards)."""
+    r = bench.run_storm_once(pools=2, duration_s=2.0, max_pending_pods=300,
+                             seed=11, drain_timeout_s=90, shards=4)
+    assert r["binds"] > 0
+    assert r["binds_per_sec"] > 0
+    assert r["total_binds"] == r["submitted_pods"]   # drained, no wedge
+    assert r["pod_e2e_events"] == r["submitted_pods"]
+
+    bench._record_scenario(
+        "arrival_storm_sharded", "throughput", shards=4,
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1)
+    out = tmp_path / "results.json"
+    bench.write_results_artifact(str(out))
+    assert bench._gate_failures == []
+    doc = json.loads(out.read_text())
+    assert bench.validate_results_artifact(doc) == []
+    assert doc["scenarios"]["arrival_storm_sharded"]["shards"] == 4
+    # negative: a sharded record without its lane count is rejected
+    doc["scenarios"]["arrival_storm_sharded"].pop("shards")
+    probs = bench.validate_results_artifact(doc)
+    assert any("arrival_storm_sharded.shards" in p for p in probs)
+
+
 def test_latency_lines_record_into_artifact():
     bench.emit_latency("synthetic scenario", [0.1, 0.2, 0.3], "synth_p99")
     doc = bench.build_results_artifact()

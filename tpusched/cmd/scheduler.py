@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "versioned JSON artifact on shutdown — loadable "
                         "by obs.load_matrix / `cmd.whatif` for goodput-"
                         "aware planning")
+    p.add_argument("--shards", type=int, default=None, metavar="N",
+                   help="sharded dispatch core (sched/shards.py): run N "
+                        "per-pool dispatch lanes with optimistic cross-"
+                        "pool conflict resolution, plus a serialized "
+                        "global lane. 1 = classic single loop, 0 = auto. "
+                        "Overrides the profile's dispatchShards")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics /healthz /readyz /debug/threads "
                         "/debug/trace /debug/gangs /debug/flightrecorder "
@@ -104,10 +110,17 @@ def resolve_profiles(args, cfg=None) -> List["versioned.PluginProfile"]:
     if args.config:
         if cfg is None:
             cfg = versioned.load_file(args.config)
-        if args.scheduler_name:
-            return [cfg.profile(args.scheduler_name)]
-        return list(cfg.profiles)
-    return [CANNED_PROFILES[args.profile]()]
+        profiles = [cfg.profile(args.scheduler_name)] \
+            if args.scheduler_name else list(cfg.profiles)
+    else:
+        profiles = [CANNED_PROFILES[args.profile]()]
+    if getattr(args, "shards", None) is not None:
+        if args.shards < 0:
+            raise versioned.ConfigError(
+                f"--shards must be >= 0 (0 = auto), got {args.shards}")
+        for prof in profiles:
+            prof.dispatch_shards = args.shards
+    return profiles
 
 
 def profile_summary(scheduler: Scheduler) -> dict:

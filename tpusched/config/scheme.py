@@ -98,6 +98,20 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
     profile = PluginProfile(
         scheduler_name=raw.get("schedulerName", "tpusched"),
         percentage_of_nodes_to_score=pct)
+    # sharded dispatch core (sched/shards.py): lane count and bind-pool
+    # width. dispatchShards: 1 = classic single loop, 0 = auto;
+    # bindPoolWorkers: 0 = auto (sized relative to the shard count)
+    for yaml_key, attr, lo in (("dispatchShards", "dispatch_shards", 0),
+                               ("bindPoolWorkers", "bind_pool_workers", 0)):
+        if yaml_key in raw:
+            try:
+                v = int(raw[yaml_key])
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"{yaml_key} must be an integer, got {raw[yaml_key]!r}")
+            if v < lo:
+                raise ConfigError(f"{yaml_key} must be >= {lo}, got {v}")
+            setattr(profile, attr, v)
     slo = raw.get("slo", {}) or {}
     if not isinstance(slo, dict):
         raise ConfigError(f"slo must be a mapping, got {type(slo).__name__}")

@@ -186,12 +186,27 @@ def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
 
 def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
     _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig",
-                                   "percentageOfNodesToScore"})
+                                   "percentageOfNodesToScore",
+                                   "dispatchShards", "bindPoolWorkers"})
     name = raw.get("schedulerName") or "tpusched"
     pct = int(raw.get("percentageOfNodesToScore") or 0)
     if not 0 <= pct <= 100:
         raise ConfigError(
             f"profile {name!r}: percentageOfNodesToScore must be 0-100, got {pct}")
+    # sharded dispatch core (sched/shards.py): dispatchShards 1 = classic
+    # single loop (default), 0 = auto-size, N = N pool-partitioned lanes
+    # + a global lane; bindPoolWorkers 0 = auto (sized vs. shard count)
+    try:
+        shards = int(raw.get("dispatchShards", 1))
+        bind_workers = int(raw.get("bindPoolWorkers", 0))
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"profile {name!r}: dispatchShards/bindPoolWorkers must be "
+            f"integers")
+    if shards < 0 or bind_workers < 0:
+        raise ConfigError(
+            f"profile {name!r}: dispatchShards/bindPoolWorkers must be "
+            f">= 0")
     plugins = raw.get("plugins") or {}
     for ep in plugins:
         if ep not in EXTENSION_POINTS:
@@ -233,6 +248,8 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         post_bind=[n for n, _ in wiring["postBind"]],
         plugin_args=args,
         percentage_of_nodes_to_score=pct,
+        dispatch_shards=shards,
+        bind_pool_workers=bind_workers,
     )
 
 

@@ -118,6 +118,27 @@ class PluginProfile:
     # objective.  Config YAML: `slo: {podE2ESeconds, gangBoundSeconds}`.
     slo_pod_e2e_s: float = 2.0
     slo_gang_bound_s: float = 2.0
+    # Sharded dispatch (sched/shards.py, ROADMAP item 1): number of
+    # per-pool dispatch lanes running scheduling cycles concurrently, each
+    # over its pool partition with optimistic conflict resolution on the
+    # cache's per-pool cursors; a serialized global lane handles pods whose
+    # feasible pools span shards (multislice sets, explicit cross-shard
+    # constraints, any fleet with ElasticQuotas).  1 (default) = the
+    # classic single dispatch loop, byte-identical behavior to pre-sharding.
+    # 0 = auto (min(4, cpu count)).  Config YAML: `dispatchShards`.
+    dispatch_shards: int = 1
+    # _BindingPool worker count. 0 = auto, sized relative to the dispatch
+    # shard count (2 workers per lane, floor 4, cap 32) so bind submission
+    # from N concurrent lanes does not become the new serialization point.
+    # Config YAML: `bindPoolWorkers`.
+    bind_pool_workers: int = 0
+
+    def effective_dispatch_shards(self) -> int:
+        """Resolve the auto (0) setting; always >= 1."""
+        if self.dispatch_shards > 0:
+            return self.dispatch_shards
+        import os
+        return max(1, min(4, os.cpu_count() or 1))
 
     def all_plugin_names(self) -> List[str]:
         names: List[str] = [self.queue_sort]
@@ -302,13 +323,40 @@ class Handle:
         self.clock = clock
         self.pod_nominator = PodNominator()
         self._snapshot: Snapshot = Snapshot()
+        # Per-thread snapshot slot for concurrent dispatch lanes (sharded
+        # scheduling runs cycles on several threads at once, each against
+        # its own epoch view); the shared slot above stays as the fallback
+        # for threads that never set one — binding-pool workers and
+        # informer callbacks running Unreserve read the most recent cycle's
+        # view there, exactly as they did pre-sharding.
+        self._snapshot_tls = threading.local()
 
     # Snapshot (updated by the scheduler at cycle start) ----------------------
     def snapshot_shared_lister(self) -> Snapshot:
-        return self._snapshot
+        snap = getattr(self._snapshot_tls, "snap", None)
+        return snap if snap is not None else self._snapshot
 
-    def set_snapshot(self, snap: Snapshot) -> None:
-        self._snapshot = snap
+    # Dispatch scope: '' = fleet-wide candidates (single loop / global
+    # lane), 'partition' = a shard lane's pool-restricted view.  Plugins
+    # whose verdicts are cached process-globally (Coscheduling's
+    # denied-PodGroup window) consult this so a partition-scoped shortfall
+    # is never promoted into a fleet-wide denial — the escalated retry on
+    # the global lane must not be poisoned by its own shard's miss.
+    def dispatch_scope(self) -> str:
+        return getattr(self._snapshot_tls, "scope", "")
+
+    def set_dispatch_scope(self, scope: str) -> None:
+        self._snapshot_tls.scope = scope
+
+    def set_snapshot(self, snap: Snapshot, shared: bool = True) -> None:
+        """``shared=False`` installs the snapshot for THIS thread only —
+        shard lanes use it for their partition-restricted views, which
+        must never become the fallback other threads read (a bind worker
+        resolving another lane's pod would see a world without its
+        node)."""
+        if shared:
+            self._snapshot = snap
+        self._snapshot_tls.snap = snap
 
     # Framework passthroughs --------------------------------------------------
     @property
@@ -587,10 +635,19 @@ class Framework:
                 # upstream prioritizeNodes parallelism
                 # (generic_scheduler.go:426): score nodes concurrently; a
                 # score() must already be safe under the parallel Filter
-                # contract (read-only on shared state / idempotent memos)
-                results = par.map(
-                    lambda i: plugin.score(state, pod, nodes[i].name),
-                    len(nodes))
+                # contract (read-only on shared state / idempotent memos).
+                # Pool workers carry no cycle context, so the CALLING
+                # cycle's snapshot is installed into each worker's thread-
+                # local slot — without this a score() reading the shared
+                # lister on a worker thread would see whatever fallback
+                # snapshot happens to be installed (under sharded dispatch
+                # possibly none at all), not this cycle's epoch view.
+                snap = self.handle.snapshot_shared_lister()
+
+                def score_at(i, _snap=snap, _plugin=plugin):
+                    self.handle.set_snapshot(_snap, shared=False)
+                    return _plugin.score(state, pod, nodes[i].name)
+                results = par.map(score_at, len(nodes))
                 scores = []
                 for n, (val, s) in zip(nodes, results):
                     if not s.is_success():

@@ -57,8 +57,14 @@ class ThroughputTelemetry:
             self._cycles = None
             self._binds = None
             return
-        self._cycles = scheduling_cycles_total.with_labels(scheduler_name)
-        self._binds = binds_total.with_labels(scheduler_name)
+        self._name = scheduler_name
+        # per-shard children, created lazily as lanes first report ('' is
+        # the classic single dispatch loop); the family total over all
+        # shards keeps the pre-sharding meaning of binds/cycles per
+        # scheduler
+        self._cycles = {"": scheduling_cycles_total.with_labels(
+            scheduler_name, "")}
+        self._binds = {"": binds_total.with_labels(scheduler_name, "")}
         esc = escape_label_value(scheduler_name)
         self._labels = f'scheduler="{esc}"' if scheduler_name else ""
         ref = weakref.ref(self)
@@ -90,13 +96,21 @@ class ThroughputTelemetry:
         if self.publish:
             self._arrivals.append(self._clock())
 
-    def on_cycle(self) -> None:
+    def on_cycle(self, shard: str = "") -> None:
         if self.publish:
-            self._cycles.inc()
+            child = self._cycles.get(shard)
+            if child is None:
+                child = self._cycles[shard] = \
+                    scheduling_cycles_total.with_labels(self._name, shard)
+            child.inc()
 
-    def on_bind(self) -> None:
+    def on_bind(self, shard: str = "") -> None:
         if self.publish:
-            self._binds.inc()
+            child = self._binds.get(shard)
+            if child is None:
+                child = self._binds[shard] = \
+                    binds_total.with_labels(self._name, shard)
+            child.inc()
 
     # -- derived -------------------------------------------------------------
 

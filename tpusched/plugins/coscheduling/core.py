@@ -157,7 +157,14 @@ class PodGroupManager:
         nodes = self.handle.snapshot_shared_lister().list()
         err = check_cluster_resource(nodes, min_resources, full)
         if err:
-            self.add_denied_pod_group(full)
+            # partition-scoped cycles (a dispatch shard's pool-restricted
+            # view) must NOT promote their shortfall into the process-
+            # global denied window: "this shard's pools are too small" is
+            # not "the fleet is too small", and the escalated retry on
+            # the global lane would otherwise bounce off its own shard's
+            # verdict for the whole denial TTL
+            if self.handle.dispatch_scope() != "partition":
+                self.add_denied_pod_group(full)
             trace.record_rejection(
                 "Coscheduling", "cluster-capacity dry-run failed",
                 pod_group=full, gap=err,

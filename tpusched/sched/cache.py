@@ -7,14 +7,28 @@ maintained incrementally on every event (upstream's design) so snapshot() is
 a cheap per-node clone, not a rebuild. Assumed pods expire if the bind is
 never confirmed by the API server (watch event), which keeps the scheduler
 restart-safe with annotations-as-truth (SURVEY §5 checkpoint/resume).
+
+Sharded dispatch additions (ROADMAP item 1): every structural mutation is
+attributed to the POOL it touched (``tpu.dev/pool`` of the node involved)
+and bumps a per-pool cursor alongside the global one.  A shard's dispatch
+cycle captures its partition's pool-cursor tuple atomically with the
+snapshot it filters against (``snapshot_view``), and commits its placement
+through the optimistic ``assume_pod_guarded`` compare-and-assume: the
+assume lands only if the chosen pool's cursor is still the one the cycle's
+filters read — a foreign mutation in that pool (an informer event, a
+global-lane bind) fails the compare and the shard retries on fresh state
+instead of binding a stale placement.  Mutations in OTHER pools do not
+conflict: that independence is the whole point of partitioning dispatch by
+pool.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api.core import Node, Pod
 from ..api.scheduling import POD_GROUP_LABEL
+from ..api.topology import LABEL_POOL
 from ..fwk.nodeinfo import NodeInfo, Snapshot
 from ..util import klog
 from ..util.locking import GuardedLock, guarded_by
@@ -22,8 +36,36 @@ from ..util.locking import GuardedLock, guarded_by
 ASSUME_EXPIRATION_S = 30.0
 
 
-@guarded_by("_lock", "_infos", "_pods", "_assumed", "_snap_clones",
-            "_pg_assigned", "_mutation", "_snap_mutation", "_last_snapshot")
+def pool_of_node(node: Node) -> str:
+    """The pool a node's mutations are attributed to.  Unpooled nodes
+    (no ``tpu.dev/pool`` label) share the '' pool — they conflict with
+    each other and with every cycle that places onto unpooled hardware,
+    which is exactly the conservative behavior they need."""
+    return node.meta.labels.get(LABEL_POOL, "")
+
+
+class CacheView:
+    """One cycle's atomically-captured view: the snapshot its filters read,
+    the global cursor that snapshot was built at, and the per-pool cursors
+    at the same instant (restricted to the cycle's partition when one was
+    given — the equivalence-cache validity witness for shard lanes)."""
+
+    __slots__ = ("snapshot", "cursor", "pool_cursors")
+
+    def __init__(self, snapshot: Snapshot, cursor: int,
+                 pool_cursors: Dict[str, int]):
+        self.snapshot = snapshot
+        self.cursor = cursor
+        self.pool_cursors = pool_cursors
+
+    def cursor_tuple(self) -> Tuple[Tuple[str, int], ...]:
+        """Canonical (sorted) form for equivalence-entry validity."""
+        return tuple(sorted(self.pool_cursors.items()))
+
+
+@guarded_by("_lock", "_infos", "_pods", "_assumed", "_node_clones",
+            "_pg_assigned", "_mutation", "_snap_mutation", "_last_snapshot",
+            "_pool_mutation", "_pool_nodes", "_pool_members", "_part_snaps")
 class Cache:
     def __init__(self, clock=time.time):
         self._clock = clock
@@ -31,9 +73,18 @@ class Cache:
         self._infos: Dict[str, NodeInfo] = {}       # node name → live NodeInfo
         self._pods: Dict[str, Pod] = {}             # all known scheduled pods
         self._assumed: Dict[str, float] = {}        # pod key → bind deadline
-        # last snapshot's clones, keyed by (generation) — upstream's
-        # UpdateSnapshot design: only nodes that changed re-clone
-        self._snap_clones: Dict[str, Tuple[int, NodeInfo]] = {}
+        # earliest finite assume deadline (inf = none armed): the expiry
+        # sweep is O(1) until something can actually expire — every
+        # snapshot/view used to scan the whole assume table, which under
+        # N concurrent dispatch lanes turned the cache lock into the
+        # process hot spot and stalled informer ingestion behind it
+        self._next_expiry = float("inf")
+        # per-node snapshot clones keyed by generation — upstream's
+        # UpdateSnapshot design: only nodes that changed re-clone.  Shared
+        # by the full snapshot AND every partition snapshot (a node's
+        # read-only clone is the same object in both), pruned on node
+        # removal.
+        self._node_clones: Dict[str, Tuple[int, NodeInfo]] = {}
         # gang full-name → members attached to a cached node (the Permit
         # quorum input), maintained incrementally at attach/detach so
         # assigned_count never walks the fleet (O(1) per cycle at any scale)
@@ -46,6 +97,54 @@ class Cache:
         self._mutation = 0
         self._snap_mutation = -1
         self._last_snapshot: "Snapshot | None" = None
+        # per-pool change cursors (sharded dispatch): every structural
+        # mutation bumps the cursor of the pool it touched in the same
+        # critical section as the global bump, so a partition's cursor
+        # tuple is an exact witness of "nothing in MY pools changed"
+        self._pool_mutation: Dict[str, int] = {}
+        # pool → live node count (pools() without an O(nodes) walk)
+        self._pool_nodes: Dict[str, int] = {}
+        # bumped only when the pool SET changes (first node of a pool
+        # arrives / last one leaves).  Read LOCK-FREE by dispatch lanes
+        # (GIL-atomic int) to decide whether their partition needs a
+        # recompute: a per-cycle pools() call under the cache lock from N
+        # lanes was, measurably, the process's hottest contention point.
+        self.pools_version = 0
+        # pool → live node-name set: the partition snapshot builder's
+        # iteration domain (a shard rebuilds its view from ITS pools'
+        # nodes only, never walking the fleet)
+        self._pool_members: Dict[str, Dict[str, None]] = {}
+        # partition-snapshot cache: partition (pool tuple) → (the pool-
+        # cursor tuple it was built at, Snapshot).  A shard's epoch view
+        # is rebuilt only when ITS pools mutated — cross-shard traffic
+        # leaves it untouched, which is what keeps N concurrent lanes from
+        # re-cloning the fleet on every foreign assume (the copy-on-write
+        # epoch design of ROADMAP item 1).
+        self._part_snaps: Dict[Tuple[str, ...], Tuple[Tuple, Snapshot]] = {}
+
+    def _bump_locked(self, pool: str) -> None:
+        self._mutation += 1
+        self._pool_mutation[pool] = self._pool_mutation.get(pool, 0) + 1
+
+    def _pool_member_locked(self, pool: str, name: str, delta: int) -> None:
+        if delta > 0:
+            n = self._pool_nodes.get(pool, 0)
+            if n == 0:
+                self.pools_version += 1      # a pool was born
+            self._pool_nodes[pool] = n + 1
+            self._pool_members.setdefault(pool, {})[name] = None
+            return
+        n = self._pool_nodes.get(pool, 0) - 1
+        if n <= 0:
+            self._pool_nodes.pop(pool, None)
+            self.pools_version += 1          # a pool emptied out
+        else:
+            self._pool_nodes[pool] = n
+        members = self._pool_members.get(pool)
+        if members is not None:
+            members.pop(name, None)
+            if not members:
+                self._pool_members.pop(pool, None)
 
     def _pg_adjust_locked(self, pod: Pod, delta: int) -> None:
         name = pod.meta.labels.get(POD_GROUP_LABEL)
@@ -62,11 +161,21 @@ class Cache:
 
     def add_node(self, node: Node) -> None:
         with self._lock:
-            self._mutation += 1
+            pool = pool_of_node(node)
+            self._bump_locked(pool)
             old = self._infos.get(node.name)
             if old is not None:
+                old_pool = pool_of_node(old.node)
+                if old_pool != pool:
+                    # a replacement that MOVED pools dirties both: shards
+                    # on either side of the move must see the change
+                    self._bump_locked(old_pool)
+                    self._pool_member_locked(old_pool, node.name, -1)
+                    self._pool_member_locked(pool, node.name, +1)
                 for p in old.pods:
                     self._pg_adjust_locked(p, -1)
+            else:
+                self._pool_member_locked(pool, node.name, +1)
             info = NodeInfo(node)
             self._infos[node.name] = info
             # attach pods already known to live on this node
@@ -81,7 +190,13 @@ class Cache:
             if info is None:
                 self.add_node(node)
             else:
-                self._mutation += 1
+                pool = pool_of_node(node)
+                old_pool = pool_of_node(info.node)
+                self._bump_locked(pool)
+                if old_pool != pool:
+                    self._bump_locked(old_pool)
+                    self._pool_member_locked(old_pool, node.name, -1)
+                    self._pool_member_locked(pool, node.name, +1)
                 info.set_node(node)
 
     def remove_node(self, node: Node) -> list:
@@ -104,16 +219,24 @@ class Cache:
         Returns the pods that were attached so the caller can reject
         barrier-parked members and requeue the affected gangs."""
         with self._lock:
-            self._mutation += 1
             info = self._infos.pop(node.name, None)
             if info is None:
+                # cursor semantics unchanged: a no-op removal still reads
+                # as a mutation of the named node's pool (callers observed
+                # an event; shards re-validate cheaply)
+                self._bump_locked(pool_of_node(node))
                 return []
+            pool = pool_of_node(info.node)
+            self._bump_locked(pool)
+            self._pool_member_locked(pool, node.name, -1)
+            self._node_clones.pop(node.name, None)
             affected = list(info.pods)
             deadline = self._clock() + ASSUME_EXPIRATION_S
             for p in affected:
                 self._pg_adjust_locked(p, -1)
                 if self._assumed.get(p.key) == float("inf"):
                     self._assumed[p.key] = deadline
+                    self._next_expiry = min(self._next_expiry, deadline)
             return affected
 
 
@@ -122,14 +245,14 @@ class Cache:
     def _attach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None:
-            self._mutation += 1
+            self._bump_locked(pool_of_node(info.node))
             info.add_pod(pod)
             self._pg_adjust_locked(pod, +1)
 
     def _detach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None and info.remove_pod(pod):
-            self._mutation += 1
+            self._bump_locked(pool_of_node(info.node))
             self._pg_adjust_locked(pod, -1)
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
@@ -138,15 +261,62 @@ class Cache:
         *after* assume, and snapshots must see those writes — the chip model
         is rebuilt from annotations (tpuslice/chip_node.py)."""
         with self._lock:
-            pod.spec.node_name = node_name
-            self._pods[pod.key] = pod
-            self._attach_locked(pod)
-            self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
+            self._assume_locked(pod, node_name)
+
+    def _assume_locked(self, pod: Pod, node_name: str) -> None:
+        # replace-don't-stack: an entry already cached under this key (a
+        # watch confirm that raced in, or a re-assume) is detached first —
+        # stacking a second attached copy would double-count the gang's
+        # permit-quorum index (found by the cross-shard-gang-quorum
+        # interleaving scenario)
+        old = self._pods.get(pod.key)
+        if old is not None:
+            self._detach_locked(old)
+        pod.spec.node_name = node_name
+        self._pods[pod.key] = pod
+        self._attach_locked(pod)
+        self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
+
+    def assume_pod_guarded(self, pod: Pod, node_name: str,
+                           expected_pool_cursor: int,
+                           pools: Optional[Sequence[str]] = None):
+        """Optimistic compare-and-assume (sharded dispatch commit point):
+        assume ``pod`` onto ``node_name`` iff the chosen node's POOL cursor
+        still equals ``expected_pool_cursor`` — the value the calling
+        cycle's snapshot_view captured when its filters read the state.
+
+        Returns None (nothing assumed) when the pool saw a foreign
+        mutation since, or when the node itself vanished: the caller must
+        re-derive its placement on fresh state instead of committing a
+        decision computed against a superseded epoch.  Per-node filter
+        outcomes are monotone under foreign ASSUMES in other pools (they
+        only consume resources elsewhere), so the compare is deliberately
+        scoped to the one pool the placement touches — cross-pool traffic
+        never serializes here.
+
+        On success returns the post-assume cursor tuple of ``pools`` (the
+        shard-scoped equivalence arming guard's input, read in the SAME
+        critical section — a separate lock hop per cycle was measurable
+        contention), or an empty tuple when ``pools`` is None."""
+        with self._lock:
+            info = self._infos.get(node_name)
+            if info is None:
+                return None
+            pool = pool_of_node(info.node)
+            if self._pool_mutation.get(pool, 0) != expected_pool_cursor:
+                return None
+            self._assume_locked(pod, node_name)
+            if pools is None:
+                return ()
+            return tuple(sorted(
+                (p, self._pool_mutation.get(p, 0)) for p in pools))
 
     def finish_binding(self, pod: Pod) -> None:
         with self._lock:
             if pod.key in self._assumed:
-                self._assumed[pod.key] = self._clock() + ASSUME_EXPIRATION_S
+                deadline = self._clock() + ASSUME_EXPIRATION_S
+                self._assumed[pod.key] = deadline
+                self._next_expiry = min(self._next_expiry, deadline)
 
     def forget_pod(self, pod: Pod) -> None:
         with self._lock:
@@ -181,7 +351,11 @@ class Cache:
             return pod_key in self._assumed
 
     def _cleanup_expired_locked(self) -> None:
+        if self._next_expiry == float("inf") \
+                or self._clock() < self._next_expiry:
+            return                      # O(1) on the hot path
         now = self._clock()
+        nxt = float("inf")
         for key, deadline in list(self._assumed.items()):
             if deadline < now:
                 klog.warning_s("assumed pod expired without bind confirmation",
@@ -190,34 +364,90 @@ class Cache:
                 old = self._pods.pop(key, None)
                 if old is not None:
                     self._detach_locked(old)
+            else:
+                nxt = min(nxt, deadline)
+        self._next_expiry = nxt
 
     # -- snapshot -------------------------------------------------------------
 
-    def snapshot(self) -> Snapshot:
+    def _clone_of_locked(self, name: str, info: NodeInfo) -> NodeInfo:
+        ent = self._node_clones.get(name)
+        if ent is None or ent[0] != info.generation:
+            ent = (info.generation, info.clone())
+            self._node_clones[name] = ent
+        return ent[1]
+
+    def _snapshot_locked(self) -> Snapshot:
         """Incremental (upstream cache.UpdateSnapshot): a node's clone from
         the previous snapshot is reused while its generation is unchanged.
         Safe because snapshot NodeInfos are read-only by contract — every
         mutation path (preemption dry-runs, nominated-pod evaluation) clones
         first (sched/preemption.py:129-130, fwk/runtime.py:309-312)."""
+        self._cleanup_expired_locked()
+        if (self._mutation == self._snap_mutation
+                and self._last_snapshot is not None):
+            return self._last_snapshot
+        infos = {name: self._clone_of_locked(name, info)
+                 for name, info in self._infos.items()}
+        snap = Snapshot.from_infos(infos, dict(self._pg_assigned))
+        self._snap_mutation = self._mutation
+        self._last_snapshot = snap
+        return snap
+
+    def snapshot(self) -> Snapshot:
         with self._lock:
+            return self._snapshot_locked()
+
+    def snapshot_view(self,
+                      pools: Optional[Sequence[str]] = None) -> CacheView:
+        """Epoch view for one dispatch cycle: a snapshot plus the per-pool
+        cursors it was built at, read in ONE critical section so the
+        cursors are an exact witness of the state the cycle's filters see.
+
+        ``pools`` = a shard's partition: the returned snapshot holds ONLY
+        those pools' nodes — plugins sweeping the shared lister
+        (TopologyMatch's window search, Coscheduling's capacity dry-run)
+        are structurally restricted to the shard's world, which is where
+        the per-cycle cost reduction sharding exists for actually lands.
+        Gang quorum accounting stays fleet-global (the pg-assigned index
+        rides in whole).  The partition snapshot is cached against its
+        pool-cursor tuple and REBUILT ONLY when the partition's own pools
+        mutated; per-node clones are shared with the full snapshot, so a
+        rebuild clones only nodes that changed since any view saw them.
+
+        ``pools=None`` is the global lane's view: the full fleet snapshot
+        plus every pool cursor."""
+        with self._lock:
+            if pools is None:
+                snap = self._snapshot_locked()
+                return CacheView(snap, self._snap_mutation,
+                                 dict(self._pool_mutation))
             self._cleanup_expired_locked()
-            if (self._mutation == self._snap_mutation
-                    and self._last_snapshot is not None):
-                return self._last_snapshot
-            prev = self._snap_clones
-            clones: Dict[str, Tuple[int, NodeInfo]] = {}
+            cursors = {p: self._pool_mutation.get(p, 0) for p in pools}
+            key = tuple(pools)
+            sig = tuple(sorted(cursors.items()))
+            ent = self._part_snaps.get(key)
+            if ent is not None and ent[0] == sig:
+                return CacheView(ent[1], self._mutation, cursors)
             infos: Dict[str, NodeInfo] = {}
-            for name, info in self._infos.items():
-                ent = prev.get(name)
-                if ent is None or ent[0] != info.generation:
-                    ent = (info.generation, info.clone())
-                clones[name] = ent
-                infos[name] = ent[1]
-            self._snap_clones = clones
-            snap = Snapshot.from_infos(infos, dict(self._pg_assigned))
-            self._snap_mutation = self._mutation
-            self._last_snapshot = snap
-            return snap
+            for p in pools:
+                for name in self._pool_members.get(p, ()):
+                    infos[name] = self._clone_of_locked(
+                        name, self._infos[name])
+            # the gang-quorum index rides in LIVE (by reference, not a
+            # frozen copy): gang assignments land in pools OUTSIDE this
+            # partition (escalated siblings, pool-pinned members) without
+            # bumping the partition's cursors, and a frozen copy would
+            # serve Coscheduling's permit barrier stale quorum counts for
+            # as long as the cached view is reused.  Reads are single-key
+            # dict gets (GIL-atomic against the locked writers), and
+            # live-is-fresher is exactly what admission wants — the
+            # quorum clock is shard-agnostic process state by design.
+            snap = Snapshot.from_infos(infos, self._pg_assigned)
+            if len(self._part_snaps) > 64:   # partition churn backstop
+                self._part_snaps.clear()
+            self._part_snaps[key] = (sig, snap)
+            return CacheView(snap, self._mutation, cursors)
 
     def peek_snapshot(self) -> "Snapshot | None":
         """Read-only view of the LAST snapshot the scheduling loop built —
@@ -236,6 +466,12 @@ class Cache:
         with self._lock:
             return list(self._infos)
 
+    def pools(self) -> List[str]:
+        """Sorted names of pools with at least one live node — the shard
+        topology's partitioning input."""
+        with self._lock:
+            return sorted(self._pool_nodes)
+
     # -- mutation cursor (equivalence-cache validity witness) -----------------
 
     def mutation_cursor(self) -> int:
@@ -251,3 +487,19 @@ class Cache:
         only when an informer event raced in after snapshot()."""
         with self._lock:
             return self._snap_mutation
+
+    def pool_cursor(self, pool: str) -> int:
+        """Current cursor of one pool (the sharded commit protocol's
+        compare key; captured atomically via snapshot_view)."""
+        with self._lock:
+            return self._pool_mutation.get(pool, 0)
+
+    def pool_cursors(self,
+                     pools: Sequence[str]) -> Tuple[Tuple[str, int], ...]:
+        """Canonical cursor tuple for a partition — the shard-scoped
+        equivalence-cache arming guard reads this right after its own
+        guarded assume to verify the chain "my partition advanced by
+        EXACTLY my own attach"."""
+        with self._lock:
+            return tuple(sorted(
+                (p, self._pool_mutation.get(p, 0)) for p in pools))

@@ -60,8 +60,9 @@ DEFAULT_CAPACITY = 256
 
 
 class EquivEntry:
-    __slots__ = ("key", "armed_mutation", "nominator_gen", "fingerprints",
-                 "prefilter_data", "skip_filter", "restricted", "feasible")
+    __slots__ = ("key", "armed_mutation", "armed_pool_cursors",
+                 "nominator_gen", "fingerprints", "prefilter_data",
+                 "skip_filter", "restricted", "feasible")
 
     def __init__(self, key: Hashable, fingerprints: Tuple,
                  nominator_gen: int, prefilter_data: Dict,
@@ -70,6 +71,13 @@ class EquivEntry:
                  feasible: Tuple[str, ...]):
         self.key = key
         self.armed_mutation = -1          # set by arm(); -1 never matches
+        # Shard-lane validity witness (sharded dispatch): the partition's
+        # ((pool, cursor), ...) tuple at arming.  A shard's entry stays
+        # valid while ITS pools are untouched — foreign assumes in other
+        # shards' pools no longer break the chain the way any global-cursor
+        # advance does on the single-lane protocol.  None on single-lane
+        # entries (they use armed_mutation).
+        self.armed_pool_cursors: Optional[Tuple] = None
         self.nominator_gen = nominator_gen
         self.fingerprints = fingerprints
         self.prefilter_data = prefilter_data
@@ -96,11 +104,15 @@ class EquivalenceCache:
     def drop(self, key: Hashable) -> None:
         self._entries.pop(key, None)
 
-    def arm(self, entry: EquivEntry, mutation_cursor: int) -> None:
+    def arm(self, entry: EquivEntry, mutation_cursor: int,
+            pool_cursors: Optional[Tuple] = None) -> None:
         """(Re)arm ``entry`` as valid exactly at ``mutation_cursor`` and
         (re)insert it. The caller has verified the cursor advanced by
-        exactly its own assume since the state the entry describes."""
+        exactly its own assume since the state the entry describes.
+        ``pool_cursors``: the partition cursor tuple for shard-lane
+        entries (their validity witness instead of the global cursor)."""
         entry.armed_mutation = mutation_cursor
+        entry.armed_pool_cursors = pool_cursors
         self._entries[entry.key] = entry
         self._entries.move_to_end(entry.key)
         while len(self._entries) > self._capacity:

@@ -261,6 +261,18 @@ class SchedulingQueue:
             info.timestamp = self._clock()
             self._unschedulable[key] = info
 
+    def push_active(self, info: QueuedPodInfo) -> None:
+        """Inject an in-flight QueuedPodInfo straight into activeQ
+        (attempt count and first-enqueue timestamp preserved).  The
+        sharded dispatcher's escalation hop: a pod whose shard-restricted
+        cycle came up empty re-enters the GLOBAL lane immediately — no
+        backoff, no waiting for a cluster event that may never describe
+        "another shard had room"."""
+        with self._lock:
+            info.timestamp = self._clock()
+            self._active.push(info)
+            self._lock.notify_all()
+
     def requeue_after_failure(self, info: QueuedPodInfo,
                               to_backoff: bool = False,
                               delay_s: Optional[float] = None) -> None:
@@ -333,11 +345,22 @@ class SchedulingQueue:
         next pop cycle or any observer read — so a gang-sized informer storm
         costs one scan instead of one per member. Merging actions is exact:
         ClusterEvent.matches tests bitmask overlap, i.e. "some buffered
-        event would have unstuck this pod"."""
+        event would have unstuck this pod".
+
+        Nothing-parked notify suppression: the event is ALWAYS buffered
+        (a pod whose failing cycle is in flight right now parks after
+        this event and must still be moved at the buffer's next drain —
+        the pre-existing at-least-once contract), but when no pod is
+        parked the notify is skipped: the consumer's own pop poll
+        (≤0.2 s) drains the buffer soon enough for a parked-later pod,
+        and under sharded dispatch a notify_all per event per lane wakes
+        N idle dispatch workers into a GIL stampede that costs more than
+        the scheduling work itself."""
         with self._lock:
             self._pending_moves[resource] = \
                 self._pending_moves.get(resource, 0) | action
-            self._lock.notify_all()
+            if self._unschedulable or self._backoff_keys:
+                self._lock.notify_all()
 
     def _apply_pending_moves_locked(self) -> None:
         if not self._pending_moves:
@@ -447,3 +470,139 @@ class SchedulingQueue:
             out += [i.pod for (_, _, i) in self._backoff if i is not None]
             out += [i.pod for i in self._unschedulable.values()]
             return out
+
+
+class ShardedQueues:
+    """Per-lane SchedulingQueue fan-out for the sharded dispatch core
+    (sched/shards.py): one full SchedulingQueue per dispatch lane — the
+    shard lanes plus the serialized global lane — behind the exact
+    producer/observer surface the single queue exposes, so the scheduler's
+    informer wiring, watchdog, gauges and failure paths are lane-agnostic.
+
+    Routing happens at the producer boundary: ``add`` and
+    ``requeue_after_failure`` ask the injected ``route(pod) -> lane``
+    (sched/shards.ShardRouter) where the pod belongs NOW — escalations and
+    quota-mode flips change a pod's lane between attempts, and re-routing
+    on every (re)enqueue is what carries the pod across.  Broadcast
+    operations (cluster-event moves, activation, update, delete) fan out
+    to every lane: each inner call is O(1)-ish when the pod is absent, and
+    lane count is single digits.  A pod lives in at most one lane at a
+    time because every enqueue path routes first.
+
+    Consumers pop from THEIR lane only (``pop(lane, ...)``); each lane
+    keeps the single queue's full semantics — gang-sibling pop preference,
+    coalesced moves, backoff, periodic flush."""
+
+    def __init__(self, lanes: List[str], make_queue, route):
+        self._order = list(lanes)
+        self._queues: Dict[str, SchedulingQueue] = {
+            lane: make_queue() for lane in lanes}
+        self._route = route
+        # pod key → lane last enqueued into: update/delete touch ONE
+        # lane's lock instead of broadcasting across all of them — the
+        # informer fan-out (which runs pod deletes inline on the watch
+        # thread) must not pay lane-count × lock hops per event.  GIL-
+        # atomic dict ops; a racy read at worst falls back to broadcast.
+        self._where: Dict[str, str] = {}
+        self._closed = False
+
+    # -- producers (routed) ---------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        lane = self._route(pod)
+        self._where[pod.key] = lane
+        self._queues[lane].add(pod)
+
+    def requeue_after_failure(self, info: QueuedPodInfo,
+                              to_backoff: bool = False,
+                              delay_s: Optional[float] = None) -> None:
+        lane = self._route(info.pod)
+        self._where[info.pod.key] = lane
+        self._queues[lane].requeue_after_failure(
+            info, to_backoff=to_backoff, delay_s=delay_s)
+
+    def push_active(self, info: QueuedPodInfo, lane: str) -> None:
+        """Escalation / re-route hop: inject straight into ``lane``'s
+        activeQ."""
+        self._where[info.pod.key] = lane
+        self._queues[lane].push_active(info)
+
+    # -- keyed (single-lane via the location map) -----------------------------
+
+    def update(self, pod: Pod) -> None:
+        lane = self._where.get(pod.key)
+        if lane is not None:
+            self._queues[lane].update(pod)
+            return
+        for q in self._queues.values():
+            q.update(pod)
+
+    def delete(self, pod: Pod) -> None:
+        lane = self._where.pop(pod.key, None)
+        if lane is not None:
+            self._queues[lane].delete(pod)
+            return
+        for q in self._queues.values():
+            q.delete(pod)
+
+    def activate(self, pods: List[Pod]) -> None:
+        for q in self._queues.values():
+            q.activate(pods)
+
+    def move_all_to_active_or_backoff(self, resource: str,
+                                      action: int) -> None:
+        for q in self._queues.values():
+            q.move_all_to_active_or_backoff(resource, action)
+
+    def close(self) -> None:
+        self._closed = True
+        for q in self._queues.values():
+            q.close()
+
+    # -- consumers ------------------------------------------------------------
+
+    def pop(self, timeout: Optional[float] = None,
+            lane: Optional[str] = None) -> Optional[QueuedPodInfo]:
+        """Pop from one lane.  ``lane=None`` (compatibility callers:
+        tests driving cycles by hand) serves the first non-empty lane;
+        like the single queue, ``timeout=None`` blocks until a pod
+        arrives or the queues close."""
+        if lane is not None:
+            return self._queues[lane].pop(timeout=timeout)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            for name in self._order:
+                info = self._queues[name].pop(timeout=0)
+                if info is not None:
+                    return info
+            if self._closed:
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    # -- introspection --------------------------------------------------------
+
+    def lanes(self) -> List[str]:
+        return list(self._order)
+
+    def lane_queue(self, lane: str) -> SchedulingQueue:
+        return self._queues[lane]
+
+    def pending_counts(self) -> Dict[str, int]:
+        total = {"active": 0, "backoff": 0, "unschedulable": 0}
+        for q in self._queues.values():
+            for k, v in q.pending_counts().items():
+                total[k] += v
+        return total
+
+    def pending_counts_by_lane(self) -> Dict[str, Dict[str, int]]:
+        return {lane: q.pending_counts()
+                for lane, q in self._queues.items()}
+
+    def pending_pods(self) -> List[Pod]:
+        out: List[Pod] = []
+        for name in self._order:
+            out.extend(self._queues[name].pending_pods())
+        return out

@@ -38,11 +38,14 @@ from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_invalidations, equiv_cache_misses,
                             equiv_cache_vetoes, extension_point_seconds,
                             gang_bind_rollbacks, gang_stuck_total,
-                            queue_wait_seconds, schedule_attempts)
+                            queue_wait_seconds, schedule_attempts,
+                            shard_conflicts_total, shard_escalations_total)
 from ..util.podutil import assigned
-from .cache import Cache
+from .cache import Cache, CacheView, pool_of_node
 from .equivcache import EquivalenceCache, EquivEntry
-from .queue import QueuedPodInfo, SchedulingQueue
+from .queue import QueuedPodInfo, SchedulingQueue, ShardedQueues
+from .shards import (GLOBAL_LANE, ShardRouter, ShardStats, shard_lane,
+                     unit_key_of)
 
 # CycleState keys the equivalence cache must NOT memoize: per-cycle
 # scheduler plumbing, re-created fresh by every cycle.
@@ -65,6 +68,50 @@ GANG_ROLLBACK_PLUGIN = "GangBindRollback"
 # task (permit dispatch → Bind is bounded by the bind pool's own drain
 # timeout); lazily pruned on the next rollback.
 _GANG_ABORT_TTL_S = 60.0
+
+# Sharded dispatch: a cycle whose optimistic commit is refused (foreign
+# mutation raced the chosen pool between snapshot and assume) re-derives
+# on fresh state this many times before conceding the attempt to backoff.
+# Conflicts need a mutation in the SAME pool inside a sub-millisecond
+# window, so 1-2 retries resolve essentially all of them.
+_MAX_CONFLICT_RETRIES = 4
+
+
+class _LaneContext:
+    """One dispatch lane's mutable cycle-local state.
+
+    The pre-sharding scheduler kept these as Scheduler attributes because
+    exactly one thread dispatched; with N concurrent lanes each worker
+    owns a context instead — the per-lane equivalence cache (confined to
+    its worker thread, like the old one was confined to scheduleOne), the
+    pending-arm slot, and the rotating sweep start index.  The default
+    context (single-loop configs, by-hand ``schedule_one`` callers in
+    tests, and the sharded core's GLOBAL lane) behaves exactly like the
+    pre-sharding scheduler: unrestricted candidates, global-cursor
+    equivalence arming."""
+
+    __slots__ = ("lane", "pools_scoped", "equiv_cache", "equiv_pending",
+                 "next_start_node_index", "partition_pools",
+                 "partition_sig", "thread", "queue_wait")
+
+    def __init__(self, lane: str, pools_scoped: bool,
+                 equiv_cache: Optional[EquivalenceCache],
+                 telemetry: bool = True):
+        self.lane = lane                      # "" | "s<N>" | "global"
+        self.pools_scoped = pools_scoped      # True only for shard lanes
+        self.equiv_cache = equiv_cache
+        self.equiv_pending: Optional[tuple] = None
+        self.next_start_node_index = 0
+        # partition cache, refreshed when the fleet's pool set changes
+        self.partition_pools: Optional[List[str]] = None
+        self.partition_sig: Optional[tuple] = None
+        self.thread: Optional[threading.Thread] = None
+        # per-lane queue-wait histogram child, resolved once — the vec's
+        # child lookup takes a process-wide lock and this is per-cycle.
+        # Shadows resolve none: even an unobserved child registers a
+        # series in the process-global family
+        self.queue_wait = queue_wait_seconds.with_labels(lane) \
+            if telemetry else None
 
 
 class _DegradedMode:
@@ -499,11 +546,39 @@ class Scheduler:
         else:
             self._fleet = obs_mod.FleetTraceRecorder()
             self._goodput = obs_mod.GoodputAggregator(publish=False)
-        self.queue = SchedulingQueue(
-            self._fw.less, cluster_event_map, clock,
-            initial_backoff_s=profile.pod_initial_backoff_s,
-            max_backoff_s=profile.pod_max_backoff_s,
-            arrival_cb=self._throughput.on_arrival)
+        # Sharded dispatch core (sched/shards.py, ROADMAP item 1): N
+        # per-pool dispatch lanes plus a serialized global lane, each
+        # lane a full SchedulingQueue behind one routed facade.  shards=1
+        # keeps the classic single queue + single loop byte-for-byte.
+        self._shards_n = profile.effective_dispatch_shards()
+        self._sharded = self._shards_n > 1
+        pg_informer = self.informer_factory.informer(srv.POD_GROUPS)
+        self._router = ShardRouter(self._shards_n,
+                                   pg_lookup=pg_informer.get)
+        # quota mode: any ElasticQuota serializes dispatch through the
+        # global lane (cross-pool admission state; see shards.py) — seeded
+        # from the informer's current view, maintained by the quota
+        # handlers wired below
+        self._router.set_quota_mode(bool(
+            self.informer_factory.informer(srv.ELASTIC_QUOTAS).items()))
+
+        def make_lane_queue() -> SchedulingQueue:
+            return SchedulingQueue(
+                self._fw.less, cluster_event_map, clock,
+                initial_backoff_s=profile.pod_initial_backoff_s,
+                max_backoff_s=profile.pod_max_backoff_s,
+                arrival_cb=self._throughput.on_arrival)
+
+        if self._sharded:
+            self._lanes = [shard_lane(i) for i in range(self._shards_n)] \
+                + [GLOBAL_LANE]
+            self.queue = ShardedQueues(self._lanes, make_lane_queue,
+                                       self._router.lane_for)
+        else:
+            self._lanes = []
+            self.queue = make_lane_queue()
+        self._shard_stats = ShardStats(self._lanes) if self._sharded \
+            else None
         # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
         # computed at scrape time from the live queue. weakref: the global
         # registry must not keep a stopped scheduler (and everything it
@@ -551,10 +626,10 @@ class Scheduler:
                 "API retry exhaustions.", labels=sched_label.rstrip(","))
 
         # adaptive node sampling (upstream percentageOfNodesToScore):
-        # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; round-robin
-        # start index spreads scan load across cycles
+        # profile value 0 ⇒ adaptive 50 - nodes/125, floor 5%; the
+        # round-robin start index that spreads scan load across cycles
+        # lives per dispatch lane (_LaneContext.next_start_node_index)
         self.percentage_of_nodes_to_score = profile.percentage_of_nodes_to_score
-        self._next_start_node_index = 0
 
         # per-node Filter/Score parallelism (upstream parallelism=16); the
         # pool is shared by the filter sweep and the score pass
@@ -564,15 +639,29 @@ class Scheduler:
 
         # Equivalence-class scheduling cache (sched/equivcache.py): gang
         # siblings popped back-to-back skip straight to Score over the
-        # memoized feasible set. Touched only by the scheduleOne thread.
-        self._equiv_cache: Optional[EquivalenceCache] = (
-            EquivalenceCache() if profile.equiv_cache else None)
+        # memoized feasible set. One instance per dispatch lane, each
+        # confined to its worker thread (the pre-sharding cache was
+        # confined to the one scheduleOne thread the same way).
         self._equiv_differential = profile.equiv_cache_differential
-        # (entry, cycle cursor) awaiting arming: set by the cycle that built
-        # or reused the entry, consumed right after assume_pod — the only
-        # point where "the cursor advanced by EXACTLY my own attach" can be
-        # verified.
-        self._equiv_pending: Optional[tuple] = None
+
+        def make_equiv() -> Optional[EquivalenceCache]:
+            return EquivalenceCache() if profile.equiv_cache else None
+
+        # The default context doubles as the sharded core's GLOBAL lane:
+        # unrestricted candidates, global-cursor equivalence arming —
+        # i.e. exactly the pre-sharding dispatch semantics.  Shard lanes
+        # get pool-scoped contexts (partition-restricted candidates,
+        # pool-cursor-tuple arming).
+        self._ctx_default = _LaneContext(
+            GLOBAL_LANE if self._sharded else "", False, make_equiv(),
+            telemetry=telemetry)
+        self._contexts: Dict[str, _LaneContext] = \
+            {self._ctx_default.lane: self._ctx_default}
+        if self._sharded:
+            for i in range(self._shards_n):
+                lane = shard_lane(i)
+                self._contexts[lane] = _LaneContext(lane, True, make_equiv(),
+                                                    telemetry=telemetry)
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
@@ -581,7 +670,14 @@ class Scheduler:
         # parked thread per member. A 256-pod gang therefore costs zero
         # binding threads while waiting and at most pool-width while
         # draining, instead of 256 spawns + 256 blocked stacks per gang.
-        self._bind_pool = _BindingPool(max(4, min(16, os.cpu_count() or 4)))
+        # Worker count is profile-configurable and sized relative to the
+        # dispatch shard count (N concurrent lanes submit binds; a pool
+        # sized for one lane would become the new serialization point).
+        workers = profile.bind_pool_workers
+        if workers <= 0:
+            workers = min(32, max(4, min(16, os.cpu_count() or 4),
+                                  2 * self._shards_n))
+        self._bind_pool = _BindingPool(workers)
         # bind-pool backlog gauge (weakref: the registry must not keep a
         # stopped scheduler's pool alive; a dead ref prunes the series)
         pool_ref = weakref.ref(self._bind_pool)
@@ -622,6 +718,20 @@ class Scheduler:
                 and self._sched_thread.is_alive()
                 and not self._stop.is_set())
 
+    @property
+    def dispatch_shards(self) -> int:
+        return self._shards_n
+
+    def shard_router(self) -> ShardRouter:
+        return self._router
+
+    @property
+    def _next_start_node_index(self) -> int:
+        """Introspection compatibility: the default lane's rotating sweep
+        start (pre-sharding this was a Scheduler attribute; it now lives
+        per dispatch lane in _LaneContext)."""
+        return self._ctx_default.next_start_node_index
+
     # -- informer wiring ------------------------------------------------------
 
     def _responsible(self, pod: Pod) -> bool:
@@ -642,10 +752,19 @@ class Scheduler:
         for kind in (srv.POD_GROUPS, srv.ELASTIC_QUOTAS, srv.TPU_TOPOLOGIES):
             res = _KIND_TO_RESOURCE[kind]
             self.informer_factory.informer(kind).add_event_handler(
-                on_add=lambda o, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_ADD),
-                on_update=lambda o, n, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_UPDATE),
-                on_delete=lambda o, r=res: self.queue.move_all_to_active_or_backoff(r, EVENT_DELETE),
+                on_add=lambda o, r=res: self._on_cr_event(r, EVENT_ADD),
+                on_update=lambda o, n, r=res: self._on_cr_event(r, EVENT_UPDATE),
+                on_delete=lambda o, r=res: self._on_cr_event(r, EVENT_DELETE),
                 replay=False)
+
+    def _on_cr_event(self, resource: str, action: int) -> None:
+        if resource == RESOURCE_ELASTIC_QUOTA:
+            # quota presence flips the shard router's serialization mode
+            # (cross-pool admission state — see sched/shards.py); recount
+            # from the informer view so add/add/delete sequences converge
+            self._router.set_quota_mode(bool(
+                self.informer_factory.informer(srv.ELASTIC_QUOTAS).items()))
+        self.queue.move_all_to_active_or_backoff(resource, action)
 
     def _on_pod_add(self, pod: Pod) -> None:
         if assigned(pod):
@@ -760,10 +879,26 @@ class Scheduler:
     # -- lifecycle ------------------------------------------------------------
 
     def run(self) -> None:
-        self._sched_thread = threading.Thread(target=self._loop,
-                                              name="tpusched-scheduleOne",
-                                              daemon=True)
-        self._sched_thread.start()
+        if not self._sharded:
+            self._sched_thread = threading.Thread(
+                target=self._loop, args=(self._ctx_default,),
+                name="tpusched-scheduleOne", daemon=True)
+            self._sched_thread.start()
+            return
+        # one dispatch worker per lane; thread names carry the lane id so
+        # /debug/profile attribution rows name the shard (the profiler's
+        # thread labels keep the -s<N>/-global suffix — only plain numeric
+        # suffixes are folded)
+        for lane, ctx in self._contexts.items():
+            t = threading.Thread(target=self._loop, args=(ctx,),
+                                 name=f"tpusched-dispatch-{lane}",
+                                 daemon=True)
+            ctx.thread = t
+            t.start()
+        # the global lane doubles as the housekeeping thread (watchdog,
+        # shard-health publishing) and stands in for "the" loop thread in
+        # the readiness property
+        self._sched_thread = self._ctx_default.thread
 
     def stop(self) -> None:
         self._stop.set()
@@ -774,7 +909,13 @@ class Scheduler:
         # the (failing) binding tasks, which the pool drains before exit
         self._fw.iterate_over_waiting_pods(
             lambda wp: wp.reject("", "scheduler shutting down"))
-        if self._sched_thread:
+        if self._sharded:
+            deadline = time.monotonic() + 5.0
+            for ctx in self._contexts.values():
+                if ctx.thread is not None:
+                    ctx.thread.join(timeout=max(
+                        0.1, deadline - time.monotonic()))
+        elif self._sched_thread:
             self._sched_thread.join(timeout=5)
         self._bind_pool.shutdown(timeout=5.0)
         self._par.close()
@@ -785,26 +926,40 @@ class Scheduler:
         # a live server)
         self.informer_factory.close()
 
-    def _loop(self) -> None:
+    def _loop(self, ctx: _LaneContext) -> None:
+        housekeeping = ctx is self._ctx_default
+        last_health = 0.0
         while not self._stop.is_set():
-            # the watchdog sweeps BEFORE the degraded-mode gate: during an
-            # apiserver outage stuck gangs must stay visible (health entry,
-            # pinned anomalies) and their stall clocks must keep running —
-            # the sweep touches only local state (cache snapshot, queue,
-            # waiting pods), never the API
-            self._watchdog.sweep()
+            if housekeeping:
+                # the watchdog sweeps BEFORE the degraded-mode gate: during
+                # an apiserver outage stuck gangs must stay visible (health
+                # entry, pinned anomalies) and their stall clocks must keep
+                # running — the sweep touches only local state (cache
+                # snapshot, queue, waiting pods), never the API.  Sharded:
+                # exactly one lane (global) runs housekeeping; the sweep's
+                # state was never built for concurrent writers.
+                self._watchdog.sweep()
+                if self._sharded:
+                    now = time.monotonic()
+                    if now - last_health >= 1.0:
+                        last_health = now
+                        self._publish_shard_health()
             # degraded mode: pausing the pop IS the backoff — failed cycles
             # against a dead apiserver would only re-queue themselves
             pause = self._degraded.pause_remaining()
             if pause > 0:
                 self._stop.wait(min(pause, 0.5))
                 continue
-            self._degraded.maybe_expire()
-            info = self.queue.pop(timeout=0.5)
+            if housekeeping:
+                self._degraded.maybe_expire()
+            if self._sharded:
+                info = self.queue.pop(timeout=0.5, lane=ctx.lane)
+            else:
+                info = self.queue.pop(timeout=0.5)
             if info is None:
                 continue
             try:
-                self.schedule_one(info)
+                self.schedule_one(info, ctx)
             except Exception as e:
                 klog.error_s(e, "scheduleOne panicked", pod=info.pod.key)
                 try:
@@ -816,6 +971,30 @@ class Scheduler:
                                  pod=info.pod.key)
                     self.queue.requeue_after_failure(info, to_backoff=True)
 
+    def _publish_shard_health(self) -> None:
+        """health.shards for /debug/flightrecorder: per-lane cycle/bind/
+        conflict/escalation counters, queue depths and partition sizes —
+        the hot/starved-shard diagnosis surface (doc/ops.md)."""
+        try:
+            # keep the FULL snapshot fresh too: shard-lane cycles build
+            # partition views only, so without this tick peek_snapshot()
+            # readers (the /metrics capacity collector) would freeze
+            # whenever the watchdog is disabled and no global-lane cycle
+            # runs
+            self.cache.snapshot()
+            pools = self.cache.pools()
+            partitions = {lane: len(self._router.partition(pools, lane))
+                          for lane in self._lanes}
+            state = self._shard_stats.snapshot(
+                queue_depths=self.queue.pending_counts_by_lane(),
+                partitions=partitions)
+            state["quota_mode"] = self._router.quota_mode()
+            state["escalations_total"] = self._router.escalations()
+            self.recorder.set_health("shards", state)
+        except Exception as e:  # noqa: BLE001 — health publishing is
+            # advisory; a reporting bug must not take a dispatch lane down
+            klog.V(4).info_s("shard health publish failed", err=str(e))
+
     # -- one scheduling cycle -------------------------------------------------
 
     def _live_pod(self, key: str) -> Optional[Pod]:
@@ -824,12 +1003,19 @@ class Scheduler:
         the API). Immune to the two failure shapes a live API read has —
         transient unavailability burning a scheduling attempt, and the
         stale-NotFound race that would make the scheduler silently DROP a
-        pod that still exists (the chaos soak's C1). Returns an owned
-        deepcopy (callers mutate status fields) or None."""
-        live = self.informer_factory.pods().get(key)
-        return live.deepcopy() if live is not None else None
+        pod that still exists (the chaos soak's C1).
 
-    def schedule_one(self, info: QueuedPodInfo) -> None:
+        Returns the informer-SHARED object: read-only by the informer
+        contract (the queue already holds these shared objects via
+        _on_pod_add).  The cycle's one owned copy is made at assume time;
+        the rare mutation site (_run_post_filter's nomination) copies for
+        itself.  A deepcopy per popped pod was a measurable slice of the
+        per-cycle budget under sharded dispatch."""
+        return self.informer_factory.pods().get(key)
+
+    def schedule_one(self, info: QueuedPodInfo,
+                     ctx: Optional[_LaneContext] = None) -> None:
+        ctx = ctx or self._ctx_default
         pod = info.pod
         # skip pods deleted/bound while queued
         live = self._live_pod(pod.key)
@@ -839,13 +1025,27 @@ class Scheduler:
             return
         pod = live
         info.pod = live
+        if self._sharded:
+            # lane drift: the pod's unit was escalated by a sibling, quota
+            # mode flipped, or an escalation TTL lapsed since this pod was
+            # enqueued — hand it to the lane that owns it NOW instead of
+            # scheduling it under the wrong restriction.  pop() charged an
+            # attempt for a cycle that never ran; give it back so backoff
+            # ladders stay exact.
+            target = self._router.lane_for(pod)
+            if target != ctx.lane:
+                info.attempts = max(0, info.attempts - 1)
+                self.queue.push_active(info, target)
+                return
         start = self.clock()
         # global counters are live-fleet data: shadow trials (what-if,
         # defrag) must not inflate them with simulated cycles
         if self._telemetry:
             schedule_attempts.inc()
-            self._throughput.on_cycle()
-            queue_wait_seconds.observe(max(0.0, start - info.timestamp))
+            self._throughput.on_cycle(ctx.lane)
+            ctx.queue_wait.observe(max(0.0, start - info.timestamp))
+        if self._shard_stats is not None:
+            self._shard_stats.on_cycle(ctx.lane)
         # flight recorder: one cycle trace per attempt, active on this
         # thread (klog/Events correlate via the id) until the cycle either
         # resolves or parks at the permit barrier; committed to the ring
@@ -853,10 +1053,11 @@ class Scheduler:
         tr = None
         if trace.enabled():
             tr = self.recorder.begin_cycle(
-                pod, info, start, scheduler=self.profile.scheduler_name)
+                pod, info, start, scheduler=self.profile.scheduler_name,
+                shard=ctx.lane)
         token = trace.activate(tr)
         try:
-            self._schedule_cycle(info, pod, tr, start)
+            self._schedule_cycle(info, pod, tr, start, ctx)
         except Exception as e:
             if tr is not None:
                 tr.add_anomaly("cycle_panic", error=str(e))
@@ -873,34 +1074,156 @@ class Scheduler:
                     now=self.clock())
             trace.deactivate(token)
 
+    def _refresh_partition(self, ctx: _LaneContext) -> None:
+        """Rebuild the lane's pool partition when the fleet's pool SET
+        changed (pool add/remove only — the pool→shard hash is static, so
+        survivors never reshuffle).  The version probe is a lock-free int
+        read: N lanes taking the cache lock here every cycle was the
+        hottest contention point in the whole process.  A stale read
+        costs one cycle on the old partition — the per-pool cursor guard
+        still protects the commit."""
+        ver = self.cache.pools_version
+        if ver != ctx.partition_sig:
+            ctx.partition_pools = self._router.partition(
+                self.cache.pools(), ctx.lane)
+            ctx.partition_sig = ver
+
+    def _maybe_escalate(self, info: QueuedPodInfo, pod: Pod, status: Status,
+                        tr, ctx: _LaneContext,
+                        pods_to_activate: PodsToActivate) -> bool:
+        """Shard-lane miss: the restricted sweep found no home.  Escalate
+        the pod's unit to the serialized global lane (full-fleet
+        candidates, pre-sharding semantics) instead of parking it — a pod
+        only THIS shard's pools cannot host is not unschedulable, and no
+        cluster event ever announces "another shard had room".  Also the
+        reason shard lanes never run PostFilter: preemption dry-runs
+        mutate the global nominator, so nomination decisions stay
+        serialized on the global lane."""
+        if not ctx.pools_scoped or status.is_error():
+            return False
+        unit = self._router.escalate(pod)
+        if self._telemetry:
+            # live-fleet counters only: a shadow replay/what-if trial's
+            # simulated escalations must not publish as fleet state
+            shard_escalations_total.with_labels(ctx.lane).inc()
+        if self._shard_stats is not None:
+            self._shard_stats.on_escalation(ctx.lane)
+        if tr is not None:
+            tr.annotate("shard_escalated", unit)
+            tr.finish("shard-escalated", status=status)
+        self.obs_engine.on_attempt(
+            pod.key, pod_group_full_name(pod) or None, "shard-escalated",
+            status.plugin or ctx.lane,
+            f"shard {ctx.lane} partition exhausted; retrying on the "
+            f"global lane", None, getattr(info, "attempts", 0))
+        klog.V(4).info_s("shard escalation", pod=pod.key, lane=ctx.lane,
+                         unit=unit)
+        self.queue.push_active(info, GLOBAL_LANE)
+        self._activate_pods(pods_to_activate)
+        return True
+
     def _schedule_cycle(self, info: QueuedPodInfo, pod: Pod,
-                        tr, start: float) -> None:
-        state = CycleState()
-        pods_to_activate = PodsToActivate()
-        state.write(PODS_TO_ACTIVATE_KEY, pods_to_activate)
+                        tr, start: float, ctx: _LaneContext) -> None:
+        conflicts = 0
+        while True:
+            state = CycleState()
+            pods_to_activate = PodsToActivate()
+            state.write(PODS_TO_ACTIVATE_KEY, pods_to_activate)
 
-        snapshot = self.cache.snapshot()
-        self.handle.set_snapshot(snapshot)
+            view: Optional[CacheView] = None
+            if self._sharded:
+                if ctx.pools_scoped:
+                    self._refresh_partition(ctx)
+                    view = self.cache.snapshot_view(ctx.partition_pools)
+                else:
+                    view = self.cache.snapshot_view()
+                snapshot = view.snapshot
+                # partition views are thread-local ONLY: the shared
+                # fallback slot (bind workers, informer-thread unreserve)
+                # must keep seeing a full-fleet snapshot
+                self.handle.set_snapshot(snapshot,
+                                         shared=not ctx.pools_scoped)
+                self.handle.set_dispatch_scope(
+                    "partition" if ctx.pools_scoped else "")
+            else:
+                snapshot = self.cache.snapshot()
+                self.handle.set_snapshot(snapshot)
+                self.handle.set_dispatch_scope("")
 
-        node_name, status = self._schedule_pod(state, pod, snapshot)
-        if not status.is_success():
-            self._run_post_filter(state, pod, status)
-            diagnosis = state.try_read("tpusched/diagnosis")
-            if tr is not None:
-                tr.finish("error" if status.is_error() else "unschedulable",
-                          status=status, diagnosis=diagnosis)
-            self._obs_failure(info, pod, status, diagnosis=diagnosis)
-            self._handle_failure(info, status)
-            self._activate_pods(pods_to_activate)
-            return
+            if ctx.pools_scoped:
+                # the lanes ARE the parallelism: a shard's partition sweep
+                # is small and pure-Python — pool dispatch inside it only
+                # buys GIL handoffs (util/parallelize.inline_scope)
+                with self._par.inline_scope():
+                    node_name, status = self._schedule_pod(
+                        state, pod, snapshot, ctx, view)
+            else:
+                node_name, status = self._schedule_pod(state, pod, snapshot,
+                                                       ctx, view)
+            if not status.is_success():
+                if self._maybe_escalate(info, pod, status, tr, ctx,
+                                        pods_to_activate):
+                    return
+                self._run_post_filter(state, pod, status)
+                diagnosis = state.try_read("tpusched/diagnosis")
+                if tr is not None:
+                    tr.finish("error" if status.is_error()
+                              else "unschedulable",
+                              status=status, diagnosis=diagnosis)
+                self._obs_failure(info, pod, status, diagnosis=diagnosis)
+                self._handle_failure(info, status)
+                self._activate_pods(pods_to_activate)
+                return
 
-        # clear any stale nomination; assume so parallel cycles see the pod
-        self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
-        assumed = pod.deepcopy()
-        self.cache.assume_pod(assumed, node_name)
-        # the sanctioned cursor advance: (re)arm the cycle's equivalence
-        # entry iff the assume was the ONLY mutation since the snapshot
-        self._equiv_after_assume()
+            # clear stale nomination; assume so parallel cycles see the pod
+            self.handle.pod_nominator.delete_nominated_pod_if_exists(pod)
+            assumed = pod.deepcopy()
+            if self._sharded:
+                # optimistic commit: the assume lands only if the chosen
+                # pool's cursor is still the one this cycle's filters read
+                # (Cache.assume_pod_guarded).  A refusal means a foreign
+                # mutation — an informer event, another lane's bind into
+                # this pool — raced the cycle: re-derive on fresh state
+                # instead of binding a stale placement.
+                ni = snapshot.get(node_name)
+                pool = pool_of_node(ni.node) if ni is not None else ""
+                expected = view.pool_cursors.get(pool, 0)
+                committed = self.cache.assume_pod_guarded(
+                    assumed, node_name, expected,
+                    pools=ctx.partition_pools if ctx.pools_scoped else None)
+                if committed is None:
+                    conflicts += 1
+                    ctx.equiv_pending = None
+                    if self._telemetry:
+                        shard_conflicts_total.with_labels(ctx.lane).inc()
+                    if self._shard_stats is not None:
+                        self._shard_stats.on_conflict(ctx.lane)
+                    if tr is not None:
+                        tr.annotate("shard_conflicts", conflicts)
+                    if conflicts < _MAX_CONFLICT_RETRIES:
+                        continue
+                    status = Status.unschedulable(
+                        f"dispatch conflict: pool {pool!r} raced "
+                        f"{conflicts} commit attempts")
+                    if tr is not None:
+                        tr.finish("conflict-starved", status=status,
+                                  node=node_name)
+                    self._obs_failure(info, pod, status,
+                                      outcome="conflict-starved")
+                    self._handle_failure(info, status, to_backoff=True)
+                    self._activate_pods(pods_to_activate)
+                    return
+                # the sanctioned cursor advance, pool-scoped: (re)arm the
+                # cycle's equivalence entry iff the partition advanced by
+                # EXACTLY this cycle's own attach
+                self._equiv_after_assume(ctx, pool, committed)
+            else:
+                self.cache.assume_pod(assumed, node_name)
+                # the sanctioned cursor advance: (re)arm the cycle's
+                # equivalence entry iff the assume was the ONLY mutation
+                # since the snapshot
+                self._equiv_after_assume(ctx, None)
+            break
 
         s = self._timed_point("Reserve", self._fw.run_reserve_plugins_reserve,
                               state, assumed, node_name)
@@ -946,7 +1269,7 @@ class Scheduler:
 
         def on_permit_resolved(permit_status: Status,
                                args=(state, info, assumed, node_name, start,
-                                     pods_to_activate, tr)) -> None:
+                                     pods_to_activate, tr, ctx.lane)) -> None:
             # dispatch timestamp: the gang-rollback registry compares it
             # against abort times so only tasks of the aborted burst (not
             # later retry cycles) are rolled back
@@ -991,32 +1314,43 @@ class Scheduler:
                 else:
                     tr.truncated += 1
 
-    def _schedule_pod(self, state: CycleState, pod: Pod, snapshot):
+    def _candidate_infos(self, snapshot, ctx: _LaneContext):
+        """A lane's candidate node set.  Shard lanes already schedule
+        against a partition-restricted snapshot (Cache.snapshot_view), so
+        its node list IS the partition — the restriction is structural,
+        and every fleet-sweeping plugin (TopologyMatch's window search,
+        Coscheduling's capacity dry-run) inherits it for free."""
+        return snapshot.list()
+
+    def _schedule_pod(self, state: CycleState, pod: Pod, snapshot,
+                      ctx: _LaneContext, view: Optional[CacheView] = None):
         """genericScheduler.Schedule analog: prefilter → filter → score —
         with the equivalence-class fast path in front: a gang sibling whose
         class has a valid cache entry skips PreFilter and the static
         filters entirely and goes straight to a dynamic re-filter + Score
         over the memoized feasible set."""
-        self._equiv_pending = None
+        ctx.equiv_pending = None
         num_nodes = snapshot.num_nodes()
         if num_nodes == 0:
             return "", Status.unschedulable("no nodes available")
-        entry = self._equiv_lookup(pod)
+        entry = self._equiv_lookup(pod, ctx, view)
         if entry is not None:
-            result = self._schedule_from_cache(state, pod, snapshot, entry)
+            result = self._schedule_from_cache(state, pod, snapshot, entry,
+                                               ctx, view)
             if result is not None:
                 return result
             # cached feasible set drained (or differential mismatch): the
             # entry is dropped and the full path runs as the oracle
             trace.annotate("equiv_cache", "fallback")
-        return self._schedule_full(state, pod, snapshot, record=True)
+        return self._schedule_full(state, pod, snapshot, ctx, view,
+                                   record=True)
 
     def _schedule_full(self, state: CycleState, pod: Pod, snapshot,
+                       ctx: _LaneContext, view: Optional[CacheView] = None,
                        record: bool = False):
         """The full per-node path — always the oracle. ``record``: offer the
         completed cycle to the equivalence cache (False for differential
         re-runs, which must be side-effect-free on the cache)."""
-        num_nodes = snapshot.num_nodes()
         s = self._timed_point("PreFilter", self._fw.run_pre_filter_plugins,
                               state, pod)
         if not s.is_success():
@@ -1026,7 +1360,8 @@ class Scheduler:
             state.write("tpusched/diagnosis", diagnosis)
             return "", s
 
-        infos = snapshot.list()
+        infos = self._candidate_infos(snapshot, ctx)
+        num_nodes = len(infos)
         # PreFilterResult.NodeNames (upstream findNodesThatPassFilters):
         # a PreFilter that resolved the only viable hosts narrows the sweep
         rset = state.restricted_node_names
@@ -1036,9 +1371,12 @@ class Scheduler:
                 return "", Status.unschedulable(
                     f"0/{num_nodes} nodes are available: none match the "
                     "PreFilter node set")
+        if not infos:
+            return "", Status.unschedulable(
+                "0 nodes are available: dispatch shard owns no pools")
         want = self._num_feasible_nodes_to_find(len(infos))
         feasible, diagnosis, error = self._timed_point(
-            "Filter", self._find_feasible, state, pod, infos, want)
+            "Filter", self._find_feasible, state, pod, infos, want, ctx)
         if error is not None:
             return "", error
         state.write("tpusched/diagnosis", diagnosis)
@@ -1060,7 +1398,7 @@ class Scheduler:
         # letting them into an entry would share them by reference with
         # every hit cycle's Score, mutating the cached original in place.
         prefilter_export = None
-        if record and self._equiv_cache is not None:
+        if record and ctx.equiv_cache is not None:
             prefilter_export = state.export(exclude=_EQUIV_EXCLUDE_KEYS)
         node_name, status = self._select_host(state, pod, feasible)
         if record and status.is_success():
@@ -1068,7 +1406,8 @@ class Scheduler:
             # memoizing it would pin siblings to the sample
             self._equiv_offer(pod, state, feasible,
                               swept_all=want >= len(infos),
-                              prefilter_data=prefilter_export)
+                              prefilter_data=prefilter_export,
+                              ctx=ctx, view=view)
         return node_name, status
 
     def _select_host(self, state: CycleState, pod: Pod, feasible):
@@ -1090,12 +1429,15 @@ class Scheduler:
 
     # -- equivalence-class fast path (sched/equivcache.py) --------------------
 
-    def _equiv_lookup(self, pod: Pod) -> Optional[EquivEntry]:
+    def _equiv_lookup(self, pod: Pod, ctx: _LaneContext,
+                      view: Optional[CacheView]) -> Optional[EquivEntry]:
         """Return a VALID entry for the pod's class or None. Validity is the
         strict triple: mutation cursor at the snapshot this cycle's filters
-        read, nominator generation, and every EquivalenceAware plugin's
+        read (the partition's pool-cursor tuple on shard lanes — foreign
+        assumes in OTHER shards' pools no longer break the chain), the
+        nominator generation, and every EquivalenceAware plugin's
         recomputed fingerprint."""
-        if self._equiv_cache is None:
+        if ctx.equiv_cache is None:
             return None
         nominator = self.handle.pod_nominator
         if not nominator.empty():
@@ -1105,15 +1447,24 @@ class Scheduler:
             trace.annotate("equiv_cache", "bypass")
             return None
         key = equivalence_key(pod)
-        entry = self._equiv_cache.get(key)
+        entry = ctx.equiv_cache.get(key)
         if entry is None:
             equiv_cache_misses.inc()
             trace.annotate("equiv_cache", "miss")
             return None
-        if (entry.armed_mutation != self.cache.snapshot_cursor()
+        if ctx.pools_scoped:
+            cursor_ok = (entry.armed_pool_cursors is not None
+                         and view is not None
+                         and entry.armed_pool_cursors
+                         == view.cursor_tuple())
+        else:
+            cursor = view.cursor if view is not None \
+                else self.cache.snapshot_cursor()
+            cursor_ok = entry.armed_mutation == cursor
+        if (not cursor_ok
                 or entry.nominator_gen != nominator.generation
                 or entry.fingerprints != self._equiv_fingerprints(pod, None)):
-            self._equiv_cache.drop(key)
+            ctx.equiv_cache.drop(key)
             equiv_cache_invalidations.inc()
             trace.annotate("equiv_cache", "invalidated")
             return None
@@ -1131,7 +1482,8 @@ class Scheduler:
         return tuple(fps)
 
     def _schedule_from_cache(self, state: CycleState, pod: Pod, snapshot,
-                             entry: EquivEntry):
+                             entry: EquivEntry, ctx: _LaneContext,
+                             view: Optional[CacheView] = None):
         """The hit path: dynamic re-filter over the cached feasible set,
         then the shared Score tail. Returns (node, status) or None to fall
         back to the full path (entry already dropped)."""
@@ -1150,7 +1502,7 @@ class Scheduler:
             if node_info is None:
                 # a vanished node always bumps the cursor, so this is
                 # unreachable in practice — belt and braces
-                self._equiv_cache.drop(entry.key)
+                ctx.equiv_cache.drop(entry.key)
                 equiv_cache_invalidations.inc()
                 return None
             infos.append(node_info)
@@ -1166,7 +1518,7 @@ class Scheduler:
         t0 = time.perf_counter()
 
         def fallback():
-            self._equiv_cache.drop(entry.key)
+            ctx.equiv_cache.drop(entry.key)
             equiv_cache_fallbacks.inc()
             if tr is not None:
                 del tr._events[mark:]
@@ -1197,7 +1549,8 @@ class Scheduler:
         if not status.is_success():
             return fallback()
         if self._equiv_differential:
-            full_node = self._differential_check(pod, snapshot, node_name)
+            full_node = self._differential_check(pod, snapshot, node_name,
+                                                 ctx)
             if full_node != node_name:
                 return fallback()
         equiv_cache_hits.inc()
@@ -1209,10 +1562,15 @@ class Scheduler:
         state.skip_filter_plugins |= cstate.skip_filter_plugins
         state.restricted_node_names = cstate.restricted_node_names
         state.write("tpusched/diagnosis", diagnosis)
-        self._equiv_pending = (entry, self.cache.snapshot_cursor())
+        if ctx.pools_scoped and view is not None:
+            ctx.equiv_pending = (entry, view.cursor_tuple())
+        else:
+            ctx.equiv_pending = (entry, view.cursor if view is not None
+                                 else self.cache.snapshot_cursor())
         return node_name, status
 
-    def _differential_check(self, pod: Pod, snapshot, cached_node: str):
+    def _differential_check(self, pod: Pod, snapshot, cached_node: str,
+                            ctx: _LaneContext):
         """Oracle assertion (equiv_cache_differential profiles only): re-run
         the FULL path on a fresh state and compare placements. Returns the
         full path's chosen node ('' on failure). Runs UNTRACED: the oracle's
@@ -1223,7 +1581,7 @@ class Scheduler:
             full_state = CycleState()
             full_state.write(PODS_TO_ACTIVATE_KEY, PodsToActivate())
             full_node, full_status = self._schedule_full(
-                full_state, pod, snapshot, record=False)
+                full_state, pod, snapshot, ctx, record=False)
         finally:
             trace.deactivate(token)
         if full_node != cached_node or not full_status.is_success():
@@ -1236,11 +1594,13 @@ class Scheduler:
         return full_node
 
     def _equiv_offer(self, pod: Pod, state: CycleState, feasible,
-                     swept_all: bool, prefilter_data: Dict) -> None:
+                     swept_all: bool, prefilter_data: Dict,
+                     ctx: _LaneContext,
+                     view: Optional[CacheView] = None) -> None:
         """Offer a completed full cycle as a cache entry (pending until the
         assume verifies the cursor chain). ``prefilter_data`` is the data
         map exported BEFORE Score ran — the only state an entry may hold."""
-        if self._equiv_cache is None or not swept_all:
+        if ctx.equiv_cache is None or not swept_all:
             return
         nominator = self.handle.pod_nominator
         if not nominator.empty():
@@ -1257,20 +1617,50 @@ class Scheduler:
             (frozenset(state.restricted_node_names)
              if state.restricted_node_names is not None else None),
             tuple(sorted(n.name for n in feasible)))
-        self._equiv_pending = (entry, self.cache.snapshot_cursor())
+        if ctx.pools_scoped and view is not None:
+            ctx.equiv_pending = (entry, view.cursor_tuple())
+        else:
+            ctx.equiv_pending = (entry, view.cursor if view is not None
+                                 else self.cache.snapshot_cursor())
 
-    def _equiv_after_assume(self) -> None:
+    def _equiv_after_assume(self, ctx: _LaneContext,
+                            chosen_pool: Optional[str],
+                            current_cursors: Optional[tuple] = None) -> None:
         """Arm the pending entry iff the cursor advanced by EXACTLY the
         cycle's own assume; any concurrent foreign mutation breaks the
-        chain and the entry is discarded."""
-        pending, self._equiv_pending = self._equiv_pending, None
-        if pending is None or self._equiv_cache is None:
+        chain and the entry is discarded.
+
+        Shard lanes compare the PARTITION's pool-cursor tuple instead of
+        the global cursor: the chain requires the chosen pool to have
+        advanced by exactly 1 (this cycle's own attach, just verified by
+        the guarded assume) and every other partition pool to be
+        untouched.  Foreign traffic in other shards' pools is invisible
+        here — the sharded equivalence cache stays warm through exactly
+        the concurrency that used to invalidate it."""
+        pending, ctx.equiv_pending = ctx.equiv_pending, None
+        if pending is None or ctx.equiv_cache is None:
             return
-        entry, cycle_cursor = pending
+        entry, marker = pending
+        if ctx.pools_scoped:
+            cursors = marker            # ((pool, cursor), ...) at snapshot
+            # post-assume cursors were read inside the guarded assume's
+            # own critical section (assume_pod_guarded returns them) — a
+            # second lock hop here was measurable under 8 lanes
+            current = current_cursors \
+                if current_cursors is not None \
+                else self.cache.pool_cursors([p for p, _ in cursors])
+            expect = tuple((p, c + 1 if p == chosen_pool else c)
+                           for p, c in cursors)
+            if current == expect:
+                ctx.equiv_cache.arm(entry, -1, pool_cursors=current)
+            else:
+                ctx.equiv_cache.drop(entry.key)
+            return
+        cycle_cursor = marker
         if self.cache.mutation_cursor() == cycle_cursor + 1:
-            self._equiv_cache.arm(entry, cycle_cursor + 1)
+            ctx.equiv_cache.arm(entry, cycle_cursor + 1)
         else:
-            self._equiv_cache.drop(entry.key)
+            ctx.equiv_cache.drop(entry.key)
 
     @staticmethod
     def _run_batch_filters(plugins, state: CycleState, pod: Pod, infos):
@@ -1291,7 +1681,7 @@ class Scheduler:
         return batch_fail, frozenset(names)
 
     def _find_feasible(self, state: CycleState, pod: Pod, infos,
-                       want: int):
+                       want: int, ctx: _LaneContext):
         """findNodesThatPassFilters analog (generic_scheduler.go:266), in two
         stages tuned for Python-on-TPU-control-plane economics:
 
@@ -1308,9 +1698,14 @@ class Scheduler:
         Returns (feasible_nodes, diagnosis, error_status_or_None).
         """
         n = len(infos)
-        start = self._next_start_node_index % n
+        start = ctx.next_start_node_index % n
         fw = self._fw
         nominator_empty = self.handle.pod_nominator.empty()
+        # the cycle's snapshot, re-installed into each pool worker's
+        # thread-local slot below: a filter plugin (or nominated-pod
+        # evaluation) reading the shared lister from a worker thread must
+        # see THIS cycle's epoch view, not the cross-thread fallback
+        cycle_snapshot = self.handle.snapshot_shared_lister()
 
         batch_fail: List[Optional[Status]] = [None] * n
         exclude: frozenset = frozenset()
@@ -1329,6 +1724,7 @@ class Scheduler:
             node_info = infos[oi]
             fs = batch_fail[oi]
             if fs is None:
+                self.handle.set_snapshot(cycle_snapshot, shared=False)
                 fs = fw.run_filter_plugins_with_nominated_pods(
                     state, pod, node_info, exclude)
                 if fs.is_success():
@@ -1345,7 +1741,7 @@ class Scheduler:
 
         self._par.until(
             n, work, stop=lambda: len(feasible) >= want or bool(errors))
-        self._next_start_node_index = (start + max(visited[0], 1)) % n
+        ctx.next_start_node_index = (start + max(visited[0], 1)) % n
         if errors:
             return [], {}, errors[0]
         return feasible, diagnosis, None
@@ -1385,6 +1781,9 @@ class Scheduler:
                 klog.V(3).info_s("nomination patch failed; skipping",
                                  pod=pod.key, err=str(e))
                 return
+            # own the object before mutating: ``pod`` is the informer-
+            # shared copy (see _live_pod) and must stay read-only
+            pod = pod.deepcopy()
             pod.status.nominated_node_name = node
             self.handle.pod_nominator.add_nominated_pod(pod, node)
             trace.record_anomaly("preemption_nominated", node=node,
@@ -1394,7 +1793,8 @@ class Scheduler:
     def _abort_binding(self, permit_status: Status, dispatch_ts: float,
                        state: CycleState, info: QueuedPodInfo, assumed: Pod,
                        node_name: str, cycle_start: float,
-                       pods_to_activate: PodsToActivate, tr=None) -> None:
+                       pods_to_activate: PodsToActivate, tr=None,
+                       lane: str = "") -> None:
         """Shutdown-path resolution of a dispatched binding task: release
         the pod's reserved state (unreserve + forget) and finalize its
         trace — no API calls, no requeue, cheap enough for the signaling
@@ -1416,7 +1816,8 @@ class Scheduler:
     def _finish_binding(self, permit_status: Status, dispatch_ts: float,
                         state: CycleState, info: QueuedPodInfo, assumed: Pod,
                         node_name: str, cycle_start: float,
-                        pods_to_activate: PodsToActivate, tr=None) -> None:
+                        pods_to_activate: PodsToActivate, tr=None,
+                        lane: str = "") -> None:
         """Post-permit half of the binding cycle, dispatched by
         notify_on_permit once the barrier resolves. Re-activates the cycle
         trace on this pool thread so the permit-wait span, the binding
@@ -1426,7 +1827,7 @@ class Scheduler:
         try:
             self._finish_binding_traced(permit_status, dispatch_ts, state,
                                         info, assumed, node_name, cycle_start,
-                                        pods_to_activate, tr)
+                                        pods_to_activate, tr, lane)
         finally:
             trace.deactivate(token)
 
@@ -1436,7 +1837,7 @@ class Scheduler:
                                assumed: Pod, node_name: str,
                                cycle_start: float,
                                pods_to_activate: PodsToActivate,
-                               tr) -> None:
+                               tr, lane: str = "") -> None:
         pod = assumed
         s = permit_status
         gang = pod_group_full_name(pod) or None
@@ -1518,8 +1919,10 @@ class Scheduler:
             # (in-memory, near-zero-latency) binds would inflate
             # bind_total and pollute the e2e latency histogram
             bind_total.inc()
-            self._throughput.on_bind()
+            self._throughput.on_bind(lane)
             e2e_scheduling_seconds.observe(self.clock() - cycle_start)
+        if self._shard_stats is not None:
+            self._shard_stats.on_bind(lane)
         # decision attribution for the fleet trace: the watch-derived
         # bind-commit (fired inside the API patch above) is the placement
         # record; this names WHO decided and at what cost. No-op unless

@@ -1,0 +1,358 @@
+"""Sharded dispatch core: per-pool parallel scheduling lanes with
+optimistic cross-pool conflict resolution (ROADMAP item 1).
+
+The PR 7 arrival-storm baseline proved the single dispatch loop is the
+wall: 81.8 binds/s at 32 pools / 2048 hosts with a p99 pod-e2e that is
+almost pure queue wait.  The reference scheduler runs parallel scheduler
+profiles for exactly this reason; this module is the partitioning and
+routing half of that design — the scheduler (sched/scheduler.py) runs one
+dispatch worker per lane, and everything here decides WHICH lane owns a
+pod and which pools a lane may place into.
+
+Model
+-----
+
+- Pools (``tpu.dev/pool``) are statically partitioned over N shard lanes
+  by a stable hash (``crc32(pool) % N``): adding or removing pools never
+  reshuffles the survivors, and two processes (or two replays) always
+  agree on the partition — ``sched/ha.py``'s replica identity names the
+  process, the hash names the shard, so shard ownership needs no
+  coordination protocol.
+- Scheduling units (a gang, or a singleton pod) are routed to lanes by
+  the same stable hash over the unit key, so every member of a gang lands
+  in ONE lane and the equivalence cache's sibling burst survives
+  sharding.  A shard lane's cycles filter ONLY over its own pools'
+  nodes — the per-cycle sweep shrinks by ~N×, which is where most of the
+  throughput multiplier comes from; the lanes running concurrently is
+  the rest.
+- Pods whose feasible pools span shards fall back to the serialized
+  GLOBAL lane, which sweeps the whole fleet exactly like the pre-sharding
+  loop: multislice sets (their member gangs must coordinate placement
+  across pools), pods pinned by an explicit pool selector are routed to
+  that pool's shard instead, nominated preemptors (their nomination may
+  point anywhere), and — wholesale — any fleet with ElasticQuotas (quota
+  admission reads cross-pool usage; concurrent lanes could overshoot a
+  max between snapshot and assume, so quota fleets serialize until a
+  quota-aware commit protocol exists).
+- A shard-restricted cycle that comes up unschedulable ESCALATES its
+  unit to the global lane (bounded TTL, so capacity returning to the
+  unit's home shard eventually pulls it back): the shard attempt costs
+  one cheap restricted sweep, and nothing a single loop could place is
+  ever lost to partitioning.
+
+Conflict resolution is the cache's job (sched/cache.py): every structural
+mutation bumps a per-pool cursor, a cycle captures its partition's
+cursors atomically with its snapshot (``Cache.snapshot_view``), and the
+commit point is the optimistic ``Cache.assume_pod_guarded`` — reusing the
+equivalence cache's arming-guard idea ("the cursor advanced by exactly my
+own assume") as a compare-and-assume keyed on the chosen pool's cursor.
+A raced cycle re-derives on fresh state instead of binding a stale
+placement.  Gang admission needs nothing new: the permit barrier and the
+Coscheduling quorum clock are process-global state shared by all lanes.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from ..api.core import Pod
+from ..api.scheduling import pod_group_full_name
+from ..api.topology import LABEL_POOL
+
+__all__ = ["GLOBAL_LANE", "shard_lane", "pool_shard", "unit_key_of",
+           "ShardRouter", "ShardStats", "attribute_placement_diff"]
+
+GLOBAL_LANE = "global"
+
+# An escalated unit returns to its home shard after this long: pool
+# capacity churns on the scale of seconds under a storm, and a unit pinned
+# to the serialized global lane forever would re-create the single-loop
+# wall one unit at a time.
+ESCALATION_TTL_S = 30.0
+
+# Bounded memory for the cumulative escalated-unit set the replay
+# equivalence gate reads (attribution of shard-vs-global placement moves).
+_ESCALATED_EVER_CAP = 16384
+
+
+def shard_lane(index: int) -> str:
+    return f"s{index}"
+
+
+def pool_shard(pool: str, shards: int) -> int:
+    """Stable pool → shard assignment.  crc32 (not hash()) so replays,
+    restarts and HA replicas all agree."""
+    return zlib.crc32(pool.encode("utf-8")) % shards
+
+
+def unit_key_of(pod: Pod) -> str:
+    """The scheduling unit a pod belongs to: its gang's full name, or its
+    own key for singletons.  Routing by unit keeps gang siblings in one
+    lane (the equivalence-cache burst) and makes escalation gang-wide."""
+    return pod_group_full_name(pod) or pod.key
+
+
+class ShardRouter:
+    """Deterministic pod → dispatch-lane routing with an escalation
+    registry.  Cheap by contract: one informer dict get plus a couple of
+    hashes per call — it runs once per (re)enqueue and once per pop."""
+
+    def __init__(self, shards: int,
+                 pg_lookup: Optional[Callable[[str], object]] = None,
+                 clock=time.monotonic,
+                 escalation_ttl_s: float = ESCALATION_TTL_S):
+        self.shards = shards
+        self._pg_lookup = pg_lookup or (lambda key: None)
+        self._clock = clock
+        self._ttl = escalation_ttl_s
+        self._lock = threading.Lock()
+        # unit key → escalation deadline (monotonic); pruned lazily
+        self._escalated: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        # cumulative escalated units (bounded), for post-hoc attribution
+        # of placement differences in the replay equivalence gate; at the
+        # cap the set stops growing and the TRUNCATED flag flips so a
+        # consumer never mistakes "not recorded" for "never escalated"
+        self._escalated_ever: set = set()
+        self._escalated_overflow = False
+        self._escalations = 0
+        # quota mode: any ElasticQuota in the fleet serializes dispatch
+        # through the global lane (see module docstring)
+        self._quota_mode = False
+
+    # -- fleet-condition inputs ----------------------------------------------
+
+    def set_quota_mode(self, on: bool) -> None:
+        self._quota_mode = bool(on)
+
+    def quota_mode(self) -> bool:
+        return self._quota_mode
+
+    # -- escalation -----------------------------------------------------------
+
+    def escalate(self, pod: Pod) -> str:
+        """Route ``pod``'s whole unit to the global lane for the TTL.
+        Returns the unit key."""
+        unit = unit_key_of(pod)
+        now = self._clock()
+        with self._lock:
+            self._escalated[unit] = now + self._ttl
+            self._escalated.move_to_end(unit)
+            if len(self._escalated_ever) < _ESCALATED_EVER_CAP:
+                self._escalated_ever.add(unit)
+            elif unit not in self._escalated_ever:
+                self._escalated_overflow = True
+            self._escalations += 1
+            # lazy prune from the oldest end — entries are in rough
+            # deadline order because the TTL is constant
+            while self._escalated:
+                first = next(iter(self._escalated))
+                if self._escalated[first] > now:
+                    break
+                del self._escalated[first]
+        return unit
+
+    def is_escalated(self, unit: str) -> bool:
+        with self._lock:
+            deadline = self._escalated.get(unit)
+            if deadline is None:
+                return False
+            if deadline <= self._clock():
+                del self._escalated[unit]
+                return False
+            return True
+
+    def escalated_units(self) -> List[str]:
+        """Every unit routed fleet-wide over this router's lifetime —
+        escalations plus nominated preemptors — bounded; the replay
+        equivalence gate's attribution input."""
+        with self._lock:
+            return sorted(self._escalated_ever)
+
+    def escalated_truncated(self) -> bool:
+        """True iff the cumulative escalated-unit set overflowed its cap —
+        absence from escalated_units() is then inconclusive, and an
+        attribution consumer must not treat it as "never escalated"."""
+        with self._lock:
+            return self._escalated_overflow
+
+    def escalations(self) -> int:
+        with self._lock:
+            return self._escalations
+
+    # -- the routing decision -------------------------------------------------
+
+    def lane_for(self, pod: Pod) -> str:
+        if self.shards <= 1 or self._quota_mode:
+            return GLOBAL_LANE
+        gang = pod_group_full_name(pod)
+        unit = gang or pod.key
+        if getattr(pod.status, "nominated_node_name", ""):
+            # a nominated preemptor's placement may land anywhere — note
+            # the unit in the globally-routed set so the replay diff can
+            # attribute its fleet-wide placement like an escalation
+            with self._lock:
+                if len(self._escalated_ever) < _ESCALATED_EVER_CAP:
+                    self._escalated_ever.add(unit)
+                elif unit not in self._escalated_ever:
+                    self._escalated_overflow = True
+            return GLOBAL_LANE
+        if self.is_escalated(unit):
+            return GLOBAL_LANE
+        if gang:
+            pg = self._pg_lookup(gang)
+            spec = getattr(pg, "spec", None)
+            if spec is not None and (
+                    getattr(spec, "multislice_set", "")
+                    or getattr(spec, "multislice_set_size", 0) > 1):
+                # a multislice member gang must co-place with sibling
+                # gangs whose pools may hash anywhere: feasible pools
+                # span shards ⇒ the serialized lane owns it
+                return GLOBAL_LANE
+            # gang members NEVER route by a per-member pool pin: one unit
+            # = one lane is the invariant (sibling equivcache bursts,
+            # unit-wide escalation).  A member whose pinned pool is
+            # outside its unit's partition simply fails the restricted
+            # cycle and escalates the whole unit to the global lane.
+            return shard_lane(pool_shard(unit, self.shards))
+        pinned = pod.spec.node_selector.get(LABEL_POOL, "") \
+            if pod.spec.node_selector else ""
+        if pinned:
+            return shard_lane(pool_shard(pinned, self.shards))
+        return shard_lane(pool_shard(unit, self.shards))
+
+    def partition(self, pools: List[str], lane: str) -> List[str]:
+        """The pools a shard lane owns out of the fleet's current pool
+        set.  The global lane owns everything (returns the input)."""
+        if lane == GLOBAL_LANE:
+            return pools
+        idx = int(lane[1:])
+        return [p for p in pools if pool_shard(p, self.shards) == idx]
+
+
+class ShardStats:
+    """Per-lane dispatch accounting, published as ``health.shards`` in
+    /debug/flightrecorder (the hot/starved-shard diagnosis surface next to
+    the per-shard metrics)."""
+
+    __slots__ = ("_lock", "_lanes", "_clock")
+
+    def __init__(self, lanes: List[str], clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._lanes: Dict[str, Dict[str, float]] = {
+            lane: {"cycles": 0, "binds": 0, "conflicts": 0,
+                   "escalations": 0, "last_cycle_mono": 0.0}
+            for lane in lanes}
+
+    def on_cycle(self, lane: str) -> None:
+        with self._lock:
+            row = self._lanes.get(lane)
+            if row is not None:
+                row["cycles"] += 1
+                row["last_cycle_mono"] = self._clock()
+
+    def on_bind(self, lane: str) -> None:
+        with self._lock:
+            row = self._lanes.get(lane)
+            if row is not None:
+                row["binds"] += 1
+
+    def on_conflict(self, lane: str) -> None:
+        with self._lock:
+            row = self._lanes.get(lane)
+            if row is not None:
+                row["conflicts"] += 1
+
+    def on_escalation(self, lane: str) -> None:
+        with self._lock:
+            row = self._lanes.get(lane)
+            if row is not None:
+                row["escalations"] += 1
+
+    def snapshot(self, queue_depths: Optional[Dict[str, Dict[str, int]]]
+                 = None,
+                 partitions: Optional[Dict[str, int]] = None) -> Dict:
+        """The health.shards payload: per-lane counters + idle age, plus
+        the caller-supplied queue depths and partition sizes."""
+        now = self._clock()
+        with self._lock:
+            lanes = {}
+            for lane, row in self._lanes.items():
+                ent = {"cycles": int(row["cycles"]),
+                       "binds": int(row["binds"]),
+                       "conflicts": int(row["conflicts"]),
+                       "escalations": int(row["escalations"]),
+                       "idle_s": round(now - row["last_cycle_mono"], 3)
+                       if row["last_cycle_mono"] else None}
+                if queue_depths and lane in queue_depths:
+                    ent["queue"] = queue_depths[lane]
+                if partitions and lane in partitions:
+                    ent["pools"] = partitions[lane]
+                lanes[lane] = ent
+        return {"lanes": lanes, "shard_count": len(self._lanes)}
+
+
+def attribute_placement_diff(diff: Dict, *, shards: int,
+                             pool_of_node: Callable[[str], str],
+                             gang_of: Callable[[str], Optional[str]],
+                             escalated_units: Optional[List[str]] = None,
+                             pinned_pool_of: Optional[
+                                 Callable[[str], Optional[str]]] = None,
+                             escalated_truncated: bool = False) -> Dict:
+    """Attribute a shards=1 vs shards=N lockstep placement diff
+    (sim/replay.diff_placements output) to the sharding policy.
+
+    A move is ATTRIBUTED when the sharded run's node sits in the pod's
+    routed shard's partition (the partition argmax differs from the fleet
+    argmax by design) or when the pod's unit is in the sharded run's
+    escalated set (the global lane placed it fleet-wide).  Anything else
+    — a move to a pool the router could never have offered the pod, a pod
+    bound in only one run, a bind-count delta — is UNATTRIBUTED: the
+    sharded core placed something the partitioning rule cannot explain,
+    i.e. a real divergence the replay gate must fail on.
+
+    ``pinned_pool_of`` mirrors the router's pool-selector rule for
+    SINGLETONS (a non-gang pod pinned to pool P dispatches on P's shard;
+    gang members always route by unit).  ``escalated_truncated`` (from
+    ``ShardRouter.escalated_truncated()``) marks the escalated set as
+    lossy: the report carries the flag and gates must fail on it rather
+    than trust absence."""
+    escalated = set(escalated_units or ())
+    moved_out = []
+    unattributed = []
+    for ent in diff.get("placement_diff", ()):
+        pod = ent["pod"]
+        gang = gang_of(pod)
+        unit = gang or pod
+        pinned = pinned_pool_of(pod) if (pinned_pool_of is not None
+                                         and not gang) else None
+        lane_idx = pool_shard(pinned, shards) if pinned \
+            else pool_shard(unit, shards)
+        pool_b = pool_of_node(ent["b"])
+        ann = dict(ent)
+        ann["unit"] = unit
+        ann["routed_shard"] = shard_lane(lane_idx)
+        ann["pool_b"] = pool_b
+        if unit in escalated:
+            ann["attributed"] = "escalated-global"
+        elif pool_shard(pool_b, shards) == lane_idx:
+            ann["attributed"] = "shard-partition"
+        else:
+            ann["attributed"] = ""
+            unattributed.append(ann)
+        moved_out.append(ann)
+    out = dict(diff)
+    out["placement_diff"] = moved_out
+    out["unattributed"] = unattributed
+    out["escalated_set_truncated"] = escalated_truncated
+    out["unattributed_count"] = (
+        len(unattributed)
+        + len(diff.get("only_in_a", ()))
+        + len(diff.get("only_in_b", ()))
+        + (0 if diff.get("binds_a") == diff.get("binds_b") else 1)
+        + (1 if escalated_truncated else 0))
+    return out

@@ -110,6 +110,13 @@ class ReplayReport:
     pool_utilization: List[dict]        # [{"event": i, "pools": {p: chips}}]
     feed_window_s: float
     elapsed_s: float
+    # sharded-dispatch attribution inputs (sched/shards.py): the lane
+    # count the replay ran with, and every unit the router escalated to
+    # the global lane — shards.attribute_placement_diff consumes these to
+    # separate policy-explained placement moves from real divergences
+    dispatch_shards: int = 1
+    escalated_units: List[str] = dataclasses.field(default_factory=list)
+    escalations_truncated: bool = False
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -260,7 +267,8 @@ def run_replay(trace_dir: str, *,
                settle_s: float = 0.02,
                event_timeout_s: float = 15.0,
                drain_timeout_s: float = 120.0,
-               util_sample_every: int = 50) -> ReplayReport:
+               util_sample_every: int = 50,
+               dispatch_shards: int = 0) -> ReplayReport:
     """Replay a recorded trace into a fresh shadow scheduler.
 
     ``deterministic`` (default) overrides the profile to ``parallelism=1``
@@ -272,11 +280,20 @@ def run_replay(trace_dir: str, *,
     (timed-pace throughput runs).
 
     ``pace``: ``lockstep`` (apply → quiesce → apply; the diffable mode) or
-    ``timed`` (recorded inter-event gaps divided by ``speedup``)."""
+    ``timed`` (recorded inter-event gaps divided by ``speedup``).
+
+    ``dispatch_shards`` > 0 overrides the profile's lane count — the
+    sharded-vs-single lockstep equivalence gate (make replay-smoke) runs
+    the same trace at shards=1 and shards=N and diffs the placements.
+    Lockstep pacing keeps a sharded replay deterministic: each applied
+    event settles before the next, so at most one unit is in flight and
+    exactly one lane (its router-assigned one) processes it."""
     if trace is None:
         trace = load_trace(trace_dir)
     prof = profile if profile is not None else _make_profile(
         allow_preemption, 30.0, config_path, scheduler_name)
+    if dispatch_shards > 0:
+        prof = dataclasses.replace(prof, dispatch_shards=dispatch_shards)
     if deterministic:
         # parallelism=1 + full sweeps: thread-timing-dependent visited
         # counts and sampled feasible sets out.  The WALL-clock retry
@@ -474,6 +491,9 @@ def run_replay(trace_dir: str, *,
         scheduler_name=prof.scheduler_name,
         pace=pace,
         deterministic=deterministic,
+        dispatch_shards=sched.dispatch_shards,
+        escalated_units=sched.shard_router().escalated_units(),
+        escalations_truncated=sched.shard_router().escalated_truncated(),
         workload_fingerprint=workload_fingerprint(trace.events),
         events_applied=applied,
         events_skipped=skipped,

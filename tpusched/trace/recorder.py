@@ -59,10 +59,11 @@ class FlightRecorder:
     # -- trace lifecycle ------------------------------------------------------
 
     def begin_cycle(self, pod, info, wall_start: float,
-                    scheduler: str = "") -> CycleTrace:
+                    scheduler: str = "", shard: str = "") -> CycleTrace:
         """Create the cycle trace for a popped pod. ``info`` is the queue's
         QueuedPodInfo (duck-typed: timestamp / initial_attempt_timestamp /
-        attempts)."""
+        attempts). ``shard``: the dispatch lane that ran the cycle ('' on
+        the classic single loop)."""
         gang_name = pod.meta.labels.get(POD_GROUP_LABEL)
         gang = f"{pod.meta.namespace}/{gang_name}" if gang_name else None
         tr = CycleTrace(
@@ -72,6 +73,7 @@ class FlightRecorder:
             gang=gang,
             attempt=getattr(info, "attempts", 0),
             scheduler=scheduler,
+            shard=shard,
             wall_start=wall_start,
             first_enqueue=getattr(info, "initial_attempt_timestamp",
                                   wall_start),

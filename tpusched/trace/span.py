@@ -91,7 +91,8 @@ class CycleTrace:
     """One scheduling cycle's event log + outcome attribution."""
 
     __slots__ = ("trace_id", "pod_key", "pod_uid", "gang", "attempt",
-                 "scheduler", "wall_start", "perf_start", "first_enqueue",
+                 "scheduler", "shard", "wall_start", "perf_start",
+                 "first_enqueue",
                  "queue_wait_s", "outcome", "node", "plugin",
                  "reasons", "rejections", "annotations", "anomalies",
                  "diagnosis", "blocked_on", "permit_wait_off",
@@ -101,13 +102,14 @@ class CycleTrace:
     def __init__(self, trace_id: str, pod_key: str, pod_uid: str,
                  gang: Optional[str], attempt: int, scheduler: str,
                  wall_start: float, first_enqueue: float,
-                 queue_wait_s: float):
+                 queue_wait_s: float, shard: str = ""):
         self.trace_id = trace_id
         self.pod_key = pod_key
         self.pod_uid = pod_uid
         self.gang = gang                      # "ns/name" or None
         self.attempt = attempt
         self.scheduler = scheduler
+        self.shard = shard                    # dispatch lane ('' = single loop)
         self.wall_start = wall_start          # epoch seconds at cycle start
         self.perf_start = time.perf_counter()
         self.first_enqueue = first_enqueue    # epoch seconds, first add
@@ -237,6 +239,7 @@ class CycleTrace:
             "gang": self.gang,
             "attempt": self.attempt,
             "scheduler": self.scheduler,
+            "shard": self.shard,
             "wall_start": self.wall_start,
             "first_enqueue": self.first_enqueue,
             "queue_wait_s": round(self.queue_wait_s, 6),

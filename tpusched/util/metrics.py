@@ -446,9 +446,14 @@ equiv_cache_differential_mismatches = REGISTRY.counter(
 # decomposes out of e2e latency (pop time - last enqueue time), and every
 # pinned anomaly trace (permit timeout, bind failure, gang denial,
 # preemption) counts here so dashboards can alert before anyone reads dumps.
-queue_wait_seconds = REGISTRY.histogram(
-    "tpusched_scheduling_queue_wait_duration_seconds",
-    "Last-enqueue to pop per scheduling cycle (the trace's queue-wait span).")
+# Labeled by dispatch shard (sharded core, ROADMAP item 1): '' on the
+# classic single loop, 's<N>'/'global' per lane when sharding is on — a
+# hot or starved shard shows up as ITS queue-wait distribution diverging
+# from its peers'. Family-level totals keep the pre-sharding meaning.
+queue_wait_seconds = REGISTRY.histogram_vec(
+    "tpusched_scheduling_queue_wait_duration_seconds", ("shard",),
+    "Last-enqueue to pop per scheduling cycle (the trace's queue-wait "
+    "span), by dispatch shard.")
 # Labeled by anomaly kind (permit_timeout, bind_failed, gang_denied,
 # gang_stuck, ...) so dashboards can alert on ONE failure mode without
 # name-mangled per-kind metrics; .value() is the family total.
@@ -549,12 +554,26 @@ lock_hold_seconds = REGISTRY.histogram_vec(
 # with the older unlabeled tpusched_bind_total/tpusched_schedule_attempts_
 # total (dashboards already scrape those; renaming a scraped family is a
 # breaking change this repo does not make).
+# The shard label ('' single loop, 's<N>'/'global' per dispatch lane)
+# attributes sustained throughput to the lane that produced it — the
+# first divergence to look at when one shard runs hot or starved.
 binds_total = REGISTRY.counter_vec(
-    "tpusched_binds_total", ("scheduler",),
-    "Successful bind commits, by scheduler profile.")
+    "tpusched_binds_total", ("scheduler", "shard"),
+    "Successful bind commits, by scheduler profile and dispatch shard.")
 scheduling_cycles_total = REGISTRY.counter_vec(
-    "tpusched_scheduling_cycles_total", ("scheduler",),
-    "Scheduling cycles started, by scheduler profile.")
+    "tpusched_scheduling_cycles_total", ("scheduler", "shard"),
+    "Scheduling cycles started, by scheduler profile and dispatch shard.")
+# Sharded dispatch conflict/escalation accounting (sched/shards.py):
+# conflicts = optimistic commits refused because a foreign mutation raced
+# the cycle's pool (the cycle re-derives on fresh state — correctness
+# preserved, one cycle of work spent); escalations = pods a shard-
+# restricted cycle could not place that re-entered the global lane.
+shard_conflicts_total = REGISTRY.counter_vec(
+    "tpusched_shard_conflicts_total", ("shard",),
+    "Optimistic shard commits refused by a raced pool cursor, by lane.")
+shard_escalations_total = REGISTRY.counter_vec(
+    "tpusched_shard_escalations_total", ("shard",),
+    "Pods escalated from a shard lane to the global dispatch lane.")
 
 # Sampling profiler self-accounting (tpusched/obs/profiler.py): the
 # sampler's own sample count — the denominator for every attribution

@@ -40,6 +40,25 @@ class Parallelizer:
         self.workers = workers
         self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def inline_scope(self):
+        """Context manager forcing INLINE execution for until()/map() calls
+        made from this thread while active.  Sharded dispatch lanes use it
+        around their (partition-restricted, already-small) sweeps: the
+        lanes themselves are the concurrency, and handing a 200-node
+        pure-Python sweep to a shared pool under the GIL buys only
+        future/chunk dispatch overhead and GIL handoffs."""
+        par = self
+
+        class _Inline:
+            def __enter__(self):
+                par._tls.inline = getattr(par._tls, "inline", 0) + 1
+
+            def __exit__(self, *exc):
+                par._tls.inline -= 1
+                return False
+        return _Inline()
 
     def _ensure_pool(self) -> ThreadPoolExecutor:
         with self._lock:
@@ -58,7 +77,8 @@ class Parallelizer:
               stop: Optional[Callable[[], bool]] = None) -> None:
         if n <= 0:
             return
-        if self.workers <= 1 or n < self.INLINE_THRESHOLD:
+        if self.workers <= 1 or n < self.INLINE_THRESHOLD \
+                or getattr(self._tls, "inline", 0):
             for i in range(n):
                 if stop is not None and stop():
                     return

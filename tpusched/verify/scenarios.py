@@ -387,6 +387,244 @@ class CondHandoff(Scenario):
         assert ctx.flag, "notifier never ran"
 
 
+# -- sharded dispatch core (sched/shards.py, ISSUE 11) -------------------------
+
+
+def _pool_node(name: str, pool: str):
+    node = make_node(name)
+    from ..api.topology import LABEL_POOL
+    node.meta.labels[LABEL_POOL] = pool
+    return node
+
+
+@register
+class ShardCommitGuard(Scenario):
+    """Two shard dispatch cycles racing the optimistic commit on ONE pool
+    (the lost-update control of the sharded core).
+
+    Each actor replays a shard lane's exact commit protocol: capture the
+    pool's cursor atomically with the snapshot (``Cache.snapshot_view``),
+    decide a placement against that epoch, then commit through the
+    compare-and-assume (``Cache.assume_pod_guarded``).  Both target the
+    same pool, so their assumes conflict by construction.  Invariant: at
+    most ONE guarded commit may land per captured epoch — a schedule
+    where both commits succeed against the same cursor is the lost-update
+    the guard exists to stop (two placements computed against the same
+    free capacity, both bound).  Progress is also pinned: at least one
+    commit must land (the guard must not deadlock into mutual refusal)."""
+
+    name = "shard-commit-guard"
+
+    # commit tweak point: the seeded-bug variant bypasses the guard
+    def _commit(self, cache: Cache, pod, node: str, expected: int):
+        return cache.assume_pod_guarded(pod, node, expected) is not None
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, outcomes=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(_pool_node("a1", "pool-a"))
+        ctx.cache.add_node(_pool_node("a2", "pool-a"))
+        return ctx
+
+    def threads(self, ctx):
+        def lane(i: int):
+            def run():
+                view = ctx.cache.snapshot_view(["pool-a"])
+                expected = view.pool_cursors["pool-a"]
+                pod = make_pod(f"p{i}")
+                ok = self._commit(ctx.cache, pod, f"a{i + 1}", expected)
+                ctx.outcomes.append((i, expected, ok))
+            return run
+
+        return [lane(0), lane(1)]
+
+    def check(self, ctx):
+        committed = [(i, exp) for i, exp, ok in ctx.outcomes if ok]
+        assert committed, (
+            "neither lane's commit landed — the optimistic guard refused "
+            "both cycles (mutual-refusal livelock shape)")
+        by_epoch: Dict[int, int] = {}
+        for _, exp in committed:
+            by_epoch[exp] = by_epoch.get(exp, 0) + 1
+        for epoch, n in by_epoch.items():
+            assert n == 1, (
+                f"{n} commits landed against the SAME pool epoch "
+                f"{epoch} — a lost update: both cycles placed against "
+                f"identical free capacity and both bound")
+
+
+@register
+class ShardSnapshotEpochSwap(Scenario):
+    """Shard cycle vs. informer ingestion: a foreign mutation (a watch-
+    confirmed pod landing in the shard's pool) racing the window between
+    the shard's epoch capture and its commit.  Invariant: a guarded
+    commit that LANDED implies the foreign mutation did not land inside
+    the (capture, commit] window of that pool — i.e. the shard can never
+    bind a placement computed against a superseded epoch (the epoch-swap
+    analog of the equivalence cache's arming guard, applied to the
+    commit)."""
+
+    name = "shard-snapshot-epoch-swap"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, events=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(_pool_node("n1", "pool-a"))
+        ctx.cache.add_node(_pool_node("n2", "pool-a"))
+        return ctx
+
+    def threads(self, ctx):
+        def shard():
+            view = ctx.cache.snapshot_view(["pool-a"])
+            expected = view.pool_cursors["pool-a"]
+            ok = ctx.cache.assume_pod_guarded(
+                make_pod("own"), "n1", expected) is not None
+            # the commit verdict and the pool cursor it judged must be
+            # read as one fact (reentrant outer lock, as in
+            # EquivcacheArming's foreign actor)
+            with ctx.cache._lock:
+                ctx.events.append(
+                    ("commit", expected, ok,
+                     ctx.cache.pool_cursor("pool-a")))
+
+        def informer():
+            confirmed = make_pod("foreign", node_name="n2")
+            with ctx.cache._lock:
+                ctx.cache.add_pod(confirmed)
+                ctx.events.append(
+                    ("foreign", ctx.cache.pool_cursor("pool-a")))
+
+        return [shard, informer]
+
+    def check(self, ctx):
+        commits = [e for e in ctx.events if e[0] == "commit"]
+        assert commits, "shard actor never ran"
+        _, expected, ok, after = commits[0]
+        foreign = [e[1] for e in ctx.events if e[0] == "foreign"]
+        if ok:
+            for fcur in foreign:
+                assert not (expected < fcur <= after - 1), (
+                    f"guarded commit landed at cursor {after} although "
+                    f"the informer's mutation reached the pool at cursor "
+                    f"{fcur}, inside the (capture={expected}, commit] "
+                    f"window — the shard bound a placement computed "
+                    f"against a superseded epoch")
+        else:
+            assert foreign, (
+                "guarded commit was refused although no foreign mutation "
+                "ever touched the pool — a false conflict would serialize "
+                "shard lanes for nothing")
+
+
+@register
+class CrossShardGangQuorum(Scenario):
+    """Two shard lanes admitting members of ONE gang into DIFFERENT
+    pools, racing a watch confirm.  Pins the two facts gang admission
+    relies on under sharding: (1) commits into different pools never
+    falsely conflict (cross-pool traffic must not serialize — the point
+    of partitioning), and (2) the pg-assigned quorum index (the
+    Coscheduling permit barrier's input, shard-agnostic process state)
+    stays exact through any interleaving of guarded assumes and informer
+    confirms."""
+
+    name = "cross-shard-gang-quorum"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, outcomes=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(_pool_node("a1", "pool-a"))
+        ctx.cache.add_node(_pool_node("b1", "pool-b"))
+        ctx.member_a = make_pod("m-a", pod_group="g")
+        ctx.member_b = make_pod("m-b", pod_group="g")
+        return ctx
+
+    def threads(self, ctx):
+        def lane_a():
+            view = ctx.cache.snapshot_view(["pool-a"])
+            ok = ctx.cache.assume_pod_guarded(
+                ctx.member_a, "a1", view.pool_cursors["pool-a"])
+            ctx.outcomes.append(("a", ok is not None))
+
+        def lane_b():
+            view = ctx.cache.snapshot_view(["pool-b"])
+            ok = ctx.cache.assume_pod_guarded(
+                ctx.member_b, "b1", view.pool_cursors["pool-b"])
+            ctx.outcomes.append(("b", ok is not None))
+
+        def confirm_a():
+            # the watch-confirmed copy of member a (bind commit landing):
+            # replaces the assumed entry, must not double-count quorum
+            confirmed = make_pod("m-a", pod_group="g", node_name="a1")
+            ctx.cache.add_pod(confirmed)
+
+        return [lane_a, lane_b, confirm_a]
+
+    def check(self, ctx):
+        outcomes = dict(ctx.outcomes)
+        # pool-b sees no foreign traffic in any schedule: a refusal there
+        # would be a FALSE conflict (cross-pool serialization).  pool-a
+        # may legitimately refuse lane a when the watch confirm raced its
+        # (capture, commit] window — that is the guard doing its job.
+        assert outcomes.get("b") is True, (
+            f"lane b refused in a pool nothing else touched: {ctx.outcomes}"
+            f" — cross-pool traffic must never serialize the lanes")
+        snap = ctx.cache.snapshot()
+        n = snap.assigned_count("g", "default")
+        assert n == 2, (
+            f"permit-quorum index counts {n} assigned members of gang g "
+            f"(want exactly 2: member a — assumed or watch-confirmed, "
+            f"whichever won — plus member b) — Coscheduling would "
+            f"{'over' if n > 2 else 'under'}-admit the gang")
+
+
+@register
+class BindpoolMultiSubmitDrain(Scenario):
+    """_BindingPool shutdown-drain vs. TWO lanes submitting binding tasks
+    concurrently (the sharded core submits from every dispatch lane).
+    Extends the PR 8 race fix's scenario: with N submitters the post-put
+    re-check in submit() must guarantee EVERY task exactly one outcome —
+    executed or aborted — no matter how the puts interleave with the
+    drain."""
+
+    name = "bindpool-multi-submit-drain"
+
+    def setup(self):
+        from ..sched.scheduler import _BindingPool
+        ctx = SimpleNamespace(executed=[], aborted=[])
+        ctx.pool = _BindingPool(0)
+        return ctx
+
+    def threads(self, ctx):
+        def submitter(tag: str):
+            def run():
+                def fn(task):
+                    ctx.executed.append(task)
+
+                def abort(task):
+                    ctx.aborted.append(task)
+
+                try:
+                    ctx.pool.submit(fn, abort, tag)
+                except RuntimeError:
+                    abort(tag)
+            return run
+
+        def stopper():
+            ctx.pool.shutdown(timeout=0.1)
+
+        return [submitter("lane-0"), submitter("lane-1"), stopper]
+
+    def check(self, ctx):
+        for tag in ("lane-0", "lane-1"):
+            n = (ctx.executed.count(tag) + ctx.aborted.count(tag))
+            assert n == 1, (
+                f"task {tag} finished {ctx.executed.count(tag)}x and "
+                f"aborted {ctx.aborted.count(tag)}x (want exactly one "
+                f"outcome) — under multi-lane submission a task with no "
+                f"outcome leaks its reservation; two outcomes double-"
+                f"release it")
+
+
 # -- seeded-bug self-checks (non-vacuity) --------------------------------------
 
 
@@ -458,6 +696,23 @@ class SelfcheckBrokenArming(EquivcacheArming):
 
 
 @register
+class SelfcheckUnguardedCommit(ShardCommitGuard):
+    """DELIBERATE BUG: the shard commit bypasses the optimistic guard and
+    assumes unconditionally — exactly the stale-placement lost update the
+    compare-and-assume exists to stop.  The explorer must find the
+    schedule where both lanes capture the same pool epoch and both
+    commit."""
+
+    name = "selfcheck-unguarded-commit"
+
+    def _commit(self, cache: Cache, pod, node: str, expected: int):
+        cache.assume_pod(pod, node)     # no cursor compare: always "wins"
+        return True
+    # check() is inherited: the parent invariant fires exactly when two
+    # commits land against one captured epoch
+
+
+@register
 class SelfcheckTimeoutWake(Scenario):
     """A timed wait with no notifier: the only way forward is the
     explorer's timeout-fire decision — pins that ~decisions are taken,
@@ -485,4 +740,5 @@ class SelfcheckTimeoutWake(Scenario):
 
 
 LIVE_SCENARIOS = tuple(n for n in SCENARIOS if not n.startswith("selfcheck-"))
-SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming")
+SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming",
+                   "selfcheck-unguarded-commit")
