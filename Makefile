@@ -146,7 +146,7 @@ goodput-smoke:
 		tests/test_goodput_e2e.py -q -p no:cacheprovider
 
 .PHONY: tier1
-tier1: lint race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke
+tier1: lint native-smoke race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
@@ -170,6 +170,17 @@ lint-changed:
 .PHONY: native
 native:
 	$(PY) -c "from tpusched import native; assert native.available(), 'native build failed'; print('native engine OK')"
+
+# Native-smoke (the toolchain gate, part of the tier1 flow): build the
+# engine from source (hash-stamped rebuild — mtime checks misfire on fresh
+# checkouts and out-of-band .so rewrites), load it, run a tiny-grid
+# differential of the placement math AND the incremental window-index
+# kernels against the pure-Python implementations, and assert CLEAN
+# Python fallback when g++ is missing or TPUSCHED_NO_NATIVE=1.
+.PHONY: native-smoke
+native-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native_smoke.py \
+		-q -p no:cacheprovider
 
 # All four historical grep lints are tpulint rules now; `make verify` runs
 # the FULL rule suite in one interpreter pass (via `lint`) instead of four

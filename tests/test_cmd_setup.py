@@ -54,7 +54,8 @@ WANT = {
                                 "resource_weights": {"google.com/tpu": 1},
                                 "packing_weight": 0.7,
                                 "enable_slice_preemption": True,
-                                "slice_preemption_drain_seconds": 60.0}}),
+                                "slice_preemption_drain_seconds": 60.0,
+                                "index_differential_period": 0}}),
     ("multislice", "tpusched"): dict(
         pre_score=["MultiSlice"], score=[("MultiSlice", 3)],
         args={"MultiSlice": {"same_domain_score": 100,
@@ -82,7 +83,8 @@ WANT = {
                                 "resource_weights": {"google.com/tpu": 1},
                                 "packing_weight": 0.7,
                                 "enable_slice_preemption": False,
-                                "slice_preemption_drain_seconds": 60.0}}),
+                                "slice_preemption_drain_seconds": 60.0,
+                                "index_differential_period": 0}}),
     ("trimaran", "tpusched"): dict(
         score=[("TargetLoadPacking", 1)],
         args={"TargetLoadPacking": {

@@ -218,3 +218,68 @@ def test_resync_reconciles_missed_events():
     assert informer.get("default/doomed") is None
     assert keys(informer, "default/g1") == ["default/keep"]
     assert keys(informer, "default/g2") == ["default/born", "default/stale"]
+
+
+# -- unordered watch fan-out protection (ISSUE 13 root-cause fix) -------------
+#
+# The APIServer dispatches watch events OUTSIDE its store lock, on each
+# mutating caller's thread — so two racing writers can deliver their events
+# in the opposite of store order.  The informer imposes per-key order via
+# the globally monotonic resourceVersion: late events are dropped, never
+# resurrecting dead state in downstream caches (the scheduler cache counted
+# such phantoms as permanent occupancy — wedged gangs under storm churn).
+
+def _ev(type_, obj, old=None):
+    return srv.WatchEvent(type_, srv.PODS, obj, old)
+
+
+def _bound(name, rv, node="n1"):
+    p = make_pod(name, node_name=node)
+    p.meta.resource_version = rv
+    return p
+
+
+def test_late_modified_after_delete_is_dropped():
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    seen = []
+    informer.add_event_handler(on_add=lambda o: seen.append(("add", o)),
+                               on_update=lambda o, n: seen.append(("upd", n)),
+                               on_delete=lambda o: seen.append(("del", o)),
+                               replay=False)
+    informer._handle(_ev(srv.ADDED, _bound("p", 5)))
+    informer._handle(_ev(srv.DELETED, _bound("p", 7)))
+    # the bind-confirm MODIFIED (rv 7) overtaken by the DELETE: must NOT
+    # resurrect the pod in the informer cache or reach handlers
+    informer._handle(_ev(srv.MODIFIED, _bound("p", 7), _bound("p", 5)))
+    assert informer.get("default/p") is None
+    assert [k for k, _ in seen] == ["add", "del"]
+
+
+def test_late_delete_after_recreate_is_dropped():
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    seen = []
+    informer.add_event_handler(on_delete=lambda o: seen.append(o.meta.key),
+                               replay=False)
+    informer._handle(_ev(srv.ADDED, _bound("p", 5)))
+    # recreate (global rv counter: strictly newer) overtakes the old
+    # instance's DELETED in the fan-out
+    informer._handle(_ev(srv.ADDED, _bound("p", 9)))
+    informer._handle(_ev(srv.DELETED, _bound("p", 5)))   # dead predecessor
+    live = informer.get("default/p")
+    assert live is not None and live.meta.resource_version == 9
+    assert seen == []
+
+
+def test_genuine_recreate_after_delete_is_delivered():
+    api = srv.APIServer()
+    informer = InformerFactory(api).pods()
+    adds = []
+    informer.add_event_handler(on_add=lambda o: adds.append(
+        o.meta.resource_version), replay=False)
+    informer._handle(_ev(srv.ADDED, _bound("p", 5)))
+    informer._handle(_ev(srv.DELETED, _bound("p", 5)))
+    informer._handle(_ev(srv.ADDED, _bound("p", 8)))     # fresh instance
+    assert adds == [5, 8]
+    assert informer.get("default/p").meta.resource_version == 8

@@ -1,0 +1,131 @@
+"""native-smoke: the native-toolchain gate `make tier1` runs (ISSUE 13).
+
+Builds the C++ engine from source (hash-stamped — a fresh checkout or an
+out-of-band .so rewrite must rebuild, where the old mtime check silently
+served a stale library), loads it, runs a tiny-grid differential against
+the pure-Python implementations, and asserts CLEAN fallback when the
+toolchain is absent or TPUSCHED_NO_NATIVE=1 is set.
+"""
+import shutil
+
+import pytest
+
+from tpusched import native
+from tpusched.testing import make_tpu_pool
+from tpusched.topology.engine import (MaskGrid, enumerate_placement_masks,
+                                      feasible_membership)
+from tpusched.topology.torus import HostGrid, enumerate_placements
+
+
+@pytest.fixture(autouse=True)
+def _restore_native():
+    """Every test here pokes the loader's cached verdict; leave the
+    process with the real library (re)loaded."""
+    yield
+    native.reset_for_tests()
+    native.load()
+
+
+def _tiny():
+    topo, _ = make_tpu_pool("smoke", dims=(4, 4, 4))
+    grid = HostGrid.from_spec(topo.spec)
+    return grid, MaskGrid(grid)
+
+
+def test_native_builds_loads_and_matches_python_on_tiny_grid(monkeypatch):
+    if shutil.which("g++") is None and not native.available():
+        pytest.skip("no toolchain and no prebuilt library")
+    assert native.available(), "native engine failed to build/load"
+    grid, mgrid = _tiny()
+    shape = (4, 4, 2)
+    pset_native = enumerate_placement_masks(mgrid, shape)
+    ref = {frozenset(p) for p in enumerate_placements(grid, shape)}
+    assert {mgrid.coords_of(m) for m in pset_native.masks} == ref
+    free = mgrid.mask_of(frozenset(grid.coord_of.values()))
+    n_native, mem_native = feasible_membership(pset_native, 0, free, free)
+    monkeypatch.setattr(native, "load", lambda: None)
+    n_py, mem_py = feasible_membership(pset_native, 0, free, free)
+    assert (n_native, mem_native) == (n_py, mem_py)
+
+
+def test_window_index_kernels_differential(monkeypatch):
+    """The incremental-index kernels (postings/build/apply) agree between
+    the native and Python implementations on the same plane."""
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    from tpusched.topology.windowindex import _ShapeIndex
+    _, mgrid = _tiny()
+    shape = (2, 2, 4)
+    pset = enumerate_placement_masks(mgrid, shape)
+    all_free = (1 << mgrid.ncells) - 1
+
+    def run():
+        sidx = _ShapeIndex(shape, pset)
+        sidx.rebuild(all_free)
+        sidx.apply([(0, -1), (5, -1)])
+        sidx.apply([(0, 1)])
+        return (sidx.survivors, list(sidx.blocked[:sidx.n]),
+                list(sidx.membership[:sidx.ncells]), sidx.covered_int())
+
+    got_native = run()
+    monkeypatch.setattr(native, "load", lambda: None)
+    assert run() == got_native
+
+
+def test_clean_fallback_when_toolchain_missing(monkeypatch):
+    """A failing build (g++ absent/broken) must degrade to the Python
+    path, not raise into the scheduler."""
+    native.reset_for_tests()
+    monkeypatch.setattr(native, "_build",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            FileNotFoundError("g++: not found")))
+    monkeypatch.setattr(native, "_source_fingerprint",
+                        lambda src: "force-stale")
+    assert native.load() is None
+    assert not native.available()
+    grid, mgrid = _tiny()
+    pset = enumerate_placement_masks(mgrid, (4, 4, 2))   # reference path
+    assert len(pset.masks) > 0
+    free = mgrid.mask_of(frozenset(grid.coord_of.values()))
+    n, mem = feasible_membership(pset, 0, free, free)
+    assert n == len(pset.masks)
+    assert mem
+
+
+def test_clean_fallback_under_no_native_env(monkeypatch):
+    native.reset_for_tests()
+    monkeypatch.setenv("TPUSCHED_NO_NATIVE", "1")
+    assert native.load() is None
+    # the window index still runs, on its Python kernels
+    from tpusched.sched.cache import Cache
+    from tpusched.topology.windowindex import TorusWindowIndex
+    topo, nodes = make_tpu_pool("fallback", dims=(4, 4, 4))
+    cache = Cache()
+    idx = TorusWindowIndex(publish=False)
+    idx.observe_topology(topo)
+    cache.attach_window_index(idx)
+    for n in nodes:
+        cache.add_node(n)
+    snap = cache.snapshot()
+    q = idx.query(topo, (4, 4, 4), ("default", "g"), 4,
+                  snap.pool_cursors.get("fallback"))
+    assert q is not None and q.survivors == 1
+
+
+def test_stale_stamp_forces_rebuild():
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain")
+    from pathlib import Path
+    here = Path(native.__file__).resolve().parent
+    stamp = here / "_torus_engine.so.stamp"
+    old = stamp.read_text() if stamp.exists() else None
+    try:
+        stamp.write_text("deadbeef stale")
+        native.reset_for_tests()
+        lib = native.load()
+        assert lib is not None
+        assert stamp.read_text() != "deadbeef stale", (
+            "loader served the library without refreshing the stale stamp")
+    finally:
+        if old is not None and not stamp.exists():
+            stamp.write_text(old)

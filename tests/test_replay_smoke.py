@@ -229,6 +229,45 @@ def test_sharded_lockstep_replay_matches_single_lane(two_replays,
         assert row["routed_shard"].startswith("s")
 
 
+def test_window_index_lockstep_replay_matches_python_path(two_replays,
+                                                          smoke_trace,
+                                                          monkeypatch):
+    """ISSUE 13 (`make replay-smoke` native-index gate): replay the
+    recorded storm (a) with the window index serving AND the in-cycle
+    differential oracle re-checking EVERY served sweep, and (b) with the
+    index disabled (the pure Python full-recompute path).  The contract:
+    zero placement diffs between the arms, zero differential mismatches,
+    and non-vacuity (the index actually served sweeps in arm a)."""
+    from tpusched.topology.windowindex import TorusWindowIndex
+    from tpusched.util.metrics import torus_index_differential_mismatches
+    r1, _ = two_replays                       # index on, no differential
+    served = {"n": 0}
+    orig_query = TorusWindowIndex.query
+
+    def spy(self, *a, **k):
+        q = orig_query(self, *a, **k)
+        if q is not None:
+            served["n"] += 1
+        return q
+
+    monkeypatch.setattr(TorusWindowIndex, "query", spy)
+    monkeypatch.setenv("TPUSCHED_INDEX_DIFFERENTIAL", "1")
+    mism0 = torus_index_differential_mismatches.value()
+    r_diff = run_replay(smoke_trace)
+    assert served["n"] > 0, (
+        "the index never served a sweep — the lockstep gate is vacuous")
+    assert torus_index_differential_mismatches.value() == mism0, (
+        "the in-cycle oracle caught an index/full-path feasible-set "
+        "divergence during replay")
+    monkeypatch.delenv("TPUSCHED_INDEX_DIFFERENTIAL")
+    monkeypatch.setenv("TPUSCHED_NO_WINDOW_INDEX", "1")
+    r_py = run_replay(smoke_trace)
+    for arm, rep in (("differential", r_diff), ("no-index", r_py)):
+        diff = diff_placements(r1.to_dict(), rep.to_dict())
+        assert diff["identical"] is True, (arm, diff)
+        assert rep.binds == r1.binds, arm
+
+
 def test_diff_vs_recorded_reality_is_structured(two_replays, smoke_trace):
     r1, _ = two_replays
     real = recorded_reality(load_trace(smoke_trace))

@@ -1,0 +1,435 @@
+"""ISSUE 13: incremental torus window index (topology/windowindex.py).
+
+The load-bearing property: under ANY sequence of bind/unbind/assume/
+forget (gang rollback)/node-health/node-removal transitions, the
+incrementally-maintained index answers EXACTLY what (a) a from-scratch
+rebuild of the index answers, and (b) the Python full-recompute oracle
+(TopologyMatch._occupancy + feasible_membership) answers over a snapshot
+captured at the same pool cursor — for survivor sets, membership counts,
+assigned sets, utilization, AND the capacity plane / largest-placeable
+window.  Both kernel implementations (native C++ and pure Python) are
+driven through the same property.
+"""
+import copy
+from types import SimpleNamespace
+
+import pytest
+
+from tpusched import native
+from tpusched.api.core import NodeCondition
+from tpusched.api.resources import TPU
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import tpu_gang_profile
+from tpusched.obs.capacity import largest_window_chips, pool_occupancy
+from tpusched.plugins.topologymatch import COORD_ANNOTATION
+from tpusched.plugins.topologymatch.plugin import TopologyMatch
+from tpusched.sched.cache import Cache
+from tpusched.testing import (TestCluster, make_pod, make_pod_group,
+                              make_tpu_pool)
+from tpusched.topology.engine import (MaskGrid, enumerate_placement_masks,
+                                      feasible_membership)
+from tpusched.topology.torus import HostGrid
+from tpusched.topology.windowindex import TorusWindowIndex
+from tpusched.util.metrics import (torus_index_differential_mismatches,
+                                   torus_index_queries)
+
+POOL = "wix"
+DIMS = (4, 4, 4)              # v5p: host grid 2x2x4 = 16 hosts
+SHAPES = ((2, 2, 4), (4, 4, 4))
+GANGS = ("g0", "g1", "gx")    # gx never places: the empty-gang query
+
+
+@pytest.fixture(params=["native", "python"])
+def kernels(request, monkeypatch):
+    """Drive every test through both kernel implementations."""
+    if request.param == "python":
+        monkeypatch.setattr(native, "load", lambda: None)
+    elif not native.available():
+        pytest.skip("native engine unavailable (no toolchain)")
+    return request.param
+
+
+def build_world():
+    topo, nodes = make_tpu_pool(POOL, dims=DIMS)
+    cache = Cache()
+    idx = TorusWindowIndex(publish=False)
+    idx.observe_topology(topo)
+    cache.attach_window_index(idx)
+    for n in nodes:
+        cache.add_node(n)
+    grid = HostGrid.from_spec(topo.spec)
+    return SimpleNamespace(topo=topo, nodes=nodes, cache=cache, idx=idx,
+                           grid=grid, mgrid=MaskGrid(grid))
+
+
+def oracle_query(world, snapshot, gang, need, shape):
+    """The Python full-recompute path, verbatim semantics."""
+    fake = SimpleNamespace(_node_pg_usage=TopologyMatch._node_pg_usage)
+    assigned, free, eligible, util = TopologyMatch._occupancy(
+        fake, world.grid, snapshot, gang, "default", need)
+    pset = enumerate_placement_masks(world.mgrid, shape)
+    n, mem = feasible_membership(
+        pset, world.mgrid.mask_of(assigned), world.mgrid.mask_of(free),
+        world.mgrid.mask_of(eligible))
+    return n, mem, frozenset(assigned), util
+
+
+def assert_index_matches_oracle(world):
+    snap = world.cache.snapshot()
+    cursor = snap.pool_cursors.get(POOL)
+    for gang in GANGS:
+        for shape in SHAPES:
+            for need in (2, 4):
+                q = world.idx.query(world.topo, shape, ("default", gang),
+                                    need, cursor)
+                assert q is not None, "index refused at a matching cursor"
+                n, mem, asg, util = oracle_query(world, snap, gang, need,
+                                                 shape)
+                assert q.survivors == n, (gang, shape, need)
+                assert q.membership == mem, (gang, shape, need)
+                assert q.assigned == asg, (gang, shape, need)
+                assert abs(q.pool_util - util) < 1e-12
+    # capacity plane + largest placeable vs the reference implementation
+    free_set, free_chips, capacity = pool_occupancy(world.grid, snap)
+    view = world.idx.capacity_view(world.topo)
+    assert view is not None
+    assert view[0] == free_set
+    assert view[1] == free_chips
+    assert view[2] == capacity
+    lp = world.idx.largest_placeable(world.topo)
+    assert lp[0] == largest_window_chips(world.grid, free_set)
+
+
+def assert_incremental_equals_scratch(world):
+    """A fresh index seeded from the same cache must hold byte-identical
+    planes/blocked/membership state."""
+    scratch = TorusWindowIndex(publish=False)
+    scratch.observe_topology(world.topo)
+    world.cache.attach_window_index(scratch)
+    try:
+        for shape in SHAPES:
+            world.idx.ensure_shape(POOL, shape)
+            scratch.ensure_shape(POOL, shape)
+        inc = world.idx.debug_plane(POOL)
+        fresh = scratch.debug_plane(POOL)
+        for key in ("free_mask", "cap_mask", "gang_cells", "total_alloc",
+                    "total_used", "free_chips"):
+            assert inc[key] == fresh[key], key
+        for shape in SHAPES:
+            a, b = inc["shapes"][shape], fresh["shapes"][shape]
+            assert a["survivors"] == b["survivors"], shape
+            assert a["membership"] == b["membership"], shape
+            assert a["covered"] == b["covered"], shape
+            # blocked counts may differ only in how OVER-blocked a dead
+            # placement is... they cannot: both count the same cells
+            assert a["blocked"] == b["blocked"], shape
+    finally:
+        world.cache.attach_window_index(world.idx)
+
+
+# -- the property ------------------------------------------------------------
+
+def _ops_machine(world, ops):
+    """Interpret an op stream against the cache; pods are tracked so
+    unbind/forget target live keys."""
+    live = {}
+    counter = [0]
+    for kind, a, b in ops:
+        node = world.nodes[a % len(world.nodes)]
+        if kind == "bind":
+            counter[0] += 1
+            gang = GANGS[b % 2] if b % 3 else ""
+            chips = (1, 2, 4)[b % 3]
+            p = make_pod(f"p{counter[0]}", pod_group=gang,
+                         limits={TPU: chips}, node_name=node.name)
+            world.cache.add_pod(p)
+            live[p.key] = p
+        elif kind == "assume":
+            counter[0] += 1
+            gang = GANGS[b % 2]
+            p = make_pod(f"a{counter[0]}", pod_group=gang,
+                         limits={TPU: 4})
+            world.cache.assume_pod(p, node.name)
+            live[p.key] = p
+        elif kind == "forget" and live:
+            key = sorted(live)[b % len(live)]
+            world.cache.forget_pod(live.pop(key))
+        elif kind == "unbind" and live:
+            key = sorted(live)[b % len(live)]
+            world.cache.remove_pod(live.pop(key))
+        elif kind == "health":
+            info = world.cache._infos.get(node.name)
+            if info is None:
+                continue          # node currently removed
+            flipped = copy.deepcopy(info.node)
+            ready = any(c.type == "Ready" and c.status == "True"
+                        for c in flipped.status.conditions)
+            flipped.status.conditions = [NodeCondition(
+                type="Ready", status="False" if ready else "True")]
+            world.cache.update_node(flipped)
+        elif kind == "remove_node":
+            world.cache.remove_node(node)
+        elif kind == "add_node":
+            world.cache.add_node(copy.deepcopy(node))
+
+
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+    HAVE_HYPOTHESIS = True
+
+    OPS = st.lists(
+        st.tuples(
+            st.sampled_from(["bind", "assume", "forget", "unbind",
+                             "health", "remove_node", "add_node"]),
+            st.integers(min_value=0, max_value=31),
+            st.integers(min_value=0, max_value=31)),
+        max_size=24)
+
+    @given(ops=OPS)
+    @settings(max_examples=25, deadline=None,
+              suppress_health_check=[HealthCheck.function_scoped_fixture])
+    def test_random_transitions_match_oracle_and_scratch(kernels, ops):
+        world = build_world()
+        _ops_machine(world, ops)
+        assert_index_matches_oracle(world)
+        assert_incremental_equals_scratch(world)
+except ImportError:   # pragma: no cover - CI always has hypothesis
+    HAVE_HYPOTHESIS = False
+
+
+# -- unit coverage ------------------------------------------------------------
+
+def test_seeded_fuzz_transitions_match_oracle_and_scratch(kernels):
+    """Deterministic stand-in for the hypothesis property when hypothesis
+    is absent: 12 seeded random op streams through the same machine."""
+    import random
+    kinds = ["bind", "assume", "forget", "unbind", "health",
+             "remove_node", "add_node"]
+    for seed in range(12):
+        rng = random.Random(20260804 + seed)
+        ops = [(rng.choice(kinds), rng.randrange(32), rng.randrange(32))
+               for _ in range(rng.randrange(4, 28))]
+        world = build_world()
+        # assert mid-stream too: the second query after more deltas takes
+        # the memo PATCH path (dirty-cell repair), not a fresh build
+        half = len(ops) // 2
+        _ops_machine(world, ops[:half])
+        assert_index_matches_oracle(world)
+        _ops_machine(world, ops[half:])
+        assert_index_matches_oracle(world)
+        assert_incremental_equals_scratch(world)
+
+
+def test_basic_transitions_match_oracle(kernels):
+    """Deterministic spine of the property (runs even without
+    hypothesis): bind foreign + gang pods, flip health, roll back."""
+    world = build_world()
+    _ops_machine(world, [
+        ("bind", 0, 1), ("bind", 3, 2), ("assume", 5, 1),
+        ("health", 7, 0), ("bind", 9, 0),
+    ])
+    assert_index_matches_oracle(world)
+    _ops_machine(world, [
+        ("forget", 0, 0), ("remove_node", 11, 0), ("health", 7, 0),
+        ("unbind", 0, 0),
+    ])
+    assert_index_matches_oracle(world)      # memo patch path
+    assert_incremental_equals_scratch(world)
+
+
+def test_cursor_mismatch_falls_back(kernels):
+    world = build_world()
+    snap = world.cache.snapshot()
+    cursor = snap.pool_cursors[POOL]
+    # a mutation AFTER the snapshot: the index runs ahead of the epoch
+    world.cache.add_pod(make_pod("late", limits={TPU: 4},
+                                 node_name=world.nodes[0].name))
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           cursor) is None
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           None) is None
+    # the fresh epoch serves again
+    snap = world.cache.snapshot()
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           snap.pool_cursors[POOL]) is not None
+
+
+def test_topology_rv_change_refuses_until_resync(kernels):
+    world = build_world()
+    snap = world.cache.snapshot()
+    cursor = snap.pool_cursors[POOL]
+    newer = world.topo.deepcopy()
+    newer.meta.resource_version = world.topo.meta.resource_version + 7
+    assert world.idx.query(newer, SHAPES[0], ("default", "g0"), 4,
+                           cursor) is None
+    assert world.idx.observe_topology(newer)
+    world.cache.sync_window_index()
+    snap = world.cache.snapshot()
+    q = world.idx.query(newer, SHAPES[0], ("default", "g0"), 4,
+                        snap.pool_cursors[POOL])
+    assert q is not None and q.survivors > 0
+
+
+def test_mark_stale_quarantines_until_sync(kernels):
+    world = build_world()
+    snap = world.cache.snapshot()
+    cursor = snap.pool_cursors[POOL]
+    world.idx.mark_stale(POOL)
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           cursor) is None
+    world.cache.sync_window_index()
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           cursor) is not None
+    assert_index_matches_oracle(world)
+
+
+def test_mixed_pool_label_refuses(kernels):
+    world = build_world()
+    stray = copy.deepcopy(world.nodes[2])
+    stray.meta.labels["tpu.dev/pool"] = "elsewhere"
+    world.cache.update_node(stray)
+    snap = world.cache.snapshot()
+    assert world.idx.query(world.topo, SHAPES[0], ("default", "g0"), 4,
+                           snap.pool_cursors.get(POOL)) is None
+
+
+def test_window_exists_with_vacated_nodes(kernels):
+    world = build_world()
+    # fill the whole pool with foreign singletons: no window anywhere
+    for i, n in enumerate(world.nodes):
+        world.cache.add_pod(make_pod(f"f{i}", limits={TPU: 4},
+                                     node_name=n.name))
+    assert world.idx.window_exists_with(world.topo, (2, 2, 4)) is False
+    # vacating one full 1x1x4-host column's residents reopens it
+    # (node order: host coords (0,0,0..3) come first)
+    want = {n.name for n in world.nodes[:4]}
+    verdict = world.idx.window_exists_with(world.topo, (2, 2, 4), want)
+    assert verdict is True
+    # vacating a non-window scatter does not
+    scatter = {world.nodes[0].name, world.nodes[5].name}
+    assert world.idx.window_exists_with(world.topo, (2, 2, 4),
+                                        scatter) is False
+
+
+def test_defrag_pre_gate_consumes_index(kernels):
+    from tpusched.sim.defrag import _unit_could_open_window
+    world = build_world()
+    api = srv.APIServer()
+    api.create(srv.TPU_TOPOLOGIES, world.topo)
+    # the apiserver stamps a fresh resourceVersion: re-observe ITS copy so
+    # the gate's geometry check matches what api.list serves
+    world.idx.observe_topology(api.peek(srv.TPU_TOPOLOGIES, f"/{POOL}"))
+    world.cache.sync_window_index()
+    # residents split by z-slab: gang a on host z∈{0,1}, gang b on z∈{2,3}
+    # (node order is x,y,z row-major so i % 4 is the host z coordinate)
+    for i, n in enumerate(world.nodes):
+        gang = "resident-a" if i % 4 < 2 else "resident-b"
+        p = make_pod(f"r{i}", pod_group=gang, limits={TPU: 4},
+                     node_name=n.name)
+        api.create(srv.PODS, p)
+        world.cache.add_pod(p)
+    # a 4x4x2-chip slice needs a 2x2x2-host slab
+    job = dict(slice_shape="4x4x2", accelerator="", slices=1, members=8)
+    unit_a = (("default/resident-a", 8, 32),)
+    # vacating resident-a opens the z∈{0,1} slab
+    assert _unit_could_open_window(world.idx, api, unit_a, job)
+    # a unit vacating nothing new can never open an 8-host window
+    unit_none = (("default/solo", 1, 4),)
+    assert not _unit_could_open_window(world.idx, api, unit_none, job)
+    # no index = no pruning
+    assert _unit_could_open_window(None, api, unit_none, job)
+
+
+def test_placement_set_shared_across_rv(kernels):
+    world = build_world()
+    ps1 = world.idx.placement_set(world.topo, world.mgrid, SHAPES[0])
+    ps2 = world.idx.placement_set(world.topo, world.mgrid, SHAPES[0])
+    assert ps1 is ps2
+    ref = enumerate_placement_masks(world.mgrid, SHAPES[0])
+    assert set(ps1.masks) == set(ref.masks)
+
+
+# -- scheduler e2e ------------------------------------------------------------
+
+def _add_pool(c, pool, dims):
+    topo, nodes = make_tpu_pool(pool, dims=dims)
+    c.api.create(srv.TPU_TOPOLOGIES, topo)
+    c.add_nodes(nodes)
+    return topo, nodes
+
+
+def _slice_gang(c, name, shape, members):
+    c.api.create(srv.POD_GROUPS, make_pod_group(
+        name, min_member=members, tpu_slice_shape=shape,
+        tpu_accelerator="tpu-v5p"))
+    pods = [make_pod(f"{name}-{i}", pod_group=name, limits={TPU: 4})
+            for i in range(members)]
+    c.create_pods(pods)
+    return pods
+
+
+def test_scheduler_serves_from_index_with_differential(monkeypatch):
+    """End-to-end: a slice gang schedules with the index serving sweeps,
+    the in-cycle differential oracle (period 1 = every served sweep)
+    agreeing, and health/version surfaced."""
+    monkeypatch.setenv("TPUSCHED_INDEX_DIFFERENTIAL", "1")
+    served0 = torus_index_queries.with_labels("served").value()
+    mism0 = torus_index_differential_mismatches.value()
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        _add_pool(c, "e2e", dims=(4, 4, 4))
+        pods = _slice_gang(c, "gang", "4x4x4", 16)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)
+        for p in pods:
+            got = c.pod(p.key)
+            assert got.meta.annotations.get(COORD_ANNOTATION)
+        idx = c.scheduler.window_index
+        assert idx is not None
+        health = idx.health(c.scheduler.cache.pool_cursor)
+        assert "e2e" in health["pools"]
+        assert health["pools"]["e2e"]["cursor_lag"] == 0
+        assert health["updates_total"] > 0
+    assert torus_index_queries.with_labels("served").value() > served0
+    assert torus_index_differential_mismatches.value() == mism0, (
+        "index answer diverged from the Python oracle during e2e")
+
+
+def test_differential_mismatch_quarantines_and_self_heals(monkeypatch):
+    """Seeded drift: corrupt the live plane's survivor table; the next
+    served sweep's differential check must count a mismatch, quarantine
+    the pool, reseed it from the cache, and keep scheduling correctly."""
+    monkeypatch.setenv("TPUSCHED_INDEX_DIFFERENTIAL", "1")
+    mism0 = torus_index_differential_mismatches.value()
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        _add_pool(c, "heal", dims=(4, 4, 4))
+        first = _slice_gang(c, "first", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in first], timeout=20)
+        idx = c.scheduler.window_index
+        # seed drift: cook the survivor count + memo of the hot shape
+        with idx._lock:
+            plane = idx._planes["heal"]
+            sidx = plane.shapes[(2, 2, 4)]
+            sidx.survivors += 3
+            sidx.memo.clear()
+        second = _slice_gang(c, "second", "2x2x4", 4)
+        assert c.wait_for_pods_scheduled([p.key for p in second],
+                                         timeout=20)
+        assert torus_index_differential_mismatches.value() > mism0
+        # healed: the plane serves again and matches the oracle
+        snap = c.scheduler.cache.snapshot()
+        q = idx.query(c.api.peek(srv.TPU_TOPOLOGIES, "/heal"), (2, 2, 4),
+                      ("default", "nobody"), 4,
+                      snap.pool_cursors.get("heal"))
+        assert q is not None
+
+
+def test_scheduler_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("TPUSCHED_NO_WINDOW_INDEX", "1")
+    with TestCluster(profile=tpu_gang_profile(permit_wait_s=5,
+                                              denied_s=1)) as c:
+        _add_pool(c, "noidx", dims=(4, 4, 4))
+        pods = _slice_gang(c, "gang", "4x4x4", 16)
+        assert c.scheduler.window_index is None
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=20)

@@ -7,14 +7,16 @@ order; Listers read from that cache without touching the server.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from . import server as srv
 from ..util.locking import GuardedLock, guarded_by
 
 
 @guarded_by("_lock", "_cache", "_index_fns", "_indexes",
-            "_on_add", "_on_update", "_on_delete")
+            "_on_add", "_on_update", "_on_delete", "_tombstones",
+            "_pending")
 class Informer:
     def __init__(self, api: srv.APIServer, kind: str):
         self._api = api
@@ -28,6 +30,28 @@ class Informer:
         self._on_add: List[Callable[[Any], None]] = []
         self._on_update: List[Callable[[Any, Any], None]] = []
         self._on_delete: List[Callable[[Any], None]] = []
+        # Ordered delivery (ISSUE 13 root-cause fix): the APIServer fans
+        # watch events out OUTSIDE its store lock, on the MUTATING
+        # caller's thread — so two racing writers (a bind commit, a
+        # delete) can deliver their events in the OPPOSITE of store
+        # order.  Unordered, a late bind-confirm MODIFIED processed after
+        # the pod's DELETED resurrects the object in this cache AND in
+        # every downstream handler's state (the scheduler cache counted
+        # such phantoms as permanent occupancy — wedged gangs under
+        # storm churn).  Two defenses, both keyed on the store's globally
+        # monotonic resourceVersion:
+        #  - staleness rejection: an ADDED/MODIFIED at or below the rv we
+        #    already saw for the key (live or tombstoned) is dropped; a
+        #    DELETED carrying an instance older than the cached one is
+        #    dropped (a recreate already superseded it);
+        #  - serialized dispatch: cache mutation + event enqueue happen
+        #    under the informer lock, handlers drain FIFO under a
+        #    dedicated dispatch lock — per-informer handler order equals
+        #    cache-update order, without ever running handlers under the
+        #    informer lock (handlers may read listers).
+        self._tombstones: "OrderedDict[str, int]" = OrderedDict()
+        self._pending: Deque = deque()
+        self._dispatch_lock = GuardedLock("apiserver.InformerDispatch")
         api.add_watch(kind, self._handle, replay=True)
 
     def _index_insert_locked(self, obj) -> None:
@@ -46,8 +70,11 @@ class Informer:
                     if not bucket:
                         del self._indexes[name][val]
 
+    _TOMBSTONE_CAP = 4096
+
     def _handle(self, ev: srv.WatchEvent) -> None:
         key = ev.object.meta.key
+        rv = ev.object.meta.resource_version
         with self._lock:
             if ev.type == srv.DELETED:
                 # A DELETED for a key this informer never saw (replay race:
@@ -57,29 +84,73 @@ class Informer:
                 # absent entry means nothing to unindex — the event still
                 # fans out to handlers (client-go's DeletedFinalStateUnknown
                 # analog; handlers must be delete-idempotent).
-                old = self._cache.pop(key, None)
+                old = self._cache.get(key)
+                if old is not None and old.meta.resource_version > rv:
+                    # stale DELETED delivered late: the cached instance is
+                    # NEWER (a recreate's ADDED overtook this delete in the
+                    # unordered fan-out) — the delete belongs to a dead
+                    # predecessor, not the live object
+                    return
                 if old is not None:
+                    self._cache.pop(key)
                     self._index_remove_locked(old)
+                self._tombstone_locked(key, rv)
             else:
                 old = self._cache.get(key)
+                last = old.meta.resource_version if old is not None \
+                    else self._tombstones.get(key)
+                if last is not None and rv <= last:
+                    # stale reorder: we already saw this key at (or past)
+                    # this rv — a late bind-confirm MODIFIED overtaken by
+                    # the object's DELETED, or a replay ADDED overtaken by
+                    # a live update.  Delivering it would resurrect dead
+                    # state in every downstream cache.
+                    return
                 if old is not None:
                     self._index_remove_locked(old)
                 self._cache[key] = ev.object
                 self._index_insert_locked(ev.object)
-        # per-handler isolation (client-go's processor gives each listener
-        # its own delivery): one handler raising must not starve the other
-        # handlers of the event, nor propagate into the watch source —
-        # handlers run synchronously under the mutating API call here, so an
-        # unisolated raise would surface as a failure of an unrelated write
-        if ev.type == srv.ADDED:
-            for h in list(self._on_add):
-                self._dispatch(h, ev.object)
-        elif ev.type == srv.MODIFIED:
-            for h in list(self._on_update):
-                self._dispatch(h, ev.old_object, ev.object)
-        else:
-            for h in list(self._on_delete):
-                self._dispatch(h, ev.object)
+            self._pending.append(ev)
+        self._drain_pending()
+
+    def _tombstone_locked(self, key: str, rv: int) -> None:
+        """Remember the deleted instance's rv so late stale events for the
+        key are rejected.  Re-deleted keys move to the fresh end of the
+        bounded record: cap eviction must shed genuinely old tombstones,
+        not the hottest (most recently re-deleted) keys."""
+        tomb = self._tombstones
+        tomb[key] = max(rv, tomb.pop(key, 0))
+        while len(tomb) > self._TOMBSTONE_CAP:
+            tomb.popitem(last=False)
+
+    def _drain_pending(self) -> None:
+        """FIFO handler dispatch under the dedicated dispatch lock: events
+        enter ``_pending`` in cache-update order (informer lock), and
+        whichever thread holds the dispatch lock drains them in that order
+        — so handlers observe per-informer event order even though the
+        APIServer fans out on each mutating caller's thread.  Handlers
+        never run under the informer lock (they may read listers)."""
+        with self._dispatch_lock:
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        return
+                    ev = self._pending.popleft()
+                    if ev.type == srv.ADDED:
+                        handlers = [(h, (ev.object,))
+                                    for h in self._on_add]
+                    elif ev.type == srv.MODIFIED:
+                        handlers = [(h, (ev.old_object, ev.object))
+                                    for h in self._on_update]
+                    else:
+                        handlers = [(h, (ev.object,))
+                                    for h in self._on_delete]
+                # per-handler isolation (client-go's processor gives each
+                # listener its own delivery): one handler raising must not
+                # starve the other handlers of the event, nor propagate
+                # into the watch source
+                for h, args in handlers:
+                    self._dispatch(h, *args)
 
     def _dispatch(self, handler, *args) -> None:
         try:
@@ -219,18 +290,29 @@ class Informer:
             for old in deleted:
                 self._index_remove_locked(old)
                 del self._cache[old.meta.key]
+                # same staleness protection as a live DELETED: without the
+                # tombstone, a late reordered MODIFIED for the vanished key
+                # would resurrect it — and the resync path is exactly where
+                # missed/reordered history is most likely
+                self._tombstone_locked(old.meta.key,
+                                       old.meta.resource_version)
             for obj in added + [o for _, o in updated]:
                 self._cache[obj.meta.key] = obj
                 self._index_insert_locked(obj)
-        for obj in added:
-            for h in list(self._on_add):
-                self._dispatch(h, obj)
-        for old, obj in updated:
-            for h in list(self._on_update):
-                self._dispatch(h, old, obj)
-        for old in deleted:
-            for h in list(self._on_delete):
-                self._dispatch(h, old)
+            # synthesized deliveries enter the SAME ordered pending queue
+            # as live events (appended under the informer lock), so a
+            # concurrent live delivery cannot interleave handlers out of
+            # cache-update order
+            for obj in added:
+                self._pending.append(srv.WatchEvent(srv.ADDED, self.kind,
+                                                    obj))
+            for old, obj in updated:
+                self._pending.append(srv.WatchEvent(srv.MODIFIED, self.kind,
+                                                    obj, old))
+            for old in deleted:
+                self._pending.append(srv.WatchEvent(srv.DELETED, self.kind,
+                                                    old))
+        self._drain_pending()
 
     def close(self) -> None:
         """Detach from the API server's watch fan-out and drop handlers —

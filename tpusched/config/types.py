@@ -65,11 +65,24 @@ class TopologyMatchArgs:
     # graceful termination (k8s default 30s) or a sibling's failure mid-drain
     # evicts a second window
     slice_preemption_drain_seconds: float = 60.0
+    # Window-index differential oracle (ISSUE 13): every Nth pool sweep the
+    # index serves is re-run through the Python full-recompute path and the
+    # two answers (survivors, membership, assigned, utilization) must be
+    # identical — a mismatch counts into
+    # tpusched_torus_index_differential_mismatches_total, quarantines the
+    # pool's plane and reseeds it from the cache.  0 disables (production
+    # default); the TPUSCHED_INDEX_DIFFERENTIAL env overrides (the
+    # replay-smoke lockstep gate runs with it at 1 = every sweep).
+    index_differential_period: int = 0
 
     def validate(self) -> None:
         if not 0.0 <= self.packing_weight <= 1.0:
             raise ValueError(
                 f"packingWeight must be in [0, 1], got {self.packing_weight}")
+        if self.index_differential_period < 0:
+            raise ValueError(
+                f"indexDifferentialPeriod must be >= 0, got "
+                f"{self.index_differential_period}")
         if self.scoring_strategy not in ("LeastAllocated", "MostAllocated",
                                          "BalancedAllocation"):
             raise ValueError(

@@ -114,6 +114,12 @@ class Snapshot:
         self._infos: Dict[str, NodeInfo] = {}
         self._pg_assigned: Optional[Dict[str, int]] = None  # lazy gang index
         self._pg_live: Optional[Dict[str, int]] = None      # sans terminating
+        # per-pool mutation cursors this snapshot was captured at (set by
+        # sched.cache at build time; {} on hand-built test snapshots).  The
+        # torus window index's cursor-consistency rule compares a plane's
+        # version against THIS — equality proves the plane and the
+        # snapshot describe the same occupancy epoch for that pool.
+        self.pool_cursors: Dict[str, int] = {}
         for n in nodes:
             self._infos[n.name] = NodeInfo(n)
         for p in pods:

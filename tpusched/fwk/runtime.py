@@ -132,6 +132,14 @@ class PluginProfile:
     # from N concurrent lanes does not become the new serialization point.
     # Config YAML: `bindPoolWorkers`.
     bind_pool_workers: int = 0
+    # Incremental torus window index (topology/windowindex.py, ISSUE 13):
+    # per-(pool, shape) occupancy planes + window survivor/membership
+    # tables maintained O(Δcells) from cache transitions, serving
+    # TopologyMatch's PreFilter sweep, the capacity collector and the
+    # defrag pre-gate as table lookups.  False (or the
+    # TPUSCHED_NO_WINDOW_INDEX=1 env) keeps the classic per-cycle Python
+    # recompute as the only path.
+    torus_window_index: bool = True
 
     def effective_dispatch_shards(self) -> int:
         """Resolve the auto (0) setting; always >= 1."""

@@ -2,15 +2,21 @@
 
 The reference ships its whole runtime as a compiled binary (Go). The rebuild
 keeps Python as the control-plane glue but pushes the combinatorial
-scheduling math — torus placement enumeration and per-cycle feasibility /
-membership counting (tpusched/native/torus_engine.cc) — into a C++ shared
-library, consumed via ctypes.
+scheduling math — torus placement enumeration, per-cycle feasibility /
+membership counting, and the incremental window index's posting-list
+maintenance (tpusched/native/torus_engine.cc) — into a C++ shared library,
+consumed via ctypes.
 
-The library is built on demand from the in-tree source with g++ (cached next
-to the source; rebuilt when the source is newer). Every entry point degrades
-gracefully: if the toolchain or load fails, callers fall back to the pure-
-Python implementation in tpusched/topology/engine.py, which is differential-
-tested against the native one.
+The library is built on demand from the in-tree source with g++ and cached
+next to the source.  Staleness is decided by a SOURCE-HASH stamp
+(_torus_engine.so.stamp holding sha256(source || flags)), not mtimes: a
+fresh checkout, a git branch switch, or an artifact cache restore can give
+the source any mtime relative to the cached .so, and an mtime-only check
+silently served a stale library in exactly those cases.  Every entry point
+degrades gracefully: if the toolchain or load fails, callers fall back to
+the pure-Python implementations (tpusched/topology/engine.py,
+tpusched/topology/windowindex.py), which are differential-tested against
+the native ones.
 
 Set TPUSCHED_NO_NATIVE=1 to force the Python path (used by the differential
 tests themselves).
@@ -18,6 +24,7 @@ tests themselves).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -36,24 +43,61 @@ _CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC"]
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     u64p = ctypes.POINTER(ctypes.c_uint64)
     i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    i8p = ctypes.POINTER(ctypes.c_int8)
     lib.tpusched_enumerate_placements.restype = ctypes.c_int64
     lib.tpusched_enumerate_placements.argtypes = [
         i64p, u8p, ctypes.c_int32, i64p, ctypes.c_int32, u64p, ctypes.c_int64]
     lib.tpusched_feasible_membership.restype = ctypes.c_int64
     lib.tpusched_feasible_membership.argtypes = [
         u64p, ctypes.c_int64, ctypes.c_int32, u64p, u64p, u64p, i64p, u8p]
+    # incremental window index (ISSUE 13)
+    lib.tpusched_postings_count.restype = None
+    lib.tpusched_postings_count.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, i64p]
+    lib.tpusched_postings_fill.restype = None
+    lib.tpusched_postings_fill.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, i64p, i64p, i64p]
+    lib.tpusched_index_build.restype = ctypes.c_int64
+    lib.tpusched_index_build.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, u64p, i32p, i64p, u64p]
+    lib.tpusched_index_apply.restype = ctypes.c_int64
+    lib.tpusched_index_apply.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, i64p, i64p, i64p, i8p,
+        ctypes.c_int64, i32p, i64p, u64p]
     return lib
 
 
-def _build(src: Path, so: Path) -> None:
+def _source_fingerprint(src: Path) -> str:
+    h = hashlib.sha256()
+    h.update(src.read_bytes())
+    h.update(" ".join(_CXX_FLAGS).encode())
+    return h.hexdigest()
+
+
+def _build(src: Path, so: Path, fingerprint: str) -> None:
     tmp = so.with_suffix(f".tmp{os.getpid()}.so")
     cmd = ["g++", *_CXX_FLAGS, str(src), "-o", str(tmp)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)  # atomic: concurrent builders race benignly
+        stamp_tmp = so.with_suffix(f".stamptmp{os.getpid()}")
+        # the stamp binds SOURCE to ARTIFACT: an out-of-band .so rewrite
+        # (an older checkout's builder, an artifact-cache restore) changes
+        # the artifact hash and forces a rebuild here
+        stamp_tmp.write_text(f"{fingerprint} {_artifact_hash(so)}")
+        os.replace(stamp_tmp, _stamp_path(so))
     finally:
         tmp.unlink(missing_ok=True)
+
+
+def _artifact_hash(so: Path) -> str:
+    return hashlib.sha256(so.read_bytes()).hexdigest()
+
+
+def _stamp_path(so: Path) -> Path:
+    return so.with_suffix(".so.stamp")
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -72,9 +116,15 @@ def load() -> Optional[ctypes.CDLL]:
         src = here / "torus_engine.cc"
         so = here / "_torus_engine.so"
         try:
-            if (not so.exists()
-                    or so.stat().st_mtime < src.stat().st_mtime):
-                _build(src, so)
+            fingerprint = _source_fingerprint(src)
+            stamp = _stamp_path(so)
+            stale = True
+            if so.exists() and stamp.exists():
+                parts = stamp.read_text().split()
+                stale = (len(parts) != 2 or parts[0] != fingerprint
+                         or parts[1] != _artifact_hash(so))
+            if stale:
+                _build(src, so, fingerprint)
             _lib = _configure(ctypes.CDLL(str(so)))
         except Exception as e:
             klog.warning_s("native engine unavailable; using Python fallback",
@@ -86,3 +136,12 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def reset_for_tests() -> None:
+    """Drop the cached load verdict so a test can exercise the build/
+    fallback paths again (e.g. after monkeypatching TPUSCHED_NO_NATIVE)."""
+    global _lib, _attempted
+    with _lock:
+        _lib = None
+        _attempted = False

@@ -149,4 +149,124 @@ int64_t tpusched_feasible_membership(const uint64_t* masks, int64_t n,
   return survivors;
 }
 
+// -- incremental window index (ISSUE 13) -------------------------------------
+//
+// The per-(pool, shape) window index (tpusched/topology/windowindex.py)
+// maintains, against a pool's free-host occupancy plane:
+//   blocked[p]    — number of cells of placement p NOT currently free
+//                   (p survives iff blocked[p] == 0);
+//   membership[c] — number of SURVIVING placements covering cell c;
+//   covered       — bitmask of cells with membership > 0 (so the Python
+//                   side can build node→membership dicts by iterating set
+//                   bits instead of scanning every cell).
+// Cell→placement posting lists (CSR: offsets + pids) make a plane delta
+// O(Δcells × placements-per-cell) instead of the per-cycle
+// O(placements × words) sweep tpusched_feasible_membership pays.
+// All buffers are owned by the Python caller; the pure-Python fallback in
+// windowindex.py implements identical semantics and is differential-tested.
+
+// Pass 1: per-cell posting counts (counts must be zeroed, length ncells).
+void tpusched_postings_count(const uint64_t* masks, int64_t n, int32_t words,
+                             int64_t* counts) {
+  for (int64_t p = 0; p < n; ++p) {
+    const uint64_t* m = masks + p * words;
+    for (int32_t w = 0; w < words; ++w) {
+      uint64_t bits = m[w];
+      while (bits) {
+        const int b = __builtin_ctzll(bits);
+        ++counts[(static_cast<int64_t>(w) << 6) + b];
+        bits &= bits - 1;
+      }
+    }
+  }
+}
+
+// Pass 2: fill pids in CSR order. offsets (length ncells+1) is the
+// exclusive prefix sum over counts; fill_pos must be a zeroed scratch of
+// length ncells.
+void tpusched_postings_fill(const uint64_t* masks, int64_t n, int32_t words,
+                            const int64_t* offsets, int64_t* fill_pos,
+                            int64_t* pids) {
+  for (int64_t p = 0; p < n; ++p) {
+    const uint64_t* m = masks + p * words;
+    for (int32_t w = 0; w < words; ++w) {
+      uint64_t bits = m[w];
+      while (bits) {
+        const int b = __builtin_ctzll(bits);
+        const int64_t cell = (static_cast<int64_t>(w) << 6) + b;
+        pids[offsets[cell] + fill_pos[cell]++] = p;
+        bits &= bits - 1;
+      }
+    }
+  }
+}
+
+// From-scratch build of blocked/membership/covered against a free plane.
+// blocked (length n), membership (length ncells) and covered (length words)
+// must be zeroed by the caller. Returns the survivor count.
+int64_t tpusched_index_build(const uint64_t* masks, int64_t n, int32_t words,
+                             const uint64_t* free_mask, int32_t* blocked,
+                             int64_t* membership, uint64_t* covered) {
+  int64_t survivors = 0;
+  for (int64_t p = 0; p < n; ++p) {
+    const uint64_t* m = masks + p * words;
+    int32_t blk = 0;
+    for (int32_t w = 0; w < words; ++w) {
+      blk += __builtin_popcountll(m[w] & ~free_mask[w]);
+    }
+    blocked[p] = blk;
+    if (blk) continue;
+    ++survivors;
+    for (int32_t w = 0; w < words; ++w) {
+      uint64_t bits = m[w];
+      while (bits) {
+        const int b = __builtin_ctzll(bits);
+        const int64_t cell = (static_cast<int64_t>(w) << 6) + b;
+        if (++membership[cell] == 1) covered[w] |= (uint64_t{1} << b);
+        bits &= bits - 1;
+      }
+    }
+  }
+  return survivors;
+}
+
+// Apply a batch of free-plane cell transitions. dirs[i] = +1 when cells[i]
+// became free (blocked counts drop), -1 when it became unfree. Returns the
+// survivor-count DELTA.
+int64_t tpusched_index_apply(const uint64_t* masks, int64_t n, int32_t words,
+                             const int64_t* offsets, const int64_t* pids,
+                             const int64_t* cells, const int8_t* dirs,
+                             int64_t nchanged, int32_t* blocked,
+                             int64_t* membership, uint64_t* covered) {
+  int64_t delta = 0;
+  for (int64_t i = 0; i < nchanged; ++i) {
+    const int64_t cell = cells[i];
+    const int32_t dir = dirs[i];
+    for (int64_t k = offsets[cell]; k < offsets[cell + 1]; ++k) {
+      const int64_t p = pids[k];
+      const int32_t before = blocked[p];
+      blocked[p] = before - dir;
+      int32_t flip = 0;  // +1 placement revived, -1 placement died
+      if (dir > 0 && before == 1) flip = +1;
+      if (dir < 0 && before == 0) flip = -1;
+      if (!flip) continue;
+      delta += flip;
+      const uint64_t* m = masks + p * words;
+      for (int32_t w = 0; w < words; ++w) {
+        uint64_t bits = m[w];
+        while (bits) {
+          const int b = __builtin_ctzll(bits);
+          const int64_t c = (static_cast<int64_t>(w) << 6) + b;
+          membership[c] += flip;
+          if (membership[c] == 0) covered[w] &= ~(uint64_t{1} << b);
+          else if (flip > 0 && membership[c] == 1)
+            covered[w] |= (uint64_t{1} << b);
+          bits &= bits - 1;
+        }
+      }
+    }
+  }
+  return delta;
+}
+
 }  // extern "C"

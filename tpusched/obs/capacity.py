@@ -249,29 +249,47 @@ class CapacityTelemetry:
 
     def _refresh_pools(self, sched, snapshot, cursor: int) -> None:
         seen = set()
+        index = getattr(sched, "window_index", None)
         for topo in sched.informer_factory.tputopologies().items():
             grid = self._grid(topo)
             if grid is None:
                 continue
             pool = topo.spec.pool
             seen.add(pool)
-            # free/capacity: cheap O(pool hosts) walk, always fresh
-            free_set, free, capacity = pool_occupancy(grid, snapshot)
-            # largest-window search: memoized on (cursor, topo rv) AND
-            # rate-limited — an active cluster moves the cursor between
-            # every pair of scrapes, so the memo alone would re-run the
-            # search per scrape
+            # Window-index fast path (ISSUE 13): planes + totals are
+            # maintained incrementally, so the per-scrape O(pool hosts ×
+            # pods) occupancy walk disappears, and the largest-window
+            # search is memoized on the pool's OWN plane version instead
+            # of the fleet-global cursor (an idle pool answers for free
+            # while the rest of the fleet churns).  The collector's
+            # rate limit stays on top: a hot pool re-runs the bounded
+            # ladder at most once per frag_refresh_s.
+            view = index.capacity_view(topo) if index is not None else None
+            if view is not None:
+                free_set, free, capacity, version = view
+                memo_key = ("idx", version)
+            else:
+                # free/capacity: cheap O(pool hosts) walk, always fresh
+                free_set, free, capacity = pool_occupancy(grid, snapshot)
+                memo_key = cursor
+            # largest-window search: memoized on its arm's change witness
+            # (plane version / fleet cursor) + topo rv, AND rate-limited —
+            # an active cluster moves the witness between every pair of
+            # scrapes, so the memo alone would re-run the search per scrape
+            now = self._clock()
             memo = self._frag_memo.get(pool)
             rv = topo.meta.resource_version
-            now = self._clock()
             fresh = memo is not None and (
-                (memo[0] == cursor and memo[1] == rv)
+                (memo[0] == memo_key and memo[1] == rv)
                 or now - memo[2] < self._frag_refresh_s)
             if fresh:
                 largest = memo[3]
             else:
-                largest = largest_window_chips(grid, free_set)
-                self._frag_memo[pool] = [cursor, rv, now, largest]
+                lp = index.largest_placeable(topo) \
+                    if view is not None else None
+                largest = lp[0] if lp is not None \
+                    else largest_window_chips(grid, free_set)
+                self._frag_memo[pool] = [memo_key, rv, now, largest]
             pool_capacity_chips.with_labels(pool).set(capacity)
             pool_free_chips.with_labels(pool).set(free)
             pool_largest_placeable_chips.with_labels(pool).set(largest)

@@ -25,6 +25,7 @@ Mechanics per scheduling cycle:
 from __future__ import annotations
 
 import math
+import os
 
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
@@ -52,7 +53,9 @@ from ...sched.preemption import (atomic_set_eviction_vetoed,
                                  filter_pods_with_pdb_violation,
                                  gang_min_member)
 from ...util import klog
-from ...util.metrics import preemption_attempts, slice_preemption_victims
+from ...util.metrics import (preemption_attempts, slice_preemption_victims,
+                             torus_index_differential_mismatches,
+                             torus_index_queries)
 from ...util.ttlcache import TTLCache
 from ..defaults import (NodeName, NodeResourcesFit, NodeSelector,
                         NodeUnschedulable, TaintToleration)
@@ -121,9 +124,20 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         # gang full-name → pool name, set at Reserve: once any sibling is
         # placed, later siblings' PreFilter sweeps only that pool
         self._gang_pool: Dict[str, str] = {}
+        # window-index differential oracle sampling (ISSUE 13): every Nth
+        # index-served pool sweep is re-run through the Python full
+        # recompute and compared; env overrides the profile knob so gates
+        # (replay-smoke) can force it without a config fork
+        env_period = os.environ.get("TPUSCHED_INDEX_DIFFERENTIAL")
+        self._index_diff_period = int(env_period) if env_period \
+            else self.args.index_differential_period
+        self._index_diff_count = 0
         # warm the native engine at construction — its first load may compile
         # the C++ source, which must not stall a scheduling cycle
         native.load()
+
+    def _window_index(self):
+        return getattr(self.handle, "window_index", None)
 
     @classmethod
     def new(cls, args, handle) -> "TopologyMatch":
@@ -224,30 +238,62 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
             any_valid_pool = True
             matching.append((topo, acc, grids))
 
+        # Window-index fast path (ISSUE 13): when no freed-window claims
+        # are live and a pool's index plane is provably at this snapshot's
+        # cursor epoch, the whole occupancy scan + feasibility sweep below
+        # collapses into one table lookup.  Any doubt — claims live, plane
+        # version mismatch, topology CR drift — falls back to the Python
+        # full recompute, which stays the oracle.
+        index = self._window_index()
+        claims_live = bool(self._window_claims.items())
+        gang_key = (pod.namespace, pg.meta.name)
+        publish = getattr(self.handle, "telemetry", True)
+
+        def pool_answer(topo, acc, grids):
+            """(index_result_or_None, occupancy_or_None) for one pool."""
+            need = chips_needed if chips_needed is not None \
+                else acc.chips_per_host
+            q = None
+            if index is not None and not claims_live:
+                q = index.query(topo, shape, gang_key, need,
+                                snapshot.pool_cursors.get(topo.spec.pool))
+                if publish:
+                    torus_index_queries.with_labels(
+                        "served" if q is not None else "fallback").inc()
+                if q is not None and self._index_diff_due():
+                    q = self._index_differential(q, topo, grids, shape,
+                                                 need, snapshot, pg, pod)
+            if q is not None:
+                return q, None
+            return None, self._occupancy(grids[0], snapshot, pg.meta.name,
+                                         pod.namespace, need)
+
         def sweep(pools) -> _CycleStash:
             stash = _CycleStash()
             candidates = []
             for topo, acc, grids in pools:
-                occ = self._occupancy(grids[0], snapshot, pg.meta.name,
-                                      pod.namespace,
-                                      chips_needed if chips_needed is not None
-                                      else acc.chips_per_host)
-                candidates.append((topo, acc, grids, occ))
+                q, occ = pool_answer(topo, acc, grids)
+                candidates.append((topo, acc, grids, q, occ))
             # A gang must live in ONE torus: once any sibling is assigned in
             # a pool, every other pool is off the table (a "slice" spanning
             # two disjoint ICI fabrics would be unusable).
-            pinned = [c for c in candidates if c[3][0]]
+            pinned = [c for c in candidates
+                      if (c[3].assigned if c[3] is not None else c[4][0])]
             if pinned:
                 candidates = pinned
-            for topo, acc, (grid, mgrid), (assigned, free, eligible,
-                                           pool_util) in candidates:
-                pset = self._placements(topo, mgrid, shape)
-                claimed = self._claimed_mask(mgrid, grid, topo.key,
-                                             exclude=full)
-                n_survivors, membership = feasible_membership(
-                    pset, mgrid.mask_of(assigned),
-                    mgrid.mask_of(free) & ~claimed,
-                    mgrid.mask_of(eligible) & ~claimed)
+            for topo, acc, (grid, mgrid), q, occ in candidates:
+                if q is not None:
+                    n_survivors, membership, pool_util = \
+                        q.survivors, q.membership, q.pool_util
+                else:
+                    assigned, free, eligible, pool_util = occ
+                    pset = self._placements(topo, mgrid, shape)
+                    claimed = self._claimed_mask(mgrid, grid, topo.key,
+                                                 exclude=full)
+                    n_survivors, membership = feasible_membership(
+                        pset, mgrid.mask_of(assigned),
+                        mgrid.mask_of(free) & ~claimed,
+                        mgrid.mask_of(eligible) & ~claimed)
                 if not n_survivors:
                     continue
                 stash.survivors += n_survivors
@@ -314,6 +360,12 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         return grids
 
     def _placements(self, topo, mgrid: MaskGrid, chip_shape) -> PlacementSet:
+        index = self._window_index()
+        if index is not None:
+            # ONE enumeration fleet-wide: the index's per-(pool, shape)
+            # placement sets are shared by PreFilter, this plugin's
+            # PostFilter window sweep and the capacity ladder
+            return index.placement_set(topo, mgrid, tuple(chip_shape))
         key = (topo.key, topo.meta.resource_version, tuple(chip_shape))
         got = self._placement_cache.get(key)
         if got is None:
@@ -322,6 +374,45 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
                 self._placement_cache.clear()
             self._placement_cache[key] = got
         return got
+
+    # -- window-index differential oracle (ISSUE 13) --------------------------
+
+    def _index_diff_due(self) -> bool:
+        if self._index_diff_period <= 0:
+            return False
+        self._index_diff_count += 1
+        return self._index_diff_count % self._index_diff_period == 0
+
+    def _index_differential(self, q, topo, grids, shape, need, snapshot,
+                            pg, pod):
+        """Re-run the Python full recompute for one index-served pool sweep
+        and compare.  On mismatch: count, quarantine the pool's plane (it
+        reseeds from the cache) and return None so the caller uses the
+        oracle's answer this cycle."""
+        grid, mgrid = grids
+        assigned, free, eligible, util = self._occupancy(
+            grid, snapshot, pg.meta.name, pod.namespace, need)
+        pset = self._placements(topo, mgrid, shape)
+        n_survivors, membership = feasible_membership(
+            pset, mgrid.mask_of(assigned), mgrid.mask_of(free),
+            mgrid.mask_of(eligible))
+        if (n_survivors == q.survivors and membership == q.membership
+                and frozenset(assigned) == q.assigned
+                and abs(util - q.pool_util) < 1e-12):
+            return q
+        torus_index_differential_mismatches.inc()
+        klog.error_s(
+            RuntimeError("torus window index drift"),
+            "index answer differs from the Python oracle; quarantining "
+            "pool plane", pool=topo.spec.pool, pod=pod.key,
+            index_survivors=q.survivors, oracle_survivors=n_survivors)
+        index = self._window_index()
+        if index is not None:
+            index.mark_stale(topo.spec.pool)
+            resync = getattr(self.handle, "window_index_resync", None)
+            if resync is not None:
+                resync()
+        return None
 
     @staticmethod
     def _node_pg_usage(info: NodeInfo):
@@ -515,13 +606,22 @@ class TopologyMatch(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
 
         # candidate pools with the SAME one-torus pinning rule as PreFilter:
         # once a sibling is assigned in a pool, windows elsewhere are useless
+        # (the window index answers the assigned-set probe as a dict lookup
+        # when its plane matches this snapshot's cursor epoch)
+        index = self._window_index()
         candidates = []
         for topo, acc, grids, err in self._matching_pools(shape, want_acc):
             if err:
                 continue
-            assigned, _, _, _ = self._occupancy(
-                grids[0], snapshot, pg.meta.name, pod.namespace,
-                acc.chips_per_host)
+            assigned = None
+            if index is not None:
+                assigned = index.assigned_view(
+                    topo, (pod.namespace, pg.meta.name),
+                    snapshot.pool_cursors.get(topo.spec.pool))
+            if assigned is None:
+                assigned, _, _, _ = self._occupancy(
+                    grids[0], snapshot, pg.meta.name, pod.namespace,
+                    acc.chips_per_host)
             candidates.append((topo, grids, assigned))
         pinned = [c for c in candidates if c[2]]
         if pinned:

@@ -65,7 +65,8 @@ class CacheView:
 
 @guarded_by("_lock", "_infos", "_pods", "_assumed", "_node_clones",
             "_pg_assigned", "_mutation", "_snap_mutation", "_last_snapshot",
-            "_pool_mutation", "_pool_nodes", "_pool_members", "_part_snaps")
+            "_pool_mutation", "_pool_nodes", "_pool_members", "_part_snaps",
+            "_windex")
 class Cache:
     def __init__(self, clock=time.time):
         self._clock = clock
@@ -121,10 +122,19 @@ class Cache:
         # re-cloning the fleet on every foreign assume (the copy-on-write
         # epoch design of ROADMAP item 1).
         self._part_snaps: Dict[Tuple[str, ...], Tuple[Tuple, Snapshot]] = {}
+        # incremental torus window index (topology/windowindex.py, ISSUE
+        # 13): every structural mutation below feeds the index its
+        # occupancy delta IN THE SAME critical section as the cursor bump,
+        # so a plane whose version equals a snapshot's pool cursor is an
+        # exact witness of identical occupancy.  None = no index attached
+        # (TPUSCHED_NO_WINDOW_INDEX, or the index self-detached on error).
+        self._windex = None
 
-    def _bump_locked(self, pool: str) -> None:
+    def _bump_locked(self, pool: str) -> int:
         self._mutation += 1
-        self._pool_mutation[pool] = self._pool_mutation.get(pool, 0) + 1
+        cursor = self._pool_mutation.get(pool, 0) + 1
+        self._pool_mutation[pool] = cursor
+        return cursor
 
     def _pool_member_locked(self, pool: str, name: str, delta: int) -> None:
         if delta > 0:
@@ -146,6 +156,55 @@ class Cache:
             if not members:
                 self._pool_members.pop(pool, None)
 
+    # -- window index plumbing ------------------------------------------------
+
+    def attach_window_index(self, idx) -> None:
+        """Attach (or replace) the torus window index and seed it from the
+        CURRENT cache state + per-pool cursors in one critical section."""
+        with self._lock:
+            self._windex = idx
+            if idx is None:
+                return
+            try:
+                idx.cache_reset()
+                for info in self._infos.values():
+                    idx.cache_seed_node(info.node, info.pods)
+                idx.rebuild_stale(
+                    lambda p: self._pool_mutation.get(p, 0))
+            except Exception as e:  # noqa: BLE001 — the index is an
+                # accelerator: on ANY maintenance failure detach it and let
+                # every consumer fall back to the Python recompute path
+                klog.error_s(e, "window index attach failed; detaching")
+                self._windex = None
+
+    def window_index(self):
+        with self._lock:
+            return self._windex
+
+    def sync_window_index(self) -> None:
+        """Rebuild any stale index pools (topology CR change, differential
+        self-heal) atomically with their pool cursors."""
+        with self._lock:
+            idx = self._windex
+            if idx is None or not idx.stale_pools():
+                return
+            try:
+                idx.rebuild_stale(lambda p: self._pool_mutation.get(p, 0))
+            except Exception as e:  # noqa: BLE001 — see attach_window_index
+                klog.error_s(e, "window index rebuild failed; detaching")
+                self._windex = None
+
+    def _windex_call_locked(self, method: str, *args) -> None:
+        idx = self._windex
+        if idx is None:
+            return
+        try:
+            getattr(idx, method)(*args)
+        except Exception as e:  # noqa: BLE001 — see attach_window_index
+            klog.error_s(e, "window index update failed; detaching",
+                         hook=method)
+            self._windex = None
+
     def _pg_adjust_locked(self, pod: Pod, delta: int) -> None:
         name = pod.meta.labels.get(POD_GROUP_LABEL)
         if not name or not pod.spec.node_name:
@@ -162,14 +221,14 @@ class Cache:
     def add_node(self, node: Node) -> None:
         with self._lock:
             pool = pool_of_node(node)
-            self._bump_locked(pool)
+            stamps = [(pool, self._bump_locked(pool))]
             old = self._infos.get(node.name)
             if old is not None:
                 old_pool = pool_of_node(old.node)
                 if old_pool != pool:
                     # a replacement that MOVED pools dirties both: shards
                     # on either side of the move must see the change
-                    self._bump_locked(old_pool)
+                    stamps.append((old_pool, self._bump_locked(old_pool)))
                     self._pool_member_locked(old_pool, node.name, -1)
                     self._pool_member_locked(pool, node.name, +1)
                 for p in old.pods:
@@ -179,10 +238,14 @@ class Cache:
             info = NodeInfo(node)
             self._infos[node.name] = info
             # attach pods already known to live on this node
+            attached = []
             for p in self._pods.values():
                 if p.spec.node_name == node.name:
                     info.add_pod(p)
                     self._pg_adjust_locked(p, +1)
+                    attached.append(p)
+            self._windex_call_locked("cache_node_upsert", node, attached,
+                                     stamps)
 
     def update_node(self, node: Node) -> None:
         with self._lock:
@@ -192,12 +255,14 @@ class Cache:
             else:
                 pool = pool_of_node(node)
                 old_pool = pool_of_node(info.node)
-                self._bump_locked(pool)
+                stamps = [(pool, self._bump_locked(pool))]
                 if old_pool != pool:
-                    self._bump_locked(old_pool)
+                    stamps.append((old_pool, self._bump_locked(old_pool)))
                     self._pool_member_locked(old_pool, node.name, -1)
                     self._pool_member_locked(pool, node.name, +1)
                 info.set_node(node)
+                self._windex_call_locked("cache_node_upsert", node, None,
+                                         stamps)
 
     def remove_node(self, node: Node) -> list:
         """Drop a node AND reconcile the pod state attached to it — node
@@ -224,12 +289,16 @@ class Cache:
                 # cursor semantics unchanged: a no-op removal still reads
                 # as a mutation of the named node's pool (callers observed
                 # an event; shards re-validate cheaply)
-                self._bump_locked(pool_of_node(node))
+                pool = pool_of_node(node)
+                self._windex_call_locked("cache_note", pool,
+                                         self._bump_locked(pool))
                 return []
             pool = pool_of_node(info.node)
-            self._bump_locked(pool)
+            cursor = self._bump_locked(pool)
             self._pool_member_locked(pool, node.name, -1)
             self._node_clones.pop(node.name, None)
+            self._windex_call_locked("cache_node_removed", node.name,
+                                     [(pool, cursor)])
             affected = list(info.pods)
             deadline = self._clock() + ASSUME_EXPIRATION_S
             for p in affected:
@@ -245,15 +314,21 @@ class Cache:
     def _attach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None:
-            self._bump_locked(pool_of_node(info.node))
+            pool = pool_of_node(info.node)
+            cursor = self._bump_locked(pool)
             info.add_pod(pod)
             self._pg_adjust_locked(pod, +1)
+            self._windex_call_locked("cache_pod_delta", pod.spec.node_name,
+                                     pod, 1, [(pool, cursor)])
 
     def _detach_locked(self, pod: Pod) -> None:
         info = self._infos.get(pod.spec.node_name)
         if info is not None and info.remove_pod(pod):
-            self._bump_locked(pool_of_node(info.node))
+            pool = pool_of_node(info.node)
+            cursor = self._bump_locked(pool)
             self._pg_adjust_locked(pod, -1)
+            self._windex_call_locked("cache_pod_delta", pod.spec.node_name,
+                                     pod, -1, [(pool, cursor)])
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
         """Stores the caller's object by reference (upstream shares the pod
@@ -390,6 +465,7 @@ class Cache:
         infos = {name: self._clone_of_locked(name, info)
                  for name, info in self._infos.items()}
         snap = Snapshot.from_infos(infos, dict(self._pg_assigned))
+        snap.pool_cursors = dict(self._pool_mutation)
         self._snap_mutation = self._mutation
         self._last_snapshot = snap
         return snap
@@ -444,6 +520,7 @@ class Cache:
             # live-is-fresher is exactly what admission wants — the
             # quorum clock is shard-agnostic process state by design.
             snap = Snapshot.from_infos(infos, self._pg_assigned)
+            snap.pool_cursors = dict(cursors)
             if len(self._part_snaps) > 64:   # partition churn backstop
                 self._part_snaps.clear()
             self._part_snaps[key] = (sig, snap)

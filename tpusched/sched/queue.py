@@ -146,7 +146,8 @@ class _Heap:
 
 
 @guarded_by("_lock", "_active", "_backoff", "_backoff_keys",
-            "_unschedulable", "_pending_moves", "_last_gang", "_closed")
+            "_unschedulable", "_pending_moves", "_last_gang", "_closed",
+            "_in_cycle")
 class SchedulingQueue:
     def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
                  cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
@@ -190,6 +191,13 @@ class SchedulingQueue:
         # same-priority siblings so the equivalence cache actually hits
         self._last_gang: Optional[tuple] = None
         self._closed = False
+        # pods popped but whose scheduling cycle has not completed
+        # (cycle_done), counted ATOMICALLY with the pop itself: a popped
+        # pod is otherwise invisible to both queue depths and (until a
+        # bind lands) the store, and the replay driver's lockstep barrier
+        # needs "nothing pending AND nothing mid-cycle" to be one
+        # gap-free observation (sim/replay._quiesce)
+        self._in_cycle = 0
 
     def _bk_add_locked(self, key: str) -> None:
         self._backoff_keys[key] = self._backoff_keys.get(key, 0) + 1
@@ -200,6 +208,17 @@ class SchedulingQueue:
             self._backoff_keys.pop(key, None)
         else:
             self._backoff_keys[key] = n
+
+    def cycle_done(self) -> None:
+        """Pair of pop(): the popped pod's scheduling cycle completed (it
+        either resolved or re-entered a queue on its failure path)."""
+        with self._lock:
+            self._in_cycle -= 1
+
+    def in_cycle(self) -> int:
+        """Pods popped but not yet cycle_done — the mid-cycle population
+        invisible to pending_counts (GIL-atomic read)."""
+        return self._in_cycle
 
     def pending_counts(self) -> Dict[str, int]:
         """Queue depths for the pending_pods{queue=...} gauges (upstream
@@ -445,6 +464,7 @@ class SchedulingQueue:
                 info = self._pop_preferred_locked()
                 if info is not None:
                     info.attempts += 1
+                    self._in_cycle += 1
                     return info
                 wait = 0.2
                 if self._backoff:
@@ -589,6 +609,17 @@ class ShardedQueues:
 
     def lane_queue(self, lane: str) -> SchedulingQueue:
         return self._queues[lane]
+
+    def cycle_done(self, lane: Optional[str] = None) -> None:
+        """Pair of pop(lane=...): dispatch loops report cycle completion
+        back to the lane they popped from.  (lane=None compatibility pops
+        have no dispatch loop and never report; their counter drift is
+        invisible outside the replay barrier, which drives real loops.)"""
+        if lane is not None:
+            self._queues[lane].cycle_done()
+
+    def in_cycle(self) -> int:
+        return sum(q.in_cycle() for q in self._queues.values())
 
     def pending_counts(self) -> Dict[str, int]:
         total = {"active": 0, "backoff": 0, "unschedulable": 0}

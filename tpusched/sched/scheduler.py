@@ -507,6 +507,21 @@ class Scheduler:
         # (Coscheduling's gang-bound SLO clock checks it): a trial bind's
         # latency must not count into the production burn rate
         self.handle.telemetry = telemetry
+        # Incremental torus window index (topology/windowindex.py, ISSUE
+        # 13): attached to the cache so every structural mutation feeds it
+        # an O(Δcells) update inside the cache's own critical section;
+        # TopologyMatch / the capacity collector / the defrag advisor read
+        # it through the handle.  Shadows get a private publish=False
+        # instance (their forked-state maintenance must not count into the
+        # fleet's index metrics).
+        self.window_index = None
+        if profile.torus_window_index \
+                and not os.environ.get("TPUSCHED_NO_WINDOW_INDEX"):
+            from ..topology.windowindex import TorusWindowIndex
+            self.window_index = TorusWindowIndex(publish=telemetry)
+            self.cache.attach_window_index(self.window_index)
+        self.handle.window_index = self.window_index
+        self.handle.window_index_resync = self.cache.sync_window_index
         self._fw = Framework(registry, profile, self.handle)
 
         # Plugins without EnqueueExtensions default to all-events (upstream
@@ -665,6 +680,13 @@ class Scheduler:
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
+        # cycle liveness counters (plain ints, GIL-atomic): a popped pod
+        # mid-cycle is invisible to queue depths and (until it binds) to
+        # the store — the replay driver's lockstep barrier reads these to
+        # avoid applying the next recorded event while a cycle is still
+        # deciding against the previous epoch (sim/replay._quiesce)
+        self.cycles_started = 0
+        self.cycles_finished = 0
         # Binding cycles run on a bounded pool, dispatched only when the
         # permit barrier RESOLVES (Framework.notify_on_permit) — not one
         # parked thread per member. A 256-pod gang therefore costs zero
@@ -749,13 +771,41 @@ class Scheduler:
                               self.queue.move_all_to_active_or_backoff(RESOURCE_NODE, EVENT_ADD)),
             on_update=self._on_node_update,
             on_delete=self._on_node_delete)
-        for kind in (srv.POD_GROUPS, srv.ELASTIC_QUOTAS, srv.TPU_TOPOLOGIES):
+        for kind in (srv.POD_GROUPS, srv.ELASTIC_QUOTAS):
             res = _KIND_TO_RESOURCE[kind]
             self.informer_factory.informer(kind).add_event_handler(
                 on_add=lambda o, r=res: self._on_cr_event(r, EVENT_ADD),
                 on_update=lambda o, n, r=res: self._on_cr_event(r, EVENT_UPDATE),
                 on_delete=lambda o, r=res: self._on_cr_event(r, EVENT_DELETE),
                 replay=False)
+        # TpuTopology events additionally feed the window index its grid
+        # geometry (plane rebuilds are cursor-stamped via the cache)
+        topo_informer = self.informer_factory.informer(srv.TPU_TOPOLOGIES)
+        topo_informer.add_event_handler(
+            on_add=lambda t: self._on_topology_event(t, EVENT_ADD),
+            on_update=lambda o, t: self._on_topology_event(t, EVENT_UPDATE),
+            on_delete=self._on_topology_delete,
+            replay=False)
+        # CRs present before this scheduler constructed never replay: seed
+        # the index's geometry from the informer's current view
+        if self.window_index is not None:
+            pending = False
+            for t in topo_informer.items():
+                pending = self.window_index.observe_topology(t) or pending
+            if pending:
+                self.cache.sync_window_index()
+
+    def _on_topology_event(self, topo, action: int) -> None:
+        idx = self.window_index
+        if idx is not None and idx.observe_topology(topo):
+            self.cache.sync_window_index()
+        self._on_cr_event(RESOURCE_TPU_TOPOLOGY, action)
+
+    def _on_topology_delete(self, topo) -> None:
+        idx = self.window_index
+        if idx is not None:
+            idx.forget_topology(topo.spec.pool)
+        self._on_cr_event(RESOURCE_TPU_TOPOLOGY, EVENT_DELETE)
 
     def _on_cr_event(self, resource: str, action: int) -> None:
         if resource == RESOURCE_ELASTIC_QUOTA:
@@ -939,11 +989,12 @@ class Scheduler:
                 # exactly one lane (global) runs housekeeping; the sweep's
                 # state was never built for concurrent writers.
                 self._watchdog.sweep()
-                if self._sharded:
-                    now = time.monotonic()
-                    if now - last_health >= 1.0:
-                        last_health = now
+                now = time.monotonic()
+                if now - last_health >= 1.0:
+                    last_health = now
+                    if self._sharded:
                         self._publish_shard_health()
+                    self._publish_index_health()
             # degraded mode: pausing the pop IS the backoff — failed cycles
             # against a dead apiserver would only re-queue themselves
             pause = self._degraded.pause_remaining()
@@ -970,6 +1021,15 @@ class Scheduler:
                     klog.error_s(e2, "failure path panicked; requeueing",
                                  pod=info.pod.key)
                     self.queue.requeue_after_failure(info, to_backoff=True)
+            finally:
+                # close the pop→cycle visibility gap: the popped pod stayed
+                # counted (queue._in_cycle) from inside pop()'s own critical
+                # section until here — the replay lockstep barrier relies on
+                # "pending + mid-cycle == 0" being one gap-free observation
+                if self._sharded:
+                    self.queue.cycle_done(ctx.lane)
+                else:
+                    self.queue.cycle_done()
 
     def _publish_shard_health(self) -> None:
         """health.shards for /debug/flightrecorder: per-lane cycle/bind/
@@ -995,6 +1055,22 @@ class Scheduler:
             # advisory; a reporting bug must not take a dispatch lane down
             klog.V(4).info_s("shard health publish failed", err=str(e))
 
+    def _publish_index_health(self) -> None:
+        """health.torus_index for /debug/flightrecorder: per-pool index
+        version + cursor lag (staleness vs the live pool cursor), shape
+        survivor counts, and the cumulative maintenance counters — the
+        diagnosis surface for a native-fallback regression (doc/ops.md)."""
+        idx = self.window_index
+        if idx is None or not self._telemetry:
+            return
+        try:
+            self.recorder.set_health(
+                "torus_index", idx.health(self.cache.pool_cursor))
+        except Exception as e:  # noqa: BLE001 — health publishing is
+            # advisory; a reporting bug must not take the loop down
+            klog.V(4).info_s("torus index health publish failed",
+                             err=str(e))
+
     # -- one scheduling cycle -------------------------------------------------
 
     def _live_pod(self, key: str) -> Optional[Pod]:
@@ -1015,6 +1091,14 @@ class Scheduler:
 
     def schedule_one(self, info: QueuedPodInfo,
                      ctx: Optional[_LaneContext] = None) -> None:
+        self.cycles_started += 1
+        try:
+            self._schedule_one(info, ctx)
+        finally:
+            self.cycles_finished += 1
+
+    def _schedule_one(self, info: QueuedPodInfo,
+                      ctx: Optional[_LaneContext] = None) -> None:
         ctx = ctx or self._ctx_default
         pod = info.pod
         # skip pods deleted/bound while queued

@@ -254,6 +254,45 @@ def _try_moves(base: APIServer, profile, moves: List[Tuple[str, int, int]],
         sched.stop()
 
 
+def _unit_could_open_window(index, api: APIServer, unit,
+                            job_kw: dict) -> bool:
+    """Window-index pre-gate (ISSUE 13): a migration unit whose vacated
+    hosts PLUS the fleet's currently-free hosts still contain no window
+    for the target's slice shape in ANY pool cannot possibly admit the
+    target — skip its shadow trial.  Strictly advisory and conservative:
+    any doubt (no index, multislice target, a pool the index cannot
+    answer for) keeps the trial.  The index reflects the LIVE fleet; the
+    advisor's fork is taken from the same state, and every surviving
+    candidate is still verified by the full shadow trial."""
+    from ..api.topology import parse_shape
+    if index is None or job_kw.get("slices", 1) != 1:
+        return True
+    shape_s = job_kw.get("slice_shape")
+    if not shape_s:
+        return True
+    try:
+        shape = parse_shape(shape_s)
+    except ValueError:
+        return True
+    want_acc = job_kw.get("accelerator") or ""
+    vacated = set()
+    for full, _, _ in unit:
+        ns, gname = full.split("/", 1)
+        for p in api.list(srv.PODS, ns):
+            if (p.meta.labels.get(POD_GROUP_LABEL) == gname
+                    and p.spec.node_name):
+                vacated.add(p.spec.node_name)
+    saw_pool = False
+    for topo in api.list(srv.TPU_TOPOLOGIES):
+        if want_acc and topo.spec.accelerator != want_acc:
+            continue
+        saw_pool = True
+        verdict = index.window_exists_with(topo, shape, vacated)
+        if verdict is None or verdict:
+            return True
+    return not saw_pool
+
+
 def suggest_migrations(source_api: Optional[APIServer] = None,
                        state_dir: Optional[str] = None, *,
                        job: dict,
@@ -263,7 +302,8 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
                        candidates: Optional[List[str]] = None,
                        timeout_s: float = 20.0,
                        config_path: Optional[str] = None,
-                       scheduler_name: Optional[str] = None
+                       scheduler_name: Optional[str] = None,
+                       window_index=None
                        ) -> List[MigrationSuggestion]:
     """Migration plans that admit ``job`` (simulate_gang gang kwargs;
     ``members`` required). Candidates default to every fully-bound gang,
@@ -277,6 +317,12 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
     (combined footprint ascending, at most ``max_pair_trials`` shadow
     runs) when the quota of single-unit plans isn't met — the fleet
     regime where no one migration opens a window but two do.
+
+    ``window_index``: the live scheduler's torus window index (ISSUE 13),
+    when available — units whose vacated hosts provably cannot open a
+    window for the target's slice shape skip their shadow trial entirely
+    (the pre-gate is mask math over maintained planes; every surviving
+    candidate still runs the full verified trial).
 
     Returns up to ``max_suggestions`` plans, cheapest-first; empty list =
     no plan within the search bounds (the job needs more moves, preemption,
@@ -320,6 +366,8 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
     for unit in units:
         if len(suggestions) >= max_suggestions:
             return suggestions
+        if not _unit_could_open_window(window_index, base, unit, job_kw):
+            continue   # provably hopeless: skip the shadow trial
         result = _try_moves(base, profile, list(unit), job_kw, timeout_s)
         if result is not None:
             suggestions.append(MigrationSuggestion(moves=result[1],
@@ -334,6 +382,10 @@ def suggest_migrations(source_api: Optional[APIServer] = None,
     for pair in pairs:
         if len(suggestions) >= max_suggestions or trials >= max_pair_trials:
             break
+        if not _unit_could_open_window(window_index, base,
+                                       list(pair[0]) + list(pair[1]),
+                                       job_kw):
+            continue   # gate does not burn the bounded trial budget
         trials += 1
         result = _try_moves(base, profile, list(pair[0]) + list(pair[1]),
                             job_kw, timeout_s)

@@ -223,24 +223,44 @@ def apply_event(api: APIServer, ev: dict, *,
 
 def _quiesce(api: APIServer, sched: Scheduler, settle_s: float,
              timeout_s: float) -> bool:
-    """Lockstep barrier: the store cursor has not moved and the active
-    queue is empty for a settle window.  Pods parked at a permit barrier
-    (gang waiting for siblings) or in unschedulableQ are quiescent by
-    design — the next recorded event is what un-sticks them."""
+    """Lockstep barrier: the store cursor has not moved, the active queue
+    is empty, and NO scheduling cycle is in flight or newly started, for a
+    settle window.  Pods parked at a permit barrier (gang waiting for
+    siblings) or in unschedulableQ are quiescent by design — the next
+    recorded event is what un-sticks them.  The cycle counters matter: a
+    popped pod mid-cycle is invisible to queue depths and (until a bind
+    lands) to the store, so without them the barrier could release while
+    a cycle is still deciding — the next event would then race that
+    cycle's snapshot, and whether the sweep sees the event varies run to
+    run (the divergence gets MORE likely the faster cycles get; the torus
+    window index made it reproducible)."""
     deadline = time.monotonic() + timeout_s
     last_rv = -1
+    last_started = -1
     stable_since: Optional[float] = None
     while time.monotonic() < deadline:
         rv = api.current_resource_version()
-        active = sched.queue.pending_counts().get("active", 0)
+        pending = sched.queue.pending_counts()
+        # backoff counts as active: deterministic mode zeroes pod backoff,
+        # so a backoffQ resident is imminently poppable — releasing the
+        # barrier over it lets the next event race the pod's flush+pop
+        active = pending.get("active", 0) + pending.get("backoff", 0)
+        started = sched.cycles_started
+        # queue-side mid-cycle census (counted inside pop()'s critical
+        # section): gap-free where the scheduler-side counters have a
+        # pop→increment window
+        in_flight = (started - sched.cycles_finished
+                     + sched.queue.in_cycle())
         now = time.monotonic()
-        if rv == last_rv and active == 0:
+        if rv == last_rv and active == 0 and in_flight == 0 \
+                and started == last_started:
             if stable_since is None:
                 stable_since = now
             elif now - stable_since >= settle_s:
                 return True
         else:
             last_rv = rv
+            last_started = started
             stable_since = None
         time.sleep(0.002)
     return False
@@ -310,11 +330,34 @@ def run_replay(trace_dir: str, *,
         if cos is not None:
             plugin_args["Coscheduling"] = dataclasses.replace(
                 cos, denied_pg_expiration_time_seconds=0)
+        # the stuck-gang watchdog is a wall-clock retry gate too: its
+        # force-reactivation of parked members fires at a wall instant
+        # that lands on a run-dependent event boundary (a ~30 s replay
+        # straddles the 30 s default), giving pods extra retries whose
+        # outcomes race the event pacing — the faster the cycles (the
+        # torus window index), the more visibly two runs diverge.  0
+        # disables it; replay retries stay purely event-driven.
         prof = dataclasses.replace(prof, parallelism=1,
                                    percentage_of_nodes_to_score=100,
                                    pod_initial_backoff_s=0.0,
                                    pod_max_backoff_s=0.0,
+                                   stuck_gang_after_s=0.0,
                                    plugin_args=plugin_args)
+        if prof.effective_dispatch_shards() > 1:
+            # SHARDED determinism replays pin the pre-index sweep path:
+            # with N concurrent lanes, the queue's lazily-coalesced
+            # cluster-event moves drain at wall-clock ticks (lane pop
+            # timeouts, observer reads), and at window-index cycle speeds
+            # (~50 µs sweeps) whether a parked gang's retry drains before
+            # or after the next event becomes a run-dependent coin flip —
+            # retry ordinals drift and contended placements diverge.  The
+            # index's functional equivalence is gated separately where
+            # pacing is airtight: the shards=1 lockstep index-on-vs-off
+            # gate (zero placement diffs) and the sampled in-cycle
+            # differential oracle.  Making the move drain event-logical
+            # (so sharded replays can keep the index) is a known
+            # follow-up.
+            prof = dataclasses.replace(prof, torus_window_index=False)
 
     api = APIServer()
     for kind, objs in trace.objects.items():

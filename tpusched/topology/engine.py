@@ -20,6 +20,7 @@ import ctypes
 from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
 
 from .. import native
+from ..util import tracectx
 from .torus import (Coord, HostGrid, candidate_host_blocks,
                     enumerate_placements)
 
@@ -82,11 +83,17 @@ class PlacementSet:
     def packed(self) -> ctypes.Array:
         if self._packed is None:
             words = self.mgrid.words
-            buf = (ctypes.c_uint64 * (len(self.masks) * words))()
+            nbytes = words * 8
+            # bulk conversion: int.to_bytes emits the little-endian word
+            # layout the native ABI expects directly, so one bytearray
+            # splice per placement replaces the word-by-word Python loop
+            # (this build also feeds the window index's posting lists)
+            raw = bytearray(len(self.masks) * nbytes)
             for i, m in enumerate(self.masks):
-                for w in range(words):
-                    buf[i * words + w] = (m >> (64 * w)) & 0xFFFFFFFFFFFFFFFF
-            self._packed = buf
+                raw[i * nbytes:(i + 1) * nbytes] = m.to_bytes(nbytes,
+                                                              "little")
+            self._packed = (ctypes.c_uint64 * (
+                len(self.masks) * words)).from_buffer_copy(raw)
         return self._packed
 
 
@@ -147,10 +154,16 @@ def feasible_membership(
     if lib is not None and pset.masks:
         words = mgrid.words
         membership = (ctypes.c_int64 * mgrid.ncells)()
-        survivors = lib.tpusched_feasible_membership(
-            pset.packed(), len(pset.masks), words,
-            _to_words(assigned, words), _to_words(free, words),
-            _to_words(eligible, words), membership, None)
+        # profiler attribution: native sweep time shows up as its own
+        # /debug/profile plugin row instead of melting into TopologyMatch
+        prev = tracectx.set_plugin("native:torus_engine")
+        try:
+            survivors = lib.tpusched_feasible_membership(
+                pset.packed(), len(pset.masks), words,
+                _to_words(assigned, words), _to_words(free, words),
+                _to_words(eligible, words), membership, None)
+        finally:
+            tracectx.set_plugin(prev)
         counts: Dict[str, int] = {}
         for cell in range(mgrid.ncells):
             if membership[cell]:

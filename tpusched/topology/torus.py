@@ -35,13 +35,20 @@ Placement = FrozenSet[Coord]   # set of host coords (host units)
 # Memo caches for the two pure shape functions below. Every PreFilter of a
 # slice pod evaluates them once per pool (a 1024-host/16-pool fleet pays
 # ~32 calls per pod per cycle); the result depends only on (shape,
-# accelerator, dims) — a handful of distinct keys fleet-wide. Bounded:
-# cleared wholesale if an adversarial stream of unique shapes ever grows
-# them past the cap (correct, just cold again).
+# accelerator, dims) — a handful of distinct keys fleet-wide. Bounded by
+# FIFO eviction of the OLDEST entry at the cap (dicts iterate in insertion
+# order): an adversarial stream of unique shapes can only cycle the cold
+# tail, it can never wipe the hot keys the live fleet re-reads every cycle
+# the way the old wholesale clear() did.
 _CACHE_CAP = 4096
 _blocks_cache: dict = {}
 _validate_cache: dict = {}
 _MISS = object()
+
+
+def _evict_oldest(cache: dict) -> None:
+    while len(cache) >= _CACHE_CAP:
+        cache.pop(next(iter(cache)))
 
 
 def candidate_host_blocks(chip_shape: Coord, acc: TpuAccelerator,
@@ -68,8 +75,7 @@ def candidate_host_blocks(chip_shape: Coord, acc: TpuAccelerator,
     # a mutable cached list would let one caller's sort/append poison
     # feasibility answers fleet-wide
     out = tuple(dict.fromkeys(blocks))
-    if len(_blocks_cache) >= _CACHE_CAP:
-        _blocks_cache.clear()
+    _evict_oldest(_blocks_cache)
     _blocks_cache[key] = out
     return out
 
@@ -96,8 +102,7 @@ def validate_slice_shape(shape: Coord, acc: TpuAccelerator,
                    f"{pool_dims} (host extent {extent}) under any rotation")
         else:
             err = None
-    if len(_validate_cache) >= _CACHE_CAP:
-        _validate_cache.clear()
+    _evict_oldest(_validate_cache)
     _validate_cache[key] = err
     return err
 

@@ -1,0 +1,1009 @@
+"""Incrementally-maintained torus window index (ISSUE 13, ROADMAP item 2).
+
+Today every TopologyMatch PreFilter of a slice pod pays an O(pool-hosts)
+occupancy snapshot scan plus an O(placements × words) feasibility sweep, and
+the capacity collector independently re-derives the largest-placeable window
+by existence-probing the placement generator.  This module replaces those
+per-cycle recomputations with ONE index maintained O(Δcells) from the
+scheduler cache's existing transition points:
+
+- per-pool OCCUPANCY PLANES (free / capacity-free bitsets, per-gang cell
+  masks, chip totals) derived from per-node facts fed by ``sched/cache.py``
+  at assume/confirm/forget/add/remove/health-flip time, inside the cache's
+  own critical sections;
+- per-(pool, chip-shape) WINDOW INDEXES: the placement-mask set, cell→
+  placement CSR posting lists, live per-placement blocked counts, survivor
+  count, and per-cell membership — a plane delta re-evaluates only the
+  placements whose masks intersect the touched cells
+  (native ``tpusched_index_apply``; pure-Python twin below);
+- one READ SURFACE shared by TopologyMatch (PreFilter/Filter/Score inputs,
+  PostFilter's window search), the capacity collector
+  (``pool_largest_placeable_chips`` / fragmentation) and the defrag
+  advisor's pre-gate.
+
+Consistency rule (the cursor-consistency contract, doc/performance.md):
+every plane stores the per-pool mutation cursor it was updated at, written
+ATOMICALLY with the data delta while the cache lock is held.  A reader may
+consume an answer only when the plane's version equals the pool cursor its
+OWN snapshot was captured at (``Snapshot.pool_cursors``); any mismatch —
+the index ran ahead of the snapshot, a topology CR changed, a node's pool
+label disagrees with the CR — falls back to the Python full-recompute
+path, which stays the differential oracle (sampled in-cycle via
+``TopologyMatchArgs.index_differential_period``) and the graceful-degrade
+path when the index is disabled (``TPUSCHED_NO_WINDOW_INDEX=1``).  With
+``TPUSCHED_NO_NATIVE=1`` the index still runs, on its pure-Python kernels.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from .. import native
+from ..api.core import Node, Pod, node_health_error
+from ..api.resources import TPU
+from ..api.scheduling import POD_GROUP_LABEL
+from ..api.topology import LABEL_POOL
+from ..util import tracectx
+from ..util.locking import GuardedLock, guarded_by
+from ..util.metrics import (torus_index_cells_touched_total,
+                            torus_index_rebuilds_total,
+                            torus_index_updates_total)
+from .engine import MaskGrid, PlacementSet, enumerate_placement_masks
+from .torus import HostGrid
+
+GangKey = Tuple[str, Optional[str]]          # (namespace, pod-group label)
+
+
+def gang_key_of(pod: Pod) -> GangKey:
+    return (pod.meta.namespace, pod.meta.labels.get(POD_GROUP_LABEL))
+
+
+def _pod_usage(pod: Pod) -> Tuple[int, bool]:
+    """(whole chips, counts-as-TPU-pod) — the same accounting the plugin's
+    ``_node_pg_usage`` (chip sums for window math) and the capacity
+    collector's ``_node_chip_usage`` (chips-or-memory presence for the
+    capacity plane) apply."""
+    from ..plugins.tpuslice.chip_node import pod_tpu_limits
+    chips, chips_set, _, mem_set = pod_tpu_limits(pod)
+    return chips, (chips_set or mem_set)
+
+
+class WindowQuery:
+    """One pool's PreFilter answer served from the index: identical to
+    ``feasible_membership`` over ``_occupancy`` on a same-cursor snapshot.
+    ``membership`` is a SHARED memoized dict — read-only by contract."""
+
+    __slots__ = ("survivors", "membership", "assigned", "pool_util")
+
+    def __init__(self, survivors: int, membership: Dict[str, int],
+                 assigned: FrozenSet, pool_util: float):
+        self.survivors = survivors
+        self.membership = membership
+        self.assigned = assigned
+        self.pool_util = pool_util
+
+
+class _NodeFact:
+    """Per-node occupancy facts, grid-independent (keyed by node name so a
+    TpuTopology re-layout only re-materializes planes, never re-derives
+    usage)."""
+
+    __slots__ = ("pool", "alloc", "used", "tpu_pods", "owners", "healthy")
+
+    def __init__(self) -> None:
+        self.pool = ""
+        self.alloc = 0
+        self.used = 0                     # whole chips over every pod
+        self.tpu_pods = 0                 # pods with any TPU chip/mem ask
+        # (namespace, pg-label-or-None) → [chips, pod count]; every pod
+        # contributes an entry (the plugin's has_sibling test counts any
+        # resident pod of the gang, TPU or not)
+        self.owners: Dict[GangKey, List[int]] = {}
+        self.healthy = True
+
+
+def _to_words(mask: int, words: int) -> ctypes.Array:
+    return (ctypes.c_uint64 * words).from_buffer_copy(
+        mask.to_bytes(words * 8, "little"))
+
+
+class _ShapeIndex:
+    """Window index for one (pool, chip shape): placement masks, CSR
+    posting lists, live blocked counts / survivor count / membership."""
+
+    __slots__ = ("shape", "pset", "n", "words", "ncells", "offsets", "pids",
+                 "blocked", "membership", "covered", "survivors", "memo",
+                 "dirty")
+
+    def __init__(self, shape: Tuple[int, ...], pset: PlacementSet):
+        self.shape = shape
+        self.pset = pset
+        self.n = len(pset.masks)
+        self.words = pset.mgrid.words
+        self.ncells = pset.mgrid.ncells
+        ncells = self.ncells
+        self.offsets = (ctypes.c_int64 * (ncells + 1))()
+        lib = native.load()
+        if lib is not None and self.n:
+            counts = (ctypes.c_int64 * ncells)()
+            prev = tracectx.set_plugin("native:torus_index")
+            try:
+                lib.tpusched_postings_count(pset.packed(), self.n,
+                                            self.words, counts)
+                total = 0
+                for c in range(ncells):
+                    self.offsets[c] = total
+                    total += counts[c]
+                self.offsets[ncells] = total
+                self.pids = (ctypes.c_int64 * max(1, total))()
+                ctypes.memset(counts, 0, ctypes.sizeof(counts))
+                lib.tpusched_postings_fill(pset.packed(), self.n, self.words,
+                                           self.offsets, counts, self.pids)
+            finally:
+                tracectx.set_plugin(prev)
+        else:
+            counts = [0] * ncells
+            for m in pset.masks:
+                b = m
+                while b:
+                    low = b & -b
+                    counts[low.bit_length() - 1] += 1
+                    b ^= low
+            total = 0
+            for c in range(ncells):
+                self.offsets[c] = total
+                total += counts[c]
+            self.offsets[ncells] = total
+            self.pids = (ctypes.c_int64 * max(1, total))()
+            fill = [0] * ncells
+            for p, m in enumerate(pset.masks):
+                b = m
+                while b:
+                    low = b & -b
+                    cell = low.bit_length() - 1
+                    self.pids[self.offsets[cell] + fill[cell]] = p
+                    fill[cell] += 1
+                    b ^= low
+        self.blocked = (ctypes.c_int32 * max(1, self.n))()
+        self.membership = (ctypes.c_int64 * max(1, ncells))()
+        self.covered = (ctypes.c_uint64 * max(1, self.words))()
+        self.survivors = 0
+        # need → [version, alloc_gen, survivors, membership dict,
+        # dirty-mark]: gang siblings' PreFilters between plane deltas are
+        # pure memo hits, and after a delta the NEXT sweep patches only
+        # the dirty cells (appended by apply()) instead of re-walking the
+        # whole covered plane — the O(Δ) guarantee end to end.  Served
+        # dicts are never mutated in place (readers hold them outside the
+        # lock); a patch copies, fixes the dirty cells, and re-memoizes.
+        self.memo: Dict[int, list] = {}
+        # cells whose membership/eligibility may have moved since the
+        # oldest memo entry (append-only; reset with the memo)
+        self.dirty: List[int] = []
+
+    def rebuild(self, free_mask: int) -> None:
+        ctypes.memset(self.blocked, 0, ctypes.sizeof(self.blocked))
+        ctypes.memset(self.membership, 0, ctypes.sizeof(self.membership))
+        ctypes.memset(self.covered, 0, ctypes.sizeof(self.covered))
+        self.memo.clear()
+        self.dirty.clear()
+        if not self.n:
+            self.survivors = 0
+            return
+        lib = native.load()
+        if lib is not None:
+            prev = tracectx.set_plugin("native:torus_index")
+            try:
+                self.survivors = lib.tpusched_index_build(
+                    self.pset.packed(), self.n, self.words,
+                    _to_words(free_mask, self.words), self.blocked,
+                    self.membership, self.covered)
+            finally:
+                tracectx.set_plugin(prev)
+            return
+        survivors = 0
+        for p, m in enumerate(self.pset.masks):
+            blk = (m & ~free_mask).bit_count()
+            self.blocked[p] = blk
+            if blk:
+                continue
+            survivors += 1
+            b = m
+            while b:
+                low = b & -b
+                cell = low.bit_length() - 1
+                self.membership[cell] += 1
+                if self.membership[cell] == 1:
+                    self.covered[cell >> 6] |= 1 << (cell & 63)
+                b ^= low
+        self.survivors = survivors
+
+    def apply(self, changed: List[Tuple[int, int]]) -> None:
+        """``changed``: (cell, dir) with dir=+1 freed / -1 un-freed."""
+        if not self.n or not changed:
+            return
+        self._mark_dirty(changed)
+        lib = native.load()
+        k = len(changed)
+        if lib is not None:
+            cells = (ctypes.c_int64 * k)(*(c for c, _ in changed))
+            dirs = (ctypes.c_int8 * k)(*(d for _, d in changed))
+            prev = tracectx.set_plugin("native:torus_index")
+            try:
+                self.survivors += lib.tpusched_index_apply(
+                    self.pset.packed(), self.n, self.words, self.offsets,
+                    self.pids, cells, dirs, k, self.blocked, self.membership,
+                    self.covered)
+            finally:
+                tracectx.set_plugin(prev)
+            return
+        for cell, direction in changed:
+            for i in range(self.offsets[cell], self.offsets[cell + 1]):
+                p = self.pids[i]
+                before = self.blocked[p]
+                self.blocked[p] = before - direction
+                if direction > 0 and before == 1:
+                    flip = 1
+                elif direction < 0 and before == 0:
+                    flip = -1
+                else:
+                    continue
+                self.survivors += flip
+                b = self.pset.masks[p]
+                while b:
+                    low = b & -b
+                    c = low.bit_length() - 1
+                    self.membership[c] += flip
+                    if self.membership[c] == 0:
+                        self.covered[c >> 6] &= ~(1 << (c & 63))
+                    elif flip > 0 and self.membership[c] == 1:
+                        self.covered[c >> 6] |= 1 << (c & 63)
+                    b ^= low
+
+    def _mark_dirty(self, changed: List[Tuple[int, int]]) -> None:
+        """Record every cell whose membership or eligibility MAY move under
+        this delta: the changed cells themselves plus every cell of every
+        placement posted on them (a conservative superset of the placements
+        that actually flip — the native kernel does not report flips)."""
+        if not self.memo:
+            self.dirty.clear()            # nothing to patch: stay empty
+            return
+        if len(self.dirty) > 4 * self.ncells:
+            # pathological churn: a full rebuild of the memo is cheaper
+            # than an ever-growing patch log
+            self.memo.clear()
+            self.dirty.clear()
+            return
+        dirty = self.dirty
+        masks = self.pset.masks
+        for cell, _ in changed:
+            dirty.append(cell)
+            for i in range(self.offsets[cell], self.offsets[cell + 1]):
+                b = masks[self.pids[i]]
+                while b:
+                    low = b & -b
+                    dirty.append(low.bit_length() - 1)
+                    b ^= low
+
+    def covered_int(self) -> int:
+        return int.from_bytes(bytes(self.covered), "little")
+
+
+class _PoolPlane:
+    """One pool's materialized occupancy planes over its MaskGrid."""
+
+    __slots__ = ("pool", "topo_key", "topo_rv", "grid", "mgrid", "version",
+                 "mixed", "free_mask", "cap_mask", "gang_cells", "cell_keys",
+                 "cell_state", "total_alloc", "total_used", "free_chips",
+                 "alloc_gen", "alloc_ge", "shapes", "largest_memo")
+
+    def __init__(self, pool: str, topo_key: str, topo_rv: int,
+                 grid: HostGrid, mgrid: MaskGrid):
+        self.pool = pool
+        self.topo_key = topo_key
+        self.topo_rv = topo_rv
+        self.grid = grid
+        self.mgrid = mgrid
+        self.version = -1                 # pool cursor of the last update
+        self.mixed = False                # node label pool ≠ CR pool: refuse
+        self.free_mask = 0                # present & healthy & zero chips
+        self.cap_mask = 0                 # + zero TPU usage & alloc > 0
+        self.gang_cells: Dict[GangKey, int] = {}
+        self.cell_keys: Dict[int, FrozenSet[GangKey]] = {}
+        # cell → (alloc, used) contributions currently inside the totals
+        self.cell_state: Dict[int, Tuple[int, int]] = {}
+        self.total_alloc = 0
+        self.total_used = 0
+        self.free_chips = 0               # Σ max(0, alloc - used)
+        self.alloc_gen = 0
+        self.alloc_ge: Dict[int, Tuple[int, int]] = {}  # need → (gen, mask)
+        self.shapes: Dict[Tuple[int, ...], _ShapeIndex] = {}
+        self.largest_memo: Optional[Tuple[int, int]] = None  # (version, chips)
+
+    def pool_util(self) -> float:
+        return (self.total_used / self.total_alloc
+                if self.total_alloc else 1.0)
+
+    def alloc_ge_mask(self, need: int,
+                      facts: Dict[str, "_NodeFact"]) -> int:
+        ent = self.alloc_ge.get(need)
+        if ent is not None and ent[0] == self.alloc_gen:
+            return ent[1]
+        m = 0
+        for node, coord in self.grid.coord_of.items():
+            fact = facts.get(node)
+            if fact is not None and fact.alloc >= need:
+                m |= 1 << self.mgrid.cell(coord)
+        if len(self.alloc_ge) > 16:
+            self.alloc_ge.clear()
+        self.alloc_ge[need] = (self.alloc_gen, m)
+        return m
+
+
+@guarded_by("_lock", "_facts", "_planes", "_node_planes", "_grids",
+            "_stale", "_updates", "_rebuilds", "_cells_touched",
+            "_pset_cache")
+class TorusWindowIndex:
+    """The index.  Writers are the scheduler cache's mutators: they hold
+    the cache lock and call the ``cache_*`` hooks, which take this lock
+    inside — lock order Cache → WindowIndex, never the reverse (readers
+    never touch the cache).  Readers are dispatch-lane PreFilters, the
+    /metrics capacity collector and the defrag advisor's pre-gate."""
+
+    def __init__(self, publish: bool = True):
+        self._lock = GuardedLock("topology.WindowIndex")
+        self._publish = publish           # False for shadow schedulers
+        self._facts: Dict[str, _NodeFact] = {}
+        self._planes: Dict[str, _PoolPlane] = {}
+        self._node_planes: Dict[str, List[str]] = {}
+        # pool → (topo key, rv, HostGrid, MaskGrid) awaiting (re)build
+        self._grids: Dict[str, Tuple[str, int, HostGrid, MaskGrid]] = {}
+        self._stale: Dict[str, None] = {}
+        self._updates = 0
+        self._rebuilds = 0
+        self._cells_touched = 0
+        # bounded placement-set cache for read surfaces outside live planes
+        # (PostFilter sweeps, the capacity ladder)
+        self._pset_cache: Dict[Tuple, PlacementSet] = {}
+
+    # -- topology CR intake (informer thread) ---------------------------------
+
+    def observe_topology(self, topo) -> bool:
+        """Record/refresh a pool's grid geometry and mark its plane stale.
+        The caller must follow up with ``Cache.sync_window_index()`` so the
+        plane is rebuilt atomically with its pool cursor.  Returns True when
+        a rebuild is pending."""
+        grid = HostGrid.from_spec(topo.spec)
+        with self._lock:
+            if grid is None:
+                self._drop_pool_locked(topo.spec.pool)
+                return False
+            pool = grid.pool
+            known = self._grids.get(pool)
+            if (known is not None and known[0] == topo.key
+                    and known[1] == topo.meta.resource_version
+                    and pool in self._planes):
+                return False              # same geometry already live
+            self._grids[pool] = (topo.key, topo.meta.resource_version,
+                                 grid, MaskGrid(grid))
+            self._stale[pool] = None
+            return True
+
+    def forget_topology(self, pool: str) -> None:
+        with self._lock:
+            self._drop_pool_locked(pool)
+
+    def _drop_pool_locked(self, pool: str) -> None:
+        self._grids.pop(pool, None)
+        self._stale.pop(pool, None)
+        plane = self._planes.pop(pool, None)
+        if plane is not None:
+            for node in plane.grid.coord_of:
+                pools = self._node_planes.get(node)
+                if pools and pool in pools:
+                    pools.remove(pool)
+
+    def mark_stale(self, pool: str) -> None:
+        """Quarantine one pool (differential-mismatch self-heal): queries
+        miss until ``Cache.sync_window_index()`` rebuilds the plane."""
+        with self._lock:
+            if pool in self._grids:
+                self._stale[pool] = None
+                plane = self._planes.get(pool)
+                if plane is not None:
+                    plane.version = -1
+
+    def stale_pools(self) -> List[str]:
+        with self._lock:
+            return list(self._stale)
+
+    # -- cache-side hooks (ALL called with the cache lock held) ---------------
+
+    def cache_reset(self) -> None:
+        with self._lock:
+            self._facts.clear()
+            self._planes.clear()
+            self._node_planes.clear()
+            for pool in self._grids:
+                self._stale[pool] = None
+
+    def cache_seed_node(self, node: Node, pods) -> None:
+        """Attach-time seeding: facts only; planes follow via
+        ``rebuild_stale``."""
+        with self._lock:
+            self._set_fact_locked(node, pods)
+
+    def rebuild_stale(self, cursor_of) -> None:
+        """Build every stale pool's plane from current facts, stamping it
+        with ``cursor_of(pool)`` — the caller holds the cache lock, so the
+        facts/cursor pair is a consistent epoch."""
+        with self._lock:
+            for pool in list(self._stale):
+                ent = self._grids.get(pool)
+                self._stale.pop(pool, None)
+                if ent is None:
+                    continue
+                self._build_plane_locked(pool, ent, cursor_of(pool))
+
+    def cache_note(self, pool: str, cursor: int) -> None:
+        """A structural mutation with no occupancy-visible delta still
+        advances the pool's cursor; track it or every later query misses."""
+        with self._lock:
+            plane = self._planes.get(pool)
+            if plane is not None:
+                plane.version = cursor
+
+    def cache_pod_delta(self, node_name: str, pod: Pod, delta: int,
+                        stamps) -> None:
+        with self._lock:
+            fact = self._facts.get(node_name)
+            if fact is not None:
+                chips, is_tpu = _pod_usage(pod)
+                fact.used += delta * chips
+                if is_tpu:
+                    fact.tpu_pods += delta
+                key = gang_key_of(pod)
+                ent = fact.owners.get(key)
+                if ent is None:
+                    ent = fact.owners[key] = [0, 0]
+                ent[0] += delta * chips
+                ent[1] += delta
+                if ent[1] <= 0:
+                    fact.owners.pop(key, None)
+                self._apply_node_locked(node_name)
+            self._stamp_locked(stamps)
+
+    def cache_node_upsert(self, node: Node, pods, stamps) -> None:
+        """``pods``: the node's full resident pod list (add/replace paths),
+        or None to keep the existing pod-derived facts (an in-place
+        health/alloc/label update)."""
+        with self._lock:
+            self._set_fact_locked(node, pods)
+            self._apply_node_locked(node.name)
+            self._stamp_locked(stamps)
+
+    def cache_node_removed(self, name: str, stamps) -> None:
+        with self._lock:
+            self._facts.pop(name, None)
+            self._apply_node_locked(name)
+            self._stamp_locked(stamps)
+
+    def _set_fact_locked(self, node: Node, pods) -> None:
+        fact = self._facts.get(node.name)
+        if fact is None:
+            fact = self._facts[node.name] = _NodeFact()
+            if pods is None:
+                pods = ()
+        fact.pool = node.meta.labels.get(LABEL_POOL, "")
+        fact.alloc = node.status.allocatable.get(TPU, 0)
+        fact.healthy = node_health_error(node) is None
+        if pods is not None:
+            fact.used = 0
+            fact.tpu_pods = 0
+            fact.owners = {}
+            for p in pods:
+                chips, is_tpu = _pod_usage(p)
+                fact.used += chips
+                if is_tpu:
+                    fact.tpu_pods += 1
+                key = gang_key_of(p)
+                ent = fact.owners.get(key)
+                if ent is None:
+                    ent = fact.owners[key] = [0, 0]
+                ent[0] += chips
+                ent[1] += 1
+
+    def _stamp_locked(self, stamps) -> None:
+        for pool, cursor in stamps:
+            plane = self._planes.get(pool)
+            if plane is not None:
+                plane.version = cursor
+        self._updates += 1
+        if self._publish:
+            torus_index_updates_total.inc()
+
+    def _apply_node_locked(self, name: str) -> None:
+        for pool in self._node_planes.get(name, ()):
+            plane = self._planes.get(pool)
+            if plane is not None:
+                self._apply_cell_locked(plane, name)
+
+    def _apply_cell_locked(self, plane: _PoolPlane, name: str,
+                           count: bool = True) -> None:
+        coord = plane.grid.coord_of.get(name)
+        if coord is None:
+            return
+        cell = plane.mgrid.cell(coord)
+        bit = 1 << cell
+        fact = self._facts.get(name)
+        present = fact is not None
+        if present and fact.pool != plane.pool:
+            # CR pool and node label disagree: version semantics can no
+            # longer be trusted for this plane — refuse to serve it until
+            # a rebuild observes a consistent world
+            plane.mixed = True
+        # totals
+        prev = plane.cell_state.get(cell)
+        alloc = fact.alloc if present else 0
+        used = fact.used if present else 0
+        if present:
+            if prev is None or prev[0] != alloc:
+                plane.alloc_gen += 1
+            plane.cell_state[cell] = (alloc, used)
+        else:
+            if prev is not None:
+                plane.alloc_gen += 1
+            plane.cell_state.pop(cell, None)
+        pa, pu = prev if prev is not None else (0, 0)
+        plane.total_alloc += alloc - pa
+        plane.total_used += used - pu
+        plane.free_chips += max(0, alloc - used) - max(0, pa - pu)
+        # gang cells
+        new_keys = frozenset(fact.owners) if present else frozenset()
+        old_keys = plane.cell_keys.get(cell, frozenset())
+        if new_keys != old_keys:
+            for k in old_keys - new_keys:
+                m = plane.gang_cells.get(k, 0) & ~bit
+                if m:
+                    plane.gang_cells[k] = m
+                else:
+                    plane.gang_cells.pop(k, None)
+            for k in new_keys - old_keys:
+                plane.gang_cells[k] = plane.gang_cells.get(k, 0) | bit
+            if new_keys:
+                plane.cell_keys[cell] = new_keys
+            else:
+                plane.cell_keys.pop(cell, None)
+        # planes
+        free = present and fact.healthy and used == 0
+        cap = (present and fact.healthy and fact.tpu_pods == 0
+               and alloc > 0)
+        if cap != bool(plane.cap_mask & bit):
+            plane.cap_mask ^= bit
+        if free != bool(plane.free_mask & bit):
+            plane.free_mask ^= bit
+            if count:
+                self._cells_touched += 1
+                if self._publish:
+                    torus_index_cells_touched_total.inc()
+            changed = [(cell, 1 if free else -1)]
+            for sidx in plane.shapes.values():
+                sidx.apply(changed)
+
+    def _build_plane_locked(self, pool: str, ent, cursor: int) -> None:
+        topo_key, rv, grid, mgrid = ent
+        old = self._planes.get(pool)
+        plane = _PoolPlane(pool, topo_key, rv, grid, mgrid)
+        for node in grid.coord_of:
+            pools = self._node_planes.setdefault(node, [])
+            if pool not in pools:
+                pools.append(pool)
+            self._apply_cell_locked(plane, node, count=False)
+        # a full rebuild observes the whole world at once: clear any
+        # mixed verdict derived from it only if it still holds
+        plane.mixed = any(
+            self._facts[n].pool != pool
+            for n in grid.coord_of if n in self._facts)
+        self._planes[pool] = plane
+        # keep previously-hot shapes warm across the rebuild: placement
+        # sets depend only on (dims, wrap, accelerator), so a same-geometry
+        # rebuild (host relabels, rv bumps) reuses them and pays only the
+        # cheap blocked-count rebuild.  Changed geometry drops the shapes;
+        # the next query re-enumerates OUTSIDE the locks (_shape_ready) —
+        # enumeration must never run under the cache lock.
+        if old is not None and old.grid.dims == grid.dims \
+                and old.grid.wrap == grid.wrap and old.grid.acc is grid.acc:
+            for shape, old_sidx in old.shapes.items():
+                old_sidx.rebuild(plane.free_mask)
+                plane.shapes[shape] = old_sidx
+        plane.version = cursor
+        self._rebuilds += 1
+        if self._publish:
+            torus_index_rebuilds_total.inc()
+
+    def _ensure_shape_locked(self, plane: _PoolPlane,
+                             shape: Tuple[int, ...]) -> Optional[_ShapeIndex]:
+        sidx = plane.shapes.get(shape)
+        if sidx is None:
+            pset = enumerate_placement_masks(plane.mgrid, shape)
+            sidx = _ShapeIndex(shape, pset)
+            sidx.rebuild(plane.free_mask)
+            plane.shapes[shape] = sidx
+        return sidx
+
+    def _shape_ready(self, pool: str, topo_key: str, topo_rv: int,
+                     shape: Tuple[int, ...]) -> bool:
+        """Ensure the (pool, shape) window index exists, with the
+        placement enumeration + posting-list build running OUTSIDE the
+        index lock: cache mutators block on that lock from inside their
+        own critical sections, and first-touch enumeration of a big pool
+        is the most expensive operation in this module — holding the lock
+        through it would stall every dispatch lane behind one probe."""
+        with self._lock:
+            plane = self._serving_plane_locked(pool, topo_key, topo_rv,
+                                               None)
+            if plane is None:
+                return False
+            if shape in plane.shapes:
+                return True
+            mgrid = plane.mgrid
+        pset = enumerate_placement_masks(mgrid, shape)
+        sidx = _ShapeIndex(shape, pset)
+        with self._lock:
+            plane = self._planes.get(pool)
+            if (plane is None or plane.topo_key != topo_key
+                    or plane.topo_rv != topo_rv
+                    or plane.mgrid is not mgrid):
+                return False          # geometry moved underneath the build
+            if shape not in plane.shapes:
+                sidx.rebuild(plane.free_mask)
+                plane.shapes[shape] = sidx
+            return True
+
+    # -- read surface ---------------------------------------------------------
+
+    def pool_version(self, pool: str) -> int:
+        with self._lock:
+            plane = self._planes.get(pool)
+            return plane.version if plane is not None else -1
+
+    def _serving_plane_locked(self, pool: str, topo_key: str, topo_rv: int,
+                              expected_cursor: Optional[int]
+                              ) -> Optional[_PoolPlane]:
+        plane = self._planes.get(pool)
+        if (plane is None or plane.mixed or pool in self._stale
+                or plane.topo_key != topo_key or plane.topo_rv != topo_rv):
+            return None
+        if expected_cursor is not None and plane.version != expected_cursor:
+            return None
+        return plane
+
+    def query(self, topo, shape: Tuple[int, ...], gang_key: GangKey,
+              chips_needed: int,
+              expected_cursor: Optional[int]) -> Optional[WindowQuery]:
+        """The PreFilter sweep for one pool, as a table lookup.  Returns
+        None whenever the index cannot PROVE it answers for the caller's
+        snapshot epoch — the caller falls back to the full recompute."""
+        if expected_cursor is None:
+            return None
+        shape = tuple(shape)
+        if not self._shape_ready(topo.spec.pool, topo.key,
+                                 topo.meta.resource_version, shape):
+            return None
+        with self._lock:
+            plane = self._serving_plane_locked(
+                topo.spec.pool, topo.key, topo.meta.resource_version,
+                expected_cursor)
+            if plane is None:
+                return None
+            sidx = plane.shapes.get(shape)
+            if sidx is None:
+                return None
+            assigned_mask = plane.gang_cells.get(gang_key, 0)
+            util = plane.pool_util()
+            if assigned_mask == 0:
+                membership = self._gangfree_membership_locked(
+                    plane, sidx, chips_needed)
+                return WindowQuery(sidx.survivors, membership, frozenset(),
+                                   util)
+            # sibling path: placements must contain every assigned cell —
+            # candidates come from ONE assigned cell's posting list
+            free = plane.free_mask & ~assigned_mask
+            eligible = (free
+                        & plane.alloc_ge_mask(chips_needed, self._facts)) \
+                | self._sibling_eligible_locked(plane, gang_key,
+                                                assigned_mask, chips_needed)
+            first = (assigned_mask & -assigned_mask).bit_length() - 1
+            survivors = 0
+            counts: Dict[int, int] = {}
+            masks = sidx.pset.masks
+            for i in range(sidx.offsets[first], sidx.offsets[first + 1]):
+                m = masks[sidx.pids[i]]
+                if (m & assigned_mask) != assigned_mask:
+                    continue
+                if (m & ~assigned_mask) & ~free:
+                    continue
+                survivors += 1
+                b = m & eligible
+                while b:
+                    low = b & -b
+                    cell = low.bit_length() - 1
+                    counts[cell] = counts.get(cell, 0) + 1
+                    b ^= low
+            membership = {}
+            node_of_cell = plane.mgrid.node_of_cell
+            for cell, c in counts.items():
+                node = node_of_cell[cell]
+                if node is not None:
+                    membership[node] = c
+            assigned = frozenset(
+                plane.grid.coord_of[n]
+                for n in self._gang_nodes_locked(plane, assigned_mask))
+            return WindowQuery(survivors, membership, assigned, util)
+
+    def _gangfree_membership_locked(self, plane: _PoolPlane,
+                                    sidx: _ShapeIndex,
+                                    need: int) -> Dict[str, int]:
+        """The gang-free sweep's node→membership table: memo hit when the
+        plane is unchanged, O(Δ) patch of a copied dict after a delta,
+        full O(covered) walk only on first touch / alloc changes."""
+        node_of_cell = plane.mgrid.node_of_cell
+        ent = sidx.memo.get(need)
+        if ent is not None and ent[0] == plane.version:
+            return ent[3]
+        eligible = plane.free_mask & plane.alloc_ge_mask(need, self._facts)
+        if ent is not None and ent[1] == plane.alloc_gen:
+            d = dict(ent[3])              # never patch a served dict
+            for cell in set(sidx.dirty[ent[4]:]):
+                node = node_of_cell[cell]
+                if node is None:
+                    continue
+                m = sidx.membership[cell]
+                if m and (eligible >> cell) & 1:
+                    d[node] = m
+                else:
+                    d.pop(node, None)
+            sidx.memo[need] = [plane.version, plane.alloc_gen,
+                               sidx.survivors, d, len(sidx.dirty)]
+            return d
+        membership: Dict[str, int] = {}
+        bits = sidx.covered_int() & eligible
+        while bits:
+            low = bits & -bits
+            cell = low.bit_length() - 1
+            node = node_of_cell[cell]
+            if node is not None:
+                membership[node] = sidx.membership[cell]
+            bits ^= low
+        sidx.memo[need] = [plane.version, plane.alloc_gen, sidx.survivors,
+                           membership, len(sidx.dirty)]
+        return membership
+
+    def _gang_nodes_locked(self, plane: _PoolPlane, mask: int):
+        node_of_cell = plane.mgrid.node_of_cell
+        out = []
+        while mask:
+            low = mask & -mask
+            node = node_of_cell[low.bit_length() - 1]
+            if node is not None:
+                out.append(node)
+            mask ^= low
+        return out
+
+    def _sibling_eligible_locked(self, plane: _PoolPlane, gang_key: GangKey,
+                                 assigned_mask: int, need: int) -> int:
+        """Cells the gang already sits on that can still take THIS pod:
+        healthy, zero foreign chips, and enough chips left after
+        siblings — the sub-host packing case of ``_occupancy``."""
+        out = 0
+        m = assigned_mask
+        node_of_cell = plane.mgrid.node_of_cell
+        while m:
+            low = m & -m
+            cell = low.bit_length() - 1
+            m ^= low
+            node = node_of_cell[cell]
+            fact = self._facts.get(node) if node is not None else None
+            if fact is None or not fact.healthy:
+                continue
+            ent = fact.owners.get(gang_key)
+            sib = ent[0] if ent else 0
+            if fact.used - sib:
+                continue                  # foreign chips on the host
+            if fact.alloc - sib >= need:
+                out |= low
+        return out
+
+    def assigned_view(self, topo, gang_key: GangKey,
+                      expected_cursor: Optional[int]
+                      ) -> Optional[FrozenSet]:
+        """PostFilter's pinning input: the gang's already-assigned host
+        coords in this pool, or None when the index cannot serve."""
+        if expected_cursor is None:
+            return None
+        with self._lock:
+            plane = self._serving_plane_locked(
+                topo.spec.pool, topo.key, topo.meta.resource_version,
+                expected_cursor)
+            if plane is None:
+                return None
+            mask = plane.gang_cells.get(gang_key, 0)
+            return frozenset(
+                plane.grid.coord_of[n]
+                for n in self._gang_nodes_locked(plane, mask))
+
+    def placement_set(self, topo, mgrid: MaskGrid,
+                      shape: Tuple[int, ...]) -> PlacementSet:
+        """Shared placement enumeration (PostFilter's window sweep, the
+        capacity ladder): served from the live plane's shape index when
+        the geometry matches, else from a small bounded cache."""
+        shape = tuple(shape)
+        if self._shape_ready(topo.spec.pool, topo.key,
+                             topo.meta.resource_version, shape):
+            with self._lock:
+                plane = self._planes.get(topo.spec.pool)
+                if (plane is not None and plane.topo_key == topo.key
+                        and plane.topo_rv == topo.meta.resource_version):
+                    sidx = plane.shapes.get(shape)
+                    if sidx is not None:
+                        return sidx.pset
+        key = (topo.key, topo.meta.resource_version, shape)
+        with self._lock:
+            got = self._pset_cache.get(key)
+        if got is None:
+            got = enumerate_placement_masks(mgrid, shape)   # outside lock
+            with self._lock:
+                got = self._pset_cache.setdefault(key, got)
+                while len(self._pset_cache) > 64:
+                    self._pset_cache.pop(next(iter(self._pset_cache)))
+        return got
+
+    # -- capacity / defrag surface -------------------------------------------
+
+    def capacity_view(self, topo) -> Optional[Tuple[FrozenSet, int, int, int]]:
+        """(window-eligible free host coords, free chips, capacity chips,
+        version) for the /metrics collector — the maintained twin of
+        ``obs.capacity.pool_occupancy`` (no snapshot walk).  Staleness is
+        tolerated by that surface's contract, so no cursor is required;
+        geometry must still match."""
+        with self._lock:
+            plane = self._serving_plane_locked(
+                topo.spec.pool, topo.key, topo.meta.resource_version, None)
+            if plane is None or plane.version < 0:
+                return None
+            coords = []
+            m = plane.cap_mask
+            node_of_cell = plane.mgrid.node_of_cell
+            coord_of = plane.grid.coord_of
+            while m:
+                low = m & -m
+                node = node_of_cell[low.bit_length() - 1]
+                if node is not None:
+                    coords.append(coord_of[node])
+                m ^= low
+            return (frozenset(coords), plane.free_chips, plane.total_alloc,
+                    plane.version)
+
+    def largest_placeable(self, topo) -> Optional[Tuple[int, int, int, int]]:
+        """(largest placeable chips, free chips, capacity, version) —
+        memoized on the plane version, so an idle pool answers for free
+        and an active one recomputes only after a real occupancy delta."""
+        view = self.capacity_view(topo)
+        if view is None:
+            return None
+        coords, free_chips, capacity, version = view
+        with self._lock:
+            plane = self._planes.get(topo.spec.pool)
+            if plane is None:
+                return None
+            memo = plane.largest_memo
+            if memo is not None and memo[0] == version:
+                return (memo[1], free_chips, capacity, version)
+            grid = plane.grid
+        # the ladder search runs OUTSIDE the index lock: it is bounded but
+        # not O(1), and cache mutators block on this lock
+        from ..obs.capacity import largest_window_chips  # lazy: import cycle
+        largest = largest_window_chips(grid, coords) if coords else 0
+        with self._lock:
+            plane = self._planes.get(topo.spec.pool)
+            if plane is not None and plane.version == version:
+                plane.largest_memo = (version, largest)
+        return (largest, free_chips, capacity, version)
+
+    def window_exists_with(self, topo, shape: Tuple[int, ...],
+                           extra_free_nodes=()) -> Optional[bool]:
+        """Defrag pre-gate: could any placement of ``shape`` land on the
+        pool's currently-free hosts PLUS ``extra_free_nodes`` (a candidate
+        migration's vacated hosts)?  None when the index cannot answer."""
+        shape = tuple(shape)
+        if not self._shape_ready(topo.spec.pool, topo.key,
+                                 topo.meta.resource_version, shape):
+            return None
+        with self._lock:
+            plane = self._serving_plane_locked(
+                topo.spec.pool, topo.key, topo.meta.resource_version, None)
+            if plane is None or plane.version < 0:
+                return None
+            sidx = plane.shapes.get(shape)
+            if sidx is None:
+                return None
+            extra = 0
+            for n in extra_free_nodes:
+                coord = plane.grid.coord_of.get(n)
+                if coord is not None:
+                    extra |= 1 << plane.mgrid.cell(coord)
+            if not extra:
+                return sidx.survivors > 0
+            free = plane.free_mask | extra
+            for m in sidx.pset.masks:
+                if not m & ~free:
+                    return True
+            return False
+
+    # -- observability --------------------------------------------------------
+
+    def health(self, cursor_of=None) -> Dict[str, object]:
+        """/debug/flightrecorder ``health.torus_index`` payload: per-pool
+        version + staleness (vs the live pool cursor when ``cursor_of`` is
+        given), shape count, and the cumulative maintenance counters."""
+        with self._lock:
+            pools = {}
+            for pool, plane in self._planes.items():
+                row = {"version": plane.version,
+                       "shapes": len(plane.shapes),
+                       "survivor_counts": {
+                           "x".join(map(str, s)): plane.shapes[s].survivors
+                           for s in plane.shapes},
+                       "mixed": plane.mixed,
+                       "stale": pool in self._stale}
+                pools[pool] = row
+            out = {"pools": pools,
+                   "updates_total": self._updates,
+                   "rebuilds_total": self._rebuilds,
+                   "cells_touched_total": self._cells_touched}
+        if cursor_of is not None:
+            for pool, row in out["pools"].items():
+                try:
+                    row["cursor_lag"] = cursor_of(pool) - row["version"]
+                except Exception as e:  # noqa: BLE001 — advisory surface
+                    row["cursor_lag_error"] = str(e)
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"updates": self._updates, "rebuilds": self._rebuilds,
+                    "cells_touched": self._cells_touched,
+                    "pools": len(self._planes)}
+
+    # -- test/debug surface ---------------------------------------------------
+
+    def debug_plane(self, pool: str) -> Optional[Dict[str, object]]:
+        """Internal plane state for the property tests' incremental-vs-
+        scratch comparison."""
+        with self._lock:
+            plane = self._planes.get(pool)
+            if plane is None:
+                return None
+            return {
+                "version": plane.version,
+                "free_mask": plane.free_mask,
+                "cap_mask": plane.cap_mask,
+                "gang_cells": dict(plane.gang_cells),
+                "total_alloc": plane.total_alloc,
+                "total_used": plane.total_used,
+                "free_chips": plane.free_chips,
+                "shapes": {
+                    s: {"survivors": sidx.survivors,
+                        "blocked": list(sidx.blocked[:sidx.n]),
+                        "membership": list(
+                            sidx.membership[:sidx.ncells]),
+                        "covered": sidx.covered_int()}
+                    for s, sidx in plane.shapes.items()},
+            }
+
+    def ensure_shape(self, pool: str, shape: Tuple[int, ...]) -> bool:
+        with self._lock:
+            plane = self._planes.get(pool)
+            if plane is None:
+                return False
+            self._ensure_shape_locked(plane, tuple(shape))
+            return True

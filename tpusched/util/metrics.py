@@ -442,6 +442,33 @@ equiv_cache_differential_mismatches = REGISTRY.counter(
     "tpusched_equiv_cache_differential_mismatches_total",
     "Differential-mode hits whose placement differed from the full path.")
 
+# Incremental torus window index (topology/windowindex.py, ISSUE 13).
+# updates counts every cache-transition hook applied; rebuilds counts full
+# plane (re)materializations (topology CR change, attach seeding, or a
+# differential-mismatch self-heal); cells_touched counts free-plane cell
+# flips — the Δ the O(Δ) maintenance claim is measured in.  queries land
+# as served (table lookup answered the PreFilter sweep) or fallback (the
+# cursor-consistency rule refused: version mismatch, stale/mixed plane,
+# live window claims).  differential_mismatches MUST stay 0: it counts
+# sampled in-cycle oracle checks where the index answer differed from the
+# Python full recompute (each one also quarantines + reseeds the pool).
+torus_index_updates_total = REGISTRY.counter(
+    "tpusched_torus_index_updates_total",
+    "Cache-transition updates applied to the torus window index.")
+torus_index_rebuilds_total = REGISTRY.counter(
+    "tpusched_torus_index_rebuilds_total",
+    "Full pool-plane rebuilds of the torus window index.")
+torus_index_cells_touched_total = REGISTRY.counter(
+    "tpusched_torus_index_cells_touched_total",
+    "Free-plane cell flips applied incrementally to the window index.")
+torus_index_queries = REGISTRY.counter_vec(
+    "tpusched_torus_index_queries_total", ("result",),
+    "Window-index PreFilter sweeps by outcome (served|fallback).")
+torus_index_differential_mismatches = REGISTRY.counter(
+    "tpusched_torus_index_differential_mismatches_total",
+    "Sampled differential checks where the index disagreed with the "
+    "Python full-recompute oracle.")
+
 # Flight recorder (tpusched/trace): queue-wait is the span the cycle trace
 # decomposes out of e2e latency (pop time - last enqueue time), and every
 # pinned anomaly trace (permit timeout, bind failure, gang denial,

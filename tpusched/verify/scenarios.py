@@ -739,6 +739,157 @@ class SelfcheckTimeoutWake(Scenario):
             f"(want one timeout wake)")
 
 
+# -- ISSUE 13: the window index's cursor-consistency protocol ------------------
+
+
+def _tiny_pool(pool: str):
+    """A 2x2-host v5e pool (4x4 chips) + its nodes: the smallest grid with
+    a non-trivial placement set."""
+    from ..api.core import NodeCondition
+    from ..api.resources import TPU
+    from ..api.topology import (LABEL_POOL, ObjectMeta, TpuTopology,
+                                TpuTopologySpec)
+    hosts = {}
+    nodes = []
+    for i, chip_coord in enumerate(((0, 0), (0, 2), (2, 0), (2, 2))):
+        name = f"{pool}-n{i}"
+        hosts[name] = chip_coord
+        node = make_node(name)
+        node.meta.labels[LABEL_POOL] = pool
+        node.status.allocatable[TPU] = 4
+        node.status.conditions = [NodeCondition(type="Ready", status="True")]
+        nodes.append(node)
+    topo = TpuTopology(meta=ObjectMeta(name=pool, namespace=""),
+                       spec=TpuTopologySpec(pool=pool, accelerator="tpu-v5e",
+                                            dims=(4, 4), wrap=(False, False),
+                                            hosts=hosts, chips_per_host=4))
+    return topo, nodes
+
+
+def _free_plane_oracle(snapshot, grid, mgrid) -> int:
+    """TopologyMatch's ``free`` definition recomputed from a snapshot: a
+    healthy host with zero TPU chip usage."""
+    from ..api.core import node_health_error
+    from ..plugins.tpuslice.chip_node import pod_tpu_limits
+    free = 0
+    for node, coord in grid.coord_of.items():
+        info = snapshot.get(node)
+        if info is None:
+            continue
+        used = sum(pod_tpu_limits(p)[0] for p in info.pods)
+        if used or node_health_error(info.node) is not None:
+            continue
+        free |= 1 << mgrid.cell(coord)
+    return free
+
+
+@register
+class WindowIndexEpoch(Scenario):
+    """Index maintenance vs. snapshot-view capture vs. guarded assume
+    (ISSUE 13's cursor-consistency rule).
+
+    The dispatch actor replays a shard lane's exact read protocol: capture
+    an epoch view (snapshot + pool cursor atomically), ask the window
+    index for the pool's survivor count AT that cursor, then commit
+    through the guarded assume.  The informer actor lands a foreign
+    mutation (a node-health flip) that changes the free plane and bumps
+    the cursor, racing the reader at every lock boundary.  Invariant: any
+    answer the index serves for cursor C must equal the Python oracle
+    recomputed from the SNAPSHOT captured at C — version-matched stale
+    data is the one state the atomic stamp+apply protocol must make
+    unreachable (the seeded selfcheck-stale-index variant breaks the
+    atomicity and the explorer must catch it)."""
+
+    name = "window-index-epoch"
+    SHAPE = (2, 2)
+
+    def _make_index(self):
+        from ..topology.windowindex import TorusWindowIndex
+        return TorusWindowIndex(publish=False)
+
+    def setup(self):
+        from ..topology.engine import MaskGrid, enumerate_placement_masks
+        from ..topology.torus import HostGrid
+        ctx = SimpleNamespace(now=0.0, observations=[], commits=0)
+        ctx.topo, nodes = _tiny_pool("pool-w")
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.index = self._make_index()
+        ctx.index.observe_topology(ctx.topo)
+        ctx.cache.attach_window_index(ctx.index)
+        for n in nodes:
+            ctx.cache.add_node(n)
+        ctx.sick = nodes[1].deepcopy()
+        from ..api.core import NodeCondition
+        ctx.sick.status.conditions = [
+            NodeCondition(type="Ready", status="False")]
+        ctx.grid = HostGrid.from_spec(ctx.topo.spec)
+        ctx.mgrid = MaskGrid(ctx.grid)
+        ctx.pset = enumerate_placement_masks(ctx.mgrid, self.SHAPE)
+        # warm the shape index OUTSIDE exploration so enumeration cost
+        # (and its lock holds) is not part of the schedule space
+        ctx.index.ensure_shape("pool-w", self.SHAPE)
+        return ctx
+
+    def threads(self, ctx):
+        def reader():
+            view = ctx.cache.snapshot_view(["pool-w"])
+            cursor = view.pool_cursors["pool-w"]
+            q = ctx.index.query(ctx.topo, self.SHAPE, ("default", "gw"),
+                                4, cursor)
+            if q is not None:
+                oracle_free = _free_plane_oracle(view.snapshot, ctx.grid,
+                                                 ctx.mgrid)
+                want = sum(1 for m in ctx.pset.masks
+                           if not (m & ~oracle_free))
+                ctx.observations.append((cursor, q.survivors, want))
+            pod = make_pod("pw")
+            if ctx.cache.assume_pod_guarded(pod, "pool-w-n0",
+                                            cursor) is not None:
+                ctx.commits += 1
+
+        def informer():
+            ctx.cache.update_node(ctx.sick)
+
+        return [reader, informer]
+
+    def check(self, ctx):
+        for cursor, got, want in ctx.observations:
+            assert got == want, (
+                f"index served {got} survivors at cursor {cursor}; the "
+                f"snapshot captured at that cursor says {want} — version-"
+                f"matched STALE index data reached a dispatch cycle")
+
+
+@register
+class SelfcheckStaleIndex(WindowIndexEpoch):
+    """DELIBERATE BUG: the informer applies the cache mutation + version
+    stamp inside the cache critical section but the index's occupancy
+    delta AFTER releasing it — exactly the protocol violation the real
+    hooks prevent by updating the index inside the mutator's own critical
+    section.  A reader capturing its epoch view in the window sees a
+    version-matched plane with STALE data; the explorer must find that
+    schedule (the parent invariant fires)."""
+
+    name = "selfcheck-stale-index"
+
+    def threads(self, ctx):
+        reader, _ = super().threads(ctx)
+
+        def buggy_informer():
+            with ctx.cache._lock:
+                cursor = ctx.cache._bump_locked("pool-w")
+                ctx.cache._infos[ctx.sick.name].set_node(ctx.sick)
+                # BUG: version published while the plane still shows the
+                # node healthy...
+                ctx.index.cache_note("pool-w", cursor)
+            locking.verify_point("stale-index-window")
+            # ...and the occupancy delta lands outside the critical section
+            ctx.index.cache_node_upsert(ctx.sick, None,
+                                        [("pool-w", cursor)])
+
+        return [reader, buggy_informer]
+
+
 LIVE_SCENARIOS = tuple(n for n in SCENARIOS if not n.startswith("selfcheck-"))
 SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming",
-                   "selfcheck-unguarded-commit")
+                   "selfcheck-unguarded-commit", "selfcheck-stale-index")
