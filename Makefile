@@ -62,6 +62,21 @@ bench-storm:
 bench-storm-sharded:
 	$(PY) bench.py --storm --shards 8
 
+# Quota-enabled storm (ISSUE 14): quota-aware optimistic sharded commits
+# (shards=8 over 4 ElasticQuota teams) vs the legacy quota-serialized
+# global-lane arm, same seeds both arms, recorded as arrival_storm_quota
+# with the serialized baseline + conflict attribution in the artifact.
+.PHONY: bench-storm-quota
+bench-storm-quota:
+	$(PY) bench.py --storm-quota
+
+# O(Δ) cycle core flatness (ISSUE 14): per-cycle snapshot+candidate
+# acquisition cost at 1k/4k/8k hosts (persistent pooled snapshots),
+# recorded as cycle_core_scale_{1k,4k,8k} + cycle_core_flatness.
+.PHONY: bench-cycle-core
+bench-cycle-core:
+	$(PY) bench.py --cycle-core
+
 # Chaos-smoke (the resilience gate, part of the tier1 flow): ≥5k seeded
 # scheduling cycles under injected API faults — conflicts, transients,
 # lost-response binds, a forced terminal mid-gang bind failure and a total
@@ -100,7 +115,10 @@ obs-smoke:
 # (incl. MULTIPLE submitting shards), Condition hand-off, and the ISSUE
 # 11 sharded-dispatch races: concurrent shard commits on one pool's
 # cursor (lost-update control + seeded unguarded-commit bug),
-# shard-vs-informer snapshot epoch swap, cross-shard gang permit quorum
+# shard-vs-informer snapshot epoch swap, cross-shard gang permit quorum,
+# plus the ISSUE 14 quota commit protocol: quota-epoch compare-and-
+# reserve racing two lanes on one quota (+ seeded unguarded-quota-
+# reserve bug), and the cross-quota borrow/intra-min aggregate race
 # — asserting scenario invariants + zero lock-discipline violations
 # (C7) on every explored schedule, plus the seeded-bug meta-test (the
 # explorer must FIND each deliberate bug and its artifact must replay
@@ -118,7 +136,10 @@ race-smoke:
 # same pod set binds with zero UNATTRIBUTED placement differences (every
 # move explained by the pool partition or a recorded escalation —
 # sched.shards.attribute_placement_diff) and that the sharded replay is
-# itself deterministic; a deliberately perturbed
+# itself deterministic; replay a QUOTA-namespaced storm shards=1-vs-4
+# the same way (ISSUE 14: the quota-epoch commit protocol must be
+# placement-equivalent to the serialized lane, zero unattributed
+# diffs); a deliberately perturbed
 # scoring policy must produce a nonzero, attributed diff (non-vacuity);
 # capture overhead is gated ≤3% by the min-of-N / direct-attribution
 # methodology (trace/prof-smoke precedent); crash recovery (torn tail

@@ -31,7 +31,8 @@ WANT = {
         reserve=["Coscheduling"], permit=["Coscheduling"],
         post_bind=["Coscheduling"],
         args={"Coscheduling": {"permit_waiting_time_seconds": 60,
-                               "denied_pg_expiration_time_seconds": 20}}),
+                               "denied_pg_expiration_time_seconds": 20,
+                               "pg_status_flush_seconds": 0.05}}),
     ("capacityscheduling", "tpusched"): dict(
         pre_filter=["CapacityScheduling"], post_filter=["CapacityScheduling"],
         reserve=["CapacityScheduling"]),
@@ -49,7 +50,8 @@ WANT = {
         permit=["Coscheduling", "MultiSlice"], bind=["TpuSlice"],
         post_bind=["Coscheduling"],
         args={"Coscheduling": {"permit_waiting_time_seconds": 60,
-                               "denied_pg_expiration_time_seconds": 20},
+                               "denied_pg_expiration_time_seconds": 20,
+                               "pg_status_flush_seconds": 0.05},
               "TopologyMatch": {"scoring_strategy": "LeastAllocated",
                                 "resource_weights": {"google.com/tpu": 1},
                                 "packing_weight": 0.7,

@@ -341,10 +341,14 @@ def test_post_bind_tracks_scheduling_then_scheduled():
     for m in members:
         api.create(srv.PODS, m)
     cs.post_bind(CycleState(), members[0], "h0")
+    # partial progress coalesces per flush window (ISSUE 14): the patch
+    # shows after a drain (any later manager activity, or close())
+    cs.pg_mgr.flush_status()
     got = api.get(srv.POD_GROUPS, "default/gang")
     assert got.status.scheduled == 1
     assert got.status.phase == PG_SCHEDULING
     assert got.status.schedule_start_time is not None
+    # quorum completion flushes INLINE — no drain needed
     cs.post_bind(CycleState(), members[1], "h0")
     got = api.get(srv.POD_GROUPS, "default/gang")
     assert got.status.scheduled == 2
@@ -382,3 +386,82 @@ def test_denied_window_not_extended_by_repeat_denials():
     now[0] = 1.1                    # original expiry passed despite re-add
     assert "pg" not in cache
     assert cache.add("pg")          # expired ⇒ add succeeds again
+
+
+# -- PG status patch batching (ISSUE 14 satellite) ----------------------------
+
+def _patch_counter(api):
+    """Count PodGroup patch round trips through the store."""
+    calls = {"n": 0}
+    orig = api.update
+
+    def counting_update(kind, obj, **kw):
+        if kind == srv.POD_GROUPS:
+            calls["n"] += 1
+        return orig(kind, obj, **kw)
+    api.update = counting_update
+    return calls
+
+
+def test_post_bind_batches_partial_progress_into_one_patch():
+    """Partial-progress increments inside the flush window coalesce into
+    ONE PG patch; quorum completion flushes INLINE (PG_SCHEDULED lands at
+    the real completion instant, north-star clock intact)."""
+    from tpusched.api.scheduling import PG_SCHEDULED
+    pg = make_pod_group("gang", min_member=4)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg])
+    mgr = cs.pg_mgr
+    mgr._status_flush_s = 60.0            # window never lapses in-test
+    members = [make_pod(f"m{i}", pod_group="gang") for i in range(4)]
+    for p in members:
+        api.create(srv.PODS, p)
+    # three partial binds: all pending, ZERO patches yet
+    for p in members[:3]:
+        mgr.post_bind(p, "h0")
+    live = api.try_get(srv.POD_GROUPS, "default/gang")
+    assert live.status.scheduled == 0
+    # the quorum-completing bind flushes the whole batch inline: one
+    # patch carrying all four increments
+    mgr.post_bind(members[3], "h0")
+    live = api.try_get(srv.POD_GROUPS, "default/gang")
+    assert live.status.scheduled == 4
+    assert live.status.phase == PG_SCHEDULED
+    assert mgr._status_pending == {}
+
+
+def test_post_bind_flush_zero_patches_per_bind():
+    """pg_status_flush_seconds=0 keeps the pre-14 per-bind patch (the
+    deterministic-replay arm)."""
+    pg = make_pod_group("gang", min_member=4)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg])
+    mgr = cs.pg_mgr
+    mgr._status_flush_s = 0.0
+    m = make_pod("m0", pod_group="gang")
+    api.create(srv.PODS, m)
+    mgr.post_bind(m, "h0")
+    assert api.try_get(srv.POD_GROUPS, "default/gang").status.scheduled == 1
+
+
+def test_post_bind_residue_flushes_on_window_and_close():
+    """A gang whose binds stop short of quorum must still surface its
+    partial progress: the window flush (piggybacked on any later manager
+    activity) and plugin close() both drain the residue."""
+    pg = make_pod_group("gang", min_member=4)
+    fw, cs, handle, api = gang_framework(pod_groups=[pg])
+    mgr = cs.pg_mgr
+    mgr._status_flush_s = 0.001
+    m = make_pod("m0", pod_group="gang")
+    api.create(srv.PODS, m)
+    mgr.post_bind(m, "h0")
+    # under-quorum: batched, not yet patched (or already window-flushed —
+    # both legal; drive the due-flush deterministically)
+    time.sleep(0.002)
+    mgr._flush_status_if_due()
+    assert api.try_get(srv.POD_GROUPS, "default/gang").status.scheduled == 1
+    # close() drains anything still pending
+    mgr._status_flush_s = 60.0
+    m2 = make_pod("m1", pod_group="gang")
+    api.create(srv.PODS, m2)
+    mgr.post_bind(m2, "h0")
+    cs.close()
+    assert api.try_get(srv.POD_GROUPS, "default/gang").status.scheduled == 2

@@ -1,0 +1,460 @@
+"""ISSUE 14: the quota-aware optimistic commit protocol + the O(Δ) cycle
+core's persistent pooled snapshots.
+
+Four layers:
+
+1. unit semantics of the cache quota ledger + the quota-epoch
+   compare-and-reserve (``Cache.assume_pod_guarded(quota_guard=...)``);
+2. a hypothesis property: under fuzzed cache operations (assume, confirm,
+   forget, delete, node churn, bounds churn, termination), the ledger's
+   reserved usage equals the usage recomputed from the cache's own pod
+   table — "reserved usage == bound usage" at every step;
+3. persistent pooled snapshots: structural sub-map sharing across
+   epochs, shared_snapshot()'s no-bookkeeping contract, candidate-list
+   caching;
+4. e2e: a SHARDED scheduler over ElasticQuota namespaces binds quota'd
+   pods on SHARD lanes (the pre-14 core serialized them wholesale
+   through the global lane), and an over-min borrower escalates.
+"""
+from __future__ import annotations
+
+import pytest
+
+from tpusched.api.resources import TPU, make_resources
+from tpusched.api.topology import LABEL_POOL
+from tpusched.apiserver import server as srv
+from tpusched.config.profiles import full_stack_profile
+from tpusched.fwk.nodeinfo import PooledSnapshot
+from tpusched.sched.cache import Cache, QUOTA_CONFLICT, QuotaReserve
+from tpusched.testing import (TestCluster, make_elastic_quota, make_node,
+                              make_pod, make_tpu_pool)
+from tpusched.util.podutil import pod_effective_request
+
+
+def _pool_node(name: str, pool: str, chips: int = 8):
+    node = make_node(name)
+    node.meta.labels[LABEL_POOL] = pool
+    node.status.allocatable[TPU] = chips
+    return node
+
+
+def _pod(name: str, ns: str = "team-a", chips: int = 2):
+    return make_pod(name, namespace=ns, limits={TPU: chips})
+
+
+def _quota_cache() -> Cache:
+    c = Cache()
+    c.add_node(_pool_node("a1", "pool-a"))
+    c.add_node(_pool_node("b1", "pool-b"))
+    c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 8}),
+                         "team-b": ({TPU: 4}, {TPU: 8})})
+    return c
+
+
+# -- 1. ledger + compare-and-reserve unit semantics ---------------------------
+
+
+def test_quota_ledger_tracks_assume_confirm_forget():
+    c = _quota_cache()
+    p = _pod("w0")
+    c.assume_pod(p, "a1")
+    assert c.quota_used_snapshot()["team-a"].get(TPU) == 2
+    # watch confirm replaces the assumed entry without double-count
+    confirmed = _pod("w0")
+    confirmed.spec.node_name = "a1"
+    c.add_pod(confirmed)
+    assert c.quota_used_snapshot()["team-a"].get(TPU) == 2
+    c.remove_pod(confirmed)
+    assert c.quota_used_snapshot()["team-a"].get(TPU, 0) == 0
+
+
+def test_quota_ledger_releases_on_forget_even_without_node():
+    """A pod whose node vanished still releases its quota at forget —
+    the ledger follows the pod table, not node attachment."""
+    c = _quota_cache()
+    p = _pod("w1")
+    c.assume_pod(p, "a1")
+    c.remove_node(_pool_node("a1", "pool-a"))
+    c.forget_pod(p)
+    assert c.quota_used_snapshot()["team-a"].get(TPU, 0) == 0
+
+
+def test_quota_reserve_refuses_when_room_genuinely_consumed():
+    """The semantic compare-and-reserve: a commit is refused exactly when
+    concurrent quota'd traffic consumed the room its admission assumed —
+    own-namespace max here."""
+    c = _quota_cache()
+    cursor = c.snapshot_view(["pool-a"]).pool_cursors["pool-a"]
+    # a foreign commit fills team-a's max (8) to the brim...
+    c.assume_pod(_pod("foreign", ns="team-a", chips=7), "b1")
+    # ...so a 2-chip commit judged against empty usage is refused with
+    # the QUOTA sentinel (pool-a's cursor untouched: not a pool conflict)
+    guard = QuotaReserve("team-a", {TPU: 2}, {TPU: 2})
+    assert c.assume_pod_guarded(_pod("mine"), "a1", cursor,
+                                quota_guard=guard) is QUOTA_CONFLICT
+    # a commit that still fits lands — even though the ledger CHANGED
+    # since admission (semantic guard: no false conflicts on mere churn)
+    small = QuotaReserve("team-a", {TPU: 1}, {TPU: 1})
+    assert c.assume_pod_guarded(_pod("mine", chips=1), "a1",
+                                c.pool_cursor("pool-a"),
+                                quota_guard=small) is not None
+
+
+def test_quota_reserve_enforces_aggregate_borrow_gate():
+    """Σused + total vs Σmin is checked against the LIVE fleet sums:
+    an intra-min reserve in team-b invalidates a concurrently-judged
+    borrow in team-a (the cross-namespace race a per-namespace guard
+    cannot see)."""
+    c = _quota_cache()   # mins 4+4 = 8, maxes 8
+    cursor = c.snapshot_view(["pool-a"]).pool_cursors["pool-a"]
+    # borrow admission judged on an empty fleet: 8 ≤ Σmin 8, OK...
+    guard = QuotaReserve("team-a", {TPU: 8}, {TPU: 8})
+    # ...but a foreign intra-min reserve lands first
+    c.assume_pod(_pod("foreign", ns="team-b", chips=4), "b1")
+    assert c.assume_pod_guarded(_pod("borrower", chips=8), "a1", cursor,
+                                quota_guard=guard) is QUOTA_CONFLICT
+    # releases LOOSEN the bounds: after the foreign pod goes away the
+    # same stale guard commits (teardown churn never refuses)
+    c.remove_pod(_pod("foreign", ns="team-b", chips=4))
+    assert c.assume_pod_guarded(_pod("borrower", chips=8), "a1",
+                                c.pool_cursor("pool-a"),
+                                quota_guard=guard) is not None
+
+
+def test_non_quota_traffic_never_moves_the_epoch():
+    c = _quota_cache()
+    _, epoch = c.quota_view()
+    c.assume_pod(make_pod("plain", namespace="no-quota",
+                          limits={TPU: 2}), "a1")
+    _, epoch2 = c.quota_view()
+    assert epoch2 == epoch, "an unregistered namespace bumped the epoch"
+
+
+def test_bounds_change_moves_the_epoch():
+    c = _quota_cache()
+    _, epoch = c.quota_view()
+    c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 16}),
+                         "team-b": ({TPU: 4}, {TPU: 8})})
+    _, epoch2 = c.quota_view()
+    assert epoch2 > epoch, "a max change must invalidate in-flight verdicts"
+
+
+def test_quota_seed_counts_preexisting_pods():
+    c = Cache()
+    c.add_node(_pool_node("a1", "pool-a"))
+    c.assume_pod(_pod("early"), "a1")
+    c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 8})})
+    assert c.quota_used_snapshot()["team-a"].get(TPU) == 2
+
+
+# -- 2. hypothesis: reserved usage == recomputed usage under fuzzed ops -------
+
+
+def _ledger_oracle(cache: Cache):
+    """Recompute per-namespace usage from the cache's own pod table —
+    what the ledger must equal at every step."""
+    from tpusched.util.podutil import is_pod_terminated
+    want = {}
+    for ns in cache._quota_bounds:
+        total = {}
+        for pod in cache._pods.values():
+            if pod.meta.namespace != ns or is_pod_terminated(pod):
+                continue
+            for k, v in pod_effective_request(pod).items():
+                total[k] = total.get(k, 0) + v
+        want[ns] = {k: v for k, v in total.items() if v}
+    return want
+
+
+_OPS = ("assume", "confirm", "forget", "delete", "terminate",
+        "node-del", "node-add", "bounds", "unbound")
+
+
+def _run_ledger_script(script) -> None:
+    """Apply one op script to a fresh cache, asserting after EVERY op that
+    the ledger equals the oracle and the epoch is monotone."""
+    from tpusched.api.core import POD_SUCCEEDED
+    c = Cache()
+    c.add_node(_pool_node("a1", "pool-a"))
+    c.add_node(_pool_node("b1", "pool-b"))
+    c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 64}),
+                         "team-b": ({TPU: 2}, {TPU: 64})})
+    epochs = [c.quota_epoch()]
+    for op, pid, ns, chips in script:
+        pod = make_pod(f"p{pid}", namespace=ns, limits={TPU: chips})
+        if op == "assume":
+            c.assume_pod(pod, "a1")
+        elif op == "confirm":
+            pod.spec.node_name = "b1"
+            c.add_pod(pod)
+        elif op == "forget":
+            c.forget_pod(pod)
+        elif op == "delete":
+            c.remove_pod(pod)
+        elif op == "terminate":
+            pod.spec.node_name = "a1"
+            pod.status.phase = POD_SUCCEEDED
+            c.update_pod(pod)
+        elif op == "node-del":
+            c.remove_node(_pool_node("b1", "pool-b"))
+        elif op == "node-add":
+            c.add_node(_pool_node("b1", "pool-b"))
+        elif op == "bounds":
+            c.sync_quota_bounds(
+                {"team-a": ({TPU: 4}, {TPU: 64 + chips}),
+                 "team-b": ({TPU: 2}, {TPU: 64})})
+        elif op == "unbound":
+            c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 64})})
+            c.sync_quota_bounds({"team-a": ({TPU: 4}, {TPU: 64}),
+                                 "team-b": ({TPU: 2}, {TPU: 64})})
+        got = {ns2: {k: v for k, v in used.items() if v}
+               for ns2, used in c.quota_used_snapshot().items()}
+        oracle = _ledger_oracle(c)
+        assert got == oracle, (op, pid, ns, chips)
+        # the fleet aggregate (the borrow gate's live operand) must equal
+        # the sum of the per-namespace ledgers at every step
+        want_sum = {}
+        for used in oracle.values():
+            for k, v in used.items():
+                want_sum[k] = want_sum.get(k, 0) + v
+        got_sum = {k: v for k, v in c._quota_used_sum.items() if v}
+        assert got_sum == want_sum, (op, pid, ns, chips)
+        epochs.append(c.quota_epoch())
+    assert epochs == sorted(epochs), "quota epoch went backwards"
+
+
+def test_quota_ledger_property_hypothesis():
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    ops = st.lists(
+        st.tuples(st.sampled_from(_OPS),
+                  st.integers(min_value=0, max_value=5),   # pod id
+                  st.sampled_from(["team-a", "team-b", "free"]),
+                  st.integers(min_value=1, max_value=4)),  # chips
+        min_size=1, max_size=40)
+
+    @settings(max_examples=120, deadline=None)
+    @given(ops)
+    def run(script):
+        _run_ledger_script(script)
+
+    run()
+
+
+def test_quota_ledger_property_seeded_fuzz():
+    """The same property on deterministic seeds — the arm that always
+    runs on boxes without hypothesis (test_window_index precedent)."""
+    import random
+    for seed in (1, 7, 20260804):
+        rng = random.Random(seed)
+        script = [(rng.choice(_OPS), rng.randrange(6),
+                   rng.choice(["team-a", "team-b", "free"]),
+                   rng.randrange(1, 5))
+                  for _ in range(200)]
+        _run_ledger_script(script)
+
+
+# -- 3. persistent pooled snapshots -------------------------------------------
+
+
+def test_pooled_snapshot_shares_untouched_pool_submaps():
+    c = Cache()
+    for i in range(4):
+        c.add_node(_pool_node(f"a{i}", "pool-a"))
+        c.add_node(_pool_node(f"b{i}", "pool-b"))
+    s1 = c.snapshot()
+    assert isinstance(s1, PooledSnapshot)
+    # quiet cache: the SAME snapshot object is served
+    assert c.snapshot() is s1
+    # mutate pool-b only: pool-a's sub-map (and its NodeInfo clones) are
+    # shared by reference between the epochs, pool-b's is rebuilt
+    c.assume_pod(make_pod("x", limits={TPU: 1}), "b0")
+    s2 = c.snapshot()
+    assert s2 is not s1
+    assert s2._pools["pool-a"] is s1._pools["pool-a"]
+    assert s2._pools["pool-b"] is not s1._pools["pool-b"]
+    assert s2.get("a0") is s1.get("a0")
+    assert s2.get("b0") is not s1.get("b0")
+    # cursor dict moved only for the mutated pool
+    assert s2.pool_cursors["pool-a"] == s1.pool_cursors["pool-a"]
+    assert s2.pool_cursors["pool-b"] > s1.pool_cursors["pool-b"]
+
+
+def test_pooled_snapshot_candidate_list_cached_per_epoch():
+    c = Cache()
+    for i in range(3):
+        c.add_node(_pool_node(f"n{i}", "pool-a"))
+    snap = c.snapshot()
+    flat = snap.list()
+    assert snap.list() is flat, "per-epoch candidate list must be cached"
+    assert {i.node.name for i in flat} == {"n0", "n1", "n2"}
+    assert snap.num_nodes() == 3
+    assert sorted(snap.node_names()) == ["n0", "n1", "n2"]
+
+
+def test_shared_snapshot_never_advances_loop_bookkeeping():
+    c = Cache()
+    c.add_node(_pool_node("n0", "pool-a"))
+    c.snapshot()
+    before = c.snapshot_cursor()
+    c.assume_pod(make_pod("y", limits={TPU: 1}), "n0")
+    shared = c.shared_snapshot()
+    # fresh content...
+    assert shared.get("n0") is not None
+    assert len(shared.get("n0").pods) == 1
+    # ...but the loop's snapshot cursor is untouched (the equivalence
+    # arming guard's input — a foreign advance would launder mutations)
+    assert c.snapshot_cursor() == before
+    assert c.peek_snapshot() is not shared
+
+
+def test_pooled_snapshot_partition_view_is_cached_and_scoped():
+    c = Cache()
+    c.add_node(_pool_node("a1", "pool-a"))
+    c.add_node(_pool_node("b1", "pool-b"))
+    v1 = c.snapshot_view(["pool-a"])
+    assert v1.snapshot.num_nodes() == 1
+    assert v1.snapshot.get("b1") is None
+    v2 = c.snapshot_view(["pool-a"])
+    assert v2.snapshot is v1.snapshot
+    # foreign-pool mutation leaves the partition view untouched
+    c.assume_pod(make_pod("z", limits={TPU: 1}), "b1")
+    v3 = c.snapshot_view(["pool-a"])
+    assert v3.snapshot is v1.snapshot
+    # the cursor tuple is memoized per epoch
+    assert v3.cursor_tuple() is v1.cursor_tuple()
+
+
+def test_pooled_snapshot_live_quorum_index():
+    c = Cache()
+    c.add_node(_pool_node("a1", "pool-a"))
+    snap = c.snapshot()
+    assert snap.live_pg_assigned
+    assert snap.assigned_count("g", "default") == 0
+    member = make_pod("m0", pod_group="g", limits={TPU: 1})
+    c.assume_pod(member, "a1")
+    # live-is-fresher: the SAME snapshot object sees the assume
+    assert snap.assigned_count("g", "default") == 1
+
+
+# -- 4. e2e: quota'd fleets dispatch on shard lanes ---------------------------
+
+
+def _quota_fleet_profile(shards: int):
+    prof = full_stack_profile(permit_wait_s=10, denied_s=1)
+    prof.dispatch_shards = shards
+    return prof
+
+
+def test_sharded_quota_fleet_binds_on_shard_lanes():
+    """The headline behavior: ElasticQuotas in the fleet no longer route
+    every pod through the global lane — intra-min quota'd pods dispatch
+    (and bind) on their shard lanes under the epoch-guarded commit."""
+    with TestCluster(profile=_quota_fleet_profile(4)) as c:
+        for i in range(4):
+            topo, nodes = make_tpu_pool(f"pool-{i}", dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        for ns in ("team-a", "team-b"):
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{ns}-quota", ns, min={TPU: 512}, max={TPU: 1024}))
+        pods = [make_pod(f"w{i}", namespace="team-a" if i % 2 else "team-b",
+                         limits={TPU: 4},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(12)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=60)
+        stats = c.scheduler._shard_stats.snapshot()
+        shard_binds = sum(row["binds"] for lane, row in
+                          stats["lanes"].items() if lane != "global")
+        assert shard_binds > 0, (
+            f"every quota'd bind went through the global lane — the "
+            f"quota-aware commit protocol is not routing shard lanes: "
+            f"{stats}")
+        health = c.scheduler.cache.quota_health()
+        assert health["namespaces"] == 2
+        assert health["epoch"] > 0
+
+
+def test_sharded_quota_borrower_escalates_to_global_lane():
+    """An over-min borrower on a shard lane is rejected by
+    CapacityScheduling's partition-scope rule and escalates; the global
+    lane admits it fleet-wide (it still binds)."""
+    with TestCluster(profile=_quota_fleet_profile(4)) as c:
+        for i in range(2):
+            topo, nodes = make_tpu_pool(f"pool-{i}", dims=(4, 4, 4))
+            c.api.create(srv.TPU_TOPOLOGIES, topo)
+            c.add_nodes(nodes)
+        # team-a: tiny min, generous max — any real pod borrows
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "a-quota", "team-a", min={TPU: 1}, max={TPU: 1024}))
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "b-quota", "team-b", min={TPU: 2048}, max={TPU: 4096}))
+        pod = make_pod("borrower", namespace="team-a", limits={TPU: 4},
+                       requests=make_resources(cpu=1, memory="1Gi"))
+        c.create_pods([pod])
+        assert c.wait_for_pods_scheduled([pod.key], timeout=60)
+        router = c.scheduler.shard_router()
+        assert "team-a/borrower" in router.escalated_units(), (
+            router.escalated_units())
+
+
+def test_sharded_quota_burst_never_overshoots_max():
+    """The equivalence cache stays WARM under quotas in sharded mode
+    (ISSUE 14: bounds-only fingerprint) — so this pins the safety side:
+    a burst of identical quota'd pods (one equivalence class, hit-path
+    commits carrying the memoized QuotaReserve) must bind at most the
+    quota max; the commit's semantic re-check is the only thing standing
+    between a stale memoized admission and overshoot."""
+    import time as _time
+    prof = _quota_fleet_profile(4)
+    with TestCluster(profile=prof) as c:
+        topo, nodes = make_tpu_pool("pool-0", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)          # 16 hosts × 4 chips = 64 chips
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "a-quota", "team-a", min={TPU: 12}, max={TPU: 12}))
+        pods = [make_pod(f"b{i}", namespace="team-a", limits={TPU: 4},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(8)]               # 32 chips asked, 12 allowed
+        c.create_pods(pods)
+        deadline = _time.monotonic() + 20
+        while _time.monotonic() < deadline:
+            bound = [p for p in pods
+                     if c.pod(p.key) and c.pod(p.key).spec.node_name]
+            if len(bound) >= 3:
+                break
+            _time.sleep(0.05)
+        _time.sleep(1.0)             # let any overshooting stragglers bind
+        bound = [p for p in pods
+                 if c.pod(p.key) and c.pod(p.key).spec.node_name]
+        assert len(bound) == 3, (
+            f"{len(bound)} × 4-chip pods bound under a 12-chip max — "
+            f"{'overshoot' if len(bound) > 3 else 'under-admission'}")
+        assert c.scheduler.cache.quota_used_snapshot()["team-a"].get(
+            TPU, 0) <= 12
+
+
+def test_quota_serialize_legacy_arm_routes_global():
+    """The pre-14 wholesale serialization survives as the opt-in
+    quota_serialize_dispatch knob (the bench baseline arm)."""
+    prof = _quota_fleet_profile(4)
+    prof.quota_serialize_dispatch = True
+    with TestCluster(profile=prof) as c:
+        topo, nodes = make_tpu_pool("pool-0", dims=(4, 4, 4))
+        c.api.create(srv.TPU_TOPOLOGIES, topo)
+        c.add_nodes(nodes)
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "a-quota", "team-a", min={TPU: 512}, max={TPU: 1024}))
+        pods = [make_pod(f"s{i}", namespace="team-a", limits={TPU: 4},
+                         requests=make_resources(cpu=1, memory="1Gi"))
+                for i in range(4)]
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled([p.key for p in pods], timeout=60)
+        stats = c.scheduler._shard_stats.snapshot()
+        shard_binds = sum(row["binds"] for lane, row in
+                          stats["lanes"].items() if lane != "global")
+        assert shard_binds == 0, (
+            f"legacy serialize arm bound on shard lanes: {stats}")

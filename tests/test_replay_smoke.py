@@ -37,20 +37,32 @@ IN_FLIGHT_CAP = 40          # pods
 
 
 def record_smoke_storm(out_dir: str, seed: int = 7,
-                       capture: bool = True) -> dict:
+                       capture: bool = True,
+                       quota_teams: tuple = (),
+                       profile=None) -> dict:
     """Record (or, capture=False, just run — the overhead-gate A/B arm)
     a tiny mixed storm with capacity recycling and a full drain.  Returns
-    run stats including the wall time of the submission+drain window."""
+    run stats including the wall time of the submission+drain window.
+
+    ``quota_teams``: namespaces to spread units across, each with a
+    generous-min ElasticQuota (the intra-min regime — the ISSUE 14 quota
+    shards=1-vs-N equivalence gate's workload; pass a full_stack profile
+    so CapacityScheduling actually runs)."""
     import random
     rng = random.Random(seed)
     rec = obs.default_fleetrecorder()
     stats = {"submitted": 0}
-    with TestCluster(profile=tpu_gang_profile(permit_wait_s=30,
-                                              denied_s=1)) as c:
+    if profile is None:
+        profile = tpu_gang_profile(permit_wait_s=30, denied_s=1)
+    with TestCluster(profile=profile) as c:
         for i in range(2):
             topo, nodes = make_tpu_pool(f"pool-{i}", dims=(4, 4, 4))
             c.api.create(srv.TPU_TOPOLOGIES, topo)
             c.add_nodes(nodes)
+        from tpusched.testing import make_elastic_quota
+        for team in quota_teams:
+            c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+                f"{team}-quota", team, min={TPU: 512}, max={TPU: 1024}))
         if capture:
             rec.attach(c.api, out_dir)
         try:
@@ -80,19 +92,24 @@ def record_smoke_storm(out_dir: str, seed: int = 7,
                     continue
                 gang = rng.random() < 0.4
                 name = f"smoke-{seq:03d}"
+                ns = quota_teams[seq % len(quota_teams)] if quota_teams \
+                    else "default"
                 seq += 1
                 if gang:
                     c.api.create(srv.POD_GROUPS, make_pod_group(
-                        name, min_member=4, tpu_slice_shape="2x2x4",
+                        name, namespace=ns, min_member=4,
+                        tpu_slice_shape="2x2x4",
                         tpu_accelerator="tpu-v5p"))
-                    pods = [make_pod(f"{name}-{j}", pod_group=name,
+                    pods = [make_pod(f"{name}-{j}", namespace=ns,
+                                     pod_group=name,
                                      limits={TPU: 4},
                                      requests=make_resources(
                                          cpu=1, memory="1Gi"))
                             for j in range(4)]
-                    live.append((f"default/{name}", [p.key for p in pods]))
+                    live.append((f"{ns}/{name}", [p.key for p in pods]))
                 else:
-                    pods = [make_pod(f"{name}-0", limits={TPU: 1},
+                    pods = [make_pod(f"{name}-0", namespace=ns,
+                                     limits={TPU: 1},
                                      requests=make_resources(
                                          cpu=1, memory="1Gi"))]
                     live.append((None, [p.key for p in pods]))
@@ -227,6 +244,74 @@ def test_sharded_lockstep_replay_matches_single_lane(two_replays,
     for row in attributed["placement_diff"]:
         assert row["attributed"] in ("shard-partition", "escalated-global")
         assert row["routed_shard"].startswith("s")
+
+
+@pytest.fixture(scope="module")
+def quota_trace(tmp_path_factory):
+    from tpusched.config.profiles import full_stack_profile
+    d = str(tmp_path_factory.mktemp("quotatrace"))
+    record_smoke_storm(d, seed=11, quota_teams=("team-a", "team-b"),
+                       profile=full_stack_profile(permit_wait_s=30,
+                                                  denied_s=1))
+    return d
+
+
+def test_quota_sharded_lockstep_replay_matches_single_lane(quota_trace):
+    """ISSUE 14 (`make replay-smoke` quota gate): the quota-aware
+    optimistic commit protocol must be placement-equivalent to the
+    serialized single lane.  Replay a storm whose units all live in
+    ElasticQuota namespaces (intra-min regime — the traffic the pre-14
+    router serialized WHOLESALE through the global lane) at shards=1 and
+    shards=4 in lockstep: same pod set binds, bind counts match, the
+    sharded replay is deterministic, and every placement move is
+    attributed to the partition/escalation policy — zero UNATTRIBUTED
+    differences.  An unattributed move here is exactly a quota-epoch
+    protocol bug (a commit landed against a superseded admission
+    verdict)."""
+    from tpusched.api.scheduling import pod_group_full_name
+    from tpusched.api.topology import LABEL_POOL
+    from tpusched.config.profiles import full_stack_profile
+    from tpusched.sched.shards import attribute_placement_diff
+    from tpusched.sim.replay import _decode
+
+    def prof():
+        return full_stack_profile(permit_wait_s=30, denied_s=1)
+
+    r1 = run_replay(quota_trace, profile=prof())
+    assert r1.binds > 0 and r1.unbound == []
+    rs = run_replay(quota_trace, profile=prof(), dispatch_shards=4)
+    assert rs.dispatch_shards == 4
+    assert rs.unbound == [], "quota sharded replay left pods unbound"
+    assert rs.binds == r1.binds
+    rs2 = run_replay(quota_trace, profile=prof(), dispatch_shards=4)
+    assert json.dumps(rs.placements) == json.dumps(rs2.placements), (
+        "quota sharded lockstep replay is nondeterministic")
+
+    trace = load_trace(quota_trace)
+    pool_of = {n.meta.name: n.meta.labels.get(LABEL_POOL, "")
+               for n in trace.objects.get(srv.NODES, ())}
+    gang_of = {}
+    pinned_of = {}
+    for ev in trace.events:
+        if ev.get("kind") == "pod-arrival":
+            obj = _decode(ev)
+            if obj is not None:
+                gang_of[obj.meta.key] = pod_group_full_name(obj) or None
+                pinned_of[obj.meta.key] = \
+                    (obj.spec.node_selector or {}).get(LABEL_POOL)
+    assert rs.escalations_truncated is False
+    diff = diff_placements(r1.to_dict(), rs.to_dict())
+    attributed = attribute_placement_diff(
+        diff, shards=4,
+        pool_of_node=lambda n: pool_of.get(n, ""),
+        gang_of=lambda p: gang_of.get(p),
+        escalated_units=rs.escalated_units,
+        pinned_pool_of=lambda p: pinned_of.get(p),
+        escalated_truncated=rs.escalations_truncated)
+    assert attributed["unattributed_count"] == 0, (
+        f"unattributed placement differences under quota sharding: "
+        f"{attributed['unattributed']} / only_in: "
+        f"{attributed['only_in_a']} {attributed['only_in_b']}")
 
 
 def test_window_index_lockstep_replay_matches_python_path(two_replays,

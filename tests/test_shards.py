@@ -67,10 +67,20 @@ def test_router_global_fallbacks():
     ms.spec.multislice_set = "setA"
     pgs["default/ms1"] = ms
     assert r.lane_for(make_pod("m", pod_group="ms1")) == GLOBAL_LANE
-    # quota mode serializes EVERYTHING
+    # quota presence no longer serializes dispatch (ISSUE 14: the commit
+    # is quota-epoch guarded instead) ...
     r.set_quota_mode(True)
-    assert r.lane_for(make_pod("plain")) == GLOBAL_LANE
+    assert r.lane_for(make_pod("plain")) != GLOBAL_LANE
+    assert not r.quota_serialized()
     r.set_quota_mode(False)
+    # ... unless the LEGACY quota_serialize_dispatch arm is on (the bench
+    # baseline / operational escape hatch)
+    r_legacy = ShardRouter(4, pg_lookup=pgs.get, quota_serialize=True)
+    r_legacy.set_quota_mode(True)
+    assert r_legacy.lane_for(make_pod("plain")) == GLOBAL_LANE
+    assert r_legacy.quota_serialized()
+    r_legacy.set_quota_mode(False)
+    assert r_legacy.lane_for(make_pod("plain")) != GLOBAL_LANE
     # an explicit pool selector pins a SINGLETON to that pool's shard
     pinned = make_pod("pin")
     pinned.spec.node_selector = {LABEL_POOL: "pool-07"}

@@ -78,6 +78,54 @@ def test_sharded_storm_smoke_runs_and_reports(tmp_path):
     assert any("arrival_storm_sharded.shards" in p for p in probs)
 
 
+def test_quota_storm_smoke_runs_and_reports(tmp_path):
+    """ISSUE 14 CI smoke: the QUOTA-ENABLED storm (2 ElasticQuota teams,
+    shards=4) sustains the scaled-down storm with the quota-aware
+    optimistic commit protocol — quota'd binds land on SHARD lanes (the
+    pre-14 router serialized them wholesale), the run drains without
+    wedging, and the record lands as ``arrival_storm_quota`` —
+    schema-valid, with the serialized-arm baseline and conflict
+    attribution stamped (the validator rejects a record missing either)."""
+    r = bench.run_storm_once(pools=2, duration_s=2.0, max_pending_pods=300,
+                             seed=11, drain_timeout_s=90, shards=4,
+                             quota_teams=2)
+    assert r["binds"] > 0
+    assert r["total_binds"] == r["submitted_pods"]   # drained, no wedge
+    assert r["quota_teams"] == 2 and not r["quota_serialized"]
+    assert r["dispatch"] is not None
+    assert r["dispatch"]["shard_binds"] > 0, (
+        f"no quota'd bind used a shard lane: {r['dispatch']}")
+    # the serialized baseline arm still works (the A/B control)
+    rs = bench.run_storm_once(pools=2, duration_s=1.0,
+                              max_pending_pods=300, seed=11,
+                              drain_timeout_s=90, shards=4,
+                              quota_teams=2, quota_serialize=True)
+    assert rs["total_binds"] == rs["submitted_pods"]
+    assert rs["dispatch"]["shard_binds"] == 0, (
+        f"legacy serialize arm bound on shard lanes: {rs['dispatch']}")
+
+    bench._record_scenario(
+        "arrival_storm_quota", "throughput", shards=4, quota_teams=2,
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1,
+        serialized_binds_per_sec=rs["binds_per_sec"],
+        quota_conflicts=r["dispatch"]["quota_conflicts"],
+        escalations=r["dispatch"]["escalations"])
+    out = tmp_path / "results.json"
+    bench.write_results_artifact(str(out))
+    assert bench._gate_failures == []
+    doc = json.loads(out.read_text())
+    assert bench.validate_results_artifact(doc) == []
+    # negative tables: a quota record must name its anatomy
+    for field in ("serialized_binds_per_sec", "quota_teams",
+                  "quota_conflicts", "escalations", "shards"):
+        broken = json.loads(out.read_text())
+        broken["scenarios"]["arrival_storm_quota"].pop(field)
+        probs = bench.validate_results_artifact(broken)
+        assert any(f"arrival_storm_quota.{field}" in p for p in probs), (
+            field, probs)
+
+
 def test_latency_lines_record_into_artifact():
     bench.emit_latency("synthetic scenario", [0.1, 0.2, 0.3], "synth_p99")
     doc = bench.build_results_artifact()

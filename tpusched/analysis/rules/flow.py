@@ -173,6 +173,10 @@ class AtomicityViolation(Rule):
 
 _SNAPSHOT_ALLOWED = ("tpusched/sched/", "tpusched/verify/")
 _SNAP_ESCAPE_MUTATORS = _MUTATORS
+# foreign-thread snapshot readers under the read-only/function-local
+# contract: peek_snapshot (last loop-built view, may be stale) and
+# shared_snapshot (persistent composed view, always fresh — ISSUE 14)
+_SNAP_READERS = ("peek_snapshot", "shared_snapshot")
 
 
 @register
@@ -232,7 +236,7 @@ class SnapshotDiscipline(Rule):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             has_peek = any(isinstance(n, ast.Attribute)
-                           and n.attr == "peek_snapshot"
+                           and n.attr in _SNAP_READERS
                            for n in ast.walk(fn))
             if not has_peek:
                 continue
@@ -251,7 +255,7 @@ class SnapshotDiscipline(Rule):
                     v = n.value
                     from_peek = (isinstance(v, ast.Call)
                                  and isinstance(v.func, ast.Attribute)
-                                 and v.func.attr == "peek_snapshot"
+                                 and v.func.attr in _SNAP_READERS
                                  and len(elts) == 1)
                     for name_tgt in elts:
                         if not isinstance(name_tgt, ast.Name):

@@ -112,6 +112,12 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
             if v < lo:
                 raise ConfigError(f"{yaml_key} must be >= {lo}, got {v}")
             setattr(profile, attr, v)
+    if "quotaSerializeDispatch" in raw:
+        v = raw["quotaSerializeDispatch"]
+        if not isinstance(v, bool):
+            raise ConfigError(
+                f"quotaSerializeDispatch must be a boolean, got {v!r}")
+        profile.quota_serialize_dispatch = v
     slo = raw.get("slo", {}) or {}
     if not isinstance(slo, dict):
         raise ConfigError(f"slo must be a mapping, got {type(slo).__name__}")

@@ -35,6 +35,15 @@ class CoschedulingArgs:
     """types.go:28-39."""
     permit_waiting_time_seconds: int = DEFAULT_PERMIT_WAITING_TIME_SECONDS
     denied_pg_expiration_time_seconds: int = DEFAULT_DENIED_PG_EXPIRATION_TIME_SECONDS
+    # PodGroup status patch coalescing window (ISSUE 14 satellite): a
+    # gang's permit barrier releases all members at once, and a per-member
+    # status patch turns every bind burst into per-bind API fan-out on the
+    # binding hot path.  Partial-progress increments within this window
+    # coalesce into ONE patch per gang; quorum completion always flushes
+    # INLINE (the PodGroup-to-Bound north-star observation keeps its exact
+    # clock).  0 = patch per bind (the pre-14 behavior; deterministic
+    # replay uses it so patch timing never races the lockstep barrier).
+    pg_status_flush_seconds: float = 0.05
 
 
 @dataclass

@@ -187,7 +187,8 @@ def decode(raw: Dict[str, Any]) -> SchedulerConfiguration:
 def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
     _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig",
                                    "percentageOfNodesToScore",
-                                   "dispatchShards", "bindPoolWorkers"})
+                                   "dispatchShards", "bindPoolWorkers",
+                                   "quotaSerializeDispatch"})
     name = raw.get("schedulerName") or "tpusched"
     pct = int(raw.get("percentageOfNodesToScore") or 0)
     if not 0 <= pct <= 100:
@@ -207,6 +208,14 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         raise ConfigError(
             f"profile {name!r}: dispatchShards/bindPoolWorkers must be "
             f">= 0")
+    # legacy wholesale quota serialization (ISSUE 14): the pre-quota-
+    # protocol router behavior, kept as the bench baseline arm and an
+    # operational escape hatch (doc/ops.md)
+    quota_serialize = raw.get("quotaSerializeDispatch", False)
+    if not isinstance(quota_serialize, bool):
+        raise ConfigError(
+            f"profile {name!r}: quotaSerializeDispatch must be a boolean, "
+            f"got {quota_serialize!r}")
     plugins = raw.get("plugins") or {}
     for ep in plugins:
         if ep not in EXTENSION_POINTS:
@@ -250,6 +259,7 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         percentage_of_nodes_to_score=pct,
         dispatch_shards=shards,
         bind_pool_workers=bind_workers,
+        quota_serialize_dispatch=quota_serialize,
     )
 
 
@@ -320,6 +330,10 @@ def encode(cfg: SchedulerConfiguration) -> Dict[str, Any]:
             if spec:
                 plugins[ep] = spec
         prof: Dict[str, Any] = {"schedulerName": p.scheduler_name}
+        if p.dispatch_shards != 1:
+            prof["dispatchShards"] = p.dispatch_shards
+        if p.quota_serialize_dispatch:
+            prof["quotaSerializeDispatch"] = True
         if plugins:
             prof["plugins"] = plugins
         if p.plugin_args:

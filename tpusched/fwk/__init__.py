@@ -21,6 +21,6 @@ from .interfaces import (Plugin, QueueSortPlugin, PreFilterPlugin, FilterPlugin,
                          WILDCARD_EVENT)
 from .runtime import (Framework, Registry, Handle, PluginProfile,
                       PODS_TO_ACTIVATE_KEY, GANG_ROLLBACK_STATE_KEY,
-                      PodsToActivate)
+                      QUOTA_GUARD_STATE_KEY, PodsToActivate)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

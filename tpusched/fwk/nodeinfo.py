@@ -28,6 +28,21 @@ def next_generation() -> int:
     return next(_generation)
 
 
+def quorum_count_with_inflight(snapshot, pg_name: str,
+                               namespace: str) -> int:
+    """Gang members assigned INCLUDING the caller's own in-flight pod.
+
+    Upstream counts ``assigned + 1`` because the in-flight pod is never in
+    a frozen-at-cycle-start snapshot (core.go:209-215).  The cache's
+    persistent snapshots (PooledSnapshot) carry the LIVE gang-quorum
+    index instead — the cycle's own assume is already counted by Permit
+    time — so adding 1 there would release the barrier one member early.
+    This helper is the one place that knows which convention a lister
+    uses; every quorum comparison goes through it."""
+    n = snapshot.assigned_count(pg_name, namespace)
+    return n if getattr(snapshot, "live_pg_assigned", False) else n + 1
+
+
 def minmax_normalize(raw: Dict[str, int], scores) -> None:
     """Min-max normalize NodeScore list in place from a raw per-node dict
     (the shared pattern of allocatable.go:141-166 / pod_state.go:72-95);
@@ -109,6 +124,13 @@ class NodeInfo:
 class Snapshot:
     """Immutable-by-convention per-cycle cluster view; also the fake shared
     lister used by unit tests (/root/reference/test/util/fake.go:32-101)."""
+
+    # True when assigned_count serves the cache's LIVE gang-quorum index
+    # (set by PooledSnapshot): the caller's own in-cycle assume is already
+    # counted, so quorum checks must NOT add the upstream "+1 for the
+    # in-flight pod" (core.go:209-215) on top — see
+    # quorum_count_with_inflight.
+    live_pg_assigned = False
 
     def __init__(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()):
         self._infos: Dict[str, NodeInfo] = {}
@@ -209,3 +231,155 @@ class Snapshot:
     def clone(self) -> "Snapshot":
         return Snapshot.from_infos(
             {name: info.clone() for name, info in self._infos.items()})
+
+
+class PoolChain:
+    """Lazy pool-ordered candidate SEQUENCE over per-pool NodeInfo lists:
+    len/iter/random-access without flattening.  Built O(pools) per
+    snapshot epoch; the per-pool lists are cached against the pool's
+    sub-map by the cache, so an epoch where one pool mutated re-lists one
+    pool and chains the rest by reference — the last per-cycle O(hosts)
+    term (the flat candidate materialization) becomes O(pools).  Random
+    access (the Filter sweep's rotating start index) is a bisect over
+    prefix lengths — O(log pools), pools are double-digit."""
+
+    __slots__ = ("_lists", "_offsets", "_len")
+
+    def __init__(self, lists: List[List["NodeInfo"]]):
+        self._lists = lists
+        self._offsets = []
+        n = 0
+        for lst in lists:
+            self._offsets.append(n)
+            n += len(lst)
+        self._len = n
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self):
+        for lst in self._lists:
+            yield from lst
+
+    def __getitem__(self, i: int) -> "NodeInfo":
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        import bisect
+        j = bisect.bisect_right(self._offsets, i) - 1
+        return self._lists[j][i - self._offsets[j]]
+
+
+class PooledSnapshot(Snapshot):
+    """Persistent/versioned cluster view composed of PER-POOL sub-maps
+    (sched/cache.py's O(Δ) cycle core): each pool's ``{node: NodeInfo}``
+    dict is built once at that pool's cursor and SHARED STRUCTURALLY by
+    every snapshot that includes the pool until the pool mutates again —
+    a cycle over a quiet fleet composes its view from existing sub-maps
+    in O(pools) instead of rebuilding an O(hosts) dict, and a single
+    informer event re-clones one pool, not the fleet.
+
+    Immutability contract (stronger than the base class's by-convention):
+    the sub-map dicts are shared between the cache and EVERY live
+    snapshot, so they are never mutated in place — a pool rebuild swaps
+    in a fresh dict.  ``list()`` therefore returns ONE cached flat list
+    per snapshot epoch (pool-ordered: the lazy candidate sequence the
+    scheduler sweeps), and callers must treat it as read-only — exactly
+    the read-only contract snapshot NodeInfos already carry."""
+
+    def __init__(self, pools: Dict[str, Dict[str, "NodeInfo"]],
+                 pool_cursors: Dict[str, int],
+                 pg_assigned: Optional[Dict[str, int]] = None,
+                 pool_lists: Optional[Dict[str, List["NodeInfo"]]] = None):
+        self._pools = pools
+        self._infos = None          # base-class attr unused; see overrides
+        self.pool_cursors = pool_cursors
+        self._pg_assigned = pg_assigned
+        self.live_pg_assigned = pg_assigned is not None
+        self._pg_live = None
+        self._num = sum(len(m) for m in pools.values())
+        self._flat: Optional[List[NodeInfo]] = None   # lazy, cached
+        self._cursor_tuple = None                     # lazy, cached
+        # per-pool value lists shared from the cache's persistent entries
+        # (a pool re-lists only when its sub-map was rebuilt); the chain
+        # over them is this snapshot's candidate sequence
+        self._pool_lists = pool_lists
+        self._chain: Optional[PoolChain] = None       # lazy, cached
+
+    def candidate_seq(self):
+        """Pool-ordered candidate sequence (len/iter/index) WITHOUT
+        flattening — the scheduler's sweep input.  Falls back to the
+        cached flat list when per-pool lists were not provided."""
+        if self._pool_lists is None:
+            return self.list()
+        chain = self._chain
+        if chain is None:
+            chain = self._chain = PoolChain(
+                [self._pool_lists[p] for p in self._pools])
+        return chain
+
+    def cursor_tuple(self):
+        """Canonical sorted ((pool, cursor), ...) — the equivalence-cache
+        validity witness, memoized per snapshot epoch (the per-cycle sort
+        of the cursor dict was one of the last O(pools)-per-cycle terms)."""
+        if self._cursor_tuple is None:
+            self._cursor_tuple = tuple(sorted(self.pool_cursors.items()))
+        return self._cursor_tuple
+
+    # SharedLister overrides over the pooled layout -------------------------
+    def list(self) -> List[NodeInfo]:
+        flat = self._flat
+        if flat is None:
+            flat = [info for pool in self._pools.values()
+                    for info in pool.values()]
+            self._flat = flat
+        return flat
+
+    def get(self, node_name: str) -> Optional[NodeInfo]:
+        # O(#pools) dict probes (single-digit per shard partition, ≤ fleet
+        # pool count globally) — cheaper than maintaining a merged name
+        # index that would have to be rebuilt O(hosts) per epoch
+        for pool in self._pools.values():
+            info = pool.get(node_name)
+            if info is not None:
+                return info
+        return None
+
+    def node_names(self) -> List[str]:
+        return [name for pool in self._pools.values() for name in pool]
+
+    def num_nodes(self) -> int:
+        return self._num
+
+    def _iter_infos(self):
+        for pool in self._pools.values():
+            yield from pool.values()
+
+    def assigned_live_count(self, pg_name: str, namespace: str) -> int:
+        if self._pg_live is None:
+            idx: Dict[str, int] = {}
+            for info in self._iter_infos():
+                for key, c in info.derived(
+                        "Snapshot/pg-live",
+                        self._node_pg_live_counts).items():
+                    idx[key] = idx.get(key, 0) + c
+            self._pg_live = idx
+        return self._pg_live.get(f"{namespace}/{pg_name}", 0)
+
+    def assigned_count(self, pg_name: str, namespace: str) -> int:
+        if self._pg_assigned is None:
+            idx: Dict[str, int] = {}
+            for info in self._iter_infos():
+                for key, c in info.derived(
+                        "Snapshot/pg-assigned", self._node_pg_counts).items():
+                    idx[key] = idx.get(key, 0) + c
+            self._pg_assigned = idx
+        return self._pg_assigned.get(f"{namespace}/{pg_name}", 0)
+
+    def clone(self) -> "Snapshot":
+        # forks (what-if planner, defrag trials) get a plain mutable
+        # Snapshot: they exist to mutate their copy
+        return Snapshot.from_infos(
+            {name: info.clone() for pool in self._pools.values()
+             for name, info in pool.items()})

@@ -39,6 +39,13 @@ PODS_TO_ACTIVATE_KEY = "tpusched/pods-to-activate"
 # as soon as the faults clear, and a denial TTL on top would stall that.
 GANG_ROLLBACK_STATE_KEY = "tpusched/gang-bind-rollback"
 
+# CycleState key CapacityScheduling's PreFilter writes when ElasticQuotas
+# exist: the cache quota EPOCH its admission inputs were read at.  The
+# scheduler's sharded commit passes it into Cache.assume_pod_guarded as
+# the compare-and-reserve key (ISSUE 14) — a framework-level name so the
+# scheduler never imports plugin modules.
+QUOTA_GUARD_STATE_KEY = "tpusched/quota-commit-guard"
+
 
 class PodsToActivate:
     def __init__(self):
@@ -84,6 +91,12 @@ class PluginProfile:
     # with "unset")
     pod_initial_backoff_s: Optional[float] = None
     pod_max_backoff_s: Optional[float] = None
+    # unschedulableQ periodic flush (upstream flushUnschedulablePodsLeftover,
+    # default 30 s): a wall-clock SAFETY NET behind the event-logical move
+    # drains — None = default, explicit 0 disables it (purely event-driven
+    # retries; deterministic replay uses 0 so a wall flush can never land
+    # on a run-dependent event boundary).
+    unschedulable_flush_s: Optional[float] = None
     # gang-aware equivalence-class scheduling cache (sched/equivcache.py):
     # memoized PreFilter/Filter outcomes reused across equivalent pods
     # (gang siblings). equiv_cache_differential additionally re-runs the
@@ -123,10 +136,23 @@ class PluginProfile:
     # over its pool partition with optimistic conflict resolution on the
     # cache's per-pool cursors; a serialized global lane handles pods whose
     # feasible pools span shards (multislice sets, explicit cross-shard
-    # constraints, any fleet with ElasticQuotas).  1 (default) = the
-    # classic single dispatch loop, byte-identical behavior to pre-sharding.
+    # constraints, cross-quota borrowers).  1 (default) = the classic
+    # single dispatch loop, byte-identical behavior to pre-sharding.
     # 0 = auto (min(4, cpu count)).  Config YAML: `dispatchShards`.
     dispatch_shards: int = 1
+    # Shard escalation TTL override (sched/shards.ESCALATION_TTL_S default
+    # 30 s): how long an escalated unit stays routed to the global lane
+    # before returning to its home shard.  None = default.  Deterministic
+    # replay pins it to the whole run (a wall-clock TTL lapsing mid-replay
+    # re-routes a unit at a run-dependent event boundary).
+    escalation_ttl_s: Optional[float] = None
+    # LEGACY quota serialization (pre-ISSUE-14 behavior): route EVERY pod
+    # through the global lane whenever any ElasticQuota exists, instead of
+    # the quota-aware optimistic commit protocol (cache quota epoch
+    # compare-and-reserve).  Kept as the A/B baseline arm for
+    # bench.py --storm-quota and as an operational escape hatch
+    # (doc/ops.md).  Config YAML: `quotaSerializeDispatch`.
+    quota_serialize_dispatch: bool = False
     # _BindingPool worker count. 0 = auto, sized relative to the dispatch
     # shard count (2 workers per lane, floor 4, cap 32) so bind submission
     # from N concurrent lanes does not become the new serialization point.
@@ -322,6 +348,24 @@ class Handle:
     """framework.Handle analog passed to plugin factories: cluster views,
     clients, the waitingPods map, and helper runs (SURVEY §3.1 init
     boundary)."""
+
+    # cache quota-ledger accessor (sched.Cache.quota_view), attached by
+    # the scheduler after cache construction: CapacityScheduling's
+    # PreFilter reads its admission inputs (per-quota min/max/used)
+    # through it so the sharded commit's semantic compare-and-reserve
+    # judges the same arithmetic on live state.  None = no ledger
+    # (standalone plugin construction in unit tests; the plugin falls
+    # back to its own informer mirror).
+    quota_view = None
+    # companion accessor (sched.Cache.quota_bounds_signature): the
+    # equivalence cache's quota fingerprint input under guarded commits
+    quota_bounds_signature = None
+    # True when EVERY commit in this scheduler passes through the guarded
+    # assume (sharded dispatch): the precondition for keeping the
+    # equivalence cache warm under ElasticQuotas — a stale memoized quota
+    # admission is then caught at the commit's semantic re-check instead
+    # of slipping into an unguarded assume_pod.
+    quota_guarded_commits = False
 
     def __init__(self, clientset, informer_factory, framework_getter,
                  clock=time.time):

@@ -227,15 +227,16 @@ class CapacityTelemetry:
             return
         self._last_refresh = now
         # READ-ONLY snapshot access: this runs on the /metrics scrape
-        # thread, and cache.snapshot() from here would advance the
-        # snapshot cursor mid-cycle — laundering a concurrent foreign
-        # mutation past the equivalence cache's arming guard.  The last
-        # loop-built snapshot is at most one scheduling cycle stale.
-        snapshot = sched.cache.peek_snapshot()
+        # thread.  shared_snapshot() serves the cache's PERSISTENT
+        # composed view — always fresh at O(Δ) cost (per-pool sub-maps
+        # rebuilt only for mutated pools), and unlike cache.snapshot() it
+        # never advances the loop's snapshot bookkeeping, so it cannot
+        # launder a concurrent foreign mutation past the equivalence
+        # cache's arming guard.  This is what retired the scheduler's
+        # housekeeping-tick full snapshot refresh (ISSUE 14).
+        snapshot = sched.cache.shared_snapshot()
         self._refresh_queue(sched)
-        if snapshot is None:
-            return                        # no cycle has run yet
-        cursor = sched.cache.snapshot_cursor()
+        cursor = sched.cache.mutation_cursor()
         self._refresh_pools(sched, snapshot, cursor)
         self._refresh_quotas(sched, snapshot)
 
@@ -310,13 +311,23 @@ class CapacityTelemetry:
         quotas = list(sched.informer_factory.elasticquotas().items())
         if not quotas and not self._ns_labels:
             return
-        used: Dict[str, int] = {}
-        for info in snapshot.list():
-            for p in info.pods:
-                chips, chips_set, _, _ = pod_tpu_limits(p)
-                if chips_set:
-                    used[p.meta.namespace] = \
-                        used.get(p.meta.namespace, 0) + chips
+        # quota ledger fast path (ISSUE 14): the cache maintains per-quota
+        # used resources incrementally, so the per-scrape O(pods) fleet
+        # walk collapses to O(quotas).  Fallback to the walk only when the
+        # ledger tracks none of the informer's quotas yet (registration
+        # races the first scrape).
+        from ..api.resources import TPU as _TPU
+        ledger = sched.cache.quota_used_snapshot() \
+            if hasattr(sched.cache, "quota_used_snapshot") else {}
+        used: Dict[str, int] = {ns: int(res.get(_TPU, 0))
+                                for ns, res in ledger.items()}
+        if not ledger:
+            for info in snapshot.list():
+                for p in info.pods:
+                    chips, chips_set, _, _ = pod_tpu_limits(p)
+                    if chips_set:
+                        used[p.meta.namespace] = \
+                            used.get(p.meta.namespace, 0) + chips
         seen = set()
         for eq in quotas:
             ns = eq.meta.namespace

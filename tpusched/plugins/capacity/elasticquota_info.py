@@ -15,17 +15,50 @@ from typing import Dict, Iterable, Optional, Set
 
 from ...api.core import Pod
 from ...api.resources import ResourceList, add_resources
-from ...util.podutil import pod_effective_request
+from ...util.podutil import pod_effective_request, resources_over_bound
+
+# the ONE bound comparator, shared with the cache's commit-time
+# compare-and-reserve (sched/cache.py) — admission and commit must
+# evaluate the identical rule or the quota protocol is unsound
+_over = resources_over_bound
 
 
-def _over(used: ResourceList, delta: Optional[ResourceList],
-          bound: ResourceList) -> bool:
-    """any resource named in `bound` with used+delta > bound."""
-    for k, b in bound.items():
-        v = used.get(k, 0) + (delta.get(k, 0) if delta else 0)
-        if v > b:
-            return True
-    return False
+class LazyPodKeys:
+    """Deferred pod-key membership for a quota admission snapshot: the
+    sets are consumed ONLY by preemption dry-run idempotency
+    (add/delete_pod_if_present), so the common admission cycle must not
+    pay an O(scheduled-quota-pods) copy per namespace per cycle
+    (cache.quota_view hands out a loader instead; the copy happens on
+    first dry-run touch).  Loaded after the view's critical section, so
+    membership may lag ``used`` by the in-flight window — conservative
+    for dry-run arithmetic (a just-released pod reads as still counted)
+    and irrelevant to admission, which never reads membership."""
+
+    __slots__ = ("_loader", "_set")
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._set = None
+
+    def _materialized(self) -> set:
+        if self._set is None:
+            self._set = set(self._loader())
+        return self._set
+
+    def __contains__(self, key) -> bool:
+        return key in self._materialized()
+
+    def __iter__(self):
+        return iter(self._materialized())
+
+    def __len__(self) -> int:
+        return len(self._materialized())
+
+    def add(self, key) -> None:
+        self._materialized().add(key)
+
+    def discard(self, key) -> None:
+        self._materialized().discard(key)
 
 
 class ElasticQuotaInfo:
@@ -77,6 +110,21 @@ class ElasticQuotaInfo:
     def clone(self) -> "ElasticQuotaInfo":
         return ElasticQuotaInfo(self.namespace, self.min, self.max, self.used,
                                 self.pods)
+
+    @classmethod
+    def from_parts(cls, namespace: str, min: ResourceList, max: ResourceList,
+                   used: ResourceList, pods: Set[str]) -> "ElasticQuotaInfo":
+        """Adopt already-copied parts WITHOUT re-copying — the cache quota
+        ledger's ``quota_view()`` hands out fresh dict/set copies per call
+        (one consistent critical section), so the constructor's defensive
+        copies would only double the per-cycle allocation."""
+        info = cls.__new__(cls)
+        info.namespace = namespace
+        info.min = min
+        info.max = max
+        info.used = used
+        info.pods = pods
+        return info
 
 
 class ElasticQuotaInfos(dict):

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple
 from ...api.core import Pod, PodDisruptionBudget
 from ...api.resources import ResourceList
 from ...api.scheduling import ElasticQuota
-from ...fwk import CycleState, Status
+from ...fwk import CycleState, QUOTA_GUARD_STATE_KEY, Status
 from ...fwk.interfaces import (ClusterEvent, EnqueueExtensions,
                                EquivalenceAware, EVENT_ADD,
                                EVENT_DELETE, EVENT_UPDATE, PostFilterPlugin,
@@ -35,7 +35,8 @@ from ...sched.preemption import (Evaluator, GangDisruptionFloor,
                                  more_important_pod, reprieve_victims)
 from ...util import klog
 from ...util.podutil import assigned, is_pod_terminated, pod_effective_request
-from .elasticquota_info import ElasticQuotaInfo, ElasticQuotaInfos
+from .elasticquota_info import (ElasticQuotaInfo, ElasticQuotaInfos,
+                                LazyPodKeys)
 
 EQ_SNAPSHOT_KEY = "CapacityScheduling/elasticQuotaSnapshot"
 PRE_FILTER_STATE_KEY = "CapacityScheduling/preFilterState"
@@ -66,12 +67,23 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
     NAME = "CapacityScheduling"
 
     def equiv_fingerprint(self, pod, state):
-        """Veto while ANY ElasticQuota exists: the per-cycle quota snapshot
-        moves with every Reserve — including the same-class sibling assumes
-        the cache's cursor chain sanctions — so a memoized snapshot could
-        admit a pod the live quota arithmetic would reject. With no quotas
-        registered, PreFilter degenerates to an empty snapshot plus a pure
-        function of the pod: trivially reusable."""
+        """Under GUARDED commits (sharded dispatch, ISSUE 14) the cache
+        stays warm through quotas: a memoized admission's staleness is
+        caught by the commit's semantic re-check (used+in_eq vs max,
+        Σused+total vs Σmin against the live ledger), so usage churn —
+        including the same-class sibling assumes the cursor chain
+        sanctions — needs no invalidation here.  The fingerprint is the
+        BOUNDS signature only: a min/max or quota-set change alters which
+        QuotaReserve a cycle should have built, so it must invalidate.
+
+        Without guarded commits (single dispatch loop, the legacy
+        serialize arm, standalone plugin use) the pre-14 veto stands:
+        assume_pod is unguarded there, and a memoized snapshot could
+        admit a pod the live quota arithmetic would reject."""
+        if getattr(self.handle, "quota_guarded_commits", False):
+            sig = getattr(self.handle, "quota_bounds_signature", None)
+            if sig is not None:
+                return sig()
         with self._lock:
             return None if self.eq_infos else ()
 
@@ -138,6 +150,28 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
 
     # -- PreFilter ------------------------------------------------------------
 
+    def _snapshot_quotas(self, state: CycleState) -> "_EQSnapshot":
+        """Quota admission inputs for this cycle.  Preferred source: the
+        cache quota LEDGER through ``handle.quota_view`` — per-quota
+        min/max/used captured in ONE cache critical section, so the
+        commit's semantic re-check (``QuotaReserve``, written into
+        CycleState at the end of pre_filter) judges the same arithmetic
+        on live state.  Fallback: the plugin's own informer mirror
+        (standalone construction in unit tests, no ledger attached) —
+        correct for a single dispatch loop, which is the only way such a
+        scheduler runs."""
+        view = getattr(self.handle, "quota_view", None)
+        if view is not None:
+            raw, _epoch = view()
+            infos = ElasticQuotaInfos()
+            if raw:
+                for ns, (mn, mx, used, pods_loader) in raw.items():
+                    infos[ns] = ElasticQuotaInfo.from_parts(
+                        ns, mn, mx, used, LazyPodKeys(pods_loader))
+            return _EQSnapshot(infos)
+        with self._lock:
+            return _EQSnapshot(self.eq_infos.clone())
+
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
         # Reuse an existing snapshot when re-evaluated inside a preemption
         # dry-run (cloned CycleState): the dry-run's Add/RemovePod extensions
@@ -145,8 +179,7 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
         # those adjustments (CrossNodePreemption re-runs PreFilter this way).
         snapshot = state.try_read(EQ_SNAPSHOT_KEY)
         if snapshot is None:
-            with self._lock:
-                snapshot = _EQSnapshot(self.eq_infos.clone())
+            snapshot = self._snapshot_quotas(state)
             state.write(EQ_SNAPSHOT_KEY, snapshot)
         pod_req = pod_effective_request(pod)
 
@@ -158,10 +191,16 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
 
         # nominated-pod accounting (:218-257): reqs of nominated pods that
         # would consume this quota (same ns, ≥ priority) or global min spare
-        # (other ns, quota not over min)
+        # (other ns, quota not over min).  Guarded on the nominator's
+        # lock-free empty() peek: the sweep below walks EVERY candidate
+        # node per quota'd cycle, which with no nominated pods anywhere
+        # (the overwhelmingly common case) was a pure O(nodes) tax on the
+        # quota-storm hot path (ISSUE 14).
         in_eq: ResourceList = dict(pod_req)
         total: ResourceList = dict(pod_req)
-        for info in self.handle.snapshot_shared_lister().list():
+        nominated_iter = () if self.handle.pod_nominator.empty() \
+            else self.handle.snapshot_shared_lister().list()
+        for info in nominated_iter:
             for np in self.handle.pod_nominator.nominated_pods_for_node(
                     info.node.name):
                 if np.meta.uid == pod.meta.uid:
@@ -191,6 +230,27 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
             return Status.unschedulable(
                 f"Pod {pod.key} is rejected in PreFilter because ElasticQuota "
                 f"{eq.namespace} is more than Max")
+        if (eq.used_over_min_with(in_eq) and self._dispatch_scope()
+                == "partition"):
+            # cross-quota BORROW on a shard lane (ISSUE 14): admitting this
+            # pod spends spare min guaranteed to OTHER quotas, and borrower
+            # preemption/nomination machinery is global-lane state — reject
+            # here so the scheduler's standard escalation hop re-runs the
+            # unit on the serialized global lane with fleet-wide admission.
+            # Intra-min pods (the common multi-tenant case) stay on their
+            # shard lanes: their commit is protected by the quota-epoch
+            # compare-and-reserve.
+            from ... import trace
+            if trace.current() is not None:
+                trace.record_rejection(
+                    self.NAME, "over-min borrow needs fleet-wide admission "
+                    "(escalating to the global lane)",
+                    quota_namespace=eq.namespace,
+                    used=str(dict(eq.used)), min=str(dict(eq.min)),
+                    request=str(dict(pod_req)))
+            return Status.unschedulable(
+                f"Pod {pod.key} borrows beyond ElasticQuota {eq.namespace} "
+                f"min: cross-quota admission runs on the global lane")
         if snapshot.infos.aggregated_used_over_min_with(total):
             from ... import trace
             if trace.current() is not None:
@@ -202,7 +262,21 @@ class CapacityScheduling(PreFilterPlugin, PostFilterPlugin, ReservePlugin,
             return Status.unschedulable(
                 f"Pod {pod.key} is rejected in PreFilter because total "
                 f"ElasticQuota used is more than min")
+        # admission passed: hand the commit the exact vectors it judged
+        # (ISSUE 14).  The sharded commit re-evaluates used+in_eq vs max
+        # and Σused+total vs Σmin against the LIVE cache ledger inside
+        # assume_pod_guarded — the semantic compare-and-reserve that lets
+        # quota'd pods dispatch on shard lanes without overshoot.
+        from ...sched.cache import QuotaReserve
+        state.write(QUOTA_GUARD_STATE_KEY,
+                    QuotaReserve(eq.namespace, dict(in_eq), dict(total)))
         return Status.success()
+
+    def _dispatch_scope(self) -> str:
+        """'' (fleet-wide) or 'partition' (a shard lane's restricted
+        cycle); tolerant of bare test handles without the accessor."""
+        scope = getattr(self.handle, "dispatch_scope", None)
+        return scope() if callable(scope) else ""
 
     def pre_filter_extensions(self) -> Optional[PreFilterExtensions]:
         return _Extensions()

@@ -42,7 +42,14 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
         self.pg_mgr = PodGroupManager(
             handle,
             schedule_timeout_s=float(self.args.permit_waiting_time_seconds),
-            denied_pg_expiration_s=float(self.args.denied_pg_expiration_time_seconds))
+            denied_pg_expiration_s=float(self.args.denied_pg_expiration_time_seconds),
+            pg_status_flush_s=float(getattr(
+                self.args, "pg_status_flush_seconds", 0.0)))
+
+    def close(self) -> None:
+        """Framework shutdown: drain any coalesced PG status increments so
+        a stopped scheduler never swallows partial gang progress."""
+        self.pg_mgr.flush_status()
 
     @classmethod
     def new(cls, args, handle) -> "Coscheduling":
@@ -171,15 +178,15 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
                 pg, float(self.args.permit_waiting_time_seconds))
             klog.V(3).info_s("pod is waiting to be scheduled", pod=pod.key,
                              node=node_name, waitSeconds=wait_s)
-            # quorum progress into the cycle trace: assigned+1 (this pod is
-            # not in its own snapshot) of min_member, so a wedged barrier's
-            # dump shows exactly how far the gang got (guarded: the count
-            # lookup + format is only worth paying when a trace is live)
+            # quorum progress into the cycle trace: in-flight-inclusive
+            # count of min_member, so a wedged barrier's dump shows exactly
+            # how far the gang got (guarded: the count lookup + format is
+            # only worth paying when a trace is live)
             if trace.current() is not None:
-                assigned = self.pg_mgr.calculate_assigned_pods(
+                quorum = self.pg_mgr.quorum_with_inflight(
                     pg.meta.name, pod.namespace)
                 trace.annotate("coscheduling_quorum",
-                               f"{assigned + 1}/{pg.spec.min_member}")
+                               f"{quorum}/{pg.spec.min_member}")
             # pull the siblings into activeQ so the quorum can form
             self.pg_mgr.activate_siblings(pod, state)
             return Status.wait(), wait_s

@@ -499,10 +499,16 @@ class MultiSlice(PreFilterPlugin, FilterPlugin, PostFilterPlugin,
         if len(members) < pg.spec.multislice_set_size:
             return False
         snapshot = self.handle.snapshot_shared_lister()
+        from ...fwk.nodeinfo import quorum_count_with_inflight
         for g in members:
-            assigned = snapshot.assigned_count(g.meta.name, pod.namespace)
             if g.meta.name == pg.meta.name:
-                assigned += 1
+                # own gang: the in-flight pod counts once, on either
+                # snapshot flavor (live index vs frozen +1)
+                assigned = quorum_count_with_inflight(
+                    snapshot, g.meta.name, pod.namespace)
+            else:
+                assigned = snapshot.assigned_count(g.meta.name,
+                                                   pod.namespace)
             if assigned < g.spec.min_member:
                 return False
         return True

@@ -20,6 +20,35 @@ global-lane bind) fails the compare and the shard retries on fresh state
 instead of binding a stale placement.  Mutations in OTHER pools do not
 conflict: that independence is the whole point of partitioning dispatch by
 pool.
+
+O(Δ) cycle core (ISSUE 14): the snapshot is PERSISTENT and VERSIONED —
+per-pool ``{node: NodeInfo}`` sub-maps built at a pool cursor and shared
+structurally between every snapshot/partition view that includes the pool
+(fwk.nodeinfo.PooledSnapshot).  A cycle over a quiet fleet composes its
+view from existing sub-maps in O(pools-in-scope); a mutation re-clones ONE
+pool's map (and inside it only the nodes whose generation moved).  The
+gang-quorum index rides into every snapshot live by reference, cursor
+tuples are memoized per snapshot epoch, and the flat candidate list is
+cached per epoch — deleting the per-cycle O(hosts) dict builds, O(gangs)
+copies and candidate materialization the pre-14 core paid on every cycle.
+
+Quota ledger (ISSUE 14): each registered ElasticQuota namespace carries a
+usage cursor — ``used`` resources of the namespace's known scheduled pods
+(assumed + bound, non-terminated), maintained in the SAME critical
+sections as the pod mutations themselves, plus incrementally-maintained
+fleet aggregates (Σused, Σmin) and per-namespace change cursors / a
+fleet-wide epoch for diagnosis.  CapacityScheduling's PreFilter reads its
+admission inputs through ``quota_view()`` (one lock section) and the
+commit generalizes to a SEMANTIC compare-and-reserve:
+``assume_pod_guarded`` re-evaluates the pod's two admission bounds
+(own-namespace max, fleet aggregate borrow gate — the ``QuotaReserve``
+payload) against the LIVE ledger in O(resources) under the cache lock,
+refusing (``QUOTA_CONFLICT``) exactly when concurrent quota'd traffic
+genuinely consumed the room the verdict assumed — releases only loosen
+the bounds, so teardown/confirm churn never refuses.  The lane re-derives
+on refusal, exactly like pool conflicts.  This is what lets ElasticQuota
+fleets dispatch on shard lanes instead of serializing wholesale through
+the global lane.
 """
 from __future__ import annotations
 
@@ -29,11 +58,57 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..api.core import Node, Pod
 from ..api.scheduling import POD_GROUP_LABEL
 from ..api.topology import LABEL_POOL
-from ..fwk.nodeinfo import NodeInfo, Snapshot
+from ..fwk.nodeinfo import NodeInfo, PooledSnapshot, Snapshot
 from ..util import klog
 from ..util.locking import GuardedLock, guarded_by
+from ..util.podutil import (is_pod_terminated, pod_effective_request,
+                            resources_over_bound)
 
 ASSUME_EXPIRATION_S = 30.0
+
+
+class _QuotaConflict:
+    """Sentinel returned by ``assume_pod_guarded`` when the QUOTA
+    compare-and-reserve failed (the pool compare still returns ``None``):
+    the two conflict classes retry identically but are diagnosed
+    separately (``tpusched_shard_quota_conflicts_total``, doc/ops.md)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return "QUOTA_CONFLICT"
+
+
+QUOTA_CONFLICT = _QuotaConflict()
+
+
+# the ONE bound comparator shared with the admission side (see
+# util.podutil.resources_over_bound: admission and commit must evaluate
+# the identical rule or the compare-and-reserve is unsound)
+_over = resources_over_bound
+
+
+class QuotaReserve:
+    """Commit-time quota admission payload (CapacityScheduling's PreFilter
+    → ``Cache.assume_pod_guarded``): the pod's namespace plus the two
+    request vectors its admission was judged with — ``in_eq`` (pod request
+    + nominated same-namespace reservations, the own-max operand) and
+    ``total`` (pod request + global nominated reservations, the aggregate
+    borrow-gate operand).  The commit RE-EVALUATES both bounds against the
+    LIVE ledger under the cache lock, so the reserve is semantic: it
+    refuses exactly when the admission verdict genuinely no longer holds,
+    never because unrelated quota traffic merely happened nearby.  (A
+    first cut compared a fleet-wide quota epoch instead; under a storm,
+    bind-confirm/teardown churn moved the epoch faster than cycles
+    completed and essentially every concurrent quota'd commit thrashed —
+    measured in the quota-storm bench before this design.)"""
+
+    __slots__ = ("namespace", "in_eq", "total")
+
+    def __init__(self, namespace: str, in_eq: Dict, total: Dict):
+        self.namespace = namespace
+        self.in_eq = in_eq
+        self.total = total
 
 
 def pool_of_node(node: Node) -> str:
@@ -48,7 +123,11 @@ class CacheView:
     """One cycle's atomically-captured view: the snapshot its filters read,
     the global cursor that snapshot was built at, and the per-pool cursors
     at the same instant (restricted to the cycle's partition when one was
-    given — the equivalence-cache validity witness for shard lanes)."""
+    given — the equivalence-cache validity witness for shard lanes).
+
+    ``pool_cursors`` is the SNAPSHOT's own cursor dict (shared, read-only;
+    no per-cycle copy), so ``cursor_tuple`` can serve the snapshot's
+    memoized sorted form."""
 
     __slots__ = ("snapshot", "cursor", "pool_cursors")
 
@@ -59,14 +138,21 @@ class CacheView:
         self.pool_cursors = pool_cursors
 
     def cursor_tuple(self) -> Tuple[Tuple[str, int], ...]:
-        """Canonical (sorted) form for equivalence-entry validity."""
+        """Canonical (sorted) form for equivalence-entry validity —
+        memoized on the snapshot when the view serves the snapshot's own
+        cursors (the common case)."""
+        snap = self.snapshot
+        if (isinstance(snap, PooledSnapshot)
+                and self.pool_cursors is snap.pool_cursors):
+            return snap.cursor_tuple()
         return tuple(sorted(self.pool_cursors.items()))
 
 
 @guarded_by("_lock", "_infos", "_pods", "_assumed", "_node_clones",
             "_pg_assigned", "_mutation", "_snap_mutation", "_last_snapshot",
             "_pool_mutation", "_pool_nodes", "_pool_members", "_part_snaps",
-            "_windex")
+            "_pool_snap", "_full_snap", "_windex", "_quota_bounds",
+            "_quota_used", "_quota_pods", "_quota_cursors", "_quota_epoch")
 class Cache:
     def __init__(self, clock=time.time):
         self._clock = clock
@@ -115,13 +201,27 @@ class Cache:
         # iteration domain (a shard rebuilds its view from ITS pools'
         # nodes only, never walking the fleet)
         self._pool_members: Dict[str, Dict[str, None]] = {}
+        # persistent per-pool snapshot sub-maps (the O(Δ) cycle core):
+        # pool → (built-at cursor, {node: NodeInfo clone}, [clones]).
+        # Rebuilt ONLY when the pool's own cursor moved; the dict and
+        # list objects are shared by reference with every composed
+        # snapshot, so a rebuild swaps in fresh ones and never mutates a
+        # published one.  The list is the pool's slice of the candidate
+        # chain (PoolChain) — kept here so an epoch re-lists only the
+        # mutated pool.
+        self._pool_snap: Dict[str, Tuple[int, Dict[str, NodeInfo],
+                                         List[NodeInfo]]] = {}
+        # the composed full-fleet snapshot, memoized on the global cursor
+        # (any structural mutation is pool-attributed, so cursor equality
+        # == every sub-map is fresh AND the pool set is unchanged)
+        self._full_snap: "Tuple[int, PooledSnapshot] | None" = None
         # partition-snapshot cache: partition (pool tuple) → (the pool-
-        # cursor tuple it was built at, Snapshot).  A shard's epoch view
-        # is rebuilt only when ITS pools mutated — cross-shard traffic
-        # leaves it untouched, which is what keeps N concurrent lanes from
-        # re-cloning the fleet on every foreign assume (the copy-on-write
-        # epoch design of ROADMAP item 1).
-        self._part_snaps: Dict[Tuple[str, ...], Tuple[Tuple, Snapshot]] = {}
+        # cursor tuple it was built at, composed PooledSnapshot).  A
+        # shard's epoch view is re-COMPOSED (O(partition pools)) only when
+        # its own pools mutated — and even then the sub-maps of untouched
+        # pools are reused by reference.
+        self._part_snaps: Dict[Tuple[str, ...],
+                               Tuple[Tuple, PooledSnapshot]] = {}
         # incremental torus window index (topology/windowindex.py, ISSUE
         # 13): every structural mutation below feeds the index its
         # occupancy delta IN THE SAME critical section as the cursor bump,
@@ -129,6 +229,33 @@ class Cache:
         # exact witness of identical occupancy.  None = no index attached
         # (TPUSCHED_NO_WINDOW_INDEX, or the index self-detached on error).
         self._windex = None
+        # -- quota ledger (ISSUE 14) -----------------------------------------
+        # namespace → (min, max) bounds of the namespace's ElasticQuota,
+        # registered by the scheduler's EQ informer wiring.  Only
+        # registered namespaces are tracked: non-quota traffic never pays
+        # a ledger update and never bumps the quota epoch.
+        self._quota_bounds: Dict[str, Tuple[Dict, Dict]] = {}
+        # namespace → used resources of its known scheduled pods (assumed
+        # + bound, non-terminated), and the pod keys counted (idempotency
+        # witness for the at-least-once informer delivery contract)
+        self._quota_used: Dict[str, Dict[str, float]] = {}
+        self._quota_pods: Dict[str, set] = {}
+        # per-namespace change cursors (diagnosis surface: WHICH quota is
+        # hot) and the fleet-wide epoch (the commit compare key: quota
+        # admission reads cross-namespace state — Σused vs Σmin — so ANY
+        # registered quota's change invalidates an in-flight verdict)
+        self._quota_cursors: Dict[str, int] = {}
+        self._quota_epoch = 0
+        # cached bounds signature (the equivalence cache's quota
+        # fingerprint input under guarded commits): recomputed only on
+        # bounds sync — a per-lookup recompute would put an O(quotas)
+        # sort on the equivalence hot path
+        self._quota_bounds_sig: Tuple = ()
+        # incrementally-maintained aggregates for the commit-time borrow
+        # gate: Σ used over registered namespaces (adjusted with every
+        # quota_adjust) and Σ min (recomputed on bounds sync — rare)
+        self._quota_used_sum: Dict[str, float] = {}
+        self._quota_min_sum: Dict[str, float] = {}
 
     def _bump_locked(self, pool: str) -> int:
         self._mutation += 1
@@ -155,6 +282,157 @@ class Cache:
             members.pop(name, None)
             if not members:
                 self._pool_members.pop(pool, None)
+
+    # -- quota ledger ---------------------------------------------------------
+
+    def _quota_adjust_locked(self, pod: Pod, delta: int) -> None:
+        """Reserve (+1) / release (-1) a pod's effective request against its
+        namespace's quota usage — in the SAME critical section as the pod
+        mutation, so the quota epoch is an exact change witness.  No-op
+        for unregistered namespaces and (on reserve) terminated pods;
+        idempotent via the per-namespace pod-key set."""
+        ns = pod.meta.namespace
+        if ns not in self._quota_bounds:
+            return
+        pods = self._quota_pods.setdefault(ns, set())
+        if delta > 0:
+            if pod.key in pods or is_pod_terminated(pod):
+                return
+            pods.add(pod.key)
+            sign = 1
+        else:
+            if pod.key not in pods:
+                return
+            pods.discard(pod.key)
+            sign = -1
+        used = self._quota_used.setdefault(ns, {})
+        total = self._quota_used_sum
+        for k, v in pod_effective_request(pod).items():
+            used[k] = used.get(k, 0) + sign * v
+            total[k] = total.get(k, 0) + sign * v
+        self._quota_cursors[ns] = self._quota_cursors.get(ns, 0) + 1
+        self._quota_epoch += 1
+
+    def _quota_seed_locked(self, ns: str) -> None:
+        """(Re)derive a newly registered namespace's usage from the pods
+        the cache already knows — O(known pods), once per EQ registration."""
+        used: Dict[str, float] = {}
+        keys: set = set()
+        for pod in self._pods.values():
+            if pod.meta.namespace != ns or is_pod_terminated(pod):
+                continue
+            keys.add(pod.key)
+            for k, v in pod_effective_request(pod).items():
+                used[k] = used.get(k, 0) + v
+        self._quota_used[ns] = used
+        self._quota_pods[ns] = keys
+        for k, v in used.items():
+            self._quota_used_sum[k] = self._quota_used_sum.get(k, 0) + v
+
+    def sync_quota_bounds(self, bounds: Dict[str, Tuple[Dict, Dict]]) -> None:
+        """Reconcile the registered quota set against the informer's
+        current view: ``{namespace: (min, max)}``.  Newly registered
+        namespaces seed their usage from the cache's known pods; removed
+        ones drop their ledger; a bounds CHANGE bumps the namespace cursor
+        and the epoch (admission verdicts depend on min/max, so in-flight
+        quota-guarded commits must conflict)."""
+        with self._lock:
+            changed = False
+            for ns in list(self._quota_bounds):
+                if ns not in bounds:
+                    self._quota_bounds.pop(ns, None)
+                    dropped = self._quota_used.pop(ns, None) or {}
+                    for k, v in dropped.items():
+                        self._quota_used_sum[k] = \
+                            self._quota_used_sum.get(k, 0) - v
+                    self._quota_pods.pop(ns, None)
+                    self._quota_cursors[ns] = \
+                        self._quota_cursors.get(ns, 0) + 1
+                    self._quota_epoch += 1
+                    changed = True
+            for ns, (mn, mx) in bounds.items():
+                old = self._quota_bounds.get(ns)
+                new = (dict(mn or {}), dict(mx or {}))
+                if old == new:
+                    continue
+                self._quota_bounds[ns] = new
+                if old is None:
+                    self._quota_seed_locked(ns)
+                self._quota_cursors[ns] = \
+                    self._quota_cursors.get(ns, 0) + 1
+                self._quota_epoch += 1
+                changed = True
+            if changed:
+                # Σ min + the bounds signature: recomputed on bounds
+                # change only (rare)
+                min_sum: Dict[str, float] = {}
+                for mn, _mx in self._quota_bounds.values():
+                    for k, v in mn.items():
+                        min_sum[k] = min_sum.get(k, 0) + v
+                self._quota_min_sum = min_sum
+                self._quota_bounds_sig = tuple(sorted(
+                    (ns, tuple(sorted(mn.items())),
+                     tuple(sorted(mx.items())))
+                    for ns, (mn, mx) in self._quota_bounds.items()))
+
+    def quota_view(self):
+        """Consistent admission inputs for CapacityScheduling's PreFilter:
+        ``({namespace: (min, max, used, pod_keys_loader)}, epoch)``
+        captured in ONE critical section — the epoch is an exact change
+        witness of the usage the verdict judged (diagnosis surface; the
+        COMMIT re-checks the admission bounds semantically via
+        ``QuotaReserve``, so the epoch is not the compare key).  The
+        pod-key sets are handed out as zero-arg LOADERS, not copies:
+        only preemption dry-runs consume membership, and copying every
+        namespace's key set per quota'd cycle was an O(scheduled pods)
+        term under the cache lock.  ``(None, epoch)`` when no quota is
+        registered (the fleet is quota-free)."""
+        with self._lock:
+            if not self._quota_bounds:
+                return None, self._quota_epoch
+            out = {}
+            for ns, (mn, mx) in self._quota_bounds.items():
+                out[ns] = (dict(mn), dict(mx),
+                           dict(self._quota_used.get(ns) or {}),
+                           self._quota_pods_loader(ns))
+            return out, self._quota_epoch
+
+    def _quota_pods_loader(self, ns: str):
+        def load() -> set:
+            with self._lock:
+                return set(self._quota_pods.get(ns) or ())
+        return load
+
+    def quota_epoch(self) -> int:
+        with self._lock:
+            return self._quota_epoch
+
+    def quota_bounds_signature(self) -> Tuple:
+        """Canonical signature of the registered quota BOUNDS (not usage):
+        the equivalence cache's quota fingerprint under guarded commits —
+        usage changes need no invalidation there because every commit
+        re-validates admission against the live ledger; bounds changes do
+        (they change which QuotaReserve a cycle should have built)."""
+        with self._lock:
+            return self._quota_bounds_sig
+
+    def quota_used_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-namespace used resources of the registered quotas — the
+        capacity collector's O(quotas) replacement for its per-scrape
+        O(pods) fleet walk."""
+        with self._lock:
+            return {ns: dict(self._quota_used.get(ns) or {})
+                    for ns in self._quota_bounds}
+
+    def quota_health(self) -> Dict[str, object]:
+        """health.shards quota block: registered namespace count, the
+        epoch, and the per-namespace cursors (which quota is hot when
+        ``tpusched_shard_quota_conflicts_total`` climbs — doc/ops.md)."""
+        with self._lock:
+            return {"namespaces": len(self._quota_bounds),
+                    "epoch": self._quota_epoch,
+                    "cursors": {ns: self._quota_cursors.get(ns, 0)
+                                for ns in self._quota_bounds}}
 
     # -- window index plumbing ------------------------------------------------
 
@@ -237,7 +515,9 @@ class Cache:
                 self._pool_member_locked(pool, node.name, +1)
             info = NodeInfo(node)
             self._infos[node.name] = info
-            # attach pods already known to live on this node
+            # attach pods already known to live on this node (their quota
+            # usage never left the ledger: pods stay in _pods across node
+            # churn, so re-attachment is quota-neutral)
             attached = []
             for p in self._pods.values():
                 if p.spec.node_name == node.name:
@@ -272,7 +552,8 @@ class Cache:
         - pods stay in ``_pods`` (upstream RemoveNode semantics: the API
           server still holds bound pods, and a node-object replacement —
           remove+add of the same name — must re-attach them); quorum
-          accounting is decremented with the NodeInfo;
+          accounting is decremented with the NodeInfo; quota usage is
+          untouched (the pods still exist and hold their requests);
         - assumed pods with a still-∞ deadline get their expiry TTL armed
           NOW: their bind targets hardware that no longer exists, and
           without this a bind whose confirmation can never arrive would
@@ -347,27 +628,43 @@ class Cache:
         old = self._pods.get(pod.key)
         if old is not None:
             self._detach_locked(old)
+            self._quota_adjust_locked(old, -1)
         pod.spec.node_name = node_name
         self._pods[pod.key] = pod
         self._attach_locked(pod)
+        self._quota_adjust_locked(pod, +1)
         self._assumed[pod.key] = float("inf")  # until finish_binding arms TTL
 
     def assume_pod_guarded(self, pod: Pod, node_name: str,
                            expected_pool_cursor: int,
-                           pools: Optional[Sequence[str]] = None):
+                           pools: Optional[Sequence[str]] = None,
+                           quota_guard: "QuotaReserve | None" = None):
         """Optimistic compare-and-assume (sharded dispatch commit point):
         assume ``pod`` onto ``node_name`` iff the chosen node's POOL cursor
         still equals ``expected_pool_cursor`` — the value the calling
-        cycle's snapshot_view captured when its filters read the state.
+        cycle's snapshot_view captured when its filters read the state —
+        AND, when ``quota_guard`` is given, the pod's quota admission
+        still holds against the LIVE ledger: used + guard.in_eq within the
+        namespace's max, and Σused + guard.total within Σmin (the same two
+        bounds CapacityScheduling's PreFilter judged, re-evaluated here in
+        O(resources) under the lock).  The reserve is the attach itself:
+        landing the assume adjusts the namespace's usage in the same
+        critical section, so compare-and-assume IS compare-and-reserve —
+        two lanes can never co-admit past a max or past the aggregate
+        borrow gate, which is the overshoot that used to force quota
+        fleets through the serialized global lane wholesale.
 
         Returns None (nothing assumed) when the pool saw a foreign
-        mutation since, or when the node itself vanished: the caller must
-        re-derive its placement on fresh state instead of committing a
-        decision computed against a superseded epoch.  Per-node filter
-        outcomes are monotone under foreign ASSUMES in other pools (they
-        only consume resources elsewhere), so the compare is deliberately
-        scoped to the one pool the placement touches — cross-pool traffic
-        never serializes here.
+        mutation since, or when the node itself vanished, and the
+        ``QUOTA_CONFLICT`` sentinel when only the quota re-check failed —
+        i.e. concurrent quota'd traffic genuinely consumed the room this
+        verdict assumed (semantic refusal, never "something merely
+        changed nearby": usage RELEASES can only loosen both bounds, so
+        teardown churn and bind-confirm replacements never refuse a
+        commit).  Per-node filter outcomes are monotone under foreign
+        ASSUMES in other pools (they only consume resources elsewhere),
+        so the pool compare stays scoped to the one pool the placement
+        touches — cross-pool traffic never serializes here.
 
         On success returns the post-assume cursor tuple of ``pools`` (the
         shard-scoped equivalence arming guard's input, read in the SAME
@@ -380,6 +677,15 @@ class Cache:
             pool = pool_of_node(info.node)
             if self._pool_mutation.get(pool, 0) != expected_pool_cursor:
                 return None
+            if quota_guard is not None:
+                bounds = self._quota_bounds.get(quota_guard.namespace)
+                if bounds is not None:
+                    used = self._quota_used.get(quota_guard.namespace) or {}
+                    if _over(used, quota_guard.in_eq, bounds[1]) \
+                            or _over(self._quota_used_sum,
+                                     quota_guard.total,
+                                     self._quota_min_sum):
+                        return QUOTA_CONFLICT
             self._assume_locked(pod, node_name)
             if pools is None:
                 return ()
@@ -400,6 +706,7 @@ class Cache:
                 old = self._pods.pop(pod.key, None)
                 if old is not None:
                     self._detach_locked(old)
+                    self._quota_adjust_locked(old, -1)
 
     def add_pod(self, pod: Pod) -> None:
         """Confirmed (bound) pod from the watch stream."""
@@ -408,8 +715,10 @@ class Cache:
             old = self._pods.get(pod.key)
             if old is not None:
                 self._detach_locked(old)
+                self._quota_adjust_locked(old, -1)
             self._pods[pod.key] = pod
             self._attach_locked(pod)
+            self._quota_adjust_locked(pod, +1)
 
     def update_pod(self, pod: Pod) -> None:
         self.add_pod(pod)
@@ -420,6 +729,7 @@ class Cache:
             old = self._pods.pop(pod.key, None)
             if old is not None:
                 self._detach_locked(old)
+                self._quota_adjust_locked(old, -1)
 
     def is_assumed(self, pod_key: str) -> bool:
         with self._lock:
@@ -439,11 +749,12 @@ class Cache:
                 old = self._pods.pop(key, None)
                 if old is not None:
                     self._detach_locked(old)
+                    self._quota_adjust_locked(old, -1)
             else:
                 nxt = min(nxt, deadline)
         self._next_expiry = nxt
 
-    # -- snapshot -------------------------------------------------------------
+    # -- snapshot (persistent / versioned — the O(Δ) cycle core) --------------
 
     def _clone_of_locked(self, name: str, info: NodeInfo) -> NodeInfo:
         ent = self._node_clones.get(name)
@@ -452,20 +763,69 @@ class Cache:
             self._node_clones[name] = ent
         return ent[1]
 
-    def _snapshot_locked(self) -> Snapshot:
-        """Incremental (upstream cache.UpdateSnapshot): a node's clone from
-        the previous snapshot is reused while its generation is unchanged.
-        Safe because snapshot NodeInfos are read-only by contract — every
-        mutation path (preemption dry-runs, nominated-pod evaluation) clones
-        first (sched/preemption.py:129-130, fwk/runtime.py:309-312)."""
+    def _pool_entry_locked(self, pool: str) -> Tuple[int, Dict[str, NodeInfo],
+                                                     List[NodeInfo]]:
+        """The pool's persistent (cursor, sub-map, value-list) entry,
+        rebuilt only when the pool's own cursor moved — and inside the
+        rebuild, only nodes whose generation changed re-clone (upstream's
+        UpdateSnapshot trick, lifted one level: per-pool instead of
+        per-fleet)."""
+        cursor = self._pool_mutation.get(pool, 0)
+        ent = self._pool_snap.get(pool)
+        if ent is not None and ent[0] == cursor:
+            return ent
+        infos = {name: self._clone_of_locked(name, self._infos[name])
+                 for name in self._pool_members.get(pool, ())}
+        ent = (cursor, infos, list(infos.values()))
+        self._pool_snap[pool] = ent
+        return ent
+
+    def _compose_locked(self, pools: Sequence[str]) -> PooledSnapshot:
+        """Compose a PooledSnapshot over ``pools`` from the persistent
+        sub-maps.  O(len(pools)) plus the rebuild cost of pools that
+        actually mutated.  The gang-quorum index rides in LIVE (by
+        reference, not a frozen copy): gang assignments can land in pools
+        outside a partition (escalated siblings, pool-pinned members)
+        without bumping the partition's cursors, and a frozen copy would
+        serve Coscheduling's permit barrier stale quorum counts for as
+        long as the composed view is reused.  Reads are single-key dict
+        gets (GIL-atomic against the locked writers), and live-is-fresher
+        is exactly what admission wants — the quorum clock is shard-
+        agnostic process state by design."""
+        pool_maps: Dict[str, Dict[str, NodeInfo]] = {}
+        pool_lists: Dict[str, List[NodeInfo]] = {}
+        cursors: Dict[str, int] = {}
+        for p in pools:
+            cursor, infos, values = self._pool_entry_locked(p)
+            pool_maps[p] = infos
+            pool_lists[p] = values
+            cursors[p] = cursor
+        # prune sub-maps of pools that no longer exist (bounded memory
+        # under pool churn; cheap: dict-size compare first)
+        if len(self._pool_snap) > len(self._pool_nodes) + 8:
+            for stale in [p for p in self._pool_snap
+                          if p not in self._pool_nodes]:
+                del self._pool_snap[stale]
+        return PooledSnapshot(pool_maps, cursors, self._pg_assigned,
+                              pool_lists=pool_lists)
+
+    def _full_snapshot_locked(self) -> PooledSnapshot:
+        """The composed full-fleet snapshot, memoized on the global cursor.
+        Does NOT touch ``_snap_mutation``/``_last_snapshot`` — foreign
+        threads (the /metrics capacity collector via shared_snapshot) can
+        refresh it without laundering a concurrent mutation past the
+        equivalence cache's arming guard (which compares cursors the CYCLE
+        captured, never this memo's freshness)."""
         self._cleanup_expired_locked()
-        if (self._mutation == self._snap_mutation
-                and self._last_snapshot is not None):
-            return self._last_snapshot
-        infos = {name: self._clone_of_locked(name, info)
-                 for name, info in self._infos.items()}
-        snap = Snapshot.from_infos(infos, dict(self._pg_assigned))
-        snap.pool_cursors = dict(self._pool_mutation)
+        if self._full_snap is not None \
+                and self._full_snap[0] == self._mutation:
+            return self._full_snap[1]
+        snap = self._compose_locked(sorted(self._pool_nodes))
+        self._full_snap = (self._mutation, snap)
+        return snap
+
+    def _snapshot_locked(self) -> Snapshot:
+        snap = self._full_snapshot_locked()
         self._snap_mutation = self._mutation
         self._last_snapshot = snap
         return snap
@@ -473,6 +833,18 @@ class Cache:
     def snapshot(self) -> Snapshot:
         with self._lock:
             return self._snapshot_locked()
+
+    def shared_snapshot(self) -> Snapshot:
+        """The persistent full-fleet snapshot for FOREIGN threads (the
+        /metrics capacity collector, housekeeping readers): always fresh,
+        O(Δ) to serve, and — unlike snapshot() — it never advances the
+        loop's ``_snap_mutation``/``_last_snapshot`` bookkeeping, so it
+        cannot launder a concurrent foreign mutation past the equivalence
+        cache's "cursor advanced by exactly my own assume" arming guard.
+        This is what let the sharded core drop its housekeeping-tick full
+        snapshot() refresh (ISSUE 14 satellite)."""
+        with self._lock:
+            return self._full_snapshot_locked()
 
     def snapshot_view(self,
                       pools: Optional[Sequence[str]] = None) -> CacheView:
@@ -486,56 +858,38 @@ class Cache:
         are structurally restricted to the shard's world, which is where
         the per-cycle cost reduction sharding exists for actually lands.
         Gang quorum accounting stays fleet-global (the pg-assigned index
-        rides in whole).  The partition snapshot is cached against its
-        pool-cursor tuple and REBUILT ONLY when the partition's own pools
-        mutated; per-node clones are shared with the full snapshot, so a
-        rebuild clones only nodes that changed since any view saw them.
+        rides in live).  The partition snapshot is cached against its
+        pool-cursor tuple and RE-COMPOSED ONLY when the partition's own
+        pools mutated; sub-maps and per-node clones are shared with the
+        full snapshot, so a recompose re-clones only nodes that changed
+        since any view saw them.
 
         ``pools=None`` is the global lane's view: the full fleet snapshot
-        plus every pool cursor."""
+        plus every live pool's cursor (the snapshot's own cursor dict —
+        no per-cycle copy)."""
         with self._lock:
             if pools is None:
                 snap = self._snapshot_locked()
                 return CacheView(snap, self._snap_mutation,
-                                 dict(self._pool_mutation))
+                                 snap.pool_cursors)
             self._cleanup_expired_locked()
-            cursors = {p: self._pool_mutation.get(p, 0) for p in pools}
             key = tuple(pools)
-            sig = tuple(sorted(cursors.items()))
+            sig = tuple(self._pool_mutation.get(p, 0) for p in pools)
             ent = self._part_snaps.get(key)
             if ent is not None and ent[0] == sig:
-                return CacheView(ent[1], self._mutation, cursors)
-            infos: Dict[str, NodeInfo] = {}
-            for p in pools:
-                for name in self._pool_members.get(p, ()):
-                    infos[name] = self._clone_of_locked(
-                        name, self._infos[name])
-            # the gang-quorum index rides in LIVE (by reference, not a
-            # frozen copy): gang assignments land in pools OUTSIDE this
-            # partition (escalated siblings, pool-pinned members) without
-            # bumping the partition's cursors, and a frozen copy would
-            # serve Coscheduling's permit barrier stale quorum counts for
-            # as long as the cached view is reused.  Reads are single-key
-            # dict gets (GIL-atomic against the locked writers), and
-            # live-is-fresher is exactly what admission wants — the
-            # quorum clock is shard-agnostic process state by design.
-            snap = Snapshot.from_infos(infos, self._pg_assigned)
-            snap.pool_cursors = dict(cursors)
-            if len(self._part_snaps) > 64:   # partition churn backstop
-                self._part_snaps.clear()
-            self._part_snaps[key] = (sig, snap)
-            return CacheView(snap, self._mutation, cursors)
+                snap = ent[1]
+            else:
+                snap = self._compose_locked(pools)
+                if len(self._part_snaps) > 64:   # partition churn backstop
+                    self._part_snaps.clear()
+                self._part_snaps[key] = (sig, snap)
+            return CacheView(snap, self._mutation, snap.pool_cursors)
 
     def peek_snapshot(self) -> "Snapshot | None":
         """Read-only view of the LAST snapshot the scheduling loop built —
-        never rebuilds.  Foreign threads (the /metrics capacity collector)
-        must use this instead of snapshot(): a rebuild from outside the
-        loop advances ``_snap_mutation`` mid-cycle, which would launder a
-        concurrent foreign mutation past the equivalence cache's
-        "cursor advanced by exactly my own assume" arming guard
-        (scheduler._equiv_offer / _equiv_after_assume) and arm an entry
-        whose feasible set was computed against older state.  Telemetry
-        readers tolerate the staleness (at most one scheduling cycle)."""
+        never rebuilds.  Prefer ``shared_snapshot()`` for foreign-thread
+        readers that need freshness: it serves the persistent composed
+        snapshot without touching the loop's snapshot bookkeeping."""
         with self._lock:
             return self._last_snapshot
 
@@ -576,7 +930,7 @@ class Cache:
         """Canonical cursor tuple for a partition — the shard-scoped
         equivalence-cache arming guard reads this right after its own
         guarded assume to verify the chain "my partition advanced by
-        EXACTLY my own attach"."""
+        EXACTLY its own attach"."""
         with self._lock:
             return tuple(sorted(
                 (p, self._pool_mutation.get(p, 0)) for p in pools))

@@ -38,10 +38,20 @@ are re-checked by the still-running *dynamic* filters (resource/chip fit);
 re-run would read byte-identical inputs, so they are skipped. Score always
 runs fresh on the live snapshot. Plugins whose PreFilter output is not
 provably reusable veto entry creation via their fingerprint (e.g.
-TopologyMatch vetoes multi-window placements, CapacityScheduling vetoes
-when quotas exist). The full path stays the oracle: nominated pods bypass
-the cache entirely, and the scheduler's differential mode re-runs the full
-path on every hit and asserts the identical placement.
+TopologyMatch vetoes multi-window placements). Quota admission is the
+interesting case (ISSUE 14): a memoized verdict goes stale with every
+sibling assume (usage moves; not-monotone), so under UNGUARDED commits
+(single dispatch loop, the legacy serialize arm) CapacityScheduling still
+vetoes — but under GUARDED commits (sharded dispatch) it fingerprints
+only the quota BOUNDS and lets entries stay warm: the memoized
+``QuotaReserve`` rides the entry into the sibling's commit, where
+``Cache.assume_pod_guarded`` re-evaluates the admission bounds against
+the live ledger and refuses exactly the stale case (the hit then falls
+back to the full path). The safety argument for quota'd hits is that
+commit-time semantic re-check, not snapshot freshness. The full path
+stays the oracle: nominated pods bypass the cache entirely, and the
+scheduler's differential mode re-runs the full path on every hit and
+asserts the identical placement.
 
 Single-threaded by design: only the scheduleOne loop touches it —
 declared via @util.locking.thread_confined, asserted in debug mode

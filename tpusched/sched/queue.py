@@ -146,15 +146,16 @@ class _Heap:
 
 
 @guarded_by("_lock", "_active", "_backoff", "_backoff_keys",
-            "_unschedulable", "_pending_moves", "_last_gang", "_closed",
-            "_in_cycle")
+            "_unschedulable", "_pending_moves", "_cycle_moves", "_last_gang",
+            "_closed", "_in_cycle")
 class SchedulingQueue:
     def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
                  cluster_event_map: Optional[Dict[str, List[ClusterEvent]]] = None,
                  clock=time.time,
                  initial_backoff_s: Optional[float] = None,
                  max_backoff_s: Optional[float] = None,
-                 arrival_cb: Optional[Callable[[], None]] = None):
+                 arrival_cb: Optional[Callable[[], None]] = None,
+                 unschedulable_flush_s: Optional[float] = None):
         self._clock = clock
         # throughput telemetry hook (obs/throughput.ThroughputTelemetry
         # .on_arrival): fired once per NEW pending pod entering the queue —
@@ -166,6 +167,15 @@ class SchedulingQueue:
                                    is None else initial_backoff_s)
         self._max_backoff_s = (MAX_BACKOFF_S if max_backoff_s is None
                                else max_backoff_s)
+        # periodic unschedulableQ flush: a pure wall-clock SAFETY NET now
+        # that the move drains are event-logical (see _cycle_moves below) —
+        # a pod no event would ever unstick still gets a retry.  None =
+        # default 30 s; explicit 0 disables it (deterministic replay: a
+        # lockstep run packs recorded seconds into milliseconds, so a wall
+        # flush lands on a run-dependent event boundary and forks the
+        # placement sequence).
+        self._flush_s = (UNSCHEDULABLE_Q_FLUSH_S if unschedulable_flush_s
+                         is None else unschedulable_flush_s)
         # the Condition's underlying lock is the named guard — debug
         # mode instruments it, off mode is a plain RLock inside; the
         # GuardedCondition flavor lets the interleaving explorer
@@ -187,6 +197,16 @@ class SchedulingQueue:
         # parked pods if applied per event; buffering them here and draining
         # once per pop cycle (or observer read) makes the storm one scan.
         self._pending_moves: Dict[str, int] = {}
+        # EVENT-LOGICAL at-least-once for in-flight cycles (ISSUE 14
+        # satellite): every buffered move is ALSO OR'd here, and the mask
+        # is cleared at each pop — so when the popped pod's failing cycle
+        # parks, add_unschedulable_if_not_present can check, synchronously,
+        # whether any event since its pop would have unstuck it.  Before
+        # this, an event drained while the cycle was mid-flight was lost to
+        # the parking pod until a wall-clock tick (the 0.2 s pop poll or
+        # the 30 s periodic flush) — timing that made sharded lockstep
+        # replay pin the pre-index sweep path (sim/replay.py).
+        self._cycle_moves: Dict[str, int] = {}
         # gang of the most recently popped pod: pop() prefers its remaining
         # same-priority siblings so the equivalence cache actually hits
         self._last_gang: Optional[tuple] = None
@@ -278,6 +298,27 @@ class SchedulingQueue:
             if key in self._active or key in self._unschedulable:
                 return
             info.timestamp = self._clock()
+            # park-time move check (event-logical at-least-once): an event
+            # that arrived since this pod was popped — still buffered, or
+            # already drained to the pods parked at the time — must not
+            # strand THIS pod until a wall-clock tick.  Apply it now,
+            # synchronously, through the same backoff-expiry routing the
+            # drain itself uses.
+            moves = dict(self._cycle_moves)
+            for r, m in self._pending_moves.items():
+                moves[r] = moves.get(r, 0) | m
+            if moves and any(self._event_unsticks(info, r, m)
+                             for r, m in moves.items()):
+                expiry = info.timestamp + info.backoff_duration(
+                    self._initial_backoff_s, self._max_backoff_s)
+                if expiry <= info.timestamp:
+                    self._active.push(info)
+                else:
+                    heapq.heappush(self._backoff,
+                                   (expiry, next(self._backoff_seq), info))
+                    self._bk_add_locked(key)
+                self._lock.notify_all()
+                return
             self._unschedulable[key] = info
 
     def push_active(self, info: QueuedPodInfo) -> None:
@@ -378,6 +419,14 @@ class SchedulingQueue:
         with self._lock:
             self._pending_moves[resource] = \
                 self._pending_moves.get(resource, 0) | action
+            # the cycle-scoped mask makes the at-least-once contract
+            # SYNCHRONOUS for the in-flight pod: whenever its failing
+            # cycle parks, the park-time check replays every event
+            # recorded here since its pop (add_unschedulable_if_not_
+            # present) — no wall-clock drain tick involved
+            if self._in_cycle > 0:
+                self._cycle_moves[resource] = \
+                    self._cycle_moves.get(resource, 0) | action
             if self._unschedulable or self._backoff_keys:
                 self._lock.notify_all()
 
@@ -423,8 +472,10 @@ class SchedulingQueue:
             if info is not None:
                 self._bk_del_locked(info.pod.key)
                 self._active.push(info)
+        if self._flush_s <= 0:
+            return          # event-driven retries only (replay determinism)
         for key, info in list(self._unschedulable.items()):
-            if now - info.timestamp > UNSCHEDULABLE_Q_FLUSH_S:
+            if now - info.timestamp > self._flush_s:
                 del self._unschedulable[key]
                 self._active.push(info)
 
@@ -465,6 +516,10 @@ class SchedulingQueue:
                 if info is not None:
                     info.attempts += 1
                     self._in_cycle += 1
+                    # a fresh cycle starts: the park-time move check
+                    # covers events from HERE on (one consumer per lane
+                    # by design, so the mask is this cycle's)
+                    self._cycle_moves = {}
                     return info
                 wait = 0.2
                 if self._backoff:

@@ -22,7 +22,7 @@ from ..apiserver import Clientset, InformerFactory
 from ..apiserver import server as srv
 from ..fwk import (CycleState, Framework, Handle, PluginProfile, Registry,
                    Status, GANG_ROLLBACK_STATE_KEY, PODS_TO_ACTIVATE_KEY,
-                   PodsToActivate)
+                   QUOTA_GUARD_STATE_KEY, PodsToActivate)
 from ..fwk.interfaces import (EVENT_ADD, EVENT_DELETE, EVENT_UPDATE,
                               RESOURCE_ELASTIC_QUOTA, RESOURCE_NODE,
                               RESOURCE_POD, RESOURCE_POD_GROUP,
@@ -39,16 +39,22 @@ from ..util.metrics import (bind_total, e2e_scheduling_seconds,
                             equiv_cache_vetoes, extension_point_seconds,
                             gang_bind_rollbacks, gang_stuck_total,
                             queue_wait_seconds, schedule_attempts,
-                            shard_conflicts_total, shard_escalations_total)
+                            shard_conflicts_total, shard_escalations_total,
+                            shard_quota_conflicts_total)
 from ..util.podutil import assigned
-from .cache import Cache, CacheView, pool_of_node
+from .cache import Cache, CacheView, QUOTA_CONFLICT, pool_of_node
 from .equivcache import EquivalenceCache, EquivEntry
 from .queue import QueuedPodInfo, SchedulingQueue, ShardedQueues
 from .shards import (GLOBAL_LANE, ShardRouter, ShardStats, shard_lane,
                      unit_key_of)
 
 # CycleState keys the equivalence cache must NOT memoize: per-cycle
-# scheduler plumbing, re-created fresh by every cycle.
+# scheduler plumbing, re-created fresh by every cycle.  The quota commit
+# guard (QUOTA_GUARD_STATE_KEY) is deliberately MEMOIZED: its request
+# vectors are a pure function of the equivalence class (identical pod
+# requests, empty nominator — both preconditions of cache use), and a
+# sibling's hit-path commit must carry them into the guarded assume or
+# it would reserve quota unguarded.
 _EQUIV_EXCLUDE_KEYS = frozenset((PODS_TO_ACTIVATE_KEY, "tpusched/diagnosis"))
 
 _KIND_TO_RESOURCE = {
@@ -568,21 +574,38 @@ class Scheduler:
         self._shards_n = profile.effective_dispatch_shards()
         self._sharded = self._shards_n > 1
         pg_informer = self.informer_factory.informer(srv.POD_GROUPS)
-        self._router = ShardRouter(self._shards_n,
-                                   pg_lookup=pg_informer.get)
-        # quota mode: any ElasticQuota serializes dispatch through the
-        # global lane (cross-pool admission state; see shards.py) — seeded
-        # from the informer's current view, maintained by the quota
-        # handlers wired below
-        self._router.set_quota_mode(bool(
-            self.informer_factory.informer(srv.ELASTIC_QUOTAS).items()))
+        from .shards import ESCALATION_TTL_S
+        self._router = ShardRouter(
+            self._shards_n, pg_lookup=pg_informer.get,
+            escalation_ttl_s=(profile.escalation_ttl_s
+                              if profile.escalation_ttl_s is not None
+                              else ESCALATION_TTL_S),
+            quota_serialize=profile.quota_serialize_dispatch)
+        # quota-aware sharded commits (ISSUE 14): the cache's quota ledger
+        # mirrors the ElasticQuota bounds (seeded here, maintained by the
+        # EQ handlers wired below) and maintains per-quota usage in its own
+        # critical sections; CapacityScheduling reads admission inputs
+        # through handle.quota_view and the commit compares the quota
+        # epoch.  The router's quota flag remains for the legacy
+        # quota_serialize_dispatch arm and the health report.
+        self.handle.quota_view = self.cache.quota_view
+        self.handle.quota_bounds_signature = \
+            self.cache.quota_bounds_signature
+        # sharded mode: every commit is a guarded assume, so the
+        # equivalence cache may stay warm under quotas (the commit's
+        # semantic re-check catches stale memoized admissions).  The
+        # legacy serialize arm skips the guard — veto stays there.
+        self.handle.quota_guarded_commits = \
+            self._sharded and not profile.quota_serialize_dispatch
+        self._sync_quota_ledger()
 
         def make_lane_queue() -> SchedulingQueue:
             return SchedulingQueue(
                 self._fw.less, cluster_event_map, clock,
                 initial_backoff_s=profile.pod_initial_backoff_s,
                 max_backoff_s=profile.pod_max_backoff_s,
-                arrival_cb=self._throughput.on_arrival)
+                arrival_cb=self._throughput.on_arrival,
+                unschedulable_flush_s=profile.unschedulable_flush_s)
 
         if self._sharded:
             self._lanes = [shard_lane(i) for i in range(self._shards_n)] \
@@ -807,13 +830,20 @@ class Scheduler:
             idx.forget_topology(topo.spec.pool)
         self._on_cr_event(RESOURCE_TPU_TOPOLOGY, EVENT_DELETE)
 
+    def _sync_quota_ledger(self) -> None:
+        """Reconcile the cache quota ledger (and the router's quota flag)
+        from the EQ informer's current view — full resync so add/add/
+        delete sequences converge regardless of delivery order."""
+        quotas = list(
+            self.informer_factory.informer(srv.ELASTIC_QUOTAS).items())
+        self.cache.sync_quota_bounds(
+            {eq.meta.namespace: (eq.spec.min, eq.spec.max)
+             for eq in quotas})
+        self._router.set_quota_mode(bool(quotas))
+
     def _on_cr_event(self, resource: str, action: int) -> None:
         if resource == RESOURCE_ELASTIC_QUOTA:
-            # quota presence flips the shard router's serialization mode
-            # (cross-pool admission state — see sched/shards.py); recount
-            # from the informer view so add/add/delete sequences converge
-            self._router.set_quota_mode(bool(
-                self.informer_factory.informer(srv.ELASTIC_QUOTAS).items()))
+            self._sync_quota_ledger()
         self.queue.move_all_to_active_or_backoff(resource, action)
 
     def _on_pod_add(self, pod: Pod) -> None:
@@ -1031,24 +1061,61 @@ class Scheduler:
                 else:
                     self.queue.cycle_done()
 
+    def drive_dispatch_once(self) -> bool:
+        """Single-step the sharded dispatch core on the CALLING thread:
+        pop at most one pod per lane, in canonical lane order, and run its
+        full scheduling cycle inline.  The deterministic-replay driver
+        (sim/replay.py) uses this instead of run() — lockstep pacing makes
+        EVENT order logical, and this makes CYCLE order logical too, so a
+        sharded replay exercises the exact routing/partition/commit
+        semantics of production lanes without the thread-interleaving
+        nondeterminism physical concurrency brings (two lanes binding into
+        different pools in either order score each other's occupancy
+        differently).  Returns True iff any lane had work."""
+        drove = False
+        for lane in (self._lanes or [self._ctx_default.lane]):
+            ctx = self._contexts[lane]
+            info = self.queue.pop(timeout=0, lane=lane) if self._sharded \
+                else self.queue.pop(timeout=0)
+            if info is None:
+                continue
+            drove = True
+            try:
+                self.schedule_one(info, ctx)
+            except Exception as e:
+                klog.error_s(e, "scheduleOne panicked", pod=info.pod.key)
+                try:
+                    self._handle_failure(info, Status.error(str(e)))
+                except Exception as e2:
+                    klog.error_s(e2, "failure path panicked; requeueing",
+                                 pod=info.pod.key)
+                    self.queue.requeue_after_failure(info, to_backoff=True)
+            finally:
+                if self._sharded:
+                    self.queue.cycle_done(lane)
+                else:
+                    self.queue.cycle_done()
+        return drove
+
     def _publish_shard_health(self) -> None:
         """health.shards for /debug/flightrecorder: per-lane cycle/bind/
         conflict/escalation counters, queue depths and partition sizes —
         the hot/starved-shard diagnosis surface (doc/ops.md)."""
         try:
-            # keep the FULL snapshot fresh too: shard-lane cycles build
-            # partition views only, so without this tick peek_snapshot()
-            # readers (the /metrics capacity collector) would freeze
-            # whenever the watchdog is disabled and no global-lane cycle
-            # runs
-            self.cache.snapshot()
+            # (the pre-14 full-snapshot refresh tick is gone: the capacity
+            # collector reads the cache's PERSISTENT composed snapshot via
+            # shared_snapshot(), which is always fresh at O(Δ) cost — no
+            # housekeeping rebuild needed, and no foreign advance of the
+            # loop's snapshot bookkeeping)
             pools = self.cache.pools()
             partitions = {lane: len(self._router.partition(pools, lane))
                           for lane in self._lanes}
             state = self._shard_stats.snapshot(
                 queue_depths=self.queue.pending_counts_by_lane(),
                 partitions=partitions)
-            state["quota_mode"] = self._router.quota_mode()
+            state["quota_fleet"] = self._router.quota_mode()
+            state["quota_serialized"] = self._router.quota_serialized()
+            state["quota"] = self.cache.quota_health()
             state["escalations_total"] = self._router.escalations()
             self.recorder.set_health("shards", state)
         except Exception as e:  # noqa: BLE001 — health publishing is
@@ -1265,30 +1332,58 @@ class Scheduler:
             if self._sharded:
                 # optimistic commit: the assume lands only if the chosen
                 # pool's cursor is still the one this cycle's filters read
-                # (Cache.assume_pod_guarded).  A refusal means a foreign
-                # mutation — an informer event, another lane's bind into
-                # this pool — raced the cycle: re-derive on fresh state
-                # instead of binding a stale placement.
+                # (Cache.assume_pod_guarded) AND — for quota'd pods — the
+                # quota epoch is still the one CapacityScheduling's
+                # admission read (the compare-and-reserve of ISSUE 14).
+                # A refusal means a foreign mutation — an informer event,
+                # another lane's bind into this pool, a concurrent quota'd
+                # commit anywhere — raced the cycle: re-derive on fresh
+                # state instead of binding a stale placement.
                 ni = snapshot.get(node_name)
                 pool = pool_of_node(ni.node) if ni is not None else ""
                 expected = view.pool_cursors.get(pool, 0)
+                # legacy quota-serialized arm: the global lane owns ALL
+                # quota traffic, so verdict→reserve is already atomic by
+                # serialization — the pre-14 semantics the arm reproduces
+                quota_guard = None if self._router.quota_serialized() \
+                    else state.try_read(QUOTA_GUARD_STATE_KEY)
                 committed = self.cache.assume_pod_guarded(
                     assumed, node_name, expected,
-                    pools=ctx.partition_pools if ctx.pools_scoped else None)
-                if committed is None:
+                    pools=ctx.partition_pools if ctx.pools_scoped else None,
+                    quota_guard=quota_guard)
+                if committed is None or committed is QUOTA_CONFLICT:
+                    quota_raced = committed is QUOTA_CONFLICT
                     conflicts += 1
                     ctx.equiv_pending = None
                     if self._telemetry:
                         shard_conflicts_total.with_labels(ctx.lane).inc()
+                        if quota_raced:
+                            shard_quota_conflicts_total.with_labels(
+                                ctx.lane).inc()
                     if self._shard_stats is not None:
-                        self._shard_stats.on_conflict(ctx.lane)
+                        self._shard_stats.on_conflict(ctx.lane,
+                                                      quota=quota_raced)
                     if tr is not None:
                         tr.annotate("shard_conflicts", conflicts)
                     if conflicts < _MAX_CONFLICT_RETRIES:
                         continue
+                    if quota_raced and ctx.pools_scoped:
+                        # quota-conflict starvation is fleet-wide pressure
+                        # (every concurrent quota'd commit moves the
+                        # epoch), not pool contention: the serialized
+                        # global lane is the contention-free path, so
+                        # escalate the unit instead of parking it in
+                        # backoff to lose the same race again
+                        status = Status.unschedulable(
+                            f"quota epoch raced {conflicts} commit "
+                            f"attempts")
+                        if self._maybe_escalate(info, pod, status, tr, ctx,
+                                                pods_to_activate):
+                            return
                     status = Status.unschedulable(
-                        f"dispatch conflict: pool {pool!r} raced "
-                        f"{conflicts} commit attempts")
+                        f"dispatch conflict: "
+                        f"{'quota epoch' if quota_raced else 'pool ' + repr(pool)}"
+                        f" raced {conflicts} commit attempts")
                     if tr is not None:
                         tr.finish("conflict-starved", status=status,
                                   node=node_name)
@@ -1403,8 +1498,13 @@ class Scheduler:
         against a partition-restricted snapshot (Cache.snapshot_view), so
         its node list IS the partition — the restriction is structural,
         and every fleet-sweeping plugin (TopologyMatch's window search,
-        Coscheduling's capacity dry-run) inherits it for free."""
-        return snapshot.list()
+        Coscheduling's capacity dry-run) inherits it for free.  Pooled
+        snapshots serve a lazy pool-ordered chain (ISSUE 14: len/iter/
+        index over the persistent per-pool lists, O(pools) per epoch —
+        the old per-cycle flat materialization was the last O(hosts)
+        term); plain test snapshots fall back to list()."""
+        seq = getattr(snapshot, "candidate_seq", None)
+        return seq() if seq is not None else snapshot.list()
 
     def _schedule_pod(self, state: CycleState, pod: Pod, snapshot,
                       ctx: _LaneContext, view: Optional[CacheView] = None):

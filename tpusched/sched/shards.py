@@ -29,11 +29,18 @@ Model
   GLOBAL lane, which sweeps the whole fleet exactly like the pre-sharding
   loop: multislice sets (their member gangs must coordinate placement
   across pools), pods pinned by an explicit pool selector are routed to
-  that pool's shard instead, nominated preemptors (their nomination may
-  point anywhere), and — wholesale — any fleet with ElasticQuotas (quota
-  admission reads cross-pool usage; concurrent lanes could overshoot a
-  max between snapshot and assume, so quota fleets serialize until a
-  quota-aware commit protocol exists).
+  that pool's shard instead, and nominated preemptors (their nomination
+  may point anywhere).  ElasticQuota fleets no longer serialize
+  wholesale (ISSUE 14): quota admission commits through the cache's
+  quota-epoch compare-and-reserve (``Cache.assume_pod_guarded`` with a
+  ``quota_guard``), so quota'd pods dispatch on their shard lanes and a
+  raced quota verdict re-derives exactly like a pool conflict.  Only
+  cross-quota BORROWERS (admission that spends another quota's spare
+  min) escalate to the global lane — CapacityScheduling's PreFilter
+  rejects them on partition-scoped cycles and the standard escalation
+  hop carries the unit over.  The pre-14 wholesale serialization
+  survives only as the opt-in ``quota_serialize_dispatch`` profile knob
+  (the bench baseline arm and an operational escape hatch).
 - A shard-restricted cycle that comes up unschedulable ESCALATES its
   unit to the global lane (bounded TTL, so capacity returning to the
   unit's home shard eventually pulls it back): the shard attempt costs
@@ -103,11 +110,13 @@ class ShardRouter:
     def __init__(self, shards: int,
                  pg_lookup: Optional[Callable[[str], object]] = None,
                  clock=time.monotonic,
-                 escalation_ttl_s: float = ESCALATION_TTL_S):
+                 escalation_ttl_s: float = ESCALATION_TTL_S,
+                 quota_serialize: bool = False):
         self.shards = shards
         self._pg_lookup = pg_lookup or (lambda key: None)
         self._clock = clock
         self._ttl = escalation_ttl_s
+        self._quota_serialize = quota_serialize
         self._lock = threading.Lock()
         # unit key → escalation deadline (monotonic); pruned lazily
         self._escalated: "collections.OrderedDict[str, float]" = \
@@ -119,8 +128,9 @@ class ShardRouter:
         self._escalated_ever: set = set()
         self._escalated_overflow = False
         self._escalations = 0
-        # quota mode: any ElasticQuota in the fleet serializes dispatch
-        # through the global lane (see module docstring)
+        # fleet-has-quotas flag: routing consults it ONLY under the legacy
+        # quota_serialize mode; otherwise it is health-report context
+        # (quota'd fleets dispatch sharded via the epoch-guarded commit)
         self._quota_mode = False
 
     # -- fleet-condition inputs ----------------------------------------------
@@ -130,6 +140,11 @@ class ShardRouter:
 
     def quota_mode(self) -> bool:
         return self._quota_mode
+
+    def quota_serialized(self) -> bool:
+        """True iff quota presence currently serializes routing (the
+        legacy ``quota_serialize_dispatch`` arm is on AND quotas exist)."""
+        return self._quota_serialize and self._quota_mode
 
     # -- escalation -----------------------------------------------------------
 
@@ -186,7 +201,7 @@ class ShardRouter:
     # -- the routing decision -------------------------------------------------
 
     def lane_for(self, pod: Pod) -> str:
-        if self.shards <= 1 or self._quota_mode:
+        if self.shards <= 1 or (self._quota_serialize and self._quota_mode):
             return GLOBAL_LANE
         gang = pod_group_full_name(pod)
         unit = gang or pod.key
@@ -245,7 +260,8 @@ class ShardStats:
         self._clock = clock
         self._lanes: Dict[str, Dict[str, float]] = {
             lane: {"cycles": 0, "binds": 0, "conflicts": 0,
-                   "escalations": 0, "last_cycle_mono": 0.0}
+                   "quota_conflicts": 0, "escalations": 0,
+                   "last_cycle_mono": 0.0}
             for lane in lanes}
 
     def on_cycle(self, lane: str) -> None:
@@ -261,11 +277,13 @@ class ShardStats:
             if row is not None:
                 row["binds"] += 1
 
-    def on_conflict(self, lane: str) -> None:
+    def on_conflict(self, lane: str, quota: bool = False) -> None:
         with self._lock:
             row = self._lanes.get(lane)
             if row is not None:
                 row["conflicts"] += 1
+                if quota:
+                    row["quota_conflicts"] += 1
 
     def on_escalation(self, lane: str) -> None:
         with self._lock:
@@ -285,6 +303,7 @@ class ShardStats:
                 ent = {"cycles": int(row["cycles"]),
                        "binds": int(row["binds"]),
                        "conflicts": int(row["conflicts"]),
+                       "quota_conflicts": int(row["quota_conflicts"]),
                        "escalations": int(row["escalations"]),
                        "idle_s": round(now - row["last_cycle_mono"], 3)
                        if row["last_cycle_mono"] else None}

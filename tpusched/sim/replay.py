@@ -328,8 +328,13 @@ def run_replay(trace_dir: str, *,
         plugin_args = dict(prof.plugin_args)
         cos = plugin_args.get("Coscheduling")
         if cos is not None:
+            # denied-window 0: purely event-driven gang retries.
+            # pg_status_flush 0: per-bind PG status patches — a coalesced
+            # flush landing a window later would move the store's resource
+            # version at a wall instant the lockstep barrier cannot order.
             plugin_args["Coscheduling"] = dataclasses.replace(
-                cos, denied_pg_expiration_time_seconds=0)
+                cos, denied_pg_expiration_time_seconds=0,
+                pg_status_flush_seconds=0.0)
         # the stuck-gang watchdog is a wall-clock retry gate too: its
         # force-reactivation of parked members fires at a wall instant
         # that lands on a run-dependent event boundary (a ~30 s replay
@@ -337,27 +342,25 @@ def run_replay(trace_dir: str, *,
         # outcomes race the event pacing — the faster the cycles (the
         # torus window index), the more visibly two runs diverge.  0
         # disables it; replay retries stay purely event-driven.
+        # unschedulable_flush 0: the last wall-clock retry gate.  The
+        # queue's move drains are now EVENT-LOGICAL (ISSUE 14 satellite:
+        # cycle-scoped move masks + the park-time check in
+        # sched/queue.add_unschedulable_if_not_present), so sharded
+        # lockstep replays no longer pin the pre-index sweep path — the
+        # window index stays ON and the shards=1-vs-N equivalence gate
+        # exercises exactly the production read surface.
+        # escalation_ttl pinned past any replay length: a unit escalated to
+        # the global lane stays there — a wall TTL lapsing mid-replay would
+        # re-route it at a run-dependent event boundary (and the escalated
+        # set the attribution gate reads already covers it either way).
         prof = dataclasses.replace(prof, parallelism=1,
                                    percentage_of_nodes_to_score=100,
                                    pod_initial_backoff_s=0.0,
                                    pod_max_backoff_s=0.0,
                                    stuck_gang_after_s=0.0,
+                                   unschedulable_flush_s=0.0,
+                                   escalation_ttl_s=1e9,
                                    plugin_args=plugin_args)
-        if prof.effective_dispatch_shards() > 1:
-            # SHARDED determinism replays pin the pre-index sweep path:
-            # with N concurrent lanes, the queue's lazily-coalesced
-            # cluster-event moves drain at wall-clock ticks (lane pop
-            # timeouts, observer reads), and at window-index cycle speeds
-            # (~50 µs sweeps) whether a parked gang's retry drains before
-            # or after the next event becomes a run-dependent coin flip —
-            # retry ordinals drift and contended placements diverge.  The
-            # index's functional equivalence is gated separately where
-            # pacing is airtight: the shards=1 lockstep index-on-vs-off
-            # gate (zero placement diffs) and the sampled in-cycle
-            # differential oracle.  Making the move drain event-logical
-            # (so sharded replays can keep the index) is a known
-            # follow-up.
-            prof = dataclasses.replace(prof, torus_window_index=False)
 
     api = APIServer()
     for kind, objs in trace.objects.items():
@@ -430,8 +433,35 @@ def run_replay(trace_dir: str, *,
     # the replay cannot place must not stall the stream forever).
     ever_bound = {p for p, _ in trace.recorded_binds()}
 
+    # SERIAL lane multiplexing (deterministic sharded replays): lockstep
+    # pacing makes event order logical; driving cycles from THIS thread —
+    # one pod per lane, canonical lane order, via drive_dispatch_once —
+    # makes cycle order logical too.  Physical lane threads racing each
+    # other bind into different pools in either order and score each
+    # other's occupancy differently, which at window-index cycle speeds
+    # made two identical sharded replays diverge (the reason the pre-14
+    # core pinned the index OFF here).  The routing, partitioning,
+    # escalation and guarded-commit semantics are byte-identical to the
+    # threaded core — only the interleaving is canonicalized.
+    serial = (deterministic and pace == "lockstep"
+              and prof.effective_dispatch_shards() > 1)
     sched = Scheduler(api, default_registry(), prof, telemetry=False)
-    sched.run()
+    if not serial:
+        sched.run()
+
+    def settle(window_s: float, timeout_s: float) -> bool:
+        if not serial:
+            return _quiesce(api, sched, window_s, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if sched.drive_dispatch_once():
+                continue
+            # no lane had poppable work: wait for async tails (bind pool,
+            # watch fan-out) to stabilize, re-driving if they wake pods
+            if _quiesce(api, sched, window_s, min(0.25, timeout_s)):
+                if not sched.drive_dispatch_once():
+                    return True
+        return False
     start = time.monotonic()
     applied = skipped = 0
     samples: List[dict] = []
@@ -451,13 +481,15 @@ def run_replay(trace_dir: str, *,
             live = api.peek(srv.PODS, key)
             if live is None or live.spec.node_name:
                 return
+            if serial:
+                sched.drive_dispatch_once()
             now = time.monotonic()
             if len(bound) != last_binds:
                 last_binds = len(bound)
                 last_progress = now
             elif now - last_progress > max(0.15, settle_s * 3):
                 return
-            time.sleep(0.005)
+            time.sleep(0.0 if serial else 0.005)
     try:
         for i, ev in enumerate(trace.events):
             kind = ev.get("kind", "")
@@ -485,7 +517,7 @@ def run_replay(trace_dir: str, *,
                 inject_ts[ev["pod"]] = time.monotonic()
                 note_pod(ev)
             if pace == "lockstep" and kind in _QUIESCE_KINDS:
-                _quiesce(api, sched, settle_s, event_timeout_s)
+                settle(settle_s, event_timeout_s)
             if util_sample_every > 0 and applied % util_sample_every == 0 \
                     and len(samples) < 200:
                 samples.append({"event": i,
@@ -502,12 +534,12 @@ def run_replay(trace_dir: str, *,
                                and api.peek(srv.PODS, k) is not None]
             if not outstanding:
                 break
-            if _quiesce(api, sched, settle_s * 4, 1.0) \
+            if settle(settle_s * 4, 1.0) \
                     and not sched.queue.pending_counts().get("backoff", 0):
                 # stable store, empty active/backoff queues, outstanding
                 # pods: genuinely unplaceable without further events — stop
                 break
-            time.sleep(0.01)
+            time.sleep(0.0 if serial else 0.01)
         samples.append({"event": len(trace.events),
                         "pools": _pool_usage(api, pool_of, chips_of)})
     finally:

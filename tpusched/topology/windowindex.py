@@ -25,7 +25,10 @@ Consistency rule (the cursor-consistency contract, doc/performance.md):
 every plane stores the per-pool mutation cursor it was updated at, written
 ATOMICALLY with the data delta while the cache lock is held.  A reader may
 consume an answer only when the plane's version equals the pool cursor its
-OWN snapshot was captured at (``Snapshot.pool_cursors``); any mismatch —
+OWN snapshot was captured at (``Snapshot.pool_cursors`` — since ISSUE 14
+the cache's persistent ``PooledSnapshot`` carries these as the same
+per-pool cursors its sub-maps were composed at, so the index's planes and
+the snapshot's pool sub-maps are versioned by ONE clock); any mismatch —
 the index ran ahead of the snapshot, a topology CR changed, a node's pool
 label disagrees with the CR — falls back to the Python full-recompute
 path, which stays the differential oracle (sampled in-cycle via

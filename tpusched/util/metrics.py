@@ -601,6 +601,14 @@ shard_conflicts_total = REGISTRY.counter_vec(
 shard_escalations_total = REGISTRY.counter_vec(
     "tpusched_shard_escalations_total", ("shard",),
     "Pods escalated from a shard lane to the global dispatch lane.")
+# quota-guarded commits refused by a raced quota EPOCH (ISSUE 14: the
+# fleet-wide compare-and-reserve for ElasticQuota admission) — separate
+# from pool conflicts because the remedies differ (doc/ops.md: a hot
+# quota-conflict loop points at concurrent quota'd traffic, not at pool
+# contention)
+shard_quota_conflicts_total = REGISTRY.counter_vec(
+    "tpusched_shard_quota_conflicts_total", ("shard",),
+    "Quota-guarded commits refused by a raced quota epoch, by lane.")
 
 # Sampling profiler self-accounting (tpusched/obs/profiler.py): the
 # sampler's own sample count — the denominator for every attribution

@@ -66,6 +66,21 @@ def is_pod_terminated(pod: Pod) -> bool:
     return pod.status.phase in (POD_SUCCEEDED, POD_FAILED)
 
 
+def resources_over_bound(used, delta, bound) -> bool:
+    """any resource NAMED BY ``bound`` with used+delta > bound — the cmp2
+    comparison semantics of ElasticQuota bounds (elasticquota.go:90-100:
+    a bound omitting a resource places no limit on it).  ONE copy shared
+    by CapacityScheduling's admission (plugins/capacity) and the cache's
+    commit-time compare-and-reserve (sched/cache.assume_pod_guarded):
+    the quota protocol is only sound while both evaluate the identical
+    rule, so they must not drift."""
+    for k, b in bound.items():
+        v = used.get(k, 0) + (delta.get(k, 0) if delta else 0)
+        if v > b:
+            return True
+    return False
+
+
 def is_pod_active(pod: Pod) -> bool:
     return not is_pod_terminated(pod) and not pod.is_terminating()
 

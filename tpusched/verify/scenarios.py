@@ -625,6 +625,189 @@ class BindpoolMultiSubmitDrain(Scenario):
                 f"release it")
 
 
+# -- ISSUE 14: the quota-aware optimistic commit protocol ----------------------
+
+
+def _quota_pod(name: str, ns: str, chips: int):
+    from ..api.resources import TPU
+    return make_pod(name, namespace=ns, limits={TPU: chips})
+
+
+def _quota_infos(raw):
+    """Build the plugin's admission view from a cache quota_view payload —
+    the same adoption path CapacityScheduling._snapshot_quotas uses."""
+    from ..plugins.capacity.elasticquota_info import (ElasticQuotaInfo,
+                                                      ElasticQuotaInfos,
+                                                      LazyPodKeys)
+    infos = ElasticQuotaInfos()
+    for ns, (mn, mx, used, pods_loader) in (raw or {}).items():
+        infos[ns] = ElasticQuotaInfo.from_parts(ns, mn, mx, used,
+                                                LazyPodKeys(pods_loader))
+    return infos
+
+
+@register
+class QuotaCommitGuard(Scenario):
+    """Two shard lanes admitting pods of ONE ElasticQuota namespace into
+    DIFFERENT pools, racing the semantic quota compare-and-reserve
+    (Cache.assume_pod_guarded with a QuotaReserve — ISSUE 14).
+
+    Each actor replays a lane's exact protocol: capture its pool epoch
+    view, read the admission inputs in one critical section
+    (Cache.quota_view), run the plugin's own max-bound arithmetic, and —
+    only if admission passes — commit through the guarded assume with the
+    request vectors it judged.  The pools differ, so the POOL cursors
+    never conflict: every refusal is the QUOTA guard's.  min = max = 4
+    chips and each pod asks 4, so admitting both is the overshoot the
+    protocol exists to stop.  Invariants: ledger usage never exceeds max,
+    exactly one pod lands (the loser either saw fresh usage and was
+    rejected at admission, or was refused by the commit re-check), and a
+    quota refusal implies another commit really consumed the room (the
+    semantic guard never refuses on unrelated churn)."""
+
+    name = "quota-commit-guard"
+    NS = "team"
+    CHIPS = 4
+
+    # commit tweak point: the seeded-bug variant drops the quota guard
+    def _commit(self, cache: Cache, pod, node: str, pool_cursor: int,
+                req):
+        from ..sched.cache import QUOTA_CONFLICT, QuotaReserve
+        res = cache.assume_pod_guarded(
+            pod, node, pool_cursor,
+            quota_guard=QuotaReserve(self.NS, dict(req), dict(req)))
+        if res is QUOTA_CONFLICT:
+            return "quota-conflict"
+        return "committed" if res is not None else "pool-conflict"
+
+    def setup(self):
+        from ..api.resources import TPU
+        ctx = SimpleNamespace(now=0.0, outcomes=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(_pool_node("a1", "pool-a"))
+        ctx.cache.add_node(_pool_node("b1", "pool-b"))
+        ctx.cache.sync_quota_bounds(
+            {self.NS: ({TPU: self.CHIPS}, {TPU: self.CHIPS})})
+        return ctx
+
+    def threads(self, ctx):
+        def lane(i: int, pool: str, node: str):
+            def run():
+                from ..util.podutil import pod_effective_request
+                view = ctx.cache.snapshot_view([pool])
+                cursor = view.pool_cursors[pool]
+                raw, _epoch = ctx.cache.quota_view()
+                infos = _quota_infos(raw)
+                pod = _quota_pod(f"q{i}", self.NS, self.CHIPS)
+                req = pod_effective_request(pod)
+                eq = infos.get(self.NS)
+                if eq is not None and eq.used_over_max_with(req):
+                    ctx.outcomes.append((i, "rejected"))
+                    return
+                ctx.outcomes.append(
+                    (i, self._commit(ctx.cache, pod, node, cursor, req)))
+            return run
+
+        return [lane(0, "pool-a", "a1"), lane(1, "pool-b", "b1")]
+
+    def check(self, ctx):
+        from ..api.resources import TPU
+        used = ctx.cache.quota_used_snapshot().get(self.NS, {})
+        assert used.get(TPU, 0) <= self.CHIPS, (
+            f"quota usage {used} exceeds max {self.CHIPS} chips — two "
+            f"lanes reserved past the bound (the overshoot the "
+            f"compare-and-reserve exists to stop)")
+        committed = [o for o in ctx.outcomes if o[1] == "committed"]
+        assert len(committed) == 1, (
+            f"{len(committed)} commits landed (want exactly 1): "
+            f"{ctx.outcomes}")
+        for i, kind in ctx.outcomes:
+            if kind == "pool-conflict":
+                raise AssertionError(
+                    f"lane {i} hit a POOL conflict in a pool nothing else "
+                    f"touched — the quota guard must not leak into the "
+                    f"pool compare")
+            if kind == "quota-conflict":
+                assert any(o != i and k == "committed"
+                           for o, k in ctx.outcomes), (
+                    f"lane {i} was quota-refused although no other commit "
+                    f"consumed the room — the semantic guard must never "
+                    f"refuse on unrelated churn")
+
+
+@register
+class QuotaBorrowAggregate(Scenario):
+    """Cross-quota borrow vs a concurrent intra-min reserve: the
+    aggregate gate (Σ used ≤ Σ min) spans BOTH quotas, so the two
+    admissions are mutually invalidating even though they touch different
+    namespaces AND different pools — exactly why the quota guard compares
+    the fleet-wide epoch, not a per-namespace cursor.
+
+    team-a (min 4 / max 8) admits a BORROWER asking 8 (over its min —
+    legal while Σused + 8 ≤ Σmin = 8); team-b admits an intra-min pod
+    asking 4.  Admitting both puts Σused = 12 > Σmin = 8: borrowed
+    capacity that was promised to somebody's guarantee.  The commit's
+    semantic re-check evaluates the aggregate bound against the LIVE
+    fleet sums, which is exactly what a per-namespace check could not
+    see.  Invariant: the aggregate bound holds at quiescence under every
+    interleaving."""
+
+    name = "quota-borrow-aggregate"
+
+    def setup(self):
+        from ..api.resources import TPU
+        ctx = SimpleNamespace(now=0.0, outcomes=[])
+        ctx.cache = Cache(clock=_counter_clock(ctx))
+        ctx.cache.add_node(_pool_node("a1", "pool-a"))
+        ctx.cache.add_node(_pool_node("b1", "pool-b"))
+        ctx.cache.sync_quota_bounds({
+            "team-a": ({TPU: 4}, {TPU: 8}),
+            "team-b": ({TPU: 4}, {TPU: 8})})
+        return ctx
+
+    def threads(self, ctx):
+        from ..sched.cache import QUOTA_CONFLICT, QuotaReserve
+
+        def admit_and_commit(tag: str, ns: str, chips: int, pool: str,
+                             node: str):
+            def run():
+                from ..util.podutil import pod_effective_request
+                view = ctx.cache.snapshot_view([pool])
+                cursor = view.pool_cursors[pool]
+                raw, _epoch = ctx.cache.quota_view()
+                infos = _quota_infos(raw)
+                pod = _quota_pod(f"p-{tag}", ns, chips)
+                req = pod_effective_request(pod)
+                eq = infos.get(ns)
+                if eq is None or eq.used_over_max_with(req) \
+                        or infos.aggregated_used_over_min_with(req):
+                    ctx.outcomes.append((tag, "rejected"))
+                    return
+                res = ctx.cache.assume_pod_guarded(
+                    pod, node, cursor,
+                    quota_guard=QuotaReserve(ns, dict(req), dict(req)))
+                ctx.outcomes.append(
+                    (tag, "quota-conflict" if res is QUOTA_CONFLICT
+                     else "committed" if res is not None
+                     else "pool-conflict"))
+            return run
+
+        return [admit_and_commit("borrow", "team-a", 8, "pool-a", "a1"),
+                admit_and_commit("intra", "team-b", 4, "pool-b", "b1")]
+
+    def check(self, ctx):
+        from ..api.resources import TPU
+        used = ctx.cache.quota_used_snapshot()
+        total = sum(res.get(TPU, 0) for res in used.values())
+        assert total <= 8, (
+            f"Σ quota usage {total} chips exceeds Σ min 8 after a "
+            f"borrow/intra-min race ({ctx.outcomes}) — the aggregate "
+            f"borrow gate was overshot; the fleet-wide epoch compare "
+            f"exists because per-namespace guards cannot see this")
+        assert any(k == "committed" for _, k in ctx.outcomes), (
+            f"no commit landed at all: {ctx.outcomes} — mutual refusal")
+
+
 # -- seeded-bug self-checks (non-vacuity) --------------------------------------
 
 
@@ -710,6 +893,26 @@ class SelfcheckUnguardedCommit(ShardCommitGuard):
         return True
     # check() is inherited: the parent invariant fires exactly when two
     # commits land against one captured epoch
+
+
+@register
+class SelfcheckUnguardedQuotaReserve(QuotaCommitGuard):
+    """DELIBERATE BUG: the commit drops the quota guard and compares only
+    the pool cursor — the pools differ, so BOTH lanes' assumes land and
+    the quota's max is overshot (the exact bug the quota epoch
+    compare-and-reserve exists to stop).  The explorer must find the
+    schedule where both lanes pass admission against the same epoch."""
+
+    name = "selfcheck-unguarded-quota-reserve"
+
+    def _commit(self, cache: Cache, pod, node: str, pool_cursor: int,
+                req):
+        # BUG: no quota_guard — the reserve is unguarded against
+        # concurrent quota traffic
+        res = cache.assume_pod_guarded(pod, node, pool_cursor)
+        return "committed" if res is not None else "pool-conflict"
+    # check() is inherited: the usage-over-max / two-commits invariants
+    # fire exactly when both lanes reserve against one epoch
 
 
 @register
@@ -892,4 +1095,5 @@ class SelfcheckStaleIndex(WindowIndexEpoch):
 
 LIVE_SCENARIOS = tuple(n for n in SCENARIOS if not n.startswith("selfcheck-"))
 SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming",
-                   "selfcheck-unguarded-commit", "selfcheck-stale-index")
+                   "selfcheck-unguarded-commit", "selfcheck-stale-index",
+                   "selfcheck-unguarded-quota-reserve")
