@@ -145,10 +145,20 @@ race-smoke:
 # methodology (trace/prof-smoke precedent); crash recovery (torn tail
 # segment tolerated, capture resumes into a fresh segment) and
 # capture-under-concurrent-scrape bounds ride in the same suite.
+#
+# VIRTUAL-TIME gate (ISSUE 15, tests/test_virtual_replay.py): a recorded
+# storm stretched past one simulated HOUR — permit/backoff/denial
+# windows left at production-nonzero values — replays to completion in
+# bounded wall time under the discrete-event clock, TWICE byte-
+# identically; the virtual arm demonstrably diverges from the
+# --legacy-zeroed-gates arm on at least one attributed retry ordinal
+# (fired gate deadlines are the attribution); and the
+# `cmd.trace evaluate` exit-code contract (0 comparable / 1 regression
+# vs budget / 2 usage) is pinned.
 .PHONY: replay-smoke
 replay-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_replay_smoke.py \
-		-q -p no:cacheprovider
+		tests/test_virtual_replay.py -q -p no:cacheprovider
 
 # Goodput-smoke (the gang-runtime-telemetry gate, part of the tier1
 # flow): the arrival storm with in-band member goodput reports on vs off,

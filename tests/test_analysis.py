@@ -451,6 +451,70 @@ def test_monotonic_clock_sees_through_aliases(tmp_path):
     assert len(r.findings) == 2
 
 
+def test_monotonic_clock_gate_modules_flag_raw_monotonic_calls(tmp_path):
+    """ISSUE 15: inside the scheduler gate modules a raw
+    time.monotonic() CALL is a finding (a deadline computed from it is
+    invisible to VirtualClock); outside them it stays free."""
+    src = """
+        import time
+
+        def deadline():
+            return time.monotonic() + 5.0
+    """
+    gate = run_snippet(tmp_path, "tpusched/sched/queue.py", src,
+                       ["monotonic-clock"])
+    assert len(gate.findings) == 1
+    assert "handle clock" in gate.findings[0].message
+    free = run_snippet(tmp_path, "tpusched/obs/whatever.py", src,
+                       ["monotonic-clock"])
+    assert free.findings == []
+
+
+def test_monotonic_clock_gate_modules_flag_clock_default_param(tmp_path):
+    """...and a ``clock=time.monotonic`` DEFAULT parameter (gate
+    components must default to clock=None and resolve in the body, so
+    skipping the handle clock is a visible wiring choice).  Aliases are
+    resolved; non-clock parameters and body fallbacks stay free."""
+    src = """
+        import time
+        from time import monotonic as mono
+
+        class Gate:
+            def __init__(self, ttl, clock=time.monotonic):
+                self._clock = clock
+
+        def ok(ttl, clock=None, other=mono):
+            return (clock or time.monotonic)
+    """
+    r = run_snippet(tmp_path, "tpusched/util/ttlcache.py", src,
+                    ["monotonic-clock"])
+    assert len(r.findings) == 1
+    assert "visible choice" in r.findings[0].message
+
+    aliased = """
+        from time import monotonic as mono
+
+        def gate(clock=mono):
+            return clock
+    """
+    r2 = run_snippet(tmp_path, "tpusched/sched/shards.py", aliased,
+                     ["monotonic-clock"])
+    assert len(r2.findings) == 1
+
+
+def test_monotonic_clock_substrate_module_is_exempt(tmp_path):
+    src = """
+        import time
+
+        def now():
+            return time.monotonic()
+        wall = time.time
+    """
+    r = run_snippet(tmp_path, "tpusched/util/clock.py", src,
+                    ["monotonic-clock"])
+    assert r.findings == []
+
+
 # -- thread-hygiene ------------------------------------------------------------
 
 

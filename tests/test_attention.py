@@ -8,8 +8,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpusched.jaxbridge import attention, workload
+from tpusched.jaxbridge import attention, compat, workload
 from tpusched.jaxbridge.mesh import build_named_mesh
+
+# The legacy experimental shard_map cannot express two constructs these
+# tests rely on: manual axis_index inside a PARTIALLY-auto mesh (its
+# lowering emits a PartitionId instruction XLA SPMD rejects), and the
+# non-causal ring-flash arm's collective pattern.  The compat shim
+# (jaxbridge/compat.py) recovers everything else; these skip cleanly
+# instead of erroring when only the legacy API exists.
+needs_modern_shard_map = pytest.mark.skipif(
+    not compat.have_modern_shard_map(),
+    reason="needs jax.shard_map (legacy experimental shard_map lowers "
+           "manual axis_index under partial-auto to PartitionId, which "
+           "XLA SPMD rejects)")
 
 
 def _qkv(key, b=2, s=256, h=2, d=64, dtype=jnp.float32):
@@ -82,6 +94,7 @@ def test_ring_gradients_match_naive():
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
 
 
+@needs_modern_shard_map
 def test_ring_composes_with_full_mesh_train_step():
     """cfg.attn='ring' on a dp×sp×tp mesh: the full sharded train step runs
     and matches the GSPMD (naive) step loss."""
@@ -196,7 +209,9 @@ def test_flash_attention_is_gqa_native():
 
 # -- ring-flash: the pallas kernels inside the sp ring ------------------------
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    True,
+    pytest.param(False, marks=needs_modern_shard_map)])
 @pytest.mark.parametrize("sp", [2, 4])
 def test_ring_flash_matches_naive(causal, sp):
     mesh = build_named_mesh({"sp": sp})
@@ -241,6 +256,7 @@ def test_ring_flash_gqa_matches_naive():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@needs_modern_shard_map
 def test_ring_flash_composes_with_full_mesh_train_step():
     import dataclasses
     mesh = build_named_mesh({"dp": 2, "sp": 2, "tp": 2})

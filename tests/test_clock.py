@@ -1,0 +1,211 @@
+"""Property tests for the injectable clock substrate (util/clock).
+
+The VirtualClock is the engine deterministic replay trusts for every
+timeout in the system — these pin its laws: time never runs backward,
+armed deadlines fire in deadline order, a cancelled timer never fires,
+re-arming fires at the new instant, and wait_until never over-advances.
+Hypothesis drives the properties where available; the seeded-fuzz
+stand-ins below keep the same machines exercised when it is not.
+"""
+import random
+import time
+
+import pytest
+
+from tpusched.util.clock import (CallableClock, VirtualClock, WALL,
+                                 WallClock, as_clock)
+
+
+# -- normalization ------------------------------------------------------------
+
+
+def test_as_clock_normalizes_every_legacy_spelling():
+    assert as_clock(None) is WALL
+    assert as_clock(time.time) is WALL
+    assert as_clock(time.monotonic) is WALL
+    vc = VirtualClock()
+    assert as_clock(vc) is vc
+    fake = as_clock(lambda: 42.0)
+    assert isinstance(fake, CallableClock)
+    assert fake.now() == fake.wall() == 42.0
+    assert fake.arm("x", 99.0) == 0        # registry is a no-op
+    with pytest.raises(TypeError):
+        as_clock(3)
+
+
+def test_wall_clock_is_transparent():
+    w = WallClock()
+    assert not w.virtual
+    m0 = time.monotonic()
+    assert w.now() >= m0
+    assert abs(w.wall() - time.time()) < 1.0
+    assert w.arm("anything", w.now() + 1e9) == 0    # no registry, no leak
+    t0 = time.monotonic()
+    w.wait_until(t0 - 100.0)                        # past deadline: no sleep
+    assert time.monotonic() - t0 < 0.5
+
+
+# -- the op machines the properties drive -------------------------------------
+
+
+def _drive(clk: VirtualClock, ops):
+    """Apply (op, value) steps, asserting monotonicity after each."""
+    last = clk.now()
+    for op, val in ops:
+        if op == "advance":
+            clk.advance(val)
+        elif op == "advance_to":
+            clk.advance_to(val)
+        elif op == "arm":
+            clk.arm(f"t{val:.3f}", val)
+        elif op == "fire":
+            clk.advance_to_next_deadline()
+        elif op == "wait_until":
+            clk.wait_until(val)
+        elif op == "sleep":
+            clk.sleep(val)
+        now = clk.now()
+        assert now >= last, (op, val)
+        last = now
+
+
+def _fire_all(clk: VirtualClock):
+    fired = []
+    while True:
+        hit = clk.advance_to_next_deadline()
+        if hit is None:
+            return fired
+        fired.append(hit[1])
+        assert clk.now() >= hit[1]          # time reached the deadline
+
+
+_OP_KINDS = ("advance", "advance_to", "arm", "fire", "wait_until", "sleep")
+
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    HAVE_HYPOTHESIS = True
+
+    _OPS = st.lists(
+        st.tuples(st.sampled_from(_OP_KINDS),
+                  st.floats(0, 200, allow_nan=False)),
+        max_size=60)
+
+    @settings(max_examples=200, deadline=None)
+    @given(ops=_OPS)
+    def test_virtual_time_is_monotonic(ops):
+        """now() never decreases under ANY op interleaving — advancing
+        to a past instant, firing a lapsed deadline, a stale wait_until
+        — none may move time backward."""
+        _drive(VirtualClock(), ops)
+
+    @settings(max_examples=200, deadline=None)
+    @given(deadlines=st.lists(st.floats(0, 1000, allow_nan=False),
+                              min_size=1, max_size=40))
+    def test_deadlines_fire_in_deadline_order(deadlines):
+        clk = VirtualClock()
+        for i, d in enumerate(deadlines):
+            clk.arm(f"d{i}", d)
+        assert _fire_all(clk) == sorted(deadlines)
+        assert clk.fired_total() == len(deadlines)
+        assert clk.armed_count() == 0
+
+    @settings(max_examples=200, deadline=None)
+    @given(deadlines=st.lists(st.floats(0, 1000, allow_nan=False),
+                              min_size=2, max_size=30),
+           data=st.data())
+    def test_cancelled_timers_never_fire_and_rearm_fires_at_new_instant(
+            deadlines, data):
+        clk = VirtualClock()
+        tokens = [clk.arm(f"d{i}", d) for i, d in enumerate(deadlines)]
+        cancel_idx = data.draw(st.integers(0, len(tokens) - 1))
+        clk.cancel(tokens[cancel_idx])
+        new_deadline = data.draw(st.floats(0, 1000, allow_nan=False))
+        clk.arm(f"d{cancel_idx}", new_deadline)
+        expected = sorted([d for i, d in enumerate(deadlines)
+                           if i != cancel_idx] + [new_deadline])
+        assert _fire_all(clk) == expected
+
+    @settings(max_examples=200, deadline=None)
+    @given(start=st.floats(0, 100, allow_nan=False),
+           target=st.floats(0, 100, allow_nan=False))
+    def test_wait_until_never_over_advances(start, target):
+        clk = VirtualClock(start=start)
+        clk.wait_until(target)
+        assert clk.now() == max(start, target)    # exactly, never past
+except ImportError:   # the seeded-fuzz stand-ins below still run
+    HAVE_HYPOTHESIS = False
+
+
+# -- deterministic stand-ins (run with or without hypothesis) -----------------
+
+
+def test_seeded_fuzz_virtual_time_is_monotonic():
+    for seed in range(20):
+        rng = random.Random(20260804 + seed)
+        ops = [(rng.choice(_OP_KINDS), rng.uniform(0, 200))
+               for _ in range(rng.randrange(5, 60))]
+        _drive(VirtualClock(), ops)
+
+
+def test_seeded_fuzz_deadlines_fire_in_order_with_cancel_and_rearm():
+    for seed in range(20):
+        rng = random.Random(707 + seed)
+        deadlines = [rng.uniform(0, 1000)
+                     for _ in range(rng.randrange(2, 30))]
+        clk = VirtualClock()
+        tokens = [clk.arm(f"d{i}", d) for i, d in enumerate(deadlines)]
+        cancel_idx = rng.randrange(len(tokens))
+        clk.cancel(tokens[cancel_idx])
+        new_deadline = rng.uniform(0, 1000)
+        clk.arm(f"d{cancel_idx}", new_deadline)
+        expected = sorted([d for i, d in enumerate(deadlines)
+                           if i != cancel_idx] + [new_deadline])
+        assert _fire_all(clk) == expected
+        assert clk.armed_count() == 0
+
+
+def test_wait_until_exact():
+    clk = VirtualClock(start=5.0)
+    clk.wait_until(3.0)
+    assert clk.now() == 5.0              # stale target: no move
+    clk.wait_until(8.25)
+    assert clk.now() == 8.25             # exact, never past
+
+
+def test_fire_respects_limit_and_does_not_move_time():
+    clk = VirtualClock()
+    clk.arm("late", 10.0)
+    assert clk.advance_to_next_deadline(limit=5.0) is None
+    assert clk.now() == 0.0                   # a refused fire is free
+    assert clk.advance_to_next_deadline(limit=10.0) is None   # exclusive
+    hit = clk.advance_to_next_deadline(limit=10.1)
+    assert hit == ("late", 10.0) and clk.now() == 10.0
+
+
+def test_wall_offset_and_wall_scale_arming():
+    clk = VirtualClock(start=100.0, wall0=1_000_100.0)
+    assert clk.wall() == pytest.approx(1_000_100.0)
+    clk.arm("w", 1_000_103.5, wall=True)      # wall scale → mono 103.5
+    clk.arm("m", 102.0)
+    assert clk.advance_to_next_deadline()[0] == "m"
+    label, deadline = clk.advance_to_next_deadline()
+    assert label == "w" and deadline == pytest.approx(103.5)
+    assert clk.wall() == pytest.approx(1_000_103.5)
+
+
+def test_fired_log_and_label_census():
+    clk = VirtualClock()
+    for i in range(5):
+        clk.arm("backoff", float(i))
+    clk.arm("permit", 2.5)
+    while clk.advance_to_next_deadline() is not None:
+        pass
+    assert clk.fired_total() == 6
+    assert clk.fired_by_label() == {"backoff": 5, "permit": 1}
+    labels = [lbl for _, lbl in clk.fired()]
+    assert labels.count("permit") == 1
+    # log instants are nondecreasing (the fire order IS time order)
+    instants = [t for t, _ in clk.fired()]
+    assert instants == sorted(instants)

@@ -456,7 +456,7 @@ def test_post_bind_residue_flushes_on_window_and_close():
     # under-quorum: batched, not yet patched (or already window-flushed —
     # both legal; drive the due-flush deterministically)
     time.sleep(0.002)
-    mgr._flush_status_if_due()
+    mgr.flush_status_if_due()
     assert api.try_get(srv.POD_GROUPS, "default/gang").status.scheduled == 1
     # close() drains anything still pending
     mgr._status_flush_s = 60.0

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tpusched.jaxbridge import compat
 from tpusched.jaxbridge.mesh import build_named_mesh
 from tpusched.jaxbridge.pipeline import (init_pipeline_params,
                                          make_pipeline_train_step,
@@ -16,6 +17,19 @@ from tpusched.jaxbridge.pipeline import (init_pipeline_params,
                                          stack_layers)
 from tpusched.jaxbridge.workload import (ModelConfig, init_params, loss_fn,
                                          sgd_train_step)
+
+# The pipeline schedule runs shard_map manual ONLY over pp (dp/tp stay
+# automatic) and transposes a replicated-scalar loss — both constructs
+# the legacy experimental shard_map cannot express (partial-auto
+# axis_index lowers to a PartitionId instruction XLA SPMD rejects, and
+# its spec prover fails the replicated-grad transpose).  The compat shim
+# (jaxbridge/compat.py) keeps everything importable; the schedule tests
+# skip cleanly on legacy-only builds instead of erroring.
+needs_modern_shard_map = pytest.mark.skipif(
+    not compat.have_modern_shard_map(),
+    reason="pipeline schedule needs jax.shard_map (partial-auto manual "
+           "axes + replicated-grad transpose unsupported on the legacy "
+           "experimental API)")
 
 
 def tiny(**kw):
@@ -25,6 +39,7 @@ def tiny(**kw):
     return ModelConfig(**base)
 
 
+@needs_modern_shard_map
 @pytest.mark.parametrize("pp,n_micro", [(2, 2), (4, 4), (2, 4)])
 def test_pipeline_loss_matches_plain_loss(pp, n_micro):
     cfg = tiny()
@@ -42,6 +57,7 @@ def test_pipeline_loss_matches_plain_loss(pp, n_micro):
     np.testing.assert_allclose(float(got), want, rtol=1e-5)
 
 
+@needs_modern_shard_map
 def test_pipeline_training_decreases_loss():
     cfg = tiny()
     mesh = build_named_mesh({"pp": 2, "dp": 2})
@@ -97,6 +113,7 @@ def test_pipeline_grads_match_plain_grads():
                                atol=2e-5, rtol=2e-4)
 
 
+@needs_modern_shard_map
 def test_pipeline_moe_composes():
     """pp x ep: an MoE model pipelined over 2 stages with experts sharded
     over ep inside each stage."""

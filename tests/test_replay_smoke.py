@@ -39,7 +39,8 @@ IN_FLIGHT_CAP = 40          # pods
 def record_smoke_storm(out_dir: str, seed: int = 7,
                        capture: bool = True,
                        quota_teams: tuple = (),
-                       profile=None) -> dict:
+                       profile=None,
+                       goodput_reports: bool = False) -> dict:
     """Record (or, capture=False, just run — the overhead-gate A/B arm)
     a tiny mixed storm with capacity recycling and a full drain.  Returns
     run stats including the wall time of the submission+drain window.
@@ -75,6 +76,15 @@ def record_smoke_storm(out_dir: str, seed: int = 7,
                     pods = [c.pod(k) for k in keys]
                     if all(p is not None and p.spec.node_name
                            for p in pods):
+                        if goodput_reports and pg is not None:
+                            # one step-report batch per bound gang before
+                            # teardown: the trace then carries the
+                            # goodput-report events matrix_from_trace
+                            # joins, so `cmd.trace evaluate` prices
+                            # placements through a non-empty matrix
+                            c.pump_gang_progress(
+                                pg, {k: 0.1 for k in keys}, steps=2,
+                                tokens_per_step=400.0)
                         for k in keys:
                             c.api.delete(srv.PODS, k)
                         if pg is not None:
@@ -366,9 +376,13 @@ def test_diff_vs_recorded_reality_is_structured(two_replays, smoke_trace):
 
 def test_replay_report_carries_differential_surfaces(two_replays):
     r1, _ = two_replays
-    # per-pool utilization curve sampled over the stream
+    # per-pool utilization curve sampled over the stream (ISSUE 15: each
+    # sample also stamps the replay-clock instant and — with topologies
+    # present — the fragmentation trajectory row)
     assert r1.pool_utilization
-    assert all(set(s) == {"event", "pools"} for s in r1.pool_utilization)
+    assert all({"event", "pools", "t"} <= set(s)
+               and set(s) <= {"event", "pools", "t", "frag"}
+               for s in r1.pool_utilization)
     final = r1.pool_utilization[-1]["pools"]
     assert all(isinstance(v, int) for v in final.values())
     # SLO attainment vs the profile objective

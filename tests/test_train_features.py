@@ -12,6 +12,15 @@ from tpusched.jaxbridge import mesh as meshlib
 from tpusched.jaxbridge import workload as wl
 
 
+from tpusched.jaxbridge import compat
+
+# see tests/test_pipeline.py: the pipeline path needs jax.shard_map
+needs_modern_shard_map = pytest.mark.skipif(
+    not compat.have_modern_shard_map(),
+    reason="pipeline path needs jax.shard_map (legacy experimental API "
+           "cannot express it)")
+
+
 def need_devices(n=8):
     if len(jax.devices()) < n:
         pytest.skip(f"needs {n} virtual devices")
@@ -221,6 +230,7 @@ def test_mixed_precision_decode_path():
     assert (out == out2).all()
 
 
+@needs_modern_shard_map
 def test_mixed_precision_pipeline_path():
     """Pipeline-parallel training under the f32-master policy (regression:
     bf16 buffers vs f32 activations crash at trace time)."""

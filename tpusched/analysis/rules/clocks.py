@@ -1,4 +1,5 @@
-"""monotonic-clock: no ``time.time()`` in library code.
+"""monotonic-clock: no ``time.time()`` in library code, and no ad-hoc
+wall reads at scheduler GATE sites.
 
 Wall clocks jump — NTP slew, VM suspend, leap smearing — and a latency or
 duration computed from two ``time.time()`` reads can come out negative or
@@ -17,6 +18,23 @@ spans.  The discipline:
 The rule flags ``time.time()`` CALLS only.  ``clock=time.time`` default
 parameters and ``default_factory=time.time`` are references, not calls —
 the injected-clock idiom stays free.
+
+GATE-SITE discipline (ISSUE 15): the modules that own scheduler time
+gates — backoff/flush queues, permit barriers, denial windows,
+escalation TTLs, watchdogs — must route their clocks through the
+injected handle clock (``util/clock.Clock``), because virtual-time
+replay depends on every gate reading (and ARMING its deadlines on) the
+one substrate.  In those modules this rule additionally flags:
+
+- direct ``time.monotonic()`` CALLS — a gate deadline computed from a
+  raw wall read is invisible to ``VirtualClock`` and silently breaks
+  trace compression.  Legitimate live-surface sites (bounds on REAL
+  thread blocking: pop() wait deadlines, shutdown joins, health-publish
+  pacing) carry a justified suppression;
+- ``clock=time.monotonic`` DEFAULT parameters — gate components default
+  to ``clock=None`` and resolve the fallback in the body
+  (``clock or time.monotonic``), so a constructor wired without the
+  handle clock is a visible choice, not an invisible default.
 """
 from __future__ import annotations
 
@@ -25,25 +43,76 @@ from typing import Iterable
 
 from ..core import Finding, FileContext, Rule, dotted_name, register
 
+# The scheduler-owned gate modules (relpath prefixes): everything here
+# holds at least one time gate the virtual-time replay driver must be
+# able to see.  util/clock.py itself is the substrate — exempt.
+_GATE_MODULES = (
+    "tpusched/sched/queue.py",
+    "tpusched/sched/scheduler.py",
+    "tpusched/sched/shards.py",
+    "tpusched/fwk/runtime.py",
+    "tpusched/util/ttlcache.py",
+    "tpusched/plugins/coscheduling/",
+)
+
 
 @register
 class MonotonicClock(Rule):
     name = "monotonic-clock"
     summary = ("no time.time() calls — monotonic for durations, injected "
-               "clock= for timestamps")
+               "clock= for timestamps; gate sites route through the "
+               "handle clock")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.relpath.startswith("tpusched/"):
             return
+        if ctx.relpath == "tpusched/util/clock.py":
+            return      # the substrate itself wraps the raw reads
         # resolve `import time as _time` / `from time import time` so an
         # alias cannot smuggle a wall-clock read past the rule
         spellings = set(ctx.import_aliases("time", "time"))
+        gate = any(ctx.relpath.startswith(p) for p in _GATE_MODULES)
+        mono_spellings = set(ctx.import_aliases("time", "monotonic")) \
+            if gate else set()
         for node in ctx.nodes:
-            if isinstance(node, ast.Call) \
-                    and dotted_name(node.func) in spellings:
-                yield self.finding(
-                    ctx, node,
-                    "time.time() call: use time.monotonic() for "
-                    "durations/deadlines, the injected clock= for "
-                    "timestamps; wall-time-by-design sites must be "
-                    "suppressed with a justification")
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in spellings:
+                    yield self.finding(
+                        ctx, node,
+                        "time.time() call: use time.monotonic() for "
+                        "durations/deadlines, the injected clock= for "
+                        "timestamps; wall-time-by-design sites must be "
+                        "suppressed with a justification")
+                elif gate and name in mono_spellings:
+                    yield self.finding(
+                        ctx, node,
+                        "raw time.monotonic() in a scheduler gate "
+                        "module: route through the injected handle "
+                        "clock (util/clock) so virtual-time replay sees "
+                        "the gate; live-surface sites (real thread-wait "
+                        "bounds, shutdown joins, publish pacing) need a "
+                        "justified suppression")
+            elif gate and isinstance(node, ast.FunctionDef):
+                for arg, default in self._defaults(node):
+                    if arg == "clock" \
+                            and dotted_name(default) in mono_spellings:
+                        yield self.finding(
+                            ctx, default,
+                            "clock=time.monotonic default parameter in "
+                            "a gate module: default to clock=None and "
+                            "resolve `clock or time.monotonic` in the "
+                            "body — wiring a gate without the handle "
+                            "clock must be a visible choice")
+
+    @staticmethod
+    def _defaults(fn: ast.FunctionDef):
+        """(arg name, default node) pairs, positional + kw-only."""
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+            yield arg.arg, default
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None:
+                yield arg.arg, default

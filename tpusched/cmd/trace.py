@@ -16,10 +16,17 @@ workload traces (tpusched/obs/fleetrace.py + tpusched/sim/replay.py).
     python -m tpusched.cmd.trace diff /tmp/r1.json /tmp/r2.json
     python -m tpusched.cmd.trace diff /tmp/r1.json /tmp/trace
 
+    # evaluate a config/policy change over a recorded day: replay BOTH
+    # arms on virtual time and render the attributed comparison
+    python -m tpusched.cmd.trace evaluate /tmp/trace \\
+        --arm base.yaml --arm candidate.yaml
+
 Exit codes: ``diff`` (and ``replay`` with ``--fail-on-diff``) exit 0 when
 placements are identical, 1 when they differ, 2 on usage errors — so CI
 can gate on "replaying the same trace twice changes nothing"
-(``make replay-smoke``).
+(``make replay-smoke``).  ``evaluate`` exits 0 when the arms are
+comparable, 1 when the candidate regresses past a ``--budget-*`` bound,
+2 on usage errors.
 """
 from __future__ import annotations
 
@@ -73,11 +80,43 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("--production-fidelity", action="store_true",
                      help="keep the profile's parallelism / node sampling "
                           "instead of the deterministic overrides")
+    rep.add_argument("--legacy-zeroed-gates", action="store_true",
+                     help="pre-virtual-time determinism: wall clock with "
+                          "every retry gate zeroed (pod backoff, denial "
+                          "window, watchdog off) — the A/B arm; default "
+                          "deterministic replay runs the production "
+                          "windows on a virtual clock")
     rep.add_argument("--report", help="write the replay report JSON here")
     rep.add_argument("--fail-on-diff", action="store_true",
                      help="exit 1 if placements differ from the recorded "
                           "reality")
     rep.add_argument("--json", action="store_true")
+
+    ev = sub.add_parser(
+        "evaluate",
+        help="replay N config arms over one trace (virtual time) and "
+             "render the attributed scheduling-quality comparison")
+    ev.add_argument("trace", help="trace directory")
+    ev.add_argument("--arm", action="append", default=[],
+                    help="a TpuSchedulerConfiguration YAML, or 'default' "
+                         "for the canned profile; repeat per arm (first "
+                         "arm is the base). NAME=PATH names an arm")
+    ev.add_argument("--scheduler-name",
+                    help="profile to pick from multi-profile configs")
+    ev.add_argument("--legacy-zeroed-gates", action="store_true",
+                    help="run the arms under the zeroed-gate lockstep "
+                         "instead of virtual time")
+    ev.add_argument("--report", help="write the evaluation JSON here")
+    ev.add_argument("--json", action="store_true")
+    ev.add_argument("--budget-jct-p99-pct", type=float, default=None,
+                    help="fail (exit 1) if the candidate's JCT p99 "
+                         "regresses more than this percent vs the base")
+    ev.add_argument("--budget-min-attainment", type=float, default=None,
+                    help="fail (exit 1) if any candidate arm's SLO "
+                         "attainment falls below this fraction")
+    ev.add_argument("--budget-goodput-drop-pct", type=float, default=None,
+                    help="fail (exit 1) if the candidate's priced "
+                         "goodput drops more than this percent vs base")
 
     dif = sub.add_parser("diff",
                          help="diff two replay reports, or a report vs a "
@@ -258,6 +297,7 @@ def _cmd_replay(args) -> int:
         scheduler_name=args.scheduler_name,
         allow_preemption=args.allow_preemption,
         deterministic=not args.production_fidelity,
+        legacy_zeroed_gates=args.legacy_zeroed_gates,
         pace=args.pace, speedup=args.speedup).to_dict()
     if args.report:
         with open(args.report, "w", encoding="utf-8") as f:
@@ -269,9 +309,17 @@ def _cmd_replay(args) -> int:
         print(f"replayed {report['events_applied']} event(s) "
               f"({report['pace']}, "
               f"{'deterministic' if report['deterministic'] else 'production'}"
+              f", {report['clock_mode']} clock"
               f"): {report['binds']} bind(s), "
               f"{len(report['unbound'])} unbound, "
               f"feed window {report['feed_window_s']}s")
+        vt = report.get("virtual_time") or {}
+        if vt:
+            print(f"  time: {vt.get('recorded_span_s')}s recorded -> "
+                  f"{vt.get('replay_wall_s')}s wall "
+                  f"(x{vt.get('compression_ratio')}"
+                  + (f", {vt.get('deadlines_fired')} deadline(s) fired"
+                     if "deadlines_fired" in vt else "") + ")")
         e2e = report["pod_e2e"]
         print(f"  replay pod-e2e p50 {e2e['p50_s']}s / p99 {e2e['p99_s']}s "
               f"({e2e['events']} events, attainment {e2e['attainment']})")
@@ -282,6 +330,118 @@ def _cmd_replay(args) -> int:
         if args.report:
             print(f"  report written to {args.report}")
     return 1 if args.fail_on_diff and not diff["identical"] else 0
+
+
+def _cmd_evaluate(args) -> int:
+    from ..obs.fleetrace import load_trace
+    from ..sim.evaluate import ArmSpec, evaluate_arms
+    if not args.arm:
+        print("evaluate needs at least one --arm (a config YAML or "
+              "'default'); the first arm is the base", file=sys.stderr)
+        return 2
+    arms = []
+    for i, spec in enumerate(args.arm):
+        name, _, path = spec.rpartition("=")
+        if not name:
+            name, path = "", spec
+        if path in ("default", "-"):
+            cfg = None
+        else:
+            if not os.path.isfile(path):
+                print(f"arm config not found: {path}", file=sys.stderr)
+                return 2
+            cfg = path
+        label = name or (os.path.splitext(os.path.basename(path))[0]
+                         if cfg else "default")
+        if any(a.name == label for a in arms):
+            label = f"{label}#{i}"
+        arms.append(ArmSpec(name=label, config_path=cfg,
+                            scheduler_name=args.scheduler_name))
+    try:
+        trace = load_trace(args.trace)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    doc = evaluate_arms(args.trace, arms, trace=trace,
+                        legacy_zeroed_gates=args.legacy_zeroed_gates)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        _render_evaluation(doc)
+        if args.report:
+            print(f"report written to {args.report}")
+    return _evaluate_verdict(args, doc)
+
+
+def _render_evaluation(doc: dict) -> None:
+    print(f"evaluated {len(doc['arms'])} arm(s) over {doc['trace']} "
+          f"({doc['recorded_span_s']}s recorded, matrix cells "
+          f"{doc['matrix_cells']})")
+    for arm in doc["arms"]:
+        s = arm["summary"]
+        jct, qd = s.get("jct") or {}, s.get("queueing_delay") or {}
+        vt = s.get("virtual_time") or {}
+        gp = s.get("goodput") or {}
+        util = s.get("utilization") or {}
+        print(f"  arm {arm['name']}: {s['binds']} bind(s), "
+              f"{s['unbound']} unbound, {s['retried_pods']} retried")
+        print(f"    jct p50 {jct.get('p50_s')}s p99 {jct.get('p99_s')}s "
+              f"attainment {jct.get('attainment')} | queueing p50 "
+              f"{qd.get('p50_s')}s p99 {qd.get('p99_s')}s")
+        print(f"    util mean {util.get('mean_utilization')} frag mean "
+              f"{util.get('mean_fragmentation')} | goodput "
+              f"{gp.get('total_units_per_s')} unit/s "
+              f"({gp.get('priced_pods')} priced) | replayed "
+              f"{vt.get('recorded_span_s')}s in "
+              f"{vt.get('replay_wall_s')}s wall "
+              f"(x{vt.get('compression_ratio')})")
+    for cmp_ in doc["comparisons"]:
+        d = cmp_["deltas"]
+        print(f"  {cmp_['candidate']} vs {cmp_['base']}: "
+              f"jct p99 {_fmt_pct(d['jct_p99_pct'])}, queueing p99 "
+              f"{_fmt_pct(d['queueing_p99_pct'])}, attainment "
+              f"{d['attainment_delta']:+.4f}, binds {d['binds_delta']:+d}, "
+              f"goodput {_fmt_pct(d['goodput_pct'])}, "
+              f"{d['placements_moved']} placement(s) moved")
+
+
+def _fmt_pct(v) -> str:
+    return "n/a" if v is None else f"{v:+.1f}%"
+
+
+def _evaluate_verdict(args, doc: dict) -> int:
+    """The exit-code contract: 1 iff an explicit budget is violated by
+    any candidate arm (vs the base arm)."""
+    failed = False
+    for cmp_ in doc["comparisons"]:
+        d = cmp_["deltas"]
+        if args.budget_jct_p99_pct is not None \
+                and d["jct_p99_pct"] is not None \
+                and d["jct_p99_pct"] > args.budget_jct_p99_pct:
+            print(f"BUDGET: {cmp_['candidate']} jct p99 "
+                  f"{_fmt_pct(d['jct_p99_pct'])} exceeds "
+                  f"+{args.budget_jct_p99_pct}%", file=sys.stderr)
+            failed = True
+        if args.budget_goodput_drop_pct is not None \
+                and d["goodput_pct"] is not None \
+                and -d["goodput_pct"] > args.budget_goodput_drop_pct:
+            print(f"BUDGET: {cmp_['candidate']} goodput "
+                  f"{_fmt_pct(d['goodput_pct'])} drops more than "
+                  f"{args.budget_goodput_drop_pct}%", file=sys.stderr)
+            failed = True
+    if args.budget_min_attainment is not None:
+        for arm in doc["arms"][1:] or doc["arms"]:
+            att = ((arm["summary"].get("jct") or {})
+                   .get("attainment"))
+            if att is not None and att < args.budget_min_attainment:
+                print(f"BUDGET: arm {arm['name']} attainment {att} "
+                      f"below {args.budget_min_attainment}",
+                      file=sys.stderr)
+                failed = True
+    return 1 if failed else 0
 
 
 def _cmd_diff(args) -> int:
@@ -315,6 +475,8 @@ def main(argv=None) -> int:
             return _cmd_inspect(args)
         if args.cmd == "replay":
             return _cmd_replay(args)
+        if args.cmd == "evaluate":
+            return _cmd_evaluate(args)
         return _cmd_diff(args)
     except BrokenPipeError:
         # `trace diff ... | head` closing the pipe is not an error; keep

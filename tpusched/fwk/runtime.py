@@ -201,12 +201,18 @@ class Registry(Dict[str, Callable[[Any, "Handle"], Plugin]]):
 
 class _WaitingPod:
     """A pod parked at Permit. Per-plugin deadlines; any rejection or any
-    plugin's timeout rejects the pod; all allowed ⇒ proceed to bind."""
+    plugin's timeout rejects the pod; all allowed ⇒ proceed to bind.
 
-    def __init__(self, pod: Pod, plugin_timeouts: Dict[str, float]):
+    ``clock`` is the now-read the deadlines live on (the framework passes
+    its handle clock's): under virtual-time replay the permit window is a
+    real armed deadline the driver jumps to, not a wall wait."""
+
+    def __init__(self, pod: Pod, plugin_timeouts: Dict[str, float],
+                 clock=None):
         self.pod = pod
         self._cond = threading.Condition()
-        now = time.monotonic()
+        self._clock = clock or time.monotonic
+        now = self._clock()
         self._pending: Dict[str, float] = {p: now + t for p, t in plugin_timeouts.items()}
         self._status: Optional[Status] = None
         self._callbacks: List = []
@@ -276,13 +282,18 @@ class _WaitingPod:
         self._fire(fire, self._status)
 
     def wait(self) -> Status:
+        """Blocking wait (direct framework users only; the scheduler's
+        binding path is callback-driven).  Under a VIRTUAL clock the
+        remaining window is virtual seconds — the condition wait below
+        still bounds real blocking, but deadline enforcement then comes
+        from ``expire_if_due`` (driver/watchdog), not from this wait."""
         with self._cond:
             while self._status is None:
                 if not self._pending:
                     self._status = Status.success()
                     break
                 deadline = min(self._pending.values())
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self._clock()
                 if remaining <= 0:
                     plugin = min(self._pending, key=self._pending.get)
                     self._status = Status.unschedulable(
@@ -368,11 +379,19 @@ class Handle:
     quota_guarded_commits = False
 
     def __init__(self, clientset, informer_factory, framework_getter,
-                 clock=time.time):
+                 clock=time.time, clock_handle=None):
+        from ..util.clock import as_clock
         self.clientset = clientset
         self.informer_factory = informer_factory
         self._framework_getter = framework_getter
         self.clock = clock
+        # the full Clock object (util/clock): wall/mono reads PLUS the
+        # deadline registry.  Plugins and the framework route their gate
+        # clocks (denial windows, permit deadlines, flush windows)
+        # through this so a VirtualClock replay sees every lapse as an
+        # armed deadline instead of a wall wait.
+        self.clock_handle = clock_handle if clock_handle is not None \
+            else as_clock(clock)
         self.pod_nominator = PodNominator()
         self._snapshot: Snapshot = Snapshot()
         # Per-thread snapshot slot for concurrent dispatch lanes (sharded
@@ -471,8 +490,13 @@ class Framework:
     """One profile's compiled plugin set."""
 
     def __init__(self, registry: Registry, profile: PluginProfile, handle: Handle):
+        from ..util.clock import WALL
         self.profile = profile
         self.handle = handle
+        # gate clock for the permit barrier (handles built before the
+        # clock_handle attr existed fall back to the wall singleton)
+        self._clock_handle = getattr(handle, "clock_handle", None) or WALL
+        self._now = self._clock_handle.now
         self._waiting: Dict[str, _WaitingPod] = {}
         self._waiting_lock = threading.RLock()
         # deadline sweeper for the event-driven permit barrier: started
@@ -763,7 +787,7 @@ class Framework:
                     # reserved state
                     return Status.unschedulable(
                         f"pod {pod.key} rejected: framework is closing")
-                wp = _WaitingPod(pod, plugin_timeouts)
+                wp = _WaitingPod(pod, plugin_timeouts, clock=self._now)
                 self._waiting[pod.meta.uid] = wp
                 if self._sweeper is None:
                     self._sweeper = threading.Thread(
@@ -771,6 +795,13 @@ class Framework:
                         name="tpusched-permit-sweeper", daemon=True)
                     self._sweeper.start()
                 d = wp.deadline()
+                if d is not None:
+                    # every permit deadline is an armed gate: a virtual-
+                    # time replay driver jumps to it and expires the
+                    # barrier via expire_due_permits (a stale fire after
+                    # early resolution is harmless — expire_if_due is
+                    # idempotent on resolved pods)
+                    self._clock_handle.arm("permit", d)
                 if d is not None and (self._permit_horizon is None
                                       or d < self._permit_horizon):
                     self._permit_horizon = d
@@ -842,15 +873,22 @@ class Framework:
                     if d is not None and (nxt is None or d < nxt):
                         nxt = d
                 self._permit_horizon = nxt
-                timeout = None if nxt is None \
-                    else max(0.01, nxt - time.monotonic())
+                # under a VIRTUAL clock the horizon is virtual seconds
+                # away — a real-time wait toward it would either spin or
+                # oversleep.  The sweeper goes purely event-driven there;
+                # deadline enforcement comes from the replay driver
+                # (expire_due_permits after each clock advance) and the
+                # watchdog's belt-and-braces expire_if_due.
+                timeout = None if (nxt is None
+                                   or self._clock_handle.virtual) \
+                    else max(0.01, nxt - self._now())
                 self._waiting_cv.wait(timeout=timeout)
                 if self._closed:
                     return
                 # a wake before the horizon means an inserter SHRANK it
                 # (inserters only notify then): nothing can be due yet,
                 # recompute the horizon without sweeping the waiters
-                now = time.monotonic()
+                now = self._now()
                 horizon = self._permit_horizon
                 if horizon is None or now < horizon:
                     continue
@@ -858,6 +896,29 @@ class Framework:
                        if (d := wp.deadline()) is not None and d <= now]
             for wp in due:  # fires callbacks — never under the lock
                 wp.expire_if_due(now)
+
+    def expire_due_permits(self, now: Optional[float] = None) -> int:
+        """Enforce every lapsed permit deadline NOW (idempotent on
+        resolved pods).  The virtual-time replay driver calls this after
+        each clock advance — the real-time sweeper thread cannot pace
+        itself against a clock that only moves when driven.  Returns how
+        many barriers actually expired: their resolution callbacks hand
+        work to the bind pool ASYNCHRONOUSLY, so the driver must settle
+        whenever this is nonzero (a queue-side probe alone can miss the
+        in-flight hand-off)."""
+        if now is None:
+            now = self._now()
+        with self._waiting_lock:
+            pods = list(self._waiting.values())
+        expired = 0
+        for wp in pods:             # fires callbacks — never under the lock
+            # single read: a concurrent resolution between two deadline()
+            # calls would turn the second into None mid-comparison
+            d = wp.deadline()
+            if d is not None and d <= now:
+                expired += 1
+            wp.expire_if_due(now)
+        return expired
 
     def iterate_over_waiting_pods(self, fn) -> None:
         with self._waiting_lock:

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from . import compat
+
 NEG_INF = float(np.finfo(np.float32).min)
 
 
@@ -472,9 +474,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # max is finite from the first step on.
     q32 = q.astype(jnp.float32).reshape(b, s_loc, kv, n_rep, d)
     # fresh accumulators are device-invariant constants; mark them varying
-    # over the manual sp axis so the scan carry types line up (JAX VMA rules)
+    # over the manual sp axis so the scan carry types line up (JAX VMA
+    # rules; identity on pre-VMA JAX — jaxbridge/compat.py)
     def vary(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return compat.pcast_varying(x, (axis_name,))
     m0 = vary(jnp.full((b, kv, n_rep, s_loc, 1), NEG_INF, jnp.float32))
     l0 = vary(jnp.zeros((b, kv, n_rep, s_loc, 1), jnp.float32))
     acc0 = vary(jnp.zeros((b, s_loc, kv, n_rep, d), jnp.float32))
@@ -512,8 +515,8 @@ def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
     only ``axis_name`` is manual; every other mesh axis stays automatic."""
     spec = P(batch_spec, axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={axis_name})
+    return compat.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, axis_names={axis_name})
 
 
 # -- ring-flash attention: the pallas kernels INSIDE the sp ring --------------
@@ -686,6 +689,6 @@ def make_ring_flash_attention(mesh, axis_name: str = "sp",
     fn = functools.partial(ring_flash_attention, axis_name=axis_name,
                            causal=causal, block_q=block_q, block_k=block_k,
                            interpret=interpret)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={axis_name},
-                         check_vma=False)
+    return compat.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, axis_names={axis_name},
+                            check_vma=False)

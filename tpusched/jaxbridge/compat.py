@@ -1,0 +1,128 @@
+"""JAX API compatibility shims.
+
+``shard_map`` moved twice across the JAX versions this tree meets in the
+wild: modern releases expose ``jax.shard_map(..., axis_names=...)``
+(manual axes named explicitly, everything else automatic), older ones
+only ``jax.experimental.shard_map.shard_map(..., auto=...)`` (manual
+over every mesh axis unless listed in ``auto``).  The two parameters are
+complements of each other over the mesh's axis set, so one adapter
+covers both — and the VMA helper ``jax.lax.pcast`` that the new API's
+varying-mesh-axes rules require does not exist on the old one, which
+has no VMA system at all (``pcast_varying`` is the identity there).
+
+Callers (``attention.py``, ``pipeline.py``) use :func:`shard_map` and
+:func:`pcast_varying` and never touch ``jax.shard_map`` directly; tests
+gate on :func:`have_shard_map` so a JAX build with NEITHER spelling
+skips cleanly instead of erroring 28 tests deep.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["have_shard_map", "have_modern_shard_map", "shard_map",
+           "pcast_varying", "ShardMapUnavailable"]
+
+
+class ShardMapUnavailable(RuntimeError):
+    """Raised when no shard_map spelling exists in this JAX build."""
+
+
+def _new_api():
+    """The modern top-level entry point, or None."""
+    fn = getattr(jax, "shard_map", None)
+    return fn if callable(fn) else None
+
+
+def _experimental_api():
+    """The legacy experimental entry point, or None."""
+    try:
+        from jax.experimental.shard_map import shard_map as esm
+        return esm
+    except (ImportError, AttributeError):
+        return None
+
+
+def have_shard_map() -> bool:
+    """True when some shard_map spelling exists — the skip gate the
+    ring-attention / pipeline tests use."""
+    try:
+        return _new_api() is not None or _experimental_api() is not None
+    # tpulint: disable=exception-taxonomy — capability probe: a JAX build
+    # broken enough to throw here has no shard_map to offer, and the
+    # callers (test skip gates) need a boolean, not a stack trace
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def have_modern_shard_map() -> bool:
+    """True when the top-level ``jax.shard_map`` exists.  A handful of
+    constructs only the new API can express on this backend — manual
+    ``axis_index`` inside a PARTIALLY-auto mesh (the legacy lowering
+    emits a PartitionId instruction XLA SPMD rejects) and the
+    replicated-scalar gradient transpose the pipeline loss relies on —
+    gate their tests on this instead of :func:`have_shard_map`."""
+    try:
+        return _new_api() is not None
+    # tpulint: disable=exception-taxonomy — same capability-probe
+    # contract as have_shard_map above
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """Version-portable ``shard_map``.
+
+    ``axis_names``: the axes the body handles MANUALLY (the new API's
+    parameter).  None means every mesh axis is manual (both APIs'
+    historical default).  On the legacy API this translates to
+    ``auto = mesh.axis_names - axis_names``, with the replication
+    checker ON by default (see the check_vma note below — disabling it
+    also disables the spec prover replicated outputs need).
+
+    ``check_vma``: forwarded to the new API when it understands it (the
+    pallas-in-manual-axis escape hatch); the legacy API has no VMA
+    checker, so the flag is moot there."""
+    new = _new_api()
+    if new is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        try:
+            return new(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+        except TypeError:
+            # a transitional jax.shard_map without the check_vma kwarg
+            kwargs.pop("check_vma", None)
+            return new(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+    legacy = _experimental_api()
+    if legacy is None:
+        raise ShardMapUnavailable(
+            "this JAX build exposes neither jax.shard_map nor "
+            "jax.experimental.shard_map")
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # check_rep mirrors the new API's check_vma: the legacy replication
+    # checker understands psum'd outputs (what replicated out_specs need
+    # proven), and disabling it also disables the spec prover that
+    # replicated scalars require — so it stays ON unless the caller
+    # explicitly opted out (the pallas-in-manual-axis case, where kernel
+    # outputs carry no replication annotation at all).
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma if check_vma is not None else True,
+                  auto=auto)
+
+
+def pcast_varying(x, axis_names):
+    """``jax.lax.pcast(x, axis_names, to="varying")`` where it exists —
+    the VMA cast the NEW shard_map's carry-type rules require for
+    device-invariant scan seeds.  The legacy API has no VMA system (and
+    runs here with check_rep off), so the identity is exact there."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, tuple(axis_names), to="varying")

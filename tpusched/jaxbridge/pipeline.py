@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import attention
+from . import attention, compat
 from .workload import (ModelConfig, Params, _block, _resolve_attn_fn,
                        _rmsnorm, cast_params_for_compute, init_params,
                        param_specs)
@@ -102,7 +102,7 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig, n_micro: int,
             return x, jnp.sum(auxs)
 
         def vary(x):
-            return jax.lax.pcast(x, ("pp",), to="varying")
+            return compat.pcast_varying(x, ("pp",))
 
         d = embed.shape[1]
         ticks = n_micro + pp - 1
@@ -143,7 +143,7 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig, n_micro: int,
                           jnp.mean(nll) + cfg.moe_aux_weight * aux_tot, 0.0)
         return jax.lax.psum(local, "pp")
 
-    sharded_loss = jax.shard_map(
+    sharded_loss = compat.shard_map(
         pipe_loss, mesh=mesh,
         in_specs=(P("pp"), P("pp"), P("pp"), P("pp"), P()),
         out_specs=P(),

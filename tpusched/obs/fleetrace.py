@@ -392,6 +392,12 @@ class FleetTraceRecorder:
         self._events_by_kind: Dict[str, int] = {}
         self._started_wall = 0.0
         self._started_mono = 0.0
+        # recorded-span bookkeeping (ISSUE 15 small fix): first/last event
+        # stamps of the CURRENT capture, so /debug/fleetrace states how
+        # much fleet time the trace spans — the number a virtual-time
+        # replay's compression ratio is quoted against
+        self._first_event_mono: Optional[float] = None
+        self._last_event_mono: Optional[float] = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -433,6 +439,8 @@ class FleetTraceRecorder:
             self._started_wall = wall
             self._started_mono = mono
             self._events_by_kind = {}
+            self._first_event_mono = None
+            self._last_event_mono = None
             # direct appends (not _enqueue): attach holds self._lock and
             # _enqueue's bookkeeping takes it too
             writer.append("capture-start", mono, wall,
@@ -516,6 +524,9 @@ class FleetTraceRecorder:
             with self._lock:
                 self._events_by_kind[kind] = \
                     self._events_by_kind.get(kind, 0) + 1
+                if self._first_event_mono is None:
+                    self._first_event_mono = mono
+                self._last_event_mono = mono
         # ok is False → dropped (counted by the writer); None → detached
         # mid-flight (not a loss)
 
@@ -675,6 +686,14 @@ class FleetTraceRecorder:
             out["started_wall"] = self._started_wall
             out["attached_for_s"] = round(
                 time.monotonic() - self._started_mono, 3)
+            # the virtual↔wall mapping stamp: how much FLEET time the
+            # capture spans so far — the denominator an operator (or a
+            # replay report) quotes trace-compression ratios against,
+            # and the at-a-glance tell between a live capture and a
+            # compressed evaluation of one
+            out["recorded_span_s"] = round(
+                self._last_event_mono - self._first_event_mono, 3) \
+                if self._first_event_mono is not None else 0.0
         return out
 
 

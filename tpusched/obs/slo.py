@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ..util.metrics import REGISTRY
@@ -50,13 +51,19 @@ slo_objective_seconds = REGISTRY.gauge_vec(
 
 class _Objective:
     __slots__ = ("name", "target_s", "count", "breaches", "window",
-                 "window_breaches", "samples")
+                 "window_breaches", "samples", "first_stamp", "last_stamp")
 
     def __init__(self, name: str, target_s: float, window: int = _WINDOW):
         self.name = name
         self.target_s = target_s
         self.count = 0
         self.breaches = 0
+        # first/last observation stamps on the TRACKER's clock: under a
+        # virtual-time replay these delimit the replayed interval, so
+        # summary() can say "this attainment describes N recorded hours"
+        # rather than the wall seconds the replay took
+        self.first_stamp: Optional[float] = None
+        self.last_stamp: Optional[float] = None
         # rolling breach window (booleans, with a running count so the
         # per-bind burn computation is O(1), not an O(window) sum) +
         # bounded sample window for exact quantiles — an always-on
@@ -82,7 +89,8 @@ class _Objective:
 class SLOTracker:
     def __init__(self, pod_e2e_s: float = DEFAULT_POD_E2E_S,
                  gang_bound_s: float = DEFAULT_GANG_BOUND_S,
-                 publish: bool = True, window: int = _WINDOW):
+                 publish: bool = True, window: int = _WINDOW,
+                 clock=time.time):
         """``publish=False`` builds a PRIVATE tracker (shadow schedulers:
         what-if planner, defrag trials): observations accumulate in the
         internal windows for summary() but never touch the process-global
@@ -90,9 +98,14 @@ class SLOTracker:
         not count into the production burn rate.  ``window`` sizes the
         rolling burn/quantile deques: bench installs one large enough to
         hold EVERY counted run's events so its summary quantiles and
-        breach counts describe the same window."""
+        breach counts describe the same window.  ``clock`` stamps each
+        observation (wall-flavored): a replay scheduler injects its
+        virtual clock so the summary's observed span is REPLAY time."""
         self._lock = threading.Lock()
         self._publish = publish
+        # wall-flavored by design: the stamps pair with the scheduler's
+        # wall latency clock (and become virtual wall under replay)
+        self._clock = clock
         # introspectable config (the scheduler re-installs the global
         # tracker only when its profile asks for DIFFERENT targets)
         self.targets = (pod_e2e_s, gang_bound_s)
@@ -110,6 +123,7 @@ class SLOTracker:
     def observe(self, objective: str, seconds: float) -> Optional[bool]:
         """Record one completion; returns whether it breached (None when
         the objective is disabled/unknown)."""
+        stamp = self._clock()
         with self._lock:
             obj = self._objectives.get(objective)
             if obj is None:
@@ -118,6 +132,9 @@ class SLOTracker:
             obj.count += 1
             if breached:
                 obj.breaches += 1
+            if obj.first_stamp is None:
+                obj.first_stamp = stamp
+            obj.last_stamp = stamp
             burn = obj.push(breached, seconds)
         if self._publish:
             slo_events.with_labels(objective).inc()
@@ -143,6 +160,11 @@ class SLOTracker:
                     "objective_s": obj.target_s,
                     "events": obj.count,
                     "breaches": obj.breaches,
+                    # the observed interval on the tracker's own clock —
+                    # a virtual-time replay reports the REPLAYED span
+                    # here, not the wall seconds it compressed into
+                    "span_s": round(obj.last_stamp - obj.first_stamp, 3)
+                    if obj.first_stamp is not None else 0.0,
                     "attainment": round(1.0 - (obj.breaches / obj.count), 4)
                     if obj.count else 1.0,
                     "burn_rate": round(

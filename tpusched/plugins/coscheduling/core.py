@@ -18,7 +18,6 @@ Deliberate fixes over the reference (SURVEY §2 quirks):
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional, Tuple
 
 from ...api.core import Pod
@@ -59,13 +58,24 @@ class PodGroupManager:
     def __init__(self, handle, schedule_timeout_s: float,
                  denied_pg_expiration_s: float,
                  pg_status_flush_s: float = 0.0):
+        from ...util.clock import WALL
         self.handle = handle
         self.schedule_timeout_s = schedule_timeout_s
         self.pg_informer = handle.informer_factory.podgroups()
         self.pod_informer = handle.informer_factory.pods()
         self.pod_informer.add_index(POD_GROUP_INDEX, pod_group_index_key)
-        self.last_denied_pg = TTLCache(denied_pg_expiration_s)
-        self.permitted_pg = TTLCache(schedule_timeout_s)
+        # gate clocks route through the scheduler's injected handle clock
+        # (util/clock): the denial window is THE retry gate a Gavel-style
+        # policy replay must reproduce — arming its expiry lets a
+        # virtual-time replay fire the lapse at its recorded-timeline
+        # instant instead of zeroing the window (sim/replay.py)
+        clk = getattr(handle, "clock_handle", None) or WALL
+        self._clock_handle = clk
+        self._now = clk.now
+        self.last_denied_pg = TTLCache(
+            denied_pg_expiration_s, clock=self._now,
+            arm=lambda exp: clk.arm("denied-window", exp))
+        self.permitted_pg = TTLCache(schedule_timeout_s, clock=self._now)
         # PG status patch coalescing (ISSUE 14 satellite): gang full-name
         # → increments not yet patched.  Partial-progress increments within
         # the flush window fold into one patch per gang (a gang's bind
@@ -76,20 +86,22 @@ class PodGroupManager:
         self._status_flush_s = max(0.0, pg_status_flush_s)
         self._status_lock = threading.Lock()
         self._status_pending: dict = {}
-        self._status_last_flush = time.monotonic()
+        self._status_last_flush = self._now()
         # gang → cumulative increments noted since the gang was first
         # seen (NOT since the last flush): quorum-completion detection
         # must not depend on the informer's view of status.scheduled,
         # which lags its own patches over a real API transport.  TTL'd
         # like the synthesized-PG cache; pruned at quorum flush.
-        self._status_seen = TTLCache(max(3600.0, 60 * schedule_timeout_s))
+        self._status_seen = TTLCache(max(3600.0, 60 * schedule_timeout_s),
+                                     clock=self._now)
         # KEP-2 lightweight gangs: one synthesized PodGroup instance per
         # "ns/name", created on first sight. Sharing the instance gives every
         # member the same QueueSort timestamp (gangs drain contiguously),
         # keeps the hot queue comparator allocation-free, and lets post_bind
         # track status/metrics for groups that have no CR to patch. TTL'd so
         # abandoned CRD-less gang names don't accumulate forever.
-        self._synthesized_pgs = TTLCache(max(3600.0, 60 * schedule_timeout_s))
+        self._synthesized_pgs = TTLCache(max(3600.0, 60 * schedule_timeout_s),
+                                         clock=self._now)
         self._synthesized_status_lock = threading.Lock()
 
     # -- lookups --------------------------------------------------------------
@@ -149,7 +161,7 @@ class PodGroupManager:
         # residue drain for the status batcher: a retrying sibling's cycle
         # is a natural, event-driven flush point (no timer thread; cheap
         # no-op while nothing is pending)
-        self._flush_status_if_due()
+        self.flush_status_if_due()
         full, pg = self.get_pod_group(pod)
         if pg is None:
             return None
@@ -250,11 +262,17 @@ class PodGroupManager:
         if self._status_flush_s <= 0:
             self._patch_status(full, pg, pod, 1)
             return
-        mono = time.monotonic()
+        mono = self._now()
         with self._status_lock:
             pending = self._status_pending.get(full)
             if pending is None:
                 pending = self._status_pending[full] = [0, pod]
+                # first increment of a fresh batch: arm the flush horizon
+                # so a virtual-time replay drains the window on schedule
+                # (the residue drains via pre_filter / on_clock_tick)
+                self._clock_handle.arm(
+                    "pg-status-flush",
+                    self._status_last_flush + self._status_flush_s)
             pending[0] += 1
             pending[1] = pod              # a live member for the sweep
             # quorum completion always flushes INLINE: PG_SCHEDULED (and
@@ -299,16 +317,16 @@ class PodGroupManager:
         with self._status_lock:
             due = [(f, p[0], p[1]) for f, p in self._status_pending.items()]
             self._status_pending.clear()
-            self._status_last_flush = time.monotonic()
+            self._status_last_flush = self._now()
         for f, inc, member in due:
             _, g = self.get_pod_group(member)
             if g is not None:
                 self._patch_status(f, g, member, inc)
 
-    def _flush_status_if_due(self) -> None:
+    def flush_status_if_due(self) -> None:
         if self._status_flush_s <= 0 or not self._status_pending:
             return
-        if time.monotonic() - self._status_last_flush \
+        if self._now() - self._status_last_flush \
                 >= self._status_flush_s:
             self.flush_status()
 

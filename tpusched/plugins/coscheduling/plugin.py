@@ -51,6 +51,13 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PostFilterPlugin,
         a stopped scheduler never swallows partial gang progress."""
         self.pg_mgr.flush_status()
 
+    def on_clock_tick(self) -> None:
+        """Timer hook (Scheduler.run_timers_once): the virtual-time replay
+        driver fires this after advancing the clock so the PG-status flush
+        window drains at its armed deadline, not only on the next
+        pre_filter cycle."""
+        self.pg_mgr.flush_status_if_due()
+
     @classmethod
     def new(cls, args, handle) -> "Coscheduling":
         return cls(args, handle)

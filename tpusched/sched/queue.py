@@ -155,8 +155,17 @@ class SchedulingQueue:
                  initial_backoff_s: Optional[float] = None,
                  max_backoff_s: Optional[float] = None,
                  arrival_cb: Optional[Callable[[], None]] = None,
-                 unschedulable_flush_s: Optional[float] = None):
+                 unschedulable_flush_s: Optional[float] = None,
+                 handle_clock=None):
+        from ..util.clock import WALL
         self._clock = clock
+        # the full Clock object (util/clock): every backoff expiry and
+        # unschedulableQ flush horizon is ARMED on it, so a virtual-time
+        # replay jumps straight to the release instant instead of zeroing
+        # the window.  Queue timestamps are wall-flavored (they feed the
+        # scheduler's wall latency math) — hence wall=True on the arms.
+        self._handle_clock = handle_clock if handle_clock is not None \
+            else WALL
         # throughput telemetry hook (obs/throughput.ThroughputTelemetry
         # .on_arrival): fired once per NEW pending pod entering the queue —
         # requeues/updates/activations are not arrivals
@@ -317,9 +326,14 @@ class SchedulingQueue:
                     heapq.heappush(self._backoff,
                                    (expiry, next(self._backoff_seq), info))
                     self._bk_add_locked(key)
+                    self._handle_clock.arm("backoff", expiry, wall=True)
                 self._lock.notify_all()
                 return
             self._unschedulable[key] = info
+            if self._flush_s > 0:
+                self._handle_clock.arm("unsched-flush",
+                                       info.timestamp + self._flush_s,
+                                       wall=True)
 
     def push_active(self, info: QueuedPodInfo) -> None:
         """Inject an in-flight QueuedPodInfo straight into activeQ
@@ -362,6 +376,8 @@ class SchedulingQueue:
                                (info.timestamp + delay,
                                 next(self._backoff_seq), info))
                 self._bk_add_locked(key)
+                self._handle_clock.arm("backoff", info.timestamp + delay,
+                                       wall=True)
                 self._lock.notify_all()
             return
         self.add_unschedulable_if_not_present(info)
@@ -450,6 +466,7 @@ class SchedulingQueue:
                 heapq.heappush(self._backoff,
                                (expiry, next(self._backoff_seq), info))
                 self._bk_add_locked(info.pod.key)
+                self._handle_clock.arm("backoff", expiry, wall=True)
         if moved:
             self._lock.notify_all()
 
@@ -506,6 +523,9 @@ class SchedulingQueue:
         return info
 
     def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+        # tpulint: disable=monotonic-clock — the pop timeout bounds REAL
+        # blocking of the consumer thread (live surface), not a
+        # scheduling gate; virtual replay drives pop(timeout=0)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
@@ -525,6 +545,8 @@ class SchedulingQueue:
                 if self._backoff:
                     wait = min(wait, max(0.0, self._backoff[0][0] - self._clock()))
                 if deadline is not None:
+                    # tpulint: disable=monotonic-clock — same real-wait
+                    # bound as the deadline computation above
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
@@ -644,8 +666,12 @@ class ShardedQueues:
         arrives or the queues close."""
         if lane is not None:
             return self._queues[lane].pop(timeout=timeout)
-        deadline = None if timeout is None \
-            else time.monotonic() + timeout
+        if timeout is None:
+            deadline = None
+        else:
+            # tpulint: disable=monotonic-clock — real-wait bound for the
+            # compatibility polling pop (live surface, not a gate)
+            deadline = time.monotonic() + timeout
         while True:
             for name in self._order:
                 info = self._queues[name].pop(timeout=0)
@@ -653,6 +679,7 @@ class ShardedQueues:
                     return info
             if self._closed:
                 return None
+            # tpulint: disable=monotonic-clock — same real-wait bound
             if deadline is not None and time.monotonic() >= deadline:
                 return None
             time.sleep(0.005)

@@ -133,12 +133,12 @@ class _DegradedMode:
     ``tpusched_degraded_mode`` gauge."""
 
     def __init__(self, threshold: int, initial_pause_s: float,
-                 max_pause_s: float, publish=None, clock=time.monotonic):
+                 max_pause_s: float, publish=None, clock=None):
         self._threshold = threshold
         self._initial = initial_pause_s
         self._max = max_pause_s
         self._publish = publish or (lambda component, state: None)
-        self._clock = clock
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._consecutive = 0
         self._pause = initial_pause_s
@@ -252,11 +252,13 @@ class _StuckGangWatchdog:
     snapshot access needs no extra locking."""
 
     def __init__(self, scheduler: "Scheduler", stuck_after_s: float,
-                 sweep_interval_s: float, clock=time.monotonic):
+                 sweep_interval_s: float, clock=None):
+        from ..util.clock import as_clock
         self._sched = scheduler
         self._after = stuck_after_s
         self._interval = max(0.05, sweep_interval_s)
-        self._clock = clock
+        self._clock_handle = as_clock(clock)
+        self._clock = self._clock_handle.now
         self._last_sweep = 0.0
         # gang → [signature, since, last_fired, last_seen]
         self._state: Dict[str, list] = {}
@@ -285,6 +287,15 @@ class _StuckGangWatchdog:
             gang = pod_group_full_name(pod)
             if gang:
                 pending.setdefault(gang, []).append(pod)
+
+        # the watchdog is itself a wall-clock retry gate (its forced
+        # reactivations give parked members extra retries): arm the next
+        # sweep whenever it has live gangs to watch, so a virtual-time
+        # replay fires sweeps at deterministic instants — and an idle
+        # fleet arms NOTHING, letting the replay driver jump a recorded
+        # quiet hour in one hop
+        if pending or waiting or self._state:
+            self._clock_handle.arm("watchdog", now + self._interval)
 
         snapshot = sched.cache.snapshot()
         live = set(pending) | set(waiting)
@@ -428,8 +439,12 @@ class _BindingPool:
         locking.verify_point("bindpool.shutdown")
         for _ in self._threads:
             self._q.put(None)
+        # tpulint: disable=monotonic-clock — shutdown join bound on REAL
+        # worker threads (live surface): a virtual clock never moves while
+        # a wedged Bind blocks, so the drain budget must be wall time
         deadline = time.monotonic() + timeout
         for t in self._threads:
+            # tpulint: disable=monotonic-clock — same real join bound
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         self._abort_queued()
 
@@ -449,7 +464,15 @@ class Scheduler:
         dilute the production burn rate.  Shadows get private throwaway
         instances instead."""
         self.api = api
-        self.clock = clock
+        # One injectable time substrate (util/clock): ``clock`` accepts the
+        # legacy wall callable (tests inject fakes), a full Clock, or None.
+        # clock_handle is the structured object — wall()/now() reads plus
+        # the deadline registry every scheduler gate arms its expiry on —
+        # and self.clock stays the wall-flavored callable the existing
+        # latency math reads, so callable-injection sites are unchanged.
+        from ..util.clock import as_clock
+        self.clock_handle = as_clock(clock)
+        self.clock = self.clock_handle.wall
         # Scheduling flight recorder (tpusched/trace): every cycle emits a
         # span tree into the process-global ring unless a private recorder
         # is injected (bench/test isolation).  Shadows get a private ring:
@@ -477,9 +500,12 @@ class Scheduler:
         # profile must not reset the rolling windows); shadows observe
         # into a private tracker that dies with them
         if not telemetry:
+            # shadow trackers observe on THIS scheduler's clock: a
+            # virtual-time replay's attainment windows then describe
+            # replay time, so a replayed day reports real attainment
             self._slo = obs_mod.SLOTracker(profile.slo_pod_e2e_s,
                                            profile.slo_gang_bound_s,
-                                           publish=False)
+                                           publish=False, clock=self.clock)
         else:
             if obs_mod.default_slo().targets != (profile.slo_pod_e2e_s,
                                                  profile.slo_gang_bound_s):
@@ -498,17 +524,19 @@ class Scheduler:
         self._degraded = _DegradedMode(
             profile.degraded_threshold, profile.degraded_initial_pause_s,
             profile.degraded_max_pause_s,
-            publish=lambda comp, state: self.recorder.set_health(comp, state))
+            publish=lambda comp, state: self.recorder.set_health(comp, state),
+            clock=self.clock_handle.now)
         self.clientset = Clientset(
             api, on_retry_exhausted=self._degraded.on_retry_exhausted,
             on_success=self._degraded.on_success)
         self.informer_factory = InformerFactory(api)
-        self.cache = Cache(clock)
+        self.cache = Cache(self.clock)
         self.profile = profile
 
         self._fw: Optional[Framework] = None
         self.handle = Handle(self.clientset, self.informer_factory,
-                             lambda: self._fw, clock)
+                             lambda: self._fw, self.clock,
+                             clock_handle=self.clock_handle)
         # shadow marker for plugins that feed process-global telemetry
         # (Coscheduling's gang-bound SLO clock checks it): a trial bind's
         # latency must not count into the production burn rate
@@ -566,7 +594,10 @@ class Scheduler:
             self._goodput = obs_mod.ensure_goodput(api)
         else:
             self._fleet = obs_mod.FleetTraceRecorder()
-            self._goodput = obs_mod.GoodputAggregator(publish=False)
+            # replay-time EWMA stamps: the shadow aggregator folds matrix
+            # cells on this scheduler's clock, not the host's wall
+            self._goodput = obs_mod.GoodputAggregator(publish=False,
+                                                      clock=self.clock)
         # Sharded dispatch core (sched/shards.py, ROADMAP item 1): N
         # per-pool dispatch lanes plus a serialized global lane, each
         # lane a full SchedulingQueue behind one routed facade.  shards=1
@@ -577,6 +608,7 @@ class Scheduler:
         from .shards import ESCALATION_TTL_S
         self._router = ShardRouter(
             self._shards_n, pg_lookup=pg_informer.get,
+            clock=self.clock_handle,
             escalation_ttl_s=(profile.escalation_ttl_s
                               if profile.escalation_ttl_s is not None
                               else ESCALATION_TTL_S),
@@ -601,11 +633,12 @@ class Scheduler:
 
         def make_lane_queue() -> SchedulingQueue:
             return SchedulingQueue(
-                self._fw.less, cluster_event_map, clock,
+                self._fw.less, cluster_event_map, self.clock,
                 initial_backoff_s=profile.pod_initial_backoff_s,
                 max_backoff_s=profile.pod_max_backoff_s,
                 arrival_cb=self._throughput.on_arrival,
-                unschedulable_flush_s=profile.unschedulable_flush_s)
+                unschedulable_flush_s=profile.unschedulable_flush_s,
+                handle_clock=self.clock_handle)
 
         if self._sharded:
             self._lanes = [shard_lane(i) for i in range(self._shards_n)] \
@@ -615,8 +648,9 @@ class Scheduler:
         else:
             self._lanes = []
             self.queue = make_lane_queue()
-        self._shard_stats = ShardStats(self._lanes) if self._sharded \
-            else None
+        self._shard_stats = ShardStats(self._lanes,
+                                       clock=self.clock_handle.now) \
+            if self._sharded else None
         # upstream pending_pods{queue="active|backoff|unschedulable"} gauges,
         # computed at scrape time from the live queue. weakref: the global
         # registry must not keep a stopped scheduler (and everything it
@@ -703,6 +737,12 @@ class Scheduler:
 
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
+        # optional per-cycle tap for replay drivers: called
+        # (pod_key, attempt_ordinal, wall_now) at the top of every real
+        # scheduling cycle — the replay eval plane derives queueing delay
+        # (arrival → first attempt) and the per-pod retry-ordinal record
+        # from it.  None (the default) costs one attribute read per cycle.
+        self.cycle_observer = None
         # cycle liveness counters (plain ints, GIL-atomic): a popped pod
         # mid-cycle is invisible to queue depths and (until it binds) to
         # the store — the replay driver's lockstep barrier reads these to
@@ -743,7 +783,7 @@ class Scheduler:
         # belt-and-braces, swept between cycles on the scheduling thread
         self._watchdog = _StuckGangWatchdog(
             self, profile.stuck_gang_after_s,
-            profile.stuck_gang_sweep_interval_s)
+            profile.stuck_gang_sweep_interval_s, clock=self.clock_handle)
         # capacity & fragmentation telemetry: a scrape-time collector over
         # this scheduler's informers + cache (unregistered at stop()).
         # Shadows register none — a trial's fork must not publish
@@ -990,11 +1030,14 @@ class Scheduler:
         self._fw.iterate_over_waiting_pods(
             lambda wp: wp.reject("", "scheduler shutting down"))
         if self._sharded:
+            # tpulint: disable=monotonic-clock — stop() join bound on
+            # REAL dispatch threads (live surface, not a scheduling gate)
             deadline = time.monotonic() + 5.0
             for ctx in self._contexts.values():
                 if ctx.thread is not None:
-                    ctx.thread.join(timeout=max(
-                        0.1, deadline - time.monotonic()))
+                    # tpulint: disable=monotonic-clock — same join bound
+                    remaining = deadline - time.monotonic()
+                    ctx.thread.join(timeout=max(0.1, remaining))
         elif self._sched_thread:
             self._sched_thread.join(timeout=5)
         self._bind_pool.shutdown(timeout=5.0)
@@ -1019,6 +1062,9 @@ class Scheduler:
                 # exactly one lane (global) runs housekeeping; the sweep's
                 # state was never built for concurrent writers.
                 self._watchdog.sweep()
+                # tpulint: disable=monotonic-clock — health-publish pacing
+                # of the REAL housekeeping thread (live surface); the
+                # replay driver never runs this loop
                 now = time.monotonic()
                 if now - last_health >= 1.0:
                     last_health = now
@@ -1096,6 +1142,28 @@ class Scheduler:
                 else:
                     self.queue.cycle_done()
         return drove
+
+    def run_timers_once(self) -> int:
+        """Fire every due time-based gate NOW, on the calling thread — the
+        virtual-time replay driver's companion to ``drive_dispatch_once``:
+        after jumping the clock to an armed deadline it calls this so the
+        gate the deadline belongs to actually lapses (permit barriers
+        expire, the stuck-gang watchdog sweeps, degraded-mode windows
+        close, plugin flush windows drain).  Everything here is idempotent
+        and cheap when nothing is due; the queue-side gates (backoff
+        release, unschedulableQ flush) need no call — they fire inside the
+        next ``pop()``.  Returns the number of permit barriers that
+        expired: their failure paths run ASYNC on the bind pool, so a
+        replay driver must fully settle when this is nonzero."""
+        now = self.clock_handle.now()
+        expired = self._fw.expire_due_permits(now)
+        self._watchdog.sweep()
+        self._degraded.maybe_expire()
+        for plugin in self._fw.plugins.values():
+            tick = getattr(plugin, "on_clock_tick", None)
+            if tick is not None:
+                tick()
+        return expired
 
     def _publish_shard_health(self) -> None:
         """health.shards for /debug/flightrecorder: per-lane cycle/bind/
@@ -1189,6 +1257,8 @@ class Scheduler:
                 self.queue.push_active(info, target)
                 return
         start = self.clock()
+        if self.cycle_observer is not None:
+            self.cycle_observer(pod.key, getattr(info, "attempts", 0), start)
         # global counters are live-fleet data: shadow trials (what-if,
         # defrag) must not inflate them with simulated cycles
         if self._telemetry:
@@ -1452,7 +1522,7 @@ class Scheduler:
             # dispatch timestamp: the gang-rollback registry compares it
             # against abort times so only tasks of the aborted burst (not
             # later retry cycles) are rolled back
-            dispatch_ts = time.monotonic()
+            dispatch_ts = self.clock_handle.now()
             try:
                 self._bind_pool.submit(self._finish_binding,
                                        self._abort_binding, permit_status,
@@ -2159,7 +2229,7 @@ class Scheduler:
         with self._gang_aborts_lock:
             entry = self._gang_aborts.get(gang)
             if entry is not None \
-                    and time.monotonic() - entry[0] > _GANG_ABORT_TTL_S:
+                    and self.clock_handle.now() - entry[0] > _GANG_ABORT_TTL_S:
                 # expired entries are pruned HERE too (not only when the
                 # next rollback fires), so the registry really does hold
                 # only gangs that failed a bind within the TTL
@@ -2180,7 +2250,7 @@ class Scheduler:
         Members already bound stay bound — they count toward quorum when
         the rolled-back members retry through backoff, so the gang
         completes once the faults clear instead of wedging half-bound."""
-        now = time.monotonic()
+        now = self.clock_handle.now()
         with self._gang_aborts_lock:
             for g, ent in list(self._gang_aborts.items()):
                 if now - ent[0] > _GANG_ABORT_TTL_S:

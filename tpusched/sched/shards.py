@@ -61,7 +61,6 @@ from __future__ import annotations
 
 import collections
 import threading
-import time
 import zlib
 from typing import Callable, Dict, List, Optional
 
@@ -109,12 +108,18 @@ class ShardRouter:
 
     def __init__(self, shards: int,
                  pg_lookup: Optional[Callable[[str], object]] = None,
-                 clock=time.monotonic,
+                 clock=None,
                  escalation_ttl_s: float = ESCALATION_TTL_S,
                  quota_serialize: bool = False):
+        from ..util.clock import as_clock
         self.shards = shards
         self._pg_lookup = pg_lookup or (lambda key: None)
-        self._clock = clock
+        # escalation TTLs are scheduler gates: route them through the
+        # injected handle clock (util/clock) so a virtual-time replay can
+        # jump to the lapse — the lapse re-routes the unit home, which is
+        # exactly the retry dynamic zeroed-gate replay used to erase
+        self._clock_handle = as_clock(clock)
+        self._clock = self._clock_handle.now
         self._ttl = escalation_ttl_s
         self._quota_serialize = quota_serialize
         self._lock = threading.Lock()
@@ -153,6 +158,7 @@ class ShardRouter:
         Returns the unit key."""
         unit = unit_key_of(pod)
         now = self._clock()
+        self._clock_handle.arm("escalation", now + self._ttl)
         with self._lock:
             self._escalated[unit] = now + self._ttl
             self._escalated.move_to_end(unit)
@@ -255,9 +261,10 @@ class ShardStats:
 
     __slots__ = ("_lock", "_lanes", "_clock")
 
-    def __init__(self, lanes: List[str], clock=time.monotonic):
+    def __init__(self, lanes: List[str], clock=None):
+        from ..util.clock import as_clock
         self._lock = threading.Lock()
-        self._clock = clock
+        self._clock = as_clock(clock).now
         self._lanes: Dict[str, Dict[str, float]] = {
             lane: {"cycles": 0, "binds": 0, "conflicts": 0,
                    "quota_conflicts": 0, "escalations": 0,

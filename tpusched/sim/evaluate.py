@@ -1,0 +1,288 @@
+"""The policy-evaluation plane over recorded traces (ISSUE 15).
+
+``evaluate_arms`` replays the SAME recorded trace once per configuration
+arm — each on virtual time, so a recorded day costs wall seconds and
+every retry gate fires deterministically — and renders an attributed
+two-arm (or N-arm) comparison of scheduling quality:
+
+- **JCT** (pod arrival → bind, on replay time: p50/p99 + SLO attainment)
+- **queueing delay** (arrival → first scheduling attempt)
+- **SLO attainment / burn** from the shadow scheduler's own tracker
+- **utilization + fragmentation trajectory** per pool (mean in-flight
+  chip demand over capacity; mean and final 1 − largest/free)
+- **goodput** — placements priced through the measured
+  workload×generation throughput matrix (PR 10): a pod landing on a
+  generation its workload runs faster on scores higher, which is exactly
+  the "fits, but on the slow generation" signal a goodput-aware policy
+  is supposed to move
+
+plus the raw placement diff between arms.  This is the substrate ROADMAP
+item 3's policy rounds, item 4's defrag controller and item 5's
+autoscaler evaluate against; ``python -m tpusched.cmd.trace evaluate``
+is the operator surface (exit-code contract: 0 comparable / 1 regression
+vs budget / 2 usage).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..obs.fleetrace import FleetTrace, load_trace
+from ..obs.goodput import (GoodputMatrix, matrix_from_trace, pod_chips,
+                           workload_fingerprint_of)
+from .replay import diff_placements, run_replay
+
+__all__ = ["ArmSpec", "evaluate_arms", "goodput_estimate",
+           "compare_arms"]
+
+
+@dataclasses.dataclass
+class ArmSpec:
+    """One configuration arm: a TpuSchedulerConfiguration YAML (None =
+    the canned default profile), the profile to pick from it, and a
+    display name."""
+    name: str
+    config_path: Optional[str] = None
+    scheduler_name: Optional[str] = None
+
+
+def _mean(xs: List[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def _node_generations(trace: FleetTrace) -> Dict[str, str]:
+    """node name → accelerator generation label, from the snapshot plus
+    streamed node events (same join matrix_from_trace performs)."""
+    from ..api.topology import LABEL_ACCELERATOR
+    from ..apiserver import server as srv
+    from ..apiserver.persistence import KIND_CLASSES, decode_object
+    gen: Dict[str, str] = {}
+    for node in trace.objects.get(srv.NODES, ()):
+        gen[node.meta.name] = node.meta.labels.get(LABEL_ACCELERATOR, "")
+    for e in trace.events:
+        if e.get("kind") in ("node-add", "node-update") \
+                and e.get("object") is not None:
+            node = decode_object(KIND_CLASSES[srv.NODES], e["object"])
+            gen[node.meta.name] = node.meta.labels.get(LABEL_ACCELERATOR,
+                                                       "")
+    return gen
+
+
+def _trace_pods(trace: FleetTrace) -> Dict[str, Any]:
+    """pod key → decoded Pod (snapshot + arrivals) and its PodGroup."""
+    from ..api.scheduling import pod_group_full_name
+    from ..apiserver import server as srv
+    from ..apiserver.persistence import KIND_CLASSES, decode_object
+    pods: Dict[str, Any] = {p.meta.key: p
+                            for p in trace.objects.get(srv.PODS, ())}
+    groups: Dict[str, Any] = {g.meta.key: g
+                              for g in trace.objects.get(srv.POD_GROUPS,
+                                                         ())}
+    for e in trace.events:
+        if e.get("kind") == "pod-arrival" and e.get("object") is not None:
+            p = decode_object(KIND_CLASSES[srv.PODS], e["object"])
+            pods[p.meta.key] = p
+        elif e.get("kind") in ("podgroup-add", "podgroup-update") \
+                and e.get("object") is not None:
+            g = decode_object(KIND_CLASSES[srv.POD_GROUPS], e["object"])
+            groups[g.meta.key] = g
+    out: Dict[str, Any] = {}
+    for key, pod in pods.items():
+        pg = groups.get(pod_group_full_name(pod) or "")
+        out[key] = (pod, pg)
+    return out
+
+
+def goodput_estimate(trace: FleetTrace, placements: List[List[str]],
+                     matrix: Optional[GoodputMatrix] = None,
+                     generations: Optional[Dict[str, str]] = None,
+                     pods: Optional[Dict[str, Any]] = None) -> dict:
+    """Price a placement sequence through the measured matrix: for each
+    (pod, node), chips × measured goodput-per-chip of (pod's workload
+    fingerprint, node's generation).  Pods whose cell was never measured
+    are counted (``unpriced``) but contribute nothing — an estimate must
+    not invent throughput for hardware nobody measured.  Returns zeros
+    (``cells: 0``) when the trace carries no goodput reports at all.
+
+    ``generations``/``pods``: the arm-invariant trace joins — pass them
+    (``evaluate_arms`` does) so an N-arm evaluation decodes the event
+    stream once, not once per arm."""
+    if matrix is None:
+        matrix = matrix_from_trace(trace)
+    if matrix.size() == 0:
+        return {"cells": 0, "total_units_per_s": 0.0, "priced_pods": 0,
+                "unpriced_pods": len(placements)}
+    if generations is None:
+        generations = _node_generations(trace)
+    if pods is None:
+        pods = _trace_pods(trace)
+    total = 0.0
+    priced = unpriced = 0
+    for pod_key, node in placements:
+        entry = pods.get(pod_key)
+        if entry is None:
+            unpriced += 1
+            continue
+        pod, pg = entry
+        per_chip = matrix.peek(workload_fingerprint_of(pod, pg) or
+                               "unlabeled", generations.get(node, ""))
+        chips = pod_chips(pod)
+        if per_chip is None or chips <= 0:
+            unpriced += 1
+            continue
+        total += per_chip * chips
+        priced += 1
+    return {"cells": matrix.size(),
+            "total_units_per_s": round(total, 4),
+            "priced_pods": priced, "unpriced_pods": unpriced}
+
+
+def _utilization_summary(report: dict) -> dict:
+    """Mean fleet utilization + fragmentation trajectory digest from the
+    replay's pool samples (each sample: in-flight chips per pool, and —
+    when topologies exist — the free/capacity/largest triple)."""
+    samples = report.get("pool_utilization") or []
+    util: List[float] = []
+    frag_means: List[float] = []
+    final_frag: Dict[str, float] = {}
+    for s in samples:
+        frag = s.get("frag") or {}
+        cap = sum(f.get("capacity", 0) for f in frag.values())
+        if cap > 0:
+            # numerator restricted to the pools the denominator covers:
+            # on a mixed fleet (some pools without a TpuTopology CR)
+            # counting topology-less in-flight chips against
+            # topology-only capacity would invent utilization
+            used = sum(c for p, c in (s.get("pools") or {}).items()
+                       if p in frag)
+            util.append(min(1.0, used / cap))
+        per_pool = [f.get("fragmentation", 0.0) for f in frag.values()]
+        if per_pool:
+            frag_means.append(_mean(per_pool))
+            final_frag = {p: f.get("fragmentation", 0.0)
+                          for p, f in frag.items()}
+    return {"samples": len(samples),
+            "mean_utilization": round(_mean(util), 4) if util else None,
+            "mean_fragmentation": round(_mean(frag_means), 4)
+            if frag_means else None,
+            "final_fragmentation": final_frag or None}
+
+
+def summarize_arm(trace: FleetTrace, report: dict,
+                  matrix: Optional[GoodputMatrix] = None,
+                  generations: Optional[Dict[str, str]] = None,
+                  pods: Optional[Dict[str, Any]] = None) -> dict:
+    """One arm's scheduling-quality digest from its replay report."""
+    slo = report.get("slo") or {}
+    return {
+        "binds": report.get("binds", 0),
+        "unbound": len(report.get("unbound", ())),
+        "jct": report.get("pod_e2e"),
+        "queueing_delay": report.get("queueing_delay"),
+        "slo": slo,
+        "retried_pods": len(report.get("retries", {})),
+        "utilization": _utilization_summary(report),
+        "goodput": goodput_estimate(trace,
+                                    report.get("placements", []),
+                                    matrix=matrix,
+                                    generations=generations, pods=pods),
+        "virtual_time": report.get("virtual_time"),
+    }
+
+
+def _pct_delta(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    """(b - a) / a as a percentage; None when undefined."""
+    if a is None or b is None or a == 0:
+        return None
+    return round(100.0 * (b - a) / a, 2)
+
+
+def compare_arms(base: dict, cand: dict, placement_diff: dict) -> dict:
+    """The attributed two-arm comparison: per-metric deltas (positive =
+    candidate larger) plus the raw placement divergence."""
+    b_jct, c_jct = base.get("jct") or {}, cand.get("jct") or {}
+    b_qd, c_qd = (base.get("queueing_delay") or {},
+                  cand.get("queueing_delay") or {})
+    b_slo = (base.get("slo") or {}).get("pod_e2e") or {}
+    c_slo = (cand.get("slo") or {}).get("pod_e2e") or {}
+    b_gp, c_gp = base.get("goodput") or {}, cand.get("goodput") or {}
+    return {
+        "jct_p50_pct": _pct_delta(b_jct.get("p50_s"), c_jct.get("p50_s")),
+        "jct_p99_pct": _pct_delta(b_jct.get("p99_s"), c_jct.get("p99_s")),
+        "queueing_p50_pct": _pct_delta(b_qd.get("p50_s"),
+                                       c_qd.get("p50_s")),
+        "queueing_p99_pct": _pct_delta(b_qd.get("p99_s"),
+                                       c_qd.get("p99_s")),
+        "attainment_delta": round(
+            (c_jct.get("attainment") or 0.0)
+            - (b_jct.get("attainment") or 0.0), 4),
+        "slo_attainment_delta": round(
+            (c_slo.get("attainment") or 0.0)
+            - (b_slo.get("attainment") or 0.0), 4)
+        if b_slo or c_slo else None,
+        "binds_delta": cand.get("binds", 0) - base.get("binds", 0),
+        "unbound_delta": cand.get("unbound", 0) - base.get("unbound", 0),
+        "goodput_pct": _pct_delta(b_gp.get("total_units_per_s"),
+                                  c_gp.get("total_units_per_s")),
+        "mean_utilization_delta": round(
+            (cand["utilization"].get("mean_utilization") or 0.0)
+            - (base["utilization"].get("mean_utilization") or 0.0), 4),
+        "mean_fragmentation_delta": round(
+            (cand["utilization"].get("mean_fragmentation") or 0.0)
+            - (base["utilization"].get("mean_fragmentation") or 0.0), 4),
+        "placements_moved": placement_diff.get("moved", 0),
+        "only_in_base": len(placement_diff.get("only_in_a", ())),
+        "only_in_candidate": len(placement_diff.get("only_in_b", ())),
+        "identical_placements": placement_diff.get("identical", False),
+    }
+
+
+def evaluate_arms(trace_dir: str, arms: List[ArmSpec], *,
+                  trace: Optional[FleetTrace] = None,
+                  legacy_zeroed_gates: bool = False,
+                  event_timeout_s: float = 15.0,
+                  drain_timeout_s: float = 120.0) -> dict:
+    """Replay every arm over the same trace (virtual time) and compare
+    each later arm against the FIRST (the base).  Returns the full
+    evaluation document ``cmd.trace evaluate`` renders."""
+    if trace is None:
+        trace = load_trace(trace_dir)
+    # the arm-invariant trace joins, computed once for all arms: the
+    # matrix, the node→generation map and the pod/PodGroup decode
+    matrix = matrix_from_trace(trace)
+    generations = _node_generations(trace) if matrix.size() else {}
+    pods = _trace_pods(trace) if matrix.size() else {}
+    arm_docs: List[dict] = []
+    reports: List[dict] = []
+    for arm in arms:
+        report = run_replay(
+            trace_dir, trace=trace, config_path=arm.config_path,
+            scheduler_name=arm.scheduler_name,
+            legacy_zeroed_gates=legacy_zeroed_gates,
+            event_timeout_s=event_timeout_s,
+            drain_timeout_s=drain_timeout_s).to_dict()
+        reports.append(report)
+        arm_docs.append({"name": arm.name,
+                         "config": arm.config_path,
+                         "scheduler_name": report.get("scheduler_name"),
+                         "summary": summarize_arm(
+                             trace, report, matrix=matrix,
+                             generations=generations, pods=pods)})
+    comparisons = []
+    for i in range(1, len(arm_docs)):
+        diff = diff_placements(reports[0], reports[i])
+        comparisons.append({
+            "base": arm_docs[0]["name"],
+            "candidate": arm_docs[i]["name"],
+            "deltas": compare_arms(arm_docs[0]["summary"],
+                                   arm_docs[i]["summary"], diff),
+        })
+    return {
+        "trace": trace_dir,
+        "workload_fingerprint": reports[0].get("workload_fingerprint")
+        if reports else "",
+        "recorded_span_s": round(trace.window_s(), 3),
+        "matrix_cells": matrix.size(),
+        "arms": arm_docs,
+        "comparisons": comparisons,
+    }

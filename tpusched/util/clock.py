@@ -1,0 +1,267 @@
+"""Injectable scheduler clock: one process-wide time substrate.
+
+Every scheduler-owned gate — pod backoff release, Coscheduling denial
+window, permit-barrier deadline, stuck-gang watchdog sweep, shard
+escalation TTL, PG-status flush window, unschedulableQ safety-net flush —
+used to read wall time ad hoc (``time.monotonic()`` / an injected bare
+callable).  That made recorded-trace replay a choice between two bad
+modes: *timed* replay re-pays the recorded hours in wall seconds, and
+*zeroed-gate* lockstep (PR 9) deletes exactly the retry/timeout dynamics
+a policy study needs to measure.
+
+This module is the third mode's substrate.  A ``Clock`` carries two
+reads (``now()`` monotonic-flavored, ``wall()`` epoch-flavored — the two
+timebases the codebase already mixes deliberately) plus a *deadline
+registry*: gate sites ``arm()`` the absolute instant their window
+lapses.  ``WallClock`` is the zero-overhead production default — reads
+delegate straight to ``time``, ``arm()`` is a no-op (real time advances
+by itself).  ``VirtualClock`` is a discrete-event engine: time moves
+only when the owner advances it, and when the replay driver finds the
+system quiescent it jumps straight to the earliest armed deadline
+(``advance_to_next_deadline``) instead of sleeping — recorded hours
+compress into wall seconds while every timeout still fires, in faithful
+order, at its recorded-timeline instant (sim/replay.py).
+
+Timebase discipline: armed deadlines live on the ``now()`` scale.  Sites
+whose deadlines were computed from ``wall()`` reads (the scheduling
+queue's backoff expiries — its timestamps feed wall-flavored latency
+math) pass ``wall=True`` and the clock normalizes; under ``WallClock``
+the flag is moot (no-op arm), under ``VirtualClock`` the two scales
+differ by a constant offset fixed at construction.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Clock", "WallClock", "CallableClock", "VirtualClock",
+           "as_clock", "WALL"]
+
+# Bound on the remembered fired-deadline log (VirtualClock): replay
+# reports read it for retry-ordinal attribution; a day-long trace fires
+# far more than a report needs to prove non-vacuity.
+_FIRED_LOG_CAP = 4096
+
+
+class Clock:
+    """The protocol.  Subclasses override everything; the base exists so
+    ``isinstance(x, Clock)`` is the one dispatch test."""
+
+    #: discrete-event clocks advance only when driven; live surfaces
+    #: consult this to skip real-time waits that would never wake
+    virtual = False
+
+    def now(self) -> float:                      # monotonic-flavored
+        raise NotImplementedError
+
+    def wall(self) -> float:                     # epoch-flavored
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait_until(self, deadline: float) -> None:
+        """Block (wall) / advance (virtual) until ``now() >= deadline``.
+        Never over-advances a virtual clock past ``deadline``."""
+        raise NotImplementedError
+
+    # -- deadline registry ----------------------------------------------------
+
+    def arm(self, label: str, deadline: float, *,
+            wall: bool = False) -> int:
+        """Register an absolute instant a scheduler gate lapses at.
+        Zero-overhead no-op on the wall clock (real time fires gates by
+        itself); the discrete-event engine records it so a quiescent
+        replay can jump straight there.  Returns a token for
+        ``cancel()`` (0 = nothing registered)."""
+        return 0
+
+    def cancel(self, token: int) -> None:
+        """Disarm a previously armed deadline.  Best-effort: firing a
+        stale deadline is always harmless (the gate site re-checks its
+        own state), so sites only cancel when it is cheap to."""
+
+
+class WallClock(Clock):
+    """Production default: real time, no registry.  The method bodies
+    delegate straight to ``time`` so injecting this costs nothing over
+    the ad-hoc reads it replaces."""
+
+    virtual = False
+    now = staticmethod(time.monotonic)
+    wall = staticmethod(time.time)   # the epoch read this clock centralizes
+    sleep = staticmethod(time.sleep)
+
+    def wait_until(self, deadline: float) -> None:
+        remaining = deadline - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+
+
+class CallableClock(Clock):
+    """Adapter for the legacy injected-callable idiom (``clock=lambda:
+    t`` in unit tests and the verify scenarios): both reads serve the
+    one callable, the registry is a no-op, nothing sleeps."""
+
+    virtual = False
+
+    def __init__(self, fn: Callable[[], float]):
+        self._fn = fn
+
+    def now(self) -> float:
+        return self._fn()
+
+    def wall(self) -> float:
+        return self._fn()
+
+    def sleep(self, seconds: float) -> None:
+        return None
+
+    def wait_until(self, deadline: float) -> None:
+        return None
+
+
+class VirtualClock(Clock):
+    """Discrete-event time: ``now()`` returns the virtual instant, which
+    moves only via ``advance*``/``sleep``/``wait_until``.  Armed
+    deadlines sit in a heap; ``advance_to_next_deadline()`` pops the
+    earliest live one and jumps time to it, returning (label, deadline)
+    so the driver can attribute what fired.  Thread-safe: the replay
+    driver advances while bind-pool workers and watch callbacks read."""
+
+    virtual = True
+
+    def __init__(self, start: float = 0.0, wall0: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._t = float(start)
+        # wall() = now() + offset; fixed at construction so the two
+        # scales stay a constant apart (arm(wall=True) normalizes by it)
+        self._wall_offset = (wall0 - start) if wall0 is not None else 0.0
+        self._heap: List[Tuple[float, int, int]] = []   # (deadline, seq, tok)
+        self._armed: Dict[int, Tuple[float, str]] = {}  # tok → (deadline, label)
+        self._seq = itertools.count(1)
+        self._fired: List[Tuple[float, str]] = []
+        self._fired_total = 0
+        self._fired_by_label: Dict[str, int] = {}
+
+    # -- reads ----------------------------------------------------------------
+
+    def now(self) -> float:
+        with self._lock:
+            return self._t
+
+    def wall(self) -> float:
+        with self._lock:
+            return self._t + self._wall_offset
+
+    # -- movement -------------------------------------------------------------
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            self.advance(seconds)
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._t += max(0.0, seconds)
+
+    def advance_to(self, instant: float) -> None:
+        """Jump to ``instant`` (never backward).  Pending deadlines at or
+        before it stay pending — the driver fires them explicitly via
+        ``advance_to_next_deadline`` so every lapse is attributed."""
+        with self._lock:
+            self._t = max(self._t, instant)
+
+    def wait_until(self, deadline: float) -> None:
+        self.advance_to(deadline)
+
+    # -- deadline registry ----------------------------------------------------
+
+    def arm(self, label: str, deadline: float, *,
+            wall: bool = False) -> int:
+        if wall:
+            deadline -= self._wall_offset
+        with self._lock:
+            tok = next(self._seq)
+            self._armed[tok] = (deadline, label)
+            heapq.heappush(self._heap, (deadline, tok, tok))
+            return tok
+
+    def cancel(self, token: int) -> None:
+        with self._lock:
+            self._armed.pop(token, None)   # heap entry lazily skipped
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest live armed deadline (``now()`` scale), or None."""
+        with self._lock:
+            return self._peek_locked()
+
+    def _peek_locked(self) -> Optional[float]:
+        while self._heap:
+            deadline, _, tok = self._heap[0]
+            if tok in self._armed:
+                return deadline
+            heapq.heappop(self._heap)
+        return None
+
+    def advance_to_next_deadline(
+            self, limit: Optional[float] = None
+    ) -> Optional[Tuple[str, float]]:
+        """Pop the earliest live deadline and jump time to it; returns
+        (label, deadline) or None when nothing is armed (or the earliest
+        lies at/after ``limit`` — then time does NOT move; the caller
+        owns the jump to its own horizon)."""
+        with self._lock:
+            deadline = self._peek_locked()
+            if deadline is None or (limit is not None
+                                    and deadline >= limit):
+                return None
+            _, _, tok = heapq.heappop(self._heap)
+            _, label = self._armed.pop(tok)
+            self._t = max(self._t, deadline)
+            self._fired_total += 1
+            self._fired_by_label[label] = \
+                self._fired_by_label.get(label, 0) + 1
+            if len(self._fired) < _FIRED_LOG_CAP:
+                self._fired.append((self._t, label))
+            return label, deadline
+
+    # -- introspection --------------------------------------------------------
+
+    def armed_count(self) -> int:
+        with self._lock:
+            return len(self._armed)
+
+    def fired(self) -> List[Tuple[float, str]]:
+        """The fired-deadline log (bounded; ``fired_total`` is exact)."""
+        with self._lock:
+            return list(self._fired)
+
+    def fired_total(self) -> int:
+        with self._lock:
+            return self._fired_total
+
+    def fired_by_label(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired_by_label)
+
+
+#: the shared zero-overhead default — component constructors resolve
+#: ``clock=None`` to this instead of re-instantiating
+WALL = WallClock()
+
+
+def as_clock(clock) -> Clock:
+    """Normalize every historical ``clock=`` spelling to a ``Clock``:
+    None / ``time.time`` / ``time.monotonic`` → the shared WallClock,
+    a ``Clock`` → itself, any other callable → ``CallableClock`` (the
+    injected-fake-clock test idiom keeps working unchanged)."""
+    if clock is None or clock is time.time or clock is time.monotonic:
+        return WALL
+    if isinstance(clock, Clock):
+        return clock
+    if callable(clock):
+        return CallableClock(clock)
+    raise TypeError(f"not a clock: {clock!r}")

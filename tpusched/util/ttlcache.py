@@ -5,13 +5,22 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
 class TTLCache:
-    def __init__(self, default_ttl: float, clock=time.monotonic):
+    def __init__(self, default_ttl: float, clock=None,
+                 arm: Optional[Callable[[float], None]] = None):
+        """``clock`` is the injected now-read (the scheduler passes its
+        handle clock's ``now``; None = real monotonic).  ``arm`` — called
+        with each entry's absolute expiry — lets a discrete-event clock
+        (util/clock.VirtualClock) learn when a window lapses, so
+        deterministic replay can jump straight to the lapse instead of
+        zeroing the TTL (the denial-window gate this cache exists
+        for)."""
         self._ttl = default_ttl
-        self._clock = clock
+        self._clock = clock or time.monotonic
+        self._arm = arm
         self._lock = threading.Lock()
         self._items: Dict[str, Tuple[Any, float]] = {}
 
@@ -19,6 +28,8 @@ class TTLCache:
         exp = self._clock() + (self._ttl if ttl is None else ttl)
         with self._lock:
             self._items[key] = (value, exp)
+        if self._arm is not None:
+            self._arm(exp)
 
     def add(self, key: str, value: Any = True,
             ttl: Optional[float] = None) -> bool:
@@ -35,7 +46,9 @@ class TTLCache:
             if item is not None and item[1] >= now:
                 return False
             self._items[key] = (value, exp)
-            return True
+        if self._arm is not None:
+            self._arm(exp)
+        return True
 
     def remaining(self, key: str) -> float:
         """Seconds until `key` expires; 0.0 if absent or already expired.
