@@ -70,6 +70,32 @@ bench-storm-sharded:
 bench-storm-quota:
 	$(PY) bench.py --storm-quota
 
+# Native-dispatch storm (ISSUE 16): the sharded storm with the batched
+# C++ Filter→Score→rank inner loop (GIL released per candidate sweep) vs
+# the pure-Python plugin arm, same seeds, plus the every-cycle
+# differential-oracle stamp — recorded as arrival_storm_native.
+.PHONY: bench-storm-native
+bench-storm-native:
+	$(PY) bench.py --storm-native
+
+# Coalesced bind-side fan-out storm (ISSUE 16): watch dispatch batched
+# through the commit-order flush queue (deferred event formatting) vs the
+# synchronous default, same seeds — recorded as arrival_storm_fanout.
+.PHONY: bench-storm-fanout
+bench-storm-fanout:
+	$(PY) bench.py --storm-fanout
+
+# Storm-native-smoke (the native-dispatch gate, part of the tier1 flow):
+# CI-scale sharded storms through the native inner loop — kernel engaged
+# (non-vacuity), differential oracle on EVERY native cycle with zero
+# mismatches, clean pure-Python A/B control arm, the coalesced fan-out
+# arm draining without a wedge, and the schema-v3 artifact records with
+# their negative validator tables.
+.PHONY: storm-native-smoke
+storm-native-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_storm_bench.py \
+		-q -p no:cacheprovider
+
 # O(Δ) cycle core flatness (ISSUE 14): per-cycle snapshot+candidate
 # acquisition cost at 1k/4k/8k hosts (persistent pooled snapshots),
 # recorded as cycle_core_scale_{1k,4k,8k} + cycle_core_flatness.
@@ -177,7 +203,7 @@ goodput-smoke:
 		tests/test_goodput_e2e.py -q -p no:cacheprovider
 
 .PHONY: tier1
-tier1: lint native-smoke race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke
+tier1: lint native-smoke race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke storm-native-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

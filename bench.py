@@ -182,7 +182,7 @@ def _repeat(fn, n: int, *args, **kwargs):
 # goodput-per-chip, ROADMAP item 3's baseline column); when present it
 # must be fully populated — a half-stamped block claims a measurement
 # that never ran.
-RESULTS_SCHEMA_VERSION = 2
+RESULTS_SCHEMA_VERSION = 3
 _RESULTS_PATH = "BENCH_RESULTS.json"
 _results_scenarios: dict = {}
 # workload identity for the environment stamp: which storm seeds /
@@ -332,6 +332,50 @@ def validate_results_artifact(doc) -> list:
                     probs.append(f"{key}.{f}: missing or non-numeric "
                                  f"({v!r}) — the conflict-rate attribution "
                                  "is part of the record")
+        if key == "arrival_storm_native":
+            # the native A/B record (schema v3) must carry its control arm,
+            # prove the kernel actually ran, and stamp the differential
+            # oracle's verdict — a native headline without the oracle
+            # count is an unverified claim
+            v = rec.get("python_binds_per_sec")
+            if not isinstance(v, num) or isinstance(v, bool) or v <= 0:
+                probs.append(f"{key}.python_binds_per_sec: missing or "
+                             f"non-positive ({v!r}) — the A/B needs its "
+                             "pure-Python baseline arm")
+            v = rec.get("native_cycles")
+            if not isinstance(v, num) or isinstance(v, bool) or v < 1:
+                probs.append(f"{key}.native_cycles: missing or < 1 "
+                             f"({v!r}) — a native record whose kernel "
+                             "never ran measured the fallback path")
+            v = rec.get("differential_cycles")
+            if not isinstance(v, num) or isinstance(v, bool) or v < 1:
+                probs.append(f"{key}.differential_cycles: missing or < 1 "
+                             f"({v!r}) — the oracle stamp is vacuous")
+            v = rec.get("differential_mismatches")
+            if not isinstance(v, num) or isinstance(v, bool) or v != 0:
+                probs.append(f"{key}.differential_mismatches: missing or "
+                             f"nonzero ({v!r}) — the kernel disagreed "
+                             "with the plugin path; the artifact must "
+                             "not ship the headline")
+        if key == "arrival_storm_fanout":
+            # the fan-out A/B record (schema v3): the synchronous control
+            # arm, the flush window the number was measured at, and proof
+            # the batcher actually delivered
+            v = rec.get("sync_binds_per_sec")
+            if not isinstance(v, num) or isinstance(v, bool) or v <= 0:
+                probs.append(f"{key}.sync_binds_per_sec: missing or "
+                             f"non-positive ({v!r}) — the A/B needs its "
+                             "synchronous baseline arm")
+            v = rec.get("flush_window_ms")
+            if not isinstance(v, num) or isinstance(v, bool) or v <= 0:
+                probs.append(f"{key}.flush_window_ms: missing or "
+                             f"non-positive ({v!r}) — the record must "
+                             "name the window it measured")
+            v = rec.get("fanout_batches")
+            if not isinstance(v, num) or isinstance(v, bool) or v < 1:
+                probs.append(f"{key}.fanout_batches: missing or < 1 "
+                             f"({v!r}) — a batched record that never "
+                             "flushed measured synchronous dispatch")
         fg = rec.get("fleet_goodput")
         if fg is not None:
             if kind != "throughput":
@@ -1327,7 +1371,10 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                    goodput_reports: bool = True,
                    shards: int = 1,
                    quota_teams: int = 0,
-                   quota_serialize: bool = False) -> dict:
+                   quota_serialize: bool = False,
+                   native: bool = True,
+                   native_differential_period: int = 0,
+                   fanout_flush_ms: float = 0.0) -> dict:
     """ONE sustained arrival storm: a mixed gang+singleton stream arrives
     continuously across ``pools`` v5p-256 pools (64 hosts each) for
     ``duration_s``, with completed workloads torn down as they bind so
@@ -1362,7 +1409,17 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     sized generously (the intra-min multi-tenant regime).
     ``quota_serialize`` flips the LEGACY pre-14 router behavior (every
     pod through the global lane while quotas exist) — the A/B baseline
-    arm the quota-aware commit protocol is measured against."""
+    arm the quota-aware commit protocol is measured against.
+
+    ``native`` (ISSUE 16) gates the batched C++ dispatch inner loop
+    (sched/nativedispatch.py; engages on shard lanes, so it needs
+    ``shards`` > 1 to matter); ``native=False`` is the pure-Python A/B
+    control arm.  ``native_differential_period`` > 0 arms the in-cycle
+    oracle every Nth native cycle — the correctness stamp, not the
+    headline arm (the oracle re-runs the Python path it checks against).
+    ``fanout_flush_ms`` > 0 routes watch fan-out through the coalesced
+    bind-side batcher (apiserver/server.py) with that flush window;
+    0 keeps the synchronous default."""
     import hashlib
     import random
 
@@ -1373,7 +1430,11 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     from tpusched.config.profiles import tpu_gang_profile
     from tpusched.testing import TestCluster, make_pod, make_pod_group, \
         make_tpu_pool
-    from tpusched.util.metrics import binds_total, scheduling_cycles_total
+    from tpusched.util.metrics import (
+        binds_total, fanout_batches_total, fanout_events_total,
+        native_dispatch_cycles_total,
+        native_dispatch_differential_mismatches, native_dispatch_pods_total,
+        scheduling_cycles_total)
 
     rng = random.Random(seed)
     weights = [w for *_, w in STORM_MIX]
@@ -1399,8 +1460,17 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     # sharded dispatch core (ROADMAP item 1): N per-pool lanes + global
     # lane; shards=1 keeps the classic single loop (the r6 baseline shape)
     profile.dispatch_shards = shards
+    profile.native_dispatch = native
+    profile.native_dispatch_differential_period = native_differential_period
     teams = [f"team-{t:02d}" for t in range(quota_teams)]
-    with TestCluster(profile=profile) as c:
+    ncyc0 = native_dispatch_cycles_total.value()
+    npod0 = native_dispatch_pods_total.value()
+    nmm0 = native_dispatch_differential_mismatches.value()
+    fb0 = fanout_batches_total.value()
+    fe0 = fanout_events_total.value()
+    api = (srv.APIServer(fanout_flush_window_s=fanout_flush_ms / 1e3)
+           if fanout_flush_ms > 0 else None)
+    with TestCluster(profile=profile, api=api) as c:
         for i in range(pools):
             topo, nodes = make_tpu_pool(f"pool-{i:02d}", dims=(8, 8, 4),
                                         dcn_domain=f"zoneA/rack{i // 4}")
@@ -1532,6 +1602,13 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                                        for r in lanes.values()),
                 "escalations": c.scheduler.shard_router().escalations(),
             }
+        fanout = None
+        if api is not None:
+            api.fanout_flush()               # drain the tail of the queue
+            fanout = api.fanout_health()
+            fanout["batches_delta"] = int(fanout_batches_total.value() - fb0)
+            fanout["events_delta"] = int(fanout_events_total.value() - fe0)
+            api._fanout.stop()
 
     e2e = slo.summary().get(obs.POD_E2E, {})
     stats = goodput.stats()
@@ -1557,6 +1634,15 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
         "quota_teams": quota_teams,
         "quota_serialized": bool(quota_serialize),
         "dispatch": dispatch,
+        "native": {
+            "enabled": bool(native),
+            "cycles": int(native_dispatch_cycles_total.value() - ncyc0),
+            "pods": int(native_dispatch_pods_total.value() - npod0),
+            "differential_mismatches": int(
+                native_dispatch_differential_mismatches.value() - nmm0),
+        },
+        "fanout": fanout,
+        "fanout_flush_ms": fanout_flush_ms,
         "pools": pools, "hosts": pools * 64,
         "duration_s": round(window_s, 3),
         "binds": int(window_binds),
@@ -1717,6 +1803,156 @@ def bench_storm_quota(runs: int = 3, pools: int = 32,
                      f"{quota_teams} ElasticQuota namespaces: "
                      f"quota-aware optimistic commits (shards={shards}) "
                      f"vs the legacy quota-serialized global lane"))
+
+
+def bench_storm_native(runs: int = 3, pools: int = 32,
+                       duration_s: float = 10.0, shards: int = 8) -> None:
+    """ISSUE 16 tentpole (a): the sharded arrival storm with the NATIVE
+    batched Filter→Score→rank inner loop (one GIL-released C++ sweep per
+    candidate set) vs the pure-Python plugin path on the same seeds —
+    min-of-N per arm (doc/performance.md).  Recorded as
+    ``arrival_storm_native`` with the python-arm baseline riding in the
+    artifact, plus a separate short DIFFERENTIAL run (the in-cycle oracle
+    re-running every native cycle) whose mismatch count must be zero —
+    the headline arm does not pay the oracle, and the oracle stamp does
+    not claim the headline's throughput."""
+    run_storm_once(pools=4, duration_s=2.0, seed=99,
+                   shards=shards)                      # warmup, small
+    native_arm = [run_storm_once(pools=pools, duration_s=duration_s,
+                                 seed=i, shards=shards, native=True)
+                  for i in range(runs)]
+    python_arm = [run_storm_once(pools=pools, duration_s=duration_s,
+                                 seed=i, shards=shards, native=False)
+                  for i in range(runs)]
+    import hashlib
+    combined = hashlib.sha256(
+        "|".join(r["workload_hash"]
+                 for r in native_arm + python_arm).encode())
+    _record_workload(storm_seeds=[r["seed"] for r in native_arm],
+                     workload_hash=combined.hexdigest()[:16])
+    best = max(native_arm, key=lambda r: r["binds_per_sec"])
+    best_py = max(python_arm, key=lambda r: r["binds_per_sec"])
+    if best["native"]["cycles"] == 0:
+        _gate_failures.append(
+            "storm-native: the native arm never evaluated a cycle — the "
+            "A/B is vacuous (toolchain missing or kernel declining)")
+    for r in python_arm:
+        if r["native"]["cycles"]:
+            _gate_failures.append(
+                "storm-native: the python control arm ran native cycles")
+    speedup = best["binds_per_sec"] / max(best_py["binds_per_sec"], 1e-9)
+    emit(f"native-dispatch storm sustained throughput (C++ batched "
+         f"inner loop, shards={shards}, {pools} pools; best of {runs}; "
+         f"per-run {[r['binds_per_sec'] for r in native_arm]}; "
+         f"python arm {best_py['binds_per_sec']} binds/s; "
+         f"{best['native']['cycles']} native cycles / "
+         f"{best['native']['pods']} pods in the headline run)",
+         best["binds_per_sec"], "binds/s", round(speedup, 2),
+         pod_e2e_p99_s=best["pod_e2e_p99_s"])
+    # correctness stamp: a short storm with the oracle on EVERY native
+    # cycle — zero mismatches or the gate fails
+    oracle = run_storm_once(pools=4, duration_s=2.0, seed=7, shards=shards,
+                            native=True, native_differential_period=1)
+    if oracle["native"]["differential_mismatches"]:
+        _gate_failures.append(
+            f"storm-native: in-cycle differential oracle caught "
+            f"{oracle['native']['differential_mismatches']} mismatch(es)")
+    emit(f"native-dispatch in-cycle differential oracle under storm load "
+         f"({oracle['native']['cycles']} native cycles re-checked)",
+         oracle["native"]["differential_mismatches"], "mismatches", None)
+    _record_scenario(
+        "arrival_storm_native", "throughput",
+        binds_per_sec=best["binds_per_sec"],
+        pod_e2e_p50_s=best["pod_e2e_p50_s"],
+        pod_e2e_p99_s=best["pod_e2e_p99_s"],
+        runs=runs, shards=shards,
+        python_binds_per_sec=best_py["binds_per_sec"],
+        python_pod_e2e_p99_s=best_py["pod_e2e_p99_s"],
+        speedup_vs_python=round(speedup, 2),
+        native_cycles=best["native"]["cycles"],
+        native_pods=best["native"]["pods"],
+        differential_cycles=oracle["native"]["cycles"],
+        differential_mismatches=oracle["native"]["differential_mismatches"],
+        per_run=[{k: r[k] for k in ("binds_per_sec", "pod_e2e_p99_s",
+                                    "binds", "pending_peak")}
+                 for r in native_arm],
+        python_per_run=[{k: r[k] for k in ("binds_per_sec",
+                                           "pod_e2e_p99_s", "binds")}
+                        for r in python_arm],
+        description=(f"sustained mixed arrival storm, native batched "
+                     f"dispatch inner loop (shards={shards}) vs the "
+                     f"pure-Python plugin path, same seeds both arms"))
+
+
+def bench_storm_fanout(runs: int = 3, pools: int = 32,
+                       duration_s: float = 10.0, shards: int = 8,
+                       flush_window_ms: float = 5.0) -> None:
+    """ISSUE 16 tentpole (b): the sharded arrival storm with watch
+    fan-out COALESCED through the bind-side batcher (commit-order queue,
+    one flusher thread, deferred event formatting) vs the synchronous
+    default on the same seeds — min-of-N per arm.  Recorded as
+    ``arrival_storm_fanout`` with the synchronous baseline riding in the
+    artifact.  On a single-CPU box the offload buys no parallelism, so
+    the honest expectation is throughput-neutral-or-better; the win the
+    batcher is FOR (bind-path latency + commit-order delivery) is pinned
+    by tests/test_fanout_batching.py, not by this throughput number."""
+    run_storm_once(pools=4, duration_s=2.0, seed=99, shards=shards,
+                   fanout_flush_ms=flush_window_ms)     # warmup, small
+    batched = [run_storm_once(pools=pools, duration_s=duration_s,
+                              seed=i, shards=shards,
+                              fanout_flush_ms=flush_window_ms)
+               for i in range(runs)]
+    sync = [run_storm_once(pools=pools, duration_s=duration_s,
+                           seed=i, shards=shards)
+            for i in range(runs)]
+    import hashlib
+    combined = hashlib.sha256(
+        "|".join(r["workload_hash"] for r in batched + sync).encode())
+    _record_workload(storm_seeds=[r["seed"] for r in batched],
+                     workload_hash=combined.hexdigest()[:16])
+    best = max(batched, key=lambda r: r["binds_per_sec"])
+    best_sync = max(sync, key=lambda r: r["binds_per_sec"])
+    fo = best["fanout"] or {}
+    if not fo.get("batches_delta"):
+        _gate_failures.append(
+            "storm-fanout: the batched arm never delivered a flush batch "
+            "— the A/B is vacuous")
+    for r in sync:
+        if r["fanout"] is not None:
+            _gate_failures.append(
+                "storm-fanout: the synchronous control arm ran batched")
+    speedup = best["binds_per_sec"] / max(best_sync["binds_per_sec"], 1e-9)
+    emit(f"fanout-batched storm sustained throughput (coalesced watch "
+         f"fan-out, flush window {flush_window_ms}ms, shards={shards}, "
+         f"{pools} pools; best of {runs}; per-run "
+         f"{[r['binds_per_sec'] for r in batched]}; synchronous arm "
+         f"{best_sync['binds_per_sec']} binds/s; headline run delivered "
+         f"{fo.get('events_delta', 0)} events in "
+         f"{fo.get('batches_delta', 0)} batches)",
+         best["binds_per_sec"], "binds/s", round(speedup, 2),
+         pod_e2e_p99_s=best["pod_e2e_p99_s"])
+    _record_scenario(
+        "arrival_storm_fanout", "throughput",
+        binds_per_sec=best["binds_per_sec"],
+        pod_e2e_p50_s=best["pod_e2e_p50_s"],
+        pod_e2e_p99_s=best["pod_e2e_p99_s"],
+        runs=runs, shards=shards,
+        flush_window_ms=flush_window_ms,
+        sync_binds_per_sec=best_sync["binds_per_sec"],
+        sync_pod_e2e_p99_s=best_sync["pod_e2e_p99_s"],
+        speedup_vs_sync=round(speedup, 2),
+        fanout_batches=fo.get("batches_delta", 0),
+        fanout_events=fo.get("events_delta", 0),
+        per_run=[{k: r[k] for k in ("binds_per_sec", "pod_e2e_p99_s",
+                                    "binds", "pending_peak")}
+                 for r in batched],
+        sync_per_run=[{k: r[k] for k in ("binds_per_sec",
+                                         "pod_e2e_p99_s", "binds")}
+                      for r in sync],
+        description=(f"sustained mixed arrival storm, coalesced bind-side "
+                     f"watch fan-out (flush window {flush_window_ms}ms, "
+                     f"shards={shards}) vs synchronous dispatch, same "
+                     f"seeds both arms"))
 
 
 def run_cycle_core_once(pools: int, gangs: int) -> list:
@@ -2892,6 +3128,64 @@ def main() -> int:
                       file=sys.stderr)
                 return 2
         bench_storm_quota(shards=shards)
+        write_results_artifact(_results_path())
+        if _gate_failures:
+            for f in _gate_failures:
+                print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)
+            return 1
+        return 0
+    if "--artifact-refresh" in sys.argv:
+        # regenerate the COMMITTED BENCH_RESULTS.json scenario set in one
+        # process (one environment stamp): the storm family (baseline,
+        # sharded, quota, native, fanout) plus the cycle-core and
+        # torus-index scaling curves — the reproducible provenance of the
+        # checked-in artifact.
+        bench_storm()
+        bench_storm(shards=8)
+        bench_storm_quota()
+        bench_storm_native()
+        bench_storm_fanout()
+        bench_cycle_core()
+        bench_index_scaling()
+        write_results_artifact(_results_path())
+        if _gate_failures:
+            for f in _gate_failures:
+                print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)
+            return 1
+        return 0
+    if "--storm-native" in sys.argv:
+        # ISSUE 16 acceptance run: the sharded storm with the native
+        # batched dispatch inner loop vs the pure-Python arm, plus the
+        # every-cycle differential-oracle stamp, recorded as
+        # arrival_storm_native.
+        shards = 8
+        if "--shards" in sys.argv:
+            try:
+                shards = int(sys.argv[sys.argv.index("--shards") + 1])
+            except (IndexError, ValueError):
+                print("usage: bench.py --storm-native [--shards N]",
+                      file=sys.stderr)
+                return 2
+        bench_storm_native(shards=shards)
+        write_results_artifact(_results_path())
+        if _gate_failures:
+            for f in _gate_failures:
+                print(f"PERF GATE FAILED: {f}", file=sys.stderr, flush=True)
+            return 1
+        return 0
+    if "--storm-fanout" in sys.argv:
+        # ISSUE 16 acceptance run: the sharded storm with coalesced
+        # bind-side watch fan-out vs the synchronous default, recorded as
+        # arrival_storm_fanout.
+        window_ms = 5.0
+        if "--flush-ms" in sys.argv:
+            try:
+                window_ms = float(sys.argv[sys.argv.index("--flush-ms") + 1])
+            except (IndexError, ValueError):
+                print("usage: bench.py --storm-fanout [--flush-ms MS]",
+                      file=sys.stderr)
+                return 2
+        bench_storm_fanout(flush_window_ms=window_ms)
         write_results_artifact(_results_path())
         if _gate_failures:
             for f in _gate_failures:

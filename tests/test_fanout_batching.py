@@ -1,0 +1,227 @@
+"""ISSUE 16 tentpole b: coalesced bind-side fan-out — ordering contract.
+
+The batcher enqueues watch events UNDER the store lock (commit order IS
+queue order) and one flusher delivers batches; the informers' per-key RV
+staleness defenses stay on for mixed-mode/replay traffic.  These tests
+pin: per-key RV monotonicity under batched delivery, DELETED-after-
+MODIFIED rejection when a split batch reorders, handler re-attach
+mid-flush, the deferred Event ride-along (trace-id preserved), the env
+knob, and the health/metrics surfaces.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from tpusched.api.core import Pod, ObjectMeta
+from tpusched.apiserver import server as srv
+from tpusched.apiserver.client import Clientset
+from tpusched.apiserver.informers import Informer
+from tpusched.util import tracectx
+from tpusched.util.metrics import fanout_batches_total, fanout_events_total
+
+
+def _pod(name, ns="d"):
+    return Pod(meta=ObjectMeta(name=name, namespace=ns))
+
+
+def _batched(window_s=3600.0):
+    """A batched APIServer with the daemon flusher parked (stopped before
+    any event): tests drive delivery deterministically via
+    fanout_flush()."""
+    api = srv.APIServer(fanout_flush_window_s=window_s)
+    api._fanout.stop()
+    return api
+
+
+def test_sync_default_is_unchanged():
+    api = srv.APIServer()
+    assert api._fanout is None
+    seen = []
+    api.add_watch(srv.PODS, lambda ev: seen.append(ev.type))
+    api.create(srv.PODS, _pod("a"))
+    assert seen == [srv.ADDED]        # delivered on the mutator's thread
+    assert api.fanout_health() == {"mode": "synchronous",
+                                   "flush_window_ms": 0.0}
+
+
+def test_env_knob_arms_the_batcher(monkeypatch):
+    monkeypatch.setenv("TPUSCHED_FANOUT_FLUSH_MS", "2.5")
+    api = srv.APIServer()
+    assert api._fanout is not None
+    assert api.fanout_health()["flush_window_ms"] == 2.5
+    api._fanout.stop()
+    monkeypatch.setenv("TPUSCHED_FANOUT_FLUSH_MS", "garbage")
+    assert srv.APIServer()._fanout is None      # unparsable → synchronous
+
+
+def test_batched_delivery_is_commit_ordered_per_key():
+    """Racing writer threads: every informer-observed RV sequence per key
+    must be strictly increasing — the commit-order enqueue makes the
+    global delivery order the store order."""
+    api = _batched()
+    inf = Informer(api, srv.PODS)
+    seen = {}
+    inf.add_event_handler(
+        on_add=lambda o: seen.setdefault(o.meta.key, []).append(
+            o.meta.resource_version),
+        on_update=lambda _old, o: seen.setdefault(o.meta.key, []).append(
+            o.meta.resource_version))
+
+    def writer(i):
+        p = _pod(f"p{i}")
+        api.create(srv.PODS, p)
+        for _ in range(10):
+            api.patch(srv.PODS, p.meta.key, lambda q: None)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    stop = threading.Event()
+    flusher = threading.Thread(
+        target=lambda: [api.fanout_flush() or time.sleep(0.001)
+                        for _ in iter(lambda: not stop.is_set(), False)])
+    flusher.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    flusher.join()
+    api.fanout_flush()
+    assert len(seen) == 4
+    for key, rvs in seen.items():
+        assert rvs == sorted(rvs), f"{key}: non-monotone delivery {rvs}"
+        assert len(rvs) == 11, f"{key}: lost events ({len(rvs)}/11)"
+
+
+def test_stale_modified_after_deleted_is_rejected():
+    """A batch split across racing flush calls can deliver DELETED before
+    an older MODIFIED — the informer's per-key staleness rejection must
+    drop the stale MODIFIED, never resurrecting the key."""
+    api = _batched()
+    p = _pod("doomed")
+    api.create(srv.PODS, p)
+    api.fanout_flush()
+    inf = Informer(api, srv.PODS)
+    updates, deletes = [], []
+    inf.add_event_handler(on_update=lambda _o, o: updates.append(
+        o.meta.resource_version),
+        on_delete=lambda o: deletes.append(o.meta.resource_version))
+    api.patch(srv.PODS, p.meta.key, lambda q: None)      # MODIFIED rv2
+    api.delete(srv.PODS, p.meta.key)                     # DELETED  rv2-obj
+    # simulate the reorder: deliver the queue back-to-front
+    batch = list(api._fanout._queue)
+    api._fanout._queue.clear()
+    for ev in reversed(batch):
+        api._dispatch(ev)
+    assert deletes and inf.get(p.meta.key) is None
+    assert not updates, (
+        "stale MODIFIED delivered after DELETED resurrected the pod in "
+        "the informer cache")
+
+
+def test_handler_reattach_mid_flush_sees_consistent_replay():
+    """add_event_handler while the queue holds undelivered events: the
+    replay (cache snapshot) plus live deliveries must converge on the
+    store's final state, without duplicate-resurrect."""
+    api = _batched()
+    for i in range(3):
+        api.create(srv.PODS, _pod(f"p{i}"))
+    api.fanout_flush()
+    inf = Informer(api, srv.PODS)
+    api.patch(srv.PODS, "d/p0", lambda q: None)
+    api.delete(srv.PODS, "d/p1")                    # still queued
+    adds, deletes = [], []
+    inf.add_event_handler(on_add=lambda o: adds.append(o.meta.key),
+                          on_delete=lambda o: deletes.append(o.meta.key))
+    api.fanout_flush()                              # drain the backlog
+    assert sorted(adds) == ["d/p0", "d/p1", "d/p2"]  # replay snapshot
+    assert deletes == ["d/p1"]                       # live delete lands
+    assert inf.get("d/p1") is None
+    assert inf.get("d/p0") is not None
+
+
+def test_deferred_event_rides_the_flush_and_keeps_trace_id():
+    """record_event_deferred: formatting happens on the flusher, but the
+    thread-local trace id is captured at call time — the flight-recorder
+    correlation survives the hop."""
+    api = _batched()
+    cs = Clientset(api)
+    prev = tracectx.set("t-fanout")
+    try:
+        cs.record_event_deferred("d/p", "Pod", "Normal", "Scheduled",
+                                 lambda: "Successfully assigned d/p to n1")
+    finally:
+        tracectx.set(prev)
+    assert not api.events()                  # nothing before the flush
+    api.fanout_flush()
+    evs = api.events()
+    assert len(evs) == 1
+    assert evs[0].message == "Successfully assigned d/p to n1 [trace=t-fanout]"
+    # synchronous fallback: no batcher → recorded immediately
+    api2 = srv.APIServer()
+    Clientset(api2).record_event_deferred("d/q", "Pod", "Normal", "S",
+                                          lambda: "m")
+    assert api2.events()[0].message == "m"
+
+
+def test_flush_metrics_and_health_surface():
+    api = _batched()
+    b0 = fanout_batches_total.value()
+    e0 = fanout_events_total.value()
+    api.create(srv.PODS, _pod("m0"))
+    api.create(srv.PODS, _pod("m1"))
+    api.fanout_flush()
+    assert fanout_batches_total.value() == b0 + 1
+    assert fanout_events_total.value() == e0 + 2
+    h = api.fanout_health()
+    assert h["mode"] == "batched"
+    assert h["batches"] >= 1 and h["events_delivered"] >= 2
+    assert h["queue_depth"] == 0
+    published = []
+    api.set_fanout_health_sink(published.append)
+    api.create(srv.PODS, _pod("m2"))
+    api.fanout_flush()
+    assert published and published[-1]["events_delivered"] >= 3
+
+
+def test_daemon_flusher_delivers_without_explicit_flush():
+    """The real shape: a live flusher thread with a short window delivers
+    on its own; the mutator never runs a handler."""
+    api = srv.APIServer(fanout_flush_window_s=0.002)
+    seen = []
+    mutator_tid = threading.get_ident()
+    tids = []
+    api.add_watch(srv.PODS, lambda ev: (seen.append(ev.type),
+                                        tids.append(threading.get_ident())))
+    api.create(srv.PODS, _pod("bg"))
+    deadline = time.monotonic() + 2.0
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert seen == [srv.ADDED]
+    assert tids[0] != mutator_tid, (
+        "batched mode delivered on the mutator's thread — the bind "
+        "critical path still pays the fan-out")
+    api._fanout.stop()
+
+
+def test_health_fanout_in_flightrecorder(monkeypatch):
+    """health.fanout in the /debug/flightrecorder payload: a static
+    synchronous snapshot by default, live flush counters in batched
+    mode."""
+    from tpusched.testing import TestCluster, make_node
+    with TestCluster() as c:
+        h = c.scheduler.recorder.dump()["health"]
+        assert h.get("fanout", {}).get("mode") == "synchronous"
+    monkeypatch.setenv("TPUSCHED_FANOUT_FLUSH_MS", "1")
+    with TestCluster() as c:
+        c.api.create(srv.NODES, make_node("h-fanout"))
+        api = c.api
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            h = c.scheduler.recorder.dump()["health"].get("fanout", {})
+            if h.get("batches", 0) >= 1:
+                break
+            time.sleep(0.01)
+        assert h.get("mode") == "batched", h
+        assert h.get("batches", 0) >= 1, h
+        assert h.get("flush_window_ms") == 1.0, h

@@ -384,3 +384,42 @@ def test_issue10_goodput_families_round_trip_exposition():
     finally:
         workload_goodput_per_chip.remove("2x2x4/4chip", "tpu-v5p")
         workload_goodput_per_chip.remove("2x2x4/4chip", "tpu-v6e")
+
+
+def test_issue16_native_dispatch_and_fanout_families_round_trip():
+    """The ISSUE 16 families: native batched-dispatch cycle/pod counters,
+    the per-reason fallback vec, the differential-mismatch counter, and
+    the bind fan-out batch/event counters + flush-latency histogram — all
+    through the validating exposition round trip."""
+    from tpusched.util.metrics import (
+        fanout_batches_total, fanout_events_total, fanout_flush_seconds,
+        native_dispatch_cycles_total,
+        native_dispatch_differential_mismatches,
+        native_dispatch_fallbacks, native_dispatch_pods_total)
+    native_dispatch_cycles_total.inc(3)
+    native_dispatch_pods_total.inc(2)
+    native_dispatch_fallbacks.with_labels("no-native").inc()
+    native_dispatch_fallbacks.with_labels("pod-shape").inc(2)
+    native_dispatch_differential_mismatches.inc(0)
+    fanout_batches_total.inc()
+    fanout_events_total.inc(5)
+    fanout_flush_seconds.observe(0.0009)
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert types["tpusched_native_dispatch_cycles_total"] == "counter"
+    assert types["tpusched_native_dispatch_pods_total"] == "counter"
+    assert types["tpusched_native_dispatch_fallbacks_total"] == "counter"
+    assert types["tpusched_native_dispatch_differential_mismatches_total"] \
+        == "counter"
+    assert types["tpusched_fanout_batches_total"] == "counter"
+    assert types["tpusched_fanout_events_total"] == "counter"
+    assert types["tpusched_fanout_flush_seconds"] == "histogram"
+    reasons = {labels.get("reason"): v for name, labels, v in samples
+               if name == "tpusched_native_dispatch_fallbacks_total"}
+    assert reasons.get("no-native", 0) >= 1
+    assert reasons.get("pod-shape", 0) >= 2
+    # the sub-ms flush actually lands in a sub-ms bucket
+    sub_ms = [v for name, labels, v in samples
+              if name == "tpusched_fanout_flush_seconds_bucket"
+              and labels["le"] not in ("+Inf",)
+              and float(labels["le"]) < 0.002]
+    assert sub_ms and max(sub_ms) >= 1.0

@@ -1,0 +1,208 @@
+"""ISSUE 16 parity suite: the native batched dispatch kernel against its
+oracles, over fuzzed occupancy / node-health / quota states.
+
+Three layers, strongest last:
+
+1. seeded-fuzz kernel parity: tpusched_dispatch_eval (C) against
+   py_dispatch_eval (the pure-Python mirror of the SAME packed-row
+   semantics) on randomized row matrices — both kernel implementations
+   must agree field-for-field (feasible set, raw scores, topo scores,
+   visited count);
+2. the same property under hypothesis when available (the container may
+   not ship it; the seeded fuzz always runs);
+3. in-vivo differential: a live TestCluster with the per-cycle oracle
+   sampling EVERY native cycle (TPUSCHED_NATIVE_DIFFERENTIAL=1) over
+   fuzzed pod shapes, cordoned/unhealthy nodes and an ElasticQuota —
+   zero mismatches allowed, and the native path must actually have run
+   (non-vacuity).
+
+The fuzz keeps membership <= max_membership: the stash's max IS the max
+over its members (production invariant), and C truncation vs Python
+floor division only diverge on the negative numerators that invariant
+excludes.
+"""
+from __future__ import annotations
+
+import ctypes
+import random
+from dataclasses import replace
+
+import pytest
+
+from tpusched import native
+from tpusched.sched import nativedispatch as nd
+
+SEED = 20260807
+TRIALS = 300
+
+
+def _rand_rows(rng: random.Random, n: int):
+    rows = []
+    for _ in range(n):
+        alloc = [rng.randrange(0, 64), rng.randrange(0, 1 << 22),
+                 rng.randrange(0, 110), rng.randrange(0, 8)]
+        req = [rng.randrange(0, 64), rng.randrange(0, 1 << 22),
+               rng.randrange(0, 110), rng.randrange(0, 8)]
+        ucl = rng.randrange(0, 8)
+        uml = rng.randrange(0, 1 << 16)
+        hbm = rng.randrange(0, 1 << 16)
+        free = rng.randrange(0, 8)
+        flags = rng.randrange(0, 4)
+        rows += alloc + req + [ucl, uml, hbm, free, flags]
+    return rows
+
+
+def _call_native(lib, rows, req, chips_set, chips_req, start, want,
+                 membership, pool_util, max_membership, strategy,
+                 packing_weight):
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(i64)
+    n = len(rows) // nd.DISPATCH_FIELDS
+    buf = (i64 * len(rows))(*rows)
+    blocks = (i64p * 1)(ctypes.cast(buf, i64p))
+    lens = (i64 * 1)(n)
+    req_buf = (i64 * 4)(*req)
+    memb = (i64 * n)(*membership) if membership is not None else None
+    util = (ctypes.c_double * n)(*pool_util) if pool_util is not None \
+        else None
+    out_f, out_r, out_t = (i64 * n)(), (i64 * n)(), (i64 * n)()
+    out_v = (i64 * 1)()
+    nf = lib.tpusched_dispatch_eval(
+        blocks, lens, 1, req_buf, 1 if chips_set else 0, chips_req,
+        start, want, memb, util, max_membership, strategy,
+        packing_weight, 0, out_f, out_r, out_t, out_v)
+    return (list(out_f[:nf]), list(out_r[:nf]), list(out_t[:nf]),
+            out_v[0])
+
+
+def _one_trial(lib, rng: random.Random):
+    n = rng.randrange(1, 25)
+    rows = _rand_rows(rng, n)
+    req = tuple(rng.randrange(0, 80) for _ in range(4))
+    chips_set = rng.random() < 0.7
+    chips_req = rng.randrange(0, 8)
+    start = rng.randrange(0, n)
+    want = rng.randrange(1, n + 2)
+    if rng.random() < 0.5:
+        max_membership = rng.randrange(1, 9)
+        membership = [rng.randrange(-1, max_membership + 1)
+                      for _ in range(n)]       # <= maxm by construction
+        pool_util = [rng.random() for _ in range(n)]
+    else:
+        max_membership, membership, pool_util = 1, None, None
+    strategy = rng.randrange(0, 3)
+    packing_weight = rng.choice([0.0, 0.3, 0.5, 0.7, 1.0])
+    got = _call_native(lib, rows, req, chips_set, chips_req, start, want,
+                       membership, pool_util, max_membership, strategy,
+                       packing_weight)
+    exp = nd.py_dispatch_eval(rows, req, chips_set, chips_req, start,
+                              want, membership, pool_util, max_membership,
+                              strategy, packing_weight)
+    assert got == tuple(exp), (
+        f"kernel/mirror divergence: n={n} start={start} want={want} "
+        f"chips=({chips_set},{chips_req}) strat={strategy} "
+        f"pw={packing_weight}\n rows={rows}\n memb={membership}\n "
+        f"util={pool_util}\n native={got}\n python={exp}")
+
+
+def test_kernel_matches_python_mirror_seeded_fuzz():
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    lib = native.load()
+    rng = random.Random(SEED)
+    for _ in range(TRIALS):
+        _one_trial(lib, rng)
+
+
+def test_combine_scores_normalization_properties():
+    """The shared normalize+blend helper (used by the native select and
+    the parity oracle): bounded output, reverse flips, zero-max passthrough
+    — pure Python, runs everywhere."""
+    rng = random.Random(SEED + 1)
+    for _ in range(200):
+        k = rng.randrange(0, 12)
+        raws = [rng.randrange(0, 100) for _ in range(k)]
+        topos = [rng.randrange(0, 100) for _ in range(k)]
+        w_tpu, w_topo = rng.randrange(0, 5), rng.randrange(0, 5)
+        fwd = nd.combine_scores(raws, topos, w_tpu, w_topo, False)
+        rev = nd.combine_scores(raws, topos, w_tpu, w_topo, True)
+        assert len(fwd) == len(rev) == k
+        for f, r, topo in zip(fwd, rev, topos):
+            assert f + r == 100 * w_tpu + 2 * topo * w_topo
+        if raws and max(raws) > 0:
+            hi = raws.index(max(raws))
+            assert fwd[hi] - topos[hi] * w_topo == 100 * w_tpu
+
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @given(st.integers(min_value=0, max_value=2 ** 31))
+    @settings(max_examples=60, deadline=None)
+    def test_kernel_parity_hypothesis(trial_seed):
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        _one_trial(native.load(), random.Random(trial_seed))
+except ImportError:      # container without hypothesis: seeded fuzz above
+    pass                 # carries the property
+
+
+# -- in-vivo: every native cycle differentially checked ------------------------
+
+
+def test_native_dispatch_in_vivo_zero_mismatches(monkeypatch):
+    """A live cluster with fuzzed occupancy (mixed pod sizes), node health
+    (cordons), a gang, and an ElasticQuota — scheduled with the in-cycle
+    oracle re-running EVERY native cycle.  Zero differential mismatches,
+    and the native path must actually have evaluated cycles."""
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    monkeypatch.setenv("TPUSCHED_NATIVE_DIFFERENTIAL", "1")
+    from tpusched.apiserver import server as srv
+    from tpusched.testing import (TestCluster, make_elastic_quota,
+                                  make_pod, make_pod_group, make_tpu_pool)
+    from tpusched.util.metrics import (
+        native_dispatch_cycles_total,
+        native_dispatch_differential_mismatches)
+    from tpusched.api.resources import TPU
+    from tpusched.testing.cluster import default_profile
+
+    profile = replace(default_profile(), dispatch_shards=2)
+    mismatch0 = native_dispatch_differential_mismatches.value()
+    cycles0 = native_dispatch_cycles_total.value()
+    rng = random.Random(SEED + 2)
+    with TestCluster(profile=profile) as c:
+        topo_a, nodes_a = make_tpu_pool("pa", dims=(4, 4, 4))
+        topo_b, nodes_b = make_tpu_pool("pb", dims=(4, 4, 4))
+        # node-health fuzz with a deterministic footprint: cordon one
+        # z=3-layer host per pool — that layer only backs the z=2 slice
+        # window, so the 4x4x2 gang stays placeable at z in {0, 1}
+        for nodes in (nodes_a, nodes_b):
+            layer = [n for n in nodes if n.meta.name.endswith("-3")]
+            rng.choice(layer).spec.unschedulable = True
+        c.api.create(srv.TPU_TOPOLOGIES, topo_a)
+        c.api.create(srv.TPU_TOPOLOGIES, topo_b)
+        c.add_nodes(nodes_a + nodes_b)
+        c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
+            "q", "default", min={TPU: 64}, max={TPU: 128}))
+        pods, keys = [], []
+        for i in range(12):           # fuzzed occupancy: mixed chip sizes
+            p = make_pod(f"solo-{i}", limits={TPU: rng.choice([1, 2, 4])})
+            pods.append(p)
+            keys.append(p.key)
+        c.api.create(srv.POD_GROUPS, make_pod_group(
+            "gang", min_member=8, tpu_slice_shape="4x4x2"))
+        for i in range(8):            # 8 hosts x 4 chips = the 4x4x2 slice
+            p = make_pod(f"gang-{i}", limits={TPU: 4}, pod_group="gang")
+            pods.append(p)
+            keys.append(p.key)
+        c.create_pods(pods)
+        assert c.wait_for_pods_scheduled(keys, timeout=30.0), (
+            "fuzzed workload failed to schedule")
+    assert native_dispatch_differential_mismatches.value() == mismatch0, (
+        "the in-cycle oracle caught the kernel disagreeing with the "
+        "plugin path")
+    assert native_dispatch_cycles_total.value() > cycles0, (
+        "native dispatch never engaged — the in-vivo parity test is "
+        "vacuous")

@@ -112,6 +112,173 @@ def test_clean_fallback_under_no_native_env(monkeypatch):
     assert q is not None and q.survivors == 1
 
 
+# -- ISSUE 16: the batched dispatch inner loop --------------------------------
+
+import ctypes
+import threading
+import time
+
+from tpusched.sched import nativedispatch as nd
+from tpusched.util import tracectx
+
+
+def _drow(alloc=(64, 1 << 30, 110, 4), req=(0, 0, 0, 0), ucl=0, uml=0,
+          hbm=1 << 20, free=4, flags=nd._FLAG_HEALTHY):
+    """One packed candidate row (DISPATCH_FIELDS int64s)."""
+    return list(alloc) + list(req) + [ucl, uml, hbm, free, flags]
+
+
+def _call_dispatch(lib, rows, req, chips_set, chips_req, start, want,
+                   membership=None, pool_util=None, max_membership=1,
+                   strategy=0, packing_weight=0.7, spin_us=0):
+    """Single-block ctypes harness around tpusched_dispatch_eval, shaped
+    exactly like py_dispatch_eval's return."""
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(i64)
+    n = len(rows) // nd.DISPATCH_FIELDS
+    buf = (i64 * len(rows))(*rows)
+    blocks = (i64p * 1)(ctypes.cast(buf, i64p))
+    lens = (i64 * 1)(n)
+    req_buf = (i64 * 4)(*req)
+    memb = (i64 * n)(*membership) if membership is not None else None
+    util = (ctypes.c_double * n)(*pool_util) if pool_util is not None \
+        else None
+    out_f, out_r, out_t = (i64 * n)(), (i64 * n)(), (i64 * n)()
+    out_v = (i64 * 1)()
+    nf = lib.tpusched_dispatch_eval(
+        blocks, lens, 1, req_buf, 1 if chips_set else 0, chips_req,
+        start, want, memb, util, max_membership, strategy,
+        packing_weight, spin_us, out_f, out_r, out_t, out_v)
+    return (list(out_f[:nf]), list(out_r[:nf]), list(out_t[:nf]),
+            out_v[0])
+
+
+def test_dispatch_kernel_builds_and_matches_python_mirror():
+    """tpusched_dispatch_eval against py_dispatch_eval over a row set
+    exercising every filter leg (health, hard taint, resource fit, chip
+    capacity/limit) at several rotation starts and want cutoffs."""
+    if shutil.which("g++") is None and not native.available():
+        pytest.skip("no toolchain and no prebuilt library")
+    assert native.available(), "native engine failed to build/load"
+    lib = native.load()
+    rows = (_drow() + _drow(flags=0)
+            + _drow(flags=nd._FLAG_HEALTHY | nd._FLAG_HARD_TAINT)
+            + _drow(req=(60, 0, 0, 0)) + _drow(free=1) + _drow(ucl=3)
+            + _drow(uml=2 << 20) + _drow())
+    req = (8, 1 << 20, 1, 2)
+    for start in (0, 3, 7):
+        for want in (1, 3, 8):
+            got = _call_dispatch(lib, rows, req, True, 2, start, want)
+            exp = nd.py_dispatch_eval(rows, req, True, 2, start, want)
+            assert got == tuple(exp), (start, want, got, exp)
+
+
+def test_dispatch_kernel_topology_scoring_matches_python_mirror():
+    """The TopologyMatch constraint/strategy blend (gang cycles): all
+    three strategies, with the float math in C expected bit-identical
+    (-ffp-contract=off) to CPython's."""
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    lib = native.load()
+    rows = _drow() + _drow(free=3) + _drow(free=2) + _drow(flags=0)
+    membership = [4, 2, 1, 3]
+    pool_util = [0.25, 0.5, 0.875, 0.0]
+    for strategy in (0, 1, 2):
+        for pw in (0.7, 0.3):
+            got = _call_dispatch(lib, rows, (0, 0, 0, 0), True, 1, 1, 4,
+                                 membership=membership,
+                                 pool_util=pool_util, max_membership=4,
+                                 strategy=strategy, packing_weight=pw)
+            exp = nd.py_dispatch_eval(rows, (0, 0, 0, 0), True, 1, 1, 4,
+                                      membership=membership,
+                                      pool_util=pool_util,
+                                      max_membership=4, strategy=strategy,
+                                      packing_weight=pw)
+            assert got == tuple(exp), (strategy, pw, got, exp)
+
+
+def test_dispatch_kernel_releases_gil_lanes_overlap():
+    """Non-vacuity for the headline claim: two lanes busy inside the
+    kernel (spin_us hook) must OVERLAP in wall time — impossible if the
+    call held the GIL — and the hot-path sampler, which can only run
+    mid-kernel because the GIL is free, must attribute samples to the
+    ``native:dispatch`` plugin row."""
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    from tpusched.obs.profiler import (HotPathProfiler,
+                                       set_profiling_enabled)
+    lib = native.load()
+    rows = _drow()
+    spin_s = 0.25
+    prev_enabled = set_profiling_enabled(True)
+    prof = HotPathProfiler(interval_s=0.002)
+    assert prof.ensure_started()
+    barrier = threading.Barrier(2)
+
+    def lane():
+        prev = tracectx.set_plugin("native:dispatch")
+        try:
+            barrier.wait()
+            _call_dispatch(lib, rows, (0, 0, 0, 0), False, 0, 0, 1,
+                           spin_us=int(spin_s * 1e6))
+        finally:
+            tracectx.set_plugin(prev)
+
+    lanes = [threading.Thread(target=lane, name=f"tpusched-lane-{i}")
+             for i in range(2)]
+    t0 = time.monotonic()
+    for t in lanes:
+        t.start()
+    for t in lanes:
+        t.join()
+    elapsed = time.monotonic() - t0
+    prof.stop()
+    set_profiling_enabled(prev_enabled)
+    assert elapsed < 2 * spin_s * 0.8, (
+        f"two {spin_s}s kernel calls took {elapsed:.3f}s — the lanes "
+        f"serialized, the kernel is holding the GIL")
+    native_rows = [r for r in prof.top_attribution(64)
+                   if r["plugin"] == "native:dispatch"]
+    assert native_rows, (
+        "sampler never caught a lane inside the kernel — the "
+        "native:dispatch attribution is dark")
+
+
+def test_dispatch_fallback_when_toolchain_missing(monkeypatch):
+    """With the native library unavailable, NativeDispatch.attempt must
+    decline (reason no-native) and leave the cycle to the Python path."""
+    native.reset_for_tests()
+    monkeypatch.setattr(native, "_build",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            FileNotFoundError("g++: not found")))
+    monkeypatch.setattr(native, "_source_fingerprint",
+                        lambda src: "force-stale")
+    from types import SimpleNamespace
+    from tpusched.util.metrics import native_dispatch_fallbacks
+    disp = nd.NativeDispatch(SimpleNamespace(profile=SimpleNamespace()))
+    before = native_dispatch_fallbacks.with_labels("no-native").value()
+    got = disp.attempt(state=None, pod=None, snapshot=None, infos=[],
+                       want=1, ctx=SimpleNamespace(pools_scoped=True),
+                       restricted=False)
+    assert got is None
+    after = native_dispatch_fallbacks.with_labels("no-native").value()
+    assert after == before + 1
+
+
+def test_dispatch_fallback_under_no_native_env(monkeypatch):
+    """TPUSCHED_NO_NATIVE=1 keeps the whole dispatch path pure-Python: the
+    loader declines and the Scheduler constructor never wires
+    NativeDispatch in (the in-vivo gate for the env contract)."""
+    native.reset_for_tests()
+    monkeypatch.setenv("TPUSCHED_NO_NATIVE", "1")
+    assert native.load() is None
+    from types import SimpleNamespace
+    disp = nd.NativeDispatch(SimpleNamespace(profile=SimpleNamespace()))
+    assert disp.attempt(state=None, pod=None, snapshot=None, infos=[],
+                        want=1, ctx=SimpleNamespace(pools_scoped=True),
+                        restricted=False) is None
+
+
 def test_stale_stamp_forces_rebuild():
     if shutil.which("g++") is None:
         pytest.skip("no toolchain")

@@ -278,3 +278,119 @@ def test_validator_rejects_malformed_fleet_goodput(mutate, expect):
     mutate(doc)
     probs = bench.validate_results_artifact(doc)
     assert probs and any(expect in p for p in probs), probs
+
+
+def test_native_storm_smoke_runs_and_reports(tmp_path):
+    """ISSUE 16 CI smoke: the scaled-down sharded storm through the
+    native batched dispatch inner loop — kernel engaged (non-vacuity),
+    in-cycle differential oracle on EVERY native cycle with zero
+    mismatches, the pure-Python control arm stays native-free, and the
+    record lands as ``arrival_storm_native`` — schema-v3-valid, with the
+    baseline arm + oracle stamp enforced by negative tables."""
+    import importlib
+    native = importlib.import_module("tpusched.native")
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    r = bench.run_storm_once(pools=2, duration_s=2.0, max_pending_pods=300,
+                             seed=11, drain_timeout_s=90, shards=4,
+                             native=True, native_differential_period=1)
+    assert r["binds"] > 0
+    assert r["total_binds"] == r["submitted_pods"]   # drained, no wedge
+    assert r["native"]["enabled"]
+    assert r["native"]["cycles"] > 0, (
+        f"native kernel never engaged: {r['native']}")
+    assert r["native"]["pods"] > 0
+    assert r["native"]["differential_mismatches"] == 0, (
+        f"oracle caught the kernel: {r['native']}")
+    # the pure-Python control arm must not touch the kernel
+    rp = bench.run_storm_once(pools=2, duration_s=1.0,
+                              max_pending_pods=300, seed=11,
+                              drain_timeout_s=90, shards=4, native=False)
+    assert rp["total_binds"] == rp["submitted_pods"]
+    assert rp["native"]["cycles"] == 0, (
+        f"python arm ran native cycles: {rp['native']}")
+
+    bench._record_scenario(
+        "arrival_storm_native", "throughput", shards=4,
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1,
+        python_binds_per_sec=rp["binds_per_sec"],
+        native_cycles=r["native"]["cycles"],
+        native_pods=r["native"]["pods"],
+        differential_cycles=r["native"]["cycles"],
+        differential_mismatches=0)
+    out = tmp_path / "results.json"
+    bench.write_results_artifact(str(out))
+    assert bench._gate_failures == []
+    doc = json.loads(out.read_text())
+    assert bench.validate_results_artifact(doc) == []
+    assert doc["schema_version"] == 3
+    # negative tables: the native record must carry its anatomy
+    for field in ("python_binds_per_sec", "native_cycles",
+                  "differential_cycles", "differential_mismatches"):
+        broken = json.loads(out.read_text())
+        broken["scenarios"]["arrival_storm_native"].pop(field)
+        probs = bench.validate_results_artifact(broken)
+        assert any(f"arrival_storm_native.{field}" in p for p in probs), (
+            field, probs)
+    # a nonzero mismatch count is rejected outright — the artifact must
+    # never ship a native headline the oracle disagreed with
+    broken = json.loads(out.read_text())
+    broken["scenarios"]["arrival_storm_native"]["differential_mismatches"] = 2
+    probs = bench.validate_results_artifact(broken)
+    assert any("differential_mismatches" in p for p in probs)
+    # a kernel that never ran is a fallback measurement, not a native one
+    broken = json.loads(out.read_text())
+    broken["scenarios"]["arrival_storm_native"]["native_cycles"] = 0
+    probs = bench.validate_results_artifact(broken)
+    assert any("native_cycles" in p for p in probs)
+
+
+def test_fanout_storm_smoke_runs_and_reports(tmp_path):
+    """ISSUE 16 CI smoke: the scaled-down storm with watch fan-out
+    coalesced through the commit-order batcher — flush batches actually
+    delivered, the run drains without a wedge, and the record lands as
+    ``arrival_storm_fanout`` — schema-v3-valid, with the synchronous
+    baseline + window + delivery proof enforced by negative tables."""
+    r = bench.run_storm_once(pools=2, duration_s=2.0, max_pending_pods=300,
+                             seed=11, drain_timeout_s=90, shards=4,
+                             fanout_flush_ms=1.0)
+    assert r["binds"] > 0
+    assert r["total_binds"] == r["submitted_pods"]   # drained, no wedge
+    assert r["fanout"] is not None
+    assert r["fanout"]["mode"] == "batched"
+    assert r["fanout"]["batches_delta"] >= 1, r["fanout"]
+    assert r["fanout"]["events_delta"] >= r["total_binds"], (
+        "fewer fan-out events than binds — deliveries leaked around "
+        "the batcher")
+    rs = bench.run_storm_once(pools=2, duration_s=1.0,
+                              max_pending_pods=300, seed=11,
+                              drain_timeout_s=90, shards=4)
+    assert rs["fanout"] is None                      # synchronous control
+
+    bench._record_scenario(
+        "arrival_storm_fanout", "throughput", shards=4,
+        binds_per_sec=r["binds_per_sec"], pod_e2e_p50_s=r["pod_e2e_p50_s"],
+        pod_e2e_p99_s=r["pod_e2e_p99_s"], runs=1,
+        flush_window_ms=1.0,
+        sync_binds_per_sec=rs["binds_per_sec"],
+        fanout_batches=r["fanout"]["batches_delta"],
+        fanout_events=r["fanout"]["events_delta"])
+    out = tmp_path / "results.json"
+    bench.write_results_artifact(str(out))
+    assert bench._gate_failures == []
+    doc = json.loads(out.read_text())
+    assert bench.validate_results_artifact(doc) == []
+    # negative tables: the fan-out record must carry its anatomy
+    for field in ("sync_binds_per_sec", "flush_window_ms",
+                  "fanout_batches"):
+        broken = json.loads(out.read_text())
+        broken["scenarios"]["arrival_storm_fanout"].pop(field)
+        probs = bench.validate_results_artifact(broken)
+        assert any(f"arrival_storm_fanout.{field}" in p for p in probs), (
+            field, probs)
+    # a zero-batch record measured synchronous dispatch in costume
+    broken = json.loads(out.read_text())
+    broken["scenarios"]["arrival_storm_fanout"]["fanout_batches"] = 0
+    probs = bench.validate_results_artifact(broken)
+    assert any("fanout_batches" in p for p in probs)

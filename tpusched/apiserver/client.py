@@ -332,3 +332,28 @@ class Clientset:
             events_dropped.inc()
             klog.V(4).info_s("event emission dropped", object=object_key,
                              reason=reason, err=str(e))
+
+    def record_event_deferred(self, object_key: str, kind: str, etype: str,
+                              reason: str,
+                              message_fn: Callable[[], str]) -> None:
+        """``record_event`` for hot paths: the message is built lazily on
+        the apiserver's fan-out flusher when batching is armed (synchronous
+        fallback otherwise). The trace id is thread-local, so it is
+        captured HERE on the calling thread and spliced in at format time —
+        deferral must not lose the flight-recorder correlation."""
+        tid = tracectx.get()
+
+        def build() -> str:
+            message = message_fn()
+            if tid:
+                message = f"{message} [trace={tid}]" if message \
+                    else f"[trace={tid}]"
+            return message
+
+        try:
+            self.api.record_event_deferred(object_key, kind, etype, reason,
+                                           build)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            events_dropped.inc()
+            klog.V(4).info_s("event emission dropped", object=object_key,
+                             reason=reason, err=str(e))

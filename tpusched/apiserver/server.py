@@ -17,6 +17,7 @@ Semantics mirrored from the k8s API server as the reference uses it:
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -24,6 +25,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..api.core import Binding, Event, GangMemberStatus, Pod, PodCondition
 from ..util import klog
+from ..util.metrics import (fanout_batches_total, fanout_events_total,
+                            fanout_flush_seconds)
 
 # Canonical kind names.
 PODS = "pods"
@@ -75,14 +78,158 @@ class _Lease:
                       lease_duration=self.lease_duration)
 
 
+class _FanoutBatcher:
+    """Coalesced watch fan-out (ISSUE 16 tentpole b).
+
+    In synchronous mode (the default, flush window 0) every mutator runs
+    the whole watch fan-out — every informer's cache update plus every
+    downstream handler — on its own thread before its API call returns.
+    Under storm load that makes the bind thread's critical path mostly
+    OTHER components' bookkeeping.  With a flush window armed, mutators
+    instead append their events to this queue IN COMMIT ORDER (under the
+    store lock — a deque append, nothing else) and return; one named
+    daemon thread wakes per window and delivers the accumulated batch.
+
+    Ordering contract — strictly stronger than synchronous mode: events
+    are enqueued under the store lock at commit time, so the flusher
+    delivers them in TRUE store-commit order.  Synchronous fan-out runs
+    on each mutating caller's thread and two racing writers can deliver
+    in the opposite of commit order (the PR 12 reorder class, defended by
+    the informers' per-key RV staleness rejection + tombstones).  Those
+    informer-side defenses stay on and are still required for replays and
+    mixed-mode operation; the batched path just stops generating the
+    reorder in the first place.  Per-informer FIFO handler serialization
+    (Informer._drain_pending) is untouched — the flusher is simply ONE
+    more calling thread to it, and the dedicated dispatch lock already
+    serializes handler execution.
+
+    Deferred Events (``record_event_deferred``) ride the same queue:
+    their message %-formatting and Event construction happen on the
+    flusher, so a bind commit pays one tuple append for its audit trail.
+
+    Shutdown: the thread is daemonic and dies with the process;
+    ``flush()`` drains synchronously for tests and drain barriers.
+    """
+
+    def __init__(self, window_s: float, deliver_watch: Callable[..., None],
+                 deliver_event: Callable[[Event], None]):
+        self._window_s = window_s
+        self._deliver_watch = deliver_watch
+        self._deliver_event = deliver_event
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: collections.deque = collections.deque()
+        self._stopped = False
+        self._batches = 0
+        self._delivered = 0
+        self._last_flush_s = 0.0
+        self._health_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._thread = threading.Thread(
+            target=self._run, name="apiserver-fanout-flush", daemon=True)
+        self._thread.start()
+
+    def submit(self, item) -> None:
+        """Append one WatchEvent/Event to the batch. Called under the
+        APIServer store lock — commit order IS queue order."""
+        with self._cv:
+            self._queue.append(item)
+            if len(self._queue) == 1:
+                self._cv.notify()
+
+    def flush(self) -> None:
+        """Deliver everything queued so far on the CALLING thread (tests,
+        drain barriers). Safe to race the flusher: the splice is atomic
+        and delivery order is splice order."""
+        self._flush_once()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self.flush()
+
+    def set_health_sink(self, sink: Optional[Callable[[Dict[str, Any]], None]]
+                        ) -> None:
+        self._health_sink = sink
+        if sink is not None:
+            sink(self.health())
+
+    def health(self) -> Dict[str, Any]:
+        with self._cv:
+            return {"mode": "batched",
+                    "flush_window_ms": round(self._window_s * 1e3, 3),
+                    "queue_depth": len(self._queue),
+                    "batches": self._batches,
+                    "events_delivered": self._delivered,
+                    "last_flush_s": round(self._last_flush_s, 6)}
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._queue:
+                    return
+            # coalescing window: let racing mutators pile on before the
+            # flush (duration-only sleep — no wall-clock deadline)
+            if self._window_s > 0:
+                time.sleep(self._window_s)
+            self._flush_once()
+
+    def _flush_once(self) -> None:
+        with self._cv:
+            if not self._queue:
+                return
+            batch = list(self._queue)
+            self._queue.clear()
+        t0 = time.monotonic()
+        for item in batch:
+            try:
+                if isinstance(item, WatchEvent):
+                    self._deliver_watch(item)
+                else:
+                    self._deliver_event(item() if callable(item) else item)
+            except Exception as e:  # a handler/codec panic must not stall
+                klog.error_s(e, "fanout flush delivery panicked")
+        took = time.monotonic() - t0
+        fanout_batches_total.inc()
+        fanout_events_total.inc(len(batch))
+        fanout_flush_seconds.observe(took)
+        with self._cv:
+            self._batches += 1
+            self._delivered += len(batch)
+            self._last_flush_s = took
+        sink = self._health_sink
+        if sink is not None:
+            try:
+                sink(self.health())
+            except Exception:
+                pass  # advisory telemetry only
+
+
 class APIServer:
     """The hermetic control plane. All access is via the public methods; the
     lock is never held while user callbacks run."""
 
-    def __init__(self, clock=time.time):
+    def __init__(self, clock=time.time, fanout_flush_window_s=None):
         self._clock = clock
         self._lock = threading.RLock()
         self._rv = 0
+        # Coalesced watch fan-out (ISSUE 16 tentpole b). Window 0 (the
+        # default) keeps the historical synchronous dispatch: every
+        # existing test, replay, and race-smoke run is byte-identical.
+        # A positive window arms the batcher; TPUSCHED_FANOUT_FLUSH_MS
+        # is the ops knob when the constructor isn't reachable (bench
+        # arms, canary rollout).
+        if fanout_flush_window_s is None:
+            try:
+                fanout_flush_window_s = float(
+                    os.environ.get("TPUSCHED_FANOUT_FLUSH_MS", "0")) / 1e3
+            except ValueError:
+                fanout_flush_window_s = 0.0
+        self._fanout: Optional[_FanoutBatcher] = None
+        if fanout_flush_window_s > 0:
+            self._fanout = _FanoutBatcher(
+                fanout_flush_window_s, self._dispatch, self._append_event)
         self._stores: Dict[str, Dict[str, Any]] = {k: {} for k in ALL_KINDS}
         self._handlers: Dict[str, List[Callable[[WatchEvent], None]]] = {k: [] for k in ALL_KINDS}
         # k8s Events (recorder sink). Bounded ring: real Events are TTL'd in
@@ -146,6 +293,43 @@ class APIServer:
             except Exception as e:  # handlers must not kill the server
                 klog.error_s(e, "watch handler panicked", kind=ev.kind)
 
+    def _fanout_submit_locked(self, ev: WatchEvent) -> bool:
+        """Queue ``ev`` on the batcher if one is armed. MUST be called under
+        the store lock — that is what makes queue order commit order. Lock
+        order is store→batcher only (the flusher delivers without touching
+        the store lock), so this nesting cannot deadlock."""
+        b = self._fanout
+        if b is None:
+            return False
+        b.submit(ev)
+        return True
+
+    def _append_event(self, ev: Event) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    def fanout_flush(self) -> None:
+        """Synchronously deliver all queued fan-out (no-op when the batcher
+        is off). Test/drain barrier: after this returns, every write that
+        HAPPENED-BEFORE the call has reached every informer."""
+        if self._fanout is not None:
+            self._fanout.flush()
+
+    def fanout_health(self) -> Dict[str, Any]:
+        if self._fanout is None:
+            return {"mode": "synchronous", "flush_window_ms": 0.0}
+        return self._fanout.health()
+
+    def set_fanout_health_sink(
+            self, sink: Optional[Callable[[Dict[str, Any]], None]]) -> None:
+        """Wire a health publisher (the scheduler points this at the flight
+        recorder's ``health.fanout`` slot). Advisory-only; sink panics are
+        swallowed by the batcher."""
+        if self._fanout is not None:
+            self._fanout.set_health_sink(sink)
+        elif sink is not None:
+            sink(self.fanout_health())
+
     def add_watch(self, kind: str, handler: Callable[[WatchEvent], None],
                   replay: bool = True) -> None:
         """Register a watch handler. With replay=True (client-go semantics),
@@ -190,7 +374,10 @@ class APIServer:
             self._stores[kind][key] = stored
             if self._persist:
                 self._persist("put", kind, stored)
-        self._dispatch(WatchEvent(ADDED, kind, stored))
+            ev = WatchEvent(ADDED, kind, stored)
+            deferred = self._fanout_submit_locked(ev)
+        if not deferred:
+            self._dispatch(ev)
         return stored.deepcopy()  # callers own (and may mutate) returns
 
     def get(self, kind: str, key: str):
@@ -242,7 +429,10 @@ class APIServer:
             self._stores[kind][key] = stored
             if self._persist:
                 self._persist("put", kind, stored)
-        self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
+            ev = WatchEvent(MODIFIED, kind, stored, old)
+            deferred = self._fanout_submit_locked(ev)
+        if not deferred:
+            self._dispatch(ev)
         return stored.deepcopy()
 
     def patch(self, kind: str, key: str, mutate: Callable[[Any], None]) -> Any:
@@ -259,7 +449,10 @@ class APIServer:
             self._stores[kind][key] = stored
             if self._persist:
                 self._persist("put", kind, stored)
-        self._dispatch(WatchEvent(MODIFIED, kind, stored, old))
+            ev = WatchEvent(MODIFIED, kind, stored, old)
+            deferred = self._fanout_submit_locked(ev)
+        if not deferred:
+            self._dispatch(ev)
         return stored.deepcopy()
 
     def delete(self, kind: str, key: str, uid: Optional[str] = None) -> None:
@@ -284,7 +477,10 @@ class APIServer:
             self._rv += 1
             if self._persist:
                 self._persist("delete", kind, obj)
-        self._dispatch(WatchEvent(DELETED, kind, obj))
+            ev = WatchEvent(DELETED, kind, obj)
+            deferred = self._fanout_submit_locked(ev)
+        if not deferred:
+            self._dispatch(ev)
 
     def peek(self, kind: str, key: str):
         """Zero-copy read of the live stored object (or None). Callers MUST
@@ -333,6 +529,25 @@ class APIServer:
                    message=message, timestamp=self._clock())
         with self._lock:
             self._events.append(ev)
+
+    def record_event_deferred(self, object_key: str, kind: str, etype: str,
+                              reason: str,
+                              message_fn: Callable[[], str]) -> None:
+        """record_event with the message formatting (and the events-ring
+        lock acquisition) pushed onto the fan-out flusher. The bind hot
+        path pays one timestamp read + queue append; the timestamp is
+        taken NOW so deferral never skews event time. Falls back to the
+        synchronous path when the batcher is off."""
+        if self._fanout is None:
+            self.record_event(object_key, kind, etype, reason, message_fn())
+            return
+        ts = self._clock()
+
+        def build() -> Event:
+            return Event(object_key=object_key, kind=kind, type=etype,
+                         reason=reason, message=message_fn(), timestamp=ts)
+
+        self._fanout.submit(build)
 
     def events(self) -> List[Event]:
         with self._lock:

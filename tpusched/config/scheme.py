@@ -118,6 +118,25 @@ def decode_profile(raw: Dict[str, Any]) -> PluginProfile:
             raise ConfigError(
                 f"quotaSerializeDispatch must be a boolean, got {v!r}")
         profile.quota_serialize_dispatch = v
+    # native batched dispatch (sched/nativedispatch.py): boolean gate +
+    # sampled in-cycle differential period (0 disables sampling)
+    if "nativeDispatch" in raw:
+        v = raw["nativeDispatch"]
+        if not isinstance(v, bool):
+            raise ConfigError(
+                f"nativeDispatch must be a boolean, got {v!r}")
+        profile.native_dispatch = v
+    if "nativeDispatchDifferentialPeriod" in raw:
+        try:
+            v = int(raw["nativeDispatchDifferentialPeriod"])
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "nativeDispatchDifferentialPeriod must be an integer, got "
+                f"{raw['nativeDispatchDifferentialPeriod']!r}")
+        if v < 0:
+            raise ConfigError(
+                f"nativeDispatchDifferentialPeriod must be >= 0, got {v}")
+        profile.native_dispatch_differential_period = v
     slo = raw.get("slo", {}) or {}
     if not isinstance(slo, dict):
         raise ConfigError(f"slo must be a mapping, got {type(slo).__name__}")

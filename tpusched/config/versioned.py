@@ -188,7 +188,9 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
     _check_fields("profile", raw, {"schedulerName", "plugins", "pluginConfig",
                                    "percentageOfNodesToScore",
                                    "dispatchShards", "bindPoolWorkers",
-                                   "quotaSerializeDispatch"})
+                                   "quotaSerializeDispatch",
+                                   "nativeDispatch",
+                                   "nativeDispatchDifferentialPeriod"})
     name = raw.get("schedulerName") or "tpusched"
     pct = int(raw.get("percentageOfNodesToScore") or 0)
     if not 0 <= pct <= 100:
@@ -216,6 +218,22 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         raise ConfigError(
             f"profile {name!r}: quotaSerializeDispatch must be a boolean, "
             f"got {quota_serialize!r}")
+    # native batched dispatch (sched/nativedispatch.py, ISSUE 16)
+    native_dispatch = raw.get("nativeDispatch", True)
+    if not isinstance(native_dispatch, bool):
+        raise ConfigError(
+            f"profile {name!r}: nativeDispatch must be a boolean, got "
+            f"{native_dispatch!r}")
+    try:
+        native_diff = int(raw.get("nativeDispatchDifferentialPeriod", 0))
+    except (TypeError, ValueError):
+        raise ConfigError(
+            f"profile {name!r}: nativeDispatchDifferentialPeriod must be "
+            f"an integer")
+    if native_diff < 0:
+        raise ConfigError(
+            f"profile {name!r}: nativeDispatchDifferentialPeriod must be "
+            f">= 0")
     plugins = raw.get("plugins") or {}
     for ep in plugins:
         if ep not in EXTENSION_POINTS:
@@ -260,6 +278,8 @@ def _decode_profile(raw: Dict[str, Any], version: str) -> PluginProfile:
         dispatch_shards=shards,
         bind_pool_workers=bind_workers,
         quota_serialize_dispatch=quota_serialize,
+        native_dispatch=native_dispatch,
+        native_dispatch_differential_period=native_diff,
     )
 
 

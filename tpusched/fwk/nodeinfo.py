@@ -319,6 +319,16 @@ class PooledSnapshot(Snapshot):
                 [self._pool_lists[p] for p in self._pools])
         return chain
 
+    def pool_segments(self):
+        """[(pool, per-pool NodeInfo list)] in candidate-sequence order —
+        the native dispatch packer keys its per-(pool, cursor) candidate
+        blocks off these shared lists (sched/nativedispatch.py), reusing a
+        pool's packed matrix until the pool's cursor moves.  None when the
+        snapshot was built without per-pool lists (plain test snapshots)."""
+        if self._pool_lists is None:
+            return None
+        return [(p, self._pool_lists[p]) for p in self._pools]
+
     def cursor_tuple(self):
         """Canonical sorted ((pool, cursor), ...) — the equivalence-cache
         validity witness, memoized per snapshot epoch (the per-cycle sort

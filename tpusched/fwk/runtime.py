@@ -166,6 +166,20 @@ class PluginProfile:
     # TPUSCHED_NO_WINDOW_INDEX=1 env) keeps the classic per-cycle Python
     # recompute as the only path.
     torus_window_index: bool = True
+    # Native batched dispatch inner loop (sched/nativedispatch.py, ISSUE
+    # 16): evaluate covered cycles' whole Filter→Score sweep in one
+    # GIL-released C++ call (native/torus_engine.cc), re-entering Python
+    # only for PreScore/argmax and the guarded commit.  False (or
+    # TPUSCHED_NO_NATIVE=1 / TPUSCHED_NATIVE_DISPATCH=0) keeps the
+    # pure-Python sweep as the only path.  Config YAML: `nativeDispatch`.
+    native_dispatch: bool = True
+    # Sampled in-cycle differential oracle: every Nth native cycle per
+    # lane ALSO runs the pure-Python sweep and asserts the identical
+    # placement (mismatches count
+    # tpusched_native_dispatch_differential_mismatches_total and the
+    # oracle's answer wins).  0 disables; the TPUSCHED_NATIVE_DIFFERENTIAL
+    # env overrides.  Config YAML: `nativeDispatchDifferentialPeriod`.
+    native_dispatch_differential_period: int = 0
 
     def effective_dispatch_shards(self) -> int:
         """Resolve the auto (0) setting; always >= 1."""

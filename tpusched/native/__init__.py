@@ -37,7 +37,11 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _attempted = False
 
-_CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC"]
+# -ffp-contract=off: the dispatch kernel replicates CPython float scoring
+# (TopologyMatch's weighted blend) bit-for-bit; FMA contraction on targets
+# that fuse by default (aarch64 gcc) would round differently at int()
+# truncation boundaries and break the native-vs-oracle differential.
+_CXX_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC", "-ffp-contract=off"]
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -66,6 +70,16 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tpusched_index_apply.argtypes = [
         u64p, ctypes.c_int64, ctypes.c_int32, i64p, i64p, i64p, i8p,
         ctypes.c_int64, i32p, i64p, u64p]
+    # batched dispatch inner loop (ISSUE 16)
+    lib.tpusched_dispatch_eval.restype = ctypes.c_int64
+    lib.tpusched_dispatch_eval.argtypes = [
+        ctypes.POINTER(i64p), i64p, ctypes.c_int32,   # blocks/lens/nblocks
+        i64p, ctypes.c_int32, ctypes.c_int64,         # req/chips_set/chips_req
+        ctypes.c_int64, ctypes.c_int64,               # start/want
+        i64p, ctypes.POINTER(ctypes.c_double),        # membership/pool_util
+        ctypes.c_int64, ctypes.c_int32,               # max_membership/strategy
+        ctypes.c_double, ctypes.c_int64,              # packing_weight/spin_us
+        i64p, i64p, i64p, i64p]                       # feasible/raw/topo/visited
     return lib
 
 

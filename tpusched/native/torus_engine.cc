@@ -15,7 +15,9 @@
 // stride[rank-1] = 1. Masks are little-endian uint64 words:
 // word w, bit b ⇔ cell w*64+b.
 
+#include <cmath>
 #include <cstdint>
+#include <ctime>
 #include <set>
 #include <vector>
 
@@ -267,6 +269,153 @@ int64_t tpusched_index_apply(const uint64_t* masks, int64_t n, int32_t words,
     }
   }
   return delta;
+}
+
+}  // extern "C"
+
+// -- batched dispatch inner loop (ISSUE 16) ----------------------------------
+//
+// One call evaluates a whole cycle's candidate sweep — the per-node Filter
+// chain, the rotating-start / stop-at-want visit order, TpuSlice +
+// TopologyMatch scoring with TpuSlice's normalize — over packed per-pool
+// candidate blocks, re-entering Python only for the final name tie-break and
+// the guarded commit.  Candidate blocks are row-major int64 matrices of
+// kDispatchFields per node (pod-independent facts, packed/reused per
+// (pool, cursor) epoch by sched/nativedispatch.py):
+//
+//   0..3  allocatable  [cpu, memory, pods, tpu-chips]
+//   4..7  requested    [cpu, memory, pods, tpu-chips]   (resident-pod sums)
+//   8     used_chips_limit   (Σ TPU-chip limits over resident TPU pods)
+//   9     used_mem_limit     (Σ TPU-memory limits over resident TPU pods)
+//   10    hbm_total_mb
+//   11    free_chips         (wholly-free chip count, ChipNode semantics)
+//   12    flags: bit0 healthy, bit1 has-hard-taint (NoSchedule/NoExecute)
+//
+// The semantics replicated here are pinned by the pure-Python oracle
+// (sched/nativedispatch.py:py_dispatch_eval) and the in-cycle sampled
+// differential in the scheduler; any drift is a bug in THIS file.
+// Float scoring uses plain IEEE double ops — the build adds
+// -ffp-contract=off so FMA contraction cannot diverge from CPython.
+
+namespace {
+
+constexpr int kDispatchFields = 13;
+constexpr int64_t kMaxNodeScore = 100;
+constexpr uint64_t kFlagHealthy = 1;
+constexpr uint64_t kFlagHardTaint = 2;
+
+inline int64_t strategy_score(int32_t strategy, double util) {
+  // TopologyMatch._strategy_score: 0 LeastAllocated, 1 MostAllocated,
+  // 2 BalancedAllocation — int() truncation matches the C cast for the
+  // non-negative range these produce.
+  if (strategy == 1) return static_cast<int64_t>(util * 100.0);
+  if (strategy == 2)
+    return static_cast<int64_t>((1.0 - std::fabs(util - 0.5) * 2.0) * 100.0);
+  return static_cast<int64_t>((1.0 - util) * 100.0);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Evaluate one cycle's candidate sweep.  Returns the feasible count
+// (bounded by want); out_feasible receives global candidate indexes in
+// visit order, out_raw the per-feasible TpuSlice raw score (free chips),
+// out_topo the per-feasible weighted TopologyMatch score, and *out_visited
+// the number of candidates evaluated (the rotation-advance input).
+//
+//   blocks/block_lens/nblocks: per-pool candidate matrices, concatenated
+//       in candidate-sequence order; global index i lives in the block
+//       containing prefix offset i.
+//   req: the pod's effective request [cpu, memory, pods, tpu-chips];
+//       0 ⇔ resource absent (NodeResourcesFit checks only v>0 entries).
+//   chips_set/chips_req: TpuSlice whole-chip ask (chips_set may be 1 with
+//       chips_req 0, mirroring a zero-valued limit).
+//   start/want: rotating sweep origin and the stop-at-want bound; the stop
+//       is checked BEFORE each visit, matching Parallelizer.until inline.
+//   membership/pool_util: optional per-candidate gang-stash columns
+//       (TopologyMatch _CycleStash); null for non-slice pods.
+//   spin_us: test-only busy-wait inside the GIL-released region (the
+//       native-smoke overlap proof); 0 in production.
+int64_t tpusched_dispatch_eval(
+    const int64_t* const* blocks, const int64_t* block_lens, int32_t nblocks,
+    const int64_t* req, int32_t chips_set, int64_t chips_req, int64_t start,
+    int64_t want, const int64_t* membership, const double* pool_util,
+    int64_t max_membership, int32_t strategy, double packing_weight,
+    int64_t spin_us, int64_t* out_feasible, int64_t* out_raw,
+    int64_t* out_topo, int64_t* out_visited) {
+  if (spin_us > 0) {
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    for (;;) {
+      clock_gettime(CLOCK_MONOTONIC, &t1);
+      const int64_t us = (t1.tv_sec - t0.tv_sec) * 1000000 +
+                         (t1.tv_nsec - t0.tv_nsec) / 1000;
+      if (us >= spin_us) break;
+    }
+  }
+  int64_t n = 0;
+  for (int32_t b = 0; b < nblocks; ++b) n += block_lens[b];
+  *out_visited = 0;
+  if (n <= 0) return 0;
+
+  int64_t nf = 0;
+  int64_t visited = 0;
+  for (int64_t idx = 0; idx < n; ++idx) {
+    if (nf >= want) break;  // stop() checked before each visit
+    const int64_t oi = (start + idx) % n;
+    // locate oi's block (nblocks is single/double digit; linear scan)
+    int64_t off = 0;
+    int32_t b = 0;
+    while (b < nblocks && oi >= off + block_lens[b]) {
+      off += block_lens[b];
+      ++b;
+    }
+    const int64_t* r = blocks[b] + (oi - off) * kDispatchFields;
+    ++visited;
+    const uint64_t flags = static_cast<uint64_t>(r[12]);
+    // NodeUnschedulable + TpuSlice/TopologyMatch health gates
+    if (!(flags & kFlagHealthy)) continue;
+    // TaintToleration for a toleration-less pod: any hard taint rejects
+    if (flags & kFlagHardTaint) continue;
+    // NodeResourcesFit over the v>0 request entries
+    bool fit = true;
+    for (int k = 0; k < 4; ++k) {
+      if (req[k] > 0 && r[4 + k] + req[k] > r[k]) {
+        fit = false;
+        break;
+      }
+    }
+    if (!fit) continue;
+    if (chips_set) {
+      // TpuSlice.filter for a whole-chip pod
+      if (r[3] <= 0) continue;                   // unknown resource type
+      if (r[8] + chips_req > r[3]) continue;     // insufficient chips
+      if (r[9] > r[10]) continue;                // insufficient tpu-memory
+      if (r[11] < chips_req) continue;           // no fit indexes
+    }
+    // TopologyMatch.filter: membership probe against the PreFilter stash
+    if (membership != nullptr && membership[oi] <= 0) continue;
+
+    out_feasible[nf] = oi;
+    // TpuSlice raw score: free chips for whole-chip pods, else 0 (the
+    // normalize over the feasible set happens in one pass below)
+    out_raw[nf] = (chips_set && r[3] > 0) ? r[11] : 0;
+    if (membership != nullptr) {
+      const int64_t maxm = max_membership > 0 ? max_membership : 1;
+      const int64_t constraint =
+          kMaxNodeScore * (max_membership - membership[oi]) / maxm;
+      const int64_t strat = strategy_score(strategy, pool_util[oi]);
+      const double v = static_cast<double>(constraint) * packing_weight +
+                       static_cast<double>(strat) * (1.0 - packing_weight);
+      out_topo[nf] = static_cast<int64_t>(v);
+    } else {
+      out_topo[nf] = 0;
+    }
+    ++nf;
+  }
+  *out_visited = visited;
+  return nf;
 }
 
 }  // extern "C"

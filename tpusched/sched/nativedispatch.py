@@ -1,0 +1,555 @@
+"""Native batched dispatch inner loop (ISSUE 16).
+
+The sharded core (ROADMAP item 1, ISSUEs 12-14) made every per-cycle cost
+O(Δ) — except the dispatch inner loop itself: the per-node Filter sweep and
+the Score pass are pure Python, so N shard lanes time-slice one interpreter
+and lane concurrency tops out at ~1.5-2x (doc/performance.md).  This module
+packs a cycle's candidate set into flat int64 matrices and evaluates the
+whole Filter→Score sweep in ONE ctypes call into the native torus engine
+(tpusched_dispatch_eval) — ctypes releases the GIL for the call, so lanes
+finally overlap inside the hot loop.  Python is re-entered only for the
+final argmax name tie-break and the guarded commit
+(Cache.assume_pod_guarded stays the authoritative compare-and-reserve).
+
+Exactness contract — the kernel must be BIT-IDENTICAL to the pure-Python
+path, which stays on as the oracle:
+
+- Coverage is opt-out, not best-effort: ``attempt`` declines (returns None,
+  counted per reason in tpusched_native_dispatch_fallbacks_total) whenever
+  the cycle's semantics are not provably replicated — unknown/unskipped
+  plugins, nominated pods, non-canonical pod shapes (node name/selector,
+  tolerations, fractional TPU memory, exotic resources), live freed-window
+  claims, non-integer resource values, non-inline lanes (the thread-pool
+  sweep's feasible set is nondeterministic by contract), and zero-feasible
+  outcomes (the Python path re-runs to produce byte-identical diagnosis).
+- The visit order replicates Parallelizer.until's inline contract: rotate
+  from ctx.next_start_node_index, stop checked BEFORE each visit once
+  ``want`` feasible nodes are found, and the rotation advance is
+  (start + max(visited, 1)) % n — exactly _find_feasible's bookkeeping.
+- Scoring replicates run_score_plugins for the covered plugin set:
+  TpuSlice raw = free chips, default-normalized over the feasible set
+  (reverse ⇔ binpack), TopologyMatch's weighted constraint/strategy blend
+  (computed in C with -ffp-contract=off so the float math matches CPython),
+  each times its profile weight; argmax ties break on the
+  lexicographically-last node name, in Python, like _select_host.
+- A sampled in-cycle differential (native_dispatch_differential_period /
+  TPUSCHED_NATIVE_DIFFERENTIAL) re-runs the pure-Python sweep with the same
+  rotation start and asserts the identical placement; a mismatch counts
+  tpusched_native_dispatch_differential_mismatches_total, logs, and uses
+  the ORACLE's answer for that cycle.
+
+Candidate packing amortizes like the pooled snapshots it reads (ISSUE 13):
+per-(pool, cursor) blocks are packed once and reused by reference until the
+pool's cursor moves, so a quiet pool costs nothing and a bind re-packs one
+pool, not the partition.  Gang cycles (restricted node sets from
+TopologyMatch's window stash) pack ad hoc per cycle — the stash already
+collapsed the candidate set to window survivors.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .. import native
+from ..api.core import Pod, node_health_error
+from ..api.resources import CPU, MEMORY, PODS, TPU
+from ..fwk import CycleState, Status
+from ..util import klog, tracectx
+from ..util.metrics import (native_dispatch_cycles_total,
+                            native_dispatch_differential_mismatches,
+                            native_dispatch_fallbacks,
+                            native_dispatch_pods_total)
+
+# One row per candidate node, int64 each — mirrored by kDispatchFields in
+# native/torus_engine.cc (keep in lockstep).
+DISPATCH_FIELDS = 13
+_FLAG_HEALTHY = 1
+_FLAG_HARD_TAINT = 2
+
+# Filter plugins whose semantics the kernel replicates; any OTHER filter in
+# the profile must be per-cycle skipped (PreFilter Skip) or the cycle falls
+# back.  NodeResourcesFit is covered through its batch semantics (the
+# kernel's fit pass IS filter_batch's fused loop).
+_COVERED_FILTERS = frozenset({
+    "TopologyMatch", "NodeUnschedulable", "NodeName", "NodeSelector",
+    "TaintToleration", "NodeResourcesFit", "TpuSlice",
+})
+_COVERED_SCORERS = frozenset({"TpuSlice", "TopologyMatch"})
+_STRATEGY_CODES = {"LeastAllocated": 0, "MostAllocated": 1,
+                   "BalancedAllocation": 2}
+
+# CycleState keys shared with the plugins (framework-level contract: the
+# scheduler core reads them by name, like QUOTA_GUARD_STATE_KEY).
+_TOPO_STATE_KEY = "TopologyMatch/state"
+_TOPO_CLAIMS_KEY = "TopologyMatch/claimed-hosts"
+_FIT_REQ_KEY = "NodeResourcesFit/pod-request"
+
+_CANONICAL = (CPU, MEMORY, PODS, TPU)
+
+
+class _ProfileSupport:
+    """Once-per-scheduler verdict: can this profile's Filter/Score plugin
+    wiring be replicated by the kernel at all, and with which parameters."""
+
+    __slots__ = ("ok", "skip_needed", "score_skip_needed", "w_tpu", "w_topo",
+                 "reverse_tpu", "strategy", "packing_weight")
+
+    def __init__(self, fw) -> None:
+        self.ok = False
+        self.skip_needed: frozenset = frozenset()
+        self.score_skip_needed: frozenset = frozenset()
+        self.w_tpu = 0
+        self.w_topo = 0
+        self.reverse_tpu = False
+        self.strategy = 0
+        self.packing_weight = 0.7
+        filter_names = {p.name() for p in fw.filter_plugins}
+        batch_names = {p.name() for p in fw.batch_filter_plugins}
+        if not batch_names <= {"NodeResourcesFit"}:
+            return
+        self.skip_needed = frozenset(filter_names - _COVERED_FILTERS)
+        score_extra = set()
+        for plugin, weight in fw.score_plugins:
+            name = plugin.name()
+            if name == "TpuSlice":
+                self.w_tpu = weight
+                self.reverse_tpu = plugin.args.score_mode == "binpack"
+            elif name == "TopologyMatch":
+                self.w_topo = weight
+                strategy = _STRATEGY_CODES.get(plugin.args.scoring_strategy)
+                if strategy is None:
+                    return
+                self.strategy = strategy
+                self.packing_weight = plugin.args.packing_weight
+            else:
+                score_extra.add(name)
+        self.score_skip_needed = frozenset(score_extra)
+        # pre-score plugins run for real in _select (same as _select_host),
+        # so they need no coverage here — only their SCORE methods must end
+        # up skipped, which _select re-checks per cycle after PreScore.
+        self.ok = True
+
+
+class _Block:
+    """One pool's packed candidate matrix, valid while the pool's cursor
+    (and the identity of its shared per-pool NodeInfo list) is unchanged."""
+
+    __slots__ = ("cursor", "list_id", "n", "buf", "infos")
+
+    def __init__(self, cursor: int, list_id: int, n: int, buf, infos) -> None:
+        self.cursor = cursor
+        self.list_id = list_id
+        self.n = n
+        self.buf = buf
+        self.infos = infos      # the shared (read-only) per-pool list
+
+
+class _Arena:
+    """Per-lane scratch: pool blocks + reusable kernel in/out buffers.
+    Lane-confined (lives on _LaneContext), so no locking."""
+
+    __slots__ = ("blocks", "req_buf", "out_cap", "out_feasible", "out_raw",
+                 "out_topo", "out_visited", "differential_tick")
+
+    def __init__(self) -> None:
+        self.blocks: Dict[str, _Block] = {}
+        self.req_buf = (ctypes.c_int64 * 4)()
+        self.out_cap = 0
+        self.out_feasible = None
+        self.out_raw = None
+        self.out_topo = None
+        self.out_visited = (ctypes.c_int64 * 1)()
+        self.differential_tick = 0
+
+    def ensure_out(self, want: int) -> None:
+        if want > self.out_cap:
+            cap = max(want, 128)
+            self.out_cap = cap
+            self.out_feasible = (ctypes.c_int64 * cap)()
+            self.out_raw = (ctypes.c_int64 * cap)()
+            self.out_topo = (ctypes.c_int64 * cap)()
+
+
+def pack_rows(infos) -> List[int]:
+    """The pod-independent per-node dispatch facts, row-major — the single
+    definition both the arena packer and the parity tests use.  Raises
+    (TypeError/OverflowError via the ctypes copy downstream, ValueError
+    here) on rows the kernel cannot represent exactly."""
+    from ..plugins.tpuslice.chip_node import ChipNode
+    vals: List[int] = []
+    for info in infos:
+        node = info.node
+        alloc = info.allocatable
+        req = info.requested
+        flags = _FLAG_HEALTHY if node_health_error(node) is None else 0
+        for t in node.spec.taints:
+            if t.effect in ("NoSchedule", "NoExecute"):
+                flags |= _FLAG_HARD_TAINT
+                break
+        cn = ChipNode.cached(info)
+        if cn is None:
+            ucl = uml = hbm = free = 0
+        else:
+            ucl = cn.used_chips_limit
+            uml = cn.used_mem_limit
+            hbm = cn.hbm_total_mb
+            free = len(cn.free_chip_indexes())
+        row = (alloc.get(CPU, 0), alloc.get(MEMORY, 0), alloc.get(PODS, 0),
+               alloc.get(TPU, 0), req.get(CPU, 0), req.get(MEMORY, 0),
+               req.get(PODS, 0), req.get(TPU, 0), ucl, uml, hbm, free, flags)
+        for v in row:
+            # bool is an int; exact floats (test fixtures) are NOT packable
+            if type(v) is not int and not isinstance(v, bool):
+                raise ValueError(f"non-integer dispatch fact {v!r} "
+                                 f"on node {node.name}")
+        vals.extend(row)
+    return vals
+
+
+def py_dispatch_eval(rows: List[int], req, chips_set: bool, chips_req: int,
+                     start: int, want: int, membership=None, pool_util=None,
+                     max_membership: int = 1, strategy: int = 0,
+                     packing_weight: float = 0.7):
+    """Pure-Python mirror of tpusched_dispatch_eval over one packed row
+    matrix — the parity-suite oracle for the kernel itself (the scheduler's
+    oracle is the real plugin path).  Returns (feasible, raws, topos,
+    visited)."""
+    n = len(rows) // DISPATCH_FIELDS
+    feasible: List[int] = []
+    raws: List[int] = []
+    topos: List[int] = []
+    visited = 0
+    for idx in range(n):
+        if len(feasible) >= want:
+            break
+        oi = (start + idx) % n
+        r = rows[oi * DISPATCH_FIELDS:(oi + 1) * DISPATCH_FIELDS]
+        visited += 1
+        flags = r[12]
+        if not flags & _FLAG_HEALTHY:
+            continue
+        if flags & _FLAG_HARD_TAINT:
+            continue
+        if any(req[k] > 0 and r[4 + k] + req[k] > r[k] for k in range(4)):
+            continue
+        if chips_set:
+            if r[3] <= 0 or r[8] + chips_req > r[3] or r[9] > r[10] \
+                    or r[11] < chips_req:
+                continue
+        if membership is not None and membership[oi] <= 0:
+            continue
+        feasible.append(oi)
+        raws.append(r[11] if (chips_set and r[3] > 0) else 0)
+        if membership is not None:
+            maxm = max(1, max_membership)
+            constraint = 100 * (max_membership - membership[oi]) // maxm
+            util = pool_util[oi]
+            if strategy == 1:
+                strat = int(util * 100.0)
+            elif strategy == 2:
+                strat = int((1.0 - abs(util - 0.5) * 2.0) * 100.0)
+            else:
+                strat = int((1.0 - util) * 100.0)
+            topos.append(int(constraint * packing_weight
+                             + strat * (1.0 - packing_weight)))
+        else:
+            topos.append(0)
+    return feasible, raws, topos, visited
+
+
+def combine_scores(raws: List[int], topos: List[int], w_tpu: int,
+                   w_topo: int, reverse_tpu: bool) -> List[int]:
+    """run_score_plugins' totals for the covered plugin pair: TpuSlice
+    default-normalize over the feasible set (reverse ⇔ binpack), then the
+    weighted sum.  Shared by the dispatch path and the parity tests."""
+    max_raw = max(raws, default=0)
+    totals = []
+    for raw, topo in zip(raws, topos):
+        s = raw * 100 // max_raw if max_raw > 0 else raw
+        if reverse_tpu:
+            s = 100 - s
+        totals.append(s * w_tpu + topo * w_topo)
+    return totals
+
+
+class NativeDispatch:
+    """The scheduler-side driver.  One instance per Scheduler; all mutable
+    per-lane state lives on the lane context's arena."""
+
+    def __init__(self, scheduler) -> None:
+        self._sched = scheduler
+        self._support: Optional[_ProfileSupport] = None
+        self._lib_checked = False
+        self._lib = None
+        period = os.environ.get("TPUSCHED_NATIVE_DIFFERENTIAL")
+        if period is not None:
+            self.differential_period = int(period)
+        else:
+            self.differential_period = getattr(
+                scheduler.profile, "native_dispatch_differential_period", 0)
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _lib_or_none(self):
+        if not self._lib_checked:
+            self._lib = native.load()
+            self._lib_checked = True
+        return self._lib
+
+    def _profile_support(self) -> _ProfileSupport:
+        if self._support is None:
+            self._support = _ProfileSupport(self._sched._fw)
+        return self._support
+
+    @staticmethod
+    def _decline(reason: str) -> None:
+        native_dispatch_fallbacks.with_labels(reason).inc()
+        return None
+
+    # -- the per-cycle entry point -------------------------------------------
+
+    def attempt(self, state: CycleState, pod: Pod, snapshot, infos,
+                want: int, ctx, restricted: bool, view=None
+                ) -> Optional[Tuple[str, Status]]:
+        """Evaluate this cycle natively if every semantic is covered.
+        Returns (node_name, status) to use as the cycle's Filter+Score
+        outcome — with ctx's rotation advanced exactly as _find_feasible
+        would — or None to run the pure-Python path (ctx untouched)."""
+        if not ctx.pools_scoped:
+            # the thread-pool sweep's feasible set is nondeterministic by
+            # contract; only the inline (lane-is-the-parallelism) sweep is
+            # replicable bit-for-bit
+            return self._decline("lane")
+        lib = self._lib_or_none()
+        if lib is None:
+            return self._decline("no-native")
+        sup = self._profile_support()
+        if not sup.ok:
+            return self._decline("profile")
+        if not self._sched.handle.pod_nominator.empty():
+            return self._decline("nominated")
+        if not sup.skip_needed <= state.skip_filter_plugins:
+            return self._decline("plugin-active")
+        stash = state.try_read(_TOPO_STATE_KEY)
+        if stash is None and state.try_read(_TOPO_CLAIMS_KEY):
+            return self._decline("claims")
+        spec = pod.spec
+        if spec.node_name or spec.node_selector or spec.tolerations:
+            return self._decline("pod-shape")
+        from ..plugins.tpuslice.chip_node import pod_tpu_limits
+        chips_req, chips_set, _, mem_set = pod_tpu_limits(pod)
+        if mem_set:
+            return self._decline("pod-shape")
+
+        def build_request():
+            from ..util.podutil import pod_effective_request
+            req = pod_effective_request(pod)
+            req["pods"] = 1
+            return tuple((k, v) for k, v in req.items() if v > 0)
+
+        request = state.read_or_init(_FIT_REQ_KEY, build_request)
+        req_map = dict(request)
+        if any(k not in _CANONICAL for k in req_map):
+            return self._decline("pod-shape")
+
+        arena = ctx.native_arena
+        if arena is None:
+            arena = ctx.native_arena = _Arena()
+        n = len(infos)
+        start = ctx.next_start_node_index % n
+
+        try:
+            if restricted or stash is not None \
+                    or getattr(snapshot, "pool_segments", None) is None:
+                # gang/restricted cycles: the candidate set is already the
+                # PreFilter-narrowed survivor list (small), packed ad hoc
+                packed = self._pack_adhoc(arena, infos, stash)
+            else:
+                packed = self._pack_pooled(arena, snapshot, n)
+        except (ValueError, TypeError, OverflowError):
+            return self._decline("pack-error")
+        if packed is None:
+            return self._decline("pack-error")
+        block_ptrs, block_lens, nblocks, keepalive, memb_arr, util_arr, \
+            maxm = packed
+
+        arena.ensure_out(want)
+        req_buf = arena.req_buf
+        for k, res in enumerate(_CANONICAL):
+            v = req_map.get(res, 0)
+            if type(v) is not int:
+                return self._decline("pod-shape")
+            req_buf[k] = v
+
+        prev = tracectx.set_plugin("native:dispatch")
+        try:
+            nf = lib.tpusched_dispatch_eval(
+                block_ptrs, block_lens, nblocks, req_buf,
+                1 if chips_set else 0, chips_req, start, want,
+                memb_arr, util_arr, maxm, sup.strategy,
+                sup.packing_weight, 0,
+                arena.out_feasible, arena.out_raw, arena.out_topo,
+                arena.out_visited)
+        finally:
+            tracectx.set_plugin(prev)
+        native_dispatch_cycles_total.inc()
+        visited = arena.out_visited[0]
+        if nf <= 0:
+            # zero feasible: the Python path re-runs for byte-identical
+            # diagnosis aggregation (failures are off the throughput path)
+            return self._decline("no-feasible")
+
+        del keepalive  # buffers only needed alive through the kernel call
+        advance = (start + max(visited, 1)) % n
+        feasible_nodes = [infos[i].node for i in arena.out_feasible[:nf]]
+        raws = list(arena.out_raw[:nf])
+        topos = list(arena.out_topo[:nf])
+
+        # snapshot the data map BEFORE Score-phase writes, exactly like
+        # _schedule_full: the entry the offer below arms may hold
+        # PreFilter/Filter state only
+        prefilter_export = None
+        if ctx.equiv_cache is not None:
+            from .scheduler import _EQUIV_EXCLUDE_KEYS
+            prefilter_export = state.export(exclude=_EQUIV_EXCLUDE_KEYS)
+
+        result = self._select(state, pod, feasible_nodes, raws, topos, sup)
+        if result is None:
+            return self._decline("prescore")
+        node_name, status = result
+
+        mismatch = False
+        if self.differential_period > 0:
+            arena.differential_tick += 1
+            if arena.differential_tick >= self.differential_period:
+                arena.differential_tick = 0
+                oracle = self._differential(state, pod, infos, want, start,
+                                            node_name, status)
+                if oracle is not None:
+                    mismatch = True
+                    node_name, status, advance = oracle
+        ctx.next_start_node_index = advance
+        state.write("tpusched/diagnosis", {})
+        if status.is_success():
+            native_dispatch_pods_total.inc()
+            if not mismatch:
+                # arm the equivalence cache exactly as the Python full path
+                # would — gang siblings depend on this fast path (a
+                # complete sweep is required; the sampled big-partition
+                # sweep keeps swept_all False, same as _schedule_full)
+                self._sched._equiv_offer(pod, state, feasible_nodes,
+                                         swept_all=want >= n,
+                                         prefilter_data=prefilter_export,
+                                         ctx=ctx, view=view)
+        return node_name, status
+
+    # -- packing --------------------------------------------------------------
+
+    def _pack_pooled(self, arena: _Arena, snapshot, n: int):
+        """Per-(pool, cursor) cached blocks over the pooled snapshot's
+        shared per-pool lists, concatenated in candidate-sequence order
+        (PoolChain order == pool_segments order, so the kernel's global
+        index maps straight back through ``infos[gi]``)."""
+        segments = snapshot.pool_segments()
+        if segments is None:
+            return None
+        cursors = snapshot.pool_cursors
+        blocks: List[_Block] = []
+        total = 0
+        for pool, lst in segments:
+            cursor = cursors.get(pool, -1)
+            blk = arena.blocks.get(pool)
+            if blk is None or blk.cursor != cursor \
+                    or blk.list_id != id(lst) or blk.n != len(lst):
+                vals = pack_rows(lst)
+                buf = (ctypes.c_int64 * max(1, len(vals)))(*vals)
+                blk = _Block(cursor, id(lst), len(lst), buf, lst)
+                arena.blocks[pool] = blk
+            blocks.append(blk)
+            total += blk.n
+        if total != n:
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        nblocks = len(blocks)
+        block_ptrs = (i64p * max(1, nblocks))(
+            *[ctypes.cast(b.buf, i64p) for b in blocks])
+        block_lens = (ctypes.c_int64 * max(1, nblocks))(
+            *[b.n for b in blocks])
+        return block_ptrs, block_lens, nblocks, blocks, None, None, 1
+
+    def _pack_adhoc(self, arena: _Arena, infos, stash):
+        """Per-cycle single-block pack for restricted (gang) candidate sets
+        and non-pooled snapshots; carries the gang stash columns."""
+        infos = list(infos)
+        vals = pack_rows(infos)
+        buf = (ctypes.c_int64 * max(1, len(vals)))(*vals)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        block_ptrs = (i64p * 1)(ctypes.cast(buf, i64p))
+        block_lens = (ctypes.c_int64 * 1)(len(infos))
+        memb_arr = util_arr = None
+        maxm = 1
+        if stash is not None:
+            n = len(infos)
+            memb_arr = (ctypes.c_int64 * max(1, n))()
+            util_arr = (ctypes.c_double * max(1, n))()
+            for i, info in enumerate(infos):
+                ent = stash.allowed.get(info.node.name)
+                if ent is None:
+                    memb_arr[i] = -1
+                else:
+                    memb_arr[i] = ent[1]
+                    util_arr[i] = ent[2]
+            maxm = stash.max_membership
+        return block_ptrs, block_lens, 1, buf, memb_arr, util_arr, maxm
+
+    # -- selection ------------------------------------------------------------
+
+    def _select(self, state: CycleState, pod: Pod, feasible_nodes, raws,
+                topos, sup: _ProfileSupport):
+        """_select_host's semantics over the kernel outputs.  Returns
+        (node, status), or None to fall back (pre-score anomaly)."""
+        if len(feasible_nodes) == 1:
+            return feasible_nodes[0].name, Status.success()
+        s = self._sched._timed_point("PreScore",
+                                     self._sched._fw.run_pre_score_plugins,
+                                     state, pod, feasible_nodes)
+        if not s.is_success():
+            return "", s
+        if sup.score_skip_needed - state.skip_score_plugins:
+            # a scorer the kernel cannot replicate would actually run
+            return None
+        totals = combine_scores(raws, topos, sup.w_tpu, sup.w_topo,
+                                sup.reverse_tpu)
+        best = max(zip(totals, (n.name for n in feasible_nodes)))
+        return best[1], Status.success()
+
+    # -- sampled in-cycle oracle ----------------------------------------------
+
+    def _differential(self, state: CycleState, pod: Pod, infos, want: int,
+                      start: int, node_name: str, status: Status):
+        """Re-run the pure-Python sweep with the same rotation start and
+        compare placements.  On mismatch: count, log, and return the
+        oracle's (node, status, advance) — correctness wins over speed."""
+        sched = self._sched
+
+        class _ShimCtx:
+            next_start_node_index = start
+
+        shim = _ShimCtx()
+        feasible, _diag, error = sched._find_feasible(
+            state, pod, infos, want, shim)
+        if error is not None:
+            o_node, o_status = "", error
+        elif not feasible:
+            o_node, o_status = "", Status.unschedulable("0 nodes (oracle)")
+        else:
+            o_node, o_status = sched._select_host(state, pod, feasible)
+        if o_node == node_name and o_status.is_success() \
+                == status.is_success():
+            return None
+        native_dispatch_differential_mismatches.inc()
+        klog.error_s(None, "native dispatch differential mismatch",
+                     pod=pod.key, native=node_name or "<none>",
+                     oracle=o_node or "<none>")
+        return o_node, o_status, shim.next_start_node_index

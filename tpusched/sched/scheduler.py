@@ -98,7 +98,7 @@ class _LaneContext:
 
     __slots__ = ("lane", "pools_scoped", "equiv_cache", "equiv_pending",
                  "next_start_node_index", "partition_pools",
-                 "partition_sig", "thread", "queue_wait")
+                 "partition_sig", "thread", "queue_wait", "native_arena")
 
     def __init__(self, lane: str, pools_scoped: bool,
                  equiv_cache: Optional[EquivalenceCache],
@@ -118,6 +118,9 @@ class _LaneContext:
         # series in the process-global family
         self.queue_wait = queue_wait_seconds.with_labels(lane) \
             if telemetry else None
+        # native batched-dispatch scratch (sched/nativedispatch._Arena),
+        # lane-confined like the equivalence cache; lazily created
+        self.native_arena = None
 
 
 class _DegradedMode:
@@ -557,6 +560,28 @@ class Scheduler:
         self.handle.window_index = self.window_index
         self.handle.window_index_resync = self.cache.sync_window_index
         self._fw = Framework(registry, profile, self.handle)
+
+        # Native batched dispatch inner loop (sched/nativedispatch.py,
+        # ISSUE 16): the whole Filter→Score sweep for covered cycles runs
+        # as one GIL-released kernel call; the pure-Python path stays on as
+        # the sampled in-cycle oracle and the TPUSCHED_NO_NATIVE fallback.
+        self._native = None
+        if profile.native_dispatch \
+                and not os.environ.get("TPUSCHED_NO_NATIVE") \
+                and os.environ.get("TPUSCHED_NATIVE_DISPATCH") != "0":
+            from .nativedispatch import NativeDispatch
+            self._native = NativeDispatch(self)
+
+        # health.fanout for /debug/flightrecorder: the apiserver's fan-out
+        # batcher pushes a snapshot after every flush (mode, window, queue
+        # depth, batch counters); in synchronous mode one static snapshot
+        # is published so the mode is always inspectable.
+        try:
+            api.set_fanout_health_sink(
+                lambda h: self.recorder.set_health("fanout", h))
+        except Exception as e:  # noqa: BLE001 — advisory wiring only
+            klog.V(4).info_s("fanout health sink wiring skipped",
+                             err=str(e))
 
         # Plugins without EnqueueExtensions default to all-events (upstream
         # semantics: only declared hints narrow the requeue set).
@@ -1629,6 +1654,14 @@ class Scheduler:
             return "", Status.unschedulable(
                 "0 nodes are available: dispatch shard owns no pools")
         want = self._num_feasible_nodes_to_find(len(infos))
+        if self._native is not None and record:
+            # ``record=False`` marks a differential/oracle re-run — those
+            # must exercise the pure-Python path by definition
+            result = self._native.attempt(state, pod, snapshot, infos, want,
+                                          ctx, restricted=rset is not None,
+                                          view=view)
+            if result is not None:
+                return result
         feasible, diagnosis, error = self._timed_point(
             "Filter", self._find_feasible, state, pod, infos, want, ctx)
         if error is not None:
@@ -2199,9 +2232,9 @@ class Scheduler:
             max(0.0, self.clock() - getattr(info,
                                             "initial_attempt_timestamp",
                                             cycle_start)))
-        self.clientset.record_event(
+        self.clientset.record_event_deferred(
             pod.key, "Pod", "Normal", "Scheduled",
-            f"Successfully assigned {pod.key} to {node_name}")
+            lambda: f"Successfully assigned {pod.key} to {node_name}")
         klog.V(4).info_s("bound", pod=pod.key, node=node_name)
         self._timed_point("PostBind", self._fw.run_post_bind_plugins,
                           state, pod, node_name)

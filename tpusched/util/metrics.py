@@ -670,3 +670,41 @@ workload_goodput_per_chip = REGISTRY.gauge_vec(
     "tpusched_workload_goodput_per_chip", ("workload", "generation"),
     "EWMA goodput-per-chip by workload fingerprint and pool generation "
     "(the Gavel throughput-matrix cell, ROADMAP item 3).")
+
+# Native batched dispatch inner loop (sched/nativedispatch.py, ISSUE 16).
+# cycles counts kernel sweeps executed (one per candidate-set evaluation);
+# pods counts placements that completed through the native path (the bind
+# commit itself stays Cache.assume_pod_guarded); fallbacks counts cycles
+# the native path declined, by reason (no-native, profile, pod-shape,
+# claims, prescore, no-feasible, inexact, …) — the ops runbook's first
+# diagnostic read.  differential_mismatches MUST stay 0: it counts sampled
+# in-cycle oracle re-runs whose placement differed from the kernel's (each
+# one also re-routes that cycle to the oracle's answer).
+native_dispatch_cycles_total = REGISTRY.counter(
+    "tpusched_native_dispatch_cycles_total",
+    "Candidate-set sweeps evaluated by the native dispatch kernel.")
+native_dispatch_pods_total = REGISTRY.counter(
+    "tpusched_native_dispatch_pods_total",
+    "Pods whose Filter/Score/rank completed through the native kernel.")
+native_dispatch_fallbacks = REGISTRY.counter_vec(
+    "tpusched_native_dispatch_fallbacks_total", ("reason",),
+    "Cycles the native dispatch path declined, by reason.")
+native_dispatch_differential_mismatches = REGISTRY.counter(
+    "tpusched_native_dispatch_differential_mismatches_total",
+    "Sampled oracle re-runs disagreeing with the native dispatch kernel.")
+
+# Coalesced bind-side watch fan-out (apiserver/server.py, ISSUE 16).
+# batches counts flush-window drains (each delivers >= 1 events in store-
+# commit order); events counts watch events delivered through the batcher;
+# flush_seconds observes commit-to-delivery latency per batch — the knob's
+# direct cost, bounded by the flush window plus handler time.
+fanout_batches_total = REGISTRY.counter(
+    "tpusched_fanout_batches_total",
+    "Coalesced watch-dispatch flush batches delivered.")
+fanout_events_total = REGISTRY.counter(
+    "tpusched_fanout_events_total",
+    "Watch events delivered through the coalesced fan-out batcher.")
+fanout_flush_seconds = REGISTRY.histogram(
+    "tpusched_fanout_flush_seconds",
+    "Commit-to-delivery latency of coalesced watch flush batches.",
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, 1.0))

@@ -305,6 +305,83 @@ class InformerDeleteRace(Scenario):
 
 
 @register
+class FanoutFlushInformerOrdering(Scenario):
+    """The coalesced fan-out batcher (ISSUE 16 tentpole b) racing the
+    informer delivery plane.  A bind-confirm MODIFIED and the pod's
+    DELETED commit in store order and are enqueued UNDER the store lock
+    (commit order IS queue order); two racing flush threads — the daemon
+    flusher is just one more calling thread — splice and deliver into a
+    real Informer.  Invariants: the tombstoned pod is never resurrected
+    in the informer cache, and no MODIFIED for the key is delivered
+    after its DELETED (per-key RV staleness rejection is load-bearing
+    when racing flushers split a batch)."""
+
+    name = "fanout-flush-vs-informer-ordering"
+
+    def setup(self):
+        ctx = SimpleNamespace(now=0.0, seen=[])
+        # batched mode with the daemon flusher deliberately parked
+        # (stopped before any event exists): scenarios must not run
+        # unmanaged threads, so flush delivery is driven only by the
+        # explored actors below via fanout_flush()
+        ctx.api = srv.APIServer(fanout_flush_window_s=3600.0)
+        ctx.api._fanout.stop()
+        ctx.pod = make_pod("doomed", node_name="n1")
+        ctx.api.create(srv.PODS, ctx.pod)
+        ctx.api.fanout_flush()            # pod visible pre-race
+        ctx.key = ctx.pod.meta.key
+        ctx.inf = Informer(ctx.api, srv.PODS)
+
+        def on_update(_old, obj):
+            ctx.seen.append(("MODIFIED", obj.meta.resource_version))
+
+        def on_delete(obj):
+            ctx.seen.append(("DELETED", obj.meta.resource_version))
+
+        ctx.inf.add_event_handler(on_update=on_update, on_delete=on_delete)
+        return ctx
+
+    def threads(self, ctx):
+        def writer():
+            # bind-confirm then reap: two commits in strict store order,
+            # enqueued under the store lock
+            ctx.api.patch(srv.PODS, ctx.key,
+                          lambda p: p.meta.annotations.update(bound="y"))
+            ctx.api.delete(srv.PODS, ctx.key)
+
+        def flusher_a():
+            ctx.api.fanout_flush()
+
+        def flusher_b():
+            ctx.api.fanout_flush()
+
+        def reader():
+            ctx.inf.get(ctx.key)
+            ctx.inf.items()
+
+        return [writer, flusher_a, flusher_b, reader]
+
+    def check(self, ctx):
+        ctx.api.fanout_flush()            # drain whatever the race left
+        assert ctx.inf.get(ctx.key) is None, (
+            "informer cache still holds the deleted pod — batched "
+            "dispatch resurrected tombstoned pod state")
+        deleted_at = next((i for i, (t, _) in enumerate(ctx.seen)
+                           if t == "DELETED"), None)
+        assert deleted_at is not None, (
+            f"DELETED never delivered (seen={ctx.seen}) — the flush "
+            f"race lost the delete")
+        late_mods = [e for e in ctx.seen[deleted_at + 1:]
+                     if e[0] == "MODIFIED"]
+        assert not late_mods, (
+            f"MODIFIED delivered after DELETED ({ctx.seen}) — a split "
+            f"batch defeated the per-key staleness rejection")
+        rvs = [rv for _, rv in ctx.seen]
+        assert rvs == sorted(rvs), (
+            f"per-key delivery not RV-monotone: {ctx.seen}")
+
+
+@register
 class BindpoolShutdownDrain(Scenario):
     """_BindingPool shutdown-drain vs. a late permit resolution
     submitting its binding task.  Invariant: the task is executed XOR
@@ -1093,7 +1170,70 @@ class SelfcheckStaleIndex(WindowIndexEpoch):
         return [reader, buggy_informer]
 
 
+@register
+class SelfcheckFanoutResurrect(Scenario):
+    """DELIBERATE BUG: the pre-batcher fan-out pairing — each mutator
+    appends its watch event to the delivery queue AFTER releasing the
+    store critical section (racing other mutators' appends) and the
+    consumer applies events with NO per-key staleness defense.  The
+    explorer must find the schedule where the delete's event overtakes
+    the earlier update's append, so the flush re-applies the stale
+    MODIFIED after the DELETED and resurrects tombstoned pod state —
+    the exact reorder class the real batcher removes by enqueueing in
+    commit order and the real informer rejects by RV."""
+
+    name = "selfcheck-fanout-resurrect"
+
+    def setup(self):
+        # rv-1 object exists in the store and in the consumer cache
+        ctx = SimpleNamespace(rv=1, store={"p": 1}, cache={"p": 1},
+                              queue=[], mod_rv=None, del_rv=None)
+        ctx.lock = locking.GuardedLock("verify.fanout-store")
+        return ctx
+
+    def threads(self, ctx):
+        def updater():
+            with ctx.lock:
+                if "p" not in ctx.store:
+                    return              # lost the race to the reaper
+                ctx.rv += 1
+                ctx.store["p"] = ctx.rv
+                ctx.mod_rv = ctx.rv
+                ev = ("MODIFIED", ctx.rv)
+            # BUG: the append happens outside the critical section — the
+            # reaper's commit AND append can both land in this window
+            ctx.queue.append(ev)
+
+        def reaper():
+            with ctx.lock:
+                if "p" not in ctx.store:
+                    return
+                ctx.rv += 1
+                ctx.store.pop("p")
+                ctx.del_rv = ctx.rv
+                ev = ("DELETED", ctx.rv)
+            ctx.queue.append(ev)        # same bug, same window
+
+        return [updater, reaper]
+
+    def check(self, ctx):
+        # the flush: apply the queue to the defense-less consumer cache
+        for typ, rv in ctx.queue:
+            if typ == "MODIFIED":
+                ctx.cache["p"] = rv
+            else:
+                ctx.cache.pop("p", None)
+        # the reaper always wins the store (the updater declines once the
+        # key is gone), so the pod must be gone downstream too
+        assert ctx.del_rv is not None
+        assert "p" not in ctx.cache, (
+            f"resurrected: stale MODIFIED(rv={ctx.mod_rv}) applied after "
+            f"DELETED(rv={ctx.del_rv}) — queue order {ctx.queue} inverted "
+            f"commit order")
+
+
 LIVE_SCENARIOS = tuple(n for n in SCENARIOS if not n.startswith("selfcheck-"))
 SELFCHECK_BUGGY = ("selfcheck-lost-update", "selfcheck-broken-arming",
                    "selfcheck-unguarded-commit", "selfcheck-stale-index",
-                   "selfcheck-unguarded-quota-reserve")
+                   "selfcheck-unguarded-quota-reserve",
+                   "selfcheck-fanout-resurrect")
