@@ -202,8 +202,24 @@ goodput-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_goodput.py \
 		tests/test_goodput_e2e.py -q -p no:cacheprovider
 
+# Incident-smoke (the closed-incident-loop gate, part of the tier1 flow,
+# ISSUE 20): the arrival storm with the health-timeline + anomaly-sentinel
+# plane on vs off, interleaved min-of-N on binds/sec — fails above 3%
+# overhead (direct-attribution fallback: the timeline's own measured tick
+# cost vs the run's wall) or if the plane never sampled/evaluated
+# (vacuity). The accompanying pytest suite carries the rest of the gate:
+# two virtual-time replays of one recorded storm must render byte-
+# identical timeline sample counts and incident censuses (determinism),
+# plus the timeline soak, sentinel hysteresis units, bundle schema and
+# torn-write recovery, and the seeded bind-rate-collapse non-vacuity e2e.
+.PHONY: incident-smoke
+incident-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --incident-smoke
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_timeline.py \
+		tests/test_incident.py -q -p no:cacheprovider
+
 .PHONY: tier1
-tier1: lint native-smoke race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke storm-native-smoke
+tier1: lint native-smoke race-smoke chaos-smoke trace-smoke obs-smoke prof-smoke replay-smoke goodput-smoke storm-native-smoke incident-smoke
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

@@ -1374,7 +1374,8 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                    quota_serialize: bool = False,
                    native: bool = True,
                    native_differential_period: int = 0,
-                   fanout_flush_ms: float = 0.0) -> dict:
+                   fanout_flush_ms: float = 0.0,
+                   trace_dir: str | None = None) -> dict:
     """ONE sustained arrival storm: a mixed gang+singleton stream arrives
     continuously across ``pools`` v5p-256 pools (64 hosts each) for
     ``duration_s``, with completed workloads torn down as they bind so
@@ -1419,7 +1420,9 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
     headline arm (the oracle re-runs the Python path it checks against).
     ``fanout_flush_ms`` > 0 routes watch fan-out through the coalesced
     bind-side batcher (apiserver/server.py) with that flush window;
-    0 keeps the synchronous default."""
+    0 keeps the synchronous default.  ``trace_dir`` attaches the fleet
+    trace recorder for the run (ISSUE 20's incident-smoke records its
+    determinism-check trace this way)."""
     import hashlib
     import random
 
@@ -1488,6 +1491,11 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
                 c.api.create(srv.ELASTIC_QUOTAS, make_elastic_quota(
                     f"{team}-quota", team,
                     min={TPU: per_team}, max={TPU: 2 * per_team}))
+
+        fleet_rec = None
+        if trace_dir is not None:
+            fleet_rec = obs.default_fleetrecorder()
+            fleet_rec.attach(c.api, trace_dir)
 
         binds0 = binds_total.value()
         cycles0 = scheduling_cycles_total.value()
@@ -1609,6 +1617,9 @@ def run_storm_once(pools: int = 32, duration_s: float = 10.0,
             fanout["batches_delta"] = int(fanout_batches_total.value() - fb0)
             fanout["events_delta"] = int(fanout_events_total.value() - fe0)
             api._fanout.stop()
+        if fleet_rec is not None:
+            fleet_rec.flush()
+            fleet_rec.detach()
 
     e2e = slo.summary().get(obs.POD_E2E, {})
     stats = goodput.stats()
@@ -3036,6 +3047,119 @@ def goodput_smoke() -> int:
     return 0
 
 
+def _incident_plane_arms(on: bool):
+    """Install fresh process-global incident-plane instances for one
+    bench arm.  ON: a live-cadence timeline + sentinel + in-memory
+    bundle ring.  OFF: a timeline whose interval never elapses, so the
+    housekeeping lane's ``maybe_tick`` returns at the interval check —
+    scheduler wiring (family registration, listener attach) is identical
+    in both arms, isolating the PER-TICK sampling+detection cost the
+    incident plane adds to a live fleet."""
+    from tpusched import obs
+    tl = obs.install_timeline(obs.HealthTimeline(
+        interval_s=0.25 if on else 1e9))
+    sn = obs.install_sentinel(obs.AnomalySentinel())
+    obs.install_incidents(obs.IncidentManager())
+    return tl, sn
+
+
+def incident_smoke() -> int:
+    """``--incident-smoke`` (make incident-smoke, wired into the tier1
+    flow): the overhead + non-vacuity gates over the ISSUE 20 incident
+    plane.
+
+    1. OVERHEAD: the arrival storm with the sentinel plane ON vs OFF,
+       interleaved min-of-N on binds/sec; fails above 3%, with the
+       trace/prof/goodput-smoke-style direct-attribution fallback (the
+       timeline's own ``tick_seconds_total`` self-ratioed against the
+       busiest ON run's wall) whenever the box cannot resolve the
+       budget itself (off-arm spread > 3%) — a tighter fallback
+       trigger than those smokes' 3x, because the incident plane is
+       paced rather than on the storm's critical path, so the direct
+       number is its exact cost, not a proxy.
+    2. NON-VACUITY: every ON arm must have committed timeline samples
+       and evaluated its detectors over them, with zero family sampling
+       errors — a gate green because the plane never ran would be a
+       disabled gate wearing a green check.
+
+    The third incident-plane gate — two virtual-time replays of one
+    recorded storm rendering byte-identical timeline/incident censuses —
+    rides in the pytest half of ``make incident-smoke``
+    (tests/test_incident.py), on the replay-smoke recording recipe.
+    """
+    import gc
+
+    RUNS = 3
+    POOLS = 8
+    DUR = 2.0
+    _incident_plane_arms(on=True)
+    run_storm_once(pools=4, duration_s=1.0, seed=99)       # shared warmup
+    on_runs, off_runs = [], []
+    for i in range(RUNS):
+        for arm in (("on", "off") if i % 2 == 0 else ("off", "on")):
+            gc.collect()               # level GC debt across the arms
+            tl, sn = _incident_plane_arms(on=(arm == "on"))
+            r = run_storm_once(pools=POOLS, duration_s=DUR, seed=i)
+            r["_timeline"], r["_sentinel"] = tl.stats(), sn.stats()
+            (on_runs if arm == "on" else off_runs).append(r)
+
+    for r in on_runs:
+        ts, ss = r["_timeline"], r["_sentinel"]
+        if ts["samples_total"] == 0 or ss["ticks_total"] == 0:
+            print(f"INCIDENT-SMOKE FAILED: ON arm committed "
+                  f"{ts['samples_total']} timeline samples / evaluated "
+                  f"{ss['ticks_total']} sentinel ticks — the incident "
+                  "plane never ran", file=sys.stderr)
+            return 1
+        if ts["errors_total"]:
+            print(f"INCIDENT-SMOKE FAILED: {ts['errors_total']} family "
+                  "sampling errors under storm load (families: "
+                  f"{ts['families']})", file=sys.stderr)
+            return 1
+    on_best = max(r["binds_per_sec"] for r in on_runs)
+    off_best = max(r["binds_per_sec"] for r in off_runs)
+    off_rates = [r["binds_per_sec"] for r in off_runs]
+    overhead = (off_best - on_best) / off_best
+    off_spread = (off_best - min(off_rates)) / off_best
+    samples = max(r["_timeline"]["samples_total"] for r in on_runs)
+    print(f"incident-smoke: sentinel-on best {on_best:.1f} binds/s vs "
+          f"off best {off_best:.1f} over {RUNS} interleaved runs each "
+          f"(overhead {overhead * 100:+.2f}%, off-arm spread "
+          f"{off_spread * 100:.0f}%, budget 3%, {samples} samples in "
+          "the busiest ON arm)")
+    if overhead > 0.03:
+        if off_spread <= 0.03:
+            # the box CAN resolve 3%: the A/B verdict stands
+            print(f"INCIDENT-SMOKE FAILED: sentinel overhead "
+                  f"{overhead * 100:.2f}% > 3% (on best {on_best:.1f}, "
+                  f"off best {off_best:.1f} binds/s)", file=sys.stderr)
+            return 1
+        # Fallback threshold is the budget itself (not trace/prof's 3x):
+        # when same-code OFF runs differ by more than the budget, the
+        # A/B cannot resolve the budget.  And unlike those smokes —
+        # whose instrumentation rides the storm's critical path, making
+        # A/B the only honest measure — the incident plane is PACED
+        # (housekeeping ticks), so tick_seconds_total IS its cost, not
+        # a proxy: the timeline's own measured tick cost, self-ratioed
+        # against the busiest ON run's wall (submission window + drain)
+        busiest = max(on_runs,
+                      key=lambda r: r["_timeline"]["samples_total"])
+        wall = busiest["duration_s"] + busiest["drain_s"]
+        cost = busiest["_timeline"]["tick_seconds_total"]
+        direct = cost / wall
+        n = busiest["_timeline"]["samples_total"]
+        print(f"incident-smoke: A/B inconclusive on this box (off-arm "
+              f"spread {off_spread * 100:.0f}%); direct attribution: "
+              f"{cost * 1e3:.2f} ms across {n} ticks = "
+              f"{direct * 100:.2f}% of that run's {wall:.2f}s wall "
+              "(budget 3%)")
+        if direct > 0.03:
+            print(f"INCIDENT-SMOKE FAILED: direct tick cost "
+                  f"{direct * 100:.2f}% > 3%", file=sys.stderr)
+            return 1
+    return 0
+
+
 def smoke_gate() -> int:
     """CI perf gate (make bench-smoke): only the headline gang scenario at
     n=3 (pre-push fast path; the full matrix is `make bench`), gated on the
@@ -3093,6 +3217,8 @@ def main() -> int:
         return prof_smoke()
     if "--goodput-smoke" in sys.argv:
         return goodput_smoke()
+    if "--incident-smoke" in sys.argv:
+        return incident_smoke()
     if "--smoke" in sys.argv:
         return smoke_gate()
     if "--storm" in sys.argv:

@@ -157,6 +157,10 @@ def test_debug_index_enumerates_every_mounted_endpoint(fresh_obs):
         status, idx = _get_json(server.port, "/debug/")
         assert status == 200
         assert idx["endpoints"] == DEBUG_ENDPOINTS
+        # the incident plane's surfaces are part of the pinned contract
+        # (ISSUE 20): losing either would orphan the cmd.incident runbook
+        assert "/debug/timeline" in idx["endpoints"]
+        assert "/debug/incidents" in idx["endpoints"]
         # trailing-slash-less spelling serves the same index
         status2, idx2 = _get_json(server.port, "/debug")
         assert status2 == 200 and idx2 == idx

@@ -423,3 +423,41 @@ def test_issue16_native_dispatch_and_fanout_families_round_trip():
               and labels["le"] not in ("+Inf",)
               and float(labels["le"]) < 0.002]
     assert sub_ms and max(sub_ms) >= 1.0
+
+
+def test_issue20_incident_plane_families_round_trip():
+    """The ISSUE 20 families: timeline sample/overflow counters, the
+    per-detector sentinel firing vec, and the incident bundle
+    written/dropped counters — through the validating exposition round
+    trip, alongside the native-dispatch families the health.native
+    surface re-exposes."""
+    from tpusched.util.metrics import (
+        incident_bundles_dropped_total, incident_bundles_written_total,
+        native_dispatch_cycles_total, sentinel_firings_total,
+        timeline_overflow_total, timeline_samples_total)
+    timeline_samples_total.inc(4)
+    timeline_overflow_total.inc(2)
+    sentinel_firings_total.with_labels("bind_rate_collapse").inc()
+    sentinel_firings_total.with_labels("slo_burn_spike").inc(2)
+    incident_bundles_written_total.inc()
+    incident_bundles_dropped_total.inc(0)
+    native_dispatch_cycles_total.inc(0)
+    types, helps, samples = parse_exposition(REGISTRY.expose())
+    assert types["tpusched_timeline_samples_total"] == "counter"
+    assert types["tpusched_timeline_overflow_total"] == "counter"
+    assert types["tpusched_sentinel_firings_total"] == "counter"
+    assert types["tpusched_incident_bundles_written_total"] == "counter"
+    assert types["tpusched_incident_bundles_dropped_total"] == "counter"
+    assert types["tpusched_native_dispatch_cycles_total"] == "counter"
+    for name in ("tpusched_timeline_samples_total",
+                 "tpusched_sentinel_firings_total",
+                 "tpusched_incident_bundles_written_total"):
+        assert helps.get(name, "").strip(), f"{name}: empty HELP"
+    by_detector = {labels.get("detector"): v for name, labels, v
+                   in samples if name == "tpusched_sentinel_firings_total"}
+    assert by_detector.get("bind_rate_collapse", 0) >= 1
+    assert by_detector.get("slo_burn_spike", 0) >= 2
+    totals = {name: v for name, labels, v in samples if not labels}
+    assert totals.get("tpusched_timeline_samples_total", 0) >= 4
+    assert totals.get("tpusched_timeline_overflow_total", 0) >= 2
+    assert totals.get("tpusched_incident_bundles_written_total", 0) >= 1

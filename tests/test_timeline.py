@@ -1,0 +1,365 @@
+"""Health timeline + anomaly sentinel units (ISSUE 20, the pytest half
+of ``make incident-smoke``).
+
+The load-bearing claims:
+
+- budgets HOLD under a 10k-tick soak with concurrent scrapes — entries
+  and approximate bytes never exceed their caps, and every evicted
+  sample is counted (overflow accounted, never stored);
+- rate families store per-second deltas of cumulative counters (first
+  tick: 0.0 — no baseline yet), gauge families store values as-is;
+- a raising family or listener is counted and skipped, never propagated
+  into the (housekeeping) caller;
+- ``arm_on``/``disarm`` drive the VirtualClock deadline registry — the
+  replay determinism contract's tick plumbing;
+- sentinel hysteresis: ``enter_ticks`` consecutive abnormal samples to
+  fire, ``clear_ticks`` normal ones to re-arm, cooldown bounding the
+  firing volume of an oscillating condition;
+- the stock ``bind_rate_collapse`` detector judges a collapse against
+  the HEALTHY trailing baseline (the sample under evaluation joins the
+  baseline only after evaluation).
+"""
+import threading
+
+import pytest
+
+from tpusched.obs.sentinel import (AnomalySentinel, BaselineView, Detector,
+                                   default_detectors)
+from tpusched.obs.timeline import HealthTimeline
+from tpusched.util.clock import VirtualClock
+
+
+def _mk(interval_s: float = 1.0, **kw) -> HealthTimeline:
+    kw.setdefault("publish", False)
+    return HealthTimeline(interval_s=interval_s, **kw)
+
+
+# -- family sampling ----------------------------------------------------------
+
+def test_gauge_and_rate_families():
+    tl = _mk()
+    state = {"gauge": 5.0, "counter": 0.0}
+    tl.register_family("depth", lambda: state["gauge"])
+    tl.register_family("binds", lambda: state["counter"], kind="rate")
+
+    s0 = tl.tick(now=10.0)
+    assert s0["v"]["depth"] == 5.0
+    assert s0["v"]["binds"] == 0.0          # first rate tick: no baseline
+
+    state["gauge"], state["counter"] = 7.0, 30.0
+    s1 = tl.tick(now=12.0)                  # +30 over 2s -> 15/s
+    assert s1["v"]["depth"] == 7.0
+    assert s1["v"]["binds"] == pytest.approx(15.0)
+
+    state["counter"] = 20.0                 # counter reset (restart):
+    s2 = tl.tick(now=13.0)                  # negative delta clamps to 0
+    assert s2["v"]["binds"] == 0.0
+
+
+def test_none_reading_omits_family_from_sample():
+    tl = _mk()
+    tl.register_family("sometimes", lambda: None)
+    tl.register_family("always", lambda: 1.0)
+    s = tl.tick(now=1.0)
+    assert "sometimes" not in s["v"] and s["v"]["always"] == 1.0
+
+
+def test_raising_family_is_counted_and_skipped():
+    tl = _mk()
+    tl.register_family("bad", lambda: 1 / 0)
+    tl.register_family("good", lambda: 2.0)
+    s = tl.tick(now=1.0)
+    assert s["v"] == {"good": 2.0}
+    assert tl.stats()["errors_total"] == 1
+
+
+def test_register_replaces_and_unregister_drops():
+    tl = _mk()
+    tl.register_family("f", lambda: 1.0)
+    tl.register_family("f", lambda: 2.0)        # replace, same name
+    assert tl.tick(now=1.0)["v"]["f"] == 2.0
+    tl.unregister_family("f")
+    assert tl.tick(now=2.0)["v"] == {}
+    with pytest.raises(ValueError):
+        tl.register_family("g", lambda: 0.0, kind="exotic")
+
+
+def test_raising_listener_is_counted_and_others_still_run():
+    tl = _mk()
+    tl.register_family("f", lambda: 1.0)
+    seen = []
+    tl.add_listener(lambda s: 1 / 0)
+    tl.add_listener(seen.append)
+    tl.tick(now=1.0)
+    assert len(seen) == 1
+    assert tl.stats()["errors_total"] == 1
+
+
+# -- budgets ------------------------------------------------------------------
+
+def test_entry_budget_evicts_oldest_and_counts_overflow():
+    tl = _mk(max_samples=10)
+    tl.register_family("f", lambda: 0.0)
+    for i in range(25):
+        tl.tick(now=float(i))
+    st = tl.stats()
+    assert st["entries"] == 10
+    assert st["samples_total"] == 25
+    assert st["overflow_total"] == 15
+    # the RING kept the newest: oldest stored tick is t=15
+    assert tl.samples()[0]["t"] == 15.0
+
+
+def test_byte_budget_binds_independently_of_entry_budget():
+    tl = _mk(max_samples=100000, max_bytes=2048)
+    tl.register_family("a-reasonably-long-family-name", lambda: 1.0)
+    for i in range(500):
+        tl.tick(now=float(i))
+    st = tl.stats()
+    assert st["approx_bytes"] <= 2048
+    assert st["entries"] < 500
+    assert st["overflow_total"] == 500 - st["entries"]
+
+
+def test_soak_10k_ticks_under_concurrent_scrapes():
+    """10k ticks racing scrape threads: budgets hold at every observed
+    instant, no exception escapes, and at the end every sample ever
+    committed is either stored or counted as overflow."""
+    tl = _mk(max_samples=256, max_bytes=64 << 10)
+    state = {"n": 0.0}
+    tl.register_family("binds", lambda: state["n"], kind="rate")
+    tl.register_family("depth", lambda: state["n"] % 97)
+    stop = threading.Event()
+    violations = []
+
+    def scrape():
+        while not stop.is_set():
+            st = tl.stats()
+            if st["entries"] > tl.max_samples \
+                    or st["approx_bytes"] > tl.max_bytes:
+                violations.append(st)
+            tl.window(50.0, now=state["n"])
+            tl.dump(10.0)
+            tl.census()
+
+    threads = [threading.Thread(target=scrape, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(10_000):
+            state["n"] += 3.0
+            tl.tick(now=float(i))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not violations, violations[:3]
+    st = tl.stats()
+    assert st["samples_total"] == 10_000
+    assert st["entries"] == 256
+    assert st["overflow_total"] == 10_000 - 256
+    assert st["errors_total"] == 0
+
+
+# -- windows / census ---------------------------------------------------------
+
+def test_window_filters_by_horizon():
+    tl = _mk()
+    tl.register_family("f", lambda: 1.0)
+    for i in range(10):
+        tl.tick(now=float(i))
+    assert len(tl.window(3.5, now=9.0)) == 4       # t in {5.5..9} -> 6..9
+    assert len(tl.window(100.0, now=9.0)) == 10
+    assert tl.latest()["t"] == 9.0
+
+
+def test_census_carries_no_wall_stamps():
+    """The census is the byte-identical replay-comparison view: counts
+    and family names only — a wall stamp would differ across two replays
+    of one trace by construction."""
+    tl = _mk()
+    tl.register_family("f", lambda: 1.0)
+    tl.tick(now=1.0)
+    census = tl.census()
+    assert set(census) == {"samples_total", "overflow_total", "entries",
+                           "families"}
+    assert census["samples_total"] == 1 and census["families"] == ["f"]
+
+
+# -- clock plumbing -----------------------------------------------------------
+
+def test_arm_on_registers_virtual_deadline_and_rearm_follows_ticks():
+    vc = VirtualClock(start=100.0)
+    tl = _mk(interval_s=2.0)
+    tl.register_family("f", lambda: 1.0)
+    tl.arm_on(vc)
+    assert vc.armed_count() == 1
+    assert vc.next_deadline() == pytest.approx(102.0)
+    tl.tick(now=104.0)                    # tick re-arms at now+interval
+    assert vc.next_deadline() == pytest.approx(106.0)
+    assert vc.armed_count() == 1          # the stale token was cancelled
+
+
+def test_disarm_cancels_and_stops_rearming():
+    vc = VirtualClock(start=0.0)
+    tl = _mk(interval_s=1.0)
+    tl.arm_on(vc)
+    tl.disarm()
+    assert vc.armed_count() == 0
+    tl.tick(now=5.0)                      # ticking no longer re-arms
+    assert vc.armed_count() == 0
+    assert tl.stats()["armed"] is False
+
+
+def test_maybe_tick_is_interval_gated():
+    tl = _mk(interval_s=1.0)
+    tl.register_family("f", lambda: 1.0)
+    assert tl.maybe_tick(now=10.0) is True
+    assert tl.maybe_tick(now=10.5) is False
+    assert tl.maybe_tick(now=11.0) is True
+    assert tl.stats()["samples_total"] == 2
+
+
+# -- sentinel hysteresis ------------------------------------------------------
+
+def _always(detail):
+    return lambda v, base: detail if v.get("bad") else None
+
+
+def _sample(t, **v):
+    return {"t": t, "wall": 1e9 + t, "v": v}
+
+
+def test_sentinel_fires_after_enter_ticks_and_cooldown_bounds_volume():
+    sn = AnomalySentinel(detectors=[
+        Detector("d", _always({"reason": "x"}), enter_ticks=3,
+                 clear_ticks=2, cooldown_ticks=4)], publish=False)
+    fired = []
+    for i in range(10):
+        fired += sn.on_sample(_sample(float(i), bad=1))
+    # fired once at the 3rd abnormal tick; then active + cooldown hold
+    assert [f["t"] for f in fired] == [2.0]
+    assert sn.census() == {"d": 1}
+    st = sn.stats()
+    assert st["ticks_total"] == 10 and st["detectors"]["d"]["active"]
+
+
+def test_sentinel_clear_ticks_rearm_then_refire():
+    sn = AnomalySentinel(detectors=[
+        Detector("d", _always({"reason": "x"}), enter_ticks=2,
+                 clear_ticks=2, cooldown_ticks=0)], publish=False)
+    t = [0.0]
+
+    def feed(bad, n):
+        out = []
+        for _ in range(n):
+            out += sn.on_sample(_sample(t[0], bad=bad))
+            t[0] += 1.0
+        return out
+
+    assert len(feed(1, 3)) == 1           # enters at the 2nd abnormal
+    assert feed(0, 1) == []               # one normal tick: still active
+    assert len(feed(1, 4)) == 0           # re-abnormal while active: no dup
+    feed(0, 2)                            # clear_ticks normals: re-armed
+    assert len(feed(1, 2)) == 1           # fires again
+    assert sn.census() == {"d": 2}
+
+
+def test_sentinel_raising_detector_counted_not_propagated():
+    def boom(v, base):
+        raise RuntimeError("detector bug")
+    sn = AnomalySentinel(detectors=[Detector("boom", boom),
+                                    Detector("ok", _always({"reason": "x"}),
+                                             enter_ticks=1)],
+                         publish=False)
+    fired = sn.on_sample(_sample(0.0, bad=1))
+    assert [f["detector"] for f in fired] == ["ok"]
+    assert sn.stats()["errors_total"] == 1
+
+
+def test_sentinel_on_firing_hook_and_firing_shape():
+    got = []
+    sn = AnomalySentinel(detectors=[Detector("d", _always({"reason": "x",
+                                                           "k": 2.0}),
+                                             enter_ticks=1)],
+                         publish=False, on_firing=got.append)
+    sn.on_sample(_sample(7.0, bad=1, depth=3.0))
+    assert len(got) == 1
+    f = got[0]
+    assert f["detector"] == "d" and f["t"] == 7.0
+    assert f["detail"]["reason"] == "x"
+    assert f["values"] == {"bad": 1, "depth": 3.0}
+
+
+def test_bind_rate_collapse_judged_against_healthy_baseline():
+    """The stock detector: healthy binds at 10/s, then a collapse to
+    0.5/s with pods pending — fires exactly enter_ticks into the
+    collapse, because the baseline excludes the sample under
+    evaluation."""
+    dets = {d.name: d for d in default_detectors()}
+    sn = AnomalySentinel(detectors=[dets["bind_rate_collapse"]],
+                         publish=False)
+    fired = []
+    for i in range(30):
+        fired += sn.on_sample(_sample(float(i), bind_rate=10.0,
+                                      pending_pods=20.0))
+    assert fired == []                    # healthy: never fires
+    for i in range(30, 40):
+        fired += sn.on_sample(_sample(float(i), bind_rate=0.5,
+                                      pending_pods=20.0))
+    assert len(fired) == 1
+    assert fired[0]["t"] == 32.0          # 3rd collapsed tick (enter=3)
+    detail = fired[0]["detail"]
+    assert detail["bind_rate"] == 0.5 and detail["baseline"] > 5.0
+
+
+def test_bind_rate_collapse_needs_pending_work():
+    """Zero bind rate with an EMPTY queue is an idle fleet, not an
+    incident."""
+    dets = {d.name: d for d in default_detectors()}
+    sn = AnomalySentinel(detectors=[dets["bind_rate_collapse"]],
+                         publish=False)
+    for i in range(20):
+        sn.on_sample(_sample(float(i), bind_rate=10.0, pending_pods=20.0))
+    fired = []
+    for i in range(20, 30):
+        fired += sn.on_sample(_sample(float(i), bind_rate=0.0,
+                                      pending_pods=0.0))
+    assert fired == []
+
+
+def test_degraded_entry_is_an_edge_detector():
+    dets = {d.name: d for d in default_detectors()}
+    sn = AnomalySentinel(detectors=[dets["degraded_mode_entry"]],
+                         publish=False)
+    fired = sn.on_sample(_sample(0.0, degraded=0.0))
+    fired += sn.on_sample(_sample(1.0, degraded=1.0))     # the edge
+    assert [f["detector"] for f in fired] == ["degraded_mode_entry"]
+
+
+def test_sentinel_attach_moves_between_timelines():
+    tl1, tl2 = _mk(), _mk()
+    tl1.register_family("bad", lambda: 1.0)
+    tl2.register_family("bad", lambda: 1.0)
+    sn = AnomalySentinel(detectors=[Detector("d", _always({"reason": "x"}),
+                                             enter_ticks=1,
+                                             cooldown_ticks=0,
+                                             clear_ticks=1)],
+                         publish=False)
+    sn.attach(tl1)
+    sn.attach(tl2)                        # move: tl1 listener removed
+    tl1.tick(now=1.0)
+    assert sn.stats()["ticks_total"] == 0
+    tl2.tick(now=1.0)
+    assert sn.stats()["ticks_total"] == 1
+
+
+def test_baseline_view_mean_prev_window():
+    b = BaselineView()
+    for i in range(40):
+        b.push({"x": float(i)})
+    assert b.ticks() == 30                # bounded trailing window
+    assert b.prev("x") == 39.0
+    assert b.mean("x") == pytest.approx(sum(range(10, 40)) / 30)
+    assert b.mean("missing") is None and b.prev("missing") is None

@@ -30,7 +30,15 @@ surface — a shadow publishing synthetic member reports would fabricate
 fleet goodput, straggler anomalies and throughput-matrix cells; shadows
 hold a private ``GoodputAggregator(publish=False)`` instead.  (The pure
 data types — ``GoodputMatrix``, ``workload_fingerprint_of`` — are NOT
-accessors: sim/ consumes matrices by value on purpose.)
+accessors: sim/ consumes matrices by value on purpose.)  The incident
+plane (ISSUE 20) extends it once more: the health timeline, anomaly
+sentinel and incident-bundle manager (``default_timeline``/
+``default_sentinel``/``default_incidents``/``ensure_incidents`` and
+their installers) are live surfaces — a shadow ticking the global
+timeline would fold trial bind rates into the fleet health history and
+a shadow firing the global sentinel would write trial incidents into
+the operator's black box; shadows hold private ``publish=False``
+instances with an in-memory bundle ring.
 
 Checks:
 
@@ -60,7 +68,10 @@ _ACCESSORS = frozenset((
     "install_engine", "default_slo", "install_slo",
     "default_profiler", "install_profiler", "ensure_profiler",
     "default_fleetrecorder", "install_fleetrecorder", "ensure_fleetrace",
-    "default_goodput", "install_goodput", "ensure_goodput"))
+    "default_goodput", "install_goodput", "ensure_goodput",
+    "default_timeline", "install_timeline",
+    "default_sentinel", "install_sentinel",
+    "default_incidents", "install_incidents", "ensure_incidents"))
 _REGISTRY_METHODS = frozenset(("gauge_func", "register_collector"))
 _GUARDS = ("telemetry", "_telemetry", "publish", "_publish")
 _DEFINING = frozenset(("tpusched/trace/__init__.py",

@@ -202,8 +202,10 @@ class _FanoutBatcher:
         if sink is not None:
             try:
                 sink(self.health())
-            except Exception:
-                pass  # advisory telemetry only
+            # tpulint: disable=exception-taxonomy — advisory telemetry
+            # mirror; a failing sink must not stall the fan-out flusher
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class APIServer:

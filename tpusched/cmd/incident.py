@@ -1,0 +1,230 @@
+"""The incident binary: read the scheduler's black box.
+
+Operates directly on a bundle directory (obs/incident.py) — incident
+triage must work when the scheduler that wrote the bundles is DOWN, so
+unlike ``cmd.explain`` this binary never needs a live debug endpoint.
+
+    python -m tpusched.cmd.incident list
+    python -m tpusched.cmd.incident inspect inc-...-bind_rate_collapse
+    python -m tpusched.cmd.incident diff inc-A inc-B
+
+The bundle directory comes from ``--dir`` or ``$TPUSCHED_INCIDENT_DIR``.
+``inspect`` renders the evidence in triage order: what fired, what the
+timeline did around the trigger, which gangs were blocked and WHY, what
+the health sections said — the 3am read that replaces six debug-endpoint
+curls.  Exit codes: 0 = ok, 1 = bundle missing/invalid, 2 = usage error.
+``--json`` prints raw payloads for scripting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusched-incident",
+        description="inspect black-box incident bundles")
+    p.add_argument("--dir", default=os.environ.get(
+        "TPUSCHED_INCIDENT_DIR", ""),
+        help="bundle directory (default: $TPUSCHED_INCIDENT_DIR)")
+    p.add_argument("--json", action="store_true",
+                   help="print raw JSON instead of prose")
+    sub = p.add_subparsers(dest="command")
+    sub.add_parser("list", help="index of stored bundles, newest first")
+    insp = sub.add_parser("inspect", help="render one bundle for triage")
+    insp.add_argument("id", help="bundle id (or unique substring)")
+    diff = sub.add_parser("diff", help="what changed between two bundles")
+    diff.add_argument("id_a")
+    diff.add_argument("id_b")
+    return p
+
+
+def _manager(directory: str):
+    from ..obs.incident import IncidentManager
+    return IncidentManager(directory=directory, publish=False)
+
+
+def _resolve(mgr, query: str):
+    """Exact id, else unique-substring match over the index."""
+    doc = mgr.get(query)
+    if doc is not None:
+        return doc
+    hits = [e["id"] for e in mgr.list() if query in e["id"]]
+    if len(hits) == 1:
+        return mgr.get(hits[0])
+    return None
+
+
+def _wall_str(wall) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(float(wall)))
+    except (TypeError, ValueError):
+        return str(wall)
+
+
+def _section(doc, name):
+    sec = doc.get("sections", {}).get(name)
+    if not isinstance(sec, dict):
+        return None
+    return sec.get("data") if sec.get("ok") else None
+
+
+def cmd_list(mgr, as_json: bool) -> int:
+    index = mgr.list()
+    if as_json:
+        print(json.dumps(index, indent=2, default=str))
+        return 0
+    if not index:
+        print("no bundles")
+        return 0
+    for e in index:
+        print(f"{e['id']}  detector={e['detector']}  "
+              f"captured={_wall_str(e['captured_wall'])}  "
+              f"sections={len(e['sections'])}")
+    return 0
+
+
+def _render_timeline(doc) -> None:
+    samples = _section(doc, "timeline") or []
+    if not samples:
+        print("  (no timeline window captured)")
+        return
+    trigger_v = doc.get("trigger", {}).get("values", {})
+    families = sorted(set(trigger_v)
+                      | {k for s in samples for k in s.get("v", {})})
+    # triage-first ordering: the rate/depth families an operator reads
+    # before anything else
+    lead = [f for f in ("bind_rate", "pending_pods", "pending_gangs",
+                        "degraded", "slo_burn") if f in families]
+    rest = [f for f in families if f not in lead]
+    print(f"  {len(samples)} samples captured around the trigger; "
+          f"families: {', '.join(lead + rest)}")
+    tail = samples[-12:]
+    for fam in lead:
+        vals = [s["v"].get(fam) for s in tail if fam in s.get("v", {})]
+        if not vals:
+            continue
+        spark = " ".join(f"{v:.3g}" for v in vals)
+        print(f"    {fam:>14}: {spark}")
+
+
+def _render_explain(doc) -> None:
+    explain = _section(doc, "explain")
+    if not explain:
+        print("  (no diagnosis captured)")
+        return
+    top = explain.get("top_blockers", [])
+    if top:
+        print("  top blockers at capture time:")
+        for row in top[:5]:
+            print(f"    - [{row.get('plugin') or '(scheduler)'}] "
+                  f"{row.get('reason')} ({row.get('pods')} pod(s))")
+            if row.get("suggestion"):
+                print(f"        unblock: {row['suggestion']}")
+    gangs = explain.get("gangs") or {}
+    for name, g in list(gangs.items())[:5]:
+        if not g:
+            continue
+        print(f"  gang {name}: pending {g.get('pending_for_s', 0):.1f}s, "
+              f"blocking plugin {g.get('blocking_plugin') or '(none)'}")
+
+
+def cmd_inspect(mgr, query: str, as_json: bool) -> int:
+    from ..obs.incident import validate_bundle
+    doc = _resolve(mgr, query)
+    if doc is None:
+        print(f"no bundle matching {query!r}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    problems = validate_bundle(doc)
+    trigger = doc.get("trigger", {})
+    detail = trigger.get("detail", {})
+    print(f"incident {doc['id']}")
+    print(f"  captured: {_wall_str(doc.get('captured_wall'))}"
+          + ("" if not problems
+             else f"  [SCHEMA PROBLEMS: {'; '.join(problems)}]"))
+    print(f"  detector: {trigger.get('detector')}")
+    if detail.get("reason"):
+        print(f"  cause: {detail['reason']}")
+    nums = {k: v for k, v in detail.items()
+            if isinstance(v, (int, float))}
+    if nums:
+        print("  evidence: " + ", ".join(
+            f"{k}={v:.4g}" for k, v in sorted(nums.items())))
+    print("timeline:")
+    _render_timeline(doc)
+    print("diagnosis:")
+    _render_explain(doc)
+    anomalies = _section(doc, "anomalies") or []
+    if anomalies:
+        kinds: dict = {}
+        for tr in anomalies:
+            for a in tr.get("anomalies", []):
+                k = a.get("kind", "?")
+                kinds[k] = kinds.get(k, 0) + 1
+        print("pinned anomalies: " + ", ".join(
+            f"{k}x{n}" for k, n in sorted(kinds.items())))
+    health = _section(doc, "health") or {}
+    if health:
+        print("health sections captured: " + ", ".join(sorted(health)))
+    config = _section(doc, "config") or {}
+    if config.get("sha256"):
+        print(f"config fingerprint: {config['sha256'][:16]}")
+    return 1 if problems else 0
+
+
+def cmd_diff(mgr, id_a: str, id_b: str, as_json: bool) -> int:
+    a, b = _resolve(mgr, id_a), _resolve(mgr, id_b)
+    if a is None or b is None:
+        missing = id_a if a is None else id_b
+        print(f"no bundle matching {missing!r}", file=sys.stderr)
+        return 1
+    out = mgr.diff(a["id"], b["id"])
+    if out is None:
+        print("diff failed", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    print(f"diff {out['a']} -> {out['b']}")
+    print(f"  triggers: {out['trigger_a']} -> {out['trigger_b']}")
+    if out["only_in_a"]:
+        print(f"  sections only in A: {', '.join(out['only_in_a'])}")
+    if out["only_in_b"]:
+        print(f"  sections only in B: {', '.join(out['only_in_b'])}")
+    for name, keys in sorted(out["changed"].items()):
+        print(f"  {name}: changed {', '.join(str(k) for k in keys[:12])}")
+    if not (out["only_in_a"] or out["only_in_b"] or out["changed"]):
+        print("  (no structural differences)")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().print_help()
+        return 2
+    if not args.dir:
+        print("no bundle directory: pass --dir or set "
+              "TPUSCHED_INCIDENT_DIR", file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.dir):
+        print(f"not a directory: {args.dir}", file=sys.stderr)
+        return 2
+    mgr = _manager(args.dir)
+    if args.command == "list":
+        return cmd_list(mgr, args.json)
+    if args.command == "inspect":
+        return cmd_inspect(mgr, args.id, args.json)
+    return cmd_diff(mgr, args.id_a, args.id_b, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,8 +25,10 @@ Exit codes: ``diff`` (and ``replay`` with ``--fail-on-diff``) exit 0 when
 placements are identical, 1 when they differ, 2 on usage errors — so CI
 can gate on "replaying the same trace twice changes nothing"
 (``make replay-smoke``).  ``evaluate`` exits 0 when the arms are
-comparable, 1 when the candidate regresses past a ``--budget-*`` bound,
-2 on usage errors.
+comparable, 1 when the candidate regresses past a ``--budget-*`` bound
+OR the anomaly sentinel fired during an arm's replay (a policy that
+wedges gangs fails its evaluation with the detector census attached;
+``--allow-incidents`` downgrades that to a warning), 2 on usage errors.
 """
 from __future__ import annotations
 
@@ -117,6 +119,11 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--budget-goodput-drop-pct", type=float, default=None,
                     help="fail (exit 1) if the candidate's priced "
                          "goodput drops more than this percent vs base")
+    ev.add_argument("--allow-incidents", action="store_true",
+                    help="downgrade sentinel firings during an arm's "
+                         "replay from a failure (exit 1) to a warning — "
+                         "for traces whose recorded reality already "
+                         "contains the anomaly")
 
     dif = sub.add_parser("diff",
                          help="diff two replay reports, or a report vs a "
@@ -406,6 +413,14 @@ def _render_evaluation(doc: dict) -> None:
               f"{d['attainment_delta']:+.4f}, binds {d['binds_delta']:+d}, "
               f"goodput {_fmt_pct(d['goodput_pct'])}, "
               f"{d['placements_moved']} placement(s) moved")
+    for fail in doc.get("incident_failures", ()):
+        dets = ", ".join(f"{k}x{v}"
+                         for k, v in sorted(fail["detectors"].items()))
+        bundles = fail.get("bundles") or {}
+        print(f"  INCIDENT: arm {fail['arm']} fired the sentinel "
+              f"{fail['firings']} time(s) during replay "
+              f"[{dets or 'unknown'}]; "
+              f"{bundles.get('written_total', 0)} bundle(s) captured")
 
 
 def _fmt_pct(v) -> str:
@@ -414,8 +429,20 @@ def _fmt_pct(v) -> str:
 
 def _evaluate_verdict(args, doc: dict) -> int:
     """The exit-code contract: 1 iff an explicit budget is violated by
-    any candidate arm (vs the base arm)."""
+    any candidate arm (vs the base arm), or the anomaly sentinel fired
+    during an arm's replay (a wedge is a failure even when no numeric
+    budget was asked for) — unless ``--allow-incidents``."""
     failed = False
+    for fail in doc.get("incident_failures", ()):
+        dets = ", ".join(f"{k}x{v}"
+                         for k, v in sorted(fail["detectors"].items()))
+        msg = (f"INCIDENT: arm {fail['arm']} fired the sentinel "
+               f"{fail['firings']} time(s) [{dets or 'unknown'}]")
+        if args.allow_incidents:
+            print(f"warning: {msg} (allowed)", file=sys.stderr)
+        else:
+            print(msg, file=sys.stderr)
+            failed = True
     for cmp_ in doc["comparisons"]:
         d = cmp_["deltas"]
         if args.budget_jct_p99_pct is not None \

@@ -42,6 +42,10 @@ from .throughput import ThroughputTelemetry
 from .fleetrace import FleetTraceRecorder
 from .goodput import (GoodputAggregator, GoodputMatrix, load_matrix,
                       matrix_from_trace, workload_fingerprint_of)
+from .timeline import HealthTimeline, register_scheduler_families
+from .sentinel import AnomalySentinel, Detector, default_detectors
+from .incident import (IncidentManager, config_fingerprint,
+                       validate_bundle, wire_incident_plane)
 from . import reasons  # noqa: F401  (re-export)
 
 __all__ = [
@@ -58,6 +62,12 @@ __all__ = [
     "GoodputAggregator", "GoodputMatrix", "load_matrix", "matrix_from_trace",
     "workload_fingerprint_of",
     "default_goodput", "install_goodput", "ensure_goodput",
+    "HealthTimeline", "AnomalySentinel", "Detector", "default_detectors",
+    "IncidentManager", "register_scheduler_families", "wire_incident_plane",
+    "config_fingerprint", "validate_bundle",
+    "default_timeline", "install_timeline",
+    "default_sentinel", "install_sentinel",
+    "default_incidents", "install_incidents", "ensure_incidents",
 ]
 
 _engine = DiagnosisEngine()
@@ -65,6 +75,9 @@ _slo = SLOTracker()
 _profiler = HotPathProfiler()
 _fleet = FleetTraceRecorder()
 _goodput = GoodputAggregator()
+_timeline = HealthTimeline()
+_sentinel = AnomalySentinel()
+_incidents = IncidentManager()
 
 
 def default_engine() -> DiagnosisEngine:
@@ -169,6 +182,67 @@ def ensure_goodput(api) -> GoodputAggregator:
     synthetic members must not publish as fleet runtime telemetry."""
     _goodput.attach(api)
     return _goodput
+
+
+def default_timeline() -> HealthTimeline:
+    return _timeline
+
+
+def install_timeline(timeline: HealthTimeline) -> HealthTimeline:
+    """Swap the process-global health timeline (bench/test isolation).
+    Schedulers wired earlier keep feeding the instance they registered
+    families on; the /debug/timeline route resolves the global at
+    request time."""
+    global _timeline
+    _timeline = timeline
+    return timeline
+
+
+def default_sentinel() -> AnomalySentinel:
+    return _sentinel
+
+
+def install_sentinel(sentinel: AnomalySentinel) -> AnomalySentinel:
+    """Swap the process-global anomaly sentinel.  The replaced sentinel
+    is detached from whatever timeline it listened on — two sentinels on
+    one tick stream would double every firing (and every bundle)."""
+    global _sentinel
+    if _sentinel is not sentinel and _sentinel._attached_to is not None:
+        _sentinel._attached_to.remove_listener(_sentinel.on_sample)
+    _sentinel = sentinel
+    return sentinel
+
+
+def default_incidents() -> IncidentManager:
+    return _incidents
+
+
+def install_incidents(mgr: IncidentManager) -> IncidentManager:
+    """Swap the process-global incident manager (bench/test isolation)."""
+    global _incidents
+    _incidents = mgr
+    return mgr
+
+
+def ensure_incidents() -> IncidentManager:
+    """Arm the process-global incident manager from the environment
+    (``TPUSCHED_INCIDENT_DIR``), idempotently — live schedulers call
+    this at construction; shadows hold a private in-memory
+    ``IncidentManager(publish=False)`` and must never reach this
+    accessor (shadow-isolation lint rule)."""
+    import os as _os
+    from .incident import ENV_DIR
+    directory = _os.environ.get(ENV_DIR, "")
+    if directory and not _incidents.directory:
+        try:
+            _incidents.arm_directory(directory)
+        except Exception as e:  # noqa: BLE001 — capture is
+            # observability: an unwritable bundle dir must not keep the
+            # scheduler down
+            from ..util import klog
+            klog.error_s(e, "incident bundle dir arm failed",
+                         directory=directory)
+    return _incidents
 
 
 def ensure_fleetrace(api) -> FleetTraceRecorder:

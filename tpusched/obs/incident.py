@@ -1,0 +1,460 @@
+"""Black-box incident bundles: the capture stage of the closed incident
+loop (ISSUE 20).
+
+When a sentinel detector fires, everything a postmortem needs is still
+in memory — the timeline window around the trigger, the pinned anomaly
+traces, /debug/explain documents for the top blocked gangs, the
+profiler's hot-path attribution, the fleetrace capture cursor, every
+flight-recorder health section, the config fingerprint.  Ten minutes
+later it has scrolled out of the bounded rings.  ``IncidentManager``
+freezes it NOW, into one atomic, crash-safe, disk-bounded JSON bundle —
+the scheduler's flight data recorder, written at the moment of impact.
+
+Crash safety follows apiserver/persistence.Journal discipline: bundles
+are written to ``<id>.json.tmp``, flushed, fsynced, then ``os.replace``d
+into place — a crash mid-write leaves a ``.tmp`` (removed on reopen),
+never a torn ``.json``.  A ``.json`` that fails to parse on reopen
+(torn by an older writer, truncated disk) is quarantined to
+``.corrupt``, counted, and never served.
+
+Shadow isolation: a ``publish=False`` manager keeps bundles in a
+bounded in-memory ring (directory=None) on the shadow's clock — the
+virtual-time policy-evaluation plane reads them; nothing touches disk
+or the global ``tpusched_incident_bundles_*`` counters.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+from ..util import klog
+from ..util.clock import WALL, Clock
+from ..util.metrics import (incident_bundles_dropped_total,
+                            incident_bundles_written_total)
+
+__all__ = ["IncidentManager", "validate_bundle", "config_fingerprint",
+           "wire_incident_plane", "SCHEMA_VERSION", "ENV_DIR"]
+
+SCHEMA_VERSION = 1
+ENV_DIR = "TPUSCHED_INCIDENT_DIR"
+
+DEFAULT_MAX_BUNDLES = 32
+DEFAULT_MAX_BYTES = 32 << 20
+DEFAULT_COOLDOWN_S = 60.0
+# timeline seconds frozen around the trigger: enough to see the healthy
+# baseline BEFORE the collapse, bounded so a bundle stays readable
+INCIDENT_WINDOW_S = 180.0
+_EXPLAIN_GANGS = 5
+_PROFILER_CAPTURE_S = 0.75
+
+_REQUIRED_KEYS = ("schema_version", "id", "captured_wall", "trigger",
+                  "sections")
+
+
+class IncidentManager:
+    """Bounded store of black-box bundles, disk- or memory-backed."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_bundles: int = DEFAULT_MAX_BUNDLES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 publish: bool = True,
+                 clock: Optional[Clock] = None):
+        self.directory = directory or None
+        self.max_bundles = int(max_bundles)
+        self.max_bytes = int(max_bytes)
+        self.cooldown_s = float(cooldown_s)
+        self.publish = publish
+        self._clock: Clock = clock if clock is not None else WALL
+        self._lock = threading.Lock()
+        self._memory: List[Dict[str, Any]] = []   # directory=None mode
+        self._seq = 0
+        self._last_capture: Dict[str, float] = {}  # detector -> wall
+        self._written_total = 0
+        self._dropped_total = 0
+        self._recovered_tmp = 0
+        self._quarantined = 0
+        if self.directory:
+            self._recover()
+
+    # -- crash recovery -------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Reopen discipline: a ``.tmp`` is an interrupted write (atomic
+        replace never happened — remove it); a ``.json`` that fails to
+        parse is quarantined to ``.corrupt`` so it is counted once and
+        never served or deleted by the budget sweep."""
+        os.makedirs(self.directory, exist_ok=True)
+        for name in sorted(os.listdir(self.directory)):
+            path = os.path.join(self.directory, name)
+            if name.endswith(".tmp"):
+                try:
+                    os.remove(path)
+                    self._recovered_tmp += 1
+                except OSError:
+                    pass
+                continue
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+                if validate_bundle(doc):
+                    raise ValueError("schema")
+            except (OSError, ValueError):
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
+                self._quarantined += 1
+
+    # -- capture --------------------------------------------------------------
+
+    def arm_directory(self, directory: str) -> None:
+        """Switch from memory to disk mode (``ensure_incidents`` path)."""
+        with self._lock:
+            if self.directory == directory:
+                return
+            self.directory = directory
+            self._recover()
+
+    def capture(self, trigger: Dict[str, Any],
+                sources: Dict[str, Callable[[], Any]]) -> Optional[str]:
+        """Freeze one bundle.  ``trigger`` is the sentinel firing;
+        ``sources`` maps section name -> zero-arg callable.  A raising
+        source becomes an error section — partial evidence beats no
+        bundle.  Returns the bundle id, or None when suppressed
+        (cooldown) or dropped (budget/write failure)."""
+        detector = str(trigger.get("detector", "unknown"))
+        wall = self._clock.wall()
+        with self._lock:
+            last = self._last_capture.get(detector)
+            if last is not None and wall - last < self.cooldown_s:
+                return None
+            self._last_capture[detector] = wall
+            self._seq += 1
+            seq = self._seq
+        bundle_id = f"inc-{int(wall * 1000):013d}-{seq:04d}-{detector}"
+        sections: Dict[str, Dict[str, Any]] = {}
+        for name, fn in sorted(sources.items()):
+            try:
+                sections[name] = {"ok": True, "data": fn()}
+            except Exception as e:  # noqa: BLE001 — partial evidence
+                # beats no bundle; the error IS the section's evidence
+                sections[name] = {"ok": False, "error": str(e)}
+        doc = {"schema_version": SCHEMA_VERSION, "id": bundle_id,
+               "captured_wall": wall, "trigger": trigger,
+               "sections": sections}
+        if self._store(doc):
+            if self.publish:
+                incident_bundles_written_total.inc()
+            return bundle_id
+        if self.publish:
+            incident_bundles_dropped_total.inc()
+        return None
+
+    def _store(self, doc: Dict[str, Any]) -> bool:
+        if not self.directory:
+            with self._lock:
+                self._memory.append(doc)
+                while len(self._memory) > self.max_bundles:
+                    self._memory.pop(0)
+                    self._dropped_total += 1
+                self._written_total += 1
+            return True
+        try:
+            payload = json.dumps(doc, sort_keys=True, default=str)
+        except (TypeError, ValueError) as e:
+            klog.error_s(e, "incident bundle not serializable",
+                         id=doc["id"])
+            with self._lock:
+                self._dropped_total += 1
+            return False
+        if len(payload) > self.max_bytes:
+            with self._lock:
+                self._dropped_total += 1
+            return False
+        path = os.path.join(self.directory, doc["id"] + ".json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            klog.error_s(e, "incident bundle write failed", id=doc["id"])
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            with self._lock:
+                self._dropped_total += 1
+            return False
+        with self._lock:
+            self._written_total += 1
+        self._enforce_budget()
+        return True
+
+    def _enforce_budget(self) -> None:
+        """Oldest-first deletion past either budget (ids sort by capture
+        wall time, so lexicographic order IS age order)."""
+        try:
+            names = sorted(n for n in os.listdir(self.directory)
+                           if n.endswith(".json"))
+            sizes = {}
+            for n in names:
+                try:
+                    sizes[n] = os.path.getsize(
+                        os.path.join(self.directory, n))
+                except OSError:
+                    sizes[n] = 0
+            dropped = 0
+            while names and (len(names) > self.max_bundles
+                             or sum(sizes[n] for n in names)
+                             > self.max_bytes):
+                victim = names.pop(0)
+                try:
+                    os.remove(os.path.join(self.directory, victim))
+                    dropped += 1
+                except OSError:
+                    pass
+            if dropped:
+                with self._lock:
+                    self._dropped_total += dropped
+                if self.publish:
+                    incident_bundles_dropped_total.inc(dropped)
+        except OSError as e:
+            klog.V(4).info_s("incident budget sweep failed", err=str(e))
+
+    # -- reads ----------------------------------------------------------------
+
+    def list(self) -> List[Dict[str, Any]]:
+        """Newest-first index: id, detector, captured_wall, sections."""
+        docs: List[Dict[str, Any]] = []
+        if not self.directory:
+            with self._lock:
+                mem = list(self._memory)
+            docs = mem
+        else:
+            try:
+                names = sorted((n for n in os.listdir(self.directory)
+                                if n.endswith(".json")), reverse=True)
+            except OSError:
+                names = []
+            for n in names:
+                doc = self._read(os.path.join(self.directory, n))
+                if doc is not None:
+                    docs.append(doc)
+        index = [{"id": d["id"],
+                  "detector": d.get("trigger", {}).get("detector"),
+                  "captured_wall": d.get("captured_wall"),
+                  "sections": sorted(d.get("sections", {}))}
+                 for d in docs]
+        index.sort(key=lambda e: str(e["id"]), reverse=True)
+        return index
+
+    def get(self, bundle_id: str) -> Optional[Dict[str, Any]]:
+        if not self.directory:
+            with self._lock:
+                for doc in reversed(self._memory):
+                    if doc["id"] == bundle_id:
+                        return doc
+            return None
+        # ids are filenames minus .json; refuse path traversal
+        if "/" in bundle_id or bundle_id.startswith("."):
+            return None
+        return self._read(os.path.join(self.directory,
+                                       bundle_id + ".json"))
+
+    @staticmethod
+    def _read(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def diff(self, id_a: str, id_b: str) -> Optional[Dict[str, Any]]:
+        """Section-level structural diff between two bundles — the
+        'what changed between the 3am incident and the 4am one' view."""
+        a, b = self.get(id_a), self.get(id_b)
+        if a is None or b is None:
+            return None
+        sa, sb = a.get("sections", {}), b.get("sections", {})
+        common = sorted(set(sa) & set(sb))
+        changed = {}
+        for name in common:
+            da, db = sa[name].get("data"), sb[name].get("data")
+            if da == db:
+                continue
+            if isinstance(da, dict) and isinstance(db, dict):
+                keys = sorted(set(da) | set(db))
+                changed[name] = [k for k in keys
+                                 if da.get(k) != db.get(k)]
+            else:
+                changed[name] = ["<value>"]
+        return {"a": id_a, "b": id_b,
+                "trigger_a": a.get("trigger", {}).get("detector"),
+                "trigger_b": b.get("trigger", {}).get("detector"),
+                "only_in_a": sorted(set(sa) - set(sb)),
+                "only_in_b": sorted(set(sb) - set(sa)),
+                "changed": changed}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"directory": self.directory or "",
+                    "max_bundles": self.max_bundles,
+                    "max_bytes": self.max_bytes,
+                    "cooldown_s": self.cooldown_s,
+                    "written_total": self._written_total,
+                    "dropped_total": self._dropped_total,
+                    "recovered_tmp": self._recovered_tmp,
+                    "quarantined": self._quarantined}
+
+    def census(self) -> Dict[str, Any]:
+        """Deterministic comparison view: per-detector bundle counts
+        (derived from ids — stable across two virtual replays of one
+        trace) plus the written/dropped totals."""
+        by_detector: Dict[str, int] = {}
+        for entry in self.list():
+            d = str(entry.get("detector"))
+            by_detector[d] = by_detector.get(d, 0) + 1
+        with self._lock:
+            return {"written_total": self._written_total,
+                    "dropped_total": self._dropped_total,
+                    "by_detector": dict(sorted(by_detector.items()))}
+
+
+# -- schema -------------------------------------------------------------------
+
+def validate_bundle(doc: Any) -> List[str]:
+    """Schema-v1 validation: a list of problems, [] when valid."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["bundle is not an object"]
+    for key in _REQUIRED_KEYS:
+        if key not in doc:
+            problems.append(f"missing key: {key}")
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {doc.get('schema_version')!r}, "
+            f"want {SCHEMA_VERSION}")
+    if not isinstance(doc.get("id"), str) or not doc.get("id"):
+        problems.append("id must be a non-empty string")
+    if not isinstance(doc.get("captured_wall"), (int, float)):
+        problems.append("captured_wall must be a number")
+    trigger = doc.get("trigger")
+    if not isinstance(trigger, dict):
+        problems.append("trigger must be an object")
+    elif not trigger.get("detector"):
+        problems.append("trigger.detector missing")
+    sections = doc.get("sections")
+    if not isinstance(sections, dict):
+        problems.append("sections must be an object")
+    else:
+        for name, sec in sections.items():
+            if not isinstance(sec, dict) or "ok" not in sec:
+                problems.append(f"section {name}: missing ok flag")
+            elif sec["ok"] and "data" not in sec:
+                problems.append(f"section {name}: ok without data")
+            elif not sec["ok"] and "error" not in sec:
+                problems.append(f"section {name}: failed without error")
+    return problems
+
+
+def config_fingerprint(profile) -> Dict[str, Any]:
+    """Stable digest of the effective scheduler profile — two bundles
+    with different fingerprints were captured under different configs,
+    which is usually the whole diagnosis."""
+    try:
+        import dataclasses
+        if dataclasses.is_dataclass(profile):
+            snap = dataclasses.asdict(profile)
+        else:
+            snap = dict(getattr(profile, "__dict__", {}))
+    # tpulint: disable=exception-taxonomy — a fingerprint must never fail
+    # a capture; an unconvertible profile degrades to its repr
+    except Exception:  # noqa: BLE001
+        snap = {"repr": repr(profile)}
+    snap = {k: v for k, v in snap.items()
+            if isinstance(v, (str, int, float, bool, type(None)))}
+    blob = json.dumps(snap, sort_keys=True, separators=(",", ":"))
+    return {"sha256": hashlib.sha256(blob.encode("utf-8")).hexdigest(),
+            "profile": snap}
+
+
+# -- scheduler wiring ---------------------------------------------------------
+
+def wire_incident_plane(sched, timeline, sentinel,
+                        incidents: IncidentManager) -> None:
+    """Close the loop for one scheduler: curated families onto the
+    timeline, sentinel listening on ticks and pinning into the
+    scheduler's recorder, firings freezing bundles whose sources read
+    the scheduler's own surfaces.  Everything closes over a WEAK ref —
+    the (possibly process-global) plane must not keep a stopped
+    scheduler alive."""
+    from .timeline import register_scheduler_families
+    register_scheduler_families(timeline, sched)
+    sentinel.recorder = sched.recorder
+    sentinel.attach(timeline)
+    ref = weakref.ref(sched)
+    telemetry = bool(getattr(sched, "_telemetry", True))
+
+    def on_firing(firing: Dict[str, Any]) -> None:
+        s = ref()
+        if s is None:
+            return
+        incidents.capture(firing, _bundle_sources(s, timeline, sentinel,
+                                                  telemetry))
+
+    sentinel.on_firing = on_firing
+    timeline.arm_on(sched.clock_handle)
+
+
+def _bundle_sources(s, timeline, sentinel,
+                    telemetry: bool) -> Dict[str, Callable[[], Any]]:
+    """The section callables for one capture — each reads a surface the
+    operator would otherwise have had to curl mid-incident."""
+
+    def explain() -> Dict[str, Any]:
+        doc = s.obs_engine.dump()
+        gangs = {}
+        for name in doc.get("pending_gangs", [])[:_EXPLAIN_GANGS]:
+            gangs[name] = s.obs_engine.explain_gang(name)
+        doc["gangs"] = gangs
+        return doc
+
+    def profiler() -> Dict[str, Any]:
+        # live schedulers only: a fresh bounded capture window, falling
+        # back to the rolling attribution when concurrent captures are
+        # saturated.  Shadows never register this source — a trial must
+        # not read (or block on) the live sampler.
+        if not telemetry:
+            return {"fresh": False, "skipped": "shadow"}
+        from . import default_profiler
+        prof = default_profiler()
+        cap = prof.capture(_PROFILER_CAPTURE_S) if prof.running else None
+        if cap is not None:
+            return {"fresh": True, "stats": cap.stats(),
+                    "top": cap.top_attribution(10)}
+        return {"fresh": False, "health": prof.health()}
+
+    sources: Dict[str, Callable[[], Any]] = {
+        "timeline": lambda: timeline.window(INCIDENT_WINDOW_S),
+        "timeline_stats": timeline.stats,
+        "anomalies": s.recorder.pinned_dump,
+        "explain": explain,
+        "fleetrace": s._fleet.status,
+        "health": s.recorder.health,
+        "sentinel": sentinel.stats,
+        "queues": lambda: dict(s.queue.pending_counts()),
+        "config": lambda: config_fingerprint(s.profile),
+    }
+    if telemetry:
+        sources["profiler"] = profiler
+    return sources

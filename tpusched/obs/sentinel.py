@@ -1,0 +1,340 @@
+"""Anomaly sentinel: the detection stage of the closed incident loop
+(ISSUE 20) — a detector registry evaluated once per timeline tick, with
+hysteresis and cooldown, each firing pinning a flight-recorder anomaly
+and triggering a black-box incident capture (obs/incident.py).
+
+Detectors are PURE functions over the committed timeline sample plus a
+small trailing-baseline view — they hold no locks, touch no scheduler
+state, and a raising detector is counted and skipped, never propagated
+into the housekeeping thread.  Hysteresis (``enter_ticks`` consecutive
+abnormal ticks before firing, ``clear_ticks`` normal ticks before
+re-arming) keeps one noisy sample from paging anyone; cooldown bounds
+bundle volume when a condition oscillates.
+
+Shadow isolation: a ``publish=False`` sentinel evaluates identically
+(virtual-time policy evaluation NEEDS the firings) but never bumps the
+global ``tpusched_sentinel_firings_total`` family — firings pin into
+whatever recorder it was wired with (the shadow's private one).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..util import klog
+from ..util.metrics import sentinel_firings_total
+
+__all__ = ["Detector", "AnomalySentinel", "default_detectors",
+           "BaselineView"]
+
+_FIRINGS_CAP = 256          # bounded firing log (newest kept)
+_BASELINE_TICKS = 30        # trailing window the baselines average over
+DEFAULT_ENTER_TICKS = 3
+DEFAULT_CLEAR_TICKS = 5
+DEFAULT_COOLDOWN_TICKS = 120
+
+
+class BaselineView:
+    """Trailing per-family view handed to detectors: ``mean(name)`` /
+    ``prev(name)`` over the last ``_BASELINE_TICKS`` committed samples,
+    EXCLUDING the sample under evaluation (a collapse must be judged
+    against the healthy past, not against itself)."""
+
+    def __init__(self) -> None:
+        self._history: List[Dict[str, float]] = []
+
+    def push(self, values: Dict[str, float]) -> None:
+        self._history.append(values)
+        if len(self._history) > _BASELINE_TICKS:
+            self._history.pop(0)
+
+    def ticks(self) -> int:
+        return len(self._history)
+
+    def prev(self, name: str) -> Optional[float]:
+        for values in reversed(self._history):
+            if name in values:
+                return values[name]
+        return None
+
+    def mean(self, name: str) -> Optional[float]:
+        xs = [v[name] for v in self._history if name in v]
+        return (sum(xs) / len(xs)) if xs else None
+
+
+class Detector:
+    """One named anomaly check.  ``check(values, baseline)`` returns a
+    detail dict while the condition holds, else None.  The sentinel
+    applies hysteresis/cooldown around it."""
+
+    def __init__(self, name: str,
+                 check: Callable[[Dict[str, float], BaselineView],
+                                 Optional[Dict[str, Any]]],
+                 enter_ticks: int = DEFAULT_ENTER_TICKS,
+                 clear_ticks: int = DEFAULT_CLEAR_TICKS,
+                 cooldown_ticks: int = DEFAULT_COOLDOWN_TICKS):
+        self.name = name
+        self.check = check
+        self.enter_ticks = max(1, int(enter_ticks))
+        self.clear_ticks = max(1, int(clear_ticks))
+        self.cooldown_ticks = max(0, int(cooldown_ticks))
+        # hysteresis state (owned by the sentinel's tick thread)
+        self.abnormal_streak = 0
+        self.normal_streak = 0
+        self.active = False
+        self.cooldown_left = 0
+        self.firings = 0
+
+    def reset(self) -> None:
+        self.abnormal_streak = self.normal_streak = 0
+        self.active = False
+        self.cooldown_left = 0
+
+
+class AnomalySentinel:
+    """Evaluates every registered detector against each timeline tick.
+
+    Wire-up: ``sentinel.attach(timeline)`` registers the sentinel as a
+    tick listener; ``on_firing`` (the incident manager's capture hook)
+    and ``recorder`` (the scheduler's flight recorder, for pinned
+    anomalies) are injected by the scheduler.
+    """
+
+    def __init__(self, detectors: Optional[List[Detector]] = None,
+                 publish: bool = True, recorder=None,
+                 on_firing: Optional[Callable[[Dict[str, Any]], None]]
+                 = None):
+        self.publish = publish
+        self.recorder = recorder
+        self.on_firing = on_firing
+        self._lock = threading.Lock()
+        self._detectors: Dict[str, Detector] = {}
+        for d in (detectors if detectors is not None
+                  else default_detectors()):
+            self._detectors[d.name] = d
+        self._baseline = BaselineView()
+        self._firings: List[Dict[str, Any]] = []
+        self._ticks_total = 0
+        self._errors_total = 0
+        self._attached_to = None
+
+    # -- registry -------------------------------------------------------------
+
+    def register(self, detector: Detector) -> None:
+        """Add or REPLACE a detector (replace resets hysteresis)."""
+        with self._lock:
+            self._detectors[detector.name] = detector
+
+    def detector(self, name: str) -> Optional[Detector]:
+        with self._lock:
+            return self._detectors.get(name)
+
+    def detector_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._detectors)
+
+    def attach(self, timeline) -> None:
+        """Listen on ``timeline`` ticks (idempotent; re-attach moves)."""
+        if self._attached_to is not None \
+                and self._attached_to is not timeline:
+            self._attached_to.remove_listener(self.on_sample)
+        self._attached_to = timeline
+        timeline.add_listener(self.on_sample)
+
+    # -- evaluation -----------------------------------------------------------
+
+    def on_sample(self, sample: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Evaluate every detector against one committed timeline sample.
+        Returns the firings this tick produced (tests drive this
+        directly with synthetic samples)."""
+        values = sample.get("v", {})
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            self._ticks_total += 1
+            detectors = list(self._detectors.values())
+            baseline = self._baseline
+            for d in detectors:
+                if d.cooldown_left > 0:
+                    d.cooldown_left -= 1
+                try:
+                    detail = d.check(values, baseline)
+                # tpulint: disable=exception-taxonomy — a buggy detector
+                # must not take the housekeeping thread down; counted in
+                # errors_total and visible in stats()
+                except Exception:  # noqa: BLE001
+                    self._errors_total += 1
+                    continue
+                if detail is None:
+                    d.abnormal_streak = 0
+                    d.normal_streak += 1
+                    if d.active and d.normal_streak >= d.clear_ticks:
+                        d.active = False
+                    continue
+                d.normal_streak = 0
+                d.abnormal_streak += 1
+                if d.active or d.cooldown_left > 0:
+                    continue
+                if d.abnormal_streak < d.enter_ticks:
+                    continue
+                d.active = True
+                d.cooldown_left = d.cooldown_ticks
+                d.firings += 1
+                firing = {"detector": d.name, "t": sample.get("t"),
+                          "wall": sample.get("wall"), "detail": detail,
+                          "values": dict(values)}
+                self._firings.append(firing)
+                if len(self._firings) > _FIRINGS_CAP:
+                    self._firings.pop(0)
+                fired.append(firing)
+            # the evaluated sample joins the baseline AFTER evaluation:
+            # a collapse is judged against the healthy past only
+            baseline.push(values)
+        for firing in fired:
+            self._emit(firing)
+        return fired
+
+    def _emit(self, firing: Dict[str, Any]) -> None:
+        name = firing["detector"]
+        if self.publish:
+            sentinel_firings_total.with_labels(name).inc()
+        try:
+            from ..trace import pin_event
+            pin_event(f"sentinel_{name}", recorder=self.recorder,
+                      **{k: v for k, v in firing["detail"].items()
+                         if isinstance(v, (str, int, float, bool))})
+        except Exception as e:  # noqa: BLE001 — pinning is advisory
+            klog.V(4).info_s("sentinel pin failed", err=str(e))
+        if self.on_firing is not None:
+            try:
+                self.on_firing(firing)
+            except Exception as e:  # noqa: BLE001 — incident capture
+                # failing must never take detection down with it
+                klog.error_s(e, "incident capture hook failed",
+                             detector=name)
+
+    # -- reads ----------------------------------------------------------------
+
+    def firings(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._firings)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "ticks_total": self._ticks_total,
+                "errors_total": self._errors_total,
+                "firings_total": sum(d.firings
+                                     for d in self._detectors.values()),
+                "detectors": {
+                    d.name: {"firings": d.firings, "active": d.active,
+                             "cooldown_left": d.cooldown_left}
+                    for d in self._detectors.values()},
+            }
+
+    def census(self) -> Dict[str, int]:
+        """{detector: firing count}, zero-suppressed — the deterministic
+        replay/evaluation comparison view."""
+        with self._lock:
+            return {d.name: d.firings
+                    for d in self._detectors.values() if d.firings}
+
+
+# -- the default detector set -------------------------------------------------
+
+def default_detectors(  # noqa: PLR0913 — the knobs ARE the spec
+        collapse_ratio: float = 0.2,
+        collapse_min_baseline: float = 0.5,
+        collapse_min_pending: float = 8.0,
+        burn_threshold: float = 2.0,
+        straggler_rate: float = 1.0,
+        escalation_rate: float = 5.0,
+        quota_conflict_rate: float = 50.0,
+        fanout_backlog: float = 4096.0) -> List[Detector]:
+    """The eight standing detectors.  Thresholds are constructor knobs so
+    tests and benches can tighten them; the defaults are deliberately
+    conservative — a sentinel that cries wolf gets disabled in a week.
+    Detectors returning None when their family is absent makes every one
+    of them safe on shadow timelines (global-metric families are live
+    schedulers only)."""
+
+    def bind_rate_collapse(v, base):
+        rate, pending = v.get("bind_rate"), v.get("pending_pods", 0.0)
+        if rate is None or pending < collapse_min_pending:
+            return None
+        mean = base.mean("bind_rate")
+        if mean is None or mean < collapse_min_baseline:
+            return None
+        if rate < collapse_ratio * mean:
+            return {"bind_rate": rate, "baseline": mean,
+                    "pending_pods": pending,
+                    "reason": "bind rate collapsed vs trailing baseline "
+                              "while pods stayed pending"}
+        return None
+
+    def slo_burn_spike(v, base):
+        burn = v.get("slo_burn")
+        if burn is not None and burn > burn_threshold:
+            return {"burn_rate": burn, "threshold": burn_threshold,
+                    "reason": "SLO burn rate above threshold"}
+        return None
+
+    def straggler_storm(v, base):
+        rate = v.get("stragglers")
+        if rate is not None and rate > straggler_rate:
+            return {"straggler_edges_per_s": rate,
+                    "reason": "gang straggler edges accruing fleet-wide"}
+        return None
+
+    def shard_starvation(v, base):
+        rate = v.get("shard_escalations")
+        if rate is not None and rate > escalation_rate:
+            return {"escalations_per_s": rate,
+                    "reason": "shard escalations hot — lanes starving "
+                              "behind the global lane"}
+        return None
+
+    def quota_conflict_hot_loop(v, base):
+        rate = v.get("quota_conflicts")
+        if rate is not None and rate > quota_conflict_rate:
+            return {"quota_conflicts_per_s": rate,
+                    "reason": "quota compare-and-reserve conflicts "
+                              "looping hot"}
+        return None
+
+    def degraded_mode_entry(v, base):
+        cur = v.get("degraded", 0.0)
+        prev = base.prev("degraded")
+        if cur >= 1.0 and (prev is None or prev < 1.0):
+            return {"reason": "scheduler entered degraded mode "
+                              "(pop-dispatch paused after API retry "
+                              "exhaustion)"}
+        return None
+
+    def native_differential_mismatch(v, base):
+        rate = v.get("native_mismatches")
+        if rate is not None and rate > 0.0:
+            return {"mismatches_per_s": rate,
+                    "reason": "native dispatch disagreed with the "
+                              "pure-Python oracle"}
+        return None
+
+    def watch_fanout_backlog(v, base):
+        depth = v.get("fanout_backlog")
+        if depth is not None and depth > fanout_backlog:
+            return {"queue_depth": depth,
+                    "reason": "apiserver watch fan-out backlog growing"}
+        return None
+
+    return [
+        Detector("bind_rate_collapse", bind_rate_collapse),
+        Detector("slo_burn_spike", slo_burn_spike),
+        Detector("straggler_storm", straggler_storm),
+        Detector("shard_starvation", shard_starvation),
+        Detector("quota_conflict_hot_loop", quota_conflict_hot_loop),
+        # entry is an EDGE — one tick is the event
+        Detector("degraded_mode_entry", degraded_mode_entry,
+                 enter_ticks=1),
+        Detector("native_differential_mismatch",
+                 native_differential_mismatch, enter_ticks=1),
+        Detector("watch_fanout_backlog", watch_fanout_backlog),
+    ]

@@ -19,10 +19,12 @@ not scheduling throughput, is the bottleneck).  Queue depths themselves
 are already exposed as ``tpusched_pending_pods{queue=...}``.
 
 Shadow isolation: a ``publish=False`` instance (what-if planner, defrag
-trials) is an inert shell — every feed method is a no-op and no gauge is
-registered, so a trial run can never publish hypothetical binds/sec as
-fleet throughput.  The hot-path cost of a publishing instance is one
-counter increment (arrivals also append one float to a bounded deque).
+trials) is a publish-inert shell — no counter children, no gauges, so a
+trial run can never publish hypothetical binds/sec as fleet throughput.
+Feed methods still bump two PRIVATE ints (``binds_observed``,
+``cycles_observed``) that only the instance's own health timeline
+reads.  The hot-path cost of a publishing instance is one counter
+increment (arrivals also append one float to a bounded deque).
 """
 from __future__ import annotations
 
@@ -48,6 +50,14 @@ class ThroughputTelemetry:
         self.publish = publish
         self._clock = clock
         self._window_s = window_s
+        # private tallies kept even when publish=False: the health
+        # timeline (obs/timeline.py) derives its bind/cycle rate
+        # families from these, and a SHADOW scheduler's private timeline
+        # (virtual-time replay) needs real counts without touching the
+        # global tpusched_binds_total family. Plain ints: += 1 is
+        # GIL-atomic, and an approximate read is fine for a rate family.
+        self.binds_observed = 0
+        self.cycles_observed = 0
         # deque.append is atomic under the GIL; the rate reader copies.
         self._arrivals: "collections.deque[float]" = collections.deque(
             maxlen=_ARRIVAL_CAP)
@@ -97,6 +107,7 @@ class ThroughputTelemetry:
             self._arrivals.append(self._clock())
 
     def on_cycle(self, shard: str = "") -> None:
+        self.cycles_observed += 1
         if self.publish:
             child = self._cycles.get(shard)
             if child is None:
@@ -105,6 +116,7 @@ class ThroughputTelemetry:
             child.inc()
 
     def on_bind(self, shard: str = "") -> None:
+        self.binds_observed += 1
         if self.publish:
             child = self._binds.get(shard)
             if child is None:

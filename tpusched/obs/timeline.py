@@ -1,0 +1,463 @@
+"""Fleet health timeline: the bounded in-process time-series ring every
+other incident-plane piece stands on (ISSUE 20).
+
+Every observability surface built so far answers "what is happening
+NOW" — the flight recorder's last N cycles, /debug/explain's rolling
+rejections, the profiler's rolling sample window.  None of them records
+how fleet health EVOLVED, so by the time a human looks at a 3am wedge
+the evidence has scrolled out of the bounded rings.  ``HealthTimeline``
+closes that gap: one sample per tick over a curated family set (bind
+rate, pending depth, queue-wait/pod-e2e quantiles, SLO burn,
+fragmentation, shard/quota conflict rates, degraded-mode gauge,
+native-dispatch fallback rate, lock wait, bind-pool backlog, watch
+fan-out backlog), entry+byte budgeted, overflow counted never stored.
+
+Clock discipline: the timeline ticks on the scheduler's injected
+``Clock`` (util/clock.py).  Live, the housekeeping lane paces
+``maybe_tick()`` once a second under WallClock.  Under VirtualClock
+replay the timeline ARMS its next tick in the clock's deadline registry
+(``arm_on``), so ``sim/replay.advance_until`` jumps to every tick
+boundary and ``Scheduler.run_timers_once`` fires it — a recorded hour
+replayed at 376x yields the full hour's timeline, deterministically.
+
+Shadow isolation: a ``publish=False`` timeline samples into its own
+ring (virtual-time replay needs the data) but never touches the global
+``tpusched_timeline_*`` counters.  The /debug/timeline route resolves
+the process-global instance (obs.default_timeline) at request time.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..util import klog
+from ..util.clock import WALL, Clock
+from ..util.metrics import (timeline_overflow_total, timeline_samples_total)
+
+__all__ = ["HealthTimeline", "register_scheduler_families",
+           "DEFAULT_INTERVAL_S", "DEFAULT_MAX_SAMPLES", "DEFAULT_MAX_BYTES"]
+
+DEFAULT_INTERVAL_S = 1.0
+# ~68 min at 1 Hz; the byte budget is the binding bound under wide
+# family sets (each sample is a flat {family: float} dict)
+DEFAULT_MAX_SAMPLES = 4096
+DEFAULT_MAX_BYTES = 1 << 20
+
+_TICK_LABEL = "timeline-tick"
+# fragmentation is the one non-O(1) family: recompute at most every
+# N ticks AND only when the cache mutation cursor moved (capacity.py
+# rate-limits its scrape-time twin the same way)
+_FRAG_EVERY_TICKS = 15
+
+
+class HealthTimeline:
+    """Bounded time-series ring over registered health families.
+
+    A FAMILY is ``(name, fn, kind)``: ``fn()`` returns the current value
+    (float, or None for "no reading this tick").  ``kind="gauge"``
+    samples the value as-is; ``kind="rate"`` treats the value as a
+    cumulative counter and stores the per-second delta between ticks
+    (first tick of a rate family stores 0.0 — no baseline yet).  Family
+    functions must be cheap and must never block on scheduler locks held
+    across I/O; exceptions are swallowed and counted (``errors_total``),
+    never propagated into the dispatch/housekeeping thread.
+    """
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 max_samples: int = DEFAULT_MAX_SAMPLES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 publish: bool = True,
+                 clock: Optional[Clock] = None):
+        self.interval_s = float(interval_s)
+        self.max_samples = int(max_samples)
+        self.max_bytes = int(max_bytes)
+        self.publish = publish
+        self._clock: Clock = clock if clock is not None else WALL
+        self._lock = threading.Lock()
+        self._families: Dict[str, Tuple[Callable[[], Any], str]] = {}
+        self._rate_last: Dict[str, Tuple[float, float]] = {}  # name -> (t, raw)
+        self._samples: List[Dict[str, Any]] = []
+        self._bytes = 0
+        self._listeners: List[Callable[[Dict[str, Any]], None]] = []
+        self._last_tick = -1e18
+        self._tick_token: Optional[int] = None
+        self._armed = False
+        # counters mirrored locally so a publish=False shadow still
+        # reports its own census (replay determinism reads these)
+        self._samples_total = 0
+        self._overflow_total = 0
+        self._errors_total = 0
+        self._tick_seconds_total = 0.0
+
+    # -- family registry ------------------------------------------------------
+
+    def register_family(self, name: str, fn: Callable[[], Any],
+                        kind: str = "gauge") -> None:
+        """Register (or REPLACE — re-register-replaces, same semantics as
+        gauge_func) a health family.  ``kind`` is ``gauge`` or ``rate``."""
+        if kind not in ("gauge", "rate"):
+            raise ValueError(f"unknown family kind {kind!r}")
+        with self._lock:
+            self._families[name] = (fn, kind)
+            self._rate_last.pop(name, None)
+
+    def unregister_family(self, name: str) -> None:
+        with self._lock:
+            self._families.pop(name, None)
+            self._rate_last.pop(name, None)
+
+    def family_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._families)
+
+    def add_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Listeners run after each tick, OUTSIDE the ring lock, with the
+        committed sample (the sentinel hooks here).  A raising listener
+        is counted as an error, never propagated."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    # -- ticking --------------------------------------------------------------
+
+    def arm_on(self, clock: Clock) -> None:
+        """Adopt ``clock`` as the tick clock and arm the next tick in its
+        deadline registry.  Under WallClock ``arm`` is a no-op (the live
+        housekeeping lane paces ``maybe_tick`` itself); under
+        VirtualClock this is what makes ``advance_to_next_deadline``
+        stop at every tick boundary, so a replayed hour accrues the full
+        hour's samples."""
+        with self._lock:
+            self._clock = clock
+            self._armed = True
+            self._rearm_locked(self._clock.now())
+
+    def _rearm_locked(self, now: float) -> None:
+        if not self._armed:
+            return
+        if self._tick_token is not None:
+            try:
+                self._clock.cancel(self._tick_token)
+            # tpulint: disable=exception-taxonomy — best-effort cancel of
+            # a possibly already-fired (stale) deadline token; the rearm
+            # below is the operation that matters
+            except Exception:  # noqa: BLE001
+                pass
+        self._tick_token = self._clock.arm(_TICK_LABEL,
+                                           now + self.interval_s)
+
+    def disarm(self) -> None:
+        """Stop arming tick deadlines (``maybe_tick`` still works).  The
+        virtual-time replay driver calls this when the recorded span
+        ends: a perpetually re-armed tick would keep the drain loop's
+        "nothing armed → genuinely unplaceable" exit from ever firing,
+        and post-span tick counts would become wall-bounded — i.e.
+        nondeterministic across two replays of one trace."""
+        with self._lock:
+            self._armed = False
+            if self._tick_token is not None:
+                try:
+                    self._clock.cancel(self._tick_token)
+                # tpulint: disable=exception-taxonomy — best-effort cancel
+                # of a possibly already-fired token during disarm teardown
+                except Exception:  # noqa: BLE001
+                    pass
+                self._tick_token = None
+
+    def maybe_tick(self, now: Optional[float] = None) -> bool:
+        """Tick iff a full interval elapsed since the last tick.  Safe to
+        call from any thread at any cadence — this is the live
+        housekeeping pacing AND the replay driver's fire point."""
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            if now - self._last_tick < self.interval_s:
+                return False
+        self.tick(now)
+        return True
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Sample every family once and commit one ring entry."""
+        if now is None:
+            now = self._clock.now()
+        t0 = self._clock.now()
+        with self._lock:
+            families = list(self._families.items())
+            self._last_tick = now
+        values: Dict[str, float] = {}
+        errors = 0
+        for name, (fn, kind) in families:
+            try:
+                raw = fn()
+            # tpulint: disable=exception-taxonomy — a failing family must
+            # not take the housekeeping/dispatch thread down; the failure
+            # is counted (errors_total) and visible in stats()
+            except Exception:  # noqa: BLE001
+                errors += 1
+                continue
+            if raw is None:
+                continue
+            raw = float(raw)
+            if kind == "rate":
+                last = self._rate_last.get(name)
+                self._rate_last[name] = (now, raw)
+                if last is None:
+                    values[name] = 0.0
+                else:
+                    dt = max(now - last[0], 1e-9)
+                    values[name] = max(0.0, (raw - last[1]) / dt)
+            else:
+                values[name] = raw
+        sample = {"t": now, "wall": self._clock.wall(), "v": values}
+        # flat floats: ~16 bytes of overhead per family entry is a good
+        # stable approximation without a json.dumps per tick
+        approx = 32 + sum(len(k) + 16 for k in values)
+        with self._lock:
+            self._samples.append(sample)
+            self._bytes += approx
+            self._samples_total += 1
+            self._errors_total += errors
+            evicted = 0
+            while self._samples and (
+                    len(self._samples) > self.max_samples
+                    or self._bytes > self.max_bytes):
+                old = self._samples.pop(0)
+                self._bytes -= 32 + sum(len(k) + 16 for k in old["v"])
+                evicted += 1
+            if not self._samples:
+                self._bytes = 0
+            self._overflow_total += evicted
+            listeners = list(self._listeners)
+            self._rearm_locked(now)
+            self._tick_seconds_total += max(0.0, self._clock.now() - t0)
+        if self.publish:
+            timeline_samples_total.inc()
+            if evicted:
+                timeline_overflow_total.inc(evicted)
+        for fn in listeners:
+            try:
+                fn(sample)
+            except Exception as e:  # noqa: BLE001 — listener bugs are
+                # observability bugs, not scheduling bugs
+                with self._lock:
+                    self._errors_total += 1
+                klog.V(4).info_s("timeline listener failed", err=str(e))
+        return sample
+
+    # -- reads ----------------------------------------------------------------
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._samples)
+
+    def window(self, seconds: float,
+               now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Samples with ``t >= now - seconds`` (oldest first)."""
+        if now is None:
+            now = self._clock.now()
+        horizon = now - seconds
+        with self._lock:
+            return [s for s in self._samples if s["t"] >= horizon]
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._samples[-1] if self._samples else None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._samples),
+                "approx_bytes": self._bytes,
+                "max_samples": self.max_samples,
+                "max_bytes": self.max_bytes,
+                "interval_s": self.interval_s,
+                "families": sorted(self._families),
+                "samples_total": self._samples_total,
+                "overflow_total": self._overflow_total,
+                "errors_total": self._errors_total,
+                "tick_seconds_total": self._tick_seconds_total,
+                "armed": self._armed,
+            }
+
+    def dump(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """The /debug/timeline document."""
+        samples = (self.window(window_s) if window_s is not None
+                   else self.samples())
+        return {"stats": self.stats(), "samples": samples}
+
+    def census(self) -> Dict[str, Any]:
+        """The deterministic replay-comparison view: counts only, no
+        wall stamps (two virtual replays of one trace must render this
+        byte-identically)."""
+        with self._lock:
+            return {"samples_total": self._samples_total,
+                    "overflow_total": self._overflow_total,
+                    "entries": len(self._samples),
+                    "families": sorted(self._families)}
+
+    def census_json(self) -> str:
+        return json.dumps(self.census(), sort_keys=True,
+                          separators=(",", ":"))
+
+
+# -- the curated scheduler family set -----------------------------------------
+
+def register_scheduler_families(timeline: HealthTimeline, sched) -> None:
+    """Register the curated family set for one scheduler.
+
+    Every family closes over a WEAK reference — the (possibly global)
+    timeline must not keep a stopped scheduler alive; a dead ref reads
+    as None and the family simply stops producing values (same
+    discipline as the registry's gauge_func pruning).  Re-registration
+    replaces: in-process restarts (HA failover, bench arms) take the
+    families over instead of sampling a corpse.
+
+    Global-metric families (queue-wait/pod-e2e/lock-wait quantiles,
+    native-dispatch and shard/quota conflict counters) are registered
+    only for ``telemetry=True`` schedulers — a shadow reading global
+    counters would fold live-fleet deltas into its private trial
+    timeline.
+    """
+    ref = weakref.ref(sched)
+    telemetry = bool(getattr(sched, "_telemetry", True))
+
+    def _with(fn):
+        def read():
+            live = ref()
+            return None if live is None else fn(live)
+        return read
+
+    timeline.register_family(
+        "bind_rate", _with(lambda s: s._throughput.binds_observed), "rate")
+    timeline.register_family(
+        "cycle_rate", _with(lambda s: s.cycles_finished), "rate")
+    timeline.register_family(
+        "pending_pods",
+        _with(lambda s: sum(s.queue.pending_counts().values())))
+    timeline.register_family("pending_gangs", _with(_pending_gangs))
+    timeline.register_family(
+        "bind_backlog", _with(lambda s: s._bind_pool.backlog()))
+    timeline.register_family(
+        "degraded", _with(lambda s: 1.0 if s._degraded.active() else 0.0))
+    timeline.register_family(
+        "shard_escalations", _with(lambda s: s._router.escalations()),
+        "rate")
+    timeline.register_family(
+        "fanout_backlog",
+        _with(lambda s: float(
+            s.recorder.health().get("fanout", {}).get("queue_depth", 0))))
+    timeline.register_family(
+        "stragglers",
+        _with(lambda s: s._goodput.stats().get("straggler_edges_total", 0)),
+        "rate")
+    timeline.register_family("slo_burn", _with(_slo_burn))
+    timeline.register_family("frag_largest_placeable",
+                             _frag_family(ref, timeline))
+
+    if not telemetry:
+        return
+    # global-metric families: live schedulers only (guard above)
+    from ..util import metrics as m
+    timeline.register_family(
+        "queue_wait_p99", lambda: _vec_q99(m.queue_wait_seconds))
+    timeline.register_family(
+        "pod_e2e_p99", lambda: m.e2e_scheduling_seconds.quantile(0.99))
+    timeline.register_family(
+        "lock_wait_p99", lambda: _vec_q99(m.lock_wait_seconds))
+    timeline.register_family(
+        "shard_conflicts", m.shard_conflicts_total.value, "rate")
+    timeline.register_family(
+        "quota_conflicts", m.shard_quota_conflicts_total.value, "rate")
+    timeline.register_family(
+        "native_fallbacks", m.native_dispatch_fallbacks.value, "rate")
+    timeline.register_family(
+        "native_mismatches",
+        m.native_dispatch_differential_mismatches.value, "rate")
+
+
+def _pending_gangs(s) -> float:
+    gangs = set()
+
+    def visit(wp):
+        g = getattr(wp, "gang", None) or getattr(wp, "gang_name", None)
+        gangs.add(g if g else getattr(wp, "pod_key", id(wp)))
+    try:
+        s._fw.iterate_over_waiting_pods(visit)
+    # tpulint: disable=exception-taxonomy — advisory census read off a
+    # live queue; a racing mutation yields one missing sample, not an
+    # error worth the housekeeping thread
+    except Exception:  # noqa: BLE001
+        return 0.0
+    return float(len(gangs))
+
+
+def _slo_burn(s) -> Optional[float]:
+    # live schedulers hold _slo=None and resolve the process-global
+    # tracker; shadows hold a private publish=False tracker
+    if s._telemetry:
+        from . import default_slo
+        tracker = default_slo()
+    else:
+        tracker = s._slo
+    if tracker is None:
+        return None
+    burns = [doc.get("burn_rate", 0.0)
+             for doc in tracker.summary().values()]
+    return max(burns) if burns else 0.0
+
+
+def _vec_q99(vec) -> float:
+    children = vec.children()
+    if not children:
+        return 0.0
+    return max(c.quantile(0.99) for c in children.values())
+
+
+def _frag_family(ref, timeline: HealthTimeline):
+    """Largest placeable slice (chips) over all pools — the one
+    non-O(1) family, so it is memoized on the cache mutation cursor and
+    recomputed at most every ``_FRAG_EVERY_TICKS`` ticks (capacity.py
+    rate-limits its scrape-time twin the same way; trend data, not a
+    scheduling input)."""
+    memo = {"cursor": None, "tick": -_FRAG_EVERY_TICKS, "value": None,
+            "n": 0}
+
+    def read():
+        s = ref()
+        if s is None:
+            return None
+        memo["n"] += 1
+        if (memo["value"] is not None
+                and memo["n"] - memo["tick"] < _FRAG_EVERY_TICKS):
+            return memo["value"]
+        try:
+            cursor = s.cache.mutation_cursor()
+            if cursor == memo["cursor"] and memo["value"] is not None:
+                memo["tick"] = memo["n"]
+                return memo["value"]
+            from .capacity import HostGrid, largest_placeable_chips
+            snapshot = s.cache.shared_snapshot()
+            best = 0
+            for topo in s.informer_factory.tputopologies().items():
+                grid = HostGrid.from_spec(topo.spec)
+                if grid is None:
+                    continue
+                placeable, _, _ = largest_placeable_chips(grid, snapshot)
+                best = max(best, placeable)
+            memo.update(cursor=cursor, tick=memo["n"], value=float(best))
+            return memo["value"]
+        # tpulint: disable=exception-taxonomy — advisory trend family:
+        # on any failure serve the memoized last-good value rather than
+        # poison the whole sample
+        except Exception:  # noqa: BLE001
+            return memo["value"]
+    return read

@@ -815,6 +815,28 @@ class Scheduler:
         # hypothetical pool/quota gauges as real fleet state
         self._capacity = obs_mod.CapacityTelemetry(self) if telemetry \
             else None
+        # The closed incident plane (ISSUE 20): health timeline +
+        # anomaly sentinel + black-box incident bundles.  Live
+        # schedulers wire the process-global instances (the bundle dir
+        # arms from TPUSCHED_INCIDENT_DIR); shadows get private
+        # publish=False instances on the scheduler's (possibly virtual)
+        # clock with an in-memory bundle ring — the virtual-time
+        # replay/evaluation plane accrues the same timeline and incident
+        # censuses a live hour would, deterministically, without
+        # touching the operator's black box.
+        if telemetry:
+            self._timeline = obs_mod.default_timeline()
+            self._sentinel = obs_mod.default_sentinel()
+            self._incidents = obs_mod.ensure_incidents()
+        else:
+            self._timeline = obs_mod.HealthTimeline(
+                publish=False, clock=self.clock_handle)
+            self._sentinel = obs_mod.AnomalySentinel(
+                publish=False, recorder=self.recorder)
+            self._incidents = obs_mod.IncidentManager(
+                publish=False, clock=self.clock_handle)
+        obs_mod.wire_incident_plane(self, self._timeline, self._sentinel,
+                                    self._incidents)
         self._wire_informers()
 
     @property
@@ -1096,6 +1118,10 @@ class Scheduler:
                     if self._sharded:
                         self._publish_shard_health()
                     self._publish_index_health()
+                    # health timeline tick (obs/timeline.py): paced
+                    # here under WallClock; maybe_tick re-checks the
+                    # interval on the timeline's own clock
+                    self._timeline.maybe_tick()
             # degraded mode: pausing the pop IS the backoff — failed cycles
             # against a dead apiserver would only re-queue themselves
             pause = self._degraded.pause_remaining()
@@ -1188,6 +1214,11 @@ class Scheduler:
             tick = getattr(plugin, "on_clock_tick", None)
             if tick is not None:
                 tick()
+        # virtual-time health timeline: the replay driver jumps the
+        # clock to the armed timeline-tick deadline and this fires it
+        # (tick re-arms the next one); under WallClock the housekeeping
+        # lane paces this instead and the call is an interval re-check
+        self._timeline.maybe_tick(now)
         return expired
 
     def _publish_shard_health(self) -> None:

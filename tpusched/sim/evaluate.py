@@ -174,6 +174,8 @@ def summarize_arm(trace: FleetTrace, report: dict,
                   pods: Optional[Dict[str, Any]] = None) -> dict:
     """One arm's scheduling-quality digest from its replay report."""
     slo = report.get("slo") or {}
+    incidents = report.get("incidents") or {}
+    sentinel_census = incidents.get("sentinel") or {}
     return {
         "binds": report.get("binds", 0),
         "unbound": len(report.get("unbound", ())),
@@ -187,6 +189,13 @@ def summarize_arm(trace: FleetTrace, report: dict,
                                     matrix=matrix,
                                     generations=generations, pods=pods),
         "virtual_time": report.get("virtual_time"),
+        # the incident plane in virtual time (ISSUE 20): the shadow
+        # sentinel's per-detector firing census + the shadow bundle ring.
+        # A policy that wedges gangs does not just lose JCT points — it
+        # FAILS its evaluation, with the bundle census attached.
+        "timeline": report.get("timeline") or {},
+        "incidents": incidents,
+        "incidents_fired": sum(sentinel_census.values()),
     }
 
 
@@ -234,6 +243,8 @@ def compare_arms(base: dict, cand: dict, placement_diff: dict) -> dict:
         "only_in_base": len(placement_diff.get("only_in_a", ())),
         "only_in_candidate": len(placement_diff.get("only_in_b", ())),
         "identical_placements": placement_diff.get("identical", False),
+        "incidents_fired_delta": (cand.get("incidents_fired", 0)
+                                  - base.get("incidents_fired", 0)),
     }
 
 
@@ -277,6 +288,22 @@ def evaluate_arms(trace_dir: str, arms: List[ArmSpec], *,
             "deltas": compare_arms(arm_docs[0]["summary"],
                                    arm_docs[i]["summary"], diff),
         })
+    # The closed incident loop in virtual time: an arm whose replay fired
+    # the anomaly sentinel is a wedge failure — the policy produced a
+    # fleet state bad enough that the live plane would have cut a black
+    # box.  Attach the detector census so the verdict names the failure
+    # mode, not just a JCT delta.
+    incident_failures = []
+    for doc in arm_docs:
+        census = (doc["summary"].get("incidents") or {})
+        fired = doc["summary"].get("incidents_fired", 0)
+        if fired:
+            incident_failures.append({
+                "arm": doc["name"],
+                "firings": fired,
+                "detectors": census.get("sentinel") or {},
+                "bundles": census.get("bundles") or {},
+            })
     return {
         "trace": trace_dir,
         "workload_fingerprint": reports[0].get("workload_fingerprint")
@@ -285,4 +312,5 @@ def evaluate_arms(trace_dir: str, arms: List[ArmSpec], *,
         "matrix_cells": matrix.size(),
         "arms": arm_docs,
         "comparisons": comparisons,
+        "incident_failures": incident_failures,
     }

@@ -165,6 +165,15 @@ class ReplayReport:
     # the shadow scheduler's own SLO tracker summary, observed on replay
     # time (obs/slo.SLOTracker.summary(): attainment/burn/p50/p99/span)
     slo: dict = dataclasses.field(default_factory=dict)
+    # -- the incident plane in virtual time (ISSUE 20) --
+    # the shadow scheduler's private health-timeline census (sample/
+    # overflow counts + family set): two virtual replays of one trace
+    # must render this byte-identically — the determinism smoke pins it
+    timeline: dict = dataclasses.field(default_factory=dict)
+    # sentinel firings by detector + incident-bundle census from the
+    # shadow's in-memory ring: a policy that wedges gangs surfaces here,
+    # and cmd.trace evaluate fails the arm on it
+    incidents: dict = dataclasses.field(default_factory=dict)
     # per-sample fragmentation trajectory rides in pool_utilization
     # (each sample carries a "frag" map when topologies are present)
 
@@ -689,6 +698,14 @@ def run_replay(trace_dir: str, *,
                                        fragmentation_curve, clk))
         feed_window = time.monotonic() - start
 
+        # the recorded span is over: stop the shadow timeline re-arming
+        # its tick deadline.  Left armed, the drain loop below could
+        # never hit its "nothing armed -> genuinely unplaceable" exit,
+        # and post-span tick counts would be bounded by WALL timeouts —
+        # nondeterministic across two replays of the same trace (the
+        # incident-plane determinism gate pins the sample census)
+        sched._timeline.disarm()
+
         # drain: give in-flight gangs a bounded chance to finish binding.
         # Virtual time drains by firing armed gates forward (a gang held
         # by its denial window or backoff ladder needs the clock, not
@@ -793,7 +810,10 @@ def run_replay(trace_dir: str, *,
                         "events": len(qdelay)},
         retries={k: attempts_of[k] for k in retried[:_RETRIES_CAP]},
         retries_truncated=len(retried) > _RETRIES_CAP,
-        slo=sched._slo.summary() if sched._slo is not None else {})
+        slo=sched._slo.summary() if sched._slo is not None else {},
+        timeline=sched._timeline.census(),
+        incidents={"sentinel": sched._sentinel.census(),
+                   "bundles": sched._incidents.census()})
 
 
 def _pool_usage(api: APIServer, pool_of: Dict[str, str],

@@ -182,6 +182,14 @@ class FlightRecorder:
             else:
                 self._health[component] = dict(state)
 
+    def health(self) -> Dict[str, Dict[str, Any]]:
+        """Every published health section, by component — the incident
+        plane (obs/incident.py) freezes this whole map into a black-box
+        bundle, and the health timeline samples single fields from it
+        (fan-out backlog) without paying for a full dump()."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._health.items()}
+
     # -- views (the /debug surface) ------------------------------------------
 
     def traces(self) -> List[CycleTrace]:

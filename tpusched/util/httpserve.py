@@ -73,6 +73,11 @@ DEBUG_ENDPOINTS = {
     "/debug/goodput": "gang runtime goodput: per-gang health, straggler "
                       "attribution, workload×generation throughput "
                       "matrix (?gang= for one gang)",
+    "/debug/timeline": "fleet health timeline: bounded time-series ring "
+                       "over bind rate, pending depth, SLO burn, "
+                       "fragmentation, conflicts (?window= seconds)",
+    "/debug/incidents": "black-box incident bundles: sentinel firings + "
+                        "bundle index (?id= for one full bundle)",
     "/debug/vars": "process variables (thread count)",
 }
 
@@ -135,6 +140,25 @@ class MetricsServer:
                     if prof.running:
                         dump.setdefault("health", {})["profiler"] = \
                             prof.health()
+                    # native batched dispatch (ISSUE 16) counters as a
+                    # health section: cycles/pods through the kernel,
+                    # declines by reason, and the oracle-mismatch count
+                    # that MUST stay 0 — the first read of the ops
+                    # runbook's native-dispatch triage
+                    from . import metrics as m
+                    dump.setdefault("health", {})["native"] = {
+                        "cycles_total":
+                            m.native_dispatch_cycles_total.value(),
+                        "pods_total":
+                            m.native_dispatch_pods_total.value(),
+                        "fallbacks_by_reason": {
+                            k[0]: c.value() for k, c in
+                            m.native_dispatch_fallbacks.children()
+                            .items()},
+                        "differential_mismatches_total":
+                            m.native_dispatch_differential_mismatches
+                            .value(),
+                    }
                     self._send_json(dump)
                 elif path == "/debug/profile":
                     code, body, ctype = self._profile_payload(query)
@@ -152,6 +176,12 @@ class MetricsServer:
                     code, payload = self._goodput_payload(query)
                     self._send(code, json.dumps(payload) + "\n",
                                "application/json")
+                elif path == "/debug/timeline":
+                    self._send_json(self._timeline_payload(query))
+                elif path == "/debug/incidents":
+                    code, payload = self._incidents_payload(query)
+                    self._send(code, json.dumps(payload, default=str)
+                               + "\n", "application/json")
                 elif path in ("/debug", "/debug/"):
                     self._send_json({"endpoints": DEBUG_ENDPOINTS})
                 elif path == "/debug/vars":
@@ -222,6 +252,49 @@ class MetricsServer:
                                               "members never reported)"}
                     return 200, out
                 return 200, agg.dump()
+
+            def _timeline_payload(self, query: str):
+                """/debug/timeline: the fleet health time-series ring
+                (tpusched/obs/timeline.py).  ``?window=SECONDS`` bounds
+                the returned samples; default is the full ring."""
+                from .. import obs
+                qs = urllib.parse.parse_qs(query)
+                # tpulint: disable=shadow-isolation — the debug server
+                # serves the LIVE process surfaces by contract; shadow
+                # schedulers never mount an HTTP server
+                tl = obs.default_timeline()
+                try:
+                    window = float(qs["window"][0]) if "window" in qs \
+                        else None
+                except ValueError:
+                    window = None
+                return tl.dump(window)
+
+            def _incidents_payload(self, query: str):
+                """/debug/incidents: the black-box bundle surface
+                (tpusched/obs/incident.py) — sentinel state + bundle
+                index; ``?id=`` serves one full bundle."""
+                from .. import obs
+                qs = urllib.parse.parse_qs(query)
+                # tpulint: disable=shadow-isolation — the debug server
+                # serves the LIVE process surfaces by contract; shadow
+                # schedulers never mount an HTTP server
+                mgr = obs.default_incidents()
+                bundle_id = qs.get("id", [None])[0]
+                if bundle_id is not None:
+                    doc = mgr.get(bundle_id)
+                    if doc is None:
+                        return 404, {"error": f"no bundle {bundle_id!r} "
+                                              "(evicted by the disk "
+                                              "budget, or never written)"}
+                    return 200, doc
+                # tpulint: disable=shadow-isolation — live surface,
+                # same contract as default_incidents above
+                sentinel = obs.default_sentinel()
+                return 200, {"stats": mgr.stats(),
+                             "sentinel": sentinel.stats(),
+                             "firings": sentinel.firings()[-32:],
+                             "bundles": mgr.list()}
 
             def _explain_payload(self, query: str):
                 """/debug/explain: the why-pending diagnosis surface.

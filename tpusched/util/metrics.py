@@ -708,3 +708,27 @@ fanout_flush_seconds = REGISTRY.histogram(
     "tpusched_fanout_flush_seconds",
     "Commit-to-delivery latency of coalesced watch flush batches.",
     buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, 1.0))
+
+# The closed incident plane (obs/timeline.py, obs/sentinel.py,
+# obs/incident.py — ISSUE 20).  timeline_samples counts committed health
+# ticks; timeline_overflow counts ring entries EVICTED under the
+# entry/byte budget (counted, never stored — the always-on discipline).
+# sentinel_firings is labeled by detector so a dashboard can alert on
+# one anomaly class; incident bundle written/dropped split tells an
+# operator whether the black box actually has the 3am evidence or the
+# disk budget ate it.
+timeline_samples_total = REGISTRY.counter(
+    "tpusched_timeline_samples_total",
+    "Health timeline ticks committed to the in-process ring.")
+timeline_overflow_total = REGISTRY.counter(
+    "tpusched_timeline_overflow_total",
+    "Timeline ring entries evicted under the entry/byte budget.")
+sentinel_firings_total = REGISTRY.counter_vec(
+    "tpusched_sentinel_firings_total", ("detector",),
+    "Anomaly sentinel firings, by detector.")
+incident_bundles_written_total = REGISTRY.counter(
+    "tpusched_incident_bundles_written_total",
+    "Black-box incident bundles committed (atomic write or memory ring).")
+incident_bundles_dropped_total = REGISTRY.counter(
+    "tpusched_incident_bundles_dropped_total",
+    "Incident bundles dropped or evicted (budget, cooldown excluded).")
